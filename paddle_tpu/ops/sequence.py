@@ -1,0 +1,228 @@
+"""Ragged-sequence ops — the TPU-native replacement for LoDTensor.
+
+The reference packs variable-length sequences without padding via LoD offsets
+(reference: paddle/fluid/framework/lod_tensor.h:110,229) and operates on them
+with 46 sequence ops (reference: paddle/fluid/operators/sequence_ops/).
+That representation is shape-dynamic and XLA-hostile (SURVEY §5.7, §7).
+
+TPU-native canonicalization: a batch of sequences is a dense padded array
+``(B, T_max, ...)`` plus an integer ``lengths (B,)`` vector. All sequence ops
+are masked dense ops — static shapes, MXU/VPU friendly, recompile-free across
+batches once T_max is bucketed (see paddle_tpu.data.bucketing).
+
+Each function below names the reference op it replaces.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+
+def sequence_mask(lengths, maxlen: int, dtype=jnp.float32):
+    """reference: operators/sequence_mask_op.cc → (B, maxlen) 0/1 mask."""
+    pos = jnp.arange(maxlen)
+    return (pos[None, :] < lengths[:, None]).astype(dtype)
+
+
+def _lowest(dtype):
+    """Most-negative representable value for float or int dtypes."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.finfo(dtype).min
+    return jnp.iinfo(dtype).min
+
+
+def sequence_pad(flat, lengths, maxlen: int, pad_value: float = 0.0):
+    """reference: sequence_pad_op.cc — packed (sum(L), D) + lengths → (B, maxlen, D).
+
+    Eager-path helper (the packed layout only appears at ingestion; dynamic
+    slicing below is fine on host, and jit-safe when lengths are concrete).
+    """
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lengths.astype(jnp.int32))])
+    b = lengths.shape[0]
+    d = flat.shape[1:]
+    idx = offsets[:-1, None] + jnp.arange(maxlen)[None, :]  # (B, maxlen)
+    idx = jnp.minimum(idx, flat.shape[0] - 1)
+    out = flat[idx]  # (B, maxlen, *D)
+    mask = sequence_mask(lengths, maxlen, jnp.bool_)
+    mask = mask.reshape(b, maxlen, *([1] * len(d)))
+    return jnp.where(mask, out, jnp.asarray(pad_value, out.dtype))
+
+
+def sequence_unpad(x, lengths):
+    """reference: sequence_unpad_op.cc — inverse of pad. Eager only (dynamic
+    output size); inside jit keep the padded form and mask."""
+    pieces = [x[i, :int(l)] for i, l in enumerate(lengths)]
+    return jnp.concatenate(pieces, axis=0)
+
+
+def sequence_pool(x, lengths, pool_type: str = "sum"):
+    """reference: sequence_pool_op.cc — pool over time with masking.
+    x: (B, T, D); returns (B, D)."""
+    mask = sequence_mask(lengths, x.shape[1], x.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    # pooled results have shape (B, *feature); broadcast per-row scalars to that
+    row = lambda v: v.reshape((-1,) + (1,) * (x.ndim - 2))
+    if pool_type == "sum":
+        return jnp.sum(x * mask, axis=1)
+    if pool_type == "average":
+        denom = row(jnp.maximum(lengths.astype(x.dtype), 1.0))
+        return jnp.sum(x * mask, axis=1) / denom
+    if pool_type == "sqrt":
+        denom = row(jnp.sqrt(jnp.maximum(lengths.astype(x.dtype), 1.0)))
+        return jnp.sum(x * mask, axis=1) / denom
+    if pool_type == "max":
+        masked = jnp.where(mask > 0, x, _lowest(x.dtype))
+        out = jnp.max(masked, axis=1)
+        return jnp.where(row(lengths) > 0, out, 0.0)
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+        return x[jnp.arange(x.shape[0]), idx]
+    if pool_type == "first":
+        return x[:, 0]
+    enforce(False, "unknown pool_type %s", pool_type)
+
+
+def sequence_softmax(x, lengths):
+    """reference: sequence_softmax_op.cc — masked softmax over time (B, T)."""
+    mask = sequence_mask(lengths, x.shape[1], jnp.bool_)
+    masked = jnp.where(mask, x, _lowest(x.dtype))
+    out = jax.nn.softmax(masked, axis=1)
+    return out * mask.astype(x.dtype)
+
+
+def sequence_reverse(x, lengths):
+    """reference: sequence_reverse_op.cc — reverse each row's valid prefix."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    ln = lengths[:, None]
+    src = jnp.where(pos < ln, ln - 1 - pos, pos)  # (B, T)
+    return jnp.take_along_axis(
+        x, src.astype(jnp.int32).reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_expand(x, ref_lengths, rmax: Optional[int] = None):
+    """reference: sequence_expand_op.cc — repeat each row i ref_lengths[i] times
+    along a new ragged axis; dense analog: (B, D) → (B, R_max, D) masked.
+
+    Pass static ``rmax`` when calling under jit (like sequence_mask's maxlen);
+    without it the bound is taken from concrete ref_lengths (eager only).
+    """
+    if rmax is None:
+        rmax = int(jnp.max(ref_lengths)) if not isinstance(ref_lengths, (list, tuple)) \
+            else max(ref_lengths)
+    out = jnp.repeat(x[:, None], rmax, axis=1)
+    mask = sequence_mask(jnp.asarray(ref_lengths), rmax, out.dtype)
+    return out * mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+
+
+def sequence_concat(xs, lengths_list):
+    """reference: sequence_concat_op.cc — concat along time, per row."""
+    b = xs[0].shape[0]
+    total = sum(x.shape[1] for x in xs)
+    d = xs[0].shape[2:]
+    out = jnp.zeros((b, total) + d, xs[0].dtype)
+    new_lengths = sum(jnp.asarray(l) for l in lengths_list)
+    # Shift each segment into place with scatter via take: build gather index.
+    # Row i of output = concat of valid prefixes. Compute source map eagerly.
+    t_out = jnp.arange(total)[None, :]  # (1, total)
+    starts = []
+    acc = jnp.zeros(b, jnp.int32)
+    for l in lengths_list:
+        starts.append(acc)
+        acc = acc + jnp.asarray(l, jnp.int32)
+    result = out
+    offset_in = 0
+    for x, l, st in zip(xs, lengths_list, starts):
+        l = jnp.asarray(l, jnp.int32)
+        tmax = x.shape[1]
+        src_pos = t_out - st[:, None]  # position within this segment
+        valid = (src_pos >= 0) & (src_pos < l[:, None])
+        src_pos_c = jnp.clip(src_pos, 0, tmax - 1).astype(jnp.int32)
+        gathered = jnp.take_along_axis(
+            x, src_pos_c.reshape(b, total, *([1] * len(d))), axis=1)
+        result = jnp.where(valid.reshape(b, total, *([1] * len(d))),
+                           gathered, result)
+    return result, new_lengths
+
+
+def sequence_slice(x, lengths, offset, length):
+    """reference: sequence_slice_op.cc — per-row window [offset, offset+length)."""
+    b, t = x.shape[:2]
+    pos = jnp.arange(t)[None, :]
+    src = pos + offset[:, None]
+    valid = pos < length[:, None]
+    src_c = jnp.clip(src, 0, t - 1).astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x, src_c.reshape(b, t, *([1] * (x.ndim - 2))), axis=1)
+    mask = valid.reshape(b, t, *([1] * (x.ndim - 2)))
+    return out * mask.astype(x.dtype), length
+
+
+def sequence_enumerate(x, lengths, win_size: int, pad_value: int = 0):
+    """reference: sequence_enumerate_op.cc — sliding windows of ids (B, T) →
+    (B, T, win_size)."""
+    b, t = x.shape
+    idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # (T, W)
+    valid_in_row = idx < lengths[:, None, None]
+    idx_c = jnp.minimum(idx, t - 1)
+    out = x[:, idx_c]  # (B, T, W)
+    return jnp.where(valid_in_row, out, pad_value)
+
+
+def sequence_erase(x, lengths, tokens):
+    """reference: sequence_erase_op.cc — remove listed tokens; dense analog
+    compacts each row to the left. Eager-only (per-row python loop)."""
+    outs, new_lens = [], []
+    t = x.shape[1]
+    for i in range(x.shape[0]):
+        row = [v for v in list(x[i, :int(lengths[i])]) if int(v) not in tokens]
+        new_lens.append(len(row))
+        row = row + [0] * (t - len(row))
+        outs.append(jnp.array(row, x.dtype))
+    return jnp.stack(outs), jnp.array(new_lens, jnp.int32)
+
+
+def sequence_expand_as(x, ref_lengths, rmax: Optional[int] = None):
+    """reference: sequence_expand_as_op.cc."""
+    return sequence_expand(x, ref_lengths, rmax=rmax)
+
+
+def im2sequence(x, kernel, stride, padding=(0, 0)):
+    """reference: operators/im2sequence_op.cc — image patches to sequence."""
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=kernel, window_strides=stride,
+        padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, cKK, oh, ow = patches.shape
+    return patches.reshape(n, cKK, oh * ow).transpose(0, 2, 1)
+
+
+def position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
+    """reference: operators/add_position_encoding_op.cc — sinusoidal PE added.
+    Handles odd feature dims: sin part gets ceil(d/2) columns, cos floor(d/2)."""
+    b, t, d = x.shape
+    sin_d = (d + 1) // 2
+    cos_d = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = max(sin_d, 1)
+    div_sin = jnp.power(10000.0, jnp.arange(sin_d, dtype=jnp.float32) / half)
+    div_cos = jnp.power(10000.0, jnp.arange(cos_d, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div_sin), jnp.cos(pos / div_cos)], axis=1)
+    return alpha * x + beta * pe[None]
+
+
+def hash_embedding_ids(ids, num_buckets: int, num_hash: int = 1):
+    """reference: operators/hash_op.cc — multi-hash ids into buckets."""
+    outs = []
+    x = ids.astype(jnp.uint32)
+    for i in range(num_hash):
+        h = (x * jnp.uint32(2654435761) + jnp.uint32(i * 0x9E3779B9))
+        outs.append((h % jnp.uint32(num_buckets)).astype(jnp.int32))
+    return jnp.stack(outs, axis=-1)

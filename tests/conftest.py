@@ -1,0 +1,28 @@
+"""Test bootstrap: force an 8-device CPU simulation BEFORE jax backends init.
+
+Mirrors the reference's multi-process-on-one-host distributed test strategy
+(reference: python/paddle/fluid/tests/unittests/test_dist_base.py:305) using
+JAX's virtual host devices instead of subprocesses: collectives and shardings
+compile and run exactly as on a pod, just on CPU.
+
+NOTE: this environment pre-imports jax via a sitecustomize on PYTHONPATH, so
+plain env-var setting is too late; we go through jax.config (backends are
+still uninitialized at conftest time).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

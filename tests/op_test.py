@@ -1,0 +1,88 @@
+"""OpTest harness — numpy-reference checking + numeric gradient checking.
+
+Replicates the reference's per-op test harness (reference:
+python/paddle/fluid/tests/unittests/op_test.py:134): each op is checked
+(a) forward against a numpy reference, both eager and under jit (the "run on
+every place" analog — here: eager vs compiled), and (b) backward by comparing
+``jax.grad`` against central finite differences computed in float64 (the
+``get_numeric_gradient`` analog, reference: op_test.py:45).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_output(fn: Callable, args: Sequence, expected, rtol=1e-5, atol=1e-6):
+    """Run ``fn`` eagerly and under jit; compare both against ``expected``."""
+    eager = fn(*[jnp.asarray(a) for a in args])
+    jitted = jax.jit(fn)(*[jnp.asarray(a) for a in args])
+    for name, got in (("eager", eager), ("jit", jitted)):
+        got_flat = jax.tree_util.tree_leaves(got)
+        exp_flat = jax.tree_util.tree_leaves(expected)
+        assert len(got_flat) == len(exp_flat), (
+            f"{name}: structure mismatch {len(got_flat)} vs {len(exp_flat)}")
+        for g, e in zip(got_flat, exp_flat):
+            np.testing.assert_allclose(
+                np.asarray(g, dtype=np.float64) if np.asarray(g).dtype != bool else np.asarray(g),
+                np.asarray(e, dtype=np.float64) if np.asarray(e).dtype != bool else np.asarray(e),
+                rtol=rtol, atol=atol,
+                err_msg=f"[{name}] output mismatch for {fn}")
+
+
+def numeric_grad(fn: Callable, args: Sequence[np.ndarray], wrt: int = 0,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central finite differences of scalar-valued ``fn`` w.r.t. args[wrt],
+    computed in float64 (reference op_test.py get_numeric_gradient)."""
+    args = [np.asarray(a, dtype=np.float64 if np.issubdtype(np.asarray(a).dtype, np.floating) else None)
+            for a in args]
+
+    def f(x):
+        a = list(args)
+        a[wrt] = x
+        with jax.enable_x64(True):
+            out = fn(*[jnp.asarray(v) for v in a])
+        return float(np.sum(np.asarray(out, dtype=np.float64)))
+
+    x0 = args[wrt]
+    grad = np.zeros_like(x0, dtype=np.float64)
+    flat = x0.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = f(x0)
+        flat[i] = orig - eps
+        fm = f(x0)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * eps)
+    return grad
+
+
+def check_grad(fn: Callable, args: Sequence[np.ndarray], wrt=(0,),
+               rtol=1e-2, atol=1e-3, eps: float = 1e-3):
+    """Compare analytic jax.grad (of sum(fn)) vs numeric FD for each arg index.
+
+    fp64 on CPU — mirrors OpTest's "check on CPU place first" precision story
+    (SURVEY §7 hard parts).
+    """
+    if isinstance(wrt, int):
+        wrt = (wrt,)
+
+    def scalar_fn(*a):
+        return jnp.sum(fn(*a))
+
+    with jax.enable_x64(True):
+        a64 = [jnp.asarray(np.asarray(x, dtype=np.float64)
+                           if np.issubdtype(np.asarray(x).dtype, np.floating)
+                           else np.asarray(x)) for x in args]
+        analytic = jax.grad(scalar_fn, argnums=wrt)(*a64)
+    for k, idx in enumerate(wrt):
+        num = numeric_grad(fn, args, wrt=idx, eps=eps)
+        np.testing.assert_allclose(
+            np.asarray(analytic[k], dtype=np.float64), num, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch wrt arg {idx} for {fn}")

@@ -1,0 +1,100 @@
+"""Core-layer tests: flags, places, mesh, dtypes, enforce, profiler."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core import (FLAGS, EnforceError, enforce, enforce_eq,
+                             mesh_scope, profiler)
+from paddle_tpu.core.config import BuildStrategy, DistributeConfig
+from paddle_tpu.core.dtypes import POLICIES, get_policy, policy_scope, to_dtype
+from paddle_tpu.core.mesh import axis_size, build_mesh, get_mesh, sharding
+from jax.sharding import PartitionSpec
+
+
+def test_flags_define_get_set():
+    assert FLAGS.get("check_nan_inf") is False
+    FLAGS.set("check_nan_inf", True)
+    assert FLAGS.get("check_nan_inf") is True
+    FLAGS.reset("check_nan_inf")
+    assert FLAGS.get("check_nan_inf") is False
+
+
+def test_flags_env_override(monkeypatch):
+    monkeypatch.setenv("FLAGS_my_test_flag", "42")
+    FLAGS.define("my_test_flag", 7)
+    assert FLAGS.get("my_test_flag") == 42
+
+
+def test_enforce():
+    enforce(True)
+    with pytest.raises(EnforceError):
+        enforce(False, "boom %s", 1)
+    enforce_eq(3, 3)
+    with pytest.raises(EnforceError):
+        enforce_eq(3, 4)
+
+
+def test_places():
+    assert pt.device_count() >= 1
+    p = pt.default_place()
+    assert p.device() is not None
+    assert "Place" in repr(p)
+
+
+def test_mesh_8_devices():
+    assert len(jax.devices()) == 8, "conftest must give 8 virtual devices"
+    mesh = build_mesh(dp=2, tp=4)
+    assert axis_size("dp", mesh) == 2
+    assert axis_size("tp", mesh) == 4
+    assert axis_size("pp", mesh) == 1
+    with mesh_scope(mesh):
+        assert get_mesh() is mesh
+        s = sharding(PartitionSpec("dp"))
+        x = jax.device_put(np.zeros((8, 4)), s)
+        assert x.sharding.is_equivalent_to(s, 2)
+
+
+def test_mesh_size_mismatch():
+    with pytest.raises(EnforceError):
+        build_mesh(dp=3)
+
+
+def test_distribute_config():
+    cfg = DistributeConfig(dp=2, tp=2, pp=2)
+    assert cfg.total() == 8
+
+
+def test_dtype_policy():
+    assert to_dtype("bfloat16") == jax.numpy.bfloat16
+    with policy_scope("mixed_bf16"):
+        pol = get_policy()
+        assert pol.compute_dtype == "bfloat16"
+        x = pol.cast_to_compute(np.ones((2, 2), np.float32))
+        assert x.dtype == jax.numpy.bfloat16
+    assert get_policy() is POLICIES["float32"]
+
+
+def test_seed_and_keys():
+    pt.seed(1234)
+    k1 = pt.core.next_key()
+    k2 = pt.core.next_key()
+    assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+    pt.seed(1234)
+    k1b = pt.core.next_key()
+    assert np.array_equal(jax.random.key_data(k1), jax.random.key_data(k1b))
+
+
+def test_profiler_chrome_trace(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    with profiler(path):
+        with pt.core.RecordEvent("step"):
+            np.zeros(10).sum()
+    with open(path) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "step" in names

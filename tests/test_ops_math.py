@@ -1,0 +1,155 @@
+"""OpTest-style checks for math/activation/elementwise ops."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import math as M
+from op_test import check_grad, check_output
+
+RNG = np.random.default_rng(0)
+
+
+def u(shape, lo=-2.0, hi=2.0):
+    return RNG.uniform(lo, hi, shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("name,ref", [
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("exp", np.exp),
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("tanh", np.tanh),
+    ("sqrt", np.sqrt),
+    ("abs", np.abs),
+    ("log", np.log),
+    ("square", np.square),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+    ("reciprocal", lambda x: 1 / x),
+    ("floor", np.floor),
+    ("ceil", np.ceil),
+    ("sin", np.sin),
+    ("cos", np.cos),
+])
+def test_unary_forward(name, ref):
+    x = u((3, 17), 0.1, 2.0)  # positive domain works for all
+    check_output(getattr(M, name), [x], ref(x), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["sigmoid", "tanh", "softplus", "gelu",
+                                  "swish", "elu", "stanh", "square"])
+def test_unary_grad(name):
+    x = u((2, 5), -1.5, 1.5)
+    check_grad(getattr(M, name), [x])
+
+
+def test_leaky_relu():
+    x = u((4, 4))
+    check_output(M.leaky_relu, [x], np.where(x >= 0, x, 0.02 * x))
+
+
+def test_hard_sigmoid():
+    x = u((4, 4), -5, 5)
+    check_output(M.hard_sigmoid, [x], np.clip(0.2 * x + 0.5, 0, 1))
+
+
+def test_relu6():
+    x = u((4, 4), -3, 9)
+    check_output(M.relu6, [x], np.clip(x, 0, 6))
+
+
+@pytest.mark.parametrize("op,npop", [
+    (M.elementwise_add, np.add),
+    (M.elementwise_sub, np.subtract),
+    (M.elementwise_mul, np.multiply),
+    (M.elementwise_div, np.divide),
+    (M.elementwise_max, np.maximum),
+    (M.elementwise_min, np.minimum),
+])
+def test_elementwise_same_shape(op, npop):
+    x, y = u((3, 4)), u((3, 4), 0.5, 2.0)
+    check_output(op, [x, y], npop(x, y), rtol=1e-5)
+
+
+def test_elementwise_axis_broadcast():
+    # Reference semantics: x (2,3,4,5), y (3,4) at axis=1
+    x = u((2, 3, 4, 5))
+    y = u((3, 4))
+    expected = x + y.reshape(1, 3, 4, 1)
+    check_output(lambda a, b: M.elementwise_add(a, b, axis=1), [x, y], expected)
+
+
+def test_elementwise_grad():
+    x, y = u((3, 4)), u((3, 4), 0.5, 2.0)
+    check_grad(M.elementwise_mul, [x, y], wrt=(0, 1))
+
+
+def test_matmul_transpose():
+    x, y = u((3, 4)), u((3, 5))
+    check_output(lambda a, b: M.matmul(a, b, transpose_x=True), [x, y],
+                 x.T @ y, rtol=1e-4)
+
+
+def test_matmul_batched_alpha():
+    x, y = u((2, 3, 4)), u((2, 4, 5))
+    check_output(lambda a, b: M.matmul(a, b, alpha=2.0), [x, y],
+                 2.0 * np.matmul(x, y), rtol=1e-4)
+
+
+def test_matmul_grad():
+    x, y = u((2, 3)), u((3, 4))
+    check_grad(M.matmul, [x, y], wrt=(0, 1))
+
+
+def test_mul_flatten():
+    x = u((2, 3, 4))
+    y = u((12, 5))
+    check_output(lambda a, b: M.mul(a, b, x_num_col_dims=1), [x, y],
+                 x.reshape(2, 12) @ y, rtol=1e-4)
+
+
+def test_scale():
+    x = u((3, 3))
+    check_output(lambda a: M.scale(a, 2.0, 1.0), [x], x * 2 + 1)
+    check_output(lambda a: M.scale(a, 2.0, 1.0, bias_after_scale=False), [x],
+                 (x + 1) * 2)
+
+
+def test_clip_by_norm():
+    x = u((4, 4))
+    norm = np.sqrt((x ** 2).sum())
+    check_output(lambda a: M.clip_by_norm(a, 1.0), [x], x / norm)
+
+
+def test_cumsum():
+    x = u((3, 5))
+    check_output(lambda a: M.cumsum(a, axis=1), [x], np.cumsum(x, 1))
+    check_output(lambda a: M.cumsum(a, axis=1, reverse=True), [x],
+                 np.flip(np.cumsum(np.flip(x, 1), 1), 1))
+    excl = np.cumsum(x, 1) - x
+    check_output(lambda a: M.cumsum(a, axis=1, exclusive=True), [x], excl)
+
+
+def test_bilinear_tensor_product():
+    x, y, w = u((2, 3)), u((2, 4)), u((5, 3, 4))
+    expected = np.einsum("bi,kij,bj->bk", x, w, y)
+    check_output(M.bilinear_tensor_product, [x, y, w], expected, rtol=1e-4)
+
+
+def test_cos_sim():
+    x, y = u((3, 8)), u((3, 8))
+    num = (x * y).sum(-1, keepdims=True)
+    den = np.linalg.norm(x, axis=-1, keepdims=True) * np.linalg.norm(y, axis=-1, keepdims=True)
+    check_output(M.cos_sim, [x, y], num / den, rtol=1e-4)
+
+
+def test_maxout():
+    x = u((2, 6, 3, 3))
+    expected = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    check_output(lambda a: M.maxout(a, groups=2), [x], expected)
+
+
+def test_prelu_channel():
+    x = u((2, 3, 4, 4))
+    alpha = u((3,), 0.1, 0.3)
+    expected = np.where(x >= 0, x, alpha.reshape(1, 3, 1, 1) * x)
+    check_output(lambda a, al: M.prelu(a, al, mode="channel"), [x, alpha], expected)

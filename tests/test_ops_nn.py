@@ -1,0 +1,383 @@
+"""OpTest-style checks for nn ops (conv/pool/norm/softmax/dropout/embedding)
+and loss ops — including torch-free numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import loss as L
+from paddle_tpu.ops import nn as N
+from op_test import check_grad, check_output
+
+RNG = np.random.default_rng(1)
+
+
+def u(shape, lo=-1.0, hi=1.0):
+    return RNG.uniform(lo, hi, shape).astype(np.float32)
+
+
+# --- conv ------------------------------------------------------------------
+
+def np_conv2d(x, w, stride=1, pad=0):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh, j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def test_conv2d_vs_numpy():
+    x, w = u((2, 3, 8, 8)), u((4, 3, 3, 3))
+    check_output(lambda a, b: N.conv2d(a, b, stride=2, padding=1), [x, w],
+                 np_conv2d(x, w, 2, 1), rtol=1e-3, atol=1e-4)
+
+
+def test_conv2d_grad():
+    x, w = u((1, 2, 5, 5)), u((2, 2, 3, 3))
+    check_grad(lambda a, b: N.conv2d(a, b, padding=1), [x, w], wrt=(0, 1),
+               rtol=2e-2, atol=2e-3)
+
+
+def test_depthwise_conv2d_shape():
+    x, w = u((2, 4, 8, 8)), u((4, 1, 3, 3))
+    out = N.depthwise_conv2d(x, w, padding=1)
+    assert out.shape == (2, 4, 8, 8)
+
+
+def test_conv2d_transpose_shape_formula():
+    # Reference formula: out = (in-1)*stride - 2*pad + dilation*(k-1) + 1
+    x = u((1, 2, 4, 4))
+    w = u((2, 3, 3, 3))  # IOHW: in=2, out=3
+    out = N.conv2d_transpose(x, w, stride=2, padding=0)
+    assert out.shape == (1, 3, 9, 9), out.shape
+    out = N.conv2d_transpose(x, w, stride=2, padding=1)
+    assert out.shape == (1, 3, 7, 7), out.shape
+
+
+def test_conv2d_transpose_inverts_conv_shapes():
+    # conv then conv_transpose with same config returns original spatial size
+    x = u((1, 3, 8, 8))
+    w = u((5, 3, 3, 3))  # OIHW for conv
+    y = N.conv2d(x, w, stride=2, padding=1)  # -> (1,5,4,4)
+    wt = u((5, 3, 3, 3))  # IOHW for transpose: in=5, out=3
+    z = N.conv2d_transpose(y, wt, stride=2, padding=1)
+    assert z.shape == (1, 3, 7, 7)
+
+
+def test_conv2d_transpose_matches_grad_of_conv():
+    # conv_transpose(y, w) with stride s, pad p == d(conv)/dx evaluated via VJP
+    x = u((1, 2, 6, 6))
+    w_oihw = u((3, 2, 3, 3))
+
+    def conv_fn(xx):
+        return N.conv2d(xx, jnp.asarray(w_oihw), stride=2, padding=1)
+
+    y = u((1, 3, 3, 3))
+    _, vjp = jax.vjp(conv_fn, jnp.asarray(x))
+    expected = vjp(jnp.asarray(y))[0]
+    # the conv's OIHW kernel (O=3,I=2) read as IOHW is exactly the transpose
+    # conv's kernel (in=3, out=2) — VJP flips the roles, not the array
+    got = N.conv2d_transpose(jnp.asarray(y), jnp.asarray(w_oihw), stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected)[:, :, :5, :5],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_groups():
+    x = u((1, 4, 4, 4))
+    w = u((4, 2, 3, 3))  # groups=2: in=4 split into 2, out per group=2
+    out = N.conv2d_transpose(x, w, stride=1, padding=0, groups=2)
+    assert out.shape == (1, 4, 6, 6)
+
+
+# --- pooling ---------------------------------------------------------------
+
+def test_pool2d_max():
+    x = u((2, 3, 8, 8))
+    expected = x.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+    check_output(lambda a: N.pool2d(a, 2, "max", stride=2), [x], expected)
+
+
+def test_pool2d_avg():
+    x = u((2, 3, 8, 8))
+    expected = x.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    check_output(lambda a: N.pool2d(a, 2, "avg", stride=2), [x], expected,
+                 rtol=1e-5)
+
+
+def test_pool2d_global():
+    x = u((2, 3, 8, 8))
+    out = N.pool2d(x, 2, "avg", global_pooling=True)
+    np.testing.assert_allclose(np.asarray(out)[..., 0, 0],
+                               x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_adaptive_pool2d():
+    x = u((2, 3, 8, 8))
+    out = N.adaptive_pool2d(x, 2, "avg")
+    expected = x.reshape(2, 3, 2, 4, 2, 4).mean(axis=(3, 5))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+# --- norms -----------------------------------------------------------------
+
+def test_batch_norm_train_and_infer():
+    x = u((4, 3, 5, 5))
+    scale, bias = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mean, var = np.zeros(3, np.float32), np.ones(3, np.float32)
+    y, nm, nv = N.batch_norm(x, scale, bias, mean, var, training=True)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    np.testing.assert_allclose(np.asarray(nm), 0.9 * 0 + 0.1 * bm, rtol=1e-4)
+    expected = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-3, atol=1e-4)
+    # inference: uses running stats, returns them unchanged
+    y2, m2, v2 = N.batch_norm(x, scale, bias, mean, var, training=False)
+    np.testing.assert_allclose(np.asarray(m2), mean)
+    np.testing.assert_allclose(np.asarray(y2), x / np.sqrt(1 + 1e-5), rtol=1e-4)
+
+
+def test_layer_norm():
+    x = u((4, 10))
+    g, b = u((10,), 0.5, 1.5), u((10,))
+    mu = x.mean(1, keepdims=True)
+    sd = np.sqrt(x.var(1, keepdims=True) + 1e-5)
+    expected = (x - mu) / sd * g + b
+    check_output(lambda a, gg, bb: N.layer_norm(a, gg, bb), [x, g, b], expected,
+                 rtol=1e-3, atol=1e-4)
+
+
+def test_group_norm():
+    x = u((2, 4, 3, 3))
+    out = N.group_norm(x, groups=2)
+    xr = x.reshape(2, 2, 2 * 3 * 3)
+    mu = xr.mean(-1, keepdims=True)
+    sd = np.sqrt(xr.var(-1, keepdims=True) + 1e-5)
+    expected = ((xr - mu) / sd).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-3, atol=1e-4)
+
+
+def test_rms_norm():
+    x = u((3, 8))
+    expected = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    check_output(N.rms_norm, [x], expected, rtol=1e-4)
+
+
+def test_l2_normalize():
+    x = u((3, 8))
+    check_output(N.l2_normalize, [x],
+                 x / np.linalg.norm(x, axis=-1, keepdims=True), rtol=1e-4)
+
+
+# --- softmax / dropout / embedding ----------------------------------------
+
+def test_softmax():
+    x = u((3, 7))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    check_output(N.softmax, [x], e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+
+def test_softmax_grad():
+    x = u((2, 5))
+    check_grad(lambda a: N.softmax(a) ** 2, [x])
+
+
+def test_dropout_infer_and_train():
+    x = u((100, 100), 0.5, 1.5)
+    assert np.allclose(np.asarray(N.dropout(x, 0.3, training=False)), x)
+    out = N.dropout(jnp.asarray(x), 0.5, key=jax.random.key(0))
+    kept = np.asarray(out) != 0
+    assert 0.4 < kept.mean() < 0.6
+    # upscale: kept values are x / keep_prob
+    np.testing.assert_allclose(np.asarray(out)[kept], (x * 2)[kept], rtol=1e-5)
+
+
+def test_embedding_padding_idx():
+    table = u((10, 4))
+    ids = np.array([[1, 2], [0, 9]])
+    out = N.embedding(ids, table, padding_idx=0)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], table[1])
+    assert np.all(np.asarray(out)[1, 0] == 0)
+
+
+def test_one_hot():
+    out = N.one_hot(np.array([0, 2]), 4)
+    expected = np.array([[1, 0, 0, 0], [0, 0, 1, 0]], np.float32)
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_interpolate_nearest():
+    x = u((1, 1, 2, 2))
+    out = N.interpolate(x, (4, 4), "nearest")
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, :2, :2],
+                               np.repeat(np.repeat(x[0, 0, :1, :1], 2, 0), 2, 1))
+
+
+def test_pixel_shuffle():
+    x = u((1, 4, 2, 2))
+    out = N.pixel_shuffle(x, 2)
+    assert out.shape == (1, 1, 4, 4)
+
+
+def test_pad2d_reflect():
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    out = N.pad2d(x, [1, 1, 1, 1], mode="reflect")
+    np.testing.assert_allclose(np.asarray(out)[0, 0],
+                               np.pad(x[0, 0], 1, mode="reflect"))
+
+
+def test_space_to_depth():
+    x = u((1, 2, 4, 4))
+    out = N.space_to_depth(x, 2)
+    assert out.shape == (1, 8, 2, 2)
+
+
+def test_shuffle_channel():
+    x = np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1)
+    out = N.shuffle_channel(x, 2)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                               [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+# --- losses ----------------------------------------------------------------
+
+def test_softmax_with_cross_entropy():
+    logits = u((4, 7))
+    label = np.array([1, 0, 6, 3])
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expected = -np.log(p[np.arange(4), label])[:, None]
+    check_output(lambda l: L.softmax_with_cross_entropy(l, jnp.asarray(label)),
+                 [logits], expected, rtol=1e-4)
+
+
+def test_softmax_with_cross_entropy_axis1():
+    # regression: class axis != -1 must index at `axis`, not broadcast
+    logits = u((2, 5, 3))
+    label = RNG.integers(0, 5, (2, 3))
+    out = L.softmax_with_cross_entropy(jnp.asarray(logits), jnp.asarray(label), axis=1)
+    assert out.shape == (2, 1, 3), out.shape
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    expected = -np.log(np.take_along_axis(p, label[:, None], axis=1))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4)
+
+
+def test_softmax_with_cross_entropy_soft_label():
+    logits = u((3, 5))
+    soft = np.abs(u((3, 5))) + 0.1
+    soft = soft / soft.sum(-1, keepdims=True)
+    logp = logits - logits.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    expected = -(soft * logp).sum(-1, keepdims=True)
+    check_output(lambda l, s: L.softmax_with_cross_entropy(l, s, soft_label=True),
+                 [logits, soft], expected, rtol=1e-4)
+
+
+def test_softmax_with_cross_entropy_ignore_index():
+    logits = u((3, 4))
+    label = np.array([1, 2, 2])
+    out = L.softmax_with_cross_entropy(jnp.asarray(logits), jnp.asarray(label),
+                                       ignore_index=2)
+    assert np.asarray(out)[1] == 0 and np.asarray(out)[2] == 0
+    assert np.asarray(out)[0] > 0
+
+
+def test_softmax_ce_grad():
+    logits = u((3, 5))
+    label = np.array([0, 2, 4])
+    check_grad(lambda l: L.softmax_with_cross_entropy(l, jnp.asarray(label)),
+               [logits], rtol=2e-2)
+
+
+def test_sigmoid_ce_with_logits():
+    x = u((3, 4), -3, 3)
+    lbl = (u((3, 4)) > 0).astype(np.float32)
+    expected = np.maximum(x, 0) - x * lbl + np.log1p(np.exp(-np.abs(x)))
+    check_output(L.sigmoid_cross_entropy_with_logits, [x, lbl], expected, rtol=1e-4)
+
+
+def test_huber_loss():
+    x, y = u((5,)), u((5,))
+    d = y - x
+    expected = np.where(np.abs(d) <= 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+    check_output(L.huber_loss, [x, y], expected, rtol=1e-5)
+
+
+def test_log_loss():
+    p = np.clip(u((4, 1), 0.1, 0.9), 0.1, 0.9)
+    lbl = (u((4, 1)) > 0).astype(np.float32)
+    expected = -lbl * np.log(p + 1e-4) - (1 - lbl) * np.log(1 - p + 1e-4)
+    check_output(L.log_loss, [p, lbl], expected, rtol=1e-5)
+
+
+def test_label_smooth():
+    lbl = np.eye(4, dtype=np.float32)[[0, 2]]
+    out = L.label_smooth(jnp.asarray(lbl), 0.1)
+    np.testing.assert_allclose(np.asarray(out), 0.9 * lbl + 0.025, rtol=1e-5)
+
+
+def test_kldiv_loss():
+    x = np.log(np.full((2, 3), 1 / 3, np.float32))
+    t = np.full((2, 3), 1 / 3, np.float32)
+    out = L.kldiv_loss(jnp.asarray(x), jnp.asarray(t))
+    np.testing.assert_allclose(float(out), 0.0, atol=1e-6)
+
+
+def test_hinge_and_rank_losses():
+    logits, lbl = u((4,)), (u((4,)) > 0).astype(np.float32)
+    expected = np.maximum(0, 1 - logits * (2 * lbl - 1))
+    check_output(L.hinge_loss, [logits, lbl], expected, rtol=1e-5)
+    left, right = u((4, 1)), u((4, 1))
+    d = left - right
+    expected = np.log1p(np.exp(d)) - lbl[:, None] * d
+    check_output(L.rank_loss, [lbl[:, None], left, right], expected, rtol=1e-4)
+
+
+def test_mse_and_square_error():
+    x, y = u((3, 2)), u((3, 2))
+    check_output(L.square_error_cost, [x, y], (x - y) ** 2, rtol=1e-5)
+    np.testing.assert_allclose(float(L.mse_loss(jnp.asarray(x), jnp.asarray(y))),
+                               ((x - y) ** 2).mean(), rtol=1e-5)
+
+
+def test_softmax_ce_negative_ignore_index_default():
+    # regression: default ignore_index=-100 must mask, not NaN
+    logits = u((3, 5))
+    label = np.array([1, -100, 2])
+    out = L.softmax_with_cross_entropy(jnp.asarray(logits), jnp.asarray(label))
+    arr = np.asarray(out)
+    assert arr[1] == 0 and np.isfinite(arr).all()
+    assert arr[0] > 0 and arr[2] > 0
+
+
+def test_interpolate_bad_method_typed_error():
+    from paddle_tpu.core import EnforceError
+    with pytest.raises(EnforceError, match="bicubic"):
+        N.interpolate(jnp.ones((1, 1, 2, 2)), (4, 4), method="bicubic")
+    with pytest.raises(EnforceError, match="wrap"):
+        N.pad2d(jnp.ones((1, 1, 2, 2)), [1, 1, 1, 1], mode="wrap")
+
+
+def test_temporal_shift_matches_reference_direction():
+    # reference temporal_shift_op.h: channels < c1 read t-1 (zero pad),
+    # c1..c2 read t+1 (zero pad), rest unshifted
+    x = RNG.uniform(-1, 1, (4, 4, 2, 2)).astype(np.float32)  # nt=4, seg=2
+    out = np.asarray(N.temporal_shift(jnp.asarray(x), seg_num=2, shift_ratio=0.25))
+    xr = x.reshape(2, 2, 4, 2, 2)
+    outr = out.reshape(2, 2, 4, 2, 2)
+    # channel 0: from previous frame, zero at t=0
+    assert np.all(outr[:, 0, 0] == 0)
+    np.testing.assert_allclose(outr[:, 1, 0], xr[:, 0, 0])
+    # channel 1: from next frame, zero at last t
+    np.testing.assert_allclose(outr[:, 0, 1], xr[:, 1, 1])
+    assert np.all(outr[:, 1, 1] == 0)
+    # channels 2-3 unshifted
+    np.testing.assert_allclose(outr[:, :, 2:], xr[:, :, 2:])
