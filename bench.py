@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Benchmark harness — fluid_benchmark.py analog (reference:
+benchmark/fluid/fluid_benchmark.py:296-300 examples/sec metric).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares against the last recorded value in BENCH_HISTORY.json
+(the reference publishes no numbers — BASELINE.md — so the baseline is our own
+trajectory; >1.0 means faster than the previous record).
+
+Usage: python bench.py [--smoke] [--model mnist_mlp]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.models import mnist as M
+
+    pt.seed(0)
+    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+    model = M.MnistMLP(hidden1=512, hidden2=256)
+    trainer = parallel.Trainer.supervised(
+        model, optimizer.Adam(1e-3), M.loss_fn, mesh=mesh)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch_size, 784)).astype(np.float32))
+    label = jnp.asarray(rng.integers(0, 10, batch_size))
+    batch = {"x": x, "label": label}
+    for _ in range(warmup):
+        loss, _ = trainer.train_step(batch)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, _ = trainer.train_step(batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return steps * batch_size / dt, "examples/sec"
+
+
+MODELS = {
+    "mnist_mlp": bench_mnist_mlp,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="mnist_mlp", choices=sorted(MODELS))
+    ap.add_argument("--smoke", action="store_true", help="quick run")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--batch-size", type=int, default=None)
+    args = ap.parse_args()
+
+    steps = args.steps or (10 if args.smoke else 100)
+    batch = args.batch_size or (256 if args.smoke else 8192)
+    value, unit = MODELS[args.model](steps, batch)
+
+    metric = f"{args.model}_throughput"
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_HISTORY.json")
+    history = {}
+    if os.path.exists(hist_path):
+        try:
+            with open(hist_path) as f:
+                history = json.load(f)
+        except Exception:
+            history = {}
+    prev = history.get(metric)
+    vs_baseline = (value / prev) if prev else 1.0
+    if not args.smoke:
+        history[metric] = max(value, prev or 0.0)
+        with open(hist_path, "w") as f:
+            json.dump(history, f, indent=1)
+
+    print(json.dumps({"metric": metric, "value": round(value, 2), "unit": unit,
+                      "vs_baseline": round(vs_baseline, 4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,9 @@ _LAZY = {
     "models": ".models",
     "metrics": ".metrics",
     "profiler": ".core.profiler",
+    "initializer": ".initializer",
+    "regularizer": ".regularizer",
+    "clip": ".clip",
 }
 
 

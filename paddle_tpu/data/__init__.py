@@ -1,0 +1,11 @@
+"""Data layer: reader decorators, feeders, datasets, ragged batching."""
+
+from . import dataset
+from .feeder import DataFeeder, DeviceLoader
+from .reader import (batch, buffered, cache, chain, compose, firstn,
+                     map_readers, shuffle, xmap_readers)
+
+__all__ = [
+    "dataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",
+    "chain", "compose", "firstn", "map_readers", "shuffle", "xmap_readers",
+]

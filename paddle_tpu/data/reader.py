@@ -1,0 +1,217 @@
+"""Reader decorators — capability parity with paddle.reader
+(reference: python/paddle/reader/decorator.py:36-360 — map_readers, buffered,
+compose, chain, shuffle, firstn, xmap_readers, cache; plus paddle.batch
+(reference: python/paddle/batch.py)).
+
+A *reader creator* is a zero-arg callable returning an iterator of samples —
+identical contract to the reference, so recipes port directly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as pyrandom
+import threading
+from typing import Any, Callable, Iterable, Iterator, List
+
+Reader = Callable[[], Iterator[Any]]
+
+
+def map_readers(func: Callable, *readers: Reader) -> Reader:
+    """reference: decorator.py map_readers."""
+
+    def reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader: Reader, buf_size: int, seed=None) -> Reader:
+    """reference: decorator.py shuffle — buffered shuffle."""
+
+    def shuffled():
+        rng = pyrandom.Random(seed)
+        buf: List[Any] = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers: Reader) -> Reader:
+    """reference: decorator.py chain."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
+    """reference: decorator.py compose — zip readers into tuple samples."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*its):
+                if any(i is None for i in items):
+                    raise RuntimeError("composed readers have different lengths")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            # reference decorator.py: plain zip — trailing samples discarded
+            for items in zip(*its):
+                yield sum((make_tuple(i) for i in items), ())
+
+    return reader
+
+
+def buffered(reader: Reader, size: int) -> Reader:
+    """reference: decorator.py buffered — background-thread prefetch."""
+
+    end = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+        if err:
+            raise err[0]
+
+    return buffered_reader
+
+
+def firstn(reader: Reader, n: int) -> Reader:
+    """reference: decorator.py firstn."""
+
+    def reader_n():
+        return itertools.islice(reader(), n)
+
+    return reader_n
+
+
+def cache(reader: Reader) -> Reader:
+    """reference: decorator.py cache — materialize the whole stream on first
+    use, replay thereafter. Full materialization up front (like the reference's
+    tuple(reader())) so an abandoned first pass can't duplicate samples."""
+    memo: List[Any] = []
+    done = [False]
+
+    def cached():
+        if not done[0]:
+            memo.extend(reader())
+            done[0] = True
+        yield from memo
+
+    return cached
+
+
+def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
+                 buffer_size: int, order: bool = False) -> Reader:
+    """reference: decorator.py xmap_readers — parallel map via threads.
+    (Threads, not processes: mappers are typically numpy, which releases
+    the GIL; keeps the zero-copy contract.)"""
+
+    end = object()
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        errors: List[BaseException] = []
+
+        def feeder():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def worker():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    i, x = item
+                    out_q.put((i, mapper(x)))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                out_q.put(end)
+
+        threading.Thread(target=feeder, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=worker, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending = {}
+            next_i = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, y = item
+                pending[i] = y
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+        if errors:
+            raise errors[0]
+
+    return xreader
+
+
+def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
+    """reference: python/paddle/batch.py — group samples into lists.
+    drop_last defaults True (static shapes: partial batches would recompile)."""
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,213 @@
+"""Metrics — capability parity with the reference metrics stack
+(reference: python/paddle/fluid/metrics.py — Accuracy, Precision, Recall, Auc,
+EditDistance, CompositeMetric; metric ops operators/metrics/accuracy_op.cc,
+auc_op.cc).
+
+Two pieces, like the reference: an in-graph *op* part (pure functions usable
+under jit) and host-side *accumulators* (the MetricBase role).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# --- in-graph metric ops ---------------------------------------------------
+
+def accuracy(pred_logits, label, k: int = 1):
+    """reference: operators/metrics/accuracy_op.cc — top-k accuracy."""
+    label = label.reshape(-1)
+    if k == 1:
+        correct = (jnp.argmax(pred_logits, axis=-1) == label)
+        return jnp.mean(correct.astype(jnp.float32))
+    topk = jnp.argsort(pred_logits, axis=-1)[..., -k:]
+    correct = jnp.any(topk == label[:, None], axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+def auc_terms(probs, label, num_thresholds: int = 200):
+    """Histogram terms for streaming AUC (reference: operators/metrics/
+    auc_op.cc) — returns (tp, fp) histograms to be accumulated host-side."""
+    pos_prob = probs[:, 1] if probs.ndim == 2 else probs
+    label = label.reshape(-1)
+    idx = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                   num_thresholds)
+    tp = jnp.zeros(num_thresholds + 1).at[idx].add(label.astype(jnp.float32))
+    fp = jnp.zeros(num_thresholds + 1).at[idx].add(1.0 - label.astype(jnp.float32))
+    return tp, fp
+
+
+# --- host-side accumulators ------------------------------------------------
+
+class MetricBase:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """reference: metrics.py Accuracy — weighted running average."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1.0):
+        self.value += float(value) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        if self.weight == 0:
+            return 0.0
+        return self.value / self.weight
+
+
+class Auc(MetricBase):
+    """reference: metrics.py Auc — trapezoidal over threshold histogram."""
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self.tp = np.zeros(self.num_thresholds + 1)
+        self.fp = np.zeros(self.num_thresholds + 1)
+
+    def update(self, probs, label):
+        tp, fp = auc_terms(jnp.asarray(probs), jnp.asarray(label),
+                           self.num_thresholds)
+        self.tp += np.asarray(tp)
+        self.fp += np.asarray(fp)
+
+    def eval(self):
+        # cumulative from the top threshold down → ROC points
+        tp_cum = np.cumsum(self.tp[::-1])
+        fp_cum = np.cumsum(self.fp[::-1])
+        total_pos = tp_cum[-1]
+        total_neg = fp_cum[-1]
+        if total_pos == 0 or total_neg == 0:
+            return 0.0
+        tpr = tp_cum / total_pos
+        fpr = fp_cum / total_neg
+        return float(np.trapezoid(tpr, fpr))
+
+
+class Precision(MetricBase):
+    """reference: metrics.py Precision (binary)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class EditDistance(MetricBase):
+    """reference: metrics.py EditDistance + operators/edit_distance_op.cc."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.seq_right = 0
+
+    @staticmethod
+    def _levenshtein(a, b) -> int:
+        m, n = len(a), len(b)
+        dp = list(range(n + 1))
+        for i in range(1, m + 1):
+            prev = dp[0]
+            dp[0] = i
+            for j in range(1, n + 1):
+                cur = dp[j]
+                dp[j] = min(dp[j] + 1, dp[j - 1] + 1,
+                            prev + (a[i - 1] != b[j - 1]))
+                prev = cur
+        return dp[n]
+
+    def update(self, hyps, refs):
+        for h, r in zip(hyps, refs):
+            d = self._levenshtein(list(h), list(r))
+            if self.normalized:
+                d = d / max(len(r), 1)
+            self.total += d
+            self.count += 1
+            if d == 0:
+                self.seq_right += 1
+
+    def eval(self):
+        avg = self.total / self.count if self.count else 0.0
+        instance_err = 1.0 - (self.seq_right / self.count if self.count else 0.0)
+        return avg, instance_err
+
+
+class CompositeMetric(MetricBase):
+    """reference: metrics.py CompositeMetric."""
+
+    def __init__(self, *metrics: MetricBase):
+        self.metrics = list(metrics)
+
+    def add_metric(self, m: MetricBase):
+        self.metrics.append(m)
+
+    def reset(self):
+        for m in self.metrics:
+            m.reset()
+
+    def update(self, *args, **kwargs):
+        for m in self.metrics:
+            m.update(*args, **kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self.metrics]
+
+
+def chunk_eval(*args, **kwargs):
+    raise NotImplementedError(
+        "chunk_eval (reference: operators/metrics/chunk_eval... sequence "
+        "chunking F1) lands with the NLP tagging models")

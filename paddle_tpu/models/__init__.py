@@ -1,0 +1,6 @@
+"""Model zoo — the reference's benchmark/book models rebuilt TPU-first
+(reference: benchmark/fluid/models/, tests/book/)."""
+
+from . import mnist
+
+__all__ = ["mnist"]

@@ -1,0 +1,80 @@
+"""Attention ops.
+
+The reference has no attention op — it composes matmul+softmax in python
+(reference: python/paddle/fluid/nets.py:343 scaled_dot_product_attention).
+Here attention is first-class: an XLA path (compiler-fused) and a Pallas
+flash-attention path for long sequences (paddle_tpu.ops.pallas.flash_attention)
+selected automatically on TPU.
+
+Layout convention: (batch, seq, heads, head_dim) — "BTHD".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+
+def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                                 dropout_p: float = 0.0, dropout_key=None,
+                                 scale: Optional[float] = None,
+                                 use_flash: bool = True):
+    """q: (B, Tq, H, D), k/v: (B, Tk, H, D) → (B, Tq, H, D).
+
+    mask: broadcastable to (B, H, Tq, Tk); True/1 = keep, False/0 = mask out.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if use_flash and mask is None and dropout_p == 0.0:
+        flash = _get_flash()
+        if flash is not None and _flash_ok(q, k):
+            return flash(q, k, v, causal=causal, scale=scale)
+    return xla_attention(q, k, v, mask=mask, causal=causal,
+                         dropout_p=dropout_p, dropout_key=dropout_key,
+                         scale=scale)
+
+
+def xla_attention(q, k, v, mask=None, causal: bool = False,
+                  dropout_p: float = 0.0, dropout_key=None,
+                  scale: Optional[float] = None):
+    """Reference XLA implementation — materializes (B, H, Tq, Tk) scores."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    neg = jnp.finfo(logits.dtype).min
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
+        logits = jnp.where(cm, logits, neg)
+    if mask is not None:
+        logits = jnp.where(mask.astype(jnp.bool_), logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0:
+        enforce(dropout_key is not None, "attention dropout requires a key")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.lru_cache(maxsize=1)
+def _get_flash():
+    try:
+        from .pallas.flash_attention import flash_attention
+
+        return flash_attention
+    except Exception:
+        return None
+
+
+def _flash_ok(q, k) -> bool:
+    """Flash kernel constraints: TPU backend, block-divisible seq lens,
+    supported head dim."""
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
+    return tq % 128 == 0 and tk % 128 == 0 and d in (64, 128, 256)

@@ -1,0 +1,14 @@
+"""Parallelism: collectives, data-parallel trainer, sharding rules.
+
+TP/PP/SP/EP land as mesh-axis sharding rules (SURVEY §7 step 8); the mesh
+itself lives in paddle_tpu.core.mesh.
+"""
+
+from .api import DataParallel, Trainer
+from .collective import (allgather, allreduce, all_to_all, axis_index,
+                         broadcast, ppermute, reduce_scatter)
+
+__all__ = [
+    "DataParallel", "Trainer", "allgather", "allreduce", "all_to_all",
+    "axis_index", "broadcast", "ppermute", "reduce_scatter",
+]

@@ -1,0 +1,137 @@
+"""High-level parallel training — the ParallelExecutor/CompiledProgram/fleet
+capability (reference: framework/parallel_executor.cc:195,
+compiler.py:117 with_data_parallel, incubate/fleet/collective) as one object.
+
+``Trainer`` owns (params, buffers, opt_state) placed on a mesh and a jitted
+train step. Data parallelism is a *sharding*, not a program rewrite: params
+replicated, batch split over "dp"; XLA inserts gradient all-reduces (the whole
+multi_devices_graph_pass, reference: multi_devices_graph_pass.cc:450, becomes
+compiler work). Buffers donate so updates are in-place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import random as prandom
+from ..core.config import BuildStrategy
+from ..core.enforce import enforce
+from ..core.mesh import get_mesh
+from ..nn.layer import Layer
+from ..optimizer.optimizers import Optimizer
+
+
+class Trainer:
+    """Functional training driver.
+
+    loss_builder(params, buffers, rng, batch) ->
+        (loss, (metrics_dict, new_buffers))
+    """
+
+    def __init__(self, model: Layer, optimizer: Optimizer,
+                 loss_builder: Callable, mesh=None,
+                 build_strategy: Optional[BuildStrategy] = None,
+                 param_spec: Optional[Dict[str, P]] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_builder = loss_builder
+        self.mesh = mesh or get_mesh()
+        self.strategy = build_strategy or BuildStrategy()
+
+        rep = NamedSharding(self.mesh, P())
+
+        def place(tree, spec_map=None):
+            def put(path_leaf):
+                return jax.device_put(path_leaf, rep)
+
+            return jax.tree_util.tree_map(put, tree)
+
+        self.params = place(model.named_parameters())
+        if param_spec:
+            for name, spec in param_spec.items():
+                self.params[name] = jax.device_put(
+                    self.params[name], NamedSharding(self.mesh, spec))
+        self.buffers = place(model.named_buffers())
+        self.opt_state = place(optimizer.init(self.params))
+        self._rng = prandom.next_key()
+        donate = (0, 1, 2) if self.strategy.donate_inputs else ()
+        self._jit_step = jax.jit(self._step, donate_argnums=donate)
+        self._jit_eval = jax.jit(self._eval_step)
+
+    # --- pure step functions ------------------------------------------------
+
+    def _step(self, params, buffers, opt_state, rng, batch):
+        def lf(p):
+            loss, (metrics, new_buffers) = self.loss_builder(
+                p, buffers, rng, batch)
+            return loss, (metrics, new_buffers)
+
+        (loss, (metrics, new_buffers)), grads = jax.value_and_grad(
+            lf, has_aux=True)(params)
+        new_params, new_opt_state = self.optimizer.apply(params, grads,
+                                                         opt_state)
+        return loss, metrics, new_params, new_buffers, new_opt_state
+
+    def _eval_step(self, params, buffers, batch):
+        loss, (metrics, _) = self.loss_builder(params, buffers, None, batch)
+        return loss, metrics
+
+    # --- driver API ---------------------------------------------------------
+
+    def train_step(self, batch) -> Tuple[Any, Dict[str, Any]]:
+        self._rng, sub = jax.random.split(self._rng)
+        loss, metrics, self.params, self.buffers, self.opt_state = \
+            self._jit_step(self.params, self.buffers, self.opt_state, sub, batch)
+        return loss, metrics
+
+    def eval_step(self, batch):
+        return self._jit_eval(self.params, self.buffers, batch)
+
+    def sync_model(self) -> Layer:
+        """Write current params/buffers back into the Layer (for save/export)."""
+        self.model.set_parameters(jax.device_get(self.params))
+        self.model.set_buffers(jax.device_get(self.buffers))
+        return self.model
+
+    def data_sharding(self) -> NamedSharding:
+        """Sharding for input batches: leading dim over dp (feed via
+        DataFeeder(sharding=...) — the feed_and_split analog)."""
+        return NamedSharding(self.mesh, P("dp"))
+
+    @classmethod
+    def supervised(cls, model: Layer, optimizer: Optimizer,
+                   loss_fn: Callable, metrics_fn: Optional[Callable] = None,
+                   mesh=None, **kw) -> "Trainer":
+        """Convenience for (x, label) batches: batch = dict(x=..., label=...)
+        or tuple (x, label)."""
+
+        def loss_builder(params, buffers, rng, batch):
+            if isinstance(batch, dict):
+                x, label = batch["x"], batch["label"]
+            else:
+                x, label = batch
+            training = rng is not None
+            out, new_buffers = model.functional_call(
+                params, x, buffers=buffers, rng=rng, training=training)
+            loss = loss_fn(out, label)
+            metrics = metrics_fn(out, label) if metrics_fn else {}
+            return loss, (metrics, new_buffers)
+
+        return cls(model, optimizer, loss_builder, mesh=mesh, **kw)
+
+
+class DataParallel:
+    """Dygraph-style wrapper (reference: dygraph/parallel.py:79 DataParallel)
+    — here just a Trainer factory over an all-device dp mesh."""
+
+    def __new__(cls, model: Layer, optimizer: Optimizer, loss_fn: Callable,
+                metrics_fn=None, devices=None):
+        from ..core.mesh import auto_mesh
+
+        mesh = auto_mesh(devices)
+        return Trainer.supervised(model, optimizer, loss_fn, metrics_fn,
+                                  mesh=mesh)

@@ -1,0 +1,157 @@
+"""Data-layer tests: reader decorators, feeder, device loader, datasets."""
+
+import numpy as np
+
+import jax
+
+from paddle_tpu import data as D
+
+
+def count_reader(n):
+    def reader():
+        yield from range(n)
+
+    return reader
+
+
+def test_batch_and_drop_last():
+    batches = list(D.batch(count_reader(10), 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    batches = list(D.batch(count_reader(10), 3, drop_last=False)())
+    assert batches[-1] == [9]
+
+
+def test_shuffle_is_permutation_and_seeded():
+    out1 = list(D.shuffle(count_reader(20), 8, seed=5)())
+    out2 = list(D.shuffle(count_reader(20), 8, seed=5)())
+    assert out1 == out2
+    assert sorted(out1) == list(range(20))
+    assert out1 != list(range(20))
+
+
+def test_chain_compose_map_firstn():
+    c = D.chain(count_reader(2), count_reader(2))
+    assert list(c()) == [0, 1, 0, 1]
+    comp = D.compose(count_reader(3), count_reader(3))
+    assert list(comp()) == [(0, 0), (1, 1), (2, 2)]
+    m = D.map_readers(lambda a, b: a + b, count_reader(3), count_reader(3))
+    assert list(m()) == [0, 2, 4]
+    assert list(D.firstn(count_reader(100), 3)()) == [0, 1, 2]
+
+
+def test_buffered_and_cache():
+    assert list(D.buffered(count_reader(10), 2)()) == list(range(10))
+    calls = [0]
+
+    def reader():
+        calls[0] += 1
+        yield from range(3)
+
+    c = D.cache(reader)
+    assert list(c()) == [0, 1, 2]
+    assert list(c()) == [0, 1, 2]
+    assert calls[0] == 1
+
+
+def test_buffered_propagates_errors():
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    import pytest
+
+    with pytest.raises(ValueError, match="boom"):
+        list(D.buffered(bad, 2)())
+
+
+def test_xmap_readers_ordered():
+    out = list(D.xmap_readers(lambda x: x * 2, count_reader(20), 4, 4,
+                              order=True)())
+    assert out == [2 * i for i in range(20)]
+
+
+def test_xmap_readers_unordered_complete():
+    out = list(D.xmap_readers(lambda x: x * 2, count_reader(20), 4, 4)())
+    assert sorted(out) == [2 * i for i in range(20)]
+
+
+def test_data_feeder_stacks_and_types():
+    feeder = D.DataFeeder(["img", "label"], dtypes=[np.float32, np.int32])
+    batch = [(np.ones(4), 1), (np.zeros(4), 0)]
+    out = feeder.feed(batch)
+    assert out["img"].shape == (2, 4)
+    assert str(out["img"].dtype) == "float32"
+    assert str(out["label"].dtype) == "int32"
+
+
+def test_data_feeder_sharded():
+    from jax.sharding import NamedSharding, PartitionSpec
+    import paddle_tpu as pt
+
+    mesh = pt.build_mesh(dp=8)
+    s = NamedSharding(mesh, PartitionSpec("dp"))
+    feeder = D.DataFeeder(["x"], sharding=s)
+    out = feeder.feed([(np.ones(3),) for _ in range(16)])
+    assert out["x"].sharding.is_equivalent_to(s, 2)
+
+
+def test_device_loader_prefetch():
+    def batches():
+        for i in range(5):
+            yield {"x": np.full((2, 2), i, np.float32)}
+
+    seen = [np.asarray(b["x"])[0, 0] for b in D.DeviceLoader(batches)]
+    assert seen == [0, 1, 2, 3, 4]
+
+
+def test_mnist_dataset_contract():
+    r = D.dataset.mnist("train", synthetic_size=64)
+    samples = list(r())
+    assert len(samples) == 64
+    img, lbl = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert 0 <= lbl < 10
+    # deterministic
+    img2, _ = next(iter(r()))
+    np.testing.assert_allclose(img, img2)
+
+
+def test_synthetic_translation_contract():
+    r = D.dataset.synthetic_translation(size=10)
+    for src, trg in r():
+        assert src.dtype == np.int64
+        np.testing.assert_array_equal(trg, src[::-1])
+
+
+def test_synthetic_ctr_contract():
+    r = D.dataset.synthetic_ctr(size=10)
+    dense, sparse, label = next(iter(r()))
+    assert dense.shape == (13,) and sparse.shape == (26,)
+    assert label in (0, 1)
+
+
+def test_compose_unaligned_truncates():
+    # regression: check_alignment=False follows reference zip semantics
+    out = list(D.compose(count_reader(5), count_reader(3),
+                         check_alignment=False)())
+    assert out == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_cache_abandoned_first_pass_no_dup():
+    c = D.cache(count_reader(6))
+    it = iter(c())
+    next(it), next(it)  # abandon early
+    assert list(c()) == list(range(6))
+    assert list(c()) == list(range(6))
+
+
+def test_xmap_readers_propagates_mapper_error():
+    import pytest
+
+    def bad_mapper(x):
+        if x == 3:
+            raise ValueError("mapper boom")
+        return x
+
+    with pytest.raises(ValueError, match="mapper boom"):
+        list(D.xmap_readers(bad_mapper, count_reader(10), 2, 2)())

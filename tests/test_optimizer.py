@@ -1,0 +1,227 @@
+"""Optimizer tests: update-rule correctness vs hand-computed numpy, schedules,
+clip/regularizer plumbing, loss scaler, end-to-end quadratic convergence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import clip as C
+from paddle_tpu import optimizer as opt
+from paddle_tpu import regularizer as reg
+
+RNG = np.random.default_rng(4)
+
+
+def quad_params():
+    return {"w": jnp.asarray(np.array([1.0, -2.0, 3.0], np.float32))}
+
+
+def quad_loss(params):
+    return jnp.sum(jnp.square(params["w"]))
+
+
+@pytest.mark.parametrize("optimizer,tol_steps", [
+    (opt.SGD(learning_rate=0.1), 200),
+    (opt.Momentum(learning_rate=0.05, momentum=0.9), 200),
+    (opt.Momentum(learning_rate=0.05, momentum=0.9, use_nesterov=True), 200),
+    (opt.Adam(learning_rate=0.1), 300),
+    (opt.AdamW(learning_rate=0.1, weight_decay=0.001), 300),
+    (opt.Adamax(learning_rate=0.2), 300),
+    (opt.Adagrad(learning_rate=0.5), 300),
+    (opt.DecayedAdagrad(learning_rate=0.2), 300),
+    (opt.Adadelta(learning_rate=5.0), 300),
+    (opt.RMSProp(learning_rate=0.05), 300),
+    (opt.RMSProp(learning_rate=0.05, centered=True, momentum=0.5), 300),
+    (opt.Ftrl(learning_rate=0.5), 300),
+    (opt.Lamb(learning_rate=0.05, weight_decay=0.0), 300),
+    (opt.LarsMomentum(learning_rate=0.5), 300),
+])
+def test_optimizers_converge_on_quadratic(optimizer, tol_steps):
+    params = quad_params()
+    state = optimizer.init(params)
+    step = jax.jit(optimizer.minimize_fn(quad_loss))
+    loss0 = float(quad_loss(params))
+    for _ in range(tol_steps):
+        loss, params, state = step(params, state)
+    assert float(loss) < 0.05 * loss0, f"{type(optimizer).__name__}: {float(loss)}"
+
+
+def test_sgd_exact_update():
+    o = opt.SGD(learning_rate=0.1)
+    params = {"w": jnp.array([1.0, 2.0])}
+    grads = {"w": jnp.array([0.5, -1.0])}
+    state = o.init(params)
+    new_p, state = o.apply(params, grads, state)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), [0.95, 2.1], rtol=1e-6)
+    assert int(state["step"]) == 1
+
+
+def test_momentum_matches_reference_formula():
+    # reference momentum_op: v' = mu*v + g; p' = p - lr*v'
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9)
+    p = {"w": jnp.array([1.0])}
+    g = {"w": jnp.array([1.0])}
+    s = o.init(p)
+    p1, s = o.apply(p, g, s)
+    np.testing.assert_allclose(np.asarray(p1["w"]), [1 - 0.1 * 1.0], rtol=1e-6)
+    p2, s = o.apply(p1, g, s)
+    # v2 = 0.9*1 + 1 = 1.9
+    np.testing.assert_allclose(np.asarray(p2["w"]), [0.9 - 0.19], rtol=1e-6)
+
+
+def test_adam_matches_reference_formula():
+    o = opt.Adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    p = {"w": jnp.array([2.0])}
+    g = {"w": jnp.array([0.4])}
+    s = o.init(p)
+    p1, s = o.apply(p, g, s)
+    m = 0.1 * 0.4
+    v = 0.001 * 0.16
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expected = 2.0 - 0.001 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(np.asarray(p1["w"]), [expected], rtol=1e-5)
+
+
+def test_nested_pytree_params():
+    o = opt.Adam(learning_rate=0.05)
+    params = {"a": {"w": jnp.ones((2, 2)), "b": jnp.ones((2,))},
+              "c": [jnp.ones((3,))]}
+
+    def loss(p):
+        return (jnp.sum(p["a"]["w"] ** 2) + jnp.sum(p["a"]["b"] ** 2)
+                + jnp.sum(p["c"][0] ** 2))
+
+    state = o.init(params)
+    step = jax.jit(o.minimize_fn(loss))
+    for _ in range(100):
+        l, params, state = step(params, state)
+    assert float(l) < 0.2
+
+
+# --- LR schedules ----------------------------------------------------------
+
+def test_schedules_shapes_and_values():
+    s = jnp.asarray(0)
+    assert abs(float(opt.ExponentialDecay(1.0, 10, 0.5)(jnp.asarray(10))) - 0.5) < 1e-6
+    assert abs(float(opt.InverseTimeDecay(1.0, 10, 1.0)(jnp.asarray(10))) - 0.5) < 1e-6
+    pw = opt.PiecewiseDecay([100, 200], [1.0, 0.5, 0.25])
+    assert float(pw(jnp.asarray(0))) == 1.0
+    assert float(pw(jnp.asarray(150))) == 0.5
+    assert float(pw(jnp.asarray(250))) == 0.25
+    poly = opt.PolynomialDecay(1.0, 100, end_learning_rate=0.0, power=1.0)
+    assert abs(float(poly(jnp.asarray(50))) - 0.5) < 1e-6
+    cos = opt.CosineDecay(1.0, 10, 10)
+    assert abs(float(cos(jnp.asarray(0))) - 1.0) < 1e-6
+    noam = opt.NoamDecay(512, 4000)
+    v1, v2 = float(noam(jnp.asarray(100))), float(noam(jnp.asarray(4000)))
+    assert v1 < v2  # warming up
+
+
+def test_linear_warmup_wraps_schedule():
+    lw = opt.LinearWarmup(opt.PiecewiseDecay([100], [1.0, 0.1]), 10, 0.0, 1.0)
+    assert abs(float(lw(jnp.asarray(5))) - 0.5) < 1e-6
+    assert float(lw(jnp.asarray(50))) == 1.0
+    assert abs(float(lw(jnp.asarray(150))) - 0.1) < 1e-6
+
+
+def test_schedule_in_optimizer_steps():
+    o = opt.SGD(learning_rate=opt.PiecewiseDecay([2], [0.1, 0.01]))
+    p = {"w": jnp.array([1.0])}
+    s = o.init(p)
+    step = jax.jit(o.minimize_fn(lambda pp: jnp.sum(pp["w"] ** 2)))
+    _, p, s = step(p, s)  # step 0: lr 0.1
+    assert abs(float(p["w"][0]) - 0.8) < 1e-6
+    _, p, s = step(p, s)
+    _, p, s = step(p, s)  # step 2: lr 0.01
+    assert float(o.current_lr(s)) == pytest.approx(0.01)
+
+
+# --- clip / regularizer ----------------------------------------------------
+
+def test_clip_by_global_norm():
+    g = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}  # global norm 5
+    clipped = C.GradientClipByGlobalNorm(1.0)(g)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), [0.6], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(clipped["b"]), [0.8], rtol=1e-5)
+    # under the cap: untouched
+    same = C.GradientClipByGlobalNorm(10.0)(g)
+    np.testing.assert_allclose(np.asarray(same["a"]), [3.0], rtol=1e-6)
+
+
+def test_clip_by_value_and_norm():
+    g = {"a": jnp.array([-5.0, 5.0])}
+    out = C.GradientClipByValue(1.0)(g)
+    np.testing.assert_allclose(np.asarray(out["a"]), [-1, 1])
+    out = C.GradientClipByNorm(1.0)({"a": jnp.array([3.0, 4.0])})
+    np.testing.assert_allclose(np.asarray(out["a"]), [0.6, 0.8], rtol=1e-5)
+
+
+def test_l2_regularizer_in_optimizer():
+    o = opt.SGD(learning_rate=1.0, regularization=reg.L2Decay(0.1))
+    p = {"w": jnp.array([1.0])}
+    s = o.init(p)
+    new_p, _ = o.apply(p, {"w": jnp.array([0.0])}, s)
+    # grad = 0 + 0.1*w → p' = 1 - 0.1
+    np.testing.assert_allclose(np.asarray(new_p["w"]), [0.9], rtol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    o = opt.SGD(learning_rate=1.0, grad_clip=C.GradientClipByGlobalNorm(1.0))
+    p = {"w": jnp.array([0.0])}
+    s = o.init(p)
+    new_p, _ = o.apply(p, {"w": jnp.array([100.0])}, s)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), [-1.0], rtol=1e-5)
+
+
+# --- loss scaler -----------------------------------------------------------
+
+def test_dynamic_loss_scaler():
+    scaler = opt.DynamicLossScaler(init_scale=4.0, incr_every_n_steps=2)
+    s = scaler.init()
+    grads = {"w": jnp.array([8.0])}
+    unscaled, s, finite = scaler.unscale_and_update(grads, s)
+    assert bool(finite)
+    np.testing.assert_allclose(np.asarray(unscaled["w"]), [2.0])
+    assert float(s["scale"]) == 4.0 and int(s["good_steps"]) == 1
+    _, s, _ = scaler.unscale_and_update(grads, s)
+    assert float(s["scale"]) == 8.0  # grew after 2 good steps
+    bad = {"w": jnp.array([jnp.inf])}
+    _, s, finite = scaler.unscale_and_update(bad, s)
+    assert not bool(finite)
+    assert float(s["scale"]) == 4.0  # halved
+
+
+def test_loss_scaler_jittable():
+    scaler = opt.DynamicLossScaler(init_scale=2.0)
+    s = scaler.init()
+
+    @jax.jit
+    def f(grads, s):
+        return scaler.unscale_and_update(grads, s)
+
+    un, s2, finite = f({"w": jnp.array([4.0])}, s)
+    np.testing.assert_allclose(np.asarray(un["w"]), [2.0])
+
+
+def test_loss_scaler_decr_every_n():
+    scaler = opt.DynamicLossScaler(init_scale=8.0, decr_every_n_nan_or_inf=2)
+    s = scaler.init()
+    bad = {"w": jnp.array([jnp.inf])}
+    _, s, _ = scaler.unscale_and_update(bad, s)
+    assert float(s["scale"]) == 8.0  # first bad step: no decay yet
+    _, s, _ = scaler.unscale_and_update(bad, s)
+    assert float(s["scale"]) == 4.0  # second consecutive bad step: halve
+
+
+def test_clip_before_regularization_order():
+    # reference order: clip raw grads first, then add decay term
+    from paddle_tpu import clip as C, regularizer as reg
+    o = opt.SGD(learning_rate=1.0, grad_clip=C.GradientClipByGlobalNorm(1.0),
+                regularization=reg.L2Decay(0.5))
+    p = {"w": jnp.array([2.0])}
+    s = o.init(p)
+    new_p, _ = o.apply(p, {"w": jnp.array([100.0])}, s)
+    # clip(100)->1, then +0.5*2=1 -> grad 2 -> p' = 0
+    np.testing.assert_allclose(np.asarray(new_p["w"]), [0.0], atol=1e-6)
