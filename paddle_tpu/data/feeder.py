@@ -82,8 +82,11 @@ class DeviceLoader:
         self.capacity = capacity
 
     def __iter__(self):
+        from .reader import _put_cancellable
+
         q: queue.Queue = queue.Queue(maxsize=self.capacity)
         err = []
+        stop = threading.Event()
 
         def stage(item):
             if self.transform is not None:
@@ -98,17 +101,23 @@ class DeviceLoader:
         def worker():
             try:
                 for item in self.batches():
-                    q.put(stage(item))
+                    if not _put_cancellable(q, stage(item), stop):
+                        return
             except BaseException as e:
                 err.append(e)
             finally:
-                q.put(self._END)
+                _put_cancellable(q, self._END, stop)
 
         threading.Thread(target=worker, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is self._END:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    break
+                yield item
+        finally:
+            # early break/exception in the train loop: release the worker so
+            # staged device batches aren't pinned for the process lifetime
+            stop.set()
         if err:
             raise err[0]

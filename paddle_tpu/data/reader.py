@@ -87,27 +87,45 @@ def buffered(reader: Reader, size: int) -> Reader:
     def buffered_reader():
         q: queue.Queue = queue.Queue(maxsize=size)
         err: List[BaseException] = []
+        stop = threading.Event()
 
         def worker():
             try:
                 for item in reader():
-                    q.put(item)
+                    if not _put_cancellable(q, item, stop):
+                        return
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                q.put(end)
+                _put_cancellable(q, end, stop)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is end:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    break
+                yield item
+        finally:
+            # consumer may abandon mid-stream (break/exception): unblock the
+            # worker so it exits instead of pinning buffered items forever
+            stop.set()
         if err:
             raise err[0]
 
     return buffered_reader
+
+
+def _put_cancellable(q: "queue.Queue", item, stop: "threading.Event") -> bool:
+    """q.put that gives up once `stop` is set; returns False if cancelled."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
 
 
 def firstn(reader: Reader, n: int) -> Reader:
@@ -149,10 +167,15 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
         errors: List[BaseException] = []
 
         def feeder():
-            for i, item in enumerate(reader()):
-                in_q.put((i, item))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, item in enumerate(reader()):
+                    in_q.put((i, item))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                # always release the workers, even if reader() raised
+                for _ in range(process_num):
+                    in_q.put(end)
 
         def worker():
             try:

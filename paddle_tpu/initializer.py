@@ -116,7 +116,6 @@ class Bilinear(Initializer):
         # shape: (C_in, C_out, kh, kw) or (C, 1, kh, kw)
         kh, kw = shape[-2], shape[-1]
         f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
-        c_h = (kh - 1) / (2.0 * f_h) if kh % 2 == 0 else (f_h - 1) / (2.0 * f_h) * 2
         # standard bilinear kernel
         og = np.ogrid[:kh, :kw]
         center_h = (kh - 1) / 2.0
@@ -124,7 +123,6 @@ class Bilinear(Initializer):
         filt = ((1 - np.abs(og[0] - center_h) / f_h)
                 * (1 - np.abs(og[1] - center_w) / f_w))
         weight = np.zeros(shape, np.float32)
-        minc = min(shape[0], shape[1])
         for i in range(shape[0]):
             weight[i, min(i, shape[1] - 1)] = filt
         return jnp.asarray(weight, dtype)

@@ -99,8 +99,10 @@ class Auc(MetricBase):
         total_neg = fp_cum[-1]
         if total_pos == 0 or total_neg == 0:
             return 0.0
-        tpr = tp_cum / total_pos
-        fpr = fp_cum / total_neg
+        # prepend the (0,0) ROC anchor so mass in the top bucket still
+        # integrates over the full curve (degenerate case → 0.5, not 0)
+        tpr = np.concatenate([[0.0], tp_cum / total_pos])
+        fpr = np.concatenate([[0.0], fp_cum / total_neg])
         return float(np.trapezoid(tpr, fpr))
 
 

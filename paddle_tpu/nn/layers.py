@@ -95,11 +95,14 @@ class Conv2DTranspose(Layer):
                                   I.Constant(0.0), is_bias=True)
 
     def forward(self, x):
-        out = ON.conv2d_transpose(x, self.weight, self.stride, self.padding,
+        pol = get_policy()
+        out = ON.conv2d_transpose(pol.cast_to_compute(x),
+                                  pol.cast_to_compute(self.weight),
+                                  self.stride, self.padding,
                                   self.dilation, self.groups)
         if self.has_bias:
-            out = out + self.bias.reshape(1, -1, 1, 1)
-        return _apply_act(out, self.act)
+            out = out + pol.cast_to_compute(self.bias).reshape(1, -1, 1, 1)
+        return _apply_act(pol.cast_to_output(out), self.act)
 
 
 class Pool2D(Layer):

@@ -1,0 +1,347 @@
+"""Flash attention — blockwise online-softmax attention as a Pallas TPU
+kernel, with a custom VJP (recompute-based backward).
+
+Capability role: the reference has no attention op at all (it composes
+matmul+softmax in python, reference: python/paddle/fluid/nets.py:343); its
+hand-written-kernel niche is `operators/jit/`. Here the niche is filled
+TPU-natively: Q/K/V stream HBM→VMEM block by block, scores never materialize
+in HBM, softmax runs online with a running (max, sum), and the MXU sees only
+dense (block_q × d) @ (d × block_k) matmuls.
+
+Layout: (batch, seq, heads, head_dim) at the API; internally (batch*heads,
+seq, head_dim). Sequence lengths must be divisible by the block sizes (the
+framework-level caller pads — ragged semantics are handled one level up, see
+ops/sequence.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; interpret mode needs none of it
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30  # safe large-negative (finite: avoids inf-inf NaNs in bwd)
+
+
+def _vmem_spec(shape, index_map):
+    if _VMEM is not None:
+        return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def _scratch(shape, dtype):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    return pl.MemoryRef(shape, dtype) if hasattr(pl, "MemoryRef") else None
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, offset, block_q,
+                block_k, num_k_blocks):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: block (i, j) contributes iff its lowest row can see its first
+    # column: i*bq + bq - 1 >= j*bk
+    should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
+                  if causal else True)
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)  # (bk, d)
+        v = v_ref[0]                      # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (i * block_q + rows + offset) >= (j * block_k + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                              # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                             # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)                    # (bq, 1)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-37))
+
+
+def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    grid = (bh, tq // block_q, tk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, offset=tk - tq,
+        block_q=block_q, block_k=block_k, num_k_blocks=tk // block_k)
+    # lse carried as (bh, tq, 1): the trailing unit dim keeps the block's
+    # last-two-dims (block_q, 1) legal for the Mosaic (8, 128) tiling rule
+    out_shape = (
+        jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[
+            _scratch((block_q, d), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (recompute p from q,k + saved lse — no score materialization)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, offset, block_q, block_k,
+               num_k_blocks):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
+                  if causal else True)
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]      # (bq, 1)
+        delta = delta_ref[0]  # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (i * block_q + rows + offset) >= (j * block_k + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, offset,
+                block_q, block_k, num_q_blocks):
+    j, i = pl.program_id(1), pl.program_id(2)  # kv block outer, q block inner
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
+                  if causal else True)
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]      # (bq, 1)
+        delta = delta_ref[0]  # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (i * block_q + rows + offset) >= (j * block_k + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                               # (bq, bk)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, d)
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+              interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (bh, tq, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, offset=tk - tq,
+            block_q=block_q, block_k=block_k, num_k_blocks=tk // block_k),
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, offset=tk - tq,
+            block_q=block_q, block_k=block_k, num_q_blocks=tq // block_q),
+        grid=(bh, tk // block_k, tq // block_q),
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=(
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ),
+        scratch_shapes=[
+            _scratch((block_k, d), jnp.float32),
+            _scratch((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper over (batch*heads, seq, d)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                     interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """Blockwise attention over (batch, seq, heads, head_dim) inputs.
+
+    Sequence lengths must divide the block sizes (shrunk automatically for
+    short sequences). Differentiable (custom VJP, recompute backward).
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"seq lens ({tq},{tk}) must be divisible by blocks "
+            f"({block_q},{block_k}); pad upstream")
+    if interpret is None:
+        interpret = _use_interpret()
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    of = _flash(qf, kf, vf, causal, float(scale), block_q, block_k, interpret)
+    return of.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

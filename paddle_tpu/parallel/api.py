@@ -56,7 +56,10 @@ class Trainer:
                 self.params[name] = jax.device_put(
                     self.params[name], NamedSharding(self.mesh, spec))
         self.buffers = place(model.named_buffers())
-        self.opt_state = place(optimizer.init(self.params))
+        # opt state inherits each param's sharding (init uses zeros_like on
+        # the already-placed params) — re-placing replicated would defeat
+        # param_spec's memory sharding for the moments
+        self.opt_state = optimizer.init(self.params)
         self._rng = prandom.next_key()
         donate = (0, 1, 2) if self.strategy.donate_inputs else ()
         self._jit_step = jax.jit(self._step, donate_argnums=donate)
