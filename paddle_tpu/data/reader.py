@@ -165,58 +165,65 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
         in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
         errors: List[BaseException] = []
+        stop = threading.Event()
 
         def feeder():
             try:
                 for i, item in enumerate(reader()):
-                    in_q.put((i, item))
+                    if not _put_cancellable(in_q, (i, item), stop):
+                        return
             except BaseException as e:
                 errors.append(e)
             finally:
                 # always release the workers, even if reader() raised
                 for _ in range(process_num):
-                    in_q.put(end)
+                    _put_cancellable(in_q, end, stop)
 
         def worker():
             try:
-                while True:
+                while not stop.is_set():
                     item = in_q.get()
                     if item is end:
                         return
                     i, x = item
-                    out_q.put((i, mapper(x)))
+                    if not _put_cancellable(out_q, (i, mapper(x)), stop):
+                        return
             except BaseException as e:
                 errors.append(e)
             finally:
-                out_q.put(end)
+                _put_cancellable(out_q, end, stop)
 
         threading.Thread(target=feeder, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=worker, daemon=True).start()
 
         finished = 0
-        if order:
-            pending = {}
-            next_i = 0
-            while finished < process_num:
-                item = out_q.get()
-                if item is end:
-                    finished += 1
-                    continue
-                i, y = item
-                pending[i] = y
-                while next_i in pending:
-                    yield pending.pop(next_i)
-                    next_i += 1
-            for i in sorted(pending):
-                yield pending[i]
-        else:
-            while finished < process_num:
-                item = out_q.get()
-                if item is end:
-                    finished += 1
-                    continue
-                yield item[1]
+        try:
+            if order:
+                pending = {}
+                next_i = 0
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is end:
+                        finished += 1
+                        continue
+                    i, y = item
+                    pending[i] = y
+                    while next_i in pending:
+                        yield pending.pop(next_i)
+                        next_i += 1
+                for i in sorted(pending):
+                    yield pending[i]
+            else:
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is end:
+                        finished += 1
+                        continue
+                    yield item[1]
+        finally:
+            # abandoned consumer: unblock feeder + workers so they exit
+            stop.set()
         if errors:
             raise errors[0]
 

@@ -1,6 +1,6 @@
 """Model zoo — the reference's benchmark/book models rebuilt TPU-first
 (reference: benchmark/fluid/models/, tests/book/)."""
 
-from . import mnist
+from . import bert, mnist, transformer
 
-__all__ = ["mnist"]
+__all__ = ["bert", "mnist", "transformer"]

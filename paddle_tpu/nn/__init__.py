@@ -7,6 +7,10 @@ from .layers import (GELU, RNN, BatchNorm, BilinearTensorProduct, Conv2D,
                      GRUCell, LayerNorm, Linear, LSTMCell, MultiHeadAttention,
                      Pool2D, PRelu, ReLU, RMSNorm, Sigmoid, Softmax,
                      SpectralNorm, Tanh)
+from .transformer import (FeedForward, LearnedPositionalEmbedding,
+                          PositionalEncoding, TransformerDecoder,
+                          TransformerDecoderLayer, TransformerEncoder,
+                          TransformerEncoderLayer)
 
 __all__ = [
     "Layer", "LayerList", "Parameter", "Sequential",
@@ -15,4 +19,7 @@ __all__ = [
     "GRUCell", "LayerNorm", "Linear", "LSTMCell", "MultiHeadAttention",
     "Pool2D", "PRelu", "ReLU", "RMSNorm", "Sigmoid", "Softmax",
     "SpectralNorm", "Tanh",
+    "FeedForward", "LearnedPositionalEmbedding", "PositionalEncoding",
+    "TransformerDecoder", "TransformerDecoderLayer", "TransformerEncoder",
+    "TransformerEncoderLayer",
 ]

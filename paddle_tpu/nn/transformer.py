@@ -1,0 +1,175 @@
+"""Transformer layers — encoder/decoder stacks over MultiHeadAttention.
+
+The reference assembles transformers in model code from primitives
+(reference: benchmark/fluid/models/machine_translation.py,
+python/paddle/fluid/nets.py:343 scaled_dot_product_attention); here the
+stack is first-class so the flash/ring-attention kernel paths and TP/SP
+sharding rules have a single home.
+
+TPU notes: pre-norm by default (stable in bf16), GELU FFN, static shapes
+(padding/masking handles ragged batches — see ops/sequence.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from .layer import Layer, LayerList
+from .layers import Dropout, Embedding, LayerNorm, Linear, MultiHeadAttention
+
+
+class FeedForward(Layer):
+    """Position-wise FFN: Linear → act → dropout → Linear."""
+
+    def __init__(self, d_model: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "gelu"):
+        super().__init__()
+        self.fc1 = Linear(d_model, dim_feedforward, act=activation)
+        self.fc2 = Linear(dim_feedforward, d_model)
+        self.drop = Dropout(dropout)
+
+    def forward(self, x):
+        return self.fc2(self.drop(self.fc1(x)))
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "gelu",
+                 normalize_before: bool = True, use_flash: bool = True):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                            use_flash=use_flash)
+        self.ffn = FeedForward(d_model, dim_feedforward, dropout, activation)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.drop1 = Dropout(dropout)
+        self.drop2 = Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        if self.normalize_before:
+            x = x + self.drop1(self.self_attn(self.norm1(x), attn_mask=mask))
+            x = x + self.drop2(self.ffn(self.norm2(x)))
+        else:
+            x = self.norm1(x + self.drop1(self.self_attn(x, attn_mask=mask)))
+            x = self.norm2(x + self.drop2(self.ffn(x)))
+        return x
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "gelu",
+                 normalize_before: bool = True, use_flash: bool = True):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                            use_flash=use_flash)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                             use_flash=use_flash)
+        self.ffn = FeedForward(d_model, dim_feedforward, dropout, activation)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.drop1 = Dropout(dropout)
+        self.drop2 = Dropout(dropout)
+        self.drop3 = Dropout(dropout)
+
+    def forward(self, x, memory, self_mask=None, cross_mask=None,
+                causal: bool = True):
+        if self.normalize_before:
+            x = x + self.drop1(self.self_attn(self.norm1(x),
+                                              attn_mask=self_mask,
+                                              causal=causal))
+            x = x + self.drop2(self.cross_attn(self.norm2(x), memory, memory,
+                                               attn_mask=cross_mask))
+            x = x + self.drop3(self.ffn(self.norm3(x)))
+        else:
+            x = self.norm1(x + self.drop1(self.self_attn(
+                x, attn_mask=self_mask, causal=causal)))
+            x = self.norm2(x + self.drop2(self.cross_attn(
+                x, memory, memory, attn_mask=cross_mask)))
+            x = self.norm3(x + self.drop3(self.ffn(x)))
+        return x
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, num_layers: int, d_model: int, nhead: int,
+                 dim_feedforward: int, dropout: float = 0.1,
+                 activation: str = "gelu", normalize_before: bool = True,
+                 use_flash: bool = True):
+        super().__init__()
+        self.layers = LayerList([
+            TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
+                                    activation, normalize_before, use_flash)
+            for _ in range(num_layers)])
+        self.final_norm = LayerNorm(d_model) if normalize_before else None
+
+    def forward(self, x, mask=None):
+        for layer in self.layers:
+            x = layer(x, mask=mask)
+        if self.final_norm is not None:
+            x = self.final_norm(x)
+        return x
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, num_layers: int, d_model: int, nhead: int,
+                 dim_feedforward: int, dropout: float = 0.1,
+                 activation: str = "gelu", normalize_before: bool = True,
+                 use_flash: bool = True):
+        super().__init__()
+        self.layers = LayerList([
+            TransformerDecoderLayer(d_model, nhead, dim_feedforward, dropout,
+                                    activation, normalize_before, use_flash)
+            for _ in range(num_layers)])
+        self.final_norm = LayerNorm(d_model) if normalize_before else None
+
+    def forward(self, x, memory, self_mask=None, cross_mask=None,
+                causal: bool = True):
+        for layer in self.layers:
+            x = layer(x, memory, self_mask=self_mask, cross_mask=cross_mask,
+                      causal=causal)
+        if self.final_norm is not None:
+            x = self.final_norm(x)
+        return x
+
+
+class PositionalEncoding(Layer):
+    """Sinusoidal position signal (reference: the NMT model's
+    position_encoding_init, benchmark/fluid/models/machine_translation.py)."""
+
+    def __init__(self, d_model: int, max_len: int = 4096,
+                 dropout: float = 0.0, scale_embedding: bool = True):
+        super().__init__()
+        enforce(d_model % 2 == 0, "d_model must be even, got %s", d_model)
+        pos = np.arange(max_len)[:, None]
+        div = np.exp(np.arange(0, d_model, 2) * (-math.log(10000.0) / d_model))
+        pe = np.zeros((max_len, d_model), np.float32)
+        pe[:, 0::2] = np.sin(pos * div)
+        pe[:, 1::2] = np.cos(pos * div)
+        self.register_buffer("pe", pe)
+        self.scale = math.sqrt(d_model) if scale_embedding else 1.0
+        self.drop = Dropout(dropout)
+
+    def forward(self, x):
+        t = x.shape[1]
+        out = x * self.scale + self.pe[None, :t].astype(x.dtype)
+        return self.drop(out)
+
+
+class LearnedPositionalEmbedding(Layer):
+    """BERT-style learned positions."""
+
+    def __init__(self, max_len: int, d_model: int):
+        super().__init__()
+        self.emb = Embedding(max_len, d_model)
+
+    def forward(self, x):
+        t = x.shape[1]
+        positions = jnp.arange(t)[None, :]
+        return x + self.emb(positions)

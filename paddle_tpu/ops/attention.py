@@ -47,13 +47,22 @@ def xla_attention(q, k, v, mask=None, causal: bool = False,
         scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     neg = jnp.finfo(logits.dtype).min
+    keep = None
     if causal:
         tq, tk = logits.shape[-2], logits.shape[-1]
-        cm = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
-        logits = jnp.where(cm, logits, neg)
+        keep = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
+        logits = jnp.where(keep, logits, neg)
     if mask is not None:
-        logits = jnp.where(mask.astype(jnp.bool_), logits, neg)
+        mask = mask.astype(jnp.bool_)
+        keep = mask if keep is None else (keep & mask)
+        logits = jnp.where(mask, logits, neg)
     probs = jax.nn.softmax(logits, axis=-1)
+    if keep is not None:
+        # rows with no valid key output zeros (flash-kernel convention),
+        # not a uniform average of V
+        any_valid = jnp.any(jnp.broadcast_to(keep, logits.shape), -1,
+                            keepdims=True)
+        probs = jnp.where(any_valid, probs, 0.0)
     if dropout_p > 0.0:
         enforce(dropout_key is not None, "attention dropout requires a key")
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)

@@ -7,8 +7,12 @@ itself lives in paddle_tpu.core.mesh.
 from .api import DataParallel, Trainer
 from .collective import (allgather, allreduce, all_to_all, axis_index,
                          broadcast, ppermute, reduce_scatter)
+from .sharding import (OptStateRules, constraint, infer_param_spec,
+                       shard_params, transformer_tp_rules, zero_dp_rules)
 
 __all__ = [
     "DataParallel", "Trainer", "allgather", "allreduce", "all_to_all",
     "axis_index", "broadcast", "ppermute", "reduce_scatter",
+    "OptStateRules", "constraint", "infer_param_spec", "shard_params",
+    "transformer_tp_rules", "zero_dp_rules",
 ]

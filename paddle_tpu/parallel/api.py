@@ -35,7 +35,8 @@ class Trainer:
     def __init__(self, model: Layer, optimizer: Optimizer,
                  loss_builder: Callable, mesh=None,
                  build_strategy: Optional[BuildStrategy] = None,
-                 param_spec: Optional[Dict[str, P]] = None):
+                 param_spec: Optional[Dict[str, P]] = None,
+                 opt_state_rules=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_builder = loss_builder
@@ -60,6 +61,11 @@ class Trainer:
         # the already-placed params) — re-placing replicated would defeat
         # param_spec's memory sharding for the moments
         self.opt_state = optimizer.init(self.params)
+        if opt_state_rules is not None:
+            # ZeRO-style: shard large moment leaves over dp (the PS-sharded
+            # optimizer-state capability, reference:
+            # transpiler/distribute_transpiler.py:702)
+            self.opt_state = opt_state_rules.place(self.opt_state, self.mesh)
         self._rng = prandom.next_key()
         donate = (0, 1, 2) if self.strategy.donate_inputs else ()
         self._jit_step = jax.jit(self._step, donate_argnums=donate)
