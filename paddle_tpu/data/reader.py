@@ -128,6 +128,20 @@ def _put_cancellable(q: "queue.Queue", item, stop: "threading.Event") -> bool:
     return False
 
 
+_CANCELLED = object()
+
+
+def _get_cancellable(q: "queue.Queue", stop: "threading.Event"):
+    """q.get that gives up once `stop` is set; returns _CANCELLED then
+    (otherwise an abandoned consumer would leak blocked threads)."""
+    while not stop.is_set():
+        try:
+            return q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+    return _CANCELLED
+
+
 def firstn(reader: Reader, n: int) -> Reader:
     """reference: decorator.py firstn."""
 
@@ -182,8 +196,8 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
         def worker():
             try:
                 while not stop.is_set():
-                    item = in_q.get()
-                    if item is end:
+                    item = _get_cancellable(in_q, stop)
+                    if item is end or item is _CANCELLED:
                         return
                     i, x = item
                     if not _put_cancellable(out_q, (i, mapper(x)), stop):

@@ -29,6 +29,8 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout: float = 0.1
     use_flash: bool = True
+    # None | 'ring' | 'ulysses' — shard attention over the 'sp' mesh axis
+    seq_parallel: Optional[str] = None
 
     @classmethod
     def base(cls):
@@ -67,7 +69,8 @@ class BertModel(nn.Layer):
         self.encoder = TransformerEncoder(
             cfg.num_layers, cfg.hidden_size, cfg.num_heads,
             cfg.intermediate_size, cfg.dropout, activation="gelu",
-            normalize_before=False, use_flash=cfg.use_flash)
+            normalize_before=False, use_flash=cfg.use_flash,
+            seq_parallel=cfg.seq_parallel)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):

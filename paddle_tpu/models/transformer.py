@@ -34,6 +34,10 @@ class NMTConfig:
     eos_id: int = 1
     pad_id: int = 2
     use_flash: bool = True
+    # decoder-side self-attention SP only: the encoder always applies a
+    # source padding mask, which the SP attention paths reject (see
+    # nn.MultiHeadAttention); long-source SP needs packed sequences
+    seq_parallel: Optional[str] = None
 
     @classmethod
     def base(cls):
@@ -61,7 +65,8 @@ class TransformerNMT(nn.Layer):
             cfg.dim_feedforward, cfg.dropout, use_flash=cfg.use_flash)
         self.decoder = TransformerDecoder(
             cfg.num_decoder_layers, cfg.d_model, cfg.num_heads,
-            cfg.dim_feedforward, cfg.dropout, use_flash=cfg.use_flash)
+            cfg.dim_feedforward, cfg.dropout, use_flash=cfg.use_flash,
+            seq_parallel=cfg.seq_parallel)
         self.generator = nn.Linear(cfg.d_model, cfg.tgt_vocab)
 
     def encode(self, src_ids):

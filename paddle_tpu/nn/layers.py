@@ -384,7 +384,8 @@ class MultiHeadAttention(Layer):
     with an optional Pallas flash-attention path on TPU."""
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
-                 bias: bool = True, use_flash: bool = True, dtype=None):
+                 bias: bool = True, use_flash: bool = True,
+                 seq_parallel: Optional[str] = None, dtype=None):
         super().__init__()
         enforce(embed_dim % num_heads == 0,
                 "embed_dim %s not divisible by heads %s", embed_dim, num_heads)
@@ -392,6 +393,8 @@ class MultiHeadAttention(Layer):
         self.head_dim = embed_dim // num_heads
         self.dropout_p = dropout
         self.use_flash = use_flash
+        # None | "ring" | "ulysses": shard attention over the 'sp' mesh axis
+        self.seq_parallel = seq_parallel
         self.q_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
         self.k_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
         self.v_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
@@ -408,13 +411,34 @@ class MultiHeadAttention(Layer):
         k = self.k_proj(key).reshape(b, tk, h, hd)
         v = self.v_proj(value).reshape(b, tk, h, hd)
 
-        from ..ops.attention import scaled_dot_product_attention
+        if self.seq_parallel is not None:
+            # explicit errors, never a silent fall-back to full attention —
+            # the full path materializes (B,H,T,T) scores and would OOM on
+            # exactly the sequence lengths SP exists for
+            enforce(attn_mask is None,
+                    "seq_parallel=%s does not support attn_mask yet; use "
+                    "causal= or pack sequences", self.seq_parallel)
+            enforce(not (self.training and self.dropout_p > 0),
+                    "seq_parallel attention does not support attention "
+                    "dropout; set dropout=0 on MultiHeadAttention")
+            if self.seq_parallel == "ring":
+                enforce(tk == tq, "ring attention requires self-attention "
+                        "shapes (tq=%s != tk=%s); use 'ulysses' for "
+                        "cross-attention", tq, tk)
+            from ..parallel.context_parallel import context_parallel_attention
 
-        out = scaled_dot_product_attention(
-            q, k, v, mask=attn_mask, causal=causal,
-            dropout_p=self.dropout_p if self.training else 0.0,
-            dropout_key=self.rng("attn_dropout") if (self.training and self.dropout_p > 0) else None,
-            use_flash=self.use_flash)
+            kw = ({"use_flash": self.use_flash}
+                  if self.seq_parallel == "ulysses" else {})
+            out = context_parallel_attention(
+                q, k, v, impl=self.seq_parallel, causal=causal, **kw)
+        else:
+            from ..ops.attention import scaled_dot_product_attention
+
+            out = scaled_dot_product_attention(
+                q, k, v, mask=attn_mask, causal=causal,
+                dropout_p=self.dropout_p if self.training else 0.0,
+                dropout_key=self.rng("attn_dropout") if (self.training and self.dropout_p > 0) else None,
+                use_flash=self.use_flash)
         out = out.reshape(b, tq, d)
         return self.out_proj(out)
 

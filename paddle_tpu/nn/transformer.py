@@ -40,11 +40,16 @@ class FeedForward(Layer):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
                  dropout: float = 0.1, activation: str = "gelu",
-                 normalize_before: bool = True, use_flash: bool = True):
+                 normalize_before: bool = True, use_flash: bool = True,
+                 seq_parallel=None):
         super().__init__()
         self.normalize_before = normalize_before
-        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
-                                            use_flash=use_flash)
+        # attention-probability dropout is unsupported under SP (the ring/
+        # a2a paths have no per-probability RNG plan yet); residual/FFN
+        # dropout below stays active, so regularization is not silently lost
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=0.0 if seq_parallel else dropout,
+            use_flash=use_flash, seq_parallel=seq_parallel)
         self.ffn = FeedForward(d_model, dim_feedforward, dropout, activation)
         self.norm1 = LayerNorm(d_model)
         self.norm2 = LayerNorm(d_model)
@@ -64,11 +69,16 @@ class TransformerEncoderLayer(Layer):
 class TransformerDecoderLayer(Layer):
     def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
                  dropout: float = 0.1, activation: str = "gelu",
-                 normalize_before: bool = True, use_flash: bool = True):
+                 normalize_before: bool = True, use_flash: bool = True,
+                 seq_parallel=None):
         super().__init__()
         self.normalize_before = normalize_before
-        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
-                                            use_flash=use_flash)
+        # attention-probability dropout off under SP (see EncoderLayer note)
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=0.0 if seq_parallel else dropout,
+            use_flash=use_flash, seq_parallel=seq_parallel)
+        # cross-attention keeps the standard path: its K/V length is the
+        # (short) memory length, not the SP-sharded decoder length
         self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
                                              use_flash=use_flash)
         self.ffn = FeedForward(d_model, dim_feedforward, dropout, activation)
@@ -101,11 +111,12 @@ class TransformerEncoder(Layer):
     def __init__(self, num_layers: int, d_model: int, nhead: int,
                  dim_feedforward: int, dropout: float = 0.1,
                  activation: str = "gelu", normalize_before: bool = True,
-                 use_flash: bool = True):
+                 use_flash: bool = True, seq_parallel=None):
         super().__init__()
         self.layers = LayerList([
             TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
-                                    activation, normalize_before, use_flash)
+                                    activation, normalize_before, use_flash,
+                                    seq_parallel)
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
 
@@ -121,11 +132,12 @@ class TransformerDecoder(Layer):
     def __init__(self, num_layers: int, d_model: int, nhead: int,
                  dim_feedforward: int, dropout: float = 0.1,
                  activation: str = "gelu", normalize_before: bool = True,
-                 use_flash: bool = True):
+                 use_flash: bool = True, seq_parallel=None):
         super().__init__()
         self.layers = LayerList([
             TransformerDecoderLayer(d_model, nhead, dim_feedforward, dropout,
-                                    activation, normalize_before, use_flash)
+                                    activation, normalize_before, use_flash,
+                                    seq_parallel)
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
 

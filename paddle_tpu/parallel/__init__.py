@@ -5,6 +5,8 @@ itself lives in paddle_tpu.core.mesh.
 """
 
 from .api import DataParallel, Trainer
+from .context_parallel import (context_parallel_attention, ring_attention,
+                               ulysses_attention)
 from .collective import (allgather, allreduce, all_to_all, axis_index,
                          broadcast, ppermute, reduce_scatter)
 from .sharding import (OptStateRules, constraint, infer_param_spec,
@@ -12,7 +14,8 @@ from .sharding import (OptStateRules, constraint, infer_param_spec,
 
 __all__ = [
     "DataParallel", "Trainer", "allgather", "allreduce", "all_to_all",
-    "axis_index", "broadcast", "ppermute", "reduce_scatter",
+    "axis_index", "broadcast", "context_parallel_attention", "ppermute",
+    "reduce_scatter", "ring_attention", "ulysses_attention",
     "OptStateRules", "constraint", "infer_param_spec", "shard_params",
     "transformer_tp_rules", "zero_dp_rules",
 ]

@@ -1,0 +1,173 @@
+"""Context/sequence parallelism — ring attention and Ulysses all-to-all.
+
+The reference has no sequence-parallel story at all (SURVEY.md §5.7; its long
+-sequence mechanism is LoDTensor packing, reference: framework/lod_tensor.h:110).
+These are green-field TPU designs:
+
+- **Ring attention**: shard the sequence over the ``sp`` mesh axis; K/V blocks
+  rotate around the ring via ``lax.ppermute`` (one ICI hop per step) while each
+  device accumulates its Q-block's attention with a running online softmax
+  (max/sum carries, exactly the flash-attention recurrence lifted to the mesh
+  level). Peak memory per device is O(seq/sp); compute overlaps with the
+  collective permute under XLA's async scheduling.
+
+- **Ulysses**: all-to-all swaps sequence sharding for head sharding, runs a
+  full (optionally Pallas flash) attention locally over seq with heads/sp heads
+  per device, and all-to-alls back. Two a2a hops; requires heads % sp == 0.
+
+Both are differentiable end-to-end: ring via autodiff through the
+``lax.scan``+``ppermute`` loop (step compute wrapped in ``jax.checkpoint`` so
+backward recomputes scores instead of storing (t×t) blocks), Ulysses via the
+flash kernel's custom VJP plus the self-transposing all-to-alls.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.enforce import enforce
+from ..core.mesh import get_mesh
+
+_NEG_INF = -1e30  # finite: avoids inf-inf NaNs under autodiff
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+
+def _ring_step_compute(qf, acc, m, l, kc, vc, src, my_idx, *, t_local, causal,
+                       scale):
+    """One ring step's flash-style accumulation (no collectives; wrapped in
+    jax.checkpoint by the caller so backward recomputes the (t×t) scores)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = my_idx * t_local + lax.broadcasted_iota(
+            jnp.int32, (t_local, t_local), 0)
+        cols = src * t_local + lax.broadcasted_iota(
+            jnp.int32, (t_local, t_local), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)          # (b,h,t,1)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv     # (b,t,h,d)
+    if causal:
+        # K/V block strictly in this Q block's future: contributes nothing.
+        # (s is all _NEG_INF there; keeping old carries avoids exp(0)=1 rows.)
+        valid = src <= my_idx
+        acc_new = jnp.where(valid, acc_new, acc)
+        m_new = jnp.where(valid, m_new, m)
+        l_new = jnp.where(valid, l_new, l)
+    return acc_new, m_new, l_new
+
+
+def _ring_inner(q, k, v, *, axis, causal, scale, n):
+    b, t, h, d = q.shape  # local (sequence-sharded) shapes
+    my_idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qf = q.astype(jnp.float32)
+    compute = jax.checkpoint(functools.partial(
+        _ring_step_compute, t_local=t, causal=causal, scale=scale))
+
+    def step(carry, t_step):
+        acc, m, l, kc, vc = carry
+        src = (my_idx - t_step) % n  # origin rank of the K/V block we hold
+        acc, m, l = compute(qf, acc, m, l, kc, vc, src, my_idx)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return (acc, m, l, kc, vc), None
+
+    acc0 = jnp.zeros((b, t, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    # scan the first n-1 steps (compute + rotate); the last block's compute is
+    # peeled out so the final rotation — whose result would be discarded —
+    # never hits the ICI ring
+    (acc, m, l, kc, vc), _ = lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n - 1))
+    acc, _, l = compute(qf, acc, m, l, kc, vc, (my_idx - (n - 1)) % n, my_idx)
+    o = acc / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-37)
+    return o.astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None, axis: str = "sp",
+                   batch_axis: Optional[str] = "dp", mesh=None):
+    """Sequence-parallel attention over global (B, T, H, D) arrays.
+
+    ``q``/``k``/``v`` are sharded ``P(batch_axis, axis)`` over the mesh; T must
+    divide by the ``axis`` size. Causal masking is in *global* positions.
+    """
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    b, t, h, d = q.shape
+    enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
+    enforce(k.shape == q.shape and v.shape == q.shape,
+            "ring attention is self-attention shaped: q/k/v must match")
+    if scale is None:
+        scale = d ** -0.5
+    spec = P(batch_axis, axis, None, None)
+    inner = functools.partial(_ring_inner, axis=axis, causal=causal,
+                              scale=float(scale), n=n)
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism
+# ---------------------------------------------------------------------------
+
+
+def _ulysses_inner(q, k, v, *, axis, causal, scale, use_flash):
+    from ..ops.attention import scaled_dot_product_attention
+
+    # (b, t/sp, h, d) --a2a--> (b, t, h/sp, d): full sequence, head subset
+    q = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    o = scaled_dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                     use_flash=use_flash)
+    # back to sequence sharding
+    return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = False,
+                      scale: Optional[float] = None, axis: str = "sp",
+                      batch_axis: Optional[str] = "dp", mesh=None,
+                      use_flash: bool = True):
+    """DeepSpeed-Ulysses-style SP: a2a seq→head shard, local full attention
+    (Pallas flash on TPU), a2a back. Requires heads % sp == 0."""
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    b, t, h, d = q.shape
+    enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
+    enforce(h % n == 0, "num heads %s must divide sp size %s (Ulysses)", h, n)
+    if scale is None:
+        scale = d ** -0.5
+    spec = P(batch_axis, axis, None, None)
+    inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
+                              scale=float(scale), use_flash=use_flash)
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def context_parallel_attention(q, k, v, *, impl: str = "ring", **kw):
+    """Dispatch helper: ``impl`` in {"ring", "ulysses"}."""
+    if impl == "ring":
+        return ring_attention(q, k, v, **kw)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, **kw)
+    raise ValueError(f"unknown context-parallel impl {impl!r}")

@@ -65,7 +65,7 @@ def shard_params(params: Dict[str, object], rules: Sequence[Rule],
                  mesh=None) -> Dict[str, object]:
     """Place params per rules (unmatched → replicated)."""
     mesh = mesh or get_mesh()
-    spec_map = infer_param_spec(params, rules)
+    spec_map = infer_param_spec(params, rules, mesh)
     out = {}
     for name, value in params.items():
         spec = spec_map.get(name, P())
