@@ -50,8 +50,153 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5):
     return steps * batch_size / dt, "examples/sec"
 
 
+def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
+                 lr=1e-3):
+    """Shared harness: jitted value_and_grad+Adam step, timed post-warmup."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+
+    params = model.named_parameters()
+    buffers = model.named_buffers()
+    opt = optimizer.Adam(lr)
+    state = opt.init(params)
+    batch = make_batch(batch_size)
+
+    @jax.jit
+    def step(params, buffers, state, batch):
+        def loss(p):
+            out, new_buf = model.functional_call(
+                p, *batch, buffers=buffers, training=True)
+            return loss_fn(out, batch), new_buf
+
+        (l, new_buf), g = jax.value_and_grad(loss, has_aux=True)(params)
+        params, state = opt.apply(params, g, state)
+        return params, new_buf, state, l
+
+    for _ in range(warmup):
+        params, buffers, state, l = step(params, buffers, state, batch)
+    jax.block_until_ready(l)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, buffers, state, l = step(params, buffers, state, batch)
+    jax.block_until_ready(l)
+    dt = time.perf_counter() - t0
+    return steps * batch_size / dt, "examples/sec"
+
+
+def bench_resnet50(steps: int, batch_size: int, smoke: bool = False):
+    """BASELINE config 2 (image 224 is the headline; smoke uses 64)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+
+    pt.seed(0)
+    size = 64 if smoke else 224
+    batch_size = min(batch_size, 8 if smoke else 128)
+    model = resnet.resnet50(num_classes=1000)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        return (jnp.asarray(rng.normal(size=(bs, 3, size, size))
+                            .astype(np.float32)),)
+
+    def loss_fn(logits, batch):
+        labels = jnp.zeros((logits.shape[0],), jnp.int32)
+        return resnet.loss_fn(logits, labels)
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+
+
+def bench_bert_base(steps: int, batch_size: int):
+    """BASELINE config 3: BERT-base MLM pretrain step, seq 128."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert as B
+
+    pt.seed(0)
+    batch_size = min(batch_size, 32)
+    cfg = B.BertConfig.base()
+    model = B.BertForPretraining(cfg)
+    rng = np.random.default_rng(0)
+    T = 128
+
+    def make_batch(bs):
+        return (jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, T))),)
+
+    def loss_fn(out, batch):
+        from paddle_tpu.ops import loss as L
+
+        mlm_logits, _ = out  # MLM over every position: predict input ids
+        return jnp.mean(L.softmax_with_cross_entropy(mlm_logits, batch[0]))
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+
+
+def bench_transformer_nmt(steps: int, batch_size: int):
+    """BASELINE config 4: Transformer NMT train step, seq 64."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as TR
+
+    pt.seed(0)
+    batch_size = min(batch_size, 64)
+    cfg = TR.NMTConfig.base()
+    model = TR.TransformerNMT(cfg)
+    rng = np.random.default_rng(0)
+    T = 64
+
+    def make_batch(bs):
+        src = jnp.asarray(rng.integers(3, cfg.src_vocab, (bs, T)))
+        tgt = jnp.asarray(rng.integers(3, cfg.tgt_vocab, (bs, T)))
+        return (src, tgt)
+
+    def loss_fn(out, batch):
+        logits = out[0] if isinstance(out, tuple) else out
+        from paddle_tpu.ops import loss as L
+
+        return jnp.mean(L.softmax_with_cross_entropy(logits, batch[1]))
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+
+
+def bench_deepfm(steps: int, batch_size: int):
+    """BASELINE config 5: DeepFM sparse CTR step."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import deepfm as DF
+
+    pt.seed(0)
+    cfg = DF.DeepFMConfig(total_vocab=100_000, num_fields=26, dense_dim=13,
+                          embed_dim=16, embedding_axis=None)
+    model = DF.DeepFM(cfg)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        ids = jnp.asarray(rng.integers(0, cfg.total_vocab,
+                                       (bs, cfg.num_fields)))
+        dense = jnp.asarray(rng.normal(size=(bs, cfg.dense_dim))
+                            .astype(np.float32))
+        return (ids, dense)
+
+    def loss_fn(logits, batch):
+        labels = (batch[0][:, 0] % 2).astype(jnp.float32)
+        return DF.loss_fn(logits, labels)
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+
+
 MODELS = {
     "mnist_mlp": bench_mnist_mlp,
+    "resnet50": bench_resnet50,
+    "bert_base": bench_bert_base,
+    "transformer_nmt": bench_transformer_nmt,
+    "deepfm": bench_deepfm,
 }
 
 
@@ -61,11 +206,24 @@ def main():
     ap.add_argument("--smoke", action="store_true", help="quick run")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) — needed because "
+                    "this environment's sitecustomize overrides JAX_PLATFORMS")
     args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     steps = args.steps or (10 if args.smoke else 100)
     batch = args.batch_size or (256 if args.smoke else 8192)
-    value, unit = MODELS[args.model](steps, batch)
+    import inspect
+
+    fn = MODELS[args.model]
+    kwargs = ({"smoke": args.smoke}
+              if "smoke" in inspect.signature(fn).parameters else {})
+    value, unit = fn(steps, batch, **kwargs)
 
     metric = f"{args.model}_throughput"
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

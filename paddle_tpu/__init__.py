@@ -27,6 +27,7 @@ _LAZY = {
     "initializer": ".initializer",
     "regularizer": ".regularizer",
     "clip": ".clip",
+    "native": ".native",
 }
 
 

@@ -1,11 +1,12 @@
 """Data layer: reader decorators, feeders, datasets, ragged batching."""
 
 from . import dataset
+from .dataset import MultiSlotDataset
 from .feeder import DataFeeder, DeviceLoader
 from .reader import (batch, buffered, cache, chain, compose, firstn,
                      map_readers, shuffle, xmap_readers)
 
 __all__ = [
-    "dataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",
+    "dataset", "MultiSlotDataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",
     "chain", "compose", "firstn", "map_readers", "shuffle", "xmap_readers",
 ]

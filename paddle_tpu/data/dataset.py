@@ -149,3 +149,56 @@ def synthetic_ctr(num_sparse_fields: int = 26, sparse_dim: int = 100000,
             yield dense, sparse.astype(np.int64), label
 
     return reader
+
+
+class MultiSlotDataset:
+    """Dataset-style UX over the native C++ feed (reference:
+    python/paddle/fluid/dataset.py:21 InMemoryDataset/QueueDataset —
+    set_filelist/set_batch_size/set_thread then iterate). Parsing and
+    batching happen in C++ worker threads (paddle_tpu.native)."""
+
+    def __init__(self):
+        self._files = []
+        self._slots = []
+        self._batch_size = 1
+        self._threads = 2
+        self._queue_capacity = 8
+        self._drop_last = True
+
+    def set_filelist(self, files):
+        self._files = list(files)
+        return self
+
+    def set_use_var(self, slots):
+        """slots: [(name, 'u'|'f'), ...] in file order (the reference binds
+        slots to program vars; here names key the yielded dict)."""
+        self._slots = list(slots)
+        return self
+
+    def set_batch_size(self, bs: int):
+        self._batch_size = bs
+        return self
+
+    def set_thread(self, n: int):
+        self._threads = n
+        return self
+
+    def set_queue_capacity(self, n: int):
+        self._queue_capacity = n
+        return self
+
+    def set_drop_last(self, drop: bool):
+        self._drop_last = drop
+        return self
+
+    def __iter__(self):
+        from .. import native
+
+        feed = native.MultiSlotFeed(
+            self._files, self._slots, self._batch_size,
+            num_threads=self._threads, queue_capacity=self._queue_capacity,
+            drop_last=self._drop_last)
+        try:
+            yield from feed
+        finally:
+            feed.close()

@@ -1,6 +1,7 @@
 """Model zoo — the reference's benchmark/book models rebuilt TPU-first
 (reference: benchmark/fluid/models/, tests/book/)."""
 
-from . import bert, mnist, transformer
+from . import bert, deepfm, mnist, resnet, se_resnext, transformer, vgg
 
-__all__ = ["bert", "mnist", "transformer"]
+__all__ = ["bert", "deepfm", "mnist", "resnet", "se_resnext", "transformer",
+           "vgg"]

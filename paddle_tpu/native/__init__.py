@@ -1,0 +1,142 @@
+"""Native (C++) runtime components, bound via ctypes (no pybind in this
+environment). Currently: the multithreaded MultiSlot data feed
+(src/datafeed.cc) — the reference's C++ ingestion role
+(reference: framework/data_feed.h:55, operators/reader/buffered_reader.cc).
+
+The shared library builds on demand with `make` (g++ is part of the
+supported toolchain); import fails soft — ``available()`` reports status
+and the pure-Python pipeline (paddle_tpu.data) is always there.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libptdatafeed.so")
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True, text=True, timeout=300)
+            except Exception as e:  # toolchain missing → soft-fail
+                _build_error = getattr(e, "stderr", str(e)) or str(e)
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.ptdf_create.restype = ctypes.c_void_p
+        lib.ptdf_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.ptdf_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptdf_next.restype = ctypes.c_void_p
+        lib.ptdf_next.argtypes = [ctypes.c_void_p]
+        lib.ptdf_batch_free.argtypes = [ctypes.c_void_p]
+        lib.ptdf_batch_size.restype = ctypes.c_int64
+        lib.ptdf_batch_size.argtypes = [ctypes.c_void_p]
+        lib.ptdf_batch_maxlen.restype = ctypes.c_int64
+        lib.ptdf_batch_maxlen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_batch_ivalues.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.ptdf_batch_ivalues.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_batch_fvalues.restype = ctypes.POINTER(ctypes.c_float)
+        lib.ptdf_batch_fvalues.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_batch_lengths.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.ptdf_batch_lengths.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_error.restype = ctypes.c_int
+        lib.ptdf_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library is (or can be) built and loaded."""
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+class MultiSlotFeed:
+    """Iterate dense padded batches parsed by C++ worker threads.
+
+    ``slots``: [(name, 'u'|'f'), ...] in file order. Yields
+    {name: (values (B, maxlen), lengths (B,))} with int64/float32 values.
+    The training thread never touches file IO or parsing — batches queue
+    up to ``queue_capacity`` deep while the accelerator computes.
+    """
+
+    def __init__(self, files: Sequence[str],
+                 slots: Sequence[Tuple[str, str]], batch_size: int,
+                 num_threads: int = 2, queue_capacity: int = 8,
+                 drop_last: bool = True):
+        from ..core.enforce import enforce
+
+        lib = _load()
+        enforce(lib is not None,
+                "native datafeed unavailable: %s", _build_error)
+        for f in files:
+            enforce(os.path.exists(f), "no such data file: %s", f)
+        self._lib = lib
+        self.slots = list(slots)
+        spec = ",".join(f"{n}:{d}" for n, d in self.slots).encode()
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        self._h = lib.ptdf_create(arr, len(files), spec, batch_size,
+                                  num_threads, queue_capacity,
+                                  1 if drop_last else 0)
+        enforce(self._h is not None, "ptdf_create failed (bad slot spec?)")
+
+    def __iter__(self) -> Iterator[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+        lib = self._lib
+        while True:
+            b = lib.ptdf_next(self._h)
+            if not b:
+                break
+            try:
+                bs = lib.ptdf_batch_size(b)
+                out = {}
+                for i, (name, d) in enumerate(self.slots):
+                    ml = lib.ptdf_batch_maxlen(b, i)
+                    n = int(bs * ml)
+                    if d == "u":
+                        ptr = lib.ptdf_batch_ivalues(b, i)
+                        vals = np.ctypeslib.as_array(ptr, (n,)).copy()
+                    else:
+                        ptr = lib.ptdf_batch_fvalues(b, i)
+                        vals = np.ctypeslib.as_array(ptr, (n,)).copy()
+                    lens = np.ctypeslib.as_array(
+                        lib.ptdf_batch_lengths(b, i), (int(bs),)).copy()
+                    out[name] = (vals.reshape(int(bs), int(ml)), lens)
+                yield out
+            finally:
+                lib.ptdf_batch_free(b)
+        err = ctypes.create_string_buffer(512)
+        if lib.ptdf_error(self._h, err, 512):
+            raise RuntimeError(f"native datafeed: {err.value.decode()}")
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ptdf_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

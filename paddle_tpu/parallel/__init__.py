@@ -9,6 +9,9 @@ from .context_parallel import (context_parallel_attention, ring_attention,
                                ulysses_attention)
 from .collective import (allgather, allreduce, all_to_all, axis_index,
                          broadcast, ppermute, reduce_scatter)
+from .pipeline import GPipe, pipeline_apply, stage_param_sharding
+from .sharded_embedding import (ShardedEmbedding, embedding_ep_rules,
+                                sharded_embedding_lookup)
 from .sharding import (OptStateRules, constraint, infer_param_spec,
                        shard_params, transformer_tp_rules, zero_dp_rules)
 
@@ -16,6 +19,8 @@ __all__ = [
     "DataParallel", "Trainer", "allgather", "allreduce", "all_to_all",
     "axis_index", "broadcast", "context_parallel_attention", "ppermute",
     "reduce_scatter", "ring_attention", "ulysses_attention",
+    "GPipe", "pipeline_apply", "stage_param_sharding",
+    "ShardedEmbedding", "embedding_ep_rules", "sharded_embedding_lookup",
     "OptStateRules", "constraint", "infer_param_spec", "shard_params",
     "transformer_tp_rules", "zero_dp_rules",
 ]

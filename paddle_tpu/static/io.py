@@ -1,0 +1,204 @@
+"""Static-graph save/load — fluid io.py capability surface (reference:
+python/paddle/fluid/io.py: save_persistables:460, load_persistables:693,
+save_inference_model:898, load_inference_model:1074).
+
+TPU-native artifact design (SURVEY.md §7: "a thin Program artifact —
+serialized HLO + metadata — keeps the save/load/C++-serve capability"):
+``save_inference_model`` exports the pruned feed→fetch computation as a
+**StableHLO portable artifact** via ``jax.export`` plus an ``.npz`` of
+persistable vars and a JSON manifest. The artifact is loadable from
+Python (this module) or any PJRT host (the C++ serving loader) — it
+replaces the reference's ``__model__`` ProgramDesc + per-var files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from .executor import Executor, Scope, _exec_opnodes, _exec_program
+from .program import Program, Var, _GradNode, _OpNode
+
+
+def _prune(program: Program, fetch_names: Sequence[str]):
+    """Backward-slice the node list to what `fetch_names` needs — the role
+    of ProgramDesc pruning (reference: framework/prune.cc) before export."""
+    needed = set(fetch_names)
+    keep = [False] * len(program.nodes)
+    for i in range(len(program.nodes) - 1, -1, -1):
+        node = program.nodes[i]
+        if any(o in needed for o in node.outputs):
+            keep[i] = True
+            if isinstance(node, _GradNode):
+                # grads need the whole prefix + its params
+                for j in range(node.prefix_len):
+                    keep[j] = True
+                needed.update(node.param_names)
+                needed.add(node.loss_name)
+            else:
+                needed.update(node.inputs)
+    # second pass: prefix nodes pulled in by a grad node add their inputs
+    for i in range(len(program.nodes) - 1, -1, -1):
+        if keep[i] and isinstance(program.nodes[i], _OpNode):
+            needed.update(program.nodes[i].inputs)
+    return [n for i, n in enumerate(program.nodes) if keep[i]], needed
+
+_MANIFEST = "manifest.json"
+_PARAMS = "params.npz"
+_HLO = "program.stablehlo"
+
+
+def save_persistables(executor: Executor, dirname: str,
+                      main_program: Program) -> None:
+    """reference: io.py save_persistables:460 — all scope-backed vars."""
+    os.makedirs(dirname, exist_ok=True)
+    arrs = {n: np.asarray(executor.scope.get(n))
+            for n in main_program.persistable_names()
+            if executor.scope.has(n)}
+    np.savez(os.path.join(dirname, _PARAMS), **arrs)
+
+
+def load_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None) -> None:
+    """reference: io.py load_persistables:693."""
+    path = os.path.join(dirname, _PARAMS)
+    enforce(os.path.exists(path), "no persistables at %s", dirname)
+    with np.load(path) as data:
+        for n in data.files:
+            executor.scope.set(n, jnp.asarray(data[n]))
+
+
+def save_inference_model(dirname: str, feed_target_names: Sequence[str],
+                         fetch_targets: Sequence[Var], executor: Executor,
+                         main_program: Optional[Program] = None) -> None:
+    """reference: io.py save_inference_model:898 — prune to feed→fetch and
+    export. Params stay *inputs* of the exported module (shipped alongside
+    in the .npz), so the artifact is weight-swappable like the reference's
+    __model__ + separate param files."""
+    from .program import default_main_program
+
+    program = main_program or default_main_program()
+    fetch_names = [f.name if isinstance(f, Var) else f for f in fetch_targets]
+    for n in feed_target_names:
+        enforce(n in program.vars and program.vars[n].is_feed,
+                "feed target %s is not a data() var", n)
+    nodes, needed = _prune(program, fetch_names)
+    enforce(not any(isinstance(n, _GradNode) for n in nodes),
+            "inference export reaches grad ops; fetch forward vars only")
+    missing = [n for n in needed
+               if n in program.vars and program.vars[n].is_feed
+               and n not in feed_target_names]
+    enforce(not missing,
+            "pruned inference graph still needs feeds %s — add them to "
+            "feed_target_names", missing)
+    persist = [n for n in program.persistable_names()
+               if executor.scope.has(n) and n in needed]
+    params = {n: executor.scope.get(n) for n in persist}
+    consts = {k: v for k, v in getattr(program, "_const_values", {}).items()
+              if k in needed}
+
+    def infer_fn(params, feeds):
+        env = dict(consts)
+        env.update(params)
+        env.update(feeds)
+        env = _exec_opnodes(nodes, env)
+        return [env[f] for f in fetch_names]
+
+    # -1 feed dims export as symbolic dimensions so the artifact stays
+    # batch-polymorphic (the reference's ProgramDesc is shape-agnostic;
+    # a fixed-shape StableHLO module would silently lose that capability)
+    n_sym = 0
+    feed_specs, polymorphic = {}, False
+    for n in feed_target_names:
+        v = program.vars[n]
+        if any(d == -1 for d in v.shape):
+            polymorphic = True
+            dims = []
+            for d in v.shape:
+                if d == -1:
+                    dims.append(f"d{n_sym}")
+                    n_sym += 1
+                else:
+                    dims.append(str(d))
+            shape = jax.export.symbolic_shape(",".join(dims))
+        else:
+            shape = tuple(v.shape)
+        feed_specs[n] = jax.ShapeDtypeStruct(shape, v.dtype)
+    param_specs = {n: jax.ShapeDtypeStruct(np.shape(a),
+                                           jnp.asarray(a).dtype)
+                   for n, a in params.items()}
+    try:
+        exported = jax.export.export(jax.jit(infer_fn))(param_specs,
+                                                        feed_specs)
+    except Exception:
+        if not polymorphic:
+            raise
+        # some recorded op doesn't trace symbolically — fall back to a
+        # fixed batch and say so in the manifest rather than pretending
+        polymorphic = False
+        for n in list(feed_specs):
+            v = program.vars[n]
+            shape = tuple(8 if d == -1 else d for d in v.shape)
+            feed_specs[n] = jax.ShapeDtypeStruct(shape, v.dtype)
+        exported = jax.export.export(jax.jit(infer_fn))(param_specs,
+                                                        feed_specs)
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, _HLO), "wb") as f:
+        f.write(exported.serialize())
+    np.savez(os.path.join(dirname, _PARAMS),
+             **{n: np.asarray(a) for n, a in params.items()})
+    with open(os.path.join(dirname, _MANIFEST), "w") as f:
+        json.dump({
+            "feed_target_names": list(feed_target_names),
+            "fetch_target_names": fetch_names,
+            "feed_shapes": {n: list(program.vars[n].shape)
+                            if polymorphic else
+                            list(feed_specs[n].shape)
+                            for n in feed_target_names},
+            "batch_polymorphic": polymorphic,
+            "format": "stablehlo+npz/v1",
+        }, f, indent=1)
+
+
+class InferencePredictor:
+    """Loaded artifact: ``run(feed_dict) -> [outputs]`` — the role of
+    AnalysisPredictor::Run (reference: inference/api/analysis_predictor.h:46)
+    minus the pass pipeline (XLA already optimized the module)."""
+
+    def __init__(self, exported, params: Dict[str, jnp.ndarray],
+                 feed_names: List[str], fetch_names: List[str]):
+        self._exported = exported
+        self._params = params
+        self.feed_target_names = feed_names
+        self.fetch_target_names = fetch_names
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        enforce(set(feeds) == set(self.feed_target_names),
+                "feed keys %s != expected %s", sorted(feeds),
+                sorted(self.feed_target_names))
+        out = self._exported.call(self._params, feeds)
+        return [np.asarray(o) for o in out]
+
+
+def load_inference_model(dirname: str) -> InferencePredictor:
+    """reference: io.py load_inference_model:1074 → (program, feeds,
+    fetches); here: a ready predictor over the StableHLO artifact."""
+    with open(os.path.join(dirname, _MANIFEST)) as f:
+        manifest = json.load(f)
+    enforce(manifest.get("format") == "stablehlo+npz/v1",
+            "unknown inference-model format %s", manifest.get("format"))
+    with open(os.path.join(dirname, _HLO), "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    with np.load(os.path.join(dirname, _PARAMS)) as data:
+        params = {n: jnp.asarray(data[n]) for n in data.files}
+    return InferencePredictor(exported, params,
+                              manifest["feed_target_names"],
+                              manifest["fetch_target_names"])

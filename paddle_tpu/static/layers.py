@@ -1,0 +1,203 @@
+"""Static-graph layer functions — fluid `layers.*` capability surface
+(reference: python/paddle/fluid/layers/nn.py, 184 functions; fc:210) as
+thin recorders over the functional op library: each call creates params on
+the current Program and records one traced op node.
+
+Param creation mirrors LayerHelper (reference: layer_helper.py:29).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializer as I
+from ..ops import loss as OL
+from ..ops import math as OM
+from ..ops import nn as ON
+from .program import Program, Var, default_main_program
+
+
+def _prog(*vars_) -> Program:
+    for v in vars_:
+        if isinstance(v, Var):
+            return v.program
+    return default_main_program()
+
+
+def fc(input: Var, size: int, act: Optional[str] = None,
+       bias_attr: bool = True, name: str = "fc") -> Var:
+    """reference: layers/nn.py fc:210."""
+    prog = _prog(input)
+    d_in = input.shape[-1]
+    w = prog.create_parameter(prog.unique_name(f"{name}_w"), (d_in, size),
+                              initializer=I.XavierUniform())
+    args = [input, w]
+    if bias_attr:
+        b = prog.create_parameter(prog.unique_name(f"{name}_b"), (size,),
+                                  initializer=I.Constant(0.0))
+        args.append(b)
+
+    def fn(x, w, b=None):
+        y = x @ w
+        if b is not None:
+            y = y + b
+        if act is not None:
+            y = getattr(jax.nn, act, getattr(OM, act, None))(y)
+        return y
+
+    return prog.apply(fn, args, name=name)
+
+
+def conv2d(input: Var, num_filters: int, filter_size: int, stride: int = 1,
+           padding: int = 0, groups: int = 1, act: Optional[str] = None,
+           bias_attr: bool = True, name: str = "conv2d") -> Var:
+    prog = _prog(input)
+    c_in = input.shape[1]
+    w = prog.create_parameter(
+        prog.unique_name(f"{name}_w"),
+        (num_filters, c_in // groups, filter_size, filter_size),
+        initializer=I.MSRA(uniform=False))
+    args = [input, w]
+    if bias_attr:
+        b = prog.create_parameter(prog.unique_name(f"{name}_b"),
+                                  (num_filters,), initializer=I.Constant(0.0))
+        args.append(b)
+
+    def fn(x, w, b=None):
+        y = ON.conv2d(x, w, stride, padding, 1, groups)
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        if act is not None:
+            y = getattr(jax.nn, act)(y)
+        return y
+
+    return prog.apply(fn, args, name=name)
+
+
+def embedding(input: Var, size: Sequence[int], padding_idx=None,
+              name: str = "embedding") -> Var:
+    prog = _prog(input)
+    w = prog.create_parameter(prog.unique_name(f"{name}_w"), tuple(size),
+                              initializer=I.XavierNormal())
+    return prog.apply(lambda ids, t: ON.embedding(ids, t, padding_idx),
+                      [input, w], name=name)
+
+
+def _unary(fnname, jfn):
+    def layer(x: Var, name: Optional[str] = None) -> Var:
+        return _prog(x).apply(jfn, [x], name=name or fnname)
+
+    layer.__name__ = fnname
+    return layer
+
+
+relu = _unary("relu", jax.nn.relu)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+softmax = _unary("softmax", lambda x: jax.nn.softmax(x, axis=-1))
+exp = _unary("exp", jnp.exp)
+log = _unary("log", jnp.log)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+
+
+def mean(x: Var, name: str = "mean") -> Var:
+    return _prog(x).apply(jnp.mean, [x], name=name)
+
+
+def reduce_sum(x: Var, dim=None, keep_dim: bool = False) -> Var:
+    return _prog(x).apply(
+        lambda a: jnp.sum(a, axis=dim, keepdims=keep_dim), [x],
+        name="reduce_sum")
+
+
+def reshape(x: Var, shape: Sequence[int]) -> Var:
+    return _prog(x).apply(lambda a: jnp.reshape(a, shape), [x],
+                          name="reshape")
+
+
+def transpose(x: Var, perm: Sequence[int]) -> Var:
+    return _prog(x).apply(lambda a: jnp.transpose(a, perm), [x],
+                          name="transpose")
+
+
+def concat(xs: Sequence[Var], axis: int = 0) -> Var:
+    prog = _prog(*xs)
+    return prog.apply(lambda *a: jnp.concatenate(a, axis=axis), list(xs),
+                      name="concat")
+
+
+def dropout(x: Var, dropout_prob: float = 0.5, seed: int = 0,
+            is_test: bool = False) -> Var:
+    """Static dropout uses a fixed fold-in key per recorded op (the dygraph
+    path owns stateful RNG; reference: operators/dropout_op.cc)."""
+    if is_test or dropout_prob == 0.0:
+        return x
+    prog = _prog(x)
+    opid = prog._name_counter + 1
+    key = jax.random.fold_in(jax.random.key(seed), opid)
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - dropout_prob, a.shape)
+        return jnp.where(keep, a / (1.0 - dropout_prob), 0.0)
+
+    return prog.apply(fn, [x], name="dropout", eval_fn=lambda a: a)
+
+
+def cross_entropy(input: Var, label: Var, soft_label: bool = False) -> Var:
+    return _prog(input).apply(
+        lambda p, l: OL.cross_entropy(p, l, soft_label=soft_label),
+        [input, label], name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits: Var, label: Var) -> Var:
+    return _prog(logits).apply(OL.softmax_with_cross_entropy,
+                               [logits, label],
+                               name="softmax_with_cross_entropy")
+
+
+def accuracy(input: Var, label: Var) -> Var:
+    from ..metrics import accuracy as acc_fn
+
+    return _prog(input).apply(acc_fn, [input, label], name="accuracy")
+
+
+def batch_norm(input: Var, act: Optional[str] = None, is_test: bool = False,
+               momentum: float = 0.9, epsilon: float = 1e-5,
+               name: str = "batch_norm") -> Var:
+    """Static BN: scale/bias trainable; running stats are persistable
+    non-trainable vars updated through the step (mirrors the reference's
+    batch_norm_op in-place MeanOut/VarianceOut)."""
+    prog = _prog(input)
+    c = input.shape[1]
+    scale = prog.create_parameter(prog.unique_name(f"{name}_scale"), (c,),
+                                  initializer=I.Constant(1.0))
+    bias = prog.create_parameter(prog.unique_name(f"{name}_bias"), (c,),
+                                 initializer=I.Constant(0.0))
+    rmean = prog.create_parameter(prog.unique_name(f"{name}_mean"), (c,),
+                                  initializer=I.Constant(0.0),
+                                  trainable=False)
+    rvar = prog.create_parameter(prog.unique_name(f"{name}_var"), (c,),
+                                 initializer=I.Constant(1.0),
+                                 trainable=False)
+
+    def make_fn(training):
+        def fn(x, s, b, m, v):
+            y, nm, nv = ON.batch_norm(x, s, b, m, v, training=training,
+                                      momentum=momentum, epsilon=epsilon)
+            if act is not None:
+                y = getattr(jax.nn, act)(y)
+            return y, nm, nv
+
+        return fn
+
+    y, nm, nv = prog.apply(make_fn(not is_test),
+                           [input, scale, bias, rmean, rvar],
+                           name=name, eval_fn=make_fn(False))
+    prog.assign(rmean, nm)
+    prog.assign(rvar, nv)
+    return y

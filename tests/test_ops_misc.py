@@ -2,6 +2,7 @@
 plus regressions from review findings (FLAGS.set parsing, key_for stability,
 sequence_pool 'last' 2-D, position_encoding odd dims, lazy subpackage access)."""
 
+import os
 import subprocess
 import sys
 
@@ -290,10 +291,15 @@ def test_flags_set_string_bool():
 
 
 def test_key_for_stable_across_processes():
-    code = ("import paddle_tpu as pt, jax, numpy as np; pt.seed(3); "
+    # force the CPU backend in the children: a bare import would try to grab
+    # the real TPU (slow single-client tunnel) and hang the suite
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import paddle_tpu as pt, numpy as np; pt.seed(3); "
             "print(np.asarray(jax.random.key_data(pt.core.random.key_for('dropout'))).tolist())")
     outs = {subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, cwd="/root/repo").stdout.strip()
+                           text=True, cwd="/root/repo", env=env,
+                           timeout=120).stdout.strip()
             for _ in range(2)}
     assert len(outs) == 1 and next(iter(outs)), outs
 

@@ -1,0 +1,221 @@
+"""Static-graph mode: Program/Executor/append_backward/optimizers/IO.
+
+Mirrors the reference's framework unit tests (test_program, test_executor,
+test_optimizer, tests/book/test_fit_a_line.py / test_recognize_digits.py
+full train→save→load→infer cycle).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+
+
+def _mlp_program(with_opt=None):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (-1, 8))
+        label = prog.data("label", (-1,), "int32")
+        h = static.layers.fc(x, 16, act="relu")
+        logits = static.layers.fc(h, 4)
+        loss = static.layers.mean(
+            static.layers.softmax_with_cross_entropy(logits, label))
+        if with_opt is not None:
+            with_opt.minimize(loss)
+    return prog, x, label, logits, loss
+
+
+def _batch(bs=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(bs, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32) + 2 * (x[:, 1] > 0).astype(np.int32)
+    return x, y
+
+
+def test_program_records_ops_and_vars():
+    prog, x, label, logits, loss = _mlp_program()
+    assert loss.name in prog.vars
+    assert len(prog.param_names()) == 4  # 2×(w, b)
+    assert any(n.name == "fc" for n in prog.nodes)
+
+
+def test_executor_forward_fetch():
+    prog, x, label, logits, loss = _mlp_program()
+    exe = static.Executor(scope=static.Scope())
+    xs, ys = _batch()
+    out, l = exe.run(prog, feed={"x": xs, "label": ys},
+                     fetch_list=[logits, loss])
+    assert out.shape == (16, 4)
+    assert np.isfinite(l).all()
+
+
+def test_append_backward_grads_match_numeric():
+    prog, x, label, logits, loss = _mlp_program()
+    with static.program_guard(prog):
+        pairs = static.append_backward(loss)
+    exe = static.Executor(scope=static.Scope())
+    xs, ys = _batch()
+    feed = {"x": xs, "label": ys}
+    grad_names = [g.name for _, g in pairs]
+    fetched = exe.run(prog, feed=feed, fetch_list=[loss.name] + grad_names)
+    l0, grads = fetched[0], fetched[1:]
+    # numeric check on the first weight's [0,0] entry
+    pname = pairs[0][0].name
+    w = np.asarray(exe.scope.get(pname)).copy()
+    eps = 1e-3
+    w_pos = w.copy(); w_pos[0, 0] += eps
+    exe.scope.set(pname, w_pos)
+    lp = exe.run(prog, feed=feed, fetch_list=[loss.name])[0]
+    w_neg = w.copy(); w_neg[0, 0] -= eps
+    exe.scope.set(pname, w_neg)
+    ln = exe.run(prog, feed=feed, fetch_list=[loss.name])[0]
+    numeric = (lp - ln) / (2 * eps)
+    np.testing.assert_allclose(grads[0][0, 0], numeric, atol=1e-2, rtol=1e-2)
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (static.SGD, {"learning_rate": 0.1}),
+    (static.Momentum, {"learning_rate": 0.05, "momentum": 0.9}),
+    (static.Adam, {"learning_rate": 0.01}),
+])
+def test_static_training_loss_decreases(opt_cls, kw):
+    prog, x, label, logits, loss = _mlp_program(with_opt=opt_cls(**kw))
+    exe = static.Executor(scope=static.Scope())
+    xs, ys = _batch(64, seed=3)
+    losses = []
+    for _ in range(25):
+        l, = exe.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8, losses[::6]
+
+
+def test_executor_compile_cache_reused():
+    prog, x, label, logits, loss = _mlp_program(with_opt=static.SGD(0.1))
+    exe = static.Executor(scope=static.Scope())
+    xs, ys = _batch()
+    exe.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+    assert len(exe._cache) == 1
+    exe.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+    assert len(exe._cache) == 1  # same signature → cached executable
+    exe.run(prog, feed={"x": xs[:8], "label": ys[:8]}, fetch_list=[loss])
+    assert len(exe._cache) == 2  # new batch size → recompile (documented)
+
+
+def test_math_op_patch_on_vars():
+    prog = static.Program()
+    with static.program_guard(prog):
+        a = prog.data("a", (4,))
+        b = prog.data("b", (4,))
+        c = (a + b) * a - b / (a + 1.0)
+    exe = static.Executor(scope=static.Scope())
+    av = np.arange(4, dtype=np.float32) + 1
+    bv = np.ones(4, dtype=np.float32)
+    out, = exe.run(prog, feed={"a": av, "b": bv}, fetch_list=[c])
+    np.testing.assert_allclose(out, (av + bv) * av - bv / (av + 1.0),
+                               rtol=1e-6)
+
+
+def test_batch_norm_static_updates_running_stats():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (-1, 3, 8, 8))
+        y = static.layers.batch_norm(x, act="relu")
+        m = static.layers.mean(y)
+    exe = static.Executor(scope=static.Scope())
+    xs = np.random.default_rng(0).normal(size=(4, 3, 8, 8)).astype(np.float32)
+    exe.run(prog, feed={"x": xs}, fetch_list=[m])
+    mean_name = [n for n in prog.persistable_names() if "mean" in n][0]
+    assert not np.allclose(np.asarray(exe.scope.get(mean_name)), 0.0)
+
+
+def test_clone_for_test_batch_norm_inference_mode():
+    # regression: a for_test clone must use running stats and leave them
+    # untouched (the reference's is_test batch_norm semantics)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (-1, 3))
+        y = static.layers.batch_norm(x)
+        d = static.layers.dropout(y, dropout_prob=0.9)
+        m = static.layers.mean(d)
+    exe = static.Executor(scope=static.Scope())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 3)).astype(np.float32)
+    exe.run(prog, feed={"x": xs}, fetch_list=[m])  # one train step
+    mean_name = [n for n in prog.persistable_names() if "mean" in n][0]
+    stats_before = np.asarray(exe.scope.get(mean_name)).copy()
+
+    test_prog = prog.clone(for_test=True)
+    out, = exe.run(test_prog, feed={"x": xs * 5 + 2}, fetch_list=[m])
+    np.testing.assert_allclose(np.asarray(exe.scope.get(mean_name)),
+                               stats_before)  # eval didn't mutate stats
+    # eval dropout is identity: mean(d) == mean(bn(x)) under running stats,
+    # which is NOT ~0 (a 0.9 train-mode dropout would zero most entries
+    # and train-mode BN would center the output at exactly 0)
+    bn_out, = exe.run(test_prog, feed={"x": xs * 5 + 2},
+                      fetch_list=[test_prog.nodes[0].outputs[0]])
+    np.testing.assert_allclose(out, np.mean(bn_out), rtol=1e-5)
+
+
+def test_missing_feed_named_error():
+    from paddle_tpu.core.enforce import EnforceError
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        a = prog.data("a", (4,))
+        b = prog.data("b", (4,))
+        c = a + b
+    exe = static.Executor(scope=static.Scope())
+    with pytest.raises(EnforceError, match="missing feeds.*'b'"):
+        exe.run(prog, feed={"a": np.ones(4, np.float32)}, fetch_list=[c])
+
+
+def test_save_load_inference_model(tmp_path):
+    prog, x, label, logits, loss = _mlp_program(with_opt=static.SGD(0.1))
+    exe = static.Executor(scope=static.Scope())
+    xs, ys = _batch()
+    for _ in range(3):
+        exe.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+
+    d = str(tmp_path / "model")
+    static.save_inference_model(d, ["x"], [logits], exe, prog)
+    # reference semantics: exe.run executes the WHOLE program (including
+    # optimizer updates), so the comparison target comes from a for_test
+    # clone that stops before the backward marker
+    test_prog = prog.clone(for_test=True)
+    want, = exe.run(test_prog, feed={"x": xs[:8], "label": ys[:8]},
+                    fetch_list=[logits])
+    pred = static.load_inference_model(d)
+    assert pred.feed_target_names == ["x"]
+    got, = pred.run({"x": xs[:8]})
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    # regression: a -1 feed dim must export batch-polymorphic — the loaded
+    # artifact serves batch sizes it was never traced at
+    want3, = exe.run(test_prog, feed={"x": xs[:3], "label": ys[:3]},
+                     fetch_list=[logits])
+    got3, = pred.run({"x": xs[:3]})
+    np.testing.assert_allclose(got3, want3, atol=1e-5, rtol=1e-5)
+
+
+def test_save_load_persistables(tmp_path):
+    prog, x, label, logits, loss = _mlp_program(with_opt=static.Adam(0.01))
+    exe = static.Executor(scope=static.Scope())
+    xs, ys = _batch()
+    exe.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+    d = str(tmp_path / "ckpt")
+    static.save_persistables(exe, d, prog)
+
+    exe2 = static.Executor(scope=static.Scope())
+    static.load_persistables(exe2, d)
+    l1, = exe.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+    l2, = exe2.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+    # same state (incl. Adam moments) → identical next step
+    np.testing.assert_allclose(l1, l2, atol=1e-6)
+
+
+def test_feed_validation_errors():
+    prog, x, label, logits, loss = _mlp_program()
+    exe = static.Executor(scope=static.Scope())
+    with pytest.raises(Exception, match="fetch target"):
+        exe.run(prog, feed={}, fetch_list=["nope"])
+    with pytest.raises(Exception, match="feed"):
+        exe.run(prog, feed={"bogus": np.zeros(3)}, fetch_list=[loss])
