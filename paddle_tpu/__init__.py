@@ -28,6 +28,7 @@ _LAZY = {
     "regularizer": ".regularizer",
     "clip": ".clip",
     "native": ".native",
+    "checkpoint": ".checkpoint",
 }
 
 

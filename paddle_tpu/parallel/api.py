@@ -111,6 +111,44 @@ class Trainer:
         DataFeeder(sharding=...) — the feed_and_split analog)."""
         return NamedSharding(self.mesh, P("dp"))
 
+    # --- checkpoint/resume (SURVEY §5.4) ------------------------------------
+
+    def state(self) -> Dict[str, Any]:
+        """Full resumable training state (params + buffers + optimizer
+        moments + RNG) — what the reference persists via save_persistables
+        (params + optimizer accumulators, reference: io.py:460)."""
+        return {"params": self.params, "buffers": self.buffers,
+                "opt_state": self.opt_state,
+                "rng": jax.random.key_data(self._rng)}
+
+    def save_checkpoint(self, manager_or_dir, step: Optional[int] = None):
+        from ..checkpoint import CheckpointManager, save_state
+
+        if isinstance(manager_or_dir, CheckpointManager):
+            enforce(step is not None,
+                    "save_checkpoint(manager) needs a step number")
+            manager_or_dir.save(step, self.state())
+        else:
+            save_state(manager_or_dir, self.state())
+
+    def restore_checkpoint(self, manager_or_dir,
+                           step: Optional[int] = None) -> None:
+        """Restore in place, resharding saved leaves onto this trainer's
+        mesh (works across mesh shapes — the survey's upgrade over the
+        reference's shape-must-match load)."""
+        from ..checkpoint import CheckpointManager, restore_state
+
+        if isinstance(manager_or_dir, CheckpointManager):
+            st = manager_or_dir.restore(step, mesh=self.mesh,
+                                        target=self.state())
+        else:
+            st = restore_state(manager_or_dir, mesh=self.mesh,
+                               target=self.state())
+        self.params = st["params"]
+        self.buffers = st["buffers"]
+        self.opt_state = st["opt_state"]
+        self._rng = jax.random.wrap_key_data(jnp.asarray(st["rng"]))
+
     @classmethod
     def supervised(cls, model: Layer, optimizer: Optimizer,
                    loss_fn: Callable, metrics_fn: Optional[Callable] = None,

@@ -1,0 +1,194 @@
+"""Checkpoint/resume (SURVEY §5.4): round-trip, resharding restore across
+mesh shapes, async writes, retention GC, trainer resume continuity."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.checkpoint import (CheckpointManager, restore_state,
+                                   save_state)
+
+
+def _tree():
+    return {
+        "params": {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+                   "b": jnp.ones(4, jnp.bfloat16)},
+        "opt": {"step": jnp.asarray(7, jnp.int32),
+                "leaf": [{"m": jnp.zeros((8, 4))}, {}]},
+        "rng": None,
+    }
+
+
+def _assert_tree_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_round_trip_plain(tmp_path):
+    d = str(tmp_path / "ckpt")
+    tree = _tree()
+    save_state(d, tree)
+    got = restore_state(d)
+    _assert_tree_equal(tree, got)
+    # structure (dict keys, list/tuple kinds, None) survives
+    assert got["rng"] is None
+    assert isinstance(got["opt"]["leaf"], list)
+    assert got["params"]["b"].dtype == jnp.bfloat16
+
+
+def test_restore_reshards_onto_other_mesh(tmp_path):
+    d = str(tmp_path / "ckpt")
+    dp_mesh = pt.build_mesh(dp=8, devices=jax.devices()[:8])
+    w = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                       NamedSharding(dp_mesh, P("dp", None)))
+    save_state(d, {"w": w})
+
+    # restore onto a 4-device tp mesh: saved 'dp' axis doesn't exist there →
+    # replicated, values identical (the resharding-fallback contract)
+    tp_mesh = pt.build_mesh(tp=4, devices=jax.devices()[:4])
+    got = restore_state(d, mesh=tp_mesh)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(w))
+    assert got["w"].sharding.is_fully_replicated
+
+    # same-axes mesh of a different size: saved spec re-applies
+    dp4 = pt.build_mesh(dp=4, devices=jax.devices()[:4])
+    got4 = restore_state(d, mesh=dp4)
+    np.testing.assert_array_equal(np.asarray(got4["w"]), np.asarray(w))
+    assert not got4["w"].sharding.is_fully_replicated
+
+    # explicit shardings override the saved spec
+    over = restore_state(d, mesh=dp4, shardings={"w": P(None, "dp")})
+    np.testing.assert_array_equal(np.asarray(over["w"]), np.asarray(w))
+    assert not over["w"].sharding.is_fully_replicated
+
+
+def test_async_save_and_wait(tmp_path):
+    d = str(tmp_path / "mgr")
+    mgr = CheckpointManager(d, max_to_keep=2, async_save=True)
+    for s in (1, 2, 3):
+        mgr.save(s, {"x": jnp.full((4,), s, jnp.float32)})
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [2, 3]  # GC kept the newest two
+    assert mgr.latest_step() == 3
+    got = mgr.restore()
+    np.testing.assert_array_equal(np.asarray(got["x"]), np.full(4, 3.0))
+    got2 = mgr.restore(2)
+    np.testing.assert_array_equal(np.asarray(got2["x"]), np.full(4, 2.0))
+
+
+def test_target_shape_mismatch_raises(tmp_path):
+    from paddle_tpu.core.enforce import EnforceError
+
+    d = str(tmp_path / "ckpt")
+    save_state(d, {"w": jnp.zeros((4, 4))})
+    with pytest.raises(EnforceError, match="shape"):
+        restore_state(d, target={"w": jnp.zeros((2, 2))})
+    with pytest.raises(EnforceError, match="dtype"):
+        restore_state(d, target={"w": jnp.zeros((4, 4), jnp.bfloat16)})
+
+
+def test_async_write_failure_surfaces(tmp_path):
+    # regression: a failed background write must raise at join time, not
+    # silently report success
+    target = tmp_path / "blocked"
+    target.write_text("a file where the checkpoint dir must go")
+    handle = save_state(str(target / "sub"), {"x": jnp.zeros(2)},
+                        async_save=True)
+    with pytest.raises(Exception):
+        handle.join()
+
+
+def test_manager_async_failure_raises_on_wait(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "mgr"), async_save=True)
+    blocked = tmp_path / "mgr" / "step_5"
+    blocked.write_text("collides with the step dir")
+    mgr.save(5, {"x": jnp.zeros(2)})
+    with pytest.raises(Exception):
+        mgr.wait_until_finished()
+
+
+def test_custom_pytree_node_rejected(tmp_path):
+    from paddle_tpu.core.enforce import EnforceError
+
+    @jax.tree_util.register_pytree_node_class
+    class Box:
+        def __init__(self, a, b):
+            self.a, self.b = a, b
+
+        def tree_flatten(self):
+            return (self.a, self.b), None
+
+        @classmethod
+        def tree_unflatten(cls, aux, children):
+            return cls(*children)
+
+    with pytest.raises(EnforceError, match="custom pytree"):
+        save_state(str(tmp_path / "c"), {"box": Box(jnp.zeros(2),
+                                                    jnp.ones(2))})
+
+
+def test_trainer_save_restore_resumes_identically(tmp_path):
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.models import mnist as M
+
+    pt.seed(0)
+    mesh = pt.build_mesh(dp=8, devices=jax.devices()[:8])
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(16, 784)).astype(np.float32),
+             "label": rng.integers(0, 10, 16)}
+
+    def make():
+        pt.seed(0)
+        m = M.MnistMLP(hidden1=32, hidden2=16)
+        return parallel.Trainer.supervised(m, optimizer.Adam(1e-3),
+                                           M.loss_fn, mesh=mesh)
+
+    tr = make()
+    for _ in range(3):
+        tr.train_step(batch)
+    d = str(tmp_path / "resume")
+    tr.save_checkpoint(d)
+    want_losses = [float(tr.train_step(batch)[0]) for _ in range(3)]
+
+    tr2 = make()
+    tr2.restore_checkpoint(d)
+    got_losses = [float(tr2.train_step(batch)[0]) for _ in range(3)]
+    np.testing.assert_allclose(got_losses, want_losses, rtol=1e-5)
+
+
+def test_trainer_manager_integration(tmp_path):
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.models import mnist as M
+
+    pt.seed(0)
+    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+    m = M.MnistMLP(hidden1=16, hidden2=8)
+    tr = parallel.Trainer.supervised(m, optimizer.SGD(0.1), M.loss_fn,
+                                     mesh=mesh)
+    mgr = CheckpointManager(str(tmp_path / "mgr"), max_to_keep=3)
+    tr.save_checkpoint(mgr, step=0)
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [0]
+    tr.restore_checkpoint(mgr)  # latest
+
+
+def test_layer_save_load_convenience(tmp_path):
+    from paddle_tpu import checkpoint as C
+    from paddle_tpu.models import mnist as M
+
+    pt.seed(0)
+    m = M.MnistMLP(hidden1=16, hidden2=8)
+    p = str(tmp_path / "layer")
+    C.save(m, p)
+    pt.seed(1)
+    m2 = M.MnistMLP(hidden1=16, hidden2=8)
+    m2.load_state_dict(C.load(p))
+    _assert_tree_equal(m.state_dict(), m2.state_dict())
