@@ -5,7 +5,14 @@ come from JAX VJP (replacing GradOpDescMaker); hand-written kernels live in
 ``paddle_tpu.ops.pallas``.
 """
 
-from . import control_flow, loss, math, nn, reduction, sequence, tensor
+from . import (control_flow, detection, loss, math, nn, reduction, sequence,
+               tensor)
+from .detection import (anchor_generator, bipartite_match, box_clip,
+                        box_coder, collect_fpn_proposals, density_prior_box,
+                        distribute_fpn_proposals, generate_proposals,
+                        iou_similarity, matrix_nms, multiclass_nms, nms,
+                        polygon_box_transform, prior_box, roi_align, roi_pool,
+                        target_assign, yolo_box)
 from .control_flow import (TensorArray, case, cond, equal, fori_loop,
                            greater_equal, greater_than, less_equal, less_than,
                            logical_and, logical_not, logical_or, logical_xor,

@@ -1,0 +1,272 @@
+"""Detection suite vs naive numpy references (OpTest pattern, SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import detection as D
+
+
+def _rand_boxes(rng, n, lo=0, hi=100):
+    xy1 = rng.uniform(lo, hi - 10, size=(n, 2))
+    wh = rng.uniform(1, 10, size=(n, 2))
+    return np.concatenate([xy1, xy1 + wh], axis=1).astype(np.float32)
+
+
+def _np_iou(a, b):
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i, p in enumerate(a):
+        for j, q in enumerate(b):
+            ix1, iy1 = max(p[0], q[0]), max(p[1], q[1])
+            ix2, iy2 = min(p[2], q[2]), min(p[3], q[3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ua = (p[2] - p[0]) * (p[3] - p[1]) + \
+                (q[2] - q[0]) * (q[3] - q[1]) - inter
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def test_iou_similarity_matches_naive():
+    rng = np.random.default_rng(0)
+    a, b = _rand_boxes(rng, 7), _rand_boxes(rng, 5)
+    got = np.asarray(D.iou_similarity(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, _np_iou(a, b), atol=1e-5)
+
+
+def test_box_coder_encode_decode_round_trip():
+    rng = np.random.default_rng(1)
+    priors = jnp.asarray(_rand_boxes(rng, 6))
+    gt = jnp.asarray(_rand_boxes(rng, 4))
+    var = jnp.asarray([0.1, 0.1, 0.2, 0.2])
+    deltas = D.box_coder(priors, var, gt)           # (4, 6, 4)
+    back = D.box_coder(priors, var, deltas, code_type="decode_center_size")
+    want = jnp.broadcast_to(gt[:, None, :], back.shape)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(want),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_box_clip():
+    boxes = jnp.asarray([[-5.0, -5.0, 120.0, 90.0], [10, 10, 20, 20]])
+    out = np.asarray(D.box_clip(boxes, (80, 100)))  # h=80, w=100
+    np.testing.assert_allclose(out[0], [0, 0, 99, 79])
+    np.testing.assert_allclose(out[1], [10, 10, 20, 20])
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                         [0, 0, 10.5, 10.5]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.95])
+    idx, ok = D.nms(boxes, scores, iou_threshold=0.5, max_out=4)
+    kept = set(np.asarray(idx)[np.asarray(ok)].tolist())
+    assert kept == {3, 2}  # 3 beats 0 and 1; 2 is disjoint
+
+
+def test_nms_under_jit_static_shapes():
+    f = jax.jit(lambda b, s: D.nms(b, s, iou_threshold=0.5, max_out=8))
+    rng = np.random.default_rng(2)
+    boxes = jnp.asarray(_rand_boxes(rng, 20))
+    idx, ok = f(boxes, jnp.asarray(rng.uniform(size=20).astype(np.float32)))
+    assert idx.shape == (8,) and ok.shape == (8,)
+
+
+def test_multiclass_nms_output_contract():
+    rng = np.random.default_rng(3)
+    boxes = jnp.asarray(_rand_boxes(rng, 30))
+    scores = jnp.asarray(rng.uniform(size=(4, 30)).astype(np.float32))
+    out, valid = D.multiclass_nms(boxes, scores, keep_top_k=10,
+                                  background_label=0)
+    assert out.shape == (10, 6)
+    labels = np.asarray(out[:, 0])[np.asarray(valid)]
+    assert (labels != 0).all()  # background filtered
+    s = np.asarray(out[:, 1])[np.asarray(valid)]
+    assert (np.diff(s) <= 1e-6).all()  # sorted desc
+
+
+def test_matrix_nms_decays_overlapping():
+    boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10, 10], [40, 40, 50, 50]],
+                        jnp.float32)
+    scores = jnp.asarray([[0.9, 0.8, 0.7]])
+    out, valid = D.matrix_nms(boxes, scores, keep_top_k=3)
+    got = np.asarray(out)[np.asarray(valid)]
+    # the duplicate box's score decays to ~0 and drops below the score
+    # threshold; winner + disjoint box survive untouched
+    assert len(got) == 2
+    assert got[0][1] == pytest.approx(0.9, abs=1e-5)
+    assert got[1][1] == pytest.approx(0.7, abs=1e-5)
+
+
+def test_matrix_nms_partial_overlap_decay():
+    # regression: decay must apply at IoU < 1 too (compensation indexed by
+    # the suppressing row, not the decayed column)
+    boxes = jnp.asarray([[0, 0, 10, 10], [0, 5, 10, 15]], jnp.float32)
+    scores = jnp.asarray([[0.9, 0.8]])
+    out, valid = D.matrix_nms(boxes, scores, keep_top_k=2)
+    got = np.asarray(out)[np.asarray(valid)]
+    # iou = 1/3: linear decay (1-1/3)/(1-0) = 2/3 -> 0.8 * 2/3
+    assert got[0][1] == pytest.approx(0.9, abs=1e-5)
+    assert got[1][1] == pytest.approx(0.8 * (2 / 3), abs=1e-4)
+
+
+def test_yolo_box_score_box_alignment():
+    # regression: scores[b, i] must describe boxes[b, i] — put a single
+    # confident cell at (h=1, w=0) and check the flat index lines up
+    B, A, C, H, W = 1, 2, 3, 2, 2
+    x = np.full((B, A * (5 + C), H, W), -20.0, np.float32)
+    a, h, w, c = 1, 1, 0, 2
+    base = a * (5 + C)
+    x[0, base:base + 4, h, w] = 0.0  # centered box, anchor-sized
+    x[0, base + 4, h, w] = 10.0      # objectness for anchor 1 at (1, 0)
+    x[0, base + 5 + c, h, w] = 10.0  # class 2 logit
+    img = jnp.asarray([[64, 64]], jnp.int32)
+    boxes, scores = D.yolo_box(jnp.asarray(x), img, anchors=[8, 8, 16, 16],
+                               class_num=C, conf_thresh=0.5,
+                               downsample_ratio=32)
+    s = np.array(scores[0])          # writable copy
+    flat = (h * W + w) * A + a       # (h, w, a) flattening
+    assert s[flat, c] > 0.9
+    s[flat, c] = 0.0
+    assert np.all(s < 1e-3)          # everything else suppressed
+    b = np.asarray(boxes[0, flat])   # 32px cells: cell (1,0) -> (16, 48)
+    assert (b[0] + b[2]) / 2 == pytest.approx(16.0, abs=1e-3)
+    assert (b[1] + b[3]) / 2 == pytest.approx(48.0, abs=1e-3)
+
+
+def test_roi_align_out_of_bounds_contributes_zero():
+    # regression: border rois must not edge-extend the map
+    x = jnp.full((1, 8, 8), 4.0)
+    rois = jnp.asarray([[-8.0, -8.0, 8.0, 8.0]], jnp.float32)
+    out = np.asarray(D.roi_align(x, rois, output_size=(2, 2)))
+    assert out[0, 0, 0, 0] == pytest.approx(0.0)   # fully outside bin
+    assert out[0, 0, 1, 1] == pytest.approx(4.0)   # fully inside bin
+
+
+def test_roi_align_uniform_feature_is_identity():
+    # constant feature map -> every roi pools to the constant
+    x = jnp.full((3, 16, 16), 2.5)
+    rois = jnp.asarray([[0, 0, 8, 8], [2, 2, 14, 10]], jnp.float32)
+    out = D.roi_align(x, rois, output_size=(4, 4))
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(np.asarray(out), 2.5, rtol=1e-6)
+
+
+def test_roi_align_linear_gradient_field():
+    # f(x, y) = x: pooled value of a bin ~ its center x coordinate
+    H = W = 32
+    x = jnp.broadcast_to(jnp.arange(W, dtype=jnp.float32), (1, H, W))
+    rois = jnp.asarray([[4, 4, 12, 12]], jnp.float32)
+    out = np.asarray(D.roi_align(x, rois, output_size=(2, 2),
+                                 sampling_ratio=2))
+    # bins centered at x=6 and x=10
+    np.testing.assert_allclose(out[0, 0, :, 0], 6.0, atol=0.6)
+    np.testing.assert_allclose(out[0, 0, :, 1], 10.0, atol=0.6)
+
+
+def test_roi_pool_takes_max():
+    x = jnp.zeros((1, 16, 16)).at[0, 5, 5].set(9.0)
+    rois = jnp.asarray([[0, 0, 15, 15]], jnp.float32)
+    out = np.asarray(D.roi_pool(x, rois, output_size=(2, 2)))
+    assert out.max() == pytest.approx(9.0)
+    assert out[0, 0, 0, 0] == pytest.approx(9.0)  # peak in top-left bin
+
+
+def test_prior_box_shapes_and_range():
+    boxes, var = D.prior_box((4, 4), (64, 64), min_sizes=[16.0],
+                             max_sizes=[32.0], aspect_ratios=[2.0],
+                             flip=True, clip=True)
+    assert boxes.shape[-1] == 4 and boxes.shape[:2] == (4, 4)
+    assert boxes.shape == var.shape
+    b = np.asarray(boxes)
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    # aspect 1 + ar 2 + flipped 0.5 + max-size extra = 4 anchors
+    assert boxes.shape[2] == 4
+
+
+def test_density_prior_box_count():
+    boxes, _ = D.density_prior_box((2, 2), (32, 32), fixed_sizes=[8.0],
+                                   fixed_ratios=[1.0], densities=[2])
+    assert boxes.shape == (2, 2, 4, 4)  # density^2 anchors
+
+
+def test_anchor_generator_centered():
+    anchors, _ = D.anchor_generator((2, 2), anchor_sizes=[32.0],
+                                    aspect_ratios=[1.0], stride=(16.0, 16.0))
+    a = np.asarray(anchors[0, 0, 0])
+    cx, cy = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+    assert cx == pytest.approx(8.0) and cy == pytest.approx(8.0)
+    assert a[2] - a[0] == pytest.approx(32.0)
+
+
+def test_yolo_box_decodes_center_cell():
+    B, A, C, H, W = 1, 1, 2, 2, 2
+    x = np.zeros((B, A * (5 + C), H, W), np.float32)
+    x[0, 4] = 10.0  # high objectness everywhere
+    x[0, 5] = 3.0   # class 0 logit
+    img = jnp.asarray([[64, 64]], jnp.int32)
+    boxes, scores = D.yolo_box(jnp.asarray(x), img, anchors=[16, 16],
+                               class_num=C, conf_thresh=0.5,
+                               downsample_ratio=32)
+    assert boxes.shape == (1, H * W * A, 4)
+    assert scores.shape == (1, H * W * A, C)
+    b = np.asarray(boxes[0, 0])  # cell (0,0): center at (0.5+0)/2 * 64 = 16
+    assert (b[0] + b[2]) / 2 == pytest.approx(16.0, abs=1e-3)
+    got_w = b[2] - b[0]  # anchor 16 over input 64 -> 16 px
+    assert got_w == pytest.approx(16.0, rel=1e-3)
+
+
+def test_generate_proposals_contract():
+    rng = np.random.default_rng(5)
+    A = 40
+    anchors = jnp.asarray(_rand_boxes(rng, A))
+    scores = jnp.asarray(rng.uniform(size=A).astype(np.float32))
+    deltas = jnp.asarray(rng.normal(scale=0.1, size=(A, 4)).astype(np.float32))
+    var = jnp.ones((A, 4), jnp.float32)
+    props, ok = D.generate_proposals(scores, deltas, anchors, var,
+                                     im_shape=(100, 100),
+                                     pre_nms_top_n=20, post_nms_top_n=8,
+                                     nms_thresh=0.7)
+    assert props.shape == (8, 4)
+    p = np.asarray(props)[np.asarray(ok)]
+    assert (p[:, 0] >= 0).all() and (p[:, 2] <= 99).all()
+
+
+def test_bipartite_match_greedy():
+    sim = jnp.asarray([[0.9, 0.1, 0.0], [0.8, 0.85, 0.2]])
+    match, dist = D.bipartite_match(sim)
+    m = np.asarray(match)
+    assert m[0] == 0 and m[1] == 1  # greedy: (0,0)=0.9 first, then (1,1)
+    assert m[2] == -1
+    np.testing.assert_allclose(np.asarray(dist)[:2], [0.9, 0.85], atol=1e-6)
+
+
+def test_target_assign():
+    gt = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    match = jnp.asarray([1, -1, 0], jnp.int32)
+    out, w = D.target_assign(gt, match, mismatch_value=-1.0)
+    np.testing.assert_allclose(np.asarray(out),
+                               [[3, 4], [-1, -1], [1, 2]])
+    np.testing.assert_allclose(np.asarray(w), [1, 0, 1])
+
+
+def test_distribute_collect_fpn():
+    # scales 20 / 300 / 450 -> floor(log2(s/224)) + 4 = 2 (clipped), 4, 5
+    rois = jnp.asarray([[0, 0, 20, 20], [0, 0, 300, 300], [0, 0, 450, 450]],
+                       jnp.float32)
+    masks, lvl = D.distribute_fpn_proposals(rois)
+    l = np.asarray(lvl)
+    assert l[0] == 2 and l[1] == 4 and l[2] == 5
+    assert np.asarray(masks).sum() == 3
+    out_rois, out_scores = D.collect_fpn_proposals(
+        [rois, rois * 2], [jnp.asarray([0.1, 0.9, 0.5]),
+                           jnp.asarray([0.8, 0.2, 0.3])], post_nms_top_n=2)
+    assert out_rois.shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(out_scores), [0.9, 0.8])
+
+
+def test_polygon_box_transform():
+    x = jnp.ones((1, 8, 2, 2), jnp.float32)
+    out = np.asarray(D.polygon_box_transform(x))
+    # channel 0 (x-coord): 4*gx - 1
+    np.testing.assert_allclose(out[0, 0], [[-1, 3], [-1, 3]])
+    # channel 1 (y-coord): 4*gy - 1
+    np.testing.assert_allclose(out[0, 1], [[-1, -1], [3, 3]])
