@@ -110,6 +110,44 @@ class TransformerNMT(nn.Layer):
                                       jnp.arange(max_len))
         return tokens[:, 1:]
 
+    def beam_decode(self, src_ids, max_len: int = 64, beam_size: int = 4,
+                    length_penalty: float = 0.6):
+        """Beam-search decode, one source sentence batch at a time via vmap
+        (reference capability: contrib/decoder/beam_search_decoder.py +
+        beam_search op; here ops.beam_search's scan + pointer backtrack).
+
+        Returns (B, beam_size, max_len) sequences best-first + scores.
+        """
+        from ..ops import decode as DCD
+
+        cfg = self.cfg
+
+        def one(src_row):
+            memory, src_pad = self.encode(src_row[None])
+            mem_k = jnp.repeat(memory, beam_size, axis=0)
+            pad_k = jnp.repeat(src_pad, beam_size, axis=0)
+
+            def step_fn(state, tok):
+                tokens, t = state["tokens"], state["t"]
+                tokens = tokens.at[:, t[0]].set(tok)
+                h = self.decoder(self.pos_enc(self.tgt_emb(tokens)), mem_k,
+                                 cross_mask=pad_k[:, None, None, :],
+                                 causal=True)
+                h_t = jax.lax.dynamic_index_in_dim(h, t[0], axis=1,
+                                                   keepdims=False)
+                logp = jax.nn.log_softmax(self.generator(h_t), -1)
+                return logp, {"tokens": tokens, "t": t + 1}
+
+            init = {"tokens": jnp.full((beam_size, max_len + 1), cfg.pad_id,
+                                       jnp.int32),
+                    "t": jnp.zeros((beam_size,), jnp.int32)}
+            return DCD.beam_search(init, step_fn, beam_size=beam_size,
+                                   max_len=max_len, bos_id=cfg.bos_id,
+                                   end_id=cfg.eos_id,
+                                   length_penalty=length_penalty)
+
+        return jax.vmap(one)(src_ids)
+
 
 def nmt_loss(logits, labels, pad_id: int = 2, label_smooth: float = 0.1):
     """Label-smoothed CE over non-pad positions (reference:

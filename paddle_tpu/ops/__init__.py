@@ -5,8 +5,11 @@ come from JAX VJP (replacing GradOpDescMaker); hand-written kernels live in
 ``paddle_tpu.ops.pallas``.
 """
 
-from . import (control_flow, detection, loss, math, nn, reduction, sequence,
-               tensor)
+from . import (control_flow, decode, detection, loss, math, nn, reduction,
+               sequence, tensor)
+from .decode import (beam_search, beam_search_step, crf_decoding, ctc_align,
+                     ctc_greedy_decode, ctc_loss, edit_distance,
+                     linear_chain_crf)
 from .detection import (anchor_generator, bipartite_match, box_clip,
                         box_coder, collect_fpn_proposals, density_prior_box,
                         distribute_fpn_proposals, generate_proposals,

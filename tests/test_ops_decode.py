@@ -1,0 +1,251 @@
+"""CTC / beam search / CRF / edit distance vs brute-force references."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import decode as DC
+
+
+# --- CTC -------------------------------------------------------------------
+
+def _brute_ctc_nll(log_probs, labels, blank=0):
+    """Sum over all alignments whose collapse equals `labels` (tiny T/V)."""
+    T, V = log_probs.shape
+    total = -np.inf
+    for path in itertools.product(range(V), repeat=T):
+        # collapse: remove repeats then blanks
+        out = []
+        prev = -1
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        if out == list(labels):
+            lp = sum(log_probs[t, s] for t, s in enumerate(path))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def test_ctc_loss_matches_brute_force():
+    rng = np.random.default_rng(0)
+    T, V = 5, 3
+    logits = rng.normal(size=(T, V)).astype(np.float32)
+    lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    for labels in ([1], [1, 2], [2, 2], [1, 2, 1]):
+        L = len(labels)
+        got = DC.ctc_loss(lp[None], jnp.asarray([labels + [0] * (4 - L)]),
+                          jnp.asarray([T]), jnp.asarray([L]))
+        want = _brute_ctc_nll(np.asarray(lp), labels)
+        np.testing.assert_allclose(float(got[0]), want, rtol=1e-4,
+                                   err_msg=str(labels))
+
+
+def test_ctc_loss_batched_and_differentiable():
+    rng = np.random.default_rng(1)
+    B, T, V, L = 3, 8, 5, 3
+    lp = jax.nn.log_softmax(
+        jnp.asarray(rng.normal(size=(B, T, V)).astype(np.float32)), axis=-1)
+    labels = jnp.asarray(rng.integers(1, V, size=(B, L)))
+    il = jnp.asarray([8, 6, 5])
+    ll = jnp.asarray([3, 2, 1])
+    loss = DC.ctc_loss(lp, labels, il, ll)
+    assert loss.shape == (B,) and np.isfinite(np.asarray(loss)).all()
+    g = jax.grad(lambda x: DC.ctc_loss(
+        jax.nn.log_softmax(x, -1), labels, il, ll).sum())(
+            jnp.asarray(rng.normal(size=(B, T, V)).astype(np.float32)))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_ctc_align_collapses():
+    ids = jnp.asarray([[0, 1, 1, 0, 2, 2, 0, 3]])
+    out, n = DC.ctc_align(ids, jnp.asarray([8]))
+    assert int(n[0]) == 3
+    np.testing.assert_array_equal(np.asarray(out[0, :3]), [1, 2, 3])
+    assert np.all(np.asarray(out[0, 3:]) == 0)
+    # length mask: trailing symbols beyond `lengths` ignored
+    out2, n2 = DC.ctc_align(ids, jnp.asarray([5]))
+    assert int(n2[0]) == 2
+    np.testing.assert_array_equal(np.asarray(out2[0, :2]), [1, 2])
+
+
+def test_ctc_greedy_decode():
+    lp = jnp.log(jnp.asarray([[[0.1, 0.8, 0.1], [0.1, 0.8, 0.1],
+                               [0.8, 0.1, 0.1], [0.1, 0.1, 0.8]]]))
+    out, n = DC.ctc_greedy_decode(lp, jnp.asarray([4]))
+    assert int(n[0]) == 2
+    np.testing.assert_array_equal(np.asarray(out[0, :2]), [1, 2])
+
+
+# --- beam search -----------------------------------------------------------
+
+def test_beam_search_finds_argmax_sequence():
+    # fixed per-step distribution independent of state: best beam must be
+    # the per-step argmax sequence
+    V, K, T = 6, 3, 4
+    rng = np.random.default_rng(2)
+    tables = jax.nn.log_softmax(
+        jnp.asarray(rng.normal(size=(T, V)).astype(np.float32)), -1)
+    # end_id made unlikely so length is full
+    tables = tables.at[:, 5].add(-100.0)
+
+    def step_fn(state, tok):
+        t = state["t"]  # (K,) per-beam step counters
+        logp = tables[t]  # (K, V) gather
+        return logp, {"t": t + 1}
+
+    seqs, scores = DC.beam_search(
+        {"t": jnp.zeros((K,), jnp.int32)}, step_fn, beam_size=K, max_len=T,
+        bos_id=0, end_id=5)
+    want = np.asarray(jnp.argmax(tables, axis=1))
+    np.testing.assert_array_equal(np.asarray(seqs[0]), want)
+    want_score = float(jnp.max(tables, axis=1).sum())
+    assert float(scores[0]) == pytest.approx(want_score, rel=1e-5)
+    # beams are distinct and sorted by score
+    assert len({tuple(np.asarray(s)) for s in seqs}) == K
+    s = np.asarray(scores)
+    assert (np.diff(s) <= 1e-6).all()
+
+
+def test_beam_search_stops_at_end_id():
+    V, K = 4, 2
+    # end token (id 3) dominates from step 2 on
+    def step_fn(state, tok):
+        t = state["t"]  # (K,)
+        logp = jnp.where(t[:, None] >= 1,
+                         jnp.log(jnp.asarray([0.01, 0.01, 0.01, 0.97]))[None],
+                         jnp.log(jnp.asarray([0.05, 0.9, 0.03, 0.02]))[None])
+        return logp, {"t": t + 1}
+
+    seqs, scores = DC.beam_search({"t": jnp.zeros((K,), jnp.int32)}, step_fn,
+                                  beam_size=K, max_len=5, bos_id=0, end_id=3)
+    top = np.asarray(seqs[0])
+    assert top[0] == 1 and top[1] == 3
+    assert (top[2:] == 3).all()  # finished beam only extends with end_id
+    # score froze at finish (no accumulation past end)
+    want = np.log(0.9) + np.log(0.97)
+    assert float(scores[0]) == pytest.approx(want, rel=1e-4)
+
+
+def test_beam_search_state_reorders_with_parents():
+    # state carries the token consumed at the PREVIOUS call (two back from
+    # the next selection); penalizing both it and the current input token
+    # forbids any repeat within distance 2 — which only holds if state rows
+    # follow their beam through the parent gather
+    V, K = 5, 3
+
+    def step_fn(state, tok):
+        base = jnp.log(jnp.asarray([0.04, 0.11, 0.2, 0.3, 0.35]))
+        logp = jnp.broadcast_to(base, (K, V))
+        penalty = (jax.nn.one_hot(tok, V) +
+                   jax.nn.one_hot(state["prev"], V)) * 30.0
+        return logp - penalty, {"prev": tok}
+
+    seqs, _ = DC.beam_search(
+        {"prev": jnp.zeros((K,), jnp.int32)}, step_fn, beam_size=K,
+        max_len=6, bos_id=0, end_id=0)
+    for s in np.asarray(seqs):
+        assert all(s[i] != s[i + 1] for i in range(5)), s
+        assert all(s[i] != s[i + 2] for i in range(4)), s
+
+
+# --- CRF -------------------------------------------------------------------
+
+def _brute_crf(em, tr, start, stop, labels):
+    T, N = em.shape
+    def score(path):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, T):
+            s += tr[path[t - 1], path[t]] + em[t, path[t]]
+        return s + stop[path[-1]]
+    all_paths = list(itertools.product(range(N), repeat=T))
+    logz = np.logaddexp.reduce([score(p) for p in all_paths])
+    best = max(all_paths, key=score)
+    return logz - score(labels), best, score(best)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.default_rng(3)
+    T, N = 4, 3
+    em = rng.normal(size=(T, N)).astype(np.float32)
+    tr = rng.normal(size=(N, N)).astype(np.float32)
+    start = rng.normal(size=N).astype(np.float32)
+    stop = rng.normal(size=N).astype(np.float32)
+    labels = [1, 0, 2, 1]
+    want_nll, want_path, want_best = _brute_crf(em, tr, start, stop, labels)
+    got = DC.linear_chain_crf(jnp.asarray(em)[None], jnp.asarray(tr),
+                              jnp.asarray([labels]), jnp.asarray([T]),
+                              start_transitions=jnp.asarray(start),
+                              stop_transitions=jnp.asarray(stop))
+    np.testing.assert_allclose(float(got[0]), want_nll, rtol=1e-4)
+    paths, scores = DC.crf_decoding(jnp.asarray(em)[None], jnp.asarray(tr),
+                                    jnp.asarray([T]),
+                                    start_transitions=jnp.asarray(start),
+                                    stop_transitions=jnp.asarray(stop))
+    np.testing.assert_array_equal(np.asarray(paths[0]), want_path)
+    np.testing.assert_allclose(float(scores[0]), want_best, rtol=1e-4)
+
+
+def test_crf_respects_lengths():
+    rng = np.random.default_rng(4)
+    B, T, N = 2, 6, 4
+    em = jnp.asarray(rng.normal(size=(B, T, N)).astype(np.float32))
+    tr = jnp.asarray(rng.normal(size=(N, N)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, N, size=(B, T)))
+    # batch 0 length 4: result must equal a standalone T=4 computation
+    nll = DC.linear_chain_crf(em, tr, labels, jnp.asarray([4, 6]))
+    nll4 = DC.linear_chain_crf(em[:1, :4], tr, labels[:1, :4],
+                               jnp.asarray([4]))
+    np.testing.assert_allclose(float(nll[0]), float(nll4[0]), rtol=1e-4)
+    paths, _ = DC.crf_decoding(em, tr, jnp.asarray([4, 6]))
+    assert np.all(np.asarray(paths[0, 4:]) == 0)  # masked tail
+
+
+def test_crf_gradient_flows():
+    rng = np.random.default_rng(5)
+    T, N = 5, 3
+    em = jnp.asarray(rng.normal(size=(1, T, N)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, N, size=(1, T)))
+
+    def f(tr):
+        return DC.linear_chain_crf(em, tr, labels, jnp.asarray([T])).sum()
+
+    g = jax.grad(f)(jnp.zeros((N, N)))
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).sum() > 0
+
+
+# --- edit distance ---------------------------------------------------------
+
+def _np_edit(a, b):
+    dp = np.zeros((len(a) + 1, len(b) + 1))
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[len(a), len(b)]
+
+
+def test_edit_distance_matches_naive():
+    rng = np.random.default_rng(6)
+    B, Lh, Lr = 4, 6, 5
+    hyp = rng.integers(0, 5, size=(B, Lh))
+    ref = rng.integers(0, 5, size=(B, Lr))
+    hl = rng.integers(1, Lh + 1, size=B)
+    rl = rng.integers(1, Lr + 1, size=B)
+    got = DC.edit_distance(jnp.asarray(hyp), jnp.asarray(hl),
+                           jnp.asarray(ref), jnp.asarray(rl))
+    want = [_np_edit(hyp[b, :hl[b]].tolist(), ref[b, :rl[b]].tolist())
+            for b in range(B)]
+    np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_edit_distance_normalized():
+    got = DC.edit_distance(jnp.asarray([[1, 2, 3]]), jnp.asarray([3]),
+                           jnp.asarray([[1, 2, 4]]), jnp.asarray([3]),
+                           normalized=True)
+    assert float(got[0]) == pytest.approx(1 / 3)
