@@ -191,8 +191,36 @@ def bench_deepfm(steps: int, batch_size: int):
     return _train_bench(model, loss_fn, make_batch, steps, batch_size)
 
 
+def bench_stacked_lstm(steps: int, batch_size: int):
+    """Bench model 6: stacked dynamic LSTM sentiment (reference:
+    benchmark/fluid/models/stacked_dynamic_lstm.py), seq 100."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import stacked_lstm as S
+
+    pt.seed(0)
+    batch_size = min(batch_size, 64)
+    model = S.StackedLSTM(vocab_size=5149, embed_dim=512, hidden_dim=512,
+                          num_layers=3)
+    rng = np.random.default_rng(0)
+    T = 100
+
+    def make_batch(bs):
+        ids = jnp.asarray(rng.integers(0, 5149, (bs, T)))
+        lengths = jnp.asarray(rng.integers(T // 2, T + 1, (bs,)))
+        return (ids, lengths)
+
+    def loss_fn(logits, batch):
+        labels = (batch[0][:, 0] % 2).astype(jnp.int32)
+        return S.loss_fn(logits, labels)
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+
+
 MODELS = {
     "mnist_mlp": bench_mnist_mlp,
+    "stacked_lstm": bench_stacked_lstm,
     "resnet50": bench_resnet50,
     "bert_base": bench_bert_base,
     "transformer_nmt": bench_transformer_nmt,

@@ -7,6 +7,7 @@ from .layers import (GELU, RNN, BatchNorm, BilinearTensorProduct, Conv2D,
                      GRUCell, LayerNorm, Linear, LSTMCell, MultiHeadAttention,
                      Pool2D, PRelu, ReLU, RMSNorm, Sigmoid, Softmax,
                      SpectralNorm, Tanh)
+from .rnn_layers import GRU, LSTM
 from .transformer import (FeedForward, LearnedPositionalEmbedding,
                           PositionalEncoding, TransformerDecoder,
                           TransformerDecoderLayer, TransformerEncoder,
@@ -19,6 +20,7 @@ __all__ = [
     "GRUCell", "LayerNorm", "Linear", "LSTMCell", "MultiHeadAttention",
     "Pool2D", "PRelu", "ReLU", "RMSNorm", "Sigmoid", "Softmax",
     "SpectralNorm", "Tanh",
+    "GRU", "LSTM",
     "FeedForward", "LearnedPositionalEmbedding", "PositionalEncoding",
     "TransformerDecoder", "TransformerDecoderLayer", "TransformerEncoder",
     "TransformerEncoderLayer",

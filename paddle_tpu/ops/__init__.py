@@ -6,7 +6,7 @@ come from JAX VJP (replacing GradOpDescMaker); hand-written kernels live in
 """
 
 from . import (control_flow, decode, detection, loss, math, nn, reduction,
-               sequence, tensor)
+               rnn, sequence, tensor)
 from .decode import (beam_search, beam_search_step, crf_decoding, ctc_align,
                      ctc_greedy_decode, ctc_loss, edit_distance,
                      linear_chain_crf)
@@ -45,6 +45,8 @@ from .nn import (adaptive_pool2d, batch_norm, conv2d, conv2d_transpose, conv3d,
                  shuffle_channel, softmax, space_to_depth)
 from .reduction import (mean, reduce_all, reduce_any, reduce_max, reduce_mean,
                         reduce_min, reduce_prod, reduce_sum)
+from .rnn import (conv_shift, dynamic_rnn, gru, gru_unit, lstm, lstm_unit,
+                  lstmp, row_conv, sequence_conv)
 from .sequence import (sequence_concat, sequence_enumerate, sequence_expand,
                        sequence_mask, sequence_pad, sequence_pool,
                        sequence_reverse, sequence_slice, sequence_softmax,

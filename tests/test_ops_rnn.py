@@ -1,0 +1,253 @@
+"""OpTest-style checks for the recurrent op family (lstm/gru/lstmp/row_conv/
+conv_shift/sequence_conv) against step-by-step numpy references, plus the
+stacked LSTM/GRU layers and the stacked_lstm bench model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output
+from paddle_tpu.ops import rnn as R
+
+RNG = np.random.default_rng(7)
+
+
+def u(shape, scale=0.5):
+    return (RNG.uniform(-1, 1, shape) * scale).astype(np.float32)
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(x, w_ih, w_hh, b, lengths=None, forget_bias=0.0, reverse=False,
+            proj=None):
+    bsz, t, _ = x.shape
+    hsz = w_ih.shape[1] // 4
+    rsz = w_hh.shape[0]
+    h = np.zeros((bsz, rsz))
+    c = np.zeros((bsz, hsz))
+    outs = np.zeros((bsz, t, rsz))
+    times = range(t - 1, -1, -1) if reverse else range(t)
+    for time in times:
+        gates = x[:, time] @ w_ih + h @ w_hh + b
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = sigmoid(i), sigmoid(f + forget_bias), sigmoid(o)
+        g = np.tanh(g)
+        nc = f * c + i * g
+        nh = o * np.tanh(nc)
+        if proj is not None:
+            nh = nh @ proj
+        if lengths is not None:
+            active = (time < lengths)[:, None]
+            nh = np.where(active, nh, h)
+            nc = np.where(active, nc, c)
+            outs[:, time] = nh * active
+        else:
+            outs[:, time] = nh
+        h, c = nh, c * 0 + nc
+    return outs, h, c
+
+
+def np_gru(x, w_ih, w_hh, b, lengths=None):
+    bsz, t, _ = x.shape
+    hsz = w_hh.shape[0]
+    h = np.zeros((bsz, hsz))
+    outs = np.zeros((bsz, t, hsz))
+    for time in range(t):
+        gx = x[:, time] @ w_ih + b
+        hh = h @ w_hh
+        r = sigmoid(gx[:, :hsz] + hh[:, :hsz])
+        z = sigmoid(gx[:, hsz:2 * hsz] + hh[:, hsz:2 * hsz])
+        n = np.tanh(gx[:, 2 * hsz:] + r * hh[:, 2 * hsz:])
+        nh = z * h + (1 - z) * n
+        if lengths is not None:
+            active = (time < lengths)[:, None]
+            nh = np.where(active, nh, h)
+            outs[:, time] = nh * active
+        else:
+            outs[:, time] = nh
+        h = nh
+    return outs, h
+
+
+class TestLSTM:
+    def test_forward(self):
+        x, w_ih, w_hh, b = u((2, 5, 3)), u((3, 16)), u((4, 16)), u((16,))
+        ref_out, ref_h, ref_c = np_lstm(x, w_ih, w_hh, b)
+        out, (h, c) = R.lstm(jnp.asarray(x), jnp.asarray(w_ih),
+                             jnp.asarray(w_hh), jnp.asarray(b))
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(h, ref_h, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c, ref_c, rtol=1e-5, atol=1e-5)
+
+    def test_lengths_mask(self):
+        x, w_ih, w_hh, b = u((3, 6, 3)), u((3, 16)), u((4, 16)), u((16,))
+        lengths = np.array([6, 3, 1])
+        ref_out, ref_h, ref_c = np_lstm(x, w_ih, w_hh, b, lengths=lengths)
+        out, (h, c) = R.lstm(jnp.asarray(x), jnp.asarray(w_ih),
+                             jnp.asarray(w_hh), jnp.asarray(b),
+                             lengths=jnp.asarray(lengths))
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(h, ref_h, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c, ref_c, rtol=1e-5, atol=1e-5)
+
+    def test_reverse(self):
+        x, w_ih, w_hh, b = u((2, 4, 3)), u((3, 16)), u((4, 16)), u((16,))
+        ref_out, ref_h, _ = np_lstm(x, w_ih, w_hh, b, reverse=True)
+        out, (h, _) = R.lstm(jnp.asarray(x), jnp.asarray(w_ih),
+                             jnp.asarray(w_hh), jnp.asarray(b),
+                             is_reverse=True)
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(h, ref_h, rtol=1e-5, atol=1e-5)
+
+    def test_lstmp_projection(self):
+        x, w_ih, b = u((2, 4, 3)), u((3, 16)), u((16,))
+        proj = u((4, 2))
+        w_hh = u((2, 16))  # recurrent input is the projected size
+        ref_out, ref_h, _ = np_lstm(x, w_ih, w_hh, b, proj=proj)
+        out, (h, _) = R.lstmp(jnp.asarray(x), jnp.asarray(w_ih),
+                              jnp.asarray(w_hh), jnp.asarray(proj),
+                              bias=jnp.asarray(b))
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+
+    def test_grad(self):
+        x, w_ih, w_hh, b = u((2, 3, 2)), u((2, 8)), u((2, 8)), u((8,))
+
+        def f(x, w_ih, w_hh, b):
+            out, _ = R.lstm(x, w_ih, w_hh, b)
+            return jnp.sum(out ** 2)
+
+        check_grad(f, [x, w_ih, w_hh, b], wrt=[0, 1, 2, 3],
+                   rtol=2e-2, atol=1e-3)
+
+
+class TestGRU:
+    def test_forward_and_lengths(self):
+        x, w_ih, w_hh, b = u((3, 5, 3)), u((3, 12)), u((4, 12)), u((12,))
+        lengths = np.array([5, 2, 4])
+        ref_out, ref_h = np_gru(x, w_ih, w_hh, b, lengths=lengths)
+        out, h = R.gru(jnp.asarray(x), jnp.asarray(w_ih), jnp.asarray(w_hh),
+                       jnp.asarray(b), lengths=jnp.asarray(lengths))
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(h, ref_h, rtol=1e-5, atol=1e-5)
+
+    def test_grad(self):
+        x, w_ih, w_hh, b = u((2, 3, 2)), u((2, 6)), u((2, 6)), u((6,))
+
+        def f(x, w_ih, w_hh):
+            out, _ = R.gru(x, w_ih, w_hh, bias=jnp.asarray(b))
+            return jnp.sum(out ** 2)
+
+        check_grad(f, [x, w_ih, w_hh], wrt=[0, 1, 2], rtol=2e-2, atol=1e-3)
+
+
+class TestRowConv:
+    def test_forward(self):
+        x, w = u((2, 6, 3)), u((3, 3))
+        ref = np.zeros_like(x)
+        for k in range(3):
+            ref[:, :6 - k] += x[:, k:] * w[k][None, None, :]
+        check_output(lambda a, b: R.row_conv(a, b), [x, w], ref,
+                     rtol=1e-5, atol=1e-5)
+
+    def test_grad(self):
+        x, w = u((1, 4, 2)), u((2, 2))
+        check_grad(lambda a, b: jnp.sum(R.row_conv(a, b) ** 2), [x, w],
+                   wrt=[0, 1], rtol=2e-2, atol=1e-3)
+
+
+class TestConvShift:
+    def test_forward(self):
+        x, y = u((2, 7)), u((2, 3))
+        m, n = 7, 3
+        ref = np.zeros_like(x)
+        for b in range(2):
+            for i in range(m):
+                for j in range(n):
+                    ref[b, i] += y[b, j] * x[b, (i + j - n // 2) % m]
+        check_output(R.conv_shift, [x, y], ref, rtol=1e-5, atol=1e-5)
+
+
+class TestSequenceConv:
+    def test_forward(self):
+        x = u((2, 5, 3))
+        w = u((9, 4))  # context 3 * D 3 → 4
+        lengths = np.array([5, 3])
+        mask = (np.arange(5)[None, :] < lengths[:, None]).astype(np.float32)
+        xm = x * mask[:, :, None]
+        ref = np.zeros((2, 5, 4))
+        for t in range(5):
+            ctx = []
+            for k in (-1, 0, 1):
+                tt = t + k
+                ctx.append(xm[:, tt] if 0 <= tt < 5 else np.zeros((2, 3)))
+            ref[:, t] = np.concatenate(ctx, -1) @ w
+        out = R.sequence_conv(jnp.asarray(x), jnp.asarray(w),
+                              lengths=jnp.asarray(lengths))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestStackedLayers:
+    def test_bidirectional_lstm_shapes(self):
+        from paddle_tpu import nn
+
+        net = nn.LSTM(4, 3, num_layers=2, direction="bidirect")
+        x = jnp.asarray(u((2, 5, 4)))
+        out, (h, c) = net(x, lengths=jnp.asarray(np.array([5, 2])))
+        assert out.shape == (2, 5, 6)
+        assert h.shape == (4, 2, 3) and c.shape == (4, 2, 3)
+        # padded steps must produce zero outputs
+        np.testing.assert_allclose(out[1, 2:], 0.0, atol=1e-7)
+
+    def test_gru_layer_jit_grad(self):
+        from paddle_tpu import nn
+
+        net = nn.GRU(3, 4, num_layers=2)
+        params = net.named_parameters()
+        x = jnp.asarray(u((2, 4, 3)))
+
+        @jax.jit
+        def loss(p):
+            out, _ = net.functional_call(p, x)
+            return jnp.sum(out[0] ** 2)
+
+        g = jax.grad(loss)(params)
+        assert np.isfinite(float(loss(params)))
+        for k, v in g.items():
+            assert np.all(np.isfinite(np.asarray(v))), k
+
+
+class TestStackedLSTMModel:
+    def test_train_step_decreases_loss(self):
+        import paddle_tpu as pt
+        from paddle_tpu import optimizer
+        from paddle_tpu.models import stacked_lstm as S
+
+        pt.seed(0)
+        model = S.StackedLSTM(vocab_size=50, embed_dim=16, hidden_dim=16,
+                              num_layers=2)
+        params = model.named_parameters()
+        opt = optimizer.Adam(1e-2)
+        state = opt.init(params)
+        ids = jnp.asarray(RNG.integers(0, 50, size=(4, 7)))
+        lengths = jnp.asarray(np.array([7, 5, 3, 6]))
+        label = jnp.asarray(RNG.integers(0, 2, size=(4,)))
+
+        @jax.jit
+        def step(params, state):
+            def loss(p):
+                logits, _ = model.functional_call(p, ids, lengths)
+                return S.loss_fn(logits, label)
+
+            l, g = jax.value_and_grad(loss)(params)
+            params, state = opt.apply(params, g, state)
+            return params, state, l
+
+        losses = []
+        for _ in range(8):
+            params, state, l = step(params, state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(losses))
