@@ -8,6 +8,7 @@ from .layers import (GELU, RNN, BatchNorm, BilinearTensorProduct, Conv2D,
                      Pool2D, PRelu, ReLU, RMSNorm, Sigmoid, Softmax,
                      SpectralNorm, Tanh)
 from .rnn_layers import GRU, LSTM
+from .sampling_layers import NCE, HSigmoid
 from .transformer import (FeedForward, LearnedPositionalEmbedding,
                           PositionalEncoding, TransformerDecoder,
                           TransformerDecoderLayer, TransformerEncoder,
@@ -20,7 +21,7 @@ __all__ = [
     "GRUCell", "LayerNorm", "Linear", "LSTMCell", "MultiHeadAttention",
     "Pool2D", "PRelu", "ReLU", "RMSNorm", "Sigmoid", "Softmax",
     "SpectralNorm", "Tanh",
-    "GRU", "LSTM",
+    "GRU", "LSTM", "NCE", "HSigmoid",
     "FeedForward", "LearnedPositionalEmbedding", "PositionalEncoding",
     "TransformerDecoder", "TransformerDecoderLayer", "TransformerEncoder",
     "TransformerEncoderLayer",

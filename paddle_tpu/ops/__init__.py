@@ -6,7 +6,7 @@ come from JAX VJP (replacing GradOpDescMaker); hand-written kernels live in
 """
 
 from . import (control_flow, decode, detection, loss, math, nn, reduction,
-               rnn, sequence, tensor)
+               rnn, sampling, sequence, tensor)
 from .decode import (beam_search, beam_search_step, crf_decoding, ctc_align,
                      ctc_greedy_decode, ctc_loss, edit_distance,
                      linear_chain_crf)
@@ -47,6 +47,8 @@ from .reduction import (mean, reduce_all, reduce_any, reduce_max, reduce_mean,
                         reduce_min, reduce_prod, reduce_sum)
 from .rnn import (conv_shift, dynamic_rnn, gru, gru_unit, lstm, lstm_unit,
                   lstmp, row_conv, sequence_conv)
+from .sampling import (hsigmoid_loss, nce_loss, sample_classes, sample_logits,
+                       sampling_id)
 from .sequence import (sequence_concat, sequence_enumerate, sequence_expand,
                        sequence_mask, sequence_pad, sequence_pool,
                        sequence_reverse, sequence_slice, sequence_softmax,

@@ -1,0 +1,168 @@
+"""OpTest-style checks for sampling-based classification ops (nce,
+hierarchical_sigmoid, sampling_id, sample_logits) with numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from op_test import check_grad
+from paddle_tpu.ops import sampling as SP
+
+RNG = np.random.default_rng(11)
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestNCE:
+    def test_forward_custom_neg(self):
+        """Deterministic check with fixed negatives (uniform sampler)."""
+        b, d, c, s = 3, 4, 8, 5
+        x = RNG.normal(size=(b, d)).astype(np.float32)
+        w = RNG.normal(size=(c, d)).astype(np.float32)
+        bias = RNG.normal(size=(c,)).astype(np.float32)
+        label = RNG.integers(0, c, b)
+        neg = RNG.integers(0, c, (b, s))
+
+        # numpy reference: binary true-vs-noise with logit - log(S * 1/C)
+        def lg(ids_row, xb):
+            return xb @ w[ids_row].T + bias[ids_row]
+
+        ref = np.zeros(b)
+        for i in range(b):
+            pos = float(x[i] @ w[label[i]] + bias[label[i]]) - np.log(s / c)
+            negs = lg(neg[i], x[i]) - np.log(s / c)
+            ref[i] = -np.log(sigmoid(pos)) - np.sum(np.log(1 - sigmoid(negs)))
+
+        got = SP.nce_loss(jnp.asarray(x), jnp.asarray(label), jnp.asarray(w),
+                          bias=jnp.asarray(bias), custom_neg=neg)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_sampled_finite_and_grad(self):
+        b, d, c = 4, 3, 20
+        x = RNG.normal(size=(b, d)).astype(np.float32)
+        w = RNG.normal(size=(c, d)).astype(np.float32)
+        label = RNG.integers(0, c, b)
+        key = jax.random.key(0)
+
+        def f(x, w):
+            return jnp.sum(SP.nce_loss(x, label, w, num_neg_samples=6,
+                                       sampler="log_uniform", key=key))
+
+        assert np.isfinite(float(f(jnp.asarray(x), jnp.asarray(w))))
+        check_grad(f, [x, w], wrt=[0, 1], rtol=2e-2, atol=1e-3)
+
+    def test_layer(self):
+        import paddle_tpu as pt
+
+        pt.seed(0)
+        nce = pt.nn.NCE(6, 30, num_neg_samples=4)
+        x = jnp.asarray(RNG.normal(size=(2, 6)).astype(np.float32))
+        label = jnp.asarray(RNG.integers(0, 30, 2))
+        cost, _ = nce.functional_call(nce.named_parameters(), x, label)
+        assert cost.shape == (2,) and np.all(np.isfinite(cost))
+
+
+class TestHSigmoid:
+    def test_default_tree_matches_manual(self):
+        b, d, c = 3, 4, 6
+        x = RNG.normal(size=(b, d)).astype(np.float32)
+        w = RNG.normal(size=(c, d)).astype(np.float32)
+        bias = RNG.normal(size=(c,)).astype(np.float32)
+        label = np.array([0, 3, 5])
+
+        # manual reference: SimpleCode walk node=label+C → root
+        ref = np.zeros(b)
+        for i in range(b):
+            node = label[i] + c
+            while node > 1:
+                row = node // 2 - 1
+                bit = node & 1
+                logit = float(x[i] @ w[row] + bias[row])
+                p = sigmoid(logit)
+                ref[i] += -np.log(p if bit else 1 - p)
+                node //= 2
+
+        got = SP.hsigmoid_loss(jnp.asarray(x), jnp.asarray(label),
+                               jnp.asarray(w), bias=jnp.asarray(bias),
+                               num_classes=c)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_custom_tree_and_grad(self):
+        # 4 classes, custom 2-level paths over 3 internal nodes
+        table = np.array([[0, 1], [0, 1], [0, 2], [0, 2]], np.int32)
+        code = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.int32)
+        b, d = 3, 5
+        x = RNG.normal(size=(b, d)).astype(np.float32)
+        w = RNG.normal(size=(3, d)).astype(np.float32)
+        label = np.array([0, 2, 3])
+
+        def f(x, w):
+            return jnp.sum(SP.hsigmoid_loss(
+                x, label, w, path_table=jnp.asarray(table),
+                path_code=jnp.asarray(code)))
+
+        check_grad(f, [x, w], wrt=[0, 1], rtol=2e-2, atol=1e-3)
+
+    def test_layer(self):
+        import paddle_tpu as pt
+
+        pt.seed(0)
+        hs = pt.nn.HSigmoid(5, 10)
+        x = jnp.asarray(RNG.normal(size=(4, 5)).astype(np.float32))
+        label = jnp.asarray(RNG.integers(0, 10, 4))
+        cost, _ = hs.functional_call(hs.named_parameters(), x, label)
+        assert cost.shape == (4,) and np.all(np.isfinite(cost))
+
+
+class TestSamplingId:
+    def test_distribution(self):
+        probs = jnp.asarray(np.tile(np.array([[0.1, 0.0, 0.9]], np.float32),
+                                    (4000, 1)))
+        ids = SP.sampling_id(probs, jax.random.key(0))
+        frac2 = float(np.mean(np.asarray(ids) == 2))
+        assert 0.85 < frac2 < 0.95
+        assert not np.any(np.asarray(ids) == 1)  # zero-prob class never drawn
+
+    def test_jit(self):
+        probs = jnp.asarray(RNG.uniform(0.1, 1.0, (8, 5)).astype(np.float32))
+        ids = jax.jit(SP.sampling_id)(probs, jax.random.key(1))
+        assert ids.shape == (8,) and np.all((np.asarray(ids) >= 0)
+                                            & (np.asarray(ids) < 5))
+
+
+class TestSampleLogits:
+    def test_shapes_and_true_class_col0(self):
+        b, v, s = 4, 50, 7
+        logits = jnp.asarray(RNG.normal(size=(b, v)).astype(np.float32))
+        label = jnp.asarray(RNG.integers(0, v, b))
+        picked, lbl, ids = SP.sample_logits(logits, label, s,
+                                            jax.random.key(0))
+        assert picked.shape == (b, 1 + s)
+        assert np.all(np.asarray(lbl) == 0)
+        np.testing.assert_array_equal(np.asarray(ids[:, 0]),
+                                      np.asarray(label))
+
+    def test_accidental_hit_removed(self):
+        b, v = 2, 5
+        logits = jnp.asarray(np.zeros((b, v), np.float32))
+        label = jnp.asarray(np.array([1, 2]))
+        # force negatives that include the true label via many samples
+        picked, _, ids = SP.sample_logits(logits, label, 64,
+                                          jax.random.key(3))
+        hits = np.asarray(ids[:, 1:]) == np.asarray(label)[:, None]
+        assert hits.any(), "test needs at least one accidental hit"
+        assert np.all(np.asarray(picked[:, 1:])[hits] < -1e19)
+
+    def test_log_uniform_sampler_bias(self):
+        """Zipfian sampler should prefer small ids."""
+        ids, p = SP.sample_classes(jax.random.key(0), (20000,), 1000,
+                                   "log_uniform")
+        ids = np.asarray(ids)
+        assert (ids < 100).mean() > 0.5  # mass concentrated at head
+        # probabilities match the analytic form
+        np.testing.assert_allclose(
+            np.asarray(p[:5]),
+            np.log((ids[:5] + 2.0) / (ids[:5] + 1.0)) / np.log(1001.0),
+            rtol=1e-3, atol=1e-7)
