@@ -29,6 +29,7 @@ _LAZY = {
     "clip": ".clip",
     "native": ".native",
     "checkpoint": ".checkpoint",
+    "quant": ".quant",
 }
 
 

@@ -1,0 +1,156 @@
+"""Quantization subsystem tests: fake-quant ops vs numpy references, STE
+gradients, stateful scale trackers, QAT training, PTQ calibrate+freeze."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import quant as Q
+
+RNG = np.random.default_rng(21)
+
+
+def np_quant_dequant(x, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    c = np.clip(x, -scale, scale)
+    return np.round(c * qmax / scale) * scale / qmax
+
+
+class TestFakeQuantOps:
+    def test_abs_max(self):
+        x = RNG.normal(size=(4, 6)).astype(np.float32) * 3
+        out, scale = Q.fake_quantize_abs_max(jnp.asarray(x))
+        assert float(scale) == np.abs(x).max().astype(np.float32)
+        np.testing.assert_allclose(out, np_quant_dequant(x, float(scale)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_channel_wise(self):
+        x = RNG.normal(size=(3, 5)).astype(np.float32)
+        out, scale = Q.fake_channel_wise_quantize_abs_max(
+            jnp.asarray(x), channel_axis=1)
+        assert scale.shape == (5,)
+        ref = np.stack([np_quant_dequant(x[:, j], np.abs(x[:, j]).max())
+                        for j in range(5)], axis=1)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_ste_gradient(self):
+        """Gradient is identity inside the clip range, zero outside."""
+        x = jnp.asarray(np.array([0.2, -0.4, 1.5, -2.0], np.float32))
+        scale = 1.0
+        g = jax.grad(lambda v: jnp.sum(
+            Q.quantize_dequantize(v, scale)))(x)
+        np.testing.assert_allclose(g, [1.0, 1.0, 0.0, 0.0], atol=1e-6)
+
+    def test_quantize_roundtrip_int8(self):
+        x = RNG.normal(size=(8,)).astype(np.float32)
+        scale = float(np.abs(x).max())
+        q = Q.quantize_to_int(jnp.asarray(x), scale)
+        assert q.dtype == jnp.int8
+        deq = Q.dequantize(q, scale)
+        np.testing.assert_allclose(deq, x, atol=scale / 127 + 1e-6)
+
+    def test_moving_average_tracker(self):
+        st = Q.moving_average_state_init()
+        xs = [np.full((3,), v, np.float32) for v in (1.0, 2.0, 4.0)]
+        accum = state = 0.0
+        for x in xs:
+            scale, st = Q.moving_average_abs_max_scale(jnp.asarray(x), st,
+                                                       moving_rate=0.5)
+            accum = accum * 0.5 + np.abs(x).max()
+            state = state * 0.5 + 1.0
+            np.testing.assert_allclose(float(scale), accum / state, rtol=1e-6)
+
+    def test_range_tracker_window_max(self):
+        st = Q.range_state_init(window_size=2)
+        for v, expect in ((1.0, 1.0), (3.0, 3.0), (0.5, 3.0), (0.2, 0.5)):
+            out, st = Q.fake_quantize_range_abs_max(
+                jnp.asarray(np.full((2,), v, np.float32)), st)
+            np.testing.assert_allclose(float(st.scale), expect, rtol=1e-6)
+
+    def test_is_test_uses_frozen_scale(self):
+        st = Q.MovingAverageState(jnp.asarray(2.0), jnp.asarray(2.0),
+                                  jnp.asarray(1.0))
+        x = jnp.asarray(np.array([5.0], np.float32))  # beyond frozen scale
+        out, st2 = Q.fake_quantize_moving_average_abs_max(x, st, is_test=True)
+        assert float(out[0]) == 2.0  # clipped to frozen scale
+        assert st2 is st
+
+
+class TestQAT:
+    def _model(self):
+        pt.seed(0)
+        return pt.nn.Sequential(pt.nn.Linear(8, 16, act="relu"),
+                                pt.nn.Linear(16, 4))
+
+    def test_quantize_model_wraps(self):
+        m = Q.quantize_model(self._model())
+        kinds = [type(s).__name__ for s in m.sublayers()]
+        assert kinds.count("QuantedLayer") == 2
+        params = m.named_parameters()
+        assert any(k.endswith("inner.weight") for k in params)
+
+    def test_qat_trains(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.ops import loss as L
+
+        m = Q.quantize_model(self._model())
+        params = m.named_parameters()
+        buffers = m.named_buffers()
+        opt = optimizer.Adam(1e-2)
+        state = opt.init(params)
+        x = jnp.asarray(RNG.normal(size=(16, 8)).astype(np.float32))
+        label = jnp.asarray(RNG.integers(0, 4, 16))
+
+        @jax.jit
+        def step(params, buffers, state):
+            def loss(p):
+                out, nb = m.functional_call(p, x, buffers=buffers,
+                                            training=True)
+                return jnp.mean(L.softmax_with_cross_entropy(out, label)), nb
+
+            (l, nb), g = jax.value_and_grad(loss, has_aux=True)(params)
+            params, state = opt.apply(params, g, state)
+            return params, nb, state, l
+
+        losses = []
+        for _ in range(20):
+            params, buffers, state, l = step(params, buffers, state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+        # activation scales must have been tracked
+        assert buffers["0.act_scale"] > 0
+
+    def test_ptq_calibrate_and_freeze(self):
+        m = Q.quantize_model(self._model())
+        batches = [jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+                   for _ in range(5)]
+        Q.calibrate(m, batches)
+        assert not m.training
+        assert float(m[0].act_scale) > 0
+        table = Q.freeze(m)
+        assert set(table) == {"0", "1"}
+        ent = table["0"]
+        assert ent["weight_int8"].dtype == jnp.int8
+        assert ent["weight_scale"].shape == (16,)  # per output channel
+        # int8 weights dequantize back close to the float weights
+        w = m[0].inner._params["weight"]
+        deq = Q.dequantize(ent["weight_int8"],
+                           ent["weight_scale"], quant_axis=1)
+        np.testing.assert_allclose(np.asarray(deq), np.asarray(w),
+                                   atol=float(jnp.max(ent["weight_scale"]))
+                                   / 127 + 1e-6)
+
+    def test_eval_output_uses_frozen_scales_under_jit(self):
+        m = Q.quantize_model(self._model())
+        x = jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+        Q.calibrate(m, [x])
+        params, buffers = m.named_parameters(), m.named_buffers()
+
+        @jax.jit
+        def infer(p, b, x):
+            out, _ = m.functional_call(p, x, buffers=b, training=False)
+            return out
+
+        out = infer(params, buffers, x)
+        assert out.shape == (4, 4) and np.all(np.isfinite(out))
