@@ -30,6 +30,7 @@ _LAZY = {
     "native": ".native",
     "checkpoint": ".checkpoint",
     "quant": ".quant",
+    "amp": ".amp",
 }
 
 

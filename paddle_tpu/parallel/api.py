@@ -36,12 +36,17 @@ class Trainer:
                  loss_builder: Callable, mesh=None,
                  build_strategy: Optional[BuildStrategy] = None,
                  param_spec: Optional[Dict[str, P]] = None,
-                 opt_state_rules=None):
+                 opt_state_rules=None, amp: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_builder = loss_builder
         self.mesh = mesh or get_mesh()
         self.strategy = build_strategy or BuildStrategy()
+        # amp: policy name ("mixed_bf16" / "mixed_fp16" / ...) applied at
+        # trace time around the loss (reference: contrib/mixed_precision
+        # decorator capability; bf16 needs no loss scaling — pair
+        # "mixed_fp16" with amp.decorate()'d optimizer for scaling)
+        self.amp_policy = amp
 
         rep = NamedSharding(self.mesh, P())
 
@@ -74,19 +79,39 @@ class Trainer:
     # --- pure step functions ------------------------------------------------
 
     def _step(self, params, buffers, opt_state, rng, batch):
-        def lf(p):
-            loss, (metrics, new_buffers) = self.loss_builder(
-                p, buffers, rng, batch)
-            return loss, (metrics, new_buffers)
+        from ..amp import MixedPrecisionOptimizer
+        from ..core.dtypes import policy_scope
 
-        (loss, (metrics, new_buffers)), grads = jax.value_and_grad(
+        import contextlib
+
+        scope = (policy_scope(self.amp_policy) if self.amp_policy
+                 else contextlib.nullcontext())
+        scaled = isinstance(self.optimizer, MixedPrecisionOptimizer)
+
+        def lf(p):
+            with scope:
+                loss, (metrics, new_buffers) = self.loss_builder(
+                    p, buffers, rng, batch)
+            out_loss = (self.optimizer.scale_loss(loss, opt_state)
+                        if scaled else loss)
+            return out_loss, (loss, metrics, new_buffers)
+
+        (_, (loss, metrics, new_buffers)), grads = jax.value_and_grad(
             lf, has_aux=True)(params)
         new_params, new_opt_state = self.optimizer.apply(params, grads,
                                                          opt_state)
         return loss, metrics, new_params, new_buffers, new_opt_state
 
     def _eval_step(self, params, buffers, batch):
-        loss, (metrics, _) = self.loss_builder(params, buffers, None, batch)
+        import contextlib
+
+        from ..core.dtypes import policy_scope
+
+        scope = (policy_scope(self.amp_policy) if self.amp_policy
+                 else contextlib.nullcontext())
+        with scope:
+            loss, (metrics, _) = self.loss_builder(params, buffers, None,
+                                                   batch)
         return loss, metrics
 
     # --- driver API ---------------------------------------------------------

@@ -1,0 +1,115 @@
+"""AMP tests: bf16 policy casting, fp16 dynamic loss scaling with
+nonfinite-step skipping, decorator API, Trainer integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import amp, optimizer
+from paddle_tpu.core.dtypes import get_policy, set_policy
+
+RNG = np.random.default_rng(31)
+
+
+def teardown_module():
+    set_policy("float32")
+
+
+class TestPolicyCasting:
+    def test_linear_computes_bf16_under_policy(self):
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 3)
+        x = jnp.asarray(RNG.normal(size=(2, 4)).astype(np.float32))
+        with amp.amp_guard("mixed_bf16"):
+            out = lin(x)
+        assert out.dtype == jnp.float32  # output cast back
+        with amp.amp_guard("bfloat16"):
+            out2 = lin(x)
+        assert out2.dtype == jnp.bfloat16
+        # params stay fp32 masters either way
+        assert lin.named_parameters()["weight"].dtype == jnp.float32
+
+    def test_amp_lists(self):
+        lists = amp.AutoMixedPrecisionLists(
+            custom_white_list={"softmax"}, custom_black_list={"matmul"})
+        assert not lists.should_run_fp32("softmax")
+        assert lists.should_run_fp32("matmul")
+        assert lists.should_run_fp32("exp")
+
+
+class TestMixedPrecisionOptimizer:
+    def _setup(self):
+        params = {"w": jnp.asarray(np.ones(3, np.float32))}
+        opt = amp.decorate(optimizer.SGD(0.1), init_loss_scaling=8.0,
+                           decr_every_n_nan_or_inf=1)
+        state = opt.init(params)
+        return params, opt, state
+
+    def test_scaled_roundtrip_matches_unscaled_sgd(self):
+        params, opt, state = self._setup()
+        g = {"w": jnp.asarray(np.array([1.0, 2.0, 3.0], np.float32))}
+        scaled_g = jax.tree_util.tree_map(
+            lambda x: x * opt.current_scale(state), g)
+        new_params, state = opt.apply(params, scaled_g, state)
+        np.testing.assert_allclose(new_params["w"],
+                                   1.0 - 0.1 * np.array([1.0, 2.0, 3.0]),
+                                   rtol=1e-6)
+
+    def test_nonfinite_step_skipped_and_scale_halved(self):
+        params, opt, state = self._setup()
+        bad = {"w": jnp.asarray(np.array([np.inf, 0.0, 0.0], np.float32))}
+        new_params, new_state = opt.apply(params, bad, state)
+        np.testing.assert_allclose(new_params["w"], params["w"])  # skipped
+        assert float(opt.current_scale(new_state)) == 4.0  # halved
+        assert int(new_state["inner"]["step"]) == 0  # inner untouched
+
+    def test_static_scaling_keeps_scale(self):
+        params = {"w": jnp.ones(2)}
+        opt = amp.decorate(optimizer.SGD(0.1), init_loss_scaling=16.0,
+                           use_dynamic_loss_scaling=False)
+        state = opt.init(params)
+        g = {"w": jnp.ones(2) * 16.0}
+        _, state = opt.apply(params, g, state)
+        assert float(opt.current_scale(state)) == 16.0
+
+    def test_scale_loss(self):
+        params, opt, state = self._setup()
+        assert float(opt.scale_loss(jnp.asarray(2.0), state)) == 16.0
+
+
+class TestTrainerAMP:
+    def test_bf16_trainer_trains(self):
+        from paddle_tpu import parallel
+        from paddle_tpu.models import mnist as M
+
+        pt.seed(0)
+        mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+        model = M.MnistMLP(hidden1=32, hidden2=16)
+        tr = parallel.Trainer.supervised(
+            model, optimizer.Adam(1e-3), M.loss_fn, mesh=mesh,
+            amp="mixed_bf16")
+        x = jnp.asarray(RNG.normal(size=(16, 784)).astype(np.float32))
+        label = jnp.asarray(RNG.integers(0, 10, 16))
+        losses = [float(tr.train_step({"x": x, "label": label})[0])
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(losses))
+
+    def test_fp16_trainer_with_scaler(self):
+        from paddle_tpu import parallel
+        from paddle_tpu.models import mnist as M
+
+        pt.seed(0)
+        mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+        model = M.MnistMLP(hidden1=32, hidden2=16)
+        opt = amp.decorate(optimizer.Adam(1e-3), init_loss_scaling=128.0)
+        tr = parallel.Trainer.supervised(
+            model, opt, M.loss_fn, mesh=mesh, amp="mixed_fp16")
+        x = jnp.asarray(RNG.normal(size=(16, 784)).astype(np.float32))
+        label = jnp.asarray(RNG.integers(0, 10, 16))
+        losses = [float(tr.train_step({"x": x, "label": label})[0])
+                  for _ in range(5)]
+        # reported loss is the UNscaled one
+        assert losses[0] < 10.0
+        assert losses[-1] < losses[0]
