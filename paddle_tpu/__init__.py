@@ -31,6 +31,7 @@ _LAZY = {
     "checkpoint": ".checkpoint",
     "quant": ".quant",
     "amp": ".amp",
+    "fleet": ".fleet",
 }
 
 

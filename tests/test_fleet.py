@@ -1,0 +1,122 @@
+"""Fleet orchestration tests: role discovery from env, strategy→mesh
+construction, distributed_optimizer wrapping, one-call trainer. Multi-host
+connect=True is exercised only as far as argument validation (no second
+process in CI) — the mesh/sharding path itself is covered by the virtual
+8-device suite (conftest)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fleet
+from paddle_tpu.core.enforce import EnforceError
+
+
+@pytest.fixture(autouse=True)
+def clean_env():
+    saved = {k: os.environ.get(k) for k in
+             ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+              "PADDLE_TRAINER_ENDPOINTS", "JAX_PROCESS_ID",
+              "JAX_NUM_PROCESSES", "JAX_COORDINATOR_ADDRESS")}
+    for k in saved:
+        os.environ.pop(k, None)
+    yield
+    for k, v in saved.items():
+        if v is not None:
+            os.environ[k] = v
+        else:
+            os.environ.pop(k, None)
+
+
+class TestRoleMaker:
+    def test_single_process_defaults(self):
+        r = fleet.RoleMaker()
+        assert r.rank == 0 and r.world_size == 1
+        assert r.is_first_worker()
+
+    def test_paddle_env_protocol(self):
+        os.environ["PADDLE_TRAINER_ID"] = "2"
+        os.environ["PADDLE_TRAINERS_NUM"] = "4"
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = (
+            "10.0.0.1:6170,10.0.0.2:6170,10.0.0.3:6170,10.0.0.4:6170")
+        r = fleet.RoleMaker()
+        assert r.rank == 2 and r.world_size == 4
+        assert not r.is_first_worker()
+        assert r.coordinator == "10.0.0.1:6170"  # rank-0 endpoint
+        assert len(r.endpoints) == 4
+
+    def test_jax_env_protocol(self):
+        os.environ["JAX_PROCESS_ID"] = "1"
+        os.environ["JAX_NUM_PROCESSES"] = "2"
+        os.environ["JAX_COORDINATOR_ADDRESS"] = "host0:1234"
+        r = fleet.RoleMaker()
+        assert r.rank == 1 and r.world_size == 2
+        assert r.coordinator == "host0:1234"
+
+    def test_bad_rank_rejected(self):
+        with pytest.raises(EnforceError):
+            fleet.RoleMaker(rank=5, world_size=2)
+
+
+class TestFleetInit:
+    def test_single_process_init_builds_mesh(self):
+        f = fleet.init()
+        assert f.initialized
+        assert f.worker_num() == 1 and f.is_first_worker()
+        assert f.mesh.shape["dp"] == len(jax.devices())
+
+    def test_strategy_shapes_mesh(self):
+        n = len(jax.devices())
+        if n < 2:
+            pytest.skip("needs >=2 devices")
+        f = fleet.init(strategy=fleet.DistributedStrategy(tp=2))
+        assert f.mesh.shape["tp"] == 2
+        assert f.mesh.shape["dp"] == n // 2
+
+    def test_bad_strategy_rejected(self):
+        n = len(jax.devices())
+        with pytest.raises(EnforceError):
+            fleet.init(strategy=fleet.DistributedStrategy(dp=n + 1))
+
+    def test_multiprocess_needs_coordinator(self):
+        with pytest.raises(EnforceError):
+            fleet.init(role=fleet.RoleMaker(rank=0, world_size=2),
+                       connect=True)
+
+    def test_module_level_delegation(self):
+        fleet.init()
+        assert fleet.worker_num() == 1
+        assert fleet.instance().initialized
+
+
+class TestFleetTraining:
+    def test_distributed_optimizer_amp_wrap(self):
+        from paddle_tpu import amp, optimizer
+        from paddle_tpu.core.dtypes import set_policy
+
+        f = fleet.init(strategy=fleet.DistributedStrategy(amp="mixed_fp16"))
+        opt = f.distributed_optimizer(optimizer.Adam(1e-3))
+        assert isinstance(opt, amp.MixedPrecisionOptimizer)
+        set_policy("float32")
+
+    def test_one_call_trainer_trains(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.models import mnist as M
+
+        rng = np.random.default_rng(0)
+        pt.seed(0)
+        f = fleet.init()
+        tr = f.trainer(M.MnistMLP(hidden1=32, hidden2=16),
+                       optimizer.Adam(1e-3), M.loss_fn)
+        bs = max(8, len(jax.devices()))
+        batch = {"x": jax.device_put(
+            rng.normal(size=(bs, 784)).astype(np.float32),
+            tr.data_sharding()),
+            "label": jax.device_put(rng.integers(0, 10, bs),
+                                    tr.data_sharding())}
+        losses = [float(tr.train_step(batch)[0]) for _ in range(5)]
+        assert losses[-1] < losses[0]
