@@ -140,3 +140,170 @@ class MultiSlotFeed:
             self.close()
         except Exception:
             pass
+
+
+# --------------------------------------------------------------------------
+# C++ PJRT serving predictor (src/predictor.cc) — the Python-free serving
+# path (reference: inference/api/analysis_predictor.h:46,
+# train/demo/demo_trainer.cc). This wrapper drives the same C ABI that the
+# standalone `ptserve` binary uses, so the artifact/npz/manifest parsing is
+# testable from Python without a PJRT device.
+
+_PRED_SO = os.path.join(_DIR, "libptpredictor.so")
+_pred_lib = None
+
+
+def _load_predictor_lib():
+    global _pred_lib
+    with _lib_lock:
+        if _pred_lib is not None:
+            return _pred_lib
+        if not os.path.exists(_PRED_SO):
+            try:
+                subprocess.run(["make", "-C", _DIR, "libptpredictor.so"],
+                               check=True, capture_output=True, text=True,
+                               timeout=300)
+            except Exception as e:
+                raise RuntimeError(
+                    f"cannot build libptpredictor.so: "
+                    f"{getattr(e, 'stderr', e)}")
+        lib = ctypes.CDLL(_PRED_SO)
+        lib.ptpred_load.restype = ctypes.c_void_p
+        lib.ptpred_load.argtypes = [ctypes.c_char_p]
+        lib.ptpred_ok.argtypes = [ctypes.c_void_p]
+        lib.ptpred_error.restype = ctypes.c_char_p
+        lib.ptpred_error.argtypes = [ctypes.c_void_p]
+        lib.ptpred_compile.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptpred_num_feeds.argtypes = [ctypes.c_void_p]
+        lib.ptpred_feed_name.restype = ctypes.c_char_p
+        lib.ptpred_feed_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_num_fetches.argtypes = [ctypes.c_void_p]
+        lib.ptpred_fetch_name.restype = ctypes.c_char_p
+        lib.ptpred_fetch_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_num_params.argtypes = [ctypes.c_void_p]
+        lib.ptpred_param_dtype.restype = ctypes.c_char_p
+        lib.ptpred_param_dtype.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptpred_param_rank.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptpred_param_dim.restype = ctypes.c_int64
+        lib.ptpred_param_dim.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+        lib.ptpred_param_data.restype = ctypes.c_void_p
+        lib.ptpred_param_data.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.POINTER(ctypes.c_int64)]
+        lib.ptpred_run.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_void_p),
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.ptpred_out_rank.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_out_dim.restype = ctypes.c_int64
+        lib.ptpred_out_dim.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int]
+        lib.ptpred_out_dtype.restype = ctypes.c_char_p
+        lib.ptpred_out_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_out_data.restype = ctypes.c_void_p
+        lib.ptpred_out_data.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.ptpred_destroy.argtypes = [ctypes.c_void_p]
+        _pred_lib = lib
+        return lib
+
+
+def default_pjrt_plugin() -> Optional[str]:
+    """Plugin search: $PT_PJRT_PLUGIN, else libtpu from the environment."""
+    p = os.environ.get("PT_PJRT_PLUGIN")
+    if p:
+        return p
+    try:
+        import libtpu
+
+        return os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+    except ImportError:
+        return None
+
+
+class NativePredictor:
+    """C++ serving predictor handle (artifact parse is hermetic; ``compile``
+    needs a PJRT plugin + device)."""
+
+    def __init__(self, model_dir: str):
+        self._lib = _load_predictor_lib()
+        self._h = self._lib.ptpred_load(model_dir.encode())
+        if not self._lib.ptpred_ok(self._h):
+            err = self._lib.ptpred_error(self._h).decode()
+            self._lib.ptpred_destroy(self._h)
+            self._h = None
+            raise RuntimeError(f"native predictor load: {err}")
+
+    @property
+    def feed_names(self) -> List[str]:
+        return [self._lib.ptpred_feed_name(self._h, i).decode()
+                for i in range(self._lib.ptpred_num_feeds(self._h))]
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return [self._lib.ptpred_fetch_name(self._h, i).decode()
+                for i in range(self._lib.ptpred_num_fetches(self._h))]
+
+    def num_params(self) -> int:
+        return self._lib.ptpred_num_params(self._h)
+
+    def param(self, name: str) -> np.ndarray:
+        """Parsed param tensor (exercises the C++ npz reader)."""
+        rank = self._lib.ptpred_param_rank(self._h, name.encode())
+        if rank < 0:
+            raise KeyError(name)
+        shape = [self._lib.ptpred_param_dim(self._h, name.encode(), i)
+                 for i in range(rank)]
+        dt = self._lib.ptpred_param_dtype(self._h, name.encode()).decode()
+        n = ctypes.c_int64()
+        ptr = self._lib.ptpred_param_data(self._h, name.encode(),
+                                          ctypes.byref(n))
+        buf = ctypes.string_at(ptr, n.value)
+        return np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape).copy()
+
+    def compile(self, plugin_path: Optional[str] = None) -> None:
+        plugin = plugin_path or default_pjrt_plugin()
+        if plugin is None:
+            raise RuntimeError("no PJRT plugin found; set PT_PJRT_PLUGIN")
+        if not self._lib.ptpred_compile(self._h, plugin.encode()):
+            raise RuntimeError(
+                f"compile: {self._lib.ptpred_error(self._h).decode()}")
+
+    def run(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        names = self.feed_names
+        arrs = [np.ascontiguousarray(feeds[n]) for n in names]
+        ptrs = (ctypes.c_void_p * len(arrs))(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in arrs])
+        dims_flat = []
+        ranks = []
+        for a in arrs:
+            dims_flat.extend(a.shape)
+            ranks.append(a.ndim)
+        dims = (ctypes.c_int64 * len(dims_flat))(*dims_flat)
+        ranks_c = (ctypes.c_int * len(ranks))(*ranks)
+        if not self._lib.ptpred_run(self._h, ptrs, dims, ranks_c):
+            raise RuntimeError(
+                f"run: {self._lib.ptpred_error(self._h).decode()}")
+        outs = []
+        for i in range(self._lib.ptpred_num_fetches(self._h)):
+            rank = self._lib.ptpred_out_rank(self._h, i)
+            shape = [self._lib.ptpred_out_dim(self._h, i, d)
+                     for d in range(rank)]
+            dt = self._lib.ptpred_out_dtype(self._h, i).decode()
+            n = ctypes.c_int64()
+            ptr = self._lib.ptpred_out_data(self._h, i, ctypes.byref(n))
+            buf = ctypes.string_at(ptr, n.value)
+            outs.append(np.frombuffer(buf, dtype=np.dtype(dt))
+                        .reshape(shape).copy())
+        return outs
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ptpred_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

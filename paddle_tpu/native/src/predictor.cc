@@ -1,0 +1,648 @@
+// C++ serving predictor over the PJRT C API — the Python-free serving path
+// (capability parity with the reference's C++ inference stack:
+// paddle/fluid/inference/api/analysis_predictor.h:46 AnalysisPredictor and
+// the Python-free training/serving demo paddle/fluid/train/demo/
+// demo_trainer.cc; the artifact replaces __model__ ProgramDesc + var files).
+//
+// Loads a save_inference_model directory:
+//   manifest.json   — feed/fetch names, dtypes, arg order (calling conv)
+//   params.npz      — persistable vars (zip of .npy, stored or deflate)
+//   program.mlir.bc — StableHLO portable bytecode (compiled via
+//                     PJRT_Client_Compile, format "mlir")
+// and executes on any PJRT plugin (libtpu.so on a TPU VM; set
+// PT_PJRT_PLUGIN to the plugin path). All entry points are C ABI for
+// ctypes and for the standalone `ptserve` demo binary.
+//
+// Design note: artifact parsing (manifest/npz) is dependency-free and
+// hermetically testable; only Run() needs a live PJRT device.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dlfcn.h>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+#include <zlib.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+// ---------------------------------------------------------------- errors --
+struct Status {
+  bool ok = true;
+  std::string message;
+  static Status Ok() { return {}; }
+  static Status Err(std::string m) { return {false, std::move(m)}; }
+};
+
+// ------------------------------------------------------------ tiny JSON ---
+// Parser for the machine-written manifest (objects, arrays, strings,
+// numbers, bools). Not a general JSON library on purpose.
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json* find(const std::string& k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  bool fail = false;
+
+  void ws() { while (p < end && strchr(" \t\r\n", *p)) p++; }
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if (end - p >= (long)n && !strncmp(p, s, n)) { p += n; return true; }
+    return false;
+  }
+  Json parse() {
+    ws();
+    Json j;
+    if (p >= end) { fail = true; return j; }
+    if (*p == '{') {
+      j.kind = Json::kObj; p++;
+      ws();
+      if (p < end && *p == '}') { p++; return j; }
+      while (p < end) {
+        ws();
+        Json key = parse_string();
+        ws();
+        if (p >= end || *p != ':') { fail = true; return j; }
+        p++;
+        j.obj[key.str] = parse();
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == '}') { p++; break; }
+        fail = true; return j;
+      }
+    } else if (*p == '[') {
+      j.kind = Json::kArr; p++;
+      ws();
+      if (p < end && *p == ']') { p++; return j; }
+      while (p < end) {
+        j.arr.push_back(parse());
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == ']') { p++; break; }
+        fail = true; return j;
+      }
+    } else if (*p == '"') {
+      j = parse_string();
+    } else if (lit("true")) {
+      j.kind = Json::kBool; j.b = true;
+    } else if (lit("false")) {
+      j.kind = Json::kBool; j.b = false;
+    } else if (lit("null")) {
+      j.kind = Json::kNull;
+    } else {
+      j.kind = Json::kNum;
+      char* q = nullptr;
+      j.num = strtod(p, &q);
+      if (q == p) fail = true;
+      p = q;
+    }
+    return j;
+  }
+  Json parse_string() {
+    Json j; j.kind = Json::kStr;
+    if (p >= end || *p != '"') { fail = true; return j; }
+    p++;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        p++;
+        switch (*p) {
+          case 'n': j.str += '\n'; break;
+          case 't': j.str += '\t'; break;
+          default: j.str += *p;
+        }
+      } else {
+        j.str += *p;
+      }
+      p++;
+    }
+    if (p < end) p++;  // closing quote
+    return j;
+  }
+};
+
+// ------------------------------------------------------------- npz/zip ----
+struct NpyArray {
+  std::string dtype;          // numpy descr, e.g. "<f4"
+  std::vector<int64_t> shape;
+  std::vector<uint8_t> data;  // raw little-endian payload
+};
+
+Status InflateRaw(const uint8_t* src, size_t n, std::vector<uint8_t>* out) {
+  z_stream zs{};
+  if (inflateInit2(&zs, -MAX_WBITS) != Z_OK)
+    return Status::Err("zlib init failed");
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = n;
+  std::vector<uint8_t> buf(1 << 16);
+  int ret = Z_OK;
+  while (ret != Z_STREAM_END) {
+    zs.next_out = buf.data();
+    zs.avail_out = buf.size();
+    ret = inflate(&zs, Z_NO_FLUSH);
+    if (ret != Z_OK && ret != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return Status::Err("zlib inflate failed");
+    }
+    out->insert(out->end(), buf.data(),
+                buf.data() + (buf.size() - zs.avail_out));
+  }
+  inflateEnd(&zs);
+  return Status::Ok();
+}
+
+Status ParseNpy(const std::vector<uint8_t>& raw, NpyArray* out) {
+  if (raw.size() < 10 || memcmp(raw.data(), "\x93NUMPY", 6))
+    return Status::Err("bad .npy magic");
+  int major = raw[6];
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = raw[8] | (raw[9] << 8);
+    hoff = 10;
+  } else {
+    hlen = raw[8] | (raw[9] << 8) | (raw[10] << 16) | ((size_t)raw[11] << 24);
+    hoff = 12;
+  }
+  std::string hdr((const char*)raw.data() + hoff, hlen);
+  // header is a python dict literal: {'descr': '<f4', 'fortran_order':
+  // False, 'shape': (3, 4), }
+  auto grab = [&](const char* key) -> std::string {
+    auto k = hdr.find(key);
+    if (k == std::string::npos) return "";
+    auto c = hdr.find(':', k);
+    auto e = hdr.find_first_of(",}", c);
+    // tuples contain commas — extend to the closing paren
+    auto open = hdr.find('(', c);
+    if (open != std::string::npos && open < e) e = hdr.find(')', open) + 1;
+    return hdr.substr(c + 1, e - c - 1);
+  };
+  std::string descr = grab("'descr'");
+  auto q0 = descr.find('\'');
+  auto q1 = descr.rfind('\'');
+  if (q0 == std::string::npos || q1 <= q0)
+    return Status::Err("bad descr in npy header");
+  out->dtype = descr.substr(q0 + 1, q1 - q0 - 1);
+  if (grab("'fortran_order'").find("True") != std::string::npos)
+    return Status::Err("fortran_order arrays unsupported");
+  std::string shp = grab("'shape'");
+  out->shape.clear();
+  const char* s = shp.c_str();
+  while (*s) {
+    while (*s && !isdigit(*s)) s++;
+    if (!*s) break;
+    out->shape.push_back(strtoll(s, const_cast<char**>(&s), 10));
+  }
+  out->data.assign(raw.begin() + hoff + hlen, raw.end());
+  return Status::Ok();
+}
+
+// Minimal ZIP central-directory reader (stored + deflate entries).
+Status ReadNpz(const std::string& path,
+               std::map<std::string, NpyArray>* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return Status::Err("cannot open " + path);
+  std::vector<uint8_t> buf((std::istreambuf_iterator<char>(f)),
+                           std::istreambuf_iterator<char>());
+  if (buf.size() < 22) return Status::Err("npz too small");
+  // find end-of-central-directory record (no zip64 support; params files
+  // beyond 4GB should use sharded checkpoints instead)
+  size_t eocd = std::string::npos;
+  for (size_t i = buf.size() - 22; i + 4 >= 4; i--) {
+    if (buf[i] == 0x50 && buf[i + 1] == 0x4b && buf[i + 2] == 0x05 &&
+        buf[i + 3] == 0x06) { eocd = i; break; }
+    if (i == 0) break;
+  }
+  if (eocd == std::string::npos) return Status::Err("no zip EOCD");
+  auto rd16 = [&](size_t o) { return (uint32_t)buf[o] | (buf[o + 1] << 8); };
+  auto rd32 = [&](size_t o) {
+    return (uint32_t)buf[o] | (buf[o + 1] << 8) | (buf[o + 2] << 16) |
+           ((uint32_t)buf[o + 3] << 24);
+  };
+  uint32_t n_entries = rd16(eocd + 10);
+  size_t cd = rd32(eocd + 16);
+  for (uint32_t e = 0; e < n_entries; e++) {
+    if (rd32(cd) != 0x02014b50) return Status::Err("bad central dir entry");
+    uint16_t method = rd16(cd + 10);
+    uint32_t csize = rd32(cd + 20);
+    uint16_t nlen = rd16(cd + 28), xlen = rd16(cd + 30), clen = rd16(cd + 32);
+    uint32_t lho = rd32(cd + 42);
+    std::string name((const char*)&buf[cd + 46], nlen);
+    // local header: skip its (possibly different) name/extra lengths
+    uint16_t lnlen = rd16(lho + 26), lxlen = rd16(lho + 28);
+    size_t data_off = lho + 30 + lnlen + lxlen;
+    std::vector<uint8_t> raw;
+    if (method == 0) {
+      raw.assign(buf.begin() + data_off, buf.begin() + data_off + csize);
+    } else if (method == 8) {
+      Status st = InflateRaw(&buf[data_off], csize, &raw);
+      if (!st.ok) return st;
+    } else {
+      return Status::Err("unsupported zip method for " + name);
+    }
+    if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
+      name = name.substr(0, name.size() - 4);
+    NpyArray arr;
+    Status st = ParseNpy(raw, &arr);
+    if (!st.ok) return Status::Err(name + ": " + st.message);
+    (*out)[name] = std::move(arr);
+    cd += 46 + nlen + xlen + clen;
+  }
+  return Status::Ok();
+}
+
+// ------------------------------------------------------------- dtypes -----
+struct DtypeInfo {
+  PJRT_Buffer_Type type;
+  size_t size;
+};
+
+Status DtypeFromNumpy(const std::string& d, DtypeInfo* out) {
+  // numpy descr (little-endian) or plain name from the manifest
+  static const std::map<std::string, DtypeInfo> table = {
+      {"<f4", {PJRT_Buffer_Type_F32, 4}},  {"float32", {PJRT_Buffer_Type_F32, 4}},
+      {"<f8", {PJRT_Buffer_Type_F64, 8}},  {"float64", {PJRT_Buffer_Type_F64, 8}},
+      {"<f2", {PJRT_Buffer_Type_F16, 2}},  {"float16", {PJRT_Buffer_Type_F16, 2}},
+      {"<i4", {PJRT_Buffer_Type_S32, 4}},  {"int32", {PJRT_Buffer_Type_S32, 4}},
+      {"<i8", {PJRT_Buffer_Type_S64, 8}},  {"int64", {PJRT_Buffer_Type_S64, 8}},
+      {"|i1", {PJRT_Buffer_Type_S8, 1}},   {"int8", {PJRT_Buffer_Type_S8, 1}},
+      {"|u1", {PJRT_Buffer_Type_U8, 1}},   {"uint8", {PJRT_Buffer_Type_U8, 1}},
+      {"|b1", {PJRT_Buffer_Type_PRED, 1}}, {"bool", {PJRT_Buffer_Type_PRED, 1}},
+  };
+  auto it = table.find(d);
+  if (it == table.end()) return Status::Err("unsupported dtype " + d);
+  *out = it->second;
+  return Status::Ok();
+}
+
+// ------------------------------------------------------------ PJRT glue ---
+struct PjrtRuntime {
+  void* dl = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+
+  std::string ErrMsg(PJRT_Error* err) {
+    PJRT_Error_Message_Args m{PJRT_Error_Message_Args_STRUCT_SIZE, nullptr,
+                              err};
+    api->PJRT_Error_Message(&m);
+    std::string s(m.message, m.message_size);
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr,
+                              err};
+    api->PJRT_Error_Destroy(&d);
+    return s;
+  }
+
+  Status Init(const std::string& plugin_path) {
+    dl = dlopen(plugin_path.c_str(), RTLD_NOW | RTLD_LOCAL);
+    if (!dl) return Status::Err(std::string("dlopen: ") + dlerror());
+    auto get = (const PJRT_Api* (*)())dlsym(dl, "GetPjrtApi");
+    if (!get) return Status::Err("plugin has no GetPjrtApi symbol");
+    api = get();
+    PJRT_Plugin_Initialize_Args init{PJRT_Plugin_Initialize_Args_STRUCT_SIZE,
+                                     nullptr};
+    if (auto* err = api->PJRT_Plugin_Initialize(&init))
+      return Status::Err("plugin init: " + ErrMsg(err));
+    PJRT_Client_Create_Args args{PJRT_Client_Create_Args_STRUCT_SIZE,
+                                 nullptr};
+    if (auto* err = api->PJRT_Client_Create(&args))
+      return Status::Err("client create: " + ErrMsg(err));
+    client = args.client;
+    return Status::Ok();
+  }
+
+  ~PjrtRuntime() {
+    if (client && api) {
+      PJRT_Client_Destroy_Args d{PJRT_Client_Destroy_Args_STRUCT_SIZE,
+                                 nullptr, client};
+      api->PJRT_Client_Destroy(&d);
+    }
+    if (dl) dlclose(dl);
+  }
+};
+
+// ------------------------------------------------------------- predictor --
+struct Predictor {
+  std::string last_error;
+  std::vector<std::string> feed_names, fetch_names, arg_order;
+  std::map<std::string, std::string> feed_dtypes;
+  std::map<std::string, NpyArray> params;
+  std::string mlir_bc;
+
+  std::unique_ptr<PjrtRuntime> rt;
+  PJRT_LoadedExecutable* exec = nullptr;
+  std::vector<PJRT_Buffer*> param_buffers;  // device-resident params
+  // last run outputs
+  std::vector<std::vector<uint8_t>> out_data;
+  std::vector<std::vector<int64_t>> out_dims;
+  std::vector<std::string> out_dtypes;
+
+  Status LoadArtifact(const std::string& dir) {
+    std::ifstream mf(dir + "/manifest.json");
+    if (!mf) return Status::Err("cannot open manifest.json in " + dir);
+    std::stringstream ss;
+    ss << mf.rdbuf();
+    std::string text = ss.str();
+    JsonParser jp{text.c_str(), text.c_str() + text.size()};
+    Json m = jp.parse();
+    if (jp.fail || m.kind != Json::kObj)
+      return Status::Err("manifest.json parse error");
+    const Json* fmt = m.find("format");
+    if (!fmt || fmt->str != "stablehlo+npz/v2")
+      return Status::Err("C++ predictor needs format stablehlo+npz/v2, got " +
+                         (fmt ? fmt->str : "<missing>"));
+    for (auto* key : {"feed_target_names", "fetch_target_names", "arg_order"}) {
+      if (!m.find(key)) return Status::Err(std::string("manifest missing ") + key);
+    }
+    for (auto& j : m.find("feed_target_names")->arr)
+      feed_names.push_back(j.str);
+    for (auto& j : m.find("fetch_target_names")->arr)
+      fetch_names.push_back(j.str);
+    for (auto& j : m.find("arg_order")->arr) arg_order.push_back(j.str);
+    if (const Json* fd = m.find("feed_dtypes"))
+      for (auto& kv : fd->obj) feed_dtypes[kv.first] = kv.second.str;
+    Status st = ReadNpz(dir + "/params.npz", &params);
+    if (!st.ok) return st;
+    std::ifstream bc(dir + "/program.mlir.bc", std::ios::binary);
+    if (!bc) return Status::Err("cannot open program.mlir.bc");
+    std::stringstream bs;
+    bs << bc.rdbuf();
+    mlir_bc = bs.str();
+    return Status::Ok();
+  }
+
+  Status Compile(const std::string& plugin_path) {
+    rt = std::make_unique<PjrtRuntime>();
+    Status st = rt->Init(plugin_path);
+    if (!st.ok) return st;
+    PJRT_Program prog{PJRT_Program_STRUCT_SIZE, nullptr};
+    prog.code = const_cast<char*>(mlir_bc.data());
+    prog.code_size = mlir_bc.size();
+    static const char kFmt[] = "mlir";
+    prog.format = kFmt;
+    prog.format_size = sizeof(kFmt) - 1;
+    PJRT_Client_Compile_Args args{PJRT_Client_Compile_Args_STRUCT_SIZE,
+                                  nullptr};
+    args.client = rt->client;
+    args.program = &prog;
+    // empty CompileOptionsProto: all-defaults serialization is 0 bytes is
+    // invalid for some plugins; a minimal valid proto is field 3
+    // (executable_build_options) absent → empty message works in practice
+    static const char kEmpty[] = "";
+    args.compile_options = kEmpty;
+    args.compile_options_size = 0;
+    if (auto* err = rt->api->PJRT_Client_Compile(&args))
+      return Status::Err("compile: " + rt->ErrMsg(err));
+    exec = args.executable;
+    // push params to device once, in arg order
+    for (auto& spec : arg_order) {
+      if (spec.rfind("param:", 0) != 0) continue;
+      auto it = params.find(spec.substr(6));
+      if (it == params.end())
+        return Status::Err("missing param " + spec.substr(6));
+      PJRT_Buffer* buf = nullptr;
+      st = HostToDevice(it->second.dtype, it->second.shape,
+                        it->second.data.data(), &buf);
+      if (!st.ok) return st;
+      param_buffers.push_back(buf);
+    }
+    return Status::Ok();
+  }
+
+  Status HostToDevice(const std::string& dtype,
+                      const std::vector<int64_t>& dims, const void* data,
+                      PJRT_Buffer** out) {
+    DtypeInfo di;
+    Status st = DtypeFromNumpy(dtype, &di);
+    if (!st.ok) return st;
+    PJRT_Client_Devices_Args d{PJRT_Client_Devices_Args_STRUCT_SIZE, nullptr,
+                               rt->client};
+    rt->api->PJRT_Client_Devices(&d);
+    if (d.num_devices == 0) return Status::Err("no PJRT devices");
+    PJRT_Client_BufferFromHostBuffer_Args a{
+        PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE, nullptr};
+    a.client = rt->client;
+    a.data = data;
+    a.type = di.type;
+    a.dims = dims.data();
+    a.num_dims = dims.size();
+    a.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    a.device = d.devices[0];
+    if (auto* err = rt->api->PJRT_Client_BufferFromHostBuffer(&a))
+      return Status::Err("h2d: " + rt->ErrMsg(err));
+    // wait for the copy before the host buffer may go away
+    PJRT_Event_Await_Args w{PJRT_Event_Await_Args_STRUCT_SIZE, nullptr,
+                            a.done_with_host_buffer};
+    rt->api->PJRT_Event_Await(&w);
+    PJRT_Event_Destroy_Args ed{PJRT_Event_Destroy_Args_STRUCT_SIZE, nullptr,
+                               a.done_with_host_buffer};
+    rt->api->PJRT_Event_Destroy(&ed);
+    *out = a.buffer;
+    return Status::Ok();
+  }
+
+  Status Run(const std::map<std::string, const void*>& feeds,
+             const std::map<std::string, std::vector<int64_t>>& feed_dims) {
+    if (!exec) return Status::Err("predictor not compiled (no PJRT plugin?)");
+    std::vector<PJRT_Buffer*> args_bufs;
+    std::vector<PJRT_Buffer*> feed_bufs;
+    size_t pi = 0;
+    for (auto& spec : arg_order) {
+      if (spec.rfind("param:", 0) == 0) {
+        args_bufs.push_back(param_buffers[pi++]);
+      } else {
+        std::string name = spec.substr(5);
+        auto it = feeds.find(name);
+        if (it == feeds.end()) return Status::Err("missing feed " + name);
+        auto dt = feed_dtypes.count(name) ? feed_dtypes[name] : "float32";
+        PJRT_Buffer* buf = nullptr;
+        Status st = HostToDevice(dt, feed_dims.at(name), it->second, &buf);
+        if (!st.ok) return st;
+        feed_bufs.push_back(buf);
+        args_bufs.push_back(buf);
+      }
+    }
+    PJRT_ExecuteOptions opts{PJRT_ExecuteOptions_STRUCT_SIZE, nullptr};
+    PJRT_LoadedExecutable_Execute_Args ex{
+        PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE, nullptr};
+    ex.executable = exec;
+    ex.options = &opts;
+    PJRT_Buffer** arg_list = args_bufs.data();
+    PJRT_Buffer* const* const* al = &arg_list;
+    ex.argument_lists = const_cast<PJRT_Buffer* const**>(al);
+    ex.num_devices = 1;
+    ex.num_args = args_bufs.size();
+    std::vector<PJRT_Buffer*> outs(fetch_names.size());
+    PJRT_Buffer** out_list = outs.data();
+    PJRT_Buffer** const* ol = &out_list;
+    ex.output_lists = const_cast<PJRT_Buffer** const*>(ol);
+    ex.device_complete_events = nullptr;
+    ex.execute_device = nullptr;
+    if (auto* err = rt->api->PJRT_LoadedExecutable_Execute(&ex))
+      return Status::Err("execute: " + rt->ErrMsg(err));
+    // device → host for each output
+    out_data.assign(outs.size(), {});
+    out_dims.assign(outs.size(), {});
+    out_dtypes.assign(outs.size(), "");
+    for (size_t i = 0; i < outs.size(); i++) {
+      PJRT_Buffer_Dimensions_Args da{PJRT_Buffer_Dimensions_Args_STRUCT_SIZE,
+                                     nullptr, outs[i]};
+      rt->api->PJRT_Buffer_Dimensions(&da);
+      out_dims[i].assign(da.dims, da.dims + da.num_dims);
+      PJRT_Buffer_ElementType_Args ta{
+          PJRT_Buffer_ElementType_Args_STRUCT_SIZE, nullptr, outs[i]};
+      rt->api->PJRT_Buffer_ElementType(&ta);
+      size_t elt = 4;
+      switch (ta.type) {
+        case PJRT_Buffer_Type_F64: case PJRT_Buffer_Type_S64:
+          elt = 8; out_dtypes[i] = ta.type == PJRT_Buffer_Type_F64 ?
+              "float64" : "int64";
+          break;
+        case PJRT_Buffer_Type_S32: out_dtypes[i] = "int32"; break;
+        case PJRT_Buffer_Type_PRED: elt = 1; out_dtypes[i] = "bool"; break;
+        default: out_dtypes[i] = "float32";
+      }
+      size_t n = elt;
+      for (auto dsz : out_dims[i]) n *= dsz;
+      out_data[i].resize(n);
+      PJRT_Buffer_ToHostBuffer_Args ha{
+          PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE, nullptr};
+      ha.src = outs[i];
+      ha.dst = out_data[i].data();
+      ha.dst_size = n;
+      if (auto* err = rt->api->PJRT_Buffer_ToHostBuffer(&ha))
+        return Status::Err("d2h: " + rt->ErrMsg(err));
+      PJRT_Event_Await_Args w{PJRT_Event_Await_Args_STRUCT_SIZE, nullptr,
+                              ha.event};
+      rt->api->PJRT_Event_Await(&w);
+      PJRT_Event_Destroy_Args edd{PJRT_Event_Destroy_Args_STRUCT_SIZE,
+                                  nullptr, ha.event};
+      rt->api->PJRT_Event_Destroy(&edd);
+      PJRT_Buffer_Destroy_Args bd{PJRT_Buffer_Destroy_Args_STRUCT_SIZE,
+                                  nullptr, outs[i]};
+      rt->api->PJRT_Buffer_Destroy(&bd);
+    }
+    for (auto* b : feed_bufs) {
+      PJRT_Buffer_Destroy_Args bd{PJRT_Buffer_Destroy_Args_STRUCT_SIZE,
+                                  nullptr, b};
+      rt->api->PJRT_Buffer_Destroy(&bd);
+    }
+    return Status::Ok();
+  }
+};
+
+}  // namespace
+
+// ----------------------------------------------------------------- C ABI --
+extern "C" {
+
+void* ptpred_load(const char* model_dir) {
+  auto* p = new Predictor();
+  Status st = p->LoadArtifact(model_dir);
+  if (!st.ok) p->last_error = st.message;
+  return p;
+}
+
+int ptpred_ok(void* h) {
+  return static_cast<Predictor*>(h)->last_error.empty() ? 1 : 0;
+}
+
+const char* ptpred_error(void* h) {
+  return static_cast<Predictor*>(h)->last_error.c_str();
+}
+
+int ptpred_compile(void* h, const char* plugin_path) {
+  auto* p = static_cast<Predictor*>(h);
+  Status st = p->Compile(plugin_path);
+  if (!st.ok) { p->last_error = st.message; return 0; }
+  return 1;
+}
+
+int ptpred_num_feeds(void* h) {
+  return (int)static_cast<Predictor*>(h)->feed_names.size();
+}
+const char* ptpred_feed_name(void* h, int i) {
+  return static_cast<Predictor*>(h)->feed_names[i].c_str();
+}
+int ptpred_num_fetches(void* h) {
+  return (int)static_cast<Predictor*>(h)->fetch_names.size();
+}
+const char* ptpred_fetch_name(void* h, int i) {
+  return static_cast<Predictor*>(h)->fetch_names[i].c_str();
+}
+int ptpred_num_params(void* h) {
+  return (int)static_cast<Predictor*>(h)->params.size();
+}
+
+// param introspection (hermetic npz test surface)
+const char* ptpred_param_dtype(void* h, const char* name) {
+  auto& ps = static_cast<Predictor*>(h)->params;
+  auto it = ps.find(name);
+  return it == ps.end() ? "" : it->second.dtype.c_str();
+}
+int ptpred_param_rank(void* h, const char* name) {
+  auto& ps = static_cast<Predictor*>(h)->params;
+  auto it = ps.find(name);
+  return it == ps.end() ? -1 : (int)it->second.shape.size();
+}
+int64_t ptpred_param_dim(void* h, const char* name, int i) {
+  return static_cast<Predictor*>(h)->params[name].shape[i];
+}
+const void* ptpred_param_data(void* h, const char* name, int64_t* nbytes) {
+  auto& a = static_cast<Predictor*>(h)->params[name];
+  *nbytes = (int64_t)a.data.size();
+  return a.data.data();
+}
+
+// run: feeds as flat float32/int buffers in feed_names order
+int ptpred_run(void* h, const void** feed_ptrs, const int64_t* dims,
+               const int* ranks) {
+  auto* p = static_cast<Predictor*>(h);
+  std::map<std::string, const void*> feeds;
+  std::map<std::string, std::vector<int64_t>> fdims;
+  size_t off = 0;
+  for (size_t i = 0; i < p->feed_names.size(); i++) {
+    feeds[p->feed_names[i]] = feed_ptrs[i];
+    fdims[p->feed_names[i]] =
+        std::vector<int64_t>(dims + off, dims + off + ranks[i]);
+    off += ranks[i];
+  }
+  Status st = p->Run(feeds, fdims);
+  if (!st.ok) { p->last_error = st.message; return 0; }
+  return 1;
+}
+
+int ptpred_out_rank(void* h, int i) {
+  return (int)static_cast<Predictor*>(h)->out_dims[i].size();
+}
+int64_t ptpred_out_dim(void* h, int i, int d) {
+  return static_cast<Predictor*>(h)->out_dims[i][d];
+}
+const char* ptpred_out_dtype(void* h, int i) {
+  return static_cast<Predictor*>(h)->out_dtypes[i].c_str();
+}
+const void* ptpred_out_data(void* h, int i, int64_t* nbytes) {
+  auto& d = static_cast<Predictor*>(h)->out_data[i];
+  *nbytes = (int64_t)d.size();
+  return d.data();
+}
+
+void ptpred_destroy(void* h) { delete static_cast<Predictor*>(h); }
+
+}  // extern "C"

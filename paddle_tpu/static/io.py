@@ -52,6 +52,7 @@ def _prune(program: Program, fetch_names: Sequence[str]):
 _MANIFEST = "manifest.json"
 _PARAMS = "params.npz"
 _HLO = "program.stablehlo"
+_MLIR_BC = "program.mlir.bc"
 
 
 def save_persistables(executor: Executor, dirname: str,
@@ -152,8 +153,17 @@ def save_inference_model(dirname: str, feed_target_names: Sequence[str],
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, _HLO), "wb") as f:
         f.write(exported.serialize())
+    # raw StableHLO portable bytecode for non-Python PJRT hosts — the C++
+    # serving predictor (native/src/predictor.cc) compiles this directly
+    # via PJRT_Client_Compile, no jax.export runtime needed
+    with open(os.path.join(dirname, _MLIR_BC), "wb") as f:
+        f.write(exported.mlir_module_serialized)
     np.savez(os.path.join(dirname, _PARAMS),
              **{n: np.asarray(a) for n, a in params.items()})
+    # calling convention for foreign hosts: flattened (params, feeds) —
+    # jax flattens each dict in sorted-key order
+    arg_order = ([f"param:{n}" for n in sorted(params)] +
+                 [f"feed:{n}" for n in sorted(feed_specs)])
     with open(os.path.join(dirname, _MANIFEST), "w") as f:
         json.dump({
             "feed_target_names": list(feed_target_names),
@@ -162,8 +172,11 @@ def save_inference_model(dirname: str, feed_target_names: Sequence[str],
                             if polymorphic else
                             list(feed_specs[n].shape)
                             for n in feed_target_names},
+            "feed_dtypes": {n: np.dtype(feed_specs[n].dtype).name
+                            for n in feed_specs},
+            "arg_order": arg_order,
             "batch_polymorphic": polymorphic,
-            "format": "stablehlo+npz/v1",
+            "format": "stablehlo+npz/v2",
         }, f, indent=1)
 
 
@@ -193,7 +206,8 @@ def load_inference_model(dirname: str) -> InferencePredictor:
     fetches); here: a ready predictor over the StableHLO artifact."""
     with open(os.path.join(dirname, _MANIFEST)) as f:
         manifest = json.load(f)
-    enforce(manifest.get("format") == "stablehlo+npz/v1",
+    enforce(manifest.get("format") in ("stablehlo+npz/v1",
+                                       "stablehlo+npz/v2"),
             "unknown inference-model format %s", manifest.get("format"))
     with open(os.path.join(dirname, _HLO), "rb") as f:
         exported = jax.export.deserialize(bytearray(f.read()))
