@@ -32,6 +32,8 @@ _LAZY = {
     "quant": ".quant",
     "amp": ".amp",
     "fleet": ".fleet",
+    "debug": ".debug",
+    "install_check": ".install_check",
 }
 
 

@@ -28,6 +28,8 @@ from .core.config import DistributeConfig
 from .core.enforce import enforce
 from .core.mesh import build_mesh, get_mesh, set_mesh
 
+__all__ = ["RoleMaker", "DistributedStrategy", "Fleet", "init", "instance"]
+
 
 @dataclass
 class RoleMaker:

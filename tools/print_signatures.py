@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Print the frozen public-API signature surface (reference:
+tools/print_signatures.py + paddle/fluid/API.spec — CI diffs the output
+against the spec file so accidental API breaks fail fast).
+
+Usage:
+  python tools/print_signatures.py             # print current surface
+  python tools/print_signatures.py --update    # rewrite API.spec
+  python tools/print_signatures.py --check     # diff vs API.spec, exit 1 on drift
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# modules whose public surface is frozen
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.nn",
+    "paddle_tpu.ops",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.parallel",
+    "paddle_tpu.static",
+    "paddle_tpu.data",
+    "paddle_tpu.metrics",
+    "paddle_tpu.initializer",
+    "paddle_tpu.checkpoint",
+    "paddle_tpu.amp",
+    "paddle_tpu.quant",
+    "paddle_tpu.fleet",
+]
+
+SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "API.spec")
+
+
+def _sig(obj) -> str:
+    import re
+
+    try:
+        s = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+    # object reprs embed memory addresses — strip for determinism
+    return re.sub(r" at 0x[0-9a-f]+", "", s)
+
+
+def collect() -> list:
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(names):
+            try:
+                obj = getattr(mod, name)
+            except AttributeError:
+                continue
+            if inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{modname}.{name} class{_sig(obj.__init__)}")
+                for m, meth in sorted(vars(obj).items()):
+                    if m.startswith("_") or not callable(meth):
+                        continue
+                    lines.append(f"{modname}.{name}.{m} method{_sig(meth)}")
+            elif callable(obj):
+                lines.append(f"{modname}.{name} function{_sig(obj)}")
+            else:
+                lines.append(f"{modname}.{name} value:{type(obj).__name__}")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args(argv)
+    lines = collect()
+    if args.update:
+        with open(SPEC_PATH, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} signatures to {SPEC_PATH}")
+        return 0
+    if args.check:
+        if not os.path.exists(SPEC_PATH):
+            print("API.spec missing — run with --update first")
+            return 1
+        with open(SPEC_PATH) as f:
+            frozen = f.read().splitlines()
+        cur, ref = set(lines), set(frozen)
+        removed = sorted(ref - cur)
+        added = sorted(cur - ref)
+        if removed or added:
+            for l in removed:
+                print(f"- {l}")
+            for l in added:
+                print(f"+ {l}")
+            print(f"\nAPI drift: {len(removed)} removed/changed, "
+                  f"{len(added)} added. If intentional, re-run with "
+                  f"--update and commit API.spec.")
+            return 1
+        print(f"API surface matches spec ({len(lines)} signatures)")
+        return 0
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

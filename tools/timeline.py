@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Merge profiler dumps into one chrome://tracing timeline
+(reference: tools/timeline.py:131 — converts profile protos from N devices
+into a single chrome-trace JSON with per-device lanes).
+
+Our profiler (paddle_tpu.core.profiler) already emits chrome-trace events;
+this tool merges dumps from multiple processes/ranks into one file with
+distinct process lanes, the multi-device view the reference built from
+CUPTI protos.
+
+Usage:
+  python tools/timeline.py --output merged.json rank0.json rank1.json ...
+  python tools/timeline.py --output merged.json 'profile_dir/*.json'
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+
+
+def load_events(path):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):  # full chrome trace {"traceEvents": [...]}
+        return data.get("traceEvents", [])
+    return data
+
+
+def merge(paths, align: bool = True):
+    merged = []
+    for rank, path in enumerate(paths):
+        events = load_events(path)
+        t0 = min((e["ts"] for e in events if "ts" in e), default=0)
+        for e in events:
+            e = dict(e)
+            e["pid"] = rank  # one process lane per dump
+            if align and "ts" in e:
+                e["ts"] = e["ts"] - t0  # common zero so lanes line up
+            merged.append(e)
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank{rank}:{path}"}})
+    merged.sort(key=lambda e: e.get("ts", 0))
+    return merged
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("inputs", nargs="+",
+                    help="profiler JSON dumps (globs ok)")
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--no-align", action="store_true",
+                    help="keep absolute timestamps")
+    args = ap.parse_args(argv)
+    paths = []
+    for pat in args.inputs:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    events = merge(paths, align=not args.no_align)
+    with open(args.output, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    print(f"wrote {len(events)} events from {len(paths)} dumps "
+          f"to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
