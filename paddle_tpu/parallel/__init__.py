@@ -9,6 +9,8 @@ from .context_parallel import (context_parallel_attention, ring_attention,
                                ulysses_attention)
 from .collective import (allgather, allreduce, all_to_all, axis_index,
                          broadcast, ppermute, reduce_scatter)
+from .dgc import (DGCMomentum, dgc_allreduce, quantized_allreduce,
+                  top_k_sparsify)
 from .pipeline import GPipe, pipeline_apply, stage_param_sharding
 from .sharded_embedding import (ShardedEmbedding, embedding_ep_rules,
                                 sharded_embedding_lookup)
@@ -23,4 +25,5 @@ __all__ = [
     "ShardedEmbedding", "embedding_ep_rules", "sharded_embedding_lookup",
     "OptStateRules", "constraint", "infer_param_spec", "shard_params",
     "transformer_tp_rules", "zero_dp_rules",
+    "DGCMomentum", "dgc_allreduce", "quantized_allreduce", "top_k_sparsify",
 ]
