@@ -213,3 +213,139 @@ def chunk_eval(*args, **kwargs):
     raise NotImplementedError(
         "chunk_eval (reference: operators/metrics/chunk_eval... sequence "
         "chunking F1) lands with the NLP tagging models")
+
+
+def mean_iou(pred, label, num_classes: int):
+    """reference: operators/mean_iou_op.cc — mean intersection-over-union
+    over classes present in pred or label. Returns (mean_iou, per-class
+    intersection, per-class union)."""
+    import jax
+
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    onehot_p = jax.nn.one_hot(pred, num_classes)
+    onehot_l = jax.nn.one_hot(label, num_classes)
+    inter = jnp.sum(onehot_p * onehot_l, axis=0)
+    union = jnp.sum(onehot_p, axis=0) + jnp.sum(onehot_l, axis=0) - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    return miou, inter, union
+
+
+def precision_recall(pred_probs, label, num_classes: int):
+    """reference: operators/metrics/precision_recall_op.cc — per-class and
+    macro/micro precision/recall/F1 from argmax predictions. Returns a dict
+    of scalars + per-class (tp, fp, fn)."""
+    import jax
+
+    pred = jnp.argmax(pred_probs, axis=-1)
+    onehot_p = jax.nn.one_hot(pred, num_classes)
+    onehot_l = jax.nn.one_hot(label.reshape(-1), num_classes)
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p * (1 - onehot_l), axis=0)
+    fn = jnp.sum((1 - onehot_p) * onehot_l, axis=0)
+    prec = tp / jnp.maximum(tp + fp, 1.0)
+    rec = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-9)
+    micro_p = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fp), 1.0)
+    micro_r = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fn), 1.0)
+    return {
+        "macro_precision": jnp.mean(prec), "macro_recall": jnp.mean(rec),
+        "macro_f1": jnp.mean(f1), "micro_precision": micro_p,
+        "micro_recall": micro_r,
+        "micro_f1": 2 * micro_p * micro_r / jnp.maximum(
+            micro_p + micro_r, 1e-9),
+        "tp": tp, "fp": fp, "fn": fn,
+    }
+
+
+def positive_negative_pair(score, label, query_id):
+    """reference: operators/metrics/positive_negative_pair_op.cc — ranking
+    metric: among same-query item pairs with different labels, count pairs
+    ranked correctly (higher label → higher score), wrong, or tied."""
+    s = score.reshape(-1)
+    l = label.reshape(-1).astype(jnp.float32)
+    q = query_id.reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.triu(jnp.ones((s.size, s.size), jnp.bool_), k=1)
+    valid = same_q & upper & (l[:, None] != l[None, :])
+    sdiff = s[:, None] - s[None, :]
+    ldiff = l[:, None] - l[None, :]
+    pos = jnp.sum(valid & (sdiff * ldiff > 0))
+    neg = jnp.sum(valid & (sdiff * ldiff < 0))
+    neu = jnp.sum(valid & (sdiff == 0))
+    return pos, neg, neu
+
+
+def detection_map(det_boxes, det_scores, det_labels, gt_boxes, gt_labels,
+                  *, num_classes: int, overlap_threshold: float = 0.5):
+    """reference: operators/detection_map_op.cc — mean average precision
+    (11-point interpolated) over classes for one image batch. Dense/static
+    simplification: detections (D, 4)+(D,)+(D,); gts (G, 4)+(G,); padded
+    entries have label < 0."""
+    from .ops.detection import iou_similarity
+    import numpy as np_  # host-side: mAP is an eval-time metric
+
+    det_boxes = np_.asarray(det_boxes)
+    det_scores = np_.asarray(det_scores)
+    det_labels = np_.asarray(det_labels)
+    gt_boxes = np_.asarray(gt_boxes)
+    gt_labels = np_.asarray(gt_labels)
+    aps = []
+    for c in range(num_classes):
+        d_idx = np_.where(det_labels == c)[0]
+        g_idx = np_.where(gt_labels == c)[0]
+        if len(g_idx) == 0:
+            continue
+        order = d_idx[np_.argsort(-det_scores[d_idx])]
+        matched = set()
+        tp = np_.zeros(len(order))
+        fp = np_.zeros(len(order))
+        for i, di in enumerate(order):
+            if len(g_idx):
+                ious = np_.asarray(iou_similarity(
+                    det_boxes[di:di + 1], gt_boxes[g_idx]))[0]
+                j = int(np_.argmax(ious))
+                if ious[j] >= overlap_threshold and j not in matched:
+                    tp[i] = 1
+                    matched.add(j)
+                else:
+                    fp[i] = 1
+            else:
+                fp[i] = 1
+        ctp = np_.cumsum(tp)
+        cfp = np_.cumsum(fp)
+        rec = ctp / len(g_idx)
+        prec = ctp / np_.maximum(ctp + cfp, 1e-9)
+        ap = 0.0
+        for t in np_.linspace(0, 1, 11):
+            p = prec[rec >= t].max() if np_.any(rec >= t) else 0.0
+            ap += p / 11
+        aps.append(ap)
+    return float(np_.mean(aps)) if aps else 0.0
+
+
+class DetectionMAP(MetricBase):
+    """reference: python/paddle/fluid/metrics.py DetectionMAP accumulator."""
+
+    def __init__(self, num_classes: int, overlap_threshold: float = 0.5,
+                 name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.overlap_threshold = overlap_threshold
+        self.reset()
+
+    def reset(self):
+        self._maps = []
+
+    def update(self, det_boxes, det_scores, det_labels, gt_boxes, gt_labels):
+        self._maps.append(detection_map(
+            det_boxes, det_scores, det_labels, gt_boxes, gt_labels,
+            num_classes=self.num_classes,
+            overlap_threshold=self.overlap_threshold))
+
+    def eval(self):
+        import numpy as np_
+
+        return float(np_.mean(self._maps)) if self._maps else 0.0

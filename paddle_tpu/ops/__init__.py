@@ -5,8 +5,8 @@ come from JAX VJP (replacing GradOpDescMaker); hand-written kernels live in
 ``paddle_tpu.ops.pallas``.
 """
 
-from . import (control_flow, decode, detection, loss, math, nn, reduction,
-               rnn, sampling, sequence, tensor)
+from . import (control_flow, decode, detection, detection_extra, loss, math,
+               nn, nn_extra, reduction, rnn, sampling, sequence, tensor)
 from .decode import (beam_search, beam_search_step, crf_decoding, ctc_align,
                      ctc_greedy_decode, ctc_loss, edit_distance,
                      linear_chain_crf)
@@ -61,3 +61,32 @@ from .tensor import (arg_max, arg_min, argsort, assign, cast, concat, crop,
                      scatter_nd_add, shape, slice, split, squeeze, stack,
                      top_k, transpose, tril, triu, truncated_gaussian_random,
                      uniform_random, unsqueeze, unstack, where, zeros)
+
+from .nn_extra import (affine_channel, affine_grid, bilinear_interp,
+                       conv3d_transpose, cvm, data_norm,
+                       depthwise_conv2d_transpose, fsp_matrix,
+                       max_pool2d_with_index, max_pool3d_with_index,
+                       nearest_interp, pool3d, similarity_focus, spp,
+                       tree_conv, unpool)
+from .detection_extra import (box_decoder_and_assign,
+                              generate_proposal_labels, mine_hard_examples,
+                              psroi_pool, roi_perspective_transform,
+                              rpn_target_assign, yolov3_loss)
+from .sequence import (add_position_encoding, sequence_reshape,
+                       sequence_scatter)
+
+# --- name aliases: reference op names whose capability lives under a
+# different (or newer-generation) name here -------------------------------
+from .loss import softmax_with_cross_entropy as cross_entropy2  # *2 = stable variant
+from .decode import ctc_loss as warpctc
+from .nn import embedding as lookup_table
+from .nn import l2_normalize as norm
+from .math import elementwise_sub as minus
+from .tensor import arange as range  # noqa: A001 - matches reference name
+from .tensor import fill_constant as fill
+from .tensor import reshape as reshape2
+from .tensor import transpose as transpose2
+from .tensor import flatten as flatten2
+from .tensor import squeeze as squeeze2
+from .tensor import unsqueeze as unsqueeze2
+from .sequence import hash_embedding_ids as hash  # noqa: A001

@@ -226,3 +226,49 @@ def hash_embedding_ids(ids, num_buckets: int, num_hash: int = 1):
         h = (x * jnp.uint32(2654435761) + jnp.uint32(i * 0x9E3779B9))
         outs.append((h % jnp.uint32(num_buckets)).astype(jnp.int32))
     return jnp.stack(outs, axis=-1)
+
+
+def sequence_reshape(x, lengths, new_dim: int):
+    """reference: sequence_ops/sequence_reshape_op.cc — re-chunk each
+    sequence's flattened payload into rows of ``new_dim``. On the padded
+    (B, T, D) layout this is a reshape of the time/feature axes; lengths
+    scale by D/new_dim. Requires T*D % new_dim == 0."""
+    b, t, d = x.shape
+    enforce((t * d) % new_dim == 0,
+            "sequence_reshape: T*D=%s not divisible by new_dim=%s", t * d,
+            new_dim)
+    new_t = t * d // new_dim
+    out = x.reshape(b, new_t, new_dim)
+    new_lengths = (lengths * d) // new_dim
+    return out, new_lengths
+
+
+def sequence_scatter(x, index, updates, lengths=None):
+    """reference: sequence_ops/sequence_scatter_op.cc — add per-sequence
+    updates into x at per-sequence positions. x: (B, D); index: (B, T)
+    positions into D; updates: (B, T); padded steps (>= lengths) ignored."""
+    b, t = index.shape
+    if lengths is not None:
+        mask = (jnp.arange(t)[None, :] < lengths[:, None])
+        updates = updates * mask.astype(updates.dtype)
+    import jax
+
+    def one(row, idx, upd):
+        return row.at[idx].add(upd)
+
+    return jax.vmap(one)(x, index, updates)
+
+
+def add_position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
+    """reference: operators/add_position_encoding_op.cc — y = alpha*x +
+    beta*sinusoid(pos) with the transformer sin/cos interleave."""
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    half = d // 2
+    div = jnp.exp(jnp.arange(half, dtype=x.dtype) *
+                  -(jnp.log(10000.0) / jnp.maximum(half - 1, 1)))
+    ang = pos * div[None, :]
+    enc = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    if enc.shape[-1] < d:  # odd d
+        enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))
+    return alpha * x + beta * enc[None]

@@ -14,13 +14,15 @@ from .lr_scheduler import (CosineDecay, ExponentialDecay, InverseTimeDecay,
                            LinearWarmup, NaturalExpDecay, NoamDecay,
                            PiecewiseDecay, PolynomialDecay)
 from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
-                         DecayedAdagrad, Ftrl, Lamb, LarsMomentum, Momentum,
-                         Optimizer, RMSProp)
+                         DecayedAdagrad, ExponentialMovingAverage, Ftrl,
+                         Lamb, LarsMomentum, Momentum, Optimizer,
+                         ProximalAdagrad, ProximalGD, RMSProp)
 from .loss_scaler import DynamicLossScaler
 
 __all__ = [
     "SGD", "Adadelta", "Adagrad", "Adam", "Adamax", "AdamW", "DecayedAdagrad",
     "Ftrl", "Lamb", "LarsMomentum", "Momentum", "Optimizer", "RMSProp",
+    "ProximalGD", "ProximalAdagrad", "ExponentialMovingAverage",
     "CosineDecay", "ExponentialDecay", "InverseTimeDecay", "LinearWarmup",
     "NaturalExpDecay", "NoamDecay", "PiecewiseDecay", "PolynomialDecay",
     "DynamicLossScaler",

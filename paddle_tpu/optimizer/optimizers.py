@@ -347,3 +347,70 @@ class Lamb(Optimizer):
         u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
         ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         return p - lr.astype(p.dtype) * ratio * update, {"m": m, "v": v}
+
+
+class ProximalGD(Optimizer):
+    """reference: optimizers/proximal_gd_op.cc — SGD with L1/L2 proximal
+    projection: w = prox(w - lr*g)."""
+
+    def __init__(self, learning_rate, l1: float = 0.0, l2: float = 0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2 = l1, l2
+
+    def update_leaf(self, p, g, s, lr, step):
+        prox = p - lr * g
+        if self.l1 > 0:
+            prox = (jnp.sign(prox) *
+                    jnp.maximum(jnp.abs(prox) - lr * self.l1, 0.0))
+        new_p = prox / (1.0 + lr * self.l2)
+        return new_p, s
+
+
+class ProximalAdagrad(Optimizer):
+    """reference: optimizers/proximal_adagrad_op.cc — Adagrad step with the
+    same proximal projection using the adaptive lr."""
+
+    def __init__(self, learning_rate, l1: float = 0.0, l2: float = 0.0,
+                 epsilon: float = 1e-10, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.epsilon = l1, l2, epsilon
+
+    def init_leaf(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        moment = s["moment"] + g * g
+        alr = lr / (jnp.sqrt(moment) + self.epsilon)
+        prox = p - alr * g
+        if self.l1 > 0:
+            prox = (jnp.sign(prox) *
+                    jnp.maximum(jnp.abs(prox) - alr * self.l1, 0.0))
+        new_p = prox / (1.0 + alr * self.l2)
+        return new_p, {"moment": moment}
+
+
+class ExponentialMovingAverage:
+    """Parameter EMA (reference: operators/average_accumulates_op.cc +
+    optimizer.py ModelAverage/EMA capability): shadow = decay*shadow +
+    (1-decay)*param, with bias correction. Functional: state in, state out."""
+
+    def __init__(self, decay: float = 0.999):
+        self.decay = decay
+
+    def init(self, params):
+        return {"shadow": tree_map(jnp.zeros_like, params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def update(self, params, state):
+        count = state["count"] + 1
+        shadow = tree_map(
+            lambda s, p: self.decay * s + (1.0 - self.decay) * p,
+            state["shadow"], params)
+        return {"shadow": shadow, "count": count}
+
+    def average(self, state):
+        """Bias-corrected EMA params."""
+        corr = 1.0 - self.decay ** state["count"].astype(jnp.float32)
+        return tree_map(lambda s: s / jnp.maximum(corr, 1e-12),
+                        state["shadow"])

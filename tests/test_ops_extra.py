@@ -1,0 +1,339 @@
+"""Tests for the Appendix-A gap-fill ops: extra NN ops, detection additions,
+metric ops, proximal/EMA optimizers, sequence additions, and aliases."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import metrics as M
+from paddle_tpu import ops as O
+
+RNG = np.random.default_rng(51)
+
+
+def u(shape, lo=-1.0, hi=1.0):
+    return RNG.uniform(lo, hi, shape).astype(np.float32)
+
+
+class TestPooling:
+    def test_pool3d_max_matches_numpy(self):
+        x = u((1, 2, 4, 4, 4))
+        out = O.pool3d(jnp.asarray(x), 2, "max", stride=2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_pool3d_avg(self):
+        x = u((1, 1, 2, 2, 2))
+        out = O.pool3d(jnp.asarray(x), 2, "avg")
+        np.testing.assert_allclose(float(out.reshape(())), x.mean(),
+                                   rtol=1e-6)
+
+    def test_max_pool2d_with_index_and_unpool_roundtrip(self):
+        x = u((2, 3, 4, 4))
+        out, idx = O.max_pool2d_with_index(jnp.asarray(x), 2, stride=2)
+        assert out.shape == (2, 3, 2, 2) and idx.dtype == jnp.int32
+        # indices point at the argmax: gathering must reproduce out
+        flat = x.reshape(2, 3, 16)
+        gathered = np.take_along_axis(flat, np.asarray(idx).reshape(2, 3, 4),
+                                      axis=2)
+        np.testing.assert_allclose(gathered.reshape(out.shape), out,
+                                   rtol=1e-6)
+        # unpool scatters back: sum preserved, positions correct
+        restored = O.unpool(out, idx, (4, 4))
+        np.testing.assert_allclose(np.asarray(restored).sum(),
+                                   np.asarray(out).sum(), rtol=1e-5)
+        assert np.count_nonzero(np.asarray(restored)) <= 2 * 3 * 4
+
+    def test_spp_shape(self):
+        x = u((2, 3, 8, 8))
+        out = O.spp(jnp.asarray(x), pyramid_height=3)
+        assert out.shape == (2, 3 * (1 + 4 + 16))
+
+
+class TestAffine:
+    def test_affine_channel(self):
+        x = u((2, 3, 4, 4))
+        s, b = u((3,)), u((3,))
+        out = O.affine_channel(jnp.asarray(x), jnp.asarray(s), jnp.asarray(b))
+        ref = x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_affine_grid_identity(self):
+        theta = jnp.asarray(np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]],
+                                             np.float32), (2, 1, 1)))
+        grid = O.affine_grid(theta, (2, 3, 4, 5))
+        assert grid.shape == (2, 4, 5, 2)
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(grid[0, -1, -1], [1, 1], atol=1e-6)
+
+
+class TestConvTranspose3D:
+    def test_conv3d_transpose_shape_and_grad(self):
+        x = u((1, 2, 3, 3, 3))
+        w = u((2, 4, 2, 2, 2), -0.3, 0.3)
+        out = O.conv3d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2)
+        assert out.shape[:2] == (1, 4)
+        g = jax.grad(lambda a: jnp.sum(
+            O.conv3d_transpose(a, jnp.asarray(w), stride=2) ** 2))(
+            jnp.asarray(x))
+        assert np.all(np.isfinite(g))
+
+    def test_depthwise_transpose_matches_per_channel(self):
+        x = u((1, 3, 4, 4))
+        w = u((3, 1, 2, 2))
+        out = O.depthwise_conv2d_transpose(jnp.asarray(x), jnp.asarray(w),
+                                           stride=2)
+        assert out.shape == (1, 3, 8, 8)
+        # channel 0 result == transpose conv of channel 0 alone
+        single = jax.lax.conv_transpose(
+            jnp.asarray(x[:, :1]), jnp.asarray(w[:1]), strides=(2, 2),
+            padding="VALID",
+            dimension_numbers=("NCHW", "IOHW", "NCHW"))
+        np.testing.assert_allclose(out[:, 0], single[:, 0], rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestMiscNN:
+    def test_data_norm(self):
+        x = u((8, 3))
+        size = np.full((3,), 100.0, np.float32)
+        s = u((3,)) * 10
+        sq = np.abs(u((3,))) * 100 + (s / 100) ** 2 * 100 + 1.0
+        out = O.data_norm(jnp.asarray(x), jnp.asarray(size), jnp.asarray(s),
+                          jnp.asarray(sq))
+        mean = s / 100
+        var = sq / 100 - mean ** 2
+        np.testing.assert_allclose(out, (x - mean) / np.sqrt(var + 1e-4),
+                                   rtol=1e-4)
+
+    def test_fsp_matrix(self):
+        x, y = u((2, 3, 4, 4)), u((2, 5, 4, 4))
+        out = O.fsp_matrix(jnp.asarray(x), jnp.asarray(y))
+        assert out.shape == (2, 3, 5)
+        ref = np.einsum("nchw,ndhw->ncd", x, y) / 16
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_cvm(self):
+        x = np.abs(u((4, 6)))
+        out = O.cvm(jnp.asarray(x))
+        np.testing.assert_allclose(out[:, 0], np.log(x[:, 0] + 1), rtol=1e-5)
+        out2 = O.cvm(jnp.asarray(x), use_cvm=False)
+        assert out2.shape == (4, 4)
+
+    def test_similarity_focus_marks_argmax(self):
+        x = u((1, 2, 3, 3))
+        mask = O.similarity_focus(jnp.asarray(x), axis=1, indexes=[0])
+        assert mask.shape == x.shape
+        m = np.asarray(mask[0, 0])
+        assert m.max() == 1.0 and m.sum() >= 3  # at least one per row/col
+
+    def test_tree_conv(self):
+        nodes = u((4, 3))
+        edges = np.zeros((4, 4), np.float32)
+        edges[1, 0] = edges[2, 0] = edges[3, 1] = 1.0  # children -> parent
+        w = u((3, 3, 2))
+        out = O.tree_conv(jnp.asarray(nodes), jnp.asarray(edges),
+                          jnp.asarray(w), max_depth=2)
+        ref = nodes @ w[0] + (edges @ nodes) @ w[1] + \
+            (edges @ edges @ nodes) @ w[2]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_interp_aliases(self):
+        x = u((1, 1, 4, 4))
+        assert O.bilinear_interp(jnp.asarray(x), (8, 8)).shape == (1, 1, 8, 8)
+        assert O.nearest_interp(jnp.asarray(x), (2, 2)).shape == (1, 1, 2, 2)
+
+
+class TestDetectionExtra:
+    def test_psroi_pool_uniform_input(self):
+        # constant input per group-channel: every bin pools that constant
+        c_out, ph, pw = 2, 2, 2
+        x = np.zeros((1, c_out * ph * pw, 6, 6), np.float32)
+        for ch in range(c_out * ph * pw):
+            x[0, ch] = ch
+        rois = np.array([[0, 0, 0, 6, 6]], np.float32)
+        out = O.psroi_pool(jnp.asarray(x), jnp.asarray(rois),
+                           output_size=(ph, pw))
+        assert out.shape == (1, c_out, ph, pw)
+        # bin (i,j), out channel k pools input channel (i*pw+j)*c_out+k
+        for i in range(ph):
+            for j in range(pw):
+                for k in range(c_out):
+                    assert float(out[0, k, i, j]) == (i * pw + j) * c_out + k
+
+    def test_roi_perspective_transform_axis_aligned(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        # axis-aligned quad == the whole image corners
+        rois = np.array([[0, 0, 0, 3, 0, 3, 3, 0, 3]], np.float32)
+        out = O.roi_perspective_transform(jnp.asarray(x), jnp.asarray(rois),
+                                          transformed_height=4,
+                                          transformed_width=4)
+        np.testing.assert_allclose(out[0, 0], x[0, 0], atol=1e-4)
+
+    def test_rpn_target_assign(self):
+        anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                            [100, 100, 110, 110]], np.float32)
+        gt = np.array([[1, 1, 9, 9]], np.float32)
+        labels, matched = O.rpn_target_assign(
+            jnp.asarray(anchors), jnp.asarray(gt))
+        assert int(labels[0]) == 1   # high IoU or best anchor
+        assert int(labels[1]) == 0   # no overlap -> background
+        assert int(matched[0]) == 0
+
+    def test_mine_hard_examples(self):
+        loss = np.array([[5.0, 4.0, 3.0, 2.0, 1.0]], np.float32)
+        labels = np.array([[1, 0, 0, 0, 0]], np.int32)
+        mask = O.mine_hard_examples(jnp.asarray(loss), jnp.asarray(labels),
+                                    neg_pos_ratio=2.0)
+        np.testing.assert_array_equal(np.asarray(mask[0]),
+                                      [1, 1, 1, 0, 0])
+
+    def test_box_decoder_and_assign(self):
+        prior = np.array([[0, 0, 10, 10]], np.float32)
+        var = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
+        deltas = np.zeros((1, 8), np.float32)  # 2 classes, zero deltas
+        score = np.array([[0.2, 0.8]], np.float32)
+        decoded, assigned = O.box_decoder_and_assign(
+            jnp.asarray(prior), jnp.asarray(var), jnp.asarray(deltas),
+            jnp.asarray(score))
+        np.testing.assert_allclose(assigned[0], [0, 0, 10, 10], atol=1e-5)
+
+    def test_generate_proposal_labels(self):
+        rois = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+        gt = np.array([[0, 0, 10, 10]], np.float32)
+        cls = np.array([3], np.int32)
+        labels, matched, fg = O.generate_proposal_labels(
+            jnp.asarray(rois), jnp.asarray(gt), jnp.asarray(cls))
+        assert int(labels[0]) == 3 and int(labels[1]) == 0
+        assert bool(fg[0]) and not bool(fg[1])
+
+    def test_yolov3_loss_finite_and_grad(self):
+        n, a, c, h, w = 2, 3, 4, 4, 4
+        x = u((n, a * (5 + c), h, w), -0.5, 0.5)
+        gt_box = np.array([[[0.5, 0.5, 0.3, 0.4], [0, 0, 0, 0]],
+                           [[0.25, 0.25, 0.5, 0.5], [0.7, 0.7, 0.2, 0.2]]],
+                          np.float32)
+        gt_label = np.array([[1, 0], [2, 3]], np.int32)
+        anchors = [10, 13, 16, 30, 33, 23]
+        kw = dict(anchors=anchors, anchor_mask=[0, 1, 2], class_num=c,
+                  downsample_ratio=8)
+        loss = O.yolov3_loss(jnp.asarray(x), jnp.asarray(gt_box),
+                             jnp.asarray(gt_label), **kw)
+        assert np.isfinite(float(loss))
+        g = jax.grad(lambda v: O.yolov3_loss(
+            v, jnp.asarray(gt_box), jnp.asarray(gt_label), **kw))(
+            jnp.asarray(x))
+        assert np.all(np.isfinite(g))
+
+
+class TestMetricOps:
+    def test_mean_iou_perfect_and_half(self):
+        pred = np.array([0, 1, 1, 0])
+        miou, inter, union = M.mean_iou(jnp.asarray(pred), jnp.asarray(pred),
+                                        2)
+        assert float(miou) == 1.0
+        miou2, _, _ = M.mean_iou(jnp.asarray(pred),
+                                 jnp.asarray(np.array([0, 1, 0, 1])), 2)
+        assert 0 < float(miou2) < 1
+
+    def test_precision_recall(self):
+        probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]],
+                         np.float32)
+        label = np.array([0, 1, 1, 1])
+        out = M.precision_recall(jnp.asarray(probs), jnp.asarray(label), 2)
+        # class0: pred {0,2}, true {0} -> tp=1 fp=1 fn=0
+        np.testing.assert_allclose(np.asarray(out["tp"]), [1, 2])
+        np.testing.assert_allclose(np.asarray(out["fp"]), [1, 0])
+        np.testing.assert_allclose(np.asarray(out["fn"]), [0, 1])
+        assert 0.5 < float(out["micro_f1"]) < 1.0
+
+    def test_positive_negative_pair(self):
+        score = np.array([0.9, 0.1, 0.5, 0.4], np.float32)
+        label = np.array([1, 0, 1, 0], np.float32)
+        qid = np.array([0, 0, 1, 1])
+        pos, neg, neu = M.positive_negative_pair(
+            jnp.asarray(score), jnp.asarray(label), jnp.asarray(qid))
+        assert int(pos) == 2 and int(neg) == 0 and int(neu) == 0
+
+    def test_detection_map(self):
+        det = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        det_l = np.array([0, 0])
+        gt = np.array([[0, 0, 10, 10]], np.float32)
+        gt_l = np.array([0])
+        v = M.detection_map(det, scores, det_l, gt, gt_l, num_classes=1)
+        assert 0.9 < v <= 1.0 + 1e-9  # perfect first det, one fp
+
+
+class TestSequenceExtra:
+    def test_sequence_reshape(self):
+        x = u((2, 4, 6))
+        out, lens = O.sequence_reshape(jnp.asarray(x),
+                                       jnp.asarray(np.array([4, 2])), 3)
+        assert out.shape == (2, 8, 3)
+        np.testing.assert_array_equal(np.asarray(lens), [8, 4])
+
+    def test_sequence_scatter(self):
+        x = np.zeros((2, 5), np.float32)
+        idx = np.array([[0, 2], [1, 1]])
+        upd = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        out = O.sequence_scatter(jnp.asarray(x), jnp.asarray(idx),
+                                 jnp.asarray(upd),
+                                 lengths=jnp.asarray(np.array([2, 1])))
+        np.testing.assert_allclose(np.asarray(out[0]), [1, 0, 2, 0, 0])
+        np.testing.assert_allclose(np.asarray(out[1]), [0, 3, 0, 0, 0])
+
+    def test_add_position_encoding(self):
+        x = u((2, 6, 8))
+        out = O.add_position_encoding(jnp.asarray(x), alpha=2.0, beta=0.0)
+        np.testing.assert_allclose(out, 2.0 * x, rtol=1e-6)
+        out2 = O.add_position_encoding(jnp.asarray(np.zeros_like(x)),
+                                       alpha=1.0, beta=1.0)
+        assert float(jnp.max(jnp.abs(out2))) <= 1.0  # pure sinusoid
+
+
+class TestProximalAndEMA:
+    def test_proximal_gd_l1_shrinks_to_zero(self):
+        from paddle_tpu.optimizer import ProximalGD
+
+        opt = ProximalGD(0.1, l1=10.0)
+        params = {"w": jnp.asarray(np.array([0.5, -0.5], np.float32))}
+        state = opt.init(params)
+        p, _ = opt.apply(params, {"w": jnp.zeros(2)}, state)
+        np.testing.assert_allclose(p["w"], 0.0, atol=1e-7)  # l1 prox kills
+
+    def test_proximal_adagrad_converges(self):
+        from paddle_tpu.optimizer import ProximalAdagrad
+
+        opt = ProximalAdagrad(0.5, l2=0.01)
+        target = jnp.asarray(u((8,)))
+        params = {"w": jnp.zeros(8)}
+        state = opt.init(params)
+        for _ in range(100):
+            g = {"w": 2 * (params["w"] - target)}
+            params, state = opt.apply(params, g, state)
+        np.testing.assert_allclose(params["w"], target, atol=0.05)
+
+    def test_ema(self):
+        from paddle_tpu.optimizer import ExponentialMovingAverage
+
+        ema = ExponentialMovingAverage(0.9)
+        params = {"w": jnp.ones(3)}
+        state = ema.init(params)
+        for _ in range(5):
+            state = ema.update(params, state)
+        avg = ema.average(state)
+        np.testing.assert_allclose(avg["w"], 1.0, rtol=1e-5)  # constant
+
+
+class TestAliases:
+    def test_alias_bindings(self):
+        assert O.warpctc is O.ctc_loss
+        assert O.lookup_table is O.embedding
+        assert O.reshape2 is O.reshape
+        assert O.cross_entropy2 is O.softmax_with_cross_entropy
+        x = u((2, 3))
+        np.testing.assert_allclose(O.minus(jnp.asarray(x), jnp.asarray(x)),
+                                   0.0, atol=1e-7)

@@ -1,0 +1,45 @@
+"""sync_batch_norm capability check (reference:
+operators/sync_batch_norm_op.cu.cc — cross-GPU BN statistics over NCCL).
+
+On TPU this op needs no kernel: batch_norm under jit on a dp-sharded batch
+computes mean/var over the GLOBAL batch — XLA lowers the reductions to ICI
+collectives. This test proves the semantics: per-shard stats differ, but the
+jitted sharded result equals single-device BN on the concatenated batch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.ops import nn as N
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_batch_norm_on_sharded_batch_uses_global_stats():
+    rng = np.random.default_rng(0)
+    mesh = pt.build_mesh(dp=8)
+    # deliberately different distribution per shard so local != global stats
+    x = np.concatenate([rng.normal(loc=i, size=(4, 3, 2, 2))
+                        for i in range(8)]).astype(np.float32)
+    scale = jnp.ones(3)
+    bias = jnp.zeros(3)
+    mean = jnp.zeros(3)
+    var = jnp.ones(3)
+
+    def bn(xs):
+        y, new_mean, new_var = N.batch_norm(xs, scale, bias, mean, var,
+                                            training=True)
+        return y, new_mean, new_var
+
+    ref_y, ref_m, ref_v = bn(jnp.asarray(x))  # single logical device
+
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp")))
+    got_y, got_m, got_v = jax.jit(bn)(xs)
+    np.testing.assert_allclose(np.asarray(got_m), np.asarray(ref_m),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_y), np.asarray(ref_y),
+                               rtol=1e-4, atol=1e-4)
