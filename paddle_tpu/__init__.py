@@ -34,6 +34,7 @@ _LAZY = {
     "fleet": ".fleet",
     "debug": ".debug",
     "install_check": ".install_check",
+    "train_loop": ".train_loop",
 }
 
 

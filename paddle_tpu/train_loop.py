@@ -1,0 +1,167 @@
+"""Resumable training loop with failure detection — the elastic-recovery
+design-add (SURVEY §5.3: the reference has NO elasticity — a lost trainer
+hangs the sync barrier; graceful exit + checkpoint-notify was its whole
+story. The TPU-native answer is a re-startable jitted step + frequent async
+sharded checkpoints + a watchdog: any process can die and rejoin by
+restarting the loop, which auto-resumes from the latest checkpoint).
+
+Also covers: FLAGS_check_nan_inf parity (reference: framework/operator.cc
+output checking) as a loss/grad guard with skip-or-raise policy, and
+Executor::Close-style graceful shutdown (join async checkpoint writers).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+from .checkpoint import CheckpointManager
+from .core.config import FLAGS
+from .core.enforce import EnforceError, enforce
+
+
+class NanInfError(EnforceError):
+    """Raised when the nan/inf guard trips with policy='raise'."""
+
+
+class Watchdog:
+    """Step-progress watchdog: fires ``on_stall`` (default: print) if no
+    heartbeat arrives within ``timeout_s``. The failure-detection role of
+    the reference's rpc_deadline — but for compute progress, not RPC."""
+
+    def __init__(self, timeout_s: float = 600.0,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 poll_s: Optional[float] = None):
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall or (lambda age: print(
+            f"[watchdog] no training progress for {age:.0f}s"))
+        self._poll_s = poll_s if poll_s is not None else min(timeout_s / 4,
+                                                             30.0)
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last_beat = time.monotonic()
+        self._fired = False
+
+    def _run(self):
+        while not self._stop.wait(self._poll_s):
+            age = time.monotonic() - self._last_beat
+            if age > self.timeout_s and not self._fired:
+                self._fired = True  # fire once per stall
+                self.on_stall(age)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def stalled(self) -> bool:
+        return self._fired
+
+
+class TrainLoop:
+    """Drive a Trainer over a data stream with auto-resume.
+
+    - resume: restores the latest checkpoint before the first step
+    - checkpoint_every: periodic async sharded snapshot (params + opt state
+      + rng), retention-GC'd by the manager
+    - nan guard: FLAGS check_nan_inf equivalent; policy 'skip' drops the
+      step's update by restoring the last checkpointed state, 'raise'
+      raises NanInfError (both report the step)
+    - watchdog: stall detection while the loop runs
+    """
+
+    def __init__(self, trainer, checkpoint_dir: str,
+                 checkpoint_every: int = 1000, max_to_keep: int = 5,
+                 nan_policy: str = "raise",
+                 watchdog_timeout_s: Optional[float] = None,
+                 on_stall: Optional[Callable] = None):
+        enforce(nan_policy in ("raise", "skip", "off"),
+                "nan_policy must be raise|skip|off, got %s", nan_policy)
+        self.trainer = trainer
+        self.manager = CheckpointManager(checkpoint_dir,
+                                         max_to_keep=max_to_keep)
+        self.checkpoint_every = checkpoint_every
+        self.nan_policy = nan_policy
+        self.step = 0
+        self._watchdog = (Watchdog(watchdog_timeout_s, on_stall)
+                          if watchdog_timeout_s else None)
+        self.history: Dict[str, Any] = {"resumed_from": None,
+                                        "skipped_steps": []}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def maybe_resume(self) -> Optional[int]:
+        latest = self.manager.latest_step()
+        if latest is not None:
+            self.trainer.restore_checkpoint(self.manager, latest)
+            self.step = latest
+            self.history["resumed_from"] = latest
+        return latest
+
+    def _guard(self, loss) -> bool:
+        """True if the step is clean; handles policy when not."""
+        if self.nan_policy == "off" and not FLAGS.get("check_nan_inf"):
+            return True
+        if bool(np.isfinite(np.asarray(loss))):
+            return True
+        if self.nan_policy == "raise":
+            raise NanInfError(
+                f"non-finite loss at step {self.step}: {loss}")
+        self.history["skipped_steps"].append(self.step)
+        latest = self.manager.latest_step()
+        if latest is not None:
+            # roll back to the last good snapshot (the skip would otherwise
+            # keep poisoned optimizer moments)
+            self.trainer.restore_checkpoint(self.manager, latest)
+        return False
+
+    def run(self, batches: Iterable, num_steps: Optional[int] = None,
+            resume: bool = True,
+            on_step: Optional[Callable[[int, Any, Dict], None]] = None):
+        """Train until ``num_steps`` (global, including resumed) or data
+        exhaustion. Returns the final step count."""
+        if resume:
+            self.maybe_resume()
+        if self._watchdog:
+            self._watchdog.start()
+        try:
+            for batch in batches:
+                if num_steps is not None and self.step >= num_steps:
+                    break
+                loss, metrics = self.trainer.train_step(batch)
+                if not self._guard(loss):
+                    continue
+                self.step += 1
+                if self._watchdog:
+                    self._watchdog.beat()
+                if on_step is not None:
+                    on_step(self.step, loss, metrics)
+                if self.checkpoint_every and \
+                        self.step % self.checkpoint_every == 0:
+                    self.manager.save(self.step, self.trainer.state())
+        finally:
+            self.close()
+        return self.step
+
+    def close(self):
+        """Graceful shutdown (Executor::Close parity, reference:
+        framework/executor.cc:73): final snapshot + join async writers."""
+        if self._watchdog:
+            self._watchdog.stop()
+        if self.step > 0 and self.step not in self.manager.all_steps():
+            self.manager.save(self.step, self.trainer.state())
+        self.manager.wait_until_finished()

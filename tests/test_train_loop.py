@@ -1,0 +1,121 @@
+"""Elastic training loop tests: auto-resume after a simulated crash,
+periodic checkpoints + retention, nan guard (raise + skip/rollback),
+watchdog stall detection, graceful close."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer, parallel
+from paddle_tpu.models import mnist as M
+from paddle_tpu.train_loop import NanInfError, TrainLoop, Watchdog
+
+RNG = np.random.default_rng(61)
+
+
+def make_trainer():
+    pt.seed(0)
+    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+    model = M.MnistMLP(hidden1=16, hidden2=8)
+    return parallel.Trainer.supervised(model, optimizer.Adam(1e-3),
+                                       M.loss_fn, mesh=mesh)
+
+
+def batches(n, bs=8):
+    for _ in range(n):
+        yield {"x": jnp.asarray(RNG.normal(size=(bs, 784))
+                                .astype(np.float32)),
+               "label": jnp.asarray(RNG.integers(0, 10, bs))}
+
+
+class TestTrainLoop:
+    def test_checkpoints_written_and_gced(self, tmp_path):
+        loop = TrainLoop(make_trainer(), str(tmp_path), checkpoint_every=2,
+                         max_to_keep=2)
+        final = loop.run(batches(10))
+        assert final == 10
+        assert loop.manager.all_steps() == [8, 10]
+
+    def test_crash_resume_continues_at_step(self, tmp_path):
+        loop = TrainLoop(make_trainer(), str(tmp_path), checkpoint_every=5)
+        loop.run(batches(7))  # close() snapshots step 7
+        assert loop.manager.latest_step() == 7
+
+        # "crashed" process restarts: fresh trainer, same dir
+        loop2 = TrainLoop(make_trainer(), str(tmp_path), checkpoint_every=5)
+        final = loop2.run(batches(100), num_steps=12)
+        assert loop2.history["resumed_from"] == 7
+        assert final == 12
+
+    def test_resume_restores_params_exactly(self, tmp_path):
+        tr = make_trainer()
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=100)
+        loop.run(batches(4))
+        saved = {k: np.asarray(v) for k, v in tr.params.items()}
+
+        tr2 = make_trainer()
+        # fresh init differs from trained
+        assert not np.allclose(np.asarray(tr2.params["fc1.weight"]),
+                               saved["fc1.weight"])
+        loop2 = TrainLoop(tr2, str(tmp_path))
+        loop2.maybe_resume()
+        for k, v in tr2.params.items():
+            np.testing.assert_allclose(np.asarray(v), saved[k], rtol=1e-6)
+
+    def test_nan_raise_policy(self, tmp_path):
+        tr = make_trainer()
+        loop = TrainLoop(tr, str(tmp_path), nan_policy="raise")
+        bad = {"x": jnp.full((8, 784), np.nan, jnp.float32),
+               "label": jnp.asarray(RNG.integers(0, 10, 8))}
+        with pytest.raises(NanInfError, match="non-finite loss at step"):
+            loop.run(iter([bad]))
+
+    def test_nan_skip_policy_rolls_back(self, tmp_path):
+        tr = make_trainer()
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=2,
+                         nan_policy="skip")
+        good = list(batches(2))
+        loop.run(iter(good))  # checkpoints at step 2
+        params_before = {k: np.asarray(v) for k, v in tr.params.items()}
+        bad = {"x": jnp.full((8, 784), np.nan, jnp.float32),
+               "label": jnp.asarray(RNG.integers(0, 10, 8))}
+        loop.run(iter([bad]), resume=False)
+        assert loop.history["skipped_steps"] == [2]
+        # state rolled back to the step-2 snapshot
+        for k, v in tr.params.items():
+            np.testing.assert_allclose(np.asarray(v), params_before[k],
+                                       rtol=1e-6)
+
+    def test_final_close_snapshots(self, tmp_path):
+        loop = TrainLoop(make_trainer(), str(tmp_path),
+                         checkpoint_every=1000)
+        loop.run(batches(3))
+        assert loop.manager.latest_step() == 3  # close() wrote it
+
+
+class TestWatchdog:
+    def test_fires_on_stall_and_resets_on_beat(self):
+        fired = []
+        wd = Watchdog(timeout_s=0.3, on_stall=lambda age: fired.append(age),
+                      poll_s=0.05).start()
+        try:
+            for _ in range(4):  # heartbeats keep it quiet
+                time.sleep(0.1)
+                wd.beat()
+            assert not fired
+            time.sleep(0.6)  # stall
+            assert fired and wd.stalled
+            wd.beat()
+            assert not wd.stalled
+        finally:
+            wd.stop()
+
+    def test_loop_heartbeats_watchdog(self, tmp_path):
+        loop = TrainLoop(make_trainer(), str(tmp_path),
+                         watchdog_timeout_s=60)
+        loop.run(batches(2))
+        assert loop._watchdog is not None and not loop._watchdog.stalled

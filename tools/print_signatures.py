@@ -34,6 +34,7 @@ MODULES = [
     "paddle_tpu.amp",
     "paddle_tpu.quant",
     "paddle_tpu.fleet",
+    "paddle_tpu.train_loop",
 ]
 
 SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
