@@ -13,6 +13,7 @@ Usage: python bench.py [--smoke] [--model mnist_mlp]
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -41,21 +42,36 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5):
     batch = {"x": x, "label": label}
     for _ in range(warmup):
         loss, _ = trainer.train_step(batch)
-    jax.block_until_ready(loss)
+    float(loss)  # host fetch = the only reliable fence (see _train_bench)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         loss, _ = trainer.train_step(batch)
-    jax.block_until_ready(loss)
+        if i % 4 == 3:
+            float(loss)
+    float(loss)
     dt = time.perf_counter() - t0
     return steps * batch_size / dt, "examples/sec"
 
 
 def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
-                 lr=1e-3):
-    """Shared harness: jitted value_and_grad+Adam step, timed post-warmup."""
+                 lr=1e-3, amp=None):
+    """Shared harness: jitted value_and_grad+Adam step, timed post-warmup.
+
+    Timing blocks on the FULL output state, not just the loss scalar — the
+    device queue can resolve a scalar d2h long before the update chain
+    drains, which inflates throughput ~30x.
+
+    ``amp``: dtype policy name (e.g. "mixed_bf16") applied at trace time;
+    params/opt state stay fp32 masters. Buffers donate so param/opt updates
+    are in-place in HBM.
+    """
+    import contextlib
+
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
+    from paddle_tpu.core.dtypes import policy_scope
+
     from paddle_tpu import optimizer
 
     params = model.named_parameters()
@@ -64,12 +80,15 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     state = opt.init(params)
     batch = make_batch(batch_size)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, buffers, state, batch):
+        scope = policy_scope(amp) if amp else contextlib.nullcontext()
+
         def loss(p):
-            out, new_buf = model.functional_call(
-                p, *batch, buffers=buffers, training=True)
-            return loss_fn(out, batch), new_buf
+            with scope:
+                out, new_buf = model.functional_call(
+                    p, *batch, buffers=buffers, training=True)
+                return loss_fn(out, batch), new_buf
 
         (l, new_buf), g = jax.value_and_grad(loss, has_aux=True)(params)
         params, state = opt.apply(params, g, state)
@@ -77,16 +96,21 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
 
     for _ in range(warmup):
         params, buffers, state, l = step(params, buffers, state, batch)
-    jax.block_until_ready(l)
+    float(l)  # host fetch = the only reliable fence on this backend
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         params, buffers, state, l = step(params, buffers, state, batch)
-    jax.block_until_ready(l)
+        # fence every few steps: a loss fetch serializes the whole update
+        # chain (honest timing) while keeping the dispatch queue shallow;
+        # block_until_ready alone does NOT block through the async tunnel
+        if i % 4 == 3:
+            float(l)
+    float(l)
     dt = time.perf_counter() - t0
     return steps * batch_size / dt, "examples/sec"
 
 
-def bench_resnet50(steps: int, batch_size: int, smoke: bool = False):
+def bench_resnet50(steps: int, batch_size: int, smoke: bool = False, amp=None):
     """BASELINE config 2 (image 224 is the headline; smoke uses 64)."""
     import numpy as np
     import jax.numpy as jnp
@@ -107,10 +131,11 @@ def bench_resnet50(steps: int, batch_size: int, smoke: bool = False):
         labels = jnp.zeros((logits.shape[0],), jnp.int32)
         return resnet.loss_fn(logits, labels)
 
-    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
 
 
-def bench_bert_base(steps: int, batch_size: int):
+def bench_bert_base(steps: int, batch_size: int, amp=None):
     """BASELINE config 3: BERT-base MLM pretrain step, seq 128."""
     import numpy as np
     import jax.numpy as jnp
@@ -133,10 +158,11 @@ def bench_bert_base(steps: int, batch_size: int):
         mlm_logits, _ = out  # MLM over every position: predict input ids
         return jnp.mean(L.softmax_with_cross_entropy(mlm_logits, batch[0]))
 
-    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
 
 
-def bench_transformer_nmt(steps: int, batch_size: int):
+def bench_transformer_nmt(steps: int, batch_size: int, amp=None):
     """BASELINE config 4: Transformer NMT train step, seq 64."""
     import numpy as np
     import jax.numpy as jnp
@@ -161,10 +187,11 @@ def bench_transformer_nmt(steps: int, batch_size: int):
 
         return jnp.mean(L.softmax_with_cross_entropy(logits, batch[1]))
 
-    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
 
 
-def bench_deepfm(steps: int, batch_size: int):
+def bench_deepfm(steps: int, batch_size: int, amp=None):
     """BASELINE config 5: DeepFM sparse CTR step."""
     import numpy as np
     import jax.numpy as jnp
@@ -188,10 +215,11 @@ def bench_deepfm(steps: int, batch_size: int):
         labels = (batch[0][:, 0] % 2).astype(jnp.float32)
         return DF.loss_fn(logits, labels)
 
-    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
 
 
-def bench_stacked_lstm(steps: int, batch_size: int):
+def bench_stacked_lstm(steps: int, batch_size: int, amp=None):
     """Bench model 6: stacked dynamic LSTM sentiment (reference:
     benchmark/fluid/models/stacked_dynamic_lstm.py), seq 100."""
     import numpy as np
@@ -215,7 +243,8 @@ def bench_stacked_lstm(steps: int, batch_size: int):
         labels = (batch[0][:, 0] % 2).astype(jnp.int32)
         return S.loss_fn(logits, labels)
 
-    return _train_bench(model, loss_fn, make_batch, steps, batch_size)
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
 
 
 MODELS = {
@@ -234,6 +263,9 @@ def main():
     ap.add_argument("--smoke", action="store_true", help="quick run")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--amp", default="mixed_bf16",
+                    help="dtype policy for the step (mixed_bf16 is the TPU "
+                    "training default; pass float32 to disable)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — needed because "
                     "this environment's sitecustomize overrides JAX_PLATFORMS")
@@ -249,8 +281,14 @@ def main():
     import inspect
 
     fn = MODELS[args.model]
-    kwargs = ({"smoke": args.smoke}
-              if "smoke" in inspect.signature(fn).parameters else {})
+    import inspect as _inspect
+
+    sig = _inspect.signature(fn).parameters
+    kwargs = {}
+    if "smoke" in sig:
+        kwargs["smoke"] = args.smoke
+    if "amp" in sig and args.amp and args.amp != "float32":
+        kwargs["amp"] = args.amp
     value, unit = fn(steps, batch, **kwargs)
 
     metric = f"{args.model}_throughput"

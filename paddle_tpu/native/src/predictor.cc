@@ -339,8 +339,10 @@ struct PjrtRuntime {
 // ------------------------------------------------------------- predictor --
 struct Predictor {
   std::string last_error;
+  int num_state_outputs = 0;  // >0: training artifact, outputs loop back
   std::vector<std::string> feed_names, fetch_names, arg_order;
   std::map<std::string, std::string> feed_dtypes;
+  std::map<std::string, std::vector<int64_t>> feed_shapes;
   std::map<std::string, NpyArray> params;
   std::string mlir_bc;
 
@@ -363,9 +365,13 @@ struct Predictor {
     if (jp.fail || m.kind != Json::kObj)
       return Status::Err("manifest.json parse error");
     const Json* fmt = m.find("format");
-    if (!fmt || fmt->str != "stablehlo+npz/v2")
-      return Status::Err("C++ predictor needs format stablehlo+npz/v2, got " +
-                         (fmt ? fmt->str : "<missing>"));
+    if (!fmt || (fmt->str != "stablehlo+npz/v2" &&
+                 fmt->str != "stablehlo+npz/train/v1"))
+      return Status::Err(
+          "C++ predictor needs format stablehlo+npz/v2 or "
+          "stablehlo+npz/train/v1, got " + (fmt ? fmt->str : "<missing>"));
+    if (const Json* ns = m.find("num_state_outputs"))
+      num_state_outputs = (int)ns->num;  // train program: loop state
     for (auto* key : {"feed_target_names", "fetch_target_names", "arg_order"}) {
       if (!m.find(key)) return Status::Err(std::string("manifest missing ") + key);
     }
@@ -376,6 +382,12 @@ struct Predictor {
     for (auto& j : m.find("arg_order")->arr) arg_order.push_back(j.str);
     if (const Json* fd = m.find("feed_dtypes"))
       for (auto& kv : fd->obj) feed_dtypes[kv.first] = kv.second.str;
+    if (const Json* fs = m.find("feed_shapes"))
+      for (auto& kv : fs->obj) {
+        std::vector<int64_t> dims;
+        for (auto& d : kv.second.arr) dims.push_back((int64_t)d.num);
+        feed_shapes[kv.first] = dims;
+      }
     Status st = ReadNpz(dir + "/params.npz", &params);
     if (!st.ok) return st;
     std::ifstream bc(dir + "/program.mlir.bc", std::ios::binary);
@@ -488,7 +500,8 @@ struct Predictor {
     ex.argument_lists = const_cast<PJRT_Buffer* const**>(al);
     ex.num_devices = 1;
     ex.num_args = args_bufs.size();
-    std::vector<PJRT_Buffer*> outs(fetch_names.size());
+    size_t total_outputs = fetch_names.size() + num_state_outputs;
+    std::vector<PJRT_Buffer*> outs(total_outputs);
     PJRT_Buffer** out_list = outs.data();
     PJRT_Buffer** const* ol = &out_list;
     ex.output_lists = const_cast<PJRT_Buffer** const*>(ol);
@@ -496,7 +509,19 @@ struct Predictor {
     ex.execute_device = nullptr;
     if (auto* err = rt->api->PJRT_LoadedExecutable_Execute(&ex))
       return Status::Err("execute: " + rt->ErrMsg(err));
-    // device → host for each output
+    // training artifact: the first num_state_outputs outputs become the
+    // next step's param buffers (device-resident loop state — the C++
+    // train loop never round-trips weights to host)
+    if (num_state_outputs > 0) {
+      for (auto* b : param_buffers) {
+        PJRT_Buffer_Destroy_Args bd{PJRT_Buffer_Destroy_Args_STRUCT_SIZE,
+                                    nullptr, b};
+        rt->api->PJRT_Buffer_Destroy(&bd);
+      }
+      param_buffers.assign(outs.begin(), outs.begin() + num_state_outputs);
+      outs.erase(outs.begin(), outs.begin() + num_state_outputs);
+    }
+    // device → host for each (non-state) output
     out_data.assign(outs.size(), {});
     out_dims.assign(outs.size(), {});
     out_dtypes.assign(outs.size(), "");
@@ -582,6 +607,23 @@ const char* ptpred_feed_name(void* h, int i) {
 }
 int ptpred_num_fetches(void* h) {
   return (int)static_cast<Predictor*>(h)->fetch_names.size();
+}
+int ptpred_feed_rank(void* h, int i) {
+  auto* p = static_cast<Predictor*>(h);
+  auto it = p->feed_shapes.find(p->feed_names[i]);
+  return it == p->feed_shapes.end() ? -1 : (int)it->second.size();
+}
+int64_t ptpred_feed_dim(void* h, int i, int d) {
+  auto* p = static_cast<Predictor*>(h);
+  return p->feed_shapes[p->feed_names[i]][d];
+}
+const char* ptpred_feed_dtype(void* h, int i) {
+  auto* p = static_cast<Predictor*>(h);
+  auto it = p->feed_dtypes.find(p->feed_names[i]);
+  return it == p->feed_dtypes.end() ? "float32" : it->second.c_str();
+}
+int ptpred_num_state_outputs(void* h) {
+  return static_cast<Predictor*>(h)->num_state_outputs;
 }
 const char* ptpred_fetch_name(void* h, int i) {
   return static_cast<Predictor*>(h)->fetch_names[i].c_str();

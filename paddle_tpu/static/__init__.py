@@ -22,16 +22,18 @@ Usage (mirrors the reference's train loop):
 
 from . import layers
 from .executor import Executor, Scope, global_scope
-from .io import (InferencePredictor, load_inference_model, load_persistables,
-                 save_inference_model, save_persistables)
+from .io import (InferencePredictor, TrainStepRunner, load_inference_model,
+                 load_persistables, save_inference_model, save_persistables,
+                 save_train_program)
 from .optimizer import SGD, Adam, Momentum, Optimizer
 from .program import (GRAD_SUFFIX, Program, Var, append_backward,
                       default_main_program, program_guard)
 
 __all__ = [
     "layers", "Executor", "Scope", "global_scope",
-    "InferencePredictor", "load_inference_model", "load_persistables",
-    "save_inference_model", "save_persistables",
+    "InferencePredictor", "TrainStepRunner", "load_inference_model",
+    "load_persistables", "save_inference_model", "save_persistables",
+    "save_train_program",
     "SGD", "Adam", "Momentum", "Optimizer",
     "GRAD_SUFFIX", "Program", "Var", "append_backward",
     "default_main_program", "program_guard",

@@ -216,3 +216,100 @@ def load_inference_model(dirname: str) -> InferencePredictor:
     return InferencePredictor(exported, params,
                               manifest["feed_target_names"],
                               manifest["fetch_target_names"])
+
+
+_TRAIN_MANIFEST_FMT = "stablehlo+npz/train/v1"
+
+
+def save_train_program(dirname: str, feed_target_names: Sequence[str],
+                       loss, executor: Executor, main_program: Program
+                       ) -> None:
+    """Export a FULL train step (forward + backward + optimizer updates) as
+    a StableHLO artifact runnable from any PJRT host — the Python-free
+    *training* path (reference: paddle/fluid/train/demo/demo_trainer.cc
+    runs startup+main ProgramDescs from C++; here the step is one compiled
+    function ``(state..., feeds...) -> (new_state..., loss)``).
+
+    ``main_program`` must already have optimizer updates appended
+    (opt.minimize(loss)). State = every persistable var (params +
+    optimizer accumulators), threaded through so the caller loops by
+    feeding outputs back as inputs — C++ side: native/src/train_demo.cc.
+    """
+    loss_name = loss.name if isinstance(loss, Var) else loss
+    program = main_program
+    # auto-startup for uninitialized accumulators
+    missing = [n for n in program.param_inits
+               if not executor.scope.has(n)]
+    if missing:
+        executor.run_startup(program)
+    state_names = sorted(n for n in program.persistable_names()
+                         if executor.scope.has(n))
+    state = {n: jnp.asarray(executor.scope.get(n)) for n in state_names}
+    consts = dict(getattr(program, "_const_values", {}))
+
+    from .executor import _exec_program
+
+    def step_fn(state, feeds):
+        env = dict(consts)
+        env.update(state)
+        env.update(feeds)
+        env = _exec_program(program, env)
+        new_state = {n: env[n] for n in state_names}
+        return new_state, env[loss_name]
+
+    feed_specs = {}
+    for n in feed_target_names:
+        v = program.vars[n]
+        shape = tuple(8 if d == -1 else d for d in v.shape)  # fixed batch
+        feed_specs[n] = jax.ShapeDtypeStruct(shape, v.dtype)
+    state_specs = {n: jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+                   for n, a in state.items()}
+    exported = jax.export.export(jax.jit(step_fn))(state_specs, feed_specs)
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, _HLO), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(dirname, _MLIR_BC), "wb") as f:
+        f.write(exported.mlir_module_serialized)
+    np.savez(os.path.join(dirname, _PARAMS),
+             **{n: np.asarray(a) for n, a in state.items()})
+    arg_order = ([f"param:{n}" for n in state_names] +
+                 [f"feed:{n}" for n in sorted(feed_specs)])
+    with open(os.path.join(dirname, _MANIFEST), "w") as f:
+        json.dump({
+            "feed_target_names": list(feed_target_names),
+            "fetch_target_names": [loss_name],
+            "feed_shapes": {n: list(feed_specs[n].shape)
+                            for n in feed_specs},
+            "feed_dtypes": {n: np.dtype(feed_specs[n].dtype).name
+                            for n in feed_specs},
+            "arg_order": arg_order,
+            "state_names": state_names,
+            # outputs: flattened (new_state dict sorted, loss) — first
+            # len(state_names) outputs ARE the next step's params
+            "num_state_outputs": len(state_names),
+            "format": _TRAIN_MANIFEST_FMT,
+        }, f, indent=1)
+
+
+class TrainStepRunner:
+    """Python-side driver for a saved train program (the C++ loop's
+    reference semantics; used to validate artifacts + for Python serving
+    of exported training)."""
+
+    def __init__(self, dirname: str):
+        with open(os.path.join(dirname, _MANIFEST)) as f:
+            self.manifest = json.load(f)
+        enforce(self.manifest.get("format") == _TRAIN_MANIFEST_FMT,
+                "not a train program: %s", self.manifest.get("format"))
+        with open(os.path.join(dirname, _HLO), "rb") as f:
+            self._exported = jax.export.deserialize(bytearray(f.read()))
+        with np.load(os.path.join(dirname, _PARAMS)) as data:
+            self.state = {n: jnp.asarray(data[n])
+                          for n in self.manifest["state_names"]}
+
+    def step(self, feeds: Dict[str, np.ndarray]):
+        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        new_state, loss = self._exported.call(self.state, feeds)
+        self.state = new_state
+        return float(loss)

@@ -1,0 +1,8 @@
+"""Utility helpers (reference: python/paddle/fluid/contrib/utils,
+contrib/memory_usage_calc.py)."""
+
+from .memory import (bytes_of_tree, estimate_training_memory, format_bytes,
+                     memory_usage)
+
+__all__ = ["bytes_of_tree", "estimate_training_memory", "format_bytes",
+           "memory_usage"]

@@ -1,0 +1,75 @@
+"""Memory estimator + op microbench tool tests."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.utils import (bytes_of_tree, estimate_training_memory,
+                              format_bytes, memory_usage)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestMemory:
+    def test_bytes_of_tree(self):
+        b = jnp.zeros((5,), jnp.int32)
+        tree = {"a": jnp.zeros((10, 10), jnp.float32), "b": b}
+        assert bytes_of_tree(tree) == 400 + 5 * b.dtype.itemsize
+
+    def test_format_bytes(self):
+        assert format_bytes(512) == "512 B"
+        assert format_bytes(2048) == "2.00 KiB"
+        assert "MiB" in format_bytes(5 * 1024 * 1024)
+
+    def test_estimate_training_memory(self):
+        pt.seed(0)
+        model = pt.nn.Sequential(pt.nn.Linear(784, 128, act="relu"),
+                                 pt.nn.Linear(128, 10))
+        x = jnp.zeros((32, 784), jnp.float32)
+        est = estimate_training_memory(model, (x,), optimizer="adam")
+        p = (784 * 128 + 128 + 128 * 10 + 10) * 4
+        assert est["params_bytes"] == p
+        assert est["grads_bytes"] == p
+        assert est["optimizer_state_bytes"] == 2 * p  # adam m+v
+        assert est["activations_upper_bound_bytes"] > 0
+        assert est["total_bytes"] >= 4 * p
+        assert "params" in est["summary"]
+
+    def test_memory_usage_compiled(self):
+        compiled = jax.jit(lambda x: x @ x).lower(
+            jax.ShapeDtypeStruct((64, 64), jnp.float32)).compile()
+        out = memory_usage(compiled)
+        assert out["total_bytes"] > 0
+        assert out["argument_size_in_bytes"] >= 64 * 64 * 4
+
+
+class TestOpBenchTool:
+    def test_single_op_cli(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "op_bench.py"),
+             "--op", "ops.math.matmul", "--shapes", "64x64,64x64",
+             "--repeat", "3", "--platform", "cpu"],
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        assert rec["op"] == "ops.math.matmul"
+        assert rec["forward_ms"] > 0
+
+    def test_config_file_with_grad(self, tmp_path):
+        cfg = [{"op": "ops.nn.softmax", "args": {"x": [32, 128]},
+                "grad": True}]
+        path = tmp_path / "cases.json"
+        path.write_text(json.dumps(cfg))
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "op_bench.py"),
+             "--config", str(path), "--repeat", "3", "--platform", "cpu"],
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        assert rec["forward_ms"] > 0 and rec["grad_ms"] > 0
