@@ -1,12 +1,14 @@
 """Data layer: reader decorators, feeders, datasets, ragged batching."""
 
 from . import dataset
+from .data_generator import MultiSlotDataGenerator
 from .dataset import MultiSlotDataset
 from .feeder import DataFeeder, DeviceLoader
 from .reader import (batch, buffered, cache, chain, compose, firstn,
                      map_readers, shuffle, xmap_readers)
 
 __all__ = [
+    "MultiSlotDataGenerator",
     "dataset", "MultiSlotDataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",
     "chain", "compose", "firstn", "map_readers", "shuffle", "xmap_readers",
 ]

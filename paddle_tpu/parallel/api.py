@@ -117,9 +117,15 @@ class Trainer:
     # --- driver API ---------------------------------------------------------
 
     def train_step(self, batch) -> Tuple[Any, Dict[str, Any]]:
-        self._rng, sub = jax.random.split(self._rng)
-        loss, metrics, self.params, self.buffers, self.opt_state = \
-            self._jit_step(self.params, self.buffers, self.opt_state, sub, batch)
+        from ..core.profiler import RecordEvent
+
+        # op-level span parity (reference: RecordEvent pushed around every
+        # op run, platform/profiler.h:81) — here one span per compiled step
+        with RecordEvent("train_step"):
+            self._rng, sub = jax.random.split(self._rng)
+            loss, metrics, self.params, self.buffers, self.opt_state = \
+                self._jit_step(self.params, self.buffers, self.opt_state,
+                               sub, batch)
         return loss, metrics
 
     def eval_step(self, batch):

@@ -27,7 +27,7 @@ def model_dir(tmp_path_factory):
         x = prog.data("x", (-1, 8))
         h = static.layers.fc(x, 6, act="relu")
         out = static.layers.fc(h, 3, act="softmax")
-    exe = static.Executor()
+    exe = static.Executor(scope=static.Scope())  # isolate from global scope
     exe.run_startup(prog)
     static.save_inference_model(d, ["x"], [out], exe, prog)
     return d

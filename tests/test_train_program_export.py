@@ -27,7 +27,7 @@ def train_dir(tmp_path_factory):
         loss = static.layers.mean(
             static.layers.softmax_with_cross_entropy(logits, label))
         static.Adam(1e-2).minimize(loss)
-    exe = static.Executor()
+    exe = static.Executor(scope=static.Scope())  # isolate from global scope
     exe.run_startup(prog)
     static.save_train_program(d, ["x", "label"], loss, exe, prog)
     return d
