@@ -35,6 +35,8 @@ _LAZY = {
     "debug": ".debug",
     "install_check": ".install_check",
     "train_loop": ".train_loop",
+    "slim": ".slim",
+    "utils": ".utils",
 }
 
 

@@ -1,0 +1,148 @@
+"""Model compression — distillation + pruning (reference:
+python/paddle/fluid/contrib/slim/ — the quant part lives in
+``paddle_tpu.quant``; this module covers slim's distillation
+(distillation/distillation_strategy.py, fsp loss) and pruning
+(prune/prune_strategy.py magnitude pruning) capabilities. NAS/auto-search
+is intentionally out of scope (reference's light_nas is experimental)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .core.enforce import enforce
+from .ops.loss import softmax_with_cross_entropy
+from .ops.nn_extra import fsp_matrix
+
+# ---------------------------------------------------------------------------
+# Distillation (reference: contrib/slim/distillation — soft-label loss,
+# fsp loss, l2 feature loss between teacher/student var pairs)
+# ---------------------------------------------------------------------------
+
+
+def soft_label_loss(student_logits, teacher_logits,
+                    temperature: float = 1.0):
+    """KL-style soft-label distillation loss (reference:
+    distillation_strategy soft_label_loss): CE(student/T, softmax(teacher/T))
+    scaled by T^2 so gradients keep magnitude."""
+    t = temperature
+    teacher_probs = jax.nn.softmax(teacher_logits / t, axis=-1)
+    ce = softmax_with_cross_entropy(student_logits / t, teacher_probs,
+                                    soft_label=True)
+    return jnp.mean(ce) * (t * t)
+
+
+def fsp_loss(student_pair: Tuple, teacher_pair: Tuple):
+    """FSP distillation loss (reference: fsp_op.cc + distillation usage):
+    L2 between the student's and teacher's flow matrices."""
+    s = fsp_matrix(*student_pair)
+    te = fsp_matrix(*teacher_pair)
+    return jnp.mean((s - te) ** 2)
+
+
+def l2_feature_loss(student_feat, teacher_feat):
+    """reference: distillation l2-loss between matched feature maps."""
+    return jnp.mean((student_feat - teacher_feat) ** 2)
+
+
+class Distiller:
+    """Compose distillation terms with the task loss (the
+    DistillationStrategy role, config-driven weighting)."""
+
+    def __init__(self, temperature: float = 4.0, soft_weight: float = 0.7,
+                 hard_weight: float = 0.3, feature_weight: float = 0.0):
+        self.temperature = temperature
+        self.soft_weight = soft_weight
+        self.hard_weight = hard_weight
+        self.feature_weight = feature_weight
+
+    def loss(self, student_logits, teacher_logits, label=None,
+             feature_pairs: Sequence[Tuple] = ()):
+        total = self.soft_weight * soft_label_loss(
+            student_logits, teacher_logits, self.temperature)
+        if label is not None and self.hard_weight:
+            total = total + self.hard_weight * jnp.mean(
+                softmax_with_cross_entropy(student_logits, label))
+        for s, t in feature_pairs:
+            total = total + self.feature_weight * l2_feature_loss(s, t)
+        return total
+
+
+# ---------------------------------------------------------------------------
+# Pruning (reference: contrib/slim/prune — magnitude/sensitive pruning of
+# params by ratio; masks persist through training)
+# ---------------------------------------------------------------------------
+
+
+def magnitude_mask(param, ratio: float) -> jnp.ndarray:
+    """0/1 mask keeping the largest-|w| (1-ratio) fraction (reference:
+    prune_strategy magnitude pruning)."""
+    enforce(0.0 <= ratio < 1.0, "prune ratio must be in [0,1), got %s",
+            ratio)
+    flat = jnp.abs(param.reshape(-1))
+    k = max(int(round(flat.size * (1.0 - ratio))), 1)
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(param) >= thresh).astype(param.dtype)
+
+
+def structured_channel_mask(param, ratio: float, axis: int = 0):
+    """Channel (filter) pruning: zero whole output channels with the
+    smallest L1 norms (reference: slim filter pruning)."""
+    reduce_axes = tuple(i for i in range(param.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(param), axis=reduce_axes)
+    k = max(int(round(norms.size * (1.0 - ratio))), 1)
+    thresh = jax.lax.top_k(norms, k)[0][-1]
+    keep = (norms >= thresh).astype(param.dtype)
+    shape = [1] * param.ndim
+    shape[axis] = param.shape[axis]
+    return jnp.broadcast_to(keep.reshape(shape), param.shape)
+
+
+class Pruner:
+    """Magnitude pruner over a params pytree. ``make_masks`` selects by
+    per-param ratio (dict of path→ratio or one global ratio; params not
+    matched stay dense). ``apply`` zeroes; reapply after each optimizer
+    step (or fold into the train step) to keep sparsity — the mask-persist
+    role of the reference's pruning strategy."""
+
+    def __init__(self, ratios, structured: bool = False, axis: int = 0,
+                 match: Optional[Callable[[str], bool]] = None):
+        self.ratios = ratios
+        self.structured = structured
+        self.axis = axis
+        self.match = match or (lambda name: name.endswith("weight"))
+
+    def make_masks(self, params: Dict[str, jnp.ndarray]
+                   ) -> Dict[str, jnp.ndarray]:
+        masks = {}
+        for name, p in params.items():
+            if not self.match(name):
+                continue
+            ratio = (self.ratios.get(name)
+                     if isinstance(self.ratios, dict) else self.ratios)
+            if ratio is None or ratio <= 0:
+                continue
+            if self.structured and p.ndim >= 2:
+                masks[name] = structured_channel_mask(p, ratio, self.axis)
+            else:
+                masks[name] = magnitude_mask(p, ratio)
+        return masks
+
+    @staticmethod
+    def apply(params: Dict[str, jnp.ndarray],
+              masks: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        return {name: p * masks[name] if name in masks else p
+                for name, p in params.items()}
+
+    @staticmethod
+    def sparsity(params: Dict[str, jnp.ndarray],
+                 masks: Dict[str, jnp.ndarray]) -> float:
+        """Fraction of masked-out weights over maskable params."""
+        zeros = total = 0
+        for name in masks:
+            m = masks[name]
+            zeros += float(jnp.sum(m == 0))
+            total += m.size
+        return zeros / max(total, 1)

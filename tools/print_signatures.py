@@ -35,6 +35,8 @@ MODULES = [
     "paddle_tpu.quant",
     "paddle_tpu.fleet",
     "paddle_tpu.train_loop",
+    "paddle_tpu.slim",
+    "paddle_tpu.utils",
 ]
 
 SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
