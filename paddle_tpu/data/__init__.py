@@ -2,13 +2,13 @@
 
 from . import dataset
 from .data_generator import MultiSlotDataGenerator
-from .dataset import MultiSlotDataset
+from .dataset import MultiSlotDataset, train_from_dataset
 from .feeder import DataFeeder, DeviceLoader
 from .reader import (batch, buffered, cache, chain, compose, firstn,
                      map_readers, shuffle, xmap_readers)
 
 __all__ = [
-    "MultiSlotDataGenerator",
+    "MultiSlotDataGenerator", "train_from_dataset",
     "dataset", "MultiSlotDataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",
     "chain", "compose", "firstn", "map_readers", "shuffle", "xmap_readers",
 ]

@@ -202,3 +202,22 @@ class MultiSlotDataset:
             yield from feed
         finally:
             feed.close()
+
+
+def train_from_dataset(trainer, dataset: "MultiSlotDataset",
+                       batch_transform, epochs: int = 1,
+                       on_step=None):
+    """Dataset-based training driver — the AsyncExecutor/dataset-training
+    UX (reference: framework/async_executor.h:62 + executor.py
+    train_from_dataset: C++ threads parse+batch while the device trains).
+
+    ``batch_transform(raw)`` maps the feed's {slot: (values, lengths)} dict
+    to the trainer's batch format. Returns the number of steps run."""
+    steps = 0
+    for _ in range(epochs):
+        for raw in dataset:
+            loss, metrics = trainer.train_step(batch_transform(raw))
+            steps += 1
+            if on_step is not None:
+                on_step(steps, loss, metrics)
+    return steps

@@ -1,8 +1,10 @@
 """Utility helpers (reference: python/paddle/fluid/contrib/utils,
 contrib/memory_usage_calc.py)."""
 
+from .dlpack import from_dlpack, from_torch, to_dlpack, to_torch
 from .memory import (bytes_of_tree, estimate_training_memory, format_bytes,
                      memory_usage)
 
 __all__ = ["bytes_of_tree", "estimate_training_memory", "format_bytes",
-           "memory_usage"]
+           "memory_usage", "from_dlpack", "from_torch", "to_dlpack",
+           "to_torch"]

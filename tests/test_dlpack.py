@@ -1,0 +1,23 @@
+"""DLPack interop tests (reference: framework/dlpack_tensor.cc role)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.utils import from_dlpack, from_torch, to_torch
+
+
+def test_numpy_roundtrip():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    arr = from_dlpack(x)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    arr = from_torch(t)
+    np.testing.assert_array_equal(np.asarray(arr), t.numpy())
+    back = to_torch(arr + 1)
+    np.testing.assert_array_equal(back.numpy(), t.numpy() + 1)
