@@ -37,6 +37,7 @@ _LAZY = {
     "train_loop": ".train_loop",
     "slim": ".slim",
     "utils": ".utils",
+    "jit": ".jit",
 }
 
 

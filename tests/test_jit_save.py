@@ -1,0 +1,89 @@
+"""jit.save dygraph-export tests: Layer -> artifact -> Python predictor,
+batch polymorphism, quantized-model export, C++ loader parse."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit, quant
+
+RNG = np.random.default_rng(101)
+
+
+@pytest.fixture()
+def model():
+    pt.seed(0)
+    return pt.nn.Sequential(pt.nn.Linear(8, 16, act="relu"),
+                            pt.nn.Linear(16, 3))
+
+
+class TestJitSave:
+    def test_roundtrip_matches_eager(self, model, tmp_path):
+        x = jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+        d = str(tmp_path / "m")
+        jit.save(model, d, [x])
+        pred = jit.load(d)
+        out = pred.run({"x0": np.asarray(x)})[0]
+        ref = np.asarray(model.eval()(x))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_batch_polymorphic(self, model, tmp_path):
+        x = jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+        d = str(tmp_path / "m")
+        jit.save(model, d, [x])
+        pred = jit.load(d)
+        # different batch size must work without re-export
+        big = RNG.normal(size=(17, 8)).astype(np.float32)
+        out = pred.run({"x0": big})[0]
+        assert out.shape == (17, 3)
+
+    def test_input_names(self, model, tmp_path):
+        x = jnp.asarray(RNG.normal(size=(2, 8)).astype(np.float32))
+        d = str(tmp_path / "m")
+        jit.save(model, d, [x], input_names=["image"])
+        pred = jit.load(d)
+        assert pred.feed_target_names == ["image"]
+
+    def test_bn_buffers_baked(self, tmp_path):
+        pt.seed(0)
+        net = pt.nn.Sequential(pt.nn.Conv2D(1, 4, 3), pt.nn.BatchNorm(4))
+        x = jnp.asarray(RNG.normal(size=(2, 1, 8, 8)).astype(np.float32))
+        net.train()
+        net(x)  # update running stats
+        d = str(tmp_path / "bn")
+        jit.save(net, d, [x])
+        pred = jit.load(d)
+        out = pred.run({"x0": np.asarray(x)})[0]
+        ref = np.asarray(net.eval()(x))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_quantized_model_exports(self, model, tmp_path):
+        qm = quant.quantize_model(model)
+        x = jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+        quant.calibrate(qm, [x])
+        d = str(tmp_path / "q")
+        jit.save(qm, d, [x])
+        pred = jit.load(d)
+        out = pred.run({"x0": np.asarray(x)})[0]
+        ref, _ = qm.functional_call(qm.named_parameters(), x,
+                                    buffers=qm.named_buffers(),
+                                    training=False)
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_cpp_loader_parses_jit_artifact(self, model, tmp_path):
+        from paddle_tpu.native import NativePredictor
+
+        x = jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+        d = str(tmp_path / "m")
+        jit.save(model, d, [x])
+        p = NativePredictor(d)
+        assert p.feed_names == ["x0"]
+        assert p.num_params() == 4
+        ref = dict(np.load(os.path.join(d, "params.npz")))
+        for k, v in ref.items():
+            np.testing.assert_array_equal(p.param(k), v)
+        p.close()

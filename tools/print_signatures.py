@@ -37,6 +37,7 @@ MODULES = [
     "paddle_tpu.train_loop",
     "paddle_tpu.slim",
     "paddle_tpu.utils",
+    "paddle_tpu.jit",
 ]
 
 SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
