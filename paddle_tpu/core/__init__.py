@@ -4,7 +4,8 @@ from .config import FLAGS, BuildStrategy, DistributeConfig, ExecutionStrategy
 from .dtypes import Policy, get_policy, policy_scope, set_policy, to_dtype
 from .enforce import (EnforceError, InvalidArgumentError, NotFoundError,
                       UnimplementedError, enforce, enforce_eq, enforce_in)
-from .mesh import (AXIS_NAMES, auto_mesh, axis_size, build_mesh, get_mesh,
+from .mesh import (AXIS_NAMES, auto_mesh, axis_size, build_hybrid_mesh,
+                   build_mesh, get_mesh,
                    mesh_scope, replicated, set_mesh, sharding)
 from .places import (CPUPlace, Place, TPUPlace, default_place, device_count,
                      device_pool, is_compiled_with_tpu, set_device)
@@ -16,7 +17,8 @@ __all__ = [
     "Policy", "get_policy", "policy_scope", "set_policy", "to_dtype",
     "EnforceError", "InvalidArgumentError", "NotFoundError",
     "UnimplementedError", "enforce", "enforce_eq", "enforce_in",
-    "AXIS_NAMES", "auto_mesh", "axis_size", "build_mesh", "get_mesh",
+    "AXIS_NAMES", "auto_mesh", "axis_size", "build_hybrid_mesh",
+    "build_mesh", "get_mesh",
     "mesh_scope", "replicated", "set_mesh", "sharding",
     "CPUPlace", "Place", "TPUPlace", "default_place", "device_count",
     "device_pool", "is_compiled_with_tpu", "set_device",

@@ -119,3 +119,36 @@ def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
 def data_sharding(mesh: Optional[Mesh] = None, batch_axes=("dp",)) -> NamedSharding:
     """Sharding for a host batch: leading dim split over dp (and sp if used)."""
     return sharding(PartitionSpec(batch_axes), mesh)
+
+
+def build_hybrid_mesh(
+    dcn_dp: int = 1,
+    *,
+    dp: int = 1,
+    tp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: an outer data-parallel axis over DCN (slices /
+    hosts) and inner ICI axes within each slice (SURVEY §5.8: same
+    collectives over the DCN mesh axis; compiler-partitioned — the
+    scaling-book hybrid layout, jax mesh_utils.create_hybrid_device_mesh
+    role).
+
+    The total dp axis becomes ``dcn_dp * dp`` with DCN-adjacent devices
+    outermost, so gradient all-reduces decompose into intra-slice ICI
+    reductions + a small inter-slice DCN exchange. Device order: JAX sorts
+    ``jax.devices()`` by (process, local id), which already groups
+    slice-local devices contiguously — the reshape below relies on that.
+    """
+    if devices is None:
+        devices = jax.devices()
+    inner = dp * tp * pp * sp * ep
+    enforce(dcn_dp * inner == len(devices),
+            "hybrid mesh %s x %s != %s devices", dcn_dp, inner,
+            len(devices))
+    dev_array = np.asarray(devices).reshape(dcn_dp, dp, pp, tp, sp, ep)
+    dev_array = dev_array.reshape(dcn_dp * dp, pp, tp, sp, ep)
+    return Mesh(dev_array, axis_names=("dp", "pp", "tp", "sp", "ep"))

@@ -98,3 +98,31 @@ def test_profiler_chrome_trace(tmp_path):
         trace = json.load(f)
     names = [e["name"] for e in trace["traceEvents"]]
     assert "step" in names
+
+
+def test_build_hybrid_mesh_layout():
+    """2 'slices' x 4 local devices: dp=8 total, slice-local contiguity."""
+    import numpy as np
+    import paddle_tpu as pt
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = pt.core.mesh.build_hybrid_mesh(dcn_dp=2, dp=4, devices=devs[:8])
+    assert mesh.shape["dp"] == 8
+    arr = np.asarray(mesh.devices).reshape(2, 4, -1)
+    # outer axis groups the first 4 devices then the next 4 (DCN outermost)
+    first = [d.id for d in arr[0].ravel()]
+    second = [d.id for d in arr[1].ravel()]
+    assert max(first) < min(second)
+
+
+def test_build_hybrid_mesh_with_tp():
+    import paddle_tpu as pt
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = pt.core.mesh.build_hybrid_mesh(dcn_dp=2, dp=2, tp=2,
+                                          devices=devs[:8])
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2

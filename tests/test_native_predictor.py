@@ -123,3 +123,12 @@ class TestServeDemoBinary:
             # no local TPU: must be the typed compile/client error path
             assert r.returncode in (1, 2), (r.returncode, r.stdout, r.stderr)
             assert "model loaded" in r.stdout
+
+
+class TestNativeCppUnits:
+    def test_cpp_unit_tests_pass(self):
+        """Run the C++ parser unit tests (reference *_test.cc convention)."""
+        r = subprocess.run(["make", "-C", NATIVE_DIR, "test"],
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "predictor_test: all ok" in r.stdout
