@@ -106,3 +106,23 @@ class TestInstallCheck:
         assert pt.install_check.run_check(verbose=True)
         out = capsys.readouterr().out
         assert "installed correctly" in out
+
+
+class TestOpFrequence:
+    def test_counts_program_ops(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from op_frequence import op_freq_statistic
+
+        from paddle_tpu import static
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = prog.data("x", (-1, 4))
+            h = static.layers.fc(x, 4, act="relu")
+            h2 = static.layers.fc(h, 2)
+            loss = static.layers.mean(h2)
+            static.SGD(0.1).minimize(loss)
+        stats = op_freq_statistic(prog)
+        assert stats.get("fc", 0) == 2
+        assert stats.get("backward", 0) == 1
+        assert sum(stats.values()) == len(prog.nodes)

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Op-frequency statistics for a static Program (reference:
+python/paddle/fluid/contrib/op_frequence.py — counts op types in a program
+so users see what dominates before optimizing).
+
+Usage (python API):
+    from tools.op_frequence import op_freq_statistic
+    stats = op_freq_statistic(program)   # {op_name: count}, sorted desc
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def op_freq_statistic(program) -> dict:
+    from paddle_tpu.static.program import _GradNode
+
+    counts = Counter()
+    for node in program.nodes:
+        if isinstance(node, _GradNode):
+            counts["backward"] += 1
+        else:
+            # node names carry a uniquifying suffix (fc_0, fc_1) — strip it
+            base = node.name.rsplit("_", 1)
+            key = base[0] if len(base) == 2 and base[1].isdigit() \
+                else node.name
+            counts[key] += 1
+    return dict(counts.most_common())
+
+
+def main():
+    print("op_frequence is a library helper; see the module docstring")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
