@@ -110,8 +110,10 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     return steps * batch_size / dt, "examples/sec"
 
 
-def bench_resnet50(steps: int, batch_size: int, smoke: bool = False, amp=None):
-    """BASELINE config 2 (image 224 is the headline; smoke uses 64)."""
+def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
+                   amp=None, layout: str = "NHWC"):
+    """BASELINE config 2 (image 224 is the headline; smoke uses 64).
+    NHWC is the TPU-native layout default; pass layout=NCHW to compare."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -120,7 +122,7 @@ def bench_resnet50(steps: int, batch_size: int, smoke: bool = False, amp=None):
     pt.seed(0)
     size = 64 if smoke else 224
     batch_size = min(batch_size, 8 if smoke else 128)
-    model = resnet.resnet50(num_classes=1000)
+    model = resnet.resnet50(num_classes=1000, data_format=layout)
     rng = np.random.default_rng(0)
 
     def make_batch(bs):
@@ -263,6 +265,9 @@ def main():
     ap.add_argument("--smoke", action="store_true", help="quick run")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--layout", default=None,
+                    help="conv data format for models that support it "
+                    "(NHWC default on resnet)")
     ap.add_argument("--amp", default="mixed_bf16",
                     help="dtype policy for the step (mixed_bf16 is the TPU "
                     "training default; pass float32 to disable)")
@@ -289,6 +294,8 @@ def main():
         kwargs["smoke"] = args.smoke
     if "amp" in sig and args.amp and args.amp != "float32":
         kwargs["amp"] = args.amp
+    if "layout" in sig and args.layout:
+        kwargs["layout"] = args.layout
     value, unit = fn(steps, batch, **kwargs)
 
     metric = f"{args.model}_throughput"

@@ -18,11 +18,12 @@ from ..ops import loss as L
 
 
 def _conv_bn(in_ch: int, out_ch: int, k: int, stride: int = 1,
-             groups: int = 1, act: Optional[str] = "relu") -> nn.Layer:
+             groups: int = 1, act: Optional[str] = "relu",
+             data_format: str = "NCHW") -> nn.Layer:
     return nn.Sequential(
         nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=(k - 1) // 2,
-                  groups=groups, bias_attr=False),
-        nn.BatchNorm(out_ch, act=act),
+                  groups=groups, bias_attr=False, data_format=data_format),
+        nn.BatchNorm(out_ch, act=act, data_layout=data_format),
     )
 
 
@@ -30,15 +31,19 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, in_ch: int, ch: int, stride: int = 1,
-                 groups: int = 1, base_width: int = 64):
+                 groups: int = 1, base_width: int = 64,
+                 data_format: str = "NCHW"):
         super().__init__()
         width = int(ch * (base_width / 64.0)) * groups
         out_ch = ch * self.expansion
-        self.conv1 = _conv_bn(in_ch, width, 1)
-        self.conv2 = _conv_bn(width, width, 3, stride=stride, groups=groups)
-        self.conv3 = _conv_bn(width, out_ch, 1, act=None)
+        df = data_format
+        self.conv1 = _conv_bn(in_ch, width, 1, data_format=df)
+        self.conv2 = _conv_bn(width, width, 3, stride=stride, groups=groups,
+                              data_format=df)
+        self.conv3 = _conv_bn(width, out_ch, 1, act=None, data_format=df)
         self.short = (None if in_ch == out_ch and stride == 1
-                      else _conv_bn(in_ch, out_ch, 1, stride=stride, act=None))
+                      else _conv_bn(in_ch, out_ch, 1, stride=stride,
+                                    act=None, data_format=df))
 
     def forward(self, x):
         y = self.conv3(self.conv2(self.conv1(x)))
@@ -49,12 +54,15 @@ class BottleneckBlock(nn.Layer):
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, in_ch: int, ch: int, stride: int = 1, **_):
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 data_format: str = "NCHW", **_):
         super().__init__()
-        self.conv1 = _conv_bn(in_ch, ch, 3, stride=stride)
-        self.conv2 = _conv_bn(ch, ch, 3, act=None)
+        df = data_format
+        self.conv1 = _conv_bn(in_ch, ch, 3, stride=stride, data_format=df)
+        self.conv2 = _conv_bn(ch, ch, 3, act=None, data_format=df)
         self.short = (None if in_ch == ch and stride == 1
-                      else _conv_bn(in_ch, ch, 1, stride=stride, act=None))
+                      else _conv_bn(in_ch, ch, 1, stride=stride, act=None,
+                                    data_format=df))
 
     def forward(self, x):
         y = self.conv2(self.conv1(x))
@@ -65,16 +73,22 @@ class BasicBlock(nn.Layer):
 class ResNet(nn.Layer):
     def __init__(self, block, depths: Sequence[int], num_classes: int = 1000,
                  in_ch: int = 3, cifar: bool = False, groups: int = 1,
-                 base_width: int = 64):
+                 base_width: int = 64, data_format: str = "NCHW"):
         super().__init__()
         self.cifar = cifar
+        # NHWC is the TPU-preferred layout (channels-last tiles directly
+        # onto the MXU without the transposes NCHW convs insert); inputs
+        # stay NCHW at the API and transpose once at the stem
+        self.data_format = data_format
+        df = data_format
         ch = 16 if cifar else 64
         if cifar:
-            self.stem = _conv_bn(in_ch, ch, 3)
+            self.stem = _conv_bn(in_ch, ch, 3, data_format=df)
             widths = [16, 32, 64]
         else:
-            self.stem = _conv_bn(in_ch, ch, 7, stride=2)
-            self.maxpool = nn.Pool2D(3, "max", stride=2, padding=1)
+            self.stem = _conv_bn(in_ch, ch, 7, stride=2, data_format=df)
+            self.maxpool = nn.Pool2D(3, "max", stride=2, padding=1,
+                                     data_format=df)
             widths = [64, 128, 256, 512]
         blocks = []
         cur = ch
@@ -82,18 +96,21 @@ class ResNet(nn.Layer):
             for i in range(n):
                 stride = 2 if (i == 0 and stage > 0) else 1
                 blocks.append(block(cur, w, stride=stride, groups=groups,
-                                    base_width=base_width))
+                                    base_width=base_width, data_format=df))
                 cur = w * block.expansion
         self.blocks = nn.LayerList(blocks)
         self.head = nn.Linear(cur, num_classes)
 
     def forward(self, x):
+        if self.data_format == "NHWC":
+            x = jnp.transpose(x, (0, 2, 3, 1))  # accept NCHW inputs
         x = self.stem(x)
         if not self.cifar:
             x = self.maxpool(x)
         for blk in self.blocks:
             x = blk(x)
-        x = jnp.mean(x, axis=(2, 3))  # global average pool (NCHW)
+        pool_axes = (2, 3) if self.data_format == "NCHW" else (1, 2)
+        x = jnp.mean(x, axis=pool_axes)  # global average pool
         return self.head(x)
 
 

@@ -54,11 +54,13 @@ class Conv2D(Layer):
     def __init__(self, in_channels: int, out_channels: int,
                  kernel_size: Union[int, Sequence[int]], stride=1, padding=0,
                  dilation=1, groups: int = 1, bias_attr: bool = True,
-                 act: Optional[str] = None, weight_init=None, dtype=None):
+                 act: Optional[str] = None, weight_init=None, dtype=None,
+                 data_format: str = "NCHW"):
         super().__init__()
         k = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(kernel_size)
         self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
         self.act = act
+        self.data_format = data_format
         self.create_parameter(
             "weight", (out_channels, in_channels // groups) + k, dtype,
             weight_init or I.MSRA(uniform=False))
@@ -70,9 +72,12 @@ class Conv2D(Layer):
     def forward(self, x):
         pol = get_policy()
         out = ON.conv2d(pol.cast_to_compute(x), pol.cast_to_compute(self.weight),
-                        self.stride, self.padding, self.dilation, self.groups)
+                        self.stride, self.padding, self.dilation, self.groups,
+                        data_format=self.data_format)
         if self.has_bias:
-            out = out + pol.cast_to_compute(self.bias).reshape(1, -1, 1, 1)
+            bshape = ((1, -1, 1, 1) if self.data_format == "NCHW"
+                      else (1, 1, 1, -1))
+            out = out + pol.cast_to_compute(self.bias).reshape(bshape)
         return _apply_act(pol.cast_to_output(out), self.act)
 
 
@@ -109,16 +114,19 @@ class Pool2D(Layer):
     """reference: dygraph/nn.py Pool2D."""
 
     def __init__(self, kernel_size, pool_type: str = "max", stride=None,
-                 padding=0, global_pooling: bool = False, ceil_mode: bool = False):
+                 padding=0, global_pooling: bool = False,
+                 ceil_mode: bool = False, data_format: str = "NCHW"):
         super().__init__()
         self.kernel_size, self.pool_type = kernel_size, pool_type
         self.stride, self.padding = stride, padding
         self.global_pooling, self.ceil_mode = global_pooling, ceil_mode
+        self.data_format = data_format
 
     def forward(self, x):
         return ON.pool2d(x, self.kernel_size, self.pool_type, self.stride,
                          self.padding, ceil_mode=self.ceil_mode,
-                         global_pooling=self.global_pooling)
+                         global_pooling=self.global_pooling,
+                         data_format=self.data_format)
 
 
 class BatchNorm(Layer):

@@ -34,10 +34,26 @@ def _pair(v: IntOrPair, n: int = 2) -> Tuple[int, ...]:
 # ---------------------------------------------------------------------------
 
 def conv2d(x, weight, stride: IntOrPair = 1, padding: IntOrPair = 0,
-           dilation: IntOrPair = 1, groups: int = 1):
-    """NCHW conv; weight is OIHW (reference conv2d layout)."""
+           dilation: IntOrPair = 1, groups: int = 1,
+           data_format: str = "NCHW"):
+    """Conv with the reference's NCHW/OIHW default layout; pass
+    ``data_format="NHWC"`` for the TPU-native channels-last path (weight
+    stays OIHW at the API — it is transposed to HWIO internally, which XLA
+    folds into the kernel constant; NHWC avoids the layout transposes TPU
+    convs otherwise insert around NCHW activations)."""
     stride, dilation = _pair(stride), _pair(dilation)
     pad = _pair(padding)
+    enforce(data_format in ("NCHW", "NHWC"),
+            "conv2d data_format must be NCHW|NHWC, got %s", data_format)
+    if data_format == "NHWC":
+        return lax.conv_general_dilated(
+            x, jnp.transpose(weight, (2, 3, 1, 0)),  # OIHW -> HWIO
+            window_strides=stride,
+            padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+            rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
     return lax.conv_general_dilated(
         x, weight,
         window_strides=stride,
@@ -105,24 +121,33 @@ def conv3d(x, weight, stride: IntOrPair = 1, padding: IntOrPair = 0,
 def pool2d(x, kernel_size: IntOrPair, pool_type: str = "max",
            stride: Optional[IntOrPair] = None, padding: IntOrPair = 0,
            ceil_mode: bool = False, exclusive: bool = True,
-           global_pooling: bool = False):
+           global_pooling: bool = False, data_format: str = "NCHW"):
+    enforce(data_format in ("NCHW", "NHWC"),
+            "pool2d data_format must be NCHW|NHWC, got %s", data_format)
+    spatial = (2, 3) if data_format == "NCHW" else (1, 2)
     if global_pooling:
-        kernel_size = x.shape[2:4]
+        kernel_size = (x.shape[spatial[0]], x.shape[spatial[1]])
         padding = 0
         stride = kernel_size
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
     p = _pair(padding)
-    dims = (1, 1) + k
-    strides = (1, 1) + s
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if data_format == "NCHW":
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
     if ceil_mode:
         # extend right/bottom padding so the last partial window is included
         pads = list(pads)
-        for i, (dim, kk, ss, pp) in enumerate(zip(x.shape[2:], k, s, p)):
+        hw = (x.shape[spatial[0]], x.shape[spatial[1]])
+        for i, (dim, kk, ss, pp) in enumerate(zip(hw, k, s, p)):
             out = -(-(dim + 2 * pp - kk) // ss) + 1
             need = (out - 1) * ss + kk - dim - 2 * pp
-            pads[2 + i] = (pp, pp + max(0, need))
+            pads[spatial[0] + i] = (pp, pp + max(0, need))
         pads = tuple(pads)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min

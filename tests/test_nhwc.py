@@ -1,0 +1,90 @@
+"""NHWC (channels-last, TPU-preferred) layout path: op-level parity with
+NCHW and end-to-end ResNet equivalence with shared weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.ops import nn as N
+
+RNG = np.random.default_rng(111)
+
+
+class TestOpsNHWC:
+    def test_conv2d_layouts_agree(self):
+        x = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        w = RNG.normal(size=(4, 3, 3, 3)).astype(np.float32)
+        ref = N.conv2d(jnp.asarray(x), jnp.asarray(w), stride=2, padding=1)
+        got = N.conv2d(jnp.asarray(x.transpose(0, 2, 3, 1)), jnp.asarray(w),
+                       stride=2, padding=1, data_format="NHWC")
+        np.testing.assert_allclose(np.asarray(got).transpose(0, 3, 1, 2),
+                                   np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+    def test_pool2d_layouts_agree(self):
+        x = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        for ptype in ("max", "avg"):
+            ref = N.pool2d(jnp.asarray(x), 3, ptype, stride=2, padding=1)
+            got = N.pool2d(jnp.asarray(x.transpose(0, 2, 3, 1)), 3, ptype,
+                           stride=2, padding=1, data_format="NHWC")
+            np.testing.assert_allclose(
+                np.asarray(got).transpose(0, 3, 1, 2), np.asarray(ref),
+                rtol=1e-5, atol=1e-5)
+
+    def test_pool2d_global_nhwc(self):
+        x = RNG.normal(size=(2, 5, 5, 3)).astype(np.float32)
+        out = N.pool2d(jnp.asarray(x), 1, "avg", global_pooling=True,
+                       data_format="NHWC")
+        np.testing.assert_allclose(np.asarray(out)[:, 0, 0, :],
+                                   x.mean(axis=(1, 2)), rtol=1e-5)
+
+
+class TestResNetNHWC:
+    def test_resnet_nhwc_matches_nchw(self):
+        from paddle_tpu.models import resnet
+
+        pt.seed(0)
+        m_nchw = resnet.ResNet(resnet.BasicBlock, [1, 1, 1], num_classes=5,
+                               cifar=True)
+        pt.seed(0)
+        m_nhwc = resnet.ResNet(resnet.BasicBlock, [1, 1, 1], num_classes=5,
+                               cifar=True, data_format="NHWC")
+        # identical params by construction (same seed); verify
+        p1, p2 = m_nchw.named_parameters(), m_nhwc.named_parameters()
+        assert set(p1) == set(p2)
+        x = jnp.asarray(RNG.normal(size=(2, 3, 16, 16)).astype(np.float32))
+        out1, _ = m_nchw.functional_call(p1, x, training=False)
+        out2, _ = m_nhwc.functional_call(p1, x, training=False)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(out1),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_resnet50_nhwc_trains(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.models import resnet
+
+        pt.seed(0)
+        model = resnet.resnet50(num_classes=10, data_format="NHWC")
+        params = model.named_parameters()
+        buffers = model.named_buffers()
+        opt = optimizer.SGD(0.01)
+        state = opt.init(params)
+        x = jnp.asarray(RNG.normal(size=(2, 3, 64, 64)).astype(np.float32))
+        label = jnp.asarray(RNG.integers(0, 10, 2))
+
+        @jax.jit
+        def step(params, buffers, state):
+            def loss(p):
+                out, nb = model.functional_call(p, x, buffers=buffers,
+                                                training=True)
+                return resnet.loss_fn(out, label), nb
+
+            (l, nb), g = jax.value_and_grad(loss, has_aux=True)(params)
+            params, state = opt.apply(params, g, state)
+            return params, nb, state, l
+
+        l0 = None
+        for i in range(3):
+            params, buffers, state, l = step(params, buffers, state)
+            if i == 0:
+                l0 = float(l)
+        assert np.isfinite(float(l)) and float(l) <= l0 * 1.5
