@@ -38,6 +38,7 @@ _LAZY = {
     "slim": ".slim",
     "utils": ".utils",
     "jit": ".jit",
+    "nets": ".nets",
 }
 
 

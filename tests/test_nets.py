@@ -1,0 +1,49 @@
+"""nets.py composition helpers (reference: python/paddle/fluid/nets.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nets
+
+RNG = np.random.default_rng(121)
+
+
+def test_simple_img_conv_pool():
+    pt.seed(0)
+    net = nets.simple_img_conv_pool(1, 4, 3, 2, 2)
+    x = jnp.asarray(RNG.normal(size=(2, 1, 8, 8)).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 4, 3, 3)
+
+
+def test_img_conv_group_with_bn():
+    pt.seed(0)
+    net = nets.img_conv_group(3, [8, 8], conv_with_batchnorm=True)
+    x = jnp.asarray(RNG.normal(size=(2, 3, 8, 8)).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 8, 4, 4)
+
+
+def test_sequence_conv_pool():
+    pt.seed(0)
+    net = nets.SequenceConvPool(6, 5, 3)
+    x = jnp.asarray(RNG.normal(size=(2, 7, 6)).astype(np.float32))
+    lengths = jnp.asarray(np.array([7, 3]))
+    out = net(x, lengths)
+    assert out.shape == (2, 5)
+    assert np.all(np.isfinite(out))
+
+
+def test_glu():
+    x = jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+    out = nets.glu(x)
+    assert out.shape == (4, 4)
+    a, b = np.split(np.asarray(x), 2, axis=-1)
+    np.testing.assert_allclose(out, a / (1 + np.exp(-b)) * 1.0, rtol=1e-5)
+
+
+def test_scaled_dot_product_attention_reexport():
+    q = jnp.asarray(RNG.normal(size=(2, 4, 2, 8)).astype(np.float32))
+    out = nets.scaled_dot_product_attention(q, q, q)
+    assert out.shape == q.shape
