@@ -178,9 +178,10 @@ class Fleet:
         self._check()
         from .parallel.api import Trainer
 
-        return Trainer.supervised(model, optimizer, loss_fn, metrics_fn,
-                                  mesh=self.mesh,
-                                  amp=self._strategy.amp, **kw)
+        return Trainer.supervised(
+            model, optimizer, loss_fn, metrics_fn, mesh=self.mesh,
+            amp=self._strategy.amp,
+            grad_accum_steps=self._strategy.gradient_merge_steps, **kw)
 
     def _check(self):
         enforce(self._initialized, "call fleet.init() first")

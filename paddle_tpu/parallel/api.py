@@ -36,7 +36,8 @@ class Trainer:
                  loss_builder: Callable, mesh=None,
                  build_strategy: Optional[BuildStrategy] = None,
                  param_spec: Optional[Dict[str, P]] = None,
-                 opt_state_rules=None, amp: Optional[str] = None):
+                 opt_state_rules=None, amp: Optional[str] = None,
+                 grad_accum_steps: int = 1):
         self.model = model
         self.optimizer = optimizer
         self.loss_builder = loss_builder
@@ -47,6 +48,11 @@ class Trainer:
         # decorator capability; bf16 needs no loss scaling — pair
         # "mixed_fp16" with amp.decorate()'d optimizer for scaling)
         self.amp_policy = amp
+        # gradient merge (reference: fleet DistributedStrategy
+        # gradient_merge / gradient accumulation): average grads over K
+        # micro-steps, apply the optimizer on the K-th
+        enforce(grad_accum_steps >= 1, "grad_accum_steps must be >= 1")
+        self.grad_accum_steps = grad_accum_steps
 
         rep = NamedSharding(self.mesh, P())
 
@@ -72,8 +78,14 @@ class Trainer:
             # transpiler/distribute_transpiler.py:702)
             self.opt_state = opt_state_rules.place(self.opt_state, self.mesh)
         self._rng = prandom.next_key()
-        donate = (0, 1, 2) if self.strategy.donate_inputs else ()
-        self._jit_step = jax.jit(self._step, donate_argnums=donate)
+        if self.grad_accum_steps > 1:
+            self._accum = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+            self._accum_count = jnp.zeros((), jnp.int32)
+            donate = (0, 1, 2, 3, 4) if self.strategy.donate_inputs else ()
+            self._jit_step = jax.jit(self._accum_step, donate_argnums=donate)
+        else:
+            donate = (0, 1, 2) if self.strategy.donate_inputs else ()
+            self._jit_step = jax.jit(self._step, donate_argnums=donate)
         self._jit_eval = jax.jit(self._eval_step)
 
     # --- pure step functions ------------------------------------------------
@@ -102,6 +114,45 @@ class Trainer:
                                                          opt_state)
         return loss, metrics, new_params, new_buffers, new_opt_state
 
+    def _accum_step(self, params, buffers, opt_state, accum, count, rng,
+                    batch):
+        """Gradient-merge micro-step: accumulate; apply on the K-th."""
+        import contextlib
+
+        from ..amp import MixedPrecisionOptimizer
+        from ..core.dtypes import policy_scope
+
+        scope = (policy_scope(self.amp_policy) if self.amp_policy
+                 else contextlib.nullcontext())
+        scaled = isinstance(self.optimizer, MixedPrecisionOptimizer)
+
+        def lf(p):
+            with scope:
+                loss, (metrics, new_buffers) = self.loss_builder(
+                    p, buffers, rng, batch)
+            out_loss = (self.optimizer.scale_loss(loss, opt_state)
+                        if scaled else loss)
+            return out_loss, (loss, metrics, new_buffers)
+
+        (_, (loss, metrics, new_buffers)), grads = jax.value_and_grad(
+            lf, has_aux=True)(params)
+        k = self.grad_accum_steps
+        accum = jax.tree_util.tree_map(lambda a, g: a + g, accum, grads)
+        count = count + 1
+        do_apply = count >= k
+        mean_grads = jax.tree_util.tree_map(lambda a: a / k, accum)
+        cand_params, cand_opt = self.optimizer.apply(params, mean_grads,
+                                                     opt_state)
+        sel = lambda new, old: jax.tree_util.tree_map(
+            lambda n, o: jnp.where(do_apply, n, o), new, old)
+        new_params = sel(cand_params, params)
+        new_opt = sel(cand_opt, opt_state)
+        accum = jax.tree_util.tree_map(
+            lambda a: jnp.where(do_apply, jnp.zeros_like(a), a), accum)
+        count = jnp.where(do_apply, 0, count)
+        return (loss, metrics, new_params, new_buffers, new_opt, accum,
+                count)
+
     def _eval_step(self, params, buffers, batch):
         import contextlib
 
@@ -123,9 +174,15 @@ class Trainer:
         # op run, platform/profiler.h:81) — here one span per compiled step
         with RecordEvent("train_step"):
             self._rng, sub = jax.random.split(self._rng)
-            loss, metrics, self.params, self.buffers, self.opt_state = \
-                self._jit_step(self.params, self.buffers, self.opt_state,
-                               sub, batch)
+            if self.grad_accum_steps > 1:
+                (loss, metrics, self.params, self.buffers, self.opt_state,
+                 self._accum, self._accum_count) = self._jit_step(
+                    self.params, self.buffers, self.opt_state, self._accum,
+                    self._accum_count, sub, batch)
+            else:
+                loss, metrics, self.params, self.buffers, self.opt_state = \
+                    self._jit_step(self.params, self.buffers, self.opt_state,
+                                   sub, batch)
         return loss, metrics
 
     def eval_step(self, batch):
@@ -148,9 +205,13 @@ class Trainer:
         """Full resumable training state (params + buffers + optimizer
         moments + RNG) — what the reference persists via save_persistables
         (params + optimizer accumulators, reference: io.py:460)."""
-        return {"params": self.params, "buffers": self.buffers,
-                "opt_state": self.opt_state,
-                "rng": jax.random.key_data(self._rng)}
+        st = {"params": self.params, "buffers": self.buffers,
+              "opt_state": self.opt_state,
+              "rng": jax.random.key_data(self._rng)}
+        if self.grad_accum_steps > 1:
+            st["grad_accum"] = {"accum": self._accum,
+                                "count": self._accum_count}
+        return st
 
     def save_checkpoint(self, manager_or_dir, step: Optional[int] = None):
         from ..checkpoint import CheckpointManager, save_state
@@ -178,6 +239,9 @@ class Trainer:
         self.params = st["params"]
         self.buffers = st["buffers"]
         self.opt_state = st["opt_state"]
+        if self.grad_accum_steps > 1 and "grad_accum" in st:
+            self._accum = st["grad_accum"]["accum"]
+            self._accum_count = st["grad_accum"]["count"]
         self._rng = jax.random.wrap_key_data(jnp.asarray(st["rng"]))
 
     @classmethod

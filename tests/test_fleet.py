@@ -120,3 +120,67 @@ class TestFleetTraining:
                                     tr.data_sharding())}
         losses = [float(tr.train_step(batch)[0]) for _ in range(5)]
         assert losses[-1] < losses[0]
+
+
+class TestGradientMerge:
+    def test_accumulated_equals_big_batch(self):
+        """K micro-steps with grad merge == one step on the concatenated
+        batch (SGD: update is linear in the averaged grads)."""
+        from paddle_tpu import optimizer
+        from paddle_tpu.models import mnist as M
+        from paddle_tpu.parallel import Trainer
+
+        rng = np.random.default_rng(3)
+        xs = rng.normal(size=(4, 8, 784)).astype(np.float32)
+        ys = rng.integers(0, 10, (4, 8))
+
+        pt.seed(0)
+        mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+        acc = Trainer.supervised(M.MnistMLP(hidden1=16, hidden2=8),
+                                 optimizer.SGD(0.1), M.loss_fn, mesh=mesh,
+                                 grad_accum_steps=4)
+        for i in range(4):
+            acc.train_step({"x": jnp.asarray(xs[i]),
+                            "label": jnp.asarray(ys[i])})
+
+        pt.seed(0)
+        big = Trainer.supervised(M.MnistMLP(hidden1=16, hidden2=8),
+                                 optimizer.SGD(0.1), M.loss_fn, mesh=mesh)
+        big.train_step({"x": jnp.asarray(xs.reshape(32, 784)),
+                        "label": jnp.asarray(ys.reshape(32))})
+
+        for k in acc.params:
+            np.testing.assert_allclose(np.asarray(acc.params[k]),
+                                       np.asarray(big.params[k]),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_no_update_until_kth_step(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.models import mnist as M
+        from paddle_tpu.parallel import Trainer
+
+        rng = np.random.default_rng(4)
+        pt.seed(0)
+        mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+        tr = Trainer.supervised(M.MnistMLP(hidden1=16, hidden2=8),
+                                optimizer.SGD(0.1), M.loss_fn, mesh=mesh,
+                                grad_accum_steps=3)
+        w0 = np.asarray(tr.params["fc1.weight"]).copy()
+        batch = {"x": jnp.asarray(rng.normal(size=(8, 784))
+                                  .astype(np.float32)),
+                 "label": jnp.asarray(rng.integers(0, 10, 8))}
+        tr.train_step(batch)
+        tr.train_step(batch)
+        np.testing.assert_allclose(np.asarray(tr.params["fc1.weight"]), w0)
+        tr.train_step(batch)  # 3rd micro-step applies
+        assert not np.allclose(np.asarray(tr.params["fc1.weight"]), w0)
+
+    def test_fleet_strategy_wires_through(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.models import mnist as M
+
+        f = fleet.init(strategy=fleet.DistributedStrategy(
+            gradient_merge_steps=2))
+        tr = f.trainer(M.MnistMLP(hidden1=16, hidden2=8),
+                       optimizer.SGD(0.1), M.loss_fn)
+        assert tr.grad_accum_steps == 2
