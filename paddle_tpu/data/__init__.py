@@ -1,6 +1,8 @@
 """Data layer: reader decorators, feeders, datasets, ragged batching."""
 
 from . import dataset
+from .bucketing import (bucket_by_length, pad_to,
+                        quantile_boundaries)
 from .data_generator import MultiSlotDataGenerator
 from .dataset import MultiSlotDataset, train_from_dataset
 from .feeder import DataFeeder, DeviceLoader
@@ -9,6 +11,7 @@ from .reader import (batch, buffered, cache, chain, compose, firstn,
 
 __all__ = [
     "MultiSlotDataGenerator", "train_from_dataset",
+    "bucket_by_length", "pad_to", "quantile_boundaries",
     "dataset", "MultiSlotDataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",
     "chain", "compose", "firstn", "map_readers", "shuffle", "xmap_readers",
 ]

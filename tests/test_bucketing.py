@@ -1,0 +1,77 @@
+"""Length-bucketing tests: bounded shape count, content preservation,
+quantile boundaries, integration with sequence ops."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.data import bucket_by_length, pad_to, quantile_boundaries
+from paddle_tpu.data.bucketing import compile_shape_count
+
+RNG = np.random.default_rng(131)
+
+
+def var_len_reader(n=100, lo=1, hi=40):
+    lengths = RNG.integers(lo, hi, n)
+
+    def reader():
+        for l in lengths:
+            yield np.arange(l, dtype=np.float32)
+
+    return reader, lengths
+
+
+class TestBucketing:
+    def test_shape_count_bounded(self):
+        reader, _ = var_len_reader(200, 1, 40)
+        bucketed = bucket_by_length(reader, [8, 16, 24, 40], batch_size=8)
+        batches = list(bucketed())
+        assert compile_shape_count(batches) <= 4 * 2  # full + remainder B
+        for b in batches:
+            assert b["data"].shape[1] in (8, 16, 24, 40)
+
+    def test_content_and_lengths_preserved(self):
+        reader, lengths = var_len_reader(50, 1, 16)
+        bucketed = bucket_by_length(reader, [16], batch_size=50)
+        (batch,) = list(bucketed())
+        np.testing.assert_array_equal(np.sort(batch["lengths"]),
+                                      np.sort(lengths))
+        for row, l in zip(batch["data"], batch["lengths"]):
+            np.testing.assert_array_equal(row[:l], np.arange(l))
+            np.testing.assert_array_equal(row[l:], 0)
+
+    def test_too_long_raises_or_drops(self):
+        def reader():
+            yield np.zeros(100, np.float32)
+
+        with pytest.raises(EnforceError, match="exceeds largest bucket"):
+            list(bucket_by_length(reader, [8], 4)())
+        assert list(bucket_by_length(reader, [8], 4, drop_long=True)()) == []
+
+    def test_tuple_samples_carry_extras(self):
+        def reader():
+            yield (np.ones(3, np.float32), 7)
+            yield (np.ones(5, np.float32), 9)
+
+        (batch,) = list(bucket_by_length(reader, [8], 4)())
+        assert batch["extras"] == [(7,), (9,)]
+
+    def test_quantile_boundaries(self):
+        b = quantile_boundaries(list(range(1, 101)), 4, round_to=8)
+        assert b == sorted(set(b))
+        assert b[-1] >= 100
+        assert all(x % 8 == 0 for x in b)
+
+    def test_with_sequence_pool(self):
+        from paddle_tpu.ops.sequence import sequence_pool
+
+        reader, _ = var_len_reader(32, 2, 16)
+        bucketed = bucket_by_length(reader, [16], batch_size=32)
+        (batch,) = list(bucketed())
+        pooled = sequence_pool(jnp.asarray(batch["data"][..., None]),
+                               jnp.asarray(batch["lengths"]), "average")
+        # avg of arange(l) = (l-1)/2
+        expect = (batch["lengths"] - 1) / 2
+        np.testing.assert_allclose(pooled[:, 0], expect, rtol=1e-5)
