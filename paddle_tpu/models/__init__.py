@@ -1,8 +1,8 @@
 """Model zoo — the reference's benchmark/book models rebuilt TPU-first
 (reference: benchmark/fluid/models/, tests/book/)."""
 
-from . import (bert, deepfm, mnist, resnet, se_resnext, stacked_lstm,
-               transformer, vgg)
+from . import (bert, deepfm, mnist, recommender, resnet, se_resnext,
+               stacked_lstm, transformer, vgg)
 
-__all__ = ["bert", "deepfm", "mnist", "resnet", "se_resnext", "stacked_lstm",
-           "transformer", "vgg"]
+__all__ = ["bert", "deepfm", "mnist", "recommender", "resnet",
+           "se_resnext", "stacked_lstm", "transformer", "vgg"]

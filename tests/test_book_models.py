@@ -165,3 +165,109 @@ class TestMachineTranslation:
         model.set_parameters(jax.device_get(params))
         decoded = model.greedy_decode(jnp.asarray(src[:2]), max_len=8)
         assert np.all((np.asarray(decoded) >= 0) & (np.asarray(decoded) < 30))
+
+
+class TestRecommenderSystem:
+    """Book recommender_system: feature-fusion two-tower rating model."""
+
+    def test_trains_to_fit_ratings(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.models import recommender as R
+
+        pt.seed(0)
+        model = R.RecommenderNet(num_users=20, num_items=30, embed_dim=8,
+                                 fc_dim=16)
+        params = model.named_parameters()
+        opt = optimizer.Adam(5e-3)
+        state = opt.init(params)
+        b = 32
+        user = jnp.asarray(RNG.integers(0, 20, b))
+        gender = jnp.asarray(RNG.integers(0, 2, b))
+        age = jnp.asarray(RNG.integers(0, 7, b))
+        job = jnp.asarray(RNG.integers(0, 21, b))
+        item = jnp.asarray(RNG.integers(0, 30, b))
+        cats = jnp.asarray(RNG.integers(0, 19, (b, 3)))
+        rating = jnp.asarray(RNG.uniform(1, 5, b).astype(np.float32))
+
+        @jax.jit
+        def step(params, state):
+            def loss(p):
+                pred, _ = model.functional_call(p, user, gender, age, job,
+                                                item, cats)
+                return R.loss_fn(pred, rating)
+
+            l, g = jax.value_and_grad(loss)(params)
+            params, state = opt.apply(params, g, state)
+            return params, state, l
+
+        losses = []
+        for _ in range(60):
+            params, state, l = step(params, state)
+            losses.append(float(l))
+        assert losses[-1] < 0.5 * losses[0]
+        # predictions land in the rating range
+        pred, _ = model.functional_call(params, user, gender, age, job,
+                                        item, cats)
+        assert float(jnp.max(jnp.abs(pred))) <= 5.0 + 1e-5
+
+
+class TestLabelSemanticRoles:
+    """Book label_semantic_roles role: BiLSTM-CRF sequence tagging."""
+
+    def test_crf_tagger_learns(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.ops.decode import crf_decoding, linear_chain_crf
+
+        pt.seed(0)
+        vocab, tags, emb, hid = 30, 4, 8, 8
+        model = pt.nn.Sequential()
+
+        class Tagger(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = pt.nn.Embedding(vocab, emb)
+                self.lstm = pt.nn.LSTM(emb, hid, direction="bidirect")
+                self.proj = pt.nn.Linear(2 * hid, tags)
+                from paddle_tpu import initializer as I
+
+                self.create_parameter("transition", (tags, tags), None,
+                                      I.XavierUniform())
+
+            def forward(self, ids, lengths):
+                h, _ = self.lstm(self.emb(ids), lengths=lengths)
+                return self.proj(h)
+
+        tagger = Tagger()
+        params = tagger.named_parameters()
+        opt = optimizer.Adam(1e-2)
+        state = opt.init(params)
+        b, t = 8, 10
+        ids = RNG.integers(0, vocab, (b, t))
+        labels = ids % tags  # deterministic tag rule to learn
+        lengths = np.full((b,), t)
+
+        @jax.jit
+        def step(params, state):
+            def loss(p):
+                logits, _ = tagger.functional_call(
+                    p, jnp.asarray(ids), jnp.asarray(lengths))
+                nll = linear_chain_crf(logits, p["transition"],
+                                       jnp.asarray(labels),
+                                       jnp.asarray(lengths))
+                return jnp.mean(nll)
+
+            l, g = jax.value_and_grad(loss)(params)
+            params, state = opt.apply(params, g, state)
+            return params, state, l
+
+        losses = []
+        for _ in range(40):
+            params, state, l = step(params, state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+        logits, _ = tagger.functional_call(params, jnp.asarray(ids),
+                                           jnp.asarray(lengths))
+        decoded, _ = crf_decoding(logits, params["transition"],
+                                  jnp.asarray(lengths))
+        acc = np.mean(np.asarray(decoded) == labels)
+        assert acc > 0.5
