@@ -249,9 +249,67 @@ def bench_stacked_lstm(steps: int, batch_size: int, amp=None):
                         amp=amp)
 
 
+def bench_vgg16(steps: int, batch_size: int, smoke: bool = False, amp=None):
+    """Bench model: vgg (reference benchmark/fluid/models/vgg.py)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import vgg as V
+
+    pt.seed(0)
+    size = 224  # vgg's classifier is fixed to 7x7 feature maps
+    batch_size = min(batch_size, 2 if smoke else 64)
+    model = V.vgg16(num_classes=1000) if hasattr(V, "vgg16") else V.VGG16()
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        return (jnp.asarray(rng.normal(size=(bs, 3, size, size))
+                            .astype(np.float32)),)
+
+    def loss_fn(logits, batch):
+        from paddle_tpu.ops import loss as L
+
+        labels = jnp.zeros((logits.shape[0],), jnp.int32)
+        return jnp.mean(L.softmax_with_cross_entropy(logits, labels))
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
+
+
+def bench_se_resnext50(steps: int, batch_size: int, smoke: bool = False,
+                       amp=None):
+    """Bench model: se_resnext (reference benchmark list)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import se_resnext as S
+
+    pt.seed(0)
+    size = 64 if smoke else 224
+    batch_size = min(batch_size, 8 if smoke else 64)
+    model = (S.se_resnext50(num_classes=1000)
+             if hasattr(S, "se_resnext50") else S.SEResNeXt())
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        return (jnp.asarray(rng.normal(size=(bs, 3, size, size))
+                            .astype(np.float32)),)
+
+    def loss_fn(logits, batch):
+        from paddle_tpu.ops import loss as L
+
+        labels = jnp.zeros((logits.shape[0],), jnp.int32)
+        return jnp.mean(L.softmax_with_cross_entropy(logits, labels))
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
+
+
 MODELS = {
     "mnist_mlp": bench_mnist_mlp,
     "stacked_lstm": bench_stacked_lstm,
+    "vgg16": bench_vgg16,
+    "se_resnext50": bench_se_resnext50,
     "resnet50": bench_resnet50,
     "bert_base": bench_bert_base,
     "transformer_nmt": bench_transformer_nmt,

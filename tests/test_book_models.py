@@ -271,3 +271,56 @@ class TestLabelSemanticRoles:
                                   jnp.asarray(lengths))
         acc = np.mean(np.asarray(decoded) == labels)
         assert acc > 0.5
+
+
+class TestUnderstandSentiment:
+    """Book understand_sentiment conv variant: text CNN via
+    nets.SequenceConvPool."""
+
+    def test_text_cnn_trains(self):
+        from paddle_tpu import nets, optimizer
+
+        pt.seed(0)
+        vocab, emb_dim = 50, 16
+
+        class TextCNN(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = pt.nn.Embedding(vocab, emb_dim)
+                self.conv3 = nets.SequenceConvPool(emb_dim, 8, 3)
+                self.conv4 = nets.SequenceConvPool(emb_dim, 8, 4)
+                self.fc = pt.nn.Linear(16, 2)
+
+            def forward(self, ids, lengths):
+                h = self.emb(ids)
+                feat = jnp.concatenate([self.conv3(h, lengths),
+                                        self.conv4(h, lengths)], axis=-1)
+                return self.fc(feat)
+
+        model = TextCNN()
+        params = model.named_parameters()
+        opt = optimizer.Adam(1e-2)
+        state = opt.init(params)
+        ids = RNG.integers(0, vocab, (16, 12))
+        lengths = RNG.integers(4, 13, 16)
+        label = (ids[:, 0] % 2).astype(np.int32)
+        from paddle_tpu.ops import loss as L
+
+        @jax.jit
+        def step(params, state):
+            def loss(p):
+                logits, _ = model.functional_call(
+                    p, jnp.asarray(ids), jnp.asarray(lengths))
+                return jnp.mean(L.softmax_with_cross_entropy(
+                    logits, jnp.asarray(label)))
+
+            l, g = jax.value_and_grad(loss)(params)
+            params, state = opt.apply(params, g, state)
+            return params, state, l
+
+        losses = []
+        for _ in range(30):
+            params, state, l = step(params, state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses[-1])
