@@ -328,9 +328,20 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step: int, tree) -> None:
-        handle = save_state(self._step_dir(step), tree,
-                            async_save=self.async_save)
+        # serialize writes targeting the same step dir: a second async save
+        # of step N while the first is in flight would collide on the
+        # shared .tmp staging path
+        target = self._step_dir(step)
+        still = []
+        for t in self._pending:
+            if getattr(t, "directory", None) == target:
+                t.join()
+            else:
+                still.append(t)
+        self._pending = still
+        handle = save_state(target, tree, async_save=self.async_save)
         if isinstance(handle, _WriteHandle):
+            handle.directory = target
             self._pending.append(handle)
         self._gc()
 

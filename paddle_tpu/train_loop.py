@@ -162,6 +162,10 @@ class TrainLoop:
         framework/executor.cc:73): final snapshot + join async writers."""
         if self._watchdog:
             self._watchdog.stop()
+        # join in-flight writes FIRST so all_steps() sees them — otherwise
+        # a still-writing periodic snapshot of this same step would race
+        # the final one on the shared .tmp staging dir
+        self.manager.wait_until_finished()
         if self.step > 0 and self.step not in self.manager.all_steps():
             self.manager.save(self.step, self.trainer.state())
         self.manager.wait_until_finished()
