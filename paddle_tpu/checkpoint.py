@@ -147,7 +147,8 @@ class _WriteHandle:
     thread's exception would otherwise vanish into stderr and a 'successful'
     checkpoint would not exist on disk)."""
 
-    def __init__(self, fn=None):
+    def __init__(self, fn=None, directory: Optional[str] = None):
+        self.directory = directory  # write target, for same-dir serializing
         self._exc: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         if fn is not None:
@@ -218,9 +219,9 @@ def save_state(directory: str, tree, *, async_save: bool = False):
         os.replace(tmp, directory)
 
     if jax.process_index() != 0:  # non-writer hosts only snapshot
-        return _WriteHandle()
+        return _WriteHandle(directory=directory)
     if async_save:
-        return _WriteHandle(write)
+        return _WriteHandle(write, directory=directory)
     write()
     return None
 
@@ -334,14 +335,13 @@ class CheckpointManager:
         target = self._step_dir(step)
         still = []
         for t in self._pending:
-            if getattr(t, "directory", None) == target:
+            if t.directory == target:
                 t.join()
             else:
                 still.append(t)
         self._pending = still
         handle = save_state(target, tree, async_save=self.async_save)
         if isinstance(handle, _WriteHandle):
-            handle.directory = target
             self._pending.append(handle)
         self._gc()
 

@@ -164,8 +164,23 @@ class TrainLoop:
             self._watchdog.stop()
         # join in-flight writes FIRST so all_steps() sees them — otherwise
         # a still-writing periodic snapshot of this same step would race
-        # the final one on the shared .tmp staging dir
-        self.manager.wait_until_finished()
+        # the final one on the shared .tmp staging dir. An earlier write's
+        # failure must NOT abort the final snapshot (durability first):
+        # defer it and re-raise after the final save attempt.
+        deferred: Optional[BaseException] = None
+        try:
+            self.manager.wait_until_finished()
+        except BaseException as e:
+            deferred = e
         if self.step > 0 and self.step not in self.manager.all_steps():
             self.manager.save(self.step, self.trainer.state())
         self.manager.wait_until_finished()
+        if deferred is not None:
+            import sys
+
+            if sys.exc_info()[0] is None:
+                raise deferred
+            # close() ran from an exception's finally — don't mask the
+            # original training error with the old write failure
+            print(f"[train_loop] deferred checkpoint-write failure: "
+                  f"{deferred!r}", file=sys.stderr)
