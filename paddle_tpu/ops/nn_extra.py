@@ -50,10 +50,17 @@ def pool3d(x, kernel_size, pool_type: str = "max", stride=None, padding=0,
 def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
     """reference: operators/pool_with_index_op.cc — max pool that also
     returns the flat (h*w) argmax index per window (consumed by unpool).
-    x: (N, C, H, W) → (out, indices int32)."""
+    x: (N, C, H, W) → (out, indices int32). Differentiable: the VJP
+    scatters the output cotangent back to the argmax positions (the
+    variadic reduce_window that computes indices has no JVP rule, so the
+    gradient is supplied explicitly — exactly MaxPoolWithIndexGrad)."""
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
     p = _pair(padding)
+    return _mpwi(x, k, s, p)
+
+
+def _mpwi_impl(x, k, s, p):
     n, c, h, w = x.shape
     # index grid encoded as float payload alongside values
     idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
@@ -72,6 +79,29 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
     out, out_idx = lax.reduce_window((x, idx), init, reducer, dims, strides,
                                      pads)
     return out, out_idx.astype(jnp.int32)
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _mpwi(x, k, s, p):
+    return _mpwi_impl(x, k, s, p)
+
+
+def _mpwi_fwd(x, k, s, p):
+    out, idx = _mpwi_impl(x, k, s, p)
+    return (out, idx), (idx, x)
+
+
+def _mpwi_bwd(k, s, p, res, g):
+    idx, x = res
+    g_out, _ = g  # index cotangent is meaningless (integer output)
+    gx = unpool(g_out.astype(x.dtype), idx, (x.shape[2], x.shape[3]))
+    return (gx,)
+
+
+_mpwi.defvjp(_mpwi_fwd, _mpwi_bwd)
 
 
 def max_pool3d_with_index(x, kernel_size, stride=None, padding=0):

@@ -1,0 +1,115 @@
+"""Finite-difference gradient checks for the gap-fill op groups (the
+OpTest check_grad tier for ops that previously only had forward tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from op_test import check_grad
+from paddle_tpu import ops as O
+
+RNG = np.random.default_rng(141)
+
+
+def u(shape, scale=0.5):
+    return (RNG.uniform(-1, 1, shape) * scale).astype(np.float32)
+
+
+class TestNNExtraGrads:
+    def test_pool3d_avg_grad(self):
+        x = u((1, 2, 4, 4, 4))
+        check_grad(lambda a: jnp.sum(O.pool3d(a, 2, "avg") ** 2), [x],
+                   rtol=2e-2, atol=1e-3)
+
+    def test_spp_grad(self):
+        # well-separated values: max-pool FD checks are ill-conditioned at
+        # near-ties (eps can flip the argmax)
+        x = (RNG.permutation(32).reshape(1, 2, 4, 4).astype(np.float32)
+             * 0.1)
+        check_grad(lambda a: jnp.sum(O.spp(a, 2, "max") ** 2), [x],
+                   rtol=2e-2, atol=1e-3)
+
+    def test_affine_channel_grad(self):
+        x, s, b = u((2, 3, 4, 4)), u((3,)), u((3,))
+        check_grad(lambda a, ss, bb: jnp.sum(
+            O.affine_channel(a, ss, bb) ** 2), [x, s, b], wrt=[0, 1, 2],
+            rtol=2e-2, atol=1e-3)
+
+    def test_fsp_grad(self):
+        x, y = u((1, 2, 3, 3)), u((1, 3, 3, 3))
+        check_grad(lambda a, b: jnp.sum(O.fsp_matrix(a, b) ** 2), [x, y],
+                   wrt=[0, 1], rtol=2e-2, atol=1e-3)
+
+    def test_tree_conv_grad(self):
+        nodes = u((4, 3))
+        edges = np.zeros((4, 4), np.float32)
+        edges[1, 0] = edges[2, 0] = 1.0
+        w = u((3, 3, 2))
+        check_grad(lambda n, ww: jnp.sum(
+            O.tree_conv(n, jnp.asarray(edges), ww, max_depth=2) ** 2),
+            [nodes, w], wrt=[0, 1], rtol=2e-2, atol=1e-3)
+
+    def test_unpool_grad(self):
+        x = u((1, 2, 4, 4))
+
+        def f(a):
+            out, idx = O.max_pool2d_with_index(a, 2, stride=2)
+            return jnp.sum(O.unpool(out, idx, (4, 4)) ** 2)
+
+        check_grad(f, [x], rtol=2e-2, atol=1e-3)
+
+    def test_data_norm_grad(self):
+        x = u((4, 3))
+        size = np.full((3,), 10.0, np.float32)
+        s = u((3,))
+        sq = np.abs(u((3,))) * 10 + 1.0
+        check_grad(lambda a: jnp.sum(O.data_norm(
+            a, jnp.asarray(size), jnp.asarray(s), jnp.asarray(sq)) ** 2),
+            [x], rtol=2e-2, atol=1e-3)
+
+
+class TestDetectionExtraGrads:
+    def test_psroi_pool_grad(self):
+        x = u((1, 8, 6, 6))
+        rois = np.array([[0, 0.5, 0.5, 5.5, 5.5]], np.float32)
+        check_grad(lambda a: jnp.sum(O.psroi_pool(
+            a, jnp.asarray(rois), output_size=(2, 2)) ** 2), [x],
+            rtol=3e-2, atol=2e-3)
+
+    def test_roi_perspective_transform_grad(self):
+        x = u((1, 1, 5, 5))
+        rois = np.array([[0, 0.5, 0.5, 3.5, 0.5, 3.5, 3.5, 0.5, 3.5]],
+                        np.float32)
+        check_grad(lambda a: jnp.sum(O.roi_perspective_transform(
+            a, jnp.asarray(rois), transformed_height=3,
+            transformed_width=3) ** 2), [x], rtol=3e-2, atol=2e-3)
+
+
+class TestSamplingGrads:
+    def test_hsigmoid_custom_tree_grad_bias(self):
+        table = np.array([[0, 1], [0, 1], [0, 2], [0, 2]], np.int32)
+        code = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.int32)
+        x = u((3, 4))
+        w = u((3, 4))
+        b = u((3,))
+        label = np.array([0, 2, 3])
+        check_grad(lambda xx, bb: jnp.sum(O.hsigmoid_loss(
+            xx, jnp.asarray(label), jnp.asarray(w), bias=bb,
+            path_table=jnp.asarray(table), path_code=jnp.asarray(code))),
+            [x, b], wrt=[0, 1], rtol=2e-2, atol=1e-3)
+
+
+class TestSequenceExtraGrads:
+    def test_sequence_scatter_grad(self):
+        x = u((2, 5))
+        upd = u((2, 3))
+        idx = np.array([[0, 2, 4], [1, 1, 3]])
+        check_grad(lambda a, uu: jnp.sum(O.sequence_scatter(
+            a, jnp.asarray(idx), uu) ** 2), [x, upd], wrt=[0, 1],
+            rtol=2e-2, atol=1e-3)
+
+    def test_add_position_encoding_grad(self):
+        x = u((2, 4, 6))
+        check_grad(lambda a: jnp.sum(
+            O.add_position_encoding(a, 1.5, 0.5) ** 2), [x],
+            rtol=2e-2, atol=1e-3)
