@@ -39,6 +39,7 @@ _LAZY = {
     "utils": ".utils",
     "jit": ".jit",
     "nets": ".nets",
+    "layers": ".layers",
 }
 
 

@@ -19,7 +19,7 @@ from ..core.dtypes import default_dtype, get_policy
 from ..core.enforce import enforce
 from ..ops import math as OM
 from ..ops import nn as ON
-from .layer import Layer
+from .layer import Layer, LayerList
 
 
 class Linear(Layer):
@@ -502,3 +502,97 @@ class Flatten(Layer):
         from ..ops.tensor import flatten
 
         return flatten(x, self.start_axis)
+
+
+class MultiBoxHead(Layer):
+    """SSD detection head over multiple feature maps (reference:
+    python/paddle/fluid/layers/detection.py multi_box_head): a 3x3 conv
+    per map predicts box deltas (4A channels) and class logits (CA
+    channels); priors come from ops.detection.prior_box per map.
+
+    ``in_channels``: channel count of each input feature map (the fluid
+    version infers these from the graph; eager layers declare them).
+    min/max sizes follow the fluid ratio derivation when not given.
+    """
+
+    def __init__(self, in_channels: Sequence[int], image_size,
+                 num_classes: int, *, base_size: Optional[int] = None,
+                 aspect_ratios: Sequence[Sequence[float]] = (),
+                 min_ratio: int = 20, max_ratio: int = 90,
+                 min_sizes: Optional[Sequence[float]] = None,
+                 max_sizes: Optional[Sequence[float]] = None,
+                 steps: Optional[Sequence[float]] = None,
+                 variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                 flip: bool = True, clip: bool = False,
+                 offset: float = 0.5, dtype=None):
+        super().__init__()
+        from ..ops import detection as _D
+
+        n_maps = len(in_channels)
+        self.image_size = ((image_size, image_size)
+                           if isinstance(image_size, int) else
+                           tuple(image_size))
+        base = base_size or self.image_size[0]
+        if min_sizes is None:
+            # fluid derivation: first map at base*10%%, the rest spread
+            # min_ratio..max_ratio evenly (layers/detection.py)
+            min_sizes, max_sizes = [base * 0.1], [base * 0.2]
+            if n_maps > 1:
+                step = int(math.floor((max_ratio - min_ratio)
+                                      / max(n_maps - 2, 1)))
+                for r in range(min_ratio, max_ratio + 1, max(step, 1)):
+                    min_sizes.append(base * r / 100.0)
+                    max_sizes.append(base * (r + step) / 100.0)
+                min_sizes = min_sizes[:n_maps]
+                max_sizes = max_sizes[:n_maps]
+        self.min_sizes = [([s] if not isinstance(s, (list, tuple)) else
+                           list(s)) for s in min_sizes]
+        self.max_sizes = [([s] if not isinstance(s, (list, tuple)) else
+                           list(s)) for s in (max_sizes or [])]
+        if not aspect_ratios:
+            aspect_ratios = [[2.0]] * n_maps
+        self.aspect_ratios = [list(a) for a in aspect_ratios]
+        self.steps = steps
+        self.variances = tuple(variances)
+        self.flip, self.clip, self.offset = flip, clip, offset
+        self.num_classes = num_classes
+
+        self.num_priors = []
+        self.loc_convs = LayerList()
+        self.conf_convs = LayerList()
+        for i, c_in in enumerate(in_channels):
+            a = _D.prior_box_count(
+                self.min_sizes[i],
+                self.max_sizes[i] if self.max_sizes else (),
+                self.aspect_ratios[i], flip)
+            self.num_priors.append(a)
+            self.loc_convs.append(Conv2D(c_in, a * 4, 3, padding=1,
+                                         dtype=dtype))
+            self.conf_convs.append(Conv2D(c_in, a * num_classes, 3,
+                                          padding=1, dtype=dtype))
+
+    def forward(self, inputs):
+        from ..ops import detection as _D
+
+        locs, confs, boxes, variances = [], [], [], []
+        for i, x in enumerate(inputs):
+            n = x.shape[0]
+            loc = self.loc_convs[i](x)          # (N, 4A, H, W)
+            conf = self.conf_convs[i](x)        # (N, CA, H, W)
+            h, w = x.shape[2], x.shape[3]
+            locs.append(jnp.transpose(loc, (0, 2, 3, 1))
+                        .reshape(n, -1, 4))
+            confs.append(jnp.transpose(conf, (0, 2, 3, 1))
+                         .reshape(n, -1, self.num_classes))
+            step = ((self.steps[i], self.steps[i])
+                    if self.steps else (0.0, 0.0))
+            b, v = _D.prior_box(
+                (h, w), self.image_size, self.min_sizes[i],
+                self.max_sizes[i] if self.max_sizes else (),
+                self.aspect_ratios[i], variances=self.variances,
+                flip=self.flip, clip=self.clip, step=step,
+                offset=self.offset)
+            boxes.append(b.reshape(-1, 4))
+            variances.append(v.reshape(-1, 4))
+        return (jnp.concatenate(locs, 1), jnp.concatenate(confs, 1),
+                jnp.concatenate(boxes, 0), jnp.concatenate(variances, 0))

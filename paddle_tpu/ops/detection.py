@@ -115,6 +115,28 @@ def polygon_box_transform(x):
 # Anchors
 # ---------------------------------------------------------------------------
 
+def expand_aspect_ratios(aspect_ratios: Sequence[float],
+                         flip: bool = False) -> list:
+    """The SSD prior aspect-ratio expansion (dedup + optional reciprocal),
+    shared by prior_box and nn.MultiBoxHead so conv channel counts always
+    match generated prior counts."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    return ars
+
+
+def prior_box_count(min_sizes: Sequence[float], max_sizes: Sequence[float],
+                    aspect_ratios: Sequence[float],
+                    flip: bool = False) -> int:
+    """Number of priors per spatial cell that prior_box will generate."""
+    ars = expand_aspect_ratios(aspect_ratios, flip)
+    return len(min_sizes) * len(ars) + len(list(zip(min_sizes, max_sizes)))
+
+
 def prior_box(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
               min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
               aspect_ratios: Sequence[float] = (1.0,), *,
@@ -128,12 +150,7 @@ def prior_box(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
     img_h, img_w = image_hw
     step_h = step[0] or img_h / H
     step_w = step[1] or img_w / W
-    ars = [1.0]
-    for ar in aspect_ratios:
-        if all(abs(ar - a) > 1e-6 for a in ars):
-            ars.append(float(ar))
-            if flip:
-                ars.append(1.0 / float(ar))
+    ars = expand_aspect_ratios(aspect_ratios, flip)
     whs = []
     for ms in min_sizes:
         for ar in ars:
@@ -543,3 +560,136 @@ def collect_fpn_proposals(multi_rois, multi_scores, *, post_nms_top_n: int):
     k = min(post_nms_top_n, scores.shape[0])
     top, idx = lax.top_k(scores, k)
     return rois[idx], top
+
+
+# ---------------------------------------------------------------------------
+# SSD head: matching, loss, inference decode
+# ---------------------------------------------------------------------------
+
+def _encode_matched(prior_boxes, prior_variances, gt):
+    """Center-size encode each prior's matched gt box (M, 4) -> (M, 4)
+    deltas (the per-prior form of box_coder's pairwise encode)."""
+    pw = prior_boxes[:, 2] - prior_boxes[:, 0]
+    ph = prior_boxes[:, 3] - prior_boxes[:, 1]
+    pcx = prior_boxes[:, 0] + pw * 0.5
+    pcy = prior_boxes[:, 1] + ph * 0.5
+    tw = gt[:, 2] - gt[:, 0]
+    th = gt[:, 3] - gt[:, 1]
+    tcx = gt[:, 0] + tw * 0.5
+    tcy = gt[:, 1] + th * 0.5
+    out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                     jnp.log(jnp.maximum(tw / pw, 1e-10)),
+                     jnp.log(jnp.maximum(th / ph, 1e-10))], axis=-1)
+    pv = jnp.asarray(prior_variances)
+    return out / (pv if pv.ndim == 2 else pv[None, :])
+
+
+def ssd_match(gt_boxes, gt_mask, prior_boxes, *,
+              overlap_threshold: float = 0.5,
+              match_type: str = "per_prediction"):
+    """SSD matching for one image: bipartite (every gt claims its best
+    prior) + optionally per-prediction (any prior with IoU above threshold
+    matches its best gt). Padded gt slots (gt_mask False) never match.
+
+    Returns (match_idx (M,) int32, matched (M,) bool).
+    reference: operators/detection/bipartite_match_op.cc +
+    layers/detection.py ssd_loss matching stage.
+    """
+    G = gt_boxes.shape[0]
+    iou = iou_similarity(gt_boxes, prior_boxes)          # (G, M)
+    iou = jnp.where(gt_mask[:, None], iou, -1.0)
+    match_idx = jnp.argmax(iou, axis=0)                  # (M,)
+    best_iou = jnp.max(iou, axis=0)
+    matched = (best_iou > (overlap_threshold
+                           if match_type == "per_prediction" else 1.1))
+    # bipartite stage: greedy one-to-one, highest IoU pair first
+    def body(carry, _):
+        iou_live, midx, mok = carry
+        flat = jnp.argmax(iou_live)
+        g, m = flat // iou_live.shape[1], flat % iou_live.shape[1]
+        ok = iou_live[g, m] > 0.0
+        midx = jnp.where(ok & (jnp.arange(midx.shape[0]) == m), g, midx)
+        mok = mok | (ok & (jnp.arange(mok.shape[0]) == m))
+        iou_live = jnp.where(ok, iou_live.at[g, :].set(-1.0)
+                             .at[:, m].set(-1.0), iou_live)
+        return (iou_live, midx, mok), None
+
+    (_, match_idx, matched), _ = lax.scan(
+        body, (iou, match_idx, matched), None, length=G)
+    return match_idx.astype(jnp.int32), matched
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, gt_mask=None, *,
+             background_label: int = 0, overlap_threshold: float = 0.5,
+             neg_pos_ratio: float = 3.0, loc_loss_weight: float = 1.0,
+             conf_loss_weight: float = 1.0,
+             match_type: str = "per_prediction",
+             mining_type: str = "max_negative", normalize: bool = True):
+    """SSD multibox loss (reference: python/paddle/fluid/layers/detection.py
+    ssd_loss; ops mine_hard_examples/target_assign/bipartite_match).
+
+    Ragged gt lists use the framework's padded+mask convention (SURVEY §5.7)
+    instead of LoD: gt_box (N, G, 4), gt_label (N, G), gt_mask (N, G) bool.
+    location (N, M, 4) deltas, confidence (N, M, C) logits, priors (M, 4).
+    Returns per-image loss (N,), already hard-negative mined and normalized
+    by matched count when ``normalize``.
+    """
+    from .loss import smooth_l1_loss, softmax_with_cross_entropy
+    from .detection_extra import mine_hard_examples
+
+    N, M, _ = location.shape
+    if gt_mask is None:
+        gt_mask = jnp.ones(gt_box.shape[:2], bool)
+    if prior_box_var is None:
+        prior_box_var = jnp.ones_like(prior_box)
+
+    def one(loc, conf, gtb, gtl, gmask):
+        midx, matched = ssd_match(gtb, gmask, prior_box,
+                                  overlap_threshold=overlap_threshold,
+                                  match_type=match_type)
+        tgt_label = jnp.where(matched, gtl[midx], background_label)
+        conf_loss = softmax_with_cross_entropy(conf, tgt_label)
+        conf_loss = conf_loss.reshape(-1)                            # (M,)
+        sel = mine_hard_examples(conf_loss[None],
+                                 matched[None].astype(jnp.int32),
+                                 neg_pos_ratio=neg_pos_ratio,
+                                 mining_type=mining_type)[0]
+        tgt_loc = _encode_matched(prior_box, prior_box_var, gtb[midx])
+        loc_l = jnp.sum(smooth_l1_loss(loc, tgt_loc), axis=-1)
+        total = (conf_loss_weight * jnp.sum(conf_loss * sel)
+                 + loc_loss_weight * jnp.sum(loc_l * matched))
+        if normalize:
+            total = total / jnp.maximum(jnp.sum(matched.astype(total.dtype)),
+                                        1.0)
+        return total
+
+    return jax.vmap(one)(location, confidence, gt_box, gt_label, gt_mask)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var=None, *,
+                     background_label: int = 0,
+                     nms_threshold: float = 0.3, nms_top_k: int = 400,
+                     keep_top_k: int = 200, score_threshold: float = 0.01):
+    """SSD inference decode: per-image box decode + softmax + multiclass
+    NMS (reference: layers/detection.py detection_output →
+    box_coder decode + multiclass_nms ops).
+
+    loc (N, M, 4) deltas, scores (N, M, C) logits, priors (M, 4).
+    Returns ((N, keep_top_k, 6) [label, score, x1, y1, x2, y2], valid mask).
+    """
+    if prior_box_var is None:
+        prior_box_var = jnp.ones_like(prior_box)
+
+    def one(loc_i, score_i):
+        boxes = box_coder(prior_box, prior_box_var, loc_i[None],
+                          code_type="decode_center_size")[0]      # (M, 4)
+        probs = jax.nn.softmax(score_i, axis=-1).T                # (C, M)
+        return multiclass_nms(boxes, probs,
+                              score_threshold=score_threshold,
+                              nms_threshold=nms_threshold,
+                              nms_top_k=min(nms_top_k, loc.shape[1]),
+                              keep_top_k=keep_top_k,
+                              background_label=background_label)
+
+    return jax.vmap(one)(loc, scores)

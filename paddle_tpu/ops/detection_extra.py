@@ -268,3 +268,89 @@ def yolov3_loss(x, gt_box, gt_label, *, anchors: Sequence[int],
     neg_loss = bce(pred_obj, jnp.zeros_like(pred_obj)) * (1.0 - obj_target)
     total = total + jnp.sum(neg_loss)
     return total / n
+
+def _rasterize_polygon(polygon, box, mask_size: int):
+    """Scanline-fill one polygon into a (mask_size, mask_size) grid over
+    ``box`` (x1, y1, x2, y2). Pure numpy, even-odd rule — host-side data
+    prep (Mask R-CNN targets are computed on CPU in every framework)."""
+    import numpy as np
+
+    x1, y1, x2, y2 = [float(v) for v in box]
+    w = max(x2 - x1, 1e-6)
+    h = max(y2 - y1, 1e-6)
+    pts = np.asarray(polygon, np.float64).reshape(-1, 2)
+    # map polygon into mask pixel space
+    px = (pts[:, 0] - x1) / w * mask_size
+    py = (pts[:, 1] - y1) / h * mask_size
+    mask = np.zeros((mask_size, mask_size), np.uint8)
+    cy = np.arange(mask_size) + 0.5
+    cx = np.arange(mask_size) + 0.5
+    xj, xk = px, np.roll(px, 1)
+    yj, yk = py, np.roll(py, 1)
+    for r, yc in enumerate(cy):
+        crosses = (yj > yc) != (yk > yc)
+        if not crosses.any():
+            continue
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xint = xj + (yc - yj) / (yk - yj) * (xk - xj)
+        xs = np.sort(xint[crosses])
+        inside = (xs.searchsorted(cx, side="right") % 2) == 1
+        mask[r] = inside
+    return mask
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         roi_labels, num_classes: int, resolution: int = 14):
+    """Mask R-CNN mask targets (reference:
+    operators/detection/generate_mask_labels_op.cc). Host-side numpy —
+    ragged polygon lists are data prep, not device work, in this design
+    (OP_COVERAGE.md).
+
+    gt_segms: list (per gt) of polygon lists ([x0, y0, x1, y1, ...]).
+    rois (R, 4), roi_labels (R,) class per roi (0 = background).
+    Returns (mask_rois (P, 4), roi_has_mask (R,), mask_targets
+    (P, num_classes * resolution**2) with -1 outside the roi's class
+    section, P = number of foreground rois).
+    """
+    import numpy as np
+
+    rois = np.asarray(rois, np.float64)
+    roi_labels = np.asarray(roi_labels, np.int64)
+    gt_boxes = []
+    for segs in gt_segms:
+        allpts = np.concatenate([np.asarray(s, np.float64).reshape(-1, 2)
+                                 for s in segs], axis=0)
+        gt_boxes.append([allpts[:, 0].min(), allpts[:, 1].min(),
+                         allpts[:, 0].max(), allpts[:, 1].max()])
+    gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+    fg = np.flatnonzero(roi_labels > 0)
+    # pair each roi with its best-IoU gt in one vectorized numpy pass
+    # (host-side data prep: no device round-trips in this loop)
+    lt = np.maximum(rois[:, None, :2], gt_boxes[None, :, :2])
+    rb = np.minimum(rois[:, None, 2:], gt_boxes[None, :, 2:])
+    wh = np.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda b: np.maximum(b[:, 2] - b[:, 0], 0) * \
+        np.maximum(b[:, 3] - b[:, 1], 0)
+    union = area(rois)[:, None] + area(gt_boxes)[None, :] - inter
+    best_gt = np.where(union > 0, inter / np.maximum(union, 1e-10),
+                       0.0).argmax(axis=1)
+    mask_rois, targets = [], []
+    for r in fg:
+        box = rois[r]
+        g = int(best_gt[r])
+        m = np.zeros((resolution, resolution), np.uint8)
+        for poly in gt_segms[g]:
+            m |= _rasterize_polygon(poly, box, resolution)
+        cls = int(roi_labels[r])
+        tgt = np.full((num_classes, resolution * resolution), -1.0,
+                      np.float32)
+        tgt[cls] = m.reshape(-1).astype(np.float32)
+        mask_rois.append(box)
+        targets.append(tgt.reshape(-1))
+    roi_has_mask = (roi_labels > 0).astype(np.int32)
+    if not mask_rois:
+        return (np.zeros((0, 4), np.float32), roi_has_mask,
+                np.zeros((0, num_classes * resolution ** 2), np.float32))
+    return (np.asarray(mask_rois, np.float32), roi_has_mask,
+            np.stack(targets))

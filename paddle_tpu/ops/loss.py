@@ -202,3 +202,25 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples: int,
     idx = jnp.concatenate([lbl, sampled], axis=1)  # (n, 1+S); col 0 = true class
     picked = jnp.take_along_axis(logits, idx, axis=1)
     return softmax_with_cross_entropy(picked, jnp.zeros((n,), jnp.int32))
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """Dice coefficient loss (reference: layers/nn.py dice_loss): input
+    (..., D) class probabilities, label (..., 1) or (...,) int ids."""
+    if label.ndim == input.ndim:
+        label = label[..., 0]
+    one_hot = jax.nn.one_hot(label, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * one_hot, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(one_hot,
+                                                       axis=reduce_dims)
+    dice = (2.0 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+# fluid name (layers/nn.py smooth_l1 — summed over the trailing dim)
+def smooth_l1(x, y, inside_weight=None, outside_weight=None,
+              sigma: float = 1.0):
+    l = smooth_l1_loss(x, y, sigma=sigma, inside_weight=inside_weight,
+                       outside_weight=outside_weight)
+    return jnp.sum(l.reshape(l.shape[0], -1), axis=1, keepdims=True)

@@ -352,3 +352,13 @@ def logsumexp(x, axis=None, keepdims: bool = False):
 def isfinite(x):
     """reference: operators/isfinite_op.cc — scalar all-finite check."""
     return jnp.all(jnp.isfinite(x))
+
+
+def has_inf(x):
+    """reference: operators/isfinite_op.cc (has_inf)."""
+    return jnp.any(jnp.isinf(x))
+
+
+def has_nan(x):
+    """reference: operators/isfinite_op.cc (has_nan)."""
+    return jnp.any(jnp.isnan(x))

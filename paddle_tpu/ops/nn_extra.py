@@ -302,3 +302,43 @@ def tree_conv(nodes, edges, weight, max_depth: int = 2):
         prop = edges @ prop
         out = out + prop @ weight[d]
     return out
+
+
+def adaptive_pool3d(x, output_size, pool_type: str = "avg"):
+    """reference: operators/pool_op.cc adaptive path, 3D variant.
+    x (N, C, D, H, W) -> (N, C, od, oh, ow); sizes must divide."""
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size))
+    n, c, d, h, w = x.shape
+    enforce(d % od == 0 and h % oh == 0 and w % ow == 0,
+            "adaptive pool needs divisible sizes (%s,%s,%s)->(%s,%s,%s)",
+            d, h, w, od, oh, ow)
+    x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    return x.mean(axis=(3, 5, 7)) if pool_type == "avg" \
+        else x.max(axis=(3, 5, 7))
+
+
+def spectral_norm(weight, u, v, *, dim: int = 0, power_iters: int = 1,
+                  eps: float = 1e-12):
+    """Functional spectral normalization (reference:
+    operators/spectral_norm_op.cc). Returns (w / sigma, new_u, new_v);
+    the nn.SpectralNorm layer owns the u/v buffers."""
+    h = weight.shape[dim]
+    wmat = jnp.moveaxis(weight, dim, 0).reshape(h, -1)
+    for _ in range(power_iters):
+        v = wmat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wmat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wmat @ v
+    return weight / sigma, u, v
+
+
+def image_resize_short(x, out_short_len: int, method: str = "bilinear"):
+    """Resize so the SHORT edge equals out_short_len, keeping aspect
+    (reference: layers/nn.py image_resize_short)."""
+    h, w = x.shape[-2], x.shape[-1]
+    short, long_ = (h, w) if h < w else (w, h)
+    scale = out_short_len / float(short)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    return interpolate(x, (nh, nw), method=method)

@@ -23,6 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 MODULES = [
     "paddle_tpu",
     "paddle_tpu.nn",
+    "paddle_tpu.layers",
     "paddle_tpu.ops",
     "paddle_tpu.optimizer",
     "paddle_tpu.parallel",
