@@ -40,6 +40,7 @@ _LAZY = {
     "jit": ".jit",
     "nets": ".nets",
     "layers": ".layers",
+    "fluid": ".fluid",
 }
 
 

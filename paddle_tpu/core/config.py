@@ -125,6 +125,25 @@ class BuildStrategy:
     donate_inputs: bool = True  # buffer donation for train state (in-place update)
     remat_policy: Optional[str] = None  # None | "full" | "dots" — jax.checkpoint policy
 
+    class ReduceStrategy:
+        """reference: details/build_strategy.h:57 ReduceStrategy enum."""
+
+        AllReduce = "all_reduce"
+        Reduce = "reduce_scatter"
+
+        def __init__(self, value: str = "all_reduce"):
+            self.value = value
+
+    class GradientScaleStrategy:
+        """reference: details/build_strategy.h:59 GradientScaleStrategy."""
+
+        CoeffNumDevice = "coeff_one"
+        One = "one"
+        Customized = "customized"
+
+        def __init__(self, value: str = "coeff_one"):
+            self.value = value
+
 
 @dataclasses.dataclass
 class DistributeConfig:

@@ -63,6 +63,24 @@ class DataFeeder:
             return jax.device_put(arr, self.place.device())
         return jax.device_put(arr)
 
+    def decorate_reader(self, reader, multi_devices: bool = False,
+                        num_places=None, drop_last: bool = True):
+        """reference: data_feeder.py decorate_reader — wrap a batch reader
+        so it yields fed (device-placed, name-keyed) batches."""
+
+        def fed():
+            for batch in reader():
+                yield self.feed(batch)
+
+        return fed
+
+    def feed_parallel(self, iterable, num_places=None):
+        """reference: data_feeder.py feed_parallel — device sharding is a
+        single global-array placement here (the mesh splits the batch);
+        feeds each batch in turn."""
+        for batch in iterable:
+            yield self.feed(batch)
+
 
 class DeviceLoader:
     """Double-buffered device feeder (PyReader analog).
@@ -80,6 +98,11 @@ class DeviceLoader:
         self.transform = transform
         self.sharding = sharding
         self.capacity = capacity
+
+    def reset(self):
+        """Re-arm for a fresh epoch (PyReader.reset analog): iteration
+        restarts the source and prefetch thread on the next __iter__."""
+        return self
 
     def __iter__(self):
         from .reader import _put_cancellable

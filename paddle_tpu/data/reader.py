@@ -259,3 +259,142 @@ def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
             yield buf
 
     return batch_reader
+
+
+
+class PipeReader:
+    """Stream samples from a shell command's stdout (reference:
+    python/paddle/reader/decorator.py PipeReader — left_cmd | parse)."""
+
+    def __init__(self, command: str, bufsize: int = 8192,
+                 file_type: str = "plain"):
+        self.command = command
+        self.bufsize = bufsize
+
+    def get_line(self, cut_lines: bool = True, line_break: str = "\n"):
+        import subprocess
+
+        proc = subprocess.Popen(self.command, shell=True,
+                                stdout=subprocess.PIPE, bufsize=self.bufsize)
+        try:
+            buf = b""
+            for chunk in iter(lambda: proc.stdout.read(self.bufsize), b""):
+                buf += chunk
+                if cut_lines:
+                    lines = buf.split(line_break.encode())
+                    buf = lines.pop()
+                    for ln in lines:
+                        yield ln.decode(errors="replace")
+                else:
+                    yield buf.decode(errors="replace")
+                    buf = b""
+            if buf:
+                yield buf.decode(errors="replace")
+        finally:
+            proc.stdout.close()
+            proc.wait()
+
+
+import itertools as _itertools
+
+
+class Fake:
+    """Cache the first pass of a reader and replay it forever — IO-free
+    re-feeding for benchmarks (reference: reader/decorator.py Fake)."""
+
+    def __init__(self):
+        self._cache = None
+
+    def __call__(self, reader, length: int):
+        def fake_reader():
+            if self._cache is None:
+                self._cache = list(_itertools.islice(reader(), length))
+            for i in range(length):
+                yield self._cache[i % len(self._cache)]
+
+        return fake_reader
+
+
+def _mp_feed(r, q):
+    """Child body for multiprocess_reader (module-level: picklable under
+    spawn/forkserver start methods). The sentinel ALWAYS goes out, even if
+    the reader raises — otherwise the consumer would block forever."""
+    try:
+        for sample in r():
+            q.put(sample)
+    finally:
+        q.put(None)
+
+
+def multiprocess_reader(readers, use_pipe: bool = True,
+                        queue_size: int = 1000):
+    """Fan-in: run each reader in its own process, merge samples
+    (reference: reader/decorator.py multiprocess_reader). Falls back to
+    in-process chaining when the readers can't cross a process boundary
+    (unpicklable closures under spawn)."""
+    import multiprocessing as mp
+    import pickle
+
+    def reader():
+        try:
+            pickle.dumps(readers)
+        except Exception:
+            for r in readers:  # unpicklable: degrade to sequential chain
+                yield from r()
+            return
+        ctx = mp.get_context()
+        q = ctx.Queue(queue_size)
+        procs = [ctx.Process(target=_mp_feed, args=(r, q), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        live = len(procs)
+        try:
+            while live:
+                try:
+                    item = q.get(timeout=300)
+                except Exception:
+                    if not any(p.is_alive() for p in procs):
+                        break  # all children died without sentinels
+                    continue
+                if item is None:
+                    live -= 1
+                else:
+                    yield item
+        finally:
+            for p in procs:
+                p.terminate()
+
+    return reader
+
+
+class _Creator:
+    """``paddle.reader.creator`` namespace: readers from common sources."""
+
+    @staticmethod
+    def np_array(x):
+        def reader():
+            for row in x:
+                yield row
+
+        return reader
+
+    @staticmethod
+    def text_file(path: str):
+        def reader():
+            with open(path) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+        return reader
+
+    @staticmethod
+    def recordio(paths, buf_size: int = 100):
+        from ..core.enforce import EnforceError
+
+        raise EnforceError(
+            "RecordIO was dropped by design (SURVEY 'what NOT to "
+            "rebuild'); use creator.np_array / MultiSlotDataset")
+
+
+creator = _Creator()

@@ -1,0 +1,22 @@
+"""fluid.backward compat (reference: python/paddle/fluid/backward.py:394
+append_backward; :619 calc_gradient — both over the static Program; the
+eager path is jax.grad by construction)."""
+
+from __future__ import annotations
+
+from ..static.program import append_backward
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: backward.py calc_gradient:619 — gradients of ``targets``
+    w.r.t. arbitrary program vars (not just parameters)."""
+    names = [v.name if hasattr(v, "name") else v for v in
+             (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    if isinstance(targets, (list, tuple)):
+        total = targets[0]
+        for t in targets[1:]:
+            total = total + t  # summed objective: gradient contributions add
+        targets = total
+    pairs = append_backward(targets, parameter_list=names)
+    grads = [g for _, g in pairs]
+    return grads if isinstance(inputs, (list, tuple)) else grads[0]

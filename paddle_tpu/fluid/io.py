@@ -1,0 +1,16 @@
+"""fluid.io compat (reference: python/paddle/fluid/io.py:98-1074 save/load
+family + fluid/reader.py PyReader)."""
+
+from __future__ import annotations
+
+from ..layers import _PyReader as PyReader  # async device feed pipeline
+from ..static.io import (load_inference_model, load_persistables,
+                         save_inference_model, save_persistables)
+
+# vars/params granularities collapse onto the same artifact writer: the
+# persistable set IS the param set plus optimizer state in this design
+# (reference io.py:98 save_vars / :228 save_params / :460 save_persistables)
+save_vars = save_persistables
+save_params = save_persistables
+load_vars = load_persistables
+load_params = load_persistables

@@ -149,3 +149,19 @@ XavierInitializer = XavierUniform
 MSRAInitializer = MSRA
 BilinearInitializer = Bilinear
 NumpyArrayInitializer = NumpyArray
+
+
+def force_init_on_cpu() -> bool:
+    """reference: initializer.py force_init_on_cpu — initializer placement
+    is XLA's concern here; reported False always."""
+    return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """reference: initializer.py init_on_cpu context — a no-op scope: param
+    init runs where XLA places it (host staging is automatic)."""
+    yield

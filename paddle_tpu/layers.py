@@ -194,7 +194,7 @@ sampling_id = _SA.sampling_id
 
 # --- decode / CRF ----------------------------------------------------------
 beam_search = _DE.beam_search
-beam_search_decode = _DE.beam_search
+beam_search_decode = _DE.beam_search_decode
 crf_decoding = _DE.crf_decoding
 ctc_greedy_decoder = _DE.ctc_greedy_decode
 edit_distance = _DE.edit_distance
@@ -526,6 +526,19 @@ class _PyReader:
 
     decorate_sample_list_generator = decorate
     decorate_batch_generator = decorate
+    decorate_sample_generator = decorate
+
+    def start(self):
+        """reference: reader.py PyReader.start — arm the pipeline; the
+        DeviceLoader starts its prefetch thread on iteration."""
+        return self
+
+    def reset(self):
+        """reference: PyReader.reset — drop buffered batches so the next
+        epoch re-iterates the source."""
+        if self.loader is not None and hasattr(self.loader, "reset"):
+            self.loader.reset()
+        return self
 
     def __iter__(self):
         return iter(self.loader)
@@ -596,6 +609,12 @@ class Preprocessor:
         self._fn = fn
         return self
 
+    def inputs(self):
+        return self.reader
+
+    def outputs(self, *outs):
+        return outs
+
     def __call__(self):
         return _data.map_readers(self._fn, self.reader)()
 
@@ -660,3 +679,70 @@ def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=N
     xs = x if isinstance(x, (list, tuple)) else (x,)
     return func(*xs)
 
+
+
+# --- static-graph polymorphism ---------------------------------------------
+# Reference users call fluid.layers.* on Program Vars inside
+# fluid.program_guard. Every function in this namespace dispatches: eager
+# arrays run directly; static Vars record the SAME computation onto their
+# Program (Program.apply traces it). Param-creating layers (fc, conv2d,
+# embedding, batch_norm, ...) route to static.layers, which owns Program
+# parameter creation (reference LayerHelper role).
+
+def _wrap_static_dispatch(name, f):
+    import functools
+
+    import jax.tree_util as _jtu
+
+    def _is_var(x):
+        from .static.program import Var
+
+        return isinstance(x, Var)
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        from .static import layers as _SL
+
+        leaves, treedef = _jtu.tree_flatten((args, kwargs), is_leaf=_is_var)
+        var_pos = [i for i, l in enumerate(leaves) if _is_var(l)]
+        if not var_pos:
+            return f(*args, **kwargs)
+        static_impl = getattr(_SL, name, None)
+        if static_impl is not None and static_impl is not wrapper:
+            return static_impl(*args, **kwargs)
+        prog = leaves[var_pos[0]].program
+
+        def fn(*vals):
+            new_leaves = list(leaves)
+            for i, v in zip(var_pos, vals):
+                new_leaves[i] = v
+            a, kw = _jtu.tree_unflatten(treedef, new_leaves)
+            return f(*a, **kw)
+
+        return prog.apply(fn, [leaves[i] for i in var_pos], name=name)
+
+    return wrapper
+
+
+def _apply_static_dispatch():
+    import types
+
+    g = globals()
+    skip = {"data", "create_parameter", "create_global_var", "create_tensor",
+            "py_func", "Print", "py_reader", "create_py_reader_by_data",
+            "read_file", "open_files", "random_data_generator", "batch",
+            "shuffle", "double_buffer", "load", "fc",
+            "autoincreased_step_counter", "create_array", "array_write",
+            "array_read", "array_length", "tensor_array_to_tensor"}
+    for name, obj in list(g.items()):
+        if name.startswith("_") or name in skip:
+            continue
+        if isinstance(obj, types.FunctionType) or (
+                callable(obj) and not isinstance(obj, type)
+                and hasattr(obj, "__module__")
+                and str(getattr(obj, "__module__", "")).startswith(
+                    "paddle_tpu")):
+            g[name] = _wrap_static_dispatch(name, obj)
+
+
+_apply_static_dispatch()

@@ -87,3 +87,17 @@ def glu(x, axis: int = -1):
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "SequenceConvPool",
            "glu", "scaled_dot_product_attention"]
+
+
+def sequence_conv_pool(input, lengths, weight, bias=None, *,
+                       filter_size: int = 3, act: str = "tanh",
+                       pool_type: str = "max"):
+    """Functional form of SequenceConvPool (fluid nets.py name): sequence
+    conv with explicit weights + activation + masked sequence pool."""
+    h = R.sequence_conv(input, weight, lengths=lengths,
+                        context_length=filter_size, bias=bias)
+    if act == "tanh":
+        h = jnp.tanh(h)
+    elif act == "relu":
+        h = jnp.maximum(h, 0.0)
+    return sequence_pool(h, lengths, pool_type)

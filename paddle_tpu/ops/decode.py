@@ -302,3 +302,41 @@ def edit_distance(hyp, hyp_lengths, ref, ref_lengths, *,
         return d / jnp.maximum(rl, 1) if normalized else d
 
     return jax.vmap(per_batch)(hyp, hyp_lengths, ref, ref_lengths)
+
+
+def beam_search_decode(step_ids, step_parents, step_scores=None, *,
+                       end_id: int = 1):
+    """Backtrack per-step beam candidates into full sequences (reference:
+    operators/beam_search_decode_op.cc — walks the LoD parent links; here
+    parents are an explicit array, the padded-dense form of that link).
+
+    step_ids (T, B, K): token chosen by each beam at each step.
+    step_parents (T, B, K): index in [0, K) of the parent beam at t-1.
+    step_scores (T, B, K) optional: cumulative scores per beam.
+
+    Returns (sequences (B, K, T) backtracked token ids, scores (B, K) —
+    each beam's final cumulative score, zeros if none given).
+    """
+    T, B, K = step_ids.shape
+
+    def backtrack_one(ids_tb, parents_tb):
+        # ids_tb, parents_tb: (T, K)
+        def run(k):
+            def step(carry, t):
+                beam_idx, acc = carry
+                tok = ids_tb[t][beam_idx]
+                parent = parents_tb[t][beam_idx]
+                return (parent, acc.at[t].set(tok)), None
+
+            init = (jnp.asarray(k), jnp.zeros((T,), step_ids.dtype))
+            (final_parent, acc), _ = lax.scan(
+                step, init, jnp.arange(T - 1, -1, -1))
+            return acc
+
+        return jax.vmap(run)(jnp.arange(K))  # (K, T)
+
+    seqs = jax.vmap(backtrack_one)(jnp.transpose(step_ids, (1, 0, 2)),
+                                   jnp.transpose(step_parents, (1, 0, 2)))
+    scores = (step_scores[-1] if step_scores is not None
+              else jnp.zeros((B, K), jnp.float32))
+    return seqs, scores

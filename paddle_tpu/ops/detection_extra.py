@@ -333,10 +333,21 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
     area = lambda b: np.maximum(b[:, 2] - b[:, 0], 0) * \
         np.maximum(b[:, 3] - b[:, 1], 0)
     union = area(rois)[:, None] + area(gt_boxes)[None, :] - inter
-    best_gt = np.where(union > 0, inter / np.maximum(union, 1e-10),
-                       0.0).argmax(axis=1)
+    iou = np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+    # crowd gts never provide mask targets; a roi only matches a gt of its
+    # own class (the reference op's crowd filter + per-class matching)
+    if is_crowd is not None:
+        crowd = np.asarray(is_crowd, bool).reshape(-1)
+        iou[:, crowd] = -1.0
+    if gt_classes is not None:
+        gcls = np.asarray(gt_classes, np.int64).reshape(-1)
+        iou = np.where(gcls[None, :] == roi_labels[:, None], iou, -1.0)
+    best_gt = iou.argmax(axis=1)
+    has_match = iou.max(axis=1) > 0
     mask_rois, targets = [], []
     for r in fg:
+        if not has_match[r]:
+            continue  # fg roi with no same-class non-crowd gt: no target
         box = rois[r]
         g = int(best_gt[r])
         m = np.zeros((resolution, resolution), np.uint8)
@@ -348,7 +359,7 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
         tgt[cls] = m.reshape(-1).astype(np.float32)
         mask_rois.append(box)
         targets.append(tgt.reshape(-1))
-    roi_has_mask = (roi_labels > 0).astype(np.int32)
+    roi_has_mask = ((roi_labels > 0) & has_match).astype(np.int32)
     if not mask_rois:
         return (np.zeros((0, 4), np.float32), roi_has_mask,
                 np.zeros((0, num_classes * resolution ** 2), np.float32))

@@ -88,6 +88,92 @@ class Optimizer:
     def current_lr(self, state) -> jnp.ndarray:
         return self.schedule(state["step"])
 
+    # --- static-graph (fluid) entry points ---------------------------------
+    # reference optimizer.py: minimize = backward + apply_gradients over a
+    # Program. The SAME per-leaf rule (init_leaf/update_leaf) lowers to
+    # recorded update ops, so every functional optimizer works in static
+    # mode without a parallel implementation.
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        """reference: optimizer.py Optimizer.backward → append_backward."""
+        from ..static.program import append_backward
+
+        return append_backward(loss, parameter_list)
+
+    def apply_gradients(self, params_grads):
+        """Record update ops (+accumulator vars) for (param, grad) Vars.
+
+        Mirrors the eager apply() ordering: clip the WHOLE grad set first
+        (global-norm clips see all grads in one recorded op), then add the
+        regularization term, then per-param updates."""
+        params = [p for p, _ in params_grads]
+        grads = [g for _, g in params_grads]
+        if params and self.grad_clip is not None:
+            prog = params[0].program
+            clip = self.grad_clip
+            if len(grads) == 1:
+                out = prog.apply(lambda g: clip([g])[0], grads,
+                                 name="grad_clip")
+                grads = [out]
+            else:
+                out = prog.apply(lambda *gs: tuple(clip(list(gs))), grads,
+                                 name="grad_clip")
+                grads = list(out)
+        for param, grad in zip(params, grads):
+            self._append_static_update(param.program, param, grad)
+        return list(zip(params, grads))
+
+    def apply_optimize(self, loss, startup_program=None, params_grads=None):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        pairs = self.backward(loss, parameter_list=parameter_list)
+        self.apply_gradients(pairs)
+        return None, pairs
+
+    def get_opti_var_name_list(self):
+        """Accumulator var names created by static apply_gradients
+        (reference: optimizer.py get_opti_var_name_list)."""
+        return list(getattr(self, "_opti_var_names", []))
+
+    def _append_static_update(self, prog, param, grad):
+        from .. import initializer as _I
+
+        tpl = self.init_leaf(jnp.zeros(param.shape, param.dtype))
+        keys = sorted(tpl)
+        names = []
+        svars = []
+        for k in keys:
+            name = prog.unique_name(f"{param.name}_{k}")
+            svars.append(prog.create_parameter(
+                name, jnp.shape(tpl[k]), jnp.asarray(tpl[k]).dtype,
+                initializer=_I.Constant(0.0), trainable=False))
+            names.append(name)
+        tname = prog.unique_name(f"{param.name}_step")
+        tvar = prog.create_parameter(tname, (), jnp.int32,
+                                     initializer=_I.Constant(0.0),
+                                     trainable=False)
+        names.append(tname)
+        self._opti_var_names = getattr(self, "_opti_var_names", []) + names
+
+        def fn(p, g, t, *svals):
+            s = dict(zip(keys, svals))
+            if self.regularization is not None:
+                g = self.regularization.apply_to_grads(p, g)
+            lr = self.schedule(t)
+            p_new, s_new = self.update_leaf(p, g, s, lr, t)
+            return (p_new, t + 1) + tuple(s_new[k] for k in keys)
+
+        outs = prog.apply(fn, [param, grad, tvar] + svars,
+                          name=f"{type(self).__name__.lower()}_{param.name}")
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        prog.assign(param, outs[0])
+        prog.assign(tvar, outs[1])
+        for var, k in zip(svars, keys):
+            prog.assign(var, outs[2 + keys.index(k)])
+
 
 class SGD(Optimizer):
     """reference: optimizers/sgd_op.cc."""

@@ -126,6 +126,33 @@ class Executor:
             sub = jax.random.fold_in(key, i)
             self.scope.set(name, init(sub, shape, dtype))
 
+    # -- dataset training (reference: executor.py train_from_dataset /
+    # infer_from_dataset — the AsyncExecutor successor driving the native
+    # MultiSlot feed) ------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Run the program once per dataset batch (dataset batches are
+        name→array dicts from the native MultiSlot feed). Returns the last
+        fetch results."""
+        from .program import default_main_program
+
+        program = program or default_main_program()
+        out = None
+        for i, batch in enumerate(dataset):
+            out = self.run(program, feed=batch, fetch_list=fetch_list)
+            if debug and fetch_list and i % print_period == 0:
+                print(f"step {i}: {out}")
+        return out
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        # inference = same drive loop over a program with no update ops
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     # -- run ----------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
             feed: Optional[Dict[str, Any]] = None,

@@ -124,10 +124,39 @@ class Program:
         self.version = 0
         self._name_counter = 0
 
+    # -- fluid block API (reference framework.py Program.block:2704ff).
+    # This Program is single-block by design: nesting lives inside traced
+    # functions (lax.cond/scan sub-traces), not desc sub-blocks — so the
+    # Program IS its global block.
+    def global_block(self):
+        return self
+
+    def current_block(self):
+        return self
+
+    def block(self, index: int = 0):
+        return self
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def to_string(self, throw_on_error: bool = False, with_details=False):
+        return repr(self)
+
+    @staticmethod
+    def parse_from_string(binary_str):
+        from ..core.enforce import EnforceError
+
+        raise EnforceError(
+            "the serialized program format is the StableHLO artifact — "
+            "load with static.io.load_inference_model / the C++ predictor "
+            "(SURVEY §7: ProgramDesc → serialized HLO + metadata)")
+
     # -- naming -------------------------------------------------------------
     def unique_name(self, stem: str) -> str:
         self._name_counter += 1
-        return f"{stem}_{self._name_counter}"
+        prefix = getattr(self, "_name_prefix", "")
+        return f"{prefix}{stem}_{self._name_counter}"
 
     # -- graph building -----------------------------------------------------
     def data(self, name: str, shape: Sequence[int], dtype=None) -> Var:

@@ -1,0 +1,210 @@
+"""Whole-namespace parity: every ``paddle.fluid.*`` / ``paddle.reader.*``
+name frozen in the reference API.spec resolves under ``paddle_tpu.fluid``
+(reference: paddle/fluid/API.spec; SURVEY Appendix A.3 says to use it as
+the canonical Python-layer capability checklist). Plus behavior checks for
+the shims that carry logic (scope_guard, unique_name, LoDTensor pair,
+transpiler collective mode, contrib decoder).
+"""
+
+import os
+import re
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+REF_SPEC = "/root/reference/paddle/fluid/API.spec"
+
+# Dropped BY DESIGN with a named replacement (SURVEY "what NOT to rebuild" /
+# PARITY.md). Each entry is (spec prefix, where the capability lives now).
+DESIGN_NA = {
+    "paddle.fluid.recordio_writer.convert_reader_to_recordio_files":
+        "RecordIO dropped; data.MultiSlotDataset",
+    "paddle.fluid.contrib.reader.ctr_reader": "native MultiSlotFeed",
+}
+
+# The reference's imperative block-DSL (`with while_op.block(): ...` building
+# desc sub-blocks) cannot exist under traced functional control flow; the
+# named constructs themselves resolve to the lax-backed forms
+# (layers.While = while_loop etc. — SURVEY §7 "control flow" row), so the
+# DSL *methods* are design-na with those functions as the replacement.
+BLOCK_DSL_METHODS = {
+    "layers.While.block", "layers.Switch.case", "layers.Switch.default",
+    "layers.IfElse.false_block", "layers.IfElse.input",
+    "layers.IfElse.output", "layers.IfElse.true_block",
+    "layers.DynamicRNN.block", "layers.DynamicRNN.memory",
+    "layers.DynamicRNN.output", "layers.DynamicRNN.static_input",
+    "layers.DynamicRNN.step_input", "layers.DynamicRNN.update_memory",
+    "layers.StaticRNN.memory", "layers.StaticRNN.output",
+    "layers.StaticRNN.step", "layers.StaticRNN.step_input",
+    "layers.StaticRNN.step_output", "layers.StaticRNN.update_memory",
+    "contrib.TrainingDecoder.block", "contrib.TrainingDecoder.output",
+    "contrib.TrainingDecoder.static_input",
+    "contrib.TrainingDecoder.step_input",
+    "contrib.BeamSearchDecoder.block", "contrib.BeamSearchDecoder.early_stop",
+    "contrib.BeamSearchDecoder.read_array",
+    "contrib.BeamSearchDecoder.update_array",
+}
+
+
+def _spec_names():
+    out = []
+    with open(REF_SPEC) as f:
+        for ln in f:
+            name = ln.split(" ")[0]
+            if name.startswith("paddle.fluid."):
+                out.append(name[len("paddle.fluid."):])
+            elif name.startswith("paddle.reader."):
+                out.append("data_reader." + name[len("paddle.reader."):])
+    return out
+
+
+def _resolve(root, dotted):
+    obj = root
+    for part in dotted.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return None
+    return obj
+
+
+@pytest.mark.skipif(not os.path.exists(REF_SPEC),
+                    reason="reference checkout not mounted")
+def test_every_fluid_spec_name_resolves():
+    from paddle_tpu import data as data_reader
+
+    missing = []
+    for dotted in _spec_names():
+        if dotted in BLOCK_DSL_METHODS:
+            continue
+        if any(("paddle.fluid." + dotted).startswith(k) or
+               ("paddle." + dotted.replace("data_reader.", "reader."))
+               .startswith(k) for k in DESIGN_NA):
+            continue
+        root = {"data_reader": data_reader}.get(dotted.split(".")[0])
+        if root is not None:
+            obj = _resolve(root, dotted.split(".", 1)[1])
+        else:
+            obj = _resolve(fluid, dotted)
+        if obj is None:
+            missing.append(dotted)
+    assert not missing, (
+        f"{len(missing)} unresolved paddle.fluid spec names: {missing[:40]}")
+
+
+def test_scope_guard_swaps_global_scope():
+    s = fluid.Scope()
+    base = fluid.global_scope()
+    with fluid.scope_guard(s):
+        assert fluid.global_scope() is s
+    assert fluid.global_scope() is base
+
+
+def test_unique_name_guard_isolates():
+    a = fluid.unique_name.generate("w")
+    with fluid.unique_name.guard():
+        assert fluid.unique_name.generate("w") == "w_0"
+    b = fluid.unique_name.generate("w")
+    assert a != b and not b.endswith("_0")
+
+
+def test_lod_tensor_pair_roundtrip():
+    t = fluid.create_lod_tensor(np.arange(6).reshape(3, 2), [[2, 1]])
+    assert t.recursive_sequence_lengths() == [[2, 1]]
+    assert np.asarray(t).shape == (3, 2)
+    r = fluid.create_random_int_lodtensor([[1, 2]], [4], None, 0, 9)
+    assert np.asarray(r).shape == (3, 4)
+    assert int(np.asarray(r).max()) <= 9
+
+
+def test_transpiler_collective_mode_and_ps_redesign():
+    from paddle_tpu.core.enforce import EnforceError
+
+    tr = fluid.DistributeTranspiler()
+    tr.transpile(0, program="prog", trainers=4)
+    assert tr.get_trainer_program() == "prog"
+    with pytest.raises(EnforceError):
+        tr.get_pserver_program("127.0.0.1:7164")
+    cfg = fluid.DistributeTranspilerConfig(mode="pserver")
+    with pytest.raises(EnforceError):
+        fluid.DistributeTranspiler(cfg).transpile(0)
+
+
+def test_contrib_decoder_training_scan():
+    cell = fluid.contrib.StateCell(states={"h": jnp.zeros((2, 4))})
+
+    @cell.register
+    def _step(x_t, states):
+        return {"h": jnp.tanh(states["h"] + x_t)}
+
+    dec = fluid.contrib.TrainingDecoder(cell)
+    xs = jnp.ones((5, 2, 4))  # (T, B, D)
+    outs = dec(xs)
+    assert outs.shape == (5, 2, 4)
+    assert float(jnp.abs(outs[4]).min()) > float(jnp.abs(outs[0]).min())
+
+
+def test_functional_optimizer_static_bridge():
+    """Every functional optimizer drives static Programs through the
+    generic minimize/apply_gradients bridge (reference contract:
+    optimizer.py minimize = append_backward + update ops)."""
+    from paddle_tpu import static
+    from paddle_tpu.optimizer import RMSProp
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (8, 4))
+        y = prog.data("y", (8,))
+        h = static.layers.fc(x, 16, act="relu")
+        out = static.layers.fc(h, 3, name="head")
+        loss = static.layers.mean(
+            static.layers.softmax_with_cross_entropy(out, y))
+    opt = RMSProp(learning_rate=5e-3)
+    _, pairs = opt.minimize(loss)
+    assert len(pairs) == 4
+    assert opt.get_opti_var_name_list()  # accumulators were created
+    exe = fluid.Executor()
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.normal(size=(8, 4)).astype(np.float32),
+            "y": rng.integers(0, 3, 8)}
+    losses = [float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_executor_train_from_dataset():
+    """Executor.train_from_dataset drives a program over name-keyed
+    batches (the AsyncExecutor/dataset-training surface)."""
+    from paddle_tpu import static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (4, 2))
+        out = static.layers.fc(x, 1, name="lin")
+        loss = static.layers.mean(out)
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(4, 2)).astype(np.float32)}
+               for _ in range(3)]
+    exe = fluid.Executor()
+    out = exe.train_from_dataset(prog, batches, fetch_list=[loss])
+    assert out is not None and np.isfinite(float(out[0]))
+
+
+def test_places_and_misc():
+    assert len(fluid.cpu_places(3)) == 3
+    assert fluid.in_dygraph_mode()
+    assert fluid.memory_optimize("p") == "p"  # no-op by design (XLA)
+    with fluid.profiler.profiler():
+        with fluid.profiler.RecordEvent("span"):
+            pass
+    fluid.profiler.reset_profiler()
+    # optimizer aliases construct
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+    assert opt is not None
+    # name_scope nests and restores
+    prog = fluid.default_main_program()
+    with fluid.name_scope("blockA"):
+        assert getattr(prog, "_name_prefix", "").startswith("blockA/")
+    assert getattr(prog, "_name_prefix", "") == ""
