@@ -41,6 +41,7 @@ _LAZY = {
     "nets": ".nets",
     "layers": ".layers",
     "fluid": ".fluid",
+    "dataset": ".dataset",
 }
 
 

@@ -268,17 +268,26 @@ class PipeReader:
 
     def __init__(self, command: str, bufsize: int = 8192,
                  file_type: str = "plain"):
+        from ..core.enforce import enforce_in
+
+        enforce_in(file_type, ("plain", "gzip"), "file_type")
         self.command = command
         self.bufsize = bufsize
+        self.file_type = file_type
 
     def get_line(self, cut_lines: bool = True, line_break: str = "\n"):
         import subprocess
+        import zlib
 
         proc = subprocess.Popen(self.command, shell=True,
                                 stdout=subprocess.PIPE, bufsize=self.bufsize)
+        decomp = (zlib.decompressobj(32 + zlib.MAX_WBITS)
+                  if self.file_type == "gzip" else None)
         try:
             buf = b""
             for chunk in iter(lambda: proc.stdout.read(self.bufsize), b""):
+                if decomp is not None:
+                    chunk = decomp.decompress(chunk)
                 buf += chunk
                 if cut_lines:
                     lines = buf.split(line_break.encode())
@@ -309,6 +318,8 @@ class Fake:
         def fake_reader():
             if self._cache is None:
                 self._cache = list(_itertools.islice(reader(), length))
+            if not self._cache:
+                return  # empty source: nothing to replay
             for i in range(length):
                 yield self._cache[i % len(self._cache)]
 

@@ -12,11 +12,23 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     w.r.t. arbitrary program vars (not just parameters)."""
     names = [v.name if hasattr(v, "name") else v for v in
              (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
-    if isinstance(targets, (list, tuple)):
-        total = targets[0]
-        for t in targets[1:]:
-            total = total + t  # summed objective: gradient contributions add
-        targets = total
-    pairs = append_backward(targets, parameter_list=names)
+    tlist = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    glist = (list(target_gradients)
+             if target_gradients is not None else [None] * len(tlist))
+    import jax.numpy as jnp
+
+    weighted = []
+    for t, g in zip(tlist, glist):
+        if g is None:
+            weighted.append(t)
+        else:
+            # d(sum(t*g))/dx == g-weighted vjp of t (reference semantics)
+            weighted.append(t.program.apply(
+                lambda tv, gv: jnp.sum(tv * gv), [t, g],
+                name="weighted_target"))
+    total = weighted[0]
+    for t in weighted[1:]:
+        total = total + t  # summed objective: gradient contributions add
+    pairs = append_backward(total, parameter_list=names)
     grads = [g for _, g in pairs]
     return grads if isinstance(inputs, (list, tuple)) else grads[0]

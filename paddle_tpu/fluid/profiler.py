@@ -27,4 +27,4 @@ def cuda_profiler(output_file=None, output_mode=None, config=None):
     try:
         yield
     finally:
-        stop_profiler()
+        stop_profiler(device_trace=output_file is not None)

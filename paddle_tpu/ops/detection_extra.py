@@ -316,6 +316,10 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
 
     rois = np.asarray(rois, np.float64)
     roi_labels = np.asarray(roi_labels, np.int64)
+    if len(gt_segms) == 0:  # no gt instances: no mask targets
+        return (np.zeros((0, 4), np.float32),
+                np.zeros(len(rois), np.int32),
+                np.zeros((0, num_classes * resolution ** 2), np.float32))
     gt_boxes = []
     for segs in gt_segms:
         allpts = np.concatenate([np.asarray(s, np.float64).reshape(-1, 2)

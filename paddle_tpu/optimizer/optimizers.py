@@ -147,9 +147,14 @@ class Optimizer:
         svars = []
         for k in keys:
             name = prog.unique_name(f"{param.name}_{k}")
+            # accumulators start at init_leaf's ACTUAL value (e.g. Adagrad's
+            # initial_accumulator_value), matching the eager init() path
+            import numpy as _np
+
             svars.append(prog.create_parameter(
                 name, jnp.shape(tpl[k]), jnp.asarray(tpl[k]).dtype,
-                initializer=_I.Constant(0.0), trainable=False))
+                initializer=_I.NumpyArray(_np.asarray(tpl[k])),
+                trainable=False))
             names.append(name)
         tname = prog.unique_name(f"{param.name}_step")
         tvar = prog.create_parameter(tname, (), jnp.int32,

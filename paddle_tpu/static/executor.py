@@ -109,8 +109,16 @@ class Executor:
 
     def __init__(self, place=None, scope: Optional[Scope] = None):
         self.place = place
-        self.scope = scope or global_scope()
-        self._cache: Dict[Tuple, Any] = {}
+        self._scope = scope  # None = resolve global scope AT RUN TIME, so
+        self._cache: Dict[Tuple, Any] = {}  # fluid.scope_guard works
+
+    @property
+    def scope(self) -> Scope:
+        return self._scope if self._scope is not None else global_scope()
+
+    @scope.setter
+    def scope(self, value):
+        self._scope = value
 
     # -- startup ------------------------------------------------------------
     def run_startup(self, program: Program, seed: int = 0) -> None:

@@ -1,0 +1,172 @@
+"""paddle.dataset.* parity suite (reference: python/paddle/dataset/ — 14
+loader modules, SURVEY §2 layer 12): reader contracts, shapes, vocab
+sizes, determinism, and learnability of the synthetic fallbacks."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import dataset
+
+
+def _take(reader, n):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+def test_mnist_reader_contract():
+    samples = _take(dataset.mnist.train(synthetic_size=64), 8)
+    x, y = samples[0]
+    assert x.shape == (784,) and x.dtype == np.float32
+    assert -1.0 <= float(x.min()) and float(x.max()) <= 1.0
+    assert 0 <= y <= 9
+    # determinism
+    again = _take(dataset.mnist.train(synthetic_size=64), 8)
+    np.testing.assert_array_equal(samples[0][0], again[0][0])
+    # train/test streams differ
+    t = _take(dataset.mnist.test(synthetic_size=64), 8)
+    assert not np.array_equal(samples[0][0], t[0][0])
+
+
+def test_mnist_synthetic_is_learnable():
+    # class-conditional prototypes: nearest-prototype classification beats
+    # chance by a wide margin => a model can learn this data
+    train = _take(dataset.mnist.train(synthetic_size=512), 512)
+    X = np.stack([s[0] for s in train])
+    y = np.array([s[1] for s in train])
+    protos = np.stack([X[y == k].mean(0) for k in range(10)])
+    test = _take(dataset.mnist.test(synthetic_size=128), 128)
+    Xt = np.stack([s[0] for s in test])
+    yt = np.array([s[1] for s in test])
+    pred = np.argmin(((Xt[:, None] - protos[None]) ** 2).sum(-1), axis=1)
+    assert (pred == yt).mean() > 0.9
+
+
+def test_cifar_variants():
+    for reader, ncls in [(dataset.cifar.train10(synthetic_size=32), 10),
+                         (dataset.cifar.test10(synthetic_size=32), 10),
+                         (dataset.cifar.train100(synthetic_size=32), 100)]:
+        x, y = _take(reader, 1)[0]
+        assert x.shape == (3072,) and 0 <= y < ncls
+
+
+def test_uci_housing_split_and_norm():
+    tr = _take(dataset.uci_housing.train(), 1000)
+    te = _take(dataset.uci_housing.test(), 1000)
+    assert len(tr) == 404 and len(te) == 102  # 80/20 of 506
+    X = np.stack([s[0] for s in tr])
+    assert X.shape[1] == 13
+    assert float(X.min()) >= -1.0001 and float(X.max()) <= 1.0001
+
+
+def test_imdb_and_sentiment():
+    wd = dataset.imdb.word_dict()
+    assert len(wd) == 5149
+    ids, label = _take(dataset.imdb.train(wd, synthetic_size=16), 1)[0]
+    assert all(0 <= i < len(wd) for i in ids) and label in (0, 1)
+    sd = dataset.sentiment.get_word_dict()
+    ids, label = _take(dataset.sentiment.train(16), 1)[0]
+    assert all(0 <= i < len(sd) for i in ids)
+
+
+def test_imikolov_ngram_and_seq():
+    wd = dataset.imikolov.build_dict()
+    gram = _take(dataset.imikolov.train(wd, 5, synthetic_size=16), 4)
+    assert all(len(g) == 5 for g in gram)
+    # learnable: target is a deterministic function of the context
+    ctx = np.array(gram[0][:4])
+    assert gram[0][4] == int(ctx.sum() % (len(wd) - 3)) + 3
+    seqs = _take(dataset.imikolov.train(
+        wd, 5, dataset.imikolov.DataType.SEQ, synthetic_size=4), 2)
+    assert all(isinstance(s, list) for s in seqs)
+
+
+def test_movielens_schema():
+    assert dataset.movielens.max_user_id() == 6040
+    assert dataset.movielens.max_movie_id() == 3952
+    u, g, a, j, m, cats, title, rating = _take(
+        dataset.movielens.train(synthetic_size=8), 1)[0]
+    assert 1 <= u <= 6040 and 1 <= m <= 3952
+    assert 1.0 <= rating <= 5.0
+    assert len(dataset.movielens.get_movie_title_dict()) == 5174
+
+
+def test_conll05_srl_schema():
+    wd, vd, ld = dataset.conll05.get_dict()
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(wd)
+    sample = _take(dataset.conll05.test(synthetic_size=4), 1)[0]
+    assert len(sample) == 9  # word, 5 ctx, predicate, mark, labels
+    words, labels = sample[0], sample[8]
+    assert len(words) == len(labels) == len(sample[7])
+
+
+def test_wmt_readers():
+    src, trg = dataset.wmt14.get_dict(1000)
+    assert len(src) == 1000
+    s, t_in, t_out = _take(dataset.wmt14.train(1000, synthetic_size=8), 1)[0]
+    assert t_in[0] == dataset.wmt14.START and t_out[-1] == dataset.wmt14.END
+    assert t_in[1:] == t_out[:-1]
+    s16, i16, o16 = _take(dataset.wmt16.train(500, 500, synthetic_size=8),
+                          1)[0]
+    assert len(i16) == len(o16)
+    assert len(dataset.wmt16.get_dict("de", 200)) == 200
+
+
+def test_mq2007_formats():
+    x, r = _take(dataset.mq2007.train("pointwise", synthetic_size=4), 1)[0]
+    assert x.shape == (46,) and r in (0, 1, 2)
+    a, b = _take(dataset.mq2007.train("pairwise", synthetic_size=4), 1)[0]
+    assert a.shape == b.shape == (46,)
+    X, rel = _take(dataset.mq2007.train("listwise", synthetic_size=4), 1)[0]
+    assert X.shape[0] == rel.shape[0]
+
+
+def test_flowers_and_voc():
+    img, y = _take(dataset.flowers.train(synthetic_size=2, image_hw=64),
+                   1)[0]
+    assert img.shape == (3, 64, 64) and 0 <= y < 102
+    img, mask = _take(dataset.voc2012.train(synthetic_size=2, image_hw=32),
+                      1)[0]
+    assert img.shape == (3, 32, 32) and mask.shape == (32, 32)
+    assert mask.max() < 21
+
+
+def test_image_transforms():
+    im = np.arange(40 * 60 * 3, dtype=np.float32).reshape(40, 60, 3) / 7200
+    out = dataset.image.resize_short(im, 20)
+    assert min(out.shape[:2]) == 20
+    assert dataset.image.center_crop(out, 16).shape == (16, 16, 3)
+    chw = dataset.image.to_chw(out)
+    assert chw.shape[0] == 3
+    t = dataset.image.simple_transform(im, 32, 24, is_train=False,
+                                       mean=[0.5, 0.5, 0.5])
+    assert t.shape == (3, 24, 24)
+    rng = np.random.default_rng(0)
+    t2 = dataset.image.simple_transform(im, 32, 24, is_train=True, rng=rng)
+    assert t2.shape == (3, 24, 24)
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    import os
+
+    reader = lambda: iter(range(10))
+    suffix = os.path.join(str(tmp_path), "part-%05d.pickle")
+    files = dataset.common.split(reader, 4, suffix=suffix)
+    assert len(files) == 3
+    r0 = dataset.common.cluster_files_reader(
+        os.path.join(str(tmp_path), "part-*.pickle"), 2, 0)
+    r1 = dataset.common.cluster_files_reader(
+        os.path.join(str(tmp_path), "part-*.pickle"), 2, 1)
+    got = sorted(list(r0()) + list(r1()))
+    assert got == list(range(10))
+
+
+def test_download_is_typed_error_without_cache():
+    from paddle_tpu.core.enforce import EnforceError
+
+    with pytest.raises(EnforceError):
+        dataset.common.download("http://example.com/x.tgz", "nope")

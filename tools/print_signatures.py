@@ -29,6 +29,7 @@ MODULES = [
     "paddle_tpu.parallel",
     "paddle_tpu.static",
     "paddle_tpu.data",
+    "paddle_tpu.dataset",
     "paddle_tpu.metrics",
     "paddle_tpu.initializer",
     "paddle_tpu.checkpoint",
