@@ -54,7 +54,7 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5):
 
 
 def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
-                 lr=1e-3, amp=None):
+                 lr=1e-3, amp=None, method="forward"):
     """Shared harness: jitted value_and_grad+Adam step, timed post-warmup.
 
     Timing blocks on the FULL output state, not just the loss scalar — the
@@ -87,7 +87,8 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
         def loss(p):
             with scope:
                 out, new_buf = model.functional_call(
-                    p, *batch, buffers=buffers, training=True)
+                    p, *batch, buffers=buffers, training=True,
+                    method=method)
                 return loss_fn(out, batch), new_buf
 
         (l, new_buf), g = jax.value_and_grad(loss, has_aux=True)(params)
@@ -137,8 +138,13 @@ def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
                         amp=amp)
 
 
-def bench_bert_base(steps: int, batch_size: int, amp=None):
-    """BASELINE config 3: BERT-base MLM pretrain step, seq 128."""
+def bench_bert_base(steps: int, batch_size: int, amp=None,
+                    fused_ce: bool = True):
+    """BASELINE config 3: BERT-base MLM pretrain step, seq 128.
+
+    ``fused_ce`` routes the MLM head through the chunked
+    linear-cross-entropy (ops/fused_loss.py) so the (B, T, 30k) logits
+    tensor never materializes — the HBM-bound hot spot of this config."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -150,6 +156,18 @@ def bench_bert_base(steps: int, batch_size: int, amp=None):
     model = B.BertForPretraining(cfg)
     rng = np.random.default_rng(0)
     T = 128
+
+    if fused_ce:
+        def make_batch(bs):
+            ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, T)))
+            nsp = jnp.asarray(rng.integers(0, 2, (bs,)))
+            return (ids, ids, nsp)  # MLM over every position: predict ids
+
+        def loss_fn(out, batch):
+            return out  # forward_fused_loss returns the scalar loss
+
+        return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                            amp=amp, method="forward_fused_loss")
 
     def make_batch(bs):
         return (jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, T))),)
@@ -164,8 +182,10 @@ def bench_bert_base(steps: int, batch_size: int, amp=None):
                         amp=amp)
 
 
-def bench_transformer_nmt(steps: int, batch_size: int, amp=None):
-    """BASELINE config 4: Transformer NMT train step, seq 64."""
+def bench_transformer_nmt(steps: int, batch_size: int, amp=None,
+                          fused_ce: bool = True):
+    """BASELINE config 4: Transformer NMT train step, seq 64. ``fused_ce``
+    routes the generator head through the chunked linear-cross-entropy."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -177,6 +197,16 @@ def bench_transformer_nmt(steps: int, batch_size: int, amp=None):
     model = TR.TransformerNMT(cfg)
     rng = np.random.default_rng(0)
     T = 64
+
+    if fused_ce:
+        def make_batch(bs):
+            src = jnp.asarray(rng.integers(3, cfg.src_vocab, (bs, T)))
+            tgt = jnp.asarray(rng.integers(3, cfg.tgt_vocab, (bs, T)))
+            return (src, tgt, tgt)
+
+        return _train_bench(model, lambda out, batch: out, make_batch,
+                            steps, batch_size, amp=amp,
+                            method="forward_fused_loss")
 
     def make_batch(bs):
         src = jnp.asarray(rng.integers(3, cfg.src_vocab, (bs, T)))
@@ -326,6 +356,12 @@ def main():
     ap.add_argument("--layout", default=None,
                     help="conv data format for models that support it "
                     "(NHWC default on resnet)")
+    ap.add_argument("--fused-ce", dest="fused_ce", default=True,
+                    action="store_true",
+                    help="bert/nmt: chunked linear-CE head (the default "
+                    "measured configuration; pass --no-fused-ce for the "
+                    "legacy full-logits path)")
+    ap.add_argument("--no-fused-ce", dest="fused_ce", action="store_false")
     ap.add_argument("--amp", default="mixed_bf16",
                     help="dtype policy for the step (mixed_bf16 is the TPU "
                     "training default; pass float32 to disable)")
@@ -378,6 +414,8 @@ def main():
         kwargs["amp"] = args.amp
     if "layout" in sig and args.layout:
         kwargs["layout"] = args.layout
+    if "fused_ce" in sig:
+        kwargs["fused_ce"] = args.fused_ce
     value, unit = fn(steps, batch, **kwargs)
 
     metric = f"{args.model}_throughput"

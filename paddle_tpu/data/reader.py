@@ -283,11 +283,27 @@ class PipeReader:
                                 stdout=subprocess.PIPE, bufsize=self.bufsize)
         decomp = (zlib.decompressobj(32 + zlib.MAX_WBITS)
                   if self.file_type == "gzip" else None)
+
+        def inflate(data):
+            # handle CONCATENATED gzip members (cat a.gz b.gz): restart the
+            # decompressor on unused_data until the chunk is consumed
+            nonlocal decomp
+            out = b""
+            while data:
+                out += decomp.decompress(data)
+                data = decomp.unused_data
+                if data:
+                    decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                elif decomp.eof:
+                    decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                    break
+            return out
+
         try:
             buf = b""
             for chunk in iter(lambda: proc.stdout.read(self.bufsize), b""):
                 if decomp is not None:
-                    chunk = decomp.decompress(chunk)
+                    chunk = inflate(chunk)
                 buf += chunk
                 if cut_lines:
                     lines = buf.split(line_break.encode())

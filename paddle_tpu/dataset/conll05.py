@@ -28,9 +28,8 @@ def get_embedding(emb_dim: int = _EMB_DIM):
 
 
 def _synthetic(mode: str, n: int):
-    rng = common.synthetic_rng("conll05", mode)
-
     def reader():
+        rng = common.synthetic_rng("conll05", mode)
         for _ in range(n):
             T = int(rng.integers(5, 40))
             words = rng.integers(1, _WORD_V, T)

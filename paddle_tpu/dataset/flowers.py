@@ -11,11 +11,11 @@ _CLASSES = 102
 
 
 def _synthetic(mode: str, n: int, hw: int):
-    rng = common.synthetic_rng("flowers", "proto")
-    protos = rng.normal(0.5, 0.2, (_CLASSES, 3, 8, 8)).astype(np.float32)
-    rng = common.synthetic_rng("flowers", mode)
+    protos = common.synthetic_rng("flowers", "proto").normal(
+        0.5, 0.2, (_CLASSES, 3, 8, 8)).astype(np.float32)
 
     def reader():
+        rng = common.synthetic_rng("flowers", mode)
         for _ in range(n):
             y = int(rng.integers(0, _CLASSES))
             # upsample the class prototype + noise to (3, hw, hw)

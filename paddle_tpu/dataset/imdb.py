@@ -17,9 +17,11 @@ def word_dict(vocab_size: int = _VOCAB):
 def _synthetic(mode: str, word_idx, n: int):
     # sentiment signal: positive reviews oversample the first vocab half
     V = len(word_idx)
-    rng = common.synthetic_rng("imdb", mode)
 
     def reader():
+        # fresh stream per invocation: every epoch/iteration replays the
+        # SAME samples (paddle reader-creator contract)
+        rng = common.synthetic_rng("imdb", mode)
         for _ in range(n):
             label = int(rng.integers(0, 2))
             T = int(rng.integers(16, 120))

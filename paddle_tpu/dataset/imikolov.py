@@ -21,9 +21,9 @@ def build_dict(min_word_freq: int = 50, vocab_size: int = _VOCAB):
 
 def _synthetic(mode: str, word_idx, n, data_type, size: int):
     V = len(word_idx)
-    rng = common.synthetic_rng("imikolov", mode)
 
     def reader():
+        rng = common.synthetic_rng("imikolov", mode)
         for _ in range(size):
             if data_type == DataType.NGRAM:
                 # learnable n-gram: last word = sum of context mod V

@@ -54,23 +54,25 @@ def movie_info():
 
 
 def _synthetic(mode: str, n: int):
-    rng = common.synthetic_rng("movielens", mode)
     wu = common.synthetic_rng("movielens", "wu").normal(0, 1, _MAX_USER + 1)
     wm = common.synthetic_rng("movielens", "wm").normal(0, 1, _MAX_MOVIE + 1)
+    users = user_info()
+    movies = movie_info()
 
     def reader():
+        # fresh stream per invocation (reader-creator contract); user and
+        # movie side features come from the SAME tables user_info()/
+        # movie_info() expose, so joins on those helpers are consistent
+        rng = common.synthetic_rng("movielens", mode)
         for _ in range(n):
             u = int(rng.integers(1, _MAX_USER + 1))
             m = int(rng.integers(1, _MAX_MOVIE + 1))
             # learnable bilinear preference signal, quantized to 1..5
             score = wu[u] * wm[m] + 0.1 * rng.normal()
             rating = float(np.clip(np.round(3 + 1.5 * np.tanh(score)), 1, 5))
-            yield (u, int(rng.integers(0, 2)),
-                   int(rng.integers(0, len(age_table))),
-                   int(rng.integers(0, _MAX_JOB)), m,
-                   list(map(int, rng.integers(0, _N_CATS, 2))),
-                   list(map(int, rng.integers(1, _TITLE_VOCAB, 4))),
-                   rating)
+            ui, mi = users[u], movies[m]
+            yield (u, ui["gender"], ui["age"], ui["job"], m,
+                   mi["categories"], mi["title"], rating)
 
     return reader
 

@@ -12,10 +12,11 @@ _N_FEAT = 46
 
 
 def _synthetic(mode: str, n_queries: int):
-    rng = common.synthetic_rng("mq2007", mode)
     w = common.synthetic_rng("mq2007", "w").normal(0, 1, _N_FEAT)
 
     def gen_query(qid):
+        # per-query stream keyed by qid: deterministic on re-iteration
+        rng = common.synthetic_rng("mq2007", f"{mode}:{qid}")
         docs = int(rng.integers(5, 20))
         X = rng.normal(0, 1, (docs, _N_FEAT)).astype(np.float32)
         score = X @ w

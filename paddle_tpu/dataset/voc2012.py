@@ -12,9 +12,8 @@ _CLASSES = 21
 
 
 def _synthetic(mode: str, n: int, hw: int):
-    rng = common.synthetic_rng("voc2012", mode)
-
     def reader():
+        rng = common.synthetic_rng("voc2012", mode)
         for _ in range(n):
             img = rng.normal(0.5, 0.2, (3, hw, hw)).astype(np.float32)
             mask = np.zeros((hw, hw), np.int64)

@@ -20,9 +20,8 @@ def get_dict(dict_size: int = 30000, reverse: bool = False):
 
 
 def _synthetic(mode: str, dict_size: int, n: int):
-    rng = common.synthetic_rng("wmt14", mode)
-
     def reader():
+        rng = common.synthetic_rng("wmt14", mode)
         for _ in range(n):
             T = int(rng.integers(4, 30))
             src = rng.integers(3, dict_size, T)

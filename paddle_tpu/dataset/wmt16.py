@@ -16,9 +16,8 @@ def get_dict(lang: str = "en", dict_size: int = 10000,
 
 
 def _synthetic(mode: str, src_dict_size: int, trg_dict_size: int, n: int):
-    rng = common.synthetic_rng("wmt16", mode)
-
     def reader():
+        rng = common.synthetic_rng("wmt16", mode)
         for _ in range(n):
             T = int(rng.integers(4, 30))
             src = rng.integers(3, src_dict_size, T)

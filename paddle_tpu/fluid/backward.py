@@ -13,8 +13,17 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     names = [v.name if hasattr(v, "name") else v for v in
              (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
     tlist = list(targets) if isinstance(targets, (list, tuple)) else [targets]
-    glist = (list(target_gradients)
-             if target_gradients is not None else [None] * len(tlist))
+    if target_gradients is None:
+        glist = [None] * len(tlist)
+    else:
+        glist = (list(target_gradients)
+                 if isinstance(target_gradients, (list, tuple))
+                 else [target_gradients])
+        from ..core.enforce import enforce
+
+        enforce(len(glist) == len(tlist),
+                "target_gradients has %s entries for %s targets",
+                len(glist), len(tlist))
     import jax.numpy as jnp
 
     weighted = []

@@ -103,6 +103,27 @@ class BertForPretraining(nn.Layer):
         nsp_logits = self.nsp(pooled)
         return mlm_logits, nsp_logits
 
+    def forward_fused_loss(self, input_ids, mlm_labels, nsp_label,
+                           token_type_ids=None, attention_mask=None,
+                           vocab_chunk: int = 4096):
+        """Pretrain loss WITHOUT materializing (B, T, V) logits: the MLM
+        head goes through ops.fused_loss.linear_cross_entropy (chunked
+        vocab scan — the HBM hot spot of MLM training; fused_loss.py
+        docstring has the numbers)."""
+        from ..ops.fused_loss import mean_linear_cross_entropy
+
+        h, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h_mlm = self.mlm_norm(self.mlm_transform(h))
+        b, t, d = h_mlm.shape
+        mlm_loss = mean_linear_cross_entropy(
+            h_mlm.reshape(b * t, d), self.mlm_decoder.weight,
+            self.mlm_decoder.bias, mlm_labels.reshape(-1),
+            chunk=vocab_chunk, ignore_index=-100)
+        nsp_logits = self.nsp(pooled)
+        nsp_loss = jnp.mean(L.softmax_with_cross_entropy(nsp_logits,
+                                                         nsp_label))
+        return mlm_loss + nsp_loss
+
 
 def pretrain_loss(outputs, labels):
     """labels: dict(mlm_labels (B,T) with -100 = unmasked, nsp_label (B,))."""

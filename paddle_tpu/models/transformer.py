@@ -82,6 +82,24 @@ class TransformerNMT(nn.Layer):
                          cross_mask=src_pad[:, None, None, :], causal=True)
         return self.generator(h)
 
+    def forward_fused_loss(self, src_ids, tgt_ids, tgt_labels,
+                           vocab_chunk: int = 4096):
+        """Training loss without the (B, T, tgt_vocab) logits tensor: the
+        generator head runs through the chunked linear-cross-entropy
+        (ops/fused_loss.py — same HBM argument as the BERT MLM head).
+        ``tgt_labels`` uses pad_id positions as ignored."""
+        from ..ops.fused_loss import mean_linear_cross_entropy
+
+        memory, src_pad = self.encode(src_ids)
+        h = self.decoder(self.pos_enc(self.tgt_emb(tgt_ids)), memory,
+                         cross_mask=src_pad[:, None, None, :], causal=True)
+        b, t, d = h.shape
+        labels = jnp.where(tgt_labels == self.cfg.pad_id, -100, tgt_labels)
+        return mean_linear_cross_entropy(
+            h.reshape(b * t, d), self.generator.weight,
+            self.generator.bias, labels.reshape(-1), chunk=vocab_chunk,
+            ignore_index=-100)
+
     def greedy_decode(self, src_ids, max_len: int = 64):
         """Fixed-length greedy decode via lax.scan (static shapes — the
         reference's while_op beam search maps to compiled scan on TPU)."""

@@ -218,9 +218,11 @@ class Layer:
     def functional_call(self, params: Dict[str, Any], *args,
                         buffers: Optional[Dict[str, Any]] = None,
                         rng: Optional[jax.Array] = None,
-                        training: Optional[bool] = None, **kwargs):
-        """Pure-function entry point: run forward with `params`/`buffers`
-        injected; returns (output, new_buffers). Safe to jit/grad over."""
+                        training: Optional[bool] = None,
+                        method: str = "forward", **kwargs):
+        """Pure-function entry point: run ``method`` (default forward) with
+        `params`/`buffers` injected; returns (output, new_buffers). Safe to
+        jit/grad over."""
         saved_params = dict(self.named_parameters())
         saved_buffers = dict(self.named_buffers())
         saved_training = self.training
@@ -234,7 +236,7 @@ class Layer:
                    "count": 0}
             _RNG_STACK.append(ctx)
             try:
-                out = self.forward(*args, **kwargs)
+                out = getattr(self, method)(*args, **kwargs)
             finally:
                 _RNG_STACK.pop()
             new_buffers = dict(self.named_buffers())

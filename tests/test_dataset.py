@@ -165,6 +165,42 @@ def test_common_split_and_cluster_reader(tmp_path):
     assert got == list(range(10))
 
 
+def test_reader_reinvocation_is_deterministic():
+    """Reader-creator contract: calling the SAME reader twice replays the
+    SAME stream (epoch loops + eval comparability)."""
+    makers = [
+        lambda: dataset.imdb.train(synthetic_size=6),
+        lambda: dataset.imikolov.train(synthetic_size=6),
+        lambda: dataset.wmt14.train(500, synthetic_size=6),
+        lambda: dataset.wmt16.test(300, 300, synthetic_size=6),
+        lambda: dataset.conll05.test(synthetic_size=4),
+        lambda: dataset.movielens.train(synthetic_size=6),
+        lambda: dataset.flowers.train(synthetic_size=2, image_hw=16),
+        lambda: dataset.voc2012.train(synthetic_size=2, image_hw=16),
+        lambda: dataset.mq2007.train("listwise", synthetic_size=3),
+    ]
+    for make in makers:
+        r = make()
+        a, b = _take(r, 3), _take(r, 3)
+        for s1, s2 in zip(a, b):
+            np.testing.assert_equal(
+                np.asarray(s1[0], dtype=object).tolist()
+                if isinstance(s1, tuple) else s1,
+                np.asarray(s2[0], dtype=object).tolist()
+                if isinstance(s2, tuple) else s2)
+
+
+def test_movielens_side_features_consistent_with_info_tables():
+    users = dataset.movielens.user_info()
+    movies = dataset.movielens.movie_info()
+    for s in _take(dataset.movielens.train(synthetic_size=16), 16):
+        u, g, a, j, m, cats, title, _ = s
+        assert (g, a, j) == (users[u]["gender"], users[u]["age"],
+                             users[u]["job"])
+        assert cats == movies[m]["categories"]
+        assert title == movies[m]["title"]
+
+
 def test_download_is_typed_error_without_cache():
     from paddle_tpu.core.enforce import EnforceError
 
