@@ -24,7 +24,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
-def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5):
+def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
+                    steps_per_call: int = 8):
+    """BASELINE config 1. ``steps_per_call`` fuses K optimizer steps into
+    one dispatch (Trainer.train_steps lax.scan) — through the remote-device
+    tunnel the per-dispatch round trip dominates a step this small."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -40,17 +44,21 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5):
     x = jnp.asarray(rng.normal(size=(batch_size, 784)).astype(np.float32))
     label = jnp.asarray(rng.integers(0, 10, batch_size))
     batch = {"x": x, "label": label}
+    k = max(1, steps_per_call)
+    outer = max(1, steps // k)
     for _ in range(warmup):
-        loss, _ = trainer.train_step(batch)
+        loss, _ = (trainer.train_steps(batch, k) if k > 1
+                   else trainer.train_step(batch))
     float(loss)  # host fetch = the only reliable fence (see _train_bench)
     t0 = time.perf_counter()
-    for i in range(steps):
-        loss, _ = trainer.train_step(batch)
+    for i in range(outer):
+        loss, _ = (trainer.train_steps(batch, k) if k > 1
+                   else trainer.train_step(batch))
         if i % 4 == 3:
             float(loss)
     float(loss)
     dt = time.perf_counter() - t0
-    return steps * batch_size / dt, "examples/sec"
+    return outer * k * batch_size / dt, "examples/sec"
 
 
 def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,

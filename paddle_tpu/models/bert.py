@@ -110,15 +110,21 @@ class BertForPretraining(nn.Layer):
         head goes through ops.fused_loss.linear_cross_entropy (chunked
         vocab scan — the HBM hot spot of MLM training; fused_loss.py
         docstring has the numbers)."""
+        from ..core.dtypes import get_policy
         from ..ops.fused_loss import mean_linear_cross_entropy
 
         h, pooled = self.bert(input_ids, token_type_ids, attention_mask)
         h_mlm = self.mlm_norm(self.mlm_transform(h))
         b, t, d = h_mlm.shape
+        # the vocab matmuls honor the AMP compute dtype (bf16 on the MXU),
+        # exactly like the Linear head they replace; the op's logsumexp
+        # accumulators stay fp32 internally
+        pol = get_policy()
         mlm_loss = mean_linear_cross_entropy(
-            h_mlm.reshape(b * t, d), self.mlm_decoder.weight,
-            self.mlm_decoder.bias, mlm_labels.reshape(-1),
-            chunk=vocab_chunk, ignore_index=-100)
+            pol.cast_to_compute(h_mlm.reshape(b * t, d)),
+            pol.cast_to_compute(self.mlm_decoder.weight),
+            pol.cast_to_compute(self.mlm_decoder.bias),
+            mlm_labels.reshape(-1), chunk=vocab_chunk, ignore_index=-100)
         nsp_logits = self.nsp(pooled)
         nsp_loss = jnp.mean(L.softmax_with_cross_entropy(nsp_logits,
                                                          nsp_label))

@@ -88,6 +88,7 @@ class TransformerNMT(nn.Layer):
         generator head runs through the chunked linear-cross-entropy
         (ops/fused_loss.py — same HBM argument as the BERT MLM head).
         ``tgt_labels`` uses pad_id positions as ignored."""
+        from ..core.dtypes import get_policy
         from ..ops.fused_loss import mean_linear_cross_entropy
 
         memory, src_pad = self.encode(src_ids)
@@ -95,10 +96,12 @@ class TransformerNMT(nn.Layer):
                          cross_mask=src_pad[:, None, None, :], causal=True)
         b, t, d = h.shape
         labels = jnp.where(tgt_labels == self.cfg.pad_id, -100, tgt_labels)
+        pol = get_policy()  # vocab matmuls in the AMP compute dtype (bf16)
         return mean_linear_cross_entropy(
-            h.reshape(b * t, d), self.generator.weight,
-            self.generator.bias, labels.reshape(-1), chunk=vocab_chunk,
-            ignore_index=-100)
+            pol.cast_to_compute(h.reshape(b * t, d)),
+            pol.cast_to_compute(self.generator.weight),
+            pol.cast_to_compute(self.generator.bias),
+            labels.reshape(-1), chunk=vocab_chunk, ignore_index=-100)
 
     def greedy_decode(self, src_ids, max_len: int = 64):
         """Fixed-length greedy decode via lax.scan (static shapes — the

@@ -66,7 +66,9 @@ def _lce_fwd_impl(hidden, weight, bias, labels, chunk, ignore_index):
     def body(carry, xs):
         m, s = carry                       # running max (N,), sumexp (N,)
         w_c, b_c, mask_c = xs
-        logits = hidden @ w_c + b_c        # (N, C) — the only live tile
+        # matmul in the input (AMP compute) dtype — MXU work; accumulate
+        # the logsumexp in fp32
+        logits = (hidden @ w_c + b_c).astype(jnp.float32)
         logits = jnp.where(mask_c[None, :], logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
@@ -80,18 +82,12 @@ def _lce_fwd_impl(hidden, weight, bias, labels, chunk, ignore_index):
 
     safe = jnp.clip(labels, 0, v - 1)
     w_t = jnp.take(weight, safe, axis=1).T          # (N, D) target columns
-    t_logit = jnp.sum(hidden * w_t, axis=1)
+    t_logit = jnp.sum((hidden * w_t).astype(jnp.float32), axis=1)
     if bias is not None:
-        t_logit = t_logit + jnp.take(bias, safe)
+        t_logit = t_logit + jnp.take(bias, safe).astype(jnp.float32)
     valid = labels != ignore_index
     loss = jnp.where(valid, lse - t_logit, 0.0)
     return loss, (hidden, weight, bias, labels, lse)
-
-
-def _lce_fwd(hidden, weight, bias, labels, chunk, ignore_index):
-    loss, res = _lce_fwd_impl(hidden, weight, bias, labels, chunk,
-                              ignore_index)
-    return loss, res
 
 
 def _lce_bwd(chunk, ignore_index, res, g):
@@ -106,28 +102,30 @@ def _lce_bwd(chunk, ignore_index, res, g):
 
     def body(dh, xs):
         w_c, b_c, idx0 = xs
-        logits = hidden @ w_c + b_c
+        logits = (hidden @ w_c + b_c).astype(jnp.float32)
         col = idx0 + jnp.arange(chunk)
         p = jnp.where(col[None, :] < v,
                       jnp.exp(logits - lse[:, None]), 0.0)  # softmax tile
         # dlogits = gv * (p - onehot)
         onehot = (col[None, :] == safe[:, None]).astype(p.dtype)
-        dl = gv[:, None] * (p - onehot)    # (N, C)
-        dh = dh + dl @ w_c.T               # accumulate (N, D)
+        dl = (gv[:, None] * (p - onehot)).astype(hidden.dtype)  # (N, C)
+        dh = dh + (dl @ w_c.T).astype(jnp.float32)  # fp32 accumulator
         dw_c = hidden.T @ dl               # (D, C)
-        db_c = jnp.sum(dl, axis=0)
+        db_c = jnp.sum(dl.astype(jnp.float32), axis=0)
         return dh, (dw_c, db_c)
 
     idx0s = jnp.arange(num_chunks) * chunk
-    dh0 = jnp.zeros_like(hidden)
+    dh0 = jnp.zeros(hidden.shape, jnp.float32)
     dh, (dw_chunks, db_chunks) = lax.scan(body, dh0, (wc, bc, idx0s))
     dw = jnp.transpose(dw_chunks, (1, 0, 2)).reshape(d, num_chunks * chunk)
-    dw = dw[:, :v]
-    db = db_chunks.reshape(-1)[:v] if bias is not None else None
+    dw = dw[:, :v].astype(weight.dtype)
+    dh = dh.astype(hidden.dtype)
+    db = (db_chunks.reshape(-1)[:v].astype(bias.dtype)
+          if bias is not None else None)
     return dh, dw, db, None
 
 
-linear_cross_entropy.defvjp(_lce_fwd, _lce_bwd)
+linear_cross_entropy.defvjp(_lce_fwd_impl, _lce_bwd)
 
 
 def mean_linear_cross_entropy(hidden, weight, bias, labels,

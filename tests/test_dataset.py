@@ -179,15 +179,16 @@ def test_reader_reinvocation_is_deterministic():
         lambda: dataset.voc2012.train(synthetic_size=2, image_hw=16),
         lambda: dataset.mq2007.train("listwise", synthetic_size=3),
     ]
+    def flat(sample):
+        if isinstance(sample, (tuple, list)):
+            return [np.asarray(f).tolist() for f in sample]
+        return np.asarray(sample).tolist()
+
     for make in makers:
         r = make()
         a, b = _take(r, 3), _take(r, 3)
         for s1, s2 in zip(a, b):
-            np.testing.assert_equal(
-                np.asarray(s1[0], dtype=object).tolist()
-                if isinstance(s1, tuple) else s1,
-                np.asarray(s2[0], dtype=object).tolist()
-                if isinstance(s2, tuple) else s2)
+            assert flat(s1) == flat(s2)
 
 
 def test_movielens_side_features_consistent_with_info_tables():

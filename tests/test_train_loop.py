@@ -119,3 +119,35 @@ class TestWatchdog:
                          watchdog_timeout_s=60)
         loop.run(batches(2))
         assert loop._watchdog is not None and not loop._watchdog.stalled
+
+
+def test_trainer_train_steps_matches_single_steps():
+    """K fused steps (one dispatch, lax.scan) follow the SAME trajectory as
+    K train_step calls — num_iteration_per_drop_scope analog."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.models import mnist as M
+
+    rng = np.random.default_rng(0)
+    batch = {"x": jnp.asarray(rng.normal(size=(16, 784)).astype(np.float32)),
+             "label": jnp.asarray(rng.integers(0, 10, 16))}
+    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+
+    pt.seed(7)
+    t1 = parallel.Trainer.supervised(M.MnistMLP(), optimizer.Adam(1e-3),
+                                     M.loss_fn, mesh=mesh)
+    l_fused, _ = t1.train_steps(batch, 4)
+
+    pt.seed(7)
+    t2 = parallel.Trainer.supervised(M.MnistMLP(), optimizer.Adam(1e-3),
+                                     M.loss_fn, mesh=mesh)
+    for _ in range(4):
+        l_single, _ = t2.train_step(batch)
+    assert abs(float(l_fused) - float(l_single)) < 1e-6
+    for k in t1.params:
+        np.testing.assert_allclose(np.asarray(t1.params[k]),
+                                   np.asarray(t2.params[k]), atol=1e-6)
