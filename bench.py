@@ -343,8 +343,61 @@ def bench_se_resnext50(steps: int, batch_size: int, smoke: bool = False,
                         amp=amp)
 
 
+def bench_alexnet(steps: int, batch_size: int, smoke: bool = False,
+                  amp=None):
+    """Legacy comparison family (reference benchmark/figs AlexNet charts)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import alexnet as A
+
+    pt.seed(0)
+    batch_size = min(batch_size, 8 if smoke else 256)
+    model = A.alexnet(num_classes=1000)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        return (jnp.asarray(rng.normal(size=(bs, 3, 224, 224))
+                            .astype(np.float32)),)
+
+    def loss_fn(logits, batch):
+        labels = jnp.zeros((logits.shape[0],), jnp.int32)
+        return A.loss_fn(logits, labels)
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
+
+
+def bench_googlenet(steps: int, batch_size: int, smoke: bool = False,
+                    amp=None):
+    """Legacy comparison family (reference benchmark/figs GoogleNet)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import googlenet as G
+
+    pt.seed(0)
+    batch_size = min(batch_size, 8 if smoke else 128)
+    model = G.googlenet(num_classes=1000)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        return (jnp.asarray(rng.normal(size=(bs, 3, 224, 224))
+                            .astype(np.float32)),)
+
+    def loss_fn(outputs, batch):
+        bs = (outputs[0] if isinstance(outputs, tuple) else outputs).shape[0]
+        labels = jnp.zeros((bs,), jnp.int32)
+        return G.loss_fn(outputs, labels)
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
+
+
 MODELS = {
     "mnist_mlp": bench_mnist_mlp,
+    "alexnet": bench_alexnet,
+    "googlenet": bench_googlenet,
     "stacked_lstm": bench_stacked_lstm,
     "vgg16": bench_vgg16,
     "se_resnext50": bench_se_resnext50,

@@ -66,9 +66,11 @@ def _lce_fwd_impl(hidden, weight, bias, labels, chunk, ignore_index):
     def body(carry, xs):
         m, s = carry                       # running max (N,), sumexp (N,)
         w_c, b_c, mask_c = xs
-        # matmul in the input (AMP compute) dtype — MXU work; accumulate
-        # the logsumexp in fp32
-        logits = (hidden @ w_c + b_c).astype(jnp.float32)
+        # bf16 inputs on the MXU, fp32 accumulation — MUST match t_logit's
+        # precision or confident rows go negative (lse < target logit)
+        logits = jnp.matmul(hidden, w_c,
+                            preferred_element_type=jnp.float32) \
+            + b_c.astype(jnp.float32)
         logits = jnp.where(mask_c[None, :], logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
@@ -83,6 +85,7 @@ def _lce_fwd_impl(hidden, weight, bias, labels, chunk, ignore_index):
     safe = jnp.clip(labels, 0, v - 1)
     w_t = jnp.take(weight, safe, axis=1).T          # (N, D) target columns
     t_logit = jnp.sum((hidden * w_t).astype(jnp.float32), axis=1)
+    # (elementwise product rounds like the fp32-accumulated matmul tiles)
     if bias is not None:
         t_logit = t_logit + jnp.take(bias, safe).astype(jnp.float32)
     valid = labels != ignore_index
@@ -102,7 +105,9 @@ def _lce_bwd(chunk, ignore_index, res, g):
 
     def body(dh, xs):
         w_c, b_c, idx0 = xs
-        logits = (hidden @ w_c + b_c).astype(jnp.float32)
+        logits = jnp.matmul(hidden, w_c,
+                            preferred_element_type=jnp.float32) \
+            + b_c.astype(jnp.float32)
         col = idx0 + jnp.arange(chunk)
         p = jnp.where(col[None, :] < v,
                       jnp.exp(logits - lse[:, None]), 0.0)  # softmax tile

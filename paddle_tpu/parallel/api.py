@@ -201,6 +201,7 @@ class Trainer:
         enforce(self.grad_accum_steps == 1,
                 "train_steps composes with plain steps only (use "
                 "train_step for gradient merge)")
+        enforce(n >= 1, "train_steps needs n >= 1, got %s", n)
         key = ("train_steps", int(n))
         fn = self._multi_cache.get(key)
         if fn is None:

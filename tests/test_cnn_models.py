@@ -79,3 +79,37 @@ def test_resnet_batchnorm_buffers_update():
                if not np.allclose(np.asarray(buffers[k]),
                                   np.asarray(new_buf[k]))]
     assert changed, "BN running stats should update in training mode"
+
+
+def test_alexnet_forward_and_train_step():
+    from paddle_tpu.models import alexnet as A
+
+    pt.seed(0)
+    m = A.alexnet(num_classes=7)
+    x = jnp.asarray(np.random.default_rng(0)
+                    .normal(size=(2, 3, 224, 224)).astype(np.float32))
+    params = m.named_parameters()
+    out, _ = m.functional_call(params, x, training=False)
+    assert out.shape == (2, 7)
+    labels = jnp.asarray([1, 3])
+    g = jax.grad(lambda p: A.loss_fn(
+        m.functional_call(p, x, training=False)[0], labels))(params)
+    assert all(bool(jnp.isfinite(v).all()) for v in g.values())
+
+
+def test_googlenet_aux_heads_train_vs_eval():
+    from paddle_tpu.models import googlenet as G
+
+    pt.seed(0)
+    m = G.googlenet(num_classes=5)
+    x = jnp.asarray(np.random.default_rng(1)
+                    .normal(size=(2, 3, 224, 224)).astype(np.float32))
+    params = m.named_parameters()
+    out_t, _ = m.functional_call(params, x, training=True)
+    assert isinstance(out_t, tuple) and len(out_t) == 3  # main + 2 aux
+    out_e, _ = m.functional_call(params, x, training=False)
+    assert out_e.shape == (2, 5)  # aux heads vanish at inference
+    labels = jnp.asarray([0, 4])
+    loss = G.loss_fn(out_t, labels)
+    assert bool(jnp.isfinite(loss))
+    assert float(G.loss_fn(out_e, labels)) > 0  # eval form also scores
