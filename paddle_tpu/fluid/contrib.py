@@ -11,7 +11,7 @@ from .. import data as reader          # contrib/reader → data pipeline
 from ..core.enforce import EnforceError
 from ..ops import decode as _decode
 from ..quant import calibrate as _calibrate
-from ..quant import qat as _qat
+from ..quant import quantize_model as _quantize_model
 from ..slim import Distiller, Pruner
 from ..utils.memory import memory_usage
 
@@ -79,8 +79,11 @@ class QuantizeTranspiler:
                         weight_quantize_type=weight_quantize_type)
 
     def training_transpile(self, layer, startup_program=None):
-        return _qat(layer, **{k: v for k, v in self.cfg.items()
-                              if k in ("weight_bits", "activation_bits")})
+        from ..quant import QuantConfig
+
+        cfg = QuantConfig(weight_bits=self.cfg["weight_bits"],
+                          activation_bits=self.cfg["activation_bits"])
+        return _quantize_model(layer, cfg)
 
     def freeze_program(self, layer, place=None):
         from ..quant import freeze

@@ -1,10 +1,9 @@
 """GoogLeNet (Inception v1) — reference: benchmark/figs legacy comparison
-family; rebuilt from framework layers (NCHW, BN instead of LRN — the
-TPU-friendly normalization; aux heads included for training parity)."""
+family; rebuilt from framework layers (NCHW, plain conv+relu as in the
+v1 paper — no LRN, which XLA has no fast path for; aux heads included
+for training parity)."""
 
 from __future__ import annotations
-
-from typing import Optional
 
 import jax.numpy as jnp
 

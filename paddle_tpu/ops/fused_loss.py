@@ -84,8 +84,11 @@ def _lce_fwd_impl(hidden, weight, bias, labels, chunk, ignore_index):
 
     safe = jnp.clip(labels, 0, v - 1)
     w_t = jnp.take(weight, safe, axis=1).T          # (N, D) target columns
-    t_logit = jnp.sum((hidden * w_t).astype(jnp.float32), axis=1)
-    # (elementwise product rounds like the fp32-accumulated matmul tiles)
+    # fp32 products + fp32 sum, EXACTLY like the preferred_element_type
+    # matmul tiles — a bf16-rounded product here would make lse < t_logit
+    # (negative loss) on confident rows
+    t_logit = jnp.sum(hidden.astype(jnp.float32)
+                      * w_t.astype(jnp.float32), axis=1)
     if bias is not None:
         t_logit = t_logit + jnp.take(bias, safe).astype(jnp.float32)
     valid = labels != ignore_index

@@ -1,0 +1,33 @@
+"""int8 execution of frozen quantized layers (reference: the mkldnn int8
+kernel role + contrib/int8_inference) over the Pallas quantized-matmul
+kernel: weights live as int8 (from quant.freeze), activations quantize
+per-tensor at the recorded act scale, the GEMM accumulates int32 on the
+MXU and dequantizes in the kernel epilogue."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+from ..ops.pallas.quant_matmul import quant_matmul
+
+
+def int8_linear(x, frozen_entry, bias=None, *, out_dtype=jnp.float32,
+                use_pallas=None, interpret: bool = False):
+    """Run a frozen Linear layer in int8: x (N, D) float; frozen_entry is
+    one value of quant.freeze()'s dict ({"weight_int8" (D, O),
+    "weight_scale" (O,), "act_scale" scalar})."""
+    w_i8 = frozen_entry["weight_int8"]
+    enforce(w_i8.dtype == jnp.int8 or w_i8.dtype == jnp.int32,
+            "frozen weight must be integer, got %s", w_i8.dtype)
+    w_i8 = w_i8.astype(jnp.int8)
+    a_scale = jnp.maximum(jnp.asarray(frozen_entry["act_scale"],
+                                      jnp.float32) / 127.0, 1e-10)
+    x_i8 = jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
+    w_scale = jnp.asarray(frozen_entry["weight_scale"],
+                          jnp.float32) / 127.0
+    out = quant_matmul(x_i8, w_i8, a_scale, w_scale, out_dtype=out_dtype,
+                       use_pallas=use_pallas, interpret=interpret)
+    if bias is not None:
+        out = out + bias
+    return out
