@@ -9,5 +9,6 @@ on TPU they compile via Mosaic.
 """
 
 from .flash_attention import flash_attention
+from .quant_matmul import quant_matmul, quantize_tensor
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "quant_matmul", "quantize_tensor"]

@@ -81,7 +81,8 @@ def _pallas_quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype,
         ],
         out_specs=_spec((tile_m, tile_n), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
-        scratch_shapes=[pl.ANY if pltpu is None
+        scratch_shapes=[jax.ShapeDtypeStruct((tile_m, tile_n), jnp.int32)
+                        if pltpu is None
                         else pltpu.VMEM((tile_m, tile_n), jnp.int32)],
         interpret=interpret,
     )(a_i8, b_i8, a_scale_arr, b_scale_vec)
@@ -108,10 +109,14 @@ def quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype=jnp.float32,
                       and m % tile_m == 0 and n % tile_n == 0
                       and ka % tile_k == 0)
     if use_pallas or interpret:
+        tm, tn, tk = min(tile_m, m), min(tile_n, n), min(tile_k, ka)
+        enforce(m % tm == 0 and n % tn == 0 and ka % tk == 0,
+                "quant_matmul kernel needs tile-divisible shapes, got "
+                "(%s, %s, %s) with tiles (%s, %s, %s) — pad upstream",
+                m, ka, n, tm, tk, tn)
         return _pallas_quant_matmul(
             a_i8, b_i8, a_scale, b_scale, out_dtype=out_dtype,
-            tile_m=min(tile_m, m), tile_n=min(tile_n, n),
-            tile_k=min(tile_k, ka), interpret=interpret)
+            tile_m=tm, tile_n=tn, tile_k=tk, interpret=interpret)
     acc = jax.lax.dot_general(a_i8, b_i8, (((1,), (0,)), ((), ())),
                               preferred_element_type=jnp.int32)
     scale = jnp.asarray(a_scale, jnp.float32) * \

@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.enforce import enforce
+from ..nn.layer import Layer as _Layer
 from ..ops.pallas.quant_matmul import quant_matmul
 
 
@@ -31,3 +32,63 @@ def int8_linear(x, frozen_entry, bias=None, *, out_dtype=jnp.float32,
     if bias is not None:
         out = out + bias
     return out
+
+
+class Int8Linear(_Layer):
+    """Frozen int8 Linear executor: weights are fixed int8 BUFFERS (from
+    quant.freeze), never trainable — a proper Layer so train/eval/state
+    traversal over a swapped model keeps working."""
+
+    def __init__(self, frozen_entry, bias=None, act=None):
+        super().__init__()
+        self.register_buffer("weight_int8",
+                             jnp.asarray(frozen_entry["weight_int8"]))
+        self.register_buffer("weight_scale",
+                             jnp.asarray(frozen_entry["weight_scale"],
+                                         jnp.float32))
+        self.register_buffer("act_scale",
+                             jnp.asarray(frozen_entry["act_scale"],
+                                         jnp.float32))
+        if bias is not None:
+            self.register_buffer("linear_bias", jnp.asarray(bias))
+        self.has_bias = bias is not None
+        self.act = act
+
+    def forward(self, x):
+        entry = {"weight_int8": self.weight_int8,
+                 "weight_scale": self.weight_scale,
+                 "act_scale": self.act_scale}
+        out = int8_linear(x, entry,
+                          bias=self.linear_bias if self.has_bias else None)
+        from ..nn.layers import _apply_act  # same resolver as nn.Linear
+
+        return _apply_act(out, self.act)
+
+
+def int8_swap(model, frozen):
+    """Swap every frozen QuantedLayer-wrapped Linear for an Int8Linear so
+    plain ``model(x)`` inference runs the int8 kernel path (the
+    QuantizationFreezePass → int8 runtime handoff). Conv layers keep the
+    fake-quant float path (int8 conv lowering is a further step). Returns
+    the number of layers swapped."""
+    from .qat import QuantedLayer
+
+    swapped = 0
+    for path, sub in list(model.named_sublayers()):
+        if not isinstance(sub, QuantedLayer) or path not in frozen:
+            continue
+        inner = sub.inner
+        if type(inner).__name__ != "Linear":
+            continue
+        repl = Int8Linear(frozen[path],
+                          bias=inner._params.get("bias"),
+                          act=getattr(inner, "act", None))
+        # locate the parent and rebind the attribute/sublayer slot
+        parent = model
+        parts = path.split(".")
+        for p in parts[:-1]:
+            parent = parent._sublayers[p]
+        parent._sublayers[parts[-1]] = repl
+        object.__setattr__(parent, parts[-1], repl)
+        swapped += 1
+    return swapped

@@ -108,9 +108,14 @@ class Executor:
     placement; a mesh-aware CompiledProgram wrapper adds SPMD."""
 
     def __init__(self, place=None, scope: Optional[Scope] = None):
+        from collections import OrderedDict
+
         self.place = place
         self._scope = scope  # None = resolve global scope AT RUN TIME, so
-        self._cache: Dict[Tuple, Any] = {}  # fluid.scope_guard works
+        # LRU-bounded executable cache (FLAGS_compile_cache_capacity):
+        # recompilation management, SURVEY §7 "hard parts" — unbounded
+        # shape churn must evict, not accumulate    (scope_guard works ^)
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
 
     @property
     def scope(self) -> Scope:
@@ -204,6 +209,8 @@ class Executor:
                            for k, v in feed_vals.items()))
         key = (id(program), program.version, sig, fetch_names)
         step = self._cache.get(key)
+        if step is not None:
+            self._cache.move_to_end(key)  # LRU touch
         if step is None:
             def step(params, feed_vals, _prog=program, _consts=consts,
                      _fetch=fetch_names, _persist=tuple(persist)):
@@ -216,6 +223,11 @@ class Executor:
 
             step = jax.jit(step, donate_argnums=(0,))
             self._cache[key] = step
+            from ..core.config import FLAGS
+
+            cap = max(int(FLAGS.get("compile_cache_capacity")), 1)
+            while len(self._cache) > cap:
+                self._cache.popitem(last=False)  # evict least recent
 
         fetched, new_params = step(params, feed_vals)
         for n, v in new_params.items():

@@ -68,3 +68,29 @@ def test_qat_freeze_int8_serve_e2e():
     rel = float(jnp.abs(out - ref).max() /
                 jnp.maximum(jnp.abs(ref).max(), 1e-6))
     assert rel < 0.1, rel
+
+
+def test_int8_swap_whole_model_inference():
+    """QAT model -> freeze -> int8_swap: plain model(x) runs the int8
+    kernel path for every Linear, matching the fake-quant float model."""
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32, act="relu"), nn.Linear(32, 4))
+    q = quant.quantize_model(model)
+    rng = np.random.default_rng(3)
+    batches = [jnp.asarray(rng.normal(0, 1, (4, 16)).astype(np.float32))
+               for _ in range(3)]
+    quant.calibrate(q, batches)
+    frozen = quant.freeze(q)
+    x = batches[0]
+    ref, _ = q.functional_call(q.named_parameters(), x, training=False)
+    assert quant.int8_swap(q, frozen) == 2
+    q.eval()
+    out = q(x)
+    rel = float(jnp.abs(out - ref).max() /
+                jnp.maximum(jnp.abs(ref).max(), 1e-6))
+    assert rel < 0.1
+    # swapped model jits and the int8 weights are buffers, not params
+    out_jit = jax.jit(lambda xx: q(xx))(x)
+    assert bool(jnp.allclose(out, out_jit))
+    assert all("weight_int8" not in k for k in q.named_parameters())
+    assert any("weight_int8" in k for k in q.named_buffers())

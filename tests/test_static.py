@@ -219,3 +219,33 @@ def test_feed_validation_errors():
         exe.run(prog, feed={}, fetch_list=["nope"])
     with pytest.raises(Exception, match="feed"):
         exe.run(prog, feed={"bogus": np.zeros(3)}, fetch_list=[loss])
+
+
+def test_executor_compile_cache_lru_eviction():
+    """FLAGS_compile_cache_capacity bounds cached executables per Executor
+    (recompilation management — unbounded shape churn must evict)."""
+    import numpy as np
+
+    from paddle_tpu import static
+    from paddle_tpu.core.config import FLAGS
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (-1, 4))
+        y = prog.apply(lambda v: v * 2.0, [x], name="y")
+    exe = static.Executor(scope=static.Scope())
+    old = FLAGS.get("compile_cache_capacity")
+    try:
+        FLAGS.set("compile_cache_capacity", 3)
+        for bs in (1, 2, 3, 4, 5):  # 5 shapes through a capacity of 3
+            out = exe.run(prog, feed={"x": np.ones((bs, 4), np.float32)},
+                          fetch_list=[y])
+            assert out[0].shape == (bs, 4)
+        assert len(exe._cache) == 3
+        # most-recent shapes survive; re-running one is a cache hit
+        n_before = len(exe._cache)
+        exe.run(prog, feed={"x": np.ones((5, 4), np.float32)},
+                fetch_list=[y])
+        assert len(exe._cache) == n_before
+    finally:
+        FLAGS.set("compile_cache_capacity", old)
