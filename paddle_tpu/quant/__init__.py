@@ -7,7 +7,8 @@ from .ops import (MovingAverageState, RangeState, abs_max_scale, dequantize,
                   fake_quantize_range_abs_max, moving_average_abs_max_scale,
                   moving_average_state_init, quantize_dequantize,
                   quantize_to_int, range_state_init)
-from .int8 import Int8Linear, int8_linear, int8_swap
+from .int8 import (Int8Conv2D, Int8Linear, int8_conv2d,
+                   int8_linear, int8_swap)
 from .qat import (QuantConfig, QuantedLayer, calibrate, freeze,
                   quantize_model)
 
@@ -18,5 +19,5 @@ __all__ = [
     "moving_average_abs_max_scale", "moving_average_state_init",
     "quantize_dequantize", "quantize_to_int", "range_state_init",
     "QuantConfig", "QuantedLayer", "calibrate", "freeze", "quantize_model",
-    "int8_linear", "int8_swap", "Int8Linear",
+    "int8_linear", "int8_swap", "Int8Linear", "Int8Conv2D", "int8_conv2d",
 ]

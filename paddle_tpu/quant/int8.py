@@ -13,18 +13,35 @@ from ..nn.layer import Layer as _Layer
 from ..ops.pallas.quant_matmul import quant_matmul
 
 
+def _as_int8_weight(w):
+    enforce(jnp.issubdtype(w.dtype, jnp.integer),
+            "frozen weight must be integer, got %s", w.dtype)
+    return w.astype(jnp.int8)
+
+
+def _quantize_acts(x, act_scale):
+    """Per-tensor activation quantization at the recorded abs-max scale
+    (shared rounding convention for the linear and conv paths)."""
+    a_scale = jnp.maximum(jnp.asarray(act_scale, jnp.float32) / 127.0,
+                          1e-10)
+    x_i8 = jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
+    return x_i8, a_scale
+
+
+def _uniform(v):
+    """stride/padding normalizer: (2, 2) -> 2; non-uniform stays tuple."""
+    if isinstance(v, (tuple, list)):
+        return v[0] if len(set(v)) == 1 else tuple(v)
+    return v
+
+
 def int8_linear(x, frozen_entry, bias=None, *, out_dtype=jnp.float32,
                 use_pallas=None, interpret: bool = False):
     """Run a frozen Linear layer in int8: x (N, D) float; frozen_entry is
     one value of quant.freeze()'s dict ({"weight_int8" (D, O),
     "weight_scale" (O,), "act_scale" scalar})."""
-    w_i8 = frozen_entry["weight_int8"]
-    enforce(w_i8.dtype == jnp.int8 or w_i8.dtype == jnp.int32,
-            "frozen weight must be integer, got %s", w_i8.dtype)
-    w_i8 = w_i8.astype(jnp.int8)
-    a_scale = jnp.maximum(jnp.asarray(frozen_entry["act_scale"],
-                                      jnp.float32) / 127.0, 1e-10)
-    x_i8 = jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
+    w_i8 = _as_int8_weight(frozen_entry["weight_int8"])
+    x_i8, a_scale = _quantize_acts(x, frozen_entry["act_scale"])
     w_scale = jnp.asarray(frozen_entry["weight_scale"],
                           jnp.float32) / 127.0
     out = quant_matmul(x_i8, w_i8, a_scale, w_scale, out_dtype=out_dtype,
@@ -78,11 +95,24 @@ def int8_swap(model, frozen):
         if not isinstance(sub, QuantedLayer) or path not in frozen:
             continue
         inner = sub.inner
-        if type(inner).__name__ != "Linear":
-            continue
-        repl = Int8Linear(frozen[path],
-                          bias=inner._params.get("bias"),
-                          act=getattr(inner, "act", None))
+        tname = type(inner).__name__
+        if tname == "Linear":
+            repl = Int8Linear(frozen[path],
+                              bias=inner._params.get("bias"),
+                              act=getattr(inner, "act", None))
+        elif (tname == "Conv2D"
+              and getattr(inner, "groups", 1) == 1
+              and _uniform(getattr(inner, "dilation", 1)) == 1
+              and getattr(inner, "data_format", "NCHW") == "NCHW"
+              and isinstance(_uniform(getattr(inner, "stride", 1)), int)
+              and isinstance(_uniform(getattr(inner, "padding", 0)), int)):
+            repl = Int8Conv2D(frozen[path],
+                              bias=inner._params.get("bias"),
+                              act=getattr(inner, "act", None),
+                              stride=_uniform(inner.stride),
+                              padding=_uniform(inner.padding))
+        else:
+            continue  # grouped/dilated/NHWC convs keep the float path
         # locate the parent and rebind the attribute/sublayer slot
         parent = model
         parts = path.split(".")
@@ -92,3 +122,97 @@ def int8_swap(model, frozen):
         object.__setattr__(parent, parts[-1], repl)
         swapped += 1
     return swapped
+
+
+def _im2col_nchw(x, kh: int, kw: int, stride: int, padding: int):
+    """(B, C, H, W) -> (B*OH*OW, kh*kw*C) patches, (i, j, c) inner order —
+    integer-safe (slicing only), so int8 activations stay int8."""
+    b, c, h, w = x.shape
+    if padding:
+        x = jnp.pad(x, ((0, 0), (0, 0), (padding, padding),
+                        (padding, padding)))
+    oh = (h + 2 * padding - kh) // stride + 1
+    ow = (w + 2 * padding - kw) // stride + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(x[:, :, i:i + oh * stride:stride,
+                          j:j + ow * stride:stride])  # (B, C, OH, OW)
+    # (kh*kw, B, C, OH, OW) -> (B, OH, OW, kh*kw, C)
+    stacked = jnp.stack(cols, axis=0)
+    patches = jnp.transpose(stacked, (1, 3, 4, 0, 2))
+    return patches.reshape(b * oh * ow, kh * kw * c), (b, oh, ow)
+
+
+def int8_conv2d(x, frozen_entry, bias=None, *, stride: int = 1,
+                padding: int = 0, out_dtype=jnp.float32, use_pallas=None,
+                interpret: bool = False):
+    """Frozen int8 Conv2D: quantize activations per-tensor, im2col (int8
+    slicing — no float copy), one int8 GEMM against the reshaped (O, C,
+    kh, kw) weight, dequant epilogue. The mkldnn int8-conv role on the
+    MXU. x (B, C, H, W) float -> (B, O, OH, OW)."""
+    w_i8 = _as_int8_weight(frozen_entry["weight_int8"])
+    o, c, kh, kw = w_i8.shape
+    x_i8, a_scale = _quantize_acts(x, frozen_entry["act_scale"])
+    patches, (b, oh, ow) = _im2col_nchw(x_i8, kh, kw, stride, padding)
+    # weight -> (kh*kw*C, O) in the SAME (i, j, c) inner order as patches
+    w_mat = jnp.transpose(w_i8, (2, 3, 1, 0)).reshape(kh * kw * c, o)
+    w_scale = jnp.asarray(frozen_entry["weight_scale"],
+                          jnp.float32) / 127.0      # per-out-channel (O,)
+    # pad K and N up to the kernel tile grid (zero rows/cols are exact in
+    # integer math) so the Pallas path is actually reachable for conv
+    # shapes like K = kh*kw*C = 576
+    def _pad_to(a, mult, axis):
+        r = (-a.shape[axis]) % mult
+        if r == 0:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, r)
+        return jnp.pad(a, widths)
+
+    kdim = w_mat.shape[0]
+    tile = 128
+    patches_p = _pad_to(_pad_to(patches, tile, 1), tile, 0)
+    w_mat_p = _pad_to(_pad_to(w_mat, tile, 0), tile, 1)
+    w_scale_p = jnp.pad(jnp.broadcast_to(w_scale, (o,)),
+                        (0, w_mat_p.shape[1] - o))
+    out = quant_matmul(patches_p, w_mat_p, a_scale, w_scale_p,
+                       out_dtype=out_dtype, use_pallas=use_pallas,
+                       interpret=interpret)
+    out = out[:patches.shape[0], :o]
+    out = jnp.transpose(out.reshape(b, oh, ow, o), (0, 3, 1, 2))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+class Int8Conv2D(_Layer):
+    """Frozen int8 Conv2D executor (int8 weight buffers; see Int8Linear)."""
+
+    def __init__(self, frozen_entry, bias=None, act=None, stride: int = 1,
+                 padding: int = 0):
+        super().__init__()
+        self.register_buffer("weight_int8",
+                             jnp.asarray(frozen_entry["weight_int8"]))
+        self.register_buffer("weight_scale",
+                             jnp.asarray(frozen_entry["weight_scale"],
+                                         jnp.float32))
+        self.register_buffer("act_scale",
+                             jnp.asarray(frozen_entry["act_scale"],
+                                         jnp.float32))
+        if bias is not None:
+            self.register_buffer("conv_bias", jnp.asarray(bias))
+        self.has_bias = bias is not None
+        self.act = act
+        self.stride, self.padding = stride, padding
+
+    def forward(self, x):
+        entry = {"weight_int8": self.weight_int8,
+                 "weight_scale": self.weight_scale,
+                 "act_scale": self.act_scale}
+        out = int8_conv2d(x, entry,
+                          bias=self.conv_bias if self.has_bias else None,
+                          stride=self.stride, padding=self.padding)
+        from ..nn.layers import _apply_act
+
+        return _apply_act(out, self.act)

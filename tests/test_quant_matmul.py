@@ -94,3 +94,31 @@ def test_int8_swap_whole_model_inference():
     assert bool(jnp.allclose(out, out_jit))
     assert all("weight_int8" not in k for k in q.named_parameters())
     assert any("weight_int8" in k for k in q.named_buffers())
+
+
+def test_int8_conv_swap_cnn_inference():
+    """Conv2D path: QAT CNN -> freeze -> int8_swap runs im2col + int8 GEMM
+    for plain convs and matches the fake-quant float model; grouped convs
+    stay on the float path."""
+    pt.seed(0)
+    model = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1, act="relu"),
+        nn.Conv2D(8, 8, 3, stride=2, padding=1, groups=2),  # grouped: float
+        nn.Conv2D(8, 4, 1),
+    )
+    q = quant.quantize_model(model)
+    rng = np.random.default_rng(4)
+    batches = [jnp.asarray(rng.normal(0, 1, (2, 3, 8, 8)).astype(np.float32))
+               for _ in range(3)]
+    quant.calibrate(q, batches)
+    frozen = quant.freeze(q)
+    x = batches[0]
+    ref, _ = q.functional_call(q.named_parameters(), x, training=False)
+    n = quant.int8_swap(q, frozen)
+    assert n == 2  # the grouped conv is skipped
+    q.eval()
+    out = q(x)
+    rel = float(jnp.abs(out - ref).max() /
+                jnp.maximum(jnp.abs(ref).max(), 1e-6))
+    assert rel < 0.1, rel
+    assert bool(jnp.allclose(out, jax.jit(lambda xx: q(xx))(x)))
