@@ -4,8 +4,24 @@ family + fluid/reader.py PyReader)."""
 from __future__ import annotations
 
 from ..layers import _PyReader as PyReader  # async device feed pipeline
-from ..static.io import (load_inference_model, load_persistables,
-                         save_inference_model, save_persistables)
+from ..static.io import load_inference_model as _load_inference_model
+from ..static.io import (load_persistables, save_inference_model,
+                         save_persistables)
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None, pserver_endpoints=None):
+    """fluid signature (reference io.py:1074). The artifact here is
+    self-contained: the executor is accepted and unused; per-file names
+    don't apply (single manifest-v2 directory) and raise if customized so
+    a port doesn't silently load the wrong thing. Returns the predictor."""
+    from ..core.enforce import enforce
+
+    enforce(model_filename is None and params_filename is None,
+            "the serving artifact is a single manifest directory; "
+            "model_filename/params_filename do not apply (got %s/%s)",
+            model_filename, params_filename)
+    return _load_inference_model(dirname)
 
 # vars/params granularities collapse onto the same artifact writer: the
 # persistable set IS the param set plus optimizer state in this design

@@ -6,6 +6,7 @@ MXU and dequantizes in the kernel epilogue."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.enforce import enforce
@@ -14,8 +15,10 @@ from ..ops.pallas.quant_matmul import quant_matmul
 
 
 def _as_int8_weight(w):
-    enforce(jnp.issubdtype(w.dtype, jnp.integer),
-            "frozen weight must be integer, got %s", w.dtype)
+    # int16 (weight_bits=16) values would wrap mod 256 — reject loudly
+    enforce(w.dtype in (jnp.int8, jnp.int32),
+            "int8 execution needs 8-bit frozen weights, got %s "
+            "(weight_bits != 8?)", w.dtype)
     return w.astype(jnp.int8)
 
 
@@ -83,17 +86,19 @@ class Int8Linear(_Layer):
 
 
 def int8_swap(model, frozen):
-    """Swap every frozen QuantedLayer-wrapped Linear for an Int8Linear so
-    plain ``model(x)`` inference runs the int8 kernel path (the
-    QuantizationFreezePass → int8 runtime handoff). Conv layers keep the
-    fake-quant float path (int8 conv lowering is a further step). Returns
-    the number of layers swapped."""
+    """Swap every frozen QuantedLayer-wrapped Linear and plain NCHW
+    Conv2D for Int8Linear/Int8Conv2D so ``model(x)`` inference runs the
+    int8 kernel path (the QuantizationFreezePass → int8 runtime handoff).
+    Grouped/dilated/NHWC convs and non-8-bit freezes keep the fake-quant
+    float path. Returns the number of layers swapped."""
     from .qat import QuantedLayer
 
     swapped = 0
     for path, sub in list(model.named_sublayers()):
         if not isinstance(sub, QuantedLayer) or path not in frozen:
             continue
+        if frozen[path].get("bits", 8) != 8:
+            continue  # int8 runtime only; 16-bit freezes stay float
         inner = sub.inner
         tname = type(inner).__name__
         if tname == "Linear":
@@ -159,27 +164,32 @@ def int8_conv2d(x, frozen_entry, bias=None, *, stride: int = 1,
     w_mat = jnp.transpose(w_i8, (2, 3, 1, 0)).reshape(kh * kw * c, o)
     w_scale = jnp.asarray(frozen_entry["weight_scale"],
                           jnp.float32) / 127.0      # per-out-channel (O,)
-    # pad K and N up to the kernel tile grid (zero rows/cols are exact in
-    # integer math) so the Pallas path is actually reachable for conv
-    # shapes like K = kh*kw*C = 576
-    def _pad_to(a, mult, axis):
-        r = (-a.shape[axis]) % mult
-        if r == 0:
-            return a
-        widths = [(0, 0)] * a.ndim
-        widths[axis] = (0, r)
-        return jnp.pad(a, widths)
+    kernel_path = (interpret or use_pallas
+                   or (use_pallas is None and jax.default_backend() == "tpu"))
+    if kernel_path:
+        # pad the GEMM dims to the kernel tile grid (zero rows/cols are
+        # exact in integer math) so the Pallas path is reachable for conv
+        # shapes like K = kh*kw*C = 576; the XLA fallback stays unpadded
+        def _pad_to(a, mult, axis):
+            r = (-a.shape[axis]) % mult
+            if r == 0:
+                return a
+            widths = [(0, 0)] * a.ndim
+            widths[axis] = (0, r)
+            return jnp.pad(a, widths)
 
-    kdim = w_mat.shape[0]
-    tile = 128
-    patches_p = _pad_to(_pad_to(patches, tile, 1), tile, 0)
-    w_mat_p = _pad_to(_pad_to(w_mat, tile, 0), tile, 1)
-    w_scale_p = jnp.pad(jnp.broadcast_to(w_scale, (o,)),
-                        (0, w_mat_p.shape[1] - o))
-    out = quant_matmul(patches_p, w_mat_p, a_scale, w_scale_p,
-                       out_dtype=out_dtype, use_pallas=use_pallas,
-                       interpret=interpret)
-    out = out[:patches.shape[0], :o]
+        tile = 128
+        patches_p = _pad_to(_pad_to(patches, tile, 1), tile, 0)
+        w_mat_p = _pad_to(_pad_to(w_mat, tile, 0), tile, 1)
+        w_scale_p = jnp.pad(jnp.broadcast_to(w_scale, (o,)),
+                            (0, w_mat_p.shape[1] - o))
+        out = quant_matmul(patches_p, w_mat_p, a_scale, w_scale_p,
+                           out_dtype=out_dtype, use_pallas=True,
+                           interpret=interpret)
+        out = out[:patches.shape[0], :o]
+    else:
+        out = quant_matmul(patches, w_mat, a_scale, w_scale,
+                           out_dtype=out_dtype, use_pallas=False)
     out = jnp.transpose(out.reshape(b, oh, ow, o), (0, 3, 1, 2))
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)

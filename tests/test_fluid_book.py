@@ -1,0 +1,78 @@
+"""Book-style end-to-end tests written the way a REFERENCE user writes
+them — pure ``fluid`` idioms against ``paddle_tpu.fluid`` (reference:
+tests/book/test_fit_a_line.py:27, test_recognize_digits.py): build a
+Program under program_guard with fluid.layers, minimize with a
+fluid.optimizer class, drive with fluid.Executor over paddle.dataset
+readers, save/load the inference artifact via fluid.io."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+from paddle_tpu.data import batch as batch_reader
+
+
+def test_fit_a_line_fluid_style(tmp_path):
+    # --- build (reference: tests/book/test_fit_a_line.py train()) -------
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = prog.data("x", (-1, 13))
+        y = prog.data("y", (-1, 1))
+        y_predict = fluid.layers.fc(x, 1, name="pred")
+        cost = fluid.layers.square_error_cost(y_predict, y)
+        avg_cost = fluid.layers.mean(cost)
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=0.01)
+    opt.minimize(avg_cost)
+
+    # --- train over the uci_housing reader ------------------------------
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        first = last = None
+        for epoch in range(12):
+            for b in batch_reader(dataset.uci_housing.train(), 64)():
+                xs = np.stack([s[0] for s in b]).astype(np.float32)
+                ys = np.stack([s[1] for s in b]).astype(np.float32)
+                out = exe.run(prog, feed={"x": xs, "y": ys},
+                              fetch_list=[avg_cost])
+                if first is None:
+                    first = float(out[0])
+        last = float(out[0])
+        assert last < first * 0.5, (first, last)
+
+        # --- save + reload the inference model via fluid.io -------------
+        path = str(tmp_path / "fit_a_line")
+        fluid.io.save_inference_model(path, ["x"], [y_predict], exe,
+                                      main_program=prog)
+    predictor = fluid.io.load_inference_model(path, exe)
+    test_x = np.stack([s[0] for s in
+                       list(dataset.uci_housing.test()())[:8]])
+    pred = predictor.run({"x": test_x.astype(np.float32)})
+    out_arr = pred[0] if isinstance(pred, (list, tuple)) else pred
+    assert np.asarray(out_arr).shape[0] == 8
+
+
+def test_recognize_digits_fluid_style():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        img = prog.data("img", (-1, 784))
+        label = prog.data("label", (-1,))
+        h = fluid.layers.fc(img, 64, act="relu")
+        logits = fluid.layers.fc(h, 10, name="head")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(logits, label)
+    fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        accs = []
+        for epoch in range(3):
+            for b in batch_reader(
+                    dataset.mnist.train(synthetic_size=256), 64)():
+                xs = np.stack([s[0] for s in b]).astype(np.float32)
+                ys = np.asarray([s[1] for s in b])
+                out = exe.run(prog, feed={"img": xs, "label": ys},
+                              fetch_list=[loss, acc])
+            accs.append(float(out[1]))
+        assert accs[-1] > 0.9, accs  # synthetic digits are learnable
