@@ -25,10 +25,13 @@ import numpy as np
 
 
 def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
-                    steps_per_call: int = 8):
+                    steps_per_call: int = 8, dp: int = 1):
     """BASELINE config 1. ``steps_per_call`` fuses K optimizer steps into
     one dispatch (Trainer.train_steps lax.scan) — through the remote-device
-    tunnel the per-dispatch round trip dominates a step this small."""
+    tunnel the per-dispatch round trip dominates a step this small.
+    ``dp``: data-parallel device count (fluid_benchmark's --gpus analog);
+    the batch shards over the dp mesh axis and XLA inserts the gradient
+    all-reduce."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -36,14 +39,19 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
     from paddle_tpu.models import mnist as M
 
     pt.seed(0)
-    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+    assert batch_size >= dp > 0, f"batch {batch_size} must be >= dp {dp}"
+    mesh = pt.build_mesh(dp=dp, devices=jax.devices()[:dp])
     model = M.MnistMLP(hidden1=512, hidden2=256)
     trainer = parallel.Trainer.supervised(
         model, optimizer.Adam(1e-3), M.loss_fn, mesh=mesh)
     rng = np.random.default_rng(0)
+    batch_size -= batch_size % max(dp, 1)
     x = jnp.asarray(rng.normal(size=(batch_size, 784)).astype(np.float32))
     label = jnp.asarray(rng.integers(0, 10, batch_size))
     batch = {"x": x, "label": label}
+    if dp > 1:
+        sh = trainer.data_sharding()
+        batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
     k = max(1, steps_per_call)
     outer = max(1, steps // k)
     for _ in range(warmup):
@@ -426,6 +434,9 @@ def main():
     ap.add_argument("--amp", default="mixed_bf16",
                     help="dtype policy for the step (mixed_bf16 is the TPU "
                     "training default; pass float32 to disable)")
+    ap.add_argument("--dp", type=int, default=1,
+                    help="data-parallel device count (--gpus analog; on "
+                    "--platform cpu this creates virtual host devices)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — needed because "
                     "this environment's sitecustomize overrides JAX_PLATFORMS")
@@ -435,6 +446,8 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+        if args.dp > 1 and args.platform == "cpu":
+            jax.config.update("jax_num_cpu_devices", args.dp)
 
     steps = args.steps or (10 if args.smoke else 100)
     batch = args.batch_size or (256 if args.smoke else 8192)
@@ -477,6 +490,11 @@ def main():
         kwargs["layout"] = args.layout
     if "fused_ce" in sig:
         kwargs["fused_ce"] = args.fused_ce
+    if args.dp > 1:
+        if "dp" not in sig:
+            raise SystemExit(f"--dp is not supported by model "
+                             f"{args.model} (single-device bench)")
+        kwargs["dp"] = args.dp
     value, unit = fn(steps, batch, **kwargs)
 
     metric = f"{args.model}_throughput"

@@ -21,6 +21,9 @@ def load_inference_model(dirname, executor=None, model_filename=None,
             "the serving artifact is a single manifest directory; "
             "model_filename/params_filename do not apply (got %s/%s)",
             model_filename, params_filename)
+    enforce(pserver_endpoints is None,
+            "no pserver serving role exists (PARITY.md §2.5); distributed "
+            "serving shards via mesh, got endpoints %s", pserver_endpoints)
     return _load_inference_model(dirname)
 
 # vars/params granularities collapse onto the same artifact writer: the

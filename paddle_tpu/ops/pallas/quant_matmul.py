@@ -105,18 +105,28 @@ def quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype=jnp.float32,
             "quant_matmul takes int8 operands, got %s/%s", a_i8.dtype,
             b_i8.dtype)
     if use_pallas is None:
-        use_pallas = (jax.default_backend() == "tpu"
-                      and m % tile_m == 0 and n % tile_n == 0
-                      and ka % tile_k == 0)
+        use_pallas = jax.default_backend() == "tpu"
     if use_pallas or interpret:
+        # pad every GEMM dim to its tile (zero rows/cols are exact in
+        # integer math), run the kernel, slice back — callers never manage
+        # the tiling contract themselves
+        def _pad_to(arr, mult, axis):
+            r = (-arr.shape[axis]) % mult
+            if r == 0:
+                return arr
+            widths = [(0, 0)] * arr.ndim
+            widths[axis] = (0, r)
+            return jnp.pad(arr, widths)
+
         tm, tn, tk = min(tile_m, m), min(tile_n, n), min(tile_k, ka)
-        enforce(m % tm == 0 and n % tn == 0 and ka % tk == 0,
-                "quant_matmul kernel needs tile-divisible shapes, got "
-                "(%s, %s, %s) with tiles (%s, %s, %s) — pad upstream",
-                m, ka, n, tm, tk, tn)
-        return _pallas_quant_matmul(
-            a_i8, b_i8, a_scale, b_scale, out_dtype=out_dtype,
+        a_p = _pad_to(_pad_to(a_i8, tm, 0), tk, 1)
+        b_p = _pad_to(_pad_to(b_i8, tk, 0), tn, 1)
+        bs_p = _pad_to(jnp.broadcast_to(
+            jnp.asarray(b_scale, jnp.float32), (n,)), tn, 0)
+        out = _pallas_quant_matmul(
+            a_p, b_p, a_scale, bs_p, out_dtype=out_dtype,
             tile_m=tm, tile_n=tn, tile_k=tk, interpret=interpret)
+        return out[:m, :n]
     acc = jax.lax.dot_general(a_i8, b_i8, (((1,), (0,)), ((), ())),
                               preferred_element_type=jnp.int32)
     scale = jnp.asarray(a_scale, jnp.float32) * \

@@ -15,11 +15,12 @@ from ..ops.pallas.quant_matmul import quant_matmul
 
 
 def _as_int8_weight(w):
-    # int16 (weight_bits=16) values would wrap mod 256 — reject loudly
-    enforce(w.dtype in (jnp.int8, jnp.int32),
-            "int8 execution needs 8-bit frozen weights, got %s "
+    # any wider integer could hold values that wrap mod 256 — reject loudly
+    # (quant.freeze with weight_bits=8 emits int8 directly)
+    enforce(w.dtype == jnp.int8,
+            "int8 execution needs int8 frozen weights, got %s "
             "(weight_bits != 8?)", w.dtype)
-    return w.astype(jnp.int8)
+    return w
 
 
 def _quantize_acts(x, act_scale):
@@ -164,32 +165,9 @@ def int8_conv2d(x, frozen_entry, bias=None, *, stride: int = 1,
     w_mat = jnp.transpose(w_i8, (2, 3, 1, 0)).reshape(kh * kw * c, o)
     w_scale = jnp.asarray(frozen_entry["weight_scale"],
                           jnp.float32) / 127.0      # per-out-channel (O,)
-    kernel_path = (interpret or use_pallas
-                   or (use_pallas is None and jax.default_backend() == "tpu"))
-    if kernel_path:
-        # pad the GEMM dims to the kernel tile grid (zero rows/cols are
-        # exact in integer math) so the Pallas path is reachable for conv
-        # shapes like K = kh*kw*C = 576; the XLA fallback stays unpadded
-        def _pad_to(a, mult, axis):
-            r = (-a.shape[axis]) % mult
-            if r == 0:
-                return a
-            widths = [(0, 0)] * a.ndim
-            widths[axis] = (0, r)
-            return jnp.pad(a, widths)
-
-        tile = 128
-        patches_p = _pad_to(_pad_to(patches, tile, 1), tile, 0)
-        w_mat_p = _pad_to(_pad_to(w_mat, tile, 0), tile, 1)
-        w_scale_p = jnp.pad(jnp.broadcast_to(w_scale, (o,)),
-                            (0, w_mat_p.shape[1] - o))
-        out = quant_matmul(patches_p, w_mat_p, a_scale, w_scale_p,
-                           out_dtype=out_dtype, use_pallas=True,
-                           interpret=interpret)
-        out = out[:patches.shape[0], :o]
-    else:
-        out = quant_matmul(patches, w_mat, a_scale, w_scale,
-                           out_dtype=out_dtype, use_pallas=False)
+    out = quant_matmul(patches, w_mat, a_scale, w_scale,
+                       out_dtype=out_dtype, use_pallas=use_pallas,
+                       interpret=interpret)  # kernel pads internally
     out = jnp.transpose(out.reshape(b, oh, ow, o), (0, 3, 1, 2))
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
