@@ -25,7 +25,7 @@ import numpy as np
 
 
 def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
-                    steps_per_call: int = 8, dp: int = 1):
+                    steps_per_call: int = 8, dp: int = 1, amp=None):
     """BASELINE config 1. ``steps_per_call`` fuses K optimizer steps into
     one dispatch (Trainer.train_steps lax.scan) — through the remote-device
     tunnel the per-dispatch round trip dominates a step this small.
@@ -43,7 +43,7 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
     mesh = pt.build_mesh(dp=dp, devices=jax.devices()[:dp])
     model = M.MnistMLP(hidden1=512, hidden2=256)
     trainer = parallel.Trainer.supervised(
-        model, optimizer.Adam(1e-3), M.loss_fn, mesh=mesh)
+        model, optimizer.Adam(1e-3), M.loss_fn, mesh=mesh, amp=amp)
     rng = np.random.default_rng(0)
     batch_size -= batch_size % max(dp, 1)
     x = jnp.asarray(rng.normal(size=(batch_size, 784)).astype(np.float32))
@@ -492,8 +492,13 @@ def main():
         kwargs["fused_ce"] = args.fused_ce
     if args.dp > 1:
         if "dp" not in sig:
-            raise SystemExit(f"--dp is not supported by model "
-                             f"{args.model} (single-device bench)")
+            # keep the one-JSON-line driver contract even on misuse
+            print(json.dumps({
+                "metric": f"{args.model}_throughput", "value": 0.0,
+                "unit": "examples/sec", "vs_baseline": 0.0,
+                "error": f"--dp is not supported by model {args.model} "
+                "(single-device bench)"}))
+            return
         kwargs["dp"] = args.dp
     value, unit = fn(steps, batch, **kwargs)
 
@@ -509,7 +514,11 @@ def main():
             history = {}
     prev = history.get(metric)
     vs_baseline = (value / prev) if prev else 1.0
-    if not args.smoke:
+    import jax
+
+    on_accelerator = jax.devices()[0].platform != "cpu"
+    if not args.smoke and on_accelerator:
+        # CPU debug runs never pollute the recorded trajectory
         history[metric] = max(value, prev or 0.0)
         with open(hist_path, "w") as f:
             json.dump(history, f, indent=1)

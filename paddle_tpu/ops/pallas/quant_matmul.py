@@ -95,8 +95,8 @@ def quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype=jnp.float32,
 
     a_i8 (M, K) int8 with scalar ``a_scale``; b_i8 (K, N) int8 with scalar
     or per-channel (N,) ``b_scale``. Returns (M, N) ``out_dtype``.
-    Shapes must tile; the framework-level caller pads (same contract as
-    flash attention).
+    Any shapes: when the kernel path runs, operands pad internally to the
+    tile grid (exact in integer math) and the result slices back.
     """
     m, ka = a_i8.shape
     kb, n = b_i8.shape
