@@ -416,6 +416,14 @@ MODELS = {
 }
 
 
+def _emit_error(metric: str, msg: str) -> None:
+    """One-JSON-line driver contract, error form (shared by the device
+    watchdog and argument-misuse paths)."""
+    print(json.dumps({"metric": metric, "value": 0.0,
+                      "unit": "examples/sec", "vs_baseline": 0.0,
+                      "error": msg}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="mnist_mlp", choices=sorted(MODELS))
@@ -470,10 +478,8 @@ def main():
     probe.join(timeout=float(os.environ.get("PT_BENCH_DEVICE_TIMEOUT_S",
                                             "420")))
     if not init_ok.is_set():
-        print(json.dumps({
-            "metric": f"{args.model}_throughput", "value": 0.0,
-            "unit": "examples/sec", "vs_baseline": 0.0,
-            "error": "device init timeout (accelerator unreachable)"}))
+        _emit_error(f"{args.model}_throughput",
+                    "device init timeout (accelerator unreachable)")
         return
     import inspect
 
@@ -492,12 +498,9 @@ def main():
         kwargs["fused_ce"] = args.fused_ce
     if args.dp > 1:
         if "dp" not in sig:
-            # keep the one-JSON-line driver contract even on misuse
-            print(json.dumps({
-                "metric": f"{args.model}_throughput", "value": 0.0,
-                "unit": "examples/sec", "vs_baseline": 0.0,
-                "error": f"--dp is not supported by model {args.model} "
-                "(single-device bench)"}))
+            _emit_error(f"{args.model}_throughput",
+                        f"--dp is not supported by model {args.model} "
+                        "(single-device bench)")
             return
         kwargs["dp"] = args.dp
     value, unit = fn(steps, batch, **kwargs)

@@ -106,7 +106,7 @@ def quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype=jnp.float32,
             b_i8.dtype)
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    if use_pallas or interpret:
+    if (use_pallas or interpret) and min(m, n, ka) > 0:
         # pad every GEMM dim to its tile (zero rows/cols are exact in
         # integer math), run the kernel, slice back — callers never manage
         # the tiling contract themselves

@@ -122,3 +122,25 @@ def test_int8_conv_swap_cnn_inference():
                 jnp.maximum(jnp.abs(ref).max(), 1e-6))
     assert rel < 0.1, rel
     assert bool(jnp.allclose(out, jax.jit(lambda xx: q(xx))(x)))
+
+
+def test_int8_swapped_model_exports_to_serving_artifact(tmp_path):
+    """Full int8 serving loop: QAT -> freeze -> int8_swap -> jit.save ->
+    reload through the inference artifact, bit-exact vs the live model
+    (the int8 weights bake into the StableHLO program)."""
+    from paddle_tpu import jit
+    from paddle_tpu.static.io import load_inference_model
+
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16, act="relu"), nn.Linear(16, 4))
+    q = quant.quantize_model(model)
+    quant.calibrate(q, [jnp.ones((2, 8))])
+    quant.int8_swap(q, quant.freeze(q))
+    q.eval()
+    x = jnp.asarray(np.random.default_rng(5)
+                    .normal(size=(2, 8)).astype(np.float32))
+    ref = q(x)
+    d = str(tmp_path / "int8_artifact")
+    jit.save(q, d, [x], input_names=["x"])
+    out = load_inference_model(d).run({"x": np.asarray(x)})
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref))
