@@ -484,9 +484,7 @@ def main():
     import inspect
 
     fn = MODELS[args.model]
-    import inspect as _inspect
-
-    sig = _inspect.signature(fn).parameters
+    sig = inspect.signature(fn).parameters
     kwargs = {}
     if "smoke" in sig:
         kwargs["smoke"] = args.smoke

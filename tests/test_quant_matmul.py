@@ -144,3 +144,16 @@ def test_int8_swapped_model_exports_to_serving_artifact(tmp_path):
     jit.save(q, d, [x], input_names=["x"])
     out = load_inference_model(d).run({"x": np.asarray(x)})
     np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref))
+
+
+def test_zero_sized_dims_route_to_xla_path():
+    """Empty operands (m/k/n = 0) must not reach the tiled kernel (a zero
+    tile would divide by zero); both paths agree on the empty result."""
+    for shape_a, shape_b in (((0, 4), (4, 4)), ((4, 0), (0, 4)),
+                             ((4, 4), (4, 0))):
+        a = jnp.zeros(shape_a, jnp.int8)
+        b = jnp.zeros(shape_b, jnp.int8)
+        out = quant_matmul(a, b, 1.0, 1.0, interpret=True)
+        ref = quant_matmul(a, b, 1.0, 1.0, use_pallas=False)
+        assert out.shape == ref.shape == (shape_a[0], shape_b[1])
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
