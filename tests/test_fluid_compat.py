@@ -214,10 +214,7 @@ def test_layers_polymorphic_static_dispatch_breadth():
     """A spread of paddle_tpu.layers functions called on static Vars must
     record onto the Program via the generic dispatcher and execute
     correctly (same functions work eager — checked side by side)."""
-    import jax.numpy as jnp
-
     from paddle_tpu import layers as L
-    from paddle_tpu import static
 
     prog = fluid.Program()
     with fluid.program_guard(prog):
@@ -226,7 +223,7 @@ def test_layers_polymorphic_static_dispatch_breadth():
         r2 = L.elementwise_add(r1, x)
         r3 = L.reduce_mean(r2)
         r4 = L.concat([r1, r2], axis=1)
-        r5 = L.reshape(r4, (4, 12))
+        r5 = L.reshape(r4, (2, 24))
         r6 = L.l2_normalize(r5)
         r7 = L.reduce_sum(r6)
         cmp = L.less_than(r3, r7)
@@ -235,12 +232,13 @@ def test_layers_polymorphic_static_dispatch_breadth():
         xv = np.arange(24, dtype=np.float32).reshape(4, 6) - 12.0
         out = exe.run(prog, feed={"x": xv},
                       fetch_list=[r3, r5, r7, cmp])
+    assert out[1].shape == (2, 24)
     # eager reference through the SAME namespace functions
     xe = jnp.asarray(xv)
     e1 = L.relu(xe)
     e2 = L.elementwise_add(e1, xe)
     e3 = L.reduce_mean(e2)
-    e5 = L.reshape(L.concat([e1, e2], axis=1), (4, 12))
+    e5 = L.reshape(L.concat([e1, e2], axis=1), (2, 24))
     e6 = L.l2_normalize(e5)
     e7 = L.reduce_sum(e6)
     np.testing.assert_allclose(out[0], np.asarray(e3), rtol=1e-6)
