@@ -126,3 +126,51 @@ class TestOpFrequence:
         assert stats.get("fc", 0) == 2
         assert stats.get("backward", 0) == 1
         assert sum(stats.values()) == len(prog.nodes)
+
+
+class TestOpBench:
+    def test_hot_op_cases_file_runs(self, tmp_path):
+        """The shipped hot-op case set (tools/op_bench_cases.json) stays
+        loadable and each case executes — including the typed int specs
+        for labels and int8 operands."""
+        import json
+        import subprocess
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # a reduced inline config keeps the test fast while covering the
+        # same materialize paths (float list, typed int dict, scalar)
+        cases = [
+            {"op": "ops.math.matmul", "args": {"x": [8, 8], "y": [8, 8]},
+             "grad": True},
+            {"op": "ops.fused_loss.mean_linear_cross_entropy",
+             "args": {"hidden": [16, 8], "weight": [8, 50], "bias": [50],
+                      "labels": {"shape": [16], "dtype": "int32",
+                                 "low": 0, "high": 50}},
+             "kwargs": {"chunk": 16}, "grad": True},
+            {"op": "ops.pallas.quant_matmul",
+             "args": {"a_i8": {"shape": [8, 8], "dtype": "int8",
+                               "low": -127, "high": 127},
+                      "b_i8": {"shape": [8, 8], "dtype": "int8",
+                               "low": -127, "high": 127},
+                      "a_scale": 0.01, "b_scale": 0.02}},
+        ]
+        cfg = str(tmp_path / "cases.json")
+        with open(cfg, "w") as f:
+            json.dump(cases, f)
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "op_bench.py"),
+             "--config", cfg, "--repeat", "1", "--platform", "cpu"],
+            capture_output=True, text=True, timeout=500)
+        lines = [json.loads(l) for l in r.stdout.splitlines()
+                 if l.startswith("{")]
+        assert len(lines) == 3, r.stdout + r.stderr
+        assert all("forward_ms" in l for l in lines)
+        assert sum("grad_ms" in l for l in lines) == 2
+        # the shipped file parses and names resolvable ops
+        with open(os.path.join(root, "tools", "op_bench_cases.json")) as f:
+            shipped = json.load(f)
+        from tools.op_bench import resolve
+
+        for case in shipped:
+            assert callable(resolve(case["op"]))

@@ -16,6 +16,7 @@ tunnel, ``block_until_ready`` alone does not serialize.
 
 Usage:
   python tools/op_bench.py --config cases.json
+  python tools/op_bench.py --config tools/op_bench_cases.json   # hot-op set
   python tools/op_bench.py --op ops.math.matmul --shapes 1024x1024,1024x1024
 """
 
@@ -48,6 +49,20 @@ def materialize(args_spec, dtype, rng):
         if isinstance(spec, list):
             out[name] = jnp.asarray(
                 rng.normal(size=tuple(spec)).astype(dtype))
+        elif isinstance(spec, dict) and "shape" in spec:
+            # typed spec: {"shape": [...], "dtype": "int32",
+            #              "low": 0, "high": 100} — integer operands
+            # (labels, int8 tensors) for ops the float default can't feed
+            sdt = spec.get("dtype", dtype)
+            shape = tuple(spec["shape"])
+            if "int" in sdt:
+                lo = spec.get("low", 0)
+                hi = spec.get("high", 100)
+                out[name] = jnp.asarray(
+                    rng.integers(lo, hi, shape).astype(sdt))
+            else:
+                out[name] = jnp.asarray(
+                    rng.normal(size=shape).astype(sdt))
         else:
             out[name] = spec
     return out
