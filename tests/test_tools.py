@@ -133,11 +133,7 @@ class TestOpBench:
         """The shipped hot-op case set (tools/op_bench_cases.json) stays
         loadable and each case executes — including the typed int specs
         for labels and int8 operands."""
-        import json
-        import subprocess
-        import sys
-
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        root = REPO
         # a reduced inline config keeps the test fast while covering the
         # same materialize paths (float list, typed int dict, scalar)
         cases = [
