@@ -111,12 +111,15 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
         params, state = opt.apply(params, g, state)
         return params, new_buf, state, l
 
+    from paddle_tpu.core.profiler import RecordEvent
+
     for _ in range(warmup):
         params, buffers, state, l = step(params, buffers, state, batch)
     float(l)  # host fetch = the only reliable fence on this backend
     t0 = time.perf_counter()
     for i in range(steps):
-        params, buffers, state, l = step(params, buffers, state, batch)
+        with RecordEvent("train_step"):  # --profile span per dispatch
+            params, buffers, state, l = step(params, buffers, state, batch)
         # fence every few steps: a loss fetch serializes the whole update
         # chain (honest timing) while keeping the dispatch queue shallow;
         # block_until_ready alone does NOT block through the async tunnel
@@ -442,6 +445,10 @@ def main():
     ap.add_argument("--amp", default="mixed_bf16",
                     help="dtype policy for the step (mixed_bf16 is the TPU "
                     "training default; pass float32 to disable)")
+    ap.add_argument("--profile", default=None, metavar="TRACE_JSON",
+                    help="wrap the timed run in the profiler and write a "
+                    "chrome-trace JSON here (fluid_benchmark --profile "
+                    "analog)")
     ap.add_argument("--dp", type=int, default=1,
                     help="data-parallel device count (--gpus analog; on "
                     "--platform cpu this creates virtual host devices)")
@@ -501,7 +508,25 @@ def main():
                         "(single-device bench)")
             return
         kwargs["dp"] = args.dp
-    value, unit = fn(steps, batch, **kwargs)
+    import contextlib
+
+    if args.profile:
+        # fail on an unwritable path BEFORE the (possibly long) run,
+        # keeping the one-JSON-line contract
+        try:
+            with open(args.profile, "w"):
+                pass
+        except OSError as e:
+            _emit_error(f"{args.model}_throughput",
+                        f"unwritable --profile path: {e}")
+            return
+        from paddle_tpu.core.profiler import profiler as _prof
+
+        ctx = _prof(timeline_path=args.profile)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        value, unit = fn(steps, batch, **kwargs)
 
     metric = f"{args.model}_throughput"
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
