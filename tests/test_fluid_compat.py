@@ -264,3 +264,23 @@ def test_layers_param_creating_static_routes_to_static_layers():
         out = exe.run(prog, feed={"ids": np.array([1, 2, 3, 4])},
                       fetch_list=[h])
     assert out[0].shape == (4, 5)
+
+
+def test_compiled_program_and_parallel_executor_shims():
+    """CompiledProgram.with_data_parallel and the ParallelExecutor front
+    execute a Program (redesigned over pjit — PARITY §2.1)."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = prog.data("x", (4, 3))
+        y = prog.apply(lambda v: v * 2.0 + 1.0, [x], name="y")
+    cp = fluid.CompiledProgram(prog).with_data_parallel(loss_name="y")
+    assert cp.data_parallel and cp.program is prog
+    cp2 = fluid.CompiledProgram(prog).with_inference_optimize()
+    assert getattr(cp2, "for_inference", False)
+
+    pe = fluid.ParallelExecutor(main_program=prog)
+    with fluid.scope_guard(fluid.Scope()):
+        out = pe.run(fetch_list=[y],
+                     feed={"x": np.ones((4, 3), np.float32)})
+    np.testing.assert_allclose(out[0], 3.0 * np.ones((4, 3)), rtol=1e-6)
+    assert pe.drop_local_exe_scopes() is None
