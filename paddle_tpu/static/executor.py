@@ -176,6 +176,9 @@ class Executor:
         from .program import default_main_program
 
         program = program or default_main_program()
+        # accept a fluid.CompiledProgram front (canonical pattern:
+        # exe.run(CompiledProgram(prog).with_data_parallel(...), ...))
+        program = getattr(program, "program", program)
         feed = dict(feed or {})
         fetch_names = tuple(
             f.name if isinstance(f, Var) else f for f in (fetch_list or []))

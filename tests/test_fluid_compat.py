@@ -278,6 +278,13 @@ def test_compiled_program_and_parallel_executor_shims():
     cp2 = fluid.CompiledProgram(prog).with_inference_optimize()
     assert getattr(cp2, "for_inference", False)
 
+    # the canonical fluid pattern: exe.run(compiled_program, ...)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        out = exe.run(cp, feed={"x": np.ones((4, 3), np.float32)},
+                      fetch_list=[y])
+    np.testing.assert_allclose(out[0], 3.0 * np.ones((4, 3)), rtol=1e-6)
+
     pe = fluid.ParallelExecutor(main_program=prog)
     with fluid.scope_guard(fluid.Scope()):
         out = pe.run(fetch_list=[y],
