@@ -69,8 +69,11 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
     return outer * k * batch_size / dt, "examples/sec"
 
 
+_STEPS_PER_CALL = None  # CLI override consumed by _train_bench
+
+
 def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
-                 lr=1e-3, amp=None, method="forward"):
+                 lr=1e-3, amp=None, method="forward", steps_per_call=None):
     """Shared harness: jitted value_and_grad+Adam step, timed post-warmup.
 
     Timing blocks on the FULL output state, not just the loss scalar — the
@@ -79,12 +82,15 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
 
     ``amp``: dtype policy name (e.g. "mixed_bf16") applied at trace time;
     params/opt state stay fp32 masters. Buffers donate so param/opt updates
-    are in-place in HBM.
+    are in-place in HBM. ``steps_per_call`` fuses K update steps into one
+    dispatch via lax.scan (identical math — the Trainer.train_steps
+    pattern), amortizing the per-dispatch tunnel round trip.
     """
     import contextlib
 
     import jax
     import jax.numpy as jnp
+    from jax import lax
     import paddle_tpu as pt
     from paddle_tpu.core.dtypes import policy_scope
 
@@ -95,9 +101,9 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     opt = optimizer.Adam(lr)
     state = opt.init(params)
     batch = make_batch(batch_size)
+    k = max(1, steps_per_call or _STEPS_PER_CALL or 1)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def step(params, buffers, state, batch):
+    def one_step(params, buffers, state, batch):
         scope = policy_scope(amp) if amp else contextlib.nullcontext()
 
         def loss(p):
@@ -111,14 +117,29 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
         params, state = opt.apply(params, g, state)
         return params, new_buf, state, l
 
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, buffers, state, batch):
+        if k == 1:
+            return one_step(params, buffers, state, batch)
+
+        def body(carry, _):
+            p, b, st = carry
+            p, b, st, l = one_step(p, b, st, batch)
+            return (p, b, st), l
+
+        (params, buffers, state), ls = lax.scan(
+            body, (params, buffers, state), None, length=k)
+        return params, buffers, state, ls[-1]
+
     from paddle_tpu.core.profiler import RecordEvent
 
+    outer = max(1, steps // k)
     for _ in range(warmup):
         params, buffers, state, l = step(params, buffers, state, batch)
     float(l)  # host fetch = the only reliable fence on this backend
     t0 = time.perf_counter()
-    for i in range(steps):
-        with RecordEvent("train_step"):  # --profile span per dispatch
+    for i in range(outer):
+        with RecordEvent(f"train_step[{k}]"):  # --profile span per dispatch
             params, buffers, state, l = step(params, buffers, state, batch)
         # fence every few steps: a loss fetch serializes the whole update
         # chain (honest timing) while keeping the dispatch queue shallow;
@@ -127,7 +148,7 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
             float(l)
     float(l)
     dt = time.perf_counter() - t0
-    return steps * batch_size / dt, "examples/sec"
+    return outer * k * batch_size / dt, "examples/sec"
 
 
 def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
@@ -445,6 +466,11 @@ def main():
     ap.add_argument("--amp", default="mixed_bf16",
                     help="dtype policy for the step (mixed_bf16 is the TPU "
                     "training default; pass float32 to disable)")
+    ap.add_argument("--steps-per-call", dest="steps_per_call", type=int,
+                    default=None,
+                    help="fuse K update steps per dispatch (lax.scan; "
+                    "identical math). Default: model-specific (mnist 8, "
+                    "others 1)")
     ap.add_argument("--profile", default=None, metavar="TRACE_JSON",
                     help="wrap the timed run in the profiler and write a "
                     "chrome-trace JSON here (fluid_benchmark --profile "
@@ -501,6 +527,12 @@ def main():
         kwargs["layout"] = args.layout
     if "fused_ce" in sig:
         kwargs["fused_ce"] = args.fused_ce
+    if args.steps_per_call:
+        if "steps_per_call" in sig:
+            kwargs["steps_per_call"] = args.steps_per_call
+        else:
+            global _STEPS_PER_CALL
+            _STEPS_PER_CALL = args.steps_per_call
     if args.dp > 1:
         if "dp" not in sig:
             _emit_error(f"{args.model}_throughput",
