@@ -1,0 +1,57 @@
+"""Bench harness contract tests (reference: benchmark/fluid/
+fluid_benchmark.py role): the driver's one-JSON-line contract on success,
+misuse, and error paths; K-step dispatch fusion; profile trace output.
+Each case shells out exactly as the driver does."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(*extra, timeout=520):
+    r = subprocess.run([sys.executable, BENCH, "--platform", "cpu", *extra],
+                       capture_output=True, text=True, timeout=timeout)
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line: {r.stdout}\n{r.stderr}"
+    return json.loads(lines[-1])
+
+
+def test_smoke_emits_metric_line():
+    d = _run("--smoke", "--steps", "8", "--batch-size", "64")
+    assert d["metric"] == "mnist_mlp_throughput"
+    assert d["value"] > 0 and d["unit"] == "examples/sec"
+
+
+def test_dp_misuse_keeps_json_contract():
+    d = _run("--model", "resnet50", "--dp", "2", "--smoke",
+             "--steps", "1", "--batch-size", "2")
+    assert d["value"] == 0.0 and "--dp is not supported" in d["error"]
+
+
+def test_unwritable_profile_keeps_json_contract():
+    d = _run("--smoke", "--steps", "1", "--batch-size", "8",
+             "--profile", "/no/such/dir/x.json")
+    assert d["value"] == 0.0 and "unwritable" in d["error"]
+
+
+def test_steps_per_call_fuses_and_traces(tmp_path):
+    trace = str(tmp_path / "t.json")
+    d = _run("--model", "deepfm", "--smoke", "--steps", "4",
+             "--batch-size", "16", "--steps-per-call", "2",
+             "--profile", trace)
+    assert d["value"] > 0
+    t = json.load(open(trace))
+    names = {e["name"] for e in t["traceEvents"]}
+    assert any("[2]" in n for n in names), names
+
+
+def test_cpu_runs_do_not_write_history():
+    hist = os.path.join(REPO, "BENCH_HISTORY.json")
+    before = os.path.exists(hist) and open(hist).read()
+    _run("--steps", "2", "--batch-size", "32")  # NON-smoke cpu run
+    after = os.path.exists(hist) and open(hist).read()
+    assert before == after  # cpu runs never touch the recorded trajectory
