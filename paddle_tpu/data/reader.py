@@ -30,10 +30,26 @@ def map_readers(func: Callable, *readers: Reader) -> Reader:
 
 
 def shuffle(reader: Reader, buf_size: int, seed=None) -> Reader:
-    """reference: decorator.py shuffle — buffered shuffle."""
+    """reference: decorator.py shuffle — buffered shuffle.
+
+    With no explicit ``seed``, FLAGS_deterministic pins the stream to the
+    global seed (pt.seed() if called, else FLAGS_seed — the reference's
+    cpu/cudnn_deterministic knobs applied to the one nondeterminism source
+    the compiler doesn't own: host-side shuffling). Successive epochs
+    advance the permutation (seed + epoch), like the reference's shared
+    RNG, while staying replayable across runs."""
+    epoch = [0]
 
     def shuffled():
-        rng = pyrandom.Random(seed)
+        from ..core import random as prandom
+        from ..core.config import FLAGS
+
+        eff_seed = seed
+        if eff_seed is None and FLAGS.get("deterministic"):
+            base = prandom._seed or FLAGS.get("seed")
+            eff_seed = base + epoch[0]
+            epoch[0] += 1
+        rng = pyrandom.Random(eff_seed)
         buf: List[Any] = []
         for item in reader():
             buf.append(item)

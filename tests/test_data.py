@@ -274,3 +274,28 @@ class TestTrainFromDataset:
             on_step=lambda s, l, m: losses.append(float(l)))
         assert steps == 12  # 64/16 per epoch * 3
         assert losses[-1] < losses[0]
+
+
+def test_flags_deterministic_pins_shuffle():
+    """FLAGS_deterministic (the reference's *_deterministic knobs) pins
+    unseeded reader shuffles to FLAGS_seed so runs replay exactly."""
+    from paddle_tpu.core.config import FLAGS
+    from paddle_tpu.data import shuffle
+
+    src = lambda: iter(range(32))
+    old = FLAGS.get("deterministic")
+    try:
+        FLAGS.set("deterministic", True)
+        r1, r2 = shuffle(src, 8), shuffle(src, 8)
+        a = list(r1())       # epoch 0 of reader 1
+        b = list(r2())       # epoch 0 of reader 2: same stream
+        assert a == b and sorted(a) == list(range(32))
+        a2 = list(r1())      # epoch 1 ADVANCES the permutation
+        assert a2 != a and sorted(a2) == list(range(32))
+        assert a2 == list(r2())  # ...identically across readers
+        # explicit seed wins over the flag and never advances
+        s1 = list(shuffle(src, 8, seed=7)())
+        s2 = list(shuffle(src, 8, seed=7)())
+        assert s1 == s2
+    finally:
+        FLAGS.set("deterministic", old)
