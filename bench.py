@@ -54,6 +54,13 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
         batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
     k = max(1, steps_per_call)
     outer = max(1, steps // k)
+    # FLOPs of one update step from the trainer's single-step jit (same
+    # math the scan repeats k times) — before any call donates buffers
+    from paddle_tpu.utils.flops import lowered_flops
+
+    step_flops = lowered_flops(
+        trainer._jit_step, trainer.params, trainer.buffers,
+        trainer.opt_state, trainer._rng, batch)
     for _ in range(warmup):
         loss, _ = (trainer.train_steps(batch, k) if k > 1
                    else trainer.train_step(batch))
@@ -66,7 +73,10 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
             float(loss)
     float(loss)
     dt = time.perf_counter() - t0
-    return outer * k * batch_size / dt, "examples/sec"
+    extras = {}
+    if step_flops:
+        extras["flops_per_sec"] = step_flops * outer * k / dt
+    return outer * k * batch_size / dt, "examples/sec", extras
 
 
 _STEPS_PER_CALL = None  # CLI override consumed by _train_bench
@@ -132,6 +142,12 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
         return params, buffers, state, ls[-1]
 
     from paddle_tpu.core.profiler import RecordEvent
+    from paddle_tpu.utils.flops import lowered_flops
+
+    # model FLOPs per dispatch (fwd+bwd+opt, x k inner steps) from XLA's
+    # cost model on the lowered module — must happen BEFORE the first call
+    # donates these buffers
+    dispatch_flops = lowered_flops(step, params, buffers, state, batch)
 
     outer = max(1, steps // k)
     for _ in range(warmup):
@@ -148,7 +164,10 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
             float(l)
     float(l)
     dt = time.perf_counter() - t0
-    return outer * k * batch_size / dt, "examples/sec"
+    extras = {}
+    if dispatch_flops:
+        extras["flops_per_sec"] = dispatch_flops * outer / dt
+    return outer * k * batch_size / dt, "examples/sec", extras
 
 
 def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
@@ -440,6 +459,22 @@ MODELS = {
 }
 
 
+def evaluate_against_history(metric: str, value: float, history: dict, *,
+                             on_accelerator: bool, record: bool):
+    """Perf-regression contract: ``vs_baseline`` compares this run to the
+    BEST recorded accelerator number for the model (history keeps the
+    max; CPU runs never recorded). Returns (vs_baseline, regression);
+    regression = accelerator run >10% below the record — the API.spec
+    freeze philosophy applied to throughput. Mutates ``history`` in
+    place when ``record`` and ``on_accelerator``."""
+    prev = history.get(metric)
+    vs_baseline = (value / prev) if prev else 1.0
+    regression = bool(on_accelerator and prev and value < 0.9 * prev)
+    if record and on_accelerator:
+        history[metric] = max(value, prev or 0.0)
+    return vs_baseline, regression
+
+
 def _emit_error(metric: str, msg: str) -> None:
     """One-JSON-line driver contract, error form (shared by the device
     watchdog and argument-misuse paths)."""
@@ -558,7 +593,8 @@ def main():
     else:
         ctx = contextlib.nullcontext()
     with ctx:
-        value, unit = fn(steps, batch, **kwargs)
+        value, unit, *rest = fn(steps, batch, **kwargs)
+    extras = rest[0] if rest else {}
 
     metric = f"{args.model}_throughput"
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -570,19 +606,38 @@ def main():
                 history = json.load(f)
         except Exception:
             history = {}
-    prev = history.get(metric)
-    vs_baseline = (value / prev) if prev else 1.0
     import jax
 
     on_accelerator = jax.devices()[0].platform != "cpu"
+    vs_baseline, regression = evaluate_against_history(
+        metric, value, history, on_accelerator=on_accelerator,
+        record=not args.smoke)
+    if regression:
+        print(f"WARNING: {metric} regressed >10% vs best recorded "
+              f"({value:.2f} vs {history[metric]:.2f} {unit})",
+              file=sys.stderr)
     if not args.smoke and on_accelerator:
         # CPU debug runs never pollute the recorded trajectory
-        history[metric] = max(value, prev or 0.0)
         with open(hist_path, "w") as f:
             json.dump(history, f, indent=1)
 
-    print(json.dumps({"metric": metric, "value": round(value, 2), "unit": unit,
-                      "vs_baseline": round(vs_baseline, 4)}))
+    line = {"metric": metric, "value": round(value, 2), "unit": unit,
+            "vs_baseline": round(vs_baseline, 4)}
+    # MFU: model FLOP/s (XLA cost model over the lowered step) / chip peak.
+    # Reported only when both sides are known (never on CPU).
+    from paddle_tpu.utils.flops import mfu as _mfu
+
+    flops_per_sec = extras.get("flops_per_sec")
+    line["mfu"] = None
+    if flops_per_sec:
+        line["tflops_per_sec"] = round(flops_per_sec / 1e12, 3)
+        m = _mfu(flops_per_sec, jax.devices()[0],
+                 n_devices=max(1, args.dp))
+        if m is not None:
+            line["mfu"] = round(m, 4)
+    if regression:
+        line["regression"] = True
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,96 @@
+"""FLOPs accounting + MFU (model-FLOPs utilization) reporting.
+
+The reference's benchmark harness reports only examples/sec
+(reference: benchmark/fluid/fluid_benchmark.py:296-300); a TPU-native
+framework must also say how much of the chip those examples used. MFU =
+(model FLOPs executed per second) / (peak chip FLOP/s). Model FLOPs come
+from XLA's own cost model over the *lowered* (pre-backend-optimization)
+module — this counts the math the program asks for (fwd+bwd+optimizer),
+not remat duplicates, so it is the MFU numerator rather than an HFU one.
+
+Peak numbers are per-chip dense peak for the dtype actually feeding the
+MXU. Override with ``PT_PEAK_FLOPS`` (absolute FLOP/s) when running on a
+device kind not in the table.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+# Dense peak FLOP/s per chip by device kind substring (lowercased match).
+# bf16 column is the MXU peak; int8 is 2x on v5e-class chips.
+_PEAK_BF16 = {
+    "v6e": 918e12,     # Trillium
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def device_peak_flops(device: Optional[Any] = None,
+                      dtype: str = "bf16") -> Optional[float]:
+    """Peak FLOP/s for ``device`` (default: first jax device). Returns
+    None when unknown (e.g. CPU) — callers should then omit MFU rather
+    than report a bogus one."""
+    env = os.environ.get("PT_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass  # malformed override: fall back to the table
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    platform = getattr(device, "platform", "")
+    if platform == "cpu":
+        return None
+    # axon tunnels advertise the generation via env rather than kind
+    if not any(k in kind for k in _PEAK_BF16):
+        kind = os.environ.get("PALLAS_AXON_TPU_GEN", kind).lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            # bf16 peak is the denominator for float runs too: JAX's
+            # default matmul precision on TPU feeds the MXU bf16 inputs
+            # even for fp32 arrays, so the bf16 peak IS the hardware
+            # ceiling of the emitted program. int8 doubles it.
+            scale = {"int8": 2.0}.get(dtype, 1.0)
+            return peak * scale
+    return None
+
+
+def lowered_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one dispatch of ``jitted_fn(*args)`` per XLA's cost model
+    on the lowered module. Returns None when analysis is unavailable
+    (cost model gaps on some backends) — never raises."""
+    try:
+        analysis = jitted_fn.lower(*args, **kwargs).cost_analysis()
+        if not analysis:
+            return None
+        flops = analysis.get("flops")
+        if flops is None or flops <= 0:
+            return None
+        return float(flops)
+    except Exception:
+        return None
+
+
+def mfu(flops_per_sec: Optional[float], device: Optional[Any] = None,
+        dtype: str = "bf16", n_devices: int = 1) -> Optional[float]:
+    """Model-FLOPs utilization in [0, 1], or None when either side is
+    unknown. ``flops_per_sec`` is the GLOBAL program rate (XLA lowers the
+    pre-partitioning module), so the peak scales by ``n_devices`` when
+    the program spans a mesh."""
+    if not flops_per_sec:
+        return None
+    peak = device_peak_flops(device, dtype=dtype)
+    if not peak:
+        return None
+    return flops_per_sec / (peak * max(1, n_devices))

@@ -24,6 +24,46 @@ def test_smoke_emits_metric_line():
     d = _run("--smoke", "--steps", "8", "--batch-size", "64")
     assert d["metric"] == "mnist_mlp_throughput"
     assert d["value"] > 0 and d["unit"] == "examples/sec"
+    # FLOPs accounting: TFLOP/s reported when the XLA cost model
+    # resolves; these tests force --platform cpu, where MFU must be null
+    # (no chip peak to divide by)
+    if "tflops_per_sec" in d:  # cost model can be absent on a backend
+        assert d["tflops_per_sec"] > 0
+        assert d["mfu"] is None
+
+
+def test_regression_contract():
+    """vs_baseline compares to the best recorded accelerator number;
+    >10% below it on an accelerator flags a regression; CPU runs are
+    never recorded (the perf-freeze contract)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    ev = bench.evaluate_against_history
+
+    hist = {"m_throughput": 100.0}
+    # accelerator regression: >10% below record
+    vs, reg = ev("m_throughput", 80.0, dict(hist), on_accelerator=True,
+                 record=True)
+    assert vs == 0.8 and reg
+    # within 10% = no regression
+    _, reg = ev("m_throughput", 95.0, dict(hist), on_accelerator=True,
+                record=True)
+    assert not reg
+    # CPU run never regresses and never records
+    h = dict(hist)
+    vs, reg = ev("m_throughput", 10.0, h, on_accelerator=False, record=True)
+    assert not reg and h["m_throughput"] == 100.0
+    # new accelerator record is kept
+    h = dict(hist)
+    ev("m_throughput", 150.0, h, on_accelerator=True, record=True)
+    assert h["m_throughput"] == 150.0
+    # first-ever number: baseline 1.0, recorded
+    h = {}
+    vs, reg = ev("m_throughput", 50.0, h, on_accelerator=True, record=True)
+    assert vs == 1.0 and not reg and h["m_throughput"] == 50.0
 
 
 def test_dp_misuse_keeps_json_contract():
