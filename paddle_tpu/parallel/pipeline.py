@@ -144,8 +144,13 @@ def _jitted_pipeline(block_fn, mesh, axis, n, m, remat):
         # specs are shape-independent, built from the pytree at trace time
         stage_spec = jax.tree_util.tree_map(
             lambda a: P(axis, *([None] * (a.ndim - 1))), params_staged)
+        # manual ONLY over the pipeline axis: every other mesh axis stays
+        # auto, so dp batch sharding and tp weight sharding compose with
+        # the pipeline in ONE module (GSPMD inserts their collectives
+        # around the manual ppermute ring)
         return jax.shard_map(inner, mesh=mesh,
                              in_specs=(stage_spec, P()), out_specs=P(),
+                             axis_names=frozenset({axis}),
                              check_vma=False)(params_staged, x_mb)
 
     return jax.jit(wrapper)

@@ -1,0 +1,45 @@
+"""API-freeze gate as a test (reference: tools/diff_api.py:1 +
+paddle/fluid/API.spec — CI fails when a public signature drifts from the
+frozen spec).
+
+Mutating any public signature in the frozen modules breaks this test;
+the fix is either reverting the change or deliberately re-freezing via
+``python tools/print_signatures.py --update`` and committing API.spec.
+"""
+
+import io
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+import print_signatures  # noqa: E402
+
+
+@pytest.mark.smoke
+def test_public_api_matches_spec():
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = print_signatures.main(["--check"])
+    assert rc == 0, f"public API drifted from API.spec:\n{out.getvalue()}"
+
+
+def test_drift_is_detected(tmp_path, monkeypatch):
+    """The gate actually fires: a mutated spec line must fail --check."""
+    with open(print_signatures.SPEC_PATH) as f:
+        lines = f.read().splitlines()
+    mutated = list(lines)
+    mutated[0] = mutated[0] + ", extra_arg=None"
+    fake = tmp_path / "API.spec"
+    fake.write_text("\n".join(mutated) + "\n")
+    monkeypatch.setattr(print_signatures, "SPEC_PATH", str(fake))
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = print_signatures.main(["--check"])
+    assert rc == 1
+    assert "API drift" in out.getvalue()

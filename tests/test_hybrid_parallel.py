@@ -1,0 +1,128 @@
+"""Composed-parallelism tests: dp x tp x pp in ONE mesh and ONE module
+(VERDICT r1 #3 — compose the axes, don't just unit-test them; reference
+pattern: tests/unittests/test_dist_base.py:305 compares composed cluster
+runs against single-process runs).
+
+Golden-HLO style assertions mirror tests/test_golden_hlo.py: the compiled
+module of the hybrid step must contain BOTH the dp/tp all-reduce and the
+pipeline's collective-permute.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.parallel.hybrid import build_hybrid_transformer_step
+
+
+def _hybrid_mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return pt.build_mesh(dp=2, tp=2, pp=2, devices=devs[:8])
+
+
+def _reference_loss(params, x, y, lr=0.1):
+    """Same math, no mesh: fold the layer stack sequentially."""
+    p = jax.tree_util.tree_map(np.asarray, params)
+
+    def loss_fn(p, x, y):
+        h = x
+        for l in range(p["w1"].shape[0]):
+            h = h + jnp.tanh(h @ p["w1"][l]) @ p["w2"][l]
+        logits = h @ p["head"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    loss, grads = jax.value_and_grad(loss_fn)(p, jnp.asarray(np.asarray(x)),
+                                              jnp.asarray(np.asarray(y)))
+    new_p = jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
+    return float(loss), new_p
+
+
+def test_dp_tp_pp_single_mesh_train_step():
+    """One jitted training step over a dp=2 x tp=2 x pp=2 mesh: loss is
+    finite, matches the unsharded sequential reference, and the update
+    moves every param."""
+    mesh = _hybrid_mesh()
+    step, params, (x, y) = build_hybrid_transformer_step(mesh)
+    jstep = jax.jit(step)
+    loss, new_params = jstep(params, x, y)
+    loss = float(loss)
+    assert np.isfinite(loss)
+
+    ref_loss, ref_params = _reference_loss(params, x, y)
+    assert abs(loss - ref_loss) < 1e-4, (loss, ref_loss)
+    for k in params:
+        got = np.asarray(new_params[k])
+        want = np.asarray(ref_params[k])
+        np.testing.assert_allclose(got, want, atol=2e-5, err_msg=k)
+        assert not np.allclose(got, np.asarray(params[k])), f"{k} unmoved"
+
+
+def test_hybrid_module_has_both_collectives():
+    """Golden HLO: the SAME compiled module carries the dp/tp gradient
+    all-reduce AND the pipeline's collective-permute (VERDICT r1 #3 done
+    criterion)."""
+    mesh = _hybrid_mesh()
+    step, params, (x, y) = build_hybrid_transformer_step(mesh)
+    compiled = jax.jit(step).lower(params, x, y).compile()
+    txt = compiled.as_text()
+    assert "all-reduce" in txt, "missing dp/tp all-reduce"
+    assert "collective-permute" in txt, "missing pp collective-permute"
+
+
+def test_dp_sp_attention_step_single_mesh():
+    """dp x sp attention training step on one mesh: ring attention over
+    dp-sharded batch + sp-sharded sequence, grads flow, loss finite."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = pt.build_mesh(dp=2, sp=4, devices=devs[:8])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel import ring_attention
+
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 16, 2, 8
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    q = jax.device_put(rng.normal(size=(B, T, H, D)).astype(np.float32), sh)
+    w = jnp.eye(D, dtype=jnp.float32)
+
+    def loss_fn(w, q):
+        o = ring_attention(q @ w, q, q, causal=True, mesh=mesh)
+        return jnp.mean(o ** 2)
+
+    loss, g = jax.jit(jax.value_and_grad(loss_fn))(w, q)
+    assert np.isfinite(float(loss))
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).max() > 0
+
+    txt = jax.jit(jax.value_and_grad(loss_fn)).lower(w, q).compile().as_text()
+    assert "collective-permute" in txt  # the sp ring
+
+
+def test_hybrid_mesh_with_tp_sharded_embedding():
+    """dp x tp x pp mesh where a vocab-sharded table coexists: the
+    embedding lookup shards its vocab rows over 'tp' while the block
+    stack pipelines — still one module."""
+    mesh = _hybrid_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    step, params, (x, y) = build_hybrid_transformer_step(mesh)
+    vocab, d = 32, 16
+    rng = np.random.default_rng(1)
+    table = jax.device_put(
+        jnp.asarray(rng.normal(size=(vocab, d)).astype(np.float32)),
+        NamedSharding(mesh, P("tp", None)))
+    ids = jax.device_put(jnp.asarray(rng.integers(0, vocab, size=(8,))),
+                         NamedSharding(mesh, P("dp")))
+
+    def loss_fn(p, table, ids, y):
+        x_emb = table[ids]
+        loss, _ = step(p, x_emb, y)  # step returns (loss, new_params)
+        return loss
+
+    loss = jax.jit(loss_fn)(params, table, ids, y)
+    assert np.isfinite(float(loss))

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""API-freeze gate (reference: tools/diff_api.py:1 — CI diffs the public
+signature surface against paddle/fluid/API.spec and fails the build on
+drift).
+
+Diffs the live surface collected by ``tools/print_signatures.py`` against
+``API.spec``. Exit 0 = match, exit 1 = drift (prints a +/- diff and the
+remediation command). ``pytest tests/test_api_spec.py`` runs this same
+check so drift breaks the suite.
+
+Usage: python tools/diff_api.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import print_signatures
+
+
+def main() -> int:
+    return print_signatures.main(["--check"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
