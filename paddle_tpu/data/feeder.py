@@ -30,9 +30,22 @@ class DataFeeder:
     feed(batch) returns {"image": array, "label": array}.
     """
 
-    def __init__(self, feed_list: Sequence[str], dtypes=None, sharding=None,
-                 place=None):
-        self.feed_list = list(feed_list)
+    def __init__(self, feed_list: Sequence[Any], place=None, program=None,
+                 dtypes=None, sharding=None):
+        # entries may be names or static Program Vars (the reference's
+        # DataFeeder takes Variables); a Var carrying sequence metadata
+        # (lod_src) gets ragged columns padded + a lengths companion.
+        # Name entries resolve through ``program`` when given, so the
+        # name-based pattern keeps its LoD handling.
+        def resolve(v):
+            if isinstance(v, str) and program is not None and \
+                    hasattr(program, "vars") and v in program.vars:
+                return program.vars[v]
+            return None if isinstance(v, str) else v
+
+        self.feed_vars = [resolve(v) for v in feed_list]
+        self.feed_list = [v if isinstance(v, str) else v.name
+                          for v in feed_list]
         self.dtypes = dtypes
         self.sharding = sharding
         self.place = place
@@ -50,6 +63,28 @@ class DataFeeder:
         out = {}
         for i, name in enumerate(self.feed_list):
             col = [np.asarray(s[i]) for s in batch]
+            var = self.feed_vars[i] if i < len(self.feed_vars) else None
+            lod_src = getattr(var, "lod_src", None)
+            ragged = len({c.shape[:1] for c in col}) > 1
+            if lod_src is not None or (ragged and col[0].ndim >= 1):
+                # LoD replacement: pad ragged rows to the batch max and
+                # emit the lengths companion (SURVEY §7; reference packs
+                # these as LoD offsets, framework/lod_tensor.h:229)
+                lens = np.array([c.shape[0] for c in col], np.int32)
+                t = int(lens.max())
+                elem = col[0].shape[1:]
+                # per-token [1] elem shape collapses (reference scalars)
+                squeeze = elem == (1,)
+                arr = np.zeros((len(col), t) + (() if squeeze else elem),
+                               col[0].dtype)
+                for r, c in enumerate(col):
+                    arr[r, :c.shape[0]] = c[:, 0] if squeeze else c
+                if self.dtypes and self.dtypes[i] is not None:
+                    arr = arr.astype(self.dtypes[i])
+                out[name] = self._place(arr)
+                if lod_src is not None:
+                    out[lod_src] = self._place(lens)
+                continue
             arr = np.stack(col)
             if self.dtypes and self.dtypes[i] is not None:
                 arr = arr.astype(self.dtypes[i])

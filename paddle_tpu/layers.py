@@ -210,7 +210,20 @@ concat = _T.concat
 crop = _T.crop
 diag = _T.diag
 expand = _T.expand
-fill_constant = _T.fill_constant
+def fill_constant(shape, dtype=None, value=0.0, force_cpu=False, out=None):
+    """Static mode (inside program_guard) records a Program var — the
+    block-DSL's loop counters/conditions need Var identity; eager mode
+    returns the array (reference: layers/tensor.py fill_constant)."""
+    from .static.program import is_building
+
+    if out is not None or is_building():
+        from .static import layers as _SL
+
+        return _SL.fill_constant(shape, dtype or "float32", value,
+                                 force_cpu=force_cpu, out=out)
+    return _T.fill_constant(shape, value, dtype or jnp.float32)
+
+
 fill_constant_batch_size_like = _T.fill_constant_batch_size_like
 flatten = _T.flatten
 gather = _T.gather
@@ -237,7 +250,14 @@ uniform_random = _T.uniform_random
 unsqueeze = _T.unsqueeze
 unstack = _T.unstack
 where = _T.where_index
-zeros = _T.zeros
+def zeros(shape, dtype="float32", force_cpu=False):
+    from .static.program import is_building
+
+    if is_building():
+        from .static import layers as _SL
+
+        return _SL.zeros(shape, dtype, force_cpu)
+    return _T.zeros(shape, dtype)
 
 
 def zeros_like(x, dtype=None):
@@ -273,13 +293,61 @@ logical_or = _CF.logical_or
 logical_xor = _CF.logical_xor
 not_equal = _CF.not_equal
 
-# Block-style control flow constructs map to functional lax-backed forms —
-# the TPU-native replacement for sub-block ops (SURVEY §2.2 control flow):
-While = _CF.while_loop
+# Block-style control flow: the reference's recording block DSL (static
+# Programs — static/control_flow.py lowers the recorded body to
+# lax.while_loop/scan), with a __new__ escape to the functional
+# lax-backed forms for eager callers (SURVEY §2.2 control flow):
 Switch = _CF.switch_case
-IfElse = _CF.cond
-StaticRNN = _CF.static_rnn
-DynamicRNN = _RN.dynamic_rnn
+
+from .static import control_flow as _SCF  # noqa: E402
+
+
+class While(_SCF.While):
+    """``While(cond_var)`` + ``with w.block():`` in static mode
+    (reference: layers/control_flow.py:593); ``While(cond_fn, body_fn,
+    loop_vars)`` runs the functional lax.while_loop form."""
+
+    def __new__(cls, cond, *args, **kwargs):
+        from .static.program import Var as _Var
+
+        if isinstance(cond, _Var) and not args:
+            return super().__new__(cls)
+        return _CF.while_loop(cond, *args, **kwargs)
+
+
+class IfElse(_SCF.IfElse):
+    """``IfElse(cond_var)`` + true_block()/false_block() in static mode
+    (reference: layers/control_flow.py:1489); ``IfElse(pred, true_fn,
+    false_fn, *ops)`` runs the functional lax.cond form."""
+
+    def __new__(cls, cond, *args, **kwargs):
+        from .static.program import Var as _Var
+
+        if isinstance(cond, _Var) and not args:
+            return super().__new__(cls)
+        return _CF.cond(cond, *args, **kwargs)
+
+
+class StaticRNN(_SCF.StaticRNN):
+    """No-arg construction opens the recording block DSL (reference:
+    layers/control_flow.py:268); a callable first arg runs the functional
+    scan form ``static_rnn(cell_fn, ...)``."""
+
+    def __new__(cls, *args, **kwargs):
+        if args and callable(args[0]):
+            return _CF.static_rnn(*args, **kwargs)
+        return super().__new__(cls)
+
+
+class DynamicRNN(_SCF.DynamicRNN):
+    """No-arg construction opens the recording block DSL (reference:
+    layers/control_flow.py:1619); a callable first arg runs the
+    functional masked-scan form ``dynamic_rnn(cell_fn, x, init, ...)``."""
+
+    def __new__(cls, *args, **kwargs):
+        if args and callable(args[0]):
+            return _RN.dynamic_rnn(*args, **kwargs)
+        return super().__new__(cls)
 
 
 def Print(input, message: str = "", summarize: int = 20, **_kw):
@@ -316,25 +384,57 @@ class _EagerArray:
         return jnp.stack(self._items, axis=axis)
 
 
-def create_array(dtype="float32"):
+def create_array(dtype="float32", capacity: int = 64):
+    from .static.program import is_building
+
+    if is_building():
+        from .static import layers as _SL
+
+        return _SL.create_array(dtype, capacity)
     return _EagerArray(dtype)
 
 
-def array_write(x, i, array=None):
+def array_write(x, i, array=None, capacity: int = 64):
+    from .static.layers import StaticArray
+    from .static.program import Var as _Var, is_building
+
+    if isinstance(array, StaticArray) or isinstance(x, _Var) or \
+            is_building():
+        from .static import layers as _SL
+
+        return _SL.array_write(x, i, array, capacity)
     if array is None:
         array = create_array(x.dtype)
     return array.write(i, x)
 
 
 def array_read(array, i):
+    from .static.layers import StaticArray
+
+    if isinstance(array, StaticArray):
+        from .static import layers as _SL
+
+        return _SL.array_read(array, i)
     return array.read(i)
 
 
 def array_length(array):
+    from .static.layers import StaticArray
+
+    if isinstance(array, StaticArray):
+        from .static import layers as _SL
+
+        return _SL.array_length(array)
     return array.length()
 
 
 def tensor_array_to_tensor(array, axis: int = 0):
+    from .static.layers import StaticArray
+
+    if isinstance(array, StaticArray):
+        from .static import layers as _SL
+
+        return _SL.tensor_array_to_tensor(array, axis)
     stacked = array.stack()
     return stacked, jnp.asarray(stacked.shape[axis])
 
@@ -508,7 +608,8 @@ def data(name: str, shape, dtype=None, lod_level: int = 0):
     passed directly and this is not needed."""
     from .static import default_main_program
 
-    return default_main_program().data(name, shape, dtype)
+    return default_main_program().data(name, shape, dtype,
+                                       lod_level=lod_level)
 
 
 class _PyReader:
@@ -733,7 +834,9 @@ def _apply_static_dispatch():
             "read_file", "open_files", "random_data_generator", "batch",
             "shuffle", "double_buffer", "load", "fc",
             "autoincreased_step_counter", "create_array", "array_write",
-            "array_read", "array_length", "tensor_array_to_tensor"}
+            "array_read", "array_length", "tensor_array_to_tensor",
+            "While", "IfElse", "StaticRNN", "DynamicRNN",
+            "fill_constant", "zeros"}
     for name, obj in list(g.items()):
         if name.startswith("_") or name in skip:
             continue

@@ -21,6 +21,7 @@ Usage (mirrors the reference's train loop):
 """
 
 from . import layers
+from .control_flow import DynamicRNN, IfElse, StaticRNN, While
 from .executor import Executor, Scope, global_scope
 from .io import (InferencePredictor, TrainStepRunner, load_inference_model,
                  load_persistables, save_inference_model, save_persistables,
@@ -30,7 +31,8 @@ from .program import (GRAD_SUFFIX, Program, Var, append_backward,
                       default_main_program, program_guard)
 
 __all__ = [
-    "layers", "Executor", "Scope", "global_scope",
+    "layers", "DynamicRNN", "IfElse", "StaticRNN", "While",
+    "Executor", "Scope", "global_scope",
     "InferencePredictor", "TrainStepRunner", "load_inference_model",
     "load_persistables", "save_inference_model", "save_persistables",
     "save_train_program",

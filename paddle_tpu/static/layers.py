@@ -12,6 +12,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import initializer as I
 from ..ops import loss as OL
@@ -27,21 +28,26 @@ def _prog(*vars_) -> Program:
     return default_main_program()
 
 
-def fc(input: Var, size: int, act: Optional[str] = None,
+def fc(input, size: int, act: Optional[str] = None,
        bias_attr: bool = True, name: str = "fc") -> Var:
-    """reference: layers/nn.py fc:210."""
-    prog = _prog(input)
-    d_in = input.shape[-1]
-    w = prog.create_parameter(prog.unique_name(f"{name}_w"), (d_in, size),
-                              initializer=I.XavierUniform())
-    args = [input, w]
+    """reference: layers/nn.py fc:210. A LIST input gets one weight per
+    entry and the projections sum (the reference's multi-input mul+sum)."""
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    prog = _prog(*inputs)
+    ws = [prog.create_parameter(
+        prog.unique_name(f"{name}_w"), (x.shape[-1], size),
+        initializer=I.XavierUniform()) for x in inputs]
+    args = inputs + ws
     if bias_attr:
         b = prog.create_parameter(prog.unique_name(f"{name}_b"), (size,),
                                   initializer=I.Constant(0.0))
         args.append(b)
+    k = len(inputs)
 
-    def fn(x, w, b=None):
-        y = x @ w
+    def fn(*vals):
+        xs, rest = vals[:k], vals[k:]
+        ws_, b = rest[:k], (rest[k] if bias_attr else None)
+        y = sum(x @ w for x, w in zip(xs, ws_))
         if b is not None:
             y = y + b
         if act is not None:
@@ -78,10 +84,21 @@ def conv2d(input: Var, num_filters: int, filter_size: int, stride: int = 1,
 
 
 def embedding(input: Var, size: Sequence[int], padding_idx=None,
-              name: str = "embedding") -> Var:
+              is_sparse: bool = False, is_distributed: bool = False,
+              param_attr=None, dtype=None, name: str = "embedding") -> Var:
+    """``param_attr`` with a name enables the reference's cross-layer
+    param sharing (e.g. the MT book model's shared 'vemb' table);
+    ``is_sparse`` is advisory — gradients are dense under XLA and giant
+    tables shard via parallel.ShardedEmbedding (OP_COVERAGE.md)."""
     prog = _prog(input)
-    w = prog.create_parameter(prog.unique_name(f"{name}_w"), tuple(size),
-                              initializer=I.XavierNormal())
+    attr_name = getattr(param_attr, "name", None) or (
+        param_attr if isinstance(param_attr, str) else None)
+    if attr_name and attr_name in prog.vars:
+        w = prog.vars[attr_name]  # shared table
+    else:
+        w = prog.create_parameter(
+            attr_name or prog.unique_name(f"{name}_w"), tuple(size),
+            initializer=I.XavierNormal())
     return prog.apply(lambda ids, t: ON.embedding(ids, t, padding_idx),
                       [input, w], name=name)
 
@@ -106,7 +123,23 @@ abs = _unary("abs", jnp.abs)
 
 
 def mean(x: Var, name: str = "mean") -> Var:
-    return _prog(x).apply(jnp.mean, [x], name=name)
+    """LoD-aware: a padded sequence tensor averages over REAL tokens only
+    (the reference's mean over a LoDTensor counts actual rows)."""
+    prog = _prog(x)
+    lens = getattr(x, "lod_src", None)
+    if lens is not None and lens in prog.vars:
+        def fn(a, ln):
+            t = a.shape[1]
+            m = (jnp.arange(t)[None, :] < ln[:, None]).astype(a.dtype)
+            m = m.reshape(m.shape + (1,) * (a.ndim - 2))
+            return jnp.sum(a * m) / jnp.maximum(
+                jnp.sum(m) * float(np.prod(a.shape[2:], dtype=np.int64)
+                                   or 1), 1.0)
+
+        out = prog.apply(fn, [x, prog.vars[lens]], name=name)
+        out.lod_src = None
+        return out
+    return prog.apply(jnp.mean, [x], name=name)
 
 
 def reduce_sum(x: Var, dim=None, keep_dim: bool = False) -> Var:
@@ -201,3 +234,281 @@ def batch_norm(input: Var, act: Optional[str] = None, is_test: bool = False,
     prog.assign(rmean, nm)
     prog.assign(rvar, nv)
     return y
+
+
+# ---------------------------------------------------------------------------
+# in-place write layers (block-DSL state plumbing)
+# ---------------------------------------------------------------------------
+# The reference's While/optimizer bodies mutate vars through op outputs
+# (reference: layers/control_flow.py increment in_place, layers/ops
+# less_than(cond=...), logical_and(out=...)); here a write to an existing
+# var records Program.assign, which the block-DSL lowering turns into loop
+# carry state (static/control_flow.py).
+
+
+def assign(input: Var, output: Optional[Var] = None) -> Var:
+    prog = _prog(input, output)
+    out = prog.apply(lambda a: a, [input], name="assign_value")
+    if output is not None:
+        prog.assign(output, out)
+        return output
+    return out
+
+
+def increment(x: Var, value: float = 1.0, in_place: bool = True) -> Var:
+    prog = _prog(x)
+    out = prog.apply(lambda a: a + jnp.asarray(value, a.dtype), [x],
+                     name="increment")
+    if in_place:
+        prog.assign(x, out)
+        return x
+    return out
+
+
+def _compare(name, jfn):
+    def layer(x: Var, y, force_cpu: Optional[bool] = None,
+              cond: Optional[Var] = None) -> Var:
+        prog = _prog(x, y, cond)
+        out = prog.apply(jfn, [x, y], name=name)
+        if cond is not None:
+            prog.assign(cond, out)
+            return cond
+        return out
+
+    layer.__name__ = name
+    return layer
+
+
+less_than = _compare("less_than", jnp.less)
+less_equal = _compare("less_equal", jnp.less_equal)
+greater_than = _compare("greater_than", jnp.greater)
+greater_equal = _compare("greater_equal", jnp.greater_equal)
+equal = _compare("equal", jnp.equal)
+not_equal = _compare("not_equal", jnp.not_equal)
+
+
+def _logical(name, jfn, unary=False):
+    if unary:
+        def layer(x: Var, out: Optional[Var] = None,
+                  name_: Optional[str] = None) -> Var:
+            prog = _prog(x, out)
+            o = prog.apply(jfn, [x], name=name)
+            if out is not None:
+                prog.assign(out, o)
+                return out
+            return o
+    else:
+        def layer(x: Var, y: Var, out: Optional[Var] = None,
+                  name_: Optional[str] = None) -> Var:
+            prog = _prog(x, y, out)
+            o = prog.apply(jfn, [x, y], name=name)
+            if out is not None:
+                prog.assign(out, o)
+                return out
+            return o
+
+    layer.__name__ = name
+    return layer
+
+
+logical_and = _logical("logical_and", jnp.logical_and)
+logical_or = _logical("logical_or", jnp.logical_or)
+logical_xor = _logical("logical_xor", jnp.logical_xor)
+logical_not = _logical("logical_not", jnp.logical_not, unary=True)
+
+
+def fill_constant(shape, dtype, value, force_cpu: bool = False,
+                  out: Optional[Var] = None) -> Var:
+    from ..core.dtypes import to_dtype
+
+    prog = _prog(out)
+    o = prog.apply(
+        lambda: jnp.full(tuple(shape), value, to_dtype(dtype)),
+        [], name="fill_constant")
+    if out is not None:
+        prog.assign(out, o)
+        return out
+    return o
+
+
+def zeros(shape, dtype="float32", force_cpu: bool = False) -> Var:
+    return fill_constant(shape, dtype, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# sequence layers over the padded+lengths LoD replacement
+# ---------------------------------------------------------------------------
+
+
+def _lens_var(prog: Program, x: Var, what: str) -> Var:
+    lens = getattr(x, "lod_src", None)
+    from ..core.enforce import enforce as _enf
+
+    _enf(lens is not None and lens in prog.vars,
+         "%s needs sequence (lod_level>=1) input; %s carries no lengths "
+         "companion", what, x.name)
+    return prog.vars[lens]
+
+
+def dynamic_lstm(input: Var, size: int, use_peepholes: bool = True,
+                 is_reverse: bool = False, gate_activation: str = "sigmoid",
+                 cell_activation: str = "tanh",
+                 candidate_activation: str = "tanh",
+                 name: str = "dynamic_lstm"):
+    """reference: layers/nn.py dynamic_lstm — ``input`` is the already
+    x-projected (B, T, 4H) sequence; this layer owns the recurrent weight
+    (H, 4H) and gate bias. Peepholes are subsumed by the gate bias on the
+    masked-scan design (reference peephole weights extend the bias vector;
+    documented deviation). Returns (hidden (B,T,H), cell-final)."""
+    prog = _prog(input)
+    H = size // 4
+    w_hh = prog.create_parameter(prog.unique_name(f"{name}_w"), (H, 4 * H),
+                                 initializer=I.XavierUniform())
+    b = prog.create_parameter(prog.unique_name(f"{name}_b"), (4 * H,),
+                              initializer=I.Constant(0.0))
+    lens = _lens_var(prog, input, "dynamic_lstm")
+
+    def fn(x, w, bias, ln):
+        from ..ops import rnn as RN
+
+        eye = jnp.eye(x.shape[-1], dtype=x.dtype)  # input already projected
+        outs, (h_t, c_t) = RN.lstm(
+            x, eye, w, bias=bias, lengths=ln, is_reverse=is_reverse,
+            gate_activation=gate_activation, cell_activation=cell_activation,
+            candidate_activation=candidate_activation)
+        return outs, c_t
+
+    hidden, cell = prog.apply(fn, [input, w_hh, b, lens], name=name)
+    hidden.lod_src = input.lod_src
+    return hidden, cell
+
+
+def sequence_last_step(input: Var, name: str = "sequence_last_step") -> Var:
+    prog = _prog(input)
+    lens = _lens_var(prog, input, "sequence_last_step")
+
+    def fn(x, ln):
+        idx = jnp.maximum(ln - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+
+    out = prog.apply(fn, [input, lens], name=name)
+    out.lod_src = None
+    return out
+
+
+def sequence_first_step(input: Var, name: str = "sequence_first_step") -> Var:
+    out = _prog(input).apply(lambda x: x[:, 0], [input], name=name)
+    out.lod_src = None
+    return out
+
+
+def sequence_pool(input: Var, pool_type: str = "sum",
+                  name: str = "sequence_pool") -> Var:
+    from ..ops import sequence as SQ
+
+    prog = _prog(input)
+    lens = _lens_var(prog, input, "sequence_pool")
+    out = prog.apply(lambda x, ln: SQ.sequence_pool(x, ln, pool_type),
+                     [input, lens], name=name)
+    out.lod_src = None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static TensorArray (block-DSL state buffers)
+# ---------------------------------------------------------------------------
+# reference: layers/control_flow.py create_array / tensor_array ops +
+# operators/controlflow/tensor_array_read_write_op.cc. The reference grows
+# LoDTensorArrays dynamically; XLA needs static shapes, so the array is a
+# fixed-capacity (cap, ...) buffer var written by dynamic index — writes
+# inside While blocks become loop carry state automatically.
+
+
+class StaticArray:
+    """Handle pairing a Program with a lazily-created buffer var plus a
+    live element count (the buffer itself is capacity-padded — XLA needs
+    static shapes — while ``size`` tracks the highest written index)."""
+
+    def __init__(self, prog: Program, dtype, capacity: int):
+        self.prog = prog
+        self.dtype = dtype
+        self.capacity = capacity
+        self.buffer: Optional[Var] = None
+        self.size: Optional[Var] = None
+
+    def _ensure(self, x: Var) -> Var:
+        if self.buffer is None:
+            cap = self.capacity
+            # shape comes from the seed value AT TRACE TIME so the buffer
+            # stays batch-polymorphic (recorded Var shapes resolve -1
+            # to a placeholder and must not be baked into the zeros)
+            buf = self.prog.apply(
+                lambda v: jnp.zeros((cap,) + v.shape, v.dtype),
+                [x], name="tensor_array")
+            self.buffer = buf
+            self.size = self.prog.apply(
+                lambda: jnp.zeros((), jnp.int32), [], name="array_size")
+        return self.buffer
+
+
+def create_array(dtype="float32", capacity: int = 64) -> StaticArray:
+    from .program import default_main_program
+
+    return StaticArray(default_main_program(), dtype, capacity)
+
+
+def array_write(x: Var, i: Var, array: Optional[StaticArray] = None,
+                capacity: int = 64) -> StaticArray:
+    prog = _prog(x, i)
+    if array is None:
+        array = StaticArray(prog, x.dtype, capacity)
+    buf = array._ensure(x)
+
+    def fn(b, v, idx):
+        return b.at[jnp.reshape(idx, ()).astype(jnp.int32)].set(
+            v.astype(b.dtype))
+
+    out = prog.apply(fn, [buf, x, i], name="array_write")
+    prog.assign(buf, out)
+    new_size = prog.apply(
+        lambda s, idx: jnp.maximum(s, jnp.reshape(idx, ())
+                                   .astype(jnp.int32) + 1),
+        [array.size, i], name="array_size_update")
+    prog.assign(array.size, new_size)
+    return array
+
+
+def array_read(array: StaticArray, i: Var) -> Var:
+    from ..core.enforce import enforce as _enf
+
+    _enf(array.buffer is not None,
+         "array_read before any array_write — the buffer has no shape yet")
+    prog = array.prog
+
+    def fn(b, idx):
+        return jax.lax.dynamic_index_in_dim(
+            b, jnp.reshape(idx, ()).astype(jnp.int32), 0, keepdims=False)
+
+    return prog.apply(fn, [array.buffer, i], name="array_read")
+
+
+def array_length(array: StaticArray) -> Var:
+    """True element count (highest written index + 1), NOT the static
+    capacity — matches the eager array's length semantics."""
+    from ..core.enforce import enforce as _enf
+
+    _enf(array.size is not None,
+         "array_length before any array_write — the array is empty")
+    return array.size
+
+
+def tensor_array_to_tensor(array: StaticArray, axis: int = 0):
+    """Stacked buffer + true element count. The stacked tensor is
+    capacity-padded with zeros past ``n`` (XLA static shapes); slice with
+    ``n`` on the host or mask downstream."""
+    prog = array.prog
+    out = prog.apply(lambda b: jnp.moveaxis(b, 0, axis), [array.buffer],
+                     name="tensor_array_to_tensor")
+    return out, array.size

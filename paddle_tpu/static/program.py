@@ -45,6 +45,10 @@ class Var:
         self.is_param = is_param
         self.is_feed = is_feed
         self.trainable = trainable
+        # LoD replacement metadata: name of the companion lengths var for
+        # padded (B, T, ...) sequence data (SURVEY §7 ragged
+        # canonicalization); propagated through recorded ops
+        self.lod_src: Optional[str] = None
 
     # -- math-op patching ---------------------------------------------------
     def _binop(self, other, fn, opname):
@@ -137,6 +141,12 @@ class Program:
     def block(self, index: int = 0):
         return self
 
+    def var(self, name: str) -> Var:
+        """reference: framework.py Block.var — name lookup with a typed
+        error."""
+        enforce(name in self.vars, "program has no var %s", name)
+        return self.vars[name]
+
     def list_vars(self):
         return list(self.vars.values())
 
@@ -159,12 +169,30 @@ class Program:
         return f"{prefix}{stem}_{self._name_counter}"
 
     # -- graph building -----------------------------------------------------
-    def data(self, name: str, shape: Sequence[int], dtype=None) -> Var:
+    def data(self, name: str, shape: Sequence[int], dtype=None,
+             lod_level: int = 0) -> Var:
         """Feed placeholder (reference: layers/io.py data). Leading -1 means
-        batch-polymorphic (resolved per-run; distinct sizes recompile)."""
+        batch-polymorphic (resolved per-run; distinct sizes recompile).
+
+        ``lod_level >= 1`` declares variable-length sequence data: the var
+        becomes padded ``(-1, -1, *shape)`` (a trailing ``[1]`` elem shape
+        collapses, matching the reference's per-token scalars) and a
+        companion ``<name>@LEN`` int32 feed var carries the row lengths —
+        the LoD-offsets replacement (reference: framework/lod_tensor.h:110;
+        DataFeeder pads ragged batches and fills both)."""
         dtype = dtype or default_dtype()
         enforce(name not in self.vars, "var %s already exists", name)
-        v = Var(self, name, tuple(shape), dtype, is_feed=True)
+        if lod_level >= 1:
+            elem = tuple(d for d in shape if d != -1)  # -1 = old-style
+            # batch placeholder; per-token scalars declare shape [1]
+            if elem and elem[-1] == 1:
+                elem = elem[:-1]
+            v = Var(self, name, (-1, -1) + elem, dtype, is_feed=True)
+            lv = Var(self, name + "@LEN", (-1,), jnp.int32, is_feed=True)
+            self.vars[name + "@LEN"] = lv
+            v.lod_src = lv.name
+        else:
+            v = Var(self, name, tuple(shape), dtype, is_feed=True)
         self.vars[name] = v
         self.version += 1
         return v
@@ -232,6 +260,12 @@ class Program:
             raise type(e)(f"while recording op {name!r}: {e}") from e
         flat = out_specs if isinstance(out_specs, tuple) else (out_specs,)
 
+        # sequence metadata rides along: outputs inherit the first
+        # lod-carrying input's lengths companion (row-preserving ops keep
+        # ragged structure; consumers that reduce it clear lod_src)
+        lod_src = next((self.vars[n].lod_src for n in in_names
+                        if n in self.vars and
+                        getattr(self.vars[n], "lod_src", None)), None)
         out_vars = []
         for spec in flat:
             oname = self.unique_name(name)
@@ -239,6 +273,7 @@ class Program:
             # keep batch polymorphism: if any feed had -1 leading, outputs
             # keep their traced shape (informational only)
             ov = Var(self, oname, shape, spec.dtype)
+            ov.lod_src = lod_src
             self.vars[oname] = ov
             out_vars.append(ov)
         self.nodes.append(_OpNode(fn, in_names, [v.name for v in out_vars],
@@ -283,9 +318,12 @@ class Program:
                 for n in nodes[:cut]
             ]
         p.nodes = list(nodes)
-        p.vars = {k: Var(p, v.name, v.shape, v.dtype, is_param=v.is_param,
-                         is_feed=v.is_feed, trainable=v.trainable)
-                  for k, v in self.vars.items()}
+        p.vars = {}
+        for k, v in self.vars.items():
+            nv = Var(p, v.name, v.shape, v.dtype, is_param=v.is_param,
+                     is_feed=v.is_feed, trainable=v.trainable)
+            nv.lod_src = v.lod_src
+            p.vars[k] = nv
         p.param_inits = dict(self.param_inits)
         p._const_values = dict(getattr(self, "_const_values", {}))
         p.version = self.version
@@ -311,13 +349,22 @@ def default_main_program() -> Program:
     return _tls.main
 
 
+def is_building() -> bool:
+    """True inside ``program_guard`` — layers with no Var inputs (e.g.
+    fill_constant) use this to record onto the Program instead of
+    returning an eager array."""
+    return getattr(_tls, "building", 0) > 0
+
+
 @contextlib.contextmanager
 def program_guard(main: Program):
     prev = getattr(_tls, "main", None)
     _tls.main = main
+    _tls.building = getattr(_tls, "building", 0) + 1
     try:
         yield main
     finally:
+        _tls.building -= 1
         if prev is None:
             del _tls.main
         else:

@@ -1,0 +1,158 @@
+"""Block-DSL control flow unit tests — While.block / IfElse /
+StaticRNN.step / DynamicRNN.block recording contexts
+(static/control_flow.py; reference: python/paddle/fluid/layers/
+control_flow.py While:593, IfElse:1489, StaticRNN:268, DynamicRNN:1619).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.layers as pd
+from paddle_tpu import static
+from paddle_tpu.core.enforce import EnforceError
+
+
+def _run(prog, feed=None, fetch=None):
+    exe = static.Executor()
+    exe.scope = static.Scope()
+    return exe.run(prog, feed=feed or {}, fetch_list=fetch or [])
+
+
+def test_while_sums_counter():
+    prog = static.Program()
+    with static.program_guard(prog):
+        i = pd.fill_constant(shape=[1], dtype="int64", value=0)
+        n = pd.fill_constant(shape=[1], dtype="int64", value=10)
+        s = pd.fill_constant(shape=[1], dtype="int64", value=0)
+        cond = pd.less_than(i, n)
+        w = pd.While(cond=cond)
+        with w.block():
+            pd.assign(s + i, output=s)
+            pd.increment(i, value=1, in_place=True)
+            pd.less_than(i, n, cond=cond)
+    out = _run(prog, fetch=[s, i])
+    assert out[0].item() == 45 and out[1].item() == 10
+
+
+def test_while_requires_cond_update():
+    prog = static.Program()
+    with static.program_guard(prog):
+        i = pd.fill_constant(shape=[1], dtype="int64", value=0)
+        n = pd.fill_constant(shape=[1], dtype="int64", value=3)
+        cond = pd.less_than(i, n)
+        w = pd.While(cond=cond)
+        with pytest.raises(EnforceError, match="re-assigns its condition"):
+            with w.block():
+                pd.increment(i, in_place=True)  # cond never re-assigned
+
+
+def test_while_with_tensor_array():
+    """Reference decode pattern: seed the array pre-loop, write inside."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        i = pd.fill_constant(shape=[1], dtype="int64", value=0)
+        n = pd.fill_constant(shape=[1], dtype="int64", value=4)
+        v = pd.fill_constant(shape=[2], dtype="float32", value=1.0)
+        arr = pd.array_write(v, i, capacity=4)
+        cond = pd.less_than(i, n)
+        w = pd.While(cond=cond)
+        with w.block():
+            cur = pd.array_read(arr, i)
+            pd.increment(i, in_place=True)
+            pd.array_write(cur * 2.0, i, array=arr)
+            pd.less_than(i, n, cond=cond)
+        stacked, _size = pd.tensor_array_to_tensor(arr)
+    out = _run(prog, fetch=[stacked])[0]
+    np.testing.assert_allclose(out[:, 0], [1, 2, 4, 8])
+
+
+def test_ifelse_row_routing():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = pd.data("x", shape=[-1, 1], dtype="float32")
+        zero = pd.fill_constant(shape=[1], dtype="float32", value=0.0)
+        c = pd.less_than(x, zero)
+        ie = pd.IfElse(c)
+        with ie.true_block():
+            ie.output(-ie.input(x))
+        with ie.false_block():
+            ie.output(ie.input(x) * 10.0)
+        outs = ie()
+    xv = np.array([[-2.0], [3.0], [-4.0]], np.float32)
+    out = _run(prog, feed={"x": xv}, fetch=[outs[0]])[0]
+    np.testing.assert_allclose(out.ravel(), [2.0, 30.0, 4.0])
+
+
+def test_static_rnn_matches_manual_scan():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = pd.data("x", shape=[2, 5, 3], dtype="float32")
+        rnn = pd.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[3], value=0.0)
+            nh = (h + xt) * 0.5
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+    xv = np.random.default_rng(0).normal(size=(2, 5, 3)).astype(np.float32)
+    got = _run(prog, feed={"x": xv}, fetch=[out])[0]
+    h = np.zeros((2, 3), np.float32)
+    for t in range(5):
+        h = (h + xv[:, t]) * 0.5
+        np.testing.assert_allclose(got[:, t], h, rtol=1e-6)
+
+
+def test_dynamic_rnn_masks_by_length():
+    prog = static.Program()
+    with static.program_guard(prog):
+        seq = pd.data("seq", shape=[4], dtype="float32", lod_level=1)
+        rnn = pd.DynamicRNN()
+        with rnn.block():
+            w = rnn.step_input(seq)
+            mem = rnn.memory(shape=[4], value=0.0)
+            new = mem + w
+            rnn.update_memory(mem, new)
+            rnn.output(new)
+        out = rnn()
+        last = pd.sequence_last_step(out)
+    sv = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    lens = np.array([3, 2], np.int32)
+    got = _run(prog, feed={"seq": sv, "seq@LEN": lens}, fetch=[last])[0]
+    np.testing.assert_allclose(got[0], sv[0, :3].sum(0))
+    np.testing.assert_allclose(got[1], sv[1, :2].sum(0))  # frozen at len
+
+
+def test_dynamic_rnn_memory_init_from_var():
+    prog = static.Program()
+    with static.program_guard(prog):
+        seq = pd.data("seq", shape=[2], dtype="float32", lod_level=1)
+        init = pd.data("init", shape=[-1, 2], dtype="float32")
+        rnn = pd.DynamicRNN()
+        with rnn.block():
+            w = rnn.step_input(seq)
+            mem = rnn.memory(init=init)
+            new = mem * 0.5 + w
+            rnn.update_memory(mem, new)
+            rnn.output(new)
+        out = rnn()
+    sv = np.ones((1, 2, 2), np.float32)
+    lens = np.array([2], np.int32)
+    iv = np.full((1, 2), 4.0, np.float32)
+    got = _run(prog, feed={"seq": sv, "seq@LEN": lens, "init": iv},
+               fetch=[out])[0]
+    np.testing.assert_allclose(got[0, 0], [3.0, 3.0])   # 4*0.5+1
+    np.testing.assert_allclose(got[0, 1], [2.5, 2.5])   # 3*0.5+1
+
+
+def test_ragged_feeder_pads_and_emits_lengths():
+    from paddle_tpu.data import DataFeeder
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        seq = pd.data("seq", shape=[1], dtype="int64", lod_level=1)
+    feeder = DataFeeder([prog.var("seq")])
+    out = feeder.feed([([1, 2, 3],), ([4, 5],)])
+    np.testing.assert_array_equal(np.asarray(out["seq"]),
+                                  [[1, 2, 3], [4, 5, 0]])
+    np.testing.assert_array_equal(np.asarray(out["seq@LEN"]), [3, 2])

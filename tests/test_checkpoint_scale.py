@@ -1,0 +1,84 @@
+"""Checkpoint-at-scale smoke (VERDICT r1 #10): save a sharded
+BERT-base-sized training state on an 8-device mesh, restore it onto a
+DIFFERENT mesh shape (4 devices), and prove the resharded restore is
+exact — timing the async write path. This is the resharding-on-restore
+upgrade SURVEY §5.4 asked for over the reference's shape-must-match load
+(reference: python/paddle/fluid/io.py:460 save_persistables /
+load_persistables).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.checkpoint import restore_state, save_state
+
+
+def _bert_base_like_state(mesh, rng):
+    """Param + Adam-moment pytree with BERT-base's shape census (~110M
+    params x 3 trees), embeddings dp-sharded and the rest tp/replicated —
+    a realistic mixed-sharding checkpoint. Scaled-down layer count keeps
+    the CPU-sim test quick while the big embedding/vocab leaves keep the
+    bytes honest."""
+    H, FF, V = 768, 3072, 30528  # vocab padded to /64 (standard TPU prep)
+    layers = 4  # 12 in the real config; 4 keeps the smoke < 1 min
+    leaves = {
+        "embeddings.tok.weight": ((V, H), P("dp", None)),
+        "embeddings.pos.weight": ((512, H), P()),
+        "mlm_decoder.weight": ((H, V), P(None, "dp")),
+    }
+    for i in range(layers):
+        leaves[f"encoder.{i}.q_proj.weight"] = ((H, H), P(None, "dp"))
+        leaves[f"encoder.{i}.out_proj.weight"] = ((H, H), P("dp", None))
+        leaves[f"encoder.{i}.fc1.weight"] = ((H, FF), P(None, "dp"))
+        leaves[f"encoder.{i}.fc2.weight"] = ((FF, H), P("dp", None))
+        leaves[f"encoder.{i}.ln.weight"] = ((H,), P())
+    state = {"params": {}, "m": {}, "v": {}}
+    for name, (shape, spec) in leaves.items():
+        val = rng.normal(size=shape).astype(np.float32)
+        sh = NamedSharding(mesh, spec)
+        state["params"][name] = jax.device_put(jnp.asarray(val), sh)
+        state["m"][name] = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
+        state["v"][name] = jax.device_put(
+            jnp.full(shape, 0.5, jnp.float32), sh)
+    return state
+
+
+def test_resharding_restore_8_to_4_devices(tmp_path):
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(0)
+    mesh8 = pt.build_mesh(dp=8, devices=devs[:8])
+    state = _bert_base_like_state(mesh8, rng)
+    n_bytes = sum(l.size * l.dtype.itemsize
+                  for l in jax.tree_util.tree_leaves(state))
+    assert n_bytes > 400e6  # the smoke must be at real scale (>400 MB)
+
+    # async save: the handle returns before the bytes land; join and time
+    t0 = time.perf_counter()
+    handle = save_state(str(tmp_path / "ckpt"), state, async_save=True)
+    t_dispatch = time.perf_counter() - t0
+    handle.join()
+    t_total = time.perf_counter() - t0
+    # the async contract: dispatch returns well before the full write
+    assert t_dispatch < t_total
+    print(f"async save: dispatch {t_dispatch:.3f}s, "
+          f"total {t_total:.3f}s for {n_bytes / 1e6:.0f} MB")
+
+    # restore onto a 4-device mesh — different device count AND axis size
+    mesh4 = pt.build_mesh(dp=4, devices=devs[:4])
+    restored = restore_state(str(tmp_path / "ckpt"), mesh=mesh4,
+                             target=state)
+    for tree in ("params", "m", "v"):
+        for name, want in state[tree].items():
+            got = restored[tree][name]
+            assert got.sharding.mesh.devices.size == 4, name
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want),
+                err_msg=f"{tree}/{name} not bitwise-equal after reshard")

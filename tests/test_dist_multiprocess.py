@@ -1,23 +1,24 @@
 """Multi-process distributed integration test — the reference's
 test_dist_base pattern (reference:
 python/paddle/fluid/tests/unittests/test_dist_base.py:305 TestDistBase —
-"no fake cluster": multi-node is simulated as multi-process on one host via
-subprocess.Popen + env-var roles).
+"no fake cluster": multi-node is simulated as multi-process on one host),
+driven through ``python -m paddle_tpu.launch`` (reference:
+python/paddle/distributed/launch.py:1) instead of hand-rolled Popen
+scaffolding.
 
-Here: two real OS processes bring up fleet (JAX coordination service over
-127.0.0.1), form a global 2-device mesh, and train the same MNIST MLP with
-data parallelism; per-step losses must match a single-process run on the
-same total batch (the reference's compare-losses-within-delta check).
+Two worker processes bring up fleet (JAX coordination service over
+127.0.0.1; ranks/endpoints injected by the launcher's env protocol),
+form a global 2-device mesh, and train the same MNIST MLP with data
+parallelism; per-step losses must match a single-process run on the same
+total batch (the reference's compare-losses-within-delta check).
 """
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -34,9 +35,9 @@ import paddle_tpu as pt
 from paddle_tpu import fleet, optimizer
 from paddle_tpu.models import mnist as M
 
-rank = int(sys.argv[1])
-f = fleet.init(role=fleet.RoleMaker(rank=rank, world_size=2,
-                                    coordinator="127.0.0.1:%(port)d"))
+# rank/world/coordinator all come from the launcher's env protocol
+f = fleet.init()
+rank = f.worker_index()
 assert f.worker_num() == 2
 n = len(jax.devices())
 assert n == 2, f"expected 2 global devices, got {n}"
@@ -56,45 +57,41 @@ for i in range(3):
                  tr.data_sharding(), ys[i])}
     loss, _ = tr.train_step(batch)
     losses.append(float(loss))
-print("LOSSES:" + json.dumps(losses), flush=True)
+print("LOSSES[%%d]:%%s" %% (rank, json.dumps(losses)), flush=True)
 f.shutdown()
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _losses_from(text: str, rank: int):
+    tag = f"LOSSES[{rank}]:"
+    lines = [l for l in text.splitlines() if l.startswith(tag)]
+    assert lines, f"no rank-{rank} losses in output:\n{text}"
+    return json.loads(lines[0][len(tag):])
 
 
-def test_two_process_dp_matches_single_process(tmp_path):
-    port = _free_port()
+def test_launch_two_process_dp_matches_single_process(tmp_path):
     script = tmp_path / "worker.py"
-    script.write_text(WORKER % {"repo": REPO, "port": port})
+    script.write_text(WORKER % {"repo": REPO})
+    log_dir = tmp_path / "logs"
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # 1 local device per process
-    env["JAX_PLATFORMS"] = "cpu"
-    procs = [subprocess.Popen([sys.executable, str(script), str(r)],
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, env=env, text=True)
-             for r in (0, 1)]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
-    for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
-    per_rank = []
-    for out in outs:
-        line = [l for l in out.splitlines() if l.startswith("LOSSES:")]
-        assert line, f"no losses in output:\n{out}"
-        per_rank.append(json.loads(line[0][len("LOSSES:"):]))
-    # both ranks see the same global loss
-    np.testing.assert_allclose(per_rank[0], per_rank[1], rtol=1e-5)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--log-dir", str(log_dir),
+         "--timeout", "240", str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
 
-    # single-process reference on the full batch (double the per-rank data
-    # replication: both ranks fed identical (8, 784) slabs, and dp sharding
-    # splits them, so the global batch equals the local one)
+    # rank 0 streams through the launcher; rank 1 lands in workerlog.1
+    rank0 = _losses_from(r.stdout, 0)
+    with open(log_dir / "workerlog.1") as f:
+        rank1 = _losses_from(f.read(), 1)
+    np.testing.assert_allclose(rank0, rank1, rtol=1e-5)
+
+    # single-process reference on the full batch (both ranks fed identical
+    # (8, 784) slabs and dp shards them, so the global batch matches)
     import jax
+    import jax.numpy as jnp
 
     import paddle_tpu as pt
     from paddle_tpu import optimizer
@@ -108,8 +105,6 @@ def test_two_process_dp_matches_single_process(tmp_path):
     rng = np.random.default_rng(0)
     xs = rng.normal(size=(3, 8, 784)).astype(np.float32)
     ys = rng.integers(0, 10, (3, 8))
-    import jax.numpy as jnp
-
     ref = []
     for i in range(3):
         batch = {"x": jax.device_put(jnp.asarray(xs[i]), tr.data_sharding()),
@@ -117,4 +112,23 @@ def test_two_process_dp_matches_single_process(tmp_path):
                                          tr.data_sharding())}
         loss, _ = tr.train_step(batch)
         ref.append(float(loss))
-    np.testing.assert_allclose(per_rank[0], ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(rank0, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_launch_propagates_failure(tmp_path):
+    """A failing rank takes the job down with a non-zero exit and the
+    failing rank's log tail on stderr."""
+    script = tmp_path / "boom.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "print(f'worker {rank} says hi')\n"
+        "sys.exit(3 if rank == 1 else 0)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--log-dir", str(tmp_path / "logs"), "--timeout", "60",
+         str(script)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 3
+    assert "rank 1 exited with 3" in r.stderr
+    assert "worker 1 says hi" in r.stderr  # log tail replayed

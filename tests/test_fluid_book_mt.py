@@ -1,0 +1,251 @@
+"""Machine-translation book model through the BLOCK DSL — a verbatim-style
+port of the reference's tests/book/test_machine_translation.py (train path
+uses DynamicRNN.block(); decode uses While.block()) running through the
+paddle_tpu.fluid compat surface.
+
+VERDICT r1 #4 done-criterion: the reference's dynamic-RNN MT model runs
+through the block API (reference: python/paddle/fluid/layers/
+control_flow.py:1537 DynamicRNN docs, :635 While.block;
+tests/book/test_machine_translation.py:57 decoder_train).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.data as pdata
+import paddle_tpu.fluid as fluid
+import paddle_tpu.layers as pd
+from paddle_tpu import static
+from paddle_tpu.static import Executor
+
+dict_size = 300          # scaled from the reference's 30000 for CI speed
+hidden_dim = 32
+word_dim = 16
+batch_size = 2
+decoder_size = hidden_dim
+
+
+def encoder(is_sparse):
+    # mirrors reference encoder(): embedding -> fc(tanh) -> dynamic_lstm
+    # -> sequence_last_step
+    src_word_id = pd.data(
+        name="src_word_id", shape=[1], dtype="int64", lod_level=1)
+    src_embedding = pd.embedding(
+        input=src_word_id,
+        size=[dict_size, word_dim],
+        dtype="float32",
+        is_sparse=is_sparse,
+        param_attr=fluid.ParamAttr(name="vemb"))
+
+    fc1 = pd.fc(input=src_embedding, size=hidden_dim * 4, act="tanh")
+    lstm_hidden0, lstm_0 = pd.dynamic_lstm(input=fc1, size=hidden_dim * 4)
+    encoder_out = pd.sequence_last_step(input=lstm_hidden0)
+    return encoder_out
+
+
+def decoder_train(context, is_sparse):
+    # mirrors reference decoder_train(): DynamicRNN block with a shared
+    # 'vemb' embedding, fc over [word, state], softmax head
+    trg_language_word = pd.data(
+        name="target_language_word", shape=[1], dtype="int64", lod_level=1)
+    trg_embedding = pd.embedding(
+        input=trg_language_word,
+        size=[dict_size, word_dim],
+        dtype="float32",
+        is_sparse=is_sparse,
+        param_attr=fluid.ParamAttr(name="vemb"))
+
+    rnn = pd.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(trg_embedding)
+        pre_state = rnn.memory(init=context)
+        current_state = pd.fc(input=[current_word, pre_state],
+                              size=decoder_size,
+                              act="tanh")
+        current_score = pd.fc(input=current_state,
+                              size=dict_size,
+                              act="softmax")
+        rnn.update_memory(pre_state, current_state)
+        rnn.output(current_score)
+
+    return rnn()
+
+
+def _train_program():
+    prog = static.Program()
+    with static.program_guard(prog):
+        context = encoder(is_sparse=False)
+        rnn_out = decoder_train(context, is_sparse=False)
+        label = pd.data(
+            name="target_language_next_word", shape=[1], dtype="int64",
+            lod_level=1)
+        cost = pd.cross_entropy(input=rnn_out, label=label)
+        avg_cost = pd.mean(cost)
+
+        optimizer = fluid.optimizer.Adagrad(learning_rate=0.2)
+        optimizer.minimize(avg_cost)
+    return prog, avg_cost
+
+
+def _learnable_reader(n=512, seed=0):
+    """(src, trg_in, trg_next) samples shaped like wmt14's but with a
+    LEARNABLE decoder task: trg tokens count up by one, so next-word is a
+    deterministic function of the current word (the reference's own book
+    test asserts nothing about its cost — ours requires real learning)."""
+    def reader():
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            t = int(rng.integers(3, 7))
+            src = rng.integers(3, dict_size, t)
+            start = int(rng.integers(3, dict_size - t - 1))
+            trg = np.arange(start, start + t)
+            yield (list(map(int, src)),
+                   [0] + list(map(int, trg)),
+                   list(map(int, trg)) + [1])
+
+    return reader
+
+
+def test_mt_block_dsl_trains():
+    prog, avg_cost = _train_program()
+    train_data = pdata.batch(
+        pdata.shuffle(_learnable_reader(), buf_size=128),
+        batch_size=16)
+
+    feed_order = ["src_word_id", "target_language_word",
+                  "target_language_next_word"]
+    feed_list = [prog.global_block().var(name) for name in feed_order]
+    feeder = fluid.DataFeeder(feed_list, fluid.CPUPlace())
+
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    costs = []
+    for _pass in range(2):
+        for batch_id, data in enumerate(train_data()):
+            outs = exe.run(prog, feed=feeder.feed(data),
+                           fetch_list=[avg_cost])
+            costs.append(float(np.asarray(outs[0])))
+    assert np.isfinite(costs).all(), costs
+    # cross entropy starts near log(vocab)≈5.7; the count-up task is
+    # deterministic, so training through the block DSL must cut it down
+    assert costs[-1] < costs[0] * 0.7, (costs[0], costs[-1])
+
+
+def test_mt_decoder_matches_manual_recurrence():
+    """The DynamicRNN block's math equals a hand-rolled recurrence on the
+    same weights (per-sequence, up to each row's length)."""
+    prog, _ = _train_program()
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    exe.run_startup(prog)
+
+    src = np.array([[3, 4, 5], [6, 7, 0]], np.int64)
+    src_lens = np.array([3, 2], np.int32)
+    trg = np.array([[0, 3, 4], [0, 5, 0]], np.int64)
+    trg_lens = np.array([3, 2], np.int32)
+
+    feed = {
+        "src_word_id": src, "src_word_id@LEN": src_lens,
+        "target_language_word": trg, "target_language_word@LEN": trg_lens,
+        # the clone still records the CE loss ops, which read the label
+        # feed (the executor compiles the whole clone; dummy is fine)
+        "target_language_next_word": trg,
+        "target_language_next_word@LEN": trg_lens,
+    }
+    # inference clone: the train program's optimizer ops would mutate the
+    # weights on every run (reference clone(for_test=True) semantics)
+    test_prog = prog.clone(for_test=True)
+    rnn_out_name = [v.name for v in test_prog.list_vars()
+                    if v.name.startswith("rnn_out")][0]
+    ctx_name = [v.name for v in test_prog.list_vars()
+                if v.name.startswith("sequence_last_step")][0]
+    out, ctx = exe.run(test_prog, feed=feed,
+                       fetch_list=[rnn_out_name, ctx_name])
+
+    # manual recurrence on the same scope weights; param_inits preserves
+    # creation order: enc fc, lstm, dec fc(word,state), dec score fc
+    sc = exe.scope
+    vemb = np.asarray(sc.get("vemb"))
+    order = list(prog.param_inits)
+    fc_ws = [n for n in order if n.startswith("fc_w")]
+    fc_bs = [n for n in order if n.startswith("fc_b")]
+
+    def lookup(ids):
+        return vemb[ids]
+
+    # this test pins the DECODER block's recurrence (encoder context is
+    # fetched from the program):
+    state_w1 = np.asarray(sc.get(fc_ws[1]))   # current_word proj
+    state_w2 = np.asarray(sc.get(fc_ws[2]))   # pre_state proj
+    state_b = np.asarray(sc.get(fc_bs[1]))
+    score_w = np.asarray(sc.get(fc_ws[3]))
+    score_b = np.asarray(sc.get(fc_bs[2]))
+
+    B, T = trg.shape
+    for b in range(B):
+        state = np.asarray(ctx)[b]
+        for t in range(int(trg_lens[b])):
+            word = lookup(trg[b, t])
+            state_new = np.tanh(word @ state_w1 + state @ state_w2 + state_b)
+            logits = state_new @ score_w + score_b
+            score = np.exp(logits - logits.max())
+            score /= score.sum()
+            np.testing.assert_allclose(out[b, t], score, atol=1e-4)
+            state = state_new
+
+
+def _greedy_decode_program(max_len=6, B=2):
+    """While.block() greedy decode with TensorArray state — the
+    XLA-friendly core of the reference decoder_decode loop (reference:
+    tests/book/test_machine_translation.py:85 decoder_decode; beam
+    search's dynamic widths stay on the functional ops.decode path)."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        context = encoder(is_sparse=False)
+        counter = pd.zeros(shape=[1], dtype="int64")
+        limit = pd.fill_constant(shape=[1], dtype="int64", value=max_len)
+        state = pd.assign(context)
+        # batch-size-like constants keep the decode batch-polymorphic
+        # (the reference feeds init_ids; shape tracks the encoder batch)
+        word = pd.fill_constant_batch_size_like(
+            context, shape=[1], value=0, dtype="int64")
+        word = pd.reshape(word, [-1])
+        # seed the array BEFORE the loop (the reference does the same:
+        # array_write(init_ids, array=ids_array, i=counter)) so the
+        # buffer var pre-exists and loop writes become carry state
+        ids_array = pd.array_write(word, counter, capacity=max_len)
+        cond = pd.less_than(counter, limit)
+        w = pd.While(cond=cond)
+        with w.block():
+            word_emb = pd.embedding(
+                input=word, size=[dict_size, word_dim], dtype="float32",
+                param_attr=fluid.ParamAttr(name="vemb"))
+            new_state = pd.fc(input=[word_emb, state],
+                              size=decoder_size, act="tanh")
+            score = pd.fc(input=new_state, size=dict_size, act="softmax")
+            nxt = pd.argmax(score, axis=-1)
+            pd.array_write(nxt, counter, array=ids_array)
+            pd.assign(new_state, output=state)
+            pd.assign(nxt, output=word)
+            pd.increment(counter, value=1, in_place=True)
+            pd.less_than(counter, limit, cond=cond)
+        ids, _n = pd.tensor_array_to_tensor(ids_array, axis=0)
+    return prog, ids
+
+
+def test_mt_greedy_decode_while():
+    prog, ids = _greedy_decode_program()
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    src = np.array([[3, 4, 5], [6, 7, 0]], np.int64)
+    out = exe.run(prog, feed={"src_word_id": src,
+                              "src_word_id@LEN": np.array([3, 2], np.int32)},
+                  fetch_list=[ids])[0]
+    assert out.shape == (6, 2)  # (steps, batch)
+    assert (out >= 0).all() and (out < dict_size).all()
+    # greedy decode is deterministic given the initialized weights
+    out2 = exe.run(prog, feed={"src_word_id": src,
+                               "src_word_id@LEN": np.array([3, 2],
+                                                           np.int32)},
+                   fetch_list=[ids])[0]
+    np.testing.assert_array_equal(out, out2)

@@ -25,21 +25,15 @@ DESIGN_NA = {
     "paddle.fluid.contrib.reader.ctr_reader": "native MultiSlotFeed",
 }
 
-# The reference's imperative block-DSL (`with while_op.block(): ...` building
-# desc sub-blocks) cannot exist under traced functional control flow; the
-# named constructs themselves resolve to the lax-backed forms
-# (layers.While = while_loop etc. — SURVEY §7 "control flow" row), so the
-# DSL *methods* are design-na with those functions as the replacement.
+# The block DSL (While.block / IfElse blocks / DynamicRNN.block /
+# StaticRNN.step) is IMPLEMENTED as recording contexts lowering to
+# lax.while_loop/scan (static/control_flow.py; exercised by
+# tests/test_block_dsl.py + tests/test_fluid_book_mt.py). Remaining
+# design-na method names: Switch's case/default (switch_case functional
+# form covers it) and the contrib decoder helpers (beam search lives on
+# the functional ops.decode path — dynamic beam widths don't trace).
 BLOCK_DSL_METHODS = {
-    "layers.While.block", "layers.Switch.case", "layers.Switch.default",
-    "layers.IfElse.false_block", "layers.IfElse.input",
-    "layers.IfElse.output", "layers.IfElse.true_block",
-    "layers.DynamicRNN.block", "layers.DynamicRNN.memory",
-    "layers.DynamicRNN.output", "layers.DynamicRNN.static_input",
-    "layers.DynamicRNN.step_input", "layers.DynamicRNN.update_memory",
-    "layers.StaticRNN.memory", "layers.StaticRNN.output",
-    "layers.StaticRNN.step", "layers.StaticRNN.step_input",
-    "layers.StaticRNN.step_output", "layers.StaticRNN.update_memory",
+    "layers.Switch.case", "layers.Switch.default",
     "contrib.TrainingDecoder.block", "contrib.TrainingDecoder.output",
     "contrib.TrainingDecoder.static_input",
     "contrib.TrainingDecoder.step_input",

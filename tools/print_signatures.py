@@ -40,6 +40,7 @@ MODULES = [
     "paddle_tpu.slim",
     "paddle_tpu.utils",
     "paddle_tpu.jit",
+    "paddle_tpu.launch",
 ]
 
 SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
