@@ -1,0 +1,102 @@
+"""HDFS client tests (reference: contrib/utils/hdfs_utils.py) — driven
+against a stub ``hadoop`` binary that maps ``hadoop fs`` verbs onto a
+local directory, plus the typed-degradation path when no binary exists.
+"""
+
+import os
+import stat
+
+import pytest
+
+from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.utils import HDFSClient, multi_download, multi_upload
+
+STUB = r"""#!/bin/bash
+# stub hadoop: 'hadoop fs [-D k=v]... VERB args' over a local root
+ROOT="$STUB_ROOT"
+shift  # drop 'fs'
+while [ "$1" == "-D" ]; do shift 2; done
+verb="$1"; shift
+case "$verb" in
+  -test)
+    flag="$1"; path="$ROOT/$2"
+    [ "$flag" == "-d" ] && { [ -d "$path" ]; exit $?; }
+    [ -e "$path" ]; exit $? ;;
+  -mkdir) shift; mkdir -p "$ROOT/$1" ;;
+  -put) cp -r "$1" "$ROOT/$2" ;;
+  -get) cp -r "$ROOT/$1" "$2" ;;
+  -rm|-rmr) rm -rf "$ROOT/$1" ;;
+  -mv) mv "$ROOT/$1" "$ROOT/$2" ;;
+  -ls)
+    rec=""
+    [ "$1" == "-R" ] && { rec="yes"; shift; }
+    base="$ROOT/$1"
+    if [ -n "$rec" ]; then list=$(find "$base" -mindepth 1); else
+      list=$(find "$base" -mindepth 1 -maxdepth 1); fi
+    for f in $list; do
+      rel="${f#$ROOT/}"
+      if [ -d "$f" ]; then echo "drwxr-xr-x - u g 0 2026-01-01 00:00 $rel"
+      else echo "-rw-r--r-- 1 u g 1 2026-01-01 00:00 $rel"; fi
+    done ;;
+  *) exit 1 ;;
+esac
+"""
+
+
+@pytest.fixture
+def client(tmp_path, monkeypatch):
+    home = tmp_path / "hadoop_home"
+    (home / "bin").mkdir(parents=True)
+    stub = home / "bin" / "hadoop"
+    stub.write_text(STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    root = tmp_path / "hdfs_root"
+    root.mkdir()
+    monkeypatch.setenv("STUB_ROOT", str(root))
+    return HDFSClient(str(home)), root
+
+
+def test_degrades_with_typed_error_when_absent(monkeypatch, tmp_path):
+    monkeypatch.delenv("HADOOP_HOME", raising=False)
+    monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
+    c = HDFSClient()
+    assert not c.available()
+    with pytest.raises(EnforceError, match="no hadoop binary"):
+        c.ls("/data")
+
+
+def test_roundtrip_verbs(client, tmp_path):
+    c, root = client
+    assert c.available()
+    assert c.makedirs("models/a")
+    assert c.is_exist("models/a") and c.is_dir("models/a")
+    src = tmp_path / "w.bin"
+    src.write_text("weights")
+    assert c.upload("models/a/w.bin", str(src))
+    assert c.is_exist("models/a/w.bin")
+    assert sorted(c.ls("models")) == ["models/a"]
+    assert c.lsr("models") == ["models/a/w.bin"]
+    dst = tmp_path / "back.bin"
+    assert c.download("models/a/w.bin", str(dst))
+    assert dst.read_text() == "weights"
+    assert c.rename("models/a/w.bin", "models/a/w2.bin")
+    assert c.is_exist("models/a/w2.bin")
+    assert c.delete("models/a")
+    assert not c.is_exist("models/a")
+
+
+def test_multi_transfer_shards_by_trainer(client, tmp_path):
+    c, root = client
+    local = tmp_path / "shards"
+    local.mkdir()
+    for i in range(6):
+        (local / f"part-{i}").write_text(str(i))
+    up = multi_upload(c, "data", str(local), multi_processes=2)
+    assert len(up) == 6
+    # trainer 0 of 2 gets files 0,2,4 (stride sharding)
+    out0 = tmp_path / "t0"
+    got = multi_download(c, "data", str(out0), trainer_id=0, trainers=2,
+                         multi_processes=2)
+    assert len(got) == 3
+    all_files = sorted(os.listdir(out0))
+    assert all(f.startswith("part-") for f in all_files)

@@ -1,0 +1,60 @@
+#!/bin/bash
+# CI entry — the reference's paddle/scripts/paddle_build.sh role, sized
+# for this repo: native build, API freeze gate, tiered tests, wheel.
+#
+#   tools/ci.sh smoke    # native build + API gate + smoke tier (~2 min)
+#   tools/ci.sh full     # everything incl. the slow tier (~15-25 min)
+#   tools/ci.sh wheel    # build a wheel into dist/
+#
+# Exit code is the first failing stage's.
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+MODE="${1:-smoke}"
+
+stage() { echo; echo "=== [$1] ==="; }
+
+stage "native build"
+make -C paddle_tpu/native -s || exit $?
+
+stage "native unit tests"
+make -C paddle_tpu/native -s test || exit $?
+
+stage "API freeze gate"
+JAX_PLATFORMS=cpu python -c "
+import jax; jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, 'tools')
+import diff_api
+sys.exit(diff_api.main())
+" || exit $?
+
+case "$MODE" in
+  smoke)
+    stage "smoke tier (pytest -m smoke)"
+    python -m pytest tests/ -m smoke -q || exit $?
+    ;;
+  full)
+    stage "full suite"
+    python -m pytest tests/ -q || exit $?
+    stage "multichip dryrun (8-device CPU sim)"
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
+      || exit $?
+    stage "bench smoke"
+    python bench.py --platform cpu --smoke --steps 4 --batch-size 64 \
+      || exit $?
+    ;;
+  wheel)
+    stage "wheel"
+    python setup.py -q bdist_wheel 2>/dev/null || python -m pip wheel \
+      --no-deps -w dist . || exit $?
+    ls -la dist/
+    ;;
+  *)
+    echo "unknown mode: $MODE (smoke|full|wheel)" >&2
+    exit 2
+    ;;
+esac
+
+echo; echo "CI ($MODE) green"
