@@ -32,7 +32,7 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
         scale = q.shape[-1] ** -0.5
     if use_flash and mask is None and dropout_p == 0.0:
         flash = _get_flash()
-        if flash is not None and _flash_ok(q, k):
+        if flash is not None and _flash_ok(q, k, causal):
             return flash(q, k, v, causal=causal, scale=scale)
     return xla_attention(q, k, v, mask=mask, causal=causal,
                          dropout_p=dropout_p, dropout_key=dropout_key,
@@ -80,10 +80,19 @@ def _get_flash():
         return None
 
 
-def _flash_ok(q, k) -> bool:
+def _flash_ok(q, k, causal: bool = False) -> bool:
     """Flash kernel constraints: TPU backend, block-divisible seq lens,
-    supported head dim."""
+    supported head dim — and the autotuner's measured verdict when one
+    exists (tools/pallas_tune.py records use_flash=False for shape
+    buckets where the XLA fallback won on-chip)."""
     if jax.default_backend() not in ("tpu", "axon"):
         return False
     tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
-    return tq % 128 == 0 and tk % 128 == 0 and d in (64, 128, 256)
+    if not (tq % 128 == 0 and tk % 128 == 0 and d in (64, 128, 256)):
+        return False
+    from .pallas.tuning import attention_key, get_tuned
+
+    tuned = get_tuned(attention_key(tq, tk, d, causal))
+    if tuned is not None and not tuned.get("use_flash", True):
+        return False
+    return True

@@ -322,18 +322,35 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Blockwise attention over (batch, seq, heads, head_dim) inputs.
 
     Sequence lengths must divide the block sizes (shrunk automatically for
     short sequences). Differentiable (custom VJP, recompute backward).
+    Block sizes default to the autotuned table (ops/pallas/tuning.py,
+    written by tools/pallas_tune.py on real hardware) and fall back to
+    128x128.
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if scale is None:
         scale = d ** -0.5
+    if block_q is None or block_k is None:
+        from .tuning import attention_key, get_tuned
+
+        tuned = get_tuned(attention_key(tq, tk, d, causal)) or {}
+        # pow2 buckets can hold shapes the tuned block doesn't divide
+        # (e.g. 384 in the 512 bucket with block 256) — fall back to the
+        # defaults rather than trip the divisibility error below
+        tq_bq, tk_bk = tuned.get("block_q"), tuned.get("block_k")
+        if block_q is None:
+            block_q = (tq_bq if tq_bq and tq % min(tq_bq, tq) == 0
+                       else DEFAULT_BLOCK_Q)
+        if block_k is None:
+            block_k = (tk_bk if tk_bk and tk % min(tk_bk, tk) == 0
+                       else DEFAULT_BLOCK_K)
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     if tq % block_q or tk % block_k:

@@ -1,0 +1,103 @@
+"""Pallas tuning-table tests (ops/pallas/tuning.py + the
+tools/pallas_tune.py contract) — table lookup/persist, kernel
+consultation, and the measured use_flash dispatch override.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import tuning
+
+
+@pytest.fixture
+def table(tmp_path, monkeypatch):
+    path = tmp_path / "tuned_blocks.json"
+    monkeypatch.setattr(tuning, "_TABLE_PATH", str(path))
+    tuning.reset_cache()
+    yield path
+    tuning.reset_cache()
+
+
+def test_keys_bucket_by_pow2_and_device(table, monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
+    k1 = tuning.attention_key(128, 128, 64, True, kind="v5e")
+    k2 = tuning.attention_key(100, 120, 64, True, kind="v5e")
+    assert k1 == k2  # same pow2 bucket
+    assert tuning.attention_key(256, 256, 64, True, kind="v5e") != k1
+    assert tuning.attention_key(128, 128, 64, True, kind="v4") != k1
+    assert "causal" in k1
+    assert tuning.attention_key(128, 128, 64, False, kind="v5e") != k1
+
+
+def test_set_get_persist_roundtrip(table):
+    key = tuning.matmul_key(1024, 1024, 768, kind="v5e")
+    entry = {"tile_m": 256, "tile_n": 128, "tile_k": 512}
+    tuning.set_tuned(key, entry)
+    assert tuning.get_tuned(key) == entry
+    # persisted to disk and reloadable after a cache reset
+    tuning.reset_cache()
+    assert tuning.get_tuned(key) == entry
+    assert json.loads(table.read_text())[key] == entry
+
+
+def test_flash_attention_consults_table(table, monkeypatch):
+    """Tuned block sizes flow into the kernel call; an entry whose block
+    doesn't divide the actual seq len falls back to the 128 defaults
+    instead of raising (pow2 buckets hold non-divisible shapes)."""
+    import importlib
+
+    FA = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    calls = []
+    real = FA._flash
+
+    def spy(qf, kf, vf, causal, scale, bq, bk, interpret):
+        calls.append((bq, bk))
+        return real(qf, kf, vf, causal, scale, bq, bk, interpret)
+
+    monkeypatch.setattr(FA, "_flash", spy)
+    q = jnp.zeros((1, 128, 2, 64), jnp.float32)
+
+    key = tuning.attention_key(128, 128, 64, False)
+    tuning.set_tuned(key, {"block_q": 64, "block_k": 64}, persist=False)
+    FA.flash_attention(q, q, q)
+    assert calls[-1] == (64, 64)  # tuned blocks used
+
+    tuning.set_tuned(key, {"block_q": 96, "block_k": 96}, persist=False)
+    FA.flash_attention(q, q, q)
+    assert calls[-1] == (128, 128)  # 128 % 96 != 0 -> defaults
+
+    FA.flash_attention(q, q, q, block_q=32, block_k=32)
+    assert calls[-1] == (32, 32)  # explicit args override the table
+
+
+def test_use_flash_false_routes_to_xla(table, monkeypatch):
+    """A measured use_flash=False verdict forces the XLA fallback even on
+    a TPU backend (the autotuner's dispatch contract)."""
+    from paddle_tpu.ops import attention as A
+
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+    q = jnp.zeros((1, 128, 2, 64), jnp.float32)
+    key = tuning.attention_key(128, 128, 64, False)
+    tuning.set_tuned(key, {"use_flash": False}, persist=False)
+    assert not A._flash_ok(q, q, False)
+    tuning.set_tuned(key, {"use_flash": True, "block_q": 128,
+                           "block_k": 128}, persist=False)
+    assert A._flash_ok(q, q, False)
+
+
+def test_tune_tool_refuses_cpu(table):
+    import subprocess
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "pallas_tune.py"),
+         "--dry-run", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+    assert "refusing to tune" in r.stderr
