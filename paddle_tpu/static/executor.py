@@ -72,8 +72,49 @@ def _exec_opnodes(nodes, env: Dict[str, Any]) -> Dict[str, Any]:
     return env
 
 
-def _exec_program(prog: Program, env: Dict[str, Any]) -> Dict[str, Any]:
+def prune_for_fetch(prog: Program, fetch_names) -> Tuple[set, set]:
+    """Backward-reachability slice (reference: framework/prune.cc +
+    executor.py feed/fetch pruning): the node indices needed to produce
+    ``fetch_names`` and the feed vars that slice actually consumes.
+
+    Writes to PERSISTABLE vars are live roots regardless of the fetch
+    list — optimizer updates and batch-norm running stats are the
+    program's training effects and must run whenever recorded (matching
+    the reference Executor, which interprets the whole program; pruning
+    only drops pure dead compute, e.g. the loss ops of a test clone when
+    fetching an intermediate activation)."""
+    persistable = set(prog.persistable_names())
+    needed = set(fetch_names)
+    for node in prog.nodes:
+        if not isinstance(node, _GradNode):
+            needed.update(o for o in node.outputs if o in persistable)
+    keep = set()
+    for idx in range(len(prog.nodes) - 1, -1, -1):
+        node = prog.nodes[idx]
+        if isinstance(node, _GradNode):
+            if not any(o in needed for o in node.outputs):
+                continue
+            keep.add(idx)
+            needed.add(node.loss_name)
+            needed.update(node.param_names)
+            for p in prog.nodes[:node.prefix_len]:
+                if not isinstance(p, _GradNode):
+                    needed.update(p.inputs)
+        else:
+            if not any(o in needed for o in node.outputs):
+                continue
+            keep.add(idx)
+            needed.update(node.inputs)
+    feeds = {n for n in needed
+             if n in prog.vars and prog.vars[n].is_feed}
+    return keep, feeds
+
+
+def _exec_program(prog: Program, env: Dict[str, Any],
+                  include: Optional[set] = None) -> Dict[str, Any]:
     for i, node in enumerate(prog.nodes):
+        if include is not None and i not in include:
+            continue
         if isinstance(node, _GradNode):
             prefix = prog.nodes[:node.prefix_len]
             base = dict(env)
@@ -116,6 +157,7 @@ class Executor:
         # recompilation management, SURVEY §7 "hard parts" — unbounded
         # shape churn must evict, not accumulate    (scope_guard works ^)
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._prune_cache: Dict[Tuple, Tuple] = {}
 
     @property
     def scope(self) -> Scope:
@@ -195,15 +237,31 @@ class Executor:
         for k in feed_vals:
             enforce(k in program.vars and program.vars[k].is_feed,
                     "feed %s is not a data() var of this program", k)
-        # every data() var consumed by some node must be fed — catch it here
-        # with a named error instead of a bare KeyError from inside tracing
-        consumed = {n for node in program.nodes
-                    if isinstance(node, _OpNode) for n in node.inputs}
-        unfed = sorted(n for n in consumed
-                       if n in program.vars and program.vars[n].is_feed
-                       and n not in feed_vals)
-        enforce(not unfed, "missing feeds %s: every data() var the program "
-                "reads must appear in `feed`", unfed)
+        # prune to the fetch slice (reference: framework/prune.cc) — only
+        # data() vars that slice consumes must be fed; catch gaps here
+        # with a named error instead of a bare KeyError from inside
+        # tracing. No fetches = run the whole program (train-loop form).
+        # Memoized: the sweep is determined by (program, version, fetch)
+        # and must not run per step in the train-loop hot path.
+        pkey = (id(program), program.version, fetch_names)
+        cached = self._prune_cache.get(pkey)
+        if cached is not None:
+            keep, used_feeds = cached
+        else:
+            if fetch_names:
+                keep, used_feeds = prune_for_fetch(program, fetch_names)
+            else:
+                keep = None
+                used_feeds = {
+                    n for node in program.nodes
+                    if isinstance(node, _OpNode) for n in node.inputs
+                    if n in program.vars and program.vars[n].is_feed}
+            if len(self._prune_cache) > 256:
+                self._prune_cache.clear()
+            self._prune_cache[pkey] = (keep, used_feeds)
+        unfed = sorted(n for n in used_feeds if n not in feed_vals)
+        enforce(not unfed, "missing feeds %s: every data() var the fetched "
+                "slice reads must appear in `feed`", unfed)
         persist = program.persistable_names()
         params = {n: self.scope.get(n) for n in persist}
         consts = dict(getattr(program, "_const_values", {}))
@@ -216,11 +274,12 @@ class Executor:
             self._cache.move_to_end(key)  # LRU touch
         if step is None:
             def step(params, feed_vals, _prog=program, _consts=consts,
-                     _fetch=fetch_names, _persist=tuple(persist)):
+                     _fetch=fetch_names, _persist=tuple(persist),
+                     _keep=keep):
                 env = dict(_consts)
                 env.update(params)
                 env.update(feed_vals)
-                env = _exec_program(_prog, env)
+                env = _exec_program(_prog, env, include=_keep)
                 return ([env[f] for f in _fetch],
                         {p: env[p] for p in _persist})
 

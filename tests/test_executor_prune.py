@@ -1,0 +1,82 @@
+"""Fetch-slice pruning tests (reference: framework/prune.cc + the
+Executor's feed/fetch contract): fetching an intermediate requires only
+the feeds its slice reads, dead compute drops out of the compiled step,
+and — critically — persistable writes (optimizer updates, BN stats)
+always run, fetched or not.
+"""
+
+import numpy as np
+
+import paddle_tpu.layers as pd
+from paddle_tpu import static
+from paddle_tpu.static.executor import prune_for_fetch
+
+
+def _mnist_train_prog():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = pd.data("x", shape=[-1, 8], dtype="float32")
+        label = pd.data("label", shape=[-1], dtype="int64")
+        h = static.layers.fc(x, 8, act="relu")
+        logits = static.layers.fc(h, 4)
+        loss = static.layers.mean(
+            static.layers.softmax_with_cross_entropy(logits, label))
+        static.SGD(0.5).minimize(loss)
+    return prog, h, logits, loss
+
+
+def test_fetch_intermediate_needs_only_its_feeds():
+    """On the inference clone (no optimizer effects), fetching an
+    activation requires only the feeds its slice reads. On the TRAIN
+    program the optimizer is a live effect, so the label stays required
+    — reference semantics: the Executor runs the whole program."""
+    prog, h, logits, loss = _mnist_train_prog()
+    test_prog = prog.clone(for_test=True)
+    exe = static.Executor()
+    exe.scope = static.Scope()
+    x = np.ones((4, 8), np.float32)
+    out = exe.run(test_prog, feed={"x": x},
+                  fetch_list=[h.name])
+    assert out[0].shape == (4, 8)
+
+
+def test_persistable_writes_survive_pruning():
+    """Fetching only the loss must still run the optimizer update — the
+    reference Executor interprets the whole program; pruning may drop
+    dead compute only."""
+    prog, h, logits, loss = _mnist_train_prog()
+    exe = static.Executor()
+    exe.scope = static.Scope()
+    exe.run_startup(prog)
+    pname = [n for n in prog.param_names() if "fc_w" in n][0]
+    before = np.asarray(exe.scope.get(pname)).copy()
+    x = np.ones((4, 8), np.float32)
+    y = np.zeros((4,), np.int64)
+    losses = [float(exe.run(prog, feed={"x": x, "label": y},
+                            fetch_list=[loss])[0]) for _ in range(5)]
+    after = np.asarray(exe.scope.get(pname))
+    assert not np.allclose(before, after), "optimizer update was pruned"
+    assert losses[-1] < losses[0], "training did not progress"
+
+
+def test_prune_drops_dead_nodes():
+    prog, h, logits, loss = _mnist_train_prog()
+    # train program: the optimizer effect keeps the whole chain (incl.
+    # the label feed) live even when fetching an activation
+    keep, feeds = prune_for_fetch(prog, [h.name])
+    assert "x" in feeds and "label" in feeds
+    # inference clone: no effects — the loss tail is dead for this fetch
+    test_prog = prog.clone(for_test=True)
+    keep, feeds = prune_for_fetch(test_prog, [h.name])
+    assert "x" in feeds and "label" not in feeds
+    assert len(keep) < len(test_prog.nodes)
+
+
+def test_test_clone_prunes_loss_tail():
+    prog, h, logits, loss = _mnist_train_prog()
+    test_prog = prog.clone(for_test=True)
+    keep, feeds = prune_for_fetch(test_prog, [logits.name])
+    assert "label" not in feeds
+    # the clone has no optimizer (no persistable writes), so the CE/mean
+    # nodes after logits are all dead for this fetch
+    assert len(keep) < len(test_prog.nodes)

@@ -144,13 +144,12 @@ def test_mt_decoder_matches_manual_recurrence():
     trg = np.array([[0, 3, 4], [0, 5, 0]], np.int64)
     trg_lens = np.array([3, 2], np.int32)
 
+    # no label feeds: fetch-slice pruning (reference: framework/prune.cc)
+    # drops the CE loss ops, so only the feeds the fetched slice reads
+    # are required
     feed = {
         "src_word_id": src, "src_word_id@LEN": src_lens,
         "target_language_word": trg, "target_language_word@LEN": trg_lens,
-        # the clone still records the CE loss ops, which read the label
-        # feed (the executor compiles the whole clone; dummy is fine)
-        "target_language_next_word": trg,
-        "target_language_next_word@LEN": trg_lens,
     }
     # inference clone: the train program's optimizer ops would mutate the
     # weights on every run (reference clone(for_test=True) semantics)
