@@ -88,7 +88,9 @@ class TrainLoop:
                  checkpoint_every: int = 1000, max_to_keep: int = 5,
                  nan_policy: str = "raise",
                  watchdog_timeout_s: Optional[float] = None,
-                 on_stall: Optional[Callable] = None):
+                 on_stall: Optional[Callable] = None,
+                 max_recoveries: int = 0,
+                 recoverable: tuple = (RuntimeError, OSError)):
         enforce(nan_policy in ("raise", "skip", "off"),
                 "nan_policy must be raise|skip|off, got %s", nan_policy)
         self.trainer = trainer
@@ -99,8 +101,27 @@ class TrainLoop:
         self.step = 0
         self._watchdog = (Watchdog(watchdog_timeout_s, on_stall)
                           if watchdog_timeout_s else None)
+        # elastic recovery (the SURVEY §5.3 design-add beyond the
+        # reference's none): a step failing with a ``recoverable`` error
+        # (XLA device/runtime faults surface as RuntimeError) rolls the
+        # trainer back to the latest snapshot and continues, at most
+        # ``max_recoveries`` times per run() call. Deterministic errors
+        # (EnforceError and other RuntimeError subclasses that mean
+        # "bug", not "fault") always propagate.
+        enforce(max_recoveries >= 0, "max_recoveries must be >= 0")
+        self.max_recoveries = max_recoveries
+        self.recoverable = tuple(recoverable)
+        self._recoveries_this_run = 0
+        self._faulted = False
         self.history: Dict[str, Any] = {"resumed_from": None,
-                                        "skipped_steps": []}
+                                        "skipped_steps": [],
+                                        "recoveries": []}
+
+    def _is_recoverable(self, e: BaseException) -> bool:
+        if isinstance(e, (EnforceError, NotImplementedError,
+                          RecursionError)):
+            return False  # deterministic bug/config errors, not faults
+        return isinstance(e, self.recoverable)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -133,16 +154,50 @@ class TrainLoop:
             resume: bool = True,
             on_step: Optional[Callable[[int, Any, Dict], None]] = None):
         """Train until ``num_steps`` (global, including resumed) or data
-        exhaustion. Returns the final step count."""
+        exhaustion. Returns the final step count — which can end below
+        ``num_steps`` after an elastic recovery, since the data stream
+        is not replayable (see history["recoveries"])."""
         if resume:
             self.maybe_resume()
+        self._recoveries_this_run = 0
+        self._faulted = False
         if self._watchdog:
             self._watchdog.start()
         try:
             for batch in batches:
                 if num_steps is not None and self.step >= num_steps:
                     break
-                loss, metrics = self.trainer.train_step(batch)
+                try:
+                    loss, metrics = self.trainer.train_step(batch)
+                except Exception as e:
+                    if not self._is_recoverable(e) or \
+                            self._recoveries_this_run >= \
+                            self.max_recoveries:
+                        self._faulted = True
+                        raise
+                    # an in-flight async snapshot may be newer than the
+                    # last fully-renamed one — don't over-rewind
+                    self.manager.wait_until_finished()
+                    latest = self.manager.latest_step()
+                    if latest is None:
+                        # nothing to roll back to: with donated buffers
+                        # the failed dispatch may have consumed the live
+                        # state, so continuing would be undefined
+                        self._faulted = True
+                        raise
+                    # slice-failure recovery: roll back to the latest
+                    # snapshot and keep training (any process can do the
+                    # same and rejoin — restartable-step elasticity).
+                    # NOTE: the data stream is not rewound — batches
+                    # consumed between the snapshot and the fault are
+                    # skipped, so run() may end below num_steps.
+                    self._recoveries_this_run += 1
+                    self.history["recoveries"].append(
+                        {"step": self.step, "rolled_back_to": latest,
+                         "error": repr(e)})
+                    self.trainer.restore_checkpoint(self.manager, latest)
+                    self.step = latest
+                    continue
                 if not self._guard(loss):
                     continue
                 self.step += 1
@@ -172,7 +227,11 @@ class TrainLoop:
             self.manager.wait_until_finished()
         except BaseException as e:
             deferred = e
-        if self.step > 0 and self.step not in self.manager.all_steps():
+        # never snapshot post-fault state: after an unrecovered device
+        # fault the live buffers may be invalid (donation) or poisoned —
+        # the next run resumes from the last GOOD checkpoint instead
+        if self.step > 0 and not self._faulted and \
+                self.step not in self.manager.all_steps():
             self.manager.save(self.step, self.trainer.state())
         self.manager.wait_until_finished()
         if deferred is not None:

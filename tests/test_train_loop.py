@@ -151,3 +151,92 @@ def test_trainer_train_steps_matches_single_steps():
     for k in t1.params:
         np.testing.assert_allclose(np.asarray(t1.params[k]),
                                    np.asarray(t2.params[k]), atol=1e-6)
+
+
+class TestElasticRecovery:
+    """Slice-failure recovery (SURVEY §5.3 design-add): a step failing
+    with a device/runtime error rolls back to the latest snapshot and
+    training continues, bounded by max_recoveries."""
+
+    def _flaky(self, fail_at, exc=RuntimeError):
+        tr = make_trainer()
+        real = tr.train_step
+        state = {"calls": 0}
+
+        def step(batch):
+            state["calls"] += 1
+            if state["calls"] in fail_at:
+                raise exc("simulated device fault")
+            return real(batch)
+
+        tr.train_step = step
+        return tr, state
+
+    def test_recovers_from_transient_fault(self, tmp_path):
+        tr, _ = self._flaky(fail_at={5})
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=2,
+                         max_recoveries=1)
+        n = loop.run(batches(12), num_steps=8)
+        assert n == 8
+        assert len(loop.history["recoveries"]) == 1
+        rec = loop.history["recoveries"][0]
+        assert "simulated device fault" in rec["error"]
+        # rolled back to the latest snapshot (step 4 checkpoint)
+        assert rec["step"] == 4
+
+    def test_recovery_budget_exhausted_reraises(self, tmp_path):
+        tr, _ = self._flaky(fail_at={3, 4, 5, 6, 7, 8, 9})
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=1,
+                         max_recoveries=2)
+        with pytest.raises(RuntimeError, match="simulated device fault"):
+            loop.run(batches(12), num_steps=10)
+        assert len(loop.history["recoveries"]) == 2
+
+    def test_zero_budget_fails_fast(self, tmp_path):
+        tr, _ = self._flaky(fail_at={2})
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=1)
+        with pytest.raises(RuntimeError):
+            loop.run(batches(6), num_steps=6)
+
+    def test_unrecoverable_error_types_propagate(self, tmp_path):
+        tr, _ = self._flaky(fail_at={2}, exc=ValueError)
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=1,
+                         max_recoveries=3)
+        with pytest.raises(ValueError):
+            loop.run(batches(6), num_steps=6)
+
+    def test_enforce_errors_never_recovered(self, tmp_path):
+        from paddle_tpu.core.enforce import EnforceError
+
+        tr, _ = self._flaky(fail_at={2}, exc=EnforceError)
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=1,
+                         max_recoveries=5)
+        with pytest.raises(EnforceError):
+            loop.run(batches(6), num_steps=6)
+        assert loop.history["recoveries"] == []
+
+    def test_fault_before_first_checkpoint_reraises(self, tmp_path):
+        tr, _ = self._flaky(fail_at={1})
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=100,
+                         max_recoveries=5)
+        with pytest.raises(RuntimeError):
+            loop.run(batches(6), num_steps=6)
+
+    def test_no_post_fault_snapshot(self, tmp_path):
+        """close() after an unrecovered fault must NOT persist the
+        faulted state; the next run resumes from the last good step."""
+        tr, _ = self._flaky(fail_at={6})
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=2)
+        with pytest.raises(RuntimeError):
+            loop.run(batches(10), num_steps=10)
+        assert loop.manager.latest_step() == 4  # last GOOD snapshot
+
+    def test_recovery_budget_is_per_run(self, tmp_path):
+        tr, _ = self._flaky(fail_at={3, 8})
+        loop = TrainLoop(tr, str(tmp_path), checkpoint_every=1,
+                         max_recoveries=1)
+        loop.run(batches(5), num_steps=4)
+        assert len(loop.history["recoveries"]) == 1
+        # second run() gets a fresh budget despite the recorded history
+        loop.run(batches(5), num_steps=8)
+        assert len(loop.history["recoveries"]) == 2

@@ -1,0 +1,54 @@
+#!/bin/bash
+# One-command on-chip perf session (run when the accelerator is
+# reachable — HANDOFF.md runbook): all 10 bench models with MFU, the
+# steps-per-call and precision sweeps on the headline models, the
+# Pallas autotuner, and the hot-op microbench. Writes JSON lines to
+# stdout and a full log to bench_all.log; BENCH_HISTORY.json records
+# accelerator bests automatically.
+#
+#   tools/bench_all.sh            # full session (~30-60 min on-chip)
+#   tools/bench_all.sh quick      # one pass over the models, no sweeps
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+MODE="${1:-full}"
+LOG="bench_all.log"
+: > "$LOG"
+
+run() { echo "\$ $*" | tee -a "$LOG"; "$@" 2>>"$LOG" | tee -a "$LOG"; }
+
+MODELS="mnist_mlp alexnet googlenet stacked_lstm vgg16 se_resnext50 \
+resnet50 bert_base transformer_nmt deepfm"
+
+echo "== model pass (bf16 defaults) ==" | tee -a "$LOG"
+for m in $MODELS; do
+  run python bench.py --model "$m"
+done
+
+if [ "$MODE" = "full" ]; then
+  echo "== sweeps (headline models) ==" | tee -a "$LOG"
+  for spc in 1 4 8; do
+    run python bench.py --model mnist_mlp --steps-per-call "$spc"
+  done
+  run python bench.py --model bert_base --no-fused-ce
+  run python bench.py --model bert_base --amp float32
+  run python bench.py --model transformer_nmt --no-fused-ce
+  run python bench.py --model resnet50 --layout NCHW
+  run python bench.py --model resnet50 --amp float32
+
+  echo "== pallas autotune ==" | tee -a "$LOG"
+  run python tools/pallas_tune.py
+
+  echo "== re-run attention-bound models with the tuned table ==" \
+    | tee -a "$LOG"
+  run python bench.py --model bert_base
+  run python bench.py --model transformer_nmt
+
+  echo "== hot-op microbench ==" | tee -a "$LOG"
+  run python tools/op_bench.py --config tools/op_bench_cases.json
+fi
+
+echo "== recorded history ==" | tee -a "$LOG"
+cat BENCH_HISTORY.json 2>/dev/null | tee -a "$LOG"
+echo "done; full log in $LOG" | tee -a "$LOG"
