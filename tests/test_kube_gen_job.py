@@ -1,0 +1,42 @@
+"""k8s job-generator tests (reference: benchmark/fluid/kube_gen_job.py
+role): the emitted manifests carry the same env protocol RoleMaker and
+paddle_tpu.launch use, one indexed pod per host, and TPU node
+selectors."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gen(*extra):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kube_gen_job.py"),
+         *extra], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    return r.stdout
+
+
+def test_manifest_env_protocol_matches_rolemaker():
+    out = _gen("--jobname", "bert-pt", "--hosts", "4",
+               "--tpu-topology", "4x4", "--entry", "python train.py")
+    # the RoleMaker/launch env contract (fleet.py:35)
+    assert "PADDLE_TRAINER_ID=$JOB_COMPLETION_INDEX" in out
+    assert "PADDLE_TRAINERS_NUM=4" in out
+    assert "JAX_COORDINATOR_ADDRESS=bert-pt-0.bert-pt:8476" in out
+    # indexed completion: one rank per pod
+    assert "completionMode: Indexed" in out
+    assert "completions: 4" in out and "parallelism: 4" in out
+    # TPU scheduling
+    assert "gke-tpu-topology: 4x4" in out
+    assert 'google.com/tpu: "4"' in out
+    # headless service fronts pod-0 DNS
+    assert "clusterIP: None" in out
+
+
+def test_invalid_hosts_rejected():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kube_gen_job.py"),
+         "--hosts", "0"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 2

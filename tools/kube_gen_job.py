@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Generate Kubernetes manifests for multi-host TPU training jobs —
+the distributed-bench-launcher capability (reference:
+benchmark/fluid/kube_gen_job.py:1, which emitted pserver/trainer
+ReplicaSet+Job YAML wired by PADDLE_* env vars).
+
+TPU-native shape: no parameter servers — one indexed Job (one pod per
+host) over a TPU pod slice. Rank discovery reuses the exact env
+protocol of ``paddle_tpu.launch`` / ``fleet.RoleMaker``
+(PADDLE_TRAINER_ID from the completion index, JAX_COORDINATOR_ADDRESS =
+pod 0 via a headless Service), so the same training script runs under
+kubectl, the local launcher, or a hand-rolled Popen unchanged.
+
+Usage:
+  python tools/kube_gen_job.py --jobname bert-pretrain \
+      --hosts 4 --tpu-topology 4x4 --tpu-accelerator v5litepod-16 \
+      --image my-registry/paddle-tpu:latest \
+      --entry "python -u train.py --model bert_base" > job.yaml
+  kubectl apply -f job.yaml
+
+No kubernetes/yaml dependency: manifests are rendered as plain text.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+HEADLESS_SVC = """\
+apiVersion: v1
+kind: Service
+metadata:
+  name: {jobname}
+  labels: {{app: {jobname}}}
+spec:
+  clusterIP: None
+  selector:
+    job-name: {jobname}
+  ports:
+    - name: coordinator
+      port: {port}
+"""
+
+JOB = """\
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {jobname}
+  labels: {{app: {jobname}}}
+spec:
+  completions: {hosts}
+  parallelism: {hosts}
+  completionMode: Indexed
+  backoffLimit: {backoff}
+  template:
+    metadata:
+      labels: {{job-name: {jobname}}}
+    spec:
+      restartPolicy: Never
+      subdomain: {jobname}
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: {accelerator}
+        cloud.google.com/gke-tpu-topology: {topology}
+      containers:
+        - name: worker
+          image: {image}
+          command: ["/bin/sh", "-c"]
+          args:
+            - |
+              export PADDLE_TRAINER_ID=$JOB_COMPLETION_INDEX
+              export JAX_PROCESS_ID=$JOB_COMPLETION_INDEX
+              export PADDLE_TRAINERS_NUM={hosts}
+              export JAX_NUM_PROCESSES={hosts}
+              export JAX_COORDINATOR_ADDRESS={jobname}-0.{jobname}:{port}
+              {entry}
+          ports:
+            - containerPort: {port}
+          resources:
+            requests:
+              google.com/tpu: "{chips_per_host}"
+              cpu: "{cpu}"
+              memory: {memory}Gi
+            limits:
+              google.com/tpu: "{chips_per_host}"
+              memory: {memory}Gi
+"""
+
+
+def render(args) -> str:
+    docs = [
+        HEADLESS_SVC.format(jobname=args.jobname, port=args.port),
+        JOB.format(jobname=args.jobname, hosts=args.hosts,
+                   backoff=args.backoff, image=args.image,
+                   accelerator=args.tpu_accelerator,
+                   topology=args.tpu_topology, entry=args.entry,
+                   port=args.port, chips_per_host=args.chips_per_host,
+                   cpu=args.cpu, memory=args.memory),
+    ]
+    return "---\n".join(docs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="generate k8s manifests for a multi-host TPU job")
+    ap.add_argument("--jobname", default="paddletpu-job",
+                    help="unique job name (also the headless service)")
+    ap.add_argument("--hosts", type=int, default=1,
+                    help="number of worker hosts (pods)")
+    ap.add_argument("--chips-per-host", type=int, default=4,
+                    help="TPU chips per host (v5e hosts have 4)")
+    ap.add_argument("--tpu-accelerator", default="tpu-v5-lite-podslice",
+                    help="GKE accelerator node-selector value")
+    ap.add_argument("--tpu-topology", default="2x2",
+                    help="GKE TPU topology node-selector value")
+    ap.add_argument("--image", default="paddle-tpu:latest")
+    ap.add_argument("--entry", default="python -u train.py",
+                    help="command each worker runs")
+    ap.add_argument("--port", type=int, default=8476,
+                    help="coordination-service port on pod 0")
+    ap.add_argument("--cpu", type=int, default=8, help="CPUs per pod")
+    ap.add_argument("--memory", type=int, default=64,
+                    help="memory (GiB) per pod")
+    ap.add_argument("--backoff", type=int, default=0,
+                    help="k8s backoffLimit (elastic retry at the job "
+                    "level; in-process recovery is TrainLoop's job)")
+    args = ap.parse_args(argv)
+    if args.hosts < 1:
+        print("--hosts must be >= 1", file=sys.stderr)
+        return 2
+    print(render(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
