@@ -35,8 +35,36 @@ def test_manifest_env_protocol_matches_rolemaker():
     assert "clusterIP: None" in out
 
 
-def test_invalid_hosts_rejected():
+def _fails(*extra):
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "kube_gen_job.py"),
-         "--hosts", "0"], capture_output=True, text=True, timeout=60)
-    assert r.returncode == 2
+         *extra], capture_output=True, text=True, timeout=60)
+    return r.returncode, r.stderr
+
+
+def test_invalid_hosts_rejected():
+    rc, _ = _fails("--hosts", "0")
+    assert rc == 2
+
+
+def test_non_dns_jobname_rejected():
+    rc, err = _fails("--jobname", "Bert_PT")
+    assert rc == 2 and "DNS-1123" in err
+
+
+def test_topology_host_mismatch_rejected():
+    # 2x2 slice = 4 chips = 1 host at 4 chips/host; asking for 2 pods
+    # would deadlock scheduling
+    rc, err = _fails("--hosts", "2", "--tpu-topology", "2x2")
+    assert rc == 2 and "does not match topology" in err
+
+
+def test_multiline_entry_stays_in_block_scalar():
+    out = _gen("--hosts", "1", "--tpu-topology", "2x2",
+               "--entry", "set -e\npython train.py")
+    # both lines of the entry remain inside the args block scalar
+    lines = out.splitlines()
+    i = next(n for n, l in enumerate(lines) if "set -e" in l)
+    assert lines[i].startswith(" " * 14)
+    assert lines[i + 1].strip() == "python train.py"
+    assert lines[i + 1].startswith(" " * 14)

@@ -24,7 +24,9 @@ No kubernetes/yaml dependency: manifests are rendered as plain text.
 from __future__ import annotations
 
 import argparse
+import re
 import sys
+import textwrap
 
 HEADLESS_SVC = """\
 apiVersion: v1
@@ -127,6 +129,29 @@ def main(argv=None) -> int:
     if args.hosts < 1:
         print("--hosts must be >= 1", file=sys.stderr)
         return 2
+    if not re.fullmatch(r"[a-z0-9]([-a-z0-9]{0,51}[a-z0-9])?",
+                        args.jobname):
+        print(f"--jobname {args.jobname!r} is not DNS-1123 (lowercase "
+              "alphanumerics and '-', <=53 chars — it names the Job, the "
+              "Service, and the coordinator hostname)", file=sys.stderr)
+        return 2
+    # hosts must agree with the slice topology: a v5e host carries
+    # chips-per-host chips, so topology_product / chips_per_host pods
+    # schedule — anything else emits a job that can never fully place
+    dims = re.fullmatch(r"(\d+)x(\d+)(?:x(\d+))?", args.tpu_topology)
+    if dims:
+        chips = 1
+        for d in dims.groups():
+            chips *= int(d) if d else 1
+        want = max(1, chips // args.chips_per_host)
+        if want != args.hosts:
+            print(f"--hosts {args.hosts} does not match topology "
+                  f"{args.tpu_topology} ({chips} chips / "
+                  f"{args.chips_per_host} per host = {want} hosts); the "
+                  "job would deadlock at scheduling", file=sys.stderr)
+            return 2
+    # multi-line entries must stay inside the block scalar's indentation
+    args.entry = textwrap.indent(args.entry, " " * 14).lstrip()
     print(render(args))
     return 0
 
