@@ -77,11 +77,17 @@ def load_persistables(executor: Executor, dirname: str,
 
 def save_inference_model(dirname: str, feed_target_names: Sequence[str],
                          fetch_targets: Sequence[Var], executor: Executor,
-                         main_program: Optional[Program] = None) -> None:
+                         main_program: Optional[Program] = None,
+                         example_feeds: Optional[dict] = None) -> None:
     """reference: io.py save_inference_model:898 — prune to feed→fetch and
     export. Params stay *inputs* of the exported module (shipped alongside
     in the .npz), so the artifact is weight-swappable like the reference's
-    __model__ + separate param files."""
+    __model__ + separate param files.
+
+    ``example_feeds`` (name → array or shape): concrete shapes used when
+    the program doesn't trace with symbolic dims (control-flow-heavy
+    programs) — the fallback then fixes the artifact to THESE shapes
+    instead of a placeholder batch of 8."""
     from .program import default_main_program
 
     program = main_program or default_main_program()
@@ -140,12 +146,18 @@ def save_inference_model(dirname: str, feed_target_names: Sequence[str],
     except Exception:
         if not polymorphic:
             raise
-        # some recorded op doesn't trace symbolically — fall back to a
-        # fixed batch and say so in the manifest rather than pretending
+        # some recorded op doesn't trace symbolically — fall back to
+        # fixed shapes (the caller's example_feeds when given) and say so
+        # in the manifest rather than pretending
         polymorphic = False
         for n in list(feed_specs):
             v = program.vars[n]
-            shape = tuple(8 if d == -1 else d for d in v.shape)
+            ex = (example_feeds or {}).get(n)
+            if ex is not None:
+                shape = tuple(np.shape(ex)) if not isinstance(
+                    ex, (tuple, list)) else tuple(ex)
+            else:
+                shape = tuple(8 if d == -1 else d for d in v.shape)
             feed_specs[n] = jax.ShapeDtypeStruct(shape, v.dtype)
         exported = jax.export.export(jax.jit(infer_fn))(param_specs,
                                                         feed_specs)

@@ -248,3 +248,26 @@ def test_mt_greedy_decode_while():
                                                            np.int32)},
                    fetch_list=[ids])[0]
     np.testing.assert_array_equal(out, out2)
+
+
+def test_greedy_decode_exports_to_serving_artifact(tmp_path):
+    """The While-loop decode program serializes through the StableHLO
+    artifact (control flow in the serving format) and reloads bit-exact
+    (reference: io.py save_inference_model:898 over a program containing
+    while_op sub-blocks)."""
+    prog, ids = _greedy_decode_program()
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    src = np.array([[3, 4, 5], [6, 7, 0]], np.int64)
+    feed = {"src_word_id": src,
+            "src_word_id@LEN": np.array([3, 2], np.int32)}
+    ref = exe.run(prog, feed=feed, fetch_list=[ids])[0]
+
+    d = str(tmp_path / "decode_artifact")
+    static.save_inference_model(
+        d, ["src_word_id", "src_word_id@LEN"], [ids], exe,
+        main_program=prog, example_feeds=feed)
+    pred = static.load_inference_model(d)
+    out = pred.run(feed)
+    got = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    np.testing.assert_array_equal(got, np.asarray(ref))
