@@ -34,6 +34,27 @@ def quantile_boundaries(lengths: Sequence[int], num_buckets: int,
     return out
 
 
+def round_to_bucket(n: int, buckets) -> int:
+    """Round a length UP to its bucket boundary — the single source of
+    boundary semantics shared by bucket_by_length and DataFeeder's
+    padded-sequence path. ``buckets``: "pow2" rounds to the next power
+    of two; an ascending list picks the first boundary >= n; a length
+    beyond the last boundary returns n unchanged (exact padding — the
+    caller decides whether that's a drop, like bucket_by_length, or an
+    accepted recompile, like the feeder)."""
+    if buckets is None:
+        return n
+    if buckets == "pow2":
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+    for bound in buckets:
+        if n <= bound:
+            return int(bound)
+    return n
+
+
 def pad_to(sample: np.ndarray, length: int, pad_value=0) -> np.ndarray:
     """Pad axis 0 of one sample to ``length``."""
     sample = np.asarray(sample)

@@ -49,6 +49,26 @@ class DataFeeder:
         self.dtypes = dtypes
         self.sharding = sharding
         self.place = place
+        # recompilation management (SURVEY §7 hard part): pad ragged
+        # sequence columns UP to a bucket boundary instead of the exact
+        # batch max, so distinct batches share compiled shapes. None =
+        # exact max (every new (B, T) pair recompiles); a sorted list
+        # sets explicit boundaries; "pow2" rounds T to powers of two.
+        self.length_buckets = None
+
+    def set_length_buckets(self, buckets) -> "DataFeeder":
+        """``buckets``: "pow2" or an ascending list of boundary lengths
+        (a length above the last boundary pads to the batch max)."""
+        if buckets is not None and buckets != "pow2":
+            buckets = sorted(int(b) for b in buckets)
+            enforce(buckets, "length_buckets must be non-empty")
+        self.length_buckets = buckets
+        return self
+
+    def _bucket_len(self, t: int) -> int:
+        from .bucketing import round_to_bucket
+
+        return round_to_bucket(t, self.length_buckets)
 
     def feed(self, batch: Iterable[Any]):
         batch = list(batch)
@@ -71,7 +91,7 @@ class DataFeeder:
                 # emit the lengths companion (SURVEY §7; reference packs
                 # these as LoD offsets, framework/lod_tensor.h:229)
                 lens = np.array([c.shape[0] for c in col], np.int32)
-                t = int(lens.max())
+                t = self._bucket_len(int(lens.max()))
                 elem = col[0].shape[1:]
                 # per-token [1] elem shape collapses (reference scalars)
                 squeeze = elem == (1,)

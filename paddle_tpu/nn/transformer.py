@@ -108,10 +108,18 @@ class TransformerDecoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
+    """``remat=True`` wraps each block in ``jax.checkpoint`` so backward
+    recomputes block activations instead of storing every layer's — the
+    HBM-for-FLOPs trade that makes long-sequence training fit (TPU
+    guidance: rematerialize at block boundaries). Applies on every call
+    when enabled; meant for the jitted training path (eager callers
+    should leave the default False)."""
+
     def __init__(self, num_layers: int, d_model: int, nhead: int,
                  dim_feedforward: int, dropout: float = 0.1,
                  activation: str = "gelu", normalize_before: bool = True,
-                 use_flash: bool = True, seq_parallel=None):
+                 use_flash: bool = True, seq_parallel=None,
+                 remat: bool = False):
         super().__init__()
         self.layers = LayerList([
             TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
@@ -119,10 +127,17 @@ class TransformerEncoder(Layer):
                                     seq_parallel)
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
+        self.remat = remat
 
     def forward(self, x, mask=None):
+        import jax
+
         for layer in self.layers:
-            x = layer(x, mask=mask)
+            if self.remat:
+                x = jax.checkpoint(
+                    lambda h, _l=layer: _l(h, mask=mask))(x)
+            else:
+                x = layer(x, mask=mask)
         if self.final_norm is not None:
             x = self.final_norm(x)
         return x

@@ -84,10 +84,12 @@ def save_inference_model(dirname: str, feed_target_names: Sequence[str],
     in the .npz), so the artifact is weight-swappable like the reference's
     __model__ + separate param files.
 
-    ``example_feeds`` (name → array or shape): concrete shapes used when
-    the program doesn't trace with symbolic dims (control-flow-heavy
-    programs) — the fallback then fixes the artifact to THESE shapes
-    instead of a placeholder batch of 8."""
+    ``example_feeds`` (name → array, or a TUPLE of ints as an explicit
+    shape): concrete shapes used when the program doesn't trace with
+    symbolic dims (control-flow-heavy programs) — the fallback then
+    fixes the artifact to these shapes instead of a placeholder batch
+    of 8. Lists count as DATA (``np.shape`` of the value), so a run
+    feed dict can be passed through unchanged."""
     from .program import default_main_program
 
     program = main_program or default_main_program()
@@ -154,8 +156,10 @@ def save_inference_model(dirname: str, feed_target_names: Sequence[str],
             v = program.vars[n]
             ex = (example_feeds or {}).get(n)
             if ex is not None:
-                shape = tuple(np.shape(ex)) if not isinstance(
-                    ex, (tuple, list)) else tuple(ex)
+                # tuples are explicit shapes; everything else (arrays,
+                # lists, scalars) is data whose shape we take
+                shape = tuple(ex) if isinstance(ex, tuple) \
+                    else tuple(np.shape(ex))
             else:
                 shape = tuple(8 if d == -1 else d for d in v.shape)
             feed_specs[n] = jax.ShapeDtypeStruct(shape, v.dtype)

@@ -156,3 +156,24 @@ def test_ragged_feeder_pads_and_emits_lengths():
     np.testing.assert_array_equal(np.asarray(out["seq"]),
                                   [[1, 2, 3], [4, 5, 0]])
     np.testing.assert_array_equal(np.asarray(out["seq@LEN"]), [3, 2])
+
+
+def test_feeder_length_buckets_bound_recompilation():
+    """Bucketed padding: distinct batch max-lengths land on shared
+    compiled shapes (SURVEY §7 recompilation management)."""
+    from paddle_tpu.data import DataFeeder
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        pd.data("seq", shape=[1], dtype="int64", lod_level=1)
+    feeder = DataFeeder([prog.var("seq")]).set_length_buckets("pow2")
+    a = feeder.feed([([1, 2, 3],), ([4, 5],)])        # max 3 -> pad 4
+    b = feeder.feed([([1, 2, 3, 4],), ([5],)])        # max 4 -> pad 4
+    assert np.asarray(a["seq"]).shape == np.asarray(b["seq"]).shape == (2, 4)
+    np.testing.assert_array_equal(np.asarray(a["seq@LEN"]), [3, 2])
+
+    feeder.set_length_buckets([8, 16])
+    c = feeder.feed([([1] * 5,), ([2] * 3,)])          # max 5 -> pad 8
+    d = feeder.feed([([1] * 20,), ([2] * 2,)])         # above last -> max
+    assert np.asarray(c["seq"]).shape == (2, 8)
+    assert np.asarray(d["seq"]).shape == (2, 20)

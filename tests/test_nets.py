@@ -47,3 +47,36 @@ def test_scaled_dot_product_attention_reexport():
     q = jnp.asarray(RNG.normal(size=(2, 4, 2, 8)).astype(np.float32))
     out = nets.scaled_dot_product_attention(q, q, q)
     assert out.shape == q.shape
+
+
+def test_encoder_remat_matches_plain_grads():
+    """remat=True must change memory behavior only: loss and grads are
+    identical to the unrolled stack (jax.checkpoint replays the same
+    jaxpr, including dropout masks)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.nn.transformer import TransformerEncoder
+
+    pt.seed(0)
+    enc = TransformerEncoder(num_layers=2, d_model=16, nhead=2,
+                             dim_feedforward=32, dropout=0.0)
+    params = enc.named_parameters()
+    x = jnp.asarray(np.random.default_rng(0)
+                    .normal(size=(2, 8, 16)).astype(np.float32))
+
+    def loss(p, remat):
+        enc.remat = remat
+        out, _ = enc.functional_call(p, x)
+        return jnp.sum(out ** 2)
+
+    l0, g0 = jax.jit(jax.value_and_grad(
+        lambda p: loss(p, False)))(params)
+    l1, g1 = jax.jit(jax.value_and_grad(
+        lambda p: loss(p, True)))(params)
+    assert np.allclose(float(l0), float(l1), rtol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
