@@ -297,9 +297,19 @@ not_equal = _CF.not_equal
 # Programs — static/control_flow.py lowers the recorded body to
 # lax.while_loop/scan), with a __new__ escape to the functional
 # lax-backed forms for eager callers (SURVEY §2.2 control flow):
-Switch = _CF.switch_case
-
 from .static import control_flow as _SCF  # noqa: E402
+
+
+class Switch(_SCF.Switch):
+    """``with Switch() as s: with s.case(cond): ...`` in static mode
+    (reference: layers/control_flow.py Switch — first-match case chain);
+    ``Switch(branch_index, branch_fns, *ops)`` runs the functional
+    lax.switch form."""
+
+    def __new__(cls, *args, **kwargs):
+        if args and not isinstance(args[0], str):
+            return _CF.switch_case(*args, **kwargs)
+        return super().__new__(cls)
 
 
 class While(_SCF.While):
@@ -835,7 +845,7 @@ def _apply_static_dispatch():
             "shuffle", "double_buffer", "load", "fc",
             "autoincreased_step_counter", "create_array", "array_write",
             "array_read", "array_length", "tensor_array_to_tensor",
-            "While", "IfElse", "StaticRNN", "DynamicRNN",
+            "While", "IfElse", "StaticRNN", "DynamicRNN", "Switch",
             "fill_constant", "zeros"}
     for name, obj in list(g.items()):
         if name.startswith("_") or name in skip:

@@ -427,3 +427,115 @@ class DynamicRNN(StaticRNN):
 
     def _lengths_for(self, prog: Program) -> Optional[str]:
         return self._lens
+
+
+class Switch:
+    """reference: layers/control_flow.py Switch — first-match-wins case
+    chain, used by piecewise LR schedules::
+
+        with Switch() as switch:
+            with switch.case(step < b1):
+                assign(lr1, output=lr)
+            with switch.default():
+                assign(lr2, output=lr)
+
+    Lowering: every case body records unconditionally (compute-all), and
+    each outer var written by any body selects its final value by the
+    FIRST true condition (jnp.where chain) — the XLA form of the
+    reference's conditional_block dispatch. Bodies communicate only via
+    in-place writes to pre-existing vars (assign(output=)/increment),
+    matching the reference's usage."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.prog: Program = default_main_program()
+        # (cond_name or None, body nodes, writes, external reads)
+        self._cases: List[Tuple[Optional[str], List[_OpNode], List[str],
+                                List[str]]] = []
+        self._entered = False
+
+    def __enter__(self) -> "Switch":
+        self._entered = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self._lower()
+        return False
+
+    @contextlib.contextmanager
+    def _capture(self, cond: Optional[Var]):
+        enforce(self._entered,
+                "use Switch inside a `with Switch() as switch:` block")
+        enforce(cond is None or isinstance(cond, Var),
+                "switch.case(cond) needs a Program Var condition")
+        enforce(not (self._cases and self._cases[-1][0] is None),
+                "default() must be the last Switch block")
+        prog = self.prog
+        start = len(prog.nodes)
+        pre = set(prog.vars)
+        yield
+        body = prog.nodes[start:]
+        del prog.nodes[start:]
+        writes, external = _analyze(body, pre, bound=())
+        enforce(writes, "a Switch block must write at least one outer "
+                "var (assign(..., output=var))")
+        self._cases.append((cond.name if cond is not None else None,
+                            list(body), writes, external))
+
+    def case(self, cond: Var):
+        return self._capture(cond)
+
+    def default(self):
+        return self._capture(None)
+
+    def _lower(self) -> None:
+        enforce(self._cases, "Switch recorded no case blocks")
+        prog = self.prog
+        all_writes: List[str] = []
+        for _c, _b, writes, _e in self._cases:
+            for w in writes:
+                if w not in all_writes:
+                    all_writes.append(w)
+        cond_names = [c for c, *_ in self._cases if c is not None]
+        externals: List[str] = []
+        for _c, _b, _w, ext in self._cases:
+            for e in ext:
+                if e not in externals and e not in all_writes:
+                    externals.append(e)
+        n_w, n_c = len(all_writes), len(cond_names)
+        cases = [(c, tuple(b), tuple(w))
+                 for c, b, w, _e in self._cases]
+
+        def switch_fn(*vals):
+            init = dict(zip(all_writes, vals[:n_w]))
+            conds = dict(zip(cond_names, vals[n_w:n_w + n_c]))
+            env0 = dict(zip(externals, vals[n_w + n_c:]))
+            env0.update(init)
+            # evaluate every body from the same pre-switch env
+            outs = []
+            for cname, body, writes in cases:
+                env = dict(env0)
+                env = _exec_nodes(body, env)
+                outs.append({w: env[w] for w in writes})
+            # first-match-wins: fold the chain from the last case up
+            final = dict(init)
+            for (cname, _b, writes), got in zip(reversed(cases),
+                                                reversed(outs)):
+                if cname is None:
+                    for w in writes:
+                        final[w] = got[w]
+                    continue
+                c = jnp.reshape(conds[cname], ()).astype(bool)
+                for w in all_writes:
+                    if w in got:
+                        final[w] = jnp.where(c, got[w], final[w])
+            # single write unwraps (the _OpNode one-output convention
+            # stores fn's return directly)
+            return (final[all_writes[0]] if n_w == 1
+                    else tuple(final[w] for w in all_writes))
+
+        node = _OpNode(switch_fn,
+                       all_writes + cond_names + externals,
+                       list(all_writes), "switch")
+        prog.nodes.append(node)
+        prog.version += 1

@@ -177,3 +177,100 @@ def test_feeder_length_buckets_bound_recompilation():
     d = feeder.feed([([1] * 20,), ([2] * 2,)])         # above last -> max
     assert np.asarray(c["seq"]).shape == (2, 8)
     assert np.asarray(d["seq"]).shape == (2, 20)
+
+
+def test_switch_piecewise_lr():
+    """The reference's canonical Switch use: piecewise LR by step
+    (reference: layers/learning_rate_scheduler.py piecewise_decay built
+    on Switch.case/default)."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        step = pd.data("step", shape=[1], dtype="int64")
+        lr = pd.fill_constant(shape=[1], dtype="float32", value=0.0)
+        b1 = pd.fill_constant(shape=[1], dtype="int64", value=100)
+        b2 = pd.fill_constant(shape=[1], dtype="int64", value=200)
+        lr1 = pd.fill_constant(shape=[1], dtype="float32", value=1.0)
+        lr2 = pd.fill_constant(shape=[1], dtype="float32", value=0.5)
+        lr3 = pd.fill_constant(shape=[1], dtype="float32", value=0.1)
+        with pd.Switch() as switch:
+            with switch.case(pd.less_than(step, b1)):
+                pd.assign(lr1, output=lr)
+            with switch.case(pd.less_than(step, b2)):
+                pd.assign(lr2, output=lr)
+            with switch.default():
+                pd.assign(lr3, output=lr)
+    exe = static.Executor()
+    exe.scope = static.Scope()
+    for s, want in [(50, 1.0), (150, 0.5), (250, 0.1)]:
+        out = _run_with(exe, prog, {"step": np.array([s], np.int64)}, lr)
+        # the written var must be a plain (1,) array usable downstream
+        assert np.asarray(out).shape == (1,), np.asarray(out).shape
+        assert np.isclose(np.asarray(out)[0], want), (s, out)
+
+
+def test_switch_written_var_usable_downstream():
+    """The single-write Switch result feeds ordinary ops (regression:
+    a 1-tuple wrapped value broke any consumer)."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = pd.data("x", shape=[1], dtype="float32")
+        out = pd.fill_constant(shape=[1], dtype="float32", value=0.0)
+        zero = pd.fill_constant(shape=[1], dtype="float32", value=0.0)
+        a = pd.fill_constant(shape=[1], dtype="float32", value=3.0)
+        b = pd.fill_constant(shape=[1], dtype="float32", value=4.0)
+        with pd.Switch() as switch:
+            with switch.case(pd.greater_than(x, zero)):
+                pd.assign(a, output=out)
+            with switch.default():
+                pd.assign(b, output=out)
+        doubled = out * 2.0
+    exe = static.Executor()
+    exe.scope = static.Scope()
+    got = exe.run(prog, feed={"x": np.array([1.0], np.float32)},
+                  fetch_list=[doubled])[0]
+    assert np.isclose(np.asarray(got)[0], 6.0)
+
+
+def test_switch_case_after_default_rejected():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = pd.data("x2", shape=[1], dtype="float32")
+        out = pd.fill_constant(shape=[1], dtype="float32", value=0.0)
+        zero = pd.fill_constant(shape=[1], dtype="float32", value=0.0)
+        a = pd.fill_constant(shape=[1], dtype="float32", value=3.0)
+        with pytest.raises(EnforceError, match="default.*last"):
+            with pd.Switch() as switch:
+                with switch.default():
+                    pd.assign(a, output=out)
+                with switch.case(pd.greater_than(x, zero)):
+                    pd.assign(a, output=out)
+
+
+def _run_with(exe, prog, feed, fetch):
+    return exe.run(prog, feed=feed, fetch_list=[fetch])[0]
+
+
+def test_switch_first_match_wins():
+    """Overlapping conditions: the FIRST true case takes the write."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = pd.data("x", shape=[1], dtype="float32")
+        out = pd.fill_constant(shape=[1], dtype="float32", value=-1.0)
+        zero = pd.fill_constant(shape=[1], dtype="float32", value=0.0)
+        hundred = pd.fill_constant(shape=[1], dtype="float32", value=100.0)
+        a = pd.fill_constant(shape=[1], dtype="float32", value=7.0)
+        b = pd.fill_constant(shape=[1], dtype="float32", value=9.0)
+        with pd.Switch() as switch:
+            with switch.case(pd.greater_than(x, zero)):   # true for 5
+                pd.assign(a, output=out)
+            with switch.case(pd.less_than(x, hundred)):   # also true for 5
+                pd.assign(b, output=out)
+    exe = static.Executor()
+    exe.scope = static.Scope()
+    got = _run_with(exe, prog, {"x": np.array([5.0], np.float32)}, out)
+    assert float(got) == 7.0  # first case wins
+    got = _run_with(exe, prog, {"x": np.array([-5.0], np.float32)}, out)
+    assert float(got) == 9.0  # first false, second true
+    # no default, no match: the pre-switch value survives
+    got = _run_with(exe, prog, {"x": np.array([500.0], np.float32)}, out)
+    assert float(got) == 7.0  # 500 > 0: first case still wins

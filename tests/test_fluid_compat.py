@@ -33,7 +33,6 @@ DESIGN_NA = {
 # form covers it) and the contrib decoder helpers (beam search lives on
 # the functional ops.decode path — dynamic beam widths don't trace).
 BLOCK_DSL_METHODS = {
-    "layers.Switch.case", "layers.Switch.default",
     "contrib.TrainingDecoder.block", "contrib.TrainingDecoder.output",
     "contrib.TrainingDecoder.static_input",
     "contrib.TrainingDecoder.step_input",
