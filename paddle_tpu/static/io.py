@@ -121,21 +121,28 @@ def save_inference_model(dirname: str, feed_target_names: Sequence[str],
 
     # -1 feed dims export as symbolic dimensions so the artifact stays
     # batch-polymorphic (the reference's ProgramDesc is shape-agnostic;
-    # a fixed-shape StableHLO module would silently lose that capability)
+    # a fixed-shape StableHLO module would silently lose that capability).
+    # ONE shared symbolic scope for every feed — per-feed scopes cannot
+    # mix in a single export — and every feed's LEADING -1 shares the
+    # batch symbol "b" (data() convention: dim 0 is the batch; feeds
+    # like a sequence and its @LEN lengths companion must agree on it).
     n_sym = 0
     feed_specs, polymorphic = {}, False
+    scope = jax.export.SymbolicScope()
     for n in feed_target_names:
         v = program.vars[n]
         if any(d == -1 for d in v.shape):
             polymorphic = True
             dims = []
-            for d in v.shape:
-                if d == -1:
+            for i, d in enumerate(v.shape):
+                if d == -1 and i == 0:
+                    dims.append("b")
+                elif d == -1:
                     dims.append(f"d{n_sym}")
                     n_sym += 1
                 else:
                     dims.append(str(d))
-            shape = jax.export.symbolic_shape(",".join(dims))
+            shape = jax.export.symbolic_shape(",".join(dims), scope=scope)
         else:
             shape = tuple(v.shape)
         feed_specs[n] = jax.ShapeDtypeStruct(shape, v.dtype)

@@ -271,3 +271,11 @@ def test_greedy_decode_exports_to_serving_artifact(tmp_path):
     out = pred.run(feed)
     got = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
     np.testing.assert_array_equal(got, np.asarray(ref))
+
+    # batch polymorphism survives control flow: the SAME artifact runs a
+    # different batch size and sequence length (multi-feed programs
+    # share one symbolic scope with a common batch symbol)
+    out2 = pred.run({"src_word_id": np.full((4, 5), 3, np.int64),
+                     "src_word_id@LEN": np.full((4,), 5, np.int32)})
+    got2 = np.asarray(out2[0] if isinstance(out2, (list, tuple)) else out2)
+    assert got2.shape[1] == 4  # (steps, batch) follows the feed
