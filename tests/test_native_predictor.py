@@ -132,3 +132,34 @@ class TestNativeCppUnits:
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "predictor_test: all ok" in r.stdout
+
+
+class TestControlFlowArtifact:
+    def test_while_decode_artifact_parses_natively(self, tmp_path):
+        """A block-DSL While program's artifact loads through the C++
+        predictor's parsers (manifest + StableHLO bytecode + params) —
+        control flow is plain StableHLO to the native serving path; the
+        compile/run leg runs on a PJRT device (ptserve on a TPU VM)."""
+        import importlib.util
+
+        from paddle_tpu import static
+        from paddle_tpu.native import NativePredictor
+
+        spec = importlib.util.spec_from_file_location(
+            "mtmod", os.path.join(os.path.dirname(NATIVE_DIR), "..",
+                                  "tests", "test_fluid_book_mt.py"))
+        mt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mt)
+        prog, ids = mt._greedy_decode_program()
+        exe = static.Executor(scope=static.Scope())
+        exe.run_startup(prog)
+        d = str(tmp_path / "decode_artifact")
+        static.save_inference_model(
+            d, ["src_word_id", "src_word_id@LEN"], [ids], exe,
+            main_program=prog)
+        assert os.path.exists(os.path.join(d, "program.mlir.bc"))
+        p = NativePredictor(d)
+        assert p.feed_names == ["src_word_id", "src_word_id@LEN"]
+        assert len(p.fetch_names) == 1
+        assert p.num_params() > 0  # vemb + decoder weights
+        p.close()
