@@ -260,6 +260,24 @@ class Layer:
 _RNG_STACK: List[Dict[str, Any]] = []
 
 
+def stacked_parameters(layers) -> Dict[str, Any]:
+    """Stack the params of structurally identical layers along a new
+    leading axis — the uniform-block idiom shared by scan-over-layers
+    encoders and the GPipe pipeline. Enforces matching param trees."""
+    import jax.numpy as jnp
+
+    from ..core.enforce import enforce
+
+    per = [l.named_parameters() for l in layers]
+    enforce(per, "stacked_parameters needs at least one layer")
+    names = sorted(per[0])
+    for i, p in enumerate(per[1:], 1):
+        enforce(sorted(p) == names,
+                "layer %s is not structurally identical to layer 0 "
+                "(params %s vs %s)", i, sorted(p), names)
+    return {k: jnp.stack([p[k] for p in per]) for k in names}
+
+
 def _stable_hash(s: str) -> int:
     import zlib
 

@@ -119,7 +119,7 @@ class TransformerEncoder(Layer):
                  dim_feedforward: int, dropout: float = 0.1,
                  activation: str = "gelu", normalize_before: bool = True,
                  use_flash: bool = True, seq_parallel=None,
-                 remat: bool = False):
+                 remat: bool = False, scan_layers: bool = False):
         super().__init__()
         self.layers = LayerList([
             TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
@@ -128,16 +128,47 @@ class TransformerEncoder(Layer):
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
         self.remat = remat
+        # scan-over-layers: one traced block applied via lax.scan over
+        # stacked per-layer params — the compiled module stays O(1) in
+        # depth (compile time + HLO size for 24/48-layer stacks) and the
+        # scan body is the natural remat boundary. Dropout must be 0:
+        # the scan body shares one RNG stream, which would correlate
+        # masks across layers (checked per-call: scan_layers is a plain
+        # attribute).
+        self._dropout_p = dropout
+        self.scan_layers = scan_layers
 
     def forward(self, x, mask=None):
         import jax
+        from jax import lax
 
-        for layer in self.layers:
+        if self.scan_layers and len(self.layers) > 1:
+            enforce(self._dropout_p == 0.0 or not self.training,
+                    "scan_layers needs dropout == 0 in training (one "
+                    "traced body would reuse its RNG across layers); "
+                    "unroll instead")
+            from .layer import stacked_parameters
+
+            stacked = stacked_parameters(self.layers)
+            template = self.layers[0]
+
+            def body(h, pl):
+                out, _ = template.functional_call(
+                    pl, h, mask=mask, training=self.training)
+                return out, None
+
             if self.remat:
-                x = jax.checkpoint(
-                    lambda h, _l=layer: _l(h, mask=mask))(x)
-            else:
-                x = layer(x, mask=mask)
+                # prevent_cse is unnecessary inside scan (JAX docs) and
+                # would insert optimization barriers per iteration
+                body = jax.checkpoint(body, prevent_cse=False)
+            x = lax.scan(body, x, stacked)[0]
+        else:
+            for layer in self.layers:
+                if self.remat:
+                    x = jax.checkpoint(
+                        lambda h, _l=layer: _l(h, mask=mask))(x)
+                else:
+                    x = layer(x, mask=mask)
         if self.final_norm is not None:
             x = self.final_norm(x)
         return x

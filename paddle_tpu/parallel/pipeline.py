@@ -196,12 +196,9 @@ class GPipe:
         self._block_fn = _block_fn
 
     def stacked_params(self):
-        per_block = [b.named_parameters() for b in self.blocks]
-        names = list(per_block[0])
-        for p in per_block[1:]:
-            enforce(list(p) == names,
-                    "GPipe blocks must be structurally identical")
-        return {k: jnp.stack([p[k] for p in per_block]) for k in names}
+        from ..nn.layer import stacked_parameters
+
+        return stacked_parameters(self.blocks)
 
     def __call__(self, x, stacked_params=None):
         params = (self.stacked_params() if stacked_params is None

