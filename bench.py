@@ -198,12 +198,16 @@ def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
 
 
 def bench_bert_base(steps: int, batch_size: int, amp=None,
-                    fused_ce: bool = True):
+                    fused_ce: bool = True, remat: bool = False,
+                    scan_layers: bool = False):
     """BASELINE config 3: BERT-base MLM pretrain step, seq 128.
 
     ``fused_ce`` routes the MLM head through the chunked
     linear-cross-entropy (ops/fused_loss.py) so the (B, T, 30k) logits
-    tensor never materializes — the HBM-bound hot spot of this config."""
+    tensor never materializes — the HBM-bound hot spot of this config.
+    ``remat`` checkpoints each block; ``scan_layers`` folds the stack
+    into one lax.scan body (forces dropout 0 — noted so numbers stay
+    comparable)."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -212,6 +216,9 @@ def bench_bert_base(steps: int, batch_size: int, amp=None,
     pt.seed(0)
     batch_size = min(batch_size, 32)
     cfg = B.BertConfig.base()
+    cfg.remat, cfg.scan_layers = remat, scan_layers
+    if scan_layers:
+        cfg.dropout = 0.0  # scan body shares one RNG stream
     model = B.BertForPretraining(cfg)
     rng = np.random.default_rng(0)
     T = 128
@@ -498,6 +505,12 @@ def main():
                     "measured configuration; pass --no-fused-ce for the "
                     "legacy full-logits path)")
     ap.add_argument("--no-fused-ce", dest="fused_ce", action="store_false")
+    ap.add_argument("--remat", action="store_true",
+                    help="bert: jax.checkpoint per transformer block")
+    ap.add_argument("--scan-layers", dest="scan_layers",
+                    action="store_true",
+                    help="bert: lax.scan over the layer stack (dropout "
+                    "forced to 0)")
     ap.add_argument("--amp", default="mixed_bf16",
                     help="dtype policy for the step (mixed_bf16 is the TPU "
                     "training default; pass float32 to disable)")
@@ -562,6 +575,10 @@ def main():
         kwargs["layout"] = args.layout
     if "fused_ce" in sig:
         kwargs["fused_ce"] = args.fused_ce
+    if "remat" in sig and args.remat:
+        kwargs["remat"] = True
+    if "scan_layers" in sig and args.scan_layers:
+        kwargs["scan_layers"] = True
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call

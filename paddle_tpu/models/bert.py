@@ -31,6 +31,9 @@ class BertConfig:
     use_flash: bool = True
     # None | 'ring' | 'ulysses' — shard attention over the 'sp' mesh axis
     seq_parallel: Optional[str] = None
+    remat: bool = False        # jax.checkpoint per block (HBM for FLOPs)
+    scan_layers: bool = False  # lax.scan over stacked layers (needs
+    #                            dropout == 0 while training)
 
     @classmethod
     def base(cls):
@@ -70,7 +73,8 @@ class BertModel(nn.Layer):
             cfg.num_layers, cfg.hidden_size, cfg.num_heads,
             cfg.intermediate_size, cfg.dropout, activation="gelu",
             normalize_before=False, use_flash=cfg.use_flash,
-            seq_parallel=cfg.seq_parallel)
+            seq_parallel=cfg.seq_parallel, remat=cfg.remat,
+            scan_layers=cfg.scan_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):

@@ -33,6 +33,8 @@ if [ "$MODE" = "full" ]; then
   done
   run python bench.py --model bert_base --no-fused-ce
   run python bench.py --model bert_base --amp float32
+  run python bench.py --model bert_base --remat
+  run python bench.py --model bert_base --scan-layers
   run python bench.py --model transformer_nmt --no-fused-ce
   run python bench.py --model resnet50 --layout NCHW
   run python bench.py --model resnet50 --amp float32
