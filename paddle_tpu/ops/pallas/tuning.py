@@ -85,18 +85,23 @@ def set_tuned(key: str, entry: dict, persist: bool = True) -> None:
     with _lock:
         table[key] = entry
         if persist:
-            # merge with the on-disk table first: a concurrent tuner
-            # process must not have its winners clobbered by our stale
-            # full-table write
-            merged = {}
+            # On DISK: union of disk and memory; disk wins on conflict
+            # (a concurrent tuner's winners survive) except the key just
+            # tuned, and memory keys absent from disk are re-persisted so
+            # a corrupt/deleted file cannot shrink the write.
+            # In MEMORY: our own entries win (persist=False overrides
+            # stay deliberate); keys we lack adopt the disk value.
+            disk = {}
             try:
                 with open(_TABLE_PATH) as f:
-                    merged = json.load(f)
+                    disk = json.load(f)
             except (OSError, ValueError):
                 pass
-            merged.update(table)
-            table.update({k: v for k, v in merged.items()
-                          if k not in table})
+            merged = dict(table)
+            merged.update(disk)
+            merged[key] = entry
+            for k, v in merged.items():
+                table.setdefault(k, v)
             tmp = _TABLE_PATH + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(merged, f, indent=1, sort_keys=True)

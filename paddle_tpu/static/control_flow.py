@@ -42,7 +42,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.enforce import enforce
-from .program import Program, Var, _OpNode, default_main_program
+from .program import (TRACE_BATCH, Program, Var, _OpNode,
+                      default_main_program)
 
 
 def _exec_nodes(nodes, env: Dict[str, Any]) -> Dict[str, Any]:
@@ -110,6 +111,17 @@ class While:
         yield
         body = prog.nodes[start:]
         del prog.nodes[start:]
+        for node in body:
+            # a TensorArray first written inside the loop is not loop
+            # state (its buffer var doesn't pre-exist), so its writes
+            # would silently reset every iteration
+            enforce(not (node.name == "array_write"
+                         and node.inputs
+                         and node.inputs[0] not in pre_names),
+                    "TensorArray written inside a While block must be "
+                    "seeded with an array_write BEFORE the loop so its "
+                    "buffer becomes loop-carried state (reference decode "
+                    "seeds index 0 pre-loop)")
         writes, external = _analyze(body, pre_names, bound=())
         carry = list(dict.fromkeys([self.cond.name] + writes))
         enforce(self.cond.name in [o for n in body for o in n.outputs],
@@ -133,7 +145,10 @@ class While:
                 env = _exec_nodes(_body, env)
                 return tuple(env[nm] for nm in _carry)
 
-            return lax.while_loop(cond_fn, body_fn, init)
+            out = lax.while_loop(cond_fn, body_fn, init)
+            # _OpNode's one-output convention stores fn's return directly;
+            # unwrap the 1-tuple so the var keeps its shape
+            return out[0] if _n == 1 else out
 
         # record with explicit output names = the carried vars (write-back)
         node = _OpNode(while_fn, carry + external, list(carry), "while")
@@ -165,6 +180,22 @@ class IfElse:
     def output(self, *outs: Var) -> None:
         enforce(self._cur is not None,
                 "IfElse.output() must be called inside a branch block")
+        # -1 batch placeholders trace as TRACE_BATCH (program.py apply);
+        # normalize both sides so batch-polymorphic programs compare
+        # consistently
+        def _rows(d):
+            return TRACE_BATCH if d == -1 else d
+
+        rows = _rows(self.cond.shape[0])
+        for v in outs:
+            # compute-both-and-mask merges row-wise, so every output must
+            # keep the cond's row dimension; a cross-row reduction inside
+            # a branch (shape change) would merge garbage
+            enforce(v.shape and _rows(v.shape[0]) == rows,
+                    "IfElse output %r has shape %s but cond has %s rows: "
+                    "branch ops must be row-independent (no cross-row "
+                    "reductions) — IfElse lowers to compute-both-and-mask",
+                    v.name, tuple(v.shape), rows)
         self._outputs[self._cur].extend(v.name for v in outs)
 
     @contextlib.contextmanager
@@ -205,11 +236,15 @@ class IfElse:
             env = dict(zip(_ext, vals))
             t_env = _exec_nodes(_t, dict(env))
             f_env = _exec_nodes(_f, dict(env))
-            mask = jnp.reshape(cond, (cond.shape[0],) + (1,) *
-                               (t_env[_to[0]].ndim - 1))
-            return tuple(
-                jnp.where(mask.astype(bool), t_env[tn], f_env[fn])
-                for tn, fn in zip(_to, _fo))
+            def merge(tv, fv):
+                mask = jnp.reshape(cond, (cond.shape[0],) +
+                                   (1,) * (tv.ndim - 1))
+                return jnp.where(mask.astype(bool), tv, fv)
+
+            outs = tuple(merge(t_env[tn], f_env[fn])
+                         for tn, fn in zip(_to, _fo))
+            # single output unwraps (the _OpNode one-output convention)
+            return outs[0] if len(outs) == 1 else outs
 
         outs = prog.apply(ifelse_fn, [self.cond] +
                           [prog.vars[n] for n in ext], name="ifelse")
@@ -517,7 +552,10 @@ class Switch:
                 env = dict(env0)
                 env = _exec_nodes(body, env)
                 outs.append({w: env[w] for w in writes})
-            # first-match-wins: fold the chain from the last case up
+            # first-match-wins: fold the chain from the last case up.
+            # A true case owns ALL outer vars, not just the ones it
+            # writes — untouched vars keep their pre-switch value, as the
+            # reference runs only the first true block.
             final = dict(init)
             for (cname, _b, writes), got in zip(reversed(cases),
                                                 reversed(outs)):
@@ -527,8 +565,7 @@ class Switch:
                     continue
                 c = jnp.reshape(conds[cname], ()).astype(bool)
                 for w in all_writes:
-                    if w in got:
-                        final[w] = jnp.where(c, got[w], final[w])
+                    final[w] = jnp.where(c, got.get(w, init[w]), final[w])
             # single write unwraps (the _OpNode one-output convention
             # stores fn's return directly)
             return (final[all_writes[0]] if n_w == 1

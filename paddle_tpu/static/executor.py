@@ -17,6 +17,7 @@ donation — no host round-trips in the train loop.
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -245,8 +246,10 @@ class Executor:
         # and must not run per step in the train-loop hot path.
         pkey = (id(program), program.version, fetch_names)
         cached = self._prune_cache.get(pkey)
-        if cached is not None:
-            keep, used_feeds = cached
+        # id() can be recycled after a Program is GC'd — the weakref in
+        # the cache value validates the hit really is this program
+        if cached is not None and cached[0]() is program:
+            _, keep, used_feeds = cached
         else:
             if fetch_names:
                 keep, used_feeds = prune_for_fetch(program, fetch_names)
@@ -258,7 +261,8 @@ class Executor:
                     if n in program.vars and program.vars[n].is_feed}
             if len(self._prune_cache) > 256:
                 self._prune_cache.clear()
-            self._prune_cache[pkey] = (keep, used_feeds)
+            self._prune_cache[pkey] = (weakref.ref(program), keep,
+                                       used_feeds)
         unfed = sorted(n for n in used_feeds if n not in feed_vals)
         enforce(not unfed, "missing feeds %s: every data() var the fetched "
                 "slice reads must appear in `feed`", unfed)

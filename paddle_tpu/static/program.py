@@ -30,6 +30,10 @@ from ..core.dtypes import default_dtype
 
 GRAD_SUFFIX = "@GRAD"
 
+# substitute for -1 batch placeholders when abstract-evaluating recorded
+# ops; shape checks that compare placeholder dims must use the same value
+TRACE_BATCH = 8
+
 
 class Var:
     """Symbolic handle inside a Program (reference: framework.py:366
@@ -252,7 +256,8 @@ class Program:
                 in_specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
             else:
                 v = self.vars[n]
-                shape = tuple(8 if d == -1 else d for d in v.shape)
+                shape = tuple(TRACE_BATCH if d == -1 else d
+                              for d in v.shape)
                 in_specs.append(jax.ShapeDtypeStruct(shape, v.dtype))
         try:
             out_specs = jax.eval_shape(fn, *in_specs)

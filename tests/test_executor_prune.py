@@ -80,3 +80,46 @@ def test_test_clone_prunes_loss_tail():
     # the clone has no optimizer (no persistable writes), so the CE/mean
     # nodes after logits are all dead for this fetch
     assert len(keep) < len(test_prog.nodes)
+
+
+def test_prune_cache_survives_program_id_reuse():
+    """ADVICE r2: id() recycling after GC must not serve a stale
+    keep-set — the weakref in the cache value validates the hit."""
+    import gc
+
+    import numpy as np
+
+    from paddle_tpu import static
+    import paddle_tpu.layers as pd
+
+    exe = static.Executor()
+    exe.scope = static.Scope()
+
+    def build(mult):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = pd.data("x", shape=[1], dtype="float32")
+            y = x * float(mult)
+        return prog, y
+
+    prog1, y1 = build(2.0)
+    out = exe.run(prog1, feed={"x": np.ones((1,), np.float32)},
+                  fetch_list=[y1])
+    assert float(np.asarray(out[0])[0]) == 2.0
+    del prog1, y1
+    gc.collect()
+    prog2, y2 = build(3.0)
+    # forge the worst case deterministically: plant a stale entry under
+    # prog2's exact key whose weakref points at a DIFFERENT (dead-ish)
+    # object, with a poisoned keep-set that would break the run if used
+    class _Other:
+        pass
+
+    other = _Other()
+    import weakref
+
+    exe._prune_cache[(id(prog2), prog2.version, (y2.name,))] = (
+        weakref.ref(other), {"bogus_node"}, {"bogus_feed"})
+    out = exe.run(prog2, feed={"x": np.ones((1,), np.float32)},
+                  fetch_list=[y2])
+    assert float(np.asarray(out[0])[0]) == 3.0  # stale entry ignored

@@ -101,3 +101,52 @@ def test_tune_tool_refuses_cpu(table):
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 2
     assert "refusing to tune" in r.stderr
+
+
+def test_set_tuned_preserves_concurrent_writer(table):
+    """ADVICE r2: disk wins over our stale in-memory copy for every key
+    except the one just tuned."""
+    k_ours = tuning.matmul_key(512, 512, 512, kind="v5e")
+    k_shared = tuning.matmul_key(1024, 1024, 1024, kind="v5e")
+    tuning.set_tuned(k_shared, {"tile_m": 64})   # our stale view
+    # a concurrent tuner process overwrites k_shared on disk
+    disk = json.loads(table.read_text())
+    disk[k_shared] = {"tile_m": 999}
+    table.write_text(json.dumps(disk))
+    # our next set_tuned for a DIFFERENT key must not clobber it
+    tuning.set_tuned(k_ours, {"tile_m": 128})
+    on_disk = json.loads(table.read_text())
+    assert on_disk[k_shared] == {"tile_m": 999}
+    assert on_disk[k_ours] == {"tile_m": 128}
+    # in-memory keeps OUR entry (deliberate overrides stay); a cache
+    # reset picks up the disk winner
+    assert tuning.get_tuned(k_shared) == {"tile_m": 64}
+    tuning.reset_cache()
+    assert tuning.get_tuned(k_shared) == {"tile_m": 999}
+
+
+def test_set_tuned_persist_false_override_survives(table):
+    """Review r3: a persist=False in-memory override must not be
+    reverted to the disk value by a later persist=True write."""
+    k1 = tuning.matmul_key(512, 512, 512, kind="v5e")
+    k2 = tuning.matmul_key(4096, 4096, 4096, kind="v5e")
+    table.write_text(json.dumps({k1: {"tile_m": 1}}))
+    tuning.reset_cache()
+    tuning.set_tuned(k1, {"tile_m": 64}, persist=False)
+    tuning.set_tuned(k2, {"tile_m": 256})
+    assert tuning.get_tuned(k1) == {"tile_m": 64}   # override kept
+    # disk still has the persisted k1 (persist=False never touches disk)
+    assert json.loads(table.read_text())[k1] == {"tile_m": 1}
+
+
+def test_set_tuned_repersists_memory_when_disk_lost(table):
+    """Review r3: a deleted/corrupt table file must not shrink the
+    persisted table to one entry — in-memory winners are re-written."""
+    k1 = tuning.matmul_key(256, 256, 256, kind="v5e")
+    k2 = tuning.matmul_key(2048, 2048, 2048, kind="v5e")
+    tuning.set_tuned(k1, {"tile_m": 64})
+    table.unlink()  # operator deletes the file mid-sweep
+    tuning.set_tuned(k2, {"tile_m": 256})
+    on_disk = json.loads(table.read_text())
+    assert on_disk[k1] == {"tile_m": 64}
+    assert on_disk[k2] == {"tile_m": 256}
