@@ -34,6 +34,10 @@ class DeepFMConfig:
     dropout: float = 0.0
     # 'ep' shards the tables over the mesh; None keeps them replicated
     embedding_axis: Optional[str] = "ep"
+    # row-sparse gradient updates for the tables (SelectedRows capability;
+    # reference: lookup_table is_sparse) — train via
+    # optimizer.sparse_minimize_fn so each step touches O(B*fields) rows
+    sparse_grads: bool = False
 
     @classmethod
     def criteo(cls, total_vocab: int = 1_000_000):
@@ -53,12 +57,16 @@ class DeepFM(nn.Layer):
         self.cfg = cfg = cfg or DeepFMConfig()
         if cfg.embedding_axis:
             self.embedding = ShardedEmbedding(cfg.total_vocab, cfg.embed_dim,
-                                              axis=cfg.embedding_axis)
+                                              axis=cfg.embedding_axis,
+                                              is_sparse=cfg.sparse_grads)
             self.linear_embed = ShardedEmbedding(cfg.total_vocab, 1,
-                                                 axis=cfg.embedding_axis)
+                                                 axis=cfg.embedding_axis,
+                                                 is_sparse=cfg.sparse_grads)
         else:
-            self.embedding = nn.Embedding(cfg.total_vocab, cfg.embed_dim)
-            self.linear_embed = nn.Embedding(cfg.total_vocab, 1)
+            self.embedding = nn.Embedding(cfg.total_vocab, cfg.embed_dim,
+                                          is_sparse=cfg.sparse_grads)
+            self.linear_embed = nn.Embedding(cfg.total_vocab, 1,
+                                             is_sparse=cfg.sparse_grads)
         self.bias = self.create_parameter("bias", (1,), is_bias=True)
         mlp = []
         d_in = cfg.num_fields * cfg.embed_dim + cfg.dense_dim

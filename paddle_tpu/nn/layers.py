@@ -211,18 +211,40 @@ class RMSNorm(Layer):
 
 
 class Embedding(Layer):
-    """reference: dygraph/nn.py Embedding (lookup_table_op). Sparse-grad
-    SelectedRows semantics are handled by XLA gather fusion; the giant-table
-    sharded variant lives in paddle_tpu.parallel.sharded_embedding."""
+    """reference: dygraph/nn.py Embedding (lookup_table_op).
+
+    ``is_sparse=True`` (reference lookup_table's is_sparse attr) marks the
+    table for row-sparse gradient updates: a train step built with
+    :func:`paddle_tpu.optimizer.sparse.sparse_minimize_fn` differentiates
+    w.r.t. the gathered rows instead of the table, and the optimizer
+    touches O(batch * seq) rows per step, not O(vocab) — the SelectedRows
+    capability (reference: framework/selected_rows.h:32). Outside such a
+    step the flag is inert (plain dense gather). The giant-table sharded
+    variant lives in paddle_tpu.parallel.sharded_embedding."""
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
-                 padding_idx: Optional[int] = None, weight_init=None, dtype=None):
+                 padding_idx: Optional[int] = None, weight_init=None,
+                 dtype=None, is_sparse: bool = False):
         super().__init__()
         self.padding_idx = padding_idx
+        self.is_sparse = is_sparse
         self.create_parameter("weight", (num_embeddings, embedding_dim), dtype,
                               weight_init or I.XavierNormal())
 
     def forward(self, ids):
+        from .sparse import Capture, Inject, active
+
+        ctx = active()
+        if ctx is not None and ctx.handles(self):
+            if isinstance(ctx, Capture):
+                ctx.record(self, ids)
+            else:
+                assert isinstance(ctx, Inject)
+                rows = ctx.pop(self)
+                if self.padding_idx is not None:
+                    rows = jnp.where((ids == self.padding_idx)[..., None],
+                                     0.0, rows)
+                return rows
         return ON.embedding(ids, self.weight, self.padding_idx)
 
 

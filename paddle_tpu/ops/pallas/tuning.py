@@ -25,6 +25,9 @@ _TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "tuned_blocks.json")
 _lock = threading.Lock()
 _cache: Optional[Dict[str, dict]] = None
+# keys set with persist=False — session-only overrides that must never
+# reach the shared on-disk table
+_session_only: set = set()
 
 
 @functools.lru_cache(maxsize=1)
@@ -84,6 +87,10 @@ def set_tuned(key: str, entry: dict, persist: bool = True) -> None:
     table = _load()
     with _lock:
         table[key] = entry
+        if not persist:
+            _session_only.add(key)
+        else:
+            _session_only.discard(key)
         if persist:
             # On DISK: union of disk and memory; disk wins on conflict
             # (a concurrent tuner's winners survive) except the key just
@@ -97,7 +104,8 @@ def set_tuned(key: str, entry: dict, persist: bool = True) -> None:
                     disk = json.load(f)
             except (OSError, ValueError):
                 pass
-            merged = dict(table)
+            merged = {k: v for k, v in table.items()
+                      if k not in _session_only}
             merged.update(disk)
             merged[key] = entry
             for k, v in merged.items():
@@ -113,3 +121,4 @@ def reset_cache() -> None:
     global _cache
     with _lock:
         _cache = None
+        _session_only.clear()

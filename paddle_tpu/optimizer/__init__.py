@@ -18,8 +18,10 @@ from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
                          Lamb, LarsMomentum, Momentum, Optimizer,
                          ProximalAdagrad, ProximalGD, RMSProp)
 from .loss_scaler import DynamicLossScaler
+from .sparse import apply_rows, merge_rows, sparse_minimize_fn
 
 __all__ = [
+    "apply_rows", "merge_rows", "sparse_minimize_fn",
     "SGD", "Adadelta", "Adagrad", "Adam", "Adamax", "AdamW", "DecayedAdagrad",
     "Ftrl", "Lamb", "LarsMomentum", "Momentum", "Optimizer", "RMSProp",
     "ProximalGD", "ProximalAdagrad", "ExponentialMovingAverage",

@@ -88,12 +88,17 @@ class ShardedEmbedding(Layer):
     def __init__(self, num_embeddings: int, embedding_dim: int,
                  axis: str = "ep", padding_idx: Optional[int] = None,
                  weight_init=None, dtype=None, mesh=None,
-                 batch_axis: Optional[str] = "dp"):
+                 batch_axis: Optional[str] = "dp",
+                 is_sparse: bool = False):
         super().__init__()
         self.axis = axis
         self.batch_axis = batch_axis
         self.padding_idx = padding_idx
         self._mesh = mesh
+        # row-sparse gradient updates (see nn.Embedding.is_sparse): the
+        # sparse step's scatter composes with the P(axis, None) placement
+        # — GSPMD routes each unique row's update to its owning shard
+        self.is_sparse = is_sparse
         self.create_parameter("weight", (num_embeddings, embedding_dim),
                               dtype, weight_init or I.XavierNormal())
 
@@ -104,6 +109,19 @@ class ShardedEmbedding(Layer):
                              P(self.axis, None))
 
     def forward(self, ids):
+        from ..nn.sparse import Capture, Inject, active
+
+        ctx = active()
+        if ctx is not None and ctx.handles(self):
+            if isinstance(ctx, Capture):
+                ctx.record(self, ids)
+            else:
+                assert isinstance(ctx, Inject)
+                rows = ctx.pop(self)
+                if self.padding_idx is not None:
+                    rows = jnp.where((ids == self.padding_idx)[..., None],
+                                     0.0, rows)
+                return rows
         return sharded_embedding_lookup(
             ids, self.weight, axis=self.axis, mesh=self._mesh,
             batch_axis=self.batch_axis, padding_idx=self.padding_idx)

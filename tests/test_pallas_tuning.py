@@ -150,3 +150,20 @@ def test_set_tuned_repersists_memory_when_disk_lost(table):
     on_disk = json.loads(table.read_text())
     assert on_disk[k1] == {"tile_m": 64}
     assert on_disk[k2] == {"tile_m": 256}
+
+
+def test_persist_false_key_never_reaches_disk(table):
+    """Review r3: a session-only override for a key ABSENT from disk must
+    not be leaked to disk by a later persist=True write."""
+    k_sess = tuning.matmul_key(128, 128, 128, kind="v5e")
+    k_other = tuning.matmul_key(8192, 8192, 8192, kind="v5e")
+    tuning.set_tuned(k_sess, {"tile_m": 8}, persist=False)
+    tuning.set_tuned(k_other, {"tile_m": 512})
+    on_disk = json.loads(table.read_text())
+    assert k_sess not in on_disk
+    assert on_disk[k_other] == {"tile_m": 512}
+    # the override is still live in-process
+    assert tuning.get_tuned(k_sess) == {"tile_m": 8}
+    # re-tuning the same key WITH persist does write it
+    tuning.set_tuned(k_sess, {"tile_m": 16})
+    assert json.loads(table.read_text())[k_sess] == {"tile_m": 16}
