@@ -20,10 +20,18 @@ tensorstore/orbax-style checkpoint keyed by logical leaf path that
 - is atomic (tmp dir + rename) and step-managed with GC
   (``CheckpointManager``, max_to_keep).
 
-Layout: ``<dir>/manifest.json`` + one ``.npy`` per leaf. Multi-host: only
-process 0 writes (single-host here; per-host shard writing is a future
-optimization, not a correctness requirement — restore re-sharding handles
-placement).
+Layout: ``<dir>/manifest.json`` + one ``.npy`` per leaf — or, for leaves
+that are NOT fully addressable (multi-process sharded arrays), one
+``.npy`` PER SHARD REGION: each process snapshots and writes only the
+shards it owns (replica 0 of each region), the manifest records
+shard→file with start offsets, and restore reassembles on any mesh.
+This is the per-host write path the reference gets from each pserver
+snapshotting its own shards (reference:
+operators/distributed_ops/checkpoint_notify_op.cc) — no single-writer
+gather, so checkpoint wall-clock and host RAM stay flat as hosts are
+added (assumes the standard shared checkpoint filesystem). Writers
+coordinate through the JAX coordination service (barrier), and process 0
+performs the atomic rename.
 """
 
 from __future__ import annotations
@@ -142,6 +150,65 @@ def _sanitize(path: str) -> str:
     return re.sub(r"[^A-Za-z0-9_.-]", "_", path)
 
 
+_barrier_counts: Dict[str, int] = {}
+
+
+def _barrier(tag: str) -> None:
+    """Coordination-service barrier (no device collectives — safe from the
+    async writer thread). No-op single-process."""
+    if jax.process_count() <= 1:
+        return
+    from jax._src import distributed as _dist
+
+    client = getattr(_dist.global_state, "client", None)
+    if client is None:  # processes without a coordination service can't
+        return          # write per-host checkpoints coherently anyway
+    client.wait_at_barrier(tag, timeout_in_ms=300_000)
+
+
+def _next_barrier_prefix(directory: str) -> str:
+    # tags are keyed by TARGET DIRECTORY (+ a per-directory sequence), not
+    # a process-global counter: if one rank skips a save (e.g. its
+    # previous write failed and raised), its barriers for OTHER
+    # directories still line up with the peers' — a mismatch fails one
+    # save loudly instead of desyncing every save that follows
+    import zlib
+
+    n = _barrier_counts.get(directory, 0) + 1
+    _barrier_counts[directory] = n
+    return f"ckpt_{zlib.crc32(directory.encode()) & 0xffffffff:08x}_{n}"
+
+
+def _shard_regions(leaf):
+    """Deterministic global enumeration of a sharded leaf's unique shard
+    regions: [(region_key, start offsets, region shape)] — identical on
+    every process (sharding metadata is global)."""
+    imap = leaf.sharding.devices_indices_map(leaf.shape)
+    regions = {}
+    for idx in imap.values():
+        starts = tuple((s.start or 0) for s in idx)
+        if starts not in regions:
+            shape = tuple(
+                ((s.stop if s.stop is not None else dim) - (s.start or 0))
+                for s, dim in zip(idx, leaf.shape))
+            regions[starts] = shape
+    return [("_".join(map(str, k)), list(k), list(v))
+            for k, v in sorted(regions.items())]
+
+
+def _local_shard_payload(leaf):
+    """Snapshot THIS process's owned shards (replica 0 of each region —
+    exactly one device globally owns each region's replica 0, so every
+    region is written exactly once across the job)."""
+    out = []
+    for shard in leaf.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        starts = tuple((s.start or 0) for s in shard.index)
+        out.append(("_".join(map(str, starts)), np.asarray(shard.data)))
+    return out
+
+
 class _WriteHandle:
     """Join-able async-write handle that re-raises write failures (a daemon
     thread's exception would otherwise vanish into stderr and a 'successful'
@@ -172,11 +239,17 @@ class _WriteHandle:
             raise exc
 
 
-def save_state(directory: str, tree, *, async_save: bool = False):
+def save_state(directory: str, tree, *, async_save: bool = False,
+               per_host: Optional[bool] = None):
     """Write a pytree checkpoint. Device→host copy happens before this
     returns (state may be mutated immediately); with ``async_save`` the file
     IO runs on a daemon thread and the returned handle's ``.join()`` waits
     (and re-raises any write failure).
+
+    ``per_host``: leaves written shard-by-shard (each process writes only
+    the shard regions it owns). Defaults to automatic — any leaf that is
+    not fully addressable (multi-process sharded) MUST go per-host; pass
+    ``True`` to force it for addressable sharded leaves too.
 
     Supported containers: dict / list / tuple / None. Custom registered
     pytree nodes are rejected (loudly — a silent degrade would desync leaf
@@ -189,37 +262,78 @@ def save_state(directory: str, tree, *, async_save: bool = False):
             "tree has custom pytree nodes the checkpoint skeleton can't "
             "represent (%s skeleton leaves vs %s flattened) — use dict/"
             "list/tuple containers", counter[0], len(flat))
-    # snapshot to host NOW — training may donate/overwrite these buffers
-    host = jax.device_get([leaf for _, leaf in flat])
-    entries = []
-    seen = set()
-    for (path, leaf), arr in zip(flat, host):
-        arr = np.asarray(arr)
-        fname = _sanitize(path) + ".npy"
-        enforce(fname not in seen, "leaf path collision on %s", fname)
-        seen.add(fname)
-        entries.append({"path": path, "file": fname, "dtype": str(arr.dtype),
-                        "shape": list(arr.shape), "spec": _spec_of(leaf)})
+
+    def sharded_mode(leaf) -> bool:
+        if not isinstance(leaf, jax.Array) or leaf.is_fully_replicated:
+            return False
+        if not getattr(leaf, "is_fully_addressable", True):
+            return True
+        return bool(per_host) and isinstance(leaf.sharding, NamedSharding)
+
+    # snapshot to host NOW — training may donate/overwrite these buffers.
+    # Whole-leaf snapshots only for process-0-writable leaves (ONE batched
+    # device_get so D2H transfers overlap); sharded leaves snapshot their
+    # LOCAL owned shards on every process.
+    entries, payload, seen = [], [], set()
+    rank0 = jax.process_index() == 0
+    whole = [(path, leaf) for path, leaf in flat
+             if not sharded_mode(leaf)]
+    whole_host = dict(zip(
+        [p for p, _ in whole],
+        jax.device_get([leaf for _, leaf in whole])))
+    for path, leaf in flat:
+        base = _sanitize(path)
+        enforce(base not in seen, "leaf path collision on %s", base)
+        seen.add(base)
+        if path not in whole_host:
+            regions = [
+                {"file": f"{base}.shard_{key}.npy", "start": starts,
+                 "shape": shape}
+                for key, starts, shape in _shard_regions(leaf)]
+            entries.append({
+                "path": path, "dtype": str(np.dtype(leaf.dtype)),
+                "shape": list(leaf.shape), "spec": _spec_of(leaf),
+                "shards": regions})
+            for key, arr in _local_shard_payload(leaf):
+                payload.append((f"{base}.shard_{key}.npy", arr))
+        else:
+            arr = np.asarray(whole_host[path])
+            entries.append({"path": path, "file": base + ".npy",
+                            "dtype": str(arr.dtype),
+                            "shape": list(arr.shape),
+                            "spec": _spec_of(leaf)})
+            if rank0:
+                payload.append((base + ".npy", arr))
+
+    bprefix = _next_barrier_prefix(directory)
+    multi = jax.process_count() > 1
 
     def write():
         tmp = directory + ".tmp"
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
-        for e, arr in zip(entries, host):
-            arr = np.asarray(arr)
-            view = _EXOTIC.get(e["dtype"])
-            np.save(os.path.join(tmp, e["file"]),
+        if rank0:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+        if multi:
+            _barrier(f"{bprefix}_staged")  # tmp dir exists for everyone
+        for fname, arr in payload:
+            dt = str(arr.dtype)
+            view = _EXOTIC.get(dt)
+            np.save(os.path.join(tmp, fname),
                     arr.view(view) if view is not None else arr)
-        with open(os.path.join(tmp, _MANIFEST), "w") as f:
-            json.dump({"format": "paddle_tpu_ckpt/v1", "skeleton": skel,
-                       "leaves": entries}, f)
-        if os.path.exists(directory):
-            shutil.rmtree(directory)
-        os.replace(tmp, directory)
+        if rank0:
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump({"format": "paddle_tpu_ckpt/v1",
+                           "skeleton": skel, "leaves": entries}, f)
+        if multi:
+            _barrier(f"{bprefix}_written")  # all shards on disk
+        if rank0:
+            if os.path.exists(directory):
+                shutil.rmtree(directory)
+            os.replace(tmp, directory)
+        if multi:
+            _barrier(f"{bprefix}_renamed")  # checkpoint visible to all
 
-    if jax.process_index() != 0:  # non-writer hosts only snapshot
-        return _WriteHandle(directory=directory)
     if async_save:
         return _WriteHandle(write, directory=directory)
     write()
@@ -250,14 +364,48 @@ def restore_state(directory: str, *, mesh: Optional[Mesh] = None,
         oflat, _ = _leaf_paths(shardings)
         override = dict(oflat)
 
-    leaves = []
-    for e in manifest["leaves"]:
-        arr = np.load(os.path.join(directory, e["file"]))
-        view = _EXOTIC.get(e["dtype"])
-        if view is not None:
+    def _load_file(path_, dtype):
+        arr = np.load(path_)
+        if _EXOTIC.get(dtype) is not None:
             import ml_dtypes
 
-            arr = arr.view(getattr(ml_dtypes, e["dtype"]))
+            arr = arr.view(getattr(ml_dtypes, dtype))
+        return arr
+
+    def _np_dtype(dtype):
+        if _EXOTIC.get(dtype):
+            import ml_dtypes
+
+            return getattr(ml_dtypes, dtype)
+        return np.dtype(dtype)
+
+    def _assemble(e, region):
+        """Copy the window ``region`` (tuple of slices with concrete
+        bounds) out of the shard files, reading ONLY overlapping files —
+        per-host restore IO stays O(local shards), not O(global)."""
+        out = np.empty(tuple(s.stop - s.start for s in region),
+                       _np_dtype(e["dtype"]))
+        for rec in e["shards"]:
+            src, dst = [], []
+            for s, (r0, rn) in zip(region,
+                                   zip(rec["start"], rec["shape"])):
+                lo, hi = max(s.start, r0), min(s.stop, r0 + rn)
+                if lo >= hi:
+                    break
+                src.append(slice(lo - r0, hi - r0))
+                dst.append(slice(lo - s.start, hi - s.start))
+            else:
+                shard = _load_file(os.path.join(directory, rec["file"]),
+                                   e["dtype"])
+                out[tuple(dst)] = shard[tuple(src)]
+        return out
+
+    leaves = []
+    for e in manifest["leaves"]:
+        arr = None
+        if "shards" not in e:
+            arr = _load_file(os.path.join(directory, e["file"]),
+                             e["dtype"])
         sh = None
         if override is not None and e["path"] in override:
             sh = override[e["path"]]
@@ -272,7 +420,28 @@ def restore_state(directory: str, *, mesh: Optional[Mesh] = None,
                 spec = _spec_from(e["spec"], m)
                 if spec is not None:
                     sh = NamedSharding(m, spec)
-        x = jnp.asarray(arr) if sh is None else jax.device_put(arr, sh)
+        shape = tuple(e["shape"]) if arr is None else tuple(arr.shape)
+
+        def _window(idx, dims):
+            return tuple(
+                slice(s.start or 0, s.stop if s.stop is not None else dim)
+                for s, dim in zip(idx, dims))
+
+        if sh is None:
+            if arr is None:  # host value: assemble the full array
+                arr = _assemble(e, tuple(slice(0, d) for d in shape))
+            x = jnp.asarray(arr)
+        elif arr is None:
+            # per-host restore: each process reads only the shard files
+            # overlapping its addressable windows
+            x = jax.make_array_from_callback(
+                shape, sh,
+                lambda idx, _e=e, _d=shape: _assemble(_e, _window(idx, _d)))
+        else:
+            # make_array_from_callback works when the sharding spans
+            # processes (device_put to non-addressable devices does not)
+            x = jax.make_array_from_callback(
+                shape, sh, lambda idx, _a=arr: _a[idx])
         leaves.append(x)
 
     tree = _unskeleton(manifest["skeleton"], leaves)

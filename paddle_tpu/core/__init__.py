@@ -5,7 +5,7 @@ from .dtypes import Policy, get_policy, policy_scope, set_policy, to_dtype
 from .enforce import (EnforceError, InvalidArgumentError, NotFoundError,
                       UnimplementedError, enforce, enforce_eq, enforce_in)
 from .mesh import (AXIS_NAMES, auto_mesh, axis_size, build_hybrid_mesh,
-                   build_mesh, get_mesh,
+                   build_mesh, build_multihost_mesh, get_mesh,
                    mesh_scope, replicated, set_mesh, sharding)
 from .places import (CPUPlace, Place, TPUPlace, default_place, device_count,
                      device_pool, is_compiled_with_tpu, set_device)
@@ -18,7 +18,7 @@ __all__ = [
     "EnforceError", "InvalidArgumentError", "NotFoundError",
     "UnimplementedError", "enforce", "enforce_eq", "enforce_in",
     "AXIS_NAMES", "auto_mesh", "axis_size", "build_hybrid_mesh",
-    "build_mesh", "get_mesh",
+    "build_mesh", "build_multihost_mesh", "get_mesh",
     "mesh_scope", "replicated", "set_mesh", "sharding",
     "CPUPlace", "Place", "TPUPlace", "default_place", "device_count",
     "device_pool", "is_compiled_with_tpu", "set_device",

@@ -65,6 +65,53 @@ def build_mesh(
     return Mesh(dev_array, axis_names=("dp", "pp", "tp", "sp", "ep"))
 
 
+def build_multihost_mesh(
+    world_size: int,
+    *,
+    dcn_axis: str = "dp",
+    dp: int = 1,
+    tp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh whose ``dcn_axis`` spans the host (process) dimension.
+
+    ``jax.devices()`` orders devices process-major, so the plain
+    :func:`build_mesh` reshape always puts the OUTERMOST axis (dp) across
+    hosts. The reference's NCCL2 mode proved its collectives across real
+    processes (reference: transpiler _transpile_nccl2,
+    tests/unittests/test_dist_base.py:545); here ANY axis can be the one
+    that rides DCN: the chosen axis is split (world, size/world) with the
+    process dimension outermost, so its collectives decompose into
+    intra-host ICI plus one inter-host DCN exchange, and all other axes
+    stay host-local.
+
+    ``dcn_axis='dp'`` reproduces :func:`build_mesh`'s layout exactly.
+    """
+    sizes = {"dp": dp, "pp": pp, "tp": tp, "sp": sp, "ep": ep}
+    order = ("dp", "pp", "tp", "sp", "ep")
+    enforce(dcn_axis in sizes, "unknown mesh axis %r", dcn_axis)
+    enforce(world_size >= 1 and sizes[dcn_axis] % world_size == 0,
+            "%s axis size %s must divide by world size %s to span hosts",
+            dcn_axis, sizes[dcn_axis], world_size)
+    if devices is None:
+        devices = jax.devices()
+    total = dp * pp * tp * sp * ep
+    enforce(total == len(devices),
+            "mesh size %s != device count %s", total, len(devices))
+    k = order.index(dcn_axis)
+    local_shape = [sizes[a] for a in order]
+    local_shape[k] //= world_size
+    # (world, per-host mesh) → move the host dim next to its axis's local
+    # part → merge: axis index = host * local + j (host outermost)
+    arr = np.asarray(devices).reshape([world_size] + local_shape)
+    arr = np.moveaxis(arr, 0, k)
+    arr = arr.reshape([sizes[a] for a in order])
+    return Mesh(arr, axis_names=order)
+
+
 def from_config(cfg: DistributeConfig, devices=None) -> Mesh:
     return build_mesh(dp=cfg.dp, tp=cfg.tp, pp=cfg.pp, sp=cfg.sp, ep=cfg.ep,
                       devices=devices)

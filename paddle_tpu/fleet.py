@@ -87,6 +87,10 @@ class DistributedStrategy:
     amp: Optional[str] = None          # mixed-precision policy name
     gradient_merge_steps: int = 1      # microbatch accumulation
     donate_inputs: bool = True
+    # which mesh axis spans hosts (DCN) in multi-process runs; 'dp' is
+    # the classic layout, 'tp'/'pp' prove model axes across processes
+    # (reference NCCL2-across-trainers capability, test_dist_base.py:545)
+    dcn_axis: str = "dp"
 
 
 class Fleet:
@@ -129,7 +133,19 @@ class Fleet:
         enforce(dp * model_par == n,
                 "strategy (dp=%s tp=%s pp=%s sp=%s ep=%s) does not cover "
                 "%s devices", dp, s.tp, s.pp, s.sp, s.ep, n)
-        self.mesh = build_mesh(dp=dp, tp=s.tp, pp=s.pp, sp=s.sp, ep=s.ep)
+        enforce(s.dcn_axis in ("dp", "pp", "tp", "sp", "ep"),
+                "unknown dcn_axis %r (mesh axes: dp/pp/tp/sp/ep)",
+                s.dcn_axis)
+        world = self._role.world_size
+        if world > 1 and s.dcn_axis != "dp":
+            from .core.mesh import build_multihost_mesh
+
+            self.mesh = build_multihost_mesh(
+                world, dcn_axis=s.dcn_axis, dp=dp, tp=s.tp, pp=s.pp,
+                sp=s.sp, ep=s.ep)
+        else:
+            self.mesh = build_mesh(dp=dp, tp=s.tp, pp=s.pp, sp=s.sp,
+                                   ep=s.ep)
         set_mesh(self.mesh)
 
     def shutdown(self):

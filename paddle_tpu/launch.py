@@ -41,9 +41,14 @@ def _free_port() -> int:
 
 
 def build_worker_env(rank: int, nproc: int, endpoints: List[str],
-                     base_env=None, platform: Optional[str] = None) -> dict:
+                     base_env=None, platform: Optional[str] = None,
+                     local_devices: Optional[int] = None) -> dict:
     """Env for one worker, RoleMaker's protocol (fleet.py:35): explicit
-    args > PADDLE_* > JAX_* > single-process defaults."""
+    args > PADDLE_* > JAX_* > single-process defaults.
+
+    ``local_devices`` forces N virtual CPU devices per worker (the
+    reference launcher's per-node --gpus analog for the multi-host
+    simulation rig, SURVEY §7 'multi-host test rig without a pod')."""
     env = dict(os.environ if base_env is None else base_env)
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(nproc)
@@ -56,13 +61,19 @@ def build_worker_env(rank: int, nproc: int, endpoints: List[str],
         # each process owns its local chip(s); a forced host-device count
         # would alias the same CPU into every rank
         env.pop("XLA_FLAGS", None)
+    if local_devices:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={local_devices}"
+        ).strip()
     return env
 
 
 def launch(script: str, script_args: List[str], *, nproc: int,
            endpoints: Optional[List[str]] = None,
            log_dir: str = "launch_logs", platform: Optional[str] = None,
-           timeout: Optional[float] = None) -> int:
+           timeout: Optional[float] = None,
+           local_devices: Optional[int] = None) -> int:
     """Spawn the job; returns the job's exit code (0 = all ranks ok)."""
     if endpoints is None:
         endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
@@ -72,7 +83,8 @@ def launch(script: str, script_args: List[str], *, nproc: int,
     os.makedirs(log_dir, exist_ok=True)
     procs, logs, log_files = [], [], []
     for rank in range(nproc):
-        env = build_worker_env(rank, nproc, endpoints, platform=platform)
+        env = build_worker_env(rank, nproc, endpoints, platform=platform,
+                               local_devices=local_devices)
         if rank == 0:
             out, path = None, None  # inherit: rank 0 streams live
         else:
@@ -157,6 +169,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--platform", default=None,
                     help="force JAX_PLATFORMS in workers (e.g. cpu for "
                     "multi-process simulation on one host)")
+    ap.add_argument("--local-devices", type=int, default=None,
+                    help="force N virtual CPU devices per worker (the "
+                    "multi-host simulation rig; per-node --gpus analog)")
     ap.add_argument("--timeout", type=float, default=None,
                     help="kill the job after this many seconds")
     ap.add_argument("script", help="training script to run per rank")
@@ -166,7 +181,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     endpoints = (args.endpoints.split(",") if args.endpoints else None)
     return launch(args.script, args.script_args, nproc=args.nproc,
                   endpoints=endpoints, log_dir=args.log_dir,
-                  platform=args.platform, timeout=args.timeout)
+                  platform=args.platform, timeout=args.timeout,
+                  local_devices=args.local_devices)
 
 
 if __name__ == "__main__":

@@ -192,3 +192,62 @@ def test_layer_save_load_convenience(tmp_path):
     m2 = M.MnistMLP(hidden1=16, hidden2=8)
     m2.load_state_dict(C.load(p))
     _assert_tree_equal(m.state_dict(), m2.state_dict())
+
+
+def test_per_host_shard_layout_roundtrip(tmp_path):
+    """VERDICT r2 #7: per-shard files + manifest shard records + exact
+    reassembly (forced per_host on a single process)."""
+    import os
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = pt.build_mesh(dp=2, tp=2, devices=devs[:4])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(0)
+    w = jax.device_put(rng.normal(size=(8, 6)).astype(np.float32),
+                       NamedSharding(mesh, P("dp", "tp")))
+    b = jax.device_put(rng.normal(size=(6,)).astype(np.float32),
+                       NamedSharding(mesh, P()))
+    d = str(tmp_path / "ck")
+    save_state(d, {"w": w, "b": b}, per_host=True)
+
+    import json as _json
+
+    with open(os.path.join(d, "manifest.json")) as f:
+        man = _json.load(f)
+    by_path = {e["path"]: e for e in man["leaves"]}
+    assert "shards" in by_path["w"] and len(by_path["w"]["shards"]) == 4
+    assert "shards" not in by_path["b"]  # replicated -> whole-leaf file
+    for rec in by_path["w"]["shards"]:
+        assert os.path.exists(os.path.join(d, rec["file"]))
+
+    got = restore_state(d, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(got["b"]), np.asarray(b))
+    # saved spec re-applied on restore
+    assert not got["w"].sharding.is_fully_replicated
+
+    # reassembly also works onto a DIFFERENT mesh (resharding contract)
+    mesh2 = pt.build_mesh(dp=4, devices=devs[:4])
+    got2 = restore_state(d, mesh=mesh2)
+    np.testing.assert_array_equal(np.asarray(got2["w"]), np.asarray(w))
+
+
+def test_per_host_bf16_shards_roundtrip(tmp_path):
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = pt.build_mesh(dp=2, devices=devs[:2])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+
+    w = jax.device_put(jnp.arange(16, dtype=jnp.bfloat16).reshape(8, 2),
+                       NamedSharding(mesh, P("dp")))
+    d = str(tmp_path / "ckbf")
+    save_state(d, {"w": w}, per_host=True)
+    got = restore_state(d, mesh=mesh)
+    assert got["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(got["w"], np.float32),
+                                  np.asarray(w, np.float32))

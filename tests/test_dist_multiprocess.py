@@ -132,3 +132,223 @@ def test_launch_propagates_failure(tmp_path):
     assert r.returncode == 3
     assert "rank 1 exited with 3" in r.stderr
     assert "worker 1 says hi" in r.stderr  # log tail replayed
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 #5: a NON-dp axis spanning processes (2 procs x 4 devices,
+# tp=4 with its outer half riding the process/DCN dimension)
+# ---------------------------------------------------------------------------
+
+HYBRID_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import paddle_tpu as pt
+from paddle_tpu import fleet
+
+f = fleet.init(strategy=fleet.DistributedStrategy(dp=2, tp=4,
+                                                  dcn_axis="tp"))
+rank = f.worker_index()
+assert len(jax.devices()) == 8, f"expected 8 global devices"
+mesh = f.mesh
+
+# the tp axis must SPAN processes: each tp row mixes process indices
+tp_row = mesh.devices[0, 0, :, 0, 0]
+procs = {d.process_index for d in tp_row}
+assert len(procs) == 2, f"tp axis stays host-local: {procs}"
+
+# Megatron 2-layer MLP train step over the fleet mesh
+D, H, C, B = 16, 32, 10, 8
+rng = np.random.default_rng(0)
+w1_h = rng.normal(scale=0.2, size=(D, H)).astype(np.float32)
+w2_h = rng.normal(scale=0.2, size=(H, D)).astype(np.float32)
+wo_h = rng.normal(scale=0.2, size=(D, C)).astype(np.float32)
+
+def put(host, spec):
+    return jax.make_array_from_callback(
+        host.shape, NamedSharding(mesh, spec), lambda idx: host[idx])
+
+params = {"w1": put(w1_h, P(None, "tp")), "w2": put(w2_h, P("tp", None)),
+          "wo": put(wo_h, P())}
+
+def loss_fn(p, x, y):
+    h = jnp.tanh(x @ p["w1"]) @ p["w2"]
+    logits = (x + h) @ p["wo"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+@jax.jit
+def step(p, x, y):
+    loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+    return loss, jax.tree_util.tree_map(lambda w, gg: w - 0.1 * gg, p, g)
+
+losses = []
+for i in range(3):
+    xb = rng.normal(size=(B, D)).astype(np.float32)
+    yb = rng.integers(0, C, size=(B,))
+    x = put(xb, P("dp"))
+    y = put(yb, P("dp"))
+    loss, params = step(params, x, y)
+    losses.append(float(loss))
+print("LOSSES[%%d]:%%s" %% (rank, json.dumps(losses)), flush=True)
+f.shutdown()
+"""
+
+
+def test_launch_tp_axis_spans_processes(tmp_path):
+    """fleet builds a mesh whose tp axis crosses the process boundary
+    (DistributedStrategy.dcn_axis='tp'); the Megatron-sharded train step
+    loss-matches a single-process run of the same math."""
+    script = tmp_path / "hybrid_worker.py"
+    script.write_text(HYBRID_WORKER % {"repo": REPO})
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--local-devices", "4",
+         "--log-dir", str(log_dir), "--timeout", "240", str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+    rank0 = _losses_from(r.stdout, 0)
+    with open(log_dir / "workerlog.1") as fh:
+        rank1 = _losses_from(fh.read(), 1)
+    np.testing.assert_allclose(rank0, rank1, rtol=1e-5)
+
+    # single-process reference: same math on a local dp2 x tp4 mesh
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as pt
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        import pytest
+        pytest.skip("needs 8 virtual devices for the reference run")
+    mesh = pt.build_mesh(dp=2, tp=4, devices=devs[:8])
+    D, H, C, B = 16, 32, 10, 8
+    rng = np.random.default_rng(0)
+    params = {
+        "w1": jax.device_put(rng.normal(scale=0.2, size=(D, H))
+                             .astype(np.float32),
+                             NamedSharding(mesh, P(None, "tp"))),
+        "w2": jax.device_put(rng.normal(scale=0.2, size=(H, D))
+                             .astype(np.float32),
+                             NamedSharding(mesh, P("tp", None))),
+        "wo": jax.device_put(rng.normal(scale=0.2, size=(D, C))
+                             .astype(np.float32),
+                             NamedSharding(mesh, P())),
+    }
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        logits = (x + h) @ p["wo"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    @jax.jit
+    def step(p, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        return loss, jax.tree_util.tree_map(lambda w, gg: w - 0.1 * gg,
+                                            p, g)
+
+    ref = []
+    for i in range(3):
+        xb = rng.normal(size=(B, D)).astype(np.float32)
+        yb = rng.integers(0, C, size=(B,))
+        x = jax.device_put(jnp.asarray(xb), NamedSharding(mesh, P("dp")))
+        y = jax.device_put(jnp.asarray(yb), NamedSharding(mesh, P("dp")))
+        loss, params = step(params, x, y)
+        ref.append(float(loss))
+    np.testing.assert_allclose(rank0, ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 #7: per-host checkpoint writes — BOTH ranks write their own
+# shard files; restore reassembles and loss-matches
+# ---------------------------------------------------------------------------
+
+CKPT_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import paddle_tpu as pt
+from paddle_tpu import checkpoint, fleet
+
+f = fleet.init(strategy=fleet.DistributedStrategy(dp=4))
+rank = f.worker_index()
+mesh = f.mesh
+ckdir = os.environ["CKPT_DIR"]
+
+rng = np.random.default_rng(0)
+w_h = rng.normal(size=(8, 4)).astype(np.float32)
+
+def put(host, spec):
+    return jax.make_array_from_callback(
+        host.shape, NamedSharding(mesh, spec), lambda idx: host[idx])
+
+state = {"w": put(w_h, P("dp", None)),
+         "b": put(rng.normal(size=(4,)).astype(np.float32), P())}
+assert not state["w"].is_fully_addressable  # really spans processes
+checkpoint.save_state(ckdir, state)
+got = checkpoint.restore_state(ckdir, mesh=mesh)
+local = np.concatenate(
+    [np.asarray(s.data) for s in
+     sorted(got["w"].addressable_shards, key=lambda s: s.index[0].start)])
+start = 4 * rank
+np.testing.assert_array_equal(local, w_h[start:start + 4])
+print("CKPT_OK[%%d]" %% rank, flush=True)
+f.shutdown()
+"""
+
+
+def test_per_host_checkpoint_both_ranks_write(tmp_path):
+    script = tmp_path / "ckpt_worker.py"
+    script.write_text(CKPT_WORKER % {"repo": REPO})
+    ckdir = tmp_path / "ckpt"
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    env["CKPT_DIR"] = str(ckdir)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--local-devices", "2",
+         "--log-dir", str(log_dir), "--timeout", "240", str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+    assert "CKPT_OK[0]" in r.stdout
+    with open(log_dir / "workerlog.1") as fh:
+        assert "CKPT_OK[1]" in fh.read()
+
+    # the manifest records 4 shard regions for w, and all 4 shard files
+    # exist — written by two different processes
+    with open(ckdir / "manifest.json") as fh:
+        man = json.load(fh)
+    by_path = {e["path"]: e for e in man["leaves"]}
+    assert len(by_path["w"]["shards"]) == 4
+    for rec in by_path["w"]["shards"]:
+        assert (ckdir / rec["file"]).exists(), rec["file"]
+    assert "shards" not in by_path["b"]
+
+    # single-process reassembly of the multi-process checkpoint
+    got = restore_state_local(str(ckdir))
+    rng = np.random.default_rng(0)
+    np.testing.assert_array_equal(
+        np.asarray(got["w"]), rng.normal(size=(8, 4)).astype(np.float32))
+
+
+def restore_state_local(path):
+    from paddle_tpu import checkpoint
+
+    return checkpoint.restore_state(path)

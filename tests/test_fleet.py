@@ -184,3 +184,46 @@ class TestGradientMerge:
         tr = f.trainer(M.MnistMLP(hidden1=16, hidden2=8),
                        optimizer.SGD(0.1), M.loss_fn)
         assert tr.grad_accum_steps == 2
+
+
+class TestMultihostMesh:
+    """build_multihost_mesh: any axis can span the host dimension
+    (VERDICT r2 #5; reference NCCL2-across-trainers,
+    test_dist_base.py:545)."""
+
+    def test_tp_axis_interleaves_hosts(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from paddle_tpu.core.mesh import build_multihost_mesh
+
+        m = build_multihost_mesh(2, dcn_axis="tp", dp=2, tp=4,
+                                 devices=devs[:8])
+        ids = np.vectorize(lambda d: d.id)(m.devices)
+        # "hosts" = device halves [0..3], [4..7]; each tp row must mix them
+        for dp_i in range(2):
+            row = ids[dp_i, 0, :, 0, 0]
+            assert any(i < 4 for i in row) and any(i >= 4 for i in row), row
+
+    def test_dp_layout_matches_build_mesh(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from paddle_tpu.core.mesh import build_multihost_mesh
+
+        m = build_multihost_mesh(2, dcn_axis="dp", dp=2, tp=4,
+                                 devices=devs[:8])
+        b = pt.build_mesh(dp=2, tp=4, devices=devs[:8])
+        ids_m = np.vectorize(lambda d: d.id)(m.devices)
+        ids_b = np.vectorize(lambda d: d.id)(b.devices)
+        assert (ids_m == ids_b).all()
+
+    def test_indivisible_axis_rejected(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from paddle_tpu.core.mesh import build_multihost_mesh
+
+        with pytest.raises(EnforceError, match="span hosts"):
+            build_multihost_mesh(3, dcn_axis="tp", dp=2, tp=4,
+                                 devices=devs[:8])
