@@ -209,10 +209,53 @@ class CompositeMetric(MetricBase):
         return [m.eval() for m in self.metrics]
 
 
-def chunk_eval(*args, **kwargs):
-    raise NotImplementedError(
-        "chunk_eval (reference: operators/metrics/chunk_eval... sequence "
-        "chunking F1) lands with the NLP tagging models")
+def chunk_eval(input, label, chunk_scheme: str = "IOB",
+               num_chunk_types: int = 1, excluded_chunk_types=None,
+               seq_lens=None):
+    """Sequence-chunking precision/recall/F1 (reference:
+    operators/chunk_eval_op.cc + layers/nn.py chunk_eval). Thin wrapper
+    over :func:`paddle_tpu.ops.sequence.chunk_eval` with the fluid
+    argument order; ``seq_lens`` defaults to full rows (padded-dense
+    representation — the LoD replacement)."""
+    from .ops.sequence import chunk_eval as _ce
+
+    input = jnp.asarray(input)
+    if seq_lens is None:
+        t = input.shape[-1] if input.ndim > 1 else input.shape[0]
+        b = input.shape[0] if input.ndim > 1 else 1
+        seq_lens = jnp.full((b,), t, jnp.int32)
+    return _ce(input, label, seq_lens, num_chunk_types, chunk_scheme,
+               tuple(excluded_chunk_types or ()))
+
+
+class ChunkEvaluator(MetricBase):
+    """reference: metrics.py:361 ChunkEvaluator — accumulates
+    chunk_eval's counters over mini-batches; eval() returns
+    (precision, recall, f1)."""
+
+    def __init__(self, name=None):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
 
 
 def mean_iou(pred, label, num_classes: int):

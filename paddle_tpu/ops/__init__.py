@@ -72,7 +72,8 @@ from .detection_extra import (box_decoder_and_assign,
                               generate_proposal_labels, mine_hard_examples,
                               psroi_pool, roi_perspective_transform,
                               rpn_target_assign, yolov3_loss)
-from .sequence import (add_position_encoding, sequence_reshape,
+from .sequence import (add_position_encoding, chunk_eval,
+                       sequence_reshape,
                        sequence_scatter)
 
 # --- name aliases: reference op names whose capability lives under a

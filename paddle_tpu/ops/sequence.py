@@ -272,3 +272,148 @@ def add_position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
     if enc.shape[-1] < d:  # odd d
         enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))
     return alpha * x + beta * enc[None]
+
+
+# ---------------------------------------------------------------------------
+# chunk evaluation (sequence tagging F1)
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {
+    # (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_flags(prev_tag, prev_type, tag, typ, other, scheme):
+    """Vectorized ChunkBegin/ChunkEnd predicates (reference:
+    operators/chunk_eval_op.h ChunkBegin:95 / ChunkEnd:83 — the ordered
+    early-return chain becomes a jnp.select priority list)."""
+    _, t_begin, t_inside, t_end, t_single = scheme
+    f = jnp.full_like(tag, False, dtype=bool)
+    t = jnp.full_like(tag, True, dtype=bool)
+    end = jnp.select(
+        [prev_type == other,
+         typ == other,
+         typ != prev_type,
+         prev_tag == t_begin,
+         prev_tag == t_inside,
+         prev_tag == t_end,
+         prev_tag == t_single],
+        [f, t, t,
+         (tag == t_begin) | (tag == t_single),
+         (tag == t_begin) | (tag == t_single),
+         t, t],
+        default=f)
+    begin = jnp.select(
+        [prev_type == other,
+         typ == other,
+         typ != prev_type,
+         tag == t_begin,
+         tag == t_inside,
+         tag == t_end,
+         tag == t_single],
+        [typ != other, f, t, t,
+         (prev_tag == t_end) | (prev_tag == t_single),
+         (prev_tag == t_end) | (prev_tag == t_single),
+         t],
+        default=f)
+    return begin, end
+
+
+def _chunk_segments(labels, lengths, num_chunk_types, scheme):
+    """Per-position segment-close encoding of GetSegments (reference:
+    chunk_eval_op.h:41): returns (close (B, T+1), start (B, T+1),
+    typ (B, T+1)) where close[b, i] marks a segment [start[b, i], i-1]
+    of type typ[b, i]. One extra virtual 'other' step closes any chunk
+    still open at the sequence end."""
+    num_tag = scheme[0]
+    other = num_chunk_types
+    B, T = labels.shape
+    pos = jnp.arange(T)[None, :]
+    valid = pos < lengths[:, None]
+    # pad positions (and one virtual trailing step) become 'other' type:
+    # they never begin a chunk and close any open one
+    lab = jnp.where(valid, labels, other * num_tag)
+    lab = jnp.concatenate(
+        [lab, jnp.full((B, 1), other * num_tag, lab.dtype)], axis=1)
+    tag = lab % num_tag
+    typ = lab // num_tag
+    prev_tag = jnp.concatenate([jnp.full((B, 1), -1, tag.dtype),
+                                tag[:, :-1]], axis=1)
+    prev_typ = jnp.concatenate([jnp.full((B, 1), other, typ.dtype),
+                                typ[:, :-1]], axis=1)
+    begin, end = _chunk_flags(prev_tag, prev_typ, tag, typ, other,
+                              scheme)
+
+    def step(carry, xs):
+        in_chunk, start = carry
+        b_i, e_i, i = xs
+        close = in_chunk & e_i
+        new_in = b_i | (in_chunk & ~e_i)
+        new_start = jnp.where(b_i, i, start)
+        return (new_in, new_start), (close, start)
+
+    (_, _), (close, start) = jax.lax.scan(
+        step,
+        (jnp.zeros(B, bool), jnp.zeros(B, jnp.int32)),
+        (begin.T, end.T, jnp.arange(T + 1, dtype=jnp.int32)))
+    return close.T, start.T, prev_typ
+
+
+def chunk_eval(inference, label, lengths, num_chunk_types: int,
+               chunk_scheme: str = "IOB", excluded_chunk_types=()):
+    """Chunking precision/recall/F1 (reference:
+    operators/chunk_eval_op.h ChunkEvalKernel::Compute:110 — IOB/IOE/
+    IOBES/plain schemes over label = type * num_tag_types + tag).
+
+    Device-native: the reference walks each sequence's segment lists on
+    CPU; here segments are encoded per-position (a chunk is identified by
+    its close position + start + type, unique per side), so counting and
+    matching are elementwise over the padded (B, T) batch — one lax.scan
+    over time, everything else vectorized.
+
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) as jax scalars.
+    """
+    from ..core.enforce import enforce
+
+    enforce(chunk_scheme in _CHUNK_SCHEMES,
+            "unknown chunk scheme %r (IOB/IOE/IOBES/plain)", chunk_scheme)
+    scheme = _CHUNK_SCHEMES[chunk_scheme]
+    inference = jnp.asarray(inference)
+    label = jnp.asarray(label)
+    if inference.ndim == 1:
+        inference = inference[None]
+        label = label[None]
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(-1)
+
+    i_close, i_start, i_typ = _chunk_segments(
+        inference, lengths, num_chunk_types, scheme)
+    l_close, l_start, l_typ = _chunk_segments(
+        label, lengths, num_chunk_types, scheme)
+
+    def not_excluded(typ):
+        keep = jnp.ones_like(typ, dtype=bool)
+        for t in excluded_chunk_types:
+            keep &= typ != t
+        return keep
+
+    num_infer = jnp.sum(i_close & not_excluded(i_typ))
+    num_label = jnp.sum(l_close & not_excluded(l_typ))
+    correct = jnp.sum(i_close & l_close & (i_start == l_start) &
+                      (i_typ == l_typ) & not_excluded(i_typ))
+    num_infer = num_infer.astype(jnp.int32)
+    num_label = num_label.astype(jnp.int32)
+    correct = correct.astype(jnp.int32)
+    precision = jnp.where(num_infer > 0, correct / jnp.maximum(num_infer, 1),
+                          0.0).astype(jnp.float32)
+    recall = jnp.where(num_label > 0, correct / jnp.maximum(num_label, 1),
+                       0.0).astype(jnp.float32)
+    f1 = jnp.where(correct > 0,
+                   2 * precision * recall /
+                   jnp.maximum(precision + recall, 1e-38),
+                   0.0).astype(jnp.float32)
+    return precision, recall, f1, num_infer, num_label, correct

@@ -1,0 +1,210 @@
+"""OpTest-style tests closing the two r2 stubs (VERDICT r2 #8):
+
+- chunk_eval (reference: operators/chunk_eval_op.h — IOB/IOE/IOBES/plain
+  chunking F1) against an independent numpy reference of GetSegments,
+- poly2mask / polys_to_mask_wrt_box (reference:
+  operators/detection/mask_util.cc, contract = pycocotools
+  frPyObjects+decode) against the pycocotools golden vectors the
+  reference's own test documents, plus generate_mask_labels end-to-end.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.metrics import ChunkEvaluator, chunk_eval
+from paddle_tpu.ops.detection_extra import (generate_mask_labels, poly2mask,
+                                            polys_to_mask_wrt_box)
+from paddle_tpu.ops.sequence import chunk_eval as chunk_eval_op
+
+SCHEMES = {
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _np_segments(labels, num_chunk_types, scheme):
+    """Independent numpy port of the reference's GetSegments walk
+    (chunk_eval_op.h:41): returns a set of (begin, end, type)."""
+    num_tag, t_begin, t_inside, t_end, t_single = SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt == t_begin or pt == t_inside:
+            return t in (t_begin, t_single)
+        if pt == t_end or pt == t_single:
+            return True
+        return False
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty:
+            return True
+        if t == t_begin or t == t_single:
+            return True
+        if t in (t_inside, t_end):
+            return pt in (t_end, t_single)
+        return False
+
+    segments = []
+    tag, typ = -1, other
+    in_chunk, start = False, 0
+    for i, lab in enumerate(labels):
+        pt, pty = tag, typ
+        tag, typ = lab % num_tag, lab // num_tag
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            segments.append((start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segments.append((start, len(labels) - 1, typ))
+    return set(segments)
+
+
+def _np_chunk_eval(inf, lab, lengths, num_chunk_types, scheme, excluded):
+    ni = nl = nc = 0
+    for row_i, row_l, L in zip(inf, lab, lengths):
+        si = _np_segments(list(row_i[:L]), num_chunk_types, scheme)
+        sl = _np_segments(list(row_l[:L]), num_chunk_types, scheme)
+        keep = lambda s: s[2] not in excluded
+        si_k, sl_k = set(filter(keep, si)), set(filter(keep, sl))
+        ni += len(si_k)
+        nl += len(sl_k)
+        nc += len(si_k & sl_k)
+    return ni, nl, nc
+
+
+@pytest.mark.parametrize("scheme", list(SCHEMES))
+def test_chunk_eval_matches_numpy_reference(scheme):
+    num_tag = SCHEMES[scheme][0]
+    num_types = 3
+    vocab = num_types * num_tag + 1  # + the 'other' label
+    rng = np.random.default_rng(0)
+    for case in range(8):
+        B, T = 4, 12
+        lengths = rng.integers(1, T + 1, size=(B,))
+        inf = rng.integers(0, vocab, size=(B, T))
+        lab = rng.integers(0, vocab, size=(B, T))
+        excluded = (2,) if case % 2 else ()
+        p, r, f1, ni, nl, nc = chunk_eval_op(
+            inf, lab, lengths, num_types, scheme, excluded)
+        eni, enl, enc = _np_chunk_eval(inf, lab, lengths, num_types,
+                                       scheme, excluded)
+        assert (int(ni), int(nl), int(nc)) == (eni, enl, enc), \
+            (scheme, case)
+        ep = enc / eni if eni else 0.0
+        er = enc / enl if enl else 0.0
+        ef = 2 * ep * er / (ep + er) if enc else 0.0
+        np.testing.assert_allclose(
+            [float(p), float(r), float(f1)], [ep, er, ef], atol=1e-6)
+
+
+def test_chunk_eval_perfect_and_disjoint():
+    # B-PER I-PER O B-LOC (IOB, 2 types): identical → perfect scores
+    inf = np.array([[0, 1, 4, 2]])
+    lab = np.array([[0, 1, 4, 2]])
+    p, r, f1, ni, nl, nc = chunk_eval_op(inf, lab, np.array([4]), 2, "IOB")
+    assert (float(p), float(r), float(f1)) == (1.0, 1.0, 1.0)
+    assert (int(ni), int(nl), int(nc)) == (2, 2, 2)
+    # fully disjoint predictions → zero everything
+    inf = np.array([[4, 4, 0, 1]])
+    lab = np.array([[0, 1, 4, 4]])
+    p, r, f1, ni, nl, nc = chunk_eval_op(inf, lab, np.array([4]), 2, "IOB")
+    assert (int(nc), float(p), float(f1)) == (0, 0.0, 0.0)
+    assert int(ni) == 1 and int(nl) == 1
+
+
+def test_chunk_eval_respects_lengths():
+    """Positions past the row length must not produce chunks."""
+    inf = np.array([[0, 1, 0, 0]])
+    lab = np.array([[0, 1, 0, 0]])
+    _, _, _, ni, nl, nc = chunk_eval_op(inf, lab, np.array([2]), 2, "IOB")
+    assert (int(ni), int(nl), int(nc)) == (1, 1, 1)
+
+
+def test_chunk_evaluator_accumulates():
+    m = ChunkEvaluator()
+    m.update(10, 8, 4)
+    m.update(10, 12, 6)
+    p, r, f1 = m.eval()
+    assert p == 10 / 20 and r == 10 / 20
+    np.testing.assert_allclose(f1, 0.5)
+    m.reset()
+    assert m.eval() == (0.0, 0.0, 0.0)
+
+
+def test_metrics_chunk_eval_wrapper_defaults_full_rows():
+    out = chunk_eval(np.array([[0, 1, 4, 2]]), np.array([[0, 1, 4, 2]]),
+                     chunk_scheme="IOB", num_chunk_types=2)
+    assert float(out[2]) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# mask_util parity
+# ---------------------------------------------------------------------------
+
+GOLDEN_POLY = [1.97, 1.88, 5.81, 1.88, 1.69, 6.53, 5.94, 6.38, 1.97, 1.88]
+GOLDEN_MASK = np.array([
+    [0, 0, 0, 0, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 0, 0, 0],
+    [0, 0, 0, 1, 1, 0, 0, 0],
+    [0, 0, 0, 0, 0, 0, 0, 0],
+    [0, 0, 0, 1, 0, 0, 0, 0],
+    [0, 0, 1, 1, 1, 0, 0, 0],
+    [0, 0, 1, 1, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 0, 0, 0]], np.uint8)
+
+
+def test_poly2mask_matches_pycocotools_golden():
+    """The pycocotools frPyObjects+decode output for this polygon (the
+    contract the reference op documents in its own test)."""
+    np.testing.assert_array_equal(poly2mask(GOLDEN_POLY, 8, 8),
+                                  GOLDEN_MASK)
+
+
+def test_polys_to_mask_wrt_box_golden():
+    polys = [GOLDEN_POLY,
+             [2.97, 1.88, 3.81, 1.68, 1.69, 6.63, 6.94, 6.58, 2.97, 0.88]]
+    box = [1.69, 0.88, 6.94, 6.63]
+    expect = np.array([
+        [0, 0, 0, 0, 0, 0, 0, 0],
+        [0, 1, 1, 1, 1, 1, 0, 0],
+        [0, 0, 1, 1, 1, 0, 0, 0],
+        [0, 0, 1, 1, 1, 0, 0, 0],
+        [0, 0, 1, 1, 1, 0, 0, 0],
+        [0, 1, 1, 1, 1, 1, 0, 0],
+        [0, 1, 1, 1, 1, 1, 1, 0],
+        [1, 1, 1, 1, 1, 1, 1, 1]], np.uint8)
+    np.testing.assert_array_equal(polys_to_mask_wrt_box(polys, box, 8),
+                                  expect)
+
+
+def test_generate_mask_labels_uses_frpoly_and_sections():
+    """End to end: fg roi gets a frPoly mask in its class section, -1
+    elsewhere; background rois produce nothing."""
+    res = 8
+    gt_segms = [[GOLDEN_POLY]]
+    rois = np.array([[1.69, 1.88, 5.94, 6.53],    # fg, overlaps the gt
+                     [0.0, 0.0, 1.0, 1.0]])       # bg
+    roi_labels = np.array([2, 0])
+    mask_rois, has_mask, targets = generate_mask_labels(
+        im_info=None, gt_classes=np.array([2]), is_crowd=np.array([0]),
+        gt_segms=gt_segms, rois=rois, roi_labels=roi_labels,
+        num_classes=3, resolution=res)
+    assert mask_rois.shape == (1, 4) and targets.shape == (1, 3 * res * res)
+    assert list(has_mask) == [1, 0]
+    sec = targets[0].reshape(3, res, res)
+    assert np.all(sec[0] == -1) and np.all(sec[1] == -1)
+    # the class-2 section equals the direct frPoly rasterization
+    np.testing.assert_array_equal(
+        sec[2], polys_to_mask_wrt_box(gt_segms[0], rois[0], res)
+        .astype(np.float32))
