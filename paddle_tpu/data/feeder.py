@@ -82,8 +82,36 @@ class DataFeeder:
                 len(self.feed_list))
         out = {}
         for i, name in enumerate(self.feed_list):
-            col = [np.asarray(s[i]) for s in batch]
             var = self.feed_vars[i] if i < len(self.feed_vars) else None
+            if getattr(var, "lod_src2", None) is not None:
+                # nested LoD (level 2): each sample is a LIST of
+                # sub-sequences → pad to (B, N, T) with @LEN (B,) counts
+                # and @LEN2 (B, N) per-sub-sequence lengths (reference:
+                # framework/lod_tensor.h:229 nested offsets)
+                samples = [[np.asarray(ss) for ss in s[i]] for s in batch]
+                lens = np.array([len(s) for s in samples], np.int32)
+                n = max(int(lens.max()), 1)
+                tmax = max((c.shape[0] for s in samples for c in s),
+                           default=1)
+                t = self._bucket_len(int(tmax))
+                first = next((c for s in samples for c in s), None)
+                elem = first.shape[1:] if first is not None else ()
+                squeeze = elem == (1,)
+                dt = first.dtype if first is not None else np.float32
+                arr = np.zeros((len(samples), n, t) +
+                               (() if squeeze else elem), dt)
+                lens2 = np.zeros((len(samples), n), np.int32)
+                for r, s in enumerate(samples):
+                    for q, c in enumerate(s):
+                        arr[r, q, :c.shape[0]] = c[:, 0] if squeeze else c
+                        lens2[r, q] = c.shape[0]
+                if self.dtypes and self.dtypes[i] is not None:
+                    arr = arr.astype(self.dtypes[i])
+                out[name] = self._place(arr)
+                out[var.lod_src] = self._place(lens)
+                out[var.lod_src2] = self._place(lens2)
+                continue
+            col = [np.asarray(s[i]) for s in batch]
             lod_src = getattr(var, "lod_src", None)
             ragged = len({c.shape[:1] for c in col}) > 1
             if lod_src is not None or (ragged and col[0].ndim >= 1):

@@ -195,6 +195,9 @@ sampling_id = _SA.sampling_id
 # --- decode / CRF ----------------------------------------------------------
 beam_search = _DE.beam_search
 beam_search_decode = _DE.beam_search_decode
+beam_search_step = _DE.beam_search_batch_step
+beam_search_decode_lod = _DE.beam_search_decode_lod
+gather_beams = _DE.gather_beams
 crf_decoding = _DE.crf_decoding
 ctc_greedy_decoder = _DE.ctc_greedy_decode
 edit_distance = _DE.edit_distance

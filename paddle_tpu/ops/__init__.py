@@ -7,7 +7,9 @@ come from JAX VJP (replacing GradOpDescMaker); hand-written kernels live in
 
 from . import (control_flow, decode, detection, detection_extra, loss, math,
                nn, nn_extra, reduction, rnn, sampling, sequence, tensor)
-from .decode import (beam_search, beam_search_step, crf_decoding, ctc_align,
+from .decode import (beam_search, beam_search_batch_step,
+                     beam_search_decode_lod, beam_search_step,
+                     crf_decoding, ctc_align, gather_beams,
                      ctc_greedy_decode, ctc_loss, edit_distance,
                      linear_chain_crf)
 from .detection import (anchor_generator, bipartite_match, box_clip,

@@ -22,8 +22,9 @@ from jax import lax
 from ..core.enforce import enforce
 
 __all__ = ["ctc_loss", "ctc_align", "ctc_greedy_decode", "beam_search_step",
-           "beam_search", "linear_chain_crf", "crf_decoding",
-           "edit_distance"]
+           "beam_search", "beam_search_decode", "beam_search_batch_step",
+           "beam_search_decode_lod", "gather_beams", "linear_chain_crf",
+           "crf_decoding", "edit_distance"]
 
 _NEG = -1e30
 
@@ -119,27 +120,46 @@ def ctc_greedy_decode(log_probs, lengths, *, blank: int = 0):
 # ---------------------------------------------------------------------------
 
 def beam_search_step(scores, beam_log_probs, finished, *, beam_size: int,
-                     end_id: int, length_penalty: float = 0.0, step=1):
+                     end_id: int, length_penalty: float = 0.0, step=1,
+                     lengths=None):
     """One expansion step (the reference's beam_search op,
     operators/beam_search_op.cc, minus LoD bookkeeping): scores (K, V)
     log-probs for each live beam, beam_log_probs (K,) accumulated.
 
-    Returns (next_acc (K,), parent (K,), token (K,), next_finished (K,)).
-    Finished beams propagate with only the end_id continuation.
+    GNMT length normalization: candidates are RANKED by
+    ``total / ((5 + len) / 6) ** length_penalty`` where ``len`` is each
+    hypothesis's OWN token count — live candidates grow to ``step``,
+    finished beams keep the frozen length carried in ``lengths`` (K,).
+    The per-hypothesis lengths are what make the penalty observable: a
+    step-uniform divisor could never change a top-k. Accumulated scores
+    stay un-penalized. ``lengths=None`` starts every beam at ``step``.
+
+    Returns (next_acc (K,), parent (K,), token (K,), next_finished (K,),
+    next_lengths (K,)). Finished beams propagate with only the end_id
+    continuation.
     """
     K, V = scores.shape
+    if lengths is None:
+        lengths = jnp.full((K,), step, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
     # finished beams: freeze score, only end_id continues
     frozen = jnp.full((V,), _NEG).at[end_id].set(0.0)
     total = jnp.where(finished[:, None], beam_log_probs[:, None] + frozen,
                       beam_log_probs[:, None] + scores)  # (K, V)
-    lp = ((5.0 + step) / 6.0) ** length_penalty
+    step_i = jnp.asarray(step, jnp.int32)
+    cand_len = jnp.where(finished[:, None], lengths[:, None],
+                         step_i)                           # (K, V)
+    lp = ((5.0 + cand_len.astype(total.dtype)) / 6.0) ** length_penalty
     ranked = total / lp
     top, flat = lax.top_k(ranked.reshape(-1), K)
     parent = flat // V
     token = flat % V
     next_acc = total.reshape(-1)[flat]
     next_fin = finished[parent] | (token == end_id)
-    return next_acc, parent, token, next_fin
+    # already-finished keep their frozen length; newly-finished and live
+    # candidates are `step` tokens long
+    next_len = jnp.where(finished[parent], lengths[parent], step_i)
+    return next_acc, parent, token, next_fin, next_len
 
 
 def beam_search(init_state, step_fn: Callable, *, beam_size: int,
@@ -157,18 +177,19 @@ def beam_search(init_state, step_fn: Callable, *, beam_size: int,
     tok0 = jnp.full((beam_size,), bos_id, jnp.int32)
     acc0 = jnp.full((beam_size,), _NEG).at[0].set(0.0)  # only beam 0 live
     fin0 = jnp.zeros((beam_size,), bool)
+    len0 = jnp.zeros((beam_size,), jnp.int32)
 
     def tick(carry, t):
-        state, tok, acc, fin = carry
+        state, tok, acc, fin, lens = carry
         logp, state = step_fn(state, tok)
-        acc, parent, tok, fin = beam_search_step(
+        acc, parent, tok, fin, lens = beam_search_step(
             logp, acc, fin, beam_size=beam_size, end_id=end_id,
-            length_penalty=length_penalty, step=t + 1)
+            length_penalty=length_penalty, step=t + 1, lengths=lens)
         state = jax.tree_util.tree_map(lambda s: s[parent], state)
-        return (state, tok, acc, fin), (parent, tok)
+        return (state, tok, acc, fin, lens), (parent, tok)
 
-    (_, _, acc, _), (parents, tokens) = lax.scan(
-        tick, (init_state, tok0, acc0, fin0), jnp.arange(max_len))
+    (_, _, acc, _, lens), (parents, tokens) = lax.scan(
+        tick, (init_state, tok0, acc0, fin0, len0), jnp.arange(max_len))
 
     # backtrack: walk parent pointers from the end (reference:
     # beam_search_decode_op.cc walks the LoD sentence tree)
@@ -182,7 +203,10 @@ def beam_search(init_state, step_fn: Callable, *, beam_size: int,
         return seq[::-1]
 
     seqs = jax.vmap(backtrack)(jnp.arange(beam_size))
-    order = jnp.argsort(-acc)
+    # final ranking is length-normalized (GNMT); returned scores stay raw
+    lp = ((5.0 + jnp.maximum(lens, 1).astype(acc.dtype)) / 6.0
+          ) ** length_penalty
+    order = jnp.argsort(-(acc / lp))
     return seqs[order], acc[order]
 
 
@@ -340,3 +364,75 @@ def beam_search_decode(step_ids, step_parents, step_scores=None, *,
     scores = (step_scores[-1] if step_scores is not None
               else jnp.zeros((B, K), jnp.float32))
     return seqs, scores
+
+
+def beam_search_batch_step(log_probs, pre_scores, finished, step,
+                           lengths=None, *, beam_size: int, end_id: int,
+                           length_penalty: float = 0.0):
+    """Batched form of :func:`beam_search_step` — the op the reference
+    runs INSIDE its decode While block (reference:
+    operators/beam_search_op.cc; layers/nn.py beam_search), redesigned
+    for static shapes: each source keeps exactly K live beams.
+
+    log_probs (B, K, V), pre_scores (B, K), finished (B, K) bool-ish,
+    step scalar (the loop counter — drives the length penalty),
+    lengths (B, K) frozen hypothesis lengths (None starts at ``step``).
+    Returns (acc (B, K), parent (B, K) int32, token (B, K) int32,
+    finished (B, K) bool, lengths (B, K) int32).
+    """
+    t = jnp.reshape(step, ()).astype(jnp.int32)
+    if lengths is None:
+        lengths = jnp.broadcast_to(t, pre_scores.shape)
+
+    def one(lp, acc, fin, lens):
+        return beam_search_step(lp, acc, fin.astype(bool),
+                                beam_size=beam_size, end_id=end_id,
+                                length_penalty=length_penalty, step=t,
+                                lengths=lens)
+
+    acc, parent, token, fin, lens = jax.vmap(one)(
+        log_probs, pre_scores, finished, lengths)
+    return (acc, parent.astype(jnp.int32), token.astype(jnp.int32), fin,
+            lens)
+
+
+def gather_beams(x, parent):
+    """Reorder per-beam state by parent index: x (B, K, ...),
+    parent (B, K) -> x[b, parent[b, k]] (the state shuffle the
+    reference gets implicitly from beam_search's LoD selection)."""
+    idx = parent.astype(jnp.int32)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - idx.ndim))
+    return jnp.take_along_axis(x, jnp.broadcast_to(
+        idx, idx.shape[:2] + x.shape[2:]), axis=1)
+
+
+def beam_search_decode_lod(step_ids, step_parents, final_scores, *,
+                           end_id: int = 1,
+                           length_penalty: float = 0.0):
+    """Backtrack + rank + measure: the full beam_search_decode contract
+    (reference: operators/beam_search_decode_op.cc returns a LoD
+    level-2 tensor — level 1 = per-source candidate list, level 2 =
+    each candidate's tokens). The padded-dense equivalent of that
+    nested LoD is the triple returned here:
+
+    - sequences (B, K, T): candidate k of source b, best-first
+      (ranked by final score),
+    - lengths (B, K): its true token count (up to and including the
+      first ``end_id``; T when the beam never finished) — the level-2
+      offsets; K itself is the uniform level-1 fan-out,
+    - scores (B, K): final cumulative log-prob, descending.
+    """
+    seqs, _ = beam_search_decode(step_ids, step_parents, end_id=end_id)
+    T = step_ids.shape[0]
+    is_end = seqs == end_id
+    has_end = is_end.any(axis=-1)
+    first = jnp.argmax(is_end, axis=-1)
+    lengths = jnp.where(has_end, first + 1, T).astype(jnp.int32)
+    # rank length-normalized (GNMT); returned scores stay raw
+    lp = ((5.0 + jnp.maximum(lengths, 1).astype(final_scores.dtype))
+          / 6.0) ** length_penalty
+    order = jnp.argsort(-(final_scores / lp), axis=1)   # (B, K)
+    seqs = gather_beams(seqs, order)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    scores = jnp.take_along_axis(final_scores, order, axis=1)
+    return seqs, lengths, scores

@@ -53,6 +53,8 @@ class Var:
         # padded (B, T, ...) sequence data (SURVEY §7 ragged
         # canonicalization); propagated through recorded ops
         self.lod_src: Optional[str] = None
+        # level-2 nested LoD: companion (B, N) per-sub-sequence lengths
+        self.lod_src2: Optional[str] = None
 
     # -- math-op patching ---------------------------------------------------
     def _binop(self, other, fn, opname):
@@ -186,7 +188,27 @@ class Program:
         DataFeeder pads ragged batches and fills both)."""
         dtype = dtype or default_dtype()
         enforce(name not in self.vars, "var %s already exists", name)
-        if lod_level >= 1:
+        if lod_level >= 2:
+            # nested LoD (reference: framework/lod_tensor.h:229 level-2
+            # offsets — e.g. per-source candidate lists): padded
+            # (B, N, T, *elem) with TWO companions — <name>@LEN (B,) =
+            # sub-sequence count per sample, <name>@LEN2 (B, N) =
+            # token count per sub-sequence (0-padded)
+            enforce(lod_level == 2,
+                    "lod_level > 2 is not supported (the reference book "
+                    "models use at most level-2 results)")
+            elem = tuple(d for d in shape if d != -1)
+            if elem and elem[-1] == 1:
+                elem = elem[:-1]
+            v = Var(self, name, (-1, -1, -1) + elem, dtype, is_feed=True)
+            lv = Var(self, name + "@LEN", (-1,), jnp.int32, is_feed=True)
+            lv2 = Var(self, name + "@LEN2", (-1, -1), jnp.int32,
+                      is_feed=True)
+            self.vars[name + "@LEN"] = lv
+            self.vars[name + "@LEN2"] = lv2
+            v.lod_src = lv.name
+            v.lod_src2 = lv2.name
+        elif lod_level == 1:
             elem = tuple(d for d in shape if d != -1)  # -1 = old-style
             # batch placeholder; per-token scalars declare shape [1]
             if elem and elem[-1] == 1:
@@ -328,6 +350,7 @@ class Program:
             nv = Var(p, v.name, v.shape, v.dtype, is_param=v.is_param,
                      is_feed=v.is_feed, trainable=v.trainable)
             nv.lod_src = v.lod_src
+            nv.lod_src2 = v.lod_src2
             p.vars[k] = nv
         p.param_inits = dict(self.param_inits)
         p._const_values = dict(getattr(self, "_const_values", {}))

@@ -279,3 +279,222 @@ def test_greedy_decode_exports_to_serving_artifact(tmp_path):
                      "src_word_id@LEN": np.full((4,), 5, np.int32)})
     got2 = np.asarray(out2[0] if isinstance(out2, (list, tuple)) else out2)
     assert got2.shape[1] == 4  # (steps, batch) follows the feed
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 #6: beam-search decode through the book/export path with the
+# level-2-LoD result contract (per-source candidate lists, padded form)
+# ---------------------------------------------------------------------------
+
+beam_size = 4
+
+
+def _beam_decode_program(max_len=6):
+    """While.block() beam decode — the reference decoder_decode shape
+    (reference: tests/book/test_machine_translation.py:85, beam_search +
+    beam_search_decode ops inside While; contrib/decoder/
+    beam_search_decoder.py) on static-K beams: every source keeps exactly
+    `beam_size` live candidates, token/parent choices land in
+    TensorArrays, and beam_search_decode_lod backtracks them into the
+    padded level-2-LoD triple (seqs (B, K, T), lengths (B, K),
+    scores (B, K))."""
+    K = beam_size
+    prog = static.Program()
+    with static.program_guard(prog):
+        context = encoder(is_sparse=False)                  # (B, H)
+        counter = pd.zeros(shape=[1], dtype="int64")
+        limit = pd.fill_constant(shape=[1], dtype="int64", value=max_len)
+        state = pd.expand(pd.unsqueeze(context, axes=[1]),
+                          expand_times=[1, K, 1])           # (B, K, H)
+        word = pd.fill_constant_batch_size_like(
+            context, shape=[1, K], value=0, dtype="int64")  # bos
+        # beam 0 live, the rest muted (the reference's init_scores feed)
+        acc = pd.concat([
+            pd.fill_constant_batch_size_like(context, shape=[1, 1],
+                                             value=0.0, dtype="float32"),
+            pd.fill_constant_batch_size_like(context, shape=[1, K - 1],
+                                             value=-1e9, dtype="float32"),
+        ], axis=1)
+        fin = pd.fill_constant_batch_size_like(context, shape=[1, K],
+                                               value=0, dtype="bool")
+        lens = pd.fill_constant_batch_size_like(context, shape=[1, K],
+                                                value=0, dtype="int32")
+        tok_arr = pd.array_write(word, counter, capacity=max_len)
+        par_arr = pd.array_write(word, counter, capacity=max_len)
+        cond = pd.less_than(counter, limit)
+        w = pd.While(cond=cond)
+        with w.block():
+            word_emb = pd.embedding(
+                input=word, size=[dict_size, word_dim], dtype="float32",
+                param_attr=fluid.ParamAttr(name="vemb"))
+            new_state = pd.fc(input=[word_emb, state],
+                              size=decoder_size, act="tanh")
+            score = pd.fc(input=new_state, size=dict_size, act="softmax")
+            logp = pd.log(score)
+            acc2, parent, token, fin2, lens2 = pd.beam_search_step(
+                logp, acc, fin, counter + 1, lens, beam_size=K, end_id=1)
+            state2 = pd.gather_beams(new_state, parent)
+            pd.array_write(token, counter, array=tok_arr)
+            pd.array_write(parent, counter, array=par_arr)
+            pd.assign(state2, output=state)
+            pd.assign(acc2, output=acc)
+            pd.assign(pd.cast(token, "int64"), output=word)
+            pd.assign(fin2, output=fin)
+            pd.assign(lens2, output=lens)
+            pd.increment(counter, value=1, in_place=True)
+            pd.less_than(counter, limit, cond=cond)
+        toks, _n = pd.tensor_array_to_tensor(tok_arr, axis=0)  # (T, B, K)
+        pars, _n2 = pd.tensor_array_to_tensor(par_arr, axis=0)
+        seqs, lens, scores = pd.beam_search_decode_lod(toks, pars, acc,
+                                                       end_id=1)
+    return prog, seqs, lens, scores
+
+
+def _run_beam(exe, prog, fetches, src, src_len):
+    return exe.run(prog, feed={"src_word_id": src,
+                               "src_word_id@LEN": src_len},
+                   fetch_list=fetches)
+
+
+def test_mt_beam_decode_while():
+    prog, seqs, lens, scores = _beam_decode_program()
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    src = np.array([[3, 4, 5], [6, 7, 0]], np.int64)
+    sl = np.array([3, 2], np.int32)
+    s, l, sc = _run_beam(exe, prog, [seqs, lens, scores], src, sl)
+    s, l, sc = map(np.asarray, (s, l, sc))
+    assert s.shape == (2, beam_size, 6)
+    assert l.shape == (2, beam_size) and (l >= 1).all() and (l <= 6).all()
+    # candidates ranked best-first per source
+    assert (np.diff(sc, axis=1) <= 1e-6).all()
+    # deterministic
+    s2, l2, sc2 = map(np.asarray,
+                      _run_beam(exe, prog, [seqs, lens, scores], src, sl))
+    np.testing.assert_array_equal(s, s2)
+    np.testing.assert_array_equal(l, l2)
+
+
+def test_mt_beam_decode_matches_functional_beam_search():
+    """The While-DSL decode must equal ops.decode.beam_search (the
+    functional path) run with the SAME weights pulled from the scope."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import decode as D
+
+    prog, seqs, lens, scores = _beam_decode_program()
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    src = np.array([[3, 4, 5]], np.int64)
+    sl = np.array([3], np.int32)
+    s, l, sc = map(np.asarray,
+                   _run_beam(exe, prog, [seqs, lens, scores], src, sl))
+
+    # pull the decoder weights out of the scope by shape signature
+    vals = {n: np.asarray(exe.scope.get(n))
+            for n in prog.param_inits if exe.scope.has(n)}
+    vemb = vals["vemb"]
+    w_word = next(v for n, v in vals.items()
+                  if v.ndim == 2 and v.shape == (word_dim, decoder_size)
+                  and "fc" in n)
+    w_state = next(v for n, v in vals.items()
+                   if v.shape == (decoder_size, decoder_size))
+    b1 = next(v for n, v in vals.items()
+              if v.shape == (decoder_size,) and "_b" in n)
+    w_out = next(v for n, v in vals.items()
+                 if v.shape == (decoder_size, dict_size))
+    b_out = next(v for n, v in vals.items()
+                 if v.shape == (dict_size,) and "_b" in n)
+
+    # context = encoder forward on the same feed, via the program itself
+    ctx_var = next(v for v in prog.vars.values()
+                   if v.name.startswith("sequence_last_step"))
+    ctx = np.asarray(exe.run(prog, feed={"src_word_id": src,
+                                         "src_word_id@LEN": sl},
+                             fetch_list=[ctx_var])[0])
+
+    def step_fn(state, tok):
+        emb = jnp.asarray(vemb)[tok]
+        h = jnp.tanh(emb @ w_word + state @ w_state + b1)
+        p = jax.nn.softmax(h @ w_out + b_out)
+        return jnp.log(p), h
+
+    import jax
+
+    init = jnp.broadcast_to(jnp.asarray(ctx[0]),
+                            (beam_size, decoder_size))
+    fseqs, fscores = D.beam_search(init, step_fn, beam_size=beam_size,
+                                   max_len=6, bos_id=0, end_id=1)
+    np.testing.assert_allclose(sc[0], np.asarray(fscores), atol=1e-4)
+    np.testing.assert_array_equal(s[0], np.asarray(fseqs))
+
+
+def test_beam_decode_exports_and_native_predictor_loads(tmp_path):
+    """The beam While program exports through save_inference_model, the
+    python predictor replays it bit-exact (including a different batch),
+    and the C++ NativePredictor parses the artifact (reference:
+    io.py save_inference_model over beam-search decode programs,
+    inference/api serving them)."""
+    prog, seqs, lens, scores = _beam_decode_program()
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    src = np.array([[3, 4, 5], [6, 7, 0]], np.int64)
+    sl = np.array([3, 2], np.int32)
+    feed = {"src_word_id": src, "src_word_id@LEN": sl}
+    ref = [np.asarray(v) for v in
+           exe.run(prog, feed=feed, fetch_list=[seqs, lens, scores])]
+
+    d = str(tmp_path / "beam_artifact")
+    static.save_inference_model(
+        d, ["src_word_id", "src_word_id@LEN"], [seqs, lens, scores], exe,
+        main_program=prog, example_feeds=feed)
+    pred = static.load_inference_model(d)
+    out = [np.asarray(v) for v in pred.run(feed)]
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(got, want)
+
+    # batch polymorphism: same artifact, batch 3
+    out3 = pred.run({"src_word_id": np.full((3, 4), 5, np.int64),
+                     "src_word_id@LEN": np.full((3,), 4, np.int32)})
+    assert np.asarray(out3[0]).shape[0] == 3
+
+    # the native (C++) artifact reader loads it
+    from paddle_tpu.native import NativePredictor
+
+    p = NativePredictor(d)
+    assert p.feed_names == ["src_word_id", "src_word_id@LEN"]
+    assert len(p.fetch_names) == 3
+    p.close()
+
+
+def test_lod_level2_data_feeds_nested_lists():
+    """Nested LoD (level 2) through data() + DataFeeder: per-source
+    candidate lists pad to (B, N, T) with @LEN/@LEN2 companions — the
+    padded equivalent of the reference's level-2 offsets
+    (reference: framework/lod_tensor.h:229)."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        cands = pd.data("cands", shape=[1], dtype="int64", lod_level=2)
+        lens2 = prog.vars["cands@LEN2"]
+        # consumer: total non-pad tokens per sample via the companion
+        total = pd.reduce_sum(lens2, dim=1)
+    feeder = pdata.DataFeeder(feed_list=[cands], program=prog)
+    batch = [
+        ([ [3, 4, 5], [6, 7] ],),          # sample 0: two candidates
+        ([ [8] ],),                        # sample 1: one candidate
+    ]
+    fed = feeder.feed(batch)
+    arr = np.asarray(fed["cands"])
+    assert arr.shape[0] == 2 and arr.shape[1] == 2 and arr.shape[2] >= 3
+    np.testing.assert_array_equal(np.asarray(fed["cands@LEN"]), [2, 1])
+    l2 = np.asarray(fed["cands@LEN2"])
+    np.testing.assert_array_equal(l2[0, :2], [3, 2])
+    assert l2[1, 0] == 1 and l2[1, 1] == 0
+    np.testing.assert_array_equal(arr[0, 0, :3], [3, 4, 5])
+    np.testing.assert_array_equal(arr[1, 1], np.zeros(arr.shape[2]))
+
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    out = exe.run(prog, feed={k: np.asarray(v) for k, v in fed.items()},
+                  fetch_list=[total])[0]
+    np.testing.assert_array_equal(np.asarray(out), [5, 1])

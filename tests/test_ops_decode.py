@@ -249,3 +249,55 @@ def test_edit_distance_normalized():
                            jnp.asarray([[1, 2, 4]]), jnp.asarray([3]),
                            normalized=True)
     assert float(got[0]) == pytest.approx(1 / 3)
+
+
+def test_length_penalty_is_observable_in_step():
+    """Review r3: the GNMT penalty must compare candidates by their OWN
+    lengths — a finished short beam vs a live long beam rank differently
+    as alpha grows."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import decode as DC
+
+    K, V = 2, 3
+    end = 1
+    # beam 0 finished at length 2 with acc -1.0; beam 1 live, acc -1.05,
+    # its best continuation adds ~0 logprob (token 2)
+    acc = jnp.asarray([-1.0, -1.05])
+    fin = jnp.asarray([True, False])
+    lens = jnp.asarray([2, 5], jnp.int32)
+    scores = jnp.asarray([[0.0, 0.0, 0.0],
+                          [-20.0, -20.0, -1e-4]])
+    a0 = DC.beam_search_step(scores, acc, fin, beam_size=K, end_id=end,
+                             length_penalty=0.0, step=6, lengths=lens)
+    # alpha 0: finished beam 0 (-1.0) outranks beam 1 (-1.0501)
+    assert int(a0[1][0]) == 0
+    a9 = DC.beam_search_step(scores, acc, fin, beam_size=K, end_id=end,
+                             length_penalty=5.0, step=6, lengths=lens)
+    # large alpha: the longer hypothesis is normalized far more gently
+    assert int(a9[1][0]) == 1
+    # and the frozen length propagates
+    assert int(a0[4][jnp.argmax(a0[1] == 0)]) == 2
+
+
+def test_decode_lod_length_penalty_reorders():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import decode as DC
+
+    T, B, K = 4, 1, 2
+    end = 1
+    # beam 0: ends at t=1 (length 2); beam 1: never ends (length 4)
+    ids = jnp.asarray([[[5, 6]], [[end, 7]], [[0, 8]], [[0, 9]]])
+    parents = jnp.zeros((T, B, K), jnp.int32).at[:, 0, 1].set(1)
+    final = jnp.asarray([[-1.0, -1.2]])
+    s0, l0, sc0 = DC.beam_search_decode_lod(ids, parents, final,
+                                            end_id=end)
+    np.testing.assert_allclose(float(sc0[0, 0]), -1.0, rtol=1e-6)
+    assert int(l0[0, 0]) == 2
+    s5, l5, sc5 = DC.beam_search_decode_lod(ids, parents, final,
+                                            end_id=end,
+                                            length_penalty=5.0)
+    # normalization favors the longer beam now
+    np.testing.assert_allclose(float(sc5[0, 0]), -1.2, rtol=1e-6)
+    assert int(l5[0, 0]) == 4
