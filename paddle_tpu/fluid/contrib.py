@@ -17,35 +17,38 @@ from ..utils.memory import memory_usage
 
 
 class Compressor:
-    """reference: contrib/slim/core/compressor.py — strategy-driven
-    compression driver. config() takes {"prune": {...}, "distill": {...}}
-    dicts; run(train_step) executes the schedule over the wrapped model
-    using slim's pruners/distillers."""
+    """reference: contrib/slim/core/compressor.py — the contrib-era entry
+    point, kept as a thin front over the real driver
+    (paddle_tpu.slim.Compressor): ``config()`` takes the strategy config
+    (dict or JSON path, slim.build_strategies format), ``run()``
+    delegates the epoch loop."""
 
-    def __init__(self, model=None, optimizer=None, **kw):
-        self.model = model
-        self.optimizer = optimizer
-        self.strategies = {}
+    _KNOWN = ("params", "optimizer", "loss_fn", "train_reader", "eval_fn",
+              "epochs", "checkpoint_dir", "converge_delta")
+
+    def __init__(self, params=None, optimizer=None, loss_fn=None,
+                 train_reader=None, eval_fn=None, epochs: int = 1, **kw):
+        unknown = sorted(set(kw) - set(self._KNOWN))
+        if unknown:
+            raise TypeError(
+                f"Compressor got unknown arguments {unknown}; the contrib "
+                f"front takes {list(self._KNOWN)} (see "
+                "paddle_tpu.slim.Compressor)")
+        self._args = dict(params=params, optimizer=optimizer,
+                          loss_fn=loss_fn, train_reader=train_reader,
+                          eval_fn=eval_fn, epochs=epochs, **kw)
+        self._strategies = []
 
     def config(self, config_or_path):
-        if isinstance(config_or_path, str):
-            import json
+        from ..slim import build_strategies
 
-            with open(config_or_path) as f:
-                self.strategies = json.load(f)
-        else:
-            self.strategies = dict(config_or_path)
+        self._strategies = build_strategies(config_or_path)
         return self
 
-    def run(self, train_step=None, steps: int = 0):
-        out = self.model
-        if "prune" in self.strategies:
-            pruner = Pruner(**self.strategies["prune"])
-            out = pruner.prune(out)
-        if train_step is not None:
-            for _ in range(steps):
-                train_step(out)
-        return out
+    def run(self):
+        from ..slim import Compressor as _C
+
+        return _C(strategies=self._strategies, **self._args).run()
 
 
 class Calibrator:

@@ -290,9 +290,12 @@ class Program:
         # sequence metadata rides along: outputs inherit the first
         # lod-carrying input's lengths companion (row-preserving ops keep
         # ragged structure; consumers that reduce it clear lod_src)
-        lod_src = next((self.vars[n].lod_src for n in in_names
-                        if n in self.vars and
-                        getattr(self.vars[n], "lod_src", None)), None)
+        lod_carrier = next((self.vars[n] for n in in_names
+                            if n in self.vars and
+                            getattr(self.vars[n], "lod_src", None)), None)
+        lod_src = lod_carrier.lod_src if lod_carrier is not None else None
+        lod_src2 = (getattr(lod_carrier, "lod_src2", None)
+                    if lod_carrier is not None else None)
         out_vars = []
         for spec in flat:
             oname = self.unique_name(name)
@@ -301,6 +304,7 @@ class Program:
             # keep their traced shape (informational only)
             ov = Var(self, oname, shape, spec.dtype)
             ov.lod_src = lod_src
+            ov.lod_src2 = lod_src2
             self.vars[oname] = ov
             out_vars.append(ov)
         self.nodes.append(_OpNode(fn, in_names, [v.name for v in out_vars],

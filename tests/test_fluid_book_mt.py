@@ -498,3 +498,13 @@ def test_lod_level2_data_feeds_nested_lists():
     out = exe.run(prog, feed={k: np.asarray(v) for k, v in fed.items()},
                   fetch_list=[total])[0]
     np.testing.assert_array_equal(np.asarray(out), [5, 1])
+
+
+def test_lod_level2_metadata_propagates_through_ops():
+    """Review r3: recorded ops keep BOTH companions of level-2 data."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        cands = pd.data("cands", shape=[1], dtype="int64", lod_level=2)
+        y = cands * 2
+    assert y.lod_src == "cands@LEN"
+    assert y.lod_src2 == "cands@LEN2"
