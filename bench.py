@@ -289,6 +289,100 @@ def bench_transformer_nmt(steps: int, batch_size: int, amp=None,
                         amp=amp)
 
 
+def bench_bert_long(steps: int, batch_size: int, amp=None,
+                    seq_len: int = 2048):
+    """Long-context BERT MLM step at seq 2048 — the SURVEY §5.7
+    long-sequence showcase: attention cost is O(T^2), so this is where
+    the flash-attention kernel path engages on TPU (T % 128 == 0, head
+    dim 64) and remat at block boundaries keeps activations inside HBM.
+    Compare against --model bert_base (seq 128) for the scaling story."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert as B
+
+    pt.seed(0)
+    batch_size = min(batch_size, 4)
+    cfg = B.BertConfig.base()
+    cfg.max_position = seq_len
+    cfg.remat = True
+    model = B.BertForPretraining(cfg)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, seq_len)))
+        nsp = jnp.asarray(rng.integers(0, 2, (bs,)))
+        return (ids, ids, nsp)
+
+    def loss_fn(out, batch):
+        return out  # forward_fused_loss returns the scalar loss
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp, method="forward_fused_loss")
+
+
+def bench_deepfm_sparse(steps: int, batch_size: int, amp=None):
+    """DeepFM with ROW-SPARSE embedding updates (the SelectedRows
+    capability, reference: operators/optimizers/adam_op.h sparse branch):
+    the optimizer touches O(batch x fields) table rows per step instead
+    of O(vocab). Run next to --model deepfm (dense updates) — the gap IS
+    the sparse-update win, and it widens with total_vocab."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import deepfm as DF
+    from paddle_tpu.optimizer.sparse import sparse_minimize_fn
+
+    pt.seed(0)
+    cfg = DF.DeepFMConfig(total_vocab=100_000, num_fields=26, dense_dim=13,
+                          embed_dim=16, embedding_axis=None,
+                          sparse_grads=True)
+    model = DF.DeepFM(cfg)
+    params = model.named_parameters()
+    rng = np.random.default_rng(0)
+
+    import contextlib
+
+    from paddle_tpu.core.dtypes import policy_scope
+
+    def forward_loss(p, ids, dense):
+        # honor --amp exactly like _train_bench, so the dense-vs-sparse
+        # comparison isolates the update path, not the dtype policy
+        with (policy_scope(amp) if amp else contextlib.nullcontext()):
+            logits, _ = model.functional_call(p, ids, dense)
+            labels = (ids[:, 0] % 2).astype(jnp.float32)
+            return DF.loss_fn(logits, labels)
+
+    opt = optimizer.Adam(1e-3)
+    init_fn, step_fn = sparse_minimize_fn(model, forward_loss, opt)
+    state = init_fn(params)
+    ids = jnp.asarray(rng.integers(0, cfg.total_vocab,
+                                   (batch_size, cfg.num_fields)))
+    dense = jnp.asarray(rng.normal(size=(batch_size, cfg.dense_dim))
+                        .astype(np.float32))
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    from paddle_tpu.utils.flops import lowered_flops
+
+    dispatch_flops = lowered_flops(step, params, state, ids, dense)
+    for _ in range(3):
+        loss, params, state = step(params, state, ids, dense)
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, params, state = step(params, state, ids, dense)
+        if i % 4 == 3:
+            float(loss)
+    float(loss)
+    dt = time.perf_counter() - t0
+    extras = {}
+    if dispatch_flops:
+        extras["flops_per_sec"] = dispatch_flops * steps / dt
+    return steps * batch_size / dt, "examples/sec", extras
+
+
 def bench_deepfm(steps: int, batch_size: int, amp=None):
     """BASELINE config 5: DeepFM sparse CTR step."""
     import numpy as np
@@ -461,8 +555,10 @@ MODELS = {
     "se_resnext50": bench_se_resnext50,
     "resnet50": bench_resnet50,
     "bert_base": bench_bert_base,
+    "bert_long": bench_bert_long,
     "transformer_nmt": bench_transformer_nmt,
     "deepfm": bench_deepfm,
+    "deepfm_sparse": bench_deepfm_sparse,
 }
 
 

@@ -177,8 +177,9 @@ class DistillationStrategy(Strategy):
         self.teacher_apply = teacher_apply
         self.teacher_params = teacher_params
         self.distiller = distiller or Distiller()
-
-    def on_epoch_begin(self, context: Context):
+        # ONE wrapper object for the whole run: the Compressor's step
+        # cache is keyed by identity, so a fresh closure per epoch would
+        # force a full retrace every epoch
         d, tp, ta = self.distiller, self.teacher_params, self.teacher_apply
 
         def wrap(loss_fn):
@@ -190,7 +191,11 @@ class DistillationStrategy(Strategy):
 
             return distilled
 
-        context.loss_wrapper = wrap
+        self._wrap = wrap
+
+    def on_epoch_begin(self, context: Context):
+        if context.loss_wrapper is not self._wrap:
+            context.loss_wrapper = self._wrap
 
     def on_epoch_end(self, context: Context):
         if context.epoch_id + 1 >= self.end_epoch:
@@ -298,8 +303,12 @@ def build_strategies(config) -> List[Strategy]:
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
+    enforce("strategies" in config,
+            "compression config needs a 'strategies' list (got keys %s) — "
+            "e.g. {'strategies': [{'kind': 'uniform_prune', "
+            "'target_ratio': 0.5}]}", sorted(config))
     out = []
-    for spec in config.get("strategies", []):
+    for spec in config["strategies"]:
         spec = dict(spec)
         kind = spec.pop("kind")
         enforce(kind in _STRATEGY_KINDS,

@@ -111,7 +111,9 @@ def compute_sensitivities(params: Dict[str, jnp.ndarray],
         try:
             with open(sensitivities_file) as f:
                 sens = {k: {float(r): v for r, v in d.items()}
-                        for k, d in json.load(f).items()}
+                        for k, d in json.load(f).items()
+                        if k in params}  # stale entries (renamed layers,
+                #                          shared files) are dropped
         except (OSError, ValueError):
             sens = {}
     base = float(eval_fn(params))
@@ -145,6 +147,10 @@ def greedy_ratios_for_target(sensitivities: Dict[str, Dict[float, float]],
     single ratio upgrade with the best (extra zeros / extra metric loss)
     trade until the target is met (the greedy core of the reference's
     SensitivePruneStrategy._get_best_ratios)."""
+    unknown = sorted(set(sensitivities) - set(params))
+    enforce(not unknown,
+            "sensitivities contain params absent from the model: %s "
+            "(stale sensitivities file?)", unknown)
     sizes = {n: int(params[n].size) for n in sensitivities}
     total = sum(sizes.values())
     enforce(total > 0, "no prunable params matched")

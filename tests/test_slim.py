@@ -313,3 +313,25 @@ def test_contrib_compressor_front_runs():
     assert len(ctx.eval_history) == 2 and ctx.masks
     with pytest.raises(TypeError, match="unknown arguments"):
         fluid.contrib.Compressor(model=object())
+
+
+def test_build_strategies_rejects_legacy_config_shape():
+    """Review r3: the old contrib {'prune': {...}} shape fails loudly
+    instead of silently compressing nothing."""
+    with pytest.raises(Exception, match="'strategies' list"):
+        slim.build_strategies({"prune": {"ratios": 0.5}})
+
+
+def test_distillation_wrapper_is_stable_across_epochs():
+    """Review r3: one wrapper identity for the run — the step cache must
+    hold between epochs (no per-epoch retrace)."""
+    params, loss_fn, reader, eval_fn = _toy_setup()
+    strat = slim.DistillationStrategy(
+        lambda tp, xb, yb: xb @ tp["fc.weight"] + tp["fc.bias"],
+        dict(params))
+    c = slim.Compressor(params, optimizer.SGD(0.1), loss_fn, reader,
+                        eval_fn=eval_fn, epochs=3, strategies=[strat])
+    ctx = c.run()
+    # after run, the cached step's key still matches the context state
+    assert c._step_cache[0] == (id(ctx.masks), id(ctx.loss_wrapper)) or \
+        ctx.loss_wrapper is None

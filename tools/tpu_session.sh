@@ -27,7 +27,7 @@ run probe 300 python -c "import jax; print(jax.devices()); import jax.numpy as j
 # 1. bench: every model; the JSON lines land in the logs AND
 #    BENCH_HISTORY.json picks up accelerator entries automatically
 run bench_mnist        900  python bench.py
-for m in resnet50 bert_base transformer_nmt deepfm stacked_lstm vgg16 se_resnext50; do
+for m in resnet50 bert_base bert_long transformer_nmt deepfm deepfm_sparse stacked_lstm vgg16 se_resnext50; do
   run "bench_$m"       1200 python bench.py --model "$m"
 done
 # sweep knobs on the two headliners
