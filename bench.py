@@ -362,25 +362,42 @@ def bench_deepfm_sparse(steps: int, batch_size: int, amp=None):
                                    (batch_size, cfg.num_fields)))
     dense = jnp.asarray(rng.normal(size=(batch_size, cfg.dense_dim))
                         .astype(np.float32))
-    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    k = max(1, _STEPS_PER_CALL or 1)  # honor --steps-per-call
 
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state, ids, dense):
+        if k == 1:
+            return step_fn(params, state, ids, dense)
+
+        def body(carry, _):
+            p, s = carry
+            l, p, s = step_fn(p, s, ids, dense)
+            return (p, s), l
+
+        (params, state), ls = jax.lax.scan(body, (params, state), None,
+                                           length=k)
+        return ls[-1], params, state
+
+    from paddle_tpu.core.profiler import RecordEvent
     from paddle_tpu.utils.flops import lowered_flops
 
     dispatch_flops = lowered_flops(step, params, state, ids, dense)
     for _ in range(3):
         loss, params, state = step(params, state, ids, dense)
     float(loss)
+    outer = max(1, steps // k)
     t0 = time.perf_counter()
-    for i in range(steps):
-        loss, params, state = step(params, state, ids, dense)
+    for i in range(outer):
+        with RecordEvent(f"train_step[{k}]"):
+            loss, params, state = step(params, state, ids, dense)
         if i % 4 == 3:
             float(loss)
     float(loss)
     dt = time.perf_counter() - t0
     extras = {}
     if dispatch_flops:
-        extras["flops_per_sec"] = dispatch_flops * steps / dt
-    return steps * batch_size / dt, "examples/sec", extras
+        extras["flops_per_sec"] = dispatch_flops * outer / dt
+    return outer * k * batch_size / dt, "examples/sec", extras
 
 
 def bench_deepfm(steps: int, batch_size: int, amp=None):

@@ -179,13 +179,15 @@ class DistillationStrategy(Strategy):
         self.distiller = distiller or Distiller()
         # ONE wrapper object for the whole run: the Compressor's step
         # cache is keyed by identity, so a fresh closure per epoch would
-        # force a full retrace every epoch
-        d, tp, ta = self.distiller, self.teacher_params, self.teacher_apply
-
-        def wrap(loss_fn):
+        # force a full retrace every epoch. The closure reads through
+        # self, so reassigning strategy attributes before run() still
+        # takes effect (late binding preserved).
+        def wrap(loss_fn, _self=self):
             def distilled(params, *batch):
+                d = _self.distiller
                 student_logits = loss_fn(params, *batch, logits_only=True)
-                teacher_logits = ta(tp, *batch)
+                teacher_logits = _self.teacher_apply(
+                    _self.teacher_params, *batch)
                 label = batch[-1] if d.hard_weight else None
                 return d.loss(student_logits, teacher_logits, label)
 

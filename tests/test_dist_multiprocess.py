@@ -18,6 +18,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -352,3 +354,81 @@ def restore_state_local(path):
     from paddle_tpu import checkpoint
 
     return checkpoint.restore_state(path)
+
+
+# ---------------------------------------------------------------------------
+# Capstone: the BERT dp x tp x pp FLAGSHIP across 2 processes (items
+# r2#3 + r2#5 composed — the reference's distributed benchmark-model
+# capability, test_dist_base.py + benchmark/fluid/models)
+# ---------------------------------------------------------------------------
+
+BERT_HYBRID_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import fleet
+from paddle_tpu.parallel import build_bert_hybrid_step
+
+f = fleet.init()  # coordination only; mesh built explicitly below
+rank = f.worker_index()
+assert len(jax.devices()) == 8
+mesh = pt.build_mesh(dp=2, tp=2, pp=2)  # dp spans the two processes
+pt.set_mesh(mesh)
+step, ref_step, params, feed = build_bert_hybrid_step(mesh)
+jstep = jax.jit(step)
+losses = []
+p = params
+for i in range(2):
+    loss, p = jstep(p, *feed)
+    losses.append(float(loss))
+print("LOSSES[%%d]:%%s" %% (rank, json.dumps(losses)), flush=True)
+f.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_bert_hybrid_flagship_across_processes(tmp_path):
+    """The real BertForPretraining trains under dp2 x tp2 x pp2 with the
+    dp axis spanning two launcher processes; losses match the
+    single-process run of the same builder within float32 tolerance
+    (the partitioner compiles different layouts per topology, so exact
+    bitwise equality is not a contract here)."""
+    script = tmp_path / "bert_hybrid_worker.py"
+    script.write_text(BERT_HYBRID_WORKER % {"repo": REPO})
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--local-devices", "4",
+         "--log-dir", str(log_dir), "--timeout", "480", str(script)],
+        capture_output=True, text=True, env=dict(os.environ), cwd=REPO,
+        timeout=540)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+    rank0 = _losses_from(r.stdout, 0)
+    with open(log_dir / "workerlog.1") as fh:
+        rank1 = _losses_from(fh.read(), 1)
+    np.testing.assert_allclose(rank0, rank1, rtol=1e-5)
+
+    # single-process reference: same builder, same seeds, 8 local devices
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.parallel import build_bert_hybrid_step
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        import pytest as _pytest
+
+        _pytest.skip("needs 8 virtual devices for the reference run")
+    mesh = pt.build_mesh(dp=2, tp=2, pp=2, devices=devs[:8])
+    step, _ref, params, feed = build_bert_hybrid_step(mesh)
+    jstep = jax.jit(step)
+    ref, p = [], params
+    for i in range(2):
+        loss, p = jstep(p, *feed)
+        ref.append(float(loss))
+    np.testing.assert_allclose(rank0, ref, rtol=1e-4)
