@@ -729,26 +729,46 @@ def main():
     metric = f"{args.model}_throughput"
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_HISTORY.json")
+    line = report_line(metric, value, unit, extras,
+                       history_path=hist_path, smoke=args.smoke,
+                       dp=args.dp)
+    print(json.dumps(line))
+
+
+def report_line(metric, value, unit, extras, *, history_path, smoke,
+                dp=1, device=None):
+    """Post-run reporting: history recording + regression contract + MFU.
+
+    Separated from main() so the ACCELERATOR code path (history writes,
+    regression warnings, MFU vs the peak table) is exercised by tests
+    with a stand-in device BEFORE the first real chip session — the
+    machinery must not meet hardware for the first time in production
+    (VERDICT r2 'first on-chip session will shake out bugs' risk).
+    ``device`` defaults to jax.devices()[0].
+    """
     history = {}
-    if os.path.exists(hist_path):
+    if os.path.exists(history_path):
         try:
-            with open(hist_path) as f:
+            with open(history_path) as f:
                 history = json.load(f)
         except Exception:
             history = {}
-    import jax
+    if device is None:
+        import jax
 
-    on_accelerator = jax.devices()[0].platform != "cpu"
+        device = jax.devices()[0]
+
+    on_accelerator = device.platform != "cpu"
     vs_baseline, regression = evaluate_against_history(
         metric, value, history, on_accelerator=on_accelerator,
-        record=not args.smoke)
+        record=not smoke)
     if regression:
         print(f"WARNING: {metric} regressed >10% vs best recorded "
               f"({value:.2f} vs {history[metric]:.2f} {unit})",
               file=sys.stderr)
-    if not args.smoke and on_accelerator:
+    if not smoke and on_accelerator:
         # CPU debug runs never pollute the recorded trajectory
-        with open(hist_path, "w") as f:
+        with open(history_path, "w") as f:
             json.dump(history, f, indent=1)
 
     line = {"metric": metric, "value": round(value, 2), "unit": unit,
@@ -761,13 +781,12 @@ def main():
     line["mfu"] = None
     if flops_per_sec:
         line["tflops_per_sec"] = round(flops_per_sec / 1e12, 3)
-        m = _mfu(flops_per_sec, jax.devices()[0],
-                 n_devices=max(1, args.dp))
+        m = _mfu(flops_per_sec, device, n_devices=max(1, dp))
         if m is not None:
             line["mfu"] = round(m, 4)
     if regression:
         line["regression"] = True
-    print(json.dumps(line))
+    return line
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ import numpy as np
 
 from .. import initializer as I
 from ..ops import loss as OL
+from ..core.enforce import enforce
 from ..ops import math as OM
 from ..ops import nn as ON
 from .program import Program, Var, default_main_program
@@ -28,20 +29,65 @@ def _prog(*vars_) -> Program:
     return default_main_program()
 
 
+def shared_param(prog: Program, pname: str, shape, init) -> Var:
+    """Get-or-create a named, shareable parameter — the one sharing
+    protocol for param_attr layers (fc, embedding): an existing var must
+    be a real parameter of the matching shape (a silent collision with a
+    feed/op-output var would train nothing)."""
+    if pname in prog.vars:
+        v = prog.vars[pname]
+        enforce(v.is_param,
+                "param_attr %r collides with a non-parameter var — "
+                "pick a different name", pname)
+        enforce(tuple(v.shape) == tuple(shape),
+                "shared param %s has shape %s, this layer needs %s",
+                pname, tuple(v.shape), tuple(shape))
+        return v
+    return prog.create_parameter(pname, tuple(shape), initializer=init)
+
+
 def fc(input, size: int, act: Optional[str] = None,
-       bias_attr: bool = True, name: str = "fc") -> Var:
+       bias_attr: bool = True, name: str = "fc",
+       param_attr=None) -> Var:
     """reference: layers/nn.py fc:210. A LIST input gets one weight per
-    entry and the projections sum (the reference's multi-input mul+sum)."""
-    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    entry and the projections sum (the reference's multi-input mul+sum).
+
+    ``param_attr`` with a name pins EXACT weight names, enabling the
+    reference's cross-program weight sharing — the book pattern where
+    decoder_decode reuses decoder_train's weights through the scope
+    (reference: tests/book/test_machine_translation.py). A single
+    (non-list) input uses ``<name>`` verbatim; a LIST input appends
+    ``_0``, ``_1``, ... per entry; the bias gets ``<name>.b``. Keep the
+    input STRUCTURE identical across sharing programs — mixing the bare
+    and suffixed forms for one name in the same program is rejected."""
+    is_list = isinstance(input, (list, tuple))
+    inputs = list(input) if is_list else [input]
     prog = _prog(*inputs)
-    ws = [prog.create_parameter(
-        prog.unique_name(f"{name}_w"), (x.shape[-1], size),
-        initializer=I.XavierUniform()) for x in inputs]
+    attr_name = getattr(param_attr, "name", None) or (
+        param_attr if isinstance(param_attr, str) else None)
+    if attr_name is not None:
+        # catch bare-vs-suffixed mixing early (an arity change between
+        # two fc calls sharing one name would silently fork the weights)
+        clash = attr_name if is_list else f"{attr_name}_0"
+        enforce(clash not in prog.vars,
+                "param_attr %r is already used by an fc with a %s input "
+                "— weight names differ by input structure, so these "
+                "calls would NOT share", attr_name,
+                "single (non-list)" if is_list else "list")
+
+    def wname(i):
+        if attr_name is None:
+            return prog.unique_name(f"{name}_w")
+        return f"{attr_name}_{i}" if is_list else attr_name
+
+    ws = [shared_param(prog, wname(i), (x.shape[-1], size),
+                       I.XavierUniform())
+          for i, x in enumerate(inputs)]
     args = inputs + ws
     if bias_attr:
-        b = prog.create_parameter(prog.unique_name(f"{name}_b"), (size,),
-                                  initializer=I.Constant(0.0))
-        args.append(b)
+        bname = (f"{attr_name}.b" if attr_name is not None
+                 else prog.unique_name(f"{name}_b"))
+        args.append(shared_param(prog, bname, (size,), I.Constant(0.0)))
     k = len(inputs)
 
     def fn(*vals):
@@ -93,12 +139,8 @@ def embedding(input: Var, size: Sequence[int], padding_idx=None,
     prog = _prog(input)
     attr_name = getattr(param_attr, "name", None) or (
         param_attr if isinstance(param_attr, str) else None)
-    if attr_name and attr_name in prog.vars:
-        w = prog.vars[attr_name]  # shared table
-    else:
-        w = prog.create_parameter(
-            attr_name or prog.unique_name(f"{name}_w"), tuple(size),
-            initializer=I.XavierNormal())
+    w = shared_param(prog, attr_name or prog.unique_name(f"{name}_w"),
+                     tuple(size), I.XavierNormal())
     return prog.apply(lambda ids, t: ON.embedding(ids, t, padding_idx),
                       [input, w], name=name)
 

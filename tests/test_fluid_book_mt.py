@@ -508,3 +508,126 @@ def test_lod_level2_metadata_propagates_through_ops():
         y = cands * 2
     assert y.lod_src == "cands@LEN"
     assert y.lod_src2 == "cands@LEN2"
+
+
+def test_train_then_beam_decode_shares_trained_weights():
+    """The reference book flow: decoder_decode REUSES decoder_train's
+    weights through the scope + shared param names (reference:
+    tests/book/test_machine_translation.py trains, then decode_main
+    loads the same params). param_attr on static fc pins exact names;
+    the Executor's auto-startup only initializes MISSING params, so the
+    decode program picks up the trained values."""
+    S_FC, SC_FC = "dec_state_fc", "dec_score_fc"
+
+    def train_prog():
+        prog = static.Program()
+        with static.program_guard(prog):
+            context = encoder(is_sparse=False)
+            trg = pd.data(name="target_language_word", shape=[1],
+                          dtype="int64", lod_level=1)
+            emb = pd.embedding(input=trg, size=[dict_size, word_dim],
+                               dtype="float32",
+                               param_attr=fluid.ParamAttr(name="vemb"))
+            rnn = pd.DynamicRNN()
+            with rnn.block():
+                word = rnn.step_input(emb)
+                pre = rnn.memory(init=context)
+                cur = pd.fc(input=[word, pre], size=decoder_size,
+                            act="tanh", param_attr=S_FC)
+                score = pd.fc(input=cur, size=dict_size, act="softmax",
+                              param_attr=SC_FC)
+                rnn.update_memory(pre, cur)
+                rnn.output(score)
+            out = rnn()
+            label = pd.data(name="target_language_next_word", shape=[1],
+                            dtype="int64", lod_level=1)
+            cost = pd.mean(pd.cross_entropy(input=out, label=label))
+            fluid.optimizer.Adagrad(learning_rate=0.5).minimize(cost)
+        return prog, cost
+
+    def decode_prog(max_len=5, K=2):
+        prog = static.Program()
+        with static.program_guard(prog):
+            context = encoder(is_sparse=False)
+            counter = pd.zeros(shape=[1], dtype="int64")
+            limit = pd.fill_constant(shape=[1], dtype="int64",
+                                     value=max_len)
+            state = pd.expand(pd.unsqueeze(context, axes=[1]),
+                              expand_times=[1, K, 1])
+            word = pd.fill_constant_batch_size_like(
+                context, shape=[1, K], value=0, dtype="int64")
+            acc = pd.concat([
+                pd.fill_constant_batch_size_like(
+                    context, shape=[1, 1], value=0.0, dtype="float32"),
+                pd.fill_constant_batch_size_like(
+                    context, shape=[1, K - 1], value=-1e9,
+                    dtype="float32")], axis=1)
+            fin = pd.fill_constant_batch_size_like(
+                context, shape=[1, K], value=0, dtype="bool")
+            lens = pd.fill_constant_batch_size_like(
+                context, shape=[1, K], value=0, dtype="int32")
+            tok_arr = pd.array_write(word, counter, capacity=max_len)
+            par_arr = pd.array_write(word, counter, capacity=max_len)
+            cond = pd.less_than(counter, limit)
+            w = pd.While(cond=cond)
+            with w.block():
+                emb = pd.embedding(
+                    input=word, size=[dict_size, word_dim],
+                    dtype="float32",
+                    param_attr=fluid.ParamAttr(name="vemb"))
+                new_state = pd.fc(input=[emb, state], size=decoder_size,
+                                  act="tanh", param_attr=S_FC)
+                score = pd.fc(input=new_state, size=dict_size,
+                              act="softmax", param_attr=SC_FC)
+                logp = pd.log(score)
+                acc2, parent, token, fin2, lens2 = pd.beam_search_step(
+                    logp, acc, fin, counter + 1, lens, beam_size=K,
+                    end_id=1)
+                pd.array_write(token, counter, array=tok_arr)
+                pd.array_write(parent, counter, array=par_arr)
+                pd.assign(pd.gather_beams(new_state, parent),
+                          output=state)
+                pd.assign(acc2, output=acc)
+                pd.assign(pd.cast(token, "int64"), output=word)
+                pd.assign(fin2, output=fin)
+                pd.assign(lens2, output=lens)
+                pd.increment(counter, value=1, in_place=True)
+                pd.less_than(counter, limit, cond=cond)
+            toks, _ = pd.tensor_array_to_tensor(tok_arr, axis=0)
+            pars, _ = pd.tensor_array_to_tensor(par_arr, axis=0)
+            seqs, lns, scores = pd.beam_search_decode_lod(
+                toks, pars, acc, end_id=1)
+        return prog, seqs
+
+    exe = Executor(fluid.CPUPlace())
+    exe.scope = static.Scope()
+    tprog, cost = train_prog()
+    feeder = fluid.DataFeeder(
+        [tprog.global_block().var(n) for n in
+         ("src_word_id", "target_language_word",
+          "target_language_next_word")], fluid.CPUPlace())
+    data = list(_learnable_reader(n=64)())
+    for i in range(0, 64, 16):
+        exe.run(tprog, feed=feeder.feed(data[i:i + 16]),
+                fetch_list=[cost])
+    vemb_trained = np.asarray(exe.scope.get("vemb")).copy()
+    w_trained = np.asarray(exe.scope.get(f"{S_FC}_0")).copy()
+
+    dprog, seqs = decode_prog()
+    src = np.array([[3, 4, 5]], np.int64)
+    feed = {"src_word_id": src,
+            "src_word_id@LEN": np.array([3], np.int32)}
+    out = np.asarray(exe.run(dprog, feed=feed, fetch_list=[seqs])[0])
+
+    # the decode run did NOT re-initialize the shared params
+    np.testing.assert_array_equal(np.asarray(exe.scope.get("vemb")),
+                                  vemb_trained)
+    np.testing.assert_array_equal(
+        np.asarray(exe.scope.get(f"{S_FC}_0")), w_trained)
+
+    # and a FRESH scope (untrained weights) decodes differently
+    exe2 = Executor(fluid.CPUPlace())
+    exe2.scope = static.Scope()
+    dprog2, seqs2 = decode_prog()
+    out2 = np.asarray(exe2.run(dprog2, feed=feed, fetch_list=[seqs2])[0])
+    assert not np.array_equal(out, out2), "decode ignored trained weights"

@@ -249,3 +249,34 @@ def test_executor_compile_cache_lru_eviction():
         assert len(exe._cache) == n_before
     finally:
         FLAGS.set("compile_cache_capacity", old)
+
+
+def test_fc_param_attr_sharing_guards():
+    """Review r3: param_attr sharing protocol — exact names share, arity
+    (list-ness) mixing and non-param collisions fail loudly."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.layers as pd
+    from paddle_tpu import static
+    from paddle_tpu.core.enforce import EnforceError
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = pd.data("x", shape=[4, 8], dtype="float32")
+        h1 = pd.fc(x, 6, param_attr="W")
+        h2 = pd.fc(x, 6, param_attr="W")       # same name: shared
+        assert "W" in prog.vars and "W.b" in prog.vars
+        with pytest.raises(EnforceError, match="would NOT share"):
+            pd.fc([x, h1], 6, param_attr="W")  # list input, same name
+        with pytest.raises(EnforceError, match="shape"):
+            pd.fc(h1, 9, param_attr="W")       # shape mismatch
+        with pytest.raises(EnforceError, match="non-parameter"):
+            pd.fc(x, 8, param_attr="x")        # collides with a feed
+
+    # shared weight really is ONE var: one update moves both heads
+    exe = static.Executor()
+    exe.scope = static.Scope()
+    out = exe.run(prog, feed={"x": np.ones((4, 8), np.float32)},
+                  fetch_list=[h1, h2])
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(out[1]))
