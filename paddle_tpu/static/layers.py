@@ -66,14 +66,23 @@ def fc(input, size: int, act: Optional[str] = None,
     attr_name = getattr(param_attr, "name", None) or (
         param_attr if isinstance(param_attr, str) else None)
     if attr_name is not None:
-        # catch bare-vs-suffixed mixing early (an arity change between
-        # two fc calls sharing one name would silently fork the weights)
-        clash = attr_name if is_list else f"{attr_name}_0"
-        enforce(clash not in prog.vars,
-                "param_attr %r is already used by an fc with a %s input "
-                "— weight names differ by input structure, so these "
-                "calls would NOT share", attr_name,
-                "single (non-list)" if is_list else "list")
+        # input-structure registry: two fc calls sharing one name must
+        # agree on structure (bare weight for a single input, _0.._k-1
+        # for a k-list), or their weight names fork silently. Cross-
+        # PROGRAM mixing cannot be detected at build time — keep the
+        # input structure identical across sharing programs.
+        arity = len(inputs) if is_list else 0  # 0 = single non-list
+        registry = getattr(prog, "_fc_shared_arity", None)
+        if registry is None:
+            registry = prog._fc_shared_arity = {}
+        prev = registry.get(attr_name)
+        enforce(prev is None or prev == arity,
+                "param_attr %r was used by an fc with %s input(s); this "
+                "fc has %s — weight names differ by input structure, so "
+                "these calls would NOT share", attr_name,
+                "a single non-list" if prev == 0 else prev,
+                "a single non-list" if arity == 0 else arity)
+        registry[attr_name] = arity
 
     def wname(i):
         if attr_name is None:

@@ -269,6 +269,9 @@ def test_fc_param_attr_sharing_guards():
         assert "W" in prog.vars and "W.b" in prog.vars
         with pytest.raises(EnforceError, match="would NOT share"):
             pd.fc([x, h1], 6, param_attr="W")  # list input, same name
+        h3 = pd.fc([x, h1], 6, param_attr="W2")     # 2-list: W2_0, W2_1
+        with pytest.raises(EnforceError, match="would NOT share"):
+            pd.fc([x, h1, h3], 6, param_attr="W2")  # 3-list arity change
         with pytest.raises(EnforceError, match="shape"):
             pd.fc(h1, 9, param_attr="W")       # shape mismatch
         with pytest.raises(EnforceError, match="non-parameter"):

@@ -30,10 +30,13 @@ run bench_mnist        900  python bench.py
 for m in resnet50 bert_base bert_long transformer_nmt deepfm deepfm_sparse stacked_lstm vgg16 se_resnext50; do
   run "bench_$m"       1200 python bench.py --model "$m"
 done
-# sweep knobs on the two headliners
+# sweep knobs on the two headliners (VERDICT item 10: record the winning
+# config per model)
 run bench_bert_spc8    1200 python bench.py --model bert_base --steps-per-call 8
 run bench_bert_fp32    1200 python bench.py --model bert_base --amp float32
 run bench_bert_nofuse  1200 python bench.py --model bert_base --no-fused-ce
+run bench_bert_remat   1200 python bench.py --model bert_base --remat
+run bench_bert_scan    1200 python bench.py --model bert_base --scan-layers
 run bench_rn50_spc8    1200 python bench.py --model resnet50 --steps-per-call 8
 
 # 2. Mosaic-compile + tune the Pallas kernels; persists tuned_blocks.json
