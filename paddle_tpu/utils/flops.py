@@ -67,19 +67,34 @@ def device_peak_flops(device: Optional[Any] = None,
 
 
 def lowered_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
-    """FLOPs of one dispatch of ``jitted_fn(*args)`` per XLA's cost model
-    on the lowered module. Returns None when analysis is unavailable
-    (cost model gaps on some backends) — never raises."""
+    """FLOPs of one dispatch of ``jitted_fn(*args)`` per XLA's cost model.
+
+    Prefers the *lowered* (pre-backend-optimization) module — the true MFU
+    numerator. Some PJRT plugins (the axon TPU tunnel among them) return
+    None there; then fall back to the *compiled* executable's analysis,
+    which counts post-optimization FLOPs (an HFU-flavoured numerator:
+    remat duplicates included, algebraically-eliminated math excluded).
+    The fallback costs an AOT compile; enable the persistent compilation
+    cache (bench.py does) so the jit dispatch right after reuses it.
+    Returns None when neither side is available — never raises."""
     try:
-        analysis = jitted_fn.lower(*args, **kwargs).cost_analysis()
-        if not analysis:
-            return None
-        flops = analysis.get("flops")
-        if flops is None or flops <= 0:
-            return None
-        return float(flops)
+        lowered = jitted_fn.lower(*args, **kwargs)
     except Exception:
         return None
+    for analyze in (lowered.cost_analysis,
+                    lambda: lowered.compile().cost_analysis()):
+        try:
+            analysis = analyze()
+            if isinstance(analysis, (list, tuple)):  # one entry per program
+                analysis = analysis[0] if analysis else None
+            if not analysis:
+                continue
+            flops = analysis.get("flops")
+            if flops and flops > 0:
+                return float(flops)
+        except Exception:
+            continue
+    return None
 
 
 def mfu(flops_per_sec: Optional[float], device: Optional[Any] = None,
