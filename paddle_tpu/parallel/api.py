@@ -198,6 +198,18 @@ class Trainer:
         last step's (loss, metrics)."""
         from ..core.profiler import RecordEvent
 
+        fn = self.steps_jit(n)
+        with RecordEvent(f"train_steps[{n}]"):
+            self._rng, sub = jax.random.split(self._rng)
+            loss, metrics, self.params, self.buffers, self.opt_state = fn(
+                self.params, self.buffers, self.opt_state, sub, batch)
+        return loss, metrics
+
+    def steps_jit(self, n: int):
+        """The jitted ``n``-fused-step callable train_steps dispatches
+        (built lazily, cached, NOT yet called — so callers may
+        ``.lower()`` it for cost analysis before any donation happens).
+        Signature: ``fn(params, buffers, opt_state, rng, batch)``."""
         enforce(self.grad_accum_steps == 1,
                 "train_steps composes with plain steps only (use "
                 "train_step for gradient merge)")
@@ -221,11 +233,7 @@ class Trainer:
             donate = (0, 1, 2) if self.strategy.donate_inputs else ()
             fn = jax.jit(many, donate_argnums=donate)
             self._multi_cache[key] = fn
-        with RecordEvent(f"train_steps[{n}]"):
-            self._rng, sub = jax.random.split(self._rng)
-            loss, metrics, self.params, self.buffers, self.opt_state = fn(
-                self.params, self.buffers, self.opt_state, sub, batch)
-        return loss, metrics
+        return fn
 
     def eval_step(self, batch):
         return self._jit_eval(self.params, self.buffers, batch)

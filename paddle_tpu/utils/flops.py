@@ -66,23 +66,50 @@ def device_peak_flops(device: Optional[Any] = None,
     return None
 
 
-def lowered_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
-    """FLOPs of one dispatch of ``jitted_fn(*args)`` per XLA's cost model.
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a repo-local dir so
+    slow first compiles amortize across bench/tune processes (and across
+    wedged-tunnel retries). ``PT_COMPILE_CACHE=0`` disables; unwritable
+    paths degrade silently to no cache. Returns the dir in use or None."""
+    path = path or os.environ.get(
+        "PT_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache"))
+    if not path or path == "0":
+        return None
+    import jax
 
-    Prefers the *lowered* (pre-backend-optimization) module — the true MFU
-    numerator. Some PJRT plugins (the axon TPU tunnel among them) return
-    None there; then fall back to the *compiled* executable's analysis,
-    which counts post-optimization FLOPs (an HFU-flavoured numerator:
-    remat duplicates included, algebraically-eliminated math excluded).
-    The fallback costs an AOT compile; enable the persistent compilation
-    cache (bench.py does) so the jit dispatch right after reuses it.
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        return path
+    except OSError:
+        return None
+
+
+def lowered_flops(jitted_fn, *args, n_partitions: int = 1,
+                  **kwargs) -> Optional[float]:
+    """GLOBAL FLOPs of one dispatch of ``jitted_fn(*args)`` per XLA's
+    cost model.
+
+    Prefers the *lowered* (pre-backend-optimization, pre-partitioning)
+    module — the true MFU numerator, already global. Some PJRT plugins
+    (the axon TPU tunnel among them) return None there; then fall back
+    to the *compiled* executable's analysis, which counts
+    post-optimization, post-SPMD-partitioning FLOPs — a PER-DEVICE,
+    HFU-flavoured number (remat duplicates included, eliminated math
+    excluded) — scaled back to global by ``n_partitions`` (the mesh size
+    the program spans; collective overhead makes this a mild
+    overestimate of model FLOPs). The fallback costs an AOT compile;
+    enable_compile_cache() makes the jit dispatch right after reuse it.
     Returns None when neither side is available — never raises."""
     try:
         lowered = jitted_fn.lower(*args, **kwargs)
     except Exception:
         return None
-    for analyze in (lowered.cost_analysis,
-                    lambda: lowered.compile().cost_analysis()):
+    for analyze, scale in ((lowered.cost_analysis, 1.0),
+                           (lambda: lowered.compile().cost_analysis(),
+                            float(max(1, n_partitions)))):
         try:
             analysis = analyze()
             if isinstance(analysis, (list, tuple)):  # one entry per program
@@ -91,7 +118,7 @@ def lowered_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
                 continue
             flops = analysis.get("flops")
             if flops and flops > 0:
-                return float(flops)
+                return float(flops) * scale
         except Exception:
             continue
     return None

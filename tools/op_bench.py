@@ -137,6 +137,9 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    from paddle_tpu.utils.flops import enable_compile_cache
+
+    enable_compile_cache()
     cases = []
     if args.config:
         with open(args.config) as f:

@@ -35,16 +35,27 @@ DEFAULT_ATTN = [(32, 128, 12, 64), (8, 512, 12, 64), (2, 2048, 16, 128),
 DEFAULT_GEMM = [(512, 768, 768), (2048, 3072, 768), (4096, 30528, 768)]
 
 
-def _time(fn, *args, warmup=2, iters=10):
+def _fence(out):
+    """Host-fetch fence. Through the async device tunnel
+    ``block_until_ready`` alone does not serialize (see bench.py); a
+    scalar d2h of one element of the output is the reliable barrier.
+    Fetches a single element (not the array) so the transfer itself
+    stays out of the measurement."""
     import jax
 
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    idx = (0,) * getattr(leaf, "ndim", 0)
+    float(jax.device_get(leaf[idx] if idx else leaf))
+
+
+def _time(fn, *args, warmup=2, iters=10):
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _fence(out)
     return (time.perf_counter() - t0) / iters
 
 
@@ -178,6 +189,9 @@ def main():
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    from paddle_tpu.utils.flops import enable_compile_cache
+
+    enable_compile_cache()  # re-runs after a wedged relay skip recompiles
     backend = jax.default_backend()
     if backend not in ("tpu", "axon") and not args.allow_cpu:
         print(f"refusing to tune on backend {backend!r}: block-size "
