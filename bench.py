@@ -85,6 +85,15 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
 
 
 _STEPS_PER_CALL = None  # CLI override consumed by _train_bench
+_EXPLICIT_BATCH = False  # set by main() when --batch-size is given
+
+
+def _cap(batch_size: int, cap: int) -> int:
+    """Clamp the harness-wide default batch (8192) to the model's
+    headline config; an EXPLICIT --batch-size is honored as given so
+    knob sweeps (e.g. bert_base --batch-size 64) actually run what the
+    label says."""
+    return batch_size if _EXPLICIT_BATCH else min(batch_size, cap)
 
 
 def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
@@ -186,7 +195,7 @@ def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
 
     pt.seed(0)
     size = 64 if smoke else 224
-    batch_size = min(batch_size, 8 if smoke else 128)
+    batch_size = _cap(batch_size, 8 if smoke else 128)
     model = resnet.resnet50(num_classes=1000, data_format=layout)
     rng = np.random.default_rng(0)
 
@@ -219,7 +228,7 @@ def bench_bert_base(steps: int, batch_size: int, amp=None,
     from paddle_tpu.models import bert as B
 
     pt.seed(0)
-    batch_size = min(batch_size, 32)
+    batch_size = _cap(batch_size, 32)
     cfg = B.BertConfig.base()
     cfg.remat, cfg.scan_layers = remat, scan_layers
     if scan_layers:
@@ -263,7 +272,7 @@ def bench_transformer_nmt(steps: int, batch_size: int, amp=None,
     from paddle_tpu.models import transformer as TR
 
     pt.seed(0)
-    batch_size = min(batch_size, 64)
+    batch_size = _cap(batch_size, 64)
     cfg = TR.NMTConfig.base()
     model = TR.TransformerNMT(cfg)
     rng = np.random.default_rng(0)
@@ -307,7 +316,7 @@ def bench_bert_long(steps: int, batch_size: int, amp=None,
     from paddle_tpu.models import bert as B
 
     pt.seed(0)
-    batch_size = min(batch_size, 4)
+    batch_size = _cap(batch_size, 4)
     cfg = B.BertConfig.base()
     cfg.max_position = seq_len
     cfg.remat = True
@@ -326,12 +335,14 @@ def bench_bert_long(steps: int, batch_size: int, amp=None,
                         amp=amp, method="forward_fused_loss")
 
 
-def bench_deepfm_sparse(steps: int, batch_size: int, amp=None):
+def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
+                        vocab: int = 100_000):
     """DeepFM with ROW-SPARSE embedding updates (the SelectedRows
     capability, reference: operators/optimizers/adam_op.h sparse branch):
     the optimizer touches O(batch x fields) table rows per step instead
     of O(vocab). Run next to --model deepfm (dense updates) — the gap IS
-    the sparse-update win, and it widens with total_vocab."""
+    the sparse-update win, and it widens with total_vocab (``--vocab``
+    sweeps the crossover; on-chip at V=100k dense wins, BASELINE.md)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -341,7 +352,7 @@ def bench_deepfm_sparse(steps: int, batch_size: int, amp=None):
     from paddle_tpu.optimizer.sparse import sparse_minimize_fn
 
     pt.seed(0)
-    cfg = DF.DeepFMConfig(total_vocab=100_000, num_fields=26, dense_dim=13,
+    cfg = DF.DeepFMConfig(total_vocab=vocab, num_fields=26, dense_dim=13,
                           embed_dim=16, embedding_axis=None,
                           sparse_grads=True)
     model = DF.DeepFM(cfg)
@@ -405,15 +416,17 @@ def bench_deepfm_sparse(steps: int, batch_size: int, amp=None):
     return outer * k * batch_size / dt, "examples/sec", extras
 
 
-def bench_deepfm(steps: int, batch_size: int, amp=None):
-    """BASELINE config 5: DeepFM sparse CTR step."""
+def bench_deepfm(steps: int, batch_size: int, amp=None,
+                 vocab: int = 100_000):
+    """BASELINE config 5: DeepFM sparse CTR step (dense-gradient
+    updates; ``--vocab`` scales the table for the sparse crossover)."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.models import deepfm as DF
 
     pt.seed(0)
-    cfg = DF.DeepFMConfig(total_vocab=100_000, num_fields=26, dense_dim=13,
+    cfg = DF.DeepFMConfig(total_vocab=vocab, num_fields=26, dense_dim=13,
                           embed_dim=16, embedding_axis=None)
     model = DF.DeepFM(cfg)
     rng = np.random.default_rng(0)
@@ -442,7 +455,7 @@ def bench_stacked_lstm(steps: int, batch_size: int, amp=None):
     from paddle_tpu.models import stacked_lstm as S
 
     pt.seed(0)
-    batch_size = min(batch_size, 64)
+    batch_size = _cap(batch_size, 64)
     model = S.StackedLSTM(vocab_size=5149, embed_dim=512, hidden_dim=512,
                           num_layers=3)
     rng = np.random.default_rng(0)
@@ -470,7 +483,7 @@ def bench_vgg16(steps: int, batch_size: int, smoke: bool = False, amp=None):
 
     pt.seed(0)
     size = 224  # vgg's classifier is fixed to 7x7 feature maps
-    batch_size = min(batch_size, 2 if smoke else 64)
+    batch_size = _cap(batch_size, 2 if smoke else 64)
     model = V.vgg16(num_classes=1000) if hasattr(V, "vgg16") else V.VGG16()
     rng = np.random.default_rng(0)
 
@@ -498,7 +511,7 @@ def bench_se_resnext50(steps: int, batch_size: int, smoke: bool = False,
 
     pt.seed(0)
     size = 64 if smoke else 224
-    batch_size = min(batch_size, 8 if smoke else 64)
+    batch_size = _cap(batch_size, 8 if smoke else 64)
     model = (S.se_resnext50(num_classes=1000)
              if hasattr(S, "se_resnext50") else S.SEResNeXt())
     rng = np.random.default_rng(0)
@@ -526,7 +539,7 @@ def bench_alexnet(steps: int, batch_size: int, smoke: bool = False,
     from paddle_tpu.models import alexnet as A
 
     pt.seed(0)
-    batch_size = min(batch_size, 8 if smoke else 256)
+    batch_size = _cap(batch_size, 8 if smoke else 256)
     model = A.alexnet(num_classes=1000)
     rng = np.random.default_rng(0)
 
@@ -551,7 +564,7 @@ def bench_googlenet(steps: int, batch_size: int, smoke: bool = False,
     from paddle_tpu.models import googlenet as G
 
     pt.seed(0)
-    batch_size = min(batch_size, 8 if smoke else 128)
+    batch_size = _cap(batch_size, 8 if smoke else 128)
     model = G.googlenet(num_classes=1000)
     rng = np.random.default_rng(0)
 
@@ -641,6 +654,9 @@ def main():
                     help="wrap the timed run in the profiler and write a "
                     "chrome-trace JSON here (fluid_benchmark --profile "
                     "analog)")
+    ap.add_argument("--vocab", type=int, default=None,
+                    help="deepfm/deepfm_sparse: embedding table size "
+                    "(sweeps the sparse-vs-dense update crossover)")
     ap.add_argument("--dp", type=int, default=1,
                     help="data-parallel device count (--gpus analog; on "
                     "--platform cpu this creates virtual host devices)")
@@ -658,6 +674,9 @@ def main():
 
     steps = args.steps or (10 if args.smoke else 100)
     batch = args.batch_size or (256 if args.smoke else 8192)
+    global _EXPLICIT_BATCH
+    _EXPLICIT_BATCH = bool(args.batch_size)  # assignment: a second
+    # in-process main() without --batch-size gets the caps back
 
     # device-init watchdog: if the accelerator tunnel is wedged (device
     # claim hangs), still emit the one JSON line the driver expects
@@ -706,6 +725,8 @@ def main():
         kwargs["remat"] = True
     if "scan_layers" in sig and args.scan_layers:
         kwargs["scan_layers"] = True
+    if "vocab" in sig and args.vocab:
+        kwargs["vocab"] = args.vocab
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call
@@ -740,7 +761,15 @@ def main():
         value, unit, *rest = fn(steps, batch, **kwargs)
     extras = rest[0] if rest else {}
 
+    # a knob that changes the WORKLOAD (table size, real batch) gets its
+    # own history key — different workloads must not share a regression
+    # record. --vocab equal to the model's own default stays unsuffixed.
     metric = f"{args.model}_throughput"
+    if (args.vocab and "vocab" in sig
+            and args.vocab != sig["vocab"].default):
+        metric += f"_v{args.vocab}"
+    if _EXPLICIT_BATCH:
+        metric += f"_b{batch}"
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_HISTORY.json")
     line = report_line(metric, value, unit, extras,

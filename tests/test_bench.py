@@ -22,7 +22,8 @@ def _run(*extra, timeout=520):
 
 def test_smoke_emits_metric_line():
     d = _run("--smoke", "--steps", "8", "--batch-size", "64")
-    assert d["metric"] == "mnist_mlp_throughput"
+    # an explicit --batch-size is a different workload: own history key
+    assert d["metric"] == "mnist_mlp_throughput_b64"
     assert d["value"] > 0 and d["unit"] == "examples/sec"
     # FLOPs accounting: TFLOP/s reported when the XLA cost model
     # resolves; these tests force --platform cpu, where MFU must be null

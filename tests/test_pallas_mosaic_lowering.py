@@ -26,9 +26,9 @@ from paddle_tpu.ops.pallas.quant_matmul import quant_matmul
 
 # (b, t, h, d): BERT-base pretrain block and the 2k long-context shape
 ATTN_SHAPES = [(8, 512, 12, 64), (2, 2048, 16, 128)]
-# every tuner block size in both roles, incl. the untuned 128 default
-# (tools/pallas_tune.py ATTN_BLOCKS) without the full quadratic grid
-BLOCK_PAIRS = [(128, 128), (256, 256), (512, 512), (128, 512), (512, 128)]
+# the tuner's full block grid (tools/pallas_tune.py ATTN_BLOCKS product),
+# incl. the untuned 128x128 default every production call starts from
+BLOCK_PAIRS = list(itertools.product([128, 256, 512], repeat=2))
 
 
 def _export_tpu(jitted, *args):

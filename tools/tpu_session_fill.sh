@@ -37,6 +37,11 @@ run fill_bert_scan    1200 python bench.py --model bert_base --scan-layers
 run fill_bert_b64     1200 python bench.py --model bert_base --batch-size 64
 run fill_rn50_spc8    2400 python bench.py --model resnet50 --steps-per-call 8
 
+# sparse-vs-dense embedding-update crossover (BASELINE.md: dense won 2x
+# at V=100k on-chip; CPU showed sparse 63x ahead at V=1M)
+run fill_deepfm_v1m        1200 python bench.py --model deepfm --vocab 1000000
+run fill_deepfm_sparse_v1m 1200 python bench.py --model deepfm_sparse --vocab 1000000
+
 # Mosaic compile + tune Pallas kernels; persists tuned_blocks.json
 run pallas_tune       2400 python tools/pallas_tune.py
 run pallas_tests      1200 python -m pytest tests/test_pallas_attention.py tests/test_quant_matmul.py -q
