@@ -678,6 +678,22 @@ def main():
     _EXPLICIT_BATCH = bool(args.batch_size)  # assignment: a second
     # in-process main() without --batch-size gets the caps back
 
+    # Resolve the workload-suffixed metric key ONCE, before any code
+    # that can fail: error lines must carry the same key as the success
+    # line for the same command, or retry/history tooling mis-files the
+    # failure under a different workload. inspect on the local bench fn
+    # is safe pre-watchdog (nothing touches the device).
+    import inspect
+
+    fn = MODELS[args.model]
+    sig = inspect.signature(fn).parameters
+    metric = f"{args.model}_throughput"
+    if (args.vocab and "vocab" in sig
+            and args.vocab != sig["vocab"].default):
+        metric += f"_v{args.vocab}"
+    if _EXPLICIT_BATCH:
+        metric += f"_b{batch}"
+
     # device-init watchdog: if the accelerator tunnel is wedged (device
     # claim hangs), still emit the one JSON line the driver expects
     # instead of hanging the whole round
@@ -696,7 +712,7 @@ def main():
     probe.join(timeout=float(os.environ.get("PT_BENCH_DEVICE_TIMEOUT_S",
                                             "420")))
     if not init_ok.is_set():
-        _emit_error(f"{args.model}_throughput",
+        _emit_error(metric,
                     "device init timeout (accelerator unreachable)")
         return
     # Persistent compilation cache: amortizes the slow first compile
@@ -708,10 +724,6 @@ def main():
     from paddle_tpu.utils.flops import enable_compile_cache
 
     enable_compile_cache()
-    import inspect
-
-    fn = MODELS[args.model]
-    sig = inspect.signature(fn).parameters
     kwargs = {}
     if "smoke" in sig:
         kwargs["smoke"] = args.smoke
@@ -735,7 +747,7 @@ def main():
             _STEPS_PER_CALL = args.steps_per_call
     if args.dp > 1:
         if "dp" not in sig:
-            _emit_error(f"{args.model}_throughput",
+            _emit_error(metric,
                         f"--dp is not supported by model {args.model} "
                         "(single-device bench)")
             return
@@ -749,7 +761,7 @@ def main():
             with open(args.profile, "w"):
                 pass
         except OSError as e:
-            _emit_error(f"{args.model}_throughput",
+            _emit_error(metric,
                         f"unwritable --profile path: {e}")
             return
         from paddle_tpu.core.profiler import profiler as _prof
@@ -761,15 +773,8 @@ def main():
         value, unit, *rest = fn(steps, batch, **kwargs)
     extras = rest[0] if rest else {}
 
-    # a knob that changes the WORKLOAD (table size, real batch) gets its
-    # own history key — different workloads must not share a regression
-    # record. --vocab equal to the model's own default stays unsuffixed.
-    metric = f"{args.model}_throughput"
-    if (args.vocab and "vocab" in sig
-            and args.vocab != sig["vocab"].default):
-        metric += f"_v{args.vocab}"
-    if _EXPLICIT_BATCH:
-        metric += f"_b{batch}"
+    # `metric` was resolved before the watchdog (same suffixed key on
+    # error and success lines for the same command)
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_HISTORY.json")
     line = report_line(metric, value, unit, extras,

@@ -78,9 +78,12 @@ def tune_attention(b, t, h, d, causal, dry_run=False):
         return lambda *a: g(*a)
 
     results = []
-    for bq, bk in itertools.product(ATTN_BLOCKS, ATTN_BLOCKS):
-        if bq > t or bk > t:
-            continue
+    # candidates never exceed t; when t is below every table entry
+    # (e.g. t=64 vs ATTN_BLOCKS starting at 128) fall back to block=t so
+    # short-sequence shapes still get a real flash measurement instead of
+    # an empty sweep that would persist use_flash=False unmeasured
+    cand = [blk for blk in ATTN_BLOCKS if blk <= t] or [t]
+    for bq, bk in itertools.product(cand, cand):
         try:
             f = jax.jit(lambda q, k, v, _bq=bq, _bk=bk: flash_attention(
                 q, k, v, causal=causal, block_q=_bq, block_k=_bk,

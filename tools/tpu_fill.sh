@@ -1,0 +1,106 @@
+#!/bin/bash
+# Resumable on-chip evidence filler — supersedes tpu_session.sh /
+# tpu_session_fill.sh (both now delegate here). The relay wedges
+# unpredictably (observed windows: 17 min, 8 min), so this script is
+# built around short windows: priority-ordered items, a done-marker per
+# item (tpu_evidence/.done/<tag>), and a cheap liveness probe BEFORE
+# every item so a wedged tunnel costs ~90 s, not a 20-minute timeout.
+#
+#   bash tools/tpu_fill.sh [outdir]  # run whatever is still pending
+#   rm -rf tpu_evidence/.done        # force a full re-run
+#
+# An item is marked done only when it exits 0 AND its log contains no
+# accelerator-unreachable or bench-error marker (bench.py exits 0 even
+# when the device times out, by contract — the JSON line carries the
+# error instead). The .done/ALL marker appears only when EVERY item's
+# marker exists.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-tpu_evidence}"
+DONE="$OUT/.done"
+mkdir -p "$OUT" "$DONE"
+log() { echo "[tpu_fill $(date -u +%H:%M:%S)] $*" | tee -a "$OUT/fill.log"; }
+
+probe() {
+  timeout 90 python -c "import jax; d=jax.devices(); import jax.numpy as jnp; print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum())); print(d)" \
+    > /dev/null 2>&1
+}
+
+PENDING=0
+item() {  # item <tag> <timeout_s> <cmd...>
+  local tag="$1" to="$2"; shift 2
+  [ -e "$DONE/$tag" ] && return 0
+  if ! probe; then
+    log "probe failed before $tag — tunnel down, stopping this pass"
+    exit 3
+  fi
+  log "START $tag: $*"
+  timeout "$to" "$@" > "$OUT/$tag.log" 2>&1
+  local rc=$?
+  tail -2 "$OUT/$tag.log" | tee -a "$OUT/fill.log"
+  if [ $rc -eq 0 ] && ! grep -qE 'unreachable|"error"' "$OUT/$tag.log"; then
+    touch "$DONE/$tag"
+    log "DONE $tag"
+  else
+    PENDING=$((PENDING + 1))
+    log "FAIL $tag rc=$rc (will retry next pass)"
+  fi
+}
+
+log "=== fill pass begins ==="
+# -- tier 1: quick + unique value (MFU holes, the untuned long-context shape)
+item mfu_mnist        600  python bench.py
+item mfu_resnet50     900  python bench.py --model resnet50
+item mfu_bert         900  python bench.py --model bert_base
+item tune_a2048f      1200 python tools/pallas_tune.py --attention 2,2048,16,128
+item tune_a2048c      1200 python tools/pallas_tune.py --attention 2,2048,16,128 --causal
+item bench_bertlong2  1200 python bench.py --model bert_long
+# -- tier 2: trace + microbench + remaining tune shapes
+item trace            900  python bench.py --model bert_base --profile "$OUT/trace.json"
+item tune_a64f        900  python tools/pallas_tune.py --attention 64,64,8,64
+item tune_a64c        900  python tools/pallas_tune.py --attention 64,64,8,64 --causal
+item tune_gemm1       900  python tools/pallas_tune.py --matmul 512,768,768
+item tune_gemm2       900  python tools/pallas_tune.py --matmul 2048,3072,768
+item tune_gemm3       1200 python tools/pallas_tune.py --matmul 4096,30528,768
+item op_bench         1200 python tools/op_bench.py --config tools/op_bench_cases.json
+# -- tier 3: knob sweeps (winning-config table per model)
+item bench_bert_nofuse 900 python bench.py --model bert_base --no-fused-ce
+item bench_bert_remat  900 python bench.py --model bert_base --remat
+item bench_bert_scan   900 python bench.py --model bert_base --scan-layers
+item bench_bert_b64    900 python bench.py --model bert_base --batch-size 64
+# spc8 keeps the raised ceiling: the k=8 scanned module compiles slowly
+# (documented in the r3 chip-session plan) and the compile cache may be
+# cold for it — a lower ceiling would burn the window and never finish
+item bench_rn50_spc8  2400 python bench.py --model resnet50 --steps-per-call 8
+item bench_bert_spc8  2400 python bench.py --model bert_base --steps-per-call 8
+item bench_bert_fp32  1200 python bench.py --model bert_base --amp float32
+# sparse-vs-dense embedding-update crossover (dense won 2x at V=100k
+# on-chip; CPU showed sparse 63x ahead at V=1M — capture the chip side)
+item deepfm_v1m        1200 python bench.py --model deepfm --vocab 1000000
+item deepfm_sparse_v1m 1200 python bench.py --model deepfm_sparse --vocab 1000000
+# -- tier 4: full-sweep completeness (superset of the retired
+# tpu_session.sh list so a FRESH environment gets every model and every
+# default tune shape from this one script; in an already-captured
+# checkout these carry pre-seeded done-markers and are skipped)
+item bench_bert_long   1200 python bench.py --model bert_long
+item bench_transformer_nmt 1200 python bench.py --model transformer_nmt
+item bench_deepfm      1200 python bench.py --model deepfm
+item bench_deepfm_sparse 1200 python bench.py --model deepfm_sparse
+item bench_stacked_lstm 1200 python bench.py --model stacked_lstm
+item bench_vgg16       1200 python bench.py --model vgg16
+item bench_se_resnext50 1200 python bench.py --model se_resnext50
+item bench_alexnet     1200 python bench.py --model alexnet
+item bench_googlenet   1200 python bench.py --model googlenet
+item tune_a128f        900  python tools/pallas_tune.py --attention 32,128,12,64
+item tune_a128c        900  python tools/pallas_tune.py --attention 32,128,12,64 --causal
+item tune_a512f        900  python tools/pallas_tune.py --attention 8,512,12,64
+item tune_a512c        900  python tools/pallas_tune.py --attention 8,512,12,64 --causal
+# -- tier 5: on-chip pallas test suite (slowest, least time-sensitive)
+item pallas_tests     1500 python -m pytest tests/test_pallas_attention.py tests/test_quant_matmul.py -q
+
+if [ "$PENDING" -eq 0 ]; then
+  log "=== all items done ==="
+  touch "$DONE/ALL"
+else
+  log "=== pass complete; $PENDING item(s) still pending retry ==="
+fi

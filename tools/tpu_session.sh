@@ -1,51 +1,4 @@
 #!/bin/bash
-# The full on-chip evidence sweep (VERDICT r2 items 1+2): run the moment
-# the TPU answers. Produces BENCH_HISTORY.json accelerator entries, the
-# tuned Pallas table, op microbench numbers, and a chrome trace.
-# Usage: bash tools/tpu_session.sh [outdir]   (default: ./tpu_evidence)
-set -u
-cd "$(dirname "$0")/.."
-OUT="${1:-tpu_evidence}"
-mkdir -p "$OUT"
-log() { echo "[tpu_session $(date -u +%H:%M:%S)] $*" | tee -a "$OUT/session.log"; }
-
-run() {  # run <tag> <timeout_s> <cmd...>
-  local tag="$1" to="$2"; shift 2
-  log "START $tag: $*"
-  timeout "$to" "$@" > "$OUT/$tag.log" 2>&1
-  local rc=$?
-  log "END $tag rc=$rc (tail):"
-  tail -3 "$OUT/$tag.log" | tee -a "$OUT/session.log"
-  return $rc
-}
-
-log "=== TPU session sweep begins ==="
-
-# 0. liveness
-run probe 300 python -c "import jax; print(jax.devices()); import jax.numpy as jnp; print((jnp.ones((256,256))@jnp.ones((256,256))).sum())" || { log "chip not answering; abort"; exit 1; }
-
-# 1. bench: every model; the JSON lines land in the logs AND
-#    BENCH_HISTORY.json picks up accelerator entries automatically
-run bench_mnist        900  python bench.py
-for m in resnet50 bert_base bert_long transformer_nmt deepfm deepfm_sparse stacked_lstm vgg16 se_resnext50; do
-  run "bench_$m"       1200 python bench.py --model "$m"
-done
-# sweep knobs on the two headliners (VERDICT item 10: record the winning
-# config per model)
-run bench_bert_spc8    1200 python bench.py --model bert_base --steps-per-call 8
-run bench_bert_fp32    1200 python bench.py --model bert_base --amp float32
-run bench_bert_nofuse  1200 python bench.py --model bert_base --no-fused-ce
-run bench_bert_remat   1200 python bench.py --model bert_base --remat
-run bench_bert_scan    1200 python bench.py --model bert_base --scan-layers
-run bench_rn50_spc8    1200 python bench.py --model resnet50 --steps-per-call 8
-
-# 2. Mosaic-compile + tune the Pallas kernels; persists tuned_blocks.json
-run pallas_tune        2400 python tools/pallas_tune.py
-run pallas_tests       1200 python -m pytest tests/test_pallas_attention.py tests/test_quant_matmul.py -q
-
-# 3. hot-op microbench + chrome trace
-run op_bench           1200 python tools/op_bench.py --config tools/op_bench_cases.json
-run trace              900  python bench.py --model bert_base --profile "$OUT/trace.json"
-
-log "=== sweep done; artifacts in $OUT, BENCH_HISTORY.json and tuned_blocks.json updated ==="
-ls -la "$OUT" | tee -a "$OUT/session.log"
+# Historical entry point (HANDOFF/BASELINE reference it). The resumable
+# probe-gated filler is the real driver now — delegate.
+exec bash "$(dirname "$0")/tpu_fill.sh" "$@"
