@@ -54,9 +54,10 @@ def test_flash_attention_consults_table(table, monkeypatch):
     calls = []
     real = FA._flash
 
-    def spy(qf, kf, vf, causal, scale, bq, bk, interpret):
-        calls.append((bq, bk))
-        return real(qf, kf, vf, causal, scale, bq, bk, interpret)
+    def spy(qf, kf, vf, causal, scale, bq, bk, bq_bwd, bk_bwd, interpret):
+        calls.append((bq, bk, bq_bwd, bk_bwd))
+        return real(qf, kf, vf, causal, scale, bq, bk, bq_bwd, bk_bwd,
+                    interpret)
 
     monkeypatch.setattr(FA, "_flash", spy)
     q = jnp.zeros((1, 128, 2, 64), jnp.float32)
@@ -64,14 +65,21 @@ def test_flash_attention_consults_table(table, monkeypatch):
     key = tuning.attention_key(128, 128, 64, False)
     tuning.set_tuned(key, {"block_q": 64, "block_k": 64}, persist=False)
     FA.flash_attention(q, q, q)
-    assert calls[-1] == (64, 64)  # tuned blocks used
+    # tuned fwd blocks used; bwd defaults to the fwd blocks
+    assert calls[-1] == (64, 64, 64, 64)
+
+    tuning.set_tuned(key, {"block_q": 64, "block_k": 64,
+                           "block_q_bwd": 32, "block_k_bwd": 128},
+                     persist=False)
+    FA.flash_attention(q, q, q)
+    assert calls[-1] == (64, 64, 32, 128)  # independent tuned bwd blocks
 
     tuning.set_tuned(key, {"block_q": 96, "block_k": 96}, persist=False)
     FA.flash_attention(q, q, q)
-    assert calls[-1] == (128, 128)  # 128 % 96 != 0 -> defaults
+    assert calls[-1] == (128, 128, 128, 128)  # 128 % 96 != 0 -> defaults
 
     FA.flash_attention(q, q, q, block_q=32, block_k=32)
-    assert calls[-1] == (32, 32)  # explicit args override the table
+    assert calls[-1] == (32, 32, 32, 32)  # explicit args override the table
 
 
 def test_use_flash_false_routes_to_xla(table, monkeypatch):

@@ -71,51 +71,89 @@ def tune_attention(b, t, h, d, causal, dry_run=False):
     mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d))
                              .astype(np.float32)).astype(jnp.bfloat16)
     q, k, v = mk(), mk(), mk()
+    # a RANDOM cotangent keeps the comparison honest: grad of a plain
+    # .sum() hands XLA a constant all-ones dO it can fold through its
+    # transparent backward, while the opaque Pallas kernel sees a real
+    # tensor either way
+    ct = mk().astype(jnp.float32)
 
     def grad_of(fn):
-        g = jax.jit(jax.grad(lambda q, k, v: fn(q, k, v).astype(
-            jnp.float32).sum(), argnums=(0, 1, 2)))
+        g = jax.jit(jax.grad(lambda q, k, v: (fn(q, k, v).astype(
+            jnp.float32) * ct).sum(), argnums=(0, 1, 2)))
         return lambda *a: g(*a)
 
-    results = []
     # candidates never exceed t; when t is below every table entry
     # (e.g. t=64 vs ATTN_BLOCKS starting at 128) fall back to block=t so
     # short-sequence shapes still get a real flash measurement instead of
     # an empty sweep that would persist use_flash=False unmeasured
     cand = [blk for blk in ATTN_BLOCKS if blk <= t] or [t]
+
+    # forward and backward are tuned INDEPENDENTLY: the dq/dkv kernels
+    # have a different arithmetic-intensity sweet spot than the fwd
+    # kernel, and coupling them to one (bq, bk) pair leaves bwd time on
+    # the table (observed on-chip: best fwd pair != best bwd pair)
+    fwd_results = []
     for bq, bk in itertools.product(cand, cand):
         try:
             f = jax.jit(lambda q, k, v, _bq=bq, _bk=bk: flash_attention(
                 q, k, v, causal=causal, block_q=_bq, block_k=_bk,
                 interpret=False))
             fwd = _time(f, q, k, v)
-            bwd = _time(grad_of(lambda q, k, v, _bq=bq, _bk=bk:
-                                flash_attention(q, k, v, causal=causal,
-                                                block_q=_bq, block_k=_bk,
-                                                interpret=False)), q, k, v)
-            results.append((fwd + bwd, bq, bk, fwd, bwd))
-            print(f"  flash bq={bq} bk={bk}: fwd {fwd*1e3:.3f}ms "
-                  f"bwd {bwd*1e3:.3f}ms")
+            fwd_results.append((fwd, bq, bk))
+            print(f"  flash fwd bq={bq} bk={bk}: {fwd*1e3:.3f}ms")
         except Exception as e:
-            print(f"  flash bq={bq} bk={bk}: FAILED ({type(e).__name__}: "
-                  f"{str(e)[:120]})")
+            print(f"  flash fwd bq={bq} bk={bk}: FAILED "
+                  f"({type(e).__name__}: {str(e)[:120]})")
+    best_fwd = min(fwd_results) if fwd_results else None
+
+    bwd_results = []
+    if best_fwd is not None:
+        fq, fk = best_fwd[1], best_fwd[2]
+        for bq, bk in itertools.product(cand, cand):
+            try:
+                bfn = grad_of(
+                    lambda q, k, v, _bq=bq, _bk=bk: flash_attention(
+                        q, k, v, causal=causal, block_q=fq, block_k=fk,
+                        block_q_bwd=_bq, block_k_bwd=_bk,
+                        interpret=False))
+                bwd = _time(bfn, q, k, v)  # grad pass = fwd + bwd cost
+                bwd_results.append((bwd, bq, bk))
+                print(f"  flash bwd bq={bq} bk={bk}: {bwd*1e3:.3f}ms")
+            except Exception as e:
+                print(f"  flash bwd bq={bq} bk={bk}: FAILED "
+                      f"({type(e).__name__}: {str(e)[:120]})")
+    best_bwd = min(bwd_results) if bwd_results else None
+
     xf = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=causal))
     x_fwd = _time(xf, q, k, v)
     x_bwd = _time(grad_of(lambda q, k, v: xla_attention(q, k, v,
                                                         causal=causal)),
                   q, k, v)
     x_total = x_fwd + x_bwd
-    print(f"  xla fallback: fwd {x_fwd*1e3:.3f}ms bwd {x_bwd*1e3:.3f}ms")
+    print(f"  xla fallback: fwd {x_fwd*1e3:.3f}ms grad {x_bwd*1e3:.3f}ms")
 
     key = tuning.attention_key(t, t, d, causal)
-    if not results:
+    if best_fwd is None:
         entry = {"use_flash": False, "xla_ms": round(x_total * 1e3, 4),
                  "note": "no flash config compiled"}
+    elif best_bwd is None:
+        # fwd compiled (keep its measured winner for inference-style
+        # callers) but no bwd config did — training dispatch must fall
+        # back, and the note must not claim fwd failed too
+        entry = {"block_q": best_fwd[1], "block_k": best_fwd[2],
+                 "use_flash": False,
+                 "fwd_ms": round(best_fwd[0] * 1e3, 4),
+                 "xla_ms": round(x_total * 1e3, 4),
+                 "note": "fwd compiled; no bwd config compiled"}
     else:
-        best = min(results)
-        entry = {"block_q": best[1], "block_k": best[2],
-                 "use_flash": bool(best[0] < x_total),
-                 "flash_ms": round(best[0] * 1e3, 4),
+        # same convention both sides: total = fwd-only time + grad time
+        # (the grad dispatch re-runs fwd, so fwd cost is inside both
+        # grad numbers)
+        flash_total = best_fwd[0] + best_bwd[0]
+        entry = {"block_q": best_fwd[1], "block_k": best_fwd[2],
+                 "block_q_bwd": best_bwd[1], "block_k_bwd": best_bwd[2],
+                 "use_flash": bool(flash_total < x_total),
+                 "flash_ms": round(flash_total * 1e3, 4),
                  "xla_ms": round(x_total * 1e3, 4)}
     print(f"  -> {key}: {entry}")
     if not dry_run:

@@ -1,6 +1,7 @@
 #!/bin/bash
-# Resumable on-chip evidence filler — supersedes tpu_session.sh /
-# tpu_session_fill.sh (both now delegate here). The relay wedges
+# Resumable on-chip evidence filler — supersedes the old serial sweeps
+# (tpu_session.sh delegates here; tpu_session_fill.sh was retired, its
+# items folded into the list below). The relay wedges
 # unpredictably (observed windows: 17 min, 8 min), so this script is
 # built around short windows: priority-ordered items, a done-marker per
 # item (tpu_evidence/.done/<tag>), and a cheap liveness probe BEFORE
