@@ -78,3 +78,33 @@ def test_flash_bf16():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_grads(causal):
+    """bf16 inputs through the backward kernels: exercises the
+    quantize-to-input-dtype casts on p/ds (the bf16-native MXU precision
+    contract) that float32 tests cannot reach — a wrong cast target
+    breaks numerics here, not just on-chip speed."""
+    q, k, v = _rand_qkv(dtype=jnp.bfloat16)
+    rng = np.random.default_rng(7)
+    ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, block_q=128,
+                                block_k=128, block_q_bwd=64,
+                                block_k_bwd=128,
+                                interpret=True).astype(jnp.float32)
+                * ct).sum()
+
+    def g(q, k, v):
+        return (xla_attention(q, k, v, causal=causal).astype(jnp.float32)
+                * ct).sum()
+
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gg):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-1, atol=1e-1)

@@ -29,9 +29,11 @@ import numpy as np
 
 ATTN_BLOCKS = [128, 256, 512]
 GEMM_TILES = [128, 256, 512]
-# default shape set: BERT-base pretrain, long-context, NMT
-DEFAULT_ATTN = [(32, 128, 12, 64), (8, 512, 12, 64), (2, 2048, 16, 128),
-                (64, 64, 8, 64)]
+# default shape set: BERT-base pretrain, long-context (bert_long's real
+# shape is d=64/h=12 — the table is keyed on (tq, tk, d, causal), so a
+# d=128 tune would never match it), a d=128 long-context variant, NMT
+DEFAULT_ATTN = [(32, 128, 12, 64), (8, 512, 12, 64), (4, 2048, 12, 64),
+                (2, 2048, 16, 128), (64, 64, 8, 64)]
 DEFAULT_GEMM = [(512, 768, 768), (2048, 3072, 768), (4096, 30528, 768)]
 
 
