@@ -42,6 +42,12 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
     assert batch_size >= dp > 0, f"batch {batch_size} must be >= dp {dp}"
     mesh = pt.build_mesh(dp=dp, devices=jax.devices()[:dp])
     model = M.MnistMLP(hidden1=512, hidden2=256)
+    if _MODE == "infer":
+        _rng = np.random.default_rng(0)
+        return _infer_bench(
+            model, lambda bs: (jnp.asarray(
+                _rng.normal(size=(bs, 784)).astype(np.float32)),),
+            steps, batch_size, amp=amp)
     trainer = parallel.Trainer.supervised(
         model, optimizer.Adam(1e-3), M.loss_fn, mesh=mesh, amp=amp)
     rng = np.random.default_rng(0)
@@ -86,6 +92,8 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
 
 _STEPS_PER_CALL = None  # CLI override consumed by _train_bench
 _EXPLICIT_BATCH = False  # set by main() when --batch-size is given
+_MODE = "train"  # "train" | "infer" (--infer): per-model bench fns keep
+# their model/batch construction; _train_bench routes to _infer_bench
 
 
 def _cap(batch_size: int, cap: int) -> int:
@@ -97,7 +105,8 @@ def _cap(batch_size: int, cap: int) -> int:
 
 
 def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
-                 lr=1e-3, amp=None, method="forward", steps_per_call=None):
+                 lr=1e-3, amp=None, method="forward", steps_per_call=None,
+                 infer_batch=None):
     """Shared harness: jitted value_and_grad+Adam step, timed post-warmup.
 
     Timing blocks on the FULL output state, not just the loss scalar — the
@@ -119,6 +128,28 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     from paddle_tpu.core.dtypes import policy_scope
 
     from paddle_tpu import optimizer
+
+    if _MODE == "infer":
+        # the fused-loss training method needs labels; inference runs the
+        # plain forward (real serving materializes the logits). The train
+        # batch tuple may carry trailing label args the forward doesn't
+        # take — truncate to the forward's positional arity. A model
+        # whose label args would ALIAS optional forward params (BERT:
+        # nsp_label landing in attention_mask) must pass ``infer_batch``
+        # explicitly instead.
+        import inspect as _inspect
+
+        infer_method = ("forward" if method == "forward_fused_loss"
+                        else method)
+        if infer_batch is None:
+            fwd_params = list(_inspect.signature(
+                getattr(type(model), infer_method)).parameters.values())[1:]
+            n_pos = sum(1 for p in fwd_params
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD))
+            infer_batch = lambda bs: make_batch(bs)[:n_pos]
+        return _infer_bench(model, infer_batch, steps, batch_size,
+                            amp=amp, method=infer_method)
 
     params = model.named_parameters()
     buffers = model.named_buffers()
@@ -184,6 +215,70 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     return outer * k * batch_size / dt, "examples/sec", extras
 
 
+def _infer_bench(model, make_batch, steps, batch_size, warmup=5, amp=None,
+                 method="forward"):
+    """Inference harness (reference: the per-model inference latency
+    analyzer tests, inference/tests/api/): jitted forward only, no
+    grads/optimizer.
+
+    Two numbers, two disciplines:
+    - latency_ms_p50/p99: one dispatch at a time, host-fenced per call —
+      end-to-end serving latency including the device round trip;
+    - value (examples/sec): pipelined dispatches fenced every few calls —
+      saturated-server throughput.
+    """
+    import contextlib
+
+    import jax
+    from paddle_tpu.core.dtypes import policy_scope
+
+    params = model.named_parameters()
+    buffers = model.named_buffers()
+    batch = make_batch(batch_size)
+
+    @jax.jit
+    def fwd(params, buffers, batch):
+        scope = policy_scope(amp) if amp else contextlib.nullcontext()
+        with scope:
+            out, _ = model.functional_call(
+                params, *batch, buffers=buffers, training=False,
+                method=method)
+        return out
+
+    def _fence(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        idx = (0,) * getattr(leaf, "ndim", 0)
+        float(jax.device_get(leaf[idx] if idx else leaf).real
+              if hasattr(leaf, "real") else leaf)
+
+    for _ in range(warmup):
+        out = fwd(params, buffers, batch)
+    _fence(out)
+
+    # latency: serialize every dispatch
+    lats = []
+    for _ in range(min(steps, 50)):
+        t0 = time.perf_counter()
+        out = fwd(params, buffers, batch)
+        _fence(out)
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+
+    # throughput: keep the queue full, fence periodically
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out = fwd(params, buffers, batch)
+        if i % 8 == 7:
+            _fence(out)
+    _fence(out)
+    dt = time.perf_counter() - t0
+    extras = {"latency_ms_p50": round(p50 * 1e3, 3),
+              "latency_ms_p99": round(p99 * 1e3, 3)}
+    return steps * batch_size / dt, "examples/sec", extras
+
+
 def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
                    amp=None, layout: str = "NHWC"):
     """BASELINE config 2 (image 224 is the headline; smoke uses 64).
@@ -247,7 +342,8 @@ def bench_bert_base(steps: int, batch_size: int, amp=None,
             return out  # forward_fused_loss returns the scalar loss
 
         return _train_bench(model, loss_fn, make_batch, steps, batch_size,
-                            amp=amp, method="forward_fused_loss")
+                            amp=amp, method="forward_fused_loss",
+                            infer_batch=lambda bs: make_batch(bs)[:1])
 
     def make_batch(bs):
         return (jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, T))),)
@@ -332,7 +428,8 @@ def bench_bert_long(steps: int, batch_size: int, amp=None,
         return out  # forward_fused_loss returns the scalar loss
 
     return _train_bench(model, loss_fn, make_batch, steps, batch_size,
-                        amp=amp, method="forward_fused_loss")
+                        amp=amp, method="forward_fused_loss",
+                        infer_batch=lambda bs: make_batch(bs)[:1])
 
 
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
@@ -663,6 +760,10 @@ def main():
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — needed because "
                     "this environment's sitecustomize overrides JAX_PLATFORMS")
+    ap.add_argument("--infer", action="store_true",
+                    help="inference mode: jitted forward only, reports "
+                    "examples/sec + p50/p99 latency (the reference's "
+                    "inference/tests/api latency-harness role)")
     args = ap.parse_args()
 
     if args.platform:
@@ -685,14 +786,23 @@ def main():
     # is safe pre-watchdog (nothing touches the device).
     import inspect
 
+    global _MODE
+    _MODE = "infer" if args.infer else "train"
     fn = MODELS[args.model]
     sig = inspect.signature(fn).parameters
-    metric = f"{args.model}_throughput"
+    metric = (f"{args.model}_infer_throughput" if args.infer
+              else f"{args.model}_throughput")
     if (args.vocab and "vocab" in sig
             and args.vocab != sig["vocab"].default):
         metric += f"_v{args.vocab}"
     if _EXPLICIT_BATCH:
         metric += f"_b{batch}"
+    if args.infer and args.model == "deepfm_sparse":
+        # sparse_grads only changes the UPDATE path; the forward is
+        # identical to deepfm's — bench that instead of duplicating it
+        _emit_error(metric, "--infer: use --model deepfm (the sparse "
+                    "variant differs only in the optimizer update)")
+        return
 
     # device-init watchdog: if the accelerator tunnel is wedged (device
     # claim hangs), still emit the one JSON line the driver expects
@@ -746,6 +856,13 @@ def main():
             global _STEPS_PER_CALL
             _STEPS_PER_CALL = args.steps_per_call
     if args.dp > 1:
+        if args.infer:
+            # bench_mnist_mlp would otherwise build the dp mesh and then
+            # silently measure a single-device forward under a metric
+            # name that carries no dp marker
+            _emit_error(metric, "--infer does not support --dp "
+                        "(inference bench is single-device)")
+            return
         if "dp" not in sig:
             _emit_error(metric,
                         f"--dp is not supported by model {args.model} "
@@ -825,6 +942,9 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
     # Reported only when both sides are known (never on CPU).
     from paddle_tpu.utils.flops import mfu as _mfu
 
+    # latency percentiles from the inference harness ride along verbatim
+    line.update({k: v for k, v in extras.items()
+                 if k.startswith("latency_ms_")})
     flops_per_sec = extras.get("flops_per_sec")
     line["mfu"] = None
     if flops_per_sec:

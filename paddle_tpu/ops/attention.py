@@ -30,13 +30,41 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if use_flash and mask is None and dropout_p == 0.0:
-        flash = _get_flash()
-        if flash is not None and _flash_ok(q, k, causal):
-            return flash(q, k, v, causal=causal, scale=scale)
+    if mask is not None and mask.ndim == 2 \
+            and mask.shape == (q.shape[0], k.shape[1]):
+        # normalize the raw (B, Tk) key-padding form ONCE so the flash
+        # path and the XLA fallback see the same semantics (a bare 2D
+        # mask would right-align-broadcast against (B, H, Tq, Tk) in the
+        # fallback — wrong or a shape error)
+        mask = mask[:, None, None, :]
+    if use_flash and dropout_p == 0.0:
+        # key-padding masks (the broadcast (B, 1, 1, Tk) form every
+        # ragged-batch model emits) ride the flash kernel; only
+        # arbitrary per-head/per-query masks fall back to XLA
+        kv_mask = _as_kv_mask(mask, q.shape[0], k.shape[1])
+        if mask is None or kv_mask is not None:
+            flash = _get_flash()
+            if flash is not None and _flash_ok(q, k, causal):
+                return flash(q, k, v, causal=causal, scale=scale,
+                             kv_mask=kv_mask)
     return xla_attention(q, k, v, mask=mask, causal=causal,
                          dropout_p=dropout_p, dropout_key=dropout_key,
                          scale=scale)
+
+
+def _as_kv_mask(mask, b: int, tk: int):
+    """Normalize a keep-mask to the (B, Tk) key-padding form, or None if
+    it constrains per-head/per-query and must stay on the XLA path."""
+    if mask is None:
+        return None
+    if mask.shape == (b, tk):
+        return mask
+    if mask.ndim == 4 and mask.shape[0] in (1, b) and mask.shape[1] == 1 \
+            and mask.shape[2] == 1 and mask.shape[3] == tk:
+        import jax.numpy as _jnp
+
+        return _jnp.broadcast_to(mask[:, 0, 0, :], (b, tk))
+    return None
 
 
 def xla_attention(q, k, v, mask=None, causal: bool = False,
@@ -88,7 +116,10 @@ def _flash_ok(q, k, causal: bool = False) -> bool:
     if jax.default_backend() not in ("tpu", "axon"):
         return False
     tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
-    if not (tq % 128 == 0 and tk % 128 == 0 and d in (64, 128, 256)):
+    # 64-divisible seqs use block=64 (the tuner measures that shape too:
+    # tools/pallas_tune.py short-seq fallback); the measured use_flash
+    # verdict below still decides whether the kernel actually wins there
+    if not (tq % 64 == 0 and tk % 64 == 0 and d in (64, 128, 256)):
         return False
     from .pallas.tuning import attention_key, get_tuned
 

@@ -59,9 +59,13 @@ def _use_interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, offset, block_q,
-                block_k, num_k_blocks):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
+                offset, block_q, block_k, num_k_blocks):
+    if has_mask:
+        kvm_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        kvm_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -93,10 +97,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             mask = (i * block_q + rows + offset) >= (j * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
+        if has_mask:
+            # key-padding keep-mask (1, bk) broadcasting over q rows
+            s = jnp.where(kvm_ref[0] > 0, s, _NEG_INF)
         m_prev = m_ref[:, :1]                              # (bq, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                             # (bq, bk)
+        if causal or has_mask:
+            # a fully-masked row has m_new == _NEG_INF, making the
+            # masked exp(s - m_new) = exp(0) = 1 instead of 0
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)                    # (bq, 1)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
@@ -114,27 +125,41 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-37))
 
 
-def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
+def _mask_spec(nheads, block_k):
+    # kv_mask is (B, 1, Tk) float; every head of batch row b reads row
+    # b // nheads — the index map folds the (B*h) grid dim back to B
+    return _vmem_spec((1, 1, block_k),
+                      lambda b, i, j, _h=nheads: (b // _h, 0, j))
+
+
+def _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
+              interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     grid = (bh, tq // block_q, tk // block_k)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, offset=tk - tq,
-        block_q=block_q, block_k=block_k, num_k_blocks=tk // block_k)
+        _fwd_kernel, scale=scale, causal=causal, has_mask=kvm is not None,
+        offset=tk - tq, block_q=block_q, block_k=block_k,
+        num_k_blocks=tk // block_k)
     # lse carried as (bh, tq, 1): the trailing unit dim keeps the block's
     # last-two-dims (block_q, 1) legal for the Mosaic (8, 128) tiling rule
     out_shape = (
         jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
     )
+    in_specs = [
+        _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = (q, k, v)
+    if kvm is not None:
+        in_specs.append(_mask_spec(nheads, block_k))
+        inputs += (kvm,)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -146,7 +171,7 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
             _scratch((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse
 
 
@@ -155,9 +180,14 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, offset, block_q, block_k,
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+               scale, causal, has_mask, offset, block_q, block_k,
                num_k_blocks):
+    if has_mask:
+        kvm_ref, dq_ref, dq_acc = refs
+    else:
+        kvm_ref = None
+        dq_ref, dq_acc = refs
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -186,7 +216,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             mask = (i * block_q + rows + offset) >= (j * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
+        if has_mask:
+            s = jnp.where(kvm_ref[0] > 0, s, _NEG_INF)
         p = jnp.exp(s - lse)
+        if causal or has_mask:
+            # fully-masked rows carry lse == _NEG_INF (see fwd _finish)
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -200,9 +235,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, offset,
-                block_q, block_k, num_q_blocks):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                scale, causal, has_mask, offset, block_q, block_k,
+                num_q_blocks):
+    if has_mask:
+        kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        kvm_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     j, i = pl.program_id(1), pl.program_id(2)  # kv block outer, q block inner
 
     @pl.when(i == 0)
@@ -230,7 +270,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             mask = (i * block_q + rows + offset) >= (j * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
+        if has_mask:
+            s = jnp.where(kvm_ref[0] > 0, s, _NEG_INF)
         p = jnp.exp(s - lse)                               # (bq, bk) f32
+        if causal or has_mask:
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # (bk, d)
@@ -248,45 +292,61 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-              interpret):
+def _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale, block_q,
+              block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (bh, tq, 1)
+    has_mask = kvm is not None
 
+    dq_in_specs = [
+        _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_inputs = (q, k, v, do, lse, delta)
+    if has_mask:
+        dq_in_specs.append(_mask_spec(nheads, block_k))
+        dq_inputs += (kvm,)
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal, offset=tk - tq,
-            block_q=block_q, block_k=block_k, num_k_blocks=tk // block_k),
+            _dq_kernel, scale=scale, causal=causal, has_mask=has_mask,
+            offset=tk - tq, block_q=block_q, block_k=block_k,
+            num_k_blocks=tk // block_k),
         grid=(bh, tq // block_q, tk // block_k),
-        in_specs=[
-            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[_scratch((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
+    dkv_in_specs = [
+        _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+    ]
+    dkv_inputs = (q, k, v, do, lse, delta)
+    if has_mask:
+        # note the swapped grid axes (kv outer, q inner): index args are
+        # (b, j, i) here, the mask still selects k block j
+        dkv_in_specs.append(_vmem_spec(
+            (1, 1, block_k), lambda b, j, i, _h=nheads: (b // _h, 0, j)))
+        dkv_inputs += (kvm,)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, offset=tk - tq,
-            block_q=block_q, block_k=block_k, num_q_blocks=tq // block_q),
+            _dkv_kernel, scale=scale, causal=causal, has_mask=has_mask,
+            offset=tk - tq, block_q=block_q, block_k=block_k,
+            num_q_blocks=tq // block_q),
         grid=(bh, tk // block_k, tq // block_q),
-        in_specs=[
-            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=(
             _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -300,7 +360,7 @@ def _bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             _scratch((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -309,24 +369,28 @@ def _bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
-           block_k_bwd, interpret):
-    o, _ = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
+           block_q_bwd, block_k_bwd, interpret):
+    o, _ = _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
+                     interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
-               block_k_bwd, interpret):
-    o, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
+               block_q_bwd, block_k_bwd, interpret):
+    o, lse = _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q,
+                       block_k, interpret)
+    return o, (q, k, v, kvm, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
-               interpret, res, do):
-    q, k, v, o, lse = res
-    return _bwd_call(q, k, v, o, lse, do, causal, scale, block_q_bwd,
-                     block_k_bwd, interpret)
+def _flash_bwd(nheads, causal, scale, block_q, block_k, block_q_bwd,
+               block_k_bwd, interpret, res, do):
+    q, k, v, kvm, o, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale,
+                           block_q_bwd, block_k_bwd, interpret)
+    return dq, dk, dv, None  # the keep-mask carries no gradient
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -334,6 +398,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
+                    kv_mask=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     block_q_bwd: Optional[int] = None,
@@ -346,6 +411,12 @@ def flash_attention(q, k, v, causal: bool = False,
     Block sizes default to the autotuned table (ops/pallas/tuning.py,
     written by tools/pallas_tune.py on real hardware) and fall back to
     128x128.
+
+    ``kv_mask``: optional (batch, tk) keep-mask (True/nonzero = attend) —
+    the key-padding form every ragged-batch model needs (the LoD
+    replacement, ops/sequence.py); masked keys contribute nothing and
+    fully-masked rows output zeros, matching ops.attention.xla_attention.
+    Arbitrary (B, H, Tq, Tk) masks stay on the XLA path.
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -359,12 +430,17 @@ def flash_attention(q, k, v, causal: bool = False,
 
     def _resolve(given, key, seq, default):
         # pow2 buckets can hold shapes the tuned block doesn't divide
-        # (e.g. 384 in the 512 bucket with block 256) — fall back to the
-        # default rather than trip the divisibility error below
+        # (e.g. 384 in the 512 bucket with block 256) — walk a fallback
+        # chain (tuned -> default -> 64) and take the first block that
+        # divides the seq, rather than trip the divisibility error below
+        # (the dispatch gate admits any 64-divisible seq, so e.g. 192
+        # must resolve to 64, not crash on the 128 default)
         if given is not None:
             return min(given, seq)
-        t = tuned.get(key)
-        return min(t if t and seq % min(t, seq) == 0 else default, seq)
+        for cand in (tuned.get(key), default, 64):
+            if cand and seq % min(cand, seq) == 0:
+                return min(cand, seq)
+        return min(default, seq)
 
     block_q = _resolve(block_q, "block_q", tq, DEFAULT_BLOCK_Q)
     block_k = _resolve(block_k, "block_k", tk, DEFAULT_BLOCK_K)
@@ -382,6 +458,15 @@ def flash_attention(q, k, v, causal: bool = False,
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    of = _flash(qf, kf, vf, causal, float(scale), block_q, block_k,
+    kvm = None
+    if kv_mask is not None:
+        if kv_mask.shape != (b, tk):
+            raise ValueError(
+                f"kv_mask must be (batch, tk) = ({b},{tk}), got "
+                f"{kv_mask.shape}")
+        # (B, 1, Tk) float: the unit middle dim gives the mask block a
+        # legal (1, block_k) last-two-dims layout (same trick as lse)
+        kvm = kv_mask.astype(jnp.float32).reshape(b, 1, tk)
+    of = _flash(qf, kf, vf, kvm, h, causal, float(scale), block_q, block_k,
                 block_q_bwd, block_k_bwd, interpret)
     return of.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

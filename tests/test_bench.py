@@ -188,3 +188,19 @@ def test_cpu_device_never_writes_history_via_report(tmp_path, monkeypatch):
                                                 device_kind="cpu"))
     assert not os.path.exists(hist)
     assert line["mfu"] is None
+
+
+def test_infer_mode_emits_latency_line():
+    """--infer (the reference inference/tests/api latency-harness role):
+    one JSON line with examples/sec + p50/p99 latency, suffixed metric."""
+    d = _run("--infer", "--smoke", "--steps", "8", "--batch-size", "32")
+    assert d["metric"] == "mnist_mlp_infer_throughput_b32"
+    assert d["value"] > 0 and d["unit"] == "examples/sec"
+    assert d["latency_ms_p50"] > 0
+    assert d["latency_ms_p99"] >= d["latency_ms_p50"]
+
+
+def test_infer_deepfm_sparse_redirects():
+    d = _run("--infer", "--model", "deepfm_sparse", "--smoke")
+    assert d["value"] == 0.0
+    assert "use --model deepfm" in d["error"]

@@ -108,3 +108,76 @@ def test_flash_bf16_grads(causal):
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             rtol=1e-1, atol=1e-1)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kv_mask_matches_xla(causal):
+    """Key-padding mask through the kernel (the ragged-batch/LoD serving
+    form): masked keys contribute nothing; a fully-masked row outputs
+    zeros — both matching the xla_attention oracle."""
+    b, t = 2, 256
+    q, k, v = _rand_qkv(b=b, t=t)
+    rng = np.random.default_rng(3)
+    lengths = np.array([200, 128])
+    keep = jnp.asarray(np.arange(t)[None, :] < lengths[:, None])
+
+    out = flash_attention(q, k, v, causal=causal, kv_mask=keep,
+                          interpret=True)
+    ref = xla_attention(q, k, v, mask=keep[:, None, None, :],
+                        causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # fully-masked batch row -> zeros (flash-kernel convention both paths)
+    none_keep = jnp.asarray(np.zeros((b, t), bool))
+    out0 = flash_attention(q, k, v, causal=causal, kv_mask=none_keep,
+                           interpret=True)
+    assert float(jnp.max(jnp.abs(out0))) == 0.0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kv_mask_grads_match_xla(causal):
+    b, t = 2, 256
+    q, k, v = _rand_qkv(b=b, t=t)
+    rng = np.random.default_rng(5)
+    keep = jnp.asarray(np.arange(t)[None, :] < np.array([224, 96])[:, None])
+    ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, kv_mask=keep,
+                                interpret=True) * ct).sum()
+
+    def g(q, k, v):
+        return (xla_attention(q, k, v, mask=keep[:, None, None, :],
+                              causal=causal) * ct).sum()
+
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(gf, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_dispatch_routes_key_padding_mask_to_flash(monkeypatch):
+    """scaled_dot_product_attention sends (B,1,1,Tk) keep-masks to the
+    flash kernel and arbitrary per-query masks to XLA."""
+    from paddle_tpu.ops import attention as A
+
+    called = {}
+
+    def fake_flash(q, k, v, causal=False, scale=None, kv_mask=None):
+        called["kv_mask"] = kv_mask
+        return q
+
+    monkeypatch.setattr(A, "_get_flash", lambda: fake_flash)
+    monkeypatch.setattr(A, "_flash_ok", lambda *a, **k: True)
+    q = jnp.zeros((2, 128, 2, 64), jnp.float32)
+
+    keep4 = jnp.ones((2, 1, 1, 128), bool)
+    A.scaled_dot_product_attention(q, q, q, mask=keep4)
+    assert called["kv_mask"].shape == (2, 128)
+
+    called.clear()
+    per_query = jnp.ones((2, 1, 128, 128), bool)
+    out = A.scaled_dot_product_attention(q, q, q, mask=per_query)
+    assert "kv_mask" not in called  # arbitrary mask stays on XLA

@@ -74,3 +74,34 @@ def test_quant_matmul_lowers_to_mosaic(mnk):
             a, b, sa, sb, tile_m=_t[0], tile_n=_t[1], tile_k=_t[2],
             use_pallas=True))
         _export_tpu(f, a, b)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kv_mask_lowers_to_mosaic(causal):
+    """The key-padding-mask kernel variant (extra (B,1,Tk) input with a
+    b//h folding index map) must Mosaic-lower too — its block spec is
+    the one new tiling risk this file exists to catch."""
+    b, t, h, d = 8, 512, 12, 64
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    keep = jnp.ones((b, t), jnp.bool_)
+    fwd = jax.jit(lambda q, k, v, m: flash_attention(
+        q, k, v, causal=causal, kv_mask=m, block_q=128, block_k=128,
+        interpret=False))
+    _export_tpu(fwd, q, q, q, keep)
+
+    bwd = jax.jit(jax.grad(
+        lambda q, k, v, m: flash_attention(
+            q, k, v, causal=causal, kv_mask=m, block_q=128, block_k=128,
+            interpret=False).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    _export_tpu(bwd, q, q, q, keep)
+
+
+def test_flash_t64_lowers_to_mosaic():
+    """The t=64 short-sequence path (block=t fallback) the dispatch gate
+    now admits — NMT's seq-64 shape."""
+    b, t, h, d = 64, 64, 8, 64
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    fwd = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, block_q=64, block_k=64, interpret=False))
+    _export_tpu(fwd, q, q, q)

@@ -90,6 +90,23 @@ item bench_bert_fp32  1200 python bench.py --model bert_base --amp float32
 # on-chip; CPU showed sparse 63x ahead at V=1M — capture the chip side)
 item deepfm_v1m        1200 python bench.py --model deepfm --vocab 1000000
 item deepfm_sparse_v1m 1200 python bench.py --model deepfm_sparse --vocab 1000000
+# batch-size sweeps: the low-MFU models are batch-starved at their
+# headline configs (nmt b64/T64, lstm b512); the _bN metric suffix keeps
+# these from colliding with the headline history entries
+item bench_nmt_b256    1200 python bench.py --model transformer_nmt --batch-size 256
+item bench_rn50_b256   1500 python bench.py --model resnet50 --batch-size 256
+item bench_lstm_b2048  1200 python bench.py --model stacked_lstm --batch-size 2048
+item bench_bertlong_b8 1500 python bench.py --model bert_long --batch-size 8
+# mnist is pure dispatch-bound through the tunnel; if k=32 wins, its
+# default steps_per_call should be bumped to match
+item bench_mnist_k32   900  python bench.py --steps-per-call 32
+# inference latency/throughput (the reference's inference/tests/api
+# latency-harness role — BASELINE.md table row)
+item infer_resnet50    1200 python bench.py --infer --model resnet50
+item infer_bert        1200 python bench.py --infer --model bert_base
+item infer_mnist       900  python bench.py --infer
+item infer_deepfm      900  python bench.py --infer --model deepfm
+item infer_nmt         1200 python bench.py --infer --model transformer_nmt
 # -- tier 4: full-sweep completeness (superset of the retired
 # tpu_session.sh list so a FRESH environment gets every model and every
 # default tune shape from this one script; in an already-captured
