@@ -442,12 +442,21 @@ class MultiHeadAttention(Layer):
         v = self.v_proj(value).reshape(b, tk, h, hd)
 
         if self.seq_parallel is not None:
-            # explicit errors, never a silent fall-back to full attention —
-            # the full path materializes (B,H,T,T) scores and would OOM on
+            # key-padding masks ((B, Tk) or (B, 1, 1, Tk)) ride the SP
+            # paths (ring rotates the mask block with its K/V; Ulysses
+            # all-gathers it); anything per-head/per-query is an explicit
+            # error, never a silent fall-back to full attention — the
+            # full path materializes (B,H,T,T) scores and would OOM on
             # exactly the sequence lengths SP exists for
-            enforce(attn_mask is None,
-                    "seq_parallel=%s does not support attn_mask yet; use "
-                    "causal= or pack sequences", self.seq_parallel)
+            kv_mask = None
+            if attn_mask is not None:
+                from ..ops.attention import _as_kv_mask
+
+                kv_mask = _as_kv_mask(attn_mask, b, tk)
+                enforce(kv_mask is not None,
+                        "seq_parallel=%s supports only key-padding masks "
+                        "((B, Tk) or (B, 1, 1, Tk)); got shape %s",
+                        self.seq_parallel, attn_mask.shape)
             enforce(not (self.training and self.dropout_p > 0),
                     "seq_parallel attention does not support attention "
                     "dropout; set dropout=0 on MultiHeadAttention")
@@ -460,7 +469,8 @@ class MultiHeadAttention(Layer):
             kw = ({"use_flash": self.use_flash}
                   if self.seq_parallel == "ulysses" else {})
             out = context_parallel_attention(
-                q, k, v, impl=self.seq_parallel, causal=causal, **kw)
+                q, k, v, impl=self.seq_parallel, causal=causal,
+                kv_mask=kv_mask, **kw)
         else:
             from ..ops.attention import scaled_dot_product_attention
 

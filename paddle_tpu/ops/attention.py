@@ -30,17 +30,13 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if mask is not None and mask.ndim == 2 \
-            and mask.shape == (q.shape[0], k.shape[1]):
-        # normalize the raw (B, Tk) key-padding form ONCE so the flash
-        # path and the XLA fallback see the same semantics (a bare 2D
-        # mask would right-align-broadcast against (B, H, Tq, Tk) in the
-        # fallback — wrong or a shape error)
-        mask = mask[:, None, None, :]
     if use_flash and dropout_p == 0.0:
         # key-padding masks (the broadcast (B, 1, 1, Tk) form every
-        # ragged-batch model emits) ride the flash kernel; only
-        # arbitrary per-head/per-query masks fall back to XLA
+        # ragged-batch model emits) ride the flash kernel; anything else
+        # falls back to XLA — including 2D masks, whose historical
+        # broadcast semantics are per-QUERY (Tq, Tk), right-aligned
+        # against the (B, H, Tq, Tk) logits; promoting a (B, Tk)-shaped
+        # one to key-padding would silently change meaning when B == Tq
         kv_mask = _as_kv_mask(mask, q.shape[0], k.shape[1])
         if mask is None or kv_mask is not None:
             flash = _get_flash()
@@ -54,11 +50,12 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
 
 def _as_kv_mask(mask, b: int, tk: int):
     """Normalize a keep-mask to the (B, Tk) key-padding form, or None if
-    it constrains per-head/per-query and must stay on the XLA path."""
+    it constrains per-head/per-query and must stay on the XLA path.
+    Only the explicit (B, 1, 1, Tk) broadcast form qualifies — a bare 2D
+    mask means per-query (Tq, Tk) under the documented right-aligned
+    broadcast, never key padding."""
     if mask is None:
         return None
-    if mask.shape == (b, tk):
-        return mask
     if mask.ndim == 4 and mask.shape[0] in (1, b) and mask.shape[1] == 1 \
             and mask.shape[2] == 1 and mask.shape[3] == tk:
         import jax.numpy as _jnp

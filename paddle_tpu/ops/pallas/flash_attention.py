@@ -99,7 +99,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
             s = jnp.where(mask, s, _NEG_INF)
         if has_mask:
             # key-padding keep-mask (1, bk) broadcasting over q rows
-            s = jnp.where(kvm_ref[0] > 0, s, _NEG_INF)
+            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(kvm > 0, s, _NEG_INF)
         m_prev = m_ref[:, :1]                              # (bq, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -125,11 +126,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
         lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-37))
 
 
-def _mask_spec(nheads, block_k):
+def _mask_spec(nheads, tk):
     # kv_mask is (B, 1, Tk) float; every head of batch row b reads row
-    # b // nheads — the index map folds the (B*h) grid dim back to B
-    return _vmem_spec((1, 1, block_k),
-                      lambda b, i, j, _h=nheads: (b // _h, 0, j))
+    # b // nheads — the index map folds the (B*h) grid dim back to B.
+    # The block spans the FULL Tk lane dim (legal for any block_k: a
+    # lane dim equal to the array dim always satisfies Mosaic tiling,
+    # where a (1, block_k<128) lane block would not); kernels slice the
+    # j-th chunk with pl.ds. Cost: Tk floats of VMEM, loaded once.
+    return _vmem_spec((1, 1, tk),
+                      lambda b, i, j, _h=nheads: (b // _h, 0, 0))
 
 
 def _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
@@ -154,7 +159,7 @@ def _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
     ]
     inputs = (q, k, v)
     if kvm is not None:
-        in_specs.append(_mask_spec(nheads, block_k))
+        in_specs.append(_mask_spec(nheads, tk))
         inputs += (kvm,)
     o, lse = pl.pallas_call(
         kernel,
@@ -217,7 +222,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
             mask = (i * block_q + rows + offset) >= (j * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
         if has_mask:
-            s = jnp.where(kvm_ref[0] > 0, s, _NEG_INF)
+            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(kvm > 0, s, _NEG_INF)
         p = jnp.exp(s - lse)
         if causal or has_mask:
             # fully-masked rows carry lse == _NEG_INF (see fwd _finish)
@@ -271,7 +277,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
             mask = (i * block_q + rows + offset) >= (j * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
         if has_mask:
-            s = jnp.where(kvm_ref[0] > 0, s, _NEG_INF)
+            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(kvm > 0, s, _NEG_INF)
         p = jnp.exp(s - lse)                               # (bq, bk) f32
         if causal or has_mask:
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
@@ -310,7 +317,7 @@ def _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale, block_q,
     ]
     dq_inputs = (q, k, v, do, lse, delta)
     if has_mask:
-        dq_in_specs.append(_mask_spec(nheads, block_k))
+        dq_in_specs.append(_mask_spec(nheads, tk))
         dq_inputs += (kvm,)
     dq = pl.pallas_call(
         functools.partial(
@@ -335,10 +342,9 @@ def _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale, block_q,
     ]
     dkv_inputs = (q, k, v, do, lse, delta)
     if has_mask:
-        # note the swapped grid axes (kv outer, q inner): index args are
-        # (b, j, i) here, the mask still selects k block j
-        dkv_in_specs.append(_vmem_spec(
-            (1, 1, block_k), lambda b, j, i, _h=nheads: (b // _h, 0, j)))
+        # grid axes are swapped here (kv outer, q inner) but the full-row
+        # mask block ignores both grid indices anyway
+        dkv_in_specs.append(_mask_spec(nheads, tk))
         dkv_inputs += (kvm,)
     dk, dv = pl.pallas_call(
         functools.partial(

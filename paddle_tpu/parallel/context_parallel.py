@@ -42,10 +42,12 @@ _NEG_INF = -1e30  # finite: avoids inf-inf NaNs under autodiff
 # ---------------------------------------------------------------------------
 
 
-def _ring_step_compute(qf, acc, m, l, kc, vc, src, my_idx, *, t_local, causal,
-                       scale):
+def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, src, my_idx, *, t_local,
+                       causal, scale):
     """One ring step's flash-style accumulation (no collectives; wrapped in
-    jax.checkpoint by the caller so backward recomputes the (t×t) scores)."""
+    jax.checkpoint by the caller so backward recomputes the (t×t) scores).
+    ``kmc``: the K/V block's key-padding keep-mask (b, t_local) rotating
+    around the ring with it, or None."""
     # q/k stay in their native dtype (bf16 in production): bf16 inputs
     # with an f32 preferred_element_type run at the full MXU rate, while
     # a pre-cast to f32 would drop to the fp32 matmul rate (4-8x slower
@@ -58,9 +60,17 @@ def _ring_step_compute(qf, acc, m, l, kc, vc, src, my_idx, *, t_local, causal,
         cols = src * t_local + lax.broadcasted_iota(
             jnp.int32, (t_local, t_local), 1)
         s = jnp.where(rows >= cols, s, _NEG_INF)
+    if kmc is not None:
+        s = jnp.where(kmc[:, None, None, :], s, _NEG_INF)
     m_cur = jnp.max(s, axis=-1, keepdims=True)          # (b,h,t,1)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
+    if kmc is not None:
+        # a fully-masked row keeps m_new == _NEG_INF, turning the masked
+        # exp(s - m_new) into exp(0) = 1; zero those entries so l stays 0
+        # and the final o is 0 (causal alone can't fully mask a row —
+        # the diagonal is always visible)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
     alpha = jnp.exp(m - m_new)
     l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
@@ -76,8 +86,9 @@ def _ring_step_compute(qf, acc, m, l, kc, vc, src, my_idx, *, t_local, causal,
     return acc_new, m_new, l_new
 
 
-def _ring_inner(q, k, v, *, axis, causal, scale, n):
+def _ring_inner(q, k, v, km, *, axis, causal, scale, n):
     b, t, h, d = q.shape  # local (sequence-sharded) shapes
+    has_mask = km is not None
     my_idx = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     qf = q  # native dtype into the MXU (see _ring_step_compute note)
@@ -85,33 +96,44 @@ def _ring_inner(q, k, v, *, axis, causal, scale, n):
         _ring_step_compute, t_local=t, causal=causal, scale=scale))
 
     def step(carry, t_step):
-        acc, m, l, kc, vc = carry
+        acc, m, l, kc, vc, kmc = carry
         src = (my_idx - t_step) % n  # origin rank of the K/V block we hold
-        acc, m, l = compute(qf, acc, m, l, kc, vc, src, my_idx)
+        acc, m, l = compute(qf, acc, m, l, kc, vc,
+                            kmc if has_mask else None, src, my_idx)
         kc = lax.ppermute(kc, axis, perm)
         vc = lax.ppermute(vc, axis, perm)
-        return (acc, m, l, kc, vc), None
+        if has_mask:  # the keep-mask block travels with its K/V block
+            kmc = lax.ppermute(kmc, axis, perm)
+        return (acc, m, l, kc, vc, kmc), None
 
     acc0 = jnp.zeros((b, t, h, d), jnp.float32)
     m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    # a zeros placeholder keeps the scan carry structure static when no
+    # mask is supplied (it is never read: has_mask is a trace-time const)
+    km0 = km if has_mask else jnp.zeros((b, t), jnp.bool_)
     # scan the first n-1 steps (compute + rotate); the last block's compute is
     # peeled out so the final rotation — whose result would be discarded —
     # never hits the ICI ring
-    (acc, m, l, kc, vc), _ = lax.scan(
-        step, (acc0, m0, l0, k, v), jnp.arange(n - 1))
-    acc, _, l = compute(qf, acc, m, l, kc, vc, (my_idx - (n - 1)) % n, my_idx)
+    (acc, m, l, kc, vc, kmc), _ = lax.scan(
+        step, (acc0, m0, l0, k, v, km0), jnp.arange(n - 1))
+    acc, _, l = compute(qf, acc, m, l, kc, vc,
+                        kmc if has_mask else None,
+                        (my_idx - (n - 1)) % n, my_idx)
     o = acc / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-37)
     return o.astype(q.dtype)
 
 
 def ring_attention(q, k, v, *, causal: bool = False,
                    scale: Optional[float] = None, axis: str = "sp",
-                   batch_axis: Optional[str] = "dp", mesh=None):
+                   batch_axis: Optional[str] = "dp", mesh=None,
+                   kv_mask=None):
     """Sequence-parallel attention over global (B, T, H, D) arrays.
 
     ``q``/``k``/``v`` are sharded ``P(batch_axis, axis)`` over the mesh; T must
     divide by the ``axis`` size. Causal masking is in *global* positions.
+    ``kv_mask``: optional global (B, T) keep-mask (the ragged-batch
+    key-padding form); its blocks rotate around the ring with their K/V.
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
@@ -119,14 +141,25 @@ def ring_attention(q, k, v, *, causal: bool = False,
     enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
     enforce(k.shape == q.shape and v.shape == q.shape,
             "ring attention is self-attention shaped: q/k/v must match")
+    if kv_mask is not None:
+        enforce(kv_mask.shape == (b, t),
+                "kv_mask must be (batch, seq) = (%s, %s), got %s",
+                b, t, kv_mask.shape)
     if scale is None:
         scale = d ** -0.5
     spec = P(batch_axis, axis, None, None)
+    mspec = P(batch_axis, axis)
     inner = functools.partial(_ring_inner, axis=axis, causal=causal,
                               scale=float(scale), n=n)
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
-    return fn(q, k, v)
+    if kv_mask is None:
+        fn = jax.shard_map(lambda q, k, v: inner(q, k, v, None), mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec,
+                           check_vma=False)
+        return fn(q, k, v)
+    fn = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(spec, spec, spec, mspec), out_specs=spec,
+                       check_vma=False)
+    return fn(q, k, v, kv_mask)
 
 
 # ---------------------------------------------------------------------------
@@ -134,15 +167,22 @@ def ring_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 
-def _ulysses_inner(q, k, v, *, axis, causal, scale, use_flash):
+def _ulysses_inner(q, k, v, km, *, axis, causal, scale, use_flash):
     from ..ops.attention import scaled_dot_product_attention
 
     # (b, t/sp, h, d) --a2a--> (b, t, h/sp, d): full sequence, head subset
     q = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
-    o = scaled_dot_product_attention(q, k, v, causal=causal, scale=scale,
-                                     use_flash=use_flash)
+    mask = None
+    if km is not None:
+        # each shard holds (b, t/sp) of the keep-mask; after the a2a the
+        # local attention sees the FULL sequence, so gather the mask
+        # along sp (tiny: bools, no head/dim axes)
+        full = lax.all_gather(km, axis, axis=1, tiled=True)  # (b, t)
+        mask = full[:, None, None, :]
+    o = scaled_dot_product_attention(q, k, v, mask=mask, causal=causal,
+                                     scale=scale, use_flash=use_flash)
     # back to sequence sharding
     return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 
@@ -150,22 +190,38 @@ def _ulysses_inner(q, k, v, *, axis, causal, scale, use_flash):
 def ulysses_attention(q, k, v, *, causal: bool = False,
                       scale: Optional[float] = None, axis: str = "sp",
                       batch_axis: Optional[str] = "dp", mesh=None,
-                      use_flash: bool = True):
+                      use_flash: bool = True, kv_mask=None):
     """DeepSpeed-Ulysses-style SP: a2a seq→head shard, local full attention
-    (Pallas flash on TPU), a2a back. Requires heads % sp == 0."""
+    (Pallas flash on TPU), a2a back. Requires heads % sp == 0.
+    ``kv_mask``: optional global (B, T) keep-mask; all-gathered over sp
+    for the full-sequence local attention (key-padding routes to the
+    flash kernel's kv_mask path on TPU)."""
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
     b, t, h, d = q.shape
     enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
     enforce(h % n == 0, "num heads %s must divide sp size %s (Ulysses)", h, n)
+    if kv_mask is not None:
+        # key-padding masks cover the KEY sequence: cross-attention under
+        # Ulysses has tk != tq and the mask belongs to k/v, not q
+        tk = k.shape[1]
+        enforce(kv_mask.shape == (b, tk),
+                "kv_mask must be (batch, key_seq) = (%s, %s), got %s",
+                b, tk, kv_mask.shape)
     if scale is None:
         scale = d ** -0.5
     spec = P(batch_axis, axis, None, None)
     inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
                               scale=float(scale), use_flash=use_flash)
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+    if kv_mask is None:
+        fn = jax.shard_map(lambda q, k, v: inner(q, k, v, None), mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec,
+                           check_vma=False)
+        return fn(q, k, v)
+    fn = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(spec, spec, spec, P(batch_axis, axis)),
                        out_specs=spec, check_vma=False)
-    return fn(q, k, v)
+    return fn(q, k, v, kv_mask)
 
 
 def context_parallel_attention(q, k, v, *, impl: str = "ring", **kw):

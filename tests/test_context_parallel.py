@@ -124,10 +124,74 @@ def test_encoder_stack_seq_parallel_matches_baseline(sp_mesh):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_seq_parallel_mask_raises(sp_mesh):
+def test_seq_parallel_mask_contract(sp_mesh):
+    """Key-padding masks ride the SP paths now; per-query masks stay an
+    explicit error (silent full-attention fall-back would OOM at the
+    lengths SP exists for)."""
     import paddle_tpu.nn as nn
 
     mha = nn.MultiHeadAttention(32, 4, seq_parallel="ring").eval()
-    x = jnp.zeros((2, 64, 32), jnp.float32)
-    with pytest.raises(Exception, match="attn_mask"):
-        mha(x, attn_mask=jnp.ones((2, 1, 1, 64), jnp.bool_))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 32))
+                    .astype(np.float32))
+    keep = jnp.asarray(np.arange(64)[None, :] < np.array([40, 64])[:, None])
+    out = mha(x, attn_mask=keep[:, None, None, :])
+    ref = mha(x)  # row 1 fully visible -> identical there
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref[1]),
+                               atol=2e-5, rtol=2e-5)
+    with pytest.raises(Exception, match="key-padding"):
+        mha(x, attn_mask=jnp.ones((2, 1, 64, 64), jnp.bool_))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_kv_mask(sp_mesh, causal):
+    """Ragged-batch key-padding under ring SP: the keep-mask blocks
+    rotate with their K/V; fully-masked rows output zeros (the
+    flash/xla convention)."""
+    q, k, v = _qkv(3)
+    lengths = np.array([48, 64])
+    keep = jnp.asarray(np.arange(T)[None, :] < lengths[:, None])
+    got = ring_attention(q, k, v, causal=causal, mesh=sp_mesh,
+                         kv_mask=keep)
+    want = xla_attention(q, k, v, mask=keep[:, None, None, :],
+                         causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    # fully-masked batch row -> zeros, not NaN/garbage
+    none_keep = jnp.asarray(np.zeros((B, T), bool))
+    got0 = ring_attention(q, k, v, causal=causal, mesh=sp_mesh,
+                          kv_mask=none_keep)
+    assert float(jnp.max(jnp.abs(got0))) == 0.0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_kv_mask_grads(sp_mesh, causal):
+    q, k, v = _qkv(4)
+    keep = jnp.asarray(np.arange(T)[None, :] < np.array([40, 56])[:, None])
+
+    def loss_ring(q, k, v):
+        o = ring_attention(q, k, v, causal=causal, mesh=sp_mesh,
+                           kv_mask=keep)
+        return jnp.sum(o * o)
+
+    def loss_full(q, k, v):
+        o = xla_attention(q, k, v, mask=keep[:, None, None, :],
+                          causal=causal)
+        return jnp.sum(o * o)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_kv_mask(sp_mesh, causal):
+    q, k, v = _qkv(5)
+    keep = jnp.asarray(np.arange(T)[None, :] < np.array([32, 60])[:, None])
+    got = ulysses_attention(q, k, v, causal=causal, mesh=sp_mesh,
+                            kv_mask=keep, use_flash=False)
+    want = xla_attention(q, k, v, mask=keep[:, None, None, :],
+                         causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)

@@ -76,25 +76,40 @@ def test_quant_matmul_lowers_to_mosaic(mnk):
         _export_tpu(f, a, b)
 
 
+@pytest.mark.parametrize("blocks", [(128, 128), (64, 64), (256, 128)])
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_kv_mask_lowers_to_mosaic(causal):
-    """The key-padding-mask kernel variant (extra (B,1,Tk) input with a
-    b//h folding index map) must Mosaic-lower too — its block spec is
-    the one new tiling risk this file exists to catch."""
+def test_flash_kv_mask_lowers_to_mosaic(causal, blocks):
+    """The key-padding-mask kernel variant (extra (B,1,Tk) full-lane-row
+    input with a b//h folding index map) must Mosaic-lower too — for
+    EVERY block size the %64 dispatch gate can produce, incl. block 64
+    (a (1,1,64) lane block would violate Mosaic tiling; the full-row
+    spec + in-kernel pl.ds slice is what makes this legal)."""
+    bq, bk = blocks
     b, t, h, d = 8, 512, 12, 64
     q = jnp.zeros((b, t, h, d), jnp.bfloat16)
     keep = jnp.ones((b, t), jnp.bool_)
     fwd = jax.jit(lambda q, k, v, m: flash_attention(
-        q, k, v, causal=causal, kv_mask=m, block_q=128, block_k=128,
+        q, k, v, causal=causal, kv_mask=m, block_q=bq, block_k=bk,
         interpret=False))
     _export_tpu(fwd, q, q, q, keep)
 
     bwd = jax.jit(jax.grad(
         lambda q, k, v, m: flash_attention(
-            q, k, v, causal=causal, kv_mask=m, block_q=128, block_k=128,
+            q, k, v, causal=causal, kv_mask=m, block_q=bq, block_k=bk,
             interpret=False).astype(jnp.float32).sum(),
         argnums=(0, 1, 2)))
     _export_tpu(bwd, q, q, q, keep)
+
+
+def test_flash_t192_masked_lowers_to_mosaic():
+    """tq=192 (64-mod-128, admitted by the relaxed gate) resolves to
+    block 64 via the divisor fallback chain and must lower masked."""
+    b, t, h, d = 2, 192, 4, 64
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    keep = jnp.ones((b, t), jnp.bool_)
+    fwd = jax.jit(lambda q, k, v, m: flash_attention(
+        q, k, v, kv_mask=m, interpret=False))
+    _export_tpu(fwd, q, q, q, keep)
 
 
 def test_flash_t64_lowers_to_mosaic():
