@@ -23,13 +23,19 @@ from ..core.enforce import enforce
 def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
                                  dropout_p: float = 0.0, dropout_key=None,
                                  scale: Optional[float] = None,
-                                 use_flash: bool = True):
+                                 use_flash: bool = True,
+                                 segment_ids=None):
     """q: (B, Tq, H, D), k/v: (B, Tk, H, D) → (B, Tq, H, D).
 
     mask: broadcastable to (B, H, Tq, Tk); True/1 = keep, False/0 = mask out.
+    segment_ids: (B, T) int ids for packed batches (self-attention only);
+    positions attend within their own segment. Composes with causal/mask.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    enforce(segment_ids is None or q.shape[1] == k.shape[1],
+            "segment_ids requires self-attention shapes (tq=%s != tk=%s)",
+            q.shape[1], k.shape[1])
     if use_flash and dropout_p == 0.0:
         # key-padding masks (the broadcast (B, 1, 1, Tk) form every
         # ragged-batch model emits) ride the flash kernel; anything else
@@ -42,10 +48,10 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
             flash = _get_flash()
             if flash is not None and _flash_ok(q, k, causal):
                 return flash(q, k, v, causal=causal, scale=scale,
-                             kv_mask=kv_mask)
+                             kv_mask=kv_mask, segment_ids=segment_ids)
     return xla_attention(q, k, v, mask=mask, causal=causal,
                          dropout_p=dropout_p, dropout_key=dropout_key,
-                         scale=scale)
+                         scale=scale, segment_ids=segment_ids)
 
 
 def _as_kv_mask(mask, b: int, tk: int):
@@ -66,10 +72,14 @@ def _as_kv_mask(mask, b: int, tk: int):
 
 def xla_attention(q, k, v, mask=None, causal: bool = False,
                   dropout_p: float = 0.0, dropout_key=None,
-                  scale: Optional[float] = None):
+                  scale: Optional[float] = None, segment_ids=None):
     """Reference XLA implementation — materializes (B, H, Tq, Tk) scores."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if segment_ids is not None:
+        ids = segment_ids
+        seg = (ids[:, None, :, None] == ids[:, None, None, :])
+        mask = seg if mask is None else (mask.astype(jnp.bool_) & seg)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     neg = jnp.finfo(logits.dtype).min
     keep = None

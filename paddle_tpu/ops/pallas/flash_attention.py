@@ -60,12 +60,12 @@ def _use_interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
-                offset, block_q, block_k, num_k_blocks):
-    if has_mask:
-        kvm_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-    else:
-        kvm_ref = None
-        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+                has_segs, offset, block_q, block_k, num_k_blocks):
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -101,11 +101,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
             # key-padding keep-mask (1, bk) broadcasting over q rows
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(kvm > 0, s, _NEG_INF)
+        if has_segs:
+            # packed sequences: attend only within the same segment.
+            # q-side ids arrive (bq, 1) via the lse-style layout, kv-side
+            # (1, bk) via the full-row slice — broadcast equality gives
+            # the (bq, bk) block mask with no in-kernel transpose
+            qseg = qseg_ref[0]                       # (bq, 1)
+            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]  # (1, bk)
+            s = jnp.where(qseg == kseg, s, _NEG_INF)
         m_prev = m_ref[:, :1]                              # (bq, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                             # (bq, bk)
-        if causal or has_mask:
+        if causal or has_mask or has_segs:
             # a fully-masked row has m_new == _NEG_INF, making the
             # masked exp(s - m_new) = exp(0) = 1 instead of 0
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
@@ -126,6 +134,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
         lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-37))
 
 
+def _qseg_spec(nheads, block_q):
+    # q-side segment ids (B, Tq, 1) int32; (block_q, 1) last-two dims is
+    # the lse layout — legal for any block_q multiple of 8
+    return _vmem_spec((1, block_q, 1),
+                      lambda b, i, j, _h=nheads: (b // _h, i, 0))
+
+
 def _mask_spec(nheads, tk):
     # kv_mask is (B, 1, Tk) float; every head of batch row b reads row
     # b // nheads — the index map folds the (B*h) grid dim back to B.
@@ -137,15 +152,15 @@ def _mask_spec(nheads, tk):
                       lambda b, i, j, _h=nheads: (b // _h, 0, 0))
 
 
-def _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
-              interpret):
+def _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
+              block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     grid = (bh, tq // block_q, tk // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, has_mask=kvm is not None,
-        offset=tk - tq, block_q=block_q, block_k=block_k,
-        num_k_blocks=tk // block_k)
+        has_segs=qseg is not None, offset=tk - tq, block_q=block_q,
+        block_k=block_k, num_k_blocks=tk // block_k)
     # lse carried as (bh, tq, 1): the trailing unit dim keeps the block's
     # last-two-dims (block_q, 1) legal for the Mosaic (8, 128) tiling rule
     out_shape = (
@@ -161,6 +176,10 @@ def _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
     if kvm is not None:
         in_specs.append(_mask_spec(nheads, tk))
         inputs += (kvm,)
+    if qseg is not None:
+        in_specs.append(_qseg_spec(nheads, block_q))
+        in_specs.append(_mask_spec(nheads, tk))  # kv-side: full-row slice
+        inputs += (qseg, kseg)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -186,13 +205,13 @@ def _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-               scale, causal, has_mask, offset, block_q, block_k,
+               scale, causal, has_mask, has_segs, offset, block_q, block_k,
                num_k_blocks):
-    if has_mask:
-        kvm_ref, dq_ref, dq_acc = refs
-    else:
-        kvm_ref = None
-        dq_ref, dq_acc = refs
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
+    dq_ref, dq_acc = refs
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -224,8 +243,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         if has_mask:
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(kvm > 0, s, _NEG_INF)
+        if has_segs:
+            qseg = qseg_ref[0]
+            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        if causal or has_mask:
+        if causal or has_mask or has_segs:
             # fully-masked rows carry lse == _NEG_INF (see fwd _finish)
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         dp = jax.lax.dot_general(
@@ -242,13 +265,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                scale, causal, has_mask, offset, block_q, block_k,
+                scale, causal, has_mask, has_segs, offset, block_q, block_k,
                 num_q_blocks):
-    if has_mask:
-        kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
-    else:
-        kvm_ref = None
-        dk_ref, dv_ref, dk_acc, dv_acc = refs
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
+    dk_ref, dv_ref, dk_acc, dv_acc = refs
     j, i = pl.program_id(1), pl.program_id(2)  # kv block outer, q block inner
 
     @pl.when(i == 0)
@@ -279,8 +302,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         if has_mask:
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(kvm > 0, s, _NEG_INF)
+        if has_segs:
+            qseg = qseg_ref[0]
+            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)                               # (bq, bk) f32
-        if causal or has_mask:
+        if causal or has_mask or has_segs:
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -299,13 +326,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale, block_q,
-              block_k, interpret):
+def _bwd_call(q, k, v, kvm, qseg, kseg, nheads, o, lse, do, causal, scale,
+              block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (bh, tq, 1)
     has_mask = kvm is not None
+    has_segs = qseg is not None
 
     dq_in_specs = [
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -319,11 +347,15 @@ def _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale, block_q,
     if has_mask:
         dq_in_specs.append(_mask_spec(nheads, tk))
         dq_inputs += (kvm,)
+    if has_segs:
+        dq_in_specs.append(_qseg_spec(nheads, block_q))
+        dq_in_specs.append(_mask_spec(nheads, tk))
+        dq_inputs += (qseg, kseg)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, has_mask=has_mask,
-            offset=tk - tq, block_q=block_q, block_k=block_k,
-            num_k_blocks=tk // block_k),
+            has_segs=has_segs, offset=tk - tq, block_q=block_q,
+            block_k=block_k, num_k_blocks=tk // block_k),
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=dq_in_specs,
         out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -346,11 +378,17 @@ def _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale, block_q,
         # mask block ignores both grid indices anyway
         dkv_in_specs.append(_mask_spec(nheads, tk))
         dkv_inputs += (kvm,)
+    if has_segs:
+        # q-side spec must use the SWAPPED grid order: i is program_id(2)
+        dkv_in_specs.append(_vmem_spec(
+            (1, block_q, 1), lambda b, j, i, _h=nheads: (b // _h, i, 0)))
+        dkv_in_specs.append(_mask_spec(nheads, tk))
+        dkv_inputs += (qseg, kseg)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, has_mask=has_mask,
-            offset=tk - tq, block_q=block_q, block_k=block_k,
-            num_q_blocks=tq // block_q),
+            has_segs=has_segs, offset=tk - tq, block_q=block_q,
+            block_k=block_k, num_q_blocks=tq // block_q),
         grid=(bh, tk // block_k, tq // block_q),
         in_specs=dkv_in_specs,
         out_specs=(
@@ -376,27 +414,29 @@ def _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale, block_q,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
-def _flash(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
-           block_q_bwd, block_k_bwd, interpret):
-    o, _ = _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
-                     interpret)
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
+           block_k, block_q_bwd, block_k_bwd, interpret):
+    o, _ = _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale,
+                     block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, kvm, nheads, causal, scale, block_q, block_k,
-               block_q_bwd, block_k_bwd, interpret):
-    o, lse = _fwd_call(q, k, v, kvm, nheads, causal, scale, block_q,
-                       block_k, interpret)
-    return o, (q, k, v, kvm, o, lse)
+def _flash_fwd(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
+               block_k, block_q_bwd, block_k_bwd, interpret):
+    o, lse = _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale,
+                       block_q, block_k, interpret)
+    return o, (q, k, v, kvm, qseg, kseg, o, lse)
 
 
 def _flash_bwd(nheads, causal, scale, block_q, block_k, block_q_bwd,
                block_k_bwd, interpret, res, do):
-    q, k, v, kvm, o, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, kvm, nheads, o, lse, do, causal, scale,
-                           block_q_bwd, block_k_bwd, interpret)
-    return dq, dk, dv, None  # the keep-mask carries no gradient
+    q, k, v, kvm, qseg, kseg, o, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, kvm, qseg, kseg, nheads, o, lse, do,
+                           causal, scale, block_q_bwd, block_k_bwd,
+                           interpret)
+    # neither the keep-mask nor the segment ids carry gradients
+    return dq, dk, dv, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -405,6 +445,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     kv_mask=None,
+                    segment_ids=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     block_q_bwd: Optional[int] = None,
@@ -423,6 +464,11 @@ def flash_attention(q, k, v, causal: bool = False,
     replacement, ops/sequence.py); masked keys contribute nothing and
     fully-masked rows output zeros, matching ops.attention.xla_attention.
     Arbitrary (B, H, Tq, Tk) masks stay on the XLA path.
+
+    ``segment_ids``: optional (batch, t) int ids for PACKED batches
+    (multiple sequences per row, the padding-free pretraining layout):
+    positions attend only within their own segment; composes with
+    ``causal`` and ``kv_mask``. Self-attention only (tq == tk).
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -473,6 +519,18 @@ def flash_attention(q, k, v, causal: bool = False,
         # (B, 1, Tk) float: the unit middle dim gives the mask block a
         # legal (1, block_k) last-two-dims layout (same trick as lse)
         kvm = kv_mask.astype(jnp.float32).reshape(b, 1, tk)
-    of = _flash(qf, kf, vf, kvm, h, causal, float(scale), block_q, block_k,
-                block_q_bwd, block_k_bwd, interpret)
+    qseg = kseg = None
+    if segment_ids is not None:
+        if tq != tk:
+            raise ValueError("segment_ids requires self-attention shapes "
+                             f"(tq={tq} != tk={tk})")
+        if segment_ids.shape != (b, tq):
+            raise ValueError(
+                f"segment_ids must be (batch, t) = ({b},{tq}), got "
+                f"{segment_ids.shape}")
+        ids = segment_ids.astype(jnp.int32)
+        qseg = ids.reshape(b, tq, 1)  # q side: lse-layout blocks
+        kseg = ids.reshape(b, 1, tq)  # kv side: full-row slice blocks
+    of = _flash(qf, kf, vf, kvm, qseg, kseg, h, causal, float(scale),
+                block_q, block_k, block_q_bwd, block_k_bwd, interpret)
     return of.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

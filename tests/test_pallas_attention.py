@@ -165,7 +165,8 @@ def test_dispatch_routes_key_padding_mask_to_flash(monkeypatch):
 
     called = {}
 
-    def fake_flash(q, k, v, causal=False, scale=None, kv_mask=None):
+    def fake_flash(q, k, v, causal=False, scale=None, kv_mask=None,
+                   segment_ids=None):
         called["kv_mask"] = kv_mask
         return q
 
@@ -181,3 +182,69 @@ def test_dispatch_routes_key_padding_mask_to_flash(monkeypatch):
     per_query = jnp.ones((2, 1, 128, 128), bool)
     out = A.scaled_dot_product_attention(q, q, q, mask=per_query)
     assert "kv_mask" not in called  # arbitrary mask stays on XLA
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_matches_xla(causal):
+    """Packed-batch attention (segment ids): positions attend only
+    within their own segment — the padding-free pretraining layout."""
+    b, t = 2, 256
+    q, k, v = _rand_qkv(b=b, t=t, seed=11)
+    # rows packed as [seg0 x 96 | seg1 x 100 | seg2 x 60] and
+    # [seg0 x 256] respectively
+    ids = np.zeros((b, t), np.int32)
+    ids[0, 96:196] = 1
+    ids[0, 196:] = 2
+    ids_j = jnp.asarray(ids)
+
+    out = flash_attention(q, k, v, causal=causal, segment_ids=ids_j,
+                          interpret=True)
+    ref = xla_attention(q, k, v, causal=causal, segment_ids=ids_j)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_grads_match_xla(causal):
+    b, t = 2, 256
+    q, k, v = _rand_qkv(b=b, t=t, seed=13)
+    rng = np.random.default_rng(13)
+    ids = np.zeros((b, t), np.int32)
+    ids[0, 128:] = 1
+    ids[1, 64:160] = 1
+    ids[1, 160:] = 2
+    ids_j = jnp.asarray(ids)
+    ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, causal=causal,
+                                segment_ids=ids_j, block_q=128,
+                                block_k=128, block_q_bwd=64,
+                                block_k_bwd=128, interpret=True) * ct).sum()
+
+    def g(q, k, v):
+        return (xla_attention(q, k, v, causal=causal,
+                              segment_ids=ids_j) * ct).sum()
+
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(gf, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_segment_ids_compose_with_kv_mask():
+    """Packing + padding together: the tail of each row is padding
+    (kv_mask False) AND its own segment."""
+    b, t = 2, 256
+    q, k, v = _rand_qkv(b=b, t=t, seed=17)
+    ids = np.zeros((b, t), np.int32)
+    ids[:, 128:] = 1
+    keep = jnp.asarray(np.arange(t)[None, :] < np.array([224, 192])[:, None])
+    ids_j = jnp.asarray(ids)
+    out = flash_attention(q, k, v, segment_ids=ids_j, kv_mask=keep,
+                          interpret=True)
+    ref = xla_attention(q, k, v, mask=keep[:, None, None, :],
+                        segment_ids=ids_j)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

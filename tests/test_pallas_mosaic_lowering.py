@@ -120,3 +120,23 @@ def test_flash_t64_lowers_to_mosaic():
     fwd = jax.jit(lambda q, k, v: flash_attention(
         q, k, v, block_q=64, block_k=64, interpret=False))
     _export_tpu(fwd, q, q, q)
+
+
+@pytest.mark.parametrize("blocks", [(128, 128), (64, 64)])
+def test_flash_segment_ids_lower_to_mosaic(blocks):
+    """Packed-batch segment ids add a (B,T,1) lse-layout q-side input and
+    a (B,1,T) full-row kv-side input — both must Mosaic-lower at every
+    gate-admissible block size."""
+    bq, bk = blocks
+    b, t, h, d = 4, 512, 8, 64
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    ids = jnp.zeros((b, t), jnp.int32)
+    fwd = jax.jit(lambda q, k, v, s: flash_attention(
+        q, k, v, segment_ids=s, block_q=bq, block_k=bk, interpret=False))
+    _export_tpu(fwd, q, q, q, ids)
+
+    bwd = jax.jit(jax.grad(
+        lambda q, k, v, s: flash_attention(
+            q, k, v, segment_ids=s, block_q=bq, block_k=bk,
+            interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    _export_tpu(bwd, q, q, q, ids)
