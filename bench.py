@@ -139,8 +139,7 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
         # explicitly instead.
         import inspect as _inspect
 
-        infer_method = ("forward" if method == "forward_fused_loss"
-                        else method)
+        infer_method = ("forward" if method.endswith("_loss") else method)
         if infer_batch is None:
             fwd_params = list(_inspect.signature(
                 getattr(type(model), infer_method)).parameters.values())[1:]
@@ -432,6 +431,44 @@ def bench_bert_long(steps: int, batch_size: int, amp=None,
                         infer_batch=lambda bs: make_batch(bs)[:1])
 
 
+def bench_bert_packed(steps: int, batch_size: int, amp=None,
+                      seq_len: int = 128):
+    """BERT MLM over PACKED batches (data.bucketing.pack_sequences):
+    variable-length documents share fixed (B, T) rows with segment-ids
+    attention (the Pallas packed-batch kernel path) and per-segment
+    positions — zero padding waste vs the padded bert_base config. Same
+    row shape as bert_base, so examples/sec is directly comparable; the
+    packed rows carry ~1.9x the real tokens a padded ragged batch of the
+    same documents would."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.data.bucketing import pack_sequences
+    from paddle_tpu.models import bert as B
+
+    pt.seed(0)
+    batch_size = _cap(batch_size, 32)
+    cfg = B.BertConfig.base()
+    model = B.BertForPretraining(cfg)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        # documents: lengths 16..seq_len, enough to fill bs rows
+        def docs():
+            while True:
+                n = int(rng.integers(16, seq_len + 1))
+                yield rng.integers(3, cfg.vocab_size, n)
+
+        gen = pack_sequences(docs, capacity=seq_len, batch_size=bs)
+        batch = next(iter(gen()))
+        tokens = jnp.asarray(batch["tokens"])
+        return (tokens, jnp.asarray(batch["positions"]),
+                jnp.asarray(batch["segment_ids"]), tokens)
+
+    return _train_bench(model, lambda out, batch: out, make_batch, steps,
+                        batch_size, amp=amp, method="forward_packed_loss")
+
+
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
                         vocab: int = 100_000):
     """DeepFM with ROW-SPARSE embedding updates (the SelectedRows
@@ -687,6 +724,7 @@ MODELS = {
     "se_resnext50": bench_se_resnext50,
     "resnet50": bench_resnet50,
     "bert_base": bench_bert_base,
+    "bert_packed": bench_bert_packed,
     "bert_long": bench_bert_long,
     "transformer_nmt": bench_transformer_nmt,
     "deepfm": bench_deepfm,
@@ -802,6 +840,14 @@ def main():
         # identical to deepfm's — bench that instead of duplicating it
         _emit_error(metric, "--infer: use --model deepfm (the sparse "
                     "variant differs only in the optimizer update)")
+        return
+    if args.infer and args.model == "bert_packed":
+        # packing is a training-batch layout; the pretraining head's
+        # plain forward takes no segment_ids, so an infer run would
+        # silently measure the UNPACKED attention path under a packed
+        # label
+        _emit_error(metric, "--infer: use --model bert_base (packing is "
+                    "a training-batch layout)")
         return
 
     # device-init watchdog: if the accelerator tunnel is wedged (device

@@ -156,11 +156,15 @@ def pack_sequences(reader: Callable[[], Iterator], capacity: int,
       segment_ids  1-based segment id per token, 0 = padding tail
       positions    position WITHIN each segment (for position embeddings)
     A row closes when the next sequence does not fit; a batch closes when
-    ``batch_size`` rows are full. ``min_fill`` (0..1) drops a final
-    partial batch whose used-token fraction is below it (0 keeps all).
+    ``batch_size`` rows are full. ``min_fill`` (0..1) applies to the
+    FINAL flushed batch only: it is dropped when its used-token fraction
+    falls below the floor (0 keeps everything). Mid-stream batches are
+    always kept — their density is governed by packing, not stream end.
     """
     enforce(capacity >= 1 and batch_size >= 1,
             "capacity and batch_size must be >= 1")
+    enforce(0.0 <= min_fill <= 1.0,
+            "min_fill must be in [0, 1], got %s", min_fill)
 
     def gen():
         rows: List[List[np.ndarray]] = []
@@ -209,10 +213,10 @@ def pack_sequences(reader: Callable[[], Iterator], capacity: int,
             cur.append(s)
             used += len(s)
             if len(rows) == batch_size:
-                out = emit(rows)
+                # mid-stream batches always yield (emit only returns
+                # None on the min_fill-checked final flush)
+                yield emit(rows)
                 rows.clear()
-                if out is not None:
-                    yield out
         close_row()
         if rows:
             out = emit(rows, final=True)

@@ -55,10 +55,11 @@ class BertEmbeddings(nn.Layer):
         self.norm = nn.LayerNorm(cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
 
-    def forward(self, input_ids, token_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
         t = input_ids.shape[1]
-        pos_ids = jnp.arange(t)[None, :]
-        x = self.tok(input_ids) + self.pos(pos_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(t)[None, :]
+        x = self.tok(input_ids) + self.pos(position_ids)
         if token_type_ids is not None:
             x = x + self.seg(token_type_ids)
         return self.drop(self.norm(x))
@@ -77,13 +78,17 @@ class BertModel(nn.Layer):
             scan_layers=cfg.scan_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        x = self.embeddings(input_ids, token_type_ids)
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None, segment_ids=None):
+        """``segment_ids``/``position_ids``: the PACKED-batch form
+        (data.bucketing.pack_sequences) — attention confined to each
+        packed segment, positions restarting per segment."""
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
         mask = None
         if attention_mask is not None:
             # (B, T) keep-mask → broadcastable (B, 1, 1, T)
             mask = attention_mask[:, None, None, :]
-        h = self.encoder(x, mask=mask)
+        h = self.encoder(x, mask=mask, segment_ids=segment_ids)
         pooled = self.pooler(h[:, 0])
         return h, pooled
 
@@ -133,6 +138,30 @@ class BertForPretraining(nn.Layer):
         nsp_loss = jnp.mean(L.softmax_with_cross_entropy(nsp_logits,
                                                          nsp_label))
         return mlm_loss + nsp_loss
+
+    def forward_packed_loss(self, tokens, positions, segment_ids,
+                            mlm_labels, vocab_chunk: int = 4096):
+        """MLM loss over a PACKED batch (data.bucketing.pack_sequences
+        layout: multiple sequences per row, segment id 0 = padding tail).
+        Attention is confined to each segment via the Pallas packed-batch
+        path, positions restart per segment, and padding tokens are
+        excluded from the loss (ignore_index). NSP is skipped — a packed
+        row holds many unrelated documents, so next-sentence pairing has
+        no meaning there."""
+        from ..core.dtypes import get_policy
+        from ..ops.fused_loss import mean_linear_cross_entropy
+
+        h, _ = self.bert(tokens, position_ids=positions,
+                         segment_ids=segment_ids)
+        h_mlm = self.mlm_norm(self.mlm_transform(h))
+        b, t, d = h_mlm.shape
+        labels = jnp.where(segment_ids > 0, mlm_labels, -100)
+        pol = get_policy()
+        return mean_linear_cross_entropy(
+            pol.cast_to_compute(h_mlm.reshape(b * t, d)),
+            pol.cast_to_compute(self.mlm_decoder.weight),
+            pol.cast_to_compute(self.mlm_decoder.bias),
+            labels.reshape(-1), chunk=vocab_chunk, ignore_index=-100)
 
 
 def pretrain_loss(outputs, labels):

@@ -431,7 +431,7 @@ class MultiHeadAttention(Layer):
         self.out_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
 
     def forward(self, query, key=None, value=None, attn_mask=None,
-                causal: bool = False):
+                causal: bool = False, segment_ids=None):
         key = query if key is None else key
         value = key if value is None else value
         b, tq, d = query.shape
@@ -466,6 +466,10 @@ class MultiHeadAttention(Layer):
                         "cross-attention", tq, tk)
             from ..parallel.context_parallel import context_parallel_attention
 
+            enforce(segment_ids is None,
+                    "seq_parallel=%s does not support packed segment_ids "
+                    "yet; pack within shards or run without SP",
+                    self.seq_parallel)
             kw = ({"use_flash": self.use_flash}
                   if self.seq_parallel == "ulysses" else {})
             out = context_parallel_attention(
@@ -478,7 +482,7 @@ class MultiHeadAttention(Layer):
                 q, k, v, mask=attn_mask, causal=causal,
                 dropout_p=self.dropout_p if self.training else 0.0,
                 dropout_key=self.rng("attn_dropout") if (self.training and self.dropout_p > 0) else None,
-                use_flash=self.use_flash)
+                use_flash=self.use_flash, segment_ids=segment_ids)
         out = out.reshape(b, tq, d)
         return self.out_proj(out)
 

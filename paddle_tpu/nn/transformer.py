@@ -56,12 +56,14 @@ class TransformerEncoderLayer(Layer):
         self.drop1 = Dropout(dropout)
         self.drop2 = Dropout(dropout)
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, segment_ids=None):
         if self.normalize_before:
-            x = x + self.drop1(self.self_attn(self.norm1(x), attn_mask=mask))
+            x = x + self.drop1(self.self_attn(self.norm1(x), attn_mask=mask,
+                                              segment_ids=segment_ids))
             x = x + self.drop2(self.ffn(self.norm2(x)))
         else:
-            x = self.norm1(x + self.drop1(self.self_attn(x, attn_mask=mask)))
+            x = self.norm1(x + self.drop1(self.self_attn(
+                x, attn_mask=mask, segment_ids=segment_ids)))
             x = self.norm2(x + self.drop2(self.ffn(x)))
         return x
 
@@ -138,7 +140,7 @@ class TransformerEncoder(Layer):
         self._dropout_p = dropout
         self.scan_layers = scan_layers
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, segment_ids=None):
         import jax
         from jax import lax
 
@@ -154,7 +156,8 @@ class TransformerEncoder(Layer):
 
             def body(h, pl):
                 out, _ = template.functional_call(
-                    pl, h, mask=mask, training=self.training)
+                    pl, h, mask=mask, segment_ids=segment_ids,
+                    training=self.training)
                 return out, None
 
             if self.remat:
@@ -166,9 +169,10 @@ class TransformerEncoder(Layer):
             for layer in self.layers:
                 if self.remat:
                     x = jax.checkpoint(
-                        lambda h, _l=layer: _l(h, mask=mask))(x)
+                        lambda h, _l=layer: _l(h, mask=mask,
+                                               segment_ids=segment_ids))(x)
                 else:
-                    x = layer(x, mask=mask)
+                    x = layer(x, mask=mask, segment_ids=segment_ids)
         if self.final_norm is not None:
             x = self.final_norm(x)
         return x
