@@ -466,15 +466,11 @@ class MultiHeadAttention(Layer):
                         "cross-attention", tq, tk)
             from ..parallel.context_parallel import context_parallel_attention
 
-            enforce(segment_ids is None,
-                    "seq_parallel=%s does not support packed segment_ids "
-                    "yet; pack within shards or run without SP",
-                    self.seq_parallel)
             kw = ({"use_flash": self.use_flash}
                   if self.seq_parallel == "ulysses" else {})
             out = context_parallel_attention(
                 q, k, v, impl=self.seq_parallel, causal=causal,
-                kv_mask=kv_mask, **kw)
+                kv_mask=kv_mask, segment_ids=segment_ids, **kw)
         else:
             from ..ops.attention import scaled_dot_product_attention
 

@@ -37,17 +37,46 @@ from ..core.mesh import get_mesh
 _NEG_INF = -1e30  # finite: avoids inf-inf NaNs under autodiff
 
 
+def _shard_with_optional(inner, mesh, spec, mspec, q, k, v, kv_mask,
+                         segment_ids):
+    """shard_map an ``inner(q, k, v, km, seg)`` with OPTIONAL (B, T)
+    inputs: shard_map specs are positional, so each supplied optional
+    appends an arg+spec pair and the wrapper re-slots them (None for the
+    absent ones) — one place for the plumbing both ring and Ulysses use."""
+    args, in_specs = [q, k, v], [spec, spec, spec]
+    km_i = seg_i = None
+    if kv_mask is not None:
+        km_i = len(args)
+        args.append(kv_mask)
+        in_specs.append(mspec)
+    if segment_ids is not None:
+        seg_i = len(args)
+        args.append(segment_ids)
+        in_specs.append(mspec)
+
+    def wrapper(*xs):
+        return inner(xs[0], xs[1], xs[2],
+                     xs[km_i] if km_i is not None else None,
+                     xs[seg_i] if seg_i is not None else None)
+
+    fn = jax.shard_map(wrapper, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=spec, check_vma=False)
+    return fn(*args)
+
+
 # ---------------------------------------------------------------------------
 # ring attention
 # ---------------------------------------------------------------------------
 
 
-def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, src, my_idx, *, t_local,
-                       causal, scale):
+def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, qseg, ksegc, src,
+                       my_idx, *, t_local, causal, scale):
     """One ring step's flash-style accumulation (no collectives; wrapped in
     jax.checkpoint by the caller so backward recomputes the (t×t) scores).
     ``kmc``: the K/V block's key-padding keep-mask (b, t_local) rotating
-    around the ring with it, or None."""
+    around the ring with it, or None. ``qseg``/``ksegc``: packed-batch
+    segment ids — q side fixed to this shard, kv side rotating with its
+    block; attention stays within a segment."""
     # q/k stay in their native dtype (bf16 in production): bf16 inputs
     # with an f32 preferred_element_type run at the full MXU rate, while
     # a pre-cast to f32 would drop to the fp32 matmul rate (4-8x slower
@@ -62,10 +91,13 @@ def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, src, my_idx, *, t_local,
         s = jnp.where(rows >= cols, s, _NEG_INF)
     if kmc is not None:
         s = jnp.where(kmc[:, None, None, :], s, _NEG_INF)
+    if qseg is not None:
+        s = jnp.where(qseg[:, None, :, None] == ksegc[:, None, None, :],
+                      s, _NEG_INF)
     m_cur = jnp.max(s, axis=-1, keepdims=True)          # (b,h,t,1)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
-    if kmc is not None:
+    if kmc is not None or qseg is not None:
         # a fully-masked row keeps m_new == _NEG_INF, turning the masked
         # exp(s - m_new) into exp(0) = 1; zero those entries so l stays 0
         # and the final o is 0 (causal alone can't fully mask a row —
@@ -86,9 +118,10 @@ def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, src, my_idx, *, t_local,
     return acc_new, m_new, l_new
 
 
-def _ring_inner(q, k, v, km, *, axis, causal, scale, n):
+def _ring_inner(q, k, v, km, seg, *, axis, causal, scale, n):
     b, t, h, d = q.shape  # local (sequence-sharded) shapes
     has_mask = km is not None
+    has_segs = seg is not None
     my_idx = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     qf = q  # native dtype into the MXU (see _ring_step_compute note)
@@ -96,29 +129,36 @@ def _ring_inner(q, k, v, km, *, axis, causal, scale, n):
         _ring_step_compute, t_local=t, causal=causal, scale=scale))
 
     def step(carry, t_step):
-        acc, m, l, kc, vc, kmc = carry
+        acc, m, l, kc, vc, kmc, ksegc = carry
         src = (my_idx - t_step) % n  # origin rank of the K/V block we hold
         acc, m, l = compute(qf, acc, m, l, kc, vc,
-                            kmc if has_mask else None, src, my_idx)
+                            kmc if has_mask else None,
+                            seg if has_segs else None,
+                            ksegc if has_segs else None, src, my_idx)
         kc = lax.ppermute(kc, axis, perm)
         vc = lax.ppermute(vc, axis, perm)
         if has_mask:  # the keep-mask block travels with its K/V block
             kmc = lax.ppermute(kmc, axis, perm)
-        return (acc, m, l, kc, vc, kmc), None
+        if has_segs:  # so do the kv-side segment ids
+            ksegc = lax.ppermute(ksegc, axis, perm)
+        return (acc, m, l, kc, vc, kmc, ksegc), None
 
     acc0 = jnp.zeros((b, t, h, d), jnp.float32)
     m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t, 1), jnp.float32)
-    # a zeros placeholder keeps the scan carry structure static when no
-    # mask is supplied (it is never read: has_mask is a trace-time const)
+    # zeros placeholders keep the scan carry structure static when no
+    # mask/ids are supplied (never read: has_* are trace-time consts)
     km0 = km if has_mask else jnp.zeros((b, t), jnp.bool_)
+    seg0 = seg if has_segs else jnp.zeros((b, t), jnp.int32)
     # scan the first n-1 steps (compute + rotate); the last block's compute is
     # peeled out so the final rotation — whose result would be discarded —
     # never hits the ICI ring
-    (acc, m, l, kc, vc, kmc), _ = lax.scan(
-        step, (acc0, m0, l0, k, v, km0), jnp.arange(n - 1))
+    (acc, m, l, kc, vc, kmc, ksegc), _ = lax.scan(
+        step, (acc0, m0, l0, k, v, km0, seg0), jnp.arange(n - 1))
     acc, _, l = compute(qf, acc, m, l, kc, vc,
                         kmc if has_mask else None,
+                        seg if has_segs else None,
+                        ksegc if has_segs else None,
                         (my_idx - (n - 1)) % n, my_idx)
     o = acc / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-37)
     return o.astype(q.dtype)
@@ -127,13 +167,16 @@ def _ring_inner(q, k, v, km, *, axis, causal, scale, n):
 def ring_attention(q, k, v, *, causal: bool = False,
                    scale: Optional[float] = None, axis: str = "sp",
                    batch_axis: Optional[str] = "dp", mesh=None,
-                   kv_mask=None):
+                   kv_mask=None, segment_ids=None):
     """Sequence-parallel attention over global (B, T, H, D) arrays.
 
     ``q``/``k``/``v`` are sharded ``P(batch_axis, axis)`` over the mesh; T must
     divide by the ``axis`` size. Causal masking is in *global* positions.
     ``kv_mask``: optional global (B, T) keep-mask (the ragged-batch
     key-padding form); its blocks rotate around the ring with their K/V.
+    ``segment_ids``: optional global (B, T) packed-batch ids (ids global
+    per row, so a segment spanning a shard boundary keeps one id); the
+    kv-side ids rotate with their block.
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
@@ -141,25 +184,19 @@ def ring_attention(q, k, v, *, causal: bool = False,
     enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
     enforce(k.shape == q.shape and v.shape == q.shape,
             "ring attention is self-attention shaped: q/k/v must match")
-    if kv_mask is not None:
-        enforce(kv_mask.shape == (b, t),
-                "kv_mask must be (batch, seq) = (%s, %s), got %s",
-                b, t, kv_mask.shape)
+    for name, arr in (("kv_mask", kv_mask), ("segment_ids", segment_ids)):
+        if arr is not None:
+            enforce(arr.shape == (b, t),
+                    "%s must be (batch, seq) = (%s, %s), got %s",
+                    name, b, t, arr.shape)
     if scale is None:
         scale = d ** -0.5
     spec = P(batch_axis, axis, None, None)
     mspec = P(batch_axis, axis)
     inner = functools.partial(_ring_inner, axis=axis, causal=causal,
                               scale=float(scale), n=n)
-    if kv_mask is None:
-        fn = jax.shard_map(lambda q, k, v: inner(q, k, v, None), mesh=mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec,
-                           check_vma=False)
-        return fn(q, k, v)
-    fn = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(spec, spec, spec, mspec), out_specs=spec,
-                       check_vma=False)
-    return fn(q, k, v, kv_mask)
+    return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
+                                kv_mask, segment_ids)
 
 
 # ---------------------------------------------------------------------------
@@ -167,7 +204,7 @@ def ring_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 
-def _ulysses_inner(q, k, v, km, *, axis, causal, scale, use_flash):
+def _ulysses_inner(q, k, v, km, seg, *, axis, causal, scale, use_flash):
     from ..ops.attention import scaled_dot_product_attention
 
     # (b, t/sp, h, d) --a2a--> (b, t, h/sp, d): full sequence, head subset
@@ -181,8 +218,12 @@ def _ulysses_inner(q, k, v, km, *, axis, causal, scale, use_flash):
         # along sp (tiny: bools, no head/dim axes)
         full = lax.all_gather(km, axis, axis=1, tiled=True)  # (b, t)
         mask = full[:, None, None, :]
+    seg_full = None
+    if seg is not None:  # same gather for packed-batch segment ids
+        seg_full = lax.all_gather(seg, axis, axis=1, tiled=True)
     o = scaled_dot_product_attention(q, k, v, mask=mask, causal=causal,
-                                     scale=scale, use_flash=use_flash)
+                                     scale=scale, use_flash=use_flash,
+                                     segment_ids=seg_full)
     # back to sequence sharding
     return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 
@@ -190,12 +231,14 @@ def _ulysses_inner(q, k, v, km, *, axis, causal, scale, use_flash):
 def ulysses_attention(q, k, v, *, causal: bool = False,
                       scale: Optional[float] = None, axis: str = "sp",
                       batch_axis: Optional[str] = "dp", mesh=None,
-                      use_flash: bool = True, kv_mask=None):
+                      use_flash: bool = True, kv_mask=None,
+                      segment_ids=None):
     """DeepSpeed-Ulysses-style SP: a2a seq→head shard, local full attention
     (Pallas flash on TPU), a2a back. Requires heads % sp == 0.
     ``kv_mask``: optional global (B, T) keep-mask; all-gathered over sp
     for the full-sequence local attention (key-padding routes to the
-    flash kernel's kv_mask path on TPU)."""
+    flash kernel's kv_mask path on TPU). ``segment_ids``: optional global
+    (B, T) packed-batch ids, same gather (self-attention only)."""
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
     b, t, h, d = q.shape
@@ -208,20 +251,21 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
         enforce(kv_mask.shape == (b, tk),
                 "kv_mask must be (batch, key_seq) = (%s, %s), got %s",
                 b, tk, kv_mask.shape)
+    if segment_ids is not None:
+        enforce(q.shape[1] == k.shape[1],
+                "segment_ids requires self-attention shapes "
+                "(tq=%s != tk=%s)", q.shape[1], k.shape[1])
+        enforce(segment_ids.shape == (b, t),
+                "segment_ids must be (batch, seq) = (%s, %s), got %s",
+                b, t, segment_ids.shape)
     if scale is None:
         scale = d ** -0.5
     spec = P(batch_axis, axis, None, None)
+    mspec = P(batch_axis, axis)
     inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
                               scale=float(scale), use_flash=use_flash)
-    if kv_mask is None:
-        fn = jax.shard_map(lambda q, k, v: inner(q, k, v, None), mesh=mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec,
-                           check_vma=False)
-        return fn(q, k, v)
-    fn = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(spec, spec, spec, P(batch_axis, axis)),
-                       out_specs=spec, check_vma=False)
-    return fn(q, k, v, kv_mask)
+    return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
+                                kv_mask, segment_ids)
 
 
 def context_parallel_attention(q, k, v, *, impl: str = "ring", **kw):

@@ -81,7 +81,7 @@ item bench_bert_remat  900 python bench.py --model bert_base --remat
 item bench_bert_scan   900 python bench.py --model bert_base --scan-layers
 item bench_bert_b64    900 python bench.py --model bert_base --batch-size 64
 # packed-batch pretraining (segment-ids attention; same row shape as
-# bert_base — examples/sec directly comparable, ~1.9x real tokens/row)
+# bert_base — examples/sec directly comparable, ~1.6-1.8x real tokens/row)
 item bench_bert_packed 1200 python bench.py --model bert_packed
 # spc8 keeps the raised ceiling: the k=8 scanned module compiles slowly
 # (documented in the r3 chip-session plan) and the compile cache may be
