@@ -36,19 +36,23 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
     enforce(segment_ids is None or q.shape[1] == k.shape[1],
             "segment_ids requires self-attention shapes (tq=%s != tk=%s)",
             q.shape[1], k.shape[1])
-    if use_flash and dropout_p == 0.0:
+    if use_flash and (dropout_p == 0.0 or dropout_key is not None):
         # key-padding masks (the broadcast (B, 1, 1, Tk) form every
         # ragged-batch model emits) ride the flash kernel; anything else
         # falls back to XLA — including 2D masks, whose historical
         # broadcast semantics are per-QUERY (Tq, Tk), right-aligned
         # against the (B, H, Tq, Tk) logits; promoting a (B, Tk)-shaped
-        # one to key-padding would silently change meaning when B == Tq
+        # one to key-padding would silently change meaning when B == Tq.
+        # Attention-probability dropout runs INSIDE the kernel (in-kernel
+        # counter-based mask) — the training configs with dropout keep
+        # the no-HBM-scores property instead of falling back.
         kv_mask = _as_kv_mask(mask, q.shape[0], k.shape[1])
         if mask is None or kv_mask is not None:
             flash = _get_flash()
             if flash is not None and _flash_ok(q, k, causal):
                 return flash(q, k, v, causal=causal, scale=scale,
-                             kv_mask=kv_mask, segment_ids=segment_ids)
+                             kv_mask=kv_mask, segment_ids=segment_ids,
+                             dropout_p=dropout_p, dropout_key=dropout_key)
     return xla_attention(q, k, v, mask=mask, causal=causal,
                          dropout_p=dropout_p, dropout_key=dropout_key,
                          scale=scale, segment_ids=segment_ids)

@@ -42,10 +42,46 @@ def _vmem_spec(shape, index_map):
     return pl.BlockSpec(shape, index_map)
 
 
+def _smem_scalar_spec():
+    # (1, 1) scalar input (the dropout seed) living in SMEM on TPU
+    imap = lambda *_: (0, 0)
+    if pltpu is not None:
+        return pl.BlockSpec((1, 1), imap, memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1, 1), imap)
+
+
 def _scratch(shape, dtype):
     if pltpu is not None:
         return pltpu.VMEM(shape, dtype)
     return pl.MemoryRef(shape, dtype) if hasattr(pl, "MemoryRef") else None
+
+
+def _dropout_keep(seed, bh, row0, col0, bq, bk, dropout_p):
+    """Deterministic keep-mask for attention-probability dropout, from a
+    counter-based integer hash of (seed, batch*head, global row, global
+    col) — the same mask is rebuilt bit-identically by the backward
+    kernels (no RNG state crosses the fwd/bwd boundary) and the ops are
+    plain int32 iota/arithmetic, legal in Mosaic AND interpret mode.
+    int32 overflow wraps (two's complement) under XLA, which is exactly
+    what a mix function wants."""
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    # rows pass through a NONLINEAR mix before cols join: a single
+    # linear combination rows*A + cols*B would make every position pair
+    # offset by a fixed lattice vector (A*dr + B*dc == 0 mod 2^32) hash
+    # identically for all seeds — correlated dropout along diagonals
+    x = rows * jnp.int32(-1640531527) + seed    # 0x9E3779B9
+    x = x ^ (x >> 16)
+    x = x * jnp.int32(-2048144777)              # 0x85EBCA77 as int32
+    x = x ^ (x >> 13)
+    x = x + cols * jnp.int32(-1028477379) + bh * jnp.int32(-2048144789)
+    x = x ^ (x >> 16)
+    x = x * jnp.int32(-1119713537)
+    x = x ^ (x >> 15)
+    x = x * jnp.int32(-1640531527)
+    x = x ^ (x >> 16)
+    u = (x & jnp.int32(0x7FFFFFFF)).astype(jnp.float32) * (1.0 / 2147483648.0)
+    return u >= dropout_p
 
 
 def _use_interpret() -> bool:
@@ -60,13 +96,17 @@ def _use_interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
-                has_segs, offset, block_q, block_k, num_k_blocks):
+                has_segs, dropout_p, offset, block_q, block_k,
+                num_k_blocks):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
     kseg_ref = refs.pop(0) if has_segs else None
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-    i, j = pl.program_id(1), pl.program_id(2)
+    # program_id is read OUTSIDE pl.when bodies (interpret-mode lowering
+    # cannot resolve it inside the conditional)
+    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
     def _init():
@@ -118,7 +158,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
             # masked exp(s - m_new) = exp(0) = 1 instead of 0
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)                    # (bq, 1)
+        # l accumulates the UNdropped p: dropout applies to the softmax
+        # probabilities, not to their normalizer
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh,
+                                 i * block_q + offset, j * block_k,
+                                 block_q, block_k, dropout_p)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -152,15 +199,15 @@ def _mask_spec(nheads, tk):
                       lambda b, i, j, _h=nheads: (b // _h, 0, 0))
 
 
-def _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
-              block_k, interpret):
+def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal, scale,
+              dropout_p, block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     grid = (bh, tq // block_q, tk // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, has_mask=kvm is not None,
-        has_segs=qseg is not None, offset=tk - tq, block_q=block_q,
-        block_k=block_k, num_k_blocks=tk // block_k)
+        has_segs=qseg is not None, dropout_p=dropout_p, offset=tk - tq,
+        block_q=block_q, block_k=block_k, num_k_blocks=tk // block_k)
     # lse carried as (bh, tq, 1): the trailing unit dim keeps the block's
     # last-two-dims (block_q, 1) legal for the Mosaic (8, 128) tiling rule
     out_shape = (
@@ -180,6 +227,9 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
         in_specs.append(_qseg_spec(nheads, block_q))
         in_specs.append(_mask_spec(nheads, tk))  # kv-side: full-row slice
         inputs += (qseg, kseg)
+    if dropout_p > 0.0:
+        in_specs.append(_smem_scalar_spec())
+        inputs += (seed,)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -205,14 +255,15 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-               scale, causal, has_mask, has_segs, offset, block_q, block_k,
-               num_k_blocks):
+               scale, causal, has_mask, has_segs, dropout_p, offset,
+               block_q, block_k, num_k_blocks):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
     kseg_ref = refs.pop(0) if has_segs else None
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     dq_ref, dq_acc = refs
-    i, j = pl.program_id(1), pl.program_id(2)
+    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
     def _init():
@@ -254,6 +305,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            # same counter-based mask as fwd: out = (m ⊙ y / keep) @ v,
+            # so dL/dy = (do @ v^T) ⊙ m / keep and ds = y ⊙ (dL/dy − δ)
+            keep = _dropout_keep(seed_ref[0, 0], bh,
+                                 i * block_q + offset, j * block_k,
+                                 block_q, block_k, dropout_p)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -265,13 +323,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                scale, causal, has_mask, has_segs, offset, block_q, block_k,
-                num_q_blocks):
+                scale, causal, has_mask, has_segs, dropout_p, offset,
+                block_q, block_k, num_q_blocks):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
     kseg_ref = refs.pop(0) if has_segs else None
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     dk_ref, dv_ref, dk_acc, dv_acc = refs
+    bh = pl.program_id(0)
     j, i = pl.program_id(1), pl.program_id(2)  # kv block outer, q block inner
 
     @pl.when(i == 0)
@@ -309,12 +369,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         p = jnp.exp(s - lse)                               # (bq, bk) f32
         if causal or has_mask or has_segs:
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+        p_v = p  # dv uses the DROPPED probabilities (out = p_drop @ v)
+        if dropout_p > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh,
+                                 i * block_q + offset, j * block_k,
+                                 block_q, block_k, dropout_p)
+            p_v = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
         dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # (bk, d)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # (bq, bk)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -326,8 +394,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, kvm, qseg, kseg, nheads, o, lse, do, causal, scale,
-              block_q, block_k, interpret):
+def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
+              scale, dropout_p, block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -351,11 +419,15 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, nheads, o, lse, do, causal, scale,
         dq_in_specs.append(_qseg_spec(nheads, block_q))
         dq_in_specs.append(_mask_spec(nheads, tk))
         dq_inputs += (qseg, kseg)
+    if dropout_p > 0.0:
+        dq_in_specs.append(_smem_scalar_spec())
+        dq_inputs += (seed,)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, has_mask=has_mask,
-            has_segs=has_segs, offset=tk - tq, block_q=block_q,
-            block_k=block_k, num_k_blocks=tk // block_k),
+            has_segs=has_segs, dropout_p=dropout_p, offset=tk - tq,
+            block_q=block_q, block_k=block_k,
+            num_k_blocks=tk // block_k),
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=dq_in_specs,
         out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -384,11 +456,15 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, nheads, o, lse, do, causal, scale,
             (1, block_q, 1), lambda b, j, i, _h=nheads: (b // _h, i, 0)))
         dkv_in_specs.append(_mask_spec(nheads, tk))
         dkv_inputs += (qseg, kseg)
+    if dropout_p > 0.0:
+        dkv_in_specs.append(_smem_scalar_spec())
+        dkv_inputs += (seed,)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, has_mask=has_mask,
-            has_segs=has_segs, offset=tk - tq, block_q=block_q,
-            block_k=block_k, num_q_blocks=tq // block_q),
+            has_segs=has_segs, dropout_p=dropout_p, offset=tk - tq,
+            block_q=block_q, block_k=block_k,
+            num_q_blocks=tq // block_q),
         grid=(bh, tk // block_k, tq // block_q),
         in_specs=dkv_in_specs,
         out_specs=(
@@ -414,29 +490,31 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, nheads, o, lse, do, causal, scale,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
-def _flash(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
-           block_k, block_q_bwd, block_k_bwd, interpret):
-    o, _ = _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale,
-                     block_q, block_k, interpret)
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15))
+def _flash(q, k, v, kvm, qseg, kseg, seed, nheads, causal, scale,
+           dropout_p, block_q, block_k, block_q_bwd, block_k_bwd,
+           interpret):
+    o, _ = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal,
+                     scale, dropout_p, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, kvm, qseg, kseg, nheads, causal, scale, block_q,
-               block_k, block_q_bwd, block_k_bwd, interpret):
-    o, lse = _fwd_call(q, k, v, kvm, qseg, kseg, nheads, causal, scale,
-                       block_q, block_k, interpret)
-    return o, (q, k, v, kvm, qseg, kseg, o, lse)
+def _flash_fwd(q, k, v, kvm, qseg, kseg, seed, nheads, causal, scale,
+               dropout_p, block_q, block_k, block_q_bwd, block_k_bwd,
+               interpret):
+    o, lse = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal,
+                       scale, dropout_p, block_q, block_k, interpret)
+    return o, (q, k, v, kvm, qseg, kseg, seed, o, lse)
 
 
-def _flash_bwd(nheads, causal, scale, block_q, block_k, block_q_bwd,
-               block_k_bwd, interpret, res, do):
-    q, k, v, kvm, qseg, kseg, o, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, kvm, qseg, kseg, nheads, o, lse, do,
-                           causal, scale, block_q_bwd, block_k_bwd,
-                           interpret)
-    # neither the keep-mask nor the segment ids carry gradients
-    return dq, dk, dv, None, None, None
+def _flash_bwd(nheads, causal, scale, dropout_p, block_q, block_k,
+               block_q_bwd, block_k_bwd, interpret, res, do):
+    q, k, v, kvm, qseg, kseg, seed, o, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse,
+                           do, causal, scale, dropout_p, block_q_bwd,
+                           block_k_bwd, interpret)
+    # the keep-mask, segment ids and dropout seed carry no gradients
+    return dq, dk, dv, None, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -446,6 +524,8 @@ def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     kv_mask=None,
                     segment_ids=None,
+                    dropout_p: float = 0.0,
+                    dropout_key=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     block_q_bwd: Optional[int] = None,
@@ -469,6 +549,13 @@ def flash_attention(q, k, v, causal: bool = False,
     (multiple sequences per row, the padding-free pretraining layout):
     positions attend only within their own segment; composes with
     ``causal`` and ``kv_mask``. Self-attention only (tq == tk).
+
+    ``dropout_p``/``dropout_key``: attention-probability dropout INSIDE
+    the kernel — scores still never materialize in HBM (the whole point
+    at long seq; the XLA fallback with dropout pays the (B,H,T,T)
+    tensor). The keep-mask comes from a counter-based hash of the seed
+    and global coordinates, so the backward rebuilds it bit-identically
+    with no stored mask.
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -519,6 +606,13 @@ def flash_attention(q, k, v, causal: bool = False,
         # (B, 1, Tk) float: the unit middle dim gives the mask block a
         # legal (1, block_k) last-two-dims layout (same trick as lse)
         kvm = kv_mask.astype(jnp.float32).reshape(b, 1, tk)
+    seed = None
+    if dropout_p > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_p > 0 requires dropout_key")
+        # one int32 seed per call, (1, 1) for the SMEM scalar spec
+        seed = jax.random.randint(dropout_key, (1, 1), -2 ** 31, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
     qseg = kseg = None
     if segment_ids is not None:
         if tq != tk:
@@ -531,6 +625,7 @@ def flash_attention(q, k, v, causal: bool = False,
         ids = segment_ids.astype(jnp.int32)
         qseg = ids.reshape(b, tq, 1)  # q side: lse-layout blocks
         kseg = ids.reshape(b, 1, tq)  # kv side: full-row slice blocks
-    of = _flash(qf, kf, vf, kvm, qseg, kseg, h, causal, float(scale),
-                block_q, block_k, block_q_bwd, block_k_bwd, interpret)
+    of = _flash(qf, kf, vf, kvm, qseg, kseg, seed, h, causal,
+                float(scale), float(dropout_p), block_q, block_k,
+                block_q_bwd, block_k_bwd, interpret)
     return of.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -166,7 +166,7 @@ def test_dispatch_routes_key_padding_mask_to_flash(monkeypatch):
     called = {}
 
     def fake_flash(q, k, v, causal=False, scale=None, kv_mask=None,
-                   segment_ids=None):
+                   segment_ids=None, dropout_p=0.0, dropout_key=None):
         called["kv_mask"] = kv_mask
         return q
 
@@ -248,3 +248,101 @@ def test_flash_segment_ids_compose_with_kv_mask():
                         segment_ids=ids_j)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+class TestFlashDropout:
+    """In-kernel attention-probability dropout: the counter-based mask is
+    coordinate-addressed, so fwd and bwd (even with DIFFERENT block
+    sizes) rebuild it bit-identically, and a pure-jnp reference sharing
+    the same mask must match exactly."""
+
+    @staticmethod
+    def _ref_keep(key, b, h, t, p):
+        """The mask flash builds, reconstructed outside the kernel: hash
+        of (seed, flattened b*h, global row, global col) — block-size
+        invariant by construction."""
+        from paddle_tpu.ops.pallas.flash_attention import _dropout_keep
+
+        seed = jax.random.randint(key, (1, 1), -2 ** 31, 2 ** 31 - 1,
+                                  dtype=jnp.int32)[0, 0]
+        rows = []
+        for bh in range(b * h):
+            rows.append(_dropout_keep(seed, jnp.int32(bh), 0, 0, t, t, p))
+        return jnp.stack(rows).reshape(b, h, t, t)
+
+    @staticmethod
+    def _ref_attn(q, k, v, keep, p, causal=False):
+        scale = q.shape[-1] ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            t = logits.shape[-1]
+            logits = jnp.where(jnp.tril(jnp.ones((t, t), bool)), logits,
+                               jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(keep, probs / (1.0 - p), 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_matches_shared_mask_reference(self, causal):
+        b, t, h, p = 2, 256, 2, 0.2
+        q, k, v = _rand_qkv(b=b, t=t, h=h)
+        key = jax.random.PRNGKey(42)
+        out = flash_attention(q, k, v, causal=causal, dropout_p=p,
+                              dropout_key=key, interpret=True)
+        keep = self._ref_keep(key, b, h, t, p)
+        ref = self._ref_attn(q, k, v, keep, p, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_shared_mask_reference(self):
+        b, t, h, p = 2, 256, 2, 0.15
+        q, k, v = _rand_qkv(b=b, t=t, h=h, seed=23)
+        key = jax.random.PRNGKey(7)
+        rng = np.random.default_rng(23)
+        ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+        def f(q, k, v):
+            # distinct bwd blocks: the coordinate-addressed mask must
+            # survive a different bwd decomposition
+            return (flash_attention(q, k, v, dropout_p=p, dropout_key=key,
+                                    block_q=128, block_k=128,
+                                    block_q_bwd=64, block_k_bwd=128,
+                                    interpret=True) * ct).sum()
+
+        keep = self._ref_keep(key, b, h, t, p)
+
+        def g(q, k, v):
+            return (self._ref_attn(q, k, v, keep, p) * ct).sum()
+
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for a, bb in zip(gf, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_determinism_and_key_sensitivity(self):
+        q, k, v = _rand_qkv()
+        k1, k2 = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+        o1 = flash_attention(q, k, v, dropout_p=0.3, dropout_key=k1,
+                             interpret=True)
+        o1b = flash_attention(q, k, v, dropout_p=0.3, dropout_key=k1,
+                              interpret=True)
+        o2 = flash_attention(q, k, v, dropout_p=0.3, dropout_key=k2,
+                             interpret=True)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o1b))
+        assert float(jnp.max(jnp.abs(o1 - o2))) > 1e-3
+
+    def test_drop_rate_and_scaling(self):
+        """Empirical drop rate ~ p, and the 1/(1-p) rescale keeps the
+        output mean in range."""
+        from paddle_tpu.ops.pallas.flash_attention import _dropout_keep
+
+        keep = _dropout_keep(jnp.int32(123), jnp.int32(0), 0, 0,
+                             512, 512, 0.25)
+        rate = 1.0 - float(jnp.mean(keep.astype(jnp.float32)))
+        assert abs(rate - 0.25) < 0.01
+
+    def test_requires_key(self):
+        q, k, v = _rand_qkv()
+        with pytest.raises(ValueError, match="dropout_key"):
+            flash_attention(q, k, v, dropout_p=0.1, interpret=True)

@@ -140,3 +140,25 @@ def test_flash_segment_ids_lower_to_mosaic(blocks):
             q, k, v, segment_ids=s, block_q=bq, block_k=bk,
             interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
     _export_tpu(bwd, q, q, q, ids)
+
+
+@pytest.mark.parametrize("blocks", [(128, 128), (64, 64)])
+def test_flash_dropout_lowers_to_mosaic(blocks):
+    """In-kernel attention dropout adds an SMEM (1,1) seed input and
+    int32 hash/iota arithmetic — both must Mosaic-lower, fwd and bwd
+    (bwd rebuilds the mask, possibly at different block sizes)."""
+    bq, bk = blocks
+    b, t, h, d = 4, 512, 8, 64
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    prng = jax.random.PRNGKey(0)
+    fwd = jax.jit(lambda q, k, v, pk: flash_attention(
+        q, k, v, dropout_p=0.1, dropout_key=pk, block_q=bq, block_k=bk,
+        interpret=False))
+    _export_tpu(fwd, q, q, q, prng)
+
+    bwd = jax.jit(jax.grad(
+        lambda q, k, v, pk: flash_attention(
+            q, k, v, dropout_p=0.1, dropout_key=pk, block_q=bq,
+            block_k=bk, block_q_bwd=128, block_k_bwd=128,
+            interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    _export_tpu(bwd, q, q, q, prng)
