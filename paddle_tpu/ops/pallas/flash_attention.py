@@ -606,6 +606,8 @@ def flash_attention(q, k, v, causal: bool = False,
         # (B, 1, Tk) float: the unit middle dim gives the mask block a
         # legal (1, block_k) last-two-dims layout (same trick as lse)
         kvm = kv_mask.astype(jnp.float32).reshape(b, 1, tk)
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
     seed = None
     if dropout_p > 0.0:
         if dropout_key is None:
