@@ -6,7 +6,7 @@ itself lives in paddle_tpu.core.mesh.
 
 from .api import DataParallel, Trainer
 from .context_parallel import (context_parallel_attention, ring_attention,
-                               ulysses_attention)
+                               sharded_flash_attention, ulysses_attention)
 from .collective import (allgather, allreduce, all_to_all, axis_index,
                          broadcast, ppermute, reduce_scatter)
 from .dgc import (DGCMomentum, dgc_allreduce, quantized_allreduce,
@@ -23,7 +23,8 @@ from .sharding import (OptStateRules, constraint, infer_param_spec,
 __all__ = [
     "DataParallel", "Trainer", "allgather", "allreduce", "all_to_all",
     "axis_index", "broadcast", "context_parallel_attention", "ppermute",
-    "reduce_scatter", "ring_attention", "ulysses_attention",
+    "reduce_scatter", "ring_attention",
+    "sharded_flash_attention", "ulysses_attention",
     "GPipe", "pipeline_apply", "stage_param_sharding",
     "ShardedEmbedding", "embedding_ep_rules", "sharded_embedding_lookup",
     "OptStateRules", "constraint", "infer_param_spec", "shard_params",
