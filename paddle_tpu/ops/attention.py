@@ -24,18 +24,25 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
                                  dropout_p: float = 0.0, dropout_key=None,
                                  scale: Optional[float] = None,
                                  use_flash: bool = True,
-                                 segment_ids=None):
+                                 segment_ids=None,
+                                 window: Optional[int] = None):
     """q: (B, Tq, H, D), k/v: (B, Tk, H, D) → (B, Tq, H, D).
 
     mask: broadcastable to (B, H, Tq, Tk); True/1 = keep, False/0 = mask out.
     segment_ids: (B, T) int ids for packed batches (self-attention only);
     positions attend within their own segment. Composes with causal/mask.
+    window: sliding-window/local attention — attend only keys within
+    ``window - 1`` positions (lookback-only when causal, symmetric band
+    otherwise); the flash kernel SKIPS out-of-band blocks (O(T*window)
+    compute, the long-context local-attention pattern).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     enforce(segment_ids is None or q.shape[1] == k.shape[1],
             "segment_ids requires self-attention shapes (tq=%s != tk=%s)",
             q.shape[1], k.shape[1])
+    enforce(window is None or window >= 1,
+            "window must be >= 1, got %s", window)
     if use_flash and (dropout_p == 0.0 or dropout_key is not None):
         # key-padding masks (the broadcast (B, 1, 1, Tk) form every
         # ragged-batch model emits) ride the flash kernel; anything else
@@ -49,13 +56,16 @@ def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
         kv_mask = _as_kv_mask(mask, q.shape[0], k.shape[1])
         if mask is None or kv_mask is not None:
             flash = _get_flash()
-            if flash is not None and _flash_ok(q, k, causal):
+            if flash is not None and _flash_ok(q, k, causal,
+                                               window=window):
                 return flash(q, k, v, causal=causal, scale=scale,
                              kv_mask=kv_mask, segment_ids=segment_ids,
-                             dropout_p=dropout_p, dropout_key=dropout_key)
+                             dropout_p=dropout_p, dropout_key=dropout_key,
+                             window=window)
     return xla_attention(q, k, v, mask=mask, causal=causal,
                          dropout_p=dropout_p, dropout_key=dropout_key,
-                         scale=scale, segment_ids=segment_ids)
+                         scale=scale, segment_ids=segment_ids,
+                         window=window)
 
 
 def _as_kv_mask(mask, b: int, tk: int):
@@ -76,10 +86,20 @@ def _as_kv_mask(mask, b: int, tk: int):
 
 def xla_attention(q, k, v, mask=None, causal: bool = False,
                   dropout_p: float = 0.0, dropout_key=None,
-                  scale: Optional[float] = None, segment_ids=None):
+                  scale: Optional[float] = None, segment_ids=None,
+                  window: Optional[int] = None):
     """Reference XLA implementation — materializes (B, H, Tq, Tk) scores."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None:
+        enforce(window >= 1, "window must be >= 1, got %s", window)
+        tq, tk = q.shape[1], k.shape[1]
+        rows = jnp.arange(tq)[:, None] + (tk - tq)  # offset-aligned rows
+        cols = jnp.arange(tk)[None, :]
+        band = rows - cols < window
+        if not causal:
+            band = band & (cols - rows < window)
+        mask = band if mask is None else (mask.astype(jnp.bool_) & band)
     if segment_ids is not None:
         ids = segment_ids
         seg = (ids[:, None, :, None] == ids[:, None, None, :])
@@ -119,7 +139,7 @@ def _get_flash():
         return None
 
 
-def _flash_ok(q, k, causal: bool = False) -> bool:
+def _flash_ok(q, k, causal: bool = False, window=None) -> bool:
     """Flash kernel constraints: TPU backend, block-divisible seq lens,
     supported head dim — and the autotuner's measured verdict when one
     exists (tools/pallas_tune.py records use_flash=False for shape
@@ -132,6 +152,11 @@ def _flash_ok(q, k, causal: bool = False) -> bool:
     # verdict below still decides whether the kernel actually wins there
     if not (tq % 64 == 0 and tk % 64 == 0 and d in (64, 128, 256)):
         return False
+    if window is not None:
+        # tuned verdicts are measured at DENSE attention; banded flash
+        # skips out-of-band blocks (O(T*window)) while the XLA fallback
+        # stays O(T^2) — a dense use_flash=False must not veto it
+        return True
     from .pallas.tuning import attention_key, get_tuned
 
     tuned = get_tuned(attention_key(tq, tk, d, causal))

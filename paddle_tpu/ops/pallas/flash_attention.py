@@ -95,8 +95,8 @@ def _use_interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
-                has_segs, dropout_p, offset, block_q, block_k,
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
+                has_mask, has_segs, dropout_p, offset, block_q, block_k,
                 num_k_blocks):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
@@ -115,9 +115,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # causal: block (i, j) contributes iff its lowest row can see its first
-    # column: i*bq + bq - 1 >= j*bk
+    # column: i*bq + bq - 1 >= j*bk. A window adds band-overlap limits on
+    # both sides — out-of-band blocks skip ALL their compute (the O(T*W)
+    # point of local attention).
     should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
                   if causal else True)
+    if window is not None:
+        lo = i * block_q + offset - (window - 1)   # leftmost visible col
+        should_run &= j * block_k + block_k - 1 >= lo
+        if not causal:
+            hi = i * block_q + block_q - 1 + offset + (window - 1)
+            should_run &= j * block_k <= hi
 
     @pl.when(should_run)
     def _body():
@@ -132,11 +140,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (i * block_q + rows + offset) >= (j * block_k + cols)
-            s = jnp.where(mask, s, _NEG_INF)
+        if causal or window is not None:
+            rows = (i * block_q + offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0))
+            cols = (j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1))
+            if causal:
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            if window is not None:
+                band = rows - cols < window
+                if not causal:
+                    band &= cols - rows < window
+                s = jnp.where(band, s, _NEG_INF)
         if has_mask:
             # key-padding keep-mask (1, bk) broadcasting over q rows
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
@@ -153,7 +168,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_mask,
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                             # (bq, bk)
-        if causal or has_mask or has_segs:
+        if causal or window is not None or has_mask or has_segs:
             # a fully-masked row has m_new == _NEG_INF, making the
             # masked exp(s - m_new) = exp(0) = 1 instead of 0
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
@@ -199,15 +214,16 @@ def _mask_spec(nheads, tk):
                       lambda b, i, j, _h=nheads: (b // _h, 0, 0))
 
 
-def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal, scale,
-              dropout_p, block_q, block_k, interpret):
+def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal, window,
+              scale, dropout_p, block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     grid = (bh, tq // block_q, tk // block_k)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, has_mask=kvm is not None,
-        has_segs=qseg is not None, dropout_p=dropout_p, offset=tk - tq,
-        block_q=block_q, block_k=block_k, num_k_blocks=tk // block_k)
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        has_mask=kvm is not None, has_segs=qseg is not None,
+        dropout_p=dropout_p, offset=tk - tq, block_q=block_q,
+        block_k=block_k, num_k_blocks=tk // block_k)
     # lse carried as (bh, tq, 1): the trailing unit dim keeps the block's
     # last-two-dims (block_q, 1) legal for the Mosaic (8, 128) tiling rule
     out_shape = (
@@ -255,8 +271,8 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal, scale,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-               scale, causal, has_mask, has_segs, dropout_p, offset,
-               block_q, block_k, num_k_blocks):
+               scale, causal, window, has_mask, has_segs, dropout_p,
+               offset, block_q, block_k, num_k_blocks):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
@@ -271,6 +287,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
     should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
                   if causal else True)
+    if window is not None:
+        lo = i * block_q + offset - (window - 1)
+        should_run &= j * block_k + block_k - 1 >= lo
+        if not causal:
+            hi = i * block_q + block_q - 1 + offset + (window - 1)
+            should_run &= j * block_k <= hi
 
     @pl.when(should_run)
     def _body():
@@ -286,11 +308,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (i * block_q + rows + offset) >= (j * block_k + cols)
-            s = jnp.where(mask, s, _NEG_INF)
+        if causal or window is not None:
+            rows = (i * block_q + offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0))
+            cols = (j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1))
+            if causal:
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            if window is not None:
+                band = rows - cols < window
+                if not causal:
+                    band &= cols - rows < window
+                s = jnp.where(band, s, _NEG_INF)
         if has_mask:
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(kvm > 0, s, _NEG_INF)
@@ -299,7 +328,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
             kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        if causal or has_mask or has_segs:
+        if causal or window is not None or has_mask or has_segs:
             # fully-masked rows carry lse == _NEG_INF (see fwd _finish)
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         dp = jax.lax.dot_general(
@@ -323,8 +352,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                scale, causal, has_mask, has_segs, dropout_p, offset,
-                block_q, block_k, num_q_blocks):
+                scale, causal, window, has_mask, has_segs, dropout_p,
+                offset, block_q, block_k, num_q_blocks):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
@@ -341,6 +370,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
     should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
                   if causal else True)
+    if window is not None:
+        lo = i * block_q + offset - (window - 1)
+        should_run &= j * block_k + block_k - 1 >= lo
+        if not causal:
+            hi = i * block_q + block_q - 1 + offset + (window - 1)
+            should_run &= j * block_k <= hi
 
     @pl.when(should_run)
     def _body():
@@ -354,11 +389,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (i * block_q + rows + offset) >= (j * block_k + cols)
-            s = jnp.where(mask, s, _NEG_INF)
+        if causal or window is not None:
+            rows = (i * block_q + offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0))
+            cols = (j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1))
+            if causal:
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            if window is not None:
+                band = rows - cols < window
+                if not causal:
+                    band &= cols - rows < window
+                s = jnp.where(band, s, _NEG_INF)
         if has_mask:
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(kvm > 0, s, _NEG_INF)
@@ -367,7 +409,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
             kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)                               # (bq, bk) f32
-        if causal or has_mask or has_segs:
+        if causal or window is not None or has_mask or has_segs:
             p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         p_v = p  # dv uses the DROPPED probabilities (out = p_drop @ v)
         if dropout_p > 0.0:
@@ -395,7 +437,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 
 def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
-              scale, dropout_p, block_q, block_k, interpret):
+              window, scale, dropout_p, block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -424,9 +466,9 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
         dq_inputs += (seed,)
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal, has_mask=has_mask,
-            has_segs=has_segs, dropout_p=dropout_p, offset=tk - tq,
-            block_q=block_q, block_k=block_k,
+            _dq_kernel, scale=scale, causal=causal, window=window,
+            has_mask=has_mask, has_segs=has_segs, dropout_p=dropout_p,
+            offset=tk - tq, block_q=block_q, block_k=block_k,
             num_k_blocks=tk // block_k),
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=dq_in_specs,
@@ -461,9 +503,9 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
         dkv_inputs += (seed,)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, has_mask=has_mask,
-            has_segs=has_segs, dropout_p=dropout_p, offset=tk - tq,
-            block_q=block_q, block_k=block_k,
+            _dkv_kernel, scale=scale, causal=causal, window=window,
+            has_mask=has_mask, has_segs=has_segs, dropout_p=dropout_p,
+            offset=tk - tq, block_q=block_q, block_k=block_k,
             num_q_blocks=tq // block_q),
         grid=(bh, tk // block_k, tq // block_q),
         in_specs=dkv_in_specs,
@@ -490,29 +532,30 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15))
-def _flash(q, k, v, kvm, qseg, kseg, seed, nheads, causal, scale,
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15, 16))
+def _flash(q, k, v, kvm, qseg, kseg, seed, nheads, causal, window, scale,
            dropout_p, block_q, block_k, block_q_bwd, block_k_bwd,
            interpret):
     o, _ = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal,
-                     scale, dropout_p, block_q, block_k, interpret)
+                     window, scale, dropout_p, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, kvm, qseg, kseg, seed, nheads, causal, scale,
-               dropout_p, block_q, block_k, block_q_bwd, block_k_bwd,
-               interpret):
+def _flash_fwd(q, k, v, kvm, qseg, kseg, seed, nheads, causal, window,
+               scale, dropout_p, block_q, block_k, block_q_bwd,
+               block_k_bwd, interpret):
     o, lse = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal,
-                       scale, dropout_p, block_q, block_k, interpret)
+                       window, scale, dropout_p, block_q, block_k,
+                       interpret)
     return o, (q, k, v, kvm, qseg, kseg, seed, o, lse)
 
 
-def _flash_bwd(nheads, causal, scale, dropout_p, block_q, block_k,
+def _flash_bwd(nheads, causal, window, scale, dropout_p, block_q, block_k,
                block_q_bwd, block_k_bwd, interpret, res, do):
     q, k, v, kvm, qseg, kseg, seed, o, lse = res
     dq, dk, dv = _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse,
-                           do, causal, scale, dropout_p, block_q_bwd,
-                           block_k_bwd, interpret)
+                           do, causal, window, scale, dropout_p,
+                           block_q_bwd, block_k_bwd, interpret)
     # the keep-mask, segment ids and dropout seed carry no gradients
     return dq, dk, dv, None, None, None, None
 
@@ -524,6 +567,7 @@ def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     kv_mask=None,
                     segment_ids=None,
+                    window: Optional[int] = None,
                     dropout_p: float = 0.0,
                     dropout_key=None,
                     block_q: Optional[int] = None,
@@ -608,6 +652,8 @@ def flash_attention(q, k, v, causal: bool = False,
         kvm = kv_mask.astype(jnp.float32).reshape(b, 1, tk)
     if not 0.0 <= dropout_p < 1.0:
         raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     seed = None
     if dropout_p > 0.0:
         if dropout_key is None:
@@ -628,6 +674,7 @@ def flash_attention(q, k, v, causal: bool = False,
         qseg = ids.reshape(b, tq, 1)  # q side: lse-layout blocks
         kseg = ids.reshape(b, 1, tq)  # kv side: full-row slice blocks
     of = _flash(qf, kf, vf, kvm, qseg, kseg, seed, h, causal,
-                float(scale), float(dropout_p), block_q, block_k,
-                block_q_bwd, block_k_bwd, interpret)
+                None if window is None else int(window), float(scale),
+                float(dropout_p), block_q, block_k, block_q_bwd,
+                block_k_bwd, interpret)
     return of.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

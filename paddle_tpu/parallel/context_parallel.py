@@ -279,7 +279,7 @@ def context_parallel_attention(q, k, v, *, impl: str = "ring", **kw):
 
 def sharded_flash_attention(q, k, v, *, mesh=None, batch_axis="dp",
                             head_axis=None, causal=False, scale=None,
-                            kv_mask=None, segment_ids=None,
+                            kv_mask=None, segment_ids=None, window=None,
                             dropout_p=0.0, dropout_key=None):
     """Flash attention partitioned over batch and/or head mesh axes via
     shard_map — the pattern production TPU stacks use, because XLA's
@@ -315,11 +315,13 @@ def sharded_flash_attention(q, k, v, *, mesh=None, batch_axis="dp",
         enforce(h % axes[head_axis] == 0,
                 "heads %s must divide %s axis size %s", h, head_axis,
                 axes[head_axis])
-    for name, arr in (("kv_mask", kv_mask), ("segment_ids", segment_ids)):
+    tk = k.shape[1]  # key-padding masks cover the KEY sequence
+    for name, arr, length in (("kv_mask", kv_mask, tk),
+                              ("segment_ids", segment_ids, t)):
         if arr is not None:
-            enforce(arr.shape == (b, t),
-                    "%s must be (batch, seq) = (%s, %s), got %s",
-                    name, b, t, arr.shape)
+            enforce(arr.shape == (b, length),
+                    "%s must be (batch, %s), got %s",
+                    name, length, arr.shape)
     spec = P(batch_axis, None, head_axis, None)
     mspec = P(batch_axis, None)
 
@@ -331,7 +333,7 @@ def sharded_flash_attention(q, k, v, *, mesh=None, batch_axis="dp",
                 if ax is not None:
                     key = jax.random.fold_in(key, lax.axis_index(ax))
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               kv_mask=km, segment_ids=seg,
+                               kv_mask=km, segment_ids=seg, window=window,
                                dropout_p=dropout_p, dropout_key=key)
 
     return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,

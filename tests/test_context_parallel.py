@@ -325,7 +325,12 @@ def test_sharded_flash_dropout_deterministic_and_per_shard(sp_mesh):
 
     b, t, h, d = 4, 128, 8, 64
     rng = np.random.default_rng(2)
-    q = jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+    row = rng.normal(size=(1, t, h, d)).astype(np.float32)
+    # rows 0 and 2 are IDENTICAL and land on different dp shards
+    # (b=4 over dp=2): any output difference can only come from the
+    # per-shard dropout masks
+    q = jnp.asarray(np.concatenate([row, rng.normal(
+        size=(1, t, h, d)).astype(np.float32)] * 2, axis=0))
     key = jax.random.PRNGKey(9)
     o1 = sharded_flash_attention(q, q, q, mesh=sp_mesh, batch_axis="dp",
                                  head_axis="sp", dropout_p=0.2,
@@ -334,8 +339,7 @@ def test_sharded_flash_dropout_deterministic_and_per_shard(sp_mesh):
                                  head_axis="sp", dropout_p=0.2,
                                  dropout_key=key)
     np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
-    # identical input rows land on different shards (b=4 over dp=2):
-    # their dropout masks must NOT coincide
+    np.testing.assert_array_equal(np.asarray(q[0]), np.asarray(q[2]))
     assert float(jnp.max(jnp.abs(o1[0] - o1[2]))) > 1e-3
 
 

@@ -165,9 +165,8 @@ def test_dispatch_routes_key_padding_mask_to_flash(monkeypatch):
 
     called = {}
 
-    def fake_flash(q, k, v, causal=False, scale=None, kv_mask=None,
-                   segment_ids=None, dropout_p=0.0, dropout_key=None):
-        called["kv_mask"] = kv_mask
+    def fake_flash(q, k, v, **kw):
+        called["kv_mask"] = kw.get("kv_mask")
         return q
 
     monkeypatch.setattr(A, "_get_flash", lambda: fake_flash)
@@ -383,3 +382,61 @@ def test_flash_all_features_compose():
     ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+class TestFlashWindow:
+    """Sliding-window/local attention: banded masking with block-level
+    compute skipping (O(T*window) — the long-context local pattern)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("window", [64, 100, 256])
+    def test_matches_oracle(self, causal, window):
+        q, k, v = _rand_qkv(t=512, seed=41)
+        out = flash_attention(q, k, v, causal=causal, window=window,
+                              interpret=True)
+        ref = xla_attention(q, k, v, causal=causal, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_oracle(self, causal):
+        q, k, v = _rand_qkv(t=256, seed=43)
+        rng = np.random.default_rng(43)
+        ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+        def f(q, k, v):
+            return (flash_attention(q, k, v, causal=causal, window=96,
+                                    block_q=128, block_k=128,
+                                    block_q_bwd=64, block_k_bwd=128,
+                                    interpret=True) * ct).sum()
+
+        def g(q, k, v):
+            return (xla_attention(q, k, v, causal=causal,
+                                  window=96) * ct).sum()
+
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for a, bb in zip(gf, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_window_composes_with_mask_and_segments(self):
+        q, k, v = _rand_qkv(t=256, seed=47)
+        keep = jnp.asarray(np.arange(256)[None, :]
+                           < np.array([224, 192])[:, None])
+        ids = np.zeros((2, 256), np.int32)
+        ids[:, 128:] = 1
+        ids_j = jnp.asarray(ids)
+        out = flash_attention(q, k, v, causal=True, window=80,
+                              kv_mask=keep, segment_ids=ids_j,
+                              interpret=True)
+        ref = xla_attention(q, k, v, causal=True, window=80,
+                            mask=keep[:, None, None, :],
+                            segment_ids=ids_j)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_window_validation(self):
+        q, k, v = _rand_qkv(t=128)
+        with pytest.raises(ValueError, match="window"):
+            flash_attention(q, k, v, window=0, interpret=True)

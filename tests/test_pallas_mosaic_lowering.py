@@ -162,3 +162,21 @@ def test_flash_dropout_lowers_to_mosaic(blocks):
             block_k=bk, block_q_bwd=128, block_k_bwd=128,
             interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
     _export_tpu(bwd, q, q, q, prng)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_window_lowers_to_mosaic(causal):
+    """Banded (sliding-window) attention — the block-skip predicate and
+    in-kernel band mask must Mosaic-lower."""
+    b, t, h, d = 2, 2048, 8, 64
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    fwd = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, window=256, block_q=128, block_k=128,
+        interpret=False))
+    _export_tpu(fwd, q, q, q)
+
+    bwd = jax.jit(jax.grad(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, window=256, block_q=128, block_k=128,
+            interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    _export_tpu(bwd, q, q, q)
