@@ -399,7 +399,7 @@ def bench_transformer_nmt(steps: int, batch_size: int, amp=None,
 
 
 def bench_bert_long(steps: int, batch_size: int, amp=None,
-                    seq_len: int = 2048):
+                    seq_len: int = 2048, window: int = None):
     """Long-context BERT MLM step at seq 2048 — the SURVEY §5.7
     long-sequence showcase: attention cost is O(T^2), so this is where
     the flash-attention kernel path engages on TPU (T % 128 == 0, head
@@ -415,6 +415,7 @@ def bench_bert_long(steps: int, batch_size: int, amp=None,
     cfg = B.BertConfig.base()
     cfg.max_position = seq_len
     cfg.remat = True
+    cfg.attn_window = window  # --window: O(T*W) local attention
     model = B.BertForPretraining(cfg)
     rng = np.random.default_rng(0)
 
@@ -793,6 +794,9 @@ def main():
     ap.add_argument("--vocab", type=int, default=None,
                     help="deepfm/deepfm_sparse: embedding table size "
                     "(sweeps the sparse-vs-dense update crossover)")
+    ap.add_argument("--window", type=int, default=None,
+                    help="bert_long: sliding-window attention width "
+                    "(O(T*W) local attention vs the O(T^2) default)")
     ap.add_argument("--dp", type=int, default=1,
                     help="data-parallel device count (--gpus analog; on "
                     "--platform cpu this creates virtual host devices)")
@@ -834,6 +838,10 @@ def main():
     if (args.vocab and "vocab" in sig
             and args.vocab != sig["vocab"].default):
         metric += f"_v{args.vocab}"
+    if args.window and "window" in sig:
+        # a window changes the WORKLOAD (different attention math):
+        # its history key must not collide with the full-attention one
+        metric += f"_w{args.window}"
     if _EXPLICIT_BATCH:
         metric += f"_b{batch}"
     if args.infer and args.model == "deepfm_sparse":
@@ -896,6 +904,8 @@ def main():
         kwargs["scan_layers"] = True
     if "vocab" in sig and args.vocab:
         kwargs["vocab"] = args.vocab
+    if "window" in sig and args.window:
+        kwargs["window"] = args.window
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call

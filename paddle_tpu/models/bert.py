@@ -32,6 +32,9 @@ class BertConfig:
     # None | 'ring' | 'ulysses' — shard attention over the 'sp' mesh axis
     seq_parallel: Optional[str] = None
     remat: bool = False        # jax.checkpoint per block (HBM for FLOPs)
+    # sliding-window/local attention width (None = full; the flash
+    # kernel skips out-of-band blocks — O(T*window) long-context mode)
+    attn_window: Optional[int] = None
     scan_layers: bool = False  # lax.scan over stacked layers (needs
     #                            dropout == 0 while training)
 
@@ -75,7 +78,7 @@ class BertModel(nn.Layer):
             cfg.intermediate_size, cfg.dropout, activation="gelu",
             normalize_before=False, use_flash=cfg.use_flash,
             seq_parallel=cfg.seq_parallel, remat=cfg.remat,
-            scan_layers=cfg.scan_layers)
+            scan_layers=cfg.scan_layers, attn_window=cfg.attn_window)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,

@@ -431,7 +431,8 @@ class MultiHeadAttention(Layer):
         self.out_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
 
     def forward(self, query, key=None, value=None, attn_mask=None,
-                causal: bool = False, segment_ids=None):
+                causal: bool = False, segment_ids=None,
+                window: Optional[int] = None):
         key = query if key is None else key
         value = key if value is None else value
         b, tq, d = query.shape
@@ -442,6 +443,10 @@ class MultiHeadAttention(Layer):
         v = self.v_proj(value).reshape(b, tk, h, hd)
 
         if self.seq_parallel is not None:
+            enforce(window is None,
+                    "seq_parallel=%s does not support sliding-window "
+                    "attention yet (it would be silently ignored)",
+                    self.seq_parallel)
             # key-padding masks ((B, Tk) or (B, 1, 1, Tk)) ride the SP
             # paths (ring rotates the mask block with its K/V; Ulysses
             # all-gathers it); anything per-head/per-query is an explicit
@@ -478,7 +483,8 @@ class MultiHeadAttention(Layer):
                 q, k, v, mask=attn_mask, causal=causal,
                 dropout_p=self.dropout_p if self.training else 0.0,
                 dropout_key=self.rng("attn_dropout") if (self.training and self.dropout_p > 0) else None,
-                use_flash=self.use_flash, segment_ids=segment_ids)
+                use_flash=self.use_flash, segment_ids=segment_ids,
+                window=window)
         out = out.reshape(b, tq, d)
         return self.out_proj(out)
 

@@ -41,9 +41,11 @@ class TransformerEncoderLayer(Layer):
     def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
                  dropout: float = 0.1, activation: str = "gelu",
                  normalize_before: bool = True, use_flash: bool = True,
-                 seq_parallel=None):
+                 seq_parallel=None, attn_window=None):
         super().__init__()
         self.normalize_before = normalize_before
+        # sliding-window/local attention width (None = full)
+        self.attn_window = attn_window
         # attention-probability dropout is unsupported under SP (the ring/
         # a2a paths have no per-probability RNG plan yet); residual/FFN
         # dropout below stays active, so regularization is not silently lost
@@ -59,11 +61,13 @@ class TransformerEncoderLayer(Layer):
     def forward(self, x, mask=None, segment_ids=None):
         if self.normalize_before:
             x = x + self.drop1(self.self_attn(self.norm1(x), attn_mask=mask,
-                                              segment_ids=segment_ids))
+                                              segment_ids=segment_ids,
+                                              window=self.attn_window))
             x = x + self.drop2(self.ffn(self.norm2(x)))
         else:
             x = self.norm1(x + self.drop1(self.self_attn(
-                x, attn_mask=mask, segment_ids=segment_ids)))
+                x, attn_mask=mask, segment_ids=segment_ids,
+                window=self.attn_window)))
             x = self.norm2(x + self.drop2(self.ffn(x)))
         return x
 
@@ -121,12 +125,13 @@ class TransformerEncoder(Layer):
                  dim_feedforward: int, dropout: float = 0.1,
                  activation: str = "gelu", normalize_before: bool = True,
                  use_flash: bool = True, seq_parallel=None,
-                 remat: bool = False, scan_layers: bool = False):
+                 remat: bool = False, scan_layers: bool = False,
+                 attn_window=None):
         super().__init__()
         self.layers = LayerList([
             TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
                                     activation, normalize_before, use_flash,
-                                    seq_parallel)
+                                    seq_parallel, attn_window=attn_window)
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
         self.remat = remat

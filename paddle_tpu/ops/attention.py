@@ -152,10 +152,11 @@ def _flash_ok(q, k, causal: bool = False, window=None) -> bool:
     # verdict below still decides whether the kernel actually wins there
     if not (tq % 64 == 0 and tk % 64 == 0 and d in (64, 128, 256)):
         return False
-    if window is not None:
+    if window is not None and window < tk:
         # tuned verdicts are measured at DENSE attention; banded flash
         # skips out-of-band blocks (O(T*window)) while the XLA fallback
-        # stays O(T^2) — a dense use_flash=False must not veto it
+        # stays O(T^2) — a dense use_flash=False must not veto it.
+        # window >= tk is dense in disguise: fall through to the verdict
         return True
     from .pallas.tuning import attention_key, get_tuned
 

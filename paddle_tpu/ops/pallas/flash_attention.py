@@ -84,6 +84,43 @@ def _dropout_keep(seed, bh, row0, col0, bq, bk, dropout_p):
     return u >= dropout_p
 
 
+def _block_should_run(i, j, *, causal, window, offset, block_q, block_k):
+    """Block-level skip predicate shared by fwd/dq/dkv: a causal block
+    runs iff its lowest row can see its first column; a window adds
+    band-overlap limits on both sides (out-of-band blocks skip ALL
+    compute — the O(T*window) point of local attention)."""
+    run = ((i * block_q + block_q - 1 + offset >= j * block_k)
+           if causal else True)
+    if window is not None:
+        lo = i * block_q + offset - (window - 1)   # leftmost visible col
+        run &= j * block_k + block_k - 1 >= lo
+        if not causal:
+            hi = i * block_q + block_q - 1 + offset + (window - 1)
+            run &= j * block_k <= hi
+    return run
+
+
+def _apply_causal_band(s, i, j, *, causal, window, offset, block_q,
+                       block_k):
+    """Per-entry causal/band mask shared by fwd/dq/dkv (same global
+    coordinates in all three — a desync between forward and backward
+    masking would corrupt gradients silently)."""
+    if not causal and window is None:
+        return s
+    rows = (i * block_q + offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0))
+    cols = (j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1))
+    if causal:
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    if window is not None:
+        band = rows - cols < window
+        if not causal:
+            band &= cols - rows < window
+        s = jnp.where(band, s, _NEG_INF)
+    return s
+
+
 def _use_interpret() -> bool:
     # keep in sync with ops.attention._flash_ok: any real-TPU backend name
     # must compile via Mosaic, everything else tests via interpret mode
@@ -114,18 +151,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: block (i, j) contributes iff its lowest row can see its first
-    # column: i*bq + bq - 1 >= j*bk. A window adds band-overlap limits on
-    # both sides — out-of-band blocks skip ALL their compute (the O(T*W)
-    # point of local attention).
-    should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
-                  if causal else True)
-    if window is not None:
-        lo = i * block_q + offset - (window - 1)   # leftmost visible col
-        should_run &= j * block_k + block_k - 1 >= lo
-        if not causal:
-            hi = i * block_q + block_q - 1 + offset + (window - 1)
-            should_run &= j * block_k <= hi
+    should_run = _block_should_run(i, j, causal=causal, window=window,
+                                   offset=offset, block_q=block_q,
+                                   block_k=block_k)
 
     @pl.when(should_run)
     def _body():
@@ -140,18 +168,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
-        if causal or window is not None:
-            rows = (i * block_q + offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
-            cols = (j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
-            if causal:
-                s = jnp.where(rows >= cols, s, _NEG_INF)
-            if window is not None:
-                band = rows - cols < window
-                if not causal:
-                    band &= cols - rows < window
-                s = jnp.where(band, s, _NEG_INF)
+        s = _apply_causal_band(s, i, j, causal=causal, window=window,
+                               offset=offset, block_q=block_q,
+                               block_k=block_k)
         if has_mask:
             # key-padding keep-mask (1, bk) broadcasting over q rows
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
@@ -285,14 +304,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
-                  if causal else True)
-    if window is not None:
-        lo = i * block_q + offset - (window - 1)
-        should_run &= j * block_k + block_k - 1 >= lo
-        if not causal:
-            hi = i * block_q + block_q - 1 + offset + (window - 1)
-            should_run &= j * block_k <= hi
+    should_run = _block_should_run(i, j, causal=causal, window=window,
+                                   offset=offset, block_q=block_q,
+                                   block_k=block_k)
 
     @pl.when(should_run)
     def _body():
@@ -308,18 +322,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            rows = (i * block_q + offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
-            cols = (j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
-            if causal:
-                s = jnp.where(rows >= cols, s, _NEG_INF)
-            if window is not None:
-                band = rows - cols < window
-                if not causal:
-                    band &= cols - rows < window
-                s = jnp.where(band, s, _NEG_INF)
+        s = _apply_causal_band(s, i, j, causal=causal, window=window,
+                               offset=offset, block_q=block_q,
+                               block_k=block_k)
         if has_mask:
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(kvm > 0, s, _NEG_INF)
@@ -368,14 +373,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    should_run = ((i * block_q + block_q - 1 + offset >= j * block_k)
-                  if causal else True)
-    if window is not None:
-        lo = i * block_q + offset - (window - 1)
-        should_run &= j * block_k + block_k - 1 >= lo
-        if not causal:
-            hi = i * block_q + block_q - 1 + offset + (window - 1)
-            should_run &= j * block_k <= hi
+    should_run = _block_should_run(i, j, causal=causal, window=window,
+                                   offset=offset, block_q=block_q,
+                                   block_k=block_k)
 
     @pl.when(should_run)
     def _body():
@@ -389,18 +389,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            rows = (i * block_q + offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
-            cols = (j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
-            if causal:
-                s = jnp.where(rows >= cols, s, _NEG_INF)
-            if window is not None:
-                band = rows - cols < window
-                if not causal:
-                    band &= cols - rows < window
-                s = jnp.where(band, s, _NEG_INF)
+        s = _apply_causal_band(s, i, j, causal=causal, window=window,
+                               offset=offset, block_q=block_q,
+                               block_k=block_k)
         if has_mask:
             kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(kvm > 0, s, _NEG_INF)

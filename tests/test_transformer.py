@@ -170,3 +170,24 @@ class TestPackedBert:
         np.testing.assert_allclose(np.asarray(h_packed[0, :la]),
                                    np.asarray(h_alone[0]),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_encoder_attn_window_matches_banded_mask():
+    """attn_window through the encoder equals an explicit band mask on
+    the same weights (the O(T*W) local-attention config knob)."""
+    import paddle_tpu as pt
+    from paddle_tpu.nn.transformer import TransformerEncoder
+
+    pt.seed(3)
+    T, W = 64, 16
+    enc = TransformerEncoder(2, 32, 4, 64, dropout=0.0,
+                             attn_window=W).eval()
+    x = jnp.asarray(np.random.default_rng(9).normal(
+        size=(2, T, 32)).astype(np.float32))
+    out_w = enc(x)
+    for layer in enc.layers:
+        layer.attn_window = None
+    band = np.abs(np.arange(T)[:, None] - np.arange(T)[None, :]) < W
+    out_ref = enc(x, mask=jnp.asarray(band)[None, None])
+    np.testing.assert_allclose(np.asarray(out_w), np.asarray(out_ref),
+                               atol=2e-5, rtol=2e-5)

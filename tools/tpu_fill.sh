@@ -100,6 +100,10 @@ item bench_nmt_b256    1200 python bench.py --model transformer_nmt --batch-size
 item bench_rn50_b256   1500 python bench.py --model resnet50 --batch-size 256
 item bench_lstm_b2048  1200 python bench.py --model stacked_lstm --batch-size 2048
 item bench_bertlong_b8 1500 python bench.py --model bert_long --batch-size 8
+# O(T*W) local attention at seq 2048 — compare against bench_bertlong2
+# (same model, same DEFAULT batch of 4; the _w256 metric key keeps the
+# histories separate)
+item bench_bertlong_w256 1500 python bench.py --model bert_long --window 256
 # mnist is pure dispatch-bound through the tunnel; if k=32 wins, its
 # default steps_per_call should be bumped to match
 item bench_mnist_k32   900  python bench.py --steps-per-call 32
