@@ -415,19 +415,30 @@ class MultiHeadAttention(Layer):
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  bias: bool = True, use_flash: bool = True,
-                 seq_parallel: Optional[str] = None, dtype=None):
+                 seq_parallel: Optional[str] = None, dtype=None,
+                 num_kv_heads: Optional[int] = None):
         super().__init__()
         enforce(embed_dim % num_heads == 0,
                 "embed_dim %s not divisible by heads %s", embed_dim, num_heads)
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        # GQA/MQA: fewer K/V heads than Q heads (the flash kernel reads
+        # shared K/V blocks via its index map; XLA repeats heads)
+        self.num_kv_heads = num_kv_heads or num_heads
+        enforce(num_heads % self.num_kv_heads == 0,
+                "num_heads %s not divisible by num_kv_heads %s",
+                num_heads, self.num_kv_heads)
         self.dropout_p = dropout
         self.use_flash = use_flash
         # None | "ring" | "ulysses": shard attention over the 'sp' mesh axis
         self.seq_parallel = seq_parallel
+        enforce(seq_parallel is None or self.num_kv_heads == num_heads,
+                "seq_parallel does not support GQA (num_kv_heads < "
+                "num_heads) yet")
+        kv_dim = self.num_kv_heads * self.head_dim
         self.q_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
-        self.k_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
-        self.v_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
+        self.k_proj = Linear(embed_dim, kv_dim, bias_attr=bias)
+        self.v_proj = Linear(embed_dim, kv_dim, bias_attr=bias)
         self.out_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
 
     def forward(self, query, key=None, value=None, attn_mask=None,
@@ -438,9 +449,10 @@ class MultiHeadAttention(Layer):
         b, tq, d = query.shape
         tk = key.shape[1]
         h, hd = self.num_heads, self.head_dim
+        h_kv = self.num_kv_heads
         q = self.q_proj(query).reshape(b, tq, h, hd)
-        k = self.k_proj(key).reshape(b, tk, h, hd)
-        v = self.v_proj(value).reshape(b, tk, h, hd)
+        k = self.k_proj(key).reshape(b, tk, h_kv, hd)
+        v = self.v_proj(value).reshape(b, tk, h_kv, hd)
 
         if self.seq_parallel is not None:
             enforce(window is None,

@@ -91,6 +91,12 @@ def xla_attention(q, k, v, mask=None, causal: bool = False,
     """Reference XLA implementation — materializes (B, H, Tq, Tk) scores."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if k.shape[2] != q.shape[2]:
+        # GQA/MQA: expand the shared K/V heads (kv-major, matching the
+        # flash kernel's head -> head // group mapping)
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     if window is not None:
         enforce(window >= 1, "window must be >= 1, got %s", window)
         tq, tk = q.shape[1], k.shape[1]

@@ -222,6 +222,28 @@ def _qseg_spec(nheads, block_q):
                       lambda b, i, j, _h=nheads: (b // _h, i, 0))
 
 
+def _kv_row_fold(bh, nheads, kv_heads):
+    # k/v may carry FEWER heads than q (GQA/MQA): q-grid row bh maps to
+    # kv row batch*kv_heads + (head // group) — the kernel reads the
+    # shared K/V block via the index map instead of materializing a
+    # head-repeat in HBM. ONE definition: fwd/dq/dkv all fold with it.
+    if kv_heads == nheads:
+        return bh
+    group = nheads // kv_heads
+    return (bh // nheads) * kv_heads + (bh % nheads) // group
+
+
+def _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=2):
+    """K/V block spec; ``kv_arg_pos`` names which grid arg is the
+    kv-block index (2 for the fwd/dq (b, i, j) grids, 1 for the dkv
+    swapped (b, j, i) grid)."""
+
+    def imap(*args, _h=nheads, _kv=kv_heads, _p=kv_arg_pos):
+        return (_kv_row_fold(args[0], _h, _kv), args[_p], 0)
+
+    return _vmem_spec((1, block_k, d), imap)
+
+
 def _mask_spec(nheads, tk):
     # kv_mask is (B, 1, Tk) float; every head of batch row b reads row
     # b // nheads — the index map folds the (B*h) grid dim back to B.
@@ -233,8 +255,8 @@ def _mask_spec(nheads, tk):
                       lambda b, i, j, _h=nheads: (b // _h, 0, 0))
 
 
-def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal, window,
-              scale, dropout_p, block_q, block_k, interpret):
+def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
+              window, scale, dropout_p, block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     grid = (bh, tq // block_q, tk // block_k)
@@ -251,8 +273,8 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal, window,
     )
     in_specs = [
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        _kv_spec(block_k, d, nheads, kv_heads),
+        _kv_spec(block_k, d, nheads, kv_heads),
     ]
     inputs = (q, k, v)
     if kvm is not None:
@@ -427,8 +449,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
-              window, scale, dropout_p, block_q, block_k, interpret):
+def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
+              do, causal, window, scale, dropout_p, block_q, block_k,
+              interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -438,8 +461,8 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
 
     dq_in_specs = [
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        _kv_spec(block_k, d, nheads, kv_heads),
+        _kv_spec(block_k, d, nheads, kv_heads),
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -471,8 +494,8 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
 
     dkv_in_specs = [
         _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-        _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=1),
+        _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=1),
         _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
         _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
@@ -514,6 +537,15 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
         ],
         interpret=interpret,
     )(*dkv_inputs)
+    if kv_heads != nheads:
+        # dk/dv came back per Q-head; sum each group onto its shared
+        # K/V head (h is kv-major: head = kv_head * group + g)
+        group = nheads // kv_heads
+        b = bh // nheads
+        dk = dk.reshape(b, kv_heads, group, tk, d).sum(2).reshape(
+            b * kv_heads, tk, d)
+        dv = dv.reshape(b, kv_heads, group, tk, d).sum(2).reshape(
+            b * kv_heads, tk, d)
     return dq, dk, dv
 
 
@@ -522,31 +554,34 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse, do, causal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15, 16))
-def _flash(q, k, v, kvm, qseg, kseg, seed, nheads, causal, window, scale,
-           dropout_p, block_q, block_k, block_q_bwd, block_k_bwd,
-           interpret):
-    o, _ = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal,
-                     window, scale, dropout_p, block_q, block_k, interpret)
+@functools.partial(
+    jax.custom_vjp,
+    nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17))
+def _flash(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
+           window, scale, dropout_p, block_q, block_k, block_q_bwd,
+           block_k_bwd, interpret):
+    o, _ = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads,
+                     causal, window, scale, dropout_p, block_q, block_k,
+                     interpret)
     return o
 
 
-def _flash_fwd(q, k, v, kvm, qseg, kseg, seed, nheads, causal, window,
-               scale, dropout_p, block_q, block_k, block_q_bwd,
+def _flash_fwd(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
+               window, scale, dropout_p, block_q, block_k, block_q_bwd,
                block_k_bwd, interpret):
-    o, lse = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, causal,
-                       window, scale, dropout_p, block_q, block_k,
+    o, lse = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads,
+                       causal, window, scale, dropout_p, block_q, block_k,
                        interpret)
     return o, (q, k, v, kvm, qseg, kseg, seed, o, lse)
 
 
-def _flash_bwd(nheads, causal, window, scale, dropout_p, block_q, block_k,
-               block_q_bwd, block_k_bwd, interpret, res, do):
+def _flash_bwd(nheads, kv_heads, causal, window, scale, dropout_p,
+               block_q, block_k, block_q_bwd, block_k_bwd, interpret, res,
+               do):
     q, k, v, kvm, qseg, kseg, seed, o, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, o, lse,
-                           do, causal, window, scale, dropout_p,
-                           block_q_bwd, block_k_bwd, interpret)
+    dq, dk, dv = _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads,
+                           kv_heads, o, lse, do, causal, window, scale,
+                           dropout_p, block_q_bwd, block_k_bwd, interpret)
     # the keep-mask, segment ids and dropout seed carry no gradients
     return dq, dk, dv, None, None, None, None
 
@@ -594,6 +629,14 @@ def flash_attention(q, k, v, causal: bool = False,
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    h_kv = k.shape[2]
+    if h_kv != h:
+        # GQA/MQA: fewer K/V heads than Q heads; the kernel reads the
+        # shared block via its index map (no head-repeat in HBM)
+        if h % h_kv or v.shape[2] != h_kv:
+            raise ValueError(
+                f"kv heads ({h_kv}, v={v.shape[2]}) must divide q heads "
+                f"({h}) and match each other")
     if scale is None:
         scale = d ** -0.5
     tuned = {}
@@ -630,8 +673,8 @@ def flash_attention(q, k, v, causal: bool = False,
     if interpret is None:
         interpret = _use_interpret()
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h_kv, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h_kv, tk, d)
     kvm = None
     if kv_mask is not None:
         if kv_mask.shape != (b, tk):
@@ -664,7 +707,7 @@ def flash_attention(q, k, v, causal: bool = False,
         ids = segment_ids.astype(jnp.int32)
         qseg = ids.reshape(b, tq, 1)  # q side: lse-layout blocks
         kseg = ids.reshape(b, 1, tq)  # kv side: full-row slice blocks
-    of = _flash(qf, kf, vf, kvm, qseg, kseg, seed, h, causal,
+    of = _flash(qf, kf, vf, kvm, qseg, kseg, seed, h, h_kv, causal,
                 None if window is None else int(window), float(scale),
                 float(dropout_p), block_q, block_k, block_q_bwd,
                 block_k_bwd, interpret)

@@ -315,6 +315,11 @@ def sharded_flash_attention(q, k, v, *, mesh=None, batch_axis="dp",
         enforce(h % axes[head_axis] == 0,
                 "heads %s must divide %s axis size %s", h, head_axis,
                 axes[head_axis])
+        # GQA k/v shard with the same head spec: their (fewer) heads
+        # must divide the axis too, or shard_map fails opaquely inside
+        enforce(k.shape[2] % axes[head_axis] == 0,
+                "kv heads %s must divide %s axis size %s (GQA under "
+                "head sharding)", k.shape[2], head_axis, axes[head_axis])
     tk = k.shape[1]  # key-padding masks cover the KEY sequence
     for name, arr, length in (("kv_mask", kv_mask, tk),
                               ("segment_ids", segment_ids, t)):

@@ -440,3 +440,66 @@ class TestFlashWindow:
         q, k, v = _rand_qkv(t=128)
         with pytest.raises(ValueError, match="window"):
             flash_attention(q, k, v, window=0, interpret=True)
+
+
+class TestFlashGQA:
+    """Grouped-query attention: K/V carry fewer heads; the kernel reads
+    the shared block via its index map (no HBM head-repeat) and dK/dV
+    group-sum onto the shared heads."""
+
+    @pytest.mark.parametrize("h_kv", [1, 2, 4])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_repeated_kv_oracle(self, causal, h_kv):
+        b, t, h, d = 2, 256, 8, 64
+        rng = np.random.default_rng(51)
+        q = jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(b, t, h_kv, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(b, t, h_kv, d)).astype(np.float32))
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = xla_attention(q, k, v, causal=causal)  # oracle repeats kv
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_oracle(self):
+        b, t, h, h_kv, d = 2, 256, 8, 2, 64
+        rng = np.random.default_rng(53)
+        q = jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(b, t, h_kv, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(b, t, h_kv, d)).astype(np.float32))
+        ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+        def f(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    interpret=True) * ct).sum()
+
+        def g(q, k, v):
+            return (xla_attention(q, k, v, causal=True) * ct).sum()
+
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for a, bb, name in zip(gf, gg, "qkv"):
+            assert a.shape == bb.shape
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=5e-4, atol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_gqa_composes_with_window_and_mask(self):
+        b, t, h, h_kv = 2, 256, 4, 2
+        rng = np.random.default_rng(55)
+        q = jnp.asarray(rng.normal(size=(b, t, h, 64)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(b, t, h_kv, 64)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(b, t, h_kv, 64)).astype(np.float32))
+        keep = jnp.asarray(np.arange(t)[None, :]
+                           < np.array([224, 160])[:, None])
+        out = flash_attention(q, k, v, causal=True, window=96,
+                              kv_mask=keep, interpret=True)
+        ref = xla_attention(q, k, v, causal=True, window=96,
+                            mask=keep[:, None, None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rejects_indivisible_heads(self):
+        q = jnp.zeros((1, 128, 6, 64), jnp.float32)
+        k = jnp.zeros((1, 128, 4, 64), jnp.float32)
+        with pytest.raises(ValueError, match="kv heads"):
+            flash_attention(q, k, k, interpret=True)

@@ -180,3 +180,20 @@ def test_flash_window_lowers_to_mosaic(causal):
             q, k, v, causal=causal, window=256, block_q=128, block_k=128,
             interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
     _export_tpu(bwd, q, q, q)
+
+
+def test_flash_gqa_lowers_to_mosaic():
+    """GQA: the kv index-map folding (q-head grid row -> shared kv row)
+    must Mosaic-lower, fwd and bwd."""
+    b, t, h, h_kv, d = 2, 512, 8, 2, 64
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    k = jnp.zeros((b, t, h_kv, d), jnp.bfloat16)
+    fwd = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=False))
+    _export_tpu(fwd, q, k, k)
+
+    bwd = jax.jit(jax.grad(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128,
+            interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    _export_tpu(bwd, q, k, k)

@@ -191,3 +191,35 @@ def test_encoder_attn_window_matches_banded_mask():
     out_ref = enc(x, mask=jnp.asarray(band)[None, None])
     np.testing.assert_allclose(np.asarray(out_w), np.asarray(out_ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_mha_gqa_matches_full_heads_when_shared():
+    """num_kv_heads: GQA projections produce (B, T, h_kv, hd) K/V; with
+    the kv projection REPLICATED across the group the output equals the
+    full-head layer (same math, shared weights)."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    pt.seed(11)
+    mha = nn.MultiHeadAttention(32, 4, num_kv_heads=2).eval()
+    full = nn.MultiHeadAttention(32, 4).eval()
+    # share q/out weights; tile the kv projections across the group
+    full.q_proj.weight, full.q_proj.bias = mha.q_proj.weight, mha.q_proj.bias
+    full.out_proj.weight = mha.out_proj.weight
+    full.out_proj.bias = mha.out_proj.bias
+    hd = 8
+    wk = np.asarray(mha.k_proj.weight).reshape(32, 2, hd)
+    full.k_proj.weight = jnp.asarray(
+        np.repeat(wk, 2, axis=1).reshape(32, 32))
+    full.k_proj.bias = jnp.asarray(np.repeat(
+        np.asarray(mha.k_proj.bias).reshape(2, hd), 2, axis=0).reshape(-1))
+    wv = np.asarray(mha.v_proj.weight).reshape(32, 2, hd)
+    full.v_proj.weight = jnp.asarray(
+        np.repeat(wv, 2, axis=1).reshape(32, 32))
+    full.v_proj.bias = jnp.asarray(np.repeat(
+        np.asarray(mha.v_proj.bias).reshape(2, hd), 2, axis=0).reshape(-1))
+
+    x = jnp.asarray(np.random.default_rng(12).normal(
+        size=(2, 64, 32)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(mha(x)), np.asarray(full(x)),
+                               atol=2e-5, rtol=2e-5)
