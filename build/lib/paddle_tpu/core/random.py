@@ -1,0 +1,55 @@
+"""Global seed / PRNG-key management.
+
+The reference threads integer seeds through programs (``Program.random_seed``,
+reference: python/paddle/fluid/framework.py Program.random_seed; per-op seed
+attrs on dropout/uniform_random). JAX is functional: randomness is an explicit
+key. This module bridges the two — a global seed (settable like the reference)
+from which fresh subkeys are split for eager use, while traced code takes keys
+explicitly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+_lock = threading.Lock()
+_seed: int = 0
+_key: Optional[jax.Array] = None
+_counter: int = 0
+
+
+def seed(s: int) -> None:
+    """Set the global seed (analog of fluid's Program.random_seed)."""
+    global _seed, _key, _counter
+    with _lock:
+        _seed = int(s)
+        _key = jax.random.key(_seed)
+        _counter = 0
+
+
+def get_seed() -> int:
+    return _seed
+
+
+def next_key(n: int = 1):
+    """Split fresh subkey(s) off the global stream (eager-mode use only)."""
+    global _key, _counter
+    with _lock:
+        if _key is None:
+            _key = jax.random.key(_seed)
+        _key, *subs = jax.random.split(_key, n + 1)
+        _counter += n
+    return subs[0] if n == 1 else subs
+
+
+def key_for(name: str, base_key: Optional[jax.Array] = None) -> jax.Array:
+    """Derive a named key deterministically (trace-safe: fold a stable hash of
+    the name into the key). Uses crc32, not Python hash(), so every process /
+    host derives the same key for the same name — required for SPMD."""
+    import zlib
+
+    k = base_key if base_key is not None else jax.random.key(_seed)
+    return jax.random.fold_in(k, zlib.crc32(name.encode()) & 0x7FFFFFFF)

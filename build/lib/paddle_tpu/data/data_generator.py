@@ -1,0 +1,82 @@
+"""MultiSlot data generator — capability parity with the reference's
+dataset-file producer (reference: python/paddle/fluid/incubate/
+data_generator/__init__.py MultiSlotDataGenerator — user subclasses yield
+(slot_name, values) samples; the generator serializes them into the text
+format the C++ DataFeed parses, reference: framework/data_feed.cc
+MultiSlotDataFeed::ParseOneInstance).
+
+The emitted format is exactly what ``paddle_tpu.native.MultiSlotFeed``
+(native/src/datafeed.cc) consumes:
+  one sample per line; for each declared slot: ``<n> v_1 ... v_n``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Iterable, Iterator, List, Sequence, Tuple
+
+from ..core.enforce import enforce
+
+Sample = Sequence[Tuple[str, Sequence]]
+
+
+class MultiSlotDataGenerator:
+    """Subclass and implement ``generate_sample(line)`` returning an
+    iterator of samples, each a list of (slot_name, values) in slot order
+    (reference API: data_generator.__init__ run_from_stdin/run_from_files).
+    """
+
+    def __init__(self):
+        self._slots: List[str] = []
+
+    def set_slots(self, slots: Sequence[str]) -> None:
+        self._slots = list(slots)
+
+    # -- user hook -----------------------------------------------------------
+
+    def generate_sample(self, line: str) -> Iterator[Sample]:
+        raise NotImplementedError
+
+    # -- serialization -------------------------------------------------------
+
+    def _format_sample(self, sample: Sample) -> str:
+        if self._slots:
+            names = [name for name, _ in sample]
+            enforce(names == self._slots,
+                    "sample slots %s != declared %s", names, self._slots)
+        parts = []
+        for _, values in sample:
+            vals = list(values)
+            enforce(len(vals) > 0, "empty slot in sample")
+            parts.append(str(len(vals)))
+            parts.extend(str(v) for v in vals)
+        return " ".join(parts)
+
+    # -- drivers (reference: run_from_stdin / batch file production) ---------
+
+    def run_from_stdin(self) -> None:
+        for line in sys.stdin:
+            for sample in self.generate_sample(line):
+                sys.stdout.write(self._format_sample(sample) + "\n")
+
+    def run_from_files(self, input_files: Sequence[str],
+                       output_file: str) -> int:
+        n = 0
+        with open(output_file, "w") as out:
+            for path in input_files:
+                with open(path) as f:
+                    for line in f:
+                        for sample in self.generate_sample(line):
+                            out.write(self._format_sample(sample) + "\n")
+                            n += 1
+        return n
+
+    def run_from_iterable(self, samples: Iterable[Sample],
+                          output_file: str) -> int:
+        """Write already-built samples (no parse hook needed)."""
+        n = 0
+        with open(output_file, "w") as out:
+            for sample in samples:
+                out.write(self._format_sample(sample) + "\n")
+                n += 1
+        return n

@@ -1,0 +1,223 @@
+"""Datasets — capability analog of paddle.dataset.* (reference:
+python/paddle/dataset/ — mnist, cifar, imdb, wmt14/16, uci_housing, ...).
+
+This environment has no network egress, so loaders follow a two-tier policy:
+real files when present under ``~/.cache/paddle_tpu/dataset`` (same idea as
+the reference's paddle.dataset.common.DATA_HOME download cache), else
+deterministic *synthetic* datasets with the same shapes/dtypes/reader
+contract — sufficient for convergence smoke tests (tests/book analog) and
+benchmarking input pipelines.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Iterator, Tuple
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+# --- MNIST -----------------------------------------------------------------
+
+def _mnist_files(mode: str):
+    base = os.path.join(DATA_HOME, "mnist")
+    imgs = os.path.join(base, f"{mode}-images-idx3-ubyte.gz")
+    lbls = os.path.join(base, f"{mode}-labels-idx1-ubyte.gz")
+    if os.path.exists(imgs) and os.path.exists(lbls):
+        return imgs, lbls
+    return None
+
+
+def _read_idx_images(path):
+    with gzip.open(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    with gzip.open(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data
+
+
+def _synthetic_mnist(n: int, seed: int):
+    """Class-conditional synthetic digits: each class k has a fixed random
+    prototype; samples are noisy prototypes. Linearly separable enough to
+    train real models to high accuracy — the convergence-smoke role of
+    tests/book/test_recognize_digits.py."""
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(0.0, 1.0, (10, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, n).astype(np.int64)
+    noise = rng.normal(0.0, 0.35, (n, 28, 28)).astype(np.float32)
+    images = protos[labels] + noise
+    images = (images - 0.5) / 0.5
+    return images.astype(np.float32), labels
+
+
+def mnist(mode: str = "train", synthetic_size: int = 4096) -> Callable:
+    """Reader creator yielding (image(784,) float32 in [-1,1], label int64).
+    Mirrors paddle.dataset.mnist.train()/test() (reference:
+    python/paddle/dataset/mnist.py)."""
+    files = _mnist_files("train" if mode == "train" else "t10k")
+
+    def reader() -> Iterator[Tuple[np.ndarray, int]]:
+        if files is not None:
+            images = _read_idx_images(files[0]).astype(np.float32)
+            labels = _read_idx_labels(files[1]).astype(np.int64)
+            images = (images / 255.0 - 0.5) / 0.5
+        else:
+            images, labels = _synthetic_mnist(
+                synthetic_size, seed=0 if mode == "train" else 1)
+        for img, lbl in zip(images, labels):
+            yield img.reshape(-1), int(lbl)
+
+    return reader
+
+
+# --- CIFAR-like ------------------------------------------------------------
+
+def cifar10(mode: str = "train", synthetic_size: int = 2048) -> Callable:
+    """(image(3,32,32) float32, label int64) — paddle.dataset.cifar analog."""
+
+    def reader():
+        rng = np.random.default_rng(7 if mode == "train" else 8)
+        protos = rng.uniform(-1, 1, (10, 3, 32, 32)).astype(np.float32)
+        for _ in range(synthetic_size):
+            lbl = int(rng.integers(0, 10))
+            img = protos[lbl] + rng.normal(0, 0.4, (3, 32, 32)).astype(np.float32)
+            yield img, lbl
+
+    return reader
+
+
+# --- ImageNet-shaped synthetic (bench input) -------------------------------
+
+def fake_imagenet(batch_hw: int = 224, num_classes: int = 1000,
+                  size: int = 1024, seed: int = 0) -> Callable:
+    def reader():
+        rng = np.random.default_rng(seed)
+        for _ in range(size):
+            img = rng.normal(0, 1, (3, batch_hw, batch_hw)).astype(np.float32)
+            yield img, int(rng.integers(0, num_classes))
+
+    return reader
+
+
+# --- sequence / NMT-shaped synthetic ---------------------------------------
+
+def synthetic_translation(vocab_size: int = 1000, size: int = 2048,
+                          min_len: int = 4, max_len: int = 30,
+                          seed: int = 0) -> Callable:
+    """(src_ids, trg_ids) variable length — the wmt14 reader contract
+    (reference: python/paddle/dataset/wmt14.py). Target = reversed source
+    (a learnable synthetic task)."""
+
+    def reader():
+        rng = np.random.default_rng(seed)
+        for _ in range(size):
+            n = int(rng.integers(min_len, max_len + 1))
+            src = rng.integers(2, vocab_size, n).astype(np.int64)
+            trg = src[::-1].copy()
+            yield src, trg
+
+    return reader
+
+
+# --- CTR-shaped synthetic (DeepFM input) -----------------------------------
+
+def synthetic_ctr(num_sparse_fields: int = 26, sparse_dim: int = 100000,
+                  num_dense: int = 13, size: int = 4096, seed: int = 0) -> Callable:
+    """(dense(13,), sparse_ids(26,), label) — Criteo-shaped
+    (reference: PS/CTR pipeline, data_feed.cc MultiSlot)."""
+
+    def reader():
+        rng = np.random.default_rng(seed)
+        w_d = rng.normal(0, 1, num_dense)
+        w_s = rng.normal(0, 1, num_sparse_fields)
+        for _ in range(size):
+            dense = rng.normal(0, 1, num_dense).astype(np.float32)
+            sparse = rng.integers(0, sparse_dim, num_sparse_fields)
+            logit = dense @ w_d + ((sparse % 7) - 3) @ w_s * 0.2
+            label = int(logit + rng.normal(0, 1) > 0)
+            yield dense, sparse.astype(np.int64), label
+
+    return reader
+
+
+class MultiSlotDataset:
+    """Dataset-style UX over the native C++ feed (reference:
+    python/paddle/fluid/dataset.py:21 InMemoryDataset/QueueDataset —
+    set_filelist/set_batch_size/set_thread then iterate). Parsing and
+    batching happen in C++ worker threads (paddle_tpu.native)."""
+
+    def __init__(self):
+        self._files = []
+        self._slots = []
+        self._batch_size = 1
+        self._threads = 2
+        self._queue_capacity = 8
+        self._drop_last = True
+
+    def set_filelist(self, files):
+        self._files = list(files)
+        return self
+
+    def set_use_var(self, slots):
+        """slots: [(name, 'u'|'f'), ...] in file order (the reference binds
+        slots to program vars; here names key the yielded dict)."""
+        self._slots = list(slots)
+        return self
+
+    def set_batch_size(self, bs: int):
+        self._batch_size = bs
+        return self
+
+    def set_thread(self, n: int):
+        self._threads = n
+        return self
+
+    def set_queue_capacity(self, n: int):
+        self._queue_capacity = n
+        return self
+
+    def set_drop_last(self, drop: bool):
+        self._drop_last = drop
+        return self
+
+    def __iter__(self):
+        from .. import native
+
+        feed = native.MultiSlotFeed(
+            self._files, self._slots, self._batch_size,
+            num_threads=self._threads, queue_capacity=self._queue_capacity,
+            drop_last=self._drop_last)
+        try:
+            yield from feed
+        finally:
+            feed.close()
+
+
+def train_from_dataset(trainer, dataset: "MultiSlotDataset",
+                       batch_transform, epochs: int = 1,
+                       on_step=None):
+    """Dataset-based training driver — the AsyncExecutor/dataset-training
+    UX (reference: framework/async_executor.h:62 + executor.py
+    train_from_dataset: C++ threads parse+batch while the device trains).
+
+    ``batch_transform(raw)`` maps the feed's {slot: (values, lengths)} dict
+    to the trainer's batch format. Returns the number of steps run."""
+    steps = 0
+    for _ in range(epochs):
+        for raw in dataset:
+            loss, metrics = trainer.train_step(batch_transform(raw))
+            steps += 1
+            if on_step is not None:
+                on_step(steps, loss, metrics)
+    return steps

@@ -1,0 +1,19 @@
+"""``paddle_tpu.dataset`` — the ``paddle.dataset.*`` loader suite
+(reference: python/paddle/dataset/, 14 modules — SURVEY §2 layer 12).
+
+Same module names, same reader-creator contracts (``train()``/``test()``
+return sample generators; vocab helpers return dicts). Loading order per
+module: a cached copy under ``common.DATA_HOME`` if present → otherwise a
+DETERMINISTIC synthetic dataset with the real shapes/dtypes/vocab sizes
+(this environment has no network egress; the download helper explains
+that). Synthetic corpora are class-conditional/learnable so convergence
+smoke tests remain meaningful (tests/book pattern, SURVEY §4).
+"""
+
+from . import (cifar, common, conll05, flowers, image, imdb, imikolov,
+               mnist, movielens, mq2007, sentiment, uci_housing, voc2012,
+               wmt14, wmt16)
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens", "sentiment",
+           "uci_housing", "wmt14", "wmt16", "mq2007", "flowers", "voc2012",
+           "conll05", "image", "common"]

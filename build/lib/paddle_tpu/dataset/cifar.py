@@ -1,0 +1,63 @@
+"""CIFAR-10/100 (reference: python/paddle/dataset/cifar.py — readers yield
+(3072-float image in [0, 1], int label))."""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+
+def _tar_reader(path: str, sub_name: str):
+    def reader():
+        with tarfile.open(path, mode="r") as tf:
+            names = [n for n in tf.getnames() if sub_name in n]
+            for name in names:
+                batch = pickle.load(tf.extractfile(name), encoding="bytes")
+                data = batch[b"data"].astype(np.float32) / 255.0
+                labels = batch.get(b"labels", batch.get(b"fine_labels"))
+                for x, y in zip(data, labels):
+                    yield x, int(y)
+
+    return reader
+
+
+def _synthetic(tag: str, mode: str, num_classes: int, n: int):
+    rng = common.synthetic_rng(f"cifar{tag}", "proto")
+    protos = rng.normal(0.5, 0.25, (num_classes, 3072)).astype(np.float32)
+    rng = common.synthetic_rng(f"cifar{tag}", mode)
+    labels = rng.integers(0, num_classes, n)
+    imgs = protos[labels] + rng.normal(0, 0.1, (n, 3072)).astype(np.float32)
+    imgs = np.clip(imgs, 0, 1).astype(np.float32)
+
+    def reader():
+        for x, y in zip(imgs, labels):
+            yield x, int(y)
+
+    return reader
+
+
+def _make(tag: str, num_classes: int, mode: str, sub: str, n: int):
+    cache = common.cached("cifar", f"cifar-{tag}-python.tar.gz")
+    if cache:
+        return _tar_reader(cache, sub)
+    return _synthetic(tag, mode, num_classes, n)
+
+
+def train10(synthetic_size: int = 4096):
+    return _make("10", 10, "train", "data_batch", synthetic_size)
+
+
+def test10(synthetic_size: int = 1024):
+    return _make("10", 10, "test", "test_batch", synthetic_size)
+
+
+def train100(synthetic_size: int = 4096):
+    return _make("100", 100, "train", "train", synthetic_size)
+
+
+def test100(synthetic_size: int = 1024):
+    return _make("100", 100, "test", "test", synthetic_size)

@@ -1,0 +1,102 @@
+"""Shared dataset plumbing (reference: python/paddle/dataset/common.py —
+DATA_HOME, download, md5file, split, cluster_files_reader)."""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PT_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname: str) -> str:
+    m = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            m.update(chunk)
+    return m.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None = None,
+             save_name: str | None = None) -> str:
+    """Return the cached path if the file exists under DATA_HOME; this
+    environment has no network egress, so a missing file is a typed error
+    telling the user where to place it (the synthetic fallback in each
+    dataset module means training flows never need this)."""
+    from ..core.enforce import EnforceError
+
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(dirname,
+                            save_name or url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            raise EnforceError("cached %s fails md5 check" % filename)
+        return filename
+    raise EnforceError(
+        "no network egress: place %s at %s, or use the module's synthetic "
+        "reader (the default when no cache exists)" % (url, filename))
+
+
+def cached(module_name: str, filename: str) -> str | None:
+    """Path of a cached data file, or None (the synthetic trigger)."""
+    p = os.path.join(DATA_HOME, module_name, filename)
+    return p if os.path.exists(p) else None
+
+
+def split(reader, line_count: int, suffix: str = "%05d.pickle",
+          dumper=None):
+    """reference: common.py split — shard a reader into pickle files."""
+    dumper = dumper or pickle.dump
+    lines, idx, files = [], 0, []
+    for sample in reader():
+        lines.append(sample)
+        if len(lines) == line_count:
+            path = suffix % idx
+            with open(path, "wb") as f:
+                dumper(lines, f)
+            files.append(path)
+            lines, idx = [], idx + 1
+    if lines:
+        path = suffix % idx
+        with open(path, "wb") as f:
+            dumper(lines, f)
+        files.append(path)
+    return files
+
+
+def cluster_files_reader(files_pattern: str, trainer_count: int,
+                         trainer_id: int, loader=None):
+    """reference: common.py cluster_files_reader — each trainer reads its
+    round-robin shard of the file list."""
+    loader = loader or pickle.load
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for i, path in enumerate(flist):
+            if i % trainer_count == trainer_id:
+                with open(path, "rb") as f:
+                    for sample in loader(f):
+                        yield sample
+
+    return reader
+
+
+def synthetic_rng(module: str, mode: str) -> np.random.Generator:
+    """One deterministic stream per (module, mode): synthetic datasets are
+    stable across runs and machines."""
+    seed = int.from_bytes(hashlib.md5(
+        f"{module}:{mode}".encode()).digest()[:4], "little")
+    return np.random.default_rng(seed)
+
+
+def make_vocab(module: str, size: int, special=("<unk>", "<s>", "<e>")):
+    """Deterministic synthetic vocab word->id with the usual specials."""
+    vocab = {w: i for i, w in enumerate(special)}
+    for i in range(size - len(special)):
+        vocab[f"{module}_w{i}"] = len(vocab)
+    return vocab

@@ -1,0 +1,51 @@
+"""CoNLL-2005 semantic role labeling (reference:
+python/paddle/dataset/conll05.py — get_dict(), get_embedding(), test()
+yields (word ids, ctx ids x5, predicate ids, mark, label ids))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_WORD_V = 44068
+_LABEL_V = 59  # B/I/O tags over the role set
+_PRED_V = 3162
+_EMB_DIM = 32
+
+
+def get_dict(word_size: int = _WORD_V, label_size: int = _LABEL_V,
+             pred_size: int = _PRED_V):
+    word_dict = common.make_vocab("conll_w", word_size, special=("<unk>",))
+    verb_dict = common.make_vocab("conll_v", pred_size, special=("<unk>",))
+    label_dict = {f"tag_{i}": i for i in range(label_size)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding(emb_dim: int = _EMB_DIM):
+    rng = common.synthetic_rng("conll05", "emb")
+    return rng.normal(0, 0.1, (_WORD_V, emb_dim)).astype(np.float32)
+
+
+def _synthetic(mode: str, n: int):
+    def reader():
+        rng = common.synthetic_rng("conll05", mode)
+        for _ in range(n):
+            T = int(rng.integers(5, 40))
+            words = rng.integers(1, _WORD_V, T)
+            pred = int(rng.integers(1, _PRED_V))
+            mark_pos = int(rng.integers(0, T))
+            mark = [1 if t == mark_pos else 0 for t in range(T)]
+            # tags correlate with word id parity + predicate distance: a
+            # BiLSTM-CRF can actually fit this
+            labels = [(int(w) + abs(t - mark_pos)) % _LABEL_V
+                      for t, w in enumerate(words)]
+            wl = list(map(int, words))
+            yield (wl, wl, wl, wl, wl, wl,  # word + 5 ctx windows
+                   [pred] * T, mark, labels)
+
+    return reader
+
+
+def test(synthetic_size: int = 512):
+    return _synthetic("test", synthetic_size)

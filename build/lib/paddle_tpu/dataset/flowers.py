@@ -1,0 +1,41 @@
+"""Oxford-102 flowers (reference: python/paddle/dataset/flowers.py —
+train()/test()/valid() yield (3x224x224 float image, int label))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_CLASSES = 102
+
+
+def _synthetic(mode: str, n: int, hw: int):
+    protos = common.synthetic_rng("flowers", "proto").normal(
+        0.5, 0.2, (_CLASSES, 3, 8, 8)).astype(np.float32)
+
+    def reader():
+        rng = common.synthetic_rng("flowers", mode)
+        for _ in range(n):
+            y = int(rng.integers(0, _CLASSES))
+            # upsample the class prototype + noise to (3, hw, hw)
+            img = protos[y].repeat(hw // 8, axis=1).repeat(hw // 8, axis=2)
+            img = img + rng.normal(0, 0.08, img.shape).astype(np.float32)
+            yield np.clip(img, 0, 1).astype(np.float32), y
+
+    return reader
+
+
+def train(mapper=None, buffered_size: int = 1024, use_xmap: bool = True,
+          synthetic_size: int = 512, image_hw: int = 224):
+    return _synthetic("train", synthetic_size, image_hw)
+
+
+def test(mapper=None, buffered_size: int = 1024, use_xmap: bool = True,
+         synthetic_size: int = 128, image_hw: int = 224):
+    return _synthetic("test", synthetic_size, image_hw)
+
+
+def valid(mapper=None, buffered_size: int = 1024, use_xmap: bool = True,
+          synthetic_size: int = 128, image_hw: int = 224):
+    return _synthetic("valid", synthetic_size, image_hw)

@@ -1,0 +1,106 @@
+"""Image preprocessing utilities (reference:
+python/paddle/dataset/image.py — resize_short, center_crop, random_crop,
+left_right_flip, to_chw, simple_transform, load_and_transform). Pure
+numpy; nearest/bilinear resize without cv2."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _ensure_hwc(im: np.ndarray) -> np.ndarray:
+    if im.ndim == 2:
+        return im[:, :, None]
+    return im
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the short edge is ``size`` (bilinear, HWC)."""
+    im = _ensure_hwc(im)
+    h, w = im.shape[:2]
+    short = min(h, w)
+    scale = size / float(short)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    ys = np.clip(np.linspace(0, h - 1, nh), 0, h - 1)
+    xs = np.clip(np.linspace(0, w - 1, nw), 0, w - 1)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    out = (im[y0][:, x0] * (1 - wy) * (1 - wx) +
+           im[y1][:, x0] * wy * (1 - wx) +
+           im[y0][:, x1] * (1 - wy) * wx +
+           im[y1][:, x1] * wy * wx)
+    return out.astype(im.dtype if np.issubdtype(im.dtype, np.floating)
+                      else np.float32)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True):
+    im = _ensure_hwc(im)
+    h, w = im.shape[:2]
+    y = max((h - size) // 2, 0)
+    x = max((w - size) // 2, 0)
+    return im[y:y + size, x:x + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True, rng=None):
+    im = _ensure_hwc(im)
+    rng = rng or np.random.default_rng()
+    h, w = im.shape[:2]
+    y = int(rng.integers(0, max(h - size, 0) + 1))
+    x = int(rng.integers(0, max(w - size, 0) + 1))
+    return im[y:y + size, x:x + size]
+
+
+def left_right_flip(im: np.ndarray, is_color: bool = True):
+    return im[:, ::-1]
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)):
+    return np.transpose(_ensure_hwc(im), order)
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True, mean=None,
+                     rng=None):
+    """resize short edge → (random|center) crop → (train: random flip) →
+    CHW → mean subtract (reference: image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        rng = rng or np.random.default_rng()
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.random() > 0.5:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        im -= mean.reshape(-1, 1, 1) if mean.ndim == 1 else mean
+    return im
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    """Minimal image loader: .npy arrays natively; PNG/JPEG via PIL if it
+    exists in the environment (it is optional by design)."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image  # noqa: WPS433 (optional dependency)
+
+        return np.asarray(Image.open(path).convert(
+            "RGB" if is_color else "L"))
+    except ImportError as e:
+        from ..core.enforce import EnforceError
+
+        raise EnforceError(
+            "no image codec available: save arrays as .npy, or provide "
+            "PIL") from e
+
+
+def load_and_transform(path: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True, mean=None):
+    return simple_transform(load_image(path, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -1,0 +1,44 @@
+"""IMDB sentiment (reference: python/paddle/dataset/imdb.py — word_dict(),
+train(word_idx)/test(word_idx) yield (token-id list, 0/1 label))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_VOCAB = 5149  # reference vocab size after min-freq cutoff
+
+
+def word_dict(vocab_size: int = _VOCAB):
+    return common.make_vocab("imdb", vocab_size)
+
+
+def _synthetic(mode: str, word_idx, n: int):
+    # sentiment signal: positive reviews oversample the first vocab half
+    V = len(word_idx)
+
+    def reader():
+        # fresh stream per invocation: every epoch/iteration replays the
+        # SAME samples (paddle reader-creator contract)
+        rng = common.synthetic_rng("imdb", mode)
+        for _ in range(n):
+            label = int(rng.integers(0, 2))
+            T = int(rng.integers(16, 120))
+            if label:
+                ids = rng.integers(3, 3 + (V - 3) // 2, T)
+            else:
+                ids = rng.integers(3 + (V - 3) // 2, V, T)
+            yield list(map(int, ids)), label
+
+    return reader
+
+
+def train(word_idx=None, synthetic_size: int = 2048):
+    word_idx = word_idx or word_dict()
+    return _synthetic("train", word_idx, synthetic_size)
+
+
+def test(word_idx=None, synthetic_size: int = 512):
+    word_idx = word_idx or word_dict()
+    return _synthetic("test", word_idx, synthetic_size)

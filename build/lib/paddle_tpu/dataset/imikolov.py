@@ -1,0 +1,50 @@
+"""PTB language-model n-grams (reference: python/paddle/dataset/imikolov.py
+— build_dict(), train(word_idx, n)/test(word_idx, n) yield n-gram id
+tuples; data_type NGRAM or SEQ)."""
+
+from __future__ import annotations
+
+from . import common
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+_VOCAB = 2074  # reference dict size at min_word_freq=50
+
+
+def build_dict(min_word_freq: int = 50, vocab_size: int = _VOCAB):
+    return common.make_vocab("imikolov", vocab_size)
+
+
+def _synthetic(mode: str, word_idx, n, data_type, size: int):
+    V = len(word_idx)
+
+    def reader():
+        rng = common.synthetic_rng("imikolov", mode)
+        for _ in range(size):
+            if data_type == DataType.NGRAM:
+                # learnable n-gram: last word = sum of context mod V
+                ctx = rng.integers(3, V, n - 1)
+                tgt = int(ctx.sum() % (V - 3)) + 3
+                yield tuple(map(int, ctx)) + (tgt,)
+            else:
+                T = int(rng.integers(5, 30))
+                seq = rng.integers(3, V, T)
+                yield list(map(int, seq))
+
+    return reader
+
+
+def train(word_idx=None, n: int = 5, data_type=DataType.NGRAM,
+          synthetic_size: int = 4096):
+    word_idx = word_idx or build_dict()
+    return _synthetic("train", word_idx, n, data_type, synthetic_size)
+
+
+def test(word_idx=None, n: int = 5, data_type=DataType.NGRAM,
+         synthetic_size: int = 512):
+    word_idx = word_idx or build_dict()
+    return _synthetic("test", word_idx, n, data_type, synthetic_size)

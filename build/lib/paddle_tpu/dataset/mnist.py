@@ -1,0 +1,59 @@
+"""MNIST (reference: python/paddle/dataset/mnist.py — train()/test()
+yield (784-float image in [-1, 1], int label))."""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+
+def _idx_reader(img_path: str, lbl_path: str):
+    with gzip.open(img_path, "rb") as f:
+        _, n, h, w = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), np.uint8).reshape(n, h * w)
+    with gzip.open(lbl_path, "rb") as f:
+        struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), np.uint8)
+    return images.astype(np.float32) / 127.5 - 1.0, labels.astype(np.int64)
+
+
+def _synthetic(mode: str, n: int):
+    # class-conditional: each digit k is a fixed prototype + noise, so a
+    # classifier genuinely learns (book-test convergence contract)
+    rng = common.synthetic_rng("mnist", "proto")
+    protos = rng.normal(0, 1, (10, 784)).astype(np.float32)
+    rng = common.synthetic_rng("mnist", mode)
+    labels = rng.integers(0, 10, n)
+    images = protos[labels] + rng.normal(0, 0.3, (n, 784)).astype(np.float32)
+    return np.clip(images, -1, 1).astype(np.float32), labels.astype(np.int64)
+
+
+def _reader(mode: str, synthetic_size: int):
+    files = {"train": ("train-images-idx3-ubyte.gz",
+                       "train-labels-idx1-ubyte.gz"),
+             "test": ("t10k-images-idx3-ubyte.gz",
+                      "t10k-labels-idx1-ubyte.gz")}[mode]
+    img = common.cached("mnist", files[0])
+    lbl = common.cached("mnist", files[1])
+
+    def reader():
+        if img and lbl:
+            images, labels = _idx_reader(img, lbl)
+        else:
+            images, labels = _synthetic(mode, synthetic_size)
+        for x, y in zip(images, labels):
+            yield x, int(y)
+
+    return reader
+
+
+def train(synthetic_size: int = 8192):
+    return _reader("train", synthetic_size)
+
+
+def test(synthetic_size: int = 1024):
+    return _reader("test", synthetic_size)

@@ -1,0 +1,85 @@
+"""MovieLens-1M (reference: python/paddle/dataset/movielens.py — train()/
+test() yield (user_id, gender, age, job, movie_id, category ids, title
+ids, rating); plus the id-space helpers the recommender model sizes its
+embeddings with)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_MAX_USER = 6040
+_MAX_MOVIE = 3952
+_MAX_JOB = 20
+_N_CATS = 18
+_TITLE_VOCAB = 5174
+
+
+def max_user_id() -> int:
+    return _MAX_USER
+
+
+def max_movie_id() -> int:
+    return _MAX_MOVIE
+
+
+def max_job_id() -> int:
+    return _MAX_JOB
+
+
+def movie_categories():
+    return {f"cat_{i}": i for i in range(_N_CATS)}
+
+
+def get_movie_title_dict():
+    return common.make_vocab("ml_title", _TITLE_VOCAB, special=("<unk>",))
+
+
+def user_info():
+    rng = common.synthetic_rng("movielens", "user")
+    return {u: {"gender": int(rng.integers(0, 2)),
+                "age": int(rng.integers(0, len(age_table))),
+                "job": int(rng.integers(0, _MAX_JOB))}
+            for u in range(1, _MAX_USER + 1)}
+
+
+def movie_info():
+    rng = common.synthetic_rng("movielens", "movie")
+    return {m: {"categories": list(map(int, rng.integers(0, _N_CATS, 2))),
+                "title": list(map(int, rng.integers(1, _TITLE_VOCAB, 4)))}
+            for m in range(1, _MAX_MOVIE + 1)}
+
+
+def _synthetic(mode: str, n: int):
+    wu = common.synthetic_rng("movielens", "wu").normal(0, 1, _MAX_USER + 1)
+    wm = common.synthetic_rng("movielens", "wm").normal(0, 1, _MAX_MOVIE + 1)
+    users = user_info()
+    movies = movie_info()
+
+    def reader():
+        # fresh stream per invocation (reader-creator contract); user and
+        # movie side features come from the SAME tables user_info()/
+        # movie_info() expose, so joins on those helpers are consistent
+        rng = common.synthetic_rng("movielens", mode)
+        for _ in range(n):
+            u = int(rng.integers(1, _MAX_USER + 1))
+            m = int(rng.integers(1, _MAX_MOVIE + 1))
+            # learnable bilinear preference signal, quantized to 1..5
+            score = wu[u] * wm[m] + 0.1 * rng.normal()
+            rating = float(np.clip(np.round(3 + 1.5 * np.tanh(score)), 1, 5))
+            ui, mi = users[u], movies[m]
+            yield (u, ui["gender"], ui["age"], ui["job"], m,
+                   mi["categories"], mi["title"], rating)
+
+    return reader
+
+
+def train(synthetic_size: int = 4096):
+    return _synthetic("train", synthetic_size)
+
+
+def test(synthetic_size: int = 512):
+    return _synthetic("test", synthetic_size)

@@ -1,0 +1,55 @@
+"""MQ2007 learning-to-rank (reference: python/paddle/dataset/mq2007.py —
+readers yield per-query groups in pointwise/pairwise/listwise form over
+46-dim feature vectors with 0-2 relevance)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_N_FEAT = 46
+
+
+def _synthetic(mode: str, n_queries: int):
+    w = common.synthetic_rng("mq2007", "w").normal(0, 1, _N_FEAT)
+
+    def gen_query(qid):
+        # per-query stream keyed by qid: deterministic on re-iteration
+        rng = common.synthetic_rng("mq2007", f"{mode}:{qid}")
+        docs = int(rng.integers(5, 20))
+        X = rng.normal(0, 1, (docs, _N_FEAT)).astype(np.float32)
+        score = X @ w
+        rel = np.digitize(score, np.quantile(score, [0.5, 0.85]))
+        return X, rel.astype(np.int64)
+
+    return gen_query, n_queries
+
+
+def train(format: str = "pairwise", synthetic_size: int = 256):
+    gen, n = _synthetic("train", synthetic_size)
+    return _format_reader(gen, n, format)
+
+
+def test(format: str = "pairwise", synthetic_size: int = 64):
+    gen, n = _synthetic("test", synthetic_size)
+    return _format_reader(gen, n, format)
+
+
+def _format_reader(gen, n, format: str):
+    def reader():
+        for q in range(n):
+            X, rel = gen(q)
+            if format == "pointwise":
+                for x, r in zip(X, rel):
+                    yield x, int(r)
+            elif format == "pairwise":
+                hi = np.flatnonzero(rel == rel.max())
+                lo = np.flatnonzero(rel == rel.min())
+                for i in hi[:3]:
+                    for j in lo[:3]:
+                        yield X[i], X[j]
+            else:  # listwise
+                yield X, rel
+
+    return reader

@@ -1,0 +1,21 @@
+"""Movie-review sentiment, NLTK-corpus flavor (reference:
+python/paddle/dataset/sentiment.py — get_word_dict(), train()/test()
+yield (token-id list, 0/1 label))."""
+
+from __future__ import annotations
+
+from . import common, imdb
+
+_VOCAB = 2048
+
+
+def get_word_dict(vocab_size: int = _VOCAB):
+    return common.make_vocab("sentiment", vocab_size)
+
+
+def train(synthetic_size: int = 1600):
+    return imdb._synthetic("sent_train", get_word_dict(), synthetic_size)
+
+
+def test(synthetic_size: int = 400):
+    return imdb._synthetic("sent_test", get_word_dict(), synthetic_size)

@@ -1,0 +1,50 @@
+"""UCI Boston housing (reference: python/paddle/dataset/uci_housing.py —
+13 normalized features, float target; 80/20 train/test split)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+
+def _data():
+    cache = common.cached("uci_housing", "housing.data")
+    if cache:
+        raw = np.loadtxt(cache)
+    else:
+        # synthetic linear task with fixed ground-truth weights: fit_a_line
+        # genuinely converges on it (tests/book/test_fit_a_line analog)
+        rng = common.synthetic_rng("uci_housing", "all")
+        X = rng.normal(0, 1, (506, 13))
+        w = common.synthetic_rng("uci_housing", "w").normal(0, 1, 13)
+        y = X @ w + 0.1 * rng.normal(0, 1, 506)
+        raw = np.concatenate([X, y[:, None]], axis=1)
+    feats = raw[:, :-1].astype(np.float32)
+    # feature normalization to [-1, 1] by min/max (reference behavior)
+    fmin, fmax = feats.min(0), feats.max(0)
+    feats = (feats - (fmin + fmax) / 2) / np.maximum(fmax - fmin, 1e-6) * 2
+    target = raw[:, -1:].astype(np.float32)
+    split = int(len(feats) * 0.8)
+    return feats, target, split
+
+
+def train():
+    def reader():
+        feats, target, split = _data()
+        for x, y in zip(feats[:split], target[:split]):
+            yield x, y
+
+    return reader
+
+
+def test():
+    def reader():
+        feats, target, split = _data()
+        for x, y in zip(feats[split:], target[split:]):
+            yield x, y
+
+    return reader

@@ -1,0 +1,42 @@
+"""PASCAL VOC2012 segmentation (reference:
+python/paddle/dataset/voc2012.py — train()/test()/val() yield
+(3xHxW float image, HxW int label mask))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_CLASSES = 21
+
+
+def _synthetic(mode: str, n: int, hw: int):
+    def reader():
+        rng = common.synthetic_rng("voc2012", mode)
+        for _ in range(n):
+            img = rng.normal(0.5, 0.2, (3, hw, hw)).astype(np.float32)
+            mask = np.zeros((hw, hw), np.int64)
+            # a few class rectangles; image channels carry the class signal
+            for _k in range(int(rng.integers(1, 4))):
+                c = int(rng.integers(1, _CLASSES))
+                x0, y0 = rng.integers(0, hw // 2, 2)
+                x1 = int(x0 + rng.integers(hw // 8, hw // 2))
+                y1 = int(y0 + rng.integers(hw // 8, hw // 2))
+                mask[y0:y1, x0:x1] = c
+                img[:, y0:y1, x0:x1] += c / _CLASSES
+            yield np.clip(img, 0, 1.5).astype(np.float32), mask
+
+    return reader
+
+
+def train(synthetic_size: int = 256, image_hw: int = 64):
+    return _synthetic("train", synthetic_size, image_hw)
+
+
+def test(synthetic_size: int = 64, image_hw: int = 64):
+    return _synthetic("test", synthetic_size, image_hw)
+
+
+def val(synthetic_size: int = 64, image_hw: int = 64):
+    return _synthetic("val", synthetic_size, image_hw)

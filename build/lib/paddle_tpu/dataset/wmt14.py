@@ -1,0 +1,41 @@
+"""WMT-14 fr→en (reference: python/paddle/dataset/wmt14.py — train/test
+yield (src ids, trg ids with <s>, trg ids with <e>); get_dict returns
+(src_dict, trg_dict) id→word)."""
+
+from __future__ import annotations
+
+from . import common
+
+UNK, START, END = 2, 0, 1  # reference id layout: <s>=0 <e>=1 <unk>=2
+_SPECIAL = ("<s>", "<e>", "<unk>")
+
+
+def get_dict(dict_size: int = 30000, reverse: bool = False):
+    src = common.make_vocab("wmt14_src", dict_size, special=_SPECIAL)
+    trg = common.make_vocab("wmt14_trg", dict_size, special=_SPECIAL)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _synthetic(mode: str, dict_size: int, n: int):
+    def reader():
+        rng = common.synthetic_rng("wmt14", mode)
+        for _ in range(n):
+            T = int(rng.integers(4, 30))
+            src = rng.integers(3, dict_size, T)
+            # learnable mapping: trg token = (src token + 7) mod vocab
+            trg = (src + 7 - 3) % (dict_size - 3) + 3
+            trg = list(map(int, trg))
+            yield (list(map(int, src)), [START] + trg, trg + [END])
+
+    return reader
+
+
+def train(dict_size: int = 30000, synthetic_size: int = 4096):
+    return _synthetic("train", dict_size, synthetic_size)
+
+
+def test(dict_size: int = 30000, synthetic_size: int = 512):
+    return _synthetic("test", dict_size, synthetic_size)

@@ -1,0 +1,43 @@
+"""WMT-16 de→en, BPE-vocab flavor (reference:
+python/paddle/dataset/wmt16.py — train/test/validation readers +
+get_dict(lang, dict_size))."""
+
+from __future__ import annotations
+
+from . import common
+
+_SPECIAL = ("<s>", "<e>", "<unk>")
+
+
+def get_dict(lang: str = "en", dict_size: int = 10000,
+             reverse: bool = False):
+    d = common.make_vocab(f"wmt16_{lang}", dict_size, special=_SPECIAL)
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def _synthetic(mode: str, src_dict_size: int, trg_dict_size: int, n: int):
+    def reader():
+        rng = common.synthetic_rng("wmt16", mode)
+        for _ in range(n):
+            T = int(rng.integers(4, 30))
+            src = rng.integers(3, src_dict_size, T)
+            trg = (src * 3 + 1 - 3) % (trg_dict_size - 3) + 3
+            trg = list(map(int, trg))
+            yield (list(map(int, src)), [0] + trg, trg + [1])
+
+    return reader
+
+
+def train(src_dict_size: int = 10000, trg_dict_size: int = 10000,
+          src_lang: str = "en", synthetic_size: int = 4096):
+    return _synthetic("train", src_dict_size, trg_dict_size, synthetic_size)
+
+
+def test(src_dict_size: int = 10000, trg_dict_size: int = 10000,
+         src_lang: str = "en", synthetic_size: int = 512):
+    return _synthetic("test", src_dict_size, trg_dict_size, synthetic_size)
+
+
+def validation(src_dict_size: int = 10000, trg_dict_size: int = 10000,
+               src_lang: str = "en", synthetic_size: int = 512):
+    return _synthetic("val", src_dict_size, trg_dict_size, synthetic_size)

@@ -1,0 +1,85 @@
+"""Debug/visualization helpers (reference: python/paddle/fluid/debugger.py
+program pretty-printer, graphviz.py + net_drawer.py dot export).
+
+Works on the static-graph ``Program`` (op/var graph) — the dygraph path is
+plain Python, debuggable directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .static.program import Program, _GradNode, _OpNode
+
+
+def program_to_string(program: Program, with_shapes: bool = True) -> str:
+    """Readable dump of a Program (debugger.py pprint analog)."""
+    lines = [f"Program: {len(program.nodes)} nodes, "
+             f"{len(program.vars)} vars"]
+    lines.append("vars:")
+    for name, v in program.vars.items():
+        kind = "param" if name in program.param_names() else "var"
+        shape = f" shape={tuple(v.shape)}" if with_shapes else ""
+        lines.append(f"  {kind} {name}: dtype={v.dtype}{shape}")
+    lines.append("ops:")
+    for i, node in enumerate(program.nodes):
+        if isinstance(node, _GradNode):
+            lines.append(f"  [{i}] grad(loss={node.loss_name}) -> "
+                         f"{', '.join(node.outputs)}")
+        else:
+            lines.append(f"  [{i}] {node.name}({', '.join(node.inputs)})"
+                         f" -> {', '.join(node.outputs)}")
+    return "\n".join(lines)
+
+
+def print_program(program: Program) -> None:
+    print(program_to_string(program))
+
+
+def program_to_dot(program: Program, graph_name: str = "program") -> str:
+    """Graphviz dot of the op/var dataflow (net_drawer.py / graph_viz_pass
+    analog: op nodes as boxes, var nodes as ellipses)."""
+    lines = [f"digraph {graph_name} {{", "  rankdir=TB;"]
+    params = set(program.param_names())
+    emitted_vars = set()
+
+    def var_node(name):
+        if name in emitted_vars:
+            return
+        emitted_vars.add(name)
+        v = program.vars.get(name)
+        shape = tuple(v.shape) if v is not None else "?"
+        style = ("style=filled, fillcolor=lightblue" if name in params
+                 else "style=solid")
+        lines.append(f'  "v_{name}" [label="{name}\\n{shape}", '
+                     f'shape=ellipse, {style}];')
+
+    for i, node in enumerate(program.nodes):
+        label = ("backward" if isinstance(node, _GradNode)
+                 else node.name)
+        lines.append(f'  "op_{i}" [label="{label}", shape=box, '
+                     f'style=filled, fillcolor=lightgray];')
+        for inp in node.inputs:
+            var_node(inp)
+            lines.append(f'  "v_{inp}" -> "op_{i}";')
+        for out in node.outputs:
+            var_node(out)
+            lines.append(f'  "op_{i}" -> "v_{out}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_program(program: Program, path: str) -> str:
+    """Write dot to ``path``; render to .png alongside if graphviz's `dot`
+    binary exists (net_drawer.py behavior)."""
+    dot = program_to_dot(program)
+    with open(path, "w") as f:
+        f.write(dot)
+    import shutil
+    import subprocess
+
+    if shutil.which("dot"):
+        png = path.rsplit(".", 1)[0] + ".png"
+        subprocess.run(["dot", "-Tpng", path, "-o", png], check=False)
+        return png
+    return path

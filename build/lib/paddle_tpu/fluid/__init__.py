@@ -1,0 +1,319 @@
+"""``paddle_tpu.fluid`` — drop-in namespace for reference users.
+
+``import paddle_tpu.fluid as fluid`` gives the `paddle.fluid` surface
+(reference: python/paddle/fluid/__init__.py + API.spec) wired to the
+TPU-native implementations: Program/Executor from `paddle_tpu.static`,
+layers from `paddle_tpu.layers`, places/mesh from `paddle_tpu.core`, the
+data pipeline from `paddle_tpu.data`. Names whose mechanism was redesigned
+(LoDTensor, PS transpiler, RecordIO) resolve to their documented
+replacements — see PARITY.md / OP_COVERAGE.md for the disposition of every
+reference component.
+
+Coverage against the reference API.spec's `paddle.fluid.*` names is
+asserted by tests/test_fluid_compat.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys as _sys
+
+import jax as _jax
+import jax.numpy as _jnp
+
+import paddle_tpu as _pt
+from .. import clip, initializer, layers, metrics, nets, regularizer
+from .. import data as _data
+from ..core import CPUPlace, TPUPlace
+from ..core import config as _config
+from ..core.enforce import EnforceError as _EnforceError
+from ..install_check import run_check as _run_check
+from ..static import (Executor, Program, Scope, default_main_program,
+                      global_scope, program_guard)
+from ..static import io as _static_io
+from . import (backward, contrib, dygraph, io, optimizer, profiler,
+               transpiler, unique_name)
+
+# submodule aliases so `import paddle_tpu.fluid.layers` etc. resolve
+for _name, _mod in [("layers", layers), ("nets", nets), ("clip", clip),
+                    ("regularizer", regularizer),
+                    ("initializer", initializer), ("metrics", metrics)]:
+    _sys.modules[__name__ + "." + _name] = _mod
+
+# --- places (reference: platform/place.h; TPU is the accelerator here) -----
+CUDAPlace = TPUPlace        # accelerator place: TPU chips, not CUDA devices
+CUDAPinnedPlace = CPUPlace  # host staging; PJRT owns pinned buffers
+
+
+def cpu_places(device_count=None):
+    n = device_count or 1
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places — one per visible TPU device."""
+    ids = device_ids or range(len(_jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+def cuda_pinned_places(device_count=None):
+    return cpu_places(device_count)
+
+
+# --- programs / execution --------------------------------------------------
+def default_startup_program():
+    """The reference splits init ops into a startup program; here
+    initialization happens when the main Program's parameters are created,
+    so the startup program IS the main program's init stage."""
+    return default_main_program()
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    from ..static import executor as _exec
+
+    prev = _exec._global_scope
+    _exec._global_scope = scope
+    try:
+        yield
+    finally:
+        _exec._global_scope = prev
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    """Name prefix for created vars (debugging/viz aid, as in reference
+    framework.py name_scope)."""
+    prog = default_main_program()
+    old = getattr(prog, "_name_prefix", "")
+    prog._name_prefix = old + prefix + "/"
+    try:
+        yield
+    finally:
+        prog._name_prefix = old
+
+
+def in_dygraph_mode() -> bool:
+    """Eager is the default execution model (JAX); static Programs are the
+    opt-in path — the inverse of the reference's default."""
+    return not getattr(dygraph, "_static_forced", False)
+
+
+# --- strategies / compiled program -----------------------------------------
+BuildStrategy = _config.BuildStrategy
+ExecutionStrategy = _config.ExecutionStrategy
+
+
+class CompiledProgram:
+    """reference: compiler.py CompiledProgram — with_data_parallel maps to
+    mesh-sharded compilation (the compiler inserts collectives; SURVEY §7)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self.data_parallel = True
+        self.loss_name = loss_name
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        return self
+
+    def with_inference_optimize(self, config=None):
+        """Inference compilation (reference: compiler.py) — the analysis
+        pipeline's role is XLA AOT; the artifact path is jit.save /
+        static.InferencePredictor."""
+        self.data_parallel = False
+        self.for_inference = True
+        return self
+
+
+class ParallelExecutor:
+    """reference: parallel_executor.py:28 — redesigned as a thin front on
+    parallel.Trainer (pjit over the mesh; compiler-inserted collectives
+    replace the SSA graph + NCCL op handles)."""
+
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None, trainer=None):
+        self.trainer = trainer  # a parallel.Trainer drives execution
+        self.loss_name = loss_name
+        self.program = main_program
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed or feed_dict or {}
+        if self.trainer is not None:
+            return self.trainer.train_step(feed)
+        exe = Executor()
+        return exe.run(self.program, feed=feed, fetch_list=fetch_list,
+                       return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """Scope reuse is XLA buffer donation here; nothing to drop."""
+        return None
+
+
+# --- LoD compatibility (redesigned: padded + lengths, SURVEY §5.7) ---------
+class LoDTensor:
+    """Capability shim: a (dense values, lengths) pair. The TPU-native
+    representation of the reference's LoDTensor (lod_tensor.h:110) is a
+    padded dense array plus a lengths vector (ops.sequence)."""
+
+    def __init__(self, value=None, lengths=None):
+        self._value = None if value is None else _jnp.asarray(value)
+        self._lengths = None if lengths is None else list(lengths)
+
+    def set(self, value, place=None):
+        self._value = _jnp.asarray(value)
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lengths = lengths
+
+    def recursive_sequence_lengths(self):
+        return self._lengths
+
+    def has_valid_recursive_sequence_lengths(self) -> bool:
+        if self._lengths is None or self._value is None:
+            return self._lengths is None
+        import numpy as np
+
+        return int(np.sum(self._lengths[-1])) == int(self._value.shape[0])
+
+    # offset-form LoD accessors (reference lod_tensor.h:229: lod is the
+    # cumulative-offset form of the lengths vector)
+    def lod(self):
+        import numpy as np
+
+        if self._lengths is None:
+            return []
+        return [[0] + list(np.cumsum(lv)) for lv in self._lengths]
+
+    def set_lod(self, lod):
+        import numpy as np
+
+        self._lengths = [list(np.diff(level)) for level in lod]
+
+    def shape(self):
+        return tuple(self._value.shape) if self._value is not None else ()
+
+    def value(self):
+        return self._value
+
+    def __array__(self, dtype=None, copy=None):
+        import numpy as np
+
+        return np.asarray(self._value, dtype)
+
+
+LoDTensorArray = list  # host-side list of LoDTensors (pybind.cc:391 analog)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference: lod_tensor.py create_lod_tensor — here: pad ragged rows
+    to dense + keep lengths."""
+    import numpy as np
+
+    flat = np.asarray(data)
+    t = LoDTensor(flat, recursive_seq_lens)
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    import numpy as np
+
+    total = int(np.sum(recursive_seq_lens[-1]))
+    shape = (total,) + tuple(base_shape)
+    data = np.random.randint(low, high + 1, shape)
+    return create_lod_tensor(data, recursive_seq_lens, place)
+
+
+# --- param attrs -----------------------------------------------------------
+class ParamAttr:
+    """reference: param_attr.py ParamAttr — bundles name/initializer/
+    regularizer/lr for a parameter; consumed by nn layers' create_parameter
+    and static layers."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, gradient_clip=None,
+                 do_model_average=False):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference: param_attr.py WeightNormParamAttr (dim-wise weight
+    normalization on the parameterization)."""
+
+    def __init__(self, dim=None, **kw):
+        super().__init__(**kw)
+        self.dim = dim
+
+
+# --- data ------------------------------------------------------------------
+DataFeeder = _data.DataFeeder
+
+
+class DataFeedDesc:
+    """reference: data_feed_desc.py — config for the native MultiSlot feed
+    (paddle_tpu.native datafeed)."""
+
+    def __init__(self, proto_or_slots=None):
+        self.slots = proto_or_slots or []
+        self.batch_size = 1
+
+    def set_batch_size(self, bs: int):
+        self.batch_size = bs
+
+    def set_use_slots(self, names):
+        self.use_slots = list(names)
+
+    def set_dense_slots(self, names):
+        self.dense_slots = list(names)
+
+    def desc(self):
+        return {"slots": self.slots, "batch_size": self.batch_size}
+
+
+# --- memory passes (XLA owns buffer liveness; kept as no-op API) -----------
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """reference: memory_optimization_transpiler.py — XLA buffer
+    assignment + donation performs this; call is a no-op kept for source
+    compatibility (SURVEY §7 'what XLA obsoletes')."""
+    return input_program
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    return input_program
+
+
+# --- misc ------------------------------------------------------------------
+DistributeTranspiler = transpiler.DistributeTranspiler
+DistributeTranspilerConfig = transpiler.DistributeTranspilerConfig
+
+
+class _RecordIOWriter:
+    def __init__(self, *a, **kw):
+        raise _EnforceError(
+            "RecordIO was dropped by design (SURVEY 'what NOT to rebuild'); "
+            "use data.MultiSlotDataset or array checkpoint formats")
+
+
+recordio_writer = _sys.modules[__name__]  # legacy module name; writer below
+convert_reader_to_recordio_file = _RecordIOWriter
+
+
+def install_check():
+    return _run_check()
+
+
+install_check.run_check = _run_check

@@ -1,0 +1,43 @@
+"""fluid.backward compat (reference: python/paddle/fluid/backward.py:394
+append_backward; :619 calc_gradient — both over the static Program; the
+eager path is jax.grad by construction)."""
+
+from __future__ import annotations
+
+from ..static.program import append_backward
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: backward.py calc_gradient:619 — gradients of ``targets``
+    w.r.t. arbitrary program vars (not just parameters)."""
+    names = [v.name if hasattr(v, "name") else v for v in
+             (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    tlist = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    if target_gradients is None:
+        glist = [None] * len(tlist)
+    else:
+        glist = (list(target_gradients)
+                 if isinstance(target_gradients, (list, tuple))
+                 else [target_gradients])
+        from ..core.enforce import enforce
+
+        enforce(len(glist) == len(tlist),
+                "target_gradients has %s entries for %s targets",
+                len(glist), len(tlist))
+    import jax.numpy as jnp
+
+    weighted = []
+    for t, g in zip(tlist, glist):
+        if g is None:
+            weighted.append(t)
+        else:
+            # d(sum(t*g))/dx == g-weighted vjp of t (reference semantics)
+            weighted.append(t.program.apply(
+                lambda tv, gv: jnp.sum(tv * gv), [t, g],
+                name="weighted_target"))
+    total = weighted[0]
+    for t in weighted[1:]:
+        total = total + t  # summed objective: gradient contributions add
+    pairs = append_backward(total, parameter_list=names)
+    grads = [g for _, g in pairs]
+    return grads if isinstance(inputs, (list, tuple)) else grads[0]

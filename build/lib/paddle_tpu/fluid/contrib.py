@@ -1,0 +1,261 @@
+"""fluid.contrib compat (reference: python/paddle/fluid/contrib/) — the
+contrib surface mapped to the first-class subsystems it matured into here:
+mixed_precision → `paddle_tpu.amp`, slim/quant → `paddle_tpu.quant` +
+`paddle_tpu.slim`, decoder → `ops.decode`, memory_usage →
+`paddle_tpu.utils.memory`."""
+
+from __future__ import annotations
+
+from .. import amp as mixed_precision
+from .. import data as reader          # contrib/reader → data pipeline
+from ..core.enforce import EnforceError
+from ..ops import decode as _decode
+from ..quant import calibrate as _calibrate
+from ..quant import quantize_model as _quantize_model
+from ..slim import Distiller, Pruner
+from ..utils.memory import memory_usage
+
+
+class Compressor:
+    """reference: contrib/slim/core/compressor.py — the contrib-era entry
+    point, kept as a thin front over the real driver
+    (paddle_tpu.slim.Compressor): ``config()`` takes the strategy config
+    (dict or JSON path, slim.build_strategies format), ``run()``
+    delegates the epoch loop."""
+
+    _KNOWN = ("params", "optimizer", "loss_fn", "train_reader", "eval_fn",
+              "epochs", "checkpoint_dir", "converge_delta")
+
+    def __init__(self, params=None, optimizer=None, loss_fn=None,
+                 train_reader=None, eval_fn=None, epochs: int = 1, **kw):
+        unknown = sorted(set(kw) - set(self._KNOWN))
+        if unknown:
+            raise TypeError(
+                f"Compressor got unknown arguments {unknown}; the contrib "
+                f"front takes {list(self._KNOWN)} (see "
+                "paddle_tpu.slim.Compressor)")
+        self._args = dict(params=params, optimizer=optimizer,
+                          loss_fn=loss_fn, train_reader=train_reader,
+                          eval_fn=eval_fn, epochs=epochs, **kw)
+        self._strategies = []
+
+    def config(self, config_or_path):
+        from ..slim import build_strategies
+
+        self._strategies = build_strategies(config_or_path)
+        return self
+
+    def run(self):
+        from ..slim import Compressor as _C
+
+        return _C(strategies=self._strategies, **self._args).run()
+
+
+class Calibrator:
+    """reference: contrib/int8_inference Calibrator — post-training
+    calibration; thin driver over quant.calibrate/freeze."""
+
+    def __init__(self, model=None, **kw):
+        self.model = model
+        self.stats = None
+
+    def sample_data(self, fn, batches):
+        self.stats = _calibrate(fn, batches)
+        return self.stats
+
+    def save_int8_model(self, *a, **kw):
+        from ..quant import freeze
+
+        return freeze(self.stats, *a, **kw)
+
+
+class QuantizeTranspiler:
+    """reference: contrib/quantize/quantize_transpiler.py — program
+    rewriting for QAT; here QAT rewrites Layers (`quant.qat`)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        self.cfg = dict(weight_bits=weight_bits,
+                        activation_bits=activation_bits,
+                        activation_quantize_type=activation_quantize_type,
+                        weight_quantize_type=weight_quantize_type)
+
+    def training_transpile(self, layer, startup_program=None):
+        from ..quant import QuantConfig
+
+        cfg = QuantConfig(weight_bits=self.cfg["weight_bits"],
+                          activation_bits=self.cfg["activation_bits"])
+        return _quantize_model(layer, cfg)
+
+    def freeze_program(self, layer, place=None):
+        from ..quant import freeze
+
+        return freeze(layer)
+
+    def convert_to_int8(self, layer, place=None):
+        """Freeze + materialize int8 weights (reference: contrib/quantize
+        quantize_transpiler convert_to_int8)."""
+        from ..quant import freeze, quantize_to_int
+
+        frozen = freeze(layer)
+        return quantize_to_int(frozen) if not hasattr(frozen, "forward") \
+            else frozen
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """reference: contrib/extend_optimizer — Adam + decoupled decay is
+    first-class as optimizer.AdamW."""
+    from ..optimizer import AdamW
+
+    return AdamW
+
+
+# --- contrib/decoder (beam search framework) -------------------------------
+class InitState:
+    """reference: contrib/decoder/beam_search_decoder.py InitState."""
+
+    def __init__(self, init=None, shape=None, value=0.0, dtype="float32"):
+        import jax.numpy as jnp
+
+        self.state = (jnp.asarray(init) if init is not None
+                      else jnp.full(tuple(shape or ()), value, dtype))
+
+
+class StateCell:
+    """reference: contrib/decoder StateCell — named decode states advanced
+    by a user cell function (functional form: compute_state(inputs,
+    states) -> new states)."""
+
+    def __init__(self, inputs=None, states=None, out_state: str = "h"):
+        self.inputs = inputs or {}
+        self.states = {k: (v.state if isinstance(v, InitState) else v)
+                       for k, v in (states or {}).items()}
+        self.out_state_name = out_state
+        self._fn = None
+
+    def register(self, fn):
+        self._fn = fn
+        return fn
+
+    compute_state = register
+    state_updater = register
+
+    def get_state(self, name):
+        return self.states[name]
+
+    def set_state(self, name, value):
+        self.states[name] = value
+
+    def get_input(self, name):
+        return self.inputs[name]
+
+    def update_states(self, new_states):
+        self.states.update(new_states)
+        return self.states
+
+    def step(self, inputs, states):
+        if self._fn is None:
+            raise EnforceError("StateCell: register a compute function")
+        return self._fn(inputs, states)
+
+    def out_state(self, states=None):
+        return (states or self.states)[self.out_state_name]
+
+
+class TrainingDecoder:
+    """reference: contrib/decoder TrainingDecoder — teacher-forced decode
+    over a StateCell (functional scan form)."""
+
+    def __init__(self, state_cell: StateCell, max_len: int = 100):
+        self.state_cell = state_cell
+        self.max_len = max_len
+
+    def __call__(self, step_inputs):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(states, x_t):
+            new = self.state_cell.step(x_t, states)
+            return new, self.state_cell.out_state(new)
+
+        init = self.state_cell.states
+        _, outs = lax.scan(body, init, step_inputs)
+        return outs
+
+
+class BeamSearchDecoder:
+    """reference: contrib/decoder BeamSearchDecoder — inference-time beam
+    decode over a StateCell, delegating to ops.decode.beam_search."""
+
+    def __init__(self, state_cell: StateCell, *, beam_size: int = 4,
+                 max_len: int = 100, bos_id: int = 0, end_id: int = 1,
+                 length_penalty: float = 0.0):
+        self.state_cell = state_cell
+        self.kw = dict(beam_size=beam_size, max_len=max_len, bos_id=bos_id,
+                       end_id=end_id, length_penalty=length_penalty)
+
+    def decode(self, init_state, step_fn):
+        return _decode.beam_search(init_state, step_fn, **self.kw)
+
+    __call__ = decode
+
+
+# --- PS-era helpers --------------------------------------------------------
+def convert_dist_to_sparse_program(program):
+    raise EnforceError(
+        "sparse PS programs are replaced by parallel.ShardedEmbedding (EP "
+        "all-to-all) — PARITY.md §2.5")
+
+
+def load_persistables_for_increment(dirname, executor, program=None,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """reference: contrib/utils/lookup_table_utils.py — resuming training
+    from a checkpoint is checkpoint.restore_state / CheckpointManager."""
+    from ..checkpoint import restore_state
+
+    return restore_state(dirname)
+
+
+def load_persistables_for_inference(dirname, executor, program=None,
+                                    lookup_table_var_name=None):
+    from ..static.io import load_persistables
+
+    return load_persistables(dirname)
+
+
+def op_freq_statistic(program):
+    """reference: contrib/op_frequence.py — per-op-type frequency count of
+    a static Program (also: tools/op_frequence.py CLI)."""
+    from collections import Counter
+
+    counts = Counter()
+    for node in getattr(program, "_ops", []):
+        counts[getattr(node, "name", type(node).__name__)] += 1
+    return counts
+
+
+class HDFSClient:
+    """Dropped: no HDFS in this environment (PARITY.md §2.7); methods kept
+    for source compatibility, all raising with the replacement pointer."""
+
+    def __init__(self, *a, **kw):
+        raise EnforceError(
+            "HDFS is not available in this environment; checkpoint IO is "
+            "path-pluggable (PARITY.md §2.7)")
+
+    def _na(self, *a, **kw):
+        raise EnforceError("HDFS dropped — checkpoint IO is path-pluggable")
+
+    upload = download = is_exist = is_dir = delete = rename = _na
+    makedirs = ls = lsr = make_local_dirs = _na
+
+
+def multi_download(*a, **kw):
+    raise EnforceError("HDFS transfer utilities dropped — see HDFSClient")
+
+
+def multi_upload(*a, **kw):
+    raise EnforceError("HDFS transfer utilities dropped — see HDFSClient")

@@ -1,0 +1,39 @@
+"""fluid.dygraph compat (reference: python/paddle/fluid/dygraph/ —
+base.py:29 guard, :47 to_variable; layers.py Layer; nn.py layer classes;
+parallel.py:79 DataParallel).
+
+JAX is eager by construction, so ``guard`` is a no-op context and
+``to_variable`` is array conversion; the Layer system is `paddle_tpu.nn`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..checkpoint import restore_state as load_persistables
+from ..checkpoint import save_state as save_persistables
+from ..nn import (GRU, LSTM, NCE, BatchNorm, BilinearTensorProduct, Conv2D,
+                  Conv2DTranspose, Embedding, GroupNorm, GRUCell, HSigmoid,
+                  Layer, LayerList, LayerNorm, Linear, LSTMCell, Parameter,
+                  Pool2D, PRelu, Sequential, SpectralNorm)
+from ..parallel import DataParallel
+
+FC = Linear  # reference dygraph/nn.py FC
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Eager IS the default execution model here; guard is kept as a
+    no-op scope for source compatibility (reference: dygraph/base.py:29)."""
+    yield
+
+
+def to_variable(value, block=None, name=None):
+    """reference: dygraph/base.py:47 — numpy → device array."""
+    return jnp.asarray(value)
+
+
+def enabled() -> bool:
+    return True

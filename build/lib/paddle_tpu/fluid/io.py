@@ -1,0 +1,35 @@
+"""fluid.io compat (reference: python/paddle/fluid/io.py:98-1074 save/load
+family + fluid/reader.py PyReader)."""
+
+from __future__ import annotations
+
+from ..layers import _PyReader as PyReader  # async device feed pipeline
+from ..static.io import load_inference_model as _load_inference_model
+from ..static.io import (load_persistables, save_inference_model,
+                         save_persistables)
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None, pserver_endpoints=None):
+    """fluid signature (reference io.py:1074). The artifact here is
+    self-contained: the executor is accepted and unused; per-file names
+    don't apply (single manifest-v2 directory) and raise if customized so
+    a port doesn't silently load the wrong thing. Returns the predictor."""
+    from ..core.enforce import enforce
+
+    enforce(model_filename is None and params_filename is None,
+            "the serving artifact is a single manifest directory; "
+            "model_filename/params_filename do not apply (got %s/%s)",
+            model_filename, params_filename)
+    enforce(pserver_endpoints is None,
+            "no pserver serving role exists (PARITY.md §2.5); distributed "
+            "serving shards via mesh, got endpoints %s", pserver_endpoints)
+    return _load_inference_model(dirname)
+
+# vars/params granularities collapse onto the same artifact writer: the
+# persistable set IS the param set plus optimizer state in this design
+# (reference io.py:98 save_vars / :228 save_params / :460 save_persistables)
+save_vars = save_persistables
+save_params = save_persistables
+load_vars = load_persistables
+load_params = load_persistables

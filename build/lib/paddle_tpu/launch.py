@@ -1,0 +1,189 @@
+"""One-command multi-process bring-up — ``python -m paddle_tpu.launch``.
+
+Capability equivalent of the reference's distributed launcher
+(reference: python/paddle/distributed/launch.py:1 — spawns one trainer
+process per device, wiring PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS env vars). Here the same env protocol feeds
+``fleet.RoleMaker``; rank 0's endpoint doubles as the JAX coordination
+-service address (the gen_nccl_id successor — reference:
+operators/distributed_ops/gen_nccl_id_op.cc:31).
+
+Usage:
+    python -m paddle_tpu.launch --nproc 2 train.py [script args...]
+
+Behavior:
+- spawns ``nproc`` copies of the script, each with its rank env;
+- rank 0 streams to this process's stdout/stderr, other ranks write
+  ``<log_dir>/workerlog.<rank>`` (reference launcher's log layout);
+- first failure terminates the whole job and replays the failing
+  rank's log tail;
+- exit code = first non-zero worker exit code, else 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def build_worker_env(rank: int, nproc: int, endpoints: List[str],
+                     base_env=None, platform: Optional[str] = None,
+                     local_devices: Optional[int] = None) -> dict:
+    """Env for one worker, RoleMaker's protocol (fleet.py:35): explicit
+    args > PADDLE_* > JAX_* > single-process defaults.
+
+    ``local_devices`` forces N virtual CPU devices per worker (the
+    reference launcher's per-node --gpus analog for the multi-host
+    simulation rig, SURVEY §7 'multi-host test rig without a pod')."""
+    env = dict(os.environ if base_env is None else base_env)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(nproc)
+    env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+    env["JAX_PROCESS_ID"] = str(rank)
+    env["JAX_NUM_PROCESSES"] = str(nproc)
+    env["JAX_COORDINATOR_ADDRESS"] = endpoints[0]
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+        # each process owns its local chip(s); a forced host-device count
+        # would alias the same CPU into every rank
+        env.pop("XLA_FLAGS", None)
+    if local_devices:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={local_devices}"
+        ).strip()
+    return env
+
+
+def launch(script: str, script_args: List[str], *, nproc: int,
+           endpoints: Optional[List[str]] = None,
+           log_dir: str = "launch_logs", platform: Optional[str] = None,
+           timeout: Optional[float] = None,
+           local_devices: Optional[int] = None) -> int:
+    """Spawn the job; returns the job's exit code (0 = all ranks ok)."""
+    if endpoints is None:
+        endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
+    if len(endpoints) != nproc:
+        raise ValueError(
+            f"{len(endpoints)} endpoints for {nproc} processes")
+    os.makedirs(log_dir, exist_ok=True)
+    procs, logs, log_files = [], [], []
+    for rank in range(nproc):
+        env = build_worker_env(rank, nproc, endpoints, platform=platform,
+                               local_devices=local_devices)
+        if rank == 0:
+            out, path = None, None  # inherit: rank 0 streams live
+        else:
+            path = os.path.join(log_dir, f"workerlog.{rank}")
+            out = open(path, "w")
+            log_files.append(out)
+        logs.append(path)
+        procs.append(subprocess.Popen(
+            [sys.executable, script, *script_args], env=env,
+            stdout=out, stderr=subprocess.STDOUT if out else None))
+
+    deadline = time.time() + timeout if timeout else None
+    rc = 0
+    try:
+        pending = set(range(nproc))
+        while pending:
+            for rank in sorted(pending):
+                p = procs[rank]
+                code = p.poll()
+                if code is None:
+                    continue
+                pending.discard(rank)
+                if code != 0 and rc == 0:
+                    rc = code
+                    print(f"[launch] rank {rank} exited with {code}; "
+                          "terminating job", file=sys.stderr)
+                    if logs[rank]:
+                        _replay_tail(logs[rank], rank)
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+            if deadline and time.time() > deadline and pending:
+                print(f"[launch] timeout after {timeout}s; terminating "
+                      f"ranks {sorted(pending)}", file=sys.stderr)
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+                rc = rc or 124
+                break
+            time.sleep(0.05)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        raise
+    finally:
+        for f in log_files:
+            f.close()
+    return rc
+
+
+def _replay_tail(path: str, rank: int, n: int = 40) -> None:
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+        print(f"[launch] last {min(n, len(lines))} lines of rank {rank} "
+              f"({path}):", file=sys.stderr)
+        for line in lines[-n:]:
+            print(f"  [rank {rank}] {line}", file=sys.stderr)
+    except OSError:
+        pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.launch",
+        description="multi-process distributed launcher (reference: "
+                    "python -m paddle.distributed.launch)")
+    ap.add_argument("--nproc", type=int, default=1,
+                    help="number of worker processes (trainers)")
+    ap.add_argument("--endpoints", default=None,
+                    help="comma-separated host:port per rank (default: "
+                    "free local ports; rank 0 = coordinator)")
+    ap.add_argument("--log-dir", default="launch_logs",
+                    help="directory for workerlog.<rank> files (rank 0 "
+                    "streams to this terminal)")
+    ap.add_argument("--platform", default=None,
+                    help="force JAX_PLATFORMS in workers (e.g. cpu for "
+                    "multi-process simulation on one host)")
+    ap.add_argument("--local-devices", type=int, default=None,
+                    help="force N virtual CPU devices per worker (the "
+                    "multi-host simulation rig; per-node --gpus analog)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="kill the job after this many seconds")
+    ap.add_argument("script", help="training script to run per rank")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="arguments passed through to the script")
+    args = ap.parse_args(argv)
+    endpoints = (args.endpoints.split(",") if args.endpoints else None)
+    return launch(args.script, args.script_args, nproc=args.nproc,
+                  endpoints=endpoints, log_dir=args.log_dir,
+                  platform=args.platform, timeout=args.timeout,
+                  local_devices=args.local_devices)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

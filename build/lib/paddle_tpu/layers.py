@@ -1,0 +1,864 @@
+"""``paddle_tpu.layers`` — the complete ``fluid.layers`` user surface
+(reference: python/paddle/fluid/layers/ + API.spec `paddle.fluid.layers.*`,
+278 public names) as ONE flat, eager, functional namespace.
+
+A reference user types ``fluid.layers.<name>``; every one of those names
+resolves here to the TPU-native equivalent: most re-export the functional
+op library (`paddle_tpu.ops.*`), LR-decay names construct scheduler objects
+(`paddle_tpu.optimizer`), reader names map to the data pipeline
+(`paddle_tpu.data`), and static-graph var helpers target the current
+default Program when inside ``static.program_guard``. Coverage against the
+reference's frozen API.spec is asserted by tests/test_layers_compat.py.
+
+Dygraph-style layers with managed parameters live in ``paddle_tpu.nn``;
+Program-recording static layers in ``paddle_tpu.static.layers``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional as _Optional, Sequence as _Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import data as _data
+from . import initializer as _I
+from . import metrics as _metrics
+from . import optimizer as _opt
+from .ops import control_flow as _CF
+from .ops import decode as _DE
+from .ops import detection as _D
+from .ops import detection_extra as _DX
+from .ops import loss as _L
+from .ops import math as _M
+from .ops import nn as _N
+from .ops import nn_extra as _NE
+from .ops import reduction as _R
+from .ops import rnn as _RN
+from .ops import sampling as _SA
+from .ops import sequence as _SQ
+from .ops import tensor as _T
+
+# --- activations & elementwise math (ops.math) -----------------------------
+abs = _M.abs
+acos = _M.acos
+asin = _M.asin
+atan = _M.atan
+brelu = _M.brelu
+ceil = _M.ceil
+clip = _M.clip
+clip_by_norm = _M.clip_by_norm
+cos = _M.cos
+cos_sim = _M.cos_sim
+cumsum = _M.cumsum
+elementwise_add = _M.elementwise_add
+elementwise_div = _M.elementwise_div
+elementwise_floordiv = _M.elementwise_floordiv
+elementwise_max = _M.elementwise_max
+elementwise_min = _M.elementwise_min
+elementwise_mod = _M.elementwise_mod
+elementwise_mul = _M.elementwise_mul
+elementwise_pow = _M.elementwise_pow
+elementwise_sub = _M.elementwise_sub
+elu = _M.elu
+exp = _M.exp
+floor = _M.floor
+hard_shrink = _M.hard_shrink
+hard_sigmoid = _M.hard_sigmoid
+has_inf = _M.has_inf
+has_nan = _M.has_nan
+increment = _M.increment
+isfinite = _M.isfinite
+leaky_relu = _M.leaky_relu
+log = _M.log
+logsigmoid = _M.logsigmoid
+matmul = _M.matmul
+maxout = _M.maxout
+mul = _M.mul
+pow = _M.pow
+prelu = _M.prelu
+reciprocal = _M.reciprocal
+relu = _M.relu
+relu6 = _M.relu6
+round = _M.round
+rsqrt = _M.rsqrt
+scale = _M.scale
+selu = _M.selu
+sigmoid = _M.sigmoid
+sign = _M.sign
+sin = _M.sin
+soft_relu = _M.soft_relu
+softplus = _M.softplus
+softshrink = _M.softshrink
+softsign = _M.softsign
+sqrt = _M.sqrt
+square = _M.square
+stanh = _M.stanh
+swish = _M.swish
+tanh = _M.tanh
+tanh_shrink = _M.tanh_shrink
+thresholded_relu = _M.thresholded_relu
+bilinear_tensor_product = _M.bilinear_tensor_product
+
+# --- reductions ------------------------------------------------------------
+mean = _R.mean
+reduce_all = _R.reduce_all
+reduce_any = _R.reduce_any
+reduce_max = _R.reduce_max
+reduce_mean = _R.reduce_mean
+reduce_min = _R.reduce_min
+reduce_prod = _R.reduce_prod
+reduce_sum = _R.reduce_sum
+sum = _R.sum
+sums = _R.sum  # pre-1.0 name for elementwise list sum
+
+# --- NN ops ----------------------------------------------------------------
+adaptive_pool2d = _N.adaptive_pool2d
+adaptive_pool3d = _NE.adaptive_pool3d
+batch_norm = _N.batch_norm
+conv2d = _N.conv2d
+conv2d_transpose = _NE.conv2d_transpose
+conv3d = _N.conv3d
+conv3d_transpose = _NE.conv3d_transpose
+data_norm = _NE.data_norm
+dropout = _N.dropout
+embedding = _N.embedding
+grid_sampler = _N.grid_sampler
+group_norm = _N.group_norm
+l2_normalize = _N.l2_normalize
+layer_norm = _N.layer_norm
+lrn = _N.lrn
+one_hot = _N.one_hot
+pad2d = _N.pad2d
+pixel_shuffle = _N.pixel_shuffle
+pool2d = _N.pool2d
+pool3d = _NE.pool3d
+shuffle_channel = _N.shuffle_channel
+softmax = _N.softmax
+space_to_depth = _N.space_to_depth
+temporal_shift = _N.temporal_shift
+affine_channel = _NE.affine_channel
+affine_grid = _NE.affine_grid
+fsp_matrix = _NE.fsp_matrix
+similarity_focus = _NE.similarity_focus
+tree_conv = _NE.tree_conv
+continuous_value_model = _NE.cvm
+resize_bilinear = _NE.bilinear_interp
+resize_nearest = _NE.nearest_interp
+image_resize_short = _NE.image_resize_short
+
+
+def image_resize(input, out_shape, resample: str = "BILINEAR"):
+    """reference: layers/nn.py image_resize (BILINEAR/NEAREST)."""
+    method = {"BILINEAR": "bilinear", "NEAREST": "nearest"}.get(
+        resample.upper(), resample.lower())
+    return _N.interpolate(input, tuple(out_shape), method=method)
+
+
+def spectral_norm(weight, dim: int = 0, power_iters: int = 1,
+                  eps: float = 1e-12):
+    """Functional one-shot form; the u/v power-iteration state lives in
+    nn.SpectralNorm for training (reference: layers/nn.py spectral_norm)."""
+    h = weight.shape[dim]
+    wmat = jnp.moveaxis(weight, dim, 0).reshape(h, -1)
+    u = jax.random.normal(jax.random.key(0), (h,), weight.dtype)
+    v = jax.random.normal(jax.random.key(1), (wmat.shape[1],), weight.dtype)
+    out, _, _ = _NE.spectral_norm(weight, u, v, dim=dim,
+                                  power_iters=max(power_iters, 8), eps=eps)
+    return out
+
+
+# --- losses ----------------------------------------------------------------
+bpr_loss = _L.bpr_loss
+cross_entropy = _L.cross_entropy
+dice_loss = _L.dice_loss
+huber_loss = _L.huber_loss
+kldiv_loss = _L.kldiv_loss
+label_smooth = _L.label_smooth
+log_loss = _L.log_loss
+margin_rank_loss = _L.margin_rank_loss
+npair_loss = _L.npair_loss
+rank_loss = _L.rank_loss
+sampled_softmax_with_cross_entropy = _L.sampled_softmax_with_cross_entropy
+sigmoid_cross_entropy_with_logits = _L.sigmoid_cross_entropy_with_logits
+smooth_l1 = _L.smooth_l1
+softmax_with_cross_entropy = _L.softmax_with_cross_entropy
+square_error_cost = _L.square_error_cost
+teacher_student_sigmoid_loss = _L.teacher_student_sigmoid_loss
+warpctc = _DE.ctc_loss
+
+# --- sampling heads --------------------------------------------------------
+hsigmoid = _SA.hsigmoid_loss
+nce = _SA.nce_loss
+sampling_id = _SA.sampling_id
+
+# --- decode / CRF ----------------------------------------------------------
+beam_search = _DE.beam_search
+beam_search_decode = _DE.beam_search_decode
+beam_search_step = _DE.beam_search_batch_step
+beam_search_decode_lod = _DE.beam_search_decode_lod
+gather_beams = _DE.gather_beams
+crf_decoding = _DE.crf_decoding
+ctc_greedy_decoder = _DE.ctc_greedy_decode
+edit_distance = _DE.edit_distance
+linear_chain_crf = _DE.linear_chain_crf
+
+# --- tensor manipulation ---------------------------------------------------
+argmax = _T.arg_max
+argmin = _T.arg_min
+argsort = _T.argsort
+assign = _T.assign
+cast = _T.cast
+concat = _T.concat
+crop = _T.crop
+diag = _T.diag
+expand = _T.expand
+def fill_constant(shape, dtype=None, value=0.0, force_cpu=False, out=None):
+    """Static mode (inside program_guard) records a Program var — the
+    block-DSL's loop counters/conditions need Var identity; eager mode
+    returns the array (reference: layers/tensor.py fill_constant)."""
+    from .static.program import is_building
+
+    if out is not None or is_building():
+        from .static import layers as _SL
+
+        return _SL.fill_constant(shape, dtype or "float32", value,
+                                 force_cpu=force_cpu, out=out)
+    return _T.fill_constant(shape, value, dtype or jnp.float32)
+
+
+fill_constant_batch_size_like = _T.fill_constant_batch_size_like
+flatten = _T.flatten
+gather = _T.gather
+gaussian_random = _T.gaussian_random
+is_empty = _T.is_empty
+linspace = _T.linspace
+multiplex = _T.multiplex
+ones = _T.ones
+pad = _T.pad
+pad_constant_like = _T.pad_constant_like
+random_crop = _T.random_crop
+range = _T.arange
+reshape = _T.reshape
+reverse = _T.reverse
+scatter = _T.scatter
+shape = _T.shape
+slice = _T.slice
+split = _T.split
+squeeze = _T.squeeze
+stack = _T.stack
+topk = _T.top_k
+transpose = _T.transpose
+uniform_random = _T.uniform_random
+unsqueeze = _T.unsqueeze
+unstack = _T.unstack
+where = _T.where_index
+def zeros(shape, dtype="float32", force_cpu=False):
+    from .static.program import is_building
+
+    if is_building():
+        from .static import layers as _SL
+
+        return _SL.zeros(shape, dtype, force_cpu)
+    return _T.zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def rank(x):
+    """reference: layers/nn.py rank — ndim as a 0-d int tensor."""
+    return jnp.asarray(jnp.ndim(x), jnp.int32)
+
+
+def gaussian_random_batch_size_like(input, shape, mean: float = 0.0,
+                                    std: float = 1.0, seed: int = 0):
+    shp = (input.shape[0],) + tuple(shape[1:])
+    return _T.gaussian_random(shp, mean=mean, std=std, seed=seed)
+
+
+def uniform_random_batch_size_like(input, shape, min: float = -1.0,
+                                   max: float = 1.0, seed: int = 0):
+    shp = (input.shape[0],) + tuple(shape[1:])
+    return _T.uniform_random(shp, min=min, max=max, seed=seed)
+
+
+# --- compare / logical / control flow --------------------------------------
+equal = _CF.equal
+greater_equal = _CF.greater_equal
+greater_than = _CF.greater_than
+less_equal = _CF.less_equal
+less_than = _CF.less_than
+logical_and = _CF.logical_and
+logical_not = _CF.logical_not
+logical_or = _CF.logical_or
+logical_xor = _CF.logical_xor
+not_equal = _CF.not_equal
+
+# Block-style control flow: the reference's recording block DSL (static
+# Programs — static/control_flow.py lowers the recorded body to
+# lax.while_loop/scan), with a __new__ escape to the functional
+# lax-backed forms for eager callers (SURVEY §2.2 control flow):
+from .static import control_flow as _SCF  # noqa: E402
+
+
+class Switch(_SCF.Switch):
+    """``with Switch() as s: with s.case(cond): ...`` in static mode
+    (reference: layers/control_flow.py Switch — first-match case chain);
+    ``Switch(branch_index, branch_fns, *ops)`` runs the functional
+    lax.switch form."""
+
+    def __new__(cls, *args, **kwargs):
+        if args and not isinstance(args[0], str):
+            return _CF.switch_case(*args, **kwargs)
+        return super().__new__(cls)
+
+
+class While(_SCF.While):
+    """``While(cond_var)`` + ``with w.block():`` in static mode
+    (reference: layers/control_flow.py:593); ``While(cond_fn, body_fn,
+    loop_vars)`` runs the functional lax.while_loop form."""
+
+    def __new__(cls, cond, *args, **kwargs):
+        from .static.program import Var as _Var
+
+        if isinstance(cond, _Var) and not args:
+            return super().__new__(cls)
+        return _CF.while_loop(cond, *args, **kwargs)
+
+
+class IfElse(_SCF.IfElse):
+    """``IfElse(cond_var)`` + true_block()/false_block() in static mode
+    (reference: layers/control_flow.py:1489); ``IfElse(pred, true_fn,
+    false_fn, *ops)`` runs the functional lax.cond form."""
+
+    def __new__(cls, cond, *args, **kwargs):
+        from .static.program import Var as _Var
+
+        if isinstance(cond, _Var) and not args:
+            return super().__new__(cls)
+        return _CF.cond(cond, *args, **kwargs)
+
+
+class StaticRNN(_SCF.StaticRNN):
+    """No-arg construction opens the recording block DSL (reference:
+    layers/control_flow.py:268); a callable first arg runs the functional
+    scan form ``static_rnn(cell_fn, ...)``."""
+
+    def __new__(cls, *args, **kwargs):
+        if args and callable(args[0]):
+            return _CF.static_rnn(*args, **kwargs)
+        return super().__new__(cls)
+
+
+class DynamicRNN(_SCF.DynamicRNN):
+    """No-arg construction opens the recording block DSL (reference:
+    layers/control_flow.py:1619); a callable first arg runs the
+    functional masked-scan form ``dynamic_rnn(cell_fn, x, init, ...)``."""
+
+    def __new__(cls, *args, **kwargs):
+        if args and callable(args[0]):
+            return _RN.dynamic_rnn(*args, **kwargs)
+        return super().__new__(cls)
+
+
+def Print(input, message: str = "", summarize: int = 20, **_kw):
+    """reference: layers/control_flow.py Print — jit-compatible tensor
+    print; returns its input so it composes inside traced code."""
+    # jax.debug.print's format parser mishandles escaped braces; a plain
+    # callback prints arbitrary user messages safely
+    jax.debug.callback(lambda v, _m=message: print(_m + str(v)), input)
+    return input
+
+
+# --- TensorArray interface -------------------------------------------------
+class _EagerArray:
+    """Growable host-side tensor array for eager loops (reference:
+    layers/control_flow.py create_array / tensor_array ops). Inside jit
+    use ops.control_flow.TensorArray (static capacity, lax-friendly)."""
+
+    def __init__(self, dtype="float32"):
+        self.dtype, self._items = dtype, []
+
+    def write(self, i, x):
+        i = int(i)
+        self._items.extend([None] * (i + 1 - len(self._items)))
+        self._items[i] = jnp.asarray(x)
+        return self
+
+    def read(self, i):
+        return self._items[int(i)]
+
+    def length(self):
+        return jnp.asarray(len(self._items))
+
+    def stack(self, axis: int = 0):
+        return jnp.stack(self._items, axis=axis)
+
+
+def create_array(dtype="float32", capacity: int = 64):
+    from .static.program import is_building
+
+    if is_building():
+        from .static import layers as _SL
+
+        return _SL.create_array(dtype, capacity)
+    return _EagerArray(dtype)
+
+
+def array_write(x, i, array=None, capacity: int = 64):
+    from .static.layers import StaticArray
+    from .static.program import Var as _Var, is_building
+
+    if isinstance(array, StaticArray) or isinstance(x, _Var) or \
+            is_building():
+        from .static import layers as _SL
+
+        return _SL.array_write(x, i, array, capacity)
+    if array is None:
+        array = create_array(x.dtype)
+    return array.write(i, x)
+
+
+def array_read(array, i):
+    from .static.layers import StaticArray
+
+    if isinstance(array, StaticArray):
+        from .static import layers as _SL
+
+        return _SL.array_read(array, i)
+    return array.read(i)
+
+
+def array_length(array):
+    from .static.layers import StaticArray
+
+    if isinstance(array, StaticArray):
+        from .static import layers as _SL
+
+        return _SL.array_length(array)
+    return array.length()
+
+
+def tensor_array_to_tensor(array, axis: int = 0):
+    from .static.layers import StaticArray
+
+    if isinstance(array, StaticArray):
+        from .static import layers as _SL
+
+        return _SL.tensor_array_to_tensor(array, axis)
+    stacked = array.stack()
+    return stacked, jnp.asarray(stacked.shape[axis])
+
+
+# --- sequence ops (padded + lengths; SURVEY §5.7) --------------------------
+add_position_encoding = _SQ.add_position_encoding
+hash = _SQ.hash_embedding_ids
+im2sequence = _SQ.im2sequence
+sequence_concat = _SQ.sequence_concat
+sequence_enumerate = _SQ.sequence_enumerate
+sequence_expand = _SQ.sequence_expand
+sequence_expand_as = _SQ.sequence_expand_as
+sequence_mask = _SQ.sequence_mask
+sequence_pad = _SQ.sequence_pad
+sequence_pool = _SQ.sequence_pool
+sequence_reshape = _SQ.sequence_reshape
+sequence_reverse = _SQ.sequence_reverse
+sequence_scatter = _SQ.sequence_scatter
+sequence_slice = _SQ.sequence_slice
+sequence_softmax = _SQ.sequence_softmax
+sequence_unpad = _SQ.sequence_unpad
+sequence_conv = _RN.sequence_conv
+row_conv = _RN.row_conv
+
+
+def sequence_first_step(x, lengths=None):
+    return _SQ.sequence_pool(x, lengths, pool_type="first")
+
+
+def sequence_last_step(x, lengths=None):
+    return _SQ.sequence_pool(x, lengths, pool_type="last")
+
+
+def lod_reset(x, lengths):
+    """LoD → lengths-vector design: 'resetting the LoD' is just pairing
+    the data with a new lengths vector (SURVEY §7 LoD replacement)."""
+    return x, jnp.asarray(lengths)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference: operators/reorder_lod_tensor_by_rank_op.cc — permute the
+    batch by a rank table (descending-length order). rank_table: the
+    permutation indices (e.g. jnp.argsort(-lengths))."""
+    return jnp.take(x, jnp.asarray(rank_table), axis=0)
+
+
+# SelectedRows existed for sparse gradients; grads are dense here and giant
+# tables shard via parallel.ShardedEmbedding (OP_COVERAGE.md):
+def get_tensor_from_selected_rows(x):
+    return x
+
+
+def merge_selected_rows(x):
+    return x
+
+
+# --- RNN -------------------------------------------------------------------
+dynamic_gru = _RN.gru
+dynamic_lstm = _RN.lstm
+dynamic_lstmp = _RN.lstmp
+gru_unit = _RN.gru_unit
+lstm = _RN.lstm
+lstm_unit = _RN.lstm_unit
+
+# --- detection -------------------------------------------------------------
+anchor_generator = _D.anchor_generator
+bipartite_match = _D.bipartite_match
+box_clip = _D.box_clip
+box_coder = _D.box_coder
+box_decoder_and_assign = _DX.box_decoder_and_assign
+collect_fpn_proposals = _D.collect_fpn_proposals
+density_prior_box = _D.density_prior_box
+detection_output = _D.detection_output
+distribute_fpn_proposals = _D.distribute_fpn_proposals
+generate_mask_labels = _DX.generate_mask_labels
+generate_proposal_labels = _DX.generate_proposal_labels
+generate_proposals = _D.generate_proposals
+iou_similarity = _D.iou_similarity
+from .nn.layers import MultiBoxHead as multi_box_head  # noqa: E402
+multiclass_nms = _D.multiclass_nms
+polygon_box_transform = _D.polygon_box_transform
+prior_box = _D.prior_box
+psroi_pool = _DX.psroi_pool
+roi_align = _D.roi_align
+roi_perspective_transform = _DX.roi_perspective_transform
+roi_pool = _D.roi_pool
+rpn_target_assign = _DX.rpn_target_assign
+ssd_loss = _D.ssd_loss
+target_assign = _D.target_assign
+yolo_box = _D.yolo_box
+yolov3_loss = _DX.yolov3_loss
+
+def fc(input, size: _Optional[int] = None, weight=None, bias=None,
+       act: _Optional[str] = None, name: str = "fc", **kw):
+    """reference: layers/nn.py fc:210. Eager form takes explicit weight
+    (nn.Linear owns managed params); inside static.program_guard it
+    records onto the current Program like fluid's fc."""
+    from .static import program as _prog_mod
+
+    if weight is None:
+        from .static import layers as _SL
+
+        return _SL.fc(input, size, act=act, name=name, **kw)
+    out = jnp.matmul(input, weight)
+    if bias is not None:
+        out = out + bias
+    if act is not None:
+        out = getattr(_M, act)(out)
+    return out
+
+
+# --- metrics ---------------------------------------------------------------
+accuracy = _metrics.accuracy
+auc = _metrics.auc_terms
+chunk_eval = _metrics.chunk_eval
+detection_map = _metrics.detection_map
+mean_iou = _metrics.mean_iou
+
+# --- LR schedules (reference: layers/learning_rate_scheduler.py) -----------
+# fluid's decay layers emit a lr Variable; the TPU-native form returns a
+# scheduler object every paddle_tpu optimizer accepts as learning_rate.
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return _opt.CosineDecay(learning_rate, step_each_epoch, epochs)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase: bool = False):
+    return _opt.ExponentialDecay(learning_rate, decay_steps, decay_rate,
+                                 staircase)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase: bool = False):
+    return _opt.InverseTimeDecay(learning_rate, decay_steps, decay_rate,
+                                 staircase)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase: bool = False):
+    return _opt.NaturalExpDecay(learning_rate, decay_steps, decay_rate,
+                                staircase)
+
+
+def noam_decay(d_model, warmup_steps):
+    return _opt.NoamDecay(d_model, warmup_steps)
+
+
+def piecewise_decay(boundaries, values):
+    return _opt.PiecewiseDecay(boundaries, values)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    return _opt.PolynomialDecay(learning_rate, decay_steps,
+                                end_learning_rate, power, cycle)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    return _opt.LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# --- data / reader layer (reference: layers/io.py) -------------------------
+batch = _data.batch
+shuffle = _data.shuffle
+double_buffer = _data.buffered
+
+
+def data(name: str, shape, dtype=None, lod_level: int = 0):
+    """Declare a feed var on the current default static Program
+    (reference: layers/io.py data). Inside dygraph/eager code, arrays are
+    passed directly and this is not needed."""
+    from .static import default_main_program
+
+    return default_main_program().data(name, shape, dtype,
+                                       lod_level=lod_level)
+
+
+class _PyReader:
+    """reference: fluid/layers/io.py py_reader / fluid/reader.py PyReader —
+    decorate with a batch source, then iterate device-resident batches
+    (data.DeviceLoader is the async host→device double-buffer)."""
+
+    def __init__(self, capacity: int):
+        self.capacity, self.loader = capacity, None
+
+    def decorate(self, batches, transform=None, sharding=None):
+        self.loader = _data.DeviceLoader(batches, transform, sharding,
+                                         capacity=self.capacity)
+        return self.loader
+
+    decorate_sample_list_generator = decorate
+    decorate_batch_generator = decorate
+    decorate_sample_generator = decorate
+
+    def start(self):
+        """reference: reader.py PyReader.start — arm the pipeline; the
+        DeviceLoader starts its prefetch thread on iteration."""
+        return self
+
+    def reset(self):
+        """reference: PyReader.reset — drop buffered batches so the next
+        epoch re-iterates the source."""
+        if self.loader is not None and hasattr(self.loader, "reset"):
+            self.loader.reset()
+        return self
+
+    def __iter__(self):
+        return iter(self.loader)
+
+
+def py_reader(capacity: int, shapes=None, dtypes=None, names=None):
+    return _PyReader(capacity)
+
+
+def create_py_reader_by_data(capacity: int = 2, feed_list=None):
+    return _PyReader(capacity)
+
+
+def read_file(reader):
+    """reference: layers/io.py read_file — pull the NEXT element from a
+    reader factory (readers are plain python iterables here); iterator
+    state is kept per reader object so successive calls advance."""
+    it = getattr(reader, "_pt_iter", None)
+    if it is None:
+        it = iter(reader())
+        try:
+            reader._pt_iter = it
+        except AttributeError:
+            pass  # unwritable callable: degrade to fresh iteration
+    try:
+        return next(it)
+    except StopIteration:
+        if hasattr(reader, "_pt_iter"):
+            del reader._pt_iter
+        raise
+
+
+def open_files(filenames: _Sequence[str], batch_size: int = 1, **_kw):
+    """Line-oriented multi-file reader (role of the reference's
+    open_files/recordio readers on modern storage)."""
+    def reader():
+        for fname in filenames:
+            with open(fname) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+    return reader
+
+
+def random_data_generator(low: float, high: float, shapes, lod_levels=None,
+                          seed: int = 0):
+    """reference: reader/create_random_data_generator_op.cc."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+
+    def reader():
+        while True:
+            yield tuple(rng.uniform(low, high, s).astype(np.float32)
+                        for s in shapes)
+
+    return reader
+
+
+class Preprocessor:
+    """reference: layers/io.py Preprocessor — map a transform over a
+    reader pipeline."""
+
+    def __init__(self, reader, name: _Optional[str] = None):
+        self.reader, self._fn = reader, None
+
+    def block(self, fn):
+        self._fn = fn
+        return self
+
+    def inputs(self):
+        return self.reader
+
+    def outputs(self, *outs):
+        return outs
+
+    def __call__(self):
+        return _data.map_readers(self._fn, self.reader)()
+
+
+# --- static-graph var helpers ---------------------------------------------
+def create_tensor(dtype="float32", name: _Optional[str] = None):
+    """Eager analog of layers/tensor.py create_tensor: a 0-d placeholder
+    value (assign into it via ordinary rebinding)."""
+    return jnp.zeros((), dtype=dtype)
+
+
+def create_global_var(shape, value, dtype="float32",
+                      persistable: bool = False, force_cpu: bool = False,
+                      name: _Optional[str] = None):
+    return jnp.full(tuple(shape), value, dtype=dtype)
+
+
+def create_parameter(shape, dtype="float32", name: _Optional[str] = None,
+                     attr=None, is_bias: bool = False,
+                     default_initializer=None):
+    """Inside static.program_guard: creates a trainable Program parameter.
+    Eager: returns the initialized array (nn.Layer owns named params)."""
+    from .static import program as _prog_mod
+
+    init = default_initializer or (_I.Constant(0.0) if is_bias
+                                   else _I.XavierUniform())
+    prog = _prog_mod.default_main_program()
+    pname = name or prog.unique_name("param")
+    return prog.create_parameter(pname, tuple(shape), dtype, initializer=init)
+
+
+class _StepCounter:
+    """Host-side persistent step counter (reference: layers/nn.py
+    autoincreased_step_counter — jitted steps carry their own step state;
+    this covers the host-loop bookkeeping role)."""
+
+    def __init__(self, begin: int = 1, step: int = 1):
+        self.value, self.step = begin - step, step
+
+    def __call__(self):
+        self.value += self.step
+        return jnp.asarray(self.value, jnp.int64)
+
+
+def autoincreased_step_counter(counter_name: _Optional[str] = None,
+                               begin: int = 1, step: int = 1):
+    return _StepCounter(begin, step)
+
+
+def load(out, file_path: str, load_as_fp16: bool = False):
+    """reference: operators/load_op.cc — load one saved array
+    (checkpoint.py owns whole-state save/load)."""
+    import numpy as np
+
+    arr = np.load(file_path, allow_pickle=False)
+    return jnp.asarray(arr, jnp.float16 if load_as_fp16 else None)
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: operators/py_func_op.cc — in an eager/functional
+    framework arbitrary python composes directly; provided for API parity."""
+    xs = x if isinstance(x, (list, tuple)) else (x,)
+    return func(*xs)
+
+
+
+# --- static-graph polymorphism ---------------------------------------------
+# Reference users call fluid.layers.* on Program Vars inside
+# fluid.program_guard. Every function in this namespace dispatches: eager
+# arrays run directly; static Vars record the SAME computation onto their
+# Program (Program.apply traces it). Param-creating layers (fc, conv2d,
+# embedding, batch_norm, ...) route to static.layers, which owns Program
+# parameter creation (reference LayerHelper role).
+
+def _wrap_static_dispatch(name, f):
+    import functools
+
+    import jax.tree_util as _jtu
+
+    def _is_var(x):
+        from .static.program import Var
+
+        return isinstance(x, Var)
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        from .static import layers as _SL
+
+        leaves, treedef = _jtu.tree_flatten((args, kwargs), is_leaf=_is_var)
+        var_pos = [i for i, l in enumerate(leaves) if _is_var(l)]
+        if not var_pos:
+            return f(*args, **kwargs)
+        static_impl = getattr(_SL, name, None)
+        if static_impl is not None and static_impl is not wrapper:
+            return static_impl(*args, **kwargs)
+        prog = leaves[var_pos[0]].program
+
+        def fn(*vals):
+            new_leaves = list(leaves)
+            for i, v in zip(var_pos, vals):
+                new_leaves[i] = v
+            a, kw = _jtu.tree_unflatten(treedef, new_leaves)
+            return f(*a, **kw)
+
+        return prog.apply(fn, [leaves[i] for i in var_pos], name=name)
+
+    return wrapper
+
+
+def _apply_static_dispatch():
+    import types
+
+    g = globals()
+    skip = {"data", "create_parameter", "create_global_var", "create_tensor",
+            "py_func", "Print", "py_reader", "create_py_reader_by_data",
+            "read_file", "open_files", "random_data_generator", "batch",
+            "shuffle", "double_buffer", "load", "fc",
+            "autoincreased_step_counter", "create_array", "array_write",
+            "array_read", "array_length", "tensor_array_to_tensor",
+            "While", "IfElse", "StaticRNN", "DynamicRNN", "Switch",
+            "fill_constant", "zeros"}
+    for name, obj in list(g.items()):
+        if name.startswith("_") or name in skip:
+            continue
+        if isinstance(obj, types.FunctionType) or (
+                callable(obj) and not isinstance(obj, type)
+                and hasattr(obj, "__module__")
+                and str(getattr(obj, "__module__", "")).startswith(
+                    "paddle_tpu")):
+            g[name] = _wrap_static_dispatch(name, obj)
+
+
+_apply_static_dispatch()

@@ -1,0 +1,394 @@
+"""Metrics — capability parity with the reference metrics stack
+(reference: python/paddle/fluid/metrics.py — Accuracy, Precision, Recall, Auc,
+EditDistance, CompositeMetric; metric ops operators/metrics/accuracy_op.cc,
+auc_op.cc).
+
+Two pieces, like the reference: an in-graph *op* part (pure functions usable
+under jit) and host-side *accumulators* (the MetricBase role).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# --- in-graph metric ops ---------------------------------------------------
+
+def accuracy(pred_logits, label, k: int = 1):
+    """reference: operators/metrics/accuracy_op.cc — top-k accuracy."""
+    label = label.reshape(-1)
+    if k == 1:
+        correct = (jnp.argmax(pred_logits, axis=-1) == label)
+        return jnp.mean(correct.astype(jnp.float32))
+    topk = jnp.argsort(pred_logits, axis=-1)[..., -k:]
+    correct = jnp.any(topk == label[:, None], axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+def auc_terms(probs, label, num_thresholds: int = 200):
+    """Histogram terms for streaming AUC (reference: operators/metrics/
+    auc_op.cc) — returns (tp, fp) histograms to be accumulated host-side."""
+    pos_prob = probs[:, 1] if probs.ndim == 2 else probs
+    label = label.reshape(-1)
+    idx = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                   num_thresholds)
+    tp = jnp.zeros(num_thresholds + 1).at[idx].add(label.astype(jnp.float32))
+    fp = jnp.zeros(num_thresholds + 1).at[idx].add(1.0 - label.astype(jnp.float32))
+    return tp, fp
+
+
+# --- host-side accumulators ------------------------------------------------
+
+class MetricBase:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """reference: metrics.py Accuracy — weighted running average."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1.0):
+        self.value += float(value) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        if self.weight == 0:
+            return 0.0
+        return self.value / self.weight
+
+
+class Auc(MetricBase):
+    """reference: metrics.py Auc — trapezoidal over threshold histogram."""
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self.tp = np.zeros(self.num_thresholds + 1)
+        self.fp = np.zeros(self.num_thresholds + 1)
+
+    def update(self, probs, label):
+        tp, fp = auc_terms(jnp.asarray(probs), jnp.asarray(label),
+                           self.num_thresholds)
+        self.tp += np.asarray(tp)
+        self.fp += np.asarray(fp)
+
+    def eval(self):
+        # cumulative from the top threshold down → ROC points
+        tp_cum = np.cumsum(self.tp[::-1])
+        fp_cum = np.cumsum(self.fp[::-1])
+        total_pos = tp_cum[-1]
+        total_neg = fp_cum[-1]
+        if total_pos == 0 or total_neg == 0:
+            return 0.0
+        # prepend the (0,0) ROC anchor so mass in the top bucket still
+        # integrates over the full curve (degenerate case → 0.5, not 0)
+        tpr = np.concatenate([[0.0], tp_cum / total_pos])
+        fpr = np.concatenate([[0.0], fp_cum / total_neg])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class Precision(MetricBase):
+    """reference: metrics.py Precision (binary)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class EditDistance(MetricBase):
+    """reference: metrics.py EditDistance + operators/edit_distance_op.cc."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.seq_right = 0
+
+    @staticmethod
+    def _levenshtein(a, b) -> int:
+        m, n = len(a), len(b)
+        dp = list(range(n + 1))
+        for i in range(1, m + 1):
+            prev = dp[0]
+            dp[0] = i
+            for j in range(1, n + 1):
+                cur = dp[j]
+                dp[j] = min(dp[j] + 1, dp[j - 1] + 1,
+                            prev + (a[i - 1] != b[j - 1]))
+                prev = cur
+        return dp[n]
+
+    def update(self, hyps, refs):
+        for h, r in zip(hyps, refs):
+            d = self._levenshtein(list(h), list(r))
+            if self.normalized:
+                d = d / max(len(r), 1)
+            self.total += d
+            self.count += 1
+            if d == 0:
+                self.seq_right += 1
+
+    def eval(self):
+        avg = self.total / self.count if self.count else 0.0
+        instance_err = 1.0 - (self.seq_right / self.count if self.count else 0.0)
+        return avg, instance_err
+
+
+class CompositeMetric(MetricBase):
+    """reference: metrics.py CompositeMetric."""
+
+    def __init__(self, *metrics: MetricBase):
+        self.metrics = list(metrics)
+
+    def add_metric(self, m: MetricBase):
+        self.metrics.append(m)
+
+    def reset(self):
+        for m in self.metrics:
+            m.reset()
+
+    def update(self, *args, **kwargs):
+        for m in self.metrics:
+            m.update(*args, **kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self.metrics]
+
+
+def chunk_eval(input, label, chunk_scheme: str = "IOB",
+               num_chunk_types: int = 1, excluded_chunk_types=None,
+               seq_lens=None):
+    """Sequence-chunking precision/recall/F1 (reference:
+    operators/chunk_eval_op.cc + layers/nn.py chunk_eval). Thin wrapper
+    over :func:`paddle_tpu.ops.sequence.chunk_eval` with the fluid
+    argument order; ``seq_lens`` defaults to full rows (padded-dense
+    representation — the LoD replacement)."""
+    from .ops.sequence import chunk_eval as _ce
+
+    input = jnp.asarray(input)
+    if seq_lens is None:
+        t = input.shape[-1] if input.ndim > 1 else input.shape[0]
+        b = input.shape[0] if input.ndim > 1 else 1
+        seq_lens = jnp.full((b,), t, jnp.int32)
+    return _ce(input, label, seq_lens, num_chunk_types, chunk_scheme,
+               tuple(excluded_chunk_types or ()))
+
+
+class ChunkEvaluator(MetricBase):
+    """reference: metrics.py:361 ChunkEvaluator — accumulates
+    chunk_eval's counters over mini-batches; eval() returns
+    (precision, recall, f1)."""
+
+    def __init__(self, name=None):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+def mean_iou(pred, label, num_classes: int):
+    """reference: operators/mean_iou_op.cc — mean intersection-over-union
+    over classes present in pred or label. Returns (mean_iou, per-class
+    intersection, per-class union)."""
+    import jax
+
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    onehot_p = jax.nn.one_hot(pred, num_classes)
+    onehot_l = jax.nn.one_hot(label, num_classes)
+    inter = jnp.sum(onehot_p * onehot_l, axis=0)
+    union = jnp.sum(onehot_p, axis=0) + jnp.sum(onehot_l, axis=0) - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    return miou, inter, union
+
+
+def precision_recall(pred_probs, label, num_classes: int):
+    """reference: operators/metrics/precision_recall_op.cc — per-class and
+    macro/micro precision/recall/F1 from argmax predictions. Returns a dict
+    of scalars + per-class (tp, fp, fn)."""
+    import jax
+
+    pred = jnp.argmax(pred_probs, axis=-1)
+    onehot_p = jax.nn.one_hot(pred, num_classes)
+    onehot_l = jax.nn.one_hot(label.reshape(-1), num_classes)
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p * (1 - onehot_l), axis=0)
+    fn = jnp.sum((1 - onehot_p) * onehot_l, axis=0)
+    prec = tp / jnp.maximum(tp + fp, 1.0)
+    rec = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-9)
+    micro_p = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fp), 1.0)
+    micro_r = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fn), 1.0)
+    return {
+        "macro_precision": jnp.mean(prec), "macro_recall": jnp.mean(rec),
+        "macro_f1": jnp.mean(f1), "micro_precision": micro_p,
+        "micro_recall": micro_r,
+        "micro_f1": 2 * micro_p * micro_r / jnp.maximum(
+            micro_p + micro_r, 1e-9),
+        "tp": tp, "fp": fp, "fn": fn,
+    }
+
+
+def positive_negative_pair(score, label, query_id):
+    """reference: operators/metrics/positive_negative_pair_op.cc — ranking
+    metric: among same-query item pairs with different labels, count pairs
+    ranked correctly (higher label → higher score), wrong, or tied."""
+    s = score.reshape(-1)
+    l = label.reshape(-1).astype(jnp.float32)
+    q = query_id.reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.triu(jnp.ones((s.size, s.size), jnp.bool_), k=1)
+    valid = same_q & upper & (l[:, None] != l[None, :])
+    sdiff = s[:, None] - s[None, :]
+    ldiff = l[:, None] - l[None, :]
+    pos = jnp.sum(valid & (sdiff * ldiff > 0))
+    neg = jnp.sum(valid & (sdiff * ldiff < 0))
+    neu = jnp.sum(valid & (sdiff == 0))
+    return pos, neg, neu
+
+
+def detection_map(det_boxes, det_scores, det_labels, gt_boxes, gt_labels,
+                  *, num_classes: int, overlap_threshold: float = 0.5):
+    """reference: operators/detection_map_op.cc — mean average precision
+    (11-point interpolated) over classes for one image batch. Dense/static
+    simplification: detections (D, 4)+(D,)+(D,); gts (G, 4)+(G,); padded
+    entries have label < 0."""
+    from .ops.detection import iou_similarity
+    import numpy as np_  # host-side: mAP is an eval-time metric
+
+    det_boxes = np_.asarray(det_boxes)
+    det_scores = np_.asarray(det_scores)
+    det_labels = np_.asarray(det_labels)
+    gt_boxes = np_.asarray(gt_boxes)
+    gt_labels = np_.asarray(gt_labels)
+    aps = []
+    for c in range(num_classes):
+        d_idx = np_.where(det_labels == c)[0]
+        g_idx = np_.where(gt_labels == c)[0]
+        if len(g_idx) == 0:
+            continue
+        order = d_idx[np_.argsort(-det_scores[d_idx])]
+        matched = set()
+        tp = np_.zeros(len(order))
+        fp = np_.zeros(len(order))
+        for i, di in enumerate(order):
+            if len(g_idx):
+                ious = np_.asarray(iou_similarity(
+                    det_boxes[di:di + 1], gt_boxes[g_idx]))[0]
+                j = int(np_.argmax(ious))
+                if ious[j] >= overlap_threshold and j not in matched:
+                    tp[i] = 1
+                    matched.add(j)
+                else:
+                    fp[i] = 1
+            else:
+                fp[i] = 1
+        ctp = np_.cumsum(tp)
+        cfp = np_.cumsum(fp)
+        rec = ctp / len(g_idx)
+        prec = ctp / np_.maximum(ctp + cfp, 1e-9)
+        ap = 0.0
+        for t in np_.linspace(0, 1, 11):
+            p = prec[rec >= t].max() if np_.any(rec >= t) else 0.0
+            ap += p / 11
+        aps.append(ap)
+    return float(np_.mean(aps)) if aps else 0.0
+
+
+class DetectionMAP(MetricBase):
+    """reference: python/paddle/fluid/metrics.py DetectionMAP accumulator."""
+
+    def __init__(self, num_classes: int, overlap_threshold: float = 0.5,
+                 name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.overlap_threshold = overlap_threshold
+        self.reset()
+
+    def reset(self):
+        self._maps = []
+
+    def update(self, det_boxes, det_scores, det_labels, gt_boxes, gt_labels):
+        self._maps.append(detection_map(
+            det_boxes, det_scores, det_labels, gt_boxes, gt_labels,
+            num_classes=self.num_classes,
+            overlap_threshold=self.overlap_threshold))
+
+    def eval(self):
+        import numpy as np_
+
+        return float(np_.mean(self._maps)) if self._maps else 0.0

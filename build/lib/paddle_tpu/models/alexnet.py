@@ -1,0 +1,44 @@
+"""AlexNet — reference: benchmark/figs legacy comparison family (AlexNet/
+GoogleNet/ResNet/VGG charts); rebuilt from framework layers (NCHW)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000, in_ch: int = 3,
+                 dropout: float = 0.5):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(in_ch, 64, 11, stride=4, padding=2, act="relu"),
+            nn.Pool2D(3, "max", stride=2),
+            nn.Conv2D(64, 192, 5, padding=2, act="relu"),
+            nn.Pool2D(3, "max", stride=2),
+            nn.Conv2D(192, 384, 3, padding=1, act="relu"),
+            nn.Conv2D(384, 256, 3, padding=1, act="relu"),
+            nn.Conv2D(256, 256, 3, padding=1, act="relu"),
+            nn.Pool2D(3, "max", stride=2),
+        )
+        self.classifier = nn.Sequential(
+            nn.Flatten(),
+            nn.Dropout(dropout),
+            nn.Linear(256 * 6 * 6, 4096, act="relu"),
+            nn.Dropout(dropout),
+            nn.Linear(4096, 4096, act="relu"),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(self.features(x))
+
+
+def alexnet(num_classes: int = 1000, **kw) -> AlexNet:
+    return AlexNet(num_classes, **kw)
+
+
+def loss_fn(logits, labels):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, labels))

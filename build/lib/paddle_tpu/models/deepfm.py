@@ -1,0 +1,107 @@
+"""DeepFM — sparse CTR model (BASELINE config 5).
+
+Capability target: the reference's CTR training stack — MultiSlot sparse
+ids through PS-sharded lookup tables (reference: framework/data_feed.h:55,
+operators/lookup_table_op.cc sparse-grad path, distributed/downpour.py:24).
+Here the sparse tables are mesh-sharded dense arrays
+(parallel.sharded_embedding) and the whole model is one jitted SPMD
+computation: FM first/second-order terms + DNN tower, bf16-friendly.
+
+Input convention (Criteo-style): ``sparse_ids`` (B, F) — one id per
+categorical field, pre-offset into a single concatenated vocab of size
+sum(field vocab sizes); ``dense`` (B, Dn) — continuous features.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.enforce import enforce
+from ..ops import loss as L
+
+
+@dataclass
+class DeepFMConfig:
+    total_vocab: int = 1000          # sum of per-field vocab sizes
+    num_fields: int = 26
+    dense_dim: int = 13
+    embed_dim: int = 16
+    mlp_dims: Sequence[int] = (400, 400, 400)
+    dropout: float = 0.0
+    # 'ep' shards the tables over the mesh; None keeps them replicated
+    embedding_axis: Optional[str] = "ep"
+    # row-sparse gradient updates for the tables (SelectedRows capability;
+    # reference: lookup_table is_sparse) — train via
+    # optimizer.sparse_minimize_fn so each step touches O(B*fields) rows
+    sparse_grads: bool = False
+
+    @classmethod
+    def criteo(cls, total_vocab: int = 1_000_000):
+        return cls(total_vocab=total_vocab)
+
+    @classmethod
+    def tiny(cls):
+        return cls(total_vocab=512, num_fields=8, dense_dim=4, embed_dim=8,
+                   mlp_dims=(32, 16))
+
+
+class DeepFM(nn.Layer):
+    def __init__(self, cfg: Optional[DeepFMConfig] = None):
+        super().__init__()
+        from ..parallel.sharded_embedding import ShardedEmbedding
+
+        self.cfg = cfg = cfg or DeepFMConfig()
+        if cfg.embedding_axis:
+            self.embedding = ShardedEmbedding(cfg.total_vocab, cfg.embed_dim,
+                                              axis=cfg.embedding_axis,
+                                              is_sparse=cfg.sparse_grads)
+            self.linear_embed = ShardedEmbedding(cfg.total_vocab, 1,
+                                                 axis=cfg.embedding_axis,
+                                                 is_sparse=cfg.sparse_grads)
+        else:
+            self.embedding = nn.Embedding(cfg.total_vocab, cfg.embed_dim,
+                                          is_sparse=cfg.sparse_grads)
+            self.linear_embed = nn.Embedding(cfg.total_vocab, 1,
+                                             is_sparse=cfg.sparse_grads)
+        self.bias = self.create_parameter("bias", (1,), is_bias=True)
+        mlp = []
+        d_in = cfg.num_fields * cfg.embed_dim + cfg.dense_dim
+        for d_out in cfg.mlp_dims:
+            mlp.append(nn.Linear(d_in, d_out, act="relu"))
+            if cfg.dropout:
+                mlp.append(nn.Dropout(cfg.dropout))
+            d_in = d_out
+        mlp.append(nn.Linear(d_in, 1))
+        self.mlp = nn.Sequential(*mlp)
+        self.dense_linear = nn.Linear(cfg.dense_dim, 1)
+
+    def forward(self, sparse_ids, dense=None):
+        cfg = self.cfg
+        b, f = sparse_ids.shape
+        enforce(f == cfg.num_fields, "expected %s fields, got %s",
+                cfg.num_fields, f)
+        emb = self.embedding(sparse_ids)               # (B, F, K)
+        # FM first order: per-id scalar weights (+ dense linear)
+        first = jnp.sum(self.linear_embed(sparse_ids)[..., 0], axis=1)
+        if dense is not None:
+            first = first + self.dense_linear(dense)[:, 0]
+        # FM second order: 0.5 * ((Σe)² − Σe²) summed over K
+        s = jnp.sum(emb, axis=1)
+        second = 0.5 * jnp.sum(s * s - jnp.sum(emb * emb, axis=1), axis=-1)
+        # DNN tower over concatenated embeddings (+ dense)
+        flat = emb.reshape(b, f * cfg.embed_dim)
+        if dense is not None:
+            flat = jnp.concatenate([flat, dense], axis=-1)
+        deep = self.mlp(flat)[:, 0]
+        return first + second + deep + self.bias[0]    # logits (B,)
+
+
+def loss_fn(logits, labels):
+    """Pointwise CTR loss: sigmoid BCE (reference:
+    operators/sigmoid_cross_entropy_with_logits_op.cc)."""
+    return jnp.mean(L.sigmoid_cross_entropy_with_logits(
+        logits, labels.astype(logits.dtype)))

@@ -1,0 +1,108 @@
+"""GoogLeNet (Inception v1) — reference: benchmark/figs legacy comparison
+family; rebuilt from framework layers (NCHW, plain conv+relu as in the
+v1 paper — no LRN, which XLA has no fast path for; aux heads included
+for training parity)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+
+
+class Inception(nn.Layer):
+    """One inception block: 1x1 | 1x1→3x3 | 1x1→5x5 | pool→1x1 branches."""
+
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = nn.Conv2D(in_ch, c1, 1, act="relu")
+        self.b2 = nn.Sequential(nn.Conv2D(in_ch, c3r, 1, act="relu"),
+                                nn.Conv2D(c3r, c3, 3, padding=1, act="relu"))
+        self.b3 = nn.Sequential(nn.Conv2D(in_ch, c5r, 1, act="relu"),
+                                nn.Conv2D(c5r, c5, 5, padding=2, act="relu"))
+        self.b4_pool = nn.Pool2D(3, "max", stride=1, padding=1)
+        self.b4 = nn.Conv2D(in_ch, pp, 1, act="relu")
+
+    def forward(self, x):
+        return jnp.concatenate([self.b1(x), self.b2(x), self.b3(x),
+                                self.b4(self.b4_pool(x))], axis=1)
+
+
+class AuxHead(nn.Layer):
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        # v1 recipe: 5x5/3 avg pool (14x14 -> 4x4), 1x1 conv, 2 fc
+        self.pool = nn.Pool2D(5, "avg", stride=3)
+        self.conv = nn.Conv2D(in_ch, 128, 1, act="relu")
+        self.fc1 = nn.Linear(128 * 4 * 4, 1024, act="relu")
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = x.reshape(x.shape[0], -1)
+        return self.fc2(self.drop(self.fc1(x)))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000, in_ch: int = 3,
+                 aux_heads: bool = True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(in_ch, 64, 7, stride=2, padding=3, act="relu"),
+            nn.Pool2D(3, "max", stride=2, padding=1),
+            nn.Conv2D(64, 64, 1, act="relu"),
+            nn.Conv2D(64, 192, 3, padding=1, act="relu"),
+            nn.Pool2D(3, "max", stride=2, padding=1),
+        )
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)    # 256
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)  # 480
+        self.pool3 = nn.Pool2D(3, "max", stride=2, padding=1)
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)   # 512
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)  # 512
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)  # 512
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)  # 528
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)  # 832
+        self.pool4 = nn.Pool2D(3, "max", stride=2, padding=1)
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)  # 832
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)  # 1024
+        self.drop = nn.Dropout(0.4)
+        self.head = nn.Linear(1024, num_classes)
+        self.aux_heads = aux_heads
+        if aux_heads:
+            self.aux1 = AuxHead(512, num_classes)
+            self.aux2 = AuxHead(528, num_classes)
+
+    def forward(self, x):
+        from ..ops.nn import adaptive_pool2d
+
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        a1 = self.aux1(x) if (self.aux_heads and self.training) else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = self.aux2(x) if (self.aux_heads and self.training) else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        x = adaptive_pool2d(x, 1, "avg").reshape(x.shape[0], -1)
+        logits = self.head(self.drop(x))
+        if a1 is not None:
+            return logits, a1, a2
+        return logits
+
+
+def googlenet(num_classes: int = 1000, **kw) -> GoogLeNet:
+    return GoogLeNet(num_classes, **kw)
+
+
+def loss_fn(outputs, labels, aux_weight: float = 0.3):
+    """Main CE + 0.3-weighted aux losses (the v1 training recipe)."""
+    if isinstance(outputs, tuple):
+        main, a1, a2 = outputs
+        loss = jnp.mean(L.softmax_with_cross_entropy(main, labels))
+        for aux in (a1, a2):
+            loss = loss + aux_weight * jnp.mean(
+                L.softmax_with_cross_entropy(aux, labels))
+        return loss
+    return jnp.mean(L.softmax_with_cross_entropy(outputs, labels))

@@ -1,0 +1,52 @@
+"""MNIST models — BASELINE config 1 (reference:
+benchmark/fluid/models/mnist.py cnn_model, tests/book/test_recognize_digits.py
+mlp + conv variants).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+from ..metrics import accuracy
+
+
+class MnistMLP(nn.Layer):
+    """reference: tests/book/test_recognize_digits.py mlp — 784-128-64-10."""
+
+    def __init__(self, hidden1: int = 128, hidden2: int = 64):
+        super().__init__()
+        self.fc1 = nn.Linear(784, hidden1, act="relu")
+        self.fc2 = nn.Linear(hidden1, hidden2, act="relu")
+        self.fc3 = nn.Linear(hidden2, 10)
+
+    def forward(self, x):
+        return self.fc3(self.fc2(self.fc1(x)))
+
+
+class MnistCNN(nn.Layer):
+    """reference: benchmark/fluid/models/mnist.py cnn_model — conv-pool x2 + fc."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, 20, 5, act="relu")
+        self.pool1 = nn.Pool2D(2, "max", stride=2)
+        self.conv2 = nn.Conv2D(20, 50, 5, act="relu")
+        self.pool2 = nn.Pool2D(2, "max", stride=2)
+        self.fc = nn.Linear(50 * 4 * 4, 10)
+
+    def forward(self, x):
+        if x.ndim == 2:
+            x = x.reshape(-1, 1, 28, 28)
+        h = self.pool1(self.conv1(x))
+        h = self.pool2(self.conv2(h))
+        return self.fc(h.reshape(h.shape[0], -1))
+
+
+def loss_fn(logits, label):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, label))
+
+
+def eval_metrics(logits, label):
+    return {"acc": accuracy(logits, label)}

@@ -1,0 +1,43 @@
+"""Recommender system — book model (reference:
+tests/book/test_recommender_system.py — movielens: user/movie feature
+embeddings → fusion MLPs → cosine similarity rating regression)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops.math import cos_sim
+
+
+class RecommenderNet(nn.Layer):
+    def __init__(self, num_users: int = 6041, num_items: int = 3953,
+                 num_genders: int = 2, num_ages: int = 7,
+                 num_jobs: int = 21, num_categories: int = 19,
+                 embed_dim: int = 32, fc_dim: int = 200):
+        super().__init__()
+        self.user_emb = nn.Embedding(num_users, embed_dim)
+        self.gender_emb = nn.Embedding(num_genders, 16)
+        self.age_emb = nn.Embedding(num_ages, 16)
+        self.job_emb = nn.Embedding(num_jobs, 16)
+        self.user_fc = nn.Linear(embed_dim + 48, fc_dim, act="tanh")
+        self.item_emb = nn.Embedding(num_items, embed_dim)
+        self.cat_emb = nn.Embedding(num_categories, embed_dim)
+        self.item_fc = nn.Linear(2 * embed_dim, fc_dim, act="tanh")
+
+    def forward(self, user, gender, age, job, item, categories):
+        """categories: (B, K) multi-hot id list (padded with 0) — summed
+        like the reference's sequence_pool over category embeddings."""
+        u = jnp.concatenate([
+            self.user_emb(user), self.gender_emb(gender),
+            self.age_emb(age), self.job_emb(job)], axis=-1)
+        u = self.user_fc(u)
+        cat = jnp.sum(self.cat_emb(categories), axis=1)
+        i = jnp.concatenate([self.item_emb(item), cat], axis=-1)
+        i = self.item_fc(i)
+        # reference scales cos similarity to the 5-star range
+        return 5.0 * cos_sim(u, i)
+
+
+def loss_fn(pred, rating):
+    return jnp.mean((pred.reshape(-1) - rating) ** 2)

@@ -1,0 +1,138 @@
+"""ResNet family — BASELINE config 2 (reference:
+benchmark/fluid/models/resnet.py model zoo entry; built here from the
+framework's own layers, NCHW, bf16-policy aware).
+
+Variants: resnet50/101/152 (ImageNet, bottleneck) and resnet20/32 (CIFAR,
+basic block) — the reference benchmarks resnet on both cifar10 and
+flowers/imagenet (reference: benchmark/fluid/README.md:15-23).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+
+
+def _conv_bn(in_ch: int, out_ch: int, k: int, stride: int = 1,
+             groups: int = 1, act: Optional[str] = "relu",
+             data_format: str = "NCHW") -> nn.Layer:
+    return nn.Sequential(
+        nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=(k - 1) // 2,
+                  groups=groups, bias_attr=False, data_format=data_format),
+        nn.BatchNorm(out_ch, act=act, data_layout=data_format),
+    )
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 groups: int = 1, base_width: int = 64,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        width = int(ch * (base_width / 64.0)) * groups
+        out_ch = ch * self.expansion
+        df = data_format
+        self.conv1 = _conv_bn(in_ch, width, 1, data_format=df)
+        self.conv2 = _conv_bn(width, width, 3, stride=stride, groups=groups,
+                              data_format=df)
+        self.conv3 = _conv_bn(width, out_ch, 1, act=None, data_format=df)
+        self.short = (None if in_ch == out_ch and stride == 1
+                      else _conv_bn(in_ch, out_ch, 1, stride=stride,
+                                    act=None, data_format=df))
+
+    def forward(self, x):
+        y = self.conv3(self.conv2(self.conv1(x)))
+        s = x if self.short is None else self.short(x)
+        return jnp.maximum(y + s, 0.0)
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 data_format: str = "NCHW", **_):
+        super().__init__()
+        df = data_format
+        self.conv1 = _conv_bn(in_ch, ch, 3, stride=stride, data_format=df)
+        self.conv2 = _conv_bn(ch, ch, 3, act=None, data_format=df)
+        self.short = (None if in_ch == ch and stride == 1
+                      else _conv_bn(in_ch, ch, 1, stride=stride, act=None,
+                                    data_format=df))
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(x))
+        s = x if self.short is None else self.short(x)
+        return jnp.maximum(y + s, 0.0)
+
+
+class ResNet(nn.Layer):
+    def __init__(self, block, depths: Sequence[int], num_classes: int = 1000,
+                 in_ch: int = 3, cifar: bool = False, groups: int = 1,
+                 base_width: int = 64, data_format: str = "NCHW"):
+        super().__init__()
+        self.cifar = cifar
+        # NHWC is the TPU-preferred layout (channels-last tiles directly
+        # onto the MXU without the transposes NCHW convs insert); inputs
+        # stay NCHW at the API and transpose once at the stem
+        self.data_format = data_format
+        df = data_format
+        ch = 16 if cifar else 64
+        if cifar:
+            self.stem = _conv_bn(in_ch, ch, 3, data_format=df)
+            widths = [16, 32, 64]
+        else:
+            self.stem = _conv_bn(in_ch, ch, 7, stride=2, data_format=df)
+            self.maxpool = nn.Pool2D(3, "max", stride=2, padding=1,
+                                     data_format=df)
+            widths = [64, 128, 256, 512]
+        blocks = []
+        cur = ch
+        for stage, (w, n) in enumerate(zip(widths, depths)):
+            for i in range(n):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                blocks.append(block(cur, w, stride=stride, groups=groups,
+                                    base_width=base_width, data_format=df))
+                cur = w * block.expansion
+        self.blocks = nn.LayerList(blocks)
+        self.head = nn.Linear(cur, num_classes)
+
+    def forward(self, x):
+        if self.data_format == "NHWC":
+            x = jnp.transpose(x, (0, 2, 3, 1))  # accept NCHW inputs
+        x = self.stem(x)
+        if not self.cifar:
+            x = self.maxpool(x)
+        for blk in self.blocks:
+            x = blk(x)
+        pool_axes = (2, 3) if self.data_format == "NCHW" else (1, 2)
+        x = jnp.mean(x, axis=pool_axes)  # global average pool
+        return self.head(x)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)
+
+
+def resnet152(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes, **kw)
+
+
+def resnet20_cifar(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(BasicBlock, [3, 3, 3], num_classes, cifar=True, **kw)
+
+
+def resnet32_cifar(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(BasicBlock, [5, 5, 5], num_classes, cifar=True, **kw)
+
+
+def loss_fn(logits, labels):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, labels))

@@ -1,0 +1,81 @@
+"""SE-ResNeXt — reference: benchmark/fluid/models/se_resnext.py zoo entry
+(also the reference's distributed regression model, tests/unittests/
+dist_se_resnext.py). Grouped-conv bottleneck (cardinality 32) + squeeze-
+and-excitation gating, built from framework layers."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+from .resnet import ResNet, _conv_bn
+
+
+class SEBlock(nn.Layer):
+    """Squeeze-excitation: global pool → bottleneck MLP → sigmoid scale."""
+
+    def __init__(self, ch: int, reduction: int = 16):
+        super().__init__()
+        self.fc1 = nn.Linear(ch, max(ch // reduction, 4), act="relu")
+        self.fc2 = nn.Linear(max(ch // reduction, 4), ch, act="sigmoid")
+
+    def forward(self, x):
+        s = jnp.mean(x, axis=(2, 3))           # (N, C)
+        s = self.fc2(self.fc1(s))
+        return x * s[:, :, None, None]
+
+
+class SEBottleneck(nn.Layer):
+    expansion = 2  # ResNeXt-style wide bottleneck
+
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 cardinality: int = 32, reduction: int = 16, **_):
+        super().__init__()
+        width = ch * 2
+        out_ch = ch * self.expansion * 2
+        self.conv1 = _conv_bn(in_ch, width, 1)
+        self.conv2 = _conv_bn(width, width, 3, stride=stride,
+                              groups=cardinality)
+        self.conv3 = _conv_bn(width, out_ch, 1, act=None)
+        self.se = SEBlock(out_ch, reduction)
+        self.short = (None if in_ch == out_ch and stride == 1
+                      else _conv_bn(in_ch, out_ch, 1, stride=stride, act=None))
+
+    def forward(self, x):
+        y = self.se(self.conv3(self.conv2(self.conv1(x))))
+        s = x if self.short is None else self.short(x)
+        return jnp.maximum(y + s, 0.0)
+
+
+class SEResNeXt(nn.Layer):
+    def __init__(self, depths=(3, 4, 6, 3), num_classes: int = 1000,
+                 in_ch: int = 3, cardinality: int = 32):
+        super().__init__()
+        self.stem = _conv_bn(in_ch, 64, 7, stride=2)
+        self.maxpool = nn.Pool2D(3, "max", stride=2, padding=1)
+        widths = [64, 128, 256, 512]
+        blocks = []
+        cur = 64
+        for stage, (w, n) in enumerate(zip(widths, depths)):
+            for i in range(n):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                blocks.append(SEBottleneck(cur, w, stride=stride,
+                                           cardinality=cardinality))
+                cur = w * SEBottleneck.expansion * 2
+        self.blocks = nn.LayerList(blocks)
+        self.head = nn.Linear(cur, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.stem(x))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(jnp.mean(x, axis=(2, 3)))
+
+
+def se_resnext50(num_classes: int = 1000, **kw) -> SEResNeXt:
+    return SEResNeXt((3, 4, 6, 3), num_classes, **kw)
+
+
+def loss_fn(logits, labels):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, labels))

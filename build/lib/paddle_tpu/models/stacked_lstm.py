@@ -1,0 +1,54 @@
+"""Stacked dynamic LSTM sentiment model — BASELINE bench model
+(reference: benchmark/fluid/models/stacked_dynamic_lstm.py — IMDB word ids →
+embedding → [fc → lstm → max-pools] x N → concat pooled states → fc →
+softmax over 2 classes; the reference's dynamic LoD batches become padded
+(B, T) + lengths here, ops/sequence.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..metrics import accuracy
+from ..ops import loss as L
+from ..ops import rnn as R
+from ..ops.sequence import sequence_mask
+
+
+class StackedLSTM(nn.Layer):
+    def __init__(self, vocab_size: int = 5149, embed_dim: int = 512,
+                 hidden_dim: int = 512, num_layers: int = 3,
+                 num_classes: int = 2):
+        super().__init__()
+        self.embedding = nn.Embedding(vocab_size, embed_dim)
+        self.num_layers = num_layers
+        for i in range(num_layers):
+            in_dim = embed_dim if i == 0 else hidden_dim
+            self.add_sublayer(f"fc{i}", nn.Linear(in_dim, hidden_dim))
+            self.add_sublayer(f"lstm{i}", nn.LSTM(hidden_dim, hidden_dim))
+        self.out = nn.Linear(2 * hidden_dim, num_classes)
+
+    def forward(self, ids, lengths):
+        h = self.embedding(ids)  # (B, T, E)
+        t = ids.shape[1]
+        neg = jnp.asarray(-1e9, h.dtype)
+        mask = sequence_mask(lengths, t, jnp.bool_)[:, :, None]
+        last_h = last_cell = None
+        for i in range(self.num_layers):
+            h = getattr(self, f"fc{i}")(h)
+            h, (hn, cn) = getattr(self, f"lstm{i}")(h, lengths=lengths)
+            last_h, last_cell = h, cn
+        # reference pools max over time of both the outputs and cell path
+        pooled_h = jnp.max(jnp.where(mask, last_h, neg), axis=1)
+        pooled_c = last_cell[0]  # (B, H) final cell state, single direction
+        feat = jnp.concatenate([pooled_h, pooled_c], axis=-1)
+        return self.out(feat)
+
+
+def loss_fn(logits, label):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, label))
+
+
+def eval_metrics(logits, label):
+    return {"acc": accuracy(logits, label)}

@@ -1,0 +1,189 @@
+"""Transformer NMT — BASELINE config 4 (reference:
+benchmark/fluid/models/machine_translation.py, tests/book
+test_machine_translation.py): encoder-decoder seq2seq with label-smoothed
+cross entropy and greedy decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.transformer import (PositionalEncoding, TransformerDecoder,
+                              TransformerEncoder)
+from ..ops import loss as L
+
+
+@dataclasses.dataclass
+class NMTConfig:
+    src_vocab: int = 32000
+    tgt_vocab: int = 32000
+    d_model: int = 512
+    num_heads: int = 8
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    dim_feedforward: int = 2048
+    dropout: float = 0.1
+    max_len: int = 1024
+    label_smooth: float = 0.1
+    bos_id: int = 0
+    eos_id: int = 1
+    pad_id: int = 2
+    use_flash: bool = True
+    # decoder-side self-attention SP only: the encoder always applies a
+    # source padding mask, which the SP attention paths reject (see
+    # nn.MultiHeadAttention); long-source SP needs packed sequences
+    seq_parallel: Optional[str] = None
+
+    @classmethod
+    def base(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(src_vocab=512, tgt_vocab=512, d_model=64, num_heads=4,
+                   num_encoder_layers=2, num_decoder_layers=2,
+                   dim_feedforward=128, dropout=0.0, max_len=128)
+
+
+class TransformerNMT(nn.Layer):
+    def __init__(self, cfg: Optional[NMTConfig] = None):
+        super().__init__()
+        self.cfg = cfg = cfg or NMTConfig.base()
+        self.src_emb = nn.Embedding(cfg.src_vocab, cfg.d_model,
+                                    padding_idx=cfg.pad_id)
+        self.tgt_emb = nn.Embedding(cfg.tgt_vocab, cfg.d_model,
+                                    padding_idx=cfg.pad_id)
+        self.pos_enc = PositionalEncoding(cfg.d_model, cfg.max_len,
+                                          dropout=cfg.dropout)
+        self.encoder = TransformerEncoder(
+            cfg.num_encoder_layers, cfg.d_model, cfg.num_heads,
+            cfg.dim_feedforward, cfg.dropout, use_flash=cfg.use_flash)
+        self.decoder = TransformerDecoder(
+            cfg.num_decoder_layers, cfg.d_model, cfg.num_heads,
+            cfg.dim_feedforward, cfg.dropout, use_flash=cfg.use_flash,
+            seq_parallel=cfg.seq_parallel)
+        self.generator = nn.Linear(cfg.d_model, cfg.tgt_vocab)
+
+    def encode(self, src_ids):
+        src_pad = (src_ids != self.cfg.pad_id)
+        memory = self.encoder(self.pos_enc(self.src_emb(src_ids)),
+                              mask=src_pad[:, None, None, :])
+        return memory, src_pad
+
+    def forward(self, src_ids, tgt_ids):
+        """Teacher-forced logits: tgt_ids is the decoder input (shifted)."""
+        memory, src_pad = self.encode(src_ids)
+        h = self.decoder(self.pos_enc(self.tgt_emb(tgt_ids)), memory,
+                         cross_mask=src_pad[:, None, None, :], causal=True)
+        return self.generator(h)
+
+    def forward_fused_loss(self, src_ids, tgt_ids, tgt_labels,
+                           vocab_chunk: int = 4096):
+        """Training loss without the (B, T, tgt_vocab) logits tensor: the
+        generator head runs through the chunked linear-cross-entropy
+        (ops/fused_loss.py — same HBM argument as the BERT MLM head).
+        ``tgt_labels`` uses pad_id positions as ignored."""
+        from ..core.dtypes import get_policy
+        from ..ops.fused_loss import mean_linear_cross_entropy
+
+        memory, src_pad = self.encode(src_ids)
+        h = self.decoder(self.pos_enc(self.tgt_emb(tgt_ids)), memory,
+                         cross_mask=src_pad[:, None, None, :], causal=True)
+        b, t, d = h.shape
+        labels = jnp.where(tgt_labels == self.cfg.pad_id, -100, tgt_labels)
+        pol = get_policy()  # vocab matmuls in the AMP compute dtype (bf16)
+        return mean_linear_cross_entropy(
+            pol.cast_to_compute(h.reshape(b * t, d)),
+            pol.cast_to_compute(self.generator.weight),
+            pol.cast_to_compute(self.generator.bias),
+            labels.reshape(-1), chunk=vocab_chunk, ignore_index=-100)
+
+    def greedy_decode(self, src_ids, max_len: int = 64):
+        """Fixed-length greedy decode via lax.scan (static shapes — the
+        reference's while_op beam search maps to compiled scan on TPU)."""
+        cfg = self.cfg
+        b = src_ids.shape[0]
+        memory, src_pad = self.encode(src_ids)
+        tokens = jnp.full((b, max_len + 1), cfg.pad_id, jnp.int32)
+        tokens = tokens.at[:, 0].set(cfg.bos_id)
+        finished = jnp.zeros((b,), jnp.bool_)
+
+        def step(carry, t):
+            tokens, finished = carry
+            h = self.decoder(self.pos_enc(self.tgt_emb(tokens[:, :-1])),
+                             memory, cross_mask=src_pad[:, None, None, :],
+                             causal=True)
+            # only row t is consumed — project just it, not all positions
+            h_t = jax.lax.dynamic_index_in_dim(h, t, axis=1, keepdims=False)
+            logits = self.generator(h_t)  # (b, vocab)
+            next_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            next_tok = jnp.where(finished, cfg.pad_id, next_tok)
+            tokens = tokens.at[:, t + 1].set(next_tok)
+            finished = finished | (next_tok == cfg.eos_id)
+            return (tokens, finished), None
+
+        (tokens, _), _ = jax.lax.scan(step, (tokens, finished),
+                                      jnp.arange(max_len))
+        return tokens[:, 1:]
+
+    def beam_decode(self, src_ids, max_len: int = 64, beam_size: int = 4,
+                    length_penalty: float = 0.6):
+        """Beam-search decode, one source sentence batch at a time via vmap
+        (reference capability: contrib/decoder/beam_search_decoder.py +
+        beam_search op; here ops.beam_search's scan + pointer backtrack).
+
+        Returns (B, beam_size, max_len) sequences best-first + scores.
+        """
+        from ..ops import decode as DCD
+
+        cfg = self.cfg
+
+        def one(src_row):
+            memory, src_pad = self.encode(src_row[None])
+            mem_k = jnp.repeat(memory, beam_size, axis=0)
+            pad_k = jnp.repeat(src_pad, beam_size, axis=0)
+
+            def step_fn(state, tok):
+                tokens, t = state["tokens"], state["t"]
+                tokens = tokens.at[:, t[0]].set(tok)
+                h = self.decoder(self.pos_enc(self.tgt_emb(tokens)), mem_k,
+                                 cross_mask=pad_k[:, None, None, :],
+                                 causal=True)
+                h_t = jax.lax.dynamic_index_in_dim(h, t[0], axis=1,
+                                                   keepdims=False)
+                logp = jax.nn.log_softmax(self.generator(h_t), -1)
+                return logp, {"tokens": tokens, "t": t + 1}
+
+            init = {"tokens": jnp.full((beam_size, max_len + 1), cfg.pad_id,
+                                       jnp.int32),
+                    "t": jnp.zeros((beam_size,), jnp.int32)}
+            return DCD.beam_search(init, step_fn, beam_size=beam_size,
+                                   max_len=max_len, bos_id=cfg.bos_id,
+                                   end_id=cfg.eos_id,
+                                   length_penalty=length_penalty)
+
+        return jax.vmap(one)(src_ids)
+
+
+def nmt_loss(logits, labels, pad_id: int = 2, label_smooth: float = 0.1):
+    """Label-smoothed CE over non-pad positions (reference:
+    label_smooth op + softmax_with_cross_entropy soft-label mode)."""
+    vocab = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, vocab, dtype=logits.dtype)
+    soft = L.label_smooth(onehot, epsilon=label_smooth)
+    tok_loss = L.softmax_with_cross_entropy(logits, soft,
+                                            soft_label=True).squeeze(-1)
+    keep = (labels != pad_id)
+    return jnp.sum(tok_loss * keep) / jnp.maximum(jnp.sum(keep), 1)
+
+
+def nmt_metrics(logits, labels, pad_id: int = 2):
+    keep = (labels != pad_id)
+    pred = jnp.argmax(logits, -1)
+    acc = jnp.sum((pred == labels) * keep) / jnp.maximum(jnp.sum(keep), 1)
+    return {"token_acc": acc}

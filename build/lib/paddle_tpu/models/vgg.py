@@ -1,0 +1,57 @@
+"""VGG — reference: benchmark/fluid/models/vgg.py zoo entry; rebuilt from
+framework layers (NCHW, batch-norm variant as the reference uses)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+
+_CFGS = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+_WIDTHS = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Layer):
+    def __init__(self, depth: int = 16, num_classes: int = 1000,
+                 in_ch: int = 3, image_size: int = 224,
+                 dropout: float = 0.5):
+        super().__init__()
+        reps = _CFGS[depth]
+        feats = []
+        cur = in_ch
+        for width, n in zip(_WIDTHS, reps):
+            for _ in range(n):
+                feats.append(nn.Conv2D(cur, width, 3, padding=1,
+                                       bias_attr=False))
+                feats.append(nn.BatchNorm(width, act="relu"))
+                cur = width
+            feats.append(nn.Pool2D(2, "max", stride=2))
+        self.features = nn.Sequential(*feats)
+        spatial = image_size // 32
+        self.classifier = nn.Sequential(
+            nn.Flatten(),
+            nn.Linear(cur * spatial * spatial, 4096, act="relu"),
+            nn.Dropout(dropout),
+            nn.Linear(4096, 4096, act="relu"),
+            nn.Dropout(dropout),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(self.features(x))
+
+
+def vgg16(num_classes: int = 1000, **kw) -> VGG:
+    return VGG(16, num_classes, **kw)
+
+
+def loss_fn(logits, labels):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, labels))

@@ -1,0 +1,309 @@
+"""Native (C++) runtime components, bound via ctypes (no pybind in this
+environment). Currently: the multithreaded MultiSlot data feed
+(src/datafeed.cc) — the reference's C++ ingestion role
+(reference: framework/data_feed.h:55, operators/reader/buffered_reader.cc).
+
+The shared library builds on demand with `make` (g++ is part of the
+supported toolchain); import fails soft — ``available()`` reports status
+and the pure-Python pipeline (paddle_tpu.data) is always there.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libptdatafeed.so")
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True, text=True, timeout=300)
+            except Exception as e:  # toolchain missing → soft-fail
+                _build_error = getattr(e, "stderr", str(e)) or str(e)
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.ptdf_create.restype = ctypes.c_void_p
+        lib.ptdf_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.ptdf_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptdf_next.restype = ctypes.c_void_p
+        lib.ptdf_next.argtypes = [ctypes.c_void_p]
+        lib.ptdf_batch_free.argtypes = [ctypes.c_void_p]
+        lib.ptdf_batch_size.restype = ctypes.c_int64
+        lib.ptdf_batch_size.argtypes = [ctypes.c_void_p]
+        lib.ptdf_batch_maxlen.restype = ctypes.c_int64
+        lib.ptdf_batch_maxlen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_batch_ivalues.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.ptdf_batch_ivalues.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_batch_fvalues.restype = ctypes.POINTER(ctypes.c_float)
+        lib.ptdf_batch_fvalues.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_batch_lengths.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.ptdf_batch_lengths.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptdf_error.restype = ctypes.c_int
+        lib.ptdf_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library is (or can be) built and loaded."""
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+class MultiSlotFeed:
+    """Iterate dense padded batches parsed by C++ worker threads.
+
+    ``slots``: [(name, 'u'|'f'), ...] in file order. Yields
+    {name: (values (B, maxlen), lengths (B,))} with int64/float32 values.
+    The training thread never touches file IO or parsing — batches queue
+    up to ``queue_capacity`` deep while the accelerator computes.
+    """
+
+    def __init__(self, files: Sequence[str],
+                 slots: Sequence[Tuple[str, str]], batch_size: int,
+                 num_threads: int = 2, queue_capacity: int = 8,
+                 drop_last: bool = True):
+        from ..core.enforce import enforce
+
+        lib = _load()
+        enforce(lib is not None,
+                "native datafeed unavailable: %s", _build_error)
+        for f in files:
+            enforce(os.path.exists(f), "no such data file: %s", f)
+        self._lib = lib
+        self.slots = list(slots)
+        spec = ",".join(f"{n}:{d}" for n, d in self.slots).encode()
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        self._h = lib.ptdf_create(arr, len(files), spec, batch_size,
+                                  num_threads, queue_capacity,
+                                  1 if drop_last else 0)
+        enforce(self._h is not None, "ptdf_create failed (bad slot spec?)")
+
+    def __iter__(self) -> Iterator[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+        lib = self._lib
+        while True:
+            b = lib.ptdf_next(self._h)
+            if not b:
+                break
+            try:
+                bs = lib.ptdf_batch_size(b)
+                out = {}
+                for i, (name, d) in enumerate(self.slots):
+                    ml = lib.ptdf_batch_maxlen(b, i)
+                    n = int(bs * ml)
+                    if d == "u":
+                        ptr = lib.ptdf_batch_ivalues(b, i)
+                        vals = np.ctypeslib.as_array(ptr, (n,)).copy()
+                    else:
+                        ptr = lib.ptdf_batch_fvalues(b, i)
+                        vals = np.ctypeslib.as_array(ptr, (n,)).copy()
+                    lens = np.ctypeslib.as_array(
+                        lib.ptdf_batch_lengths(b, i), (int(bs),)).copy()
+                    out[name] = (vals.reshape(int(bs), int(ml)), lens)
+                yield out
+            finally:
+                lib.ptdf_batch_free(b)
+        err = ctypes.create_string_buffer(512)
+        if lib.ptdf_error(self._h, err, 512):
+            raise RuntimeError(f"native datafeed: {err.value.decode()}")
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ptdf_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# C++ PJRT serving predictor (src/predictor.cc) — the Python-free serving
+# path (reference: inference/api/analysis_predictor.h:46,
+# train/demo/demo_trainer.cc). This wrapper drives the same C ABI that the
+# standalone `ptserve` binary uses, so the artifact/npz/manifest parsing is
+# testable from Python without a PJRT device.
+
+_PRED_SO = os.path.join(_DIR, "libptpredictor.so")
+_pred_lib = None
+
+
+def _load_predictor_lib():
+    global _pred_lib
+    with _lib_lock:
+        if _pred_lib is not None:
+            return _pred_lib
+        if not os.path.exists(_PRED_SO):
+            try:
+                subprocess.run(["make", "-C", _DIR, "libptpredictor.so"],
+                               check=True, capture_output=True, text=True,
+                               timeout=300)
+            except Exception as e:
+                raise RuntimeError(
+                    f"cannot build libptpredictor.so: "
+                    f"{getattr(e, 'stderr', e)}")
+        lib = ctypes.CDLL(_PRED_SO)
+        lib.ptpred_load.restype = ctypes.c_void_p
+        lib.ptpred_load.argtypes = [ctypes.c_char_p]
+        lib.ptpred_ok.argtypes = [ctypes.c_void_p]
+        lib.ptpred_error.restype = ctypes.c_char_p
+        lib.ptpred_error.argtypes = [ctypes.c_void_p]
+        lib.ptpred_compile.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptpred_num_feeds.argtypes = [ctypes.c_void_p]
+        lib.ptpred_feed_name.restype = ctypes.c_char_p
+        lib.ptpred_feed_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_num_fetches.argtypes = [ctypes.c_void_p]
+        lib.ptpred_fetch_name.restype = ctypes.c_char_p
+        lib.ptpred_fetch_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_num_params.argtypes = [ctypes.c_void_p]
+        lib.ptpred_param_dtype.restype = ctypes.c_char_p
+        lib.ptpred_param_dtype.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptpred_param_rank.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptpred_param_dim.restype = ctypes.c_int64
+        lib.ptpred_param_dim.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+        lib.ptpred_param_data.restype = ctypes.c_void_p
+        lib.ptpred_param_data.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.POINTER(ctypes.c_int64)]
+        lib.ptpred_run.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_void_p),
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.ptpred_out_rank.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_out_dim.restype = ctypes.c_int64
+        lib.ptpred_out_dim.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int]
+        lib.ptpred_out_dtype.restype = ctypes.c_char_p
+        lib.ptpred_out_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpred_out_data.restype = ctypes.c_void_p
+        lib.ptpred_out_data.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.ptpred_destroy.argtypes = [ctypes.c_void_p]
+        _pred_lib = lib
+        return lib
+
+
+def default_pjrt_plugin() -> Optional[str]:
+    """Plugin search: $PT_PJRT_PLUGIN, else libtpu from the environment."""
+    p = os.environ.get("PT_PJRT_PLUGIN")
+    if p:
+        return p
+    try:
+        import libtpu
+
+        return os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+    except ImportError:
+        return None
+
+
+class NativePredictor:
+    """C++ serving predictor handle (artifact parse is hermetic; ``compile``
+    needs a PJRT plugin + device)."""
+
+    def __init__(self, model_dir: str):
+        self._lib = _load_predictor_lib()
+        self._h = self._lib.ptpred_load(model_dir.encode())
+        if not self._lib.ptpred_ok(self._h):
+            err = self._lib.ptpred_error(self._h).decode()
+            self._lib.ptpred_destroy(self._h)
+            self._h = None
+            raise RuntimeError(f"native predictor load: {err}")
+
+    @property
+    def feed_names(self) -> List[str]:
+        return [self._lib.ptpred_feed_name(self._h, i).decode()
+                for i in range(self._lib.ptpred_num_feeds(self._h))]
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return [self._lib.ptpred_fetch_name(self._h, i).decode()
+                for i in range(self._lib.ptpred_num_fetches(self._h))]
+
+    def num_params(self) -> int:
+        return self._lib.ptpred_num_params(self._h)
+
+    def param(self, name: str) -> np.ndarray:
+        """Parsed param tensor (exercises the C++ npz reader)."""
+        rank = self._lib.ptpred_param_rank(self._h, name.encode())
+        if rank < 0:
+            raise KeyError(name)
+        shape = [self._lib.ptpred_param_dim(self._h, name.encode(), i)
+                 for i in range(rank)]
+        dt = self._lib.ptpred_param_dtype(self._h, name.encode()).decode()
+        n = ctypes.c_int64()
+        ptr = self._lib.ptpred_param_data(self._h, name.encode(),
+                                          ctypes.byref(n))
+        buf = ctypes.string_at(ptr, n.value)
+        return np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape).copy()
+
+    def compile(self, plugin_path: Optional[str] = None) -> None:
+        plugin = plugin_path or default_pjrt_plugin()
+        if plugin is None:
+            raise RuntimeError("no PJRT plugin found; set PT_PJRT_PLUGIN")
+        if not self._lib.ptpred_compile(self._h, plugin.encode()):
+            raise RuntimeError(
+                f"compile: {self._lib.ptpred_error(self._h).decode()}")
+
+    def run(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        names = self.feed_names
+        arrs = [np.ascontiguousarray(feeds[n]) for n in names]
+        ptrs = (ctypes.c_void_p * len(arrs))(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in arrs])
+        dims_flat = []
+        ranks = []
+        for a in arrs:
+            dims_flat.extend(a.shape)
+            ranks.append(a.ndim)
+        dims = (ctypes.c_int64 * len(dims_flat))(*dims_flat)
+        ranks_c = (ctypes.c_int * len(ranks))(*ranks)
+        if not self._lib.ptpred_run(self._h, ptrs, dims, ranks_c):
+            raise RuntimeError(
+                f"run: {self._lib.ptpred_error(self._h).decode()}")
+        outs = []
+        for i in range(self._lib.ptpred_num_fetches(self._h)):
+            rank = self._lib.ptpred_out_rank(self._h, i)
+            shape = [self._lib.ptpred_out_dim(self._h, i, d)
+                     for d in range(rank)]
+            dt = self._lib.ptpred_out_dtype(self._h, i).decode()
+            n = ctypes.c_int64()
+            ptr = self._lib.ptpred_out_data(self._h, i, ctypes.byref(n))
+            buf = ctypes.string_at(ptr, n.value)
+            outs.append(np.frombuffer(buf, dtype=np.dtype(dt))
+                        .reshape(shape).copy())
+        return outs
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ptpred_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,263 @@
+// Dependency-free parsers for the serving artifact: tiny JSON (manifest),
+// npy/npz (params), numpy-dtype table. Shared by predictor.cc and
+// predictor_test.cc (reference test convention: units next to sources).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <string>
+#include <vector>
+#include <zlib.h>
+
+namespace ptnative {
+
+// ---------------------------------------------------------------- errors --
+struct Status {
+  bool ok = true;
+  std::string message;
+  static Status Ok() { return {}; }
+  static Status Err(std::string m) { return {false, std::move(m)}; }
+};
+
+// ------------------------------------------------------------ tiny JSON ---
+// Parser for the machine-written manifest (objects, arrays, strings,
+// numbers, bools). Not a general JSON library on purpose.
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json* find(const std::string& k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  bool fail = false;
+
+  void ws() { while (p < end && strchr(" \t\r\n", *p)) p++; }
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if (end - p >= (long)n && !strncmp(p, s, n)) { p += n; return true; }
+    return false;
+  }
+  Json parse() {
+    ws();
+    Json j;
+    if (p >= end) { fail = true; return j; }
+    if (*p == '{') {
+      j.kind = Json::kObj; p++;
+      ws();
+      if (p < end && *p == '}') { p++; return j; }
+      while (p < end) {
+        ws();
+        Json key = parse_string();
+        ws();
+        if (p >= end || *p != ':') { fail = true; return j; }
+        p++;
+        j.obj[key.str] = parse();
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == '}') { p++; break; }
+        fail = true; return j;
+      }
+    } else if (*p == '[') {
+      j.kind = Json::kArr; p++;
+      ws();
+      if (p < end && *p == ']') { p++; return j; }
+      while (p < end) {
+        j.arr.push_back(parse());
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == ']') { p++; break; }
+        fail = true; return j;
+      }
+    } else if (*p == '"') {
+      j = parse_string();
+    } else if (lit("true")) {
+      j.kind = Json::kBool; j.b = true;
+    } else if (lit("false")) {
+      j.kind = Json::kBool; j.b = false;
+    } else if (lit("null")) {
+      j.kind = Json::kNull;
+    } else {
+      j.kind = Json::kNum;
+      char* q = nullptr;
+      j.num = strtod(p, &q);
+      if (q == p) fail = true;
+      p = q;
+    }
+    return j;
+  }
+  Json parse_string() {
+    Json j; j.kind = Json::kStr;
+    if (p >= end || *p != '"') { fail = true; return j; }
+    p++;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        p++;
+        switch (*p) {
+          case 'n': j.str += '\n'; break;
+          case 't': j.str += '\t'; break;
+          default: j.str += *p;
+        }
+      } else {
+        j.str += *p;
+      }
+      p++;
+    }
+    if (p < end) p++;  // closing quote
+    return j;
+  }
+};
+
+// ------------------------------------------------------------- npz/zip ----
+struct NpyArray {
+  std::string dtype;          // numpy descr, e.g. "<f4"
+  std::vector<int64_t> shape;
+  std::vector<uint8_t> data;  // raw little-endian payload
+};
+
+inline Status InflateRaw(const uint8_t* src, size_t n,
+                         std::vector<uint8_t>* out) {
+  z_stream zs{};
+  if (inflateInit2(&zs, -MAX_WBITS) != Z_OK)
+    return Status::Err("zlib init failed");
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = n;
+  std::vector<uint8_t> buf(1 << 16);
+  int ret = Z_OK;
+  while (ret != Z_STREAM_END) {
+    zs.next_out = buf.data();
+    zs.avail_out = buf.size();
+    ret = inflate(&zs, Z_NO_FLUSH);
+    if (ret != Z_OK && ret != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return Status::Err("zlib inflate failed");
+    }
+    out->insert(out->end(), buf.data(),
+                buf.data() + (buf.size() - zs.avail_out));
+  }
+  inflateEnd(&zs);
+  return Status::Ok();
+}
+
+inline Status ParseNpy(const std::vector<uint8_t>& raw, NpyArray* out) {
+  if (raw.size() < 10 || memcmp(raw.data(), "\x93NUMPY", 6))
+    return Status::Err("bad .npy magic");
+  int major = raw[6];
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = raw[8] | (raw[9] << 8);
+    hoff = 10;
+  } else {
+    hlen = raw[8] | (raw[9] << 8) | (raw[10] << 16) | ((size_t)raw[11] << 24);
+    hoff = 12;
+  }
+  std::string hdr((const char*)raw.data() + hoff, hlen);
+  // header is a python dict literal: {'descr': '<f4', 'fortran_order':
+  // False, 'shape': (3, 4), }
+  auto grab = [&](const char* key) -> std::string {
+    auto k = hdr.find(key);
+    if (k == std::string::npos) return "";
+    auto c = hdr.find(':', k);
+    auto e = hdr.find_first_of(",}", c);
+    // tuples contain commas — extend to the closing paren
+    auto open = hdr.find('(', c);
+    if (open != std::string::npos && open < e) e = hdr.find(')', open) + 1;
+    return hdr.substr(c + 1, e - c - 1);
+  };
+  std::string descr = grab("'descr'");
+  auto q0 = descr.find('\'');
+  auto q1 = descr.rfind('\'');
+  if (q0 == std::string::npos || q1 <= q0)
+    return Status::Err("bad descr in npy header");
+  out->dtype = descr.substr(q0 + 1, q1 - q0 - 1);
+  if (grab("'fortran_order'").find("True") != std::string::npos)
+    return Status::Err("fortran_order arrays unsupported");
+  std::string shp = grab("'shape'");
+  out->shape.clear();
+  const char* s = shp.c_str();
+  while (*s) {
+    while (*s && !isdigit(*s)) s++;
+    if (!*s) break;
+    out->shape.push_back(strtoll(s, const_cast<char**>(&s), 10));
+  }
+  out->data.assign(raw.begin() + hoff + hlen, raw.end());
+  return Status::Ok();
+}
+
+// Minimal ZIP central-directory reader (stored + deflate entries).
+inline Status ReadNpz(const std::string& path,
+                      std::map<std::string, NpyArray>* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return Status::Err("cannot open " + path);
+  std::vector<uint8_t> buf((std::istreambuf_iterator<char>(f)),
+                           std::istreambuf_iterator<char>());
+  if (buf.size() < 22) return Status::Err("npz too small");
+  // find end-of-central-directory record (no zip64 support; params files
+  // beyond 4GB should use sharded checkpoints instead)
+  size_t eocd = std::string::npos;
+  for (size_t i = buf.size() - 22; i + 4 >= 4; i--) {
+    if (buf[i] == 0x50 && buf[i + 1] == 0x4b && buf[i + 2] == 0x05 &&
+        buf[i + 3] == 0x06) { eocd = i; break; }
+    if (i == 0) break;
+  }
+  if (eocd == std::string::npos) return Status::Err("no zip EOCD");
+  auto rd16 = [&](size_t o) { return (uint32_t)buf[o] | (buf[o + 1] << 8); };
+  auto rd32 = [&](size_t o) {
+    return (uint32_t)buf[o] | (buf[o + 1] << 8) | (buf[o + 2] << 16) |
+           ((uint32_t)buf[o + 3] << 24);
+  };
+  uint32_t n_entries = rd16(eocd + 10);
+  size_t cd = rd32(eocd + 16);
+  for (uint32_t e = 0; e < n_entries; e++) {
+    if (rd32(cd) != 0x02014b50) return Status::Err("bad central dir entry");
+    uint16_t method = rd16(cd + 10);
+    uint32_t csize = rd32(cd + 20);
+    uint16_t nlen = rd16(cd + 28), xlen = rd16(cd + 30), clen = rd16(cd + 32);
+    uint32_t lho = rd32(cd + 42);
+    std::string name((const char*)&buf[cd + 46], nlen);
+    // local header: skip its (possibly different) name/extra lengths
+    uint16_t lnlen = rd16(lho + 26), lxlen = rd16(lho + 28);
+    size_t data_off = lho + 30 + lnlen + lxlen;
+    std::vector<uint8_t> raw;
+    if (method == 0) {
+      raw.assign(buf.begin() + data_off, buf.begin() + data_off + csize);
+    } else if (method == 8) {
+      Status st = InflateRaw(&buf[data_off], csize, &raw);
+      if (!st.ok) return st;
+    } else {
+      return Status::Err("unsupported zip method for " + name);
+    }
+    if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
+      name = name.substr(0, name.size() - 4);
+    NpyArray arr;
+    Status st = ParseNpy(raw, &arr);
+    if (!st.ok) return Status::Err(name + ": " + st.message);
+    (*out)[name] = std::move(arr);
+    cd += 46 + nlen + xlen + clen;
+  }
+  return Status::Ok();
+}
+
+// PJRT-free dtype size table (the PJRT_Buffer_Type mapping lives in
+// predictor.cc next to the PJRT calls).
+inline size_t DtypeSize(const std::string& d) {
+  if (d == "<f4" || d == "float32" || d == "<i4" || d == "int32") return 4;
+  if (d == "<f8" || d == "float64" || d == "<i8" || d == "int64") return 8;
+  if (d == "<f2" || d == "float16") return 2;
+  if (d == "|i1" || d == "int8" || d == "|u1" || d == "uint8" ||
+      d == "|b1" || d == "bool") return 1;
+  return 0;  // unsupported
+}
+
+}  // namespace ptnative

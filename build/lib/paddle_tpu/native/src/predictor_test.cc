@@ -1,0 +1,108 @@
+// C++ unit tests for the native artifact parsers — the reference's
+// next-to-source *_test.cc convention (reference:
+// paddle/fluid/framework/lod_tensor_test.cc et al; gtest replaced by a
+// tiny assert harness to keep the bare-image build dependency-free).
+//
+// Build+run: make -C paddle_tpu/native test
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "artifact_parsers.h"
+
+using namespace ptnative;
+
+static int failures = 0;
+#define CHECK_TRUE(cond)                                              \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      failures++;                                                     \
+    }                                                                 \
+  } while (0)
+
+static void test_json_parser() {
+  const char* text =
+      "{\"format\": \"stablehlo+npz/v2\", \"n\": 3.5, \"ok\": true,"
+      " \"names\": [\"a\", \"b\"], \"shapes\": {\"x\": [-1, 8]}}";
+  JsonParser jp{text, text + strlen(text)};
+  Json j = jp.parse();
+  CHECK_TRUE(!jp.fail);
+  CHECK_TRUE(j.find("format")->str == "stablehlo+npz/v2");
+  CHECK_TRUE(j.find("n")->num == 3.5);
+  CHECK_TRUE(j.find("ok")->b);
+  CHECK_TRUE(j.find("names")->arr.size() == 2);
+  CHECK_TRUE(j.find("names")->arr[1].str == "b");
+  const Json* shapes = j.find("shapes");
+  CHECK_TRUE(shapes && shapes->find("x")->arr[0].num == -1);
+}
+
+static void test_json_escapes_and_errors() {
+  const char* esc = "{\"s\": \"a\\nb\\\"c\"}";
+  JsonParser jp{esc, esc + strlen(esc)};
+  auto j = jp.parse();
+  CHECK_TRUE(!jp.fail && j.find("s")->str == "a\nb\"c");
+  const char* bad = "{\"x\": }";
+  JsonParser jb{bad, bad + strlen(bad)};
+  jb.parse();
+  CHECK_TRUE(jb.fail);
+}
+
+static void test_npy_parser() {
+  // hand-rolled v1.0 .npy: 2x3 float32
+  std::string hdr =
+      "{'descr': '<f4', 'fortran_order': False, 'shape': (2, 3), }";
+  while ((10 + hdr.size() + 1) % 64 != 0) hdr += ' ';
+  hdr += '\n';
+  std::vector<uint8_t> raw;
+  const char magic[] = "\x93NUMPY\x01\x00";
+  raw.insert(raw.end(), magic, magic + 8);
+  raw.push_back(hdr.size() & 0xff);
+  raw.push_back((hdr.size() >> 8) & 0xff);
+  raw.insert(raw.end(), hdr.begin(), hdr.end());
+  float data[6] = {0, 1, 2, 3, 4, 5};
+  raw.insert(raw.end(), (uint8_t*)data, (uint8_t*)data + sizeof(data));
+
+  NpyArray arr;
+  auto st = ParseNpy(raw, &arr);
+  CHECK_TRUE(st.ok);
+  CHECK_TRUE(arr.dtype == "<f4");
+  CHECK_TRUE(arr.shape.size() == 2 && arr.shape[0] == 2 && arr.shape[1] == 3);
+  CHECK_TRUE(arr.data.size() == 24);
+  CHECK_TRUE(((float*)arr.data.data())[4] == 4.0f);
+}
+
+static void test_npy_rejects_garbage() {
+  std::vector<uint8_t> bad = {1, 2, 3};
+  NpyArray arr;
+  CHECK_TRUE(!ParseNpy(bad, &arr).ok);
+}
+
+static void test_npz_missing_file() {
+  std::map<std::string, NpyArray> out;
+  CHECK_TRUE(!ReadNpz("/nonexistent/params.npz", &out).ok);
+}
+
+static void test_dtype_sizes() {
+  CHECK_TRUE(DtypeSize("<f4") == 4);
+  CHECK_TRUE(DtypeSize("int64") == 8);
+  CHECK_TRUE(DtypeSize("bool") == 1);
+  CHECK_TRUE(DtypeSize("complex128") == 0);
+}
+
+int main() {
+  test_json_parser();
+  test_json_escapes_and_errors();
+  test_npy_parser();
+  test_npy_rejects_garbage();
+  test_npz_missing_file();
+  test_dtype_sizes();
+  if (failures) {
+    fprintf(stderr, "%d failures\n", failures);
+    return 1;
+  }
+  printf("predictor_test: all ok\n");
+  return 0;
+}

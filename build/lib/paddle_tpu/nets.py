@@ -1,0 +1,103 @@
+"""Convenience network compositions (reference:
+python/paddle/fluid/nets.py — simple_img_conv_pool, img_conv_group,
+sequence_conv_pool, glu, scaled_dot_product_attention built from
+primitives)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import nn
+from .core.enforce import enforce
+from .ops import rnn as R
+from .ops.attention import scaled_dot_product_attention  # re-export (nets.py:343)
+from .ops.sequence import sequence_pool
+
+
+def simple_img_conv_pool(in_channels: int, num_filters: int,
+                         filter_size: int, pool_size: int, pool_stride: int,
+                         act: Optional[str] = "relu",
+                         pool_type: str = "max") -> nn.Layer:
+    """reference: nets.py simple_img_conv_pool — conv + act + pool."""
+    return nn.Sequential(
+        nn.Conv2D(in_channels, num_filters, filter_size, act=act),
+        nn.Pool2D(pool_size, pool_type, stride=pool_stride))
+
+
+def img_conv_group(in_channels: int, conv_num_filter: Sequence[int],
+                   conv_filter_size: int = 3, pool_size: int = 2,
+                   pool_stride: int = 2, conv_act: Optional[str] = "relu",
+                   conv_with_batchnorm: bool = False,
+                   pool_type: str = "max") -> nn.Layer:
+    """reference: nets.py img_conv_group — VGG-style conv stack + pool."""
+    layers = []
+    cur = in_channels
+    for nf in conv_num_filter:
+        pad = (conv_filter_size - 1) // 2
+        if conv_with_batchnorm:
+            layers.append(nn.Conv2D(cur, nf, conv_filter_size, padding=pad,
+                                    bias_attr=False))
+            layers.append(nn.BatchNorm(nf, act=conv_act))
+        else:
+            layers.append(nn.Conv2D(cur, nf, conv_filter_size, padding=pad,
+                                    act=conv_act))
+        cur = nf
+    layers.append(nn.Pool2D(pool_size, pool_type, stride=pool_stride))
+    return nn.Sequential(*layers)
+
+
+class SequenceConvPool(nn.Layer):
+    """reference: nets.py sequence_conv_pool — sequence conv + act +
+    sequence pool over padded (B, T, D) + lengths."""
+
+    def __init__(self, input_dim: int, num_filters: int, filter_size: int,
+                 act: str = "tanh", pool_type: str = "max"):
+        super().__init__()
+        from . import initializer as I
+
+        self.filter_size = filter_size
+        self.pool_type = pool_type
+        self.act = act
+        self.create_parameter("weight", (filter_size * input_dim,
+                                         num_filters), None,
+                              I.XavierUniform())
+        self.create_parameter("bias", (num_filters,), None, I.Constant(0.0),
+                              is_bias=True)
+
+    def forward(self, x, lengths):
+        h = R.sequence_conv(x, self.weight, lengths=lengths,
+                            context_length=self.filter_size, bias=self.bias)
+        if self.act == "tanh":
+            h = jnp.tanh(h)
+        elif self.act == "relu":
+            h = jnp.maximum(h, 0.0)
+        return sequence_pool(h, lengths, self.pool_type)
+
+
+def glu(x, axis: int = -1):
+    """Gated linear unit (reference: nets.py glu): split in half along
+    ``axis``; a * sigmoid(b)."""
+    enforce(x.shape[axis] % 2 == 0,
+            "glu axis dim must be even, got %s", x.shape[axis])
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * (1.0 / (1.0 + jnp.exp(-b)))
+
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "SequenceConvPool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def sequence_conv_pool(input, lengths, weight, bias=None, *,
+                       filter_size: int = 3, act: str = "tanh",
+                       pool_type: str = "max"):
+    """Functional form of SequenceConvPool (fluid nets.py name): sequence
+    conv with explicit weights + activation + masked sequence pool."""
+    h = R.sequence_conv(input, weight, lengths=lengths,
+                        context_length=filter_size, bias=bias)
+    if act == "tanh":
+        h = jnp.tanh(h)
+    elif act == "relu":
+        h = jnp.maximum(h, 0.0)
+    return sequence_pool(h, lengths, pool_type)

@@ -1,0 +1,333 @@
+"""Layer: the module system — capability parity with fluid.dygraph.Layer
+(reference: python/paddle/fluid/dygraph/layers.py) redesigned for JAX.
+
+Design: a Layer is a *mutable container of arrays* (ergonomic, Paddle-style),
+but every compiled entry point is *functional*: ``functional_call(params,
+buffers, *args)`` injects state, runs forward, and returns updated buffers —
+so ``jax.jit``/``grad``/``pjit`` see a pure function over pytrees. This is the
+TPU-native answer to the reference's Tracer+VarBase machinery (reference:
+paddle/fluid/imperative/tracer.h:44, layer.h:116): JAX *is* the tracer; the
+Layer only has to organize state.
+
+State collections:
+  - params:  trainable (the reference's Parameter, framework.py:3476)
+  - buffers: non-trainable persistent state (BN running stats)
+Both are flat dicts keyed by dotted paths ("block1.conv.weight").
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as prandom
+from ..core.dtypes import default_dtype
+from ..core.enforce import enforce, not_found
+
+
+class Layer:
+    """Base class for all network modules."""
+
+    def __init__(self, name_scope: Optional[str] = None):
+        # use object.__setattr__ to dodge our own __setattr__ bookkeeping
+        object.__setattr__(self, "_params", {})
+        object.__setattr__(self, "_buffers", {})
+        object.__setattr__(self, "_sublayers", {})
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_rng_ctx", None)
+
+    # --- attribute plumbing -------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Layer):
+            self._sublayers[name] = value
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Parameter):
+            self._params[name] = value.value
+            object.__setattr__(self, name, None)  # real access goes via property
+        elif name in self.__dict__.get("_params", {}):
+            # re-assigning an existing parameter updates the registry, so
+            # forward and state_dict/Trainer never desync
+            self._params[name] = jnp.asarray(value)
+        elif name in self.__dict__.get("_buffers", {}):
+            self._buffers[name] = jnp.asarray(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails or attr is None-placeholder
+        params = self.__dict__.get("_params", {})
+        if name in params:
+            return params[name]
+        buffers = self.__dict__.get("_buffers", {})
+        if name in buffers:
+            return buffers[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __getattribute__(self, name):
+        val = object.__getattribute__(self, name)
+        if val is None:
+            # parameter/buffer placeholder — fetch live value
+            d = object.__getattribute__(self, "__dict__")
+            params = d.get("_params", {})
+            if name in params:
+                return params[name]
+            buffers = d.get("_buffers", {})
+            if name in buffers:
+                return buffers[name]
+        return val
+
+    # --- parameter / buffer creation ---------------------------------------
+
+    def create_parameter(self, name: str, shape, dtype=None,
+                         initializer: Optional[Callable] = None,
+                         is_bias: bool = False):
+        """LayerHelper.create_parameter analog (reference: layer_helper.py:29
+        param creation + default initializers)."""
+        from ..initializer import Constant, XavierUniform
+
+        dtype = dtype or default_dtype()
+        if initializer is None:
+            initializer = Constant(0.0) if is_bias else XavierUniform()
+        key = prandom.key_for(f"{type(self).__name__}.{name}",
+                              prandom.next_key())
+        value = initializer(key, tuple(shape), dtype)
+        self._params[name] = value
+        object.__setattr__(self, name, None)
+        return value
+
+    def register_buffer(self, name: str, value) -> None:
+        self._buffers[name] = jnp.asarray(value)
+        object.__setattr__(self, name, None)
+
+    def update_buffer(self, name: str, value) -> None:
+        """Record a new buffer value during forward (BN running stats).
+        Functional callers collect these via functional_call."""
+        enforce(name in self._buffers, "unknown buffer %s", name)
+        self._buffers[name] = value
+
+    def add_sublayer(self, name: str, layer: "Layer") -> "Layer":
+        self._sublayers[name] = layer
+        object.__setattr__(self, name, layer)
+        return layer
+
+    # --- traversal ----------------------------------------------------------
+
+    def named_sublayers(self, prefix: str = "") -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sublayers.items():
+            path = f"{prefix}{name}"
+            yield path, sub
+            yield from sub.named_sublayers(prefix=f"{path}.")
+
+    def sublayers(self) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers()]
+
+    def named_parameters(self) -> Dict[str, Any]:
+        out = {k: v for k, v in self._params.items()}
+        for name, sub in self._sublayers.items():
+            for k, v in sub.named_parameters().items():
+                out[f"{name}.{k}"] = v
+        return out
+
+    def parameters(self) -> List[Any]:
+        return list(self.named_parameters().values())
+
+    def named_buffers(self) -> Dict[str, Any]:
+        out = {k: v for k, v in self._buffers.items()}
+        for name, sub in self._sublayers.items():
+            for k, v in sub.named_buffers().items():
+                out[f"{name}.{k}"] = v
+        return out
+
+    # --- state dict (reference: dygraph/checkpoint.py save/load) ------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        out = dict(self.named_parameters())
+        out.update({f"_buffer.{k}": v for k, v in self.named_buffers().items()})
+        return out
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        params = {k: v for k, v in state.items() if not k.startswith("_buffer.")}
+        buffers = {k[len("_buffer."):]: v for k, v in state.items()
+                   if k.startswith("_buffer.")}
+        self.set_parameters(params)
+        self.set_buffers(buffers)
+
+    def set_parameters(self, flat: Dict[str, Any]) -> None:
+        own = {k: v for k, v in flat.items() if "." not in k}
+        for k, v in own.items():
+            enforce(k in self._params, "unknown parameter %s on %s", k,
+                    type(self).__name__)
+            self._params[k] = jnp.asarray(v)
+        for name, sub in self._sublayers.items():
+            prefix = f"{name}."
+            subflat = {k[len(prefix):]: v for k, v in flat.items()
+                       if k.startswith(prefix)}
+            if subflat:
+                sub.set_parameters(subflat)
+
+    def set_buffers(self, flat: Dict[str, Any]) -> None:
+        own = {k: v for k, v in flat.items() if "." not in k}
+        for k, v in own.items():
+            self._buffers[k] = jnp.asarray(v)
+        for name, sub in self._sublayers.items():
+            prefix = f"{name}."
+            subflat = {k[len(prefix):]: v for k, v in flat.items()
+                       if k.startswith(prefix)}
+            if subflat:
+                sub.set_buffers(subflat)
+
+    # --- train/eval ---------------------------------------------------------
+
+    def train(self) -> "Layer":
+        object.__setattr__(self, "training", True)
+        for sub in self._sublayers.values():
+            sub.train()
+        return self
+
+    def eval(self) -> "Layer":
+        object.__setattr__(self, "training", False)
+        for sub in self._sublayers.values():
+            sub.eval()
+        return self
+
+    # --- rng ----------------------------------------------------------------
+
+    def rng(self, tag: str = "default"):
+        """Fresh PRNG key for this layer during a functional call (dropout
+        etc.). Outside functional_call falls back to the global stream."""
+        ctx = _RNG_STACK[-1] if _RNG_STACK else None
+        if ctx is None:
+            return prandom.next_key()
+        ctx["count"] += 1
+        return jax.random.fold_in(
+            jax.random.fold_in(ctx["key"], ctx["count"]),
+            _stable_hash(tag))
+
+    # --- calling ------------------------------------------------------------
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def functional_call(self, params: Dict[str, Any], *args,
+                        buffers: Optional[Dict[str, Any]] = None,
+                        rng: Optional[jax.Array] = None,
+                        training: Optional[bool] = None,
+                        method: str = "forward", **kwargs):
+        """Pure-function entry point: run ``method`` (default forward) with
+        `params`/`buffers` injected; returns (output, new_buffers). Safe to
+        jit/grad over."""
+        saved_params = dict(self.named_parameters())
+        saved_buffers = dict(self.named_buffers())
+        saved_training = self.training
+        try:
+            self.set_parameters(params)
+            if buffers is not None:
+                self.set_buffers(buffers)
+            if training is not None:
+                (self.train if training else self.eval)()
+            ctx = {"key": rng if rng is not None else jax.random.key(0),
+                   "count": 0}
+            _RNG_STACK.append(ctx)
+            try:
+                out = getattr(self, method)(*args, **kwargs)
+            finally:
+                _RNG_STACK.pop()
+            new_buffers = dict(self.named_buffers())
+            return out, new_buffers
+        finally:
+            self.set_parameters(saved_params)
+            self.set_buffers(saved_buffers)
+            (self.train if saved_training else self.eval)()
+
+    def apply_fn(self) -> Callable:
+        """Returns f(params, *args) -> output — convenience for loss closures
+        on models without buffers."""
+
+        def f(params, *args, **kwargs):
+            out, _ = self.functional_call(params, *args, **kwargs)
+            return out
+
+        return f
+
+
+_RNG_STACK: List[Dict[str, Any]] = []
+
+
+def stacked_parameters(layers) -> Dict[str, Any]:
+    """Stack the params of structurally identical layers along a new
+    leading axis — the uniform-block idiom shared by scan-over-layers
+    encoders and the GPipe pipeline. Enforces matching param trees."""
+    import jax.numpy as jnp
+
+    from ..core.enforce import enforce
+
+    per = [l.named_parameters() for l in layers]
+    enforce(per, "stacked_parameters needs at least one layer")
+    names = sorted(per[0])
+    for i, p in enumerate(per[1:], 1):
+        enforce(sorted(p) == names,
+                "layer %s is not structurally identical to layer 0 "
+                "(params %s vs %s)", i, sorted(p), names)
+    return {k: jnp.stack([p[k] for p in per]) for k in names}
+
+
+def _stable_hash(s: str) -> int:
+    import zlib
+
+    return zlib.crc32(s.encode()) & 0x7FFFFFFF
+
+
+class Parameter:
+    """Marker wrapper so `layer.w = Parameter(array)` registers a trainable."""
+
+    def __init__(self, value):
+        self.value = jnp.asarray(value)
+
+
+class Sequential(Layer):
+    """reference: dygraph Sequential."""
+
+    def __init__(self, *layers: Layer):
+        super().__init__()
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sublayers.values():
+            x = l(x)
+        return x
+
+    def __len__(self):
+        return len(self._sublayers)
+
+    def __getitem__(self, i: int) -> Layer:
+        return self._sublayers[str(i)]
+
+
+class LayerList(Layer):
+    """reference: dygraph LayerList."""
+
+    def __init__(self, layers=()):
+        super().__init__()
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+
+    def append(self, layer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self._sublayers)), layer)
+        return self
+
+    def __iter__(self):
+        return iter(self._sublayers.values())
+
+    def __len__(self):
+        return len(self._sublayers)
+
+    def __getitem__(self, i: int) -> Layer:
+        return self._sublayers[str(i)]

@@ -1,0 +1,71 @@
+"""NCE and hierarchical-sigmoid layers (reference:
+python/paddle/fluid/dygraph/nn.py NCE / HSigmoid classes)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import initializer as I
+from ..ops import sampling as SP
+from .layer import Layer
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation head (reference: dygraph/nn.py NCE)."""
+
+    def __init__(self, dim: int, num_total_classes: int,
+                 num_neg_samples: int = 10, sampler: str = "uniform",
+                 bias_attr: bool = True, dtype=None):
+        super().__init__()
+        self.num_neg_samples = num_neg_samples
+        self.sampler = sampler
+        self.create_parameter("weight", (num_total_classes, dim), dtype,
+                              I.XavierUniform())
+        self.has_bias = bias_attr
+        if bias_attr:
+            self.create_parameter("bias", (num_total_classes,), dtype,
+                                  I.Constant(0.0), is_bias=True)
+
+    def forward(self, x, label, custom_neg=None):
+        return SP.nce_loss(
+            x, label, self.weight,
+            bias=self.bias if self.has_bias else None,
+            num_neg_samples=self.num_neg_samples, sampler=self.sampler,
+            key=None if custom_neg is not None else self.rng("nce"),
+            custom_neg=custom_neg)
+
+
+class HSigmoid(Layer):
+    """Hierarchical sigmoid head (reference: dygraph/nn.py HSigmoid /
+    operators/hierarchical_sigmoid_op.cc)."""
+
+    def __init__(self, dim: int, num_classes: int, path_table=None,
+                 path_code=None, bias_attr: bool = True, dtype=None):
+        super().__init__()
+        self.num_classes = num_classes
+        if path_table is not None:
+            self.path_table = jnp.asarray(path_table)
+            self.path_code = jnp.asarray(path_code)
+            num_nodes = int(jnp.max(self.path_table)) + 1
+        else:
+            # precompute the complete-binary-tree paths once; rebuilding per
+            # forward would be a 100k-iteration host loop on big vocabularies
+            from ..ops.sampling import _default_tree_codes
+
+            self.path_table, self.path_code = _default_tree_codes(num_classes)
+            num_nodes = num_classes  # internal nodes of a complete tree < C
+        self.create_parameter("weight", (num_nodes, dim), dtype,
+                              I.XavierUniform())
+        self.has_bias = bias_attr
+        if bias_attr:
+            self.create_parameter("bias", (num_nodes,), dtype,
+                                  I.Constant(0.0), is_bias=True)
+
+    def forward(self, x, label):
+        return SP.hsigmoid_loss(
+            x, label, self.weight,
+            bias=self.bias if self.has_bias else None,
+            num_classes=self.num_classes, path_table=self.path_table,
+            path_code=self.path_code)

@@ -1,0 +1,79 @@
+"""Sparse-gradient capture/inject contexts for embedding layers.
+
+The SelectedRows capability (reference: framework/selected_rows.h:32,
+lookup_table_op.cc is_sparse=True emits SelectedRows grads) redesigned
+for XLA: autodiff of a dense gather scatter-adds into a dense (V, D)
+zeros — an O(V) materialization and O(V) optimizer update per step. The
+TPU-native train step instead splits at the gather boundary:
+
+1. CAPTURE pass: the model forward runs once inside a capture context;
+   each sparse embedding records the ids it consumes (tracers — trace
+   structure only; XLA CSEs the duplicate forward away).
+2. Row gather ``take(table, ids)`` runs OUTSIDE the differentiated
+   function; the loss is differentiated w.r.t. the gathered ROWS
+   (O(B*T, D)), whose cotangent feeds the row-sparse optimizer update
+   (optimizer/sparse.py).
+
+An INJECT context replays the same forward with the pre-gathered rows
+substituted, in the same call order — embedding layers consult
+``active()`` and never touch their table inside the diff'd function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+_STACK: List["_Ctx"] = []
+
+
+def active() -> Optional["_Ctx"]:
+    return _STACK[-1] if _STACK else None
+
+
+class _Ctx:
+    def __init__(self, layer_ids):
+        self.layer_ids = set(layer_ids)
+        self._order: Dict[int, int] = {}  # id(layer) -> call count
+
+    def handles(self, layer) -> bool:
+        return id(layer) in self.layer_ids
+
+    def _slot(self, layer) -> str:
+        k = id(layer)
+        n = self._order.get(k, 0)
+        self._order[k] = n + 1
+        return f"{k}:{n}"
+
+    def __enter__(self):
+        _STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _STACK.pop()
+        return False
+
+
+class Capture(_Ctx):
+    """Records (slot -> ids) for every sparse-embedding call."""
+
+    def __init__(self, layer_ids):
+        super().__init__(layer_ids)
+        self.ids: Dict[str, Any] = {}
+        self.owner: Dict[str, int] = {}  # slot -> id(layer)
+
+    def record(self, layer, ids):
+        slot = self._slot(layer)
+        self.ids[slot] = ids
+        self.owner[slot] = id(layer)
+        return slot
+
+
+class Inject(_Ctx):
+    """Replays pre-gathered rows in the same call order."""
+
+    def __init__(self, layer_ids, rows: Dict[str, Any]):
+        super().__init__(layer_ids)
+        self.rows = rows
+
+    def pop(self, layer):
+        return self.rows[self._slot(layer)]

@@ -1,0 +1,172 @@
+"""Attention ops.
+
+The reference has no attention op — it composes matmul+softmax in python
+(reference: python/paddle/fluid/nets.py:343 scaled_dot_product_attention).
+Here attention is first-class: an XLA path (compiler-fused) and a Pallas
+flash-attention path for long sequences (paddle_tpu.ops.pallas.flash_attention)
+selected automatically on TPU.
+
+Layout convention: (batch, seq, heads, head_dim) — "BTHD".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+
+def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                                 dropout_p: float = 0.0, dropout_key=None,
+                                 scale: Optional[float] = None,
+                                 use_flash: bool = True,
+                                 segment_ids=None,
+                                 window: Optional[int] = None):
+    """q: (B, Tq, H, D), k/v: (B, Tk, H, D) → (B, Tq, H, D).
+
+    mask: broadcastable to (B, H, Tq, Tk); True/1 = keep, False/0 = mask out.
+    segment_ids: (B, T) int ids for packed batches (self-attention only);
+    positions attend within their own segment. Composes with causal/mask.
+    window: sliding-window/local attention — attend only keys within
+    ``window - 1`` positions (lookback-only when causal, symmetric band
+    otherwise); the flash kernel SKIPS out-of-band blocks (O(T*window)
+    compute, the long-context local-attention pattern).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    enforce(segment_ids is None or q.shape[1] == k.shape[1],
+            "segment_ids requires self-attention shapes (tq=%s != tk=%s)",
+            q.shape[1], k.shape[1])
+    enforce(window is None or window >= 1,
+            "window must be >= 1, got %s", window)
+    if use_flash and (dropout_p == 0.0 or dropout_key is not None):
+        # key-padding masks (the broadcast (B, 1, 1, Tk) form every
+        # ragged-batch model emits) ride the flash kernel; anything else
+        # falls back to XLA — including 2D masks, whose historical
+        # broadcast semantics are per-QUERY (Tq, Tk), right-aligned
+        # against the (B, H, Tq, Tk) logits; promoting a (B, Tk)-shaped
+        # one to key-padding would silently change meaning when B == Tq.
+        # Attention-probability dropout runs INSIDE the kernel (in-kernel
+        # counter-based mask) — the training configs with dropout keep
+        # the no-HBM-scores property instead of falling back.
+        kv_mask = _as_kv_mask(mask, q.shape[0], k.shape[1])
+        if mask is None or kv_mask is not None:
+            flash = _get_flash()
+            if flash is not None and _flash_ok(q, k, causal,
+                                               window=window):
+                return flash(q, k, v, causal=causal, scale=scale,
+                             kv_mask=kv_mask, segment_ids=segment_ids,
+                             dropout_p=dropout_p, dropout_key=dropout_key,
+                             window=window)
+    return xla_attention(q, k, v, mask=mask, causal=causal,
+                         dropout_p=dropout_p, dropout_key=dropout_key,
+                         scale=scale, segment_ids=segment_ids,
+                         window=window)
+
+
+def _as_kv_mask(mask, b: int, tk: int):
+    """Normalize a keep-mask to the (B, Tk) key-padding form, or None if
+    it constrains per-head/per-query and must stay on the XLA path.
+    Only the explicit (B, 1, 1, Tk) broadcast form qualifies — a bare 2D
+    mask means per-query (Tq, Tk) under the documented right-aligned
+    broadcast, never key padding."""
+    if mask is None:
+        return None
+    if mask.ndim == 4 and mask.shape[0] in (1, b) and mask.shape[1] == 1 \
+            and mask.shape[2] == 1 and mask.shape[3] == tk:
+        import jax.numpy as _jnp
+
+        return _jnp.broadcast_to(mask[:, 0, 0, :], (b, tk))
+    return None
+
+
+def xla_attention(q, k, v, mask=None, causal: bool = False,
+                  dropout_p: float = 0.0, dropout_key=None,
+                  scale: Optional[float] = None, segment_ids=None,
+                  window: Optional[int] = None):
+    """Reference XLA implementation — materializes (B, H, Tq, Tk) scores."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if k.shape[2] != q.shape[2]:
+        # GQA/MQA: expand the shared K/V heads (kv-major, matching the
+        # flash kernel's head -> head // group mapping)
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    if window is not None:
+        enforce(window >= 1, "window must be >= 1, got %s", window)
+        tq, tk = q.shape[1], k.shape[1]
+        rows = jnp.arange(tq)[:, None] + (tk - tq)  # offset-aligned rows
+        cols = jnp.arange(tk)[None, :]
+        band = rows - cols < window
+        if not causal:
+            band = band & (cols - rows < window)
+        mask = band if mask is None else (mask.astype(jnp.bool_) & band)
+    if segment_ids is not None:
+        ids = segment_ids
+        seg = (ids[:, None, :, None] == ids[:, None, None, :])
+        mask = seg if mask is None else (mask.astype(jnp.bool_) & seg)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    neg = jnp.finfo(logits.dtype).min
+    keep = None
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        keep = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
+        logits = jnp.where(keep, logits, neg)
+    if mask is not None:
+        mask = mask.astype(jnp.bool_)
+        keep = mask if keep is None else (keep & mask)
+        logits = jnp.where(mask, logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if keep is not None:
+        # rows with no valid key output zeros (flash-kernel convention),
+        # not a uniform average of V
+        any_valid = jnp.any(jnp.broadcast_to(keep, logits.shape), -1,
+                            keepdims=True)
+        probs = jnp.where(any_valid, probs, 0.0)
+    if dropout_p > 0.0:
+        enforce(dropout_key is not None, "attention dropout requires a key")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.lru_cache(maxsize=1)
+def _get_flash():
+    try:
+        from .pallas.flash_attention import flash_attention
+
+        return flash_attention
+    except Exception:
+        return None
+
+
+def _flash_ok(q, k, causal: bool = False, window=None) -> bool:
+    """Flash kernel constraints: TPU backend, block-divisible seq lens,
+    supported head dim — and the autotuner's measured verdict when one
+    exists (tools/pallas_tune.py records use_flash=False for shape
+    buckets where the XLA fallback won on-chip)."""
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
+    # 64-divisible seqs use block=64 (the tuner measures that shape too:
+    # tools/pallas_tune.py short-seq fallback); the measured use_flash
+    # verdict below still decides whether the kernel actually wins there
+    if not (tq % 64 == 0 and tk % 64 == 0 and d in (64, 128, 256)):
+        return False
+    if window is not None and window < tk:
+        # tuned verdicts are measured at DENSE attention; banded flash
+        # skips out-of-band blocks (O(T*window)) while the XLA fallback
+        # stays O(T^2) — a dense use_flash=False must not veto it.
+        # window >= tk is dense in disguise: fall through to the verdict
+        return True
+    from .pallas.tuning import attention_key, get_tuned
+
+    tuned = get_tuned(attention_key(tq, tk, d, causal))
+    if tuned is not None and not tuned.get("use_flash", True):
+        return False
+    return True

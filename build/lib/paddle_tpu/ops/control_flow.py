@@ -1,0 +1,159 @@
+"""Structured control flow — the XLA-native replacement for the reference's
+sub-block interpreter ops (reference: paddle/fluid/operators/controlflow/
+while_op.cc, conditional_block_op.cc, recurrent_op.cc and the python
+StaticRNN/DynamicRNN/While/IfElse layers in layers/control_flow.py).
+
+Design stance (SURVEY §7): no data-dependent Python control flow inside jit —
+these wrap `lax.while_loop/cond/scan/switch` with reference-flavored names so
+user code ports cleanly. `static_rnn` is the recurrent_op analog; `case`/
+`switch_case` mirror the python layers of the same name. Compare/logical ops
+(reference: controlflow/compare_op.cc:113-134, logical_op.cc) live here too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# --- compare ops (REGISTER_COMPARE_OP family) ------------------------------
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+# --- logical ops -----------------------------------------------------------
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+# --- structured control flow ----------------------------------------------
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Any):
+    """reference: while_op.cc — trace-compatible while. `loop_vars` is a pytree."""
+    return lax.while_loop(cond, body, loop_vars)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """reference: conditional_block_op.cc / layers.cond."""
+    return lax.cond(pred, true_fn, false_fn, *operands)
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]], default: Callable = None):
+    """reference: python layers.case — first true predicate wins."""
+    def build(i):
+        if i == len(pred_fn_pairs):
+            if default is None:
+                raise ValueError("case: no predicate matched and no default")
+            return default()
+        pred, fn = pred_fn_pairs[i]
+        return lax.cond(pred, fn, lambda: build(i + 1))
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns: Sequence[Callable], *operands):
+    """reference: python layers.switch_case → lax.switch."""
+    return lax.switch(branch_index, list(branch_fns), *operands)
+
+
+def scan(f: Callable, init: Any, xs: Any, length: int = None, reverse: bool = False,
+         unroll: int = 1):
+    """The workhorse loop — replaces StaticRNN/recurrent_op
+    (reference: operators/recurrent_op.cc)."""
+    return lax.scan(f, init, xs, length=length, reverse=reverse, unroll=unroll)
+
+
+def static_rnn(step_fn: Callable, inputs, initial_states,
+               time_major: bool = False):
+    """StaticRNN analog (reference: layers/control_flow.py StaticRNN).
+
+    ``step_fn(x_t, states) -> (output_t, new_states)``; inputs is a pytree of
+    (B, T, ...) arrays (or (T, B, ...) when time_major).
+    Returns (outputs stacked on time axis, final_states).
+    """
+    if not time_major:
+        inputs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), inputs)
+
+    def body(states, x_t):
+        out_t, new_states = step_fn(x_t, states)
+        return new_states, out_t
+
+    final_states, outs = lax.scan(body, initial_states, inputs)
+    if not time_major:
+        outs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), outs)
+    return outs, final_states
+
+
+def fori_loop(lower, upper, body: Callable, init):
+    return lax.fori_loop(lower, upper, body, init)
+
+
+# --- tensor array ----------------------------------------------------------
+
+class TensorArray:
+    """Trace-compatible tensor array of fixed max size — the
+    write_to_array/read_from_array/array_to_lod_tensor capability (reference:
+    operators/tensor_array_read_write_op.cc) on a dense preallocated buffer."""
+
+    def __init__(self, size: int, element_shape, dtype=jnp.float32, buffer=None):
+        self.size = size
+        if buffer is not None:
+            self.buffer = buffer
+        else:
+            self.buffer = jnp.zeros((size,) + tuple(element_shape), dtype)
+
+    def write(self, index, value) -> "TensorArray":
+        return TensorArray(self.size, value.shape, value.dtype,
+                           buffer=lax.dynamic_update_index_in_dim(
+                               self.buffer, value, index, 0))
+
+    def read(self, index):
+        return lax.dynamic_index_in_dim(self.buffer, index, 0, keepdims=False)
+
+    def stack(self):
+        return self.buffer
+
+
+jax.tree_util.register_pytree_node(
+    TensorArray,
+    lambda ta: ((ta.buffer,), (ta.size,)),
+    lambda aux, children: TensorArray(aux[0], children[0].shape[1:],
+                                      children[0].dtype, buffer=children[0]),
+)

@@ -1,0 +1,438 @@
+"""Sequence decoding + structured-prediction ops.
+
+Capability parity with the reference's CTC, beam-search and CRF operators
+(reference: operators/warpctc_op.cc — external warp-ctc library;
+ctc_align_op.cc; beam_search_op.cc + beam_search_decode_op.cc — LoD-based
+per-step beam bookkeeping; linear_chain_crf_op.cc; crf_decoding_op.cc;
+edit_distance_op.cc), redesigned for XLA: log-space dynamic programs as
+``lax.scan`` over time with static shapes and length masks — no external
+CTC library (the MXU-friendly formulation IS the framework's kernel), no
+LoD (ragged = dense + lengths, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+__all__ = ["ctc_loss", "ctc_align", "ctc_greedy_decode", "beam_search_step",
+           "beam_search", "beam_search_decode", "beam_search_batch_step",
+           "beam_search_decode_lod", "gather_beams", "linear_chain_crf",
+           "crf_decoding", "edit_distance"]
+
+_NEG = -1e30
+
+
+def _logsumexp2(a, b):
+    m = jnp.maximum(a, b)
+    dead = m <= _NEG
+    m_safe = jnp.where(dead, 0.0, m)
+    s = jnp.exp(a - m_safe) + jnp.exp(b - m_safe)
+    # the dead branch must stay NaN-free under grad: log(0) -> log(1)
+    out = m_safe + jnp.log(jnp.where(dead, 1.0, s))
+    return jnp.where(dead, _NEG, out)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, *,
+             blank: int = 0):
+    """CTC negative log-likelihood (reference: operators/warpctc_op.cc wraps
+    the external warp-ctc kernel; here the alpha recursion runs in log space
+    as one lax.scan over time — batched, static, differentiable by JAX).
+
+    log_probs: (B, T, V) log-softmax outputs; labels: (B, L) padded;
+    input_lengths (B,), label_lengths (B,). Returns (B,) losses.
+    """
+    B, T, V = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    # extended label sequence: blank l1 blank l2 ... blank
+    ext = jnp.full((B, S), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    # transitions: alpha[s] <- alpha[s] + alpha[s-1] (+ alpha[s-2] if the
+    # symbol differs from the one two back and isn't blank)
+    prev2_ok = jnp.zeros((B, S), bool)
+    prev2_ok = prev2_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    def step(alpha, t):
+        lp = log_probs[:, t]  # (B, V)
+        emit = jnp.take_along_axis(lp, ext, axis=1)  # (B, S)
+        a1 = jnp.concatenate([jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(prev2_ok, a2, _NEG)
+        new = _logsumexp2(_logsumexp2(alpha, a1), a2) + emit
+        # frozen past input_length: keep alpha (final read below)
+        new = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha0 = jnp.full((B, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(
+        jnp.take_along_axis(log_probs[:, 0], ext[:, :1], axis=1)[:, 0])
+    has1 = label_lengths > 0
+    a01 = jnp.take_along_axis(log_probs[:, 0], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(has1, a01, _NEG))
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # total prob = alpha[last blank] + alpha[last label]
+    send = 2 * label_lengths  # index of final blank
+    a_end = jnp.take_along_axis(alpha, send[:, None], axis=1)[:, 0]
+    a_lab = jnp.take_along_axis(alpha,
+                                jnp.maximum(send - 1, 0)[:, None],
+                                axis=1)[:, 0]
+    a_lab = jnp.where(label_lengths > 0, a_lab, _NEG)
+    return -_logsumexp2(a_end, a_lab)
+
+
+def ctc_align(ids, lengths, *, blank: int = 0):
+    """Collapse repeats then drop blanks (reference:
+    operators/ctc_align_op.cc). ids (B, T) -> (out (B, T), out_lengths (B,))
+    padded with ``blank`` — fixed capacity instead of LoD shrinkage."""
+    B, T = ids.shape
+    prev = jnp.concatenate([jnp.full((B, 1), -1, ids.dtype), ids[:, :-1]],
+                           axis=1)
+    t_idx = jnp.arange(T)[None, :]
+    keep = (ids != blank) & (ids != prev) & (t_idx < lengths[:, None])
+    # stable compaction: position = cumsum of keep - 1
+    pos = jnp.cumsum(keep, axis=1) - 1
+    out_len = jnp.max(jnp.where(keep, pos + 1, 0), axis=1)
+    out = jnp.full((B, T), blank, ids.dtype)
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    # dropped writes: position T is out of bounds -> mode="drop" discards
+    scatter_pos = jnp.where(keep, pos, T)
+    out = out.at[b_idx, scatter_pos].set(ids, mode="drop")
+    return out, out_len
+
+
+def ctc_greedy_decode(log_probs, lengths, *, blank: int = 0):
+    """argmax per frame + ctc_align — the reference's greedy CTC decoder
+    composition (ctc_align over top-1 ids)."""
+    ids = jnp.argmax(log_probs, axis=-1)
+    return ctc_align(ids, lengths, blank=blank)
+
+
+# ---------------------------------------------------------------------------
+# Beam search
+# ---------------------------------------------------------------------------
+
+def beam_search_step(scores, beam_log_probs, finished, *, beam_size: int,
+                     end_id: int, length_penalty: float = 0.0, step=1,
+                     lengths=None):
+    """One expansion step (the reference's beam_search op,
+    operators/beam_search_op.cc, minus LoD bookkeeping): scores (K, V)
+    log-probs for each live beam, beam_log_probs (K,) accumulated.
+
+    GNMT length normalization: candidates are RANKED by
+    ``total / ((5 + len) / 6) ** length_penalty`` where ``len`` is each
+    hypothesis's OWN token count — live candidates grow to ``step``,
+    finished beams keep the frozen length carried in ``lengths`` (K,).
+    The per-hypothesis lengths are what make the penalty observable: a
+    step-uniform divisor could never change a top-k. Accumulated scores
+    stay un-penalized. ``lengths=None`` starts every beam at ``step``.
+
+    Returns (next_acc (K,), parent (K,), token (K,), next_finished (K,),
+    next_lengths (K,)). Finished beams propagate with only the end_id
+    continuation.
+    """
+    K, V = scores.shape
+    if lengths is None:
+        lengths = jnp.full((K,), step, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    # finished beams: freeze score, only end_id continues
+    frozen = jnp.full((V,), _NEG).at[end_id].set(0.0)
+    total = jnp.where(finished[:, None], beam_log_probs[:, None] + frozen,
+                      beam_log_probs[:, None] + scores)  # (K, V)
+    step_i = jnp.asarray(step, jnp.int32)
+    cand_len = jnp.where(finished[:, None], lengths[:, None],
+                         step_i)                           # (K, V)
+    lp = ((5.0 + cand_len.astype(total.dtype)) / 6.0) ** length_penalty
+    ranked = total / lp
+    top, flat = lax.top_k(ranked.reshape(-1), K)
+    parent = flat // V
+    token = flat % V
+    next_acc = total.reshape(-1)[flat]
+    next_fin = finished[parent] | (token == end_id)
+    # already-finished keep their frozen length; newly-finished and live
+    # candidates are `step` tokens long
+    next_len = jnp.where(finished[parent], lengths[parent], step_i)
+    return next_acc, parent, token, next_fin, next_len
+
+
+def beam_search(init_state, step_fn: Callable, *, beam_size: int,
+                max_len: int, bos_id: int, end_id: int,
+                length_penalty: float = 0.0):
+    """Full decode loop (the reference composes beam_search +
+    beam_search_decode ops inside a While block, layers/control_flow.py
+    DynamicRNN; here it's one lax.scan with pointer backtracking).
+
+    step_fn(state, token (K,)) -> (log_probs (K, V), new_state); state
+    leaves must carry a leading beam axis (K, ...).
+
+    Returns (sequences (K, max_len), scores (K,)) best-first.
+    """
+    tok0 = jnp.full((beam_size,), bos_id, jnp.int32)
+    acc0 = jnp.full((beam_size,), _NEG).at[0].set(0.0)  # only beam 0 live
+    fin0 = jnp.zeros((beam_size,), bool)
+    len0 = jnp.zeros((beam_size,), jnp.int32)
+
+    def tick(carry, t):
+        state, tok, acc, fin, lens = carry
+        logp, state = step_fn(state, tok)
+        acc, parent, tok, fin, lens = beam_search_step(
+            logp, acc, fin, beam_size=beam_size, end_id=end_id,
+            length_penalty=length_penalty, step=t + 1, lengths=lens)
+        state = jax.tree_util.tree_map(lambda s: s[parent], state)
+        return (state, tok, acc, fin, lens), (parent, tok)
+
+    (_, _, acc, _, lens), (parents, tokens) = lax.scan(
+        tick, (init_state, tok0, acc0, fin0, len0), jnp.arange(max_len))
+
+    # backtrack: walk parent pointers from the end (reference:
+    # beam_search_decode_op.cc walks the LoD sentence tree)
+    def backtrack(beam_idx):
+        def body(carry, t):
+            bi, = carry
+            tok = tokens[t][bi]
+            return (parents[t][bi],), tok
+
+        _, seq = lax.scan(body, (beam_idx,), jnp.arange(max_len)[::-1])
+        return seq[::-1]
+
+    seqs = jax.vmap(backtrack)(jnp.arange(beam_size))
+    # final ranking is length-normalized (GNMT); returned scores stay raw
+    lp = ((5.0 + jnp.maximum(lens, 1).astype(acc.dtype)) / 6.0
+          ) ** length_penalty
+    order = jnp.argsort(-(acc / lp))
+    return seqs[order], acc[order]
+
+
+# ---------------------------------------------------------------------------
+# Linear-chain CRF
+# ---------------------------------------------------------------------------
+
+def linear_chain_crf(emissions, transitions, labels, lengths, *,
+                     start_transitions=None, stop_transitions=None):
+    """Negative log-likelihood of a linear-chain CRF (reference:
+    operators/linear_chain_crf_op.cc — its transition matrix packs start/
+    stop weights in rows 0/1; here they are explicit optional args).
+
+    emissions (B, T, N), labels (B, T), lengths (B,) -> (B,) nll.
+    """
+    B, T, N = emissions.shape
+    start = (start_transitions if start_transitions is not None
+             else jnp.zeros((N,)))
+    stop = (stop_transitions if stop_transitions is not None
+            else jnp.zeros((N,)))
+
+    # --- partition via forward algorithm ---
+    def fwd(alpha, t):
+        e = emissions[:, t]  # (B, N)
+        new = jax.nn.logsumexp(alpha[:, :, None] + transitions[None], axis=1)
+        new = new + e
+        new = jnp.where((t < lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha0 = start[None] + emissions[:, 0]
+    alpha, _ = lax.scan(fwd, alpha0, jnp.arange(1, T))
+    log_z = jax.nn.logsumexp(alpha + stop[None], axis=1)
+
+    # --- gold path score ---
+    t_idx = jnp.arange(T)[None, :]
+    emit = jnp.take_along_axis(emissions, labels[..., None], axis=2)[..., 0]
+    emit = jnp.where(t_idx < lengths[:, None], emit, 0.0).sum(axis=1)
+    trans = transitions[labels[:, :-1], labels[:, 1:]]  # (B, T-1)
+    trans = jnp.where(t_idx[:, 1:] < lengths[:, None], trans, 0.0).sum(axis=1)
+    first = start[labels[:, 0]]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_lab = jnp.take_along_axis(labels, last_idx[:, None], axis=1)[:, 0]
+    gold = emit + trans + first + stop[last_lab]
+    return log_z - gold
+
+
+def crf_decoding(emissions, transitions, lengths, *,
+                 start_transitions=None, stop_transitions=None):
+    """Viterbi decode (reference: operators/crf_decoding_op.cc) ->
+    (paths (B, T), scores (B,)). Positions past ``lengths`` hold 0."""
+    B, T, N = emissions.shape
+    start = (start_transitions if start_transitions is not None
+             else jnp.zeros((N,)))
+    stop = (stop_transitions if stop_transitions is not None
+            else jnp.zeros((N,)))
+
+    def fwd(carry, t):
+        score = carry  # (B, N)
+        cand = score[:, :, None] + transitions[None]  # (B, N, N)
+        best_prev = jnp.argmax(cand, axis=1)  # (B, N)
+        new = jnp.max(cand, axis=1) + emissions[:, t]
+        new = jnp.where((t < lengths)[:, None], new, score)
+        ptr = jnp.where((t < lengths)[:, None], best_prev,
+                        jnp.broadcast_to(jnp.arange(N)[None], (B, N)))
+        return new, ptr
+
+    score0 = start[None] + emissions[:, 0]
+    score, ptrs = lax.scan(fwd, score0, jnp.arange(1, T))  # ptrs (T-1, B, N)
+    final = score + stop[None]
+    best_last = jnp.argmax(final, axis=1)  # (B,)
+    best_score = jnp.max(final, axis=1)
+
+    def backtrack(b):
+        def body(carry, t):
+            cur = carry
+            prev = ptrs[t, b, cur]
+            return prev, cur
+
+        last, path_rev = lax.scan(body, best_last[b],
+                                  jnp.arange(T - 1)[::-1])
+        return jnp.concatenate([jnp.asarray([last]), path_rev[::-1]])
+
+    paths = jax.vmap(backtrack)(jnp.arange(B))
+    paths = jnp.where(jnp.arange(T)[None] < lengths[:, None], paths, 0)
+    return paths, best_score
+
+
+def edit_distance(hyp, hyp_lengths, ref, ref_lengths, *,
+                  normalized: bool = False):
+    """Levenshtein distance on padded id sequences (reference:
+    operators/edit_distance_op.cc) — DP over the hypothesis axis as a scan,
+    static (B, Lr) rows. Returns (B,) distances (float)."""
+    B, Lh = hyp.shape
+    Lr = ref.shape[1]
+
+    def per_batch(h, hl, r, rl):
+        row0 = jnp.arange(Lr + 1, dtype=jnp.float32)
+
+        def step(row, i):
+            # row: distances vs ref prefix for hyp prefix i
+            ins = row[0] + 1
+
+            def inner(carry, j):
+                left = carry  # new_row[j]
+                sub = row[j] + (h[i] != r[j])
+                dele = row[j + 1] + 1
+                best = jnp.minimum(jnp.minimum(left + 1, dele), sub)
+                return best, best
+
+            _, rest = lax.scan(inner, ins, jnp.arange(Lr))
+            new_row = jnp.concatenate([jnp.asarray([ins]), rest])
+            new_row = jnp.where(i < hl, new_row, row)
+            return new_row, None
+
+        row, _ = lax.scan(step, row0, jnp.arange(Lh))
+        d = row[rl]
+        return d / jnp.maximum(rl, 1) if normalized else d
+
+    return jax.vmap(per_batch)(hyp, hyp_lengths, ref, ref_lengths)
+
+
+def beam_search_decode(step_ids, step_parents, step_scores=None, *,
+                       end_id: int = 1):
+    """Backtrack per-step beam candidates into full sequences (reference:
+    operators/beam_search_decode_op.cc — walks the LoD parent links; here
+    parents are an explicit array, the padded-dense form of that link).
+
+    step_ids (T, B, K): token chosen by each beam at each step.
+    step_parents (T, B, K): index in [0, K) of the parent beam at t-1.
+    step_scores (T, B, K) optional: cumulative scores per beam.
+
+    Returns (sequences (B, K, T) backtracked token ids, scores (B, K) —
+    each beam's final cumulative score, zeros if none given).
+    """
+    T, B, K = step_ids.shape
+
+    def backtrack_one(ids_tb, parents_tb):
+        # ids_tb, parents_tb: (T, K)
+        def run(k):
+            def step(carry, t):
+                beam_idx, acc = carry
+                tok = ids_tb[t][beam_idx]
+                parent = parents_tb[t][beam_idx]
+                return (parent, acc.at[t].set(tok)), None
+
+            init = (jnp.asarray(k), jnp.zeros((T,), step_ids.dtype))
+            (final_parent, acc), _ = lax.scan(
+                step, init, jnp.arange(T - 1, -1, -1))
+            return acc
+
+        return jax.vmap(run)(jnp.arange(K))  # (K, T)
+
+    seqs = jax.vmap(backtrack_one)(jnp.transpose(step_ids, (1, 0, 2)),
+                                   jnp.transpose(step_parents, (1, 0, 2)))
+    scores = (step_scores[-1] if step_scores is not None
+              else jnp.zeros((B, K), jnp.float32))
+    return seqs, scores
+
+
+def beam_search_batch_step(log_probs, pre_scores, finished, step,
+                           lengths=None, *, beam_size: int, end_id: int,
+                           length_penalty: float = 0.0):
+    """Batched form of :func:`beam_search_step` — the op the reference
+    runs INSIDE its decode While block (reference:
+    operators/beam_search_op.cc; layers/nn.py beam_search), redesigned
+    for static shapes: each source keeps exactly K live beams.
+
+    log_probs (B, K, V), pre_scores (B, K), finished (B, K) bool-ish,
+    step scalar (the loop counter — drives the length penalty),
+    lengths (B, K) frozen hypothesis lengths (None starts at ``step``).
+    Returns (acc (B, K), parent (B, K) int32, token (B, K) int32,
+    finished (B, K) bool, lengths (B, K) int32).
+    """
+    t = jnp.reshape(step, ()).astype(jnp.int32)
+    if lengths is None:
+        lengths = jnp.broadcast_to(t, pre_scores.shape)
+
+    def one(lp, acc, fin, lens):
+        return beam_search_step(lp, acc, fin.astype(bool),
+                                beam_size=beam_size, end_id=end_id,
+                                length_penalty=length_penalty, step=t,
+                                lengths=lens)
+
+    acc, parent, token, fin, lens = jax.vmap(one)(
+        log_probs, pre_scores, finished, lengths)
+    return (acc, parent.astype(jnp.int32), token.astype(jnp.int32), fin,
+            lens)
+
+
+def gather_beams(x, parent):
+    """Reorder per-beam state by parent index: x (B, K, ...),
+    parent (B, K) -> x[b, parent[b, k]] (the state shuffle the
+    reference gets implicitly from beam_search's LoD selection)."""
+    idx = parent.astype(jnp.int32)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - idx.ndim))
+    return jnp.take_along_axis(x, jnp.broadcast_to(
+        idx, idx.shape[:2] + x.shape[2:]), axis=1)
+
+
+def beam_search_decode_lod(step_ids, step_parents, final_scores, *,
+                           end_id: int = 1,
+                           length_penalty: float = 0.0):
+    """Backtrack + rank + measure: the full beam_search_decode contract
+    (reference: operators/beam_search_decode_op.cc returns a LoD
+    level-2 tensor — level 1 = per-source candidate list, level 2 =
+    each candidate's tokens). The padded-dense equivalent of that
+    nested LoD is the triple returned here:
+
+    - sequences (B, K, T): candidate k of source b, best-first
+      (ranked by final score),
+    - lengths (B, K): its true token count (up to and including the
+      first ``end_id``; T when the beam never finished) — the level-2
+      offsets; K itself is the uniform level-1 fan-out,
+    - scores (B, K): final cumulative log-prob, descending.
+    """
+    seqs, _ = beam_search_decode(step_ids, step_parents, end_id=end_id)
+    T = step_ids.shape[0]
+    is_end = seqs == end_id
+    has_end = is_end.any(axis=-1)
+    first = jnp.argmax(is_end, axis=-1)
+    lengths = jnp.where(has_end, first + 1, T).astype(jnp.int32)
+    # rank length-normalized (GNMT); returned scores stay raw
+    lp = ((5.0 + jnp.maximum(lengths, 1).astype(final_scores.dtype))
+          / 6.0) ** length_penalty
+    order = jnp.argsort(-(final_scores / lp), axis=1)   # (B, K)
+    seqs = gather_beams(seqs, order)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    scores = jnp.take_along_axis(final_scores, order, axis=1)
+    return seqs, lengths, scores

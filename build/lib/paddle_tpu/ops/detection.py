@@ -1,0 +1,695 @@
+"""Detection op suite — capability parity with the reference's
+`paddle/fluid/operators/detection/` (56 files: anchors, bbox coding, IoU,
+NMS, RoI pooling, YOLO decoding, proposal generation...), re-designed for
+XLA: **every op is static-shape**. Where the reference returns
+variable-length LoD outputs (e.g. multiclass_nms keeps "however many
+survive", detection/multiclass_nms_op.cc), the TPU-native contract returns
+fixed-capacity buffers plus a validity mask/count — the compiler-friendly
+ragged encoding used throughout this framework (SURVEY.md §5.7).
+
+Boxes are [x1, y1, x2, y2] unless noted, matching the reference layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+__all__ = [
+    "iou_similarity", "box_coder", "box_clip", "prior_box",
+    "density_prior_box", "anchor_generator", "yolo_box", "nms",
+    "multiclass_nms", "matrix_nms", "roi_align", "roi_pool",
+    "generate_proposals", "bipartite_match", "target_assign",
+    "distribute_fpn_proposals", "collect_fpn_proposals", "polygon_box_transform",
+]
+
+
+# ---------------------------------------------------------------------------
+# IoU + coding
+# ---------------------------------------------------------------------------
+
+def _area(boxes):
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def iou_similarity(boxes1, boxes2):
+    """Pairwise IoU, (N, 4) x (M, 4) -> (N, M).
+    reference: operators/detection/iou_similarity_op.cc"""
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _area(boxes1)[:, None] + _area(boxes2)[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_coder(prior_boxes, prior_variances, target, *,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True):
+    """Encode boxes against priors (or decode deltas back to boxes).
+    reference: operators/detection/box_coder_op.cc — center-size coding.
+
+    encode: target (N, 4) gt boxes, priors (M, 4) -> (N, M, 4) deltas
+    decode: target (N, M, 4) (or (M, 4)) deltas -> boxes
+    """
+    pv = jnp.asarray(prior_variances)
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_boxes[:, 2] - prior_boxes[:, 0] + norm
+    ph = prior_boxes[:, 3] - prior_boxes[:, 1] + norm
+    pcx = prior_boxes[:, 0] + pw * 0.5
+    pcy = prior_boxes[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + norm
+        th = target[:, 3] - target[:, 1] + norm
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        return out / pv if pv.ndim <= 1 else out / pv[None, :, :]
+    enforce(code_type == "decode_center_size",
+            "unknown code_type %s", code_type)
+    deltas = target if target.ndim == 3 else target[None]
+    d = deltas * (pv if pv.ndim <= 1 else pv[None])
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph + pcy
+    w = jnp.exp(d[..., 2]) * pw
+    h = jnp.exp(d[..., 3]) * ph
+    boxes = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                       cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+    return boxes if target.ndim == 3 else boxes[0]
+
+
+def box_clip(boxes, im_shape):
+    """Clip boxes into [0, w-1] x [0, h-1].
+    reference: operators/detection/box_clip_op.cc"""
+    h, w = im_shape[0], im_shape[1]
+    x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def polygon_box_transform(x):
+    """(B, 8, H, W) quad offsets -> absolute coords (EAST-style).
+    reference: operators/detection/polygon_box_transform_op.cc"""
+    B, C, H, W = x.shape
+    gy = jnp.arange(H).reshape(1, 1, H, 1)
+    gx = jnp.arange(W).reshape(1, 1, 1, W)
+    is_x = (jnp.arange(C) % 2 == 0).reshape(1, C, 1, 1)
+    grid = jnp.where(is_x, 4 * gx, 4 * gy)
+    return grid - x
+
+
+# ---------------------------------------------------------------------------
+# Anchors
+# ---------------------------------------------------------------------------
+
+def expand_aspect_ratios(aspect_ratios: Sequence[float],
+                         flip: bool = False) -> list:
+    """The SSD prior aspect-ratio expansion (dedup + optional reciprocal),
+    shared by prior_box and nn.MultiBoxHead so conv channel counts always
+    match generated prior counts."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    return ars
+
+
+def prior_box_count(min_sizes: Sequence[float], max_sizes: Sequence[float],
+                    aspect_ratios: Sequence[float],
+                    flip: bool = False) -> int:
+    """Number of priors per spatial cell that prior_box will generate."""
+    ars = expand_aspect_ratios(aspect_ratios, flip)
+    return len(min_sizes) * len(ars) + len(list(zip(min_sizes, max_sizes)))
+
+
+def prior_box(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
+              min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
+              aspect_ratios: Sequence[float] = (1.0,), *,
+              variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+              flip: bool = False, clip: bool = False,
+              step: Tuple[float, float] = (0.0, 0.0),
+              offset: float = 0.5):
+    """SSD prior boxes for one feature map -> ((H, W, A, 4) boxes, vars).
+    reference: operators/detection/prior_box_op.cc"""
+    H, W = feature_hw
+    img_h, img_w = image_hw
+    step_h = step[0] or img_h / H
+    step_w = step[1] or img_w / W
+    ars = expand_aspect_ratios(aspect_ratios, flip)
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+    for ms, mx in zip(min_sizes, max_sizes):
+        whs.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+    wh = jnp.asarray(whs, jnp.float32)  # (A, 2)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # (H, W)
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # (H, W, 1, 2)
+    half = wh[None, None] / 2.0
+    boxes = jnp.concatenate([c - half, c + half], axis=-1)
+    boxes = boxes / jnp.asarray([img_w, img_h, img_w, img_h], jnp.float32)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+def density_prior_box(feature_hw, image_hw, fixed_sizes, fixed_ratios,
+                      densities, *, variances=(0.1, 0.1, 0.2, 0.2),
+                      offset: float = 0.5, clip: bool = False,
+                      step=(0.0, 0.0)):
+    """Densified priors (multiple shifted centers per cell).
+    reference: operators/detection/density_prior_box_op.cc"""
+    H, W = feature_hw
+    img_h, img_w = image_hw
+    step_h = step[0] or img_h / H
+    step_w = step[1] or img_w / W
+    all_boxes = []
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_w / density
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            for di in range(density):
+                for dj in range(density):
+                    ccx = cxg - step_w / 2.0 + shift / 2.0 + dj * shift
+                    ccy = cyg - step_h / 2.0 + shift / 2.0 + di * shift
+                    b = jnp.stack([ccx - bw / 2, ccy - bh / 2,
+                                   ccx + bw / 2, ccy + bh / 2], -1)
+                    all_boxes.append(b)
+    boxes = jnp.stack(all_boxes, axis=2)  # (H, W, A, 4)
+    boxes = boxes / jnp.asarray([img_w, img_h, img_w, img_h], jnp.float32)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+def anchor_generator(feature_hw, anchor_sizes, aspect_ratios, stride, *,
+                     variances=(0.1, 0.1, 0.2, 0.2), offset: float = 0.5):
+    """RPN anchors -> ((H, W, A, 4), vars), absolute pixel coords.
+    reference: operators/detection/anchor_generator_op.cc"""
+    H, W = feature_hw
+    whs = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            area = float(s) * float(s)
+            w = (area / ar) ** 0.5
+            whs.append((w, w * ar))
+    wh = jnp.asarray(whs, jnp.float32)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]
+    half = wh[None, None] / 2.0
+    anchors = jnp.concatenate([c - half, c + half], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+    return anchors, var
+
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float, downsample_ratio: int):
+    """Decode one YOLOv3 head: (B, A*(5+C), H, W) -> boxes (B, H*W*A, 4),
+    scores (B, H*W*A, C). reference: operators/detection/yolo_box_op.cc"""
+    B, _, H, W = x.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    x = x.reshape(B, A, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32).reshape(1, 1, 1, W)
+    gy = jnp.arange(H, dtype=jnp.float32).reshape(1, 1, H, 1)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / W
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / H
+    input_w = downsample_ratio * W
+    input_h = downsample_ratio * H
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[..., 0].reshape(B, 1, 1, 1).astype(jnp.float32)
+    img_w = img_size[..., 1].reshape(B, 1, 1, 1).astype(jnp.float32)
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # (B, A, H, W, 4)
+    keep = conf > conf_thresh
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    probs = jnp.where(keep[:, :, None], probs, 0.0)  # (B, A, C, H, W)
+    # flatten both in (h, w, a) order so scores[b, i] matches boxes[b, i]
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(B, H * W * A, 4)
+    scores = probs.transpose(0, 3, 4, 1, 2).reshape(B, H * W * A, class_num)
+    return boxes, scores
+
+
+# ---------------------------------------------------------------------------
+# NMS family — fixed-capacity outputs
+# ---------------------------------------------------------------------------
+
+def nms(boxes, scores, *, iou_threshold: float = 0.3,
+        score_threshold: float = -jnp.inf, max_out: int = 100):
+    """Greedy hard-NMS. Returns (indices (max_out,), valid_mask (max_out,)).
+
+    TPU-native contract for the reference's variable-output NMS
+    (reference: operators/detection/multiclass_nms_op.cc NMSFast): output
+    capacity is static; invalid slots have index 0 and mask False. O(K*N)
+    masked iterations instead of data-dependent loops.
+    """
+    n = boxes.shape[0]
+    k = min(max_out, n)
+    iou = iou_similarity(boxes, boxes)
+    live = scores > score_threshold
+
+    def body(carry, _):
+        live, = carry
+        masked = jnp.where(live, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        ok = masked[i] > -jnp.inf
+        # kill the chosen box and everything overlapping it
+        suppress = iou[i] >= iou_threshold
+        live = live & ~suppress & (jnp.arange(n) != i)
+        return (live,), (jnp.where(ok, i, 0), ok)
+
+    (_, ), (idx, ok) = lax.scan(body, (live,), None, length=k)
+    if k < max_out:
+        idx = jnp.pad(idx, (0, max_out - k))
+        ok = jnp.pad(ok, (0, max_out - k))
+    return idx, ok
+
+
+def multiclass_nms(boxes, scores, *, score_threshold: float = 0.01,
+                   nms_threshold: float = 0.3, nms_top_k: int = 64,
+                   keep_top_k: int = 100, background_label: int = 0):
+    """Per-class NMS then global top-k, one image.
+
+    boxes (N, 4), scores (C, N) -> (keep_top_k, 6) [label, score, x1, y1,
+    x2, y2] + valid mask. reference: detection/multiclass_nms_op.cc.
+    """
+    C, N = scores.shape
+
+    def per_class(c_scores):
+        top = min(nms_top_k, N)
+        s, order = lax.top_k(c_scores, top)
+        idx, ok = nms(boxes[order], s, iou_threshold=nms_threshold,
+                      score_threshold=score_threshold, max_out=top)
+        return order[idx], s[idx], ok
+
+    cls_idx, cls_score, cls_ok = jax.vmap(per_class)(scores)  # (C, top)
+    labels = jnp.broadcast_to(jnp.arange(C)[:, None], cls_idx.shape)
+    is_bg = labels == background_label
+    flat_score = jnp.where(cls_ok & ~is_bg, cls_score, -jnp.inf).reshape(-1)
+    k = min(keep_top_k, flat_score.shape[0])
+    best, flat_i = lax.top_k(flat_score, k)
+    sel_box = boxes[cls_idx.reshape(-1)[flat_i]]
+    sel_label = labels.reshape(-1)[flat_i].astype(jnp.float32)
+    valid = best > -jnp.inf
+    out = jnp.concatenate([sel_label[:, None],
+                           jnp.where(valid, best, 0.0)[:, None],
+                           jnp.where(valid[:, None], sel_box, 0.0)], axis=1)
+    if k < keep_top_k:
+        out = jnp.pad(out, ((0, keep_top_k - k), (0, 0)))
+        valid = jnp.pad(valid, (0, keep_top_k - k))
+    return out, valid
+
+
+def matrix_nms(boxes, scores, *, score_threshold: float = 0.01,
+               post_threshold: float = 0.0, keep_top_k: int = 100,
+               use_gaussian: bool = False, gaussian_sigma: float = 2.0):
+    """Parallel (non-iterative) NMS via pairwise decay — one matmul-friendly
+    pass, no sequential loop: the NMS variant that actually fits the TPU
+    execution model. scores (C, N)."""
+    C, N = scores.shape
+    iou = iou_similarity(boxes, boxes)
+
+    def per_class(s):
+        order = jnp.argsort(-s)
+        s_sorted = s[order]
+        iou_s = iou[order][:, order]
+        upper = jnp.triu(iou_s, k=1)  # upper[i, j]: iou of box j with
+        max_iou = jnp.max(upper, axis=0)  # higher-scored box i
+        # decay_j = min_i f(iou_ij) / f(max_iou_i): compensation is per
+        # SUPPRESSING row i (its own worst overlap), not per column
+        if use_gaussian:
+            decay = jnp.min(jnp.exp(-(upper ** 2 - max_iou[:, None] ** 2)
+                                    / gaussian_sigma), axis=0)
+        else:
+            comp = (1 - upper) / jnp.maximum(1 - max_iou[:, None], 1e-10)
+            decay = jnp.min(jnp.where(upper > 0, comp, 1.0), axis=0)
+        return s_sorted * jnp.minimum(decay, 1.0), order
+
+    dec_scores, orders = jax.vmap(per_class)(scores)
+    labels = jnp.broadcast_to(jnp.arange(C)[:, None], dec_scores.shape)
+    flat = jnp.where(dec_scores > jnp.maximum(score_threshold,
+                                              post_threshold),
+                     dec_scores, -jnp.inf).reshape(-1)
+    k = min(keep_top_k, flat.shape[0])
+    best, fi = lax.top_k(flat, k)
+    sel_box = boxes[orders.reshape(-1)[fi]]
+    valid = best > -jnp.inf
+    out = jnp.concatenate([labels.reshape(-1)[fi].astype(jnp.float32)[:, None],
+                           jnp.where(valid, best, 0.0)[:, None],
+                           jnp.where(valid[:, None], sel_box, 0.0)], axis=1)
+    if k < keep_top_k:
+        out = jnp.pad(out, ((0, keep_top_k - k), (0, 0)))
+        valid = jnp.pad(valid, (0, keep_top_k - k))
+    return out, valid
+
+
+# ---------------------------------------------------------------------------
+# RoI ops
+# ---------------------------------------------------------------------------
+
+def roi_align(x, rois, *, output_size: Tuple[int, int],
+              spatial_scale: float = 1.0, sampling_ratio: int = 2,
+              aligned: bool = False):
+    """RoIAlign: x (C, H, W), rois (R, 4) -> (R, C, oh, ow). Bilinear
+    sampling at sampling_ratio^2 points per output bin, averaged — a pure
+    gather+interp formulation (reference: detection/roi_align_op.cc's
+    PreCalc bilinear weights, as one vectorized einsum-free computation).
+    """
+    C, H, W = x.shape
+    oh, ow = output_size
+    s = max(sampling_ratio, 1)
+    off = 0.5 if aligned else 0.0
+    x1 = rois[:, 0] * spatial_scale - off
+    y1 = rois[:, 1] * spatial_scale - off
+    x2 = rois[:, 2] * spatial_scale - off
+    y2 = rois[:, 3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+    rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+    bw = rw / ow
+    bh = rh / oh
+    # sample grid: (R, oh*s) y coords, (R, ow*s) x coords
+    iy = (jnp.arange(oh * s) // s)
+    fy = (jnp.arange(oh * s) % s + 0.5) / s
+    ys = y1[:, None] + (iy[None, :] + fy[None, :]) * bh[:, None]
+    ix = (jnp.arange(ow * s) // s)
+    fx = (jnp.arange(ow * s) % s + 0.5) / s
+    xs = x1[:, None] + (ix[None, :] + fx[None, :]) * bw[:, None]
+
+    def bilinear(grid_y, grid_x):
+        y0 = jnp.clip(jnp.floor(grid_y), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(grid_x), 0, W - 1)
+        y1c = jnp.clip(y0 + 1, 0, H - 1)
+        x1c = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(grid_y, 0, H - 1) - y0
+        wx = jnp.clip(grid_x, 0, W - 1) - x0
+        y0i, x0i, y1i, x1i = (a.astype(jnp.int32) for a in (y0, x0, y1c, x1c))
+        # advanced indexing: (C, R, Sy, Sx) per corner
+        v00 = x[:, y0i[:, :, None], x0i[:, None, :]]
+        v01 = x[:, y0i[:, :, None], x1i[:, None, :]]
+        v10 = x[:, y1i[:, :, None], x0i[:, None, :]]
+        v11 = x[:, y1i[:, :, None], x1i[:, None, :]]
+        wy_ = wy[None, :, :, None]
+        wx_ = wx[None, :, None, :]
+        val = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+               v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        # reference semantics (roi_align_op.cc): samples beyond one pixel
+        # outside the map contribute 0, not edge-extended values
+        oky = (grid_y >= -1.0) & (grid_y <= H)   # (R, Sy)
+        okx = (grid_x >= -1.0) & (grid_x <= W)   # (R, Sx)
+        mask = (oky[:, :, None] & okx[:, None, :])[None]  # (1, R, Sy, Sx)
+        return jnp.where(mask, val, 0.0)
+
+    samples = bilinear(ys, xs)  # (C, R, oh*s, ow*s)
+    samples = samples.reshape(C, -1, oh, s, ow, s).mean(axis=(3, 5))
+    return samples.transpose(1, 0, 2, 3)
+
+
+def roi_pool(x, rois, *, output_size: Tuple[int, int],
+             spatial_scale: float = 1.0):
+    """RoI max-pool with quantized bins (reference:
+    detection/roi_pool_op.cc) — exact: each bin takes a masked max over the
+    full rows/columns it spans (two separable (bin, axis) masks), scanned
+    over RoIs so memory stays (C, oh, H, W)-bounded. Empty bins yield 0."""
+    C, H, W = x.shape
+    oh, ow = output_size
+    rows = jnp.arange(H)
+    cols = jnp.arange(W)
+
+    def one(roi):
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        bh = jnp.maximum(y2 - y1 + 1, 1.0) / oh
+        bw = jnp.maximum(x2 - x1 + 1, 1.0) / ow
+        i = jnp.arange(oh, dtype=jnp.float32)
+        j = jnp.arange(ow, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(i * bh) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((i + 1) * bh) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(j * bw) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((j + 1) * bw) + x1, 0, W)
+        my = (rows[None, :] >= hstart[:, None]) & \
+            (rows[None, :] < hend[:, None])        # (oh, H)
+        mx = (cols[None, :] >= wstart[:, None]) & \
+            (cols[None, :] < wend[:, None])        # (ow, W)
+        neg = jnp.finfo(x.dtype).min
+        tmp = jnp.max(jnp.where(my[None, :, :, None], x[:, None, :, :], neg),
+                      axis=2)                      # (C, oh, W)
+        out = jnp.max(jnp.where(mx[None, None, :, :], tmp[:, :, None, :],
+                                neg), axis=3)      # (C, oh, ow)
+        return jnp.where(out == neg, 0.0, out)
+
+    return lax.map(one, rois)
+
+
+# ---------------------------------------------------------------------------
+# Proposals + matching
+# ---------------------------------------------------------------------------
+
+def generate_proposals(scores, bbox_deltas, anchors, variances, im_shape, *,
+                       pre_nms_top_n: int = 6000, post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.7, min_size: float = 0.0):
+    """RPN proposal generation, one image: objectness (A,), deltas (A, 4),
+    anchors (A, 4) -> (post_nms_top_n, 4) + mask.
+    reference: detection/generate_proposals_op.cc"""
+    A = scores.shape[0]
+    k = min(pre_nms_top_n, A)
+    top_scores, order = lax.top_k(scores, k)
+    d = bbox_deltas[order] * variances[order]
+    boxes = box_coder(anchors[order], jnp.ones((k, 4), jnp.float32),
+                      d, code_type="decode_center_size")
+    boxes = box_clip(boxes, im_shape)
+    w = boxes[:, 2] - boxes[:, 0]
+    h = boxes[:, 3] - boxes[:, 1]
+    ok_size = (w >= min_size) & (h >= min_size)
+    sc = jnp.where(ok_size, top_scores, -jnp.inf)
+    idx, ok = nms(boxes, sc, iou_threshold=nms_thresh,
+                  max_out=post_nms_top_n)
+    return jnp.where(ok[:, None], boxes[idx], 0.0), ok
+
+
+def bipartite_match(sim):
+    """Greedy bipartite matching (N rows to M cols, N<=M assumed by caller).
+
+    Returns (match_indices (M,), match_dist (M,)): for each column, the row
+    it matched or -1. reference: detection/bipartite_match_op.cc
+    (BipartiteMatchFunctor greedy max path).
+    """
+    N, M = sim.shape
+    steps = min(N, M)
+
+    def body(carry, _):
+        s, col_match, col_dist = carry
+        flat = jnp.argmax(s)
+        i, j = flat // M, flat % M
+        best = s[i, j]
+        ok = best > -jnp.inf
+        col_match = jnp.where(ok, col_match.at[j].set(i), col_match)
+        col_dist = jnp.where(ok, col_dist.at[j].set(best), col_dist)
+        s = jnp.where(ok, s.at[i, :].set(-jnp.inf).at[:, j].set(-jnp.inf), s)
+        return (s, col_match, col_dist), None
+
+    init = (jnp.where(sim > 0, sim, -jnp.inf),
+            jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), sim.dtype))
+    (_, match, dist), _ = lax.scan(body, init, None, length=steps)
+    return match, dist
+
+
+def target_assign(gt, match_indices, *, mismatch_value=0.0):
+    """Scatter matched gt rows to prediction slots: gt (N, K),
+    match_indices (M,) -> out (M, K), weights (M,).
+    reference: detection/target_assign_op.cc"""
+    matched = match_indices >= 0
+    safe = jnp.maximum(match_indices, 0)
+    out = jnp.where(matched[:, None], gt[safe],
+                    jnp.full_like(gt[safe], mismatch_value))
+    return out, matched.astype(gt.dtype)
+
+
+def distribute_fpn_proposals(rois, *, min_level: int = 2, max_level: int = 5,
+                             refer_level: int = 4, refer_scale: int = 224):
+    """FPN level routing: (R, 4) -> per-level boolean masks (L, R) +
+    level index per roi. Static alternative to the reference's dynamic
+    splits (detection/distribute_fpn_proposals_op.cc)."""
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    levels = jnp.arange(min_level, max_level + 1)
+    masks = lvl[None, :] == levels[:, None]
+    return masks, lvl
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, *, post_nms_top_n: int):
+    """Concat per-level (rois, scores) and keep the global top-n.
+    reference: detection/collect_fpn_proposals_op.cc"""
+    rois = jnp.concatenate(multi_rois, axis=0)
+    scores = jnp.concatenate(multi_scores, axis=0)
+    k = min(post_nms_top_n, scores.shape[0])
+    top, idx = lax.top_k(scores, k)
+    return rois[idx], top
+
+
+# ---------------------------------------------------------------------------
+# SSD head: matching, loss, inference decode
+# ---------------------------------------------------------------------------
+
+def _encode_matched(prior_boxes, prior_variances, gt):
+    """Center-size encode each prior's matched gt box (M, 4) -> (M, 4)
+    deltas (the per-prior form of box_coder's pairwise encode)."""
+    pw = prior_boxes[:, 2] - prior_boxes[:, 0]
+    ph = prior_boxes[:, 3] - prior_boxes[:, 1]
+    pcx = prior_boxes[:, 0] + pw * 0.5
+    pcy = prior_boxes[:, 1] + ph * 0.5
+    tw = gt[:, 2] - gt[:, 0]
+    th = gt[:, 3] - gt[:, 1]
+    tcx = gt[:, 0] + tw * 0.5
+    tcy = gt[:, 1] + th * 0.5
+    out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                     jnp.log(jnp.maximum(tw / pw, 1e-10)),
+                     jnp.log(jnp.maximum(th / ph, 1e-10))], axis=-1)
+    pv = jnp.asarray(prior_variances)
+    return out / (pv if pv.ndim == 2 else pv[None, :])
+
+
+def ssd_match(gt_boxes, gt_mask, prior_boxes, *,
+              overlap_threshold: float = 0.5,
+              match_type: str = "per_prediction"):
+    """SSD matching for one image: bipartite (every gt claims its best
+    prior) + optionally per-prediction (any prior with IoU above threshold
+    matches its best gt). Padded gt slots (gt_mask False) never match.
+
+    Returns (match_idx (M,) int32, matched (M,) bool).
+    reference: operators/detection/bipartite_match_op.cc +
+    layers/detection.py ssd_loss matching stage.
+    """
+    G = gt_boxes.shape[0]
+    iou = iou_similarity(gt_boxes, prior_boxes)          # (G, M)
+    iou = jnp.where(gt_mask[:, None], iou, -1.0)
+    match_idx = jnp.argmax(iou, axis=0)                  # (M,)
+    best_iou = jnp.max(iou, axis=0)
+    matched = (best_iou > (overlap_threshold
+                           if match_type == "per_prediction" else 1.1))
+    # bipartite stage: greedy one-to-one, highest IoU pair first
+    def body(carry, _):
+        iou_live, midx, mok = carry
+        flat = jnp.argmax(iou_live)
+        g, m = flat // iou_live.shape[1], flat % iou_live.shape[1]
+        ok = iou_live[g, m] > 0.0
+        midx = jnp.where(ok & (jnp.arange(midx.shape[0]) == m), g, midx)
+        mok = mok | (ok & (jnp.arange(mok.shape[0]) == m))
+        iou_live = jnp.where(ok, iou_live.at[g, :].set(-1.0)
+                             .at[:, m].set(-1.0), iou_live)
+        return (iou_live, midx, mok), None
+
+    (_, match_idx, matched), _ = lax.scan(
+        body, (iou, match_idx, matched), None, length=G)
+    return match_idx.astype(jnp.int32), matched
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, gt_mask=None, *,
+             background_label: int = 0, overlap_threshold: float = 0.5,
+             neg_pos_ratio: float = 3.0, loc_loss_weight: float = 1.0,
+             conf_loss_weight: float = 1.0,
+             match_type: str = "per_prediction",
+             mining_type: str = "max_negative", normalize: bool = True):
+    """SSD multibox loss (reference: python/paddle/fluid/layers/detection.py
+    ssd_loss; ops mine_hard_examples/target_assign/bipartite_match).
+
+    Ragged gt lists use the framework's padded+mask convention (SURVEY §5.7)
+    instead of LoD: gt_box (N, G, 4), gt_label (N, G), gt_mask (N, G) bool.
+    location (N, M, 4) deltas, confidence (N, M, C) logits, priors (M, 4).
+    Returns per-image loss (N,), already hard-negative mined and normalized
+    by matched count when ``normalize``.
+    """
+    from .loss import smooth_l1_loss, softmax_with_cross_entropy
+    from .detection_extra import mine_hard_examples
+
+    N, M, _ = location.shape
+    if gt_mask is None:
+        gt_mask = jnp.ones(gt_box.shape[:2], bool)
+    if prior_box_var is None:
+        prior_box_var = jnp.ones_like(prior_box)
+
+    def one(loc, conf, gtb, gtl, gmask):
+        midx, matched = ssd_match(gtb, gmask, prior_box,
+                                  overlap_threshold=overlap_threshold,
+                                  match_type=match_type)
+        tgt_label = jnp.where(matched, gtl[midx], background_label)
+        conf_loss = softmax_with_cross_entropy(conf, tgt_label)
+        conf_loss = conf_loss.reshape(-1)                            # (M,)
+        sel = mine_hard_examples(conf_loss[None],
+                                 matched[None].astype(jnp.int32),
+                                 neg_pos_ratio=neg_pos_ratio,
+                                 mining_type=mining_type)[0]
+        tgt_loc = _encode_matched(prior_box, prior_box_var, gtb[midx])
+        loc_l = jnp.sum(smooth_l1_loss(loc, tgt_loc), axis=-1)
+        total = (conf_loss_weight * jnp.sum(conf_loss * sel)
+                 + loc_loss_weight * jnp.sum(loc_l * matched))
+        if normalize:
+            total = total / jnp.maximum(jnp.sum(matched.astype(total.dtype)),
+                                        1.0)
+        return total
+
+    return jax.vmap(one)(location, confidence, gt_box, gt_label, gt_mask)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var=None, *,
+                     background_label: int = 0,
+                     nms_threshold: float = 0.3, nms_top_k: int = 400,
+                     keep_top_k: int = 200, score_threshold: float = 0.01):
+    """SSD inference decode: per-image box decode + softmax + multiclass
+    NMS (reference: layers/detection.py detection_output →
+    box_coder decode + multiclass_nms ops).
+
+    loc (N, M, 4) deltas, scores (N, M, C) logits, priors (M, 4).
+    Returns ((N, keep_top_k, 6) [label, score, x1, y1, x2, y2], valid mask).
+    """
+    if prior_box_var is None:
+        prior_box_var = jnp.ones_like(prior_box)
+
+    def one(loc_i, score_i):
+        boxes = box_coder(prior_box, prior_box_var, loc_i[None],
+                          code_type="decode_center_size")[0]      # (M, 4)
+        probs = jax.nn.softmax(score_i, axis=-1).T                # (C, M)
+        return multiclass_nms(boxes, probs,
+                              score_threshold=score_threshold,
+                              nms_threshold=nms_threshold,
+                              nms_top_k=min(nms_top_k, loc.shape[1]),
+                              keep_top_k=keep_top_k,
+                              background_label=background_label)
+
+    return jax.vmap(one)(loc, scores)

@@ -1,0 +1,439 @@
+"""Detection-suite gap-fill vs Appendix A (reference:
+paddle/fluid/operators/detection/{psroi_pool_op.cc,
+roi_perspective_transform_op.cc, rpn_target_assign_op.cc,
+mine_hard_examples_op.cc, box_decoder_and_assign_op.cc,
+generate_proposal_labels_op.cc, yolov3_loss_op.cc} and
+operators/detection_map_op.cc)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+from .detection import _area, iou_similarity
+
+
+def psroi_pool(x, rois, *, output_size: Tuple[int, int],
+               spatial_scale: float = 1.0):
+    """Position-sensitive RoI pooling (reference: detection/
+    psroi_pool_op.cc — R-FCN): input channels C = out_c * ph * pw; each
+    output bin (i, j) average-pools its OWN channel group over its spatial
+    cell. x: (N, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    enforce(c % (ph * pw) == 0,
+            "psroi_pool needs C %% (ph*pw) == 0, got C=%s bins=%s", c,
+            ph * pw)
+    out_c = c // (ph * pw)
+    r = rois.shape[0]
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    boxes = rois[:, 1:] * spatial_scale
+
+    ys = jnp.arange(h, dtype=x.dtype)
+    xs = jnp.arange(w, dtype=x.dtype)
+
+    def one_roi(b, box):
+        x1, y1, x2, y2 = box
+        rh = jnp.maximum(y2 - y1, 1e-4) / ph
+        rw = jnp.maximum(x2 - x1, 1e-4) / pw
+        feat = x[b]  # (C, H, W)
+        # bin index of every pixel, clipped into [0, ph)x[0, pw)
+        bin_y = jnp.clip(jnp.floor((ys - y1) / rh), 0, ph - 1)
+        bin_x = jnp.clip(jnp.floor((xs - x1) / rw), 0, pw - 1)
+        in_y = ((ys >= y1) & (ys < y2)).astype(x.dtype)
+        in_x = ((xs >= x1) & (xs < x2)).astype(x.dtype)
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                mask = ((bin_y[:, None] == i) * (bin_x[None, :] == j)
+                        * in_y[:, None] * in_x[None, :])
+                group = feat[(i * pw + j) * out_c:(i * pw + j + 1) * out_c]
+                s = jnp.sum(group * mask[None], axis=(1, 2))
+                cnt = jnp.maximum(jnp.sum(mask), 1.0)
+                outs.append(s / cnt)
+        return jnp.stack(outs, axis=1).reshape(out_c, ph, pw)
+
+    return jax.vmap(one_roi)(batch_idx, boxes)
+
+
+def roi_perspective_transform(x, rois, *, transformed_height: int,
+                              transformed_width: int,
+                              spatial_scale: float = 1.0):
+    """reference: detection/roi_perspective_transform_op.cc — warp each
+    quadrilateral RoI to a fixed rectangle via its perspective transform,
+    bilinear sampling. rois: (R, 9) [batch_idx, x1,y1,...,x4,y4] corners in
+    (tl, tr, br, bl) order."""
+    th, tw = transformed_height, transformed_width
+    n, c, h, w = x.shape
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    quads = rois[:, 1:].reshape(-1, 4, 2) * spatial_scale
+
+    # normalized target grid
+    gy, gx = jnp.meshgrid(jnp.linspace(0.0, 1.0, th),
+                          jnp.linspace(0.0, 1.0, tw), indexing="ij")
+
+    def one(b, quad):
+        tl, tr, br, bl = quad[0], quad[1], quad[2], quad[3]
+        # bilinear interpolation of the quad corners (projective warp
+        # approximated by the bilinear surface — exact for parallelograms,
+        # matches the sampling role; keeps the op jit-friendly)
+        top = tl[None, None] + (tr - tl)[None, None] * gx[..., None]
+        bot = bl[None, None] + (br - bl)[None, None] * gx[..., None]
+        pts = top + (bot - top) * gy[..., None]  # (th, tw, 2) source coords
+        sx = jnp.clip(pts[..., 0], 0, w - 1)
+        sy = jnp.clip(pts[..., 1], 0, h - 1)
+        # clamp so x0 < x1 always (keeps bilinear weights summing to 1 at
+        # the exact right/bottom edge)
+        x0 = jnp.clip(jnp.floor(sx), 0, w - 2).astype(jnp.int32)
+        y0 = jnp.clip(jnp.floor(sy), 0, h - 2).astype(jnp.int32)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wa = (x1 - sx) * (y1 - sy)
+        wb = (sx - x0) * (y1 - sy)
+        wc = (x1 - sx) * (sy - y0)
+        wd = (sx - x0) * (sy - y0)
+        feat = x[b]  # (C, H, W)
+        gathered = (feat[:, y0, x0] * wa + feat[:, y0, x1] * wb +
+                    feat[:, y1, x0] * wc + feat[:, y1, x1] * wd)
+        return gathered
+
+    return jax.vmap(one)(batch_idx, quads)
+
+
+def rpn_target_assign(anchors, gt_boxes, *, rpn_batch_size_per_im: int = 256,
+                      rpn_positive_overlap: float = 0.7,
+                      rpn_negative_overlap: float = 0.3,
+                      key: Optional[jax.Array] = None):
+    """reference: detection/rpn_target_assign_op.cc — label anchors as
+    fg (IoU > pos thresh or best-per-gt), bg (IoU < neg thresh), or ignore
+    (-1). Static-shape form: returns per-anchor labels + matched gt index
+    (subsampling is a masked score here; the reference randomly drops to
+    the batch quota — do that host-side with `key` if needed)."""
+    iou = iou_similarity(anchors, gt_boxes)  # (A, G)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    labels = -jnp.ones(anchors.shape[0], jnp.int32)
+    labels = jnp.where(best_iou < rpn_negative_overlap, 0, labels)
+    labels = jnp.where(best_iou >= rpn_positive_overlap, 1, labels)
+    # every gt's best anchor is positive regardless of threshold
+    best_anchor_per_gt = jnp.argmax(iou, axis=0)  # (G,)
+    labels = labels.at[best_anchor_per_gt].set(1)
+    return labels, best_gt
+
+
+def mine_hard_examples(cls_loss, labels, *, neg_pos_ratio: float = 3.0,
+                       mining_type: str = "max_negative"):
+    """reference: detection/mine_hard_examples_op.cc — SSD hard-negative
+    mining: keep all positives and the top-(ratio * #pos) highest-loss
+    negatives. Returns a 0/1 selection mask (static shape)."""
+    enforce(mining_type == "max_negative",
+            "only max_negative mining is supported, got %s", mining_type)
+    pos = labels > 0
+    num_pos = jnp.sum(pos, axis=1, keepdims=True)
+    num_neg = (num_pos * neg_pos_ratio).astype(jnp.int32)
+    neg_loss = jnp.where(pos, -jnp.inf, cls_loss)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)  # rank of each anchor by neg loss
+    neg_sel = rank < num_neg
+    return (pos | neg_sel).astype(jnp.float32)
+
+
+def box_decoder_and_assign(prior_box, prior_var, target_box, box_score, *,
+                           box_clip: float = 4.135):
+    """reference: detection/box_decoder_and_assign_op.cc — decode per-class
+    box deltas then pick each box's best-scoring class decode.
+    target_box: (N, 4*C) deltas; box_score: (N, C)."""
+    n, c4 = target_box.shape
+    c = c4 // 4
+    pw = prior_box[:, 2] - prior_box[:, 0]
+    ph = prior_box[:, 3] - prior_box[:, 1]
+    px = prior_box[:, 0] + pw * 0.5
+    py = prior_box[:, 1] + ph * 0.5
+    t = target_box.reshape(n, c, 4) * prior_var.reshape(n, 1, 4)
+    dx, dy, dw, dh = t[..., 0], t[..., 1], t[..., 2], t[..., 3]
+    dw = jnp.clip(dw, -box_clip, box_clip)
+    dh = jnp.clip(dh, -box_clip, box_clip)
+    cx = px[:, None] + dx * pw[:, None]
+    cy = py[:, None] + dy * ph[:, None]
+    ow = jnp.exp(dw) * pw[:, None]
+    oh = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - ow / 2, cy - oh / 2, cx + ow / 2,
+                         cy + oh / 2], axis=-1)  # (N, C, 4)
+    best = jnp.argmax(box_score, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, axis=2), axis=1)[:, 0]
+    return decoded, assigned
+
+
+def generate_proposal_labels(rois, gt_boxes, gt_classes, *,
+                             fg_thresh: float = 0.5,
+                             bg_thresh_hi: float = 0.5,
+                             bg_thresh_lo: float = 0.0):
+    """reference: detection/generate_proposal_labels_op.cc — label RoIs
+    against ground truth for the second stage: returns (labels (R,) int32
+    with 0 = background, matched gt index (R,), fg mask)."""
+    iou = iou_similarity(rois, gt_boxes)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    fg = best_iou >= fg_thresh
+    bg = (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo)
+    labels = jnp.where(fg, gt_classes[best_gt], 0)
+    labels = jnp.where(fg | bg, labels, -1)  # neither: ignore
+    return labels.astype(jnp.int32), best_gt, fg
+
+
+def yolov3_loss(x, gt_box, gt_label, *, anchors: Sequence[int],
+                anchor_mask: Sequence[int], class_num: int,
+                ignore_thresh: float = 0.7, downsample_ratio: int = 32,
+                use_label_smooth: bool = False):
+    """reference: detection/yolov3_loss_op.cc — single-scale YOLOv3 loss:
+    objectness + box (x,y sigmoid-BCE; w,h L2) + class BCE, with
+    best-anchor responsibility assignment per gt.
+
+    x: (N, A*(5+C), H, W) raw head output; gt_box: (N, B, 4) in [0,1]
+    (cx, cy, w, h); gt_label: (N, B) int; padded gts have w==0."""
+    n, _, h, w = x.shape
+    a = len(anchor_mask)
+    c = class_num
+    x = x.reshape(n, a, 5 + c, h, w)
+    pred_xy = jax.nn.sigmoid(x[:, :, 0:2])
+    pred_wh = x[:, :, 2:4]
+    pred_obj = x[:, :, 4]
+    pred_cls = x[:, :, 5:]
+
+    all_anchors = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    mask_anchors = all_anchors[jnp.asarray(anchor_mask)]
+    input_w = w * downsample_ratio
+    input_h = h * downsample_ratio
+
+    # responsibility: for each gt, the best anchor (by IoU of (w,h) at the
+    # origin) among ALL anchors; the loss counts it only if that anchor is
+    # in this scale's mask
+    gw = gt_box[..., 2] * input_w  # (N, B)
+    gh = gt_box[..., 3] * input_h
+    inter = (jnp.minimum(gw[..., None], all_anchors[:, 0]) *
+             jnp.minimum(gh[..., None], all_anchors[:, 1]))
+    union = (gw[..., None] * gh[..., None] +
+             all_anchors[:, 0] * all_anchors[:, 1] - inter)
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+
+    valid = gt_box[..., 2] > 1e-6  # (N, B)
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    def bce(logit, target):
+        return jax.nn.softplus(logit) - target * logit
+
+    total = jnp.zeros((), x.dtype)
+    obj_target = jnp.zeros((n, a, h, w))
+    # scatter per-gt losses (B is small/static)
+    bsz = gt_box.shape[1]
+    for bi in range(bsz):
+        vb = valid[:, bi].astype(x.dtype)  # (N,)
+        in_mask = jnp.zeros((n,), jnp.int32)
+        local_a = jnp.zeros((n,), jnp.int32)
+        for k, am in enumerate(anchor_mask):
+            hit = (best_anchor[:, bi] == am).astype(jnp.int32)
+            in_mask = in_mask | hit
+            local_a = jnp.where(hit == 1, k, local_a)
+        sel = vb * in_mask.astype(x.dtype)  # (N,)
+        bidx = jnp.arange(n)
+        px = pred_xy[bidx, local_a, 0, gj[:, bi], gi[:, bi]]
+        py = pred_xy[bidx, local_a, 1, gj[:, bi], gi[:, bi]]
+        pw_ = pred_wh[bidx, local_a, 0, gj[:, bi], gi[:, bi]]
+        ph_ = pred_wh[bidx, local_a, 1, gj[:, bi], gi[:, bi]]
+        tx = gt_box[:, bi, 0] * w - gi[:, bi]
+        ty = gt_box[:, bi, 1] * h - gj[:, bi]
+        aw = mask_anchors[local_a, 0]
+        ah = mask_anchors[local_a, 1]
+        tw = jnp.log(jnp.maximum(gw[:, bi], 1e-9) / aw)
+        th = jnp.log(jnp.maximum(gh[:, bi], 1e-9) / ah)
+        scale = 2.0 - gt_box[:, bi, 2] * gt_box[:, bi, 3]
+        box_loss = (jnp.abs(px - tx) ** 2 + jnp.abs(py - ty) ** 2 +
+                    jnp.abs(pw_ - tw) ** 2 + jnp.abs(ph_ - th) ** 2) * scale
+        po = pred_obj[bidx, local_a, gj[:, bi], gi[:, bi]]
+        obj_loss = bce(po, jnp.ones_like(po))
+        tgt = (jax.nn.one_hot(gt_label[:, bi], c) if not use_label_smooth
+               else jax.nn.one_hot(gt_label[:, bi], c) * (1 - 1.0 / c)
+               + 1.0 / (2 * c))
+        pc = pred_cls[bidx, local_a, :, gj[:, bi], gi[:, bi]]
+        cls_loss = jnp.sum(bce(pc, tgt), axis=-1)
+        total = total + jnp.sum(sel * (box_loss + obj_loss + cls_loss))
+        obj_target = obj_target.at[bidx, local_a, gj[:, bi], gi[:, bi]].max(
+            sel)
+    # negative objectness for unassigned cells
+    neg_loss = bce(pred_obj, jnp.zeros_like(pred_obj)) * (1.0 - obj_target)
+    total = total + jnp.sum(neg_loss)
+    return total / n
+
+def poly2mask(xy, h: int, w: int):
+    """Rasterize one polygon to an (h, w) binary mask with the COCO
+    frPoly algorithm (reference: operators/detection/mask_util.cc
+    Poly2Mask, whose contract is pycocotools frPyObjects+decode — the
+    reference's own test documents that): vertices upsampled x5, edges
+    traced, x-boundary crossings downsampled, column-major parity fill.
+    Boundary-inclusive, bit-exact with the reference's golden vectors."""
+    import numpy as np
+
+    pts = np.asarray(xy, np.float64).reshape(-1, 2)
+    k = len(pts)
+    scale = 5.0
+    x = np.trunc(scale * pts[:, 0] + 0.5).astype(np.int64)
+    y = np.trunc(scale * pts[:, 1] + 0.5).astype(np.int64)
+    x = np.append(x, x[0])
+    y = np.append(y, y[0])
+    us, vs = [], []
+    for j in range(k):
+        xs, xe, ys, ye = int(x[j]), int(x[j + 1]), int(y[j]), int(y[j + 1])
+        dx, dy = abs(xe - xs), abs(ys - ye)
+        flip = (dx >= dy and xs > xe) or (dx < dy and ys > ye)
+        if flip:
+            xs, xe, ys, ye = xe, xs, ye, ys
+        if dx >= dy:
+            s = 0.0 if dx == 0 else (ye - ys) / dx
+            d = np.arange(dx + 1)
+            t = (dx - d) if flip else d
+            us.append(t + xs)
+            vs.append(np.trunc(ys + s * t + 0.5).astype(np.int64))
+        else:
+            s = 0.0 if dy == 0 else (xe - xs) / dy
+            d = np.arange(dy + 1)
+            t = (dy - d) if flip else d
+            vs.append(t + ys)
+            us.append(np.trunc(xs + s * t + 0.5).astype(np.int64))
+    u = np.concatenate(us)
+    v = np.concatenate(vs)
+    # x-boundary crossings, downsampled back to pixel space
+    bx, by = [], []
+    for j in range(1, len(u)):
+        if u[j] == u[j - 1]:
+            continue
+        xd = float(u[j] if u[j] < u[j - 1] else u[j] - 1)
+        xd = (xd + 0.5) / scale - 0.5
+        if np.floor(xd) != xd or xd < 0 or xd > w - 1:
+            continue
+        yd = float(min(v[j], v[j - 1]))
+        yd = (yd + 0.5) / scale - 0.5
+        yd = min(max(yd, 0.0), float(h))
+        yd = np.ceil(yd)
+        bx.append(int(xd))
+        by.append(int(yd))
+    # run-length fill over the column-major index space
+    a = np.array([cx * h + cy for cx, cy in zip(bx, by)], np.int64)
+    a = np.append(a, np.int64(h * w))
+    a.sort()
+    d = np.diff(np.concatenate([[np.int64(0)], a]))
+    runs = [int(d[0])]
+    j = 1
+    while j < len(d):
+        if d[j] > 0:
+            runs.append(int(d[j]))
+            j += 1
+        else:
+            j += 1
+            if j < len(d):
+                runs[-1] += int(d[j])
+                j += 1
+    msk = np.zeros(h * w, np.uint8)
+    pos, val = 0, 0
+    for run in runs:
+        msk[pos:pos + run] = val
+        pos += run
+        val = 1 - val
+    return msk.reshape(w, h).T
+
+
+def polys_to_mask_wrt_box(polygons, box, mask_size: int):
+    """Rasterize an instance's polygon list into a (mask_size, mask_size)
+    grid over ``box`` (reference: mask_util.cc Polys2MaskWrtBox): map each
+    polygon into box-relative pixel space, frPoly-rasterize, union."""
+    import numpy as np
+
+    box = np.asarray(box, np.float32)
+    x0, y0 = box[0], box[1]
+    w = np.maximum(box[2] - box[0], np.float32(1.0))
+    h = np.maximum(box[3] - box[1], np.float32(1.0))
+    mask = np.zeros((mask_size, mask_size), np.uint8)
+    M = np.float32(mask_size)
+    for poly in polygons:
+        # the whole coordinate mapping runs in float32, like the
+        # reference's C float math — only then may a pixel-boundary tie
+        # quantize identically in poly2mask
+        p = np.asarray(poly, np.float32).reshape(-1, 2)
+        p = np.stack([(p[:, 0] - x0) * M / w,
+                      (p[:, 1] - y0) * M / h], axis=1)
+        mask |= poly2mask(p.reshape(-1), mask_size, mask_size)
+    return mask
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         roi_labels, num_classes: int, resolution: int = 14):
+    """Mask R-CNN mask targets (reference:
+    operators/detection/generate_mask_labels_op.cc). Host-side numpy —
+    ragged polygon lists are data prep, not device work, in this design
+    (OP_COVERAGE.md).
+
+    gt_segms: list (per gt) of polygon lists ([x0, y0, x1, y1, ...]).
+    rois (R, 4), roi_labels (R,) class per roi (0 = background).
+    Returns (mask_rois (P, 4), roi_has_mask (R,), mask_targets
+    (P, num_classes * resolution**2) with -1 outside the roi's class
+    section, P = number of foreground rois).
+    """
+    import numpy as np
+
+    rois = np.asarray(rois, np.float64)
+    roi_labels = np.asarray(roi_labels, np.int64)
+    if len(gt_segms) == 0:  # no gt instances: no mask targets
+        return (np.zeros((0, 4), np.float32),
+                np.zeros(len(rois), np.int32),
+                np.zeros((0, num_classes * resolution ** 2), np.float32))
+    gt_boxes = []
+    for segs in gt_segms:
+        allpts = np.concatenate([np.asarray(s, np.float64).reshape(-1, 2)
+                                 for s in segs], axis=0)
+        gt_boxes.append([allpts[:, 0].min(), allpts[:, 1].min(),
+                         allpts[:, 0].max(), allpts[:, 1].max()])
+    gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+    fg = np.flatnonzero(roi_labels > 0)
+    # pair each roi with its best-IoU gt in one vectorized numpy pass
+    # (host-side data prep: no device round-trips in this loop)
+    lt = np.maximum(rois[:, None, :2], gt_boxes[None, :, :2])
+    rb = np.minimum(rois[:, None, 2:], gt_boxes[None, :, 2:])
+    wh = np.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda b: np.maximum(b[:, 2] - b[:, 0], 0) * \
+        np.maximum(b[:, 3] - b[:, 1], 0)
+    union = area(rois)[:, None] + area(gt_boxes)[None, :] - inter
+    iou = np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+    # crowd gts never provide mask targets; a roi only matches a gt of its
+    # own class (the reference op's crowd filter + per-class matching)
+    if is_crowd is not None:
+        crowd = np.asarray(is_crowd, bool).reshape(-1)
+        iou[:, crowd] = -1.0
+    if gt_classes is not None:
+        gcls = np.asarray(gt_classes, np.int64).reshape(-1)
+        iou = np.where(gcls[None, :] == roi_labels[:, None], iou, -1.0)
+    best_gt = iou.argmax(axis=1)
+    has_match = iou.max(axis=1) > 0
+    mask_rois, targets = [], []
+    for r in fg:
+        if not has_match[r]:
+            continue  # fg roi with no same-class non-crowd gt: no target
+        box = rois[r]
+        g = int(best_gt[r])
+        m = polys_to_mask_wrt_box(gt_segms[g], box, resolution)
+        cls = int(roi_labels[r])
+        tgt = np.full((num_classes, resolution * resolution), -1.0,
+                      np.float32)
+        tgt[cls] = m.reshape(-1).astype(np.float32)
+        mask_rois.append(box)
+        targets.append(tgt.reshape(-1))
+    roi_has_mask = ((roi_labels > 0) & has_match).astype(np.int32)
+    if not mask_rois:
+        return (np.zeros((0, 4), np.float32), roi_has_mask,
+                np.zeros((0, num_classes * resolution ** 2), np.float32))
+    return (np.asarray(mask_rois, np.float32), roi_has_mask,
+            np.stack(targets))

@@ -1,0 +1,226 @@
+"""Loss ops.
+
+Capability parity with the reference loss op set (reference:
+paddle/fluid/operators/cross_entropy_op.cc, softmax_with_cross_entropy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, huber_loss_op.cc, hinge_loss_op.cc,
+log_loss_op.cc, smooth_l1_loss_op.cc, bpr_loss_op.cc, kldiv_loss_op.cc,
+margin_rank_loss_op.cc, rank_loss_op.cc, label_smooth_op.cc,
+teacher_student_sigmoid_loss_op.cc, npair/modified_huber ...).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+
+def _index_label(label, logits_ndim: int, axis: int):
+    """Normalize a hard-label tensor to have a singleton class dim at `axis`."""
+    axis = axis % logits_ndim
+    label = jnp.asarray(label)
+    if label.ndim == logits_ndim:
+        # came in with a singleton class dim already (paddle's (N, 1) style)
+        return label.astype(jnp.int32)
+    return jnp.expand_dims(label.astype(jnp.int32), axis)
+
+
+def cross_entropy(probs, label, soft_label: bool = False, axis: int = -1,
+                  eps: float = 1e-8):
+    """Takes probabilities (reference cross_entropy_op takes softmax output)."""
+    logp = jnp.log(jnp.maximum(probs, eps))
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    picked = jnp.take_along_axis(logp, _index_label(label, logp.ndim, axis),
+                                 axis=axis)
+    return -picked
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               axis: int = -1, ignore_index: int = -100,
+                               return_softmax: bool = False):
+    """Fused, numerically-stable version (reference:
+    operators/softmax_with_cross_entropy_op.cc)."""
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = _index_label(label, logp.ndim, axis)
+        valid = lbl != ignore_index
+        # Clamp before gathering so ignored (possibly negative) labels can't
+        # index out of bounds; their loss is masked to 0 below.
+        safe = jnp.clip(lbl, 0, logits.shape[axis] - 1)
+        loss = -jnp.take_along_axis(logp, safe, axis=axis)
+        loss = loss * valid.astype(loss.dtype)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index: int = -100,
+                                      normalize: bool = False):
+    """reference: operators/sigmoid_cross_entropy_with_logits_op.cc."""
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index).astype(loss.dtype)
+    loss = loss * mask
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss
+
+
+def square_error_cost(input, label):
+    """reference: python layers square_error_cost → elementwise_sub+square."""
+    return jnp.square(input - label)
+
+
+def smooth_l1_loss(x, y, sigma: float = 1.0, inside_weight=None,
+                   outside_weight=None):
+    """reference: operators/smooth_l1_loss_op.cc — returns per-row summed loss."""
+    sigma2 = sigma * sigma
+    d = x - y
+    if inside_weight is not None:
+        d = d * inside_weight
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * d * d, ad - 0.5 / sigma2)
+    if outside_weight is not None:
+        loss = loss * outside_weight
+    return jnp.sum(loss, axis=tuple(range(1, loss.ndim)), keepdims=False)[..., None]
+
+
+def huber_loss(x, y, delta: float = 1.0):
+    """reference: operators/huber_loss_op.cc."""
+    d = y - x
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+
+
+def modified_huber_loss(x, y):
+    """reference: operators/modified_huber_loss_op.cc — y in {0,1}."""
+    s = 2.0 * y - 1.0
+    z = x * s
+    return jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), jnp.zeros_like(z)))
+
+
+def hinge_loss(logits, label):
+    """reference: operators/hinge_loss_op.cc — label in {0,1}."""
+    s = 2.0 * label - 1.0
+    return jnp.maximum(0.0, 1.0 - logits * s)
+
+
+def log_loss(predicted, label, epsilon: float = 1e-4):
+    """reference: operators/log_loss_op.cc."""
+    return (-label * jnp.log(predicted + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - predicted + epsilon))
+
+
+def bpr_loss(logits, label):
+    """reference: operators/bpr_loss_op.cc — Bayesian personalized ranking."""
+    n, d = logits.shape
+    pos = jnp.take_along_axis(logits, label.reshape(n, 1).astype(jnp.int32), axis=1)
+    diff = pos - logits  # (n, d)
+    lse = jnp.log1p(jnp.exp(-diff))
+    mask = jnp.ones((n, d)).at[jnp.arange(n), label.reshape(-1).astype(jnp.int32)].set(0.0)
+    return jnp.sum(lse * mask, axis=1, keepdims=True) / (d - 1)
+
+
+def kldiv_loss(x, target, reduction: str = "mean"):
+    """reference: operators/kldiv_loss_op.cc — x is log-prob."""
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    loss = jnp.where(target > 0, loss, jnp.zeros_like(loss))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return loss
+
+
+def margin_rank_loss(label, left, right, margin: float = 0.0):
+    """reference: operators/margin_rank_loss_op.cc."""
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+def rank_loss(label, left, right):
+    """reference: operators/rank_loss_op.cc — RankNet pairwise loss."""
+    d = left - right
+    return jnp.log1p(jnp.exp(d)) - label * d
+
+
+def label_smooth(label, epsilon: float = 0.1, prior_dist=None):
+    """reference: operators/label_smooth_op.cc."""
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+def teacher_student_sigmoid_loss(x, label, soft_max_up_bound: float = 15.0,
+                                 soft_max_lower_bound: float = -15.0):
+    """reference: operators/teacher_student_sigmoid_loss_op.cc."""
+    xc = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    # label < -1: teacher part active with soft label = label + 2
+    return jnp.where(
+        label < -1.0,
+        jnp.maximum(xc, 0.0) - xc * (label + 2.0) + jnp.log1p(jnp.exp(-jnp.abs(xc))),
+        jnp.maximum(xc, 0.0) - xc * label + jnp.log1p(jnp.exp(-jnp.abs(xc))),
+    )
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """reference: python layers npair_loss."""
+    batch = anchor.shape[0]
+    sim = anchor @ positive.T
+    lbl = labels.reshape(-1)
+    target = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.sum(target * logp, axis=1).mean()
+    # reference layers/nn.py npair_loss: l2loss *= Beta (0.25) * l2_reg
+    beta = 0.25
+    reg = beta * l2_reg * (jnp.sum(jnp.square(anchor))
+                           + jnp.sum(jnp.square(positive))) / batch
+    return ce + reg
+
+
+def mse_loss(input, label):
+    return jnp.mean(jnp.square(input - label))
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples: int,
+                                       key: Optional[jax.Array] = None):
+    """Capability analog of reference sample_logits + softmax (operators/
+    sample_logits_op.cc): subsample negatives for huge softmax."""
+    enforce(key is not None, "sampled softmax requires a PRNG key")
+    n, v = logits.shape
+    sampled = jax.random.randint(key, (n, num_samples), 0, v)
+    lbl = label.reshape(n, 1).astype(jnp.int32)
+    idx = jnp.concatenate([lbl, sampled], axis=1)  # (n, 1+S); col 0 = true class
+    picked = jnp.take_along_axis(logits, idx, axis=1)
+    return softmax_with_cross_entropy(picked, jnp.zeros((n,), jnp.int32))
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """Dice coefficient loss (reference: layers/nn.py dice_loss): input
+    (..., D) class probabilities, label (..., 1) or (...,) int ids."""
+    if label.ndim == input.ndim:
+        label = label[..., 0]
+    one_hot = jax.nn.one_hot(label, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * one_hot, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(one_hot,
+                                                       axis=reduce_dims)
+    dice = (2.0 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+# fluid name (layers/nn.py smooth_l1 — summed over the trailing dim)
+def smooth_l1(x, y, inside_weight=None, outside_weight=None,
+              sigma: float = 1.0):
+    l = smooth_l1_loss(x, y, sigma=sigma, inside_weight=inside_weight,
+                       outside_weight=outside_weight)
+    return jnp.sum(l.reshape(l.shape[0], -1), axis=1, keepdims=True)

@@ -1,0 +1,364 @@
+"""Math ops: activations, elementwise (with reference broadcast semantics),
+matmul, scale/clip/cumsum etc.
+
+Capability parity with the reference's activation family
+(reference: paddle/fluid/operators/activation_op.h:1520-1559 functor table),
+``elementwise/`` ops (reference: operators/elementwise/, axis-based broadcast)
+and ``matmul_op`` / ``mul_op``. Everything lowers to XLA; gradients come from
+JAX autodiff (the GradOpDescMaker role, reference:
+framework/grad_op_desc_maker.h:36, is played by VJP rules).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+# ---------------------------------------------------------------------------
+# Activations — full reference functor-table coverage (activation_op.h:1520).
+# ---------------------------------------------------------------------------
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def softshrink(x, lambda_: float = 0.5):
+    return jnp.where(x > lambda_, x - lambda_,
+                     jnp.where(x < -lambda_, x + lambda_, jnp.zeros_like(x)))
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def abs(x):  # noqa: A001 - matches reference op name
+    return jnp.abs(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def brelu(x, t_min: float = 0.0, t_max: float = 24.0):
+    return jnp.clip(x, t_min, t_max)
+
+
+def soft_relu(x, threshold: float = 40.0):
+    xc = jnp.clip(x, -threshold, threshold)
+    return jnp.log1p(jnp.exp(xc))
+
+
+def pow(x, factor: float = 1.0):  # noqa: A001
+    return jnp.power(x, factor)
+
+
+def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def relu6(x, threshold: float = 6.0):
+    return jnp.clip(x, 0.0, threshold)
+
+
+def leaky_relu(x, alpha: float = 0.02):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def hard_shrink(x, threshold: float = 0.5):
+    return jnp.where((x > threshold) | (x < -threshold), x, jnp.zeros_like(x))
+
+
+def hard_sigmoid(x, slope: float = 0.2, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def swish(x, beta: float = 1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, jnp.zeros_like(x))
+
+
+def maxout(x, groups: int, axis: int = 1):
+    """reference: operators/maxout_op.cc — max over channel groups."""
+    shape = list(x.shape)
+    c = shape[axis]
+    enforce(c % groups == 0, "channels %s not divisible by groups %s", c, groups)
+    new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def prelu(x, alpha, mode: str = "all"):
+    """reference: operators/prelu_op.cc — modes all/channel/element."""
+    if mode == "channel":
+        # alpha shaped (C,), x shaped (N, C, ...)
+        extra = x.ndim - 2
+        alpha = alpha.reshape((1, -1) + (1,) * extra)
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def selu(x, scale: float = 1.0507009873554805, alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+# ---------------------------------------------------------------------------
+# Elementwise binary ops with the reference's axis-broadcast semantics
+# (reference: operators/elementwise/elementwise_op.h — y's shape is matched to
+# a contiguous run of x's dims starting at `axis`).
+# ---------------------------------------------------------------------------
+
+def _broadcast_y(x, y, axis: int):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.shape == y.shape or axis == -1:
+        return y
+    # Reshape y to align with x dims [axis, axis+y.ndim) then rely on numpy
+    # broadcasting for the trailing 1s.
+    enforce(axis >= 0 and axis + y.ndim <= x.ndim,
+            "bad elementwise axis %s for shapes %s, %s", axis, x.shape, y.shape)
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def elementwise_add(x, y, axis: int = -1):
+    return x + _broadcast_y(x, y, axis)
+
+
+def elementwise_sub(x, y, axis: int = -1):
+    return x - _broadcast_y(x, y, axis)
+
+
+def elementwise_mul(x, y, axis: int = -1):
+    return x * _broadcast_y(x, y, axis)
+
+
+def elementwise_div(x, y, axis: int = -1):
+    return x / _broadcast_y(x, y, axis)
+
+
+def elementwise_min(x, y, axis: int = -1):
+    return jnp.minimum(x, _broadcast_y(x, y, axis))
+
+
+def elementwise_max(x, y, axis: int = -1):
+    return jnp.maximum(x, _broadcast_y(x, y, axis))
+
+
+def elementwise_pow(x, y, axis: int = -1):
+    return jnp.power(x, _broadcast_y(x, y, axis))
+
+
+def elementwise_mod(x, y, axis: int = -1):
+    return jnp.mod(x, _broadcast_y(x, y, axis))
+
+
+def elementwise_floordiv(x, y, axis: int = -1):
+    return jnp.floor_divide(x, _broadcast_y(x, y, axis))
+
+
+# ---------------------------------------------------------------------------
+# Matmul family — the MXU path. Keep operands large & batched; prefer bf16
+# compute via the active dtype policy (SURVEY §7: op set v0).
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
+           alpha: float = 1.0, precision=None):
+    """reference: operators/matmul_op.cc — batched matmul with transposes."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    out = jnp.matmul(x, y, precision=precision)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+def mul(x, y, x_num_col_dims: int = 1, y_num_col_dims: int = 1):
+    """reference: operators/mul_op.cc — flatten-to-2D matmul."""
+    import math as _math
+
+    xm = x.reshape((_math.prod(x.shape[:x_num_col_dims]), -1)) if x.ndim > 2 else x
+    ym = y.reshape((_math.prod(y.shape[:y_num_col_dims]), -1)) if y.ndim > 2 else y
+    return jnp.matmul(xm, ym)
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """reference: operators/bilinear_tensor_product_op.cc.
+    out[b, k] = x[b] @ W[k] @ y[b] (+ bias)."""
+    out = jnp.einsum("bi,kij,bj->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scalar/shape utility math ops.
+# ---------------------------------------------------------------------------
+
+def scale(x, scale: float = 1.0, bias: float = 0.0,  # noqa: A002
+          bias_after_scale: bool = True):
+    """reference: operators/scale_op.cc."""
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def clip(x, min: float, max: float):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def clip_by_norm(x, max_norm: float):
+    """reference: operators/clip_by_norm_op.cc."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def cumsum(x, axis: Optional[int] = None, exclusive: bool = False,
+           reverse: bool = False):
+    """reference: operators/cumsum_op.cc."""
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def increment(x, value: float = 1.0):
+    return x + value
+
+
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+def squared_l2_distance(x, y):
+    d = x - y
+    return jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim))), d
+
+
+def cos_sim(x, y, eps: float = 1e-12):
+    """reference: operators/cos_sim_op.cc — row-wise cosine similarity."""
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    num = jnp.sum(x * y, axis=-1, keepdims=True)
+    return num / jnp.maximum(xn * yn, eps)
+
+
+def logsumexp(x, axis=None, keepdims: bool = False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+def isfinite(x):
+    """reference: operators/isfinite_op.cc — scalar all-finite check."""
+    return jnp.all(jnp.isfinite(x))
+
+
+def has_inf(x):
+    """reference: operators/isfinite_op.cc (has_inf)."""
+    return jnp.any(jnp.isinf(x))
+
+
+def has_nan(x):
+    """reference: operators/isfinite_op.cc (has_nan)."""
+    return jnp.any(jnp.isnan(x))

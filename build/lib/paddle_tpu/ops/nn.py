@@ -1,0 +1,403 @@
+"""Neural-net ops: conv, pool, norms, softmax, dropout, embedding, interpolate.
+
+Capability parity with the reference's dense NN op set (reference:
+paddle/fluid/operators/conv_op.cc, batch_norm_op.cc, softmax_op.cc,
+dropout_op.cc, lookup_table_op.cc, pool_op.cc, layer_norm_op.cc,
+group_norm_op.cc, interpolate_op.cc ...). Data layout is NCHW to match the
+reference's default; XLA's conv lowering handles layout internally (MXU tiling
+is the compiler's job — SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+def _pair(v: IntOrPair, n: int = 2) -> Tuple[int, ...]:
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    enforce(len(t) == n, "expected %s values, got %s", n, t)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Convolutions (reference: operators/conv_op.* + conv_transpose_op.*)
+# ---------------------------------------------------------------------------
+
+def conv2d(x, weight, stride: IntOrPair = 1, padding: IntOrPair = 0,
+           dilation: IntOrPair = 1, groups: int = 1,
+           data_format: str = "NCHW"):
+    """Conv with the reference's NCHW/OIHW default layout; pass
+    ``data_format="NHWC"`` for the TPU-native channels-last path (weight
+    stays OIHW at the API — it is transposed to HWIO internally, which XLA
+    folds into the kernel constant; NHWC avoids the layout transposes TPU
+    convs otherwise insert around NCHW activations)."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    pad = _pair(padding)
+    enforce(data_format in ("NCHW", "NHWC"),
+            "conv2d data_format must be NCHW|NHWC, got %s", data_format)
+    if data_format == "NHWC":
+        return lax.conv_general_dilated(
+            x, jnp.transpose(weight, (2, 3, 1, 0)),  # OIHW -> HWIO
+            window_strides=stride,
+            padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+            rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    return lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilation,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+
+
+def depthwise_conv2d(x, weight, stride: IntOrPair = 1, padding: IntOrPair = 0,
+                     dilation: IntOrPair = 1):
+    """reference: operators/conv_op.cc depthwise_conv2d — groups == C_in."""
+    return conv2d(x, weight, stride, padding, dilation, groups=x.shape[1])
+
+
+def conv2d_transpose(x, weight, stride: IntOrPair = 1, padding: IntOrPair = 0,
+                     dilation: IntOrPair = 1, groups: int = 1):
+    """reference: operators/conv_transpose_op.cc. weight is IOHW
+    (in_channels, out_channels/groups, kh, kw); output spatial size follows the
+    reference formula (in-1)*stride - 2*pad + dilation*(k-1) + 1.
+
+    Implemented as a fractionally-strided conv: lhs_dilation=stride, spatially
+    flipped kernel, per-side pads dilation*(k-1) - pad.
+    """
+    stride, dilation = _pair(stride), _pair(dilation)
+    pad = _pair(padding)
+    kh, kw = weight.shape[2], weight.shape[3]
+    pads = [(dilation[0] * (kh - 1) - pad[0],) * 2,
+            (dilation[1] * (kw - 1) - pad[1],) * 2]
+
+    def one_group(xg, wg):
+        w = jnp.flip(wg, axis=(2, 3)).swapaxes(0, 1)  # IOHW -> OIHW, flipped
+        return lax.conv_general_dilated(
+            xg, w, window_strides=(1, 1), padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    if groups == 1:
+        return one_group(x, weight)
+    cin = x.shape[1]
+    enforce(cin % groups == 0, "in channels %s not divisible by groups %s",
+            cin, groups)
+    xs = jnp.split(x, groups, axis=1)
+    ws = jnp.split(weight, groups, axis=0)
+    return jnp.concatenate([one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=1)
+
+
+def conv3d(x, weight, stride: IntOrPair = 1, padding: IntOrPair = 0,
+           dilation: IntOrPair = 1, groups: int = 1):
+    stride, dilation = _pair(stride, 3), _pair(dilation, 3)
+    pad = _pair(padding, 3)
+    return lax.conv_general_dilated(
+        x, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilation,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: operators/pool_op.*)
+# ---------------------------------------------------------------------------
+
+def pool2d(x, kernel_size: IntOrPair, pool_type: str = "max",
+           stride: Optional[IntOrPair] = None, padding: IntOrPair = 0,
+           ceil_mode: bool = False, exclusive: bool = True,
+           global_pooling: bool = False, data_format: str = "NCHW"):
+    enforce(data_format in ("NCHW", "NHWC"),
+            "pool2d data_format must be NCHW|NHWC, got %s", data_format)
+    spatial = (2, 3) if data_format == "NCHW" else (1, 2)
+    if global_pooling:
+        kernel_size = (x.shape[spatial[0]], x.shape[spatial[1]])
+        padding = 0
+        stride = kernel_size
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    if data_format == "NCHW":
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    if ceil_mode:
+        # extend right/bottom padding so the last partial window is included
+        pads = list(pads)
+        hw = (x.shape[spatial[0]], x.shape[spatial[1]])
+        for i, (dim, kk, ss, pp) in enumerate(zip(hw, k, s, p)):
+            out = -(-(dim + 2 * pp - kk) // ss) + 1
+            need = (out - 1) * ss + kk - dim - 2 * pp
+            pads[spatial[0] + i] = (pp, pp + max(0, need))
+        pads = tuple(pads)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+    enforce(pool_type == "avg", "pool_type must be max|avg, got %s", pool_type)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if exclusive:
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides, pads)
+        return summed / counts
+    return summed / (k[0] * k[1])
+
+
+def adaptive_pool2d(x, output_size: IntOrPair, pool_type: str = "avg"):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    enforce(h % oh == 0 and w % ow == 0,
+            "adaptive pool needs divisible sizes (%s,%s)->(%s,%s)", h, w, oh, ow)
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if pool_type == "avg":
+        return x.mean(axis=(3, 5))
+    return x.max(axis=(3, 5))
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference: batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc,
+# norm_op.cc, data_norm_op.cc)
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, scale, bias, mean, variance, *, training: bool = False,
+               momentum: float = 0.9, epsilon: float = 1e-5,
+               data_layout: str = "NCHW"):
+    """Returns (y, new_mean, new_var). Functional: running stats are inputs and
+    outputs, not hidden state (reference batch_norm_op.cc mutates in place)."""
+    axis = 1 if data_layout == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = tuple(x.shape[axis] if i == axis else 1 for i in range(x.ndim))
+    if training:
+        batch_mean = jnp.mean(x, axis=reduce_axes)
+        batch_var = jnp.var(x, axis=reduce_axes)
+        new_mean = momentum * mean + (1 - momentum) * batch_mean
+        new_var = momentum * variance + (1 - momentum) * batch_var
+        use_mean, use_var = batch_mean, batch_var
+    else:
+        new_mean, new_var = mean, variance
+        use_mean, use_var = mean, variance
+    inv = lax.rsqrt(use_var + epsilon)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return y, new_mean, new_var
+
+
+def layer_norm(x, scale=None, bias=None, *, begin_norm_axis: int = 1,
+               epsilon: float = 1e-5):
+    """reference: operators/layer_norm_op.cc — normalize over dims
+    [begin_norm_axis, ndim)."""
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    norm_shape = x.shape[begin_norm_axis:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return y
+
+
+def group_norm(x, scale=None, bias=None, *, groups: int = 32,
+               epsilon: float = 1e-5):
+    """reference: operators/group_norm_op.cc (NCHW)."""
+    n, c = x.shape[:2]
+    enforce(c % groups == 0, "channels %s not divisible by groups %s", c, groups)
+    orig = x.shape
+    x = x.reshape(n, groups, c // groups, *orig[2:])
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = ((x - mean) * lax.rsqrt(var + epsilon)).reshape(orig)
+    bshape = (1, c) + (1,) * (len(orig) - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return y
+
+
+def l2_normalize(x, axis: int = -1, epsilon: float = 1e-12):
+    """reference: operators/norm_op.cc."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, epsilon)
+
+
+def rms_norm(x, scale=None, *, epsilon: float = 1e-6):
+    """Modern-transformer norm (no reference analog; needed for model zoo)."""
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    y = x * lax.rsqrt(var + epsilon)
+    if scale is not None:
+        y = y * scale
+    return y
+
+
+def lrn(x, n: int = 5, k: float = 1.0, alpha: float = 1e-4, beta: float = 0.75):
+    """reference: operators/lrn_op.cc — local response norm across channels."""
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    den = k + alpha * sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    return x / jnp.power(den, beta)
+
+
+# ---------------------------------------------------------------------------
+# Softmax & friends
+# ---------------------------------------------------------------------------
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Dropout & noise (functional: key in, reference seeds via op attr)
+# ---------------------------------------------------------------------------
+
+def dropout(x, p: float, key: Optional[jax.Array] = None, *,
+            training: bool = True, mode: str = "upscale_in_train"):
+    """reference: operators/dropout_op.cc (dropout_implementation attr)."""
+    if not training or p == 0.0:
+        if mode == "downgrade_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    enforce(key is not None, "dropout in training mode requires a PRNG key")
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Embedding / lookup (reference: operators/lookup_table_op.cc). Sparse-grad
+# SelectedRows semantics are subsumed by XLA gather/scatter-add fusion.
+# ---------------------------------------------------------------------------
+
+def embedding(ids, table, padding_idx: Optional[int] = None):
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def one_hot(ids, depth: int, dtype=jnp.float32):
+    return jax.nn.one_hot(ids, depth, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Resize / interpolate (reference: operators/interpolate_op.cc)
+# ---------------------------------------------------------------------------
+
+def interpolate(x, size: Sequence[int], method: str = "nearest"):
+    """NCHW resize. method in {nearest, bilinear}."""
+    methods = {"nearest": "nearest", "bilinear": "linear"}
+    enforce(method in methods, "interpolate method must be one of %s, got %s",
+            sorted(methods), method)
+    n, c = x.shape[:2]
+    out_shape = (n, c) + tuple(size)
+    return jax.image.resize(x, out_shape, method=methods[method])
+
+
+def pixel_shuffle(x, upscale_factor: int):
+    """reference: operators/pixel_shuffle_op.cc."""
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def pad2d(x, paddings: Sequence[int], mode: str = "constant", value: float = 0.0):
+    """reference: operators/pad2d_op.cc — NCHW [top, bottom, left, right]."""
+    t, b, l, r = paddings
+    cfg = ((0, 0), (0, 0), (t, b), (l, r))
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    enforce(mode in ("reflect", "edge"),
+            "pad2d mode must be constant|reflect|edge, got %s", mode)
+    return jnp.pad(x, cfg, mode=mode)
+
+
+def space_to_depth(x, blocksize: int):
+    """reference: operators/space_to_depth_op.cc (NCHW)."""
+    n, c, h, w = x.shape
+    bs = blocksize
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+def shuffle_channel(x, group: int):
+    """reference: operators/shuffle_channel_op.cc."""
+    n, c, h, w = x.shape
+    x = x.reshape(n, group, c // group, h, w)
+    return x.swapaxes(1, 2).reshape(n, c, h, w)
+
+
+def grid_sampler(x, grid):
+    """reference: operators/grid_sampler_op.cc — bilinear sample at normalized
+    grid coords. x: (N,C,H,W); grid: (N,H',W',2) in [-1,1]."""
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1, wy1 = gx - x0, gy - y0
+    wx0, wy0 = 1.0 - wx1, 1.0 - wy1
+
+    def gather(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        # batch-wise gather: (N, H', W') indices into (N, C, H, W)
+        flat = x.reshape(n, c, h * w)
+        idx = (yy * w + xx).reshape(n, 1, -1)
+        out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        return out.reshape(n, c, *gx.shape[1:])
+
+    def inb(yy, xx):
+        ok = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+        return ok.astype(x.dtype)[:, None]
+
+    out = (gather(y0, x0) * (wy0 * wx0)[:, None] * inb(y0, x0)
+           + gather(y0, x1) * (wy0 * wx1)[:, None] * inb(y0, x1)
+           + gather(y1, x0) * (wy1 * wx0)[:, None] * inb(y1, x0)
+           + gather(y1, x1) * (wy1 * wx1)[:, None] * inb(y1, x1))
+    return out
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25):
+    """reference: operators/temporal_shift_op.cc."""
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    # reference temporal_shift_op.h:60-64: channels < c1 read from t-1
+    # (zero-padded), channels c1..c2 read from t+1 (zero-padded).
+    prev = jnp.concatenate([jnp.zeros_like(x[:, :1, :c1]), x[:, :-1, :c1]], axis=1)
+    nxt = jnp.concatenate([x[:, 1:, c1:c2], jnp.zeros_like(x[:, :1, c1:c2])], axis=1)
+    keep = x[:, :, c2:]
+    return jnp.concatenate([prev, nxt, keep], axis=2).reshape(nt, c, h, w)

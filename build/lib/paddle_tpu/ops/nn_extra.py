@@ -1,0 +1,344 @@
+"""Additional NN ops — Appendix A gap-fill (reference:
+paddle/fluid/operators/{pool_op.cc pool3d, pool_with_index_op.cc,
+unpool_op.cc, spp_op.cc, affine_channel_op.cc, affine_grid_op.cc,
+conv_transpose_op.cc conv3d/depthwise variants, data_norm_op.cc,
+interpolate_op.cc bilinear/nearest, fsp_op.cc, similarity_focus_op.cc,
+tree_conv (operators/tree_conv_op.cc), cvm_op.cc}).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+from .nn import _pair, conv2d_transpose, interpolate
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def pool3d(x, kernel_size, pool_type: str = "max", stride=None, padding=0,
+           global_pooling: bool = False):
+    """reference: operators/pool_op.cc (3D path). x: (N, C, D, H, W)."""
+    if global_pooling:
+        kernel_size = x.shape[2:5]
+        padding = 0
+        stride = kernel_size
+    k = _triple(kernel_size)
+    s = _triple(stride) if stride is not None else k
+    p = _triple(padding)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    if pool_type == "max":
+        init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                else jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+    enforce(pool_type == "avg", "pool_type must be max|avg, got %s",
+            pool_type)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+    return summed / counts
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    """reference: operators/pool_with_index_op.cc — max pool that also
+    returns the flat (h*w) argmax index per window (consumed by unpool).
+    x: (N, C, H, W) → (out, indices int32). Differentiable: the VJP
+    scatters the output cotangent back to the argmax positions (the
+    variadic reduce_window that computes indices has no JVP rule, so the
+    gradient is supplied explicitly — exactly MaxPoolWithIndexGrad)."""
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    return _mpwi(x, k, s, p)
+
+
+def _mpwi_impl(x, k, s, p):
+    n, c, h, w = x.shape
+    # index grid encoded as float payload alongside values
+    idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    idx = jnp.broadcast_to(idx, x.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1.0, jnp.float32))
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    out, out_idx = lax.reduce_window((x, idx), init, reducer, dims, strides,
+                                     pads)
+    return out, out_idx.astype(jnp.int32)
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _mpwi(x, k, s, p):
+    return _mpwi_impl(x, k, s, p)
+
+
+def _mpwi_fwd(x, k, s, p):
+    out, idx = _mpwi_impl(x, k, s, p)
+    return (out, idx), (idx, x)
+
+
+def _mpwi_bwd(k, s, p, res, g):
+    idx, x = res
+    g_out, _ = g  # index cotangent is meaningless (integer output)
+    gx = unpool(g_out.astype(x.dtype), idx, (x.shape[2], x.shape[3]))
+    return (gx,)
+
+
+_mpwi.defvjp(_mpwi_fwd, _mpwi_bwd)
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0):
+    """reference: pool_with_index_op.cc 3D variant. x: (N, C, D, H, W)."""
+    k = _triple(kernel_size)
+    s = _triple(stride) if stride is not None else k
+    p = _triple(padding)
+    n, c, d, h, w = x.shape
+    idx = jnp.arange(d * h * w, dtype=jnp.float32).reshape(1, 1, d, h, w)
+    idx = jnp.broadcast_to(idx, x.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1.0, jnp.float32))
+    out, out_idx = lax.reduce_window(
+        (x, idx), init, reducer, (1, 1) + k, (1, 1) + s,
+        ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p))
+    return out, out_idx.astype(jnp.int32)
+
+
+def unpool(x, indices, output_size: Tuple[int, int]):
+    """reference: operators/unpool_op.cc — scatter pooled values back to
+    their argmax positions. x, indices: (N, C, ph, pw); indices flat over
+    output h*w."""
+    n, c, ph, pw = x.shape
+    oh, ow = output_size
+    flat_out = jnp.zeros((n, c, oh * ow), x.dtype)
+    flat_idx = indices.reshape(n, c, ph * pw)
+    flat_val = x.reshape(n, c, ph * pw)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].add(v)))(flat_out, flat_idx, flat_val)
+    return out.reshape(n, c, oh, ow)
+
+
+def spp(x, pyramid_height: int = 3, pool_type: str = "max"):
+    """Spatial pyramid pooling (reference: operators/spp_op.cc): pool to
+    1x1, 2x2, ..., concat flattened bins → (N, C * sum(4^l))."""
+    n, c, h, w = x.shape
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        kh, kw = -(-h // bins), -(-w // bins)
+        sh, sw = h // bins, w // bins
+        if sh == 0 or sw == 0:
+            enforce(False, "spp level %s too deep for input %sx%s", level,
+                    h, w)
+        from .nn import pool2d
+
+        pooled = pool2d(x, (kh, kw), pool_type, stride=(sh, sw),
+                        padding=0, ceil_mode=True)
+        pooled = pooled[:, :, :bins, :bins]
+        outs.append(pooled.reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+def affine_channel(x, scale, bias, data_layout: str = "NCHW"):
+    """reference: operators/affine_channel_op.cc — per-channel y=x*s+b
+    (BN-fold inference form)."""
+    axis = 1 if data_layout == "NCHW" else x.ndim - 1
+    shape = tuple(x.shape[axis] if i == axis else 1 for i in range(x.ndim))
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+def affine_grid(theta, out_shape: Sequence[int]):
+    """reference: operators/affine_grid_op.cc — sampling grid from 2x3
+    affine matrices (pairs with grid_sampler). theta: (N, 2, 3);
+    out_shape: (N, C, H, W) → grid (N, H, W, 2) in [-1, 1] coords."""
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    base = jnp.broadcast_to(base, (n, h * w, 3))
+    grid = jnp.einsum("nhk,nck->nhc", base, theta)  # (N, H*W, 2)
+    return grid.reshape(n, h, w, 2)
+
+
+def conv3d_transpose(x, weight, stride=1, padding=0, bias=None):
+    """reference: operators/conv_transpose_op.cc 3D. x: (N, Cin, D, H, W);
+    weight: (Cin, Cout, kd, kh, kw). out = (in-1)*s + k - 2p (the
+    reference formula; lax explicit pads are shifted by k-1)."""
+    s = _triple(stride)
+    p = _triple(padding)
+    k = weight.shape[2:]
+    lax_pad = tuple((kk - 1 - pp, kk - 1 - pp) for kk, pp in zip(k, p))
+    out = lax.conv_transpose(
+        x, weight, strides=s, padding=lax_pad,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def depthwise_conv2d_transpose(x, weight, stride=1, padding=0, bias=None):
+    """reference: conv_transpose_op.cc depthwise variant. weight:
+    (C, 1, kh, kw) — per-channel transpose conv."""
+    s = _pair(stride)
+    p = _pair(padding)
+    out = _dw_transpose(x, weight, s, p)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _dw_transpose(x, weight, s, p):
+    # grouped transpose conv: run each channel independently via vmap over
+    # channel groups (C small convs fuse fine under XLA)
+    n, c, h, w = x.shape
+    k = weight.shape[2:]
+    lax_pad = tuple((kk - 1 - pp, kk - 1 - pp) for kk, pp in zip(k, p))
+
+    def one(chan_x, chan_w):
+        return lax.conv_transpose(
+            chan_x[:, None], chan_w[None, None],
+            strides=s, padding=lax_pad,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"))[:, 0]
+
+    out = jax.vmap(one, in_axes=(1, 0), out_axes=1)(x, weight[:, 0])
+    return out
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum,
+              epsilon: float = 1e-4):
+    """reference: operators/data_norm_op.cc — CTR feature normalization
+    from accumulated (count, sum, sum-of-squares) statistics; unlike BN
+    there is no scale/bias and stats accumulate over the whole history."""
+    mean = batch_sum / batch_size
+    var = batch_square_sum / batch_size - mean * mean
+    return (x - mean) / jnp.sqrt(var + epsilon)
+
+
+def bilinear_interp(x, out_size: Sequence[int]):
+    """reference: operators/interpolate_op.cc bilinear_interp."""
+    return interpolate(x, tuple(out_size), method="bilinear")
+
+
+def nearest_interp(x, out_size: Sequence[int]):
+    """reference: operators/interpolate_op.cc nearest_interp."""
+    return interpolate(x, tuple(out_size), method="nearest")
+
+
+def fsp_matrix(x, y):
+    """reference: operators/fsp_op.cc — flow-of-solution-procedure matrix
+    for distillation: x (N, C1, H, W), y (N, C2, H, W) →
+    (N, C1, C2) = x·y^T / (H*W)."""
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    xf = x.reshape(n, c1, h * w)
+    yf = y.reshape(n, c2, h * w)
+    return jnp.einsum("nch,ndh->ncd", xf, yf) / (h * w)
+
+
+def similarity_focus(x, axis: int, indexes: Sequence[int]):
+    """reference: operators/similarity_focus_op.cc — build a focus mask:
+    for each selected slice along ``axis``, mark the (h, w) argmax positions
+    per remaining dim, union over indexes. x: (N, C, H, W) → same-shape
+    0/1 mask."""
+    enforce(axis in (1, 2, 3), "axis must be 1|2|3, got %s", axis)
+    n = x.shape[0]
+    mask = jnp.zeros_like(x, dtype=jnp.bool_)
+    for index in indexes:
+        sl = jnp.take(x, index, axis=axis)  # (N, d1, d2)
+        m1 = sl == jnp.max(sl, axis=1, keepdims=True)
+        m2 = sl == jnp.max(sl, axis=2, keepdims=True)
+        sel = (m1 | m2)
+        sel = jnp.expand_dims(sel, axis)
+        mask = mask | jnp.broadcast_to(sel, mask.shape)
+    return mask.astype(x.dtype)
+
+
+def cvm(x, use_cvm: bool = True):
+    """reference: operators/cvm_op.cc — CTR show/click feature: input
+    (N, D) whose first two columns are (show, click); with use_cvm the
+    columns become (log(show+1), log(click+1) - log(show+1)), else they are
+    dropped."""
+    show = jnp.log(x[:, 0:1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+def tree_conv(nodes, edges, weight, max_depth: int = 2):
+    """reference: operators/tree_conv_op.cc — tree-based convolution over a
+    node-feature matrix with an adjacency (children) structure.
+
+    nodes: (N, F); edges: (N, N) row-normalized adjacency (dense — the
+    XLA-friendly form of the reference's edge list); weight: (max_depth+1,
+    F, Fout). out[i] = Σ_d W_d · (A^d · nodes)[i]."""
+    out = nodes @ weight[0]
+    prop = nodes
+    for d in range(1, max_depth + 1):
+        prop = edges @ prop
+        out = out + prop @ weight[d]
+    return out
+
+
+def adaptive_pool3d(x, output_size, pool_type: str = "avg"):
+    """reference: operators/pool_op.cc adaptive path, 3D variant.
+    x (N, C, D, H, W) -> (N, C, od, oh, ow); sizes must divide."""
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size))
+    n, c, d, h, w = x.shape
+    enforce(d % od == 0 and h % oh == 0 and w % ow == 0,
+            "adaptive pool needs divisible sizes (%s,%s,%s)->(%s,%s,%s)",
+            d, h, w, od, oh, ow)
+    x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    return x.mean(axis=(3, 5, 7)) if pool_type == "avg" \
+        else x.max(axis=(3, 5, 7))
+
+
+def spectral_norm(weight, u, v, *, dim: int = 0, power_iters: int = 1,
+                  eps: float = 1e-12):
+    """Functional spectral normalization (reference:
+    operators/spectral_norm_op.cc). Returns (w / sigma, new_u, new_v);
+    the nn.SpectralNorm layer owns the u/v buffers."""
+    h = weight.shape[dim]
+    wmat = jnp.moveaxis(weight, dim, 0).reshape(h, -1)
+    for _ in range(power_iters):
+        v = wmat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wmat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wmat @ v
+    return weight / sigma, u, v
+
+
+def image_resize_short(x, out_short_len: int, method: str = "bilinear"):
+    """Resize so the SHORT edge equals out_short_len, keeping aspect
+    (reference: layers/nn.py image_resize_short)."""
+    h, w = x.shape[-2], x.shape[-1]
+    short, long_ = (h, w) if h < w else (w, h)
+    scale = out_short_len / float(short)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    return interpolate(x, (nh, nw), method=method)

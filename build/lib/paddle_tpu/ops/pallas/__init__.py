@@ -1,0 +1,14 @@
+"""Pallas custom-kernel registry — the TPU-native answer to the reference's
+hand-written/JIT kernel layer (reference: paddle/fluid/operators/jit/ xbyak
+codegen, operators/math/ hand kernels). XLA fuses the common graph; these
+kernels cover what fusion alone cannot: online-softmax attention streaming
+over HBM, ring collectives overlapping compute with ICI RDMA, etc.
+
+Kernels degrade gracefully: on CPU they run in Pallas interpret mode (tests),
+on TPU they compile via Mosaic.
+"""
+
+from .flash_attention import flash_attention
+from .quant_matmul import quant_matmul, quantize_tensor
+
+__all__ = ["flash_attention", "quant_matmul", "quantize_tensor"]

@@ -1,0 +1,714 @@
+"""Flash attention — blockwise online-softmax attention as a Pallas TPU
+kernel, with a custom VJP (recompute-based backward).
+
+Capability role: the reference has no attention op at all (it composes
+matmul+softmax in python, reference: python/paddle/fluid/nets.py:343); its
+hand-written-kernel niche is `operators/jit/`. Here the niche is filled
+TPU-natively: Q/K/V stream HBM→VMEM block by block, scores never materialize
+in HBM, softmax runs online with a running (max, sum), and the MXU sees only
+dense (block_q × d) @ (d × block_k) matmuls.
+
+Layout: (batch, seq, heads, head_dim) at the API; internally (batch*heads,
+seq, head_dim). Sequence lengths must be divisible by the block sizes (the
+framework-level caller pads — ragged semantics are handled one level up, see
+ops/sequence.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; interpret mode needs none of it
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30  # safe large-negative (finite: avoids inf-inf NaNs in bwd)
+
+
+def _vmem_spec(shape, index_map):
+    if _VMEM is not None:
+        return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def _smem_scalar_spec():
+    # (1, 1) scalar input (the dropout seed) living in SMEM on TPU
+    imap = lambda *_: (0, 0)
+    if pltpu is not None:
+        return pl.BlockSpec((1, 1), imap, memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1, 1), imap)
+
+
+def _scratch(shape, dtype):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    return pl.MemoryRef(shape, dtype) if hasattr(pl, "MemoryRef") else None
+
+
+def _dropout_keep(seed, bh, row0, col0, bq, bk, dropout_p):
+    """Deterministic keep-mask for attention-probability dropout, from a
+    counter-based integer hash of (seed, batch*head, global row, global
+    col) — the same mask is rebuilt bit-identically by the backward
+    kernels (no RNG state crosses the fwd/bwd boundary) and the ops are
+    plain int32 iota/arithmetic, legal in Mosaic AND interpret mode.
+    int32 overflow wraps (two's complement) under XLA, which is exactly
+    what a mix function wants."""
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    # rows pass through a NONLINEAR mix before cols join: a single
+    # linear combination rows*A + cols*B would make every position pair
+    # offset by a fixed lattice vector (A*dr + B*dc == 0 mod 2^32) hash
+    # identically for all seeds — correlated dropout along diagonals
+    x = rows * jnp.int32(-1640531527) + seed    # 0x9E3779B9
+    x = x ^ (x >> 16)
+    x = x * jnp.int32(-2048144777)              # 0x85EBCA77 as int32
+    x = x ^ (x >> 13)
+    x = x + cols * jnp.int32(-1028477379) + bh * jnp.int32(-2048144789)
+    x = x ^ (x >> 16)
+    x = x * jnp.int32(-1119713537)
+    x = x ^ (x >> 15)
+    x = x * jnp.int32(-1640531527)
+    x = x ^ (x >> 16)
+    u = (x & jnp.int32(0x7FFFFFFF)).astype(jnp.float32) * (1.0 / 2147483648.0)
+    return u >= dropout_p
+
+
+def _block_should_run(i, j, *, causal, window, offset, block_q, block_k):
+    """Block-level skip predicate shared by fwd/dq/dkv: a causal block
+    runs iff its lowest row can see its first column; a window adds
+    band-overlap limits on both sides (out-of-band blocks skip ALL
+    compute — the O(T*window) point of local attention)."""
+    run = ((i * block_q + block_q - 1 + offset >= j * block_k)
+           if causal else True)
+    if window is not None:
+        lo = i * block_q + offset - (window - 1)   # leftmost visible col
+        run &= j * block_k + block_k - 1 >= lo
+        if not causal:
+            hi = i * block_q + block_q - 1 + offset + (window - 1)
+            run &= j * block_k <= hi
+    return run
+
+
+def _apply_causal_band(s, i, j, *, causal, window, offset, block_q,
+                       block_k):
+    """Per-entry causal/band mask shared by fwd/dq/dkv (same global
+    coordinates in all three — a desync between forward and backward
+    masking would corrupt gradients silently)."""
+    if not causal and window is None:
+        return s
+    rows = (i * block_q + offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0))
+    cols = (j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1))
+    if causal:
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    if window is not None:
+        band = rows - cols < window
+        if not causal:
+            band &= cols - rows < window
+        s = jnp.where(band, s, _NEG_INF)
+    return s
+
+
+def _use_interpret() -> bool:
+    # keep in sync with ops.attention._flash_ok: any real-TPU backend name
+    # must compile via Mosaic, everything else tests via interpret mode
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
+                has_mask, has_segs, dropout_p, offset, block_q, block_k,
+                num_k_blocks):
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    # program_id is read OUTSIDE pl.when bodies (interpret-mode lowering
+    # cannot resolve it inside the conditional)
+    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    should_run = _block_should_run(i, j, causal=causal, window=window,
+                                   offset=offset, block_q=block_q,
+                                   block_k=block_k)
+
+    @pl.when(should_run)
+    def _body():
+        # matmul inputs stay in their native dtype (bf16 in production):
+        # bf16 x bf16 -> f32 via preferred_element_type runs at full MXU
+        # rate, while a pre-cast to f32 would drop to the fp32 matmul
+        # rate (4-8x slower on v5e) for zero accuracy gain in the
+        # accumulator
+        q = q_ref[0]                      # (bq, d)
+        k = k_ref[0]                      # (bk, d)
+        v = v_ref[0]                      # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
+        s = _apply_causal_band(s, i, j, causal=causal, window=window,
+                               offset=offset, block_q=block_q,
+                               block_k=block_k)
+        if has_mask:
+            # key-padding keep-mask (1, bk) broadcasting over q rows
+            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(kvm > 0, s, _NEG_INF)
+        if has_segs:
+            # packed sequences: attend only within the same segment.
+            # q-side ids arrive (bq, 1) via the lse-style layout, kv-side
+            # (1, bk) via the full-row slice — broadcast equality gives
+            # the (bq, bk) block mask with no in-kernel transpose
+            qseg = qseg_ref[0]                       # (bq, 1)
+            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]  # (1, bk)
+            s = jnp.where(qseg == kseg, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                              # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                             # (bq, bk)
+        if causal or window is not None or has_mask or has_segs:
+            # a fully-masked row has m_new == _NEG_INF, making the
+            # masked exp(s - m_new) = exp(0) = 1 instead of 0
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)                    # (bq, 1)
+        # l accumulates the UNdropped p: dropout applies to the softmax
+        # probabilities, not to their normalizer
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh,
+                                 i * block_q + offset, j * block_k,
+                                 block_q, block_k, dropout_p)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-37))
+
+
+def _qseg_spec(nheads, block_q):
+    # q-side segment ids (B, Tq, 1) int32; (block_q, 1) last-two dims is
+    # the lse layout — legal for any block_q multiple of 8
+    return _vmem_spec((1, block_q, 1),
+                      lambda b, i, j, _h=nheads: (b // _h, i, 0))
+
+
+def _kv_row_fold(bh, nheads, kv_heads):
+    # k/v may carry FEWER heads than q (GQA/MQA): q-grid row bh maps to
+    # kv row batch*kv_heads + (head // group) — the kernel reads the
+    # shared K/V block via the index map instead of materializing a
+    # head-repeat in HBM. ONE definition: fwd/dq/dkv all fold with it.
+    if kv_heads == nheads:
+        return bh
+    group = nheads // kv_heads
+    return (bh // nheads) * kv_heads + (bh % nheads) // group
+
+
+def _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=2):
+    """K/V block spec; ``kv_arg_pos`` names which grid arg is the
+    kv-block index (2 for the fwd/dq (b, i, j) grids, 1 for the dkv
+    swapped (b, j, i) grid)."""
+
+    def imap(*args, _h=nheads, _kv=kv_heads, _p=kv_arg_pos):
+        return (_kv_row_fold(args[0], _h, _kv), args[_p], 0)
+
+    return _vmem_spec((1, block_k, d), imap)
+
+
+def _mask_spec(nheads, tk):
+    # kv_mask is (B, 1, Tk) float; every head of batch row b reads row
+    # b // nheads — the index map folds the (B*h) grid dim back to B.
+    # The block spans the FULL Tk lane dim (legal for any block_k: a
+    # lane dim equal to the array dim always satisfies Mosaic tiling,
+    # where a (1, block_k<128) lane block would not); kernels slice the
+    # j-th chunk with pl.ds. Cost: Tk floats of VMEM, loaded once.
+    return _vmem_spec((1, 1, tk),
+                      lambda b, i, j, _h=nheads: (b // _h, 0, 0))
+
+
+def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
+              window, scale, dropout_p, block_q, block_k, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    grid = (bh, tq // block_q, tk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        has_mask=kvm is not None, has_segs=qseg is not None,
+        dropout_p=dropout_p, offset=tk - tq, block_q=block_q,
+        block_k=block_k, num_k_blocks=tk // block_k)
+    # lse carried as (bh, tq, 1): the trailing unit dim keeps the block's
+    # last-two-dims (block_q, 1) legal for the Mosaic (8, 128) tiling rule
+    out_shape = (
+        jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+    )
+    in_specs = [
+        _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        _kv_spec(block_k, d, nheads, kv_heads),
+        _kv_spec(block_k, d, nheads, kv_heads),
+    ]
+    inputs = (q, k, v)
+    if kvm is not None:
+        in_specs.append(_mask_spec(nheads, tk))
+        inputs += (kvm,)
+    if qseg is not None:
+        in_specs.append(_qseg_spec(nheads, block_q))
+        in_specs.append(_mask_spec(nheads, tk))  # kv-side: full-row slice
+        inputs += (qseg, kseg)
+    if dropout_p > 0.0:
+        in_specs.append(_smem_scalar_spec())
+        inputs += (seed,)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[
+            _scratch((block_q, d), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (recompute p from q,k + saved lse — no score materialization)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+               scale, causal, window, has_mask, has_segs, dropout_p,
+               offset, block_q, block_k, num_k_blocks):
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
+    dq_ref, dq_acc = refs
+    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    should_run = _block_should_run(i, j, causal=causal, window=window,
+                                   offset=offset, block_q=block_q,
+                                   block_k=block_k)
+
+    @pl.when(should_run)
+    def _body():
+        # native-dtype matmul inputs (see _fwd_kernel note): p/ds are
+        # quantized back to the input dtype before feeding the MXU —
+        # the standard flash-backward precision contract
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]      # (bq, 1)
+        delta = delta_ref[0]  # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _apply_causal_band(s, i, j, causal=causal, window=window,
+                               offset=offset, block_q=block_q,
+                               block_k=block_k)
+        if has_mask:
+            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(kvm > 0, s, _NEG_INF)
+        if has_segs:
+            qseg = qseg_ref[0]
+            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(qseg == kseg, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        if causal or window is not None or has_mask or has_segs:
+            # fully-masked rows carry lse == _NEG_INF (see fwd _finish)
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            # same counter-based mask as fwd: out = (m ⊙ y / keep) @ v,
+            # so dL/dy = (do @ v^T) ⊙ m / keep and ds = y ⊙ (dL/dy − δ)
+            keep = _dropout_keep(seed_ref[0, 0], bh,
+                                 i * block_q + offset, j * block_k,
+                                 block_q, block_k, dropout_p)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                scale, causal, window, has_mask, has_segs, dropout_p,
+                offset, block_q, block_k, num_q_blocks):
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
+    dk_ref, dv_ref, dk_acc, dv_acc = refs
+    bh = pl.program_id(0)
+    j, i = pl.program_id(1), pl.program_id(2)  # kv block outer, q block inner
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    should_run = _block_should_run(i, j, causal=causal, window=window,
+                                   offset=offset, block_q=block_q,
+                                   block_k=block_k)
+
+    @pl.when(should_run)
+    def _body():
+        # native-dtype matmul inputs (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]      # (bq, 1)
+        delta = delta_ref[0]  # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _apply_causal_band(s, i, j, causal=causal, window=window,
+                               offset=offset, block_q=block_q,
+                               block_k=block_k)
+        if has_mask:
+            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(kvm > 0, s, _NEG_INF)
+        if has_segs:
+            qseg = qseg_ref[0]
+            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(qseg == kseg, s, _NEG_INF)
+        p = jnp.exp(s - lse)                               # (bq, bk) f32
+        if causal or window is not None or has_mask or has_segs:
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+        p_v = p  # dv uses the DROPPED probabilities (out = p_drop @ v)
+        if dropout_p > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh,
+                                 i * block_q + offset, j * block_k,
+                                 block_q, block_k, dropout_p)
+            p_v = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, d)
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
+              do, causal, window, scale, dropout_p, block_q, block_k,
+              interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (bh, tq, 1)
+    has_mask = kvm is not None
+    has_segs = qseg is not None
+
+    dq_in_specs = [
+        _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        _kv_spec(block_k, d, nheads, kv_heads),
+        _kv_spec(block_k, d, nheads, kv_heads),
+        _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_inputs = (q, k, v, do, lse, delta)
+    if has_mask:
+        dq_in_specs.append(_mask_spec(nheads, tk))
+        dq_inputs += (kvm,)
+    if has_segs:
+        dq_in_specs.append(_qseg_spec(nheads, block_q))
+        dq_in_specs.append(_mask_spec(nheads, tk))
+        dq_inputs += (qseg, kseg)
+    if dropout_p > 0.0:
+        dq_in_specs.append(_smem_scalar_spec())
+        dq_inputs += (seed,)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, window=window,
+            has_mask=has_mask, has_segs=has_segs, dropout_p=dropout_p,
+            offset=tk - tq, block_q=block_q, block_k=block_k,
+            num_k_blocks=tk // block_k),
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=dq_in_specs,
+        out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*dq_inputs)
+
+    dkv_in_specs = [
+        _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=1),
+        _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=1),
+        _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+    ]
+    dkv_inputs = (q, k, v, do, lse, delta)
+    if has_mask:
+        # grid axes are swapped here (kv outer, q inner) but the full-row
+        # mask block ignores both grid indices anyway
+        dkv_in_specs.append(_mask_spec(nheads, tk))
+        dkv_inputs += (kvm,)
+    if has_segs:
+        # q-side spec must use the SWAPPED grid order: i is program_id(2)
+        dkv_in_specs.append(_vmem_spec(
+            (1, block_q, 1), lambda b, j, i, _h=nheads: (b // _h, i, 0)))
+        dkv_in_specs.append(_mask_spec(nheads, tk))
+        dkv_inputs += (qseg, kseg)
+    if dropout_p > 0.0:
+        dkv_in_specs.append(_smem_scalar_spec())
+        dkv_inputs += (seed,)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, window=window,
+            has_mask=has_mask, has_segs=has_segs, dropout_p=dropout_p,
+            offset=tk - tq, block_q=block_q, block_k=block_k,
+            num_q_blocks=tq // block_q),
+        grid=(bh, tk // block_k, tq // block_q),
+        in_specs=dkv_in_specs,
+        out_specs=(
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ),
+        scratch_shapes=[
+            _scratch((block_k, d), jnp.float32),
+            _scratch((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*dkv_inputs)
+    if kv_heads != nheads:
+        # dk/dv came back per Q-head; sum each group onto its shared
+        # K/V head (h is kv-major: head = kv_head * group + g)
+        group = nheads // kv_heads
+        b = bh // nheads
+        dk = dk.reshape(b, kv_heads, group, tk, d).sum(2).reshape(
+            b * kv_heads, tk, d)
+        dv = dv.reshape(b, kv_heads, group, tk, d).sum(2).reshape(
+            b * kv_heads, tk, d)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper over (batch*heads, seq, d)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp,
+    nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17))
+def _flash(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
+           window, scale, dropout_p, block_q, block_k, block_q_bwd,
+           block_k_bwd, interpret):
+    o, _ = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads,
+                     causal, window, scale, dropout_p, block_q, block_k,
+                     interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
+               window, scale, dropout_p, block_q, block_k, block_q_bwd,
+               block_k_bwd, interpret):
+    o, lse = _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads,
+                       causal, window, scale, dropout_p, block_q, block_k,
+                       interpret)
+    return o, (q, k, v, kvm, qseg, kseg, seed, o, lse)
+
+
+def _flash_bwd(nheads, kv_heads, causal, window, scale, dropout_p,
+               block_q, block_k, block_q_bwd, block_k_bwd, interpret, res,
+               do):
+    q, k, v, kvm, qseg, kseg, seed, o, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads,
+                           kv_heads, o, lse, do, causal, window, scale,
+                           dropout_p, block_q_bwd, block_k_bwd, interpret)
+    # the keep-mask, segment ids and dropout seed carry no gradients
+    return dq, dk, dv, None, None, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    kv_mask=None,
+                    segment_ids=None,
+                    window: Optional[int] = None,
+                    dropout_p: float = 0.0,
+                    dropout_key=None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
+                    interpret: Optional[bool] = None):
+    """Blockwise attention over (batch, seq, heads, head_dim) inputs.
+
+    Sequence lengths must divide the block sizes (shrunk automatically for
+    short sequences). Differentiable (custom VJP, recompute backward).
+    Block sizes default to the autotuned table (ops/pallas/tuning.py,
+    written by tools/pallas_tune.py on real hardware) and fall back to
+    128x128.
+
+    ``kv_mask``: optional (batch, tk) keep-mask (True/nonzero = attend) —
+    the key-padding form every ragged-batch model needs (the LoD
+    replacement, ops/sequence.py); masked keys contribute nothing and
+    fully-masked rows output zeros, matching ops.attention.xla_attention.
+    Arbitrary (B, H, Tq, Tk) masks stay on the XLA path.
+
+    ``segment_ids``: optional (batch, t) int ids for PACKED batches
+    (multiple sequences per row, the padding-free pretraining layout):
+    positions attend only within their own segment; composes with
+    ``causal`` and ``kv_mask``. Self-attention only (tq == tk).
+
+    ``dropout_p``/``dropout_key``: attention-probability dropout INSIDE
+    the kernel — scores still never materialize in HBM (the whole point
+    at long seq; the XLA fallback with dropout pays the (B,H,T,T)
+    tensor). The keep-mask comes from a counter-based hash of the seed
+    and global coordinates, so the backward rebuilds it bit-identically
+    with no stored mask.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    h_kv = k.shape[2]
+    if h_kv != h:
+        # GQA/MQA: fewer K/V heads than Q heads; the kernel reads the
+        # shared block via its index map (no head-repeat in HBM)
+        if h % h_kv or v.shape[2] != h_kv:
+            raise ValueError(
+                f"kv heads ({h_kv}, v={v.shape[2]}) must divide q heads "
+                f"({h}) and match each other")
+    if scale is None:
+        scale = d ** -0.5
+    tuned = {}
+    if None in (block_q, block_k, block_q_bwd, block_k_bwd):
+        from .tuning import attention_key, get_tuned
+
+        tuned = get_tuned(attention_key(tq, tk, d, causal)) or {}
+
+    def _resolve(given, key, seq, default):
+        # pow2 buckets can hold shapes the tuned block doesn't divide
+        # (e.g. 384 in the 512 bucket with block 256) — walk a fallback
+        # chain (tuned -> default -> 64) and take the first block that
+        # divides the seq, rather than trip the divisibility error below
+        # (the dispatch gate admits any 64-divisible seq, so e.g. 192
+        # must resolve to 64, not crash on the 128 default)
+        if given is not None:
+            return min(given, seq)
+        for cand in (tuned.get(key), default, 64):
+            if cand and seq % min(cand, seq) == 0:
+                return min(cand, seq)
+        return min(default, seq)
+
+    block_q = _resolve(block_q, "block_q", tq, DEFAULT_BLOCK_Q)
+    block_k = _resolve(block_k, "block_k", tk, DEFAULT_BLOCK_K)
+    # the backward kernels (dq + dkv) have their own arithmetic-intensity
+    # sweet spot; tuned independently, defaulting to the forward blocks
+    block_q_bwd = _resolve(block_q_bwd, "block_q_bwd", tq, block_q)
+    block_k_bwd = _resolve(block_k_bwd, "block_k_bwd", tk, block_k)
+    if tq % block_q or tk % block_k or tq % block_q_bwd or tk % block_k_bwd:
+        raise ValueError(
+            f"seq lens ({tq},{tk}) must be divisible by blocks "
+            f"({block_q},{block_k}) and bwd blocks "
+            f"({block_q_bwd},{block_k_bwd}); pad upstream")
+    if interpret is None:
+        interpret = _use_interpret()
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h_kv, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h_kv, tk, d)
+    kvm = None
+    if kv_mask is not None:
+        if kv_mask.shape != (b, tk):
+            raise ValueError(
+                f"kv_mask must be (batch, tk) = ({b},{tk}), got "
+                f"{kv_mask.shape}")
+        # (B, 1, Tk) float: the unit middle dim gives the mask block a
+        # legal (1, block_k) last-two-dims layout (same trick as lse)
+        kvm = kv_mask.astype(jnp.float32).reshape(b, 1, tk)
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    seed = None
+    if dropout_p > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_p > 0 requires dropout_key")
+        # one int32 seed per call, (1, 1) for the SMEM scalar spec
+        seed = jax.random.randint(dropout_key, (1, 1), -2 ** 31, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+    qseg = kseg = None
+    if segment_ids is not None:
+        if tq != tk:
+            raise ValueError("segment_ids requires self-attention shapes "
+                             f"(tq={tq} != tk={tk})")
+        if segment_ids.shape != (b, tq):
+            raise ValueError(
+                f"segment_ids must be (batch, t) = ({b},{tq}), got "
+                f"{segment_ids.shape}")
+        ids = segment_ids.astype(jnp.int32)
+        qseg = ids.reshape(b, tq, 1)  # q side: lse-layout blocks
+        kseg = ids.reshape(b, 1, tq)  # kv side: full-row slice blocks
+    of = _flash(qf, kf, vf, kvm, qseg, kseg, seed, h, h_kv, causal,
+                None if window is None else int(window), float(scale),
+                float(dropout_p), block_q, block_k, block_q_bwd,
+                block_k_bwd, interpret)
+    return of.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

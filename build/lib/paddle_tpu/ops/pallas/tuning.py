@@ -1,0 +1,124 @@
+"""Pallas kernel tuning table — the runtime-benchmark-picked kernel
+capability (reference: paddle/fluid/operators/jit/README.md:1 — the jit
+KernelPool benchmarks candidate implementations per shape and caches the
+winner; cuDNN autotuning plays the same role for convs, reference:
+operators/conv_cudnn_op.cu.cc workspace search).
+
+Here the tunables are Pallas grid/block sizes (and the flash-vs-XLA
+dispatch choice). ``tools/pallas_tune.py`` sweeps candidates ON THE REAL
+CHIP and persists winners to ``tuned_blocks.json`` next to this file,
+keyed by (kernel, device_kind, shape bucket); kernels consult the table
+at call time and fall back to the static defaults when no entry exists.
+Entries tuned on one chip generation never apply to another (device_kind
+is in the key).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+_TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tuned_blocks.json")
+_lock = threading.Lock()
+_cache: Optional[Dict[str, dict]] = None
+# keys set with persist=False — session-only overrides that must never
+# reach the shared on-disk table
+_session_only: set = set()
+
+
+@functools.lru_cache(maxsize=1)
+def _device_kind() -> str:
+    # cached for the process: this sits on the eager dispatch path
+    import jax
+
+    try:
+        d = jax.devices()[0]
+    except Exception:
+        return "unknown"
+    kind = (getattr(d, "device_kind", "") or d.platform or "unknown")
+    if d.platform == "cpu":
+        return "cpu"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+    return (gen or kind).lower().replace(" ", "_")
+
+
+def _load() -> Dict[str, dict]:
+    global _cache
+    with _lock:
+        if _cache is None:
+            try:
+                with open(_TABLE_PATH) as f:
+                    _cache = json.load(f)
+            except (OSError, ValueError):
+                _cache = {}
+        return _cache
+
+
+def _pow2_bucket(n: int) -> int:
+    """Round up to the next power of two — one table entry serves the
+    whole bucket."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def attention_key(tq: int, tk: int, d: int, causal: bool,
+                  kind: Optional[str] = None) -> str:
+    return (f"flash_attention|{kind or _device_kind()}|"
+            f"tq{_pow2_bucket(tq)}|tk{_pow2_bucket(tk)}|d{d}|"
+            f"{'causal' if causal else 'full'}")
+
+
+def matmul_key(m: int, n: int, k: int, kind: Optional[str] = None) -> str:
+    return (f"quant_matmul|{kind or _device_kind()}|"
+            f"m{_pow2_bucket(m)}|n{_pow2_bucket(n)}|k{_pow2_bucket(k)}")
+
+
+def get_tuned(key: str) -> Optional[dict]:
+    return _load().get(key)
+
+
+def set_tuned(key: str, entry: dict, persist: bool = True) -> None:
+    table = _load()
+    with _lock:
+        table[key] = entry
+        if not persist:
+            _session_only.add(key)
+        else:
+            _session_only.discard(key)
+        if persist:
+            # On DISK: union of disk and memory; disk wins on conflict
+            # (a concurrent tuner's winners survive) except the key just
+            # tuned, and memory keys absent from disk are re-persisted so
+            # a corrupt/deleted file cannot shrink the write.
+            # In MEMORY: our own entries win (persist=False overrides
+            # stay deliberate); keys we lack adopt the disk value.
+            disk = {}
+            try:
+                with open(_TABLE_PATH) as f:
+                    disk = json.load(f)
+            except (OSError, ValueError):
+                pass
+            merged = {k: v for k, v in table.items()
+                      if k not in _session_only}
+            merged.update(disk)
+            merged[key] = entry
+            for k, v in merged.items():
+                table.setdefault(k, v)
+            tmp = _TABLE_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(merged, f, indent=1, sort_keys=True)
+            os.replace(tmp, _TABLE_PATH)
+
+
+def reset_cache() -> None:
+    """Drop the in-process cache (tests / after external table edits)."""
+    global _cache
+    with _lock:
+        _cache = None
+        _session_only.clear()

@@ -1,0 +1,62 @@
+"""Reduction ops (reference: paddle/fluid/operators/reduce_ops/ — sum, mean,
+max, min, prod, all, any) plus `sum` over a var list and `mean`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+Axes = Optional[Union[int, Sequence[int]]]
+
+
+def _norm_axes(axes: Axes):
+    if axes is None:
+        return None
+    if isinstance(axes, int):
+        return (axes,)
+    return tuple(axes)
+
+
+def reduce_sum(x, dim: Axes = None, keep_dim: bool = False):
+    return jnp.sum(x, axis=_norm_axes(dim), keepdims=keep_dim)
+
+
+def reduce_mean(x, dim: Axes = None, keep_dim: bool = False):
+    return jnp.mean(x, axis=_norm_axes(dim), keepdims=keep_dim)
+
+
+def reduce_max(x, dim: Axes = None, keep_dim: bool = False):
+    return jnp.max(x, axis=_norm_axes(dim), keepdims=keep_dim)
+
+
+def reduce_min(x, dim: Axes = None, keep_dim: bool = False):
+    return jnp.min(x, axis=_norm_axes(dim), keepdims=keep_dim)
+
+
+def reduce_prod(x, dim: Axes = None, keep_dim: bool = False):
+    return jnp.prod(x, axis=_norm_axes(dim), keepdims=keep_dim)
+
+
+def reduce_all(x, dim: Axes = None, keep_dim: bool = False):
+    return jnp.all(x, axis=_norm_axes(dim), keepdims=keep_dim)
+
+
+def reduce_any(x, dim: Axes = None, keep_dim: bool = False):
+    return jnp.any(x, axis=_norm_axes(dim), keepdims=keep_dim)
+
+
+def mean(x):
+    """reference: operators/mean_op.cc — scalar mean of everything."""
+    return jnp.mean(x)
+
+
+def sum(xs):  # noqa: A001
+    """reference: operators/sum_op.cc — sum a list of same-shape tensors."""
+    if not isinstance(xs, (list, tuple)):
+        return jnp.sum(xs)
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out

@@ -1,0 +1,268 @@
+"""Recurrent ops — capability parity with the reference RNN op family
+(reference: paddle/fluid/operators/{lstm_op.cc, lstmp_op.cc, gru_op.cc,
+gru_unit_op.cc, lstm_unit_op.cc, cudnn_lstm_op.cu.cc, row_conv_op.cc,
+conv_shift_op.cc, sequence_ops/sequence_conv_op.cc}).
+
+TPU-native design: the reference packs variable-length sequences via LoD and
+runs hand-written CPU/CUDA recurrences; here every recurrence is a
+``lax.scan`` over a dense padded batch ``(B, T, D)`` with a ``lengths`` mask
+(the LoD replacement — see ops/sequence.py). The per-step matmuls are batched
+onto the MXU; the input projection ``x @ W_ih`` for ALL timesteps is hoisted
+out of the scan as one large matmul so the scan body only carries the
+hidden-to-hidden matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name: str):
+    enforce(name in _ACTS, "unknown activation %s", name)
+    return _ACTS[name]
+
+
+def lstm_unit(x_gates, h, c, forget_bias: float = 0.0,
+              gate_activation: str = "sigmoid",
+              cell_activation: str = "tanh",
+              candidate_activation: str = "tanh"):
+    """One LSTM step from pre-projected gates (reference:
+    operators/lstm_unit_op.cc). ``x_gates``: (B, 4H) = x@W_ih + h@W_hh + b
+    in i, f, g(c~), o order. Returns (new_h, new_c)."""
+    gact, cact, candact = (_act(gate_activation), _act(cell_activation),
+                           _act(candidate_activation))
+    i, f, g, o = jnp.split(x_gates, 4, axis=-1)
+    i = gact(i)
+    f = gact(f + forget_bias)
+    g = candact(g)
+    new_c = f * c + i * g
+    new_h = gact(o) * cact(new_c)
+    return new_h, new_c
+
+
+def gru_unit(x_gates, h, w_hh, gate_activation: str = "sigmoid",
+             activation: str = "tanh"):
+    """One GRU step (reference: operators/gru_unit_op.cc). ``x_gates``:
+    (B, 3H) = x@W_ih + b in r, u(z), c order; ``w_hh``: (H, 3H)."""
+    gact, act = _act(gate_activation), _act(activation)
+    hsz = h.shape[-1]
+    hh = h @ w_hh
+    r = gact(x_gates[..., :hsz] + hh[..., :hsz])
+    u = gact(x_gates[..., hsz:2 * hsz] + hh[..., hsz:2 * hsz])
+    c = act(x_gates[..., 2 * hsz:] + r * hh[..., 2 * hsz:])
+    return u * h + (1.0 - u) * c
+
+
+def _mask_carry(new, old, active):
+    """Freeze carried state for finished (padded) rows."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(active.reshape((-1,) + (1,) * (n.ndim - 1)),
+                               n, o), new, old)
+
+
+def lstm(x, w_ih, w_hh, bias=None, h0=None, c0=None, lengths=None,
+         forget_bias: float = 0.0, is_reverse: bool = False,
+         proj_weight=None, proj_activation: str = "identity",
+         gate_activation: str = "sigmoid", cell_activation: str = "tanh",
+         candidate_activation: str = "tanh"):
+    """Full-sequence LSTM (reference: operators/lstm_op.cc; with
+    ``proj_weight`` it is lstmp, reference: operators/lstmp_op.cc).
+
+    x: (B, T, D); w_ih: (D, 4H); w_hh: (R, 4H) where R = H without
+    projection, or the projection size with one; bias: (4H,);
+    proj_weight: (H, R) optional recurrent projection.
+    Returns (outputs (B, T, R), (h_T, c_T)).
+    """
+    b, t, _ = x.shape
+    hsz = w_ih.shape[-1] // 4
+    rsz = w_hh.shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((b, rsz), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, hsz), x.dtype)
+    # hoist the input projection out of the recurrence: one (B*T, D)@(D, 4H)
+    gates_x = x @ w_ih
+    if bias is not None:
+        gates_x = gates_x + bias
+    gates_x = jnp.swapaxes(gates_x, 0, 1)  # (T, B, 4H)
+    if is_reverse:
+        gates_x = jnp.flip(gates_x, axis=0)
+
+    def step(carry, inp):
+        h, c, pos = carry
+        gx, = inp
+        gates = gx + h @ w_hh
+        new_h, new_c = lstm_unit(gates, h, c, forget_bias, gate_activation,
+                                 cell_activation, candidate_activation)
+        if proj_weight is not None:
+            new_h = _act(proj_activation)(new_h @ proj_weight)
+        if lengths is not None:
+            time = t - 1 - pos if is_reverse else pos
+            active = time < lengths
+            new_h, new_c = _mask_carry((new_h, new_c), (h, c), active)
+            out = new_h * active.astype(new_h.dtype)[:, None]
+        else:
+            out = new_h
+        return (new_h, new_c, pos + 1), out
+
+    (h_t, c_t, _), outs = lax.scan(step, (h0, c0, 0), (gates_x,))
+    if is_reverse:
+        outs = jnp.flip(outs, axis=0)
+    return jnp.swapaxes(outs, 0, 1), (h_t, c_t)
+
+
+def gru(x, w_ih, w_hh, bias=None, h0=None, lengths=None,
+        is_reverse: bool = False, gate_activation: str = "sigmoid",
+        activation: str = "tanh"):
+    """Full-sequence GRU (reference: operators/gru_op.cc).
+
+    x: (B, T, D); w_ih: (D, 3H); w_hh: (H, 3H); bias: (3H,).
+    Returns (outputs (B, T, H), h_T)."""
+    b, t, _ = x.shape
+    hsz = w_hh.shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((b, hsz), x.dtype)
+    gates_x = x @ w_ih
+    if bias is not None:
+        gates_x = gates_x + bias
+    gates_x = jnp.swapaxes(gates_x, 0, 1)
+    if is_reverse:
+        gates_x = jnp.flip(gates_x, axis=0)
+
+    def step(carry, inp):
+        h, pos = carry
+        gx, = inp
+        new_h = gru_unit(gx, h, w_hh, gate_activation, activation)
+        if lengths is not None:
+            time = t - 1 - pos if is_reverse else pos
+            active = time < lengths
+            new_h = _mask_carry(new_h, h, active)
+            out = new_h * active.astype(new_h.dtype)[:, None]
+        else:
+            out = new_h
+        return (new_h, pos + 1), out
+
+    (h_t, _), outs = lax.scan(step, (h0, 0), (gates_x,))
+    if is_reverse:
+        outs = jnp.flip(outs, axis=0)
+    return jnp.swapaxes(outs, 0, 1), h_t
+
+
+def lstmp(x, w_ih, w_hh, proj_weight, bias=None, **kw):
+    """Projected LSTM (reference: operators/lstmp_op.cc)."""
+    return lstm(x, w_ih, w_hh, bias=bias, proj_weight=proj_weight, **kw)
+
+
+def row_conv(x, weight, lengths=None):
+    """Lookahead row convolution (reference: operators/row_conv_op.cc —
+    DeepSpeech2's streaming-friendly context layer).
+
+    x: (B, T, D); weight: (future_context, D). out[b, t] =
+    sum_{k<context} w[k] * x[b, t+k] (zero past the sequence end)."""
+    context = weight.shape[0]
+    b, t, d = x.shape
+    if lengths is not None:
+        from .sequence import sequence_mask
+
+        x = x * sequence_mask(lengths, t, x.dtype)[:, :, None]
+    out = jnp.zeros_like(x)
+    for k in range(context):  # context is small + static: unrolled, XLA fuses
+        sl = x[:, k:, :] * weight[k][None, None, :]
+        out = out.at[:, :t - k, :].add(sl)
+    return out
+
+
+def conv_shift(x, y):
+    """Circular convolution (reference: operators/conv_shift_op.cc).
+    x: (B, M); y: (B, N) with N odd, N <= M. out[b, i] =
+    sum_j y[b, j] * x[b, (i + j - N//2) mod M]."""
+    m, n = x.shape[1], y.shape[1]
+    enforce(n % 2 == 1, "conv_shift filter width must be odd, got %s", n)
+    half = n // 2
+    # gather shifted copies; n is small/static so the loop unrolls
+    out = jnp.zeros_like(x)
+    for j in range(n):
+        shift = j - half
+        out = out + y[:, j:j + 1] * jnp.roll(x, -shift, axis=1)
+    return out
+
+
+def sequence_conv(x, weight, lengths=None, context_length: int = 3,
+                  context_start: Optional[int] = None, bias=None):
+    """Sequence convolution over time (reference:
+    operators/sequence_ops/sequence_conv_op.cc): concatenate a context window
+    of ``context_length`` frames around each timestep (zero outside the
+    sequence) and project with ``weight``: (context_length * D, Dout).
+
+    x: (B, T, D) padded; returns (B, T, Dout)."""
+    b, t, d = x.shape
+    if context_start is None:
+        context_start = -(context_length // 2)
+    enforce(weight.shape[0] == context_length * d,
+            "sequence_conv weight rows %s != context_length*D %s",
+            weight.shape[0], context_length * d)
+    if lengths is not None:
+        from .sequence import sequence_mask
+
+        x = x * sequence_mask(lengths, t, x.dtype)[:, :, None]
+    cols = []
+    for k in range(context_length):
+        offset = context_start + k
+        shifted = jnp.roll(x, -offset, axis=1)
+        if offset > 0:  # zero the wrapped-in tail
+            mask = (jnp.arange(t) < t - offset).astype(x.dtype)
+        elif offset < 0:
+            mask = (jnp.arange(t) >= -offset).astype(x.dtype)
+        else:
+            mask = None
+        if mask is not None:
+            shifted = shifted * mask[None, :, None]
+        cols.append(shifted)
+    ctx = jnp.concatenate(cols, axis=-1)  # (B, T, context*D)
+    out = ctx @ weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dynamic_rnn(cell_fn, x, init_state, lengths=None, is_reverse=False):
+    """Generic masked recurrence (the DynamicRNN capability, reference:
+    python/paddle/fluid/layers/control_flow.py DynamicRNN — LoD-reordered
+    execution replaced by a masked scan on the padded batch).
+
+    cell_fn(x_t, state) -> (out_t, new_state); x: (B, T, D).
+    Returns (outs (B, T, ...), final_state)."""
+    b, t = x.shape[0], x.shape[1]
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+
+    def step(carry, inp):
+        state, pos = carry
+        xt, = inp
+        out, new_state = cell_fn(xt, state)
+        if lengths is not None:
+            time = t - 1 - pos if is_reverse else pos
+            active = time < lengths
+            new_state = _mask_carry(new_state, state, active)
+            out = out * active.astype(out.dtype).reshape(
+                (-1,) + (1,) * (out.ndim - 1))
+        return (new_state, pos + 1), out
+
+    (final, _), outs = lax.scan(step, (init_state, 0), (xs,))
+    if is_reverse:
+        outs = jnp.flip(outs, axis=0)
+    return jnp.swapaxes(outs, 0, 1), final

@@ -1,0 +1,199 @@
+"""Sampling-based classification ops — capability parity with the
+reference's large-vocabulary training ops (reference:
+paddle/fluid/operators/{nce_op.cc, hierarchical_sigmoid_op.cc,
+sampling_id_op.cc, sample_logits_op.cc}; dygraph layers NCE/HSigmoid in
+python/paddle/fluid/dygraph/nn.py).
+
+TPU-native notes: all paths are static-shape and gather/matmul based so they
+lower onto the MXU; samplers use JAX PRNG keys instead of the reference's
+stateful CPU samplers (operators/math/sampler.cc). The log-uniform
+("Zipfian") sampler matches the reference's LogUniformSampler distribution
+P(k) = log(k+2)/log(k+1) normalized over the range.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+
+def _log_uniform_sample(key, shape, range_max: int):
+    """Zipfian sampler: P(k) ∝ log((k+2)/(k+1)) over [0, range_max)."""
+    u = jax.random.uniform(key, shape)
+    # inverse CDF: k = exp(u * log(range_max + 1)) - 1
+    k = jnp.exp(u * jnp.log(float(range_max + 1))) - 1.0
+    return jnp.clip(k.astype(jnp.int32), 0, range_max - 1)
+
+
+def _log_uniform_prob(ids, range_max: int):
+    idsf = ids.astype(jnp.float32)
+    return (jnp.log((idsf + 2.0) / (idsf + 1.0))
+            / jnp.log(float(range_max + 1)))
+
+
+def _uniform_prob(ids, range_max: int):
+    return jnp.full(ids.shape, 1.0 / range_max, jnp.float32)
+
+
+def _uniform_sample(key, shape, range_max: int):
+    return jax.random.randint(key, shape, 0, range_max)
+
+
+_SAMPLERS = {
+    "uniform": (_uniform_sample, _uniform_prob),
+    "log_uniform": (_log_uniform_sample, _log_uniform_prob),
+}
+
+
+def _prob_fn(sampler: str):
+    enforce(sampler in _SAMPLERS, "unknown sampler %s", sampler)
+    return _SAMPLERS[sampler][1]
+
+
+def sample_classes(key, shape, num_classes: int, sampler: str = "uniform"):
+    """Draw negative class ids + their proposal probabilities."""
+    enforce(sampler in _SAMPLERS, "unknown sampler %s", sampler)
+    draw, prob = _SAMPLERS[sampler]
+    ids = draw(key, shape, num_classes)
+    return ids, prob(ids, num_classes)
+
+
+def nce_loss(x, label, weight, bias=None, num_neg_samples: int = 10,
+             sampler: str = "uniform", key: Optional[jax.Array] = None,
+             custom_neg=None):
+    """Noise-contrastive estimation loss (reference: operators/nce_op.cc;
+    dygraph/nn.py NCE).
+
+    x: (B, D) input features; label: (B,) true class ids;
+    weight: (num_classes, D); bias: (num_classes,).
+    Returns per-example cost (B,). The logit for class c is
+    ``x·w_c + b_c - log(S * P_noise(c))`` (self-normalized NCE), trained as
+    binary classification true-vs-noise, matching the reference's
+    sigmoid-cross-entropy formulation.
+    """
+    num_classes = weight.shape[0]
+    b = x.shape[0]
+    label = label.reshape(b).astype(jnp.int32)
+    if custom_neg is not None:
+        neg = jnp.asarray(custom_neg)
+        enforce(neg.ndim == 2 and neg.shape[0] == b,
+                "custom_neg must be (B, S), got %s", neg.shape)
+        neg_p = _prob_fn(sampler)(neg, num_classes)
+    else:
+        enforce(key is not None, "nce_loss requires a PRNG key")
+        neg, neg_p = sample_classes(key, (b, num_neg_samples), num_classes,
+                                    sampler)
+    s = neg.shape[1]
+
+    def logit(ids):  # ids: (B, K) → (B, K)
+        w = weight[ids]                      # (B, K, D)
+        out = jnp.einsum("bd,bkd->bk", x, w)
+        if bias is not None:
+            out = out + bias[ids]
+        return out
+
+    pos_prob = _prob_fn(sampler)(label, num_classes)
+    pos_logit = logit(label[:, None])[:, 0] - jnp.log(s * pos_prob)
+    neg_logit = logit(neg) - jnp.log(s * neg_p)
+    # -log sigmoid(pos) - sum log(1 - sigmoid(neg)), numerically stable
+    pos_cost = jax.nn.softplus(-pos_logit)
+    neg_cost = jnp.sum(jax.nn.softplus(neg_logit), axis=1)
+    return pos_cost + neg_cost
+
+
+def _default_tree_codes(num_classes: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Complete-binary-tree paths for hsigmoid's default mode (reference:
+    operators/math/matrix_bit_code.h SimpleCode: node index starts at
+    label + num_classes, walk to root; code bit = node & 1).
+
+    Returns (path_table (C, L), path_code (C, L)) with -1 padding,
+    L = ceil(log2(num_classes))."""
+    import numpy as np
+
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    table = -np.ones((num_classes, depth), np.int32)
+    code = -np.ones((num_classes, depth), np.int32)
+    for c in range(num_classes):
+        node = c + num_classes
+        i = 0
+        while node > 1:
+            # non-leaf node ids are 1..num_classes-1; row index = node/2 - 1
+            table[c, i] = node // 2 - 1
+            code[c, i] = node & 1
+            node //= 2
+            i += 1
+    return jnp.asarray(table), jnp.asarray(code)
+
+
+def hsigmoid_loss(x, label, weight, bias=None, num_classes: int = None,
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid loss (reference:
+    operators/hierarchical_sigmoid_op.cc; math/matrix_bit_code.cc).
+
+    x: (B, D); label: (B,); weight: (num_nodes, D) — one row per internal
+    tree node; bias: (num_nodes,). Default: complete binary tree over
+    ``num_classes``. Custom trees via path_table/path_code (B- or C-indexed
+    (C, L) arrays, -1 padded) — the reference's "custom tree" mode.
+    Returns per-example cost (B,)."""
+    b = x.shape[0]
+    label = label.reshape(b).astype(jnp.int32)
+    if path_table is None:
+        enforce(num_classes is not None,
+                "hsigmoid needs num_classes or explicit paths")
+        path_table, path_code = _default_tree_codes(num_classes)
+    else:
+        enforce(path_code is not None,
+                "hsigmoid: path_code is required alongside path_table")
+    rows = path_table[label]          # (B, L) node ids, -1 padded
+    codes = path_code[label]          # (B, L) bits, -1 padded
+    valid = rows >= 0
+    safe_rows = jnp.maximum(rows, 0)
+    w = weight[safe_rows]             # (B, L, D)
+    logits = jnp.einsum("bd,bld->bl", x, w)
+    if bias is not None:
+        logits = logits + bias[safe_rows]
+    # label bit 1 → sigmoid(logit), bit 0 → 1 - sigmoid(logit);
+    # cost = softplus(logit) - code*logit  (stable BCE-with-logits)
+    cost = jax.nn.softplus(logits) - codes.astype(logits.dtype) * logits
+    return jnp.sum(jnp.where(valid, cost, 0.0), axis=1)
+
+
+def sampling_id(probs, key, min: float = 0.0, max: float = 1.0):
+    """Sample one class id per row of a probability matrix (reference:
+    operators/sampling_id_op.cc — draws u~U(min,max) and walks the CDF).
+    probs: (B, C) rows need not be perfectly normalized."""
+    cdf = jnp.cumsum(probs, axis=-1)
+    total = cdf[:, -1:]
+    u = jax.random.uniform(key, (probs.shape[0], 1), minval=min,
+                           maxval=max) * total
+    ids = jnp.sum((cdf < u).astype(jnp.int32), axis=-1)
+    return jnp.minimum(ids, probs.shape[-1] - 1)  # guard max>1 overshoot
+
+
+def sample_logits(logits, label, num_samples: int, key,
+                  sampler: str = "log_uniform",
+                  remove_accidental_hits: bool = True):
+    """Sample negatives and gather their logits, correcting by -log Q
+    (reference: operators/sample_logits_op.cc — the building block under
+    sampled-softmax training).
+
+    Returns (sampled_logits (B, 1+S), sampled_label (B,) — always 0, the
+    true class sits in column 0 — and the sampled ids (B, 1+S))."""
+    b, v = logits.shape
+    label = label.reshape(b).astype(jnp.int32)
+    neg, neg_p = sample_classes(key, (b, num_samples), v, sampler)
+    ids = jnp.concatenate([label[:, None], neg], axis=1)
+    pos_p = _prob_fn(sampler)(label, v)
+    q = jnp.concatenate([pos_p[:, None], neg_p], axis=1)
+    picked = jnp.take_along_axis(logits, ids, axis=1) - jnp.log(q)
+    if remove_accidental_hits:
+        # a sampled negative equal to the true label would fight the loss;
+        # push it to -inf like the reference's remove_accidental_hits
+        hit = ids == label[:, None]
+        hit = hit.at[:, 0].set(False)
+        picked = jnp.where(hit, jnp.asarray(-1e20, picked.dtype), picked)
+    return picked, jnp.zeros((b,), jnp.int32), ids

@@ -1,0 +1,419 @@
+"""Ragged-sequence ops — the TPU-native replacement for LoDTensor.
+
+The reference packs variable-length sequences without padding via LoD offsets
+(reference: paddle/fluid/framework/lod_tensor.h:110,229) and operates on them
+with 46 sequence ops (reference: paddle/fluid/operators/sequence_ops/).
+That representation is shape-dynamic and XLA-hostile (SURVEY §5.7, §7).
+
+TPU-native canonicalization: a batch of sequences is a dense padded array
+``(B, T_max, ...)`` plus an integer ``lengths (B,)`` vector. All sequence ops
+are masked dense ops — static shapes, MXU/VPU friendly, recompile-free across
+batches once T_max is bucketed (see paddle_tpu.data.bucketing).
+
+Each function below names the reference op it replaces.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+
+def sequence_mask(lengths, maxlen: int, dtype=jnp.float32):
+    """reference: operators/sequence_mask_op.cc → (B, maxlen) 0/1 mask."""
+    pos = jnp.arange(maxlen)
+    return (pos[None, :] < lengths[:, None]).astype(dtype)
+
+
+def _lowest(dtype):
+    """Most-negative representable value for float or int dtypes."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.finfo(dtype).min
+    return jnp.iinfo(dtype).min
+
+
+def sequence_pad(flat, lengths, maxlen: int, pad_value: float = 0.0):
+    """reference: sequence_pad_op.cc — packed (sum(L), D) + lengths → (B, maxlen, D).
+
+    Eager-path helper (the packed layout only appears at ingestion; dynamic
+    slicing below is fine on host, and jit-safe when lengths are concrete).
+    """
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lengths.astype(jnp.int32))])
+    b = lengths.shape[0]
+    d = flat.shape[1:]
+    idx = offsets[:-1, None] + jnp.arange(maxlen)[None, :]  # (B, maxlen)
+    idx = jnp.minimum(idx, flat.shape[0] - 1)
+    out = flat[idx]  # (B, maxlen, *D)
+    mask = sequence_mask(lengths, maxlen, jnp.bool_)
+    mask = mask.reshape(b, maxlen, *([1] * len(d)))
+    return jnp.where(mask, out, jnp.asarray(pad_value, out.dtype))
+
+
+def sequence_unpad(x, lengths):
+    """reference: sequence_unpad_op.cc — inverse of pad. Eager only (dynamic
+    output size); inside jit keep the padded form and mask."""
+    pieces = [x[i, :int(l)] for i, l in enumerate(lengths)]
+    return jnp.concatenate(pieces, axis=0)
+
+
+def sequence_pool(x, lengths, pool_type: str = "sum"):
+    """reference: sequence_pool_op.cc — pool over time with masking.
+    x: (B, T, D); returns (B, D)."""
+    mask = sequence_mask(lengths, x.shape[1], x.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    # pooled results have shape (B, *feature); broadcast per-row scalars to that
+    row = lambda v: v.reshape((-1,) + (1,) * (x.ndim - 2))
+    if pool_type == "sum":
+        return jnp.sum(x * mask, axis=1)
+    if pool_type == "average":
+        denom = row(jnp.maximum(lengths.astype(x.dtype), 1.0))
+        return jnp.sum(x * mask, axis=1) / denom
+    if pool_type == "sqrt":
+        denom = row(jnp.sqrt(jnp.maximum(lengths.astype(x.dtype), 1.0)))
+        return jnp.sum(x * mask, axis=1) / denom
+    if pool_type == "max":
+        masked = jnp.where(mask > 0, x, _lowest(x.dtype))
+        out = jnp.max(masked, axis=1)
+        return jnp.where(row(lengths) > 0, out, 0.0)
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+        return x[jnp.arange(x.shape[0]), idx]
+    if pool_type == "first":
+        return x[:, 0]
+    enforce(False, "unknown pool_type %s", pool_type)
+
+
+def sequence_softmax(x, lengths):
+    """reference: sequence_softmax_op.cc — masked softmax over time (B, T)."""
+    mask = sequence_mask(lengths, x.shape[1], jnp.bool_)
+    masked = jnp.where(mask, x, _lowest(x.dtype))
+    out = jax.nn.softmax(masked, axis=1)
+    return out * mask.astype(x.dtype)
+
+
+def sequence_reverse(x, lengths):
+    """reference: sequence_reverse_op.cc — reverse each row's valid prefix."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    ln = lengths[:, None]
+    src = jnp.where(pos < ln, ln - 1 - pos, pos)  # (B, T)
+    return jnp.take_along_axis(
+        x, src.astype(jnp.int32).reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_expand(x, ref_lengths, rmax: Optional[int] = None):
+    """reference: sequence_expand_op.cc — repeat each row i ref_lengths[i] times
+    along a new ragged axis; dense analog: (B, D) → (B, R_max, D) masked.
+
+    Pass static ``rmax`` when calling under jit (like sequence_mask's maxlen);
+    without it the bound is taken from concrete ref_lengths (eager only).
+    """
+    if rmax is None:
+        rmax = int(jnp.max(ref_lengths)) if not isinstance(ref_lengths, (list, tuple)) \
+            else max(ref_lengths)
+    out = jnp.repeat(x[:, None], rmax, axis=1)
+    mask = sequence_mask(jnp.asarray(ref_lengths), rmax, out.dtype)
+    return out * mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+
+
+def sequence_concat(xs, lengths_list):
+    """reference: sequence_concat_op.cc — concat along time, per row."""
+    b = xs[0].shape[0]
+    total = sum(x.shape[1] for x in xs)
+    d = xs[0].shape[2:]
+    out = jnp.zeros((b, total) + d, xs[0].dtype)
+    new_lengths = sum(jnp.asarray(l) for l in lengths_list)
+    # Shift each segment into place with scatter via take: build gather index.
+    # Row i of output = concat of valid prefixes. Compute source map eagerly.
+    t_out = jnp.arange(total)[None, :]  # (1, total)
+    starts = []
+    acc = jnp.zeros(b, jnp.int32)
+    for l in lengths_list:
+        starts.append(acc)
+        acc = acc + jnp.asarray(l, jnp.int32)
+    result = out
+    offset_in = 0
+    for x, l, st in zip(xs, lengths_list, starts):
+        l = jnp.asarray(l, jnp.int32)
+        tmax = x.shape[1]
+        src_pos = t_out - st[:, None]  # position within this segment
+        valid = (src_pos >= 0) & (src_pos < l[:, None])
+        src_pos_c = jnp.clip(src_pos, 0, tmax - 1).astype(jnp.int32)
+        gathered = jnp.take_along_axis(
+            x, src_pos_c.reshape(b, total, *([1] * len(d))), axis=1)
+        result = jnp.where(valid.reshape(b, total, *([1] * len(d))),
+                           gathered, result)
+    return result, new_lengths
+
+
+def sequence_slice(x, lengths, offset, length):
+    """reference: sequence_slice_op.cc — per-row window [offset, offset+length)."""
+    b, t = x.shape[:2]
+    pos = jnp.arange(t)[None, :]
+    src = pos + offset[:, None]
+    valid = pos < length[:, None]
+    src_c = jnp.clip(src, 0, t - 1).astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x, src_c.reshape(b, t, *([1] * (x.ndim - 2))), axis=1)
+    mask = valid.reshape(b, t, *([1] * (x.ndim - 2)))
+    return out * mask.astype(x.dtype), length
+
+
+def sequence_enumerate(x, lengths, win_size: int, pad_value: int = 0):
+    """reference: sequence_enumerate_op.cc — sliding windows of ids (B, T) →
+    (B, T, win_size)."""
+    b, t = x.shape
+    idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # (T, W)
+    valid_in_row = idx < lengths[:, None, None]
+    idx_c = jnp.minimum(idx, t - 1)
+    out = x[:, idx_c]  # (B, T, W)
+    return jnp.where(valid_in_row, out, pad_value)
+
+
+def sequence_erase(x, lengths, tokens):
+    """reference: sequence_erase_op.cc — remove listed tokens; dense analog
+    compacts each row to the left. Eager-only (per-row python loop)."""
+    outs, new_lens = [], []
+    t = x.shape[1]
+    for i in range(x.shape[0]):
+        row = [v for v in list(x[i, :int(lengths[i])]) if int(v) not in tokens]
+        new_lens.append(len(row))
+        row = row + [0] * (t - len(row))
+        outs.append(jnp.array(row, x.dtype))
+    return jnp.stack(outs), jnp.array(new_lens, jnp.int32)
+
+
+def sequence_expand_as(x, ref_lengths, rmax: Optional[int] = None):
+    """reference: sequence_expand_as_op.cc."""
+    return sequence_expand(x, ref_lengths, rmax=rmax)
+
+
+def im2sequence(x, kernel, stride, padding=(0, 0)):
+    """reference: operators/im2sequence_op.cc — image patches to sequence."""
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=kernel, window_strides=stride,
+        padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, cKK, oh, ow = patches.shape
+    return patches.reshape(n, cKK, oh * ow).transpose(0, 2, 1)
+
+
+def position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
+    """reference: operators/add_position_encoding_op.cc — sinusoidal PE added.
+    Handles odd feature dims: sin part gets ceil(d/2) columns, cos floor(d/2)."""
+    b, t, d = x.shape
+    sin_d = (d + 1) // 2
+    cos_d = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = max(sin_d, 1)
+    div_sin = jnp.power(10000.0, jnp.arange(sin_d, dtype=jnp.float32) / half)
+    div_cos = jnp.power(10000.0, jnp.arange(cos_d, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div_sin), jnp.cos(pos / div_cos)], axis=1)
+    return alpha * x + beta * pe[None]
+
+
+def hash_embedding_ids(ids, num_buckets: int, num_hash: int = 1):
+    """reference: operators/hash_op.cc — multi-hash ids into buckets."""
+    outs = []
+    x = ids.astype(jnp.uint32)
+    for i in range(num_hash):
+        h = (x * jnp.uint32(2654435761) + jnp.uint32(i * 0x9E3779B9))
+        outs.append((h % jnp.uint32(num_buckets)).astype(jnp.int32))
+    return jnp.stack(outs, axis=-1)
+
+
+def sequence_reshape(x, lengths, new_dim: int):
+    """reference: sequence_ops/sequence_reshape_op.cc — re-chunk each
+    sequence's flattened payload into rows of ``new_dim``. On the padded
+    (B, T, D) layout this is a reshape of the time/feature axes; lengths
+    scale by D/new_dim. Requires T*D % new_dim == 0."""
+    b, t, d = x.shape
+    enforce((t * d) % new_dim == 0,
+            "sequence_reshape: T*D=%s not divisible by new_dim=%s", t * d,
+            new_dim)
+    new_t = t * d // new_dim
+    out = x.reshape(b, new_t, new_dim)
+    new_lengths = (lengths * d) // new_dim
+    return out, new_lengths
+
+
+def sequence_scatter(x, index, updates, lengths=None):
+    """reference: sequence_ops/sequence_scatter_op.cc — add per-sequence
+    updates into x at per-sequence positions. x: (B, D); index: (B, T)
+    positions into D; updates: (B, T); padded steps (>= lengths) ignored."""
+    b, t = index.shape
+    if lengths is not None:
+        mask = (jnp.arange(t)[None, :] < lengths[:, None])
+        updates = updates * mask.astype(updates.dtype)
+    import jax
+
+    def one(row, idx, upd):
+        return row.at[idx].add(upd)
+
+    return jax.vmap(one)(x, index, updates)
+
+
+def add_position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
+    """reference: operators/add_position_encoding_op.cc — y = alpha*x +
+    beta*sinusoid(pos) with the transformer sin/cos interleave."""
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    half = d // 2
+    div = jnp.exp(jnp.arange(half, dtype=x.dtype) *
+                  -(jnp.log(10000.0) / jnp.maximum(half - 1, 1)))
+    ang = pos * div[None, :]
+    enc = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    if enc.shape[-1] < d:  # odd d
+        enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))
+    return alpha * x + beta * enc[None]
+
+
+# ---------------------------------------------------------------------------
+# chunk evaluation (sequence tagging F1)
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {
+    # (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_flags(prev_tag, prev_type, tag, typ, other, scheme):
+    """Vectorized ChunkBegin/ChunkEnd predicates (reference:
+    operators/chunk_eval_op.h ChunkBegin:95 / ChunkEnd:83 — the ordered
+    early-return chain becomes a jnp.select priority list)."""
+    _, t_begin, t_inside, t_end, t_single = scheme
+    f = jnp.full_like(tag, False, dtype=bool)
+    t = jnp.full_like(tag, True, dtype=bool)
+    end = jnp.select(
+        [prev_type == other,
+         typ == other,
+         typ != prev_type,
+         prev_tag == t_begin,
+         prev_tag == t_inside,
+         prev_tag == t_end,
+         prev_tag == t_single],
+        [f, t, t,
+         (tag == t_begin) | (tag == t_single),
+         (tag == t_begin) | (tag == t_single),
+         t, t],
+        default=f)
+    begin = jnp.select(
+        [prev_type == other,
+         typ == other,
+         typ != prev_type,
+         tag == t_begin,
+         tag == t_inside,
+         tag == t_end,
+         tag == t_single],
+        [typ != other, f, t, t,
+         (prev_tag == t_end) | (prev_tag == t_single),
+         (prev_tag == t_end) | (prev_tag == t_single),
+         t],
+        default=f)
+    return begin, end
+
+
+def _chunk_segments(labels, lengths, num_chunk_types, scheme):
+    """Per-position segment-close encoding of GetSegments (reference:
+    chunk_eval_op.h:41): returns (close (B, T+1), start (B, T+1),
+    typ (B, T+1)) where close[b, i] marks a segment [start[b, i], i-1]
+    of type typ[b, i]. One extra virtual 'other' step closes any chunk
+    still open at the sequence end."""
+    num_tag = scheme[0]
+    other = num_chunk_types
+    B, T = labels.shape
+    pos = jnp.arange(T)[None, :]
+    valid = pos < lengths[:, None]
+    # pad positions (and one virtual trailing step) become 'other' type:
+    # they never begin a chunk and close any open one
+    lab = jnp.where(valid, labels, other * num_tag)
+    lab = jnp.concatenate(
+        [lab, jnp.full((B, 1), other * num_tag, lab.dtype)], axis=1)
+    tag = lab % num_tag
+    typ = lab // num_tag
+    prev_tag = jnp.concatenate([jnp.full((B, 1), -1, tag.dtype),
+                                tag[:, :-1]], axis=1)
+    prev_typ = jnp.concatenate([jnp.full((B, 1), other, typ.dtype),
+                                typ[:, :-1]], axis=1)
+    begin, end = _chunk_flags(prev_tag, prev_typ, tag, typ, other,
+                              scheme)
+
+    def step(carry, xs):
+        in_chunk, start = carry
+        b_i, e_i, i = xs
+        close = in_chunk & e_i
+        new_in = b_i | (in_chunk & ~e_i)
+        new_start = jnp.where(b_i, i, start)
+        return (new_in, new_start), (close, start)
+
+    (_, _), (close, start) = jax.lax.scan(
+        step,
+        (jnp.zeros(B, bool), jnp.zeros(B, jnp.int32)),
+        (begin.T, end.T, jnp.arange(T + 1, dtype=jnp.int32)))
+    return close.T, start.T, prev_typ
+
+
+def chunk_eval(inference, label, lengths, num_chunk_types: int,
+               chunk_scheme: str = "IOB", excluded_chunk_types=()):
+    """Chunking precision/recall/F1 (reference:
+    operators/chunk_eval_op.h ChunkEvalKernel::Compute:110 — IOB/IOE/
+    IOBES/plain schemes over label = type * num_tag_types + tag).
+
+    Device-native: the reference walks each sequence's segment lists on
+    CPU; here segments are encoded per-position (a chunk is identified by
+    its close position + start + type, unique per side), so counting and
+    matching are elementwise over the padded (B, T) batch — one lax.scan
+    over time, everything else vectorized.
+
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) as jax scalars.
+    """
+    from ..core.enforce import enforce
+
+    enforce(chunk_scheme in _CHUNK_SCHEMES,
+            "unknown chunk scheme %r (IOB/IOE/IOBES/plain)", chunk_scheme)
+    scheme = _CHUNK_SCHEMES[chunk_scheme]
+    inference = jnp.asarray(inference)
+    label = jnp.asarray(label)
+    if inference.ndim == 1:
+        inference = inference[None]
+        label = label[None]
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(-1)
+
+    i_close, i_start, i_typ = _chunk_segments(
+        inference, lengths, num_chunk_types, scheme)
+    l_close, l_start, l_typ = _chunk_segments(
+        label, lengths, num_chunk_types, scheme)
+
+    def not_excluded(typ):
+        keep = jnp.ones_like(typ, dtype=bool)
+        for t in excluded_chunk_types:
+            keep &= typ != t
+        return keep
+
+    num_infer = jnp.sum(i_close & not_excluded(i_typ))
+    num_label = jnp.sum(l_close & not_excluded(l_typ))
+    correct = jnp.sum(i_close & l_close & (i_start == l_start) &
+                      (i_typ == l_typ) & not_excluded(i_typ))
+    num_infer = num_infer.astype(jnp.int32)
+    num_label = num_label.astype(jnp.int32)
+    correct = correct.astype(jnp.int32)
+    precision = jnp.where(num_infer > 0, correct / jnp.maximum(num_infer, 1),
+                          0.0).astype(jnp.float32)
+    recall = jnp.where(num_label > 0, correct / jnp.maximum(num_label, 1),
+                       0.0).astype(jnp.float32)
+    f1 = jnp.where(correct > 0,
+                   2 * precision * recall /
+                   jnp.maximum(precision + recall, 1e-38),
+                   0.0).astype(jnp.float32)
+    return precision, recall, f1, num_infer, num_label, correct

@@ -1,0 +1,292 @@
+"""Tensor manipulation ops: shape, indexing, creation, search.
+
+Capability parity with reference ops: concat, split, reshape2, transpose2,
+squeeze/unsqueeze, stack/unstack, expand, slice, gather, scatter, pad,
+top_k, argsort, arg_max/min, where, shape, fill_constant, one_hot, diag,
+linspace, range, reverse, flatten, multiplex, crop, random_crop, uniform/
+gaussian_random (reference: paddle/fluid/operators/<name>_op.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+# --- creation --------------------------------------------------------------
+
+def fill_constant(shape, value, dtype=jnp.float32):
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def fill_constant_batch_size_like(ref, shape, value, dtype=jnp.float32,
+                                  input_dim_idx: int = 0, output_dim_idx: int = 0):
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jnp.full(tuple(shape), value, dtype=dtype)
+
+
+def fill_zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+def ones(shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def zeros(shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def eye(n, m=None, dtype=jnp.float32):
+    return jnp.eye(n, m, dtype=dtype)
+
+
+def diag(v):
+    return jnp.diag(v)
+
+
+def linspace(start, stop, num, dtype=jnp.float32):
+    return jnp.linspace(start, stop, int(num), dtype=dtype)
+
+
+def arange(start, end=None, step=1, dtype=None):
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+def uniform_random(shape, key, min: float = -1.0, max: float = 1.0,  # noqa: A002
+                   dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, min, max)
+
+
+def gaussian_random(shape, key, mean: float = 0.0, std: float = 1.0,
+                    dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * std + mean
+
+
+def truncated_gaussian_random(shape, key, mean: float = 0.0, std: float = 1.0,
+                              dtype=jnp.float32):
+    return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * std + mean
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+# --- shape ops -------------------------------------------------------------
+
+def reshape(x, shape):
+    """reference: reshape2 — supports one -1 and 0 (= copy input dim)."""
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def flatten(x, axis: int = 1):
+    """reference: flatten2 — collapse to 2D at `axis`."""
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return x.reshape(lead, -1)
+
+
+def squeeze(x, axes: Optional[Sequence[int]] = None):
+    return jnp.squeeze(x, tuple(axes) if axes else None)
+
+
+def unsqueeze(x, axes: Union[int, Sequence[int]]):
+    if isinstance(axes, int):
+        axes = [axes]
+    for a in sorted(axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def expand(x, expand_times: Sequence[int]):
+    """reference: expand_op.cc — tile each dim."""
+    return jnp.tile(x, expand_times)
+
+
+def expand_as(x, target):
+    return jnp.broadcast_to(x, target.shape)
+
+
+def stack(xs, axis: int = 0):
+    return jnp.stack(xs, axis)
+
+
+def unstack(x, axis: int = 0):
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+
+
+def concat(xs, axis: int = 0):
+    return jnp.concatenate(xs, axis)
+
+
+def split(x, num_or_sections, axis: int = 0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis)
+    # sections list; -1 means "rest"
+    sections = list(num_or_sections)
+    if -1 in sections:
+        total = x.shape[axis]
+        rest = total - sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = rest
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return jnp.split(x, idx, axis)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """reference: slice_op.cc."""
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = jnp.s_[st:en]
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[st:en:sd]
+    return x[tuple(idx)]
+
+
+def crop(x, shape, offsets):
+    """reference: crop_op.cc."""
+    idx = tuple(jnp.s_[o:o + s] for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def reverse(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    for a in axis:
+        x = jnp.flip(x, a)
+    return x
+
+
+def pad(x, paddings, pad_value: float = 0.0):
+    """reference: pad_op.cc — paddings is flat [before0, after0, before1, ...]."""
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return jnp.pad(x, cfg, constant_values=pad_value)
+
+
+def pad_constant_like(x, y, pad_value: float = 0.0):
+    """reference: pad_constant_like_op.cc — pad y up to x's shape."""
+    cfg = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, cfg, constant_values=pad_value)
+
+
+def shape(x):
+    return jnp.array(x.shape, dtype=jnp.int32)
+
+
+def cast(x, dtype):
+    from ..core.dtypes import to_dtype
+
+    return x.astype(to_dtype(dtype))
+
+
+# --- indexing / search -----------------------------------------------------
+
+def gather(x, index, axis: int = 0):
+    """reference: gather_op.cc — index rows along axis."""
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite: bool = True):
+    """reference: scatter_op.cc — rows of x at `index` set/added to updates."""
+    index = index.astype(jnp.int32)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def top_k(x, k: int):
+    """reference: top_k_op.cc — returns (values, indices) over last dim."""
+    return jax.lax.top_k(x, k)
+
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    # Sort ascending then flip: negation wraps for unsigned ints / breaks bool.
+    order = jnp.argsort(x, axis=axis)
+    if descending:
+        order = jnp.flip(order, axis=axis)
+    values = jnp.take_along_axis(x, order, axis=axis)
+    return values, order
+
+
+def arg_max(x, axis: int = -1):
+    return jnp.argmax(x, axis=axis)
+
+
+def arg_min(x, axis: int = -1):
+    return jnp.argmin(x, axis=axis)
+
+
+def where_index(cond):
+    """reference: where_op.cc — indices of nonzero. NOTE: dynamic output shape
+    is jit-hostile; use only eagerly or with size= bound."""
+    return jnp.stack(jnp.nonzero(cond), axis=-1)
+
+
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def multiplex(index, inputs):
+    """reference: multiplex_op.cc — per-row select among inputs."""
+    stacked = jnp.stack(inputs, axis=0)  # (K, N, D)
+    idx = index.reshape(-1).astype(jnp.int32)  # (N,)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def is_empty(x):
+    return jnp.array(x.size == 0)
+
+
+def random_crop(x, shape, key):
+    """reference: random_crop_op.cc — random offset crop of trailing dims."""
+    offsets = []
+    for i, (xs, s) in enumerate(zip(x.shape[-len(shape):], shape)):
+        key, sub = jax.random.split(key)
+        offsets.append(jax.random.randint(sub, (), 0, xs - s + 1))
+    start = [0] * (x.ndim - len(shape)) + [int(o) for o in offsets]
+    sizes = list(x.shape[:x.ndim - len(shape)]) + list(shape)
+    return jax.lax.dynamic_slice(x, start, sizes)
+
+
+def unique_with_counts(x):
+    """reference: unique_with_counts_op — eager only (dynamic shape)."""
+    vals, counts = jnp.unique(x, return_counts=True)
+    return vals, counts
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis)
+
+
+def tril(x, k: int = 0):
+    return jnp.tril(x, k)
+
+
+def triu(x, k: int = 0):
+    return jnp.triu(x, k)

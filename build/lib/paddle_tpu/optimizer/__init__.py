@@ -1,0 +1,31 @@
+"""Optimizers — capability parity with the reference optimizer set
+(reference: python/paddle/fluid/optimizer.py:49 base + 12 concrete classes
+:508-1874; C++ kernels in paddle/fluid/operators/optimizers/).
+
+Design: functional update rules over parameter pytrees (the reference's
+"append update ops to the program" becomes "pure update function jitted into
+the train step"). The Optimizer object carries hyperparameters + LR schedule;
+``init(params)`` builds the state pytree; ``apply(params, grads, state)``
+returns (new_params, new_state). ``minimize`` composes value_and_grad +
+clip + regularization + apply — the Optimizer.minimize analog.
+"""
+
+from .lr_scheduler import (CosineDecay, ExponentialDecay, InverseTimeDecay,
+                           LinearWarmup, NaturalExpDecay, NoamDecay,
+                           PiecewiseDecay, PolynomialDecay)
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
+                         DecayedAdagrad, ExponentialMovingAverage, Ftrl,
+                         Lamb, LarsMomentum, Momentum, Optimizer,
+                         ProximalAdagrad, ProximalGD, RMSProp)
+from .loss_scaler import DynamicLossScaler
+from .sparse import apply_rows, merge_rows, sparse_minimize_fn
+
+__all__ = [
+    "apply_rows", "merge_rows", "sparse_minimize_fn",
+    "SGD", "Adadelta", "Adagrad", "Adam", "Adamax", "AdamW", "DecayedAdagrad",
+    "Ftrl", "Lamb", "LarsMomentum", "Momentum", "Optimizer", "RMSProp",
+    "ProximalGD", "ProximalAdagrad", "ExponentialMovingAverage",
+    "CosineDecay", "ExponentialDecay", "InverseTimeDecay", "LinearWarmup",
+    "NaturalExpDecay", "NoamDecay", "PiecewiseDecay", "PolynomialDecay",
+    "DynamicLossScaler",
+]

@@ -1,0 +1,67 @@
+"""Dynamic loss scaling — fp16-compat mixed precision.
+
+Capability parity with the reference's mixed-precision decorator
+(reference: python/paddle/fluid/contrib/mixed_precision/decorator.py:26,190 —
+master weights + static/dynamic loss scaling). On TPU bf16 needs no scaling
+(same exponent range as fp32), so this exists for fp16-compat parity and for
+users porting fp16 recipes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DynamicLossScaler:
+    """Functional dynamic loss scaler.
+
+    state = {"scale", "good_steps"}; usage inside a train step:
+        scaled_loss = scale_loss(loss, state)
+        grads = grad(scaled_loss_fn)  # scaled grads
+        grads, state, is_finite = unscale_and_update(grads, state)
+        # skip the optimizer apply when not is_finite (lax.cond)
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 15,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5):
+        self.init_scale = init_scale
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+
+    def init(self):
+        return {"scale": jnp.asarray(self.init_scale, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32),
+                "bad_steps": jnp.zeros((), jnp.int32)}
+
+    def scale_loss(self, loss, state):
+        return loss * state["scale"].astype(loss.dtype)
+
+    def unscale_and_update(self, grads: Any, state) -> Tuple[Any, dict, Any]:
+        scale = state["scale"]
+        inv = (1.0 / scale)
+        unscaled = jax.tree_util.tree_map(
+            lambda g: g * inv.astype(g.dtype), grads)
+        finite_tree = jax.tree_util.tree_map(
+            lambda g: jnp.all(jnp.isfinite(g)), unscaled)
+        is_finite = jax.tree_util.tree_reduce(
+            jnp.logical_and, finite_tree, jnp.asarray(True))
+        good = jnp.where(is_finite, state["good_steps"] + 1, 0)
+        bad = jnp.where(is_finite, 0, state["bad_steps"] + 1)
+        grow = good >= self.incr_every_n_steps
+        shrink = bad >= self.decr_every_n_nan_or_inf
+        new_scale = jnp.where(
+            is_finite,
+            jnp.where(grow, scale * self.incr_ratio, scale),
+            jnp.where(shrink, scale * self.decr_ratio, scale))
+        new_scale = jnp.clip(new_scale, 1.0, 2.0 ** 24)
+        new_state = {"scale": new_scale,
+                     "good_steps": jnp.where(grow, 0, good),
+                     "bad_steps": jnp.where(shrink, 0, bad)}
+        return unscaled, new_state, is_finite

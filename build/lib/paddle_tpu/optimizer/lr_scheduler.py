@@ -1,0 +1,151 @@
+"""Learning-rate schedules.
+
+Capability parity with the reference schedules (reference:
+python/paddle/fluid/layers/learning_rate_scheduler.py — noam_decay,
+exponential_decay, natural_exp_decay, inverse_time_decay, polynomial_decay,
+piecewise_decay, cosine_decay, linear_lr_warmup). The reference emits schedule
+*ops* into the program; here a schedule is a pure function ``step -> lr``
+traced into the jitted train step (step is a traced scalar).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class LRSchedule:
+    def __call__(self, step):
+        raise NotImplementedError
+
+
+class Constant(LRSchedule):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, step):
+        return jnp.asarray(self.value, jnp.float32)
+
+
+class NoamDecay(LRSchedule):
+    """reference: learning_rate_scheduler.py noam_decay."""
+
+    def __init__(self, d_model: int, warmup_steps: int, scale: float = 1.0):
+        self.d_model, self.warmup_steps, self.scale = d_model, warmup_steps, scale
+
+    def __call__(self, step):
+        step = jnp.maximum(step.astype(jnp.float32), 1.0)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.scale * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class ExponentialDecay(LRSchedule):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 decay_rate: float, staircase: bool = False):
+        self.lr, self.steps, self.rate, self.staircase = (
+            learning_rate, decay_steps, decay_rate, staircase)
+
+    def __call__(self, step):
+        exp = step.astype(jnp.float32) / self.steps
+        if self.staircase:
+            exp = jnp.floor(exp)
+        return self.lr * (self.rate ** exp)
+
+
+class NaturalExpDecay(LRSchedule):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 decay_rate: float, staircase: bool = False):
+        self.lr, self.steps, self.rate, self.staircase = (
+            learning_rate, decay_steps, decay_rate, staircase)
+
+    def __call__(self, step):
+        exp = step.astype(jnp.float32) / self.steps
+        if self.staircase:
+            exp = jnp.floor(exp)
+        return self.lr * jnp.exp(-self.rate * exp)
+
+
+class InverseTimeDecay(LRSchedule):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 decay_rate: float, staircase: bool = False):
+        self.lr, self.steps, self.rate, self.staircase = (
+            learning_rate, decay_steps, decay_rate, staircase)
+
+    def __call__(self, step):
+        t = step.astype(jnp.float32) / self.steps
+        if self.staircase:
+            t = jnp.floor(t)
+        return self.lr / (1.0 + self.rate * t)
+
+
+class PolynomialDecay(LRSchedule):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 end_learning_rate: float = 1e-4, power: float = 1.0,
+                 cycle: bool = False):
+        self.lr, self.steps = learning_rate, decay_steps
+        self.end_lr, self.power, self.cycle = end_learning_rate, power, cycle
+
+    def __call__(self, step):
+        s = step.astype(jnp.float32)
+        if self.cycle:
+            mult = jnp.ceil(jnp.maximum(s, 1.0) / self.steps)
+            steps = self.steps * jnp.maximum(mult, 1.0)
+        else:
+            steps = self.steps
+            s = jnp.minimum(s, steps)
+        frac = (1.0 - s / steps) ** self.power
+        return (self.lr - self.end_lr) * frac + self.end_lr
+
+
+class PiecewiseDecay(LRSchedule):
+    """reference: piecewise_decay(boundaries, values)."""
+
+    def __init__(self, boundaries: Sequence[int], values: Sequence[float]):
+        assert len(values) == len(boundaries) + 1
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def __call__(self, step):
+        b = jnp.asarray(self.boundaries)
+        v = jnp.asarray(self.values, jnp.float32)
+        idx = jnp.sum(step >= b)
+        return v[idx]
+
+
+class CosineDecay(LRSchedule):
+    """reference: cosine_decay(lr, step_each_epoch, epochs)."""
+
+    def __init__(self, learning_rate: float, step_each_epoch: int, epochs: int):
+        self.lr, self.step_each_epoch, self.epochs = (
+            learning_rate, step_each_epoch, epochs)
+
+    def __call__(self, step):
+        epoch = jnp.floor(step.astype(jnp.float32) / self.step_each_epoch)
+        return self.lr * 0.5 * (jnp.cos(epoch * math.pi / self.epochs) + 1.0)
+
+
+class LinearWarmup(LRSchedule):
+    """reference: linear_lr_warmup — wraps another schedule (or constant)."""
+
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float,
+                 end_lr: float):
+        self.base = (learning_rate if isinstance(learning_rate, LRSchedule)
+                     else Constant(learning_rate))
+        self.warmup_steps, self.start_lr, self.end_lr = (
+            warmup_steps, start_lr, end_lr)
+
+    def __call__(self, step):
+        s = step.astype(jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * (
+            s / self.warmup_steps)
+        return jnp.where(s < self.warmup_steps, warm, self.base(step))
+
+
+def make_schedule(lr) -> LRSchedule:
+    if isinstance(lr, LRSchedule):
+        return lr
+    return Constant(float(lr))

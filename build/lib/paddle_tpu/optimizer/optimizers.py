@@ -1,0 +1,507 @@
+"""Optimizer implementations.
+
+Each mirrors a reference C++ optimizer op (reference:
+paddle/fluid/operators/optimizers/{sgd,momentum,lars_momentum,adam,adamax,
+adagrad,decayed_adagrad,adadelta,rmsprop,ftrl}_op.cc) as a pure per-leaf
+update rule lifted over the parameter pytree. Lamb/AdamW are additions the
+modern model zoo needs.
+
+The step counter lives in state["step"]; LR schedules read it (traced-safe).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+from .lr_scheduler import make_schedule
+
+PyTree = Any
+
+
+def tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class Optimizer:
+    """Base — reference Optimizer (optimizer.py:49): minimize = backward +
+    clip/regularize + apply_gradients, with LR schedule + accumulators."""
+
+    def __init__(self, learning_rate=0.01, grad_clip=None, regularization=None):
+        self.schedule = make_schedule(learning_rate)
+        self.grad_clip = grad_clip
+        self.regularization = regularization
+
+    # --- per-leaf rule (override these two) --------------------------------
+
+    def init_leaf(self, p) -> Dict[str, Any]:
+        return {}
+
+    def update_leaf(self, p, g, s: Dict[str, Any], lr, step):
+        raise NotImplementedError
+
+    # --- pytree lifting -----------------------------------------------------
+
+    def init(self, params: PyTree) -> Dict[str, Any]:
+        leaves, _ = jax.tree_util.tree_flatten(params)
+        return {"step": jnp.zeros((), jnp.int32),
+                "leaf": [self.init_leaf(p) for p in leaves]}
+
+    def apply(self, params: PyTree, grads: PyTree,
+              state: Dict[str, Any]) -> Tuple[PyTree, Dict[str, Any]]:
+        step = state["step"]
+        lr = self.schedule(step)
+        # reference order (optimizer.py apply_gradients): clip the raw grads
+        # first, then add the regularization term.
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        if self.regularization is not None:
+            grads = self.regularization.apply_to_grads(params, grads)
+        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaf_states = state["leaf"]
+        enforce(len(leaf_states) == len(leaves_p),
+                "optimizer state has %s leaves, params have %s — "
+                "init() with the same structure", len(leaf_states), len(leaves_p))
+        results = [self.update_leaf(p, g, s, lr, step)
+                   for p, g, s in zip(leaves_p, leaves_g, leaf_states)]
+        new_params = jax.tree_util.tree_unflatten(
+            treedef, [r[0] for r in results])
+        return new_params, {"step": step + 1, "leaf": [r[1] for r in results]}
+
+    # --- high-level UX ------------------------------------------------------
+
+    def minimize_fn(self, loss_fn: Callable) -> Callable:
+        """Build a jittable ``train_step(params, state, *args) ->
+        (loss, new_params, new_state)`` (Optimizer.minimize analog)."""
+
+        def step_fn(params, state, *args, **kwargs):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *args, **kwargs)
+            new_params, new_state = self.apply(params, grads, state)
+            return loss, new_params, new_state
+
+        return step_fn
+
+    def current_lr(self, state) -> jnp.ndarray:
+        return self.schedule(state["step"])
+
+    # --- static-graph (fluid) entry points ---------------------------------
+    # reference optimizer.py: minimize = backward + apply_gradients over a
+    # Program. The SAME per-leaf rule (init_leaf/update_leaf) lowers to
+    # recorded update ops, so every functional optimizer works in static
+    # mode without a parallel implementation.
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        """reference: optimizer.py Optimizer.backward → append_backward."""
+        from ..static.program import append_backward
+
+        return append_backward(loss, parameter_list)
+
+    def apply_gradients(self, params_grads):
+        """Record update ops (+accumulator vars) for (param, grad) Vars.
+
+        Mirrors the eager apply() ordering: clip the WHOLE grad set first
+        (global-norm clips see all grads in one recorded op), then add the
+        regularization term, then per-param updates."""
+        params = [p for p, _ in params_grads]
+        grads = [g for _, g in params_grads]
+        if params and self.grad_clip is not None:
+            prog = params[0].program
+            clip = self.grad_clip
+            if len(grads) == 1:
+                out = prog.apply(lambda g: clip([g])[0], grads,
+                                 name="grad_clip")
+                grads = [out]
+            else:
+                out = prog.apply(lambda *gs: tuple(clip(list(gs))), grads,
+                                 name="grad_clip")
+                grads = list(out)
+        for param, grad in zip(params, grads):
+            self._append_static_update(param.program, param, grad)
+        return list(zip(params, grads))
+
+    def apply_optimize(self, loss, startup_program=None, params_grads=None):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        pairs = self.backward(loss, parameter_list=parameter_list)
+        self.apply_gradients(pairs)
+        return None, pairs
+
+    def get_opti_var_name_list(self):
+        """Accumulator var names created by static apply_gradients
+        (reference: optimizer.py get_opti_var_name_list)."""
+        return list(getattr(self, "_opti_var_names", []))
+
+    def _append_static_update(self, prog, param, grad):
+        from .. import initializer as _I
+
+        tpl = self.init_leaf(jnp.zeros(param.shape, param.dtype))
+        keys = sorted(tpl)
+        names = []
+        svars = []
+        for k in keys:
+            name = prog.unique_name(f"{param.name}_{k}")
+            # accumulators start at init_leaf's ACTUAL value (e.g. Adagrad's
+            # initial_accumulator_value), matching the eager init() path
+            import numpy as _np
+
+            svars.append(prog.create_parameter(
+                name, jnp.shape(tpl[k]), jnp.asarray(tpl[k]).dtype,
+                initializer=_I.NumpyArray(_np.asarray(tpl[k])),
+                trainable=False))
+            names.append(name)
+        tname = prog.unique_name(f"{param.name}_step")
+        tvar = prog.create_parameter(tname, (), jnp.int32,
+                                     initializer=_I.Constant(0.0),
+                                     trainable=False)
+        names.append(tname)
+        self._opti_var_names = getattr(self, "_opti_var_names", []) + names
+
+        def fn(p, g, t, *svals):
+            s = dict(zip(keys, svals))
+            if self.regularization is not None:
+                g = self.regularization.apply_to_grads(p, g)
+            lr = self.schedule(t)
+            p_new, s_new = self.update_leaf(p, g, s, lr, t)
+            return (p_new, t + 1) + tuple(s_new[k] for k in keys)
+
+        outs = prog.apply(fn, [param, grad, tvar] + svars,
+                          name=f"{type(self).__name__.lower()}_{param.name}")
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        prog.assign(param, outs[0])
+        prog.assign(tvar, outs[1])
+        for var, k in zip(svars, keys):
+            prog.assign(var, outs[2 + keys.index(k)])
+
+
+class SGD(Optimizer):
+    """reference: optimizers/sgd_op.cc."""
+
+    def update_leaf(self, p, g, s, lr, step):
+        return p - lr.astype(p.dtype) * g.astype(p.dtype), s
+
+
+class Momentum(Optimizer):
+    """reference: optimizers/momentum_op.cc (incl. use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.01, momentum: float = 0.9,
+                 use_nesterov: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def init_leaf(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        lr = lr.astype(p.dtype)
+        v = self.momentum * s["velocity"] + g
+        if self.use_nesterov:
+            new_p = p - (g + self.momentum * v) * lr
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """reference: optimizers/lars_momentum_op.cc — layer-adaptive LR."""
+
+    def __init__(self, learning_rate=0.01, momentum: float = 0.9,
+                 lars_coeff: float = 1e-3, lars_weight_decay: float = 5e-4, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+
+    def init_leaf(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        lr = lr.astype(p.dtype)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = lr * self.lars_coeff * p_norm / (
+            g_norm + self.lars_weight_decay * p_norm + 1e-12)
+        local_lr = jnp.where(p_norm > 0, local_lr, lr)
+        v = self.momentum * s["velocity"] + local_lr * (
+            g + self.lars_weight_decay * p)
+        return p - v, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: optimizers/adam_op.cc."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 lazy_mode: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_leaf(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        t = (step + 1).astype(jnp.float32)
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        v = self.beta2 * s["v"] + (1 - self.beta2) * jnp.square(g)
+        mhat = m / (1 - self.beta1 ** t).astype(p.dtype)
+        vhat = v / (1 - self.beta2 ** t).astype(p.dtype)
+        new_p = p - lr.astype(p.dtype) * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return new_p, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (modern addition; the reference couples L2 into
+    grads via regularizer.py)."""
+
+    def __init__(self, learning_rate=0.001, weight_decay: float = 0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self.weight_decay = weight_decay
+
+    def update_leaf(self, p, g, s, lr, step):
+        new_p, new_s = super().update_leaf(p, g, s, lr, step)
+        return new_p - lr.astype(p.dtype) * self.weight_decay * p, new_s
+
+
+class Adamax(Optimizer):
+    """reference: optimizers/adamax_op.cc."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_leaf(self, p):
+        return {"m": jnp.zeros_like(p), "inf": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        t = (step + 1).astype(jnp.float32)
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        inf = jnp.maximum(self.beta2 * s["inf"], jnp.abs(g))
+        lr_t = (lr / (1 - self.beta1 ** t)).astype(p.dtype)
+        new_p = p - lr_t * m / (inf + self.epsilon)
+        return new_p, {"m": m, "inf": inf}
+
+
+class Adagrad(Optimizer):
+    """reference: optimizers/adagrad_op.cc."""
+
+    def __init__(self, learning_rate=0.01, epsilon: float = 1e-6,
+                 initial_accumulator_value: float = 0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.epsilon = epsilon
+        self.init_acc = initial_accumulator_value
+
+    def init_leaf(self, p):
+        return {"moment": jnp.full_like(p, self.init_acc)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        moment = s["moment"] + jnp.square(g)
+        new_p = p - lr.astype(p.dtype) * g / (jnp.sqrt(moment) + self.epsilon)
+        return new_p, {"moment": moment}
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: optimizers/decayed_adagrad_op.cc."""
+
+    def __init__(self, learning_rate=0.01, decay: float = 0.95,
+                 epsilon: float = 1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.decay, self.epsilon = decay, epsilon
+
+    def init_leaf(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        moment = self.decay * s["moment"] + (1 - self.decay) * jnp.square(g)
+        new_p = p - lr.astype(p.dtype) * g / (jnp.sqrt(moment) + self.epsilon)
+        return new_p, {"moment": moment}
+
+
+class Adadelta(Optimizer):
+    """reference: optimizers/adadelta_op.cc."""
+
+    def __init__(self, learning_rate=1.0, rho: float = 0.95,
+                 epsilon: float = 1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def init_leaf(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p),
+                "avg_sq_update": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        asg = self.rho * s["avg_sq_grad"] + (1 - self.rho) * jnp.square(g)
+        update = g * jnp.sqrt(s["avg_sq_update"] + self.epsilon) / jnp.sqrt(
+            asg + self.epsilon)
+        asu = self.rho * s["avg_sq_update"] + (1 - self.rho) * jnp.square(update)
+        return p - lr.astype(p.dtype) * update, \
+            {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    """reference: optimizers/rmsprop_op.cc (incl. centered variant)."""
+
+    def __init__(self, learning_rate=0.01, rho: float = 0.95,
+                 epsilon: float = 1e-6, momentum: float = 0.0,
+                 centered: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def init_leaf(self, p):
+        s = {"mean_square": jnp.zeros_like(p), "moment": jnp.zeros_like(p)}
+        if self.centered:
+            s["mean_grad"] = jnp.zeros_like(p)
+        return s
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        ms = self.rho * s["mean_square"] + (1 - self.rho) * jnp.square(g)
+        out = {"mean_square": ms}
+        if self.centered:
+            mg = self.rho * s["mean_grad"] + (1 - self.rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self.epsilon)
+        mom = self.momentum * s["moment"] + lr.astype(p.dtype) * g / denom
+        out["moment"] = mom
+        return p - mom, out
+
+
+class Ftrl(Optimizer):
+    """reference: optimizers/ftrl_op.cc."""
+
+    def __init__(self, learning_rate=0.01, l1: float = 0.0, l2: float = 0.0,
+                 lr_power: float = -0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def init_leaf(self, p):
+        return {"squared": jnp.zeros_like(p), "linear": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        lr = lr.astype(p.dtype)
+        new_sq = s["squared"] + jnp.square(g)
+        if self.lr_power == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(s["squared"])) / lr
+        else:
+            sigma = (new_sq ** -self.lr_power - s["squared"] ** -self.lr_power) / lr
+        linear = s["linear"] + g - sigma * p
+        if self.lr_power == -0.5:
+            denom = jnp.sqrt(new_sq) / lr + 2 * self.l2
+        else:
+            denom = new_sq ** -self.lr_power / lr + 2 * self.l2
+        pre = (jnp.sign(linear) * self.l1 - linear) / denom
+        new_p = jnp.where(jnp.abs(linear) > self.l1, pre, jnp.zeros_like(p))
+        return new_p, {"squared": new_sq, "linear": linear}
+
+
+class Lamb(Optimizer):
+    """LAMB (large-batch training; reference-era fleet used LARS, Lamb is the
+    transformer analog)."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-6,
+                 weight_decay: float = 0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon, self.weight_decay = epsilon, weight_decay
+
+    def init_leaf(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        t = (step + 1).astype(jnp.float32)
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        v = self.beta2 * s["v"] + (1 - self.beta2) * jnp.square(g)
+        mhat = m / (1 - self.beta1 ** t).astype(p.dtype)
+        vhat = v / (1 - self.beta2 ** t).astype(p.dtype)
+        update = mhat / (jnp.sqrt(vhat) + self.epsilon) + self.weight_decay * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return p - lr.astype(p.dtype) * ratio * update, {"m": m, "v": v}
+
+
+class ProximalGD(Optimizer):
+    """reference: optimizers/proximal_gd_op.cc — SGD with L1/L2 proximal
+    projection: w = prox(w - lr*g)."""
+
+    def __init__(self, learning_rate, l1: float = 0.0, l2: float = 0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2 = l1, l2
+
+    def update_leaf(self, p, g, s, lr, step):
+        prox = p - lr * g
+        if self.l1 > 0:
+            prox = (jnp.sign(prox) *
+                    jnp.maximum(jnp.abs(prox) - lr * self.l1, 0.0))
+        new_p = prox / (1.0 + lr * self.l2)
+        return new_p, s
+
+
+class ProximalAdagrad(Optimizer):
+    """reference: optimizers/proximal_adagrad_op.cc — Adagrad step with the
+    same proximal projection using the adaptive lr."""
+
+    def __init__(self, learning_rate, l1: float = 0.0, l2: float = 0.0,
+                 epsilon: float = 1e-10, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.epsilon = l1, l2, epsilon
+
+    def init_leaf(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def update_leaf(self, p, g, s, lr, step):
+        moment = s["moment"] + g * g
+        alr = lr / (jnp.sqrt(moment) + self.epsilon)
+        prox = p - alr * g
+        if self.l1 > 0:
+            prox = (jnp.sign(prox) *
+                    jnp.maximum(jnp.abs(prox) - alr * self.l1, 0.0))
+        new_p = prox / (1.0 + alr * self.l2)
+        return new_p, {"moment": moment}
+
+
+class ExponentialMovingAverage:
+    """Parameter EMA (reference: operators/average_accumulates_op.cc +
+    optimizer.py ModelAverage/EMA capability): shadow = decay*shadow +
+    (1-decay)*param, with bias correction. Functional: state in, state out."""
+
+    def __init__(self, decay: float = 0.999):
+        self.decay = decay
+
+    def init(self, params):
+        return {"shadow": tree_map(jnp.zeros_like, params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def update(self, params, state):
+        count = state["count"] + 1
+        shadow = tree_map(
+            lambda s, p: self.decay * s + (1.0 - self.decay) * p,
+            state["shadow"], params)
+        return {"shadow": shadow, "count": count}
+
+    def average(self, state):
+        """Bias-corrected EMA params."""
+        corr = 1.0 - self.decay ** state["count"].astype(jnp.float32)
+        return tree_map(lambda s: s / jnp.maximum(corr, 1e-12),
+                        state["shadow"])

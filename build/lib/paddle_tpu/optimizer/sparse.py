@@ -1,0 +1,172 @@
+"""Row-sparse optimizer updates — the SelectedRows update path.
+
+Capability lineage: the reference's sparse gradients are SelectedRows
+(reference: framework/selected_rows.h:32) emitted by
+lookup_table_op.cc (is_sparse=True); duplicate rows are merged by
+operators/math/selected_rows_functor.cc (MergeAdd) and the optimizer ops
+carry dedicated sparse branches that update only the touched rows
+(reference: operators/optimizers/adam_op.h SelectedRows branch with
+lazy_mode, sgd_op.cc / adagrad_op.cc sparse kernels).
+
+TPU-native form: ids are merged with a static-size ``jnp.unique`` +
+``segment_sum`` (MergeAdd), the per-row optimizer state leaves are
+gathered for the unique rows, the optimizer's ordinary ``update_leaf``
+rule runs on the (U, D) slice, and parameters/state scatter back with
+out-of-bounds drop semantics — O(batch x seq x D) per step, flat in
+vocab size. Untouched rows keep stale accumulators: the reference's
+lazy_mode semantics (momentum/Adam moments decay only when a row is
+touched).
+
+``sparse_minimize_fn`` builds the full train step around the
+capture/inject contexts of ``nn.sparse`` (see that module's docstring
+for the two-phase design).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+PyTree = Any
+
+
+def merge_rows(ids, row_grads, vocab_size: int):
+    """MergeAdd (reference: selected_rows_functor.cc): flatten and merge
+    duplicate ids. Returns (uids (N,), merged (N, D)) where slots past
+    the number of distinct ids hold ``vocab_size`` (out-of-bounds — the
+    scatter drops them)."""
+    ids = ids.reshape(-1)
+    row_grads = row_grads.reshape(ids.shape[0], -1)
+    n = ids.shape[0]
+    uids, inv = jnp.unique(ids, size=n, fill_value=vocab_size,
+                           return_inverse=True)
+    merged = jax.ops.segment_sum(row_grads, inv.reshape(-1), num_segments=n)
+    return uids, merged
+
+
+def apply_rows(optimizer, table, ids, row_grads,
+               leaf_state: Dict[str, Any], lr, step
+               ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """One row-sparse update of ``table`` with ``optimizer``'s ordinary
+    update_leaf rule applied to the touched rows only.
+
+    ``ids``: int array (any shape); ``row_grads``: ids.shape + (D,).
+    State leaves whose leading dim equals the vocab are treated as
+    per-row accumulators (Adam moments, Adagrad accumulator, momentum
+    velocity); anything else passes through untouched.
+    """
+    V = table.shape[0]
+    uids, merged = merge_rows(ids, row_grads, V)
+    merged = merged.astype(table.dtype)
+
+    def rowwise(leaf):
+        return (hasattr(leaf, "ndim") and leaf.ndim >= 1
+                and leaf.shape[0] == V)
+
+    p_rows = table.at[uids].get(mode="fill", fill_value=0)
+    s_rows = {k: (v.at[uids].get(mode="fill", fill_value=0)
+                  if rowwise(v) else v)
+              for k, v in leaf_state.items()}
+    p_new, s_new = optimizer.update_leaf(p_rows, merged, s_rows, lr, step)
+    # fill slots carry uid == V: out-of-bounds, dropped by the scatter
+    new_table = table.at[uids].set(p_new, mode="drop")
+    new_state = {k: (v.at[uids].set(s_new[k], mode="drop")
+                     if rowwise(v) else s_new[k])
+                 for k, v in leaf_state.items()}
+    return new_table, new_state
+
+
+def find_sparse_embeddings(model) -> Dict[str, Any]:
+    """{param name -> layer} for every is_sparse embedding in ``model``
+    (nn.Embedding and parallel.ShardedEmbedding)."""
+    out = {}
+    for name, sub in model.named_sublayers():
+        if getattr(sub, "is_sparse", False) and hasattr(sub, "weight"):
+            out[f"{name}.weight" if name else "weight"] = sub
+    return out
+
+
+def sparse_minimize_fn(model, forward_loss: Callable, optimizer,
+                       emb_optimizer=None):
+    """Build ``(init_fn, step_fn)`` where embedding tables flagged
+    ``is_sparse`` get row-sparse updates and everything else follows the
+    ordinary dense ``optimizer.apply``.
+
+    - ``forward_loss(params, *args, **kwargs) -> scalar loss`` must run
+      the model through ``model.functional_call`` (or ``model(...)``
+      with params set) so the sparse layers see the capture/inject
+      contexts.
+    - ``emb_optimizer`` optionally uses a different rule for the tables
+      (reference: PS deployments pair sparse Adagrad tables with dense
+      Adam); defaults to ``optimizer``.
+
+    Returned contract::
+
+        state = init_fn(params)
+        loss, new_params, new_state = jax.jit(step_fn)(params, state, *a)
+    """
+    from ..nn.sparse import Capture, Inject
+
+    embs = find_sparse_embeddings(model)
+    enforce(embs, "sparse_minimize_fn: model has no is_sparse embeddings "
+            "— use optimizer.minimize_fn instead")
+    emb_names = set(embs)
+    eopt = emb_optimizer or optimizer
+    layer_ids = {id(l) for l in embs.values()}
+    by_layer = {id(l): n for n, l in embs.items()}
+
+    def init_fn(params: Dict[str, Any]) -> Dict[str, Any]:
+        dense = {k: v for k, v in params.items() if k not in emb_names}
+        return {
+            "dense": optimizer.init(dense),
+            "sparse": {n: eopt.init_leaf(params[n]) for n in emb_names},
+        }
+
+    def step_fn(params, state, *args, **kwargs):
+        tables = {n: params[n] for n in emb_names}
+        dense = {k: v for k, v in params.items() if k not in emb_names}
+
+        # phase 1: capture the ids each sparse layer consumes (everything
+        # else in this pass is dead code — XLA DCE removes it)
+        cap = Capture(layer_ids)
+        with cap:
+            forward_loss(params, *args, **kwargs)
+        # phase 2: gather rows OUTSIDE the differentiated function
+        rows = {slot: jnp.take(tables[by_layer[owner]], cap.ids[slot],
+                               axis=0)
+                for slot, owner in cap.owner.items()}
+
+        def inner(dense_p, rows_map):
+            inj = Inject(layer_ids, rows_map)
+            with inj:
+                return forward_loss({**dense_p, **tables}, *args, **kwargs)
+
+        loss, (g_dense, g_rows) = jax.value_and_grad(
+            inner, argnums=(0, 1))(dense, rows)
+
+        step = state["dense"]["step"]
+        new_dense, new_dense_state = optimizer.apply(
+            dense, g_dense, state["dense"])
+
+        lr = eopt.schedule(step)
+        new_sparse_state = {}
+        new_tables = dict(tables)
+        for name in emb_names:
+            slots = [s for s, o in cap.owner.items()
+                     if by_layer[o] == name]
+            tbl, st = new_tables[name], state["sparse"][name]
+            for slot in slots:
+                tbl, st = apply_rows(eopt, tbl, cap.ids[slot],
+                                     g_rows[slot], st, lr, step)
+            new_tables[name] = tbl
+            new_sparse_state[name] = st
+
+        new_params = {**new_dense, **new_tables}
+        return loss, new_params, {"dense": new_dense_state,
+                                  "sparse": new_sparse_state}
+
+    return init_fn, step_fn

@@ -1,0 +1,35 @@
+"""Parallelism: collectives, data-parallel trainer, sharding rules.
+
+TP/PP/SP/EP land as mesh-axis sharding rules (SURVEY §7 step 8); the mesh
+itself lives in paddle_tpu.core.mesh.
+"""
+
+from .api import DataParallel, Trainer
+from .context_parallel import (context_parallel_attention, ring_attention,
+                               sharded_flash_attention, ulysses_attention)
+from .collective import (allgather, allreduce, all_to_all, axis_index,
+                         broadcast, ppermute, reduce_scatter)
+from .dgc import (DGCMomentum, dgc_allreduce, quantized_allreduce,
+                  top_k_sparsify)
+from .geo_sgd import GeoSGDTrainer
+from .hybrid import (build_bert_hybrid_step,
+                     build_hybrid_transformer_step)
+from .pipeline import GPipe, pipeline_apply, stage_param_sharding
+from .sharded_embedding import (ShardedEmbedding, embedding_ep_rules,
+                                sharded_embedding_lookup)
+from .sharding import (OptStateRules, constraint, infer_param_spec,
+                       shard_params, transformer_tp_rules, zero_dp_rules)
+
+__all__ = [
+    "DataParallel", "Trainer", "allgather", "allreduce", "all_to_all",
+    "axis_index", "broadcast", "context_parallel_attention", "ppermute",
+    "reduce_scatter", "ring_attention",
+    "sharded_flash_attention", "ulysses_attention",
+    "GPipe", "pipeline_apply", "stage_param_sharding",
+    "ShardedEmbedding", "embedding_ep_rules", "sharded_embedding_lookup",
+    "OptStateRules", "constraint", "infer_param_spec", "shard_params",
+    "transformer_tp_rules", "zero_dp_rules",
+    "DGCMomentum", "dgc_allreduce", "quantized_allreduce", "top_k_sparsify",
+    "build_hybrid_transformer_step", "build_bert_hybrid_step",
+    "GeoSGDTrainer",
+]

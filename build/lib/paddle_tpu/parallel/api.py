@@ -1,0 +1,329 @@
+"""High-level parallel training — the ParallelExecutor/CompiledProgram/fleet
+capability (reference: framework/parallel_executor.cc:195,
+compiler.py:117 with_data_parallel, incubate/fleet/collective) as one object.
+
+``Trainer`` owns (params, buffers, opt_state) placed on a mesh and a jitted
+train step. Data parallelism is a *sharding*, not a program rewrite: params
+replicated, batch split over "dp"; XLA inserts gradient all-reduces (the whole
+multi_devices_graph_pass, reference: multi_devices_graph_pass.cc:450, becomes
+compiler work). Buffers donate so updates are in-place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import random as prandom
+from ..core.config import BuildStrategy
+from ..core.enforce import enforce
+from ..core.mesh import get_mesh
+from ..nn.layer import Layer
+from ..optimizer.optimizers import Optimizer
+
+
+class Trainer:
+    """Functional training driver.
+
+    loss_builder(params, buffers, rng, batch) ->
+        (loss, (metrics_dict, new_buffers))
+    """
+
+    def __init__(self, model: Layer, optimizer: Optimizer,
+                 loss_builder: Callable, mesh=None,
+                 build_strategy: Optional[BuildStrategy] = None,
+                 param_spec: Optional[Dict[str, P]] = None,
+                 opt_state_rules=None, amp: Optional[str] = None,
+                 grad_accum_steps: int = 1):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_builder = loss_builder
+        self.mesh = mesh or get_mesh()
+        self.strategy = build_strategy or BuildStrategy()
+        # amp: policy name ("mixed_bf16" / "mixed_fp16" / ...) applied at
+        # trace time around the loss (reference: contrib/mixed_precision
+        # decorator capability; bf16 needs no loss scaling — pair
+        # "mixed_fp16" with amp.decorate()'d optimizer for scaling)
+        self.amp_policy = amp
+        # gradient merge (reference: fleet DistributedStrategy
+        # gradient_merge / gradient accumulation): average grads over K
+        # micro-steps, apply the optimizer on the K-th
+        enforce(grad_accum_steps >= 1, "grad_accum_steps must be >= 1")
+        self.grad_accum_steps = grad_accum_steps
+
+        rep = NamedSharding(self.mesh, P())
+
+        def place(tree, spec_map=None):
+            def put(path_leaf):
+                return jax.device_put(path_leaf, rep)
+
+            return jax.tree_util.tree_map(put, tree)
+
+        self.params = place(model.named_parameters())
+        if param_spec:
+            for name, spec in param_spec.items():
+                self.params[name] = jax.device_put(
+                    self.params[name], NamedSharding(self.mesh, spec))
+        self.buffers = place(model.named_buffers())
+        # opt state inherits each param's sharding (init uses zeros_like on
+        # the already-placed params) — re-placing replicated would defeat
+        # param_spec's memory sharding for the moments
+        self.opt_state = optimizer.init(self.params)
+        if opt_state_rules is not None:
+            # ZeRO-style: shard large moment leaves over dp (the PS-sharded
+            # optimizer-state capability, reference:
+            # transpiler/distribute_transpiler.py:702)
+            self.opt_state = opt_state_rules.place(self.opt_state, self.mesh)
+        self._rng = prandom.next_key()
+        if self.grad_accum_steps > 1:
+            self._accum = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+            self._accum_count = jnp.zeros((), jnp.int32)
+            donate = (0, 1, 2, 3, 4) if self.strategy.donate_inputs else ()
+            self._jit_step = jax.jit(self._accum_step, donate_argnums=donate)
+        else:
+            donate = (0, 1, 2) if self.strategy.donate_inputs else ()
+            self._jit_step = jax.jit(self._step, donate_argnums=donate)
+        self._jit_eval = jax.jit(self._eval_step)
+        self._multi_cache = {}
+
+    # --- pure step functions ------------------------------------------------
+
+    def _step(self, params, buffers, opt_state, rng, batch):
+        from ..amp import MixedPrecisionOptimizer
+        from ..core.dtypes import policy_scope
+
+        import contextlib
+
+        scope = (policy_scope(self.amp_policy) if self.amp_policy
+                 else contextlib.nullcontext())
+        scaled = isinstance(self.optimizer, MixedPrecisionOptimizer)
+
+        def lf(p):
+            with scope:
+                loss, (metrics, new_buffers) = self.loss_builder(
+                    p, buffers, rng, batch)
+            out_loss = (self.optimizer.scale_loss(loss, opt_state)
+                        if scaled else loss)
+            return out_loss, (loss, metrics, new_buffers)
+
+        (_, (loss, metrics, new_buffers)), grads = jax.value_and_grad(
+            lf, has_aux=True)(params)
+        new_params, new_opt_state = self.optimizer.apply(params, grads,
+                                                         opt_state)
+        return loss, metrics, new_params, new_buffers, new_opt_state
+
+    def _accum_step(self, params, buffers, opt_state, accum, count, rng,
+                    batch):
+        """Gradient-merge micro-step: accumulate; apply on the K-th."""
+        import contextlib
+
+        from ..amp import MixedPrecisionOptimizer
+        from ..core.dtypes import policy_scope
+
+        scope = (policy_scope(self.amp_policy) if self.amp_policy
+                 else contextlib.nullcontext())
+        scaled = isinstance(self.optimizer, MixedPrecisionOptimizer)
+
+        def lf(p):
+            with scope:
+                loss, (metrics, new_buffers) = self.loss_builder(
+                    p, buffers, rng, batch)
+            out_loss = (self.optimizer.scale_loss(loss, opt_state)
+                        if scaled else loss)
+            return out_loss, (loss, metrics, new_buffers)
+
+        (_, (loss, metrics, new_buffers)), grads = jax.value_and_grad(
+            lf, has_aux=True)(params)
+        k = self.grad_accum_steps
+        accum = jax.tree_util.tree_map(lambda a, g: a + g, accum, grads)
+        count = count + 1
+        do_apply = count >= k
+        mean_grads = jax.tree_util.tree_map(lambda a: a / k, accum)
+        cand_params, cand_opt = self.optimizer.apply(params, mean_grads,
+                                                     opt_state)
+        sel = lambda new, old: jax.tree_util.tree_map(
+            lambda n, o: jnp.where(do_apply, n, o), new, old)
+        new_params = sel(cand_params, params)
+        new_opt = sel(cand_opt, opt_state)
+        accum = jax.tree_util.tree_map(
+            lambda a: jnp.where(do_apply, jnp.zeros_like(a), a), accum)
+        count = jnp.where(do_apply, 0, count)
+        return (loss, metrics, new_params, new_buffers, new_opt, accum,
+                count)
+
+    def _eval_step(self, params, buffers, batch):
+        import contextlib
+
+        from ..core.dtypes import policy_scope
+
+        scope = (policy_scope(self.amp_policy) if self.amp_policy
+                 else contextlib.nullcontext())
+        with scope:
+            loss, (metrics, _) = self.loss_builder(params, buffers, None,
+                                                   batch)
+        return loss, metrics
+
+    # --- driver API ---------------------------------------------------------
+
+    def train_step(self, batch) -> Tuple[Any, Dict[str, Any]]:
+        from ..core.profiler import RecordEvent
+
+        # op-level span parity (reference: RecordEvent pushed around every
+        # op run, platform/profiler.h:81) — here one span per compiled step
+        with RecordEvent("train_step"):
+            self._rng, sub = jax.random.split(self._rng)
+            if self.grad_accum_steps > 1:
+                (loss, metrics, self.params, self.buffers, self.opt_state,
+                 self._accum, self._accum_count) = self._jit_step(
+                    self.params, self.buffers, self.opt_state, self._accum,
+                    self._accum_count, sub, batch)
+            else:
+                loss, metrics, self.params, self.buffers, self.opt_state = \
+                    self._jit_step(self.params, self.buffers, self.opt_state,
+                                   sub, batch)
+        return loss, metrics
+
+    def train_steps(self, batch, n: int):
+        """Run ``n`` fused update steps in ONE device dispatch via
+        lax.scan — the reference's num_iteration_per_drop_scope /
+        scope-buffered multi-iteration execution (ExecutionStrategy,
+        details/scope_buffered_ssa_graph_executor.h:37) in compiled form.
+        Cuts host→device round trips by n (the dominant cost through a
+        remote-device tunnel). The batch is reused for each inner step;
+        feed-per-step loops should call train_step instead. Returns the
+        last step's (loss, metrics)."""
+        from ..core.profiler import RecordEvent
+
+        fn = self.steps_jit(n)
+        with RecordEvent(f"train_steps[{n}]"):
+            self._rng, sub = jax.random.split(self._rng)
+            loss, metrics, self.params, self.buffers, self.opt_state = fn(
+                self.params, self.buffers, self.opt_state, sub, batch)
+        return loss, metrics
+
+    def steps_jit(self, n: int):
+        """The jitted ``n``-fused-step callable train_steps dispatches
+        (built lazily, cached, NOT yet called — so callers may
+        ``.lower()`` it for cost analysis before any donation happens).
+        Signature: ``fn(params, buffers, opt_state, rng, batch)``."""
+        enforce(self.grad_accum_steps == 1,
+                "train_steps composes with plain steps only (use "
+                "train_step for gradient merge)")
+        enforce(n >= 1, "train_steps needs n >= 1, got %s", n)
+        key = ("train_steps", int(n))
+        fn = self._multi_cache.get(key)
+        if fn is None:
+            def many(params, buffers, opt_state, rng, batch):
+                def body(carry, sub):
+                    params, buffers, opt_state = carry
+                    loss, metrics, params, buffers, opt_state = self._step(
+                        params, buffers, opt_state, sub, batch)
+                    return (params, buffers, opt_state), (loss, metrics)
+
+                subs = jax.random.split(rng, n)
+                (params, buffers, opt_state), (losses, metrics) = lax.scan(
+                    body, (params, buffers, opt_state), subs)
+                last = jax.tree_util.tree_map(lambda x: x[-1], metrics)
+                return losses[-1], last, params, buffers, opt_state
+
+            donate = (0, 1, 2) if self.strategy.donate_inputs else ()
+            fn = jax.jit(many, donate_argnums=donate)
+            self._multi_cache[key] = fn
+        return fn
+
+    def eval_step(self, batch):
+        return self._jit_eval(self.params, self.buffers, batch)
+
+    def sync_model(self) -> Layer:
+        """Write current params/buffers back into the Layer (for save/export)."""
+        self.model.set_parameters(jax.device_get(self.params))
+        self.model.set_buffers(jax.device_get(self.buffers))
+        return self.model
+
+    def data_sharding(self) -> NamedSharding:
+        """Sharding for input batches: leading dim over dp (feed via
+        DataFeeder(sharding=...) — the feed_and_split analog)."""
+        return NamedSharding(self.mesh, P("dp"))
+
+    # --- checkpoint/resume (SURVEY §5.4) ------------------------------------
+
+    def state(self) -> Dict[str, Any]:
+        """Full resumable training state (params + buffers + optimizer
+        moments + RNG) — what the reference persists via save_persistables
+        (params + optimizer accumulators, reference: io.py:460)."""
+        st = {"params": self.params, "buffers": self.buffers,
+              "opt_state": self.opt_state,
+              "rng": jax.random.key_data(self._rng)}
+        if self.grad_accum_steps > 1:
+            st["grad_accum"] = {"accum": self._accum,
+                                "count": self._accum_count}
+        return st
+
+    def save_checkpoint(self, manager_or_dir, step: Optional[int] = None):
+        from ..checkpoint import CheckpointManager, save_state
+
+        if isinstance(manager_or_dir, CheckpointManager):
+            enforce(step is not None,
+                    "save_checkpoint(manager) needs a step number")
+            manager_or_dir.save(step, self.state())
+        else:
+            save_state(manager_or_dir, self.state())
+
+    def restore_checkpoint(self, manager_or_dir,
+                           step: Optional[int] = None) -> None:
+        """Restore in place, resharding saved leaves onto this trainer's
+        mesh (works across mesh shapes — the survey's upgrade over the
+        reference's shape-must-match load)."""
+        from ..checkpoint import CheckpointManager, restore_state
+
+        if isinstance(manager_or_dir, CheckpointManager):
+            st = manager_or_dir.restore(step, mesh=self.mesh,
+                                        target=self.state())
+        else:
+            st = restore_state(manager_or_dir, mesh=self.mesh,
+                               target=self.state())
+        self.params = st["params"]
+        self.buffers = st["buffers"]
+        self.opt_state = st["opt_state"]
+        if self.grad_accum_steps > 1 and "grad_accum" in st:
+            self._accum = st["grad_accum"]["accum"]
+            self._accum_count = st["grad_accum"]["count"]
+        self._rng = jax.random.wrap_key_data(jnp.asarray(st["rng"]))
+
+    @classmethod
+    def supervised(cls, model: Layer, optimizer: Optimizer,
+                   loss_fn: Callable, metrics_fn: Optional[Callable] = None,
+                   mesh=None, **kw) -> "Trainer":
+        """Convenience for (x, label) batches: batch = dict(x=..., label=...)
+        or tuple (x, label)."""
+
+        def loss_builder(params, buffers, rng, batch):
+            if isinstance(batch, dict):
+                x, label = batch["x"], batch["label"]
+            else:
+                x, label = batch
+            training = rng is not None
+            out, new_buffers = model.functional_call(
+                params, x, buffers=buffers, rng=rng, training=training)
+            loss = loss_fn(out, label)
+            metrics = metrics_fn(out, label) if metrics_fn else {}
+            return loss, (metrics, new_buffers)
+
+        return cls(model, optimizer, loss_builder, mesh=mesh, **kw)
+
+
+class DataParallel:
+    """Dygraph-style wrapper (reference: dygraph/parallel.py:79 DataParallel)
+    — here just a Trainer factory over an all-device dp mesh."""
+
+    def __new__(cls, model: Layer, optimizer: Optimizer, loss_fn: Callable,
+                metrics_fn=None, devices=None):
+        from ..core.mesh import auto_mesh
+
+        mesh = auto_mesh(devices)
+        return Trainer.supervised(model, optimizer, loss_fn, metrics_fn,
+                                  mesh=mesh)

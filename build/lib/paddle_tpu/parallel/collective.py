@@ -1,0 +1,62 @@
+"""Collective ops over mesh axes.
+
+The TPU-native replacement for the reference's NCCL op-handles and RPC
+collective server (reference: framework/details/all_reduce_op_handle.cc:91,
+operators/distributed/collective_client.h, layers/collective.py:19): these are
+`lax` collectives bound to named mesh axes, emitted inside `shard_map`/`pjit`
+regions; XLA lowers them onto ICI/DCN rings — there is no hand-written
+transport.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+from jax import lax
+
+Axis = Union[str, Sequence[str]]
+
+
+def allreduce(x, axis: Axis = "dp", op: str = "sum"):
+    """reference: allreduce op (distributed_ops/allreduce_op.cc) → lax.p*."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unknown allreduce op {op}")
+
+
+def allgather(x, axis: Axis = "dp", tiled: bool = True):
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: Axis = "dp", scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
+                            tiled=True)
+
+
+def all_to_all(x, axis: Axis, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis: Axis, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def broadcast(x, axis: Axis = "dp", src: int = 0):
+    """Broadcast src's shard to all — BCastParamsToDevices analog
+    (reference: parallel_executor.cc:434)."""
+    idx = lax.axis_index(axis)
+    masked = jax.tree_util.tree_map(
+        lambda a: jax.numpy.where(idx == src, a, jax.numpy.zeros_like(a)), x)
+    return jax.tree_util.tree_map(lambda a: lax.psum(a, axis), masked)
+
+
+def axis_index(axis: Axis = "dp"):
+    return lax.axis_index(axis)

@@ -1,0 +1,345 @@
+"""Context/sequence parallelism — ring attention and Ulysses all-to-all.
+
+The reference has no sequence-parallel story at all (SURVEY.md §5.7; its long
+-sequence mechanism is LoDTensor packing, reference: framework/lod_tensor.h:110).
+These are green-field TPU designs:
+
+- **Ring attention**: shard the sequence over the ``sp`` mesh axis; K/V blocks
+  rotate around the ring via ``lax.ppermute`` (one ICI hop per step) while each
+  device accumulates its Q-block's attention with a running online softmax
+  (max/sum carries, exactly the flash-attention recurrence lifted to the mesh
+  level). Peak memory per device is O(seq/sp); compute overlaps with the
+  collective permute under XLA's async scheduling.
+
+- **Ulysses**: all-to-all swaps sequence sharding for head sharding, runs a
+  full (optionally Pallas flash) attention locally over seq with heads/sp heads
+  per device, and all-to-alls back. Two a2a hops; requires heads % sp == 0.
+
+Both are differentiable end-to-end: ring via autodiff through the
+``lax.scan``+``ppermute`` loop (step compute wrapped in ``jax.checkpoint`` so
+backward recomputes scores instead of storing (t×t) blocks), Ulysses via the
+flash kernel's custom VJP plus the self-transposing all-to-alls.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.enforce import enforce
+from ..core.mesh import get_mesh
+
+_NEG_INF = -1e30  # finite: avoids inf-inf NaNs under autodiff
+
+
+def _shard_with_optional(inner, mesh, spec, mspec, q, k, v, kv_mask,
+                         segment_ids):
+    """shard_map an ``inner(q, k, v, km, seg)`` with OPTIONAL (B, T)
+    inputs: shard_map specs are positional, so each supplied optional
+    appends an arg+spec pair and the wrapper re-slots them (None for the
+    absent ones) — one place for the plumbing both ring and Ulysses use."""
+    args, in_specs = [q, k, v], [spec, spec, spec]
+    km_i = seg_i = None
+    if kv_mask is not None:
+        km_i = len(args)
+        args.append(kv_mask)
+        in_specs.append(mspec)
+    if segment_ids is not None:
+        seg_i = len(args)
+        args.append(segment_ids)
+        in_specs.append(mspec)
+
+    def wrapper(*xs):
+        return inner(xs[0], xs[1], xs[2],
+                     xs[km_i] if km_i is not None else None,
+                     xs[seg_i] if seg_i is not None else None)
+
+    fn = jax.shard_map(wrapper, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=spec, check_vma=False)
+    return fn(*args)
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+
+def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, qseg, ksegc, src,
+                       my_idx, *, t_local, causal, scale):
+    """One ring step's flash-style accumulation (no collectives; wrapped in
+    jax.checkpoint by the caller so backward recomputes the (t×t) scores).
+    ``kmc``: the K/V block's key-padding keep-mask (b, t_local) rotating
+    around the ring with it, or None. ``qseg``/``ksegc``: packed-batch
+    segment ids — q side fixed to this shard, kv side rotating with its
+    block; attention stays within a segment."""
+    # q/k stay in their native dtype (bf16 in production): bf16 inputs
+    # with an f32 preferred_element_type run at the full MXU rate, while
+    # a pre-cast to f32 would drop to the fp32 matmul rate (4-8x slower
+    # on v5e) with no accumulator benefit
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = my_idx * t_local + lax.broadcasted_iota(
+            jnp.int32, (t_local, t_local), 0)
+        cols = src * t_local + lax.broadcasted_iota(
+            jnp.int32, (t_local, t_local), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    if kmc is not None:
+        s = jnp.where(kmc[:, None, None, :], s, _NEG_INF)
+    if qseg is not None:
+        s = jnp.where(qseg[:, None, :, None] == ksegc[:, None, None, :],
+                      s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)          # (b,h,t,1)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new)
+    if kmc is not None or qseg is not None:
+        # a fully-masked row keeps m_new == _NEG_INF, turning the masked
+        # exp(s - m_new) into exp(0) = 1; zero those entries so l stays 0
+        # and the final o is 0 (causal alone can't fully mask a row —
+        # the diagonal is always visible)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv     # (b,t,h,d)
+    if causal:
+        # K/V block strictly in this Q block's future: contributes nothing.
+        # (s is all _NEG_INF there; keeping old carries avoids exp(0)=1 rows.)
+        valid = src <= my_idx
+        acc_new = jnp.where(valid, acc_new, acc)
+        m_new = jnp.where(valid, m_new, m)
+        l_new = jnp.where(valid, l_new, l)
+    return acc_new, m_new, l_new
+
+
+def _ring_inner(q, k, v, km, seg, *, axis, causal, scale, n):
+    b, t, h, d = q.shape  # local (sequence-sharded) shapes
+    has_mask = km is not None
+    has_segs = seg is not None
+    my_idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qf = q  # native dtype into the MXU (see _ring_step_compute note)
+    compute = jax.checkpoint(functools.partial(
+        _ring_step_compute, t_local=t, causal=causal, scale=scale))
+
+    def step(carry, t_step):
+        acc, m, l, kc, vc, kmc, ksegc = carry
+        src = (my_idx - t_step) % n  # origin rank of the K/V block we hold
+        acc, m, l = compute(qf, acc, m, l, kc, vc,
+                            kmc if has_mask else None,
+                            seg if has_segs else None,
+                            ksegc if has_segs else None, src, my_idx)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        if has_mask:  # the keep-mask block travels with its K/V block
+            kmc = lax.ppermute(kmc, axis, perm)
+        if has_segs:  # so do the kv-side segment ids
+            ksegc = lax.ppermute(ksegc, axis, perm)
+        return (acc, m, l, kc, vc, kmc, ksegc), None
+
+    acc0 = jnp.zeros((b, t, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    # zeros placeholders keep the scan carry structure static when no
+    # mask/ids are supplied (never read: has_* are trace-time consts)
+    km0 = km if has_mask else jnp.zeros((b, t), jnp.bool_)
+    seg0 = seg if has_segs else jnp.zeros((b, t), jnp.int32)
+    # scan the first n-1 steps (compute + rotate); the last block's compute is
+    # peeled out so the final rotation — whose result would be discarded —
+    # never hits the ICI ring
+    (acc, m, l, kc, vc, kmc, ksegc), _ = lax.scan(
+        step, (acc0, m0, l0, k, v, km0, seg0), jnp.arange(n - 1))
+    acc, _, l = compute(qf, acc, m, l, kc, vc,
+                        kmc if has_mask else None,
+                        seg if has_segs else None,
+                        ksegc if has_segs else None,
+                        (my_idx - (n - 1)) % n, my_idx)
+    o = acc / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-37)
+    return o.astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None, axis: str = "sp",
+                   batch_axis: Optional[str] = "dp", mesh=None,
+                   kv_mask=None, segment_ids=None):
+    """Sequence-parallel attention over global (B, T, H, D) arrays.
+
+    ``q``/``k``/``v`` are sharded ``P(batch_axis, axis)`` over the mesh; T must
+    divide by the ``axis`` size. Causal masking is in *global* positions.
+    ``kv_mask``: optional global (B, T) keep-mask (the ragged-batch
+    key-padding form); its blocks rotate around the ring with their K/V.
+    ``segment_ids``: optional global (B, T) packed-batch ids (ids global
+    per row, so a segment spanning a shard boundary keeps one id); the
+    kv-side ids rotate with their block.
+    """
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    b, t, h, d = q.shape
+    enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
+    enforce(k.shape == q.shape and v.shape == q.shape,
+            "ring attention is self-attention shaped: q/k/v must match")
+    for name, arr in (("kv_mask", kv_mask), ("segment_ids", segment_ids)):
+        if arr is not None:
+            enforce(arr.shape == (b, t),
+                    "%s must be (batch, seq) = (%s, %s), got %s",
+                    name, b, t, arr.shape)
+    if scale is None:
+        scale = d ** -0.5
+    spec = P(batch_axis, axis, None, None)
+    mspec = P(batch_axis, axis)
+    inner = functools.partial(_ring_inner, axis=axis, causal=causal,
+                              scale=float(scale), n=n)
+    return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
+                                kv_mask, segment_ids)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism
+# ---------------------------------------------------------------------------
+
+
+def _ulysses_inner(q, k, v, km, seg, *, axis, causal, scale, use_flash):
+    from ..ops.attention import scaled_dot_product_attention
+
+    # (b, t/sp, h, d) --a2a--> (b, t, h/sp, d): full sequence, head subset
+    q = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    mask = None
+    if km is not None:
+        # each shard holds (b, t/sp) of the keep-mask; after the a2a the
+        # local attention sees the FULL sequence, so gather the mask
+        # along sp (tiny: bools, no head/dim axes)
+        full = lax.all_gather(km, axis, axis=1, tiled=True)  # (b, t)
+        mask = full[:, None, None, :]
+    seg_full = None
+    if seg is not None:  # same gather for packed-batch segment ids
+        seg_full = lax.all_gather(seg, axis, axis=1, tiled=True)
+    o = scaled_dot_product_attention(q, k, v, mask=mask, causal=causal,
+                                     scale=scale, use_flash=use_flash,
+                                     segment_ids=seg_full)
+    # back to sequence sharding
+    return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = False,
+                      scale: Optional[float] = None, axis: str = "sp",
+                      batch_axis: Optional[str] = "dp", mesh=None,
+                      use_flash: bool = True, kv_mask=None,
+                      segment_ids=None):
+    """DeepSpeed-Ulysses-style SP: a2a seq→head shard, local full attention
+    (Pallas flash on TPU), a2a back. Requires heads % sp == 0.
+    ``kv_mask``: optional global (B, T) keep-mask; all-gathered over sp
+    for the full-sequence local attention (key-padding routes to the
+    flash kernel's kv_mask path on TPU). ``segment_ids``: optional global
+    (B, T) packed-batch ids, same gather (self-attention only)."""
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    b, t, h, d = q.shape
+    enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
+    enforce(h % n == 0, "num heads %s must divide sp size %s (Ulysses)", h, n)
+    if kv_mask is not None:
+        # key-padding masks cover the KEY sequence: cross-attention under
+        # Ulysses has tk != tq and the mask belongs to k/v, not q
+        tk = k.shape[1]
+        enforce(kv_mask.shape == (b, tk),
+                "kv_mask must be (batch, key_seq) = (%s, %s), got %s",
+                b, tk, kv_mask.shape)
+    if segment_ids is not None:
+        enforce(q.shape[1] == k.shape[1],
+                "segment_ids requires self-attention shapes "
+                "(tq=%s != tk=%s)", q.shape[1], k.shape[1])
+        enforce(segment_ids.shape == (b, t),
+                "segment_ids must be (batch, seq) = (%s, %s), got %s",
+                b, t, segment_ids.shape)
+    if scale is None:
+        scale = d ** -0.5
+    spec = P(batch_axis, axis, None, None)
+    mspec = P(batch_axis, axis)
+    inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
+                              scale=float(scale), use_flash=use_flash)
+    return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
+                                kv_mask, segment_ids)
+
+
+def context_parallel_attention(q, k, v, *, impl: str = "ring", **kw):
+    """Dispatch helper: ``impl`` in {"ring", "ulysses"}."""
+    if impl == "ring":
+        return ring_attention(q, k, v, **kw)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, **kw)
+    raise ValueError(f"unknown context-parallel impl {impl!r}")
+
+
+def sharded_flash_attention(q, k, v, *, mesh=None, batch_axis="dp",
+                            head_axis=None, causal=False, scale=None,
+                            kv_mask=None, segment_ids=None, window=None,
+                            dropout_p=0.0, dropout_key=None):
+    """Flash attention partitioned over batch and/or head mesh axes via
+    shard_map — the pattern production TPU stacks use, because XLA's
+    auto-SPMD partitioner has no rule for the Pallas custom call and
+    would otherwise ALL-GATHER q/k/v and run it replicated (verified on
+    the 8-device CPU mesh: output comes back fully replicated).
+
+    Attention is embarrassingly parallel over batch and heads, so each
+    device runs the kernel on its local (b/dp, t, h/tp, d) shard with no
+    collectives. kv_mask/segment_ids shard over batch only. Dropout:
+    each shard folds its mesh coordinates into the key, so masks are
+    DISTINCT across devices (no cross-shard correlation) and
+    deterministic per key — but not bit-identical to the unsharded
+    call's mask (the kernel hashes its local batch*head index).
+
+    Use for TP/DP models calling flash under plain pjit; the SP paths
+    (ring/ulysses above) already run inside their own shard_map.
+    """
+    from ..ops.pallas.flash_attention import flash_attention
+
+    mesh = mesh or get_mesh()
+    b, t, h, d = q.shape
+    axes = dict(mesh.shape)
+    for name, ax in (("batch_axis", batch_axis), ("head_axis", head_axis)):
+        enforce(ax is None or ax in axes,
+                "%s %r is not a mesh axis (mesh has %s)", name, ax,
+                sorted(axes))
+    if batch_axis is not None:
+        enforce(b % axes[batch_axis] == 0,
+                "batch %s must divide %s axis size %s", b, batch_axis,
+                axes[batch_axis])
+    if head_axis is not None:
+        enforce(h % axes[head_axis] == 0,
+                "heads %s must divide %s axis size %s", h, head_axis,
+                axes[head_axis])
+        # GQA k/v shard with the same head spec: their (fewer) heads
+        # must divide the axis too, or shard_map fails opaquely inside
+        enforce(k.shape[2] % axes[head_axis] == 0,
+                "kv heads %s must divide %s axis size %s (GQA under "
+                "head sharding)", k.shape[2], head_axis, axes[head_axis])
+    tk = k.shape[1]  # key-padding masks cover the KEY sequence
+    for name, arr, length in (("kv_mask", kv_mask, tk),
+                              ("segment_ids", segment_ids, t)):
+        if arr is not None:
+            enforce(arr.shape == (b, length),
+                    "%s must be (batch, %s), got %s",
+                    name, length, arr.shape)
+    spec = P(batch_axis, None, head_axis, None)
+    mspec = P(batch_axis, None)
+
+    def inner(q, k, v, km, seg):
+        key = dropout_key
+        if key is not None:
+            # distinct masks per shard: fold the mesh coordinates in
+            for ax in (batch_axis, head_axis):
+                if ax is not None:
+                    key = jax.random.fold_in(key, lax.axis_index(ax))
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               kv_mask=km, segment_ids=seg, window=window,
+                               dropout_p=dropout_p, dropout_key=key)
+
+    return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
+                                kv_mask, segment_ids)

@@ -1,0 +1,209 @@
+"""Pipeline parallelism — stage-partitioned microbatch pipeline over 'pp'.
+
+Green-field design (the reference has no pipeline parallelism at all,
+SURVEY.md §2.5/§7: its only model-parallel-adjacent feature is PS-sharded
+optimizer state, reference: transpiler/distribute_transpiler.py:702).
+
+TPU-native shape: the repeated block's parameters are **stacked** along a
+leading layer axis and sharded ``P('pp')`` so each pipeline stage holds a
+contiguous chunk of layers in its HBM. One ``shard_map`` + ``lax.scan``
+runs the classic GPipe schedule: at tick ``t`` every stage applies its
+layers to the activation it holds, then the activations rotate one stage
+forward via ``lax.ppermute`` (a single ICI hop — pipeline traffic never
+leaves neighbouring chips). Stage 0 injects microbatch ``t``; the last
+stage banks its result. ``n + m - 1`` ticks stream ``m`` microbatches
+through ``n`` stages (bubble fraction ``(n-1)/(n+m-1)``).
+
+Backward is pure autodiff: the transpose of ``ppermute`` is the reverse
+rotation, so the gradient pipeline runs automatically in the opposite
+direction — no hand-written 1F1B engine. Each stage application is wrapped
+in ``jax.checkpoint`` so the backward recomputes block activations instead
+of storing every tick's intermediates.
+
+Constraints (standard for this schedule): every block maps activations of
+one uniform shape to the same shape (transformer blocks qualify); the
+stacked layer count must divide the 'pp' axis; microbatches all share one
+shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.enforce import enforce
+from ..core.mesh import get_mesh
+
+
+def _stack_to_stages(stacked_params, n_stages: int):
+    """(L, ...) leaves → (n_stages, L//n_stages, ...)."""
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, stacked_params)
+
+
+def _pipeline_inner(params_nk, x_mb, *, block_fn, axis, n, m, remat):
+    # params_nk leaves: (1, k, ...) — this stage's chunk; squeeze the shard dim
+    p_local = jax.tree_util.tree_map(lambda a: a[0], params_nk)
+    idx = lax.axis_index(axis)
+    # x_mb: (m, mb, ...) replicated — stage 0 reads, others ignore
+
+    def stage_fn(p_k, h):
+        def one_block(h, p):
+            return block_fn(p, h), None
+
+        return lax.scan(one_block, h, p_k)[0]
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    mb_shape = x_mb.shape[1:]
+    fwd_perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(carry, t):
+        state, outbuf = carry
+        # stage 0 injects microbatch t (clipped: past-the-end ticks feed
+        # a dummy that never reaches the output window)
+        mb = lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, m - 1), 0,
+                                      keepdims=False)
+        inp = jnp.where(idx == 0, mb, state)
+        out = stage_fn(p_local, inp)
+        # last stage banks microbatch t-(n-1) once the pipe is full
+        pos = t - (n - 1)
+        write = jnp.logical_and(idx == n - 1, pos >= 0)
+        upd = lax.dynamic_update_index_in_dim(
+            outbuf, out.astype(outbuf.dtype), jnp.clip(pos, 0, m - 1), 0)
+        outbuf = jnp.where(write, upd, outbuf)
+        if n > 1:
+            state = lax.ppermute(out, axis, fwd_perm)
+        else:
+            state = out
+        return (state, outbuf), None
+
+    state0 = jnp.zeros(mb_shape, x_mb.dtype)
+    outbuf0 = jnp.zeros((m,) + mb_shape, jnp.result_type(x_mb.dtype))
+    (_, outbuf), _ = lax.scan(tick, (state0, outbuf0), jnp.arange(n + m - 1))
+    # only the last stage's buffer is real; mask+psum broadcasts it so the
+    # result is replicated over 'pp' (loss/optimizer run identically on all
+    # stages — the XLA partitioner then dedups what it can)
+    outbuf = jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf))
+    return lax.psum(outbuf, axis)
+
+
+def pipeline_apply(block_fn: Callable, stacked_params, x, *,
+                   num_microbatches: int, axis: str = "pp",
+                   mesh=None, remat: bool = True):
+    """Run ``x`` through ``L`` stacked layers as an ``n``-stage pipeline.
+
+    - ``block_fn(params_l, h) -> h``: applies ONE layer (uniform shape).
+    - ``stacked_params``: pytree whose leaves have leading dim ``L``
+      (``L % n == 0``); stage ``s`` gets layers ``[s*L/n, (s+1)*L/n)``.
+    - ``x``: global batch ``(B, ...)`` with ``B % num_microbatches == 0``.
+
+    Returns the pipelined equivalent of folding ``block_fn`` over all ``L``
+    layers, replicated over the 'pp' axis.
+    """
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    m = num_microbatches
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    enforce(leaves, "stacked_params must be a non-empty pytree")
+    L = leaves[0].shape[0]
+    enforce(all(l.shape[0] == L for l in leaves),
+            "all stacked_params leaves must share leading layer dim %s", L)
+    enforce(L % n == 0, "layer count %s must divide pp size %s", L, n)
+    B = x.shape[0]
+    enforce(B % m == 0,
+            "num_microbatches %s must divide batch size %s", m, B)
+    x_mb = x.reshape(m, B // m, *x.shape[1:])
+
+    params_staged = _stack_to_stages(stacked_params, n)
+    # jit is required: remat's closed_call can't evaluate eagerly inside
+    # shard_map (and the production path is jitted anyway — no-op there).
+    # Cached by configuration so eager per-step callers hit the XLA compile
+    # cache instead of retracing a fresh closure every call.
+    fn = _jitted_pipeline(block_fn, mesh, axis, n, m, remat)
+    out_mb = fn(params_staged, x_mb)
+    return out_mb.reshape(B, *out_mb.shape[2:])
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_pipeline(block_fn, mesh, axis, n, m, remat):
+    inner = functools.partial(_pipeline_inner, block_fn=block_fn, axis=axis,
+                              n=n, m=m, remat=remat)
+
+    def wrapper(params_staged, x_mb):
+        # specs are shape-independent, built from the pytree at trace time
+        stage_spec = jax.tree_util.tree_map(
+            lambda a: P(axis, *([None] * (a.ndim - 1))), params_staged)
+        # manual ONLY over the pipeline axis: every other mesh axis stays
+        # auto, so dp batch sharding and tp weight sharding compose with
+        # the pipeline in ONE module (GSPMD inserts their collectives
+        # around the manual ppermute ring)
+        return jax.shard_map(inner, mesh=mesh,
+                             in_specs=(stage_spec, P()), out_specs=P(),
+                             axis_names=frozenset({axis}),
+                             check_vma=False)(params_staged, x_mb)
+
+    return jax.jit(wrapper)
+
+
+def stage_param_sharding(stacked_params, n_stages: int, axis: str = "pp",
+                         mesh=None):
+    """NamedShardings that place each stage's layer-chunk on its device —
+    apply with jax.device_put to hold only 1/n of the layers per chip."""
+    mesh = mesh or get_mesh()
+
+    def spec(leaf):
+        return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
+
+    return jax.tree_util.tree_map(spec, _stack_to_stages(stacked_params,
+                                                         n_stages))
+
+
+class GPipe:
+    """Layer-level convenience: pipeline a uniform stack of blocks.
+
+    ``blocks`` must be structurally identical Layers (same param pytree);
+    their params are stacked along a new leading axis and fed to
+    :func:`pipeline_apply`.
+    """
+
+    def __init__(self, blocks, *, num_microbatches: int, axis: str = "pp",
+                 mesh=None, remat: bool = True):
+        enforce(len(blocks) > 0, "GPipe needs at least one block")
+        self.blocks = list(blocks)
+        self.num_microbatches = num_microbatches
+        self.axis = axis
+        self.mesh = mesh
+        self.remat = remat
+        self._template = self.blocks[0]
+
+        # one stable closure for the pipeline compile cache (a fresh
+        # closure per __call__ would defeat _jitted_pipeline's lru_cache)
+        def _block_fn(p, h, _t=self._template):
+            out, _ = _t.functional_call(p, h)
+            return out
+
+        self._block_fn = _block_fn
+
+    def stacked_params(self):
+        from ..nn.layer import stacked_parameters
+
+        return stacked_parameters(self.blocks)
+
+    def __call__(self, x, stacked_params=None):
+        params = (self.stacked_params() if stacked_params is None
+                  else stacked_params)
+        return pipeline_apply(self._block_fn, params, x,
+                              num_microbatches=self.num_microbatches,
+                              axis=self.axis, mesh=self.mesh,
+                              remat=self.remat)

@@ -1,0 +1,146 @@
+"""Sharding rules — tensor parallelism as annotation, not program rewrite.
+
+This is the capability successor of the reference's DistributeTranspiler
+(reference: python/paddle/fluid/transpiler/distribute_transpiler.py:164,283 —
+which rewrote the ProgramDesc op-by-op for a cluster role): here the "rewrite"
+is a set of (param-name regex → PartitionSpec) rules; GSPMD partitions the
+traced computation and inserts the collectives over ICI. Megatron-style
+column/row parallel linear layers fall out of two specs:
+
+  column-parallel (output dim sharded):  weight P(None, "tp"), bias P("tp")
+  row-parallel    (input dim sharded):   weight P("tp", None) + psum (auto)
+
+Rules are ordered; first match wins; unmatched params replicate.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.mesh import get_mesh
+
+Rule = Tuple[str, P]
+
+
+def infer_param_spec(params: Dict[str, object],
+                     rules: Sequence[Rule],
+                     mesh=None) -> Dict[str, P]:
+    """Map each param name through the first matching rule (search, not
+    fullmatch — anchor with $ where needed). Unmatched names are omitted
+    (→ replicated), as are matches whose sharded dims don't divide the mesh
+    axis (e.g. a 2-row segment-embedding table on tp=4)."""
+    mesh = mesh or get_mesh()
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    out: Dict[str, P] = {}
+    for name, value in params.items():
+        for pat, spec in compiled:
+            if pat.search(name):
+                if _divisible(value, spec, mesh):
+                    out[name] = spec
+                break
+    return out
+
+
+def _divisible(value, spec: P, mesh) -> bool:
+    shape = getattr(value, "shape", None)
+    if shape is None:
+        return True
+    for dim, axes in enumerate(spec):
+        if axes is None or dim >= len(shape):
+            continue
+        axes = (axes,) if isinstance(axes, str) else axes
+        n = 1
+        for ax in axes:
+            n *= int(mesh.shape.get(ax, 1))
+        if shape[dim] % n:
+            return False
+    return True
+
+
+def shard_params(params: Dict[str, object], rules: Sequence[Rule],
+                 mesh=None) -> Dict[str, object]:
+    """Place params per rules (unmatched → replicated)."""
+    mesh = mesh or get_mesh()
+    spec_map = infer_param_spec(params, rules, mesh)
+    out = {}
+    for name, value in params.items():
+        spec = spec_map.get(name, P())
+        out[name] = jax.device_put(value, NamedSharding(mesh, spec))
+    return out
+
+
+def constraint(x, spec: P, mesh=None):
+    """with_sharding_constraint pinned to the global mesh — activation
+    sharding hints inside jitted code."""
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh or get_mesh(), spec))
+
+
+# ---------------------------------------------------------------------------
+# Standard rulesets
+# ---------------------------------------------------------------------------
+
+
+def transformer_tp_rules(axis: str = "tp") -> List[Rule]:
+    """Megatron-style TP for nn.transformer-built models (BERT, NMT, GPT):
+    attention QKV and FFN-in are column-parallel, attention-out and FFN-out
+    are row-parallel, vocab projections and embedding tables shard the vocab
+    dim. Head-count must divide the tp axis size."""
+    col_w, col_b = P(None, axis), P(axis)
+    row_w = P(axis, None)
+    vocab_w = P(axis, None)  # (vocab, hidden) tables: shard vocab rows
+    return [
+        (r"(q_proj|k_proj|v_proj)\.weight$", col_w),
+        (r"(q_proj|k_proj|v_proj)\.bias$", col_b),
+        (r"out_proj\.weight$", row_w),
+        (r"fc1\.weight$", col_w),
+        (r"fc1\.bias$", col_b),
+        (r"fc2\.weight$", row_w),
+        (r"(generator|mlm_decoder)\.weight$", P(None, axis)),
+        (r"(generator|mlm_decoder)\.bias$", P(axis)),
+        (r"(tok|seg|src_emb|tgt_emb)\.weight$", vocab_w),
+    ]
+
+
+def zero_dp_rules(axis: str = "dp",
+                  min_size: int = 2 ** 16) -> "OptStateRules":
+    """ZeRO-style optimizer-state sharding over dp — the capability successor
+    of PS-sharded optimizer state (reference:
+    transpiler/distribute_transpiler.py:702 get_pserver_program runs optimizer
+    blocks on each pserver's shard)."""
+    return OptStateRules(axis=axis, min_size=min_size)
+
+
+class OptStateRules:
+    """Shard large optimizer-state leaves along their biggest divisible dim."""
+
+    def __init__(self, axis: str = "dp", min_size: int = 2 ** 16):
+        self.axis = axis
+        self.min_size = min_size
+
+    def spec_for(self, leaf, mesh=None) -> Optional[P]:
+        mesh = mesh or get_mesh()
+        n = int(mesh.shape.get(self.axis, 1))
+        if n <= 1 or not hasattr(leaf, "shape") or leaf.size < self.min_size:
+            return None
+        for dim, s in enumerate(leaf.shape):
+            if s % n == 0 and s >= n:
+                spec = [None] * leaf.ndim
+                spec[dim] = self.axis
+                return P(*spec)
+        return None
+
+    def place(self, tree, mesh=None):
+        mesh = mesh or get_mesh()
+
+        def put(leaf):
+            spec = self.spec_for(leaf, mesh)
+            if spec is None:
+                return leaf
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(put, tree)

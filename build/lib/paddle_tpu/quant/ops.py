@@ -1,0 +1,165 @@
+"""Fake-quantization ops — capability parity with the reference's quant op
+family (reference: paddle/fluid/operators/fake_quantize_op.cc —
+fake_quantize_abs_max, fake_channel_wise_quantize_abs_max,
+fake_quantize_range_abs_max, fake_quantize_moving_average_abs_max,
+fake_quantize_dequantize_moving_average_abs_max, moving_average_abs_max_scale
+— and fake_dequantize_op.cc).
+
+All ops simulate int-k quantization in float (quantize→round→dequantize) so
+training stays on the MXU in bf16/f32; gradients use the straight-through
+estimator exactly like the reference's grad kernels (identity inside the
+clipping range). Stateful scale trackers (range / moving-average) are
+functional: they take and return their state, JAX-style, instead of mutating
+in/out vars.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+
+def _qmax(bit_length: int) -> float:
+    return float((1 << (bit_length - 1)) - 1)  # 127 for int8
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_round_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_round_bwd(_, g):
+    return (g,)
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+def quantize_dequantize(x, scale, bit_length: int = 8):
+    """Simulated quantization: clip to [-scale, scale], round to int-k grid,
+    return float. STE gradient: identity inside the clip range, zero outside
+    (matches FakeQuantizeAbsMaxGradKernel semantics)."""
+    qmax = _qmax(bit_length)
+    scale = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-8)
+    inv = qmax / scale
+    clipped = jnp.clip(x, -scale, scale)  # clip grad handles out-of-range zeroing
+    return _ste_round(clipped * inv) / inv
+
+
+def abs_max_scale(x, axis=None):
+    """Current abs-max of a tensor (per-channel when ``axis`` is given)."""
+    if axis is None:
+        return jnp.max(jnp.abs(x))
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    return jnp.max(jnp.abs(x), axis=reduce_axes)
+
+
+def fake_quantize_abs_max(x, bit_length: int = 8):
+    """reference: fake_quantize_abs_max — scale = abs-max of this tensor.
+    Returns (quantized x, scale)."""
+    scale = abs_max_scale(x)
+    return quantize_dequantize(x, scale, bit_length), scale
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8,
+                                       channel_axis: int = 0):
+    """reference: fake_channel_wise_quantize_abs_max — one scale per output
+    channel (weights)."""
+    scale = abs_max_scale(x, axis=channel_axis)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    return (quantize_dequantize(x, scale.reshape(shape), bit_length), scale)
+
+
+class MovingAverageState(NamedTuple):
+    scale: jnp.ndarray  # scalar running scale
+    accum: jnp.ndarray
+    state: jnp.ndarray
+
+
+def moving_average_state_init(dtype=jnp.float32) -> MovingAverageState:
+    return MovingAverageState(jnp.asarray(0.0, dtype),
+                              jnp.asarray(0.0, dtype),
+                              jnp.asarray(0.0, dtype))
+
+
+def moving_average_abs_max_scale(x, st: MovingAverageState,
+                                 moving_rate: float = 0.9
+                                 ) -> Tuple[jnp.ndarray, MovingAverageState]:
+    """reference: moving_average_abs_max_scale op — EMA of abs-max with
+    bias-corrected accumulators (accum/state pair)."""
+    cur = abs_max_scale(x).astype(st.scale.dtype)
+    accum = st.accum * moving_rate + cur
+    state = st.state * moving_rate + 1.0
+    scale = accum / state
+    return scale, MovingAverageState(scale, accum, state)
+
+
+def fake_quantize_moving_average_abs_max(x, st: MovingAverageState,
+                                         bit_length: int = 8,
+                                         moving_rate: float = 0.9,
+                                         is_test: bool = False):
+    """reference: fake_quantize_moving_average_abs_max (and the fused
+    fake_quantize_dequantize_ variant — identical here since all fake quant
+    is quantize+dequantize). Returns (quantized, new_state)."""
+    if is_test:
+        return quantize_dequantize(x, st.scale, bit_length), st
+    scale, new_st = moving_average_abs_max_scale(x, st, moving_rate)
+    return quantize_dequantize(x, scale, bit_length), new_st
+
+
+class RangeState(NamedTuple):
+    scale: jnp.ndarray       # current scale
+    scales_window: jnp.ndarray  # (window,) recent abs-max ring buffer
+    step: jnp.ndarray        # int32 counter
+
+
+def range_state_init(window_size: int = 10000,
+                     dtype=jnp.float32) -> RangeState:
+    return RangeState(jnp.asarray(0.0, dtype),
+                      jnp.zeros((window_size,), dtype),
+                      jnp.asarray(0, jnp.int32))
+
+
+def fake_quantize_range_abs_max(x, st: RangeState, bit_length: int = 8,
+                                is_test: bool = False):
+    """reference: fake_quantize_range_abs_max — scale = max of a sliding
+    window of recent abs-max values. Returns (quantized, new_state)."""
+    if is_test:
+        return quantize_dequantize(x, st.scale, bit_length), st
+    cur = abs_max_scale(x).astype(st.scale.dtype)
+    idx = st.step % st.scales_window.shape[0]
+    window = st.scales_window.at[idx].set(cur)
+    scale = jnp.max(window)
+    return (quantize_dequantize(x, scale, bit_length),
+            RangeState(scale, window, st.step + 1))
+
+
+def dequantize(q, scale, bit_length: int = 8, quant_axis: Optional[int] = None):
+    """reference: fake_dequantize_max_abs / channel-wise variant — map an
+    int-k grid tensor back to float: q * scale / qmax."""
+    qmax = _qmax(bit_length)
+    scale = jnp.asarray(scale, jnp.float32)
+    if quant_axis is not None and scale.ndim == 1:
+        shape = [1] * q.ndim
+        shape[quant_axis] = q.shape[quant_axis]
+        scale = scale.reshape(shape)
+    return q.astype(jnp.float32) * scale / qmax
+
+
+def quantize_to_int(x, scale, bit_length: int = 8):
+    """Real int quantization for export (reference: operators/quantize_op.cc
+    role): returns int8/int16 values on the int-k grid."""
+    qmax = _qmax(bit_length)
+    scale = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-8)
+    q = jnp.round(jnp.clip(x, -scale, scale) * (qmax / scale))
+    dtype = jnp.int8 if bit_length <= 8 else jnp.int16
+    return q.astype(dtype)

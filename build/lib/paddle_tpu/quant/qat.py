@@ -1,0 +1,147 @@
+"""Quantization-aware training + post-training quantization — capability
+parity with the reference's slim quantization framework (reference:
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py —
+QuantizationTransformPass inserts fake_quant ops before quantizable ops and
+QuantizationFreezePass rewrites for int8 inference; post-training calibration:
+paddle/fluid/inference/api/mkldnn_quantizer.cc).
+
+TPU-native design: instead of a protobuf-graph rewrite pass, quantization is
+a *layer rewrite*: ``quantize_model`` walks the Layer tree and wraps each
+quantizable module (Linear/Conv2D) in a ``QuantedLayer`` that fake-quants its
+input activation (moving-average abs-max, tracked in buffers so the state
+threads through ``functional_call`` pytrees) and its weight (channel-wise
+abs-max). The same wrapper serves QAT (train with STE gradients) and PTQ
+(run calibration batches, then freeze). ``freeze`` exports real int8 weights
++ scales, the QuantizationFreezePass analog.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+from ..nn.layer import Layer
+from . import ops as Q
+
+
+@dataclass
+class QuantConfig:
+    weight_bits: int = 8
+    activation_bits: int = 8
+    moving_rate: float = 0.9
+    # which layer classes get wrapped; names match paddle_tpu.nn types
+    quantizable: Tuple[str, ...] = ("Linear", "Conv2D")
+    # per-channel weight axis by layer type (Linear weight is (in, out) →
+    # channel axis 1; Conv2D weight is (cout, cin, kh, kw) → axis 0)
+    channel_axis: Dict[str, int] = field(
+        default_factory=lambda: {"Linear": 1, "Conv2D": 0})
+
+
+class QuantedLayer(Layer):
+    """Wraps one quantizable layer with activation+weight fake quantization
+    (the QuantizationTransformPass insertion point, per layer instead of
+    per graph node)."""
+
+    def __init__(self, inner: Layer, config: QuantConfig):
+        super().__init__()
+        tname = type(inner).__name__
+        enforce("weight" in inner._params,
+                "QuantedLayer needs an inner layer with a 'weight' param, "
+                "got %s", tname)
+        self.inner = inner
+        self.config = config
+        self.channel_axis = config.channel_axis.get(tname, 0)
+        # moving-average activation scale state lives in buffers so it is
+        # part of the functional state pytree
+        self.register_buffer("act_scale", jnp.asarray(0.0, jnp.float32))
+        self.register_buffer("act_accum", jnp.asarray(0.0, jnp.float32))
+        self.register_buffer("act_state", jnp.asarray(0.0, jnp.float32))
+
+    def forward(self, x, *args, **kwargs):
+        cfg = self.config
+        st = Q.MovingAverageState(self.act_scale, self.act_accum,
+                                  self.act_state)
+        xq, new_st = Q.fake_quantize_moving_average_abs_max(
+            x, st, cfg.activation_bits, cfg.moving_rate,
+            is_test=not self.training)
+        if self.training:
+            self.update_buffer("act_scale", new_st.scale)
+            self.update_buffer("act_accum", new_st.accum)
+            self.update_buffer("act_state", new_st.state)
+        w = self.inner._params["weight"]
+        wq, _ = Q.fake_channel_wise_quantize_abs_max(
+            w, cfg.weight_bits, self.channel_axis)
+        saved = self.inner._params["weight"]
+        self.inner._params["weight"] = wq
+        try:
+            out = self.inner.forward(xq, *args, **kwargs)
+        finally:
+            self.inner._params["weight"] = saved
+        return out
+
+    def weight_scales(self):
+        return Q.abs_max_scale(self.inner._params["weight"],
+                               axis=self.channel_axis)
+
+
+def quantize_model(model: Layer, config: Optional[QuantConfig] = None,
+                   ) -> Layer:
+    """Rewrite ``model`` in place, wrapping every quantizable sublayer.
+    Returns the model (param paths gain an ``.inner`` segment under each
+    wrapped layer — do this BEFORE snapshotting params)."""
+    config = config or QuantConfig()
+
+    def rewrite(layer: Layer):
+        for name, sub in list(layer._sublayers.items()):
+            if type(sub).__name__ in config.quantizable:
+                wrapper = QuantedLayer(sub, config)
+                layer._sublayers[name] = wrapper
+                object.__setattr__(layer, name, wrapper)
+            else:
+                rewrite(sub)
+
+    enforce(type(model).__name__ not in config.quantizable,
+            "quantize_model wraps sublayers; wrap the root %s yourself with "
+            "QuantedLayer", type(model).__name__)
+    rewrite(model)
+    return model
+
+
+def calibrate(model: Layer, batches: Iterable, forward=None) -> Layer:
+    """Post-training calibration (mkldnn_quantizer.cc analog): run
+    representative batches in training mode so the moving-average activation
+    scales settle, then switch to eval (frozen scales)."""
+    model.train()
+    for batch in batches:
+        if forward is not None:
+            forward(model, batch)
+        elif isinstance(batch, tuple):
+            model(*batch)
+        else:
+            model(batch)
+    model.eval()
+    return model
+
+
+def freeze(model: Layer) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """QuantizationFreezePass analog: export real int8 weights + scales for
+    every quantized layer. Returns {layer_path: {"weight_int8", "weight_scale",
+    "act_scale", "bits"}}."""
+    out = {}
+    for path, sub in model.named_sublayers():
+        if isinstance(sub, QuantedLayer):
+            w = sub.inner._params["weight"]
+            wscale = sub.weight_scales()
+            shape = [1] * w.ndim
+            shape[sub.channel_axis] = w.shape[sub.channel_axis]
+            out[path] = {
+                "weight_int8": Q.quantize_to_int(
+                    w, wscale.reshape(shape), sub.config.weight_bits),
+                "weight_scale": wscale,
+                "act_scale": sub.act_scale,
+                "bits": sub.config.weight_bits,
+            }
+    return out

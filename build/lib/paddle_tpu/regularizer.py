@@ -1,0 +1,40 @@
+"""Weight regularization — parity with the reference regularizers
+(reference: python/paddle/fluid/regularizer.py — L1Decay/L2Decay appended as
+grad-modifying ops). Here: pure functions adding the decay term to grads,
+pluggable into ``Optimizer(regularization=...)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class L2Decay:
+    def __init__(self, coeff: float):
+        self.coeff = coeff
+
+    def apply_to_grads(self, params, grads):
+        return jax.tree_util.tree_map(
+            lambda p, g: g + self.coeff * p, params, grads)
+
+    def loss_term(self, params):
+        return 0.5 * self.coeff * sum(
+            jnp.sum(jnp.square(p)) for p in jax.tree_util.tree_leaves(params))
+
+
+class L1Decay:
+    def __init__(self, coeff: float):
+        self.coeff = coeff
+
+    def apply_to_grads(self, params, grads):
+        return jax.tree_util.tree_map(
+            lambda p, g: g + self.coeff * jnp.sign(p), params, grads)
+
+    def loss_term(self, params):
+        return self.coeff * sum(
+            jnp.sum(jnp.abs(p)) for p in jax.tree_util.tree_leaves(params))
+
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

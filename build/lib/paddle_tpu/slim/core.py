@@ -1,0 +1,320 @@
+"""Compression driver — Context / Strategy / Compressor / Config.
+
+Capability lineage (reference: python/paddle/fluid/contrib/slim/core/):
+``compressor.py:207 Compressor`` runs an epoch loop firing strategy
+callbacks (on_compression_begin, on_epoch_begin/end, on_compression_end),
+checkpoints its Context between epochs (``:330/_load_checkpoint``,
+``:381/_save_checkpoint``) and stops early on metric convergence
+(``Context.eval_converged:144``); ``config.py`` builds strategies from a
+config file; ``strategy.py:51`` scopes each strategy to
+[start_epoch, end_epoch).
+
+TPU-native shape: the Context carries the FUNCTIONAL training state
+(params / opt_state pytrees + masks), strategies rewrite the loss or the
+mask set, and the train step stays one jitted function — mask
+application is folded into the step (no per-step eager work), exactly
+like the reference folds pruning into the graph it re-optimizes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+from .distill import Distiller
+from .prune import (Pruner, compute_sensitivities, greedy_ratios_for_target,
+                    uniform_ratio_search)
+
+
+class Context:
+    """Mutable compression state threaded through strategy callbacks."""
+
+    def __init__(self, params, opt_state=None, eval_fn=None):
+        self.epoch_id = 0
+        self.params = params
+        self.opt_state = opt_state
+        self.eval_fn = eval_fn
+        self.masks: Dict[str, jnp.ndarray] = {}
+        self.loss_wrapper: Optional[Callable] = None
+        self.eval_history: List[float] = []
+        self.extra: Dict[str, Any] = {}
+
+    def eval_converged(self, delta: float = 0.001, window: int = 5) -> bool:
+        """reference: compressor.py:144 — recent metric range < delta."""
+        if len(self.eval_history) < window:
+            return False
+        recent = self.eval_history[-window:]
+        return max(recent) - min(recent) < delta
+
+    # -- persistence (reference: Context.to_file/from_file) -----------------
+
+    def to_file(self, path: str) -> None:
+        from .. import checkpoint
+
+        checkpoint.save_state(path, {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "masks": self.masks,
+        })
+        with open(os.path.join(path, "context.json"), "w") as f:
+            json.dump({"epoch_id": self.epoch_id,
+                       "eval_history": self.eval_history}, f)
+
+    def from_file(self, path: str) -> None:
+        from .. import checkpoint
+
+        state = checkpoint.restore_state(path)
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.masks = state.get("masks") or {}
+        with open(os.path.join(path, "context.json")) as f:
+            meta = json.load(f)
+        self.epoch_id = meta["epoch_id"]
+        self.eval_history = list(meta["eval_history"])
+
+
+class Strategy:
+    """reference: core/strategy.py:51 — epoch-scoped callbacks."""
+
+    def __init__(self, start_epoch: int = 0, end_epoch: int = 10 ** 9):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def active(self, epoch: int) -> bool:
+        return self.start_epoch <= epoch < self.end_epoch
+
+    def on_compression_begin(self, context: Context):  # noqa: B027
+        pass
+
+    def on_epoch_begin(self, context: Context):  # noqa: B027
+        pass
+
+    def on_epoch_end(self, context: Context):  # noqa: B027
+        pass
+
+    def on_compression_end(self, context: Context):  # noqa: B027
+        pass
+
+
+class UniformPruneStrategy(Strategy):
+    """One ratio for every matched param, bisected to hit
+    ``target_ratio`` global sparsity (reference:
+    prune_strategy.py:531 UniformPruneStrategy)."""
+
+    def __init__(self, target_ratio: float, structured: bool = False,
+                 axis: int = 0, match=None, **kw):
+        super().__init__(**kw)
+        self.target_ratio = target_ratio
+        self.pruner_proto = Pruner(target_ratio, structured=structured,
+                                   axis=axis, match=match)
+
+    def on_epoch_begin(self, context: Context):
+        if context.epoch_id != self.start_epoch:
+            return
+        ratio = uniform_ratio_search(context.params, self.pruner_proto,
+                                     self.target_ratio)
+        pruner = Pruner(ratio, structured=self.pruner_proto.structured,
+                        axis=self.pruner_proto.axis,
+                        match=self.pruner_proto.match)
+        context.masks = pruner.make_masks(context.params)
+        context.params = Pruner.apply(context.params, context.masks)
+
+
+class SensitivePruneStrategy(Strategy):
+    """Per-param ratios from sensitivity analysis (reference:
+    prune_strategy.py:635 SensitivePruneStrategy): prune each candidate
+    at several ratios, measure the eval-metric drop, then greedily hit
+    ``target_ratio`` where metric loss is cheapest; sensitivities persist
+    to ``sensitivities_file``."""
+
+    def __init__(self, target_ratio: float,
+                 ratios: Sequence[float] = (0.1, 0.3, 0.5, 0.7),
+                 sensitivities_file: Optional[str] = None,
+                 max_metric_loss: Optional[float] = None,
+                 structured: bool = False, axis: int = 0, match=None, **kw):
+        super().__init__(**kw)
+        self.target_ratio = target_ratio
+        self.ratios = tuple(ratios)
+        self.sensitivities_file = sensitivities_file
+        self.max_metric_loss = max_metric_loss
+        self.pruner_proto = Pruner(target_ratio, structured=structured,
+                                   axis=axis, match=match)
+
+    def on_epoch_begin(self, context: Context):
+        if context.epoch_id != self.start_epoch:
+            return
+        enforce(context.eval_fn is not None,
+                "SensitivePruneStrategy needs the Compressor's eval_fn")
+        sens = compute_sensitivities(
+            context.params, context.eval_fn, self.pruner_proto,
+            self.ratios, self.sensitivities_file)
+        per_param = greedy_ratios_for_target(
+            sens, context.params, self.target_ratio,
+            self.max_metric_loss)
+        pruner = Pruner(per_param,
+                        structured=self.pruner_proto.structured,
+                        axis=self.pruner_proto.axis,
+                        match=lambda n: n in per_param)
+        context.masks = pruner.make_masks(context.params)
+        context.params = Pruner.apply(context.params, context.masks)
+        context.extra["prune_ratios"] = per_param
+
+
+class DistillationStrategy(Strategy):
+    """Swap the task loss for the distilled loss while active
+    (reference: distillation/distillation_strategy.py merges the teacher
+    program in on_compression_begin; here the teacher is a params tree +
+    apply_fn and the swap is a loss_wrapper on the Context)."""
+
+    def __init__(self, teacher_apply: Callable, teacher_params,
+                 distiller: Optional[Distiller] = None, **kw):
+        super().__init__(**kw)
+        self.teacher_apply = teacher_apply
+        self.teacher_params = teacher_params
+        self.distiller = distiller or Distiller()
+        # ONE wrapper object for the whole run: the Compressor's step
+        # cache is keyed by identity, so a fresh closure per epoch would
+        # force a full retrace every epoch. The closure reads through
+        # self, so reassigning strategy attributes before run() still
+        # takes effect (late binding preserved).
+        def wrap(loss_fn, _self=self):
+            def distilled(params, *batch):
+                d = _self.distiller
+                student_logits = loss_fn(params, *batch, logits_only=True)
+                teacher_logits = _self.teacher_apply(
+                    _self.teacher_params, *batch)
+                label = batch[-1] if d.hard_weight else None
+                return d.loss(student_logits, teacher_logits, label)
+
+            return distilled
+
+        self._wrap = wrap
+
+    def on_epoch_begin(self, context: Context):
+        if context.loss_wrapper is not self._wrap:
+            context.loss_wrapper = self._wrap
+
+    def on_epoch_end(self, context: Context):
+        if context.epoch_id + 1 >= self.end_epoch:
+            context.loss_wrapper = None
+
+
+class Compressor:
+    """Epoch-driven compression loop (reference: compressor.py:207).
+
+    - ``loss_fn(params, *batch, logits_only=False)`` — the task loss;
+      with ``logits_only=True`` it must return the student logits (the
+      hook distillation uses).
+    - ``train_reader()`` / ``eval_fn(params)`` — batches and the scalar
+      quality metric (higher is better).
+    - Masks in the Context are folded into the jitted step: the update
+      is re-masked every step, so sparsity persists through training.
+    - ``checkpoint_dir`` saves the Context each epoch and resumes
+      automatically (reference: _save_checkpoint/_load_checkpoint).
+    """
+
+    def __init__(self, params, optimizer, loss_fn, train_reader,
+                 eval_fn=None, epochs: int = 1, strategies=(),
+                 checkpoint_dir: Optional[str] = None,
+                 converge_delta: Optional[float] = None):
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.train_reader = train_reader
+        self.epochs = epochs
+        self.strategies = list(strategies)
+        self.checkpoint_dir = checkpoint_dir
+        self.converge_delta = converge_delta
+        self.context = Context(params, optimizer.init(params), eval_fn)
+        self._step_cache = (None, None)
+
+    def _step_fn(self):
+        ctx = self.context
+        # strategies swap masks/loss_wrapper by REASSIGNING them at epoch
+        # boundaries; while identities are unchanged the cached jitted
+        # step stays valid (no per-epoch retrace)
+        key = (id(ctx.masks), id(ctx.loss_wrapper))
+        if self._step_cache[0] == key:
+            return self._step_cache[1]
+        loss_fn = self.loss_fn
+        if ctx.loss_wrapper is not None:
+            loss_fn = ctx.loss_wrapper(self.loss_fn)
+        masks = dict(ctx.masks)
+        opt = self.optimizer
+
+        def step(params, opt_state, *batch):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, *batch))(params)
+            new_p, new_s = opt.apply(params, grads, opt_state)
+            if masks:
+                new_p = {n: (v * masks[n] if n in masks else v)
+                         for n, v in new_p.items()}
+            return loss, new_p, new_s
+
+        jitted = jax.jit(step)
+        self._step_cache = (key, jitted)
+        return jitted
+
+    def run(self):
+        ctx = self.context
+        if self.checkpoint_dir and os.path.exists(
+                os.path.join(self.checkpoint_dir, "context.json")):
+            ctx.from_file(self.checkpoint_dir)
+        for s in self.strategies:
+            s.on_compression_begin(ctx)
+        while ctx.epoch_id < self.epochs:
+            active = [s for s in self.strategies
+                      if s.active(ctx.epoch_id)]
+            for s in active:
+                s.on_epoch_begin(ctx)
+            step = self._step_fn()  # masks/loss may have changed
+            last_loss = None
+            for batch in self.train_reader():
+                last_loss, ctx.params, ctx.opt_state = step(
+                    ctx.params, ctx.opt_state, *batch)
+            for s in active:
+                s.on_epoch_end(ctx)
+            if ctx.eval_fn is not None:
+                ctx.eval_history.append(float(ctx.eval_fn(ctx.params)))
+            ctx.epoch_id += 1
+            if self.checkpoint_dir:
+                ctx.to_file(self.checkpoint_dir)
+            if (self.converge_delta is not None
+                    and ctx.eval_converged(self.converge_delta)):
+                break
+        for s in self.strategies:
+            s.on_compression_end(ctx)
+        return ctx
+
+
+_STRATEGY_KINDS = {
+    "uniform_prune": UniformPruneStrategy,
+    "sensitive_prune": SensitivePruneStrategy,
+    "distillation": DistillationStrategy,
+}
+
+
+def build_strategies(config) -> List[Strategy]:
+    """Config factory (reference: core/config.py ConfigFactory — yaml
+    there, a dict or JSON file path here): ``{"strategies": [{"kind":
+    "uniform_prune", "target_ratio": 0.5, "start_epoch": 1}, ...]}``."""
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    enforce("strategies" in config,
+            "compression config needs a 'strategies' list (got keys %s) — "
+            "e.g. {'strategies': [{'kind': 'uniform_prune', "
+            "'target_ratio': 0.5}]}", sorted(config))
+    out = []
+    for spec in config["strategies"]:
+        spec = dict(spec)
+        kind = spec.pop("kind")
+        enforce(kind in _STRATEGY_KINDS,
+                "unknown strategy kind %r (have: %s)", kind,
+                sorted(_STRATEGY_KINDS))
+        out.append(_STRATEGY_KINDS[kind](**spec))
+    return out

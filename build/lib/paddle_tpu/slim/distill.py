@@ -1,0 +1,66 @@
+"""Distillation losses + composition (reference:
+python/paddle/fluid/contrib/slim/distillation/ — soft-label loss, fsp
+loss, l2 feature loss between teacher/student var pairs;
+distillation_strategy.py merges teacher and student programs — here the
+teacher is just a second params tree + apply_fn, composed functionally).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+from ..ops.loss import softmax_with_cross_entropy
+from ..ops.nn_extra import fsp_matrix
+
+def soft_label_loss(student_logits, teacher_logits,
+                    temperature: float = 1.0):
+    """KL-style soft-label distillation loss (reference:
+    distillation_strategy soft_label_loss): CE(student/T, softmax(teacher/T))
+    scaled by T^2 so gradients keep magnitude."""
+    t = temperature
+    teacher_probs = jax.nn.softmax(teacher_logits / t, axis=-1)
+    ce = softmax_with_cross_entropy(student_logits / t, teacher_probs,
+                                    soft_label=True)
+    return jnp.mean(ce) * (t * t)
+
+
+def fsp_loss(student_pair: Tuple, teacher_pair: Tuple):
+    """FSP distillation loss (reference: fsp_op.cc + distillation usage):
+    L2 between the student's and teacher's flow matrices."""
+    s = fsp_matrix(*student_pair)
+    te = fsp_matrix(*teacher_pair)
+    return jnp.mean((s - te) ** 2)
+
+
+def l2_feature_loss(student_feat, teacher_feat):
+    """reference: distillation l2-loss between matched feature maps."""
+    return jnp.mean((student_feat - teacher_feat) ** 2)
+
+
+class Distiller:
+    """Compose distillation terms with the task loss (the
+    DistillationStrategy role, config-driven weighting)."""
+
+    def __init__(self, temperature: float = 4.0, soft_weight: float = 0.7,
+                 hard_weight: float = 0.3, feature_weight: float = 0.0):
+        self.temperature = temperature
+        self.soft_weight = soft_weight
+        self.hard_weight = hard_weight
+        self.feature_weight = feature_weight
+
+    def loss(self, student_logits, teacher_logits, label=None,
+             feature_pairs: Sequence[Tuple] = ()):
+        total = self.soft_weight * soft_label_loss(
+            student_logits, teacher_logits, self.temperature)
+        if label is not None and self.hard_weight:
+            total = total + self.hard_weight * jnp.mean(
+                softmax_with_cross_entropy(student_logits, label))
+        for s, t in feature_pairs:
+            total = total + self.feature_weight * l2_feature_loss(s, t)
+        return total
+
+

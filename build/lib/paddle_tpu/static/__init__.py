@@ -1,0 +1,42 @@
+"""Static-graph mode — the fluid Program/Executor capability surface
+(reference: python/paddle/fluid/framework.py, executor.py) on an XLA
+compile-the-whole-slice design. See program.py for the architecture note.
+
+Usage (mirrors the reference's train loop):
+
+    import paddle_tpu.static as static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (-1, 784))
+        label = prog.data("label", (-1,), "int32")
+        h = static.layers.fc(x, 128, act="relu")
+        logits = static.layers.fc(h, 10)
+        loss = static.layers.mean(
+            static.layers.softmax_with_cross_entropy(logits, label))
+        static.Adam(1e-3).minimize(loss)
+
+    exe = static.Executor()
+    out, = exe.run(prog, feed={"x": xs, "label": ys}, fetch_list=[loss])
+"""
+
+from . import layers
+from .control_flow import DynamicRNN, IfElse, StaticRNN, While
+from .executor import Executor, Scope, global_scope
+from .io import (InferencePredictor, TrainStepRunner, load_inference_model,
+                 load_persistables, save_inference_model, save_persistables,
+                 save_train_program)
+from .optimizer import SGD, Adam, Momentum, Optimizer
+from .program import (GRAD_SUFFIX, Program, Var, append_backward,
+                      default_main_program, program_guard)
+
+__all__ = [
+    "layers", "DynamicRNN", "IfElse", "StaticRNN", "While",
+    "Executor", "Scope", "global_scope",
+    "InferencePredictor", "TrainStepRunner", "load_inference_model",
+    "load_persistables", "save_inference_model", "save_persistables",
+    "save_train_program",
+    "SGD", "Adam", "Momentum", "Optimizer",
+    "GRAD_SUFFIX", "Program", "Var", "append_backward",
+    "default_main_program", "program_guard",
+]

@@ -1,0 +1,578 @@
+"""Block-DSL control flow for static Programs — While / IfElse /
+StaticRNN / DynamicRNN as RECORDING CONTEXTS.
+
+Capability equivalent of the reference's sub-block control-flow layers
+(reference: python/paddle/fluid/layers/control_flow.py — While.block:635,
+IfElse:1489, DynamicRNN:1619, StaticRNN:268; C++ interpreters
+paddle/fluid/operators/controlflow/while_op.cc:59,
+conditional_block_op.cc, recurrent_op.cc). The reference records body ops
+into a nested BlockDesc that a sub-executor interprets per iteration;
+here the ``with`` block records ordinary op nodes into the (single-block)
+Program, and on exit they are POPPED and re-recorded as ONE op node whose
+fn replays them inside ``lax.while_loop`` / ``lax.scan`` — XLA-compiled
+structured control flow instead of an op-by-op sub-interpreter.
+
+Write-back convention: the loop state of a While is exactly the set of
+pre-existing vars the body writes (via ``assign``-style in-place layers:
+``increment(x, in_place=True)``, ``less_than(..., cond=...)``,
+``logical_and(..., out=...)``, ``layers.assign(x, output=...)``) plus the
+loop condition var — mirroring the reference's requirement that the body
+mutate its condition.
+
+Sequence semantics: DynamicRNN consumes the framework's LoD replacement —
+padded ``(B, T, ...)`` arrays whose companion lengths var rides on
+``Var.lod_src`` (SURVEY §7 ragged canonicalization). Finished rows freeze
+their memories and emit zeros, numerically matching the reference's
+shrink-batch-by-length execution for pooled/masked consumers.
+
+IfElse keeps the reference's row-routing API (input/output per branch)
+but lowers to compute-both-and-mask — the XLA-native form of
+split_lod_tensor/merge_lod_tensor (reference: layers/control_flow.py
+split_lod_tensor) — valid whenever branch ops are row-independent, which
+is what the reference API supports anyway.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+from .program import (TRACE_BATCH, Program, Var, _OpNode,
+                      default_main_program)
+
+
+def _exec_nodes(nodes, env: Dict[str, Any]) -> Dict[str, Any]:
+    for node in nodes:
+        args = [env[n] for n in node.inputs]
+        out = node.fn(*args)
+        if len(node.outputs) == 1:
+            env[node.outputs[0]] = out
+        else:
+            for oname, oval in zip(node.outputs, out):
+                env[oname] = oval
+    return env
+
+
+def _analyze(body: Sequence[_OpNode], pre_names, bound: Sequence[str]):
+    """Split the body's dataflow: ``writes`` = pre-existing vars the body
+    assigns (loop state), ``external`` = names read from outside (params,
+    consts, captured activations), ``internal`` = produced inside."""
+    internal, writes = set(), []
+    for node in body:
+        enforce(isinstance(node, _OpNode),
+                "append_backward cannot appear inside a control-flow "
+                "block — call it on the outer program")
+        for o in node.outputs:
+            if o in pre_names and o not in writes:
+                writes.append(o)
+            internal.add(o)
+    external = []
+    for node in body:
+        for n in node.inputs:
+            if n not in internal and n not in bound and n not in external:
+                external.append(n)
+    # a var both read and written must resolve to the carried value, so
+    # drop carried names from the external (invariant) set
+    external = [n for n in external if n not in writes]
+    return writes, external
+
+
+class While:
+    """reference: layers/control_flow.py:593 While, :635 block().
+
+    ::
+
+        cond = layers.less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ...            # body layers; must re-assign `cond`
+            layers.increment(i, in_place=True)
+            layers.less_than(i, n, cond=cond)
+    """
+
+    def __init__(self, cond: Var, is_test: bool = False,
+                 name: Optional[str] = None):
+        enforce(isinstance(cond, Var),
+                "While(cond) needs a static Program Var (build inside "
+                "program_guard); eager code uses ops.while_loop")
+        self.cond = cond
+        self.prog: Program = cond.program
+
+    @contextlib.contextmanager
+    def block(self):
+        prog = self.prog
+        start = len(prog.nodes)
+        pre_names = set(prog.vars)
+        yield
+        body = prog.nodes[start:]
+        del prog.nodes[start:]
+        for node in body:
+            # a TensorArray first written inside the loop is not loop
+            # state (its buffer var doesn't pre-exist), so its writes
+            # would silently reset every iteration
+            enforce(not (node.name == "array_write"
+                         and node.inputs
+                         and node.inputs[0] not in pre_names),
+                    "TensorArray written inside a While block must be "
+                    "seeded with an array_write BEFORE the loop so its "
+                    "buffer becomes loop-carried state (reference decode "
+                    "seeds index 0 pre-loop)")
+        writes, external = _analyze(body, pre_names, bound=())
+        carry = list(dict.fromkeys([self.cond.name] + writes))
+        enforce(self.cond.name in [o for n in body for o in n.outputs],
+                "While body never re-assigns its condition %r (use "
+                "less_than(..., cond=cond) / logical_and(..., out=cond)) "
+                "— the loop would never terminate", self.cond.name)
+        n_carry = len(carry)
+
+        def while_fn(*vals, _body=tuple(body), _carry=tuple(carry),
+                     _ext=tuple(external), _n=n_carry):
+            init = tuple(vals[:_n])
+            inv = dict(zip(_ext, vals[_n:]))
+
+            def cond_fn(state):
+                c = state[0]
+                return jnp.reshape(c, ()).astype(bool)
+
+            def body_fn(state):
+                env = dict(inv)
+                env.update(zip(_carry, state))
+                env = _exec_nodes(_body, env)
+                return tuple(env[nm] for nm in _carry)
+
+            out = lax.while_loop(cond_fn, body_fn, init)
+            # _OpNode's one-output convention stores fn's return directly;
+            # unwrap the 1-tuple so the var keeps its shape
+            return out[0] if _n == 1 else out
+
+        # record with explicit output names = the carried vars (write-back)
+        node = _OpNode(while_fn, carry + external, list(carry), "while")
+        prog.nodes.append(node)
+        prog.version += 1
+
+
+class IfElse:
+    """reference: layers/control_flow.py:1489 IfElse. ``cond`` is a
+    (N, 1) bool tensor; both branches compute on the full rows and the
+    outputs merge by mask (the XLA form of split/merge_lod_tensor)."""
+
+    def __init__(self, cond: Var, name: Optional[str] = None):
+        enforce(isinstance(cond, Var), "IfElse(cond) needs a Program Var")
+        self.cond = cond
+        self.prog: Program = cond.program
+        self._branches: Dict[bool, Tuple[List[_OpNode], List[str],
+                                         List[str]]] = {}
+        self._cur: Optional[bool] = None
+        self._outputs: Dict[bool, List[str]] = {True: [], False: []}
+        self._external: Dict[bool, List[str]] = {True: [], False: []}
+        self._nodes: Dict[bool, List[_OpNode]] = {True: [], False: []}
+
+    def input(self, x: Var) -> Var:
+        enforce(self._cur is not None,
+                "IfElse.input() must be called inside a branch block")
+        return x  # row routing is by mask at merge time
+
+    def output(self, *outs: Var) -> None:
+        enforce(self._cur is not None,
+                "IfElse.output() must be called inside a branch block")
+        # -1 batch placeholders trace as TRACE_BATCH (program.py apply);
+        # normalize both sides so batch-polymorphic programs compare
+        # consistently
+        def _rows(d):
+            return TRACE_BATCH if d == -1 else d
+
+        rows = _rows(self.cond.shape[0])
+        for v in outs:
+            # compute-both-and-mask merges row-wise, so every output must
+            # keep the cond's row dimension; a cross-row reduction inside
+            # a branch (shape change) would merge garbage
+            enforce(v.shape and _rows(v.shape[0]) == rows,
+                    "IfElse output %r has shape %s but cond has %s rows: "
+                    "branch ops must be row-independent (no cross-row "
+                    "reductions) — IfElse lowers to compute-both-and-mask",
+                    v.name, tuple(v.shape), rows)
+        self._outputs[self._cur].extend(v.name for v in outs)
+
+    @contextlib.contextmanager
+    def _branch(self, which: bool):
+        enforce(self._cur is None, "IfElse blocks cannot nest")
+        prog = self.prog
+        self._cur = which
+        start = len(prog.nodes)
+        pre = set(prog.vars)
+        yield
+        body = prog.nodes[start:]
+        del prog.nodes[start:]
+        writes, external = _analyze(body, pre, bound=())
+        enforce(not writes, "IfElse branches produce values via "
+                ".output(...), not in-place assigns (got %s)", writes)
+        self._nodes[which] = list(body)
+        self._external[which] = external
+        self._cur = None
+
+    def true_block(self):
+        return self._branch(True)
+
+    def false_block(self):
+        return self._branch(False)
+
+    def __call__(self) -> List[Var]:
+        prog = self.prog
+        t_out, f_out = self._outputs[True], self._outputs[False]
+        enforce(len(t_out) == len(f_out) and t_out,
+                "IfElse needs the same number of output() calls in both "
+                "blocks (got %s true, %s false)", len(t_out), len(f_out))
+        ext = list(dict.fromkeys(self._external[True] +
+                                 self._external[False]))
+
+        def ifelse_fn(cond, *vals, _t=tuple(self._nodes[True]),
+                      _f=tuple(self._nodes[False]), _ext=tuple(ext),
+                      _to=tuple(t_out), _fo=tuple(f_out)):
+            env = dict(zip(_ext, vals))
+            t_env = _exec_nodes(_t, dict(env))
+            f_env = _exec_nodes(_f, dict(env))
+            def merge(tv, fv):
+                mask = jnp.reshape(cond, (cond.shape[0],) +
+                                   (1,) * (tv.ndim - 1))
+                return jnp.where(mask.astype(bool), tv, fv)
+
+            outs = tuple(merge(t_env[tn], f_env[fn])
+                         for tn, fn in zip(_to, _fo))
+            # single output unwraps (the _OpNode one-output convention)
+            return outs[0] if len(outs) == 1 else outs
+
+        outs = prog.apply(ifelse_fn, [self.cond] +
+                          [prog.vars[n] for n in ext], name="ifelse")
+        return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+class StaticRNN:
+    """reference: layers/control_flow.py:268 StaticRNN — fixed-length RNN
+    over a (B, T, D) input; ``with rnn.step():`` records one timestep."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.prog = default_main_program()
+        self._steps: List[Tuple[str, str]] = []   # (placeholder, outer x)
+        self._mems: List[Tuple[str, Optional[str], Tuple, float]] = []
+        self._updates: Dict[str, str] = {}
+        self._outs: List[str] = []
+        self._body: List[_OpNode] = []
+        self._external: List[str] = []
+        self._result: Optional[List[Var]] = None
+        self._in_block = False
+        self._seq_len: Optional[int] = None
+
+    # -- inside-block API ---------------------------------------------------
+    def step_input(self, x: Var) -> Var:
+        enforce(self._in_block, "step_input() belongs inside rnn.step()")
+        enforce(len(x.shape) >= 2, "step input must be (B, T, ...)")
+        if self._seq_len is None:
+            self._seq_len = x.shape[1]
+        ph = Var(self.prog, self.prog.unique_name("rnn_step_in"),
+                 (x.shape[0],) + tuple(x.shape[2:]), x.dtype)
+        self.prog.vars[ph.name] = ph
+        self._steps.append((ph.name, x.name))
+        return ph
+
+    def memory(self, init: Optional[Var] = None,
+               shape: Optional[Sequence[int]] = None,
+               batch_ref: Optional[Var] = None, init_value: float = 0.0,
+               init_batch_dim_idx: int = 0, ref_batch_dim_idx: int = 0,
+               value: Optional[float] = None, dtype=None) -> Var:
+        enforce(self._in_block, "memory() belongs inside the block")
+        if value is not None:
+            init_value = value
+        if init is not None:
+            mshape = tuple(init.shape)
+            init_name = init.name
+            mdtype = init.dtype
+        else:
+            enforce(shape is not None, "memory() needs init= or shape=")
+            if batch_ref is not None:
+                bsz = batch_ref.shape[0]
+            else:
+                enforce(self._steps,
+                        "memory(shape=...) without batch_ref needs a prior "
+                        "step_input to infer the batch dim")
+                bsz = self.prog.vars[self._steps[0][1]].shape[0]
+            mshape = (bsz,) + tuple(shape)
+            init_name = None
+            mdtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
+        ph = Var(self.prog, self.prog.unique_name("rnn_mem"), mshape, mdtype)
+        self.prog.vars[ph.name] = ph
+        self._mems.append((ph.name, init_name, mshape, init_value,
+                           jnp.dtype(mdtype)))
+        return ph
+
+    def update_memory(self, mem: Var, new: Var) -> None:
+        enforce(self._in_block, "update_memory() belongs inside the block")
+        self._updates[mem.name] = new.name
+
+    def step_output(self, o: Var) -> None:
+        enforce(self._in_block, "step_output() belongs inside the block")
+        self._outs.append(o.name)
+
+    output = step_output
+
+    # -- block lifecycle ----------------------------------------------------
+    @contextlib.contextmanager
+    def step(self):
+        prog = self.prog
+        self._in_block = True
+        start = len(prog.nodes)
+        pre = set(prog.vars)
+        yield
+        body = prog.nodes[start:]
+        del prog.nodes[start:]
+        bound = {ph for ph, _ in self._steps} | \
+                {m[0] for m in self._mems}
+        writes, external = _analyze(body, pre, bound=bound)
+        enforce(not writes, "StaticRNN/DynamicRNN blocks communicate via "
+                "update_memory/output, not in-place assigns (got %s)",
+                writes)
+        self._body, self._external = list(body), external
+        self._in_block = False
+        self._record()
+
+    block = step  # DynamicRNN spells it block(); share the machinery
+
+    def _lengths_for(self, prog: Program) -> Optional[str]:
+        return None  # StaticRNN: full length
+
+    def _record(self) -> None:
+        prog = self.prog
+        enforce(self._outs, "rnn block defined no output()")
+        enforce(self._steps or self._seq_len is not None,
+                "rnn block needs at least one step_input")
+        step_phs = [ph for ph, _ in self._steps]
+        step_xs = [x for _, x in self._steps]
+        mem_phs = [m[0] for m in self._mems]
+        mem_inits = [m[1] for m in self._mems]
+        init_vars = [n for n in mem_inits if n is not None]
+        lens_name = self._lengths_for(prog)
+        n_step, n_mem, n_init = len(step_phs), len(mem_phs), len(init_vars)
+
+        def rnn_fn(*vals, _body=tuple(self._body), _phs=tuple(step_phs),
+                   _mems=tuple(self._mems), _upd=dict(self._updates),
+                   _outs=tuple(self._outs), _ext=tuple(self._external),
+                   _masked=lens_name is not None):
+            xs = vals[:n_step]
+            k = n_step
+            lens = None
+            if _masked:
+                lens = vals[k]
+                k += 1
+            inits = {n: v for n, v in zip(init_vars, vals[k:k + n_init])}
+            k += n_init
+            inv = dict(zip(_ext, vals[k:]))
+            B = xs[0].shape[0] if xs else 1
+            T = xs[0].shape[1] if xs else 1
+
+            mem0 = []
+            for (ph, init_name, shape, init_value, mdtype) in _mems:
+                if init_name is not None:
+                    mem0.append(inits[init_name])
+                else:
+                    mem0.append(jnp.full((B,) + tuple(shape[1:]),
+                                         init_value, mdtype))
+
+            def one(carry, t):
+                mems = carry
+                env = dict(inv)
+                for ph, x in zip(_phs, xs):
+                    env[ph] = lax.dynamic_index_in_dim(x, t, 1,
+                                                       keepdims=False)
+                env.update(zip([m[0] for m in _mems], mems))
+                env = _exec_nodes(_body, env)
+                new = []
+                for (ph, *_rest), old in zip(_mems, mems):
+                    cand = env[_upd[ph]] if ph in _upd else old
+                    if lens is not None:
+                        act = (t < lens).reshape(
+                            (-1,) + (1,) * (cand.ndim - 1))
+                        cand = jnp.where(act, cand, old)
+                    new.append(cand)
+                outs = []
+                for o in _outs:
+                    val = env[o]
+                    if lens is not None:
+                        act = (t < lens).reshape(
+                            (-1,) + (1,) * (val.ndim - 1))
+                        val = val * act.astype(val.dtype)
+                    outs.append(val)
+                return tuple(new), tuple(outs)
+
+            _, stacked = lax.scan(one, tuple(mem0), jnp.arange(T))
+            # (T, B, ...) -> (B, T, ...); single output unwraps (the
+            # _OpNode one-output convention stores fn's return directly)
+            outs_bt = tuple(jnp.moveaxis(s, 0, 1) for s in stacked)
+            return outs_bt[0] if len(outs_bt) == 1 else outs_bt
+
+        inputs = (step_xs + ([lens_name] if lens_name else []) +
+                  init_vars + self._external)
+        out_vars = []
+        for o in self._outs:
+            inner = prog.vars[o]
+            name = prog.unique_name("rnn_out")
+            B = self.prog.vars[step_xs[0]].shape[0] if step_xs else -1
+            ov = Var(prog, name, (B, self._seq_len) + tuple(inner.shape[1:]),
+                     inner.dtype)
+            ov.lod_src = (getattr(prog.vars[step_xs[0]], "lod_src", None)
+                          if step_xs else None)
+            prog.vars[name] = ov
+            out_vars.append(ov)
+        prog.nodes.append(_OpNode(rnn_fn, list(inputs),
+                                  [v.name for v in out_vars], "rnn"))
+        prog.version += 1
+        self._result = out_vars
+
+    def __call__(self) -> Any:
+        enforce(self._result is not None,
+                "call the rnn after its block closes")
+        return (self._result[0] if len(self._result) == 1
+                else tuple(self._result))
+
+
+class DynamicRNN(StaticRNN):
+    """reference: layers/control_flow.py:1619 DynamicRNN — variable-length
+    RNN over the padded+lengths LoD replacement. ``step_input`` takes a
+    lod-carrying (B, T, ...) var; finished rows freeze memories and emit
+    zeros (numerically equal to the reference's length-sorted shrinking
+    batch for masked/pooled consumers)."""
+
+    def __init__(self, lod_level: int = 1, name: Optional[str] = None):
+        super().__init__(name=name)
+        self._lens: Optional[str] = None
+
+    def step_input(self, x: Var, level: int = 0) -> Var:
+        ph = super().step_input(x)
+        lens = getattr(x, "lod_src", None)
+        if lens is not None and self._lens is None:
+            self._lens = lens
+        return ph
+
+    def static_input(self, x: Var) -> Var:
+        # per-sequence invariant input: visible to every step as-is
+        return x
+
+    def _lengths_for(self, prog: Program) -> Optional[str]:
+        return self._lens
+
+
+class Switch:
+    """reference: layers/control_flow.py Switch — first-match-wins case
+    chain, used by piecewise LR schedules::
+
+        with Switch() as switch:
+            with switch.case(step < b1):
+                assign(lr1, output=lr)
+            with switch.default():
+                assign(lr2, output=lr)
+
+    Lowering: every case body records unconditionally (compute-all), and
+    each outer var written by any body selects its final value by the
+    FIRST true condition (jnp.where chain) — the XLA form of the
+    reference's conditional_block dispatch. Bodies communicate only via
+    in-place writes to pre-existing vars (assign(output=)/increment),
+    matching the reference's usage."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.prog: Program = default_main_program()
+        # (cond_name or None, body nodes, writes, external reads)
+        self._cases: List[Tuple[Optional[str], List[_OpNode], List[str],
+                                List[str]]] = []
+        self._entered = False
+
+    def __enter__(self) -> "Switch":
+        self._entered = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self._lower()
+        return False
+
+    @contextlib.contextmanager
+    def _capture(self, cond: Optional[Var]):
+        enforce(self._entered,
+                "use Switch inside a `with Switch() as switch:` block")
+        enforce(cond is None or isinstance(cond, Var),
+                "switch.case(cond) needs a Program Var condition")
+        enforce(not (self._cases and self._cases[-1][0] is None),
+                "default() must be the last Switch block")
+        prog = self.prog
+        start = len(prog.nodes)
+        pre = set(prog.vars)
+        yield
+        body = prog.nodes[start:]
+        del prog.nodes[start:]
+        writes, external = _analyze(body, pre, bound=())
+        enforce(writes, "a Switch block must write at least one outer "
+                "var (assign(..., output=var))")
+        self._cases.append((cond.name if cond is not None else None,
+                            list(body), writes, external))
+
+    def case(self, cond: Var):
+        return self._capture(cond)
+
+    def default(self):
+        return self._capture(None)
+
+    def _lower(self) -> None:
+        enforce(self._cases, "Switch recorded no case blocks")
+        prog = self.prog
+        all_writes: List[str] = []
+        for _c, _b, writes, _e in self._cases:
+            for w in writes:
+                if w not in all_writes:
+                    all_writes.append(w)
+        cond_names = [c for c, *_ in self._cases if c is not None]
+        externals: List[str] = []
+        for _c, _b, _w, ext in self._cases:
+            for e in ext:
+                if e not in externals and e not in all_writes:
+                    externals.append(e)
+        n_w, n_c = len(all_writes), len(cond_names)
+        cases = [(c, tuple(b), tuple(w))
+                 for c, b, w, _e in self._cases]
+
+        def switch_fn(*vals):
+            init = dict(zip(all_writes, vals[:n_w]))
+            conds = dict(zip(cond_names, vals[n_w:n_w + n_c]))
+            env0 = dict(zip(externals, vals[n_w + n_c:]))
+            env0.update(init)
+            # evaluate every body from the same pre-switch env
+            outs = []
+            for cname, body, writes in cases:
+                env = dict(env0)
+                env = _exec_nodes(body, env)
+                outs.append({w: env[w] for w in writes})
+            # first-match-wins: fold the chain from the last case up.
+            # A true case owns ALL outer vars, not just the ones it
+            # writes — untouched vars keep their pre-switch value, as the
+            # reference runs only the first true block.
+            final = dict(init)
+            for (cname, _b, writes), got in zip(reversed(cases),
+                                                reversed(outs)):
+                if cname is None:
+                    for w in writes:
+                        final[w] = got[w]
+                    continue
+                c = jnp.reshape(conds[cname], ()).astype(bool)
+                for w in all_writes:
+                    final[w] = jnp.where(c, got.get(w, init[w]), final[w])
+            # single write unwraps (the _OpNode one-output convention
+            # stores fn's return directly)
+            return (final[all_writes[0]] if n_w == 1
+                    else tuple(final[w] for w in all_writes))
+
+        node = _OpNode(switch_fn,
+                       all_writes + cond_names + externals,
+                       list(all_writes), "switch")
+        prog.nodes.append(node)
+        prog.version += 1

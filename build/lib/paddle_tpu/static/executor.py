@@ -1,0 +1,306 @@
+"""Static-graph Executor + Scope.
+
+Capability equivalent of the fluid Executor stack (reference:
+python/paddle/fluid/executor.py:288 run:539; framework/executor.cc:149;
+scope: framework/scope.h:45) — but instead of interpreting ops one by one
+(the reference's hot loop, operator.cc:881), ``Executor.run`` compiles the
+requested (feed → fetch) slice of the Program into ONE jitted XLA function
+and caches it keyed by (program version, feed signature, fetch list) —
+the same amortization role as the reference's program cache
+(executor.py:250) and the ngraph per-shape function cache
+(reference: operators/ngraph/ngraph_engine.h:117 GetNgFunction).
+
+Parameters live device-resident in a Scope; update ops (optimizer) thread
+new values through the jitted step and back into the Scope with buffer
+donation — no host round-trips in the train loop.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from .program import GRAD_SUFFIX, Program, Var, _GradNode, _OpNode
+
+
+class Scope:
+    """name → device array store (reference: framework/scope.h:45; flat —
+    XLA needs no nested kid scopes)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def set(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def get(self, name: str):
+        enforce(name in self._vars, "scope has no var %s", name)
+        return self._vars[name]
+
+    def has(self, name: str) -> bool:
+        return name in self._vars
+
+    def names(self) -> List[str]:
+        return sorted(self._vars)
+
+    def drop(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _exec_opnodes(nodes, env: Dict[str, Any]) -> Dict[str, Any]:
+    for node in nodes:
+        if not isinstance(node, _OpNode):
+            continue
+        args = [env[n] for n in node.inputs]
+        out = node.fn(*args)
+        if len(node.outputs) == 1:
+            env[node.outputs[0]] = out
+        else:
+            for oname, oval in zip(node.outputs, out):
+                env[oname] = oval
+    return env
+
+
+def prune_for_fetch(prog: Program, fetch_names) -> Tuple[set, set]:
+    """Backward-reachability slice (reference: framework/prune.cc +
+    executor.py feed/fetch pruning): the node indices needed to produce
+    ``fetch_names`` and the feed vars that slice actually consumes.
+
+    Writes to PERSISTABLE vars are live roots regardless of the fetch
+    list — optimizer updates and batch-norm running stats are the
+    program's training effects and must run whenever recorded (matching
+    the reference Executor, which interprets the whole program; pruning
+    only drops pure dead compute, e.g. the loss ops of a test clone when
+    fetching an intermediate activation)."""
+    persistable = set(prog.persistable_names())
+    needed = set(fetch_names)
+    for node in prog.nodes:
+        if not isinstance(node, _GradNode):
+            needed.update(o for o in node.outputs if o in persistable)
+    keep = set()
+    for idx in range(len(prog.nodes) - 1, -1, -1):
+        node = prog.nodes[idx]
+        if isinstance(node, _GradNode):
+            if not any(o in needed for o in node.outputs):
+                continue
+            keep.add(idx)
+            needed.add(node.loss_name)
+            needed.update(node.param_names)
+            for p in prog.nodes[:node.prefix_len]:
+                if not isinstance(p, _GradNode):
+                    needed.update(p.inputs)
+        else:
+            if not any(o in needed for o in node.outputs):
+                continue
+            keep.add(idx)
+            needed.update(node.inputs)
+    feeds = {n for n in needed
+             if n in prog.vars and prog.vars[n].is_feed}
+    return keep, feeds
+
+
+def _exec_program(prog: Program, env: Dict[str, Any],
+                  include: Optional[set] = None) -> Dict[str, Any]:
+    for i, node in enumerate(prog.nodes):
+        if include is not None and i not in include:
+            continue
+        if isinstance(node, _GradNode):
+            prefix = prog.nodes[:node.prefix_len]
+            base = dict(env)
+
+            def loss_of(pdict, _prefix=prefix, _base=base,
+                        _loss=node.loss_name):
+                e2 = dict(_base)
+                e2.update(pdict)
+                e2 = _exec_opnodes(_prefix, e2)
+                loss = e2[_loss]
+                enforce(loss.ndim == 0 or loss.size == 1,
+                        "append_backward loss must be scalar, got %s",
+                        loss.shape)
+                return jnp.reshape(loss, ())
+
+            grads = jax.grad(loss_of)({p: env[p] for p in node.param_names})
+            for p in node.param_names:
+                env[p + GRAD_SUFFIX] = grads[p]
+        else:
+            args = [env[n] for n in node.inputs]
+            out = node.fn(*args)
+            if len(node.outputs) == 1:
+                env[node.outputs[0]] = out
+            else:
+                for oname, oval in zip(node.outputs, out):
+                    env[oname] = oval
+    return env
+
+
+class Executor:
+    """reference: executor.py:288. ``place`` is advisory — XLA owns device
+    placement; a mesh-aware CompiledProgram wrapper adds SPMD."""
+
+    def __init__(self, place=None, scope: Optional[Scope] = None):
+        from collections import OrderedDict
+
+        self.place = place
+        self._scope = scope  # None = resolve global scope AT RUN TIME, so
+        # LRU-bounded executable cache (FLAGS_compile_cache_capacity):
+        # recompilation management, SURVEY §7 "hard parts" — unbounded
+        # shape churn must evict, not accumulate    (scope_guard works ^)
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._prune_cache: Dict[Tuple, Tuple] = {}
+
+    @property
+    def scope(self) -> Scope:
+        return self._scope if self._scope is not None else global_scope()
+
+    @scope.setter
+    def scope(self, value):
+        self._scope = value
+
+    # -- startup ------------------------------------------------------------
+    def run_startup(self, program: Program, seed: int = 0) -> None:
+        """Initialize every parameter of `program` into the scope
+        (reference: the startup program executed once before training)."""
+        from ..core import random as prandom
+
+        key = jax.random.key(seed)
+        for i, (name, (init, shape, dtype)) in enumerate(
+                sorted(program.param_inits.items())):
+            if self.scope.has(name):
+                continue  # idempotent, like re-running fluid startup
+            sub = jax.random.fold_in(key, i)
+            self.scope.set(name, init(sub, shape, dtype))
+
+    # -- dataset training (reference: executor.py train_from_dataset /
+    # infer_from_dataset — the AsyncExecutor successor driving the native
+    # MultiSlot feed) ------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Run the program once per dataset batch (dataset batches are
+        name→array dicts from the native MultiSlot feed). Returns the last
+        fetch results."""
+        from .program import default_main_program
+
+        program = program or default_main_program()
+        out = None
+        for i, batch in enumerate(dataset):
+            out = self.run(program, feed=batch, fetch_list=fetch_list)
+            if debug and fetch_list and i % print_period == 0:
+                print(f"step {i}: {out}")
+        return out
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        # inference = same drive loop over a program with no update ops
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
+    # -- run ----------------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[Union[str, Var]]] = None,
+            return_numpy: bool = True):
+        """Execute the program slice needed for `fetch_list`
+        (reference: executor.py run:539 feed/fetch contract)."""
+        from .program import default_main_program
+
+        program = program or default_main_program()
+        # accept a fluid.CompiledProgram front (canonical pattern:
+        # exe.run(CompiledProgram(prog).with_data_parallel(...), ...))
+        program = getattr(program, "program", program)
+        feed = dict(feed or {})
+        fetch_names = tuple(
+            f.name if isinstance(f, Var) else f for f in (fetch_list or []))
+        for fname in fetch_names:
+            enforce(fname in program.vars,
+                    "fetch target %s is not in the program", fname)
+
+        # auto-startup: initialize any missing params
+        missing = [n for n in program.param_inits if not self.scope.has(n)]
+        if missing:
+            self.run_startup(program)
+
+        feed_vals = {k: jnp.asarray(v) for k, v in feed.items()}
+        for k in feed_vals:
+            enforce(k in program.vars and program.vars[k].is_feed,
+                    "feed %s is not a data() var of this program", k)
+        # prune to the fetch slice (reference: framework/prune.cc) — only
+        # data() vars that slice consumes must be fed; catch gaps here
+        # with a named error instead of a bare KeyError from inside
+        # tracing. No fetches = run the whole program (train-loop form).
+        # Memoized: the sweep is determined by (program, version, fetch)
+        # and must not run per step in the train-loop hot path.
+        pkey = (id(program), program.version, fetch_names)
+        cached = self._prune_cache.get(pkey)
+        # id() can be recycled after a Program is GC'd — the weakref in
+        # the cache value validates the hit really is this program
+        if cached is not None and cached[0]() is program:
+            _, keep, used_feeds = cached
+        else:
+            if fetch_names:
+                keep, used_feeds = prune_for_fetch(program, fetch_names)
+            else:
+                keep = None
+                used_feeds = {
+                    n for node in program.nodes
+                    if isinstance(node, _OpNode) for n in node.inputs
+                    if n in program.vars and program.vars[n].is_feed}
+            if len(self._prune_cache) > 256:
+                self._prune_cache.clear()
+            self._prune_cache[pkey] = (weakref.ref(program), keep,
+                                       used_feeds)
+        unfed = sorted(n for n in used_feeds if n not in feed_vals)
+        enforce(not unfed, "missing feeds %s: every data() var the fetched "
+                "slice reads must appear in `feed`", unfed)
+        persist = program.persistable_names()
+        params = {n: self.scope.get(n) for n in persist}
+        consts = dict(getattr(program, "_const_values", {}))
+
+        sig = tuple(sorted((k, v.shape, str(v.dtype))
+                           for k, v in feed_vals.items()))
+        key = (id(program), program.version, sig, fetch_names)
+        step = self._cache.get(key)
+        if step is not None:
+            self._cache.move_to_end(key)  # LRU touch
+        if step is None:
+            def step(params, feed_vals, _prog=program, _consts=consts,
+                     _fetch=fetch_names, _persist=tuple(persist),
+                     _keep=keep):
+                env = dict(_consts)
+                env.update(params)
+                env.update(feed_vals)
+                env = _exec_program(_prog, env, include=_keep)
+                return ([env[f] for f in _fetch],
+                        {p: env[p] for p in _persist})
+
+            step = jax.jit(step, donate_argnums=(0,))
+            self._cache[key] = step
+            from ..core.config import FLAGS
+
+            cap = max(int(FLAGS.get("compile_cache_capacity")), 1)
+            while len(self._cache) > cap:
+                self._cache.popitem(last=False)  # evict least recent
+
+        fetched, new_params = step(params, feed_vals)
+        for n, v in new_params.items():
+            self.scope.set(n, v)
+        if return_numpy:
+            fetched = [np.asarray(v) for v in fetched]
+        return fetched
+
+    def close(self):
+        self._cache.clear()

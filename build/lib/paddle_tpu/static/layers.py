@@ -1,0 +1,565 @@
+"""Static-graph layer functions — fluid `layers.*` capability surface
+(reference: python/paddle/fluid/layers/nn.py, 184 functions; fc:210) as
+thin recorders over the functional op library: each call creates params on
+the current Program and records one traced op node.
+
+Param creation mirrors LayerHelper (reference: layer_helper.py:29).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import initializer as I
+from ..ops import loss as OL
+from ..core.enforce import enforce
+from ..ops import math as OM
+from ..ops import nn as ON
+from .program import Program, Var, default_main_program
+
+
+def _prog(*vars_) -> Program:
+    for v in vars_:
+        if isinstance(v, Var):
+            return v.program
+    return default_main_program()
+
+
+def shared_param(prog: Program, pname: str, shape, init) -> Var:
+    """Get-or-create a named, shareable parameter — the one sharing
+    protocol for param_attr layers (fc, embedding): an existing var must
+    be a real parameter of the matching shape (a silent collision with a
+    feed/op-output var would train nothing)."""
+    if pname in prog.vars:
+        v = prog.vars[pname]
+        enforce(v.is_param,
+                "param_attr %r collides with a non-parameter var — "
+                "pick a different name", pname)
+        enforce(tuple(v.shape) == tuple(shape),
+                "shared param %s has shape %s, this layer needs %s",
+                pname, tuple(v.shape), tuple(shape))
+        return v
+    return prog.create_parameter(pname, tuple(shape), initializer=init)
+
+
+def fc(input, size: int, act: Optional[str] = None,
+       bias_attr: bool = True, name: str = "fc",
+       param_attr=None) -> Var:
+    """reference: layers/nn.py fc:210. A LIST input gets one weight per
+    entry and the projections sum (the reference's multi-input mul+sum).
+
+    ``param_attr`` with a name pins EXACT weight names, enabling the
+    reference's cross-program weight sharing — the book pattern where
+    decoder_decode reuses decoder_train's weights through the scope
+    (reference: tests/book/test_machine_translation.py). A single
+    (non-list) input uses ``<name>`` verbatim; a LIST input appends
+    ``_0``, ``_1``, ... per entry; the bias gets ``<name>.b``. Keep the
+    input STRUCTURE identical across sharing programs — mixing the bare
+    and suffixed forms for one name in the same program is rejected."""
+    is_list = isinstance(input, (list, tuple))
+    inputs = list(input) if is_list else [input]
+    prog = _prog(*inputs)
+    attr_name = getattr(param_attr, "name", None) or (
+        param_attr if isinstance(param_attr, str) else None)
+    if attr_name is not None:
+        # input-structure registry: two fc calls sharing one name must
+        # agree on structure (bare weight for a single input, _0.._k-1
+        # for a k-list), or their weight names fork silently. Cross-
+        # PROGRAM mixing cannot be detected at build time — keep the
+        # input structure identical across sharing programs.
+        arity = len(inputs) if is_list else 0  # 0 = single non-list
+        registry = getattr(prog, "_fc_shared_arity", None)
+        if registry is None:
+            registry = prog._fc_shared_arity = {}
+        prev = registry.get(attr_name)
+        enforce(prev is None or prev == arity,
+                "param_attr %r was used by an fc with %s input(s); this "
+                "fc has %s — weight names differ by input structure, so "
+                "these calls would NOT share", attr_name,
+                "a single non-list" if prev == 0 else prev,
+                "a single non-list" if arity == 0 else arity)
+        registry[attr_name] = arity
+
+    def wname(i):
+        if attr_name is None:
+            return prog.unique_name(f"{name}_w")
+        return f"{attr_name}_{i}" if is_list else attr_name
+
+    ws = [shared_param(prog, wname(i), (x.shape[-1], size),
+                       I.XavierUniform())
+          for i, x in enumerate(inputs)]
+    args = inputs + ws
+    if bias_attr:
+        bname = (f"{attr_name}.b" if attr_name is not None
+                 else prog.unique_name(f"{name}_b"))
+        args.append(shared_param(prog, bname, (size,), I.Constant(0.0)))
+    k = len(inputs)
+
+    def fn(*vals):
+        xs, rest = vals[:k], vals[k:]
+        ws_, b = rest[:k], (rest[k] if bias_attr else None)
+        y = sum(x @ w for x, w in zip(xs, ws_))
+        if b is not None:
+            y = y + b
+        if act is not None:
+            y = getattr(jax.nn, act, getattr(OM, act, None))(y)
+        return y
+
+    return prog.apply(fn, args, name=name)
+
+
+def conv2d(input: Var, num_filters: int, filter_size: int, stride: int = 1,
+           padding: int = 0, groups: int = 1, act: Optional[str] = None,
+           bias_attr: bool = True, name: str = "conv2d") -> Var:
+    prog = _prog(input)
+    c_in = input.shape[1]
+    w = prog.create_parameter(
+        prog.unique_name(f"{name}_w"),
+        (num_filters, c_in // groups, filter_size, filter_size),
+        initializer=I.MSRA(uniform=False))
+    args = [input, w]
+    if bias_attr:
+        b = prog.create_parameter(prog.unique_name(f"{name}_b"),
+                                  (num_filters,), initializer=I.Constant(0.0))
+        args.append(b)
+
+    def fn(x, w, b=None):
+        y = ON.conv2d(x, w, stride, padding, 1, groups)
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        if act is not None:
+            y = getattr(jax.nn, act)(y)
+        return y
+
+    return prog.apply(fn, args, name=name)
+
+
+def embedding(input: Var, size: Sequence[int], padding_idx=None,
+              is_sparse: bool = False, is_distributed: bool = False,
+              param_attr=None, dtype=None, name: str = "embedding") -> Var:
+    """``param_attr`` with a name enables the reference's cross-layer
+    param sharing (e.g. the MT book model's shared 'vemb' table);
+    ``is_sparse`` is advisory — gradients are dense under XLA and giant
+    tables shard via parallel.ShardedEmbedding (OP_COVERAGE.md)."""
+    prog = _prog(input)
+    attr_name = getattr(param_attr, "name", None) or (
+        param_attr if isinstance(param_attr, str) else None)
+    w = shared_param(prog, attr_name or prog.unique_name(f"{name}_w"),
+                     tuple(size), I.XavierNormal())
+    return prog.apply(lambda ids, t: ON.embedding(ids, t, padding_idx),
+                      [input, w], name=name)
+
+
+def _unary(fnname, jfn):
+    def layer(x: Var, name: Optional[str] = None) -> Var:
+        return _prog(x).apply(jfn, [x], name=name or fnname)
+
+    layer.__name__ = fnname
+    return layer
+
+
+relu = _unary("relu", jax.nn.relu)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+softmax = _unary("softmax", lambda x: jax.nn.softmax(x, axis=-1))
+exp = _unary("exp", jnp.exp)
+log = _unary("log", jnp.log)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+
+
+def mean(x: Var, name: str = "mean") -> Var:
+    """LoD-aware: a padded sequence tensor averages over REAL tokens only
+    (the reference's mean over a LoDTensor counts actual rows)."""
+    prog = _prog(x)
+    lens = getattr(x, "lod_src", None)
+    if lens is not None and lens in prog.vars:
+        def fn(a, ln):
+            t = a.shape[1]
+            m = (jnp.arange(t)[None, :] < ln[:, None]).astype(a.dtype)
+            m = m.reshape(m.shape + (1,) * (a.ndim - 2))
+            return jnp.sum(a * m) / jnp.maximum(
+                jnp.sum(m) * float(np.prod(a.shape[2:], dtype=np.int64)
+                                   or 1), 1.0)
+
+        out = prog.apply(fn, [x, prog.vars[lens]], name=name)
+        out.lod_src = None
+        return out
+    return prog.apply(jnp.mean, [x], name=name)
+
+
+def reduce_sum(x: Var, dim=None, keep_dim: bool = False) -> Var:
+    return _prog(x).apply(
+        lambda a: jnp.sum(a, axis=dim, keepdims=keep_dim), [x],
+        name="reduce_sum")
+
+
+def reshape(x: Var, shape: Sequence[int]) -> Var:
+    return _prog(x).apply(lambda a: jnp.reshape(a, shape), [x],
+                          name="reshape")
+
+
+def transpose(x: Var, perm: Sequence[int]) -> Var:
+    return _prog(x).apply(lambda a: jnp.transpose(a, perm), [x],
+                          name="transpose")
+
+
+def concat(xs: Sequence[Var], axis: int = 0) -> Var:
+    prog = _prog(*xs)
+    return prog.apply(lambda *a: jnp.concatenate(a, axis=axis), list(xs),
+                      name="concat")
+
+
+def dropout(x: Var, dropout_prob: float = 0.5, seed: int = 0,
+            is_test: bool = False) -> Var:
+    """Static dropout uses a fixed fold-in key per recorded op (the dygraph
+    path owns stateful RNG; reference: operators/dropout_op.cc)."""
+    if is_test or dropout_prob == 0.0:
+        return x
+    prog = _prog(x)
+    opid = prog._name_counter + 1
+    key = jax.random.fold_in(jax.random.key(seed), opid)
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - dropout_prob, a.shape)
+        return jnp.where(keep, a / (1.0 - dropout_prob), 0.0)
+
+    return prog.apply(fn, [x], name="dropout", eval_fn=lambda a: a)
+
+
+def cross_entropy(input: Var, label: Var, soft_label: bool = False) -> Var:
+    return _prog(input).apply(
+        lambda p, l: OL.cross_entropy(p, l, soft_label=soft_label),
+        [input, label], name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits: Var, label: Var) -> Var:
+    return _prog(logits).apply(OL.softmax_with_cross_entropy,
+                               [logits, label],
+                               name="softmax_with_cross_entropy")
+
+
+def accuracy(input: Var, label: Var) -> Var:
+    from ..metrics import accuracy as acc_fn
+
+    return _prog(input).apply(acc_fn, [input, label], name="accuracy")
+
+
+def batch_norm(input: Var, act: Optional[str] = None, is_test: bool = False,
+               momentum: float = 0.9, epsilon: float = 1e-5,
+               name: str = "batch_norm") -> Var:
+    """Static BN: scale/bias trainable; running stats are persistable
+    non-trainable vars updated through the step (mirrors the reference's
+    batch_norm_op in-place MeanOut/VarianceOut)."""
+    prog = _prog(input)
+    c = input.shape[1]
+    scale = prog.create_parameter(prog.unique_name(f"{name}_scale"), (c,),
+                                  initializer=I.Constant(1.0))
+    bias = prog.create_parameter(prog.unique_name(f"{name}_bias"), (c,),
+                                 initializer=I.Constant(0.0))
+    rmean = prog.create_parameter(prog.unique_name(f"{name}_mean"), (c,),
+                                  initializer=I.Constant(0.0),
+                                  trainable=False)
+    rvar = prog.create_parameter(prog.unique_name(f"{name}_var"), (c,),
+                                 initializer=I.Constant(1.0),
+                                 trainable=False)
+
+    def make_fn(training):
+        def fn(x, s, b, m, v):
+            y, nm, nv = ON.batch_norm(x, s, b, m, v, training=training,
+                                      momentum=momentum, epsilon=epsilon)
+            if act is not None:
+                y = getattr(jax.nn, act)(y)
+            return y, nm, nv
+
+        return fn
+
+    y, nm, nv = prog.apply(make_fn(not is_test),
+                           [input, scale, bias, rmean, rvar],
+                           name=name, eval_fn=make_fn(False))
+    prog.assign(rmean, nm)
+    prog.assign(rvar, nv)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# in-place write layers (block-DSL state plumbing)
+# ---------------------------------------------------------------------------
+# The reference's While/optimizer bodies mutate vars through op outputs
+# (reference: layers/control_flow.py increment in_place, layers/ops
+# less_than(cond=...), logical_and(out=...)); here a write to an existing
+# var records Program.assign, which the block-DSL lowering turns into loop
+# carry state (static/control_flow.py).
+
+
+def assign(input: Var, output: Optional[Var] = None) -> Var:
+    prog = _prog(input, output)
+    out = prog.apply(lambda a: a, [input], name="assign_value")
+    if output is not None:
+        prog.assign(output, out)
+        return output
+    return out
+
+
+def increment(x: Var, value: float = 1.0, in_place: bool = True) -> Var:
+    prog = _prog(x)
+    out = prog.apply(lambda a: a + jnp.asarray(value, a.dtype), [x],
+                     name="increment")
+    if in_place:
+        prog.assign(x, out)
+        return x
+    return out
+
+
+def _compare(name, jfn):
+    def layer(x: Var, y, force_cpu: Optional[bool] = None,
+              cond: Optional[Var] = None) -> Var:
+        prog = _prog(x, y, cond)
+        out = prog.apply(jfn, [x, y], name=name)
+        if cond is not None:
+            prog.assign(cond, out)
+            return cond
+        return out
+
+    layer.__name__ = name
+    return layer
+
+
+less_than = _compare("less_than", jnp.less)
+less_equal = _compare("less_equal", jnp.less_equal)
+greater_than = _compare("greater_than", jnp.greater)
+greater_equal = _compare("greater_equal", jnp.greater_equal)
+equal = _compare("equal", jnp.equal)
+not_equal = _compare("not_equal", jnp.not_equal)
+
+
+def _logical(name, jfn, unary=False):
+    if unary:
+        def layer(x: Var, out: Optional[Var] = None,
+                  name_: Optional[str] = None) -> Var:
+            prog = _prog(x, out)
+            o = prog.apply(jfn, [x], name=name)
+            if out is not None:
+                prog.assign(out, o)
+                return out
+            return o
+    else:
+        def layer(x: Var, y: Var, out: Optional[Var] = None,
+                  name_: Optional[str] = None) -> Var:
+            prog = _prog(x, y, out)
+            o = prog.apply(jfn, [x, y], name=name)
+            if out is not None:
+                prog.assign(out, o)
+                return out
+            return o
+
+    layer.__name__ = name
+    return layer
+
+
+logical_and = _logical("logical_and", jnp.logical_and)
+logical_or = _logical("logical_or", jnp.logical_or)
+logical_xor = _logical("logical_xor", jnp.logical_xor)
+logical_not = _logical("logical_not", jnp.logical_not, unary=True)
+
+
+def fill_constant(shape, dtype, value, force_cpu: bool = False,
+                  out: Optional[Var] = None) -> Var:
+    from ..core.dtypes import to_dtype
+
+    prog = _prog(out)
+    o = prog.apply(
+        lambda: jnp.full(tuple(shape), value, to_dtype(dtype)),
+        [], name="fill_constant")
+    if out is not None:
+        prog.assign(out, o)
+        return out
+    return o
+
+
+def zeros(shape, dtype="float32", force_cpu: bool = False) -> Var:
+    return fill_constant(shape, dtype, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# sequence layers over the padded+lengths LoD replacement
+# ---------------------------------------------------------------------------
+
+
+def _lens_var(prog: Program, x: Var, what: str) -> Var:
+    lens = getattr(x, "lod_src", None)
+    from ..core.enforce import enforce as _enf
+
+    _enf(lens is not None and lens in prog.vars,
+         "%s needs sequence (lod_level>=1) input; %s carries no lengths "
+         "companion", what, x.name)
+    return prog.vars[lens]
+
+
+def dynamic_lstm(input: Var, size: int, use_peepholes: bool = True,
+                 is_reverse: bool = False, gate_activation: str = "sigmoid",
+                 cell_activation: str = "tanh",
+                 candidate_activation: str = "tanh",
+                 name: str = "dynamic_lstm"):
+    """reference: layers/nn.py dynamic_lstm — ``input`` is the already
+    x-projected (B, T, 4H) sequence; this layer owns the recurrent weight
+    (H, 4H) and gate bias. Peepholes are subsumed by the gate bias on the
+    masked-scan design (reference peephole weights extend the bias vector;
+    documented deviation). Returns (hidden (B,T,H), cell-final)."""
+    prog = _prog(input)
+    H = size // 4
+    w_hh = prog.create_parameter(prog.unique_name(f"{name}_w"), (H, 4 * H),
+                                 initializer=I.XavierUniform())
+    b = prog.create_parameter(prog.unique_name(f"{name}_b"), (4 * H,),
+                              initializer=I.Constant(0.0))
+    lens = _lens_var(prog, input, "dynamic_lstm")
+
+    def fn(x, w, bias, ln):
+        from ..ops import rnn as RN
+
+        eye = jnp.eye(x.shape[-1], dtype=x.dtype)  # input already projected
+        outs, (h_t, c_t) = RN.lstm(
+            x, eye, w, bias=bias, lengths=ln, is_reverse=is_reverse,
+            gate_activation=gate_activation, cell_activation=cell_activation,
+            candidate_activation=candidate_activation)
+        return outs, c_t
+
+    hidden, cell = prog.apply(fn, [input, w_hh, b, lens], name=name)
+    hidden.lod_src = input.lod_src
+    return hidden, cell
+
+
+def sequence_last_step(input: Var, name: str = "sequence_last_step") -> Var:
+    prog = _prog(input)
+    lens = _lens_var(prog, input, "sequence_last_step")
+
+    def fn(x, ln):
+        idx = jnp.maximum(ln - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+
+    out = prog.apply(fn, [input, lens], name=name)
+    out.lod_src = None
+    return out
+
+
+def sequence_first_step(input: Var, name: str = "sequence_first_step") -> Var:
+    out = _prog(input).apply(lambda x: x[:, 0], [input], name=name)
+    out.lod_src = None
+    return out
+
+
+def sequence_pool(input: Var, pool_type: str = "sum",
+                  name: str = "sequence_pool") -> Var:
+    from ..ops import sequence as SQ
+
+    prog = _prog(input)
+    lens = _lens_var(prog, input, "sequence_pool")
+    out = prog.apply(lambda x, ln: SQ.sequence_pool(x, ln, pool_type),
+                     [input, lens], name=name)
+    out.lod_src = None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static TensorArray (block-DSL state buffers)
+# ---------------------------------------------------------------------------
+# reference: layers/control_flow.py create_array / tensor_array ops +
+# operators/controlflow/tensor_array_read_write_op.cc. The reference grows
+# LoDTensorArrays dynamically; XLA needs static shapes, so the array is a
+# fixed-capacity (cap, ...) buffer var written by dynamic index — writes
+# inside While blocks become loop carry state automatically.
+
+
+class StaticArray:
+    """Handle pairing a Program with a lazily-created buffer var plus a
+    live element count (the buffer itself is capacity-padded — XLA needs
+    static shapes — while ``size`` tracks the highest written index)."""
+
+    def __init__(self, prog: Program, dtype, capacity: int):
+        self.prog = prog
+        self.dtype = dtype
+        self.capacity = capacity
+        self.buffer: Optional[Var] = None
+        self.size: Optional[Var] = None
+
+    def _ensure(self, x: Var) -> Var:
+        if self.buffer is None:
+            cap = self.capacity
+            # shape comes from the seed value AT TRACE TIME so the buffer
+            # stays batch-polymorphic (recorded Var shapes resolve -1
+            # to a placeholder and must not be baked into the zeros)
+            buf = self.prog.apply(
+                lambda v: jnp.zeros((cap,) + v.shape, v.dtype),
+                [x], name="tensor_array")
+            self.buffer = buf
+            self.size = self.prog.apply(
+                lambda: jnp.zeros((), jnp.int32), [], name="array_size")
+        return self.buffer
+
+
+def create_array(dtype="float32", capacity: int = 64) -> StaticArray:
+    from .program import default_main_program
+
+    return StaticArray(default_main_program(), dtype, capacity)
+
+
+def array_write(x: Var, i: Var, array: Optional[StaticArray] = None,
+                capacity: int = 64) -> StaticArray:
+    prog = _prog(x, i)
+    if array is None:
+        array = StaticArray(prog, x.dtype, capacity)
+    buf = array._ensure(x)
+
+    def fn(b, v, idx):
+        return b.at[jnp.reshape(idx, ()).astype(jnp.int32)].set(
+            v.astype(b.dtype))
+
+    out = prog.apply(fn, [buf, x, i], name="array_write")
+    prog.assign(buf, out)
+    new_size = prog.apply(
+        lambda s, idx: jnp.maximum(s, jnp.reshape(idx, ())
+                                   .astype(jnp.int32) + 1),
+        [array.size, i], name="array_size_update")
+    prog.assign(array.size, new_size)
+    return array
+
+
+def array_read(array: StaticArray, i: Var) -> Var:
+    from ..core.enforce import enforce as _enf
+
+    _enf(array.buffer is not None,
+         "array_read before any array_write — the buffer has no shape yet")
+    prog = array.prog
+
+    def fn(b, idx):
+        return jax.lax.dynamic_index_in_dim(
+            b, jnp.reshape(idx, ()).astype(jnp.int32), 0, keepdims=False)
+
+    return prog.apply(fn, [array.buffer, i], name="array_read")
+
+
+def array_length(array: StaticArray) -> Var:
+    """True element count (highest written index + 1), NOT the static
+    capacity — matches the eager array's length semantics."""
+    from ..core.enforce import enforce as _enf
+
+    _enf(array.size is not None,
+         "array_length before any array_write — the array is empty")
+    return array.size
+
+
+def tensor_array_to_tensor(array: StaticArray, axis: int = 0):
+    """Stacked buffer + true element count. The stacked tensor is
+    capacity-padded with zeros past ``n`` (XLA static shapes); slice with
+    ``n`` on the host or mask downstream."""
+    prog = array.prog
+    out = prog.apply(lambda b: jnp.moveaxis(b, 0, axis), [array.buffer],
+                     name="tensor_array_to_tensor")
+    return out, array.size

@@ -1,0 +1,108 @@
+"""Static-graph optimizers: ``minimize`` = append_backward + recorded
+update ops (reference: python/paddle/fluid/optimizer.py:49 —
+minimize = append_backward + _create_optimization_pass; sgd_op.cc,
+adam_op.cc, momentum_op.cc). Accumulators are persistable non-trainable
+vars in the Program, exactly the reference's accumulator mechanism
+(optimizer.py _add_accumulator)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from .. import initializer as I
+from .program import Program, Var, append_backward
+
+
+class Optimizer:
+    def __init__(self, learning_rate: float):
+        self.lr = learning_rate
+
+    def minimize(self, loss: Var,
+                 parameter_list: Optional[Sequence[str]] = None
+                 ) -> List[Tuple[Var, Var]]:
+        prog = loss.program
+        pairs = append_backward(loss, parameter_list)
+        for param, grad in pairs:
+            self._append_update(prog, param, grad)
+        return pairs
+
+    def _append_update(self, prog: Program, param: Var, grad: Var) -> None:
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op.cc."""
+
+    def _append_update(self, prog, param, grad):
+        new_p = prog.apply(lambda p, g: p - self.lr * g, [param, grad],
+                           name=f"sgd_{param.name}")
+        prog.assign(param, new_p)
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op.cc."""
+
+    def __init__(self, learning_rate: float, momentum: float = 0.9,
+                 use_nesterov: bool = False):
+        super().__init__(learning_rate)
+        self.mu = momentum
+        self.nesterov = use_nesterov
+
+    def _append_update(self, prog, param, grad):
+        vel = prog.create_parameter(
+            prog.unique_name(f"{param.name}_velocity"), param.shape,
+            param.dtype, initializer=I.Constant(0.0), trainable=False)
+
+        def fn(p, g, v):
+            v_new = self.mu * v + g
+            if self.nesterov:
+                p_new = p - self.lr * (g + self.mu * v_new)
+            else:
+                p_new = p - self.lr * v_new
+            return p_new, v_new
+
+        p_new, v_new = prog.apply(fn, [param, grad, vel],
+                                  name=f"momentum_{param.name}")
+        prog.assign(param, p_new)
+        prog.assign(vel, v_new)
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op.cc."""
+
+    def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def _append_update(self, prog, param, grad):
+        m = prog.create_parameter(prog.unique_name(f"{param.name}_moment1"),
+                                  param.shape, param.dtype,
+                                  initializer=I.Constant(0.0),
+                                  trainable=False)
+        v = prog.create_parameter(prog.unique_name(f"{param.name}_moment2"),
+                                  param.shape, param.dtype,
+                                  initializer=I.Constant(0.0),
+                                  trainable=False)
+        t = prog.create_parameter(prog.unique_name(f"{param.name}_step"),
+                                  (), jnp.float32,
+                                  initializer=I.Constant(0.0),
+                                  trainable=False)
+
+        def fn(p, g, m, v, t):
+            t_new = t + 1.0
+            m_new = self.b1 * m + (1 - self.b1) * g
+            v_new = self.b2 * v + (1 - self.b2) * g * g
+            m_hat = m_new / (1 - self.b1 ** t_new)
+            v_hat = v_new / (1 - self.b2 ** t_new)
+            p_new = p - self.lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
+            return p_new, m_new, v_new, t_new
+
+        p_new, m_new, v_new, t_new = prog.apply(
+            fn, [param, grad, m, v, t], name=f"adam_{param.name}")
+        prog.assign(param, p_new)
+        prog.assign(m, m_new)
+        prog.assign(v, v_new)
+        prog.assign(t, t_new)

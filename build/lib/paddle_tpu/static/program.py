@@ -1,0 +1,422 @@
+"""Static-graph front end: Program / Var / program_guard.
+
+Capability equivalent of fluid's graph-building core (reference:
+python/paddle/fluid/framework.py — Variable:366, Operator:924,
+Block:1369 append_op:1665, Program:2704, program_guard:3681), re-designed
+for XLA: instead of a protobuf ProgramDesc interpreted op-by-op
+(reference: framework/executor.cc:149), a Program records a DAG of
+**Python-traceable op nodes**; the Executor JIT-compiles any
+(feed, fetch) slice of it into one XLA executable and caches it — the
+per-op interpreter hot loop (reference: framework/operator.cc:881
+RunImpl) becomes a single compiled program.
+
+Autodiff parity: ``append_backward`` (reference: backward.py:394) records
+a grad node that differentiates the traced prefix with ``jax.grad`` —
+the VJP-rule registry plays the role of ``GradOpDescMaker``
+(reference: framework/grad_op_desc_maker.h:36).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from ..core.dtypes import default_dtype
+
+GRAD_SUFFIX = "@GRAD"
+
+# substitute for -1 batch placeholders when abstract-evaluating recorded
+# ops; shape checks that compare placeholder dims must use the same value
+TRACE_BATCH = 8
+
+
+class Var:
+    """Symbolic handle inside a Program (reference: framework.py:366
+    Variable) with math-op patching (reference: layers/math_op_patch.py)."""
+
+    def __init__(self, program: "Program", name: str, shape: Tuple[int, ...],
+                 dtype, *, is_param: bool = False, is_feed: bool = False,
+                 trainable: bool = True):
+        self.program = program
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.is_param = is_param
+        self.is_feed = is_feed
+        self.trainable = trainable
+        # LoD replacement metadata: name of the companion lengths var for
+        # padded (B, T, ...) sequence data (SURVEY §7 ragged
+        # canonicalization); propagated through recorded ops
+        self.lod_src: Optional[str] = None
+        # level-2 nested LoD: companion (B, N) per-sub-sequence lengths
+        self.lod_src2: Optional[str] = None
+
+    # -- math-op patching ---------------------------------------------------
+    def _binop(self, other, fn, opname):
+        # non-Var operands are captured as constants by Program.apply
+        return self.program.apply(fn, [self, other], name=opname)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a, "elementwise_sub")
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b, "elementwise_div")
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda a, b: a @ b, "matmul")
+
+    def __neg__(self):
+        return self.program.apply(lambda a: -a, [self], name="scale")
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b, "elementwise_pow")
+
+    def __repr__(self):
+        kind = "param" if self.is_param else ("feed" if self.is_feed else "var")
+        return f"Var({self.name!r}, {kind}, shape={self.shape}, dtype={self.dtype})"
+
+
+class _OpNode:
+    """One recorded op: pure fn over named inputs → named outputs."""
+
+    __slots__ = ("fn", "inputs", "outputs", "name", "attrs")
+
+    def __init__(self, fn: Callable, inputs: List[str], outputs: List[str],
+                 name: str, attrs: Optional[dict] = None):
+        self.fn = fn
+        self.inputs = inputs
+        self.outputs = outputs
+        self.name = name
+        self.attrs = attrs or {}
+
+
+class _GradNode:
+    """Backward marker (append_backward): differentiates the prefix program
+    ending at `loss_name` w.r.t. `param_names`, emitting `<p>@GRAD` vars."""
+
+    __slots__ = ("prefix_len", "loss_name", "param_names", "outputs", "name")
+
+    def __init__(self, prefix_len: int, loss_name: str,
+                 param_names: List[str]):
+        self.prefix_len = prefix_len
+        self.loss_name = loss_name
+        self.param_names = param_names
+        self.outputs = [p + GRAD_SUFFIX for p in param_names]
+        self.name = "grad"
+
+
+class Program:
+    """Recorded op DAG (reference: framework.py:2704 Program). ``version``
+    bumps on every mutation — part of the Executor's compile-cache key."""
+
+    def __init__(self):
+        self.nodes: List[Any] = []
+        self.vars: Dict[str, Var] = {}
+        self.param_inits: Dict[str, Tuple[Callable, Tuple[int, ...], Any]] = {}
+        self.version = 0
+        self._name_counter = 0
+
+    # -- fluid block API (reference framework.py Program.block:2704ff).
+    # This Program is single-block by design: nesting lives inside traced
+    # functions (lax.cond/scan sub-traces), not desc sub-blocks — so the
+    # Program IS its global block.
+    def global_block(self):
+        return self
+
+    def current_block(self):
+        return self
+
+    def block(self, index: int = 0):
+        return self
+
+    def var(self, name: str) -> Var:
+        """reference: framework.py Block.var — name lookup with a typed
+        error."""
+        enforce(name in self.vars, "program has no var %s", name)
+        return self.vars[name]
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def to_string(self, throw_on_error: bool = False, with_details=False):
+        return repr(self)
+
+    @staticmethod
+    def parse_from_string(binary_str):
+        from ..core.enforce import EnforceError
+
+        raise EnforceError(
+            "the serialized program format is the StableHLO artifact — "
+            "load with static.io.load_inference_model / the C++ predictor "
+            "(SURVEY §7: ProgramDesc → serialized HLO + metadata)")
+
+    # -- naming -------------------------------------------------------------
+    def unique_name(self, stem: str) -> str:
+        self._name_counter += 1
+        prefix = getattr(self, "_name_prefix", "")
+        return f"{prefix}{stem}_{self._name_counter}"
+
+    # -- graph building -----------------------------------------------------
+    def data(self, name: str, shape: Sequence[int], dtype=None,
+             lod_level: int = 0) -> Var:
+        """Feed placeholder (reference: layers/io.py data). Leading -1 means
+        batch-polymorphic (resolved per-run; distinct sizes recompile).
+
+        ``lod_level >= 1`` declares variable-length sequence data: the var
+        becomes padded ``(-1, -1, *shape)`` (a trailing ``[1]`` elem shape
+        collapses, matching the reference's per-token scalars) and a
+        companion ``<name>@LEN`` int32 feed var carries the row lengths —
+        the LoD-offsets replacement (reference: framework/lod_tensor.h:110;
+        DataFeeder pads ragged batches and fills both)."""
+        dtype = dtype or default_dtype()
+        enforce(name not in self.vars, "var %s already exists", name)
+        if lod_level >= 2:
+            # nested LoD (reference: framework/lod_tensor.h:229 level-2
+            # offsets — e.g. per-source candidate lists): padded
+            # (B, N, T, *elem) with TWO companions — <name>@LEN (B,) =
+            # sub-sequence count per sample, <name>@LEN2 (B, N) =
+            # token count per sub-sequence (0-padded)
+            enforce(lod_level == 2,
+                    "lod_level > 2 is not supported (the reference book "
+                    "models use at most level-2 results)")
+            elem = tuple(d for d in shape if d != -1)
+            if elem and elem[-1] == 1:
+                elem = elem[:-1]
+            v = Var(self, name, (-1, -1, -1) + elem, dtype, is_feed=True)
+            lv = Var(self, name + "@LEN", (-1,), jnp.int32, is_feed=True)
+            lv2 = Var(self, name + "@LEN2", (-1, -1), jnp.int32,
+                      is_feed=True)
+            self.vars[name + "@LEN"] = lv
+            self.vars[name + "@LEN2"] = lv2
+            v.lod_src = lv.name
+            v.lod_src2 = lv2.name
+        elif lod_level == 1:
+            elem = tuple(d for d in shape if d != -1)  # -1 = old-style
+            # batch placeholder; per-token scalars declare shape [1]
+            if elem and elem[-1] == 1:
+                elem = elem[:-1]
+            v = Var(self, name, (-1, -1) + elem, dtype, is_feed=True)
+            lv = Var(self, name + "@LEN", (-1,), jnp.int32, is_feed=True)
+            self.vars[name + "@LEN"] = lv
+            v.lod_src = lv.name
+        else:
+            v = Var(self, name, tuple(shape), dtype, is_feed=True)
+        self.vars[name] = v
+        self.version += 1
+        return v
+
+    def create_parameter(self, name: str, shape: Sequence[int], dtype=None,
+                         initializer: Optional[Callable] = None,
+                         trainable: bool = True) -> Var:
+        """Trainable parameter; its initializer becomes part of the startup
+        program (reference: framework.py:3476 Parameter + initializer.py
+        ops emitted into the startup program). ``trainable=False`` makes a
+        persistable state var (optimizer accumulators, step counters)."""
+        from ..initializer import XavierUniform
+
+        dtype = dtype or default_dtype()
+        enforce(name not in self.vars, "var %s already exists", name)
+        v = Var(self, name, tuple(shape), dtype, is_param=True,
+                trainable=trainable)
+        self.vars[name] = v
+        self.param_inits[name] = (initializer or XavierUniform(),
+                                  tuple(shape), dtype)
+        self.version += 1
+        return v
+
+    def apply(self, fn: Callable, inputs: Sequence[Any], *,
+              name: str = "op", attrs: Optional[dict] = None,
+              eval_fn: Optional[Callable] = None):
+        """Record `fn(*inputs)` as an op node. Non-Var inputs are captured
+        as constants (their values live in ``_const_values`` and are fed to
+        the executor env). Output arity/shapes/dtypes come from abstract
+        eval of ``fn``. ``eval_fn``, if given, is the inference-mode variant
+        (same signature and output arity) substituted by
+        ``clone(for_test=True)`` — the reference's is_test attribute on ops
+        like batch_norm/dropout (reference: framework.py clone semantics)."""
+        if eval_fn is not None:
+            attrs = dict(attrs or {}, eval_fn=eval_fn)
+        in_names, consts = [], {}
+        for x in inputs:
+            if isinstance(x, Var):
+                enforce(x.program is self,
+                        "input %s belongs to another Program", x.name)
+                in_names.append(x.name)
+            else:
+                cname = self.unique_name(f"const_{name}")
+                consts[cname] = x
+                in_names.append(cname)
+
+        # abstract-eval output specs
+        import jax
+
+        in_specs = []
+        for n in in_names:
+            if n in consts:
+                arr = jnp.asarray(consts[n])
+                self.vars[n] = Var(self, n, arr.shape, arr.dtype)
+                self._const_values = getattr(self, "_const_values", {})
+                self._const_values[n] = arr
+                in_specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+            else:
+                v = self.vars[n]
+                shape = tuple(TRACE_BATCH if d == -1 else d
+                              for d in v.shape)
+                in_specs.append(jax.ShapeDtypeStruct(shape, v.dtype))
+        try:
+            out_specs = jax.eval_shape(fn, *in_specs)
+        except Exception as e:  # pragma: no cover - surfacing build errors
+            raise type(e)(f"while recording op {name!r}: {e}") from e
+        flat = out_specs if isinstance(out_specs, tuple) else (out_specs,)
+
+        # sequence metadata rides along: outputs inherit the first
+        # lod-carrying input's lengths companion (row-preserving ops keep
+        # ragged structure; consumers that reduce it clear lod_src)
+        lod_carrier = next((self.vars[n] for n in in_names
+                            if n in self.vars and
+                            getattr(self.vars[n], "lod_src", None)), None)
+        lod_src = lod_carrier.lod_src if lod_carrier is not None else None
+        lod_src2 = (getattr(lod_carrier, "lod_src2", None)
+                    if lod_carrier is not None else None)
+        out_vars = []
+        for spec in flat:
+            oname = self.unique_name(name)
+            shape = tuple(spec.shape)
+            # keep batch polymorphism: if any feed had -1 leading, outputs
+            # keep their traced shape (informational only)
+            ov = Var(self, oname, shape, spec.dtype)
+            ov.lod_src = lod_src
+            ov.lod_src2 = lod_src2
+            self.vars[oname] = ov
+            out_vars.append(ov)
+        self.nodes.append(_OpNode(fn, in_names, [v.name for v in out_vars],
+                                  name, attrs))
+        self.version += 1
+        return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+    def assign(self, target: Var, value: Var) -> None:
+        """Record an in-place update of `target` (optimizer writes —
+        reference: optimizer ops mutating their Param input). The executor
+        threads the new value to subsequent reads and back to the scope."""
+        self.nodes.append(_OpNode(lambda v: v, [value.name], [target.name],
+                                  "assign"))
+        self.version += 1
+
+    def param_names(self) -> List[str]:
+        """Trainable params only (grad targets)."""
+        return [n for n, v in self.vars.items()
+                if v.is_param and v.trainable]
+
+    def persistable_names(self) -> List[str]:
+        """Everything scope-backed: params + optimizer state (reference:
+        io.py save_persistables semantics)."""
+        return [n for n, v in self.vars.items() if v.is_param]
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Snapshot (reference: Program.clone framework.py) — shares no
+        mutable state with the original. ``for_test=True`` drops the
+        backward marker and everything after it (grad + optimizer ops),
+        the reference's inference-clone semantics."""
+        p = Program()
+        nodes = self.nodes
+        if for_test:
+            cut = next((i for i, n in enumerate(nodes)
+                        if isinstance(n, _GradNode)), len(nodes))
+            # swap train-mode ops for their inference variants (batch_norm
+            # uses running stats, dropout becomes identity)
+            nodes = [
+                _OpNode(n.attrs["eval_fn"], n.inputs, n.outputs, n.name,
+                        n.attrs)
+                if isinstance(n, _OpNode) and "eval_fn" in n.attrs else n
+                for n in nodes[:cut]
+            ]
+        p.nodes = list(nodes)
+        p.vars = {}
+        for k, v in self.vars.items():
+            nv = Var(p, v.name, v.shape, v.dtype, is_param=v.is_param,
+                     is_feed=v.is_feed, trainable=v.trainable)
+            nv.lod_src = v.lod_src
+            nv.lod_src2 = v.lod_src2
+            p.vars[k] = nv
+        p.param_inits = dict(self.param_inits)
+        p._const_values = dict(getattr(self, "_const_values", {}))
+        p.version = self.version
+        p._name_counter = self._name_counter
+        return p
+
+    def __repr__(self):
+        ops = ", ".join(n.name for n in self.nodes[:8])
+        return (f"Program({len(self.nodes)} ops [{ops}...], "
+                f"{len(self.param_inits)} params)")
+
+
+# ---------------------------------------------------------------------------
+# default program + guard (reference: framework.py:3681 program_guard)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def default_main_program() -> Program:
+    if not hasattr(_tls, "main"):
+        _tls.main = Program()
+    return _tls.main
+
+
+def is_building() -> bool:
+    """True inside ``program_guard`` — layers with no Var inputs (e.g.
+    fill_constant) use this to record onto the Program instead of
+    returning an eager array."""
+    return getattr(_tls, "building", 0) > 0
+
+
+@contextlib.contextmanager
+def program_guard(main: Program):
+    prev = getattr(_tls, "main", None)
+    _tls.main = main
+    _tls.building = getattr(_tls, "building", 0) + 1
+    try:
+        yield main
+    finally:
+        _tls.building -= 1
+        if prev is None:
+            del _tls.main
+        else:
+            _tls.main = prev
+
+
+def append_backward(loss: Var, parameter_list: Optional[Sequence[str]] = None
+                    ) -> List[Tuple[Var, Var]]:
+    """reference: backward.py:394 — record grad vars for every trainable
+    param reachable in the prefix; returns [(param, grad)] pairs."""
+    prog = loss.program
+    params = list(parameter_list or prog.param_names())
+    enforce(params, "append_backward: program has no parameters")
+    node = _GradNode(len(prog.nodes), loss.name, params)
+    prog.nodes.append(node)
+    pairs = []
+    for p in params:
+        gv = Var(prog, p + GRAD_SUFFIX, prog.vars[p].shape,
+                 prog.vars[p].dtype)
+        prog.vars[gv.name] = gv
+        pairs.append((prog.vars[p], gv))
+    prog.version += 1
+    return pairs

@@ -1,0 +1,245 @@
+"""Resumable training loop with failure detection — the elastic-recovery
+design-add (SURVEY §5.3: the reference has NO elasticity — a lost trainer
+hangs the sync barrier; graceful exit + checkpoint-notify was its whole
+story. The TPU-native answer is a re-startable jitted step + frequent async
+sharded checkpoints + a watchdog: any process can die and rejoin by
+restarting the loop, which auto-resumes from the latest checkpoint).
+
+Also covers: FLAGS_check_nan_inf parity (reference: framework/operator.cc
+output checking) as a loss/grad guard with skip-or-raise policy, and
+Executor::Close-style graceful shutdown (join async checkpoint writers).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+from .checkpoint import CheckpointManager
+from .core.config import FLAGS
+from .core.enforce import EnforceError, enforce
+
+
+class NanInfError(EnforceError):
+    """Raised when the nan/inf guard trips with policy='raise'."""
+
+
+class Watchdog:
+    """Step-progress watchdog: fires ``on_stall`` (default: print) if no
+    heartbeat arrives within ``timeout_s``. The failure-detection role of
+    the reference's rpc_deadline — but for compute progress, not RPC."""
+
+    def __init__(self, timeout_s: float = 600.0,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 poll_s: Optional[float] = None):
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall or (lambda age: print(
+            f"[watchdog] no training progress for {age:.0f}s"))
+        self._poll_s = poll_s if poll_s is not None else min(timeout_s / 4,
+                                                             30.0)
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last_beat = time.monotonic()
+        self._fired = False
+
+    def _run(self):
+        while not self._stop.wait(self._poll_s):
+            age = time.monotonic() - self._last_beat
+            if age > self.timeout_s and not self._fired:
+                self._fired = True  # fire once per stall
+                self.on_stall(age)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def stalled(self) -> bool:
+        return self._fired
+
+
+class TrainLoop:
+    """Drive a Trainer over a data stream with auto-resume.
+
+    - resume: restores the latest checkpoint before the first step
+    - checkpoint_every: periodic async sharded snapshot (params + opt state
+      + rng), retention-GC'd by the manager
+    - nan guard: FLAGS check_nan_inf equivalent; policy 'skip' drops the
+      step's update by restoring the last checkpointed state, 'raise'
+      raises NanInfError (both report the step)
+    - watchdog: stall detection while the loop runs
+    """
+
+    def __init__(self, trainer, checkpoint_dir: str,
+                 checkpoint_every: int = 1000, max_to_keep: int = 5,
+                 nan_policy: str = "raise",
+                 watchdog_timeout_s: Optional[float] = None,
+                 on_stall: Optional[Callable] = None,
+                 max_recoveries: int = 0,
+                 recoverable: tuple = (RuntimeError, OSError)):
+        enforce(nan_policy in ("raise", "skip", "off"),
+                "nan_policy must be raise|skip|off, got %s", nan_policy)
+        self.trainer = trainer
+        self.manager = CheckpointManager(checkpoint_dir,
+                                         max_to_keep=max_to_keep)
+        self.checkpoint_every = checkpoint_every
+        self.nan_policy = nan_policy
+        self.step = 0
+        self._watchdog = (Watchdog(watchdog_timeout_s, on_stall)
+                          if watchdog_timeout_s else None)
+        # elastic recovery (the SURVEY §5.3 design-add beyond the
+        # reference's none): a step failing with a ``recoverable`` error
+        # (XLA device/runtime faults surface as RuntimeError) rolls the
+        # trainer back to the latest snapshot and continues, at most
+        # ``max_recoveries`` times per run() call. Deterministic errors
+        # (EnforceError and other RuntimeError subclasses that mean
+        # "bug", not "fault") always propagate.
+        enforce(max_recoveries >= 0, "max_recoveries must be >= 0")
+        self.max_recoveries = max_recoveries
+        self.recoverable = tuple(recoverable)
+        self._recoveries_this_run = 0
+        self._faulted = False
+        self.history: Dict[str, Any] = {"resumed_from": None,
+                                        "skipped_steps": [],
+                                        "recoveries": []}
+
+    def _is_recoverable(self, e: BaseException) -> bool:
+        if isinstance(e, (EnforceError, NotImplementedError,
+                          RecursionError)):
+            return False  # deterministic bug/config errors, not faults
+        return isinstance(e, self.recoverable)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def maybe_resume(self) -> Optional[int]:
+        latest = self.manager.latest_step()
+        if latest is not None:
+            self.trainer.restore_checkpoint(self.manager, latest)
+            self.step = latest
+            self.history["resumed_from"] = latest
+        return latest
+
+    def _guard(self, loss) -> bool:
+        """True if the step is clean; handles policy when not."""
+        if self.nan_policy == "off" and not FLAGS.get("check_nan_inf"):
+            return True
+        if bool(np.isfinite(np.asarray(loss))):
+            return True
+        if self.nan_policy == "raise":
+            raise NanInfError(
+                f"non-finite loss at step {self.step}: {loss}")
+        self.history["skipped_steps"].append(self.step)
+        latest = self.manager.latest_step()
+        if latest is not None:
+            # roll back to the last good snapshot (the skip would otherwise
+            # keep poisoned optimizer moments)
+            self.trainer.restore_checkpoint(self.manager, latest)
+        return False
+
+    def run(self, batches: Iterable, num_steps: Optional[int] = None,
+            resume: bool = True,
+            on_step: Optional[Callable[[int, Any, Dict], None]] = None):
+        """Train until ``num_steps`` (global, including resumed) or data
+        exhaustion. Returns the final step count — which can end below
+        ``num_steps`` after an elastic recovery, since the data stream
+        is not replayable (see history["recoveries"])."""
+        if resume:
+            self.maybe_resume()
+        self._recoveries_this_run = 0
+        self._faulted = False
+        if self._watchdog:
+            self._watchdog.start()
+        try:
+            for batch in batches:
+                if num_steps is not None and self.step >= num_steps:
+                    break
+                try:
+                    loss, metrics = self.trainer.train_step(batch)
+                except Exception as e:
+                    if not self._is_recoverable(e) or \
+                            self._recoveries_this_run >= \
+                            self.max_recoveries:
+                        self._faulted = True
+                        raise
+                    # an in-flight async snapshot may be newer than the
+                    # last fully-renamed one — don't over-rewind
+                    self.manager.wait_until_finished()
+                    latest = self.manager.latest_step()
+                    if latest is None:
+                        # nothing to roll back to: with donated buffers
+                        # the failed dispatch may have consumed the live
+                        # state, so continuing would be undefined
+                        self._faulted = True
+                        raise
+                    # slice-failure recovery: roll back to the latest
+                    # snapshot and keep training (any process can do the
+                    # same and rejoin — restartable-step elasticity).
+                    # NOTE: the data stream is not rewound — batches
+                    # consumed between the snapshot and the fault are
+                    # skipped, so run() may end below num_steps.
+                    self._recoveries_this_run += 1
+                    self.history["recoveries"].append(
+                        {"step": self.step, "rolled_back_to": latest,
+                         "error": repr(e)})
+                    self.trainer.restore_checkpoint(self.manager, latest)
+                    self.step = latest
+                    continue
+                if not self._guard(loss):
+                    continue
+                self.step += 1
+                if self._watchdog:
+                    self._watchdog.beat()
+                if on_step is not None:
+                    on_step(self.step, loss, metrics)
+                if self.checkpoint_every and \
+                        self.step % self.checkpoint_every == 0:
+                    self.manager.save(self.step, self.trainer.state())
+        finally:
+            self.close()
+        return self.step
+
+    def close(self):
+        """Graceful shutdown (Executor::Close parity, reference:
+        framework/executor.cc:73): final snapshot + join async writers."""
+        if self._watchdog:
+            self._watchdog.stop()
+        # join in-flight writes FIRST so all_steps() sees them — otherwise
+        # a still-writing periodic snapshot of this same step would race
+        # the final one on the shared .tmp staging dir. An earlier write's
+        # failure must NOT abort the final snapshot (durability first):
+        # defer it and re-raise after the final save attempt.
+        deferred: Optional[BaseException] = None
+        try:
+            self.manager.wait_until_finished()
+        except BaseException as e:
+            deferred = e
+        # never snapshot post-fault state: after an unrecovered device
+        # fault the live buffers may be invalid (donation) or poisoned —
+        # the next run resumes from the last GOOD checkpoint instead
+        if self.step > 0 and not self._faulted and \
+                self.step not in self.manager.all_steps():
+            self.manager.save(self.step, self.trainer.state())
+        self.manager.wait_until_finished()
+        if deferred is not None:
+            import sys
+
+            if sys.exc_info()[0] is None:
+                raise deferred
+            # close() ran from an exception's finally — don't mask the
+            # original training error with the old write failure
+            print(f"[train_loop] deferred checkpoint-write failure: "
+                  f"{deferred!r}", file=sys.stderr)

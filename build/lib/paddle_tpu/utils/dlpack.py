@@ -1,0 +1,37 @@
+"""DLPack interop (reference: paddle/fluid/framework/dlpack_tensor.cc —
+zero-copy tensor exchange with other frameworks).
+
+JAX speaks DLPack natively; these helpers mirror the reference's surface
+and cover the torch round-trip used by data pipelines."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+
+
+def to_dlpack(x):
+    """Array → DLPack capsule (reference: DLPackTensor ctor)."""
+    return jax.dlpack.to_dlpack(jnp.asarray(x))
+
+
+def from_dlpack(capsule_or_tensor):
+    """DLPack capsule or any __dlpack__ object (torch tensor, numpy array,
+    cupy...) → jax Array (reference: framework dlpack→Tensor path)."""
+    return jax.dlpack.from_dlpack(capsule_or_tensor)
+
+
+def from_torch(t):
+    """torch.Tensor → jax Array without a host copy when devices allow."""
+    enforce(hasattr(t, "__dlpack__"), "expected a torch tensor, got %s",
+            type(t).__name__)
+    return jax.dlpack.from_dlpack(t)
+
+
+def to_torch(x):
+    """jax Array → torch.Tensor via DLPack."""
+    import torch
+
+    return torch.from_dlpack(jnp.asarray(x))

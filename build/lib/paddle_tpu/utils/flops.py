@@ -1,0 +1,138 @@
+"""FLOPs accounting + MFU (model-FLOPs utilization) reporting.
+
+The reference's benchmark harness reports only examples/sec
+(reference: benchmark/fluid/fluid_benchmark.py:296-300); a TPU-native
+framework must also say how much of the chip those examples used. MFU =
+(model FLOPs executed per second) / (peak chip FLOP/s). Model FLOPs come
+from XLA's own cost model over the *lowered* (pre-backend-optimization)
+module — this counts the math the program asks for (fwd+bwd+optimizer),
+not remat duplicates, so it is the MFU numerator rather than an HFU one.
+
+Peak numbers are per-chip dense peak for the dtype actually feeding the
+MXU. Override with ``PT_PEAK_FLOPS`` (absolute FLOP/s) when running on a
+device kind not in the table.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+# Dense peak FLOP/s per chip by device kind substring (lowercased match).
+# bf16 column is the MXU peak; int8 is 2x on v5e-class chips.
+_PEAK_BF16 = {
+    "v6e": 918e12,     # Trillium
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def device_peak_flops(device: Optional[Any] = None,
+                      dtype: str = "bf16") -> Optional[float]:
+    """Peak FLOP/s for ``device`` (default: first jax device). Returns
+    None when unknown (e.g. CPU) — callers should then omit MFU rather
+    than report a bogus one."""
+    env = os.environ.get("PT_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass  # malformed override: fall back to the table
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    platform = getattr(device, "platform", "")
+    if platform == "cpu":
+        return None
+    # axon tunnels advertise the generation via env rather than kind
+    if not any(k in kind for k in _PEAK_BF16):
+        kind = os.environ.get("PALLAS_AXON_TPU_GEN", kind).lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            # bf16 peak is the denominator for float runs too: JAX's
+            # default matmul precision on TPU feeds the MXU bf16 inputs
+            # even for fp32 arrays, so the bf16 peak IS the hardware
+            # ceiling of the emitted program. int8 doubles it.
+            scale = {"int8": 2.0}.get(dtype, 1.0)
+            return peak * scale
+    return None
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a repo-local dir so
+    slow first compiles amortize across bench/tune processes (and across
+    wedged-tunnel retries). ``PT_COMPILE_CACHE=0`` disables; unwritable
+    paths degrade silently to no cache. Returns the dir in use or None."""
+    path = path or os.environ.get(
+        "PT_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache"))
+    if not path or path == "0":
+        return None
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        return path
+    except OSError:
+        return None
+
+
+def lowered_flops(jitted_fn, *args, n_partitions: int = 1,
+                  **kwargs) -> Optional[float]:
+    """GLOBAL FLOPs of one dispatch of ``jitted_fn(*args)`` per XLA's
+    cost model.
+
+    Prefers the *lowered* (pre-backend-optimization, pre-partitioning)
+    module — the true MFU numerator, already global. Some PJRT plugins
+    (the axon TPU tunnel among them) return None there; then fall back
+    to the *compiled* executable's analysis, which counts
+    post-optimization, post-SPMD-partitioning FLOPs — a PER-DEVICE,
+    HFU-flavoured number (remat duplicates included, eliminated math
+    excluded) — scaled back to global by ``n_partitions`` (the mesh size
+    the program spans; collective overhead makes this a mild
+    overestimate of model FLOPs). The fallback costs an AOT compile;
+    enable_compile_cache() makes the jit dispatch right after reuse it.
+    Returns None when neither side is available — never raises."""
+    try:
+        lowered = jitted_fn.lower(*args, **kwargs)
+    except Exception:
+        return None
+    for analyze, scale in ((lowered.cost_analysis, 1.0),
+                           (lambda: lowered.compile().cost_analysis(),
+                            float(max(1, n_partitions)))):
+        try:
+            analysis = analyze()
+            if isinstance(analysis, (list, tuple)):  # one entry per program
+                analysis = analysis[0] if analysis else None
+            if not analysis:
+                continue
+            flops = analysis.get("flops")
+            if flops and flops > 0:
+                return float(flops) * scale
+        except Exception:
+            continue
+    return None
+
+
+def mfu(flops_per_sec: Optional[float], device: Optional[Any] = None,
+        dtype: str = "bf16", n_devices: int = 1) -> Optional[float]:
+    """Model-FLOPs utilization in [0, 1], or None when either side is
+    unknown. ``flops_per_sec`` is the GLOBAL program rate (XLA lowers the
+    pre-partitioning module), so the peak scales by ``n_devices`` when
+    the program spans a mesh."""
+    if not flops_per_sec:
+        return None
+    peak = device_peak_flops(device, dtype=dtype)
+    if not peak:
+        return None
+    return flops_per_sec / (peak * max(1, n_devices))

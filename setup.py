@@ -23,7 +23,7 @@ class BuildWithNative(build_py):
 
 setup(
     name="paddle_tpu",
-    version="0.2.0",
+    version="0.3.0",
     description="TPU-native rebuild of the PaddlePaddle Fluid capability "
                 "surface on JAX/XLA/Pallas",
     packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
