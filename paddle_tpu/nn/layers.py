@@ -455,10 +455,6 @@ class MultiHeadAttention(Layer):
         v = self.v_proj(value).reshape(b, tk, h_kv, hd)
 
         if self.seq_parallel is not None:
-            enforce(window is None,
-                    "seq_parallel=%s does not support sliding-window "
-                    "attention yet (it would be silently ignored)",
-                    self.seq_parallel)
             # key-padding masks ((B, Tk) or (B, 1, 1, Tk)) ride the SP
             # paths (ring rotates the mask block with its K/V; Ulysses
             # all-gathers it); anything per-head/per-query is an explicit
@@ -487,7 +483,8 @@ class MultiHeadAttention(Layer):
                   if self.seq_parallel == "ulysses" else {})
             out = context_parallel_attention(
                 q, k, v, impl=self.seq_parallel, causal=causal,
-                kv_mask=kv_mask, segment_ids=segment_ids, **kw)
+                kv_mask=kv_mask, segment_ids=segment_ids, window=window,
+                **kw)
         else:
             from ..ops.attention import scaled_dot_product_attention
 

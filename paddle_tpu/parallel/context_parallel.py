@@ -70,25 +70,32 @@ def _shard_with_optional(inner, mesh, spec, mspec, q, k, v, kv_mask,
 
 
 def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, qseg, ksegc, src,
-                       my_idx, *, t_local, causal, scale):
+                       my_idx, *, t_local, causal, window, scale):
     """One ring step's flash-style accumulation (no collectives; wrapped in
     jax.checkpoint by the caller so backward recomputes the (t×t) scores).
     ``kmc``: the K/V block's key-padding keep-mask (b, t_local) rotating
     around the ring with it, or None. ``qseg``/``ksegc``: packed-batch
     segment ids — q side fixed to this shard, kv side rotating with its
-    block; attention stays within a segment."""
+    block; attention stays within a segment. ``window``: sliding-window
+    band in GLOBAL positions."""
     # q/k stay in their native dtype (bf16 in production): bf16 inputs
     # with an f32 preferred_element_type run at the full MXU rate, while
     # a pre-cast to f32 would drop to the fp32 matmul rate (4-8x slower
     # on v5e) with no accumulator benefit
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc,
                    preferred_element_type=jnp.float32) * scale
-    if causal:
+    if causal or window is not None:
         rows = my_idx * t_local + lax.broadcasted_iota(
             jnp.int32, (t_local, t_local), 0)
         cols = src * t_local + lax.broadcasted_iota(
             jnp.int32, (t_local, t_local), 1)
-        s = jnp.where(rows >= cols, s, _NEG_INF)
+        if causal:
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        if window is not None:
+            band = rows - cols < window
+            if not causal:
+                band &= cols - rows < window
+            s = jnp.where(band, s, _NEG_INF)
     if kmc is not None:
         s = jnp.where(kmc[:, None, None, :], s, _NEG_INF)
     if qseg is not None:
@@ -97,28 +104,42 @@ def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, qseg, ksegc, src,
     m_cur = jnp.max(s, axis=-1, keepdims=True)          # (b,h,t,1)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
-    if kmc is not None or qseg is not None:
+    if kmc is not None or qseg is not None or window is not None:
         # a fully-masked row keeps m_new == _NEG_INF, turning the masked
         # exp(s - m_new) into exp(0) = 1; zero those entries so l stays 0
         # and the final o is 0 (causal alone can't fully mask a row —
-        # the diagonal is always visible)
+        # the diagonal is always visible; a window CAN fully mask a row
+        # of an off-diagonal step block)
         p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
     alpha = jnp.exp(m - m_new)
     l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
                     preferred_element_type=jnp.float32)
     acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv     # (b,t,h,d)
-    if causal:
-        # K/V block strictly in this Q block's future: contributes nothing.
-        # (s is all _NEG_INF there; keeping old carries avoids exp(0)=1 rows.)
-        valid = src <= my_idx
-        acc_new = jnp.where(valid, acc_new, acc)
-        m_new = jnp.where(valid, m_new, m)
-        l_new = jnp.where(valid, l_new, l)
     return acc_new, m_new, l_new
 
 
-def _ring_inner(q, k, v, km, seg, *, axis, causal, scale, n):
+def _ring_step_gate(src, my_idx, *, t_local, causal, window):
+    """Scalar: does this ring step's K/V block contribute at all? False
+    for strictly-future blocks (causal) and blocks wholly outside the
+    window band — the caller lax.cond's the WHOLE step compute away
+    (einsum + softmax + PV), which is what makes causal ring O(T^2/2)
+    and windowed ring O(T*W) per device instead of dense cost."""
+    gate = jnp.bool_(True)
+    if causal:
+        gate &= src <= my_idx
+    if window is not None:
+        # overlap between [src*t, src*t+t-1] cols and the band of
+        # [my*t, my*t+t-1] rows
+        lo_ok = (src + 1) * t_local - 1 >= my_idx * t_local - (window - 1)
+        in_band = lo_ok if causal else (
+            lo_ok & (src * t_local <= (my_idx + 1) * t_local - 1
+                     + (window - 1)))
+        gate &= in_band
+    return gate
+
+
+def _ring_inner(q, k, v, km, seg, *, axis, causal, window, scale, n):
     b, t, h, d = q.shape  # local (sequence-sharded) shapes
     has_mask = km is not None
     has_segs = seg is not None
@@ -126,15 +147,23 @@ def _ring_inner(q, k, v, km, seg, *, axis, causal, scale, n):
     perm = [(i, (i + 1) % n) for i in range(n)]
     qf = q  # native dtype into the MXU (see _ring_step_compute note)
     compute = jax.checkpoint(functools.partial(
-        _ring_step_compute, t_local=t, causal=causal, scale=scale))
+        _ring_step_compute, t_local=t, causal=causal, window=window,
+        scale=scale))
 
     def step(carry, t_step):
         acc, m, l, kc, vc, kmc, ksegc = carry
         src = (my_idx - t_step) % n  # origin rank of the K/V block we hold
-        acc, m, l = compute(qf, acc, m, l, kc, vc,
-                            kmc if has_mask else None,
-                            seg if has_segs else None,
-                            ksegc if has_segs else None, src, my_idx)
+        gate = _ring_step_gate(src, my_idx, t_local=t, causal=causal,
+                               window=window)
+        acc, m, l = lax.cond(
+            gate,
+            lambda a, mm, ll, kcc, vcc: compute(
+                qf, a, mm, ll, kcc, vcc,
+                kmc if has_mask else None,
+                seg if has_segs else None,
+                ksegc if has_segs else None, src, my_idx),
+            lambda a, mm, ll, kcc, vcc: (a, mm, ll),
+            acc, m, l, kc, vc)
         kc = lax.ppermute(kc, axis, perm)
         vc = lax.ppermute(vc, axis, perm)
         if has_mask:  # the keep-mask block travels with its K/V block
@@ -155,11 +184,17 @@ def _ring_inner(q, k, v, km, seg, *, axis, causal, scale, n):
     # never hits the ICI ring
     (acc, m, l, kc, vc, kmc, ksegc), _ = lax.scan(
         step, (acc0, m0, l0, k, v, km0, seg0), jnp.arange(n - 1))
-    acc, _, l = compute(qf, acc, m, l, kc, vc,
-                        kmc if has_mask else None,
-                        seg if has_segs else None,
-                        ksegc if has_segs else None,
-                        (my_idx - (n - 1)) % n, my_idx)
+    last_src = (my_idx - (n - 1)) % n
+    acc, m, l = lax.cond(
+        _ring_step_gate(last_src, my_idx, t_local=t, causal=causal,
+                        window=window),
+        lambda a, mm, ll, kcc, vcc: compute(
+            qf, a, mm, ll, kcc, vcc,
+            kmc if has_mask else None,
+            seg if has_segs else None,
+            ksegc if has_segs else None, last_src, my_idx),
+        lambda a, mm, ll, kcc, vcc: (a, mm, ll),
+        acc, m, l, kc, vc)
     o = acc / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-37)
     return o.astype(q.dtype)
 
@@ -167,7 +202,8 @@ def _ring_inner(q, k, v, km, seg, *, axis, causal, scale, n):
 def ring_attention(q, k, v, *, causal: bool = False,
                    scale: Optional[float] = None, axis: str = "sp",
                    batch_axis: Optional[str] = "dp", mesh=None,
-                   kv_mask=None, segment_ids=None):
+                   kv_mask=None, segment_ids=None,
+                   window: Optional[int] = None):
     """Sequence-parallel attention over global (B, T, H, D) arrays.
 
     ``q``/``k``/``v`` are sharded ``P(batch_axis, axis)`` over the mesh; T must
@@ -176,7 +212,9 @@ def ring_attention(q, k, v, *, causal: bool = False,
     key-padding form); its blocks rotate around the ring with their K/V.
     ``segment_ids``: optional global (B, T) packed-batch ids (ids global
     per row, so a segment spanning a shard boundary keeps one id); the
-    kv-side ids rotate with their block.
+    kv-side ids rotate with their block. ``window``: sliding-window band
+    in GLOBAL positions (ring steps wholly outside the band keep their
+    carries untouched).
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
@@ -189,12 +227,14 @@ def ring_attention(q, k, v, *, causal: bool = False,
             enforce(arr.shape == (b, t),
                     "%s must be (batch, seq) = (%s, %s), got %s",
                     name, b, t, arr.shape)
+    enforce(window is None or window >= 1,
+            "window must be >= 1, got %s", window)
     if scale is None:
         scale = d ** -0.5
     spec = P(batch_axis, axis, None, None)
     mspec = P(batch_axis, axis)
     inner = functools.partial(_ring_inner, axis=axis, causal=causal,
-                              scale=float(scale), n=n)
+                              window=window, scale=float(scale), n=n)
     return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
                                 kv_mask, segment_ids)
 
@@ -204,7 +244,8 @@ def ring_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 
-def _ulysses_inner(q, k, v, km, seg, *, axis, causal, scale, use_flash):
+def _ulysses_inner(q, k, v, km, seg, *, axis, causal, window, scale,
+                   use_flash):
     from ..ops.attention import scaled_dot_product_attention
 
     # (b, t/sp, h, d) --a2a--> (b, t, h/sp, d): full sequence, head subset
@@ -223,7 +264,7 @@ def _ulysses_inner(q, k, v, km, seg, *, axis, causal, scale, use_flash):
         seg_full = lax.all_gather(seg, axis, axis=1, tiled=True)
     o = scaled_dot_product_attention(q, k, v, mask=mask, causal=causal,
                                      scale=scale, use_flash=use_flash,
-                                     segment_ids=seg_full)
+                                     segment_ids=seg_full, window=window)
     # back to sequence sharding
     return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 
@@ -232,7 +273,7 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
                       scale: Optional[float] = None, axis: str = "sp",
                       batch_axis: Optional[str] = "dp", mesh=None,
                       use_flash: bool = True, kv_mask=None,
-                      segment_ids=None):
+                      segment_ids=None, window: Optional[int] = None):
     """DeepSpeed-Ulysses-style SP: a2a seq→head shard, local full attention
     (Pallas flash on TPU), a2a back. Requires heads % sp == 0.
     ``kv_mask``: optional global (B, T) keep-mask; all-gathered over sp
@@ -262,8 +303,11 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
         scale = d ** -0.5
     spec = P(batch_axis, axis, None, None)
     mspec = P(batch_axis, axis)
+    enforce(window is None or window >= 1,
+            "window must be >= 1, got %s", window)
     inner = functools.partial(_ulysses_inner, axis=axis, causal=causal,
-                              scale=float(scale), use_flash=use_flash)
+                              window=window, scale=float(scale),
+                              use_flash=use_flash)
     return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
                                 kv_mask, segment_ids)
 

@@ -349,3 +349,60 @@ def test_sharded_flash_rejects_unknown_axis(sp_mesh):
     q = jnp.zeros((4, 128, 8, 64), jnp.float32)
     with pytest.raises(Exception, match="not a mesh axis"):
         sharded_flash_attention(q, q, q, mesh=sp_mesh, batch_axis="data")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_window(sp_mesh, causal):
+    """Sliding-window band in GLOBAL positions under ring SP: steps
+    wholly outside the band keep their carries untouched."""
+    q, k, v = _qkv(10)
+    for W in (8, 24, 48):
+        got = ring_attention(q, k, v, causal=causal, mesh=sp_mesh,
+                             window=W)
+        want = xla_attention(q, k, v, causal=causal, window=W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"W={W}")
+
+
+def test_ring_attention_window_grads(sp_mesh):
+    q, k, v = _qkv(11)
+
+    def loss_ring(q, k, v):
+        o = ring_attention(q, k, v, causal=True, mesh=sp_mesh, window=24)
+        return jnp.sum(o * o)
+
+    def loss_full(q, k, v):
+        o = xla_attention(q, k, v, causal=True, window=24)
+        return jnp.sum(o * o)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_window(sp_mesh, causal):
+    q, k, v = _qkv(12)
+    got = ulysses_attention(q, k, v, causal=causal, mesh=sp_mesh,
+                            window=24, use_flash=False)
+    want = xla_attention(q, k, v, causal=causal, window=24)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_mha_window_under_seq_parallel(sp_mesh):
+    """attn window rides the SP path through the layer API."""
+    import paddle_tpu.nn as nn
+
+    pt.seed(21)
+    mha = nn.MultiHeadAttention(32, 4, seq_parallel="ring").eval()
+    x = jnp.asarray(np.random.default_rng(22).normal(
+        size=(2, 64, 32)).astype(np.float32))
+    got = mha(x, causal=True, window=16)
+    mha.seq_parallel = None
+    want = mha(x, causal=True, window=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
