@@ -84,6 +84,41 @@ def _dropout_keep(seed, bh, row0, col0, bq, bk, dropout_p):
     return u >= dropout_p
 
 
+def _band_j_lo(i, *, block_q, block_k, offset, window):
+    # leftmost k-block a q-block can see under the window (may be < 0)
+    return (i * block_q + offset - (window - 1)) // block_k
+
+
+def _band_i_lo(j, *, block_q, block_k, offset, window, causal):
+    # topmost q-block that can see k-block j under the window
+    back = 0 if causal else (window - 1)
+    return (j * block_k - offset - back) // block_q
+
+
+def _band_width_j(*, block_q, block_k, window, causal, n_j):
+    # k-blocks a q-block can touch: band span rounded up + alignment slack
+    span = block_q - 1 + (window - 1) + (0 if causal else window - 1)
+    return min(n_j, span // block_k + 2)
+
+
+def _band_width_i(*, block_q, block_k, window, causal, n_i):
+    span = block_k - 1 + (window - 1) + (0 if causal else window - 1)
+    return min(n_i, span // block_q + 2)
+
+
+def _banded_imap(lo_fn, n, row_fn=lambda b: b):
+    """ONE definition of the banded index-map clamp, shared by every
+    spec (k/v and q-side, both grid orders): maps (grid row, outer
+    block, band step) -> (row_fn(row), clip(lo_fn(outer) + step), 0).
+    The kernels recover the same index with the same expression — a
+    single source for the band arithmetic."""
+
+    def imap(b, outer, step):
+        return (row_fn(b), jnp.clip(lo_fn(outer) + step, 0, n - 1), 0)
+
+    return imap
+
+
 def _block_should_run(i, j, *, causal, window, offset, block_q, block_k):
     """Block-level skip predicate shared by fwd/dq/dkv: a causal block
     runs iff its lowest row can see its first column; a window adds
@@ -134,7 +169,7 @@ def _use_interpret() -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
                 has_mask, has_segs, dropout_p, offset, block_q, block_k,
-                num_k_blocks):
+                num_k_blocks, banded=False, n_j=None):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
@@ -143,17 +178,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     # program_id is read OUTSIDE pl.when bodies (interpret-mode lowering
     # cannot resolve it inside the conditional)
-    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    bh, i, jj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    if banded:
+        # banded (windowed) grid: axis 2 walks only the band; recover
+        # the real k-block index (the specs clamp identically, so the
+        # loaded block matches; out-of-range steps are skipped)
+        j_raw = _band_j_lo(i, block_q=block_q, block_k=block_k,
+                           offset=offset, window=window) + jj
+        j = jnp.clip(j_raw, 0, n_j - 1)
+        in_range = (j_raw >= 0) & (j_raw < n_j)
+    else:
+        j, in_range = jj, True
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    should_run = _block_should_run(i, j, causal=causal, window=window,
-                                   offset=offset, block_q=block_q,
-                                   block_k=block_k)
+    should_run = in_range & _block_should_run(
+        i, j, causal=causal, window=window, offset=offset,
+        block_q=block_q, block_k=block_k)
 
     @pl.when(should_run)
     def _body():
@@ -207,7 +252,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(j == num_k_blocks - 1)
+    @pl.when(jj == num_k_blocks - 1)
     def _finish():
         l = l_ref[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
@@ -259,22 +304,38 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
               window, scale, dropout_p, block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
-    grid = (bh, tq // block_q, tk // block_k)
+    offset = tk - tq
+    n_j = tk // block_k
+    n_band = (_band_width_j(block_q=block_q, block_k=block_k,
+                            window=window, causal=causal, n_j=n_j)
+              if window is not None else n_j)
+    banded = window is not None and n_band < n_j
+    grid = (bh, tq // block_q, n_band if banded else n_j)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window,
         has_mask=kvm is not None, has_segs=qseg is not None,
-        dropout_p=dropout_p, offset=tk - tq, block_q=block_q,
-        block_k=block_k, num_k_blocks=tk // block_k)
+        dropout_p=dropout_p, offset=offset, block_q=block_q,
+        block_k=block_k, num_k_blocks=grid[2], banded=banded, n_j=n_j)
     # lse carried as (bh, tq, 1): the trailing unit dim keeps the block's
     # last-two-dims (block_q, 1) legal for the Mosaic (8, 128) tiling rule
     out_shape = (
         jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
     )
+    if banded:
+        # k/v specs walk only the band: jj -> clamp(j_lo(i) + jj); the
+        # pipeline then never streams out-of-band K/V blocks from HBM
+        j_lo = functools.partial(_band_j_lo, block_q=block_q,
+                                 block_k=block_k, offset=offset,
+                                 window=window)
+        kv_spec = _vmem_spec((1, block_k, d), _banded_imap(
+            j_lo, n_j, lambda b: _kv_row_fold(b, nheads, kv_heads)))
+    else:
+        kv_spec = _kv_spec(block_k, d, nheads, kv_heads)
     in_specs = [
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        _kv_spec(block_k, d, nheads, kv_heads),
-        _kv_spec(block_k, d, nheads, kv_heads),
+        kv_spec,
+        kv_spec,
     ]
     inputs = (q, k, v)
     if kvm is not None:
@@ -313,22 +374,30 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                scale, causal, window, has_mask, has_segs, dropout_p,
-               offset, block_q, block_k, num_k_blocks):
+               offset, block_q, block_k, num_k_blocks, banded=False,
+               n_j=None):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
     kseg_ref = refs.pop(0) if has_segs else None
     seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     dq_ref, dq_acc = refs
-    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    bh, i, jj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    if banded:
+        j_raw = _band_j_lo(i, block_q=block_q, block_k=block_k,
+                           offset=offset, window=window) + jj
+        j = jnp.clip(j_raw, 0, n_j - 1)
+        in_range = (j_raw >= 0) & (j_raw < n_j)
+    else:
+        j, in_range = jj, True
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    should_run = _block_should_run(i, j, causal=causal, window=window,
-                                   offset=offset, block_q=block_q,
-                                   block_k=block_k)
+    should_run = in_range & _block_should_run(
+        i, j, causal=causal, window=window, offset=offset,
+        block_q=block_q, block_k=block_k)
 
     @pl.when(should_run)
     def _body():
@@ -373,14 +442,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == num_k_blocks - 1)
+    @pl.when(jj == num_k_blocks - 1)
     def _finish():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                 scale, causal, window, has_mask, has_segs, dropout_p,
-                offset, block_q, block_k, num_q_blocks):
+                offset, block_q, block_k, num_q_blocks, banded=False,
+                n_i=None):
     refs = list(refs)
     kvm_ref = refs.pop(0) if has_mask else None
     qseg_ref = refs.pop(0) if has_segs else None
@@ -388,16 +458,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     dk_ref, dv_ref, dk_acc, dv_acc = refs
     bh = pl.program_id(0)
-    j, i = pl.program_id(1), pl.program_id(2)  # kv block outer, q block inner
+    j, ii = pl.program_id(1), pl.program_id(2)  # kv block outer, q inner
+    if banded:
+        i_raw = _band_i_lo(j, block_q=block_q, block_k=block_k,
+                           offset=offset, window=window,
+                           causal=causal) + ii
+        i = jnp.clip(i_raw, 0, n_i - 1)
+        in_range = (i_raw >= 0) & (i_raw < n_i)
+    else:
+        i, in_range = ii, True
 
-    @pl.when(i == 0)
+    @pl.when(ii == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    should_run = _block_should_run(i, j, causal=causal, window=window,
-                                   offset=offset, block_q=block_q,
-                                   block_k=block_k)
+    should_run = in_range & _block_should_run(
+        i, j, causal=causal, window=window, offset=offset,
+        block_q=block_q, block_k=block_k)
 
     @pl.when(should_run)
     def _body():
@@ -443,7 +521,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # (bk, d)
 
-    @pl.when(i == num_q_blocks - 1)
+    @pl.when(ii == num_q_blocks - 1)
     def _finish():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -454,15 +532,37 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
               interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
+    offset = tk - tq
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (bh, tq, 1)
     has_mask = kvm is not None
     has_segs = qseg is not None
+    n_j, n_i = tk // block_k, tq // block_q
+    band_j = (_band_width_j(block_q=block_q, block_k=block_k,
+                            window=window, causal=causal, n_j=n_j)
+              if window is not None else n_j)
+    banded_j = window is not None and band_j < n_j
+    band_i = (_band_width_i(block_q=block_q, block_k=block_k,
+                            window=window, causal=causal, n_i=n_i)
+              if window is not None else n_i)
+    banded_i = window is not None and band_i < n_i
 
+    j_lo = functools.partial(_band_j_lo, block_q=block_q,
+                             block_k=block_k, offset=offset,
+                             window=window)
+    i_lo = functools.partial(_band_i_lo, block_q=block_q,
+                             block_k=block_k, offset=offset,
+                             window=window, causal=causal)
+    kv_imap_banded = _banded_imap(
+        j_lo, n_j, lambda b: _kv_row_fold(b, nheads, kv_heads))
+    q_imap_banded = _banded_imap(i_lo, n_i)
+
+    dq_kv_spec = (_vmem_spec((1, block_k, d), kv_imap_banded)
+                  if banded_j else _kv_spec(block_k, d, nheads, kv_heads))
     dq_in_specs = [
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        _kv_spec(block_k, d, nheads, kv_heads),
-        _kv_spec(block_k, d, nheads, kv_heads),
+        dq_kv_spec,
+        dq_kv_spec,
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -482,9 +582,10 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, window=window,
             has_mask=has_mask, has_segs=has_segs, dropout_p=dropout_p,
-            offset=tk - tq, block_q=block_q, block_k=block_k,
-            num_k_blocks=tk // block_k),
-        grid=(bh, tq // block_q, tk // block_k),
+            offset=offset, block_q=block_q, block_k=block_k,
+            num_k_blocks=band_j if banded_j else n_j, banded=banded_j,
+            n_j=n_j),
+        grid=(bh, n_i, band_j if banded_j else n_j),
         in_specs=dq_in_specs,
         out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
@@ -492,13 +593,19 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
         interpret=interpret,
     )(*dq_inputs)
 
+    dkv_q_spec = (_vmem_spec((1, block_q, d), q_imap_banded) if banded_i
+                  else _vmem_spec((1, block_q, d),
+                                  lambda b, j, i: (b, i, 0)))
+    dkv_q1_spec = (_vmem_spec((1, block_q, 1), q_imap_banded) if banded_i
+                   else _vmem_spec((1, block_q, 1),
+                                   lambda b, j, i: (b, i, 0)))
     dkv_in_specs = [
-        _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        dkv_q_spec,
         _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=1),
         _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=1),
-        _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-        _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-        _vmem_spec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        dkv_q_spec,
+        dkv_q1_spec,
+        dkv_q1_spec,
     ]
     dkv_inputs = (q, k, v, do, lse, delta)
     if has_mask:
@@ -508,8 +615,13 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
         dkv_inputs += (kvm,)
     if has_segs:
         # q-side spec must use the SWAPPED grid order: i is program_id(2)
-        dkv_in_specs.append(_vmem_spec(
-            (1, block_q, 1), lambda b, j, i, _h=nheads: (b // _h, i, 0)))
+        if banded_i:
+            dkv_in_specs.append(_vmem_spec((1, block_q, 1), _banded_imap(
+                i_lo, n_i, lambda b, _h=nheads: b // _h)))
+        else:
+            dkv_in_specs.append(_vmem_spec(
+                (1, block_q, 1),
+                lambda b, j, i, _h=nheads: (b // _h, i, 0)))
         dkv_in_specs.append(_mask_spec(nheads, tk))
         dkv_inputs += (qseg, kseg)
     if dropout_p > 0.0:
@@ -519,9 +631,10 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, window=window,
             has_mask=has_mask, has_segs=has_segs, dropout_p=dropout_p,
-            offset=tk - tq, block_q=block_q, block_k=block_k,
-            num_q_blocks=tq // block_q),
-        grid=(bh, tk // block_k, tq // block_q),
+            offset=offset, block_q=block_q, block_k=block_k,
+            num_q_blocks=band_i if banded_i else n_i, banded=banded_i,
+            n_i=n_i),
+        grid=(bh, n_j, band_i if banded_i else n_i),
         in_specs=dkv_in_specs,
         out_specs=(
             _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),

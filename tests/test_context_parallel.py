@@ -406,3 +406,18 @@ def test_mha_window_under_seq_parallel(sp_mesh):
     want = mha(x, causal=True, window=16)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_window_through_flash_kernel(sp_mesh, monkeypatch):
+    """Ulysses + window with the FLASH path forced (interpret on CPU):
+    the shard_map + banded-Pallas composition the default CPU tests
+    never reach (the backend gate routes them to XLA)."""
+    from paddle_tpu.ops import attention as A
+
+    monkeypatch.setattr(A, "_flash_ok", lambda *a, **k: True)
+    q, k, v = _qkv(13)
+    got = ulysses_attention(q, k, v, causal=True, mesh=sp_mesh,
+                            window=24, use_flash=True)
+    want = xla_attention(q, k, v, causal=True, window=24)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
