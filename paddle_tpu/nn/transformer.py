@@ -76,9 +76,12 @@ class TransformerDecoderLayer(Layer):
     def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
                  dropout: float = 0.1, activation: str = "gelu",
                  normalize_before: bool = True, use_flash: bool = True,
-                 seq_parallel=None):
+                 seq_parallel=None, attn_window=None):
         super().__init__()
         self.normalize_before = normalize_before
+        # sliding-window width for the causal SELF-attention (the
+        # Mistral-style decoder pattern); cross-attention stays full
+        self.attn_window = attn_window
         # attention-probability dropout off under SP (see EncoderLayer note)
         self.self_attn = MultiHeadAttention(
             d_model, nhead, dropout=0.0 if seq_parallel else dropout,
@@ -100,13 +103,15 @@ class TransformerDecoderLayer(Layer):
         if self.normalize_before:
             x = x + self.drop1(self.self_attn(self.norm1(x),
                                               attn_mask=self_mask,
-                                              causal=causal))
+                                              causal=causal,
+                                              window=self.attn_window))
             x = x + self.drop2(self.cross_attn(self.norm2(x), memory, memory,
                                                attn_mask=cross_mask))
             x = x + self.drop3(self.ffn(self.norm3(x)))
         else:
             x = self.norm1(x + self.drop1(self.self_attn(
-                x, attn_mask=self_mask, causal=causal)))
+                x, attn_mask=self_mask, causal=causal,
+                window=self.attn_window)))
             x = self.norm2(x + self.drop2(self.cross_attn(
                 x, memory, memory, attn_mask=cross_mask)))
             x = self.norm3(x + self.drop3(self.ffn(x)))
@@ -187,12 +192,13 @@ class TransformerDecoder(Layer):
     def __init__(self, num_layers: int, d_model: int, nhead: int,
                  dim_feedforward: int, dropout: float = 0.1,
                  activation: str = "gelu", normalize_before: bool = True,
-                 use_flash: bool = True, seq_parallel=None):
+                 use_flash: bool = True, seq_parallel=None,
+                 attn_window=None):
         super().__init__()
         self.layers = LayerList([
             TransformerDecoderLayer(d_model, nhead, dim_feedforward, dropout,
                                     activation, normalize_before, use_flash,
-                                    seq_parallel)
+                                    seq_parallel, attn_window=attn_window)
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
 

@@ -223,3 +223,28 @@ def test_mha_gqa_matches_full_heads_when_shared():
         size=(2, 64, 32)).astype(np.float32))
     np.testing.assert_allclose(np.asarray(mha(x)), np.asarray(full(x)),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_decoder_attn_window_matches_banded_mask():
+    """Decoder self-attention window (Mistral-style causal lookback)
+    equals an explicit causal band mask on the same weights;
+    cross-attention stays full."""
+    import paddle_tpu as pt
+    from paddle_tpu.nn.transformer import TransformerDecoder
+
+    pt.seed(5)
+    T, W = 64, 16
+    dec = TransformerDecoder(2, 32, 4, 64, dropout=0.0,
+                             attn_window=W).eval()
+    rng = np.random.default_rng(15)
+    x = jnp.asarray(rng.normal(size=(2, T, 32)).astype(np.float32))
+    mem = jnp.asarray(rng.normal(size=(2, 24, 32)).astype(np.float32))
+    out_w = dec(x, mem)
+    for layer in dec.layers:
+        layer.attn_window = None
+    rows = np.arange(T)[:, None]
+    cols = np.arange(T)[None, :]
+    band = (rows - cols < W)  # causal applied by the layer itself
+    out_ref = dec(x, mem, self_mask=jnp.asarray(band)[None, None])
+    np.testing.assert_allclose(np.asarray(out_w), np.asarray(out_ref),
+                               atol=2e-5, rtol=2e-5)
