@@ -131,6 +131,67 @@ class TransformerNMT(nn.Layer):
                                       jnp.arange(max_len))
         return tokens[:, 1:]
 
+    def greedy_decode_cached(self, src_ids, max_len: int = 64):
+        """Greedy decode with per-layer K/V caches: O(T) work per step
+        instead of greedy_decode's full-prefix re-run (O(T^2) per step).
+        Cross-attention memory K/V are projected ONCE. Token-identical
+        to greedy_decode (pinned by test)."""
+        from jax import lax
+
+        from ..core.enforce import enforce
+        from ..nn.transformer import decoder_layer_step
+
+        cfg = self.cfg
+        # greedy_decode would fail loudly past the pe table; the cached
+        # path's per-step pe[t] would silently CLAMP (dynamic_slice) —
+        # make it loud here too. And the no-dropout step path is only
+        # token-identical to greedy_decode in eval mode.
+        enforce(max_len <= self.pos_enc.pe.shape[0],
+                "max_len %s exceeds the positional table (%s)",
+                max_len, self.pos_enc.pe.shape[0])
+        enforce(not self.training,
+                "greedy_decode_cached requires eval mode (the cached "
+                "step path applies no dropout); call model.eval()")
+        b = src_ids.shape[0]
+        memory, src_pad = self.encode(src_ids)
+        cross_mask = src_pad[:, None, None, :]
+        mem_kv = [layer.cross_attn.project_kv(memory)
+                  for layer in self.decoder.layers]
+        caches = [layer.self_attn.init_cache(b, max_len,
+                                             dtype=memory.dtype)
+                  for layer in self.decoder.layers]
+        tokens = jnp.full((b, max_len + 1), cfg.pad_id, jnp.int32)
+        tokens = tokens.at[:, 0].set(cfg.bos_id)
+        finished = jnp.zeros((b,), jnp.bool_)
+
+        def step(carry, t):
+            tokens, finished, caches = carry
+            word = lax.dynamic_index_in_dim(tokens, t, axis=1,
+                                            keepdims=True)  # (b, 1)
+            emb = self.tgt_emb(word)
+            # positional signal for absolute step t (the scan-friendly
+            # form of PositionalEncoding.forward's x*scale + pe[:t])
+            x_t = (emb * self.pos_enc.scale
+                   + self.pos_enc.pe[t][None, None, :].astype(emb.dtype))
+            new_caches = []
+            for layer, (mk, mv), (ck, cv) in zip(self.decoder.layers,
+                                                 mem_kv, caches):
+                x_t, ck, cv = decoder_layer_step(
+                    layer, x_t, mk, mv, ck, cv, t, cross_mask=cross_mask)
+                new_caches.append((ck, cv))
+            if self.decoder.final_norm is not None:
+                x_t = self.decoder.final_norm(x_t)
+            logits = self.generator(x_t[:, 0])
+            next_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            next_tok = jnp.where(finished, cfg.pad_id, next_tok)
+            tokens = tokens.at[:, t + 1].set(next_tok)
+            finished = finished | (next_tok == cfg.eos_id)
+            return (tokens, finished, new_caches), None
+
+        (tokens, _, _), _ = lax.scan(step, (tokens, finished, caches),
+                                     jnp.arange(max_len))
+        return tokens[:, 1:]
+
     def beam_decode(self, src_ids, max_len: int = 64, beam_size: int = 4,
                     length_penalty: float = 0.6):
         """Beam-search decode, one source sentence batch at a time via vmap

@@ -408,7 +408,70 @@ class RNN(Layer):
         return outs, final_state
 
 
-class MultiHeadAttention(Layer):
+class _MHADecodeMixin:
+    """Incremental-decode pieces for MultiHeadAttention (KV cache).
+
+    The reference era decodes with an RNN whose state is O(1) per step;
+    the transformer analog needs the K/V of every past position. These
+    methods keep decode O(T) per step instead of re-running the stack
+    over the whole prefix (O(T^2) per step) the way naive scan decode
+    does.
+    """
+
+    def init_cache(self, batch: int, capacity: int, dtype=None):
+        """Zeroed (B, capacity, h_kv, hd) K and V caches."""
+        dt = dtype or default_dtype()
+        shape = (batch, capacity, self.num_kv_heads, self.head_dim)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def project_kv(self, key, value=None):
+        """One-time K/V projection (cross-attention over fixed memory)."""
+        value = key if value is None else value
+        b, tk, _ = key.shape
+        k = self.k_proj(key).reshape(b, tk, self.num_kv_heads,
+                                     self.head_dim)
+        v = self.v_proj(value).reshape(b, tk, self.num_kv_heads,
+                                       self.head_dim)
+        return k, v
+
+    def attend_kv(self, query, k, v, attn_mask=None):
+        """Attention of ``query`` (B, Tq, D) against PRE-PROJECTED k/v."""
+        from ..ops.attention import scaled_dot_product_attention
+
+        b, tq, d = query.shape
+        q = self.q_proj(query).reshape(b, tq, self.num_heads,
+                                       self.head_dim)
+        out = scaled_dot_product_attention(
+            q, k, v, mask=attn_mask, use_flash=self.use_flash)
+        return self.out_proj(out.reshape(b, tq, d))
+
+    def forward_step(self, x_t, cache_k, cache_v, t, window=None):
+        """One decode step: project this position's K/V into the caches
+        at index ``t`` and attend over positions <= t (optionally only
+        the last ``window``). ``x_t``: (B, 1, D). Returns
+        (out_t, cache_k, cache_v)."""
+        from jax import lax
+
+        b = x_t.shape[0]
+        cap = cache_k.shape[1]
+        k_t = self.k_proj(x_t).reshape(b, 1, self.num_kv_heads,
+                                       self.head_dim)
+        v_t = self.v_proj(x_t).reshape(b, 1, self.num_kv_heads,
+                                       self.head_dim)
+        cache_k = lax.dynamic_update_slice_in_dim(
+            cache_k, k_t.astype(cache_k.dtype), t, axis=1)
+        cache_v = lax.dynamic_update_slice_in_dim(
+            cache_v, v_t.astype(cache_v.dtype), t, axis=1)
+        pos = jnp.arange(cap)
+        keep = pos <= t
+        if window is not None:
+            keep &= pos > t - window
+        mask = jnp.broadcast_to(keep, (b, cap))[:, None, None, :]
+        out = self.attend_kv(x_t, cache_k, cache_v, attn_mask=mask)
+        return out, cache_k, cache_v
+
+
+class MultiHeadAttention(_MHADecodeMixin, Layer):
     """Transformer attention. The reference builds this from primitives
     (nets.py:343 scaled_dot_product_attention); here it's a first-class layer
     with an optional Pallas flash-attention path on TPU."""
@@ -449,10 +512,8 @@ class MultiHeadAttention(Layer):
         b, tq, d = query.shape
         tk = key.shape[1]
         h, hd = self.num_heads, self.head_dim
-        h_kv = self.num_kv_heads
         q = self.q_proj(query).reshape(b, tq, h, hd)
-        k = self.k_proj(key).reshape(b, tk, h_kv, hd)
-        v = self.v_proj(value).reshape(b, tk, h_kv, hd)
+        k, v = self.project_kv(key, value)
 
         if self.seq_parallel is not None:
             # key-padding masks ((B, Tk) or (B, 1, 1, Tk)) ride the SP

@@ -246,3 +246,30 @@ class LearnedPositionalEmbedding(Layer):
         t = x.shape[1]
         positions = jnp.arange(t)[None, :]
         return x + self.emb(positions)
+
+
+def decoder_layer_step(layer, x_t, mem_k, mem_v, cache_k, cache_v, t,
+                       cross_mask=None):
+    """One incremental-decode step of a TransformerDecoderLayer: the
+    self-attention runs against the layer's K/V cache (O(T) per step —
+    the transformer analog of the reference RNN decoder's O(1) state),
+    cross-attention against PRE-PROJECTED memory K/V. ``x_t``: (B, 1, D).
+    Returns (out_t, cache_k, cache_v). Mirrors
+    TransformerDecoderLayer.forward's pre/post-norm residual layout
+    (eval mode: dropout is identity)."""
+    w = layer.attn_window
+    if layer.normalize_before:
+        h, cache_k, cache_v = layer.self_attn.forward_step(
+            layer.norm1(x_t), cache_k, cache_v, t, window=w)
+        x_t = x_t + h
+        x_t = x_t + layer.cross_attn.attend_kv(layer.norm2(x_t), mem_k,
+                                               mem_v, attn_mask=cross_mask)
+        x_t = x_t + layer.ffn(layer.norm3(x_t))
+    else:
+        h, cache_k, cache_v = layer.self_attn.forward_step(
+            x_t, cache_k, cache_v, t, window=w)
+        x_t = layer.norm1(x_t + h)
+        x_t = layer.norm2(x_t + layer.cross_attn.attend_kv(
+            x_t, mem_k, mem_v, attn_mask=cross_mask))
+        x_t = layer.norm3(x_t + layer.ffn(x_t))
+    return x_t, cache_k, cache_v

@@ -248,3 +248,20 @@ def test_decoder_attn_window_matches_banded_mask():
     out_ref = dec(x, mem, self_mask=jnp.asarray(band)[None, None])
     np.testing.assert_allclose(np.asarray(out_w), np.asarray(out_ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_greedy_decode_cached_matches_full_recompute():
+    """KV-cached incremental decode is token-identical to the
+    full-prefix-recompute greedy decode (the cache is an optimization,
+    not a semantic change)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as TR
+
+    pt.seed(13)
+    cfg = TR.NMTConfig.tiny()
+    model = TR.TransformerNMT(cfg).eval()
+    rng = np.random.default_rng(31)
+    src = jnp.asarray(rng.integers(3, cfg.src_vocab, (2, 12)))
+    ref = model.greedy_decode(src, max_len=10)
+    got = model.greedy_decode_cached(src, max_len=10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
