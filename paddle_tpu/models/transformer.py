@@ -131,6 +131,26 @@ class TransformerNMT(nn.Layer):
                                       jnp.arange(max_len))
         return tokens[:, 1:]
 
+    def _cached_step_hidden(self, tok, t, mem_kv, caches, cross_mask):
+        """One cached decode step shared by greedy and beam: embed the
+        current token (B, ), add the absolute-position term, run every
+        decoder layer against its K/V cache, final-norm. Returns
+        (h_t (B, D), new_caches)."""
+        from ..nn.transformer import decoder_layer_step
+
+        emb = self.tgt_emb(tok[:, None])
+        x_t = (emb * self.pos_enc.scale
+               + self.pos_enc.pe[t][None, None, :].astype(emb.dtype))
+        new_caches = []
+        for layer, (mk, mv), (ck, cv) in zip(self.decoder.layers,
+                                             mem_kv, caches):
+            x_t, ck, cv = decoder_layer_step(
+                layer, x_t, mk, mv, ck, cv, t, cross_mask=cross_mask)
+            new_caches.append((ck, cv))
+        if self.decoder.final_norm is not None:
+            x_t = self.decoder.final_norm(x_t)
+        return x_t[:, 0], new_caches
+
     def greedy_decode_cached(self, src_ids, max_len: int = 64):
         """Greedy decode with per-layer K/V caches: O(T) work per step
         instead of greedy_decode's full-prefix re-run (O(T^2) per step).
@@ -139,7 +159,6 @@ class TransformerNMT(nn.Layer):
         from jax import lax
 
         from ..core.enforce import enforce
-        from ..nn.transformer import decoder_layer_step
 
         cfg = self.cfg
         # greedy_decode would fail loudly past the pe table; the cached
@@ -167,21 +186,10 @@ class TransformerNMT(nn.Layer):
         def step(carry, t):
             tokens, finished, caches = carry
             word = lax.dynamic_index_in_dim(tokens, t, axis=1,
-                                            keepdims=True)  # (b, 1)
-            emb = self.tgt_emb(word)
-            # positional signal for absolute step t (the scan-friendly
-            # form of PositionalEncoding.forward's x*scale + pe[:t])
-            x_t = (emb * self.pos_enc.scale
-                   + self.pos_enc.pe[t][None, None, :].astype(emb.dtype))
-            new_caches = []
-            for layer, (mk, mv), (ck, cv) in zip(self.decoder.layers,
-                                                 mem_kv, caches):
-                x_t, ck, cv = decoder_layer_step(
-                    layer, x_t, mk, mv, ck, cv, t, cross_mask=cross_mask)
-                new_caches.append((ck, cv))
-            if self.decoder.final_norm is not None:
-                x_t = self.decoder.final_norm(x_t)
-            logits = self.generator(x_t[:, 0])
+                                            keepdims=False)  # (b,)
+            h_t, new_caches = self._cached_step_hidden(
+                word, t, mem_kv, caches, cross_mask)
+            logits = self.generator(h_t)
             next_tok = jnp.argmax(logits, -1).astype(jnp.int32)
             next_tok = jnp.where(finished, cfg.pad_id, next_tok)
             tokens = tokens.at[:, t + 1].set(next_tok)
@@ -223,6 +231,53 @@ class TransformerNMT(nn.Layer):
             init = {"tokens": jnp.full((beam_size, max_len + 1), cfg.pad_id,
                                        jnp.int32),
                     "t": jnp.zeros((beam_size,), jnp.int32)}
+            return DCD.beam_search(init, step_fn, beam_size=beam_size,
+                                   max_len=max_len, bos_id=cfg.bos_id,
+                                   end_id=cfg.eos_id,
+                                   length_penalty=length_penalty)
+
+        return jax.vmap(one)(src_ids)
+
+    def beam_decode_cached(self, src_ids, max_len: int = 64,
+                           beam_size: int = 4,
+                           length_penalty: float = 0.6):
+        """beam_decode with per-layer K/V caches in the beam state:
+        ops.decode.beam_search already gathers the WHOLE state pytree by
+        parent each step, so cache reordering across beam switches is
+        automatic — each step costs O(T) instead of re-running the
+        decoder over the full prefix. Result-identical to beam_decode
+        (pinned by test); eval mode required."""
+        from ..core.enforce import enforce
+        from ..ops import decode as DCD
+
+        cfg = self.cfg
+        enforce(max_len <= self.pos_enc.pe.shape[0],
+                "max_len %s exceeds the positional table (%s)",
+                max_len, self.pos_enc.pe.shape[0])
+        enforce(not self.training,
+                "beam_decode_cached requires eval mode; call model.eval()")
+
+        def one(src_row):
+            memory, src_pad = self.encode(src_row[None])
+            pad_b = jnp.repeat(src_pad, beam_size, axis=0)
+            cross_mask = pad_b[:, None, None, :]
+            # project cross K/V ONCE on the single memory row, then
+            # repeat the projections — 1/beam_size of the matmul work
+            mem_kv = [tuple(jnp.repeat(x, beam_size, axis=0)
+                            for x in layer.cross_attn.project_kv(memory))
+                      for layer in self.decoder.layers]
+
+            def step_fn(state, tok):
+                t = state["t"]
+                h_t, new_caches = self._cached_step_hidden(
+                    tok, t[0], mem_kv, state["caches"], cross_mask)
+                logp = jax.nn.log_softmax(self.generator(h_t), -1)
+                return logp, {"t": t + 1, "caches": new_caches}
+
+            init = {"t": jnp.zeros((beam_size,), jnp.int32),
+                    "caches": [layer.self_attn.init_cache(
+                        beam_size, max_len, dtype=memory.dtype)
+                        for layer in self.decoder.layers]}
             return DCD.beam_search(init, step_fn, beam_size=beam_size,
                                    max_len=max_len, bos_id=cfg.bos_id,
                                    end_id=cfg.eos_id,

@@ -265,3 +265,22 @@ def test_greedy_decode_cached_matches_full_recompute():
     ref = model.greedy_decode(src, max_len=10)
     got = model.greedy_decode_cached(src, max_len=10)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_beam_decode_cached_matches_full_recompute():
+    """KV-cached beam decode equals the full-recompute beam decode —
+    including cache reordering across beam switches (the state gather
+    in ops.decode.beam_search)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as TR
+
+    pt.seed(17)
+    cfg = TR.NMTConfig.tiny()
+    model = TR.TransformerNMT(cfg).eval()
+    rng = np.random.default_rng(33)
+    src = jnp.asarray(rng.integers(3, cfg.src_vocab, (2, 10)))
+    seq_ref, sc_ref = model.beam_decode(src, max_len=8, beam_size=3)
+    seq, sc = model.beam_decode_cached(src, max_len=8, beam_size=3)
+    np.testing.assert_array_equal(np.asarray(seq), np.asarray(seq_ref))
+    np.testing.assert_allclose(np.asarray(sc), np.asarray(sc_ref),
+                               rtol=1e-5, atol=1e-5)
