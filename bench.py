@@ -471,6 +471,55 @@ def bench_bert_packed(steps: int, batch_size: int, amp=None,
                         batch_size, amp=amp, method="forward_packed_loss")
 
 
+def bench_nmt_decode(steps: int, batch_size: int, amp=None,
+                     cached: bool = True, max_len: int = 64):
+    """Autoregressive decode throughput (tokens/sec) for the NMT
+    transformer — the serving-side counterpart of --infer. ``cached``
+    uses the per-layer K/V caches (O(T) per step); --no-kv-cache runs
+    the full-prefix re-run greedy_decode for the honest comparison
+    (identical tokens, pinned by tests)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as TR
+
+    import contextlib
+
+    from paddle_tpu.core.dtypes import policy_scope
+
+    pt.seed(0)
+    batch_size = _cap(batch_size, 32)
+    cfg = TR.NMTConfig.base()
+    model = TR.TransformerNMT(cfg).eval()
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(3, cfg.src_vocab, (batch_size, 64)))
+
+    decode = (model.greedy_decode_cached if cached
+              else model.greedy_decode)
+
+    def _decode(s):
+        scope = policy_scope(amp) if amp else contextlib.nullcontext()
+        with scope:  # same AMP labeling contract as the sibling benches
+            return decode(s, max_len=max_len)
+
+    fn = jax.jit(_decode)
+
+    def _fence(out):
+        float(jax.device_get(out[0, 0]))
+
+    for _ in range(2):
+        out = fn(src)
+    _fence(out)
+    outer = max(1, steps // 4)
+    t0 = time.perf_counter()
+    for i in range(outer):
+        out = fn(src)
+        _fence(out)
+    dt = time.perf_counter() - t0
+    return outer * batch_size * max_len / dt, "tokens/sec", {}
+
+
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
                         vocab: int = 100_000):
     """DeepFM with ROW-SPARSE embedding updates (the SelectedRows
@@ -729,6 +778,7 @@ MODELS = {
     "bert_packed": bench_bert_packed,
     "bert_long": bench_bert_long,
     "transformer_nmt": bench_transformer_nmt,
+    "nmt_decode": bench_nmt_decode,
     "deepfm": bench_deepfm,
     "deepfm_sparse": bench_deepfm_sparse,
 }
@@ -797,6 +847,10 @@ def main():
     ap.add_argument("--window", type=int, default=None,
                     help="bert_long: sliding-window attention width "
                     "(O(T*W) local attention vs the O(T^2) default)")
+    ap.add_argument("--no-kv-cache", dest="kv_cache", action="store_false",
+                    help="nmt_decode: full-prefix re-run decode instead "
+                    "of the K/V-cached step (same tokens; the honest "
+                    "baseline for the cache win)")
     ap.add_argument("--dp", type=int, default=1,
                     help="data-parallel device count (--gpus analog; on "
                     "--platform cpu this creates virtual host devices)")
@@ -842,6 +896,10 @@ def main():
         # a window changes the WORKLOAD (different attention math):
         # its history key must not collide with the full-attention one
         metric += f"_w{args.window}"
+    if "cached" in sig and not args.kv_cache:
+        # same workload, different implementation — its own history key
+        # so the cache-vs-recompute comparison stays visible
+        metric += "_nocache"
     if _EXPLICIT_BATCH:
         metric += f"_b{batch}"
     if args.infer and args.model == "deepfm_sparse":
@@ -849,6 +907,12 @@ def main():
         # identical to deepfm's — bench that instead of duplicating it
         _emit_error(metric, "--infer: use --model deepfm (the sparse "
                     "variant differs only in the optimizer update)")
+        return
+    if args.infer and args.model == "nmt_decode":
+        # the decode bench IS an inference workload; an --infer run would
+        # duplicate it under a second metric key and fork its history
+        _emit_error(metric, "--infer: --model nmt_decode already measures "
+                    "inference decode; run it without --infer")
         return
     if args.infer and args.model == "bert_packed":
         # packing is a training-batch layout; the pretraining head's
@@ -906,6 +970,8 @@ def main():
         kwargs["vocab"] = args.vocab
     if "window" in sig and args.window:
         kwargs["window"] = args.window
+    if "cached" in sig:
+        kwargs["cached"] = args.kv_cache
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call

@@ -204,3 +204,17 @@ def test_infer_deepfm_sparse_redirects():
     d = _run("--infer", "--model", "deepfm_sparse", "--smoke")
     assert d["value"] == 0.0
     assert "use --model deepfm" in d["error"]
+
+
+def test_nmt_decode_bench_contract():
+    """Decode bench: cached and no-cache variants emit distinct metric
+    keys (same workload, different implementation — the comparison must
+    stay visible in history)."""
+    d = _run("--model", "nmt_decode", "--smoke", "--steps", "4",
+             "--batch-size", "2")
+    assert d["metric"] == "nmt_decode_throughput_b2"
+    assert d["unit"] == "tokens/sec" and d["value"] > 0
+    d2 = _run("--model", "nmt_decode", "--no-kv-cache", "--smoke",
+              "--steps", "4", "--batch-size", "2", timeout=900)
+    assert d2["metric"] == "nmt_decode_throughput_nocache_b2"
+    assert d2["value"] > 0

@@ -114,6 +114,10 @@ item infer_bert        1200 python bench.py --infer --model bert_base
 item infer_mnist       900  python bench.py --infer
 item infer_deepfm      900  python bench.py --infer --model deepfm
 item infer_nmt         1200 python bench.py --infer --model transformer_nmt
+# autoregressive decode: K/V-cached vs full-recompute (same tokens;
+# CPU already shows 4.8x for the cache at max_len 64)
+item decode_nmt        1200 python bench.py --model nmt_decode
+item decode_nmt_full   1500 python bench.py --model nmt_decode --no-kv-cache
 # -- tier 4: full-sweep completeness (superset of the retired
 # tpu_session.sh list so a FRESH environment gets every model and every
 # default tune shape from this one script; in an already-captured
