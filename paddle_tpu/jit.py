@@ -22,10 +22,16 @@ from .nn.layer import Layer
 
 def save(layer: Layer, dirname: str, example_args: Sequence,
          input_names: Optional[Sequence[str]] = None,
-         batch_polymorphic: bool = True) -> None:
-    """Export ``layer.forward(*example_args)`` (eval mode) as an inference
-    artifact. ``example_args``: arrays or ShapeDtypeStructs; leading dims
-    export symbolically when ``batch_polymorphic``."""
+         batch_polymorphic: bool = True, method: str = "forward",
+         method_kwargs: Optional[dict] = None) -> None:
+    """Export ``layer.<method>(*example_args)`` (eval mode) as an
+    inference artifact. ``example_args``: arrays or ShapeDtypeStructs;
+    leading dims export symbolically when ``batch_polymorphic``.
+    ``method`` lets a model export an alternative jittable entry point —
+    e.g. TransformerNMT.greedy_decode_cached, so the SERVING artifact
+    carries the K/V-cached decode loop, not just the teacher-forced
+    forward; ``method_kwargs`` bakes static non-array options (e.g.
+    ``{"max_len": 128}``) into the traced artifact."""
     layer.eval()
     params = {k: jnp.asarray(v) for k, v in layer.named_parameters().items()}
     buffers = {k: jnp.asarray(v) for k, v in layer.named_buffers().items()}
@@ -34,10 +40,12 @@ def save(layer: Layer, dirname: str, example_args: Sequence,
             "input_names length %s != example args %s", len(names),
             len(example_args))
 
+    mkw = dict(method_kwargs or {})
+
     def infer_fn(params, feeds):
         out, _ = layer.functional_call(
             params, *[feeds[n] for n in names], buffers=buffers,
-            training=False)
+            training=False, method=method, **mkw)
         return list(out) if isinstance(out, (tuple, list)) else [out]
 
     feed_specs, polymorphic = {}, False
