@@ -145,12 +145,37 @@ def _get_flash():
         return None
 
 
+_FORCE_FLASH = False
+
+
+class force_flash:
+    """Context manager: route eligible shapes to the flash kernel even
+    off-TPU (interpret mode). For tests that must exercise the Pallas
+    dispatch + partitioning path on the virtual CPU mesh — production
+    dispatch stays backend-gated."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+
+    def __enter__(self):
+        global _FORCE_FLASH
+        self._prev = _FORCE_FLASH
+        _FORCE_FLASH = self.enabled
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE_FLASH
+        _FORCE_FLASH = self._prev
+        return False
+
+
 def _flash_ok(q, k, causal: bool = False, window=None) -> bool:
     """Flash kernel constraints: TPU backend, block-divisible seq lens,
     supported head dim — and the autotuner's measured verdict when one
     exists (tools/pallas_tune.py records use_flash=False for shape
     buckets where the XLA fallback won on-chip)."""
-    if jax.default_backend() not in ("tpu", "axon"):
+    if (not _FORCE_FLASH
+            and jax.default_backend() not in ("tpu", "axon")):
         return False
     tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
     # 64-divisible seqs use block=64 (the tuner measures that shape too:

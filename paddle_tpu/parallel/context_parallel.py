@@ -326,21 +326,24 @@ def sharded_flash_attention(q, k, v, *, mesh=None, batch_axis="dp",
                             kv_mask=None, segment_ids=None, window=None,
                             dropout_p=0.0, dropout_key=None):
     """Flash attention partitioned over batch and/or head mesh axes via
-    shard_map — the pattern production TPU stacks use, because XLA's
-    auto-SPMD partitioner has no rule for the Pallas custom call and
-    would otherwise ALL-GATHER q/k/v and run it replicated (verified on
-    the 8-device CPU mesh: output comes back fully replicated).
+    EXPLICIT shard_map. Since round 4 the kernel itself registers a
+    partitioning rule (jax.experimental.custom_partitioning, see
+    ops/pallas/flash_attention.py), so plain pjit auto-sharding already
+    runs it on local shards — this wrapper remains for (a) explicit
+    control of which axes shard, and (b) GQA under HEAD sharding, which
+    the auto rule pins replicated (a local head shard cannot address its
+    kv group; here the group mapping is arranged per shard).
 
     Attention is embarrassingly parallel over batch and heads, so each
     device runs the kernel on its local (b/dp, t, h/tp, d) shard with no
     collectives. kv_mask/segment_ids shard over batch only. Dropout:
     each shard folds its mesh coordinates into the key, so masks are
     DISTINCT across devices (no cross-shard correlation) and
-    deterministic per key — but not bit-identical to the unsharded
-    call's mask (the kernel hashes its local batch*head index).
+    deterministic per key — unlike the auto-partitioned path, whose
+    per-(b,h) seeds make masks bit-identical to the unsharded call.
 
-    Use for TP/DP models calling flash under plain pjit; the SP paths
-    (ring/ulysses above) already run inside their own shard_map.
+    The SP paths (ring/ulysses above) already run inside their own
+    shard_map.
     """
     from ..ops.pallas.flash_attention import flash_attention
 

@@ -258,15 +258,15 @@ class TestFlashDropout:
     @staticmethod
     def _ref_keep(key, b, h, t, p):
         """The mask flash builds, reconstructed outside the kernel: hash
-        of (seed, flattened b*h, global row, global col) — block-size
-        invariant by construction."""
+        of (per-(b,h) seed, global row, global col) — block-size AND
+        sharding invariant by construction."""
         from paddle_tpu.ops.pallas.flash_attention import _dropout_keep
 
-        seed = jax.random.randint(key, (1, 1), -2 ** 31, 2 ** 31 - 1,
-                                  dtype=jnp.int32)[0, 0]
+        seed = jax.random.randint(key, (b, h), -2 ** 31, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
         rows = []
         for bh in range(b * h):
-            rows.append(_dropout_keep(seed, jnp.int32(bh), 0, 0, t, t, p))
+            rows.append(_dropout_keep(seed[bh // h, bh % h], 0, 0, t, t, p))
         return jnp.stack(rows).reshape(b, h, t, t)
 
     @staticmethod
@@ -336,8 +336,7 @@ class TestFlashDropout:
         output mean in range."""
         from paddle_tpu.ops.pallas.flash_attention import _dropout_keep
 
-        keep = _dropout_keep(jnp.int32(123), jnp.int32(0), 0, 0,
-                             512, 512, 0.25)
+        keep = _dropout_keep(jnp.int32(123), 0, 0, 512, 512, 0.25)
         rate = 1.0 - float(jnp.mean(keep.astype(jnp.float32)))
         assert abs(rate - 0.25) < 0.01
 
@@ -366,9 +365,9 @@ def test_flash_all_features_compose():
                           segment_ids=ids_j, dropout_p=p, dropout_key=key,
                           interpret=True)
     # reference: same dropout mask, explicit everything else
-    seed = jax.random.randint(key, (1, 1), -2 ** 31, 2 ** 31 - 1,
-                              dtype=jnp.int32)[0, 0]
-    dkeep = jnp.stack([_dropout_keep(seed, jnp.int32(bh), 0, 0, t, t, p)
+    seed = jax.random.randint(key, (b, h), -2 ** 31, 2 ** 31 - 1,
+                              dtype=jnp.int32)
+    dkeep = jnp.stack([_dropout_keep(seed[bh // h, bh % h], 0, 0, t, t, p)
                        for bh in range(b * h)]).reshape(b, h, t, t)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -551,9 +550,9 @@ class TestFlashWindowBandedGrid:
         out = flash_attention(q, k, v, causal=True, window=W,
                               kv_mask=keep, dropout_p=p, dropout_key=key,
                               block_q=128, block_k=128, interpret=True)
-        seed = jax.random.randint(key, (1, 1), -2 ** 31, 2 ** 31 - 1,
-                                  dtype=jnp.int32)[0, 0]
-        dk = jnp.stack([_dropout_keep(seed, jnp.int32(bh), 0, 0, t, t, p)
+        seed = jax.random.randint(key, (b, h), -2 ** 31, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+        dk = jnp.stack([_dropout_keep(seed[bh // h, bh % h], 0, 0, t, t, p)
                         for bh in range(b * h)]).reshape(b, h, t, t)
         scale = q.shape[-1] ** -0.5
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

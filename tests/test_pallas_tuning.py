@@ -54,12 +54,11 @@ def test_flash_attention_consults_table(table, monkeypatch):
     calls = []
     real = FA._flash
 
-    def spy(qf, kf, vf, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
-            window, scale, dropout_p, bq, bk, bq_bwd, bk_bwd, interpret):
+    def spy(q, k, v, kvm, seg, seed, causal, window, scale, dropout_p,
+            bq, bk, bq_bwd, bk_bwd, interpret):
         calls.append((bq, bk, bq_bwd, bk_bwd))
-        return real(qf, kf, vf, kvm, qseg, kseg, seed, nheads, kv_heads,
-                    causal, window, scale, dropout_p, bq, bk, bq_bwd,
-                    bk_bwd, interpret)
+        return real(q, k, v, kvm, seg, seed, causal, window, scale,
+                    dropout_p, bq, bk, bq_bwd, bk_bwd, interpret)
 
     monkeypatch.setattr(FA, "_flash", spy)
     q = jnp.zeros((1, 128, 2, 64), jnp.float32)
