@@ -14,7 +14,9 @@ from .dgc import (DGCMomentum, dgc_allreduce, quantized_allreduce,
 from .geo_sgd import GeoSGDTrainer
 from .hybrid import (build_bert_hybrid_step,
                      build_hybrid_transformer_step)
-from .pipeline import GPipe, pipeline_apply, stage_param_sharding
+from .pipeline import (GPipe, bubble_fraction, gpipe_ticks,
+                       interleaved_ticks, pipeline_apply,
+                       stage_param_sharding)
 from .sharded_embedding import (ShardedEmbedding, embedding_ep_rules,
                                 sharded_embedding_lookup)
 from .sharding import (OptStateRules, constraint, infer_param_spec,
@@ -26,6 +28,7 @@ __all__ = [
     "reduce_scatter", "ring_attention",
     "sharded_flash_attention", "ulysses_attention",
     "GPipe", "pipeline_apply", "stage_param_sharding",
+    "bubble_fraction", "gpipe_ticks", "interleaved_ticks",
     "ShardedEmbedding", "embedding_ep_rules", "sharded_embedding_lookup",
     "OptStateRules", "constraint", "infer_param_spec", "shard_params",
     "transformer_tp_rules", "zero_dp_rules",

@@ -103,7 +103,9 @@ def build_hybrid_transformer_step(mesh, *, layers: int = 4, d_model: int = 16,
 def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
                            seq_len: int = 16, num_microbatches: int = 2,
                            lr: float = 0.01, seed: int = 0,
-                           vocab_chunk: int = 256):
+                           vocab_chunk: int = 256,
+                           pipeline_schedule: str = "gpipe",
+                           virtual_stages: int = 1):
     """The FLAGSHIP composed-3D step: the real ``BertForPretraining``
     stack — MultiHeadAttention (flash path on TPU), post-norm encoder
     blocks, fused chunked linear-CE MLM head, NSP head — trained under
@@ -147,8 +149,9 @@ def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
                          num_heads=4, intermediate_size=128,
                          max_position=64, dropout=0.0)
     n_pp, n_dp = mesh.shape["pp"], mesh.shape["dp"]
-    enforce(cfg.num_layers % n_pp == 0,
-            "pp size %s must divide num_layers %s", n_pp, cfg.num_layers)
+    enforce(cfg.num_layers % (n_pp * virtual_stages) == 0,
+            "pp size x virtual stages (%s x %s) must divide num_layers %s",
+            n_pp, virtual_stages, cfg.num_layers)
     enforce(batch % (num_microbatches * n_dp) == 0,
             "microbatches x dp (%s) must divide batch size %s",
             num_microbatches * n_dp, batch)
@@ -207,7 +210,8 @@ def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
         if pipelined:
             h = pipeline_apply(block_fn, p["layers"], x,
                                num_microbatches=num_microbatches,
-                               mesh=mesh)
+                               mesh=mesh, schedule=pipeline_schedule,
+                               virtual_stages=virtual_stages)
             h = constraint(h, P("dp"), mesh=mesh)
         else:
             def one(hc, p_l):
