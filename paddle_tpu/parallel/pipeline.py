@@ -98,9 +98,13 @@ def bubble_fraction(n: int, m: int, schedule: str = "gpipe",
                     virtual_stages: int = 1) -> float:
     """Idle fraction of each device's timeline under the schedule —
     the quantity the interleaved schedule exists to shrink."""
+    enforce(schedule in ("gpipe", "interleaved"),
+            "schedule must be 'gpipe' or 'interleaved', got %r", schedule)
     if schedule == "interleaved":
         t = interleaved_ticks(n, m, virtual_stages)
         return 1.0 - (m * virtual_stages) / t
+    enforce(virtual_stages == 1,
+            "gpipe schedule has no virtual stages (got %s)", virtual_stages)
     return 1.0 - m / gpipe_ticks(n, m)
 
 
@@ -147,7 +151,8 @@ def _pipeline_inner(params_nk, x_mb, *, block_fn, axis, n, m, remat):
     (_, outbuf), _ = lax.scan(tick, (state0, outbuf0), jnp.arange(n + m - 1))
     # only the last stage's buffer is real; mask+psum broadcasts it so the
     # result is replicated over 'pp' (loss/optimizer run identically on all
-    # stages — the XLA partitioner then dedups what it can)
+    # stages — the XLA partitioner then dedups what it can). n == 1 never
+    # reaches here: pipeline_apply short-circuits to a sequential fold
     outbuf = jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf))
     return lax.psum(outbuf, axis)
 
@@ -205,6 +210,7 @@ def _interleaved_inner(params_nvk, x_mb, *, block_fn, axis, n, m, v,
     outbuf0 = jnp.zeros((m,) + mb_shape, jnp.result_type(x_mb.dtype))
     T = interleaved_ticks(n, m, v)
     (_, outbuf), _ = lax.scan(tick, (state0, outbuf0), jnp.arange(T))
+    # n == 1 never reaches here (pipeline_apply short-circuits)
     outbuf = jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf))
     return lax.psum(outbuf, axis)
 
@@ -247,6 +253,20 @@ def pipeline_apply(block_fn: Callable, stacked_params, x, *,
     B = x.shape[0]
     enforce(B % m == 0,
             "num_microbatches %s must divide batch size %s", m, B)
+    if n == 1:
+        # a 1-stage pipeline IS the sequential fold; skip the shard_map
+        # entirely — the degenerate manual region would still wrap every
+        # auto dp/tp collective in a size-1 manual subgroup, which the
+        # SPMD partitioner rejects in MULTI-PROCESS compiles (seen with
+        # the dcn_dp x dp x tp hybrid mesh, pp = 1)
+        def fold(h, p_l):
+            return block_fn(p_l, h), None
+
+        body = jax.checkpoint(fold) if remat else fold
+        # match the pipelined path's output dtype contract (outbuf is
+        # result_type(x.dtype) there, whatever block_fn returns)
+        return lax.scan(body, x, stacked_params)[0].astype(
+            jnp.result_type(x.dtype))
     x_mb = x.reshape(m, B // m, *x.shape[1:])
 
     if schedule == "interleaved" and v > 1:

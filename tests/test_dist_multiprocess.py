@@ -529,3 +529,96 @@ def test_elastic_kill_and_resume_matches_uninterrupted(tmp_path):
     for s in (5, 6, 7, 8):
         np.testing.assert_allclose(cont[s], ref[s], rtol=1e-5,
                                    err_msg=f"step {s}")
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r3 #6: the hybrid-DCN mesh ACROSS processes — 2 launcher
+# processes x 4 devices form build_hybrid_mesh(dcn_dp=2, dp=2, tp=2) and
+# run the flagship BERT hybrid step; losses match the single-process run
+# (reference analog: NCCL2 multi-trainer mode,
+# paddle/fluid/framework/parallel_executor.cc:257-299)
+# ---------------------------------------------------------------------------
+
+DCN_BERT_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import fleet
+from paddle_tpu.core.mesh import build_hybrid_mesh
+from paddle_tpu.parallel.hybrid import build_bert_hybrid_step
+
+f = fleet.init()
+rank = f.worker_index()
+assert len(jax.devices()) == 8, "expected 8 global devices"
+
+# DCN-outermost data parallelism: the dp axis is dcn_dp x dp = 4 with the
+# process (DCN) dimension outermost, tp stays intra-process (ICI-local)
+mesh = build_hybrid_mesh(dcn_dp=2, dp=2, tp=2)
+dp_col = mesh.devices[:, 0, 0, 0, 0]
+assert len({d.process_index for d in dp_col}) == 2, "dp must span DCN"
+tp_row = mesh.devices[0, 0, :, 0, 0]
+assert len({d.process_index for d in tp_row}) == 1, "tp must stay local"
+
+step, _, params, feed = build_bert_hybrid_step(mesh, batch=8,
+                                               num_microbatches=2)
+jstep = jax.jit(step)
+losses = []
+for i in range(2):
+    loss, params = jstep(params, *feed)
+    losses.append(float(loss))
+print("LOSSES[%%d]:%%s" %% (rank, json.dumps(losses)), flush=True)
+f.shutdown()
+"""
+
+
+def test_launch_hybrid_dcn_bert_matches_single_process(tmp_path):
+    """2 processes x 4 devices -> build_hybrid_mesh(dcn_dp=2, dp=2, tp=2)
+    running the real BertForPretraining hybrid step: per-rank losses
+    agree and match the same mesh built single-process AND the
+    sequential (non-pipelined) form."""
+    import jax
+
+    if len(jax.devices()) < 8:  # the single-process reference needs 8
+        pytest.skip("needs 8 virtual devices")
+    script = tmp_path / "dcn_bert_worker.py"
+    script.write_text(DCN_BERT_WORKER % {"repo": REPO})
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--local-devices", "4",
+         "--log-dir", str(log_dir), "--timeout", "420", str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=480)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+    rank0 = _losses_from(r.stdout, 0)
+    with open(log_dir / "workerlog.1") as fh:
+        rank1 = _losses_from(fh.read(), 1)
+    np.testing.assert_allclose(rank0, rank1, rtol=1e-5)
+
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.core.mesh import build_hybrid_mesh
+    from paddle_tpu.parallel.hybrid import build_bert_hybrid_step
+
+    mesh = build_hybrid_mesh(dcn_dp=2, dp=2, tp=2,
+                             devices=jax.devices()[:8])
+    step, ref_step, params, feed = build_bert_hybrid_step(
+        mesh, batch=8, num_microbatches=2)
+    jstep = jax.jit(step)
+    ref = []
+    for i in range(2):
+        loss, params = jstep(params, *feed)
+        ref.append(float(loss))
+    np.testing.assert_allclose(rank0, ref, rtol=1e-4, atol=1e-5)
+
+    # and the sequential form agrees on step-0 loss
+    _, _, params2, feed2 = build_bert_hybrid_step(mesh, batch=8,
+                                                  num_microbatches=2)
+    seq_loss = float(jax.jit(ref_step)(params2, *feed2)[0])
+    assert abs(seq_loss - rank0[0]) < 1e-4, (seq_loss, rank0[0])
