@@ -3,6 +3,9 @@
 # for this repo: native build, API freeze gate, tiered tests, wheel.
 #
 #   tools/ci.sh smoke    # native build + API gate + smoke tier (~2 min)
+#   tools/ci.sh mid      # + one deep test per subsystem (~5-6 min;
+#                        #   pallas, partitioning, hybrid 3D, CP, quant,
+#                        #   native, serving — certify without the full bill)
 #   tools/ci.sh full     # everything incl. the slow tier (~15-25 min)
 #   tools/ci.sh wheel    # build a wheel into dist/
 #
@@ -34,6 +37,14 @@ case "$MODE" in
     stage "smoke tier (pytest -m smoke)"
     python -m pytest tests/ -m smoke -q || exit $?
     ;;
+  mid)
+    stage "mid tier (pytest -m mid)"
+    python -m pytest tests/ -m mid -q || exit $?
+    stage "multichip dryrun (8-device CPU sim)"
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
+      || exit $?
+    ;;
   full)
     stage "full suite"
     python -m pytest tests/ -q || exit $?
@@ -52,7 +63,7 @@ case "$MODE" in
     ls -la dist/
     ;;
   *)
-    echo "unknown mode: $MODE (smoke|full|wheel)" >&2
+    echo "unknown mode: $MODE (smoke|mid|full|wheel)" >&2
     exit 2
     ;;
 esac
