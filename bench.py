@@ -631,9 +631,13 @@ def bench_deepfm(steps: int, batch_size: int, amp=None,
                         amp=amp)
 
 
-def bench_stacked_lstm(steps: int, batch_size: int, amp=None):
+def bench_stacked_lstm(steps: int, batch_size: int, amp=None,
+                       scan_unroll: int = 1):
     """Bench model 6: stacked dynamic LSTM sentiment (reference:
-    benchmark/fluid/models/stacked_dynamic_lstm.py), seq 100."""
+    benchmark/fluid/models/stacked_dynamic_lstm.py), seq 100.
+    ``--scan-unroll K`` unrolls the time recurrence K steps per compiled
+    loop body (identical math) — the r3 3.1%-MFU diagnosis was
+    batch-starved AND scan-overhead-bound; sweep with --batch-size."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -642,7 +646,7 @@ def bench_stacked_lstm(steps: int, batch_size: int, amp=None):
     pt.seed(0)
     batch_size = _cap(batch_size, 64)
     model = S.StackedLSTM(vocab_size=5149, embed_dim=512, hidden_dim=512,
-                          num_layers=3)
+                          num_layers=3, scan_unroll=scan_unroll)
     rng = np.random.default_rng(0)
     T = 100
 
@@ -687,8 +691,11 @@ def bench_vgg16(steps: int, batch_size: int, smoke: bool = False, amp=None):
 
 
 def bench_se_resnext50(steps: int, batch_size: int, smoke: bool = False,
-                       amp=None):
-    """Bench model: se_resnext (reference benchmark list)."""
+                       amp=None, layout: str = "NHWC"):
+    """Bench model: se_resnext (reference benchmark list). NHWC is the
+    TPU-native layout default (r3 measured 9.5% MFU in NCHW — the
+    grouped-conv stack is layout-sensitive); pass --layout NCHW to
+    compare."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -697,8 +704,7 @@ def bench_se_resnext50(steps: int, batch_size: int, smoke: bool = False,
     pt.seed(0)
     size = 64 if smoke else 224
     batch_size = _cap(batch_size, 8 if smoke else 64)
-    model = (S.se_resnext50(num_classes=1000)
-             if hasattr(S, "se_resnext50") else S.SEResNeXt())
+    model = S.se_resnext50(num_classes=1000, data_format=layout)
     rng = np.random.default_rng(0)
 
     def make_batch(bs):
@@ -829,6 +835,10 @@ def main():
                     action="store_true",
                     help="bert: lax.scan over the layer stack (dropout "
                     "forced to 0)")
+    ap.add_argument("--scan-unroll", dest="scan_unroll", type=int,
+                    default=None,
+                    help="stacked_lstm: unroll the time-recurrence scan "
+                    "K steps per compiled loop body (identical math)")
     ap.add_argument("--amp", default="mixed_bf16",
                     help="dtype policy for the step (mixed_bf16 is the TPU "
                     "training default; pass float32 to disable)")
@@ -900,6 +910,18 @@ def main():
         # same workload, different implementation — its own history key
         # so the cache-vs-recompute comparison stays visible
         metric += "_nocache"
+    if (args.scan_unroll and "scan_unroll" in sig
+            and args.scan_unroll != sig["scan_unroll"].default):
+        # same math, different compiled loop body — own key for the sweep
+        metric += f"_u{args.scan_unroll}"
+    if args.layout and "layout" in sig and args.layout != sig["layout"].default:
+        metric += f"_{args.layout.lower()}"
+    if args.steps_per_call:
+        # an EXPLICIT dispatch-fusion factor is a sweep point, not the
+        # headline config: its own history key (models whose headline IS
+        # fused, e.g. mnist k=8, set it via their bench signature default
+        # and stay unsuffixed)
+        metric += f"_k{args.steps_per_call}"
     if _EXPLICIT_BATCH:
         metric += f"_b{batch}"
     if args.infer and args.model == "deepfm_sparse":
@@ -966,6 +988,8 @@ def main():
         kwargs["remat"] = True
     if "scan_layers" in sig and args.scan_layers:
         kwargs["scan_layers"] = True
+    if "scan_unroll" in sig and args.scan_unroll:
+        kwargs["scan_unroll"] = args.scan_unroll
     if "vocab" in sig and args.vocab:
         kwargs["vocab"] = args.vocab
     if "window" in sig and args.window:

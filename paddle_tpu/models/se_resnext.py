@@ -15,32 +15,39 @@ from .resnet import ResNet, _conv_bn
 class SEBlock(nn.Layer):
     """Squeeze-excitation: global pool → bottleneck MLP → sigmoid scale."""
 
-    def __init__(self, ch: int, reduction: int = 16):
+    def __init__(self, ch: int, reduction: int = 16,
+                 data_format: str = "NCHW"):
         super().__init__()
         self.fc1 = nn.Linear(ch, max(ch // reduction, 4), act="relu")
         self.fc2 = nn.Linear(max(ch // reduction, 4), ch, act="sigmoid")
+        self.data_format = data_format
 
     def forward(self, x):
-        s = jnp.mean(x, axis=(2, 3))           # (N, C)
-        s = self.fc2(self.fc1(s))
-        return x * s[:, :, None, None]
+        spatial = (2, 3) if self.data_format == "NCHW" else (1, 2)
+        s = self.fc2(self.fc1(jnp.mean(x, axis=spatial)))  # (N, C)
+        if self.data_format == "NCHW":
+            return x * s[:, :, None, None]
+        return x * s[:, None, None, :]
 
 
 class SEBottleneck(nn.Layer):
     expansion = 2  # ResNeXt-style wide bottleneck
 
     def __init__(self, in_ch: int, ch: int, stride: int = 1,
-                 cardinality: int = 32, reduction: int = 16, **_):
+                 cardinality: int = 32, reduction: int = 16,
+                 data_format: str = "NCHW", **_):
         super().__init__()
         width = ch * 2
         out_ch = ch * self.expansion * 2
-        self.conv1 = _conv_bn(in_ch, width, 1)
+        df = data_format
+        self.conv1 = _conv_bn(in_ch, width, 1, data_format=df)
         self.conv2 = _conv_bn(width, width, 3, stride=stride,
-                              groups=cardinality)
-        self.conv3 = _conv_bn(width, out_ch, 1, act=None)
-        self.se = SEBlock(out_ch, reduction)
+                              groups=cardinality, data_format=df)
+        self.conv3 = _conv_bn(width, out_ch, 1, act=None, data_format=df)
+        self.se = SEBlock(out_ch, reduction, data_format=df)
         self.short = (None if in_ch == out_ch and stride == 1
-                      else _conv_bn(in_ch, out_ch, 1, stride=stride, act=None))
+                      else _conv_bn(in_ch, out_ch, 1, stride=stride,
+                                    act=None, data_format=df))
 
     def forward(self, x):
         y = self.se(self.conv3(self.conv2(self.conv1(x))))
@@ -49,11 +56,18 @@ class SEBottleneck(nn.Layer):
 
 
 class SEResNeXt(nn.Layer):
+    """``data_format="NHWC"`` is the TPU-native layout (channels on the
+    128-lane minor dim; no boundary transposes) — the bench default."""
+
     def __init__(self, depths=(3, 4, 6, 3), num_classes: int = 1000,
-                 in_ch: int = 3, cardinality: int = 32):
+                 in_ch: int = 3, cardinality: int = 32,
+                 data_format: str = "NCHW"):
         super().__init__()
-        self.stem = _conv_bn(in_ch, 64, 7, stride=2)
-        self.maxpool = nn.Pool2D(3, "max", stride=2, padding=1)
+        df = data_format
+        self.data_format = df
+        self.stem = _conv_bn(in_ch, 64, 7, stride=2, data_format=df)
+        self.maxpool = nn.Pool2D(3, "max", stride=2, padding=1,
+                                 data_format=df)
         widths = [64, 128, 256, 512]
         blocks = []
         cur = 64
@@ -61,16 +75,20 @@ class SEResNeXt(nn.Layer):
             for i in range(n):
                 stride = 2 if (i == 0 and stage > 0) else 1
                 blocks.append(SEBottleneck(cur, w, stride=stride,
-                                           cardinality=cardinality))
+                                           cardinality=cardinality,
+                                           data_format=df))
                 cur = w * SEBottleneck.expansion * 2
         self.blocks = nn.LayerList(blocks)
         self.head = nn.Linear(cur, num_classes)
 
     def forward(self, x):
+        if self.data_format == "NHWC":
+            x = jnp.transpose(x, (0, 2, 3, 1))  # accept NCHW inputs
         x = self.maxpool(self.stem(x))
         for blk in self.blocks:
             x = blk(x)
-        return self.head(jnp.mean(x, axis=(2, 3)))
+        spatial = (2, 3) if self.data_format == "NCHW" else (1, 2)
+        return self.head(jnp.mean(x, axis=spatial))
 
 
 def se_resnext50(num_classes: int = 1000, **kw) -> SEResNeXt:

@@ -19,14 +19,15 @@ from ..ops.sequence import sequence_mask
 class StackedLSTM(nn.Layer):
     def __init__(self, vocab_size: int = 5149, embed_dim: int = 512,
                  hidden_dim: int = 512, num_layers: int = 3,
-                 num_classes: int = 2):
+                 num_classes: int = 2, scan_unroll: int = 1):
         super().__init__()
         self.embedding = nn.Embedding(vocab_size, embed_dim)
         self.num_layers = num_layers
         for i in range(num_layers):
             in_dim = embed_dim if i == 0 else hidden_dim
             self.add_sublayer(f"fc{i}", nn.Linear(in_dim, hidden_dim))
-            self.add_sublayer(f"lstm{i}", nn.LSTM(hidden_dim, hidden_dim))
+            self.add_sublayer(f"lstm{i}", nn.LSTM(hidden_dim, hidden_dim,
+                                                  scan_unroll=scan_unroll))
         self.out = nn.Linear(2 * hidden_dim, num_classes)
 
     def forward(self, ids, lengths):

@@ -25,8 +25,11 @@ class _RecurrentBase(Layer):
 
     def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
                  direction: str = "forward", dropout: float = 0.0,
-                 dtype=None):
+                 dtype=None, scan_unroll: int = 1):
         super().__init__()
+        # lax.scan unroll factor for the time recurrence (1 = no unroll);
+        # a throughput knob, identical math
+        self.scan_unroll = scan_unroll
         enforce(direction in ("forward", "bidirect", "bidirectional"),
                 "direction must be forward|bidirect, got %s", direction)
         self.input_size, self.hidden_size = input_size, hidden_size
@@ -84,7 +87,7 @@ class LSTM(_RecurrentBase):
         return R.lstm(x, getattr(self, f"w_ih_{sfx}"),
                       getattr(self, f"w_hh_{sfx}"),
                       bias=getattr(self, f"bias_{sfx}"), lengths=lengths,
-                      is_reverse=is_reverse)
+                      is_reverse=is_reverse, unroll=self.scan_unroll)
 
     def _stack_states(self, finals):
         return (jnp.stack([f[0] for f in finals]),
@@ -100,7 +103,7 @@ class GRU(_RecurrentBase):
         return R.gru(x, getattr(self, f"w_ih_{sfx}"),
                      getattr(self, f"w_hh_{sfx}"),
                      bias=getattr(self, f"bias_{sfx}"), lengths=lengths,
-                     is_reverse=is_reverse)
+                     is_reverse=is_reverse, unroll=self.scan_unroll)
 
     def _stack_states(self, finals):
         return jnp.stack(finals)

@@ -77,7 +77,7 @@ def lstm(x, w_ih, w_hh, bias=None, h0=None, c0=None, lengths=None,
          forget_bias: float = 0.0, is_reverse: bool = False,
          proj_weight=None, proj_activation: str = "identity",
          gate_activation: str = "sigmoid", cell_activation: str = "tanh",
-         candidate_activation: str = "tanh"):
+         candidate_activation: str = "tanh", unroll: int = 1):
     """Full-sequence LSTM (reference: operators/lstm_op.cc; with
     ``proj_weight`` it is lstmp, reference: operators/lstmp_op.cc).
 
@@ -118,7 +118,11 @@ def lstm(x, w_ih, w_hh, bias=None, h0=None, c0=None, lengths=None,
             out = new_h
         return (new_h, new_c, pos + 1), out
 
-    (h_t, c_t, _), outs = lax.scan(step, (h0, c0, 0), (gates_x,))
+    # unroll > 1 amortizes the per-step scan overhead on TPU (more
+    # h @ w_hh matmuls visible per compiled loop body for XLA to
+    # software-pipeline); identical math, swept by bench --scan-unroll
+    (h_t, c_t, _), outs = lax.scan(step, (h0, c0, 0), (gates_x,),
+                                   unroll=unroll)
     if is_reverse:
         outs = jnp.flip(outs, axis=0)
     return jnp.swapaxes(outs, 0, 1), (h_t, c_t)
@@ -126,7 +130,7 @@ def lstm(x, w_ih, w_hh, bias=None, h0=None, c0=None, lengths=None,
 
 def gru(x, w_ih, w_hh, bias=None, h0=None, lengths=None,
         is_reverse: bool = False, gate_activation: str = "sigmoid",
-        activation: str = "tanh"):
+        activation: str = "tanh", unroll: int = 1):
     """Full-sequence GRU (reference: operators/gru_op.cc).
 
     x: (B, T, D); w_ih: (D, 3H); w_hh: (H, 3H); bias: (3H,).
@@ -155,7 +159,7 @@ def gru(x, w_ih, w_hh, bias=None, h0=None, lengths=None,
             out = new_h
         return (new_h, pos + 1), out
 
-    (h_t, _), outs = lax.scan(step, (h0, 0), (gates_x,))
+    (h_t, _), outs = lax.scan(step, (h0, 0), (gates_x,), unroll=unroll)
     if is_reverse:
         outs = jnp.flip(outs, axis=0)
     return jnp.swapaxes(outs, 0, 1), h_t

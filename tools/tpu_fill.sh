@@ -99,6 +99,17 @@ item deepfm_sparse_v1m 1200 python bench.py --model deepfm_sparse --vocab 100000
 item bench_nmt_b256    1200 python bench.py --model transformer_nmt --batch-size 256
 item bench_rn50_b256   1500 python bench.py --model resnet50 --batch-size 256
 item bench_lstm_b2048  1200 python bench.py --model stacked_lstm --batch-size 2048
+# r4 MFU levers (VERDICT r3 #4): scan-unroll sweep for the LSTM
+# recurrence, steps-per-call for the dispatch-bound CTR model (the
+# BASELINE roofline note: 12 ms/step measured vs ~73 us ceiling),
+# NHWC-vs-NCHW + batch for the grouped-conv stack, bigger NMT batch
+item bench_lstm_u4     1200 python bench.py --model stacked_lstm --batch-size 2048 --scan-unroll 4
+item bench_lstm_u8     1200 python bench.py --model stacked_lstm --batch-size 2048 --scan-unroll 8
+item bench_deepfm_k8   1200 python bench.py --model deepfm --steps-per-call 8
+item bench_deepfm_k32  1200 python bench.py --model deepfm --steps-per-call 32
+item bench_se_nchw     1500 python bench.py --model se_resnext50 --layout NCHW
+item bench_se_b128     1500 python bench.py --model se_resnext50 --batch-size 128
+item bench_nmt_b512    1500 python bench.py --model transformer_nmt --batch-size 512
 item bench_bertlong_b8 1500 python bench.py --model bert_long --batch-size 8
 # O(T*W) local attention at seq 2048 — compare against bench_bertlong2
 # (same model, same DEFAULT batch of 4; the _w256 metric key keeps the
