@@ -398,6 +398,11 @@ const char* ptpred_feed_dtype(void* h, int i) {
   auto it = p->feed_dtypes.find(p->feed_names[i]);
   return it == p->feed_dtypes.end() ? "float32" : it->second.c_str();
 }
+int ptpred_feed_elem_size(void* h, int i) {
+  // element width in bytes, 0 if the dtype is unsupported — C-ABI view
+  // of ptnative::DtypeSize so clients (ptserve) share ONE dtype table
+  return (int)ptnative::DtypeSize(ptpred_feed_dtype(h, i));
+}
 int ptpred_num_state_outputs(void* h) {
   return static_cast<Predictor*>(h)->num_state_outputs;
 }

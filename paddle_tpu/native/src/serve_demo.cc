@@ -1,12 +1,18 @@
-// Standalone C++ serving demo — Python-free model serving (capability
+// Standalone C++ serving harness — Python-free model serving (capability
 // parity with the reference's Python-free path: paddle/fluid/train/demo/
-// demo_trainer.cc loads ProgramDescs and runs them from C++; here we load
-// a save_inference_model StableHLO artifact and serve it via PJRT).
+// demo_trainer.cc loads ProgramDescs and runs them from C++, and the
+// reference's inference/tests/api analyzer latency tests time the
+// predictor; here we load a save_inference_model StableHLO artifact,
+// serve it via PJRT, and report p50/p99 latency).
 //
-// Usage: ptserve <model_dir> <pjrt_plugin.so> [batch]
-//   feeds zeros of the manifest-declared shapes, prints output shapes +
-//   first values. Exit 0 on success.
+// Usage: ptserve <model_dir> <pjrt_plugin.so> [batch] [iters] [warmup]
+//   Feeds zeros shaped per the manifest's feed_shapes/feed_dtypes (the
+//   leading/-1 dim replaced by [batch]). iters > 1 times every run and
+//   prints a latency summary JSON line (p50/p99/mean ms, examples/sec).
+//   Exit 0 on success.
 
+#include <algorithm>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -21,6 +27,10 @@ const char* ptpred_error(void* h);
 int ptpred_compile(void* h, const char* plugin_path);
 int ptpred_num_feeds(void* h);
 const char* ptpred_feed_name(void* h, int i);
+int ptpred_feed_rank(void* h, int i);
+int64_t ptpred_feed_dim(void* h, int i, int d);
+const char* ptpred_feed_dtype(void* h, int i);
+int ptpred_feed_elem_size(void* h, int i);
 int ptpred_num_fetches(void* h);
 const char* ptpred_fetch_name(void* h, int i);
 int ptpred_run(void* h, const void** feed_ptrs, const int64_t* dims,
@@ -33,39 +43,82 @@ void ptpred_destroy(void* h);
 
 int main(int argc, char** argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: %s <model_dir> <pjrt_plugin.so> [batch]\n",
+    fprintf(stderr,
+            "usage: %s <model_dir> <pjrt_plugin.so> [batch] [iters] "
+            "[warmup]\n",
             argv[0]);
     return 64;
   }
-  int batch = argc > 3 ? atoi(argv[3]) : 1;
+  int64_t batch = argc > 3 ? atoll(argv[3]) : 1;
+  int iters = argc > 4 ? atoi(argv[4]) : 1;
+  int warmup = argc > 5 ? atoi(argv[5]) : 2;
   void* p = ptpred_load(argv[1]);
   if (!ptpred_ok(p)) {
     fprintf(stderr, "load failed: %s\n", ptpred_error(p));
     return 1;
   }
-  printf("model loaded: %d feeds, %d fetches\n", ptpred_num_feeds(p),
+  int nf = ptpred_num_feeds(p);
+  printf("model loaded: %d feeds, %d fetches\n", nf,
          ptpred_num_fetches(p));
   if (!ptpred_compile(p, argv[2])) {
     fprintf(stderr, "compile failed: %s\n", ptpred_error(p));
     return 2;
   }
-  // feeds: zeros; shapes come from the manifest via the feed introspection
-  // (simplest demo: assume rank-2 (batch, dim) float32 feeds; a real server
-  // would read manifest feed_shapes — kept minimal like demo_trainer.cc)
-  int nf = ptpred_num_feeds(p);
-  std::vector<std::vector<float>> storage(nf);
+  // zero-filled feeds shaped from the manifest; the leading (or any
+  // negative/polymorphic) batch dim becomes [batch]
+  std::vector<std::vector<uint8_t>> storage(nf);
   std::vector<const void*> ptrs(nf);
   std::vector<int64_t> dims;
-  std::vector<int> ranks(nf, 2);
+  std::vector<int> ranks(nf);
   for (int i = 0; i < nf; i++) {
-    storage[i].assign((size_t)batch * 784, 0.0f);  // demo: mnist-sized
+    int rank = ptpred_feed_rank(p, i);
+    if (rank < 0) {  // no manifest shape: legacy demo fallback (B, 784)
+      rank = 2;
+      dims.push_back(batch);
+      dims.push_back(784);
+      storage[i].assign((size_t)batch * 784 * 4, 0);
+    } else {
+      size_t elems = 1;
+      for (int d = 0; d < rank; d++) {
+        int64_t dim = ptpred_feed_dim(p, i, d);
+        if (dim < 0) {
+          dim = batch;  // polymorphic dim: caller picks the batch
+        } else if (d == 0 && dim != batch && argc > 3) {
+          // fixed-shape artifact: honor the traced batch; an override
+          // would shape-mismatch at PJRT execute with no useful message
+          fprintf(stderr,
+                  "note: feed %s has fixed batch %lld; ignoring "
+                  "requested batch %lld\n",
+                  ptpred_feed_name(p, i), (long long)dim,
+                  (long long)batch);
+          batch = dim;
+        }
+        dims.push_back(dim);
+        elems *= (size_t)dim;
+      }
+      int esz = ptpred_feed_elem_size(p, i);
+      if (esz <= 0) {
+        fprintf(stderr, "unsupported feed dtype %s\n",
+                ptpred_feed_dtype(p, i));
+        return 4;
+      }
+      storage[i].assign(elems * (size_t)esz, 0);
+    }
+    ranks[i] = rank;
     ptrs[i] = storage[i].data();
-    dims.push_back(batch);
-    dims.push_back(784);
   }
-  if (!ptpred_run(p, ptrs.data(), dims.data(), ranks.data())) {
-    fprintf(stderr, "run failed: %s\n", ptpred_error(p));
-    return 3;
+  std::vector<double> lat_ms;
+  lat_ms.reserve(iters);
+  for (int it = 0; it < warmup + iters; it++) {
+    auto t0 = std::chrono::steady_clock::now();
+    if (!ptpred_run(p, ptrs.data(), dims.data(), ranks.data())) {
+      fprintf(stderr, "run failed: %s\n", ptpred_error(p));
+      return 3;
+    }
+    auto t1 = std::chrono::steady_clock::now();
+    if (it >= warmup)
+      lat_ms.push_back(
+          std::chrono::duration<double, std::milli>(t1 - t0).count());
   }
   for (int i = 0; i < ptpred_num_fetches(p); i++) {
     printf("fetch %s: shape(", ptpred_fetch_name(p, i));
@@ -74,6 +127,21 @@ int main(int argc, char** argv) {
     int64_t nbytes = 0;
     const float* data = (const float*)ptpred_out_data(p, i, &nbytes);
     printf(") first=%g\n", nbytes >= 4 ? data[0] : 0.0);
+  }
+  if (!lat_ms.empty()) {
+    std::sort(lat_ms.begin(), lat_ms.end());
+    double sum = 0;
+    for (double v : lat_ms) sum += v;
+    size_t n = lat_ms.size();
+    double p50 = lat_ms[n / 2];
+    double p99 = lat_ms[std::min(n - 1, (size_t)(0.99 * n))];
+    double mean = sum / n;
+    // one JSON line, bench.py style — the analyzer-latency-test role
+    printf(
+        "{\"metric\": \"native_serve_latency_ms\", \"p50\": %.3f, "
+        "\"p99\": %.3f, \"mean\": %.3f, \"batch\": %lld, \"iters\": %zu, "
+        "\"examples_per_sec\": %.1f}\n",
+        p50, p99, mean, (long long)batch, n, batch * 1000.0 / mean);
   }
   ptpred_destroy(p);
   printf("ok\n");

@@ -1,0 +1,71 @@
+"""Export bench models as serving artifacts for the NATIVE latency
+harness (ptserve) — the reference's save_inference_model →
+inference/tests/api analyzer-latency flow (reference:
+paddle/fluid/inference/tests/api/analyzer_resnet50_tester.cc role).
+
+    python tools/export_serving.py --model resnet50 --out /tmp/rn50_art
+    paddle_tpu/native/ptserve /tmp/rn50_art <libtpu.so> 8 50
+
+Models: resnet50 (NHWC, 224px) and bert_base (seq 128). Exported in
+eval mode with the manifest's feed_shapes carrying a polymorphic batch
+dim, so ptserve can sweep batch sizes from one artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def export_resnet50(out: str):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import jit
+    from paddle_tpu.models import resnet
+
+    pt.seed(0)
+    model = resnet.resnet50(num_classes=1000, data_format="NHWC").eval()
+    x = jnp.asarray(np.zeros((1, 3, 224, 224), np.float32))
+    jit.save(model, out, [x], input_names=["image"])
+
+
+def export_bert_base(out: str):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import jit
+    from paddle_tpu.models import bert as B
+
+    pt.seed(0)
+    model = B.BertModel(B.BertConfig.base()).eval()
+    ids = jnp.asarray(np.zeros((1, 128), np.int32))
+    jit.save(model, out, [ids], input_names=["input_ids"])
+
+
+EXPORTS = {"resnet50": export_resnet50, "bert_base": export_bert_base}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, choices=sorted(EXPORTS))
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--platform", default=None,
+                    help="cpu to export off-chip (artifact is portable)")
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    EXPORTS[args.model](args.out)
+    print(f"exported {args.model} -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

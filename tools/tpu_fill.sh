@@ -129,6 +129,11 @@ item infer_nmt         1200 python bench.py --infer --model transformer_nmt
 # CPU already shows 4.8x for the cache at max_len 64)
 item decode_nmt        1200 python bench.py --model nmt_decode
 item decode_nmt_full   1500 python bench.py --model nmt_decode --no-kv-cache
+# NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
+# C++ predictor + PJRT C API (export runs off-chip: StableHLO is
+# portable; only the ptserve compile+run needs the chip)
+item serve_rn50        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model resnet50 --out /tmp/rn50_art --platform cpu && paddle_tpu/native/ptserve /tmp/rn50_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 50'
+item serve_bert        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model bert_base --out /tmp/bert_art --platform cpu && paddle_tpu/native/ptserve /tmp/bert_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 50'
 # -- tier 4: full-sweep completeness (superset of the retired
 # tpu_session.sh list so a FRESH environment gets every model and every
 # default tune shape from this one script; in an already-captured
