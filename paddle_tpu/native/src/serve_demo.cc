@@ -64,8 +64,25 @@ int main(int argc, char** argv) {
     fprintf(stderr, "compile failed: %s\n", ptpred_error(p));
     return 2;
   }
-  // zero-filled feeds shaped from the manifest; the leading (or any
-  // negative/polymorphic) batch dim becomes [batch]
+  // pre-pass: resolve the EFFECTIVE batch before sizing any buffer —
+  // a fixed-shape artifact (jit.save's concrete fallback) pins it to
+  // the traced leading dim; an override would shape-mismatch at PJRT
+  // execute with no useful message, and feeds must agree on it
+  for (int i = 0; i < nf; i++) {
+    int rank = ptpred_feed_rank(p, i);
+    if (rank < 1) continue;
+    int64_t d0 = ptpred_feed_dim(p, i, 0);
+    if (d0 > 0 && d0 != batch) {
+      if (argc > 3)
+        fprintf(stderr,
+                "note: feed %s has fixed batch %lld; ignoring "
+                "requested batch %lld\n",
+                ptpred_feed_name(p, i), (long long)d0, (long long)batch);
+      batch = d0;
+    }
+  }
+  // zero-filled feeds shaped from the manifest; negative/polymorphic
+  // dims become the resolved [batch]
   std::vector<std::vector<uint8_t>> storage(nf);
   std::vector<const void*> ptrs(nf);
   std::vector<int64_t> dims;
@@ -81,18 +98,7 @@ int main(int argc, char** argv) {
       size_t elems = 1;
       for (int d = 0; d < rank; d++) {
         int64_t dim = ptpred_feed_dim(p, i, d);
-        if (dim < 0) {
-          dim = batch;  // polymorphic dim: caller picks the batch
-        } else if (d == 0 && dim != batch && argc > 3) {
-          // fixed-shape artifact: honor the traced batch; an override
-          // would shape-mismatch at PJRT execute with no useful message
-          fprintf(stderr,
-                  "note: feed %s has fixed batch %lld; ignoring "
-                  "requested batch %lld\n",
-                  ptpred_feed_name(p, i), (long long)dim,
-                  (long long)batch);
-          batch = dim;
-        }
+        if (dim < 0) dim = batch;
         dims.push_back(dim);
         elems *= (size_t)dim;
       }

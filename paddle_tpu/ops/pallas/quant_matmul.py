@@ -88,6 +88,97 @@ def _pallas_quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype,
     )(a_i8, b_i8, a_scale_arr, b_scale_vec)
 
 
+def _qm_impl(a_i8, b_i8, a_scale_arr, b_scale_vec, *, out_dtype, tile_m,
+             tile_n, tile_k, interpret):
+    """Unpadded (global or per-shard) kernel invocation: pad to the tile
+    grid (exact in integer math), run, slice back. Runs per shard under
+    the partitioned call, so local shapes pad independently."""
+    m, ka = a_i8.shape
+    n = b_i8.shape[1]
+
+    def _pad_to(arr, mult, axis):
+        r = (-arr.shape[axis]) % mult
+        if r == 0:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, r)
+        return jnp.pad(arr, widths)
+
+    tm, tn, tk = min(tile_m, m), min(tile_n, n), min(tile_k, ka)
+    a_p = _pad_to(_pad_to(a_i8, tm, 0), tk, 1)
+    b_p = _pad_to(_pad_to(b_i8, tk, 0), tn, 1)
+    bs_p = _pad_to(b_scale_vec, tn, 0)
+    out = _pallas_quant_matmul(
+        a_p, b_p, a_scale_arr, bs_p, out_dtype=out_dtype,
+        tile_m=tm, tile_n=tn, tile_k=tk, interpret=interpret)
+    return out[:m, :n]
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_qm(out_dtype, tile_m, tile_n, tile_k, interpret):
+    """custom_partitioning wrapper: the SPMD partitioners have no rule
+    for a Pallas custom call and would all-gather the operands under
+    pjit (same gap the flash kernel closed — see
+    flash_attention.py). int8 GEMM shards over M (dp batch) and N
+    (column-parallel weights, per-channel scales riding along); K and
+    the scalar scale stay replicated."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    impl = functools.partial(_qm_impl, out_dtype=jnp.dtype(out_dtype),
+                             tile_m=tile_m, tile_n=tile_n, tile_k=tile_k,
+                             interpret=interpret)
+    wrapped = custom_partitioning(lambda *args: impl(*args))
+
+    def _axes_set(x):
+        if x is None:
+            return set()
+        return set(x) if isinstance(x, tuple) else {x}
+
+    def _shardings(mesh, a_sh, b_sh):
+        msh = getattr(a_sh, "mesh", None) or mesh
+        a_spec = tuple(a_sh.spec) + (None,) * (2 - len(tuple(a_sh.spec)))
+        b_spec = tuple(b_sh.spec) + (None,) * (2 - len(tuple(b_sh.spec)))
+        mx, nx = a_spec[0], b_spec[1]
+        if _axes_set(mx) & _axes_set(nx):
+            # e.g. FSDP-style weights sharded over the same axis as the
+            # batch: one mesh axis cannot shard two output dims — keep
+            # the batch sharding, re-replicate the weights' columns
+            nx = None
+        args = (NamedSharding(msh, P(mx, None)),
+                NamedSharding(msh, P(None, nx)),
+                NamedSharding(msh, P(None)),
+                NamedSharding(msh, P(nx)))
+        return msh, args, NamedSharding(msh, P(mx, nx))
+
+    def partition(mesh, arg_shapes, result_shape):
+        a_sh, b_sh = arg_shapes[0].sharding, arg_shapes[1].sharding
+        if hasattr(a_sh, "spec") and hasattr(b_sh, "spec"):
+            msh, arg_sh, res_sh = _shardings(mesh, a_sh, b_sh)
+        else:  # opaque shardings inside a manual region: echo (see flash)
+            msh = mesh
+            arg_sh = tuple(s.sharding for s in arg_shapes)
+            res_sh = result_shape.sharding
+
+        def lower_fn(*args):
+            return impl(*args)
+
+        return msh, lower_fn, res_sh, arg_sh
+
+    def infer_sharding_from_operands(mesh, arg_shapes, shape):
+        a_sh, b_sh = arg_shapes[0].sharding, arg_shapes[1].sharding
+        if not (hasattr(a_sh, "spec") and hasattr(b_sh, "spec")):
+            return NamedSharding(mesh, P())
+        return _shardings(mesh, a_sh, b_sh)[2]
+
+    wrapped.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer_sharding_from_operands,
+        sharding_rule="m k, k n, s, n -> m n",
+        need_replication_factors=("k", "s"))
+    return wrapped
+
+
 def quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype=jnp.float32,
                  tile_m: int = None, tile_n: int = None, tile_k: int = None,
                  use_pallas: bool = None, interpret: bool = False):
@@ -121,26 +212,14 @@ def quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype=jnp.float32,
         use_pallas = (jax.default_backend() in ("tpu", "axon")
                       and tuned.get("use_pallas", True))
     if (use_pallas or interpret) and min(m, n, ka) > 0:
-        # pad every GEMM dim to its tile (zero rows/cols are exact in
-        # integer math), run the kernel, slice back — callers never manage
-        # the tiling contract themselves
-        def _pad_to(arr, mult, axis):
-            r = (-arr.shape[axis]) % mult
-            if r == 0:
-                return arr
-            widths = [(0, 0)] * arr.ndim
-            widths[axis] = (0, r)
-            return jnp.pad(arr, widths)
-
-        tm, tn, tk = min(tile_m, m), min(tile_n, n), min(tile_k, ka)
-        a_p = _pad_to(_pad_to(a_i8, tm, 0), tk, 1)
-        b_p = _pad_to(_pad_to(b_i8, tk, 0), tn, 1)
-        bs_p = _pad_to(jnp.broadcast_to(
-            jnp.asarray(b_scale, jnp.float32), (n,)), tn, 0)
-        out = _pallas_quant_matmul(
-            a_p, b_p, a_scale, bs_p, out_dtype=out_dtype,
-            tile_m=tm, tile_n=tn, tile_k=tk, interpret=interpret)
-        return out[:m, :n]
+        # padding/tiling happens per shard inside the partitioned call
+        # (callers never manage the tiling contract themselves)
+        fn = _partitioned_qm(jnp.dtype(out_dtype).name, int(tile_m),
+                             int(tile_n), int(tile_k), bool(interpret))
+        return fn(a_i8, b_i8,
+                  jnp.asarray(a_scale, jnp.float32).reshape(1),
+                  jnp.broadcast_to(jnp.asarray(b_scale, jnp.float32),
+                                   (n,)))
     acc = jax.lax.dot_general(a_i8, b_i8, (((1,), (0,)), ((), ())),
                               preferred_element_type=jnp.int32)
     scale = jnp.asarray(a_scale, jnp.float32) * \

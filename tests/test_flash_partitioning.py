@@ -207,3 +207,32 @@ def test_dispatch_under_mesh_routes_to_partitioned_flash():
     assert "all-gather" not in txt
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_quant_matmul_partitions_without_gather():
+    """The int8 GEMM kernel carries the same partitioning rule as flash:
+    activations shard over dp (M), column-parallel weights + per-channel
+    scales over tp (N), K replicated — no all-gather in the module and
+    exact agreement with the unsharded run (int8 math is exact)."""
+    from paddle_tpu.ops.pallas.quant_matmul import (quant_matmul,
+                                                    quantize_tensor)
+
+    mesh = pt.build_mesh(dp=2, tp=2, pp=2)
+    rng = np.random.default_rng(9)
+    a = jnp.asarray(rng.normal(size=(64, 96)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(96, 128)).astype(np.float32))
+    a_i8, sa = quantize_tensor(a)
+    b_i8, sb = quantize_tensor(b, per_channel_axis=1)
+    ref = quant_matmul(a_i8, b_i8, sa, sb, interpret=True)
+
+    a_s = jax.device_put(a_i8, NamedSharding(mesh, P("dp", None)))
+    b_s = jax.device_put(b_i8, NamedSharding(mesh, P(None, "tp")))
+    sb_s = jax.device_put(sb, NamedSharding(mesh, P("tp")))
+    fn = jax.jit(lambda a, b, s: quant_matmul(a, b, sa, s, interpret=True))
+    txt = fn.lower(a_s, b_s, sb_s).compile().as_text()
+    assert "all-gather" not in txt
+    out = fn(a_s, b_s, sb_s)
+    s = tuple(out.sharding.spec) + (None,) * (2 - len(out.sharding.spec))
+    assert s == ("dp", "tp"), s
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
