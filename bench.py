@@ -306,14 +306,16 @@ def bench_resnet50(steps: int, batch_size: int, smoke: bool = False,
 
 
 def bench_bert_base(steps: int, batch_size: int, amp=None,
-                    fused_ce: bool = True, remat: bool = False,
+                    fused_ce: bool = True, remat=False,
                     scan_layers: bool = False):
     """BASELINE config 3: BERT-base MLM pretrain step, seq 128.
 
     ``fused_ce`` routes the MLM head through the chunked
     linear-cross-entropy (ops/fused_loss.py) so the (B, T, 30k) logits
     tensor never materializes — the HBM-bound hot spot of this config.
-    ``remat`` checkpoints each block; ``scan_layers`` folds the stack
+    ``remat`` checkpoints each block (False | "full" | "dots" — "dots"
+    saves matmul outputs, recomputing only the elementwise tail);
+    ``scan_layers`` folds the stack
     into one lax.scan body (forces dropout 0 — noted so numbers stay
     comparable)."""
     import numpy as np
@@ -324,7 +326,8 @@ def bench_bert_base(steps: int, batch_size: int, amp=None,
     pt.seed(0)
     batch_size = _cap(batch_size, 32)
     cfg = B.BertConfig.base()
-    cfg.remat, cfg.scan_layers = remat, scan_layers
+    cfg.remat, cfg.scan_layers = bool(remat), scan_layers
+    cfg.remat_policy = "dots" if remat == "dots" else None
     if scan_layers:
         cfg.dropout = 0.0  # scan body shares one RNG stream
     model = B.BertForPretraining(cfg)
@@ -829,8 +832,11 @@ def main():
                     "measured configuration; pass --no-fused-ce for the "
                     "legacy full-logits path)")
     ap.add_argument("--no-fused-ce", dest="fused_ce", action="store_false")
-    ap.add_argument("--remat", action="store_true",
-                    help="bert: jax.checkpoint per transformer block")
+    ap.add_argument("--remat", nargs="?", const="full", default=None,
+                    choices=["full", "dots"],
+                    help="bert: jax.checkpoint per transformer block; "
+                    "'dots' saves matmul outputs and recomputes only the "
+                    "elementwise tail (less recompute, more HBM)")
     ap.add_argument("--scan-layers", dest="scan_layers",
                     action="store_true",
                     help="bert: lax.scan over the layer stack (dropout "
@@ -985,7 +991,7 @@ def main():
     if "fused_ce" in sig:
         kwargs["fused_ce"] = args.fused_ce
     if "remat" in sig and args.remat:
-        kwargs["remat"] = True
+        kwargs["remat"] = args.remat
     if "scan_layers" in sig and args.scan_layers:
         kwargs["scan_layers"] = True
     if "scan_unroll" in sig and args.scan_unroll:

@@ -32,6 +32,7 @@ class BertConfig:
     # None | 'ring' | 'ulysses' — shard attention over the 'sp' mesh axis
     seq_parallel: Optional[str] = None
     remat: bool = False        # jax.checkpoint per block (HBM for FLOPs)
+    remat_policy: Optional[str] = None  # None (save nothing) | "dots"
     # sliding-window/local attention width (None = full; the flash
     # kernel skips out-of-band blocks — O(T*window) long-context mode)
     attn_window: Optional[int] = None
@@ -78,6 +79,7 @@ class BertModel(nn.Layer):
             cfg.intermediate_size, cfg.dropout, activation="gelu",
             normalize_before=False, use_flash=cfg.use_flash,
             seq_parallel=cfg.seq_parallel, remat=cfg.remat,
+            remat_policy=cfg.remat_policy,
             scan_layers=cfg.scan_layers, attn_window=cfg.attn_window)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
 

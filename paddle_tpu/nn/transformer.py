@@ -131,7 +131,7 @@ class TransformerEncoder(Layer):
                  activation: str = "gelu", normalize_before: bool = True,
                  use_flash: bool = True, seq_parallel=None,
                  remat: bool = False, scan_layers: bool = False,
-                 attn_window=None):
+                 attn_window=None, remat_policy: Optional[str] = None):
         super().__init__()
         self.layers = LayerList([
             TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
@@ -140,6 +140,16 @@ class TransformerEncoder(Layer):
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
         self.remat = remat
+        # None = save nothing (recompute everything); "dots" = save
+        # matmul outputs and recompute only the elementwise tail — less
+        # recompute FLOPs for a bit more HBM (the standard policy sweep
+        # for MFU at long sequence). Validated HERE so a policy on a
+        # non-remat encoder fails loudly instead of silently not running
+        enforce(remat_policy in (None, "dots"),
+                "remat_policy must be None or 'dots', got %r", remat_policy)
+        enforce(remat_policy is None or remat,
+                "remat_policy=%r requires remat=True", remat_policy)
+        self.remat_policy = remat_policy
         # scan-over-layers: one traced block applied via lax.scan over
         # stacked per-layer params — the compiled module stays O(1) in
         # depth (compile time + HLO size for 24/48-layer stacks) and the
@@ -149,6 +159,13 @@ class TransformerEncoder(Layer):
         # attribute).
         self._dropout_p = dropout
         self.scan_layers = scan_layers
+
+    def _ckpt_policy(self):
+        import jax
+
+        if self.remat_policy is None:
+            return None
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
 
     def forward(self, x, mask=None, segment_ids=None):
         import jax
@@ -173,14 +190,16 @@ class TransformerEncoder(Layer):
             if self.remat:
                 # prevent_cse is unnecessary inside scan (JAX docs) and
                 # would insert optimization barriers per iteration
-                body = jax.checkpoint(body, prevent_cse=False)
+                body = jax.checkpoint(body, prevent_cse=False,
+                                      policy=self._ckpt_policy())
             x = lax.scan(body, x, stacked)[0]
         else:
             for layer in self.layers:
                 if self.remat:
                     x = jax.checkpoint(
                         lambda h, _l=layer: _l(h, mask=mask,
-                                               segment_ids=segment_ids))(x)
+                                               segment_ids=segment_ids),
+                        policy=self._ckpt_policy())(x)
                 else:
                     x = layer(x, mask=mask, segment_ids=segment_ids)
         if self.final_norm is not None:

@@ -284,3 +284,32 @@ def test_beam_decode_cached_matches_full_recompute():
     np.testing.assert_array_equal(np.asarray(seq), np.asarray(seq_ref))
     np.testing.assert_allclose(np.asarray(sc), np.asarray(sc_ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_encoder_remat_policy_identical_math():
+    """remat + remat_policy='dots' trade recompute for HBM only: forward
+    and gradients must match the no-remat encoder exactly (the bench
+    --remat/--remat dots sweep relies on this)."""
+    import jax
+
+    from paddle_tpu import nn as N
+
+    pt.seed(7)
+    enc = N.transformer.TransformerEncoder(2, 32, 4, 64, dropout=0.0)
+    params = enc.named_parameters()
+    x = jnp.asarray(np.random.default_rng(8).normal(
+        size=(2, 16, 32)).astype(np.float32))
+
+    def loss(p, remat, policy):
+        enc.remat, enc.remat_policy = remat, policy
+        out, _ = enc.functional_call(p, x, training=False)
+        return jnp.mean(out ** 2)
+
+    base, gbase = jax.value_and_grad(lambda p: loss(p, False, None))(params)
+    for policy in (None, "dots"):
+        v, g = jax.value_and_grad(lambda p: loss(p, True, policy))(params)
+        np.testing.assert_allclose(float(v), float(base), rtol=1e-6)
+        for k in gbase:
+            np.testing.assert_allclose(np.asarray(g[k]),
+                                       np.asarray(gbase[k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)

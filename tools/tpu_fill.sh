@@ -78,6 +78,7 @@ item op_bench         1200 python tools/op_bench.py --config tools/op_bench_case
 # -- tier 3: knob sweeps (winning-config table per model)
 item bench_bert_nofuse 900 python bench.py --model bert_base --no-fused-ce
 item bench_bert_remat  900 python bench.py --model bert_base --remat
+item bench_bert_rdots  900 python bench.py --model bert_base --remat dots
 item bench_bert_scan   900 python bench.py --model bert_base --scan-layers
 item bench_bert_b64    900 python bench.py --model bert_base --batch-size 64
 # packed-batch pretraining (segment-ids attention; same row shape as
