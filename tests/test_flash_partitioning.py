@@ -148,6 +148,52 @@ class TestFlashUnderPjit:
                                    rtol=2e-6, atol=2e-6)
 
 
+@pytest.mark.parametrize("causal,window,mask,segs,dropout", [
+    (True, None, False, False, 0.0),
+    (False, None, True, False, 0.0),
+    (True, 32, False, False, 0.0),
+    (False, None, False, True, 0.0),
+    (True, None, True, False, 0.2),
+    (True, 48, True, True, 0.1),
+])
+def test_partitioned_feature_combos_match_unsharded(causal, window, mask,
+                                                    segs, dropout):
+    """Every kernel feature (causal, window band, key-padding mask,
+    packed segments, in-kernel dropout) must survive partitioning —
+    exact agreement with the unsharded call under the dp x tp mesh."""
+    mesh = pt.build_mesh(dp=2, tp=2, pp=2)
+    b, t = 4, 128
+    q, k, v = _qkv(b=b, t=t, seed=hash((causal, window, mask, segs)) % 97)
+    kw = dict(causal=causal, window=window, interpret=True)
+    args, specs = [q, k, v], [P("dp", None, "tp", None)] * 3
+    lam_names = []
+    if mask:
+        keep = jnp.asarray(np.arange(t)[None, :]
+                           < RNG.integers(t // 2, t, size=(b, 1)))
+        args.append(keep)
+        specs.append(P("dp", None))
+        lam_names.append("kv_mask")
+    if segs:
+        ids = jnp.asarray((np.arange(t)[None, :] >= t // 2)
+                          .astype(np.int32).repeat(b, 0))
+        args.append(ids)
+        specs.append(P("dp", None))
+        lam_names.append("segment_ids")
+    if dropout:
+        kw.update(dropout_p=dropout, dropout_key=jax.random.PRNGKey(5))
+
+    def call(*xs):
+        extra = dict(zip(lam_names, xs[3:]))
+        return flash_attention(xs[0], xs[1], xs[2], **extra, **kw)
+
+    ref = call(*args)
+    sharded = [jax.device_put(a, NamedSharding(mesh, s))
+               for a, s in zip(args, specs)]
+    out = jax.jit(call)(*sharded)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
 def test_hybrid_bert_flagship_rides_flash(monkeypatch):
     """VERDICT r3 #3 done-criterion: the FLAGSHIP build_bert_hybrid_step
     (real BertForPretraining under dp x tp x pp) takes the flash kernel
