@@ -16,7 +16,7 @@ from .hybrid import (build_bert_hybrid_step,
                      build_hybrid_transformer_step)
 from .pipeline import (GPipe, bubble_fraction, gpipe_ticks,
                        interleaved_ticks, pipeline_apply,
-                       stage_param_sharding)
+                       ring_order_layers, stage_param_sharding)
 from .sharded_embedding import (ShardedEmbedding, embedding_ep_rules,
                                 sharded_embedding_lookup)
 from .sharding import (OptStateRules, constraint, infer_param_spec,
@@ -29,6 +29,7 @@ __all__ = [
     "sharded_flash_attention", "ulysses_attention",
     "GPipe", "pipeline_apply", "stage_param_sharding",
     "bubble_fraction", "gpipe_ticks", "interleaved_ticks",
+    "ring_order_layers",
     "ShardedEmbedding", "embedding_ep_rules", "sharded_embedding_lookup",
     "OptStateRules", "constraint", "infer_param_spec", "shard_params",
     "transformer_tp_rules", "zero_dp_rules",

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.enforce import enforce
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_apply, ring_order_layers
 from .sharding import constraint
 
 
@@ -165,6 +165,13 @@ def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
 
     # --- split: stacked encoder-layer params | everything else ------------
     stacked = stacked_parameters(model.bert.encoder.layers)
+    ring = pipeline_schedule == "interleaved" and virtual_stages > 1
+    if ring:
+        # persistent state holds the stack in the interleaved schedule's
+        # RING order (device-contiguous round-robin chunks): the
+        # per-step stage split is then a LOCAL reshape — a logical-order
+        # 'pp'-sharded stack would all-to-all every weight every step
+        stacked = ring_order_layers(stacked, n_pp, virtual_stages)
     rest = {k: v for k, v in model.named_parameters().items()
             if ".encoder.layers." not in k}
 
@@ -211,13 +218,19 @@ def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
             h = pipeline_apply(block_fn, p["layers"], x,
                                num_microbatches=num_microbatches,
                                mesh=mesh, schedule=pipeline_schedule,
-                               virtual_stages=virtual_stages)
+                               virtual_stages=virtual_stages,
+                               layers_in_ring_order=ring)
             h = constraint(h, P("dp"), mesh=mesh)
         else:
             def one(hc, p_l):
                 return block_fn(p_l, hc), None
 
-            h = jax.lax.scan(one, x, p["layers"])[0]
+            layers = p["layers"]
+            if ring:
+                # the sequential oracle applies layers in LOGICAL order
+                layers = ring_order_layers(layers, n_pp,
+                                           virtual_stages, inverse=True)
+            h = jax.lax.scan(one, x, layers)[0]
         pooled, _ = model.bert.pooler.functional_call(
             sub(r, "bert.pooler"), h[:, 0])
         hm, _ = model.mlm_transform.functional_call(

@@ -66,10 +66,16 @@ def _stack_to_stages(stacked_params, n_stages: int):
 
 
 def _interleave_to_stages(stacked_params, n: int, v: int):
-    """(L, ...) leaves → (n, v, L/(n*v), ...): device ``d`` slot ``j``
-    holds chunk ``j*n + d`` — the round-robin layout the interleaved
-    schedule walks (a microbatch's j-th ring pass applies chunks
-    ``j*n .. j*n + n - 1`` in device order)."""
+    """LOGICAL-order (L, ...) leaves → (n, v, L/(n*v), ...): device ``d``
+    slot ``j`` holds chunk ``j*n + d`` — the round-robin layout the
+    interleaved schedule walks (a microbatch's j-th ring pass applies
+    chunks ``j*n .. j*n + n - 1`` in device order).
+
+    NOTE: on a 'pp'-sharded stack this transpose is a cross-device
+    RESHARD (XLA lowers it to all-to-alls of every weight, each step).
+    Persistent training state should store the stack in RING ORDER
+    (:func:`ring_order_layers`) and pass ``layers_in_ring_order=True`` so
+    the per-step reshape stays device-local."""
 
     def reshape(leaf):
         L = leaf.shape[0]
@@ -78,6 +84,40 @@ def _interleave_to_stages(stacked_params, n: int, v: int):
         return jnp.swapaxes(a, 0, 1)
 
     return jax.tree_util.tree_map(reshape, stacked_params)
+
+
+def _ring_to_stages(stacked_params, n: int, v: int):
+    """RING-order (L, ...) leaves → (n, v, k, ...) by pure local reshape
+    (ring order stores device d's chunks contiguously: rows
+    [d*v*k, (d+1)*v*k) are chunks d, n+d, 2n+d, …)."""
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        k = L // (n * v)
+        return leaf.reshape(n, v, k, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, stacked_params)
+
+
+def ring_order_layers(stacked_params, n: int, v: int, *,
+                      inverse: bool = False):
+    """Permute a stacked (L, ...) pytree between LOGICAL layer order and
+    the interleaved schedule's RING order (device-contiguous round-robin
+    chunks). Apply once at parameter-placement time so each training
+    step's stage reshape is local — leaving the stack logical would
+    all-to-all every weight on every step. ``inverse=True`` maps ring
+    order back to logical (the sequential-oracle path)."""
+
+    def perm(leaf):
+        L = leaf.shape[0]
+        k = L // (n * v)
+        if inverse:  # ring (n, v, k) layout -> logical (v, n, k)
+            a = leaf.reshape(n, v, k, *leaf.shape[1:])
+        else:        # logical (v, n, k) layout -> ring (n, v, k)
+            a = leaf.reshape(v, n, k, *leaf.shape[1:])
+        return jnp.swapaxes(a, 0, 1).reshape(L, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(perm, stacked_params)
 
 
 def gpipe_ticks(n: int, m: int) -> int:
@@ -218,7 +258,8 @@ def _interleaved_inner(params_nvk, x_mb, *, block_fn, axis, n, m, v,
 def pipeline_apply(block_fn: Callable, stacked_params, x, *,
                    num_microbatches: int, axis: str = "pp",
                    mesh=None, remat: bool = True,
-                   schedule: str = "gpipe", virtual_stages: int = 1):
+                   schedule: str = "gpipe", virtual_stages: int = 1,
+                   layers_in_ring_order: bool = False):
     """Run ``x`` through ``L`` stacked layers as an ``n``-stage pipeline.
 
     - ``block_fn(params_l, h) -> h``: applies ONE layer (uniform shape).
@@ -228,6 +269,10 @@ def pipeline_apply(block_fn: Callable, stacked_params, x, *,
     - ``schedule``: ``"gpipe"`` (contiguous chunks) or ``"interleaved"``
       (``virtual_stages`` round-robin chunks per device — lower bubble,
       see module docstring; requires ``L % (n * virtual_stages) == 0``).
+    - ``layers_in_ring_order``: the stacked leaves were pre-permuted with
+      :func:`ring_order_layers` (persistent 'pp'-sharded training state
+      should be — the per-step stage split is then a LOCAL reshape;
+      logical-order sharded stacks pay a weight all-to-all per step).
 
     Returns the pipelined equivalent of folding ``block_fn`` over all ``L``
     layers, replicated over the 'pp' axis.
@@ -253,24 +298,34 @@ def pipeline_apply(block_fn: Callable, stacked_params, x, *,
     B = x.shape[0]
     enforce(B % m == 0,
             "num_microbatches %s must divide batch size %s", m, B)
+    enforce(not layers_in_ring_order
+            or (schedule == "interleaved" and v > 1),
+            "layers_in_ring_order only applies to the interleaved "
+            "schedule with virtual_stages > 1")
     if n == 1:
         # a 1-stage pipeline IS the sequential fold; skip the shard_map
         # entirely — the degenerate manual region would still wrap every
         # auto dp/tp collective in a size-1 manual subgroup, which the
         # SPMD partitioner rejects in MULTI-PROCESS compiles (seen with
         # the dcn_dp x dp x tp hybrid mesh, pp = 1)
+        fold_params = (ring_order_layers(stacked_params, n, v,
+                                         inverse=True)
+                       if layers_in_ring_order else stacked_params)
+
         def fold(h, p_l):
             return block_fn(p_l, h), None
 
         body = jax.checkpoint(fold) if remat else fold
         # match the pipelined path's output dtype contract (outbuf is
         # result_type(x.dtype) there, whatever block_fn returns)
-        return lax.scan(body, x, stacked_params)[0].astype(
+        return lax.scan(body, x, fold_params)[0].astype(
             jnp.result_type(x.dtype))
     x_mb = x.reshape(m, B // m, *x.shape[1:])
 
     if schedule == "interleaved" and v > 1:
-        params_staged = _interleave_to_stages(stacked_params, n, v)
+        params_staged = (_ring_to_stages(stacked_params, n, v)
+                         if layers_in_ring_order
+                         else _interleave_to_stages(stacked_params, n, v))
     else:
         params_staged = _stack_to_stages(stacked_params, n)
     # jit is required: remat's closed_call can't evaluate eagerly inside

@@ -170,3 +170,36 @@ class TestOpBench:
 
         for case in shipped:
             assert callable(resolve(case["op"]))
+
+
+class TestCommReport:
+    def test_collective_traffic_parses_scalar_and_tuple_ops(self):
+        """The HLO tally behind tools/comm_report.py: scalar-result,
+        TUPLE-result (grad-bucket all-reduces), async -start/-done pairs
+        (counted once), and non-collective lines."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "comm_report", os.path.join(os.path.dirname(__file__), "..",
+                                        "tools", "comm_report.py"))
+        cr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cr)
+
+        hlo = "\n".join([
+            "  %ar.1 = f32[8,64]{1,0} all-reduce(%p0), replica_groups={}",
+            "  %ar.2 = (f32[128]{0}, bf16[64,2]{1,0}) all-reduce(%a, %b)",
+            # real async form: the -start result tuple carries the
+            # operand alias + context scalars; only the -done's result
+            # is the output payload
+            "  %cp.s = (f32[4,4]{1,0}, f32[4,4]{1,0}, u32[], u32[]) "
+            "collective-permute-start(%x)",
+            "  %cp.d = f32[4,4]{1,0} collective-permute-done(%cp.s)",
+            "  %add = f32[8]{0} add(%y, %z)",
+        ])
+        got = cr.collective_traffic(hlo)
+        assert got["all-reduce"][0] == 2
+        assert got["all-reduce"][1] == 8 * 64 * 4 + 128 * 4 + 64 * 2 * 2
+        # async pair counted ONCE, at the -done payload
+        assert got["collective-permute"] == (1, 4 * 4 * 4)
+        assert "add" not in got and len(got) == 2

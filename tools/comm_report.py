@@ -1,0 +1,150 @@
+"""Collective-traffic report for parallel configs — the scaling-book
+"pick a mesh, annotate shardings, let XLA insert collectives, profile,
+iterate" loop, runnable WITHOUT hardware: compile the hybrid BERT train
+step on the virtual CPU mesh per config and tally every collective the
+SPMD partitioner inserted (kind, count, bytes) next to the module's
+compute FLOPs. The communication:compute ratio is the quantity mesh
+layouts are chosen to minimize (SURVEY §5.8; reference analog: the
+multi-device graph pass's inserted allreduce op-handles,
+framework/details/all_reduce_op_handle.cc, which the reference could
+only count by reading timeline traces).
+
+    python tools/comm_report.py                       # the default sweep
+    python tools/comm_report.py --config dp2tp2pp2    # one config
+
+Prints one JSON line per config:
+  {"config", "collectives": {kind: {"count", "mbytes"}}, "tflops",
+   "comm_mbytes_total", "bytes_per_flop"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
+                "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+                "s8": 1, "u8": 1, "pred": 1}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+# `%x = <result type> all-reduce(...` — the result type may be a TUPLE
+# of shapes (grad-bucket all-reduces are). Async pairs are counted at
+# the -done op, whose result IS the output payload; a -start's tuple
+# also carries the operand alias + context scalars and would inflate
+# the tally ~2x
+_LINE_RE = re.compile(
+    r"=\s+(.*?)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(-start|-done)?\(")
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _bytes_of(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def collective_traffic(hlo_text: str):
+    """Tally collectives in compiled HLO text: {kind: (count, bytes)}.
+    Bytes are per-device result payload per execution of the op (tuple
+    results sum their elements; fusion/while bodies count once —
+    multiply by trip counts externally if needed)."""
+    out = {}
+    for line in hlo_text.splitlines():
+        m = _LINE_RE.search(line)
+        if not m:
+            continue
+        typ, kind, suffix = m.groups()
+        if suffix == "-start":
+            continue  # counted at the matching -done (see _LINE_RE note)
+        b = sum(_bytes_of(dt, dims)
+                for dt, dims in _SHAPE_RE.findall(typ))
+        cnt, byt = out.get(kind, (0, 0))
+        out[kind] = (cnt + 1, byt + b)
+    return out
+
+
+CONFIGS = {
+    "dp8": dict(dp=8, tp=1, pp=1),
+    "dp4tp2": dict(dp=4, tp=2, pp=1),
+    "dp2tp4": dict(dp=2, tp=4, pp=1),
+    "dp2tp2pp2": dict(dp=2, tp=2, pp=2),
+    "dp2tp2pp2_interleaved": dict(dp=2, tp=2, pp=2,
+                                  pipeline_schedule="interleaved",
+                                  virtual_stages=2, layers=4),
+}
+
+
+def report(config_name: str, *, batch: int = 8, seq_len: int = 32,
+           layers: int = 2):
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.bert import BertConfig
+    from paddle_tpu.parallel.hybrid import build_bert_hybrid_step
+
+    spec = dict(CONFIGS[config_name])
+    sched = spec.pop("pipeline_schedule", "gpipe")
+    v = spec.pop("virtual_stages", 1)
+    layers = spec.pop("layers", layers)
+    mesh = pt.build_mesh(devices=jax.devices()[:8], **spec)
+    # tiny stack: collective STRUCTURE (which kinds, how the bytes
+    # scale with the axes) is what matters; absolute sizes scale with
+    # the model and are reported per-config for ratio comparisons
+    cfg = BertConfig(vocab_size=256, hidden_size=64, num_layers=layers,
+                     num_heads=4, intermediate_size=128, max_position=64,
+                     dropout=0.0)
+    step, _, params, feed = build_bert_hybrid_step(
+        mesh, cfg=cfg, batch=batch, seq_len=seq_len,
+        num_microbatches=2 if spec.get("pp", 1) > 1 else 1,
+        pipeline_schedule=sched, virtual_stages=v)
+    compiled = jax.jit(step).lower(params, *feed).compile()
+    traffic = collective_traffic(compiled.as_text())
+    cost = compiled.cost_analysis() or {}
+    flops = float(cost.get("flops", 0.0))
+    total = sum(b for _, b in traffic.values())
+    return {
+        "config": config_name,
+        "collectives": {k: {"count": c, "mbytes": round(b / 1e6, 3)}
+                        for k, (c, b) in sorted(traffic.items())},
+        "tflops": round(flops / 1e12, 4),
+        "comm_mbytes_total": round(total / 1e6, 3),
+        "bytes_per_flop": round(total / flops, 6) if flops else None,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=sorted(CONFIGS), default=None)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+    names = [args.config] if args.config else list(CONFIGS)
+    for name in names:
+        print(json.dumps(report(name, batch=args.batch)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import jax
+
+    # virtual-mesh analysis tool: NEVER touch the device tunnel (and the
+    # env-var-only JAX_PLATFORMS=cpu route hangs when the tunnel is down
+    # — this environment pre-imports jax via sitecustomize; config.update
+    # is the reliable override, see tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 8:
+        print("comm_report needs 8 virtual devices: run with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8",
+              file=sys.stderr)
+        sys.exit(2)
+    sys.exit(main())
