@@ -7,6 +7,7 @@ from .layers import (GELU, RNN, BatchNorm, BilinearTensorProduct, Conv2D,
                      GRUCell, LayerNorm, Linear, LSTMCell, MultiHeadAttention,
                      Pool2D, PRelu, ReLU, RMSNorm, Sigmoid, Softmax,
                      SpectralNorm, Tanh)
+from .moe import SwitchFFN
 from .rnn_layers import GRU, LSTM
 from .sampling_layers import NCE, HSigmoid
 from .transformer import (FeedForward, LearnedPositionalEmbedding,
@@ -21,7 +22,7 @@ __all__ = [
     "GRUCell", "LayerNorm", "Linear", "LSTMCell", "MultiHeadAttention",
     "Pool2D", "PRelu", "ReLU", "RMSNorm", "Sigmoid", "Softmax",
     "SpectralNorm", "Tanh",
-    "GRU", "LSTM", "NCE", "HSigmoid",
+    "GRU", "LSTM", "NCE", "HSigmoid", "SwitchFFN",
     "FeedForward", "LearnedPositionalEmbedding", "PositionalEncoding",
     "TransformerDecoder", "TransformerDecoderLayer", "TransformerEncoder",
     "TransformerEncoderLayer",

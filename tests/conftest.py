@@ -93,6 +93,7 @@ MID_PATTERNS = [
     "test_fleet.py",
     "test_static.py",
     "test_sparse_embedding_grads.py",
+    "test_moe.py",
 ]
 
 # representative fast subset across subsystems (the smoke tier)
