@@ -38,10 +38,17 @@ class FeedForward(Layer):
 
 
 class TransformerEncoderLayer(Layer):
+    """``moe_experts > 0`` swaps the dense FFN for a Switch-MoE FFN
+    (:class:`~paddle_tpu.nn.moe.SwitchFFN`) — experts shard over the
+    'ep' mesh axis; the load-balance aux loss rides the layer's buffers
+    (collect ``*.ffn.aux_loss`` from functional_call's new_buffers)."""
+
     def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
                  dropout: float = 0.1, activation: str = "gelu",
                  normalize_before: bool = True, use_flash: bool = True,
-                 seq_parallel=None, attn_window=None):
+                 seq_parallel=None, attn_window=None,
+                 moe_experts: int = 0,
+                 moe_capacity_factor: float = 1.25):
         super().__init__()
         self.normalize_before = normalize_before
         # sliding-window/local attention width (None = full)
@@ -52,7 +59,14 @@ class TransformerEncoderLayer(Layer):
         self.self_attn = MultiHeadAttention(
             d_model, nhead, dropout=0.0 if seq_parallel else dropout,
             use_flash=use_flash, seq_parallel=seq_parallel)
-        self.ffn = FeedForward(d_model, dim_feedforward, dropout, activation)
+        if moe_experts:
+            from .moe import SwitchFFN
+
+            self.ffn = SwitchFFN(d_model, dim_feedforward, moe_experts,
+                                 capacity_factor=moe_capacity_factor)
+        else:
+            self.ffn = FeedForward(d_model, dim_feedforward, dropout,
+                                   activation)
         self.norm1 = LayerNorm(d_model)
         self.norm2 = LayerNorm(d_model)
         self.drop1 = Dropout(dropout)
@@ -131,12 +145,15 @@ class TransformerEncoder(Layer):
                  activation: str = "gelu", normalize_before: bool = True,
                  use_flash: bool = True, seq_parallel=None,
                  remat: bool = False, scan_layers: bool = False,
-                 attn_window=None, remat_policy: Optional[str] = None):
+                 attn_window=None, remat_policy: Optional[str] = None,
+                 moe_experts: int = 0, moe_capacity_factor: float = 1.25):
         super().__init__()
         self.layers = LayerList([
             TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
                                     activation, normalize_before, use_flash,
-                                    seq_parallel, attn_window=attn_window)
+                                    seq_parallel, attn_window=attn_window,
+                                    moe_experts=moe_experts,
+                                    moe_capacity_factor=moe_capacity_factor)
             for _ in range(num_layers)])
         self.final_norm = LayerNorm(d_model) if normalize_before else None
         self.remat = remat

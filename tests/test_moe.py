@@ -141,3 +141,29 @@ def test_ep_sharded_experts_golden_hlo():
     assert "w1" in spec and spec["w1"][0] == "ep"
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_encoder_layer_moe_option_and_aux_collection():
+    """moe_experts on the transformer stack: forward shape holds, every
+    layer's aux loss surfaces through functional_call's new_buffers."""
+    pt.seed(3)
+    enc = nn.TransformerEncoder(2, 16, 4, 32, dropout=0.0, moe_experts=4)
+    x = jnp.asarray(RNG.normal(size=(2, 8, 16)).astype(np.float32))
+    params = enc.named_parameters()
+    out, new_buf = enc.functional_call(params, x,
+                                       buffers=enc.named_buffers(),
+                                       training=False)
+    assert out.shape == x.shape
+    aux_keys = [k for k in new_buf if k.endswith("ffn.aux_loss")]
+    assert len(aux_keys) == 2, sorted(new_buf)
+    total_aux = sum(float(new_buf[k]) for k in aux_keys)
+    assert total_aux >= 2.0 - 1e-5  # >= 1 per layer
+
+    def loss(p):
+        out, nb = enc.functional_call(p, x, buffers=enc.named_buffers(),
+                                      training=False)
+        return (jnp.mean(out ** 2)
+                + 0.01 * sum(nb[k] for k in aux_keys))
+
+    g = jax.grad(loss)(params)
+    assert np.abs(np.asarray(g["layers.0.ffn.router_w"])).max() > 0
