@@ -106,7 +106,7 @@ def _cap(batch_size: int, cap: int) -> int:
 
 def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
                  lr=1e-3, amp=None, method="forward", steps_per_call=None,
-                 infer_batch=None):
+                 infer_batch=None, aux_loss_fn=None):
     """Shared harness: jitted value_and_grad+Adam step, timed post-warmup.
 
     Timing blocks on the FULL output state, not just the loss scalar — the
@@ -118,6 +118,8 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     are in-place in HBM. ``steps_per_call`` fuses K update steps into one
     dispatch via lax.scan (identical math — the Trainer.train_steps
     pattern), amortizing the per-dispatch tunnel round trip.
+    ``aux_loss_fn(new_buffers) -> scalar`` adds buffer-carried auxiliary
+    objectives (the MoE load-balance loss) to the optimized loss.
     """
     import contextlib
 
@@ -165,7 +167,10 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
                 out, new_buf = model.functional_call(
                     p, *batch, buffers=buffers, training=True,
                     method=method)
-                return loss_fn(out, batch), new_buf
+                l = loss_fn(out, batch)
+                if aux_loss_fn is not None:
+                    l = l + aux_loss_fn(new_buf)
+                return l, new_buf
 
         (l, new_buf), g = jax.value_and_grad(loss, has_aux=True)(params)
         params, state = opt.apply(params, g, state)
@@ -358,6 +363,46 @@ def bench_bert_base(steps: int, batch_size: int, amp=None,
 
     return _train_bench(model, loss_fn, make_batch, steps, batch_size,
                         amp=amp)
+
+
+def bench_bert_moe(steps: int, batch_size: int, amp=None,
+                   experts: int = 8):
+    """Switch-MoE BERT (green-field config — the reference has no MoE):
+    bert_base geometry with each block's FFN replaced by an
+    ``experts``-way Switch FFN (top-1, cf 1.25); the optimized loss adds
+    0.01 x the per-layer load-balance aux. Single-chip this measures the
+    dense dispatch/combine einsum cost; on a mesh the experts shard over
+    'ep' (tests/test_moe.py golden HLO)."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert as B
+    from paddle_tpu.utils.flops import enable_compile_cache
+
+    enable_compile_cache()
+    pt.seed(0)
+    batch_size = _cap(batch_size, 16)
+    cfg = B.BertConfig.base()
+    cfg.dropout = 0.0
+    cfg.moe_experts = experts
+    model = B.BertForPretraining(cfg)
+    rng = np.random.default_rng(0)
+    T = 128
+
+    def make_batch(bs):
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, T)))
+        mlm = jnp.asarray(np.where(
+            rng.random((bs, T)) < 0.15,
+            rng.integers(0, cfg.vocab_size, (bs, T)), -100))
+        nsp = jnp.asarray(rng.integers(0, 2, (bs,)))
+        return (ids, mlm, nsp)
+
+    def aux(new_buf):
+        return 0.01 * sum(v for k, v in new_buf.items()
+                          if k.endswith("ffn.aux_loss"))
+
+    return _train_bench(model, lambda out, batch: out, make_batch, steps,
+                        batch_size, amp=amp, method="forward_fused_loss",
+                        aux_loss_fn=aux)
 
 
 def bench_transformer_nmt(steps: int, batch_size: int, amp=None,
@@ -785,6 +830,7 @@ MODELS = {
     "resnet50": bench_resnet50,
     "bert_base": bench_bert_base,
     "bert_packed": bench_bert_packed,
+    "bert_moe": bench_bert_moe,
     "bert_long": bench_bert_long,
     "transformer_nmt": bench_transformer_nmt,
     "nmt_decode": bench_nmt_decode,

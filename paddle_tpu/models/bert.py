@@ -38,6 +38,11 @@ class BertConfig:
     attn_window: Optional[int] = None
     scan_layers: bool = False  # lax.scan over stacked layers (needs
     #                            dropout == 0 while training)
+    # > 0 swaps each block's dense FFN for a Switch-MoE FFN (nn.moe);
+    # experts shard over the 'ep' mesh axis, per-layer load-balance aux
+    # losses ride functional_call's new_buffers (*.ffn.aux_loss)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def base(cls):
@@ -80,7 +85,9 @@ class BertModel(nn.Layer):
             normalize_before=False, use_flash=cfg.use_flash,
             seq_parallel=cfg.seq_parallel, remat=cfg.remat,
             remat_policy=cfg.remat_policy,
-            scan_layers=cfg.scan_layers, attn_window=cfg.attn_window)
+            scan_layers=cfg.scan_layers, attn_window=cfg.attn_window,
+            moe_experts=cfg.moe_experts,
+            moe_capacity_factor=cfg.moe_capacity_factor)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
