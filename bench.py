@@ -376,9 +376,7 @@ def bench_bert_moe(steps: int, batch_size: int, amp=None,
     import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.models import bert as B
-    from paddle_tpu.utils.flops import enable_compile_cache
 
-    enable_compile_cache()
     pt.seed(0)
     batch_size = _cap(batch_size, 16)
     cfg = B.BertConfig.base()
@@ -400,9 +398,13 @@ def bench_bert_moe(steps: int, batch_size: int, amp=None,
         return 0.01 * sum(v for k, v in new_buf.items()
                           if k.endswith("ffn.aux_loss"))
 
+    # --infer: only input_ids reaches the forward (mlm/nsp labels would
+    # alias token_type_ids/attention_mask — the _train_bench docstring
+    # hazard bench_bert_base guards the same way)
     return _train_bench(model, lambda out, batch: out, make_batch, steps,
                         batch_size, amp=amp, method="forward_fused_loss",
-                        aux_loss_fn=aux)
+                        aux_loss_fn=aux,
+                        infer_batch=lambda bs: make_batch(bs)[:1])
 
 
 def bench_transformer_nmt(steps: int, batch_size: int, amp=None,

@@ -622,3 +622,82 @@ def test_launch_hybrid_dcn_bert_matches_single_process(tmp_path):
                                                   num_microbatches=2)
     seq_loss = float(jax.jit(ref_step)(params2, *feed2)[0])
     assert abs(seq_loss - rank0[0]) < 1e-4, (seq_loss, rank0[0])
+
+
+# ---------------------------------------------------------------------------
+# r4: the PIPELINE axis spanning processes — collective-permute over the
+# DCN/process boundary (2 procs x 4 devices, pp=4 with its outer half
+# crossing hosts), GPipe AND interleaved schedules
+# ---------------------------------------------------------------------------
+
+PP_DCN_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu import fleet
+from paddle_tpu.parallel import pipeline_apply
+
+f = fleet.init(strategy=fleet.DistributedStrategy(dp=2, pp=4,
+                                                  dcn_axis="pp"))
+rank = f.worker_index()
+mesh = f.mesh
+pp_col = mesh.devices[0, :, 0, 0, 0]
+assert len({d.process_index for d in pp_col}) == 2, "pp must span hosts"
+
+L, D, B = 8, 16, 8
+rng = np.random.default_rng(0)
+params = {"w": jnp.asarray(rng.normal(scale=0.5, size=(L, D, D))
+                           .astype(np.float32))}
+x = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
+
+def block(p, h):
+    return jnp.tanh(h @ p["w"])
+
+results = {}
+for sched, v in (("gpipe", 1), ("interleaved", 2)):
+    out = jax.jit(lambda p, x, _s=sched, _v=v: pipeline_apply(
+        block, p, x, num_microbatches=4, mesh=mesh, schedule=_s,
+        virtual_stages=_v))(params, x)
+    results[sched] = float(jnp.sum(out))
+print("SUMS[%%d]:%%s" %% (rank, json.dumps(results)), flush=True)
+f.shutdown()
+"""
+
+
+def test_launch_pipeline_axis_spans_processes(tmp_path):
+    """pp=4 with its outer half on the process (DCN) dimension: both
+    pipeline schedules run across hosts and match the sequential fold
+    computed locally."""
+    script = tmp_path / "pp_dcn_worker.py"
+    script.write_text(PP_DCN_WORKER % {"repo": REPO})
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--local-devices", "4",
+         "--log-dir", str(log_dir), "--timeout", "420", str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=480)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+    tag = "SUMS[0]:"
+    lines = [l for l in r.stdout.splitlines() if l.startswith(tag)]
+    assert lines, r.stdout
+    sums = json.loads(lines[0][len(tag):])
+
+    # local sequential oracle (same seeds)
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(scale=0.5, size=(8, 16, 16)).astype(np.float32)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    h = x
+    for l in range(8):
+        h = np.tanh(h @ w[l])
+    want = float(np.sum(h))
+    assert abs(sums["gpipe"] - want) < 1e-3 * max(1, abs(want))
+    assert abs(sums["interleaved"] - want) < 1e-3 * max(1, abs(want))
