@@ -38,40 +38,60 @@ __all__ = ["SwitchFFN", "switch_moe"]
 
 
 def switch_moe(x, router_w, w1, b1, w2, b2, *, capacity: int,
-               act=jax.nn.gelu):
-    """Functional Switch top-1 MoE over tokens.
+               act=jax.nn.gelu, top_k: int = 1):
+    """Functional top-k MoE over tokens (k=1: Switch; k=2: GShard).
 
     x: (S, D) tokens; router_w: (D, E); w1: (E, D, F); b1: (E, F);
     w2: (E, F, D); b2: (E, D). Returns (y (S, D), aux_loss scalar,
-    kept_fraction scalar).
+    kept_fraction scalar — the fraction of (token, choice) assignments
+    that fit capacity).
+
+    top-2 follows GShard's ordering: every token's FIRST choice claims
+    its expert slot before any second choice does, and the two gates are
+    renormalized to sum to 1 per token.
     """
+    enforce(top_k in (1, 2), "top_k must be 1 or 2, got %s", top_k)
     s = x.shape[0]
     e = router_w.shape[1]
     logits = x @ router_w                              # (S, E)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                # (S,)
-    gate = jnp.max(probs, axis=-1)                     # (S,)
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # (S, E)
-    # position of each token within its expert's queue (arrival order —
-    # deterministic, shard-invariant: plain prefix sum over tokens)
-    pos = jnp.cumsum(onehot, axis=0) * onehot          # (S, E), 1-based
-    keep = (pos > 0) & (pos <= capacity)
-    pos_c = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
-    # dispatch mask (S, E, C): token s -> slot (expert, position)
-    slot = jax.nn.one_hot(pos_c, capacity, dtype=x.dtype)   # (S, E, C)
-    dmask = slot * keep.astype(x.dtype)[..., None]
+    top_p, top_i = jax.lax.top_k(probs, top_k)         # (S, k)
+    # Switch top-1 scales by the RAW router probability; GShard top-2
+    # renormalizes the two gates to sum to 1 per token
+    gates = (top_p if top_k == 1
+             else top_p / jnp.sum(top_p, axis=-1, keepdims=True))
+    onehots = [jax.nn.one_hot(top_i[:, j], e, dtype=jnp.float32)
+               for j in range(top_k)]                  # k x (S, E)
+    # positions within each expert's queue (arrival order — deterministic,
+    # shard-invariant prefix sums); ALL first choices precede second ones
+    pos = [jnp.cumsum(onehots[0], axis=0) * onehots[0]]  # (S, E), 1-based
+    if top_k == 2:
+        first_counts = jnp.sum(onehots[0], axis=0)     # (E,)
+        pos.append((jnp.cumsum(onehots[1], axis=0) + first_counts[None, :])
+                   * onehots[1])
+    dmask = jnp.zeros((s, e, capacity), x.dtype)
+    combine = jnp.zeros((s, e, capacity), x.dtype)
+    kept_ct = jnp.zeros((), jnp.float32)
+    for j in range(top_k):
+        keep = (pos[j] > 0) & (pos[j] <= capacity)
+        pos_c = jnp.clip(pos[j] - 1, 0, capacity - 1).astype(jnp.int32)
+        slot = jax.nn.one_hot(pos_c, capacity, dtype=x.dtype)  # (S, E, C)
+        dm = slot * keep.astype(x.dtype)[..., None]
+        dmask = dmask + dm
+        combine = combine + dm * gates[:, j].astype(x.dtype)[:, None, None]
+        # BOOL mask counted in f32: a bf16 dmask sum saturates at 256
+        # under the mixed_bf16 policy and would corrupt the metric
+        kept_ct = kept_ct + jnp.sum(keep.astype(jnp.float32))
     expert_in = jnp.einsum("sec,sd->ecd", dmask, x)    # (E, C, D)
     h = act(jnp.einsum("ecd,edf->ecf", expert_in, w1) + b1[:, None, :])
     out_e = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
-    combine = dmask * gate.astype(x.dtype)[:, None, None]
     y = jnp.einsum("sec,ecd->sd", combine, out_e)      # dropped -> zeros
-    # Switch load-balance aux: E * sum_e(fraction_of_tokens_e * mean_prob_e)
-    frac = jnp.mean(onehot, axis=0)                    # (E,)
+    # load-balance aux over FIRST-choice assignment (Switch/GShard form):
+    # E * sum_e(fraction_of_tokens_e * mean_prob_e)
+    frac = jnp.mean(onehots[0], axis=0)                # (E,)
     mean_prob = jnp.mean(probs, axis=0)                # (E,)
     aux = e * jnp.sum(frac * mean_prob)
-    # count from the BOOL mask in f32: a bf16 dmask sum saturates at
-    # 256 under the mixed_bf16 policy and would corrupt the metric
-    kept = jnp.sum(keep.astype(jnp.float32)) / s
+    kept = kept_ct / (s * top_k)
     return y, aux.astype(jnp.float32), kept.astype(jnp.float32)
 
 
@@ -93,15 +113,19 @@ class SwitchFFN(Layer):
 
     def __init__(self, d_model: int, d_ff: int, num_experts: int,
                  capacity_factor: float = 1.25,
-                 act=jax.nn.gelu, dtype=None):
+                 act=jax.nn.gelu, dtype=None, router_top_k: int = 1):
         super().__init__()
         enforce(num_experts >= 2, "SwitchFFN needs >= 2 experts, got %s",
                 num_experts)
         enforce(capacity_factor > 0.0,
                 "capacity_factor must be > 0, got %s", capacity_factor)
+        enforce(router_top_k in (1, 2),
+                "router_top_k must be 1 (Switch) or 2 (GShard), got %s",
+                router_top_k)
         self.num_experts = num_experts
         self.capacity_factor = float(capacity_factor)
         self.act = act
+        self.router_top_k = router_top_k
         self.create_parameter("router_w", (d_model, num_experts),
                               dtype, I.XavierUniform())
         self.create_parameter("w1", (num_experts, d_model, d_ff), dtype,
@@ -116,7 +140,11 @@ class SwitchFFN(Layer):
         self.register_buffer("kept_fraction", jnp.ones((), jnp.float32))
 
     def capacity(self, tokens: int) -> int:
-        return max(1, math.ceil(tokens / self.num_experts
+        # top-k routing makes k*tokens assignments: capacity scales with
+        # k (GShard convention) or the second choices would nearly all
+        # drop at the default factor
+        return max(1, math.ceil(tokens * self.router_top_k
+                                / self.num_experts
                                 * self.capacity_factor))
 
     def forward(self, x):
@@ -124,7 +152,8 @@ class SwitchFFN(Layer):
         y, aux, kept = switch_moe(
             x.reshape(b * t, d), self.router_w,
             self.w1, self.b1, self.w2, self.b2,
-            capacity=self.capacity(b * t), act=self.act)
+            capacity=self.capacity(b * t), act=self.act,
+            top_k=self.router_top_k)
         self.update_buffer("aux_loss", aux)
         self.update_buffer("kept_fraction", kept)
         return y.reshape(b, t, d)

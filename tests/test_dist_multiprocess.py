@@ -690,8 +690,6 @@ def test_launch_pipeline_axis_spans_processes(tmp_path):
     sums = json.loads(lines[0][len(tag):])
 
     # local sequential oracle (same seeds)
-    import jax.numpy as jnp
-
     rng = np.random.default_rng(0)
     w = rng.normal(scale=0.5, size=(8, 16, 16)).astype(np.float32)
     x = rng.normal(size=(8, 16)).astype(np.float32)

@@ -167,3 +167,57 @@ def test_encoder_layer_moe_option_and_aux_collection():
 
     g = jax.grad(loss)(params)
     assert np.abs(np.asarray(g["layers.0.ffn.router_w"])).max() > 0
+
+
+def _oracle_top2(x, w, capacity):
+    """Per-token GShard top-2 reference: all first choices claim slots
+    before any second choice; gates renormalized per token."""
+    probs = np.asarray(jax.nn.softmax(x @ w["router_w"], -1))
+    order = np.argsort(-probs, axis=-1)[:, :2]
+    counts = {}
+    assign = []  # (token, expert, gate, choice)
+    for s in range(x.shape[0]):  # first choices
+        e = int(order[s, 0])
+        counts[e] = counts.get(e, 0) + 1
+        g = probs[s, order[s, 0]] + probs[s, order[s, 1]]
+        if counts[e] <= capacity:
+            assign.append((s, e, probs[s, e] / g))
+    for s in range(x.shape[0]):  # then second choices
+        e = int(order[s, 1])
+        counts[e] = counts.get(e, 0) + 1
+        g = probs[s, order[s, 0]] + probs[s, order[s, 1]]
+        if counts[e] <= capacity:
+            assign.append((s, e, probs[s, e] / g))
+    out = np.zeros_like(np.asarray(x))
+    for s, e, g in assign:
+        h = jax.nn.gelu(x[s] @ w["w1"][e] + w["b1"][e])
+        out[s] += np.asarray(h @ w["w2"][e] + w["b2"][e]) * g
+    return out.astype(np.float32)
+
+
+def test_top2_matches_per_token_oracle():
+    d, s, cap = 16, 24, 5
+    w = _weights(d=d, seed=9)
+    x = jnp.asarray(RNG.normal(size=(s, d)).astype(np.float32))
+    y, aux, kept = switch_moe(x, w["router_w"], w["w1"], w["b1"],
+                              w["w2"], w["b2"], capacity=cap, top_k=2)
+    want = _oracle_top2(x, w, cap)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=3e-5, atol=3e-5)
+    assert 0.0 < float(kept) <= 1.0
+    assert float(aux) >= 1.0 - 1e-6
+
+
+def test_top2_layer_grads():
+    pt.seed(4)
+    layer = nn.SwitchFFN(8, 16, num_experts=4, capacity_factor=2.0,
+                         router_top_k=2)
+    x = jnp.asarray(RNG.normal(size=(1, 12, 8)).astype(np.float32))
+    params = layer.named_parameters()
+
+    def loss(p):
+        out, nb = layer.functional_call(p, x, buffers=layer.named_buffers())
+        return jnp.mean(out ** 2) + 0.01 * nb["aux_loss"]
+
+    g = jax.grad(loss)(params)
+    for name in ("router_w", "w1", "w2"):
+        assert np.abs(np.asarray(g[name])).max() > 0, name
