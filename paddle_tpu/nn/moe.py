@@ -43,8 +43,10 @@ def switch_moe(x, router_w, w1, b1, w2, b2, *, capacity: int,
 
     x: (S, D) tokens; router_w: (D, E); w1: (E, D, F); b1: (E, F);
     w2: (E, F, D); b2: (E, D). Returns (y (S, D), aux_loss scalar,
-    kept_fraction scalar — the fraction of (token, choice) assignments
-    that fit capacity).
+    z_loss scalar, kept_fraction scalar — kept = the fraction of
+    (token, choice) assignments that fit capacity; z_loss is the ST-MoE
+    router stability term mean(logsumexp(logits)^2), weighted ~1e-3 by
+    the trainer to keep router logits from drifting large).
 
     top-2 follows GShard's ordering: every token's FIRST choice claims
     its expert slot before any second choice does, and the two gates are
@@ -53,8 +55,10 @@ def switch_moe(x, router_w, w1, b1, w2, b2, *, capacity: int,
     enforce(top_k in (1, 2), "top_k must be 1 or 2, got %s", top_k)
     s = x.shape[0]
     e = router_w.shape[1]
-    logits = x @ router_w                              # (S, E)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    logits = (x @ router_w).astype(jnp.float32)        # (S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    z = jax.nn.logsumexp(logits, axis=-1)              # (S,)
+    z_loss = jnp.mean(z * z)
     top_p, top_i = jax.lax.top_k(probs, top_k)         # (S, k)
     # Switch top-1 scales by the RAW router probability; GShard top-2
     # renormalizes the two gates to sum to 1 per token
@@ -92,7 +96,8 @@ def switch_moe(x, router_w, w1, b1, w2, b2, *, capacity: int,
     mean_prob = jnp.mean(probs, axis=0)                # (E,)
     aux = e * jnp.sum(frac * mean_prob)
     kept = kept_ct / (s * top_k)
-    return y, aux.astype(jnp.float32), kept.astype(jnp.float32)
+    return (y, aux.astype(jnp.float32), z_loss.astype(jnp.float32),
+            kept.astype(jnp.float32))
 
 
 class SwitchFFN(Layer):
@@ -137,6 +142,7 @@ class SwitchFFN(Layer):
         self.create_parameter("b2", (num_experts, d_model), dtype,
                               I.Constant(0.0), is_bias=True)
         self.register_buffer("aux_loss", jnp.zeros((), jnp.float32))
+        self.register_buffer("router_z_loss", jnp.zeros((), jnp.float32))
         self.register_buffer("kept_fraction", jnp.ones((), jnp.float32))
 
     def capacity(self, tokens: int) -> int:
@@ -149,12 +155,13 @@ class SwitchFFN(Layer):
 
     def forward(self, x):
         b, t, d = x.shape
-        y, aux, kept = switch_moe(
+        y, aux, z_loss, kept = switch_moe(
             x.reshape(b * t, d), self.router_w,
             self.w1, self.b1, self.w2, self.b2,
             capacity=self.capacity(b * t), act=self.act,
             top_k=self.router_top_k)
         self.update_buffer("aux_loss", aux)
+        self.update_buffer("router_z_loss", z_loss)
         self.update_buffer("kept_fraction", kept)
         return y.reshape(b, t, d)
 

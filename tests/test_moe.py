@@ -46,8 +46,10 @@ def test_switch_moe_matches_per_token_oracle():
     d, s, cap = 16, 24, 4
     w = _weights(d=d, seed=1)
     x = jnp.asarray(RNG.normal(size=(s, d)).astype(np.float32))
-    y, aux, kept = switch_moe(x, w["router_w"], w["w1"], w["b1"],
-                              w["w2"], w["b2"], capacity=cap)
+    y, aux, z_loss, kept = switch_moe(x, w["router_w"], w["w1"],
+                                      w["b1"], w["w2"], w["b2"],
+                                      capacity=cap)
+    assert float(z_loss) > 0.0
     want = _oracle(x, w, cap)
     np.testing.assert_allclose(np.asarray(y), want, rtol=2e-5, atol=2e-5)
     assert 0.0 < float(kept) <= 1.0
@@ -63,8 +65,8 @@ def test_capacity_drops_overflow_tokens():
     w["router_w"] = jnp.zeros_like(w["router_w"]).at[:, 0].set(5.0)
     x = jnp.asarray(np.abs(RNG.normal(size=(10, d))).astype(np.float32)
                     + 0.1)
-    y, _, kept = switch_moe(x, w["router_w"], w["w1"], w["b1"],
-                            w["w2"], w["b2"], capacity=3)
+    y, _, _, kept = switch_moe(x, w["router_w"], w["w1"], w["b1"],
+                               w["w2"], w["b2"], capacity=3)
     # first 3 tokens processed, the rest dropped to zeros
     assert float(kept) == pytest.approx(0.3)
     assert not np.allclose(np.asarray(y[:3]), 0.0)
@@ -199,8 +201,8 @@ def test_top2_matches_per_token_oracle():
     d, s, cap = 16, 24, 5
     w = _weights(d=d, seed=9)
     x = jnp.asarray(RNG.normal(size=(s, d)).astype(np.float32))
-    y, aux, kept = switch_moe(x, w["router_w"], w["w1"], w["b1"],
-                              w["w2"], w["b2"], capacity=cap, top_k=2)
+    y, aux, _, kept = switch_moe(x, w["router_w"], w["w1"], w["b1"],
+                                 w["w2"], w["b2"], capacity=cap, top_k=2)
     want = _oracle_top2(x, w, cap)
     np.testing.assert_allclose(np.asarray(y), want, rtol=3e-5, atol=3e-5)
     assert 0.0 < float(kept) <= 1.0
