@@ -13,7 +13,7 @@ only count by reading timeline traces).
     python tools/comm_report.py --config dp2tp2pp2    # one config
 
 Prints one JSON line per config:
-  {"config", "collectives": {kind: {"count", "mbytes"}}, "tflops",
+  {"config", "collectives": {kind: {"count", "mbytes"}}, "gflops",
    "comm_mbytes_total", "bytes_per_flop"}
 """
 
@@ -117,7 +117,7 @@ def report(config_name: str, *, batch: int = 8, seq_len: int = 32,
         "config": config_name,
         "collectives": {k: {"count": c, "mbytes": round(b / 1e6, 3)}
                         for k, (c, b) in sorted(traffic.items())},
-        "tflops": round(flops / 1e12, 4),
+        "gflops": round(flops / 1e9, 3),
         "comm_mbytes_total": round(total / 1e6, 3),
         "bytes_per_flop": round(total / flops, 6) if flops else None,
     }
