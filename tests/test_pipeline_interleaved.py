@@ -203,3 +203,26 @@ def test_ring_order_roundtrip():
             assert got[d, j, 0] == want, (d, j, got[d, j], want)
     back = ring_order_layers(r, n, v, inverse=True)
     np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(x["w"]))
+
+
+def test_interleaved_deep_wrap_v4(pp_mesh):
+    """v=4 on 4 stages (16 layer-chunks, 4 ring wraps per microbatch):
+    the deepest interleaving still reproduces the sequential fold, with
+    a ragged burst (m=6 over n=4)."""
+    L16 = 16
+    rng = np.random.default_rng(21)
+    params = {"w": jnp.asarray(rng.normal(scale=0.35, size=(L16, D, D))
+                               .astype(np.float32))}
+    x = jnp.asarray(rng.normal(size=(12, D)).astype(np.float32))
+    got = pipeline_apply(_block_fn_w, params, x, num_microbatches=6,
+                         mesh=pp_mesh, schedule="interleaved",
+                         virtual_stages=4)
+    h = x
+    for l in range(L16):
+        h = _block_fn_w({"w": params["w"][l]}, h)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(h),
+                               atol=1e-5, rtol=1e-5)
+
+
+def _block_fn_w(p, h):
+    return jnp.tanh(h @ p["w"])
