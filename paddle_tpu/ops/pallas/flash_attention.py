@@ -759,29 +759,35 @@ def _bwd4(q, k, v, kvm, seg, seed, o, lse, do, *, causal, window,
 
 def _attn_rule(has_mask, has_segs, has_seed, gqa, bwd):
     """Einsum-style Shardy sharding rule + need-replication factors for
-    the fwd/bwd custom calls. b (batch) and h (q heads) are passthrough
-    (shardable); tq/tk/d must be replicated (the kernel computes full
-    attention rows locally). Under GQA the k/v head factor g differs
-    from h, and a LOCAL h-shard could not address its kv group, so h and
-    g are both pinned replicated (GQA + head sharding goes through
-    parallel.sharded_flash_attention instead)."""
-    kh = "g" if gqa else "h"
-    qm, km = "b tq h d", f"b tk {kh} d"
+    the fwd/bwd custom calls. b (batch) and the head factor are
+    passthrough (shardable); tq/tk/d must be replicated (the kernel
+    computes full attention rows locally). Under GQA the q tensor
+    crosses the boundary as 5-D (b, tq, kv_heads, group, d) so the
+    KV-HEAD factor g is SHARED with k/v and shards consistently — a
+    head shard then owns whole kv groups (group itself is pinned
+    replicated: splitting a group would orphan its shared K/V)."""
+    if gqa:
+        qm, km = "b tq g grp d", "b tk g d"
+        lse, seed = "b g grp tq", "b g grp"
+    else:
+        qm, km = "b tq h d", "b tk h d"
+        lse, seed = "b h tq", "b h"
     ins = [qm, km, km]
     if has_mask:
         ins.append("b tk")
     if has_segs:
         ins.append("b tq")
     if has_seed:
-        ins.append("b h")
+        ins.append(seed)
     if bwd:
-        ins += [qm, "b h tq", qm]          # o, lse, do
+        ins += [qm, lse, qm]               # o, lse, do
         outs = [qm, km, km]                # dq, dk, dv
     else:
-        outs = [qm, "b h tq"]              # o, lse
+        outs = [qm, lse]                   # o, lse
     # need_replication must be sorted by factor first-appearance index:
-    # b=0, tq=1, h=2, d=3, tk=4 (+ g=5 under GQA)
-    need = ("tq", "h", "d", "tk", "g") if gqa else ("tq", "d", "tk")
+    # non-GQA b=0, tq=1, h=2, d=3, tk=4; GQA b=0, tq=1, g=2, grp=3,
+    # d=4, tk=5
+    need = ("tq", "grp", "d", "tk") if gqa else ("tq", "d", "tk")
     rule = ", ".join(ins) + " -> " + ", ".join(outs)
     return rule, need
 
@@ -795,27 +801,34 @@ def _attn_shardings(mesh, q_sharding, has_mask, has_segs, has_seed, gqa,
 
     msh = getattr(q_sharding, "mesh", None) or mesh
     spec = tuple(q_sharding.spec) if q_sharding is not None else ()
-    spec = spec + (None,) * (4 - len(spec))
+    spec = spec + (None,) * ((5 if gqa else 4) - len(spec))
     bax = spec[0]
-    hax = None if gqa else spec[2]
-    kax = hax  # under GQA both are already pinned None above
+    hax = spec[2]  # kv-head dim under GQA (q crosses as 5-D), else heads
 
     def S(*parts):
         return NamedSharding(msh, P(*parts))
 
-    qs, ks = S(bax, None, hax, None), S(bax, None, kax, None)
+    if gqa:
+        qs = S(bax, None, hax, None, None)   # (b, tq, kv, group, d)
+        ks = S(bax, None, hax, None)         # (b, tk, kv, d)
+        lse_s = S(bax, hax, None, None)      # (b, kv, group, tq)
+        seed_s = S(bax, hax, None)           # (b, kv, group)
+    else:
+        qs = ks = S(bax, None, hax, None)
+        lse_s = S(bax, hax, None)
+        seed_s = S(bax, hax)
     args = [qs, ks, ks]
     if has_mask:
         args.append(S(bax, None))
     if has_segs:
         args.append(S(bax, None))
     if has_seed:
-        args.append(S(bax, hax))
+        args.append(seed_s)
     if bwd:
-        args += [qs, S(bax, hax, None), qs]
+        args += [qs, lse_s, qs]
         results = (qs, ks, ks)
     else:
-        results = (qs, S(bax, hax, None))
+        results = (qs, lse_s)
     return msh, tuple(args), results
 
 
@@ -831,17 +844,38 @@ def _partitioned(bwd, has_mask, has_segs, has_seed, gqa, causal, window,
             q, k, v, kvm, seg, seed = _unpack_opt(
                 args[:-3], has_mask, has_segs, has_seed)
             o, lse, do = args[-3], args[-2], args[-1]
-            return _bwd4(q, k, v, kvm, seg, seed, o, lse, do,
-                         causal=causal, window=window, scale=scale,
-                         dropout_p=dropout_p, block_q_bwd=blk_a,
-                         block_k_bwd=blk_b, interpret=interpret)
+            if gqa:  # 5-D boundary (see _attn_rule) -> kernel 4-D forms
+                b, tq, kv, grp, d = q.shape
+                q = q.reshape(b, tq, kv * grp, d)
+                o = o.reshape(b, tq, kv * grp, d)
+                do = do.reshape(b, tq, kv * grp, d)
+                lse = lse.reshape(b, kv * grp, tq)
+                seed = (None if seed is None
+                        else seed.reshape(seed.shape[0], kv * grp))
+            dq, dk, dv = _bwd4(q, k, v, kvm, seg, seed, o, lse, do,
+                               causal=causal, window=window, scale=scale,
+                               dropout_p=dropout_p, block_q_bwd=blk_a,
+                               block_k_bwd=blk_b, interpret=interpret)
+            if gqa:
+                dq = dq.reshape(b, tq, kv, grp, d)
+            return dq, dk, dv
     else:
         def impl(*args):
             q, k, v, kvm, seg, seed = _unpack_opt(
                 args, has_mask, has_segs, has_seed)
-            return _fwd4(q, k, v, kvm, seg, seed, causal=causal,
-                         window=window, scale=scale, dropout_p=dropout_p,
-                         block_q=blk_a, block_k=blk_b, interpret=interpret)
+            if gqa:  # 5-D boundary (see _attn_rule) -> kernel 4-D forms
+                b, tq, kv, grp, d = q.shape
+                q = q.reshape(b, tq, kv * grp, d)
+                seed = (None if seed is None
+                        else seed.reshape(seed.shape[0], kv * grp))
+            o, lse = _fwd4(q, k, v, kvm, seg, seed, causal=causal,
+                           window=window, scale=scale, dropout_p=dropout_p,
+                           block_q=blk_a, block_k=blk_b,
+                           interpret=interpret)
+            if gqa:
+                o = o.reshape(b, tq, kv, grp, d)
+                lse = lse.reshape(b, kv, grp, tq)
+            return o, lse
 
     wrapped = custom_partitioning(impl)
     rule, need = _attn_rule(has_mask, has_segs, has_seed, gqa, bwd)
@@ -906,13 +940,31 @@ def _flash(q, k, v, kvm, seg, seed, causal, window, scale, dropout_p,
     return o
 
 
+def _gqa_pack(q, seed, hkv):
+    """4-D (b, t, h, d) q / (b, h) seed -> the 5-D/3-D GQA boundary
+    forms whose kv-head dim shards with k/v (see _attn_rule)."""
+    b, tq, h, d = q.shape
+    grp = h // hkv
+    q5 = q.reshape(b, tq, hkv, grp, d)
+    seed3 = None if seed is None else seed.reshape(b, hkv, grp)
+    return q5, seed3
+
+
 def _flash_fwd(q, k, v, kvm, seg, seed, causal, window, scale, dropout_p,
                block_q, block_k, block_q_bwd, block_k_bwd, interpret):
     gqa = k.shape[2] != q.shape[2]
     fwd = _partitioned(False, kvm is not None, seg is not None,
                        seed is not None, gqa, causal, window, scale,
                        dropout_p, block_q, block_k, interpret)
-    o, lse = fwd(*_opt_args(q, k, v, kvm, seg, seed))
+    if gqa:
+        b, tq, h, d = q.shape
+        q5, seed3 = _gqa_pack(q, seed, k.shape[2])
+        o5, lse = fwd(*_opt_args(q5, k, v, kvm, seg, seed3))
+        o = o5.reshape(b, tq, h, d)
+    else:
+        o, lse = fwd(*_opt_args(q, k, v, kvm, seg, seed))
+    # lse is stored in the call's boundary layout ((b, kv, grp, tq)
+    # under GQA) and handed back to the bwd call unchanged
     return o, (q, k, v, kvm, seg, seed, o, lse)
 
 
@@ -923,7 +975,19 @@ def _flash_bwd(causal, window, scale, dropout_p, block_q, block_k,
     bwd = _partitioned(True, kvm is not None, seg is not None,
                        seed is not None, gqa, causal, window, scale,
                        dropout_p, block_q_bwd, block_k_bwd, interpret)
-    dq, dk, dv = bwd(*(_opt_args(q, k, v, kvm, seg, seed) + (o, lse, do)))
+    if gqa:
+        b, tq, h, d = q.shape
+        hkv = k.shape[2]
+        grp = h // hkv
+        q5, seed3 = _gqa_pack(q, seed, hkv)
+        o5 = o.reshape(b, tq, hkv, grp, d)
+        do5 = do.reshape(b, tq, hkv, grp, d)
+        dq5, dk, dv = bwd(*(_opt_args(q5, k, v, kvm, seg, seed3)
+                            + (o5, lse, do5)))
+        dq = dq5.reshape(b, tq, h, d)
+    else:
+        dq, dk, dv = bwd(*(_opt_args(q, k, v, kvm, seg, seed)
+                           + (o, lse, do)))
     # the keep-mask, segment ids and dropout seed carry no gradients
     return dq, dk, dv, None, None, None
 

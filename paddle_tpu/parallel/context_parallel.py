@@ -328,11 +328,11 @@ def sharded_flash_attention(q, k, v, *, mesh=None, batch_axis="dp",
     """Flash attention partitioned over batch and/or head mesh axes via
     EXPLICIT shard_map. Since round 4 the kernel itself registers a
     partitioning rule (jax.experimental.custom_partitioning, see
-    ops/pallas/flash_attention.py), so plain pjit auto-sharding already
-    runs it on local shards — this wrapper remains for (a) explicit
-    control of which axes shard, and (b) GQA under HEAD sharding, which
-    the auto rule pins replicated (a local head shard cannot address its
-    kv group; here the group mapping is arranged per shard).
+    ops/pallas/flash_attention.py) covering dense AND GQA heads (q
+    crosses the boundary as (B, T, KV, GROUP, D) so kv heads shard with
+    k/v), so plain pjit auto-sharding already runs it on local shards —
+    this wrapper remains for explicit control of which axes shard
+    independently of the operands' incoming shardings.
 
     Attention is embarrassingly parallel over batch and heads, so each
     device runs the kernel on its local (b/dp, t, h/tp, d) shard with no

@@ -129,23 +129,43 @@ class TestFlashUnderPjit:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-6, atol=1e-6)
 
-    def test_gqa_pins_heads_replicated(self):
-        """GQA (h != h_kv): a local head shard could not address its kv
-        group, so the rule pins heads replicated — batch still shards and
-        values still match."""
-        mesh = pt.build_mesh(dp=4, tp=2, pp=1)
+    def test_gqa_shards_kv_heads(self):
+        """GQA (h != h_kv): q crosses the boundary as (B, T, KV, GROUP,
+        D) so the KV-HEAD factor shards WITH k/v — a head shard owns
+        whole kv groups, no all-gather, grads exact (incl. the
+        group-summed dk/dv)."""
+        mesh = pt.build_mesh(dp=2, tp=2, pp=2)
         b, t, h, hkv, d = 4, 128, 8, 2, 64
         rng = np.random.default_rng(5)
         q = jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
         k = jnp.asarray(rng.normal(size=(b, t, hkv, d)).astype(np.float32))
         v = jnp.asarray(rng.normal(size=(b, t, hkv, d)).astype(np.float32))
         ref = flash_attention(q, k, v, causal=True, interpret=True)
+        # shard KV heads over tp: q's head dim divides (8 q heads -> 2 kv
+        # groups of 4, one kv head per tp shard)
         qs, = _put(mesh, P("dp", None, "tp", None), q)
-        ks, vs = _put(mesh, P("dp", None, None, None), k, v)
-        out = jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=True, interpret=True))(qs, ks, vs)
+        ks, vs = _put(mesh, P("dp", None, "tp", None), k, v)
+        fn = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=True))
+        txt = fn.lower(qs, ks, vs).compile().as_text()
+        assert "all-gather" not in txt, \
+            "GQA head sharding must not gather q/k/v"
+        out = fn(qs, ks, vs)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-6, atol=2e-6)
+
+        ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    interpret=True) * ct).sum()
+
+        ref_g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        got_g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qs, ks, vs)
+        for gg, rr, name in zip(got_g, ref_g, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
+                                       rtol=2e-5, atol=2e-5,
+                                       err_msg=name)
 
 
 @pytest.mark.parametrize("causal,window,mask,segs,dropout", [
