@@ -302,3 +302,38 @@ def test_quant_matmul_partitions_without_gather():
     assert s == ("dp", "tp"), s
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_banded_window_partitions_without_gather():
+    """The BANDED grid (window small enough that out-of-band K/V blocks
+    are skipped — t=1024, w=96, blocks 128 gives a 3-wide band over 8
+    k-blocks) must survive partitioning: the index-map clamps use global
+    coordinates that are seq-local anyway (seq is pinned replicated), so
+    shards agree with the unsharded run exactly, fwd and bwd."""
+    mesh = pt.build_mesh(dp=2, tp=2, pp=2)
+    b, t, h, d = 4, 1024, 4, 64
+    rng = np.random.default_rng(31)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d))
+                             .astype(np.float32) * 0.3)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=True, window=96, block_q=128, block_k=128,
+              interpret=True)
+    ref = flash_attention(q, k, v, **kw)
+    qs, ks, vs = _put(mesh, P("dp", None, "tp", None), q, k, v)
+    fn = jax.jit(lambda q, k, v: flash_attention(q, k, v, **kw))
+    txt = fn.lower(qs, ks, vs).compile().as_text()
+    assert "all-gather" not in txt
+    out = fn(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+    ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, **kw) * ct).sum()
+
+    ref_g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    got_g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qs, ks, vs)
+    for gg, rr, name in zip(got_g, ref_g, "qkv"):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
