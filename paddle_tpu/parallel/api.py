@@ -297,9 +297,18 @@ class Trainer:
     @classmethod
     def supervised(cls, model: Layer, optimizer: Optimizer,
                    loss_fn: Callable, metrics_fn: Optional[Callable] = None,
-                   mesh=None, **kw) -> "Trainer":
+                   mesh=None, aux_loss_weight: float = 0.0,
+                   router_z_loss_weight: float = 0.0,
+                   **kw) -> "Trainer":
         """Convenience for (x, label) batches: batch = dict(x=..., label=...)
-        or tuple (x, label)."""
+        or tuple (x, label).
+
+        ``aux_loss_weight``/``router_z_loss_weight`` add those multiples
+        of every buffer named ``*aux_loss`` / ``*router_z_loss`` to the
+        TRAINING objective (eval_step reports the pure task loss) — the
+        MoE load-balance/stability terms ride the buffer mechanism
+        (nn.moe.SwitchFFN); the Switch-paper weights are 0.01 and the
+        ST-MoE z weight 1e-3."""
 
         def loss_builder(params, buffers, rng, batch):
             if isinstance(batch, dict):
@@ -311,6 +320,17 @@ class Trainer:
                 params, x, buffers=buffers, rng=rng, training=training)
             loss = loss_fn(out, label)
             metrics = metrics_fn(out, label) if metrics_fn else {}
+            if training and (aux_loss_weight or router_z_loss_weight):
+                # regularizers join only the OPTIMIZED loss; eval stays
+                # comparable to task-only baselines
+                if aux_loss_weight:
+                    loss = loss + aux_loss_weight * sum(
+                        v for k, v in new_buffers.items()
+                        if k.endswith("aux_loss"))
+                if router_z_loss_weight:
+                    loss = loss + router_z_loss_weight * sum(
+                        v for k, v in new_buffers.items()
+                        if k.endswith("router_z_loss"))
             return loss, (metrics, new_buffers)
 
         return cls(model, optimizer, loss_builder, mesh=mesh, **kw)

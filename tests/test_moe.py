@@ -276,3 +276,39 @@ def test_bert_moe_composes_with_tp_on_one_mesh():
     assert len(probes) >= 3, probes  # router + experts + tp attention
     for probe in probes:
         assert np.abs(np.asarray(g[probe])).max() > 0, probe
+
+
+def test_trainer_supervised_aux_loss_weight():
+    """The high-level Trainer folds the MoE aux/z losses into the
+    objective when aux_loss_weight is set — loss decreases and the
+    router receives gradient (it gets NO grad from a pure task loss if
+    the gates were detached; here the gate scaling carries it)."""
+    from paddle_tpu import optimizer
+    from paddle_tpu.parallel import Trainer
+
+    pt.seed(8)
+
+    class TinyMoENet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ffn = nn.SwitchFFN(8, 16, num_experts=2,
+                                    capacity_factor=2.0)
+            self.head = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.head(self.ffn(x).mean(axis=1))
+
+    model = TinyMoENet()
+    from paddle_tpu.ops import loss as L
+
+    tr = Trainer.supervised(
+        model, optimizer.Adam(1e-2),
+        lambda out, y: jnp.mean(L.softmax_with_cross_entropy(out, y)),
+        mesh=pt.build_mesh(dp=1, devices=jax.devices()[:1]),
+        aux_loss_weight=0.01)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(16, 4, 8)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 2, 16))
+    losses = [float(tr.train_step({"x": x, "label": y})[0])
+              for _ in range(12)]
+    assert losses[-1] < losses[0], losses
