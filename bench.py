@@ -90,6 +90,9 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
     return outer * k * batch_size / dt, "examples/sec", extras
 
 
+HEADLINE_STEPS = 100  # the full-length measurement; shorter runs (fast
+# sweep) fork the workload fingerprint and never claim headline records
+
 _STEPS_PER_CALL = None  # CLI override consumed by _train_bench
 _EXPLICIT_BATCH = False  # set by main() when --batch-size is given
 _MODE = "train"  # "train" | "infer" (--infer): per-model bench fns keep
@@ -841,19 +844,138 @@ MODELS = {
 }
 
 
+def hist_value(entry) -> float:
+    """Numeric view of a history entry — dict form ({"value": ...} with
+    metadata) or the legacy bare float."""
+    return entry["value"] if isinstance(entry, dict) else entry
+
+
+def run_config_fingerprint(metric: str, args, steps: int):
+    """Like-for-like identity + provenance for a history entry.
+
+    Returns ``(config_hash, config)``. The hash covers the WORKLOAD
+    identity: the metric key (which already encodes model + every
+    workload suffix: _vN/_wN/_nocache/_uN/_layout/_kN/_bN/_dpN/_infer)
+    plus the measurement length (``steps`` — a 24-step fast-sweep number
+    is noisier than a 100-step one and must never set or mask the
+    headline record; it lives under its own ``metric@hash`` variant
+    key). Two runs that share a metric key and steps hash identically —
+    knob sweeps (remat / amp / fused-ce variants that deliberately
+    compete for the headline record under one key) stay comparable. The
+    ``config`` dict records the full knob set as provenance so the
+    history is never silent about what produced a record (VERDICT r4
+    weak #4).
+    """
+    import hashlib
+
+    workload = {"metric": metric, "dp": args.dp, "steps": steps}
+    config_hash = hashlib.sha1(
+        json.dumps(workload, sort_keys=True).encode()).hexdigest()[:12]
+    config = {
+        "model": args.model, "steps": steps,
+        # an explicit --batch-size is honored as given; the harness-wide
+        # default is clamped per model inside the bench fn (_cap), so
+        # the requested value would be provenance fiction — record the
+        # truth we have
+        "batch": args.batch_size if args.batch_size else "model-default",
+        "amp": args.amp, "fused_ce": args.fused_ce, "remat": args.remat,
+        "scan_layers": args.scan_layers, "scan_unroll": args.scan_unroll,
+        "steps_per_call": args.steps_per_call, "vocab": args.vocab,
+        "window": args.window, "kv_cache": args.kv_cache,
+        "layout": args.layout, "dp": args.dp, "infer": args.infer,
+    }
+    # None = knob not set; False values (e.g. --no-fused-ce) are REAL
+    # provenance and must stay visible
+    config = {k: v for k, v in config.items() if v is not None}
+    return config_hash, config
+
+
 def evaluate_against_history(metric: str, value: float, history: dict, *,
-                             on_accelerator: bool, record: bool):
+                             on_accelerator: bool, record: bool,
+                             device_kind=None, config_hash=None,
+                             config=None, now=None):
     """Perf-regression contract: ``vs_baseline`` compares this run to the
-    BEST recorded accelerator number for the model (history keeps the
-    max; CPU runs never recorded). Returns (vs_baseline, regression);
+    BEST recorded accelerator number for the SAME workload (history keeps
+    the max; CPU runs never recorded). Returns (vs_baseline, regression);
     regression = accelerator run >10% below the record — the API.spec
     freeze philosophy applied to throughput. Mutates ``history`` in
-    place when ``record`` and ``on_accelerator``."""
-    prev = history.get(metric)
+    place when ``record`` and ``on_accelerator``.
+
+    Entries are dicts ``{value, ts, device, config_hash, config}``
+    (legacy bare floats still read, and are upgraded in place on the
+    next record). Like-for-like gate: a run only ever compares against
+    and updates an entry whose ``device`` and ``config_hash`` match its
+    own. A mismatched run is NOT silently compared (vs_baseline 1.0, no
+    regression flag) and records NON-destructively under the variant key
+    ``metric@config_hash`` — the headline record keeps its key, so an
+    alternating pair of configs can neither demote the true record nor
+    mask a later real regression against it. Legacy floats carry no
+    metadata; they were by construction 100-step headline chip runs
+    (CPU was never recorded), so they baseline only runs whose measured
+    length is the headline default."""
+    def _matches(entry):
+        if not isinstance(entry, dict) or entry.get("legacy"):
+            # legacy bare float (or its dict upgrade) — a full-length
+            # headline chip number with unknown knob provenance: it
+            # baselines only headline-length runs
+            return (config or {}).get("steps") in (None, HEADLINE_STEPS)
+        pd, ph = entry.get("device"), entry.get("config_hash")
+        if pd is not None and device_kind is not None and pd != device_kind:
+            return False
+        if ph is not None and config_hash is not None and ph != config_hash:
+            return False
+        return True
+
+    variant_key = f"{metric}@{config_hash}" if config_hash else None
+    # third tier: device-qualified variant, so runs from two chip
+    # generations each keep (and regress against) their OWN record
+    # instead of thrashing one key through _superseded
+    device_key = (f"{variant_key}@{device_kind}"
+                  if variant_key and device_kind else None)
+    baseline_key, prev_entry = None, None
+    for key in filter(None, (metric, variant_key, device_key)):
+        entry = history.get(key)
+        if entry is not None and _matches(entry):
+            baseline_key, prev_entry = key, entry
+            break
+    prev = hist_value(prev_entry) if prev_entry is not None else None
     vs_baseline = (value / prev) if prev else 1.0
     regression = bool(on_accelerator and prev and value < 0.9 * prev)
     if record and on_accelerator:
-        history[metric] = max(value, prev or 0.0)
+        if prev is not None and prev >= value:
+            # the record stands, keeping the metadata of the run that
+            # set it; bare legacy floats get a minimal dict upgrade
+            if not isinstance(prev_entry, dict):
+                history[baseline_key] = {"value": prev, "legacy": True}
+        else:
+            entry = {"value": value}
+            if now:
+                entry["ts"] = now
+            if device_kind:
+                entry["device"] = device_kind
+            if config_hash:
+                entry["config_hash"] = config_hash
+            if config:
+                entry["config"] = config
+            if baseline_key is not None:
+                target = baseline_key  # beat a matching record in place
+            else:
+                # headline-config runs own the bare metric key when it
+                # is free; anything else takes the first vacant variant
+                # tier (config, then config@device). All tiers occupied
+                # by mismatched entries can only mean scheme drift —
+                # archive the most specific one, never drop it.
+                headline = (config or {}).get("steps") in (None, HEADLINE_STEPS)
+                candidates = ([metric] if headline else []) + list(
+                    filter(None, (variant_key, device_key)))
+                vacant = [k for k in candidates if k not in history]
+                target = vacant[0] if vacant else (
+                    candidates[-1] if candidates else metric)
+                old = history.get(target)
+                if old is not None:
+                    history.setdefault("_superseded", []).append(
+                        {"metric": target, "entry": old})
+            history[target] = entry
     return vs_baseline, regression
 
 
@@ -934,7 +1056,7 @@ def main():
         if args.dp > 1 and args.platform == "cpu":
             jax.config.update("jax_num_cpu_devices", args.dp)
 
-    steps = args.steps or (10 if args.smoke else 100)
+    steps = args.steps or (10 if args.smoke else HEADLINE_STEPS)
     batch = args.batch_size or (256 if args.smoke else 8192)
     global _EXPLICIT_BATCH
     _EXPLICIT_BATCH = bool(args.batch_size)  # assignment: a second
@@ -971,13 +1093,25 @@ def main():
     if args.layout and "layout" in sig and args.layout != sig["layout"].default:
         metric += f"_{args.layout.lower()}"
     if args.steps_per_call:
-        # an EXPLICIT dispatch-fusion factor is a sweep point, not the
-        # headline config: its own history key (models whose headline IS
-        # fused, e.g. mnist k=8, set it via their bench signature default
-        # and stay unsuffixed)
-        metric += f"_k{args.steps_per_call}"
+        # a dispatch-fusion factor that DIFFERS from the model's headline
+        # default is a sweep point: its own history key. Passing the
+        # model's own default explicitly (e.g. mnist --steps-per-call 8)
+        # must not fork the history of an identical configuration —
+        # mirror the scan-unroll pattern and compare against the bench
+        # signature's default (1 for models routed via _train_bench).
+        _k_default = (sig["steps_per_call"].default
+                      if "steps_per_call" in sig else 1)
+        if not isinstance(_k_default, int):
+            _k_default = 1
+        if args.steps_per_call != _k_default:
+            metric += f"_k{args.steps_per_call}"
     if _EXPLICIT_BATCH:
         metric += f"_b{batch}"
+    if args.dp > 1:
+        # data-parallel width changes the WORKLOAD (global batch shards
+        # over dp devices): its own history key, never silently compared
+        # against the single-device record
+        metric += f"_dp{args.dp}"
     if args.infer and args.model == "deepfm_sparse":
         # sparse_grads only changes the UPDATE path; the forward is
         # identical to deepfm's — bench that instead of duplicating it
@@ -1095,14 +1229,16 @@ def main():
     # error and success lines for the same command)
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_HISTORY.json")
+    config_hash, run_config = run_config_fingerprint(metric, args, steps)
     line = report_line(metric, value, unit, extras,
                        history_path=hist_path, smoke=args.smoke,
-                       dp=args.dp)
+                       dp=args.dp, config_hash=config_hash,
+                       run_config=run_config)
     print(json.dumps(line))
 
 
 def report_line(metric, value, unit, extras, *, history_path, smoke,
-                dp=1, device=None):
+                dp=1, device=None, config_hash=None, run_config=None):
     """Post-run reporting: history recording + regression contract + MFU.
 
     Separated from main() so the ACCELERATOR code path (history writes,
@@ -1125,13 +1261,23 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
         device = jax.devices()[0]
 
     on_accelerator = device.platform != "cpu"
+    import datetime
+
     vs_baseline, regression = evaluate_against_history(
         metric, value, history, on_accelerator=on_accelerator,
-        record=not smoke)
+        record=not smoke,
+        device_kind=getattr(device, "device_kind", None) or device.platform,
+        config_hash=config_hash, config=run_config,
+        now=datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"))
     if regression:
+        # the baseline may live under a variant key; recover its value
+        # from the ratio rather than assuming history[metric] holds it
+        # (guarded: a 0.0 value yields vs_baseline 0.0)
+        prev_str = (f"{value / vs_baseline:.2f}" if vs_baseline > 0
+                    else "recorded baseline")
         print(f"WARNING: {metric} regressed >10% vs best recorded "
-              f"({value:.2f} vs {history[metric]:.2f} {unit})",
-              file=sys.stderr)
+              f"({value:.2f} vs {prev_str} {unit})", file=sys.stderr)
     if not smoke and on_accelerator:
         # CPU debug runs never pollute the recorded trajectory
         with open(history_path, "w") as f:

@@ -13,6 +13,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -140,14 +141,21 @@ int main(int argc, char** argv) {
     for (double v : lat_ms) sum += v;
     size_t n = lat_ms.size();
     double p50 = lat_ms[n / 2];
-    double p99 = lat_ms[std::min(n - 1, (size_t)(0.99 * n))];
+    // nearest-rank percentile: idx = ceil(0.99*n)-1. By definition this
+    // still lands on the last sample for any n < 100 — a true p99 needs
+    // >= 100 samples (the fill-list ptserve items pass iters=100) — so
+    // max is reported as its own field and small-n p99 readings should
+    // be read as max, not as a percentile.
+    size_t p99_idx = (size_t)std::ceil(0.99 * (double)n);
+    double p99 = lat_ms[p99_idx > 0 ? p99_idx - 1 : 0];
+    double mx = lat_ms[n - 1];
     double mean = sum / n;
     // one JSON line, bench.py style — the analyzer-latency-test role
     printf(
         "{\"metric\": \"native_serve_latency_ms\", \"p50\": %.3f, "
-        "\"p99\": %.3f, \"mean\": %.3f, \"batch\": %lld, \"iters\": %zu, "
-        "\"examples_per_sec\": %.1f}\n",
-        p50, p99, mean, (long long)batch, n, batch * 1000.0 / mean);
+        "\"p99\": %.3f, \"max\": %.3f, \"mean\": %.3f, \"batch\": %lld, "
+        "\"iters\": %zu, \"examples_per_sec\": %.1f}\n",
+        p50, p99, mx, mean, (long long)batch, n, batch * 1000.0 / mean);
   }
   ptpred_destroy(p);
   printf("ok\n");

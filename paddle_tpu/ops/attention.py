@@ -152,7 +152,16 @@ class force_flash:
     """Context manager: route eligible shapes to the flash kernel even
     off-TPU (interpret mode). For tests that must exercise the Pallas
     dispatch + partitioning path on the virtual CPU mesh — production
-    dispatch stays backend-gated."""
+    dispatch stays backend-gated.
+
+    CAVEAT (trace-time flag, jit cache): the flag is read when a
+    function is TRACED, not when it is called — a function first jitted
+    inside this context keeps the flash path via jax's jit cache after
+    the context exits (and one jitted outside keeps the XLA path inside
+    it). Tests that flip the flag must trace fresh functions (or call
+    ``.clear_cache()`` on the jitted fn) on each side of the toggle.
+    The flag is also process-global, not thread-local — don't toggle it
+    concurrently from multiple threads."""
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled

@@ -120,9 +120,12 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         nid = item.nodeid
         if any(p in nid for p in SLOW_PATTERNS):
+            # slow wins: a compile-heavy test never rides into the mid
+            # tier even when a broad MID pattern (e.g. a bare filename)
+            # also matches it
             item.add_marker(pytest.mark.slow)
         elif any(p in nid for p in SMOKE_PATTERNS):
             item.add_marker(pytest.mark.smoke)
             item.add_marker(pytest.mark.mid)  # mid is a smoke superset
-        if any(p in nid for p in MID_PATTERNS):
+        elif any(p in nid for p in MID_PATTERNS):
             item.add_marker(pytest.mark.mid)

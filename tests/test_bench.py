@@ -12,6 +12,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
+def _load_bench(name="bench_mod"):
+    """Load bench.py as a fresh module (its module state — _MODE,
+    _EXPLICIT_BATCH — must not leak between tests)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(name, BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _run(*extra, timeout=520):
     r = subprocess.run([sys.executable, BENCH, "--platform", "cpu", *extra],
                        capture_output=True, text=True, timeout=timeout)
@@ -37,14 +48,10 @@ def test_regression_contract():
     """vs_baseline compares to the best recorded accelerator number;
     >10% below it on an accelerator flags a regression; CPU runs are
     never recorded (the perf-freeze contract)."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench()
     ev = bench.evaluate_against_history
 
-    hist = {"m_throughput": 100.0}
+    hist = {"m_throughput": 100.0}  # legacy bare-float entry
     # accelerator regression: >10% below record
     vs, reg = ev("m_throughput", 80.0, dict(hist), on_accelerator=True,
                  record=True)
@@ -57,14 +64,126 @@ def test_regression_contract():
     h = dict(hist)
     vs, reg = ev("m_throughput", 10.0, h, on_accelerator=False, record=True)
     assert not reg and h["m_throughput"] == 100.0
-    # new accelerator record is kept
+    # new accelerator record is kept (entries are metadata dicts now)
     h = dict(hist)
-    ev("m_throughput", 150.0, h, on_accelerator=True, record=True)
-    assert h["m_throughput"] == 150.0
+    ev("m_throughput", 150.0, h, on_accelerator=True, record=True,
+       device_kind="TPU v5e", config_hash="abc", now="2026-08-01T00:00:00")
+    e = h["m_throughput"]
+    assert bench.hist_value(e) == 150.0
+    assert e["device"] == "TPU v5e" and e["config_hash"] == "abc"
+    assert e["ts"] == "2026-08-01T00:00:00"
+    # a slower run against a legacy float keeps the record, upgraded to
+    # the dict form (marked legacy: its provenance is unknown)
+    h = dict(hist)
+    ev("m_throughput", 80.0, h, on_accelerator=True, record=True)
+    assert h["m_throughput"] == {"value": 100.0, "legacy": True}
     # first-ever number: baseline 1.0, recorded
     h = {}
     vs, reg = ev("m_throughput", 50.0, h, on_accelerator=True, record=True)
-    assert vs == 1.0 and not reg and h["m_throughput"] == 50.0
+    assert vs == 1.0 and not reg and bench.hist_value(h["m_throughput"]) == 50.0
+
+
+def test_history_like_for_like_gate():
+    """VERDICT r4 weak #4: vs_baseline never compares across device or
+    workload config silently — a mismatched run is no baseline (1.0, no
+    regression) and records NON-destructively under metric@hash, so the
+    true record keeps its key and later matching runs still regress
+    against it."""
+    bench = _load_bench("bench_mod2")
+    ev = bench.evaluate_against_history
+
+    v5e = {"value": 100.0, "device": "TPU v5e", "config_hash": "cfgA",
+           "ts": "t0"}
+    # same device + config: normal comparison, record stands
+    h = {"m": dict(v5e)}
+    vs, reg = ev("m", 50.0, h, on_accelerator=True, record=True,
+                 device_kind="TPU v5e", config_hash="cfgA")
+    assert vs == 0.5 and reg and bench.hist_value(h["m"]) == 100.0
+    # different workload fingerprint (e.g. a 24-step fast-sweep run vs
+    # the 100-step record): no comparison, and the record is untouched —
+    # the fast number lands under its own variant key
+    h = {"m": dict(v5e)}
+    vs, reg = ev("m", 30.0, h, on_accelerator=True, record=True,
+                 device_kind="TPU v5e", config_hash="cfgB",
+                 config={"steps": 24})
+    assert vs == 1.0 and not reg
+    assert h["m"] == v5e  # headline record not demoted
+    assert bench.hist_value(h["m@cfgB"]) == 30.0
+    # ...and a LATER matching run still regresses against the original
+    # record (the alternating-config masking scenario)
+    vs, reg = ev("m", 50.0, h, on_accelerator=True, record=True,
+                 device_kind="TPU v5e", config_hash="cfgA")
+    assert vs == 0.5 and reg
+    # the fast variant compares against its own baseline on repeat
+    vs, reg = ev("m", 33.0, h, on_accelerator=True, record=True,
+                 device_kind="TPU v5e", config_hash="cfgB",
+                 config={"steps": 24})
+    assert vs == 1.1 and bench.hist_value(h["m@cfgB"]) == 33.0
+    # a non-headline run never claims a VACANT headline key either
+    h = {}
+    ev("m", 30.0, h, on_accelerator=True, record=True,
+       device_kind="TPU v5e", config_hash="cfgB", config={"steps": 24})
+    assert "m" not in h and bench.hist_value(h["m@cfgB"]) == 30.0
+    # a legacy float upgraded in place ({"legacy": True}) KEEPS the
+    # headline-length gate: a later fast run neither compares against
+    # nor overwrites it
+    h = {"m": 100.0}
+    ev("m", 80.0, h, on_accelerator=True, record=True,
+       device_kind="TPU v5e", config_hash="cfgA")  # upgrade, record stands
+    assert h["m"] == {"value": 100.0, "legacy": True}
+    vs, reg = ev("m", 500.0, h, on_accelerator=True, record=True,
+                 device_kind="TPU v5e", config_hash="cfgB",
+                 config={"steps": 24})
+    assert vs == 1.0 and not reg
+    assert h["m"] == {"value": 100.0, "legacy": True}  # untouched
+    assert bench.hist_value(h["m@cfgB"]) == 500.0
+    # a different chip generation takes a device-qualified key: both
+    # devices keep their own records, neither thrashes the other's
+    h = {"m": dict(v5e),
+         "m@cfgB": {"value": 20.0, "device": "TPU v5e",
+                    "config_hash": "cfgB"}}
+    ev("m", 40.0, h, on_accelerator=True, record=True,
+       device_kind="TPU v6e", config_hash="cfgB", config={"steps": 24})
+    assert h["m@cfgB"]["device"] == "TPU v5e"  # v5e record untouched
+    assert bench.hist_value(h["m@cfgB@TPU v6e"]) == 40.0
+    # ...and the v6e run regresses against its OWN record next time
+    vs, reg = ev("m", 20.0, h, on_accelerator=True, record=True,
+                 device_kind="TPU v6e", config_hash="cfgB",
+                 config={"steps": 24})
+    assert vs == 0.5 and reg
+    # a v5e rerun still compares to the v5e variant record
+    vs, _ = ev("m", 30.0, h, on_accelerator=True, record=True,
+               device_kind="TPU v5e", config_hash="cfgB",
+               config={"steps": 24})
+    assert vs == 1.5 and bench.hist_value(h["m@cfgB"]) == 30.0
+
+
+def test_run_config_fingerprint_identity():
+    """Knob sweeps sharing a metric key + steps hash identically (they
+    compete for one record); a different measurement length forks the
+    hash (fast-sweep isolation)."""
+    import argparse
+
+    bench = _load_bench("bench_mod3")
+
+    def ns(**kw):
+        base = dict(model="bert_base", steps=None, batch_size=None,
+                    amp="mixed_bf16", fused_ce=True, remat=None,
+                    scan_layers=False, scan_unroll=None,
+                    steps_per_call=None, vocab=None, window=None,
+                    kv_cache=True, layout=None, dp=1, infer=False)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    h1, c1 = bench.run_config_fingerprint("bert_base_throughput", ns(),
+                                          100)
+    h2, c2 = bench.run_config_fingerprint("bert_base_throughput",
+                                          ns(remat="dots"), 100)
+    assert h1 == h2  # remat is a knob, not workload identity
+    assert c2["remat"] == "dots"  # but it IS recorded as provenance
+    h3, _ = bench.run_config_fingerprint("bert_base_throughput", ns(),
+                                         24)
+    assert h3 != h1  # fast-sweep steps fork the hash (own variant key)
 
 
 def test_dp_misuse_keeps_json_contract():
@@ -125,7 +244,9 @@ def test_accelerator_report_path_end_to_end(tmp_path, monkeypatch):
     assert line["mfu"] == 0.5
     assert line["tflops_per_sec"] == 98.5
     with open(hist) as f:
-        assert json.load(f)["bert_base_throughput"] == 1000.0
+        e = json.load(f)["bert_base_throughput"]
+    assert bench.hist_value(e) == 1000.0
+    assert e["device"] == "TPU v5e" and e["ts"]  # metadata rides along
 
     # a faster run replaces the record
     line = bench.report_line("bert_base_throughput", 1200.0,
@@ -133,7 +254,7 @@ def test_accelerator_report_path_end_to_end(tmp_path, monkeypatch):
                              smoke=False, device=dev)
     assert line["vs_baseline"] == 1.2
     with open(hist) as f:
-        assert json.load(f)["bert_base_throughput"] == 1200.0
+        assert bench.hist_value(json.load(f)["bert_base_throughput"]) == 1200.0
 
     # a >10% drop flags regression, warns, and keeps the best record
     err = io.StringIO()
@@ -145,7 +266,7 @@ def test_accelerator_report_path_end_to_end(tmp_path, monkeypatch):
     assert line.get("regression") is True
     assert "regressed" in err.getvalue()
     with open(hist) as f:
-        assert json.load(f)["bert_base_throughput"] == 1200.0
+        assert bench.hist_value(json.load(f)["bert_base_throughput"]) == 1200.0
 
     # smoke runs never record, even on the accelerator
     line = bench.report_line("other_metric", 50.0, "examples/sec", {},

@@ -49,6 +49,11 @@ item() {  # item <tag> <timeout_s> <cmd...>
 }
 
 log "=== fill pass begins ==="
+# -- tier 0: window-sized complete sweep (VERDICT r4 #1) — ALL 10 models
+# at real shapes / reduced steps, 60 s hard budget each, <= 10 min
+# total, sized to the 8-17-minute windows actually observed. One short
+# window = a complete post-fix MFU table; everything below refines it.
+item fast_sweep 660 bash tools/fast_sweep.sh "$OUT"
 # -- tier 1: quick + unique value (MFU holes, the untuned long-context shape)
 item mfu_mnist        600  python bench.py
 item mfu_resnet50     900  python bench.py --model resnet50
@@ -143,8 +148,8 @@ item decode_nmt_full   1500 python bench.py --model nmt_decode --no-kv-cache
 # NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
 # C++ predictor + PJRT C API (export runs off-chip: StableHLO is
 # portable; only the ptserve compile+run needs the chip)
-item serve_rn50        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model resnet50 --out /tmp/rn50_art --platform cpu && paddle_tpu/native/ptserve /tmp/rn50_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 50'
-item serve_bert        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model bert_base --out /tmp/bert_art --platform cpu && paddle_tpu/native/ptserve /tmp/bert_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 50'
+item serve_rn50        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model resnet50 --out /tmp/rn50_art --platform cpu && paddle_tpu/native/ptserve /tmp/rn50_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
+item serve_bert        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model bert_base --out /tmp/bert_art --platform cpu && paddle_tpu/native/ptserve /tmp/bert_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
 # -- tier 4: full-sweep completeness (superset of the retired
 # tpu_session.sh list so a FRESH environment gets every model and every
 # default tune shape from this one script; in an already-captured
