@@ -541,7 +541,7 @@ class MultiHeadAttention(_MHADecodeMixin, Layer):
             from ..parallel.context_parallel import context_parallel_attention
 
             kw = ({"use_flash": self.use_flash}
-                  if self.seq_parallel == "ulysses" else {})
+                  if self.seq_parallel in ("ulysses", "ring") else {})
             out = context_parallel_attention(
                 q, k, v, impl=self.seq_parallel, causal=causal,
                 kv_mask=kv_mask, segment_ids=segment_ids, window=window,

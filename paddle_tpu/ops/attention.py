@@ -179,14 +179,22 @@ class force_flash:
 
 
 def _flash_ok(q, k, causal: bool = False, window=None) -> bool:
+    """Flash kernel constraints for (B, T, H, D) operands — see
+    flash_shape_ok for the actual gate."""
+    return flash_shape_ok(q.shape[1], k.shape[1], q.shape[-1],
+                          causal=causal, window=window)
+
+
+def flash_shape_ok(tq, tk, d, causal: bool = False, window=None) -> bool:
     """Flash kernel constraints: TPU backend, block-divisible seq lens,
     supported head dim — and the autotuner's measured verdict when one
     exists (tools/pallas_tune.py records use_flash=False for shape
-    buckets where the XLA fallback won on-chip)."""
+    buckets where the XLA fallback won on-chip). Shape-level so the
+    ring-attention dispatch (parallel/context_parallel.py) can gate on
+    its PER-SHARD (t/sp) block shape."""
     if (not _FORCE_FLASH
             and jax.default_backend() not in ("tpu", "axon")):
         return False
-    tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
     # 64-divisible seqs use block=64 (the tuner measures that shape too:
     # tools/pallas_tune.py short-seq fallback); the measured use_flash
     # verdict below still decides whether the kernel actually wins there

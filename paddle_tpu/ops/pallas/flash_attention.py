@@ -540,12 +540,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
               do, causal, window, scale, dropout_p, block_q, block_k,
-              interpret):
+              interpret, delta=None):
     bh, tq, d = q.shape
     tk = k.shape[1]
     offset = tk - tq
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (bh, tq, 1)
+    if delta is None:  # ring callers pass the hop-invariant value once
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # (bh, tq, 1)
     has_mask = kvm is not None
     has_segs = qseg is not None
     n_j, n_i = tk // block_k, tq // block_q
@@ -755,6 +756,113 @@ def _bwd4(q, k, v, kvm, seg, seed, o, lse, do, *, causal, window,
     return (dq.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
             dk.reshape(b, hkv, tk, d).transpose(0, 2, 1, 3),
             dv.reshape(b, hkv, tk, d).transpose(0, 2, 1, 3))
+
+
+def resolve_block_sizes(tq, tk, d, causal, block_q=None, block_k=None,
+                        block_q_bwd=None, block_k_bwd=None):
+    """Resolve the four kernel block sizes from the autotuned table
+    (ops/pallas/tuning.py), falling back pow2-wise to sizes that divide
+    the sequence lengths. Shared by flash_attention and the
+    ring-attention per-step calls (parallel/context_parallel.py), which
+    see t/sp-sized blocks and must resolve against THOSE shapes."""
+    tuned = {}
+    if None in (block_q, block_k, block_q_bwd, block_k_bwd):
+        from .tuning import attention_key, get_tuned
+
+        tuned = get_tuned(attention_key(tq, tk, d, causal)) or {}
+
+    def _resolve(given, key, seq, default):
+        # pow2 buckets can hold shapes the tuned block doesn't divide
+        # (e.g. 384 in the 512 bucket with block 256) — walk a fallback
+        # chain (tuned -> default -> 64) and take the first block that
+        # divides the seq, rather than trip the divisibility error in
+        # flash_attention (the dispatch gate admits any 64-divisible
+        # seq, so e.g. 192 must resolve to 64, not crash on the 128
+        # default)
+        if given is not None:
+            return min(given, seq)
+        for cand in (tuned.get(key), default, 64):
+            if cand and seq % min(cand, seq) == 0:
+                return min(cand, seq)
+        return min(default, seq)
+
+    block_q = _resolve(block_q, "block_q", tq, DEFAULT_BLOCK_Q)
+    block_k = _resolve(block_k, "block_k", tk, DEFAULT_BLOCK_K)
+    # the backward kernels (dq + dkv) have their own arithmetic-intensity
+    # sweet spot; tuned independently, defaulting to the forward blocks
+    block_q_bwd = _resolve(block_q_bwd, "block_q_bwd", tq, block_q)
+    block_k_bwd = _resolve(block_k_bwd, "block_k_bwd", tk, block_k)
+    return block_q, block_k, block_q_bwd, block_k_bwd
+
+
+# ---------------------------------------------------------------------------
+# ring-attention per-step entry points (parallel/context_parallel.py)
+#
+# Ring attention holds the q rows home and rotates K/V blocks around the
+# 'sp' mesh axis. Each hop runs the SAME pallas kernels as single-chip
+# flash on (q_local, kv_block) — these two wrappers differ from
+# _fwd4/_bwd4 only in that (a) the forward RETURNS the logsumexp so the
+# ring loop can merge hops flash-decoding style, and (b) the q-side and
+# kv-side segment ids are INDEPENDENT arrays (q ids stay home, kv ids
+# travel with their block). No GQA/window/dropout (the ring dispatch
+# gates those to the einsum path).
+# ---------------------------------------------------------------------------
+
+
+def ring_fwd_block(q, k, v, kvm, qseg, kseg, *, causal, scale, block_q,
+                   block_k, interpret):
+    """One ring hop's flash forward: local q (B, Tq, H, D) against one
+    rotating K/V block (B, Tk, H, D). Returns (o, lse): o is the
+    block-normalized output and lse = m + log(l) its per-row logsumexp
+    ((B, H, Tq)) — exactly the pair the flash-decoding merge needs.
+    ``causal`` here means THIS block is the diagonal one (same global
+    offsets); strictly-past blocks are called with causal=False and
+    strictly-future ones are skipped by the caller."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    kvm3 = None if kvm is None else kvm.astype(jnp.float32).reshape(b, 1, tk)
+    qseg3 = None if qseg is None else qseg.astype(jnp.int32).reshape(b, tq, 1)
+    kseg3 = None if kseg is None else kseg.astype(jnp.int32).reshape(b, 1, tk)
+    o, lse = _fwd_call(qf, kf, vf, kvm3, qseg3, kseg3, None, h, h,
+                       causal, None, scale, 0.0, block_q, block_k,
+                       interpret)
+    return (o.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
+            lse.reshape(b, h, tq))
+
+
+def ring_bwd_block(q, k, v, kvm, qseg, kseg, o, lse, do, *, causal,
+                   scale, block_q, block_k, interpret, delta=None):
+    """One ring hop's flash backward under the GLOBAL softmax: p is
+    recomputed against the ring-merged lse and delta = rowsum(do * o)
+    uses the FINAL output, so the returned (dq, dk, dv) are this
+    (q rows, kv block) pair's exact contributions to the global
+    gradients — the standard flash backward decomposition, evaluated one
+    hop at a time. ``o``/``do``: final output / upstream cotangent
+    (B, Tq, H, D); ``lse``: ring-merged (B, H, Tq); ``delta``: optional
+    precomputed rowsum(do*o) ((B, Tq, H) — hop-invariant, so the ring
+    loop computes it once instead of n times)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    of = o.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    dof = do.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    lsef = lse.reshape(b * h, tq, 1)
+    deltaf = (None if delta is None
+              else delta.transpose(0, 2, 1).reshape(b * h, tq, 1))
+    kvm3 = None if kvm is None else kvm.astype(jnp.float32).reshape(b, 1, tk)
+    qseg3 = None if qseg is None else qseg.astype(jnp.int32).reshape(b, tq, 1)
+    kseg3 = None if kseg is None else kseg.astype(jnp.int32).reshape(b, 1, tk)
+    dq, dk, dv = _bwd_call(qf, kf, vf, kvm3, qseg3, kseg3, None, h, h,
+                           of, lsef, dof, causal, None, scale, 0.0,
+                           block_q, block_k, interpret, delta=deltaf)
+    return (dq.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
+            dk.reshape(b, h, tk, d).transpose(0, 2, 1, 3),
+            dv.reshape(b, h, tk, d).transpose(0, 2, 1, 3))
 
 
 def _attn_rule(has_mask, has_segs, has_seed, gqa, bwd):
@@ -1045,32 +1153,8 @@ def flash_attention(q, k, v, causal: bool = False,
                 f"({h}) and match each other")
     if scale is None:
         scale = d ** -0.5
-    tuned = {}
-    if None in (block_q, block_k, block_q_bwd, block_k_bwd):
-        from .tuning import attention_key, get_tuned
-
-        tuned = get_tuned(attention_key(tq, tk, d, causal)) or {}
-
-    def _resolve(given, key, seq, default):
-        # pow2 buckets can hold shapes the tuned block doesn't divide
-        # (e.g. 384 in the 512 bucket with block 256) — walk a fallback
-        # chain (tuned -> default -> 64) and take the first block that
-        # divides the seq, rather than trip the divisibility error below
-        # (the dispatch gate admits any 64-divisible seq, so e.g. 192
-        # must resolve to 64, not crash on the 128 default)
-        if given is not None:
-            return min(given, seq)
-        for cand in (tuned.get(key), default, 64):
-            if cand and seq % min(cand, seq) == 0:
-                return min(cand, seq)
-        return min(default, seq)
-
-    block_q = _resolve(block_q, "block_q", tq, DEFAULT_BLOCK_Q)
-    block_k = _resolve(block_k, "block_k", tk, DEFAULT_BLOCK_K)
-    # the backward kernels (dq + dkv) have their own arithmetic-intensity
-    # sweet spot; tuned independently, defaulting to the forward blocks
-    block_q_bwd = _resolve(block_q_bwd, "block_q_bwd", tq, block_q)
-    block_k_bwd = _resolve(block_k_bwd, "block_k_bwd", tk, block_k)
+    block_q, block_k, block_q_bwd, block_k_bwd = resolve_block_sizes(
+        tq, tk, d, causal, block_q, block_k, block_q_bwd, block_k_bwd)
     if tq % block_q or tk % block_k or tq % block_q_bwd or tk % block_k_bwd:
         raise ValueError(
             f"seq lens ({tq},{tk}) must be divisible by blocks "

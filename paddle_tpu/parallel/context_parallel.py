@@ -199,11 +199,204 @@ def _ring_inner(q, k, v, km, seg, *, axis, causal, window, scale, n):
     return o.astype(q.dtype)
 
 
+# --- ring attention on the flash kernel (VERDICT r4 #3) -------------------
+#
+# The einsum inner above materializes per-shard-pair (t x t) score blocks
+# through XLA every hop — exactly the cost the flash kernel exists to
+# kill, and the reason bert_long's SP config was bounded by the fallback.
+# This path instead runs the Pallas flash FORWARD per hop (returning the
+# block's output + logsumexp) and merges hops flash-decoding style:
+#
+#   lse' = logaddexp(lse, lse_hop)
+#   o'   = o * exp(lse - lse') + o_hop * exp(lse_hop - lse')
+#
+# which is the online-softmax recurrence carried ACROSS ppermute hops —
+# scores never leave VMEM. The backward is its own ring loop: each hop
+# calls the flash backward kernel with the GLOBAL (ring-merged) lse and
+# the FINAL output (delta = rowsum(do*o)), which makes every hop's
+# (dq, dk, dv) the exact contribution of that (q rows, kv block) pair to
+# the global gradients; dk/dv accumulators travel the ring with their
+# block and arrive home after n hops. Causal runs skip strictly-future
+# blocks entirely (lax.cond) and use the causal kernel variant only on
+# the diagonal block, keeping the O(T^2/2) ring schedule.
+#
+# Gated to kv_mask/segment_ids/causal (no window/GQA/dropout — those
+# stay on the einsum path or don't apply); dispatch in ring_attention.
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
+def _ring_flash(q, k, v, km, seg, axis, causal, scale, n, block_q,
+                block_k, block_q_bwd, block_k_bwd, interpret):
+    o, _ = _ring_flash_fwd(q, k, v, km, seg, axis, causal, scale, n,
+                           block_q, block_k, block_q_bwd, block_k_bwd,
+                           interpret)
+    return o
+
+
+def _ring_flash_fwd(q, k, v, km, seg, axis, causal, scale, n, block_q,
+                    block_k, block_q_bwd, block_k_bwd, interpret):
+    from ..ops.pallas.flash_attention import ring_fwd_block
+
+    b, t, h, d = q.shape
+    my_idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_mask = km is not None
+    has_segs = seg is not None
+
+    def fwd_block(kc, vc, kmc, ksegc, blk_causal):
+        return ring_fwd_block(
+            q, kc, vc, kmc if has_mask else None,
+            seg if has_segs else None, ksegc if has_segs else None,
+            causal=blk_causal, scale=scale, block_q=block_q,
+            block_k=block_k, interpret=interpret)
+
+    def merge(o_acc, lse_acc, o_s, lse_s):
+        lse_new = jnp.logaddexp(lse_acc, lse_s)          # (b, h, t)
+        w = lambda x: jnp.exp(x - lse_new).transpose(0, 2, 1)[..., None]
+        return (o_acc * w(lse_acc) + o_s.astype(jnp.float32) * w(lse_s),
+                lse_new)
+
+    def contribute(o_acc, lse_acc, kc, vc, kmc, ksegc, src):
+        if causal:
+            o_s, lse_s = lax.cond(
+                src == my_idx,
+                lambda: fwd_block(kc, vc, kmc, ksegc, True),
+                lambda: fwd_block(kc, vc, kmc, ksegc, False))
+        else:
+            o_s, lse_s = fwd_block(kc, vc, kmc, ksegc, False)
+        return merge(o_acc, lse_acc, o_s, lse_s)
+
+    def step_body(o_acc, lse_acc, kc, vc, kmc, ksegc, src):
+        if causal:  # strictly-future blocks contribute nothing at all
+            return lax.cond(
+                src > my_idx,
+                lambda: (o_acc, lse_acc),
+                lambda: contribute(o_acc, lse_acc, kc, vc, kmc, ksegc,
+                                   src))
+        return contribute(o_acc, lse_acc, kc, vc, kmc, ksegc, src)
+
+    def step(carry, t_step):
+        o_acc, lse_acc, kc, vc, kmc, ksegc = carry
+        src = (my_idx - t_step) % n
+        o_acc, lse_acc = step_body(o_acc, lse_acc, kc, vc, kmc, ksegc,
+                                   src)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        if has_mask:
+            kmc = lax.ppermute(kmc, axis, perm)
+        if has_segs:
+            ksegc = lax.ppermute(ksegc, axis, perm)
+        return (o_acc, lse_acc, kc, vc, kmc, ksegc), None
+
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    km0 = km if has_mask else jnp.zeros((b, t), jnp.bool_)
+    seg0 = seg if has_segs else jnp.zeros((b, t), jnp.int32)
+    # scan the first n-1 hops (compute + rotate); the last hop's compute
+    # is peeled so the final rotation never hits the ICI ring
+    (o_acc, lse_acc, kc, vc, kmc, ksegc), _ = lax.scan(
+        step, (o0, lse0, k, v, km0, seg0), jnp.arange(n - 1))
+    last_src = (my_idx - (n - 1)) % n
+    o_acc, lse_acc = step_body(o_acc, lse_acc, kc, vc, kmc, ksegc,
+                               last_src)
+    o = o_acc.astype(q.dtype)
+    return o, (q, k, v, km, seg, o, lse_acc)
+
+
+def _ring_flash_bwd(axis, causal, scale, n, block_q, block_k,
+                    block_q_bwd, block_k_bwd, interpret, res, do):
+    from ..ops.pallas.flash_attention import ring_bwd_block
+
+    q, k, v, km, seg, o, lse = res
+    b, t, h, d = q.shape
+    my_idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_mask = km is not None
+    has_segs = seg is not None
+
+    # hop-invariant: rowsum(do * o) against the FINAL output, computed
+    # once here rather than inside each of the n hops' kernel calls
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # (b, t, h)
+
+    def bwd_block(kc, vc, kmc, ksegc, blk_causal):
+        return ring_bwd_block(
+            q, kc, vc, kmc if has_mask else None,
+            seg if has_segs else None, ksegc if has_segs else None,
+            o, lse, do, causal=blk_causal, scale=scale,
+            block_q=block_q_bwd, block_k=block_k_bwd,
+            interpret=interpret, delta=delta)
+
+    def contribute(dq, dkc, dvc, kc, vc, kmc, ksegc, src):
+        if causal:
+            dq_p, dk_p, dv_p = lax.cond(
+                src == my_idx,
+                lambda: bwd_block(kc, vc, kmc, ksegc, True),
+                lambda: bwd_block(kc, vc, kmc, ksegc, False))
+        else:
+            dq_p, dk_p, dv_p = bwd_block(kc, vc, kmc, ksegc, False)
+        return (dq + dq_p.astype(jnp.float32),
+                dkc + dk_p.astype(jnp.float32),
+                dvc + dv_p.astype(jnp.float32))
+
+    def step_body(dq, dkc, dvc, kc, vc, kmc, ksegc, src):
+        if causal:
+            return lax.cond(
+                src > my_idx,
+                lambda: (dq, dkc, dvc),
+                lambda: contribute(dq, dkc, dvc, kc, vc, kmc, ksegc,
+                                   src))
+        return contribute(dq, dkc, dvc, kc, vc, kmc, ksegc, src)
+
+    def step(carry, t_step):
+        dq, kc, vc, kmc, ksegc, dkc, dvc = carry
+        src = (my_idx - t_step) % n
+        dq, dkc, dvc = step_body(dq, dkc, dvc, kc, vc, kmc, ksegc, src)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        if has_mask:
+            kmc = lax.ppermute(kmc, axis, perm)
+        if has_segs:
+            ksegc = lax.ppermute(ksegc, axis, perm)
+        # the block's gradient accumulators travel WITH it
+        dkc = lax.ppermute(dkc, axis, perm)
+        dvc = lax.ppermute(dvc, axis, perm)
+        return (dq, kc, vc, kmc, ksegc, dkc, dvc), None
+
+    dq0 = jnp.zeros((b, t, h, d), jnp.float32)
+    dk0 = jnp.zeros((b, t, h, d), jnp.float32)
+    dv0 = jnp.zeros((b, t, h, d), jnp.float32)
+    km0 = km if has_mask else jnp.zeros((b, t), jnp.bool_)
+    seg0 = seg if has_segs else jnp.zeros((b, t), jnp.int32)
+    (dq, kc, vc, kmc, ksegc, dkc, dvc), _ = lax.scan(
+        step, (dq0, k, v, km0, seg0, dk0, dv0), jnp.arange(n - 1))
+    last_src = (my_idx - (n - 1)) % n
+    dq, dkc, dvc = step_body(dq, dkc, dvc, kc, vc, kmc, ksegc, last_src)
+    # one final hop brings each block's accumulated dk/dv home (the k/v
+    # blocks themselves are already discarded — no need to rotate them)
+    dkc = lax.ppermute(dkc, axis, perm)
+    dvc = lax.ppermute(dvc, axis, perm)
+    return (dq.astype(q.dtype), dkc.astype(k.dtype),
+            dvc.astype(v.dtype), None, None)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _ring_flash_inner(q, k, v, km, seg, *, axis, causal, scale, n,
+                      blocks, interpret):
+    return _ring_flash(q, k, v, km, seg, axis, causal, scale, n,
+                       blocks[0], blocks[1], blocks[2], blocks[3],
+                       interpret)
+
+
 def ring_attention(q, k, v, *, causal: bool = False,
                    scale: Optional[float] = None, axis: str = "sp",
                    batch_axis: Optional[str] = "dp", mesh=None,
                    kv_mask=None, segment_ids=None,
-                   window: Optional[int] = None):
+                   window: Optional[int] = None,
+                   use_flash: bool = True):
     """Sequence-parallel attention over global (B, T, H, D) arrays.
 
     ``q``/``k``/``v`` are sharded ``P(batch_axis, axis)`` over the mesh; T must
@@ -215,6 +408,12 @@ def ring_attention(q, k, v, *, causal: bool = False,
     kv-side ids rotate with their block. ``window``: sliding-window band
     in GLOBAL positions (ring steps wholly outside the band keep their
     carries untouched).
+
+    ``use_flash``: route each ring hop through the Pallas flash kernel
+    (online-softmax carries merged ACROSS hops — scores never hit HBM)
+    when the per-shard block shape is kernel-eligible; windowed runs and
+    ineligible shapes keep the einsum inner. Same gating semantics as
+    scaled_dot_product_attention's use_flash.
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
@@ -233,8 +432,22 @@ def ring_attention(q, k, v, *, causal: bool = False,
         scale = d ** -0.5
     spec = P(batch_axis, axis, None, None)
     mspec = P(batch_axis, axis)
-    inner = functools.partial(_ring_inner, axis=axis, causal=causal,
-                              window=window, scale=float(scale), n=n)
+    t_local = t // n
+    from ..ops.attention import flash_shape_ok
+
+    if use_flash and window is None and flash_shape_ok(
+            t_local, t_local, d, causal=causal):
+        from ..ops.pallas.flash_attention import (_use_interpret,
+                                                  resolve_block_sizes)
+
+        blocks = resolve_block_sizes(t_local, t_local, d, causal)
+        inner = functools.partial(
+            _ring_flash_inner, axis=axis, causal=causal,
+            scale=float(scale), n=n, blocks=blocks,
+            interpret=_use_interpret())
+    else:
+        inner = functools.partial(_ring_inner, axis=axis, causal=causal,
+                                  window=window, scale=float(scale), n=n)
     return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,
                                 kv_mask, segment_ids)
 

@@ -421,3 +421,203 @@ def test_ulysses_window_through_flash_kernel(sp_mesh, monkeypatch):
     want = xla_attention(q, k, v, causal=True, window=24)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# ring attention ON the flash kernel (VERDICT r4 #3): per-hop Pallas
+# flash forward, online-softmax (o, lse) merged across ppermute hops —
+# scores never materialize through XLA; backward is a second ring loop
+# feeding the flash backward kernel the GLOBAL lse + final output.
+# ---------------------------------------------------------------------------
+
+# kernel-eligible per-shard block: T/sp = 64 rows, head_dim 64
+FB, FT, FH, FD = 2, 256, 2, 64
+
+
+def _qkv_flash(seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(FB, FT, FH, FD)).astype(np.float32) * 0.3)
+    return mk(), mk(), mk()
+
+
+def _count_ring_fwd_blocks(monkeypatch):
+    """Trace-time counter on the per-hop kernel entry — proof the ring
+    path went through Pallas, not the einsum inner."""
+    import importlib
+
+    # the package re-exports the flash_attention FUNCTION under the same
+    # name; grab the module itself
+    F = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+    calls = {"n": 0}
+    real = F.ring_fwd_block
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(F, "ring_fwd_block", counting)
+    return calls
+
+
+class TestRingFlash:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_xla(self, sp_mesh, causal, monkeypatch):
+        from paddle_tpu.ops.attention import force_flash
+
+        calls = _count_ring_fwd_blocks(monkeypatch)
+        q, k, v = _qkv_flash()
+        with force_flash():
+            got = ring_attention(q, k, v, causal=causal, mesh=sp_mesh)
+        assert calls["n"] > 0, "ring did not take the flash path"
+        want = xla_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_xla(self, sp_mesh, causal):
+        from paddle_tpu.ops.attention import force_flash
+
+        q, k, v = _qkv_flash(1)
+        ct = jnp.asarray(np.random.default_rng(9).normal(
+            size=(FB, FT, FH, FD)).astype(np.float32))
+
+        def loss_ring(q, k, v):
+            o = ring_attention(q, k, v, causal=causal, mesh=sp_mesh)
+            return jnp.sum(o * ct)
+
+        def loss_full(q, k, v):
+            o = xla_attention(q, k, v, causal=causal)
+            return jnp.sum(o * ct)
+
+        with force_flash():
+            g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for gr, gf in zip(g_ring, g_full):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                       atol=5e-4, rtol=5e-4)
+
+    def test_kv_mask_forward_and_grads(self, sp_mesh):
+        from paddle_tpu.ops.attention import force_flash
+
+        q, k, v = _qkv_flash(2)
+        # ragged batch: row 0 keeps 160 keys (crosses shard boundaries),
+        # row 1 keeps everything
+        keep = jnp.asarray(np.arange(FT)[None, :]
+                           < np.array([160, FT])[:, None])
+
+        def loss(fn):
+            def f(q, k, v):
+                return jnp.sum(fn(q, k, v) ** 2)
+            return f
+
+        ring_fn = lambda q, k, v: ring_attention(
+            q, k, v, mesh=sp_mesh, kv_mask=keep)
+        full_fn = lambda q, k, v: xla_attention(
+            q, k, v, mask=keep[:, None, None, :])
+        with force_flash():
+            got = ring_fn(q, k, v)
+            g_ring = jax.grad(loss(ring_fn), argnums=(0, 1, 2))(q, k, v)
+        want = full_fn(q, k, v)
+        g_full = jax.grad(loss(full_fn), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+        for gr, gf in zip(g_ring, g_full):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                       atol=5e-4, rtol=5e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_segment_ids(self, sp_mesh, causal):
+        from paddle_tpu.ops.attention import force_flash
+
+        q, k, v = _qkv_flash(3)
+        # two packed segments per row; boundary inside a shard and at a
+        # shard boundary respectively
+        seg = jnp.asarray(np.stack([
+            (np.arange(FT) >= 100).astype(np.int32),
+            (np.arange(FT) >= 128).astype(np.int32)]))
+        with force_flash():
+            got = ring_attention(q, k, v, causal=causal, mesh=sp_mesh,
+                                 segment_ids=seg)
+        want = xla_attention(q, k, v, causal=causal,
+                             mask=(seg[:, None, :, None]
+                                   == seg[:, None, None, :]))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_no_gather_and_ring_permute_in_hlo(self, sp_mesh):
+        """The compiled sharded module moves K/V by collective-permute
+        only — no all-gather anywhere (the einsum path has the same
+        contract; this pins it for the flash path, VERDICT r4 #3's
+        no-gather assert)."""
+        from paddle_tpu.ops.attention import force_flash
+        from jax.sharding import NamedSharding
+
+        q, k, v = _qkv_flash(4)
+        sh = NamedSharding(sp_mesh, jax.sharding.PartitionSpec(
+            "dp", "sp", None, None))
+        qs, ks, vs = (jax.device_put(a, sh) for a in (q, k, v))
+        with force_flash():
+            fn = jax.jit(lambda q, k, v: ring_attention(
+                q, k, v, causal=True, mesh=sp_mesh))
+            txt = fn.lower(qs, ks, vs).compile().as_text()
+            out = fn(qs, ks, vs)
+        assert "all-gather" not in txt, "ring-flash must never gather K/V"
+        assert "collective-permute" in txt, "expected ring ppermute hops"
+        want = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_falls_back_to_einsum(self, sp_mesh, monkeypatch):
+        from paddle_tpu.ops.attention import force_flash
+
+        calls = _count_ring_fwd_blocks(monkeypatch)
+        q, k, v = _qkv_flash(5)
+        with force_flash():
+            got = ring_attention(q, k, v, causal=True, mesh=sp_mesh,
+                                 window=32)
+        assert calls["n"] == 0, "windowed ring must keep the einsum inner"
+        want = xla_attention(q, k, v, causal=True, window=32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_use_flash_false_keeps_einsum(self, sp_mesh, monkeypatch):
+        from paddle_tpu.ops.attention import force_flash
+
+        calls = _count_ring_fwd_blocks(monkeypatch)
+        q, k, v = _qkv_flash(6)
+        with force_flash():
+            got = ring_attention(q, k, v, mesh=sp_mesh, use_flash=False)
+        assert calls["n"] == 0
+        want = xla_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_bert_long_sp_config_rides_flash(self, sp_mesh, monkeypatch):
+        """VERDICT r4 #3 done-criterion: the bert_long SP configuration
+        (BertForPretraining, seq_parallel='ring', head_dim 64, seq
+        dividing sp into 64-row blocks) compiles to the flash ring."""
+        from paddle_tpu.models import bert as B
+        from paddle_tpu.ops.attention import force_flash
+
+        calls = _count_ring_fwd_blocks(monkeypatch)
+        pt.seed(11)
+        cfg = B.BertConfig(vocab_size=512, hidden_size=128, num_layers=1,
+                           num_heads=2, intermediate_size=256,
+                           max_position=256, dropout=0.0,
+                           seq_parallel="ring")
+        model = B.BertForPretraining(cfg).eval()
+        rng = np.random.default_rng(12)
+        ids = jnp.asarray(rng.integers(0, 512, (2, 256)))
+        with force_flash():
+            out = model(ids)
+        assert calls["n"] > 0, "bert SP config did not ride the kernel"
+        cfg2 = B.BertConfig(vocab_size=512, hidden_size=128, num_layers=1,
+                            num_heads=2, intermediate_size=256,
+                            max_position=256, dropout=0.0)
+        pt.seed(11)
+        ref = B.BertForPretraining(cfg2).eval()(ids)
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(out)[0]),
+            np.asarray(jax.tree_util.tree_leaves(ref)[0]),
+            atol=3e-4, rtol=3e-4)
