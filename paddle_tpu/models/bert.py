@@ -54,6 +54,15 @@ class BertConfig:
         return cls(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
                    intermediate_size=128, max_position=128, dropout=0.0)
 
+    @classmethod
+    def moe_smoke(cls, layers: int = 4):
+        """The ONE bert_moe smoke configuration shared by the test suite
+        and the multichip dryrun (capacity 2.0 keeps routing drops out of
+        loss-match tolerances) — tune it in one place."""
+        return cls(vocab_size=256, hidden_size=64, num_layers=layers,
+                   num_heads=4, intermediate_size=128, max_position=32,
+                   dropout=0.0, moe_experts=4, moe_capacity_factor=2.0)
+
 
 class BertEmbeddings(nn.Layer):
     def __init__(self, cfg: BertConfig):

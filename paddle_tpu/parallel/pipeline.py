@@ -148,17 +148,66 @@ def bubble_fraction(n: int, m: int, schedule: str = "gpipe",
     return 1.0 - m / gpipe_ticks(n, m)
 
 
-def _pipeline_inner(params_nk, x_mb, *, block_fn, axis, n, m, remat):
+def _aux_block_step(block_fn):
+    """Scan body applying one aux-carrying block: the SINGLE definition
+    of the aux accumulation (f32, summed over layers) shared by both
+    schedule inners and the sequential folds — the microbatch-mean aux
+    contract must not be able to diverge between the pipelined paths and
+    their loss-match oracles."""
+    def one_block(carry, p):
+        h, a = carry
+        h, al = block_fn(p, h)
+        return (h, a + al.astype(jnp.float32)), None
+
+    return one_block
+
+
+def microbatched_aux_fold(block_fn, stacked_params, x, *,
+                          num_microbatches, aux_size, remat=True):
+    """Sequential per-MICROBATCH fold of an aux-carrying block stack:
+    ``(out (B, ...), aux_mean (aux_size,))`` with aux summed over layers
+    per microbatch and averaged over microbatches — numerically the same
+    definition every pipelined schedule computes (MoE routing state is
+    microbatch-local, so a full-batch fold would differ). Used by the
+    n == 1 pipeline short-circuit AND by sequential loss-match oracles
+    (parallel/hybrid.py)."""
+    body = _aux_block_step(block_fn)
+    if remat:
+        body = jax.checkpoint(body)
+    B, m = x.shape[0], num_microbatches
+    x_mb = x.reshape(m, B // m, *x.shape[1:])
+
+    def per_mb(_, mb):
+        out = lax.scan(body, (mb, jnp.zeros((aux_size,), jnp.float32)),
+                       stacked_params)[0]
+        return None, out
+
+    _, (h_mb, a_mb) = lax.scan(per_mb, None, x_mb)
+    return h_mb.reshape(B, *h_mb.shape[2:]), jnp.mean(a_mb, axis=0)
+
+
+def _pipeline_inner(params_nk, x_mb, *, block_fn, axis, n, m, remat,
+                    aux_size=0):
     # params_nk leaves: (1, k, ...) — this stage's chunk; squeeze the shard dim
     p_local = jax.tree_util.tree_map(lambda a: a[0], params_nk)
     idx = lax.axis_index(axis)
+    has_aux = aux_size > 0
     # x_mb: (m, mb, ...) replicated — stage 0 reads, others ignore
 
-    def stage_fn(p_k, h):
-        def one_block(h, p):
-            return block_fn(p, h), None
+    if has_aux:
+        # aux contract: block_fn(p, h) -> (h, aux (A,)); each
+        # microbatch's aux vector RIDES THE RING with its activation,
+        # summed over the layers it passes through, and is banked by the
+        # last stage next to the output (MoE load-balance/z losses —
+        # VERDICT r4 #4)
+        def stage_fn(p_k, h, a):
+            return lax.scan(_aux_block_step(block_fn), (h, a), p_k)[0]
+    else:
+        def stage_fn(p_k, h):
+            def one_block(h, p):
+                return block_fn(p, h), None
 
-        return lax.scan(one_block, h, p_k)[0]
+            return lax.scan(one_block, h, p_k)[0]
 
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
@@ -167,38 +216,65 @@ def _pipeline_inner(params_nk, x_mb, *, block_fn, axis, n, m, remat):
     fwd_perm = [(i, i + 1) for i in range(n - 1)]
 
     def tick(carry, t):
-        state, outbuf = carry
+        if has_aux:
+            state, aux_state, outbuf, auxbuf = carry
+        else:
+            state, outbuf = carry
         # stage 0 injects microbatch t (clipped: past-the-end ticks feed
         # a dummy that never reaches the output window)
         mb = lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, m - 1), 0,
                                       keepdims=False)
         inp = jnp.where(idx == 0, mb, state)
-        out = stage_fn(p_local, inp)
+        if has_aux:
+            a_in = jnp.where(idx == 0, jnp.zeros_like(aux_state),
+                             aux_state)
+            out, a_out = stage_fn(p_local, inp, a_in)
+        else:
+            out = stage_fn(p_local, inp)
         # last stage banks microbatch t-(n-1) once the pipe is full
         pos = t - (n - 1)
         write = jnp.logical_and(idx == n - 1, pos >= 0)
         upd = lax.dynamic_update_index_in_dim(
             outbuf, out.astype(outbuf.dtype), jnp.clip(pos, 0, m - 1), 0)
         outbuf = jnp.where(write, upd, outbuf)
+        if has_aux:
+            aupd = lax.dynamic_update_index_in_dim(
+                auxbuf, a_out, jnp.clip(pos, 0, m - 1), 0)
+            auxbuf = jnp.where(write, aupd, auxbuf)
         if n > 1:
             state = lax.ppermute(out, axis, fwd_perm)
+            if has_aux:
+                aux_state = lax.ppermute(a_out, axis, fwd_perm)
         else:
             state = out
-        return (state, outbuf), None
+            if has_aux:
+                aux_state = a_out
+        carry = ((state, aux_state, outbuf, auxbuf) if has_aux
+                 else (state, outbuf))
+        return carry, None
 
     state0 = jnp.zeros(mb_shape, x_mb.dtype)
     outbuf0 = jnp.zeros((m,) + mb_shape, jnp.result_type(x_mb.dtype))
-    (_, outbuf), _ = lax.scan(tick, (state0, outbuf0), jnp.arange(n + m - 1))
+    init = ((state0, jnp.zeros((aux_size,), jnp.float32), outbuf0,
+             jnp.zeros((m, aux_size), jnp.float32)) if has_aux
+            else (state0, outbuf0))
+    carry, _ = lax.scan(tick, init, jnp.arange(n + m - 1))
     # only the last stage's buffer is real; mask+psum broadcasts it so the
     # result is replicated over 'pp' (loss/optimizer run identically on all
     # stages — the XLA partitioner then dedups what it can). n == 1 never
     # reaches here: pipeline_apply short-circuits to a sequential fold
+    if has_aux:
+        _, _, outbuf, auxbuf = carry
+        outbuf = jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf))
+        auxbuf = jnp.where(idx == n - 1, auxbuf, jnp.zeros_like(auxbuf))
+        return lax.psum(outbuf, axis), lax.psum(auxbuf, axis)
+    _, outbuf = carry
     outbuf = jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf))
     return lax.psum(outbuf, axis)
 
 
 def _interleaved_inner(params_nvk, x_mb, *, block_fn, axis, n, m, v,
-                       remat):
+                       remat, aux_size=0):
     """One device's lockstep loop of the interleaved schedule.
 
     Tick arithmetic (s = t - device_index ≥ 0 inside the busy window):
@@ -207,19 +283,31 @@ def _interleaved_inner(params_nvk, x_mb, *, block_fn, axis, n, m, v,
     j*n + d (local slot j) to the activation the ring just delivered;
     stage 0 overrides with a fresh injection when j == 0, the last stage
     banks after its j == v-1 application. The full ring permutation
-    (n-1 → 0 wrap) carries activations into their next pass."""
+    (n-1 → 0 wrap) carries activations into their next pass. With
+    ``aux_size``, each microbatch's (A,) aux vector travels the full
+    v-pass ring journey with its activation (see _pipeline_inner)."""
     p_local = jax.tree_util.tree_map(lambda a: a[0], params_nvk)  # (v,k,...)
     idx = lax.axis_index(axis)
+    has_aux = aux_size > 0
 
-    def chunk_fn(p_vk, j, h):
-        p_k = jax.tree_util.tree_map(
-            lambda a: lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
-            p_vk)
+    if has_aux:
+        def chunk_fn(p_vk, j, h, a):
+            p_k = jax.tree_util.tree_map(
+                lambda arr: lax.dynamic_index_in_dim(arr, j, 0,
+                                                     keepdims=False),
+                p_vk)
+            return lax.scan(_aux_block_step(block_fn), (h, a), p_k)[0]
+    else:
+        def chunk_fn(p_vk, j, h):
+            p_k = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, j, 0,
+                                                   keepdims=False),
+                p_vk)
 
-        def one_block(h, p):
-            return block_fn(p, h), None
+            def one_block(h, p):
+                return block_fn(p, h), None
 
-        return lax.scan(one_block, h, p_k)[0]
+            return lax.scan(one_block, h, p_k)[0]
 
     if remat:
         chunk_fn = jax.checkpoint(chunk_fn)
@@ -228,29 +316,54 @@ def _interleaved_inner(params_nvk, x_mb, *, block_fn, axis, n, m, v,
     perm = [(i, (i + 1) % n) for i in range(n)]  # full ring: passes wrap
 
     def tick(carry, t):
-        state, outbuf = carry
+        if has_aux:
+            state, aux_state, outbuf, auxbuf = carry
+        else:
+            state, outbuf = carry
         s = jnp.maximum(t - idx, 0)  # pre-window ticks compute garbage
         r = s % (v * n)
         j = r // n
         mb = (s // (v * n)) * n + r % n
         inj = lax.dynamic_index_in_dim(x_mb, jnp.clip(mb, 0, m - 1), 0,
                                        keepdims=False)
-        inp = jnp.where(jnp.logical_and(idx == 0, j == 0), inj, state)
-        out = chunk_fn(p_local, j, inp)
+        fresh = jnp.logical_and(idx == 0, j == 0)
+        inp = jnp.where(fresh, inj, state)
+        if has_aux:
+            a_in = jnp.where(fresh, jnp.zeros_like(aux_state), aux_state)
+            out, a_out = chunk_fn(p_local, j, inp, a_in)
+        else:
+            out = chunk_fn(p_local, j, inp)
         write = jnp.logical_and(
             jnp.logical_and(idx == n - 1, j == v - 1),
             jnp.logical_and(mb < m, t >= idx))
         upd = lax.dynamic_update_index_in_dim(
             outbuf, out.astype(outbuf.dtype), jnp.clip(mb, 0, m - 1), 0)
         outbuf = jnp.where(write, upd, outbuf)
+        if has_aux:
+            aupd = lax.dynamic_update_index_in_dim(
+                auxbuf, a_out, jnp.clip(mb, 0, m - 1), 0)
+            auxbuf = jnp.where(write, aupd, auxbuf)
         state = lax.ppermute(out, axis, perm) if n > 1 else out
+        if has_aux:
+            aux_state = (lax.ppermute(a_out, axis, perm) if n > 1
+                         else a_out)
+            return (state, aux_state, outbuf, auxbuf), None
         return (state, outbuf), None
 
     state0 = jnp.zeros(mb_shape, x_mb.dtype)
     outbuf0 = jnp.zeros((m,) + mb_shape, jnp.result_type(x_mb.dtype))
     T = interleaved_ticks(n, m, v)
-    (_, outbuf), _ = lax.scan(tick, (state0, outbuf0), jnp.arange(T))
+    init = ((state0, jnp.zeros((aux_size,), jnp.float32), outbuf0,
+             jnp.zeros((m, aux_size), jnp.float32)) if has_aux
+            else (state0, outbuf0))
+    carry, _ = lax.scan(tick, init, jnp.arange(T))
     # n == 1 never reaches here (pipeline_apply short-circuits)
+    if has_aux:
+        _, _, outbuf, auxbuf = carry
+        outbuf = jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf))
+        auxbuf = jnp.where(idx == n - 1, auxbuf, jnp.zeros_like(auxbuf))
+        return lax.psum(outbuf, axis), lax.psum(auxbuf, axis)
+    _, outbuf = carry
     outbuf = jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf))
     return lax.psum(outbuf, axis)
 
@@ -259,7 +372,8 @@ def pipeline_apply(block_fn: Callable, stacked_params, x, *,
                    num_microbatches: int, axis: str = "pp",
                    mesh=None, remat: bool = True,
                    schedule: str = "gpipe", virtual_stages: int = 1,
-                   layers_in_ring_order: bool = False):
+                   layers_in_ring_order: bool = False,
+                   aux_size: int = 0):
     """Run ``x`` through ``L`` stacked layers as an ``n``-stage pipeline.
 
     - ``block_fn(params_l, h) -> h``: applies ONE layer (uniform shape).
@@ -273,6 +387,16 @@ def pipeline_apply(block_fn: Callable, stacked_params, x, *,
       :func:`ring_order_layers` (persistent 'pp'-sharded training state
       should be — the per-step stage split is then a LOCAL reshape;
       logical-order sharded stacks pay a weight all-to-all per step).
+    - ``aux_size``: when > 0 the block contract widens to
+      ``block_fn(params_l, h) -> (h, aux)`` with ``aux`` a float32
+      ``(aux_size,)`` vector per layer (MoE load-balance/router-z losses
+      — VERDICT r4 #4). Each microbatch's aux rides the pipeline ring
+      with its activation, summed over all ``L`` layers, and the return
+      becomes ``(out, aux_mean)`` where ``aux_mean`` is the
+      MICROBATCH-MEAN of the per-microbatch layer sums — the pipelined
+      aux definition (each microbatch routes independently, so a
+      full-batch aux would not be computable without materializing every
+      microbatch's router state).
 
     Returns the pipelined equivalent of folding ``block_fn`` over all ``L``
     layers, replicated over the 'pp' axis.
@@ -312,6 +436,16 @@ def pipeline_apply(block_fn: Callable, stacked_params, x, *,
                                          inverse=True)
                        if layers_in_ring_order else stacked_params)
 
+        if aux_size > 0:
+            # the pipelined aux is per-MICROBATCH (routing state is
+            # microbatch-local); the degenerate fold must microbatch
+            # identically, or its MoE capacity/queues — and therefore
+            # its loss — would differ from every n > 1 configuration
+            h, aux = microbatched_aux_fold(
+                block_fn, fold_params, x, num_microbatches=m,
+                aux_size=aux_size, remat=remat)
+            return h.astype(jnp.result_type(x.dtype)), aux
+
         def fold(h, p_l):
             return block_fn(p_l, h), None
 
@@ -332,20 +466,28 @@ def pipeline_apply(block_fn: Callable, stacked_params, x, *,
     # shard_map (and the production path is jitted anyway — no-op there).
     # Cached by configuration so eager per-step callers hit the XLA compile
     # cache instead of retracing a fresh closure every call.
-    fn = _jitted_pipeline(block_fn, mesh, axis, n, m, remat, schedule, v)
+    fn = _jitted_pipeline(block_fn, mesh, axis, n, m, remat, schedule, v,
+                          aux_size)
+    if aux_size > 0:
+        out_mb, aux_mb = fn(params_staged, x_mb)
+        return (out_mb.reshape(B, *out_mb.shape[2:]),
+                jnp.mean(aux_mb, axis=0))
     out_mb = fn(params_staged, x_mb)
     return out_mb.reshape(B, *out_mb.shape[2:])
 
 
 @functools.lru_cache(maxsize=64)
 def _jitted_pipeline(block_fn, mesh, axis, n, m, remat, schedule="gpipe",
-                     v=1):
+                     v=1, aux_size=0):
     if schedule == "interleaved" and v > 1:
         inner = functools.partial(_interleaved_inner, block_fn=block_fn,
-                                  axis=axis, n=n, m=m, v=v, remat=remat)
+                                  axis=axis, n=n, m=m, v=v, remat=remat,
+                                  aux_size=aux_size)
     else:
         inner = functools.partial(_pipeline_inner, block_fn=block_fn,
-                                  axis=axis, n=n, m=m, remat=remat)
+                                  axis=axis, n=n, m=m, remat=remat,
+                                  aux_size=aux_size)
+    out_specs = (P(), P()) if aux_size > 0 else P()
 
     def wrapper(params_staged, x_mb):
         # specs are shape-independent, built from the pytree at trace time
@@ -356,7 +498,8 @@ def _jitted_pipeline(block_fn, mesh, axis, n, m, remat, schedule="gpipe",
         # the pipeline in ONE module (GSPMD inserts their collectives
         # around the manual ppermute ring)
         return jax.shard_map(inner, mesh=mesh,
-                             in_specs=(stage_spec, P()), out_specs=P(),
+                             in_specs=(stage_spec, P()),
+                             out_specs=out_specs,
                              axis_names=frozenset({axis}),
                              check_vma=False)(params_staged, x_mb)
 
