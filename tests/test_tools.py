@@ -177,14 +177,9 @@ class TestCommReport:
         """The HLO tally behind tools/comm_report.py: scalar-result,
         TUPLE-result (grad-bucket all-reduces), async -start/-done pairs
         (counted once), and non-collective lines."""
-        import importlib.util
-        import os
+        from conftest import load_tool
 
-        spec = importlib.util.spec_from_file_location(
-            "comm_report", os.path.join(os.path.dirname(__file__), "..",
-                                        "tools", "comm_report.py"))
-        cr = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(cr)
+        cr = load_tool("comm_report")
 
         hlo = "\n".join([
             "  %ar.1 = f32[8,64]{1,0} all-reduce(%p0), replica_groups={}",
