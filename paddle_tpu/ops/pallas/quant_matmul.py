@@ -196,14 +196,23 @@ def quant_matmul(a_i8, b_i8, a_scale, b_scale, *, out_dtype=jnp.float32,
     enforce(a_i8.dtype == jnp.int8 and b_i8.dtype == jnp.int8,
             "quant_matmul takes int8 operands, got %s/%s", a_i8.dtype,
             b_i8.dtype)
+    # symbolic dims (jax.export batch-polymorphic serving artifacts)
+    # can't bucket into the tuned table or feed a pallas grid — those
+    # traces take the XLA dot_general path unconditionally (the Pallas
+    # kernel is a runtime dispatch choice, not an artifact property)
+    static_shape = all(isinstance(d, int) for d in (m, n, ka))
+    if not static_shape:
+        use_pallas = False
+        interpret = False
     tuned = {}
-    if tile_m is None or tile_n is None or tile_k is None:
+    if static_shape and (tile_m is None or tile_n is None
+                         or tile_k is None):
         from .tuning import get_tuned, matmul_key
 
         tuned = get_tuned(matmul_key(m, n, ka)) or {}
-        tile_m = tile_m or tuned.get("tile_m", 128)
-        tile_n = tile_n or tuned.get("tile_n", 128)
-        tile_k = tile_k or tuned.get("tile_k", 128)
+    tile_m = tile_m or tuned.get("tile_m", 128)
+    tile_n = tile_n or tuned.get("tile_n", 128)
+    tile_k = tile_k or tuned.get("tile_k", 128)
     if use_pallas is None:
         # axon is the tunneled TPU backend — same Mosaic compile path;
         # a recorded use_pallas=False verdict (no tile config compiled

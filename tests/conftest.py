@@ -96,6 +96,7 @@ MID_PATTERNS = [
     "test_batch_and_head_sharded_matches_oracle",
     "test_quant_matmul.py::test_kernel_matches_xla_path_exactly",
     "test_quant_matmul.py::test_qat_freeze_int8_serve_e2e",
+    "test_quant_serving.py",
     "test_sharded_embedding.py::test_lookup_matches_dense_gather",
     "test_sharded_embedding.py::test_deepfm_trains_and_loss_decreases",
     "test_jit_save.py::TestJitSave::test_roundtrip_matches_eager",
@@ -141,7 +142,13 @@ def load_tool(name):
     spec = importlib.util.spec_from_file_location(f"_tool_{name}", path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[f"_tool_{name}"] = mod
-    spec.loader.exec_module(mod)
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        # never cache a half-initialized module: the next caller should
+        # see the real import error, not a random AttributeError
+        del sys.modules[f"_tool_{name}"]
+        raise
     return mod
 
 

@@ -4,57 +4,142 @@ inference/tests/api analyzer-latency flow (reference:
 paddle/fluid/inference/tests/api/analyzer_resnet50_tester.cc role).
 
     python tools/export_serving.py --model resnet50 --out /tmp/rn50_art
-    paddle_tpu/native/ptserve /tmp/rn50_art <libtpu.so> 8 50
+    paddle_tpu/native/ptserve /tmp/rn50_art <libtpu.so> 8 100
 
-Models: resnet50 (NHWC, 224px) and bert_base (seq 128). Exported in
-eval mode with the manifest's feed_shapes carrying a polymorphic batch
-dim, so ptserve can sweep batch sizes from one artifact.
+Models: resnet50 (NHWC, 224px), bert_base (seq 128), and mnist_mlp (the
+small artifact the CPU test loop round-trips). Exported in eval mode
+with the manifest's feed_shapes carrying a polymorphic batch dim, so
+ptserve can sweep batch sizes from one artifact.
+
+``--quantize``: post-training int8 quantization before export
+(mkldnn_quantizer.cc role, reference:
+paddle/fluid/inference/api/mkldnn_quantizer.cc): wrap Linear/Conv2D
+(quant.quantize_model), calibrate activation ranges on synthetic batches
+shaped like the example inputs (SMOKE calibration — deployments should
+calibrate on real data), freeze to int8, and swap in the int8 executors.
+Export quantized artifacts with ``--platform cpu``: the int8 matmuls
+then lower to portable XLA ops (the Pallas int8 GEMM is a runtime
+dispatch choice, not an artifact property — and its custom-partitioning
+wrapper cannot cross jax.export).
 """
 
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def export_resnet50(out: str):
+def _build_resnet50():
     import jax.numpy as jnp
     import numpy as np
 
     import paddle_tpu as pt
-    from paddle_tpu import jit
     from paddle_tpu.models import resnet
 
     pt.seed(0)
     model = resnet.resnet50(num_classes=1000, data_format="NHWC").eval()
     x = jnp.asarray(np.zeros((1, 3, 224, 224), np.float32))
-    jit.save(model, out, [x], input_names=["image"])
+    return model, [x], ["image"]
 
 
-def export_bert_base(out: str):
+def _build_bert_base():
     import jax.numpy as jnp
     import numpy as np
 
     import paddle_tpu as pt
-    from paddle_tpu import jit
     from paddle_tpu.models import bert as B
 
     pt.seed(0)
     model = B.BertModel(B.BertConfig.base()).eval()
     ids = jnp.asarray(np.zeros((1, 128), np.int32))
-    jit.save(model, out, [ids], input_names=["input_ids"])
+    return model, [ids], ["input_ids"]
 
 
-EXPORTS = {"resnet50": export_resnet50, "bert_base": export_bert_base}
+def _build_mnist_mlp():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import mnist as M
+
+    pt.seed(0)
+    model = M.MnistMLP(hidden1=512, hidden2=256).eval()
+    x = jnp.asarray(np.zeros((1, 784), np.float32))
+    return model, [x], ["x"]
+
+
+BUILDERS = {"resnet50": _build_resnet50, "bert_base": _build_bert_base,
+            "mnist_mlp": _build_mnist_mlp}
+
+
+def _synthetic_calib_batches(example_args, n_batches=4, batch=8, seed=0):
+    """Batches shaped like the example args, batch dim widened: float
+    inputs ~ N(0, 1), integer inputs uniform in a small id range."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        args = []
+        for a in example_args:
+            shape = (batch,) + tuple(a.shape[1:])
+            if jnp.issubdtype(a.dtype, jnp.integer):
+                args.append(jnp.asarray(
+                    rng.integers(0, 128, shape).astype(a.dtype)))
+            else:
+                args.append(jnp.asarray(
+                    rng.normal(size=shape).astype(a.dtype)))
+        out.append(tuple(args) if len(args) > 1 else args[0])
+    return out
+
+
+def ptq_int8(model, example_args, n_batches: int = 4, seed: int = 0):
+    """PTQ for serving export: quantize -> calibrate (synthetic) ->
+    freeze -> int8_swap. Returns the number of layers swapped (0 means
+    nothing in the model was quantizable — the caller should fail loudly
+    rather than ship a silently-float 'int8' artifact)."""
+    from paddle_tpu import quant
+
+    q = quant.quantize_model(model)
+    quant.calibrate(q, _synthetic_calib_batches(example_args,
+                                                n_batches=n_batches,
+                                                seed=seed))
+    frozen = quant.freeze(q)
+    return quant.int8_swap(q, frozen)
+
+
+def export(model_name: str, out: str, quantize: bool = False):
+    from paddle_tpu import jit
+
+    model, example_args, input_names = BUILDERS[model_name]()
+    if quantize:
+        swapped = ptq_int8(model, example_args)
+        if not swapped:
+            raise RuntimeError(
+                f"--quantize swapped 0 layers for {model_name}; refusing "
+                "to export a float artifact under an int8 label")
+        model.eval()
+    jit.save(model, out, example_args, input_names=input_names)
+    return model
+
+
+# back-compat alias, CALL-compatible with the old per-model export
+# functions: EXPORTS[name](out_dir) still produces the fp32 artifact
+EXPORTS = {name: functools.partial(export, name) for name in BUILDERS}
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", required=True, choices=sorted(EXPORTS))
+    ap.add_argument("--model", required=True, choices=sorted(BUILDERS))
     ap.add_argument("--out", required=True)
+    ap.add_argument("--quantize", action="store_true",
+                    help="post-training int8 before export (see module "
+                    "docstring; use with --platform cpu)")
     ap.add_argument("--platform", default=None,
                     help="cpu to export off-chip (artifact is portable)")
     args = ap.parse_args(argv)
@@ -62,8 +147,9 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-    EXPORTS[args.model](args.out)
-    print(f"exported {args.model} -> {args.out}")
+    export(args.model, args.out, quantize=args.quantize)
+    print(f"exported {args.model}{' int8' if args.quantize else ''} "
+          f"-> {args.out}")
     return 0
 
 
