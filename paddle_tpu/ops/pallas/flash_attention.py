@@ -812,21 +812,23 @@ def resolve_block_sizes(tq, tk, d, causal, block_q=None, block_k=None,
 def ring_fwd_block(q, k, v, kvm, qseg, kseg, *, causal, scale, block_q,
                    block_k, interpret):
     """One ring hop's flash forward: local q (B, Tq, H, D) against one
-    rotating K/V block (B, Tk, H, D). Returns (o, lse): o is the
-    block-normalized output and lse = m + log(l) its per-row logsumexp
-    ((B, H, Tq)) — exactly the pair the flash-decoding merge needs.
-    ``causal`` here means THIS block is the diagonal one (same global
-    offsets); strictly-past blocks are called with causal=False and
-    strictly-future ones are skipped by the caller."""
+    rotating K/V block (B, Tk, Hkv, D; Hkv | H — GQA blocks rotate with
+    their FEWER heads, the kernel's index map shares them across each
+    group). Returns (o, lse): o is the block-normalized output and
+    lse = m + log(l) its per-row logsumexp ((B, H, Tq)) — exactly the
+    pair the flash-decoding merge needs. ``causal`` here means THIS
+    block is the diagonal one (same global offsets); strictly-past
+    blocks are called with causal=False and strictly-future ones are
+    skipped by the caller."""
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, hkv = k.shape[1], k.shape[2]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
     kvm3 = None if kvm is None else kvm.astype(jnp.float32).reshape(b, 1, tk)
     qseg3 = None if qseg is None else qseg.astype(jnp.int32).reshape(b, tq, 1)
     kseg3 = None if kseg is None else kseg.astype(jnp.int32).reshape(b, 1, tk)
-    o, lse = _fwd_call(qf, kf, vf, kvm3, qseg3, kseg3, None, h, h,
+    o, lse = _fwd_call(qf, kf, vf, kvm3, qseg3, kseg3, None, h, hkv,
                        causal, None, scale, 0.0, block_q, block_k,
                        interpret)
     return (o.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
@@ -843,12 +845,13 @@ def ring_bwd_block(q, k, v, kvm, qseg, kseg, o, lse, do, *, causal,
     hop at a time. ``o``/``do``: final output / upstream cotangent
     (B, Tq, H, D); ``lse``: ring-merged (B, H, Tq); ``delta``: optional
     precomputed rowsum(do*o) ((B, Tq, H) — hop-invariant, so the ring
-    loop computes it once instead of n times)."""
+    loop computes it once instead of n times). Under GQA (k/v carry
+    Hkv < H heads) dk/dv come back group-summed onto the Hkv heads."""
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, hkv = k.shape[1], k.shape[2]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
     of = o.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     dof = do.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     lsef = lse.reshape(b * h, tq, 1)
@@ -857,12 +860,12 @@ def ring_bwd_block(q, k, v, kvm, qseg, kseg, o, lse, do, *, causal,
     kvm3 = None if kvm is None else kvm.astype(jnp.float32).reshape(b, 1, tk)
     qseg3 = None if qseg is None else qseg.astype(jnp.int32).reshape(b, tq, 1)
     kseg3 = None if kseg is None else kseg.astype(jnp.int32).reshape(b, 1, tk)
-    dq, dk, dv = _bwd_call(qf, kf, vf, kvm3, qseg3, kseg3, None, h, h,
+    dq, dk, dv = _bwd_call(qf, kf, vf, kvm3, qseg3, kseg3, None, h, hkv,
                            of, lsef, dof, causal, None, scale, 0.0,
                            block_q, block_k, interpret, delta=deltaf)
     return (dq.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
-            dk.reshape(b, h, tk, d).transpose(0, 2, 1, 3),
-            dv.reshape(b, h, tk, d).transpose(0, 2, 1, 3))
+            dk.reshape(b, hkv, tk, d).transpose(0, 2, 1, 3),
+            dv.reshape(b, hkv, tk, d).transpose(0, 2, 1, 3))
 
 
 def _attn_rule(has_mask, has_segs, has_seed, gqa, bwd):

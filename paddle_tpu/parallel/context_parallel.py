@@ -82,8 +82,20 @@ def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, qseg, ksegc, src,
     # with an f32 preferred_element_type run at the full MXU rate, while
     # a pre-cast to f32 would drop to the fp32 matmul rate (4-8x slower
     # on v5e) with no accumulator benefit
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc,
-                   preferred_element_type=jnp.float32) * scale
+    b, t, h, d = qf.shape
+    hkv = kc.shape[2]
+    if hkv != h:
+        # GQA: the K/V blocks rotate the ring with their FEWER heads
+        # (h/hkv x less ICI traffic and carry memory than expanding up
+        # front); the grouped einsum shares each kv head across its
+        # group, kv-major head order matching the kernel/xla paths
+        q5 = qf.reshape(b, t, hkv, h // hkv, d)
+        s = jnp.einsum("bqegd,bked->begqk", q5, kc,
+                       preferred_element_type=jnp.float32)
+        s = s.reshape(b, h, t, kc.shape[1]) * scale
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc,
+                       preferred_element_type=jnp.float32) * scale
     if causal or window is not None:
         rows = my_idx * t_local + lax.broadcasted_iota(
             jnp.int32, (t_local, t_local), 0)
@@ -113,8 +125,14 @@ def _ring_step_compute(qf, acc, m, l, kc, vc, kmc, qseg, ksegc, src,
         p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
     alpha = jnp.exp(m - m_new)
     l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
-                    preferred_element_type=jnp.float32)
+    if hkv != h:
+        p5 = p.astype(vc.dtype).reshape(b, hkv, h // hkv, t, kc.shape[1])
+        pv = jnp.einsum("begqk,bked->bqegd", p5, vc,
+                        preferred_element_type=jnp.float32)
+        pv = pv.reshape(b, t, h, d)
+    else:
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
+                        preferred_element_type=jnp.float32)
     acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv     # (b,t,h,d)
     return acc_new, m_new, l_new
 
@@ -220,8 +238,10 @@ def _ring_inner(q, k, v, km, seg, *, axis, causal, window, scale, n):
 # blocks entirely (lax.cond) and use the causal kernel variant only on
 # the diagonal block, keeping the O(T^2/2) ring schedule.
 #
-# Gated to kv_mask/segment_ids/causal (no window/GQA/dropout — those
-# stay on the einsum path or don't apply); dispatch in ring_attention.
+# Handles kv_mask/segment_ids/causal AND GQA (kv blocks rotate with
+# their fewer heads; the kernel shares them per group). Windowed runs
+# stay on the einsum inner; dropout doesn't apply under SP. Dispatch in
+# ring_attention.
 
 
 @functools.partial(
@@ -365,8 +385,9 @@ def _ring_flash_bwd(axis, causal, scale, n, block_q, block_k,
         return (dq, kc, vc, kmc, ksegc, dkc, dvc), None
 
     dq0 = jnp.zeros((b, t, h, d), jnp.float32)
-    dk0 = jnp.zeros((b, t, h, d), jnp.float32)
-    dv0 = jnp.zeros((b, t, h, d), jnp.float32)
+    # GQA: accumulators match the (possibly fewer-headed) K/V blocks
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
     km0 = km if has_mask else jnp.zeros((b, t), jnp.bool_)
     seg0 = seg if has_segs else jnp.zeros((b, t), jnp.int32)
     (dq, kc, vc, kmc, ksegc, dkc, dvc), _ = lax.scan(
@@ -414,13 +435,24 @@ def ring_attention(q, k, v, *, causal: bool = False,
     when the per-shard block shape is kernel-eligible; windowed runs and
     ineligible shapes keep the einsum inner. Same gating semantics as
     scaled_dot_product_attention's use_flash.
+
+    GQA/MQA (r5): ``k``/``v`` may carry fewer heads than ``q``
+    (``h % kv_heads == 0``) — on the flash path the smaller blocks
+    rotate as-is and the kernel shares them per group (dk/dv come home
+    group-summed); the einsum fallback expands them kv-major up front.
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
     b, t, h, d = q.shape
+    hkv = k.shape[2]
     enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
-    enforce(k.shape == q.shape and v.shape == q.shape,
-            "ring attention is self-attention shaped: q/k/v must match")
+    enforce(k.shape == v.shape and k.shape[0] == b and k.shape[1] == t
+            and k.shape[3] == d,
+            "ring attention is self-attention shaped: k/v must be "
+            "(%s, %s, kv_heads, %s), got k=%s v=%s", b, t, d, k.shape,
+            v.shape)
+    enforce(h % hkv == 0,
+            "q heads %s must be a multiple of kv heads %s (GQA)", h, hkv)
     for name, arr in (("kv_mask", kv_mask), ("segment_ids", segment_ids)):
         if arr is not None:
             enforce(arr.shape == (b, t),
@@ -446,6 +478,8 @@ def ring_attention(q, k, v, *, causal: bool = False,
             scale=float(scale), n=n, blocks=blocks,
             interpret=_use_interpret())
     else:
+        # the einsum inner handles GQA natively (grouped score einsum in
+        # _ring_step_compute): kv blocks rotate with their fewer heads
         inner = functools.partial(_ring_inner, axis=axis, causal=causal,
                                   window=window, scale=float(scale), n=n)
     return _shard_with_optional(inner, mesh, spec, mspec, q, k, v,

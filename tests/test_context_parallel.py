@@ -621,3 +621,83 @@ class TestRingFlash:
             np.asarray(jax.tree_util.tree_leaves(out)[0]),
             np.asarray(jax.tree_util.tree_leaves(ref)[0]),
             atol=3e-4, rtol=3e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gqa_forward_and_grads(self, sp_mesh, causal, monkeypatch):
+        """GQA ring (r5): kv blocks rotate with their FEWER heads; the
+        kernel shares them per group; dk/dv come home group-summed.
+        Oracle: xla_attention's kv-major head expansion."""
+        from paddle_tpu.ops.attention import force_flash
+
+        calls = _count_ring_fwd_blocks(monkeypatch)
+        rng = np.random.default_rng(21)
+        q = jnp.asarray(rng.normal(size=(FB, FT, 4, FD))
+                        .astype(np.float32) * 0.3)
+        mk_kv = lambda: jnp.asarray(rng.normal(size=(FB, FT, 2, FD))
+                                    .astype(np.float32) * 0.3)
+        k, v = mk_kv(), mk_kv()
+        ct = jnp.asarray(rng.normal(size=(FB, FT, 4, FD))
+                         .astype(np.float32))
+
+        def loss_ring(q, k, v):
+            o = ring_attention(q, k, v, causal=causal, mesh=sp_mesh)
+            return jnp.sum(o * ct)
+
+        def loss_full(q, k, v):
+            o = xla_attention(q, k, v, causal=causal)
+            return jnp.sum(o * ct)
+
+        with force_flash():
+            got = ring_attention(q, k, v, causal=causal, mesh=sp_mesh)
+            g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        assert calls["n"] > 0, "GQA ring did not take the flash path"
+        want = xla_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+        g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for gr, gf, name in zip(g_ring, g_full, "qkv"):
+            assert gr.shape == gf.shape, name  # dk/dv keep kv-head count
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
+
+    @pytest.mark.parametrize("window", [None, 32])
+    def test_gqa_einsum_fallback_matches(self, sp_mesh, window,
+                                         monkeypatch):
+        """With the kernel gated off (or a window forcing the fallback),
+        GQA rides the einsum inner via the GROUPED score einsum — kv
+        blocks keep their fewer heads through the ring; same numbers,
+        no kernel calls."""
+        calls = _count_ring_fwd_blocks(monkeypatch)
+        rng = np.random.default_rng(22)
+        q = jnp.asarray(rng.normal(size=(FB, FT, 4, FD))
+                        .astype(np.float32) * 0.3)
+        mk_kv = lambda: jnp.asarray(rng.normal(size=(FB, FT, 2, FD))
+                                    .astype(np.float32) * 0.3)
+        k, v = mk_kv(), mk_kv()
+        got = ring_attention(q, k, v, causal=True, mesh=sp_mesh,
+                             use_flash=False, window=window)
+        assert calls["n"] == 0
+        want = xla_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gqa_through_mha_layer(self, sp_mesh):
+        """The layer surface: MultiHeadAttention(num_kv_heads < heads,
+        seq_parallel='ring') runs and matches its own non-SP path."""
+        import paddle_tpu.nn as nn
+
+        pt.seed(31)
+        mha = nn.MultiHeadAttention(64, 4, num_kv_heads=2,
+                                    seq_parallel="ring").eval()
+        x = jnp.asarray(np.random.default_rng(32).normal(
+            size=(2, 64, 64)).astype(np.float32))
+        got = mha(x, causal=True)
+        mha.seq_parallel = None
+        want = mha(x, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+        # ulysses stays explicitly gated for GQA
+        with pytest.raises(Exception, match="ulysses"):
+            nn.MultiHeadAttention(64, 4, num_kv_heads=2,
+                                  seq_parallel="ulysses")
