@@ -495,14 +495,10 @@ class MultiHeadAttention(_MHADecodeMixin, Layer):
         self.use_flash = use_flash
         # None | "ring" | "ulysses": shard attention over the 'sp' mesh axis
         self.seq_parallel = seq_parallel
-        # ring SP supports GQA (r5): kv blocks rotate with their fewer
-        # heads through the flash kernel (einsum fallback expands them).
-        # Ulysses still needs heads % sp on BOTH q and kv sides; keep it
-        # gated rather than silently replicate kv heads
-        enforce(seq_parallel in (None, "ring")
-                or self.num_kv_heads == num_heads,
-                "seq_parallel='ulysses' does not support GQA "
-                "(num_kv_heads < num_heads); use seq_parallel='ring'")
+        # GQA under SP (r5): ring rotates kv blocks with their fewer
+        # heads; Ulysses shards whole groups and enforces
+        # kv_heads % sp == 0 at CALL time (the mesh isn't known here) —
+        # its typed error points at ring for kv_heads < sp
         kv_dim = self.num_kv_heads * self.head_dim
         self.q_proj = Linear(embed_dim, embed_dim, bias_attr=bias)
         self.k_proj = Linear(embed_dim, kv_dim, bias_attr=bias)

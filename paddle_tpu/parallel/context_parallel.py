@@ -526,12 +526,25 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
     ``kv_mask``: optional global (B, T) keep-mask; all-gathered over sp
     for the full-sequence local attention (key-padding routes to the
     flash kernel's kv_mask path on TPU). ``segment_ids``: optional global
-    (B, T) packed-batch ids, same gather (self-attention only)."""
+    (B, T) packed-batch ids, same gather (self-attention only).
+
+    GQA/MQA (r5): supported when ``kv_heads % sp == 0`` — q's kv-major
+    head order means each head shard then holds WHOLE groups, so the k/v
+    all-to-alls split their own (fewer) heads and the local attention
+    stays a valid GQA problem. Fewer kv heads than sp can't shard this
+    way; use ``ring`` there."""
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
     b, t, h, d = q.shape
+    hkv = k.shape[2]
     enforce(t % n == 0, "seq len %s must divide sp size %s", t, n)
     enforce(h % n == 0, "num heads %s must divide sp size %s (Ulysses)", h, n)
+    enforce(h % hkv == 0,
+            "q heads %s must be a multiple of kv heads %s (GQA)", h, hkv)
+    enforce(hkv % n == 0,
+            "kv heads %s must divide sp size %s (Ulysses GQA shards "
+            "whole groups per device; use seq_parallel='ring' for "
+            "kv_heads < sp)", hkv, n)
     if kv_mask is not None:
         # key-padding masks cover the KEY sequence: cross-attention under
         # Ulysses has tk != tq and the mask belongs to k/v, not q

@@ -697,7 +697,48 @@ class TestRingFlash:
         want = mha(x, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
-        # ulysses stays explicitly gated for GQA
-        with pytest.raises(Exception, match="ulysses"):
-            nn.MultiHeadAttention(64, 4, num_kv_heads=2,
-                                  seq_parallel="ulysses")
+        # ulysses with kv_heads < sp fails typed at call time, pointing
+        # at ring
+        mha_u = nn.MultiHeadAttention(64, 4, num_kv_heads=2,
+                                      seq_parallel="ulysses").eval()
+        with pytest.raises(Exception, match="ring"):
+            mha_u(x, causal=True)
+
+
+def test_ulysses_gqa_matches_oracle(sp_mesh):
+    """Ulysses GQA (kv_heads % sp == 0): k/v all-to-all their own fewer
+    heads, each shard holds whole groups — matches the XLA GQA oracle,
+    forward and grads."""
+    rng = np.random.default_rng(41)
+    q = jnp.asarray(rng.normal(size=(2, 64, 8, 16)).astype(np.float32))
+    mk_kv = lambda: jnp.asarray(rng.normal(size=(2, 64, 4, 16))
+                                .astype(np.float32))
+    k, v = mk_kv(), mk_kv()
+    got = ulysses_attention(q, k, v, causal=True, mesh=sp_mesh)
+    want = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    ct = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * ct)
+
+    ul = lambda q, k, v: ulysses_attention(q, k, v, causal=True,
+                                           mesh=sp_mesh)
+    fu = lambda q, k, v: xla_attention(q, k, v, causal=True)
+    g_u = jax.grad(loss(ul), argnums=(0, 1, 2))(q, k, v)
+    g_f = jax.grad(loss(fu), argnums=(0, 1, 2))(q, k, v)
+    for gu, gf, name in zip(g_u, g_f, "qkv"):
+        assert gu.shape == gf.shape, name
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gf),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_ulysses_gqa_rejects_too_few_kv_heads(sp_mesh):
+    rng = np.random.default_rng(42)
+    q = jnp.asarray(rng.normal(size=(2, 64, 8, 16)).astype(np.float32))
+    kv = jnp.asarray(rng.normal(size=(2, 64, 2, 16)).astype(np.float32))
+    with pytest.raises(Exception, match="ring"):
+        ulysses_attention(q, kv, kv, mesh=sp_mesh)  # hkv=2 < sp=4
