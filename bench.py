@@ -368,6 +368,38 @@ def bench_bert_base(steps: int, batch_size: int, amp=None,
                         amp=amp)
 
 
+def bench_gpt(steps: int, batch_size: int, smoke: bool = False,
+              amp=None, seq_len: int = 1024):
+    """Decoder-only causal LM (models/gpt.py — RoPE + GQA 12q/4kv +
+    SwiGLU, head_dim 64 so the causal flash kernel engages, fused
+    linear-CE head): the modern long-context training workload the
+    reference era lacks. Next-token loss over random ids; remat per
+    block keeps seq 1024 activations in HBM."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import gpt as G
+
+    pt.seed(0)
+    batch_size = _cap(batch_size, 2 if smoke else 8)
+    cfg = G.GPTConfig.small()
+    if smoke:
+        cfg.vocab_size, cfg.num_layers = 1024, 2
+        seq_len = min(seq_len, 128)
+    cfg.max_position = seq_len
+    cfg.remat = True
+    model = G.GPTForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        ids = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (bs, seq_len)))
+        return (ids,)
+
+    return _train_bench(model, lambda out, batch: out, make_batch,
+                        steps, batch_size, amp=amp,
+                        method="forward_loss", infer_batch=make_batch)
+
+
 def bench_bert_moe(steps: int, batch_size: int, amp=None,
                    experts: int = 8):
     """Switch-MoE BERT (green-field config — the reference has no MoE):
@@ -836,6 +868,7 @@ MODELS = {
     "bert_base": bench_bert_base,
     "bert_packed": bench_bert_packed,
     "bert_moe": bench_bert_moe,
+    "gpt": bench_gpt,
     "bert_long": bench_bert_long,
     "transformer_nmt": bench_transformer_nmt,
     "nmt_decode": bench_nmt_decode,

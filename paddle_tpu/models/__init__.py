@@ -1,9 +1,10 @@
 """Model zoo — the reference's benchmark/book models rebuilt TPU-first
 (reference: benchmark/fluid/models/, tests/book/)."""
 
-from . import (alexnet, bert, deepfm, googlenet, mnist, recommender,
-               resnet, se_resnext, stacked_lstm, transformer, vgg)
+from . import (alexnet, bert, deepfm, googlenet, gpt, mnist,
+               recommender, resnet, se_resnext, stacked_lstm,
+               transformer, vgg)
 
-__all__ = ["alexnet", "bert", "deepfm", "googlenet", "mnist",
+__all__ = ["alexnet", "bert", "deepfm", "googlenet", "gpt", "mnist",
            "recommender", "resnet", "se_resnext", "stacked_lstm",
            "transformer", "vgg"]

@@ -1,0 +1,249 @@
+"""Decoder-only causal LM (GPT/Llama-style) — the modern long-context
+flagship workload, assembled from this framework's own pieces: RoPE
+(ops.attention.rotary_embedding), GQA MultiHeadAttention on the Pallas
+flash path, RMSNorm pre-norm blocks, SwiGLU (or Switch-MoE) FFNs,
+KV-cached greedy decode, and a fused linear-CE training head.
+
+Green-field relative to the reference (its transformer story is the
+encoder-decoder NMT model, reference:
+benchmark/fluid/models/machine_translation.py); this family exists so a
+user scaling a decoder LM finds the whole recipe — causal flash
+attention, sequence parallelism (seq_parallel='ring' supports GQA),
+pipeline-able uniform blocks, MoE FFNs — in one model.
+
+Geometry notes (TPU-first): head_dim 64/128 keeps the flash dispatch
+gate open; hidden sizes stay multiples of 128 for MXU tiling; the block
+is uniform h -> h so parallel.pipeline_apply and scan_layers both apply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializer as I
+from .. import nn
+from ..core.enforce import enforce
+from ..nn.layer import Layer
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None   # < num_heads = GQA/MQA
+    intermediate_size: int = 2048        # SwiGLU width
+    max_position: int = 2048             # decode-cache capacity default
+    rope_theta: float = 10000.0
+    dropout: float = 0.0                 # residual/FFN dropout
+    use_flash: bool = True
+    remat: bool = False                  # jax.checkpoint per block
+    # None | 'ring' | 'ulysses' — shard attention over the 'sp' axis
+    # (ring supports GQA; see parallel.context_parallel)
+    seq_parallel: Optional[str] = None
+    attn_window: Optional[int] = None    # sliding-window local attention
+    moe_experts: int = 0                 # > 0: Switch-MoE FFN over 'ep'
+    moe_capacity_factor: float = 1.25
+    tie_embeddings: bool = True          # LM head = embedding^T
+
+    @classmethod
+    def tiny(cls):
+        """For tests: 2 layers, hidden 128, GQA 4q/2kv, head_dim 32."""
+        return cls(vocab_size=512, hidden_size=128, num_layers=2,
+                   num_heads=4, num_kv_heads=2, intermediate_size=256,
+                   max_position=128)
+
+    @classmethod
+    def small(cls):
+        """A llama-ish small config: head_dim 64 (flash-eligible)."""
+        return cls(vocab_size=32000, hidden_size=768, num_layers=12,
+                   num_heads=12, num_kv_heads=4, intermediate_size=2048,
+                   max_position=2048)
+
+
+class _SwiGLU(Layer):
+    """Gated FFN: down(silu(gate(x)) * up(x)) — the Llama MLP."""
+
+    def __init__(self, d_model: int, d_ff: int, dropout: float = 0.0):
+        super().__init__()
+        self.gate = nn.Linear(d_model, d_ff, bias_attr=False)
+        self.up = nn.Linear(d_model, d_ff, bias_attr=False)
+        self.down = nn.Linear(d_ff, d_model, bias_attr=False)
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.drop(self.down(jax.nn.silu(self.gate(x)) * self.up(x)))
+
+
+class GPTBlock(Layer):
+    """Pre-norm decoder block: x + attn(rms(x)); x + ffn(rms(x)).
+    Uniform h -> h (pipeline_apply / scan_layers compatible)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.attn_window = cfg.attn_window
+        self.norm1 = nn.RMSNorm(cfg.hidden_size)
+        self.self_attn = nn.MultiHeadAttention(
+            cfg.hidden_size, cfg.num_heads, dropout=cfg.dropout,
+            bias=False, use_flash=cfg.use_flash,
+            seq_parallel=cfg.seq_parallel,
+            num_kv_heads=cfg.num_kv_heads or cfg.num_heads,
+            rotary=True, rotary_theta=cfg.rope_theta)
+        self.norm2 = nn.RMSNorm(cfg.hidden_size)
+        if cfg.moe_experts:
+            self.ffn = nn.SwitchFFN(
+                cfg.hidden_size, cfg.intermediate_size, cfg.moe_experts,
+                capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.ffn = _SwiGLU(cfg.hidden_size, cfg.intermediate_size,
+                               cfg.dropout)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, kv_mask=None):
+        x = x + self.drop(self.self_attn(
+            self.norm1(x), causal=True, window=self.attn_window,
+            attn_mask=None if kv_mask is None
+            else kv_mask[:, None, None, :]))
+        return x + self.ffn(self.norm2(x))
+
+
+class GPTForCausalLM(Layer):
+    """Token embedding -> N GPTBlocks -> final RMSNorm -> LM head.
+
+    ``forward(ids)`` returns (B, T, V) logits (tied head when
+    cfg.tie_embeddings). ``forward_loss(ids, labels)`` is the training
+    entry: next-token shift + fused chunked linear-CE (the logits
+    matrix never materializes; ops/fused_loss.py). ``greedy_decode``
+    runs the KV-cached incremental loop (RoPE applied at each cache
+    position — MultiHeadAttention.forward_step).
+    """
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        enforce((cfg.hidden_size // cfg.num_heads) % 2 == 0,
+                "rotary needs an even head_dim, got %s",
+                cfg.hidden_size // cfg.num_heads)
+        self.cfg = cfg
+        self.embed = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm_f = nn.RMSNorm(cfg.hidden_size)
+        if not cfg.tie_embeddings:
+            self.create_parameter(
+                "lm_head", (cfg.hidden_size, cfg.vocab_size), None,
+                I.XavierUniform())
+
+    def _head_weight(self):
+        return (self.embed.weight.T if self.cfg.tie_embeddings
+                else self.lm_head)
+
+    def _trunk(self, ids, kv_mask=None):
+        x = self.embed(ids)
+        for blk in self.blocks:
+            if self.cfg.remat:
+                x = jax.checkpoint(
+                    lambda h, b=blk: b(h, kv_mask=kv_mask))(x)
+            else:
+                x = blk(x, kv_mask=kv_mask)
+        return self.norm_f(x)
+
+    def forward(self, ids, kv_mask=None):
+        h = self._trunk(ids, kv_mask=kv_mask)
+        return h @ self._head_weight()
+
+    def forward_loss(self, ids, labels=None, kv_mask=None,
+                     vocab_chunk: int = 1024, ignore_index: int = -100):
+        """Mean next-token CE. ``labels`` default to ids shifted left
+        (standard causal-LM training); pass explicit labels with
+        ``ignore_index`` holes for masked/padded positions."""
+        from ..ops.fused_loss import mean_linear_cross_entropy
+
+        h = self._trunk(ids, kv_mask=kv_mask)
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:],
+                 jnp.full((ids.shape[0], 1), ignore_index, ids.dtype)],
+                axis=1)
+        b, t, d = h.shape
+        w = self._head_weight()
+        return mean_linear_cross_entropy(
+            h.reshape(b * t, d), w, None, labels.reshape(-1),
+            chunk=vocab_chunk, ignore_index=ignore_index)
+
+    def greedy_decode(self, prompt_ids, max_len: int,
+                      capacity: Optional[int] = None):
+        """KV-cached greedy continuation of ``prompt_ids`` (B, Tp) to
+        total length ``max_len``. Returns (B, max_len) token ids.
+        O(T) per step via per-block K/V caches; RoPE rotates each
+        cached K at its absolute position and each query at its own."""
+        from jax import lax
+
+        enforce(not self.training,
+                "greedy_decode runs in eval mode (call .eval()); live "
+                "dropout would break the token-identical-to-forward "
+                "contract")
+        b, tp = prompt_ids.shape
+        cap = capacity or max(self.cfg.max_position, max_len)
+        enforce(max_len > tp, "max_len %s must exceed prompt %s",
+                max_len, tp)
+        enforce(cap >= max_len, "cache capacity %s < max_len %s", cap,
+                max_len)
+        caches = [blk.self_attn.init_cache(b, cap)
+                  for blk in self.blocks]
+
+        def one_pos(carry, t):
+            tok, caches = carry
+            x = self.embed(tok[:, None])          # (B, 1, D)
+            new_caches = []
+            for blk, (ck, cv) in zip(self.blocks, caches):
+                h = blk.norm1(x)
+                a, ck, cv = blk.self_attn.forward_step(
+                    h, ck, cv, t, window=self.cfg.attn_window)
+                x = x + a
+                x = x + blk.ffn(blk.norm2(x))
+                new_caches.append((ck, cv))
+            logits = self.norm_f(x)[:, 0] @ self._head_weight()
+            nxt = jnp.argmax(logits, axis=-1).astype(prompt_ids.dtype)
+            return (nxt, new_caches), nxt
+
+        # prefill: teacher-force the prompt through the step loop (the
+        # scan keeps ONE compiled block body for prefill + generation)
+        tokens = jnp.concatenate(
+            [prompt_ids,
+             jnp.zeros((b, max_len - tp), prompt_ids.dtype)], axis=1)
+
+        def scan_step(carry, t):
+            tok_prev, caches = carry
+            (nxt, caches), _ = one_pos((tok_prev, caches), t)
+            # while still inside the prompt, feed the real next token
+            inside = t + 1 < tp
+            forced = lax.dynamic_index_in_dim(
+                tokens, jnp.clip(t + 1, 0, max_len - 1), 1,
+                keepdims=False)
+            tok = jnp.where(inside, forced, nxt)
+            return (tok, caches), tok
+
+        (_, _), outs = lax.scan(
+            scan_step, (tokens[:, 0], caches),
+            jnp.arange(max_len - 1))
+        outs = jnp.swapaxes(outs, 0, 1)           # (B, max_len - 1)
+        return jnp.concatenate([tokens[:, :1], outs], axis=1)
+
+
+def loss_fn(logits, labels, ignore_index: int = -100):
+    """Plain (unfused) next-token CE over (B, T, V) logits — the test
+    oracle for forward_loss."""
+    b, t, v = logits.shape
+    flat = logits.reshape(b * t, v).astype(jnp.float32)
+    lbl = labels.reshape(-1)
+    keep = lbl != ignore_index
+    lp = jax.nn.log_softmax(flat)
+    picked = jnp.take_along_axis(
+        lp, jnp.clip(lbl, 0, v - 1)[:, None], axis=1)[:, 0]
+    return -jnp.sum(jnp.where(keep, picked, 0.0)) / jnp.maximum(
+        jnp.sum(keep), 1)

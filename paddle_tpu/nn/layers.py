@@ -434,13 +434,20 @@ class _MHADecodeMixin:
                                        self.head_dim)
         return k, v
 
-    def attend_kv(self, query, k, v, attn_mask=None):
-        """Attention of ``query`` (B, Tq, D) against PRE-PROJECTED k/v."""
-        from ..ops.attention import scaled_dot_product_attention
+    def attend_kv(self, query, k, v, attn_mask=None, q_positions=None):
+        """Attention of ``query`` (B, Tq, D) against PRE-PROJECTED k/v.
+        ``q_positions``: absolute positions for rotary queries (the
+        cached K was rotated at write time — the RoPE cache
+        convention)."""
+        from ..ops.attention import (rotary_embedding,
+                                     scaled_dot_product_attention)
 
         b, tq, d = query.shape
         q = self.q_proj(query).reshape(b, tq, self.num_heads,
                                        self.head_dim)
+        if q_positions is not None:
+            q = rotary_embedding(q, q_positions,
+                                 theta=self.rotary_theta)
         out = scaled_dot_product_attention(
             q, k, v, mask=attn_mask, use_flash=self.use_flash)
         return self.out_proj(out.reshape(b, tq, d))
@@ -458,6 +465,11 @@ class _MHADecodeMixin:
                                        self.head_dim)
         v_t = self.v_proj(x_t).reshape(b, 1, self.num_kv_heads,
                                        self.head_dim)
+        if self.rotary:
+            from ..ops.attention import rotary_embedding
+
+            pos_t = jnp.full((1,), t, jnp.int32)
+            k_t = rotary_embedding(k_t, pos_t, theta=self.rotary_theta)
         cache_k = lax.dynamic_update_slice_in_dim(
             cache_k, k_t.astype(cache_k.dtype), t, axis=1)
         cache_v = lax.dynamic_update_slice_in_dim(
@@ -467,7 +479,10 @@ class _MHADecodeMixin:
         if window is not None:
             keep &= pos > t - window
         mask = jnp.broadcast_to(keep, (b, cap))[:, None, None, :]
-        out = self.attend_kv(x_t, cache_k, cache_v, attn_mask=mask)
+        out = self.attend_kv(
+            x_t, cache_k, cache_v, attn_mask=mask,
+            q_positions=(jnp.full((1,), t, jnp.int32) if self.rotary
+                         else None))
         return out, cache_k, cache_v
 
 
@@ -479,10 +494,16 @@ class MultiHeadAttention(_MHADecodeMixin, Layer):
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  bias: bool = True, use_flash: bool = True,
                  seq_parallel: Optional[str] = None, dtype=None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 rotary: bool = False, rotary_theta: float = 10000.0):
         super().__init__()
         enforce(embed_dim % num_heads == 0,
                 "embed_dim %s not divisible by heads %s", embed_dim, num_heads)
+        # RoPE on q/k after projection (self-attention decoder blocks);
+        # applied on the GLOBAL arrays before any SP sharding, so ring/
+        # Ulysses see position-correct rotations
+        self.rotary = rotary
+        self.rotary_theta = float(rotary_theta)
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
         # GQA/MQA: fewer K/V heads than Q heads (the flash kernel reads
@@ -515,6 +536,14 @@ class MultiHeadAttention(_MHADecodeMixin, Layer):
         h, hd = self.num_heads, self.head_dim
         q = self.q_proj(query).reshape(b, tq, h, hd)
         k, v = self.project_kv(key, value)
+        if self.rotary:
+            from ..ops.attention import rotary_embedding
+
+            enforce(tk == tq, "rotary MHA is self-attention shaped "
+                    "(tq=%s != tk=%s)", tq, tk)
+            pos = jnp.arange(tq)
+            q = rotary_embedding(q, pos, theta=self.rotary_theta)
+            k = rotary_embedding(k, pos, theta=self.rotary_theta)
 
         if self.seq_parallel is not None:
             # key-padding masks ((B, Tk) or (B, 1, 1, Tk)) ride the SP

@@ -178,6 +178,34 @@ class force_flash:
         return False
 
 
+def rotary_embedding(x, positions, theta: float = 10000.0):
+    """Rotary position embedding (RoPE) over (B, T, H, D) with even D.
+
+    ``positions``: (T,) or (B, T) integer absolute positions — decode
+    passes the cache index, sequence-parallel callers pass GLOBAL
+    positions (rotation happens on the pre-shard arrays, so sharded
+    attention sees position-correct q/k). Rotate-half convention
+    (GPT-NeoX/Llama): pairs are (x[..., i], x[..., i + D/2]).
+
+    Green-field (the reference era predates RoPE; its positional story
+    is learned position tables, reference:
+    python/paddle/fluid/layers/nn.py position_encoding role).
+    """
+    d = x.shape[-1]
+    enforce(d % 2 == 0, "rotary needs an even head_dim, got %s", d)
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
+    # insert the head axis before the feature axis; (T, half) inputs
+    # broadcast over batch AND heads, (B, T, half) over heads only
+    cos = jnp.cos(ang)[..., None, :]
+    sin = jnp.sin(ang)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 def _flash_ok(q, k, causal: bool = False, window=None) -> bool:
     """Flash kernel constraints for (B, T, H, D) operands — see
     flash_shape_ok for the actual gate."""

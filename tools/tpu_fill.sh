@@ -164,6 +164,9 @@ item bench_googlenet   1200 python bench.py --model googlenet
 # Switch-MoE BERT (r4 green-field config; dense dispatch einsums on one
 # chip — the ep-sharded story is the virtual-mesh golden-HLO test)
 item bench_bert_moe    1500 python bench.py --model bert_moe
+# decoder-only causal LM (r5 model family): RoPE+GQA+SwiGLU, seq 1024,
+# causal flash path — the modern long-context MFU row
+item bench_gpt         1800 python bench.py --model gpt
 item tune_a128f        900  python tools/pallas_tune.py --attention 32,128,12,64
 item tune_a128c        900  python tools/pallas_tune.py --attention 32,128,12,64 --causal
 item tune_a512f        900  python tools/pallas_tune.py --attention 8,512,12,64
