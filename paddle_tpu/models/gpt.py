@@ -175,16 +175,46 @@ class GPTForCausalLM(Layer):
             h.reshape(b * t, d), w, None, labels.reshape(-1),
             chunk=vocab_chunk, ignore_index=ignore_index)
 
-    def greedy_decode(self, prompt_ids, max_len: int,
-                      capacity: Optional[int] = None):
-        """KV-cached greedy continuation of ``prompt_ids`` (B, Tp) to
-        total length ``max_len``. Returns (B, max_len) token ids.
+    def _step_logits(self, tok, caches, t):
+        """One KV-cached position: embed ``tok`` (B,), run every block's
+        forward_step at cache index ``t``, return ((B, V) logits, new
+        caches)."""
+        x = self.embed(tok[:, None])              # (B, 1, D)
+        new_caches = []
+        for blk, (ck, cv) in zip(self.blocks, caches):
+            h = blk.norm1(x)
+            a, ck, cv = blk.self_attn.forward_step(
+                h, ck, cv, t, window=self.cfg.attn_window)
+            x = x + a
+            x = x + blk.ffn(blk.norm2(x))
+            new_caches.append((ck, cv))
+        return self.norm_f(x)[:, 0] @ self._head_weight(), new_caches
+
+    def generate(self, prompt_ids, max_len: int, *, key=None,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_id: Optional[int] = None,
+                 capacity: Optional[int] = None):
+        """KV-cached continuation of ``prompt_ids`` (B, Tp) to total
+        length ``max_len``; returns (B, max_len) token ids.
+
+        ``temperature == 0`` is exact greedy (argmax, no key needed);
+        otherwise tokens are drawn via ops.sampling.sample_from_logits
+        (temperature scaling, then top-k, then nucleus top-p), with a
+        per-position key derived by ``fold_in`` so the draw stream is
+        independent of batch size and prompt length. ``eos_id`` freezes
+        a row once it emits eos (every later token is eos_id).
+
         O(T) per step via per-block K/V caches; RoPE rotates each
-        cached K at its absolute position and each query at its own."""
+        cached K at its absolute position and each query at its own.
+        Green-field vs the reference (its decoding story is beam search
+        over the NMT encoder-decoder, reference:
+        benchmark/fluid/models/machine_translation.py)."""
         from jax import lax
 
+        from ..ops.sampling import sample_from_logits
+
         enforce(not self.training,
-                "greedy_decode runs in eval mode (call .eval()); live "
+                "generate runs in eval mode (call .eval()); live "
                 "dropout would break the token-identical-to-forward "
                 "contract")
         b, tp = prompt_ids.shape
@@ -193,23 +223,13 @@ class GPTForCausalLM(Layer):
                 max_len, tp)
         enforce(cap >= max_len, "cache capacity %s < max_len %s", cap,
                 max_len)
+        sampled = float(temperature) != 0.0
+        if sampled:
+            enforce(key is not None,
+                    "temperature > 0 samples and needs a PRNG key; "
+                    "pass temperature=0 for greedy decoding")
         caches = [blk.self_attn.init_cache(b, cap)
                   for blk in self.blocks]
-
-        def one_pos(carry, t):
-            tok, caches = carry
-            x = self.embed(tok[:, None])          # (B, 1, D)
-            new_caches = []
-            for blk, (ck, cv) in zip(self.blocks, caches):
-                h = blk.norm1(x)
-                a, ck, cv = blk.self_attn.forward_step(
-                    h, ck, cv, t, window=self.cfg.attn_window)
-                x = x + a
-                x = x + blk.ffn(blk.norm2(x))
-                new_caches.append((ck, cv))
-            logits = self.norm_f(x)[:, 0] @ self._head_weight()
-            nxt = jnp.argmax(logits, axis=-1).astype(prompt_ids.dtype)
-            return (nxt, new_caches), nxt
 
         # prefill: teacher-force the prompt through the step loop (the
         # scan keeps ONE compiled block body for prefill + generation)
@@ -218,21 +238,40 @@ class GPTForCausalLM(Layer):
              jnp.zeros((b, max_len - tp), prompt_ids.dtype)], axis=1)
 
         def scan_step(carry, t):
-            tok_prev, caches = carry
-            (nxt, caches), _ = one_pos((tok_prev, caches), t)
+            tok_prev, caches, done = carry
+            logits, caches = self._step_logits(tok_prev, caches, t)
+            if sampled:
+                nxt = sample_from_logits(
+                    logits, jax.random.fold_in(key, t), temperature,
+                    top_k, top_p)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            nxt = nxt.astype(prompt_ids.dtype)
+            if eos_id is not None:
+                nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype),
+                                nxt)
             # while still inside the prompt, feed the real next token
             inside = t + 1 < tp
             forced = lax.dynamic_index_in_dim(
                 tokens, jnp.clip(t + 1, 0, max_len - 1), 1,
                 keepdims=False)
             tok = jnp.where(inside, forced, nxt)
-            return (tok, caches), tok
+            if eos_id is not None:
+                done = done | ((tok == eos_id) & jnp.logical_not(inside))
+            return (tok, caches, done), tok
 
-        (_, _), outs = lax.scan(
-            scan_step, (tokens[:, 0], caches),
+        (_, _, _), outs = lax.scan(
+            scan_step,
+            (tokens[:, 0], caches, jnp.zeros((b,), bool)),
             jnp.arange(max_len - 1))
         outs = jnp.swapaxes(outs, 0, 1)           # (B, max_len - 1)
         return jnp.concatenate([tokens[:, :1], outs], axis=1)
+
+    def greedy_decode(self, prompt_ids, max_len: int,
+                      capacity: Optional[int] = None):
+        """KV-cached greedy continuation — generate(temperature=0)."""
+        return self.generate(prompt_ids, max_len, temperature=0.0,
+                             capacity=capacity)
 
 
 def loss_fn(logits, labels, ignore_index: int = -100):

@@ -461,6 +461,9 @@ class _MHADecodeMixin:
 
         b = x_t.shape[0]
         cap = cache_k.shape[1]
+        # one positions array shared by the k rotation here and the q
+        # rotation inside attend_kv — they must never desynchronize
+        pos_t = jnp.full((1,), t, jnp.int32) if self.rotary else None
         k_t = self.k_proj(x_t).reshape(b, 1, self.num_kv_heads,
                                        self.head_dim)
         v_t = self.v_proj(x_t).reshape(b, 1, self.num_kv_heads,
@@ -468,7 +471,6 @@ class _MHADecodeMixin:
         if self.rotary:
             from ..ops.attention import rotary_embedding
 
-            pos_t = jnp.full((1,), t, jnp.int32)
             k_t = rotary_embedding(k_t, pos_t, theta=self.rotary_theta)
         cache_k = lax.dynamic_update_slice_in_dim(
             cache_k, k_t.astype(cache_k.dtype), t, axis=1)
@@ -480,9 +482,7 @@ class _MHADecodeMixin:
             keep &= pos > t - window
         mask = jnp.broadcast_to(keep, (b, cap))[:, None, None, :]
         out = self.attend_kv(
-            x_t, cache_k, cache_v, attn_mask=mask,
-            q_positions=(jnp.full((1,), t, jnp.int32) if self.rotary
-                         else None))
+            x_t, cache_k, cache_v, attn_mask=mask, q_positions=pos_t)
         return out, cache_k, cache_v
 
 

@@ -49,8 +49,9 @@ from .reduction import (mean, reduce_all, reduce_any, reduce_max, reduce_mean,
                         reduce_min, reduce_prod, reduce_sum)
 from .rnn import (conv_shift, dynamic_rnn, gru, gru_unit, lstm, lstm_unit,
                   lstmp, row_conv, sequence_conv)
-from .sampling import (hsigmoid_loss, nce_loss, sample_classes, sample_logits,
-                       sampling_id)
+from .sampling import (hsigmoid_loss, nce_loss, sample_classes,
+                       sample_from_logits, sample_logits, sampling_id,
+                       top_k_logits, top_p_logits)
 from .sequence import (sequence_concat, sequence_enumerate, sequence_expand,
                        sequence_mask, sequence_pad, sequence_pool,
                        sequence_reverse, sequence_slice, sequence_softmax,

@@ -174,6 +174,53 @@ def sampling_id(probs, key, min: float = 0.0, max: float = 1.0):
     return jnp.minimum(ids, probs.shape[-1] - 1)  # guard max>1 overshoot
 
 
+def top_k_logits(logits, k: int):
+    """Keep the k largest entries per row; push the rest to -inf.
+    ``k <= 0`` is a no-op (no filtering). Ties at the k-th value all
+    survive (the filter is by value threshold, not by rank)."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def top_p_logits(logits, p: float):
+    """Nucleus filter: keep the smallest set of entries whose
+    probability mass reaches ``p`` (the top entry always survives);
+    push the rest to -inf. ``p >= 1`` is a no-op."""
+    if p >= 1.0:
+        return logits
+    enforce(p > 0.0, "top_p must be in (0, 1], got %s (p <= 0 would "
+            "filter every token)", p)
+    srt = jnp.sort(logits, axis=-1)[..., ::-1]           # descending
+    probs = jax.nn.softmax(srt.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # an entry is kept while the mass BEFORE it is still < p, so the
+    # set is the minimal prefix with cum >= p and is never empty
+    keep = (cum - probs) < p
+    thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                     keepdims=True).astype(logits.dtype)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def sample_from_logits(logits, key, temperature: float = 1.0,
+                       top_k: int = 0, top_p: float = 1.0):
+    """Draw one token id per row: temperature scaling, then top-k, then
+    nucleus (top-p) filtering, then a categorical draw — the standard LM
+    decoding order. ``temperature == 0`` is exact argmax (no key use).
+    Green-field next to :func:`sampling_id` (reference:
+    operators/sampling_id_op.cc draws from given probs; modern decoder
+    sampling needs the filtered-logits form)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    enforce(temperature > 0.0, "temperature must be >= 0, got %s",
+            temperature)
+    scaled = logits.astype(jnp.float32) / float(temperature)
+    scaled = top_k_logits(scaled, top_k)
+    scaled = top_p_logits(scaled, top_p)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
 def sample_logits(logits, label, num_samples: int, key,
                   sampler: str = "log_uniform",
                   remove_accidental_hits: bool = True):

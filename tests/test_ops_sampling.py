@@ -166,3 +166,59 @@ class TestSampleLogits:
             np.asarray(p[:5]),
             np.log((ids[:5] + 2.0) / (ids[:5] + 1.0)) / np.log(1001.0),
             rtol=1e-3, atol=1e-7)
+
+
+class TestDecodeSampling:
+    """Temperature / top-k / top-p decoding filters (green-field: the
+    reference's sampling_id draws from raw probs; modern LM decoding
+    needs the filtered-logits form)."""
+
+    def test_top_k_filter_against_numpy(self):
+        logits = jnp.asarray(RNG.normal(size=(4, 16)).astype(np.float32))
+        got = np.asarray(SP.top_k_logits(logits, 3))
+        ref = np.asarray(logits).copy()
+        for row in ref:
+            kth = np.sort(row)[-3]
+            row[row < kth] = -np.inf
+        np.testing.assert_array_equal(got, ref)
+        # k<=0 and k>=V are no-ops
+        np.testing.assert_array_equal(
+            np.asarray(SP.top_k_logits(logits, 0)), np.asarray(logits))
+        np.testing.assert_array_equal(
+            np.asarray(SP.top_k_logits(logits, 16)), np.asarray(logits))
+
+    def test_top_p_keeps_minimal_prefix(self):
+        logits = jnp.asarray(
+            np.log(np.array([[0.5, 0.3, 0.15, 0.05]], np.float32)))
+        # p=0.6: {0.5} has mass 0.5 < 0.6 so token 1 is also kept
+        got = np.asarray(SP.top_p_logits(logits, 0.6))[0]
+        assert np.isfinite(got[:2]).all() and np.isinf(got[2:]).all()
+        # p smaller than the top prob still keeps the top token
+        got = np.asarray(SP.top_p_logits(logits, 0.1))[0]
+        assert np.isfinite(got[0]) and np.isinf(got[1:]).all()
+        # p>=1 is a no-op
+        np.testing.assert_array_equal(
+            np.asarray(SP.top_p_logits(logits, 1.0)), np.asarray(logits))
+
+    def test_sample_matches_filtered_softmax_frequencies(self):
+        """Empirical draw frequencies track softmax of the filtered,
+        temperature-scaled logits."""
+        logits = jnp.asarray(
+            np.array([0.0, 1.0, 2.0, 3.0], np.float32))
+        n, temp, k = 4000, 0.7, 3
+        rows = jnp.broadcast_to(logits, (n, 4))
+        ids = np.asarray(SP.sample_from_logits(
+            rows, jax.random.key(0), temperature=temp, top_k=k))
+        freq = np.bincount(ids, minlength=4) / n
+        scaled = np.asarray(logits) / temp
+        scaled[0] = -np.inf  # top_k=3 drops the smallest
+        want = np.exp(scaled - scaled.max())
+        want = want / want.sum()
+        assert freq[0] == 0.0
+        np.testing.assert_allclose(freq, want, atol=0.03)
+
+    def test_temperature_zero_is_argmax(self):
+        logits = jnp.asarray(RNG.normal(size=(8, 32)).astype(np.float32))
+        got = SP.sample_from_logits(logits, None, temperature=0.0)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(jnp.argmax(logits, axis=-1)))
