@@ -2,9 +2,9 @@
 (reference: benchmark/fluid/models/, tests/book/)."""
 
 from . import (alexnet, bert, deepfm, googlenet, gpt, mnist,
-               recommender, resnet, se_resnext, stacked_lstm,
-               transformer, vgg)
+               recommender, resnet, se_resnext, speculative,
+               stacked_lstm, transformer, vgg)
 
 __all__ = ["alexnet", "bert", "deepfm", "googlenet", "gpt", "mnist",
-           "recommender", "resnet", "se_resnext", "stacked_lstm",
-           "transformer", "vgg"]
+           "recommender", "resnet", "se_resnext", "speculative",
+           "stacked_lstm", "transformer", "vgg"]

@@ -175,20 +175,26 @@ class GPTForCausalLM(Layer):
             h.reshape(b * t, d), w, None, labels.reshape(-1),
             chunk=vocab_chunk, ignore_index=ignore_index)
 
-    def _step_logits(self, tok, caches, t):
-        """One KV-cached position: embed ``tok`` (B,), run every block's
-        forward_step at cache index ``t``, return ((B, V) logits, new
-        caches)."""
-        x = self.embed(tok[:, None])              # (B, 1, D)
+    def _chunk_logits(self, toks, caches, t0):
+        """S KV-cached positions in one pass: embed ``toks`` (B, S), run
+        every block's forward_chunk at cache indices [t0, t0+S), return
+        ((B, S, V) logits, new caches). The speculative-decoding target
+        scores its gamma+1 candidates with one call."""
+        x = self.embed(toks)                      # (B, S, D)
         new_caches = []
         for blk, (ck, cv) in zip(self.blocks, caches):
             h = blk.norm1(x)
-            a, ck, cv = blk.self_attn.forward_step(
-                h, ck, cv, t, window=self.cfg.attn_window)
+            a, ck, cv = blk.self_attn.forward_chunk(
+                h, ck, cv, t0, window=self.cfg.attn_window)
             x = x + a
             x = x + blk.ffn(blk.norm2(x))
             new_caches.append((ck, cv))
-        return self.norm_f(x)[:, 0] @ self._head_weight(), new_caches
+        return self.norm_f(x) @ self._head_weight(), new_caches
+
+    def _step_logits(self, tok, caches, t):
+        """One KV-cached position: ``tok`` (B,) -> ((B, V), caches)."""
+        logits, caches = self._chunk_logits(tok[:, None], caches, t)
+        return logits[:, 0], caches
 
     def generate(self, prompt_ids, max_len: int, *, key=None,
                  temperature: float = 1.0, top_k: int = 0,

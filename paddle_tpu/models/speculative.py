@@ -1,0 +1,211 @@
+"""Speculative decoding: a small draft model proposes gamma tokens
+autoregressively, the target model scores all gamma+1 positions in ONE
+KV-cached forward_chunk, and a modified rejection test accepts a prefix
+— the output distribution is EXACTLY the target model's (the
+Leviathan/Chen 2023 construction), at a fraction of the target's
+sequential steps whenever the draft agrees often.
+
+TPU-first shape: every round does fixed-shape work (gamma draft steps +
+one (gamma+1)-token target chunk), so the whole loop is one compiled
+lax.while_loop; per-row progress is independent (each row accepts a
+different prefix length), handled by vmapping a single-row loop over
+the batch — cache writes at per-row dynamic offsets stay plain
+dynamic_update_slice under the vmap. Rejected positions leave stale K/V
+above the row's cursor; they are masked out by the <= t attention mask
+and overwritten before ever becoming visible.
+
+Green-field vs the reference (its decoding story is beam search over
+the NMT encoder-decoder, reference:
+benchmark/fluid/models/machine_translation.py, and the beam-search ops
+paddle/fluid/operators/beam_search_op.cc); this is the modern
+LM-serving analog of that "decode faster than one token per model
+call" capability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+from ..ops.sampling import filter_logits
+
+
+def speculative_generate(target, draft, prompt_ids, max_len: int, *,
+                         gamma: int = 4, key=None,
+                         temperature: float = 1.0, top_k: int = 0,
+                         top_p: float = 1.0,
+                         eos_id: Optional[int] = None,
+                         capacity: Optional[int] = None,
+                         return_stats: bool = False):
+    """Continue ``prompt_ids`` (B, Tp) to (B, max_len) token ids,
+    drawing from the TARGET model's (filtered) distribution while
+    running most positions through ``draft``.
+
+    ``temperature == 0`` is exact greedy: the result is token-identical
+    to ``target.greedy_decode`` (accepted drafts are exactly the
+    positions where the two argmaxes agree). Otherwise tokens are
+    provably distributed as target sampling with the same
+    temperature/top_k/top_p chain. ``eos_id`` stops a row once emitted
+    and fills the remainder of the row with eos.
+
+    With ``return_stats`` also returns a dict with per-row
+    ``accepted_drafts`` and ``rounds`` (mean accepted/round =
+    gamma * acceptance rate; tokens per target call = 1 + that).
+
+    Both models must share the vocabulary; draft quality only affects
+    speed, never the output distribution.
+    """
+    enforce(gamma >= 1, "gamma must be >= 1, got %s", gamma)
+    enforce(not target.training and not draft.training,
+            "speculative_generate runs in eval mode (call .eval())")
+    enforce(target.cfg.vocab_size == draft.cfg.vocab_size,
+            "vocab mismatch: target %s vs draft %s",
+            target.cfg.vocab_size, draft.cfg.vocab_size)
+    b, tp = prompt_ids.shape
+    enforce(max_len > tp, "max_len %s must exceed prompt %s", max_len,
+            tp)
+    cap = capacity or max(target.cfg.max_position, max_len + gamma)
+    enforce(cap >= max_len + gamma,
+            "cache capacity %s < max_len + gamma = %s (target chunk "
+            "writes run past max_len on the last round)", cap,
+            max_len + gamma)
+    sampled = float(temperature) != 0.0
+    if sampled:
+        enforce(key is not None,
+                "temperature > 0 samples and needs a PRNG key; "
+                "pass temperature=0 for greedy decoding")
+    else:
+        key = jax.random.key(0)  # never consumed; uniform row signature
+    # buffer padded past max_len so the (gamma+1)-token write of the
+    # final round never clamps backward over valid tokens
+    buf_len = max_len + gamma + 1
+
+    def _filtered_logprobs(logits):
+        return jax.nn.log_softmax(
+            filter_logits(logits, temperature, top_k, top_p), axis=-1)
+
+    def one_row(prompt_row, rkey):
+        tokens = jnp.zeros((buf_len,), prompt_ids.dtype)
+        tokens = lax.dynamic_update_slice(tokens, prompt_row, (0,))
+
+        caches_t = [blk.self_attn.init_cache(1, cap)
+                    for blk in target.blocks]
+        caches_d = [blk.self_attn.init_cache(1, cap)
+                    for blk in draft.blocks]
+        # prefill caches for positions [0, tp-1): the main loop refeeds
+        # the token at t-1 through BOTH models, so position tp-1 (and
+        # later) is always cached by the loop itself
+        if tp > 1:
+            _, caches_t = target._chunk_logits(
+                prompt_row[None, :tp - 1], caches_t, 0)
+            _, caches_d = draft._chunk_logits(
+                prompt_row[None, :tp - 1], caches_d, 0)
+
+        def cond(carry):
+            t, done = carry[1], carry[-1]
+            return jnp.logical_and(t < max_len, jnp.logical_not(done))
+
+        def body(carry):
+            tokens, t, caches_t, caches_d, rnd, acc, rounds, done = carry
+            last = lax.dynamic_slice(tokens, (t - 1,), (1,))    # (1,)
+
+            def draft_step(c, i):
+                tok, caches = c
+                logits, caches = draft._step_logits(
+                    tok[None], caches, t - 1 + i)               # (1, V)
+                if sampled:
+                    log_q = _filtered_logprobs(logits[0])       # (V,)
+                    ki = jax.random.fold_in(
+                        jax.random.fold_in(rkey, rnd), i)
+                    d = jax.random.categorical(ki, log_q)
+                else:
+                    log_q = jnp.zeros((logits.shape[-1],),
+                                      jnp.float32)
+                    d = jnp.argmax(logits[0], axis=-1)
+                d = d.astype(tokens.dtype)
+                return (d, caches), (d, jnp.exp(log_q))
+
+            (_, caches_d), (drafts, q_all) = lax.scan(
+                draft_step, (last[0], caches_d), jnp.arange(gamma))
+            # also cache d_{gamma-1}'s K/V at t+gamma-1 (logits unused):
+            # on a fully-accepted round the cursor jumps past that
+            # position and no later write covers it — a zero K row
+            # there would be attended (logit 0) by every later draft
+            # query, silently degrading acceptance. For n < gamma the
+            # position is >= the new cursor and the next round's writes
+            # overwrite it before any query attends it.
+            _, caches_d = draft._step_logits(
+                drafts[-1][None], caches_d, t - 1 + gamma)
+
+            # target scores [last, d_0..d_{gamma-1}] in one chunk:
+            # logits for positions t..t+gamma
+            chunk = jnp.concatenate([last, drafts])[None]  # (1, gamma+1)
+            logits_t, caches_t = target._chunk_logits(
+                chunk, caches_t, t - 1)
+
+            if sampled:
+                p_all = jnp.exp(_filtered_logprobs(logits_t[0]))
+                idx = jnp.arange(gamma)
+                pi = p_all[idx, drafts]
+                qi = q_all[idx, drafts]
+                ku = jax.random.fold_in(
+                    jax.random.fold_in(rkey, rnd), gamma)
+                u = jax.random.uniform(ku, (gamma,))
+                accept = u * qi < pi          # u < p/q without the /0
+                n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+                # correction: residual max(p_n - q_n, 0) normalized; at
+                # n == gamma q is all-zero so this IS the bonus draw
+                # from p_gamma
+                p_n = p_all[n]
+                q_n = jnp.where(n < gamma,
+                                q_all[jnp.minimum(n, gamma - 1)], 0.0)
+                res = jnp.clip(p_n - q_n, 0.0, None)
+                norm = jnp.sum(res)
+                res = jnp.where(norm > 0, res / norm, p_n)
+                kc = jax.random.fold_in(
+                    jax.random.fold_in(rkey, rnd), gamma + 1)
+                corr = jax.random.categorical(
+                    kc, jnp.where(res > 0, jnp.log(res), -jnp.inf))
+            else:
+                tgt = jnp.argmax(logits_t[0], axis=-1)  # (gamma+1,)
+                accept = drafts == tgt[:gamma].astype(drafts.dtype)
+                n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+                corr = tgt[n]
+            corr = corr.astype(tokens.dtype)
+
+            slot = jnp.arange(gamma + 1)
+            emitted = jnp.where(
+                slot < n, jnp.concatenate([drafts, drafts[-1:]]),
+                jnp.where(slot == n, corr, 0)).astype(tokens.dtype)
+            tokens = lax.dynamic_update_slice(tokens, emitted, (t,))
+            t_new = t + n + 1
+            if eos_id is not None:
+                done = done | jnp.any((emitted == eos_id) & (slot <= n))
+            done = done | (t_new >= max_len)
+            return (tokens, t_new, caches_t, caches_d, rnd + 1,
+                    acc + n, rounds + 1, done)
+
+        init = (tokens, jnp.asarray(tp, jnp.int32), caches_t, caches_d,
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32), jnp.asarray(False))
+        tokens, _, _, _, _, acc, rounds, _ = lax.while_loop(
+            cond, body, init)
+        out = tokens[:max_len]
+        if eos_id is not None:
+            pos = jnp.arange(max_len)
+            hit = (out == eos_id) & (pos >= tp)
+            first = jnp.argmax(hit)
+            out = jnp.where(jnp.any(hit) & (pos > first),
+                            jnp.asarray(eos_id, out.dtype), out)
+        return out, acc, rounds
+
+    row_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(b))
+    out, acc, rounds = jax.vmap(one_row)(prompt_ids, row_keys)
+    if return_stats:
+        return out, {"accepted_drafts": acc, "rounds": rounds}
+    return out

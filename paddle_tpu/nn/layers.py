@@ -452,38 +452,48 @@ class _MHADecodeMixin:
             q, k, v, mask=attn_mask, use_flash=self.use_flash)
         return self.out_proj(out.reshape(b, tq, d))
 
-    def forward_step(self, x_t, cache_k, cache_v, t, window=None):
-        """One decode step: project this position's K/V into the caches
-        at index ``t`` and attend over positions <= t (optionally only
-        the last ``window``). ``x_t``: (B, 1, D). Returns
-        (out_t, cache_k, cache_v)."""
+    def forward_chunk(self, x_chunk, cache_k, cache_v, t0, window=None):
+        """S decode positions in ONE call: project the chunk's K/V into
+        the caches at [t0, t0+S) and attend each position i over cache
+        positions <= t0+i (optionally only the last ``window``).
+        ``x_chunk``: (B, S, D); returns (out (B, S, D), cache_k,
+        cache_v). One speculative-decoding target-scoring pass over
+        gamma drafts = one forward_chunk; S=1 is the classic decode
+        step. Caller guarantees t0+S <= capacity (dynamic_update_slice
+        would silently clamp the write window otherwise)."""
         from jax import lax
 
-        b = x_t.shape[0]
+        b, s, _ = x_chunk.shape
         cap = cache_k.shape[1]
         # one positions array shared by the k rotation here and the q
         # rotation inside attend_kv — they must never desynchronize
-        pos_t = jnp.full((1,), t, jnp.int32) if self.rotary else None
-        k_t = self.k_proj(x_t).reshape(b, 1, self.num_kv_heads,
-                                       self.head_dim)
-        v_t = self.v_proj(x_t).reshape(b, 1, self.num_kv_heads,
-                                       self.head_dim)
+        pos_chunk = t0 + jnp.arange(s, dtype=jnp.int32)       # (S,)
+        k_c = self.k_proj(x_chunk).reshape(b, s, self.num_kv_heads,
+                                           self.head_dim)
+        v_c = self.v_proj(x_chunk).reshape(b, s, self.num_kv_heads,
+                                           self.head_dim)
         if self.rotary:
             from ..ops.attention import rotary_embedding
 
-            k_t = rotary_embedding(k_t, pos_t, theta=self.rotary_theta)
+            k_c = rotary_embedding(k_c, pos_chunk,
+                                   theta=self.rotary_theta)
         cache_k = lax.dynamic_update_slice_in_dim(
-            cache_k, k_t.astype(cache_k.dtype), t, axis=1)
+            cache_k, k_c.astype(cache_k.dtype), t0, axis=1)
         cache_v = lax.dynamic_update_slice_in_dim(
-            cache_v, v_t.astype(cache_v.dtype), t, axis=1)
+            cache_v, v_c.astype(cache_v.dtype), t0, axis=1)
         pos = jnp.arange(cap)
-        keep = pos <= t
+        keep = pos[None, :] <= pos_chunk[:, None]             # (S, cap)
         if window is not None:
-            keep &= pos > t - window
-        mask = jnp.broadcast_to(keep, (b, cap))[:, None, None, :]
+            keep &= pos[None, :] > pos_chunk[:, None] - window
         out = self.attend_kv(
-            x_t, cache_k, cache_v, attn_mask=mask, q_positions=pos_t)
+            x_chunk, cache_k, cache_v, attn_mask=keep[None, None],
+            q_positions=pos_chunk if self.rotary else None)
         return out, cache_k, cache_v
+
+    def forward_step(self, x_t, cache_k, cache_v, t, window=None):
+        """One decode step (``x_t``: (B, 1, D)) — forward_chunk S=1."""
+        return self.forward_chunk(x_t, cache_k, cache_v, t,
+                                  window=window)
 
 
 class MultiHeadAttention(_MHADecodeMixin, Layer):

@@ -203,6 +203,20 @@ def top_p_logits(logits, p: float):
     return jnp.where(logits < thresh, -jnp.inf, logits)
 
 
+def filter_logits(logits, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 1.0):
+    """The decoding filter chain without the draw: temperature scaling,
+    then top-k, then nucleus (top-p), in float32. softmax of the result
+    is the EXACT distribution sample_from_logits draws from — the
+    contract speculative decoding's accept/reject test relies on.
+    ``temperature`` must be > 0 here (argmax needs no filtering)."""
+    enforce(temperature > 0.0, "temperature must be > 0, got %s",
+            temperature)
+    scaled = logits.astype(jnp.float32) / float(temperature)
+    scaled = top_k_logits(scaled, top_k)
+    return top_p_logits(scaled, top_p)
+
+
 def sample_from_logits(logits, key, temperature: float = 1.0,
                        top_k: int = 0, top_p: float = 1.0):
     """Draw one token id per row: temperature scaling, then top-k, then
@@ -213,12 +227,8 @@ def sample_from_logits(logits, key, temperature: float = 1.0,
     sampling needs the filtered-logits form)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    enforce(temperature > 0.0, "temperature must be >= 0, got %s",
-            temperature)
-    scaled = logits.astype(jnp.float32) / float(temperature)
-    scaled = top_k_logits(scaled, top_k)
-    scaled = top_p_logits(scaled, top_p)
-    return jax.random.categorical(key, scaled, axis=-1)
+    return jax.random.categorical(
+        key, filter_logits(logits, temperature, top_k, top_p), axis=-1)
 
 
 def sample_logits(logits, label, num_samples: int, key,

@@ -109,6 +109,8 @@ MID_PATTERNS = [
     "test_quant_matmul.py::test_qat_freeze_int8_serve_e2e",
     "test_quant_serving.py",
     "test_gpt.py::test_greedy_decode_matches_full_recompute",
+    "test_speculative.py::test_forward_chunk_matches_sequential_steps",
+    "test_speculative.py::test_greedy_spec_equals_target_greedy",
     "test_gpt.py::test_gqa_flash_path_engages",
     "test_gpt.py::test_ring_sp_matches_plain",
     "test_sharded_embedding.py::test_lookup_matches_dense_gather",

@@ -1,0 +1,164 @@
+"""Speculative decoding (models/speculative.py): draft-propose /
+target-verify with exact target-distribution preservation, plus the
+forward_chunk multi-position cache step it rides on. Green-field vs the
+reference (its decode story is beam search,
+paddle/fluid/operators/beam_search_op.cc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import gpt as G
+from paddle_tpu.models.speculative import speculative_generate
+
+
+def _tiny_pair(seed_t=0, seed_d=99):
+    """A 2-layer target and an independently initialized 1-layer draft
+    over the same vocab."""
+    pt.seed(seed_t)
+    tgt = G.GPTForCausalLM(G.GPTConfig.tiny()).eval()
+    pt.seed(seed_d)
+    drf = G.GPTForCausalLM(G.GPTConfig(
+        vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
+        num_kv_heads=2, intermediate_size=128, max_position=128)).eval()
+    return tgt, drf
+
+
+def _prompt(vocab, b=2, t=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, vocab, (b, t)))
+
+
+def test_forward_chunk_matches_sequential_steps():
+    """One S-token chunk == S one-token forward_steps: same outputs,
+    same cache contents (the speculative target-scoring contract)."""
+    pt.seed(1)
+    from paddle_tpu import nn
+
+    attn = nn.MultiHeadAttention(64, 4, num_kv_heads=2, rotary=True,
+                                 bias=False).eval()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 6, 64)).astype(np.float32))
+    ck0, cv0 = attn.init_cache(2, 16)
+
+    outs, ck, cv = [], ck0, cv0
+    for t in range(6):
+        o, ck, cv = attn.forward_step(x[:, t:t + 1], ck, cv, t)
+        outs.append(o)
+    want = jnp.concatenate(outs, axis=1)
+
+    got, ck2, cv2 = attn.forward_chunk(x, ck0, cv0, 0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ck2), np.asarray(ck),
+                               atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(cv2), np.asarray(cv),
+                               atol=1e-6, rtol=1e-6)
+
+    # chunk at a dynamic offset mid-cache (the per-round scoring case)
+    got2, _, _ = attn.forward_chunk(x[:, 3:], ck, cv, 3)
+    np.testing.assert_allclose(np.asarray(got2),
+                               np.asarray(want[:, 3:]),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 4])
+def test_greedy_spec_equals_target_greedy(gamma):
+    """temperature=0: token-identical to target.greedy_decode for any
+    draft and any gamma (the exact-correctness oracle)."""
+    tgt, drf = _tiny_pair()
+    prompt = _prompt(512, b=2, t=5, seed=2)
+    want = np.asarray(tgt.greedy_decode(prompt, 20))
+    got = np.asarray(speculative_generate(tgt, drf, prompt, 20,
+                                          gamma=gamma, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_perfect_draft_accepts_everything():
+    """draft == target: every draft accepted, so each round emits
+    gamma+1 tokens and rounds == ceil((max_len - tp) / (gamma + 1))."""
+    tgt, _ = _tiny_pair()
+    prompt = _prompt(512, b=2, t=4, seed=3)
+    out, stats = speculative_generate(tgt, tgt, prompt, 19, gamma=2,
+                                      temperature=0.0,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(tgt.greedy_decode(prompt,
+                                                               19)))
+    rounds = np.asarray(stats["rounds"])
+    acc = np.asarray(stats["accepted_drafts"])
+    # 15 tokens at gamma+1=3/round is 5 rounds; the final round may
+    # overshoot max_len by up to gamma accepted-but-unused drafts, so
+    # acc + rounds lands in [15, 15+gamma]. Draft and target run in
+    # differently-fused compiled programs, so a near-tied argmax can
+    # flip between them and cost a round — output equality above is
+    # exact regardless (corrections come from the target's own logits);
+    # allow one such flip.
+    assert ((acc + rounds >= 15) & (acc + rounds <= 17)).all(), (acc,
+                                                                rounds)
+    assert ((rounds >= 5) & (rounds <= 6)).all(), rounds
+
+
+def test_sampled_distribution_matches_target():
+    """The theorem: spec-sampled next-token frequencies match direct
+    target sampling (filtered distribution), despite most draws passing
+    through an independent draft."""
+    pt.seed(4)
+    cfg_t = G.GPTConfig(vocab_size=16, hidden_size=32, num_layers=1,
+                        num_heads=2, num_kv_heads=2,
+                        intermediate_size=64, max_position=32)
+    tgt = G.GPTForCausalLM(cfg_t).eval()
+    pt.seed(44)
+    drf = G.GPTForCausalLM(cfg_t).eval()
+    temp, k = 1.3, 8
+    prompt = jnp.tile(jnp.asarray([[3, 7]]), (4000, 1))
+    out = np.asarray(speculative_generate(
+        tgt, drf, prompt, 3, gamma=2, key=jax.random.key(5),
+        temperature=temp, top_k=k))
+    freq = np.bincount(out[:, 2], minlength=16) / out.shape[0]
+
+    from paddle_tpu.ops.sampling import filter_logits
+    logits = tgt(prompt[:1])[0, 1]
+    want = np.asarray(jax.nn.softmax(filter_logits(logits, temp, k)))
+    assert 0.5 * np.abs(freq - want).sum() < 0.06, (freq, want)
+    # the draft must actually be contributing accepted tokens for the
+    # test to mean anything
+    _, stats = speculative_generate(
+        tgt, drf, prompt[:200], 12, gamma=2, key=jax.random.key(6),
+        temperature=temp, top_k=k, return_stats=True)
+    assert np.asarray(stats["accepted_drafts"]).mean() > 1.0
+
+
+def test_eos_stops_and_fills():
+    tgt, drf = _tiny_pair()
+    prompt = _prompt(512, b=3, t=4, seed=7)
+    free = np.asarray(speculative_generate(
+        tgt, drf, prompt, 32, gamma=3, key=jax.random.key(8),
+        temperature=2.0))
+    eos = int(free[0, 12])
+    out = np.asarray(speculative_generate(
+        tgt, drf, prompt, 32, gamma=3, key=jax.random.key(8),
+        temperature=2.0, eos_id=eos))
+    hit = (out[:, 4:] == eos).any(axis=1)
+    assert hit.any()
+    for row in out[hit]:
+        first = 4 + int(np.argmax(row[4:] == eos))
+        assert (row[first:] == eos).all()
+
+
+def test_typed_errors():
+    tgt, drf = _tiny_pair()
+    prompt = _prompt(512, b=1, t=4, seed=9)
+    with pytest.raises(Exception, match="gamma"):
+        speculative_generate(tgt, drf, prompt, 12, gamma=0,
+                             temperature=0.0)
+    with pytest.raises(Exception, match="PRNG key"):
+        speculative_generate(tgt, drf, prompt, 12)
+    with pytest.raises(Exception, match="vocab"):
+        pt.seed(10)
+        bad = G.GPTForCausalLM(G.GPTConfig(
+            vocab_size=64, hidden_size=64, num_layers=1, num_heads=2,
+            intermediate_size=64, max_position=64)).eval()
+        speculative_generate(tgt, bad, prompt, 12, temperature=0.0)
