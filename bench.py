@@ -13,6 +13,7 @@ Usage: python bench.py [--smoke] [--model mnist_mlp]
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import functools
 import json
 import os
@@ -605,6 +606,85 @@ def bench_nmt_decode(steps: int, batch_size: int, amp=None,
     return outer * batch_size * max_len / dt, "tokens/sec", {}
 
 
+def bench_gpt_decode(steps: int, batch_size: int, amp=None,
+                     max_len: int = 128, gamma: int = 0,
+                     smoke: bool = False):
+    """GPT KV-cached decode throughput (tokens/sec, generated positions
+    only). Default is greedy decode on the 12-layer small config.
+    ``--gamma g`` > 0 switches to speculative decoding against a
+    2-layer draft sharing the target's geometry (fresh init): the
+    output distribution is the target's regardless of the draft, so
+    this measures the MACHINERY cost honestly — the emitted
+    accept-per-round extra turns the number into the real speedup
+    formula (tokens per target pass = 1 + accepted/round) for any
+    better-trained draft pair."""
+    import contextlib
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.core.dtypes import policy_scope
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.models.speculative import speculative_generate
+
+    pt.seed(0)
+    batch_size = _cap(batch_size, 2 if smoke else 16)
+    cfg = G.GPTConfig.small()
+    if smoke:
+        cfg.vocab_size, cfg.num_layers = 1024, 2
+        max_len = min(max_len, 32)
+    cfg.max_position = max_len + max(gamma, 0)
+    model = G.GPTForCausalLM(cfg).eval()
+    rng = np.random.default_rng(0)
+    prompt_len = min(16, max_len // 2)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch_size, prompt_len)))
+
+    if gamma > 0:
+        dcfg = dataclasses.replace(cfg, num_layers=2)
+        pt.seed(1)
+        draft = G.GPTForCausalLM(dcfg).eval()
+
+        def _decode(p):
+            scope = policy_scope(amp) if amp else contextlib.nullcontext()
+            with scope:
+                return speculative_generate(
+                    model, draft, p, max_len, gamma=gamma,
+                    temperature=0.0, return_stats=True)
+
+        fn = jax.jit(_decode)
+    else:
+        def _decode(p):
+            scope = policy_scope(amp) if amp else contextlib.nullcontext()
+            with scope:
+                return model.greedy_decode(p, max_len), None
+
+        fn = jax.jit(_decode)
+
+    def _fence(out):
+        float(jax.device_get(out[0][0, 0]))
+
+    for _ in range(2):
+        out = fn(prompt)
+    _fence(out)
+    outer = max(1, steps // 4)
+    t0 = time.perf_counter()
+    for i in range(outer):
+        out = fn(prompt)
+        _fence(out)
+    dt = time.perf_counter() - t0
+    extras = {}
+    if gamma > 0:
+        stats = jax.device_get(out[1])
+        rounds = float(np.mean(stats["rounds"]))
+        extras = {"accept_per_round":
+                  round(float(np.mean(stats["accepted_drafts"])) /
+                        max(rounds, 1.0), 3),
+                  "rounds": round(rounds, 1)}
+    gen = max_len - prompt_len
+    return outer * batch_size * gen / dt, "tokens/sec", extras
+
+
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
                         vocab: int = 100_000):
     """DeepFM with ROW-SPARSE embedding updates (the SelectedRows
@@ -872,6 +952,7 @@ MODELS = {
     "bert_long": bench_bert_long,
     "transformer_nmt": bench_transformer_nmt,
     "nmt_decode": bench_nmt_decode,
+    "gpt_decode": bench_gpt_decode,
     "deepfm": bench_deepfm,
     "deepfm_sparse": bench_deepfm_sparse,
 }
@@ -915,6 +996,7 @@ def run_config_fingerprint(metric: str, args, steps: int):
         "scan_layers": args.scan_layers, "scan_unroll": args.scan_unroll,
         "steps_per_call": args.steps_per_call, "vocab": args.vocab,
         "window": args.window, "kv_cache": args.kv_cache,
+        "gamma": args.gamma,
         "layout": args.layout, "dp": args.dp, "infer": args.infer,
     }
     # None = knob not set; False values (e.g. --no-fused-ce) are REAL
@@ -1066,6 +1148,9 @@ def main():
     ap.add_argument("--window", type=int, default=None,
                     help="bert_long: sliding-window attention width "
                     "(O(T*W) local attention vs the O(T^2) default)")
+    ap.add_argument("--gamma", type=int, default=None,
+                    help="gpt_decode: speculative-decoding draft length "
+                    "(0/unset = plain greedy decode)")
     ap.add_argument("--no-kv-cache", dest="kv_cache", action="store_false",
                     help="nmt_decode: full-prefix re-run decode instead "
                     "of the K/V-cached step (same tokens; the honest "
@@ -1115,6 +1200,15 @@ def main():
         # a window changes the WORKLOAD (different attention math):
         # its history key must not collide with the full-attention one
         metric += f"_w{args.window}"
+    if args.gamma is not None and args.gamma < 0:
+        # a negative value would fall back to greedy inside the bench fn
+        # while recording under a speculative _gN key — refuse instead
+        _emit_error(metric, f"--gamma must be >= 1, got {args.gamma}")
+        return
+    if args.gamma and "gamma" in sig:
+        # speculative decode is a different WORKLOAD (draft model in the
+        # loop): its own history key per gamma
+        metric += f"_g{args.gamma}"
     if "cached" in sig and not args.kv_cache:
         # same workload, different implementation — its own history key
         # so the cache-vs-recompute comparison stays visible
@@ -1150,6 +1244,10 @@ def main():
         # identical to deepfm's — bench that instead of duplicating it
         _emit_error(metric, "--infer: use --model deepfm (the sparse "
                     "variant differs only in the optimizer update)")
+        return
+    if args.infer and args.model == "gpt_decode":
+        _emit_error(metric, "--infer: --model gpt_decode already measures "
+                    "inference decode; run it without --infer")
         return
     if args.infer and args.model == "nmt_decode":
         # the decode bench IS an inference workload; an --infer run would
@@ -1217,6 +1315,8 @@ def main():
         kwargs["window"] = args.window
     if "cached" in sig:
         kwargs["cached"] = args.kv_cache
+    if args.gamma and "gamma" in sig:
+        kwargs["gamma"] = args.gamma
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call
@@ -1322,9 +1422,11 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
     # Reported only when both sides are known (never on CPU).
     from paddle_tpu.utils.flops import mfu as _mfu
 
-    # latency percentiles from the inference harness ride along verbatim
+    # latency percentiles from the inference harness, and the
+    # speculative-decode acceptance stats, ride along verbatim
     line.update({k: v for k, v in extras.items()
-                 if k.startswith("latency_ms_")})
+                 if k.startswith("latency_ms_")
+                 or k in ("accept_per_round", "rounds")})
     flops_per_sec = extras.get("flops_per_sec")
     line["mfu"] = None
     if flops_per_sec:

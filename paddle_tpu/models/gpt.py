@@ -175,11 +175,14 @@ class GPTForCausalLM(Layer):
             h.reshape(b * t, d), w, None, labels.reshape(-1),
             chunk=vocab_chunk, ignore_index=ignore_index)
 
-    def _chunk_logits(self, toks, caches, t0):
+    def _chunk_logits(self, toks, caches, t0, head: bool = True):
         """S KV-cached positions in one pass: embed ``toks`` (B, S), run
         every block's forward_chunk at cache indices [t0, t0+S), return
         ((B, S, V) logits, new caches). The speculative-decoding target
-        scores its gamma+1 candidates with one call."""
+        scores its gamma+1 candidates with one call. ``head=False``
+        skips the (S, V) head projection and returns (None, caches) —
+        the cache-only prefill path (XLA would DCE the dead matmul
+        under jit, but eager callers pay it for real)."""
         x = self.embed(toks)                      # (B, S, D)
         new_caches = []
         for blk, (ck, cv) in zip(self.blocks, caches):
@@ -189,6 +192,8 @@ class GPTForCausalLM(Layer):
             x = x + a
             x = x + blk.ffn(blk.norm2(x))
             new_caches.append((ck, cv))
+        if not head:
+            return None, new_caches
         return self.norm_f(x) @ self._head_weight(), new_caches
 
     def _step_logits(self, tok, caches, t):

@@ -101,9 +101,9 @@ def speculative_generate(target, draft, prompt_ids, max_len: int, *,
         # later) is always cached by the loop itself
         if tp > 1:
             _, caches_t = target._chunk_logits(
-                prompt_row[None, :tp - 1], caches_t, 0)
+                prompt_row[None, :tp - 1], caches_t, 0, head=False)
             _, caches_d = draft._chunk_logits(
-                prompt_row[None, :tp - 1], caches_d, 0)
+                prompt_row[None, :tp - 1], caches_d, 0, head=False)
 
         def cond(carry):
             t, done = carry[1], carry[-1]

@@ -339,3 +339,18 @@ def test_nmt_decode_bench_contract():
               "--steps", "4", "--batch-size", "2", timeout=900)
     assert d2["metric"] == "nmt_decode_throughput_nocache_b2"
     assert d2["value"] > 0
+
+
+def test_gpt_decode_bench_contract():
+    """GPT decode bench: greedy and speculative variants emit distinct
+    metric keys; the speculative line carries the acceptance stats that
+    turn machinery tokens/sec into the real-pair speedup formula."""
+    d = _run("--model", "gpt_decode", "--smoke", "--steps", "4",
+             "--batch-size", "2")
+    assert d["metric"] == "gpt_decode_throughput_b2"
+    assert d["unit"] == "tokens/sec" and d["value"] > 0
+    d2 = _run("--model", "gpt_decode", "--gamma", "2", "--smoke",
+              "--steps", "4", "--batch-size", "2", timeout=900)
+    assert d2["metric"] == "gpt_decode_throughput_g2_b2"
+    assert d2["value"] > 0
+    assert "accept_per_round" in d2 and "rounds" in d2

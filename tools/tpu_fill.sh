@@ -145,6 +145,11 @@ item infer_nmt         1200 python bench.py --infer --model transformer_nmt
 # CPU already shows 4.8x for the cache at max_len 64)
 item decode_nmt        1200 python bench.py --model nmt_decode
 item decode_nmt_full   1500 python bench.py --model nmt_decode --no-kv-cache
+# GPT KV-cached decode + speculative machinery cost (r5: tokens/sec
+# with accept_per_round riding the JSON line — the real-pair speedup
+# formula is 1 + accepted/round per target pass)
+item decode_gpt        1500 python bench.py --model gpt_decode
+item decode_gpt_spec   1500 python bench.py --model gpt_decode --gamma 4
 # NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
 # C++ predictor + PJRT C API (export runs off-chip: StableHLO is
 # portable; only the ptserve compile+run needs the chip)
