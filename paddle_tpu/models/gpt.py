@@ -175,7 +175,8 @@ class GPTForCausalLM(Layer):
             h.reshape(b * t, d), w, None, labels.reshape(-1),
             chunk=vocab_chunk, ignore_index=ignore_index)
 
-    def _chunk_logits(self, toks, caches, t0, head: bool = True):
+    def _chunk_logits(self, toks, caches, t0, head: bool = True,
+                      decode_kernel: bool = False):
         """S KV-cached positions in one pass: embed ``toks`` (B, S), run
         every block's forward_chunk at cache indices [t0, t0+S), return
         ((B, S, V) logits, new caches). The speculative-decoding target
@@ -188,7 +189,8 @@ class GPTForCausalLM(Layer):
         for blk, (ck, cv) in zip(self.blocks, caches):
             h = blk.norm1(x)
             a, ck, cv = blk.self_attn.forward_chunk(
-                h, ck, cv, t0, window=self.cfg.attn_window)
+                h, ck, cv, t0, window=self.cfg.attn_window,
+                decode_kernel=decode_kernel)
             x = x + a
             x = x + blk.ffn(blk.norm2(x))
             new_caches.append((ck, cv))
@@ -196,9 +198,10 @@ class GPTForCausalLM(Layer):
             return None, new_caches
         return self.norm_f(x) @ self._head_weight(), new_caches
 
-    def _step_logits(self, tok, caches, t):
+    def _step_logits(self, tok, caches, t, decode_kernel: bool = False):
         """One KV-cached position: ``tok`` (B,) -> ((B, V), caches)."""
-        logits, caches = self._chunk_logits(tok[:, None], caches, t)
+        logits, caches = self._chunk_logits(
+            tok[:, None], caches, t, decode_kernel=decode_kernel)
         return logits[:, 0], caches
 
     def generate(self, prompt_ids, max_len: int, *, key=None,
@@ -250,7 +253,11 @@ class GPTForCausalLM(Layer):
 
         def scan_step(carry, t):
             tok_prev, caches, done = carry
-            logits, caches = self._step_logits(tok_prev, caches, t)
+            # the flash-decode kernel masks pos <= t in-kernel and reads
+            # only live cache blocks (eligible shapes; XLA mask path
+            # otherwise) — safe here: generate() never runs under vmap
+            logits, caches = self._step_logits(tok_prev, caches, t,
+                                               decode_kernel=True)
             if sampled:
                 nxt = sample_from_logits(
                     logits, jax.random.fold_in(key, t), temperature,

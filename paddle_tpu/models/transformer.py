@@ -131,11 +131,15 @@ class TransformerNMT(nn.Layer):
                                       jnp.arange(max_len))
         return tokens[:, 1:]
 
-    def _cached_step_hidden(self, tok, t, mem_kv, caches, cross_mask):
+    def _cached_step_hidden(self, tok, t, mem_kv, caches, cross_mask,
+                            decode_kernel: bool = False):
         """One cached decode step shared by greedy and beam: embed the
         current token (B, ), add the absolute-position term, run every
         decoder layer against its K/V cache, final-norm. Returns
-        (h_t (B, D), new_caches)."""
+        (h_t (B, D), new_caches). ``decode_kernel`` opts the
+        self-attention into the Pallas flash-decode path — greedy only;
+        beam_decode runs this under vmap, where the scalar-prefetch
+        pallas_call must not go."""
         from ..nn.transformer import decoder_layer_step
 
         emb = self.tgt_emb(tok[:, None])
@@ -145,7 +149,8 @@ class TransformerNMT(nn.Layer):
         for layer, (mk, mv), (ck, cv) in zip(self.decoder.layers,
                                              mem_kv, caches):
             x_t, ck, cv = decoder_layer_step(
-                layer, x_t, mk, mv, ck, cv, t, cross_mask=cross_mask)
+                layer, x_t, mk, mv, ck, cv, t, cross_mask=cross_mask,
+                decode_kernel=decode_kernel)
             new_caches.append((ck, cv))
         if self.decoder.final_norm is not None:
             x_t = self.decoder.final_norm(x_t)
@@ -188,7 +193,8 @@ class TransformerNMT(nn.Layer):
             word = lax.dynamic_index_in_dim(tokens, t, axis=1,
                                             keepdims=False)  # (b,)
             h_t, new_caches = self._cached_step_hidden(
-                word, t, mem_kv, caches, cross_mask)
+                word, t, mem_kv, caches, cross_mask,
+                decode_kernel=True)
             logits = self.generator(h_t)
             next_tok = jnp.argmax(logits, -1).astype(jnp.int32)
             next_tok = jnp.where(finished, cfg.pad_id, next_tok)

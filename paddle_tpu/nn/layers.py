@@ -434,12 +434,18 @@ class _MHADecodeMixin:
                                        self.head_dim)
         return k, v
 
-    def attend_kv(self, query, k, v, attn_mask=None, q_positions=None):
+    def attend_kv(self, query, k, v, attn_mask=None, q_positions=None,
+                  decode_t=None, window=None):
         """Attention of ``query`` (B, Tq, D) against PRE-PROJECTED k/v.
         ``q_positions``: absolute positions for rotary queries (the
         cached K was rotated at write time — the RoPE cache
-        convention)."""
-        from ..ops.attention import (rotary_embedding,
+        convention). ``decode_t`` (with Tq == 1): the cache cursor —
+        eligible shapes ride the Pallas flash-decode kernel, which
+        applies the pos <= decode_t (and ``window``) mask in-kernel and
+        reads only live cache blocks from HBM; ineligible shapes fall
+        back to ``attn_mask`` (callers pass both)."""
+        from ..ops.attention import (_get_flash_decode, decode_flash_ok,
+                                     rotary_embedding,
                                      scaled_dot_product_attention)
 
         b, tq, d = query.shape
@@ -448,11 +454,17 @@ class _MHADecodeMixin:
         if q_positions is not None:
             q = rotary_embedding(q, q_positions,
                                  theta=self.rotary_theta)
-        out = scaled_dot_product_attention(
-            q, k, v, mask=attn_mask, use_flash=self.use_flash)
+        if (decode_t is not None and tq == 1 and self.use_flash
+                and decode_flash_ok(k.shape[1], self.head_dim)
+                and _get_flash_decode() is not None):
+            out = _get_flash_decode()(q, k, v, decode_t, window=window)
+        else:
+            out = scaled_dot_product_attention(
+                q, k, v, mask=attn_mask, use_flash=self.use_flash)
         return self.out_proj(out.reshape(b, tq, d))
 
-    def forward_chunk(self, x_chunk, cache_k, cache_v, t0, window=None):
+    def forward_chunk(self, x_chunk, cache_k, cache_v, t0, window=None,
+                      decode_kernel: bool = False):
         """S decode positions in ONE call: project the chunk's K/V into
         the caches at [t0, t0+S) and attend each position i over cache
         positions <= t0+i (optionally only the last ``window``).
@@ -487,13 +499,21 @@ class _MHADecodeMixin:
             keep &= pos[None, :] > pos_chunk[:, None] - window
         out = self.attend_kv(
             x_chunk, cache_k, cache_v, attn_mask=keep[None, None],
-            q_positions=pos_chunk if self.rotary else None)
+            q_positions=pos_chunk if self.rotary else None,
+            # the decode kernel is an OPT-IN (plain jit decode loops):
+            # its scalar-prefetch pallas_call must not be dragged under
+            # an outer vmap (the speculative per-row loop) where the
+            # batching rule would reject it
+            decode_t=(t0 if decode_kernel and s == 1 else None),
+            window=window)
         return out, cache_k, cache_v
 
-    def forward_step(self, x_t, cache_k, cache_v, t, window=None):
+    def forward_step(self, x_t, cache_k, cache_v, t, window=None,
+                     decode_kernel: bool = False):
         """One decode step (``x_t``: (B, 1, D)) — forward_chunk S=1."""
         return self.forward_chunk(x_t, cache_k, cache_v, t,
-                                  window=window)
+                                  window=window,
+                                  decode_kernel=decode_kernel)
 
 
 class MultiHeadAttention(_MHADecodeMixin, Layer):

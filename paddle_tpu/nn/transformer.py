@@ -285,7 +285,7 @@ class LearnedPositionalEmbedding(Layer):
 
 
 def decoder_layer_step(layer, x_t, mem_k, mem_v, cache_k, cache_v, t,
-                       cross_mask=None):
+                       cross_mask=None, decode_kernel: bool = False):
     """One incremental-decode step of a TransformerDecoderLayer: the
     self-attention runs against the layer's K/V cache (O(T) per step —
     the transformer analog of the reference RNN decoder's O(1) state),
@@ -296,14 +296,16 @@ def decoder_layer_step(layer, x_t, mem_k, mem_v, cache_k, cache_v, t,
     w = layer.attn_window
     if layer.normalize_before:
         h, cache_k, cache_v = layer.self_attn.forward_step(
-            layer.norm1(x_t), cache_k, cache_v, t, window=w)
+            layer.norm1(x_t), cache_k, cache_v, t, window=w,
+            decode_kernel=decode_kernel)
         x_t = x_t + h
         x_t = x_t + layer.cross_attn.attend_kv(layer.norm2(x_t), mem_k,
                                                mem_v, attn_mask=cross_mask)
         x_t = x_t + layer.ffn(layer.norm3(x_t))
     else:
         h, cache_k, cache_v = layer.self_attn.forward_step(
-            x_t, cache_k, cache_v, t, window=w)
+            x_t, cache_k, cache_v, t, window=w,
+            decode_kernel=decode_kernel)
         x_t = layer.norm1(x_t + h)
         x_t = layer.norm2(x_t + layer.cross_attn.attend_kv(
             x_t, mem_k, mem_v, attn_mask=cross_mask))

@@ -147,6 +147,10 @@ def _get_flash():
 
 _FORCE_FLASH = False
 
+# head dims both Pallas kernels support — ONE list so the decode and
+# training dispatch gates never desynchronize
+_FLASH_HEAD_DIMS = (64, 128, 256)
+
 
 class force_flash:
     """Context manager: route eligible shapes to the flash kernel even
@@ -206,6 +210,32 @@ def rotary_embedding(x, positions, theta: float = 10000.0):
     return out.astype(x.dtype)
 
 
+@functools.lru_cache(maxsize=1)
+def _get_flash_decode():
+    try:
+        from .pallas.flash_decode import flash_decode
+
+        return flash_decode
+    except Exception:
+        return None
+
+
+def decode_flash_ok(capacity: int, d: int) -> bool:
+    """Dispatch gate for the single-position decode kernel
+    (pallas/flash_decode.py): TPU backend (or force_flash), supported
+    head dim, block-divisible cache capacity. A separate gate from
+    flash_shape_ok — decode shapes (tq=1 against a fixed capacity)
+    never satisfy the training kernel's block rules."""
+    if (not _FORCE_FLASH
+            and jax.default_backend() not in ("tpu", "axon")):
+        return False
+    try:
+        from .pallas.flash_decode import decode_block_k
+    except Exception:  # kernel unavailable -> XLA mask path
+        return False
+    return d in _FLASH_HEAD_DIMS and decode_block_k(capacity) is not None
+
+
 def _flash_ok(q, k, causal: bool = False, window=None) -> bool:
     """Flash kernel constraints for (B, T, H, D) operands — see
     flash_shape_ok for the actual gate."""
@@ -226,7 +256,7 @@ def flash_shape_ok(tq, tk, d, causal: bool = False, window=None) -> bool:
     # 64-divisible seqs use block=64 (the tuner measures that shape too:
     # tools/pallas_tune.py short-seq fallback); the measured use_flash
     # verdict below still decides whether the kernel actually wins there
-    if not (tq % 64 == 0 and tk % 64 == 0 and d in (64, 128, 256)):
+    if not (tq % 64 == 0 and tk % 64 == 0 and d in _FLASH_HEAD_DIMS):
         return False
     if window is not None and window < tk:
         # tuned verdicts are measured at DENSE attention; banded flash

@@ -1,0 +1,174 @@
+"""Pallas flash-decode: single-position KV-cache attention.
+
+The decode hot loop attends ONE query per (batch, head) against a
+pre-allocated (B, capacity, H_kv, D) cache with a ``pos <= t`` mask.
+The XLA fallback streams the FULL capacity from HBM every step even
+when only t+1 positions are live; decode is bandwidth-bound, so that
+over-read is the whole cost. This kernel walks kv blocks on a
+(B, capacity/block_k) grid with the block index CLAMPED into the live
+range [lo(t), t // block_k] via a scalar-prefetch index map — Mosaic
+elides the DMA when consecutive grid steps map to the same block, so
+HBM traffic is O(t) (O(window) with sliding-window attention), not
+O(capacity).
+
+All H query heads of one batch element ride one program as the row
+dimension of the score matrix (a single decode row per head would
+waste the 8-sublane tile); GQA/MQA groups take static per-kv-head
+slices of those rows, reading each shared K/V block once. Online
+softmax carries (m, l, acc) in VMEM scratch across kv blocks exactly
+like the training kernel (flash_attention.py).
+
+Inference-only: no VJP (the decode loop never differentiates).
+Reference niche: the hand-tuned JIT kernel layer,
+/root/reference/paddle/fluid/operators/jit/ — decode attention is the
+op XLA leaves the most bandwidth on the table for.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ...core.enforce import enforce
+from .flash_attention import _NEG_INF, _scratch, _use_interpret, pltpu
+
+if pltpu is None:  # pragma: no cover
+    # unlike the sibling training kernel, this one NEEDS pltpu
+    # (PrefetchScalarGridSpec for the cursor); failing the import here
+    # lets ops.attention's guarded importers fall back to the XLA path
+    raise ImportError("flash_decode requires jax.experimental.pallas.tpu")
+
+DEFAULT_DECODE_BLOCK_K = 256
+
+
+def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, scale, window, block_k, n_j, nheads,
+                   kv_heads):
+    j = pl.program_id(1)
+    t = t_ref[0]
+    t_blk = t // block_k
+    lo_blk = (jnp.maximum(t - window + 1, 0) // block_k
+              if window is not None else 0)
+    group = nheads // kv_heads
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when((j <= t_blk) & (j >= lo_blk))
+    def _body():
+        q = q_ref[0]                                  # (H, D)
+        parts = []
+        for hk in range(kv_heads):
+            qg = q[hk * group:(hk + 1) * group]       # (G, D)
+            kk = k_ref[0, :, hk]                      # (block_k, D)
+            parts.append(jax.lax.dot_general(
+                qg, kk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        s = jnp.concatenate(parts, axis=0) * scale    # (H, block_k)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        live = cols <= t
+        if window is not None:
+            live &= cols > t - window
+        s = jnp.where(live, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                         # (H, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, -1, keepdims=True)
+        pvs = []
+        for hk in range(kv_heads):
+            vv = v_ref[0, :, hk]                      # (block_k, D)
+            pg = p[hk * group:(hk + 1) * group]
+            pvs.append(jax.lax.dot_general(
+                pg.astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(pvs, axis=0)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_j - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # t<0 would divide by zero
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def decode_block_k(capacity: int) -> Optional[int]:
+    """Largest supported kv block dividing the cache capacity (None =
+    shape ineligible for the kernel)."""
+    for bk in (DEFAULT_DECODE_BLOCK_K, 128, 64):
+        if capacity % bk == 0:
+            return bk
+    return None
+
+
+def flash_decode(q, k, v, t, *, window: Optional[int] = None,
+                 scale: Optional[float] = None,
+                 block_k: Optional[int] = None,
+                 interpret: Optional[bool] = None):
+    """One decode position: q (B, 1, H, D) against caches k/v
+    (B, capacity, H_kv, D) with the ``pos <= t`` (and optional
+    sliding-``window``) mask applied in-kernel. Returns (B, 1, H, D).
+    ``t`` may be a traced scalar (it rides scalar prefetch into the
+    index maps). Capacity must be divisible by ``block_k``."""
+    b, tq, h, d = q.shape
+    enforce(tq == 1, "flash_decode takes one query position, got %s",
+            tq)
+    cap, kv_h = k.shape[1], k.shape[2]
+    enforce(h % kv_h == 0, "heads %s not divisible by kv heads %s", h,
+            kv_h)
+    enforce(window is None or window >= 1,
+            "window must be >= 1, got %s", window)
+    block_k = block_k or decode_block_k(cap)
+    enforce(block_k is not None and cap % block_k == 0,
+            "capacity %s not divisible by a supported block (%s)", cap,
+            block_k)
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    n_j = cap // block_k
+    qh = q[:, 0]                                      # (B, H, D)
+    t_arr = jnp.full((1,), t, jnp.int32)
+
+    def kv_imap(b_, j, t_):
+        jj = jnp.minimum(j, t_[0] // block_k)
+        if window is not None:
+            jj = jnp.maximum(
+                jj, jnp.maximum(t_[0] - window + 1, 0) // block_k)
+        return (b_, jj, 0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, window=window, block_k=block_k,
+        n_j=n_j, nheads=h, kv_heads=kv_h)
+    qo_spec = pl.BlockSpec((1, h, d), lambda b_, j, t_: (b_, 0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n_j),
+            in_specs=[
+                qo_spec,
+                pl.BlockSpec((1, block_k, kv_h, d), kv_imap),
+                pl.BlockSpec((1, block_k, kv_h, d), kv_imap),
+            ],
+            out_specs=qo_spec,
+            scratch_shapes=[
+                _scratch((h, d), jnp.float32),
+                _scratch((h, 128), jnp.float32),
+                _scratch((h, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(t_arr, qh, k, v)
+    return out[:, None]
