@@ -233,7 +233,12 @@ def decode_flash_ok(capacity: int, d: int) -> bool:
         from .pallas.flash_decode import decode_block_k
     except Exception:  # kernel unavailable -> XLA mask path
         return False
-    return d in _FLASH_HEAD_DIMS and decode_block_k(capacity) is not None
+    if d not in _FLASH_HEAD_DIMS or decode_block_k(capacity) is None:
+        return False
+    from .pallas.tuning import decode_key, get_tuned
+
+    tuned = get_tuned(decode_key(capacity, d))
+    return tuned is None or tuned.get("use_flash", True)
 
 
 def _flash_ok(q, k, causal: bool = False, window=None) -> bool:

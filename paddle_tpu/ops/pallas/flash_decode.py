@@ -102,9 +102,18 @@ def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-def decode_block_k(capacity: int) -> Optional[int]:
-    """Largest supported kv block dividing the cache capacity (None =
-    shape ineligible for the kernel)."""
+def decode_block_k(capacity: int, d: Optional[int] = None) -> Optional[int]:
+    """kv block for a cache capacity: the on-chip tuned winner when the
+    table has one (tools/pallas_tune.py --decode), else the largest
+    supported divisor. None = shape ineligible for the kernel."""
+    if d is not None:
+        from .tuning import decode_key, get_tuned
+
+        tuned = get_tuned(decode_key(capacity, d))
+        if tuned is not None:
+            bk = tuned.get("block_k")
+            if bk and capacity % bk == 0:
+                return bk
     for bk in (DEFAULT_DECODE_BLOCK_K, 128, 64):
         if capacity % bk == 0:
             return bk
@@ -128,7 +137,7 @@ def flash_decode(q, k, v, t, *, window: Optional[int] = None,
             kv_h)
     enforce(window is None or window >= 1,
             "window must be >= 1, got %s", window)
-    block_k = block_k or decode_block_k(cap)
+    block_k = block_k or decode_block_k(cap, d)
     enforce(block_k is not None and cap % block_k == 0,
             "capacity %s not divisible by a supported block (%s)", cap,
             block_k)

@@ -74,6 +74,13 @@ def attention_key(tq: int, tk: int, d: int, causal: bool,
             f"{'causal' if causal else 'full'}")
 
 
+def decode_key(capacity: int, d: int, kind: Optional[str] = None) -> str:
+    """Flash-decode bucket: capacity x head_dim (t varies at runtime
+    inside one compiled loop, heads only change the tiny row count)."""
+    return (f"flash_decode|{kind or _device_kind()}|"
+            f"cap{_pow2_bucket(capacity)}|d{d}")
+
+
 def matmul_key(m: int, n: int, k: int, kind: Optional[str] = None) -> str:
     return (f"quant_matmul|{kind or _device_kind()}|"
             f"m{_pow2_bucket(m)}|n{_pow2_bucket(n)}|k{_pow2_bucket(k)}")

@@ -113,7 +113,12 @@ def test_generate_rides_kernel_and_matches(monkeypatch):
                       intermediate_size=512, max_position=64)
     m = G.GPTForCausalLM(cfg).eval()
     prompt = jnp.asarray(RNG.integers(0, 256, (2, 4)))
-    want = m.greedy_decode(prompt, 24)           # XLA mask path
+    # baseline with the kernel forced OFF — on a TPU backend the gate
+    # passes without force_flash, and a kernel-vs-kernel comparison
+    # would vacuously pass
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(A, "decode_flash_ok", lambda *a: False)
+        want = m.greedy_decode(prompt, 24)       # XLA mask path
 
     calls = {"n": 0}
     real = A._get_flash_decode()
@@ -141,7 +146,9 @@ def test_window_decode_through_model(monkeypatch):
                       attn_window=16)
     m = G.GPTForCausalLM(cfg).eval()
     prompt = jnp.asarray(RNG.integers(0, 256, (2, 4)))
-    want = m.greedy_decode(prompt, 32)
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(A, "decode_flash_ok", lambda *a: False)
+        want = m.greedy_decode(prompt, 32)       # XLA mask path
     with A.force_flash():
         got = m.generate(prompt, 32, temperature=0.0)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
@@ -174,7 +181,9 @@ def test_nmt_cached_decode_rides_kernel(monkeypatch):
                        max_len=64, dropout=0.0)
     m = TR.TransformerNMT(cfg).eval()
     src = jnp.asarray(RNG.integers(3, 128, (2, 16)))
-    want = m.greedy_decode_cached(src, max_len=64)
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(A, "decode_flash_ok", lambda *a: False)
+        want = m.greedy_decode_cached(src, max_len=64)  # XLA mask path
 
     calls = {"n": 0}
     real = A._get_flash_decode()
@@ -188,3 +197,25 @@ def test_nmt_cached_decode_rides_kernel(monkeypatch):
         got = m.greedy_decode_cached(src, max_len=64)
     assert calls["n"] > 0, "cached decode did not ride the kernel"
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tuned_table_drives_block_and_dispatch():
+    """A decode tuning entry picks the kernel's block_k, and a measured
+    use_flash=False verdict vetoes dispatch (same contract as the
+    training kernel's table)."""
+    from paddle_tpu.ops.pallas import tuning
+    from paddle_tpu.ops.pallas.flash_decode import decode_block_k
+
+    key = tuning.decode_key(512, 64)
+    try:
+        tuning.set_tuned(key, {"block_k": 64, "use_flash": True},
+                         persist=False)
+        assert decode_block_k(512, 64) == 64
+        with A.force_flash():
+            assert A.decode_flash_ok(512, 64)
+        tuning.set_tuned(key, {"use_flash": False}, persist=False)
+        assert decode_block_k(512, 64) == 256  # fallback default
+        with A.force_flash():
+            assert not A.decode_flash_ok(512, 64)
+    finally:
+        tuning.reset_cache()

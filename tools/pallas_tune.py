@@ -35,6 +35,9 @@ GEMM_TILES = [128, 256, 512]
 DEFAULT_ATTN = [(32, 128, 12, 64), (8, 512, 12, 64), (4, 2048, 12, 64),
                 (2, 2048, 16, 128), (64, 64, 8, 64)]
 DEFAULT_GEMM = [(512, 768, 768), (2048, 3072, 768), (4096, 30528, 768)]
+# decode: GPT-small serving cache (cap 2048, GQA 12q/4kv d64) + the NMT
+# decode cache (cap 64)
+DEFAULT_DECODE = [(16, 2048, 12, 4, 64), (32, 64, 8, 8, 64)]
 
 
 def _fence(out):
@@ -163,6 +166,65 @@ def tune_attention(b, t, h, d, causal, dry_run=False):
     return entry
 
 
+def tune_decode(b, cap, h, kv, d, dry_run=False):
+    """Flash-decode block sweep: one cached-decode position (traced
+    cursor, as production decodes run it) at t = cap/2 and t = cap-1 —
+    the average and worst live range — against the XLA masked fallback.
+    Records block_k + use_flash under the decode key."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import xla_attention
+    from paddle_tpu.ops.pallas import tuning
+    from paddle_tpu.ops.pallas.flash_decode import flash_decode
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d))
+                    .astype(np.float32)).astype(jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(b, cap, kv, d))
+                    .astype(np.float32)).astype(jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(b, cap, kv, d))
+                    .astype(np.float32)).astype(jnp.bfloat16)
+    ts = (cap // 2, cap - 1)
+
+    cand = [bk for bk in (64, 128, 256, 512) if cap % bk == 0]
+    results = []
+    for bk in cand:
+        try:
+            f = jax.jit(lambda q, k, v, t, _bk=bk: flash_decode(
+                q, k, v, t, block_k=_bk, interpret=False))
+            ms = sum(_time(f, q, k, v, t) for t in ts)
+            results.append((ms, bk))
+            print(f"  flash decode bk={bk}: {ms*1e3:.3f}ms")
+        except Exception as e:
+            print(f"  flash decode bk={bk}: FAILED "
+                  f"({type(e).__name__}: {str(e)[:120]})")
+    best = min(results) if results else None
+
+    def xla_decode(q, k, v, t):
+        keep = (jnp.arange(cap) <= t)[None, None, None, :]
+        return xla_attention(q, k, v, mask=jnp.broadcast_to(
+            keep, (b, 1, 1, cap)))
+
+    xf = jax.jit(xla_decode)
+    x_ms = sum(_time(xf, q, k, v, t) for t in ts)
+    print(f"  xla masked fallback: {x_ms*1e3:.3f}ms")
+
+    key = tuning.decode_key(cap, d)
+    if best is None:
+        entry = {"use_flash": False, "xla_ms": round(x_ms * 1e3, 4),
+                 "note": "no decode block compiled"}
+    else:
+        entry = {"block_k": best[1],
+                 "use_flash": bool(best[0] < x_ms),
+                 "flash_ms": round(best[0] * 1e3, 4),
+                 "xla_ms": round(x_ms * 1e3, 4)}
+    print(f"  -> {key}: {entry}")
+    if not dry_run:
+        tuning.set_tuned(key, entry)
+    return entry
+
+
 def tune_matmul(m, n, k, dry_run=False):
     import jax
     import jax.numpy as jnp
@@ -218,6 +280,9 @@ def main():
                     metavar="B,T,H,D", help="attention shape to tune")
     ap.add_argument("--matmul", action="append", default=None,
                     metavar="M,N,K", help="int8 GEMM shape to tune")
+    ap.add_argument("--decode", action="append", default=None,
+                    metavar="B,CAP,H,KV,D",
+                    help="flash-decode shape to tune")
     ap.add_argument("--causal", action="store_true")
     ap.add_argument("--dry-run", action="store_true")
     ap.add_argument("--allow-cpu", action="store_true",
@@ -243,11 +308,13 @@ def main():
         return 2
 
     # an explicit request for one family suppresses the other's defaults
-    explicit = bool(args.attention or args.matmul)
+    explicit = bool(args.attention or args.matmul or args.decode)
     attn = ([tuple(map(int, s.split(","))) for s in args.attention]
             if args.attention else ([] if explicit else DEFAULT_ATTN))
     gemm = ([tuple(map(int, s.split(","))) for s in args.matmul]
             if args.matmul else ([] if explicit else DEFAULT_GEMM))
+    dec = ([tuple(map(int, s.split(","))) for s in args.decode]
+           if args.decode else ([] if explicit else DEFAULT_DECODE))
     causal_set = [args.causal] if args.attention else [False, True]
 
     for (b, t, h, d) in attn:
@@ -258,6 +325,10 @@ def main():
     for (m, n, k) in gemm:
         print(f"tuning int8 gemm m={m} n={n} k={k} on {backend}")
         tune_matmul(m, n, k, dry_run=args.dry_run)
+    for (b, cap, h, kv, d) in dec:
+        print(f"tuning flash decode b={b} cap={cap} h={h} kv={kv} "
+              f"d={d} on {backend}")
+        tune_decode(b, cap, h, kv, d, dry_run=args.dry_run)
     return 0
 
 
