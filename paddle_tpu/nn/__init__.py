@@ -7,6 +7,8 @@ from .layers import (GELU, RNN, BatchNorm, BilinearTensorProduct, Conv2D,
                      GRUCell, LayerNorm, Linear, LSTMCell, MultiHeadAttention,
                      Pool2D, PRelu, ReLU, RMSNorm, Sigmoid, Softmax,
                      SpectralNorm, Tanh)
+from .lora import (LoRALinear, apply_lora, lora_parameters,
+                   merge_lora)
 from .moe import SwitchFFN
 from .rnn_layers import GRU, LSTM
 from .sampling_layers import NCE, HSigmoid
@@ -23,6 +25,7 @@ __all__ = [
     "Pool2D", "PRelu", "ReLU", "RMSNorm", "Sigmoid", "Softmax",
     "SpectralNorm", "Tanh",
     "GRU", "LSTM", "NCE", "HSigmoid", "SwitchFFN",
+    "LoRALinear", "apply_lora", "lora_parameters", "merge_lora",
     "FeedForward", "LearnedPositionalEmbedding", "PositionalEncoding",
     "TransformerDecoder", "TransformerDecoderLayer", "TransformerEncoder",
     "TransformerEncoderLayer",

@@ -1,0 +1,164 @@
+"""LoRA — low-rank adaptation for parameter-efficient fine-tuning.
+
+out = x @ W_frozen + (alpha/r) * dropout(x) @ A @ B, with A (in, r)
+normal-initialized and B (r, out) zero-initialized, so an adapted model
+is EXACTLY the base model at step 0 and only (in+out)*r values train
+per wrapped projection.
+
+Framework-native shape: ``apply_lora`` rewrites Linear sublayers in
+place the way quant.quantize_model wraps quantizable layers; the frozen
+base weight/bias move from params to BUFFERS, so the trainable
+dict (``named_parameters``) is exactly the adapter set plus whatever
+was never wrapped — a Trainer or a hand-rolled value_and_grad sees only
+what should move, and the frozen weights still ride functional_call /
+jit donation as buffers instead of being baked into the executable as
+constants. ``merge_lora`` folds A@B back into plain Linears for
+serving/export.
+
+Green-field vs the reference (its fine-tuning story is full-parameter
+training; nearest spirit: the slim distill/prune package,
+/root/reference/python/paddle/fluid/contrib/slim/ — adapt a big model
+cheaply instead of retraining it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import initializer as I
+from ..core.dtypes import get_policy
+from ..core.enforce import enforce
+from .layer import Layer
+from .layers import Dropout, Linear, _apply_act
+
+
+class LoRALinear(Layer):
+    """A Linear with its weight frozen (buffer) plus a trainable
+    low-rank delta. Drop-in: same forward contract (bias, act,
+    AMP policy) as the Linear it wraps."""
+
+    def __init__(self, inner: Linear, r: int,
+                 alpha: Optional[float] = None, dropout: float = 0.0):
+        super().__init__()
+        enforce(isinstance(inner, Linear),
+                "LoRALinear wraps nn.Linear, got %s",
+                type(inner).__name__)
+        enforce(r >= 1, "rank must be >= 1, got %s", r)
+        self.in_features = inner.in_features
+        self.out_features = inner.out_features
+        self.act = inner.act
+        self.has_bias = inner.has_bias
+        self.r = r
+        self.scale = float(alpha if alpha is not None else r) / r
+        # frozen base: buffers, not params — out of the trainable dict,
+        # still threaded through functional_call/checkpoints
+        self.register_buffer("weight", inner.weight)
+        if inner.has_bias:
+            self.register_buffer("bias", inner.bias)
+        self.drop = Dropout(dropout)
+        self.create_parameter("lora_a", (self.in_features, r), None,
+                              I.Normal(scale=0.02))
+        self.create_parameter("lora_b", (r, self.out_features), None,
+                              I.Constant(0.0))
+
+    def forward(self, x):
+        pol = get_policy()
+        xc = pol.cast_to_compute(x)
+        out = jnp.matmul(xc, pol.cast_to_compute(self.weight))
+        delta = jnp.matmul(
+            jnp.matmul(pol.cast_to_compute(self.drop(x)),
+                       pol.cast_to_compute(self.lora_a)),
+            pol.cast_to_compute(self.lora_b))
+        out = out + self.scale * delta
+        if self.has_bias:
+            out = out + pol.cast_to_compute(self.bias)
+        return _apply_act(pol.cast_to_output(out), self.act)
+
+    def merged_weight(self):
+        """W + (alpha/r) A@B in the base weight's dtype."""
+        delta = (self.lora_a.astype(jnp.float32)
+                 @ self.lora_b.astype(jnp.float32))
+        return (self.weight.astype(jnp.float32)
+                + self.scale * delta).astype(self.weight.dtype)
+
+    def to_linear(self) -> Linear:
+        """A plain Linear with the adapter folded in (serving/export)."""
+        # constant init: the weight is overwritten on the next line, and
+        # a Xavier draw here would both waste work and advance the
+        # global PRNG stream once per merged layer
+        lin = Linear(self.in_features, self.out_features,
+                     bias_attr=self.has_bias, act=self.act,
+                     weight_init=I.Constant(0.0),
+                     bias_init=I.Constant(0.0))
+        lin._params["weight"] = self.merged_weight()
+        if self.has_bias:
+            lin._params["bias"] = self.bias
+        return lin
+
+
+def apply_lora(model: Layer, r: int, alpha: Optional[float] = None,
+               dropout: float = 0.0,
+               targets: Optional[Sequence[str]] = None,
+               predicate: Optional[Callable[[str, Layer], bool]] = None,
+               ) -> List[str]:
+    """Wrap matching Linear sublayers of ``model`` in place; returns the
+    wrapped paths. ``targets``: attribute-name suffixes to adapt (e.g.
+    ("q_proj", "v_proj") — the classic attention recipe); None adapts
+    every Linear. ``predicate(path, layer)`` further filters. Do this
+    BEFORE snapshotting params: the trainable dict shrinks to the
+    adapters (+ never-wrapped layers); frozen weights become buffers."""
+    wrapped: List[str] = []
+
+    def rewrite(layer: Layer, prefix: str):
+        for name, sub in list(layer._sublayers.items()):
+            path = f"{prefix}{name}"
+            if isinstance(sub, LoRALinear):
+                continue
+            if (isinstance(sub, Linear)
+                    and (targets is None
+                         or any(name == t or name.endswith(t)
+                                for t in targets))
+                    and (predicate is None or predicate(path, sub))):
+                layer._sublayers[name] = LoRALinear(sub, r, alpha,
+                                                    dropout)
+                object.__setattr__(layer, name, layer._sublayers[name])
+                wrapped.append(path)
+            else:
+                rewrite(sub, f"{path}.")
+
+    enforce(not isinstance(model, Linear),
+            "apply_lora rewrites sublayers; wrap a bare Linear with "
+            "LoRALinear directly")
+    rewrite(model, "")
+    enforce(wrapped, "apply_lora matched no Linear sublayers "
+            "(targets=%s)", targets)
+    return wrapped
+
+
+def lora_parameters(model: Layer) -> dict:
+    """The trainable adapter subset of ``model.named_parameters()`` —
+    what the fine-tuning optimizer should see."""
+    return {k: v for k, v in model.named_parameters().items()
+            if k.endswith("lora_a") or k.endswith("lora_b")}
+
+
+def merge_lora(model: Layer) -> List[str]:
+    """Fold every LoRALinear back into a plain Linear in place (the
+    adapter disappears into the weight; forward is byte-for-byte the
+    adapted model's in eval mode). Returns the merged paths."""
+    merged: List[str] = []
+
+    def rewrite(layer: Layer, prefix: str):
+        for name, sub in list(layer._sublayers.items()):
+            path = f"{prefix}{name}"
+            if isinstance(sub, LoRALinear):
+                layer._sublayers[name] = sub.to_linear()
+                object.__setattr__(layer, name, layer._sublayers[name])
+                merged.append(path)
+            else:
+                rewrite(sub, f"{path}.")
+
+    rewrite(model, "")
+    return merged

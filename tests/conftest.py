@@ -111,6 +111,8 @@ MID_PATTERNS = [
     "test_gpt.py::test_greedy_decode_matches_full_recompute",
     "test_speculative.py::test_forward_chunk_matches_sequential_steps",
     "test_pallas_decode.py::test_matches_oracle_across_cursor",
+    "test_lora.py::test_trainable_subset_and_frozen_base",
+    "test_lora.py::test_merge_matches_adapted_forward",
     "test_pallas_decode.py::test_generate_rides_kernel_and_matches",
     "test_speculative.py::test_greedy_spec_equals_target_greedy",
     "test_gpt.py::test_gqa_flash_path_engages",

@@ -1,0 +1,115 @@
+"""LoRA adapters (nn/lora.py): frozen-base low-rank fine-tuning that is
+exactly the base model at init, trains only the adapter subset, and
+merges back to plain Linears for serving. Green-field (the reference's
+cheap-adaptation spirit is contrib/slim distill/prune)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models import gpt as G
+
+
+def _model():
+    pt.seed(0)
+    return G.GPTForCausalLM(G.GPTConfig.tiny()).eval()
+
+
+def _ids(b=2, t=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, 512, (b, t)))
+
+
+def test_init_is_exactly_base_model():
+    m = _model()
+    ids = _ids()
+    base = m(ids)
+    wrapped = nn.apply_lora(m, r=4, targets=("q_proj", "v_proj"))
+    assert len(wrapped) == 4  # 2 layers x (q, v)
+    np.testing.assert_array_equal(np.asarray(m(ids)), np.asarray(base))
+
+
+def test_trainable_subset_and_frozen_base():
+    m = _model()
+    nn.apply_lora(m, r=4, targets=("q_proj", "v_proj"))
+    lp = nn.lora_parameters(m)
+    assert len(lp) == 8 and all(
+        k.endswith(("lora_a", "lora_b")) for k in lp)
+    # the frozen projection weights moved OUT of the trainable dict
+    assert not any("q_proj.weight" in k for k in m.named_parameters())
+    assert any(k.endswith("q_proj.weight") for k in m.named_buffers())
+
+    ids = _ids(seed=1)
+    opt = optimizer.Adam(1e-2)
+    state = opt.init(lp)
+    buffers = m.named_buffers()
+    frozen_before = {k: np.asarray(v) for k, v in buffers.items()
+                     if k.endswith("weight")}
+
+    @jax.jit
+    def step(lp, state):
+        def loss(p):
+            out, _ = m.functional_call(p, ids, training=True,
+                                       method="forward_loss")
+            return out
+
+        l, g = jax.value_and_grad(loss)(lp)
+        lp, state = opt.apply(lp, g, state)
+        return l, lp, state
+
+    losses = []
+    for _ in range(6):
+        l, lp, state = step(lp, state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+    # B started at zero, must have moved; base weights must not have
+    assert any(np.abs(np.asarray(v)).max() > 0 for k, v in lp.items()
+               if k.endswith("lora_b"))
+    for k, v in m.named_buffers().items():
+        if k in frozen_before:
+            np.testing.assert_array_equal(np.asarray(v),
+                                          frozen_before[k])
+
+
+def test_merge_matches_adapted_forward():
+    m = _model()
+    nn.apply_lora(m, r=4)
+    # push the adapters off zero so the merge actually carries signal
+    from paddle_tpu.nn.layer import _stable_hash
+
+    pt.seed(3)
+    params = m.named_parameters()
+    for k in params:
+        if k.endswith(("lora_a", "lora_b")):
+            params[k] = params[k] + 0.05 * jax.random.normal(
+                jax.random.key(_stable_hash(k)), params[k].shape)
+    m.set_parameters(params)
+    ids = _ids(seed=2)
+    want = m(ids)
+    merged = nn.merge_lora(m)
+    assert merged and not any(
+        isinstance(s, nn.LoRALinear) for _, s in m.named_sublayers())
+    np.testing.assert_allclose(np.asarray(m(ids)), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    # merged model has NO adapter params left
+    assert not nn.lora_parameters(m)
+
+
+def test_generate_still_works_after_adapting():
+    m = _model()
+    nn.apply_lora(m, r=2, targets=("q_proj",))
+    out = m.generate(_ids(b=1, t=4, seed=4), 12, temperature=0.0)
+    assert out.shape == (1, 12)
+
+
+def test_typed_errors():
+    m = _model()
+    with pytest.raises(Exception, match="rank"):
+        nn.apply_lora(m, r=0)
+    with pytest.raises(Exception, match="matched no"):
+        nn.apply_lora(m, r=2, targets=("no_such_proj",))
+    with pytest.raises(Exception, match="wraps nn.Linear"):
+        nn.LoRALinear(nn.RMSNorm(8), r=2)

@@ -19,8 +19,8 @@ LOG="bench_all.log"
 run() { echo "\$ $*" | tee -a "$LOG"; "$@" 2>>"$LOG" | tee -a "$LOG"; }
 
 MODELS="mnist_mlp alexnet googlenet stacked_lstm vgg16 se_resnext50 \
-resnet50 bert_base bert_long bert_packed bert_moe transformer_nmt \
-deepfm deepfm_sparse"
+resnet50 bert_base bert_long bert_packed bert_moe gpt transformer_nmt \
+nmt_decode gpt_decode deepfm deepfm_sparse"
 
 echo "== model pass (bf16 defaults) ==" | tee -a "$LOG"
 for m in $MODELS; do
@@ -43,6 +43,7 @@ if [ "$MODE" = "full" ]; then
   run python bench.py --model stacked_lstm --batch-size 2048 --scan-unroll 8
   run python bench.py --model se_resnext50 --layout NCHW
   run python bench.py --model deepfm --steps-per-call 8
+  run python bench.py --model gpt_decode --gamma 4
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py
