@@ -606,6 +606,42 @@ def bench_nmt_decode(steps: int, batch_size: int, amp=None,
     return outer * batch_size * max_len / dt, "tokens/sec", {}
 
 
+def bench_vit(steps: int, batch_size: int, smoke: bool = False,
+              amp=None, layout: str = "NHWC"):
+    """ViT-B/16 @224 (models/vit.py — green-field next to the conv zoo;
+    ~17.6 GFLOP fwd/img lands almost entirely on the MXU as big
+    matmuls): supervised CE over random images. remat per block keeps
+    b128 activations in HBM."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import vit as V
+
+    pt.seed(0)
+    batch_size = _cap(batch_size, 8 if smoke else 128)
+    cfg = V.ViTConfig.tiny() if smoke else V.ViTConfig.base()
+    cfg.layout = layout
+    cfg.remat = not smoke
+    model = V.ViT(cfg)
+    rng = np.random.default_rng(0)
+
+    def make_batch(bs):
+        if layout == "NHWC":
+            shape = (bs, cfg.image_size, cfg.image_size,
+                     cfg.num_channels)
+        else:
+            shape = (bs, cfg.num_channels, cfg.image_size,
+                     cfg.image_size)
+        return (jnp.asarray(rng.normal(size=shape).astype(np.float32)),)
+
+    def loss_fn(logits, batch):
+        labels = jnp.asarray(
+            np.arange(logits.shape[0]) % cfg.num_classes)
+        return V.loss_fn(logits, labels)
+
+    return _train_bench(model, loss_fn, make_batch, steps, batch_size,
+                        amp=amp)
+
+
 def bench_gpt_decode(steps: int, batch_size: int, amp=None,
                      max_len: int = 128, gamma: int = 0,
                      smoke: bool = False):
@@ -949,6 +985,7 @@ MODELS = {
     "bert_packed": bench_bert_packed,
     "bert_moe": bench_bert_moe,
     "gpt": bench_gpt,
+    "vit": bench_vit,
     "bert_long": bench_bert_long,
     "transformer_nmt": bench_transformer_nmt,
     "nmt_decode": bench_nmt_decode,

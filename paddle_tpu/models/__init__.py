@@ -3,8 +3,8 @@
 
 from . import (alexnet, bert, deepfm, googlenet, gpt, mnist,
                recommender, resnet, se_resnext, speculative,
-               stacked_lstm, transformer, vgg)
+               stacked_lstm, transformer, vgg, vit)
 
 __all__ = ["alexnet", "bert", "deepfm", "googlenet", "gpt", "mnist",
            "recommender", "resnet", "se_resnext", "speculative",
-           "stacked_lstm", "transformer", "vgg"]
+           "stacked_lstm", "transformer", "vgg", "vit"]

@@ -1,0 +1,115 @@
+"""Vision Transformer (ViT-B/16-style) — the modern patch-attention
+vision family next to the conv zoo (green-field: the reference's vision
+story is the conv benchmark set, reference:
+benchmark/fluid/models/resnet.py, vgg.py, se_resnext.py; this family
+exists so a vision user scaling past convs finds the attention recipe
+assembled from the same pieces the language models use).
+
+TPU-first notes: patch embedding is ONE strided conv (stride = patch:
+an MXU-shaped (P*P*C, D) matmul per patch, NHWC default); the encoder
+is the shared nn.TransformerEncoder, so flash/remat/scan-layers/MoE and
+the tp/pp/dp parallel recipes apply unchanged. hidden/heads keep
+head_dim 64 and hidden a multiple of 128 for MXU tiling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializer as I
+from .. import nn
+from ..core.enforce import enforce
+from ..nn.layer import Layer
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    num_classes: int = 1000
+    dropout: float = 0.0
+    pool: str = "cls"            # "cls" | "mean"
+    layout: str = "NHWC"         # bench-sweepable like resnet
+    remat: bool = False
+    scan_layers: bool = False
+
+    @classmethod
+    def tiny(cls):
+        """For tests: 32px/8-patch, hidden 64, 2 layers."""
+        return cls(image_size=32, patch_size=8, hidden_size=64,
+                   num_layers=2, num_heads=4, intermediate_size=128,
+                   num_classes=10)
+
+    @classmethod
+    def base(cls):
+        """ViT-B/16 geometry (~86M params, ~17.6 GFLOP fwd @224)."""
+        return cls()
+
+
+class ViT(Layer):
+    """Patch conv -> [CLS] + learned positions -> pre-norm encoder ->
+    pooled head. ``forward(images)`` takes NHWC (B, H, W, C) by default
+    (NCHW with cfg.layout), returns (B, num_classes) logits."""
+
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        enforce(cfg.image_size % cfg.patch_size == 0,
+                "image %s not divisible by patch %s", cfg.image_size,
+                cfg.patch_size)
+        enforce(cfg.pool in ("cls", "mean"),
+                "pool must be 'cls' or 'mean', got %r", cfg.pool)
+        self.cfg = cfg
+        grid = cfg.image_size // cfg.patch_size
+        self.num_patches = grid * grid
+        self.patch_embed = nn.Conv2D(
+            cfg.num_channels, cfg.hidden_size, cfg.patch_size,
+            stride=cfg.patch_size, data_format=cfg.layout)
+        if cfg.pool == "cls":
+            self.create_parameter("cls_token", (1, 1, cfg.hidden_size),
+                                  None, I.Normal(scale=0.02))
+        n_tok = self.num_patches + (1 if cfg.pool == "cls" else 0)
+        self.create_parameter("pos_embed", (1, n_tok, cfg.hidden_size),
+                              None, I.Normal(scale=0.02))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.encoder = nn.TransformerEncoder(
+            cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+            cfg.intermediate_size, dropout=cfg.dropout,
+            activation="gelu", normalize_before=True,
+            remat=cfg.remat, scan_layers=cfg.scan_layers)
+        self.head = nn.Linear(cfg.hidden_size, cfg.num_classes)
+
+    def forward(self, images):
+        cfg = self.cfg
+        p = self.patch_embed(images)
+        if cfg.layout == "NHWC":
+            b, gh, gw, d = p.shape
+        else:
+            b, d, gh, gw = p.shape
+            p = jnp.transpose(p, (0, 2, 3, 1))
+        enforce(gh * gw == self.num_patches,
+                "got %sx%s patches for image %s/%s", gh, gw,
+                cfg.image_size, cfg.patch_size)
+        x = p.reshape(b, self.num_patches, cfg.hidden_size)
+        if cfg.pool == "cls":
+            cls = jnp.broadcast_to(self.cls_token,
+                                   (b, 1, cfg.hidden_size))
+            x = jnp.concatenate([cls.astype(x.dtype), x], axis=1)
+        x = self.drop(x + self.pos_embed.astype(x.dtype))
+        x = self.encoder(x)
+        pooled = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
+        return self.head(pooled)
+
+
+def loss_fn(logits, labels):
+    """Mean CE over (B, num_classes) logits."""
+    from ..ops import loss as L
+
+    return jnp.mean(L.softmax_with_cross_entropy(logits, labels))

@@ -19,7 +19,7 @@ LOG="bench_all.log"
 run() { echo "\$ $*" | tee -a "$LOG"; "$@" 2>>"$LOG" | tee -a "$LOG"; }
 
 MODELS="mnist_mlp alexnet googlenet stacked_lstm vgg16 se_resnext50 \
-resnet50 bert_base bert_long bert_packed bert_moe gpt transformer_nmt \
+resnet50 bert_base bert_long bert_packed bert_moe gpt vit transformer_nmt \
 nmt_decode gpt_decode deepfm deepfm_sparse"
 
 echo "== model pass (bf16 defaults) ==" | tee -a "$LOG"

@@ -172,6 +172,8 @@ item bench_bert_moe    1500 python bench.py --model bert_moe
 # decoder-only causal LM (r5 model family): RoPE+GQA+SwiGLU, seq 1024,
 # causal flash path — the modern long-context MFU row
 item bench_gpt         1800 python bench.py --model gpt
+# ViT-B/16 (r5 model family): patch-attention vision, MXU-dense
+item bench_vit         1500 python bench.py --model vit
 item tune_a128f        900  python tools/pallas_tune.py --attention 32,128,12,64
 item tune_a128c        900  python tools/pallas_tune.py --attention 32,128,12,64 --causal
 item tune_a512f        900  python tools/pallas_tune.py --attention 8,512,12,64
