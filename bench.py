@@ -644,7 +644,7 @@ def bench_vit(steps: int, batch_size: int, smoke: bool = False,
 
 def bench_gpt_decode(steps: int, batch_size: int, amp=None,
                      max_len: int = 128, gamma: int = 0,
-                     smoke: bool = False):
+                     weight_only: bool = False, smoke: bool = False):
     """GPT KV-cached decode throughput (tokens/sec, generated positions
     only). Default is greedy decode on the 12-layer small config.
     ``--gamma g`` > 0 switches to speculative decoding against a
@@ -671,6 +671,12 @@ def bench_gpt_decode(steps: int, batch_size: int, amp=None,
         max_len = min(max_len, 32)
     cfg.max_position = max_len + max(gamma, 0)
     model = G.GPTForCausalLM(cfg).eval()
+    if weight_only:
+        # W8A16: halve the weight HBM stream of the bandwidth-bound
+        # decode loop (logit accuracy pinned in tests/test_weight_only)
+        from paddle_tpu.quant import apply_weight_only_int8
+
+        apply_weight_only_int8(model)
     rng = np.random.default_rng(0)
     prompt_len = min(16, max_len // 2)
     prompt = jnp.asarray(
@@ -1033,7 +1039,7 @@ def run_config_fingerprint(metric: str, args, steps: int):
         "scan_layers": args.scan_layers, "scan_unroll": args.scan_unroll,
         "steps_per_call": args.steps_per_call, "vocab": args.vocab,
         "window": args.window, "kv_cache": args.kv_cache,
-        "gamma": args.gamma,
+        "gamma": args.gamma, "weight_only": args.weight_only,
         "layout": args.layout, "dp": args.dp, "infer": args.infer,
     }
     # None = knob not set; False values (e.g. --no-fused-ce) are REAL
@@ -1185,6 +1191,10 @@ def main():
     ap.add_argument("--window", type=int, default=None,
                     help="bert_long: sliding-window attention width "
                     "(O(T*W) local attention vs the O(T^2) default)")
+    ap.add_argument("--weight-only", dest="weight_only",
+                    action="store_true",
+                    help="gpt_decode: weight-only int8 (W8A16) on the "
+                    "model's matmuls")
     ap.add_argument("--gamma", type=int, default=None,
                     help="gpt_decode: speculative-decoding draft length "
                     "(0/unset = plain greedy decode)")
@@ -1246,6 +1256,10 @@ def main():
         # speculative decode is a different WORKLOAD (draft model in the
         # loop): its own history key per gamma
         metric += f"_g{args.gamma}"
+    if args.weight_only and "weight_only" in sig:
+        # same workload, different weight storage — own history key so
+        # the W8A16-vs-bf16 comparison stays visible
+        metric += "_w8"
     if "cached" in sig and not args.kv_cache:
         # same workload, different implementation — its own history key
         # so the cache-vs-recompute comparison stays visible
@@ -1354,6 +1368,8 @@ def main():
         kwargs["cached"] = args.kv_cache
     if args.gamma and "gamma" in sig:
         kwargs["gamma"] = args.gamma
+    if args.weight_only and "weight_only" in sig:
+        kwargs["weight_only"] = True
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call

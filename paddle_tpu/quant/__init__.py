@@ -9,11 +9,13 @@ from .ops import (MovingAverageState, RangeState, abs_max_scale, dequantize,
                   quantize_to_int, range_state_init)
 from .int8 import (Int8Conv2D, Int8Linear, int8_conv2d,
                    int8_linear, int8_swap)
+from .weight_only import WeightOnlyLinear, apply_weight_only_int8
 from .qat import (QuantConfig, QuantedLayer, calibrate, freeze,
                   quantize_model)
 
 __all__ = [
-    "MovingAverageState", "RangeState", "abs_max_scale", "dequantize",
+    "MovingAverageState", "RangeState", "WeightOnlyLinear",
+    "abs_max_scale", "apply_weight_only_int8", "dequantize",
     "fake_channel_wise_quantize_abs_max", "fake_quantize_abs_max",
     "fake_quantize_moving_average_abs_max", "fake_quantize_range_abs_max",
     "moving_average_abs_max_scale", "moving_average_state_init",

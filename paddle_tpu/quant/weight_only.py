@@ -1,0 +1,113 @@
+"""Weight-only int8 quantization (W8A16) — the LM-serving memory-
+bandwidth lever: weights store as per-output-channel symmetric int8
+(half of bf16, a quarter of fp32 HBM bytes) and dequantize in-register
+at matmul time (XLA fuses the convert+scale into the operand read), so
+the bandwidth-bound decode loop streams half the weight bytes while
+activations and accumulation stay high-precision.
+
+Different trade than quant/int8.py's full int8 execution (QAT/PTQ +
+int8 GEMM kernel): that path quantizes ACTIVATIONS too and needs
+calibration; this one is a pure post-training weight transform — no
+data, no retraining, accuracy within bf16 noise for typical LMs.
+
+Reference niche: the int8 serving capability family
+(/root/reference/paddle/fluid/inference/api/mkldnn_quantizer.cc role);
+weight-only is its modern decode-serving variant.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..core.dtypes import get_policy
+from ..core.enforce import enforce
+from ..nn.layer import Layer
+from ..nn.layers import Linear, _apply_act
+
+
+class WeightOnlyLinear(Layer):
+    """A Linear whose weight lives as int8 + per-out-channel fp32
+    scales (buffers — this is a serving transform, nothing trains).
+    Same forward contract (bias, act, AMP policy) as the Linear it
+    replaces."""
+
+    def __init__(self, inner: Linear):
+        super().__init__()
+        enforce(isinstance(inner, Linear),
+                "WeightOnlyLinear wraps nn.Linear, got %s",
+                type(inner).__name__)
+        self.in_features = inner.in_features
+        self.out_features = inner.out_features
+        self.act = inner.act
+        self.has_bias = inner.has_bias
+        from .ops import abs_max_scale, quantize_to_int
+
+        # the package-wide convention (quant/ops.py): scale = per-channel
+        # abs-max, int grid = round(w * 127 / scale), dequant = q *
+        # scale / 127 — so this buffer interoperates with
+        # quant.dequantize(q, scale, quant_axis=1)
+        w = inner.weight.astype(jnp.float32)          # (in, out)
+        scale = jnp.maximum(abs_max_scale(w, axis=1), 1e-8)
+        q = quantize_to_int(w, scale[None, :])
+        self.register_buffer("qweight", q)
+        self.register_buffer("scale", scale)
+        if inner.has_bias:
+            self.register_buffer("bias", inner.bias)
+
+    def forward(self, x):
+        pol = get_policy()
+        xc = pol.cast_to_compute(x)
+        # dequant in the compute dtype: int8 -> bf16 mul fuses into the
+        # matmul operand read; the int8 bytes are what HBM streams
+        w = (self.qweight.astype(xc.dtype)
+             * (self.scale / 127.0).astype(xc.dtype))
+        out = jnp.matmul(xc, w)
+        if self.has_bias:
+            out = out + pol.cast_to_compute(self.bias)
+        return _apply_act(pol.cast_to_output(out), self.act)
+
+    def dequantized_weight(self):
+        from .ops import dequantize
+
+        return dequantize(self.qweight, self.scale, quant_axis=1)
+
+
+def apply_weight_only_int8(model: Layer,
+                           targets: Optional[Sequence[str]] = None,
+                           predicate: Optional[
+                               Callable[[str, Layer], bool]] = None,
+                           min_features: int = 0) -> List[str]:
+    """Replace matching Linear sublayers with WeightOnlyLinear in place
+    (the quantize_model/apply_lora rewrite idiom); returns the wrapped
+    paths. ``targets``: attribute-name suffixes (None = every Linear);
+    ``min_features``: skip layers smaller than this on BOTH dims (tiny
+    heads gain nothing and lose the most precision)."""
+    wrapped: List[str] = []
+
+    def rewrite(layer: Layer, prefix: str):
+        for name, sub in list(layer._sublayers.items()):
+            path = f"{prefix}{name}"
+            if isinstance(sub, WeightOnlyLinear):
+                continue
+            if (isinstance(sub, Linear)
+                    and (targets is None
+                         or any(name == t or name.endswith(t)
+                                for t in targets))
+                    and max(sub.in_features,
+                            sub.out_features) >= min_features
+                    and (predicate is None or predicate(path, sub))):
+                layer._sublayers[name] = WeightOnlyLinear(sub)
+                object.__setattr__(layer, name, layer._sublayers[name])
+                wrapped.append(path)
+            else:
+                rewrite(sub, f"{path}.")
+
+    enforce(not isinstance(model, Linear),
+            "apply_weight_only_int8 rewrites sublayers; wrap a bare "
+            "Linear with WeightOnlyLinear directly")
+    rewrite(model, "")
+    enforce(wrapped, "apply_weight_only_int8 matched no Linear "
+            "sublayers (targets=%s)", targets)
+    return wrapped

@@ -150,6 +150,7 @@ item decode_nmt_full   1500 python bench.py --model nmt_decode --no-kv-cache
 # formula is 1 + accepted/round per target pass)
 item decode_gpt        1500 python bench.py --model gpt_decode
 item decode_gpt_spec   1500 python bench.py --model gpt_decode --gamma 4
+item decode_gpt_w8     1500 python bench.py --model gpt_decode --weight-only
 # NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
 # C++ predictor + PJRT C API (export runs off-chip: StableHLO is
 # portable; only the ptserve compile+run needs the chip)
