@@ -109,32 +109,13 @@ def apply_lora(model: Layer, r: int, alpha: Optional[float] = None,
     every Linear. ``predicate(path, layer)`` further filters. Do this
     BEFORE snapshotting params: the trainable dict shrinks to the
     adapters (+ never-wrapped layers); frozen weights become buffers."""
-    wrapped: List[str] = []
+    from .rewrite import rewrite_linears
 
-    def rewrite(layer: Layer, prefix: str):
-        for name, sub in list(layer._sublayers.items()):
-            path = f"{prefix}{name}"
-            if isinstance(sub, LoRALinear):
-                continue
-            if (isinstance(sub, Linear)
-                    and (targets is None
-                         or any(name == t or name.endswith(t)
-                                for t in targets))
-                    and (predicate is None or predicate(path, sub))):
-                layer._sublayers[name] = LoRALinear(sub, r, alpha,
-                                                    dropout)
-                object.__setattr__(layer, name, layer._sublayers[name])
-                wrapped.append(path)
-            else:
-                rewrite(sub, f"{path}.")
-
-    enforce(not isinstance(model, Linear),
-            "apply_lora rewrites sublayers; wrap a bare Linear with "
-            "LoRALinear directly")
-    rewrite(model, "")
-    enforce(wrapped, "apply_lora matched no Linear sublayers "
-            "(targets=%s)", targets)
-    return wrapped
+    return rewrite_linears(
+        model, lambda lin: LoRALinear(lin, r, alpha, dropout),
+        targets=targets, predicate=predicate,
+        skip=lambda sub: isinstance(sub, LoRALinear),
+        what="apply_lora")
 
 
 def lora_parameters(model: Layer) -> dict:

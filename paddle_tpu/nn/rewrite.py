@@ -1,0 +1,50 @@
+"""Shared in-place Linear-rewrite traversal — ONE definition of the
+walk that apply_lora (nn/lora.py) and apply_weight_only_int8
+(quant/weight_only.py) both wrap: recursive _sublayers descent,
+attribute-suffix targeting, predicate filter, re-binding via
+object.__setattr__ (the quantize_model idiom, quant/qat.py)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from ..core.enforce import enforce
+from .layer import Layer
+from .layers import Linear
+
+
+def rewrite_linears(model: Layer, make: Callable[[Linear], Layer],
+                    targets: Optional[Sequence[str]] = None,
+                    predicate: Optional[
+                        Callable[[str, Layer], bool]] = None,
+                    skip: Optional[Callable[[Layer], bool]] = None,
+                    what: str = "rewrite_linears") -> List[str]:
+    """Replace matching Linear sublayers of ``model`` with
+    ``make(linear)`` in place; returns the rewritten paths.
+    ``targets``: attribute-name suffixes (None = every Linear);
+    ``predicate(path, layer)`` filters further; ``skip(layer)`` guards
+    against double-wrapping (e.g. an already-wrapped type)."""
+    done: List[str] = []
+
+    def walk(layer: Layer, prefix: str):
+        for name, sub in list(layer._sublayers.items()):
+            path = f"{prefix}{name}"
+            if skip is not None and skip(sub):
+                continue
+            if (isinstance(sub, Linear)
+                    and (targets is None
+                         or any(name == t or name.endswith(t)
+                                for t in targets))
+                    and (predicate is None or predicate(path, sub))):
+                layer._sublayers[name] = make(sub)
+                object.__setattr__(layer, name, layer._sublayers[name])
+                done.append(path)
+            else:
+                walk(sub, f"{path}.")
+
+    enforce(not isinstance(model, Linear),
+            "%s rewrites sublayers; wrap a bare Linear directly", what)
+    walk(model, "")
+    enforce(done, "%s matched no Linear sublayers (targets=%s)", what,
+            targets)
+    return done

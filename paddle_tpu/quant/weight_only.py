@@ -84,30 +84,13 @@ def apply_weight_only_int8(model: Layer,
     paths. ``targets``: attribute-name suffixes (None = every Linear);
     ``min_features``: skip layers smaller than this on BOTH dims (tiny
     heads gain nothing and lose the most precision)."""
-    wrapped: List[str] = []
+    from ..nn.rewrite import rewrite_linears
 
-    def rewrite(layer: Layer, prefix: str):
-        for name, sub in list(layer._sublayers.items()):
-            path = f"{prefix}{name}"
-            if isinstance(sub, WeightOnlyLinear):
-                continue
-            if (isinstance(sub, Linear)
-                    and (targets is None
-                         or any(name == t or name.endswith(t)
-                                for t in targets))
-                    and max(sub.in_features,
-                            sub.out_features) >= min_features
-                    and (predicate is None or predicate(path, sub))):
-                layer._sublayers[name] = WeightOnlyLinear(sub)
-                object.__setattr__(layer, name, layer._sublayers[name])
-                wrapped.append(path)
-            else:
-                rewrite(sub, f"{path}.")
+    def big_enough(path, sub):
+        return (max(sub.in_features, sub.out_features) >= min_features
+                and (predicate is None or predicate(path, sub)))
 
-    enforce(not isinstance(model, Linear),
-            "apply_weight_only_int8 rewrites sublayers; wrap a bare "
-            "Linear with WeightOnlyLinear directly")
-    rewrite(model, "")
-    enforce(wrapped, "apply_weight_only_int8 matched no Linear "
-            "sublayers (targets=%s)", targets)
-    return wrapped
+    return rewrite_linears(
+        model, WeightOnlyLinear, targets=targets, predicate=big_enough,
+        skip=lambda sub: isinstance(sub, WeightOnlyLinear),
+        what="apply_weight_only_int8")
