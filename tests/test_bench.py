@@ -171,7 +171,8 @@ def test_run_config_fingerprint_identity():
                     amp="mixed_bf16", fused_ce=True, remat=None,
                     scan_layers=False, scan_unroll=None,
                     steps_per_call=None, vocab=None, window=None,
-                    kv_cache=True, layout=None, dp=1, infer=False)
+                    kv_cache=True, layout=None, dp=1, infer=False,
+                    gamma=None, weight_only=False)
         base.update(kw)
         return argparse.Namespace(**base)
 
