@@ -70,6 +70,7 @@ SLOW_PATTERNS = [
     "test_fused_loss.py::test_bert_fused_head_matches_naive",
     "test_checkpoint_scale.py",
     "test_moe.py::test_bert_moe_composes_with_tp_on_one_mesh",
+    "test_examples.py",
 ]
 
 # mid tier = smoke + one representative per DEEP subsystem (pallas

@@ -4,9 +4,11 @@
 # The relay's only-ever device windows were 17 and 8 minutes; the full
 # fill list budgets 600-1500 s PER item, so a repeat of those windows
 # would capture ~2 items and still no post-fix MFU table. This sweep is
-# sized so ONE short window yields the complete 10-model table: real
+# sized so ONE short window yields the (r5: 12-)model table: real
 # headline shapes, reduced step counts, a HARD 60 s budget per model,
-# total <= 10 min. Runs are NON-smoke so they record into
+# total <= ~12 min worst case — a shorter window completes on the NEXT
+# pass via the per-model resume markers with the compile cache warm.
+# Runs are NON-smoke so they record into
 # BENCH_HISTORY.json (with r5 metadata: ts/device/config_hash). Because
 # --steps 24 forks the workload fingerprint, each number lands under its
 # own "<metric>@<hash>" VARIANT key — the bare headline keys stay
@@ -35,7 +37,10 @@ mkdir -p "$OUT" "$DONE"
 # CLI flag needed). --steps 24 keeps real shapes but caps the timed
 # loop; throughput is steady-state post-warmup so the reduced count only
 # adds noise, which the full benches behind this item later wash out.
-MODELS="mnist_mlp resnet50 bert_base vgg16 se_resnext50 transformer_nmt stacked_lstm deepfm deepfm_sparse bert_long"
+# r5 adds the two new MXU-dense families (gpt seq-1024 causal LM, ViT
+# B/16) — 12 models, still inside a ~12-minute window with the compile
+# cache warm
+MODELS="mnist_mlp resnet50 bert_base vgg16 se_resnext50 transformer_nmt stacked_lstm deepfm deepfm_sparse bert_long gpt vit"
 missing=0
 for m in $MODELS; do
   tag="fast_$m"
