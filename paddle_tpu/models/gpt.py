@@ -175,22 +175,17 @@ class GPTForCausalLM(Layer):
             h.reshape(b * t, d), w, None, labels.reshape(-1),
             chunk=vocab_chunk, ignore_index=ignore_index)
 
-    def _chunk_logits(self, toks, caches, t0, head: bool = True,
-                      decode_kernel: bool = False):
-        """S KV-cached positions in one pass: embed ``toks`` (B, S), run
-        every block's forward_chunk at cache indices [t0, t0+S), return
-        ((B, S, V) logits, new caches). The speculative-decoding target
-        scores its gamma+1 candidates with one call. ``head=False``
-        skips the (S, V) head projection and returns (None, caches) —
-        the cache-only prefill path (XLA would DCE the dead matmul
-        under jit, but eager callers pay it for real)."""
-        x = self.embed(toks)                      # (B, S, D)
+    def _cached_blocks(self, x, caches, attn_step, head: bool = True):
+        """ONE definition of the cached-decode block composition
+        (norm1 -> attn -> residual -> ffn -> norm_f@head) shared by the
+        chunk, single-step, and per-row-cursor entries — the attention
+        flavor is the only thing that varies. ``head=False`` skips the
+        (S, V) head projection (cache-only prefill; XLA would DCE the
+        dead matmul under jit, but eager callers pay it for real)."""
         new_caches = []
         for blk, (ck, cv) in zip(self.blocks, caches):
             h = blk.norm1(x)
-            a, ck, cv = blk.self_attn.forward_chunk(
-                h, ck, cv, t0, window=self.cfg.attn_window,
-                decode_kernel=decode_kernel)
+            a, ck, cv = attn_step(blk.self_attn, h, ck, cv)
             x = x + a
             x = x + blk.ffn(blk.norm2(x))
             new_caches.append((ck, cv))
@@ -198,10 +193,35 @@ class GPTForCausalLM(Layer):
             return None, new_caches
         return self.norm_f(x) @ self._head_weight(), new_caches
 
+    def _chunk_logits(self, toks, caches, t0, head: bool = True,
+                      decode_kernel: bool = False):
+        """S KV-cached positions in one pass: embed ``toks`` (B, S), run
+        every block's forward_chunk at cache indices [t0, t0+S), return
+        ((B, S, V) logits, new caches). The speculative-decoding target
+        scores its gamma+1 candidates with one call."""
+        return self._cached_blocks(
+            self.embed(toks), caches,
+            lambda sa, h, ck, cv: sa.forward_chunk(
+                h, ck, cv, t0, window=self.cfg.attn_window,
+                decode_kernel=decode_kernel),
+            head=head)
+
     def _step_logits(self, tok, caches, t, decode_kernel: bool = False):
         """One KV-cached position: ``tok`` (B,) -> ((B, V), caches)."""
         logits, caches = self._chunk_logits(
             tok[:, None], caches, t, decode_kernel=decode_kernel)
+        return logits[:, 0], caches
+
+    def _step_logits_rows(self, tok, caches, t_rows,
+                          decode_kernel: bool = False):
+        """One KV-cached position PER ROW at per-row cursors ``t_rows``
+        (B,) — the continuous-batching step (serving.BatchedDecoder).
+        ``tok`` (B,) -> ((B, V) logits, caches)."""
+        logits, caches = self._cached_blocks(
+            self.embed(tok[:, None]), caches,
+            lambda sa, h, ck, cv: sa.forward_step_rows(
+                h, ck, cv, t_rows, window=self.cfg.attn_window,
+                decode_kernel=decode_kernel))
         return logits[:, 0], caches
 
     def generate(self, prompt_ids, max_len: int, *, key=None,

@@ -515,6 +515,46 @@ class _MHADecodeMixin:
                                   window=window,
                                   decode_kernel=decode_kernel)
 
+    def forward_step_rows(self, x_t, cache_k, cache_v, t_rows,
+                          window=None, decode_kernel: bool = False):
+        """One decode position PER ROW at per-row cursors ``t_rows``
+        (B,) — the continuous-batching step (each serving slot at its
+        own position). Cache writes land at each row's own index
+        (vmapped dynamic_update_slice); attention rides the
+        flash-decode kernel's per-row-cursor form when eligible, else
+        a per-row masked XLA path. ``x_t``: (B, 1, D)."""
+        from jax import lax
+
+        b = x_t.shape[0]
+        cap = cache_k.shape[1]
+        pos_rows = t_rows.astype(jnp.int32)[:, None]          # (B, 1)
+        k_t = self.k_proj(x_t).reshape(b, 1, self.num_kv_heads,
+                                       self.head_dim)
+        v_t = self.v_proj(x_t).reshape(b, 1, self.num_kv_heads,
+                                       self.head_dim)
+        if self.rotary:
+            from ..ops.attention import rotary_embedding
+
+            k_t = rotary_embedding(k_t, pos_rows,
+                                   theta=self.rotary_theta)
+        write = jax.vmap(lambda c, u, s: lax.dynamic_update_slice_in_dim(
+            c, u, s, axis=0))
+        cache_k = write(cache_k, k_t.astype(cache_k.dtype),
+                        pos_rows[:, 0])
+        cache_v = write(cache_v, v_t.astype(cache_v.dtype),
+                        pos_rows[:, 0])
+        pos = jnp.arange(cap)[None, :]
+        keep = pos <= pos_rows
+        if window is not None:
+            keep &= pos > pos_rows - window
+        out = self.attend_kv(
+            x_t, cache_k, cache_v,
+            attn_mask=keep[:, None, None, :],
+            q_positions=pos_rows if self.rotary else None,
+            decode_t=(pos_rows[:, 0] if decode_kernel else None),
+            window=window)
+        return out, cache_k, cache_v
+
 
 class MultiHeadAttention(_MHADecodeMixin, Layer):
     """Transformer attention. The reference builds this from primitives

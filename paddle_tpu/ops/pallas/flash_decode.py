@@ -48,8 +48,9 @@ DEFAULT_DECODE_BLOCK_K = 256
 def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
                    l_ref, *, scale, window, block_k, n_j, nheads,
                    kv_heads):
-    j = pl.program_id(1)
-    t = t_ref[0]
+    b, j = pl.program_id(0), pl.program_id(1)
+    t = t_ref[b]  # PER-ROW cursor (continuous batching: each slot at
+    # its own position; the classic shared-cursor decode broadcasts)
     t_blk = t // block_k
     lo_blk = (jnp.maximum(t - window + 1, 0) // block_k
               if window is not None else 0)
@@ -127,8 +128,10 @@ def flash_decode(q, k, v, t, *, window: Optional[int] = None,
     """One decode position: q (B, 1, H, D) against caches k/v
     (B, capacity, H_kv, D) with the ``pos <= t`` (and optional
     sliding-``window``) mask applied in-kernel. Returns (B, 1, H, D).
-    ``t`` may be a traced scalar (it rides scalar prefetch into the
-    index maps). Capacity must be divisible by ``block_k``."""
+    ``t`` may be a traced scalar (one shared cursor) or a (B,) array
+    of PER-ROW cursors (the continuous-batching step); either rides
+    scalar prefetch into the index maps. Capacity must be divisible by
+    ``block_k``."""
     b, tq, h, d = q.shape
     enforce(tq == 1, "flash_decode takes one query position, got %s",
             tq)
@@ -147,13 +150,13 @@ def flash_decode(q, k, v, t, *, window: Optional[int] = None,
         interpret = _use_interpret()
     n_j = cap // block_k
     qh = q[:, 0]                                      # (B, H, D)
-    t_arr = jnp.full((1,), t, jnp.int32)
+    t_arr = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (b,))
 
     def kv_imap(b_, j, t_):
-        jj = jnp.minimum(j, t_[0] // block_k)
+        jj = jnp.minimum(j, t_[b_] // block_k)
         if window is not None:
             jj = jnp.maximum(
-                jj, jnp.maximum(t_[0] - window + 1, 0) // block_k)
+                jj, jnp.maximum(t_[b_] - window + 1, 0) // block_k)
         return (b_, jj, 0, 0)
 
     kernel = functools.partial(

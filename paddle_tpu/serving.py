@@ -200,23 +200,13 @@ class BatchedDecoder:
         model = self.model
 
         def step(caches, tok, t):
-            def one(tok_s, t_s, *row):
-                row = [(rk[None], rv[None])
-                       for rk, rv in zip(row[0::2], row[1::2])]
-                logits, row = model._step_logits(tok_s[None], row, t_s)
-                flat = []
-                for rk, rv in row:
-                    flat += [rk[0], rv[0]]
-                return (logits[0], *flat)
-
-            flat_in = []
-            for ck, cv in caches:
-                flat_in += [ck, cv]
-            out = jax.vmap(one)(tok, t, *flat_in)
-            logits, flat = out[0], out[1:]
-            new_caches = [(flat[i], flat[i + 1])
-                          for i in range(0, len(flat), 2)]
-            return new_caches, logits
+            # ONE un-vmapped program over the whole arena: per-row
+            # cursors thread through forward_step_rows, so the
+            # flash-decode kernel (per-row scalar prefetch) is eligible
+            # — each slot reads only ITS live cache blocks from HBM
+            logits, caches = model._step_logits_rows(
+                tok, caches, t, decode_kernel=True)
+            return caches, logits
 
         return jax.jit(step)
 
