@@ -727,6 +727,62 @@ def bench_gpt_decode(steps: int, batch_size: int, amp=None,
     return outer * batch_size * gen / dt, "tokens/sec", extras
 
 
+def bench_gpt_serve(steps: int, batch_size: int, amp=None,
+                    max_new: int = 64, smoke: bool = False,
+                    weight_only: bool = False):
+    """Continuous-batching serving throughput (serving.BatchedDecoder):
+    2x``batch_size`` requests with MIXED prompt lengths over a
+    ``batch_size``-slot arena — generated tokens/sec across the whole
+    workload, admission/refill included (the slot machinery's win over
+    pad-to-slowest static batching). --weight-only composes W8A16."""
+    import contextlib
+
+    import paddle_tpu as pt
+    from paddle_tpu.core.dtypes import policy_scope
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.serving import BatchedDecoder
+
+    pt.seed(0)
+    slots = _cap(batch_size, 2 if smoke else 8)
+    cfg = G.GPTConfig.small()
+    if smoke:
+        cfg.vocab_size, cfg.num_layers = 1024, 2
+        max_new = min(max_new, 8)
+    cap = 256 if not smoke else 64
+    cfg.max_position = cap
+    model = G.GPTForCausalLM(cfg).eval()
+    if weight_only:
+        from paddle_tpu.quant import apply_weight_only_int8
+
+        apply_weight_only_int8(model)
+    rng = np.random.default_rng(0)
+    n_req = 2 * slots
+    lens = [int(8 + (i * 7) % 24) for i in range(n_req)]  # mixed
+    # ONE decoder across warmup + timed runs: its jitted step and
+    # prefill-bucket functions cache per-instance, so a fresh decoder
+    # per run would re-trace inside the timed loop
+    dec = BatchedDecoder(model, slots=slots, capacity=cap)
+
+    def run_all():
+        scope = policy_scope(amp) if amp else contextlib.nullcontext()
+        with scope:  # trace-time policy, same contract as gpt_decode
+            for n in lens:
+                dec.submit(rng.integers(1, cfg.vocab_size, (n,))
+                           .astype(np.int32), max_new)
+            return dec.run()
+
+    run_all()  # warmup: compiles the step + prefill buckets
+    outer = max(1, steps // 50)
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(outer):
+        outs = run_all()
+        total += sum(len(v) for v in outs.values())
+    dt = time.perf_counter() - t0
+    return total / dt, "tokens/sec", {"requests": n_req,
+                                      "slots": slots}
+
+
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
                         vocab: int = 100_000):
     """DeepFM with ROW-SPARSE embedding updates (the SelectedRows
@@ -996,6 +1052,7 @@ MODELS = {
     "transformer_nmt": bench_transformer_nmt,
     "nmt_decode": bench_nmt_decode,
     "gpt_decode": bench_gpt_decode,
+    "gpt_serve": bench_gpt_serve,
     "deepfm": bench_deepfm,
     "deepfm_sparse": bench_deepfm_sparse,
 }
@@ -1295,6 +1352,10 @@ def main():
         # identical to deepfm's — bench that instead of duplicating it
         _emit_error(metric, "--infer: use --model deepfm (the sparse "
                     "variant differs only in the optimizer update)")
+        return
+    if args.infer and args.model == "gpt_serve":
+        _emit_error(metric, "--infer: --model gpt_serve already measures "
+                    "inference serving; run it without --infer")
         return
     if args.infer and args.model == "gpt_decode":
         _emit_error(metric, "--infer: --model gpt_decode already measures "

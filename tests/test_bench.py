@@ -355,3 +355,17 @@ def test_gpt_decode_bench_contract():
     assert d2["metric"] == "gpt_decode_throughput_g2_b2"
     assert d2["value"] > 0
     assert "accept_per_round" in d2 and "rounds" in d2
+
+
+def test_gpt_serve_bench_contract():
+    """Continuous-batching serving bench emits tokens/sec; the W8A16
+    variant forks its history key (else fill runs would clobber the
+    bf16 headline record)."""
+    d = _run("--model", "gpt_serve", "--smoke", "--steps", "50",
+             "--batch-size", "2", timeout=900)
+    assert d["metric"] == "gpt_serve_throughput_b2"
+    assert d["unit"] == "tokens/sec" and d["value"] > 0
+    d2 = _run("--model", "gpt_serve", "--smoke", "--steps", "50",
+              "--batch-size", "2", "--weight-only", timeout=900)
+    assert d2["metric"] == "gpt_serve_throughput_w8_b2"
+    assert d2["value"] > 0

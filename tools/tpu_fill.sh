@@ -151,6 +151,10 @@ item decode_nmt_full   1500 python bench.py --model nmt_decode --no-kv-cache
 item decode_gpt        1500 python bench.py --model gpt_decode
 item decode_gpt_spec   1500 python bench.py --model gpt_decode --gamma 4
 item decode_gpt_w8     1500 python bench.py --model gpt_decode --weight-only
+# continuous-batching serving throughput (r5: mixed-length requests
+# over the slot arena; admission/refill included)
+item serve_gpt_cb      1800 python bench.py --model gpt_serve
+item serve_gpt_cb_w8   1800 python bench.py --model gpt_serve --weight-only
 # NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
 # C++ predictor + PJRT C API (export runs off-chip: StableHLO is
 # portable; only the ptserve compile+run needs the chip)
