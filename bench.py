@@ -1250,8 +1250,8 @@ def main():
                     "(O(T*W) local attention vs the O(T^2) default)")
     ap.add_argument("--weight-only", dest="weight_only",
                     action="store_true",
-                    help="gpt_decode: weight-only int8 (W8A16) on the "
-                    "model's matmuls")
+                    help="gpt_decode/gpt_serve: weight-only int8 "
+                    "(W8A16) on the model's matmuls (_w8 history key)")
     ap.add_argument("--gamma", type=int, default=None,
                     help="gpt_decode: speculative-decoding draft length "
                     "(0/unset = plain greedy decode)")

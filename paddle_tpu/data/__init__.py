@@ -1,6 +1,7 @@
 """Data layer: reader decorators, feeders, datasets, ragged batching."""
 
 from . import dataset
+from .bpe import BPETokenizer
 from .bucketing import (bucket_by_length, pad_to,
                         quantile_boundaries)
 from .data_generator import MultiSlotDataGenerator
@@ -11,6 +12,7 @@ from .reader import (Fake, PipeReader, batch, buffered, cache, chain,
                      multiprocess_reader, shuffle, xmap_readers)
 
 __all__ = [
+    "BPETokenizer",
     "MultiSlotDataGenerator", "train_from_dataset",
     "bucket_by_length", "pad_to", "quantile_boundaries",
     "dataset", "MultiSlotDataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",

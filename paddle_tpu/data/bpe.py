@@ -1,0 +1,212 @@
+"""Byte-level BPE tokenizer — train/encode/decode with no external
+dependencies, so the causal-LM family (models/gpt.py, serving.py) has a
+complete text path in-framework.
+
+Byte-level: the base alphabet is all 256 bytes, so ANY string encodes
+losslessly (no unk) and decode is exact byte reconstruction. Merges are
+learned greedily on pair frequency (the standard BPE objective);
+encoding applies merges by learned rank (lowest rank first), the
+tie-stable order that reproduces GPT-2-style tokenizers.
+
+Host-side by design: tokenization is IO-time work that belongs in the
+input pipeline (data/ decorators), never inside jit. Green-field vs the
+reference (its text path is pre-tokenized id files, reference:
+python/paddle/dataset/imdb.py tokenize role + the NMT benchmark's
+pre-built vocab, benchmark/fluid/models/machine_translation.py).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.enforce import enforce
+
+
+class BPETokenizer:
+    """``train()`` learns merges; ``encode(str) -> List[int]``,
+    ``decode(ids) -> str``. Token ids: 0..255 are raw bytes, 256+ are
+    merges in learned order, then specials. ``save``/``load``
+    round-trip the vocabulary as JSON."""
+
+    def __init__(self, merges: Optional[List[Tuple[int, int]]] = None,
+                 specials: Sequence[str] = ()):
+        self.merges: List[Tuple[int, int]] = list(merges or [])
+        self._ranks: Dict[Tuple[int, int], int] = {
+            tuple(m): i for i, m in enumerate(self.merges)}
+        self.specials: Dict[str, int] = {}
+        for s in specials:
+            self.add_special(s)
+
+    # --- vocab -------------------------------------------------------------
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges) + len(self.specials)
+
+    def add_special(self, token: str) -> int:
+        """Register a special token (e.g. "<|eos|>"); returns its id.
+        Specials are matched exactly and never split."""
+        if token in self.specials:
+            return self.specials[token]
+        tid = 256 + len(self.merges) + len(self.specials)
+        self.specials[token] = tid
+        return tid
+
+    # --- train -------------------------------------------------------------
+
+    def train(self, texts: Iterable[str], vocab_size: int,
+              min_pair_count: int = 2) -> "BPETokenizer":
+        """Learn ``vocab_size - 256 - len(specials)`` merges from
+        ``texts`` (greedy highest-count pair, ties by first-seen order
+        via Counter insertion). Stops early when no pair reaches
+        ``min_pair_count``."""
+        enforce(vocab_size > 256 + len(self.specials),
+                "vocab_size %s leaves no room for merges over the 256 "
+                "byte alphabet + %s specials", vocab_size,
+                len(self.specials))
+        enforce(not self.merges,
+                "train() on an already-trained tokenizer (merges=%s)",
+                len(self.merges))
+        from collections import defaultdict
+
+        seqs = [list(t.encode("utf-8")) for t in texts]
+        n_merges = vocab_size - 256 - len(self.specials)
+        # incremental pair counts (the standard BPE-trainer
+        # optimization): a merge only re-counts the sequences that
+        # CONTAIN the merged pair — O(affected) per merge, not
+        # O(corpus); `where` is the pair -> sequence-index inverted
+        # index that finds them without a scan
+        seq_counts = [Counter(zip(s, s[1:])) for s in seqs]
+        counts: Counter = Counter()
+        where = defaultdict(set)
+        for i, c in enumerate(seq_counts):
+            counts.update(c)
+            for p in c:
+                where[p].add(i)
+        for _ in range(n_merges):
+            if not counts:
+                break
+            pair, cnt = counts.most_common(1)[0]
+            if cnt < min_pair_count:
+                break
+            new_id = 256 + len(self.merges)
+            self.merges.append(pair)
+            self._ranks[pair] = len(self.merges) - 1
+            for i in list(where.get(pair, ())):
+                old = seq_counts[i]
+                counts.subtract(old)
+                seqs[i] = _apply_merge(seqs[i], pair, new_id)
+                new = Counter(zip(seqs[i], seqs[i][1:]))
+                seq_counts[i] = new
+                counts.update(new)
+                for p in old:
+                    if p not in new:
+                        where[p].discard(i)
+                for p in new:
+                    where[p].add(i)
+            counts = +counts  # drop <= 0 entries (subtract leftovers)
+        # specials keep ids ABOVE the merge range: reassign after train
+        self.specials = {s: 256 + len(self.merges) + i
+                         for i, s in enumerate(self.specials)}
+        return self
+
+    # --- encode/decode -----------------------------------------------------
+
+    def encode(self, text: str) -> List[int]:
+        out: List[int] = []
+        for chunk, is_special in self._split_specials(text):
+            if is_special:
+                out.append(self.specials[chunk])
+                continue
+            ids = list(chunk.encode("utf-8"))
+            while len(ids) > 1:
+                # lowest-rank applicable merge first (the learned order)
+                best = min(zip(ids, ids[1:]),
+                           key=lambda p: self._ranks.get(p, 1 << 60))
+                if best not in self._ranks:
+                    break
+                ids = _apply_merge(ids, best,
+                                   256 + self._ranks[best])
+            out.extend(ids)
+        return out
+
+    def decode(self, ids: Sequence[int]) -> str:
+        inv_special = {v: k for k, v in self.specials.items()}
+        data = bytearray()
+        text: List[str] = []
+
+        def flush():
+            if data:
+                text.append(bytes(data).decode("utf-8",
+                                               errors="replace"))
+                data.clear()
+
+        for tid in ids:
+            tid = int(tid)
+            if tid in inv_special:
+                flush()
+                text.append(inv_special[tid])
+            else:
+                data.extend(self._expand(tid))
+        flush()
+        return "".join(text)
+
+    def _expand(self, tid: int) -> bytes:
+        enforce(0 <= tid < 256 + len(self.merges),
+                "token id %s outside vocab (%s)", tid, self.vocab_size)
+        if tid < 256:
+            return bytes([tid])
+        a, b = self.merges[tid - 256]
+        return self._expand(a) + self._expand(b)
+
+    def _split_specials(self, text: str):
+        if not self.specials:
+            yield text, False
+            return
+        # longest-first exact matching
+        toks = sorted(self.specials, key=len, reverse=True)
+        i, start = 0, 0
+        while i < len(text):
+            hit = next((t for t in toks if text.startswith(t, i)), None)
+            if hit is not None:
+                if i > start:
+                    yield text[start:i], False
+                yield hit, True
+                i += len(hit)
+                start = i
+            else:
+                i += 1
+        if start < len(text):
+            yield text[start:], False
+
+    # --- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"merges": self.merges,
+                       "specials": self.specials}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            d = json.load(f)
+        tok = cls([tuple(m) for m in d["merges"]])
+        tok.specials = {k: int(v) for k, v in d["specials"].items()}
+        return tok
+
+
+def _apply_merge(ids: List[int], pair: Tuple[int, int],
+                 new_id: int) -> List[int]:
+    out: List[int] = []
+    i = 0
+    while i < len(ids):
+        if (i + 1 < len(ids) and ids[i] == pair[0]
+                and ids[i + 1] == pair[1]):
+            out.append(new_id)
+            i += 2
+        else:
+            out.append(ids[i])
+            i += 1
+    return out

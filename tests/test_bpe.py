@@ -1,0 +1,87 @@
+"""Byte-level BPE tokenizer (data/bpe.py): lossless round-trip,
+learned-merge ordering, specials, persistence. Green-field (the
+reference's text path is pre-tokenized id files)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data.bpe import BPETokenizer
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown fox is quick and the dog is lazy",
+    "pack my box with five dozen liquor jugs",
+    "how vexingly quick daft zebras jump",
+] * 4
+
+
+def test_roundtrip_any_text_lossless():
+    tok = BPETokenizer().train(CORPUS, vocab_size=300)
+    for t in CORPUS + ["completely unseen text!", "ünïcödé 漢字 🙂",
+                       "", "\n\t spaces \n"]:
+        assert tok.decode(tok.encode(t)) == t
+
+
+def test_merges_compress_training_text():
+    tok = BPETokenizer().train(CORPUS, vocab_size=320)
+    raw = len(CORPUS[0].encode("utf-8"))
+    enc = len(tok.encode(CORPUS[0]))
+    assert enc < raw * 0.7, (enc, raw)  # frequent pairs merged
+    assert 256 < tok.vocab_size <= 320
+
+
+def test_encode_applies_merges_in_learned_rank_order():
+    tok = BPETokenizer()
+    # hand-built merges: (t,h)->256 then (256,e)->257 ("the")
+    tok.merges = [(ord("t"), ord("h")), (256, ord("e"))]
+    tok._ranks = {m: i for i, m in enumerate(tok.merges)}
+    assert tok.encode("the") == [257]
+    assert tok.encode("th") == [256]
+    assert tok.decode([257]) == "the"
+
+
+def test_specials_never_split_and_roundtrip(tmp_path):
+    tok = BPETokenizer(specials=("<|eos|>",))
+    tok.train(CORPUS, vocab_size=300)
+    eos = tok.specials["<|eos|>"]
+    ids = tok.encode("the dog<|eos|>the fox")
+    assert ids.count(eos) == 1
+    assert tok.decode(ids) == "the dog<|eos|>the fox"
+    # persistence round-trip
+    p = str(tmp_path / "tok.json")
+    tok.save(p)
+    tok2 = BPETokenizer.load(p)
+    assert tok2.encode("the quick dog<|eos|>") == tok.encode(
+        "the quick dog<|eos|>")
+    assert tok2.vocab_size == tok.vocab_size
+
+
+def test_typed_errors():
+    with pytest.raises(Exception, match="vocab_size"):
+        BPETokenizer().train(CORPUS, vocab_size=100)
+    tok = BPETokenizer().train(CORPUS, vocab_size=280)
+    with pytest.raises(Exception, match="train\\(\\) on an already"):
+        tok.train(CORPUS, vocab_size=300)
+    with pytest.raises(Exception, match="outside vocab"):
+        tok.decode([tok.vocab_size + 5])
+
+
+def test_feeds_gpt_family():
+    """Tokenizer output feeds the LM family directly."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import gpt as G
+
+    tok = BPETokenizer(specials=("<|eos|>",))
+    tok.train(CORPUS, vocab_size=300)
+    pt.seed(0)
+    cfg = G.GPTConfig(vocab_size=tok.vocab_size, hidden_size=64,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      intermediate_size=128, max_position=128)
+    m = G.GPTForCausalLM(cfg).eval()
+    ids = jnp.asarray([tok.encode("the quick brown")[:8]])
+    out = m.generate(ids, ids.shape[1] + 8, temperature=0.0,
+                     eos_id=tok.specials["<|eos|>"])
+    text = tok.decode(np.asarray(out)[0])
+    assert isinstance(text, str) and len(text) > 0
