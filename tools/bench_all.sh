@@ -44,6 +44,8 @@ if [ "$MODE" = "full" ]; then
   run python bench.py --model se_resnext50 --layout NCHW
   run python bench.py --model deepfm --steps-per-call 8
   run python bench.py --model gpt_decode --gamma 4
+  run python bench.py --model gpt_serve
+  run python bench.py --model gpt_serve --weight-only
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py
