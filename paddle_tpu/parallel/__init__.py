@@ -12,7 +12,7 @@ from .collective import (allgather, allreduce, all_to_all, axis_index,
 from .dgc import (DGCMomentum, dgc_allreduce, quantized_allreduce,
                   top_k_sparsify)
 from .geo_sgd import GeoSGDTrainer
-from .hybrid import (build_bert_hybrid_step,
+from .hybrid import (build_bert_hybrid_step, build_gpt_hybrid_step,
                      build_hybrid_transformer_step)
 from .pipeline import (GPipe, bubble_fraction, gpipe_ticks,
                        interleaved_ticks, pipeline_apply,
@@ -35,5 +35,6 @@ __all__ = [
     "transformer_tp_rules", "zero_dp_rules",
     "DGCMomentum", "dgc_allreduce", "quantized_allreduce", "top_k_sparsify",
     "build_hybrid_transformer_step", "build_bert_hybrid_step",
+    "build_gpt_hybrid_step",
     "GeoSGDTrainer",
 ]

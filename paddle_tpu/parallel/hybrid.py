@@ -33,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.enforce import enforce
 from .pipeline import (microbatched_aux_fold, pipeline_apply,
                        ring_order_layers)
-from .sharding import constraint
+from .sharding import constraint, infer_param_spec
 
 
 def build_hybrid_transformer_step(mesh, *, layers: int = 4, d_model: int = 16,
@@ -99,6 +99,96 @@ def build_hybrid_transformer_step(mesh, *, layers: int = 4, d_model: int = 16,
         return loss, new_p
 
     return step, params, (x, y)
+
+
+def _sub(tree, prefix):
+    """Strip ``prefix.`` from matching keys (functional_call feeding)."""
+    pre = prefix + "."
+    return {k[len(pre):]: v for k, v in tree.items()
+            if k.startswith(pre)}
+
+
+def _place_hybrid_params(mesh, stacked, rest, rules, ring, n_pp,
+                         virtual_stages):
+    """Shared placement: ring-order the stack when the interleaved
+    schedule needs it, infer tp/ep specs for rest and the stacked
+    leaves ('pp' on the layer dim, the rule shifted past it), and
+    device_put everything."""
+    if ring:
+        stacked = ring_order_layers(stacked, n_pp, virtual_stages)
+    rest_spec = infer_param_spec(rest, rules, mesh)
+    stacked_spec = {
+        name: P("pp", *spec)
+        for name, spec in infer_param_spec(
+            {n: v[0] for n, v in stacked.items()}, rules, mesh).items()}
+
+    def put(tree, spec_map, default):
+        return {n: jax.device_put(v, NamedSharding(
+                    mesh, spec_map.get(n, default)))
+                for n, v in tree.items()}
+
+    return {"layers": put(stacked, stacked_spec, P("pp")),
+            "rest": put(rest, rest_spec, P())}
+
+
+def _stacked_blocks_runner(mesh, template, moe, num_microbatches,
+                           pipeline_schedule, virtual_stages):
+    """ONE definition of the hybrid block-stack execution shared by the
+    BERT and GPT flagship builders: pipelined (both schedules, ring
+    weight order, MoE aux riding the scan carry) vs the sequential
+    oracle fold. Returns ``run(layers, x, pipelined) -> (h, aux)`` and
+    the ring flag (callers ring-order their persistent stack with
+    it)."""
+    n_pp = mesh.shape["pp"]
+    ring = pipeline_schedule == "interleaved" and virtual_stages > 1
+
+    def block_fn(p_l, h):
+        out, _ = template.functional_call(p_l, h, training=False)
+        return out
+
+    def block_fn_aux(p_l, h):
+        out, nb = template.functional_call(p_l, h, training=False)
+        # [load-balance, router-z]; kept_fraction stays a buffer-level
+        # diagnostic — carrying it through every pipeline tick would be
+        # dead payload the scan carry can't DCE
+        return out, jnp.stack([nb["ffn.aux_loss"],
+                               nb["ffn.router_z_loss"]])
+
+    def run(layers, x, *, pipelined):
+        aux = None
+        if pipelined:
+            h = pipeline_apply(block_fn_aux if moe else block_fn,
+                               layers, x,
+                               num_microbatches=num_microbatches,
+                               mesh=mesh, schedule=pipeline_schedule,
+                               virtual_stages=virtual_stages,
+                               layers_in_ring_order=ring,
+                               aux_size=2 if moe else 0)
+            if moe:
+                h, aux = h
+            h = constraint(h, P("dp"), mesh=mesh)
+        else:
+            if ring:
+                # the sequential oracle applies layers in LOGICAL order
+                layers = ring_order_layers(layers, n_pp,
+                                           virtual_stages, inverse=True)
+            if moe:
+                # per-MICROBATCH fold (MoE routing is microbatch-local
+                # in the pipelined form): the SAME shared definition the
+                # n == 1 pipeline path uses, so oracle and pipeline can
+                # never diverge on the aux contract
+                h, aux = microbatched_aux_fold(
+                    block_fn_aux, layers, x,
+                    num_microbatches=num_microbatches, aux_size=2,
+                    remat=False)
+            else:
+                def one(hc, p_l):
+                    return block_fn(p_l, hc), None
+
+                h = jax.lax.scan(one, x, layers)[0]
+        return h, aux
+
+    return run, ring
 
 
 def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
@@ -171,37 +261,23 @@ def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
     moe = getattr(cfg, "moe_experts", 0) > 0
     moe_aux_w, moe_z_w = 0.01, 1e-3
 
-    # --- split: stacked encoder-layer params | everything else ------------
+    run_blocks, ring = _stacked_blocks_runner(
+        mesh, template, moe, num_microbatches, pipeline_schedule,
+        virtual_stages)
+    # split: stacked encoder-layer params | everything else; the
+    # persistent stack holds RING order under the interleaved schedule
+    # (device-contiguous chunks — a logical-order 'pp'-sharded stack
+    # would all-to-all every weight every step)
     stacked = stacked_parameters(model.bert.encoder.layers)
-    ring = pipeline_schedule == "interleaved" and virtual_stages > 1
-    if ring:
-        # persistent state holds the stack in the interleaved schedule's
-        # RING order (device-contiguous round-robin chunks): the
-        # per-step stage split is then a LOCAL reshape — a logical-order
-        # 'pp'-sharded stack would all-to-all every weight every step
-        stacked = ring_order_layers(stacked, n_pp, virtual_stages)
     rest = {k: v for k, v in model.named_parameters().items()
             if ".encoder.layers." not in k}
-
     rules = transformer_tp_rules()
     if moe and "ep" in mesh.shape:
         from ..nn.moe import expert_param_spec
 
         rules = rules + expert_param_spec("ep")
-    rest_spec = infer_param_spec(rest, rules, mesh)
-    # stacked leaves: 'pp' on the layer dim + the tp rule shifted past it
-    stacked_spec = {
-        name: P("pp", *spec)
-        for name, spec in infer_param_spec(
-            {n: v[0] for n, v in stacked.items()}, rules, mesh).items()}
-
-    def put(tree, spec_map, default):
-        return {n: jax.device_put(v, NamedSharding(
-                    mesh, spec_map.get(n, default)))
-                for n, v in tree.items()}
-
-    params = {"layers": put(stacked, stacked_spec, P("pp")),
-              "rest": put(rest, rest_spec, P())}
+    params = _place_hybrid_params(mesh, stacked, rest, rules, ring,
+                                  n_pp, virtual_stages)
 
     rng = np.random.default_rng(seed)
     ids = rng.integers(0, cfg.vocab_size, size=(batch, seq_len))
@@ -212,60 +288,13 @@ def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
     dsh = NamedSharding(mesh, P("dp"))
     feed = tuple(jax.device_put(jnp.asarray(a), dsh)
                  for a in (ids, mlm_labels, nsp_label))
-
-    def sub(tree, prefix):
-        pre = prefix + "."
-        return {k[len(pre):]: v for k, v in tree.items()
-                if k.startswith(pre)}
-
-    def block_fn(p_l, h):
-        out, _ = template.functional_call(p_l, h, training=False)
-        return out
-
-    def block_fn_aux(p_l, h):
-        out, nb = template.functional_call(p_l, h, training=False)
-        # [load-balance, router-z]; kept_fraction stays a buffer-level
-        # diagnostic — carrying it through every pipeline tick would be
-        # dead payload the scan carry can't DCE
-        return out, jnp.stack([nb["ffn.aux_loss"],
-                               nb["ffn.router_z_loss"]])
+    sub = _sub
 
     def loss_fn(p, ids, mlm_labels, nsp_label, *, pipelined):
         r = p["rest"]
         x, _ = model.bert.embeddings.functional_call(
             sub(r, "bert.embeddings"), ids, training=False)
-        aux = None
-        if pipelined:
-            h = pipeline_apply(block_fn_aux if moe else block_fn,
-                               p["layers"], x,
-                               num_microbatches=num_microbatches,
-                               mesh=mesh, schedule=pipeline_schedule,
-                               virtual_stages=virtual_stages,
-                               layers_in_ring_order=ring,
-                               aux_size=2 if moe else 0)
-            if moe:
-                h, aux = h
-            h = constraint(h, P("dp"), mesh=mesh)
-        else:
-            layers = p["layers"]
-            if ring:
-                # the sequential oracle applies layers in LOGICAL order
-                layers = ring_order_layers(layers, n_pp,
-                                           virtual_stages, inverse=True)
-            if moe:
-                # per-MICROBATCH fold (MoE routing is microbatch-local
-                # in the pipelined form): the SAME shared definition the
-                # n == 1 pipeline path uses, so oracle and pipeline can
-                # never diverge on the aux contract
-                h, aux = microbatched_aux_fold(
-                    block_fn_aux, layers, x,
-                    num_microbatches=num_microbatches, aux_size=2,
-                    remat=False)
-            else:
-                def one(hc, p_l):
-                    return block_fn(p_l, hc), None
-
-                h = jax.lax.scan(one, x, layers)[0]
+        h, aux = run_blocks(p["layers"], x, pipelined=pipelined)
         pooled, _ = model.bert.pooler.functional_call(
             sub(r, "bert.pooler"), h[:, 0])
         hm, _ = model.mlm_transform.functional_call(
@@ -290,6 +319,107 @@ def build_bert_hybrid_step(mesh, *, cfg=None, batch: int = 8,
             loss, grads = jax.value_and_grad(
                 lambda p_: loss_fn(p_, ids, mlm_labels, nsp_label,
                                    pipelined=pipelined))(p)
+            new_p = jax.tree_util.tree_map(lambda w, g: w - lr * g,
+                                           p, grads)
+            return loss, new_p
+
+        return step
+
+    return _make_step(True), _make_step(False), params, feed
+
+
+def build_gpt_hybrid_step(mesh, *, cfg=None, batch: int = 8,
+                          seq_len: int = 16, num_microbatches: int = 2,
+                          lr: float = 0.01, seed: int = 0,
+                          vocab_chunk: int = 256,
+                          pipeline_schedule: str = "gpipe",
+                          virtual_stages: int = 1):
+    """The MODERN flagship composed-3D step: the real GPTForCausalLM
+    stack — RoPE + GQA attention (flash path on TPU), RMSNorm pre-norm
+    blocks, SwiGLU (or Switch-MoE) FFNs, tied-embedding fused chunked
+    linear-CE next-token head — trained under ONE dp x tp x pp mesh,
+    the decoder-LM sibling of :func:`build_bert_hybrid_step` (same
+    decomposition, same return contract; feed is ``(ids,)``).
+
+    tp notes: GQA's kv heads must divide the tp axis; the SwiGLU
+    gate/up/down split and the ``embed`` vocab sharding come from
+    :func:`transformer_tp_rules`; the TIED head reuses the 'tp'-sharded
+    embedding transposed (row-sharded table -> column-parallel head —
+    GSPMD inserts the same collectives Megatron's vocab-parallel head
+    uses)."""
+    for ax in ("dp", "tp", "pp"):
+        enforce(ax in mesh.shape, "hybrid mesh needs axis %r", ax)
+
+    import numpy as np
+
+    from ..core.random import seed as set_seed
+    from ..models.gpt import GPTConfig, GPTForCausalLM
+    from ..nn.layer import stacked_parameters
+    from ..ops.fused_loss import mean_linear_cross_entropy
+    from .sharding import infer_param_spec, transformer_tp_rules
+
+    if cfg is None:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                        num_heads=4, num_kv_heads=2,
+                        intermediate_size=128, max_position=64)
+    n_pp, n_dp = mesh.shape["pp"], mesh.shape["dp"]
+    enforce(cfg.num_layers % (n_pp * virtual_stages) == 0,
+            "pp size x virtual stages (%s x %s) must divide num_layers "
+            "%s", n_pp, virtual_stages, cfg.num_layers)
+    enforce(batch % (num_microbatches * n_dp) == 0,
+            "microbatches x dp (%s) must divide batch size %s",
+            num_microbatches * n_dp, batch)
+    enforce(cfg.dropout == 0.0,
+            "hybrid GPT step needs dropout == 0 (deterministic "
+            "loss-match contract)")
+    enforce(cfg.tie_embeddings,
+            "hybrid GPT step assumes the tied head (embed.weight.T)")
+
+    set_seed(seed)
+    model = GPTForCausalLM(cfg)
+    template = model.blocks[0]
+    moe = cfg.moe_experts > 0
+    moe_aux_w, moe_z_w = 0.01, 1e-3
+
+    run_blocks, ring = _stacked_blocks_runner(
+        mesh, template, moe, num_microbatches, pipeline_schedule,
+        virtual_stages)
+    stacked = stacked_parameters(list(model.blocks))
+    rest = {k: v for k, v in model.named_parameters().items()
+            if not k.startswith("blocks.")}
+    rules = transformer_tp_rules()
+    if moe and "ep" in mesh.shape:
+        from ..nn.moe import expert_param_spec
+
+        rules = rules + expert_param_spec("ep")
+    params = _place_hybrid_params(mesh, stacked, rest, rules, ring,
+                                  n_pp, virtual_stages)
+
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, size=(batch, seq_len))
+    feed = (jax.device_put(jnp.asarray(ids),
+                           NamedSharding(mesh, P("dp"))),)
+
+    def loss_fn(p, ids, *, pipelined):
+        r = p["rest"]
+        x = r["embed.weight"][ids]                # (B, T, D) gather
+        h, aux = run_blocks(p["layers"], x, pipelined=pipelined)
+        hn, _ = model.norm_f.functional_call(_sub(r, "norm_f"), h)
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)],
+            axis=1)
+        b, t, d = hn.shape
+        loss = mean_linear_cross_entropy(
+            hn.reshape(b * t, d), r["embed.weight"].T, None,
+            labels.reshape(-1), chunk=vocab_chunk, ignore_index=-100)
+        if moe:
+            loss = loss + moe_aux_w * aux[0] + moe_z_w * aux[1]
+        return loss
+
+    def _make_step(pipelined):
+        def step(p, ids):
+            loss, grads = jax.value_and_grad(
+                lambda p_: loss_fn(p_, ids, pipelined=pipelined))(p)
             new_p = jax.tree_util.tree_map(lambda w, g: w - lr * g,
                                            p, grads)
             return loss, new_p

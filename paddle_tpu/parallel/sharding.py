@@ -102,7 +102,16 @@ def transformer_tp_rules(axis: str = "tp") -> List[Rule]:
         (r"fc2\.weight$", row_w),
         (r"(generator|mlm_decoder)\.weight$", P(None, axis)),
         (r"(generator|mlm_decoder)\.bias$", P(axis)),
-        (r"(tok|seg|src_emb|tgt_emb)\.weight$", vocab_w),
+        # GPT-family SwiGLU FFN: gate/up column-parallel, down
+        # row-parallel (the Megatron MLP split for gated FFNs);
+        # attribute-anchored like 'embed' below (a module whose name
+        # merely ENDS in gate/up/down must not inherit the split)
+        (r"(^|\.)(gate|up)\.weight$", col_w),
+        (r"(^|\.)down\.weight$", row_w),
+        # attribute boundary: 'embed' must be the WHOLE attribute name
+        # (GPT's token table), not a suffix of one — ViT's
+        # patch_embed.weight is a 4D conv kernel that must replicate
+        (r"(^|\.)(tok|seg|src_emb|tgt_emb|embed)\.weight$", vocab_w),
     ]
 
 

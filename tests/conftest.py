@@ -115,6 +115,7 @@ MID_PATTERNS = [
     "test_lora.py::test_trainable_subset_and_frozen_base",
     "test_vit.py::test_train_step_loss_decreases",
     "test_serving.py::test_more_requests_than_slots_all_complete",
+    "test_gpt_hybrid.py::test_gpt_hybrid_matches_model_api_loss",
     "test_lora.py::test_merge_matches_adapted_forward",
     "test_pallas_decode.py::test_generate_rides_kernel_and_matches",
     "test_speculative.py::test_greedy_spec_equals_target_greedy",
