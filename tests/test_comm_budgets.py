@@ -90,3 +90,18 @@ def test_bert_moe_ep_pp_structure(cr):
     assert "collective-permute" in k and "all-reduce" in k, rep
     assert ("all-gather" in k) or ("all-to-all" in k), rep["collectives"]
     assert rep["bytes_per_flop"] < 0.03, rep
+
+
+def test_gpt_hybrid_structure(cr):
+    """The GPT 3D flagship shows the same collective structure as the
+    BERT hybrid: all-reduce (dp grads + tp activations) and the
+    pipeline's collective-permute, with nothing exotic sneaking in."""
+    r = cr.report("gpt_dp2tp2pp2")
+    kinds = _kinds(r)
+    assert "all-reduce" in kinds and "collective-permute" in kinds
+    assert r["gflops"] > 0
+    # traffic stays within the same order as the BERT config on the
+    # same mesh (shared budget philosophy: a sharding regression that
+    # gathers weights would blow this by >10x)
+    b = cr.report("dp2tp2pp2")
+    assert r["comm_mbytes_total"] < 10 * max(b["comm_mbytes_total"], 1)

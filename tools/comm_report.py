@@ -88,6 +88,10 @@ CONFIGS = {
     "resnet20_dp8": dict(model="resnet_dp", dp=8),
     "deepfm_ep4": dict(model="deepfm_ep", dp=2, ep=4),
     "bert_moe_ep": dict(model="bert_moe", dp=2, tp=1, pp=2, ep=2),
+    # the GPT 3D flagship (r5): same structural expectations as the
+    # BERT hybrid (dp grad all-reduce, tp activation all-reduces, pp
+    # neighbour permutes) over the decoder stack + tied vocab head
+    "gpt_dp2tp2pp2": dict(model="gpt", dp=2, tp=2, pp=2),
 }
 
 
@@ -193,7 +197,19 @@ def report(config_name: str, *, batch: int = 8, seq_len: int = 32,
         # tiny stack: collective STRUCTURE (which kinds, how the bytes
         # scale with the axes) is what matters; absolute sizes scale with
         # the model and are reported per-config for ratio comparisons
-        if model_kind == "bert_moe":
+        if model_kind == "gpt":
+            from paddle_tpu.models.gpt import GPTConfig
+            from paddle_tpu.parallel.hybrid import build_gpt_hybrid_step
+
+            gcfg = GPTConfig(vocab_size=256, hidden_size=64,
+                             num_layers=layers, num_heads=4,
+                             num_kv_heads=2, intermediate_size=128,
+                             max_position=64)
+            step, _, params, feed = build_gpt_hybrid_step(
+                mesh, cfg=gcfg, batch=batch, seq_len=seq_len,
+                num_microbatches=2, pipeline_schedule=sched,
+                virtual_stages=v)
+        elif model_kind == "bert_moe":
             cfg = BertConfig.moe_smoke(layers=4)
             seq_len = min(seq_len, cfg.max_position)
         else:
@@ -201,10 +217,11 @@ def report(config_name: str, *, batch: int = 8, seq_len: int = 32,
                              num_layers=layers, num_heads=4,
                              intermediate_size=128, max_position=64,
                              dropout=0.0)
-        step, _, params, feed = build_bert_hybrid_step(
-            mesh, cfg=cfg, batch=batch, seq_len=seq_len,
-            num_microbatches=2 if spec.get("pp", 1) > 1 else 1,
-            pipeline_schedule=sched, virtual_stages=v)
+        if model_kind != "gpt":
+            step, _, params, feed = build_bert_hybrid_step(
+                mesh, cfg=cfg, batch=batch, seq_len=seq_len,
+                num_microbatches=2 if spec.get("pp", 1) > 1 else 1,
+                pipeline_schedule=sched, virtual_stages=v)
         compiled = jax.jit(step).lower(params, *feed).compile()
     traffic = collective_traffic(compiled.as_text())
     cost = compiled.cost_analysis() or {}
