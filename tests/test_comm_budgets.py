@@ -99,6 +99,9 @@ def test_gpt_hybrid_structure(cr):
     r = cr.report("gpt_dp2tp2pp2")
     kinds = _kinds(r)
     assert "all-reduce" in kinds and "collective-permute" in kinds
+    # the r4 regression class this gate exists for: a sharding change
+    # that all-to-alls weights every step must FAIL here
+    assert "all-to-all" not in kinds
     assert r["gflops"] > 0
     # traffic stays within the same order as the BERT config on the
     # same mesh (shared budget philosophy: a sharding regression that

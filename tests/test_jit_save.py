@@ -110,3 +110,45 @@ def test_export_cached_decode_as_serving_artifact(tmp_path):
     [served] = pred.run({"src": np.asarray(src4)})
     direct = model.greedy_decode_cached(src4, max_len=9)
     np.testing.assert_array_equal(np.asarray(served), np.asarray(direct))
+
+
+class TestGPTServingArtifact:
+    """The causal-LM scoring export (tools/export_serving.py 'gpt'
+    builder shape): ids -> logits through jit.save, the Python
+    predictor, AND the C++ predictor's parsers; W8A16-quantized buffers
+    ride the artifact."""
+
+    def _tiny_gpt(self):
+        import paddle_tpu as pt
+        from paddle_tpu.models import gpt as G
+
+        pt.seed(0)
+        return G.GPTForCausalLM(G.GPTConfig.tiny()).eval()
+
+    def test_scoring_roundtrip_and_native_parse(self, tmp_path):
+        from paddle_tpu.native import NativePredictor
+
+        m = self._tiny_gpt()
+        ids = jnp.asarray(RNG.integers(0, 512, (2, 16)).astype(np.int32))
+        d = str(tmp_path / "gpt_art")
+        jit.save(m, d, [ids], input_names=["input_ids"])
+        pred = jit.load(d)
+        out = pred.run({"input_ids": np.asarray(ids)})[0]
+        np.testing.assert_allclose(out, np.asarray(m(ids)),
+                                   rtol=2e-5, atol=2e-5)
+        p = NativePredictor(d)
+        assert p.feed_names == ["input_ids"]
+        assert p.num_params() > 0
+        p.close()
+
+    def test_weight_only_int8_artifact(self, tmp_path):
+        from paddle_tpu import quant
+
+        m = self._tiny_gpt()
+        ids = jnp.asarray(RNG.integers(0, 512, (2, 16)).astype(np.int32))
+        quant.apply_weight_only_int8(m)
+        want = np.asarray(m(ids))
+        d = str(tmp_path / "gpt_w8")
+        jit.save(m, d, [ids], input_names=["input_ids"])
+        out = jit.load(d).run({"input_ids": np.asarray(ids)})[0]
+        np.testing.assert_allclose(out, want, rtol=2e-5, atol=2e-5)

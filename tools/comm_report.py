@@ -209,15 +209,14 @@ def report(config_name: str, *, batch: int = 8, seq_len: int = 32,
                 mesh, cfg=gcfg, batch=batch, seq_len=seq_len,
                 num_microbatches=2, pipeline_schedule=sched,
                 virtual_stages=v)
-        elif model_kind == "bert_moe":
-            cfg = BertConfig.moe_smoke(layers=4)
-            seq_len = min(seq_len, cfg.max_position)
         else:
-            cfg = BertConfig(vocab_size=256, hidden_size=64,
-                             num_layers=layers, num_heads=4,
-                             intermediate_size=128, max_position=64,
-                             dropout=0.0)
-        if model_kind != "gpt":
+            cfg = (BertConfig.moe_smoke(layers=4)
+                   if model_kind == "bert_moe"
+                   else BertConfig(vocab_size=256, hidden_size=64,
+                                   num_layers=layers, num_heads=4,
+                                   intermediate_size=128,
+                                   max_position=64, dropout=0.0))
+            seq_len = min(seq_len, cfg.max_position)
             step, _, params, feed = build_bert_hybrid_step(
                 mesh, cfg=cfg, batch=batch, seq_len=seq_len,
                 num_microbatches=2 if spec.get("pp", 1) > 1 else 1,

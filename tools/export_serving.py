@@ -72,8 +72,25 @@ def _build_mnist_mlp():
     return model, [x], ["x"]
 
 
+def _build_gpt():
+    """Causal-LM scoring artifact (r5): ids -> logits on the small
+    config (12L, GQA 12q/4kv, tied head). Serving-side decode runs in
+    serving.BatchedDecoder; this is the native-predictor scoring leg
+    (ranking/prefill-style serving)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import gpt as G
+
+    pt.seed(0)
+    model = G.GPTForCausalLM(G.GPTConfig.small()).eval()
+    ids = jnp.asarray(np.zeros((1, 128), np.int32))
+    return model, [ids], ["input_ids"]
+
+
 BUILDERS = {"resnet50": _build_resnet50, "bert_base": _build_bert_base,
-            "mnist_mlp": _build_mnist_mlp}
+            "mnist_mlp": _build_mnist_mlp, "gpt": _build_gpt}
 
 
 def _synthetic_calib_batches(example_args, n_batches=4, batch=8, seed=0):

@@ -165,6 +165,7 @@ item serve_bert        1500 bash -c 'make -C paddle_tpu/native -s ptserve && pyt
 # p50/p99 side of the same artifacts)
 item serve_rn50_int8   1800 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model resnet50 --quantize --out /tmp/rn50_int8 --platform cpu && paddle_tpu/native/ptserve /tmp/rn50_int8 "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
 item serve_bert_int8   1800 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model bert_base --quantize --out /tmp/bert_int8 --platform cpu && paddle_tpu/native/ptserve /tmp/bert_int8 "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
+item serve_gpt_nat     1800 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model gpt --out /tmp/gpt_art --platform cpu && paddle_tpu/native/ptserve /tmp/gpt_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 4 50'
 # -- tier 4: full-sweep completeness (superset of the retired
 # tpu_session.sh list so a FRESH environment gets every model and every
 # default tune shape from this one script; in an already-captured
