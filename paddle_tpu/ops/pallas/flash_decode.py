@@ -103,6 +103,87 @@ def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _paged_kernel(t_ref, table_ref, *rest, **kw):
+    """The paged variant IS _decode_kernel: page translation happens
+    entirely in the specs' index maps (which consume table_ref); the
+    kernel body masks by LOGICAL position only, so the online-softmax
+    math stays defined once."""
+    del table_ref
+    _decode_kernel(t_ref, *rest, **kw)
+
+
+def flash_decode_paged(q, kpool, vpool, table, t, *,
+                       window: Optional[int] = None,
+                       scale: Optional[float] = None,
+                       interpret: Optional[bool] = None):
+    """Paged decode attention (vLLM-style): the KV cache lives in a
+    SHARED page pool (pages, page_size, H_kv, D); each row's logical
+    cache is the page sequence ``table[b]`` (B, n_logical) of physical
+    page ids. One grid step loads one page — the scalar-prefetched
+    table drives the DMA, so a row reads ONLY its own live pages and
+    the pool can be sized to the live token count instead of
+    slots x max-capacity. q: (B, 1, H, D); t: scalar or (B,) per-row
+    cursors (LOGICAL positions). Returns (B, 1, H, D).
+
+    Entries of ``table`` beyond a row's live range may be garbage (the
+    index map clamps to the live page walk); pages are block_k-sized by
+    construction. The serving-side pool manager is
+    paddle_tpu.serving.PagedKVPool."""
+    b, tq, h, d = q.shape
+    enforce(tq == 1, "flash_decode_paged takes one query position, "
+            "got %s", tq)
+    enforce(window is None or window >= 1,
+            "window must be >= 1, got %s", window)
+    pages, block_k, kv_h, dk = kpool.shape
+    enforce(dk == d, "pool head_dim %s != q head_dim %s", dk, d)
+    enforce(h % kv_h == 0, "heads %s not divisible by kv heads %s", h,
+            kv_h)
+    n_log = table.shape[1]
+    enforce(table.shape[0] == b,
+            "table rows %s != batch %s", table.shape[0], b)
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    qh = q[:, 0]
+    t_arr = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (b,))
+    table = table.astype(jnp.int32)
+
+    def kv_imap(b_, j, t_, table_):
+        jj = jnp.minimum(j, t_[b_] // block_k)
+        if window is not None:
+            jj = jnp.maximum(
+                jj, jnp.maximum(t_[b_] - window + 1, 0) // block_k)
+        page = jnp.clip(table_[b_, jj], 0, pages - 1)
+        return (page, 0, 0, 0)
+
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, window=window, block_k=block_k,
+        n_j=n_log, nheads=h, kv_heads=kv_h)
+    qo_spec = pl.BlockSpec((1, h, d), lambda b_, j, t_, tb_: (b_, 0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_log),
+            in_specs=[
+                qo_spec,
+                pl.BlockSpec((1, block_k, kv_h, d), kv_imap),
+                pl.BlockSpec((1, block_k, kv_h, d), kv_imap),
+            ],
+            out_specs=qo_spec,
+            scratch_shapes=[
+                _scratch((h, d), jnp.float32),
+                _scratch((h, 128), jnp.float32),
+                _scratch((h, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(t_arr, table, qh, kpool, vpool)
+    return out[:, None]
+
+
 def decode_block_k(capacity: int, d: Optional[int] = None) -> Optional[int]:
     """kv block for a cache capacity: the on-chip tuned winner when the
     table has one (tools/pallas_tune.py --decode), else the largest

@@ -33,6 +33,135 @@ from .core.enforce import enforce
 from .ops.sampling import sample_from_logits
 
 
+class PagedKVPool:
+    """Shared page pool for paged-KV attention (vLLM-style): K and V
+    live in (pages, page_size, kv_heads, head_dim) pools shared by all
+    requests; each request owns a PAGE TABLE (its logical cache = the
+    page sequence), so memory scales with live tokens, not
+    slots x max-capacity. The attention side is
+    ops.pallas.flash_decode.flash_decode_paged (the scalar-prefetched
+    table drives the page DMA) with an XLA gather fallback.
+
+    Host-side alloc/free here; the pools are functional arrays — step
+    functions thread them like any cache (write_rows/write_chunk return
+    updated pools). Serving integration (BatchedDecoder paged mode) is
+    the round-6 hook; the building blocks are tested now
+    (tests/test_paged_kv.py)."""
+
+    def __init__(self, pages: int, page_size: int, kv_heads: int,
+                 head_dim: int, dtype=None):
+        enforce(page_size in (64, 128, 256),
+                "page_size must be one of (64, 128, 256), got %s",
+                page_size)
+        enforce(pages >= 1, "pages must be >= 1, got %s", pages)
+        from .core.dtypes import default_dtype
+
+        dt = dtype or default_dtype()
+        shape = (pages, page_size, kv_heads, head_dim)
+        self.kpool = jnp.zeros(shape, dt)
+        self.vpool = jnp.zeros(shape, dt)
+        self.page_size = page_size
+        self.pages = pages
+        self._free = list(range(pages - 1, -1, -1))
+        self._free_set = set(self._free)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> np.ndarray:
+        """Claim n pages (typed error when exhausted — the admission
+        backpressure signal)."""
+        enforce(n <= len(self._free),
+                "page pool exhausted: want %s, free %s", n,
+                len(self._free))
+        got = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(got)
+        return np.asarray(got, np.int32)
+
+    def free(self, ids) -> None:
+        """Return pages; a double free would hand the same physical
+        page to two requests (silent KV cross-contamination), so it is
+        a typed error instead."""
+        for i in np.asarray(ids).reshape(-1):
+            i = int(i)
+            enforce(0 <= i < self.pages,
+                    "page id %s outside pool (%s pages)", i, self.pages)
+            enforce(i not in self._free_set, "double free of page %s",
+                    i)
+            self._free.append(i)
+            self._free_set.add(i)
+
+    # --- functional array ops (jit-safe; thread the returned pools) --
+
+    @staticmethod
+    def write_rows(kpool, vpool, table, t_rows, k_t, v_t, page_size):
+        """One position per row at LOGICAL cursors ``t_rows`` (B,):
+        scatter k_t/v_t (B, 1, kv, hd) into each row's page. Cursors
+        past the row's table capacity DROP (the contiguous cache's
+        OOB-scatter semantics) instead of clamp-corrupting the last
+        live page."""
+        n_log = table.shape[1]
+        rows = jnp.arange(table.shape[0])
+        valid = t_rows < n_log * page_size
+        col = jnp.minimum(t_rows // page_size, n_log - 1)
+        # invalid rows get an out-of-pool page id -> mode="drop"
+        page = jnp.where(valid, table[rows, col], kpool.shape[0])
+        off = t_rows % page_size
+        kpool = kpool.at[page, off].set(k_t[:, 0].astype(kpool.dtype),
+                                        mode="drop")
+        vpool = vpool.at[page, off].set(v_t[:, 0].astype(vpool.dtype),
+                                        mode="drop")
+        return kpool, vpool
+
+    @staticmethod
+    def write_chunk(kpool, vpool, table_row, t0, k_c, v_c, page_size):
+        """S consecutive positions for ONE row starting at logical
+        ``t0``: k_c/v_c (1, S, kv, hd). Positions past the table
+        capacity drop (see write_rows)."""
+        s = k_c.shape[1]
+        n_log = table_row.shape[0]
+        pos = t0 + jnp.arange(s)
+        valid = pos < n_log * page_size
+        col = jnp.minimum(pos // page_size, n_log - 1)
+        page = jnp.where(valid, table_row[col], kpool.shape[0])
+        off = pos % page_size
+        kpool = kpool.at[page, off].set(k_c[0].astype(kpool.dtype),
+                                        mode="drop")
+        vpool = vpool.at[page, off].set(v_c[0].astype(vpool.dtype),
+                                        mode="drop")
+        return kpool, vpool
+
+    @staticmethod
+    def attend(q, kpool, vpool, table, t_rows, window=None):
+        """Decode attention over the paged cache: the Pallas paged
+        kernel when eligible, else gather-the-pages + masked XLA."""
+        from .ops import attention as A
+
+        d = q.shape[-1]
+        page_size, n_log = kpool.shape[1], table.shape[1]
+        # scalar cursor broadcasts on BOTH paths (the kernel already
+        # broadcasts; the gather fallback must match)
+        t_rows = jnp.broadcast_to(jnp.asarray(t_rows, jnp.int32),
+                                  (q.shape[0],))
+        if (A.decode_flash_ok(page_size * n_log, d)
+                and A._get_flash_decode() is not None):
+            from .ops.pallas.flash_decode import flash_decode_paged
+
+            return flash_decode_paged(q, kpool, vpool, table, t_rows,
+                                      window=window)
+        k = kpool[table].reshape(table.shape[0], n_log * page_size,
+                                 *kpool.shape[2:])
+        v = vpool[table].reshape(table.shape[0], n_log * page_size,
+                                 *vpool.shape[2:])
+        pos = jnp.arange(n_log * page_size)[None, :]
+        keep = pos <= t_rows[:, None]
+        if window is not None:
+            keep &= pos > t_rows[:, None] - window
+        return A.scaled_dot_product_attention(
+            q, k, v, mask=keep[:, None, None, :], use_flash=False)
+
+
 class Request:
     """One generation request; ``result`` is filled on completion."""
 

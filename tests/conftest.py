@@ -112,6 +112,7 @@ MID_PATTERNS = [
     "test_gpt.py::test_greedy_decode_matches_full_recompute",
     "test_speculative.py::test_forward_chunk_matches_sequential_steps",
     "test_pallas_decode.py::test_matches_oracle_across_cursor",
+    "test_paged_kv.py::test_pool_write_then_attend_decode_loop",
     "test_lora.py::test_trainable_subset_and_frozen_base",
     "test_vit.py::test_train_step_loss_decreases",
     "test_serving.py::test_more_requests_than_slots_all_complete",
