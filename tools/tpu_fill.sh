@@ -189,7 +189,7 @@ item tune_a512c        900  python tools/pallas_tune.py --attention 8,512,12,64 
 item tune_dec2048      900  python tools/pallas_tune.py --decode 16,2048,12,4,64
 item tune_dec64        900  python tools/pallas_tune.py --decode 32,64,8,8,64
 # -- tier 5: on-chip pallas test suite (slowest, least time-sensitive)
-item pallas_tests     1800 python -m pytest tests/test_pallas_attention.py tests/test_pallas_decode.py tests/test_quant_matmul.py -q
+item pallas_tests     1800 python -m pytest tests/test_pallas_attention.py tests/test_pallas_decode.py tests/test_paged_kv.py tests/test_quant_matmul.py -q
 
 if [ "$PENDING" -eq 0 ]; then
   log "=== all items done ==="
