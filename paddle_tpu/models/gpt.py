@@ -224,6 +224,28 @@ class GPTForCausalLM(Layer):
                 decode_kernel=decode_kernel))
         return logits[:, 0], caches
 
+    def _step_logits_paged(self, tok, pools, table, t_rows):
+        """One position PER ROW against PAGED caches: ``pools`` is the
+        per-block [(kpool, vpool), ...] list, ``table`` the shared
+        (B, n_log) page table. ``tok`` (B,) -> ((B, V) logits, pools)."""
+        logits, pools = self._cached_blocks(
+            self.embed(tok[:, None]), pools,
+            lambda sa, h, kp, vp: sa.forward_step_paged(
+                h, kp, vp, table, t_rows,
+                window=self.cfg.attn_window))
+        return logits[:, 0], pools
+
+    def _chunk_logits_paged(self, toks, pools, table_row, t0,
+                            head: bool = True):
+        """S prefill positions for ONE row against paged caches (see
+        _step_logits_paged). ``toks`` (1, S)."""
+        return self._cached_blocks(
+            self.embed(toks), pools,
+            lambda sa, h, kp, vp: sa.forward_chunk_paged(
+                h, kp, vp, table_row, t0,
+                window=self.cfg.attn_window),
+            head=head)
+
     def generate(self, prompt_ids, max_len: int, *, key=None,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,

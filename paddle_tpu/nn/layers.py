@@ -480,15 +480,7 @@ class _MHADecodeMixin:
         # one positions array shared by the k rotation here and the q
         # rotation inside attend_kv — they must never desynchronize
         pos_chunk = t0 + jnp.arange(s, dtype=jnp.int32)       # (S,)
-        k_c = self.k_proj(x_chunk).reshape(b, s, self.num_kv_heads,
-                                           self.head_dim)
-        v_c = self.v_proj(x_chunk).reshape(b, s, self.num_kv_heads,
-                                           self.head_dim)
-        if self.rotary:
-            from ..ops.attention import rotary_embedding
-
-            k_c = rotary_embedding(k_c, pos_chunk,
-                                   theta=self.rotary_theta)
+        k_c, v_c = self._project_kv_t(x_chunk, pos_chunk)
         cache_k = lax.dynamic_update_slice_in_dim(
             cache_k, k_c.astype(cache_k.dtype), t0, axis=1)
         cache_v = lax.dynamic_update_slice_in_dim(
@@ -507,6 +499,81 @@ class _MHADecodeMixin:
             decode_t=(t0 if decode_kernel and s == 1 else None),
             window=window)
         return out, cache_k, cache_v
+
+    def _project_kv_t(self, x_t, positions):
+        """Project (and rotate) this step's K/V: x_t (B, S, D) ->
+        (B, S, kv_heads, head_dim) each; ``positions`` (S,) or (B, S)
+        absolute positions for the rotary K convention."""
+        b, s, _ = x_t.shape
+        k_t = self.k_proj(x_t).reshape(b, s, self.num_kv_heads,
+                                       self.head_dim)
+        v_t = self.v_proj(x_t).reshape(b, s, self.num_kv_heads,
+                                       self.head_dim)
+        if self.rotary:
+            from ..ops.attention import rotary_embedding
+
+            k_t = rotary_embedding(k_t, positions,
+                                   theta=self.rotary_theta)
+        return k_t, v_t
+
+    def forward_step_paged(self, x_t, kpool, vpool, table, t_rows,
+                           window=None):
+        """One decode position PER ROW against a PAGED cache
+        (ops/paged_kv.py): project+rotate this position's K/V, scatter
+        into each row's page at its logical cursor, attend over the
+        row's pages (paged kernel when eligible, gather fallback).
+        ``x_t``: (B, 1, D); returns (out, kpool, vpool)."""
+        from ..ops import paged_kv
+
+        pos_rows = t_rows.astype(jnp.int32)[:, None]          # (B, 1)
+        k_t, v_t = self._project_kv_t(x_t, pos_rows)
+        kpool, vpool = paged_kv.write_rows(
+            kpool, vpool, table, pos_rows[:, 0], k_t, v_t,
+            kpool.shape[1])
+        out = paged_kv.attend(
+            self._rotated_q(x_t, pos_rows), kpool, vpool, table,
+            pos_rows[:, 0], window=window)
+        b, tq, d = x_t.shape
+        return (self.out_proj(out.reshape(b, tq, d)), kpool, vpool)
+
+    def forward_chunk_paged(self, x_chunk, kpool, vpool, table_row,
+                            t0, window=None):
+        """S prefill positions for ONE row (batch 1) against the paged
+        cache: chunk-write, then attend each position i over pages up
+        to t0+i (gather path — prefill runs once per request).
+        ``x_chunk``: (1, S, D); returns (out, kpool, vpool)."""
+        from ..ops import paged_kv
+        from ..ops.attention import scaled_dot_product_attention
+
+        b, s, d = x_chunk.shape
+        pos_chunk = t0 + jnp.arange(s, dtype=jnp.int32)       # (S,)
+        k_c, v_c = self._project_kv_t(x_chunk, pos_chunk)
+        kpool, vpool = paged_kv.write_chunk(
+            kpool, vpool, table_row, t0, k_c, v_c, kpool.shape[1])
+        k = paged_kv.gather_rows(kpool, table_row[None])
+        v = paged_kv.gather_rows(vpool, table_row[None])
+        cap = k.shape[1]
+        pos = jnp.arange(cap)
+        keep = pos[None, :] <= pos_chunk[:, None]             # (S, cap)
+        if window is not None:
+            keep &= pos[None, :] > pos_chunk[:, None] - window
+        out = scaled_dot_product_attention(
+            self._rotated_q(x_chunk, pos_chunk), k, v,
+            mask=keep[None, None], use_flash=False)
+        return (self.out_proj(out.reshape(b, s, d)), kpool, vpool)
+
+    def _rotated_q(self, query, positions):
+        """Projected (and rotated) q for the paged paths — the same
+        prologue attend_kv applies."""
+        from ..ops.attention import rotary_embedding
+
+        b, tq, d = query.shape
+        q = self.q_proj(query).reshape(b, tq, self.num_heads,
+                                       self.head_dim)
+        if self.rotary:
+            q = rotary_embedding(q, positions,
+                                 theta=self.rotary_theta)
+        return q
 
     def forward_step(self, x_t, cache_k, cache_v, t, window=None,
                      decode_kernel: bool = False):
@@ -528,15 +595,7 @@ class _MHADecodeMixin:
         b = x_t.shape[0]
         cap = cache_k.shape[1]
         pos_rows = t_rows.astype(jnp.int32)[:, None]          # (B, 1)
-        k_t = self.k_proj(x_t).reshape(b, 1, self.num_kv_heads,
-                                       self.head_dim)
-        v_t = self.v_proj(x_t).reshape(b, 1, self.num_kv_heads,
-                                       self.head_dim)
-        if self.rotary:
-            from ..ops.attention import rotary_embedding
-
-            k_t = rotary_embedding(k_t, pos_rows,
-                                   theta=self.rotary_theta)
+        k_t, v_t = self._project_kv_t(x_t, pos_rows)
         write = jax.vmap(lambda c, u, s: lax.dynamic_update_slice_in_dim(
             c, u, s, axis=0))
         cache_k = write(cache_k, k_t.astype(cache_k.dtype),

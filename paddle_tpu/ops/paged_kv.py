@@ -1,0 +1,92 @@
+"""Functional paged-KV cache ops (vLLM-style): K/V live in a SHARED
+(pages, page_size, kv_heads, head_dim) pool; a request's logical cache
+is its page-id sequence. These are the jit-safe array ops — write one
+position per row, write a prompt chunk for one row, attend over the
+pages (Pallas paged kernel when eligible, gather fallback). The
+host-side allocator is paddle_tpu.serving.PagedKVPool.
+
+Green-field (the modern serving-memory capability; the reference's
+serving holds one contiguous buffer per request,
+/root/reference/paddle/fluid/inference/api/api_impl.cc role).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def write_rows(kpool, vpool, table, t_rows, k_t, v_t, page_size: int):
+    """One position per row at LOGICAL cursors ``t_rows`` (B,): scatter
+    k_t/v_t (B, 1, kv, hd) into each row's page. Cursors past the
+    row's table capacity DROP (the contiguous cache's OOB-scatter
+    semantics) instead of clamp-corrupting the last live page."""
+    n_log = table.shape[1]
+    rows = jnp.arange(table.shape[0])
+    valid = t_rows < n_log * page_size
+    col = jnp.minimum(t_rows // page_size, n_log - 1)
+    # invalid rows get an out-of-pool page id -> mode="drop"
+    page = jnp.where(valid, table[rows, col], kpool.shape[0])
+    off = t_rows % page_size
+    kpool = kpool.at[page, off].set(k_t[:, 0].astype(kpool.dtype),
+                                    mode="drop")
+    vpool = vpool.at[page, off].set(v_t[:, 0].astype(vpool.dtype),
+                                    mode="drop")
+    return kpool, vpool
+
+
+def write_chunk(kpool, vpool, table_row, t0, k_c, v_c, page_size: int):
+    """S consecutive positions for ONE row starting at logical ``t0``:
+    k_c/v_c (1, S, kv, hd). Positions past the table capacity drop
+    (see write_rows)."""
+    s = k_c.shape[1]
+    n_log = table_row.shape[0]
+    pos = t0 + jnp.arange(s)
+    valid = pos < n_log * page_size
+    col = jnp.minimum(pos // page_size, n_log - 1)
+    page = jnp.where(valid, table_row[col], kpool.shape[0])
+    off = pos % page_size
+    kpool = kpool.at[page, off].set(k_c[0].astype(kpool.dtype),
+                                    mode="drop")
+    vpool = vpool.at[page, off].set(v_c[0].astype(vpool.dtype),
+                                    mode="drop")
+    return kpool, vpool
+
+
+def gather_rows(pool, table):
+    """Assemble each row's LOGICAL cache: (B, n_log*page_size, kv, hd).
+    The fallback/prefill view; the decode kernel never materializes
+    it."""
+    b, n_log = table.shape
+    return pool[table].reshape(b, n_log * pool.shape[1],
+                               *pool.shape[2:])
+
+
+def attend(q, kpool, vpool, table, t_rows,
+           window: Optional[int] = None):
+    """Decode attention over the paged cache: the Pallas paged kernel
+    when eligible, else gather-the-pages + masked XLA. ``t_rows``:
+    scalar or (B,) logical cursors."""
+    from . import attention as A
+
+    d = q.shape[-1]
+    page_size, n_log = kpool.shape[1], table.shape[1]
+    # scalar cursor broadcasts on BOTH paths (the kernel already
+    # broadcasts; the gather fallback must match)
+    t_rows = jnp.broadcast_to(jnp.asarray(t_rows, jnp.int32),
+                              (q.shape[0],))
+    if (A.decode_flash_ok(page_size * n_log, d)
+            and A._get_flash_decode() is not None):
+        from .pallas.flash_decode import flash_decode_paged
+
+        return flash_decode_paged(q, kpool, vpool, table, t_rows,
+                                  window=window)
+    k = gather_rows(kpool, table)
+    v = gather_rows(vpool, table)
+    pos = jnp.arange(n_log * page_size)[None, :]
+    keep = pos <= t_rows[:, None]
+    if window is not None:
+        keep &= pos > t_rows[:, None] - window
+    return A.scaled_dot_product_attention(
+        q, k, v, mask=keep[:, None, None, :], use_flash=False)

@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 
 from .core.enforce import enforce
+from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
 
 
@@ -49,17 +50,20 @@ class PagedKVPool:
     (tests/test_paged_kv.py)."""
 
     def __init__(self, pages: int, page_size: int, kv_heads: int,
-                 head_dim: int, dtype=None):
+                 head_dim: int, dtype=None, arrays: bool = True):
         enforce(page_size in (64, 128, 256),
                 "page_size must be one of (64, 128, 256), got %s",
                 page_size)
         enforce(pages >= 1, "pages must be >= 1, got %s", pages)
         from .core.dtypes import default_dtype
 
-        dt = dtype or default_dtype()
-        shape = (pages, page_size, kv_heads, head_dim)
-        self.kpool = jnp.zeros(shape, dt)
-        self.vpool = jnp.zeros(shape, dt)
+        self.dtype = dtype or default_dtype()
+        self.shape = (pages, page_size, kv_heads, head_dim)
+        # arrays=False: allocator-only (callers that thread their own
+        # functional pools — BatchedDecoder — must not pin two extra
+        # pool-sized device buffers here for the decoder's lifetime)
+        self.kpool = jnp.zeros(self.shape, self.dtype) if arrays else None
+        self.vpool = jnp.zeros(self.shape, self.dtype) if arrays else None
         self.page_size = page_size
         self.pages = pages
         self._free = list(range(pages - 1, -1, -1))
@@ -92,74 +96,12 @@ class PagedKVPool:
             self._free.append(i)
             self._free_set.add(i)
 
-    # --- functional array ops (jit-safe; thread the returned pools) --
+    # --- functional array ops (jit-safe; thread the returned pools;
+    # ONE definition in ops/paged_kv.py, re-exported here) ------------
 
-    @staticmethod
-    def write_rows(kpool, vpool, table, t_rows, k_t, v_t, page_size):
-        """One position per row at LOGICAL cursors ``t_rows`` (B,):
-        scatter k_t/v_t (B, 1, kv, hd) into each row's page. Cursors
-        past the row's table capacity DROP (the contiguous cache's
-        OOB-scatter semantics) instead of clamp-corrupting the last
-        live page."""
-        n_log = table.shape[1]
-        rows = jnp.arange(table.shape[0])
-        valid = t_rows < n_log * page_size
-        col = jnp.minimum(t_rows // page_size, n_log - 1)
-        # invalid rows get an out-of-pool page id -> mode="drop"
-        page = jnp.where(valid, table[rows, col], kpool.shape[0])
-        off = t_rows % page_size
-        kpool = kpool.at[page, off].set(k_t[:, 0].astype(kpool.dtype),
-                                        mode="drop")
-        vpool = vpool.at[page, off].set(v_t[:, 0].astype(vpool.dtype),
-                                        mode="drop")
-        return kpool, vpool
-
-    @staticmethod
-    def write_chunk(kpool, vpool, table_row, t0, k_c, v_c, page_size):
-        """S consecutive positions for ONE row starting at logical
-        ``t0``: k_c/v_c (1, S, kv, hd). Positions past the table
-        capacity drop (see write_rows)."""
-        s = k_c.shape[1]
-        n_log = table_row.shape[0]
-        pos = t0 + jnp.arange(s)
-        valid = pos < n_log * page_size
-        col = jnp.minimum(pos // page_size, n_log - 1)
-        page = jnp.where(valid, table_row[col], kpool.shape[0])
-        off = pos % page_size
-        kpool = kpool.at[page, off].set(k_c[0].astype(kpool.dtype),
-                                        mode="drop")
-        vpool = vpool.at[page, off].set(v_c[0].astype(vpool.dtype),
-                                        mode="drop")
-        return kpool, vpool
-
-    @staticmethod
-    def attend(q, kpool, vpool, table, t_rows, window=None):
-        """Decode attention over the paged cache: the Pallas paged
-        kernel when eligible, else gather-the-pages + masked XLA."""
-        from .ops import attention as A
-
-        d = q.shape[-1]
-        page_size, n_log = kpool.shape[1], table.shape[1]
-        # scalar cursor broadcasts on BOTH paths (the kernel already
-        # broadcasts; the gather fallback must match)
-        t_rows = jnp.broadcast_to(jnp.asarray(t_rows, jnp.int32),
-                                  (q.shape[0],))
-        if (A.decode_flash_ok(page_size * n_log, d)
-                and A._get_flash_decode() is not None):
-            from .ops.pallas.flash_decode import flash_decode_paged
-
-            return flash_decode_paged(q, kpool, vpool, table, t_rows,
-                                      window=window)
-        k = kpool[table].reshape(table.shape[0], n_log * page_size,
-                                 *kpool.shape[2:])
-        v = vpool[table].reshape(table.shape[0], n_log * page_size,
-                                 *vpool.shape[2:])
-        pos = jnp.arange(n_log * page_size)[None, :]
-        keep = pos <= t_rows[:, None]
-        if window is not None:
-            keep &= pos > t_rows[:, None] - window
-        return A.scaled_dot_product_attention(
-            q, k, v, mask=keep[:, None, None, :], use_flash=False)
+    write_rows = staticmethod(paged_ops.write_rows)
+    write_chunk = staticmethod(paged_ops.write_chunk)
+    attend = staticmethod(paged_ops.attend)
 
 
 class Request:
@@ -189,7 +131,8 @@ class BatchedDecoder:
     def __init__(self, model, slots: int, capacity: int, *,
                  eos_id: Optional[int] = None, key=None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, prompt_bucket: int = 16):
+                 top_p: float = 1.0, prompt_bucket: int = 16,
+                 pages: Optional[int] = None, page_size: int = 128):
         enforce(slots >= 1, "slots must be >= 1, got %s", slots)
         enforce(capacity >= prompt_bucket,
                 "capacity %s < prompt bucket %s", capacity,
@@ -204,11 +147,41 @@ class BatchedDecoder:
                     "temperature > 0 samples and needs a PRNG key")
         self.key = key if key is not None else jax.random.key(0)
         self.bucket = prompt_bucket
-        # arena: per-block (slots, cap, h_kv, hd) caches
-        self.caches = [blk.self_attn.init_cache(slots, capacity)
-                       for blk in model.blocks]
+        # PAGED mode (pages=N): K/V live in per-block SHARED page pools
+        # + one page table — memory scales with live tokens (pages
+        # actually allocated), not slots x capacity; admission
+        # backpressures on pool exhaustion. Contiguous mode (default):
+        # per-block (slots, cap, h_kv, hd) arenas.
+        self.paged = pages is not None
+        if self.paged:
+            enforce(capacity % page_size == 0,
+                    "capacity %s not divisible by page_size %s",
+                    capacity, page_size)
+            attn0 = model.blocks[0].self_attn
+            self._allocator = PagedKVPool(
+                pages, page_size, attn0.num_kv_heads, attn0.head_dim,
+                arrays=False)
+            self.page_size = page_size
+            self.n_log = capacity // page_size
+            al = self._allocator
+            self.pools = [(jnp.zeros(al.shape, al.dtype),
+                           jnp.zeros(al.shape, al.dtype))
+                          for _ in model.blocks]
+            self.table = np.zeros((slots, self.n_log), np.int32)
+            self._slot_pages: List[Optional[np.ndarray]] = \
+                [None] * slots
+        else:
+            self.caches = [blk.self_attn.init_cache(slots, capacity)
+                           for blk in model.blocks]
         self.tok = jnp.zeros((slots,), jnp.int32)      # last token/slot
-        self.t = jnp.zeros((slots,), jnp.int32)        # cursor/slot
+        # cursors: paged mode parks EVERY not-yet-admitted slot past
+        # capacity — an idle slot's table row is zeros, and a cursor of
+        # 0 would scatter its junk K/V into physical page 0, which the
+        # allocator hands to the first real request (write_rows drops
+        # OOB cursors instead). Contiguous slots own private rows, so
+        # 0 is harmless there.
+        self.t = jnp.full((slots,),
+                          capacity if self.paged else 0, jnp.int32)
         self.active = np.zeros((slots,), bool)         # host-side
         self.budget = np.zeros((slots,), np.int64)     # tokens left
         self.owner: List[Optional[Request]] = [None] * slots
@@ -231,6 +204,14 @@ class BatchedDecoder:
         enforce(len(r.prompt) + max_new <= self.capacity,
                 "prompt %s + max_new %s exceeds slot capacity %s",
                 len(r.prompt), max_new, self.capacity)
+        if self.paged:
+            # a demand beyond the WHOLE pool could never be admitted —
+            # _admit would re-queue it forever (silent run() hang)
+            need = ((len(r.prompt) + max_new + self.page_size - 1)
+                    // self.page_size)
+            enforce(need <= self._allocator.pages,
+                    "request needs %s pages but the pool only has %s",
+                    need, self._allocator.pages)
         self._next_rid += 1
         self.queue.append(r)
         return r.rid
@@ -292,8 +273,33 @@ class BatchedDecoder:
         self._prefill_cache[lb] = fn
         return fn
 
+    def _prefill_fn_paged(self, lb: int):
+        """Jitted paged prefill for bucket length lb: chunk-write the
+        prompt into the row's pages cache-only, then one re-step of the
+        last token for the next-token logits."""
+        fn = self._prefill_cache.get(("paged", lb))
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def prefill(pools, table_row, padded, plen):
+            _, pools = model._chunk_logits_paged(
+                padded[None], pools, table_row, 0, head=False)
+            last = lax.dynamic_index_in_dim(padded, plen - 1,
+                                            keepdims=False)
+            logits, pools = model._step_logits_paged(
+                last[None], pools, table_row[None],
+                jnp.full((1,), plen - 1, jnp.int32))
+            return pools, logits[0]
+
+        fn = jax.jit(prefill)
+        self._prefill_cache[("paged", lb)] = fn
+        return fn
+
     def _admit(self):
-        """Fill every free slot from the queue (prefill + first token)."""
+        """Fill every free slot from the queue (prefill + first token).
+        Paged mode backpressures: a request whose page demand exceeds
+        the free pool stays queued until completions free pages."""
         for s in range(self.slots):
             if self.active[s] or not self.queue:
                 continue
@@ -302,8 +308,23 @@ class BatchedDecoder:
             lb = self._bucket_len(plen)
             padded = np.zeros((lb,), np.int32)
             padded[:plen] = r.prompt
-            self.caches, logits = self._prefill_fn(lb)(
-                self.caches, jnp.asarray(padded), plen, s)
+            if self.paged:
+                need = ((plen + r.max_new + self.page_size - 1)
+                        // self.page_size)
+                if need > self._allocator.free_pages:
+                    self.queue.insert(0, r)     # wait for completions
+                    break
+                ids = self._allocator.alloc(need)
+                row = np.zeros((self.n_log,), np.int32)
+                row[:need] = ids
+                self.table[s] = row
+                self._slot_pages[s] = ids
+                self.pools, logits = self._prefill_fn_paged(lb)(
+                    self.pools, jnp.asarray(row), jnp.asarray(padded),
+                    plen)
+            else:
+                self.caches, logits = self._prefill_fn(lb)(
+                    self.caches, jnp.asarray(padded), plen, s)
             self.owner[s] = r
             self._slot_gen[s] = self.gen_count
             self.gen_count += 1
@@ -328,14 +349,20 @@ class BatchedDecoder:
     def _build_step(self):
         model = self.model
 
-        def step(caches, tok, t):
-            # ONE un-vmapped program over the whole arena: per-row
-            # cursors thread through forward_step_rows, so the
-            # flash-decode kernel (per-row scalar prefetch) is eligible
-            # — each slot reads only ITS live cache blocks from HBM
-            logits, caches = model._step_logits_rows(
-                tok, caches, t, decode_kernel=True)
-            return caches, logits
+        if self.paged:
+            def step(pools, table, tok, t):
+                logits, pools = model._step_logits_paged(
+                    tok, pools, table, t)
+                return pools, logits
+        else:
+            def step(caches, tok, t):
+                # ONE un-vmapped program over the whole arena: per-row
+                # cursors thread through forward_step_rows, so the
+                # flash-decode kernel (per-row scalar prefetch) is
+                # eligible — each slot reads only ITS live cache blocks
+                logits, caches = model._step_logits_rows(
+                    tok, caches, t, decode_kernel=True)
+                return caches, logits
 
         return jax.jit(step)
 
@@ -345,8 +372,12 @@ class BatchedDecoder:
         if self._step_fn is None:
             self._step_fn = self._build_step()
         was_active = self.active.copy()
-        self.caches, logits = self._step_fn(self.caches, self.tok,
-                                            self.t)
+        if self.paged:
+            self.pools, logits = self._step_fn(
+                self.pools, jnp.asarray(self.table), self.tok, self.t)
+        else:
+            self.caches, logits = self._step_fn(self.caches, self.tok,
+                                                self.t)
         # ONE batched pick over all slots (a per-slot un-jitted
         # dispatch would dominate the loop this module exists to make
         # fast); the token lands at position t+1, so that is its key
@@ -383,3 +414,10 @@ class BatchedDecoder:
             self.owner[s] = None
             self.active[s] = False
             self.emitted[s] = []
+            if self.paged and self._slot_pages[s] is not None:
+                # freed pages may be REALLOCATED to another request, so
+                # the retired slot's stale step-writes must DROP: park
+                # its cursor past capacity (write_rows' OOB semantics)
+                self._allocator.free(self._slot_pages[s])
+                self._slot_pages[s] = None
+                self.t = self.t.at[s].set(self.capacity)
