@@ -45,10 +45,11 @@ def main():
     wrapped = quant.apply_weight_only_int8(target)
     print(f"W8A16: {len(wrapped)} projections quantized")
 
-    # --- continuous batching: 6 requests over 3 slots -----------------
-    dec = BatchedDecoder(target, slots=3, capacity=64,
-                         key=jax.random.key(0), temperature=0.8,
-                         top_p=0.9, eos_id=7)
+    # --- continuous batching over a PAGED KV cache: 6 requests over 3
+    # slots sharing a page pool (memory scales with live tokens) ------
+    dec = BatchedDecoder(target, slots=3, capacity=128, pages=8,
+                         page_size=64, key=jax.random.key(0),
+                         temperature=0.8, top_p=0.9, eos_id=7)
     rng = np.random.default_rng(0)
     rids = [dec.submit(rng.integers(1, 512, (n,)), max_new=16)
             for n in (4, 9, 5, 7, 3, 6)]
