@@ -729,7 +729,7 @@ def bench_gpt_decode(steps: int, batch_size: int, amp=None,
 
 def bench_gpt_serve(steps: int, batch_size: int, amp=None,
                     max_new: int = 64, smoke: bool = False,
-                    weight_only: bool = False):
+                    weight_only: bool = False, paged: bool = False):
     """Continuous-batching serving throughput (serving.BatchedDecoder):
     2x``batch_size`` requests with MIXED prompt lengths over a
     ``batch_size``-slot arena — generated tokens/sec across the whole
@@ -760,8 +760,14 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     lens = [int(8 + (i * 7) % 24) for i in range(n_req)]  # mixed
     # ONE decoder across warmup + timed runs: its jitted step and
     # prefill-bucket functions cache per-instance, so a fresh decoder
-    # per run would re-trace inside the timed loop
-    dec = BatchedDecoder(model, slots=slots, capacity=cap)
+    # per run would re-trace inside the timed loop. --paged serves over
+    # the shared page pool (memory ~ live tokens) instead of the
+    # slots x capacity arena.
+    kw = {}
+    if paged:
+        kw = dict(pages=max(slots * (cap // 64) // 2, slots),
+                  page_size=64)
+    dec = BatchedDecoder(model, slots=slots, capacity=cap, **kw)
 
     def run_all():
         scope = policy_scope(amp) if amp else contextlib.nullcontext()
@@ -1097,6 +1103,7 @@ def run_config_fingerprint(metric: str, args, steps: int):
         "steps_per_call": args.steps_per_call, "vocab": args.vocab,
         "window": args.window, "kv_cache": args.kv_cache,
         "gamma": args.gamma, "weight_only": args.weight_only,
+        "paged": args.paged,
         "layout": args.layout, "dp": args.dp, "infer": args.infer,
     }
     # None = knob not set; False values (e.g. --no-fused-ce) are REAL
@@ -1248,6 +1255,9 @@ def main():
     ap.add_argument("--window", type=int, default=None,
                     help="bert_long: sliding-window attention width "
                     "(O(T*W) local attention vs the O(T^2) default)")
+    ap.add_argument("--paged", action="store_true",
+                    help="gpt_serve: paged-KV arena (page pool sized "
+                    "to ~half the dense slots x capacity)")
     ap.add_argument("--weight-only", dest="weight_only",
                     action="store_true",
                     help="gpt_decode/gpt_serve: weight-only int8 "
@@ -1317,6 +1327,9 @@ def main():
         # same workload, different weight storage — own history key so
         # the W8A16-vs-bf16 comparison stays visible
         metric += "_w8"
+    if args.paged and "paged" in sig:
+        # different cache layout (page pool vs dense arena): own key
+        metric += "_paged"
     if "cached" in sig and not args.kv_cache:
         # same workload, different implementation — its own history key
         # so the cache-vs-recompute comparison stays visible
@@ -1431,6 +1444,8 @@ def main():
         kwargs["gamma"] = args.gamma
     if args.weight_only and "weight_only" in sig:
         kwargs["weight_only"] = True
+    if args.paged and "paged" in sig:
+        kwargs["paged"] = True
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call

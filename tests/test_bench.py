@@ -172,7 +172,7 @@ def test_run_config_fingerprint_identity():
                     scan_layers=False, scan_unroll=None,
                     steps_per_call=None, vocab=None, window=None,
                     kv_cache=True, layout=None, dp=1, infer=False,
-                    gamma=None, weight_only=False)
+                    gamma=None, weight_only=False, paged=False)
         base.update(kw)
         return argparse.Namespace(**base)
 
@@ -369,3 +369,10 @@ def test_gpt_serve_bench_contract():
               "--batch-size", "2", "--weight-only", timeout=900)
     assert d2["metric"] == "gpt_serve_throughput_w8_b2"
     assert d2["value"] > 0
+
+
+def test_gpt_serve_paged_key():
+    d = _run("--model", "gpt_serve", "--smoke", "--steps", "50",
+             "--batch-size", "2", "--paged", timeout=900)
+    assert d["metric"] == "gpt_serve_throughput_paged_b2"
+    assert d["value"] > 0

@@ -155,6 +155,7 @@ item decode_gpt_w8     1500 python bench.py --model gpt_decode --weight-only
 # over the slot arena; admission/refill included)
 item serve_gpt_cb      1800 python bench.py --model gpt_serve
 item serve_gpt_cb_w8   1800 python bench.py --model gpt_serve --weight-only
+item serve_gpt_cb_pg   1800 python bench.py --model gpt_serve --paged
 # NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
 # C++ predictor + PJRT C API (export runs off-chip: StableHLO is
 # portable; only the ptserve compile+run needs the chip)
