@@ -46,6 +46,7 @@ if [ "$MODE" = "full" ]; then
   run python bench.py --model gpt_decode --gamma 4
   run python bench.py --model gpt_serve
   run python bench.py --model gpt_serve --weight-only
+  run python bench.py --model gpt_serve --paged
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py
