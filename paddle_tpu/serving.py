@@ -68,6 +68,10 @@ class PagedKVPool:
         self.pages = pages
         self._free = list(range(pages - 1, -1, -1))
         self._free_set = set(self._free)
+        # reference counts (prefix caching: a page shared by N live
+        # requests + the registry has ref N+1 and only returns to the
+        # free list at 0)
+        self._ref = np.zeros(pages, np.int64)
 
     @property
     def free_pages(self) -> int:
@@ -81,20 +85,35 @@ class PagedKVPool:
                 len(self._free))
         got = [self._free.pop() for _ in range(n)]
         self._free_set.difference_update(got)
+        for i in got:
+            self._ref[i] = 1
         return np.asarray(got, np.int32)
 
-    def free(self, ids) -> None:
-        """Return pages; a double free would hand the same physical
-        page to two requests (silent KV cross-contamination), so it is
-        a typed error instead."""
+    def share(self, ids) -> None:
+        """Take an extra reference on live pages (prefix caching)."""
         for i in np.asarray(ids).reshape(-1):
             i = int(i)
             enforce(0 <= i < self.pages,
                     "page id %s outside pool (%s pages)", i, self.pages)
-            enforce(i not in self._free_set, "double free of page %s",
-                    i)
-            self._free.append(i)
-            self._free_set.add(i)
+            enforce(self._ref[i] > 0,
+                    "share of unallocated page %s", i)
+            self._ref[i] += 1
+
+    def free(self, ids) -> None:
+        """Drop one reference per page; a page returns to the free list
+        at refcount 0. Over-freeing would hand the same physical page
+        to two requests (silent KV cross-contamination), so it is a
+        typed error instead."""
+        for i in np.asarray(ids).reshape(-1):
+            i = int(i)
+            enforce(0 <= i < self.pages,
+                    "page id %s outside pool (%s pages)", i, self.pages)
+            enforce(i not in self._free_set and self._ref[i] > 0,
+                    "double free of page %s", i)
+            self._ref[i] -= 1
+            if self._ref[i] == 0:
+                self._free.append(i)
+                self._free_set.add(i)
 
     # --- functional array ops (jit-safe; thread the returned pools;
     # ONE definition in ops/paged_kv.py, re-exported here) ------------
@@ -132,7 +151,8 @@ class BatchedDecoder:
                  eos_id: Optional[int] = None, key=None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, prompt_bucket: int = 16,
-                 pages: Optional[int] = None, page_size: int = 128):
+                 pages: Optional[int] = None, page_size: int = 128,
+                 prefix_cache: bool = False):
         enforce(slots >= 1, "slots must be >= 1, got %s", slots)
         enforce(capacity >= prompt_bucket,
                 "capacity %s < prompt bucket %s", capacity,
@@ -157,6 +177,11 @@ class BatchedDecoder:
             enforce(capacity % page_size == 0,
                     "capacity %s not divisible by page_size %s",
                     capacity, page_size)
+            enforce(page_size % prompt_bucket == 0,
+                    "page_size %s must be a multiple of prompt_bucket "
+                    "%s (bucket round-up must never overrun the "
+                    "allocated pages into another request's page 0)",
+                    page_size, prompt_bucket)
             attn0 = model.blocks[0].self_attn
             self._allocator = PagedKVPool(
                 pages, page_size, attn0.num_kv_heads, attn0.head_dim,
@@ -170,7 +195,21 @@ class BatchedDecoder:
             self.table = np.zeros((slots, self.n_log), np.int32)
             self._slot_pages: List[Optional[np.ndarray]] = \
                 [None] * slots
+            # prefix caching (opt-in): completed requests REGISTER
+            # their page-aligned prompt-prefix pages (one registry
+            # reference via the allocator's refcounts); a later request
+            # sharing that exact token prefix reuses the pages and
+            # prefills only its suffix. Insertion-ordered dict = LRU
+            # (hits re-insert); eviction frees registry references when
+            # admission runs dry. K/V in a shared page are a pure
+            # function of (tokens, positions, weights), so reuse is
+            # exact.
+            self.prefix_cache = prefix_cache
+            self._prefix_registry: Dict[tuple, np.ndarray] = {}
+            self.prefix_hits = 0
         else:
+            enforce(not prefix_cache,
+                    "prefix_cache requires paged mode (pages=N)")
             self.caches = [blk.self_attn.init_cache(slots, capacity)
                            for blk in model.blocks]
         self.tok = jnp.zeros((slots,), jnp.int32)      # last token/slot
@@ -296,6 +335,59 @@ class BatchedDecoder:
         self._prefill_cache[("paged", lb)] = fn
         return fn
 
+    def _suffix_fns(self, lb: int):
+        """Prefix-hit prefill pieces: cache-only chunk of the SUFFIX at
+        a page-aligned offset (one compile per bucket) and the
+        lb-independent last-token re-step (compiled ONCE; also used
+        alone when the whole prompt is cached)."""
+        model = self.model
+        chunk_fn = self._prefill_cache.get(("suffix", lb))
+        if chunk_fn is None:
+            def chunk(pools, table_row, padded, t0):
+                _, pools = model._chunk_logits_paged(
+                    padded[None], pools, table_row, t0, head=False)
+                return pools
+
+            chunk_fn = jax.jit(chunk)
+            self._prefill_cache[("suffix", lb)] = chunk_fn
+        restep_fn = self._prefill_cache.get(("restep",))
+        if restep_fn is None:
+            def restep(pools, table_row, tok, pos):
+                logits, pools = model._step_logits_paged(
+                    tok[None], pools, table_row[None],
+                    jnp.full((1,), pos, jnp.int32))
+                return pools, logits[0]
+
+            restep_fn = jax.jit(restep)
+            self._prefill_cache[("restep",)] = restep_fn
+        return chunk_fn, restep_fn
+
+    def _prefix_key(self, prompt: np.ndarray, n: int) -> bytes:
+        return np.ascontiguousarray(prompt[:n], np.int32).tobytes()
+
+    def _lookup_prefix(self, prompt: np.ndarray):
+        """Longest registered page-aligned prefix of ``prompt`` ->
+        (pages, cached_len); LRU-touches the hit. Keys are the raw
+        token bytes (one memcpy + C-level hash, not per-int boxing)."""
+        if not self._prefix_registry:
+            return None, 0
+        ps = self.page_size
+        for k in range(min(len(prompt) // ps, self.n_log), 0, -1):
+            key_t = self._prefix_key(prompt, k * ps)
+            e = self._prefix_registry.pop(key_t, None)
+            if e is not None:
+                self._prefix_registry[key_t] = e      # LRU re-insert
+                return e, k * ps
+        return None, 0
+
+    def _evict_prefixes(self, want: int):
+        """Drop oldest registry entries until ``want`` pages are free
+        (pages still referenced by live requests stay allocated)."""
+        while (self._prefix_registry
+               and self._allocator.free_pages < want):
+            key_t = next(iter(self._prefix_registry))
+            self._allocator.free(self._prefix_registry.pop(key_t))
+
     def _admit(self):
         """Fill every free slot from the queue (prefill + first token).
         Paged mode backpressures: a request whose page demand exceeds
@@ -309,19 +401,59 @@ class BatchedDecoder:
             padded = np.zeros((lb,), np.int32)
             padded[:plen] = r.prompt
             if self.paged:
+                hit, cached = (self._lookup_prefix(r.prompt)
+                               if self.prefix_cache else (None, 0))
+                if hit is not None:
+                    # PIN before any eviction: _evict_prefixes may drop
+                    # the hit's own registry entry, and an unpinned hit
+                    # would be freed and handed straight back by
+                    # alloc() — the same physical page twice in one
+                    # table (silent KV corruption)
+                    self._allocator.share(hit)
                 need = ((plen + r.max_new + self.page_size - 1)
                         // self.page_size)
-                if need > self._allocator.free_pages:
+                need_new = need - cached // self.page_size
+                if need_new > self._allocator.free_pages:
+                    self._evict_prefixes(need_new)
+                if need_new > self._allocator.free_pages:
+                    if hit is not None:
+                        self._allocator.free(hit)   # unpin
                     self.queue.insert(0, r)     # wait for completions
                     break
-                ids = self._allocator.alloc(need)
+                new_ids = self._allocator.alloc(need_new)
+                if hit is not None:
+                    self.prefix_hits += 1
+                    ids = np.concatenate([hit, new_ids])
+                else:
+                    ids = new_ids
                 row = np.zeros((self.n_log,), np.int32)
                 row[:need] = ids
                 self.table[s] = row
                 self._slot_pages[s] = ids
-                self.pools, logits = self._prefill_fn_paged(lb)(
-                    self.pools, jnp.asarray(row), jnp.asarray(padded),
-                    plen)
+                if cached == 0:
+                    self.pools, logits = self._prefill_fn_paged(lb)(
+                        self.pools, jnp.asarray(row),
+                        jnp.asarray(padded), plen)
+                else:
+                    # prefill only the uncached suffix (page-aligned
+                    # t0), then the usual last-token re-step for the
+                    # next-token logits — handles a fully-cached
+                    # prompt (empty suffix) too
+                    suf = r.prompt[cached:]
+                    if len(suf):
+                        slb = self._bucket_len(len(suf))
+                        spad = np.zeros((slb,), np.int32)
+                        spad[:len(suf)] = suf
+                        chunk_fn, restep_fn = self._suffix_fns(slb)
+                        self.pools = chunk_fn(
+                            self.pools, jnp.asarray(row),
+                            jnp.asarray(spad), cached)
+                    else:
+                        _, restep_fn = self._suffix_fns(self.bucket)
+                    self.pools, logits = restep_fn(
+                        self.pools, jnp.asarray(row),
+                        jnp.asarray(r.prompt[plen - 1], jnp.int32),
+                        plen - 1)
             else:
                 self.caches, logits = self._prefill_fn(lb)(
                     self.caches, jnp.asarray(padded), plen, s)
@@ -415,6 +547,19 @@ class BatchedDecoder:
             self.active[s] = False
             self.emitted[s] = []
             if self.paged and self._slot_pages[s] is not None:
+                if self.prefix_cache:
+                    # register this prompt's page-aligned prefix for
+                    # reuse (one registry reference; idempotent when
+                    # the key is already present)
+                    ps_ = self.page_size
+                    m = len(r.prompt) // ps_
+                    if m >= 1:
+                        key_t = self._prefix_key(r.prompt, m * ps_)
+                        if key_t not in self._prefix_registry:
+                            pref = self._slot_pages[s][:m]
+                            self._allocator.share(pref)
+                            self._prefix_registry[key_t] = \
+                                np.asarray(pref)
                 # freed pages may be REALLOCATED to another request, so
                 # the retired slot's stale step-writes must DROP: park
                 # its cursor past capacity (write_rows' OOB semantics)

@@ -173,3 +173,103 @@ class TestPagedMode:
                                   page_size=64)
             srid = solo.submit(_prompt(5, seed), 10)
             np.testing.assert_array_equal(solo.run()[srid], outs[rid])
+
+
+class TestPrefixCache:
+    """Prefix caching (paged mode, opt-in): shared system prompts
+    reuse their page-aligned KV pages; only suffixes prefill."""
+
+    def test_shared_prefix_reuses_pages_and_matches_cold(self):
+        m = _model(30)
+        sys_prompt = _prompt(64, 90)            # exactly one page
+        mk = lambda tail_seed, n: np.concatenate(
+            [sys_prompt, _prompt(n, tail_seed)])
+
+        def run(prefix_cache):
+            dec = BatchedDecoder(m, slots=1, capacity=128, pages=6,
+                                 page_size=64,
+                                 prefix_cache=prefix_cache)
+            rids = [dec.submit(mk(91 + i, 4 + i), 8) for i in range(3)]
+            outs = dec.run()
+            return dec, [outs[r] for r in rids]
+
+        cold_dec, cold = run(prefix_cache=False)
+        hot_dec, hot = run(prefix_cache=True)
+        assert hot_dec.prefix_hits == 2         # requests 2 and 3 hit
+        for h, c in zip(hot, cold):
+            agree = (h == c).mean()
+            assert agree >= 0.9, (agree, h, c)  # fp near-ties only
+        # the registry retains the prefix page (refcounted), live
+        # requests released theirs
+        assert hot_dec._allocator.free_pages == 6 - 1
+
+    def test_fully_cached_prompt_and_eviction(self):
+        m = _model(31)
+        p64 = _prompt(64, 95)                   # page-aligned prompt
+        dec = BatchedDecoder(m, slots=1, capacity=128, pages=3,
+                             page_size=64, prefix_cache=True)
+        a = dec.submit(p64, 8)
+        outs = dec.run()
+        assert outs[a].shape == (8,)
+        # identical prompt again: fully-cached prefix (suffix empty)
+        b = dec.submit(p64, 8)
+        outs2 = dec.run()
+        assert dec.prefix_hits == 1
+        agree = (outs2[b] == outs[a]).mean()
+        assert agree >= 0.9, (outs2[b], outs[a])
+        # fill the pool with fresh prompts: the registry entry is
+        # EVICTED to satisfy admission instead of deadlocking
+        c = dec.submit(_prompt(80, 96), 40)     # needs 2 pages
+        d = dec.submit(_prompt(80, 97), 40)
+        outs3 = dec.run()
+        assert outs3[c].shape == (40,) and outs3[d].shape == (40,)
+
+    def test_refcount_share_and_double_free_guards(self):
+        from paddle_tpu.serving import PagedKVPool
+
+        pool = PagedKVPool(pages=2, page_size=64, kv_heads=2,
+                           head_dim=64)
+        a = pool.alloc(1)
+        pool.share(a)
+        pool.free(a)                            # ref 2 -> 1: still live
+        assert pool.free_pages == 1
+        pool.free(a)                            # ref 1 -> 0: returns
+        assert pool.free_pages == 2
+        with pytest.raises(Exception, match="double free"):
+            pool.free(a)
+        with pytest.raises(Exception, match="unallocated"):
+            pool.share(a)
+
+    def test_evicting_the_hit_does_not_corrupt(self):
+        """The reviewer repro: the hit's registry entry is evicted to
+        satisfy the same admission — the pinned shared pages must NOT
+        be handed back as 'new' pages (duplicate physical page in one
+        table). Output must match a cold run."""
+        m = _model(32)
+        P = _prompt(64, 98)
+        tail = _prompt(4, 99)
+        full = np.concatenate([P, tail])
+
+        cold = BatchedDecoder(m, slots=2, capacity=128, pages=3,
+                              page_size=64)
+        crid = cold.submit(full, 8)
+        cold_out = cold.run()[crid]
+
+        dec = BatchedDecoder(m, slots=2, capacity=128, pages=3,
+                             page_size=64, prefix_cache=True)
+        r0 = dec.submit(P, 8)                   # registers page for P
+        dec.run()
+        a = dec.submit(_prompt(70, 100), 40)    # needs 2 pages
+        b = dec.submit(full, 8)                 # hits P while the pool
+        outs = dec.run()                        # is dry
+        # the PIN makes the dangerous path impossible: eviction cannot
+        # free the hit's pages (our reference holds them), so b
+        # backpressures instead of receiving its own prefix page back
+        # as a "new" page; it admits cold after `a` completes (the
+        # registry entry was evicted meanwhile — hits may be 0)
+        assert dec.prefix_hits <= 1
+        assert outs[a].shape == (40,)
+        agree = (outs[b] == cold_out).mean()
+        assert agree >= 0.9, (agree, outs[b], cold_out)
+        assert dec._allocator.free_pages + len(
+            dec._prefix_registry) >= 3 - 1      # nothing leaked
