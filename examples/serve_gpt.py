@@ -48,8 +48,9 @@ def main():
     # --- continuous batching over a PAGED KV cache: 6 requests over 3
     # slots sharing a page pool (memory scales with live tokens) ------
     dec = BatchedDecoder(target, slots=3, capacity=128, pages=8,
-                         page_size=64, key=jax.random.key(0),
-                         temperature=0.8, top_p=0.9, eos_id=7)
+                         page_size=64, prefix_cache=True,
+                         key=jax.random.key(0), temperature=0.8,
+                         top_p=0.9, eos_id=7)
     rng = np.random.default_rng(0)
     rids = [dec.submit(rng.integers(1, 512, (n,)), max_new=16)
             for n in (4, 9, 5, 7, 3, 6)]
