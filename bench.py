@@ -729,12 +729,17 @@ def bench_gpt_decode(steps: int, batch_size: int, amp=None,
 
 def bench_gpt_serve(steps: int, batch_size: int, amp=None,
                     max_new: int = 64, smoke: bool = False,
-                    weight_only: bool = False, paged: bool = False):
+                    weight_only: bool = False, paged: bool = False,
+                    gamma: int = 0, prefill_chunk=None):
     """Continuous-batching serving throughput (serving.BatchedDecoder):
     2x``batch_size`` requests with MIXED prompt lengths over a
     ``batch_size``-slot arena — generated tokens/sec across the whole
     workload, admission/refill included (the slot machinery's win over
-    pad-to-slowest static batching). --weight-only composes W8A16."""
+    pad-to-slowest static batching). --weight-only composes W8A16;
+    --gamma g serves SPECULATIVELY (per-row drafts + one per-row verify
+    chunk per round, 2-layer draft — accept_per_round extra gives the
+    real-pair speedup formula); --prefill-chunk C smooths admission by
+    prefilling C tokens per serving tick instead of a whole prompt."""
     import contextlib
 
     import paddle_tpu as pt
@@ -767,6 +772,13 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     if paged:
         kw = dict(pages=max(slots * (cap // 64) // 2, slots),
                   page_size=64)
+    if gamma > 0:
+        dcfg = dataclasses.replace(cfg, num_layers=2)
+        pt.seed(1)
+        kw["draft"] = G.GPTForCausalLM(dcfg).eval()
+        kw["gamma"] = gamma
+    if prefill_chunk:
+        kw["prefill_chunk"] = prefill_chunk
     dec = BatchedDecoder(model, slots=slots, capacity=cap, **kw)
 
     def run_all():
@@ -785,8 +797,11 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
         outs = run_all()
         total += sum(len(v) for v in outs.values())
     dt = time.perf_counter() - t0
-    return total / dt, "tokens/sec", {"requests": n_req,
-                                      "slots": slots}
+    extras = {"requests": n_req, "slots": slots}
+    if gamma > 0:
+        extras["accept_per_round"] = round(
+            dec.spec_accepted / max(1, dec.spec_row_rounds), 3)
+    return total / dt, "tokens/sec", extras
 
 
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
@@ -1249,6 +1264,13 @@ def main():
                     help="wrap the timed run in the profiler and write a "
                     "chrome-trace JSON here (fluid_benchmark --profile "
                     "analog)")
+    ap.add_argument("--device-trace", dest="device_trace", default=None,
+                    metavar="DIR",
+                    help="wrap the timed run in jax.profiler.trace(DIR): "
+                    "captures DEVICE-side op timelines (xplane.pb, "
+                    "TensorBoard-consumable) — the device_tracer.h half "
+                    "of the profiler capability; fails loudly if the "
+                    "PJRT plugin exposes no profiler")
     ap.add_argument("--vocab", type=int, default=None,
                     help="deepfm/deepfm_sparse: embedding table size "
                     "(sweeps the sparse-vs-dense update crossover)")
@@ -1258,6 +1280,11 @@ def main():
     ap.add_argument("--paged", action="store_true",
                     help="gpt_serve: paged-KV arena (page pool sized "
                     "to ~half the dense slots x capacity)")
+    ap.add_argument("--prefill-chunk", dest="prefill_chunk", type=int,
+                    default=None,
+                    help="gpt_serve: chunked prefill — C prompt tokens "
+                    "per serving tick instead of whole-prompt "
+                    "admission stalls (_pcN history key)")
     ap.add_argument("--weight-only", dest="weight_only",
                     action="store_true",
                     help="gpt_decode/gpt_serve: weight-only int8 "
@@ -1330,6 +1357,10 @@ def main():
     if args.paged and "paged" in sig:
         # different cache layout (page pool vs dense arena): own key
         metric += "_paged"
+    if args.prefill_chunk and "prefill_chunk" in sig:
+        # different admission schedule (prefill interleaved with
+        # decode): own key per chunk size
+        metric += f"_pc{args.prefill_chunk}"
     if "cached" in sig and not args.kv_cache:
         # same workload, different implementation — its own history key
         # so the cache-vs-recompute comparison stays visible
@@ -1446,6 +1477,8 @@ def main():
         kwargs["weight_only"] = True
     if args.paged and "paged" in sig:
         kwargs["paged"] = True
+    if args.prefill_chunk and "prefill_chunk" in sig:
+        kwargs["prefill_chunk"] = args.prefill_chunk
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call
@@ -1483,9 +1516,31 @@ def main():
         ctx = _prof(timeline_path=args.profile)
     else:
         ctx = contextlib.nullcontext()
-    with ctx:
+    if args.device_trace:
+        import jax
+
+        dctx = jax.profiler.trace(args.device_trace)
+    else:
+        dctx = contextlib.nullcontext()
+    with ctx, dctx:
         value, unit, *rest = fn(steps, batch, **kwargs)
     extras = rest[0] if rest else {}
+    if args.device_trace:
+        # the artifact contract: at least one non-trivial xplane proto
+        # must exist, or the run errors (an empty dir would let the
+        # fill item mark "device trace captured" on a no-op)
+        import glob as _glob
+
+        planes = [p for p in _glob.glob(os.path.join(
+            args.device_trace, "**", "*.xplane.pb"), recursive=True)
+            if os.path.getsize(p) > 1024]
+        if not planes:
+            _emit_error(metric, "device trace produced no xplane.pb "
+                        "(PJRT profiler unsupported on this platform?)")
+            return
+        extras["device_trace_planes"] = [
+            {"file": os.path.relpath(p, args.device_trace),
+             "bytes": os.path.getsize(p)} for p in planes]
 
     # `metric` was resolved before the watchdog (same suffixed key on
     # error and success lines for the same command)

@@ -69,6 +69,23 @@ def main():
     print("speculative: tokens/target-pass =",
           np.round(1 + acc / np.maximum(rounds, 1), 2).tolist())
 
+    # --- the serving-arena composition: SPECULATIVE rounds over the
+    # paged pool + CHUNKED PREFILL (long prompts prefill 64 tokens per
+    # tick so live slots keep their decode cadence) ------------------
+    # sampled mode: rejection-sampling acceptance (u*q < p) is
+    # meaningful even for this untrained pair — greedy acceptance
+    # would be argmax agreement, ~0 across two random models
+    sdec = BatchedDecoder(target, slots=2, capacity=128, pages=8,
+                          page_size=64, draft=draft, gamma=3,
+                          prefill_chunk=64, temperature=0.8,
+                          key=jax.random.key(3))
+    srids = [sdec.submit(rng.integers(1, 512, (n,)), max_new=12)
+             for n in (40, 5, 9)]
+    souts = sdec.run()
+    rate = sdec.spec_accepted / max(1, sdec.spec_row_rounds)
+    print(f"arena speculative: {len(souts)} requests done, "
+          f"accept/round = {rate:.2f}")
+
 
 if __name__ == "__main__":
     main()

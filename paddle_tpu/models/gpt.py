@@ -224,6 +224,25 @@ class GPTForCausalLM(Layer):
                 decode_kernel=decode_kernel))
         return logits[:, 0], caches
 
+    def _chunk_logits_rows(self, toks, caches, t0_rows):
+        """S KV-cached positions PER ROW at per-row chunk starts
+        ``t0_rows`` (B,) — the arena speculative verify: every slot
+        scores its gamma+1 candidates at its OWN cursor in ONE pass.
+        ``toks`` (B, S) -> ((B, S, V) logits, caches)."""
+        return self._cached_blocks(
+            self.embed(toks), caches,
+            lambda sa, h, ck, cv: sa.forward_chunk_rows(
+                h, ck, cv, t0_rows, window=self.cfg.attn_window))
+
+    def _chunk_logits_paged_rows(self, toks, pools, table, t0_rows):
+        """S positions PER ROW against PAGED caches at per-row chunk
+        starts (see _chunk_logits_rows). ``toks`` (B, S)."""
+        return self._cached_blocks(
+            self.embed(toks), pools,
+            lambda sa, h, kp, vp: sa.forward_chunk_paged_rows(
+                h, kp, vp, table, t0_rows,
+                window=self.cfg.attn_window))
+
     def _step_logits_paged(self, tok, pools, table, t_rows):
         """One position PER ROW against PAGED caches: ``pools`` is the
         per-block [(kpool, vpool), ...] list, ``table`` the shared

@@ -614,6 +614,72 @@ class _MHADecodeMixin:
             window=window)
         return out, cache_k, cache_v
 
+    def forward_chunk_rows(self, x_chunk, cache_k, cache_v, t0_rows,
+                           window=None):
+        """S decode positions PER ROW at per-row chunk starts
+        ``t0_rows`` (B,) — the speculative verify chunk over a
+        continuous-batching arena (each slot scores its gamma+1
+        candidates at its OWN cursor). ``x_chunk``: (B, S, D); returns
+        (out (B, S, D), cache_k, cache_v). Caller contract matches
+        forward_chunk: position i of row b attends cache positions
+        <= t0_rows[b]+i; writes at t0+S past capacity clamp (retired
+        rows park past capacity — junk at the clamped tail is
+        overwritten by a later real write before any query attends
+        it)."""
+        from jax import lax
+
+        b, s, _ = x_chunk.shape
+        cap = cache_k.shape[1]
+        pos_chunk = (t0_rows.astype(jnp.int32)[:, None]
+                     + jnp.arange(s, dtype=jnp.int32)[None, :])  # (B, S)
+        k_c, v_c = self._project_kv_t(x_chunk, pos_chunk)
+        write = jax.vmap(lambda c, u, t: lax.dynamic_update_slice_in_dim(
+            c, u, t, axis=0))
+        cache_k = write(cache_k, k_c.astype(cache_k.dtype),
+                        t0_rows.astype(jnp.int32))
+        cache_v = write(cache_v, v_c.astype(cache_v.dtype),
+                        t0_rows.astype(jnp.int32))
+        pos = jnp.arange(cap)
+        keep = pos[None, None, :] <= pos_chunk[:, :, None]   # (B, S, cap)
+        if window is not None:
+            keep &= pos[None, None, :] > pos_chunk[:, :, None] - window
+        out = self.attend_kv(
+            x_chunk, cache_k, cache_v, attn_mask=keep[:, None],
+            q_positions=pos_chunk if self.rotary else None,
+            window=window)
+        return out, cache_k, cache_v
+
+    def forward_chunk_paged_rows(self, x_chunk, kpool, vpool, table,
+                                 t0_rows, window=None):
+        """S decode positions PER ROW against the PAGED cache at
+        per-row chunk starts (the paged-arena speculative verify
+        chunk): chunk-write every row's candidates at its own logical
+        offset (OOB rows drop — parked cursors), attend over each
+        row's pages via the gather path (S is gamma+1-small; the paged
+        decode kernel stays the S=1 hot loop). ``x_chunk``: (B, S, D);
+        returns (out, kpool, vpool)."""
+        from ..ops import paged_kv
+        from ..ops.attention import scaled_dot_product_attention
+
+        b, s, d = x_chunk.shape
+        pos_chunk = (t0_rows.astype(jnp.int32)[:, None]
+                     + jnp.arange(s, dtype=jnp.int32)[None, :])  # (B, S)
+        k_c, v_c = self._project_kv_t(x_chunk, pos_chunk)
+        kpool, vpool = paged_kv.write_chunk_rows(
+            kpool, vpool, table, t0_rows.astype(jnp.int32), k_c, v_c,
+            kpool.shape[1])
+        k = paged_kv.gather_rows(kpool, table)
+        v = paged_kv.gather_rows(vpool, table)
+        cap = k.shape[1]
+        pos = jnp.arange(cap)
+        keep = pos[None, None, :] <= pos_chunk[:, :, None]   # (B, S, cap)
+        if window is not None:
+            keep &= pos[None, None, :] > pos_chunk[:, :, None] - window
+        out = scaled_dot_product_attention(
+            self._rotated_q(x_chunk, pos_chunk), k, v,
+            mask=keep[:, None], use_flash=False)
+        return (self.out_proj(out.reshape(b, s, d)), kpool, vpool)
+
 
 class MultiHeadAttention(_MHADecodeMixin, Layer):
     """Transformer attention. The reference builds this from primitives

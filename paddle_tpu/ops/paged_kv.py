@@ -54,6 +54,28 @@ def write_chunk(kpool, vpool, table_row, t0, k_c, v_c, page_size: int):
     return kpool, vpool
 
 
+def write_chunk_rows(kpool, vpool, table, t0_rows, k_c, v_c,
+                     page_size: int):
+    """S consecutive positions PER ROW starting at per-row logical
+    cursors ``t0_rows`` (B,): k_c/v_c (B, S, kv, hd) — the speculative
+    verify-chunk write (every row lands its gamma+1 candidate K/V at
+    its OWN offset). Positions past the table capacity drop (see
+    write_rows)."""
+    b, s = k_c.shape[:2]
+    n_log = table.shape[1]
+    pos = t0_rows[:, None] + jnp.arange(s)[None, :]           # (B, S)
+    valid = pos < n_log * page_size
+    col = jnp.minimum(pos // page_size, n_log - 1)
+    rows = jnp.arange(b)[:, None]
+    page = jnp.where(valid, table[rows, col], kpool.shape[0])
+    off = pos % page_size
+    kpool = kpool.at[page, off].set(k_c.astype(kpool.dtype),
+                                    mode="drop")
+    vpool = vpool.at[page, off].set(v_c.astype(vpool.dtype),
+                                    mode="drop")
+    return kpool, vpool
+
+
 def gather_rows(pool, table):
     """Assemble each row's LOGICAL cache: (B, n_log*page_size, kv, hd).
     The fallback/prefill view; the decode kernel never materializes

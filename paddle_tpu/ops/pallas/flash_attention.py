@@ -228,16 +228,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
                                offset=offset, block_q=block_q,
                                block_k=block_k)
         if has_mask:
-            # key-padding keep-mask (1, bk) broadcasting over q rows
-            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            # key-padding keep-mask (1, bk) broadcasting over q rows;
+            # the j-th block arrives via the index map (blocked layout)
+            kvm = kvm_ref[0]
             s = jnp.where(kvm > 0, s, _NEG_INF)
         if has_segs:
             # packed sequences: attend only within the same segment.
             # q-side ids arrive (bq, 1) via the lse-style layout, kv-side
-            # (1, bk) via the full-row slice — broadcast equality gives
-            # the (bq, bk) block mask with no in-kernel transpose
+            # (1, bk) via the blocked index map — broadcast equality
+            # gives the (bq, bk) block mask with no in-kernel transpose
             qseg = qseg_ref[0]                       # (bq, 1)
-            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]  # (1, bk)
+            kseg = kseg_ref[0]                       # (1, bk)
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         m_prev = m_ref[:, :1]                              # (bq, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -300,15 +301,36 @@ def _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=2):
     return _vmem_spec((1, block_k, d), imap)
 
 
-def _mask_spec(nheads, tk):
-    # kv_mask is (B, 1, Tk) float; every head of batch row b reads row
-    # b // nheads — the index map folds the (B*h) grid dim back to B.
-    # The block spans the FULL Tk lane dim (legal for any block_k: a
-    # lane dim equal to the array dim always satisfies Mosaic tiling,
-    # where a (1, block_k<128) lane block would not); kernels slice the
-    # j-th chunk with pl.ds. Cost: Tk floats of VMEM, loaded once.
-    return _vmem_spec((1, 1, tk),
-                      lambda b, i, j, _h=nheads: (b // _h, 0, 0))
+def _mask_block_spec(nheads, block_k, j_pos=2, banded_lo=None,
+                     n_j=None):
+    """kv-side mask/segment block spec over the (B, n_j, block_k)
+    BLOCKED layout (the call sites reshape the (B, 1, Tk) row): the
+    grid's k-block index picks the j-th chunk via the INDEX MAP, so
+    the kernel never slices the lane dim at a dynamic offset — Mosaic
+    cannot prove ``j * block_k`` is lane-aligned when block_k is not a
+    multiple of 128, and the seq-64 NMT shape (block_k=64) failed TPU
+    compilation exactly there ("cannot statically prove that index in
+    dimension 2 is a multiple of 128"). A block whose lane dim equals
+    the array's last dim is legal for ANY block_k. ``j_pos`` names the
+    grid arg carrying the k-block index (2 for the fwd/dq (b, i, j)
+    grids, 1 for the dkv (b, j, i) grid); ``banded_lo`` switches to
+    the shared banded clamp (the kernels recover the same index)."""
+    if banded_lo is not None:
+        return _vmem_spec((1, 1, block_k), _banded_imap(
+            banded_lo, n_j, lambda b, _h=nheads: b // _h))
+
+    def imap(*args, _h=nheads, _p=j_pos):
+        return (args[0] // _h, args[_p], 0)
+
+    return _vmem_spec((1, 1, block_k), imap)
+
+
+def _block_mask(m, n_j, block_k):
+    """(B, 1, Tk) kv-side mask/segment row -> (B, n_j, block_k) blocked
+    layout for _mask_block_spec (None passes through)."""
+    if m is None:
+        return None
+    return m.reshape(m.shape[0], n_j, block_k)
 
 
 def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
@@ -333,16 +355,19 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
         jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
     )
+    j_lo = functools.partial(_band_j_lo, block_q=block_q,
+                             block_k=block_k, offset=offset,
+                             window=window)
     if banded:
         # k/v specs walk only the band: jj -> clamp(j_lo(i) + jj); the
         # pipeline then never streams out-of-band K/V blocks from HBM
-        j_lo = functools.partial(_band_j_lo, block_q=block_q,
-                                 block_k=block_k, offset=offset,
-                                 window=window)
         kv_spec = _vmem_spec((1, block_k, d), _banded_imap(
             j_lo, n_j, lambda b: _kv_row_fold(b, nheads, kv_heads)))
     else:
         kv_spec = _kv_spec(block_k, d, nheads, kv_heads)
+    mask_spec = _mask_block_spec(
+        nheads, block_k, j_pos=2,
+        banded_lo=j_lo if banded else None, n_j=n_j)
     in_specs = [
         _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         kv_spec,
@@ -350,12 +375,12 @@ def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
     ]
     inputs = (q, k, v)
     if kvm is not None:
-        in_specs.append(_mask_spec(nheads, tk))
-        inputs += (kvm,)
+        in_specs.append(mask_spec)
+        inputs += (_block_mask(kvm, n_j, block_k),)
     if qseg is not None:
         in_specs.append(_qseg_spec(nheads, block_q))
-        in_specs.append(_mask_spec(nheads, tk))  # kv-side: full-row slice
-        inputs += (qseg, kseg)
+        in_specs.append(mask_spec)  # kv-side: blocked layout
+        inputs += (qseg, _block_mask(kseg, n_j, block_k))
     if dropout_p > 0.0:
         in_specs.append(_seed_spec(q.shape[0]))
         inputs += (seed,)
@@ -428,11 +453,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                                offset=offset, block_q=block_q,
                                block_k=block_k)
         if has_mask:
-            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            kvm = kvm_ref[0]    # j-th block via the index map
             s = jnp.where(kvm > 0, s, _NEG_INF)
         if has_segs:
             qseg = qseg_ref[0]
-            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
+            kseg = kseg_ref[0]
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)
         if causal or window is not None or has_mask or has_segs:
@@ -504,11 +529,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                                offset=offset, block_q=block_q,
                                block_k=block_k)
         if has_mask:
-            kvm = kvm_ref[0, :, pl.ds(j * block_k, block_k)]
+            kvm = kvm_ref[0]    # j-th block via the index map
             s = jnp.where(kvm > 0, s, _NEG_INF)
         if has_segs:
             qseg = qseg_ref[0]
-            kseg = kseg_ref[0, :, pl.ds(j * block_k, block_k)]
+            kseg = kseg_ref[0]
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)                               # (bq, bk) f32
         if causal or window is not None or has_mask or has_segs:
@@ -579,14 +604,22 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
         _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         _vmem_spec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
     ]
+    # blocked kv-side mask layout (see _mask_block_spec): the grid's
+    # k-block index picks the chunk, shared by dq (j = args[2], banded
+    # clamp when windowed) and dkv (j = args[1], never banded over j)
+    kvm_b = _block_mask(kvm, n_j, block_k)
+    kseg_b = _block_mask(kseg, n_j, block_k)
+    dq_mask_spec = _mask_block_spec(
+        nheads, block_k, j_pos=2,
+        banded_lo=j_lo if banded_j else None, n_j=n_j)
     dq_inputs = (q, k, v, do, lse, delta)
     if has_mask:
-        dq_in_specs.append(_mask_spec(nheads, tk))
-        dq_inputs += (kvm,)
+        dq_in_specs.append(dq_mask_spec)
+        dq_inputs += (kvm_b,)
     if has_segs:
         dq_in_specs.append(_qseg_spec(nheads, block_q))
-        dq_in_specs.append(_mask_spec(nheads, tk))
-        dq_inputs += (qseg, kseg)
+        dq_in_specs.append(dq_mask_spec)
+        dq_inputs += (qseg, kseg_b)
     if dropout_p > 0.0:
         dq_in_specs.append(_seed_spec(q.shape[0]))
         dq_inputs += (seed,)
@@ -619,12 +652,13 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
         dkv_q1_spec,
         dkv_q1_spec,
     ]
+    # dkv grid is (b, j, i): the k-block index is args[1] (plain even
+    # when banded — dkv bands over i, not j)
+    dkv_mask_spec = _mask_block_spec(nheads, block_k, j_pos=1)
     dkv_inputs = (q, k, v, do, lse, delta)
     if has_mask:
-        # grid axes are swapped here (kv outer, q inner) but the full-row
-        # mask block ignores both grid indices anyway
-        dkv_in_specs.append(_mask_spec(nheads, tk))
-        dkv_inputs += (kvm,)
+        dkv_in_specs.append(dkv_mask_spec)
+        dkv_inputs += (kvm_b,)
     if has_segs:
         # q-side spec must use the SWAPPED grid order: i is program_id(2)
         if banded_i:
@@ -634,8 +668,8 @@ def _bwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, o, lse,
             dkv_in_specs.append(_vmem_spec(
                 (1, block_q, 1),
                 lambda b, j, i, _h=nheads: (b // _h, i, 0)))
-        dkv_in_specs.append(_mask_spec(nheads, tk))
-        dkv_inputs += (qseg, kseg)
+        dkv_in_specs.append(dkv_mask_spec)
+        dkv_inputs += (qseg, kseg_b)
     if dropout_p > 0.0:
         dkv_in_specs.append(_seed_spec(q.shape[0]))
         dkv_inputs += (seed,)

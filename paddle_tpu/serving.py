@@ -13,6 +13,12 @@ This is the serving-runtime capstone over the decode stack: generate()
 semantics per request (greedy or temperature/top-k/top-p sampling, eos
 freezing), the KV-cache mixin underneath, and it composes with
 quant.apply_weight_only_int8 (buffers ride the same functional step).
+Opt-in refinements: paged KV (pages=N, vLLM-style page pool + prefix
+caching), CHUNKED PREFILL (prefill_chunk=C — C prompt tokens per
+serving tick instead of whole-prompt admission stalls), and
+SPECULATIVE DECODING over the arena (draft=model, gamma=g — per-row
+draft steps + ONE per-row verify chunk per round; greedy mode is
+token-identical to the plain arena).
 
 Green-field vs the reference (its serving is the one-request-at-a-time
 predictor, /root/reference/paddle/fluid/inference/api/api_impl.cc role;
@@ -123,6 +129,26 @@ class PagedKVPool:
     attend = staticmethod(paged_ops.attend)
 
 
+def _row_apply(caches, s, fn):
+    """Slice slot ``s`` of each layer's (slots, ...) K/V cache pair as
+    a batch-1 row, run ``fn(row) -> (result, new_row)``, write the row
+    back (dtype-cast) — the ONE definition of the per-slot
+    slice/run/write-back boilerplate every contiguous prefill piece
+    (full, chunk, restep, draft) shares. jit-safe: callers close over
+    it inside their traced functions."""
+    row = [(lax.dynamic_slice_in_dim(ck, s, 1, axis=0),
+            lax.dynamic_slice_in_dim(cv, s, 1, axis=0))
+           for ck, cv in caches]
+    out, row = fn(row)
+    new = []
+    for (ck, cv), (rk, rv) in zip(caches, row):
+        new.append((lax.dynamic_update_slice_in_dim(
+            ck, rk.astype(ck.dtype), s, axis=0),
+            lax.dynamic_update_slice_in_dim(
+                cv, rv.astype(cv.dtype), s, axis=0)))
+    return out, new
+
+
 class Request:
     """One generation request; ``result`` is filled on completion."""
 
@@ -152,12 +178,55 @@ class BatchedDecoder:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, prompt_bucket: int = 16,
                  pages: Optional[int] = None, page_size: int = 128,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 prefill_chunk: Optional[int] = None,
+                 draft=None, gamma: int = 4):
         enforce(slots >= 1, "slots must be >= 1, got %s", slots)
         enforce(capacity >= prompt_bucket,
                 "capacity %s < prompt bucket %s", capacity,
                 prompt_bucket)
         self.model = model
+        # CHUNKED PREFILL (opt-in): admission only ALLOCATES; the
+        # prompt then prefills prefill_chunk tokens per serving-loop
+        # tick (one chunk per tick across all admitting slots), so
+        # active slots keep emitting at decode cadence instead of
+        # stalling for a whole long-prompt prefill (Sarathi-style
+        # throughput smoothing). Token-identical to monolithic
+        # prefill: chunk boundaries don't change the attention math.
+        self.prefill_chunk = prefill_chunk
+        if prefill_chunk is not None:
+            enforce(prefill_chunk >= 1, "prefill_chunk must be >= 1")
+            enforce(prefill_chunk <= capacity,
+                    "prefill_chunk %s > capacity %s", prefill_chunk,
+                    capacity)
+            if pages is not None:
+                # the chunk grid must never overrun the allocated
+                # pages into an unallocated table entry (= physical
+                # page 0): with C | page_size, the padded chunk
+                # frontier (smallest multiple of C >= plen) is <= the
+                # page demand ceil((plen+max_new)/ps)*ps
+                enforce(page_size % prefill_chunk == 0,
+                        "prefill_chunk %s must divide page_size %s",
+                        prefill_chunk, page_size)
+        # SPECULATIVE DECODING over the arena (opt-in): a small draft
+        # model proposes ``gamma`` tokens per round at every slot's own
+        # cursor; the target verifies all gamma+1 in ONE per-row chunk
+        # (_chunk_logits_rows / _chunk_logits_paged_rows) and a
+        # modified rejection test accepts a prefix — output tokens are
+        # distributed EXACTLY as the target's own sampling chain
+        # (greedy mode is token-identical to the plain arena). The
+        # draft keeps a contiguous (slots, capacity) cache arena of
+        # its own; in paged mode only the TARGET is paged.
+        self.draft = draft
+        self.gamma = int(gamma)
+        if draft is not None:
+            enforce(gamma >= 1, "gamma must be >= 1, got %s", gamma)
+            enforce(model.cfg.vocab_size == draft.cfg.vocab_size,
+                    "vocab mismatch: target %s vs draft %s",
+                    model.cfg.vocab_size, draft.cfg.vocab_size)
+        # verify-chunk writes run up to cursor+gamma; spec-mode
+        # admission budgets those positions too
+        self._extra = self.gamma if draft is not None else 0
         self.slots, self.capacity = slots, capacity
         self.eos_id = eos_id
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -212,6 +281,9 @@ class BatchedDecoder:
                     "prefix_cache requires paged mode (pages=N)")
             self.caches = [blk.self_attn.init_cache(slots, capacity)
                            for blk in model.blocks]
+        if draft is not None:
+            self.caches_d = [blk.self_attn.init_cache(slots, capacity)
+                             for blk in draft.blocks]
         self.tok = jnp.zeros((slots,), jnp.int32)      # last token/slot
         # cursors: paged mode parks EVERY not-yet-admitted slot past
         # capacity — an idle slot's table row is zeros, and a cursor of
@@ -232,6 +304,17 @@ class BatchedDecoder:
         self._next_rid = 0
         self._prefill_cache: Dict[int, object] = {}
         self._step_fn = None
+        self._spec_fn = None
+        # spec-mode stats: mean accepted per target verify per row =
+        # spec_accepted / spec_row_rounds; tokens per target call =
+        # 1 + that (the real-pair speedup formula)
+        self.spec_rounds = 0
+        self.spec_row_rounds = 0
+        self.spec_accepted = 0
+        # chunked-prefill state: slot -> {padded, plen, off, request};
+        # _pf_order is admission-FIFO so ticks are fair
+        self._pf: List[Optional[dict]] = [None] * slots
+        self._pf_order: List[int] = []
 
     # ----- host API --------------------------------------------------------
 
@@ -240,14 +323,18 @@ class BatchedDecoder:
                 "empty prompt")
         enforce(max_new >= 1, "max_new must be >= 1, got %s", max_new)
         r = Request(self._next_rid, prompt_ids, max_new)
-        enforce(len(r.prompt) + max_new <= self.capacity,
-                "prompt %s + max_new %s exceeds slot capacity %s",
-                len(r.prompt), max_new, self.capacity)
+        # spec mode reserves gamma extra positions: the verify chunk
+        # writes up to cursor+gamma, and a clamped contiguous write
+        # there would corrupt K/V BELOW a live cursor
+        enforce(len(r.prompt) + max_new + self._extra <= self.capacity,
+                "prompt %s + max_new %s (+%s speculative margin) "
+                "exceeds slot capacity %s",
+                len(r.prompt), max_new, self._extra, self.capacity)
         if self.paged:
             # a demand beyond the WHOLE pool could never be admitted —
             # _admit would re-queue it forever (silent run() hang)
-            need = ((len(r.prompt) + max_new + self.page_size - 1)
-                    // self.page_size)
+            need = ((len(r.prompt) + max_new + self._extra
+                     + self.page_size - 1) // self.page_size)
             enforce(need <= self._allocator.pages,
                     "request needs %s pages but the pool only has %s",
                     need, self._allocator.pages)
@@ -257,8 +344,9 @@ class BatchedDecoder:
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive until every submitted request completes."""
-        while self.queue or self.active.any():
+        while self.queue or self._pf_order or self.active.any():
             self._admit()
+            self._prefill_tick()
             self._step()
         out = {rid: r.result for rid, r in self.done.items()}
         self.done = {}
@@ -284,28 +372,21 @@ class BatchedDecoder:
         model = self.model
 
         def prefill(caches, padded, plen, s):
-            # slice each block's slot-s cache as batch 1, chunk-run the
-            # FULL bucket (static shape) CACHE-ONLY — positions >= plen
-            # write garbage above the cursor, masked + overwritten
-            # later. The (lb, vocab) head projection would be the
-            # dominant prefill FLOP and all but one row is discarded,
-            # so the next-token logits come from a one-position re-step
-            # of the LAST prompt token instead (idempotent K/V rewrite
-            # at plen-1, single-row head).
-            row = [(lax.dynamic_slice_in_dim(ck, s, 1, axis=0),
-                    lax.dynamic_slice_in_dim(cv, s, 1, axis=0))
-                   for ck, cv in caches]
-            _, row = model._chunk_logits(padded[None], row, 0,
-                                         head=False)
-            last = lax.dynamic_index_in_dim(padded, plen - 1,
-                                            keepdims=False)
-            logits, row = model._step_logits(last[None], row, plen - 1)
-            new = []
-            for (ck, cv), (rk, rv) in zip(caches, row):
-                new.append((lax.dynamic_update_slice_in_dim(
-                    ck, rk.astype(ck.dtype), s, axis=0),
-                    lax.dynamic_update_slice_in_dim(
-                        cv, rv.astype(cv.dtype), s, axis=0)))
+            # chunk-run the FULL bucket (static shape) CACHE-ONLY —
+            # positions >= plen write garbage above the cursor, masked
+            # + overwritten later. The (lb, vocab) head projection
+            # would be the dominant prefill FLOP and all but one row
+            # is discarded, so the next-token logits come from a
+            # one-position re-step of the LAST prompt token instead
+            # (idempotent K/V rewrite at plen-1, single-row head).
+            def body(row):
+                _, row = model._chunk_logits(padded[None], row, 0,
+                                             head=False)
+                last = lax.dynamic_index_in_dim(padded, plen - 1,
+                                                keepdims=False)
+                return model._step_logits(last[None], row, plen - 1)
+
+            logits, new = _row_apply(caches, s, body)
             return new, logits[0]
 
         fn = jax.jit(prefill)
@@ -362,6 +443,89 @@ class BatchedDecoder:
             self._prefill_cache[("restep",)] = restep_fn
         return chunk_fn, restep_fn
 
+    def _chunk_fn_contig(self, c: int):
+        """Jitted cache-only contiguous-prefill piece: run chunk tokens
+        (c,) at [t0, t0+c) through slot ``s``'s row (one compile per
+        chunk size — the chunk size is fixed, so one total)."""
+        fn = self._prefill_cache.get(("cchunk", c))
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def chunk(caches, toks, t0, s):
+            _, new = _row_apply(caches, s, lambda row: model._chunk_logits(
+                toks[None], row, t0, head=False))
+            return new
+
+        fn = jax.jit(chunk)
+        self._prefill_cache[("cchunk", c)] = fn
+        return fn
+
+    def _restep_contig(self):
+        """Jitted last-token re-step for slot ``s`` (chunked-prefill
+        finish): idempotent K/V rewrite at pos, single-row head."""
+        fn = self._prefill_cache.get(("crestep",))
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def restep(caches, tok, pos, s):
+            logits, new = _row_apply(
+                caches, s,
+                lambda row: model._step_logits(tok[None], row, pos))
+            return new, logits[0]
+
+        fn = jax.jit(restep)
+        self._prefill_cache[("crestep",)] = fn
+        return fn
+
+    def _prefill_tick(self):
+        """Advance chunked prefill by ONE chunk (FIFO across admitting
+        slots) — bounds the prefill work added to any serving-loop
+        iteration, so active slots keep their decode cadence. On the
+        final chunk the slot activates via the last-token re-step."""
+        if not self._pf_order:
+            return
+        s = self._pf_order[0]
+        st = self._pf[s]
+        padded, plen, off, r = (st["padded"], st["plen"], st["off"],
+                                st["r"])
+        c = self.prefill_chunk
+        if off < plen:
+            t0 = off
+            if t0 + c > self.capacity:
+                # slide the final chunk back so the write can't clamp
+                # below the frontier (the overlap re-writes the same
+                # real tokens — idempotent); paged mode never triggers
+                # this (page demand >= the chunk frontier)
+                t0 = self.capacity - c
+            toks = jnp.asarray(padded[t0:t0 + c])
+            if self.paged:
+                chunk_fn, _ = self._suffix_fns(c)
+                self.pools = chunk_fn(
+                    self.pools, jnp.asarray(self.table[s]), toks, t0)
+            else:
+                self.caches = self._chunk_fn_contig(c)(
+                    self.caches, toks, jnp.asarray(t0, jnp.int32),
+                    jnp.asarray(s, jnp.int32))
+            st["off"] = t0 + c
+            if st["off"] < plen:
+                return
+        # all chunks written: re-step the last prompt token for the
+        # next-token logits and go live
+        last = jnp.asarray(int(padded[plen - 1]), jnp.int32)
+        if self.paged:
+            _, restep_fn = self._suffix_fns(self.bucket)
+            self.pools, logits = restep_fn(
+                self.pools, jnp.asarray(self.table[s]), last, plen - 1)
+        else:
+            self.caches, logits = self._restep_contig()(
+                self.caches, last, jnp.asarray(plen - 1, jnp.int32),
+                jnp.asarray(s, jnp.int32))
+        self._pf[s] = None
+        self._pf_order.pop(0)
+        self._activate(s, r, logits, plen)
+
     def _prefix_key(self, prompt: np.ndarray, n: int) -> bytes:
         return np.ascontiguousarray(prompt[:n], np.int32).tobytes()
 
@@ -388,48 +552,118 @@ class BatchedDecoder:
             key_t = next(iter(self._prefix_registry))
             self._allocator.free(self._prefix_registry.pop(key_t))
 
+    def _try_alloc_paged(self, s: int, r: Request):
+        """Paged admission allocation (prefix lookup + pin + evict +
+        alloc); installs the slot's table row. Returns the cached
+        prefix length, or None when the pool can't satisfy the demand
+        yet (caller requeues — backpressure)."""
+        plen = len(r.prompt)
+        hit, cached = (self._lookup_prefix(r.prompt)
+                       if self.prefix_cache else (None, 0))
+        if hit is not None:
+            # PIN before any eviction: _evict_prefixes may drop the
+            # hit's own registry entry, and an unpinned hit would be
+            # freed and handed straight back by alloc() — the same
+            # physical page twice in one table (silent KV corruption)
+            self._allocator.share(hit)
+        need = ((plen + r.max_new + self._extra + self.page_size - 1)
+                // self.page_size)
+        need_new = need - cached // self.page_size
+        if need_new > self._allocator.free_pages:
+            self._evict_prefixes(need_new)
+        if need_new > self._allocator.free_pages:
+            if hit is not None:
+                self._allocator.free(hit)       # unpin
+            return None                         # wait for completions
+        new_ids = self._allocator.alloc(need_new)
+        if hit is not None:
+            self.prefix_hits += 1
+            ids = np.concatenate([hit, new_ids])
+        else:
+            ids = new_ids
+        row = np.zeros((self.n_log,), np.int32)
+        row[:need] = ids
+        self.table[s] = row
+        self._slot_pages[s] = ids
+        return cached
+
+    def _draft_prefill_fn(self, lb: int):
+        """Jitted cache-only draft prefill for bucket lb (spec mode):
+        the draft arena needs the prompt's K/V at [0, plen) — the spec
+        round's first draft step feeds the last emitted token, so no
+        restep/logits here."""
+        fn = self._prefill_cache.get(("draft", lb))
+        if fn is not None:
+            return fn
+        draft = self.draft
+
+        def prefill(caches, padded, s):
+            _, new = _row_apply(caches, s, lambda row: draft._chunk_logits(
+                padded[None], row, 0, head=False))
+            return new
+
+        fn = jax.jit(prefill)
+        self._prefill_cache[("draft", lb)] = fn
+        return fn
+
+    def _activate(self, s: int, r: Request, logits, plen: int):
+        """Shared admission epilogue: first-token pick + slot live."""
+        self.active[s] = True
+        tok = self._pick(logits[None], s, plen)[0]
+        self.emitted[s] = [int(tok)]
+        self.budget[s] = r.max_new - 1
+        self.tok = self.tok.at[s].set(int(tok))
+        self.t = self.t.at[s].set(plen)
+        self._maybe_finish(s)
+
     def _admit(self):
-        """Fill every free slot from the queue (prefill + first token).
-        Paged mode backpressures: a request whose page demand exceeds
-        the free pool stays queued until completions free pages."""
+        """Fill every free slot from the queue. Monolithic mode runs
+        the whole prefill (+ first token) here; chunked mode
+        (prefill_chunk=C) only allocates and queues the slot for
+        _prefill_tick. Paged mode backpressures: a request whose page
+        demand exceeds the free pool stays queued until completions
+        free pages."""
         for s in range(self.slots):
-            if self.active[s] or not self.queue:
+            if (self.active[s] or self._pf[s] is not None
+                    or not self.queue):
                 continue
             r = self.queue.pop(0)
             plen = len(r.prompt)
             lb = self._bucket_len(plen)
             padded = np.zeros((lb,), np.int32)
             padded[:plen] = r.prompt
+            cached = 0
             if self.paged:
-                hit, cached = (self._lookup_prefix(r.prompt)
-                               if self.prefix_cache else (None, 0))
-                if hit is not None:
-                    # PIN before any eviction: _evict_prefixes may drop
-                    # the hit's own registry entry, and an unpinned hit
-                    # would be freed and handed straight back by
-                    # alloc() — the same physical page twice in one
-                    # table (silent KV corruption)
-                    self._allocator.share(hit)
-                need = ((plen + r.max_new + self.page_size - 1)
-                        // self.page_size)
-                need_new = need - cached // self.page_size
-                if need_new > self._allocator.free_pages:
-                    self._evict_prefixes(need_new)
-                if need_new > self._allocator.free_pages:
-                    if hit is not None:
-                        self._allocator.free(hit)   # unpin
-                    self.queue.insert(0, r)     # wait for completions
+                cached = self._try_alloc_paged(s, r)
+                if cached is None:
+                    self.queue.insert(0, r)
                     break
-                new_ids = self._allocator.alloc(need_new)
-                if hit is not None:
-                    self.prefix_hits += 1
-                    ids = np.concatenate([hit, new_ids])
-                else:
-                    ids = new_ids
-                row = np.zeros((self.n_log,), np.int32)
-                row[:need] = ids
-                self.table[s] = row
-                self._slot_pages[s] = ids
+            self.owner[s] = r
+            self._slot_gen[s] = self.gen_count
+            self.gen_count += 1
+            if self.draft is not None:
+                # draft cache needs the FULL prompt regardless of the
+                # target's prefix hit (prefix pages cache only the
+                # target's K/V); draft prefill is the cheap side
+                self.caches_d = self._draft_prefill_fn(lb)(
+                    self.caches_d, jnp.asarray(padded),
+                    jnp.asarray(s, jnp.int32))
+            if self.prefill_chunk is not None:
+                # defer: chunk grid starts at the cached frontier
+                # (page-aligned, hence chunk-aligned); park the cursor
+                # so arena steps can't land junk below the frontier.
+                # The tick reads fixed-size chunks, so pad the prompt
+                # to the CHUNK grid (not the prompt bucket)
+                c = self.prefill_chunk
+                grid = np.zeros((max(1, -(-plen // c)) * c,), np.int32)
+                grid[:plen] = r.prompt
+                self._pf[s] = {"padded": grid, "plen": plen,
+                               "off": cached, "r": r}
+                self._pf_order.append(s)
+                self.t = self.t.at[s].set(self.capacity)
+                continue
+            if self.paged:
+                row = self.table[s]
                 if cached == 0:
                     self.pools, logits = self._prefill_fn_paged(lb)(
                         self.pools, jnp.asarray(row),
@@ -457,16 +691,7 @@ class BatchedDecoder:
             else:
                 self.caches, logits = self._prefill_fn(lb)(
                     self.caches, jnp.asarray(padded), plen, s)
-            self.owner[s] = r
-            self._slot_gen[s] = self.gen_count
-            self.gen_count += 1
-            self.active[s] = True
-            tok = self._pick(logits[None], s, int(plen))[0]
-            self.emitted[s] = [int(tok)]
-            self.budget[s] = r.max_new - 1
-            self.tok = self.tok.at[s].set(int(tok))
-            self.t = self.t.at[s].set(plen)
-            self._maybe_finish(s)
+            self._activate(s, r, logits, int(plen))
 
     def _pick(self, logits, s: int, pos: int):
         """Admission-time single-row pick (the steady-state loop picks
@@ -498,7 +723,167 @@ class BatchedDecoder:
 
         return jax.jit(step)
 
+    def _build_spec_step(self):
+        """One speculative ROUND over the whole arena, jitted: gamma
+        per-row draft steps (lax.scan), ONE per-row target verify
+        chunk, and the Leviathan/Chen modified rejection test — all at
+        per-row cursors, fixed shapes. Greedy mode (temperature=0) is
+        token-identical to the plain arena step loop; sampled mode
+        draws from the target's own filtered distribution (the same
+        construction models/speculative.py pins with a frequency
+        test). Inactive/parked rows compute junk that the host
+        discards; their writes drop (paged) or land above any
+        attended position (contiguous clamp)."""
+        from .ops.sampling import filter_logits
+
+        model, draft, gamma = self.model, self.draft, self.gamma
+        sampled, temp = self.sampled, self.temperature
+        top_k, top_p, key = self.top_k, self.top_p, self.key
+        paged = self.paged
+
+        def _flp(logits):
+            return jax.nn.log_softmax(
+                filter_logits(logits, temp, top_k, top_p), axis=-1)
+
+        def spec(tstate, table, caches_d, tok, t, gens):
+            # per-row key chain: (admission generation, round nonce=t —
+            # strictly increasing per slot-generation, so draws never
+            # collide across rounds)
+            kb = jax.vmap(lambda g, tt: jax.random.fold_in(
+                jax.random.fold_in(key, g), tt))(
+                gens, t.astype(jnp.uint32))
+
+            def draft_step(c, i):
+                tokc, cd = c
+                logits, cd = draft._step_logits_rows(tokc, cd, t + i)
+                if sampled:
+                    lq = _flp(logits)                        # (B, V)
+                    ki = jax.vmap(
+                        lambda kk: jax.random.fold_in(kk, i))(kb)
+                    d = jax.vmap(jax.random.categorical)(ki, lq)
+                    q = jnp.exp(lq)
+                else:
+                    d = jnp.argmax(logits, axis=-1)
+                    q = jnp.zeros_like(logits, jnp.float32)
+                d = d.astype(jnp.int32)
+                return (d, cd), (d, q)
+
+            (_, caches_d), (drafts, q_all) = lax.scan(
+                draft_step, (tok, caches_d), jnp.arange(gamma))
+            # cache d_{gamma-1}'s K/V at t+gamma (logits unused): on a
+            # fully-accepted round no later write covers that position
+            # before draft queries attend it (models/speculative.py's
+            # argument, per row here)
+            _, caches_d = draft._step_logits_rows(
+                drafts[-1], caches_d, t + gamma)
+
+            # target scores [last, d_0..d_{gamma-1}] per row in ONE
+            # per-row chunk: logits for positions t+1 .. t+gamma+1
+            drafts_b = jnp.swapaxes(drafts, 0, 1)      # (B, gamma)
+            chunk = jnp.concatenate([tok[:, None], drafts_b], axis=1)
+            if paged:
+                logits_t, tstate = model._chunk_logits_paged_rows(
+                    chunk, tstate, table, t)
+            else:
+                logits_t, tstate = model._chunk_logits_rows(
+                    chunk, tstate, t)
+
+            if sampled:
+                p_all = jnp.exp(_flp(logits_t))    # (B, gamma+1, V)
+                q_b = jnp.swapaxes(q_all, 0, 1)    # (B, gamma, V)
+                pi = jnp.take_along_axis(
+                    p_all[:, :gamma], drafts_b[..., None],
+                    axis=2)[..., 0]
+                qi = jnp.take_along_axis(
+                    q_b, drafts_b[..., None], axis=2)[..., 0]
+                ku = jax.vmap(
+                    lambda kk: jax.random.fold_in(kk, gamma))(kb)
+                u = jax.vmap(
+                    lambda kk: jax.random.uniform(kk, (gamma,)))(ku)
+                accept = u * qi < pi           # u < p/q without the /0
+                n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32),
+                                        axis=1), axis=1)
+                # residual max(p_n - q_n, 0) normalized; at n == gamma
+                # q is all-zero so this IS the bonus draw from p_gamma
+                p_n = jnp.take_along_axis(
+                    p_all, n[:, None, None], axis=1)[:, 0]
+                q_n = jnp.take_along_axis(
+                    q_b, jnp.minimum(n, gamma - 1)[:, None, None],
+                    axis=1)[:, 0]
+                q_n = jnp.where((n < gamma)[:, None], q_n, 0.0)
+                res = jnp.clip(p_n - q_n, 0.0, None)
+                norm = jnp.sum(res, axis=1, keepdims=True)
+                res = jnp.where(norm > 0, res / norm, p_n)
+                kc = jax.vmap(
+                    lambda kk: jax.random.fold_in(kk, gamma + 1))(kb)
+                corr = jax.vmap(jax.random.categorical)(
+                    kc, jnp.where(res > 0, jnp.log(res), -jnp.inf))
+            else:
+                tgt = jnp.argmax(logits_t, axis=-1)  # (B, gamma+1)
+                accept = drafts_b == tgt[:, :gamma]
+                n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32),
+                                        axis=1), axis=1)
+                corr = jnp.take_along_axis(tgt, n[:, None],
+                                           axis=1)[:, 0]
+            corr = corr.astype(jnp.int32)
+            slot = jnp.arange(gamma + 1)[None, :]
+            ext = jnp.concatenate([drafts_b, drafts_b[:, -1:]],
+                                  axis=1)
+            emitted = jnp.where(
+                slot < n[:, None], ext,
+                jnp.where(slot == n[:, None], corr[:, None],
+                          0)).astype(jnp.int32)
+            return tstate, caches_d, emitted, n, corr, t + n + 1
+
+        return jax.jit(spec)
+
+    def _step_spec(self):
+        """One speculative round (host side): run the jitted round,
+        then append each row's accepted prefix + correction in order —
+        budget/eos finishing applies per TOKEN, so a row never emits
+        past its budget or beyond eos."""
+        if not self.active.any():
+            return
+        if self._spec_fn is None:
+            self._spec_fn = self._build_spec_step()
+        was_active = self.active.copy()
+        gens = jnp.asarray(self._slot_gen.astype(np.uint32))
+        if self.paged:
+            (self.pools, self.caches_d, emitted, n, new_tok,
+             new_t) = self._spec_fn(self.pools, jnp.asarray(self.table),
+                                    self.caches_d, self.tok, self.t,
+                                    gens)
+        else:
+            (self.caches, self.caches_d, emitted, n, new_tok,
+             new_t) = self._spec_fn(self.caches, None, self.caches_d,
+                                    self.tok, self.t, gens)
+        emitted = np.asarray(jax.device_get(emitted))
+        n_np = np.asarray(jax.device_get(n))
+        new_tok = np.asarray(jax.device_get(new_tok))
+        new_t = np.asarray(jax.device_get(new_t))
+        self.spec_rounds += 1
+        self.spec_row_rounds += int(was_active.sum())
+        self.spec_accepted += int(n_np[was_active].sum())
+        for s in range(self.slots):
+            if not was_active[s]:
+                continue
+            for j in range(int(n_np[s]) + 1):
+                self.emitted[s].append(int(emitted[s, j]))
+                self.budget[s] -= 1
+                self._maybe_finish(s)
+                if not self.active[s]:
+                    break
+        # retired rows keep what _maybe_finish left (paged parking);
+        # live rows advance by their accepted count + 1
+        keep = was_active & self.active
+        self.tok = jnp.asarray(
+            np.where(keep, new_tok, np.asarray(self.tok)))
+        self.t = jnp.asarray(
+            np.where(keep, new_t, np.asarray(self.t)).astype(np.int32))
+
     def _step(self):
+        if self.draft is not None:
+            return self._step_spec()
         if not self.active.any():
             return
         if self._step_fn is None:
