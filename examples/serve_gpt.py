@@ -79,8 +79,8 @@ def main():
                           page_size=64, draft=draft, gamma=3,
                           prefill_chunk=64, temperature=0.8,
                           key=jax.random.key(3))
-    srids = [sdec.submit(rng.integers(1, 512, (n,)), max_new=12)
-             for n in (40, 5, 9)]
+    for n in (40, 5, 9):
+        sdec.submit(rng.integers(1, 512, (n,)), max_new=12)
     souts = sdec.run()
     rate = sdec.spec_accepted / max(1, sdec.spec_row_rounds)
     print(f"arena speculative: {len(souts)} requests done, "

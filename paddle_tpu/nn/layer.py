@@ -260,6 +260,38 @@ class Layer:
 _RNG_STACK: List[Dict[str, Any]] = []
 
 
+@contextlib.contextmanager
+def inject_state(*bindings):
+    """Temporarily bind ``(model, params[, buffers])`` tuples — the
+    multi-model sibling of Layer.functional_call for jit bodies that
+    drive SEVERAL Layers at once (speculative decoding's target+draft,
+    the serving arena's model+draft) or bound-method pipelines that
+    functional_call's single-method entry can't express.
+
+    Why it exists: a jitted closure over a Layer traces the weights as
+    HLO CONSTANTS. Off-chip that only bloats the program; through a
+    remote-compile relay (the axon tunnel POSTs the serialized program
+    over HTTP) a 100M-param model baked into every program exceeds the
+    relay's body limit (observed: HTTP 413 on every decode bench).
+    Passing params/buffers through this context as jit ARGUMENTS keeps
+    compiled programs weight-free. Restores the previous (concrete)
+    state on exit — same discipline as functional_call."""
+    saved = [(m, dict(m.named_parameters()), dict(m.named_buffers()))
+             for m, *_ in bindings]
+    try:
+        for b in bindings:
+            m, p = b[0], b[1]
+            m.set_parameters(p)
+            if len(b) > 2 and b[2]:
+                m.set_buffers(b[2])
+        yield
+    finally:
+        for m, p, bufs in saved:
+            m.set_parameters(p)
+            if bufs:
+                m.set_buffers(bufs)
+
+
 def stacked_parameters(layers) -> Dict[str, Any]:
     """Stack the params of structurally identical layers along a new
     leading axis — the uniform-block idiom shared by scan-over-layers

@@ -36,6 +36,7 @@ import numpy as np
 from jax import lax
 
 from .core.enforce import enforce
+from .nn.layer import inject_state
 from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
 
@@ -305,6 +306,16 @@ class BatchedDecoder:
         self._prefill_cache: Dict[int, object] = {}
         self._step_fn = None
         self._spec_fn = None
+        # weights/buffers snapshot, passed to every jitted fn as REAL
+        # arguments (inject_state): compiled programs stay weight-free,
+        # which remote-compile relays require (HTTP 413 otherwise) and
+        # which also lets all prefill buckets + the step share one
+        # on-device copy of the weights
+        self._mstate = (dict(model.named_parameters()),
+                        dict(model.named_buffers()))
+        self._dstate = (None if draft is None else
+                        (dict(draft.named_parameters()),
+                         dict(draft.named_buffers())))
         # spec-mode stats: mean accepted per target verify per row =
         # spec_accepted / spec_row_rounds; tokens per target call =
         # 1 + that (the real-pair speedup formula)
@@ -371,7 +382,7 @@ class BatchedDecoder:
             return fn
         model = self.model
 
-        def prefill(caches, padded, plen, s):
+        def prefill(mstate, caches, padded, plen, s):
             # chunk-run the FULL bucket (static shape) CACHE-ONLY —
             # positions >= plen write garbage above the cursor, masked
             # + overwritten later. The (lb, vocab) head projection
@@ -386,7 +397,8 @@ class BatchedDecoder:
                                                 keepdims=False)
                 return model._step_logits(last[None], row, plen - 1)
 
-            logits, new = _row_apply(caches, s, body)
+            with inject_state((model, *mstate)):
+                logits, new = _row_apply(caches, s, body)
             return new, logits[0]
 
         fn = jax.jit(prefill)
@@ -402,14 +414,15 @@ class BatchedDecoder:
             return fn
         model = self.model
 
-        def prefill(pools, table_row, padded, plen):
-            _, pools = model._chunk_logits_paged(
-                padded[None], pools, table_row, 0, head=False)
-            last = lax.dynamic_index_in_dim(padded, plen - 1,
-                                            keepdims=False)
-            logits, pools = model._step_logits_paged(
-                last[None], pools, table_row[None],
-                jnp.full((1,), plen - 1, jnp.int32))
+        def prefill(mstate, pools, table_row, padded, plen):
+            with inject_state((model, *mstate)):
+                _, pools = model._chunk_logits_paged(
+                    padded[None], pools, table_row, 0, head=False)
+                last = lax.dynamic_index_in_dim(padded, plen - 1,
+                                                keepdims=False)
+                logits, pools = model._step_logits_paged(
+                    last[None], pools, table_row[None],
+                    jnp.full((1,), plen - 1, jnp.int32))
             return pools, logits[0]
 
         fn = jax.jit(prefill)
@@ -424,19 +437,21 @@ class BatchedDecoder:
         model = self.model
         chunk_fn = self._prefill_cache.get(("suffix", lb))
         if chunk_fn is None:
-            def chunk(pools, table_row, padded, t0):
-                _, pools = model._chunk_logits_paged(
-                    padded[None], pools, table_row, t0, head=False)
+            def chunk(mstate, pools, table_row, padded, t0):
+                with inject_state((model, *mstate)):
+                    _, pools = model._chunk_logits_paged(
+                        padded[None], pools, table_row, t0, head=False)
                 return pools
 
             chunk_fn = jax.jit(chunk)
             self._prefill_cache[("suffix", lb)] = chunk_fn
         restep_fn = self._prefill_cache.get(("restep",))
         if restep_fn is None:
-            def restep(pools, table_row, tok, pos):
-                logits, pools = model._step_logits_paged(
-                    tok[None], pools, table_row[None],
-                    jnp.full((1,), pos, jnp.int32))
+            def restep(mstate, pools, table_row, tok, pos):
+                with inject_state((model, *mstate)):
+                    logits, pools = model._step_logits_paged(
+                        tok[None], pools, table_row[None],
+                        jnp.full((1,), pos, jnp.int32))
                 return pools, logits[0]
 
             restep_fn = jax.jit(restep)
@@ -452,9 +467,11 @@ class BatchedDecoder:
             return fn
         model = self.model
 
-        def chunk(caches, toks, t0, s):
-            _, new = _row_apply(caches, s, lambda row: model._chunk_logits(
-                toks[None], row, t0, head=False))
+        def chunk(mstate, caches, toks, t0, s):
+            with inject_state((model, *mstate)):
+                _, new = _row_apply(
+                    caches, s, lambda row: model._chunk_logits(
+                        toks[None], row, t0, head=False))
             return new
 
         fn = jax.jit(chunk)
@@ -469,10 +486,11 @@ class BatchedDecoder:
             return fn
         model = self.model
 
-        def restep(caches, tok, pos, s):
-            logits, new = _row_apply(
-                caches, s,
-                lambda row: model._step_logits(tok[None], row, pos))
+        def restep(mstate, caches, tok, pos, s):
+            with inject_state((model, *mstate)):
+                logits, new = _row_apply(
+                    caches, s,
+                    lambda row: model._step_logits(tok[None], row, pos))
             return new, logits[0]
 
         fn = jax.jit(restep)
@@ -503,10 +521,12 @@ class BatchedDecoder:
             if self.paged:
                 chunk_fn, _ = self._suffix_fns(c)
                 self.pools = chunk_fn(
-                    self.pools, jnp.asarray(self.table[s]), toks, t0)
+                    self._mstate, self.pools,
+                    jnp.asarray(self.table[s]), toks, t0)
             else:
                 self.caches = self._chunk_fn_contig(c)(
-                    self.caches, toks, jnp.asarray(t0, jnp.int32),
+                    self._mstate, self.caches, toks,
+                    jnp.asarray(t0, jnp.int32),
                     jnp.asarray(s, jnp.int32))
             st["off"] = t0 + c
             if st["off"] < plen:
@@ -517,10 +537,12 @@ class BatchedDecoder:
         if self.paged:
             _, restep_fn = self._suffix_fns(self.bucket)
             self.pools, logits = restep_fn(
-                self.pools, jnp.asarray(self.table[s]), last, plen - 1)
+                self._mstate, self.pools, jnp.asarray(self.table[s]),
+                last, plen - 1)
         else:
             self.caches, logits = self._restep_contig()(
-                self.caches, last, jnp.asarray(plen - 1, jnp.int32),
+                self._mstate, self.caches, last,
+                jnp.asarray(plen - 1, jnp.int32),
                 jnp.asarray(s, jnp.int32))
         self._pf[s] = None
         self._pf_order.pop(0)
@@ -597,9 +619,11 @@ class BatchedDecoder:
             return fn
         draft = self.draft
 
-        def prefill(caches, padded, s):
-            _, new = _row_apply(caches, s, lambda row: draft._chunk_logits(
-                padded[None], row, 0, head=False))
+        def prefill(dstate, caches, padded, s):
+            with inject_state((draft, *dstate)):
+                _, new = _row_apply(
+                    caches, s, lambda row: draft._chunk_logits(
+                        padded[None], row, 0, head=False))
             return new
 
         fn = jax.jit(prefill)
@@ -646,7 +670,7 @@ class BatchedDecoder:
                 # target's prefix hit (prefix pages cache only the
                 # target's K/V); draft prefill is the cheap side
                 self.caches_d = self._draft_prefill_fn(lb)(
-                    self.caches_d, jnp.asarray(padded),
+                    self._dstate, self.caches_d, jnp.asarray(padded),
                     jnp.asarray(s, jnp.int32))
             if self.prefill_chunk is not None:
                 # defer: chunk grid starts at the cached frontier
@@ -666,7 +690,7 @@ class BatchedDecoder:
                 row = self.table[s]
                 if cached == 0:
                     self.pools, logits = self._prefill_fn_paged(lb)(
-                        self.pools, jnp.asarray(row),
+                        self._mstate, self.pools, jnp.asarray(row),
                         jnp.asarray(padded), plen)
                 else:
                     # prefill only the uncached suffix (page-aligned
@@ -680,17 +704,18 @@ class BatchedDecoder:
                         spad[:len(suf)] = suf
                         chunk_fn, restep_fn = self._suffix_fns(slb)
                         self.pools = chunk_fn(
-                            self.pools, jnp.asarray(row),
+                            self._mstate, self.pools, jnp.asarray(row),
                             jnp.asarray(spad), cached)
                     else:
                         _, restep_fn = self._suffix_fns(self.bucket)
                     self.pools, logits = restep_fn(
-                        self.pools, jnp.asarray(row),
+                        self._mstate, self.pools, jnp.asarray(row),
                         jnp.asarray(r.prompt[plen - 1], jnp.int32),
                         plen - 1)
             else:
                 self.caches, logits = self._prefill_fn(lb)(
-                    self.caches, jnp.asarray(padded), plen, s)
+                    self._mstate, self.caches, jnp.asarray(padded),
+                    plen, s)
             self._activate(s, r, logits, int(plen))
 
     def _pick(self, logits, s: int, pos: int):
@@ -707,18 +732,20 @@ class BatchedDecoder:
         model = self.model
 
         if self.paged:
-            def step(pools, table, tok, t):
-                logits, pools = model._step_logits_paged(
-                    tok, pools, table, t)
+            def step(mstate, pools, table, tok, t):
+                with inject_state((model, *mstate)):
+                    logits, pools = model._step_logits_paged(
+                        tok, pools, table, t)
                 return pools, logits
         else:
-            def step(caches, tok, t):
+            def step(mstate, caches, tok, t):
                 # ONE un-vmapped program over the whole arena: per-row
                 # cursors thread through forward_step_rows, so the
                 # flash-decode kernel (per-row scalar prefetch) is
                 # eligible — each slot reads only ITS live cache blocks
-                logits, caches = model._step_logits_rows(
-                    tok, caches, t, decode_kernel=True)
+                with inject_state((model, *mstate)):
+                    logits, caches = model._step_logits_rows(
+                        tok, caches, t, decode_kernel=True)
                 return caches, logits
 
         return jax.jit(step)
@@ -835,7 +862,12 @@ class BatchedDecoder:
                           0)).astype(jnp.int32)
             return tstate, caches_d, emitted, n, corr, t + n + 1
 
-        return jax.jit(spec)
+        def spec_injected(mstate, dstate, tstate, table, caches_d, tok,
+                          t, gens):
+            with inject_state((model, *mstate), (draft, *dstate)):
+                return spec(tstate, table, caches_d, tok, t, gens)
+
+        return jax.jit(spec_injected)
 
     def _step_spec(self):
         """One speculative round (host side): run the jitted round,
@@ -850,17 +882,21 @@ class BatchedDecoder:
         gens = jnp.asarray(self._slot_gen.astype(np.uint32))
         if self.paged:
             (self.pools, self.caches_d, emitted, n, new_tok,
-             new_t) = self._spec_fn(self.pools, jnp.asarray(self.table),
+             new_t) = self._spec_fn(self._mstate, self._dstate,
+                                    self.pools,
+                                    jnp.asarray(self.table),
                                     self.caches_d, self.tok, self.t,
                                     gens)
         else:
             (self.caches, self.caches_d, emitted, n, new_tok,
-             new_t) = self._spec_fn(self.caches, None, self.caches_d,
+             new_t) = self._spec_fn(self._mstate, self._dstate,
+                                    self.caches, None, self.caches_d,
                                     self.tok, self.t, gens)
-        emitted = np.asarray(jax.device_get(emitted))
-        n_np = np.asarray(jax.device_get(n))
-        new_tok = np.asarray(jax.device_get(new_tok))
-        new_t = np.asarray(jax.device_get(new_t))
+        # ONE batched transfer for the round's four host-side scalars
+        # (per-array device_get would pay four sync round trips in the
+        # serving hot loop)
+        emitted, n_np, new_tok, new_t = jax.device_get(
+            (emitted, n, new_tok, new_t))
         self.spec_rounds += 1
         self.spec_row_rounds += int(was_active.sum())
         self.spec_accepted += int(n_np[was_active].sum())
@@ -891,10 +927,11 @@ class BatchedDecoder:
         was_active = self.active.copy()
         if self.paged:
             self.pools, logits = self._step_fn(
-                self.pools, jnp.asarray(self.table), self.tok, self.t)
+                self._mstate, self.pools, jnp.asarray(self.table),
+                self.tok, self.t)
         else:
-            self.caches, logits = self._step_fn(self.caches, self.tok,
-                                                self.t)
+            self.caches, logits = self._step_fn(
+                self._mstate, self.caches, self.tok, self.t)
         # ONE batched pick over all slots (a per-slot un-jitted
         # dispatch would dominate the loop this module exists to make
         # fast); the token lands at position t+1, so that is its key

@@ -132,13 +132,15 @@ item deepfm_sparse_v1m 1200 python bench.py --model deepfm_sparse --vocab 100000
 # these from colliding with the headline history entries
 item bench_nmt_b256    1200 python bench.py --model transformer_nmt --batch-size 256
 item bench_rn50_b256   1500 python bench.py --model resnet50 --batch-size 256
-item bench_lstm_b2048  1200 python bench.py --model stacked_lstm --batch-size 2048
+# b2048 OOMs the 16G v5e by 600M (driver-captured); b1024 is the
+# largest feasible point of the batch lever
+item bench_lstm_b1024  1200 python bench.py --model stacked_lstm --batch-size 1024
 # r4 MFU levers (VERDICT r3 #4): scan-unroll sweep for the LSTM
 # recurrence, steps-per-call for the dispatch-bound CTR model (the
 # BASELINE roofline note: 12 ms/step measured vs ~73 us ceiling),
 # NHWC-vs-NCHW + batch for the grouped-conv stack, bigger NMT batch
-item bench_lstm_u4     1200 python bench.py --model stacked_lstm --batch-size 2048 --scan-unroll 4
-item bench_lstm_u8     1200 python bench.py --model stacked_lstm --batch-size 2048 --scan-unroll 8
+item bench_lstm_b1024_u4 1200 python bench.py --model stacked_lstm --batch-size 1024 --scan-unroll 4
+item bench_lstm_b1024_u8 1200 python bench.py --model stacked_lstm --batch-size 1024 --scan-unroll 8
 item bench_deepfm_k8   1200 python bench.py --model deepfm --steps-per-call 8
 item bench_deepfm_k32  1200 python bench.py --model deepfm --steps-per-call 32
 item bench_se_nchw     1500 python bench.py --model se_resnext50 --layout NCHW
