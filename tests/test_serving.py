@@ -364,6 +364,24 @@ class TestChunkedPrefill:
         agree = (out == cold_out).mean()
         assert agree >= 0.9, (agree, out, cold_out)
 
+    def test_final_chunk_slide_at_capacity(self):
+        """capacity NOT a multiple of the chunk: the final chunk must
+        slide back (t0 = capacity - C) instead of clamp-corrupting K/V
+        below the frontier — the overlap re-writes the same real
+        tokens idempotently, so the result matches monolithic
+        prefill. (Contiguous-only: paged capacities are page-multiples
+        and the page demand bounds the grid, so the slide can't
+        trigger there.)"""
+        m = _model(45)
+        prompt = _prompt(50, 145)      # grid pads to 64 > capacity 56
+
+        def run(**kw):
+            dec = BatchedDecoder(m, slots=1, capacity=56, **kw)
+            rid = dec.submit(prompt, 4)
+            return dec.run()[rid]
+
+        np.testing.assert_array_equal(run(prefill_chunk=16), run())
+
     def test_typed_errors(self):
         m = _model(44)
         with pytest.raises(Exception, match="divide page_size"):

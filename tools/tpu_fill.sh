@@ -183,14 +183,14 @@ item serve_gpt_pgpc    1800 python bench.py --model gpt_serve --paged --prefill-
 # NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
 # C++ predictor + PJRT C API (export runs off-chip: StableHLO is
 # portable; only the ptserve compile+run needs the chip)
-item serve_rn50        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model resnet50 --out /tmp/rn50_art --platform cpu && paddle_tpu/native/ptserve /tmp/rn50_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
-item serve_bert        1500 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model bert_base --out /tmp/bert_art --platform cpu && paddle_tpu/native/ptserve /tmp/bert_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
+item serve_rn50        1500 bash tools/ptserve_item.sh resnet50 /tmp/rn50_art 8 100
+item serve_bert        1500 bash tools/ptserve_item.sh bert_base /tmp/bert_art 8 100
 # int8 PTQ serving latency vs fp32 (VERDICT r4 #8: accuracy is asserted
 # off-chip in tests/test_quant_serving.py; these capture the on-chip
 # p50/p99 side of the same artifacts)
-item serve_rn50_int8   1800 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model resnet50 --quantize --out /tmp/rn50_int8 --platform cpu && paddle_tpu/native/ptserve /tmp/rn50_int8 "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
-item serve_bert_int8   1800 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model bert_base --quantize --out /tmp/bert_int8 --platform cpu && paddle_tpu/native/ptserve /tmp/bert_int8 "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 8 100'
-item serve_gpt_nat     1800 bash -c 'make -C paddle_tpu/native -s ptserve && python tools/export_serving.py --model gpt --out /tmp/gpt_art --platform cpu && paddle_tpu/native/ptserve /tmp/gpt_art "$(python -c "import libtpu,os;print(os.path.join(os.path.dirname(libtpu.__file__),\"libtpu.so\"))")" 4 50'
+item serve_rn50_int8   1800 bash tools/ptserve_item.sh resnet50 /tmp/rn50_int8 8 100 --quantize
+item serve_bert_int8   1800 bash tools/ptserve_item.sh bert_base /tmp/bert_int8 8 100 --quantize
+item serve_gpt_nat     1800 bash tools/ptserve_item.sh gpt /tmp/gpt_art 4 50
 # -- tier 4: full-sweep completeness (superset of the retired
 # tpu_session.sh list so a FRESH environment gets every model and every
 # default tune shape from this one script; in an already-captured
