@@ -117,15 +117,17 @@ def _band_width_i(*, block_q, block_k, window, causal, n_i):
     return min(n_i, span // block_q + 2)
 
 
-def _banded_imap(lo_fn, n, row_fn=lambda b: b):
+def _banded_imap(lo_fn, n, row_fn=lambda b: b, zeros=1):
     """ONE definition of the banded index-map clamp, shared by every
-    spec (k/v and q-side, both grid orders): maps (grid row, outer
-    block, band step) -> (row_fn(row), clip(lo_fn(outer) + step), 0).
-    The kernels recover the same index with the same expression — a
-    single source for the band arithmetic."""
+    spec (k/v and q-side, both grid orders; ``zeros`` trailing unit
+    coordinates — 2 for the 4-D blocked mask layout): maps (grid row,
+    outer block, band step) -> (row_fn(row), clip(lo_fn(outer) + step),
+    0...). The kernels recover the same index with the same
+    expression — a single source for the band arithmetic."""
 
     def imap(b, outer, step):
-        return (row_fn(b), jnp.clip(lo_fn(outer) + step, 0, n - 1), 0)
+        return (row_fn(b), jnp.clip(lo_fn(outer) + step, 0, n - 1),
+                *([0] * zeros))
 
     return imap
 
@@ -230,7 +232,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
         if has_mask:
             # key-padding keep-mask (1, bk) broadcasting over q rows;
             # the j-th block arrives via the index map (blocked layout)
-            kvm = kvm_ref[0]
+            kvm = kvm_ref[0, 0]
             s = jnp.where(kvm > 0, s, _NEG_INF)
         if has_segs:
             # packed sequences: attend only within the same segment.
@@ -238,7 +240,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, window,
             # (1, bk) via the blocked index map — broadcast equality
             # gives the (bq, bk) block mask with no in-kernel transpose
             qseg = qseg_ref[0]                       # (bq, 1)
-            kseg = kseg_ref[0]                       # (1, bk)
+            kseg = kseg_ref[0, 0]                    # (1, bk)
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         m_prev = m_ref[:, :1]                              # (bq, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -303,34 +305,39 @@ def _kv_spec(block_k, d, nheads, kv_heads, kv_arg_pos=2):
 
 def _mask_block_spec(nheads, block_k, j_pos=2, banded_lo=None,
                      n_j=None):
-    """kv-side mask/segment block spec over the (B, n_j, block_k)
+    """kv-side mask/segment block spec over the (B, n_j, 1, block_k)
     BLOCKED layout (the call sites reshape the (B, 1, Tk) row): the
-    grid's k-block index picks the j-th chunk via the INDEX MAP, so
-    the kernel never slices the lane dim at a dynamic offset — Mosaic
-    cannot prove ``j * block_k`` is lane-aligned when block_k is not a
-    multiple of 128, and the seq-64 NMT shape (block_k=64) failed TPU
-    compilation exactly there ("cannot statically prove that index in
-    dimension 2 is a multiple of 128"). A block whose lane dim equals
-    the array's last dim is legal for ANY block_k. ``j_pos`` names the
-    grid arg carrying the k-block index (2 for the fwd/dq (b, i, j)
-    grids, 1 for the dkv (b, j, i) grid); ``banded_lo`` switches to
-    the shared banded clamp (the kernels recover the same index)."""
+    grid's k-block index picks the j-th chunk via the INDEX MAP on a
+    LEADING (untiled) dim, so the kernel never slices the lane dim at
+    a dynamic offset — Mosaic cannot prove ``j * block_k`` is
+    lane-aligned when block_k is not a multiple of 128, and the seq-64
+    NMT shape (block_k=64) failed TPU compilation exactly there
+    ("cannot statically prove that index in dimension 2 is a multiple
+    of 128"). The last TWO dims stay (1, block_k) == the array's own
+    trailing dims, which satisfies the Mosaic tiling rule for ANY
+    block_k; n_j must NOT sit in the sublane slot (a (1-of-n_j) block
+    there violates the divisible-by-8-or-full rule whenever n_j > 1 —
+    caught by tests/test_pallas_mosaic_lowering.py). ``j_pos`` names
+    the grid arg carrying the k-block index (2 for the fwd/dq
+    (b, i, j) grids, 1 for the dkv (b, j, i) grid); ``banded_lo``
+    switches to the banded clamp (the kernels recover the same
+    index)."""
     if banded_lo is not None:
-        return _vmem_spec((1, 1, block_k), _banded_imap(
-            banded_lo, n_j, lambda b, _h=nheads: b // _h))
+        return _vmem_spec((1, 1, 1, block_k), _banded_imap(
+            banded_lo, n_j, lambda b, _h=nheads: b // _h, zeros=2))
 
     def imap(*args, _h=nheads, _p=j_pos):
-        return (args[0] // _h, args[_p], 0)
+        return (args[0] // _h, args[_p], 0, 0)
 
-    return _vmem_spec((1, 1, block_k), imap)
+    return _vmem_spec((1, 1, 1, block_k), imap)
 
 
 def _block_mask(m, n_j, block_k):
-    """(B, 1, Tk) kv-side mask/segment row -> (B, n_j, block_k) blocked
-    layout for _mask_block_spec (None passes through)."""
+    """(B, 1, Tk) kv-side mask/segment row -> (B, n_j, 1, block_k)
+    blocked layout for _mask_block_spec (None passes through)."""
     if m is None:
         return None
-    return m.reshape(m.shape[0], n_j, block_k)
+    return m.reshape(m.shape[0], n_j, 1, block_k)
 
 
 def _fwd_call(q, k, v, kvm, qseg, kseg, seed, nheads, kv_heads, causal,
@@ -453,11 +460,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                                offset=offset, block_q=block_q,
                                block_k=block_k)
         if has_mask:
-            kvm = kvm_ref[0]    # j-th block via the index map
+            kvm = kvm_ref[0, 0]  # j-th block via the index map
             s = jnp.where(kvm > 0, s, _NEG_INF)
         if has_segs:
             qseg = qseg_ref[0]
-            kseg = kseg_ref[0]
+            kseg = kseg_ref[0, 0]
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)
         if causal or window is not None or has_mask or has_segs:
@@ -529,11 +536,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                                offset=offset, block_q=block_q,
                                block_k=block_k)
         if has_mask:
-            kvm = kvm_ref[0]    # j-th block via the index map
+            kvm = kvm_ref[0, 0]  # j-th block via the index map
             s = jnp.where(kvm > 0, s, _NEG_INF)
         if has_segs:
             qseg = qseg_ref[0]
-            kseg = kseg_ref[0]
+            kseg = kseg_ref[0, 0]
             s = jnp.where(qseg == kseg, s, _NEG_INF)
         p = jnp.exp(s - lse)                               # (bq, bk) f32
         if causal or window is not None or has_mask or has_segs:
