@@ -763,7 +763,8 @@ def bench_gpt_decode(steps: int, batch_size: int, amp=None,
 def bench_gpt_serve(steps: int, batch_size: int, amp=None,
                     max_new: int = 64, smoke: bool = False,
                     weight_only: bool = False, paged: bool = False,
-                    gamma: int = 0, prefill_chunk=None):
+                    gamma: int = 0, prefill_chunk=None,
+                    decode_steps: int = 1):
     """Continuous-batching serving throughput (serving.BatchedDecoder):
     2x``batch_size`` requests with MIXED prompt lengths over a
     ``batch_size``-slot arena — generated tokens/sec across the whole
@@ -812,6 +813,8 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
         kw["gamma"] = gamma
     if prefill_chunk:
         kw["prefill_chunk"] = prefill_chunk
+    if decode_steps > 1:
+        kw["decode_steps"] = decode_steps
     dec = BatchedDecoder(model, slots=slots, capacity=cap, **kw)
 
     def run_all():
@@ -1318,6 +1321,11 @@ def main():
                     help="gpt_serve: chunked prefill — C prompt tokens "
                     "per serving tick instead of whole-prompt "
                     "admission stalls (_pcN history key)")
+    ap.add_argument("--decode-steps", dest="decode_steps", type=int,
+                    default=None,
+                    help="gpt_serve: k tokens per serving dispatch "
+                    "(in-device picks; token-identical to k=1) — "
+                    "amortizes the per-dispatch round trip (_dsN key)")
     ap.add_argument("--weight-only", dest="weight_only",
                     action="store_true",
                     help="gpt_decode/gpt_serve: weight-only int8 "
@@ -1394,6 +1402,12 @@ def main():
         # different admission schedule (prefill interleaved with
         # decode): own key per chunk size
         metric += f"_pc{args.prefill_chunk}"
+    if (args.decode_steps and args.decode_steps > 1
+            and "decode_steps" in sig):
+        # same workload, fused dispatch — own key so the RTT
+        # amortization stays visible next to the k=1 row (--decode-steps
+        # 1 IS the baseline: no key fork, mirrors --gamma 0)
+        metric += f"_ds{args.decode_steps}"
     if "cached" in sig and not args.kv_cache:
         # same workload, different implementation — its own history key
         # so the cache-vs-recompute comparison stays visible
@@ -1512,6 +1526,9 @@ def main():
         kwargs["paged"] = True
     if args.prefill_chunk and "prefill_chunk" in sig:
         kwargs["prefill_chunk"] = args.prefill_chunk
+    if (args.decode_steps and args.decode_steps > 1
+            and "decode_steps" in sig):
+        kwargs["decode_steps"] = args.decode_steps
     if args.steps_per_call:
         if "steps_per_call" in sig:
             kwargs["steps_per_call"] = args.steps_per_call

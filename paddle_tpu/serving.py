@@ -181,7 +181,7 @@ class BatchedDecoder:
                  pages: Optional[int] = None, page_size: int = 128,
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
-                 draft=None, gamma: int = 4):
+                 draft=None, gamma: int = 4, decode_steps: int = 1):
         enforce(slots >= 1, "slots must be >= 1, got %s", slots)
         enforce(capacity >= prompt_bucket,
                 "capacity %s < prompt bucket %s", capacity,
@@ -209,6 +209,18 @@ class BatchedDecoder:
                 enforce(page_size % prefill_chunk == 0,
                         "prefill_chunk %s must divide page_size %s",
                         prefill_chunk, page_size)
+        # MULTI-TOKEN DECODE STEPS (opt-in, decode_steps=k): the jitted
+        # step scans k single-token steps with the token picks moved
+        # IN-DEVICE, so every dispatch advances all slots k tokens —
+        # the steps-per-call lever applied to serving. On high-latency
+        # links (the axon relay: one ~RTT per dispatch) this multiplies
+        # arena throughput by ~k. Semantics: token-identical to k=1
+        # (same fold_in key chain); admission/eos granularity coarsens
+        # to k (a row hitting eos mid-window discards the tail
+        # host-side and never emits past eos or its budget).
+        self.decode_steps = int(decode_steps)
+        enforce(self.decode_steps >= 1,
+                "decode_steps must be >= 1, got %s", decode_steps)
         # SPECULATIVE DECODING over the arena (opt-in): a small draft
         # model proposes ``gamma`` tokens per round at every slot's own
         # cursor; the target verifies all gamma+1 in ONE per-row chunk
@@ -225,9 +237,18 @@ class BatchedDecoder:
             enforce(model.cfg.vocab_size == draft.cfg.vocab_size,
                     "vocab mismatch: target %s vs draft %s",
                     model.cfg.vocab_size, draft.cfg.vocab_size)
-        # verify-chunk writes run up to cursor+gamma; spec-mode
-        # admission budgets those positions too
-        self._extra = self.gamma if draft is not None else 0
+            enforce(self.decode_steps == 1,
+                    "decode_steps composes with the plain arena only; "
+                    "speculative rounds already emit multiple tokens "
+                    "per dispatch")
+        # overrun margin budgeted at admission: spec verify-chunks
+        # write up to cursor+gamma; a decode_steps window can write up
+        # to k-1 positions past a mid-window finish. Without the
+        # margin those writes would scatter into UNALLOCATED table
+        # entries (= physical page 0) in paged mode, or clamp-corrupt
+        # the contiguous row tail
+        self._extra = (self.gamma if draft is not None
+                       else self.decode_steps - 1)
         self.slots, self.capacity = slots, capacity
         self.eos_id = eos_id
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -334,11 +355,12 @@ class BatchedDecoder:
                 "empty prompt")
         enforce(max_new >= 1, "max_new must be >= 1, got %s", max_new)
         r = Request(self._next_rid, prompt_ids, max_new)
-        # spec mode reserves gamma extra positions: the verify chunk
-        # writes up to cursor+gamma, and a clamped contiguous write
-        # there would corrupt K/V BELOW a live cursor
+        # spec/multi-step modes reserve extra positions (see _extra):
+        # overrun writes past an unreserved capacity would corrupt K/V
+        # below a live cursor (contiguous clamp) or another request's
+        # pages (paged unallocated-entry scatter)
         enforce(len(r.prompt) + max_new + self._extra <= self.capacity,
-                "prompt %s + max_new %s (+%s speculative margin) "
+                "prompt %s + max_new %s (+%s speculative/window margin) "
                 "exceeds slot capacity %s",
                 len(r.prompt), max_new, self._extra, self.capacity)
         if self.paged:
@@ -728,27 +750,92 @@ class BatchedDecoder:
         return sample_from_logits(logits, k, self.temperature,
                                   self.top_k, self.top_p).astype(jnp.int32)
 
-    def _build_step(self):
-        model = self.model
+    def _build_multi_step(self):
+        """decode_steps=k jitted step: scan k single-token steps with
+        the picks IN-DEVICE (same fold_in key chain as the host picks,
+        so outputs are token-identical to k=1) — every dispatch
+        advances all slots k tokens, amortizing the per-dispatch
+        round trip exactly like the training benches' steps-per-call.
+        Inactive/parked rows compute junk the host discards; their
+        writes drop (paged) or land above any attended position."""
+        model, kd = self.model, self.decode_steps
+        sampled, temp = self.sampled, self.temperature
+        top_k, top_p, key = self.top_k, self.top_p, self.key
+        paged = self.paged
 
-        if self.paged:
-            def step(mstate, pools, table, tok, t):
+        def pick(logits, gens, poss):
+            if not sampled:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keys = jax.vmap(lambda g, p: jax.random.fold_in(
+                jax.random.fold_in(key, g), p))(
+                gens, poss.astype(jnp.uint32))
+            return jax.vmap(lambda lg, kk: sample_from_logits(
+                lg[None], kk, temp, top_k,
+                top_p)[0])(logits, keys).astype(jnp.int32)
+
+        if paged:
+            def step(mstate, pools, table, tok, t, gens):
                 with inject_state((model, *mstate)):
-                    logits, pools = model._step_logits_paged(
-                        tok, pools, table, t)
-                return pools, logits
+                    def body(c, _):
+                        pools, tok, t = c
+                        logits, pools = model._step_logits_paged(
+                            tok, pools, table, t)
+                        nxt = pick(logits, gens, t + 1)
+                        return (pools, nxt, t + 1), nxt
+
+                    (pools, _, _), toks = lax.scan(
+                        body, (pools, tok, t), None, length=kd)
+                return pools, jnp.swapaxes(toks, 0, 1)   # (B, k)
         else:
-            def step(mstate, caches, tok, t):
-                # ONE un-vmapped program over the whole arena: per-row
-                # cursors thread through forward_step_rows, so the
-                # flash-decode kernel (per-row scalar prefetch) is
-                # eligible — each slot reads only ITS live cache blocks
+            def step(mstate, caches, tok, t, gens):
                 with inject_state((model, *mstate)):
-                    logits, caches = model._step_logits_rows(
-                        tok, caches, t, decode_kernel=True)
-                return caches, logits
+                    def body(c, _):
+                        caches, tok, t = c
+                        logits, caches = model._step_logits_rows(
+                            tok, caches, t, decode_kernel=True)
+                        nxt = pick(logits, gens, t + 1)
+                        return (caches, nxt, t + 1), nxt
+
+                    (caches, _, _), toks = lax.scan(
+                        body, (caches, tok, t), None, length=kd)
+                return caches, jnp.swapaxes(toks, 0, 1)
 
         return jax.jit(step)
+
+    def _step_multi(self):
+        """decode_steps host side: append each row's k tokens in order
+        with per-TOKEN budget/eos finishing (nothing emits past eos or
+        budget; a mid-window finish discards the tail)."""
+        if not self.active.any():
+            return
+        if self._step_fn is None:
+            self._step_fn = self._build_multi_step()
+        was_active = self.active.copy()
+        gens = jnp.asarray(self._slot_gen.astype(np.uint32))
+        if self.paged:
+            self.pools, toks = self._step_fn(
+                self._mstate, self.pools, jnp.asarray(self.table),
+                self.tok, self.t, gens)
+        else:
+            self.caches, toks = self._step_fn(
+                self._mstate, self.caches, self.tok, self.t, gens)
+        toks = np.asarray(jax.device_get(toks)).astype(np.int32)
+        for s in range(self.slots):
+            if not was_active[s]:
+                continue
+            for j in range(self.decode_steps):
+                self.emitted[s].append(int(toks[s, j]))
+                self.budget[s] -= 1
+                self._maybe_finish(s)
+                if not self.active[s]:
+                    break
+        # retired rows keep what _maybe_finish left (paged parking)
+        keep = was_active & self.active
+        cur_t = np.asarray(self.t)
+        self.tok = jnp.asarray(np.where(
+            keep, toks[:, -1], np.asarray(self.tok)).astype(np.int32))
+        self.t = jnp.asarray(np.where(
+            keep, cur_t + self.decode_steps, cur_t).astype(np.int32))
 
     def _build_spec_step(self):
         """One speculative ROUND over the whole arena, jitted: gamma
@@ -920,43 +1007,12 @@ class BatchedDecoder:
     def _step(self):
         if self.draft is not None:
             return self._step_spec()
-        if not self.active.any():
-            return
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
-        was_active = self.active.copy()
-        if self.paged:
-            self.pools, logits = self._step_fn(
-                self._mstate, self.pools, jnp.asarray(self.table),
-                self.tok, self.t)
-        else:
-            self.caches, logits = self._step_fn(
-                self._mstate, self.caches, self.tok, self.t)
-        # ONE batched pick over all slots (a per-slot un-jitted
-        # dispatch would dominate the loop this module exists to make
-        # fast); the token lands at position t+1, so that is its key
-        # position — the admit-time pick used plen, never colliding
-        if self.sampled:
-            poss = np.asarray(self.t) + 1
-            keys = jax.vmap(lambda g, p: jax.random.fold_in(
-                jax.random.fold_in(self.key, g), p))(
-                jnp.asarray(self._slot_gen.astype(np.uint32)),
-                jnp.asarray(poss.astype(np.uint32)))
-            toks = jax.vmap(lambda lg, k: sample_from_logits(
-                lg[None], k, self.temperature, self.top_k,
-                self.top_p)[0])(logits, keys)
-        else:
-            toks = jnp.argmax(logits, axis=-1)
-        toks = np.asarray(jax.device_get(toks)).astype(np.int32)
-        for s in range(self.slots):
-            if not was_active[s]:
-                continue
-            self.emitted[s].append(int(toks[s]))
-            self.budget[s] -= 1
-            self._maybe_finish(s)
-        self.tok = jnp.asarray(np.where(was_active, toks,
-                                        np.asarray(self.tok)))
-        self.t = self.t + jnp.asarray(was_active.astype(np.int32))
+        # k == 1 rides the same generalized scan path (length-1 scan,
+        # in-device pick — pinned token-identical to the historical
+        # host-pick loop by TestMultiStepDecode): ONE epilogue for
+        # emit/budget/eos and one key chain, never two copies to keep
+        # in lockstep
+        return self._step_multi()
 
     def _maybe_finish(self, s: int):
         r = self.owner[s]

@@ -517,5 +517,96 @@ class TestSpeculativeArena:
         with pytest.raises(Exception, match="vocab"):
             BatchedDecoder(m, slots=1, capacity=64, draft=bad)
         dec = BatchedDecoder(m, slots=1, capacity=32, draft=d, gamma=4)
-        with pytest.raises(Exception, match="speculative margin"):
+        with pytest.raises(Exception, match="margin"):
             dec.submit(_prompt(8, 197), 21)    # 8 + 21 + 4 > 32
+
+
+class TestMultiStepDecode:
+    """BatchedDecoder(decode_steps=k): one dispatch advances every slot
+    k tokens with IN-DEVICE picks — token-identical to k=1 (the same
+    fold_in key chain), with per-token budget/eos finishing host-side.
+    The steps-per-call lever applied to serving (RTT-bound links)."""
+
+    def test_greedy_matches_k1_both_cache_modes(self):
+        m = _model(60)
+        prompts = [_prompt(n, 200 + i) for i, n in enumerate((5, 9, 4))]
+
+        def run(**kw):
+            dec = BatchedDecoder(m, slots=2, capacity=64, **kw)
+            rids = [dec.submit(p, 12) for p in prompts]
+            outs = dec.run()
+            return [outs[r] for r in rids]
+
+        for base in ({}, {"pages": 8, "page_size": 64}):
+            want = run(**base)
+            got = run(decode_steps=4, **base)
+            for g, w in zip(got, want):
+                np.testing.assert_array_equal(g, w)
+
+    def test_sampled_matches_k1(self):
+        m = _model(61)
+
+        def run(**kw):
+            dec = BatchedDecoder(m, slots=2, capacity=64,
+                                 temperature=0.8, top_k=40,
+                                 key=jax.random.key(7), **kw)
+            rids = [dec.submit(_prompt(5, 210), 10),
+                    dec.submit(_prompt(8, 211), 10)]
+            outs = dec.run()
+            return [outs[r] for r in rids]
+
+        for x, y in zip(run(), run(decode_steps=5)):
+            np.testing.assert_array_equal(x, y)
+
+    def test_eos_and_budget_respected_mid_window(self):
+        """Budgets NOT divisible by k and an eos landing mid-window:
+        nothing emits past either; results match k=1 exactly."""
+        m = _model(62)
+        prompt = _prompt(5, 220)
+        free = BatchedDecoder(m, slots=1, capacity=64)
+        rid = free.submit(prompt, 20)
+        eos = int(free.run()[rid][6])       # fires mid-window for k=4
+
+        def run(**kw):
+            dec = BatchedDecoder(m, slots=1, capacity=64, eos_id=eos,
+                                 **kw)
+            r1 = dec.submit(prompt, 21)     # 21 % 4 != 0
+            r2 = dec.submit(_prompt(4, 221), 3)  # budget < k
+            outs = dec.run()
+            return [outs[r1], outs[r2]]
+
+        want, got = run(), run(decode_steps=4)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        hits = np.flatnonzero(got[0] == eos)
+        if len(hits):
+            assert hits[0] == len(got[0]) - 1
+
+    def test_composes_with_chunked_prefill(self):
+        m = _model(63)
+        prompts = [_prompt(34, 230), _prompt(6, 231)]
+
+        def run(**kw):
+            dec = BatchedDecoder(m, slots=2, capacity=128, pages=8,
+                                 page_size=64, **kw)
+            rids = [dec.submit(p, 9) for p in prompts]
+            outs = dec.run()
+            return [outs[r] for r in rids]
+
+        want = run()
+        got = run(decode_steps=3, prefill_chunk=32)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_typed_errors(self):
+        m = _model(64)
+        d = _model(65)
+        with pytest.raises(Exception, match="decode_steps"):
+            BatchedDecoder(m, slots=1, capacity=64, draft=d,
+                           decode_steps=4)
+        with pytest.raises(Exception, match="decode_steps"):
+            BatchedDecoder(m, slots=1, capacity=64, decode_steps=0)
+        # the k-1 overrun margin is budgeted at admission
+        dec = BatchedDecoder(m, slots=1, capacity=32, decode_steps=8)
+        with pytest.raises(Exception, match="margin"):
+            dec.submit(_prompt(8, 240), 18)   # 8 + 18 + 7 > 32

@@ -180,6 +180,9 @@ item serve_gpt_cb_pg   1800 python bench.py --model gpt_serve --paged
 # extra = the real-pair speedup formula) and chunked-prefill smoothing
 item serve_gpt_spec    1800 python bench.py --model gpt_serve --gamma 4
 item serve_gpt_pgpc    1800 python bench.py --model gpt_serve --paged --prefill-chunk 64
+# multi-token serving dispatch: the RTT-amortization lever (k tokens
+# per round trip; token-identical to k=1)
+item serve_gpt_ds8     1800 python bench.py --model gpt_serve --decode-steps 8
 # NATIVE serving latency (VERDICT r3 #7): ptserve p50/p99 through the
 # C++ predictor + PJRT C API (export runs off-chip: StableHLO is
 # portable; only the ptserve compile+run needs the chip)
