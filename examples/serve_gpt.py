@@ -74,7 +74,10 @@ def main():
     # tick so live slots keep their decode cadence) ------------------
     # sampled mode: rejection-sampling acceptance (u*q < p) is
     # meaningful even for this untrained pair — greedy acceptance
-    # would be argmax agreement, ~0 across two random models
+    # would be argmax agreement, ~0 across two random models.
+    # (For a dispatch-bound link WITHOUT a draft model, the sibling
+    # lever is BatchedDecoder(decode_steps=k): k tokens per dispatch,
+    # token-identical to k=1.)
     sdec = BatchedDecoder(target, slots=2, capacity=128, pages=8,
                           page_size=64, draft=draft, gamma=3,
                           prefill_chunk=64, temperature=0.8,

@@ -48,7 +48,34 @@ item() {  # item <tag> <timeout_s> <cmd...>
   fi
 }
 
+local_item() {  # local_item <tag> <timeout_s> <cmd...> — NO tunnel probe:
+  # pure host-side post-processing of already-captured artifacts must
+  # not be blocked by (or burn 90 s against) a wedged tunnel
+  local tag="$1" to="$2"; shift 2
+  [ -e "$DONE/$tag" ] && return 0
+  log "START $tag: $*"
+  timeout "$to" "$@" > "$OUT/$tag.log" 2>&1
+  local rc=$?
+  tail -2 "$OUT/$tag.log" | tee -a "$OUT/fill.log"
+  if [ $rc -eq 0 ] && ! grep -qE 'unreachable|"error"' "$OUT/$tag.log"; then
+    touch "$DONE/$tag"
+    log "DONE $tag"
+  else
+    PENDING=$((PENDING + 1))
+    log "FAIL $tag rc=$rc (will retry next pass)"
+  fi
+}
+
 log "=== fill pass begins ==="
+# host-side post-processing first (no probe): op-level attribution
+# tables from any ALREADY-captured xplanes — the verdict artifact for
+# the SE-ResNeXt <20%-MFU question must not wait on the tunnel
+if [ -e "$DONE/dtrace_bert" ]; then
+  local_item dtrace_bert_sum 300 python tools/xplane_summary.py "$OUT/xprof_bert" --json "$OUT/xprof_bert_summary.json"
+fi
+if [ -e "$DONE/dtrace_se" ]; then
+  local_item dtrace_se_sum   300 python tools/xplane_summary.py "$OUT/xprof_se" --json "$OUT/xprof_se_summary.json"
+fi
 # -- tier 0: window-sized complete sweep (VERDICT r4 #1) — ALL 10 models
 # at real shapes / reduced steps, 60 s hard budget each, <= 10 min
 # total, sized to the 8-17-minute windows actually observed. One short
