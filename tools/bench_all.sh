@@ -40,13 +40,16 @@ if [ "$MODE" = "full" ]; then
   run python bench.py --model transformer_nmt --no-fused-ce
   run python bench.py --model resnet50 --layout NCHW
   run python bench.py --model resnet50 --amp float32
-  run python bench.py --model stacked_lstm --batch-size 2048 --scan-unroll 8
+  run python bench.py --model stacked_lstm --batch-size 1024 --scan-unroll 8
   run python bench.py --model se_resnext50 --layout NCHW
   run python bench.py --model deepfm --steps-per-call 8
   run python bench.py --model gpt_decode --gamma 4
   run python bench.py --model gpt_serve
   run python bench.py --model gpt_serve --weight-only
   run python bench.py --model gpt_serve --paged
+  run python bench.py --model gpt_serve --gamma 4
+  run python bench.py --model gpt_serve --decode-steps 8
+  run python bench.py --model gpt_serve --paged --prefill-chunk 64
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py
