@@ -376,3 +376,22 @@ def test_gpt_serve_paged_key():
              "--batch-size", "2", "--paged", timeout=900)
     assert d["metric"] == "gpt_serve_throughput_paged_b2"
     assert d["value"] > 0
+
+
+def test_gpt_serve_new_knob_keys():
+    """The r5 serving knobs fork their own history keys — and
+    --decode-steps 1 is the BASELINE (identical run, no _ds1 fork)."""
+    d = _run("--model", "gpt_serve", "--smoke", "--steps", "50",
+             "--batch-size", "2", "--decode-steps", "4", timeout=900)
+    assert d["metric"] == "gpt_serve_throughput_ds4_b2"
+    assert d["unit"] == "tokens/sec" and d["value"] > 0
+    # minimal steps: this run exists only to pin the NO-FORK key (the
+    # identical-workload property); its throughput number is discarded
+    d1 = _run("--model", "gpt_serve", "--smoke", "--steps", "4",
+              "--batch-size", "2", "--decode-steps", "1", timeout=900)
+    assert d1["metric"] == "gpt_serve_throughput_b2"
+    d2 = _run("--model", "gpt_serve", "--smoke", "--steps", "50",
+              "--batch-size", "2", "--gamma", "2", "--prefill-chunk",
+              "16", timeout=900)
+    assert d2["metric"] == "gpt_serve_throughput_g2_pc16_b2"
+    assert "accept_per_round" in d2
