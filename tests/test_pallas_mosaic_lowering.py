@@ -197,3 +197,71 @@ def test_flash_gqa_lowers_to_mosaic():
             q, k, v, causal=True, block_q=128, block_k=128,
             interpret=False).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
     _export_tpu(bwd, q, k, k)
+
+
+# --- flash-DECODE kernels (serving hot loop) --------------------------------
+# The NMT lesson applied forward: interpret-mode correctness never
+# exercises Mosaic tiling/scalar-prefetch legality, so the decode
+# kernels get the same export gate — contiguous + paged, every block
+# size decode_block_k can produce, per-row cursors, and INSIDE a
+# lax.scan body (the BatchedDecoder decode_steps program shape).
+
+from paddle_tpu.ops.pallas.flash_decode import (  # noqa: E402
+    flash_decode, flash_decode_paged)
+
+# (cap, d, h, kv): GQA serving shape + the small NMT decode cache
+DECODE_SHAPES = [(2048, 64, 12, 4), (256, 64, 8, 8), (512, 128, 16, 8)]
+
+
+@pytest.mark.parametrize("shape", DECODE_SHAPES)
+def test_flash_decode_lowers_to_mosaic(shape):
+    cap, d, h, kv = shape
+    b = 4
+    q = jnp.zeros((b, 1, h, d), jnp.bfloat16)
+    k = jnp.zeros((b, cap, kv, d), jnp.bfloat16)
+    t = jnp.full((b,), cap // 2, jnp.int32)      # per-row cursors
+    for bk in (64, 128, 256):
+        if cap % bk:
+            continue
+        fn = jax.jit(lambda q, k, v, t, _bk=bk: flash_decode(
+            q, k, v, t, block_k=_bk, interpret=False))
+        _export_tpu(fn, q, k, k, t)
+    # windowed variant at the default block
+    fnw = jax.jit(lambda q, k, v, t: flash_decode(
+        q, k, v, t, window=128, interpret=False))
+    _export_tpu(fnw, q, k, k, t)
+
+
+@pytest.mark.parametrize("page_size", [64, 128, 256])
+def test_flash_decode_paged_lowers_to_mosaic(page_size):
+    b, h, kv, d, n_log = 4, 8, 4, 64, 4
+    pages = b * n_log
+    q = jnp.zeros((b, 1, h, d), jnp.bfloat16)
+    pool = jnp.zeros((pages, page_size, kv, d), jnp.bfloat16)
+    table = jnp.arange(b * n_log, dtype=jnp.int32).reshape(b, n_log)
+    t = jnp.full((b,), page_size + 3, jnp.int32)
+    fn = jax.jit(lambda q, kp, vp, tb, t: flash_decode_paged(
+        q, kp, vp, tb, t, interpret=False))
+    _export_tpu(fn, q, pool, pool, table, t)
+
+
+def test_flash_decode_inside_scan_lowers_to_mosaic():
+    """The decode_steps serving program: the scalar-prefetch
+    pallas_call sits INSIDE a lax.scan body whose cursor is a loop
+    carry — the exact program BatchedDecoder(decode_steps=k)
+    compiles."""
+    b, cap, h, kv, d = 4, 256, 8, 4, 64
+    q = jnp.zeros((b, 1, h, d), jnp.bfloat16)
+    k = jnp.zeros((b, cap, kv, d), jnp.bfloat16)
+    t0 = jnp.full((b,), 7, jnp.int32)
+
+    def multi(q, k, v, t0):
+        def body(c, _):
+            t, o = c
+            o = flash_decode(q, k, v, t, interpret=False)
+            return (t + 1, o), None
+
+        (_, o), _ = jax.lax.scan(body, (t0, q), None, length=4)
+        return o
+
+    _export_tpu(jax.jit(multi), q, k, k, t0)
