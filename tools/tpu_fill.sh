@@ -235,8 +235,8 @@ item bench_bert_moe    1500 python bench.py --model bert_moe
 item bench_gpt         1800 python bench.py --model gpt
 # ViT-B/16 (r5 model family): patch-attention vision, MXU-dense
 item bench_vit         1500 python bench.py --model vit
-item tune_a512f        900  python tools/pallas_tune.py --attention 8,512,12,64
-item tune_a512c        900  python tools/pallas_tune.py --attention 8,512,12,64 --causal
+item tune_a512f        1500 python tools/pallas_tune.py --attention 8,512,12,64
+item tune_a512c        1500 python tools/pallas_tune.py --attention 8,512,12,64 --causal
 # flash-decode block sweep + use_flash verdict (r5 kernel): GPT serving
 # cache and the NMT decode cache
 item tune_dec2048      900  python tools/pallas_tune.py --decode 16,2048,12,4,64
