@@ -1480,14 +1480,37 @@ def main():
         jax.devices()
         init_ok.set()
 
+    timeout_s = float(os.environ.get("PT_BENCH_DEVICE_TIMEOUT_S", "420"))
     probe = threading.Thread(target=_probe, daemon=True)
     probe.start()
-    probe.join(timeout=float(os.environ.get("PT_BENCH_DEVICE_TIMEOUT_S",
-                                            "420")))
+    probe.join(timeout=timeout_s)
     if not init_ok.is_set():
-        _emit_error(metric,
-                    "device init timeout (accelerator unreachable)")
-        return
+        # transient tunnel wedges sometimes clear: give the claim one
+        # more timeout window before giving up on the accelerator
+        probe.join(timeout=timeout_s)
+    if not init_ok.is_set():
+        if os.environ.get("PT_BENCH_CPU_FALLBACK"):
+            # already fell back once and CPU init ALSO hung — nothing
+            # left to fall back to; keep the one-JSON-line contract
+            _emit_error(metric,
+                        "device init timeout (accelerator unreachable; "
+                        "cpu fallback also failed)")
+            return
+        # fall back to CPU so the round still produces a real number
+        # (tagged "backend": "cpu_fallback" in the JSON) instead of the
+        # driver-breaking value-0.0 error line. The wedged backend init
+        # may hold jax's init lock in this process, so re-exec with the
+        # platform forced — a clean process is the only reliable way to
+        # re-enter backend selection.
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PT_BENCH_CPU_FALLBACK="1")
+        print("WARNING: device init timed out twice; re-running on cpu "
+              "(backend=cpu_fallback)", file=sys.stderr)
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
     # Persistent compilation cache: amortizes the slow first compile
     # across bench processes (the knob sweep re-lowers near-identical
     # modules) and lets the AOT compile inside lowered_flops' fallback be
@@ -1601,6 +1624,10 @@ def main():
                        history_path=hist_path, smoke=args.smoke,
                        dp=args.dp, config_hash=config_hash,
                        run_config=run_config)
+    if os.environ.get("PT_BENCH_CPU_FALLBACK"):
+        # this run is a device-init-timeout fallback: the number is a
+        # CPU number and must never read as an accelerator record
+        line["backend"] = "cpu_fallback"
     print(json.dumps(line))
 
 

@@ -24,6 +24,7 @@ _LAZY = {
     "models": ".models",
     "metrics": ".metrics",
     "profiler": ".core.profiler",
+    "telemetry": ".telemetry",
     "initializer": ".initializer",
     "regularizer": ".regularizer",
     "clip": ".clip",

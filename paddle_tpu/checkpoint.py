@@ -41,6 +41,7 @@ import os
 import re
 import shutil
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -48,8 +49,30 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import telemetry
 from .core.enforce import enforce
 from .core.mesh import get_mesh
+
+
+@telemetry.cached_instruments
+def _ckpt_metrics(reg):
+    """Checkpoint instrument set (only reached when telemetry is on)."""
+    return {
+        "saves": reg.counter("pt_checkpoint_saves_total",
+                             "checkpoint writes completed"),
+        "save_time": reg.histogram(
+            "pt_checkpoint_save_seconds",
+            "checkpoint write wall time (staging + rename; measured in "
+            "the writer thread for async saves)", unit="s"),
+        "bytes": reg.counter(
+            "pt_checkpoint_bytes_written_total",
+            "payload bytes written by this process", unit="bytes"),
+        "restores": reg.counter("pt_checkpoint_restores_total",
+                                "checkpoint restores completed"),
+        "restore_time": reg.histogram(
+            "pt_checkpoint_restore_seconds",
+            "checkpoint read+reshard wall time", unit="s"),
+    }
 
 _MANIFEST = "manifest.json"
 
@@ -309,6 +332,9 @@ def save_state(directory: str, tree, *, async_save: bool = False,
     multi = jax.process_count() > 1
 
     def write():
+        telem = telemetry.enabled()
+        if telem:
+            t0 = time.perf_counter()
         tmp = directory + ".tmp"
         if rank0:
             if os.path.exists(tmp):
@@ -333,8 +359,19 @@ def save_state(directory: str, tree, *, async_save: bool = False,
             os.replace(tmp, directory)
         if multi:
             _barrier(f"{bprefix}_renamed")  # checkpoint visible to all
+        if telem:
+            m = _ckpt_metrics()
+            m["saves"].inc()
+            m["save_time"].observe(time.perf_counter() - t0)
+            m["bytes"].inc(sum(a.nbytes for _, a in payload))
 
     if async_save:
+        # snapshot to OWNED host copies first: device_get on the cpu
+        # backend can return zero-copy views of live jax buffers, and
+        # the training step the caller overlaps with this write may
+        # DONATE those buffers — np.save in the writer thread would
+        # then read freed memory
+        payload = [(fname, np.array(arr)) for fname, arr in payload]
         return _WriteHandle(write, directory=directory)
     write()
     return None
@@ -353,6 +390,9 @@ def restore_state(directory: str, *, mesh: Optional[Mesh] = None,
     - ``target``: optional pytree; when given, leaf dtypes/shapes are
       validated against it (catching model/checkpoint mismatch early).
     """
+    telem = telemetry.enabled()
+    if telem:
+        t_restore0 = time.perf_counter()
     mpath = os.path.join(directory, _MANIFEST)
     enforce(os.path.exists(mpath), "no checkpoint at %s", directory)
     with open(mpath) as f:
@@ -457,6 +497,10 @@ def restore_state(directory: str, *, mesh: Optional[Mesh] = None,
                 enforce(jnp.dtype(tmap[path].dtype) == jnp.dtype(leaf.dtype),
                         "checkpoint leaf %s dtype %s != target %s", path,
                         leaf.dtype, tmap[path].dtype)
+    if telem:
+        m = _ckpt_metrics()
+        m["restores"].inc()
+        m["restore_time"].observe(time.perf_counter() - t_restore0)
     return tree
 
 

@@ -1,106 +1,28 @@
-"""Tracing / profiling: RecordEvent spans + chrome-trace export + jax.profiler.
+"""Thin shim over ``paddle_tpu.telemetry.trace`` (the span machinery
+moved there; this module keeps the historical import surface).
 
 Parity targets (SURVEY §5.1):
   - RAII ``RecordEvent`` (reference: paddle/fluid/platform/profiler.h:81)
   - python ``fluid.profiler.profiler`` context (reference:
     python/paddle/fluid/profiler.py:222)
-  - ``tools/timeline.py`` chrome://tracing export (reference: tools/timeline.py:131)
+  - ``tools/timeline.py`` chrome://tracing export (reference:
+    tools/timeline.py:131)
 
-Host-side spans are collected in-process and exported directly as chrome-trace
-JSON (no intermediate proto — the proto existed to cross the C++/Python
-boundary, which we don't have). Device-side tracing delegates to
-``jax.profiler`` (XPlane/ TensorBoard), the TPU analog of CUPTI.
+All of it now lives in ``telemetry.trace``, which adds span nesting and
+a structured JSONL export on top; see that module. ``_events``/``_lock``
+are re-exported for the fluid compat layer — the list is mutated in
+place only, so these aliases never go stale.
 """
 
 from __future__ import annotations
 
-import contextlib
-import json
-import os
-import threading
-import time
-from typing import Any, Dict, List, Optional
+from ..telemetry.trace import (RecordEvent, Span, _events, _lock,
+                               export_chrome_trace, export_jsonl,
+                               get_events, profiler, record_event, span,
+                               start_profiler, stop_profiler)
 
-import jax
-
-_lock = threading.Lock()
-_events: List[Dict[str, Any]] = []
-_enabled = False
-
-
-class RecordEvent:
-    """Context-manager span recorder; also annotates device traces via
-    ``jax.profiler.TraceAnnotation`` so spans appear in XPlane timelines."""
-
-    def __init__(self, name: str):
-        self.name = name
-        self._t0 = 0.0
-        self._ann = None
-
-    def __enter__(self):
-        self._t0 = time.perf_counter_ns()
-        if _enabled:
-            self._ann = jax.profiler.TraceAnnotation(self.name)
-            self._ann.__enter__()
-        return self
-
-    def __exit__(self, *exc):
-        t1 = time.perf_counter_ns()
-        if self._ann is not None:
-            self._ann.__exit__(*exc)
-            self._ann = None
-        if _enabled:
-            with _lock:
-                _events.append({
-                    "name": self.name,
-                    "ph": "X",
-                    "ts": self._t0 / 1e3,  # chrome trace wants microseconds
-                    "dur": (t1 - self._t0) / 1e3,
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident() % 100000,
-                })
-        return False
-
-
-def record_event(name: str) -> RecordEvent:
-    return RecordEvent(name)
-
-
-def start_profiler(device_trace_dir: Optional[str] = None) -> None:
-    """Begin collecting host spans; optionally also start a jax device trace."""
-    global _enabled
-    with _lock:
-        _events.clear()
-    _enabled = True
-    if device_trace_dir:
-        jax.profiler.start_trace(device_trace_dir)
-
-
-def stop_profiler(timeline_path: Optional[str] = None,
-                  device_trace: bool = False) -> List[Dict[str, Any]]:
-    """Stop collection; optionally write chrome-trace JSON (timeline.py analog)."""
-    global _enabled
-    _enabled = False
-    if device_trace:
-        jax.profiler.stop_trace()
-    with _lock:
-        events = list(_events)
-    if timeline_path:
-        export_chrome_trace(events, timeline_path)
-    return events
-
-
-def export_chrome_trace(events: List[Dict[str, Any]], path: str) -> None:
-    with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-
-
-@contextlib.contextmanager
-def profiler(timeline_path: Optional[str] = None,
-             device_trace_dir: Optional[str] = None):
-    """``with profiler("/tmp/timeline.json"):`` — fluid.profiler.profiler analog."""
-    start_profiler(device_trace_dir)
-    try:
-        yield
-    finally:
-        stop_profiler(timeline_path, device_trace=device_trace_dir is not None)
+__all__ = [
+    "RecordEvent", "Span", "export_chrome_trace", "export_jsonl",
+    "get_events", "profiler", "record_event", "span", "start_profiler",
+    "stop_profiler",
+]

@@ -8,14 +8,12 @@ import contextlib
 
 from ..core.profiler import (RecordEvent, export_chrome_trace, profiler,
                              record_event, start_profiler, stop_profiler)
-from ..core.profiler import _events as _host_events
-from ..core.profiler import _lock as _host_lock
+from ..telemetry import trace as _trace
 
 
 def reset_profiler():
     """reference: profiler.py reset_profiler — drop collected host events."""
-    with _host_lock:
-        _host_events.clear()
+    _trace.reset()
 
 
 @contextlib.contextmanager

@@ -21,6 +21,8 @@ import os
 import threading
 from typing import Dict, Optional
 
+from ... import telemetry
+
 _TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "tuned_blocks.json")
 _lock = threading.Lock()
@@ -87,7 +89,17 @@ def matmul_key(m: int, n: int, k: int, kind: Optional[str] = None) -> str:
 
 
 def get_tuned(key: str) -> Optional[dict]:
-    return _load().get(key)
+    entry = _load().get(key)
+    if telemetry.enabled():
+        # hit = a kernel launches with chip-measured blocks; miss = it
+        # runs on static defaults (the tuning-coverage signal)
+        telemetry.registry().counter(
+            "pt_tuning_cache_hits_total" if entry is not None
+            else "pt_tuning_cache_misses_total",
+            "pallas tuning-table lookups "
+            + ("served by" if entry is not None else "absent from")
+            + " tuned_blocks.json").inc()
+    return entry
 
 
 def set_tuned(key: str, entry: dict, persist: bool = True) -> None:

@@ -18,12 +18,23 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from ..core import random as prandom
 from ..core.config import BuildStrategy
 from ..core.enforce import enforce
 from ..core.mesh import get_mesh
 from ..nn.layer import Layer
 from ..optimizer.optimizers import Optimizer
+
+
+@telemetry.cached_instruments
+def _trainer_metrics(reg):
+    """Trainer instrument set (only reached when telemetry is on)."""
+    return {
+        "dispatch": reg.histogram(
+            "pt_trainer_dispatch_seconds",
+            "train_step dispatch wall time (unfenced)", unit="s"),
+    }
 
 
 class Trainer:
@@ -173,8 +184,12 @@ class Trainer:
         from ..core.profiler import RecordEvent
 
         # op-level span parity (reference: RecordEvent pushed around every
-        # op run, platform/profiler.h:81) — here one span per compiled step
-        with RecordEvent("train_step"):
+        # op run, platform/profiler.h:81) — here one span per compiled
+        # step, doubling as the dispatch-time histogram when telemetry
+        # is on (async dispatch: the fenced step time is train_loop's)
+        hist = (_trainer_metrics()["dispatch"]
+                if telemetry.enabled() else None)
+        with RecordEvent("train_step", histogram=hist):
             self._rng, sub = jax.random.split(self._rng)
             if self.grad_accum_steps > 1:
                 (loss, metrics, self.params, self.buffers, self.opt_state,

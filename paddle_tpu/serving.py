@@ -17,8 +17,17 @@ Opt-in refinements: paged KV (pages=N, vLLM-style page pool + prefix
 caching), CHUNKED PREFILL (prefill_chunk=C — C prompt tokens per
 serving tick instead of whole-prompt admission stalls), and
 SPECULATIVE DECODING over the arena (draft=model, gamma=g — per-row
-draft steps + ONE per-row verify chunk per round; greedy mode is
-token-identical to the plain arena).
+draft steps + ONE per-row verify chunk per round; greedy mode matches
+the plain arena up to near-tie argmax flips — the verify chunk and the
+step loop reduce in different orders, so a near-tie can break either
+way; ``TestSpeculativeArena`` pins exactly this).
+
+Telemetry (``paddle_tpu.telemetry``, off by default): TTFT and
+per-token decode latency histograms, queue depth / page-pool occupancy
+gauges, admission rejections, speculative accept rate, and recompile
+tracking of the step + per-bucket prefill signatures. All host-side
+scalars recorded outside jit; every hook short-circuits on the enabled
+flag.
 
 Green-field vs the reference (its serving is the one-request-at-a-time
 predictor, /root/reference/paddle/fluid/inference/api/api_impl.cc role;
@@ -28,6 +37,7 @@ capability).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -35,10 +45,53 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import telemetry
 from .core.enforce import enforce
 from .nn.layer import inject_state
 from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
+from .telemetry import recompile as _recompile
+
+
+@telemetry.cached_instruments
+def _serving_metrics(reg):
+    """Serving instrument set, memoized against the registry generation
+    (run() touches this every tick — rebuilding 12 get-or-create
+    lookups per tick is pure waste). Only reached when telemetry is
+    enabled."""
+    return {
+        "requests": reg.counter(
+            "pt_serving_requests_total", "requests submitted"),
+        "completed": reg.counter(
+            "pt_serving_completed_total", "requests completed"),
+        "tokens": reg.counter(
+            "pt_serving_tokens_total", "tokens emitted"),
+        "ttft": reg.histogram(
+            "pt_serving_ttft_seconds",
+            "submit-to-first-token latency (includes queue wait)",
+            unit="s"),
+        "decode_latency": reg.histogram(
+            "pt_serving_decode_latency_seconds",
+            "per-token decode latency (dispatch wall time / tokens "
+            "emitted that dispatch)", unit="s"),
+        "queue_depth": reg.gauge(
+            "pt_serving_queue_depth", "requests waiting for a slot"),
+        "rejections": reg.counter(
+            "pt_serving_admission_rejections_total",
+            "paged admissions deferred on page-pool exhaustion"),
+        "page_occupancy": reg.gauge(
+            "pt_serving_page_occupancy_ratio",
+            "allocated fraction of the KV page pool"),
+        "spec_rounds": reg.counter(
+            "pt_serving_spec_row_rounds_total",
+            "speculative verify rounds (per active row)"),
+        "spec_accepted": reg.counter(
+            "pt_serving_spec_accepted_total",
+            "draft tokens accepted by target verify"),
+        "spec_accept_rate": reg.gauge(
+            "pt_serving_spec_accept_rate",
+            "mean accepted draft tokens per verify round (0..gamma)"),
+    }
 
 
 class PagedKVPool:
@@ -158,6 +211,7 @@ class Request:
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.result: Optional[np.ndarray] = None
+        self.t_submit = 0.0  # stamped at submit when telemetry is on
 
 
 class BatchedDecoder:
@@ -227,7 +281,8 @@ class BatchedDecoder:
         # (_chunk_logits_rows / _chunk_logits_paged_rows) and a
         # modified rejection test accepts a prefix — output tokens are
         # distributed EXACTLY as the target's own sampling chain
-        # (greedy mode is token-identical to the plain arena). The
+        # (greedy mode matches the plain arena up to near-tie argmax
+        # flips; see the module docstring). The
         # draft keeps a contiguous (slots, capacity) cache arena of
         # its own; in paged mode only the TARGET is paged.
         self.draft = draft
@@ -327,6 +382,7 @@ class BatchedDecoder:
         self._prefill_cache: Dict[int, object] = {}
         self._step_fn = None
         self._spec_fn = None
+        self._weights_fp = None  # stamped per run() when telemetry on
         # weights/buffers snapshot, passed to every jitted fn as REAL
         # arguments (inject_state): compiled programs stay weight-free,
         # which remote-compile relays require (HTTP 413 otherwise) and
@@ -372,12 +428,41 @@ class BatchedDecoder:
                     "request needs %s pages but the pool only has %s",
                     need, self._allocator.pages)
         self._next_rid += 1
+        if telemetry.enabled():
+            r.t_submit = time.perf_counter()
+            _serving_metrics()["requests"].inc()
         self.queue.append(r)
         return r.rid
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive until every submitted request completes."""
+        # refresh the weight snapshot: the jitted fns take weights as
+        # REAL arguments, so post-construction mutation of the model
+        # (quant.apply_weight_only_int8, a LoRA merge, a hot-swapped
+        # checkpoint) must be re-snapshotted here or it would be
+        # silently ignored by every step. Unchanged weights rebuild a
+        # dict of the SAME arrays — no retrace, no transfer.
+        self._mstate = (dict(self.model.named_parameters()),
+                        dict(self.model.named_buffers()))
+        if self.draft is not None:
+            self._dstate = (dict(self.draft.named_parameters()),
+                            dict(self.draft.named_buffers()))
+        if telemetry.enabled():
+            # fingerprint the weight pytrees ONCE per run (they only
+            # change here): per-tick records pass the hash as an Opaque
+            # token, so a quant/LoRA swap between runs still registers
+            # as a retrace without re-walking every leaf per dispatch
+            self._weights_fp = _recompile.Opaque(hash(
+                telemetry.fingerprint(
+                    (self._mstate, getattr(self, "_dstate", None)))))
         while self.queue or self._pf_order or self.active.any():
+            if telemetry.enabled():
+                m = _serving_metrics()
+                m["queue_depth"].set(len(self.queue))
+                if self.paged:
+                    al = self._allocator
+                    m["page_occupancy"].set(
+                        (al.pages - al.free_pages) / al.pages)
             self._admit()
             self._prefill_tick()
             self._step()
@@ -657,6 +742,11 @@ class BatchedDecoder:
         self.active[s] = True
         tok = self._pick(logits[None], s, plen)[0]
         self.emitted[s] = [int(tok)]
+        if telemetry.enabled():
+            m = _serving_metrics()
+            if r.t_submit:
+                m["ttft"].observe(time.perf_counter() - r.t_submit)
+            m["tokens"].inc()
         self.budget[s] = r.max_new - 1
         self.tok = self.tok.at[s].set(int(tok))
         self.t = self.t.at[s].set(plen)
@@ -682,6 +772,8 @@ class BatchedDecoder:
             if self.paged:
                 cached = self._try_alloc_paged(s, r)
                 if cached is None:
+                    if telemetry.enabled():
+                        _serving_metrics()["rejections"].inc()
                     self.queue.insert(0, r)
                     break
             self.owner[s] = r
@@ -708,6 +800,13 @@ class BatchedDecoder:
                 self._pf_order.append(s)
                 self.t = self.t.at[s].set(self.capacity)
                 continue
+            if telemetry.enabled():
+                # one compile per prompt bucket: a new padded shape
+                # here IS a new monolithic-prefill executable. Chunked
+                # mode bailed out above — it compiles per CHUNK size,
+                # so recording the bucket there would count compiles
+                # that never happen
+                _recompile.record("serving.prefill", padded)
             if self.paged:
                 row = self.table[s]
                 if cached == 0:
@@ -811,6 +910,15 @@ class BatchedDecoder:
         if self._step_fn is None:
             self._step_fn = self._build_multi_step()
         was_active = self.active.copy()
+        telem = telemetry.enabled()
+        if telem:
+            # the weight token participates: run()'s weight re-snapshot
+            # means a post-construction quant/LoRA swap changes the
+            # weight pytree and genuinely retraces — a fingerprint of
+            # just (tok, t) would never see it
+            _recompile.record("serving.step", self.tok, self.t,
+                              weights=self._weights_fp)
+            t_dispatch = time.perf_counter()
         gens = jnp.asarray(self._slot_gen.astype(np.uint32))
         if self.paged:
             self.pools, toks = self._step_fn(
@@ -820,15 +928,22 @@ class BatchedDecoder:
             self.caches, toks = self._step_fn(
                 self._mstate, self.caches, self.tok, self.t, gens)
         toks = np.asarray(jax.device_get(toks)).astype(np.int32)
+        n_emitted = 0
         for s in range(self.slots):
             if not was_active[s]:
                 continue
             for j in range(self.decode_steps):
                 self.emitted[s].append(int(toks[s, j]))
+                n_emitted += 1
                 self.budget[s] -= 1
                 self._maybe_finish(s)
                 if not self.active[s]:
                     break
+        if telem and n_emitted:
+            m = _serving_metrics()
+            m["tokens"].inc(n_emitted)
+            m["decode_latency"].observe(
+                (time.perf_counter() - t_dispatch) / n_emitted)
         # retired rows keep what _maybe_finish left (paged parking)
         keep = was_active & self.active
         cur_t = np.asarray(self.t)
@@ -966,6 +1081,11 @@ class BatchedDecoder:
         if self._spec_fn is None:
             self._spec_fn = self._build_spec_step()
         was_active = self.active.copy()
+        telem = telemetry.enabled()
+        if telem:
+            _recompile.record("serving.spec_step", self.tok, self.t,
+                              weights=self._weights_fp)
+            t_dispatch = time.perf_counter()
         gens = jnp.asarray(self._slot_gen.astype(np.uint32))
         if self.paged:
             (self.pools, self.caches_d, emitted, n, new_tok,
@@ -987,15 +1107,28 @@ class BatchedDecoder:
         self.spec_rounds += 1
         self.spec_row_rounds += int(was_active.sum())
         self.spec_accepted += int(n_np[was_active].sum())
+        n_emitted = 0
         for s in range(self.slots):
             if not was_active[s]:
                 continue
             for j in range(int(n_np[s]) + 1):
                 self.emitted[s].append(int(emitted[s, j]))
+                n_emitted += 1
                 self.budget[s] -= 1
                 self._maybe_finish(s)
                 if not self.active[s]:
                     break
+        if telem:
+            m = _serving_metrics()
+            m["spec_rounds"].inc(int(was_active.sum()))
+            m["spec_accepted"].inc(int(n_np[was_active].sum()))
+            if self.spec_row_rounds:
+                m["spec_accept_rate"].set(
+                    self.spec_accepted / self.spec_row_rounds)
+            if n_emitted:
+                m["tokens"].inc(n_emitted)
+                m["decode_latency"].observe(
+                    (time.perf_counter() - t_dispatch) / n_emitted)
         # retired rows keep what _maybe_finish left (paged parking);
         # live rows advance by their accepted count + 1
         keep = was_active & self.active
@@ -1021,6 +1154,8 @@ class BatchedDecoder:
         if hit_eos or self.budget[s] <= 0:
             r.result = np.asarray(self.emitted[s], np.int32)
             self.done[r.rid] = r
+            if telemetry.enabled():
+                _serving_metrics()["completed"].inc()
             self.owner[s] = None
             self.active[s] = False
             self.emitted[s] = []

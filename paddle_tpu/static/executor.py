@@ -17,6 +17,7 @@ donation — no host round-trips in the train loop.
 
 from __future__ import annotations
 
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -24,8 +25,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..core.enforce import enforce
 from .program import GRAD_SUFFIX, Program, Var, _GradNode, _OpNode
+
+
+@telemetry.cached_instruments
+def _exec_metrics(reg):
+    """Executor instrument set, memoized against the registry
+    generation (touched every run). Only reached when telemetry is
+    on."""
+    return {
+        "hits": reg.counter(
+            "pt_executor_cache_hits_total",
+            "Executor.run dispatches served by the program cache"),
+        "misses": reg.counter(
+            "pt_executor_cache_misses_total",
+            "Executor.run compiles (new program/feed-signature/fetch "
+            "keys)"),
+        "run_time": reg.histogram(
+            "pt_executor_run_seconds",
+            "Executor.run wall time (prune + dispatch + fetch)",
+            unit="s"),
+    }
 
 
 class Scope:
@@ -219,6 +241,9 @@ class Executor:
         from .program import default_main_program
 
         program = program or default_main_program()
+        telem = telemetry.enabled()
+        if telem:
+            t_run0 = time.perf_counter()
         # accept a fluid.CompiledProgram front (canonical pattern:
         # exe.run(CompiledProgram(prog).with_data_parallel(...), ...))
         program = getattr(program, "program", program)
@@ -274,6 +299,11 @@ class Executor:
                            for k, v in feed_vals.items()))
         key = (id(program), program.version, sig, fetch_names)
         step = self._cache.get(key)
+        if telem:
+            # program-cache telemetry: a miss here is an XLA compile on
+            # the train-loop hot path — THE executor perf signal
+            _exec_metrics()["hits" if step is not None
+                            else "misses"].inc()
         if step is not None:
             self._cache.move_to_end(key)  # LRU touch
         if step is None:
@@ -300,6 +330,11 @@ class Executor:
             self.scope.set(n, v)
         if return_numpy:
             fetched = [np.asarray(v) for v in fetched]
+        if telem:
+            # with return_numpy the conversion above fenced the
+            # dispatch; device-array fetches record dispatch latency
+            _exec_metrics()["run_time"].observe(
+                time.perf_counter() - t_run0)
         return fetched
 
     def close(self):

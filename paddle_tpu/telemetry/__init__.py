@@ -1,0 +1,60 @@
+"""paddle_tpu.telemetry — framework-wide metrics, tracing, and
+instrumentation.
+
+The observability layer the north-star serving system needs (per-request
+latency, throughput, recompile telemetry) and the reference only hinted
+at with its profiler (SURVEY §5.1). Four pieces:
+
+- ``metrics``: process-global :class:`MetricsRegistry` with typed
+  :class:`Counter` / :class:`Gauge` / :class:`Histogram` (fixed
+  log-spaced buckets, lock-free snapshot reads).
+- ``trace``: nestable spans unifying (and superseding)
+  ``core/profiler.py``'s RecordEvent — chrome-trace JSON export
+  preserved, plus a structured JSONL event log.
+- ``recompile``: jitted-call signature fingerprinting — counts trace
+  cache misses per call-site (the #1 silent TPU perf killer).
+- ``export``: Prometheus text format + ``summary()`` human table.
+
+Everything is OFF by default and zero-cost when off: instrumented
+call-sites check :func:`enabled` (one module-global bool) before any
+dict work, and instrumentation only ever records host-side scalars
+outside jit — tracers never reach an instrument.
+
+Usage::
+
+    import paddle_tpu.telemetry as telemetry
+    telemetry.enable()          # or PT_TELEMETRY=1
+    ... serve / train ...
+    print(telemetry.summary())              # human table
+    text = telemetry.prometheus_text()      # /metrics payload
+"""
+
+from __future__ import annotations
+
+from . import export, metrics, recompile, trace
+from .export import prometheus_text, summary
+from .metrics import (Counter, DEFAULT_BUCKETS, Gauge, Histogram,
+                      MetricsRegistry, cached_instruments, disable,
+                      enable, enabled, log_buckets, registry)
+from .recompile import RecompileTracker, fingerprint
+from .trace import (RecordEvent, Span, export_chrome_trace, export_jsonl,
+                    span)
+
+__all__ = [
+    "Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
+    "MetricsRegistry", "RecompileTracker", "RecordEvent", "Span",
+    "cached_instruments",
+    "disable", "enable", "enabled", "export", "export_chrome_trace",
+    "export_jsonl", "fingerprint", "log_buckets", "metrics",
+    "prometheus_text", "recompile", "registry", "reset", "span",
+    "summary", "trace",
+]
+
+
+def reset() -> None:
+    """Full telemetry reset: drop every metric, span, and recompile
+    fingerprint (tests / between benchmark phases). Leaves the enabled
+    flag as-is."""
+    registry().reset()
+    trace.reset()
+    recompile.tracker().reset()

@@ -1,0 +1,105 @@
+"""Exporters: Prometheus text format + human-readable summary table.
+
+``prometheus_text()`` renders the registry in the Prometheus exposition
+format (text/plain; version 0.0.4): HELP/TYPE headers, ``_total``
+counter convention respected as-is (callers name counters with the
+suffix), histograms as cumulative ``_bucket{le=...}`` series plus
+``_sum``/``_count``. Serve it from any HTTP handler or dump it to a
+file for node-exporter's textfile collector.
+
+``summary()`` renders the same registry as an aligned text table with
+count/mean/p50/p99 for histograms — the operator's one-call view after
+a serving or training run.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      registry as _registry)
+
+
+def _fmt_val(v: float) -> str:
+    if not math.isfinite(v):
+        # Prometheus exposition spellings; int(inf/nan) would raise
+        # and take the whole scrape down with it
+        return "NaN" if math.isnan(v) else (
+            "+Inf" if v > 0 else "-Inf")
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_le(b: float) -> str:
+    # Prometheus le labels: shortest repr that round-trips
+    return _fmt_val(b) if b == int(b) else repr(float(b))
+
+
+def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
+    reg = reg or _registry()
+    out = []
+    seen_headers = set()
+    for m in reg.collect():
+        if m.name not in seen_headers:
+            seen_headers.add(m.name)
+            if m.desc:
+                out.append(f"# HELP {m.name} {m.desc}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, (Counter, Gauge)):
+            out.append(f"{m.full_name} {_fmt_val(m.value)}")
+        elif isinstance(m, Histogram):
+            snap = m.snapshot()
+            base_labels = dict(m.labels)
+            acc = 0
+            for bound, c in zip(snap["buckets"], snap["counts"]):
+                acc += c
+                lbl = dict(base_labels, le=_fmt_le(bound))
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(lbl.items()))
+                out.append(f"{m.name}_bucket{{{inner}}} {acc}")
+            inner = ",".join(f'{k}="{v}"' for k, v in sorted(
+                dict(base_labels, le="+Inf").items()))
+            out.append(f"{m.name}_bucket{{{inner}}} {snap['count']}")
+            suffix = ("{" + ",".join(
+                f'{k}="{v}"' for k, v in sorted(base_labels.items()))
+                + "}") if base_labels else ""
+            out.append(f"{m.name}_sum{suffix} {repr(snap['sum'])}")
+            out.append(f"{m.name}_count{suffix} {snap['count']}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def summary(reg: Optional[MetricsRegistry] = None) -> str:
+    """Aligned human table of every instrument with data."""
+    reg = reg or _registry()
+    rows = []
+    for m in reg.collect():
+        if isinstance(m, Counter):
+            rows.append((m.full_name, "counter", _fmt_val(m.value),
+                         "", "", "", m.unit))
+        elif isinstance(m, Gauge):
+            rows.append((m.full_name, "gauge", f"{m.value:.6g}",
+                         "", "", "", m.unit))
+        elif isinstance(m, Histogram):
+            if not m.count:
+                rows.append((m.full_name, "histogram", "0",
+                             "", "", "", m.unit))
+                continue
+            rows.append((m.full_name, "histogram", str(m.count),
+                         f"{m.mean:.6g}", f"{m.percentile(0.5):.6g}",
+                         f"{m.percentile(0.99):.6g}", m.unit))
+    if not rows:
+        return ""
+    header = ("metric", "type", "count/value", "mean", "p50", "p99",
+              "unit")
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip(),
+        "  ".join("-" * w for w in widths),
+    ]
+    for r in rows:
+        lines.append("  ".join(
+            c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(lines) + "\n"

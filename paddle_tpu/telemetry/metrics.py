@@ -1,0 +1,334 @@
+"""Typed metric instruments + the process-global registry.
+
+The counters/histograms layer of ``paddle_tpu.telemetry`` (SURVEY §5.1
+gives the reference only a span profiler; production serving needs
+Prometheus-style counters — TTFT/TPOT/throughput are how the
+Gemma-on-TPU serving study, arXiv:2605.25645, evaluates a server).
+
+Design constraints, in order:
+
+- ZERO cost when disabled: every instrumented call-site checks
+  ``metrics.enabled()`` (a module-global bool behind a trivial function)
+  before touching any instrument or building any dict. Nothing in this
+  module imports jax; instruments only ever see host-side Python
+  scalars — never tracers (instrumentation lives OUTSIDE jit by
+  contract).
+- Lock-free reads: ``snapshot()``/``value`` copy without taking a lock,
+  so a scrape never stalls the serving loop (a concurrent scrape may
+  tear across fields — fine for monitoring). Mutations take a tiny
+  per-instrument lock (``+=`` is NOT atomic in CPython — a thread
+  switch between load and store would lose increments, e.g. two
+  overlapping async checkpoint writers). The registry dict itself is
+  guarded by a lock only on CREATE (get-or-create races at startup).
+- Fixed log-spaced histogram buckets: one static bucket ladder spanning
+  1µs..10ks covers every latency this framework records, so histograms
+  never allocate after construction and merge trivially across
+  snapshots.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import os
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# global enable flag — THE check every instrumented call-site performs first
+# ---------------------------------------------------------------------------
+
+_enabled = os.environ.get("PT_TELEMETRY", "").lower() in ("1", "true", "on")
+
+
+def enable() -> None:
+    """Turn instrumentation on process-wide (default off; also via
+    ``PT_TELEMETRY=1``)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+def log_buckets(lo: float = 1e-6, hi: float = 1e4,
+                per_decade: int = 3) -> Tuple[float, ...]:
+    """Fixed log-spaced bucket upper bounds covering [lo, hi]."""
+    n = int(round(math.log10(hi / lo) * per_decade))
+    return tuple(lo * 10.0 ** (i / per_decade) for i in range(n + 1))
+
+
+# 1µs .. 10000s at 3 buckets/decade — 31 bounds, enough resolution for
+# p50/p99 on anything from a cache lookup to a full-suite checkpoint
+DEFAULT_BUCKETS = log_buckets()
+
+
+def _label_str(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, desc: str = "", unit: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.desc = desc
+        self.unit = unit
+        self.labels = dict(labels or {})
+        self._mu = threading.Lock()  # mutations only; reads stay free
+
+    @property
+    def full_name(self) -> str:
+        return self.name + _label_str(self.labels)
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count (requests, tokens, cache misses)."""
+
+    kind = "counter"
+
+    def __init__(self, name, desc="", unit="", labels=None):
+        super().__init__(name, desc, unit, labels)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease (inc {amount})")
+        with self._mu:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"kind": "counter", "value": self._value,
+                "unit": self.unit}
+
+
+class Gauge(_Instrument):
+    """Point-in-time value (queue depth, pool occupancy, loss scale)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, desc="", unit="", labels=None):
+        super().__init__(name, desc, unit, labels)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._mu:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._mu:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"kind": "gauge", "value": self._value, "unit": self.unit}
+
+
+class Histogram(_Instrument):
+    """Distribution over fixed log-spaced buckets.
+
+    ``_counts[i]`` counts observations <= ``buckets[i]``
+    (non-cumulative per bucket; the Prometheus exporter cumulates);
+    ``_counts[-1]`` is the +Inf overflow bucket.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, desc="", unit="", labels=None,
+                 buckets: Optional[Iterable[float]] = None):
+        super().__init__(name, desc, unit, labels)
+        bs = tuple(sorted(buckets)) if buckets is not None \
+            else DEFAULT_BUCKETS
+        if not bs:
+            raise ValueError(f"histogram {name} needs >= 1 bucket")
+        self.buckets: Tuple[float, ...] = bs
+        self._counts: List[int] = [0] * (len(bs) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._mu:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Approximate quantile by linear interpolation inside the
+        owning bucket (exact min/max at q=0/1; 0.0 when empty)."""
+        if not self._count:
+            return 0.0
+        if q <= 0:
+            return self._min
+        if q >= 1:
+            return self._max
+        target = q * self._count
+        acc = 0
+        for i, c in enumerate(self._counts):
+            if not c:
+                continue
+            if acc + c >= target:
+                lo = self.buckets[i - 1] if i >= 1 else min(
+                    self._min, self.buckets[0])
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else max(self._max, self.buckets[-1]))
+                frac = (target - acc) / c
+                return lo + (hi - lo) * frac
+            acc += c
+        return self._max
+
+    def snapshot(self) -> dict:
+        counts = list(self._counts)  # copy-then-read: scrape-safe
+        return {"kind": "histogram", "unit": self.unit,
+                "count": self._count, "sum": self._sum,
+                "min": self._min if self._count else 0.0,
+                "max": self._max if self._count else 0.0,
+                "buckets": self.buckets, "counts": counts}
+
+
+class MetricsRegistry:
+    """Process-global name→instrument store with get-or-create access.
+
+    Keys are (name, sorted label items); get-or-create with a mismatched
+    kind is a loud error (two subsystems silently sharing one name would
+    corrupt both)."""
+
+    def __init__(self):
+        self._metrics: Dict[Tuple, _Instrument] = {}
+        self._lock = threading.Lock()
+        self._generation = 0
+
+    def _get_or_create(self, cls, name, desc, unit, labels, **kw):
+        key = (name, tuple(sorted((labels or {}).items())))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, desc, unit, labels, **kw)
+                    self._metrics[key] = m
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name} already registered as {m.kind}, "
+                f"requested {cls.kind}")
+        return m
+
+    def counter(self, name: str, desc: str = "", unit: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get_or_create(Counter, name, desc, unit, labels)
+
+    def gauge(self, name: str, desc: str = "", unit: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, desc, unit, labels)
+
+    def histogram(self, name: str, desc: str = "", unit: str = "",
+                  labels: Optional[Dict[str, str]] = None,
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        h = self._get_or_create(Histogram, name, desc, unit, labels,
+                                buckets=buckets)
+        if buckets is not None and tuple(sorted(buckets)) != h.buckets:
+            # same silent-sharing hazard the kind check guards against:
+            # observations would land on the first creator's ladder
+            raise ValueError(
+                f"histogram {name} already registered with buckets "
+                f"{h.buckets}, requested {tuple(sorted(buckets))}")
+        return h
+
+    def get(self, name: str,
+            labels: Optional[Dict[str, str]] = None):
+        return self._metrics.get(
+            (name, tuple(sorted((labels or {}).items()))))
+
+    def collect(self) -> List[_Instrument]:
+        """Stable-ordered instrument list (name, then labels)."""
+        return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Plain-dict view keyed by full name — lock-free (instrument
+        snapshots copy their own state)."""
+        return {m.full_name: m.snapshot() for m in self.collect()}
+
+    @property
+    def generation(self) -> int:
+        """Bumped by :meth:`reset` — lets call-sites memoize their
+        instrument dicts and invalidate when the registry is wiped."""
+        return self._generation
+
+    def reset(self) -> None:
+        """Drop every instrument (tests; a fresh serving process starts
+        clean anyway)."""
+        with self._lock:
+            self._metrics.clear()
+            self._generation += 1
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+def cached_instruments(build):
+    """Decorator memoizing a per-module instrument-dict factory against
+    the registry generation: ``build(reg)`` runs once, then every call
+    returns the same dict until :meth:`MetricsRegistry.reset` bumps the
+    generation (tests / process-level wipes). Keeps hot-path
+    instrumentation to one flag check + one dict return instead of N
+    get-or-create lookups per tick."""
+    cache = {"gen": -1, "val": None}
+
+    def get():
+        reg = registry()
+        if cache["val"] is None or cache["gen"] != reg.generation:
+            cache["val"] = build(reg)
+            cache["gen"] = reg.generation
+        return cache["val"]
+
+    get.__name__ = build.__name__
+    get.__doc__ = build.__doc__
+    return get

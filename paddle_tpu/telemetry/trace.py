@@ -1,0 +1,209 @@
+"""Nestable span tracing — supersedes ``core/profiler.py``'s RecordEvent.
+
+One span machinery for the whole framework: RAII/context-manager spans
+(reference: paddle/fluid/platform/profiler.h:81 RecordEvent) collected
+host-side with monotonic timestamps and a thread-local nesting stack,
+exported as
+
+- chrome-trace JSON (``export_chrome_trace`` — the historical
+  tools/timeline.py contract, preserved verbatim), and
+- a structured JSONL event log (``export_jsonl`` — one JSON object per
+  line with monotonic ns timestamps, name, duration, pid/tid, nesting
+  depth and parent span; greppable/streamable where chrome-trace is
+  load-the-whole-file).
+
+Device-side tracing still delegates to ``jax.profiler`` (XPlane /
+TensorBoard — the TPU analog of CUPTI); jax is imported lazily so the
+telemetry package stays import-light.
+
+``core/profiler.py`` and ``fluid/profiler.py`` are thin shims over this
+module. Compat invariant: ``_events`` is only ever mutated IN PLACE
+(never rebound) — the shims import the list object itself.
+
+Span durations optionally feed a metrics histogram: pass
+``histogram=`` (a ``metrics.Histogram``) and the span observes its own
+duration when telemetry is enabled — one timer, both sinks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []   # in-place mutation only (shim compat)
+_enabled = False
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+class Span:
+    """Context-manager span; nests via a thread-local stack and also
+    annotates device traces (``jax.profiler.TraceAnnotation``) so spans
+    appear in XPlane timelines when a device trace is running."""
+
+    __slots__ = ("name", "cat", "histogram", "_t0", "_ann", "_depth",
+                 "_parent", "_pushed")
+
+    def __init__(self, name: str, cat: str = "host", histogram=None):
+        self.name = name
+        self.cat = cat
+        self.histogram = histogram
+        self._t0 = 0.0
+        self._ann = None
+        self._depth = 0
+        self._parent = None
+        self._pushed = False
+
+    def __enter__(self):
+        if _enabled:
+            stack = _stack()
+            self._depth = len(stack)
+            self._parent = stack[-1].name if stack else None
+            stack.append(self)
+            self._pushed = True
+            import jax  # lazy: only on an enabled trace path
+
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        if self._pushed:
+            # pop by identity, and even when collection was stopped
+            # mid-span — an `if _enabled` guard here would leak the
+            # stack entry and corrupt depth/parent for this thread in
+            # every later profiler window
+            self._pushed = False
+            stack = _stack()
+            if stack and stack[-1] is self:
+                stack.pop()
+            elif self in stack:
+                stack.remove(self)
+        if _enabled:
+            with _lock:
+                _events.append({
+                    "name": self.name,
+                    "cat": self.cat,
+                    "ph": "X",
+                    "ts": self._t0 / 1e3,  # chrome trace wants µs
+                    "dur": (t1 - self._t0) / 1e3,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100000,
+                    "args": {"depth": self._depth,
+                             "parent": self._parent},
+                })
+        if self.histogram is not None and _metrics.enabled():
+            self.histogram.observe((t1 - self._t0) / 1e9)
+        return False
+
+
+# historical names, kept as the same objects (API.spec / shim compat)
+RecordEvent = Span
+
+
+def record_event(name: str) -> Span:
+    return Span(name)
+
+
+def span(name: str, cat: str = "host", histogram=None) -> Span:
+    return Span(name, cat, histogram)
+
+
+def tracing() -> bool:
+    return _enabled
+
+
+def start_profiler(device_trace_dir: Optional[str] = None) -> None:
+    """Begin collecting host spans; optionally also start a jax device
+    trace."""
+    global _enabled
+    with _lock:
+        _events.clear()
+    _enabled = True
+    if device_trace_dir:
+        import jax
+
+        jax.profiler.start_trace(device_trace_dir)
+
+
+def stop_profiler(timeline_path: Optional[str] = None,
+                  device_trace: bool = False) -> List[Dict[str, Any]]:
+    """Stop collection; optionally write chrome-trace JSON
+    (tools/timeline.py analog)."""
+    global _enabled
+    _enabled = False
+    if device_trace:
+        import jax
+
+        jax.profiler.stop_trace()
+    with _lock:
+        events = list(_events)
+    if timeline_path:
+        export_chrome_trace(events, timeline_path)
+    return events
+
+
+def get_events() -> List[Dict[str, Any]]:
+    """Copy of the collected span list (running or stopped)."""
+    with _lock:
+        return list(_events)
+
+
+def reset() -> None:
+    """Drop collected spans without toggling collection."""
+    with _lock:
+        _events.clear()
+
+
+def export_chrome_trace(events: List[Dict[str, Any]], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+def export_jsonl(events: List[Dict[str, Any]], path: str) -> None:
+    """Structured event log: one JSON object per line, monotonic ns
+    timestamps (``ts_ns``/``dur_ns``), nesting depth + parent."""
+    with open(path, "w") as f:
+        for e in events:
+            args = e.get("args", {})
+            f.write(json.dumps({
+                "name": e["name"],
+                "cat": e.get("cat", "host"),
+                "ts_ns": int(e["ts"] * 1e3),
+                "dur_ns": int(e["dur"] * 1e3),
+                "pid": e["pid"],
+                "tid": e["tid"],
+                "depth": args.get("depth", 0),
+                "parent": args.get("parent"),
+            }) + "\n")
+
+
+@contextlib.contextmanager
+def profiler(timeline_path: Optional[str] = None,
+             device_trace_dir: Optional[str] = None):
+    """``with profiler("/tmp/timeline.json"):`` — fluid.profiler.profiler
+    analog."""
+    start_profiler(device_trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(timeline_path,
+                      device_trace=device_trace_dir is not None)

@@ -18,9 +18,52 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 import numpy as np
 
+from . import telemetry
 from .checkpoint import CheckpointManager
 from .core.config import FLAGS
 from .core.enforce import EnforceError, enforce
+from .telemetry import recompile as _recompile
+
+
+@telemetry.cached_instruments
+def _train_metrics(reg):
+    """Training instrument set, memoized against the registry
+    generation (touched every step). Only reached when telemetry is
+    enabled."""
+    return {
+        "steps": reg.counter("pt_train_steps_total",
+                             "optimizer steps completed"),
+        "step_time": reg.histogram(
+            "pt_train_step_seconds",
+            "wall time per training step (dispatch + loss fence)",
+            unit="s"),
+        "examples_per_sec": reg.gauge(
+            "pt_train_examples_per_sec",
+            "throughput over the last step (batch size / step time)"),
+        "nan_skips": reg.counter(
+            "pt_train_nan_skips_total",
+            "steps dropped by the nan/inf guard"),
+        "loss_scale": reg.gauge(
+            "pt_train_loss_scale", "current dynamic loss scale"),
+        "loss_scale_events": reg.counter(
+            "pt_train_loss_scale_events_total",
+            "dynamic loss-scale growth/backoff events"),
+    }
+
+
+def _batch_size(batch) -> int:
+    """Leading dim of the first array leaf (0 when undeterminable)."""
+    if isinstance(batch, dict):
+        vals = [batch[k] for k in sorted(batch)]
+    elif isinstance(batch, (list, tuple)):
+        vals = list(batch)
+    else:
+        vals = [batch]
+    for v in vals:
+        shape = getattr(v, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
 
 
 class NanInfError(EnforceError):
@@ -113,6 +156,7 @@ class TrainLoop:
         self.recoverable = tuple(recoverable)
         self._recoveries_this_run = 0
         self._faulted = False
+        self._last_loss_scale: Optional[float] = None
         self.history: Dict[str, Any] = {"resumed_from": None,
                                         "skipped_steps": [],
                                         "recoveries": []}
@@ -142,6 +186,8 @@ class TrainLoop:
         if self.nan_policy == "raise":
             raise NanInfError(
                 f"non-finite loss at step {self.step}: {loss}")
+        if telemetry.enabled():
+            _train_metrics()["nan_skips"].inc()
         self.history["skipped_steps"].append(self.step)
         latest = self.manager.latest_step()
         if latest is not None:
@@ -167,6 +213,13 @@ class TrainLoop:
             for batch in batches:
                 if num_steps is not None and self.step >= num_steps:
                     break
+                telem = telemetry.enabled()
+                if telem:
+                    # one abstract-signature record per step: a batch
+                    # whose shapes/dtypes drift retraces the jitted
+                    # step, and this is where it becomes visible
+                    _recompile.record("train_loop.step", batch)
+                    t0 = time.perf_counter()
                 try:
                     loss, metrics = self.trainer.train_step(batch)
                 except Exception as e:
@@ -201,6 +254,32 @@ class TrainLoop:
                 if not self._guard(loss):
                     continue
                 self.step += 1
+                if telem:
+                    # _guard's np.isfinite fetch already fenced the
+                    # dispatch except under nan_policy='off'; fence
+                    # explicitly so the histogram never records an
+                    # async-dispatch mirage
+                    np.asarray(loss)
+                    dt = time.perf_counter() - t0
+                    tmet = _train_metrics()
+                    tmet["steps"].inc()
+                    tmet["step_time"].observe(dt)
+                    bs = _batch_size(batch)
+                    if bs and dt > 0:
+                        tmet["examples_per_sec"].set(bs / dt)
+                    opt = getattr(self.trainer, "optimizer", None)
+                    if opt is not None and hasattr(opt, "current_scale"):
+                        try:
+                            scale = float(np.asarray(opt.current_scale(
+                                self.trainer.opt_state)))
+                        except Exception:
+                            scale = None
+                        if scale is not None:
+                            tmet["loss_scale"].set(scale)
+                            if (self._last_loss_scale is not None
+                                    and scale != self._last_loss_scale):
+                                tmet["loss_scale_events"].inc()
+                            self._last_loss_scale = scale
                 if self._watchdog:
                     self._watchdog.beat()
                 if on_step is not None:

@@ -23,7 +23,12 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer JAX spells the device count as a config option; older builds
+    # only honor the XLA_FLAGS env var set above (before first device use)
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,499 @@
+"""paddle_tpu.telemetry: counter/gauge/histogram math, span nesting +
+chrome-trace round-trip, recompile-tracker retrace detection, Prometheus
+export format, and the serving/training integration smoke tests the
+ISSUE acceptance criteria pin (TTFT/decode-latency histograms populated
+after a BatchedDecoder run; step-time/examples-per-sec after a train
+loop; recompile counter flat across same-shape steps and incrementing
+on a changed batch shape; disabled = nothing recorded)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.telemetry as telemetry
+from paddle_tpu.telemetry import metrics as tmetrics
+from paddle_tpu.telemetry import recompile as trecompile
+from paddle_tpu.telemetry import trace as ttrace
+
+RNG = np.random.default_rng(71)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts disabled and empty, and leaves no state for
+    the rest of the suite."""
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# instrument math
+# ---------------------------------------------------------------------------
+
+class TestInstruments:
+    def test_counter_math_and_monotonicity(self):
+        c = telemetry.registry().counter("pt_t_total", "d", unit="1")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1)
+        # get-or-create returns the SAME instrument
+        assert telemetry.registry().counter("pt_t_total") is c
+
+    def test_gauge_set_inc_dec(self):
+        g = telemetry.registry().gauge("pt_t_depth")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3.0
+
+    def test_histogram_buckets_and_percentiles(self):
+        h = telemetry.registry().histogram(
+            "pt_t_lat", buckets=(0.001, 0.01, 0.1, 1.0))
+        for v in (0.0005, 0.005, 0.005, 0.05, 2.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["count"] == 5
+        assert snap["counts"] == [1, 2, 1, 0, 1]  # last = +Inf overflow
+        assert snap["min"] == 0.0005 and snap["max"] == 2.0
+        assert h.mean == pytest.approx(sum((0.0005, 0.005, 0.005,
+                                            0.05, 2.0)) / 5)
+        # p50 falls in the (0.001, 0.01] bucket; p0/p1 are exact
+        assert 0.001 <= h.percentile(0.5) <= 0.01
+        assert h.percentile(0.0) == 0.0005
+        assert h.percentile(1.0) == 2.0
+
+    def test_log_buckets_are_log_spaced(self):
+        bs = tmetrics.log_buckets(1e-3, 1e0, per_decade=1)
+        assert bs == pytest.approx((1e-3, 1e-2, 1e-1, 1e0))
+
+    def test_kind_collision_is_loud(self):
+        telemetry.registry().counter("pt_t_x")
+        with pytest.raises(TypeError, match="already registered"):
+            telemetry.registry().gauge("pt_t_x")
+
+    def test_bucket_collision_is_loud(self):
+        telemetry.registry().histogram("pt_t_b", buckets=(0.1, 1.0))
+        telemetry.registry().histogram("pt_t_b")  # no buckets: ok
+        with pytest.raises(ValueError, match="buckets"):
+            telemetry.registry().histogram("pt_t_b", buckets=(10.0,))
+
+    def test_labels_fork_instruments(self):
+        a = telemetry.registry().counter("pt_t_l", labels={"site": "a"})
+        b = telemetry.registry().counter("pt_t_l", labels={"site": "b"})
+        a.inc()
+        assert b.value == 0
+        snap = telemetry.registry().snapshot()
+        assert snap['pt_t_l{site="a"}']["value"] == 1
+
+    def test_reset_bumps_generation(self):
+        """Call-sites memoize their instrument dicts against this —
+        a reset that didn't bump it would leave them incrementing
+        orphaned instruments."""
+        reg = telemetry.registry()
+        g = reg.generation
+        c = reg.counter("pt_t_gen")
+        telemetry.reset()
+        assert reg.generation == g + 1
+        assert reg.counter("pt_t_gen") is not c
+
+    def test_snapshot_is_plain_data(self):
+        telemetry.registry().counter("pt_t_c").inc(2)
+        snap = telemetry.registry().snapshot()
+        json.dumps({k: dict(v, buckets=None, counts=None)
+                    if v["kind"] == "histogram" else v
+                    for k, v in snap.items()})  # serializable
+        assert snap["pt_t_c"] == {"kind": "counter", "value": 2.0,
+                                  "unit": ""}
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class TestTrace:
+    def test_span_nesting_and_chrome_trace_roundtrip(self, tmp_path):
+        path = str(tmp_path / "timeline.json")
+        ttrace.start_profiler()
+        with ttrace.span("outer"):
+            with ttrace.span("inner"):
+                pass
+        with ttrace.span("flat"):
+            pass
+        events = ttrace.stop_profiler(timeline_path=path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert [e["name"] for e in doc["traceEvents"]] == [
+            e["name"] for e in events]
+        by_name = {e["name"]: e for e in events}
+        assert by_name["inner"]["args"]["depth"] == 1
+        assert by_name["inner"]["args"]["parent"] == "outer"
+        assert by_name["outer"]["args"]["depth"] == 0
+        assert by_name["outer"]["args"]["parent"] is None
+        for e in events:  # chrome-trace complete events, µs timestamps
+            assert e["ph"] == "X" and e["dur"] >= 0
+
+    def test_jsonl_export(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        ttrace.start_profiler()
+        with ttrace.span("a"):
+            pass
+        events = ttrace.stop_profiler()
+        ttrace.export_jsonl(events, path)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert len(lines) == 1
+        rec = lines[0]
+        assert rec["name"] == "a" and rec["depth"] == 0
+        assert rec["dur_ns"] >= 0 and rec["ts_ns"] > 0
+
+    def test_record_event_compat_shim(self):
+        """core.profiler and fluid.profiler keep working as shims."""
+        import importlib
+
+        import paddle_tpu.fluid as fluid
+
+        # NB: attribute access on the package returns the exported
+        # `profiler` context-manager FUNCTION (it shadows the module)
+        core_prof = importlib.import_module("paddle_tpu.core.profiler")
+
+        core_prof.start_profiler()
+        with core_prof.RecordEvent("step"):
+            pass
+        with fluid.profiler.RecordEvent("span"):
+            pass
+        fluid.profiler.reset_profiler()
+        assert core_prof.stop_profiler() == []
+
+    def test_stop_mid_span_does_not_corrupt_nesting(self):
+        """A span still open when stop_profiler runs must pop its stack
+        entry on exit — otherwise every later window on this thread
+        reports bogus depth/parent."""
+        ttrace.start_profiler()
+        outer = ttrace.span("outer")
+        outer.__enter__()
+        ttrace.stop_profiler()
+        outer.__exit__(None, None, None)
+        ttrace.start_profiler()
+        with ttrace.span("later"):
+            pass
+        (e,) = ttrace.stop_profiler()
+        assert e["args"]["depth"] == 0
+        assert e["args"]["parent"] is None
+
+    def test_span_feeds_histogram_when_enabled(self):
+        telemetry.enable()
+        h = telemetry.registry().histogram("pt_t_span_s", unit="s")
+        with ttrace.span("timed", histogram=h):
+            pass
+        assert h.count == 1
+        telemetry.disable()
+        with ttrace.span("timed", histogram=h):
+            pass
+        assert h.count == 1  # disabled: no observation
+
+
+# ---------------------------------------------------------------------------
+# recompile tracker
+# ---------------------------------------------------------------------------
+
+class TestRecompile:
+    def test_fingerprint_abstracts_values(self):
+        fp = telemetry.fingerprint
+        a = fp({"x": np.zeros((4, 8), np.float32)})
+        b = fp({"x": np.ones((4, 8), np.float32)})
+        c = fp({"x": np.zeros((8, 8), np.float32)})
+        d = fp({"x": np.zeros((4, 8), np.int32)})
+        assert a == b          # values never participate
+        assert a != c and a != d
+
+    def test_opaque_token_participates_by_value(self):
+        """Opaque wraps a pre-computed fingerprint hash so hot paths
+        (serving ticks) pass O(1) weight tokens instead of re-walking
+        the pytree — and unlike plain scalars, its VALUE forks the
+        signature."""
+        fp = telemetry.fingerprint
+        assert fp(trecompile.Opaque(1)) != fp(trecompile.Opaque(2))
+        assert fp(1) == fp(2)  # plain scalars: type only
+        tr = trecompile.RecompileTracker()
+        tr.record("s", np.zeros((2,)), weights=trecompile.Opaque(11))
+        tr.record("s", np.zeros((2,)), weights=trecompile.Opaque(11))
+        tr.record("s", np.zeros((2,)), weights=trecompile.Opaque(22))
+        assert tr.stats()["s"] == {"signatures": 2, "calls": 3,
+                                   "recompiles": 1}
+
+    def test_detects_forced_retrace(self):
+        """A jitted fn re-dispatched with a new shape retraces; the
+        tracker sees exactly that signature change."""
+        tr = trecompile.RecompileTracker()
+        traces = []
+
+        @jax.jit
+        def f(x):
+            traces.append(1)  # python body runs once per trace
+            return x * 2
+
+        for arr in (jnp.zeros((4,)), jnp.zeros((4,)), jnp.zeros((8,))):
+            tr.record("f", arr)
+            f(arr).block_until_ready()
+        assert len(traces) == 2  # the ground truth: one forced retrace
+        st = tr.stats()["f"]
+        assert st == {"signatures": 2, "calls": 3, "recompiles": 1}
+
+    def test_global_counters(self):
+        trecompile.record("site_a", np.zeros((2,)))
+        trecompile.record("site_a", np.zeros((3,)))
+        reg = telemetry.registry()
+        assert reg.get("pt_jit_compiles_total",
+                       {"site": "site_a"}).value == 2
+        assert reg.get("pt_jit_recompiles_total",
+                       {"site": "site_a"}).value == 1
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+class TestExport:
+    def test_prometheus_text_format(self):
+        reg = telemetry.registry()
+        reg.counter("pt_t_req_total", "requests", unit="1").inc(3)
+        reg.gauge("pt_t_depth").set(2)
+        h = reg.histogram("pt_t_lat_seconds", "latency", unit="s",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = telemetry.prometheus_text()
+        lines = text.strip().splitlines()
+        assert "# TYPE pt_t_req_total counter" in lines
+        assert "# HELP pt_t_req_total requests" in lines
+        assert "pt_t_req_total 3" in lines
+        assert "# TYPE pt_t_depth gauge" in lines
+        assert "pt_t_depth 2" in lines
+        # histogram: cumulative buckets + +Inf + sum/count
+        assert 'pt_t_lat_seconds_bucket{le="0.1"} 1' in lines
+        assert 'pt_t_lat_seconds_bucket{le="1"} 1' in lines
+        assert 'pt_t_lat_seconds_bucket{le="+Inf"} 2' in lines
+        assert "pt_t_lat_seconds_count 2" in lines
+        assert any(ln.startswith("pt_t_lat_seconds_sum ")
+                   for ln in lines)
+
+    def test_summary_table(self):
+        telemetry.registry().counter("pt_t_c", "c").inc(7)
+        h = telemetry.registry().histogram("pt_t_h", unit="s")
+        h.observe(0.5)
+        out = telemetry.summary()
+        assert "pt_t_c" in out and "7" in out
+        assert "pt_t_h" in out and "p99" in out
+
+    def test_empty_registry_renders_empty(self):
+        assert telemetry.summary() == ""
+        assert telemetry.prometheus_text() == ""
+
+    def test_non_finite_values_render_not_raise(self):
+        telemetry.registry().gauge("pt_t_inf").set(float("inf"))
+        telemetry.registry().gauge("pt_t_nan").set(float("nan"))
+        text = telemetry.prometheus_text()
+        assert "pt_t_inf +Inf" in text
+        assert "pt_t_nan NaN" in text
+        assert "pt_t_inf" in telemetry.summary()
+
+
+# ---------------------------------------------------------------------------
+# serving integration (acceptance: TTFT/decode-latency/accept-rate
+# populated after a BatchedDecoder run; disabled = zero recorded state)
+# ---------------------------------------------------------------------------
+
+def _gpt(seed=0):
+    from paddle_tpu.models import gpt as G
+
+    pt.seed(seed)
+    return G.GPTForCausalLM(G.GPTConfig.tiny()).eval()
+
+
+def _prompt(n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 512, (n,)).astype(np.int32)
+
+
+class TestServingIntegration:
+    def test_batched_decoder_populates_metrics(self):
+        from paddle_tpu.serving import BatchedDecoder
+
+        telemetry.enable()
+        m = _gpt(0)
+        dec = BatchedDecoder(m, slots=2, capacity=64)
+        rids = [dec.submit(_prompt(5, 80), 4),
+                dec.submit(_prompt(9, 81), 6)]
+        outs = dec.run()
+        assert sorted(outs) == sorted(rids)
+        reg = telemetry.registry()
+        ttft = reg.get("pt_serving_ttft_seconds")
+        lat = reg.get("pt_serving_decode_latency_seconds")
+        assert ttft is not None and ttft.count == 2
+        assert ttft.percentile(0.5) > 0
+        assert lat is not None and lat.count >= 1
+        assert reg.get("pt_serving_requests_total").value == 2
+        assert reg.get("pt_serving_completed_total").value == 2
+        assert reg.get("pt_serving_tokens_total").value == 10
+        # the jitted arena step compiled once and never retraced
+        st = trecompile.tracker().stats()["serving.step"]
+        assert st["recompiles"] == 0 and st["calls"] >= 1
+        # acceptance: a non-empty summary carrying the serving rows
+        out = telemetry.summary()
+        assert "pt_serving_ttft_seconds" in out
+        assert "pt_serving_decode_latency_seconds" in out
+
+    def test_speculative_accept_rate_populated(self):
+        from paddle_tpu.models import gpt as G
+        from paddle_tpu.serving import BatchedDecoder
+
+        telemetry.enable()
+        m = _gpt(50)
+        pt.seed(51)
+        dcfg = G.GPTConfig(vocab_size=512, hidden_size=64,
+                           num_layers=1, num_heads=2, num_kv_heads=2,
+                           intermediate_size=128, max_position=128)
+        d = G.GPTForCausalLM(dcfg).eval()
+        dec = BatchedDecoder(m, slots=1, capacity=64, draft=d, gamma=3)
+        dec.submit(_prompt(6, 90), 8)
+        dec.run()
+        reg = telemetry.registry()
+        assert reg.get("pt_serving_spec_row_rounds_total").value > 0
+        rate = reg.get("pt_serving_spec_accept_rate").value
+        assert 0.0 <= rate <= 3.0
+        assert rate == pytest.approx(
+            dec.spec_accepted / dec.spec_row_rounds)
+
+    def test_disabled_records_nothing(self):
+        from paddle_tpu.serving import BatchedDecoder
+
+        m = _gpt(1)
+        dec = BatchedDecoder(m, slots=1, capacity=64)
+        rid = dec.submit(_prompt(4, 82), 3)
+        out = dec.run()
+        assert out[rid].shape == (3,)
+        # the short-circuit really short-circuited: no instruments, no
+        # fingerprints, no spans
+        assert telemetry.registry().snapshot() == {}
+        assert trecompile.tracker().stats() == {}
+        assert ttrace.get_events() == []
+
+
+# ---------------------------------------------------------------------------
+# training integration (acceptance: step-time/examples-per-sec after an
+# MNIST train_loop run; recompile counter flat on same shapes and
+# incremented by a deliberate batch-shape change)
+# ---------------------------------------------------------------------------
+
+def _mnist_loop(tmp_path):
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.models import mnist as M
+    from paddle_tpu.train_loop import TrainLoop
+
+    pt.seed(0)
+    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+    model = M.MnistMLP(hidden1=16, hidden2=8)
+    tr = parallel.Trainer.supervised(model, optimizer.Adam(1e-3),
+                                     M.loss_fn, mesh=mesh)
+    return TrainLoop(tr, str(tmp_path), checkpoint_every=100)
+
+
+def _mnist_batches(n, bs=8):
+    for _ in range(n):
+        yield {"x": jnp.asarray(RNG.normal(size=(bs, 784))
+                                .astype(np.float32)),
+               "label": jnp.asarray(RNG.integers(0, 10, bs))}
+
+
+class TestTrainingIntegration:
+    def test_train_loop_populates_metrics_and_recompile_counter(
+            self, tmp_path):
+        telemetry.enable()
+        loop = _mnist_loop(tmp_path)
+        loop.run(_mnist_batches(4))
+        reg = telemetry.registry()
+        step_h = reg.get("pt_train_step_seconds")
+        assert step_h is not None and step_h.count == 4
+        assert reg.get("pt_train_steps_total").value == 4
+        assert reg.get("pt_train_examples_per_sec").value > 0
+        site = "train_loop.step"
+        rc = trecompile.tracker()
+        base = rc.recompiles(site)
+        # same-shape steps: the recompile counter stays at its value
+        loop.run(_mnist_batches(3), resume=False)
+        assert rc.recompiles(site) == base
+        ctr = reg.get("pt_jit_recompiles_total", {"site": site})
+        before = ctr.value if ctr is not None else 0
+        # deliberately changed batch shape: exactly one more retrace
+        loop.run(_mnist_batches(1, bs=4), resume=False)
+        assert rc.recompiles(site) == base + 1
+        ctr = reg.get("pt_jit_recompiles_total", {"site": site})
+        assert ctr is not None and ctr.value == before + 1
+        # acceptance: non-empty summary carrying the training rows
+        out = telemetry.summary()
+        assert "pt_train_step_seconds" in out
+        assert "pt_train_examples_per_sec" in out
+
+    def test_checkpoint_metrics_ride_along(self, tmp_path):
+        telemetry.enable()
+        loop = _mnist_loop(tmp_path)
+        loop.run(_mnist_batches(2))  # close() writes a final snapshot
+        reg = telemetry.registry()
+        assert reg.get("pt_checkpoint_saves_total").value >= 1
+        assert reg.get("pt_checkpoint_save_seconds").count >= 1
+        assert reg.get("pt_checkpoint_bytes_written_total").value > 0
+        loop2 = _mnist_loop(tmp_path)
+        assert loop2.maybe_resume() == 2
+        assert reg.get("pt_checkpoint_restores_total").value == 1
+        assert reg.get("pt_checkpoint_restore_seconds").count == 1
+
+    def test_disabled_records_nothing(self, tmp_path):
+        loop = _mnist_loop(tmp_path)
+        loop.run(_mnist_batches(2))
+        assert telemetry.registry().snapshot() == {}
+        assert trecompile.tracker().stats() == {}
+
+
+# ---------------------------------------------------------------------------
+# executor + tuning-table counters
+# ---------------------------------------------------------------------------
+
+def test_executor_cache_hit_miss_counters():
+    from paddle_tpu import static
+
+    telemetry.enable()
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (-1, 8))
+        y = static.layers.fc(x, 4)
+    exe = static.Executor(scope=static.Scope())
+    feed = {"x": np.ones((4, 8), np.float32)}
+    exe.run(prog, feed=feed, fetch_list=[y])
+    exe.run(prog, feed=feed, fetch_list=[y])
+    reg = telemetry.registry()
+    assert reg.get("pt_executor_cache_misses_total").value == 1
+    assert reg.get("pt_executor_cache_hits_total").value == 1
+    assert reg.get("pt_executor_run_seconds").count == 2
+
+
+def test_tuning_table_lookup_counters():
+    from paddle_tpu.ops.pallas import tuning
+
+    telemetry.enable()
+    tuning.set_tuned("telemetry_test|key", {"bq": 128}, persist=False)
+    try:
+        assert tuning.get_tuned("telemetry_test|key") is not None
+        assert tuning.get_tuned("telemetry_test|missing") is None
+        reg = telemetry.registry()
+        assert reg.get("pt_tuning_cache_hits_total").value == 1
+        assert reg.get("pt_tuning_cache_misses_total").value == 1
+    finally:
+        tuning.reset_cache()
