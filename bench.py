@@ -85,7 +85,7 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
             float(loss)
     float(loss)
     dt = time.perf_counter() - t0
-    extras = {}
+    extras = {"step_time_ms": round(dt / (outer * k) * 1e3, 3)}
     if step_flops:
         extras["flops_per_sec"] = step_flops * outer * k / dt
     return outer * k * batch_size / dt, "examples/sec", extras
@@ -230,7 +230,7 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
             float(l)
     float(l)
     dt = time.perf_counter() - t0
-    extras = {}
+    extras = {"step_time_ms": round(dt / (outer * k) * 1e3, 3)}
     if dispatch_flops:
         extras["flops_per_sec"] = dispatch_flops * outer / dt
     return outer * k * batch_size / dt, "examples/sec", extras
@@ -296,7 +296,8 @@ def _infer_bench(model, make_batch, steps, batch_size, warmup=5, amp=None,
     _fence(out)
     dt = time.perf_counter() - t0
     extras = {"latency_ms_p50": round(p50 * 1e3, 3),
-              "latency_ms_p99": round(p99 * 1e3, 3)}
+              "latency_ms_p99": round(p99 * 1e3, 3),
+              "step_time_ms": round(dt / steps * 1e3, 3)}
     return steps * batch_size / dt, "examples/sec", extras
 
 
@@ -624,7 +625,8 @@ def bench_nmt_decode(steps: int, batch_size: int, amp=None,
         out = fn(params, src)
         _fence(out)
     dt = time.perf_counter() - t0
-    return outer * batch_size * max_len / dt, "tokens/sec", {}
+    return (outer * batch_size * max_len / dt, "tokens/sec",
+            {"step_time_ms": round(dt / outer * 1e3, 3)})
 
 
 def bench_vit(steps: int, batch_size: int, smoke: bool = False,
@@ -748,7 +750,7 @@ def bench_gpt_decode(steps: int, batch_size: int, amp=None,
         out = fn(*args)
         _fence(out)
     dt = time.perf_counter() - t0
-    extras = {}
+    extras = {"step_time_ms": round(dt / outer * 1e3, 3)}
     if gamma > 0:
         stats = jax.device_get(out[1])
         rounds = float(np.mean(stats["rounds"]))
@@ -833,7 +835,8 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
         outs = run_all()
         total += sum(len(v) for v in outs.values())
     dt = time.perf_counter() - t0
-    extras = {"requests": n_req, "slots": slots}
+    extras = {"requests": n_req, "slots": slots,
+              "step_time_ms": round(dt / outer * 1e3, 3)}
     if gamma > 0:
         extras["accept_per_round"] = round(
             dec.spec_accepted / max(1, dec.spec_row_rounds), 3)
@@ -915,7 +918,7 @@ def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
             float(loss)
     float(loss)
     dt = time.perf_counter() - t0
-    extras = {}
+    extras = {"step_time_ms": round(dt / (outer * k) * 1e3, 3)}
     if dispatch_flops:
         extras["flops_per_sec"] = dispatch_flops * outer / dt
     return outer * k * batch_size / dt, "examples/sec", extras
@@ -1092,8 +1095,81 @@ def bench_googlenet(steps: int, batch_size: int, smoke: bool = False,
                         amp=amp)
 
 
+def bench_input_pipeline(steps: int, batch_size: int, warmup: int = 3,
+                         amp=None):
+    """Built-in A/B of the overlapped device input pipeline
+    (data/device_loader.py): the SAME jitted train step driven from a
+    host-side numpy stream (per-batch rng generation + per-row
+    normalization — real input-pipeline host work), once staged
+    synchronously in the consumer thread (prefetch OFF) and once through
+    a depth-2 DevicePrefetcher background thread (prefetch ON). Every
+    step is loss-fenced in BOTH arms, so each arm measures honest
+    host+compute wall time per step and the ON/OFF delta is exactly the
+    host-work overlap the prefetcher buys. Each arm runs twice and keeps
+    its best time (same discipline for both, cancels machine drift).
+    ``value`` is the prefetch-ON throughput; extras carry both arms and
+    the speedup ratio."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.data.device_loader import DevicePrefetcher
+    from paddle_tpu.models import mnist as M
+    from paddle_tpu.utils.flops import lowered_flops
+
+    pt.seed(0)
+    batch_size = _cap(batch_size, 256)
+    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+    trainer = parallel.Trainer.supervised(
+        M.MnistMLP(hidden1=512, hidden2=256), optimizer.Adam(1e-3),
+        M.loss_fn, mesh=mesh, amp=amp)
+
+    def host_batches(n, seed=0):
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            x = rng.normal(size=(batch_size, 784)).astype(np.float32)
+            x = (x - x.mean(axis=1, keepdims=True)) / (
+                x.std(axis=1, keepdims=True) + 1e-6)
+            yield {"x": x, "label": rng.integers(0, 10, batch_size)}
+
+    # FLOPs before the first call donates the trainer state
+    probe = next(host_batches(1))
+    step_flops = lowered_flops(trainer._jit_step, trainer.params,
+                               trainer.buffers, trainer.opt_state,
+                               trainer._rng, probe)
+    loss = None
+    for b in DevicePrefetcher(lambda: host_batches(max(warmup, 1)),
+                              size=0):
+        loss, _ = trainer.train_step(b)
+    float(loss)
+
+    def run_arm(depth, seed):
+        t0 = time.perf_counter()
+        for b in DevicePrefetcher(lambda: host_batches(steps, seed),
+                                  size=depth):
+            loss, _ = trainer.train_step(b)
+            float(loss)  # per-step fence — see docstring
+        return time.perf_counter() - t0
+
+    # off, on, on, off: mirrored order so slow machine drift hits both
+    # arms symmetrically
+    dt_off = run_arm(0, seed=1)
+    dt_on = min(run_arm(2, seed=2), run_arm(2, seed=3))
+    dt_off = min(dt_off, run_arm(0, seed=4))
+    value = steps * batch_size / dt_on
+    extras = {
+        "prefetch_off": round(steps * batch_size / dt_off, 2),
+        "prefetch_on": round(value, 2),
+        "overlap_speedup": round(dt_off / dt_on, 4),
+        "step_time_ms": round(dt_on / steps * 1e3, 3),
+    }
+    if step_flops:
+        extras["flops_per_sec"] = step_flops * steps / dt_on
+    return value, "examples/sec", extras
+
+
 MODELS = {
     "mnist_mlp": bench_mnist_mlp,
+    "input_pipeline": bench_input_pipeline,
     "alexnet": bench_alexnet,
     "googlenet": bench_googlenet,
     "stacked_lstm": bench_stacked_lstm,
@@ -1253,11 +1329,22 @@ def evaluate_against_history(metric: str, value: float, history: dict, *,
 
 
 def _emit_error(metric: str, msg: str) -> None:
-    """One-JSON-line driver contract, error form (shared by the device
-    watchdog and argument-misuse paths)."""
+    """One-JSON-line driver contract, argument-MISUSE form: a
+    deterministic caller error keeps the value-0.0 shape (it could never
+    have produced a number and never enters history)."""
     print(json.dumps({"metric": metric, "value": 0.0,
                       "unit": "examples/sec", "vs_baseline": 0.0,
+                      "backend": None, "mfu": None, "step_time_ms": None,
                       "error": msg}))
+
+
+def _emit_skip(metric: str, msg: str) -> None:
+    """One-JSON-line driver contract, INFRA-error form: the workload is
+    fine but the environment failed (device init timeout, profiler
+    unsupported). Emits ``"skipped": true`` with the error and NO value
+    key — a 0.0 row here would read as a real measurement and drag
+    BENCH_HISTORY trend plots to zero."""
+    print(json.dumps({"metric": metric, "skipped": True, "error": msg}))
 
 
 def main():
@@ -1354,7 +1441,15 @@ def main():
 
         jax.config.update("jax_platforms", args.platform)
         if args.dp > 1 and args.platform == "cpu":
-            jax.config.update("jax_num_cpu_devices", args.dp)
+            try:
+                jax.config.update("jax_num_cpu_devices", args.dp)
+            except AttributeError:
+                # older JAX only honors the XLA_FLAGS env var, and only
+                # before backend init (the conftest guard, applied here)
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") +
+                    f" --xla_force_host_platform_device_count={args.dp}"
+                ).strip()
 
     steps = args.steps or (10 if args.smoke else HEADLINE_STEPS)
     batch = args.batch_size or (256 if args.smoke else 8192)
@@ -1444,6 +1539,12 @@ def main():
         _emit_error(metric, "--infer: use --model deepfm (the sparse "
                     "variant differs only in the optimizer update)")
         return
+    if args.infer and args.model == "input_pipeline":
+        # the A/B measures the TRAIN step under both staging modes; an
+        # --infer run would silently measure training under an infer key
+        _emit_error(metric, "--infer: input_pipeline A/Bs the train "
+                    "step; run it without --infer")
+        return
     if args.infer and args.model == "gpt_serve":
         _emit_error(metric, "--infer: --model gpt_serve already measures "
                     "inference serving; run it without --infer")
@@ -1492,9 +1593,10 @@ def main():
         if os.environ.get("PT_BENCH_CPU_FALLBACK"):
             # already fell back once and CPU init ALSO hung — nothing
             # left to fall back to; keep the one-JSON-line contract
-            _emit_error(metric,
-                        "device init timeout (accelerator unreachable; "
-                        "cpu fallback also failed)")
+            # (skipped, not value 0.0: infra error, not a measurement)
+            _emit_skip(metric,
+                       "device init timeout (accelerator unreachable; "
+                       "cpu fallback also failed)")
             return
         # fall back to CPU so the round still produces a real number
         # (tagged "backend": "cpu_fallback" in the JSON) instead of the
@@ -1608,8 +1710,8 @@ def main():
             args.device_trace, "**", "*.xplane.pb"), recursive=True)
             if os.path.getsize(p) > 1024]
         if not planes:
-            _emit_error(metric, "device trace produced no xplane.pb "
-                        "(PJRT profiler unsupported on this platform?)")
+            _emit_skip(metric, "device trace produced no xplane.pb "
+                       "(PJRT profiler unsupported on this platform?)")
             return
         extras["device_trace_planes"] = [
             {"file": os.path.relpath(p, args.device_trace),
@@ -1678,16 +1780,25 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
             json.dump(history, f, indent=1)
 
     line = {"metric": metric, "value": round(value, 2), "unit": unit,
-            "vs_baseline": round(vs_baseline, 4)}
+            "vs_baseline": round(vs_baseline, 4),
+            # backend on EVERY line (main() overrides to "cpu_fallback"
+            # after a device-init-timeout re-exec) so a reader never has
+            # to infer which hardware a number came from
+            "backend": device.platform,
+            # fenced wall time per step/dispatch — the denominator the
+            # mfu field divides FLOPs by; None when a bench predates it
+            "step_time_ms": extras.get("step_time_ms")}
     # MFU: model FLOP/s (XLA cost model over the lowered step) / chip peak.
     # Reported only when both sides are known (never on CPU).
     from paddle_tpu.utils.flops import mfu as _mfu
 
-    # latency percentiles from the inference harness, and the
-    # speculative-decode acceptance stats, ride along verbatim
+    # latency percentiles from the inference harness, the
+    # speculative-decode acceptance stats, and the input-pipeline A/B
+    # numbers ride along verbatim
     line.update({k: v for k, v in extras.items()
                  if k.startswith("latency_ms_")
-                 or k in ("accept_per_round", "rounds")})
+                 or k in ("accept_per_round", "rounds", "prefetch_off",
+                          "prefetch_on", "overlap_speedup")})
     flops_per_sec = extras.get("flops_per_sec")
     line["mfu"] = None
     if flops_per_sec:

@@ -6,15 +6,18 @@ from .bucketing import (bucket_by_length, pad_to,
                         quantile_boundaries)
 from .data_generator import MultiSlotDataGenerator
 from .dataset import MultiSlotDataset, train_from_dataset
+from .device_loader import (BucketPadder, DevicePrefetcher,
+                            prefetch_to_device)
 from .feeder import DataFeeder, DeviceLoader
 from .reader import (Fake, PipeReader, batch, buffered, cache, chain,
                      compose, creator, firstn, map_readers,
                      multiprocess_reader, shuffle, xmap_readers)
 
 __all__ = [
-    "BPETokenizer",
+    "BPETokenizer", "BucketPadder", "DevicePrefetcher",
     "MultiSlotDataGenerator", "train_from_dataset",
-    "bucket_by_length", "pad_to", "quantile_boundaries",
+    "bucket_by_length", "pad_to", "prefetch_to_device",
+    "quantile_boundaries",
     "dataset", "MultiSlotDataset", "DataFeeder", "DeviceLoader", "batch", "buffered", "cache",
     "chain", "compose", "firstn", "map_readers", "shuffle", "xmap_readers",
     "Fake", "PipeReader", "creator", "multiprocess_reader",

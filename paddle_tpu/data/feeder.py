@@ -13,14 +13,13 @@ Capability parity with the reference's feed stack:
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
 
 from ..core.enforce import enforce
+from .device_loader import DevicePrefetcher
 
 
 class DataFeeder:
@@ -165,65 +164,32 @@ class DataFeeder:
             yield self.feed(batch)
 
 
-class DeviceLoader:
+class DeviceLoader(DevicePrefetcher):
     """Double-buffered device feeder (PyReader analog).
 
-    Wraps an iterable of host batches; a daemon thread keeps up to
-    ``capacity`` batches staged on device ahead of the consumer.
+    Thin compatibility front over
+    :class:`..data.device_loader.DevicePrefetcher` — a daemon thread
+    keeps up to ``capacity`` batches staged on device ahead of the
+    consumer. The full pipeline (mesh-default sharding, bucket padding,
+    telemetry) lives on the base class.
     """
-
-    _END = object()
 
     def __init__(self, batches: Callable[[], Iterator[Any]],
                  transform: Optional[Callable] = None,
                  sharding=None, capacity: int = 2):
-        self.batches = batches
-        self.transform = transform
-        self.sharding = sharding
+        # capacity=0 used to mean an UNBOUNDED prefetch queue
+        # (Queue(maxsize=0)); on the DevicePrefetcher base size=0 means
+        # synchronous staging — reject it loudly rather than silently
+        # serializing a caller who asked for maximum overlap
+        enforce(capacity >= 1,
+                "DeviceLoader capacity must be >= 1, got %s (use "
+                "DevicePrefetcher(size=0) for synchronous staging)",
+                capacity)
+        super().__init__(batches, size=capacity, transform=transform,
+                         sharding=sharding)
         self.capacity = capacity
 
     def reset(self):
         """Re-arm for a fresh epoch (PyReader.reset analog): iteration
         restarts the source and prefetch thread on the next __iter__."""
         return self
-
-    def __iter__(self):
-        from .reader import _put_cancellable
-
-        q: queue.Queue = queue.Queue(maxsize=self.capacity)
-        err = []
-        stop = threading.Event()
-
-        def stage(item):
-            if self.transform is not None:
-                item = self.transform(item)
-            if self.sharding is not None:
-                item = jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, self.sharding), item)
-            else:
-                item = jax.tree_util.tree_map(jax.device_put, item)
-            return item
-
-        def worker():
-            try:
-                for item in self.batches():
-                    if not _put_cancellable(q, stage(item), stop):
-                        return
-            except BaseException as e:
-                err.append(e)
-            finally:
-                _put_cancellable(q, self._END, stop)
-
-        threading.Thread(target=worker, daemon=True).start()
-        try:
-            while True:
-                item = q.get()
-                if item is self._END:
-                    break
-                yield item
-        finally:
-            # early break/exception in the train loop: release the worker so
-            # staged device batches aren't pinned for the process lifetime
-            stop.set()
-        if err:
-            raise err[0]

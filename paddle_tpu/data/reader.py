@@ -115,7 +115,8 @@ def buffered(reader: Reader, size: int) -> Reader:
             finally:
                 _put_cancellable(q, end, stop)
 
-        t = threading.Thread(target=worker, daemon=True)
+        t = threading.Thread(target=worker, daemon=True,
+                             name="pt-reader-buffered")
         t.start()
         try:
             while True:
@@ -223,9 +224,11 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
             finally:
                 _put_cancellable(out_q, end, stop)
 
-        threading.Thread(target=feeder, daemon=True).start()
+        threading.Thread(target=feeder, daemon=True,
+                         name="pt-reader-xmap-feeder").start()
         for _ in range(process_num):
-            threading.Thread(target=worker, daemon=True).start()
+            threading.Thread(target=worker, daemon=True,
+                             name="pt-reader-xmap-worker").start()
 
         finished = 0
         try:

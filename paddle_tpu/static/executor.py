@@ -171,7 +171,8 @@ class Executor:
     """reference: executor.py:288. ``place`` is advisory — XLA owns device
     placement; a mesh-aware CompiledProgram wrapper adds SPMD."""
 
-    def __init__(self, place=None, scope: Optional[Scope] = None):
+    def __init__(self, place=None, scope: Optional[Scope] = None,
+                 feed_buckets=None, feed_pad_value=0):
         from collections import OrderedDict
 
         self.place = place
@@ -181,6 +182,34 @@ class Executor:
         # shape churn must evict, not accumulate    (scope_guard works ^)
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._prune_cache: Dict[Tuple, Tuple] = {}
+        self._feed_padder = None
+        self._len_padder = None
+        if feed_buckets is not None:
+            self.set_feed_buckets(feed_buckets, feed_pad_value)
+
+    def set_feed_buckets(self, buckets, pad_value=0) -> "Executor":
+        """Pad batch-polymorphic feeds (``data()`` vars declared with
+        leading dim -1; fixed-shape feeds ride through) UP to a fixed
+        bucket boundary
+        (``"pow2"`` or an ascending size list — ``data.device_loader``
+        boundary semantics) before the program-cache signature is
+        computed, so a drifting final batch hits a cached executable
+        instead of compiling a new one (and, under the LRU cap, instead
+        of thrashing real entries out). Padded rows participate in the
+        program's reductions and ride through fetches — slice fetched
+        row-wise outputs back to the real batch size yourself when it
+        matters. ``buckets=None`` turns padding back off."""
+        from ..data.device_loader import BucketPadder
+
+        if buckets is None:
+            self._feed_padder = self._len_padder = None
+        else:
+            self._feed_padder = BucketPadder(buckets, pad_value=pad_value)
+            # LoD length companions (<name>@LEN[2]) always pad with 0:
+            # a fabricated row must carry zero sequence length, not
+            # pad_value fake timesteps
+            self._len_padder = BucketPadder(buckets, pad_value=0)
+        return self
 
     @property
     def scope(self) -> Scope:
@@ -248,6 +277,21 @@ class Executor:
         # exe.run(CompiledProgram(prog).with_data_parallel(...), ...))
         program = getattr(program, "program", program)
         feed = dict(feed or {})
+        if self._feed_padder is not None and feed:
+            # bucket-pad BEFORE the feed signature: the cached-step path
+            # then sees one signature per bucket, not per ragged shape.
+            # Only batch-polymorphic feeds (declared leading dim -1) are
+            # padded — a fixed-shape aux feed (class weights, ...) must
+            # ride through exactly or its math is silently corrupted.
+            def _pad_feed(k, v):
+                var = program.vars.get(k)
+                if var is None or tuple(var.shape[:1]) != (-1,):
+                    return v  # fixed-shape feed: exact
+                if k.endswith("@LEN") or k.endswith("@LEN2"):
+                    return self._len_padder(v)  # fake rows: length 0
+                return self._feed_padder(v)
+
+            feed = {k: _pad_feed(k, v) for k, v in feed.items()}
         fetch_names = tuple(
             f.name if isinstance(f, Var) else f for f in (fetch_list or []))
         for fname in fetch_names:

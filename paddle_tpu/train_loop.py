@@ -198,11 +198,47 @@ class TrainLoop:
 
     def run(self, batches: Iterable, num_steps: Optional[int] = None,
             resume: bool = True,
-            on_step: Optional[Callable[[int, Any, Dict], None]] = None):
+            on_step: Optional[Callable[[int, Any, Dict], None]] = None,
+            prefetch: Optional[int] = None, bucket_by=None, pad_value=0):
         """Train until ``num_steps`` (global, including resumed) or data
         exhaustion. Returns the final step count — which can end below
         ``num_steps`` after an elastic recovery, since the data stream
-        is not replayable (see history["recoveries"])."""
+        is not replayable (see history["recoveries"]).
+
+        Input pipeline (opt-in, ``data.device_loader``):
+
+        - ``prefetch=N``: stage batches onto device N ahead via a
+          background thread (double buffering at N=2), overlapping host
+          work + transfer with the device's compute on the previous
+          step. Batches land pre-placed with the trainer's
+          ``data_sharding()`` when it has one. Donation-safe by
+          construction: the Trainer step donates (params, buffers,
+          opt_state) — never the batch — and the prefetcher copies any
+          already-device-resident leaf, so a staged buffer can never be
+          a donated one.
+        - ``bucket_by=...``: pad the batch axis up to a fixed bucket set
+          ("pow2" or an ascending size list) so a ragged final batch
+          reuses the compiled step instead of retracing it (visible in
+          ``pt_jit_recompiles_total{site="train_loop.step"}``).
+          ``pad_value`` fills the padded rows. Works with or without
+          ``prefetch`` (alone it stages synchronously).
+        """
+        if prefetch is not None or bucket_by is not None:
+            from .data.device_loader import DevicePrefetcher
+
+            sharding = None
+            get_sh = getattr(self.trainer, "data_sharding", None)
+            if callable(get_sh) and getattr(self.trainer, "mesh",
+                                            None) is not None:
+                # no blanket except: a broken data_sharding() (bad axis
+                # name, ...) must fail loudly, not silently stage every
+                # batch at default placement
+                sharding = get_sh()
+            batches = DevicePrefetcher(batches,
+                                       size=int(prefetch or 0),
+                                       sharding=sharding,
+                                       bucket_by=bucket_by,
+                                       pad_value=pad_value)
         if resume:
             self.maybe_resume()
         self._recoveries_this_run = 0
@@ -264,7 +300,11 @@ class TrainLoop:
                     tmet = _train_metrics()
                     tmet["steps"].inc()
                     tmet["step_time"].observe(dt)
-                    bs = _batch_size(batch)
+                    # pre-pad row count when the batch came through the
+                    # prefetcher: bucket padding must not inflate the
+                    # examples/sec gauge
+                    bs = (getattr(batches, "last_real_rows", None)
+                          or _batch_size(batch))
                     if bs and dt > 0:
                         tmet["examples_per_sec"].set(bs / dt)
                     opt = getattr(self.trainer, "optimizer", None)

@@ -66,6 +66,52 @@ def device_peak_flops(device: Optional[Any] = None,
     return None
 
 
+def _harden_cache_writes() -> None:
+    """Make jax's persistent-cache writes atomic. jax<=0.4.x
+    ``LRUCache.put`` writes the entry with a bare ``write_bytes``: a
+    process killed mid-write (bench watchdogs, CI ``timeout -k``)
+    leaves a torn entry that later processes deserialize — observed as
+    segfaults/NaNs in previously-green runs until the dir is wiped.
+    Write to a same-dir temp file and ``os.replace`` into place; best
+    effort, jax versions without this layout are left alone."""
+    import tempfile
+    import time
+
+    from jax._src import lru_cache as _lru
+
+    if getattr(_lru.LRUCache, "_pt_atomic_put", False):
+        return
+    orig_put = _lru.LRUCache.put
+
+    def put(self, key, val):
+        # stock behavior on the locked (eviction) path and on non-local
+        # cache dirs (gs://...): mkstemp/os.replace are local-FS-only
+        local = getattr(_lru, "_is_local_filesystem", lambda p: False)
+        if self.eviction_enabled or not local(str(self.path)):
+            return orig_put(self, key, val)
+        if not key:
+            raise ValueError("key cannot be empty")
+        cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+        if cache_path.exists():
+            return
+        fd, tmp = tempfile.mkstemp(dir=str(self.path), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(val)
+            os.replace(tmp, cache_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        (self.path / f"{key}{_lru._ATIME_SUFFIX}").write_bytes(
+            time.time_ns().to_bytes(8, "little"))
+
+    _lru.LRUCache.put = put
+    _lru.LRUCache._pt_atomic_put = True
+
+
 def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
     """Point JAX's persistent compilation cache at a repo-local dir so
     slow first compiles amortize across bench/tune processes (and across
@@ -77,10 +123,22 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
             os.path.abspath(__file__)))), ".jax_cache"))
     if not path or path == "0":
         return None
+    import glob
+
     import jax
 
     try:
+        _harden_cache_writes()
+    except Exception:
+        pass  # unknown jax cache layout: run with stock writes
+    try:
         os.makedirs(path, exist_ok=True)
+        # leftover temp files from killed writers are dead weight
+        for tmp in glob.glob(os.path.join(path, "*.tmp")):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         jax.config.update("jax_compilation_cache_dir", path)
         return path
     except OSError:

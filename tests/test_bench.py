@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
@@ -187,10 +189,104 @@ def test_run_config_fingerprint_identity():
     assert h3 != h1  # fast-sweep steps fork the hash (own variant key)
 
 
+def test_input_pipeline_ab_contract():
+    """The built-in prefetch A/B (PR 2 tentpole): one line carrying both
+    arms + the overlap speedup, value = prefetch-ON throughput."""
+    d = _run("--model", "input_pipeline", "--smoke", "--steps", "6",
+             "--batch-size", "64")
+    assert d["metric"] == "input_pipeline_throughput_b64"
+    assert d["value"] > 0 and d["unit"] == "examples/sec"
+    assert d["prefetch_on"] > 0 and d["prefetch_off"] > 0
+    assert d["overlap_speedup"] > 0
+    assert d["value"] == d["prefetch_on"]
+    assert d["step_time_ms"] > 0
+
+
+def test_every_line_carries_mfu_step_time_backend():
+    """PR 2 schema: every success line says which backend produced it
+    and the fenced per-step time next to mfu (null on CPU — no peak)."""
+    d = _run("--smoke", "--steps", "4", "--batch-size", "32")
+    assert d["backend"] == "cpu"
+    assert d["step_time_ms"] > 0
+    assert "mfu" in d and d["mfu"] is None  # cpu: honest null
+
+
+def test_infra_error_emits_skip_not_zero():
+    """Infra failures (device init timeout after the cpu fallback) must
+    emit "skipped": true with the error, NEVER a value-0.0 row that
+    drags BENCH_HISTORY trend plots to zero."""
+    env = dict(os.environ, PT_BENCH_DEVICE_TIMEOUT_S="0",
+               PT_BENCH_CPU_FALLBACK="1")
+    r = subprocess.run([sys.executable, BENCH, "--platform", "cpu",
+                        "--smoke", "--steps", "1", "--batch-size", "8"],
+                       capture_output=True, text=True, timeout=240,
+                       env=env)
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line: {r.stdout}\n{r.stderr}"
+    d = json.loads(lines[-1])
+    assert d.get("skipped") is True
+    assert "value" not in d
+    assert "device init timeout" in d["error"]
+    assert d["metric"] == "mnist_mlp_throughput_b8"
+
+
+def test_compile_cache_writes_are_atomic(tmp_path):
+    """Torn-write hardening (utils/flops._harden_cache_writes): a
+    process SIGKILLed mid-cache-write (bench watchdog, CI timeout -k)
+    must never leave a truncated entry that segfaults later runs —
+    entries are written to a temp file and os.replace'd into place."""
+    from paddle_tpu.utils import flops as F
+
+    d = str(tmp_path / "cache")
+    assert F.enable_compile_cache(d) == d
+    from jax._src import compilation_cache as cc
+    from jax._src import lru_cache
+
+    assert getattr(lru_cache.LRUCache, "_pt_atomic_put", False)
+    import jax
+
+    # the cache object is a lazily-initialized singleton: drop it so the
+    # dir change above takes effect even mid-suite
+    cc.reset_cache()
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        float(jax.jit(lambda x: x * 2)(1.0))
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # conftest pointed the cache at the repo dir; restore it
+        F.enable_compile_cache()
+        cc.reset_cache()
+    entries = [e for e in os.listdir(d) if e.endswith("-cache")]
+    assert entries, "no cache entry written through the atomic path"
+    assert not [e for e in os.listdir(d) if e.endswith(".tmp")]
+
+
+@pytest.mark.slow
+def test_e2e_bench_smoke_validates_schema():
+    """End-to-end CI gate: run bench.py once on CPU (a real smoke run,
+    no step/batch overrides) and validate the full JSON schema so bench
+    breakage is caught before the round snapshot. A broken line here
+    means every BENCH_r*.json of the round is unusable."""
+    d = _run("--smoke")
+    for key in ("metric", "value", "unit", "vs_baseline", "backend",
+                "step_time_ms", "mfu"):
+        assert key in d, f"schema key missing: {key} in {d}"
+    assert d["metric"] == "mnist_mlp_throughput"
+    assert isinstance(d["value"], float) and d["value"] > 0
+    assert d["unit"] == "examples/sec"
+    assert d["backend"] == "cpu"
+    assert d["step_time_ms"] > 0
+    assert d["mfu"] is None  # cpu: no chip peak to divide by
+    assert "skipped" not in d and "error" not in d
+
+
 def test_dp_misuse_keeps_json_contract():
     d = _run("--model", "resnet50", "--dp", "2", "--smoke",
              "--steps", "1", "--batch-size", "2")
     assert d["value"] == 0.0 and "--dp is not supported" in d["error"]
+    # error rows carry the full schema too (null where unmeasurable)
+    assert d["backend"] is None and d["mfu"] is None
+    assert d["step_time_ms"] is None
 
 
 def test_unwritable_profile_keeps_json_contract():

@@ -157,6 +157,91 @@ def test_xmap_readers_propagates_mapper_error():
         list(D.xmap_readers(bad_mapper, count_reader(10), 2, 2)())
 
 
+# -- reader thread hygiene (PR 2 regression pins) ---------------------------
+# Worker threads are named "pt-reader-*" exactly so these tests can prove
+# they exit; an abandoned consumer (break mid-stream) must release every
+# worker instead of pinning buffered items for the process lifetime.
+
+def _wait_reader_threads_gone(timeout=5.0):
+    import threading
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        left = [t.name for t in threading.enumerate()
+                if t.name.startswith("pt-reader")]
+        if not left:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_buffered_source_error_propagates_midstream():
+    def bad():
+        yield from range(5)
+        raise OSError("source boom")
+
+    import pytest
+
+    with pytest.raises(OSError, match="source boom"):
+        list(D.buffered(bad, 2)())
+    assert _wait_reader_threads_gone()
+
+
+def test_buffered_no_thread_leak_after_abandoned_consumer():
+    it = iter(D.buffered(count_reader(10_000), 4)())
+    next(it)
+    next(it)
+    it.close()  # break mid-stream
+    assert _wait_reader_threads_gone(), [
+        t.name for t in __import__("threading").enumerate()]
+
+
+def test_xmap_source_error_propagates():
+    def bad():
+        yield 1
+        raise RuntimeError("feeder boom")
+
+    import pytest
+
+    with pytest.raises(RuntimeError, match="feeder boom"):
+        list(D.xmap_readers(lambda x: x, bad, 2, 2)())
+    assert _wait_reader_threads_gone()
+
+
+def test_xmap_no_thread_leak_after_abandoned_consumer():
+    for order in (False, True):
+        it = iter(D.xmap_readers(lambda x: x * 2, count_reader(10_000),
+                                 3, 2, order=order)())
+        next(it)
+        next(it)
+        it.close()  # break mid-stream: feeder + 3 workers must all exit
+        assert _wait_reader_threads_gone(), order
+
+
+def test_xmap_mapper_error_leaves_no_threads():
+    import pytest
+
+    def bad_mapper(x):
+        raise ValueError("mapper boom")
+
+    with pytest.raises(ValueError, match="mapper boom"):
+        list(D.xmap_readers(bad_mapper, count_reader(100), 2, 2)())
+    assert _wait_reader_threads_gone()
+
+
+def test_device_loader_rejects_capacity_zero():
+    """capacity=0 used to mean an unbounded prefetch queue; on the
+    DevicePrefetcher base it would mean NO prefetch — reject loudly
+    instead of silently serializing the pipeline."""
+    import pytest
+
+    from paddle_tpu.core.enforce import EnforceError
+
+    with pytest.raises(EnforceError, match="capacity"):
+        D.DeviceLoader(count_reader(4), capacity=0)
+
+
 class TestMultiSlotDataGenerator:
     def test_roundtrip_through_native_feed(self, tmp_path):
         """Generated files parse back through the C++ MultiSlotFeed."""

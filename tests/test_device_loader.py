@@ -1,0 +1,429 @@
+"""Overlapped device input pipeline (data/device_loader.py): bucket
+padding, prefetch ordering/termination, worker-exception propagation,
+thread hygiene, donation safety, telemetry, and the two integration
+points — TrainLoop (bucketing kills retraces) and the static Executor's
+cached-step path (bucketed feeds reuse the compiled slice)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import telemetry
+from paddle_tpu.data import BucketPadder, DevicePrefetcher
+from paddle_tpu.core.enforce import EnforceError
+
+
+def _np_batches(n, bs=4, seed=0):
+    rng = np.random.default_rng(seed)
+
+    def gen():
+        for i in range(n):
+            yield {"x": np.full((bs, 3), i, np.float32),
+                   "label": rng.integers(0, 10, bs)}
+
+    return gen
+
+
+def _wait_no_pt_threads(prefix="pt-device", timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not [t for t in threading.enumerate()
+                if t.name.startswith(prefix)]:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestBucketPadder:
+    def test_list_boundaries_and_overflow(self):
+        p = BucketPadder([8, 16, 32])
+        out, added = p.pad({"x": np.ones((13, 4)), "label": np.arange(13)})
+        assert out["x"].shape == (16, 4)
+        assert out["label"].shape == (16,)
+        assert added == 6  # 3 rows on x + 3 on label
+        # beyond the last boundary: exact shape (accepted recompile)
+        out, added = p.pad({"x": np.ones((40, 4))})
+        assert out["x"].shape == (40, 4) and added == 0
+
+    def test_pow2(self):
+        p = BucketPadder("pow2")
+        assert p({"x": np.ones((9, 2))})["x"].shape == (16, 2)
+        assert p({"x": np.ones((16, 2))})["x"].shape == (16, 2)
+
+    def test_edge_mode_repeats_last_row(self):
+        p = BucketPadder([4], mode="edge")
+        out = p({"x": np.asarray([1.0, 2.0, 3.0])})
+        np.testing.assert_allclose(out["x"], [1.0, 2.0, 3.0, 3.0])
+
+    def test_zeros_mode_and_pad_value(self):
+        p = BucketPadder([4], pad_value=-1)
+        out = p({"x": np.asarray([5, 6])})
+        np.testing.assert_array_equal(out["x"], [5, 6, -1, -1])
+
+    def test_non_array_leaves_ride_through(self):
+        p = BucketPadder([8])
+        out = p({"x": np.ones((3, 2)), "k": 7})
+        assert out["k"] == 7 and out["x"].shape == (8, 2)
+
+    def test_fixed_size_aux_leaf_not_padded(self):
+        """Only leaves at the dominant batch size are padded — a
+        fixed-shape aux leaf (class weights, ...) must ride through
+        exactly, not get zero-corrupted up to the bucket."""
+        p = BucketPadder([64])
+        out, added = p.pad({"x": np.ones((32, 4)),
+                            "label": np.zeros(32),
+                            "class_w": np.ones(10)})
+        assert out["x"].shape == (64, 4)
+        assert out["label"].shape == (64,)
+        assert out["class_w"].shape == (10,)
+        np.testing.assert_array_equal(out["class_w"], np.ones(10))
+        assert added == 64  # 32 on x + 32 on label, none on class_w
+
+    def test_aux_leaf_longer_than_batch_loses_tie(self):
+        """One batch leaf vs one LONGER aux leaf (count tie): the batch
+        leaf carries more elements and must win — the aux vector stays
+        exact and the batch leaf gets the padding."""
+        p = BucketPadder([64])
+        out, added = p.pad({"x": np.ones((32, 4)),
+                            "class_w": np.arange(40.0)})
+        assert out["x"].shape == (64, 4)
+        assert out["class_w"].shape == (40,)
+        np.testing.assert_array_equal(out["class_w"], np.arange(40.0))
+        assert added == 32
+
+    def test_empty_batch_rides_through(self):
+        """A 0-row batch must NOT be padded up to a fabricated row —
+        that would train on fake data (and mode='edge' cannot even
+        extend an empty axis)."""
+        for mode in ("zeros", "edge"):
+            p = BucketPadder("pow2", mode=mode)
+            out, added = p.pad({"x": np.ones((0, 4), np.float32)})
+            assert out["x"].shape == (0, 4) and added == 0
+
+    def test_rejects_bad_config(self):
+        with pytest.raises(EnforceError):
+            BucketPadder([])
+        with pytest.raises(EnforceError):
+            BucketPadder([4], mode="wrap")
+
+    def test_pad_waste_counter(self):
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            BucketPadder([8]).pad({"x": np.ones((5, 2))})
+            snap = telemetry.registry().snapshot()
+            assert snap["pt_input_bucket_pad_rows_total"]["value"] == 3
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+class TestDevicePrefetcher:
+    def test_ordering_and_termination(self):
+        for size in (0, 1, 2, 3):
+            seen = [float(np.asarray(b["x"])[0, 0])
+                    for b in DevicePrefetcher(_np_batches(7), size=size)]
+            assert seen == list(range(7)), (size, seen)
+
+    def test_reiterable_per_epoch(self):
+        loader = DevicePrefetcher(_np_batches(3), size=2)
+        for _ in range(2):  # reader-creator source: fresh pass each iter
+            assert len(list(loader)) == 3
+
+    def test_worker_exception_propagates(self):
+        def bad():
+            yield {"x": np.zeros((2,))}
+            raise ValueError("stage boom")
+
+        with pytest.raises(ValueError, match="stage boom"):
+            list(DevicePrefetcher(bad, size=2))
+
+    def test_transform_runs_on_host_side(self):
+        out = list(DevicePrefetcher(
+            _np_batches(2), size=2,
+            transform=lambda b: {"x": b["x"] + 1}))
+        assert float(np.asarray(out[1]["x"])[0, 0]) == 2.0
+
+    def test_no_thread_leak_after_abandon(self):
+        it = iter(DevicePrefetcher(_np_batches(1000), size=2))
+        next(it)
+        next(it)
+        it.close()  # break mid-stream
+        assert _wait_no_pt_threads(), [
+            t.name for t in threading.enumerate()]
+
+    def test_mesh_default_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = pt.build_mesh(dp=8)
+        out = list(DevicePrefetcher(_np_batches(2, bs=16), size=2,
+                                    mesh=mesh))
+        want = NamedSharding(mesh, PartitionSpec("dp"))
+        assert out[0]["x"].sharding.is_equivalent_to(want, 2)
+
+    def test_bucketing_stabilizes_shapes(self):
+        def ragged():
+            for bs in (32, 32, 17):
+                yield {"x": np.ones((bs, 4), np.float32)}
+
+        shapes = {b["x"].shape for b in DevicePrefetcher(
+            ragged, size=2, bucket_by=[32])}
+        assert shapes == {(32, 4)}
+
+    def test_last_real_rows_tracks_prepad_size(self):
+        """examples/sec consumers divide by the PRE-pad row count —
+        bucket padding must not inflate throughput telemetry."""
+        def ragged():
+            for bs in (32, 17):
+                yield {"x": np.ones((bs, 4), np.float32)}
+
+        for size in (0, 2):
+            loader = DevicePrefetcher(ragged, size=size, bucket_by=[32])
+            assert loader.last_real_rows is None
+            seen = [(loader.last_real_rows, b["x"].shape[0])
+                    for b in loader]
+            assert seen == [(32, 32), (17, 32)], (size, seen)
+
+    def test_last_real_rows_honors_axis_without_padder(self):
+        """axis= must steer last_real_rows even when bucket_by is
+        unset (time-major (T, B, ...) batches)."""
+        def batches():
+            yield {"x": np.ones((7, 3, 4), np.float32)}  # T=7, B=3
+
+        loader = DevicePrefetcher(batches, size=0, axis=1)
+        list(loader)
+        assert loader.last_real_rows == 3
+
+    def test_last_real_rows_ignores_aux_leaf(self):
+        """'aux' sorts before 'x': the dominant batch size must win
+        over whichever leaf the pytree flattens first."""
+        def batches():
+            yield {"aux": np.ones(10), "label": np.zeros(32),
+                   "x": np.ones((32, 4), np.float32)}
+
+        loader = DevicePrefetcher(batches, size=0)
+        list(loader)
+        assert loader.last_real_rows == 32
+
+    def test_donation_safety_copies_placed_arrays(self):
+        """An input leaf that is already a committed jax.Array must NOT
+        alias through device_put: a consumer step that donates its batch
+        would otherwise invalidate the source buffer for later yields
+        (the donated-prefetched-buffer hazard)."""
+        src = jnp.ones((4,))
+
+        def same_twice():
+            yield {"x": src}
+            yield {"x": src}
+
+        outs = list(DevicePrefetcher(same_twice, size=2))
+        assert outs[0]["x"] is not src and outs[1]["x"] is not src
+
+        donating = jax.jit(lambda b: b["x"].sum(), donate_argnums=(0,))
+        # both dispatches must succeed — neither consumed a buffer the
+        # other (or the source) still needs
+        vals = [float(donating(b)) for b in
+                DevicePrefetcher(same_twice, size=2)]
+        assert vals == [4.0, 4.0]
+        assert float(src.sum()) == 4.0  # source untouched
+
+    def test_donate_safe_off_aliases(self):
+        src = jnp.ones((4,))
+        out = next(iter(DevicePrefetcher(lambda: iter([{"x": src}]),
+                                         size=0, donate_safe=False)))
+        assert out["x"] is src  # documented zero-copy behavior
+
+    def test_telemetry_instruments(self):
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            list(DevicePrefetcher(_np_batches(5), size=2,
+                                  bucket_by=[8], pad_value=0))
+            snap = telemetry.registry().snapshot()
+            assert snap["pt_input_batches_total"]["value"] == 5
+            assert snap["pt_input_host_wait_seconds"]["count"] == 5
+            assert snap["pt_input_bucket_pad_rows_total"]["value"] > 0
+            assert "pt_input_prefetch_queue_depth" in snap
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+def _make_trainer():
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.models import mnist as M
+
+    pt.seed(0)
+    mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+    return parallel.Trainer.supervised(
+        M.MnistMLP(hidden1=16, hidden2=8), optimizer.Adam(1e-3),
+        M.loss_fn, mesh=mesh)
+
+
+def _ragged_batches(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    for bs in sizes:
+        yield {"x": rng.normal(size=(bs, 784)).astype(np.float32),
+               "label": rng.integers(0, 10, bs)}
+
+
+class TestTrainLoopIntegration:
+    def test_ragged_final_batch_retraces_without_bucketing(self, tmp_path):
+        from paddle_tpu.train_loop import TrainLoop
+
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            loop = TrainLoop(_make_trainer(), str(tmp_path),
+                             checkpoint_every=1000)
+            loop.run(_ragged_batches([32, 32, 32, 17]), resume=False)
+            assert telemetry.recompile.tracker().recompiles(
+                "train_loop.step") > 0
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+    def test_bucket_by_kills_retraces(self, tmp_path):
+        """The acceptance pin: a stream with a ragged final batch causes
+        ZERO post-warmup retraces of the jitted step once bucket_by is
+        set — one signature for the whole run."""
+        from paddle_tpu.train_loop import TrainLoop
+
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            loop = TrainLoop(_make_trainer(), str(tmp_path),
+                             checkpoint_every=1000)
+            n = loop.run(_ragged_batches([32, 32, 32, 17]), resume=False,
+                         prefetch=2, bucket_by=[32])
+            assert n == 4
+            tr = telemetry.recompile.tracker()
+            assert tr.recompiles("train_loop.step") == 0
+            assert tr.stats()["train_loop.step"]["signatures"] == 1
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+    def test_prefetch_trains_and_batches_are_placed(self, tmp_path):
+        from paddle_tpu.train_loop import TrainLoop
+
+        loop = TrainLoop(_make_trainer(), str(tmp_path),
+                         checkpoint_every=1000)
+        n = loop.run(_ragged_batches([8, 8, 8]), resume=False, prefetch=2)
+        assert n == 3
+
+    def test_bucket_by_without_prefetch_stages_synchronously(self,
+                                                             tmp_path):
+        from paddle_tpu.train_loop import TrainLoop
+
+        loop = TrainLoop(_make_trainer(), str(tmp_path),
+                         checkpoint_every=1000)
+        n = loop.run(_ragged_batches([8, 5]), resume=False,
+                     bucket_by="pow2")
+        assert n == 2
+        assert _wait_no_pt_threads()  # no thread was ever started
+
+
+class TestExecutorFeedBuckets:
+    def _prog(self):
+        import paddle_tpu.static as static
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = prog.data("x", (-1, 8))
+            label = prog.data("label", (-1,), "int32")
+            h = static.layers.fc(x, 16, act="relu")
+            logits = static.layers.fc(h, 4)
+            loss = static.layers.mean(
+                static.layers.softmax_with_cross_entropy(logits, label))
+        return prog, loss, logits
+
+    def _feed(self, bs, seed=0):
+        rng = np.random.default_rng(seed)
+        return {"x": rng.normal(size=(bs, 8)).astype(np.float32),
+                "label": rng.integers(0, 4, bs).astype(np.int32)}
+
+    def test_ragged_feed_reuses_cached_step(self):
+        import paddle_tpu.static as static
+
+        prog, loss, _ = self._prog()
+        exe = static.Executor(scope=static.Scope(),
+                              feed_buckets=[16])
+        out16, = exe.run(prog, feed=self._feed(16), fetch_list=[loss])
+        out13, = exe.run(prog, feed=self._feed(13), fetch_list=[loss])
+        assert len(exe._cache) == 1  # the ragged batch hit the cache
+        assert np.isfinite(out16).all() and np.isfinite(out13).all()
+
+    def test_without_buckets_ragged_feed_recompiles(self):
+        import paddle_tpu.static as static
+
+        prog, loss, _ = self._prog()
+        exe = static.Executor(scope=static.Scope())
+        exe.run(prog, feed=self._feed(16), fetch_list=[loss])
+        exe.run(prog, feed=self._feed(13), fetch_list=[loss])
+        assert len(exe._cache) == 2  # one executable per ragged shape
+
+    def test_fetch_carries_padded_rows(self):
+        import paddle_tpu.static as static
+
+        prog, _, logits = self._prog()
+        exe = static.Executor(scope=static.Scope()).set_feed_buckets([16])
+        # fetching a row-wise output: the padded batch dim rides through
+        # (the documented contract — slice back to the real rows)
+        out, = exe.run(prog, feed=self._feed(13), fetch_list=[logits])
+        assert out.shape == (16, 4)
+
+    def test_fixed_shape_feed_not_padded(self):
+        """Only batch-polymorphic feeds (declared leading dim -1) are
+        bucket-padded; a fixed-shape aux feed must reach the program
+        exactly or its math is silently corrupted."""
+        import paddle_tpu.static as static
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = prog.data("x", (-1, 8))
+            w = prog.data("w", (10,))
+            h = static.layers.fc(x, 10)
+            out = static.layers.mean(h + w)
+        exe = static.Executor(scope=static.Scope(), feed_buckets=[16])
+        rng = np.random.default_rng(0)
+        wv = np.linspace(1.0, 2.0, 10).astype(np.float32)
+        for bs in (16, 13):  # ragged second run: x padded, w untouched
+            val, = exe.run(prog, feed={
+                "x": rng.normal(size=(bs, 8)).astype(np.float32),
+                "w": wv}, fetch_list=[out])
+            assert np.isfinite(val).all()
+        assert len(exe._cache) == 1
+
+    def test_lod_length_feed_pads_with_zero(self):
+        """Fabricated rows must carry sequence length 0 — never the
+        data feed_pad_value — or sequence ops sum fake timesteps."""
+        import paddle_tpu.static as static
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            src = prog.data("src", (-1, 1), "int32", lod_level=1)
+            total = static.layers.reduce_sum(prog.vars["src@LEN"])
+        exe = static.Executor(scope=static.Scope(),
+                              feed_buckets=[8], feed_pad_value=7)
+        lens = np.array([3, 2, 4], np.int32)  # 3 rows -> padded to 8
+        out, = exe.run(prog, feed={
+            "src": np.zeros((3, 4, 1), np.int32), "src@LEN": lens},
+            fetch_list=[total])
+        # data var pads with 7 (documented); @LEN tail must stay 0
+        assert int(out) == int(lens.sum())
+
+    def test_set_feed_buckets_none_disables(self):
+        import paddle_tpu.static as static
+
+        prog, loss, _ = self._prog()
+        exe = static.Executor(scope=static.Scope(), feed_buckets=[16])
+        exe.set_feed_buckets(None)
+        exe.run(prog, feed=self._feed(13), fetch_list=[loss])
+        assert len(exe._cache) == 1  # compiled at the exact 13-row shape
