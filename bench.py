@@ -1335,7 +1335,7 @@ def _emit_error(metric: str, msg: str) -> None:
     print(json.dumps({"metric": metric, "value": 0.0,
                       "unit": "examples/sec", "vs_baseline": 0.0,
                       "backend": None, "mfu": None, "step_time_ms": None,
-                      "error": msg}))
+                      "peak_mem_bytes": None, "error": msg}))
 
 
 def _emit_skip(metric: str, msg: str) -> None:
@@ -1344,7 +1344,8 @@ def _emit_skip(metric: str, msg: str) -> None:
     unsupported). Emits ``"skipped": true`` with the error and NO value
     key — a 0.0 row here would read as a real measurement and drag
     BENCH_HISTORY trend plots to zero."""
-    print(json.dumps({"metric": metric, "skipped": True, "error": msg}))
+    print(json.dumps({"metric": metric, "skipped": True,
+                      "peak_mem_bytes": None, "error": msg}))
 
 
 def main():
@@ -1788,6 +1789,16 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
             # fenced wall time per step/dispatch — the denominator the
             # mfu field divides FLOPs by; None when a bench predates it
             "step_time_ms": extras.get("step_time_ms")}
+    # device-memory high-water mark of the run (telemetry.diag monitor):
+    # null where the backend has no memory_stats() (CPU) — the
+    # live-array fallback is an allocation view, never a peak, and must
+    # not masquerade as one in recorded numbers
+    try:
+        from paddle_tpu.telemetry.diag import peak_memory_bytes
+
+        line["peak_mem_bytes"] = peak_memory_bytes()
+    except Exception:
+        line["peak_mem_bytes"] = None
     # MFU: model FLOP/s (XLA cost model over the lowered step) / chip peak.
     # Reported only when both sides are known (never on CPU).
     from paddle_tpu.utils.flops import mfu as _mfu

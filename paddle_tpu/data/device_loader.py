@@ -70,6 +70,10 @@ def _input_metrics(reg):
             "array leaves (wasted compute bought for compile reuse)"),
         "batches": reg.counter(
             "pt_input_batches_total", "batches staged onto device"),
+        "depth": reg.gauge(
+            "pt_input_prefetch_depth",
+            "current prefetch staging capacity (auto sizing grows it "
+            "while host-wait p50 exceeds threshold)"),
     }
 
 
@@ -199,6 +203,15 @@ class DevicePrefetcher:
     ``size`` >= 1 enables the background staging thread with that many
     queue slots (2 = double buffering, 3 = triple); ``size=0`` stages
     synchronously in the consumer thread (bucketing without prefetch).
+    ``size="auto"`` starts at depth 2 and GROWS the staging capacity by
+    one (up to ``auto_cap``) whenever the p50 of the last
+    ``AUTO_WINDOW`` host waits exceeds ``auto_threshold_s`` — the
+    ``pt_input_host_wait_seconds`` signal fed back into the knob it
+    measures (ROADMAP's auto-sized prefetch depth). Depth never
+    shrinks: a deeper queue only costs idle slots once the producer
+    keeps up, while thrashing the depth down would re-starve a bursty
+    consumer. ``current_depth`` is the live value (/statusz shows it;
+    ``pt_input_prefetch_depth`` gauges it).
     Abandoning the iterator mid-stream (``break``) releases the worker —
     no leaked thread, no device batches pinned for the process lifetime;
     a worker exception re-raises in the consumer.
@@ -214,15 +227,44 @@ class DevicePrefetcher:
 
     _END = object()
 
+    AUTO_INITIAL = 2   # "auto" starting depth (double buffering)
+    AUTO_CAP = 8       # default growth ceiling
+    AUTO_WINDOW = 8    # host waits per growth decision
+    AUTO_THRESHOLD_S = 1e-3  # p50 wait above this = input-bound
+
     def __init__(self, batches: Union[Callable[[], Iterator[Any]],
                                       Iterable[Any]],
-                 *, size: int = 2, mesh=None, sharding=None,
+                 *, size: Union[int, str] = 2, mesh=None, sharding=None,
                  transform: Optional[Callable] = None,
                  bucket_by=None, pad_value=0, axis: int = 0,
-                 donate_safe: bool = True):
-        enforce(size >= 0, "prefetch size must be >= 0, got %s", size)
+                 donate_safe: bool = True,
+                 auto_cap: Optional[int] = None,
+                 auto_threshold_s: Optional[float] = None):
+        self.auto = size == "auto"
+        if self.auto:
+            self.auto_cap = int(auto_cap if auto_cap is not None
+                                else self.AUTO_CAP)
+            size = min(self.AUTO_INITIAL, self.auto_cap)
+            enforce(self.auto_cap >= 1,
+                    "auto_cap must be >= 1, got %s", self.auto_cap)
+        else:
+            enforce(auto_cap is None and auto_threshold_s is None,
+                    "auto_cap/auto_threshold_s only apply to "
+                    "size='auto'")
+            enforce(not isinstance(size, str),
+                    "prefetch size must be an int or 'auto', got %r",
+                    size)
+            size = int(size)
+            enforce(size >= 0, "prefetch size must be >= 0, got %s",
+                    size)
+            self.auto_cap = size
+        self.auto_threshold_s = float(
+            auto_threshold_s if auto_threshold_s is not None
+            else self.AUTO_THRESHOLD_S)
         self.batches = batches
         self.size = int(size)
+        self._depth = self.size  # live capacity (auto mode grows it)
+        self.last_queue_depth: Optional[int] = None
         if sharding is None and mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -285,15 +327,48 @@ class DevicePrefetcher:
 
     # -- iteration (consumer side) ------------------------------------------
 
+    @property
+    def current_depth(self) -> int:
+        """Live staging capacity (== ``size`` unless auto mode grew
+        it)."""
+        return self._depth
+
+    def _maybe_grow(self, q: "queue.Queue", waits: list) -> None:
+        """Auto sizing: one growth decision per full wait window. The
+        p50 (not mean — a single slow batch must not grow the queue)
+        above threshold means the consumer is input-bound; a deeper
+        queue buys the worker more run-ahead."""
+        if len(waits) < self.AUTO_WINDOW or self._depth >= self.auto_cap:
+            return
+        p50 = sorted(waits)[len(waits) // 2]
+        waits.clear()  # fresh window either way (no double counting)
+        if p50 <= self.auto_threshold_s:
+            return
+        self._depth += 1
+        with q.mutex:
+            # stdlib Queue reads maxsize dynamically under its mutex;
+            # wake a producer blocked on the OLD bound
+            q.maxsize = self._depth
+            q.not_full.notify()
+        if telemetry.enabled():
+            _input_metrics()["depth"].set(self._depth)
+
     def __iter__(self):
         if self.size == 0:
             for item in self._source():
                 staged, rows = self._stage(item)
                 self.last_real_rows = rows
+                self.last_queue_depth = 0
                 yield staged
             return
 
-        q: queue.Queue = queue.Queue(maxsize=self.size)
+        if telemetry.enabled():
+            # export the starting capacity too — a healthy auto
+            # pipeline that never grows must still be distinguishable
+            # from no prefetcher at all
+            _input_metrics()["depth"].set(self._depth)
+        q: queue.Queue = queue.Queue(maxsize=self._depth)
+        waits: list = []
         err = []
         stop = threading.Event()
 
@@ -314,18 +389,31 @@ class DevicePrefetcher:
         try:
             while True:
                 telem = telemetry.enabled()
-                if telem:
+                # auto mode needs the wait signal with telemetry off
+                # too — its feedback loop must not depend on metrics
+                # being scraped
+                if telem or self.auto:
                     t0 = time.perf_counter()
                 item = q.get()
-                if telem:
-                    met = _input_metrics()
-                    if item is not self._END:
-                        met["host_wait"].observe(time.perf_counter() - t0)
-                    met["queue_depth"].set(q.qsize())
+                if telem or self.auto:
+                    wait = time.perf_counter() - t0
+                    if telem:
+                        met = _input_metrics()
+                        if item is not self._END:
+                            met["host_wait"].observe(wait)
+                        met["queue_depth"].set(q.qsize())
+                    if (self.auto and item is not self._END
+                            and self._depth < self.auto_cap):
+                        # at the cap the window stops accumulating —
+                        # nothing reads it again, and a long run must
+                        # not grow the list one float per batch forever
+                        waits.append(wait)
+                        self._maybe_grow(q, waits)
                 if item is self._END:
                     break
                 staged, rows = item
                 self.last_real_rows = rows
+                self.last_queue_depth = q.qsize()
                 yield staged
         finally:
             # consumer abandoned mid-stream (break/exception): release

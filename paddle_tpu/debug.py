@@ -59,7 +59,11 @@ def program_to_dot(program: Program, graph_name: str = "program") -> str:
                  else node.name)
         lines.append(f'  "op_{i}" [label="{label}", shape=box, '
                      f'style=filled, fillcolor=lightgray];')
-        for inp in node.inputs:
+        # _GradNode carries no .inputs — its dataflow sources are the
+        # loss it differentiates and the params it differentiates w.r.t.
+        inputs = ([node.loss_name] + list(node.param_names)
+                  if isinstance(node, _GradNode) else node.inputs)
+        for inp in inputs:
             var_node(inp)
             lines.append(f'  "v_{inp}" -> "op_{i}";')
         for out in node.outputs:

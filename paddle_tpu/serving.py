@@ -38,7 +38,7 @@ capability).
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +51,7 @@ from .nn.layer import inject_state
 from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
 from .telemetry import recompile as _recompile
+from .telemetry import server as _dbg_server
 
 
 @telemetry.cached_instruments
@@ -403,6 +404,9 @@ class BatchedDecoder:
         # _pf_order is admission-FIFO so ticks are fair
         self._pf: List[Optional[dict]] = [None] * slots
         self._pf_order: List[int] = []
+        self.debug_server = None  # last run(debug_port=)'s server
+        # (live during that run; kept stopped afterwards for port/
+        # status inspection)
 
     # ----- host API --------------------------------------------------------
 
@@ -431,11 +435,34 @@ class BatchedDecoder:
         if telemetry.enabled():
             r.t_submit = time.perf_counter()
             _serving_metrics()["requests"].inc()
+            # /healthz last-request age (owner-scoped while run() has
+            # our server up; submits outside a live run broadcast — a
+            # stopped server kept for post-run inspection must not
+            # swallow the heartbeat)
+            srv = self.debug_server
+            if srv is not None and srv.running:
+                srv.note("request")
+            else:
+                _dbg_server.note("request")
         self.queue.append(r)
         return r.rid
 
-    def run(self) -> Dict[int, np.ndarray]:
-        """Drive until every submitted request completes."""
+    def run(self, debug_port: Optional[int] = None,
+            flight_recorder=None) -> Dict[int, np.ndarray]:
+        """Drive until every submitted request completes.
+
+        Live diagnostics (opt-in): ``debug_port=P`` serves the debug
+        endpoints (/metrics /healthz /statusz /tracez /memz) on
+        127.0.0.1:P for the duration of the drive (0 = ephemeral;
+        ``self.debug_server`` holds the running server; starting it
+        enables telemetry; the thread is joined before run() returns).
+        ``flight_recorder=`` records one entry per serving tick
+        (tick wall time, queue depth, active slots) into a
+        :class:`telemetry.diag.FlightRecorder` — its ``step_stall``
+        watch catches a wedged arena; policy ``halt`` raises
+        :class:`telemetry.diag.AnomalyHalt`, ``skip_step`` downgrades to ``record``
+        (a serving tick is not an optimizer update; there is nothing
+        to roll back). Only consulted while telemetry is enabled."""
         # refresh the weight snapshot: the jitted fns take weights as
         # REAL arguments, so post-construction mutation of the model
         # (quant.apply_weight_only_int8, a LoRA merge, a hot-swapped
@@ -455,20 +482,77 @@ class BatchedDecoder:
             self._weights_fp = _recompile.Opaque(hash(
                 telemetry.fingerprint(
                     (self._mstate, getattr(self, "_dstate", None)))))
-        while self.queue or self._pf_order or self.active.any():
-            if telemetry.enabled():
-                m = _serving_metrics()
-                m["queue_depth"].set(len(self.queue))
-                if self.paged:
-                    al = self._allocator
-                    m["page_occupancy"].set(
-                        (al.pages - al.free_pages) / al.pages)
-            self._admit()
-            self._prefill_tick()
-            self._step()
+        self.debug_server = None
+        if debug_port is not None:
+            self.debug_server = _dbg_server.DebugServer(
+                port=debug_port, owned=True,
+                run_config={"role": "serving", "slots": self.slots,
+                            "capacity": self.capacity,
+                            "paged": self.paged,
+                            "spec": self.draft is not None,
+                            "decode_steps": self.decode_steps}).start()
+            self.debug_server.add_status("serving", self._statusz)
+            if self.queue or self._pf_order or self.active.any():
+                # requests submitted before the server came up: seed the
+                # last-request clock now (a lower bound on the true age)
+                self.debug_server.note("request")
+        tick = 0
+        try:
+            while self.queue or self._pf_order or self.active.any():
+                telem = telemetry.enabled()
+                if telem:
+                    m = _serving_metrics()
+                    m["queue_depth"].set(len(self.queue))
+                    if self.paged:
+                        al = self._allocator
+                        m["page_occupancy"].set(
+                            (al.pages - al.free_pages) / al.pages)
+                    t_tick = time.perf_counter()
+                self._admit()
+                self._prefill_tick()
+                self._step()
+                if telem:
+                    tick += 1
+                    # stamp OUR server when we own one (owner-scoped
+                    # heartbeat — see telemetry.server.note)
+                    if self.debug_server is not None:
+                        self.debug_server.note("step")
+                    else:
+                        _dbg_server.note("step")
+                    if flight_recorder is not None:
+                        action = flight_recorder.record_step(
+                            tick,
+                            step_time=time.perf_counter() - t_tick,
+                            queue_depth=len(self.queue),
+                            active_slots=int(self.active.sum()))
+                        if action == "halt":
+                            raise flight_recorder.halt_error(
+                                f"serving tick {tick}")
+        finally:
+            if self.debug_server is not None:
+                self.debug_server.stop()
         out = {rid: r.result for rid, r in self.done.items()}
         self.done = {}
         return out
+
+    def _statusz(self) -> Dict[str, Any]:
+        """Arena view for /statusz (host-side fields only — reading it
+        mid-tick may tear across fields, fine for monitoring)."""
+        st = {"slots": self.slots, "capacity": self.capacity,
+              "active_slots": int(self.active.sum()),
+              "queue_depth": len(self.queue),
+              "completed": len(self.done),
+              "prefilling": len(self._pf_order)}
+        if self.paged:
+            al = self._allocator
+            st["pages"] = al.pages
+            st["free_pages"] = al.free_pages
+            if self.prefix_cache:
+                st["prefix_hits"] = self.prefix_hits
+        if self.draft is not None:
+            st["spec_rounds"] = self.spec_rounds
+            st["spec_accepted"] = self.spec_accepted
+        return st
 
     # ----- internals -------------------------------------------------------
 

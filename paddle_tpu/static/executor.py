@@ -27,6 +27,7 @@ import numpy as np
 
 from .. import telemetry
 from ..core.enforce import enforce
+from ..telemetry import server as _dbg_server
 from .program import GRAD_SUFFIX, Program, Var, _GradNode, _OpNode
 
 
@@ -184,8 +185,25 @@ class Executor:
         self._prune_cache: Dict[Tuple, Tuple] = {}
         self._feed_padder = None
         self._len_padder = None
+        self._flight_recorder = None
+        self._run_count = 0
         if feed_buckets is not None:
             self.set_feed_buckets(feed_buckets, feed_pad_value)
+
+    def attach_flight_recorder(self, recorder) -> "Executor":
+        """Record every ``run()`` (wall time + the first scalar fetch as
+        the loss signal) into a :class:`telemetry.diag.FlightRecorder`
+        while telemetry is enabled. The loss signal REQUIRES
+        ``return_numpy=True`` (the default) and a size-1 value in
+        ``fetch_list`` — without one the nan watch has nothing to watch
+        and only the step-time stall check is live (fetch your loss if
+        you want NaN detection). Policy on anomaly: ``halt`` raises
+        :class:`telemetry.diag.AnomalyHalt`; ``skip_step`` downgrades to ``record`` —
+        the jitted step donated the old scope state, so there is no
+        pre-update state left to roll back to (the dump bundle is the
+        value here). ``None`` detaches."""
+        self._flight_recorder = recorder
+        return self
 
     def set_feed_buckets(self, buckets, pad_value=0) -> "Executor":
         """Pad batch-polymorphic feeds (``data()`` vars declared with
@@ -377,8 +395,24 @@ class Executor:
         if telem:
             # with return_numpy the conversion above fenced the
             # dispatch; device-array fetches record dispatch latency
-            _exec_metrics()["run_time"].observe(
-                time.perf_counter() - t_run0)
+            dt_run = time.perf_counter() - t_run0
+            _exec_metrics()["run_time"].observe(dt_run)
+            _dbg_server.note("step")  # /healthz last-step age
+            self._run_count += 1
+            if self._flight_recorder is not None:
+                # loss signal: the first scalar fetch, and only off the
+                # already-fenced numpy copies (a device_get here would
+                # add a sync the caller didn't ask for)
+                loss_val = None
+                if return_numpy:
+                    loss_val = next(
+                        (float(v.reshape(())) for v in fetched
+                         if getattr(v, "size", 0) == 1), None)
+                action = self._flight_recorder.record_step(
+                    self._run_count, loss=loss_val, step_time=dt_run)
+                if action == "halt":
+                    raise self._flight_recorder.halt_error(
+                        f"executor run {self._run_count}")
         return fetched
 
     def close(self):

@@ -3,7 +3,7 @@ instrumentation.
 
 The observability layer the north-star serving system needs (per-request
 latency, throughput, recompile telemetry) and the reference only hinted
-at with its profiler (SURVEY §5.1). Four pieces:
+at with its profiler (SURVEY §5.1). The pieces:
 
 - ``metrics``: process-global :class:`MetricsRegistry` with typed
   :class:`Counter` / :class:`Gauge` / :class:`Histogram` (fixed
@@ -13,7 +13,14 @@ at with its profiler (SURVEY §5.1). Four pieces:
   preserved, plus a structured JSONL event log.
 - ``recompile``: jitted-call signature fingerprinting — counts trace
   cache misses per call-site (the #1 silent TPU perf killer).
-- ``export``: Prometheus text format + ``summary()`` human table.
+- ``export``: Prometheus text format + ``summary()`` human table +
+  atomic ``write_textfile`` for node-exporter's textfile collector.
+- ``server``: debug HTTP endpoint on a daemon thread (/metrics /healthz
+  /statusz /tracez /memz) — opt-in via ``TrainLoop.run(debug_port=)``,
+  ``serving.BatchedDecoder.run(debug_port=)``, or ``server.start()``.
+- ``diag``: device-memory monitor + :class:`FlightRecorder` (ring of
+  recent steps, anomaly watch, atomic dump-on-anomaly bundles with a
+  record/skip_step/halt policy).
 
 Everything is OFF by default and zero-cost when off: instrumented
 call-sites check :func:`enabled` (one module-global bool) before any
@@ -31,23 +38,28 @@ Usage::
 
 from __future__ import annotations
 
-from . import export, metrics, recompile, trace
-from .export import prometheus_text, summary
+from . import diag, export, metrics, recompile, server, trace
+from .diag import (AnomalyHalt, FlightRecorder, device_memory,
+                   peak_memory_bytes)
+from .export import prometheus_text, summary, write_textfile
 from .metrics import (Counter, DEFAULT_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, cached_instruments, disable,
                       enable, enabled, log_buckets, registry)
 from .recompile import RecompileTracker, fingerprint
+from .server import DebugServer
 from .trace import (RecordEvent, Span, export_chrome_trace, export_jsonl,
                     span)
 
 __all__ = [
-    "Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
+    "AnomalyHalt", "Counter", "DEFAULT_BUCKETS", "DebugServer",
+    "FlightRecorder", "Gauge", "Histogram",
     "MetricsRegistry", "RecompileTracker", "RecordEvent", "Span",
-    "cached_instruments",
+    "cached_instruments", "device_memory", "diag",
     "disable", "enable", "enabled", "export", "export_chrome_trace",
     "export_jsonl", "fingerprint", "log_buckets", "metrics",
-    "prometheus_text", "recompile", "registry", "reset", "span",
-    "summary", "trace",
+    "peak_memory_bytes",
+    "prometheus_text", "recompile", "registry", "reset", "server",
+    "span", "summary", "trace", "write_textfile",
 ]
 
 

@@ -1,0 +1,342 @@
+"""Training flight recorder + device-memory monitor.
+
+The post-mortem half of the live diagnostics plane (``server`` is the
+live half): when a training run NaNs or a serving process stalls, the
+evidence — the last N steps of loss/grad-norm/step-time, the metrics
+registry, the recompile report, device memory — is gone by the time
+anyone looks, unless something was recording it all along. TensorFlow's
+production story leans on exactly this always-on introspection layer
+(arXiv:1605.08695); the TPU serving comparison in arXiv:2605.25645
+treats live memory visibility as a precondition for operating at scale.
+
+Two pieces:
+
+- :func:`device_memory` / :func:`peak_memory_bytes`: per-device memory
+  stats where the backend provides ``memory_stats()`` (TPU/GPU PJRT
+  plugins do), with a guarded CPU fallback that aggregates live
+  ``jax.Array`` bytes per device (``jax.live_arrays()`` — an
+  *allocation* view, not an HBM accountant, and labeled as such).
+- :class:`FlightRecorder`: a ring buffer of the last N steps (loss,
+  grad-norm, loss scale, step time, input queue depth) plus an anomaly
+  watch — NaN/Inf loss or grad-norm, grad-norm spike vs the running
+  mean, step-time stall — that on trigger writes ONE JSON dump bundle
+  (recorder ring, full metrics snapshot, recompile report, device
+  memory, run config) using the same temp-file + ``os.replace``
+  discipline as the hardened compile cache (a dump that tears on a
+  SIGKILL is worse than no dump: it reads as evidence and lies), and
+  returns a configurable policy (``record`` / ``skip_step`` / ``halt``)
+  for the caller to apply.
+
+Like everything in ``paddle_tpu.telemetry``: off by default and
+zero-cost when off. Call-sites consult the recorder only behind the
+one ``telemetry.enabled()`` flag check, and the recorder itself only
+ever sees host-side Python scalars — never tracers, nothing inside jit.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+from . import recompile as _recompile
+from ._atomic import atomic_write_text
+
+POLICIES = ("record", "skip_step", "halt")
+
+
+class AnomalyHalt(RuntimeError):
+    """Raised by a caller applying the ``halt`` policy after a
+    FlightRecorder anomaly (the dump bundle is already on disk)."""
+
+
+# ---------------------------------------------------------------------------
+# device-memory monitor
+# ---------------------------------------------------------------------------
+
+def _live_bytes_by_device() -> Dict[int, int]:
+    """Live ``jax.Array`` bytes per device id (the CPU fallback view —
+    framework-visible allocations, not the backend's own accounting)."""
+    import jax
+
+    per: Dict[int, int] = {}
+    for a in jax.live_arrays():
+        try:
+            for sh in a.addressable_shards:
+                did = sh.device.id
+                per[did] = per.get(did, 0) + int(sh.data.nbytes)
+        except Exception:
+            # a deleted/donated array can race the walk; skip it rather
+            # than fail the whole scrape
+            continue
+    return per
+
+
+def device_memory() -> List[Dict[str, Any]]:
+    """Per-device memory report. Where the backend implements
+    ``memory_stats()`` (TPU/GPU PJRT) the entry carries it verbatim
+    under ``memory_stats``; otherwise ``live_array_bytes`` carries the
+    :func:`_live_bytes_by_device` fallback and ``memory_stats`` is
+    None, so a reader can always tell which accounting it is seeing."""
+    import jax
+
+    devices = jax.devices()
+    fallback: Optional[Dict[int, int]] = None
+    out = []
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        entry: Dict[str, Any] = {
+            "id": int(d.id),
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", None) or d.platform,
+            "memory_stats": ({k: int(v) for k, v in stats.items()}
+                             if stats else None),
+        }
+        if not stats:
+            if fallback is None:  # one live_arrays walk for all devices
+                fallback = _live_bytes_by_device()
+            entry["live_array_bytes"] = fallback.get(int(d.id), 0)
+        out.append(entry)
+    return out
+
+
+def peak_memory_bytes() -> Optional[int]:
+    """Max per-device ``peak_bytes_in_use`` from ``memory_stats()`` —
+    None when no device reports that key. STRICTLY the peak: neither
+    the live-array fallback nor an instantaneous ``bytes_in_use`` is a
+    high-water mark, and a scrape-time snapshot masquerading as one
+    would understate every transient spike freed before the scrape.
+    Reads ``memory_stats()`` directly (not via :func:`device_memory`)
+    so a stats-less backend costs one call per device, never the
+    live-array walk the fallback view pays."""
+    import jax
+
+    peak = None
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        v = (stats or {}).get("peak_bytes_in_use")
+        if v is None:
+            continue
+        peak = max(peak or 0, int(v))
+    return peak
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def _finite(v) -> Optional[float]:
+    """Host float or None; never raises (a recorder must not take the
+    training loop down over a weird scalar)."""
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+class FlightRecorder:
+    """Ring buffer of recent training steps + anomaly watch + dump.
+
+    ``record_step`` appends one host-scalar entry, runs the anomaly
+    checks, and on a trigger writes the dump bundle and returns the
+    configured policy string (``record`` / ``skip_step`` / ``halt``) for
+    the caller to apply; on a clean step it returns None. The recorder
+    never applies policy itself — skipping an optimizer step or halting
+    a run is the loop's business (and impossible from here).
+
+    Anomaly checks (host floats only):
+
+    - ``nan_loss`` / ``nan_grad_norm``: non-finite loss or grad norm.
+    - ``grad_spike``: grad-norm > ``grad_spike_factor`` x the running
+      mean of the previous grad norms, after ``warmup_steps`` samples.
+    - ``step_stall``: step time > ``stall_factor`` x the running mean
+      of the previous step times, after ``warmup_steps`` samples.
+
+    Dumps are rate-limited to ``max_dumps`` per recorder (a NaN that
+    repeats every step must not fill the disk with identical bundles);
+    anomalies are logged to ``anomalies`` (bounded to the most recent
+    ``MAX_ANOMALIES``; ``anomalies_total`` counts all). ``dump()`` can
+    also be called manually (reason="manual") — e.g. from a debugger or
+    an operator endpoint.
+    """
+
+    MAX_ANOMALIES = 1000  # kept records; anomalies_total counts beyond
+
+    def __init__(self, dump_dir: str = ".", *, capacity: int = 256,
+                 policy: str = "record", grad_spike_factor: float = 10.0,
+                 stall_factor: float = 10.0, warmup_steps: int = 20,
+                 max_dumps: int = 3,
+                 run_config: Optional[Dict[str, Any]] = None):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {policy!r}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.dump_dir = dump_dir
+        self.policy = policy
+        self.grad_spike_factor = float(grad_spike_factor)
+        self.stall_factor = float(stall_factor)
+        self.warmup_steps = int(warmup_steps)
+        self.max_dumps = int(max_dumps)
+        self.run_config: Dict[str, Any] = dict(run_config or {})
+        self.ring: deque = deque(maxlen=int(capacity))
+        self.anomalies: List[Dict[str, Any]] = []
+        self.anomalies_total = 0
+        self.dumps: List[str] = []
+        # running means over every FINITE sample — flagged spikes
+        # included, so a regime change converges instead of flagging
+        # forever (see record_step); non-finite values never enter
+        self._gn_sum = 0.0
+        self._gn_n = 0
+        self._dt_sum = 0.0
+        self._dt_n = 0
+
+    # -- recording ----------------------------------------------------------
+
+    def record_step(self, step: int, *, loss=None, grad_norm=None,
+                    loss_scale=None, step_time=None, queue_depth=None,
+                    **extra) -> Optional[str]:
+        """Record one step; returns the policy string on anomaly, else
+        None. All values must already be host scalars — fetch/fence
+        BEFORE calling (the recorder never touches device buffers)."""
+        entry: Dict[str, Any] = {"step": int(step), "ts": time.time()}
+        loss = _finite(loss)
+        grad_norm = _finite(grad_norm)
+        step_time = _finite(step_time)
+        if loss is not None:
+            entry["loss"] = loss
+        if grad_norm is not None:
+            entry["grad_norm"] = grad_norm
+        if loss_scale is not None:
+            entry["loss_scale"] = _finite(loss_scale)
+        if step_time is not None:
+            entry["step_time_s"] = step_time
+        if queue_depth is not None:
+            entry["queue_depth"] = int(queue_depth)
+        for k, v in extra.items():
+            entry[k] = _finite(v) if isinstance(v, (int, float)) else v
+        anomaly = self._detect(loss, grad_norm, step_time)
+        if anomaly:
+            entry["anomaly"] = anomaly
+        self.ring.append(entry)
+        # FINITE samples feed the running baselines — including flagged
+        # spikes/stalls: a genuine regime change (post-warmup LR bump,
+        # slower phase of the schedule) then flags a bounded number of
+        # times while the mean catches up, instead of flagging every
+        # step forever against a frozen baseline. Non-finite values
+        # never enter (one NaN would poison the mean for good).
+        if grad_norm is not None and math.isfinite(grad_norm):
+            self._gn_sum += grad_norm
+            self._gn_n += 1
+        if step_time is not None and math.isfinite(step_time):
+            self._dt_sum += step_time
+            self._dt_n += 1
+        if anomaly is None:
+            return None
+        record = {"step": int(step), "kind": anomaly, "ts": entry["ts"],
+                  "policy": self.policy}
+        self.anomalies_total += 1
+        if len(self.anomalies) >= self.MAX_ANOMALIES:
+            # bounded log: a run flagging every step must not grow one
+            # dict per step for a million steps (anomalies_total still
+            # counts them all)
+            self.anomalies.pop(0)
+        self.anomalies.append(record)
+        if len(self.dumps) < self.max_dumps:
+            try:
+                record["dump"] = self.dump(reason=anomaly)
+            except Exception as e:
+                # the recorder observes the run, it must never kill it:
+                # a full disk / unwritable dump_dir degrades to a noted
+                # failure, and the policy still applies
+                record["dump_error"] = repr(e)
+        return self.policy
+
+    def halt_error(self, context: str) -> AnomalyHalt:
+        """The exception a caller applying the ``halt`` policy raises —
+        one construction shared by every wired loop, naming the anomaly
+        and THIS anomaly's dump fate (a rate-limited or failed dump
+        must not cite an earlier anomaly's bundle as its evidence)."""
+        last = self.anomalies[-1] if self.anomalies else {}
+        if "dump" in last:
+            where = f"(dump: {last['dump']})"
+        elif "dump_error" in last:
+            where = f"(dump failed: {last['dump_error']})"
+        else:
+            where = "(no dump: rate-limited)"
+        return AnomalyHalt(
+            f"flight recorder halt at {context}: {last.get('kind')} "
+            f"{where}")
+
+    def _detect(self, loss, grad_norm, step_time) -> Optional[str]:
+        if loss is not None and not math.isfinite(loss):
+            return "nan_loss"
+        if grad_norm is not None and not math.isfinite(grad_norm):
+            return "nan_grad_norm"
+        if (grad_norm is not None and self._gn_n >= self.warmup_steps
+                and self._gn_sum > 0
+                and grad_norm > self.grad_spike_factor
+                * (self._gn_sum / self._gn_n)):
+            return "grad_spike"
+        if (step_time is not None and self._dt_n >= self.warmup_steps
+                and self._dt_sum > 0
+                and step_time > self.stall_factor
+                * (self._dt_sum / self._dt_n)):
+            return "step_stall"
+        return None
+
+    # -- dumping ------------------------------------------------------------
+
+    def bundle(self, reason: str = "manual") -> Dict[str, Any]:
+        """The dump payload as a dict (everything an on-call needs in
+        one file): recorder ring, metrics snapshot, recompile report,
+        device memory, run config, anomaly log."""
+        try:
+            mem = device_memory()
+        except Exception as e:  # a wedged backend must not kill the dump
+            mem = [{"error": repr(e)}]
+        return {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "last_step": (self.ring[-1]["step"] if self.ring else None),
+            "run_config": self.run_config,
+            "ring": list(self.ring),
+            "anomalies": list(self.anomalies),
+            "anomalies_total": self.anomalies_total,
+            "metrics": _metrics.registry().snapshot(),
+            "recompile": _recompile.tracker().stats(),
+            "device_memory": mem,
+        }
+
+    def dump(self, reason: str = "manual") -> str:
+        """Write the bundle to ``dump_dir`` atomically (same-dir temp
+        file + ``os.replace`` — the compile-cache torn-write discipline:
+        a reader either sees a complete bundle or no file). Returns the
+        final path."""
+        os.makedirs(self.dump_dir, exist_ok=True)
+        step = self.ring[-1]["step"] if self.ring else 0
+        path = os.path.join(
+            self.dump_dir,
+            f"pt_flight_{reason}_step{step}_pid{os.getpid()}"
+            f"_{len(self.dumps)}.json")
+        # histogram snapshots carry tuples and +/-inf; default=str
+        # keeps any exotic run_config value from killing the dump
+        atomic_write_text(path, json.dumps(self.bundle(reason),
+                                           default=str),
+                          prefix=".pt_flight_")
+        self.dumps.append(path)
+        return path

@@ -17,6 +17,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+from ._atomic import atomic_write_text
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       registry as _registry)
 
@@ -68,6 +69,20 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
             out.append(f"{m.name}_sum{suffix} {repr(snap['sum'])}")
             out.append(f"{m.name}_count{suffix} {snap['count']}")
     return "\n".join(out) + ("\n" if out else "")
+
+
+def write_textfile(path: str,
+                   reg: Optional[MetricsRegistry] = None) -> str:
+    """Write the Prometheus exposition to ``path`` ATOMICALLY — the
+    node-exporter textfile-collector contract. The collector re-reads
+    the file on its own schedule, so a plain ``open(...).write`` races
+    it: a scrape landing mid-write reads a torn exposition (the same
+    torn-write hazard ROADMAP documents for the compile cache — here it
+    surfaces as phantom counter resets, not segfaults). Same-dir temp
+    file + ``os.replace`` makes every read all-or-nothing. Returns
+    ``path``."""
+    return atomic_write_text(path, prometheus_text(reg),
+                             prefix=".pt_metrics_")
 
 
 def summary(reg: Optional[MetricsRegistry] = None) -> str:

@@ -1,0 +1,293 @@
+"""Debug HTTP server — the live half of the diagnostics plane.
+
+A stdlib-``http.server`` endpoint on a daemon thread serving the
+telemetry the rest of the package already collects (nothing here adds
+measurement cost; it only exposes what the instruments hold):
+
+- ``/metrics``  Prometheus exposition (``export.prometheus_text``) —
+  point a scraper at it.
+- ``/healthz``  liveness JSON: uptime plus the age of the last training
+  step / serving request heartbeat (``note()``).
+- ``/statusz``  backend + device inventory, uptime, telemetry state,
+  the recompile-tracker report, and any status providers the owning
+  loop attached (``add_status`` — e.g. the input pipeline's live
+  prefetch depth).
+- ``/tracez``   ring of recent completed spans as JSON (populated while
+  ``trace.start_profiler()`` collection is on).
+- ``/memz``     per-device memory (``diag.device_memory``): backend
+  ``memory_stats()`` where available, live-array fallback elsewhere.
+
+Started opt-in from ``TrainLoop.run(debug_port=...)`` and
+``serving.BatchedDecoder.run(debug_port=...)`` (or standalone via
+:func:`start`); ``port=0`` binds an ephemeral port (``srv.port`` tells
+you which). Binds 127.0.0.1 by default — this is an operator debug
+plane, not a public API; put a real proxy in front for anything else.
+
+``start()`` ENABLES telemetry process-wide: opting into the debug port
+is opting into the instrumentation it serves (a metrics endpoint over a
+disabled registry would scrape empty forever and read as "all quiet").
+With no server started, the module is inert: the ``note()`` heartbeat
+hook instrumented call-sites invoke is one empty-list check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from . import metrics as _metrics
+from . import recompile as _recompile
+from . import trace as _trace
+
+TRACEZ_SPANS = 256  # /tracez shows at most this many most-recent spans
+
+_ACTIVE: List["DebugServer"] = []
+
+
+def active() -> List["DebugServer"]:
+    """Servers currently running in this process."""
+    return list(_ACTIVE)
+
+
+def note(kind: str = "step") -> None:
+    """BROADCAST heartbeat for call-sites that don't own a server (the
+    static Executor; anything running next to a standalone
+    ``server.start()``): stamps every running server's
+    ``last_<kind>_age_s`` clock — except loop-OWNED servers
+    (``owned=True``: the ``TrainLoop``/``BatchedDecoder`` debug_port
+    servers), which only their owning loop stamps via ``srv.note``;
+    skipping them here means a co-resident Executor or second loop can
+    never mask an owned loop's stall on its own /healthz. One list
+    check when no server runs — safe on hot paths that already passed
+    the enabled-flag gate."""
+    if not _ACTIVE:
+        return
+    now = time.monotonic()
+    for s in list(_ACTIVE):
+        if not s.owned:
+            s._last[kind] = now
+
+
+class DebugServer:
+    """One debug endpoint bound to ``host:port`` (port 0 = ephemeral).
+
+    ``start()`` binds, spawns the daemon serving thread, registers the
+    server for :func:`note` heartbeats, and enables telemetry;
+    ``stop()`` shuts the listener down and JOINS the thread — callers
+    that started a server own its shutdown (the reader-hygiene standard:
+    no leaked daemon threads after ``run()`` returns)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 run_config: Optional[Dict[str, Any]] = None,
+                 owned: bool = False):
+        self.host = host
+        self._want_port = int(port)
+        # owned=True (the TrainLoop/BatchedDecoder debug_port servers):
+        # only the owning loop stamps this server's heartbeats —
+        # broadcast note() skips it, so a co-resident Executor or
+        # second loop can never mask this loop's stall on /healthz
+        self.owned = owned
+        self.run_config: Dict[str, Any] = dict(run_config or {})
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._bound_port: Optional[int] = None
+        self._t0 = 0.0
+        self._last: Dict[str, float] = {}
+        self._status: Dict[str, Callable[[], Any]] = {}
+
+    # -- wiring -------------------------------------------------------------
+
+    def note(self, kind: str = "step") -> None:
+        """Stamp THIS server's ``last_<kind>_age_s`` clock (the owning
+        loop's heartbeat; module-level :func:`note` broadcasts)."""
+        self._last[kind] = time.monotonic()
+
+    def add_status(self, name: str, provider: Callable[[], Any]) -> None:
+        """Attach a zero-arg callable whose return value is embedded in
+        /statusz under ``status[name]`` (evaluated per scrape; failures
+        render as an error string, never a 500)."""
+        self._status[name] = provider
+
+    @property
+    def port(self) -> int:
+        """The bound port — survives stop() so a caller that kept the
+        server object can still report which port it served."""
+        return self._bound_port or self._want_port
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def url(self, path: str = "/") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def start(self) -> "DebugServer":
+        if self._httpd is not None:
+            return self
+        handler = _make_handler(self)
+        # bind FIRST: a taken port must fail without flipping the
+        # process-wide telemetry switch on for a server that never ran
+        self._httpd = ThreadingHTTPServer((self.host, self._want_port),
+                                          handler)
+        try:
+            self._bound_port = self._httpd.server_address[1]
+            self._httpd.daemon_threads = True
+            self._t0 = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                daemon=True, name="pt-debug-server")
+            self._thread.start()
+        except BaseException:
+            # a failed thread spawn must not strand the bound socket
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+            raise
+        # only once the server is actually serving: a start() that
+        # failed anywhere above leaves the process-wide switch untouched
+        _metrics.enable()  # the port IS the telemetry opt-in (docstring)
+        _ACTIVE.append(self)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        try:
+            _ACTIVE.remove(self)
+        except ValueError:
+            pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "DebugServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- endpoint payloads (run on handler threads) -------------------------
+
+    def _age(self, kind: str) -> Optional[float]:
+        t = self._last.get(kind)
+        return None if t is None else round(time.monotonic() - t, 3)
+
+    def healthz(self) -> Dict[str, Any]:
+        return {
+            "status": "ok",
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "last_step_age_s": self._age("step"),
+            "last_request_age_s": self._age("request"),
+            "pid": os.getpid(),
+        }
+
+    def statusz(self) -> Dict[str, Any]:
+        import jax
+
+        devices = jax.devices()
+        status = {}
+        for name, fn in self._status.items():
+            try:
+                status[name] = fn()
+            except Exception as e:
+                status[name] = f"<status provider failed: {e!r}>"
+        return {
+            "backend": devices[0].platform if devices else None,
+            "device_count": len(devices),
+            "devices": [{"id": int(d.id),
+                         "kind": getattr(d, "device_kind", None)
+                         or d.platform,
+                         "platform": d.platform,
+                         "process_index": int(
+                             getattr(d, "process_index", 0))}
+                        for d in devices],
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "telemetry_enabled": _metrics.enabled(),
+            "tracing": _trace.tracing(),
+            "recompile": _recompile.tracker().stats(),
+            "status": status,
+            "run_config": self.run_config,
+        }
+
+    def tracez(self) -> Dict[str, Any]:
+        events = _trace.get_events()
+        return {"tracing": _trace.tracing(), "total": len(events),
+                "spans": events[-TRACEZ_SPANS:]}
+
+    def memz(self) -> Dict[str, Any]:
+        from . import diag
+
+        return {"devices": diag.device_memory(),
+                "peak_mem_bytes": diag.peak_memory_bytes()}
+
+
+def _make_handler(server: DebugServer):
+    class Handler(BaseHTTPRequestHandler):
+        # scrapes are frequent; stock per-request stderr logging would
+        # drown the training logs
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _send(self, code: int, body: str,
+                  ctype: str = "application/json") -> None:
+            data = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype + "; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            try:
+                if path == "/metrics":
+                    from .export import prometheus_text
+
+                    self._send(200, prometheus_text(),
+                               "text/plain; version=0.0.4")
+                elif path == "/healthz":
+                    self._send(200, json.dumps(server.healthz()))
+                elif path == "/statusz":
+                    self._send(200, json.dumps(server.statusz(),
+                                               default=str))
+                elif path == "/tracez":
+                    self._send(200, json.dumps(server.tracez(),
+                                               default=str))
+                elif path == "/memz":
+                    self._send(200, json.dumps(server.memz(),
+                                               default=str))
+                elif path == "/":
+                    self._send(200, json.dumps({"endpoints": [
+                        "/metrics", "/healthz", "/statusz", "/tracez",
+                        "/memz"]}))
+                else:
+                    self._send(404, json.dumps(
+                        {"error": f"no such endpoint: {path}"}))
+            except BrokenPipeError:
+                pass  # scraper went away mid-response
+            except Exception:
+                # a broken scrape must report, not kill the handler
+                # thread silently
+                try:
+                    self._send(500, json.dumps(
+                        {"error": traceback.format_exc()}))
+                except Exception:
+                    pass
+
+    return Handler
+
+
+def start(port: int = 0, host: str = "127.0.0.1",
+          run_config: Optional[Dict[str, Any]] = None) -> DebugServer:
+    """Start a debug server (module-level convenience). Caller owns
+    ``stop()``."""
+    return DebugServer(port=port, host=host, run_config=run_config).start()

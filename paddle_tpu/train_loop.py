@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, Iterable, Optional
+from typing import Any, Callable, Dict, Iterable, Optional, Union
 
 import numpy as np
 
@@ -23,6 +23,8 @@ from .checkpoint import CheckpointManager
 from .core.config import FLAGS
 from .core.enforce import EnforceError, enforce
 from .telemetry import recompile as _recompile
+from .telemetry import server as _dbg_server
+from .telemetry.diag import AnomalyHalt, FlightRecorder
 
 
 @telemetry.cached_instruments
@@ -157,6 +159,7 @@ class TrainLoop:
         self._recoveries_this_run = 0
         self._faulted = False
         self._last_loss_scale: Optional[float] = None
+        self.debug_server = None  # set while run(debug_port=) is live
         self.history: Dict[str, Any] = {"resumed_from": None,
                                         "skipped_steps": [],
                                         "recoveries": []}
@@ -199,7 +202,9 @@ class TrainLoop:
     def run(self, batches: Iterable, num_steps: Optional[int] = None,
             resume: bool = True,
             on_step: Optional[Callable[[int, Any, Dict], None]] = None,
-            prefetch: Optional[int] = None, bucket_by=None, pad_value=0):
+            prefetch: Union[int, str, None] = None, bucket_by=None,
+            pad_value=0, debug_port: Optional[int] = None,
+            flight_recorder: Optional[FlightRecorder] = None):
         """Train until ``num_steps`` (global, including resumed) or data
         exhaustion. Returns the final step count — which can end below
         ``num_steps`` after an elastic recovery, since the data stream
@@ -215,13 +220,36 @@ class TrainLoop:
           construction: the Trainer step donates (params, buffers,
           opt_state) — never the batch — and the prefetcher copies any
           already-device-resident leaf, so a staged buffer can never be
-          a donated one.
+          a donated one. ``prefetch="auto"`` starts at depth 2 and
+          grows the staging depth while the host-wait p50 stays above
+          threshold (capped — ``data.device_loader`` auto sizing).
         - ``bucket_by=...``: pad the batch axis up to a fixed bucket set
           ("pow2" or an ascending size list) so a ragged final batch
           reuses the compiled step instead of retracing it (visible in
           ``pt_jit_recompiles_total{site="train_loop.step"}``).
           ``pad_value`` fills the padded rows. Works with or without
           ``prefetch`` (alone it stages synchronously).
+
+        Live diagnostics (opt-in, ``telemetry.server`` / ``.diag``):
+
+        - ``debug_port=P``: serve /metrics /healthz /statusz /tracez
+          /memz on 127.0.0.1:P (0 = ephemeral; ``self.debug_server``
+          holds the running server) for the duration of the run.
+          Starting the server ENABLES telemetry; the thread is joined
+          before run() returns.
+        - ``flight_recorder=FlightRecorder(...)``: record per-step
+          loss / grad-norm / loss-scale / step-time / queue-depth into
+          the recorder's ring and apply its policy on anomaly —
+          ``record`` keeps going (the dump bundle is on disk),
+          ``skip_step`` drops a NAN step like the nan guard (rollback
+          to the last checkpoint; with NO checkpoint to roll back to
+          it escalates to halt — the poisoned update already applied
+          and continuing would train on it; finite anomalies —
+          spike/stall — never roll back: the state is sound and a
+          rollback would destroy real progress), ``halt`` raises
+          :class:`telemetry.diag.AnomalyHalt`. Only consulted while
+          telemetry is enabled — with telemetry off the loop executes
+          no recorder code at all (the enabled-flag contract).
         """
         if prefetch is not None or bucket_by is not None:
             from .data.device_loader import DevicePrefetcher
@@ -234,18 +262,53 @@ class TrainLoop:
                 # name, ...) must fail loudly, not silently stage every
                 # batch at default placement
                 sharding = get_sh()
+            # strings pass through raw so DevicePrefetcher's typed
+            # "int or 'auto'" error fires on a typo'd mode, not a bare
+            # int() ValueError here
             batches = DevicePrefetcher(batches,
-                                       size=int(prefetch or 0),
+                                       size=(prefetch
+                                             if isinstance(prefetch, str)
+                                             else int(prefetch or 0)),
                                        sharding=sharding,
                                        bucket_by=bucket_by,
                                        pad_value=pad_value)
+        if flight_recorder is not None:
+            # provenance for the dump bundle (never overrides what the
+            # caller already recorded there)
+            for k, v in (("checkpoint_dir", self.manager.directory),
+                         ("nan_policy", self.nan_policy),
+                         ("num_steps", num_steps),
+                         ("checkpoint_every", self.checkpoint_every)):
+                flight_recorder.run_config.setdefault(k, v)
         if resume:
             self.maybe_resume()
         self._recoveries_this_run = 0
         self._faulted = False
+        self.debug_server = None
         if self._watchdog:
             self._watchdog.start()
         try:
+            if debug_port is not None:
+                # started INSIDE the guarded block: the finally below
+                # stops whatever got started, so no failure between
+                # here and the loop can leak the daemon thread
+                from .telemetry.server import DebugServer
+
+                self.debug_server = DebugServer(
+                    port=debug_port, owned=True,
+                    run_config={"role": "train_loop",
+                                "checkpoint_dir": self.manager.directory,
+                                "nan_policy": self.nan_policy,
+                                "num_steps": num_steps}).start()
+                if hasattr(batches, "current_depth"):
+                    # the input pipeline's live knob on /statusz
+                    pf = batches
+                    self.debug_server.add_status(
+                        "input_pipeline",
+                        lambda: {"prefetch_depth": pf.current_depth,
+                                 "auto": pf.auto,
+                                 "queue_depth": pf.last_queue_depth,
+                                 "last_real_rows": pf.last_real_rows})
             for batch in batches:
                 if num_steps is not None and self.step >= num_steps:
                     break
@@ -287,6 +350,68 @@ class TrainLoop:
                     self.trainer.restore_checkpoint(self.manager, latest)
                     self.step = latest
                     continue
+                if telem and flight_recorder is not None:
+                    # recorder sees the step BEFORE the nan guard: its
+                    # anomaly watch + policy subsume the guard for runs
+                    # that configure it (the guard still applies after,
+                    # under its own nan_policy). float() fences, so the
+                    # recorder only ever holds host scalars.
+                    action = flight_recorder.record_step(
+                        self.step + 1,
+                        loss=float(np.asarray(loss)),
+                        grad_norm=(metrics.get("grad_norm")
+                                   if isinstance(metrics, dict) else None),
+                        loss_scale=self._last_loss_scale,
+                        step_time=time.perf_counter() - t0,
+                        queue_depth=getattr(batches, "last_queue_depth",
+                                            None))
+                    if action == "halt":
+                        # the post-anomaly live state is suspect (the
+                        # update already applied) — close() must not
+                        # snapshot it over the last good checkpoint
+                        self._faulted = True
+                        raise flight_recorder.halt_error(
+                            f"step {self.step + 1}")
+                    if action == "skip_step":
+                        if not flight_recorder.anomalies[-1]["kind"] \
+                                .startswith("nan"):
+                            # finite anomaly (spike/stall): the applied
+                            # update is numerically sound, and rolling
+                            # back would destroy up to checkpoint_every
+                            # steps of real progress over a GC pause —
+                            # skip_step degrades to record here (the
+                            # dump is the value)
+                            pass
+                        else:
+                            # non-finite update: same remedy as the nan
+                            # guard's skip — drop it by rolling back to
+                            # the last snapshot (join in-flight async
+                            # writes first: a still-renaming snapshot
+                            # would read as "no checkpoint" and
+                            # silently keep the poisoned state)
+                            self.manager.wait_until_finished()
+                            latest = self.manager.latest_step()
+                            if latest is not None:
+                                # bookkeeping parity with the _guard
+                                # nan-skip this path subsumes: the
+                                # history entry AND the nan-skip
+                                # counter (dashboards alert on it)
+                                self.history["skipped_steps"].append(
+                                    self.step)
+                                _train_metrics()["nan_skips"].inc()
+                                self.trainer.restore_checkpoint(
+                                    self.manager, latest)
+                            else:
+                                # NOTHING to roll back to: continuing
+                                # would train on poison — same
+                                # latest-is-None-is-fatal stance as the
+                                # exception-recovery path above
+                                self._faulted = True
+                                raise flight_recorder.halt_error(
+                                    f"step {self.step + 1} (skip_step "
+                                    f"with no checkpoint to roll back "
+                                    f"to)")
+                            continue
                 if not self._guard(loss):
                     continue
                 self.step += 1
@@ -322,12 +447,25 @@ class TrainLoop:
                             self._last_loss_scale = scale
                 if self._watchdog:
                     self._watchdog.beat()
+                if telem:
+                    # /healthz last-step age: stamp OUR server when we
+                    # own one (a co-resident serving loop's stall must
+                    # stay visible on its own endpoint), broadcast only
+                    # for standalone servers
+                    if self.debug_server is not None:
+                        self.debug_server.note("step")
+                    else:
+                        _dbg_server.note("step")
                 if on_step is not None:
                     on_step(self.step, loss, metrics)
                 if self.checkpoint_every and \
                         self.step % self.checkpoint_every == 0:
                     self.manager.save(self.step, self.trainer.state())
         finally:
+            if self.debug_server is not None:
+                # joined before run() returns: no leaked daemon thread
+                # (the object stays on self for post-run inspection)
+                self.debug_server.stop()
             self.close()
         return self.step
 

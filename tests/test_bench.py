@@ -204,11 +204,15 @@ def test_input_pipeline_ab_contract():
 
 def test_every_line_carries_mfu_step_time_backend():
     """PR 2 schema: every success line says which backend produced it
-    and the fenced per-step time next to mfu (null on CPU — no peak)."""
+    and the fenced per-step time next to mfu (null on CPU — no peak).
+    PR 4 adds peak_mem_bytes from the device-memory monitor — null on
+    CPU (no memory_stats(); the live-array fallback is an allocation
+    view, never a peak)."""
     d = _run("--smoke", "--steps", "4", "--batch-size", "32")
     assert d["backend"] == "cpu"
     assert d["step_time_ms"] > 0
     assert "mfu" in d and d["mfu"] is None  # cpu: honest null
+    assert "peak_mem_bytes" in d and d["peak_mem_bytes"] is None
 
 
 def test_infra_error_emits_skip_not_zero():
@@ -269,7 +273,7 @@ def test_e2e_bench_smoke_validates_schema():
     means every BENCH_r*.json of the round is unusable."""
     d = _run("--smoke")
     for key in ("metric", "value", "unit", "vs_baseline", "backend",
-                "step_time_ms", "mfu"):
+                "step_time_ms", "mfu", "peak_mem_bytes"):
         assert key in d, f"schema key missing: {key} in {d}"
     assert d["metric"] == "mnist_mlp_throughput"
     assert isinstance(d["value"], float) and d["value"] > 0
@@ -287,6 +291,7 @@ def test_dp_misuse_keeps_json_contract():
     # error rows carry the full schema too (null where unmeasurable)
     assert d["backend"] is None and d["mfu"] is None
     assert d["step_time_ms"] is None
+    assert d["peak_mem_bytes"] is None
 
 
 def test_unwritable_profile_keeps_json_contract():

@@ -1,0 +1,133 @@
+"""debug.py (program pretty-printer + graphviz export): zero coverage
+before this file. Pins ``program_to_string`` (param/var kinds, shapes,
+op lines, ``_GradNode`` rendering) and ``program_to_dot`` /
+``draw_program`` over a small static Program — including a program WITH
+``append_backward`` recorded, which used to crash the dot export
+(``_GradNode`` carries no ``.inputs``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.debug import (draw_program, print_program,
+                              program_to_dot, program_to_string)
+
+
+def _prog(with_backward=False):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = prog.data("x", (-1, 4))
+        h = static.layers.fc(x, 3, act="relu")
+        loss = static.layers.mean(h)
+        if with_backward:
+            static.append_backward(loss)
+    return prog, x, loss
+
+
+class TestProgramToString:
+    def test_var_kinds_shapes_and_ops(self):
+        prog, x, loss = _prog()
+        s = program_to_string(prog)
+        assert s.startswith(f"Program: {len(prog.nodes)} nodes")
+        # feed var renders as a plain var with its declared shape
+        assert f"var {x.name}:" in s
+        assert "shape=(-1, 4)" in s
+        # parameters render as params
+        for p in prog.param_names():
+            assert f"param {p}:" in s
+        # every op node renders with its inputs -> outputs
+        assert f"-> {loss.name}" in s
+        assert "ops:" in s and "vars:" in s
+
+    def test_with_shapes_false_drops_shapes(self):
+        prog, _, _ = _prog()
+        s = program_to_string(prog, with_shapes=False)
+        assert "shape=" not in s
+        assert "dtype=" in s
+
+    def test_grad_node_renders(self):
+        prog, _, loss = _prog(with_backward=True)
+        s = program_to_string(prog)
+        assert f"grad(loss={loss.name})" in s
+        assert "@GRAD" in s
+
+    def test_print_program_prints(self, capsys):
+        prog, _, _ = _prog()
+        print_program(prog)
+        assert "Program:" in capsys.readouterr().out
+
+
+class TestProgramToDot:
+    def _assert_well_formed(self, dot, prog):
+        assert dot.startswith("digraph program {")
+        assert dot.rstrip().endswith("}")
+        # every node/edge line is terminated (a truncated emit would
+        # produce a line without the trailing ;)
+        for line in dot.splitlines()[1:-1]:
+            assert line.rstrip().endswith(";"), line
+        # one box per program node
+        assert dot.count("shape=box") == len(prog.nodes)
+
+    def test_ops_vars_and_param_styling(self):
+        prog, x, loss = _prog()
+        dot = program_to_dot(prog)
+        self._assert_well_formed(dot, prog)
+        # params are filled ellipses, feeds plain
+        for p in prog.param_names():
+            assert f'"v_{p}" [label="{p}' in dot
+        assert "fillcolor=lightblue" in dot
+        assert f'"v_{x.name}"' in dot
+        # dataflow edges exist in both directions around an op
+        assert f'"v_{x.name}" -> "op_0";' in dot
+
+    def test_grad_node_export_does_not_crash_and_wires_edges(self):
+        """Regression: _GradNode has no .inputs — the dot export used
+        to raise AttributeError on any program with append_backward."""
+        prog, _, loss = _prog(with_backward=True)
+        dot = program_to_dot(prog)
+        self._assert_well_formed(dot, prog)
+        gi = next(i for i, n in enumerate(prog.nodes)
+                  if n.__class__.__name__ == "_GradNode")
+        assert f'"op_{gi}" [label="backward"' in dot
+        # backward consumes the loss and the params, emits @GRAD vars
+        assert f'"v_{loss.name}" -> "op_{gi}";' in dot
+        for p in prog.param_names():
+            assert f'"v_{p}" -> "op_{gi}";' in dot
+            assert f'"op_{gi}" -> "v_{p}@GRAD";' in dot
+
+    def test_duplicate_vars_emitted_once(self):
+        prog, x, _ = _prog()
+        dot = program_to_dot(prog)
+        assert dot.count(f'"v_{x.name}" [label=') == 1
+
+    def test_graph_name(self):
+        prog, _, _ = _prog()
+        assert program_to_dot(prog, "g2").startswith("digraph g2 {")
+
+
+def test_draw_program_writes_dot_file(tmp_path):
+    prog, _, _ = _prog(with_backward=True)
+    path = str(tmp_path / "prog.dot")
+    out = draw_program(prog, path)
+    assert os.path.exists(path)
+    content = open(path).read()
+    assert content.startswith("digraph program {")
+    # returns the png path only when graphviz rendered one
+    if out.endswith(".png"):
+        assert os.path.exists(out)
+    else:
+        assert out == path
+
+
+def test_executed_program_still_prints(tmp_path):
+    """The dump helpers must work on a program that has actually run
+    (vars materialized through the Executor)."""
+    prog, x, loss = _prog()
+    exe = static.Executor(scope=static.Scope())
+    out = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                  fetch_list=[loss])
+    assert np.isfinite(out[0]).all()
+    assert "Program:" in program_to_string(prog)
+    assert "digraph" in program_to_dot(prog)

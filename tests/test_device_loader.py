@@ -427,3 +427,115 @@ class TestExecutorFeedBuckets:
         exe.set_feed_buckets(None)
         exe.run(prog, feed=self._feed(13), fetch_list=[loss])
         assert len(exe._cache) == 1  # compiled at the exact 13-row shape
+
+
+class TestAutoPrefetchDepth:
+    """prefetch="auto": the pt_input_host_wait_seconds signal fed back
+    into the staging depth (ROADMAP open item) — depth grows while the
+    host-wait p50 exceeds threshold, capped, and never shrinks."""
+
+    def _slow_source(self, n, delay=0.004, bs=4):
+        def gen():
+            for i in range(n):
+                time.sleep(delay)
+                yield {"x": np.full((bs, 3), i, np.float32)}
+
+        return gen
+
+    def test_depth_grows_under_input_bound_load_and_caps(self):
+        pf = DevicePrefetcher(self._slow_source(48), size="auto",
+                              auto_cap=5, auto_threshold_s=1e-4)
+        assert pf.auto and pf.current_depth == 2
+        seen = sum(1 for _ in pf)
+        assert seen == 48
+        assert pf.current_depth == 5  # grew to the cap, never past it
+        assert _wait_no_pt_threads()
+
+    def test_depth_stays_put_when_pipeline_keeps_up(self):
+        pf = DevicePrefetcher(_np_batches(48), size="auto",
+                              auto_cap=8, auto_threshold_s=0.25)
+        list(pf)
+        assert pf.current_depth == 2  # producer faster than threshold
+
+    def test_auto_works_with_telemetry_off_and_gauges_when_on(self):
+        # the feedback loop must not depend on metrics being scraped
+        assert not telemetry.enabled()
+        pf = DevicePrefetcher(self._slow_source(32), size="auto",
+                              auto_cap=4, auto_threshold_s=1e-4)
+        list(pf)
+        assert pf.current_depth == 4
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            pf2 = DevicePrefetcher(self._slow_source(32), size="auto",
+                                   auto_cap=4, auto_threshold_s=1e-4)
+            list(pf2)
+            snap = telemetry.registry().snapshot()
+            assert snap["pt_input_prefetch_depth"]["value"] == 4
+            # a pipeline that never grows still exports its capacity —
+            # "depth 2, healthy" must be distinguishable from "no
+            # prefetcher"
+            list(DevicePrefetcher(_np_batches(4), size=3))
+            snap = telemetry.registry().snapshot()
+            assert snap["pt_input_prefetch_depth"]["value"] == 3
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+    def test_queue_depth_exposed_for_statusz(self):
+        pf = DevicePrefetcher(_np_batches(6), size=2)
+        assert pf.last_queue_depth is None
+        for _ in pf:
+            assert pf.last_queue_depth is not None
+        assert pf.current_depth == 2 and not pf.auto
+
+    def test_rejects_bad_auto_config(self):
+        with pytest.raises(EnforceError):
+            DevicePrefetcher(_np_batches(1), size="turbo")
+        with pytest.raises(EnforceError):
+            DevicePrefetcher(_np_batches(1), size=2, auto_cap=4)
+        with pytest.raises(EnforceError):
+            DevicePrefetcher(_np_batches(1), size=1,
+                             auto_threshold_s=0.1)
+
+    def test_train_loop_typos_get_the_typed_error(self, tmp_path):
+        """A typo'd mode string through TrainLoop.run(prefetch=) must
+        hit DevicePrefetcher's named enforce, not a bare int()
+        ValueError."""
+        from paddle_tpu.train_loop import TrainLoop
+
+        class _Stub:
+            def train_step(self, b):
+                return np.float32(0.0), {}
+
+            def state(self):
+                return {}
+
+            def restore_checkpoint(self, m, s):
+                pass
+
+        loop = TrainLoop(_Stub(), str(tmp_path), nan_policy="off")
+        with pytest.raises(EnforceError, match="int or 'auto'"):
+            loop.run(_np_batches(1)(), prefetch="Auto")
+
+    def test_train_loop_accepts_prefetch_auto(self, tmp_path):
+        from paddle_tpu.train_loop import TrainLoop
+
+        mesh = pt.build_mesh(dp=1, devices=jax.devices()[:1])
+        from paddle_tpu import optimizer, parallel
+        from paddle_tpu.models import mnist as M
+
+        pt.seed(0)
+        trainer = parallel.Trainer.supervised(
+            M.MnistMLP(hidden1=8, hidden2=8), optimizer.Adam(1e-3),
+            M.loss_fn, mesh=mesh)
+
+        def batches(n, bs=8):
+            rng = np.random.default_rng(0)
+            for _ in range(n):
+                yield {"x": rng.normal(size=(bs, 784)).astype(np.float32),
+                       "label": rng.integers(0, 10, bs)}
+
+        loop = TrainLoop(trainer, str(tmp_path), checkpoint_every=100)
+        assert loop.run(batches(3), prefetch="auto") == 3
+        assert _wait_no_pt_threads()

@@ -305,6 +305,69 @@ class TestExport:
         assert "pt_t_nan NaN" in text
         assert "pt_t_inf" in telemetry.summary()
 
+    def test_write_textfile_golden_format(self, tmp_path):
+        """node-exporter textfile collector contract, pinned LINE BY
+        LINE: HELP before TYPE, samples after their headers, histogram
+        buckets cumulative and in ascending le order with +Inf last,
+        then _sum/_count — the full exposition, not substrings."""
+        reg = telemetry.registry()
+        reg.counter("pt_t_req_total", "requests", unit="1").inc(3)
+        reg.gauge("pt_t_depth", "queue depth").set(2)
+        h = reg.histogram("pt_t_lat_seconds", "latency", unit="s",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        path = str(tmp_path / "pt.prom")
+        assert telemetry.write_textfile(path) == path
+        lines = open(path).read().splitlines()
+        assert lines == [
+            "# HELP pt_t_depth queue depth",
+            "# TYPE pt_t_depth gauge",
+            "pt_t_depth 2",
+            "# HELP pt_t_lat_seconds latency",
+            "# TYPE pt_t_lat_seconds histogram",
+            'pt_t_lat_seconds_bucket{le="0.1"} 1',
+            'pt_t_lat_seconds_bucket{le="1"} 1',
+            'pt_t_lat_seconds_bucket{le="+Inf"} 2',
+            "pt_t_lat_seconds_sum 5.05",
+            "pt_t_lat_seconds_count 2",
+            "# HELP pt_t_req_total requests",
+            "# TYPE pt_t_req_total counter",
+            "pt_t_req_total 3",
+        ]
+        # the exposition ends with exactly one newline (a missing final
+        # newline makes node-exporter drop the last sample)
+        assert open(path).read().endswith("pt_t_req_total 3\n")
+
+    def test_write_textfile_is_atomic(self, tmp_path, monkeypatch):
+        """Temp-file + os.replace discipline: the target either holds a
+        complete exposition or keeps its previous content — a reader
+        never sees a torn write, and a failed replace leaves no temp
+        droppings."""
+        telemetry.registry().counter("pt_t_total", "d").inc()
+        path = str(tmp_path / "pt.prom")
+        with open(path, "w") as f:
+            f.write("previous complete exposition\n")
+        import os as _os
+
+        real_replace = _os.replace
+
+        def boom(src, dst):
+            raise OSError("simulated crash at rename")
+
+        monkeypatch.setattr("paddle_tpu.telemetry._atomic.os.replace",
+                            boom)
+        with pytest.raises(OSError, match="simulated"):
+            telemetry.write_textfile(path)
+        # target untouched, no .tmp left behind
+        assert open(path).read() == "previous complete exposition\n"
+        assert [f for f in _os.listdir(tmp_path)
+                if f.endswith(".tmp")] == []
+        monkeypatch.setattr("paddle_tpu.telemetry._atomic.os.replace",
+                            real_replace)
+        telemetry.write_textfile(path)
+        assert "pt_t_total 1" in open(path).read()
+
 
 # ---------------------------------------------------------------------------
 # serving integration (acceptance: TTFT/decode-latency/accept-rate
