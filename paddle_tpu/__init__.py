@@ -35,6 +35,7 @@ _LAZY = {
     "fleet": ".fleet",
     "debug": ".debug",
     "install_check": ".install_check",
+    "resilience": ".resilience",
     "train_loop": ".train_loop",
     "slim": ".slim",
     "utils": ".utils",

@@ -36,10 +36,12 @@ performs the atomic rename.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import re
 import shutil
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -50,8 +52,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import telemetry
-from .core.enforce import enforce
+from .core.enforce import EnforceError, enforce
 from .core.mesh import get_mesh
+from .resilience import faults as _faults
+from .resilience.integrity import (ChecksumError, checksum_bytes,
+                                   verify_bytes)
+from .resilience.retry import retry_io
+from .utils.atomic import atomic_write_bytes, atomic_write_text
 
 
 @telemetry.cached_instruments
@@ -72,9 +79,22 @@ def _ckpt_metrics(reg):
         "restore_time": reg.histogram(
             "pt_checkpoint_restore_seconds",
             "checkpoint read+reshard wall time", unit="s"),
+        "checksum_failures": reg.counter(
+            "pt_checkpoint_checksum_failures_total",
+            "checkpoint files whose bytes failed checksum "
+            "verification on restore"),
+        "restore_fallbacks": reg.counter(
+            "pt_checkpoint_restore_fallbacks_total",
+            "CheckpointManager.restore fallbacks to an older committed "
+            "step after a torn/corrupt newer one"),
     }
 
 _MANIFEST = "manifest.json"
+# commit marker: written LAST into the staging dir (after every shard
+# and the manifest, via the shared atomic helper), so its presence in a
+# published step dir certifies completeness — a dir torn by a mid-copy
+# kill or a partial rsync lacks it and restore skips that step
+_COMMITTED = "COMMITTED"
 
 # dtypes numpy's .npy format can't round-trip natively are stored as a
 # same-width uint view and restored by name
@@ -174,18 +194,127 @@ def _sanitize(path: str) -> str:
 
 
 _barrier_counts: Dict[str, int] = {}
+_BARRIER_SUBDIR = ".pt_barrier"
+_RUN_START = time.time()  # stale-barrier sweep boundary (this process)
+_swept_barrier_roots: Dict[str, float] = {}  # root -> last sweep time
+_BARRIER_TIMEOUT_S = 300.0
+_SWEEP_INTERVAL_S = 300.0
 
 
-def _barrier(tag: str) -> None:
+def _barrier_root(directory: str) -> str:
+    """Where the file-barrier fallback keeps its rendezvous files:
+    beside the target directory (the shared checkpoint FS)."""
+    parent = os.path.dirname(os.path.abspath(directory))
+    return os.path.join(parent, _BARRIER_SUBDIR)
+
+
+_STALE_BARRIER_AGE_S = 60.0
+
+
+def _sweep_stale_barriers(root: str, now: Optional[float] = None) -> int:
+    """GC barrier litter from DEAD runs on first barrier entry: a run
+    killed mid-barrier leaves its rendezvous files behind, and because
+    every run restarts its per-directory sequence at 1, a stale
+    ``<tag>.<rank>`` from the old run would read as "rank already
+    arrived" and desync (or deadlock) the next run in the same
+    directory. Stale = (older than this process's start AND at least
+    ``_STALE_BARRIER_AGE_S`` old — the age floor protects a live
+    peer's fresh rendezvous file from a rank whose module import
+    happened after the peer already entered the job's first barrier;
+    process start times are not ordered across ranks) OR older than
+    the barrier timeout + slack (a barrier either completed or timed
+    out by then, so its files are provably dead — this arm also
+    reclaims THIS run's own accumulation across many saves, since
+    manager saves target fresh step dirs and never reach the per-dir
+    n-2 lazy cleanup). Re-runs per root every ``_SWEEP_INTERVAL_S``.
+    Even a wrong deletion is self-healing: a live polling rank
+    re-publishes its file (see ``_file_barrier``). Returns the number
+    of files removed."""
+    t = time.time() if now is None else now
+    last = _swept_barrier_roots.get(root)
+    if last is not None and t - last < _SWEEP_INTERVAL_S:
+        return 0
+    _swept_barrier_roots[root] = t
+    cutoff = min(_RUN_START, t - _STALE_BARRIER_AGE_S)
+    dead_by_timeout = t - (_BARRIER_TIMEOUT_S * 2 + 60.0)
+    removed = 0
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    for name in names:
+        path = os.path.join(root, name)
+        try:
+            mtime = os.path.getmtime(path)
+            if mtime < cutoff or mtime < dead_by_timeout:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            pass  # a peer rank swept it first
+    return removed
+
+
+def _file_barrier(directory: str, tag: str, *,
+                  rank: Optional[int] = None,
+                  world: Optional[int] = None,
+                  timeout_s: float = 300.0,
+                  poll_s: float = 0.01) -> None:
+    """Shared-filesystem barrier fallback (no coordination service):
+    every rank publishes ``<root>/<tag>.<rank>`` and polls until all
+    ``world`` files exist. Files persist until the NEXT sequence's lazy
+    cleanup (`_next_barrier_prefix`) or a later run's stale sweep —
+    deleting them inline would race ranks still polling this tag.
+
+    Known limitation (fallback path only — jobs with a coordination
+    client never come here): a job crash-restarted within the stale
+    sweep's age floor (``_STALE_BARRIER_AGE_S``) of a mid-barrier kill
+    can see the dead run's same-tag files as arrivals and release a
+    barrier early (sequence numbers restart at 1 per process). Closing
+    it needs a run-unique tag component agreed WITHOUT a coordinator —
+    tracked under the ROADMAP multi-host coordinated-preemption item."""
+    root = _barrier_root(directory)
+    _sweep_stale_barriers(root)
+    os.makedirs(root, exist_ok=True)
+    rank = jax.process_index() if rank is None else rank
+    world = jax.process_count() if world is None else world
+    mine = os.path.join(root, f"{tag}.{rank}")
+    atomic_write_text(mine, "1")
+    deadline = time.monotonic() + timeout_s
+    while True:
+        present = sum(
+            os.path.exists(os.path.join(root, f"{tag}.{r}"))
+            for r in range(world))
+        if present >= world:
+            return
+        if not os.path.exists(mine):
+            # self-heal: a peer whose process started much later may
+            # have swept this file as stale (start times are not
+            # ordered across ranks) — a live rank simply re-publishes,
+            # so a false sweep costs one poll interval, never the
+            # barrier
+            atomic_write_text(mine, "1")
+        enforce(time.monotonic() < deadline,
+                "file barrier %s timed out after %ss (%s/%s ranks)",
+                tag, timeout_s, present, world)
+        time.sleep(poll_s)
+
+
+def _barrier(tag: str, directory: str) -> None:
     """Coordination-service barrier (no device collectives — safe from the
-    async writer thread). No-op single-process."""
+    async writer thread); file-barrier fallback when multi-process with
+    no coordination client. No-op single-process."""
     if jax.process_count() <= 1:
         return
     from jax._src import distributed as _dist
 
     client = getattr(_dist.global_state, "client", None)
-    if client is None:  # processes without a coordination service can't
-        return          # write per-host checkpoints coherently anyway
+    if client is None:
+        # multi-process but no coordination service: rendezvous through
+        # the shared checkpoint filesystem instead of silently skipping
+        # (a skipped barrier lets rank 0 rename before peers finish
+        # writing their shards — a torn checkpoint by construction)
+        _file_barrier(directory, tag)
+        return
     client.wait_at_barrier(tag, timeout_in_ms=300_000)
 
 
@@ -199,7 +328,23 @@ def _next_barrier_prefix(directory: str) -> str:
 
     n = _barrier_counts.get(directory, 0) + 1
     _barrier_counts[directory] = n
-    return f"ckpt_{zlib.crc32(directory.encode()) & 0xffffffff:08x}_{n}"
+    crc = zlib.crc32(directory.encode()) & 0xffffffff
+    if n > 2:
+        # lazy file-barrier litter GC: entering sequence n proves every
+        # rank passed sequence n-1, which proves every rank long
+        # finished polling sequence n-2 — its files are dead weight
+        root = _barrier_root(directory)
+        try:
+            stale = f"ckpt_{crc:08x}_{n - 2}_"
+            for name in os.listdir(root):
+                if name.startswith(stale):
+                    try:
+                        os.unlink(os.path.join(root, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+    return f"ckpt_{crc:08x}_{n}"
 
 
 def _shard_regions(leaf):
@@ -230,6 +375,55 @@ def _local_shard_payload(leaf):
         starts = tuple((s.start or 0) for s in shard.index)
         out.append(("_".join(map(str, starts)), np.asarray(shard.data)))
     return out
+
+
+def _npy_bytes(arr: np.ndarray):
+    """Serialize to .npy format in memory — one pass yields both the
+    exact file bytes to checksum and the payload for the atomic write
+    (no read-back verification I/O). Returns a zero-copy READ-ONLY
+    memoryview (``getvalue()`` would add a second full copy of the
+    leaf; native crc32c rejects writable buffers; the view keeps its
+    BytesIO exporter alive)."""
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getbuffer().toreadonly()
+
+
+def _write_resilient(path: str, data: bytes, point: str, inj) -> None:
+    """Atomic file write under the transient-I/O retry policy, with the
+    fault-injection points threaded through: ``io.slow`` may delay each
+    attempt, ``point`` may raise (a retried OSError models a transient
+    fault; an exhausted budget tears the save) or corrupt the bytes."""
+    def attempt():
+        d = data
+        if inj is not None:
+            inj.fire("io.slow", path=path)
+            d = inj.fire(point, data=d, path=path)
+        atomic_write_bytes(path, d)
+
+    retry_io(attempt, what=point)
+
+
+def _read_resilient(path: str, inj) -> bytes:
+    """Whole-file read under the retry policy + injection points. The
+    read bytes pass THROUGH the ``restore.read`` fire so a ``corrupt``
+    rule really hands corrupted bytes to the verifier (not a silently
+    discarded flag); raising rules raise either way."""
+    def attempt():
+        if inj is not None:
+            inj.fire("io.slow", path=path)
+        with open(path, "rb") as f:
+            raw = f.read()
+        if inj is not None:
+            raw = inj.fire("restore.read", data=raw, path=path)
+        return raw
+
+    return retry_io(attempt, what="restore.read")
+
+
+def _note_checksum_failure() -> None:
+    if telemetry.enabled():
+        _ckpt_metrics()["checksum_failures"].inc()
 
 
 class _WriteHandle:
@@ -277,6 +471,14 @@ def save_state(directory: str, tree, *, async_save: bool = False,
     Supported containers: dict / list / tuple / None. Custom registered
     pytree nodes are rejected (loudly — a silent degrade would desync leaf
     indices); namedtuples round-trip as plain tuples.
+
+    Integrity (resilience plane): every file's bytes are checksummed
+    into the manifest (non-rank-0 shards into per-rank sidecars), a
+    ``COMMITTED`` marker carrying the manifest checksum is written last
+    in the staging dir, and only then does the atomic rename publish
+    the step. Transient I/O errors retry with capped backoff
+    (``resilience.retry``); an armed ``FaultInjector`` is honored at
+    ``ckpt.write`` / ``ckpt.manifest`` / ``io.slow``.
     """
     flat, _ = _leaf_paths(tree)
     counter = [0]
@@ -335,30 +537,85 @@ def save_state(directory: str, tree, *, async_save: bool = False,
         telem = telemetry.enabled()
         if telem:
             t0 = time.perf_counter()
+        # one injector/policy resolve per write — never per file (the
+        # zero-cost-when-disabled contract: unarmed runs pay a single
+        # None-check here and nothing below)
+        inj = _faults.active()
         tmp = directory + ".tmp"
         if rank0:
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
         if multi:
-            _barrier(f"{bprefix}_staged")  # tmp dir exists for everyone
+            _barrier(f"{bprefix}_staged", directory)  # tmp dir exists
+        checksums: Dict[str, str] = {}
         for fname, arr in payload:
             dt = str(arr.dtype)
             view = _EXOTIC.get(dt)
-            np.save(os.path.join(tmp, fname),
-                    arr.view(view) if view is not None else arr)
+            data = _npy_bytes(arr.view(view) if view is not None
+                              else arr)
+            # checksum the TRUE bytes before the injector touches them:
+            # an injected corruption models the storage tearing the
+            # write, which restore-time verification must then catch
+            checksums[fname] = checksum_bytes(data)
+            _write_resilient(os.path.join(tmp, fname), data,
+                             "ckpt.write", inj)
         if rank0:
-            with open(os.path.join(tmp, _MANIFEST), "w") as f:
-                json.dump({"format": "paddle_tpu_ckpt/v1",
-                           "skeleton": skel, "leaves": entries}, f)
+            text = json.dumps({"format": "paddle_tpu_ckpt/v1",
+                               "skeleton": skel, "leaves": entries,
+                               "checksums": checksums})
+            _write_resilient(os.path.join(tmp, _MANIFEST),
+                             text.encode(), "ckpt.manifest", inj)
+        elif checksums:
+            # non-rank-0 shards: rank 0 can't know these checksums
+            # without a gather, so each rank publishes a sidecar the
+            # restore path merges with the manifest's own map
+            _write_resilient(
+                os.path.join(tmp,
+                             f"checksums.{jax.process_index()}.json"),
+                json.dumps(checksums).encode(), "ckpt.write", inj)
         if multi:
-            _barrier(f"{bprefix}_written")  # all shards on disk
+            _barrier(f"{bprefix}_written", directory)  # all on disk
         if rank0:
-            if os.path.exists(directory):
-                shutil.rmtree(directory)
-            os.replace(tmp, directory)
+            # COMMITTED last, still inside the staging dir: its
+            # presence certifies every byte above it (including the
+            # manifest, whose checksum it carries) landed first. The
+            # atomic rename then publishes marker and payload together.
+            retry_io(lambda: atomic_write_text(
+                os.path.join(tmp, _COMMITTED),
+                json.dumps({"format": "paddle_tpu_ckpt/v1",
+                            "manifest_checksum": checksum_bytes(
+                                text.encode()),
+                            "process_count": jax.process_count()})),
+                what="ckpt.commit")
+            enforce(not os.path.exists(directory)
+                    or os.path.isdir(directory),
+                    "checkpoint target %s exists and is not a "
+                    "directory", directory)
+
+            def publish():
+                # re-entrant on retry: each attempt re-reads the disk
+                # state, so a transient failure after the rename (old
+                # dir already moved to .old) lands in the else branch
+                if os.path.isdir(directory):
+                    # never rmtree the live checkpoint before the
+                    # rename: a kill in that window would destroy the
+                    # old data with the new not yet visible. Swap via a
+                    # trash name — a kill mid-swap leaves the old bytes
+                    # recoverable under .old (GC restores them) and the
+                    # step simply absent (restore falls back).
+                    trash = directory + ".old"
+                    if os.path.exists(trash):
+                        shutil.rmtree(trash)
+                    os.rename(directory, trash)
+                    os.replace(tmp, directory)
+                    shutil.rmtree(trash, ignore_errors=True)
+                else:
+                    os.replace(tmp, directory)
+
+            retry_io(publish, what="ckpt.publish")
         if multi:
-            _barrier(f"{bprefix}_renamed")  # checkpoint visible to all
+            _barrier(f"{bprefix}_renamed", directory)  # visible to all
         if telem:
             m = _ckpt_metrics()
             m["saves"].inc()
@@ -378,7 +635,7 @@ def save_state(directory: str, tree, *, async_save: bool = False,
 
 
 def restore_state(directory: str, *, mesh: Optional[Mesh] = None,
-                  shardings=None, target=None):
+                  shardings=None, target=None, verify: bool = True):
     """Read a checkpoint back, resharding onto ``mesh``.
 
     - ``shardings``: optional pytree (matching the saved tree) of
@@ -389,23 +646,82 @@ def restore_state(directory: str, *, mesh: Optional[Mesh] = None,
       upgrade over the reference's shape-must-match load.
     - ``target``: optional pytree; when given, leaf dtypes/shapes are
       validated against it (catching model/checkpoint mismatch early).
+    - ``verify``: check every read file against the checksums the save
+      recorded (manifest + per-rank sidecars) and the manifest itself
+      against the ``COMMITTED`` marker's checksum — a torn or
+      bit-flipped file raises :class:`resilience.ChecksumError` instead
+      of restoring corrupt weights. Pre-integrity checkpoints carry no
+      checksums and restore unverified. File reads are retried under
+      the transient-I/O policy (``pt_retry_total``).
     """
     telem = telemetry.enabled()
     if telem:
         t_restore0 = time.perf_counter()
+    inj = _faults.active()
     mpath = os.path.join(directory, _MANIFEST)
     enforce(os.path.exists(mpath), "no checkpoint at %s", directory)
-    with open(mpath) as f:
-        manifest = json.load(f)
+    raw_manifest = _read_resilient(mpath, inj)
+    cpath = os.path.join(directory, _COMMITTED)
+    if verify and os.path.exists(cpath):
+        try:
+            marker = json.loads(_read_resilient(cpath, inj))
+        except ValueError as e:
+            _note_checksum_failure()
+            raise ChecksumError(f"{cpath}: torn COMMITTED marker "
+                                f"({e})") from e
+        tag = marker.get("manifest_checksum")
+        if tag:
+            try:
+                verify_bytes(raw_manifest, tag, name=mpath)
+            except ChecksumError:
+                _note_checksum_failure()
+                raise
+    try:
+        manifest = json.loads(raw_manifest)
+    except ValueError as e:
+        # a torn manifest with no marker to catch it first
+        _note_checksum_failure()
+        raise ChecksumError(f"{mpath}: unparseable manifest "
+                            f"({e})") from e
     enforce(manifest.get("format") == "paddle_tpu_ckpt/v1",
             "unknown checkpoint format %s", manifest.get("format"))
+    checksums: Dict[str, str] = dict(manifest.get("checksums") or {})
+    if verify:
+        # per-rank sidecars: shard checksums from writers other than
+        # the manifest's author
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            names = []
+        for name in sorted(names):
+            if name.startswith("checksums.") and name.endswith(".json"):
+                try:
+                    checksums.update(json.loads(_read_resilient(
+                        os.path.join(directory, name), inj)))
+                except ValueError as e:
+                    _note_checksum_failure()
+                    raise ChecksumError(
+                        f"{name}: torn checksum sidecar ({e})") from e
     override = None
     if shardings is not None:
         oflat, _ = _leaf_paths(shardings)
         override = dict(oflat)
 
     def _load_file(path_, dtype):
-        arr = np.load(path_)
+        raw = _read_resilient(path_, inj)
+        tag = checksums.get(os.path.basename(path_))
+        if verify and tag is not None:
+            try:
+                verify_bytes(raw, tag, name=path_)
+            except ChecksumError:
+                _note_checksum_failure()
+                raise
+        try:
+            arr = np.load(io.BytesIO(raw))
+        except ValueError as e:
+            _note_checksum_failure()
+            raise ChecksumError(f"{path_}: unreadable npy payload "
+                                f"({e})") from e
         if _EXOTIC.get(dtype) is not None:
             import ml_dtypes
 
@@ -523,12 +839,15 @@ class CheckpointManager:
         self.max_to_keep = max_to_keep
         self.async_save = async_save
         self._pending: List[_WriteHandle] = []
+        self.last_restored_step: Optional[int] = None
         os.makedirs(directory, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")
 
     def all_steps(self) -> List[int]:
+        """Steps with a manifest on disk (committed or not — see
+        :meth:`committed_steps` for the restore-trustworthy subset)."""
         steps = []
         for name in os.listdir(self.directory):
             m = self._STEP_RE.match(name)
@@ -537,8 +856,37 @@ class CheckpointManager:
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
+    def _is_committed(self, name: str) -> bool:
+        d = os.path.join(self.directory, name)
+        mpath = os.path.join(d, _MANIFEST)
+        if not os.path.exists(mpath):
+            return False
+        if os.path.exists(os.path.join(d, _COMMITTED)):
+            return True
+        # no marker: legacy pre-integrity checkpoints (no checksums in
+        # the manifest) predate the marker and are trusted; a
+        # checksummed manifest WITHOUT its marker is a torn copy of a
+        # new-format checkpoint — never trust it
+        try:
+            with open(mpath) as f:
+                return "checksums" not in json.load(f)
+        except (OSError, ValueError):
+            return False
+
+    def committed_steps(self) -> List[int]:
+        """Steps whose save provably completed (``COMMITTED`` marker,
+        or legacy format with no integrity metadata)."""
+        steps = []
+        for name in os.listdir(self.directory):
+            m = self._STEP_RE.match(name)
+            if m and self._is_committed(name):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
     def latest_step(self) -> Optional[int]:
-        steps = self.all_steps()
+        """Newest COMMITTED step — the only kind worth resuming from
+        (a torn newer dir must not shadow restorable progress)."""
+        steps = self.committed_steps()
         return steps[-1] if steps else None
 
     def save(self, step: int, tree) -> None:
@@ -558,15 +906,45 @@ class CheckpointManager:
             self._pending.append(handle)
         self._gc()
 
+    # errors that mean "this step's bytes are bad", where trying the
+    # previous committed step is the right move. Config/shape errors
+    # (EnforceError) would fail identically on every step and propagate.
+    _FALLBACK_ERRORS = (ChecksumError, OSError, ValueError, KeyError)
+
     def restore(self, step: Optional[int] = None, *, mesh=None,
                 shardings=None, target=None):
+        """Restore ``step`` (explicit: exactly that step, integrity
+        errors propagate) or, with ``step=None``, the newest committed
+        checksum-valid step: a torn/corrupt newer step logs a warning,
+        bumps ``pt_checkpoint_restore_fallbacks_total``, and restore
+        falls back to the next older committed step — the kill-safety
+        contract (never a torn restore, never data loss past the last
+        commit). ``last_restored_step`` records what was restored."""
         self.wait_until_finished()
-        if step is None:
-            step = self.latest_step()
-            enforce(step is not None, "no checkpoints under %s",
-                    self.directory)
-        return restore_state(self._step_dir(step), mesh=mesh,
-                             shardings=shardings, target=target)
+        if step is not None:
+            tree = restore_state(self._step_dir(step), mesh=mesh,
+                                 shardings=shardings, target=target)
+            self.last_restored_step = step
+            return tree
+        steps = self.committed_steps()
+        enforce(steps, "no checkpoints under %s", self.directory)
+        last_exc: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                tree = restore_state(self._step_dir(s), mesh=mesh,
+                                     shardings=shardings, target=target)
+                self.last_restored_step = s
+                return tree
+            except EnforceError:
+                raise
+            except self._FALLBACK_ERRORS as e:
+                last_exc = e
+                if telemetry.enabled():
+                    _ckpt_metrics()["restore_fallbacks"].inc()
+                print(f"[checkpoint] step {s} failed restore "
+                      f"({type(e).__name__}: {e}); falling back to the "
+                      f"previous committed step", file=sys.stderr)
+        raise last_exc  # every committed step failed integrity
 
     def wait_until_finished(self) -> None:
         """Join outstanding writes, re-raising the first failure, then run
@@ -583,15 +961,65 @@ class CheckpointManager:
             raise first_exc
 
     def _gc(self) -> None:
-        # non-blocking: all_steps() only sees fully-written (renamed) dirs,
-        # so in-flight saves are invisible here and get pruned by a later
-        # pass — save() must never stall on its own write thread. Failed
-        # handles stay pending so wait_until_finished() re-raises them.
+        # non-blocking: committed_steps() only sees fully-written
+        # (renamed + COMMITTED) dirs, so in-flight saves are invisible
+        # here and get pruned by a later pass — save() must never stall
+        # on its own write thread. Failed handles stay pending so
+        # wait_until_finished() re-raises them.
         self._pending = [t for t in self._pending
                          if not t.done() or t._exc is not None]
-        steps = self.all_steps()
+        # GC only PAST COMMITTED steps: retention counts committed
+        # checkpoints, so the newest committed one survives even when
+        # max_to_keep is "exceeded" by a newer save that is still
+        # uncommitted/in-flight — deleting it then would leave zero
+        # restorable state if that newer save tears
+        steps = self.committed_steps()
         for s in steps[:-self.max_to_keep]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        # crash litter: torn step dirs (uncommitted, no in-flight
+        # writer, older than the newest committed step — provably a
+        # dead save) and step_N.old trash from a kill mid-rename-swap
+        # would otherwise accumulate forever across preempt/resume
+        # cycles on the same directory. Litter AT OR ABOVE the newest
+        # committed step is deliberately kept: the pending-handle set
+        # only covers THIS process's writers, and a peer rank's
+        # in-flight save always targets a step >= newest — deleting
+        # there would race it (one leaked tmp dir is the cheaper
+        # failure)
+        newest = steps[-1] if steps else None
+        pending = {t.directory for t in self._pending
+                   if t.directory is not None}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            full = os.path.join(self.directory, name)
+            if name.endswith(".old") and \
+                    self._STEP_RE.match(name[:-len(".old")]):
+                base = os.path.join(self.directory,
+                                    name[:-len(".old")])
+                if os.path.exists(base):
+                    # swap completed (or a later save landed): the
+                    # trash copy is superseded
+                    shutil.rmtree(full, ignore_errors=True)
+                elif os.path.exists(os.path.join(full, _MANIFEST)):
+                    # kill mid-rename-swap: the .old copy IS the only
+                    # surviving data for this step — honor save_state's
+                    # "recoverable under .old" promise and put it back
+                    try:
+                        os.rename(full, base)
+                    except OSError:
+                        pass
+                else:
+                    shutil.rmtree(full, ignore_errors=True)
+                continue
+            base = name[:-len(".tmp")] if name.endswith(".tmp") else name
+            m = self._STEP_RE.match(base)
+            if (m and newest is not None and int(m.group(1)) < newest
+                    and os.path.join(self.directory, base) not in pending
+                    and not self._is_committed(base)):
+                shutil.rmtree(full, ignore_errors=True)
 
 
 # --- dygraph-parity convenience (reference: dygraph/checkpoint.py) ---------

@@ -212,7 +212,15 @@ def train_from_dataset(trainer, dataset: "MultiSlotDataset",
     train_from_dataset: C++ threads parse+batch while the device trains).
 
     ``batch_transform(raw)`` maps the feed's {slot: (values, lengths)} dict
-    to the trainer's batch format. Returns the number of steps run."""
+    to the trainer's batch format. Returns the number of steps run.
+
+    Honors the ambient :class:`resilience.PreemptionHandler` when one
+    is installed (resolved once — no handler, no per-step resilience
+    code): on signal the loop finishes the in-flight step and returns
+    early so the caller can checkpoint within the grace window."""
+    from ..resilience import preemption as _preemption
+
+    pre = _preemption.active()
     steps = 0
     for _ in range(epochs):
         for raw in dataset:
@@ -220,4 +228,6 @@ def train_from_dataset(trainer, dataset: "MultiSlotDataset",
             steps += 1
             if on_step is not None:
                 on_step(steps, loss, metrics)
+            if pre is not None and pre.requested():
+                return steps
     return steps

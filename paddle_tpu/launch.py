@@ -73,8 +73,18 @@ def launch(script: str, script_args: List[str], *, nproc: int,
            endpoints: Optional[List[str]] = None,
            log_dir: str = "launch_logs", platform: Optional[str] = None,
            timeout: Optional[float] = None,
-           local_devices: Optional[int] = None) -> int:
-    """Spawn the job; returns the job's exit code (0 = all ranks ok)."""
+           local_devices: Optional[int] = None,
+           grace: float = 30.0) -> int:
+    """Spawn the job; returns the job's exit code (0 = all ranks ok).
+
+    Preemption relay: a SIGTERM delivered to the launcher (TPU
+    preemption hits the job's parent first) is forwarded as SIGTERM to
+    every worker, giving each rank's
+    :class:`resilience.PreemptionHandler` its grace window — workers
+    finish the in-flight step, checkpoint, and exit 0. Workers still
+    alive ``grace`` seconds after the relay are killed. During the
+    relay window a non-zero worker exit no longer tears down its peers
+    (they are already shutting down and deserve their own grace)."""
     if endpoints is None:
         endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
     if len(endpoints) != nproc:
@@ -96,6 +106,24 @@ def launch(script: str, script_args: List[str], *, nproc: int,
             [sys.executable, script, *script_args], env=env,
             stdout=out, stderr=subprocess.STDOUT if out else None))
 
+    relayed_at: List[Optional[float]] = [None]
+
+    def _relay(signum, frame):
+        if relayed_at[0] is not None:
+            return  # second SIGTERM: the grace clock is already running
+        relayed_at[0] = time.time()
+        print(f"[launch] SIGTERM: relaying to {nproc} workers "
+              f"(grace {grace}s)", file=sys.stderr)
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+
+    prev_term = None
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _relay)
+    except ValueError:
+        pass  # not the main thread: no relay, workers get the default
+
     deadline = time.time() + timeout if timeout else None
     rc = 0
     try:
@@ -109,6 +137,8 @@ def launch(script: str, script_args: List[str], *, nproc: int,
                 pending.discard(rank)
                 if code != 0 and rc == 0:
                     rc = code
+                    if relayed_at[0] is not None:
+                        continue  # preempting: peers keep their grace
                     print(f"[launch] rank {rank} exited with {code}; "
                           "terminating job", file=sys.stderr)
                     if logs[rank]:
@@ -116,6 +146,15 @@ def launch(script: str, script_args: List[str], *, nproc: int,
                     for q in procs:
                         if q.poll() is None:
                             q.terminate()
+            if relayed_at[0] is not None and pending and \
+                    time.time() > relayed_at[0] + grace:
+                print(f"[launch] grace window ({grace}s) expired; "
+                      f"killing ranks {sorted(pending)}",
+                      file=sys.stderr)
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                rc = rc or 143  # the job WAS preempted, not clean
             if deadline and time.time() > deadline and pending:
                 print(f"[launch] timeout after {timeout}s; terminating "
                       f"ranks {sorted(pending)}", file=sys.stderr)
@@ -136,6 +175,8 @@ def launch(script: str, script_args: List[str], *, nproc: int,
                 p.send_signal(signal.SIGINT)
         raise
     finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
         for f in log_files:
             f.close()
     return rc
@@ -174,6 +215,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "multi-host simulation rig; per-node --gpus analog)")
     ap.add_argument("--timeout", type=float, default=None,
                     help="kill the job after this many seconds")
+    ap.add_argument("--grace", type=float, default=30.0,
+                    help="seconds workers get to checkpoint and exit "
+                    "after a relayed SIGTERM before being killed "
+                    "(preemption grace window)")
     ap.add_argument("script", help="training script to run per rank")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed through to the script")
@@ -182,7 +227,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     return launch(args.script, args.script_args, nproc=args.nproc,
                   endpoints=endpoints, log_dir=args.log_dir,
                   platform=args.platform, timeout=args.timeout,
-                  local_devices=args.local_devices)
+                  local_devices=args.local_devices, grace=args.grace)
 
 
 if __name__ == "__main__":

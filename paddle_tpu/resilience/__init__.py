@@ -1,0 +1,56 @@
+"""paddle_tpu.resilience — the fault-tolerance plane.
+
+The layer that turns failures into recoverable events (the diagnostics
+plane of PR 4 can *see* a failure; this one *survives* it):
+
+- ``preemption``: SIGTERM/SIGINT grace handler — opted into by
+  ``TrainLoop.run(preemption=...)`` and
+  ``serving.BatchedDecoder.run(preemption=...)``; the loop finishes the
+  in-flight step, writes a final checkpoint / drains in-flight
+  requests, and exits with a ``preempted`` status instead of dying
+  mid-save.
+- ``retry``: capped exponential backoff + seeded jitter for transient
+  I/O (``pt_retry_total``), deadline-bounded — checkpoint save/restore
+  wrap every file op in it.
+- ``integrity``: per-file checksums (crc32c when native, else crc32)
+  recorded in the checkpoint manifest and verified on restore.
+- ``faults``: seeded deterministic :class:`FaultInjector` with named
+  injection points (``ckpt.write``, ``ckpt.manifest``,
+  ``restore.read``, ``step.nan``, ``io.slow``) — the substrate of the
+  chaos test suite. Off by default with zero hot-path cost.
+
+Everything here is opt-in: with no handler installed and no injector
+armed, the training/serving hot paths execute no resilience code (the
+telemetry-off discipline, pinned by test).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from . import faults, integrity, preemption, retry
+from .faults import POINTS, FaultError, FaultInjector
+from .integrity import ChecksumError, checksum_bytes, verify_bytes
+from .preemption import PreemptionHandler
+from .retry import DEFAULT_POLICY, RetryPolicy, retry_io
+
+__all__ = [
+    "ChecksumError", "DEFAULT_POLICY", "FaultError", "FaultInjector",
+    "POINTS", "PreemptionHandler", "RetryPolicy", "checksum_bytes",
+    "faults", "integrity", "preemption", "retry", "retry_io",
+    "statusz", "verify_bytes",
+]
+
+
+def statusz() -> Dict[str, Any]:
+    """Resilience section for the debug server's /statusz: ambient
+    preemption-handler state + armed-injector schedule (both usually
+    absent — that absence is itself the signal)."""
+    out: Dict[str, Any] = {}
+    handler = preemption.active()
+    out["preemption"] = (handler.statusz() if handler is not None
+                        else {"installed": False})
+    inj = faults.active()
+    out["faults"] = (inj.statusz() if inj is not None
+                     else {"armed": False})
+    return out

@@ -1,0 +1,193 @@
+"""Deterministic fault injection — the substrate the chaos test suite
+drives to PROVE the preemption and checkpoint-integrity pillars (kill
+mid-save at every injection point → resume always lands on the last
+committed step).
+
+A :class:`FaultInjector` holds per-point rules (raise / corrupt / delay
+on a seeded, repeatable schedule) and is armed process-globally with
+``inj.arm()`` / ``with inj:``. Instrumented call-sites resolve
+:func:`active` ONCE per operation and pass every I/O through
+:meth:`FaultInjector.fire`; with no injector armed the call-sites see
+``None`` and execute nothing — zero hot-path cost, the telemetry-off
+discipline applied to chaos tooling.
+
+Injection points (:data:`POINTS`):
+
+- ``ckpt.write``    each checkpoint leaf/shard file write
+- ``ckpt.manifest`` the manifest write
+- ``restore.read``  each checkpoint file read
+- ``step.nan``      the training step's loss (corrupt → NaN)
+- ``io.slow``       any checkpoint file I/O (delay rules widen the
+  kill window for the SIGKILL e2e and exercise retry deadlines)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import telemetry
+from ..core.enforce import enforce
+
+POINTS = ("ckpt.write", "ckpt.manifest", "restore.read", "step.nan",
+          "io.slow")
+
+_ACTIVE: Optional["FaultInjector"] = None
+_LOCK = threading.Lock()
+
+
+@telemetry.cached_instruments
+def _fault_metrics(reg):
+    return {
+        "fired": reg.counter("pt_faults_injected_total",
+                             "faults fired by an armed FaultInjector"),
+    }
+
+
+class FaultError(OSError):
+    """Default injected error — an OSError subclass, so the retry layer
+    treats it as the transient I/O fault it simulates."""
+
+
+class FaultInjector:
+    """Seeded, deterministic fault schedule over named injection points.
+
+    Rules (one per point, latest :meth:`on` wins):
+
+    - ``at=(3, 5)``: fire on those 1-based call indices of the point —
+      fully deterministic, independent of the seed.
+    - ``prob=0.2``: fire per call with that probability, drawn from the
+      injector's own seeded RNG — repeatable for a fixed seed and call
+      order.
+    - ``times=N``: total fire budget for the rule (None = unlimited).
+      ``times=1`` with the default error models a transient fault the
+      retry layer absorbs; ``times`` >= the retry budget models a hard
+      fault that tears the save.
+
+    Effects (combinable): ``error=`` raise it (class or instance;
+    default :class:`FaultError`), ``delay_s=`` sleep first,
+    ``corrupt=True`` flip one byte of the payload instead of raising
+    (for ``step.nan``: poison the loss).
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rules: Dict[str, Dict[str, Any]] = {}
+        self.calls: Dict[str, int] = {p: 0 for p in POINTS}
+        self.fired: Dict[str, int] = {p: 0 for p in POINTS}
+
+    def on(self, point: str, *, error=None, prob: float = 0.0,
+           at=(), times: Optional[int] = None,
+           delay_s: float = 0.0, corrupt: bool = False,
+           match: Optional[str] = None) -> "FaultInjector":
+        """Install the rule for ``point`` (returns self for chaining).
+        ``match``: only fire when the call-site's ``path`` contains this
+        substring (target one shard file, spare the manifest, ...)."""
+        enforce(point in POINTS, "unknown injection point %r (have %s)",
+                point, ", ".join(POINTS))
+        enforce(0.0 <= prob <= 1.0, "prob must be in [0, 1], got %s",
+                prob)
+        self._rules[point] = {
+            "error": error, "prob": float(prob),
+            "at": frozenset(int(i) for i in at),
+            "times": times, "delay_s": float(delay_s),
+            "corrupt": bool(corrupt), "match": match,
+        }
+        return self
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self) -> "FaultInjector":
+        """Make this the process's active injector (one at a time —
+        overlapping schedules would destroy determinism)."""
+        global _ACTIVE
+        with _LOCK:
+            enforce(_ACTIVE is None or _ACTIVE is self,
+                    "another FaultInjector is already armed")
+            _ACTIVE = self
+        return self
+
+    def disarm(self) -> None:
+        global _ACTIVE
+        with _LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+    def __enter__(self) -> "FaultInjector":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+    # -- firing ------------------------------------------------------------
+
+    def _should_fire(self, rule, n: int) -> bool:
+        if rule["times"] is not None and rule["times"] <= 0:
+            return False
+        if rule["at"]:
+            return n in rule["at"]
+        if rule["prob"] > 0.0:
+            return self._rng.random() < rule["prob"]
+        # no schedule (bare `on(point, ...)`) fires on every call —
+        # the "this path is broken, period" mode; bound with times=
+        return True
+
+    def fire(self, point: str, *, data: Optional[bytes] = None,
+             path: Optional[str] = None):
+        """Run ``point``'s rule for this call.
+
+        Returns ``data`` (possibly one byte flipped, when the rule says
+        ``corrupt``) if ``data`` was given, else True/False = fired.
+        Raising rules raise instead. Call order is the schedule clock:
+        every call increments the point's index whether or not a rule
+        fires, so ``at=`` indices are stable across rule edits."""
+        self.calls[point] = n = self.calls.get(point, 0) + 1
+        rule = self._rules.get(point)
+        if rule is None:
+            return data if data is not None else False
+        if rule["match"] is not None and (path is None
+                                          or rule["match"] not in path):
+            return data if data is not None else False
+        if not self._should_fire(rule, n):
+            return data if data is not None else False
+        if rule["times"] is not None:
+            rule["times"] -= 1
+        self.fired[point] = self.fired.get(point, 0) + 1
+        if telemetry.enabled():
+            _fault_metrics()["fired"].inc()
+        if rule["delay_s"] > 0.0:
+            time.sleep(rule["delay_s"])
+        if rule["corrupt"]:
+            if data is not None:
+                # flip one byte in the middle: deterministic, always
+                # lands inside the payload (npy data follows the
+                # header). bytes() first: call-sites may hand a
+                # zero-copy memoryview, which doesn't concatenate
+                data = bytes(data)
+                i = len(data) // 2
+                return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+            return True
+        if rule["error"] is not None or not rule["delay_s"]:
+            err = rule["error"]
+            if err is None:
+                err = FaultError(f"injected fault at {point} "
+                                 f"(call {n}, path={path})")
+            elif isinstance(err, type):
+                err = err(f"injected fault at {point} (call {n})")
+            raise err
+        return data if data is not None else True
+
+    def statusz(self) -> Dict[str, Any]:
+        return {"seed": self.seed,
+                "points": sorted(self._rules),
+                "calls": {k: v for k, v in self.calls.items() if v},
+                "fired": {k: v for k, v in self.fired.items() if v}}
+
+
+def active() -> Optional[FaultInjector]:
+    """The armed injector, or None (the common case — call-sites gate
+    every fire() behind this None-check)."""
+    return _ACTIVE

@@ -1,0 +1,103 @@
+"""Bounded retry for transient I/O — capped exponential backoff with
+deterministic seeded jitter, deadline-bounded, counted.
+
+Checkpoint save/restore wrap every file operation in :func:`retry_io`:
+a transient filesystem hiccup (shared-FS blip, NFS timeout — surfacing
+as ``OSError``) costs a short backoff instead of aborting the save
+outright. Deterministic errors (checksum mismatches, enforce failures)
+are NOT retryable and propagate immediately.
+
+``pt_retry_total`` counts absorbed faults; ``pt_retry_exhausted_total``
+counts operations that failed even after the budget — both only while
+telemetry is enabled.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from .. import telemetry
+from ..core.enforce import enforce
+
+T = TypeVar("T")
+
+
+@telemetry.cached_instruments
+def _retry_metrics(reg):
+    return {
+        "retries": reg.counter(
+            "pt_retry_total",
+            "transient I/O errors absorbed by resilience.retry"),
+        "exhausted": reg.counter(
+            "pt_retry_exhausted_total",
+            "operations that failed after the full retry budget"),
+    }
+
+
+class RetryPolicy:
+    """Retry shape: up to ``max_attempts`` tries, sleeping
+    ``base_delay_s * 2^k`` (capped at ``max_delay_s``) plus up to
+    ``jitter`` fraction of that, never sleeping past ``deadline_s``
+    total. The jitter RNG is seeded — two runs with the same policy and
+    failure schedule back off identically (the determinism the
+    fault-injection harness needs)."""
+
+    def __init__(self, max_attempts: int = 4, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0, deadline_s: float = 30.0,
+                 retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+                 jitter: float = 0.5, seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep):
+        enforce(max_attempts >= 1, "max_attempts must be >= 1, got %s",
+                max_attempts)
+        enforce(deadline_s > 0, "deadline_s must be > 0, got %s",
+                deadline_s)
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.deadline_s = deadline_s
+        self.retry_on = tuple(retry_on)
+        self.jitter = jitter
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry ``attempt`` (1-based)."""
+        base = min(self.base_delay_s * (2.0 ** (attempt - 1)),
+                   self.max_delay_s)
+        return base * (1.0 + self.jitter * self._rng.random())
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def retry_io(fn: Callable[[], T], *,
+             policy: Optional[RetryPolicy] = None,
+             what: str = "io") -> T:
+    """Run ``fn`` under ``policy`` (default :data:`DEFAULT_POLICY`).
+
+    Retries only ``policy.retry_on`` errors; re-raises the last error
+    once attempts are exhausted or the next backoff would cross the
+    deadline. ``what`` names the operation in telemetry-off-safe log
+    lines."""
+    policy = policy or DEFAULT_POLICY
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except policy.retry_on as e:
+            attempt += 1
+            delay = policy.backoff_s(attempt)
+            out_of_budget = attempt >= policy.max_attempts
+            past_deadline = (time.monotonic() - t0 + delay
+                             > policy.deadline_s)
+            if out_of_budget or past_deadline:
+                if telemetry.enabled():
+                    _retry_metrics()["exhausted"].inc()
+                raise
+            if telemetry.enabled():
+                _retry_metrics()["retries"].inc()
+            policy._sleep(delay)

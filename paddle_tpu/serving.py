@@ -407,6 +407,8 @@ class BatchedDecoder:
         self.debug_server = None  # last run(debug_port=)'s server
         # (live during that run; kept stopped afterwards for port/
         # status inspection)
+        self.preempted = False  # last run() exited on a grace signal
+        # (in-flight drained; self.queue holds the unserved remainder)
 
     # ----- host API --------------------------------------------------------
 
@@ -448,7 +450,8 @@ class BatchedDecoder:
         return r.rid
 
     def run(self, debug_port: Optional[int] = None,
-            flight_recorder=None) -> Dict[int, np.ndarray]:
+            flight_recorder=None,
+            preemption=None) -> Dict[int, np.ndarray]:
         """Drive until every submitted request completes.
 
         Live diagnostics (opt-in): ``debug_port=P`` serves the debug
@@ -462,7 +465,17 @@ class BatchedDecoder:
         watch catches a wedged arena; policy ``halt`` raises
         :class:`telemetry.diag.AnomalyHalt`, ``skip_step`` downgrades to ``record``
         (a serving tick is not an optimizer update; there is nothing
-        to roll back). Only consulted while telemetry is enabled."""
+        to roll back). Only consulted while telemetry is enabled.
+
+        Preemption grace (opt-in, ``resilience``): ``preemption=True``
+        installs a SIGTERM/SIGINT handler for the drive (or pass an
+        existing :class:`resilience.PreemptionHandler`). On signal the
+        arena stops ADMITTING queued requests but keeps ticking until
+        every in-flight request (active or mid-prefill) completes —
+        drained results are returned, ``self.preempted`` is True, and
+        unserved requests stay in ``self.queue`` for a successor
+        process. Default ``preemption=None``: no handler, no per-tick
+        resilience code (the zero-cost contract)."""
         # refresh the weight snapshot: the jitted fns take weights as
         # REAL arguments, so post-construction mutation of the model
         # (quant.apply_weight_only_int8, a LoRA merge, a hot-swapped
@@ -496,9 +509,30 @@ class BatchedDecoder:
                 # requests submitted before the server came up: seed the
                 # last-request clock now (a lower bound on the true age)
                 self.debug_server.note("request")
+        # preemption grace (resolved once — zero per-tick cost when
+        # None): on signal, stop admitting and drain in-flight slots
+        pre = None
+        own_pre = False
+        self.preempted = False
+        if preemption is not None and preemption is not False:
+            from .resilience.preemption import PreemptionHandler
+
+            pre = (PreemptionHandler() if preemption is True
+                   else preemption)
+            if not pre.installed:
+                pre.install()
+                own_pre = True
         tick = 0
         try:
             while self.queue or self._pf_order or self.active.any():
+                if pre is not None and not self.preempted \
+                        and pre.requested():
+                    self.preempted = True
+                if self.preempted and not (self._pf_order
+                                           or self.active.any()):
+                    # in-flight work drained; queued requests stay in
+                    # self.queue for a successor process
+                    break
                 telem = telemetry.enabled()
                 if telem:
                     m = _serving_metrics()
@@ -508,7 +542,8 @@ class BatchedDecoder:
                         m["page_occupancy"].set(
                             (al.pages - al.free_pages) / al.pages)
                     t_tick = time.perf_counter()
-                self._admit()
+                if not self.preempted:
+                    self._admit()
                 self._prefill_tick()
                 self._step()
                 if telem:
@@ -529,8 +564,14 @@ class BatchedDecoder:
                             raise flight_recorder.halt_error(
                                 f"serving tick {tick}")
         finally:
+            if own_pre:
+                pre.uninstall()
             if self.debug_server is not None:
                 self.debug_server.stop()
+        if self.preempted and telemetry.enabled():
+            from .resilience.preemption import _preempt_metrics
+
+            _preempt_metrics()["clean_exits"].inc()
         out = {rid: r.result for rid, r in self.done.items()}
         self.done = {}
         return out
@@ -542,7 +583,8 @@ class BatchedDecoder:
               "active_slots": int(self.active.sum()),
               "queue_depth": len(self.queue),
               "completed": len(self.done),
-              "prefilling": len(self._pf_order)}
+              "prefilling": len(self._pf_order),
+              "preempted": self.preempted}
         if self.paged:
             al = self._allocator
             st["pages"] = al.pages

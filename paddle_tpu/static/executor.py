@@ -185,6 +185,7 @@ class Executor:
         self._prune_cache: Dict[Tuple, Tuple] = {}
         self._feed_padder = None
         self._len_padder = None
+        self.last_run_preempted = False  # train_from_dataset preemption
         self._flight_recorder = None
         self._run_count = 0
         if feed_buckets is not None:
@@ -259,15 +260,29 @@ class Executor:
                            fetch_info=None, print_period=100):
         """Run the program once per dataset batch (dataset batches are
         name→array dicts from the native MultiSlot feed). Returns the last
-        fetch results."""
+        fetch results.
+
+        Honors the ambient :class:`resilience.PreemptionHandler` when
+        one is installed: on signal the loop finishes the in-flight
+        batch and returns early (``self.last_run_preempted`` True) so
+        the caller can snapshot the scope and exit within the grace
+        window. Resolved once per call — no handler, no per-batch
+        resilience code."""
+        from ..resilience import preemption as _preemption
         from .program import default_main_program
 
         program = program or default_main_program()
+        pre = _preemption.active()
+        self.last_run_preempted = False  # also set in __init__: readable
+        # on executors whose dataset loop never ran
         out = None
         for i, batch in enumerate(dataset):
             out = self.run(program, feed=batch, fetch_list=fetch_list)
             if debug and fetch_list and i % print_period == 0:
                 print(f"step {i}: {out}")
+            if pre is not None and pre.requested():
+                self.last_run_preempted = True
+                break
         return out
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,

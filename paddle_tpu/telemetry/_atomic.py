@@ -1,32 +1,12 @@
-"""Atomic text-file writes — the one copy of the temp-file +
-``os.replace`` discipline the telemetry exporters share (the torn-write
-hazard ROADMAP documents for the compile cache applies to anything a
-concurrent reader re-reads: a node-exporter scrape or a flight-recorder
-bundle landing mid-write would read as complete and lie)."""
+"""Re-export shim — the atomic-write helper moved to its neutral home
+``paddle_tpu.utils.atomic`` (checkpointing needs it too, and the
+checkpoint layer must not depend on telemetry internals). Import from
+there; this module survives only for existing importers and tests that
+patch ``paddle_tpu.telemetry._atomic.os.replace``."""
 
 from __future__ import annotations
 
-import os
-import tempfile
+import os  # noqa: F401  (kept: tests patch _atomic.os.replace)
+import tempfile  # noqa: F401
 
-
-def atomic_write_text(path: str, text: str,
-                      prefix: str = ".pt_atomic_") -> str:
-    """Write ``text`` to ``path`` via a same-dir temp file +
-    ``os.replace``: every reader sees the old content or all of the new,
-    never a torn middle; a failed write unlinks the temp file and leaves
-    the target untouched. Returns ``path``."""
-    directory = os.path.dirname(os.path.abspath(path))
-    fd, tmp = tempfile.mkstemp(dir=directory, prefix=prefix,
-                               suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            f.write(text)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    return path
+from ..utils.atomic import atomic_write_bytes, atomic_write_text  # noqa: F401

@@ -200,6 +200,15 @@ class DebugServer:
                 status[name] = fn()
             except Exception as e:
                 status[name] = f"<status provider failed: {e!r}>"
+        # fault-tolerance plane: ambient preemption-handler + armed
+        # fault-injector state (lazy import — resilience pulls in
+        # telemetry, so a top-level import here would cycle)
+        try:
+            from .. import resilience as _resilience
+
+            resilience = _resilience.statusz()
+        except Exception as e:  # /statusz must render regardless
+            resilience = f"<resilience status failed: {e!r}>"
         return {
             "backend": devices[0].platform if devices else None,
             "device_count": len(devices),
@@ -214,6 +223,7 @@ class DebugServer:
             "telemetry_enabled": _metrics.enabled(),
             "tracing": _trace.tracing(),
             "recompile": _recompile.tracker().stats(),
+            "resilience": resilience,
             "status": status,
             "run_config": self.run_config,
         }

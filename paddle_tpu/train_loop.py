@@ -12,6 +12,7 @@ Executor::Close-style graceful shutdown (join async checkpoint writers).
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Union
@@ -22,6 +23,8 @@ from . import telemetry
 from .checkpoint import CheckpointManager
 from .core.config import FLAGS
 from .core.enforce import EnforceError, enforce
+from .resilience import faults as _faults
+from .resilience.preemption import PreemptionHandler, _preempt_metrics
 from .telemetry import recompile as _recompile
 from .telemetry import server as _dbg_server
 from .telemetry.diag import AnomalyHalt, FlightRecorder
@@ -160,6 +163,8 @@ class TrainLoop:
         self._faulted = False
         self._last_loss_scale: Optional[float] = None
         self.debug_server = None  # set while run(debug_port=) is live
+        # "idle" -> "running" -> "completed" | "preempted" | "faulted"
+        self.status = "idle"
         self.history: Dict[str, Any] = {"resumed_from": None,
                                         "skipped_steps": [],
                                         "recoveries": []}
@@ -173,12 +178,32 @@ class TrainLoop:
     # -- lifecycle -----------------------------------------------------------
 
     def maybe_resume(self) -> Optional[int]:
-        latest = self.manager.latest_step()
-        if latest is not None:
-            self.trainer.restore_checkpoint(self.manager, latest)
-            self.step = latest
-            self.history["resumed_from"] = latest
+        if self.manager.latest_step() is None:
+            return None
+        # step=None takes CheckpointManager's VERIFIED restore path: a
+        # torn or bit-flipped latest step falls back to the newest
+        # committed checksum-valid one instead of crashing the resume
+        self.trainer.restore_checkpoint(self.manager, None)
+        latest = self.manager.last_restored_step
+        self.step = latest
+        self.history["resumed_from"] = latest
         return latest
+
+    def _note_rollback(self, restored: Optional[int],
+                       expected: Optional[int], why: str) -> None:
+        """After a rollback restore: when the verified restore fell
+        back PAST the expected newest committed step (its bytes were
+        corrupt), the step counter must follow what was actually
+        restored (or the next periodic save would label old weights
+        with the current step number) and the rewind is recorded. The
+        normal rollback-to-latest case is a no-op here — plain skip
+        semantics keep the counter."""
+        if restored is None or restored == expected:
+            return
+        self.history["recoveries"].append(
+            {"step": self.step, "rolled_back_to": restored,
+             "error": why + " fell back past a corrupt step"})
+        self.step = restored
 
     def _guard(self, loss) -> bool:
         """True if the step is clean; handles policy when not."""
@@ -194,9 +219,13 @@ class TrainLoop:
         self.history["skipped_steps"].append(self.step)
         latest = self.manager.latest_step()
         if latest is not None:
-            # roll back to the last good snapshot (the skip would otherwise
-            # keep poisoned optimizer moments)
-            self.trainer.restore_checkpoint(self.manager, latest)
+            # roll back to the last good snapshot (the skip would
+            # otherwise keep poisoned optimizer moments); step=None =
+            # the VERIFIED fallback path — a corrupt newest committed
+            # step falls back instead of killing a recoverable run
+            self.trainer.restore_checkpoint(self.manager, None)
+            self._note_rollback(self.manager.last_restored_step,
+                                latest, "nan-skip rollback")
         return False
 
     def run(self, batches: Iterable, num_steps: Optional[int] = None,
@@ -204,7 +233,8 @@ class TrainLoop:
             on_step: Optional[Callable[[int, Any, Dict], None]] = None,
             prefetch: Union[int, str, None] = None, bucket_by=None,
             pad_value=0, debug_port: Optional[int] = None,
-            flight_recorder: Optional[FlightRecorder] = None):
+            flight_recorder: Optional[FlightRecorder] = None,
+            preemption: Union[bool, PreemptionHandler, None] = None):
         """Train until ``num_steps`` (global, including resumed) or data
         exhaustion. Returns the final step count — which can end below
         ``num_steps`` after an elastic recovery, since the data stream
@@ -250,6 +280,23 @@ class TrainLoop:
           :class:`telemetry.diag.AnomalyHalt`. Only consulted while
           telemetry is enabled — with telemetry off the loop executes
           no recorder code at all (the enabled-flag contract).
+
+        Fault tolerance (opt-in, ``resilience``):
+
+        - ``preemption=True`` installs a SIGTERM/SIGINT grace handler
+          for the duration of the run (pass an existing
+          :class:`resilience.PreemptionHandler` to share one across
+          components). On signal the loop finishes the in-flight step,
+          breaks out with ``self.status == "preempted"``, and close()
+          writes the final checkpoint (joining async writers) — the
+          run dies clean instead of mid-save. With the default
+          ``preemption=None`` no handler exists and the hot path
+          executes no resilience code (pinned by test).
+        - an armed :class:`resilience.FaultInjector` (chaos tests) is
+          consulted at the ``step.nan`` point after each step — a
+          ``corrupt`` rule poisons the loss so the nan machinery can
+          be driven deterministically; a raising rule simulates a
+          device fault through the elastic-recovery path.
         """
         if prefetch is not None or bucket_by is not None:
             from .data.device_loader import DevicePrefetcher
@@ -285,6 +332,19 @@ class TrainLoop:
         self._recoveries_this_run = 0
         self._faulted = False
         self.debug_server = None
+        self.status = "running"
+        # resolved ONCE, outside the hot path: with no handler and no
+        # armed injector both are None and each step pays two
+        # None-checks — the zero-cost-when-disabled contract
+        pre: Optional[PreemptionHandler] = None
+        own_pre = False
+        if preemption is not None and preemption is not False:
+            pre = (PreemptionHandler() if preemption is True
+                   else preemption)
+            if not pre.installed:
+                pre.install()
+                own_pre = True
+        inj = _faults.active()
         if self._watchdog:
             self._watchdog.start()
         try:
@@ -310,6 +370,15 @@ class TrainLoop:
                                  "queue_depth": pf.last_queue_depth,
                                  "last_real_rows": pf.last_real_rows})
             for batch in batches:
+                if pre is not None and pre.requested():
+                    # preemption grace: the in-flight step already
+                    # finished (top-of-body check also covers the
+                    # nan-skip/recovery continue paths); break out
+                    # clean and let close() write the final checkpoint
+                    # (joining async writers) — never die mid-save
+                    self.status = "preempted"
+                    self.history["preempted_at"] = self.step
+                    break
                 if num_steps is not None and self.step >= num_steps:
                     break
                 telem = telemetry.enabled()
@@ -321,6 +390,12 @@ class TrainLoop:
                     t0 = time.perf_counter()
                 try:
                     loss, metrics = self.trainer.train_step(batch)
+                    if inj is not None and inj.fire("step.nan"):
+                        # corrupt rule: poison the loss so the nan
+                        # guard / recorder path runs deterministically
+                        # (a raising rule lands in the except below —
+                        # the simulated-device-fault mode)
+                        loss = np.float32(np.nan)
                 except Exception as e:
                     if not self._is_recoverable(e) or \
                             self._recoveries_this_run >= \
@@ -340,14 +415,17 @@ class TrainLoop:
                     # slice-failure recovery: roll back to the latest
                     # snapshot and keep training (any process can do the
                     # same and rejoin — restartable-step elasticity).
+                    # step=None = the verified fallback path (a corrupt
+                    # newest step must not end a recoverable run).
                     # NOTE: the data stream is not rewound — batches
                     # consumed between the snapshot and the fault are
                     # skipped, so run() may end below num_steps.
                     self._recoveries_this_run += 1
+                    self.trainer.restore_checkpoint(self.manager, None)
+                    latest = self.manager.last_restored_step
                     self.history["recoveries"].append(
                         {"step": self.step, "rolled_back_to": latest,
                          "error": repr(e)})
-                    self.trainer.restore_checkpoint(self.manager, latest)
                     self.step = latest
                     continue
                 if telem and flight_recorder is not None:
@@ -395,12 +473,16 @@ class TrainLoop:
                                 # bookkeeping parity with the _guard
                                 # nan-skip this path subsumes: the
                                 # history entry AND the nan-skip
-                                # counter (dashboards alert on it)
+                                # counter (dashboards alert on it);
+                                # step=None = verified fallback restore
                                 self.history["skipped_steps"].append(
                                     self.step)
                                 _train_metrics()["nan_skips"].inc()
                                 self.trainer.restore_checkpoint(
-                                    self.manager, latest)
+                                    self.manager, None)
+                                self._note_rollback(
+                                    self.manager.last_restored_step,
+                                    latest, "recorder skip_step")
                             else:
                                 # NOTHING to roll back to: continuing
                                 # would train on poison — same
@@ -461,12 +543,26 @@ class TrainLoop:
                 if self.checkpoint_every and \
                         self.step % self.checkpoint_every == 0:
                     self.manager.save(self.step, self.trainer.state())
+        except BaseException:
+            # OUR exception, not sys.exc_info(): run() called from a
+            # caller's except block must not read the caller's
+            # in-flight exception as its own fault
+            self.status = "faulted"
+            raise
         finally:
             if self.debug_server is not None:
                 # joined before run() returns: no leaked daemon thread
                 # (the object stays on self for post-run inspection)
                 self.debug_server.stop()
+            if own_pre:
+                pre.uninstall()
+            if self.status == "running":
+                self.status = "completed"
             self.close()
+        if self.status == "preempted" and telemetry.enabled():
+            # counted AFTER close(): the final checkpoint is on disk,
+            # so this really was a clean preemption exit
+            _preempt_metrics()["clean_exits"].inc()
         return self.step
 
     def close(self):
@@ -486,14 +582,14 @@ class TrainLoop:
             deferred = e
         # never snapshot post-fault state: after an unrecovered device
         # fault the live buffers may be invalid (donation) or poisoned —
-        # the next run resumes from the last GOOD checkpoint instead
+        # the next run resumes from the last GOOD checkpoint instead.
+        # committed_steps (not all_steps): a torn dir for this step
+        # must not satisfy the final-snapshot check
         if self.step > 0 and not self._faulted and \
-                self.step not in self.manager.all_steps():
+                self.step not in self.manager.committed_steps():
             self.manager.save(self.step, self.trainer.state())
         self.manager.wait_until_finished()
         if deferred is not None:
-            import sys
-
             if sys.exc_info()[0] is None:
                 raise deferred
             # close() ran from an exception's finally — don't mask the

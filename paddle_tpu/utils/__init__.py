@@ -1,13 +1,15 @@
 """Utility helpers (reference: python/paddle/fluid/contrib/utils,
 contrib/memory_usage_calc.py)."""
 
+from .atomic import atomic_write_bytes, atomic_write_text
 from .dlpack import from_dlpack, from_torch, to_dlpack, to_torch
 from .flops import device_peak_flops, lowered_flops, mfu
 from .hdfs import HDFSClient, multi_download, multi_upload
 from .memory import (bytes_of_tree, estimate_training_memory, format_bytes,
                      memory_usage)
 
-__all__ = ["bytes_of_tree", "estimate_training_memory", "format_bytes",
+__all__ = ["atomic_write_bytes", "atomic_write_text", "bytes_of_tree",
+           "estimate_training_memory", "format_bytes",
            "memory_usage", "from_dlpack", "from_torch", "to_dlpack",
            "to_torch", "device_peak_flops", "lowered_flops", "mfu",
            "HDFSClient", "multi_download", "multi_upload"]

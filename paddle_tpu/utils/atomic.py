@@ -1,0 +1,51 @@
+"""Atomic file writes — the ONE copy of the temp-file + ``os.replace``
+discipline the framework shares (telemetry exporters, flight-recorder
+dumps, checkpoint manifests/leaves, the ``COMMITTED`` marker).
+
+The torn-write hazard ROADMAP documents for the compile cache applies to
+anything a concurrent reader — or a crash-restarted successor process —
+re-reads: a node-exporter scrape, a flight-recorder bundle, or a
+checkpoint shard landing mid-write would read as complete and lie.
+Every writer here stages to a same-directory temp file and publishes
+with ``os.replace``: readers see the old content or all of the new,
+never a torn middle, and a failed write unlinks the temp file leaving
+the target untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def _atomic_write(path: str, payload, mode: str, prefix: str) -> str:
+    """The one implementation both public helpers wrap — a future
+    change to the discipline (fsync-before-replace, ...) lands once."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=prefix,
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def atomic_write_text(path: str, text: str,
+                      prefix: str = ".pt_atomic_") -> str:
+    """Write ``text`` to ``path`` atomically (same-dir temp file +
+    ``os.replace``). Returns ``path``."""
+    return _atomic_write(path, text, "w", prefix)
+
+
+def atomic_write_bytes(path: str, data,
+                       prefix: str = ".pt_atomic_") -> str:
+    """Binary twin of :func:`atomic_write_text` (checkpoint leaves and
+    shard payloads; accepts any bytes-like). Returns ``path``."""
+    return _atomic_write(path, data, "wb", prefix)

@@ -76,6 +76,10 @@ SLOW_PATTERNS = [
     "test_checkpoint_scale.py",
     "test_moe.py::test_bert_moe_composes_with_tp_on_one_mesh",
     "test_examples.py",
+    # subprocess e2es (~20-30s each): must never ride into the mid
+    # tier via the bare test_chaos.py MID pattern
+    "test_chaos.py::test_sigkill_mid_save_resumes_last_committed",
+    "test_chaos.py::test_launch_relays_sigterm_within_grace",
 ]
 
 # mid tier = smoke + one representative per DEEP subsystem (pallas
@@ -141,6 +145,8 @@ MID_PATTERNS = [
     "test_transformer.py::test_decoder_causality",
     "test_transformer.py::test_greedy_decode_cached_matches_full_recompute",
     "test_train_loop.py",
+    "test_resilience.py",
+    "test_chaos.py",
     "test_fleet.py",
     "test_static.py",
     "test_sparse_embedding_grads.py",

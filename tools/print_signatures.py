@@ -36,6 +36,7 @@ MODULES = [
     "paddle_tpu.amp",
     "paddle_tpu.quant",
     "paddle_tpu.fleet",
+    "paddle_tpu.resilience",
     "paddle_tpu.train_loop",
     "paddle_tpu.slim",
     "paddle_tpu.utils",
