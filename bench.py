@@ -1224,10 +1224,93 @@ def bench_checkpoint(steps: int, batch_size: int, amp=None):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_sharding_plan(steps: int, batch_size: int, amp=None):
+    """OOM-gate bench for the sharding-plan plane (parallel/plan.py): a
+    model whose REPLICATED param+opt state exceeds the per-device byte
+    budget under dp=1, trained under an fsdp Plan instead. On a real
+    chip the budget is HBM and the replicated form simply OOMs; on CPU
+    backends (no hard HBM wall) the budget is MEASURED: replicated
+    per-device bytes = the full state (every device holds every byte),
+    budget = half of that, and the planned per-device footprint must
+    come in under it — it lands at ~replicated/fsdp, the evidence the
+    acceptance gate asks for. The timed loop is the steady-state planned
+    step; one lap runs under the transfer guard (zero resharding
+    copies) and the jit cache is pinned to one entry (zero retraces
+    after step 1). extras carry both footprints, the budget, and the
+    shard ratio."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer, parallel
+    from paddle_tpu.models import mnist as M
+    from paddle_tpu.parallel.plan import (Plan, guard_no_resharding,
+                                          max_device_bytes)
+    from paddle_tpu.utils.flops import lowered_flops
+
+    pt.seed(0)
+    batch_size = _cap(batch_size, 256)
+    n_dev = len(jax.devices())
+    fsdp = next((k for k in (8, 4, 2, 1) if k <= n_dev), 1)
+    plan = Plan(dp=1, fsdp=fsdp)
+    model = M.MnistMLP(hidden1=2048, hidden2=2048)
+    trainer = parallel.Trainer.supervised(
+        model, optimizer.Adam(1e-3), M.loss_fn, plan=plan, amp=amp)
+    state = {"params": trainer.params, "opt": trainer.opt_state}
+    # replicated per-device footprint: every device holds every byte
+    replicated = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                     for l in jax.tree_util.tree_leaves(state))
+    planned = max_device_bytes(state)
+    budget = replicated // 2
+    fits = planned <= budget < replicated
+
+    rng = np.random.default_rng(0)
+    assert batch_size >= fsdp > 0, \
+        f"batch {batch_size} must be >= fsdp {fsdp}"
+    batch_size -= batch_size % fsdp
+    sh = trainer.data_sharding()
+    batch = {"x": jax.device_put(jnp.asarray(
+                 rng.normal(size=(batch_size, 784)).astype(np.float32)),
+                 sh),
+             "label": jax.device_put(
+                 jnp.asarray(rng.integers(0, 10, batch_size)), sh)}
+    step_flops = lowered_flops(trainer._jit_step, trainer.params,
+                               trainer.buffers, trainer.opt_state,
+                               trainer._rng, batch,
+                               n_partitions=plan.num_devices)
+    for _ in range(3):
+        loss, _ = trainer.train_step(batch)
+    float(loss)
+    with guard_no_resharding():  # steady state pays no resharding copy
+        loss, _ = trainer.train_step(batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, _ = trainer.train_step(batch)
+        if i % 4 == 3:
+            float(loss)
+    float(loss)
+    dt = time.perf_counter() - t0
+    assert trainer._jit_step._cache_size() == 1, \
+        "planned step retraced after step 1"
+    extras = {
+        "step_time_ms": round(dt / steps * 1e3, 3),
+        "fsdp": fsdp,
+        "peak_mem_bytes_replicated": int(replicated),
+        "peak_mem_bytes_planned": int(planned),
+        "byte_budget": int(budget),
+        "fits_budget_only_planned": bool(fits),
+        "shard_ratio": round(replicated / planned, 3) if planned else None,
+    }
+    if step_flops:
+        extras["flops_per_sec"] = step_flops * steps / dt
+    return steps * batch_size / dt, "examples/sec", extras
+
+
 MODELS = {
     "mnist_mlp": bench_mnist_mlp,
     "input_pipeline": bench_input_pipeline,
     "checkpoint": bench_checkpoint,
+    "sharding_plan": bench_sharding_plan,
     "alexnet": bench_alexnet,
     "googlenet": bench_googlenet,
     "stacked_lstm": bench_stacked_lstm,
@@ -1862,12 +1945,16 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
     from paddle_tpu.utils.flops import mfu as _mfu
 
     # latency percentiles from the inference harness, the
-    # speculative-decode acceptance stats, and the input-pipeline A/B
-    # numbers ride along verbatim
+    # speculative-decode acceptance stats, the input-pipeline A/B
+    # numbers, and the sharding-plan byte-budget evidence ride along
+    # verbatim
     line.update({k: v for k, v in extras.items()
                  if k.startswith("latency_ms_")
                  or k in ("accept_per_round", "rounds", "prefetch_off",
-                          "prefetch_on", "overlap_speedup")})
+                          "prefetch_on", "overlap_speedup", "fsdp",
+                          "peak_mem_bytes_replicated",
+                          "peak_mem_bytes_planned", "byte_budget",
+                          "fits_budget_only_planned", "shard_ratio")})
     flops_per_sec = extras.get("flops_per_sec")
     line["mfu"] = None
     if flops_per_sec:

@@ -59,6 +59,7 @@ from .resilience.integrity import (ChecksumError, checksum_bytes,
                                    verify_bytes)
 from .resilience.retry import retry_io
 from .utils.atomic import atomic_write_bytes, atomic_write_text
+from .utils.memory import owned_on_device
 
 
 @telemetry.cached_instruments
@@ -364,6 +365,18 @@ def _shard_regions(leaf):
             for k, v in sorted(regions.items())]
 
 
+def _owned_host(a) -> np.ndarray:
+    """Owned host copy of a device->host snapshot. On the cpu backend
+    ``device_get`` / ``shard.data`` views are ZERO-COPY aliases of the
+    live device buffers; the overlapped training step the caller resumes
+    may DONATE those buffers before the (possibly async) file write
+    reads them — a garbage read or SIGSEGV. Copy leaf-by-leaf at
+    snapshot time; results that already own their bytes (every non-cpu
+    backend's D2H copy) pass through untouched."""
+    a = np.asarray(a)
+    return a if a.base is None else np.array(a)
+
+
 def _local_shard_payload(leaf):
     """Snapshot THIS process's owned shards (replica 0 of each region —
     exactly one device globally owns each region's replica 0, so every
@@ -373,7 +386,7 @@ def _local_shard_payload(leaf):
         if shard.replica_id != 0:
             continue
         starts = tuple((s.start or 0) for s in shard.index)
-        out.append(("_".join(map(str, starts)), np.asarray(shard.data)))
+        out.append(("_".join(map(str, starts)), _owned_host(shard.data)))
     return out
 
 
@@ -498,14 +511,19 @@ def save_state(directory: str, tree, *, async_save: bool = False,
     # snapshot to host NOW — training may donate/overwrite these buffers.
     # Whole-leaf snapshots only for process-0-writable leaves (ONE batched
     # device_get so D2H transfers overlap); sharded leaves snapshot their
-    # LOCAL owned shards on every process.
+    # LOCAL owned shards on every process. Every snapshot is copied to an
+    # OWNED host array leaf-by-leaf (sync and async paths alike): cpu-
+    # backend device_get returns zero-copy views of the live buffers, and
+    # the next overlapped step donating them under a view would read as
+    # garbage (or SIGSEGV) at file-write time.
     entries, payload, seen = [], [], set()
     rank0 = jax.process_index() == 0
     whole = [(path, leaf) for path, leaf in flat
              if not sharded_mode(leaf)]
-    whole_host = dict(zip(
-        [p for p, _ in whole],
-        jax.device_get([leaf for _, leaf in whole])))
+    whole_host = {
+        p: _owned_host(v) for p, v in zip(
+            [p for p, _ in whole],
+            jax.device_get([leaf for _, leaf in whole]))}
     for path, leaf in flat:
         base = _sanitize(path)
         enforce(base not in seen, "leaf path collision on %s", base)
@@ -522,7 +540,7 @@ def save_state(directory: str, tree, *, async_save: bool = False,
             for key, arr in _local_shard_payload(leaf):
                 payload.append((f"{base}.shard_{key}.npy", arr))
         else:
-            arr = np.asarray(whole_host[path])
+            arr = whole_host[path]
             entries.append({"path": path, "file": base + ".npy",
                             "dtype": str(arr.dtype),
                             "shape": list(arr.shape),
@@ -623,12 +641,9 @@ def save_state(directory: str, tree, *, async_save: bool = False,
             m["bytes"].inc(sum(a.nbytes for _, a in payload))
 
     if async_save:
-        # snapshot to OWNED host copies first: device_get on the cpu
-        # backend can return zero-copy views of live jax buffers, and
-        # the training step the caller overlaps with this write may
-        # DONATE those buffers — np.save in the writer thread would
-        # then read freed memory
-        payload = [(fname, np.array(arr)) for fname, arr in payload]
+        # payload already holds OWNED host copies (_owned_host at
+        # snapshot time, shared with the sync path) — the writer thread
+        # can never read a buffer the overlapped step donated
         return _WriteHandle(write, directory=directory)
     write()
     return None
@@ -798,7 +813,12 @@ def restore_state(directory: str, *, mesh: Optional[Mesh] = None,
             # processes (device_put to non-addressable devices does not)
             x = jax.make_array_from_callback(
                 shape, sh, lambda idx, _a=arr: _a[idx])
-        leaves.append(x)
+        # the CPU backend can zero-copy these host temporaries into the
+        # device buffers; a consumer that DONATES a restored leaf (every
+        # Trainer step) would then hand numpy-owned memory to the
+        # runtime — the flaky restore-then-train SIGSEGV. One on-device
+        # copy re-homes the bytes into runtime-owned buffers.
+        leaves.append(owned_on_device(x))
 
     tree = _unskeleton(manifest["skeleton"], leaves)
     if target is not None:

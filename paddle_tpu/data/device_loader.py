@@ -200,6 +200,15 @@ class DevicePrefetcher:
     mesh's ``P("dp")`` batch sharding when only ``mesh`` is given; plain
     default placement otherwise).
 
+    ``stage_per_shard`` (sharding-plan staging): stage each leaf
+    shard-by-shard — only the slices this process's devices hold are
+    ``device_put``, and the global array assembles via
+    ``jax.make_array_from_single_device_arrays``. Auto-enabled whenever
+    the sharding spans non-addressable devices (a multi-host plan mesh),
+    where it is the only staging that works AND each host's transfer
+    volume drops to its own shard; force ``True`` to take the path on a
+    fully-addressable mesh (tests do).
+
     ``size`` >= 1 enables the background staging thread with that many
     queue slots (2 = double buffering, 3 = triple); ``size=0`` stages
     synchronously in the consumer thread (bucketing without prefetch).
@@ -239,7 +248,8 @@ class DevicePrefetcher:
                  bucket_by=None, pad_value=0, axis: int = 0,
                  donate_safe: bool = True,
                  auto_cap: Optional[int] = None,
-                 auto_threshold_s: Optional[float] = None):
+                 auto_threshold_s: Optional[float] = None,
+                 stage_per_shard: Optional[bool] = None):
         self.auto = size == "auto"
         if self.auto:
             self.auto_cap = int(auto_cap if auto_cap is not None
@@ -284,9 +294,51 @@ class DevicePrefetcher:
             enforce(axis >= 0, "axis must be >= 0, got %s", axis)
             self.axis = int(axis)
         self.donate_safe = donate_safe
+        # per-shard staging (the sharding-plan path): each process
+        # device_puts ONLY the shard slices its own devices hold and
+        # assembles the global array via
+        # jax.make_array_from_single_device_arrays — on a multi-host
+        # mesh no host ever materializes (or transfers) rows another
+        # host consumes. Default None = automatic: forced ON whenever
+        # the sharding spans devices this process cannot address (a
+        # whole-batch device_put there would fail outright); OFF for
+        # fully-addressable shardings where a single device_put lets
+        # the runtime scatter (tests force it ON to exercise the path
+        # single-process).
+        if stage_per_shard is None:
+            stage_per_shard = bool(
+                self.sharding is not None
+                and not getattr(self.sharding, "is_fully_addressable",
+                                True))
+        self.stage_per_shard = bool(stage_per_shard)
+        enforce(not self.stage_per_shard or self.sharding is not None,
+                "stage_per_shard needs a sharding (or mesh) to stage "
+                "onto")
         self.last_real_rows: Optional[int] = None
 
     # -- staging (worker side) ----------------------------------------------
+
+    def _put_per_shard(self, leaf):
+        """Stage one leaf shard-by-shard: device_put ONLY the slices
+        this process's devices own (``addressable_devices_indices_map``)
+        and assemble the global array with
+        ``jax.make_array_from_single_device_arrays`` — the per-host
+        staging contract a multi-host sharding plan needs (a whole-array
+        ``device_put`` cannot even target non-addressable devices).
+        Donation safety matches the whole-array path: a live jax.Array
+        source is sliced through an owned host copy, never aliased."""
+        import jax
+
+        host = np.asarray(leaf)
+        parts = []
+        for dev, idx in self.sharding.addressable_devices_indices_map(
+                host.shape).items():
+            part = host[idx]
+            if self.donate_safe and isinstance(leaf, jax.Array):
+                part = np.array(part)
+            parts.append(jax.device_put(part, dev))
+        return jax.make_array_from_single_device_arrays(
+            host.shape, self.sharding, parts)
 
     def _source(self) -> Iterator[Any]:
         src = self.batches
@@ -309,6 +361,9 @@ class DevicePrefetcher:
         def put(leaf):
             if getattr(leaf, "shape", None) is None:
                 return leaf  # python scalar rides along untouched
+            if (self.stage_per_shard and np.ndim(leaf) >= 1
+                    and self.sharding is not None):
+                return self._put_per_shard(leaf)
             if self.donate_safe and isinstance(leaf, jax.Array):
                 # device_put on an already-placed array is an alias, and
                 # a consumer step donating its batch would invalidate

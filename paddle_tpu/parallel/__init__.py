@@ -5,6 +5,8 @@ itself lives in paddle_tpu.core.mesh.
 """
 
 from .api import DataParallel, Trainer
+from .plan import (Plan, compile_step, device_bytes, guard_no_resharding,
+                   host_init, max_device_bytes)
 from .context_parallel import (context_parallel_attention, ring_attention,
                                sharded_flash_attention, ulysses_attention)
 from .collective import (allgather, allreduce, all_to_all, axis_index,
@@ -23,7 +25,10 @@ from .sharding import (OptStateRules, constraint, infer_param_spec,
                        shard_params, transformer_tp_rules, zero_dp_rules)
 
 __all__ = [
-    "DataParallel", "Trainer", "allgather", "allreduce", "all_to_all",
+    "DataParallel", "Trainer",
+    "Plan", "compile_step", "device_bytes", "guard_no_resharding",
+    "host_init", "max_device_bytes",
+    "allgather", "allreduce", "all_to_all",
     "axis_index", "broadcast", "context_parallel_attention", "ppermute",
     "reduce_scatter", "ring_attention",
     "sharded_flash_attention", "ulysses_attention",

@@ -7,6 +7,14 @@ train step. Data parallelism is a *sharding*, not a program rewrite: params
 replicated, batch split over "dp"; XLA inserts gradient all-reduces (the whole
 multi_devices_graph_pass, reference: multi_devices_graph_pass.cc:450, becomes
 compiler work). Buffers donate so updates are in-place in HBM.
+
+With a :class:`..plan.Plan` the trainer goes multi-chip: state is placed
+**sharded by construction** (params staged host->shard, opt moments born
+sharded from ``zeros_like`` on placed params — no device ever holds the
+replicated bytes), and every step variant (plain / gradient-merge /
+scan-fused / eval) compiles through one :func:`..plan.compile_step`
+path — ``pjit`` with full in/out shardings + donation for explicit
+(fsdp/tp) plans, a ``shard_map``-wrapped ``jax.jit`` for pure DP.
 """
 
 from __future__ import annotations
@@ -25,6 +33,7 @@ from ..core.enforce import enforce
 from ..core.mesh import get_mesh
 from ..nn.layer import Layer
 from ..optimizer.optimizers import Optimizer
+from .plan import Plan, compile_step, pmean_axes
 
 
 @telemetry.cached_instruments
@@ -49,11 +58,21 @@ class Trainer:
                  build_strategy: Optional[BuildStrategy] = None,
                  param_spec: Optional[Dict[str, P]] = None,
                  opt_state_rules=None, amp: Optional[str] = None,
-                 grad_accum_steps: int = 1):
+                 grad_accum_steps: int = 1, plan: Optional[Plan] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_builder = loss_builder
-        self.mesh = mesh or get_mesh()
+        self.plan = plan
+        if plan is not None:
+            enforce(param_spec is None and opt_state_rules is None,
+                    "plan subsumes param_spec/opt_state_rules — express "
+                    "the specs as Plan rules instead")
+            enforce(mesh is None or mesh is plan.mesh,
+                    "pass either mesh or plan, not both (the plan owns "
+                    "its mesh)")
+            self.mesh = plan.mesh
+        else:
+            self.mesh = mesh or get_mesh()
         self.strategy = build_strategy or BuildStrategy()
         # amp: policy name ("mixed_bf16" / "mixed_fp16" / ...) applied at
         # trace time around the loss (reference: contrib/mixed_precision
@@ -65,43 +84,128 @@ class Trainer:
         # micro-steps, apply the optimizer on the K-th
         enforce(grad_accum_steps >= 1, "grad_accum_steps must be >= 1")
         self.grad_accum_steps = grad_accum_steps
+        # axes the shard_map fallback reduces grads/loss over (empty for
+        # plan-less and explicit-pjit compilation, where GSPMD inserts
+        # the collectives)
+        self._pmean_axes = pmean_axes(plan)
 
         rep = NamedSharding(self.mesh, P())
 
-        def place(tree, spec_map=None):
-            def put(path_leaf):
-                return jax.device_put(path_leaf, rep)
+        if plan is not None:
+            # sharded by construction: each param stages host->shard per
+            # the plan (never materialized replicated on any device);
+            # the model re-points at the placed arrays so the eager
+            # init-time copies on the default device are released
+            self.params = plan.place(model.named_parameters())
+            model.set_parameters(self.params)
+            self.buffers = plan.place(model.named_buffers())
+            model.set_buffers(self.buffers)
+        else:
+            def place(tree):
+                return jax.tree_util.tree_map(
+                    lambda leaf: jax.device_put(leaf, rep), tree)
 
-            return jax.tree_util.tree_map(put, tree)
-
-        self.params = place(model.named_parameters())
-        if param_spec:
-            for name, spec in param_spec.items():
-                self.params[name] = jax.device_put(
-                    self.params[name], NamedSharding(self.mesh, spec))
-        self.buffers = place(model.named_buffers())
+            self.params = place(model.named_parameters())
+            if param_spec:
+                for name, spec in param_spec.items():
+                    self.params[name] = jax.device_put(
+                        self.params[name], NamedSharding(self.mesh, spec))
+            self.buffers = place(model.named_buffers())
         # opt state inherits each param's sharding (init uses zeros_like on
         # the already-placed params) — re-placing replicated would defeat
-        # param_spec's memory sharding for the moments
+        # the plan's/param_spec's memory sharding for the moments
         self.opt_state = optimizer.init(self.params)
-        if opt_state_rules is not None:
+        if plan is not None:
+            # only non-mesh leaves (step counters, loss-scale scalars)
+            # re-place; moments born sharded stay sharded (ZeRO-style)
+            self.opt_state = plan.place_replicated(self.opt_state)
+        elif opt_state_rules is not None:
             # ZeRO-style: shard large moment leaves over dp (the PS-sharded
             # optimizer-state capability, reference:
             # transpiler/distribute_transpiler.py:702)
             self.opt_state = opt_state_rules.place(self.opt_state, self.mesh)
         self._rng = prandom.next_key()
+        if plan is not None and plan.num_devices > 1:
+            self._rng = jax.device_put(self._rng, rep)
         if self.grad_accum_steps > 1:
             self._accum = jax.tree_util.tree_map(jnp.zeros_like, self.params)
             self._accum_count = jnp.zeros((), jnp.int32)
+            if plan is not None:
+                self._accum_count = jax.device_put(self._accum_count, rep)
             donate = (0, 1, 2, 3, 4) if self.strategy.donate_inputs else ()
-            self._jit_step = jax.jit(self._accum_step, donate_argnums=donate)
+            self._jit_step = compile_step(
+                plan, self._accum_step, donate_argnums=donate,
+                **self._step_shardings(accum=True))
         else:
             donate = (0, 1, 2) if self.strategy.donate_inputs else ()
-            self._jit_step = jax.jit(self._step, donate_argnums=donate)
-        self._jit_eval = jax.jit(self._eval_step)
+            self._jit_step = compile_step(
+                plan, self._step, donate_argnums=donate,
+                **self._step_shardings())
+        self._jit_eval = compile_step(plan, self._eval_step,
+                                      **self._eval_shardings())
         self._multi_cache = {}
 
+    # --- plan sharding derivation -------------------------------------------
+
+    @staticmethod
+    def _sharding_tree(tree):
+        """Mirror a placed state tree into its shardings (every leaf is
+        a mesh-placed jax.Array after init, so this IS the truth the
+        pjit in/out shardings must match for a zero-copy steady state)."""
+        return jax.tree_util.tree_map(lambda x: x.sharding, tree)
+
+    def _step_shardings(self, accum: bool = False) -> Dict[str, Any]:
+        """``compile_step`` kwargs for the train-step signatures. Only
+        explicit plans need them (pjit); plan-less and pure-DP
+        compilation derives everything from placement/shard_map."""
+        if self.plan is None or not self.plan.explicit:
+            return {}
+        rep = NamedSharding(self.mesh, P())
+        p_sh = self._sharding_tree(self.params)
+        b_sh = self._sharding_tree(self.buffers)
+        o_sh = self._sharding_tree(self.opt_state)
+        batch_sh = self.plan.batch_sharding()
+        if accum:
+            # (params, buffers, opt_state, accum, count, rng, batch)
+            return {
+                "in_shardings": (p_sh, b_sh, o_sh, p_sh, rep, rep,
+                                 batch_sh),
+                "out_shardings": (rep, rep, p_sh, b_sh, o_sh, p_sh, rep),
+            }
+        # (params, buffers, opt_state, rng, batch) ->
+        # (loss, metrics, params, buffers, opt_state)
+        return {
+            "in_shardings": (p_sh, b_sh, o_sh, rep, batch_sh),
+            "out_shardings": (rep, rep, p_sh, b_sh, o_sh),
+        }
+
+    def _eval_shardings(self) -> Dict[str, Any]:
+        if self.plan is None or not self.plan.explicit:
+            return {}
+        rep = NamedSharding(self.mesh, P())
+        return {
+            "in_shardings": (self._sharding_tree(self.params),
+                             self._sharding_tree(self.buffers),
+                             self.plan.batch_sharding()),
+            "out_shardings": (rep, rep),
+        }
+
     # --- pure step functions ------------------------------------------------
+
+    def _shard_rng(self, rng):
+        """Per-shard RNG under the shard_map fallback: fold the batch
+        axes' indices into the key so dropout draws differ per shard
+        (the replicated key would repeat masks across the dp axis)."""
+        for ax in self._pmean_axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
+        return rng
+
+    def _pmean(self, tree):
+        """Reduce per-shard values over the batch axes under the
+        shard_map fallback (no-op when GSPMD owns the collectives)."""
+        if not self._pmean_axes:
+            return tree
+        return lax.pmean(tree, self._pmean_axes)
 
     def _step(self, params, buffers, opt_state, rng, batch):
         from ..amp import MixedPrecisionOptimizer
@@ -112,6 +216,7 @@ class Trainer:
         scope = (policy_scope(self.amp_policy) if self.amp_policy
                  else contextlib.nullcontext())
         scaled = isinstance(self.optimizer, MixedPrecisionOptimizer)
+        rng = self._shard_rng(rng)
 
         def lf(p):
             with scope:
@@ -123,6 +228,12 @@ class Trainer:
 
         (_, (loss, metrics, new_buffers)), grads = jax.value_and_grad(
             lf, has_aux=True)(params)
+        # shard_map fallback: the gradient all-reduce is OURS to write
+        # (mean over batch shards == grad of the global-mean loss);
+        # loss/metrics/buffer updates reduce the same way so every
+        # shard applies an identical update and outputs stay replicated
+        loss, metrics, new_buffers, grads = self._pmean(
+            (loss, metrics, new_buffers, grads))
         new_params, new_opt_state = self.optimizer.apply(params, grads,
                                                          opt_state)
         return loss, metrics, new_params, new_buffers, new_opt_state
@@ -138,6 +249,7 @@ class Trainer:
         scope = (policy_scope(self.amp_policy) if self.amp_policy
                  else contextlib.nullcontext())
         scaled = isinstance(self.optimizer, MixedPrecisionOptimizer)
+        rng = self._shard_rng(rng)
 
         def lf(p):
             with scope:
@@ -149,6 +261,8 @@ class Trainer:
 
         (_, (loss, metrics, new_buffers)), grads = jax.value_and_grad(
             lf, has_aux=True)(params)
+        loss, metrics, new_buffers, grads = self._pmean(
+            (loss, metrics, new_buffers, grads))
         k = self.grad_accum_steps
         accum = jax.tree_util.tree_map(lambda a, g: a + g, accum, grads)
         count = count + 1
@@ -176,7 +290,7 @@ class Trainer:
         with scope:
             loss, (metrics, _) = self.loss_builder(params, buffers, None,
                                                    batch)
-        return loss, metrics
+        return self._pmean((loss, metrics))
 
     # --- driver API ---------------------------------------------------------
 
@@ -246,7 +360,11 @@ class Trainer:
                 return losses[-1], last, params, buffers, opt_state
 
             donate = (0, 1, 2) if self.strategy.donate_inputs else ()
-            fn = jax.jit(many, donate_argnums=donate)
+            # the scan-fused step rides the SAME compile path as the
+            # single step: pjit shardings / shard_map wrap carry over
+            # (the scan body calls _step, which is collective-aware)
+            fn = compile_step(self.plan, many, donate_argnums=donate,
+                              **self._step_shardings())
             self._multi_cache[key] = fn
         return fn
 
@@ -260,8 +378,11 @@ class Trainer:
         return self.model
 
     def data_sharding(self) -> NamedSharding:
-        """Sharding for input batches: leading dim over dp (feed via
+        """Sharding for input batches: the plan's batch sharding when
+        one rides the trainer, else leading dim over dp (feed via
         DataFeeder(sharding=...) — the feed_and_split analog)."""
+        if self.plan is not None:
+            return self.plan.batch_sharding()
         return NamedSharding(self.mesh, P("dp"))
 
     # --- checkpoint/resume (SURVEY §5.4) ------------------------------------
@@ -288,18 +409,41 @@ class Trainer:
         else:
             save_state(manager_or_dir, self.state())
 
+    def state_shardings(self) -> Optional[Dict[str, Any]]:
+        """Shardings of the live state (plan trainers only): what a
+        restore must reshard saved leaves onto, regardless of the mesh
+        the checkpoint was written from (dp=8 -> fsdp=4 x dp=2 works)."""
+        if self.plan is None:
+            return None
+        sh: Dict[str, Any] = {
+            "params": self._sharding_tree(self.params),
+            "buffers": self._sharding_tree(self.buffers),
+            "opt_state": self._sharding_tree(self.opt_state),
+            "rng": self.plan.replicated(),
+        }
+        if self.grad_accum_steps > 1:
+            sh["grad_accum"] = {
+                "accum": self._sharding_tree(self._accum),
+                "count": self.plan.replicated()}
+        return sh
+
     def restore_checkpoint(self, manager_or_dir,
                            step: Optional[int] = None) -> None:
         """Restore in place, resharding saved leaves onto this trainer's
         mesh (works across mesh shapes — the survey's upgrade over the
-        reference's shape-must-match load)."""
+        reference's shape-must-match load). Plan trainers reshard onto
+        the PLAN's shardings, so a checkpoint written under any other
+        plan shape restores straight into the declared layout."""
         from ..checkpoint import CheckpointManager, restore_state
 
+        shardings = self.state_shardings()
         if isinstance(manager_or_dir, CheckpointManager):
             st = manager_or_dir.restore(step, mesh=self.mesh,
+                                        shardings=shardings,
                                         target=self.state())
         else:
             st = restore_state(manager_or_dir, mesh=self.mesh,
+                               shardings=shardings,
                                target=self.state())
         self.params = st["params"]
         self.buffers = st["buffers"]

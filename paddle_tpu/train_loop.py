@@ -369,6 +369,14 @@ class TrainLoop:
                                  "auto": pf.auto,
                                  "queue_depth": pf.last_queue_depth,
                                  "last_real_rows": pf.last_real_rows})
+                plan = getattr(self.trainer, "plan", None)
+                if plan is not None:
+                    # the sharding plan on /statusz: mesh axes, compile
+                    # mode, and which params ride which spec
+                    tp = self.trainer
+                    self.debug_server.add_status(
+                        "sharding_plan",
+                        lambda: plan.describe(getattr(tp, "params", None)))
             for batch in batches:
                 if pre is not None and pre.requested():
                     # preemption grace: the in-flight step already

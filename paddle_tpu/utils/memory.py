@@ -22,6 +22,33 @@ _OPT_SLOTS = {"sgd": 0, "momentum": 1, "adam": 2, "adamw": 2, "lamb": 2,
               "adagrad": 1, "adadelta": 2, "rmsprop": 2, "ftrl": 2}
 
 
+def owned_on_device(x):
+    """Re-home a device array into runtime-owned buffers when the CPU
+    backend may have zero-copied it from host memory.
+
+    The CPU PJRT client aliases suitably-aligned numpy arrays straight
+    into device buffers on ``device_put`` / ``make_array_from_callback``.
+    That is fine for read-only consumers, but a jitted step that DONATES
+    such a leaf hands memory numpy still owns to the runtime for output
+    reuse — intermittent heap corruption once the host side frees it
+    (the long-flaky ``TestElasticRecovery`` SIGSEGV: checkpoint-restored
+    params donated by the next train step). One on-device copy moves the
+    bytes into buffers the runtime allocated itself. Non-CPU backends
+    always copy host->HBM on transfer, and non-addressable (multi-
+    process) leaves cannot take an eager op — both pass through.
+    """
+    if not isinstance(x, jax.Array) or not getattr(
+            x, "is_fully_addressable", True):
+        return x
+    try:
+        dev = next(iter(x.sharding.device_set))
+    except Exception:
+        return x
+    if dev.platform != "cpu":
+        return x
+    return jnp.copy(x)
+
+
 def bytes_of_tree(tree) -> int:
     """Exact byte count of a pytree of arrays/specs."""
     total = 0

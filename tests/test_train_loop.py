@@ -80,7 +80,10 @@ class TestTrainLoop:
                          nan_policy="skip")
         good = list(batches(2))
         loop.run(iter(good))  # checkpoints at step 2
-        params_before = {k: np.asarray(v) for k, v in tr.params.items()}
+        # owned copies, NOT np.asarray views: the bad step below DONATES
+        # tr.params, and a cpu-backend zero-copy view would compare
+        # garbage after the rollback
+        params_before = {k: np.array(v) for k, v in tr.params.items()}
         bad = {"x": jnp.full((8, 784), np.nan, jnp.float32),
                "label": jnp.asarray(RNG.integers(0, 10, 8))}
         loop.run(iter([bad]), resume=False)

@@ -20,7 +20,7 @@ run() { echo "\$ $*" | tee -a "$LOG"; "$@" 2>>"$LOG" | tee -a "$LOG"; }
 
 MODELS="mnist_mlp alexnet googlenet stacked_lstm vgg16 se_resnext50 \
 resnet50 bert_base bert_long bert_packed bert_moe gpt vit transformer_nmt \
-nmt_decode gpt_decode deepfm deepfm_sparse"
+nmt_decode gpt_decode deepfm deepfm_sparse sharding_plan"
 
 echo "== model pass (bf16 defaults) ==" | tee -a "$LOG"
 for m in $MODELS; do
