@@ -766,7 +766,7 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
                     max_new: int = 64, smoke: bool = False,
                     weight_only: bool = False, paged: bool = False,
                     gamma: int = 0, prefill_chunk=None,
-                    decode_steps: int = 1):
+                    decode_steps: int = 1, kv_dtype=None):
     """Continuous-batching serving throughput (serving.BatchedDecoder):
     2x``batch_size`` requests with MIXED prompt lengths over a
     ``batch_size``-slot arena — generated tokens/sec across the whole
@@ -775,7 +775,12 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     --gamma g serves SPECULATIVELY (per-row drafts + one per-row verify
     chunk per round, 2-layer draft — accept_per_round extra gives the
     real-pair speedup formula); --prefill-chunk C smooths admission by
-    prefilling C tokens per serving tick instead of a whole prompt."""
+    prefilling C tokens per serving tick instead of a whole prompt;
+    --kv-dtype int8 serves over the QUANTIZED page pool (implies
+    --paged) and additionally measures the serving-DENSITY A/B: max
+    concurrent sessions before admission backpressure at ONE page-pool
+    HBM budget, fp32 KV vs int8 KV, plus the greedy-decode parity
+    agreement (the density acceptance gate's evidence)."""
     import contextlib
 
     import paddle_tpu as pt
@@ -783,6 +788,8 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     from paddle_tpu.models import gpt as G
     from paddle_tpu.serving import BatchedDecoder
 
+    if kv_dtype is not None:
+        paged = True  # quantized KV lives in the page pool
     pt.seed(0)
     slots = _cap(batch_size, 2 if smoke else 8)
     cfg = G.GPTConfig.small()
@@ -808,6 +815,8 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     if paged:
         kw = dict(pages=max(slots * (cap // 64) // 2, slots),
                   page_size=64)
+        if kv_dtype is not None:
+            kw["kv_dtype"] = kv_dtype
     if gamma > 0:
         dcfg = dataclasses.replace(cfg, num_layers=2)
         pt.seed(1)
@@ -840,7 +849,70 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     if gamma > 0:
         extras["accept_per_round"] = round(
             dec.spec_accepted / max(1, dec.spec_row_rounds), 3)
+    if kv_dtype is not None:
+        extras["kv_dtype"] = kv_dtype
+        extras.update(_kv_serve_density(model, cap, smoke))
     return total / dt, "tokens/sec", extras
+
+
+def _kv_serve_density(model, cap: int, smoke: bool):
+    """The serving-density A/B behind ``--kv-dtype int8``: at ONE
+    page-pool HBM budget (what ``base_pages`` fp32 pages cost), how
+    many concurrent sessions does each KV storage form admit before
+    the pool backpressures? Sessions are real admissions (one page
+    each), counted after a single admission pass with slots sized off
+    the critical path — pages are the binding resource, exactly the
+    production regime (KV HBM sets the per-chip session ceiling). Both
+    arms then serve the SAME prompts to completion greedily; the
+    agreement of rid-matched outputs is the parity evidence (near-tie
+    argmax flips compound on an untrained model, so first-half
+    agreement is the gate — the same contract the spec-decode bench
+    uses)."""
+    from paddle_tpu.serving import BatchedDecoder, PagedKVPool
+
+    attn0 = model.blocks[0].self_attn
+    nblk = len(model.blocks)
+    ps = 64
+
+    def per_page(kvd):
+        return PagedKVPool(1, ps, attn0.num_kv_heads, attn0.head_dim,
+                           arrays=False, kv_dtype=kvd).pool_nbytes
+
+    base_pages = 8 if smoke else 24
+    budget = base_pages * 2 * nblk * per_page(None)
+    pages = {kvd: int(budget // (2 * nblk * per_page(kvd)))
+             for kvd in (None, "int8")}
+    # enough submissions that BOTH arms hit pool backpressure
+    n_req = pages["int8"] + 2
+    rng = np.random.default_rng(7)
+    vocab = model.cfg.vocab_size
+    plen, mnew = 24, 8
+    prompts = [rng.integers(1, vocab, (plen,)).astype(np.int32)
+               for _ in range(n_req)]
+    out = {"kv_page_bytes_fp32": per_page(None),
+           "kv_page_bytes_int8": per_page("int8"),
+           "kv_pool_budget_bytes": int(budget)}
+    outs_by_arm = {}
+    for kvd in (None, "int8"):
+        dec = BatchedDecoder(model, slots=n_req, capacity=cap,
+                             pages=pages[kvd], page_size=ps,
+                             kv_dtype=kvd)
+        rids = [dec.submit(p, mnew) for p in prompts]
+        dec._admit()  # ONE admission wave: pages bind, slots don't
+        admitted = sum(o is not None for o in dec.owner)
+        out[f"max_sessions_{kvd or 'fp32'}"] = int(admitted)
+        served = dec.run()
+        outs_by_arm[kvd] = [served[r] for r in rids]
+    if out["max_sessions_fp32"]:
+        out["session_ratio"] = round(
+            out["max_sessions_int8"] / out["max_sessions_fp32"], 3)
+    agree = [float((a == b).mean()) for a, b in
+             zip(outs_by_arm[None], outs_by_arm["int8"])]
+    half = [float((a[:len(a) // 2] == b[:len(b) // 2]).mean())
+            for a, b in zip(outs_by_arm[None], outs_by_arm["int8"])]
+    out["kv_parity_agree"] = round(sum(agree) / len(agree), 3)
+    out["kv_parity_gate"] = bool(sum(half) / len(half) >= 0.9)
+    return out
 
 
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
@@ -1306,8 +1378,107 @@ def bench_sharding_plan(steps: int, batch_size: int, amp=None):
     return steps * batch_size / dt, "examples/sec", extras
 
 
+def bench_quant_comm(steps: int, batch_size: int, amp=None):
+    """Compressed-gradient-allreduce A/B (quant.collectives): the SAME
+    pure-DP plan trained with the fp32 ``lax.pmean`` vs the hand-written
+    int8 ring psum (``Plan(grad_compression="int8")``), on however many
+    devices are up (8-device sim on CPU; real chips on-TPU). Evidence
+    the acceptance gate asks for: per-step collective payload bytes
+    int8 vs fp32 (counter-verified against
+    ``pt_collective_bytes_total{compressed=}``), step time both ways,
+    and the TRAJECTORY PARITY GATE — K lockstep steps from one seed
+    must keep the loss gap inside tolerance, or the extras say so
+    loudly. On ICI-rich single-host sims the ring moves host-memory
+    bytes, so step-time parity (not speedup) is the CPU expectation;
+    the byte counters are the transferable number."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer, parallel, telemetry
+    from paddle_tpu.models import mnist as M
+    from paddle_tpu.parallel.plan import Plan
+    from paddle_tpu.quant.collectives import _comm_metrics
+
+    n_dev = len(jax.devices())
+    dp = next((k for k in (8, 4, 2) if k <= n_dev), 0)
+    if dp < 2:
+        raise RuntimeError(
+            f"quant_comm needs >= 2 devices for the allreduce ring, "
+            f"got {n_dev} (is the 8-device sim guard stripped?)")
+    batch_size = _cap(batch_size, 256)
+    # round to the dp grid, never below one row per shard (an explicit
+    # --batch-size 4 on the 8-device sim must not become an empty batch)
+    batch_size = max(dp, batch_size - batch_size % dp)
+    rng = np.random.default_rng(0)
+    batch = {"x": jnp.asarray(rng.normal(size=(batch_size, 784))
+                              .astype(np.float32)),
+             "label": jnp.asarray(rng.integers(0, 10, batch_size))}
+
+    def make(comp):
+        pt.seed(0)
+        model = M.MnistMLP(hidden1=1024, hidden2=1024)
+        return parallel.Trainer.supervised(
+            model, optimizer.Adam(1e-3), M.loss_fn, amp=amp,
+            plan=Plan(dp=dp, grad_compression=comp))
+
+    was_enabled = telemetry.enabled()
+    telemetry.enable()  # the byte counters ARE the evidence
+    try:
+        tr_fp, tr_q = make(None), make("int8")
+        # trajectory parity gate: K lockstep steps, one seed, one batch
+        parity_steps = 8
+        for _ in range(parity_steps):
+            l_fp, _ = tr_fp.train_step(batch)
+            l_q, _ = tr_q.train_step(batch)
+        l_fp, l_q = float(l_fp), float(l_q)
+        parity_gap = abs(l_fp - l_q)
+        parity_ok = parity_gap <= max(5e-3, 5e-3 * abs(l_fp))
+        # counter-verified bytes: the per-step payload each trainer
+        # recorded must match what the counters actually advanced by
+        m = _comm_metrics()
+        c_i8, c_fp = m["bytes_int8"].value, m["bytes_fp32"].value
+        warm = parity_steps
+        i8_step = sum(tr_q._comm_bytes)
+        fp_step = sum(tr_fp._comm_bytes)
+        counters_match = (
+            abs(c_i8 - tr_q._comm_bytes[0] * warm) < 1
+            and abs(c_fp - (tr_fp._comm_bytes[1]
+                            + tr_q._comm_bytes[1]) * warm) < 1)
+
+        def timed(tr):
+            loss, _ = tr.train_step(batch)
+            float(loss)
+            t0 = time.perf_counter()
+            for i in range(steps):
+                loss, _ = tr.train_step(batch)
+                if i % 4 == 3:
+                    float(loss)
+            float(loss)
+            return time.perf_counter() - t0
+
+        dt_fp, dt_q = timed(tr_fp), timed(tr_q)
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    ratio = fp_step / i8_step if i8_step else None
+    extras = {
+        "dp": dp,
+        "step_time_ms": round(dt_q / steps * 1e3, 3),
+        "step_time_ms_fp32": round(dt_fp / steps * 1e3, 3),
+        "comm_bytes_per_step_fp32": int(fp_step),
+        "comm_bytes_per_step_int8": int(i8_step),
+        "comm_byte_ratio": round(ratio, 3) if ratio else None,
+        "comm_counter_verified": bool(counters_match),
+        "parity_loss_fp32": round(l_fp, 6),
+        "parity_loss_int8": round(l_q, 6),
+        "parity_gate": bool(parity_ok),
+    }
+    return steps * batch_size / dt_q, "examples/sec", extras
+
+
 MODELS = {
     "mnist_mlp": bench_mnist_mlp,
+    "quant_comm": bench_quant_comm,
     "input_pipeline": bench_input_pipeline,
     "checkpoint": bench_checkpoint,
     "sharding_plan": bench_sharding_plan,
@@ -1486,6 +1657,9 @@ def _emit_skip(metric: str, msg: str) -> None:
     key — a 0.0 row here would read as a real measurement and drag
     BENCH_HISTORY trend plots to zero."""
     print(json.dumps({"metric": metric, "skipped": True,
+                      # infra-degraded row: trend tooling must not
+                      # fold it into deltas (the BENCH_r05 hazard)
+                      "backend_degraded": True,
                       "peak_mem_bytes": None, "error": msg}))
 
 
@@ -1545,6 +1719,12 @@ def main():
     ap.add_argument("--paged", action="store_true",
                     help="gpt_serve: paged-KV arena (page pool sized "
                     "to ~half the dense slots x capacity)")
+    ap.add_argument("--kv-dtype", dest="kv_dtype", default=None,
+                    choices=("int8",),
+                    help="gpt_serve: quantized paged KV pool (implies "
+                    "--paged; int8 values + per-vector scales — "
+                    "~3.7x pages per HBM byte) plus the max-sessions "
+                    "density A/B and greedy parity extras")
     ap.add_argument("--prefill-chunk", dest="prefill_chunk", type=int,
                     default=None,
                     help="gpt_serve: chunked prefill — C prompt tokens "
@@ -1635,6 +1815,10 @@ def main():
     if args.paged and "paged" in sig:
         # different cache layout (page pool vs dense arena): own key
         metric += "_paged"
+    if args.kv_dtype and "kv_dtype" in sig:
+        # different KV storage form (quantized page pool): own key so
+        # the density-vs-precision trade stays visible next to fp32
+        metric += f"_kv{args.kv_dtype}"
     if args.prefill_chunk and "prefill_chunk" in sig:
         # different admission schedule (prefill interleaved with
         # decode): own key per chunk size
@@ -1709,6 +1893,19 @@ def main():
         _emit_error(metric, "--infer: use --model bert_base (packing is "
                     "a training-batch layout)")
         return
+
+    if args.model == "quant_comm":
+        # the allreduce ring needs devices: give a cpu-only run the
+        # 8-device sim BEFORE backend init (accelerator backends ignore
+        # the cpu device count — on-chip runs use the real devices)
+        import jax
+
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
 
     # device-init watchdog: if the accelerator tunnel is wedged (device
     # claim hangs), still emit the one JSON line the driver expects
@@ -1791,6 +1988,8 @@ def main():
         kwargs["weight_only"] = True
     if args.paged and "paged" in sig:
         kwargs["paged"] = True
+    if args.kv_dtype and "kv_dtype" in sig:
+        kwargs["kv_dtype"] = args.kv_dtype
     if args.prefill_chunk and "prefill_chunk" in sig:
         kwargs["prefill_chunk"] = args.prefill_chunk
     if (args.decode_steps and args.decode_steps > 1
@@ -1870,8 +2069,11 @@ def main():
                        run_config=run_config)
     if os.environ.get("PT_BENCH_CPU_FALLBACK"):
         # this run is a device-init-timeout fallback: the number is a
-        # CPU number and must never read as an accelerator record
+        # CPU number and must never read as an accelerator record —
+        # and trend tooling must refuse to diff it against on-chip
+        # rows (BENCH_r05 polluted deltas exactly this way)
         line["backend"] = "cpu_fallback"
+        line["backend_degraded"] = True
     print(json.dumps(line))
 
 
@@ -1949,12 +2151,14 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
     # numbers, and the sharding-plan byte-budget evidence ride along
     # verbatim
     line.update({k: v for k, v in extras.items()
-                 if k.startswith("latency_ms_")
+                 if k.startswith(("latency_ms_", "comm_", "parity_",
+                                  "kv_", "max_sessions_"))
                  or k in ("accept_per_round", "rounds", "prefetch_off",
                           "prefetch_on", "overlap_speedup", "fsdp",
                           "peak_mem_bytes_replicated",
                           "peak_mem_bytes_planned", "byte_budget",
-                          "fits_budget_only_planned", "shard_ratio")})
+                          "fits_budget_only_planned", "shard_ratio",
+                          "session_ratio", "step_time_ms_fp32", "dp")})
     flops_per_sec = extras.get("flops_per_sec")
     line["mfu"] = None
     if flops_per_sec:

@@ -5,6 +5,21 @@ position per row, write a prompt chunk for one row, attend over the
 pages (Pallas paged kernel when eligible, gather fallback). The
 host-side allocator is paddle_tpu.serving.PagedKVPool.
 
+Pools come in two storage forms, transparent to every caller:
+
+- a plain float array (the original layout), or
+- :class:`QuantizedPool` — int8 values + per-(page, position, kv_head)
+  float32 scales (the ``quant.ops.absmax_encode`` wire format over each
+  head_dim vector). KV bytes set the concurrent-session ceiling per
+  chip, so int8 KV ~= 3.7x the pages of fp32 (1 + 4/head_dim bytes per
+  element vs 4) at the same HBM. Writes QUANTIZE ON APPEND (each K/V
+  vector encoded once, at write time); attention DEQUANTIZES the
+  gathered pages only (never the whole pool), so the working set stays
+  O(live tokens). Quantized pools take the gather path — the Pallas
+  paged kernel reads raw pool blocks via scalar-prefetched DMA and has
+  no epilogue slot for scales yet; the kernel-side int8 path slots in
+  here when it grows one.
+
 Green-field (the modern serving-memory capability; the reference's
 serving holds one contiguous buffer per request,
 /root/reference/paddle/fluid/inference/api/api_impl.cc role).
@@ -12,9 +27,68 @@ serving holds one contiguous buffer per request,
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
+
+
+class QuantizedPool(NamedTuple):
+    """int8 paged K or V pool: ``q`` (pages, page_size, kv_heads,
+    head_dim) int8 values, ``scale`` (pages, page_size, kv_heads)
+    float32 per-vector abs-max scales (dequant = ``q * scale``). A
+    pytree — threads through jitted step functions exactly like the
+    float pool it replaces; ``shape``/``dtype`` mirror the float pool's
+    so shape-driven callers (page_size, OOB page ids) never branch."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes of the pool (values + scales) — the serving
+        density accounting (`pt_serving_kv_pool_bytes`)."""
+        return quantized_pool_nbytes(self.q.shape)
+
+
+def quantized_pool_nbytes(shape) -> int:
+    """Device bytes a :class:`QuantizedPool` with value layout
+    ``shape`` = (pages, page_size, kv_heads, head_dim) costs: int8
+    values + one f32 scale per (page, position, kv_head) vector. THE
+    wire-format byte formula — ``QuantizedPool.nbytes`` and serving's
+    ``PagedKVPool.pool_nbytes`` both read it, so the density accounting
+    can't drift from the storage layout."""
+    pages, page_size, kv_heads, head_dim = shape
+    vecs = pages * page_size * kv_heads
+    return vecs * head_dim + vecs * 4
+
+
+def _encode_vectors(x):
+    """(..., head_dim) float -> (q int8, scale (...,)) per-vector
+    abs-max int8 (the shared quant.ops convention)."""
+    from ..quant.ops import absmax_encode
+
+    q, scale = absmax_encode(x, axis=-1)
+    return q, scale[..., 0]
+
+
+def _pool_write(pool, page, off, x):
+    """Scatter ``x`` (K/V vectors) into the pool at [page, off] with
+    OOB-drop semantics — quantize-on-append for QuantizedPool, plain
+    dtype-cast store otherwise. ``page``/``off`` index arrays broadcast
+    per the caller's layout."""
+    if isinstance(pool, QuantizedPool):
+        q, s = _encode_vectors(x)
+        return QuantizedPool(pool.q.at[page, off].set(q, mode="drop"),
+                             pool.scale.at[page, off].set(s, mode="drop"))
+    return pool.at[page, off].set(x.astype(pool.dtype), mode="drop")
 
 
 def write_rows(kpool, vpool, table, t_rows, k_t, v_t, page_size: int):
@@ -29,10 +103,8 @@ def write_rows(kpool, vpool, table, t_rows, k_t, v_t, page_size: int):
     # invalid rows get an out-of-pool page id -> mode="drop"
     page = jnp.where(valid, table[rows, col], kpool.shape[0])
     off = t_rows % page_size
-    kpool = kpool.at[page, off].set(k_t[:, 0].astype(kpool.dtype),
-                                    mode="drop")
-    vpool = vpool.at[page, off].set(v_t[:, 0].astype(vpool.dtype),
-                                    mode="drop")
+    kpool = _pool_write(kpool, page, off, k_t[:, 0])
+    vpool = _pool_write(vpool, page, off, v_t[:, 0])
     return kpool, vpool
 
 
@@ -47,10 +119,8 @@ def write_chunk(kpool, vpool, table_row, t0, k_c, v_c, page_size: int):
     col = jnp.minimum(pos // page_size, n_log - 1)
     page = jnp.where(valid, table_row[col], kpool.shape[0])
     off = pos % page_size
-    kpool = kpool.at[page, off].set(k_c[0].astype(kpool.dtype),
-                                    mode="drop")
-    vpool = vpool.at[page, off].set(v_c[0].astype(vpool.dtype),
-                                    mode="drop")
+    kpool = _pool_write(kpool, page, off, k_c[0])
+    vpool = _pool_write(vpool, page, off, v_c[0])
     return kpool, vpool
 
 
@@ -69,18 +139,21 @@ def write_chunk_rows(kpool, vpool, table, t0_rows, k_c, v_c,
     rows = jnp.arange(b)[:, None]
     page = jnp.where(valid, table[rows, col], kpool.shape[0])
     off = pos % page_size
-    kpool = kpool.at[page, off].set(k_c.astype(kpool.dtype),
-                                    mode="drop")
-    vpool = vpool.at[page, off].set(v_c.astype(vpool.dtype),
-                                    mode="drop")
+    kpool = _pool_write(kpool, page, off, k_c)
+    vpool = _pool_write(vpool, page, off, v_c)
     return kpool, vpool
 
 
 def gather_rows(pool, table):
     """Assemble each row's LOGICAL cache: (B, n_log*page_size, kv, hd).
     The fallback/prefill view; the decode kernel never materializes
-    it."""
+    it. Quantized pools dequantize HERE — only the gathered rows ever
+    exist in float."""
     b, n_log = table.shape
+    if isinstance(pool, QuantizedPool):
+        vals = (pool.q[table].astype(jnp.float32)
+                * pool.scale[table][..., None])
+        return vals.reshape(b, n_log * pool.shape[1], *pool.shape[2:])
     return pool[table].reshape(b, n_log * pool.shape[1],
                                *pool.shape[2:])
 
@@ -88,8 +161,9 @@ def gather_rows(pool, table):
 def attend(q, kpool, vpool, table, t_rows,
            window: Optional[int] = None):
     """Decode attention over the paged cache: the Pallas paged kernel
-    when eligible, else gather-the-pages + masked XLA. ``t_rows``:
-    scalar or (B,) logical cursors."""
+    when eligible, else gather-the-pages + masked XLA (always the
+    gather path for quantized pools — dequant happens on the gathered
+    rows). ``t_rows``: scalar or (B,) logical cursors."""
     from . import attention as A
 
     d = q.shape[-1]
@@ -98,7 +172,8 @@ def attend(q, kpool, vpool, table, t_rows,
     # broadcasts; the gather fallback must match)
     t_rows = jnp.broadcast_to(jnp.asarray(t_rows, jnp.int32),
                               (q.shape[0],))
-    if (A.decode_flash_ok(page_size * n_log, d)
+    if (not isinstance(kpool, QuantizedPool)
+            and A.decode_flash_ok(page_size * n_log, d)
             and A._get_flash_decode() is not None):
         from .pallas.flash_decode import flash_decode_paged
 

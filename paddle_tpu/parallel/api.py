@@ -58,11 +58,22 @@ class Trainer:
                  build_strategy: Optional[BuildStrategy] = None,
                  param_spec: Optional[Dict[str, P]] = None,
                  opt_state_rules=None, amp: Optional[str] = None,
-                 grad_accum_steps: int = 1, plan: Optional[Plan] = None):
+                 grad_accum_steps: int = 1, plan: Optional[Plan] = None,
+                 grad_compression: Optional[str] = None):
+        from ..quant.collectives import check_mode
+
         self.model = model
         self.optimizer = optimizer
         self.loss_builder = loss_builder
         self.plan = plan
+        # compressed gradient allreduce (amp-style opt-in; "int8" |
+        # "int8_sr"): trainer knob beats the plan's default. Applied at
+        # the ONE reduce boundary every step variant shares (_step /
+        # _accum_step / the scan-fused body), so plain, accum, and
+        # fused steps all compile it in via the same compile_step path.
+        self.grad_compression = check_mode(
+            grad_compression if grad_compression is not None
+            else (plan.grad_compression if plan is not None else None))
         if plan is not None:
             enforce(param_spec is None and opt_state_rules is None,
                     "plan subsumes param_spec/opt_state_rules — express "
@@ -88,6 +99,10 @@ class Trainer:
         # plan-less and explicit-pjit compilation, where GSPMD inserts
         # the collectives)
         self._pmean_axes = pmean_axes(plan)
+        if self.grad_compression is not None:
+            enforce(plan is not None and plan.num_devices > 1,
+                    "grad_compression compresses the gradient "
+                    "allreduce — it needs a multi-device plan")
 
         rep = NamedSharding(self.mesh, P())
 
@@ -124,6 +139,18 @@ class Trainer:
             # optimizer-state capability, reference:
             # transpiler/distribute_transpiler.py:702)
             self.opt_state = opt_state_rules.place(self.opt_state, self.mesh)
+        # static per-step collective payload for the host-side byte
+        # counters (grads tree mirrors params; shapes never change
+        # after init, so compute once and bump per dispatched step)
+        self._comm_bytes = (0, 0)
+        if self._pmean_axes:
+            from ..quant.collectives import tree_payload_bytes
+
+            ax_size = 1
+            for a in self._pmean_axes:
+                ax_size *= int(self.plan.mesh.shape[a])
+            self._comm_bytes = tree_payload_bytes(
+                self.params, ax_size, compression=self.grad_compression)
         self._rng = prandom.next_key()
         if plan is not None and plan.num_devices > 1:
             self._rng = jax.device_put(self._rng, rep)
@@ -207,6 +234,36 @@ class Trainer:
             return tree
         return lax.pmean(tree, self._pmean_axes)
 
+    def _reduce_grads(self, grads, rng):
+        """THE gradient reduce boundary — every step variant (plain /
+        accum / scan-fused) funnels its grads through here, so the
+        grad_compression opt-in lands in all of them from the one
+        compile path. Shard_map fallback: int8 ring pmean
+        (quant.collectives.quantized_pmean_tree) when compressed, plain
+        pmean otherwise. Explicit (pjit/GSPMD) plans: the int8
+        wire-format round-trip at the reduce boundary. No plan / no
+        compression: identity (zero-cost contract — no quant code in
+        the trace)."""
+        comp = self.grad_compression
+        sr_key = (jax.random.fold_in(rng, 0x51C8)
+                  if comp == "int8_sr" else None)
+        if self._pmean_axes:
+            if comp is None or len(self._pmean_axes) != 1:
+                # no single ring over a multi-axis reduce; the plan
+                # vocabulary can't produce one today (pure DP is
+                # exactly ("dp",)) but fail soft, not wrong
+                return lax.pmean(grads, self._pmean_axes)
+            from ..quant.collectives import quantized_pmean_tree
+
+            ax = self._pmean_axes[0]
+            return quantized_pmean_tree(
+                grads, ax, int(self.plan.mesh.shape[ax]), key=sr_key)
+        if comp is not None:
+            from ..quant.collectives import compress_grads
+
+            return compress_grads(grads, key=sr_key)
+        return grads
+
     def _step(self, params, buffers, opt_state, rng, batch):
         from ..amp import MixedPrecisionOptimizer
         from ..core.dtypes import policy_scope
@@ -231,9 +288,12 @@ class Trainer:
         # shard_map fallback: the gradient all-reduce is OURS to write
         # (mean over batch shards == grad of the global-mean loss);
         # loss/metrics/buffer updates reduce the same way so every
-        # shard applies an identical update and outputs stay replicated
-        loss, metrics, new_buffers, grads = self._pmean(
-            (loss, metrics, new_buffers, grads))
+        # shard applies an identical update and outputs stay replicated.
+        # Grads go through the dedicated reduce boundary (int8 ring
+        # when grad_compression is on).
+        loss, metrics, new_buffers = self._pmean(
+            (loss, metrics, new_buffers))
+        grads = self._reduce_grads(grads, rng)
         new_params, new_opt_state = self.optimizer.apply(params, grads,
                                                          opt_state)
         return loss, metrics, new_params, new_buffers, new_opt_state
@@ -261,8 +321,9 @@ class Trainer:
 
         (_, (loss, metrics, new_buffers)), grads = jax.value_and_grad(
             lf, has_aux=True)(params)
-        loss, metrics, new_buffers, grads = self._pmean(
-            (loss, metrics, new_buffers, grads))
+        loss, metrics, new_buffers = self._pmean(
+            (loss, metrics, new_buffers))
+        grads = self._reduce_grads(grads, rng)
         k = self.grad_accum_steps
         accum = jax.tree_util.tree_map(lambda a, g: a + g, accum, grads)
         count = count + 1
@@ -314,6 +375,10 @@ class Trainer:
                 loss, metrics, self.params, self.buffers, self.opt_state = \
                     self._jit_step(self.params, self.buffers, self.opt_state,
                                    sub, batch)
+        if telemetry.enabled() and self._pmean_axes:
+            from ..quant.collectives import record_payload_bytes
+
+            record_payload_bytes(*self._comm_bytes)
         return loss, metrics
 
     def train_steps(self, batch, n: int):
@@ -332,6 +397,12 @@ class Trainer:
             self._rng, sub = jax.random.split(self._rng)
             loss, metrics, self.params, self.buffers, self.opt_state = fn(
                 self.params, self.buffers, self.opt_state, sub, batch)
+        if telemetry.enabled() and self._pmean_axes:
+            from ..quant.collectives import record_payload_bytes
+
+            # the fused dispatch runs n reduces (one per inner step)
+            record_payload_bytes(self._comm_bytes[0] * n,
+                                 self._comm_bytes[1] * n)
         return loss, metrics
 
     def steps_jit(self, n: int):

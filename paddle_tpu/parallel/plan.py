@@ -90,10 +90,18 @@ class Plan:
                  min_shard_size: int = 1024,
                  batch_axes: Sequence[str] = ("dp", "fsdp"),
                  devices: Optional[Sequence[jax.Device]] = None,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 grad_compression: Optional[str] = None):
         for name, s in (("dp", dp), ("fsdp", fsdp), ("tp", tp)):
             enforce(s >= 1, "plan axis %s must be >= 1, got %s", name, s)
         self.dp, self.fsdp, self.tp = int(dp), int(fsdp), int(tp)
+        # opt-in int8 gradient allreduce ("int8" | "int8_sr" stochastic
+        # rounding): the Trainer compiles the quantized psum into the
+        # pure-DP shard_map step / the wire-format round-trip into the
+        # pjit reduce boundary (quant.collectives)
+        from ..quant.collectives import check_mode
+
+        self.grad_compression = check_mode(grad_compression)
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
         self.params = dict(params or {})
         self.min_shard_size = int(min_shard_size)
@@ -270,6 +278,7 @@ class Plan:
             "mode": "pjit" if self.explicit else "shard_map",
             "rules": len(self.rules),
             "explicit_params": len(self.params),
+            "grad_compression": self.grad_compression,
         }
         if params is not None:
             specs = {n: self.spec_for(n, v) for n, v in params.items()}

@@ -1,12 +1,15 @@
 """Quantization subsystem — fake-quant op family + QAT/PTQ layer rewriting
 (reference: operators/fake_quantize_op.cc, contrib/slim/quantization/)."""
 
-from .ops import (MovingAverageState, RangeState, abs_max_scale, dequantize,
+from .ops import (MovingAverageState, RangeState, abs_max_scale,
+                  absmax_decode, absmax_encode, dequantize,
                   fake_channel_wise_quantize_abs_max, fake_quantize_abs_max,
                   fake_quantize_moving_average_abs_max,
                   fake_quantize_range_abs_max, moving_average_abs_max_scale,
                   moving_average_state_init, quantize_dequantize,
                   quantize_to_int, range_state_init)
+from .collectives import (compress_grads, quantized_pmean,
+                          quantized_pmean_tree, quantized_psum)
 from .int8 import (Int8Conv2D, Int8Linear, int8_conv2d,
                    int8_linear, int8_swap)
 from .weight_only import WeightOnlyLinear, apply_weight_only_int8
@@ -15,11 +18,13 @@ from .qat import (QuantConfig, QuantedLayer, calibrate, freeze,
 
 __all__ = [
     "MovingAverageState", "RangeState", "WeightOnlyLinear",
-    "abs_max_scale", "apply_weight_only_int8", "dequantize",
+    "abs_max_scale", "absmax_decode", "absmax_encode",
+    "apply_weight_only_int8", "compress_grads", "dequantize",
     "fake_channel_wise_quantize_abs_max", "fake_quantize_abs_max",
     "fake_quantize_moving_average_abs_max", "fake_quantize_range_abs_max",
     "moving_average_abs_max_scale", "moving_average_state_init",
-    "quantize_dequantize", "quantize_to_int", "range_state_init",
+    "quantize_dequantize", "quantize_to_int", "quantized_pmean",
+    "quantized_pmean_tree", "quantized_psum", "range_state_init",
     "QuantConfig", "QuantedLayer", "calibrate", "freeze", "quantize_model",
     "int8_linear", "int8_swap", "Int8Linear", "Int8Conv2D", "int8_conv2d",
 ]

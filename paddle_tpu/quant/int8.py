@@ -25,11 +25,11 @@ def _as_int8_weight(w):
 
 def _quantize_acts(x, act_scale):
     """Per-tensor activation quantization at the recorded abs-max scale
-    (shared rounding convention for the linear and conv paths)."""
-    a_scale = jnp.maximum(jnp.asarray(act_scale, jnp.float32) / 127.0,
-                          1e-10)
-    x_i8 = jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
-    return x_i8, a_scale
+    — the shared ``quant.ops.absmax_encode`` convention (one rounding
+    rule with the KV-pool and collective quantizers)."""
+    from .ops import absmax_encode
+
+    return absmax_encode(x, absmax=act_scale)
 
 
 

@@ -143,6 +143,54 @@ def fake_quantize_range_abs_max(x, st: RangeState, bit_length: int = 8,
             RangeState(scale, window, st.step + 1))
 
 
+# ---------------------------------------------------------------------------
+# THE shared abs-max int-k encode/decode (one rounding convention for
+# every real-int8 quantizer in the tree: int8 activation execution,
+# the quantized paged-KV pool, and the compressed gradient collectives
+# — three conventions drifting apart is a parity bug waiting to happen)
+# ---------------------------------------------------------------------------
+
+
+def absmax_encode(x, axis: Optional[int] = None, *, absmax=None,
+                  bit_length: int = 8, eps: float = 1e-10, key=None):
+    """Quantize ``x`` onto the symmetric int-k grid at an abs-max scale.
+
+    Convention (the ONE every caller shares): ``scale = max(absmax /
+    qmax, eps)``; ``q = clip(round(x / scale), -qmax, qmax)`` as int8
+    (int16 above 8 bits); dequant is ``q * scale`` (:func:`absmax_decode`).
+
+    - ``axis``: reduction axis the abs-max is taken over (``None`` =
+      whole tensor, scalar scale). The returned scale keeps the reduced
+      axis with size 1, so ``q * scale`` broadcasts back.
+    - ``absmax``: externally-recorded abs-max (calibrated activation
+      scales) — skips the local reduction; ``axis`` is ignored.
+    - ``key``: optional PRNG key switching nearest-even rounding to
+      STOCHASTIC rounding (``floor(y + u)``, ``u ~ U[0, 1)`` —
+      unbiased: ``E[q] = y``), the gradient-compression option where
+      rounding bias would accumulate across steps.
+
+    Returns ``(q, scale)`` with ``scale`` float32.
+    """
+    qmax = _qmax(bit_length)
+    if absmax is None:
+        absmax = jnp.max(jnp.abs(x), axis=axis,
+                         keepdims=axis is not None)
+    scale = jnp.maximum(jnp.asarray(absmax, jnp.float32) / qmax, eps)
+    y = x.astype(jnp.float32) / scale
+    if key is not None:
+        y = jnp.floor(y + jax.random.uniform(key, y.shape))
+    else:
+        y = jnp.round(y)
+    dtype = jnp.int8 if bit_length <= 8 else jnp.int16
+    return jnp.clip(y, -qmax, qmax).astype(dtype), scale
+
+
+def absmax_decode(q, scale):
+    """Map an :func:`absmax_encode` payload back to float32:
+    ``q * scale`` (scale broadcasts — the reduced axis was kept)."""
+    return q.astype(jnp.float32) * scale
+
+
 def dequantize(q, scale, bit_length: int = 8, quant_axis: Optional[int] = None):
     """reference: fake_dequantize_max_abs / channel-wise variant — map an
     int-k grid tensor back to float: q * scale / qmax."""

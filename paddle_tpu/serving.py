@@ -83,6 +83,15 @@ def _serving_metrics(reg):
         "page_occupancy": reg.gauge(
             "pt_serving_page_occupancy_ratio",
             "allocated fraction of the KV page pool"),
+        "kv_pool_bytes": reg.gauge(
+            "pt_serving_kv_pool_bytes",
+            "device bytes held by the paged KV pools (all blocks, "
+            "K+V, scales included for kv_dtype=int8) — the "
+            "concurrent-session HBM denominator"),
+        "kv_pool_live_bytes": reg.gauge(
+            "pt_serving_kv_pool_live_bytes",
+            "KV pool bytes backing ALLOCATED pages (occupancy x pool "
+            "bytes)"),
         "spec_rounds": reg.counter(
             "pt_serving_spec_row_rounds_total",
             "speculative verify rounds (per active row)"),
@@ -111,20 +120,30 @@ class PagedKVPool:
     (tests/test_paged_kv.py)."""
 
     def __init__(self, pages: int, page_size: int, kv_heads: int,
-                 head_dim: int, dtype=None, arrays: bool = True):
+                 head_dim: int, dtype=None, arrays: bool = True,
+                 kv_dtype=None):
         enforce(page_size in (64, 128, 256),
                 "page_size must be one of (64, 128, 256), got %s",
                 page_size)
         enforce(pages >= 1, "pages must be >= 1, got %s", pages)
         from .core.dtypes import default_dtype
 
+        # kv_dtype="int8": QUANTIZED pools (ops.paged_kv.QuantizedPool
+        # — int8 values + per-vector f32 scales, quantize-on-append /
+        # dequantize-in-attention). ~(1 + 4/head_dim)/itemsize the
+        # bytes per cached token of the float pool, which is what sets
+        # max concurrent sessions at a fixed page-pool HBM budget.
+        enforce(kv_dtype in (None, "int8", jnp.int8),
+                'kv_dtype must be None or "int8", got %r', kv_dtype)
+        self.quantized = kv_dtype is not None
+        self.kv_dtype = "int8" if self.quantized else None
         self.dtype = dtype or default_dtype()
         self.shape = (pages, page_size, kv_heads, head_dim)
         # arrays=False: allocator-only (callers that thread their own
         # functional pools — BatchedDecoder — must not pin two extra
         # pool-sized device buffers here for the decoder's lifetime)
-        self.kpool = jnp.zeros(self.shape, self.dtype) if arrays else None
-        self.vpool = jnp.zeros(self.shape, self.dtype) if arrays else None
+        self.kpool = self.empty_pool() if arrays else None
+        self.vpool = self.empty_pool() if arrays else None
         self.page_size = page_size
         self.pages = pages
         self._free = list(range(pages - 1, -1, -1))
@@ -137,6 +156,25 @@ class PagedKVPool:
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    def empty_pool(self):
+        """Mint one zeroed functional pool array in this pool's storage
+        form (float array, or QuantizedPool when ``kv_dtype="int8"``) —
+        what BatchedDecoder threads per block."""
+        if self.quantized:
+            return paged_ops.QuantizedPool(
+                jnp.zeros(self.shape, jnp.int8),
+                jnp.zeros(self.shape[:3], jnp.float32))
+        return jnp.zeros(self.shape, self.dtype)
+
+    @property
+    def pool_nbytes(self) -> int:
+        """Device bytes ONE pool array costs (K or V side) — the
+        serving-density denominator: sessions/HBM scales with
+        1/pool_nbytes at fixed pages."""
+        if self.quantized:
+            return paged_ops.quantized_pool_nbytes(self.shape)
+        return int(np.prod(self.shape)) * jnp.dtype(self.dtype).itemsize
 
     def alloc(self, n: int) -> np.ndarray:
         """Claim n pages (typed error when exhausted — the admission
@@ -234,7 +272,7 @@ class BatchedDecoder:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, prompt_bucket: int = 16,
                  pages: Optional[int] = None, page_size: int = 128,
-                 prefix_cache: bool = False,
+                 prefix_cache: bool = False, kv_dtype=None,
                  prefill_chunk: Optional[int] = None,
                  draft=None, gamma: int = 4, decode_steps: int = 1):
         enforce(slots >= 1, "slots must be >= 1, got %s", slots)
@@ -330,14 +368,16 @@ class BatchedDecoder:
                     "allocated pages into another request's page 0)",
                     page_size, prompt_bucket)
             attn0 = model.blocks[0].self_attn
+            # kv_dtype="int8": quantized page pools (quantize-on-append
+            # K/V, dequantize-in-attention) — ~(4*hd)/(hd+4) more pages
+            # per HBM byte than fp32, which is the max-sessions lever
             self._allocator = PagedKVPool(
                 pages, page_size, attn0.num_kv_heads, attn0.head_dim,
-                arrays=False)
+                arrays=False, kv_dtype=kv_dtype)
             self.page_size = page_size
             self.n_log = capacity // page_size
             al = self._allocator
-            self.pools = [(jnp.zeros(al.shape, al.dtype),
-                           jnp.zeros(al.shape, al.dtype))
+            self.pools = [(al.empty_pool(), al.empty_pool())
                           for _ in model.blocks]
             self.table = np.zeros((slots, self.n_log), np.int32)
             self._slot_pages: List[Optional[np.ndarray]] = \
@@ -357,6 +397,9 @@ class BatchedDecoder:
         else:
             enforce(not prefix_cache,
                     "prefix_cache requires paged mode (pages=N)")
+            enforce(kv_dtype is None,
+                    "kv_dtype requires paged mode (pages=N) — the "
+                    "contiguous arena has no quantized form")
             self.caches = [blk.self_attn.init_cache(slots, capacity)
                            for blk in model.blocks]
         if draft is not None:
@@ -502,6 +545,8 @@ class BatchedDecoder:
                 run_config={"role": "serving", "slots": self.slots,
                             "capacity": self.capacity,
                             "paged": self.paged,
+                            "kv_dtype": (self._allocator.kv_dtype
+                                         if self.paged else None),
                             "spec": self.draft is not None,
                             "decode_steps": self.decode_steps}).start()
             self.debug_server.add_status("serving", self._statusz)
@@ -539,8 +584,12 @@ class BatchedDecoder:
                     m["queue_depth"].set(len(self.queue))
                     if self.paged:
                         al = self._allocator
-                        m["page_occupancy"].set(
-                            (al.pages - al.free_pages) / al.pages)
+                        occ = (al.pages - al.free_pages) / al.pages
+                        m["page_occupancy"].set(occ)
+                        pool_b = (2 * len(self.pools)
+                                  * al.pool_nbytes)
+                        m["kv_pool_bytes"].set(pool_b)
+                        m["kv_pool_live_bytes"].set(occ * pool_b)
                     t_tick = time.perf_counter()
                 if not self.preempted:
                     self._admit()
@@ -589,6 +638,8 @@ class BatchedDecoder:
             al = self._allocator
             st["pages"] = al.pages
             st["free_pages"] = al.free_pages
+            st["kv_dtype"] = al.kv_dtype or str(al.dtype)
+            st["kv_pool_bytes"] = 2 * len(self.pools) * al.pool_nbytes
             if self.prefix_cache:
                 st["prefix_hits"] = self.prefix_hits
         if self.draft is not None:

@@ -122,6 +122,11 @@ MID_PATTERNS = [
     "test_speculative.py::test_forward_chunk_matches_sequential_steps",
     "test_pallas_decode.py::test_matches_oracle_across_cursor",
     "test_paged_kv.py::test_pool_write_then_attend_decode_loop",
+    "test_paged_kv.py::TestQuantizedPool::"
+    "test_write_attend_matches_fp32_pool",
+    "test_quant_comm.py",
+    "test_serving.py::TestPagedMode::"
+    "test_quantized_kv_serves_and_logit_parity",
     "test_lora.py::test_trainable_subset_and_frozen_base",
     "test_vit.py::test_train_step_loss_decreases",
     "test_serving.py::test_more_requests_than_slots_all_complete",

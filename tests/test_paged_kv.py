@@ -119,6 +119,120 @@ def test_alloc_free_and_exhaustion():
         PagedKVPool(pages=4, page_size=48, kv_heads=2, head_dim=64)
 
 
+class TestQuantizedPool:
+    """kv_dtype="int8": QuantizedPool (int8 values + per-vector f32
+    scales), quantize-on-append / dequantize-in-attention — the serving
+    density lever. Parity is gated against the fp32 pool at the
+    quantization step bound (absmax/127 per cached vector)."""
+
+    def _pools(self, pages=8, ps=64, kv=2, d=64):
+        pool = PagedKVPool(pages=pages, page_size=ps, kv_heads=kv,
+                           head_dim=d, dtype=jnp.float32)
+        qpool = PagedKVPool(pages=pages, page_size=ps, kv_heads=kv,
+                            head_dim=d, kv_dtype="int8")
+        return pool, qpool
+
+    def test_write_attend_matches_fp32_pool(self):
+        """Chunk-prefill + stepped decode over int8 pools tracks the
+        fp32 pools within the quantization bound, scrambled tables and
+        all."""
+        from paddle_tpu.ops.paged_kv import QuantizedPool
+
+        B, H, KV, D, PS, NLOG = 2, 4, 2, 64, 64, 3
+        pool, qpool = self._pools(kv=KV, d=D)
+        table = jnp.asarray(np.stack([pool.alloc(NLOG),
+                                      pool.alloc(NLOG)]))
+        kf, vf = pool.kpool, pool.vpool
+        kq, vq = qpool.kpool, qpool.vpool
+        assert isinstance(kq, QuantizedPool)
+        lens = [37, 90]
+        for i, n in enumerate(lens):
+            kc = jnp.asarray(RNG.normal(size=(1, n, KV, D))
+                             .astype(np.float32))
+            vc = jnp.asarray(RNG.normal(size=(1, n, KV, D))
+                             .astype(np.float32))
+            kf, vf = PagedKVPool.write_chunk(kf, vf, table[i], 0, kc,
+                                             vc, PS)
+            kq, vq = PagedKVPool.write_chunk(kq, vq, table[i], 0, kc,
+                                             vc, PS)
+        t_rows = jnp.asarray(lens, jnp.int32)
+        for _ in range(2):
+            kt = jnp.asarray(RNG.normal(size=(B, 1, KV, D))
+                             .astype(np.float32))
+            vt = jnp.asarray(RNG.normal(size=(B, 1, KV, D))
+                             .astype(np.float32))
+            kf, vf = PagedKVPool.write_rows(kf, vf, table, t_rows, kt,
+                                            vt, PS)
+            kq, vq = PagedKVPool.write_rows(kq, vq, table, t_rows, kt,
+                                            vt, PS)
+            q = jnp.asarray(RNG.normal(size=(B, 1, H, D))
+                            .astype(np.float32))
+            want = PagedKVPool.attend(q, kf, vf, table, t_rows)
+            got = PagedKVPool.attend(q, kq, vq, table, t_rows)
+            # attention outputs are convex combos of V rows; the int8
+            # round-trip perturbs K (scores) and V by <= absmax/254
+            # per element — a few % on the output
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(want), atol=0.08,
+                                       rtol=0.05)
+            t_rows = t_rows + 1
+
+    def test_dequantized_cache_round_trips_within_bound(self):
+        """gather_rows over an int8 pool == the written vectors within
+        the shared-helper bound (scale/2 per element)."""
+        from paddle_tpu.ops import paged_kv as PO
+
+        _, qpool = self._pools()
+        table = jnp.asarray([qpool.alloc(2)])
+        kc = jnp.asarray(RNG.normal(size=(1, 100, 2, 64))
+                         .astype(np.float32))
+        kq, _ = PagedKVPool.write_chunk(qpool.kpool, qpool.vpool,
+                                        table[0], 0, kc, kc, 64)
+        got = PO.gather_rows(kq, table)[:, :100]
+        step = np.abs(np.asarray(kc)).max(-1, keepdims=True) / 127.0
+        assert (np.abs(np.asarray(got) - np.asarray(kc))
+                <= step / 2 * (1 + 1e-5)).all()
+
+    def test_no_cross_row_contamination(self):
+        """Row A's quantized writes (values AND scales) never touch row
+        B's pages — the scale plane must honor the same page isolation
+        as the values."""
+        _, qpool = self._pools()
+        ta = qpool.alloc(2)
+        tb = qpool.alloc(2)
+        table = jnp.asarray(np.stack([ta, tb]))
+        kq, vq = qpool.kpool, qpool.vpool
+        kc = jnp.asarray(RNG.normal(size=(1, 80, 2, 64))
+                         .astype(np.float32))
+        kq2, vq2 = PagedKVPool.write_chunk(kq, vq, table[0], 0, kc, kc,
+                                           64)
+        for pid in tb:
+            np.testing.assert_array_equal(np.asarray(kq2.q[pid]),
+                                          np.asarray(kq.q[pid]))
+            np.testing.assert_array_equal(np.asarray(kq2.scale[pid]),
+                                          np.asarray(kq.scale[pid]))
+
+    def test_oob_write_drops_values_and_scales(self):
+        _, qpool = self._pools(pages=4)
+        table = jnp.asarray([qpool.alloc(2)])        # capacity 128
+        kt = jnp.ones((1, 1, 2, 64), jnp.float32)
+        k2, v2 = PagedKVPool.write_rows(
+            qpool.kpool, qpool.vpool, table,
+            jnp.asarray([128], jnp.int32), kt, kt, 64)
+        np.testing.assert_array_equal(np.asarray(k2.q),
+                                      np.asarray(qpool.kpool.q))
+        np.testing.assert_array_equal(np.asarray(k2.scale),
+                                      np.asarray(qpool.kpool.scale))
+
+    def test_pool_bytes_ratio_and_validation(self):
+        pool, qpool = self._pools()
+        ratio = pool.pool_nbytes / qpool.pool_nbytes
+        assert ratio >= 3.5, ratio                   # hd=64: ~3.76x
+        with pytest.raises(Exception, match="kv_dtype"):
+            PagedKVPool(pages=4, page_size=64, kv_heads=2, head_dim=64,
+                        kv_dtype="int4")
+
+
 def test_oob_writes_drop_and_double_free_rejected():
     """Cursor past the table's capacity drops the write (contiguous
     semantics) instead of corrupting the last live page; free() rejects

@@ -20,7 +20,7 @@ run() { echo "\$ $*" | tee -a "$LOG"; "$@" 2>>"$LOG" | tee -a "$LOG"; }
 
 MODELS="mnist_mlp alexnet googlenet stacked_lstm vgg16 se_resnext50 \
 resnet50 bert_base bert_long bert_packed bert_moe gpt vit transformer_nmt \
-nmt_decode gpt_decode deepfm deepfm_sparse sharding_plan"
+nmt_decode gpt_decode deepfm deepfm_sparse sharding_plan quant_comm"
 
 echo "== model pass (bf16 defaults) ==" | tee -a "$LOG"
 for m in $MODELS; do
@@ -50,6 +50,7 @@ if [ "$MODE" = "full" ]; then
   run python bench.py --model gpt_serve --gamma 4
   run python bench.py --model gpt_serve --decode-steps 8
   run python bench.py --model gpt_serve --paged --prefill-chunk 64
+  run python bench.py --model gpt_serve --kv-dtype int8
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py
@@ -65,4 +66,18 @@ fi
 
 echo "== recorded history ==" | tee -a "$LOG"
 cat BENCH_HISTORY.json 2>/dev/null | tee -a "$LOG"
+
+# degraded-run banner: a session with cpu_fallback / skipped rows must
+# never be read as an accelerator trend point (the BENCH_r05 hazard —
+# error/cpu rows silently polluting deltas)
+if grep -qE '"backend_degraded": ?true|"backend": ?"cpu_fallback"' "$LOG"; then
+  {
+    echo "############################################################"
+    echo "# WARNING: BACKEND DEGRADED during this session.            #"
+    echo "# One or more runs fell back to CPU or were skipped —       #"
+    echo "# do NOT compare this session's numbers against on-chip     #"
+    echo "# baselines (rows are tagged \"backend_degraded\": true).     #"
+    echo "############################################################"
+  } | tee -a "$LOG"
+fi
 echo "done; full log in $LOG" | tee -a "$LOG"
