@@ -25,6 +25,7 @@ _LAZY = {
     "metrics": ".metrics",
     "profiler": ".core.profiler",
     "telemetry": ".telemetry",
+    "analysis": ".analysis",
     "initializer": ".initializer",
     "regularizer": ".regularizer",
     "clip": ".clip",

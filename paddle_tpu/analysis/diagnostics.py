@@ -1,0 +1,112 @@
+"""Typed diagnostic records — the one result currency every static
+analyzer in this package speaks.
+
+A :class:`Diagnostic` names WHAT is wrong (a stable ``PT-`` code + a
+human message), WHERE (a Program node index / var name for the IR
+verifier, a file:line for the repo linter, a state-leaf name for the
+plan audit) and HOW TO FIX IT (the hint). Analyzers only *report*;
+policy (raise / render / count) belongs to the caller — the Executor
+raises on errors, ``Plan.describe`` embeds a summary, ``tools/lint.py``
+sets the exit code.
+
+Code registry (grep anchor — add new codes here, README carries the
+user-facing table):
+
+=============  ========================================================
+PT-UBW-001     Program IR: read of an undefined or not-yet-written var
+PT-DUP-002     Program IR: conflicting writes to one var
+PT-DEAD-003    Program IR: dead op for the requested fetch slice
+PT-FETCH-004   Program IR: fetch target undefined or unreachable
+PT-SHAPE-005   Program IR: declared vs inferred shape/dtype mismatch
+PT-MUT-006     Program IR: parameter written outside update ops
+PT-DON-101     Donation: donated leaf is host-owned (numpy-backed)
+PT-DON-102     Donation: donated leaf is a non-owning host view
+PT-DON-103     Donation: donated argument unused by the step
+PT-DON-104     Donation: donated buffer aliases a live/non-donated one
+PT-SHARD-201   Plan audit: placed leaf would reshard at dispatch
+PT-SHARD-202   Plan audit: explicit/pattern spec dropped (divisibility)
+PT-SHARD-203   Plan audit: big leaf replicated under an fsdp plan
+PT-LINT-301    Repo lint: state-file write bypasses utils/atomic
+PT-LINT-302    Repo lint: wall-clock time.time() inside a span body
+PT-LINT-303    Repo lint: unnamed threading.Thread
+PT-LINT-304    Repo lint: device_get result flows into a donating call
+PT-LINT-305    Repo lint: leftover debug hook (jax.debug.print, ...)
+=============  ========================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    """One finding from a static pass. ``node``/``var`` locate inside a
+    Program (or a state tree: ``var`` is the leaf name), ``path``/
+    ``line`` locate inside a source file (the linter)."""
+
+    code: str
+    severity: str
+    message: str
+    hint: str = ""
+    node: Optional[int] = None
+    var: Optional[str] = None
+    path: Optional[str] = None
+    line: Optional[int] = None
+
+    def __post_init__(self):
+        from ..core.enforce import enforce
+
+        enforce(self.severity in SEVERITIES,
+                "diagnostic severity must be one of %s, got %r",
+                SEVERITIES, self.severity)
+
+    def location(self) -> str:
+        if self.path is not None:
+            return (f"{self.path}:{self.line}" if self.line is not None
+                    else self.path)
+        parts = []
+        if self.node is not None:
+            parts.append(f"op[{self.node}]")
+        if self.var is not None:
+            parts.append(f"var {self.var!r}")
+        return " ".join(parts)
+
+    def __str__(self) -> str:
+        loc = self.location()
+        s = f"{self.code} {self.severity}"
+        if loc:
+            s += f" at {loc}"
+        s += f": {self.message}"
+        if self.hint:
+            s += f" (hint: {self.hint})"
+        return s
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v not in (None, "")}
+
+
+def errors(diags: List[Diagnostic]) -> List[Diagnostic]:
+    return [d for d in diags if d.severity == "error"]
+
+
+def has_errors(diags: List[Diagnostic]) -> bool:
+    return any(d.severity == "error" for d in diags)
+
+
+def format_diagnostics(diags: List[Diagnostic],
+                       header: Optional[str] = None) -> str:
+    """Multi-line render, errors first (stable within a severity)."""
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    ranked = sorted(diags, key=lambda d: order.get(d.severity, 99))
+    n_err = sum(1 for d in diags if d.severity == "error")
+    n_warn = sum(1 for d in diags if d.severity == "warning")
+    lines = [header if header is not None else
+             f"{len(diags)} finding(s): {n_err} error(s), "
+             f"{n_warn} warning(s)"]
+    lines += [f"  {d}" for d in ranked]
+    return "\n".join(lines)

@@ -1,0 +1,365 @@
+"""Repo linter — AST checks for this framework's OWN invariants.
+
+Generic linters don't know this codebase's hard-won rules; these five
+were each paid for with a real bug class (codes in ``diagnostics.py``):
+
+- **PT-LINT-301** — serialized state written through a bare
+  ``open(path, "w")`` + ``json.dump``: a crash mid-write leaves a torn
+  file a restarted reader trusts (the PR 2 compile-cache corruption
+  class). State writes go through ``utils/atomic``. Writers that stage
+  to a temp file and ``os.replace`` themselves are recognized.
+- **PT-LINT-302** — wall-clock ``time.time()`` inside a telemetry span
+  body (``with Span(...)`` / ``RecordEvent(...)``): spans measure with
+  monotonic clocks; mixing in wall time yields negative/NTP-skewed
+  durations. Timestamps belong outside the span or use
+  ``time.perf_counter()``.
+- **PT-LINT-303** — ``threading.Thread`` without ``name=``: an unnamed
+  thread is undebuggable in /statusz thread dumps and py-spy profiles
+  (this repo names threads ``pt-*``).
+- **PT-LINT-304** — a ``jax.device_get`` result flowing into a
+  donating call (``train_step`` / ``train_steps`` / ``_jit_*``):
+  device_get returns zero-copy views on the CPU backend; donating the
+  source invalidates them (the PR 6 snapshot SIGSEGV class).
+- **PT-LINT-305** — leftover debug hooks: ``jax.debug.print``,
+  ``jax.debug.breakpoint``, ``breakpoint()``, ``pdb.set_trace()``.
+
+Suppression: append ``# pt-lint: disable=PT-LINT-303 <reason>`` to the
+flagged line (or the line above). The reason is REQUIRED — a bare
+suppression is ignored and the finding notes why. Multiple codes
+comma-separate.
+
+``tools/lint.py`` is the CLI (text or ``--format=json``); the ``lint``
+stage of ``tools/ci.sh`` runs it over ``paddle_tpu/`` on every smoke+
+build.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import Diagnostic
+
+LINT_CODES = {
+    "PT-LINT-301": "state-file write bypasses utils/atomic",
+    "PT-LINT-302": "wall-clock time.time() inside a telemetry span body",
+    "PT-LINT-303": "unnamed threading.Thread",
+    "PT-LINT-304": "device_get result flows into a donating call",
+    "PT-LINT-305": "leftover debug hook",
+}
+
+# callees whose arguments get donated (this repo's donating entry
+# points); extend here when a new donating API lands
+DONATING_CALLEES = {"train_step", "train_steps"}
+DONATING_PREFIXES = ("_jit_",)
+
+# calls that mark a function as doing its own atomic staging. The
+# helpers are unambiguous by terminal name; os.replace must match its
+# full dotted form — a bare terminal "replace" would let any
+# str.replace() in the scope masquerade as atomic staging
+ATOMIC_MARKERS = {"mkstemp", "atomic_write_text",
+                  "atomic_write_bytes", "_atomic_write"}
+ATOMIC_DOTTED = {"os.replace"}
+
+SPAN_NAMES = {"Span", "RecordEvent"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*pt-lint:\s*disable=([A-Za-z0-9\-, ]+?)(?:\s+(.*))?$")
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _suppressions(src: str) -> Dict[int, Tuple[Set[str], str]]:
+    out: Dict[int, Tuple[Set[str], str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            codes = {c.strip().upper() for c in m.group(1).split(",")
+                     if c.strip()}
+            out[i] = (codes, (m.group(2) or "").strip())
+    return out
+
+
+def _is_device_get(call: ast.Call) -> bool:
+    return _terminal(call.func) == "device_get"
+
+
+def _is_donating_callee(func: ast.AST) -> bool:
+    name = _terminal(func)
+    return (name in DONATING_CALLEES
+            or any(name.startswith(p) for p in DONATING_PREFIXES))
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Diagnostic] = []
+        self._span_depth = 0
+        # open-file bindings live per `with` body: name -> mode
+        self._wfiles: List[Dict[str, str]] = []
+        # per-scope ({terminal callee names}, {dotted callee names})
+        self._scope_calls: List[Tuple[Set[str], Set[str]]] = []
+        self._devget_names: List[Set[str]] = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _flag(self, code: str, node: ast.AST, message: str,
+              hint: str) -> None:
+        self.findings.append(Diagnostic(
+            code=code, severity="error", message=message, hint=hint,
+            path=self.path, line=getattr(node, "lineno", None)))
+
+    def _scope_has_atomic(self) -> bool:
+        if not self._scope_calls:
+            return False
+        terminals, dotted = self._scope_calls[-1]
+        return bool(terminals & ATOMIC_MARKERS or dotted & ATOMIC_DOTTED)
+
+    # -- scopes -------------------------------------------------------------
+
+    def _enter_scope(self, node) -> None:
+        calls = [n.func for n in ast.walk(node)
+                 if isinstance(n, ast.Call)]
+        self._scope_calls.append(({_terminal(f) for f in calls},
+                                  {_dotted(f) for f in calls}))
+        self._devget_names.append(set())
+
+    def visit_Module(self, node):
+        self._enter_scope(node)
+        self.generic_visit(node)
+        self._scope_calls.pop()
+        self._devget_names.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter_scope(node)
+        self.generic_visit(node)
+        self._scope_calls.pop()
+        self._devget_names.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- with: spans + open files -------------------------------------------
+
+    def visit_With(self, node):
+        span = 0
+        wf: Dict[str, str] = {}
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._bind_target(item.optional_vars, None)  # rebinds clean
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                if _terminal(ctx.func) in SPAN_NAMES:
+                    span += 1
+                if _terminal(ctx.func) == "open":
+                    mode = "r"
+                    if len(ctx.args) >= 2 and isinstance(
+                            ctx.args[1], ast.Constant):
+                        mode = str(ctx.args[1].value)
+                    for kw in ctx.keywords:
+                        if kw.arg == "mode" and isinstance(
+                                kw.value, ast.Constant):
+                            mode = str(kw.value.value)
+                    if mode.startswith("w") and isinstance(
+                            item.optional_vars, ast.Name):
+                        wf[item.optional_vars.id] = mode
+        self._span_depth += span
+        self._wfiles.append(wf)
+        self.generic_visit(node)
+        self._wfiles.pop()
+        self._span_depth -= span
+
+    # -- assignments: track device_get results ------------------------------
+
+    def _bind(self, name: str, tainted: bool) -> None:
+        """Record a name (re)binding in the current scope. A binding to
+        anything but a device_get call CLEARS taint — `x = np.array(x)`
+        is exactly the fix the 304 hint prescribes."""
+        if not self._devget_names:
+            return
+        if tainted:
+            self._devget_names[-1].add(name)
+        else:
+            self._devget_names[-1].discard(name)
+
+    def _bind_target(self, target: ast.AST, value: Optional[ast.AST]):
+        """One assignment-shaped binding: Name targets pair with their
+        value (elementwise through matching tuple/list unpacking), any
+        other rebinding form clears."""
+        if isinstance(target, ast.Name):
+            self._bind(target.id, isinstance(value, ast.Call)
+                       and _is_device_get(value))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                    and len(value.elts) == len(target.elts)
+                    else [None] * len(target.elts))
+            for t, v in zip(target.elts, elts):
+                self._bind_target(t, v)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._bind_target(t, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._bind_target(node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        # `for x in jax.device_get(tree)` iterates zero-copy views;
+        # any other iterable rebinds the target clean each pass
+        self._bind_target(node.target,
+                          node.iter if isinstance(node.iter, ast.Call)
+                          and _is_device_get(node.iter) else None)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    # -- calls: every rule's trigger site ------------------------------------
+
+    def visit_Call(self, node):
+        callee = _terminal(node.func)
+        dotted = _dotted(node.func)
+
+        # PT-LINT-305: leftover debug hooks
+        if dotted in ("jax.debug.print", "jax.debug.breakpoint",
+                      "pdb.set_trace") or (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "breakpoint"):
+            self._flag(
+                "PT-LINT-305", node,
+                f"leftover debug hook {dotted or 'breakpoint'}()",
+                "remove before landing (gate behind a flag if it must "
+                "stay)")
+
+        # PT-LINT-303: unnamed threads
+        if callee == "Thread" and dotted in ("threading.Thread",
+                                             "Thread"):
+            if not any(kw.arg == "name" for kw in node.keywords):
+                self._flag(
+                    "PT-LINT-303", node,
+                    "threading.Thread without name=",
+                    'name it "pt-<role>" so thread dumps and /statusz '
+                    "stay readable")
+
+        # PT-LINT-302: wall clock inside a span body
+        if dotted == "time.time" and self._span_depth > 0:
+            self._flag(
+                "PT-LINT-302", node,
+                "time.time() inside a telemetry span body",
+                "span durations are monotonic — use "
+                "time.perf_counter(), or move the wall-clock stamp "
+                "outside the span")
+
+        # PT-LINT-301: json.dump into a bare open(..., "w")
+        if dotted == "json.dump" and len(node.args) >= 2:
+            fobj = node.args[1]
+            if (isinstance(fobj, ast.Name)
+                    and any(fobj.id in wf for wf in self._wfiles)
+                    and not self._scope_has_atomic()):
+                self._flag(
+                    "PT-LINT-301", node,
+                    f"json.dump into open(..., 'w') file "
+                    f"{fobj.id!r}: a crash mid-write leaves a torn "
+                    f"file for the next reader",
+                    "write via utils.atomic.atomic_write_text("
+                    "path, json.dumps(...)) or stage + os.replace")
+
+        # PT-LINT-304: device_get result into a donating call
+        if _is_donating_callee(node.func):
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                hazard = (isinstance(arg, ast.Call)
+                          and _is_device_get(arg))
+                # name lookup is CURRENT-scope only: a tainted outer
+                # name must not flag a nested function's unrelated
+                # parameter/local of the same name (shadowing), and the
+                # PR 6 hazard class is same-scope by nature
+                hazard = hazard or (
+                    isinstance(arg, ast.Name) and self._devget_names
+                    and arg.id in self._devget_names[-1])
+                if hazard:
+                    self._flag(
+                        "PT-LINT-304", node,
+                        f"device_get result passed into donating call "
+                        f"{callee!r}: device_get returns zero-copy "
+                        f"views on the cpu backend and donation "
+                        f"invalidates them",
+                        "copy first (np.array / "
+                        "utils.memory.owned_on_device)")
+        self.generic_visit(node)
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Diagnostic]:
+    """Lint one source string. Syntax errors come back as a single
+    finding (a file the linter can't parse can't be certified)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Diagnostic(
+            code="PT-LINT-305", severity="error", path=path,
+            line=e.lineno, message=f"file does not parse: {e.msg}",
+            hint="fix the syntax error")]
+    linter = _Linter(path)
+    linter.visit(tree)
+    sup = _suppressions(src)
+    out: List[Diagnostic] = []
+    for d in linter.findings:
+        # BOTH candidate lines are consulted: a same-line comment for a
+        # different code (or a bare one) must not shadow a valid
+        # reasoned suppression sitting directly above
+        entries = [e for e in (sup.get(d.line), sup.get((d.line or 0) - 1))
+                   if e is not None and d.code in e[0]]
+        if any(reason for _, reason in entries):
+            continue  # suppressed with a reason: silent
+        if entries:
+            d.message += (" [suppression ignored: pt-lint disable "
+                          "comments require a reason]")
+        out.append(d)
+    return out
+
+
+def lint_file(path: str) -> List[Diagnostic]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Sequence[str],
+               exclude: Sequence[str] = ("__pycache__",)
+               ) -> List[Diagnostic]:
+    """Lint files and directory trees (``*.py`` only). Deterministic
+    order: sorted paths, findings in line order per file."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in exclude)
+                files.extend(os.path.join(root, n)
+                             for n in sorted(names)
+                             if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    out: List[Diagnostic] = []
+    for f in files:
+        out.extend(lint_file(f))
+    return out

@@ -1,0 +1,321 @@
+"""Program IR verifier — ahead-of-execution checking for the static
+graph.
+
+The reference Fluid interprets a protobuf ``ProgramDesc`` with no
+pre-execution verification (reference: framework/executor.cc:149 — a
+malformed program dies mid-run inside the op loop). The TensorFlow
+paper's case for a declarative dataflow graph is exactly that it can be
+*checked and transformed before it runs*; this module is that pass for
+``static.program.Program``: pure static walks over the recorded op DAG,
+no execution, returning :class:`..diagnostics.Diagnostic` records.
+
+Checks (codes in ``diagnostics.py``):
+
+- **PT-UBW-001** — an op reads a var that is neither a source (feed /
+  param / captured const) nor written by an earlier node: undefined
+  input, or use-before-write when a later node does produce it.
+- **PT-DUP-002** — conflicting writes: a var written by two nodes where
+  the re-writer is not an ``assign`` (the one sanctioned in-place
+  update; sequential re-assigns are the optimizer's normal mutation)
+  and not a write-back — a node that also reads the var it writes
+  (``while``/``switch`` loop carries update in place by contract).
+- **PT-DEAD-003** — ops outside the backward-reachability slice of the
+  requested fetch list (persistable writes are live roots, matching
+  ``executor.prune_for_fetch``). Only checked when a fetch list is
+  given — without one every terminal op is a legitimate output.
+- **PT-FETCH-004** — a fetch target that is not in the program, or is
+  recorded but never produced by any kept node (the classic case:
+  fetching a grad var from a ``clone(for_test=True)`` that cut the
+  backward ops — previously a bare ``KeyError`` from inside jit
+  tracing).
+- **PT-SHAPE-005** — declared output shape/dtype vs re-derived abstract
+  eval of the recorded fn (the same ``jax.eval_shape`` rule
+  ``Program.apply`` used at record time): catches tampered metadata and
+  ``eval_fn`` variants whose shapes drifted from their train twin.
+- **PT-MUT-006** — a parameter var written by a node that is not an
+  update op (``assign``): params may only mutate through the sanctioned
+  update path.
+
+``Executor.run`` wires :func:`verify_program` in as
+verify-on-first-compile (once per program version, opt-out via
+``FLAGS_static_verify``); ``debug.program_to_string`` /
+``program_to_dot`` render the findings inline.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import Dict, List, Optional, Sequence, Set
+
+from .diagnostics import Diagnostic
+
+# ops allowed to (re)write an existing var — the in-place update path
+UPDATE_OPS = ("assign",)
+
+
+def _source_names(program) -> Set[str]:
+    """Vars that exist before any node runs: feeds, params (scope-backed
+    persistables) and captured constants."""
+    src = {n for n, v in program.vars.items()
+           if getattr(v, "is_feed", False) or getattr(v, "is_param", False)}
+    src.update(getattr(program, "_const_values", {}))
+    return src
+
+
+def _writer_map(program) -> Dict[str, List[int]]:
+    out: Dict[str, List[int]] = {}
+    for i, node in enumerate(program.nodes):
+        for o in node.outputs:
+            out.setdefault(o, []).append(i)
+    return out
+
+
+def _op_in_specs(program, node):
+    """Rebuild the abstract input specs ``Program.apply`` evaluated the
+    op under (TRACE_BATCH substituted for -1 placeholder dims)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..static.program import TRACE_BATCH
+
+    consts = getattr(program, "_const_values", {})
+    specs = []
+    for n in node.inputs:
+        if n in consts:
+            arr = jnp.asarray(consts[n])
+            specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+        else:
+            v = program.vars[n]
+            shape = tuple(TRACE_BATCH if d == -1 else d for d in v.shape)
+            specs.append(jax.ShapeDtypeStruct(shape, v.dtype))
+    return specs
+
+
+def fetch_diagnostic(program, name: str) -> Diagnostic:
+    """PT-FETCH-004 for one bad fetch target, with a close-name hint —
+    the Executor routes its previously-opaque errors through this."""
+    from ..static.program import _GradNode
+
+    if name in program.vars:
+        # recorded but unreachable: its producing node is gone (the
+        # clone(for_test=True) cut) or never existed
+        cut = any(isinstance(n, _GradNode) for n in program.nodes)
+        why = ("its producing op is not in this program"
+               + (" (a clone(for_test=True) drops backward/optimizer "
+                  "ops but keeps their vars)" if not cut else ""))
+        return Diagnostic(
+            code="PT-FETCH-004", severity="error", var=name,
+            message=f"fetch target {name!r} is recorded but never "
+                    f"produced — {why}",
+            hint="fetch a var produced by this program's ops, or run "
+                 "the training program instead")
+    close = difflib.get_close_matches(name, list(program.vars), n=3)
+    hint = (f"did you mean {', '.join(repr(c) for c in close)}?"
+            if close else "declare it with data()/create_parameter() or "
+                          "fetch an op output")
+    return Diagnostic(
+        code="PT-FETCH-004", severity="error", var=name,
+        message=f"fetch target {name!r} is not in the program "
+                f"({len(program.vars)} vars recorded)",
+        hint=hint)
+
+
+def verify_program(program, fetch_list: Optional[Sequence] = None,
+                   check_shapes: bool = True) -> List[Diagnostic]:
+    """Run every IR check over ``program``; returns diagnostics (empty
+    = clean). Pure static — nothing executes, nothing compiles."""
+    from ..static.program import _GradNode
+
+    diags: List[Diagnostic] = []
+    sources = _source_names(program)
+    writers = _writer_map(program)
+    fetch_names = [f if isinstance(f, str) else f.name
+                   for f in (fetch_list or [])]
+
+    # -- def-use walk: UBW / DUP / MUT ----------------------------------
+    written: Set[str] = set()
+    first_writer: Dict[str, int] = {}
+    for i, node in enumerate(program.nodes):
+        if isinstance(node, _GradNode):
+            reads = [node.loss_name] + list(node.param_names)
+        else:
+            reads = list(node.inputs)
+        for n in reads:
+            if n in sources or n in written:
+                continue
+            if n not in program.vars and n not in getattr(
+                    program, "_const_values", {}):
+                diags.append(Diagnostic(
+                    code="PT-UBW-001", severity="error", node=i, var=n,
+                    message=f"op[{i}] {node.name!r} reads {n!r}, which "
+                            f"is not a var of this program",
+                    hint="record the producing op first, or feed it "
+                         "via data()"))
+            elif any(j > i for j in writers.get(n, [])):
+                diags.append(Diagnostic(
+                    code="PT-UBW-001", severity="error", node=i, var=n,
+                    message=f"op[{i}] {node.name!r} reads {n!r} before "
+                            f"op[{min(j for j in writers[n] if j > i)}] "
+                            f"writes it (use-before-write)",
+                    hint="reorder the program so producers precede "
+                         "consumers"))
+            else:
+                diags.append(Diagnostic(
+                    code="PT-UBW-001", severity="error", node=i, var=n,
+                    message=f"op[{i}] {node.name!r} reads {n!r}, which "
+                            f"no op writes and no feed/param provides",
+                    hint="the var is declared but never produced"))
+        if isinstance(node, _GradNode):
+            # only when the loss IS produced before this node (so the
+            # generic read check above stayed silent) but every writer
+            # sits past the differentiated prefix — a never-written or
+            # later-written loss already got its PT-UBW-001 above
+            if (node.loss_name in written
+                    and all(j >= node.prefix_len
+                            for j in writers.get(node.loss_name, []))):
+                diags.append(Diagnostic(
+                    code="PT-UBW-001", severity="error", node=i,
+                    var=node.loss_name,
+                    message=f"backward op[{i}] differentiates "
+                            f"{node.loss_name!r}, which is not produced "
+                            f"by its prefix (first {node.prefix_len} "
+                            f"nodes)",
+                    hint="append_backward must come after the loss ops"))
+        for o in node.outputs:
+            if o in written and first_writer.get(o) != i:
+                # a node that also READS the var it writes is a
+                # write-back by construction (while/switch loop carries:
+                # outputs = carried inputs) — in-place is its contract,
+                # not a conflict
+                if node.name not in UPDATE_OPS and o not in reads:
+                    diags.append(Diagnostic(
+                        code="PT-DUP-002", severity="error", node=i,
+                        var=o,
+                        message=f"op[{i}] {node.name!r} re-writes "
+                                f"{o!r}, already written by "
+                                f"op[{first_writer[o]}] — only "
+                                f"{UPDATE_OPS} ops or a write-back that "
+                                f"reads its own output may update in "
+                                f"place",
+                        hint="give the second write a fresh output var"))
+            else:
+                first_writer.setdefault(o, i)
+            written.add(o)
+            v = program.vars.get(o)
+            if (v is not None and getattr(v, "is_param", False)
+                    and node.name not in UPDATE_OPS):
+                diags.append(Diagnostic(
+                    code="PT-MUT-006", severity="error", node=i, var=o,
+                    message=f"op[{i}] {node.name!r} writes parameter "
+                            f"{o!r} outside the update ops "
+                            f"({', '.join(UPDATE_OPS)})",
+                    hint="parameters mutate only through "
+                         "Program.assign (optimizer updates)"))
+
+    # -- fetch reachability + dead ops ----------------------------------
+    produced = sources | set(writers)
+    for f in fetch_names:
+        if f not in program.vars or f not in produced:
+            diags.append(fetch_diagnostic(program, f))
+    valid_fetches = [f for f in fetch_names
+                     if f in program.vars and f in produced]
+    if valid_fetches:
+        from ..static.executor import prune_for_fetch
+
+        keep, _ = prune_for_fetch(program, valid_fetches)
+        for i, node in enumerate(program.nodes):
+            if isinstance(node, _GradNode) or i in keep:
+                continue
+            diags.append(Diagnostic(
+                code="PT-DEAD-003", severity="warning", node=i,
+                var=node.outputs[0] if node.outputs else None,
+                message=f"op[{i}] {node.name!r} is dead for fetch "
+                        f"{valid_fetches}: no fetch target or "
+                        f"persistable write depends on it",
+                hint="drop the op, or fetch one of its outputs"))
+
+    # -- declared vs inferred shapes/dtypes -----------------------------
+    if check_shapes:
+        diags.extend(_check_shapes(program))
+    return diags
+
+
+def _check_shapes(program) -> List[Diagnostic]:
+    import jax
+
+    from ..static.program import _GradNode, _OpNode
+
+    diags: List[Diagnostic] = []
+    for i, node in enumerate(program.nodes):
+        if isinstance(node, _GradNode):
+            # grads mirror their params by construction
+            for p, gname in zip(node.param_names, node.outputs):
+                pv = program.vars.get(p)
+                gv = program.vars.get(gname)
+                if pv is None or gv is None:
+                    continue
+                if tuple(gv.shape) != tuple(pv.shape):
+                    diags.append(Diagnostic(
+                        code="PT-SHAPE-005", severity="error", node=i,
+                        var=gname,
+                        message=f"grad var {gname!r} declares shape "
+                                f"{tuple(gv.shape)} but its param is "
+                                f"{tuple(pv.shape)}",
+                        hint="grad vars must mirror their parameter"))
+            continue
+        if not isinstance(node, _OpNode):
+            continue
+        # inputs must resolve before abstract eval can
+        if any(n not in program.vars and n not in getattr(
+                program, "_const_values", {}) for n in node.inputs):
+            continue  # already PT-UBW-001
+        try:
+            out_specs = jax.eval_shape(node.fn,
+                                       *_op_in_specs(program, node))
+        except Exception as e:
+            diags.append(Diagnostic(
+                code="PT-SHAPE-005", severity="error", node=i,
+                message=f"op[{i}] {node.name!r} fails abstract "
+                        f"evaluation: {type(e).__name__}: {e}",
+                hint="the recorded fn no longer matches its declared "
+                     "inputs (did an eval_fn change arity?)"))
+            continue
+        flat = (out_specs if isinstance(out_specs, tuple)
+                else (out_specs,))
+        if len(flat) != len(node.outputs):
+            diags.append(Diagnostic(
+                code="PT-SHAPE-005", severity="error", node=i,
+                message=f"op[{i}] {node.name!r} produces {len(flat)} "
+                        f"output(s) but declares {len(node.outputs)}",
+                hint="eval_fn variants must keep the train fn's "
+                     "output arity"))
+            continue
+        for spec, oname in zip(flat, node.outputs):
+            v = program.vars.get(oname)
+            if v is None:
+                continue
+            declared, inferred = tuple(v.shape), tuple(spec.shape)
+            # -1 declared dims are dynamic placeholders (the same ones
+            # _op_in_specs substitutes TRACE_BATCH for on the way in) —
+            # they match ANY inferred extent
+            if len(declared) != len(inferred) or any(
+                    d != -1 and d != s
+                    for d, s in zip(declared, inferred)):
+                diags.append(Diagnostic(
+                    code="PT-SHAPE-005", severity="error", node=i,
+                    var=oname,
+                    message=f"op[{i}] {node.name!r} infers shape "
+                            f"{inferred} for {oname!r} but it "
+                            f"declares {declared}",
+                    hint="the declared var metadata drifted from the "
+                         "recorded fn"))
+            elif str(spec.dtype) != str(v.dtype):
+                diags.append(Diagnostic(
+                    code="PT-SHAPE-005", severity="error", node=i,
+                    var=oname,
+                    message=f"op[{i}] {node.name!r} infers dtype "
+                            f"{spec.dtype} for {oname!r} but it "
+                            f"declares {v.dtype}",
+                    hint="the declared var metadata drifted from the "
+                         "recorded fn"))
+    return diags

@@ -455,7 +455,8 @@ class _WriteHandle:
                 except BaseException as e:  # re-raised at join()
                     self._exc = e
 
-            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread = threading.Thread(target=run, daemon=True,
+                                            name="pt-ckpt-async-writer")
             self._thread.start()
 
     def done(self) -> bool:

@@ -100,6 +100,11 @@ FLAGS.define("log_level", 0, "verbosity, VLOG-style")
 FLAGS.define("allocator_strategy", "pjrt", "device memory strategy (informational; PJRT owns HBM)")
 FLAGS.define("compile_cache_capacity", 128, "max cached executables per Executor")
 FLAGS.define("deterministic", False, "force deterministic reductions/collectives")
+FLAGS.define("static_verify", True,
+             "run the static analyzers (analysis/) at compile boundaries: "
+             "Program IR verification on the Executor's first compile of a "
+             "program version, donation-provenance checks at Trainer "
+             "compile time; 0 disables all wired-in passes")
 
 
 @dataclasses.dataclass

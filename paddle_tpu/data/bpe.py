@@ -184,9 +184,10 @@ class BPETokenizer:
     # --- persistence -------------------------------------------------------
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump({"merges": self.merges,
-                       "specials": self.specials}, f)
+        from ..utils.atomic import atomic_write_text
+
+        atomic_write_text(path, json.dumps(
+            {"merges": self.merges, "specials": self.specials}))
 
     @classmethod
     def load(cls, path: str) -> "BPETokenizer":

@@ -2,25 +2,69 @@
 program pretty-printer, graphviz.py + net_drawer.py dot export).
 
 Works on the static-graph ``Program`` (op/var graph) — the dygraph path is
-plain Python, debuggable directly.
+plain Python, debuggable directly. Both renderers accept the
+``analysis`` plane's findings (``diagnostics=`` — a list of
+:class:`paddle_tpu.analysis.Diagnostic`): the pretty-printer annotates
+offending ops/vars inline, the dot export colors dead ops::
+
+    from paddle_tpu import analysis, debug
+    diags = analysis.verify_program(prog, fetch_list=[loss])
+    print(debug.program_to_string(prog, diagnostics=diags))
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, List, Optional
 
 from .static.program import Program, _GradNode, _OpNode
 
 
-def program_to_string(program: Program, with_shapes: bool = True) -> str:
-    """Readable dump of a Program (debugger.py pprint analog)."""
+def _index_diags(diagnostics):
+    """(by_node, by_var, rest) lookup maps for inline rendering. A
+    diagnostic with a node index anchors to the op line; var-only ones
+    anchor to the var line."""
+    by_node: Dict[int, list] = {}
+    by_var: Dict[str, list] = {}
+    rest: List = []
+    for d in diagnostics or []:
+        if getattr(d, "node", None) is not None:
+            by_node.setdefault(d.node, []).append(d)
+        elif getattr(d, "var", None) is not None:
+            by_var.setdefault(d.var, []).append(d)
+        else:
+            rest.append(d)
+    return by_node, by_var, rest
+
+
+def _mark(d) -> str:
+    return "!" if d.severity == "error" else "*"
+
+
+def program_to_string(program: Program, with_shapes: bool = True,
+                      diagnostics: Optional[list] = None) -> str:
+    """Readable dump of a Program (debugger.py pprint analog).
+    ``diagnostics`` (from ``analysis.verify_program``) render inline
+    next to the op/var they locate."""
+    by_node, by_var, rest = _index_diags(diagnostics)
     lines = [f"Program: {len(program.nodes)} nodes, "
              f"{len(program.vars)} vars"]
+    if diagnostics:
+        n_err = sum(1 for d in diagnostics if d.severity == "error")
+        lines.append(f"diagnostics: {len(diagnostics)} finding(s), "
+                     f"{n_err} error(s)")
     lines.append("vars:")
     for name, v in program.vars.items():
         kind = "param" if name in program.param_names() else "var"
         shape = f" shape={tuple(v.shape)}" if with_shapes else ""
         lines.append(f"  {kind} {name}: dtype={v.dtype}{shape}")
+        for d in by_var.get(name, []):
+            lines.append(f"    {_mark(d)} [{d.code}] {d.message}")
+    # var-anchored findings whose var is NOT recorded (an undefined
+    # fetch target's PT-FETCH-004, a typo'd name) have no var line to
+    # sit under — surface them in the trailer instead of dropping them
+    for vname, ds in by_var.items():
+        if vname not in program.vars:
+            rest.extend(ds)
     lines.append("ops:")
     for i, node in enumerate(program.nodes):
         if isinstance(node, _GradNode):
@@ -29,16 +73,31 @@ def program_to_string(program: Program, with_shapes: bool = True) -> str:
         else:
             lines.append(f"  [{i}] {node.name}({', '.join(node.inputs)})"
                          f" -> {', '.join(node.outputs)}")
+        for d in by_node.get(i, []):
+            lines.append(f"    {_mark(d)} [{d.code}] {d.message}")
+    for d in rest:
+        lines.append(f"{_mark(d)} [{d.code}] {d.message}")
     return "\n".join(lines)
 
 
-def print_program(program: Program) -> None:
-    print(program_to_string(program))
+def print_program(program: Program, diagnostics=None) -> None:
+    print(program_to_string(program, diagnostics=diagnostics))
 
 
-def program_to_dot(program: Program, graph_name: str = "program") -> str:
+# dot fill colors: live ops vs ops a verifier diagnostic marked dead
+# (PT-DEAD-003) vs ops carrying any error-severity finding
+_OP_FILL = "lightgray"
+_DEAD_FILL = "mistyrose"
+_ERR_FILL = "lightcoral"
+
+
+def program_to_dot(program: Program, graph_name: str = "program",
+                   diagnostics: Optional[list] = None) -> str:
     """Graphviz dot of the op/var dataflow (net_drawer.py / graph_viz_pass
-    analog: op nodes as boxes, var nodes as ellipses)."""
+    analog: op nodes as boxes, var nodes as ellipses). With
+    ``diagnostics``, dead ops (PT-DEAD-003) fill ``mistyrose`` and ops
+    with error findings ``lightcoral``."""
+    by_node, _, _ = _index_diags(diagnostics)
     lines = [f"digraph {graph_name} {{", "  rankdir=TB;"]
     params = set(program.param_names())
     emitted_vars = set()
@@ -57,8 +116,16 @@ def program_to_dot(program: Program, graph_name: str = "program") -> str:
     for i, node in enumerate(program.nodes):
         label = ("backward" if isinstance(node, _GradNode)
                  else node.name)
+        fill = _OP_FILL
+        for d in by_node.get(i, []):
+            if d.code.startswith("PT-DEAD"):
+                fill = _DEAD_FILL
+                label += "\\n(dead)"
+                break
+            if d.severity == "error":
+                fill = _ERR_FILL
         lines.append(f'  "op_{i}" [label="{label}", shape=box, '
-                     f'style=filled, fillcolor=lightgray];')
+                     f'style=filled, fillcolor={fill}];')
         # _GradNode carries no .inputs — its dataflow sources are the
         # loss it differentiates and the params it differentiates w.r.t.
         inputs = ([node.loss_name] + list(node.param_names)

@@ -18,6 +18,9 @@ import numpy as np
 
 from .core.enforce import enforce
 from .nn.layer import Layer
+from .utils import compat as _compat
+
+_compat.jax_export()  # jax<0.5: jax.export is lazy; attribute access needs one import
 
 
 def save(layer: Layer, dirname: str, example_args: Sequence,
@@ -85,8 +88,11 @@ def save(layer: Layer, dirname: str, example_args: Sequence,
         f.write(exported.mlir_module_serialized)
     np.savez(os.path.join(dirname, "params.npz"),
              **{n: np.asarray(v) for n, v in params.items()})
-    with open(os.path.join(dirname, "manifest.json"), "w") as f:
-        json.dump({
+    from .utils.atomic import atomic_write_text
+
+    atomic_write_text(
+        os.path.join(dirname, "manifest.json"),
+        json.dumps({
             "feed_target_names": names,
             "fetch_target_names": fetch_names,
             "feed_shapes": {
@@ -100,7 +106,7 @@ def save(layer: Layer, dirname: str, example_args: Sequence,
                           [f"feed:{n}" for n in sorted(feed_specs)]),
             "batch_polymorphic": polymorphic,
             "format": "stablehlo+npz/v2",
-        }, f, indent=1)
+        }, indent=1))
 
 
 def load(dirname: str):

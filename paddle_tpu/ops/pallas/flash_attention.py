@@ -23,6 +23,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ...utils import compat
+
+compat.fix_custom_partitioning_static_args()
+
 try:  # pltpu only resolves on TPU builds; interpret mode needs none of it
     from jax.experimental.pallas import tpu as pltpu
 
@@ -1065,7 +1069,8 @@ def _partitioned(bwd, has_mask, has_segs, has_seed, gqa, causal, window,
         return _attn_shardings(mesh, q_sh, has_mask, has_segs, has_seed,
                                gqa, bwd)[2]
 
-    wrapped.def_partition(
+    compat.def_partition(
+        wrapped,
         partition=partition,
         infer_sharding_from_operands=infer_sharding_from_operands,
         sharding_rule=rule,

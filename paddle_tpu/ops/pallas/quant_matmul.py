@@ -23,6 +23,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ...core.enforce import enforce
+from ...utils import compat
+
+compat.fix_custom_partitioning_static_args()
 
 try:  # pltpu resolves on TPU builds; interpret mode needs none of it
     from jax.experimental.pallas import tpu as pltpu
@@ -171,7 +174,8 @@ def _partitioned_qm(out_dtype, tile_m, tile_n, tile_k, interpret):
             return NamedSharding(mesh, P())
         return _shardings(mesh, a_sh, b_sh)[2]
 
-    wrapped.def_partition(
+    compat.def_partition(
+        wrapped,
         partition=partition,
         infer_sharding_from_operands=infer_sharding_from_operands,
         sharding_rule="m k, k n, s, n -> m n",

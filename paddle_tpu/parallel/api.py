@@ -116,9 +116,17 @@ class Trainer:
             self.buffers = plan.place(model.named_buffers())
             model.set_buffers(self.buffers)
         else:
+            # same transfer discipline as Plan.place: record the put's
+            # provenance (a cpu client may zero-copy a numpy-backed
+            # leaf) and launder into runtime-owned buffers — these
+            # leaves are about to be donated every step
+            from ..analysis.donation import note_transfer
+            from ..utils.memory import owned_on_device
+
             def place(tree):
                 return jax.tree_util.tree_map(
-                    lambda leaf: jax.device_put(leaf, rep), tree)
+                    lambda leaf: owned_on_device(note_transfer(
+                        leaf, jax.device_put(leaf, rep))), tree)
 
             self.params = place(model.named_parameters())
             if param_spec:
@@ -171,6 +179,34 @@ class Trainer:
         self._jit_eval = compile_step(plan, self._eval_step,
                                       **self._eval_shardings())
         self._multi_cache = {}
+        self._check_donation_safety(donate)
+
+    def _check_donation_safety(self, donate) -> None:
+        """Compile-time donation-provenance check (analysis/donation):
+        every leaf the jitted step will donate must be runtime-owned —
+        a host-backed one (the PR 6 restore-SIGSEGV class: cpu client
+        zero-copying numpy temporaries) corrupts the heap only
+        *sometimes*, so it is flagged HERE, before the first dispatch.
+        Once per Trainer construction, skippable via
+        FLAGS_static_verify=0 — zero steady-state cost."""
+        from ..core.config import FLAGS
+
+        if not donate or not FLAGS.get("static_verify"):
+            return
+        from ..analysis.diagnostics import format_diagnostics
+        from ..analysis.donation import check_donation
+
+        if self.grad_accum_steps > 1:
+            args = (self.params, self.buffers, self.opt_state,
+                    self._accum, self._accum_count, self._rng)
+        else:
+            args = (self.params, self.buffers, self.opt_state,
+                    self._rng)
+        diags = [d for d in check_donation(args, donate)
+                 if d.severity == "error"]
+        enforce(not diags, "train state failed the donation-safety "
+                "check (FLAGS_static_verify=0 skips):\n%s",
+                format_diagnostics(diags))
 
     # --- plan sharding derivation -------------------------------------------
 

@@ -28,6 +28,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compat import shard_map
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -59,8 +61,8 @@ def _shard_with_optional(inner, mesh, spec, mspec, q, k, v, kv_mask,
                      xs[km_i] if km_i is not None else None,
                      xs[seg_i] if seg_i is not None else None)
 
-    fn = jax.shard_map(wrapper, mesh=mesh, in_specs=tuple(in_specs),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map(wrapper, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=spec, check_vma=False)
     return fn(*args)
 
 

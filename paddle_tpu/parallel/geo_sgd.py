@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.enforce import enforce
+from ..utils.compat import shard_map
 
 
 class GeoSGDTrainer:
@@ -95,7 +96,7 @@ class GeoSGDTrainer:
                                    self._specs(buffers),
                                    self._specs(opt_state))
             batch_spec = jax.tree_util.tree_map(lambda _: P(axis), batch)
-            return jax.shard_map(
+            return shard_map(
                 inner, mesh=self.mesh,
                 in_specs=(pspec, bspec, sspec, P(), batch_spec),
                 out_specs=(P(axis), pspec, bspec, sspec),
@@ -109,8 +110,8 @@ class GeoSGDTrainer:
                     lambda x: lax.pmean(x, axis), p)
 
             spec = self._specs(params)
-            return jax.shard_map(inner, mesh=self.mesh, in_specs=(spec,),
-                                 out_specs=spec, check_vma=False)(params)
+            return shard_map(inner, mesh=self.mesh, in_specs=(spec,),
+                             out_specs=spec, check_vma=False)(params)
 
         self._jit_local = jax.jit(local)
         self._jit_avg = jax.jit(avg)

@@ -53,6 +53,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.enforce import enforce
 from ..core.mesh import get_mesh
+from ..utils.compat import shard_map
 
 
 def _stack_to_stages(stacked_params, n_stages: int):
@@ -497,11 +498,11 @@ def _jitted_pipeline(block_fn, mesh, axis, n, m, remat, schedule="gpipe",
         # auto, so dp batch sharding and tp weight sharding compose with
         # the pipeline in ONE module (GSPMD inserts their collectives
         # around the manual ppermute ring)
-        return jax.shard_map(inner, mesh=mesh,
-                             in_specs=(stage_spec, P()),
-                             out_specs=out_specs,
-                             axis_names=frozenset({axis}),
-                             check_vma=False)(params_staged, x_mb)
+        return shard_map(inner, mesh=mesh,
+                         in_specs=(stage_spec, P()),
+                         out_specs=out_specs,
+                         axis_names=frozenset({axis}),
+                         check_vma=False)(params_staged, x_mb)
 
     return jax.jit(wrapper)
 

@@ -181,6 +181,20 @@ class Plan:
                 break
         return self._default_spec(value)
 
+    def requested_spec(self, name: str) -> Optional[P]:
+        """The spec the author *asked for* (explicit map, else first
+        matching rule) before any divisibility gating — ``None`` when
+        only the default tier applies. Lives next to :meth:`spec_for`
+        so the audit's notion of "requested" can never drift from the
+        resolution order it checks (``analysis/shardcheck`` compares
+        this against what :meth:`spec_for` actually resolves)."""
+        if name in self.params:
+            return self.params[name]
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        return None
+
     def _divisible(self, value, spec: P) -> bool:
         shape = getattr(value, "shape", None)
         if shape is None:
@@ -242,13 +256,20 @@ class Plan:
         buffers (:func:`..utils.memory.owned_on_device`) because every
         train step DONATES them — a cpu-backend zero-copy alias of the
         init-time host array would corrupt the heap on reuse."""
+        from ..analysis.donation import note_transfer
         from ..utils.memory import owned_on_device
 
         out = {}
         for name, leaf in named.items():
             sh = self.sharding_for(name, leaf)
             host = np.asarray(leaf) if isinstance(leaf, jax.Array) else leaf
-            out[name] = owned_on_device(jax.device_put(host, sh))
+            placed = note_transfer(host, jax.device_put(host, sh))
+            # note_transfer records the host-backed provenance of the
+            # staging put; owned_on_device's copy is recorded owned —
+            # so if the laundering were ever bypassed, the Trainer's
+            # compile-time donation check (analysis/donation.py) flags
+            # the placed state instead of the runtime corrupting later
+            out[name] = owned_on_device(placed)
         return out
 
     def place_replicated(self, tree):
@@ -286,6 +307,14 @@ class Plan:
             out["sharded_params"] = len(sharded)
             out["replicated_params"] = len(params) - len(sharded)
             out["param_specs"] = sharded
+            # static plan audit (analysis/shardcheck): would-reshard /
+            # dropped-spec / big-leaf-replicated findings ride along,
+            # so /statusz's sharding section reports layout hazards
+            # without any extra wiring
+            from ..analysis.shardcheck import audit_plan, audit_summary
+
+            out["audit"] = audit_summary(
+                audit_plan(self, params, specs=specs))
         return out
 
     def __repr__(self):

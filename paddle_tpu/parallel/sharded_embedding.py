@@ -32,6 +32,7 @@ from ..core.enforce import enforce
 from ..core.mesh import get_mesh
 from ..nn.layer import Layer
 from .. import initializer as I
+from ..utils.compat import shard_map
 
 
 def _lookup_inner(ids, table, *, axis, rows_per_shard):
@@ -67,10 +68,10 @@ def sharded_embedding_lookup(ids, table, *, axis: str = "ep",
     ids_spec = P(batch_axis, *([None] * (ids.ndim - 1)))
     inner = functools.partial(_lookup_inner, axis=axis,
                               rows_per_shard=V // n)
-    fn = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(ids_spec, P(axis, None)),
-                       out_specs=P(batch_axis, *([None] * ids.ndim)),
-                       check_vma=False)
+    fn = shard_map(inner, mesh=mesh,
+                   in_specs=(ids_spec, P(axis, None)),
+                   out_specs=P(batch_axis, *([None] * ids.ndim)),
+                   check_vma=False)
     out = fn(ids, table)
     if padding_idx is not None:
         out = jnp.where((ids == padding_idx)[..., None], 0.0, out)

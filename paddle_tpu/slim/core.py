@@ -61,9 +61,12 @@ class Context:
             "opt_state": self.opt_state,
             "masks": self.masks,
         })
-        with open(os.path.join(path, "context.json"), "w") as f:
-            json.dump({"epoch_id": self.epoch_id,
-                       "eval_history": self.eval_history}, f)
+        from ..utils.atomic import atomic_write_text
+
+        atomic_write_text(
+            os.path.join(path, "context.json"),
+            json.dumps({"epoch_id": self.epoch_id,
+                        "eval_history": self.eval_history}))
 
     def from_file(self, path: str) -> None:
         from .. import checkpoint

@@ -132,8 +132,10 @@ def compute_sensitivities(params: Dict[str, jnp.ndarray],
             pruned[name] = p * mask
             done[ratio] = base - float(eval_fn(pruned))
         if sensitivities_file:
-            with open(sensitivities_file, "w") as f:
-                json.dump(sens, f, indent=1, sort_keys=True)
+            from ..utils.atomic import atomic_write_text
+
+            atomic_write_text(sensitivities_file,
+                              json.dumps(sens, indent=1, sort_keys=True))
     return sens
 
 

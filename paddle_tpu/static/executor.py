@@ -183,6 +183,15 @@ class Executor:
         # shape churn must evict, not accumulate    (scope_guard works ^)
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._prune_cache: Dict[Tuple, Tuple] = {}
+        # verify-on-first-compile memo: (id, version, fetch) keys that
+        # already passed the static verifier — a program-cache hit (or
+        # a new feed signature of a verified program slice) never
+        # re-verifies, so the steady-state hot path pays one dict lookup.
+        # Values are weakrefs: like _prune_cache, an id() recycled after
+        # a verified Program is GC'd must not let a NEW program skip
+        # verification
+        self._verified: Dict[Tuple, Any] = {}
+        self.last_diagnostics: list = []
         self._feed_padder = None
         self._len_padder = None
         self.last_run_preempted = False  # train_from_dataset preemption
@@ -293,6 +302,43 @@ class Executor:
                                        debug, fetch_list, fetch_info,
                                        print_period)
 
+    # -- static verification (analysis/verify.py) ---------------------------
+    def _maybe_verify(self, program: Program, fetch_names: Tuple) -> None:
+        """Run the Program IR verifier once per (program, version,
+        fetch slice) — a memo hit (the steady-state path) pays one dict
+        lookup and restores that verification's findings, so
+        ``self.last_diagnostics`` always reflects the program being
+        run, never a stale one from another run. Skippable via
+        ``FLAGS_static_verify=0``. Errors raise with the full
+        diagnostic render; warnings (dead ops, ...) are kept on
+        ``self.last_diagnostics`` for debug tooling."""
+        from ..core.config import FLAGS
+
+        if not FLAGS.get("static_verify"):
+            return
+        vkey = (id(program), program.version, fetch_names)
+        cached = self._verified.get(vkey)
+        if cached is not None and cached[0]() is program:
+            self.last_diagnostics = cached[1]
+            return
+        from ..analysis.diagnostics import format_diagnostics
+        from ..analysis.verify import verify_program
+
+        diags = verify_program(program, fetch_names)
+        self.last_diagnostics = diags
+        errs = [d for d in diags if d.severity == "error"]
+        if errs:
+            # NOT memoized: a failing program re-verifies (and
+            # re-raises with the same diagnostics) on every attempt —
+            # memoizing the failure would let the retry fall through
+            # to the opaque mid-trace error this pass exists to replace
+            enforce(False, "program failed static verification "
+                    "(FLAGS_static_verify=0 skips):\n%s",
+                    format_diagnostics(errs))
+        if len(self._verified) > 256:
+            self._verified.clear()
+        self._verified[vkey] = (weakref.ref(program), diags)
+
     # -- run ----------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
             feed: Optional[Dict[str, Any]] = None,
@@ -328,8 +374,17 @@ class Executor:
         fetch_names = tuple(
             f.name if isinstance(f, Var) else f for f in (fetch_list or []))
         for fname in fetch_names:
-            enforce(fname in program.vars,
-                    "fetch target %s is not in the program", fname)
+            if fname not in program.vars:
+                # routed through the verifier's diagnostic so the user
+                # gets a PT- code + close-name hint, not a bare lookup
+                # error (the undefined-fetch half of PT-FETCH-004; the
+                # unreachable-var half is caught by _maybe_verify below
+                # before tracing would KeyError)
+                from ..analysis.verify import fetch_diagnostic
+
+                d = fetch_diagnostic(program, fname)
+                self.last_diagnostics = [d]
+                enforce(False, "%s", str(d))
 
         # auto-startup: initialize any missing params
         missing = [n for n in program.param_inits if not self.scope.has(n)]
@@ -375,6 +430,15 @@ class Executor:
         sig = tuple(sorted((k, v.shape, str(v.dtype))
                            for k, v in feed_vals.items()))
         key = (id(program), program.version, sig, fetch_names)
+        # verify-on-first-compile: a malformed program fails HERE with
+        # typed PT- diagnostics instead of mid-trace (the reference
+        # interpreted unverified ProgramDescs and died in the op loop).
+        # Compile is the amortization point — the verifier walk is
+        # noise next to an XLA compile, and the memo keys by program
+        # version so cache hits and new feed signatures of a verified
+        # slice pay one dict lookup (which keeps last_diagnostics
+        # pointed at THIS program's findings), never a re-walk.
+        self._maybe_verify(program, fetch_names)
         step = self._cache.get(key)
         if telem:
             # program-cache telemetry: a miss here is an XLA compile on

@@ -22,8 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.enforce import enforce
+from ..utils.atomic import atomic_write_text
+from ..utils import compat as _compat
 from .executor import Executor, Scope, _exec_opnodes, _exec_program
 from .program import Program, Var, _GradNode, _OpNode
+
+_compat.jax_export()  # jax<0.5: jax.export is lazy; attribute access needs one import
 
 
 def _prune(program: Program, fetch_names: Sequence[str]):
@@ -187,20 +191,19 @@ def save_inference_model(dirname: str, feed_target_names: Sequence[str],
     # jax flattens each dict in sorted-key order
     arg_order = ([f"param:{n}" for n in sorted(params)] +
                  [f"feed:{n}" for n in sorted(feed_specs)])
-    with open(os.path.join(dirname, _MANIFEST), "w") as f:
-        json.dump({
-            "feed_target_names": list(feed_target_names),
-            "fetch_target_names": fetch_names,
-            "feed_shapes": {n: list(program.vars[n].shape)
-                            if polymorphic else
-                            list(feed_specs[n].shape)
-                            for n in feed_target_names},
-            "feed_dtypes": {n: np.dtype(feed_specs[n].dtype).name
-                            for n in feed_specs},
-            "arg_order": arg_order,
-            "batch_polymorphic": polymorphic,
-            "format": "stablehlo+npz/v2",
-        }, f, indent=1)
+    atomic_write_text(os.path.join(dirname, _MANIFEST), json.dumps({
+        "feed_target_names": list(feed_target_names),
+        "fetch_target_names": fetch_names,
+        "feed_shapes": {n: list(program.vars[n].shape)
+                        if polymorphic else
+                        list(feed_specs[n].shape)
+                        for n in feed_target_names},
+        "feed_dtypes": {n: np.dtype(feed_specs[n].dtype).name
+                        for n in feed_specs},
+        "arg_order": arg_order,
+        "batch_polymorphic": polymorphic,
+        "format": "stablehlo+npz/v2",
+    }, indent=1))
 
 
 class InferencePredictor:
@@ -298,21 +301,20 @@ def save_train_program(dirname: str, feed_target_names: Sequence[str],
              **{n: np.asarray(a) for n, a in state.items()})
     arg_order = ([f"param:{n}" for n in state_names] +
                  [f"feed:{n}" for n in sorted(feed_specs)])
-    with open(os.path.join(dirname, _MANIFEST), "w") as f:
-        json.dump({
-            "feed_target_names": list(feed_target_names),
-            "fetch_target_names": [loss_name],
-            "feed_shapes": {n: list(feed_specs[n].shape)
-                            for n in feed_specs},
-            "feed_dtypes": {n: np.dtype(feed_specs[n].dtype).name
-                            for n in feed_specs},
-            "arg_order": arg_order,
-            "state_names": state_names,
-            # outputs: flattened (new_state dict sorted, loss) — first
-            # len(state_names) outputs ARE the next step's params
-            "num_state_outputs": len(state_names),
-            "format": _TRAIN_MANIFEST_FMT,
-        }, f, indent=1)
+    atomic_write_text(os.path.join(dirname, _MANIFEST), json.dumps({
+        "feed_target_names": list(feed_target_names),
+        "fetch_target_names": [loss_name],
+        "feed_shapes": {n: list(feed_specs[n].shape)
+                        for n in feed_specs},
+        "feed_dtypes": {n: np.dtype(feed_specs[n].dtype).name
+                        for n in feed_specs},
+        "arg_order": arg_order,
+        "state_names": state_names,
+        # outputs: flattened (new_state dict sorted, loss) — first
+        # len(state_names) outputs ARE the next step's params
+        "num_state_outputs": len(state_names),
+        "format": _TRAIN_MANIFEST_FMT,
+    }, indent=1))
 
 
 class TrainStepRunner:

@@ -174,8 +174,10 @@ def reset() -> None:
 
 
 def export_chrome_trace(events: List[Dict[str, Any]], path: str) -> None:
-    with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    from ..utils.atomic import atomic_write_text
+
+    atomic_write_text(path, json.dumps(
+        {"traceEvents": events, "displayTimeUnit": "ms"}))
 
 
 def export_jsonl(events: List[Dict[str, Any]], path: str) -> None:

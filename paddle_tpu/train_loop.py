@@ -95,7 +95,8 @@ class Watchdog:
 
     def start(self):
         self._last_beat = time.monotonic()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="pt-watchdog")
         self._thread.start()
         return self
 

@@ -161,17 +161,18 @@ def lowered_flops(jitted_fn, *args, n_partitions: int = 1,
     overestimate of model FLOPs). The fallback costs an AOT compile;
     enable_compile_cache() makes the jit dispatch right after reuse it.
     Returns None when neither side is available — never raises."""
+    from . import compat
+
     try:
         lowered = jitted_fn.lower(*args, **kwargs)
     except Exception:
         return None
-    for analyze, scale in ((lowered.cost_analysis, 1.0),
-                           (lambda: lowered.compile().cost_analysis(),
-                            float(max(1, n_partitions)))):
+    for analyzed, scale in ((lambda: lowered, 1.0),
+                            (lowered.compile,
+                             float(max(1, n_partitions)))):
         try:
-            analysis = analyze()
-            if isinstance(analysis, (list, tuple)):  # one entry per program
-                analysis = analysis[0] if analysis else None
+            # compat.cost_analysis owns the list-vs-dict jax drift
+            analysis = compat.cost_analysis(analyzed())
             if not analysis:
                 continue
             flops = analysis.get("flops")

@@ -46,7 +46,11 @@ def owned_on_device(x):
         return x
     if dev.platform != "cpu":
         return x
-    return jnp.copy(x)
+    from ..analysis.donation import note_owned
+
+    # the copy is runtime-allocated by construction — record it so the
+    # donation analyzer classifies it "owned" (committed) provenance
+    return note_owned(jnp.copy(x))
 
 
 def bytes_of_tree(tree) -> int:

@@ -131,3 +131,71 @@ def test_executed_program_still_prints(tmp_path):
     assert np.isfinite(out[0]).all()
     assert "Program:" in program_to_string(prog)
     assert "digraph" in program_to_dot(prog)
+
+
+class TestDiagnosticsRendering:
+    """Satellite regression: both renderers accept the analysis plane's
+    findings — program_to_string annotates inline next to the offending
+    op/var, program_to_dot colors dead ops mistyrose and error ops
+    lightcoral."""
+
+    def _diagged(self):
+        from paddle_tpu.analysis import verify_program
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = prog.data("x", (4,))
+            y = prog.apply(lambda a: a * 2, [x], name="scale")
+            z = prog.apply(lambda a: a + 1, [x], name="inc")
+        # fetching only y makes the inc op dead (PT-DEAD-003 warning)
+        return prog, y, z, verify_program(prog, [y.name])
+
+    def test_string_annotates_inline_at_the_offending_op(self):
+        prog, y, z, diags = self._diagged()
+        assert diags  # the corpus really produced findings
+        s = program_to_string(prog, diagnostics=diags)
+        lines = s.splitlines()
+        assert any("diagnostics: 1 finding(s), 0 error(s)" in l
+                   for l in lines)
+        # the annotation sits directly under the dead op's line
+        op_idx = next(i for i, l in enumerate(lines)
+                      if l.startswith("  [1] inc"))
+        assert "[PT-DEAD-003]" in lines[op_idx + 1]
+        assert lines[op_idx + 1].lstrip().startswith("*")  # warning mark
+
+    def test_string_var_anchored_and_error_marked(self):
+        from paddle_tpu.analysis import Diagnostic
+
+        prog, y, _, _ = self._diagged()
+        d = Diagnostic(code="PT-FETCH-004", severity="error",
+                       var=y.name, message="boom")
+        s = program_to_string(prog, diagnostics=[d])
+        lines = s.splitlines()
+        var_idx = next(i for i, l in enumerate(lines)
+                       if l.startswith(f"  var {y.name}:"))
+        assert "[PT-FETCH-004]" in lines[var_idx + 1]
+        assert lines[var_idx + 1].lstrip().startswith("!")  # error mark
+
+    def test_no_diagnostics_renders_unchanged(self):
+        prog, _, _, _ = self._diagged()
+        assert program_to_string(prog) == program_to_string(
+            prog, diagnostics=[])
+
+    def test_dot_colors_dead_ops(self):
+        prog, y, z, diags = self._diagged()
+        dot = program_to_dot(prog, diagnostics=diags)
+        assert '"op_1" [label="inc\\n(dead)", shape=box, ' \
+               'style=filled, fillcolor=mistyrose];' in dot
+        # the live op keeps the normal fill
+        assert '"op_0" [label="scale", shape=box, ' \
+               'style=filled, fillcolor=lightgray];' in dot
+
+    def test_dot_colors_error_ops(self):
+        from paddle_tpu.analysis import Diagnostic
+
+        prog, _, _, _ = self._diagged()
+        d = Diagnostic(code="PT-UBW-001", severity="error", node=0,
+                       message="boom")
+        dot = program_to_dot(prog, diagnostics=[d])
+        assert '"op_0" [label="scale", shape=box, ' \
+               'style=filled, fillcolor=lightcoral];' in dot

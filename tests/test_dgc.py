@@ -9,6 +9,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as pt
+from paddle_tpu.utils.compat import shard_map
 from paddle_tpu.parallel import (DGCMomentum, dgc_allreduce,
                                  quantized_allreduce, top_k_sparsify)
 
@@ -88,8 +89,8 @@ class TestQuantizedAllreduce:
         def f(xs):
             return quantized_allreduce(xs[0], "dp")[None]
 
-        out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
-                                    out_specs=P("dp")))(jnp.asarray(x))
+        out = jax.jit(shard_map(f, mesh=mesh, in_specs=P("dp"),
+                                out_specs=P("dp")))(jnp.asarray(x))
         exact = x.sum(axis=0)
         got = np.asarray(out)[0]
         # two int8 quantization phases: tolerance ~ 2 * max|x| * n / 127
@@ -110,7 +111,7 @@ class TestQuantizedAllreduce:
                 dgc_allreduce({"a": tree["a"][0], "b": tree["b"][0]},
                               "dp", sparsity=0.5, quantize=False))
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             f, mesh=mesh, in_specs=({"a": P("dp"), "b": P("dp")},),
             out_specs={"a": P("dp"), "b": P("dp")}))(
             {"a": jnp.asarray(g1), "b": jnp.asarray(g2)})

@@ -21,6 +21,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import paddle_tpu as pt
+from conftest import requires_partial_manual
 from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
 pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
@@ -37,6 +38,12 @@ def partitioner(request):
     callbacks). Both params set the flag EXPLICITLY (with save/restore)
     so the matrix holds even if the ambient default changes or another
     test leaks the config (VERDICT r4 weak #5 / next #9)."""
+    from paddle_tpu.utils import compat
+
+    if (request.param == "shardy"
+            and not compat.supports_shardy_sharding_rule()):
+        pytest.skip("this jax's custom_partitioning takes no sdy "
+                    "sharding_rule — shardy-mode would gather, not shard")
     old = jax.config.jax_use_shardy_partitioner
     jax.config.update("jax_use_shardy_partitioner",
                       request.param == "shardy")
@@ -231,6 +238,7 @@ def test_partitioned_feature_combos_match_unsharded(causal, window, mask,
                                rtol=2e-6, atol=2e-6)
 
 
+@requires_partial_manual
 def test_hybrid_bert_flagship_rides_flash(monkeypatch):
     """VERDICT r3 #3 done-criterion: the FLAGSHIP build_bert_hybrid_step
     (real BertForPretraining under dp x tp x pp) takes the flash kernel

@@ -12,6 +12,8 @@ import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.parallel.pipeline import GPipe, pipeline_apply
+from conftest import requires_partial_manual
+
 
 L, D, B = 8, 16, 12
 
@@ -42,6 +44,7 @@ def _sequential(params, x):
     return h
 
 
+@requires_partial_manual
 def test_pipeline_forward_matches_sequential(pp_mesh):
     params = _params()
     x = jnp.asarray(np.random.default_rng(1).normal(
@@ -53,6 +56,7 @@ def test_pipeline_forward_matches_sequential(pp_mesh):
                                atol=1e-5, rtol=1e-5)
 
 
+@requires_partial_manual
 def test_pipeline_grads_match_sequential(pp_mesh):
     params = _params(2)
     x = jnp.asarray(np.random.default_rng(3).normal(
@@ -72,6 +76,7 @@ def test_pipeline_grads_match_sequential(pp_mesh):
                                    atol=5e-5, rtol=5e-5)
 
 
+@requires_partial_manual
 def test_pipeline_jit_with_stage_placed_params(pp_mesh):
     """jit + params physically placed per stage (the production memory
     layout: each chip holds L/n layers)."""
@@ -94,6 +99,7 @@ def test_pipeline_jit_with_stage_placed_params(pp_mesh):
     assert not placed["w"].sharding.is_fully_replicated
 
 
+@requires_partial_manual
 def test_gpipe_layer_wrapper(pp_mesh):
     import paddle_tpu.nn as nn
 

@@ -28,11 +28,15 @@ import numpy as np
 import pytest
 
 import paddle_tpu as pt
+from conftest import requires_partial_manual
 from paddle_tpu.parallel import pipeline_apply
 from paddle_tpu.utils.memory import memory_usage
 
-pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
-                                reason="needs 8 devices")
+pytestmark = [
+    pytest.mark.skipif(len(jax.devices()) < 8,
+                                    reason="needs 8 devices"),
+    requires_partial_manual,
+]
 
 L, D, B = 8, 256, 32
 

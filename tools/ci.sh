@@ -33,6 +33,16 @@ sys.exit(diff_api.main())
 " || exit $?
 
 case "$MODE" in
+  smoke|mid|full)
+    # repo lint (analysis/lint.py): the framework's own invariants —
+    # atomic state writes, span clocks, thread names, donation hygiene,
+    # debug leftovers. Pure AST, budget well under 20 s.
+    stage "repo lint (tools/lint.py)"
+    JAX_PLATFORMS=cpu python tools/lint.py || exit $?
+    ;;
+esac
+
+case "$MODE" in
   smoke)
     stage "smoke tier (pytest -m smoke)"
     python -m pytest tests/ -m smoke -q || exit $?

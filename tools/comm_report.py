@@ -223,7 +223,8 @@ def report(config_name: str, *, batch: int = 8, seq_len: int = 32,
                 pipeline_schedule=sched, virtual_stages=v)
         compiled = jax.jit(step).lower(params, *feed).compile()
     traffic = collective_traffic(compiled.as_text())
-    cost = compiled.cost_analysis() or {}
+    from paddle_tpu.utils import compat
+    cost = compat.cost_analysis(compiled)
     flops = float(cost.get("flops", 0.0))
     total = sum(b for _, b in traffic.values())
     out = {

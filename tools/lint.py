@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Repo lint CLI — drives ``paddle_tpu.analysis.lint`` over the tree.
+
+The ``lint`` stage of ``tools/ci.sh`` (smoke and up) runs this over
+``paddle_tpu/``; exit 1 means findings. Suppress a deliberate hit with
+``# pt-lint: disable=PT-LINT-xxx <reason>`` on (or above) the flagged
+line — the reason is required.
+
+Usage:
+  python tools/lint.py                      # lint paddle_tpu/
+  python tools/lint.py path1 path2 ...      # lint specific files/trees
+  python tools/lint.py --format=json        # machine-readable findings
+  python tools/lint.py --select=PT-LINT-301 # only some codes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "paddle_tpu")],
+                    help="files or directories (default: paddle_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated PT-LINT codes to report "
+                         "(default: all)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import format_diagnostics, lint_paths
+    from paddle_tpu.analysis.lint import LINT_CODES
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",")}
+        unknown = select - set(LINT_CODES)
+        if unknown:
+            print(f"unknown codes: {sorted(unknown)} "
+                  f"(known: {sorted(LINT_CODES)})", file=sys.stderr)
+            return 2
+    findings = lint_paths(args.paths)
+    if select is not None:
+        findings = [d for d in findings if d.code in select]
+    if args.format == "json":
+        print(json.dumps({
+            "count": len(findings),
+            "findings": [d.to_dict() for d in findings],
+        }, indent=1, sort_keys=True))
+    elif findings:
+        print(format_diagnostics(findings))
+    else:
+        print("lint clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

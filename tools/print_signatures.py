@@ -37,6 +37,7 @@ MODULES = [
     "paddle_tpu.quant",
     "paddle_tpu.fleet",
     "paddle_tpu.resilience",
+    "paddle_tpu.analysis",
     "paddle_tpu.train_loop",
     "paddle_tpu.slim",
     "paddle_tpu.utils",

@@ -73,7 +73,8 @@ def main():
         # without it every step copies the whole (V, D) table
         jstep = jax.jit(step_fn, donate_argnums=(0, 1))
         compiled = jstep.lower(params, state, ids, y).compile()
-        ca = compiled.cost_analysis() or {}
+        from paddle_tpu.utils import compat
+        ca = compat.cost_analysis(compiled)
         loss, params_, state_ = jstep(params, state, ids, y)  # warmup
         jax.block_until_ready(loss)
         t0 = time.perf_counter()
