@@ -55,6 +55,8 @@ from . import telemetry
 from .core.enforce import EnforceError, enforce
 from .core.mesh import get_mesh
 from .resilience import faults as _faults
+from .resilience.controller import (BarrierTimeoutError,
+                                    note_barrier_timeout)
 from .resilience.integrity import (ChecksumError, checksum_bytes,
                                    verify_bytes)
 from .resilience.retry import retry_io
@@ -195,6 +197,20 @@ def _sanitize(path: str) -> str:
 
 
 _barrier_counts: Dict[str, int] = {}
+# last coordination-barrier outcome, for the fleet controller's
+# /statusz row ("last barrier latency" is the operator's first clue a
+# peer is wedging saves) — written by _barrier/_file_barrier only
+_BARRIER_STATS: Dict[str, Any] = {"last_latency_s": None,
+                                  "last_tag": None, "timeouts": 0}
+
+
+def barrier_stats() -> Dict[str, Any]:
+    """Snapshot of the last coordination-barrier latency/tag and the
+    process's barrier-timeout count (mirrors
+    ``pt_barrier_timeouts_total``, readable with telemetry off)."""
+    return dict(_BARRIER_STATS)
+
+
 _BARRIER_SUBDIR = ".pt_barrier"
 _RUN_START = time.time()  # stale-barrier sweep boundary (this process)
 _swept_barrier_roots: Dict[str, float] = {}  # root -> last sweep time
@@ -294,29 +310,59 @@ def _file_barrier(directory: str, tag: str, *,
             # so a false sweep costs one poll interval, never the
             # barrier
             atomic_write_text(mine, "1")
-        enforce(time.monotonic() < deadline,
-                "file barrier %s timed out after %ss (%s/%s ranks)",
-                tag, timeout_s, present, world)
+        if time.monotonic() >= deadline:
+            missing = [r for r in range(world) if not os.path.exists(
+                os.path.join(root, f"{tag}.{r}"))]
+            _BARRIER_STATS["timeouts"] += 1
+            note_barrier_timeout()
+            raise BarrierTimeoutError(tag, missing=missing,
+                                      world=world,
+                                      timeout_s=timeout_s)
         time.sleep(poll_s)
 
 
 def _barrier(tag: str, directory: str) -> None:
     """Coordination-service barrier (no device collectives — safe from the
     async writer thread); file-barrier fallback when multi-process with
-    no coordination client. No-op single-process."""
+    no coordination client. No-op single-process. A timeout on either
+    path raises the typed :class:`resilience.BarrierTimeoutError`
+    (naming the missing ranks where the transport can tell) and bumps
+    ``pt_barrier_timeouts_total`` — never an opaque transport error."""
     if jax.process_count() <= 1:
         return
     from jax._src import distributed as _dist
 
     client = getattr(_dist.global_state, "client", None)
-    if client is None:
-        # multi-process but no coordination service: rendezvous through
-        # the shared checkpoint filesystem instead of silently skipping
-        # (a skipped barrier lets rank 0 rename before peers finish
-        # writing their shards — a torn checkpoint by construction)
-        _file_barrier(directory, tag)
-        return
-    client.wait_at_barrier(tag, timeout_in_ms=300_000)
+    t0 = time.monotonic()
+    try:
+        if client is None:
+            # multi-process but no coordination service: rendezvous
+            # through the shared checkpoint filesystem instead of
+            # silently skipping (a skipped barrier lets rank 0 rename
+            # before peers finish writing their shards — a torn
+            # checkpoint by construction)
+            _file_barrier(directory, tag)
+        else:
+            try:
+                client.wait_at_barrier(
+                    tag, timeout_in_ms=int(_BARRIER_TIMEOUT_S * 1000))
+            except Exception as e:
+                msg = str(e).lower()
+                if ("deadline" in msg or "timed out" in msg
+                        or "timeout" in msg):
+                    # the service can't say who is missing, but the
+                    # diagnostic still carries tag/world/deadline
+                    _BARRIER_STATS["timeouts"] += 1
+                    note_barrier_timeout()
+                    raise BarrierTimeoutError(
+                        tag, world=jax.process_count(),
+                        timeout_s=_BARRIER_TIMEOUT_S,
+                        detail=str(e)) from e
+                raise
+    finally:
+        _BARRIER_STATS["last_latency_s"] = round(
+            time.monotonic() - t0, 4)
+        _BARRIER_STATS["last_tag"] = tag
 
 
 def _next_barrier_prefix(directory: str) -> str:

@@ -199,6 +199,22 @@ class Fleet:
             amp=self._strategy.amp,
             grad_accum_steps=self._strategy.gradient_merge_steps, **kw)
 
+    def controller(self, **kw):
+        """Build a :class:`resilience.FleetController` wired to this
+        fleet's role — rank/world from the RoleMaker env protocol (a
+        full ``fleet.init()`` is NOT required: a worker that never
+        brings up the coordination service still coordinates over the
+        file transport), transport auto-selected (the JAX coordination
+        client when connected, else the shared-filesystem fallback
+        under ``PT_FLEET_DIR``). Feed it to
+        ``TrainLoop.run(controller=...)``."""
+        from .resilience.controller import FleetController
+
+        role = self._role if self._role is not None else RoleMaker()
+        kw.setdefault("rank", role.rank)
+        kw.setdefault("world", role.world_size)
+        return FleetController(**kw)
+
     def _check(self):
         enforce(self._initialized, "call fleet.init() first")
 

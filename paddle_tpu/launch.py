@@ -12,12 +12,26 @@ Usage:
     python -m paddle_tpu.launch --nproc 2 train.py [script args...]
 
 Behavior:
-- spawns ``nproc`` copies of the script, each with its rank env;
+- spawns ``nproc`` copies of the script, each with its rank env (plus
+  the fleet-controller transport env: ``PT_FLEET_DIR`` under the log
+  dir and a per-attempt ``PT_FLEET_RUN_ID``);
 - rank 0 streams to this process's stdout/stderr, other ranks write
   ``<log_dir>/workerlog.<rank>`` (reference launcher's log layout);
-- first failure terminates the whole job and replays the failing
-  rank's log tail;
-- exit code = first non-zero worker exit code, else 0.
+- a worker that exits non-zero FAIL-FASTS the job: the failing rank's
+  log tail is replayed, the rank is marked ``dead`` through the fleet
+  transport (surviving controllers drop it from the preempt agreement
+  instead of hanging in the next barrier), peers get SIGTERM and the
+  grace window to commit, and stragglers are killed when it expires —
+  the launcher never hangs on survivors stuck in a dead rank's
+  barrier;
+- ``--elastic``: instead of dying with the lost worker, the job
+  respawns on the N-1 surviving slots (fresh rank numbering, a fresh
+  ``PT_FLEET_RUN_ID`` so no dead-attempt coordination state leaks) and
+  resumes from the last COMMITTED checkpoint — the worker script's
+  ordinary ``TrainLoop`` resume path reshards it onto the smaller
+  process set (the cross-plan-shape restore);
+- exit code = the final attempt's first non-zero worker exit code,
+  else 0.
 """
 
 from __future__ import annotations
@@ -29,7 +43,9 @@ import socket
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+__all__ = ["build_worker_env", "launch", "main"]
 
 
 def _free_port() -> int:
@@ -42,13 +58,20 @@ def _free_port() -> int:
 
 def build_worker_env(rank: int, nproc: int, endpoints: List[str],
                      base_env=None, platform: Optional[str] = None,
-                     local_devices: Optional[int] = None) -> dict:
+                     local_devices: Optional[int] = None,
+                     fleet_dir: Optional[str] = None,
+                     run_id: Optional[str] = None) -> dict:
     """Env for one worker, RoleMaker's protocol (fleet.py:35): explicit
     args > PADDLE_* > JAX_* > single-process defaults.
 
     ``local_devices`` forces N virtual CPU devices per worker (the
     reference launcher's per-node --gpus analog for the multi-host
-    simulation rig, SURVEY §7 'multi-host test rig without a pod')."""
+    simulation rig, SURVEY §7 'multi-host test rig without a pod').
+
+    ``fleet_dir``/``run_id`` seed the fleet controller's coordination
+    transport (``resilience.controller``): the shared file-transport
+    root and the per-attempt key namespace — an elastic restart gets a
+    fresh ``run_id`` so a dead attempt's acks never read as live."""
     env = dict(os.environ if base_env is None else base_env)
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(nproc)
@@ -56,6 +79,10 @@ def build_worker_env(rank: int, nproc: int, endpoints: List[str],
     env["JAX_PROCESS_ID"] = str(rank)
     env["JAX_NUM_PROCESSES"] = str(nproc)
     env["JAX_COORDINATOR_ADDRESS"] = endpoints[0]
+    if fleet_dir:
+        env["PT_FLEET_DIR"] = fleet_dir
+    if run_id:
+        env["PT_FLEET_RUN_ID"] = run_id
     if platform:
         env["JAX_PLATFORMS"] = platform
         # each process owns its local chip(s); a forced host-device count
@@ -69,32 +96,40 @@ def build_worker_env(rank: int, nproc: int, endpoints: List[str],
     return env
 
 
-def launch(script: str, script_args: List[str], *, nproc: int,
-           endpoints: Optional[List[str]] = None,
-           log_dir: str = "launch_logs", platform: Optional[str] = None,
-           timeout: Optional[float] = None,
-           local_devices: Optional[int] = None,
-           grace: float = 30.0) -> int:
-    """Spawn the job; returns the job's exit code (0 = all ranks ok).
+def _mark_dead(fleet_dir: str, run_id: str, rank: int) -> None:
+    """Publish the fleet transport's ``dead.<rank>`` marker (the
+    FileTransport key layout: ``<root>/<run_id>.<key>``) so surviving
+    controllers drop the rank from the preempt agreement and exit
+    clean inside the grace window instead of holding for a corpse.
+    Plain-stdlib on purpose: the launcher stays importable without the
+    framework's heavy deps on the hot teardown path."""
+    try:
+        os.makedirs(fleet_dir, exist_ok=True)
+        path = os.path.join(fleet_dir, f"{run_id}.dead.{rank}")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("1")
+        os.replace(tmp, path)
+    except OSError:
+        pass  # best-effort: the grace-kill below still bounds teardown
 
-    Preemption relay: a SIGTERM delivered to the launcher (TPU
-    preemption hits the job's parent first) is forwarded as SIGTERM to
-    every worker, giving each rank's
-    :class:`resilience.PreemptionHandler` its grace window — workers
-    finish the in-flight step, checkpoint, and exit 0. Workers still
-    alive ``grace`` seconds after the relay are killed. During the
-    relay window a non-zero worker exit no longer tears down its peers
-    (they are already shutting down and deserve their own grace)."""
-    if endpoints is None:
-        endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
-    if len(endpoints) != nproc:
-        raise ValueError(
-            f"{len(endpoints)} endpoints for {nproc} processes")
-    os.makedirs(log_dir, exist_ok=True)
+
+def _run_attempt(script: str, script_args: List[str], *, nproc: int,
+                 endpoints: List[str], log_dir: str,
+                 platform: Optional[str],
+                 local_devices: Optional[int], grace: float,
+                 deadline: Optional[float], fleet_dir: str,
+                 run_id: str,
+                 relayed: List[bool]) -> Tuple[int, Optional[int]]:
+    """One spawn of the whole worker set. Returns (exit code, the rank
+    whose unexpected death triggered teardown — None for clean /
+    relayed / timed-out attempts)."""
     procs, logs, log_files = [], [], []
     for rank in range(nproc):
-        env = build_worker_env(rank, nproc, endpoints, platform=platform,
-                               local_devices=local_devices)
+        env = build_worker_env(rank, nproc, endpoints,
+                               platform=platform,
+                               local_devices=local_devices,
+                               fleet_dir=fleet_dir, run_id=run_id)
         if rank == 0:
             out, path = None, None  # inherit: rank 0 streams live
         else:
@@ -107,11 +142,18 @@ def launch(script: str, script_args: List[str], *, nproc: int,
             stdout=out, stderr=subprocess.STDOUT if out else None))
 
     relayed_at: List[Optional[float]] = [None]
+    # one grace clock for BOTH teardown kinds (preemption relay and
+    # worker-failure fail-fast): once it expires, stragglers — e.g.
+    # survivors wedged in a dead rank's coordination barrier — are
+    # killed instead of hanging the launcher
+    kill_at: List[Optional[float]] = [None]
 
     def _relay(signum, frame):
         if relayed_at[0] is not None:
             return  # second SIGTERM: the grace clock is already running
         relayed_at[0] = time.time()
+        relayed[0] = True
+        kill_at[0] = relayed_at[0] + grace
         print(f"[launch] SIGTERM: relaying to {nproc} workers "
               f"(grace {grace}s)", file=sys.stderr)
         for q in procs:
@@ -124,8 +166,8 @@ def launch(script: str, script_args: List[str], *, nproc: int,
     except ValueError:
         pass  # not the main thread: no relay, workers get the default
 
-    deadline = time.time() + timeout if timeout else None
     rc = 0
+    failed_rank: Optional[int] = None
     try:
         pending = set(range(nproc))
         while pending:
@@ -139,29 +181,39 @@ def launch(script: str, script_args: List[str], *, nproc: int,
                     rc = code
                     if relayed_at[0] is not None:
                         continue  # preempting: peers keep their grace
+                    failed_rank = rank
                     print(f"[launch] rank {rank} exited with {code}; "
-                          "terminating job", file=sys.stderr)
+                          f"failing fast (peers get {grace}s to "
+                          "commit)", file=sys.stderr)
                     if logs[rank]:
                         _replay_tail(logs[rank], rank)
+                    # dead marker FIRST: when the peers' SIGTERM lands
+                    # their controllers already see the rank as gone
+                    # and agree among the survivors
+                    _mark_dead(fleet_dir, run_id, rank)
+                    kill_at[0] = time.time() + grace
                     for q in procs:
                         if q.poll() is None:
                             q.terminate()
-            if relayed_at[0] is not None and pending and \
-                    time.time() > relayed_at[0] + grace:
+            if kill_at[0] is not None and pending and \
+                    time.time() > kill_at[0]:
                 print(f"[launch] grace window ({grace}s) expired; "
                       f"killing ranks {sorted(pending)}",
                       file=sys.stderr)
                 for q in procs:
                     if q.poll() is None:
                         q.kill()
-                rc = rc or 143  # the job WAS preempted, not clean
+                if relayed_at[0] is not None:
+                    rc = rc or 143  # the job WAS preempted, not clean
+                kill_at[0] = None  # fired once; the kills are done
             if deadline and time.time() > deadline and pending:
-                print(f"[launch] timeout after {timeout}s; terminating "
-                      f"ranks {sorted(pending)}", file=sys.stderr)
+                print(f"[launch] timeout; terminating ranks "
+                      f"{sorted(pending)}", file=sys.stderr)
                 for q in procs:
                     if q.poll() is None:
                         q.terminate()
                 rc = rc or 124
+                failed_rank = None  # a timeout is not an elastic event
                 break
             time.sleep(0.05)
         for p in procs:
@@ -179,7 +231,89 @@ def launch(script: str, script_args: List[str], *, nproc: int,
             signal.signal(signal.SIGTERM, prev_term)
         for f in log_files:
             f.close()
-    return rc
+    return rc, failed_rank
+
+
+def launch(script: str, script_args: List[str], *, nproc: int,
+           endpoints: Optional[List[str]] = None,
+           log_dir: str = "launch_logs", platform: Optional[str] = None,
+           timeout: Optional[float] = None,
+           local_devices: Optional[int] = None,
+           grace: float = 30.0, elastic: bool = False,
+           max_restarts: Optional[int] = None,
+           min_procs: int = 1) -> int:
+    """Spawn the job; returns the job's exit code (0 = all ranks ok).
+
+    Preemption relay: a SIGTERM delivered to the launcher (TPU
+    preemption hits the job's parent first) is forwarded as SIGTERM to
+    every worker, giving each rank's
+    :class:`resilience.PreemptionHandler` its grace window — workers
+    finish the in-flight step, checkpoint (fleet-coordinated when the
+    script runs a :class:`resilience.FleetController`: every rank
+    commits the SAME agreed step), and exit 0. Workers still alive
+    ``grace`` seconds after the relay are killed. During the relay
+    window a non-zero worker exit no longer tears down its peers (they
+    are already shutting down and deserve their own grace).
+
+    Fail-fast: outside a relay, the FIRST non-zero worker exit tears
+    the job down — tail replay, ``dead`` marker through the fleet
+    transport, SIGTERM to peers, hard kill when the grace window
+    expires. Survivors with a controller exit clean (coordinated
+    commit among the live ranks); survivors without one are bounded by
+    the kill.
+
+    Elastic (``--elastic``): a torn-down job respawns on the surviving
+    ``nproc - 1`` slots — fresh ranks, fresh coordination namespace —
+    and the worker script's resume path restores the last COMMITTED
+    checkpoint onto the smaller process set. At most ``max_restarts``
+    times (default ``nproc - 1``: down to one worker), never below
+    ``min_procs``, never after a preemption relay or global timeout.
+    """
+    os.makedirs(log_dir, exist_ok=True)
+    fleet_dir = os.path.join(log_dir, "fleet")
+    deadline = time.time() + timeout if timeout else None
+    restarts_left = 0
+    if elastic:
+        restarts_left = (max_restarts if max_restarts is not None
+                         else max(nproc - 1, 0))
+    attempt = 0
+    cur_endpoints = endpoints
+    relayed = [False]
+    while True:
+        eps = cur_endpoints
+        if eps is None:
+            eps = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
+        if len(eps) != nproc:
+            raise ValueError(
+                f"{len(eps)} endpoints for {nproc} processes")
+        run_id = f"L{os.getpid()}a{attempt}"
+        rc, failed_rank = _run_attempt(
+            script, script_args, nproc=nproc, endpoints=eps,
+            log_dir=log_dir, platform=platform,
+            local_devices=local_devices, grace=grace,
+            deadline=deadline, fleet_dir=fleet_dir, run_id=run_id,
+            relayed=relayed)
+        if rc == 0 or relayed[0] or failed_rank is None or \
+                restarts_left <= 0:
+            return rc
+        if nproc - 1 < max(min_procs, 1):
+            print(f"[launch] elastic: cannot drop below "
+                  f"min_procs={max(min_procs, 1)}; giving up",
+                  file=sys.stderr)
+            return rc
+        if deadline and time.time() > deadline:
+            return rc
+        restarts_left -= 1
+        attempt += 1
+        nproc -= 1
+        if cur_endpoints is not None:
+            # drop the dead slot's endpoint; survivors keep theirs
+            cur_endpoints = [e for i, e in enumerate(cur_endpoints)
+                             if i != failed_rank]
+        print(f"[launch] elastic restart #{attempt}: rank "
+              f"{failed_rank} died (rc {rc}); respawning on {nproc} "
+              f"surviving worker(s) from the last committed "
+              f"checkpoint", file=sys.stderr)
 
 
 def _replay_tail(path: str, rank: int, n: int = 40) -> None:
@@ -217,8 +351,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="kill the job after this many seconds")
     ap.add_argument("--grace", type=float, default=30.0,
                     help="seconds workers get to checkpoint and exit "
-                    "after a relayed SIGTERM before being killed "
-                    "(preemption grace window)")
+                    "after a relayed SIGTERM or a peer's death before "
+                    "being killed (preemption/fail-fast grace window)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="respawn the job on the N-1 surviving "
+                    "workers (resuming from the last committed "
+                    "checkpoint) when a worker dies, instead of dying "
+                    "with it")
+    ap.add_argument("--max-restarts", type=int, default=None,
+                    help="elastic restart budget (default: nproc-1 — "
+                    "shrink down to a single worker)")
+    ap.add_argument("--min-procs", type=int, default=1,
+                    help="never restart with fewer workers than this")
     ap.add_argument("script", help="training script to run per rank")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed through to the script")
@@ -227,7 +371,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     return launch(args.script, args.script_args, nproc=args.nproc,
                   endpoints=endpoints, log_dir=args.log_dir,
                   platform=args.platform, timeout=args.timeout,
-                  local_devices=args.local_devices, grace=args.grace)
+                  local_devices=args.local_devices, grace=args.grace,
+                  elastic=args.elastic, max_restarts=args.max_restarts,
+                  min_procs=args.min_procs)
 
 
 if __name__ == "__main__":

@@ -16,8 +16,16 @@ plane of PR 4 can *see* a failure; this one *survives* it):
   recorded in the checkpoint manifest and verified on restore.
 - ``faults``: seeded deterministic :class:`FaultInjector` with named
   injection points (``ckpt.write``, ``ckpt.manifest``,
-  ``restore.read``, ``step.nan``, ``io.slow``) — the substrate of the
-  chaos test suite. Off by default with zero hot-path cost.
+  ``restore.read``, ``step.nan``, ``io.slow``, ``fleet.notice``) — the
+  substrate of the chaos test suite. Off by default with zero hot-path
+  cost.
+- ``controller``: the elastic fleet controller —
+  :class:`FleetController` agrees "preempt at step N" across ranks
+  over the coordination transport, watches a metadata notice source
+  ahead of SIGTERM, aggregates per-rank health into ``/podz``, and
+  (with ``launch.py --elastic``) lets the job respawn on N-1 hosts
+  from the last committed checkpoint. :class:`BarrierTimeoutError` is
+  the typed diagnostic every coordination wait raises on expiry.
 
 Everything here is opt-in: with no handler installed and no injector
 armed, the training/serving hot paths execute no resilience code (the
@@ -28,17 +36,20 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import faults, integrity, preemption, retry
+from . import controller, faults, integrity, preemption, retry
+from .controller import (BarrierTimeoutError, FileNotice,
+                         FleetController, HttpNotice)
 from .faults import POINTS, FaultError, FaultInjector
 from .integrity import ChecksumError, checksum_bytes, verify_bytes
 from .preemption import PreemptionHandler
 from .retry import DEFAULT_POLICY, RetryPolicy, retry_io
 
 __all__ = [
-    "ChecksumError", "DEFAULT_POLICY", "FaultError", "FaultInjector",
-    "POINTS", "PreemptionHandler", "RetryPolicy", "checksum_bytes",
-    "faults", "integrity", "preemption", "retry", "retry_io",
-    "statusz", "verify_bytes",
+    "BarrierTimeoutError", "ChecksumError", "DEFAULT_POLICY",
+    "FaultError", "FaultInjector", "FileNotice", "FleetController",
+    "HttpNotice", "POINTS", "PreemptionHandler", "RetryPolicy",
+    "checksum_bytes", "controller", "faults", "integrity",
+    "preemption", "retry", "retry_io", "statusz", "verify_bytes",
 ]
 
 
@@ -53,4 +64,7 @@ def statusz() -> Dict[str, Any]:
     inj = faults.active()
     out["faults"] = (inj.statusz() if inj is not None
                      else {"armed": False})
+    ctl = controller.active()
+    out["controller"] = (ctl.statusz() if ctl is not None
+                         else {"active": False})
     return out

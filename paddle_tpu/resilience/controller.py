@@ -1,0 +1,760 @@
+"""Elastic fleet controller — the control plane that coordinates hosts.
+
+PR 5 made ONE process preemption-safe: each rank reacts to its own
+SIGTERM, finishes the in-flight step, and checkpoints wherever it
+stands. Multi-host that is not enough — ranks receive the signal at
+different steps, a desynced rank fails the final cooperative save
+loudly, and a rank that dies outright kills the whole job. This module
+is the missing coordinator, split out of the data plane the way the
+TensorFlow paper separates control-plane RPC from tensor traffic
+(PAPERS.md): a tiny key-value protocol over the job's coordination
+transport agrees on ONE "preempt at step N" for the whole fleet.
+
+The pieces:
+
+- :class:`FleetController` — one per rank, woven through
+  ``TrainLoop.run(controller=...)``. On a preemption notice (its
+  :class:`~.preemption.PreemptionHandler`'s SIGTERM flag, a metadata
+  watcher, or a peer's published ack) the rank publishes
+  ``preempt.ack.<rank> = <own step>`` and HOLDS; once every live rank's
+  ack is in, the agreed step is ``max(acks)`` — held ranks catch up to
+  it, every rank commits the SAME step, and a commit-confirmation wait
+  keeps any rank from reporting a clean exit before the whole fleet's
+  checkpoint is on disk.
+- Coordination transports — :class:`ClientTransport` rides the JAX
+  coordination service (``checkpoint._barrier``'s client) when the job
+  brought one up; :class:`FileTransport` is the shared-filesystem
+  fallback the CI rig and coordinator-less jobs use (same stance as the
+  checkpoint file-barrier fallback). Keys are namespaced by a per-job
+  ``run_id`` so an elastic restart never reads a dead attempt's state.
+- A metadata **watcher** thread — polls a pluggable
+  :class:`NoticeSource` (the GCE/TPU maintenance-event metadata URL, or
+  a file stub for CI) and raises the preempt flag AHEAD of SIGTERM for
+  a longer grace window.
+- ``/podz`` — pod-level aggregation: the controller publishes each
+  rank's debug-server endpoint through the transport, and any rank's
+  ``/podz`` fans out to every worker's ``/healthz`` + ``/statusz`` +
+  ``/memz`` and renders one fleet view (per-rank heartbeat age, last
+  committed step, preempt state).
+- :class:`BarrierTimeoutError` — the typed diagnostic every
+  coordination wait (checkpoint barriers included) raises on expiry,
+  naming the ranks that never arrived instead of an opaque timeout.
+
+``launch.py --elastic`` closes the loop: a dead worker is marked
+``dead.<rank>`` through the transport (survivors drop it from
+agreement and exit clean within the grace window) and the job respawns
+on the surviving hosts from the last COMMITTED checkpoint.
+
+Zero-cost when unused: no controller, no code on the hot path — the
+loop resolves ``controller`` once per run, and ``check()`` is an Event
+peek plus a time-throttled transport poll.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import telemetry
+from ..core.enforce import EnforceError, enforce
+from ..utils.atomic import atomic_write_text
+from . import faults as _faults
+from .preemption import PreemptionHandler
+
+__all__ = [
+    "BarrierTimeoutError", "ClientTransport", "FileNotice",
+    "FleetController", "HttpNotice", "active", "auto_transport",
+    "notice_source_from_env",
+]
+
+_ACTIVE: Optional["FleetController"] = None
+
+# env protocol (set by launch.py for every worker; overridable):
+ENV_FLEET_DIR = "PT_FLEET_DIR"       # FileTransport root (shared FS)
+ENV_RUN_ID = "PT_FLEET_RUN_ID"       # per-attempt namespace for keys
+ENV_NOTICE = "PT_PREEMPT_NOTICE"     # notice source: http(s) URL | path
+
+
+@telemetry.cached_instruments
+def _fleet_metrics(reg):
+    return {
+        "agreements": reg.counter(
+            "pt_fleet_preempt_agreements_total",
+            "coordinated preempt-at-step agreements reached"),
+        "notices": reg.counter(
+            "pt_fleet_preempt_notices_total",
+            "preemption notices raised by the metadata watcher"),
+        "barrier_timeouts": reg.counter(
+            "pt_barrier_timeouts_total",
+            "coordination barrier / fleet-agreement waits that "
+            "timed out"),
+    }
+
+
+def note_barrier_timeout() -> None:
+    """Bump ``pt_barrier_timeouts_total`` (shared with checkpoint's
+    barrier paths — one counter for every coordination-wait expiry)."""
+    if telemetry.enabled():
+        _fleet_metrics()["barrier_timeouts"].inc()
+
+
+class BarrierTimeoutError(EnforceError):
+    """A coordination wait (checkpoint barrier, preempt agreement,
+    commit confirmation) expired. Unlike the opaque transport error it
+    replaces, this names the ranks that never arrived — the first thing
+    an operator needs when one host of a pod wedges. An
+    :class:`~..core.enforce.EnforceError`: drive loops propagate it
+    (a half-agreed fleet must fail loudly, never be 'recovered' into
+    silent divergence)."""
+
+    def __init__(self, tag: str, *, missing: Optional[List[int]] = None,
+                 world: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 detail: Optional[str] = None):
+        self.tag = tag
+        self.missing = sorted(missing) if missing else []
+        self.world = world
+        self.timeout_s = timeout_s
+        who = (f"missing ranks {self.missing}" if self.missing
+               else "missing ranks unknown (coordination-service "
+                    "barrier)")
+        msg = (f"barrier/agreement '{tag}' timed out after "
+               f"{timeout_s}s ({who}, world={world})")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+# ---------------------------------------------------------------------------
+# Coordination transports
+# ---------------------------------------------------------------------------
+
+class FileTransport:
+    """Shared-filesystem key-value fallback (the file-barrier stance:
+    jobs without a coordination service rendezvous through the
+    checkpoint FS). One file per key, atomic-published; keys are
+    namespaced ``<run_id>.<key>`` so a crash-restarted or elastic
+    successor run never reads a dead attempt's acks as live state."""
+
+    kind = "file"
+
+    def __init__(self, root: str, run_id: str = "r0",
+                 stale_age_s: float = 120.0):
+        self.root = root
+        self.run_id = run_id
+        self.stale_age_s = stale_age_s
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{self.run_id}.{key}")
+
+    def put(self, key: str, value: str) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        atomic_write_text(self._path(key), value)
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key)) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def sweep(self) -> int:
+        """GC other-run litter past the stale age. Prefix namespacing
+        already makes foreign keys invisible to :meth:`get`; this just
+        keeps the root from accumulating forever across elastic
+        restarts into the same directory."""
+        prefix = self.run_id + "."
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        removed = 0
+        now = time.time()
+        for name in names:
+            if name.startswith(prefix):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                if now - os.path.getmtime(path) > self.stale_age_s:
+                    os.unlink(path)
+                    removed += 1
+            except OSError:
+                pass  # a peer swept it first
+        return removed
+
+
+class ClientTransport:
+    """The JAX coordination-service KV store (``checkpoint._barrier``'s
+    client) — the production transport whenever the job brought the
+    service up (``fleet.init`` multi-process)."""
+
+    kind = "client"
+
+    def __init__(self, client, run_id: str = "r0"):
+        self._client = client
+        self.run_id = run_id
+
+    def _key(self, key: str) -> str:
+        return f"pt_fleet/{self.run_id}/{key}"
+
+    def put(self, key: str, value: str) -> None:
+        self._client.key_value_set(self._key(key), value)
+
+    def get(self, key: str) -> Optional[str]:
+        try_get = getattr(self._client, "key_value_try_get", None)
+        try:
+            if try_get is not None:
+                return try_get(self._key(key))
+            # old clients: a blocking get with a tiny deadline is the
+            # only non-blocking probe available
+            return self._client.blocking_key_value_get(
+                self._key(key), 50)
+        except Exception:
+            return None  # NotFound surfaces as an error on both paths
+
+    def sweep(self) -> int:
+        return 0  # the service dies with the job; nothing persists
+
+
+def coordination_client():
+    """The live JAX coordination-service client, or None (single
+    process / ``fleet.init(connect=False)`` / plain tests)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None)
+    except Exception:
+        return None
+
+
+def auto_transport(*, run_id: Optional[str] = None,
+                   root: Optional[str] = None):
+    """Pick the transport the way ``checkpoint._barrier`` picks its
+    rendezvous: the coordination client when the job has one, else the
+    shared-filesystem fallback (root: explicit > ``PT_FLEET_DIR`` >
+    ``./.pt_fleet``)."""
+    run_id = run_id or os.environ.get(ENV_RUN_ID) or "r0"
+    client = coordination_client()
+    if client is not None:
+        return ClientTransport(client, run_id)
+    root = (root or os.environ.get(ENV_FLEET_DIR)
+            or os.path.join(os.getcwd(), ".pt_fleet"))
+    return FileTransport(root, run_id)
+
+
+# ---------------------------------------------------------------------------
+# Preemption notice sources (the metadata watcher's pluggable input)
+# ---------------------------------------------------------------------------
+
+class FileNotice:
+    """CI / orchestrator stub: the notice is a file appearing at
+    ``path`` (an init-container or test touches it)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def poll(self) -> bool:
+        return os.path.exists(self.path)
+
+    def describe(self) -> str:
+        return f"file:{self.path}"
+
+
+class HttpNotice:
+    """GCE/TPU metadata poller. The default URL is the instance
+    maintenance-event endpoint; any body other than ``NONE`` (or a
+    configured ``trigger`` substring match) is a preemption notice —
+    delivered minutes before the SIGTERM, which is the whole point:
+    the fleet agrees and commits on the LONG grace window."""
+
+    DEFAULT_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                   "instance/maintenance-event")
+
+    def __init__(self, url: Optional[str] = None,
+                 trigger: Optional[str] = None,
+                 timeout_s: float = 2.0):
+        self.url = url or self.DEFAULT_URL
+        self.trigger = trigger
+        self.timeout_s = timeout_s
+
+    def poll(self) -> bool:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url, headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            body = r.read().decode("utf-8", "replace").strip()
+        if self.trigger is not None:
+            return self.trigger in body
+        return body not in ("", "NONE")
+
+    def describe(self) -> str:
+        return f"http:{self.url}"
+
+
+def notice_source_from_env(env=None):
+    """Build the notice source ``PT_PREEMPT_NOTICE`` names: an
+    ``http(s)://`` URL → :class:`HttpNotice`, anything else → a
+    :class:`FileNotice` path. None when unset."""
+    env = os.environ if env is None else env
+    spec = env.get(ENV_NOTICE)
+    if not spec:
+        return None
+    if spec.startswith("http://") or spec.startswith("https://"):
+        return HttpNotice(spec)
+    return FileNotice(spec)
+
+
+def _fetch_json(url: str, timeout_s: float = 2.0):
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read().decode("utf-8"))
+    except Exception as e:  # per-rank rows degrade, /podz never 500s
+        return {"error": repr(e)}
+
+
+# ---------------------------------------------------------------------------
+# The controller
+# ---------------------------------------------------------------------------
+
+class FleetController:
+    """One rank's view of the fleet control plane.
+
+    Protocol (symmetric — no special coordinator rank, so killing ANY
+    rank mid-agreement degrades the same way):
+
+    1. A rank notices preemption: its handler's SIGTERM flag,
+       :meth:`request` (metadata watcher / API), or — sampled every
+       ``poll_interval_s`` — a peer's published ack.
+    2. It publishes ``preempt.ack.<rank> = <its step>`` and holds,
+       polling until every LIVE rank's ack is present (ranks marked
+       ``dead.<rank>`` by the launcher are dropped from agreement —
+       survivors never hang on a corpse). Timeout ⇒
+       :class:`BarrierTimeoutError` naming the missing ranks.
+    3. Agreed step = ``max(acks)``: no rank ever rewinds; held ranks
+       resume and train UP TO the agreed step, then every rank commits
+       the same step and confirms through ``committed.<rank>``.
+
+    ``TrainLoop.run(controller=...)`` drives all of this; the only
+    methods loops call are :meth:`check` (per step),
+    :meth:`confirm_committed` (after the final save), and
+    :meth:`note_checkpoint` (after periodic saves, for /podz rows).
+    """
+
+    def __init__(self, *, rank: Optional[int] = None,
+                 world: Optional[int] = None,
+                 transport=None,
+                 handler: Optional[PreemptionHandler] = None,
+                 notice_source=None,
+                 coordination_dir: Optional[str] = None,
+                 run_id: Optional[str] = None,
+                 poll_interval_s: float = 0.25,
+                 hold_poll_s: float = 0.02,
+                 watch_interval_s: float = 2.0,
+                 agree_timeout_s: float = 60.0,
+                 commit_timeout_s: float = 300.0,
+                 podz_fetch_timeout_s: float = 2.0):
+        env = os.environ
+        if rank is None:
+            rank = int(env.get("PADDLE_TRAINER_ID",
+                               env.get("JAX_PROCESS_ID", 0)))
+        if world is None:
+            world = int(env.get("PADDLE_TRAINERS_NUM",
+                                env.get("JAX_NUM_PROCESSES", 1)))
+        enforce(0 <= rank < world,
+                "rank %s out of range for world size %s", rank, world)
+        self.rank = rank
+        self.world = world
+        self.run_id = run_id or env.get(ENV_RUN_ID) or "r0"
+        if transport is None and world > 1:
+            transport = auto_transport(run_id=self.run_id,
+                                       root=coordination_dir)
+        self.transport = transport
+        # the launcher is transport-agnostic: its dead-rank markers
+        # always land on the shared file root. When the primary
+        # transport is the coordination service, still consult the
+        # file markers — otherwise a crashed rank would hold the
+        # agreement for the full timeout while the launcher's grace
+        # kill lands first
+        self._marker_transport = None
+        if transport is not None and \
+                getattr(transport, "kind", "") != "file":
+            root = coordination_dir or os.environ.get(ENV_FLEET_DIR)
+            if root:
+                self._marker_transport = FileTransport(root,
+                                                       self.run_id)
+        self.handler = handler if handler is not None \
+            else PreemptionHandler()
+        if notice_source is None:
+            notice_source = notice_source_from_env()
+        self.notice_source = notice_source
+        self.poll_interval_s = poll_interval_s
+        self.hold_poll_s = hold_poll_s
+        self.watch_interval_s = watch_interval_s
+        self.agree_timeout_s = agree_timeout_s
+        self.commit_timeout_s = commit_timeout_s
+        self.podz_fetch_timeout_s = podz_fetch_timeout_s
+        # agreement state
+        self.acked_step: Optional[int] = None
+        self.agreed_step: Optional[int] = None
+        self.last_checkpoint_step: Optional[int] = None
+        self.last_committed_step: Optional[int] = None
+        self.committed_view: Optional[Dict[int, int]] = None
+        self.last_wait_s: Optional[float] = None
+        self.request_reason: Optional[str] = None
+        self._notice = False
+        self._own_endpoint: Optional[str] = None
+        # throttle clock starts NOW: the first transport peek waits a
+        # full interval, so a controller on the hot path costs zero
+        # transport IO until one elapses
+        self._last_peek = time.monotonic()
+        self._watch_error: Optional[str] = None
+        self._watcher: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    def start(self) -> "FleetController":
+        """Register as the process's active controller (the /statusz
+        'controller' section), sweep dead-run transport litter, and
+        start the metadata watcher when a notice source is
+        configured."""
+        global _ACTIVE
+        if self._started:
+            return self
+        self._started = True
+        _ACTIVE = self
+        if self.transport is not None:
+            self.transport.sweep()
+        if self.notice_source is not None:
+            self._stop_evt.clear()
+            self._watcher = threading.Thread(
+                target=self._watch, daemon=True,
+                name="pt-fleet-watcher")
+            self._watcher.start()
+        return self
+
+    def stop(self) -> None:
+        global _ACTIVE
+        self._stop_evt.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+            self._watcher = None
+        if _ACTIVE is self:
+            _ACTIVE = None
+        self._started = False
+
+    def __enter__(self) -> "FleetController":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- preemption notice --------------------------------------------------
+
+    def request(self, reason: str = "api") -> None:
+        """Raise the preempt flag without a signal (metadata watcher,
+        orchestrator RPC, tests). The next :meth:`check` starts the
+        agreement."""
+        self.request_reason = self.request_reason or reason
+        self._notice = True
+        self.handler.request()
+
+    def _requested(self) -> bool:
+        if self._notice:
+            return True
+        if self.handler.requested():
+            self.request_reason = self.request_reason or "signal"
+            return True
+        return False
+
+    def _watch(self) -> None:
+        """Metadata watcher: poll the notice source (and the seeded
+        ``fleet.notice`` injection point — a ``corrupt`` rule is a
+        synthetic notice, a raising rule a flaky metadata endpoint)
+        until a notice lands, then raise the flag once and exit."""
+        while not self._stop_evt.wait(self.watch_interval_s):
+            try:
+                inj = _faults.active()
+                fired = (inj is not None
+                         and bool(inj.fire("fleet.notice")))
+                if fired or self.notice_source.poll():
+                    if telemetry.enabled():
+                        _fleet_metrics()["notices"].inc()
+                    self.request(reason="notice")
+                    return
+            except Exception as e:
+                # a flaky metadata endpoint must never kill the watcher
+                self._watch_error = repr(e)
+
+    # -- the agreement ------------------------------------------------------
+
+    def _marker(self, key: str) -> Optional[str]:
+        """A key on the primary transport OR the launcher's file-marker
+        root (dead/done records can originate from either side)."""
+        v = (self.transport.get(key)
+             if self.transport is not None else None)
+        if v is None and self._marker_transport is not None:
+            v = self._marker_transport.get(key)
+        return v
+
+    def _live_ranks(self) -> List[int]:
+        """Every rank still PARTICIPATING in coordination: not marked
+        dead by the launcher and not cleanly done (a rank whose data
+        stream ran dry publishes ``done.<rank>`` on exit — without it,
+        survivors would hold the agreement for a rank that finished
+        and left). Self always counts — we are provably alive."""
+        if self.transport is None:
+            return [self.rank]
+        return [r for r in range(self.world)
+                if r == self.rank
+                or (self._marker(f"dead.{r}") is None
+                    and self._marker(f"done.{r}") is None)]
+
+    def _peer_ack_seen(self) -> bool:
+        # ONE well-known key, not a per-peer scan: the hot-path sample
+        # stays O(1) transport reads at any world size (an old-client
+        # blocking-get fallback costs one bounded probe, not world-1)
+        if self.transport is None:
+            return False
+        return self.transport.get("preempt.flag") is not None
+
+    def _wait_all(self, prefix: str, *, timeout_s: float,
+                  what: str) -> Dict[int, int]:
+        """Gather ``<prefix>.<rank>``: WAIT only on live ranks, but
+        collect EVERY published value — a rank that acked and then
+        died still contributed its step, so every survivor computes
+        the same max no matter when the dead marker landed relative
+        to its own wait (values are persistent on both transports).
+        On expiry, the typed diagnostic names whoever never arrived."""
+        deadline = time.monotonic() + timeout_s
+        t0 = time.monotonic()
+        while True:
+            vals: Dict[int, int] = {}
+            for r in range(self.world):
+                v = self.transport.get(f"{prefix}.{r}")
+                if v is not None:
+                    vals[r] = int(v)
+            missing = [r for r in self._live_ranks()
+                       if r not in vals]
+            if not missing:
+                self.last_wait_s = round(time.monotonic() - t0, 3)
+                return vals
+            if time.monotonic() >= deadline:
+                note_barrier_timeout()
+                raise BarrierTimeoutError(
+                    what, missing=missing, world=self.world,
+                    timeout_s=timeout_s)
+            time.sleep(self.hold_poll_s)
+
+    def check(self, step: int) -> Optional[int]:
+        """The per-step drive. Returns the agreed preempt step once one
+        exists (the loop commits when ``step >= agreed``), else None.
+        Cheap until a preemption is in flight: one Event peek plus a
+        transport sample at most every ``poll_interval_s``."""
+        if self.agreed_step is not None:
+            return self.agreed_step
+        requested = self._requested()
+        if not requested and self.world > 1:
+            now = time.monotonic()
+            if now - self._last_peek >= self.poll_interval_s:
+                self._last_peek = now
+                if self._peer_ack_seen():
+                    requested = True
+                    self.request_reason = (self.request_reason
+                                           or "peer")
+        if not requested:
+            return None
+        return self._agree(step)
+
+    def _agree(self, step: int) -> int:
+        if self.world <= 1 or self.transport is None:
+            self.agreed_step = int(step)
+        else:
+            if self.acked_step is None:
+                # publish-then-hold: our ack freezes our step, so
+                # max(acks) is an upper bound no rank has passed. The
+                # shared preempt.flag is what peers' O(1) hot-path
+                # sample watches (first writer wins; rewrites are
+                # harmless)
+                self.acked_step = int(step)
+                self.transport.put(f"preempt.ack.{self.rank}",
+                                   str(int(step)))
+                self.transport.put("preempt.flag", str(self.rank))
+            acks = self._wait_all("preempt.ack",
+                                  timeout_s=self.agree_timeout_s,
+                                  what="preempt-agreement")
+            self.agreed_step = max(acks.values())
+        if telemetry.enabled():
+            _fleet_metrics()["agreements"].inc()
+        return self.agreed_step
+
+    def confirm_committed(self, step: int) -> Dict[int, int]:
+        """Publish this rank's committed step and wait for every live
+        rank's — no rank reports a clean preempted exit until the whole
+        fleet's checkpoints are on disk. Returns {rank: step}."""
+        step = int(step)
+        if self.world <= 1 or self.transport is None:
+            self.last_committed_step = step
+            self.committed_view = {self.rank: step}
+            return dict(self.committed_view)
+        self.transport.put(f"committed.{self.rank}", str(step))
+        vals = self._wait_all("committed",
+                              timeout_s=self.commit_timeout_s,
+                              what="commit-confirmation")
+        self.last_committed_step = step
+        self.committed_view = vals
+        return vals
+
+    def note_checkpoint(self, step: int) -> None:
+        """Record the newest step a save targeted (the /podz per-rank
+        'last committed step' row; async writes may still be in
+        flight — the COMMITTED marker on disk is the truth)."""
+        self.last_checkpoint_step = int(step)
+
+    def note_done(self, step: int) -> None:
+        """Announce a CLEAN exit (data stream exhausted / num_steps
+        reached) through the transport: peers drop this rank from
+        future agreements instead of timing out on a rank that
+        finished and left. Best-effort — the launcher's dead marker
+        and the grace kill bound the failure modes either way."""
+        if self.transport is None:
+            return
+        try:
+            self.transport.put(f"done.{self.rank}", str(int(step)))
+        except Exception:
+            pass  # a failed announce degrades to the agree timeout
+
+    # -- pod-level aggregation (/podz) --------------------------------------
+
+    def publish_endpoint(self, host: str, port: int) -> None:
+        """Announce this rank's debug-server address through the
+        transport so any rank's /podz can fan out to it. The debug
+        server binds loopback by default, which a REMOTE aggregator
+        cannot reach — on a real multi-host fleet set
+        ``PT_PODZ_ADVERTISE_HOST`` (this host's routable name) or bind
+        the server on one; the single-host rig needs neither."""
+        host = os.environ.get("PT_PODZ_ADVERTISE_HOST") or host
+        self._own_endpoint = f"{host}:{port}"
+        if self.transport is not None:
+            self.transport.put(f"debug.{self.rank}",
+                               self._own_endpoint)
+
+    def _podz_row(self, r: int) -> Dict[str, Any]:
+        if r == self.rank and self._own_endpoint:
+            ep = self._own_endpoint
+        elif self.transport is not None:
+            ep = self.transport.get(f"debug.{r}")
+        else:
+            ep = None
+        dead = self._marker(f"dead.{r}") is not None
+        done = self._marker(f"done.{r}")
+        row: Dict[str, Any] = {"rank": r, "endpoint": ep,
+                               "dead": dead,
+                               "done_at_step": (int(done)
+                                                if done else None)}
+        if ep and not dead:
+            t = self.podz_fetch_timeout_s
+            h = _fetch_json(f"http://{ep}/healthz", t)
+            row["healthz"] = h
+            if isinstance(h, dict):
+                row["heartbeat_age_s"] = h.get("last_step_age_s")
+            s = _fetch_json(f"http://{ep}/statusz", t)
+            if isinstance(s, dict) and "error" not in s:
+                row["backend"] = s.get("backend")
+                res = s.get("resilience")
+                view = (res.get("controller")
+                        if isinstance(res, dict) else None)
+                if isinstance(view, dict):
+                    row["last_checkpoint_step"] = view.get(
+                        "last_checkpoint_step")
+                    row["last_committed_step"] = view.get(
+                        "last_committed_step")
+                    row["preempt"] = {
+                        k: view.get(k)
+                        for k in ("preempt_requested", "acked_step",
+                                  "agreed_preempt_step")}
+            else:
+                row["statusz_error"] = (s.get("error")
+                                        if isinstance(s, dict)
+                                        else repr(s))
+            m = _fetch_json(f"http://{ep}/memz", t)
+            if isinstance(m, dict):
+                row["peak_mem_bytes"] = m.get("peak_mem_bytes")
+        return row
+
+    def podz(self) -> Dict[str, Any]:
+        """One fleet view: fan out to every rank's /healthz + /statusz
+        + /memz and distill per-rank heartbeat age, last committed
+        step, and preempt state. Unreachable ranks degrade to an error
+        row — /podz renders whatever the fleet can still tell it.
+        Ranks fetch CONCURRENTLY: a scrape of a partially-wedged fleet
+        is bounded near one rank's fetch budget, not world x timeouts."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self.world <= 1:
+            rows = [self._podz_row(0)]
+        else:
+            with ThreadPoolExecutor(
+                    max_workers=min(8, self.world),
+                    thread_name_prefix="pt-podz-fetch") as ex:
+                rows = list(ex.map(self._podz_row,
+                                   range(self.world)))
+        return {"world_size": self.world,
+                "aggregator_rank": self.rank,
+                "run_id": self.run_id,
+                "preempt_requested": self._requested(),
+                "agreed_preempt_step": self.agreed_step,
+                "ranks": {str(row["rank"]): row for row in rows}}
+
+    # -- introspection ------------------------------------------------------
+
+    def statusz(self) -> Dict[str, Any]:
+        """The /statusz 'resilience.controller' section — the per-rank
+        row /podz aggregates: agreement state, notice source, and the
+        last coordination-barrier latency."""
+        out: Dict[str, Any] = {
+            "active": self._started,
+            "rank": self.rank,
+            "world_size": self.world,
+            "run_id": self.run_id,
+            "transport": (getattr(self.transport, "kind", None)
+                          if self.transport is not None else None),
+            "notice_source": (self.notice_source.describe()
+                              if self.notice_source is not None
+                              else None),
+            "watcher_alive": (self._watcher is not None
+                              and self._watcher.is_alive()),
+            "watch_error": self._watch_error,
+            "preempt_requested": self._requested(),
+            "request_reason": self.request_reason,
+            "acked_step": self.acked_step,
+            "agreed_preempt_step": self.agreed_step,
+            "last_checkpoint_step": self.last_checkpoint_step,
+            "last_committed_step": self.last_committed_step,
+            "last_agreement_wait_s": self.last_wait_s,
+        }
+        try:  # lazy: checkpoint pulls jax; /statusz must render anyway
+            from .. import checkpoint as _ckpt
+
+            bs = _ckpt.barrier_stats()
+            out["last_barrier_latency_s"] = bs["last_latency_s"]
+            out["barrier_timeouts"] = bs["timeouts"]
+        except Exception:
+            out["last_barrier_latency_s"] = None
+        return out
+
+
+def active() -> Optional[FleetController]:
+    """The process's started controller, or None (the /statusz hook)."""
+    return _ACTIVE

@@ -19,6 +19,9 @@ Injection points (:data:`POINTS`):
 - ``step.nan``      the training step's loss (corrupt → NaN)
 - ``io.slow``       any checkpoint file I/O (delay rules widen the
   kill window for the SIGKILL e2e and exercise retry deadlines)
+- ``fleet.notice``  the fleet controller's metadata-watcher poll (a
+  ``corrupt`` rule injects a synthetic preemption notice; a raising
+  rule models a flaky metadata endpoint)
 """
 
 from __future__ import annotations
@@ -32,7 +35,7 @@ from .. import telemetry
 from ..core.enforce import enforce
 
 POINTS = ("ckpt.write", "ckpt.manifest", "restore.read", "step.nan",
-          "io.slow")
+          "io.slow", "fleet.notice")
 
 _ACTIVE: Optional["FaultInjector"] = None
 _LOCK = threading.Lock()

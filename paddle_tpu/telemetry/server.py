@@ -16,6 +16,12 @@ measurement cost; it only exposes what the instruments hold):
   ``trace.start_profiler()`` collection is on).
 - ``/memz``     per-device memory (``diag.device_memory``): backend
   ``memory_stats()`` where available, live-array fallback elsewhere.
+- ``/podz``     pod-level fleet view (only when a
+  ``resilience.FleetController`` is attached via :meth:`DebugServer.
+  set_fleet` — ``TrainLoop.run(controller=..., debug_port=...)`` wires
+  it): fans out to every rank's /healthz + /statusz + /memz through
+  the fleet transport and renders one aggregate (per-rank heartbeat
+  age, last committed step, preempt state).
 
 Started opt-in from ``TrainLoop.run(debug_port=...)`` and
 ``serving.BatchedDecoder.run(debug_port=...)`` (or standalone via
@@ -99,6 +105,7 @@ class DebugServer:
         self._t0 = 0.0
         self._last: Dict[str, float] = {}
         self._status: Dict[str, Callable[[], Any]] = {}
+        self._fleet: Optional[Callable[[], Any]] = None
 
     # -- wiring -------------------------------------------------------------
 
@@ -112,6 +119,12 @@ class DebugServer:
         /statusz under ``status[name]`` (evaluated per scrape; failures
         render as an error string, never a 500)."""
         self._status[name] = provider
+
+    def set_fleet(self, provider: Callable[[], Any]) -> None:
+        """Mount a pod-level aggregation provider on ``/podz``
+        (normally ``FleetController.podz`` — evaluated per scrape, so
+        the view is live). Without one, /podz answers 404."""
+        self._fleet = provider
 
     @property
     def port(self) -> int:
@@ -275,10 +288,22 @@ def _make_handler(server: DebugServer):
                 elif path == "/memz":
                     self._send(200, json.dumps(server.memz(),
                                                default=str))
+                elif path == "/podz":
+                    if server._fleet is None:
+                        self._send(404, json.dumps({
+                            "error": "no fleet controller attached "
+                                     "(TrainLoop.run(controller=..., "
+                                     "debug_port=...))"}))
+                    else:
+                        self._send(200, json.dumps(server._fleet(),
+                                                   default=str))
                 elif path == "/":
-                    self._send(200, json.dumps({"endpoints": [
-                        "/metrics", "/healthz", "/statusz", "/tracez",
-                        "/memz"]}))
+                    endpoints = ["/metrics", "/healthz", "/statusz",
+                                 "/tracez", "/memz"]
+                    if server._fleet is not None:
+                        endpoints.append("/podz")
+                    self._send(200, json.dumps(
+                        {"endpoints": endpoints}))
                 else:
                     self._send(404, json.dumps(
                         {"error": f"no such endpoint: {path}"}))

@@ -24,6 +24,7 @@ from .checkpoint import CheckpointManager
 from .core.config import FLAGS
 from .core.enforce import EnforceError, enforce
 from .resilience import faults as _faults
+from .resilience.controller import FleetController
 from .resilience.preemption import PreemptionHandler, _preempt_metrics
 from .telemetry import recompile as _recompile
 from .telemetry import server as _dbg_server
@@ -235,7 +236,8 @@ class TrainLoop:
             prefetch: Union[int, str, None] = None, bucket_by=None,
             pad_value=0, debug_port: Optional[int] = None,
             flight_recorder: Optional[FlightRecorder] = None,
-            preemption: Union[bool, PreemptionHandler, None] = None):
+            preemption: Union[bool, PreemptionHandler, None] = None,
+            controller: Optional[FleetController] = None):
         """Train until ``num_steps`` (global, including resumed) or data
         exhaustion. Returns the final step count — which can end below
         ``num_steps`` after an elastic recovery, since the data stream
@@ -298,6 +300,19 @@ class TrainLoop:
           ``corrupt`` rule poisons the loss so the nan machinery can
           be driven deterministically; a raising rule simulates a
           device fault through the elastic-recovery path.
+        - ``controller=FleetController(...)`` upgrades preemption from
+          per-process to FLEET-COORDINATED (``resilience.controller``):
+          a SIGTERM / metadata notice on ANY rank starts a
+          preempt-at-step agreement over the coordination transport,
+          every rank trains up to the agreed step (``max`` of all
+          ranks' acks — nobody rewinds), commits ONE consistent
+          checkpoint at that step, and confirms through the transport
+          before reporting a clean ``preempted`` exit. The
+          controller's handler doubles as the preemption handler (no
+          separate ``preemption=`` needed); an expired agreement or
+          commit confirmation raises the typed
+          :class:`resilience.BarrierTimeoutError` naming the missing
+          ranks instead of hanging the survivors.
         """
         if prefetch is not None or bucket_by is not None:
             from .data.device_loader import DevicePrefetcher
@@ -345,6 +360,30 @@ class TrainLoop:
             if not pre.installed:
                 pre.install()
                 own_pre = True
+        ctl = controller
+        if ctl is not None:
+            if pre is not None:
+                # explicit preemption= alongside a controller: share
+                # ONE flag — the signal the user's handler receives
+                # must be the same one that starts the fleet agreement
+                ctl.handler = pre
+            else:
+                # the controller's handler IS the preemption handler:
+                # its SIGTERM flag is what starts the fleet agreement
+                pre = ctl.handler
+                if not pre.installed:
+                    try:
+                        pre.install()
+                        own_pre = True
+                    except ValueError:
+                        # not the main thread (signal.signal
+                        # constraint): the controller still preempts
+                        # via notices/peer acks
+                        pass
+        own_ctl = False
+        if ctl is not None and not ctl.started:
+            ctl.start()
+            own_ctl = True
         inj = _faults.active()
         if self._watchdog:
             self._watchdog.start()
@@ -378,8 +417,47 @@ class TrainLoop:
                     self.debug_server.add_status(
                         "sharding_plan",
                         lambda: plan.describe(getattr(tp, "params", None)))
+                if ctl is not None:
+                    # pod-level aggregation: announce this rank's
+                    # endpoint through the fleet transport and mount
+                    # the controller's fan-out view on /podz
+                    ctl.publish_endpoint(self.debug_server.host,
+                                         self.debug_server.port)
+                    self.debug_server.set_fleet(ctl.podz)
+
+            def _commit_preempt():
+                # coordinated preemption epilogue: ONE consistent
+                # checkpoint at the agreed step, confirmed through the
+                # transport so no rank reports a clean exit before the
+                # whole fleet's commit is on disk
+                self.status = "preempted"
+                self.history["preempted_at"] = self.step
+                self.history["preempt_agreed_step"] = ctl.agreed_step
+                self.manager.wait_until_finished()
+                if self.step > 0 and \
+                        self.step not in self.manager.committed_steps():
+                    self.manager.save(self.step, self.trainer.state())
+                    self.manager.wait_until_finished()
+                ctl.note_checkpoint(self.step)
+                committed = ctl.confirm_committed(self.step)
+                if committed and len(set(committed.values())) > 1:
+                    # only reachable when a rank's data stream ran dry
+                    # below the agreed step — worth an operator line
+                    print(f"[fleet] ranks committed differing steps: "
+                          f"{committed}", file=sys.stderr)
+
             for batch in batches:
-                if pre is not None and pre.requested():
+                if ctl is not None:
+                    # fleet-coordinated preemption: check() is an Event
+                    # peek + a throttled transport sample until a
+                    # preemption is in flight, then publishes this
+                    # rank's ack and HOLDS for the agreement; ranks
+                    # below the agreed step keep training up to it
+                    agreed = ctl.check(self.step)
+                    if agreed is not None and self.step >= agreed:
+                        _commit_preempt()
+                        break
+                elif pre is not None and pre.requested():
                     # preemption grace: the in-flight step already
                     # finished (top-of-body check also covers the
                     # nan-skip/recovery continue paths); break out
@@ -552,6 +630,14 @@ class TrainLoop:
                 if self.checkpoint_every and \
                         self.step % self.checkpoint_every == 0:
                     self.manager.save(self.step, self.trainer.state())
+                    if ctl is not None:
+                        ctl.note_checkpoint(self.step)
+            if ctl is not None and self.status == "running" and \
+                    ctl.agreed_step is not None:
+                # the stream ran dry (or num_steps landed) below the
+                # agreed step: still commit and confirm what we have —
+                # peers are holding for this rank's commit record
+                _commit_preempt()
         except BaseException:
             # OUR exception, not sys.exc_info(): run() called from a
             # caller's except block must not read the caller's
@@ -567,6 +653,14 @@ class TrainLoop:
                 pre.uninstall()
             if self.status == "running":
                 self.status = "completed"
+            if ctl is not None and self.status == "completed":
+                # announce the clean exit BEFORE leaving: without it,
+                # a later preemption would hold the agreement for a
+                # rank that finished its data and left (faulted exits
+                # stay unannounced — the launcher marks those dead)
+                ctl.note_done(self.step)
+            if own_ctl:
+                ctl.stop()
             self.close()
         if self.status == "preempted" and telemetry.enabled():
             # counted AFTER close(): the final checkpoint is on disk,

@@ -80,6 +80,15 @@ SLOW_PATTERNS = [
     # tier via the bare test_chaos.py MID pattern
     "test_chaos.py::test_sigkill_mid_save_resumes_last_committed",
     "test_chaos.py::test_launch_relays_sigterm_within_grace",
+    # fleet-controller chaos e2es: ci.sh mid runs them as their own
+    # "fleet smoke" stage (pytest -m chaos on the file), so the bare
+    # filename MID pattern must not pull them into -m mid a second time
+    "test_fleet_controller.py::test_coordinated_sigterm_both_ranks_"
+    "commit_same_step",
+    "test_fleet_controller.py::test_chaos_coordinator_killed_mid_"
+    "agreement_is_typed_error",
+    "test_fleet_controller.py::test_elastic_n_minus_one_restart_"
+    "resumes_committed_step",
 ]
 
 # mid tier = smoke + one representative per DEEP subsystem (pallas
@@ -154,6 +163,7 @@ MID_PATTERNS = [
     "test_resilience.py",
     "test_chaos.py",
     "test_fleet.py",
+    "test_fleet_controller.py",
     "test_static.py",
     "test_sparse_embedding_grads.py",
     "test_moe.py",

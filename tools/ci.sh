@@ -50,6 +50,10 @@ case "$MODE" in
   mid)
     stage "mid tier (pytest -m mid)"
     python -m pytest tests/ -m mid -q || exit $?
+    stage "fleet smoke (2-rank launch -> train -> coordinated SIGTERM \
+-> resume; chaos tier, FaultInjector seeds pinned)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_controller.py \
+      -q -m chaos || exit $?
     stage "multichip dryrun (8-device CPU sim)"
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
