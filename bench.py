@@ -855,6 +855,245 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     return total / dt, "tokens/sec", extras
 
 
+def _router_replica_spec(smoke=False, kv_dtype=None, slots=4,
+                         seed=0, prefill_chunk=None):
+    """Replica model contract for the router bench + worker processes
+    (``python -m paddle_tpu.serving_router --worker --spec
+    bench:_router_replica_spec``): every replica builds the SAME
+    weights (fixed seed), so placement is invisible in the output."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.serving import BatchedDecoder
+
+    pt.seed(seed)
+    cfg = G.GPTConfig.small()
+    cap = 256
+    if smoke:
+        # 3 layers (not the usual smoke 2): the router A/B's signal is
+        # the absolute ms a monolithic long-prompt prefill steals from
+        # decode — one extra layer grows that effect past CI timing
+        # noise at still-smoke cost
+        cfg.vocab_size, cfg.num_layers = 1024, 3
+        cap, slots = 128, max(2, slots // 2)
+    cfg.max_position = cap
+    model = G.GPTForCausalLM(cfg).eval()
+    kw = {}
+    if prefill_chunk:
+        kw["prefill_chunk"] = prefill_chunk
+    return BatchedDecoder(
+        model, slots=slots, capacity=cap,
+        pages=slots * (cap // 64) + 8, page_size=64,
+        kv_dtype=kv_dtype, **kw)
+
+
+def _open_loop(router, prompts, max_new: int, rate_rps: float,
+               rng, timeout_s: float = 900.0):
+    """Seeded Poisson OPEN-loop load: arrivals are exponential gaps at
+    ``rate_rps`` independent of completions (the closed-loop bench
+    hides queueing collapse; open-loop is how serving studies measure
+    TTFT under load). Returns (tickets, wall_s) with wall measured
+    submit-of-first to completion-of-last non-shed request."""
+    gaps = rng.exponential(1.0 / rate_rps, size=len(prompts))
+    arrivals = np.cumsum(gaps)
+    t0 = time.perf_counter()
+    tickets = []
+    for i, p in enumerate(prompts):
+        while time.perf_counter() - t0 < arrivals[i]:
+            time.sleep(0.0005)
+        tickets.append(router.submit(p, max_new, session=f"s{i}"))
+    router.wait(tickets, timeout=timeout_s)
+    return tickets, time.perf_counter() - t0
+
+
+def _arm_stats(tickets, wall_s: float, short_lt=None):
+    served = [t for t in tickets if not t.shed]
+    ttfts = np.asarray([t.ttft_s for t in served])
+    toks = sum(len(t.tokens) for t in served)
+    itls = np.asarray([t.itl_p99_s for t in served])
+    out = {
+        "ttft_p50_ms": round(float(np.quantile(ttfts, 0.5)) * 1e3, 2),
+        "ttft_p99_ms": round(float(np.quantile(ttfts, 0.99)) * 1e3, 2),
+        "itl_p99_ms": round(float(np.quantile(itls, 0.99)) * 1e3, 2),
+        "tokps": round(toks / wall_s, 2),
+        "shed_rate": round(1.0 - len(served) / len(tickets), 4),
+        "requests": len(tickets),
+    }
+    if short_lt is not None:
+        # the interactive tail: TTFT of SHORT prompts only. A long
+        # prompt's own TTFT is prefill-dominated either way; what
+        # disaggregation structurally removes is shorts waiting behind
+        # someone ELSE's monolithic prefill
+        s = np.asarray([t.ttft_s for t in served
+                        if len(t.prompt) < short_lt])
+        if len(s):
+            out["ttft_short_p99_ms"] = round(
+                float(np.quantile(s, 0.99)) * 1e3, 2)
+            # the gate statistic: a mean over all shorts averages
+            # scheduler noise that a 12-sample p99 (= max) cannot
+            out["ttft_short_mean_ms"] = round(
+                float(s.mean()) * 1e3, 2)
+    return out
+
+
+def bench_gpt_router(steps: int, batch_size: int, amp=None,
+                     smoke: bool = False, replicas: int = 2,
+                     prefill_workers: int = 1, overload: float = 2.0,
+                     kv_dtype=None, router_procs: bool = False):
+    """Production-serving A/B (serving_router.Router): a seeded Poisson
+    OPEN-loop load with long prompts mixed in, three arms on the same
+    replicas —
+
+    1. ``mono``: single replica, monolithic whole-prompt prefill (the
+       pre-router baseline: a long admission stalls every decode tick);
+    2. headline: ``replicas`` decode replicas behind the router with
+       ``prefill_workers`` dedicated prefill workers (long prompts
+       prefill OFF the decode loop and hand off KV pages) at the SAME
+       offered rate — the p99-TTFT win at equal aggregate tok/s;
+    3. ``overload``: the same topology at ``overload``x the rate with
+       the SLO shed policy on — p99 TTFT stays bounded (sheds absorb
+       the excess) instead of queue collapse.
+
+    The offered rate self-calibrates to 85% of the mono replica's
+    closed-loop service rate (high enough that arrivals collide with
+    monolithic long-prompt prefills, below mono saturation), so the
+    numbers transfer across backends.
+    ``--router-procs`` runs the replicas as real worker processes over
+    HTTP (the deployment shape); default is in-process replica threads
+    (same router code path, deterministic for the gate test)."""
+    from paddle_tpu.serving_router import (LocalReplica, Router,
+                                           SLOPolicy, spawn_replicas)
+
+    n_req = 18 if smoke else max(18, min(steps, 48))
+    long_len, max_new = (112, 8) if smoke else (192, 16)
+    disagg_min = long_len // 2
+    rng = np.random.default_rng(0)
+    vocab = 1024 if smoke else 50257
+    spec_kw = {"smoke": smoke, "kv_dtype": kv_dtype}
+
+    def mk_prompts(n, seed):
+        # every 3rd prompt is LONG — the mix that makes monolithic
+        # admission visibly steal decode ticks (the disagg motivation)
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            ln = long_len if i % 3 == 2 else int(8 + (i * 5) % 16)
+            out.append(r.integers(1, vocab, (ln,)).astype(np.int32))
+        return out
+
+    def drive(rep, rids, timeout_s=600.0):
+        # transport-agnostic completion wait: ACCUMULATE drained
+        # results locally (HttpReplica's /drain consumes server-side;
+        # a keep=True peek only exists on LocalReplica)
+        seen = {}
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            seen.update(rep.drain_results())
+            if all(r in seen for r in rids):
+                return seen
+            time.sleep(0.01)
+        raise TimeoutError(f"replica {rep.name}: warm/calibration "
+                           f"requests incomplete after {timeout_s}s")
+
+    if router_procs:
+        spec = "bench:_router_replica_spec"
+        reps = spawn_replicas(spec, replicas, spec_kw=spec_kw)
+        pfs = spawn_replicas(spec, prefill_workers, role="prefill",
+                             spec_kw=spec_kw) if prefill_workers else []
+    else:
+        reps = [LocalReplica(_router_replica_spec(**spec_kw),
+                             name=f"r{i}").start()
+                for i in range(replicas)]
+        pfs = [LocalReplica(_router_replica_spec(**spec_kw),
+                            name=f"pf{i}")
+               for i in range(prefill_workers)]
+        # warm every jit path the load will hit (short + long prompt
+        # buckets, the serving step, the prefill worker's long bucket)
+        warm = mk_prompts(2, 99)
+        for rep in reps:
+            drive(rep, [rep.submit(p, 2)
+                        for p in (warm[0], warm[1],
+                                  np.ones(long_len, np.int32))])
+        for pw in pfs:
+            pw.decoder.prefill_export(np.ones(long_len, np.int32))
+            pw.decoder._warmed = True
+        if pfs:
+            # one full disagg round trip per decode replica: compiles
+            # the page-import executables so the first TIMED handoff
+            # isn't a cold trace
+            h = pfs[0].prefill(np.ones(long_len, np.int32))
+            for rep in reps:
+                drive(rep, [rep.inject(h, 2)])
+    try:
+        # rate calibration: closed-loop service rate of ONE replica
+        cal = mk_prompts(8, 1)
+        t0 = time.perf_counter()
+        drive(reps[0], [reps[0].submit(p, max_new) for p in cal])
+        cal_rps = len(cal) / (time.perf_counter() - t0)
+        # 85% of the MONO closed-loop service rate: high enough that
+        # arrivals collide with monolithic long-prompt prefills (the
+        # tail the router exists to fix), below mono saturation so the
+        # baseline arm still drains
+        rate = 0.85 * cal_rps
+
+        # arms 1+2 INTERLEAVED in alternating blocks (mono, disagg,
+        # mono, disagg) over the same replicas: both arms sample the
+        # same machine-load epochs, so slow background drift between
+        # two sequentially-timed arms can't masquerade as (or mask)
+        # the disaggregation effect
+        mono_router = Router(reps[:1], poll_interval_s=0.02)
+        head_router = Router(reps, prefill_workers=pfs,
+                             disagg_min_tokens=disagg_min,
+                             poll_interval_s=0.02)
+        arm_tickets = {"mono": [], "head": []}
+        arm_wall = {"mono": 0.0, "head": 0.0}
+        half = max(6, n_req // 2)
+        for b, arm in enumerate(("mono", "head", "mono", "head")):
+            router = mono_router if arm == "mono" else head_router
+            tickets, wall = _open_loop(
+                router, mk_prompts(half, 10 + b // 2), max_new, rate,
+                np.random.default_rng(100 + b))
+            arm_tickets[arm].extend(tickets)
+            arm_wall[arm] += wall
+        mono = _arm_stats(arm_tickets["mono"], arm_wall["mono"],
+                          short_lt=disagg_min)
+        head = _arm_stats(arm_tickets["head"], arm_wall["head"],
+                          short_lt=disagg_min)
+        mono_router.close()
+        head_router.close()
+
+        # arm 3: overload with the SLO shed policy. The overload rate
+        # anchors on the CLOSED-LOOP service rate (saturation), not the
+        # 70% offered rate — "2x overload" must actually exceed
+        # capacity or no queue ever builds; the arm runs 2x as many
+        # requests so the queue demonstrably grows without the policy
+        router = Router(reps, prefill_workers=pfs,
+                        disagg_min_tokens=disagg_min,
+                        policy=SLOPolicy(degrade_at=1.0, shed_at=1.5),
+                        poll_interval_s=0.02)
+        over = _arm_stats(*_open_loop(router, mk_prompts(2 * n_req, 3),
+                                      max_new, overload * cal_rps,
+                                      rng))
+        router.close()
+    finally:
+        for rep in reps + pfs:
+            rep.close()
+    extras = dict(head)
+    extras.update({
+        "replicas": replicas, "prefill_workers": prefill_workers,
+        "rate_rps": round(rate, 3),
+        "mono_ttft_p50_ms": mono["ttft_p50_ms"],
+        "mono_ttft_p99_ms": mono["ttft_p99_ms"],
+        "mono_ttft_short_p99_ms": mono.get("ttft_short_p99_ms"),
+        "mono_ttft_short_mean_ms": mono.get("ttft_short_mean_ms"),
+        "mono_itl_p99_ms": mono["itl_p99_ms"],
+        "mono_tokps": mono["tokps"],
+        "overload_ttft_p99_ms": over["ttft_p99_ms"],
+        "overload_shed_rate": over["shed_rate"],
+        "overload_tokps": over["tokps"],
+    })
+    return extras.pop("tokps"), "tokens/sec", extras
+
+
 def _kv_serve_density(model, cap: int, smoke: bool):
     """The serving-density A/B behind ``--kv-dtype int8``: at ONE
     page-pool HBM budget (what ``base_pages`` fp32 pages cost), how
@@ -1543,6 +1782,11 @@ def run_config_fingerprint(metric: str, args, steps: int):
         "window": args.window, "kv_cache": args.kv_cache,
         "gamma": args.gamma, "weight_only": args.weight_only,
         "paged": args.paged,
+        "router": (args.replicas if getattr(args, "router", False)
+                   else None),
+        "router_prefill_workers": (
+            args.prefill_workers if getattr(args, "router", False)
+            else None),
         "layout": args.layout, "dp": args.dp, "infer": args.infer,
     }
     # None = knob not set; False values (e.g. --no-fused-ce) are REAL
@@ -1725,6 +1969,24 @@ def main():
                     "--paged; int8 values + per-vector scales — "
                     "~3.7x pages per HBM byte) plus the max-sessions "
                     "density A/B and greedy parity extras")
+    ap.add_argument("--router", action="store_true",
+                    help="gpt_serve: the production-serving A/B — "
+                    "multi-replica router + prefill/decode "
+                    "disaggregation + SLO shed under a seeded Poisson "
+                    "open-loop load (p50/p99 TTFT, p99 ITL, aggregate "
+                    "tok/s, shed rate; _routerN history key)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="--router: decode replica count")
+    ap.add_argument("--prefill-workers", dest="prefill_workers",
+                    type=int, default=1,
+                    help="--router: dedicated prefill workers (0 = "
+                    "no disaggregation)")
+    ap.add_argument("--overload", type=float, default=2.0,
+                    help="--router: overload factor for the shed arm")
+    ap.add_argument("--router-procs", dest="router_procs",
+                    action="store_true",
+                    help="--router: replicas as real worker processes "
+                    "over HTTP instead of in-process threads")
     ap.add_argument("--prefill-chunk", dest="prefill_chunk", type=int,
                     default=None,
                     help="gpt_serve: chunked prefill — C prompt tokens "
@@ -1789,9 +2051,21 @@ def main():
     global _MODE
     _MODE = "infer" if args.infer else "train"
     fn = MODELS[args.model]
+    if args.router:
+        if args.model != "gpt_serve":
+            _emit_error(f"{args.model}_throughput",
+                        "--router only applies to --model gpt_serve")
+            return
+        fn = bench_gpt_router
     sig = inspect.signature(fn).parameters
     metric = (f"{args.model}_infer_throughput" if args.infer
               else f"{args.model}_throughput")
+    if args.router:
+        # the router A/B is its own WORKLOAD (open-loop Poisson load,
+        # multi-replica topology): one history key per replica count
+        metric += f"_router{args.replicas}"
+        if args.router_procs:
+            metric += "_procs"
     if (args.vocab and "vocab" in sig
             and args.vocab != sig["vocab"].default):
         metric += f"_v{args.vocab}"
@@ -1990,6 +2264,11 @@ def main():
         kwargs["paged"] = True
     if args.kv_dtype and "kv_dtype" in sig:
         kwargs["kv_dtype"] = args.kv_dtype
+    if args.router:
+        kwargs["replicas"] = args.replicas
+        kwargs["prefill_workers"] = args.prefill_workers
+        kwargs["overload"] = args.overload
+        kwargs["router_procs"] = args.router_procs
     if args.prefill_chunk and "prefill_chunk" in sig:
         kwargs["prefill_chunk"] = args.prefill_chunk
     if (args.decode_steps and args.decode_steps > 1
@@ -2152,13 +2431,20 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
     # verbatim
     line.update({k: v for k, v in extras.items()
                  if k.startswith(("latency_ms_", "comm_", "parity_",
-                                  "kv_", "max_sessions_"))
+                                  "kv_", "max_sessions_",
+                                  # router serving A/B: TTFT/ITL
+                                  # percentiles, shed rates, and the
+                                  # mono/overload comparison arms
+                                  "ttft_", "itl_", "mono_",
+                                  "overload_"))
                  or k in ("accept_per_round", "rounds", "prefetch_off",
                           "prefetch_on", "overlap_speedup", "fsdp",
                           "peak_mem_bytes_replicated",
                           "peak_mem_bytes_planned", "byte_budget",
                           "fits_budget_only_planned", "shard_ratio",
-                          "session_ratio", "step_time_ms_fp32", "dp")})
+                          "session_ratio", "step_time_ms_fp32", "dp",
+                          "shed_rate", "replicas", "prefill_workers",
+                          "rate_rps")})
     flops_per_sec = extras.get("flops_per_sec")
     line["mfu"] = None
     if flops_per_sec:

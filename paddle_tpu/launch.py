@@ -32,7 +32,13 @@ Behavior:
   process set (the cross-plan-shape restore);
 - exit code = the final attempt's first non-zero worker exit code,
   else 0.
-"""
+
+Serving bring-up (``--serve``): instead of training ranks, spawn
+``--nproc`` serving REPLICA worker processes (+ ``--prefill-workers``
+dedicated prefill workers) from a ``--spec module:fn`` decoder factory
+and run the :mod:`paddle_tpu.serving_router` front end over them —
+the one-command form of the production serving plane (README
+"Production serving")."""
 
 from __future__ import annotations
 
@@ -363,10 +369,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "shrink down to a single worker)")
     ap.add_argument("--min-procs", type=int, default=1,
                     help="never restart with fewer workers than this")
-    ap.add_argument("script", help="training script to run per rank")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving bring-up: spawn --nproc serving "
+                    "replica workers (+ --prefill-workers) from --spec "
+                    "and run the serving_router front end over them")
+    ap.add_argument("--spec", default=None,
+                    help="--serve: module:function returning each "
+                    "replica's serving.BatchedDecoder")
+    ap.add_argument("--spec-kw", dest="spec_kw", default=None,
+                    help="--serve: JSON kwargs for the spec function")
+    ap.add_argument("--prefill-workers", dest="prefill_workers",
+                    type=int, default=0,
+                    help="--serve: dedicated prefill workers "
+                    "(prefill/decode disaggregation; 0 = chunked "
+                    "prefill stays the in-replica fallback)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="--serve: router front-end port (0 = "
+                    "ephemeral)")
+    ap.add_argument("script", nargs="?", default=None,
+                    help="training script to run per rank (omitted "
+                    "with --serve)")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed through to the script")
     args = ap.parse_args(argv)
+    if args.serve:
+        if not args.spec:
+            ap.error("--serve requires --spec module:fn")
+        import json as _json
+
+        from .serving_router import serve_main
+
+        router = serve_main(
+            args.spec, replicas=args.nproc,
+            prefill_workers=args.prefill_workers, port=args.port,
+            spec_kw=_json.loads(args.spec_kw) if args.spec_kw else None,
+            log_dir=args.log_dir)
+        print(f"[launch] router serving on {router.server.url()} over "
+              f"{args.nproc} replica(s) + {args.prefill_workers} "
+              f"prefill worker(s)", file=sys.stderr)
+        import threading as _threading
+
+        stop = _threading.Event()
+        try:
+            signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        except ValueError:
+            pass  # not the main thread
+        try:
+            while not stop.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            pass
+        finally:
+            router.close(replicas=True)
+        return 0
+    if not args.script:
+        ap.error("script is required (unless --serve)")
     endpoints = (args.endpoints.split(",") if args.endpoints else None)
     return launch(args.script, args.script_args, nproc=args.nproc,
                   endpoints=endpoints, log_dir=args.log_dir,

@@ -144,6 +144,40 @@ def write_chunk_rows(kpool, vpool, table, t0_rows, k_c, v_c,
     return kpool, vpool
 
 
+def export_pages(pool, ids):
+    """Materialize the CONTENTS of pages ``ids`` (n,) — the
+    prefill→decode KV-handoff wire payload: ``(n, page_size, kv_heads,
+    head_dim)`` values for a float pool, ``(q, scale)`` arrays for a
+    :class:`QuantizedPool` (int8 values + per-vector scales travel
+    together, so a handoff never silently dequantizes). Pure gather —
+    the caller owns any device→host transfer."""
+    if isinstance(pool, QuantizedPool):
+        return pool.q[ids], pool.scale[ids]
+    return pool[ids]
+
+
+def import_pages(pool, ids, payload):
+    """Write :func:`export_pages` payloads into pages ``ids`` of
+    ``pool`` (the decode-side half of the KV handoff). Storage forms
+    must match: a quantized payload only lands in a quantized pool —
+    re-quantizing a dequantized handoff would double the quantization
+    error, so the mismatch is a typed error instead."""
+    from ..core.enforce import enforce
+
+    if isinstance(pool, QuantizedPool):
+        enforce(isinstance(payload, tuple) and len(payload) == 2,
+                "quantized pool needs a (q, scale) payload, got %s",
+                type(payload).__name__)
+        q, scale = payload
+        return QuantizedPool(
+            pool.q.at[ids].set(jnp.asarray(q, jnp.int8)),
+            pool.scale.at[ids].set(jnp.asarray(scale, jnp.float32)))
+    enforce(not isinstance(payload, tuple),
+            "float pool cannot import a quantized (q, scale) payload "
+            "— kv_dtype must match across the handoff")
+    return pool.at[ids].set(jnp.asarray(payload).astype(pool.dtype))
+
+
 def gather_rows(pool, table):
     """Assemble each row's LOGICAL cache: (B, n_log*page_size, kv, hd).
     The fallback/prefill view; the decode kernel never materializes

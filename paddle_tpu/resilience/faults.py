@@ -22,6 +22,10 @@ Injection points (:data:`POINTS`):
 - ``fleet.notice``  the fleet controller's metadata-watcher poll (a
   ``corrupt`` rule injects a synthetic preemption notice; a raising
   rule models a flaky metadata endpoint)
+- ``router.dispatch`` the serving router's per-request dispatch to a
+  replica (``path`` = the replica name, so ``match=`` targets one
+  replica) — a raising rule models a replica dying mid-dispatch and
+  drives the router's retry-on-surviving-replica path deterministically
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ from .. import telemetry
 from ..core.enforce import enforce
 
 POINTS = ("ckpt.write", "ckpt.manifest", "restore.read", "step.nan",
-          "io.slow", "fleet.notice")
+          "io.slow", "fleet.notice", "router.dispatch")
 
 _ACTIVE: Optional["FaultInjector"] = None
 _LOCK = threading.Lock()

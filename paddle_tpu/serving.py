@@ -47,6 +47,9 @@ from jax import lax
 
 from . import telemetry
 from .core.enforce import enforce
+
+__all__ = ["BatchedDecoder", "PagedKVPool", "Request", "KVHandoff",
+           "reject_cause"]
 from .nn.layer import inject_state
 from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
@@ -79,7 +82,18 @@ def _serving_metrics(reg):
             "pt_serving_queue_depth", "requests waiting for a slot"),
         "rejections": reg.counter(
             "pt_serving_admission_rejections_total",
-            "paged admissions deferred on page-pool exhaustion"),
+            "admissions rejected or deferred (all causes; see the "
+            "cause-labeled series for the split)"),
+        # cause-labeled split of the same total (unlabeled series kept
+        # for dashboard compat): pool_exhausted = paged admission
+        # deferred on page exhaustion, capacity = hard queue-depth cap,
+        # shed = SLO load-shed (router-side policy)
+        "rejections_by_cause": {
+            cause: reg.counter(
+                "pt_serving_admission_rejections_total",
+                "admissions rejected or deferred, by cause",
+                labels={"cause": cause})
+            for cause in ("pool_exhausted", "capacity", "shed")},
         "page_occupancy": reg.gauge(
             "pt_serving_page_occupancy_ratio",
             "allocated fraction of the KV page pool"),
@@ -242,6 +256,105 @@ def _row_apply(caches, s, fn):
     return out, new
 
 
+def reject_cause(cause: str) -> None:
+    """Bump the admission-rejection counters (unlabeled total + the
+    cause-labeled series) — the ONE place the split is recorded, shared
+    by the arena's pool backpressure and the router's shed policy.
+    No-op while telemetry is disabled."""
+    if not telemetry.enabled():
+        return
+    m = _serving_metrics()
+    m["rejections"].inc()
+    by = m["rejections_by_cause"].get(cause)
+    if by is not None:
+        by.inc()
+
+
+class KVHandoff:
+    """Prefilled KV pages + next-token logits for ONE prompt — the
+    prefill→decode disaggregation wire unit. A dedicated prefill worker
+    produces it (:meth:`BatchedDecoder.prefill_export`), a decode
+    replica consumes it (:meth:`BatchedDecoder.inject_prefilled`), so a
+    long prompt's whole-prompt prefill never runs inside a decode
+    replica's serving loop.
+
+    ``blocks`` holds one ``(k_payload, v_payload)`` per transformer
+    block: ``(m, page_size, kv_heads, head_dim)`` float arrays, or
+    ``(q, scale)`` tuples for int8 pools (the storage form crosses the
+    wire intact — no dequant/requant round trip). ``to_bytes`` /
+    ``from_bytes`` are the npz wire format the HTTP handoff uses."""
+
+    def __init__(self, prompt, plen: int, logits, blocks,
+                 page_size: int, kv_dtype=None):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.plen = int(plen)
+        self.logits = np.asarray(logits, np.float32)
+        self.blocks = blocks
+        self.page_size = int(page_size)
+        self.kv_dtype = kv_dtype
+
+    @property
+    def pages(self) -> int:
+        """Pages per block the payload covers."""
+        first = self.blocks[0][0]
+        return (first[0] if isinstance(first, tuple)
+                else first).shape[0]
+
+    @property
+    def nbytes(self) -> int:
+        n = 0
+        for kp, vp in self.blocks:
+            for p in (kp, vp):
+                arrs = p if isinstance(p, tuple) else (p,)
+                n += sum(int(a.nbytes) for a in arrs)
+        return n
+
+    def to_bytes(self) -> bytes:
+        import io
+
+        quant = self.kv_dtype is not None
+
+        def stack(side):
+            if quant:
+                return (np.stack([np.asarray(b[side][0])
+                                  for b in self.blocks]),
+                        np.stack([np.asarray(b[side][1])
+                                  for b in self.blocks]))
+            return (np.stack([np.asarray(b[side])
+                              for b in self.blocks]),)
+
+        arrays = {"prompt": self.prompt,
+                  "logits": self.logits,
+                  "meta": np.asarray([self.plen, self.page_size,
+                                      int(quant)], np.int64)}
+        for side, name in ((0, "k"), (1, "v")):
+            payload = stack(side)
+            if quant:
+                arrays[name + "q"], arrays[name + "s"] = payload
+            else:
+                arrays[name] = payload[0]
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "KVHandoff":
+        import io
+
+        z = np.load(io.BytesIO(data))
+        plen, page_size, quant = (int(x) for x in z["meta"])
+        blocks = []
+        if quant:
+            n = z["kq"].shape[0]
+            blocks = [((z["kq"][i], z["ks"][i]),
+                       (z["vq"][i], z["vs"][i])) for i in range(n)]
+        else:
+            blocks = [(z["k"][i], z["v"][i])
+                      for i in range(z["k"].shape[0])]
+        return KVHandoff(z["prompt"], plen, z["logits"], blocks,
+                         page_size, "int8" if quant else None)
+
+
 class Request:
     """One generation request; ``result`` is filled on completion."""
 
@@ -250,7 +363,11 @@ class Request:
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.result: Optional[np.ndarray] = None
-        self.t_submit = 0.0  # stamped at submit when telemetry is on
+        self.t_submit = 0.0   # stamped at submit (always — the router
+        self.t_first = 0.0    # latency accounting reads these even
+        self.t_done = 0.0     # with telemetry off; three float stores)
+        self.t_tokens: List[float] = []  # per-token emission stamps
+        self.handoff: Optional[KVHandoff] = None  # pre-filled KV pages
 
 
 class BatchedDecoder:
@@ -424,8 +541,17 @@ class BatchedDecoder:
         self.done: Dict[int, Request] = {}
         self._next_rid = 0
         self._prefill_cache: Dict[int, object] = {}
-        self._step_fn = None
+        # jitted arena steps keyed by tokens-per-dispatch k: degraded
+        # mode drops to k=1 without retracing the k=decode_steps fn
+        self._step_fns: Dict[int, object] = {}
         self._spec_fn = None
+        # SLO degrade lever (router-driven): forces decode_steps=1 and
+        # bypasses speculative rounds until cleared — see set_degraded
+        self.degraded = False
+        # readiness (router placement signal, distinct from liveness):
+        # False until the serving step has dispatched once (jit warm),
+        # False again while draining on preemption
+        self._warmed = False
         self._weights_fp = None  # stamped per run() when telemetry on
         # weights/buffers snapshot, passed to every jitted fn as REAL
         # arguments (inject_state): compiled programs stay weight-free,
@@ -477,8 +603,8 @@ class BatchedDecoder:
                     "request needs %s pages but the pool only has %s",
                     need, self._allocator.pages)
         self._next_rid += 1
+        r.t_submit = time.perf_counter()
         if telemetry.enabled():
-            r.t_submit = time.perf_counter()
             _serving_metrics()["requests"].inc()
             # /healthz last-request age (owner-scoped while run() has
             # our server up; submits outside a live run broadcast — a
@@ -550,6 +676,11 @@ class BatchedDecoder:
                             "spec": self.draft is not None,
                             "decode_steps": self.decode_steps}).start()
             self.debug_server.add_status("serving", self._statusz)
+            # readiness is distinct from liveness: a draining or
+            # not-yet-warmed arena answers ready=false on /healthz +
+            # /readyz so a router stops PLACING sessions here without
+            # concluding the process is dead
+            self.debug_server.set_ready(lambda: self.ready)
             if self.queue or self._pf_order or self.active.any():
                 # requests submitted before the server came up: seed the
                 # last-request clock now (a lower bound on the true age)
@@ -645,7 +776,142 @@ class BatchedDecoder:
         if self.draft is not None:
             st["spec_rounds"] = self.spec_rounds
             st["spec_accepted"] = self.spec_accepted
+        st["ready"] = self.ready
+        st["degraded"] = self.degraded
         return st
+
+    # ----- router surface (readiness, degrade, KV handoff) -----------------
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (placement signal): True once the arena has
+        dispatched a step (jit warm) and it is not draining. Liveness
+        stays /healthz's heartbeat clocks — a not-ready replica is
+        healthy, just not placeable."""
+        return self._warmed and not self.preempted
+
+    def set_degraded(self, on: bool) -> None:
+        """SLO degrade lever (the router's load-shed precursor): while
+        on, every dispatch emits ONE token (decode_steps forced to 1 —
+        eos/budget granularity tightens, so no mid-window tail is ever
+        computed just to be discarded) and speculative rounds are
+        bypassed (no draft steps, no gamma+1 verify chunk per tick).
+        Output correctness is unaffected either way: the plain step
+        emits the target's own picks, and on re-enable the rejection
+        test keeps outputs target-distributed even against a stale
+        draft cache (stale drafts only lower the accept rate)."""
+        self.degraded = bool(on)
+
+    def prefill_export(self, prompt_ids) -> KVHandoff:
+        """Run the bucketed prefill for ``prompt_ids`` and EXPORT the
+        resulting KV pages + next-token logits instead of activating a
+        slot — the prefill-worker half of prefill/decode
+        disaggregation. Pages are allocated, written, gathered to host,
+        and freed again, so a prefill worker's pool only ever holds
+        in-flight prompts. Requires paged mode (the page payload IS the
+        wire format; contiguous arenas chunk-prefill locally instead)."""
+        enforce(self.paged, "prefill_export requires paged mode "
+                "(pages=N) — the handoff payload is KV pages")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        plen = len(prompt)
+        enforce(plen >= 1, "empty prompt")
+        enforce(plen <= self.capacity,
+                "prompt %s exceeds prefill capacity %s", plen,
+                self.capacity)
+        # weights may have been swapped since construction (LoRA/quant)
+        self._mstate = (dict(self.model.named_parameters()),
+                        dict(self.model.named_buffers()))
+        ps = self.page_size
+        m = (plen + ps - 1) // ps
+        ids = self._allocator.alloc(m)  # typed error when exhausted
+        try:
+            row = np.zeros((self.n_log,), np.int32)
+            row[:m] = ids
+            lb = self._bucket_len(plen)
+            padded = np.zeros((lb,), np.int32)
+            padded[:plen] = prompt
+            if telemetry.enabled():
+                _recompile.record("serving.prefill", padded)
+            self.pools, logits = self._prefill_fn_paged(lb)(
+                self._mstate, self.pools, jnp.asarray(row),
+                jnp.asarray(padded), plen)
+            al = self._allocator
+            blocks = []
+            for kp, vp in self.pools:
+                payload = []
+                for pool in (kp, vp):
+                    got = paged_ops.export_pages(pool, jnp.asarray(ids))
+                    payload.append(
+                        tuple(np.asarray(a) for a in got)
+                        if al.kv_dtype else np.asarray(got))
+                blocks.append(tuple(payload))
+            return KVHandoff(prompt, plen, np.asarray(logits), blocks,
+                             ps, al.kv_dtype)
+        finally:
+            self._allocator.free(ids)
+
+    def inject_prefilled(self, handoff: KVHandoff, max_new: int) -> int:
+        """Admit a request whose prompt KV arrives PRE-FILLED (a
+        :class:`KVHandoff` from a prefill worker): the decode replica
+        allocates pages, imports the payload, and activates the slot
+        from the handoff's logits — no prompt token ever runs through
+        this replica's prefill, so whole-prompt admission can't stall a
+        decode tick. Queues like :meth:`submit` (paged backpressure
+        applies); returns the request id."""
+        enforce(self.paged, "inject_prefilled requires paged mode "
+                "(pages=N) on the decode replica")
+        enforce(isinstance(handoff, KVHandoff),
+                "inject_prefilled takes a KVHandoff, got %s",
+                type(handoff).__name__)
+        enforce(handoff.page_size == self.page_size,
+                "handoff page_size %s != replica page_size %s",
+                handoff.page_size, self.page_size)
+        al = self._allocator
+        enforce(handoff.kv_dtype == al.kv_dtype,
+                "handoff kv_dtype %r != replica kv_dtype %r — the "
+                "storage form crosses the wire intact",
+                handoff.kv_dtype, al.kv_dtype)
+        enforce(len(handoff.blocks) == len(self.pools),
+                "handoff has %s blocks, replica model has %s",
+                len(handoff.blocks), len(self.pools))
+        enforce(max_new >= 1, "max_new must be >= 1, got %s", max_new)
+        r = Request(self._next_rid, handoff.prompt, max_new)
+        enforce(len(r.prompt) + max_new + self._extra <= self.capacity,
+                "prompt %s + max_new %s (+%s speculative/window margin) "
+                "exceeds slot capacity %s",
+                len(r.prompt), max_new, self._extra, self.capacity)
+        need = ((len(r.prompt) + max_new + self._extra
+                 + self.page_size - 1) // self.page_size)
+        enforce(need <= al.pages,
+                "request needs %s pages but the pool only has %s",
+                need, al.pages)
+        r.handoff = handoff
+        self._next_rid += 1
+        r.t_submit = time.perf_counter()
+        if telemetry.enabled():
+            _serving_metrics()["requests"].inc()
+            srv = self.debug_server
+            if srv is not None and srv.running:
+                srv.note("request")
+            else:
+                _dbg_server.note("request")
+        self.queue.append(r)
+        return r.rid
+
+    def _import_handoff(self, s: int, r: Request) -> None:
+        """Write the handoff payload into this slot's freshly allocated
+        pages and activate from the handoff logits (admission epilogue
+        for pre-filled requests)."""
+        h = r.handoff
+        plen = h.plen
+        m = (plen + self.page_size - 1) // self.page_size
+        ids = jnp.asarray(self._slot_pages[s][:m])
+        pools = []
+        for (kp, vp), (pk, pv) in zip(self.pools, h.blocks):
+            pools.append((paged_ops.import_pages(kp, ids, pk),
+                          paged_ops.import_pages(vp, ids, pv)))
+        self.pools = pools
+        self._activate(s, r, jnp.asarray(h.logits), plen)
 
     # ----- internals -------------------------------------------------------
 
@@ -864,8 +1130,13 @@ class BatchedDecoder:
         prefix length, or None when the pool can't satisfy the demand
         yet (caller requeues — backpressure)."""
         plen = len(r.prompt)
+        # handoff requests never take a prefix hit: their payload is
+        # IMPORTED over the allocated pages, and importing onto pages
+        # shared with the registry (or a live request) would corrupt
+        # every other holder's KV
         hit, cached = (self._lookup_prefix(r.prompt)
-                       if self.prefix_cache else (None, 0))
+                       if self.prefix_cache and r.handoff is None
+                       else (None, 0))
         if hit is not None:
             # PIN before any eviction: _evict_prefixes may drop the
             # hit's own registry entry, and an unpinned hit would be
@@ -919,10 +1190,12 @@ class BatchedDecoder:
         self.active[s] = True
         tok = self._pick(logits[None], s, plen)[0]
         self.emitted[s] = [int(tok)]
+        r.t_first = time.perf_counter()
+        r.t_tokens.append(r.t_first)
         if telemetry.enabled():
             m = _serving_metrics()
             if r.t_submit:
-                m["ttft"].observe(time.perf_counter() - r.t_submit)
+                m["ttft"].observe(r.t_first - r.t_submit)
             m["tokens"].inc()
         self.budget[s] = r.max_new - 1
         self.tok = self.tok.at[s].set(int(tok))
@@ -949,8 +1222,7 @@ class BatchedDecoder:
             if self.paged:
                 cached = self._try_alloc_paged(s, r)
                 if cached is None:
-                    if telemetry.enabled():
-                        _serving_metrics()["rejections"].inc()
+                    reject_cause("pool_exhausted")
                     self.queue.insert(0, r)
                     break
             self.owner[s] = r
@@ -963,6 +1235,12 @@ class BatchedDecoder:
                 self.caches_d = self._draft_prefill_fn(lb)(
                     self._dstate, self.caches_d, jnp.asarray(padded),
                     jnp.asarray(s, jnp.int32))
+            if r.handoff is not None:
+                # pre-filled KV arrived with the request: import the
+                # pages and go live — no local prefill work at all
+                # (chunked-prefill deferral included)
+                self._import_handoff(s, r)
+                continue
             if self.prefill_chunk is not None:
                 # defer: chunk grid starts at the cached frontier
                 # (page-aligned, hence chunk-aligned); park the cursor
@@ -1026,15 +1304,17 @@ class BatchedDecoder:
         return sample_from_logits(logits, k, self.temperature,
                                   self.top_k, self.top_p).astype(jnp.int32)
 
-    def _build_multi_step(self):
+    def _build_multi_step(self, kd: int):
         """decode_steps=k jitted step: scan k single-token steps with
         the picks IN-DEVICE (same fold_in key chain as the host picks,
         so outputs are token-identical to k=1) — every dispatch
         advances all slots k tokens, amortizing the per-dispatch
         round trip exactly like the training benches' steps-per-call.
         Inactive/parked rows compute junk the host discards; their
-        writes drop (paged) or land above any attended position."""
-        model, kd = self.model, self.decode_steps
+        writes drop (paged) or land above any attended position.
+        ``kd`` is a parameter (not ``self.decode_steps``) so the SLO
+        degrade lever can hold a k=1 executable next to the full-k one."""
+        model = self.model
         sampled, temp = self.sampled, self.temperature
         top_k, top_p, key = self.top_k, self.top_p, self.key
         paged = self.paged
@@ -1081,11 +1361,15 @@ class BatchedDecoder:
     def _step_multi(self):
         """decode_steps host side: append each row's k tokens in order
         with per-TOKEN budget/eos finishing (nothing emits past eos or
-        budget; a mid-window finish discards the tail)."""
+        budget; a mid-window finish discards the tail). Degraded mode
+        dispatches the k=1 executable instead (separate cache entry —
+        no retrace when toggling)."""
         if not self.active.any():
             return
-        if self._step_fn is None:
-            self._step_fn = self._build_multi_step()
+        kd = 1 if self.degraded else self.decode_steps
+        step_fn = self._step_fns.get(kd)
+        if step_fn is None:
+            step_fn = self._step_fns[kd] = self._build_multi_step(kd)
         was_active = self.active.copy()
         telem = telemetry.enabled()
         if telem:
@@ -1098,19 +1382,22 @@ class BatchedDecoder:
             t_dispatch = time.perf_counter()
         gens = jnp.asarray(self._slot_gen.astype(np.uint32))
         if self.paged:
-            self.pools, toks = self._step_fn(
+            self.pools, toks = step_fn(
                 self._mstate, self.pools, jnp.asarray(self.table),
                 self.tok, self.t, gens)
         else:
-            self.caches, toks = self._step_fn(
+            self.caches, toks = step_fn(
                 self._mstate, self.caches, self.tok, self.t, gens)
         toks = np.asarray(jax.device_get(toks)).astype(np.int32)
+        self._warmed = True
+        now = time.perf_counter()
         n_emitted = 0
         for s in range(self.slots):
             if not was_active[s]:
                 continue
-            for j in range(self.decode_steps):
+            for j in range(kd):
                 self.emitted[s].append(int(toks[s, j]))
+                self.owner[s].t_tokens.append(now)
                 n_emitted += 1
                 self.budget[s] -= 1
                 self._maybe_finish(s)
@@ -1127,7 +1414,7 @@ class BatchedDecoder:
         self.tok = jnp.asarray(np.where(
             keep, toks[:, -1], np.asarray(self.tok)).astype(np.int32))
         self.t = jnp.asarray(np.where(
-            keep, cur_t + self.decode_steps, cur_t).astype(np.int32))
+            keep, cur_t + kd, cur_t).astype(np.int32))
 
     def _build_spec_step(self):
         """One speculative ROUND over the whole arena, jitted: gamma
@@ -1281,6 +1568,8 @@ class BatchedDecoder:
         # serving hot loop)
         emitted, n_np, new_tok, new_t = jax.device_get(
             (emitted, n, new_tok, new_t))
+        self._warmed = True
+        now = time.perf_counter()
         self.spec_rounds += 1
         self.spec_row_rounds += int(was_active.sum())
         self.spec_accepted += int(n_np[was_active].sum())
@@ -1290,6 +1579,7 @@ class BatchedDecoder:
                 continue
             for j in range(int(n_np[s]) + 1):
                 self.emitted[s].append(int(emitted[s, j]))
+                self.owner[s].t_tokens.append(now)
                 n_emitted += 1
                 self.budget[s] -= 1
                 self._maybe_finish(s)
@@ -1315,7 +1605,7 @@ class BatchedDecoder:
             np.where(keep, new_t, np.asarray(self.t)).astype(np.int32))
 
     def _step(self):
-        if self.draft is not None:
+        if self.draft is not None and not self.degraded:
             return self._step_spec()
         # k == 1 rides the same generalized scan path (length-1 scan,
         # in-device pick — pinned token-identical to the historical
@@ -1330,6 +1620,7 @@ class BatchedDecoder:
                    and self.emitted[s][-1] == self.eos_id)
         if hit_eos or self.budget[s] <= 0:
             r.result = np.asarray(self.emitted[s], np.int32)
+            r.t_done = time.perf_counter()
             self.done[r.rid] = r
             if telemetry.enabled():
                 _serving_metrics()["completed"].inc()

@@ -1,0 +1,1254 @@
+"""Production serving plane: a multi-replica router over
+``serving.BatchedDecoder`` arenas — the millions-of-users story on top
+of the single-replica serving runtime.
+
+Three levers, each a tail-latency lever real TPU serving deployments
+win on (cf. the Gemma-on-TPU serving study, PAPERS.md):
+
+- **Multi-replica routing.** A :class:`Router` spreads sessions over N
+  replicas (in-process :class:`LocalReplica` threads or
+  :class:`HttpReplica` worker processes), health-checked through each
+  replica's existing ``/healthz`` + the new ``/readyz`` readiness
+  split, with LEAST-LOADED placement driven by the same occupancy/
+  queue gauges /statusz already serves, and SESSION AFFINITY so a
+  multi-turn conversation lands where its prefix-cache KV lives.
+
+- **Prefill/decode disaggregation.** Dedicated prefill workers run the
+  bucketed prefill and hand the resulting KV pages (float or int8
+  ``QuantizedPool`` pages alike) to a decode replica as a
+  :class:`serving.KVHandoff` — whole-prompt admission never stalls a
+  decode tick. Chunked prefill remains the single-replica fallback;
+  the router only disaggregates prompts past ``disagg_min_tokens``.
+
+- **SLO-aware admission + load shedding.** An :class:`SLOPolicy` fed
+  by the router's live in-flight count and the observed TTFT EWMA
+  degrades first (``BatchedDecoder.set_degraded``: decode_steps→1,
+  speculative rounds off) and SHEDS before p99 TTFT blows through
+  target — shed admissions bump the cause-labeled
+  ``pt_serving_admission_rejections_total{cause="shed"}`` next to the
+  arena's own ``pool_exhausted`` series.
+
+Resilience: a replica that dies mid-stream (health-check failures or a
+dispatch error — chaos point ``router.dispatch``) has its in-flight
+requests retried on a surviving replica; requests are only lost to a
+typed :class:`NoReplicasError` when EVERY replica is down.
+
+Process bring-up: ``python -m paddle_tpu.serving_router --worker``
+runs one replica/prefill worker (model from ``--spec module:fn``);
+:func:`spawn_replicas` forks N of them; ``python -m paddle_tpu.launch
+--serve`` is the one-command front end.
+
+Green-field vs the reference (its serving is a one-request-at-a-time
+predictor per process; cross-replica routing/disaggregation is the
+modern LM-serving analog of its multi-instance deployment story).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import telemetry
+from .core.enforce import EnforceError, enforce
+from .serving import BatchedDecoder, KVHandoff, reject_cause
+from .telemetry import server as _dbg_server
+
+__all__ = ["Router", "SLOPolicy", "LocalReplica", "HttpReplica",
+           "Ticket", "NoReplicasError", "RequestShedError",
+           "spawn_replicas", "serve_main", "main"]
+
+
+class NoReplicasError(EnforceError):
+    """Every replica is down (or none was ever ready): the one
+    condition under which the router LOSES a request. Anything short
+    of this retries on a survivor."""
+
+
+class RequestShedError(EnforceError):
+    """Raised (opt-in, ``submit(raise_on_shed=True)``) when the SLO
+    policy sheds the admission; default is a ``Ticket`` with
+    ``shed=True`` so open-loop callers count sheds without exception
+    overhead."""
+
+
+@telemetry.cached_instruments
+def _router_metrics(reg):
+    return {
+        "requests": reg.counter(
+            "pt_router_requests_total", "requests routed"),
+        "shed": reg.counter(
+            "pt_router_shed_total",
+            "admissions shed by the SLO policy"),
+        "retries": reg.counter(
+            "pt_router_retries_total",
+            "in-flight requests re-dispatched after a replica "
+            "failure"),
+        "replica_deaths": reg.counter(
+            "pt_router_replica_deaths_total",
+            "replicas marked dead by the health loop"),
+        "disagg": reg.counter(
+            "pt_router_disagg_prefills_total",
+            "prompts prefilled on a dedicated worker and handed "
+            "off as KV pages"),
+        "healthy": reg.gauge(
+            "pt_router_replicas_healthy", "replicas alive and ready"),
+        "degraded": reg.gauge(
+            "pt_router_degraded",
+            "1 while the SLO policy holds the fleet degraded"),
+        "ttft": reg.histogram(
+            "pt_router_ttft_seconds",
+            "router-side submit-to-first-token latency", unit="s"),
+        "queue_wait": reg.histogram(
+            "pt_router_dispatch_wait_seconds",
+            "router submit-to-replica-dispatch wait", unit="s"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# SLO policy
+# ---------------------------------------------------------------------------
+
+class SLOPolicy:
+    """Deadline/queue-depth admission policy.
+
+    Decision inputs: ``in_flight`` (router-tracked dispatched+queued
+    requests), ``slots`` (live replica capacity), and the router's TTFT
+    EWMA. Two ladders, most-degraded wins:
+
+    - load factor = in_flight / slots: ``>= degrade_at`` → degrade
+      (decode_steps=1, spec off), ``>= shed_at`` → shed. Queue growth
+      is the EARLY signal — it predicts TTFT before TTFT blows.
+    - ``target_ttft_s`` (optional): estimated wait (load factor x
+      observed per-request TTFT EWMA) past the target → shed; past
+      half the target → degrade. The deadline side of the policy.
+
+    Pure function of its inputs (no clock, no I/O) — the unit tests pin
+    the ladder deterministically."""
+
+    def __init__(self, target_ttft_s: Optional[float] = None,
+                 degrade_at: float = 1.5, shed_at: float = 3.0):
+        enforce(shed_at >= degrade_at,
+                "shed_at %s < degrade_at %s (shedding is the deeper "
+                "degradation)", shed_at, degrade_at)
+        self.target_ttft_s = target_ttft_s
+        self.degrade_at = float(degrade_at)
+        self.shed_at = float(shed_at)
+
+    def admit(self, in_flight: int, slots: int,
+              ewma_ttft_s: Optional[float] = None) -> str:
+        """-> "admit" | "degrade" | "shed" for one arriving request."""
+        if slots <= 0:
+            return "shed"
+        lf = in_flight / slots
+        est = lf * ewma_ttft_s if ewma_ttft_s else None
+        if lf >= self.shed_at or (
+                self.target_ttft_s and est is not None
+                and est > self.target_ttft_s):
+            return "shed"
+        if lf >= self.degrade_at or (
+                self.target_ttft_s and est is not None
+                and est > 0.5 * self.target_ttft_s):
+            return "degrade"
+        return "admit"
+
+
+# ---------------------------------------------------------------------------
+# Replicas
+# ---------------------------------------------------------------------------
+
+class LocalReplica:
+    """One in-process replica: a :class:`serving.BatchedDecoder` driven
+    by a background serve thread (admit → prefill tick → step, exactly
+    ``run()``'s loop body) with a lock around every arena touch, so
+    router dispatch threads and the serve loop interleave safely.
+
+    Also the PREFILL-worker form: a replica that only ever receives
+    :meth:`prefill` calls ticks nothing and just runs bucketed prefills
+    under the same lock. ``warmup()`` drives one tiny request to
+    compile the step + prefill bucket before the replica reports
+    ready.
+
+    Each in-process replica needs its OWN model instance (same seed =
+    identical weights): the jitted arena passes weights via
+    ``inject_state``, which temporarily rebinds the model's parameters
+    — two replicas tracing one shared model from different threads
+    would leak tracers into each other. Worker processes get this
+    isolation for free."""
+
+    def __init__(self, decoder: BatchedDecoder, name: str = "replica0",
+                 idle_s: float = 0.002):
+        self.decoder = decoder
+        self.name = name
+        self.idle_s = idle_s
+        self._mu = threading.RLock()
+        self._done: Dict[int, Dict[str, Any]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "LocalReplica":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"pt-replica-{self.name}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+
+    def warmup(self, vocab_hint: int = 8) -> None:
+        """Compile the serving step (and smallest prefill bucket) by
+        driving one 2-token request to completion — a replica warms
+        BEFORE it reports ready, so the router never places a real
+        session onto a cold jit cache. max_new=2 on purpose: a 1-token
+        request finishes at ACTIVATION without ever dispatching the
+        arena step, which would leave the step executable cold (and
+        ``ready`` false forever)."""
+        rid = self.submit(np.asarray([1, min(2, vocab_hint - 1)],
+                                     np.int32), 2)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if rid in self.drain_results(keep=True):
+                return
+            if self._thread is None:  # not started: tick inline
+                with self._mu:
+                    self._tick_locked()
+            else:
+                time.sleep(0.005)
+        raise EnforceError(f"replica {self.name} warmup timed out")
+
+    # -- serving API (router-facing) ----------------------------------------
+
+    def submit(self, prompt, max_new: int,
+               session: Optional[str] = None) -> int:
+        with self._mu:
+            return self.decoder.submit(prompt, max_new)
+
+    def inject(self, handoff: KVHandoff, max_new: int,
+               session: Optional[str] = None) -> int:
+        with self._mu:
+            return self.decoder.inject_prefilled(handoff, max_new)
+
+    def prefill(self, prompt) -> KVHandoff:
+        with self._mu:
+            return self.decoder.prefill_export(prompt)
+
+    def drain_results(self, keep: bool = False) -> Dict[int, Dict]:
+        """Completed requests since the last drain:
+        ``{rid: {tokens, ttft_s, itl_p99_s, t_first, t_done}}``.
+        ``keep=True`` peeks without consuming (warmup)."""
+        with self._mu:
+            out = dict(self._done)
+            if not keep:
+                self._done.clear()
+            return out
+
+    def set_degraded(self, on: bool) -> None:
+        with self._mu:
+            self.decoder.set_degraded(on)
+
+    def healthz(self) -> Dict[str, Any]:
+        return {"status": "ok", "ready": self.decoder.ready,
+                "pid": os.getpid()}
+
+    def load(self) -> Dict[str, Any]:
+        d = self.decoder
+        with self._mu:
+            out = {"queue_depth": len(d.queue),
+                   "active_slots": int(d.active.sum()),
+                   "prefilling": len(d._pf_order),
+                   "slots": d.slots}
+            if d.paged:
+                out["free_pages"] = d._allocator.free_pages
+            return out
+
+    # -- serve loop ---------------------------------------------------------
+
+    def _tick_locked(self) -> bool:
+        """One serving tick (caller holds the lock). Returns True when
+        any work happened (idle loops back off otherwise)."""
+        d = self.decoder
+        busy = bool(d.queue or d._pf_order or d.active.any())
+        if not busy:
+            return False
+        d._admit()
+        d._prefill_tick()
+        d._step()
+        if d.done:
+            for rid, r in d.done.items():
+                ts = r.t_tokens
+                itl = np.diff(ts) if len(ts) > 1 else np.asarray([0.0])
+                self._done[rid] = {
+                    "tokens": r.result,
+                    "ttft_s": r.t_first - r.t_submit,
+                    "itl_p99_s": float(np.quantile(itl, 0.99)),
+                    "t_first": r.t_first, "t_done": r.t_done,
+                    "n_tokens": len(r.result),
+                }
+            d.done.clear()
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._mu:
+                busy = self._tick_locked()
+            if not busy:
+                time.sleep(self.idle_s)
+
+
+class HttpReplica:
+    """Client handle for one replica WORKER PROCESS (the
+    ``--worker`` CLI below): the serving API over the worker's debug
+    server port — ``/healthz``/``/readyz``/``/statusz`` for placement,
+    POST ``/submit`` ``/inject`` ``/prefill`` ``/drain`` ``/config``
+    for the data path. Transport errors raise ``OSError`` — the
+    router's failover signal."""
+
+    def __init__(self, url: str, name: Optional[str] = None,
+                 timeout_s: float = 60.0,
+                 proc: Optional[subprocess.Popen] = None):
+        self.url = url.rstrip("/")
+        self.name = name or url
+        self.timeout_s = timeout_s
+        self.proc = proc  # when spawn_replicas owns the process
+
+    def _get(self, path: str) -> Dict[str, Any]:
+        with urllib.request.urlopen(self.url + path,
+                                    timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def _post(self, path: str, body: bytes,
+              ctype: str = "application/json") -> bytes:
+        req = urllib.request.Request(
+            self.url + path, data=body, method="POST",
+            headers={"Content-Type": ctype})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            # 400 = the handler rejected the REQUEST (typed enforce
+            # error worker-side); surface it as such, not as replica
+            # death
+            detail = e.read().decode(errors="replace")
+            raise EnforceError(
+                f"replica {self.name} rejected {path}: {detail}") \
+                from None
+
+    def _post_json(self, path: str, obj: Any) -> Dict[str, Any]:
+        return json.loads(self._post(
+            path, json.dumps(obj).encode()).decode())
+
+    def submit(self, prompt, max_new: int,
+               session: Optional[str] = None) -> int:
+        out = self._post_json("/submit", {
+            "prompt": np.asarray(prompt, np.int32).tolist(),
+            "max_new": int(max_new)})
+        return int(out["rid"])
+
+    def inject(self, handoff: KVHandoff, max_new: int,
+               session: Optional[str] = None) -> int:
+        # wire layout: 8-byte big-endian max_new, then the npz payload
+        # (the npz body is opaque bytes; max_new can't ride inside it
+        # without a second parse, and the stdlib handler drops query
+        # strings before dispatch)
+        body = int(max_new).to_bytes(8, "big") + handoff.to_bytes()
+        out = json.loads(self._post(
+            "/inject", body, "application/octet-stream").decode())
+        return int(out["rid"])
+
+    def prefill(self, prompt) -> KVHandoff:
+        body = self._post("/prefill", json.dumps({
+            "prompt": np.asarray(prompt, np.int32).tolist()}).encode())
+        return KVHandoff.from_bytes(body)
+
+    def drain_results(self) -> Dict[int, Dict]:
+        out = self._post_json("/drain", {})
+        return {int(rid): {**rec, "tokens": np.asarray(
+            rec["tokens"], np.int32)}
+            for rid, rec in out["done"].items()}
+
+    def set_degraded(self, on: bool) -> None:
+        self._post_json("/config", {"degraded": bool(on)})
+
+    def healthz(self) -> Dict[str, Any]:
+        return self._get("/healthz")
+
+    def load(self) -> Dict[str, Any]:
+        # the dedicated lightweight endpoint — the health poll hits
+        # this tens of times a second, and the full /statusz renders
+        # device inventory + recompile report per scrape
+        return self._post_json("/load", {})
+
+    def close(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+class Ticket:
+    """One routed request. ``shed=True`` = never dispatched (SLO
+    policy); otherwise ``wait()``/``Router.wait`` fills ``tokens`` and
+    the latency fields, or ``error`` when every replica died."""
+
+    def __init__(self, rid: int, prompt, max_new: int,
+                 session: Optional[str]):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.session = session
+        self.shed = False
+        self.t_submit = time.perf_counter()
+        self.t_dispatched = 0.0
+        self.replica: Optional[str] = None
+        self.replica_rid: Optional[int] = None
+        self.retries = 0
+        self.disaggregated = False
+        self.tokens: Optional[np.ndarray] = None
+        self.ttft_s: Optional[float] = None
+        self.itl_p99_s: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    @property
+    def ok(self) -> bool:
+        return self.tokens is not None
+
+    def wait(self, timeout: Optional[float] = None) -> "Ticket":
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.rid} still in flight after {timeout}s "
+                f"(replica={self.replica})")
+        if self.error is not None:
+            raise self.error
+        return self
+
+
+class _ReplicaState:
+    def __init__(self, replica):
+        self.replica = replica
+        self.alive = True
+        self.ready = False
+        self.fails = 0
+        self.load: Dict[str, Any] = {"queue_depth": 0,
+                                     "active_slots": 0, "slots": 1}
+        self.inflight: Dict[int, Ticket] = {}  # replica_rid -> ticket
+        # results drained before their dispatcher registered the rid
+        # (the fast-completion race) park here until the registration
+        # catches up; bounded, insertion-ordered (oldest evicted)
+        self.orphans: Dict[int, Dict] = {}
+
+
+class Router:
+    """Spread sessions over N replicas; health-check, shed, fail over.
+
+    ``replicas``: :class:`LocalReplica` / :class:`HttpReplica` handles
+    (started/spawned by the caller — the router routes, it does not own
+    model processes unless asked to ``close(replicas=True)``).
+    ``prefill_workers``: replicas whose only job is
+    :meth:`~LocalReplica.prefill`; prompts of at least
+    ``disagg_min_tokens`` tokens are prefilled there and handed off as
+    KV pages. ``policy``: an :class:`SLOPolicy` (None = admit always).
+
+    Submission is NON-blocking (open-loop): ``submit`` sheds or
+    enqueues; dispatcher threads place the request (running the
+    disaggregated prefill when eligible); a poll loop drains completed
+    results and health-checks replicas, retrying the in-flight load of
+    a dead replica on the survivors."""
+
+    def __init__(self, replicas: Sequence, prefill_workers: Sequence = (),
+                 policy: Optional[SLOPolicy] = None,
+                 session_affinity: bool = True,
+                 disagg_min_tokens: Optional[int] = 64,
+                 poll_interval_s: float = 0.05,
+                 health_fails: int = 2,
+                 dispatchers: Optional[int] = None,
+                 max_in_flight: Optional[int] = None):
+        enforce(len(replicas) >= 1, "router needs >= 1 replica")
+        self._replicas: Dict[str, _ReplicaState] = {}
+        for r in replicas:
+            enforce(r.name not in self._replicas,
+                    "duplicate replica name %r", r.name)
+            self._replicas[r.name] = _ReplicaState(r)
+        self._prefill = list(prefill_workers)
+        self._pf_rr = 0
+        self.policy = policy
+        self.session_affinity = session_affinity
+        self.disagg_min_tokens = disagg_min_tokens
+        self.poll_interval_s = poll_interval_s
+        self.health_fails = int(health_fails)
+        # hard queue-depth cap, independent of the SLO policy: past it
+        # admissions reject with cause="capacity" (the policy's
+        # load-factor shed keeps cause="shed" — the /metrics split)
+        self.max_in_flight = max_in_flight
+        self._mu = threading.RLock()
+        self._affinity: Dict[str, str] = {}
+        self._tickets: Dict[int, Ticket] = {}
+        self._next_rid = 0
+        self._queued = 0            # accepted, not yet dispatched
+        self._degraded = False
+        self._ewma_ttft: Optional[float] = None
+        self._shed_count = 0
+        self._served_count = 0
+        self._retry_count = 0
+        self._stop = threading.Event()
+        self._dispatch_q: "queue.Queue[Optional[Ticket]]" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._probe_all()
+        if dispatchers is None:
+            # a dispatcher BLOCKS for the whole synchronous prefill of
+            # a disaggregated request: without a lane per prefill
+            # worker, two long prompts in a row would park every
+            # dispatcher and short requests would queue behind a
+            # prefill — the exact tail disaggregation exists to remove
+            dispatchers = 2 + len(self._prefill)
+        for i in range(max(1, int(dispatchers))):
+            t = threading.Thread(target=self._dispatch_loop,
+                                 daemon=True,
+                                 name=f"pt-router-dispatch-{i}")
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._poll_loop, daemon=True,
+                             name="pt-router-poll")
+        t.start()
+        self._threads.append(t)
+        self.server: Optional[_dbg_server.DebugServer] = None
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, prompt, max_new: int,
+               session: Optional[str] = None,
+               raise_on_shed: bool = False) -> Ticket:
+        """Route one request (non-blocking). SLO shed returns a
+        ``shed=True`` ticket (or raises :class:`RequestShedError` when
+        asked); :class:`NoReplicasError` when no replica is alive."""
+        with self._mu:
+            t = Ticket(self._next_rid, prompt, max_new, session)
+            self._next_rid += 1
+        if telemetry.enabled():
+            _router_metrics()["requests"].inc()
+        if not self._alive_names():
+            self._probe_all()
+            if not self._alive_names():
+                raise NoReplicasError(
+                    "no replica alive to place the request on")
+        cause = None
+        if self.max_in_flight is not None:
+            with self._mu:
+                if self._in_flight_locked() >= self.max_in_flight:
+                    cause = "capacity"  # hard queue-depth cap
+        if cause is None and self._policy_action() == "shed":
+            cause = "shed"
+        if cause is not None:
+            t.shed = True
+            t.done.set()
+            with self._mu:
+                self._shed_count += 1
+            if telemetry.enabled():
+                _router_metrics()["shed"].inc()
+            reject_cause(cause)
+            if raise_on_shed:
+                raise RequestShedError(
+                    f"admission rejected ({cause}: "
+                    + ("hard in-flight cap reached"
+                       if cause == "capacity"
+                       else "SLO load factor past shed_at") + ")")
+            return t
+        with self._mu:
+            self._tickets[t.rid] = t
+            self._queued += 1
+        self._dispatch_q.put(t)
+        return t
+
+    def wait(self, tickets: Sequence[Ticket],
+             timeout: Optional[float] = None) -> Dict[int, Ticket]:
+        """Block until every non-shed ticket completes (or ``timeout``
+        per ticket); raises the first ticket error (NoReplicasError
+        when the fleet died under the request)."""
+        out = {}
+        for t in tickets:
+            if not t.shed:
+                t.wait(timeout)
+            out[t.rid] = t
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            alive = self._alive_names()
+            return {
+                "replicas": len(self._replicas),
+                "alive": len(alive),
+                "prefill_workers": len(self._prefill),
+                "in_flight": self._in_flight_locked(),
+                "served": self._served_count,
+                "shed": self._shed_count,
+                "retries": self._retry_count,
+                "degraded": self._degraded,
+                "ewma_ttft_s": self._ewma_ttft,
+                "affinity_sessions": len(self._affinity),
+            }
+
+    def replicaz(self) -> Dict[str, Any]:
+        """Per-replica fan-out (the /podz pattern over serving
+        replicas): live health + load + in-flight, one row each."""
+        rows = {}
+        for name, st in list(self._replicas.items()):
+            row: Dict[str, Any] = {"alive": st.alive,
+                                   "ready": st.ready,
+                                   "inflight": len(st.inflight)}
+            if st.alive:
+                try:
+                    row["healthz"] = st.replica.healthz()
+                    row["load"] = st.replica.load()
+                except Exception as e:
+                    row["error"] = repr(e)
+            rows[name] = row
+        return {"replicas": rows, "router": self.stats()}
+
+    def start_server(self, port: int = 0,
+                     host: str = "127.0.0.1") -> _dbg_server.DebugServer:
+        """Serve the router's own debug plane: /statusz gains a
+        ``router`` section, /podz fans out over the replicas (the
+        fleet-controller pattern reused), /readyz = any replica
+        placeable."""
+        srv = _dbg_server.DebugServer(
+            port=port, host=host,
+            run_config={"role": "router",
+                        "replicas": sorted(self._replicas)})
+        srv.add_status("router", self.stats)
+        srv.set_fleet(self.replicaz)
+        srv.set_ready(lambda: bool(self._alive_names()))
+        srv.add_post("/submit", self._http_submit)
+        srv.add_post("/drain", self._http_drain)
+        self.server = srv.start()
+        return self.server
+
+    def close(self, replicas: bool = False) -> None:
+        self._stop.set()
+        for _ in self._threads:
+            self._dispatch_q.put(None)
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+        if replicas:
+            for st in self._replicas.values():
+                try:
+                    st.replica.close()
+                except Exception:
+                    pass
+            for w in self._prefill:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- router HTTP front-end (start_server) -------------------------------
+
+    def _http_submit(self, body: bytes) -> Dict[str, Any]:
+        req = json.loads(body.decode() or "{}")
+        t = self.submit(np.asarray(req["prompt"], np.int32),
+                        int(req["max_new"]),
+                        session=req.get("session"))
+        return {"rid": t.rid, "shed": t.shed}
+
+    def _http_drain(self, body: bytes) -> Dict[str, Any]:
+        done = {}
+        with self._mu:
+            for rid, t in list(self._tickets.items()):
+                if t.done.is_set():
+                    done[rid] = {
+                        "tokens": (t.tokens.tolist() if t.ok else None),
+                        "ttft_s": t.ttft_s,
+                        "itl_p99_s": t.itl_p99_s,
+                        "shed": t.shed,
+                        "error": repr(t.error) if t.error else None}
+                    del self._tickets[rid]
+        return {"done": done}
+
+    # -- policy -------------------------------------------------------------
+
+    def _alive_names(self) -> List[str]:
+        return [n for n, st in self._replicas.items() if st.alive]
+
+    def _in_flight_locked(self) -> int:
+        return self._queued + sum(len(st.inflight)
+                                  for st in self._replicas.values())
+
+    def _policy_action(self) -> str:
+        if self.policy is None:
+            return "admit"
+        with self._mu:
+            in_flight = self._in_flight_locked()
+            slots = sum(st.load.get("slots", 1)
+                        for st in self._replicas.values() if st.alive)
+            ewma = self._ewma_ttft
+        action = self.policy.admit(in_flight, slots, ewma)
+        want_degraded = action in ("degrade", "shed")
+        if want_degraded != self._degraded:
+            # hysteresis-free toggle is fine: set_degraded is
+            # idempotent and cheap (a bool; the k=1 step fn caches)
+            self._degraded = want_degraded
+            if telemetry.enabled():
+                _router_metrics()["degraded"].set(int(want_degraded))
+            for st in list(self._replicas.values()):
+                if st.alive:
+                    try:
+                        st.replica.set_degraded(want_degraded)
+                    except Exception:
+                        pass  # health loop will catch a dead replica
+        return action
+
+    # -- placement + dispatch -----------------------------------------------
+
+    def _pick_replica(self, t: Ticket) -> Optional[_ReplicaState]:
+        with self._mu:
+            if (self.session_affinity and t.session is not None):
+                # affinity holds only while the replica is PLACEABLE
+                # (alive AND ready) — a draining home replica loses the
+                # session to least-loaded placement
+                name = self._affinity.get(t.session)
+                if name is not None:
+                    st = self._replicas.get(name)
+                    if st is not None and st.alive and st.ready:
+                        return st
+
+            def pick(require_ready: bool):
+                best, best_load = None, None
+                for st in self._replicas.values():
+                    if not st.alive or (require_ready and not st.ready):
+                        continue
+                    load = (len(st.inflight)
+                            + st.load.get("queue_depth", 0)
+                            + st.load.get("prefilling", 0))
+                    if best_load is None or load < best_load:
+                        best, best_load = st, load
+                return best
+
+            # ready replicas first; an all-cold fleet (nothing warmed
+            # yet) still places on an alive one rather than failing
+            return pick(True) or pick(False)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            t = self._dispatch_q.get()
+            if t is None:
+                return
+            if self._stop.is_set():
+                # closing: a silently dropped ticket would hang its
+                # waiter — fail it typed and keep draining the queue
+                with self._mu:
+                    self._queued = max(0, self._queued - 1)
+                t.error = NoReplicasError(
+                    f"router closed before request {t.rid} was "
+                    "dispatched")
+                t.done.set()
+                continue
+            self._dispatch(t)
+
+    def _dispatch(self, t: Ticket) -> None:
+        from .resilience import faults as _faults
+
+        st = self._pick_replica(t)
+        if st is None:
+            with self._mu:
+                self._queued = max(0, self._queued - 1)
+            t.error = NoReplicasError(
+                "all replicas down; request cannot be placed")
+            t.done.set()
+            return
+        try:
+            inj = _faults.active()
+            if inj is not None:
+                inj.fire("router.dispatch", path=st.replica.name)
+            handoff = None
+            if (self._prefill and self.disagg_min_tokens is not None
+                    and len(t.prompt) >= self.disagg_min_tokens):
+                # a prefill-worker failure must not be blamed on the
+                # decode replica picked above: drop the worker from the
+                # rotation and FALL BACK to in-replica prefill (chunked
+                # prefill / monolithic — the documented fallback path)
+                with self._mu:
+                    workers = list(self._prefill)
+                    # round-robin cursor under the lock: two racing
+                    # dispatchers must not pick the SAME worker and
+                    # serialize on its replica lock while another
+                    # worker idles
+                    if workers:
+                        worker = workers[self._pf_rr % len(workers)]
+                        self._pf_rr += 1
+                if workers:
+                    try:
+                        handoff = worker.prefill(t.prompt)
+                        t.disaggregated = True
+                        if telemetry.enabled():
+                            _router_metrics()["disagg"].inc()
+                    except EnforceError:
+                        raise  # typed rejection: the REQUEST's fault
+                    except Exception:
+                        with self._mu:
+                            if worker in self._prefill:
+                                self._prefill.remove(worker)
+            if handoff is not None:
+                rid = st.replica.inject(handoff, t.max_new,
+                                        session=t.session)
+            else:
+                rid = st.replica.submit(t.prompt, t.max_new,
+                                        session=t.session)
+        except EnforceError:
+            # typed replica-side rejection (bad request): the caller's
+            # error, not a replica death
+            with self._mu:
+                self._queued = max(0, self._queued - 1)
+            t.error = sys.exc_info()[1]
+            t.done.set()
+            return
+        except Exception:
+            # transport/dispatch failure: fail the replica over and
+            # retry the request on a survivor
+            self._fail_replica(st, reason=repr(sys.exc_info()[1]))
+            self._requeue(t)
+            return
+        t.t_dispatched = time.perf_counter()
+        t.replica, t.replica_rid = st.replica.name, rid
+        with self._mu:
+            self._queued = max(0, self._queued - 1)
+            # the poll thread may have drained this rid's result
+            # BEFORE we registered it (a request can finish at its
+            # first serve tick) — the parked orphan record completes
+            # the ticket right here instead of hanging its waiter
+            rec = st.orphans.pop(rid, None)
+            if rec is None:
+                st.inflight[rid] = t
+            if self.session_affinity and t.session is not None:
+                self._affinity[t.session] = st.replica.name
+        if rec is not None:
+            self._finish(t, rec)
+        if telemetry.enabled():
+            _router_metrics()["queue_wait"].observe(
+                t.t_dispatched - t.t_submit)
+
+    def _requeue(self, t: Ticket) -> None:
+        """Re-dispatch after a replica failure — the request survives
+        as long as ANY replica does."""
+        t.retries += 1
+        t.replica = t.replica_rid = None
+        with self._mu:
+            self._retry_count += 1
+        if telemetry.enabled():
+            _router_metrics()["retries"].inc()
+        if not self._alive_names():
+            with self._mu:
+                self._queued = max(0, self._queued - 1)
+            t.error = NoReplicasError(
+                f"request {t.rid} lost: all replicas down "
+                f"(after {t.retries} retries)")
+            t.done.set()
+            return
+        self._dispatch_q.put(t)
+
+    # -- health + results ---------------------------------------------------
+
+    def _probe_all(self) -> None:
+        for st in list(self._replicas.values()):
+            self._probe(st)
+        if telemetry.enabled():
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+
+    def _probe(self, st: _ReplicaState) -> None:
+        try:
+            hz = st.replica.healthz()
+            st.load = st.replica.load()
+            st.fails = 0
+            # ready=False is NOT death: placement stops (pick requires
+            # ready) but in-flight work keeps draining and nothing is
+            # retried — a draining replica finishes what it holds
+            st.ready = bool(hz.get("ready", True))
+            if not st.alive:
+                st.alive = True  # answered again: recovered
+        except Exception:
+            st.fails += 1
+            if st.fails >= self.health_fails and st.alive:
+                self._fail_replica(st, reason="health check failed "
+                                   f"{st.fails}x")
+
+    def _fail_replica(self, st: _ReplicaState, reason: str = "") -> None:
+        with self._mu:
+            if not st.alive and not st.inflight:
+                return
+            st.alive = False
+            orphans = list(st.inflight.values())
+            st.inflight.clear()
+            for s, name in list(self._affinity.items()):
+                if name == st.replica.name:
+                    del self._affinity[s]
+        if telemetry.enabled():
+            _router_metrics()["replica_deaths"].inc()
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+        for t in orphans:
+            with self._mu:
+                self._queued += 1  # back to pre-dispatch accounting
+            self._requeue(t)
+
+    def _finish(self, t: Ticket, rec: Dict) -> None:
+        """Complete a ticket from its replica-side result record."""
+        t.tokens = np.asarray(rec["tokens"], np.int32)
+        # replica-side TTFT is measured from ITS submit; add the
+        # router-side dispatch wait so the number is end-to-end
+        wait = max(0.0, t.t_dispatched - t.t_submit)
+        t.ttft_s = float(rec["ttft_s"]) + wait
+        t.itl_p99_s = float(rec.get("itl_p99_s") or 0.0)
+        with self._mu:
+            self._served_count += 1
+            a = 0.2  # EWMA over recent completions
+            self._ewma_ttft = (t.ttft_s if self._ewma_ttft is None
+                               else (1 - a) * self._ewma_ttft
+                               + a * t.ttft_s)
+        if telemetry.enabled():
+            _router_metrics()["ttft"].observe(t.ttft_s)
+        t.done.set()
+
+    def _harvest(self, st: _ReplicaState) -> None:
+        if not st.inflight:
+            return
+        try:
+            done = st.replica.drain_results()
+        except Exception:
+            return  # the probe path owns failure counting
+        for rid, rec in done.items():
+            with self._mu:
+                t = st.inflight.pop(rid, None)
+                if t is None:
+                    # drained before the dispatcher registered the rid
+                    # (fast completion) or a stale record (warmup, a
+                    # retried duplicate's original): park it for the
+                    # registration to claim; bound the buffer so stale
+                    # entries can't accumulate
+                    st.orphans[rid] = rec
+                    while len(st.orphans) > 256:
+                        st.orphans.pop(next(iter(st.orphans)))
+                    continue
+            self._finish(t, rec)
+
+    def _poll_once(self) -> None:
+        """One health+results sweep (the poll loop's body; tests drive
+        it directly for deterministic schedules). Probes EVERY replica
+        — including dead ones, so a transient failure (GC pause, slow
+        compile) recovers the replica on its next successful answer
+        instead of removing it from the fleet forever."""
+        for st in list(self._replicas.values()):
+            self._probe(st)
+            if st.inflight:
+                self._harvest(st)
+        if telemetry.enabled():
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self._poll_once()
+
+
+# ---------------------------------------------------------------------------
+# Worker process + spawning
+# ---------------------------------------------------------------------------
+
+def _resolve_spec(spec: str, spec_kw: Optional[dict]):
+    """``module:fn`` → the BatchedDecoder the callable builds (the
+    worker-process model contract: the function must be importable in
+    a FRESH process and return a ready-to-serve decoder)."""
+    mod, _, fn = spec.partition(":")
+    enforce(mod and fn, "--spec must be module:function, got %r", spec)
+    import importlib
+
+    f = getattr(importlib.import_module(mod), fn)
+    dec = f(**(spec_kw or {}))
+    enforce(isinstance(dec, BatchedDecoder),
+            "spec %r must return a serving.BatchedDecoder, got %s",
+            spec, type(dec).__name__)
+    return dec
+
+
+def run_worker(spec: str, role: str = "decode", port: int = 0,
+               port_file: Optional[str] = None,
+               spec_kw: Optional[dict] = None, warm: bool = True,
+               _ready_evt: Optional[threading.Event] = None) -> None:
+    """One replica worker: build the decoder from ``spec``, serve the
+    router API + debug endpoints on ``port``, run until SIGTERM/SIGINT.
+    ``role="prefill"``: no serve loop — the worker only answers
+    /prefill (and reports ready after its prefill bucket warms)."""
+    import signal as _signal
+
+    decoder = _resolve_spec(spec, spec_kw)
+    name = f"{role}-{os.getpid()}"
+    rep = LocalReplica(decoder, name=name)
+    if role == "decode":
+        rep.start()
+    srv = _dbg_server.DebugServer(
+        port=port, owned=True,
+        run_config={"role": f"serving-{role}", "spec": spec,
+                    "slots": decoder.slots,
+                    "capacity": decoder.capacity,
+                    "paged": decoder.paged})
+    srv.add_status("serving", decoder._statusz)
+    srv.set_ready(lambda: decoder.ready)
+    if role == "decode":
+        # arena endpoints only where a serve loop actually ticks — a
+        # /submit accepted by a prefill worker would enqueue into an
+        # arena nothing drives (silent forever-pending instead of 404)
+        def _submit(b: bytes) -> Dict[str, Any]:
+            req = json.loads(b.decode())
+            return {"rid": rep.submit(
+                np.asarray(req["prompt"], np.int32),
+                int(req["max_new"]))}
+
+        srv.add_post("/submit", _submit)
+        srv.add_post("/drain", lambda b: {"done": {
+            rid: {**rec, "tokens": np.asarray(rec["tokens"]).tolist()}
+            for rid, rec in rep.drain_results().items()}})
+        srv.add_post("/inject", _make_inject(rep))
+    srv.add_post("/config", lambda b: _worker_config(rep, b))
+    srv.add_post("/load", lambda b: rep.load())
+    srv.add_post("/prefill", lambda b: (
+        "application/octet-stream",
+        rep.prefill(np.asarray(
+            json.loads(b.decode())["prompt"], np.int32)).to_bytes()))
+    srv.start()
+    if port_file:
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(srv.port))
+        os.replace(tmp, port_file)
+    if warm:
+        if role == "prefill":
+            # compile the prefill bucket so the first real handoff
+            # isn't a cold trace, then report ready
+            decoder.prefill_export(np.asarray([1, 2], np.int32))
+            decoder._warmed = True
+        else:
+            rep.warmup()
+    stop = threading.Event()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            _signal.signal(sig, lambda *a: stop.set())
+        except ValueError:
+            pass  # not the main thread (in-process tests)
+    if _ready_evt is not None:
+        _ready_evt.set()
+    try:
+        while not stop.wait(0.1):
+            pass
+    finally:
+        rep.stop()
+        srv.stop()
+
+
+def _worker_config(rep: LocalReplica, body: bytes) -> Dict[str, Any]:
+    cfg = json.loads(body.decode() or "{}")
+    if "degraded" in cfg:
+        rep.set_degraded(bool(cfg["degraded"]))
+    return {"ok": True, "degraded": rep.decoder.degraded}
+
+
+def _make_inject(rep: LocalReplica):
+    """/inject POST handler: the npz handoff payload carries everything
+    but max_new, which rides a leading 8-byte header (the stdlib
+    handler gives us only the body)."""
+    def handler(body: bytes) -> Dict[str, Any]:
+        enforce(len(body) > 8, "inject body too short")
+        max_new = int.from_bytes(body[:8], "big")
+        h = KVHandoff.from_bytes(body[8:])
+        return {"rid": rep.inject(h, max_new)}
+
+    return handler
+
+
+def spawn_replicas(spec: str, n: int, role: str = "decode",
+                   spec_kw: Optional[dict] = None,
+                   log_dir: Optional[str] = None,
+                   env: Optional[dict] = None,
+                   timeout_s: float = 300.0,
+                   warm: bool = True) -> List[HttpReplica]:
+    """Fork ``n`` replica worker processes (``--worker`` CLI) and wait
+    until each is serving (and warm, unless ``warm=False``). Returns
+    connected :class:`HttpReplica` handles owning their process
+    (``close()`` terminates it)."""
+    import tempfile
+
+    workdir = log_dir or tempfile.mkdtemp(prefix="pt-router-")
+    os.makedirs(workdir, exist_ok=True)
+    procs = []
+    for i in range(n):
+        pf = os.path.join(workdir, f"{role}{i}.port")
+        if os.path.exists(pf):
+            os.remove(pf)
+        log = open(os.path.join(workdir, f"{role}{i}.log"), "w")
+        cmd = [sys.executable, "-m", "paddle_tpu.serving_router",
+               "--worker", "--spec", spec, "--role", role,
+               "--port", "0", "--port-file", pf]
+        if spec_kw:
+            cmd += ["--spec-kw", json.dumps(spec_kw)]
+        if not warm:
+            cmd += ["--no-warm"]
+        wenv = dict(os.environ if env is None else env)
+        wenv.setdefault("JAX_PLATFORMS", "cpu")
+        procs.append((subprocess.Popen(
+            cmd, env=wenv, stdout=log, stderr=subprocess.STDOUT), pf,
+            log))
+    out = []
+    try:
+        for i, (p, pf, log) in enumerate(procs):
+            # per-WORKER deadline: the workers boot in parallel, so by
+            # the time worker i's wait starts, it has been warming all
+            # along — a shared deadline would let a slow first warmup
+            # starve the later waits
+            deadline = time.monotonic() + timeout_s
+            port = None
+            while time.monotonic() < deadline:
+                if p.poll() is not None:
+                    raise EnforceError(
+                        f"{role} worker {i} exited rc={p.returncode} "
+                        f"before serving (log: {log.name})")
+                if os.path.exists(pf):
+                    with open(pf) as f:
+                        port = int(f.read().strip())
+                    break
+                time.sleep(0.05)
+            enforce(port is not None,
+                    "%s worker %s did not serve within %ss (log: %s)",
+                    role, i, timeout_s, log.name)
+            rep = HttpReplica(f"http://127.0.0.1:{port}",
+                              name=f"{role}{i}", proc=p)
+            if warm:
+                is_ready = False
+                while time.monotonic() < deadline:
+                    try:
+                        is_ready = bool(rep.healthz().get("ready"))
+                    except OSError:
+                        is_ready = False
+                    if is_ready:
+                        break
+                    enforce(p.poll() is None,
+                            "%s worker %s died during warmup (log: %s)",
+                            role, i, log.name)
+                    time.sleep(0.1)
+                enforce(is_ready,
+                        "%s worker %s never became ready within %ss "
+                        "(warmup wedged? log: %s)", role, i, timeout_s,
+                        log.name)
+            out.append(rep)
+    except BaseException:
+        for p, _, _ in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+    finally:
+        for _, _, log in procs:
+            log.close()
+    return out
+
+
+def serve_main(spec: str, replicas: int = 2, prefill_workers: int = 0,
+               port: int = 0, spec_kw: Optional[dict] = None,
+               log_dir: Optional[str] = None,
+               policy: Optional[SLOPolicy] = None,
+               disagg_min_tokens: Optional[int] = 64) -> Router:
+    """One-command serving bring-up (``python -m paddle_tpu.launch
+    --serve``): spawn the replica (and prefill) worker processes, build
+    the router over them, and serve the router front-end (POST /submit
+    /drain + /statusz + /podz replica fan-out) on ``port``. Returns the
+    running router — the caller owns ``close(replicas=True)``."""
+    reps = spawn_replicas(spec, replicas, spec_kw=spec_kw,
+                          log_dir=log_dir)
+    pfs = (spawn_replicas(spec, prefill_workers, role="prefill",
+                          spec_kw=spec_kw, log_dir=log_dir)
+           if prefill_workers else [])
+    router = Router(reps, prefill_workers=pfs, policy=policy,
+                    disagg_min_tokens=disagg_min_tokens)
+    router.start_server(port=port)
+    return router
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving_router",
+        description="serving replica worker / router front-end")
+    ap.add_argument("--worker", action="store_true",
+                    help="run ONE replica worker (spawned by "
+                    "spawn_replicas / launch --serve)")
+    ap.add_argument("--spec", required=True,
+                    help="module:function returning the replica's "
+                    "BatchedDecoder")
+    ap.add_argument("--spec-kw", default=None,
+                    help="JSON kwargs for the spec function")
+    ap.add_argument("--role", default="decode",
+                    choices=("decode", "prefill"))
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--port-file", default=None,
+                    help="write the bound port here once serving")
+    ap.add_argument("--no-warm", dest="warm", action="store_false",
+                    help="skip the warmup request (report ready only "
+                    "after the first real dispatch)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="(router mode) decode worker processes")
+    ap.add_argument("--prefill-workers", type=int, default=0,
+                    help="(router mode) dedicated prefill workers")
+    args = ap.parse_args(argv)
+    kw = json.loads(args.spec_kw) if args.spec_kw else None
+    if args.worker:
+        run_worker(args.spec, role=args.role, port=args.port,
+                   port_file=args.port_file, spec_kw=kw,
+                   warm=args.warm)
+        return 0
+    router = serve_main(args.spec, replicas=args.replicas,
+                        prefill_workers=args.prefill_workers,
+                        port=args.port, spec_kw=kw)
+    print(f"[router] serving on {router.server.url()} over "
+          f"{args.replicas} replica(s)", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.close(replicas=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

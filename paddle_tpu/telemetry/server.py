@@ -7,7 +7,14 @@ measurement cost; it only exposes what the instruments hold):
 - ``/metrics``  Prometheus exposition (``export.prometheus_text``) —
   point a scraper at it.
 - ``/healthz``  liveness JSON: uptime plus the age of the last training
-  step / serving request heartbeat (``note()``).
+  step / serving request heartbeat (``note()``) — and, when the owning
+  loop attached a readiness provider (``set_ready``), a ``ready``
+  field. Liveness and readiness are distinct signals: a draining or
+  not-yet-warmed serving replica is alive (do not restart it) but not
+  ready (stop placing sessions on it).
+- ``/readyz``   readiness probe: 200 ``{"ready": true}`` /
+  503 ``{"ready": false}`` — the k8s-style binary form of the same
+  provider, so a router's health check is one status-code test.
 - ``/statusz``  backend + device inventory, uptime, telemetry state,
   the recompile-tracker report, and any status providers the owning
   loop attached (``add_status`` — e.g. the input pipeline's live
@@ -106,6 +113,8 @@ class DebugServer:
         self._last: Dict[str, float] = {}
         self._status: Dict[str, Callable[[], Any]] = {}
         self._fleet: Optional[Callable[[], Any]] = None
+        self._ready: Optional[Callable[[], bool]] = None
+        self._posts: Dict[str, Callable[[bytes], Any]] = {}
 
     # -- wiring -------------------------------------------------------------
 
@@ -125,6 +134,24 @@ class DebugServer:
         (normally ``FleetController.podz`` — evaluated per scrape, so
         the view is live). Without one, /podz answers 404."""
         self._fleet = provider
+
+    def set_ready(self, provider: Callable[[], bool]) -> None:
+        """Attach the READINESS provider (placement gate, distinct from
+        liveness): serves ``/readyz`` (200/503) and the ``ready`` field
+        of ``/healthz``. Evaluated per probe; a provider failure reads
+        as not-ready (fail closed — a router must never place onto a
+        replica whose readiness can't be established)."""
+        self._ready = provider
+
+    def add_post(self, path: str, handler: Callable[[bytes], Any]) -> None:
+        """Mount a POST handler at ``path`` (absolute, e.g.
+        ``/submit``): ``handler(body_bytes)`` returns a JSON-able
+        object, or ``(content_type, bytes)`` for a binary response —
+        the serving-replica control surface (submit/inject/drain/
+        config) rides the same port as the debug endpoints. Handler
+        exceptions answer 400 with the error string (a bad request
+        must not read as a dead replica)."""
+        self._posts[path] = handler
 
     @property
     def port(self) -> int:
@@ -194,14 +221,29 @@ class DebugServer:
         t = self._last.get(kind)
         return None if t is None else round(time.monotonic() - t, 3)
 
+    def ready(self) -> Optional[bool]:
+        """Readiness via the attached provider (None = no provider:
+        plain liveness servers have no placement semantics). Provider
+        failures fail CLOSED (not ready)."""
+        if self._ready is None:
+            return None
+        try:
+            return bool(self._ready())
+        except Exception:
+            return False
+
     def healthz(self) -> Dict[str, Any]:
-        return {
+        out = {
             "status": "ok",
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "last_step_age_s": self._age("step"),
             "last_request_age_s": self._age("request"),
             "pid": os.getpid(),
         }
+        ready = self.ready()
+        if ready is not None:
+            out["ready"] = ready
+        return out
 
     def statusz(self) -> Dict[str, Any]:
         import jax
@@ -279,6 +321,15 @@ def _make_handler(server: DebugServer):
                                "text/plain; version=0.0.4")
                 elif path == "/healthz":
                     self._send(200, json.dumps(server.healthz()))
+                elif path == "/readyz":
+                    ready = server.ready()
+                    if ready is None:
+                        self._send(404, json.dumps(
+                            {"error": "no readiness provider attached "
+                                      "(DebugServer.set_ready)"}))
+                    else:
+                        self._send(200 if ready else 503,
+                                   json.dumps({"ready": ready}))
                 elif path == "/statusz":
                     self._send(200, json.dumps(server.statusz(),
                                                default=str))
@@ -300,8 +351,11 @@ def _make_handler(server: DebugServer):
                 elif path == "/":
                     endpoints = ["/metrics", "/healthz", "/statusz",
                                  "/tracez", "/memz"]
+                    if server._ready is not None:
+                        endpoints.append("/readyz")
                     if server._fleet is not None:
                         endpoints.append("/podz")
+                    endpoints.extend(sorted(server._posts))
                     self._send(200, json.dumps(
                         {"endpoints": endpoints}))
                 else:
@@ -315,6 +369,41 @@ def _make_handler(server: DebugServer):
                 try:
                     self._send(500, json.dumps(
                         {"error": traceback.format_exc()}))
+                except Exception:
+                    pass
+
+        def do_POST(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            fn = server._posts.get(path)
+            if fn is None:
+                self._send(404, json.dumps(
+                    {"error": f"no such POST endpoint: {path}"}))
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                out = fn(body)
+                if (isinstance(out, tuple) and len(out) == 2
+                        and isinstance(out[1], (bytes, bytearray))):
+                    ctype, data = out
+                    data = bytes(data)
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self._send(200, json.dumps(out, default=str))
+            except BrokenPipeError:
+                pass  # caller went away mid-response
+            except Exception as e:
+                # a handler error is the CALLER's problem (bad request,
+                # typed enforce failure) — answer 400 with the message;
+                # only transport breakage should look like a dead
+                # replica to a router's health check
+                try:
+                    self._send(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}))
                 except Exception:
                     pass
 

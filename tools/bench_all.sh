@@ -51,6 +51,10 @@ if [ "$MODE" = "full" ]; then
   run python bench.py --model gpt_serve --decode-steps 8
   run python bench.py --model gpt_serve --paged --prefill-chunk 64
   run python bench.py --model gpt_serve --kv-dtype int8
+  # production serving plane: open-loop Poisson router A/B (p50/p99
+  # TTFT + p99 ITL + aggregate tok/s + shed rate on the JSON line)
+  run python bench.py --model gpt_serve --router --replicas 1
+  run python bench.py --model gpt_serve --router --replicas 2
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py

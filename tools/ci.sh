@@ -54,6 +54,11 @@ case "$MODE" in
 -> resume; chaos tier, FaultInjector seeds pinned)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_controller.py \
       -q -m chaos || exit $?
+    stage "router smoke (2-replica HTTP router e2e on the CPU backend \
++ dispatch-fault failover; deterministic seeds)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving_router.py \
+      -q -k "http_router_smoke or dispatch_fault or all_replicas_down" \
+      || exit $?
     stage "multichip dryrun (8-device CPU sim)"
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \

@@ -37,6 +37,8 @@ MODULES = [
     "paddle_tpu.quant",
     "paddle_tpu.fleet",
     "paddle_tpu.resilience",
+    "paddle_tpu.serving",
+    "paddle_tpu.serving_router",
     "paddle_tpu.analysis",
     "paddle_tpu.train_loop",
     "paddle_tpu.slim",
