@@ -1894,17 +1894,22 @@ def _emit_error(metric: str, msg: str) -> None:
                       "peak_mem_bytes": None, "error": msg}))
 
 
-def _emit_skip(metric: str, msg: str) -> None:
+def _emit_skip(metric: str, msg: str, cause: str = None) -> None:
     """One-JSON-line driver contract, INFRA-error form: the workload is
     fine but the environment failed (device init timeout, profiler
     unsupported). Emits ``"skipped": true`` with the error and NO value
     key — a 0.0 row here would read as a real measurement and drag
-    BENCH_HISTORY trend plots to zero."""
-    print(json.dumps({"metric": metric, "skipped": True,
-                      # infra-degraded row: trend tooling must not
-                      # fold it into deltas (the BENCH_r05 hazard)
-                      "backend_degraded": True,
-                      "peak_mem_bytes": None, "error": msg}))
+    BENCH_HISTORY trend plots to zero. ``cause`` stamps a stable
+    machine-readable reason (e.g. ``device_init_timeout``) so trend
+    tooling can bucket degraded rounds without parsing prose."""
+    line = {"metric": metric, "skipped": True,
+            # infra-degraded row: trend tooling must not
+            # fold it into deltas (the BENCH_r05 hazard)
+            "backend_degraded": True,
+            "peak_mem_bytes": None, "error": msg}
+    if cause:
+        line["cause"] = cause
+    print(json.dumps(line))
 
 
 def main():
@@ -2194,14 +2199,19 @@ def main():
         jax.devices()
         init_ok.set()
 
+    # ONE hard window — the old double-join gave a wedged tunnel
+    # 2x420 s per bench row, and the cpu-fallback re-exec then paid the
+    # same again: a full bench round could hang for the better part of
+    # an hour doing nothing (the ROADMAP/BENCH_r05-r06 operational
+    # note). CPU init is near-instant, so the fallback attempt gets a
+    # short bounded window instead of the accelerator's.
     timeout_s = float(os.environ.get("PT_BENCH_DEVICE_TIMEOUT_S", "420"))
-    probe = threading.Thread(target=_probe, daemon=True)
+    if os.environ.get("PT_BENCH_CPU_FALLBACK"):
+        timeout_s = min(timeout_s, 60.0)
+    probe = threading.Thread(target=_probe, daemon=True,
+                             name="pt-bench-device-probe")
     probe.start()
     probe.join(timeout=timeout_s)
-    if not init_ok.is_set():
-        # transient tunnel wedges sometimes clear: give the claim one
-        # more timeout window before giving up on the accelerator
-        probe.join(timeout=timeout_s)
     if not init_ok.is_set():
         if os.environ.get("PT_BENCH_CPU_FALLBACK"):
             # already fell back once and CPU init ALSO hung — nothing
@@ -2209,7 +2219,8 @@ def main():
             # (skipped, not value 0.0: infra error, not a measurement)
             _emit_skip(metric,
                        "device init timeout (accelerator unreachable; "
-                       "cpu fallback also failed)")
+                       "cpu fallback also failed)",
+                       cause="device_init_timeout")
             return
         # fall back to CPU so the round still produces a real number
         # (tagged "backend": "cpu_fallback" in the JSON) instead of the
@@ -2219,8 +2230,9 @@ def main():
         # re-enter backend selection.
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    PT_BENCH_CPU_FALLBACK="1")
-        print("WARNING: device init timed out twice; re-running on cpu "
-              "(backend=cpu_fallback)", file=sys.stderr)
+        print(f"WARNING: device init timed out ({timeout_s:.0f}s); "
+              "re-running on cpu (backend=cpu_fallback, "
+              "cause=device_init_timeout)", file=sys.stderr)
         sys.stderr.flush()
         sys.stdout.flush()
         os.execve(sys.executable,
@@ -2353,6 +2365,7 @@ def main():
         # rows (BENCH_r05 polluted deltas exactly this way)
         line["backend"] = "cpu_fallback"
         line["backend_degraded"] = True
+        line["cause"] = "device_init_timeout"
     print(json.dumps(line))
 
 

@@ -31,6 +31,7 @@ PT-LINT-302    Repo lint: wall-clock time.time() inside a span body
 PT-LINT-303    Repo lint: unnamed threading.Thread
 PT-LINT-304    Repo lint: device_get result flows into a donating call
 PT-LINT-305    Repo lint: leftover debug hook (jax.debug.print, ...)
+PT-LINT-306    Repo lint: HTTP hop without trace-header propagation
 =============  ========================================================
 """
 

@@ -48,6 +48,7 @@ LINT_CODES = {
     "PT-LINT-303": "unnamed threading.Thread",
     "PT-LINT-304": "device_get result flows into a donating call",
     "PT-LINT-305": "leftover debug hook",
+    "PT-LINT-306": "HTTP hop without trace-header propagation",
 }
 
 # callees whose arguments get donated (this repo's donating entry
@@ -64,6 +65,15 @@ ATOMIC_MARKERS = {"mkstemp", "atomic_write_text",
 ATOMIC_DOTTED = {"os.replace"}
 
 SPAN_NAMES = {"Span", "RecordEvent"}
+
+# PT-LINT-306 (trace propagation) applies only to the serving/debug
+# HTTP planes — the files whose request hops carry the distributed
+# trace header. A POST-shaped urllib call (data=/method=) or a do_POST
+# handler in these files must touch one of the TRACE_MARKERS helpers
+# somewhere in its scope (telemetry.tracing's header surface).
+TRACE_FILES = ("serving_router.py", "telemetry/server.py")
+TRACE_MARKERS = {"_trace_headers", "trace_headers", "to_header",
+                 "from_header"}
 
 _SUPPRESS_RE = re.compile(
     r"#\s*pt-lint:\s*disable=([A-Za-z0-9\-, ]+?)(?:\s+(.*))?$")
@@ -113,6 +123,8 @@ def _is_donating_callee(func: ast.AST) -> bool:
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str):
         self.path = path
+        norm = path.replace("\\", "/")
+        self._trace_file = any(norm.endswith(f) for f in TRACE_FILES)
         self.findings: List[Diagnostic] = []
         self._span_depth = 0
         # open-file bindings live per `with` body: name -> mode
@@ -135,6 +147,12 @@ class _Linter(ast.NodeVisitor):
         terminals, dotted = self._scope_calls[-1]
         return bool(terminals & ATOMIC_MARKERS or dotted & ATOMIC_DOTTED)
 
+    def _scope_has_trace_marker(self) -> bool:
+        if not self._scope_calls:
+            return False
+        terminals, _ = self._scope_calls[-1]
+        return bool(terminals & TRACE_MARKERS)
+
     # -- scopes -------------------------------------------------------------
 
     def _enter_scope(self, node) -> None:
@@ -152,6 +170,19 @@ class _Linter(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node):
         self._enter_scope(node)
+        # PT-LINT-306 (handler side): a POST dispatch handler in a
+        # trace-plane file must consult the trace header (bind the
+        # incoming context via tracing.from_header) — otherwise every
+        # span its handlers produce silently drops off the request's
+        # cross-process tree
+        if (self._trace_file and node.name == "do_POST"
+                and not self._scope_has_trace_marker()):
+            self._flag(
+                "PT-LINT-306", node,
+                "do_POST handler does not propagate the trace header",
+                "read headers[tracing.TRACE_HEADER], "
+                "tracing.from_header + tracing.bind around the "
+                "handler dispatch")
         self.generic_visit(node)
         self._scope_calls.pop()
         self._devget_names.pop()
@@ -284,6 +315,24 @@ class _Linter(ast.NodeVisitor):
                     f"file for the next reader",
                     "write via utils.atomic.atomic_write_text("
                     "path, json.dumps(...)) or stage + os.replace")
+
+        # PT-LINT-306 (client side): a POST-shaped urllib call in a
+        # trace-plane file whose scope never touches the trace-header
+        # surface breaks cross-process propagation — every hop out of
+        # the router/debug plane must carry X-PT-Trace
+        if (self._trace_file
+                and callee in ("Request", "urlopen")
+                and dotted.startswith(("urllib.", "request."))
+                and any(kw.arg in ("data", "method")
+                        for kw in node.keywords)
+                and not self._scope_has_trace_marker()):
+            self._flag(
+                "PT-LINT-306", node,
+                f"HTTP request via {callee!r} built without trace-"
+                f"header propagation",
+                "build headers through _trace_headers(...) (or stamp "
+                "tracing.current().to_header() onto "
+                "tracing.TRACE_HEADER)")
 
         # PT-LINT-304: device_get result into a donating call
         if _is_donating_callee(node.func):

@@ -386,6 +386,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--port", type=int, default=0,
                     help="--serve: router front-end port (0 = "
                     "ephemeral)")
+    ap.add_argument("--trace-sample", dest="trace_sample", type=float,
+                    default=None,
+                    help="--serve: head-based request-trace sampling "
+                    "rate 0..1 (default PT_TRACE_SAMPLE or 1.0); the "
+                    "router's /tracez?trace_id= merges each sampled "
+                    "request's cross-process timeline")
     ap.add_argument("script", nargs="?", default=None,
                     help="training script to run per rank (omitted "
                     "with --serve)")
@@ -403,7 +409,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.spec, replicas=args.nproc,
             prefill_workers=args.prefill_workers, port=args.port,
             spec_kw=_json.loads(args.spec_kw) if args.spec_kw else None,
-            log_dir=args.log_dir)
+            log_dir=args.log_dir, trace_sample=args.trace_sample)
         print(f"[launch] router serving on {router.server.url()} over "
               f"{args.nproc} replica(s) + {args.prefill_workers} "
               f"prefill worker(s)", file=sys.stderr)

@@ -60,6 +60,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .. import telemetry
 from ..core.enforce import EnforceError, enforce
+from ..telemetry import tracing as _tracing
 from ..utils.atomic import atomic_write_text
 from . import faults as _faults
 from .preemption import PreemptionHandler
@@ -468,6 +469,12 @@ class FleetController:
         agreement."""
         self.request_reason = self.request_reason or reason
         self._notice = True
+        if telemetry.enabled():
+            # preempt-agreement breadcrumbs on the trace ring: the
+            # fleet /tracez fan-in shows request → per-rank ack →
+            # agreement on each rank's lane next to its step spans
+            _tracing.event("fleet.preempt.request", rank=self.rank,
+                           reason=reason)
         self.handler.request()
 
     def _requested(self) -> bool:
@@ -591,12 +598,17 @@ class FleetController:
                 self.transport.put(f"preempt.ack.{self.rank}",
                                    str(int(step)))
                 self.transport.put("preempt.flag", str(self.rank))
+                if telemetry.enabled():
+                    _tracing.event("fleet.preempt.ack",
+                                   rank=self.rank, step=int(step))
             acks = self._wait_all("preempt.ack",
                                   timeout_s=self.agree_timeout_s,
                                   what="preempt-agreement")
             self.agreed_step = max(acks.values())
         if telemetry.enabled():
             _fleet_metrics()["agreements"].inc()
+            _tracing.event("fleet.preempt.agreed", rank=self.rank,
+                           step=int(self.agreed_step))
         return self.agreed_step
 
     def confirm_committed(self, step: int) -> Dict[int, int]:
@@ -614,6 +626,9 @@ class FleetController:
                               what="commit-confirmation")
         self.last_committed_step = step
         self.committed_view = vals
+        if telemetry.enabled():
+            _tracing.event("fleet.commit.confirmed", rank=self.rank,
+                           step=step)
         return vals
 
     def note_checkpoint(self, step: int) -> None:
@@ -716,6 +731,61 @@ class FleetController:
                 "preempt_requested": self._requested(),
                 "agreed_preempt_step": self.agreed_step,
                 "ranks": {str(row["rank"]): row for row in rows}}
+
+    def tracez_fanout(self,
+                      trace_id: Optional[str] = None) -> Dict[str, Any]:
+        """/podz-style TRACE aggregation for training fleets (mounted
+        on the debug server's ``/tracez?trace_id=`` when a controller
+        is attached): fan out to every rank's /tracez, align each
+        rank's spans via its clock-offset handshake, and merge ONE
+        chrome-trace — per-rank lanes carrying the rank-tagged
+        ``train.step`` spans and the preempt-agreement events.
+        Unreachable/dead ranks degrade to error rows."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        collections: List[Dict[str, Any]] = []
+        rows: Dict[str, Any] = {}
+        # ``local=1`` forces each rank's LOCAL ring: every rank mounts
+        # this same fan-out on its own /tracez, so without it two
+        # ranks' aggregators would recurse into each other
+        q = (f"?trace_id={trace_id}&local=1" if trace_id
+             else "?local=1")
+
+        def fetch(r: int):
+            if r == self.rank:
+                return r, _tracing.collection(trace_id,
+                                              proc=f"rank{r}"), "local"
+            if self._marker(f"dead.{r}") is not None:
+                return r, None, "dead"
+            ep = (self.transport.get(f"debug.{r}")
+                  if self.transport is not None else None)
+            if not ep:
+                return r, None, "no endpoint published"
+            j = _fetch_json(f"http://{ep}/tracez{q}",
+                            self.podz_fetch_timeout_s)
+            if isinstance(j, dict) and "trace_spans" in j:
+                j["proc"] = f"rank{r}"
+                return r, j, ep
+            return r, None, (j.get("error") if isinstance(j, dict)
+                             else repr(j))
+
+        with ThreadPoolExecutor(
+                max_workers=min(8, max(1, self.world)),
+                thread_name_prefix="pt-tracez-fetch") as ex:
+            for r, j, info in ex.map(fetch, range(self.world)):
+                if j is not None:
+                    collections.append(j)
+                    rows[str(r)] = {
+                        "rank": r, "source": info,
+                        "spans": len(j.get("trace_spans",
+                                           j.get("spans", [])))}
+                else:
+                    rows[str(r)] = {"rank": r, "error": info}
+        return {"world_size": self.world,
+                "aggregator_rank": self.rank,
+                "trace_id": trace_id,
+                "ranks": rows,
+                "trace": _tracing.merge_chrome_trace(collections)}
 
     # -- introspection ------------------------------------------------------
 

@@ -37,6 +37,7 @@ capability).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional
 
@@ -55,6 +56,12 @@ from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
 from .telemetry import recompile as _recompile
 from .telemetry import server as _dbg_server
+from .telemetry import tracing as _tracing
+
+# reusable inert context manager: span call-sites gate on
+# telemetry.enabled() (the zero-cost contract — a disabled run must
+# execute NO tracing code, pinned by test) and fall back to this
+_NULL_CM = contextlib.nullcontext()
 
 
 @telemetry.cached_instruments
@@ -285,13 +292,17 @@ class KVHandoff:
     ``from_bytes`` are the npz wire format the HTTP handoff uses."""
 
     def __init__(self, prompt, plen: int, logits, blocks,
-                 page_size: int, kv_dtype=None):
+                 page_size: int, kv_dtype=None, trace=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.plen = int(plen)
         self.logits = np.asarray(logits, np.float32)
         self.blocks = blocks
         self.page_size = int(page_size)
         self.kv_dtype = kv_dtype
+        # trace context (telemetry.tracing.TraceContext) riding the
+        # wire form: in-process disaggregation hands the producer's
+        # context straight to the decode replica — no HTTP header hop
+        self.trace = trace
 
     @property
     def pages(self) -> int:
@@ -327,6 +338,9 @@ class KVHandoff:
                   "logits": self.logits,
                   "meta": np.asarray([self.plen, self.page_size,
                                       int(quant)], np.int64)}
+        if self.trace is not None:
+            # the trace context crosses the wire in header form
+            arrays["trace"] = np.asarray(self.trace.to_header())
         for side, name in ((0, "k"), (1, "v")):
             payload = stack(side)
             if quant:
@@ -351,8 +365,11 @@ class KVHandoff:
         else:
             blocks = [(z["k"][i], z["v"][i])
                       for i in range(z["k"].shape[0])]
+        trace = (_tracing.from_header(str(z["trace"]))
+                 if "trace" in z.files else None)
         return KVHandoff(z["prompt"], plen, z["logits"], blocks,
-                         page_size, "int8" if quant else None)
+                         page_size, "int8" if quant else None,
+                         trace=trace)
 
 
 class Request:
@@ -368,6 +385,7 @@ class Request:
         self.t_done = 0.0     # with telemetry off; three float stores)
         self.t_tokens: List[float] = []  # per-token emission stamps
         self.handoff: Optional[KVHandoff] = None  # pre-filled KV pages
+        self.trace = None  # TraceContext (telemetry on + traced hop)
 
 
 class BatchedDecoder:
@@ -534,6 +552,11 @@ class BatchedDecoder:
         self.active = np.zeros((slots,), bool)         # host-side
         self.budget = np.zeros((slots,), np.int64)     # tokens left
         self.owner: List[Optional[Request]] = [None] * slots
+        # per-slot trace context of the ACTIVE request (None unless
+        # telemetry was on at submit and the request is traced) — the
+        # decode tick's span/exemplar source; one list store per
+        # activation, so the disabled path never touches tracing
+        self._slot_trace: List[Optional[Any]] = [None] * slots
         self.emitted: List[List[int]] = [[] for _ in range(slots)]
         self.gen_count = 0                             # admission counter
         self._slot_gen = np.zeros((slots,), np.int64)
@@ -606,6 +629,11 @@ class BatchedDecoder:
         r.t_submit = time.perf_counter()
         if telemetry.enabled():
             _serving_metrics()["requests"].inc()
+            # request-scoped tracing: adopt the caller's bound context
+            # (the router's dispatch / the debug server's POST edge
+            # binds it) so the whole decode life of this request lands
+            # on ONE trace
+            r.trace = _tracing.current()
             # /healthz last-request age (owner-scoped while run() has
             # our server up; submits outside a live run broadcast — a
             # stopped server kept for post-run inspection must not
@@ -824,29 +852,36 @@ class BatchedDecoder:
         ps = self.page_size
         m = (plen + ps - 1) // ps
         ids = self._allocator.alloc(m)  # typed error when exhausted
+        telem = telemetry.enabled()
+        ctx = _tracing.current() if telem else None
+        cm = (_tracing.span("serve.prefill.export", ctx=ctx,
+                            plen=plen, pages=int(m))
+              if telem else _NULL_CM)
         try:
-            row = np.zeros((self.n_log,), np.int32)
-            row[:m] = ids
-            lb = self._bucket_len(plen)
-            padded = np.zeros((lb,), np.int32)
-            padded[:plen] = prompt
-            if telemetry.enabled():
-                _recompile.record("serving.prefill", padded)
-            self.pools, logits = self._prefill_fn_paged(lb)(
-                self._mstate, self.pools, jnp.asarray(row),
-                jnp.asarray(padded), plen)
-            al = self._allocator
-            blocks = []
-            for kp, vp in self.pools:
-                payload = []
-                for pool in (kp, vp):
-                    got = paged_ops.export_pages(pool, jnp.asarray(ids))
-                    payload.append(
-                        tuple(np.asarray(a) for a in got)
-                        if al.kv_dtype else np.asarray(got))
-                blocks.append(tuple(payload))
-            return KVHandoff(prompt, plen, np.asarray(logits), blocks,
-                             ps, al.kv_dtype)
+            with cm:
+                row = np.zeros((self.n_log,), np.int32)
+                row[:m] = ids
+                lb = self._bucket_len(plen)
+                padded = np.zeros((lb,), np.int32)
+                padded[:plen] = prompt
+                if telem:
+                    _recompile.record("serving.prefill", padded)
+                self.pools, logits = self._prefill_fn_paged(lb)(
+                    self._mstate, self.pools, jnp.asarray(row),
+                    jnp.asarray(padded), plen)
+                al = self._allocator
+                blocks = []
+                for kp, vp in self.pools:
+                    payload = []
+                    for pool in (kp, vp):
+                        got = paged_ops.export_pages(pool,
+                                                     jnp.asarray(ids))
+                        payload.append(
+                            tuple(np.asarray(a) for a in got)
+                            if al.kv_dtype else np.asarray(got))
+                    blocks.append(tuple(payload))
+                return KVHandoff(prompt, plen, np.asarray(logits),
+                                 blocks, ps, al.kv_dtype, trace=ctx)
         finally:
             self._allocator.free(ids)
 
@@ -890,6 +925,10 @@ class BatchedDecoder:
         r.t_submit = time.perf_counter()
         if telemetry.enabled():
             _serving_metrics()["requests"].inc()
+            # the handoff carries its producer's context (in-process
+            # disaggregation); an HTTP hop's bound header context wins
+            # — both are the same trace when the router did its job
+            r.trace = _tracing.current() or handoff.trace
             srv = self.debug_server
             if srv is not None and srv.running:
                 srv.note("request")
@@ -904,14 +943,18 @@ class BatchedDecoder:
         for pre-filled requests)."""
         h = r.handoff
         plen = h.plen
-        m = (plen + self.page_size - 1) // self.page_size
-        ids = jnp.asarray(self._slot_pages[s][:m])
-        pools = []
-        for (kp, vp), (pk, pv) in zip(self.pools, h.blocks):
-            pools.append((paged_ops.import_pages(kp, ids, pk),
-                          paged_ops.import_pages(vp, ids, pv)))
-        self.pools = pools
-        self._activate(s, r, jnp.asarray(h.logits), plen)
+        cm = (_tracing.span("serve.handoff.import", ctx=r.trace,
+                            plen=plen, slot=s)
+              if telemetry.enabled() else _NULL_CM)
+        with cm:
+            m = (plen + self.page_size - 1) // self.page_size
+            ids = jnp.asarray(self._slot_pages[s][:m])
+            pools = []
+            for (kp, vp), (pk, pv) in zip(self.pools, h.blocks):
+                pools.append((paged_ops.import_pages(kp, ids, pk),
+                              paged_ops.import_pages(vp, ids, pv)))
+            self.pools = pools
+            self._activate(s, r, jnp.asarray(h.logits), plen)
 
     # ----- internals -------------------------------------------------------
 
@@ -1188,14 +1231,24 @@ class BatchedDecoder:
     def _activate(self, s: int, r: Request, logits, plen: int):
         """Shared admission epilogue: first-token pick + slot live."""
         self.active[s] = True
+        self._slot_trace[s] = r.trace
         tok = self._pick(logits[None], s, plen)[0]
         self.emitted[s] = [int(tok)]
         r.t_first = time.perf_counter()
         r.t_tokens.append(r.t_first)
         if telemetry.enabled():
             m = _serving_metrics()
+            traced = r.trace is not None and r.trace.sampled
             if r.t_submit:
-                m["ttft"].observe(r.t_first - r.t_submit)
+                # TTFT exemplar: a traced sample stamps its trace id
+                # onto the bucket it lands in — the p99 row's link to
+                # the cross-process timeline that produced it
+                m["ttft"].observe(
+                    r.t_first - r.t_submit,
+                    exemplar=r.trace.trace_id if traced else None)
+            if traced:
+                _tracing.event("serve.first_token", ctx=r.trace,
+                               rid=r.rid, slot=s)
             m["tokens"].inc()
         self.budget[s] = r.max_new - 1
         self.tok = self.tok.at[s].set(int(tok))
@@ -1255,44 +1308,50 @@ class BatchedDecoder:
                 self._pf_order.append(s)
                 self.t = self.t.at[s].set(self.capacity)
                 continue
-            if telemetry.enabled():
+            telem = telemetry.enabled()
+            if telem:
                 # one compile per prompt bucket: a new padded shape
                 # here IS a new monolithic-prefill executable. Chunked
                 # mode bailed out above — it compiles per CHUNK size,
                 # so recording the bucket there would count compiles
                 # that never happen
                 _recompile.record("serving.prefill", padded)
-            if self.paged:
-                row = self.table[s]
-                if cached == 0:
-                    self.pools, logits = self._prefill_fn_paged(lb)(
-                        self._mstate, self.pools, jnp.asarray(row),
-                        jnp.asarray(padded), plen)
-                else:
-                    # prefill only the uncached suffix (page-aligned
-                    # t0), then the usual last-token re-step for the
-                    # next-token logits — handles a fully-cached
-                    # prompt (empty suffix) too
-                    suf = r.prompt[cached:]
-                    if len(suf):
-                        slb = self._bucket_len(len(suf))
-                        spad = np.zeros((slb,), np.int32)
-                        spad[:len(suf)] = suf
-                        chunk_fn, restep_fn = self._suffix_fns(slb)
-                        self.pools = chunk_fn(
+            pf_cm = (_tracing.span("serve.prefill", ctx=r.trace,
+                                   plen=plen, slot=s, cached=cached)
+                     if telem else _NULL_CM)
+            with pf_cm:
+                if self.paged:
+                    row = self.table[s]
+                    if cached == 0:
+                        self.pools, logits = self._prefill_fn_paged(lb)(
                             self._mstate, self.pools, jnp.asarray(row),
-                            jnp.asarray(spad), cached)
+                            jnp.asarray(padded), plen)
                     else:
-                        _, restep_fn = self._suffix_fns(self.bucket)
-                    self.pools, logits = restep_fn(
-                        self._mstate, self.pools, jnp.asarray(row),
-                        jnp.asarray(r.prompt[plen - 1], jnp.int32),
-                        plen - 1)
-            else:
-                self.caches, logits = self._prefill_fn(lb)(
-                    self._mstate, self.caches, jnp.asarray(padded),
-                    plen, s)
-            self._activate(s, r, logits, int(plen))
+                        # prefill only the uncached suffix (page-aligned
+                        # t0), then the usual last-token re-step for the
+                        # next-token logits — handles a fully-cached
+                        # prompt (empty suffix) too
+                        suf = r.prompt[cached:]
+                        if len(suf):
+                            slb = self._bucket_len(len(suf))
+                            spad = np.zeros((slb,), np.int32)
+                            spad[:len(suf)] = suf
+                            chunk_fn, restep_fn = self._suffix_fns(slb)
+                            self.pools = chunk_fn(
+                                self._mstate, self.pools,
+                                jnp.asarray(row),
+                                jnp.asarray(spad), cached)
+                        else:
+                            _, restep_fn = self._suffix_fns(self.bucket)
+                        self.pools, logits = restep_fn(
+                            self._mstate, self.pools, jnp.asarray(row),
+                            jnp.asarray(r.prompt[plen - 1], jnp.int32),
+                            plen - 1)
+                else:
+                    self.caches, logits = self._prefill_fn(lb)(
+                        self._mstate, self.caches, jnp.asarray(padded),
+                        plen, s)
+                self._activate(s, r, logits, int(plen))
 
     def _pick(self, logits, s: int, pos: int):
         """Admission-time single-row pick (the steady-state loop picks
@@ -1380,15 +1439,27 @@ class BatchedDecoder:
             _recompile.record("serving.step", self.tok, self.t,
                               weights=self._weights_fp)
             t_dispatch = time.perf_counter()
-        gens = jnp.asarray(self._slot_gen.astype(np.uint32))
-        if self.paged:
-            self.pools, toks = step_fn(
-                self._mstate, self.pools, jnp.asarray(self.table),
-                self.tok, self.t, gens)
-        else:
-            self.caches, toks = step_fn(
-                self._mstate, self.caches, self.tok, self.t, gens)
-        toks = np.asarray(jax.device_get(toks)).astype(np.int32)
+        # per-decode-tick span: one dispatch advances every active
+        # slot, so the tick rides the first SAMPLED slot's context
+        # (an unsampled context must not shadow a sampled neighbor —
+        # it would starve that request's timeline of its decode ticks)
+        tick_ctx = (next((c for c in self._slot_trace
+                          if c is not None and c.sampled), None)
+                    if telem else None)
+        tick_cm = (_tracing.span("serve.decode.tick", ctx=tick_ctx,
+                                 k=kd,
+                                 n_active=int(was_active.sum()))
+                   if telem and tick_ctx is not None else _NULL_CM)
+        with tick_cm:
+            gens = jnp.asarray(self._slot_gen.astype(np.uint32))
+            if self.paged:
+                self.pools, toks = step_fn(
+                    self._mstate, self.pools, jnp.asarray(self.table),
+                    self.tok, self.t, gens)
+            else:
+                self.caches, toks = step_fn(
+                    self._mstate, self.caches, self.tok, self.t, gens)
+            toks = np.asarray(jax.device_get(toks)).astype(np.int32)
         self._warmed = True
         now = time.perf_counter()
         n_emitted = 0
@@ -1407,7 +1478,9 @@ class BatchedDecoder:
             m = _serving_metrics()
             m["tokens"].inc(n_emitted)
             m["decode_latency"].observe(
-                (time.perf_counter() - t_dispatch) / n_emitted)
+                (time.perf_counter() - t_dispatch) / n_emitted,
+                exemplar=(tick_ctx.trace_id
+                          if tick_ctx is not None else None))
         # retired rows keep what _maybe_finish left (paged parking)
         keep = was_active & self.active
         cur_t = np.asarray(self.t)
@@ -1593,9 +1666,14 @@ class BatchedDecoder:
                 m["spec_accept_rate"].set(
                     self.spec_accepted / self.spec_row_rounds)
             if n_emitted:
+                # first SAMPLED slot (same rule as the plain tick)
+                spec_ctx = next((c for c in self._slot_trace
+                                 if c is not None and c.sampled), None)
                 m["tokens"].inc(n_emitted)
                 m["decode_latency"].observe(
-                    (time.perf_counter() - t_dispatch) / n_emitted)
+                    (time.perf_counter() - t_dispatch) / n_emitted,
+                    exemplar=(spec_ctx.trace_id
+                              if spec_ctx is not None else None))
         # retired rows keep what _maybe_finish left (paged parking);
         # live rows advance by their accepted count + 1
         keep = was_active & self.active
@@ -1624,7 +1702,13 @@ class BatchedDecoder:
             self.done[r.rid] = r
             if telemetry.enabled():
                 _serving_metrics()["completed"].inc()
+                if r.trace is not None and r.trace.sampled:
+                    _tracing.event("serve.done", ctx=r.trace,
+                                   rid=r.rid,
+                                   n_tokens=len(r.result),
+                                   eos=bool(hit_eos))
             self.owner[s] = None
+            self._slot_trace[s] = None
             self.active[s] = False
             self.emitted[s] = []
             if self.paged and self._slot_pages[s] is not None:

@@ -45,6 +45,7 @@ modern LM-serving analog of its multi-instance deployment story).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import queue
@@ -62,6 +63,21 @@ from . import telemetry
 from .core.enforce import EnforceError, enforce
 from .serving import BatchedDecoder, KVHandoff, reject_cause
 from .telemetry import server as _dbg_server
+from .telemetry import tracing as _tracing
+
+_NULL_CM = contextlib.nullcontext()
+
+
+def _trace_headers(base: Dict[str, str]) -> Dict[str, str]:
+    """Stamp the bound trace context onto outbound HTTP headers — the
+    ONE helper every cross-process hop in this file rides (pt-lint
+    PT-LINT-306 flags HTTP POSTs here that skip it). No-op when
+    telemetry is off or no sampled context is bound."""
+    if telemetry.enabled():
+        ctx = _tracing.current()
+        if ctx is not None and ctx.sampled:
+            base[_tracing.TRACE_HEADER] = ctx.to_header()
+    return base
 
 __all__ = ["Router", "SLOPolicy", "LocalReplica", "HttpReplica",
            "Ticket", "NoReplicasError", "RequestShedError",
@@ -340,7 +356,7 @@ class HttpReplica:
               ctype: str = "application/json") -> bytes:
         req = urllib.request.Request(
             self.url + path, data=body, method="POST",
-            headers={"Content-Type": ctype})
+            headers=_trace_headers({"Content-Type": ctype}))
         try:
             with urllib.request.urlopen(req,
                                         timeout=self.timeout_s) as r:
@@ -423,6 +439,7 @@ class Ticket:
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.session = session
+        self.trace = None  # TraceContext minted at admission
         self.shed = False
         self.t_submit = time.perf_counter()
         self.t_dispatched = 0.0
@@ -489,7 +506,10 @@ class Router:
                  poll_interval_s: float = 0.05,
                  health_fails: int = 2,
                  dispatchers: Optional[int] = None,
-                 max_in_flight: Optional[int] = None):
+                 max_in_flight: Optional[int] = None,
+                 trace_sample: Optional[float] = None,
+                 textfile_path: Optional[str] = None,
+                 textfile_interval_s: float = 5.0):
         enforce(len(replicas) >= 1, "router needs >= 1 replica")
         self._replicas: Dict[str, _ReplicaState] = {}
         for r in replicas:
@@ -507,6 +527,18 @@ class Router:
         # admissions reject with cause="capacity" (the policy's
         # load-factor shed keeps cause="shed" — the /metrics split)
         self.max_in_flight = max_in_flight
+        # head-based trace sampling for requests admitted HERE (None =
+        # the process-wide telemetry.tracing rate, default 1.0); the
+        # decision rides the context to every replica/worker hop
+        self.trace_sample = trace_sample
+        # node-exporter textfile sink: the poll loop re-writes the
+        # whole registry (pt_router_* included) every
+        # textfile_interval_s — the scrape-less deployment path
+        # (env PT_ROUTER_TEXTFILE works for the CLI bring-up)
+        self._textfile = (textfile_path
+                          or os.environ.get("PT_ROUTER_TEXTFILE"))
+        self._textfile_interval_s = float(textfile_interval_s)
+        self._textfile_t = 0.0
         self._mu = threading.RLock()
         self._affinity: Dict[str, str] = {}
         self._tickets: Dict[int, Ticket] = {}
@@ -553,6 +585,13 @@ class Router:
             self._next_rid += 1
         if telemetry.enabled():
             _router_metrics()["requests"].inc()
+            # the trace is MINTED here — admission is the one edge
+            # every request crosses exactly once, so the head-based
+            # sampling draw happens here and nowhere else
+            t.trace = _tracing.new_trace(rate=self.trace_sample)
+            _tracing.event("router.admit", ctx=t.trace, rid=t.rid,
+                           session=session, plen=int(t.prompt.size),
+                           max_new=t.max_new)
         if not self._alive_names():
             self._probe_all()
             if not self._alive_names():
@@ -572,6 +611,8 @@ class Router:
                 self._shed_count += 1
             if telemetry.enabled():
                 _router_metrics()["shed"].inc()
+                _tracing.event("router.shed", ctx=t.trace,
+                               rid=t.rid, cause=cause)
             reject_cause(cause)
             if raise_on_shed:
                 raise RequestShedError(
@@ -631,11 +672,73 @@ class Router:
             rows[name] = row
         return {"replicas": rows, "router": self.stats()}
 
+    def trace_fanin(self,
+                    trace_id: Optional[str] = None) -> Dict[str, Any]:
+        """Fleet trace aggregation — the ``/tracez?trace_id=`` payload
+        on the router's debug server: collect matching spans from this
+        process's own ring (router spans + any in-process replicas)
+        and every worker process's /tracez, align timestamps via each
+        process's clock-offset handshake, and merge into ONE
+        chrome-trace with per-process lanes. Unreachable workers
+        degrade to ``errors`` rows — a dead replica never fails the
+        merge of what the fleet can still tell us."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        collections: List[Dict[str, Any]] = [
+            _tracing.collection(trace_id, proc="router")]
+        sources = ["router"]
+        errors: Dict[str, str] = {}
+        peers = [(n, st.replica)
+                 for n, st in list(self._replicas.items())]
+        peers += [(getattr(w, "name", f"prefill{i}"), w)
+                  for i, w in enumerate(list(self._prefill))]
+        seen = set()
+        targets = []
+        for name, rep in peers:
+            url = getattr(rep, "url", None)
+            if url is None or url in seen:
+                continue  # in-process replica: spans ride OUR ring
+            seen.add(url)
+            targets.append((name, url))
+        # ``local=1``: ask each peer for its LOCAL ring, never its own
+        # fan-in (aggregators must not recurse into each other)
+        q = (f"?trace_id={trace_id}&local=1" if trace_id
+             else "?local=1")
+
+        def fetch(target):
+            name, url = target
+            try:
+                with urllib.request.urlopen(url + "/tracez" + q,
+                                            timeout=2) as r:
+                    j = json.loads(r.read().decode())
+                j["proc"] = name
+                return name, j, None
+            except Exception as e:
+                return name, None, repr(e)
+
+        if targets:
+            # CONCURRENT fan-out: a scrape of a partially-wedged fleet
+            # is bounded near ONE peer's timeout, not peers x timeout
+            # serialized on the debug-server handler thread
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(targets)),
+                    thread_name_prefix="pt-tracez-fetch") as ex:
+                for name, j, err in ex.map(fetch, targets):
+                    if j is not None:
+                        collections.append(j)
+                        sources.append(name)
+                    else:
+                        errors[name] = err
+        merged = _tracing.merge_chrome_trace(collections)
+        return {"trace_id": trace_id, "sources": sources,
+                "errors": errors, "trace": merged}
+
     def start_server(self, port: int = 0,
                      host: str = "127.0.0.1") -> _dbg_server.DebugServer:
         """Serve the router's own debug plane: /statusz gains a
         ``router`` section, /podz fans out over the replicas (the
-        fleet-controller pattern reused), /readyz = any replica
+        fleet-controller pattern reused), /tracez?trace_id= merges the
+        fleet's spans for one request, /readyz = any replica
         placeable."""
         srv = _dbg_server.DebugServer(
             port=port, host=host,
@@ -643,6 +746,7 @@ class Router:
                         "replicas": sorted(self._replicas)})
         srv.add_status("router", self.stats)
         srv.set_fleet(self.replicaz)
+        srv.set_trace_fanin(self.trace_fanin)
         srv.set_ready(lambda: bool(self._alive_names()))
         srv.add_post("/submit", self._http_submit)
         srv.add_post("/drain", self._http_drain)
@@ -781,8 +885,6 @@ class Router:
             self._dispatch(t)
 
     def _dispatch(self, t: Ticket) -> None:
-        from .resilience import faults as _faults
-
         st = self._pick_replica(t)
         if st is None:
             with self._mu:
@@ -791,6 +893,25 @@ class Router:
                 "all replicas down; request cannot be placed")
             t.done.set()
             return
+        telem = telemetry.enabled()
+        # bind the request's context for the whole placement: every
+        # hop below (prefill-worker POST, replica submit/inject —
+        # HTTP header or in-process thread-local alike) parents onto
+        # this dispatch span, and a retry re-enters here with the
+        # SAME trace id (retry count annotated)
+        cm_bind = _tracing.bind(t.trace) if telem else _NULL_CM
+        cm_span = (_tracing.span("router.dispatch", ctx=t.trace,
+                                 rid=t.rid,
+                                 replica=st.replica.name,
+                                 retry=t.retries)
+                   if telem else _NULL_CM)
+        with cm_bind, cm_span:
+            self._dispatch_on(t, st, telem)
+
+    def _dispatch_on(self, t: Ticket, st: "_ReplicaState",
+                     telem: bool) -> None:
+        from .resilience import faults as _faults
+
         try:
             inj = _faults.active()
             if inj is not None:
@@ -812,10 +933,16 @@ class Router:
                         worker = workers[self._pf_rr % len(workers)]
                         self._pf_rr += 1
                 if workers:
+                    pf_cm = (_tracing.span("router.disagg_prefill",
+                                           ctx=t.trace,
+                                           worker=worker.name,
+                                           plen=int(t.prompt.size))
+                             if telem else _NULL_CM)
                     try:
-                        handoff = worker.prefill(t.prompt)
+                        with pf_cm:
+                            handoff = worker.prefill(t.prompt)
                         t.disaggregated = True
-                        if telemetry.enabled():
+                        if telem:
                             _router_metrics()["disagg"].inc()
                     except EnforceError:
                         raise  # typed rejection: the REQUEST's fault
@@ -860,17 +987,26 @@ class Router:
             self._finish(t, rec)
         if telemetry.enabled():
             _router_metrics()["queue_wait"].observe(
-                t.t_dispatched - t.t_submit)
+                t.t_dispatched - t.t_submit,
+                exemplar=(t.trace.trace_id
+                          if t.trace is not None and t.trace.sampled
+                          else None))
 
     def _requeue(self, t: Ticket) -> None:
         """Re-dispatch after a replica failure — the request survives
         as long as ANY replica does."""
         t.retries += 1
+        prev = t.replica
         t.replica = t.replica_rid = None
         with self._mu:
             self._retry_count += 1
         if telemetry.enabled():
             _router_metrics()["retries"].inc()
+            # the retry stays on the SAME trace id — the merged
+            # timeline shows the death and the re-dispatch as one
+            # request's story, annotated here
+            _tracing.event("router.retry", ctx=t.trace, rid=t.rid,
+                           retries=t.retries, failed_replica=prev)
         if not self._alive_names():
             with self._mu:
                 self._queued = max(0, self._queued - 1)
@@ -939,7 +1075,11 @@ class Router:
                                else (1 - a) * self._ewma_ttft
                                + a * t.ttft_s)
         if telemetry.enabled():
-            _router_metrics()["ttft"].observe(t.ttft_s)
+            _router_metrics()["ttft"].observe(
+                t.ttft_s,
+                exemplar=(t.trace.trace_id
+                          if t.trace is not None and t.trace.sampled
+                          else None))
         t.done.set()
 
     def _harvest(self, st: _ReplicaState) -> None:
@@ -976,6 +1116,18 @@ class Router:
                 self._harvest(st)
         if telemetry.enabled():
             _router_metrics()["healthy"].set(len(self._alive_names()))
+            if self._textfile:
+                # node-exporter textfile path: re-write the whole
+                # registry (pt_router_* included) on a bounded cadence
+                # — scrape-less deployments read the same series a
+                # /metrics scrape would
+                now = time.monotonic()
+                if now - self._textfile_t >= self._textfile_interval_s:
+                    self._textfile_t = now
+                    try:
+                        telemetry.write_textfile(self._textfile)
+                    except Exception:
+                        pass  # a full disk must not kill the poll loop
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
@@ -1185,7 +1337,9 @@ def serve_main(spec: str, replicas: int = 2, prefill_workers: int = 0,
                port: int = 0, spec_kw: Optional[dict] = None,
                log_dir: Optional[str] = None,
                policy: Optional[SLOPolicy] = None,
-               disagg_min_tokens: Optional[int] = 64) -> Router:
+               disagg_min_tokens: Optional[int] = 64,
+               trace_sample: Optional[float] = None,
+               textfile_path: Optional[str] = None) -> Router:
     """One-command serving bring-up (``python -m paddle_tpu.launch
     --serve``): spawn the replica (and prefill) worker processes, build
     the router over them, and serve the router front-end (POST /submit
@@ -1197,7 +1351,9 @@ def serve_main(spec: str, replicas: int = 2, prefill_workers: int = 0,
                           spec_kw=spec_kw, log_dir=log_dir)
            if prefill_workers else [])
     router = Router(reps, prefill_workers=pfs, policy=policy,
-                    disagg_min_tokens=disagg_min_tokens)
+                    disagg_min_tokens=disagg_min_tokens,
+                    trace_sample=trace_sample,
+                    textfile_path=textfile_path)
     router.start_server(port=port)
     return router
 
@@ -1228,6 +1384,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="(router mode) decode worker processes")
     ap.add_argument("--prefill-workers", type=int, default=0,
                     help="(router mode) dedicated prefill workers")
+    ap.add_argument("--trace-sample", dest="trace_sample", type=float,
+                    default=None,
+                    help="(router mode) head-based request-trace "
+                    "sampling rate 0..1 (default: PT_TRACE_SAMPLE or "
+                    "1.0)")
+    ap.add_argument("--textfile", dest="textfile", default=None,
+                    help="(router mode) write the metrics exposition "
+                    "here periodically (node-exporter textfile "
+                    "collector; also env PT_ROUTER_TEXTFILE)")
     args = ap.parse_args(argv)
     kw = json.loads(args.spec_kw) if args.spec_kw else None
     if args.worker:
@@ -1237,7 +1402,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     router = serve_main(args.spec, replicas=args.replicas,
                         prefill_workers=args.prefill_workers,
-                        port=args.port, spec_kw=kw)
+                        port=args.port, spec_kw=kw,
+                        trace_sample=args.trace_sample,
+                        textfile_path=args.textfile)
     print(f"[router] serving on {router.server.url()} over "
           f"{args.replicas} replica(s)", file=sys.stderr)
     try:

@@ -38,10 +38,11 @@ Usage::
 
 from __future__ import annotations
 
-from . import diag, export, metrics, recompile, server, trace
+from . import diag, export, metrics, recompile, server, trace, tracing
 from .diag import (AnomalyHalt, FlightRecorder, device_memory,
                    peak_memory_bytes)
-from .export import prometheus_text, summary, write_textfile
+from .export import (openmetrics_text, prometheus_text, summary,
+                     write_textfile)
 from .metrics import (Counter, DEFAULT_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, cached_instruments, disable,
                       enable, enabled, log_buckets, registry)
@@ -49,24 +50,29 @@ from .recompile import RecompileTracker, fingerprint
 from .server import DebugServer
 from .trace import (RecordEvent, Span, export_chrome_trace, export_jsonl,
                     span)
+from .tracing import (TRACE_HEADER, TraceContext, TraceSpan,
+                      merge_chrome_trace, new_trace)
 
 __all__ = [
     "AnomalyHalt", "Counter", "DEFAULT_BUCKETS", "DebugServer",
     "FlightRecorder", "Gauge", "Histogram",
     "MetricsRegistry", "RecompileTracker", "RecordEvent", "Span",
+    "TRACE_HEADER", "TraceContext", "TraceSpan",
     "cached_instruments", "device_memory", "diag",
     "disable", "enable", "enabled", "export", "export_chrome_trace",
-    "export_jsonl", "fingerprint", "log_buckets", "metrics",
-    "peak_memory_bytes",
+    "export_jsonl", "fingerprint", "log_buckets",
+    "merge_chrome_trace", "metrics", "new_trace",
+    "openmetrics_text", "peak_memory_bytes",
     "prometheus_text", "recompile", "registry", "reset", "server",
-    "span", "summary", "trace", "write_textfile",
+    "span", "summary", "trace", "tracing", "write_textfile",
 ]
 
 
 def reset() -> None:
-    """Full telemetry reset: drop every metric, span, and recompile
-    fingerprint (tests / between benchmark phases). Leaves the enabled
-    flag as-is."""
+    """Full telemetry reset: drop every metric, span, trace, and
+    recompile fingerprint (tests / between benchmark phases). Leaves
+    the enabled flag as-is."""
     registry().reset()
     trace.reset()
+    tracing.reset()
     recompile.tracker().reset()

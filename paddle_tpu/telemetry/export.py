@@ -38,7 +38,24 @@ def _fmt_le(b: float) -> str:
     return _fmt_val(b) if b == int(b) else repr(float(b))
 
 
-def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix for a _bucket sample:
+    ``# {trace_id="..."} value timestamp`` — the tail-latency
+    breadcrumb linking a histogram bucket to the distributed trace
+    that produced its slowest recent sample. Only rendered on the
+    OPENMETRICS exposition (``prometheus_text(exemplars=True)`` /
+    :func:`openmetrics_text`): the syntax is illegal in the classic
+    text format, where one suffixed line would make a strict parser
+    (node-exporter's textfile collector included) drop the WHOLE
+    exposition."""
+    if not ex:
+        return ""
+    return (f' # {{trace_id="{ex["trace_id"]}"}} '
+            f'{repr(float(ex["value"]))} {repr(float(ex["ts"]))}')
+
+
+def prometheus_text(reg: Optional[MetricsRegistry] = None, *,
+                    exemplars: bool = False) -> str:
     reg = reg or _registry()
     out = []
     seen_headers = set()
@@ -53,22 +70,36 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
         elif isinstance(m, Histogram):
             snap = m.snapshot()
             base_labels = dict(m.labels)
+            ex = m.exemplars() if exemplars else {}
             acc = 0
-            for bound, c in zip(snap["buckets"], snap["counts"]):
+            for i, (bound, c) in enumerate(zip(snap["buckets"],
+                                               snap["counts"])):
                 acc += c
                 lbl = dict(base_labels, le=_fmt_le(bound))
                 inner = ",".join(
                     f'{k}="{v}"' for k, v in sorted(lbl.items()))
-                out.append(f"{m.name}_bucket{{{inner}}} {acc}")
+                out.append(f"{m.name}_bucket{{{inner}}} {acc}"
+                           + _fmt_exemplar(ex.get(i)))
             inner = ",".join(f'{k}="{v}"' for k, v in sorted(
                 dict(base_labels, le="+Inf").items()))
-            out.append(f"{m.name}_bucket{{{inner}}} {snap['count']}")
+            out.append(f"{m.name}_bucket{{{inner}}} {snap['count']}"
+                       + _fmt_exemplar(ex.get(len(snap["buckets"]))))
             suffix = ("{" + ",".join(
                 f'{k}="{v}"' for k, v in sorted(base_labels.items()))
                 + "}") if base_labels else ""
             out.append(f"{m.name}_sum{suffix} {repr(snap['sum'])}")
             out.append(f"{m.name}_count{suffix} {snap['count']}")
     return "\n".join(out) + ("\n" if out else "")
+
+
+def openmetrics_text(reg: Optional[MetricsRegistry] = None) -> str:
+    """The OpenMetrics form of the exposition: exemplar suffixes on
+    histogram ``_bucket`` lines (the tail-latency trace-id
+    breadcrumbs) plus the required ``# EOF`` terminator. This is what
+    the debug server's ``/metrics`` serves; the classic form
+    (:func:`prometheus_text`, no exemplars) stays the textfile /
+    plain-scraper format."""
+    return prometheus_text(reg, exemplars=True) + "# EOF\n"
 
 
 def write_textfile(path: str,
@@ -79,8 +110,10 @@ def write_textfile(path: str,
     it: a scrape landing mid-write reads a torn exposition (the same
     torn-write hazard ROADMAP documents for the compile cache — here it
     surfaces as phantom counter resets, not segfaults). Same-dir temp
-    file + ``os.replace`` makes every read all-or-nothing. Returns
-    ``path``."""
+    file + ``os.replace`` makes every read all-or-nothing. CLASSIC
+    format on purpose — the textfile collector rejects OpenMetrics
+    exemplar syntax, and one suffixed line would drop the whole file.
+    Returns ``path``."""
     return atomic_write_text(path, prometheus_text(reg),
                              prefix=".pt_metrics_")
 

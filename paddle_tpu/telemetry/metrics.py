@@ -32,7 +32,8 @@ import bisect
 import math
 import os
 import threading
-from typing import Dict, Iterable, List, Optional, Tuple
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # global enable flag — THE check every instrumented call-site performs first
@@ -172,8 +173,15 @@ class Histogram(_Instrument):
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        # per-bucket exemplars (OpenMetrics): bucket index -> the
+        # last trace_id/value/wall-time that landed there. Only
+        # populated when observe() is handed an exemplar (a sampled
+        # request's trace id) — the tail-latency breadcrumb linking a
+        # p99 bucket to the cross-process timeline that produced it.
+        self._exemplars: Dict[int, Dict[str, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         v = float(value)
         i = bisect.bisect_left(self.buckets, v)
         with self._mu:
@@ -184,6 +192,29 @@ class Histogram(_Instrument):
                 self._min = v
             if v > self._max:
                 self._max = v
+            if exemplar is not None:
+                self._exemplars[i] = {"trace_id": str(exemplar),
+                                      "value": v,
+                                      "ts": time.time()}
+
+    def exemplars(self) -> Dict[int, Dict[str, float]]:
+        """Copy of the per-bucket exemplar map (bucket index ->
+        {trace_id, value, ts}; index len(buckets) = +Inf)."""
+        with self._mu:
+            return {i: dict(e) for i, e in self._exemplars.items()}
+
+    def top_exemplar(self) -> Optional[Dict[str, Any]]:
+        """The exemplar from the HIGHEST populated bucket — the
+        slowest recently-traced sample, i.e. the trace the p99 row
+        points an operator at (``le`` names the bucket)."""
+        ex = self.exemplars()
+        if not ex:
+            return None
+        i = max(ex)
+        out = dict(ex[i])
+        out["le"] = (self.buckets[i] if i < len(self.buckets)
+                     else math.inf)
+        return out
 
     @property
     def count(self) -> int:

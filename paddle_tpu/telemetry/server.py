@@ -20,7 +20,13 @@ measurement cost; it only exposes what the instruments hold):
   loop attached (``add_status`` — e.g. the input pipeline's live
   prefetch depth).
 - ``/tracez``   ring of recent completed spans as JSON (populated while
-  ``trace.start_profiler()`` collection is on).
+  ``trace.start_profiler()`` collection is on) PLUS the distributed
+  request-tracing view: the process's trace-span ring and its
+  clock-offset handshake (``telemetry.tracing``). ``?trace_id=``
+  filters to one trace; with a fan-in provider attached
+  (:meth:`DebugServer.set_trace_fanin` — the router /
+  FleetController), ``?trace_id=`` aggregates matching spans from
+  EVERY peer into one clock-aligned merged chrome-trace.
 - ``/memz``     per-device memory (``diag.device_memory``): backend
   ``memory_stats()`` where available, live-array fallback elsewhere.
 - ``/podz``     pod-level fleet view (only when a
@@ -56,6 +62,7 @@ from typing import Any, Callable, Dict, List, Optional
 from . import metrics as _metrics
 from . import recompile as _recompile
 from . import trace as _trace
+from . import tracing as _tracing
 
 TRACEZ_SPANS = 256  # /tracez shows at most this many most-recent spans
 
@@ -115,6 +122,8 @@ class DebugServer:
         self._fleet: Optional[Callable[[], Any]] = None
         self._ready: Optional[Callable[[], bool]] = None
         self._posts: Dict[str, Callable[[bytes], Any]] = {}
+        self._trace_fanin: Optional[Callable[[Optional[str]], Any]] = \
+            None
 
     # -- wiring -------------------------------------------------------------
 
@@ -134,6 +143,16 @@ class DebugServer:
         (normally ``FleetController.podz`` — evaluated per scrape, so
         the view is live). Without one, /podz answers 404."""
         self._fleet = provider
+
+    def set_trace_fanin(
+            self, provider: Callable[[Optional[str]], Any]) -> None:
+        """Mount a FLEET trace-aggregation provider on
+        ``/tracez?trace_id=`` (and ``/tracez?fanin=1``):
+        ``provider(trace_id)`` fans out to every peer's /tracez,
+        aligns clocks, and returns one merged chrome-trace view
+        (``Router.trace_fanin`` / ``FleetController.tracez_fanout``).
+        Without one, /tracez?trace_id= filters the LOCAL ring only."""
+        self._trace_fanin = provider
 
     def set_ready(self, provider: Callable[[], bool]) -> None:
         """Attach the READINESS provider (placement gate, distinct from
@@ -264,6 +283,16 @@ class DebugServer:
             resilience = _resilience.statusz()
         except Exception as e:  # /statusz must render regardless
             resilience = f"<resilience status failed: {e!r}>"
+        # tail-latency exemplars: each histogram's highest populated
+        # bucket with a recorded trace id — the /statusz row that
+        # links a p99 straight to its cross-process timeline
+        # (/tracez?trace_id=...)
+        exemplars = {}
+        for m in _metrics.registry().collect():
+            top = (m.top_exemplar()
+                   if isinstance(m, _metrics.Histogram) else None)
+            if top:
+                exemplars[m.full_name] = top
         return {
             "backend": devices[0].platform if devices else None,
             "device_count": len(devices),
@@ -279,14 +308,30 @@ class DebugServer:
             "tracing": _trace.tracing(),
             "recompile": _recompile.tracker().stats(),
             "resilience": resilience,
+            "exemplars": exemplars,
             "status": status,
             "run_config": self.run_config,
         }
 
-    def tracez(self) -> Dict[str, Any]:
-        events = _trace.get_events()
-        return {"tracing": _trace.tracing(), "total": len(events),
-                "spans": events[-TRACEZ_SPANS:]}
+    def tracez(self, trace_id: Optional[str] = None) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            # the clock-offset handshake + pid the fleet fan-in
+            # aligns/lanes this process's spans with
+            "pid": os.getpid(),
+            "proc": (self.run_config.get("role")
+                     or f"pid{os.getpid()}"),
+            "clock": _tracing.clock(),
+            "trace_total": _tracing.total(),
+            "trace_spans": _tracing.spans(trace_id),
+        }
+        if trace_id is None:
+            # the historical profiler-ring view rides along on the
+            # unfiltered scrape
+            events = _trace.get_events()
+            out["tracing"] = _trace.tracing()
+            out["total"] = len(events)
+            out["spans"] = events[-TRACEZ_SPANS:]
+        return out
 
     def memz(self) -> Dict[str, Any]:
         from . import diag
@@ -312,13 +357,19 @@ def _make_handler(server: DebugServer):
             self.wfile.write(data)
 
         def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            raw, _, query = self.path.partition("?")
+            path = raw.rstrip("/") or "/"
             try:
                 if path == "/metrics":
-                    from .export import prometheus_text
+                    # OpenMetrics on the wire: exemplar suffixes are
+                    # only legal under this content type — a classic
+                    # text/plain parser handed one would drop the
+                    # whole scrape (write_textfile stays classic)
+                    from .export import openmetrics_text
 
-                    self._send(200, prometheus_text(),
-                               "text/plain; version=0.0.4")
+                    self._send(200, openmetrics_text(),
+                               "application/openmetrics-text; "
+                               "version=1.0.0")
                 elif path == "/healthz":
                     self._send(200, json.dumps(server.healthz()))
                 elif path == "/readyz":
@@ -334,8 +385,25 @@ def _make_handler(server: DebugServer):
                     self._send(200, json.dumps(server.statusz(),
                                                default=str))
                 elif path == "/tracez":
-                    self._send(200, json.dumps(server.tracez(),
-                                               default=str))
+                    from urllib.parse import parse_qs
+
+                    qs = parse_qs(query)
+                    tid = (qs.get("trace_id") or [None])[0]
+                    # ``local=1`` forces the LOCAL view even when a
+                    # fan-in provider is mounted — it is what the
+                    # fan-out itself requests from peers, so two
+                    # aggregators (e.g. every fleet rank mounts one)
+                    # can never recurse into each other's fan-ins
+                    if (server._trace_fanin is not None
+                            and "local" not in qs
+                            and (tid or "fanin" in qs)):
+                        # fleet aggregation: fan out to every peer,
+                        # align clocks, one merged chrome-trace
+                        self._send(200, json.dumps(
+                            server._trace_fanin(tid), default=str))
+                    else:
+                        self._send(200, json.dumps(server.tracez(tid),
+                                                   default=str))
                 elif path == "/memz":
                     self._send(200, json.dumps(server.memz(),
                                                default=str))
@@ -382,7 +450,22 @@ def _make_handler(server: DebugServer):
             try:
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else b""
-                out = fn(body)
+                # cross-process trace propagation: an incoming
+                # X-PT-Trace header binds the request's context for
+                # the handler's duration (and records the server-side
+                # hop span), so spans the handler produces parent
+                # onto the caller's tree — the one choke point every
+                # POST endpoint (submit/inject/prefill/drain/config)
+                # rides through. pt-lint PT-LINT-306 keeps it honest.
+                hdr = self.headers.get(_tracing.TRACE_HEADER)
+                if hdr and _metrics.enabled():
+                    ctx = _tracing.from_header(hdr)
+                    with _tracing.bind(ctx), \
+                            _tracing.span("http.POST " + path,
+                                          path=path):
+                        out = fn(body)
+                else:
+                    out = fn(body)
                 if (isinstance(out, tuple) and len(out) == 2
                         and isinstance(out[1], (bytes, bytearray))):
                     ctype, data = out

@@ -49,6 +49,13 @@ def _stack() -> list:
     return s
 
 
+def _tid() -> int:
+    try:
+        return threading.get_native_id()
+    except AttributeError:  # pragma: no cover (py<3.8)
+        return threading.get_ident() % 100000
+
+
 class Span:
     """Context-manager span; nests via a thread-local stack and also
     annotates device traces (``jax.profiler.TraceAnnotation``) so spans
@@ -106,9 +113,15 @@ class Span:
                     "ts": self._t0 / 1e3,  # chrome trace wants µs
                     "dur": (t1 - self._t0) / 1e3,
                     "pid": os.getpid(),
-                    "tid": threading.get_ident() % 100000,
+                    # REAL OS thread id: spans from named worker
+                    # threads (pt-reader-*, pt-ckpt-async-writer,
+                    # pt-fleet-watcher) must land in their own chrome
+                    # lanes — the old get_ident()%100000 hash collided
+                    # and carried no name
+                    "tid": _tid(),
                     "args": {"depth": self._depth,
-                             "parent": self._parent},
+                             "parent": self._parent,
+                             "thread": threading.current_thread().name},
                 })
         if self.histogram is not None and _metrics.enabled():
             self.histogram.observe((t1 - self._t0) / 1e9)
@@ -174,10 +187,31 @@ def reset() -> None:
 
 
 def export_chrome_trace(events: List[Dict[str, Any]], path: str) -> None:
+    """Chrome-trace JSON with proper lanes: thread_name/process_name
+    METADATA events are emitted for every (pid, tid) seen, so spans
+    from named worker threads (pt-reader-*, pt-ckpt-async-writer,
+    pt-fleet-watcher, ...) render in their own labeled lane instead of
+    interleaving anonymously."""
     from ..utils.atomic import atomic_write_text
 
+    meta: List[Dict[str, Any]] = []
+    seen_pids: set = set()
+    seen_tids: set = set()
+    for e in events:
+        pid, tid = e.get("pid"), e.get("tid")
+        if pid is not None and pid not in seen_pids:
+            seen_pids.add(pid)
+            meta.append({"ph": "M", "name": "process_name",
+                         "pid": pid, "tid": 0,
+                         "args": {"name": f"pid {pid}"}})
+        tname = (e.get("args") or {}).get("thread")
+        if tname and (pid, tid) not in seen_tids:
+            seen_tids.add((pid, tid))
+            meta.append({"ph": "M", "name": "thread_name",
+                         "pid": pid, "tid": tid,
+                         "args": {"name": tname}})
     atomic_write_text(path, json.dumps(
-        {"traceEvents": events, "displayTimeUnit": "ms"}))
+        {"traceEvents": meta + list(events), "displayTimeUnit": "ms"}))
 
 
 def export_jsonl(events: List[Dict[str, Any]], path: str) -> None:

@@ -12,6 +12,7 @@ Executor::Close-style graceful shutdown (join async checkpoint writers).
 
 from __future__ import annotations
 
+import contextlib
 import sys
 import threading
 import time
@@ -28,7 +29,10 @@ from .resilience.controller import FleetController
 from .resilience.preemption import PreemptionHandler, _preempt_metrics
 from .telemetry import recompile as _recompile
 from .telemetry import server as _dbg_server
+from .telemetry import tracing as _tracing
 from .telemetry.diag import AnomalyHalt, FlightRecorder
+
+_NULL_CM = contextlib.nullcontext()
 
 
 @telemetry.cached_instruments
@@ -420,10 +424,15 @@ class TrainLoop:
                 if ctl is not None:
                     # pod-level aggregation: announce this rank's
                     # endpoint through the fleet transport and mount
-                    # the controller's fan-out view on /podz
+                    # the controller's fan-out view on /podz and its
+                    # trace fan-in on /tracez?trace_id= (rank-tagged
+                    # step spans + preempt-agreement events, merged
+                    # clock-aligned across the fleet)
                     ctl.publish_endpoint(self.debug_server.host,
                                          self.debug_server.port)
                     self.debug_server.set_fleet(ctl.podz)
+                    self.debug_server.set_trace_fanin(
+                        ctl.tracez_fanout)
 
             def _commit_preempt():
                 # coordinated preemption epilogue: ONE consistent
@@ -446,6 +455,13 @@ class TrainLoop:
                     print(f"[fleet] ranks committed differing steps: "
                           f"{committed}", file=sys.stderr)
 
+            # run-scoped trace: step spans land on ONE trace id per
+            # run, tagged with this process's rank, so the fleet
+            # /tracez fan-in merges rank-lanes of the same job (minted
+            # lazily — a debug_port enables telemetry just above)
+            run_trace = (_tracing.new_trace()
+                         if telemetry.enabled() else None)
+            rank = ctl.rank if ctl is not None else 0
             for batch in batches:
                 if ctl is not None:
                     # fleet-coordinated preemption: check() is an Event
@@ -475,8 +491,15 @@ class TrainLoop:
                     # step, and this is where it becomes visible
                     _recompile.record("train_loop.step", batch)
                     t0 = time.perf_counter()
+                    if run_trace is None:
+                        run_trace = _tracing.new_trace()
+                step_cm = (_tracing.span("train.step", ctx=run_trace,
+                                         rank=rank,
+                                         step=self.step + 1)
+                           if telem else _NULL_CM)
                 try:
-                    loss, metrics = self.trainer.train_step(batch)
+                    with step_cm:
+                        loss, metrics = self.trainer.train_step(batch)
                     if inj is not None and inj.fire("step.nan"):
                         # corrupt rule: poison the loss so the nan
                         # guard / recorder path runs deterministically

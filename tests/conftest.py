@@ -89,6 +89,10 @@ SLOW_PATTERNS = [
     "agreement_is_typed_error",
     "test_fleet_controller.py::test_elastic_n_minus_one_restart_"
     "resumes_committed_step",
+    # trace-smoke subprocess e2e: ci.sh mid runs it as its own "trace
+    # smoke" stage (pytest -m chaos on the file) — keep it out of -m
+    # mid so it doesn't run twice
+    "test_tracing.py::test_trace_smoke_two_process_merged_trace",
 ]
 
 # mid tier = smoke + one representative per DEEP subsystem (pallas
@@ -167,6 +171,7 @@ MID_PATTERNS = [
     "test_static.py",
     "test_sparse_embedding_grads.py",
     "test_moe.py",
+    "test_tracing.py",
 ]
 
 # representative fast subset across subsystems (the smoke tier)

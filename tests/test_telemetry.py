@@ -131,8 +131,16 @@ class TestTrace:
         events = ttrace.stop_profiler(timeline_path=path)
         with open(path) as f:
             doc = json.load(f)
-        assert [e["name"] for e in doc["traceEvents"]] == [
+        # lane metadata (thread_name/process_name, ph="M") precedes
+        # the span events — the chrome-trace thread-lane fix
+        spans_out = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert [e["name"] for e in spans_out] == [
             e["name"] for e in events]
+        tnames = [e for e in meta if e["name"] == "thread_name"]
+        assert tnames and tnames[0]["args"]["name"]  # labeled lane
+        assert all(e["tid"] == events[0]["tid"] for e in spans_out)
+        assert events[0]["args"]["thread"]  # thread name recorded
         by_name = {e["name"]: e for e in events}
         assert by_name["inner"]["args"]["depth"] == 1
         assert by_name["inner"]["args"]["parent"] == "outer"
@@ -338,6 +346,38 @@ class TestExport:
         # the exposition ends with exactly one newline (a missing final
         # newline makes node-exporter drop the last sample)
         assert open(path).read().endswith("pt_t_req_total 3\n")
+
+    def test_write_textfile_includes_router_metrics(self, tmp_path):
+        """The node-exporter path carries the ROUTER's series too (the
+        scrape-only gap): instantiate the router instrument set the way
+        serving_router does, drive it, and pin the exposition lines —
+        including the OpenMetrics exemplar suffix on the bucket a
+        traced sample landed in."""
+        from paddle_tpu.serving_router import _router_metrics
+
+        m = _router_metrics()
+        m["requests"].inc(4)
+        m["healthy"].set(2)
+        m["ttft"].observe(0.5, exemplar="cafe42")
+        path = str(tmp_path / "router.prom")
+        assert telemetry.write_textfile(path) == path
+        text = open(path).read()
+        lines = text.splitlines()
+        assert "# TYPE pt_router_requests_total counter" in lines
+        assert "pt_router_requests_total 4" in lines
+        assert "pt_router_replicas_healthy 2" in lines
+        bucket_lines = [ln for ln in lines
+                        if ln.startswith("pt_router_ttft_seconds_bucket")]
+        assert bucket_lines, "router TTFT histogram missing"
+        # the textfile is CLASSIC format: exemplar syntax must never
+        # reach it (the collector would reject the whole file) — the
+        # exemplar rides the OpenMetrics form only, on its own bucket
+        assert "# {" not in text
+        om = telemetry.openmetrics_text()
+        tagged = [ln for ln in om.splitlines()
+                  if ln.startswith("pt_router_ttft_seconds_bucket")
+                  and '# {trace_id="cafe42"} 0.5' in ln]
+        assert len(tagged) == 1
 
     def test_write_textfile_is_atomic(self, tmp_path, monkeypatch):
         """Temp-file + os.replace discipline: the target either holds a

@@ -59,6 +59,10 @@ case "$MODE" in
     JAX_PLATFORMS=cpu python -m pytest tests/test_serving_router.py \
       -q -k "http_router_smoke or dispatch_fault or all_replicas_down" \
       || exit $?
+    stage "trace smoke (routed request through 2 worker processes -> \
+ONE merged cross-process chrome-trace with a shared trace id)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py \
+      -q -m chaos || exit $?
     stage "multichip dryrun (8-device CPU sim)"
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
