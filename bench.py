@@ -1530,6 +1530,41 @@ def bench_checkpoint(steps: int, batch_size: int, amp=None):
             "resume_restore_ms": round(sum(restore_s) / steps * 1e3, 3),
             "step_time_ms": round(dt / steps * 1e3, 3),
         }
+        # step-agreed save transaction overhead: a 2-rank in-process
+        # fleet (file transport) runs the two-phase global commit and
+        # commit_barrier_ms is the time from this rank's last shard
+        # staged to the fleet-wide COMMITTED marker landing — the
+        # transaction's cost on the trend line, separate from raw IO
+        import os
+        import threading
+
+        from paddle_tpu.resilience import FleetController
+        from paddle_tpu.resilience.controller import FileTransport
+
+        froot = os.path.join(root, "fleet")
+
+        def ctl(rank):
+            return FleetController(
+                rank=rank, world=2, hold_poll_s=0.002,
+                ckpt_timeout_s=120.0,
+                transport=FileTransport(froot, "bench"))
+
+        m0 = CheckpointManager(os.path.join(root, "ga"),
+                               max_to_keep=2, async_save=False,
+                               coordinator=ctl(0))
+        m1 = CheckpointManager(os.path.join(root, "gb"),
+                               max_to_keep=2, async_save=False,
+                               coordinator=ctl(1))
+        barriers = []
+        for i in range(1, min(steps, 4) + 1):
+            t = threading.Thread(target=lambda s=i: m1.save(s, state),
+                                 name="pt-bench-ckpt-rank1")
+            t.start()
+            m0.save(i, state)
+            t.join()
+            barriers.append(m0.last_commit_barrier_s)
+        extras["commit_barrier_ms"] = round(
+            sum(barriers) / len(barriers) * 1e3, 3)
         return value, "MB/sec", extras
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -2452,6 +2487,10 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                                   "overload_"))
                  or k in ("accept_per_round", "rounds", "prefetch_off",
                           "prefetch_on", "overlap_speedup", "fsdp",
+                          # checkpoint bench: save/recovery latency and
+                          # the step-agreed transaction's barrier cost
+                          "save_ms", "resume_restore_ms",
+                          "commit_barrier_ms", "payload_mb",
                           "peak_mem_bytes_replicated",
                           "peak_mem_bytes_planned", "byte_budget",
                           "fits_budget_only_planned", "shard_ratio",

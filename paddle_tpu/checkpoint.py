@@ -55,7 +55,9 @@ from . import telemetry
 from .core.enforce import EnforceError, enforce
 from .core.mesh import get_mesh
 from .resilience import faults as _faults
-from .resilience.controller import (BarrierTimeoutError,
+from .resilience.controller import (_KV_POLICY, BarrierTimeoutError,
+                                    ClientTransport,
+                                    active as _fleet_active,
                                     note_barrier_timeout)
 from .resilience.integrity import (ChecksumError, checksum_bytes,
                                    verify_bytes)
@@ -90,6 +92,10 @@ def _ckpt_metrics(reg):
             "pt_checkpoint_restore_fallbacks_total",
             "CheckpointManager.restore fallbacks to an older committed "
             "step after a torn/corrupt newer one"),
+        "commit_barrier": reg.histogram(
+            "pt_checkpoint_commit_barrier_seconds",
+            "step-agreed saves: time from this rank's last shard "
+            "staged to the fleet-wide global commit landing", unit="s"),
     }
 
 _MANIFEST = "manifest.json"
@@ -98,6 +104,12 @@ _MANIFEST = "manifest.json"
 # published step dir certifies completeness — a dir torn by a mid-copy
 # kill or a partial rsync lacks it and restore skips that step
 _COMMITTED = "COMMITTED"
+# fleet-level commit marker (CheckpointManager with a coordinator): the
+# durable mirror of the transport's global-commit record — present only
+# once EVERY live rank staged this step, so a restarted multi-host fleet
+# trusts exactly the steps the whole fleet finished ("all hosts save
+# step N or none"). Never written single-process.
+_GLOBAL = "GLOBAL_COMMITTED"
 
 # dtypes numpy's .npy format can't round-trip natively are stored as a
 # same-width uint view and restored by name
@@ -321,13 +333,79 @@ def _file_barrier(directory: str, tag: str, *,
         time.sleep(poll_s)
 
 
+def _client_kv_barrier(client, tag: str, *, timeout_s: float,
+                       poll_s: float = 0.02) -> None:
+    """Coordination-service barrier over the service's KV store instead
+    of the opaque ``wait_at_barrier``: each rank publishes an arrival
+    key (retried under the bounded transport policy) and polls for its
+    peers', so an expiry names exactly the ranks that never arrived —
+    the same typed diagnostic the file path gives. A rank the launcher
+    marked dead fails the save FAST instead of burning the whole
+    timeout: its shards can never arrive, and committing without them
+    would publish a torn step, so the save must die loudly, not hang
+    and not half-commit."""
+    from .resilience.retry import retry_io as _retry
+
+    from .resilience.controller import (ENV_FLEET_DIR, ENV_RUN_ID,
+                                        FileTransport)
+
+    rank, world = jax.process_index(), jax.process_count()
+    # ClientTransport carries the client-compat shims exactly once
+    # (allow_overwrite fallback on put, try_get/blocking-get probe on
+    # get) — the barrier is just its KV under a dedicated namespace
+    kv = ClientTransport(client, "ckptbar")
+    _retry(lambda: kv.put(f"{tag}.{rank}", "1"),
+           policy=_KV_POLICY, what="ckpt.barrier")
+    # lazy litter reclamation, the file-barrier n-2 proof transplanted:
+    # entering sequence n proves every rank passed n-1, hence nobody
+    # still polls n-2 — its arrival keys are dead weight on the
+    # coordination service (3 x world keys per save, forever). Tags
+    # are "ckpt_<crc>_<n>_<phase>"; each rank reclaims its OWN key.
+    parts = tag.rsplit("_", 2)
+    if len(parts) == 3 and parts[1].isdigit() and int(parts[1]) > 2:
+        kv.delete(f"{parts[0]}_{int(parts[1]) - 2}_{parts[2]}.{rank}")
+
+    def _is_dead(r: int) -> bool:
+        # the launcher's dead markers: via the active controller when
+        # one is running, else straight from the launcher's file root
+        # (a job without a FleetController still deserves the fail-
+        # fast — otherwise a peer's SIGKILL burns the full barrier
+        # timeout before the typed error)
+        ctl = _fleet_active()
+        if ctl is not None:
+            return ctl._marker(f"dead.{r}") is not None
+        root = os.environ.get(ENV_FLEET_DIR)
+        if not root:
+            return False
+        run_id = os.environ.get(ENV_RUN_ID) or "r0"
+        return FileTransport(root, run_id).get(f"dead.{r}") is not None
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        missing = [r for r in range(world)
+                   if r != rank and kv.get(f"{tag}.{r}") is None]
+        if not missing:
+            return
+        dead = [r for r in missing if _is_dead(r)]
+        if dead or time.monotonic() >= deadline:
+            _BARRIER_STATS["timeouts"] += 1
+            note_barrier_timeout()
+            raise BarrierTimeoutError(
+                tag, missing=missing, world=world, timeout_s=timeout_s,
+                detail=(f"rank(s) {dead} died mid-save" if dead
+                        else None))
+        time.sleep(poll_s)
+
+
 def _barrier(tag: str, directory: str) -> None:
     """Coordination-service barrier (no device collectives — safe from the
     async writer thread); file-barrier fallback when multi-process with
     no coordination client. No-op single-process. A timeout on either
     path raises the typed :class:`resilience.BarrierTimeoutError`
-    (naming the missing ranks where the transport can tell) and bumps
-    ``pt_barrier_timeouts_total`` — never an opaque transport error."""
+    naming the missing ranks (the client path rendezvouses through the
+    coordination-service KV store, so it can tell too — not just the
+    file path) and bumps ``pt_barrier_timeouts_total`` — never an
+    opaque transport error."""
     if jax.process_count() <= 1:
         return
     from jax._src import distributed as _dist
@@ -342,6 +420,9 @@ def _barrier(tag: str, directory: str) -> None:
             # before peers finish writing their shards — a torn
             # checkpoint by construction)
             _file_barrier(directory, tag)
+        elif hasattr(client, "key_value_set"):
+            _client_kv_barrier(client, tag,
+                               timeout_s=_BARRIER_TIMEOUT_S)
         else:
             try:
                 client.wait_at_barrier(
@@ -350,8 +431,8 @@ def _barrier(tag: str, directory: str) -> None:
                 msg = str(e).lower()
                 if ("deadline" in msg or "timed out" in msg
                         or "timeout" in msg):
-                    # the service can't say who is missing, but the
-                    # diagnostic still carries tag/world/deadline
+                    # this legacy client can't say who is missing, but
+                    # the diagnostic still carries tag/world/deadline
                     _BARRIER_STATS["timeouts"] += 1
                     note_barrier_timeout()
                     raise BarrierTimeoutError(
@@ -894,20 +975,48 @@ class CheckpointManager:
 
     ``save`` snapshots synchronously and writes asynchronously by default;
     ``wait_until_finished`` joins outstanding writes (call before exit).
+
+    ``coordinator`` (a :class:`resilience.FleetController`, normally
+    wired by ``TrainLoop.run(controller=...)``) upgrades every periodic
+    save to a FLEET-LEVEL TRANSACTION — two-phase step-agreed commit
+    ("all hosts save step N or none"): the local write is only the
+    STAGE phase, the rank publishes ``staged.<rank>`` through the
+    coordination transport, and the step becomes restore-trustworthy
+    for the fleet only when every live rank staged it and the single
+    global commit marker lands (mirrored durably as a per-step
+    ``GLOBAL_COMMITTED`` file — the transport dies with the job; the
+    disk record is what a restarted fleet trusts). Restore and GC then
+    consult only globally-committed steps, so a rank can never prune
+    the last step a peer is still staging (the multi-host
+    ``max_to_keep=1`` hazard). With no coordinator — or world 1 — every
+    path is byte-for-byte the single-process manager: zero transport
+    IO, no extra markers (test-pinned).
     """
 
     _STEP_RE = re.compile(r"^step_(\d+)$")
 
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 async_save: bool = True):
+                 async_save: bool = True, coordinator=None):
         enforce(max_to_keep >= 1, "max_to_keep must be >= 1, got %s",
                 max_to_keep)
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.async_save = async_save
+        self.coordinator = coordinator
         self._pending: List[_WriteHandle] = []
         self.last_restored_step: Optional[int] = None
+        self.last_commit_barrier_s: Optional[float] = None
         os.makedirs(directory, exist_ok=True)
+
+    def _coord(self):
+        """The attached coordinator when it can actually coordinate
+        (multi-rank with a live transport); None selects the unchanged
+        single-process paths everywhere below."""
+        c = self.coordinator
+        if c is None or getattr(c, "world", 1) <= 1 or \
+                getattr(c, "transport", None) is None:
+            return None
+        return c
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")
@@ -950,16 +1059,72 @@ class CheckpointManager:
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
+    def globally_committed_steps(self) -> List[int]:
+        """Steps the WHOLE fleet finished saving (locally committed AND
+        carrying the durable ``GLOBAL_COMMITTED`` mirror). Fleet-mode
+        restore and GC consult only these; single-process managers
+        never write the marker."""
+        return [s for s in self.committed_steps()
+                if os.path.exists(os.path.join(self._step_dir(s),
+                                               _GLOBAL))]
+
+    def promote_global(self, step: int) -> None:
+        """Durably mark ``step`` globally committed. Restore-time
+        promotion: the fleet just AGREED every live rank holds this
+        step, which is exactly the all-ranks-staged evidence the
+        save-time marker records — a crash between everyone staging
+        and the marker landing must not demote the step forever."""
+        d = self._step_dir(step)
+        if os.path.isdir(d) and not os.path.exists(
+                os.path.join(d, _GLOBAL)):
+            retry_io(lambda: atomic_write_text(
+                os.path.join(d, _GLOBAL),
+                json.dumps({"step": int(step), "promoted": True})),
+                what="ckpt.commit")
+
+    def align_global(self, agreed: Optional[int]) -> None:
+        """Reconcile this rank's durable global markers with the
+        fleet's restore agreement: promote ``agreed`` (the fleet
+        provably holds it) and DEMOTE every marker ABOVE it — or all
+        of them when the agreement cold-starts. A stale marker from a
+        dead attempt (e.g. a survivor's post-agreement commit a
+        replacement rank never saw) would otherwise poison the fleet
+        GC floor: ``_gc_fleet`` computes its newest-global floor from
+        disk, so a stale step_100 marker makes it prune THIS run's
+        fresh commits as "strictly older" — the exact data-loss class
+        this layer exists to close — and ``restore(None)`` rollbacks
+        would diverge ranks onto steps the fleet doesn't share.
+        Demoted steps keep their local data (stage-only); they just
+        stop being fleet-trusted."""
+        for s in self.globally_committed_steps():
+            if agreed is None or s > agreed:
+                try:
+                    os.unlink(os.path.join(self._step_dir(s), _GLOBAL))
+                except OSError:
+                    pass
+        if agreed is not None:
+            self.promote_global(agreed)
+
     def latest_step(self) -> Optional[int]:
         """Newest COMMITTED step — the only kind worth resuming from
-        (a torn newer dir must not shadow restorable progress)."""
-        steps = self.committed_steps()
+        (a torn newer dir must not shadow restorable progress). Fleet
+        mode narrows that to globally-committed: a step a peer never
+        finished staging is not restorable progress for the FLEET."""
+        coord = self._coord()
+        steps = (self.globally_committed_steps() if coord is not None
+                 else self.committed_steps())
         return steps[-1] if steps else None
 
-    def save(self, step: int, tree) -> None:
+    def save(self, step: int, tree, *, coordinate: bool = True) -> None:
         # serialize writes targeting the same step dir: a second async save
         # of step N while the first is in flight would collide on the
-        # shared .tmp staging path
+        # shared .tmp staging path.
+        # ``coordinate=False`` stages locally WITHOUT the fleet
+        # transaction — the clean-completion epilogue uses it (ranks
+        # can complete at different final steps; a global commit there
+        # would hold each rank for a step its peers never save). The
+        # restore-time agreement reconciles such stage-only steps: if
+        # every rank holds one, it is restored and promoted.
         target = self._step_dir(step)
         still = []
         for t in self._pending:
@@ -968,10 +1133,66 @@ class CheckpointManager:
             else:
                 still.append(t)
         self._pending = still
-        handle = save_state(target, tree, async_save=self.async_save)
-        if isinstance(handle, _WriteHandle):
-            self._pending.append(handle)
+        coord = self._coord() if coordinate else None
+        if coord is None:
+            handle = save_state(target, tree,
+                                async_save=self.async_save)
+            if isinstance(handle, _WriteHandle):
+                self._pending.append(handle)
+            self._gc()
+            return
+        # fleet mode: stage locally, then run the two-phase global
+        # commit. For async saves the device→host snapshot STILL
+        # happens synchronously inside this call (save_state's
+        # donation-safety contract — the next overlapped step may
+        # donate the live buffers); only the file IO and the commit
+        # barrier ride writer threads, so training never blocks on a
+        # peer's staging. A commit that expires surfaces the typed
+        # BarrierTimeoutError at the next join (wait_until_finished /
+        # close).
+        if self.async_save:
+            inner = save_state(target, tree, async_save=True)
+
+            def commit_after():
+                inner.join()  # stage on disk (re-raises IO failures)
+                self._global_commit(step, coord)
+
+            self._pending.append(_WriteHandle(commit_after,
+                                              directory=target))
+        else:
+            save_state(target, tree, async_save=False)
+            self._global_commit(step, coord)
         self._gc()
+
+    def _global_commit(self, step: int, coord) -> None:
+        """Phases of the fleet transaction, after the local stage:
+        publish ``staged.<rank>``, hold for every live rank's, land the
+        global marker on the transport, then mirror it durably into the
+        step dir. The ``ckpt.stage`` / ``ckpt.commit`` injection points
+        bracket the two phases (delay rules widen the SIGKILL windows
+        the chaos e2es aim at; raising rules model transport faults —
+        the save tears, the step stays uncommitted for the fleet)."""
+        inj = _faults.active()
+        if inj is not None:
+            inj.fire("ckpt.stage", path=self._step_dir(step))
+        t0 = time.perf_counter()
+        coord.note_stage(step)
+        if coord.wait_global_commit(step) is None:
+            # deferred to an in-flight preempt agreement (see
+            # controller.wait_global_commit): the step stays staged-
+            # but-uncommitted so the train loop can publish its ack
+            return
+        if inj is not None:
+            inj.fire("ckpt.commit", path=self._step_dir(step))
+        retry_io(lambda: atomic_write_text(
+            os.path.join(self._step_dir(step), _GLOBAL),
+            json.dumps({"step": int(step), "world": coord.world,
+                        "run_id": coord.run_id})),
+            what="ckpt.commit")
+        self.last_commit_barrier_s = time.perf_counter() - t0
+        if telemetry.enabled():
+            _ckpt_metrics()["commit_barrier"].observe(
+                self.last_commit_barrier_s)
 
     # errors that mean "this step's bytes are bad", where trying the
     # previous committed step is the right move. Config/shape errors
@@ -986,14 +1207,19 @@ class CheckpointManager:
         bumps ``pt_checkpoint_restore_fallbacks_total``, and restore
         falls back to the next older committed step — the kill-safety
         contract (never a torn restore, never data loss past the last
-        commit). ``last_restored_step`` records what was restored."""
+        commit). Fleet mode (``coordinator=``) scans only GLOBALLY
+        committed steps: a step one rank holds but a peer never
+        finished staging would restore the fleet into divergence.
+        ``last_restored_step`` records what was restored."""
         self.wait_until_finished()
         if step is not None:
             tree = restore_state(self._step_dir(step), mesh=mesh,
                                  shardings=shardings, target=target)
             self.last_restored_step = step
             return tree
-        steps = self.committed_steps()
+        steps = (self.globally_committed_steps()
+                 if self._coord() is not None
+                 else self.committed_steps())
         enforce(steps, "no checkpoints under %s", self.directory)
         last_exc: Optional[BaseException] = None
         for s in reversed(steps):
@@ -1035,6 +1261,9 @@ class CheckpointManager:
         # wait_until_finished() re-raises them.
         self._pending = [t for t in self._pending
                          if not t.done() or t._exc is not None]
+        if self._coord() is not None:
+            self._gc_fleet()
+            return
         # GC only PAST COMMITTED steps: retention counts committed
         # checkpoints, so the newest committed one survives even when
         # max_to_keep is "exceeded" by a newer save that is still
@@ -1087,6 +1316,56 @@ class CheckpointManager:
                     and os.path.join(self.directory, base) not in pending
                     and not self._is_committed(base)):
                 shutil.rmtree(full, ignore_errors=True)
+
+    def _gc_fleet(self) -> None:
+        """Fleet-mode retention: a step is prunable ONLY when strictly
+        older than the newest GLOBALLY-committed step. A locally
+        committed (or still-staging) step at or above that floor may be
+        the fleet's next common restorable state — pruning it out from
+        under a peer that hasn't finished staging is exactly the
+        multi-host ``max_to_keep=1`` data-loss hazard. Retention counts
+        globally committed steps; torn stages below the floor are
+        provably superseded and swept."""
+        gsteps = self.globally_committed_steps()
+        if not gsteps:
+            return  # nothing fleet-trusted yet: prune NOTHING
+        newest = gsteps[-1]
+        protected = set(gsteps[-self.max_to_keep:])
+        pending = {t.directory for t in self._pending
+                   if t.directory is not None}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            base = name
+            for suf in (".tmp", ".old"):
+                if name.endswith(suf):
+                    base = name[:-len(suf)]
+                    break
+            m = self._STEP_RE.match(base)
+            if not m:
+                continue
+            full = os.path.join(self.directory, name)
+            tgt = os.path.join(self.directory, base)
+            if name.endswith(".old"):
+                # same .old recovery contract as the single-process GC:
+                # a kill mid-rename-swap leaves the step's only copy in
+                # the trash name — put it back, never erase it
+                if os.path.exists(tgt):
+                    shutil.rmtree(full, ignore_errors=True)
+                elif os.path.exists(os.path.join(full, _MANIFEST)):
+                    try:
+                        os.rename(full, tgt)
+                    except OSError:
+                        pass
+                else:
+                    shutil.rmtree(full, ignore_errors=True)
+                continue
+            s = int(m.group(1))
+            if s >= newest or s in protected or tgt in pending:
+                continue
+            shutil.rmtree(full, ignore_errors=True)
 
 
 # --- dygraph-parity convenience (reference: dygraph/checkpoint.py) ---------

@@ -14,7 +14,10 @@ Usage:
 Behavior:
 - spawns ``nproc`` copies of the script, each with its rank env (plus
   the fleet-controller transport env: ``PT_FLEET_DIR`` under the log
-  dir and a per-attempt ``PT_FLEET_RUN_ID``);
+  dir and a per-attempt ``PT_FLEET_RUN_ID`` — the same transport the
+  step-agreed periodic-save transaction and the restore-step
+  agreement ride, so a launched job gets multi-host durable
+  checkpointing with no extra wiring);
 - rank 0 streams to this process's stdout/stderr, other ranks write
   ``<log_dir>/workerlog.<rank>`` (reference launcher's log layout);
 - a worker that exits non-zero FAIL-FASTS the job: the failing rank's

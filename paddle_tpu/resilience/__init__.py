@@ -15,17 +15,24 @@ plane of PR 4 can *see* a failure; this one *survives* it):
 - ``integrity``: per-file checksums (crc32c when native, else crc32)
   recorded in the checkpoint manifest and verified on restore.
 - ``faults``: seeded deterministic :class:`FaultInjector` with named
-  injection points (``ckpt.write``, ``ckpt.manifest``,
-  ``restore.read``, ``step.nan``, ``io.slow``, ``fleet.notice``) — the
-  substrate of the chaos test suite. Off by default with zero hot-path
-  cost.
+  injection points (``ckpt.write``, ``ckpt.manifest``, ``ckpt.stage``,
+  ``ckpt.commit``, ``restore.read``, ``step.nan``, ``io.slow``,
+  ``fleet.notice``) — the substrate of the chaos test suite. Off by
+  default with zero hot-path cost.
 - ``controller``: the elastic fleet controller —
   :class:`FleetController` agrees "preempt at step N" across ranks
-  over the coordination transport, watches a metadata notice source
-  ahead of SIGTERM, aggregates per-rank health into ``/podz``, and
-  (with ``launch.py --elastic``) lets the job respawn on N-1 hosts
-  from the last committed checkpoint. :class:`BarrierTimeoutError` is
-  the typed diagnostic every coordination wait raises on expiry.
+  over the coordination transport, makes every PERIODIC save a
+  step-agreed two-phase transaction ("all hosts save step N or none" —
+  the ``ckpt.staged.<rank>`` / global ``ckpt.committed`` protocol
+  CheckpointManager drives through its ``coordinator=`` seam), agrees
+  on ONE fleet-held restore step at resume, watches a metadata notice
+  source ahead of SIGTERM, aggregates per-rank health into ``/podz``
+  (including ``last_committed_global`` commit-drift rows), and (with
+  ``launch.py --elastic``) lets the job respawn on N-1 hosts from the
+  last committed checkpoint. :class:`BarrierTimeoutError` is the typed
+  diagnostic every coordination wait raises on expiry — naming the
+  missing ranks on the coordination-service path too, not just the
+  shared-FS fallback.
 
 Everything here is opt-in: with no handler installed and no injector
 armed, the training/serving hot paths execute no resilience code (the

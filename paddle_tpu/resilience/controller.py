@@ -64,6 +64,7 @@ from ..telemetry import tracing as _tracing
 from ..utils.atomic import atomic_write_text
 from . import faults as _faults
 from .preemption import PreemptionHandler
+from .retry import RetryPolicy, retry_io
 
 __all__ = [
     "BarrierTimeoutError", "ClientTransport", "FileNotice",
@@ -72,6 +73,16 @@ __all__ = [
 ]
 
 _ACTIVE: Optional["FleetController"] = None
+
+# Transport KV writes ride the shared transient-I/O retry machinery: a
+# shared-FS blip (OSError) or a coordination-service RPC hiccup
+# (RuntimeError — jax's client surfaces gRPC faults as XlaRuntimeError)
+# costs a short bounded backoff instead of tearing a save or an
+# agreement. Deadline-bounded: an op that keeps failing raises inside
+# 10s, it never wedges a commit.
+_KV_POLICY = RetryPolicy(max_attempts=4, base_delay_s=0.02,
+                         max_delay_s=0.5, deadline_s=10.0,
+                         retry_on=(OSError, RuntimeError))
 
 # env protocol (set by launch.py for every worker; overridable):
 ENV_FLEET_DIR = "PT_FLEET_DIR"       # FileTransport root (shared FS)
@@ -92,6 +103,11 @@ def _fleet_metrics(reg):
             "pt_barrier_timeouts_total",
             "coordination barrier / fleet-agreement waits that "
             "timed out"),
+        "commit_lag": reg.gauge(
+            "pt_checkpoint_commit_lag_steps",
+            "steps this rank's newest staged checkpoint is ahead of "
+            "the fleet's newest globally-committed step (commit "
+            "drift; 0 = the whole fleet is caught up)"),
     }
 
 
@@ -162,6 +178,12 @@ class FileTransport:
         except OSError:
             return None
 
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass  # already gone (a peer reclaimed it first)
+
     def sweep(self) -> int:
         """GC other-run litter past the stale age. Prefix namespacing
         already makes foreign keys invisible to :meth:`get`; this just
@@ -202,7 +224,22 @@ class ClientTransport:
         return f"pt_fleet/{self.run_id}/{key}"
 
     def put(self, key: str, value: str) -> None:
-        self._client.key_value_set(self._key(key), value)
+        # allow_overwrite: the protocol's shared keys (preempt.flag,
+        # the global ckpt.committed.<N> marker) are written by EVERY
+        # rank with the same idempotent value — the service's default
+        # rejects the second writer, which would tear a commit that
+        # actually succeeded
+        try:
+            self._client.key_value_set(self._key(key), value,
+                                       allow_overwrite=True)
+        except TypeError:
+            # old clients without the kwarg: tolerate the duplicate
+            # publish (same-value rewrites are harmless by design)
+            try:
+                self._client.key_value_set(self._key(key), value)
+            except Exception as e:
+                if "already exists" not in str(e).lower():
+                    raise
 
     def get(self, key: str) -> Optional[str]:
         try_get = getattr(self._client, "key_value_try_get", None)
@@ -215,6 +252,12 @@ class ClientTransport:
                 self._key(key), 50)
         except Exception:
             return None  # NotFound surfaces as an error on both paths
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(self._key(key))
+        except Exception:
+            pass  # already gone / old client without delete
 
     def sweep(self) -> int:
         return 0  # the service dies with the job; nothing persists
@@ -359,6 +402,8 @@ class FleetController:
                  watch_interval_s: float = 2.0,
                  agree_timeout_s: float = 60.0,
                  commit_timeout_s: float = 300.0,
+                 ckpt_timeout_s: float = 300.0,
+                 dead_grace_s: float = 5.0,
                  podz_fetch_timeout_s: float = 2.0):
         env = os.environ
         if rank is None:
@@ -399,12 +444,23 @@ class FleetController:
         self.watch_interval_s = watch_interval_s
         self.agree_timeout_s = agree_timeout_s
         self.commit_timeout_s = commit_timeout_s
+        self.ckpt_timeout_s = ckpt_timeout_s
+        self.dead_grace_s = dead_grace_s
         self.podz_fetch_timeout_s = podz_fetch_timeout_s
         # agreement state
         self.acked_step: Optional[int] = None
         self.agreed_step: Optional[int] = None
         self.last_checkpoint_step: Optional[int] = None
         self.last_committed_step: Optional[int] = None
+        # step-agreed periodic save state (two-phase global commit).
+        # The ledger is touched from every async writer thread running
+        # a coordinated save — guard it.
+        self._staged_steps: List[int] = []  # own staged-key ledger
+        self._staged_lock = threading.Lock()
+        self.last_staged_step: Optional[int] = None
+        self.last_global_commit_step: Optional[int] = None
+        self.last_commit_barrier_s: Optional[float] = None
+        self.agreed_restore_step: Optional[int] = None
         self.committed_view: Optional[Dict[int, int]] = None
         self.last_wait_s: Optional[float] = None
         self.request_reason: Optional[str] = None
@@ -536,8 +592,8 @@ class FleetController:
             return False
         return self.transport.get("preempt.flag") is not None
 
-    def _wait_all(self, prefix: str, *, timeout_s: float,
-                  what: str) -> Dict[int, int]:
+    def _wait_all_raw(self, prefix: str, *, timeout_s: float,
+                      what: str) -> Dict[int, str]:
         """Gather ``<prefix>.<rank>``: WAIT only on live ranks, but
         collect EVERY published value — a rank that acked and then
         died still contributed its step, so every survivor computes
@@ -547,11 +603,11 @@ class FleetController:
         deadline = time.monotonic() + timeout_s
         t0 = time.monotonic()
         while True:
-            vals: Dict[int, int] = {}
+            vals: Dict[int, str] = {}
             for r in range(self.world):
                 v = self.transport.get(f"{prefix}.{r}")
                 if v is not None:
-                    vals[r] = int(v)
+                    vals[r] = v
             missing = [r for r in self._live_ranks()
                        if r not in vals]
             if not missing:
@@ -563,6 +619,11 @@ class FleetController:
                     what, missing=missing, world=self.world,
                     timeout_s=timeout_s)
             time.sleep(self.hold_poll_s)
+
+    def _wait_all(self, prefix: str, *, timeout_s: float,
+                  what: str) -> Dict[int, int]:
+        return {r: int(v) for r, v in self._wait_all_raw(
+            prefix, timeout_s=timeout_s, what=what).items()}
 
     def check(self, step: int) -> Optional[int]:
         """The per-step drive. Returns the agreed preempt step once one
@@ -636,6 +697,7 @@ class FleetController:
         'last committed step' row; async writes may still be in
         flight — the COMMITTED marker on disk is the truth)."""
         self.last_checkpoint_step = int(step)
+        self._update_commit_lag()
 
     def note_done(self, step: int) -> None:
         """Announce a CLEAN exit (data stream exhausted / num_steps
@@ -649,6 +711,225 @@ class FleetController:
             self.transport.put(f"done.{self.rank}", str(int(step)))
         except Exception:
             pass  # a failed announce degrades to the agree timeout
+
+    # -- step-agreed periodic saves (two-phase global commit) ---------------
+    #
+    # The preempt agreement above coordinates the FINAL save; these
+    # methods make EVERY periodic save a fleet-level transaction
+    # (orbax's "all hosts save step N or none"): each rank stages its
+    # step-N checkpoint locally, publishes ``ckpt.staged.<N>.<rank>``,
+    # and the single global ``ckpt.committed.<N>`` marker lands only
+    # once every LIVE rank has staged — dead-rank markers keep a
+    # crashed rank from wedging the commit, and a wait that expires
+    # raises the typed BarrierTimeoutError naming the missing ranks.
+    # CheckpointManager drives this through its ``coordinator=`` seam
+    # and records the durable per-step GLOBAL_COMMITTED marker (the
+    # transport's state dies with the job; the disk record is what a
+    # restarted fleet trusts).
+
+    def _kv_put(self, key: str, value: str) -> None:
+        """Transport put under the bounded transport retry policy —
+        every KV op on the save/agreement path is deadline-bounded,
+        never a single-shot RPC that tears a commit on one blip."""
+        enforce(self.transport is not None,
+                "no coordination transport (world=%s)", self.world)
+        retry_io(lambda: self.transport.put(key, value),
+                 policy=_KV_POLICY, what="fleet.kv_put")
+
+    def note_stage(self, step: int) -> None:
+        """Phase 1: announce this rank's step-``step`` checkpoint is
+        fully staged (locally committed on disk)."""
+        step = int(step)
+        self._kv_put(f"ckpt.staged.{step}.{self.rank}", str(step))
+        with self._staged_lock:
+            self._staged_steps.append(step)
+        self.last_staged_step = step
+        self._update_commit_lag()
+        if telemetry.enabled():
+            _tracing.event("fleet.ckpt.staged", rank=self.rank,
+                           step=step)
+
+    def wait_global_commit(self, step: int) -> Optional[float]:
+        """Phase 2: hold until every live rank staged ``step``, then
+        land the global commit marker (every rank writes the same
+        idempotent value — no special coordinator rank, so killing ANY
+        rank mid-commit degrades the same way). Returns the barrier
+        wait in seconds (the ``commit_barrier_ms`` bench column) — or
+        None when the commit DEFERS to an in-flight preempt agreement.
+
+        The deferral closes a deadlock: once some rank publishes the
+        preempt flag it HOLDS in the ack-wait and will not stage this
+        step until the agreement resolves — and a rank blocking inside
+        a synchronous coordinated save is exactly what keeps the
+        agreement from resolving (its ack publishes on the loop's next
+        check). So while a preemption is in flight and unagreed, the
+        commit backs off: the step stays staged-but-uncommitted, which
+        is safe (fleet GC never prunes at/above the global floor, and
+        the restore agreement reconciles common stage-only steps), and
+        the final preempt save commits coordinated at the agreed step.
+
+        Dead-rank semantics differ by phase, on purpose. BEFORE any
+        preempt agreement, a rank marked ``dead.<rank>`` fails the
+        commit FAST with the typed error naming it (never a hang) — a
+        crashed rank can never stage, and committing the step globally
+        WITHOUT its copy would let retention GC prune the fleet's last
+        common step, leaving a restarted fleet with no consistent
+        restore point at all (the job is being torn down by the
+        launcher's fail-fast anyway). AFTER an agreement resolved, the
+        fleet itself already dropped the corpse from the live set — the
+        survivors' FINAL coordinated save commits among the live, which
+        is what the elastic N-1 restart resumes from. Ranks that
+        announced ``done.<rank>`` (clean data exhaustion) are always
+        dropped: their exit was coordinated and they will never save
+        this step."""
+        step = int(step)
+        what = f"ckpt-commit step {step}"
+        t0 = time.monotonic()
+        deadline = t0 + self.ckpt_timeout_s
+        prefix = f"ckpt.staged.{step}"
+        dead_seen_at: Optional[float] = None
+        while True:
+            missing: List[int] = []
+            dead: List[int] = []
+            for r in range(self.world):
+                if r == self.rank:
+                    continue
+                if self.transport.get(f"{prefix}.{r}") is not None:
+                    continue
+                if self._marker(f"done.{r}") is not None:
+                    continue
+                if self._marker(f"dead.{r}") is not None:
+                    if self.agreed_step is not None:
+                        continue  # agreement already dropped the corpse
+                    dead.append(r)
+                else:
+                    missing.append(r)
+            if not missing and not dead:
+                break  # every live rank staged: commit now
+            if self.transport.get(f"ckpt.committed.{step}") \
+                    is not None:
+                # a peer already landed the global commit — and may
+                # have begun reclaiming its staged keys, so "missing"
+                # can be a cleanup mirage on overlapped async saves.
+                # The persistent marker IS the transaction's outcome.
+                break
+            if self.agreed_step is None and (
+                    self._requested()
+                    or self.transport.get("preempt.flag") is not None):
+                return None  # defer to the forming preempt agreement
+            if dead:
+                # a corpse before any agreement. The launcher's
+                # fail-fast marks dead FIRST and SIGTERMs survivors
+                # right after — give that teardown ``dead_grace_s`` to
+                # reach us (the deferral above then routes this save
+                # into the coordinated preempt exit). A dead marker
+                # with no teardown following means a torn fleet with
+                # nobody driving it down: fail typed, never commit.
+                if dead_seen_at is None:
+                    dead_seen_at = time.monotonic()
+                if time.monotonic() - dead_seen_at >= \
+                        self.dead_grace_s:
+                    note_barrier_timeout()
+                    raise BarrierTimeoutError(
+                        what, missing=dead + missing,
+                        world=self.world,
+                        timeout_s=self.ckpt_timeout_s,
+                        detail=f"rank(s) {dead} died mid-commit")
+                time.sleep(self.hold_poll_s)
+                continue
+            if time.monotonic() >= deadline:
+                note_barrier_timeout()
+                raise BarrierTimeoutError(
+                    what, missing=missing, world=self.world,
+                    timeout_s=self.ckpt_timeout_s)
+            time.sleep(self.hold_poll_s)
+        self._kv_put(f"ckpt.committed.{step}", str(step))
+        wait_s = time.monotonic() - t0
+        if self.last_global_commit_step is None or \
+                step > self.last_global_commit_step:
+            self.last_global_commit_step = step
+        # transport hygiene: a global commit of N proves every live
+        # rank staged N, hence finished every save below it — staged
+        # keys for older steps are dead weight (one key per step per
+        # rank, forever, on the shared-FS transport). Each rank
+        # reclaims its OWN; overlapped async waits on an older step
+        # stay safe because the wait loop above breaks on the PERSISTED
+        # ckpt.committed marker (which is why the committed markers
+        # themselves are never reclaimed — they are the durable
+        # transaction outcome a late waiter falls back to).
+        with self._staged_lock:
+            reclaim = [s for s in self._staged_steps if s < step]
+            self._staged_steps = [s for s in self._staged_steps
+                                  if s >= step]
+        for s in reclaim:
+            self.transport.delete(f"ckpt.staged.{s}.{self.rank}")
+        self.last_commit_barrier_s = round(wait_s, 4)
+        self._update_commit_lag()
+        if telemetry.enabled():
+            _tracing.event("fleet.ckpt.global_commit", rank=self.rank,
+                           step=step)
+        return wait_s
+
+    def global_commit_seen(self, step: int) -> bool:
+        """Whether the fleet-wide commit marker for ``step`` is visible
+        on the transport (a rank that timed out can re-check before
+        declaring the step dead)."""
+        if self.transport is None:
+            return False
+        return self.transport.get(f"ckpt.committed.{int(step)}") \
+            is not None
+
+    def agree_restore_step(self, local_steps) -> Optional[int]:
+        """Restore-time agreement: every rank publishes the steps it
+        can restore locally (its committed step dirs) and the fleet
+        restores the NEWEST step every live rank has — one consistent
+        step on every rank, never each rank's own newest. Returns None
+        when the fleet shares no restorable step (a consistent cold
+        start on every rank). Runs at attempt start, before training:
+        the rank set is the launcher's spawned set, so the published
+        lists and the live set agree on every rank."""
+        steps = sorted({int(s) for s in local_steps})
+        if self.world <= 1 or self.transport is None:
+            agreed = steps[-1] if steps else None
+        else:
+            self._kv_put(f"restore.steps.{self.rank}",
+                         json.dumps(steps))
+            if not steps:
+                # nothing restorable locally: the fleet intersection
+                # is empty no matter what peers hold — cold start NOW,
+                # and the published empty list lets every peer reach
+                # the same conclusion without holding for this rank
+                agreed = None
+            else:
+                vals = self._wait_all_raw(
+                    "restore.steps", timeout_s=self.agree_timeout_s,
+                    what="restore-agreement")
+                common: Optional[set] = None
+                for v in vals.values():
+                    s = set(json.loads(v))
+                    common = s if common is None else (common & s)
+                agreed = max(common) if common else None
+        self.agreed_restore_step = agreed
+        if agreed is not None and (
+                self.last_global_commit_step is None
+                or agreed > self.last_global_commit_step):
+            # the agreed step IS fleet-held (the caller promotes it):
+            # seed the global-commit view so the commit-lag gauge
+            # reports DRIFT after a resume, not the absolute step
+            self.last_global_commit_step = agreed
+            self._update_commit_lag()
+        return agreed
+
+    def _update_commit_lag(self) -> None:
+        if not telemetry.enabled():
+            return
+        local = self.last_staged_step
+        if local is None:
+            local = self.last_checkpoint_step
+        if local is None:
+            return
+        _fleet_metrics()["commit_lag"].set(
+            max(0, local - (self.last_global_commit_step or 0)))
 
     # -- pod-level aggregation (/podz) --------------------------------------
 
@@ -695,6 +976,14 @@ class FleetController:
                         "last_checkpoint_step")
                     row["last_committed_step"] = view.get(
                         "last_committed_step")
+                    # fleet-wide commit next to the local one: a rank
+                    # whose local step runs ahead of the global commit
+                    # is the one wedging (or outpacing) the fleet —
+                    # commit drift is visible at a glance
+                    row["last_committed_global"] = view.get(
+                        "last_global_commit_step")
+                    row["last_staged_step"] = view.get(
+                        "last_staged_step")
                     row["preempt"] = {
                         k: view.get(k)
                         for k in ("preempt_requested", "acked_step",
@@ -730,6 +1019,7 @@ class FleetController:
                 "run_id": self.run_id,
                 "preempt_requested": self._requested(),
                 "agreed_preempt_step": self.agreed_step,
+                "last_committed_global": self.last_global_commit_step,
                 "ranks": {str(row["rank"]): row for row in rows}}
 
     def tracez_fanout(self,
@@ -812,6 +1102,10 @@ class FleetController:
             "agreed_preempt_step": self.agreed_step,
             "last_checkpoint_step": self.last_checkpoint_step,
             "last_committed_step": self.last_committed_step,
+            "last_staged_step": self.last_staged_step,
+            "last_global_commit_step": self.last_global_commit_step,
+            "last_commit_barrier_s": self.last_commit_barrier_s,
+            "agreed_restore_step": self.agreed_restore_step,
             "last_agreement_wait_s": self.last_wait_s,
         }
         try:  # lazy: checkpoint pulls jax; /statusz must render anyway

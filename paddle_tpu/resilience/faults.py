@@ -15,6 +15,13 @@ Injection points (:data:`POINTS`):
 
 - ``ckpt.write``    each checkpoint leaf/shard file write
 - ``ckpt.manifest`` the manifest write
+- ``ckpt.stage``    the coordinated save's stage phase: fired after the
+  local step dir committed, BEFORE ``staged.<rank>`` is published
+  through the fleet transport (delay rules widen the mid-stage
+  SIGKILL window; raising rules model a transport put failing)
+- ``ckpt.commit``   the coordinated save's commit phase: fired after
+  every live rank staged, BEFORE the durable ``GLOBAL_COMMITTED``
+  marker lands on disk (delay rules widen the mid-commit kill window)
 - ``restore.read``  each checkpoint file read
 - ``step.nan``      the training step's loss (corrupt → NaN)
 - ``io.slow``       any checkpoint file I/O (delay rules widen the
@@ -38,8 +45,9 @@ from typing import Any, Dict, Optional
 from .. import telemetry
 from ..core.enforce import enforce
 
-POINTS = ("ckpt.write", "ckpt.manifest", "restore.read", "step.nan",
-          "io.slow", "fleet.notice", "router.dispatch")
+POINTS = ("ckpt.write", "ckpt.manifest", "ckpt.stage", "ckpt.commit",
+          "restore.read", "step.nan", "io.slow", "fleet.notice",
+          "router.dispatch")
 
 _ACTIVE: Optional["FaultInjector"] = None
 _LOCK = threading.Lock()

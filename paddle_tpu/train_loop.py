@@ -184,6 +184,32 @@ class TrainLoop:
     # -- lifecycle -----------------------------------------------------------
 
     def maybe_resume(self) -> Optional[int]:
+        coord = self.manager._coord()
+        if coord is not None:
+            # multi-host: every rank must restore the SAME step, and
+            # only one the whole fleet holds. Each rank publishes its
+            # locally committed steps through the transport and the
+            # fleet restores the newest COMMON one — then promotes it
+            # to globally committed (the agreement itself is the
+            # all-ranks-staged evidence a crash mid-commit may have
+            # kept off disk). No common step → a consistent cold start
+            # on every rank, never each rank's own newest.
+            agreed = coord.agree_restore_step(
+                self.manager.committed_steps())
+            # promote the agreed step AND demote stale global markers
+            # above it (or all of them on a cold start) — a dead
+            # attempt's leftover marker would poison the fleet GC
+            # floor and rollback restores
+            self.manager.align_global(agreed)
+            if agreed is None:
+                return None
+            # explicit-step restore: integrity errors on the agreed
+            # step propagate loudly — one rank silently falling back
+            # to an older step would diverge the fleet
+            self.trainer.restore_checkpoint(self.manager, agreed)
+            self.step = agreed
+            self.history["resumed_from"] = agreed
+            return agreed
         if self.manager.latest_step() is None:
             return None
         # step=None takes CheckpointManager's VERIFIED restore path: a
@@ -347,6 +373,16 @@ class TrainLoop:
                          ("num_steps", num_steps),
                          ("checkpoint_every", self.checkpoint_every)):
                 flight_recorder.run_config.setdefault(k, v)
+        if controller is not None and \
+                self.manager.coordinator is not controller:
+            # wire BEFORE resume: periodic saves become fleet-level
+            # two-phase transactions (checkpoint.CheckpointManager
+            # fleet mode) and maybe_resume() runs the restore-step
+            # agreement — every rank loads the same fleet-held step.
+            # Re-binds on a NEW controller too: a second run() with a
+            # fresh attempt's controller must not keep publishing into
+            # the dead attempt's key namespace
+            self.manager.coordinator = controller
         if resume:
             self.maybe_resume()
         self._recoveries_this_run = 0
@@ -443,9 +479,19 @@ class TrainLoop:
                 self.history["preempted_at"] = self.step
                 self.history["preempt_agreed_step"] = ctl.agreed_step
                 self.manager.wait_until_finished()
+                # a rank whose data ran dry BELOW the agreed step is
+                # saving a step its peers will never stage: stage it
+                # locally only, and announce done FIRST so the peers'
+                # coordinated save at the agreed step doesn't hold for
+                # this rank either
+                below = (ctl.agreed_step is not None
+                         and self.step < ctl.agreed_step)
+                if below:
+                    ctl.note_done(self.step)
                 if self.step > 0 and \
                         self.step not in self.manager.committed_steps():
-                    self.manager.save(self.step, self.trainer.state())
+                    self.manager.save(self.step, self.trainer.state(),
+                                      coordinate=not below)
                     self.manager.wait_until_finished()
                 ctl.note_checkpoint(self.step)
                 committed = ctl.confirm_committed(self.step)
@@ -711,9 +757,15 @@ class TrainLoop:
         # the next run resumes from the last GOOD checkpoint instead.
         # committed_steps (not all_steps): a torn dir for this step
         # must not satisfy the final-snapshot check
+        # coordinate=False: the completion epilogue stages locally
+        # only — ranks can finish at different final steps, and a
+        # global commit here would hold each for a step its peers
+        # never save (the preempt path's coordinated save already ran
+        # through _commit_preempt; this is a no-op there)
         if self.step > 0 and not self._faulted and \
                 self.step not in self.manager.committed_steps():
-            self.manager.save(self.step, self.trainer.state())
+            self.manager.save(self.step, self.trainer.state(),
+                              coordinate=False)
         self.manager.wait_until_finished()
         if deferred is not None:
             if sys.exc_info()[0] is None:

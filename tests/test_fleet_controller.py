@@ -463,6 +463,49 @@ class TestStatusAndPodz:
         finally:
             srv.stop()
 
+    def test_podz_row_carries_global_commit_columns(self, tmp_path):
+        """Satellite: rank rows show ``last_committed_global`` (and the
+        local staged step) next to the local last-committed step, so
+        commit drift across the fleet is visible at a glance."""
+        from paddle_tpu.telemetry.server import DebugServer
+
+        c0 = _controller(tmp_path, 0, 1)
+        c0.last_global_commit_step = 7
+        c0.last_staged_step = 9
+        s0 = DebugServer(port=0, owned=True).start()
+        try:
+            c0.start()
+            c0.publish_endpoint(s0.host, s0.port)
+            s0.set_fleet(c0.podz)
+            pod = c0.podz()
+            assert pod["last_committed_global"] == 7
+            row = pod["ranks"]["0"]
+            assert row["last_committed_global"] == 7
+            assert row["last_staged_step"] == 9
+            view = c0.statusz()
+            assert view["last_global_commit_step"] == 7
+            assert view["last_staged_step"] == 9
+            assert "last_commit_barrier_s" in view
+        finally:
+            c0.stop()
+            s0.stop()
+
+    def test_commit_lag_gauge_tracks_drift(self, tmp_path):
+        """``pt_checkpoint_commit_lag_steps``: staged-ahead-of-global
+        distance; snaps back to 0 when the fleet commit catches up."""
+        telemetry.enable()
+        try:
+            c0 = _controller(tmp_path, 0, 2)
+            c0.note_stage(5)
+            g = telemetry.registry().get(
+                "pt_checkpoint_commit_lag_steps")
+            assert g is not None and g.value == 5.0
+            c0.transport.put("ckpt.staged.5.1", "5")
+            c0.wait_global_commit(5)
+            assert g.value == 0.0
+        finally:
+            telemetry.disable()
+
     def test_podz_marks_dead_and_unreachable_ranks(self, tmp_path):
         c0 = _controller(tmp_path, 0, 3)
         c0.transport.put("dead.2", "1")

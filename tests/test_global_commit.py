@@ -1,0 +1,623 @@
+"""Step-agreed periodic checkpointing — the two-phase global commit
+(checkpoint.CheckpointManager fleet mode + FleetController's
+``ckpt.staged.<rank>`` / global ``ckpt.committed`` protocol): every
+periodic save is a fleet-level transaction ("all hosts save step N or
+none"), GC never prunes a step a peer is still staging, restore agrees
+on one fleet-held step, dead ranks fail commits fast and typed, and
+the world=1 path is byte-for-byte the plain single-process save."""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import telemetry
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.resilience import (BarrierTimeoutError, FaultInjector,
+                                   FleetController)
+from paddle_tpu.resilience.controller import FileTransport
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _payload(step):
+    return {"w": jnp.full((8, 4), float(step), jnp.float32),
+            "step": jnp.asarray(step, jnp.int32)}
+
+
+def _value(tree):
+    return float(np.asarray(tree["w"])[0, 0])
+
+
+def _ctl(tmp_path, rank, world=2, **kw):
+    kw.setdefault("poll_interval_s", 0.0)
+    kw.setdefault("hold_poll_s", 0.005)
+    kw.setdefault("agree_timeout_s", 5.0)
+    kw.setdefault("ckpt_timeout_s", 5.0)
+    kw.setdefault("dead_grace_s", 0.5)
+    return FleetController(
+        rank=rank, world=world,
+        transport=FileTransport(str(tmp_path / "fleet"), "gc1"), **kw)
+
+
+def _mgr(tmp_path, rank, ctl, **kw):
+    kw.setdefault("max_to_keep", 10)
+    kw.setdefault("async_save", False)
+    return CheckpointManager(str(tmp_path / f"ckpt.{rank}"),
+                             coordinator=ctl, **kw)
+
+
+def _pair(tmp_path, **kw):
+    c0, c1 = _ctl(tmp_path, 0, **kw), _ctl(tmp_path, 1, **kw)
+    return (_mgr(tmp_path, 0, c0), _mgr(tmp_path, 1, c1)), (c0, c1)
+
+
+def _save_both(m0, m1, step, expect_errors=False):
+    """Concurrent coordinated saves (each rank's save holds for the
+    peer's stage, so they must overlap). Returns both ranks' errors."""
+    errs = []
+
+    def run(m):
+        try:
+            m.save(step, _payload(step))
+        except BaseException as e:
+            errs.append(e)
+
+    t = threading.Thread(target=lambda: run(m1),
+                         name="pt-test-gcommit-r1")
+    t.start()
+    try:
+        run(m0)
+    finally:
+        t.join(timeout=30)
+    assert not t.is_alive()
+    if not expect_errors:
+        assert not errs, errs
+    return errs
+
+
+class TestGlobalCommit:
+    def test_both_ranks_land_durable_global_marker(self, tmp_path):
+        (m0, m1), (c0, c1) = _pair(tmp_path)
+        _save_both(m0, m1, 1)
+        for m in (m0, m1):
+            assert m.committed_steps() == [1]
+            assert m.globally_committed_steps() == [1]
+            mark = json.loads(open(os.path.join(
+                m._step_dir(1), "GLOBAL_COMMITTED")).read())
+            assert mark["step"] == 1 and mark["world"] == 2
+            assert m.last_commit_barrier_s is not None
+        # the single transport-level commit marker landed too
+        assert c0.transport.get("ckpt.committed.1") == "1"
+        assert c0.last_global_commit_step == 1
+        assert c1.last_staged_step == 1
+        # and restore trusts it
+        assert _value(m0.restore()) == 1.0
+
+    def test_transport_staged_keys_reclaimed_after_commit(self, tmp_path):
+        """A global commit of N proves every live rank finished every
+        save below it — older STAGED keys (one per step per rank) are
+        reclaimed instead of accumulating forever. The committed
+        markers persist on purpose: they are the durable outcome a
+        late overlapped waiter breaks on after the reclaim."""
+        (m0, m1), (c0, c1) = _pair(tmp_path)
+        _save_both(m0, m1, 1)
+        _save_both(m0, m1, 2)
+        assert c0.transport.get("ckpt.staged.1.0") is None
+        assert c1.transport.get("ckpt.staged.1.1") is None
+        assert c0.transport.get("ckpt.staged.2.0") == "2"
+        # the durable outcome markers survive
+        assert c0.transport.get("ckpt.committed.1") == "1"
+        assert c0.transport.get("ckpt.committed.2") == "2"
+
+    def test_wait_breaks_on_peer_commit_marker_after_reclaim(
+            self, tmp_path):
+        """Review fix: overlapped async saves can reclaim staged keys
+        for an older step right after its commit — a late waiter on
+        that step must break on the PERSISTED ckpt.committed marker,
+        not block the full timeout on the vanished staged keys."""
+        c1 = _ctl(tmp_path, 1, ckpt_timeout_s=30.0)
+        # the peer committed step 4 and already reclaimed its staged
+        # key; only the durable outcome marker remains
+        c1.transport.put("ckpt.committed.4", "4")
+        c1.note_stage(4)
+        t0 = time.monotonic()
+        assert c1.wait_global_commit(4) is not None
+        assert time.monotonic() - t0 < 5.0
+        assert c1.last_global_commit_step == 4
+
+    def test_agreement_seeds_global_commit_view(self, tmp_path):
+        """Review fix: after a resume, the commit-lag gauge must
+        report DRIFT, not the absolute step number — the agreed
+        restore step seeds the global-commit view."""
+        telemetry.enable()
+        try:
+            c0, c1 = _ctl(tmp_path, 0), _ctl(tmp_path, 1)
+            out = {}
+
+            def r1():
+                out["c1"] = c1.agree_restore_step([7])
+
+            t = threading.Thread(target=r1, name="pt-test-seed-r1")
+            t.start()
+            try:
+                out["c0"] = c0.agree_restore_step([7])
+            finally:
+                t.join(timeout=15)
+            assert out == {"c0": 7, "c1": 7}
+            assert c0.last_global_commit_step == 7
+            c0.note_stage(9)
+            g = telemetry.registry().get(
+                "pt_checkpoint_commit_lag_steps")
+            assert g is not None and g.value == 2.0  # 9 - 7, not 9
+        finally:
+            telemetry.disable()
+
+    def test_commit_timeout_is_typed_and_names_missing(self, tmp_path):
+        (m0, _m1), _ = _pair(tmp_path, ckpt_timeout_s=0.3)
+        with pytest.raises(BarrierTimeoutError) as ei:
+            m0.save(3, _payload(3))
+        assert ei.value.missing == [1]
+        assert "ckpt-commit step 3" in str(ei.value)
+        # locally committed (the stage completed) but NEVER trusted
+        # fleet-wide
+        assert m0.committed_steps() == [3]
+        assert m0.globally_committed_steps() == []
+
+    def test_dead_rank_fails_commit_fast_and_typed(self, tmp_path):
+        (m0, _m1), (c0, _c1) = _pair(tmp_path, ckpt_timeout_s=30.0)
+        c0.transport.put("dead.1", "1")
+        t0 = time.monotonic()
+        with pytest.raises(BarrierTimeoutError) as ei:
+            m0.save(1, _payload(1))
+        # FAST: the dead marker (plus its teardown grace) short-
+        # circuits the 30s window
+        assert time.monotonic() - t0 < 10.0
+        assert ei.value.missing == [1]
+        assert "died mid-commit" in str(ei.value)
+        assert m0.globally_committed_steps() == []
+
+    def test_commit_defers_to_inflight_preempt_agreement(self, tmp_path):
+        """Deadlock regression: once a peer publishes the preempt flag
+        and HOLDS in the ack-wait, a rank blocking inside a sync
+        coordinated save could never publish its own ack — the commit
+        wait must defer (stage-only save) so the loop can ack, and the
+        agreement then resolves normally."""
+        (m0, _m1), (c0, c1) = _pair(tmp_path, ckpt_timeout_s=60.0,
+                                    agree_timeout_s=30.0)
+        c1.request()
+        done = {}
+
+        def r1():
+            done["agreed"] = c1.check(4)  # acks 4 + flag, holds
+
+        t = threading.Thread(target=r1, name="pt-test-defer-r1")
+        t.start()
+        try:
+            deadline = time.time() + 5
+            while c0.transport.get("preempt.flag") is None and \
+                    time.time() < deadline:
+                time.sleep(0.005)
+            t0 = time.monotonic()
+            m0.save(1, _payload(1))  # would deadlock without deferral
+            assert time.monotonic() - t0 < 10.0
+            assert m0.committed_steps() == [1]
+            assert m0.globally_committed_steps() == []  # stage-only
+            # the loop's next check acks and the agreement completes
+            assert c0.check(3) == 4
+        finally:
+            t.join(timeout=15)
+        assert not t.is_alive()
+        assert done["agreed"] == 4
+
+    def test_dead_rank_dropped_after_agreement(self, tmp_path):
+        """Once the preempt agreement resolved (the fleet already
+        dropped the corpse), the survivors' FINAL coordinated save
+        commits among the live ranks — the elastic N-1 restart resumes
+        from exactly this checkpoint."""
+        (m0, _m1), (c0, _c1) = _pair(tmp_path)
+        c0.transport.put("dead.1", "1")
+        c0.request()
+        assert c0.check(6) == 6  # agreement among live = {0}
+        m0.save(6, _payload(6))  # commits without the dead rank
+        assert m0.globally_committed_steps() == [6]
+
+    def test_done_rank_is_dropped_from_commit(self, tmp_path):
+        """A rank that cleanly exhausted its data (done marker) will
+        never stage again — the survivor's periodic saves must keep
+        committing instead of timing out on it."""
+        (m0, _m1), (c0, c1) = _pair(tmp_path)
+        c1.note_done(5)
+        m0.save(6, _payload(6))  # no hold: live set is effectively {0}
+        assert m0.globally_committed_steps() == [6]
+
+    def test_async_coordinated_save_does_not_block_caller(self, tmp_path):
+        """The whole transaction rides the writer thread: save()
+        returns while the peer is still staging, and the global marker
+        lands at join time."""
+        c0, c1 = _ctl(tmp_path, 0), _ctl(tmp_path, 1)
+        m0 = _mgr(tmp_path, 0, c0, async_save=True)
+        m1 = _mgr(tmp_path, 1, c1)
+        t0 = time.monotonic()
+        m0.save(1, _payload(1))  # returns immediately, holds in thread
+        assert time.monotonic() - t0 < 2.0
+        m1.save(1, _payload(1))
+        m0.wait_until_finished()
+        assert m0.globally_committed_steps() == [1]
+        assert m1.globally_committed_steps() == [1]
+
+    def test_fleet_async_snapshot_on_caller_thread(self, tmp_path,
+                                                   monkeypatch):
+        """Review fix: the fleet async path must keep save_state's
+        donation-safety contract — the device→host snapshot happens on
+        the CALLER thread before save() returns (the next overlapped
+        step may donate the live buffers); only file IO and the commit
+        barrier ride the writer thread."""
+        import threading as th
+
+        import jax
+
+        seen = []
+        orig = jax.device_get
+
+        def spy(x):
+            seen.append(th.current_thread().name)
+            return orig(x)
+
+        monkeypatch.setattr(jax, "device_get", spy)
+        c0, c1 = _ctl(tmp_path, 0), _ctl(tmp_path, 1)
+        m0 = _mgr(tmp_path, 0, c0, async_save=True)
+        m1 = _mgr(tmp_path, 1, c1)
+        m0.save(1, _payload(1))
+        main = th.current_thread().name
+        assert seen and all(s == main for s in seen), seen
+        m1.save(1, _payload(1))
+        m0.wait_until_finished()
+        assert m0.globally_committed_steps() == [1]
+
+    def test_async_commit_timeout_surfaces_at_join(self, tmp_path):
+        c0 = _ctl(tmp_path, 0, ckpt_timeout_s=0.3)
+        m0 = _mgr(tmp_path, 0, c0, async_save=True)
+        m0.save(2, _payload(2))
+        with pytest.raises(BarrierTimeoutError):
+            m0.wait_until_finished()
+
+
+class TestFleetGC:
+    def test_never_prunes_step_a_peer_is_still_staging(self, tmp_path):
+        """THE multi-host max_to_keep=1 hazard (satellite fix): rank 0
+        reaches step 2 and saves while rank 1 is still staging — the
+        only globally-committed step (1) must survive rank 0's
+        retention pass, or a crash now leaves NO restorable fleet
+        state."""
+        (m0, m1), _ = _pair(tmp_path)
+        m0.max_to_keep = m1.max_to_keep = 1
+        _save_both(m0, m1, 1)
+        root = str(tmp_path / "fleet")
+
+        def r0():
+            m0.save(2, _payload(2))  # holds for rank 1's stage
+
+        t = threading.Thread(target=r0, name="pt-test-gc-r0")
+        t.start()
+        try:
+            # rank 0 is mid-transaction: staged 2, waiting on rank 1
+            deadline = time.time() + 5
+            while not os.path.exists(os.path.join(
+                    root, "gc1.ckpt.staged.2.0")) and \
+                    time.time() < deadline:
+                time.sleep(0.005)
+            # the hazard moment: step 1 must still be on disk
+            assert os.path.isdir(m0._step_dir(1))
+            assert m0.globally_committed_steps() == [1]
+            m1.save(2, _payload(2))  # rank 1 catches up; commit lands
+        finally:
+            t.join(timeout=30)
+        assert not t.is_alive()
+        # NOW retention may prune step 1 (strictly older than the
+        # newest globally-committed step on both ranks)
+        m0._gc()
+        m1._gc()
+        for m in (m0, m1):
+            assert m.globally_committed_steps() == [2]
+            assert not os.path.exists(m._step_dir(1))
+            assert _value(m.restore()) == 2.0
+
+    def test_nothing_pruned_before_first_global_commit(self, tmp_path):
+        (m0, _m1), _ = _pair(tmp_path, ckpt_timeout_s=0.2)
+        m0.max_to_keep = 1
+        for s in (1, 2):
+            with pytest.raises(BarrierTimeoutError):
+                m0.save(s, _payload(s))
+        # both stages locally committed, neither global: prune NOTHING
+        assert m0.committed_steps() == [1, 2]
+
+    def test_torn_stage_below_global_floor_is_swept(self, tmp_path):
+        (m0, m1), _ = _pair(tmp_path)
+        # torn litter from a dead save below the (future) global floor
+        os.makedirs(m0._step_dir(0) + ".tmp")
+        _save_both(m0, m1, 1)
+        m0._gc()
+        assert not os.path.exists(m0._step_dir(0) + ".tmp")
+
+    def test_old_trash_recovered_not_erased(self, tmp_path):
+        """Fleet GC honors the same mid-rename-swap recovery contract
+        as the single-process GC: a .old dir holding the step's only
+        copy is put back."""
+        (m0, m1), _ = _pair(tmp_path)
+        _save_both(m0, m1, 1)
+        _save_both(m0, m1, 2)
+        os.rename(m0._step_dir(2), m0._step_dir(2) + ".old")
+        m0._gc()
+        assert m0.committed_steps() == [1, 2]
+
+
+class TestRestoreAgreement:
+    def test_newest_common_step_wins(self, tmp_path):
+        (m0, m1), (c0, c1) = _pair(tmp_path)
+        _save_both(m0, m1, 1)
+        # rank 0 ran ahead with a stage-only (uncoordinated) save
+        m0.save(2, _payload(2), coordinate=False)
+        out = {}
+
+        def r1():
+            out["c1"] = c1.agree_restore_step(m1.committed_steps())
+
+        t = threading.Thread(target=r1, name="pt-test-agree-r1")
+        t.start()
+        try:
+            out["c0"] = c0.agree_restore_step(m0.committed_steps())
+        finally:
+            t.join(timeout=15)
+        # 2 is NOT common (rank 1 never staged it): the fleet restores 1
+        assert out == {"c0": 1, "c1": 1}
+
+    def test_common_stage_only_step_promoted_and_restored(self, tmp_path):
+        """Crash between everyone staging and the durable marker
+        landing: both ranks hold step 1 locally committed with NO
+        global marker on disk — the restarted attempt's agreement
+        proves it fleet-held, promotes it, and restores it (the
+        mid-commit kill recovery path)."""
+        (m0, m1), _ = _pair(tmp_path)
+        inj = FaultInjector().on("ckpt.commit", times=99)
+        with inj:
+            errs = _save_both(m0, m1, 1, expect_errors=True)
+        assert len(errs) == 2  # both durable-marker writes torn
+        for m in (m0, m1):
+            assert m.committed_steps() == [1]
+            assert m.globally_committed_steps() == []
+        # the restarted attempt: fresh controllers, fresh run
+        # namespace (the old transport state died with the job)
+        d0 = FleetController(
+            rank=0, world=2, hold_poll_s=0.005, agree_timeout_s=5.0,
+            transport=FileTransport(str(tmp_path / "fleet"), "gc2"))
+        d1 = FleetController(
+            rank=1, world=2, hold_poll_s=0.005, agree_timeout_s=5.0,
+            transport=FileTransport(str(tmp_path / "fleet"), "gc2"))
+        out = {}
+
+        def r1():
+            out["c1"] = d1.agree_restore_step(m1.committed_steps())
+
+        t = threading.Thread(target=r1, name="pt-test-promote-r1")
+        t.start()
+        try:
+            out["c0"] = d0.agree_restore_step(m0.committed_steps())
+        finally:
+            t.join(timeout=15)
+        assert out == {"c0": 1, "c1": 1}
+        for m in (m0, m1):
+            m.promote_global(1)
+            assert m.globally_committed_steps() == [1]
+            assert _value(m.restore()) == 1.0
+
+    def test_stale_newer_global_marker_demoted_at_resume(self, tmp_path):
+        """Review fix: a dead attempt's leftover GLOBAL marker above
+        the agreed step would poison the fleet GC floor (fresh commits
+        pruned as 'strictly older than stale') — align_global demotes
+        it while keeping the local data."""
+        (m0, m1), _ = _pair(tmp_path)
+        m0.max_to_keep = m1.max_to_keep = 1
+        _save_both(m0, m1, 1)
+        # stale fleet-trust from a dead attempt on rank 0 only
+        m0.save(100, _payload(100), coordinate=False)
+        m0.promote_global(100)
+        m0.align_global(1)
+        m1.align_global(1)
+        assert m0.globally_committed_steps() == [1]
+        assert 100 in m0.committed_steps()  # data kept, trust removed
+        # fresh commits now survive their own GC pass
+        _save_both(m0, m1, 2)
+        for m in (m0, m1):
+            assert 2 in m.globally_committed_steps()
+            assert os.path.isdir(m._step_dir(2))
+
+    def test_align_global_cold_start_demotes_everything(self, tmp_path):
+        (m0, m1), _ = _pair(tmp_path)
+        _save_both(m0, m1, 3)
+        m0.align_global(None)
+        assert m0.globally_committed_steps() == []
+        assert m0.committed_steps() == [3]
+
+    def test_no_common_step_is_consistent_cold_start(self, tmp_path):
+        (m0, m1), (c0, c1) = _pair(tmp_path)
+        m0.save(1, _payload(1), coordinate=False)  # rank 1 has nothing
+        out = {}
+
+        def r1():
+            out["c1"] = c1.agree_restore_step(m1.committed_steps())
+
+        t = threading.Thread(target=r1, name="pt-test-cold-r1")
+        t.start()
+        try:
+            out["c0"] = c0.agree_restore_step(m0.committed_steps())
+        finally:
+            t.join(timeout=15)
+        assert out == {"c0": None, "c1": None}
+
+    def test_empty_local_list_returns_without_holding(self, tmp_path):
+        c1 = _ctl(tmp_path, 1, agree_timeout_s=30.0)
+        t0 = time.monotonic()
+        assert c1.agree_restore_step([]) is None
+        assert time.monotonic() - t0 < 2.0  # no wait on the peer
+
+
+class TestTrainLoopIntegration:
+    def test_dry_rank_below_agreed_step_does_not_stall_fleet(
+            self, tmp_path):
+        """Review fix: a rank whose data runs dry BELOW the agreed
+        preempt step saves stage-only and announces done — its peers'
+        coordinated save at the agreed step must not hold for a step
+        the dry rank will never stage (previously a fleet-wide double
+        ckpt_timeout stall)."""
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from test_resilience import batches, make_loop
+
+        c0 = _ctl(tmp_path, 0, poll_interval_s=0.01,
+                  agree_timeout_s=30.0, ckpt_timeout_s=30.0)
+        c1 = _ctl(tmp_path, 1, poll_interval_s=0.01,
+                  agree_timeout_s=30.0, ckpt_timeout_s=30.0)
+        loop0 = make_loop(tmp_path / "ckpt0", checkpoint_every=1000)
+        loop1 = make_loop(tmp_path / "ckpt1", checkpoint_every=1000)
+        err = []
+
+        def rank1():
+            try:
+                # only 5 batches: rank 1 runs dry below the agreed step
+                loop1.run(batches(5), controller=c1)
+            except BaseException as e:
+                err.append(e)
+
+        t = threading.Thread(target=rank1, name="pt-test-dry-r1")
+
+        def on_step(step, loss, metrics):
+            if step == 2:
+                t.start()
+            if step == 8:
+                c0.request()
+
+        t0 = time.monotonic()
+        loop0.run(batches(4000), on_step=on_step, controller=c0)
+        t.join(timeout=90)
+        assert not t.is_alive()
+        assert not err, f"rank 1 failed: {err}"
+        # bounded: no ckpt_timeout stall anywhere near the 30s windows
+        assert time.monotonic() - t0 < 25.0
+        assert loop0.status == "preempted"
+        agreed = c0.agreed_step
+        assert agreed is not None and agreed >= 8
+        # rank 0 committed the agreed step WITHOUT holding for rank 1
+        assert loop0.manager.globally_committed_steps() == [agreed]
+        # the dry rank staged its final step locally and announced done
+        assert loop1.status in ("preempted", "completed")
+        assert loop1.manager.committed_steps()
+        assert c0.transport.get("done.1") is not None
+
+
+class TestFaultPoints:
+    def test_stage_fault_tears_the_transaction(self, tmp_path):
+        (m0, _m1), _ = _pair(tmp_path)
+        inj = FaultInjector().on("ckpt.stage", times=99)
+        with inj:
+            with pytest.raises(OSError):
+                m0.save(1, _payload(1))
+        assert inj.fired["ckpt.stage"] > 0
+        # the local stage is on disk; the fleet never trusted it
+        assert m0.committed_steps() == [1]
+        assert m0.globally_committed_steps() == []
+
+    def test_commit_fault_leaves_durable_marker_off(self, tmp_path):
+        (m0, m1), (c0, _c1) = _pair(tmp_path)
+        inj = FaultInjector().on("ckpt.commit", times=99,
+                                 match="ckpt.0")
+        with inj:
+            errs = _save_both(m0, m1, 1, expect_errors=True)
+        # rank 0's durable marker write was torn AFTER the transport
+        # commit: rank 1 trusts the step, rank 0's disk does not (the
+        # restore agreement reconciles via promotion)
+        assert inj.fired["ckpt.commit"] > 0
+        assert m0.globally_committed_steps() == []
+        assert m1.globally_committed_steps() == [1]
+        assert c0.transport.get("ckpt.committed.1") == "1"
+        assert len(errs) == 1  # rank 1 unaffected
+        assert isinstance(errs[0], OSError)
+        m0.promote_global(1)
+        assert m0.globally_committed_steps() == [1]
+
+    def test_transient_transport_put_fault_absorbed(self, tmp_path):
+        """Every KV op on the commit path rides the bounded transport
+        retry policy: two transient put failures cost backoff, not the
+        transaction."""
+        c0 = _ctl(tmp_path, 0)
+        fails = [2]
+        orig = c0.transport.put
+
+        def flaky(key, value):
+            if fails[0] > 0:
+                fails[0] -= 1
+                raise OSError("transient KV blip")
+            orig(key, value)
+
+        c0.transport.put = flaky
+        c0.note_stage(4)
+        assert fails[0] == 0
+        assert c0.transport.get("ckpt.staged.4.0") == "4"
+
+
+class TestWorldOneFastPath:
+    def _dir_digest(self, d):
+        out = {}
+        for name in sorted(os.listdir(d)):
+            with open(os.path.join(d, name), "rb") as f:
+                out[name] = hashlib.sha256(f.read()).hexdigest()
+        return out
+
+    def test_byte_for_byte_plain_save_and_zero_transport_io(
+            self, tmp_path):
+        """world=1 with a controller attached is EXACTLY the existing
+        single-process save: same file set, same bytes, no
+        GLOBAL_COMMITTED marker, zero transport IO (test-pinned)."""
+        calls = []
+
+        class SpyTransport:
+            kind = "file"
+
+            def put(self, key, value):
+                calls.append(("put", key))
+
+            def get(self, key):
+                calls.append(("get", key))
+                return None
+
+            def sweep(self):
+                return 0
+
+        ctl = FleetController(rank=0, world=1,
+                              transport=SpyTransport())
+        plain = CheckpointManager(str(tmp_path / "plain"),
+                                  async_save=False)
+        fleet = CheckpointManager(str(tmp_path / "fleet1"),
+                                  async_save=False, coordinator=ctl)
+        plain.save(1, _payload(1))
+        fleet.save(1, _payload(1))
+        d0 = self._dir_digest(plain._step_dir(1))
+        d1 = self._dir_digest(fleet._step_dir(1))
+        assert d0 == d1  # identical names AND identical bytes
+        assert "GLOBAL_COMMITTED" not in d1
+        assert calls == []  # zero transport IO
+        assert fleet.latest_step() == 1
+        assert _value(fleet.restore()) == 1.0
+        assert calls == []

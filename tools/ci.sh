@@ -63,6 +63,12 @@ case "$MODE" in
 ONE merged cross-process chrome-trace with a shared trace id)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py \
       -q -m chaos || exit $?
+    stage "dist smoke (REAL 2-process jax.distributed job: preempt \
+agreement + a step-agreed periodic save, both over the LIVE \
+ClientTransport KV — not the file fallback)"
+    JAX_PLATFORMS=cpu python -m pytest \
+      "tests/test_dist_fleet_transport.py::\
+test_dist_smoke_agreement_and_step_agreed_save" -q || exit $?
     stage "multichip dryrun (8-device CPU sim)"
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
