@@ -887,12 +887,15 @@ def _router_replica_spec(smoke=False, kv_dtype=None, slots=4,
 
 
 def _open_loop(router, prompts, max_new: int, rate_rps: float,
-               rng, timeout_s: float = 900.0):
+               rng, timeout_s: float = 900.0, stream: bool = False):
     """Seeded Poisson OPEN-loop load: arrivals are exponential gaps at
     ``rate_rps`` independent of completions (the closed-loop bench
     hides queueing collapse; open-loop is how serving studies measure
     TTFT under load). Returns (tickets, wall_s) with wall measured
-    submit-of-first to completion-of-last non-shed request."""
+    submit-of-first to completion-of-last non-shed request.
+    ``stream=True`` submits streaming tickets — TTFT is then the
+    router-side FIRST-TOKEN stamp, and the client-side inter-token
+    gaps land on the tickets via :func:`_drain_streams`."""
     gaps = rng.exponential(1.0 / rate_rps, size=len(prompts))
     arrivals = np.cumsum(gaps)
     t0 = time.perf_counter()
@@ -900,9 +903,29 @@ def _open_loop(router, prompts, max_new: int, rate_rps: float,
     for i, p in enumerate(prompts):
         while time.perf_counter() - t0 < arrivals[i]:
             time.sleep(0.0005)
-        tickets.append(router.submit(p, max_new, session=f"s{i}"))
+        tickets.append(router.submit(p, max_new, session=f"s{i}",
+                                     stream=stream))
     router.wait(tickets, timeout=timeout_s)
-    return tickets, time.perf_counter() - t0
+    wall = time.perf_counter() - t0
+    if stream:
+        _drain_streams(tickets)
+    return tickets, wall
+
+
+def _drain_streams(tickets):
+    """Read each streamed ticket's client records and REPLACE its
+    ``itl_p99_s`` with the CLIENT-side inter-token gap p99 (arrival
+    stamps at the router fan-in — the latency a streaming consumer
+    actually experiences, network hop included), so ``_arm_stats``
+    reports streaming ITL from the same field."""
+    for t in tickets:
+        if t.shed or t.stream is None:
+            continue
+        stamps = [r["t"] for r in t.stream
+                  if r.get("t") is not None and "i" in r]
+        gaps = (np.diff(np.asarray(stamps)) if len(stamps) > 1
+                else np.asarray([0.0]))
+        t.itl_p99_s = float(np.quantile(gaps, 0.99))
 
 
 def _arm_stats(tickets, wall_s: float, short_lt=None):
@@ -938,7 +961,8 @@ def _arm_stats(tickets, wall_s: float, short_lt=None):
 def bench_gpt_router(steps: int, batch_size: int, amp=None,
                      smoke: bool = False, replicas: int = 2,
                      prefill_workers: int = 1, overload: float = 2.0,
-                     kv_dtype=None, router_procs: bool = False):
+                     kv_dtype=None, router_procs: bool = False,
+                     stream: bool = False):
     """Production-serving A/B (serving_router.Router): a seeded Poisson
     OPEN-loop load with long prompts mixed in, three arms on the same
     replicas —
@@ -1035,29 +1059,40 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
         # baseline arm still drains
         rate = 0.85 * cal_rps
 
-        # arms 1+2 INTERLEAVED in alternating blocks (mono, disagg,
-        # mono, disagg) over the same replicas: both arms sample the
-        # same machine-load epochs, so slow background drift between
-        # two sequentially-timed arms can't masquerade as (or mask)
-        # the disaggregation effect
+        # arms 1+2 (+ the streaming arm) INTERLEAVED in alternating
+        # blocks over the same replicas: every arm samples the same
+        # machine-load epochs, so slow background drift between
+        # sequentially-timed arms can't masquerade as (or mask) the
+        # disaggregation/streaming effect
         mono_router = Router(reps[:1], poll_interval_s=0.02)
         head_router = Router(reps, prefill_workers=pfs,
                              disagg_min_tokens=disagg_min,
                              poll_interval_s=0.02)
-        arm_tickets = {"mono": [], "head": []}
-        arm_wall = {"mono": 0.0, "head": 0.0}
+        cycle = (("mono", "head", "stream") * 2 if stream
+                 else ("mono", "head", "mono", "head"))
+        n_arms = len(set(cycle))
+        arm_tickets = {a: [] for a in set(cycle)}
+        arm_wall = {a: 0.0 for a in set(cycle)}
         half = max(6, n_req // 2)
-        for b, arm in enumerate(("mono", "head", "mono", "head")):
+        for b, arm in enumerate(cycle):
             router = mono_router if arm == "mono" else head_router
+            # prompt seed advances per ROUND (b // n_arms), so every
+            # arm samples the IDENTICAL prompt sets — a seed-dependent
+            # long-prompt skew can't masquerade as an arm effect
             tickets, wall = _open_loop(
-                router, mk_prompts(half, 10 + b // 2), max_new, rate,
-                np.random.default_rng(100 + b))
+                router, mk_prompts(half, 10 + b // n_arms), max_new,
+                rate, np.random.default_rng(100 + b),
+                stream=(arm == "stream"))
             arm_tickets[arm].extend(tickets)
             arm_wall[arm] += wall
         mono = _arm_stats(arm_tickets["mono"], arm_wall["mono"],
                           short_lt=disagg_min)
         head = _arm_stats(arm_tickets["head"], arm_wall["head"],
                           short_lt=disagg_min)
+        stream_arm = (_arm_stats(arm_tickets["stream"],
+                                 arm_wall["stream"],
+                                 short_lt=disagg_min)
+                      if stream else None)
         mono_router.close()
         head_router.close()
 
@@ -1091,7 +1126,102 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
         "overload_shed_rate": over["shed_rate"],
         "overload_tokps": over["tokps"],
     })
+    if stream_arm is not None:
+        # the streaming arm, one column family apart: TTFT here is the
+        # router-side FIRST-TOKEN stamp and ITL the client-side
+        # inter-token gaps (_drain_streams) — same load, same replicas
+        extras.update({
+            "stream_ttft_p50_ms": stream_arm["ttft_p50_ms"],
+            "stream_ttft_p99_ms": stream_arm["ttft_p99_ms"],
+            "stream_ttft_short_mean_ms":
+                stream_arm.get("ttft_short_mean_ms"),
+            "stream_itl_p99_ms": stream_arm["itl_p99_ms"],
+            "stream_tokps": stream_arm["tokps"],
+        })
+        # shared-system-prompt routing A/B (in-process by design: the
+        # signal is the ROUTING logic's hit rate, counter-verified
+        # from pool stats, not a transport latency)
+        extras.update(_prefix_routing_ab())
     return extras.pop("tokps"), "tokens/sec", extras
+
+
+def _prefix_routing_ab(seed: int = 0, n_req: int = 12):
+    """Shared-system-prompt routing A/B: the SAME workload (two
+    64-token system prompts, each carried by several requests) against
+    prefix-hash routing vs session-only affinity, over 2 fresh
+    prefix-cache replicas per arm. The reported hit rates are
+    COUNTER-VERIFIED from the replicas' own pool stats
+    (``decoder.prefix_hits`` / ``prefix_lookups``), never inferred
+    from routing decisions.
+
+    Determinism: the session arm pre-pins its sessions with a blocking
+    wave of 2 x slots unique requests (slot caps force an exact split
+    — the best session-only routing can do), and every session serves
+    BOTH system prompts over the run, so ANY 2/2 session split makes
+    both replicas prefill both prefixes: misses = 2 per prefix. The
+    hash arm's fresh-session requests follow the prefix home: misses
+    = 1 per prefix. Strictly higher hit rate, by construction."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.serving import BatchedDecoder
+    from paddle_tpu.serving_router import LocalReplica, Router
+
+    rng = np.random.default_rng(seed)
+    sys_prompts = [rng.integers(1, 500, (64,)).astype(np.int32)
+                   for _ in range(2)]
+    suffixes = [rng.integers(1, 500, (8,)).astype(np.int32)
+                for _ in range(n_req)]
+    seeds_p = [rng.integers(1, 500, (8,)).astype(np.int32)
+               for _ in range(4)]
+    # every session meets every prefix: (session i%4, prefix pattern
+    # that rotates) — see docstring
+    pattern = [(i % 4, (i + i // 4) % 2) for i in range(n_req)]
+
+    def mk_replicas():
+        reps = []
+        for i in range(2):
+            pt.seed(0)
+            m = G.GPTForCausalLM(G.GPTConfig.tiny()).eval()
+            d = BatchedDecoder(m, slots=2, capacity=192, pages=24,
+                               page_size=64, prefix_cache=True)
+            reps.append(LocalReplica(d, name=f"p{i}").start())
+        for rep in reps:
+            rep.warmup()
+        return reps
+
+    out = {}
+    for arm, pht in (("hash", 64), ("session", None)):
+        reps = mk_replicas()
+        router = Router(reps, poll_interval_s=0.02,
+                        prefix_hash_tokens=pht,
+                        disagg_min_tokens=None)
+        try:
+            if arm == "session":
+                seeds = [router.submit(seeds_p[j], 2, session=f"s{j}")
+                         for j in range(4)]
+                router.wait(seeds, timeout=300)
+            base_h = sum(r.decoder.prefix_hits for r in reps)
+            base_l = sum(r.decoder.prefix_lookups for r in reps)
+            for i, (sess_i, pfx_i) in enumerate(pattern):
+                p = np.concatenate([sys_prompts[pfx_i], suffixes[i]])
+                sess = (f"s{sess_i}" if arm == "session"
+                        else f"fresh{i}")
+                # sequential on purpose: the measured quantity is the
+                # hit RATE, and concurrent same-prefix admissions
+                # can't hit a registry that fills at completion
+                router.submit(p, 4, session=sess).wait(300)
+            hits = sum(r.decoder.prefix_hits for r in reps) - base_h
+            lookups = (sum(r.decoder.prefix_lookups for r in reps)
+                       - base_l)
+            out[f"prefix_hits_{arm}"] = int(hits)
+            out[f"prefix_lookups_{arm}"] = int(lookups)
+            out[f"prefix_hit_rate_{arm}"] = round(
+                hits / max(1, lookups), 4)
+        finally:
+            router.close()
+            for rep in reps:
+                rep.close()
+    return out
 
 
 def _kv_serve_density(model, cap: int, smoke: bool):
@@ -2027,6 +2157,12 @@ def main():
                     action="store_true",
                     help="--router: replicas as real worker processes "
                     "over HTTP instead of in-process threads")
+    ap.add_argument("--stream", action="store_true",
+                    help="--router: add the per-token STREAMING arm "
+                    "(router-side first-token TTFT + client-side "
+                    "inter-token-latency columns) and the "
+                    "prefix-hash vs session-only routing hit-rate "
+                    "A/B to the same JSON line")
     ap.add_argument("--prefill-chunk", dest="prefill_chunk", type=int,
                     default=None,
                     help="gpt_serve: chunked prefill — C prompt tokens "
@@ -2091,6 +2227,11 @@ def main():
     global _MODE
     _MODE = "infer" if args.infer else "train"
     fn = MODELS[args.model]
+    if args.stream and not args.router:
+        _emit_error(f"{args.model}_throughput",
+                    "--stream only applies with --router "
+                    "(gpt_serve streaming arm)")
+        return
     if args.router:
         if args.model != "gpt_serve":
             _emit_error(f"{args.model}_throughput",
@@ -2106,6 +2247,10 @@ def main():
         metric += f"_router{args.replicas}"
         if args.router_procs:
             metric += "_procs"
+        if args.stream:
+            # the streaming arm changes the measured columns (stream
+            # TTFT/ITL + the prefix-routing A/B): its own history key
+            metric += "_stream"
     if (args.vocab and "vocab" in sig
             and args.vocab != sig["vocab"].default):
         metric += f"_v{args.vocab}"
@@ -2316,6 +2461,7 @@ def main():
         kwargs["prefill_workers"] = args.prefill_workers
         kwargs["overload"] = args.overload
         kwargs["router_procs"] = args.router_procs
+        kwargs["stream"] = args.stream
     if args.prefill_chunk and "prefill_chunk" in sig:
         kwargs["prefill_chunk"] = args.prefill_chunk
     if (args.decode_steps and args.decode_steps > 1

@@ -32,6 +32,8 @@ PT-LINT-303    Repo lint: unnamed threading.Thread
 PT-LINT-304    Repo lint: device_get result flows into a donating call
 PT-LINT-305    Repo lint: leftover debug hook (jax.debug.print, ...)
 PT-LINT-306    Repo lint: HTTP hop without trace-header propagation
+PT-LINT-307    Repo lint: SSE/chunked writer missing per-event flush
+               or trace-header echo
 =============  ========================================================
 """
 

@@ -49,6 +49,8 @@ LINT_CODES = {
     "PT-LINT-304": "device_get result flows into a donating call",
     "PT-LINT-305": "leftover debug hook",
     "PT-LINT-306": "HTTP hop without trace-header propagation",
+    "PT-LINT-307": "SSE/chunked response writer missing per-event "
+                   "flush or trace-header echo",
 }
 
 # callees whose arguments get donated (this repo's donating entry
@@ -74,6 +76,14 @@ SPAN_NAMES = {"Span", "RecordEvent"}
 TRACE_FILES = ("serving_router.py", "telemetry/server.py")
 TRACE_MARKERS = {"_trace_headers", "trace_headers", "to_header",
                  "from_header"}
+
+# PT-LINT-307 (streaming writers), same file set: a function that
+# emits an SSE/chunked response (it mentions the text/event-stream
+# content type) must FLUSH per event (a token buffered in the server
+# is a token the client doesn't have — the whole point of per-token
+# streaming) and touch the trace-header surface (echo X-PT-Trace) so
+# the stream stays on the request's trace.
+SSE_CONTENT_TYPE = "text/event-stream"
 
 _SUPPRESS_RE = re.compile(
     r"#\s*pt-lint:\s*disable=([A-Za-z0-9\-, ]+?)(?:\s+(.*))?$")
@@ -183,6 +193,30 @@ class _Linter(ast.NodeVisitor):
                 "read headers[tracing.TRACE_HEADER], "
                 "tracing.from_header + tracing.bind around the "
                 "handler dispatch")
+        # PT-LINT-307: an SSE/chunked response writer (it names the
+        # text/event-stream content type) must flush per event and
+        # echo the trace header — a buffered token defeats per-token
+        # streaming, and an unechoed header drops the stream off the
+        # request's trace
+        if self._trace_file and any(
+                isinstance(n, ast.Constant)
+                and isinstance(n.value, str)
+                and SSE_CONTENT_TYPE in n.value
+                for n in ast.walk(node)):
+            terminals, _ = self._scope_calls[-1]
+            if "flush" not in terminals:
+                self._flag(
+                    "PT-LINT-307", node,
+                    f"SSE writer {node.name!r} never flushes: tokens "
+                    f"buffer server-side instead of streaming",
+                    "call wfile.flush() after every data: event")
+            if not self._scope_has_trace_marker():
+                self._flag(
+                    "PT-LINT-307", node,
+                    f"SSE writer {node.name!r} does not propagate the "
+                    f"trace header",
+                    "echo tracing.TRACE_HEADER (ctx.to_header()) onto "
+                    "the streaming response headers")
         self.generic_visit(node)
         self._scope_calls.pop()
         self._devget_names.pop()

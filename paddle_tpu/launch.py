@@ -395,6 +395,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "rate 0..1 (default PT_TRACE_SAMPLE or 1.0); the "
                     "router's /tracez?trace_id= merges each sampled "
                     "request's cross-process timeline")
+    ap.add_argument("--dispatch", default="pull",
+                    choices=("pull", "push"),
+                    help="--serve: pull = replicas pull from the "
+                    "central work-stealing dispatch queue (default); "
+                    "push = legacy least-loaded placement")
+    ap.add_argument("--prefix-hash-tokens", dest="prefix_hash_tokens",
+                    type=int, default=64,
+                    help="--serve: route by a rolling hash of the "
+                    "first N prompt tokens (shared system prompts "
+                    "land on one warm replica's prefix cache; 0 "
+                    "disables)")
     ap.add_argument("script", nargs="?", default=None,
                     help="training script to run per rank (omitted "
                     "with --serve)")
@@ -412,7 +423,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.spec, replicas=args.nproc,
             prefill_workers=args.prefill_workers, port=args.port,
             spec_kw=_json.loads(args.spec_kw) if args.spec_kw else None,
-            log_dir=args.log_dir, trace_sample=args.trace_sample)
+            log_dir=args.log_dir, trace_sample=args.trace_sample,
+            dispatch=args.dispatch,
+            prefix_hash_tokens=args.prefix_hash_tokens or None)
         print(f"[launch] router serving on {router.server.url()} over "
               f"{args.nproc} replica(s) + {args.prefill_workers} "
               f"prefill worker(s)", file=sys.stderr)

@@ -38,6 +38,7 @@ capability).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -50,7 +51,7 @@ from . import telemetry
 from .core.enforce import enforce
 
 __all__ = ["BatchedDecoder", "PagedKVPool", "Request", "KVHandoff",
-           "reject_cause"]
+           "TokenStream", "reject_cause"]
 from .nn.layer import inject_state
 from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
@@ -122,6 +123,14 @@ def _serving_metrics(reg):
         "spec_accept_rate": reg.gauge(
             "pt_serving_spec_accept_rate",
             "mean accepted draft tokens per verify round (0..gamma)"),
+        "streams": reg.counter(
+            "pt_serving_streams_total",
+            "requests served with a per-token stream attached"),
+        "stream_stalled": reg.counter(
+            "pt_stream_stalled_seconds",
+            "cumulative seconds streams spent stalled on a full "
+            "client buffer (the backpressure that pauses a stream, "
+            "never the arena tick)", unit="s"),
     }
 
 
@@ -277,6 +286,205 @@ def reject_cause(cause: str) -> None:
         by.inc()
 
 
+class TokenStream:
+    """Bounded per-client token buffer — the per-token streaming sink.
+
+    Tokens leave the arena the TICK they are sampled (not at request
+    completion): the arena's host loop calls :meth:`offer` with the
+    request's emitted-token list each tick, and records append from the
+    stream's own high-water index while the buffer has room. ``offer``
+    NEVER blocks — a stalled client (full buffer) pauses ITS OWN stream
+    (stall seconds accumulate on ``pt_stream_stalled_seconds``) and the
+    stream catches back up from the same list on a later tick once the
+    client drains; the arena tick cadence is never throttled by any one
+    consumer (pinned by test).
+
+    The router's fan-in pump feeds a CLIENT-side instance through
+    :meth:`put`, which MAY wait (bounded) for room — the pump is a
+    per-request thread, so client backpressure propagates upstream to
+    the replica-side buffer, never to the arena.
+
+    Records are dicts. Tokens: ``{"i": index, "tok": id, "t":
+    perf_counter-or-None}``. Control records ride the same queue and
+    bypass the cap (they are O(retries), not O(tokens)):
+    ``{"event": "resume", "retries": n, ...}`` (replica died mid-stream,
+    the request re-dispatched on a survivor — same trace id, already-
+    delivered tokens stay valid), ``{"event": "end", "n": total}``,
+    ``{"event": "error", "error": repr}`` (typed terminal failure —
+    a client NEVER sees a silent stall). Consume via :meth:`get` or
+    iteration; ``None`` from ``get`` means timeout (stream still live)
+    — iteration ends only at end/error."""
+
+    def __init__(self, maxlen: int = 256):
+        enforce(maxlen >= 1, "stream maxlen must be >= 1, got %s",
+                maxlen)
+        self.maxlen = int(maxlen)
+        self._buf: List[Dict[str, Any]] = []
+        self._cond = threading.Condition()
+        self._src = 0                 # next emitted index to buffer
+        self._final = None            # completion record's token array
+        self._end_sent = False
+        self.closed = False
+        self.error: Optional[BaseException] = None
+        self.stalled_s = 0.0
+        self._stall_t0: Optional[float] = None
+
+    # -- producer side ------------------------------------------------------
+
+    def _note_stall_end(self, now: float) -> None:
+        if self._stall_t0 is not None:
+            d = max(0.0, now - self._stall_t0)
+            self.stalled_s += d
+            self._stall_t0 = None
+            if d and telemetry.enabled():
+                _serving_metrics()["stream_stalled"].inc(d)
+
+    def offer(self, toks, now: Optional[float] = None) -> None:
+        """Arena side: buffer token records for ``toks[src:]`` while
+        the client buffer has room. Never blocks (see class doc)."""
+        if now is None:
+            now = time.perf_counter()
+        with self._cond:
+            if self.closed:
+                return
+            progressed = False
+            while self._src < len(toks) and len(self._buf) < self.maxlen:
+                self._buf.append({"i": self._src,
+                                  "tok": int(toks[self._src]),
+                                  "t": now})
+                self._src += 1
+                progressed = True
+            if self._src < len(toks):
+                if self._stall_t0 is None:
+                    self._stall_t0 = now   # stall starts
+            else:
+                self._note_stall_end(now)
+            if progressed:
+                self._cond.notify_all()
+
+    def put(self, rec: Dict[str, Any],
+            timeout: Optional[float] = None) -> bool:
+        """Pump side: append ONE record, waiting (bounded) for room.
+        Returns False when the stream closed or the wait expired —
+        the caller's signal that the client went away."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while len(self._buf) >= self.maxlen and not self.closed:
+                w = 0.05
+                if deadline is not None:
+                    w = min(w, deadline - time.monotonic())
+                    if w <= 0:
+                        return False
+                t0 = time.monotonic()
+                self._cond.wait(w)
+                d = time.monotonic() - t0
+                self.stalled_s += d
+                if d and telemetry.enabled():
+                    _serving_metrics()["stream_stalled"].inc(d)
+            if self.closed:
+                return False
+            if "i" in rec and int(rec["i"]) < self._src:
+                # already delivered — a finish()-driven tail (or an
+                # earlier pump) outran this record; a lagging pump
+                # near completion must not hand the client the same
+                # index twice. Dropped-as-delivered, not a failure.
+                return True
+            self._buf.append(dict(rec))
+            if "i" in rec:
+                # keep the high-water index in sync so a later
+                # finish() serves only the not-yet-forwarded tail
+                self._src = max(self._src, int(rec["i"]) + 1)
+            self._cond.notify_all()
+            return True
+
+    def control(self, event: str, **kv: Any) -> None:
+        """Append a control record (resume markers and the like) —
+        bypasses the cap so backpressure can't delay the very record
+        that explains the stream's state."""
+        with self._cond:
+            if self.closed:
+                return
+            self._buf.append({"event": event, **kv})
+            self._cond.notify_all()
+
+    def finish(self, result, now: Optional[float] = None) -> None:
+        """Producer epilogue: the request completed with ``result``
+        tokens. Any tokens a stalled client has not buffered yet are
+        served CONSUMER-driven from this record (no producer thread
+        lingers for a slow reader), then the typed end record."""
+        if now is None:
+            now = time.perf_counter()
+        with self._cond:
+            self._note_stall_end(now)
+            self._final = np.asarray(result, np.int32)
+            self._cond.notify_all()
+
+    def fail(self, err: BaseException) -> None:
+        """Terminal failure: the typed error record, then closed —
+        a consumer blocked in ``get`` wakes to it immediately."""
+        with self._cond:
+            self._note_stall_end(time.perf_counter())
+            self.error = err
+            self._buf.append({"event": "error", "error": repr(err)})
+            self.closed = True
+            self._cond.notify_all()
+
+    # -- consumer side ------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return (not self._buf
+                    and (self.closed
+                         or (self._final is not None and self._end_sent
+                             and self._src >= len(self._final))))
+
+    def get(self, timeout: Optional[float] = None):
+        """Next record, or None on timeout (stream still live) or when
+        the stream is fully drained after end/error."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while True:
+                if self._buf:
+                    rec = self._buf.pop(0)
+                    self._cond.notify_all()   # room freed: wake put()
+                    return rec
+                if self._final is not None:
+                    if self._src < len(self._final):
+                        i = self._src
+                        self._src += 1
+                        return {"i": i, "tok": int(self._final[i]),
+                                "t": None}
+                    if not self._end_sent:
+                        self._end_sent = True
+                        self.closed = True
+                        return {"event": "end",
+                                "n": int(len(self._final))}
+                if self.closed:
+                    return None
+                w = 0.1
+                if deadline is not None:
+                    w = min(w, deadline - time.monotonic())
+                    if w <= 0:
+                        return None
+                self._cond.wait(w)
+
+    def __iter__(self):
+        """Yield records until the end/error record has been consumed
+        (the end/error record itself IS yielded)."""
+        while True:
+            rec = self.get(timeout=1.0)
+            if rec is None:
+                if self.done:
+                    return
+                continue
+            yield rec
+            if rec.get("event") in ("end", "error"):
+                return
+
+
 class KVHandoff:
     """Prefilled KV pages + next-token logits for ONE prompt — the
     prefill→decode disaggregation wire unit. A dedicated prefill worker
@@ -386,6 +594,7 @@ class Request:
         self.t_tokens: List[float] = []  # per-token emission stamps
         self.handoff: Optional[KVHandoff] = None  # pre-filled KV pages
         self.trace = None  # TraceContext (telemetry on + traced hop)
+        self.stream: Optional[TokenStream] = None  # per-token sink
 
 
 class BatchedDecoder:
@@ -529,6 +738,7 @@ class BatchedDecoder:
             self.prefix_cache = prefix_cache
             self._prefix_registry: Dict[tuple, np.ndarray] = {}
             self.prefix_hits = 0
+            self.prefix_lookups = 0  # admissions that consulted it
         else:
             enforce(not prefix_cache,
                     "prefix_cache requires paged mode (pages=N)")
@@ -604,11 +814,20 @@ class BatchedDecoder:
 
     # ----- host API --------------------------------------------------------
 
-    def submit(self, prompt_ids, max_new: int) -> int:
+    def submit(self, prompt_ids, max_new: int,
+               stream: Optional[TokenStream] = None) -> int:
+        """Enqueue one request. ``stream=`` attaches a
+        :class:`TokenStream`: tokens leave the arena the tick they are
+        sampled (offered per serving tick) instead of only at
+        completion — the per-token streaming sink."""
         enforce(len(np.asarray(prompt_ids).reshape(-1)) >= 1,
                 "empty prompt")
         enforce(max_new >= 1, "max_new must be >= 1, got %s", max_new)
+        enforce(stream is None or isinstance(stream, TokenStream),
+                "stream= takes a serving.TokenStream, got %s",
+                type(stream).__name__)
         r = Request(self._next_rid, prompt_ids, max_new)
+        r.stream = stream
         # spec/multi-step modes reserve extra positions (see _extra):
         # overrun writes past an unreserved capacity would corrupt K/V
         # below a live cursor (contiguous clamp) or another request's
@@ -629,6 +848,8 @@ class BatchedDecoder:
         r.t_submit = time.perf_counter()
         if telemetry.enabled():
             _serving_metrics()["requests"].inc()
+            if stream is not None:
+                _serving_metrics()["streams"].inc()
             # request-scoped tracing: adopt the caller's bound context
             # (the router's dispatch / the debug server's POST edge
             # binds it) so the whole decode life of this request lands
@@ -818,6 +1039,51 @@ class BatchedDecoder:
         healthy, just not placeable."""
         return self._warmed and not self.preempted
 
+    def warm_step(self) -> None:
+        """EXPLICIT arena warmup: compile + dispatch the decode step
+        executable once over the (idle) arena and mark the replica
+        warmed — no sacrificial decode required. Replaces the old
+        "max_new=2 warmup" workaround (a max_new=1 request finishes at
+        activation without ever dispatching the arena step, and a
+        2-token one burned a decode tick just to touch the
+        executable). Safe on an idle arena: paged cursors are parked
+        past capacity so the junk writes DROP (write_rows' OOB
+        semantics); contiguous junk lands at positions a later prefill
+        fully overwrites and no attention ever reads (nothing is
+        active, and prefill rewrites [0, bucket) wholesale)."""
+        kd = 1 if self.degraded else self.decode_steps
+        step_fn = self._step_fns.get(kd)
+        if step_fn is None:
+            step_fn = self._step_fns[kd] = self._build_multi_step(kd)
+        gens = jnp.asarray(self._slot_gen.astype(np.uint32))
+        if self.paged:
+            self.pools, toks = step_fn(
+                self._mstate, self.pools, jnp.asarray(self.table),
+                self.tok, self.t, gens)
+        else:
+            self.caches, toks = step_fn(
+                self._mstate, self.caches, self.tok, self.t, gens)
+        jax.block_until_ready(toks)
+        if self.draft is not None and not self.degraded:
+            # spec arenas serve through the spec round: warm that
+            # executable too (same idle-arena safety argument; the
+            # draft cache junk is likewise overwritten at prefill)
+            if self._spec_fn is None:
+                self._spec_fn = self._build_spec_step()
+            if self.paged:
+                out = self._spec_fn(self._mstate, self._dstate,
+                                    self.pools, jnp.asarray(self.table),
+                                    self.caches_d, self.tok, self.t,
+                                    gens)
+                self.pools, self.caches_d = out[0], out[1]
+            else:
+                out = self._spec_fn(self._mstate, self._dstate,
+                                    self.caches, None, self.caches_d,
+                                    self.tok, self.t, gens)
+                self.caches, self.caches_d = out[0], out[1]
+            jax.block_until_ready(out[2])
+        self._warmed = True
+
     def set_degraded(self, on: bool) -> None:
         """SLO degrade lever (the router's load-shed precursor): while
         on, every dispatch emits ONE token (decode_steps forced to 1 —
@@ -885,7 +1151,8 @@ class BatchedDecoder:
         finally:
             self._allocator.free(ids)
 
-    def inject_prefilled(self, handoff: KVHandoff, max_new: int) -> int:
+    def inject_prefilled(self, handoff: KVHandoff, max_new: int,
+                         stream: Optional[TokenStream] = None) -> int:
         """Admit a request whose prompt KV arrives PRE-FILLED (a
         :class:`KVHandoff` from a prefill worker): the decode replica
         allocates pages, imports the payload, and activates the slot
@@ -920,11 +1187,17 @@ class BatchedDecoder:
         enforce(need <= al.pages,
                 "request needs %s pages but the pool only has %s",
                 need, al.pages)
+        enforce(stream is None or isinstance(stream, TokenStream),
+                "stream= takes a serving.TokenStream, got %s",
+                type(stream).__name__)
         r.handoff = handoff
+        r.stream = stream
         self._next_rid += 1
         r.t_submit = time.perf_counter()
         if telemetry.enabled():
             _serving_metrics()["requests"].inc()
+            if stream is not None:
+                _serving_metrics()["streams"].inc()
             # the handoff carries its producer's context (in-process
             # disaggregation); an HTTP hop's bound header context wins
             # — both are the same trace when the router did its job
@@ -1177,9 +1450,11 @@ class BatchedDecoder:
         # IMPORTED over the allocated pages, and importing onto pages
         # shared with the registry (or a live request) would corrupt
         # every other holder's KV
-        hit, cached = (self._lookup_prefix(r.prompt)
-                       if self.prefix_cache and r.handoff is None
-                       else (None, 0))
+        if self.prefix_cache and r.handoff is None:
+            self.prefix_lookups += 1
+            hit, cached = self._lookup_prefix(r.prompt)
+        else:
+            hit, cached = None, 0
         if hit is not None:
             # PIN before any eviction: _evict_prefixes may drop the
             # hit's own registry entry, and an unpinned hit would be
@@ -1253,6 +1528,10 @@ class BatchedDecoder:
         self.budget[s] = r.max_new - 1
         self.tok = self.tok.at[s].set(int(tok))
         self.t = self.t.at[s].set(plen)
+        if r.stream is not None:
+            # the first token leaves the arena at activation, not at
+            # completion — the streaming-TTFT edge
+            r.stream.offer(self.emitted[s], r.t_first)
         self._maybe_finish(s)
 
     def _admit(self):
@@ -1466,14 +1745,19 @@ class BatchedDecoder:
         for s in range(self.slots):
             if not was_active[s]:
                 continue
+            r = self.owner[s]
             for j in range(kd):
                 self.emitted[s].append(int(toks[s, j]))
-                self.owner[s].t_tokens.append(now)
+                r.t_tokens.append(now)
                 n_emitted += 1
                 self.budget[s] -= 1
                 self._maybe_finish(s)
                 if not self.active[s]:
                     break
+            if r.stream is not None and r.result is None:
+                # per-tick streaming: this tick's tokens leave NOW
+                # (completion already streamed via finish above)
+                r.stream.offer(self.emitted[s], now)
         if telem and n_emitted:
             m = _serving_metrics()
             m["tokens"].inc(n_emitted)
@@ -1650,14 +1934,17 @@ class BatchedDecoder:
         for s in range(self.slots):
             if not was_active[s]:
                 continue
+            r = self.owner[s]
             for j in range(int(n_np[s]) + 1):
                 self.emitted[s].append(int(emitted[s, j]))
-                self.owner[s].t_tokens.append(now)
+                r.t_tokens.append(now)
                 n_emitted += 1
                 self.budget[s] -= 1
                 self._maybe_finish(s)
                 if not self.active[s]:
                     break
+            if r.stream is not None and r.result is None:
+                r.stream.offer(self.emitted[s], now)
         if telem:
             m = _serving_metrics()
             m["spec_rounds"].inc(int(was_active.sum()))
@@ -1700,6 +1987,10 @@ class BatchedDecoder:
             r.result = np.asarray(self.emitted[s], np.int32)
             r.t_done = time.perf_counter()
             self.done[r.rid] = r
+            if r.stream is not None:
+                # remaining un-buffered tokens serve consumer-driven
+                # from the completion record; then the typed end mark
+                r.stream.finish(r.result, r.t_done)
             if telemetry.enabled():
                 _serving_metrics()["completed"].inc()
                 if r.trace is not None and r.trace.sampled:

@@ -1,17 +1,46 @@
-"""Production serving plane: a multi-replica router over
+"""Production serving plane: a multi-replica STREAMING router over
 ``serving.BatchedDecoder`` arenas — the millions-of-users story on top
 of the single-replica serving runtime.
 
-Three levers, each a tail-latency lever real TPU serving deployments
-win on (cf. the Gemma-on-TPU serving study, PAPERS.md):
+The request path is a streaming data plane (the PR 13 rebuild):
 
-- **Multi-replica routing.** A :class:`Router` spreads sessions over N
-  replicas (in-process :class:`LocalReplica` threads or
-  :class:`HttpReplica` worker processes), health-checked through each
-  replica's existing ``/healthz`` + the new ``/readyz`` readiness
-  split, with LEAST-LOADED placement driven by the same occupancy/
-  queue gauges /statusz already serves, and SESSION AFFINITY so a
-  multi-turn conversation lands where its prefix-cache KV lives.
+- **Per-token streaming.** ``Router.submit(stream=True)`` returns a
+  ticket whose :class:`serving.TokenStream` receives tokens the TICK
+  they are sampled: the arena offers per-tick, the replica serves them
+  as chunked SSE (``POST /stream``, flushed per token, ``X-PT-Trace``
+  echoed — PT-LINT-307), and a per-request fan-in pump forwards them
+  into the client's bounded buffer. The FIRST token stamps the same
+  TTFT histogram the non-streaming path uses, so streaming vs not is
+  one bench column apart; client stalls pause only that client's
+  stream (backpressure never reaches the arena tick loop). A replica
+  death mid-stream surfaces a typed ``resume`` record on the SAME
+  trace id — already-delivered tokens stay valid (greedy re-decode is
+  deterministic; the pump dedupes by token index) — and an all-down
+  fleet surfaces a typed ``error`` record: a client NEVER sees a
+  silent stall.
+
+- **Replica-PULL dispatch (work stealing).** Admitted tickets land on
+  ONE central dispatch queue; ready replicas pull from it (a lane per
+  replica) whenever they have slot headroom. A warming/slow replica
+  simply pulls less — nothing is parked on it by a stale placement
+  guess — and queue depth/wait becomes the shed signal
+  (:class:`SLOPolicy` reads the MEASURED dispatch-queue wait). A
+  replica death re-QUEUES its in-flight tickets rather than
+  re-placing them. ``dispatch="push"`` keeps the PR 10 least-loaded
+  push path for A/B (the bench gates pull's p99 win under one slow
+  replica).
+
+- **Prefix-hash routing.** Tickets carry a rolling hash of their
+  first-N prompt tokens; fleets of sessions sharing a system prompt
+  hash alike and land where that prefix's KV pages already live (the
+  arena's prefix cache) — a SOFT pull-queue hint: the prefix's home
+  replica claims it first, a STARVING replica steals it
+  (``pt_router_steals_total``) and becomes the new home. Session
+  affinity stays the STRONG hint (never stolen while the home is
+  placeable) and both tables are LRU-bounded (the PR 10 unbounded
+  ``_affinity`` leak is closed).
+
+Plus the PR 10 levers, unchanged in spirit:
 
 - **Prefill/decode disaggregation.** Dedicated prefill workers run the
   bucketed prefill and hand the resulting KV pages (float or int8
@@ -55,13 +84,14 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from . import telemetry
 from .core.enforce import EnforceError, enforce
-from .serving import BatchedDecoder, KVHandoff, reject_cause
+from .serving import BatchedDecoder, KVHandoff, TokenStream, reject_cause
 from .telemetry import server as _dbg_server
 from .telemetry import tracing as _tracing
 
@@ -81,7 +111,60 @@ def _trace_headers(base: Dict[str, str]) -> Dict[str, str]:
 
 __all__ = ["Router", "SLOPolicy", "LocalReplica", "HttpReplica",
            "Ticket", "NoReplicasError", "RequestShedError",
-           "spawn_replicas", "serve_main", "main"]
+           "prefix_hash", "spawn_replicas", "serve_main", "main"]
+
+
+def prefix_hash(prompt, n: int) -> Optional[int]:
+    """Rolling hash of the first ``n`` prompt tokens — the prefix-hash
+    routing key. Prompts sharing their first-n tokens (a fleet of
+    sessions on one system prompt) hash alike and route to the replica
+    whose prefix-cache pages already hold that prefix. ``None`` for
+    prompts shorter than ``n``: too short to carry a shared system
+    prompt, and a short-prefix collision would fake affinity."""
+    p = np.asarray(prompt).reshape(-1)
+    if len(p) < n:
+        return None
+    h = 0
+    for t in p[:n]:
+        h = (h * 1000003 + int(t)) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class _LRU:
+    """Bounded touch-ordered map (session-affinity and prefix-home
+    tables): ``get`` touches, ``set`` past the cap evicts the
+    least-recently-used entry — the PR 10 unbounded ``Router._affinity``
+    growth closed at the type. Not thread-safe on its own; callers hold
+    the router lock."""
+
+    def __init__(self, cap: int):
+        enforce(cap >= 1, "LRU cap must be >= 1, got %s", cap)
+        self.cap = int(cap)
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+
+    def get(self, key, default=None):
+        v = self._d.get(key, default)
+        if key in self._d:
+            self._d.move_to_end(key)
+        return v
+
+    def set(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.cap:
+            self._d.popitem(last=False)
+
+    def pop(self, key, default=None):
+        return self._d.pop(key, default)
+
+    def items(self):
+        return list(self._d.items())
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
 
 
 class NoReplicasError(EnforceError):
@@ -127,6 +210,22 @@ def _router_metrics(reg):
         "queue_wait": reg.histogram(
             "pt_router_dispatch_wait_seconds",
             "router submit-to-replica-dispatch wait", unit="s"),
+        "queue_depth": reg.gauge(
+            "pt_router_dispatch_queue_depth",
+            "tickets waiting on the central pull-dispatch queue — "
+            "the shed signal"),
+        "steals": reg.counter(
+            "pt_router_steals_total",
+            "pull dispatches where a starving replica took a ticket "
+            "hinted at another replica (work stealing)"),
+        "itl": reg.histogram(
+            "pt_router_itl_seconds",
+            "router-side inter-token latency under streaming "
+            "(gap between consecutive streamed tokens)", unit="s"),
+        "prefix_ratio": reg.gauge(
+            "pt_router_prefix_cache_hit_ratio",
+            "fleet prefix-cache hit rate: sum(prefix hits) / "
+            "sum(prefix lookups) over live replicas' pool stats"),
     }
 
 
@@ -138,15 +237,17 @@ class SLOPolicy:
     """Deadline/queue-depth admission policy.
 
     Decision inputs: ``in_flight`` (router-tracked dispatched+queued
-    requests), ``slots`` (live replica capacity), and the router's TTFT
-    EWMA. Two ladders, most-degraded wins:
+    requests), ``slots`` (live replica capacity), and a wait estimate.
+    Two ladders, most-degraded wins:
 
     - load factor = in_flight / slots: ``>= degrade_at`` → degrade
       (decode_steps=1, spec off), ``>= shed_at`` → shed. Queue growth
       is the EARLY signal — it predicts TTFT before TTFT blows.
-    - ``target_ttft_s`` (optional): estimated wait (load factor x
-      observed per-request TTFT EWMA) past the target → shed; past
-      half the target → degrade. The deadline side of the policy.
+    - ``target_ttft_s`` (optional): a wait estimate past the target →
+      shed; past half the target → degrade. Under PULL dispatch the
+      estimate is ``queue_wait_s`` — the MEASURED dispatch-queue wait
+      EWMA (a queue property, not a placement guess); the legacy push
+      path estimates load factor x observed TTFT EWMA.
 
     Pure function of its inputs (no clock, no I/O) — the unit tests pin
     the ladder deterministically."""
@@ -161,12 +262,16 @@ class SLOPolicy:
         self.shed_at = float(shed_at)
 
     def admit(self, in_flight: int, slots: int,
-              ewma_ttft_s: Optional[float] = None) -> str:
-        """-> "admit" | "degrade" | "shed" for one arriving request."""
+              ewma_ttft_s: Optional[float] = None,
+              queue_wait_s: Optional[float] = None) -> str:
+        """-> "admit" | "degrade" | "shed" for one arriving request.
+        ``queue_wait_s`` (the measured dispatch-wait EWMA) wins over
+        the ``ewma_ttft_s`` load-factor estimate when both are given."""
         if slots <= 0:
             return "shed"
         lf = in_flight / slots
-        est = lf * ewma_ttft_s if ewma_ttft_s else None
+        est = (queue_wait_s if queue_wait_s is not None
+               else lf * ewma_ttft_s if ewma_ttft_s else None)
         if lf >= self.shed_at or (
                 self.target_ttft_s and est is not None
                 and est > self.target_ttft_s):
@@ -208,6 +313,10 @@ class LocalReplica:
         self.idle_s = idle_s
         self._mu = threading.RLock()
         self._done: Dict[int, Dict[str, Any]] = {}
+        # replica-side per-request token streams (stream=True submits)
+        # keyed by rid until the router's fan-in pump claims them;
+        # bounded so an abandoned stream can't leak forever
+        self._streams: Dict[int, TokenStream] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -232,37 +341,84 @@ class LocalReplica:
         self.stop()
 
     def warmup(self, vocab_hint: int = 8) -> None:
-        """Compile the serving step (and smallest prefill bucket) by
-        driving one 2-token request to completion — a replica warms
-        BEFORE it reports ready, so the router never places a real
-        session onto a cold jit cache. max_new=2 on purpose: a 1-token
-        request finishes at ACTIVATION without ever dispatching the
-        arena step, which would leave the step executable cold (and
-        ``ready`` false forever)."""
+        """Warm the replica BEFORE it reports ready, so the router
+        never places a real session onto a cold jit cache: one 1-token
+        request to completion compiles the prefill bucket + activation
+        (a max_new=1 request finishes AT activation), then the
+        EXPLICIT :meth:`serving.BatchedDecoder.warm_step` compiles and
+        dispatches the arena step executable over the idle arena — no
+        sacrificial decode tick (the old max_new=2 workaround)."""
         rid = self.submit(np.asarray([1, min(2, vocab_hint - 1)],
-                                     np.int32), 2)
+                                     np.int32), 1)
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
             if rid in self.drain_results(keep=True):
-                return
+                break
             if self._thread is None:  # not started: tick inline
                 with self._mu:
                     self._tick_locked()
             else:
                 time.sleep(0.005)
-        raise EnforceError(f"replica {self.name} warmup timed out")
+        else:
+            raise EnforceError(f"replica {self.name} warmup timed out")
+        with self._mu:
+            self.decoder.warm_step()
 
     # -- serving API (router-facing) ----------------------------------------
 
+    def _register_stream(self, rid: int, ts: TokenStream) -> None:
+        self._streams[rid] = ts
+        if len(self._streams) > 1024:  # abandoned-stream bound
+            # prefer evicting streams that already ended (claimed-or-
+            # finished leftovers); a LIVE unclaimed stream goes only
+            # when the map is full of live ones — and then with the
+            # typed error record so a late open_stream/holder sees a
+            # failure, never a silent downgrade
+            for rid_old in list(self._streams):
+                old = self._streams[rid_old]
+                if old.closed or old.done:
+                    del self._streams[rid_old]
+                    if len(self._streams) <= 1024:
+                        return
+            while len(self._streams) > 1024:
+                rid_old = next(iter(self._streams))
+                self._streams.pop(rid_old).fail(EnforceError(
+                    f"stream for rid {rid_old} evicted: replica "
+                    f"stream registry overflow (unclaimed streams)"))
+
     def submit(self, prompt, max_new: int,
-               session: Optional[str] = None) -> int:
+               session: Optional[str] = None,
+               stream: bool = False) -> int:
         with self._mu:
-            return self.decoder.submit(prompt, max_new)
+            if not stream:
+                return self.decoder.submit(prompt, max_new)
+            ts = TokenStream()
+            rid = self.decoder.submit(prompt, max_new, stream=ts)
+            self._register_stream(rid, ts)
+            return rid
 
     def inject(self, handoff: KVHandoff, max_new: int,
-               session: Optional[str] = None) -> int:
+               session: Optional[str] = None,
+               stream: bool = False) -> int:
         with self._mu:
-            return self.decoder.inject_prefilled(handoff, max_new)
+            if not stream:
+                return self.decoder.inject_prefilled(handoff, max_new)
+            ts = TokenStream()
+            rid = self.decoder.inject_prefilled(handoff, max_new,
+                                                stream=ts)
+            self._register_stream(rid, ts)
+            return rid
+
+    def open_stream(self, rid: int):
+        """Claim the replica-side token stream for ``rid`` (one
+        consumer per stream) — an iterator of token/control records.
+        Typed error when no stream was registered for the rid."""
+        with self._mu:
+            ts = self._streams.pop(rid, None)
+        enforce(ts is not None,
+                "no token stream registered for rid %s on replica %s",
+                rid, self.name)
+        return iter(ts)
 
     def prefill(self, prompt) -> KVHandoff:
         with self._mu:
@@ -295,6 +451,11 @@ class LocalReplica:
                    "slots": d.slots}
             if d.paged:
                 out["free_pages"] = d._allocator.free_pages
+                if d.prefix_cache:
+                    # the pool-stat truth the router's fleet hit-rate
+                    # gauge is counter-verified against
+                    out["prefix_hits"] = d.prefix_hits
+                    out["prefix_lookups"] = d.prefix_lookups
             return out
 
     # -- serve loop ---------------------------------------------------------
@@ -375,22 +536,48 @@ class HttpReplica:
             path, json.dumps(obj).encode()).decode())
 
     def submit(self, prompt, max_new: int,
-               session: Optional[str] = None) -> int:
+               session: Optional[str] = None,
+               stream: bool = False) -> int:
         out = self._post_json("/submit", {
             "prompt": np.asarray(prompt, np.int32).tolist(),
-            "max_new": int(max_new)})
+            "max_new": int(max_new), "stream": bool(stream)})
         return int(out["rid"])
 
     def inject(self, handoff: KVHandoff, max_new: int,
-               session: Optional[str] = None) -> int:
-        # wire layout: 8-byte big-endian max_new, then the npz payload
-        # (the npz body is opaque bytes; max_new can't ride inside it
-        # without a second parse, and the stdlib handler drops query
-        # strings before dispatch)
-        body = int(max_new).to_bytes(8, "big") + handoff.to_bytes()
+               session: Optional[str] = None,
+               stream: bool = False) -> int:
+        # wire layout: 8-byte big-endian max_new, 1 flag byte (bit 0 =
+        # stream), then the npz payload (the npz body is opaque bytes;
+        # scalars can't ride inside it without a second parse, and the
+        # stdlib handler drops query strings before dispatch)
+        body = (int(max_new).to_bytes(8, "big")
+                + bytes([1 if stream else 0]) + handoff.to_bytes())
         out = json.loads(self._post(
             "/inject", body, "application/octet-stream").decode())
         return int(out["rid"])
+
+    def open_stream(self, rid: int):
+        """Generator over the worker's ``POST /stream`` SSE events —
+        one token/control record per ``data:`` line, delivered as the
+        worker flushes them (per-token). The trace header rides the
+        request (PT-LINT-306) so replica-side stream spans stay on the
+        request's trace."""
+        req = urllib.request.Request(
+            self.url + "/stream",
+            data=json.dumps({"rid": int(rid)}).encode(),
+            method="POST",
+            headers=_trace_headers(
+                {"Content-Type": "application/json"}))
+
+        def gen():
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                for line in r:
+                    line = line.strip()
+                    if line.startswith(b"data: "):
+                        yield json.loads(line[6:].decode())
+
+        return gen()
 
     def prefill(self, prompt) -> KVHandoff:
         body = self._post("/prefill", json.dumps({
@@ -431,7 +618,14 @@ class HttpReplica:
 class Ticket:
     """One routed request. ``shed=True`` = never dispatched (SLO
     policy); otherwise ``wait()``/``Router.wait`` fills ``tokens`` and
-    the latency fields, or ``error`` when every replica died."""
+    the latency fields, or ``error`` when every replica died.
+
+    Streaming (``Router.submit(stream=True)``): ``stream`` is the
+    client-side :class:`serving.TokenStream` — tokens arrive as the
+    arena samples them, control records mark retries (``resume``) and
+    terminal failure (``error``), and ``ttft_s`` is stamped from the
+    FIRST streamed token (the streaming TTFT edge) instead of the
+    completion record."""
 
     def __init__(self, rid: int, prompt, max_new: int,
                  session: Optional[str]):
@@ -447,6 +641,12 @@ class Ticket:
         self.replica_rid: Optional[int] = None
         self.retries = 0
         self.disaggregated = False
+        self.stolen = False  # pull dispatch ignored a placement hint
+        self.prefix: Optional[int] = None  # prefix-hash routing key
+        self.stream: Optional[TokenStream] = None  # client-side sink
+        self.t_first_stream: Optional[float] = None
+        self._stream_next = 0  # next token index to deliver (dedupe
+        self._pump_gen = 0     # across retries) / live pump generation
         self.tokens: Optional[np.ndarray] = None
         self.ttft_s: Optional[float] = None
         self.itl_p99_s: Optional[float] = None
@@ -470,8 +670,10 @@ class Ticket:
 class _ReplicaState:
     def __init__(self, replica):
         self.replica = replica
+        self.name = replica.name
         self.alive = True
         self.ready = False
+        self.claimed = 0  # pulled off the queue, not yet registered
         self.fails = 0
         self.load: Dict[str, Any] = {"queue_depth": 0,
                                      "active_slots": 0, "slots": 1}
@@ -509,8 +711,22 @@ class Router:
                  max_in_flight: Optional[int] = None,
                  trace_sample: Optional[float] = None,
                  textfile_path: Optional[str] = None,
-                 textfile_interval_s: float = 5.0):
+                 textfile_interval_s: float = 5.0,
+                 dispatch: str = "pull",
+                 pull_lanes: int = 2,
+                 steal_age_s: float = 0.05,
+                 affinity_max_sessions: int = 4096,
+                 prefix_hash_tokens: Optional[int] = 64,
+                 prefix_homes_max: int = 4096,
+                 stream_buffer: int = 256):
         enforce(len(replicas) >= 1, "router needs >= 1 replica")
+        enforce(dispatch in ("pull", "push"),
+                'dispatch must be "pull" (work-stealing replica pull) '
+                'or "push" (legacy least-loaded placement), got %r',
+                dispatch)
+        enforce(prefix_hash_tokens is None or prefix_hash_tokens >= 1,
+                "prefix_hash_tokens must be None or >= 1, got %s",
+                prefix_hash_tokens)
         self._replicas: Dict[str, _ReplicaState] = {}
         for r in replicas:
             enforce(r.name not in self._replicas,
@@ -540,32 +756,67 @@ class Router:
         self._textfile_interval_s = float(textfile_interval_s)
         self._textfile_t = 0.0
         self._mu = threading.RLock()
-        self._affinity: Dict[str, str] = {}
+        # LRU-bounded placement-hint tables (the PR 10 unbounded
+        # _affinity leak): sessions evict least-recently-touched past
+        # the cap, and replica death drops its entries
+        self._affinity = _LRU(affinity_max_sessions)
+        self._prefix_home = _LRU(prefix_homes_max)
+        self.prefix_hash_tokens = prefix_hash_tokens
+        self.stream_buffer = int(stream_buffer)
+        self._dispatch_mode = dispatch
+        # a steal waits this long before ignoring a soft hint: fresh
+        # tickets get their warm home a beat to claim them; anything
+        # older (incl. requeues after a death, whose submit stamp is
+        # old by construction) is immediately stealable
+        self.steal_age_s = float(steal_age_s)
         self._tickets: Dict[int, Ticket] = {}
         self._next_rid = 0
         self._queued = 0            # accepted, not yet dispatched
         self._degraded = False
         self._ewma_ttft: Optional[float] = None
+        self._ewma_wait: Optional[float] = None  # dispatch-queue wait
         self._shed_count = 0
         self._served_count = 0
         self._retry_count = 0
+        self._steal_count = 0
         self._stop = threading.Event()
+        # central pull-dispatch queue (pull mode): replicas CLAIM from
+        # it under self._work; its depth is the shed signal
+        self._pending: "deque[Ticket]" = deque()
+        self._work = threading.Condition(threading.Lock())
         self._dispatch_q: "queue.Queue[Optional[Ticket]]" = queue.Queue()
         self._threads: List[threading.Thread] = []
         self._probe_all()
-        if dispatchers is None:
-            # a dispatcher BLOCKS for the whole synchronous prefill of
-            # a disaggregated request: without a lane per prefill
-            # worker, two long prompts in a row would park every
-            # dispatcher and short requests would queue behind a
-            # prefill — the exact tail disaggregation exists to remove
-            dispatchers = 2 + len(self._prefill)
-        for i in range(max(1, int(dispatchers))):
-            t = threading.Thread(target=self._dispatch_loop,
-                                 daemon=True,
-                                 name=f"pt-router-dispatch-{i}")
-            t.start()
-            self._threads.append(t)
+        if dispatch == "pull":
+            # one pull-worker per (replica, lane): a replica pulls
+            # work whenever IT has slot headroom — a warming or slow
+            # replica simply pulls less, and nothing is parked on it
+            # by a stale placement guess. Two lanes per replica so one
+            # blocking disaggregated prefill can't idle the replica.
+            for name in self._replicas:
+                st = self._replicas[name]
+                for lane in range(max(1, int(pull_lanes))):
+                    t = threading.Thread(
+                        target=self._pull_loop, args=(st,),
+                        daemon=True,
+                        name=f"pt-router-pull-{name}-{lane}")
+                    t.start()
+                    self._threads.append(t)
+        else:
+            if dispatchers is None:
+                # a dispatcher BLOCKS for the whole synchronous prefill
+                # of a disaggregated request: without a lane per prefill
+                # worker, two long prompts in a row would park every
+                # dispatcher and short requests would queue behind a
+                # prefill — the exact tail disaggregation exists to
+                # remove
+                dispatchers = 2 + len(self._prefill)
+            for i in range(max(1, int(dispatchers))):
+                t = threading.Thread(target=self._dispatch_loop,
+                                     daemon=True,
+                                     name=f"pt-router-dispatch-{i}")
+                t.start()
+                self._threads.append(t)
         t = threading.Thread(target=self._poll_loop, daemon=True,
                              name="pt-router-poll")
         t.start()
@@ -576,13 +827,27 @@ class Router:
 
     def submit(self, prompt, max_new: int,
                session: Optional[str] = None,
-               raise_on_shed: bool = False) -> Ticket:
+               raise_on_shed: bool = False,
+               stream: bool = False) -> Ticket:
         """Route one request (non-blocking). SLO shed returns a
         ``shed=True`` ticket (or raises :class:`RequestShedError` when
-        asked); :class:`NoReplicasError` when no replica is alive."""
+        asked); :class:`NoReplicasError` when no replica is alive.
+
+        ``stream=True``: the returned ticket carries a client-side
+        :class:`serving.TokenStream` — tokens arrive per decode tick,
+        the first one stamps ``ttft_s`` and the router TTFT histogram,
+        and terminal failure/retry surface as typed control records on
+        the stream (never a silent stall)."""
         with self._mu:
             t = Ticket(self._next_rid, prompt, max_new, session)
             self._next_rid += 1
+        if stream:
+            t.stream = TokenStream(maxlen=self.stream_buffer)
+        if self.prefix_hash_tokens is not None:
+            # prefix-hash routing key: sessions sharing a system
+            # prompt hash alike and hint at the replica whose prefix
+            # cache already holds those pages
+            t.prefix = prefix_hash(t.prompt, self.prefix_hash_tokens)
         if telemetry.enabled():
             _router_metrics()["requests"].inc()
             # the trace is MINTED here — admission is the one edge
@@ -606,6 +871,12 @@ class Router:
             cause = "shed"
         if cause is not None:
             t.shed = True
+            err = RequestShedError(
+                f"admission rejected ({cause}: "
+                + ("hard in-flight cap reached" if cause == "capacity"
+                   else "SLO load/queue-wait past shed_at") + ")")
+            if t.stream is not None:
+                t.stream.fail(err)  # typed, never a silent stall
             t.done.set()
             with self._mu:
                 self._shed_count += 1
@@ -615,16 +886,20 @@ class Router:
                                rid=t.rid, cause=cause)
             reject_cause(cause)
             if raise_on_shed:
-                raise RequestShedError(
-                    f"admission rejected ({cause}: "
-                    + ("hard in-flight cap reached"
-                       if cause == "capacity"
-                       else "SLO load factor past shed_at") + ")")
+                raise err
             return t
         with self._mu:
             self._tickets[t.rid] = t
             self._queued += 1
-        self._dispatch_q.put(t)
+        if self._dispatch_mode == "pull":
+            with self._work:
+                self._pending.append(t)
+                if telemetry.enabled():
+                    _router_metrics()["queue_depth"].set(
+                        len(self._pending))
+                self._work.notify_all()
+        else:
+            self._dispatch_q.put(t)
         return t
 
     def wait(self, tickets: Sequence[Ticket],
@@ -653,7 +928,25 @@ class Router:
                 "degraded": self._degraded,
                 "ewma_ttft_s": self._ewma_ttft,
                 "affinity_sessions": len(self._affinity),
+                "dispatch": self._dispatch_mode,
+                "dispatch_queue_depth": len(self._pending),
+                "ewma_queue_wait_s": self._ewma_wait,
+                "steals": self._steal_count,
+                "prefix_homes": len(self._prefix_home),
+                "prefix_cache": self._prefix_stats(),
             }
+
+    def _prefix_stats(self) -> Dict[str, Any]:
+        """Fleet prefix-cache hit rate, counter-verified from the
+        replicas' own POOL stats (the load-poll `prefix_hits`/
+        `prefix_lookups` rows), never inferred from routing
+        decisions."""
+        hits = lookups = 0
+        for st in self._replicas.values():
+            hits += int(st.load.get("prefix_hits", 0) or 0)
+            lookups += int(st.load.get("prefix_lookups", 0) or 0)
+        return {"hits": hits, "lookups": lookups,
+                "hit_ratio": (hits / lookups if lookups else None)}
 
     def replicaz(self) -> Dict[str, Any]:
         """Per-replica fan-out (the /podz pattern over serving
@@ -750,16 +1043,31 @@ class Router:
         srv.set_ready(lambda: bool(self._alive_names()))
         srv.add_post("/submit", self._http_submit)
         srv.add_post("/drain", self._http_drain)
+        srv.add_sse("/stream", self._http_stream)
         self.server = srv.start()
         return self.server
 
     def close(self, replicas: bool = False) -> None:
         self._stop.set()
-        for _ in self._threads:
-            self._dispatch_q.put(None)
+        if self._dispatch_mode == "push":
+            for _ in self._threads:
+                self._dispatch_q.put(None)
+        with self._work:
+            self._work.notify_all()
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []
+        # a silently dropped ticket would hang its waiter: fail
+        # anything still on the central queue typed
+        with self._work:
+            leftover = list(self._pending)
+            self._pending.clear()
+        for t in leftover:
+            with self._mu:
+                self._queued = max(0, self._queued - 1)
+            self._fail_ticket(t, NoReplicasError(
+                f"router closed before request {t.rid} was "
+                "dispatched"))
         if self.server is not None:
             self.server.stop()
             self.server = None
@@ -787,8 +1095,22 @@ class Router:
         req = json.loads(body.decode() or "{}")
         t = self.submit(np.asarray(req["prompt"], np.int32),
                         int(req["max_new"]),
-                        session=req.get("session"))
+                        session=req.get("session"),
+                        stream=bool(req.get("stream")))
         return {"rid": t.rid, "shed": t.shed}
+
+    def _http_stream(self, body: bytes):
+        """Router front-end SSE: fan a streamed ticket's client stream
+        out over HTTP (one consumer per ticket)."""
+        rid = int(json.loads(body.decode() or "{}")["rid"])
+        with self._mu:
+            t = self._tickets.get(rid)
+        enforce(t is not None and t.stream is not None,
+                "no streaming ticket %s (submit with stream=true "
+                "first)", rid)
+        if telemetry.enabled():
+            _tracing.event("stream.open", ctx=t.trace, rid=rid)
+        return iter(t.stream)
 
     def _http_drain(self, body: bytes) -> Dict[str, Any]:
         done = {}
@@ -821,7 +1143,15 @@ class Router:
             slots = sum(st.load.get("slots", 1)
                         for st in self._replicas.values() if st.alive)
             ewma = self._ewma_ttft
-        action = self.policy.admit(in_flight, slots, ewma)
+            wait = self._ewma_wait
+        if self._dispatch_mode == "pull":
+            # the shed signal is the QUEUE: depth rides in_flight, and
+            # the deadline ladder reads the measured dispatch-queue
+            # wait EWMA — a queue property, not a placement guess
+            action = self.policy.admit(in_flight, slots,
+                                       queue_wait_s=wait)
+        else:
+            action = self.policy.admit(in_flight, slots, ewma)
         want_degraded = action in ("degrade", "shed")
         if want_degraded != self._degraded:
             # hysteresis-free toggle is fine: set_degraded is
@@ -867,6 +1197,110 @@ class Router:
             # yet) still places on an alive one rather than failing
             return pick(True) or pick(False)
 
+    def _fail_ticket(self, t: Ticket, err: BaseException) -> None:
+        """Terminal ticket failure — the ONE place a ticket dies, so a
+        streaming client always gets the typed error record (never a
+        silent stall)."""
+        t.error = err
+        if t.stream is not None:
+            t.stream.fail(err)
+        t.done.set()
+
+    # -- pull dispatch (work stealing) --------------------------------------
+
+    def _hint_for(self, t: Ticket):
+        """Resolve the ticket's placement hint NOW -> (replica_name,
+        strong) or (None, False). Session affinity is STRONG (a
+        multi-turn conversation's KV lives on its home; never stolen
+        while the home is placeable); the prefix-hash home is SOFT (a
+        warm preference a starving replica may steal). A hint whose
+        replica is dead or not ready resolves to None — re-queue means
+        re-queue, not a wait on a corpse. Caller holds self._mu."""
+        if self.session_affinity and t.session is not None:
+            name = self._affinity.get(t.session)
+            if name is not None:
+                st = self._replicas.get(name)
+                if st is not None and st.alive and st.ready:
+                    return name, True
+        if t.prefix is not None:
+            name = self._prefix_home.get(t.prefix)
+            if name is not None:
+                st = self._replicas.get(name)
+                if st is not None and st.alive and st.ready:
+                    return name, False
+        return None, False
+
+    def _claim_locked(self, st: "_ReplicaState"):
+        """One claim attempt by replica ``st`` against the central
+        queue -> (ticket, stolen) or None. Claims honor hints: a
+        ticket hinted HERE (or unhinted) goes first; a soft-hinted
+        ticket parked for another replica is stolen only when this
+        replica is STARVING (nothing in flight or claimed) and the
+        ticket has waited past ``steal_age_s`` — the work-stealing
+        rule: honor the hint when warm, ignore it when starving.
+        ``st.claimed`` counts pulls not yet registered in-flight, so
+        racing lanes can't over-claim past the slot cap. Caller holds
+        self._work."""
+        if self._stop.is_set() or not st.alive:
+            return None
+        if not st.ready and any(
+                s.alive and s.ready for s in self._replicas.values()):
+            # cold replica with warm peers available: don't pull —
+            # but an all-cold fleet still serves (bring-up)
+            return None
+        steal_i = None
+        with self._mu:
+            cap = max(1, int(st.load.get("slots", 1) or 1))
+            if len(st.inflight) + st.claimed >= cap:
+                return None  # no headroom: the queue holds the rest
+            starving = not st.inflight and not st.claimed
+            now = time.perf_counter()
+            # bounded scan: past this depth the backlog is effectively
+            # unhinted FIFO (2 LRU lookups per ticket under the global
+            # lock, times lanes x 50Hz idle wakeups, would otherwise
+            # inflate the very queue wait the SLO policy sheds on); a
+            # 128-deep hinted-only prefix already means severe
+            # overload, where shedding — not perfect hint honoring —
+            # is the design response
+            limit = min(len(self._pending), 128)
+            for i in range(limit):
+                t = self._pending[i]
+                hint, strong = self._hint_for(t)
+                if hint is None or hint == st.name:
+                    del self._pending[i]
+                    st.claimed += 1
+                    return t, False
+                if strong:
+                    continue  # pinned session: home is placeable
+                if (starving and steal_i is None
+                        and now - t.t_submit >= self.steal_age_s):
+                    steal_i = i
+            if steal_i is not None:
+                t = self._pending[steal_i]
+                del self._pending[steal_i]
+                st.claimed += 1
+                return t, True
+        return None
+
+    def _pull_loop(self, st: "_ReplicaState") -> None:
+        """One pull lane for one replica: claim work whenever the
+        replica has slot headroom, dispatch it, repeat. The replica's
+        own pace gates its intake — a slow or warming replica pulls
+        less and the fleet's fast replicas absorb the queue."""
+        while not self._stop.is_set():
+            with self._work:
+                got = self._claim_locked(st)
+                if got is None:
+                    self._work.wait(0.02)
+                    got = self._claim_locked(st)
+                if got is not None and telemetry.enabled():
+                    _router_metrics()["queue_depth"].set(
+                        len(self._pending))
+            if got is None:
+                continue
+            t, stolen = got
+            self._dispatch_to(t, st, stolen=stolen, claimed=True)
+
     def _dispatch_loop(self) -> None:
         while True:
             t = self._dispatch_q.get()
@@ -877,10 +1311,9 @@ class Router:
                 # waiter — fail it typed and keep draining the queue
                 with self._mu:
                     self._queued = max(0, self._queued - 1)
-                t.error = NoReplicasError(
+                self._fail_ticket(t, NoReplicasError(
                     f"router closed before request {t.rid} was "
-                    "dispatched")
-                t.done.set()
+                    "dispatched"))
                 continue
             self._dispatch(t)
 
@@ -889,11 +1322,21 @@ class Router:
         if st is None:
             with self._mu:
                 self._queued = max(0, self._queued - 1)
-            t.error = NoReplicasError(
-                "all replicas down; request cannot be placed")
-            t.done.set()
+            self._fail_ticket(t, NoReplicasError(
+                "all replicas down; request cannot be placed"))
             return
+        self._dispatch_to(t, st)
+
+    def _dispatch_to(self, t: Ticket, st: "_ReplicaState",
+                     stolen: bool = False,
+                     claimed: bool = False) -> None:
         telem = telemetry.enabled()
+        if stolen:
+            t.stolen = True
+            with self._mu:
+                self._steal_count += 1
+            if telem:
+                _router_metrics()["steals"].inc()
         # bind the request's context for the whole placement: every
         # hop below (prefill-worker POST, replica submit/inject —
         # HTTP header or in-process thread-local alike) parents onto
@@ -903,10 +1346,17 @@ class Router:
         cm_span = (_tracing.span("router.dispatch", ctx=t.trace,
                                  rid=t.rid,
                                  replica=st.replica.name,
-                                 retry=t.retries)
+                                 retry=t.retries, stolen=stolen)
                    if telem else _NULL_CM)
-        with cm_bind, cm_span:
-            self._dispatch_on(t, st, telem)
+        try:
+            with cm_bind, cm_span:
+                self._dispatch_on(t, st, telem)
+        finally:
+            if claimed:
+                # claim settled (registered in-flight, failed, or
+                # requeued): release the headroom reservation
+                with self._mu:
+                    st.claimed = max(0, st.claimed - 1)
 
     def _dispatch_on(self, t: Ticket, st: "_ReplicaState",
                      telem: bool) -> None:
@@ -950,19 +1400,20 @@ class Router:
                         with self._mu:
                             if worker in self._prefill:
                                 self._prefill.remove(worker)
+            # stream= only when asked: replica stubs predating the
+            # streaming plane keep working un-streamed
+            kw = ({"session": t.session, "stream": True}
+                  if t.stream is not None else {"session": t.session})
             if handoff is not None:
-                rid = st.replica.inject(handoff, t.max_new,
-                                        session=t.session)
+                rid = st.replica.inject(handoff, t.max_new, **kw)
             else:
-                rid = st.replica.submit(t.prompt, t.max_new,
-                                        session=t.session)
+                rid = st.replica.submit(t.prompt, t.max_new, **kw)
         except EnforceError:
             # typed replica-side rejection (bad request): the caller's
             # error, not a replica death
             with self._mu:
                 self._queued = max(0, self._queued - 1)
-            t.error = sys.exc_info()[1]
-            t.done.set()
+            self._fail_ticket(t, sys.exc_info()[1])
             return
         except Exception:
             # transport/dispatch failure: fail the replica over and
@@ -972,8 +1423,12 @@ class Router:
             return
         t.t_dispatched = time.perf_counter()
         t.replica, t.replica_rid = st.replica.name, rid
+        wait = max(0.0, t.t_dispatched - t.t_submit)
         with self._mu:
             self._queued = max(0, self._queued - 1)
+            a = 0.2  # EWMA over recent dispatches — the policy's
+            self._ewma_wait = (wait if self._ewma_wait is None  # input
+                               else (1 - a) * self._ewma_wait + a * wait)
             # the poll thread may have drained this rid's result
             # BEFORE we registered it (a request can finish at its
             # first serve tick) — the parked orphan record completes
@@ -982,24 +1437,44 @@ class Router:
             if rec is None:
                 st.inflight[rid] = t
             if self.session_affinity and t.session is not None:
-                self._affinity[t.session] = st.replica.name
+                self._affinity.set(t.session, st.replica.name)
+            if t.prefix is not None:
+                # stamp (or re-stamp after a steal) the prefix's home:
+                # the NEXT prompt sharing this prefix lands where the
+                # pages now live, so the fleet converges on one warm
+                # replica per system prompt
+                self._prefix_home.set(t.prefix, st.replica.name)
+        if t.stream is not None and rec is None:
+            self._start_pump(t, st)
         if rec is not None:
             self._finish(t, rec)
         if telemetry.enabled():
             _router_metrics()["queue_wait"].observe(
-                t.t_dispatched - t.t_submit,
+                wait,
                 exemplar=(t.trace.trace_id
                           if t.trace is not None and t.trace.sampled
                           else None))
 
     def _requeue(self, t: Ticket) -> None:
-        """Re-dispatch after a replica failure — the request survives
-        as long as ANY replica does."""
+        """Re-QUEUE after a replica failure — the request goes back on
+        the central queue (pull mode: any survivor with headroom picks
+        it up; no re-placement guess) and survives as long as ANY
+        replica does. A streaming client sees a typed ``resume``
+        record on the SAME trace id: tokens already delivered stay
+        valid — greedy re-decode is deterministic and the new pump
+        skips past the delivered index."""
         t.retries += 1
         prev = t.replica
         t.replica = t.replica_rid = None
         with self._mu:
             self._retry_count += 1
+            t._pump_gen += 1  # supersede any pump still draining prev
+        if t.stream is not None:
+            t.stream.control(
+                "resume", retries=t.retries, failed_replica=prev,
+                resume_at=t._stream_next,
+                trace_id=(t.trace.trace_id if t.trace is not None
+                          else None))
         if telemetry.enabled():
             _router_metrics()["retries"].inc()
             # the retry stays on the SAME trace id — the merged
@@ -1007,15 +1482,93 @@ class Router:
             # request's story, annotated here
             _tracing.event("router.retry", ctx=t.trace, rid=t.rid,
                            retries=t.retries, failed_replica=prev)
+            if t.stream is not None:
+                _tracing.event("stream.resume", ctx=t.trace,
+                               rid=t.rid, retries=t.retries,
+                               resume_at=t._stream_next)
         if not self._alive_names():
             with self._mu:
                 self._queued = max(0, self._queued - 1)
-            t.error = NoReplicasError(
+            self._fail_ticket(t, NoReplicasError(
                 f"request {t.rid} lost: all replicas down "
-                f"(after {t.retries} retries)")
-            t.done.set()
+                f"(after {t.retries} retries)"))
             return
-        self._dispatch_q.put(t)
+        if self._dispatch_mode == "pull":
+            with self._work:
+                self._pending.appendleft(t)  # retries jump the queue
+                self._work.notify_all()
+        else:
+            self._dispatch_q.put(t)
+
+    # -- streaming fan-in ---------------------------------------------------
+
+    def _start_pump(self, t: Ticket, st: "_ReplicaState") -> None:
+        with self._mu:
+            t._pump_gen += 1
+            gen = t._pump_gen
+        threading.Thread(target=self._pump, args=(t, st, gen),
+                         daemon=True,
+                         name=f"pt-router-stream-{t.rid}").start()
+
+    def _pump(self, t: Ticket, st: "_ReplicaState", gen: int) -> None:
+        """Fan ONE replica-side token stream into the ticket's client
+        stream. First token stamps ``ttft_s`` + the router TTFT
+        histogram (the streaming edge — same series the non-streaming
+        path feeds at completion); later gaps feed the ITL histogram,
+        exemplars riding the request's trace. Token records dedupe by
+        index across retries (re-decode is deterministic; token i IS
+        token i), and a superseded pump (its ticket re-dispatched)
+        stops forwarding the moment it notices. Transport death here
+        is NOT terminal — the health loop owns failover, and the
+        client's resume/error records come from the requeue path."""
+        telem = telemetry.enabled()
+        traced = (telem and t.trace is not None and t.trace.sampled)
+        cm = (_tracing.bind(t.trace) if traced else _NULL_CM)
+        try:
+            with cm:
+                if traced:
+                    _tracing.event("stream.fanin", ctx=t.trace,
+                                   rid=t.rid,
+                                   replica=st.replica.name,
+                                   retry=t.retries)
+                source = st.replica.open_stream(t.replica_rid)
+                last_t: Optional[float] = None
+                for rec in source:
+                    if self._stop.is_set() or t._pump_gen != gen:
+                        return  # superseded by a retry / shutdown
+                    if "i" in rec:
+                        now = time.perf_counter()
+                        if rec["i"] < t._stream_next:
+                            continue  # delivered before the retry
+                        t._stream_next = rec["i"] + 1
+                        ex = (t.trace.trace_id if traced else None)
+                        first = False
+                        if t.ttft_s is None:
+                            # claim the TTFT under the lock: the
+                            # harvest thread's _finish races this on
+                            # fast completions, and the histogram must
+                            # see exactly ONE observation per request
+                            with self._mu:
+                                first = t.ttft_s is None
+                                if first:
+                                    t.t_first_stream = now
+                                    t.ttft_s = now - t.t_submit
+                        if first:
+                            if telem:
+                                _router_metrics()["ttft"].observe(
+                                    t.ttft_s, exemplar=ex)
+                        elif telem and last_t is not None:
+                            _router_metrics()["itl"].observe(
+                                now - last_t, exemplar=ex)
+                        last_t = now
+                        t.stream.put(
+                            {"i": rec["i"], "tok": rec["tok"],
+                             "t": now}, timeout=300.0)
+                    elif rec.get("event") == "end":
+                        return  # completion record closes the client
+                        # stream via _finish (harvest path)
+        except Exception:
+            return  # transport death: health loop + requeue own it
 
     # -- health + results ---------------------------------------------------
 
@@ -1049,9 +1602,14 @@ class Router:
             st.alive = False
             orphans = list(st.inflight.values())
             st.inflight.clear()
-            for s, name in list(self._affinity.items()):
+            # a dead replica's placement hints die with it: sessions
+            # AND prefix homes (the next claim re-homes them)
+            for s, name in self._affinity.items():
                 if name == st.replica.name:
-                    del self._affinity[s]
+                    self._affinity.pop(s)
+            for h, name in self._prefix_home.items():
+                if name == st.replica.name:
+                    self._prefix_home.pop(h)
         if telemetry.enabled():
             _router_metrics()["replica_deaths"].inc()
             _router_metrics()["healthy"].set(len(self._alive_names()))
@@ -1059,14 +1617,35 @@ class Router:
             with self._mu:
                 self._queued += 1  # back to pre-dispatch accounting
             self._requeue(t)
+        if not self._alive_names():
+            # the LAST replica died: tickets still parked on the
+            # central pull queue would otherwise wait on claims that
+            # can never come (dead replicas never claim) — fail them
+            # typed, exactly like push mode's placement failure; a
+            # later replica recovery serves new admissions, not these
+            with self._work:
+                leftover = list(self._pending)
+                self._pending.clear()
+            for lt in leftover:
+                with self._mu:
+                    self._queued = max(0, self._queued - 1)
+                self._fail_ticket(lt, NoReplicasError(
+                    f"request {lt.rid} lost: all replicas down before "
+                    "any could claim it"))
 
     def _finish(self, t: Ticket, rec: Dict) -> None:
         """Complete a ticket from its replica-side result record."""
         t.tokens = np.asarray(rec["tokens"], np.int32)
-        # replica-side TTFT is measured from ITS submit; add the
-        # router-side dispatch wait so the number is end-to-end
-        wait = max(0.0, t.t_dispatched - t.t_submit)
-        t.ttft_s = float(rec["ttft_s"]) + wait
+        with self._mu:
+            # claim under the lock (the stream pump races this on fast
+            # completions): a STREAMED ticket that already stamped
+            # ttft_s from its first token keeps the streaming
+            # measurement; otherwise replica-side TTFT (measured from
+            # ITS submit) + the router-side dispatch wait = end-to-end
+            streamed_first = t.ttft_s is not None
+            if not streamed_first:
+                wait = max(0.0, t.t_dispatched - t.t_submit)
+                t.ttft_s = float(rec["ttft_s"]) + wait
         t.itl_p99_s = float(rec.get("itl_p99_s") or 0.0)
         with self._mu:
             self._served_count += 1
@@ -1074,12 +1653,22 @@ class Router:
             self._ewma_ttft = (t.ttft_s if self._ewma_ttft is None
                                else (1 - a) * self._ewma_ttft
                                + a * t.ttft_s)
-        if telemetry.enabled():
+        if telemetry.enabled() and not streamed_first:
             _router_metrics()["ttft"].observe(
                 t.ttft_s,
                 exemplar=(t.trace.trace_id
                           if t.trace is not None and t.trace.sampled
                           else None))
+        if t.stream is not None:
+            # any tokens the pump has not forwarded yet serve
+            # consumer-driven from the completion record, then the
+            # typed end mark — the stream can't outlive its ticket.
+            # Supersede the pump: a lagging fan-in must stop
+            # forwarding (its late records are dropped-as-delivered
+            # by the client stream's high-water check anyway)
+            with self._mu:
+                t._pump_gen += 1
+            t.stream.finish(t.tokens)
         t.done.set()
 
     def _harvest(self, st: _ReplicaState) -> None:
@@ -1114,8 +1703,17 @@ class Router:
             self._probe(st)
             if st.inflight:
                 self._harvest(st)
+        if self._dispatch_mode == "pull" and self._pending:
+            # probes/harvests may have freed headroom or flipped
+            # readiness: wake the pull lanes
+            with self._work:
+                self._work.notify_all()
         if telemetry.enabled():
             _router_metrics()["healthy"].set(len(self._alive_names()))
+            stats = self._prefix_stats()
+            if stats["lookups"]:
+                _router_metrics()["prefix_ratio"].set(
+                    stats["hit_ratio"])
             if self._textfile:
                 # node-exporter textfile path: re-write the whole
                 # registry (pt_router_* included) on a bounded cadence
@@ -1185,9 +1783,21 @@ def run_worker(spec: str, role: str = "decode", port: int = 0,
             req = json.loads(b.decode())
             return {"rid": rep.submit(
                 np.asarray(req["prompt"], np.int32),
-                int(req["max_new"]))}
+                int(req["max_new"]),
+                stream=bool(req.get("stream")))}
+
+        def _stream(b: bytes):
+            # SSE per-token stream for one rid: the iterator IS the
+            # replica-side TokenStream, served chunked with per-token
+            # flush + trace-header echo by DebugServer.add_sse
+            rid = int(json.loads(b.decode())["rid"])
+            it = rep.open_stream(rid)
+            if telemetry.enabled():
+                _tracing.event("stream.open", rid=rid)
+            return it
 
         srv.add_post("/submit", _submit)
+        srv.add_sse("/stream", _stream)
         srv.add_post("/drain", lambda b: {"done": {
             rid: {**rec, "tokens": np.asarray(rec["tokens"]).tolist()}
             for rid, rec in rep.drain_results().items()}})
@@ -1237,13 +1847,15 @@ def _worker_config(rep: LocalReplica, body: bytes) -> Dict[str, Any]:
 
 def _make_inject(rep: LocalReplica):
     """/inject POST handler: the npz handoff payload carries everything
-    but max_new, which rides a leading 8-byte header (the stdlib
-    handler gives us only the body)."""
+    but the scalars, which ride a leading header (8-byte big-endian
+    max_new + 1 flag byte, bit 0 = stream — the stdlib handler gives
+    us only the body)."""
     def handler(body: bytes) -> Dict[str, Any]:
-        enforce(len(body) > 8, "inject body too short")
+        enforce(len(body) > 9, "inject body too short")
         max_new = int.from_bytes(body[:8], "big")
-        h = KVHandoff.from_bytes(body[8:])
-        return {"rid": rep.inject(h, max_new)}
+        stream = bool(body[8] & 1)
+        h = KVHandoff.from_bytes(body[9:])
+        return {"rid": rep.inject(h, max_new, stream=stream)}
 
     return handler
 
@@ -1339,12 +1951,15 @@ def serve_main(spec: str, replicas: int = 2, prefill_workers: int = 0,
                policy: Optional[SLOPolicy] = None,
                disagg_min_tokens: Optional[int] = 64,
                trace_sample: Optional[float] = None,
-               textfile_path: Optional[str] = None) -> Router:
+               textfile_path: Optional[str] = None,
+               dispatch: str = "pull",
+               prefix_hash_tokens: Optional[int] = 64) -> Router:
     """One-command serving bring-up (``python -m paddle_tpu.launch
     --serve``): spawn the replica (and prefill) worker processes, build
     the router over them, and serve the router front-end (POST /submit
-    /drain + /statusz + /podz replica fan-out) on ``port``. Returns the
-    running router — the caller owns ``close(replicas=True)``."""
+    /stream /drain + /statusz + /podz replica fan-out) on ``port``.
+    Returns the running router — the caller owns
+    ``close(replicas=True)``."""
     reps = spawn_replicas(spec, replicas, spec_kw=spec_kw,
                           log_dir=log_dir)
     pfs = (spawn_replicas(spec, prefill_workers, role="prefill",
@@ -1353,7 +1968,9 @@ def serve_main(spec: str, replicas: int = 2, prefill_workers: int = 0,
     router = Router(reps, prefill_workers=pfs, policy=policy,
                     disagg_min_tokens=disagg_min_tokens,
                     trace_sample=trace_sample,
-                    textfile_path=textfile_path)
+                    textfile_path=textfile_path,
+                    dispatch=dispatch,
+                    prefix_hash_tokens=prefix_hash_tokens)
     router.start_server(port=port)
     return router
 
@@ -1393,6 +2010,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="(router mode) write the metrics exposition "
                     "here periodically (node-exporter textfile "
                     "collector; also env PT_ROUTER_TEXTFILE)")
+    ap.add_argument("--dispatch", default="pull",
+                    choices=("pull", "push"),
+                    help="(router mode) pull = replicas pull from the "
+                    "central work-stealing queue (default); push = "
+                    "legacy least-loaded placement")
+    ap.add_argument("--prefix-hash-tokens", dest="prefix_hash_tokens",
+                    type=int, default=64,
+                    help="(router mode) route by a rolling hash of "
+                    "the first N prompt tokens so shared system "
+                    "prompts land on one warm replica (0 disables)")
     args = ap.parse_args(argv)
     kw = json.loads(args.spec_kw) if args.spec_kw else None
     if args.worker:
@@ -1404,7 +2031,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         prefill_workers=args.prefill_workers,
                         port=args.port, spec_kw=kw,
                         trace_sample=args.trace_sample,
-                        textfile_path=args.textfile)
+                        textfile_path=args.textfile,
+                        dispatch=args.dispatch,
+                        prefix_hash_tokens=(args.prefix_hash_tokens
+                                            or None))
     print(f"[router] serving on {router.server.url()} over "
           f"{args.replicas} replica(s)", file=sys.stderr)
     try:

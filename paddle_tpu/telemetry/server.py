@@ -122,6 +122,7 @@ class DebugServer:
         self._fleet: Optional[Callable[[], Any]] = None
         self._ready: Optional[Callable[[], bool]] = None
         self._posts: Dict[str, Callable[[bytes], Any]] = {}
+        self._sse: Dict[str, Callable[[bytes], Any]] = {}
         self._trace_fanin: Optional[Callable[[Optional[str]], Any]] = \
             None
 
@@ -171,6 +172,21 @@ class DebugServer:
         exceptions answer 400 with the error string (a bad request
         must not read as a dead replica)."""
         self._posts[path] = handler
+
+    def add_sse(self, path: str,
+                handler: Callable[[bytes], Any]) -> None:
+        """Mount a STREAMING POST handler at ``path``:
+        ``handler(body_bytes)`` returns an iterator of JSON-able
+        records, each written as one ``data: <json>`` SSE event and
+        FLUSHED immediately (per-token streaming — a buffered token is
+        a token the client doesn't have). The response carries no
+        Content-Length; the stream ends when the iterator does
+        (connection close delimits). The incoming ``X-PT-Trace``
+        header is bound for the iterator's whole life and echoed onto
+        the response headers, so every span the stream produces — and
+        the client's view of it — stays on the request's trace
+        (PT-LINT-307 pins both the flush and the echo)."""
+        self._sse[path] = handler
 
     @property
     def port(self) -> int:
@@ -423,7 +439,8 @@ def _make_handler(server: DebugServer):
                         endpoints.append("/readyz")
                     if server._fleet is not None:
                         endpoints.append("/podz")
-                    endpoints.extend(sorted(server._posts))
+                    endpoints.extend(sorted(set(server._posts)
+                                            | set(server._sse)))
                     self._send(200, json.dumps(
                         {"endpoints": endpoints}))
                 else:
@@ -440,10 +457,42 @@ def _make_handler(server: DebugServer):
                 except Exception:
                     pass
 
+        def _send_sse(self, events, ctx=None) -> None:
+            """Chunked SSE writer: one ``data: <json>`` event per
+            record, FLUSHED per record — a token buffered here is a
+            token the client doesn't have yet (PT-LINT-307 pins the
+            per-event flush). The request's trace context is echoed
+            onto the response via ``to_header`` so the hop — and the
+            client's stream reader — stays on the request's trace.
+            No Content-Length: the iterator's end (connection close)
+            delimits the stream."""
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/event-stream; charset=utf-8")
+            self.send_header("Cache-Control", "no-cache")
+            if ctx is not None:
+                self.send_header(_tracing.TRACE_HEADER,
+                                 ctx.to_header())
+            self.end_headers()
+            try:
+                for ev in events:
+                    self.wfile.write(
+                        b"data: "
+                        + json.dumps(ev, default=str).encode("utf-8")
+                        + b"\n\n")
+                    self.wfile.flush()  # per-token: never buffer
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client hung up mid-stream
+            finally:
+                close = getattr(events, "close", None)
+                if close is not None:
+                    close()
+
         def do_POST(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             fn = server._posts.get(path)
-            if fn is None:
+            sse = server._sse.get(path)
+            if fn is None and sse is None:
                 self._send(404, json.dumps(
                     {"error": f"no such POST endpoint: {path}"}))
                 return
@@ -458,8 +507,21 @@ def _make_handler(server: DebugServer):
                 # POST endpoint (submit/inject/prefill/drain/config)
                 # rides through. pt-lint PT-LINT-306 keeps it honest.
                 hdr = self.headers.get(_tracing.TRACE_HEADER)
-                if hdr and _metrics.enabled():
-                    ctx = _tracing.from_header(hdr)
+                ctx = (_tracing.from_header(hdr)
+                       if hdr and _metrics.enabled() else None)
+                if sse is not None:
+                    # streaming endpoint: the context stays bound for
+                    # the ITERATOR's whole life (tokens produce spans
+                    # too), and rides the response headers back
+                    if ctx is not None:
+                        with _tracing.bind(ctx), \
+                                _tracing.span("http.POST " + path,
+                                              path=path):
+                            self._send_sse(sse(body), ctx)
+                    else:
+                        self._send_sse(sse(body))
+                    return
+                if ctx is not None:
                     with _tracing.bind(ctx), \
                             _tracing.span("http.POST " + path,
                                           path=path):

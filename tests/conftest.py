@@ -93,6 +93,16 @@ SLOW_PATTERNS = [
     # smoke" stage (pytest -m chaos on the file) — keep it out of -m
     # mid so it doesn't run twice
     "test_tracing.py::test_trace_smoke_two_process_merged_trace",
+    # streaming-plane subprocess e2es (~30-60s each: worker spawns):
+    # the stream-smoke one runs as ci.sh mid's own "stream smoke"
+    # stage; the SIGKILL chaos pair and the bench gate ride the full
+    # suite only
+    "test_serving_stream.py::test_stream_smoke_two_worker_token_"
+    "incremental",
+    "test_serving_stream.py::test_sigkill_mid_stream_typed_resume_"
+    "same_trace",
+    "test_serving_stream.py::test_all_down_mid_stream_typed_error",
+    "test_serving_stream.py::test_stream_bench_gate",
 ]
 
 # mid tier = smoke + one representative per DEEP subsystem (pallas
@@ -162,6 +172,7 @@ MID_PATTERNS = [
     "test_native_datafeed.py",
     "test_transformer.py::test_decoder_causality",
     "test_transformer.py::test_greedy_decode_cached_matches_full_recompute",
+    "test_serving_stream.py",
     "test_train_loop.py",
     "test_sharding_plan.py",
     "test_resilience.py",

@@ -363,7 +363,7 @@ class TestRouterLogic:
             r.close()
 
     def test_session_affinity_beats_load(self):
-        a, b = _FakeReplica("a"), _FakeReplica("b")
+        a, b = _FakeReplica("a", slots=4), _FakeReplica("b", slots=4)
         r = _router([a, b], poll_interval_s=30)
         try:
             t0 = r.submit(_prompt(4), 2, session="conv")
@@ -371,13 +371,16 @@ class TestRouterLogic:
             while t0.replica is None and time.time() < deadline:
                 time.sleep(0.01)
             home = t0.replica
-            # home replica now carries load; the session sticks anyway
+            # home replica now carries load (nothing drains at a 30s
+            # poll); the session's STRONG hint sticks anyway — only
+            # the home claims it from the pull queue
             for _ in range(3):
                 tn = r.submit(_prompt(4), 2, session="conv")
                 while tn.replica is None and time.time() < deadline:
                     time.sleep(0.01)
                 assert tn.replica == home
-            # a session-less request balances AWAY from the loaded home
+            # a session-less request pulls to the idle replica: home
+            # is at its slot headroom with the 4 conv streams
             tf = r.submit(_prompt(4), 2)
             while tf.replica is None and time.time() < deadline:
                 time.sleep(0.01)

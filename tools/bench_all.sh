@@ -55,6 +55,9 @@ if [ "$MODE" = "full" ]; then
   # TTFT + p99 ITL + aggregate tok/s + shed rate on the JSON line)
   run python bench.py --model gpt_serve --router --replicas 1
   run python bench.py --model gpt_serve --router --replicas 2
+  # streaming data plane: per-token streaming arm (stream TTFT/ITL)
+  # + prefix-hash vs session-only routing hit-rate A/B
+  run python bench.py --model gpt_serve --router --stream --replicas 1
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py

@@ -59,6 +59,10 @@ case "$MODE" in
     JAX_PLATFORMS=cpu python -m pytest tests/test_serving_router.py \
       -q -k "http_router_smoke or dispatch_fault or all_replicas_down" \
       || exit $?
+    stage "stream smoke (2-worker routed STREAMING request: tokens \
+arrive incrementally across processes over per-token-flushed SSE)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving_stream.py \
+      -q -k "stream_smoke" || exit $?
     stage "trace smoke (routed request through 2 worker processes -> \
 ONE merged cross-process chrome-trace with a shared trace id)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py \
