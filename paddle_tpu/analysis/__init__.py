@@ -20,12 +20,21 @@ anything executes.
 - :mod:`.lint` — AST linter for repo invariants (atomic state writes,
   span clocks, thread names, device_get-into-donation, debug
   leftovers). ``tools/lint.py`` CLI + the ci.sh ``lint`` stage.
+- :mod:`.concurrency` — whole-repo concurrency verifier (the
+  ``PT-RACE-4xx`` family: unsynchronized shared writes from thread
+  entries, lock-order inversions with witness paths, blocking calls
+  under locks, non-looped condition waits, unjoined non-daemon
+  threads). ``tools/lint.py --select PT-RACE`` + the ci.sh ``race
+  smoke`` stage; :func:`~.concurrency.lock_order_graph` feeds the
+  runtime lock-order watchdog (``telemetry/lockwatch.py``).
 
 Opt out of the wired-in passes with ``FLAGS_static_verify=0`` (env or
 ``core.config.FLAGS``); the analyzers stay importable/callable either
 way.
 """
 
+from .concurrency import (RACE_CODES, analyze_file, analyze_paths,
+                          analyze_source, lock_order_graph)
 from .diagnostics import (Diagnostic, errors, format_diagnostics,
                           has_errors)
 from .donation import (check_donation, classify_provenance,
@@ -42,4 +51,6 @@ __all__ = [
     "note_host_backed", "note_transfer", "track_host_transfers",
     "audit_plan", "audit_summary",
     "lint_source", "lint_file", "lint_paths", "LINT_CODES",
+    "analyze_source", "analyze_file", "analyze_paths", "RACE_CODES",
+    "lock_order_graph",
 ]

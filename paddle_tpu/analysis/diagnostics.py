@@ -28,12 +28,23 @@ PT-SHARD-202   Plan audit: explicit/pattern spec dropped (divisibility)
 PT-SHARD-203   Plan audit: big leaf replicated under an fsdp plan
 PT-LINT-301    Repo lint: state-file write bypasses utils/atomic
 PT-LINT-302    Repo lint: wall-clock time.time() inside a span body
-PT-LINT-303    Repo lint: unnamed threading.Thread
+PT-LINT-303    Repo lint: unnamed thread (Thread without name= /
+               ThreadPoolExecutor without thread_name_prefix)
 PT-LINT-304    Repo lint: device_get result flows into a donating call
 PT-LINT-305    Repo lint: leftover debug hook (jax.debug.print, ...)
 PT-LINT-306    Repo lint: HTTP hop without trace-header propagation
 PT-LINT-307    Repo lint: SSE/chunked writer missing per-event flush
                or trace-header echo
+PT-RACE-401    Concurrency: shared attribute written from a thread
+               entry with no common lock
+PT-RACE-402    Concurrency: lock-order inversion (cycle in the
+               lock-acquisition graph, both witness paths named)
+PT-RACE-403    Concurrency: timeout-less blocking call (join /
+               queue.get / Event.wait / foreign Condition.wait)
+               while holding a lock
+PT-RACE-404    Concurrency: Condition.wait outside a predicate loop
+PT-RACE-405    Concurrency: non-daemon thread never joined in its
+               module
 =============  ========================================================
 """
 

@@ -13,9 +13,11 @@ were each paid for with a real bug class (codes in ``diagnostics.py``):
   monotonic clocks; mixing in wall time yields negative/NTP-skewed
   durations. Timestamps belong outside the span or use
   ``time.perf_counter()``.
-- **PT-LINT-303** — ``threading.Thread`` without ``name=``: an unnamed
-  thread is undebuggable in /statusz thread dumps and py-spy profiles
-  (this repo names threads ``pt-*``).
+- **PT-LINT-303** — ``threading.Thread`` without ``name=`` (or a
+  ``ThreadPoolExecutor`` without ``thread_name_prefix=``): an unnamed
+  thread is undebuggable in /statusz thread dumps, py-spy profiles,
+  and merged chrome-traces — an anonymous pool lane in a fleet trace
+  is a lane nobody can attribute (this repo names threads ``pt-*``).
 - **PT-LINT-304** — a ``jax.device_get`` result flowing into a
   donating call (``train_step`` / ``train_steps`` / ``_jit_*``):
   device_get returns zero-copy views on the CPU backend; donating the
@@ -45,7 +47,8 @@ from .diagnostics import Diagnostic
 LINT_CODES = {
     "PT-LINT-301": "state-file write bypasses utils/atomic",
     "PT-LINT-302": "wall-clock time.time() inside a telemetry span body",
-    "PT-LINT-303": "unnamed threading.Thread",
+    "PT-LINT-303": "unnamed thread (Thread without name= / "
+                   "ThreadPoolExecutor without thread_name_prefix)",
     "PT-LINT-304": "device_get result flows into a donating call",
     "PT-LINT-305": "leftover debug hook",
     "PT-LINT-306": "HTTP hop without trace-header propagation",
@@ -326,6 +329,18 @@ class _Linter(ast.NodeVisitor):
                     "threading.Thread without name=",
                     'name it "pt-<role>" so thread dumps and /statusz '
                     "stay readable")
+        # PT-LINT-303 (pool form): an executor without a name prefix
+        # produces anonymous ThreadPoolExecutor-N lanes in merged
+        # chrome-traces (the /podz and trace fan-in pools did)
+        if callee == "ThreadPoolExecutor":
+            if not any(kw.arg == "thread_name_prefix"
+                       for kw in node.keywords):
+                self._flag(
+                    "PT-LINT-303", node,
+                    "ThreadPoolExecutor without thread_name_prefix=",
+                    'pass thread_name_prefix="pt-<role>" so pool '
+                    "lanes stay attributable in thread dumps and "
+                    "merged traces")
 
         # PT-LINT-302: wall clock inside a span body
         if dotted == "time.time" and self._span_depth > 0:

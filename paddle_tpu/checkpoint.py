@@ -571,6 +571,12 @@ class _WriteHandle:
     thread's exception would otherwise vanish into stderr and a 'successful'
     checkpoint would not exist on disk)."""
 
+    # a wedged writer (hung filesystem, dead NFS mount) must never hang
+    # close()/wait_until_finished() forever — join() is bounded and
+    # raises typed on expiry. Generous by design: the commit barrier's
+    # own 300s timeout fires long before this on the coordinated path.
+    DEFAULT_JOIN_TIMEOUT_S = 600.0
+
     def __init__(self, fn=None, directory: Optional[str] = None):
         self.directory = directory  # write target, for same-dir serializing
         self._exc: Optional[BaseException] = None
@@ -580,6 +586,7 @@ class _WriteHandle:
                 try:
                     fn()
                 except BaseException as e:  # re-raised at join()
+                    # pt-lint: disable=PT-RACE-401 join() reads _exc only after Thread.join returns (the happens-before edge)
                     self._exc = e
 
             self._thread = threading.Thread(target=run, daemon=True,
@@ -589,9 +596,20 @@ class _WriteHandle:
     def done(self) -> bool:
         return self._thread is None or not self._thread.is_alive()
 
-    def join(self) -> None:
+    def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:
-            self._thread.join()
+            # env read at CALL time, so the error hint's "override and
+            # retry" works inside a live process
+            t = (timeout if timeout is not None
+                 else float(os.environ.get("PT_CKPT_JOIN_TIMEOUT_S",
+                                           self.DEFAULT_JOIN_TIMEOUT_S)))
+            self._thread.join(t)
+            if self._thread.is_alive():
+                raise EnforceError(
+                    f"checkpoint writer thread still running after "
+                    f"{t:.0f}s (target {self.directory or '?'}): "
+                    f"wedged IO — refusing to hang teardown "
+                    f"(PT_CKPT_JOIN_TIMEOUT_S overrides)")
         if self._exc is not None:
             exc, self._exc = self._exc, None
             raise exc

@@ -49,7 +49,7 @@ import numpy as np
 from .. import telemetry
 from ..core.enforce import enforce
 from .bucketing import round_to_bucket
-from .reader import _put_cancellable
+from .reader import _PRODUCER_LOST, _get_bounded, _put_cancellable
 
 
 @telemetry.cached_instruments
@@ -439,8 +439,9 @@ class DevicePrefetcher:
             finally:
                 _put_cancellable(q, self._END, stop)
 
-        threading.Thread(target=worker, daemon=True,
-                         name="pt-device-prefetch").start()
+        wt = threading.Thread(target=worker, daemon=True,
+                              name="pt-device-prefetch")
+        wt.start()
         try:
             while True:
                 telem = telemetry.enabled()
@@ -449,7 +450,15 @@ class DevicePrefetcher:
                 # being scraped
                 if telem or self.auto:
                     t0 = time.perf_counter()
-                item = q.get()
+                # bounded by worker LIVENESS: a staging thread that
+                # died without its end sentinel must never hang the
+                # training loop (or this generator's teardown) forever
+                item = _get_bounded(q, (wt,))
+                if item is _PRODUCER_LOST:
+                    if not err:
+                        enforce(False, "prefetch worker died without "
+                                "delivering its end sentinel")
+                    break  # err re-raised below
                 if telem or self.auto:
                     wait = time.perf_counter() - t0
                     if telem:

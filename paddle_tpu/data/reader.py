@@ -120,7 +120,13 @@ def buffered(reader: Reader, size: int) -> Reader:
         t.start()
         try:
             while True:
-                item = q.get()
+                item = _get_bounded(q, (t,))
+                if item is _PRODUCER_LOST:
+                    if not err:
+                        raise RuntimeError(
+                            "buffered reader worker died without "
+                            "delivering its end sentinel")
+                    break  # err re-raised below
                 if item is end:
                     break
                 yield item
@@ -146,6 +152,7 @@ def _put_cancellable(q: "queue.Queue", item, stop: "threading.Event") -> bool:
 
 
 _CANCELLED = object()
+_PRODUCER_LOST = object()
 
 
 def _get_cancellable(q: "queue.Queue", stop: "threading.Event"):
@@ -157,6 +164,29 @@ def _get_cancellable(q: "queue.Queue", stop: "threading.Event"):
         except queue.Empty:
             continue
     return _CANCELLED
+
+
+def _get_bounded(q: "queue.Queue", threads, poll_s: float = 0.5):
+    """Consumer-side q.get bounded by PRODUCER LIVENESS: blocks while
+    any producer thread is alive, but a producer that died without
+    delivering its end sentinel (a failed sentinel put, an interpreter
+    tearing down) returns :data:`_PRODUCER_LOST` instead of hanging
+    the consumer — and its generator teardown — forever. The liveness
+    poll is idle-side only: a live queue hands items over at q.get
+    speed."""
+    while True:
+        try:
+            return q.get(timeout=poll_s)
+        except queue.Empty:
+            if not any(t.is_alive() for t in threads):
+                # final drain: the producer may have enqueued its
+                # sentinel and exited INSIDE the Empty->liveness
+                # window — a clean epoch end must never be
+                # misreported as a lost producer
+                try:
+                    return q.get_nowait()
+                except queue.Empty:
+                    return _PRODUCER_LOST
 
 
 def firstn(reader: Reader, n: int) -> Reader:
@@ -226,9 +256,20 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
 
         threading.Thread(target=feeder, daemon=True,
                          name="pt-reader-xmap-feeder").start()
+        workers = []
         for _ in range(process_num):
-            threading.Thread(target=worker, daemon=True,
-                             name="pt-reader-xmap-worker").start()
+            w = threading.Thread(target=worker, daemon=True,
+                                 name="pt-reader-xmap-worker")
+            w.start()
+            workers.append(w)
+
+        def lost():
+            # a worker that died without its sentinel must not hang
+            # the consumer; surface the recorded error (or a typed one)
+            if not errors:
+                errors.append(RuntimeError(
+                    "xmap worker died without delivering its end "
+                    "sentinel"))
 
         finished = 0
         try:
@@ -236,7 +277,10 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
                 pending = {}
                 next_i = 0
                 while finished < process_num:
-                    item = out_q.get()
+                    item = _get_bounded(out_q, workers)
+                    if item is _PRODUCER_LOST:
+                        lost()
+                        break
                     if item is end:
                         finished += 1
                         continue
@@ -249,7 +293,10 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
                     yield pending[i]
             else:
                 while finished < process_num:
-                    item = out_q.get()
+                    item = _get_bounded(out_q, workers)
+                    if item is _PRODUCER_LOST:
+                        lost()
+                        break
                     if item is end:
                         finished += 1
                         continue

@@ -463,6 +463,12 @@ class FleetController:
         self.agreed_restore_step: Optional[int] = None
         self.committed_view: Optional[Dict[int, int]] = None
         self.last_wait_s: Optional[float] = None
+        # guards request_reason/_notice/_watch_error: the metadata
+        # watcher thread and the training loop both WRITE them
+        # (request() from the watcher, _requested()'s signal-reason
+        # stamp from the loop) — the flag reads stay lock-free (the
+        # publication pattern; the lock serializes the writers)
+        self._req_mu = threading.Lock()
         self.request_reason: Optional[str] = None
         self._notice = False
         self._own_endpoint: Optional[str] = None
@@ -523,8 +529,9 @@ class FleetController:
         """Raise the preempt flag without a signal (metadata watcher,
         orchestrator RPC, tests). The next :meth:`check` starts the
         agreement."""
-        self.request_reason = self.request_reason or reason
-        self._notice = True
+        with self._req_mu:
+            self.request_reason = self.request_reason or reason
+            self._notice = True
         if telemetry.enabled():
             # preempt-agreement breadcrumbs on the trace ring: the
             # fleet /tracez fan-in shows request → per-rank ack →
@@ -537,7 +544,8 @@ class FleetController:
         if self._notice:
             return True
         if self.handler.requested():
-            self.request_reason = self.request_reason or "signal"
+            with self._req_mu:
+                self.request_reason = self.request_reason or "signal"
             return True
         return False
 
@@ -558,7 +566,8 @@ class FleetController:
                     return
             except Exception as e:
                 # a flaky metadata endpoint must never kill the watcher
-                self._watch_error = repr(e)
+                with self._req_mu:
+                    self._watch_error = repr(e)
 
     # -- the agreement ------------------------------------------------------
 
@@ -639,8 +648,9 @@ class FleetController:
                 self._last_peek = now
                 if self._peer_ack_seen():
                     requested = True
-                    self.request_reason = (self.request_reason
-                                           or "peer")
+                    with self._req_mu:
+                        self.request_reason = (self.request_reason
+                                               or "peer")
         if not requested:
             return None
         return self._agree(step)

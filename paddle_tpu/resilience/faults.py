@@ -33,6 +33,13 @@ Injection points (:data:`POINTS`):
   replica (``path`` = the replica name, so ``match=`` targets one
   replica) — a raising rule models a replica dying mid-dispatch and
   drives the router's retry-on-surviving-replica path deterministically
+- ``lock.acquire`` a :class:`~paddle_tpu.telemetry.lockwatch.
+  WatchedLock` acquisition (``path`` = the lock's name; fired only
+  while the lock-order watchdog is enabled). A seeded ``delay_s`` rule
+  matched to ONE lock stretches its acquire window so two racing
+  threads interleave deterministically — the chaos suite uses it to
+  force a real lock-order inversion the watchdog must catch with both
+  witness stacks
 """
 
 from __future__ import annotations
@@ -47,7 +54,7 @@ from ..core.enforce import enforce
 
 POINTS = ("ckpt.write", "ckpt.manifest", "ckpt.stage", "ckpt.commit",
           "restore.read", "step.nan", "io.slow", "fleet.notice",
-          "router.dispatch")
+          "router.dispatch", "lock.acquire")
 
 _ACTIVE: Optional["FaultInjector"] = None
 _LOCK = threading.Lock()

@@ -1303,7 +1303,16 @@ class Router:
 
     def _dispatch_loop(self) -> None:
         while True:
-            t = self._dispatch_q.get()
+            try:
+                # bounded get: close() posts one None sentinel per
+                # thread, but a dispatcher must exit on _stop even if
+                # its sentinel is lost — a wedged dispatcher would pin
+                # close()'s join budget for nothing
+                t = self._dispatch_q.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
             if t is None:
                 return
             if self._stop.is_set():

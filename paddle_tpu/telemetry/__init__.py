@@ -21,6 +21,10 @@ at with its profiler (SURVEY §5.1). The pieces:
 - ``diag``: device-memory monitor + :class:`FlightRecorder` (ring of
   recent steps, anomaly watch, atomic dump-on-anomaly bundles with a
   record/skip_step/halt policy).
+- ``lockwatch``: runtime lock-order watchdog — :class:`WatchedLock`
+  records acquisition order at test time, catches real lock-order
+  inversions with witness stack pairs, and validates the static
+  ``analysis/concurrency.py`` lock graph against observed reality.
 
 Everything is OFF by default and zero-cost when off: instrumented
 call-sites check :func:`enabled` (one module-global bool) before any
@@ -38,7 +42,8 @@ Usage::
 
 from __future__ import annotations
 
-from . import diag, export, metrics, recompile, server, trace, tracing
+from . import (diag, export, lockwatch, metrics, recompile, server,
+               trace, tracing)
 from .diag import (AnomalyHalt, FlightRecorder, device_memory,
                    peak_memory_bytes)
 from .export import (openmetrics_text, prometheus_text, summary,
@@ -61,7 +66,7 @@ __all__ = [
     "cached_instruments", "device_memory", "diag",
     "disable", "enable", "enabled", "export", "export_chrome_trace",
     "export_jsonl", "fingerprint", "log_buckets",
-    "merge_chrome_trace", "metrics", "new_trace",
+    "lockwatch", "merge_chrome_trace", "metrics", "new_trace",
     "openmetrics_text", "peak_memory_bytes",
     "prometheus_text", "recompile", "registry", "reset", "server",
     "span", "summary", "trace", "tracing", "write_textfile",

@@ -95,6 +95,11 @@ class Watchdog:
                                                              30.0)
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
+        # guards _fired/_last_beat: beat() (the training thread) and
+        # _run() (the watchdog thread) both WRITE them — unlocked, a
+        # beat racing the fire could strand _fired=True and suppress
+        # the next stall's alert (PT-RACE-401)
+        self._mu = threading.Lock()
         self._fired = False
         self._thread: Optional[threading.Thread] = None
 
@@ -106,14 +111,20 @@ class Watchdog:
         return self
 
     def beat(self):
-        self._last_beat = time.monotonic()
-        self._fired = False
+        with self._mu:
+            self._last_beat = time.monotonic()
+            self._fired = False
 
     def _run(self):
         while not self._stop.wait(self._poll_s):
-            age = time.monotonic() - self._last_beat
-            if age > self.timeout_s and not self._fired:
-                self._fired = True  # fire once per stall
+            with self._mu:
+                age = time.monotonic() - self._last_beat
+                fire = age > self.timeout_s and not self._fired
+                if fire:
+                    self._fired = True  # fire once per stall
+            if fire:
+                # user callback runs OUTSIDE the lock: a slow on_stall
+                # must never block beat() (PT-RACE-403 discipline)
                 self.on_stall(age)
 
     def stop(self):

@@ -141,7 +141,9 @@ def multi_download(client: HDFSClient, hdfs_path: str, local_path: str,
         os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
         return dst if client.download(f, dst) else None
 
-    with ThreadPoolExecutor(max_workers=max(1, multi_processes)) as pool:
+    with ThreadPoolExecutor(max_workers=max(1, multi_processes),
+                            thread_name_prefix="pt-hdfs-download"
+                            ) as pool:
         got = list(pool.map(get, mine))
     return [g for g in got if g]
 
@@ -165,6 +167,8 @@ def multi_upload(client: HDFSClient, hdfs_path: str, local_path: str,
             client.makedirs(parent)
         return dst if client.upload(dst, f, overwrite=overwrite) else None
 
-    with ThreadPoolExecutor(max_workers=max(1, multi_processes)) as pool:
+    with ThreadPoolExecutor(max_workers=max(1, multi_processes),
+                            thread_name_prefix="pt-hdfs-upload"
+                            ) as pool:
         done = list(pool.map(put, todo))
     return [d for d in done if d]

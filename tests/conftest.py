@@ -190,6 +190,8 @@ MID_PATTERNS = [
 SMOKE_PATTERNS = [
     "test_core.py",
     "test_analysis.py",
+    "test_concurrency_analysis.py",
+    "test_lockwatch.py",
     "test_mnist_e2e.py",
     "test_api_spec.py::test_public_api_matches_spec",
     "test_bench.py::test_regression_contract",

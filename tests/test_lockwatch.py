@@ -1,0 +1,313 @@
+"""Concurrency verification plane — runtime half
+(``paddle_tpu/telemetry/lockwatch.py``).
+
+The lock-order watchdog: WatchedLock delegation, per-thread held-set
+tracking, inversion detection with BOTH witness stacks, validation of
+the static ``analysis/concurrency.py`` lock graph against observed
+orderings, the zero-cost-when-disabled pin (the telemetry discipline),
+and the chaos acceptance test: a SEEDED ``lock.acquire`` fault rule
+forces two racing threads into a deterministic inversion window and
+the watchdog names both witness stacks. ci.sh runs this file as part
+of the ``race smoke`` stage."""
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.analysis.concurrency import lock_order_graph
+from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.resilience.faults import FaultInjector
+from paddle_tpu.telemetry import lockwatch
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog():
+    lockwatch.disable()
+    yield
+    lockwatch.disable()
+
+
+def _run_threads(*fns):
+    ts = [threading.Thread(target=fn, name=f"pt-lw-{fn.__name__}")
+          for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in ts), "worker wedged"
+
+
+# ---------------------------------------------------------------------------
+# WatchedLock basics
+# ---------------------------------------------------------------------------
+
+
+class TestWatchedLock:
+    def test_is_a_real_lock_either_way(self):
+        lk = lockwatch.WatchedLock("L")
+        with lk:
+            assert lk.locked()
+        assert not lk.locked()
+        assert lk.acquire(blocking=False)
+        assert not lk.acquire(blocking=False)  # non-reentrant default
+        lk.release()
+        lockwatch.enable()
+        with lk:
+            assert lk.locked()
+        assert not lk.locked()
+
+    def test_needs_a_name(self):
+        with pytest.raises(EnforceError):
+            lockwatch.WatchedLock("")
+
+    def test_rlock_reentry_records_no_self_edge(self):
+        wd = lockwatch.enable()
+        lk = lockwatch.WatchedLock("R", lock=threading.RLock())
+        with lk:
+            with lk:
+                pass
+        assert wd.edges() == {} and wd.violations == []
+
+    def test_locked_works_on_rlock_pre_314(self):
+        # RLock grows .locked() only in Python 3.14 — the wrapper must
+        # answer on this interpreter too
+        lk = lockwatch.WatchedLock("R", lock=threading.RLock())
+        assert lk.locked() is False
+        with lk:
+            assert lk.locked() is True
+        assert lk.locked() is False
+
+    def test_enable_idempotent_policy_conflict_loud(self):
+        wd = lockwatch.enable()
+        assert lockwatch.enable() is wd
+        with pytest.raises(EnforceError):
+            lockwatch.enable(raise_on_inversion=True)
+
+
+# ---------------------------------------------------------------------------
+# order recording + inversion detection
+# ---------------------------------------------------------------------------
+
+
+class TestInversionDetection:
+    def test_edges_recorded_with_counts(self):
+        wd = lockwatch.enable()
+        a = lockwatch.WatchedLock("A")
+        b = lockwatch.WatchedLock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert wd.edges() == {("A", "B"): 3}
+        assert wd.violations == []
+
+    def test_inversion_caught_with_both_witness_stacks(self):
+        wd = lockwatch.enable()
+        a = lockwatch.WatchedLock("A")
+        b = lockwatch.WatchedLock("B")
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        # sequential is enough: the ORDER graph cycles regardless of
+        # overlap — that is the whole point (catch the deadlock that
+        # has not happened yet)
+        _run_threads(order_ab)
+        _run_threads(order_ba)
+        assert len(wd.violations) == 1
+        v = wd.violations[0]
+        assert set(v["cycle"]) == {"A", "B"}
+        # BOTH witness stacks present and naming their call paths
+        assert any("order_ba" in f for f in v["witness"])
+        assert any("order_ab" in f for f in v["prior_witness"])
+        assert v["thread"] != v["prior_thread"]
+        rep = wd.report()
+        assert rep["edges"] == {"A -> B": 1, "B -> A": 1}
+        assert len(rep["violations"]) == 1
+
+    def test_three_lock_cycle_detected(self):
+        wd = lockwatch.enable()
+        lks = {n: lockwatch.WatchedLock(n) for n in "ABC"}
+
+        def take(x, y):
+            with lks[x]:
+                with lks[y]:
+                    pass
+
+        take("A", "B")
+        take("B", "C")
+        assert wd.violations == []
+        take("C", "A")  # closes A->B->C->A
+        assert len(wd.violations) == 1
+        assert set(wd.violations[0]["cycle"]) == {"A", "B", "C"}
+
+    def test_raise_on_inversion_policy(self):
+        lockwatch.enable(raise_on_inversion=True)
+        a = lockwatch.WatchedLock("A")
+        b = lockwatch.WatchedLock("B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockwatch.LockOrderError):
+            with b:
+                with a:
+                    pass
+        # the failed path still released cleanly
+        assert not a.locked() and not b.locked()
+
+    def test_release_out_of_order_keeps_held_set_right(self):
+        wd = lockwatch.enable()
+        a = lockwatch.WatchedLock("A")
+        b = lockwatch.WatchedLock("B")
+        a.acquire()
+        b.acquire()
+        a.release()   # release A first: only B is held now
+        c = lockwatch.WatchedLock("C")
+        with c:
+            pass
+        b.release()
+        # C was acquired under B only — never under A
+        assert ("B", "C") in wd.edges()
+        assert ("A", "C") not in wd.edges()
+
+
+# ---------------------------------------------------------------------------
+# static-graph validation (the two halves meet)
+# ---------------------------------------------------------------------------
+
+
+class TestVerifyStatic:
+    def test_observed_subset_of_static_is_sound(self, tmp_path):
+        (tmp_path / "m.py").write_text(textwrap.dedent("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """))
+        static = lock_order_graph([str(tmp_path)])
+        mod = f"{tmp_path.name}.m"  # <parent_dir>.<stem> identity
+        wd = lockwatch.enable()
+        a = lockwatch.WatchedLock(f"{mod}:C._a")
+        b = lockwatch.WatchedLock(f"{mod}:C._b")
+        with a:
+            with b:
+                pass
+        out = wd.verify_static(static)
+        assert out["unmodeled"] == [] and out["violations"] == []
+
+    def test_unmodeled_edge_reported_with_runtime_witness(self):
+        wd = lockwatch.enable()
+        a = lockwatch.WatchedLock("m:C._a")
+        b = lockwatch.WatchedLock("m:C._b")
+        with b:
+            with a:   # order the static model never predicted
+                pass
+        out = wd.verify_static({("m:C._a", "m:C._b"): "static"})
+        assert len(out["unmodeled"]) == 1
+        rec = out["unmodeled"][0]
+        assert rec["edge"] == ("m:C._b", "m:C._a")
+        assert rec["witness"]  # runtime stack attached
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when disabled (the telemetry discipline, test-pinned)
+# ---------------------------------------------------------------------------
+
+
+class TestZeroCost:
+    def test_disabled_lock_records_nothing(self, monkeypatch):
+        tripped = []
+        monkeypatch.setattr(
+            lockwatch.LockOrderWatchdog, "note_acquire",
+            lambda self, name: tripped.append(("acq", name)))
+        monkeypatch.setattr(
+            lockwatch.LockOrderWatchdog, "note_release",
+            lambda self, name: tripped.append(("rel", name)))
+        monkeypatch.setattr(
+            lockwatch, "_capture_stack",
+            lambda: tripped.append("stack"))
+        lk = lockwatch.WatchedLock("Z")
+        with lk:
+            with lockwatch.WatchedLock("Y"):
+                pass
+        assert tripped == []
+
+    def test_disabled_lock_never_consults_fault_injector(self):
+        # the lock.acquire point fires ONLY while the watchdog is on:
+        # an armed injector must see zero calls from a disabled lock
+        inj = FaultInjector(seed=3).on("lock.acquire", delay_s=0.0)
+        with inj:
+            lk = lockwatch.WatchedLock("Z")
+            with lk:
+                pass
+        assert inj.calls["lock.acquire"] == 0
+
+    def test_active_mirrors_enable_disable(self):
+        assert lockwatch.active() is None
+        wd = lockwatch.enable()
+        assert lockwatch.active() is wd
+        lockwatch.disable()
+        assert lockwatch.active() is None
+
+
+# ---------------------------------------------------------------------------
+# chaos: the seeded injected inversion (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestSeededInversion:
+    def test_injected_inversion_caught_with_witness_stacks(self):
+        """The deterministic drive: a seeded ``lock.acquire`` delay
+        rule matched to ONE lock stretches its acquire window so the
+        two workers' critical sections genuinely overlap (a REAL
+        inversion, both locks concurrently held somewhere), and the
+        watchdog must catch it naming both witness stacks."""
+        wd = lockwatch.enable()
+        outer = lockwatch.WatchedLock("router.mu")
+        inner = lockwatch.WatchedLock("replica.mu")
+        inj = FaultInjector(seed=7).on("lock.acquire", delay_s=0.05,
+                                       match="replica.mu", times=1)
+
+        def forward_path():
+            with outer:
+                time.sleep(0.02)
+                with inner:  # delayed 50ms by the injector
+                    pass
+
+        def inverted_path():
+            time.sleep(0.01)  # start inside forward's hold window
+            with inner:
+                time.sleep(0.02)
+                with outer:
+                    pass
+
+        with inj:
+            _run_threads(forward_path, inverted_path)
+
+        assert inj.fired["lock.acquire"] == 1  # the seeded delay hit
+        assert len(wd.violations) == 1
+        v = wd.violations[0]
+        assert set(v["cycle"]) == {"router.mu", "replica.mu"}
+        # both witness stacks name their acquisition paths
+        both = v["witness"] + v["prior_witness"]
+        assert any("forward_path" in f for f in both)
+        assert any("inverted_path" in f for f in both)
+        # deterministic: the same seed fires the same schedule
+        replay = FaultInjector(seed=7).on("lock.acquire", delay_s=0.05,
+                                          match="replica.mu", times=1)
+        assert replay.seed == 7 and inj.calls["lock.acquire"] >= 2

@@ -536,7 +536,14 @@ def test_streamed_tickets_match_and_measure():
             assert [r["tok"] for r in recs
                     if "i" in r] == t.tokens.tolist()
             assert recs[-1]["event"] == "end"
-            assert t.t_first_stream is not None
+            # the TTFT claim is lock-arbitrated between the pump (live
+            # first token -> t_first_stream stamped) and the harvest
+            # (_finish outran the pump on a fast completion ->
+            # replica-side TTFT, t_first_stream stays None). Either
+            # claimant is legal; asserting the pump always wins was a
+            # race (flaked under load — found by the PT-RACE dogfood)
+            if t.t_first_stream is not None:
+                assert t.t_first_stream >= t.t_submit
             assert t.ttft_s is not None and t.ttft_s > 0
             solo = _decoder(pages=24, slots=2)
             rid = solo.submit(p, 8)

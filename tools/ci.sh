@@ -36,9 +36,19 @@ case "$MODE" in
   smoke|mid|full)
     # repo lint (analysis/lint.py): the framework's own invariants —
     # atomic state writes, span clocks, thread names, donation hygiene,
-    # debug leftovers. Pure AST, budget well under 20 s.
+    # debug leftovers. Pure AST, budget well under 20 s. Family-scoped
+    # so the race-smoke stage below isn't a duplicate repo walk.
     stage "repo lint (tools/lint.py)"
-    JAX_PLATFORMS=cpu python tools/lint.py || exit $?
+    JAX_PLATFORMS=cpu python tools/lint.py --select PT-LINT || exit $?
+    # race smoke: the concurrency verification plane — the PT-RACE
+    # static pass repo-wide (lock-order inversions, unsynced shared
+    # writes, blocking-under-lock) plus the runtime lock-order
+    # watchdog's unit tests incl. the seeded injected inversion.
+    # Pure AST + thread-only tests; stays inside the ~20 s lint budget.
+    stage "race smoke (PT-RACE lint + lock-order watchdog units)"
+    JAX_PLATFORMS=cpu python tools/lint.py --select PT-RACE || exit $?
+    JAX_PLATFORMS=cpu python -m pytest tests/test_lockwatch.py -q \
+      || exit $?
     ;;
 esac
 

@@ -1,9 +1,12 @@
 #!/usr/bin/env python
-"""Repo lint CLI — drives ``paddle_tpu.analysis.lint`` over the tree.
+"""Repo lint CLI — drives ``paddle_tpu.analysis.lint`` AND the
+concurrency verifier (``paddle_tpu.analysis.concurrency``) over the
+tree.
 
 The ``lint`` stage of ``tools/ci.sh`` (smoke and up) runs this over
-``paddle_tpu/``; exit 1 means findings. Suppress a deliberate hit with
-``# pt-lint: disable=PT-LINT-xxx <reason>`` on (or above) the flagged
+``paddle_tpu/``; the ``race smoke`` stage re-runs it with ``--select
+PT-RACE``; exit 1 means findings. Suppress a deliberate hit with
+``# pt-lint: disable=PT-XXXX-nnn <reason>`` on (or above) the flagged
 line — the reason is required.
 
 Usage:
@@ -11,6 +14,7 @@ Usage:
   python tools/lint.py path1 path2 ...      # lint specific files/trees
   python tools/lint.py --format=json        # machine-readable findings
   python tools/lint.py --select=PT-LINT-301 # only some codes
+  python tools/lint.py --select=PT-RACE     # a whole family (prefix)
 """
 
 from __future__ import annotations
@@ -31,24 +35,42 @@ def main(argv=None) -> int:
                     help="files or directories (default: paddle_tpu/)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--select", default=None,
-                    help="comma-separated PT-LINT codes to report "
-                         "(default: all)")
+                    help="comma-separated codes OR family prefixes to "
+                         "report (e.g. PT-LINT-301, PT-RACE; "
+                         "default: all)")
     args = ap.parse_args(argv)
 
-    from paddle_tpu.analysis import format_diagnostics, lint_paths
+    from paddle_tpu.analysis import (analyze_paths, format_diagnostics,
+                                     lint_paths)
+    from paddle_tpu.analysis.concurrency import RACE_CODES
     from paddle_tpu.analysis.lint import LINT_CODES
 
+    known = set(LINT_CODES) | set(RACE_CODES)
     select = None
     if args.select:
         select = {c.strip().upper() for c in args.select.split(",")}
-        unknown = select - set(LINT_CODES)
+        unknown = {c for c in select
+                   if c not in known
+                   and not any(k.startswith(c + "-") or k == c
+                               for k in known)}
         if unknown:
             print(f"unknown codes: {sorted(unknown)} "
-                  f"(known: {sorted(LINT_CODES)})", file=sys.stderr)
+                  f"(known: {sorted(known)} or a family prefix like "
+                  f"PT-RACE)", file=sys.stderr)
             return 2
-    findings = lint_paths(args.paths)
-    if select is not None:
-        findings = [d for d in findings if d.code in select]
+
+    def selected(code: str) -> bool:
+        return (select is None or code in select
+                or any(code.startswith(s + "-") for s in select))
+
+    # run only the passes whose codes are selected — `--select
+    # PT-RACE` must not pay for (or re-gate) the whole lint family
+    findings = []
+    if any(selected(c) for c in LINT_CODES):
+        findings += lint_paths(args.paths)
+    if any(selected(c) for c in RACE_CODES):
+        findings += analyze_paths(args.paths)
+    findings = [d for d in findings if selected(d.code)]
     if args.format == "json":
         print(json.dumps({
             "count": len(findings),
