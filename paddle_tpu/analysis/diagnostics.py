@@ -35,6 +35,14 @@ PT-LINT-305    Repo lint: leftover debug hook (jax.debug.print, ...)
 PT-LINT-306    Repo lint: HTTP hop without trace-header propagation
 PT-LINT-307    Repo lint: SSE/chunked writer missing per-event flush
                or trace-header echo
+PT-LINT-308    Repo lint: attend-path QuantizedPool dispatch branch
+               outside ops/paged_kv.py (storage-form dispatch must
+               stay at the one attend boundary; kernels take raw
+               (values, scales) arrays)
+PT-TUNE-501    Tuning table: device-matched decode entry exists only
+               under the legacy pre-int8 key — dtype-keyed entry
+               missing (stale table; re-run tools/pallas_tune.py
+               --decode on the chip)
 PT-RACE-401    Concurrency: shared attribute written from a thread
                entry with no common lock
 PT-RACE-402    Concurrency: lock-order inversion (cycle in the

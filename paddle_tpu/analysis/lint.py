@@ -54,6 +54,8 @@ LINT_CODES = {
     "PT-LINT-306": "HTTP hop without trace-header propagation",
     "PT-LINT-307": "SSE/chunked response writer missing per-event "
                    "flush or trace-header echo",
+    "PT-LINT-308": "attend-path QuantizedPool dispatch branch outside "
+                   "ops/paged_kv.py",
 }
 
 # callees whose arguments get donated (this repo's donating entry
@@ -87,6 +89,14 @@ TRACE_MARKERS = {"_trace_headers", "trace_headers", "to_header",
 # streaming) and touch the trace-header surface (echo X-PT-Trace) so
 # the stream stays on the request's trace.
 SSE_CONTENT_TYPE = "text/event-stream"
+
+# PT-LINT-308: ops/paged_kv.py is THE storage-form dispatch boundary —
+# attend() unpacks a QuantizedPool into raw (values, scales) arrays
+# before anything kernel- or serving-side sees it. An isinstance branch
+# on QuantizedPool anywhere else re-opens the pre-PR 15 drift hazard
+# (two dispatch sites whose eligibility rules diverge silently).
+POOL_DISPATCH_FILE = "ops/paged_kv.py"
+POOL_DISPATCH_CLASS = "QuantizedPool"
 
 _SUPPRESS_RE = re.compile(
     r"#\s*pt-lint:\s*disable=([A-Za-z0-9\-, ]+?)(?:\s+(.*))?$")
@@ -138,6 +148,7 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         norm = path.replace("\\", "/")
         self._trace_file = any(norm.endswith(f) for f in TRACE_FILES)
+        self._pool_dispatch_file = norm.endswith(POOL_DISPATCH_FILE)
         self.findings: List[Diagnostic] = []
         self._span_depth = 0
         # open-file bindings live per `with` body: name -> mode
@@ -382,6 +393,23 @@ class _Linter(ast.NodeVisitor):
                 "build headers through _trace_headers(...) (or stamp "
                 "tracing.current().to_header() onto "
                 "tracing.TRACE_HEADER)")
+
+        # PT-LINT-308: isinstance(x, QuantizedPool) outside the one
+        # dispatch boundary — storage-form branches belong to
+        # ops/paged_kv.py; everything downstream takes raw arrays
+        if (callee == "isinstance" and not self._pool_dispatch_file
+                and len(node.args) == 2):
+            classes = (list(node.args[1].elts)
+                       if isinstance(node.args[1], (ast.Tuple, ast.List))
+                       else [node.args[1]])
+            if any(_terminal(c) == POOL_DISPATCH_CLASS for c in classes):
+                self._flag(
+                    "PT-LINT-308", node,
+                    "attend-path QuantizedPool dispatch branch outside "
+                    "ops/paged_kv.py",
+                    "keep storage-form dispatch at the attend boundary "
+                    "(ops/paged_kv.py); pass raw (values, scales) "
+                    "arrays across kernel/serving seams instead")
 
         # PT-LINT-304: device_get result into a donating call
         if _is_donating_callee(node.func):

@@ -550,8 +550,12 @@ class _MHADecodeMixin:
         k_c, v_c = self._project_kv_t(x_chunk, pos_chunk)
         kpool, vpool = paged_kv.write_chunk(
             kpool, vpool, table_row, t0, k_c, v_c, kpool.shape[1])
-        k = paged_kv.gather_rows(kpool, table_row[None])
-        v = paged_kv.gather_rows(vpool, table_row[None])
+        # static chunk extent (the bucketed-prefill case: t0 is a
+        # Python int) -> gather/dequantize only the live page columns
+        # instead of the row's full logical view
+        upto = t0 + s if isinstance(t0, int) else None
+        k = paged_kv.gather_rows(kpool, table_row[None], upto=upto)
+        v = paged_kv.gather_rows(vpool, table_row[None], upto=upto)
         cap = k.shape[1]
         pos = jnp.arange(cap)
         keep = pos[None, :] <= pos_chunk[:, None]             # (S, cap)

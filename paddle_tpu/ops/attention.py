@@ -220,12 +220,22 @@ def _get_flash_decode():
         return None
 
 
-def decode_flash_ok(capacity: int, d: int) -> bool:
+def decode_flash_ok(capacity: int, d: int,
+                    pool_dtype: str = "f32",
+                    page_size: Optional[int] = None) -> bool:
     """Dispatch gate for the single-position decode kernel
     (pallas/flash_decode.py): TPU backend (or force_flash), supported
     head dim, block-divisible cache capacity. A separate gate from
     flash_shape_ok — decode shapes (tq=1 against a fixed capacity)
-    never satisfy the training kernel's block rules."""
+    never satisfy the training kernel's block rules. ``pool_dtype``
+    keys the tuned verdict per KV storage form ("f32" | "int8" — the
+    int8 paged variant dequantizes in-kernel and has its own measured
+    winner). ``page_size``: for paged pools the page IS the kernel
+    block, fixed by the deployed pool rather than chosen at dispatch —
+    a tuned entry carrying per-page verdicts (``use_flash_by_page``,
+    tools/pallas_tune.py) answers for THAT page size; the aggregate
+    ``use_flash`` (measured at the tuner's best page) only decides
+    when the deployed page was never swept."""
     if (not _FORCE_FLASH
             and jax.default_backend() not in ("tpu", "axon")):
         return False
@@ -235,10 +245,17 @@ def decode_flash_ok(capacity: int, d: int) -> bool:
         return False
     if d not in _FLASH_HEAD_DIMS or decode_block_k(capacity) is None:
         return False
-    from .pallas.tuning import decode_key, get_tuned
+    from .pallas.tuning import get_tuned_decode
 
-    tuned = get_tuned(decode_key(capacity, d))
-    return tuned is None or tuned.get("use_flash", True)
+    tuned = get_tuned_decode(capacity, d, pool_dtype)
+    if tuned is None:
+        return True
+    by_page = tuned.get("use_flash_by_page")
+    if page_size is not None and by_page is not None:
+        verdict = by_page.get(str(page_size))
+        if verdict is not None:
+            return bool(verdict)
+    return tuned.get("use_flash", True)
 
 
 def _flash_ok(q, k, causal: bool = False, window=None) -> bool:

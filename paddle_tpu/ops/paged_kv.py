@@ -13,12 +13,18 @@ Pools come in two storage forms, transparent to every caller:
   head_dim vector). KV bytes set the concurrent-session ceiling per
   chip, so int8 KV ~= 3.7x the pages of fp32 (1 + 4/head_dim bytes per
   element vs 4) at the same HBM. Writes QUANTIZE ON APPEND (each K/V
-  vector encoded once, at write time); attention DEQUANTIZES the
-  gathered pages only (never the whole pool), so the working set stays
-  O(live tokens). Quantized pools take the gather path — the Pallas
-  paged kernel reads raw pool blocks via scalar-prefetched DMA and has
-  no epilogue slot for scales yet; the kernel-side int8 path slots in
-  here when it grows one.
+  vector encoded once, at write time); attention DEQUANTIZES only the
+  blocks it touches (never the whole pool), so the working set stays
+  O(live tokens). Quantized decode rides the SAME Pallas paged kernel
+  as float pools when eligible: int8 blocks stream from HBM with their
+  scale blocks prefetched along the same clamped page walk, and dequant
+  happens in VMEM as a per-block epilogue (flash_decode_paged's
+  k_scale/v_scale form) — O(t) DMA plus ~4x fewer HBM bytes per block.
+  The gather path remains the fallback (CPU, ineligible shapes,
+  measured use_flash=False verdicts).
+
+This module is the ONE place that branches on the pool storage form —
+kernels and serving code take raw arrays (PT-LINT-308 pins it).
 
 Green-field (the modern serving-memory capability; the reference's
 serving holds one contiguous buffer per request,
@@ -178,26 +184,43 @@ def import_pages(pool, ids, payload):
     return pool.at[ids].set(jnp.asarray(payload).astype(pool.dtype))
 
 
-def gather_rows(pool, table):
-    """Assemble each row's LOGICAL cache: (B, n_log*page_size, kv, hd).
+def gather_rows(pool, table, upto: Optional[int] = None,
+                full: bool = False):
+    """Assemble each row's LOGICAL cache: (B, n_cols*page_size, kv, hd).
     The fallback/prefill view; the decode kernel never materializes
     it. Quantized pools dequantize HERE — only the gathered rows ever
-    exist in float."""
+    exist in float.
+
+    ``upto``: STATIC bound on the live positions (the prefill path,
+    where the chunk extent t0+S is a Python int) — only the first
+    ``ceil(upto / page_size)`` table columns are gathered/dequantized,
+    so a short prompt over a long table stops materializing (and for
+    quantized pools, dequantizing) the full logical view in float32.
+    None (traced cursors: the decode fallback) or ``full=True`` (the
+    explicit full-view escape for tests/handoffs) keeps the whole
+    view."""
     b, n_log = table.shape
+    ps = pool.shape[1]
+    if upto is not None and not full:
+        n_cols = min(n_log, max(1, -(-int(upto) // ps)))
+        table = table[:, :n_cols]
+        n_log = n_cols
     if isinstance(pool, QuantizedPool):
         vals = (pool.q[table].astype(jnp.float32)
                 * pool.scale[table][..., None])
-        return vals.reshape(b, n_log * pool.shape[1], *pool.shape[2:])
-    return pool[table].reshape(b, n_log * pool.shape[1],
-                               *pool.shape[2:])
+        return vals.reshape(b, n_log * ps, *pool.shape[2:])
+    return pool[table].reshape(b, n_log * ps, *pool.shape[2:])
 
 
 def attend(q, kpool, vpool, table, t_rows,
            window: Optional[int] = None):
     """Decode attention over the paged cache: the Pallas paged kernel
-    when eligible, else gather-the-pages + masked XLA (always the
-    gather path for quantized pools — dequant happens on the gathered
-    rows). ``t_rows``: scalar or (B,) logical cursors."""
+    when eligible — float AND quantized pools; int8 pools hand the
+    kernel their raw (values, scales) planes and dequant runs as the
+    kernel's per-block VMEM epilogue — else gather-the-pages + masked
+    XLA (dequant on the gathered rows). ``t_rows``: scalar or (B,)
+    logical cursors. THE storage-form dispatch boundary: nothing past
+    this call branches on :class:`QuantizedPool`."""
     from . import attention as A
 
     d = q.shape[-1]
@@ -206,11 +229,17 @@ def attend(q, kpool, vpool, table, t_rows,
     # broadcasts; the gather fallback must match)
     t_rows = jnp.broadcast_to(jnp.asarray(t_rows, jnp.int32),
                               (q.shape[0],))
-    if (not isinstance(kpool, QuantizedPool)
-            and A.decode_flash_ok(page_size * n_log, d)
+    quantized = isinstance(kpool, QuantizedPool)
+    if (A.decode_flash_ok(page_size * n_log, d,
+                          "int8" if quantized else "f32", page_size)
             and A._get_flash_decode() is not None):
         from .pallas.flash_decode import flash_decode_paged
 
+        if quantized:
+            return flash_decode_paged(
+                q, kpool.q, vpool.q, table, t_rows,
+                k_scale=kpool.scale, v_scale=vpool.scale,
+                window=window)
         return flash_decode_paged(q, kpool, vpool, table, t_rows,
                                   window=window)
     k = gather_rows(kpool, table)

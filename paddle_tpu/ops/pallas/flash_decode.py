@@ -18,6 +18,15 @@ slices of those rows, reading each shared K/V block once. Online
 softmax carries (m, l, acc) in VMEM scratch across kv blocks exactly
 like the training kernel (flash_attention.py).
 
+The paged form has an int8-native variant (ISSUE 15): K/V blocks
+stream from HBM as raw int8 with their per-(page, pos, kv_head) f32
+scales prefetched along the SAME clamped page walk, and dequant runs
+in VMEM as a per-block epilogue before the online-softmax update —
+quantized decode keeps the O(t) DMA behavior and moves ~4x fewer HBM
+bytes per block. This module only ever sees raw arrays; the
+QuantizedPool-vs-float dispatch lives in ops/paged_kv.attend
+(PT-LINT-308 pins that boundary).
+
 Inference-only: no VJP (the decode loop never differentiates).
 Reference niche: the hand-tuned JIT kernel layer,
 /root/reference/paddle/fluid/operators/jit/ — decode attention is the
@@ -45,9 +54,16 @@ if pltpu is None:  # pragma: no cover
 DEFAULT_DECODE_BLOCK_K = 256
 
 
-def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                   l_ref, *, scale, window, block_k, n_j, nheads,
-                   kv_heads):
+def _decode_core(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                 l_ref, ks_ref, vs_ref, *, scale, window, block_k, n_j,
+                 nheads, kv_heads):
+    """Shared online-softmax decode body. ``ks_ref``/``vs_ref``: the
+    int8 variant's per-(position, kv_head) f32 scale blocks — when
+    present, K/V blocks arrive as raw int8 and dequantize HERE, in
+    VMEM, as an epilogue on each block before the softmax update (the
+    pool streams ~4x fewer HBM bytes per block; float never exists
+    outside the block working set). None = the float path, bit-for-bit
+    the pre-int8 kernel."""
     b, j = pl.program_id(0), pl.program_id(1)
     t = t_ref[b]  # PER-ROW cursor (continuous batching: each slot at
     # its own position; the classic shared-cursor decode broadcasts)
@@ -69,6 +85,11 @@ def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         for hk in range(kv_heads):
             qg = q[hk * group:(hk + 1) * group]       # (G, D)
             kk = k_ref[0, :, hk]                      # (block_k, D)
+            if ks_ref is not None:
+                # dequant epilogue: int8 block * per-vector scale, f32
+                kk = (kk.astype(jnp.float32)
+                      * ks_ref[0, :, hk][:, None])
+                qg = qg.astype(jnp.float32)
             parts.append(jax.lax.dot_general(
                 qg, kk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))
@@ -88,6 +109,9 @@ def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         pvs = []
         for hk in range(kv_heads):
             vv = v_ref[0, :, hk]                      # (block_k, D)
+            if vs_ref is not None:
+                vv = (vv.astype(jnp.float32)
+                      * vs_ref[0, :, hk][:, None])
             pg = p[hk * group:(hk + 1) * group]
             pvs.append(jax.lax.dot_general(
                 pg.astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
@@ -103,6 +127,13 @@ def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _decode_kernel(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, **kw):
+    """Float decode kernel — the core with no scale planes."""
+    _decode_core(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                 l_ref, None, None, **kw)
+
+
 def _paged_kernel(t_ref, table_ref, *rest, **kw):
     """The paged variant IS _decode_kernel: page translation happens
     entirely in the specs' index maps (which consume table_ref); the
@@ -112,7 +143,19 @@ def _paged_kernel(t_ref, table_ref, *rest, **kw):
     _decode_kernel(t_ref, *rest, **kw)
 
 
+def _paged_kernel_quant(t_ref, table_ref, q_ref, k_ref, ks_ref, v_ref,
+                        vs_ref, o_ref, acc_ref, m_ref, l_ref, **kw):
+    """int8 paged variant: K/V blocks stream raw int8 with their
+    per-(page, pos, kv_head) scale blocks prefetched alongside (same
+    page walk in the index maps); the core dequantizes per block in
+    VMEM."""
+    del table_ref
+    _decode_core(t_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                 l_ref, ks_ref, vs_ref, **kw)
+
+
 def flash_decode_paged(q, kpool, vpool, table, t, *,
+                       k_scale=None, v_scale=None,
                        window: Optional[int] = None,
                        scale: Optional[float] = None,
                        interpret: Optional[bool] = None):
@@ -125,6 +168,15 @@ def flash_decode_paged(q, kpool, vpool, table, t, *,
     slots x max-capacity. q: (B, 1, H, D); t: scalar or (B,) per-row
     cursors (LOGICAL positions). Returns (B, 1, H, D).
 
+    int8 pools: pass the RAW int8 value pools as ``kpool``/``vpool``
+    and their per-(page, pos, kv_head) f32 scale planes as
+    ``k_scale``/``v_scale`` — scale blocks ride the same clamped page
+    walk and dequant happens in VMEM per block (the epilogue), so
+    quantized decode keeps the O(t) DMA behavior AND streams ~4x fewer
+    HBM bytes per block. The storage-form dispatch (QuantizedPool or
+    float) stays in ops/paged_kv.attend — this kernel only ever sees
+    raw arrays.
+
     Entries of ``table`` beyond a row's live range may be garbage (the
     index map clamps to the live page walk); pages are block_k-sized by
     construction. The serving-side pool manager is
@@ -134,10 +186,20 @@ def flash_decode_paged(q, kpool, vpool, table, t, *,
             "got %s", tq)
     enforce(window is None or window >= 1,
             "window must be >= 1, got %s", window)
+    enforce((k_scale is None) == (v_scale is None),
+            "k_scale and v_scale come together (int8 pools) or not at "
+            "all (float pools)")
     pages, block_k, kv_h, dk = kpool.shape
     enforce(dk == d, "pool head_dim %s != q head_dim %s", dk, d)
     enforce(h % kv_h == 0, "heads %s not divisible by kv heads %s", h,
             kv_h)
+    quantized = k_scale is not None
+    if quantized:
+        for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+            enforce(tuple(sc.shape) == (pages, block_k, kv_h),
+                    "%s must be the pool's (pages, page_size, "
+                    "kv_heads) scale plane %s, got %s",
+                    name, (pages, block_k, kv_h), tuple(sc.shape))
     n_log = table.shape[1]
     enforce(table.shape[0] == b,
             "table rows %s != batch %s", table.shape[0], b)
@@ -149,28 +211,41 @@ def flash_decode_paged(q, kpool, vpool, table, t, *,
     t_arr = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (b,))
     table = table.astype(jnp.int32)
 
-    def kv_imap(b_, j, t_, table_):
+    def _live_page(b_, j, t_, table_):
         jj = jnp.minimum(j, t_[b_] // block_k)
         if window is not None:
             jj = jnp.maximum(
                 jj, jnp.maximum(t_[b_] - window + 1, 0) // block_k)
-        page = jnp.clip(table_[b_, jj], 0, pages - 1)
-        return (page, 0, 0, 0)
+        return jnp.clip(table_[b_, jj], 0, pages - 1)
 
-    kernel = functools.partial(
-        _paged_kernel, scale=scale, window=window, block_k=block_k,
-        n_j=n_log, nheads=h, kv_heads=kv_h)
+    def kv_imap(b_, j, t_, table_):
+        return (_live_page(b_, j, t_, table_), 0, 0, 0)
+
+    def sc_imap(b_, j, t_, table_):
+        # the scale plane walks the SAME clamped live pages
+        return (_live_page(b_, j, t_, table_), 0, 0)
+
     qo_spec = pl.BlockSpec((1, h, d), lambda b_, j, t_, tb_: (b_, 0, 0))
+    kv_spec = pl.BlockSpec((1, block_k, kv_h, d), kv_imap)
+    kw = dict(scale=scale, window=window, block_k=block_k, n_j=n_log,
+              nheads=h, kv_heads=kv_h)
+    if quantized:
+        sc_spec = pl.BlockSpec((1, block_k, kv_h), sc_imap)
+        kernel = functools.partial(_paged_kernel_quant, **kw)
+        in_specs = [qo_spec, kv_spec, sc_spec, kv_spec, sc_spec]
+        operands = (t_arr, table, qh, kpool,
+                    k_scale.astype(jnp.float32), vpool,
+                    v_scale.astype(jnp.float32))
+    else:
+        kernel = functools.partial(_paged_kernel, **kw)
+        in_specs = [qo_spec, kv_spec, kv_spec]
+        operands = (t_arr, table, qh, kpool, vpool)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_log),
-            in_specs=[
-                qo_spec,
-                pl.BlockSpec((1, block_k, kv_h, d), kv_imap),
-                pl.BlockSpec((1, block_k, kv_h, d), kv_imap),
-            ],
+            in_specs=in_specs,
             out_specs=qo_spec,
             scratch_shapes=[
                 _scratch((h, d), jnp.float32),
@@ -180,7 +255,7 @@ def flash_decode_paged(q, kpool, vpool, table, t, *,
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=interpret,
-    )(t_arr, table, qh, kpool, vpool)
+    )(*operands)
     return out[:, None]
 
 
@@ -189,9 +264,9 @@ def decode_block_k(capacity: int, d: Optional[int] = None) -> Optional[int]:
     table has one (tools/pallas_tune.py --decode), else the largest
     supported divisor. None = shape ineligible for the kernel."""
     if d is not None:
-        from .tuning import decode_key, get_tuned
+        from .tuning import get_tuned_decode
 
-        tuned = get_tuned(decode_key(capacity, d))
+        tuned = get_tuned_decode(capacity, d, "f32")
         if tuned is not None:
             bk = tuned.get("block_k")
             if bk and capacity % bk == 0:

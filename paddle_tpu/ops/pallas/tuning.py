@@ -76,11 +76,95 @@ def attention_key(tq: int, tk: int, d: int, causal: bool,
             f"{'causal' if causal else 'full'}")
 
 
-def decode_key(capacity: int, d: int, kind: Optional[str] = None) -> str:
-    """Flash-decode bucket: capacity x head_dim (t varies at runtime
-    inside one compiled loop, heads only change the tiny row count)."""
+def decode_key(capacity: int, d: int, kind: Optional[str] = None,
+               pool_dtype: str = "f32") -> str:
+    """Flash-decode bucket: capacity x head_dim x POOL DTYPE (t varies
+    at runtime inside one compiled loop, heads only change the tiny row
+    count). ``pool_dtype`` names the KV storage form — the int8 paged
+    variant dequantizes in-kernel (different arithmetic intensity, its
+    own winner), so entries are keyed per form. Float keys carry an
+    explicit ``|pf32`` suffix; pre-dtype tables (no suffix) are honored
+    for f32 lookups through :func:`get_tuned_decode`'s legacy fallback."""
+    return (f"flash_decode|{kind or _device_kind()}|"
+            f"cap{_pow2_bucket(capacity)}|d{d}|p{pool_dtype}")
+
+
+def _legacy_decode_key(capacity: int, d: int,
+                       kind: Optional[str] = None) -> str:
+    """The pre-dtype (PR <15) decode key form — read-only back-compat."""
     return (f"flash_decode|{kind or _device_kind()}|"
             f"cap{_pow2_bucket(capacity)}|d{d}")
+
+
+# keys already diagnosed as stale (warn ONCE per key per process) and
+# the typed findings themselves (tests / CI assert on them)
+_stale_dtype_seen: set = set()
+_stale_dtype_findings: list = []
+
+
+def stale_dtype_findings() -> list:
+    """Typed PT-TUNE-501 findings emitted so far (cleared by
+    :func:`reset_cache`)."""
+    with _lock:
+        return list(_stale_dtype_findings)
+
+
+def _note_stale_dtype(key: str, legacy_key: str) -> None:
+    """A device-matched decode entry exists under the LEGACY (pre-int8)
+    key but the dtype-keyed entry is missing: the table predates the
+    dtype-keyed schema for this shape. Silent fallback would quietly run
+    static default blocks forever — emit a typed diagnostic instead so
+    stale tables are visible (re-running tools/pallas_tune.py --decode
+    on the chip clears it)."""
+    import warnings
+
+    from ...analysis.diagnostics import Diagnostic
+
+    # check-and-record under _lock: concurrent decode traces (router
+    # claim lanes) must not double-emit the warn-ONCE-per-key finding
+    with _lock:
+        if key in _stale_dtype_seen:
+            return
+        _stale_dtype_seen.add(key)
+        diag = Diagnostic(
+            code="PT-TUNE-501", severity="warning",
+            message=(f"tuned_blocks.json has a device-matched decode entry "
+                     f"at {legacy_key!r} but no dtype-keyed entry {key!r} "
+                     f"— stale pre-int8 tuning table for this shape"),
+            hint=("re-run tools/pallas_tune.py --decode on this chip to "
+                  "record the dtype-keyed entries"),
+            path=_TABLE_PATH)
+        _stale_dtype_findings.append(diag)
+    warnings.warn(str(diag), stacklevel=3)
+    if telemetry.enabled():
+        telemetry.registry().counter(
+            "pt_tuning_stale_dtype_total",
+            "decode tuning-table lookups that found only a pre-int8 "
+            "legacy entry for a dtype-keyed shape").inc()
+
+
+def get_tuned_decode(capacity: int, d: int, pool_dtype: str = "f32",
+                     kind: Optional[str] = None) -> Optional[dict]:
+    """Decode-table lookup under the dtype-keyed schema. f32 lookups
+    fall back to the legacy (pre-dtype) key silently — same semantics,
+    the on-disk chips' entries stay live AND a served legacy entry
+    counts as a cache HIT (the kernel really launches with
+    chip-measured blocks — the coverage signal must say so); other
+    dtypes finding ONLY a legacy entry emit the typed PT-TUNE-501
+    diagnostic and return None (static defaults run, but the staleness
+    is visible)."""
+    table = _load()
+    key = decode_key(capacity, d, kind, pool_dtype)
+    legacy_key = _legacy_decode_key(capacity, d, kind)
+    entry = table.get(key)
+    if entry is None and pool_dtype == "f32":
+        entry = table.get(legacy_key)
+    _count_lookup(entry is not None)   # ONE lookup, one hit-or-miss
+    if entry is not None:
+        return entry
+    if pool_dtype != "f32" and table.get(legacy_key) is not None:
+        _note_stale_dtype(key, legacy_key)
+    return None
 
 
 def matmul_key(m: int, n: int, k: int, kind: Optional[str] = None) -> str:
@@ -88,17 +172,21 @@ def matmul_key(m: int, n: int, k: int, kind: Optional[str] = None) -> str:
             f"m{_pow2_bucket(m)}|n{_pow2_bucket(n)}|k{_pow2_bucket(k)}")
 
 
-def get_tuned(key: str) -> Optional[dict]:
-    entry = _load().get(key)
+def _count_lookup(hit: bool) -> None:
+    """hit = a kernel launches with chip-measured blocks; miss = it
+    runs on static defaults (the tuning-coverage signal)."""
     if telemetry.enabled():
-        # hit = a kernel launches with chip-measured blocks; miss = it
-        # runs on static defaults (the tuning-coverage signal)
         telemetry.registry().counter(
-            "pt_tuning_cache_hits_total" if entry is not None
+            "pt_tuning_cache_hits_total" if hit
             else "pt_tuning_cache_misses_total",
             "pallas tuning-table lookups "
-            + ("served by" if entry is not None else "absent from")
+            + ("served by" if hit else "absent from")
             + " tuned_blocks.json").inc()
+
+
+def get_tuned(key: str) -> Optional[dict]:
+    entry = _load().get(key)
+    _count_lookup(entry is not None)
     return entry
 
 
@@ -141,3 +229,5 @@ def reset_cache() -> None:
     with _lock:
         _cache = None
         _session_only.clear()
+        _stale_dtype_seen.clear()
+        del _stale_dtype_findings[:]

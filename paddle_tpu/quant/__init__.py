@@ -9,7 +9,8 @@ from .ops import (MovingAverageState, RangeState, abs_max_scale,
                   moving_average_state_init, quantize_dequantize,
                   quantize_to_int, range_state_init)
 from .collectives import (compress_grads, quantized_pmean,
-                          quantized_pmean_tree, quantized_psum)
+                          quantized_pmean_tree, quantized_psum,
+                          quantized_psum_partitioned)
 from .int8 import (Int8Conv2D, Int8Linear, int8_conv2d,
                    int8_linear, int8_swap)
 from .weight_only import WeightOnlyLinear, apply_weight_only_int8
@@ -24,7 +25,8 @@ __all__ = [
     "fake_quantize_moving_average_abs_max", "fake_quantize_range_abs_max",
     "moving_average_abs_max_scale", "moving_average_state_init",
     "quantize_dequantize", "quantize_to_int", "quantized_pmean",
-    "quantized_pmean_tree", "quantized_psum", "range_state_init",
+    "quantized_pmean_tree", "quantized_psum",
+    "quantized_psum_partitioned", "range_state_init",
     "QuantConfig", "QuantedLayer", "calibrate", "freeze", "quantize_model",
     "int8_linear", "int8_swap", "Int8Linear", "Int8Conv2D", "int8_conv2d",
 ]

@@ -29,12 +29,23 @@ gated, never silently lossy in the failure modes that matter):
 - **stochastic rounding** (``key=``): unbiased ``floor(y + u)``
   rounding so quantization bias cannot accumulate across steps.
 
+:func:`quantized_psum_partitioned` is the same ring rebuilt as a
+``jax.custom_partitioning``-wrapped collective for PJIT-LEVEL callers:
+the stacked per-shard partials stay sharded over the named axis and the
+int8 encode/exchange/accumulate lowers INSIDE the partitioned
+computation (bit-identical to the shard_map form on the same mesh) —
+no shard_map body to write, and GSPMD composes the op with everything
+around it. Both forms funnel their dispatch through
+``utils.compat.native_int8_allreduce()``: the moment the runtime
+exposes a native int8 AllReduce (EQuARX proper), it swaps in under
+both spellings with zero call-site changes.
+
 The explicit (fsdp/tp) pjit path has no user-visible collective — GSPMD
 owns the reduce schedule — so :func:`compress_grads` applies the SAME
 int8 wire-format round-trip at the reduce boundary instead: numerics
 (and therefore the parity gate) match the quantized wire exactly, and
-an XLA-internal int8 AllReduce (the EQuARX runtime hook) slots in
-underneath without an API change when the backend grows one.
+the native-AllReduce seam above slots in underneath without an API
+change when the backend grows one.
 
 Byte accounting is host-side (``pt_collective_bytes_total{compressed=}``
 — traced code cannot touch counters): leaf shapes are static, so the
@@ -46,12 +57,15 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from .. import telemetry
 from ..core.enforce import enforce
+from ..utils import compat
 from .ops import absmax_decode, absmax_encode
 
 # per-group quantization granularity of the wire format (elements per
@@ -184,6 +198,17 @@ def quantized_psum(x, axis_name: str, axis_size: int, *,
     """
     n = int(axis_size)
     enforce(n >= 2, "quantized_psum needs axis_size >= 2, got %s", n)
+    native = compat.native_int8_allreduce()
+    if native is not None and (
+            key is None or not getattr(native, "partial_contract",
+                                       False)):
+        # the runtime grew an in-XLA int8 AllReduce (EQuARX proper):
+        # route through it — same contract, the ring below becomes the
+        # reference implementation. A partial-contract adapter (no
+        # stochastic-rounding support) is refused for key= calls: SR
+        # numerics must never silently degrade to nearest rounding.
+        return native(x, axis_name=axis_name, axis_size=n, group=group,
+                      key=key)
     shape, dt = x.shape, x.dtype
     flat = x.reshape(-1).astype(jnp.float32)
     size = flat.size
@@ -239,6 +264,103 @@ def quantized_pmean(x, axis_name: str, axis_size: int, *,
     wants: mean over batch shards == grad of the global-mean loss)."""
     return quantized_psum(x, axis_name, axis_size, group=group,
                           key=key) / axis_size
+
+
+# ---------------------------------------------------------------------------
+# the custom-partitioned form (pjit-level callers — no shard_map body)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_psum(axis_name: str, group: int, has_key: bool):
+    """Build (and cache per static config) the custom_partitioning
+    wrapper around the int8 ring. The SPMD partitioners have no rule
+    for a quantized collective — under plain pjit the stacked partials
+    would all-gather and reduce in fp32, erasing the byte win. The
+    registered partition keeps the input sharded over ``axis_name`` and
+    lowers to a per-shard body that runs :func:`quantized_psum` over
+    the SAME named axis: the int8 encode/exchange/accumulate executes
+    INSIDE the partitioned computation (per-shard ring, fp32
+    accumulation), not at its edges."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    compat.fix_custom_partitioning_static_args()
+
+    def ref(x, *maybe_key):
+        # global semantics (abstract eval + the no-mesh eager fallback):
+        # the exact fp32 sum over the stacked partials. The partitioned
+        # lowering replaces this with the quantized ring — single-shard
+        # (and eager) calls are exact, multi-shard calls carry the
+        # documented quantization-step bound.
+        return x.astype(jnp.float32).sum(0).astype(x.dtype)
+
+    wrapped = custom_partitioning(ref)
+
+    def _arg_shardings(msh, ndim):
+        xs = NamedSharding(msh, P(axis_name, *([None] * (ndim - 1))))
+        if has_key:
+            return (xs, NamedSharding(msh, P()))
+        return (xs,)
+
+    def partition(mesh, arg_shapes, result_shape):
+        a_sh = arg_shapes[0].sharding
+        msh = getattr(a_sh, "mesh", None) or mesh
+        n = int(msh.shape[axis_name])
+        ndim = len(arg_shapes[0].shape)
+
+        def lower_fn(x_local, *maybe_key):
+            # local partials fold first (any even sharding of the
+            # leading dim is correct: sum of local sums == global sum),
+            # then ONE ring over the named axis
+            part = x_local.astype(jnp.float32).sum(0)
+            k = maybe_key[0] if maybe_key else None
+            if k is not None:
+                # per-device independent draws (unbiasedness is
+                # per-element; see quantized_psum's key contract)
+                k = jax.random.fold_in(k, lax.axis_index(axis_name))
+            if n < 2:
+                out = part
+            else:
+                out = quantized_psum(part, axis_name, n, group=group,
+                                     key=k)
+            return out.astype(x_local.dtype)
+
+        return (msh, lower_fn, NamedSharding(msh, P()),
+                _arg_shardings(msh, ndim))
+
+    def infer_sharding_from_operands(mesh, arg_shapes, shape):
+        a_sh = arg_shapes[0].sharding
+        msh = getattr(a_sh, "mesh", None) or mesh
+        return NamedSharding(msh, P())
+
+    compat.def_partition(
+        wrapped, partition=partition,
+        infer_sharding_from_operands=infer_sharding_from_operands)
+    return wrapped
+
+
+def quantized_psum_partitioned(x, axis_name: str, *,
+                               group: int = GROUP_SIZE, key=None):
+    """:func:`quantized_psum` as a ``jax.custom_partitioning``-wrapped
+    collective — the pjit-level spelling (no shard_map body to write).
+    ``x`` (n, ...) stacks the per-shard partials on dim 0, sharded over
+    mesh axis ``axis_name``; returns the REPLICATED sum (...) in ``x``'s
+    dtype. The lowered computation runs the identical hand-written int8
+    ring (same wire format, same per-hop payload — byte accounting via
+    :func:`leaf_payload_bytes` applies unchanged; same nan-poison and
+    stochastic-rounding ``key=`` contracts), so results are
+    bit-identical to the shard_map form on the same mesh. Outside a
+    mesh/jit the exact fp32 sum runs instead (nothing to compress
+    across). The runtime-native int8 AllReduce seam
+    (``utils.compat.native_int8_allreduce``) applies inside the
+    partitioned body exactly as it does inside shard_map bodies."""
+    enforce(x.ndim >= 1,
+            "quantized_psum_partitioned stacks per-shard partials on "
+            "dim 0 — got a scalar")
+    wrapped = _partitioned_psum(axis_name, int(group), key is not None)
+    out = wrapped(x, key) if key is not None else wrapped(x)
+    return out.astype(x.dtype)
 
 
 def quantized_pmean_tree(tree, axis_name: str, axis_size: int, *,

@@ -614,6 +614,31 @@ class TestLint:
                  "  # pt-lint: disable=PT-LINT-305 nope\n")
         assert len(lint_source(wrong, "x.py")) == 1
 
+    def test_quantized_pool_branch_outside_boundary_flagged(self):
+        """PT-LINT-308: isinstance dispatch on QuantizedPool belongs
+        to ops/paged_kv.py (THE storage-form boundary); anywhere else
+        it re-opens the dual-dispatch drift hazard. Constructing or
+        importing the class is fine — only the isinstance branch is
+        the dispatch."""
+        src = ("from paddle_tpu.ops.paged_kv import QuantizedPool\n"
+               "def attend_like(pool):\n"
+               "    if isinstance(pool, QuantizedPool):\n"
+               "        return 1\n"
+               "    return 0\n")
+        diags = lint_source(src, "paddle_tpu/serving.py")
+        assert [d.code for d in diags] == ["PT-LINT-308"]
+        # tuple-of-classes form flags too
+        tup = ("def f(pool):\n"
+               "    return isinstance(pool, (tuple, QuantizedPool))\n")
+        assert [d.code for d in lint_source(tup, "x.py")] == \
+            ["PT-LINT-308"]
+        # clean twins: the boundary file itself, and non-branch uses
+        assert lint_source(src, "paddle_tpu/ops/paged_kv.py") == []
+        mk = ("from paddle_tpu.ops.paged_kv import QuantizedPool\n"
+              "def build(q, s):\n"
+              "    return QuantizedPool(q, s)\n")
+        assert lint_source(mk, "paddle_tpu/serving.py") == []
+
     def test_unparsable_file_is_a_finding(self):
         diags = lint_source("def f(:\n", "broken.py")
         assert len(diags) == 1 and "does not parse" in diags[0].message

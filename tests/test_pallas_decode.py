@@ -221,6 +221,52 @@ def test_tuned_table_drives_block_and_dispatch():
         tuning.reset_cache()
 
 
+def test_decode_dtype_key_roundtrip_and_stale_diag():
+    """ISSUE 15: decode tuning buckets are keyed by POOL DTYPE. New
+    keys carry an explicit |p<dtype> suffix; f32 lookups fall back to
+    the legacy (pre-int8) key silently; an int8 lookup that finds ONLY
+    a legacy entry emits the typed PT-TUNE-501 diagnostic instead of a
+    silent static-defaults fallback."""
+    import warnings
+
+    from paddle_tpu.ops.pallas import tuning
+
+    key8 = tuning.decode_key(512, 64, pool_dtype="int8")
+    keyf = tuning.decode_key(512, 64)
+    assert key8.endswith("|pint8") and keyf.endswith("|pf32")
+    assert key8.rsplit("|", 1)[0] == keyf.rsplit("|", 1)[0]
+    try:
+        # dtype-keyed roundtrip: set under the int8 key, read it back
+        tuning.set_tuned(key8, {"block_k": 64, "use_flash": True},
+                         persist=False)
+        assert tuning.get_tuned_decode(512, 64, "int8")["block_k"] == 64
+        assert tuning.get_tuned_decode(512, 64, "f32") is None
+        # legacy (pre-dtype) entry: honored silently for f32 ...
+        legacy = tuning._legacy_decode_key(1024, 64)
+        tuning.set_tuned(legacy, {"block_k": 128, "use_flash": True},
+                         persist=False)
+        assert (tuning.get_tuned_decode(1024, 64, "f32")["block_k"]
+                == 128)
+        assert not tuning.stale_dtype_findings()
+        # ... but an int8 lookup against the stale table is a TYPED
+        # diagnostic, not a silent miss
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            assert tuning.get_tuned_decode(1024, 64, "int8") is None
+        finds = tuning.stale_dtype_findings()
+        assert any(d.code == "PT-TUNE-501" for d in finds)
+        assert any("PT-TUNE-501" in str(w.message) for w in caught)
+        # warn ONCE per key per process
+        with warnings.catch_warnings(record=True) as again:
+            warnings.simplefilter("always")
+            assert tuning.get_tuned_decode(1024, 64, "int8") is None
+        assert not again
+        assert len(tuning.stale_dtype_findings()) == 1
+    finally:
+        tuning.reset_cache()
+    assert not tuning.stale_dtype_findings()   # reset clears findings
+
+
 def test_per_row_cursors_match_oracle():
     """(B,) cursor array (the continuous-batching step): each row masks
     and reads at its own position."""

@@ -23,6 +23,13 @@ import pytest
 
 from paddle_tpu.ops.pallas.flash_attention import flash_attention
 from paddle_tpu.ops.pallas.quant_matmul import quant_matmul
+from paddle_tpu.utils import compat
+
+# jax<0.5 ships jax.export as a LAZY package attribute — a plain
+# jax.export.export raises AttributeError until the submodule is
+# imported once; the compat funnel materializes it (the same shim every
+# production jax.export caller rides)
+compat.jax_export()
 
 # (b, t, h, d): BERT-base pretrain block and the 2k long-context shape
 ATTN_SHAPES = [(8, 512, 12, 64), (2, 2048, 16, 128)]
@@ -243,6 +250,29 @@ def test_flash_decode_paged_lowers_to_mosaic(page_size):
     fn = jax.jit(lambda q, kp, vp, tb, t: flash_decode_paged(
         q, kp, vp, tb, t, interpret=False))
     _export_tpu(fn, q, pool, pool, table, t)
+
+
+@pytest.mark.parametrize("page_size", [64, 128, 256])
+def test_flash_decode_paged_int8_lowers_to_mosaic(page_size):
+    """The int8 dequant-epilogue variant (ISSUE 15): int8 value blocks
+    + rank-3 f32 scale blocks ride the same clamped page walk — the
+    tiling/layout legality of BOTH block shapes must clear Mosaic, not
+    just interpret mode."""
+    b, h, kv, d, n_log = 4, 8, 4, 64, 4
+    pages = b * n_log
+    q = jnp.zeros((b, 1, h, d), jnp.bfloat16)
+    pool = jnp.zeros((pages, page_size, kv, d), jnp.int8)
+    sc = jnp.zeros((pages, page_size, kv), jnp.float32)
+    table = jnp.arange(b * n_log, dtype=jnp.int32).reshape(b, n_log)
+    t = jnp.full((b,), page_size + 3, jnp.int32)
+    fn = jax.jit(lambda q, kp, ks, vp, vs, tb, t: flash_decode_paged(
+        q, kp, vp, tb, t, k_scale=ks, v_scale=vs, interpret=False))
+    _export_tpu(fn, q, pool, sc, pool, sc, table, t)
+    # windowed variant (the sliding-window serving config)
+    fnw = jax.jit(lambda q, kp, ks, vp, vs, tb, t: flash_decode_paged(
+        q, kp, vp, tb, t, k_scale=ks, v_scale=vs, window=page_size,
+        interpret=False))
+    _export_tpu(fnw, q, pool, sc, pool, sc, table, t)
 
 
 def test_flash_decode_inside_scan_lowers_to_mosaic():

@@ -184,6 +184,147 @@ class TestQuantizedPsum:
 
 
 # ---------------------------------------------------------------------------
+# the custom-partitioned form (ISSUE 15: the ring INSIDE the
+# partitioned computation — pjit-level callers, no shard_map body)
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionedPsum:
+    def _cp(self, x_rows, devs, **kw):
+        from jax.sharding import NamedSharding
+
+        mesh = _dp_mesh(devs)
+        xx = jax.device_put(jnp.asarray(x_rows),
+                            NamedSharding(mesh, P("dp")))
+        return np.asarray(jax.jit(
+            lambda v: QC.quantized_psum_partitioned(v, "dp", **kw))(xx))
+
+    def test_bit_identical_to_shard_map_ring(self, eight_devices):
+        """THE parity gate: the custom_partitioning form lowers to the
+        SAME per-shard ring over the same mesh, so outputs are
+        bit-identical to the shard_map spelling — not merely close."""
+        x = RNG.normal(size=(8, 3000)).astype(np.float32)
+        want = _ring_psum(jnp.asarray(x), eight_devices, group=256)
+        got = self._cp(x, eight_devices, group=256)
+        np.testing.assert_array_equal(got, want)
+
+    def test_ring_runs_inside_partitioned_computation(
+            self, eight_devices, monkeypatch):
+        """The byte-count gate, structurally: the lowered computation
+        calls quantized_psum with the SAME (axis, size, group) as the
+        shard_map form — identical ring, identical per-hop payload
+        (leaf_payload_bytes applies unchanged)."""
+        seen = []
+        real = QC.quantized_psum
+
+        def counting(x, axis_name, axis_size, **kw):
+            seen.append((axis_name, int(axis_size),
+                         kw.get("group")))
+            return real(x, axis_name, axis_size, **kw)
+
+        monkeypatch.setattr(QC, "quantized_psum", counting)
+        x = RNG.normal(size=(8, 2048)).astype(np.float32)
+        got = self._cp(x, eight_devices, group=512)
+        assert ("dp", 8, 512) in seen
+        atol = np.abs(x).max() / 127 * 8 * 1.5
+        np.testing.assert_allclose(got, x.sum(0), atol=atol)
+
+    def test_stochastic_mode_preserved(self, eight_devices):
+        x = RNG.normal(size=(8, 2048)).astype(np.float32)
+        got = self._cp(x, eight_devices, key=jax.random.key(3))
+        atol = np.abs(x).max() / 127 * 8 * 2.0
+        np.testing.assert_allclose(got, x.sum(0), atol=atol)
+
+    def test_nonfinite_poisons_output(self, eight_devices):
+        x = RNG.normal(size=(8, 512)).astype(np.float32)
+        x[3, 7] = np.inf
+        assert np.isnan(self._cp(x, eight_devices)).all()
+
+    def test_eager_fallback_is_exact(self):
+        """Outside jit/mesh there is nothing to compress across — the
+        reference body (exact fp32 sum) runs."""
+        x = RNG.normal(size=(4, 300)).astype(np.float32)
+        got = np.asarray(QC.quantized_psum_partitioned(
+            jnp.asarray(x), "dp"))
+        np.testing.assert_allclose(got, x.sum(0), atol=1e-5)
+
+    def test_native_allreduce_probe_seam(self, eight_devices,
+                                         monkeypatch):
+        """utils.compat.native_int8_allreduce is the runtime-native
+        int8 AllReduce seam: when it resolves, BOTH psum spellings
+        bypass the hand-written ring through it."""
+        from jax import lax
+
+        from paddle_tpu.utils import compat
+
+        def fake_native():
+            return (lambda x, *, axis_name, axis_size, group, key:
+                    lax.psum(x, axis_name) + 1000.0)
+
+        monkeypatch.setattr(compat, "native_int8_allreduce",
+                            fake_native)
+        x = RNG.normal(size=(8, 512)).astype(np.float32)
+        got = _ring_psum(jnp.asarray(x), eight_devices)
+        np.testing.assert_allclose(got, x.sum(0) + 1000.0, rtol=1e-5)
+        got_cp = self._cp(x, eight_devices)
+        np.testing.assert_allclose(got_cp, x.sum(0) + 1000.0,
+                                   rtol=1e-5)
+
+    def test_partial_contract_native_refused_for_sr(
+            self, eight_devices, monkeypatch):
+        """An upstream-attr adapter can't forward the stochastic key
+        (partial_contract=True): key= (int8_sr) calls must keep the
+        ring — silently degrading SR to nearest rounding would let
+        bias accumulate — while nearest-rounding calls adopt it."""
+        from jax import lax
+
+        from paddle_tpu.utils import compat
+
+        def fake_native():
+            def f(x, *, axis_name, axis_size, group, key):
+                return lax.psum(x, axis_name) + 1000.0
+
+            f.partial_contract = True
+            return f
+
+        monkeypatch.setattr(compat, "native_int8_allreduce",
+                            fake_native)
+        x = RNG.normal(size=(8, 2048)).astype(np.float32)
+        # SR call: the ring runs (result near the true sum, NOT +1000)
+        got = _ring_psum(jnp.asarray(x), eight_devices,
+                         key=jax.random.key(0))
+        np.testing.assert_allclose(got, x.sum(0),
+                                   atol=np.abs(x).max() / 127 * 8 * 2)
+        # nearest-rounding call: the native adapter is adopted
+        got2 = _ring_psum(jnp.asarray(x), eight_devices)
+        np.testing.assert_allclose(got2, x.sum(0) + 1000.0, rtol=1e-5)
+
+    def test_native_probe_env_resolution(self, monkeypatch):
+        """The PT_NATIVE_INT8_ALLREDUCE=module:fn override resolves;
+        unset (this toolchain) the probe is None and the ring runs."""
+        from paddle_tpu.utils import compat
+
+        monkeypatch.delenv("PT_NATIVE_INT8_ALLREDUCE", raising=False)
+        assert compat.native_int8_allreduce() is None
+        monkeypatch.setenv("PT_NATIVE_INT8_ALLREDUCE",
+                           "operator:add")
+        assert compat.native_int8_allreduce() is not None
+
+    def test_native_probe_env_malformed_is_typed(self, monkeypatch):
+        """A spec missing the ':fn' half fails TYPED at the probe,
+        naming the env var and expected form — not a bare getattr
+        AttributeError from inside a traced collective."""
+        from paddle_tpu.core.enforce import EnforceError
+        from paddle_tpu.utils import compat
+
+        for bad in ("operator", "operator:", ":add"):
+            monkeypatch.setenv("PT_NATIVE_INT8_ALLREDUCE", bad)
+            with pytest.raises(EnforceError,
+                               match="PT_NATIVE_INT8_ALLREDUCE"):
+                compat.native_int8_allreduce()
+
+
+# ---------------------------------------------------------------------------
 # byte accounting
 # ---------------------------------------------------------------------------
 

@@ -49,6 +49,17 @@ case "$MODE" in
     JAX_PLATFORMS=cpu python tools/lint.py --select PT-RACE || exit $?
     JAX_PLATFORMS=cpu python -m pytest tests/test_lockwatch.py -q \
       || exit $?
+    # kernel smoke: the int8-native decode plane — interpret-mode
+    # parity of the Pallas paged kernel's int8 dequant-epilogue path
+    # vs the gather+dequant reference (GQA/MQA, windows, ragged
+    # cursors) plus the tuning-table dtype-key roundtrip + stale-table
+    # diagnostic. Tiny shapes; runs on CPU without a chip.
+    stage "kernel smoke (int8/float paged-decode parity + tuning \
+dtype keys)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_paged_kv.py \
+      -q -k "quantized_kernel or gather_upto" || exit $?
+    JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_decode.py \
+      -q -k "dtype_key" || exit $?
     ;;
 esac
 

@@ -170,13 +170,18 @@ def tune_decode(b, cap, h, kv, d, dry_run=False):
     """Flash-decode block sweep: one cached-decode position (traced
     cursor, as production decodes run it) at t = cap/2 and t = cap-1 —
     the average and worst live range — against the XLA masked fallback.
-    Records block_k + use_flash under the decode key."""
+    Records block_k + use_flash under the f32 decode key, then sweeps
+    the INT8 PAGED variant (int8 pools + in-kernel dequant epilogue,
+    page_size = block_k) against its gather+dequant fallback and
+    records the verdict under the int8-dtype-keyed entry."""
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu.ops.attention import xla_attention
     from paddle_tpu.ops.pallas import tuning
-    from paddle_tpu.ops.pallas.flash_decode import flash_decode
+    from paddle_tpu.ops.pallas.flash_decode import (flash_decode,
+                                                    flash_decode_paged)
+    from paddle_tpu.quant.ops import absmax_encode
 
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(b, 1, h, d))
@@ -222,6 +227,77 @@ def tune_decode(b, cap, h, kv, d, dry_run=False):
     print(f"  -> {key}: {entry}")
     if not dry_run:
         tuning.set_tuned(key, entry)
+
+    # ---- int8 paged variant: the page size IS the kernel block, so
+    # the sweep is over page sizes; the fallback arm is what attend()
+    # would run instead (gather + dequantize the logical view + masked
+    # XLA). Values quantize per-(page, pos, kv_head) head_dim vector —
+    # the QuantizedPool wire format.
+    kf32 = k.astype(jnp.float32)
+    vf32 = v.astype(jnp.float32)
+    results_q = []
+    for bk in cand:
+        n_log = cap // bk
+        kp = kf32.reshape(b * n_log, bk, kv, d)
+        vp = vf32.reshape(b * n_log, bk, kv, d)
+        kq, ksc = absmax_encode(kp, axis=-1)
+        vq, vsc = absmax_encode(vp, axis=-1)
+        ksc, vsc = ksc[..., 0], vsc[..., 0]
+        table = jnp.arange(b * n_log, dtype=jnp.int32).reshape(b, n_log)
+        try:
+            f = jax.jit(lambda q, kq, ksc, vq, vsc, t: flash_decode_paged(
+                q, kq, vq, table, t, k_scale=ksc, v_scale=vsc,
+                interpret=False))
+            ms = sum(_time(f, q, kq, ksc, vq, vsc, t) for t in ts)
+            results_q.append((ms, bk))
+            print(f"  int8 paged decode page={bk}: {ms*1e3:.3f}ms")
+        except Exception as e:
+            print(f"  int8 paged decode page={bk}: FAILED "
+                  f"({type(e).__name__}: {str(e)[:120]})")
+    best_q = min(results_q) if results_q else None
+
+    # gather+dequant fallback at ONE representative page size — timed
+    # through the REAL attend fallback (paged_kv.gather_rows + masked
+    # XLA, dispatch gate forced off) so the reference arm can never
+    # drift from what a use_flash=False verdict actually runs
+    import paddle_tpu.ops.attention as attention_mod
+    from paddle_tpu.ops import paged_kv as PO
+
+    bk0 = cand[0]
+    n_log = cap // bk0
+    kq, ksc = absmax_encode(kf32.reshape(b * n_log, bk0, kv, d), axis=-1)
+    vq, vsc = absmax_encode(vf32.reshape(b * n_log, bk0, kv, d), axis=-1)
+    kqp = PO.QuantizedPool(kq, ksc[..., 0])
+    vqp = PO.QuantizedPool(vq, vsc[..., 0])
+    table = jnp.arange(b * n_log, dtype=jnp.int32).reshape(b, n_log)
+    orig_gate = attention_mod.decode_flash_ok
+    attention_mod.decode_flash_ok = lambda *a, **kw: False
+    try:
+        gf = jax.jit(lambda q, t: PO.attend(q, kqp, vqp, table, t))
+        g_ms = sum(_time(gf, q, t) for t in ts)
+    finally:
+        attention_mod.decode_flash_ok = orig_gate
+    print(f"  int8 gather+dequant fallback: {g_ms*1e3:.3f}ms")
+
+    key_q = tuning.decode_key(cap, d, pool_dtype="int8")
+    if best_q is None:
+        entry_q = {"use_flash": False, "xla_ms": round(g_ms * 1e3, 4),
+                   "note": "no int8 decode page size compiled"}
+    else:
+        # unlike the contiguous kernel (block_k freely chosen at
+        # dispatch), the paged kernel's block IS the deployed pool's
+        # page size — record a verdict PER swept page so attend() can
+        # veto the kernel for a page where gather won even though the
+        # best page beat it (decode_flash_ok's use_flash_by_page path)
+        entry_q = {"block_k": best_q[1],
+                   "use_flash": bool(best_q[0] < g_ms),
+                   "use_flash_by_page": {str(bk): bool(ms < g_ms)
+                                         for ms, bk in results_q},
+                   "flash_ms": round(best_q[0] * 1e3, 4),
+                   "xla_ms": round(g_ms * 1e3, 4)}
+    print(f"  -> {key_q}: {entry_q}")
+    if not dry_run:
+        tuning.set_tuned(key_q, entry_q)
     return entry
 
 
