@@ -1344,14 +1344,215 @@ def _kv_decode_step_time(model, cap: int, smoke: bool):
     return out
 
 
+def _parse_plan_arg(plan: str) -> dict:
+    """'ep=8' / 'dp=2,ep=4' -> {'dp': int, 'ep': int} (argument misuse
+    raises ValueError; main() turns it into the value-0.0 error line)."""
+    axes = {"dp": 1, "ep": 1}
+    for part in str(plan).split(","):
+        k, sep, v = part.partition("=")
+        k, v = k.strip(), v.strip()
+        if k not in axes or not sep or not v.isdigit() or int(v) < 1:
+            raise ValueError(
+                f"--plan expects 'ep=N' or 'dp=M,ep=N' with N>=1, "
+                f"got {plan!r}")
+        axes[k] = int(v)
+    return axes
+
+
+def _bench_deepfm_sparse_ep(steps, batch_size, amp, vocab, plan_arg):
+    """The ep-sharded arm of deepfm_sparse: the full sharded-embedding
+    vertical slice under ``Plan(dp=M, ep=N, tables=[...])`` —
+
+    - tables row-sharded over the ``ep`` mesh axis, trained through
+      ``embedding.sparse_ep_minimize_fn`` (local MergeAdd + int8
+      (ids, rows) exchange; the dense (V, D) gradient never exists) and
+      compiled once through ``parallel.compile_step``;
+    - the byte-budget gate (the PR-6 evidence shape): the REPLICATED
+      table footprint must exceed the per-device budget while the
+      ep-sharded footprint fits — the table provably cannot fit one
+      device, only the plan can hold it;
+    - wire accounting: per-step sparse payload bytes (counter-verified
+      via ``record_exchange_bytes``) next to the dense-allreduce
+      counterfactual over the same device count;
+    - the host-backed feeding plane: a ``HostBackedTable`` mirror of
+      the big table rides ``DevicePrefetcher(prefetch_rows=...)`` so
+      each batch's rows stage host->chip overlapped with compute;
+      extras report its cache hit rate on the (skewed) id stream.
+    """
+    import contextlib
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.core.dtypes import policy_scope
+    from paddle_tpu.data import DevicePrefetcher
+    from paddle_tpu.embedding import (HostBackedTable, dense_grad_bytes,
+                                      exchange_payload_bytes,
+                                      record_exchange_bytes,
+                                      should_compress,
+                                      sparse_ep_minimize_fn)
+    from paddle_tpu.models import deepfm as DF
+    from paddle_tpu.parallel.plan import Plan, compile_step
+
+    axes = _parse_plan_arg(plan_arg)
+    dp, ep = axes["dp"], axes["ep"]
+    need = dp * ep
+    n_dev = len(jax.devices())
+    if n_dev < need:  # main() pre-checks; defensive for direct callers
+        raise RuntimeError(f"--plan {plan_arg} needs {need} devices, "
+                           f"have {n_dev}")
+
+    pt.seed(0)
+    vocab = max(ep, vocab - vocab % ep)   # ep must divide the rows
+    batch_size = max(dp, batch_size - batch_size % dp)
+    cfg = DF.DeepFMConfig(total_vocab=vocab, num_fields=26, dense_dim=13,
+                          embed_dim=16, embedding_axis=None,
+                          sparse_grads=True)
+    model = DF.DeepFM(cfg)
+    params = model.named_parameters()
+    plan = Plan(dp=dp, ep=ep,
+                tables=[r"(embedding|linear_embed)\.weight$"],
+                devices=jax.devices()[:need])
+    table_names = sorted(n for n in params if plan.is_table(n))
+    assert table_names, "no table matched the ep registration"
+
+    # --- byte-budget gate (PR-6 evidence shape): replicated tables
+    # exceed the per-device budget, the ep-sharded form fits ----------
+    replicated = sum(int(np.prod(params[n].shape)) * 4
+                     for n in table_names)
+    planned = sum(-(-int(params[n].shape[0]) // ep)
+                  * int(np.prod(params[n].shape[1:])) * 4
+                  for n in table_names)
+    budget = replicated // 2
+    assert planned <= budget < replicated, (
+        f"byte-budget gate: planned {planned} must fit budget {budget} "
+        f"< replicated {replicated} (raise --vocab or ep)")
+
+    placed = plan.place(params)
+
+    def forward_loss(p, ids, dense):
+        with (policy_scope(amp) if amp else contextlib.nullcontext()):
+            logits, _ = model.functional_call(p, ids, dense)
+            labels = (ids[:, 0] % 2).astype(jnp.float32)
+            return DF.loss_fn(logits, labels)
+
+    opt = optimizer.Adam(1e-3)
+    init_fn, step_fn = sparse_ep_minimize_fn(model, forward_loss, opt,
+                                             plan=plan)
+    state = init_fn(placed)
+    rep = NamedSharding(plan.mesh, P())
+    s_sh = jax.tree_util.tree_map(
+        lambda x: (NamedSharding(plan.mesh, P("ep", None))
+                   if getattr(x, "ndim", 0) >= 1 and x.shape[0] == vocab
+                   else rep), state)
+    state = jax.tree_util.tree_map(jax.device_put, state, s_sh)
+    p_sh = jax.tree_util.tree_map(lambda x: x.sharding, placed)
+    bs = plan.batch_sharding()
+    step = compile_step(plan, step_fn, in_shardings=(p_sh, s_sh, bs, bs),
+                        out_shardings=(rep, p_sh, s_sh))
+
+    # --- host-backed feeding plane: the big table's HostBackedTable
+    # mirror stages each batch's rows host->chip from the prefetcher's
+    # background thread (parameter_prefetch overlap, no PS fleet) ------
+    cap = max(64, vocab // 16)
+    host_tbl = HostBackedTable.from_array(placed[table_names[0]],
+                                          capacity=cap,
+                                          name="deepfm.embedding")
+    rng = np.random.default_rng(0)
+    total = steps + 3  # timed steps + warmup
+
+    def batches():
+        for _ in range(total):
+            # power-law id skew (CTR traffic shape): the hot head makes
+            # the working set meaningful — a uniform stream at V >> cap
+            # would measure only cold misses
+            ids = np.minimum(
+                vocab * rng.random((batch_size, cfg.num_fields)) ** 8,
+                vocab - 1).astype(np.int32)
+            dense = rng.normal(
+                size=(batch_size, cfg.dense_dim)).astype(np.float32)
+            yield {"ids": ids, "dense": dense}
+
+    pref = DevicePrefetcher(
+        batches, size=2, sharding=bs,
+        prefetch_rows=lambda b: host_tbl.prefetch(b["ids"]))
+
+    # --- wire accounting (static shapes -> computed once per step) ----
+    n_ids = batch_size * cfg.num_fields  # global ids per step
+    payload = 0
+    for n in table_names:
+        dim = int(params[n].shape[1])
+        comp = should_compress(n_ids, dp, dim)
+        payload += exchange_payload_bytes(n_ids // dp, dim, dp,
+                                          compressed=comp)
+    # the counterfactual: dense (V, D) fp32 table-grad allreduce over
+    # the SAME device count (what a replicated-table dp=need run moves)
+    dense_cf = sum(dense_grad_bytes(vocab, int(params[n].shape[1]), need)
+                   for n in table_names)
+
+    it = iter(pref)
+    for _ in range(3):
+        b = next(it)
+        loss, placed, state = step(placed, state, b["ids"], b["dense"])
+    float(loss)
+    t0 = time.perf_counter()
+    done = 0
+    for b in it:
+        loss, placed, state = step(placed, state, b["ids"], b["dense"])
+        for n in table_names:
+            dim = int(params[n].shape[1])
+            record_exchange_bytes(
+                n_ids // dp, dim, dp,
+                compressed=should_compress(n_ids, dp, dim))
+        done += 1
+        if done % 4 == 3:
+            float(loss)
+    float(loss)
+    dt = time.perf_counter() - t0
+    assert done == steps, f"prefetcher delivered {done}/{steps} batches"
+
+    extras = {
+        "step_time_ms": round(dt / steps * 1e3, 3),
+        "emb_rows_per_sec": round(steps * n_ids / dt, 1),
+        "emb_payload_bytes_per_step": int(payload),
+        "emb_dense_grad_bytes_per_step": int(dense_cf),
+        "emb_bytes_ratio": (round(dense_cf / payload, 1)
+                            if payload else None),
+        "emb_cache_hit_rate": round(host_tbl.hit_rate, 4),
+        "emb_cache_capacity_rows": int(cap),
+        "emb_table_rows": int(vocab),
+        "peak_mem_bytes_replicated": int(replicated),
+        "peak_mem_bytes_planned": int(planned),
+        "byte_budget": int(budget),
+        "fits_budget_only_planned": True,  # asserted above
+        "shard_ratio": round(replicated / planned, 3),
+        "dp": dp,
+        "emb_ep": ep,
+    }
+    return steps * batch_size / dt, "examples/sec", extras
+
+
 def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
-                        vocab: int = 100_000):
+                        vocab: int = 100_000, plan=None):
     """DeepFM with ROW-SPARSE embedding updates (the SelectedRows
     capability, reference: operators/optimizers/adam_op.h sparse branch):
     the optimizer touches O(batch x fields) table rows per step instead
     of O(vocab). Run next to --model deepfm (dense updates) — the gap IS
     the sparse-update win, and it widens with total_vocab (``--vocab``
-    sweeps the crossover; on-chip at V=100k dense wins, BASELINE.md)."""
+    sweeps the crossover; on-chip at V=100k dense wins, BASELINE.md).
+
+    ``--plan ep=8`` (or ``dp=2,ep=4``) switches to the ep-sharded arm:
+    tables row-sharded over the plan mesh, sparse (ids, rows) gradient
+    exchange, host-backed row prefetch, and the byte-budget gate — see
+    :func:`_bench_deepfm_sparse_ep`."""
+    if plan:
+        return _bench_deepfm_sparse_ep(steps, batch_size, amp, vocab,
+                                       plan)
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -2247,6 +2448,13 @@ def main():
     ap.add_argument("--dp", type=int, default=1,
                     help="data-parallel device count (--gpus analog; on "
                     "--platform cpu this creates virtual host devices)")
+    ap.add_argument("--plan", default=None, metavar="AXES",
+                    help="deepfm_sparse: sharding plan for the embedding "
+                    "tables, e.g. 'ep=8' or 'dp=2,ep=4' — tables "
+                    "row-shard over the ep mesh axis with sparse "
+                    "(ids, rows) gradient exchange and the byte-budget "
+                    "gate (on cpu the dp*ep virtual devices are created "
+                    "automatically)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — needed because "
                     "this environment's sitecustomize overrides JAX_PLATFORMS")
@@ -2378,6 +2586,28 @@ def main():
         # over dp devices): its own history key, never silently compared
         # against the single-device record
         metric += f"_dp{args.dp}"
+    plan_axes = None
+    if args.plan:
+        if args.model != "deepfm_sparse" or "plan" not in sig:
+            _emit_error(metric, "--plan only applies to --model "
+                        "deepfm_sparse (the ep-sharded embedding arm)")
+            return
+        if args.infer:
+            _emit_error(metric, "--infer does not support --plan "
+                        "(the ep arm measures the sparse train step)")
+            return
+        if args.dp > 1:
+            _emit_error(metric, "--plan carries its own dp axis "
+                        "(use --plan dp=M,ep=N, not --dp)")
+            return
+        try:
+            plan_axes = _parse_plan_arg(args.plan)
+        except ValueError as e:
+            _emit_error(metric, str(e))
+            return
+        # the plan shape is the WORKLOAD (mesh axes + exchange
+        # topology): its own history key, e.g. _ep8 or _dp2_ep4
+        metric += "_" + args.plan.replace("=", "").replace(",", "_")
     if args.infer and args.model == "deepfm_sparse":
         # sparse_grads only changes the UPDATE path; the forward is
         # identical to deepfm's — bench that instead of duplicating it
@@ -2413,18 +2643,21 @@ def main():
                     "a training-batch layout)")
         return
 
-    if args.model == "quant_comm":
-        # the allreduce ring needs devices: give a cpu-only run the
-        # 8-device sim BEFORE backend init (accelerator backends ignore
-        # the cpu device count — on-chip runs use the real devices)
+    if args.model == "quant_comm" or plan_axes:
+        # the allreduce ring / the plan mesh needs devices: give a
+        # cpu-only run the device sim BEFORE backend init (accelerator
+        # backends ignore the cpu device count — on-chip runs use the
+        # real devices)
         import jax
 
+        n_sim = (plan_axes["dp"] * plan_axes["ep"]) if plan_axes else 8
         try:
-            jax.config.update("jax_num_cpu_devices", 8)
+            jax.config.update("jax_num_cpu_devices", n_sim)
         except AttributeError:
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=8").strip()
+                + f" --xla_force_host_platform_device_count={n_sim}"
+            ).strip()
 
     # device-init watchdog: if the accelerator tunnel is wedged (device
     # claim hangs), still emit the one JSON line the driver expects
@@ -2488,6 +2721,20 @@ def main():
 
     enable_compile_cache()
     kwargs = {}
+    if plan_axes:
+        import jax
+
+        need = plan_axes["dp"] * plan_axes["ep"]
+        if len(jax.devices()) < need:
+            # infra shape, not argument misuse: the workload is fine but
+            # this host/backend cannot field the mesh (e.g. a 4-chip
+            # slice asked for ep=8) — skipped row, never a 0.0 value
+            _emit_skip(metric,
+                       f"--plan {args.plan} needs {need} devices, "
+                       f"have {len(jax.devices())}",
+                       cause="insufficient_devices")
+            return
+        kwargs["plan"] = args.plan
     if "smoke" in sig:
         kwargs["smoke"] = args.smoke
     if "amp" in sig and args.amp and args.amp != "float32":
@@ -2690,7 +2937,10 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                                   # percentiles, shed rates, and the
                                   # mono/overload comparison arms
                                   "ttft_", "itl_", "mono_",
-                                  "overload_"))
+                                  # sharded-embedding plane: wire
+                                  # payload vs dense counterfactual,
+                                  # host-cache hit rate, table rows
+                                  "overload_", "emb_"))
                  or k in ("accept_per_round", "rounds", "prefetch_off",
                           "prefetch_on", "overlap_speedup", "fsdp",
                           # checkpoint bench: save/recovery latency and

@@ -26,6 +26,12 @@ PT-DON-104     Donation: donated buffer aliases a live/non-donated one
 PT-SHARD-201   Plan audit: placed leaf would reshard at dispatch
 PT-SHARD-202   Plan audit: explicit/pattern spec dropped (divisibility)
 PT-SHARD-203   Plan audit: big leaf replicated under an fsdp plan
+PT-SHARD-204   Plan audit: registered table not row-sharded under an
+               ep plan (explicit override or indivisible vocab —
+               every device pays the whole table)
+PT-SHARD-205   Plan audit: table rows sharded over a batch axis
+               (id-batch/table-axis mismatch — breaks lookup/exchange
+               offset arithmetic)
 PT-LINT-301    Repo lint: state-file write bypasses utils/atomic
 PT-LINT-302    Repo lint: wall-clock time.time() inside a span body
 PT-LINT-303    Repo lint: unnamed thread (Thread without name= /

@@ -2,7 +2,7 @@
 the plan mesh *without placing anything* and report layout hazards
 before any byte moves.
 
-Three findings (codes in ``diagnostics.py``):
+Five findings (codes in ``diagnostics.py``):
 
 - **PT-SHARD-201 would-reshard** — a leaf already placed on the plan's
   mesh whose live sharding differs from what the plan resolves for its
@@ -17,6 +17,17 @@ Three findings (codes in ``diagnostics.py``):
   or above ``byte_threshold`` resolved to full replication: every
   device pays its whole footprint, exactly what the plan was meant to
   avoid.
+- **PT-SHARD-204 table not row-sharded under ep** — a param the plan
+  registered via ``tables=`` resolved WITHOUT the ``ep`` table axis on
+  its row dim under an ``ep > 1`` plan (explicit override, vocab
+  indivisible, …): every device pays the whole table, exactly the HBM
+  wall the ep axis exists to break.
+- **PT-SHARD-205 table rows sharded over a batch axis** — a registered
+  table's ROW dim is split over ``dp``/``fsdp``. Ids address rows
+  globally while batch axes split the *id stream*; rows scattered over
+  a batch axis make every lookup a cross-replica gather and the sparse
+  exchange's shard-offset arithmetic wrong — the id-batch/table-axis
+  mismatch.
 
 ``Plan.describe(params)`` embeds the audit summary (and /statusz's
 sharding section rides describe), so the findings are visible on a
@@ -67,6 +78,17 @@ def audit_plan(plan, state: Dict[str, Any], *,
     import jax
     from jax.sharding import NamedSharding
 
+    is_table = getattr(plan, "is_table", None)
+    plan_ep = int(getattr(plan, "ep", 1))
+    batch_axes = set(getattr(plan, "batch_axes", ()) or ())
+
+    def _dim0_axes(spec, ndim) -> set:
+        t = _spec_tuple(spec, max(ndim, 1))
+        e = t[0]
+        if e is None:
+            return set()
+        return set(e) if isinstance(e, tuple) else {e}
+
     diags: List[Diagnostic] = []
     for name, leaf in state.items():
         shape = getattr(leaf, "shape", None)
@@ -95,6 +117,33 @@ def audit_plan(plan, state: Dict[str, Any], *,
                         f"every device pays the whole leaf",
                 hint="add a rule/explicit spec sharding one divisible "
                      "axis, or lower min_shard_size"))
+
+        if is_table is not None and is_table(name):
+            axes0 = _dim0_axes(resolved, ndim)
+            if plan_ep > 1 and "ep" not in axes0:
+                diags.append(Diagnostic(
+                    code="PT-SHARD-204", severity="warning", var=name,
+                    message=f"{name}: registered table resolved "
+                            f"{resolved} under an ep={plan_ep} plan — "
+                            f"rows are not sharded over the table "
+                            f"axis, every device pays the whole "
+                            f"table",
+                    hint="make the vocab divisible by ep (pad the "
+                         "table) and drop any explicit spec "
+                         "overriding the table registration"))
+            bad = axes0 & batch_axes
+            if bad:
+                diags.append(Diagnostic(
+                    code="PT-SHARD-205", severity="error", var=name,
+                    message=f"{name}: table ROWS sharded over batch "
+                            f"axis {sorted(bad)} — ids address rows "
+                            f"globally, so splitting the row dim over "
+                            f"an id-batch axis breaks lookup/exchange "
+                            f"offset arithmetic (id-batch/table-axis "
+                            f"mismatch)",
+                    hint="shard table rows over the 'ep' table axis "
+                         "(tables= registration), never over "
+                         "dp/fsdp"))
 
         if isinstance(leaf, jax.Array):
             sh = getattr(leaf, "sharding", None)

@@ -195,10 +195,13 @@ class DevicePrefetcher:
     ``batches`` is a reader creator (zero-arg callable returning an
     iterator — the ``data.reader`` contract, re-iterable per epoch) or a
     plain iterable (single pass). Per staged batch, in the worker:
-    ``transform`` (host-side, optional) → :class:`BucketPadder` (when
-    ``bucket_by`` is set) → ``jax.device_put`` with ``sharding`` (or the
-    mesh's ``P("dp")`` batch sharding when only ``mesh`` is given; plain
-    default placement otherwise).
+    ``transform`` (host-side, optional) → ``prefetch_rows`` (optional:
+    called with the host batch so a host-backed embedding table can
+    stage its rows host→chip overlapped with compute — see
+    ``embedding.HostBackedTable.prefetch``) → :class:`BucketPadder`
+    (when ``bucket_by`` is set) → ``jax.device_put`` with ``sharding``
+    (or the mesh's ``P("dp")`` batch sharding when only ``mesh`` is
+    given; plain default placement otherwise).
 
     ``stage_per_shard`` (sharding-plan staging): stage each leaf
     shard-by-shard — only the slices this process's devices hold are
@@ -249,7 +252,8 @@ class DevicePrefetcher:
                  donate_safe: bool = True,
                  auto_cap: Optional[int] = None,
                  auto_threshold_s: Optional[float] = None,
-                 stage_per_shard: Optional[bool] = None):
+                 stage_per_shard: Optional[bool] = None,
+                 prefetch_rows: Optional[Callable[[Any], Any]] = None):
         self.auto = size == "auto"
         if self.auto:
             self.auto_cap = int(auto_cap if auto_cap is not None
@@ -314,6 +318,11 @@ class DevicePrefetcher:
         enforce(not self.stage_per_shard or self.sharding is not None,
                 "stage_per_shard needs a sharding (or mesh) to stage "
                 "onto")
+        # host-backed embedding hook: called with each (post-transform,
+        # pre-pad) host batch from the staging thread, so e.g.
+        # embedding.HostBackedTable.prefetch moves the NEXT step's rows
+        # host->chip while the device computes the current step
+        self.prefetch_rows = prefetch_rows
         self.last_real_rows: Optional[int] = None
 
     # -- staging (worker side) ----------------------------------------------
@@ -350,6 +359,8 @@ class DevicePrefetcher:
 
         if self.transform is not None:
             item = self.transform(item)
+        if self.prefetch_rows is not None:
+            self.prefetch_rows(item)
         if self.padder is not None:
             # _pad_impl hands back the pre-pad batch size from its own
             # tree traversal — no second flatten on the hot path

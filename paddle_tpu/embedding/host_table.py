@@ -1,0 +1,243 @@
+"""Host-backed giant embedding tables — authoritative rows in host RAM,
+hot rows on chip.
+
+The reference serves 10^8-row tables from a parameter-server fleet and
+pulls the batch's rows into trainer memory per step (reference:
+framework/fleet/fleet_wrapper.h:55 PullSparseVarsSync,
+operators/distributed/parameter_prefetch.cc). The TPU-native analog
+needs no second fleet: host RAM is the parameter server. A
+:class:`HostBackedTable` keeps the full (V, D) table as a numpy array
+on the host and maintains an on-chip working set of hot rows governed
+by :class:`.cache.RowCache` (clock/second-chance LRU over row ids).
+
+Data plane per step (all host-driven — this is the feeding layer, not
+traced code):
+
+1. :meth:`prefetch` — dedup the NEXT batch's ids, admit them into the
+   cache, and ``device_put`` only the missing rows into their slots.
+   ``data.DevicePrefetcher`` calls this from its background staging
+   thread (``prefetch_rows=`` hook), so the host->chip row transfer
+   overlaps the current step's compute — the parameter_prefetch overlap
+   without the RPC.
+2. :meth:`lookup` — map ids to slots and gather from the working set.
+3. :meth:`update` — write-through: new row values land in the host
+   array (authoritative) AND in any resident working-set slot, so
+   eviction never loses data and there is no dirty-row flush path.
+
+Counters (`pt_embedding_cache_{hits,misses,evictions}_total`) advance
+per call when telemetry is on; :meth:`statusz` is a ready-made section
+for the debug server (``DebugServer.add_status("embedding", t.statusz)``).
+
+Checkpointing rides ``paddle_tpu.checkpoint``: :meth:`save` writes the
+host rows (checksummed, atomic-rename manifest), :meth:`load` restores
+them — and a table trained ep-sharded on chip can be ingested via
+:meth:`from_array`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..core.enforce import enforce
+from .cache import RowCache
+
+
+@telemetry.cached_instruments
+def _emb_metrics(reg):
+    """Embedding-plane instrument set (only reached when telemetry is
+    on)."""
+    return {
+        "hits": reg.counter(
+            "pt_embedding_cache_hits_total",
+            "host-backed table lookups served from the on-chip "
+            "working set"),
+        "misses": reg.counter(
+            "pt_embedding_cache_misses_total",
+            "host-backed table lookups that fetched rows host->chip"),
+        "evictions": reg.counter(
+            "pt_embedding_cache_evictions_total",
+            "working-set rows evicted by the clock sweep"),
+        "prefetched_rows": reg.counter(
+            "pt_embedding_prefetched_rows_total",
+            "rows staged host->chip by prefetch (the overlap path)"),
+    }
+
+
+class HostBackedTable:
+    """(V, D) embedding table whose authoritative rows live in host RAM
+    with an on-chip working set of ``capacity`` hot rows.
+
+    ``rows`` may be passed (any array-like, copied to a host numpy
+    array) or initialized N(0, 1/sqrt(D)) from ``seed``. ``capacity``
+    bounds on-chip bytes at ``capacity * D * itemsize`` regardless of
+    ``V`` — the table the chip could never hold is exactly the point.
+    """
+
+    def __init__(self, num_rows: int, dim: int, *, capacity: int,
+                 dtype=jnp.float32, rows: Optional[Any] = None,
+                 seed: int = 0, name: str = "table"):
+        enforce(num_rows >= 1 and dim >= 1,
+                "HostBackedTable needs num_rows/dim >= 1, got (%s, %s)",
+                num_rows, dim)
+        enforce(capacity >= 1, "capacity must be >= 1, got %s", capacity)
+        self.num_rows, self.dim = int(num_rows), int(dim)
+        self.name = name
+        try:
+            self._np_dtype = np.dtype(dtype)
+        except TypeError:
+            # exotic device dtypes mirror on host as f32
+            self._np_dtype = np.dtype(np.float32)
+        if rows is not None:
+            rows = np.asarray(rows, self._np_dtype)
+            enforce(rows.shape == (self.num_rows, self.dim),
+                    "rows shape %s != (%s, %s)", rows.shape,
+                    self.num_rows, self.dim)
+            self.rows = np.array(rows, copy=True)
+        else:
+            rng = np.random.default_rng(seed)
+            self.rows = (rng.standard_normal((self.num_rows, self.dim))
+                         / np.sqrt(self.dim)).astype(self._np_dtype)
+        self.cache = RowCache(capacity)
+        self._ws = jnp.zeros((int(capacity), self.dim), dtype)
+        # one lock orders prefetch (background staging thread) against
+        # lookup/update (training thread): the cache has its own lock,
+        # but slot assignment and the working-set fill must be atomic
+        # together or a lookup could gather a slot before its row lands
+        self._lock = threading.RLock()
+
+    # -- data plane ----------------------------------------------------------
+
+    def _admit_and_fill(self, uids: np.ndarray) -> int:
+        """Admit unique ids; device_put missing rows. Returns #misses.
+        Caller holds the lock."""
+        if uids.size == 0:
+            return 0
+        slots, was_miss, evicted = self.cache.admit(uids)
+        n_miss = int(was_miss.sum())
+        if n_miss:
+            fetch = uids[was_miss]
+            payload = jnp.asarray(self.rows[fetch], self._ws.dtype)
+            self._ws = self._ws.at[jnp.asarray(slots[was_miss])].set(
+                payload)
+        if telemetry.enabled():
+            m = _emb_metrics()
+            m["hits"].inc(int((~was_miss).sum()))
+            m["misses"].inc(n_miss)
+            if evicted:
+                m["evictions"].inc(len(evicted))
+        return n_miss
+
+    def _check_ids(self, ids: np.ndarray) -> None:
+        enforce(ids.size == 0 or (int(ids.min()) >= 0
+                                  and int(ids.max()) < self.num_rows),
+                "id out of range [0, %s) for table %r", self.num_rows,
+                self.name)
+
+    def prefetch(self, ids) -> int:
+        """Stage the rows for ``ids`` host->chip ahead of use (the
+        DevicePrefetcher overlap hook). Returns rows actually moved."""
+        uids = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        self._check_ids(uids)
+        with self._lock:
+            n = self._admit_and_fill(uids)
+        if n and telemetry.enabled():
+            _emb_metrics()["prefetched_rows"].inc(n)
+        return n
+
+    def lookup(self, ids):
+        """Rows for ``ids`` (any int shape) as a device array
+        ``ids.shape + (D,)`` gathered from the working set (missing
+        rows are fetched first — a fully prefetched batch gathers
+        without touching the host)."""
+        arr = np.asarray(ids, np.int64)
+        flat = arr.reshape(-1)
+        self._check_ids(flat)
+        with self._lock:
+            uids = np.unique(flat)
+            self._admit_and_fill(uids)
+            slots = self.cache.slots_of(flat)
+            out = jnp.take(self._ws, jnp.asarray(slots), axis=0)
+        return out.reshape(arr.shape + (self.dim,))
+
+    def update(self, ids, new_rows) -> None:
+        """Write-through row update: the host array is authoritative,
+        resident working-set slots are patched in place — eviction
+        never loses data."""
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        self._check_ids(flat)
+        vals = np.asarray(new_rows, self._np_dtype).reshape(
+            flat.size, self.dim)
+        with self._lock:
+            self.rows[flat] = vals
+            slots = self.cache.slots_of(flat)
+            resident = slots >= 0
+            if resident.any():
+                self._ws = self._ws.at[jnp.asarray(slots[resident])].set(
+                    jnp.asarray(vals[resident], self._ws.dtype))
+
+    # -- reporting -----------------------------------------------------------
+
+    @property
+    def host_bytes(self) -> int:
+        return int(self.rows.nbytes)
+
+    @property
+    def device_bytes(self) -> int:
+        return int(self._ws.size) * self._ws.dtype.itemsize
+
+    @property
+    def hit_rate(self) -> float:
+        return float(self.cache.stats()["hit_rate"])
+
+    def statusz(self) -> Dict[str, Any]:
+        """The ``/statusz`` embedding section (attach via
+        ``DebugServer.add_status``) — host-side fields only, safe to
+        render on every scrape."""
+        s = self.cache.stats()
+        s.update({
+            "name": self.name,
+            "rows": self.num_rows,
+            "dim": self.dim,
+            "host_bytes": self.host_bytes,
+            "device_bytes": self.device_bytes,
+        })
+        return s
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def save(self, directory: str) -> None:
+        """Write the authoritative host rows through the checkpoint
+        plane (manifest + checksums + atomic commit)."""
+        from .. import checkpoint
+
+        checkpoint.save_state(directory, {"rows": self.rows})
+
+    @classmethod
+    def load(cls, directory: str, *, capacity: int,
+             dtype=jnp.float32, name: str = "table") -> "HostBackedTable":
+        from .. import checkpoint
+
+        tree = checkpoint.restore_state(directory)
+        rows = np.asarray(tree["rows"])
+        return cls(rows.shape[0], rows.shape[1], capacity=capacity,
+                   dtype=dtype, rows=rows, name=name)
+
+    @classmethod
+    def from_array(cls, rows, *, capacity: int, dtype=jnp.float32,
+                   name: str = "table") -> "HostBackedTable":
+        """Ingest an existing (possibly ep-sharded, device-resident)
+        table — e.g. to serve a table trained under ``Plan(ep=N)``."""
+        host = np.asarray(rows)
+        return cls(host.shape[0], host.shape[1], capacity=capacity,
+                   dtype=dtype, rows=host, name=name)
+
+    def __repr__(self):
+        return (f"HostBackedTable({self.name!r}, rows={self.num_rows}, "
+                f"dim={self.dim}, capacity={self.cache.capacity}, "
+                f"resident={len(self.cache)})")

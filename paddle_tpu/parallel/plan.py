@@ -11,8 +11,9 @@ XLA executable — ``pjit`` with full ``in_shardings``/``out_shardings``/
 Gemma-31B-on-TPU table-stakes setup), or a ``shard_map``-wrapped
 ``jax.jit`` for pure data parallelism (the SNIPPETS [1]-[3] pattern).
 
-Axes (a plan mesh always carries all three, degenerate sizes included,
-so specs can name any axis regardless of the active parallelism):
+Axes (a plan mesh always carries the three core axes, degenerate sizes
+included, so specs can name any axis regardless of the active
+parallelism):
 
 - ``dp``:   data parallel — batch split, params replicated
 - ``fsdp``: fully-sharded data parallel — batch split AND params/opt
@@ -20,6 +21,12 @@ so specs can name any axis regardless of the active parallelism):
   param's largest divisible axis over ``fsdp``
 - ``tp``:   tensor parallel — param dims split per explicit/pattern rules
   (``parallel.sharding.transformer_tp_rules`` compose directly)
+- ``ep``:   embedding-table axis (opt-in: the mesh carries it only when
+  ``ep > 1``) — params registered via ``tables=`` shard their ROWS
+  (dim 0) over ``ep``, the parameter-server giant-table layout
+  (reference: distribute_lookup_table.py) without a parameter server.
+  Batch leaves never split over ``ep``; ids replicate across it and
+  the lookup reduces over it (``parallel.sharded_embedding``).
 
 Spec resolution per param name: **explicit map > pattern rules >
 largest-axis-over-fsdp default > replicated.** Derived shardings:
@@ -49,6 +56,9 @@ from .. import telemetry
 from ..core.enforce import enforce
 
 PLAN_AXES = ("dp", "fsdp", "tp")
+# the opt-in table axis (present in the plan mesh only when ep > 1 so
+# ep=1 plans keep the exact legacy 3-axis mesh)
+TABLE_AXIS = "ep"
 
 Rule = Tuple[str, P]
 
@@ -78,6 +88,11 @@ class Plan:
       everything else replicates.
     - ``batch_axes``: mesh axes the batch leading dim splits over
       (default ``("dp", "fsdp")`` — the standard FSDP layout).
+    - ``tables``: regex patterns naming embedding-table params whose
+      ROWS shard over the ``ep`` axis (``P("ep", None)``) when
+      ``ep > 1`` — resolved between the explicit map and the pattern
+      rules, so a table registration beats ``transformer_tp_rules``
+      but an explicit per-name spec still wins.
 
     A spec that names an axis the leaf's dim doesn't divide by is
     dropped to the next resolution tier (same divisibility contract as
@@ -85,16 +100,21 @@ class Plan:
     """
 
     def __init__(self, dp: int = 1, fsdp: int = 1, tp: int = 1, *,
+                 ep: int = 1,
                  rules: Sequence[Rule] = (),
                  params: Optional[Dict[str, P]] = None,
+                 tables: Sequence[str] = (),
                  min_shard_size: int = 1024,
                  batch_axes: Sequence[str] = ("dp", "fsdp"),
                  devices: Optional[Sequence[jax.Device]] = None,
                  mesh: Optional[Mesh] = None,
                  grad_compression: Optional[str] = None):
-        for name, s in (("dp", dp), ("fsdp", fsdp), ("tp", tp)):
+        for name, s in (("dp", dp), ("fsdp", fsdp), ("tp", tp),
+                        (TABLE_AXIS, ep)):
             enforce(s >= 1, "plan axis %s must be >= 1, got %s", name, s)
         self.dp, self.fsdp, self.tp = int(dp), int(fsdp), int(tp)
+        self.ep = int(ep)
+        self.tables = [re.compile(pat) for pat in tables]
         # opt-in int8 gradient allreduce ("int8" | "int8_sr" stochastic
         # rounding): the Trainer compiles the quantized psum into the
         # pure-DP shard_map step / the wire-format round-trip into the
@@ -117,6 +137,11 @@ class Plan:
                     == (self.dp, self.fsdp, self.tp),
                     "mesh shape %s != plan (dp=%s, fsdp=%s, tp=%s)",
                     dict(mesh.shape), self.dp, self.fsdp, self.tp)
+            # the ep axis is opt-in: an ep=1 plan accepts the legacy
+            # 3-axis mesh; an ep>1 plan needs the table axis on it
+            enforce(int(mesh.shape.get(TABLE_AXIS, 1)) == self.ep,
+                    "mesh %s axis size %s != plan ep=%s", TABLE_AXIS,
+                    int(mesh.shape.get(TABLE_AXIS, 1)), self.ep)
             self._mesh: Optional[Mesh] = mesh
         else:
             self._mesh = None
@@ -127,40 +152,63 @@ class Plan:
     @property
     def mesh(self) -> Mesh:
         """The plan's mesh, built lazily over its devices (default: the
-        first ``dp*fsdp*tp`` of ``jax.devices()``). ``fsdp``/``tp`` take
-        the innermost (ICI-adjacent) positions, ``dp`` the outer
-        (possibly DCN) one — the scaling-book layout."""
+        first ``dp*fsdp*tp*ep`` of ``jax.devices()``). ``fsdp``/``tp``
+        (and ``ep``, whose lookup psum is the hot collective) take the
+        innermost (ICI-adjacent) positions, ``dp`` the outer (possibly
+        DCN) one — the scaling-book layout. An ep=1 plan builds the
+        exact legacy 3-axis mesh; the table axis appears only when
+        ``ep > 1``."""
         if self._mesh is None:
-            n = self.dp * self.fsdp * self.tp
+            n = self.num_devices
             devices = self._devices
             if devices is None:
                 devices = jax.devices()[:n]
             enforce(len(devices) == n,
-                    "plan needs %s devices (dp=%s x fsdp=%s x tp=%s), "
-                    "got %s", n, self.dp, self.fsdp, self.tp,
-                    len(devices))
-            self._mesh = Mesh(
-                np.asarray(devices).reshape(self.dp, self.fsdp, self.tp),
-                axis_names=PLAN_AXES)
+                    "plan needs %s devices (dp=%s x fsdp=%s x tp=%s "
+                    "x ep=%s), got %s", n, self.dp, self.fsdp, self.tp,
+                    self.ep, len(devices))
+            if self.ep > 1:
+                self._mesh = Mesh(
+                    np.asarray(devices).reshape(self.dp, self.fsdp,
+                                                self.tp, self.ep),
+                    axis_names=PLAN_AXES + (TABLE_AXIS,))
+            else:
+                self._mesh = Mesh(
+                    np.asarray(devices).reshape(self.dp, self.fsdp,
+                                                self.tp),
+                    axis_names=PLAN_AXES)
         return self._mesh
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp
+        return self.dp * self.fsdp * self.tp * self.ep
 
     @property
     def explicit(self) -> bool:
-        """True when the plan carries real shardings — fsdp/tp axes or
-        any per-param rule — and steps must compile through ``pjit``
+        """True when the plan carries real shardings — fsdp/tp/ep axes
+        or any per-param rule — and steps must compile through ``pjit``
         with full in/out shardings. A pure-DP plan (dp only) takes the
         ``shard_map`` fallback instead."""
-        return (self.fsdp > 1 or self.tp > 1 or bool(self.rules)
-                or bool(self.params))
+        return (self.fsdp > 1 or self.tp > 1 or self.ep > 1
+                or bool(self.rules) or bool(self.params))
 
     # -- spec resolution -----------------------------------------------------
 
+    def is_table(self, name: str) -> bool:
+        """True when ``name`` matches a registered ``tables=`` pattern
+        — the leaves the ``ep`` axis row-shards (and the leaves
+        ``analysis/shardcheck``'s PT-SHARD-204/205 table audits
+        apply to)."""
+        return any(pat.search(name) for pat in self.tables)
+
+    def table_spec(self) -> P:
+        """The row-sharded layout registered tables resolve to under an
+        ``ep`` plan."""
+        return P(TABLE_AXIS, None)
+
     def spec_for(self, name: str, value=None) -> P:
-        """Resolve one param/buffer name: explicit > pattern > default.
+        """Resolve one param/buffer name: explicit > table > pattern >
+        default.
 
         ``value`` (or anything with ``.shape``) gates divisibility and
         the default rule's size floor; without it, explicit/pattern
@@ -171,6 +219,12 @@ class Plan:
             spec = self.params[name]
             if self._divisible(value, spec):
                 return spec
+        if self.ep > 1 and self.is_table(name):
+            spec = self.table_spec()
+            if self._divisible(value, spec):
+                return spec
+            # indivisible vocab: fall through to rules/default (the
+            # audit reports the drop as PT-SHARD-202/204)
         for pat, spec in self.rules:
             if pat.search(name):
                 if self._divisible(value, spec):
@@ -182,14 +236,17 @@ class Plan:
         return self._default_spec(value)
 
     def requested_spec(self, name: str) -> Optional[P]:
-        """The spec the author *asked for* (explicit map, else first
-        matching rule) before any divisibility gating — ``None`` when
-        only the default tier applies. Lives next to :meth:`spec_for`
-        so the audit's notion of "requested" can never drift from the
-        resolution order it checks (``analysis/shardcheck`` compares
-        this against what :meth:`spec_for` actually resolves)."""
+        """The spec the author *asked for* (explicit map, else table
+        registration, else first matching rule) before any divisibility
+        gating — ``None`` when only the default tier applies. Lives
+        next to :meth:`spec_for` so the audit's notion of "requested"
+        can never drift from the resolution order it checks
+        (``analysis/shardcheck`` compares this against what
+        :meth:`spec_for` actually resolves)."""
         if name in self.params:
             return self.params[name]
+        if self.ep > 1 and self.is_table(name):
+            return self.table_spec()
         for pat, spec in self.rules:
             if pat.search(name):
                 return spec
@@ -293,12 +350,14 @@ class Plan:
     def describe(self, params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Plan summary for ``/statusz`` and bench extras."""
         out: Dict[str, Any] = {
-            "axes": {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp},
+            "axes": {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                     "ep": self.ep},
             "devices": self.num_devices,
             "batch_axes": list(self.batch_axes),
             "mode": "pjit" if self.explicit else "shard_map",
             "rules": len(self.rules),
             "explicit_params": len(self.params),
+            "tables": len(self.tables),
             "grad_compression": self.grad_compression,
         }
         if params is not None:
@@ -319,7 +378,8 @@ class Plan:
 
     def __repr__(self):
         return (f"Plan(dp={self.dp}, fsdp={self.fsdp}, tp={self.tp}, "
-                f"rules={len(self.rules)}, explicit={self.explicit})")
+                f"ep={self.ep}, rules={len(self.rules)}, "
+                f"tables={len(self.tables)}, explicit={self.explicit})")
 
 
 @contextlib.contextmanager

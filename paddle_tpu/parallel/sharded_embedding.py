@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..core.enforce import enforce
+from ..core.enforce import InvalidArgumentError, enforce
 from ..core.mesh import get_mesh
 from ..nn.layer import Layer
 from .. import initializer as I
@@ -46,6 +46,35 @@ def _lookup_inner(ids, table, *, axis, rows_per_shard):
     return lax.psum(rows, axis)
 
 
+def _check_ids_in_vocab(ids, vocab: int,
+                        padding_idx: Optional[int] = None) -> None:
+    """Typed out-of-vocab enforcement on CONCRETE ids (eager calls and
+    the op-construction path). An id outside [0, V) used to psum to a
+    silent all-zeros row — indistinguishable from a real zero embedding
+    and the classic off-by-one-vocab data bug; now it raises
+    :class:`..core.enforce.InvalidArgumentError`. ``padding_idx`` ids
+    are exempt (an out-of-range pad like -1 is a legitimate
+    convention). Traced ids (inside jit/pjit, shapes only) skip the
+    check — the in-shard mask still yields zeros there, and the data
+    pipeline owns validation."""
+    if isinstance(ids, jax.core.Tracer) or getattr(ids, "size", 0) == 0:
+        return
+    import numpy as np
+
+    # host-side numpy on the concrete ids: jnp ops here would STAGE
+    # under an enclosing jit trace (constants become tracers) and the
+    # int() coercion would blow up mid-trace
+    check = np.asarray(ids)
+    if padding_idx is not None:
+        check = np.where(check == padding_idx, 0, check)
+    lo, hi = int(check.min()), int(check.max())
+    if lo < 0 or hi >= vocab:
+        raise InvalidArgumentError(
+            f"embedding ids span [{lo}, {hi}] but the table has "
+            f"{vocab} rows — out-of-vocab ids are an error, not a "
+            f"clip (hash or bucket ids upstream, or grow the table)")
+
+
 def sharded_embedding_lookup(ids, table, *, axis: str = "ep",
                              batch_axis: Optional[str] = "dp", mesh=None,
                              padding_idx: Optional[int] = None):
@@ -53,6 +82,9 @@ def sharded_embedding_lookup(ids, table, *, axis: str = "ep",
 
     ``ids``: any int shape, batch-sharded over ``batch_axis`` (or
     replicated with ``batch_axis=None``). Returns ids.shape + (D,).
+    ``padding_idx`` rows come back as exact zeros; concrete
+    out-of-vocab ids raise :class:`..core.enforce.InvalidArgumentError`
+    (see :func:`_check_ids_in_vocab`).
     """
     mesh = mesh or get_mesh()
     enforce(axis in mesh.shape, "mesh has no %r axis (axes: %s)", axis,
@@ -61,6 +93,7 @@ def sharded_embedding_lookup(ids, table, *, axis: str = "ep",
     V, D = table.shape
     enforce(V % n == 0,
             "vocab %s must divide %s axis size %s (pad the table)", V, axis, n)
+    _check_ids_in_vocab(ids, V, padding_idx)
     if batch_axis is not None and batch_axis not in mesh.shape:
         batch_axis = None  # user mesh without a batch axis: replicate ids
     if batch_axis is not None and ids.shape[0] % mesh.shape[batch_axis]:

@@ -1,0 +1,414 @@
+"""Sharded embedding plane: the ep Plan axis, the host-backed table
+(RowCache + HostBackedTable + DevicePrefetcher hook), the sparse
+(ids, rows) gradient exchange, and the shardcheck table audits."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.embedding import (HostBackedTable, RowCache,
+                                  dense_grad_bytes, exchange_payload_bytes,
+                                  should_compress, sparse_ep_minimize_fn,
+                                  sparse_ep_update)
+from paddle_tpu.parallel.plan import Plan
+
+V, D = 64, 8
+
+
+# ---------------------------------------------------------------------------
+# RowCache — the clock/second-chance eviction substrate
+# ---------------------------------------------------------------------------
+
+
+class TestRowCache:
+    def test_admit_hit_miss_accounting(self):
+        c = RowCache(4)
+        slots, miss, ev = c.admit(np.array([3, 7]))
+        assert miss.all() and not ev
+        slots2, miss2, _ = c.admit(np.array([3, 9]))
+        assert not miss2[0] and miss2[1]
+        assert slots2[0] == slots[0]  # resident row keeps its slot
+        s = c.stats()
+        assert s["hits"] == 1 and s["misses"] == 3
+        assert s["resident"] == 3
+
+    def test_eviction_prefers_cold_rows(self):
+        c = RowCache(2)
+        c.admit(np.array([1, 2]))
+        # first eviction sweep clears every reference bit, evicts 1
+        _, _, ev = c.admit(np.array([3]))
+        assert ev == [1]
+        c.admit(np.array([3]))  # re-reference 3: its bit is set again
+        # 2's bit is still clear from the sweep: 2 is the cold victim
+        _, _, ev2 = c.admit(np.array([4]))
+        assert ev2 == [2]
+        assert (c.slots_of(np.array([3, 4])) >= 0).all()
+
+    def test_same_call_ids_protected_from_each_other(self):
+        c = RowCache(2)
+        c.admit(np.array([1, 2]))
+        slots, miss, evicted = c.admit(np.array([5, 6]))
+        # both new rows land; they evict the OLD rows, never each other
+        assert miss.all() and sorted(evicted) == [1, 2]
+        assert (c.slots_of(np.array([5, 6])) >= 0).all()
+
+    def test_batch_larger_than_capacity_rejected(self):
+        c = RowCache(2)
+        with pytest.raises(Exception, match="capacity"):
+            c.admit(np.array([1, 2, 3]))
+
+
+# ---------------------------------------------------------------------------
+# HostBackedTable — authoritative host rows, on-chip working set
+# ---------------------------------------------------------------------------
+
+
+class TestHostBackedTable:
+    def test_lookup_matches_host_rows(self):
+        t = HostBackedTable(V, D, capacity=16, seed=1)
+        ids = np.array([[1, 5], [63, 1]])
+        out = np.asarray(t.lookup(ids))
+        np.testing.assert_allclose(out, t.rows[ids], atol=1e-6)
+        assert out.shape == (2, 2, D)
+
+    def test_device_bytes_bounded_by_capacity_not_vocab(self):
+        t = HostBackedTable(10_000, D, capacity=8)
+        assert t.device_bytes == 8 * D * 4
+        assert t.host_bytes == 10_000 * D * 4
+
+    def test_prefetch_makes_lookup_all_hits(self):
+        t = HostBackedTable(V, D, capacity=16, seed=2)
+        ids = np.array([4, 9, 4, 30])
+        moved = t.prefetch(ids)
+        assert moved == 3  # deduped
+        before = t.cache.stats()["misses"]
+        np.testing.assert_allclose(np.asarray(t.lookup(ids)),
+                                   t.rows[ids], atol=1e-6)
+        assert t.cache.stats()["misses"] == before  # zero new misses
+
+    def test_update_write_through_survives_eviction(self):
+        t = HostBackedTable(V, D, capacity=2, seed=3)
+        t.lookup(np.array([1]))
+        t.update(np.array([1]), np.full((1, D), 7.0))
+        # thrash row 1 out of the working set...
+        t.lookup(np.array([10, 20]))
+        assert t.cache.slots_of(np.array([1]))[0] == -1
+        # ...the host array is authoritative: the re-fetch sees the write
+        np.testing.assert_allclose(np.asarray(t.lookup(np.array([1]))),
+                                   np.full((1, D), 7.0), atol=1e-6)
+
+    def test_out_of_range_id_enforced(self):
+        t = HostBackedTable(V, D, capacity=4)
+        with pytest.raises(Exception, match="out of range"):
+            t.lookup(np.array([V]))
+        with pytest.raises(Exception, match="out of range"):
+            t.prefetch(np.array([-1]))
+
+    def test_statusz_section(self):
+        t = HostBackedTable(V, D, capacity=4, name="ad_ids")
+        t.lookup(np.array([0, 1]))
+        s = t.statusz()
+        for k in ("name", "rows", "dim", "host_bytes", "device_bytes",
+                  "hits", "misses", "evictions", "hit_rate"):
+            assert k in s, k
+        assert s["name"] == "ad_ids" and s["misses"] == 2
+
+    def test_device_prefetcher_hook_overlaps_staging(self):
+        from paddle_tpu.data.device_loader import DevicePrefetcher
+
+        t = HostBackedTable(V, D, capacity=16, seed=4)
+        batches = [{"ids": np.array([1, 2, 3])},
+                   {"ids": np.array([3, 4, 5])}]
+        staged = list(DevicePrefetcher(
+            batches, size=2,
+            prefetch_rows=lambda b: t.prefetch(b["ids"])))
+        assert len(staged) == 2
+        # every batch's rows were staged by the hook: lookups all hit
+        before = t.cache.stats()["misses"]
+        for b in batches:
+            t.lookup(b["ids"])
+        assert t.cache.stats()["misses"] == before
+
+
+# ---------------------------------------------------------------------------
+# the ep axis as a Plan citizen
+# ---------------------------------------------------------------------------
+
+
+class TestPlanEpAxis:
+    def test_table_registration_resolves_row_sharding(self):
+        plan = Plan(dp=2, ep=4, tables=[r"emb\.weight$"])
+        assert plan.mesh.shape == {"dp": 2, "fsdp": 1, "tp": 1, "ep": 4}
+        w = jax.ShapeDtypeStruct((V, D), jnp.float32)
+        assert plan.spec_for("emb.weight", w) == P("ep", None)
+        # non-table params never ride the ep axis
+        assert plan.spec_for("fc.w", w) == P()
+
+    def test_ep1_plan_keeps_legacy_three_axis_mesh(self):
+        plan = Plan(dp=2, fsdp=2, tables=[r"emb\.weight$"])
+        assert tuple(plan.mesh.axis_names) == ("dp", "fsdp", "tp")
+        w = jax.ShapeDtypeStruct((V, D), jnp.float32)
+        # tables are inert at ep=1: the fsdp default still applies
+        assert "ep" not in (plan.spec_for("emb.weight", w) or ())
+
+    def test_indivisible_vocab_falls_through(self):
+        plan = Plan(ep=8, tables=[r"emb\.weight$"], min_shard_size=1)
+        w = jax.ShapeDtypeStruct((V + 1, D), jnp.float32)
+        assert plan.spec_for("emb.weight", w) == P()  # not torn
+
+    def test_batch_sharding_never_splits_over_ep(self):
+        plan = Plan(dp=2, ep=4, tables=[r"emb\.weight$"])
+        spec = plan.batch_sharding().spec
+        flat = [a for e in spec if e is not None
+                for a in (e if isinstance(e, tuple) else (e,))]
+        assert "ep" not in flat and "dp" in flat
+
+    def test_place_and_compile_step_one_compile_path(self):
+        plan = Plan(dp=2, ep=4, tables=[r"emb\.weight$"])
+        state = {"emb.weight": jnp.zeros((V, D)), "fc.w": jnp.zeros((D,))}
+        placed = plan.place(state)
+        assert placed["emb.weight"].sharding.spec == P("ep", None)
+
+        from paddle_tpu.parallel import compile_step
+        sh = jax.tree_util.tree_map(lambda x: x.sharding, placed)
+        step = compile_step(plan, lambda s: jax.tree_util.tree_map(
+            lambda x: x + 1, s), in_shardings=(sh,), out_shardings=sh)
+        out = step(placed)
+        assert step.compiled_via == "pjit"
+        assert out["emb.weight"].sharding.spec == P("ep", None)
+
+    def test_describe_reports_ep_and_tables(self):
+        d = Plan(dp=2, ep=4, tables=[r"emb"]).describe()
+        assert d["axes"]["ep"] == 4 and d["tables"] == 1
+
+
+# ---------------------------------------------------------------------------
+# shardcheck: the table audits (PT-SHARD-204 / 205)
+# ---------------------------------------------------------------------------
+
+
+class TestTableAudit:
+    STATE = {"emb.weight": jax.ShapeDtypeStruct((V * 16, D), jnp.float32)}
+
+    def _codes(self, plan):
+        from paddle_tpu.analysis.shardcheck import audit_plan
+
+        return [d.code for d in audit_plan(plan, self.STATE)]
+
+    def test_clean_ep_plan_no_findings(self):
+        assert self._codes(Plan(dp=2, ep=4, tables=[r"emb\.weight$"])) == []
+
+    def test_replicated_table_under_ep_flags_204(self):
+        plan = Plan(dp=2, ep=4, tables=[r"emb\.weight$"],
+                    params={"emb.weight": P()})
+        assert "PT-SHARD-204" in self._codes(plan)
+
+    def test_table_rows_on_batch_axis_flags_205(self):
+        plan = Plan(dp=2, ep=4, tables=[r"emb\.weight$"],
+                    params={"emb.weight": P("dp", None)})
+        codes = self._codes(plan)
+        assert "PT-SHARD-205" in codes
+
+
+# ---------------------------------------------------------------------------
+# byte accounting — the wire the sparse exchange replaces
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_payload_beats_dense_gradient_by_orders():
+    ids, vocab, dim, n = 4096, 10_000_000, 64, 8
+    int8 = exchange_payload_bytes(ids, dim, n, compressed=True)
+    fp32 = exchange_payload_bytes(ids, dim, n, compressed=False)
+    dense = dense_grad_bytes(vocab, dim, n)
+    assert int8 < fp32 < dense
+    assert dense / int8 > 1000  # the point of the subsystem
+    # degenerate axis: nothing crosses a wire
+    assert exchange_payload_bytes(ids, dim, 1, compressed=True) == 0
+    assert dense_grad_bytes(vocab, dim, 1) == 0
+
+
+def test_should_compress_tiny_payload_fp32_fallback():
+    assert not should_compress(8, 2, D)          # toy payload rides fp32
+    assert should_compress(4096, 2, 64)          # real payload rides int8
+
+
+# ---------------------------------------------------------------------------
+# sparse_ep_update — exchange + scatter parity on the 8-device sim
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ep_mesh():
+    mesh = pt.build_mesh(dp=2, ep=4, devices=jax.devices()[:8])
+    with pt.core.mesh.mesh_scope(mesh):
+        yield mesh
+
+
+def _dense_reference(opt, table, ids, row_grads, leaf_state, lr, step):
+    """The dense-gradient oracle: scatter-add rows into a (V, D) grad
+    and run the optimizer's ordinary dense update_leaf over the whole
+    table (fresh state: untouched rows stay bit-identical)."""
+    g = jnp.zeros_like(table).at[ids.reshape(-1)].add(
+        row_grads.reshape(-1, table.shape[1]))
+    return opt.update_leaf(table, g, leaf_state,
+                           jnp.asarray(lr, jnp.float32),
+                           jnp.asarray(step))
+
+
+class TestSparseEpUpdate:
+    def _setup(self, seed, B=32):
+        from paddle_tpu import optimizer
+
+        rng = np.random.default_rng(seed)
+        table = jnp.asarray(rng.normal(size=(V, D)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, V, size=(B,)))
+        grads = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
+        opt = optimizer.SGD(0.1)
+        return opt, table, ids, grads
+
+    def test_fp32_exchange_matches_dense_oracle(self, ep_mesh):
+        opt, table, ids, grads = self._setup(0)
+        st = opt.init_leaf(table)
+        new, _ = sparse_ep_update(opt, table, ids, grads, st, 0.1, 0,
+                                  mesh=ep_mesh, compress=False)
+        want, _ = _dense_reference(opt, table, ids, grads, st, 0.1, 0)
+        np.testing.assert_allclose(np.asarray(new), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_int8_exchange_close_and_untouched_rows_exact(self, ep_mesh):
+        opt, table, ids, grads = self._setup(1)
+        st = opt.init_leaf(table)
+        new, _ = sparse_ep_update(opt, table, ids, grads, st, 0.1, 0,
+                                  mesh=ep_mesh, compress=True)
+        want, _ = _dense_reference(opt, table, ids, grads, st, 0.1, 0)
+        np.testing.assert_allclose(np.asarray(new), np.asarray(want),
+                                   atol=5e-2)
+        untouched = np.setdiff1d(np.arange(V), np.asarray(ids))
+        np.testing.assert_array_equal(np.asarray(new)[untouched],
+                                      np.asarray(table)[untouched])
+
+    def test_adam_rowwise_state_matches_dense_oracle(self, ep_mesh):
+        from paddle_tpu import optimizer
+
+        rng = np.random.default_rng(2)
+        table = jnp.asarray(rng.normal(size=(V, D)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, V, size=(32,)))
+        grads = jnp.asarray(rng.normal(size=(32, D)).astype(np.float32))
+        opt = optimizer.Adam(1e-2)
+        st = opt.init_leaf(table)
+        new, new_st = sparse_ep_update(opt, table, ids, grads, st, 1e-2,
+                                       0, mesh=ep_mesh, compress=False)
+        want, want_st = _dense_reference(opt, table, ids, grads, st,
+                                         1e-2, 0)
+        np.testing.assert_allclose(np.asarray(new), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+        for k in new_st:
+            if hasattr(new_st[k], "shape") and np.shape(new_st[k])[:1] == (V,):
+                np.testing.assert_allclose(np.asarray(new_st[k]),
+                                           np.asarray(want_st[k]),
+                                           atol=1e-5, rtol=1e-5)
+
+    def test_nonfinite_grad_poisons_touched_rows(self, ep_mesh):
+        opt, table, ids, grads = self._setup(3)
+        grads = grads.at[0, 0].set(jnp.inf)
+        st = opt.init_leaf(table)
+        new, _ = sparse_ep_update(opt, table, ids, grads, st, 0.1, 0,
+                                  mesh=ep_mesh, compress=True)
+        # the poison lands in touched rows (the nan-guard fires on the
+        # next loss), never silently laundered through the quantizer
+        assert not np.isfinite(
+            np.asarray(new)[np.asarray(ids)]).all()
+
+    def test_indivisible_vocab_enforced(self, ep_mesh):
+        from paddle_tpu import optimizer
+
+        opt = optimizer.SGD(0.1)
+        bad = jnp.zeros((V + 2, D))
+        with pytest.raises(Exception, match="vocab"):
+            sparse_ep_update(opt, bad, jnp.zeros((8,), jnp.int32),
+                             jnp.zeros((8, D)), opt.init_leaf(bad),
+                             0.1, 0, mesh=ep_mesh)
+
+
+# ---------------------------------------------------------------------------
+# the full vertical: Plan(ep=N) + compile_step + sparse_ep_minimize_fn
+# ---------------------------------------------------------------------------
+
+
+def test_plan_ep_train_step_matches_unsharded_sparse_loop():
+    """DeepFM-shaped toy: one is_sparse embedding + a dense head,
+    trained under Plan(dp=2, ep=4) through the one-compile path, must
+    bit-match (atol 1e-5) the unsharded optimizer.sparse loop."""
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.nn.layer import Layer
+    from paddle_tpu.optimizer.sparse import sparse_minimize_fn
+    from paddle_tpu.parallel import compile_step
+
+    class Toy(Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V, D, is_sparse=True)
+            self.fc = nn.Linear(D, 1)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(axis=1)).squeeze(-1)
+
+    def make(seed):
+        pt.seed(seed)
+        return Toy()
+
+    rng = np.random.default_rng(11)
+    ids = jnp.asarray(rng.integers(0, V, size=(16, 4)))
+    y = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+
+    def loss_of(model):
+        def f(params, ids, y):
+            pred, _ = model.functional_call(params, ids)
+            return jnp.mean((pred - y) ** 2)
+        return f
+
+    # oracle: the unsharded sparse loop
+    m_ref = make(5)
+    opt = optimizer.SGD(0.1)
+    init_ref, step_ref = sparse_minimize_fn(m_ref, loss_of(m_ref), opt)
+    p_ref = m_ref.named_parameters()
+    s_ref = init_ref(p_ref)
+    for _ in range(3):
+        l_ref, p_ref, s_ref = step_ref(p_ref, s_ref, ids, y)
+
+    # the plan path: ep-sharded table, compiled once
+    m = make(5)
+    plan = Plan(dp=2, ep=4, tables=[r"emb\.weight$"])
+    init_fn, step_fn = sparse_ep_minimize_fn(
+        m, loss_of(m), opt, plan=plan, compress=False)
+    params = plan.place(m.named_parameters())
+    assert params["emb.weight"].sharding.spec == P("ep", None)
+    state = init_fn(params)
+    from jax.sharding import NamedSharding
+    p_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
+    rep = NamedSharding(plan.mesh, P())
+    # optimizer state: rowwise (V-leading) leaves ride the table's ep
+    # placement, scalars/others replicate on the SAME mesh as params
+    s_sh = jax.tree_util.tree_map(
+        lambda x: (NamedSharding(plan.mesh, P("ep", None))
+                   if getattr(x, "ndim", 0) >= 1 and x.shape[0] == V
+                   else rep), state)
+    state = jax.tree_util.tree_map(jax.device_put, state, s_sh)
+    bs = plan.batch_sharding()
+    step = compile_step(plan, step_fn,
+                        in_shardings=(p_sh, s_sh, bs, bs),
+                        out_shardings=(rep, p_sh, s_sh))
+    for _ in range(3):
+        l, params, state = step(params, state, ids, y)
+    assert step.compiled_via == "pjit"
+
+    np.testing.assert_allclose(float(l), float(l_ref), atol=1e-5)
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(params[k]),
+                                   np.asarray(p_ref[k]),
+                                   atol=1e-5, rtol=1e-5, err_msg=k)
+    # placement preserved across steps (no silent reshard)
+    assert params["emb.weight"].sharding.spec == P("ep", None)

@@ -64,6 +64,35 @@ def test_lookup_padding_idx(ep_mesh):
     assert np.allclose(np.asarray(out[0, 1]), 1.0)
 
 
+def test_lookup_rejects_out_of_vocab_ids(ep_mesh):
+    # an id >= V (or < 0) used to psum to a silent all-zeros row — the
+    # off-by-one-vocab data bug; it must raise a TYPED enforce instead
+    from paddle_tpu.core.enforce import InvalidArgumentError
+
+    table = jnp.ones((V, D), jnp.float32)
+    with pytest.raises(InvalidArgumentError, match="out-of-vocab"):
+        sharded_embedding_lookup(jnp.asarray([1, V]), table, mesh=ep_mesh)
+    with pytest.raises(InvalidArgumentError, match="out-of-vocab"):
+        sharded_embedding_lookup(jnp.asarray([-1, 2]), table, mesh=ep_mesh)
+
+
+def test_lookup_out_of_range_padding_idx_is_exempt(ep_mesh):
+    # pad conventions like -1 live OUTSIDE [0, V): legitimate, zeros out
+    table = jnp.ones((V, D), jnp.float32)
+    ids = jnp.asarray([[5, -1], [-1, 7]])
+    out = sharded_embedding_lookup(ids, table, mesh=ep_mesh,
+                                   padding_idx=-1)
+    np.testing.assert_allclose(np.asarray(out[0, 1]), 0.0)
+    np.testing.assert_allclose(np.asarray(out[1, 0]), 0.0)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), 1.0)
+    # but a NON-pad id out of range still raises with padding_idx set
+    from paddle_tpu.core.enforce import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError, match="out-of-vocab"):
+        sharded_embedding_lookup(jnp.asarray([5, V]), table,
+                                 mesh=ep_mesh, padding_idx=-1)
+
+
 def test_sharded_embedding_layer_and_rules(ep_mesh):
     pt.seed(0)
     emb = ShardedEmbedding(V, D, mesh=ep_mesh)

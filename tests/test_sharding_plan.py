@@ -237,7 +237,7 @@ class TestPlannedTraining:
         plan = Plan(dp=2, fsdp=4, min_shard_size=64)
         tr = make_trainer(plan=plan)
         d = plan.describe(tr.params)
-        assert d["axes"] == {"dp": 2, "fsdp": 4, "tp": 1}
+        assert d["axes"] == {"dp": 2, "fsdp": 4, "tp": 1, "ep": 1}
         assert d["mode"] == "pjit"
         assert d["sharded_params"] >= 3
         assert "fc1.weight" in d["param_specs"]

@@ -43,6 +43,9 @@ if [ "$MODE" = "full" ]; then
   run python bench.py --model stacked_lstm --batch-size 1024 --scan-unroll 8
   run python bench.py --model se_resnext50 --layout NCHW
   run python bench.py --model deepfm --steps-per-call 8
+  # sharded embedding plane: ep=8 row-sharded tables, sparse (ids,
+  # rows) exchange, byte-budget gate (_ep8 history key)
+  run python bench.py --model deepfm_sparse --plan ep=8
   run python bench.py --model gpt_decode --gamma 4
   run python bench.py --model gpt_serve
   run python bench.py --model gpt_serve --weight-only

@@ -71,6 +71,11 @@ case "$MODE" in
   mid)
     stage "mid tier (pytest -m mid)"
     python -m pytest tests/ -m mid -q || exit $?
+    stage "embedding smoke (SIGKILL mid-ep-table-save -> newest \
+committed step restores, then re-places onto a smaller ep mesh; the \
+fast ep-plan/exchange/host-cache tests ride -m mid above)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_embedding_ckpt.py \
+      -q -m chaos || exit $?
     stage "fleet smoke (2-rank launch -> train -> coordinated SIGTERM \
 -> resume; chaos tier, FaultInjector seeds pinned)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_controller.py \

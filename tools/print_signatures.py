@@ -33,6 +33,7 @@ MODULES = [
     "paddle_tpu.metrics",
     "paddle_tpu.initializer",
     "paddle_tpu.checkpoint",
+    "paddle_tpu.embedding",
     "paddle_tpu.amp",
     "paddle_tpu.quant",
     "paddle_tpu.fleet",
