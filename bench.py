@@ -887,6 +887,77 @@ def _router_replica_spec(smoke=False, kv_dtype=None, slots=4,
         kv_dtype=kv_dtype, **kw)
 
 
+def _router_aot_ttfr_ab(spec_kw):
+    """TTFR (time-to-first-ready) A/B for the aot compiled-program
+    plane: boot the SAME replica twice — once through the ordinary
+    trace path (construct model, trace, compile, warm) and once
+    trace-free from the serialized artifact the first boot exported —
+    and gate ``ttfr_aot_ms < ttfr_traced_ms`` (the artifact exists to
+    delete trace+compile from elastic scale-up; if it doesn't, the
+    plane is a regression and the bench must say so). The AOT replica
+    then serves a real request end-to-end, so the number is a SERVING
+    boot, not a load microbenchmark. Artifact export/load failures
+    raise :class:`_SkipBench` (skipped row, cause
+    ``artifact_load_failed``) — never a fake 0.0 TTFR."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu import aot
+    from paddle_tpu.core.enforce import enforce
+    from paddle_tpu.serving_router import LocalReplica
+
+    def boot(mk):
+        t0 = time.perf_counter()
+        rep = LocalReplica(mk(), name="ttfr").start()
+        rep.warmup()
+        return rep, (time.perf_counter() - t0) * 1e3
+
+    rep, ttfr_traced = boot(lambda: _router_replica_spec(**spec_kw))
+    tmp = tempfile.mkdtemp(prefix="pt-aot-bench-")
+    art = os.path.join(tmp, "artifact")
+    try:
+        try:
+            aot.export_decoder(rep.decoder, art)
+        except aot.AotError as e:
+            raise _SkipBench(f"aot artifact export failed: {e}",
+                             cause="artifact_load_failed")
+        finally:
+            rep.close()
+
+        def load():
+            try:
+                return aot.load_decoder(art)
+            except aot.AotError as e:
+                raise _SkipBench(f"aot artifact load failed: {e}",
+                                 cause="artifact_load_failed")
+
+        rep2, ttfr_aot = boot(load)
+        try:
+            # end-to-end through the trace-free replica: the stub
+            # booby-traps every trace entry point, so tokens coming
+            # back prove the serialized programs served the request
+            rid = rep2.submit(np.asarray([1, 2], np.int32), 4)
+            deadline = time.time() + 300.0
+            done = {}
+            while rid not in done and time.time() < deadline:
+                done.update(rep2.drain_results())
+                time.sleep(0.01)
+            enforce(rid in done and len(done[rid]["tokens"]) > 0,
+                    "aot-booted replica served no tokens")
+            info = getattr(rep2.decoder, "aot_info", {})
+        finally:
+            rep2.close()
+        enforce(ttfr_aot < ttfr_traced,
+                "aot cold start (%.0f ms) must beat the traced boot "
+                "(%.0f ms) — the artifact plane exists to delete "
+                "trace+compile from scale-up", ttfr_aot, ttfr_traced)
+        return {"ttfr_traced_ms": round(ttfr_traced, 1),
+                "ttfr_aot_ms": round(ttfr_aot, 1),
+                "aot_artifact_id": info.get("artifact_id")}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _open_loop(router, prompts, max_new: int, rate_rps: float,
                rng, timeout_s: float = 900.0, stream: bool = False):
     """Seeded Poisson OPEN-loop load: arrivals are exponential gaps at
@@ -963,7 +1034,7 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
                      smoke: bool = False, replicas: int = 2,
                      prefill_workers: int = 1, overload: float = 2.0,
                      kv_dtype=None, router_procs: bool = False,
-                     stream: bool = False):
+                     stream: bool = False, from_artifact: bool = False):
     """Production-serving A/B (serving_router.Router): a seeded Poisson
     OPEN-loop load with long prompts mixed in, three arms on the same
     replicas —
@@ -994,6 +1065,10 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
     rng = np.random.default_rng(0)
     vocab = 1024 if smoke else 50257
     spec_kw = {"smoke": smoke, "kv_dtype": kv_dtype}
+    # the AOT TTFR A/B boots its own pair of replicas BEFORE the fleet
+    # spawns (no shared page pools, so neither boot is flattered by a
+    # pre-warmed process) and gates ttfr_aot < ttfr_traced
+    aot_cols = _router_aot_ttfr_ab(spec_kw) if from_artifact else {}
 
     def mk_prompts(n, seed):
         # every 3rd prompt is LONG — the mix that makes monolithic
@@ -1127,6 +1202,7 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
         "overload_shed_rate": over["shed_rate"],
         "overload_tokps": over["tokps"],
     })
+    extras.update(aot_cols)
     if stream_arm is not None:
         # the streaming arm, one column family apart: TTFT here is the
         # router-side FIRST-TOKEN stamp and ITL the client-side
@@ -2213,6 +2289,9 @@ def run_config_fingerprint(metric: str, args, steps: int):
         "router_prefill_workers": (
             args.prefill_workers if getattr(args, "router", False)
             else None),
+        "router_from_artifact": (
+            True if getattr(args, "router", False)
+            and getattr(args, "from_artifact", False) else None),
         "layout": args.layout, "dp": args.dp, "infer": args.infer,
     }
     # None = knob not set; False values (e.g. --no-fused-ce) are REAL
@@ -2320,6 +2399,18 @@ def _emit_error(metric: str, msg: str) -> None:
                       "peak_mem_bytes": None, "error": msg}))
 
 
+class _SkipBench(Exception):
+    """Raised by a bench fn when the ENVIRONMENT (not the workload)
+    makes the measurement impossible mid-run — e.g. the aot artifact
+    failed to export/load. main() converts it into the ``skipped``
+    JSON line via :func:`_emit_skip`; a fabricated 0.0 (or a fake TTFR)
+    would read as a real measurement and poison the trend history."""
+
+    def __init__(self, msg: str, cause: str = None):
+        super().__init__(msg)
+        self.cause = cause
+
+
 def _emit_skip(metric: str, msg: str, cause: str = None) -> None:
     """One-JSON-line driver contract, INFRA-error form: the workload is
     fine but the environment failed (device init timeout, profiler
@@ -2424,6 +2515,14 @@ def main():
                     "inter-token-latency columns) and the "
                     "prefix-hash vs session-only routing hit-rate "
                     "A/B to the same JSON line")
+    ap.add_argument("--from-artifact", dest="from_artifact",
+                    action="store_true",
+                    help="--router: add the AOT cold-start A/B — "
+                    "export the replica's compiled programs "
+                    "(paddle_tpu.aot) and boot a second replica "
+                    "trace-free from the artifact; reports "
+                    "ttfr_traced_ms vs ttfr_aot_ms and GATES "
+                    "ttfr_aot < ttfr_traced (_aot history key)")
     ap.add_argument("--prefill-chunk", dest="prefill_chunk", type=int,
                     default=None,
                     help="gpt_serve: chunked prefill — C prompt tokens "
@@ -2500,6 +2599,11 @@ def main():
                     "--stream only applies with --router "
                     "(gpt_serve streaming arm)")
         return
+    if args.from_artifact and not args.router:
+        _emit_error(f"{args.model}_throughput",
+                    "--from-artifact only applies with --router "
+                    "(the aot cold-start A/B)")
+        return
     if args.router:
         if args.model != "gpt_serve":
             _emit_error(f"{args.model}_throughput",
@@ -2519,6 +2623,9 @@ def main():
             # the streaming arm changes the measured columns (stream
             # TTFT/ITL + the prefix-routing A/B): its own history key
             metric += "_stream"
+        if args.from_artifact:
+            # the AOT A/B adds the TTFR columns + its gate: own key
+            metric += "_aot"
     if (args.vocab and "vocab" in sig
             and args.vocab != sig["vocab"].default):
         metric += f"_v{args.vocab}"
@@ -2769,6 +2876,7 @@ def main():
         kwargs["overload"] = args.overload
         kwargs["router_procs"] = args.router_procs
         kwargs["stream"] = args.stream
+        kwargs["from_artifact"] = args.from_artifact
     if args.prefill_chunk and "prefill_chunk" in sig:
         kwargs["prefill_chunk"] = args.prefill_chunk
     if (args.decode_steps and args.decode_steps > 1
@@ -2818,7 +2926,11 @@ def main():
     else:
         dctx = contextlib.nullcontext()
     with ctx, dctx:
-        value, unit, *rest = fn(steps, batch, **kwargs)
+        try:
+            value, unit, *rest = fn(steps, batch, **kwargs)
+        except _SkipBench as e:
+            _emit_skip(metric, str(e), cause=e.cause)
+            return
     extras = rest[0] if rest else {}
     if args.device_trace:
         # the artifact contract: at least one non-trivial xplane proto
@@ -2935,13 +3047,18 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                                   "kv_", "max_sessions_",
                                   # router serving A/B: TTFT/ITL
                                   # percentiles, shed rates, and the
-                                  # mono/overload comparison arms
+                                  # mono/overload comparison arms (+
+                                  # the streaming arm, the prefix-hash
+                                  # routing A/B, and the aot TTFR
+                                  # cold-start A/B columns)
                                   "ttft_", "itl_", "mono_",
+                                  "stream_", "prefix_", "ttfr_",
                                   # sharded-embedding plane: wire
                                   # payload vs dense counterfactual,
                                   # host-cache hit rate, table rows
                                   "overload_", "emb_"))
-                 or k in ("accept_per_round", "rounds", "prefetch_off",
+                 or k in ("aot_artifact_id",
+                          "accept_per_round", "rounds", "prefetch_off",
                           "prefetch_on", "overlap_speedup", "fsdp",
                           # checkpoint bench: save/recovery latency and
                           # the step-agreed transaction's barrier cost

@@ -59,6 +59,12 @@ PT-RACE-403    Concurrency: timeout-less blocking call (join /
 PT-RACE-404    Concurrency: Condition.wait outside a predicate loop
 PT-RACE-405    Concurrency: non-daemon thread never joined in its
                module
+PT-AOT-601     AOT serving (warning): --from-artifact boot rejected
+               the serialized artifact (toolchain fingerprint drift,
+               torn/unreadable artifact) and fell back to the trace
+               path — the replica serves correctly but pays
+               trace+compile cold start; re-export the artifact under
+               the current jax/jaxlib to restore trace-free boots
 =============  ========================================================
 """
 

@@ -1,0 +1,31 @@
+"""AOT compiled-program plane: the *compiled program* as the
+deployment unit (the reference's AnalysisPredictor stance, PAPER.md
+layer 8).
+
+``export_decoder`` serializes a warmed serving arena's compiled
+decode/prefill executables (jax.export) + weights + config into a
+committed two-phase artifact next to the checkpoint; ``load_decoder``
+(``restore_and_run``) boots a serving replica from the artifact alone
+— no Python model construction, no tracing — so elastic scale-up pays
+artifact-load + dispatch, not trace + compile. Serving integration:
+``launch.py --serve --from-artifact`` / ``serving_router.run_worker``
+(PT-AOT-601 warn-once fallback to the trace path on fingerprint
+mismatch).
+"""
+
+from .artifact import (ARTIFACT_FORMAT, AotCompatError, AotError,
+                       artifact_dir_for_step, check_fingerprint,
+                       export_decoder, fingerprint, latest_artifact,
+                       read_manifest, resolve_artifact)
+from .loader import AotTraceError, ModelStub, load_decoder
+
+# the loader IS restore_and_run — the artifact-native bring-up named by
+# the checkpoint plane's restore() lineage
+restore_and_run = load_decoder
+
+__all__ = [
+    "ARTIFACT_FORMAT", "AotError", "AotCompatError", "AotTraceError",
+    "ModelStub", "artifact_dir_for_step", "check_fingerprint",
+    "export_decoder", "fingerprint", "latest_artifact", "load_decoder",
+    "read_manifest", "resolve_artifact", "restore_and_run",
+]

@@ -1012,6 +1012,13 @@ class CheckpointManager:
     """
 
     _STEP_RE = re.compile(r"^step_(\d+)$")
+    # aot compiled-program artifacts (paddle_tpu.aot) live NEXT TO
+    # their producing step dir and ride its retention: GC prunes
+    # aot_step_N exactly when step_N falls out of retention, so a
+    # serving boot can never resolve an artifact whose weights-step
+    # was already deleted (aot.latest_artifact additionally refuses
+    # artifacts whose companion step_N lost its COMMITTED marker)
+    _AOT_RE = re.compile(r"^aot_step_(\d+)$")
 
     def __init__(self, directory: str, max_to_keep: int = 5,
                  async_save: bool = True, coordinator=None):
@@ -1290,6 +1297,10 @@ class CheckpointManager:
         steps = self.committed_steps()
         for s in steps[:-self.max_to_keep]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            # the step's compiled-program artifact rides the same
+            # retention — weights gone means nothing serves from it
+            shutil.rmtree(os.path.join(self.directory, f"aot_step_{s}"),
+                          ignore_errors=True)
         # crash litter: torn step dirs (uncommitted, no in-flight
         # writer, older than the newest committed step — provably a
         # dead save) and step_N.old trash from a kill mid-rename-swap
@@ -1328,7 +1339,21 @@ class CheckpointManager:
                 else:
                     shutil.rmtree(full, ignore_errors=True)
                 continue
-            base = name[:-len(".tmp")] if name.endswith(".tmp") else name
+            base = name
+            for suf in (".tmp", ".old"):
+                if name.endswith(suf):
+                    base = name[:-len(suf)]
+                    break
+            ma = self._AOT_RE.match(base)
+            if ma:
+                # stale artifact: its producing step_N fell out of
+                # retention (or never committed) and newer committed
+                # state exists — nothing may serve from it
+                s = int(ma.group(1))
+                if (newest is not None and s < newest
+                        and not self._is_committed(f"step_{s}")):
+                    shutil.rmtree(full, ignore_errors=True)
+                continue
             m = self._STEP_RE.match(base)
             if (m and newest is not None and int(m.group(1)) < newest
                     and os.path.join(self.directory, base) not in pending
@@ -1361,6 +1386,16 @@ class CheckpointManager:
                 if name.endswith(suf):
                     base = name[:-len(suf)]
                     break
+            ma = self._AOT_RE.match(base)
+            if ma:
+                # artifacts ride the fleet retention of their step:
+                # prunable only below the globally-committed floor and
+                # outside the protected window (same rule as step dirs)
+                s = int(ma.group(1))
+                if s < newest and s not in protected:
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+                continue
             m = self._STEP_RE.match(base)
             if not m:
                 continue

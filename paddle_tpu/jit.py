@@ -105,6 +105,10 @@ def save(layer: Layer, dirname: str, example_args: Sequence,
             "arg_order": ([f"param:{n}" for n in sorted(params)] +
                           [f"feed:{n}" for n in sorted(feed_specs)]),
             "batch_polymorphic": polymorphic,
+            # the producing toolchain identity (the aot-plane compat
+            # gate) — consumers that rehydrate the serialized program
+            # (rather than re-lowering the StableHLO) compare it
+            "fingerprint": _compat.runtime_fingerprint(),
             "format": "stablehlo+npz/v2",
         }, indent=1))
 

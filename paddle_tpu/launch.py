@@ -378,7 +378,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "and run the serving_router front end over them")
     ap.add_argument("--spec", default=None,
                     help="--serve: module:function returning each "
-                    "replica's serving.BatchedDecoder")
+                    "replica's serving.BatchedDecoder; also accepts "
+                    "the multi-model form name=module:fn,name2=... "
+                    "(one replica set + page pool per model)")
+    ap.add_argument("--from-artifact", dest="from_artifact",
+                    default=None,
+                    help="--serve: aot artifact dir (or checkpoint "
+                    "root holding aot_step_N) — boot replicas "
+                    "trace-free from serialized programs; --spec "
+                    "becomes the traced fallback on fingerprint "
+                    "mismatch (PT-AOT-601)")
     ap.add_argument("--spec-kw", dest="spec_kw", default=None,
                     help="--serve: JSON kwargs for the spec function")
     ap.add_argument("--prefill-workers", dest="prefill_workers",
@@ -413,8 +422,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="arguments passed through to the script")
     args = ap.parse_args(argv)
     if args.serve:
-        if not args.spec:
-            ap.error("--serve requires --spec module:fn")
+        if not (args.spec or args.from_artifact):
+            ap.error("--serve requires --spec module:fn and/or "
+                     "--from-artifact DIR")
         import json as _json
 
         from .serving_router import serve_main
@@ -425,7 +435,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             spec_kw=_json.loads(args.spec_kw) if args.spec_kw else None,
             log_dir=args.log_dir, trace_sample=args.trace_sample,
             dispatch=args.dispatch,
-            prefix_hash_tokens=args.prefix_hash_tokens or None)
+            prefix_hash_tokens=args.prefix_hash_tokens or None,
+            from_artifact=args.from_artifact)
         print(f"[launch] router serving on {router.server.url()} over "
               f"{args.nproc} replica(s) + {args.prefill_workers} "
               f"prefill worker(s)", file=sys.stderr)

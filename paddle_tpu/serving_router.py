@@ -226,6 +226,16 @@ def _router_metrics(reg):
             "pt_router_prefix_cache_hit_ratio",
             "fleet prefix-cache hit rate: sum(prefix hits) / "
             "sum(prefix lookups) over live replicas' pool stats"),
+        # mode-labeled cold-start split (the reject_cause idiom):
+        # aot = trace-free boot from a serialized artifact, traced =
+        # ordinary trace path, traced_fallback = an artifact was asked
+        # for but rejected (fingerprint/load) and the trace path ran
+        "cold_starts": {
+            mode: reg.counter(
+                "pt_aot_cold_starts_total",
+                "serving replica cold starts by boot mode",
+                labels={"mode": mode})
+            for mode in ("aot", "traced", "traced_fallback")},
     }
 
 
@@ -253,13 +263,31 @@ class SLOPolicy:
     the ladder deterministically."""
 
     def __init__(self, target_ttft_s: Optional[float] = None,
-                 degrade_at: float = 1.5, shed_at: float = 3.0):
+                 degrade_at: float = 1.5, shed_at: float = 3.0,
+                 classes: Optional[Dict[str, "SLOPolicy"]] = None):
         enforce(shed_at >= degrade_at,
                 "shed_at %s < degrade_at %s (shedding is the deeper "
                 "degradation)", shed_at, degrade_at)
         self.target_ttft_s = target_ttft_s
         self.degrade_at = float(degrade_at)
         self.shed_at = float(shed_at)
+        # per-model SLO classes (multi-model routing): model id ->
+        # its own policy; unlisted models (and untagged requests) use
+        # THIS policy's ladder as the fleet-wide default
+        for m, p in (classes or {}).items():
+            enforce(isinstance(p, SLOPolicy),
+                    "SLO class for model %r must be an SLOPolicy, "
+                    "got %s", m, type(p).__name__)
+        self.classes: Dict[str, "SLOPolicy"] = dict(classes or {})
+
+    def resolve(self, model: Optional[str]) -> "SLOPolicy":
+        """The policy governing ``model``'s admissions: its registered
+        SLO class, else this (fleet-default) policy."""
+        if model is not None:
+            got = self.classes.get(model)
+            if got is not None:
+                return got
+        return self
 
     def admit(self, in_flight: int, slots: int,
               ewma_ttft_s: Optional[float] = None,
@@ -307,9 +335,12 @@ class LocalReplica:
     isolation for free."""
 
     def __init__(self, decoder: BatchedDecoder, name: str = "replica0",
-                 idle_s: float = 0.002):
+                 idle_s: float = 0.002, model: Optional[str] = None):
         self.decoder = decoder
         self.name = name
+        # model tag (multi-model routing): tagged tickets only place on
+        # replicas serving their model; None = the single-model fleet
+        self.model = model
         self.idle_s = idle_s
         self._mu = threading.RLock()
         self._done: Dict[int, Dict[str, Any]] = {}
@@ -502,9 +533,11 @@ class HttpReplica:
 
     def __init__(self, url: str, name: Optional[str] = None,
                  timeout_s: float = 60.0,
-                 proc: Optional[subprocess.Popen] = None):
+                 proc: Optional[subprocess.Popen] = None,
+                 model: Optional[str] = None):
         self.url = url.rstrip("/")
         self.name = name or url
+        self.model = model  # multi-model routing tag (see LocalReplica)
         self.timeout_s = timeout_s
         self.proc = proc  # when spawn_replicas owns the process
 
@@ -628,11 +661,13 @@ class Ticket:
     completion record."""
 
     def __init__(self, rid: int, prompt, max_new: int,
-                 session: Optional[str]):
+                 session: Optional[str],
+                 model: Optional[str] = None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.session = session
+        self.model = model  # model-id routing key (None = any replica)
         self.trace = None  # TraceContext minted at admission
         self.shed = False
         self.t_submit = time.perf_counter()
@@ -671,6 +706,7 @@ class _ReplicaState:
     def __init__(self, replica):
         self.replica = replica
         self.name = replica.name
+        self.model = getattr(replica, "model", None)
         self.alive = True
         self.ready = False
         self.claimed = 0  # pulled off the queue, not yet registered
@@ -732,6 +768,13 @@ class Router:
             enforce(r.name not in self._replicas,
                     "duplicate replica name %r", r.name)
             self._replicas[r.name] = _ReplicaState(r)
+        # multi-model fleet: the model tags present across replicas —
+        # submit(model=) is validated against this set so a typo'd
+        # model id fails typed at admission, not as a forever-parked
+        # ticket no replica will ever claim
+        self._models = sorted({st.model
+                               for st in self._replicas.values()
+                               if st.model is not None})
         self._prefill = list(prefill_workers)
         self._pf_rr = 0
         self.policy = policy
@@ -772,7 +815,11 @@ class Router:
         self._tickets: Dict[int, Ticket] = {}
         self._next_rid = 0
         self._queued = 0            # accepted, not yet dispatched
+        # per-model split of _queued (multi-model SLO ladders read
+        # their own model's queue pressure, not the fleet total)
+        self._queued_by: Dict[str, int] = {}
         self._degraded = False
+        self._degraded_by: Dict[Optional[str], bool] = {}
         self._ewma_ttft: Optional[float] = None
         self._ewma_wait: Optional[float] = None  # dispatch-queue wait
         self._shed_count = 0
@@ -828,18 +875,31 @@ class Router:
     def submit(self, prompt, max_new: int,
                session: Optional[str] = None,
                raise_on_shed: bool = False,
-               stream: bool = False) -> Ticket:
+               stream: bool = False,
+               model: Optional[str] = None) -> Ticket:
         """Route one request (non-blocking). SLO shed returns a
         ``shed=True`` ticket (or raises :class:`RequestShedError` when
         asked); :class:`NoReplicasError` when no replica is alive.
+
+        ``model=``: model-id routing on a multi-model fleet — the
+        ticket only places on replicas tagged with that model (their
+        own arenas, so per-model page pools come with the placement),
+        and its admission runs under that model's SLO class
+        (:meth:`SLOPolicy.resolve`). An unknown model id is a typed
+        error at admission. ``model=None`` places anywhere (the
+        single-model fleet, unchanged).
 
         ``stream=True``: the returned ticket carries a client-side
         :class:`serving.TokenStream` — tokens arrive per decode tick,
         the first one stamps ``ttft_s`` and the router TTFT histogram,
         and terminal failure/retry surface as typed control records on
         the stream (never a silent stall)."""
+        enforce(model is None or model in self._models,
+                "unknown model %r: this fleet serves %s", model,
+                self._models or "an untagged single-model fleet")
         with self._mu:
-            t = Ticket(self._next_rid, prompt, max_new, session)
+            t = Ticket(self._next_rid, prompt, max_new, session,
+                       model=model)
             self._next_rid += 1
         if stream:
             t.stream = TokenStream(maxlen=self.stream_buffer)
@@ -857,17 +917,18 @@ class Router:
             _tracing.event("router.admit", ctx=t.trace, rid=t.rid,
                            session=session, plen=int(t.prompt.size),
                            max_new=t.max_new)
-        if not self._alive_names():
+        if not self._alive_names(t.model):
             self._probe_all()
-            if not self._alive_names():
+            if not self._alive_names(t.model):
                 raise NoReplicasError(
-                    "no replica alive to place the request on")
+                    "no replica alive to place the request on"
+                    + (f" (model {t.model!r})" if t.model else ""))
         cause = None
         if self.max_in_flight is not None:
             with self._mu:
                 if self._in_flight_locked() >= self.max_in_flight:
                     cause = "capacity"  # hard queue-depth cap
-        if cause is None and self._policy_action() == "shed":
+        if cause is None and self._policy_action(t.model) == "shed":
             cause = "shed"
         if cause is not None:
             t.shed = True
@@ -890,7 +951,7 @@ class Router:
             return t
         with self._mu:
             self._tickets[t.rid] = t
-            self._queued += 1
+            self._q_adj(t, +1)
         if self._dispatch_mode == "pull":
             with self._work:
                 self._pending.append(t)
@@ -934,6 +995,10 @@ class Router:
                 "steals": self._steal_count,
                 "prefix_homes": len(self._prefix_home),
                 "prefix_cache": self._prefix_stats(),
+                "models": list(self._models),
+                "queued_by_model": dict(self._queued_by),
+                "degraded_by": {str(k): v for k, v in
+                                self._degraded_by.items() if v},
             }
 
     def _prefix_stats(self) -> Dict[str, Any]:
@@ -1096,7 +1161,8 @@ class Router:
         t = self.submit(np.asarray(req["prompt"], np.int32),
                         int(req["max_new"]),
                         session=req.get("session"),
-                        stream=bool(req.get("stream")))
+                        stream=bool(req.get("stream")),
+                        model=req.get("model"))
         return {"rid": t.rid, "shed": t.shed}
 
     def _http_stream(self, body: bytes):
@@ -1128,39 +1194,71 @@ class Router:
 
     # -- policy -------------------------------------------------------------
 
-    def _alive_names(self) -> List[str]:
-        return [n for n, st in self._replicas.items() if st.alive]
+    def _alive_names(self, model: Optional[str] = None) -> List[str]:
+        return [n for n, st in self._replicas.items()
+                if st.alive and (model is None or st.model == model)]
 
-    def _in_flight_locked(self) -> int:
-        return self._queued + sum(len(st.inflight)
-                                  for st in self._replicas.values())
+    @staticmethod
+    def _model_ok(st: "_ReplicaState", t: Ticket) -> bool:
+        """Model routing filter: an untagged ticket places anywhere; a
+        tagged one only on replicas serving its model (each replica's
+        own arena = its own page pool, so per-model pools ride the
+        placement)."""
+        return t.model is None or st.model == t.model
 
-    def _policy_action(self) -> str:
+    def _q_adj(self, t: Ticket, delta: int) -> None:
+        """Queued-count accounting (caller holds ``self._mu``): the
+        fleet scalar plus the per-model split the per-model SLO
+        ladders read."""
+        self._queued = max(0, self._queued + delta)
+        if t.model is not None:
+            cur = self._queued_by.get(t.model, 0)
+            self._queued_by[t.model] = max(0, cur + delta)
+
+    def _in_flight_locked(self, model: Optional[str] = None) -> int:
+        if model is None:
+            return self._queued + sum(len(st.inflight)
+                                      for st in self._replicas.values())
+        return (self._queued_by.get(model, 0)
+                + sum(len(st.inflight)
+                      for st in self._replicas.values()
+                      if st.model == model))
+
+    def _policy_action(self, model: Optional[str] = None) -> str:
         if self.policy is None:
             return "admit"
+        # the model's OWN ladder over the model's OWN queue pressure
+        # and slot pool: one model blowing through its SLO class
+        # degrades/sheds only itself, never its neighbors
+        pol = self.policy.resolve(model)
         with self._mu:
-            in_flight = self._in_flight_locked()
+            in_flight = self._in_flight_locked(model)
             slots = sum(st.load.get("slots", 1)
-                        for st in self._replicas.values() if st.alive)
+                        for st in self._replicas.values()
+                        if st.alive and (model is None
+                                         or st.model == model))
             ewma = self._ewma_ttft
             wait = self._ewma_wait
         if self._dispatch_mode == "pull":
             # the shed signal is the QUEUE: depth rides in_flight, and
             # the deadline ladder reads the measured dispatch-queue
             # wait EWMA — a queue property, not a placement guess
-            action = self.policy.admit(in_flight, slots,
-                                       queue_wait_s=wait)
+            action = pol.admit(in_flight, slots, queue_wait_s=wait)
         else:
-            action = self.policy.admit(in_flight, slots, ewma)
+            action = pol.admit(in_flight, slots, ewma)
         want_degraded = action in ("degrade", "shed")
-        if want_degraded != self._degraded:
+        if want_degraded != self._degraded_by.get(model, False):
             # hysteresis-free toggle is fine: set_degraded is
-            # idempotent and cheap (a bool; the k=1 step fn caches)
-            self._degraded = want_degraded
+            # idempotent and cheap (a bool; the k=1 step fn caches).
+            # model=None (the fleet-wide ladder) toggles every
+            # replica; a tagged ladder toggles only its model's.
+            with self._mu:
+                self._degraded_by[model] = want_degraded
+                self._degraded = any(self._degraded_by.values())
             if telemetry.enabled():
-                _router_metrics()["degraded"].set(int(want_degraded))
+                _router_metrics()["degraded"].set(int(self._degraded))
             for st in list(self._replicas.values()):
-                if st.alive:
+                if st.alive and (model is None or st.model == model):
                     try:
                         st.replica.set_degraded(want_degraded)
                     except Exception:
@@ -1178,13 +1276,16 @@ class Router:
                 name = self._affinity.get(t.session)
                 if name is not None:
                     st = self._replicas.get(name)
-                    if st is not None and st.alive and st.ready:
+                    if (st is not None and st.alive and st.ready
+                            and self._model_ok(st, t)):
                         return st
 
             def pick(require_ready: bool):
                 best, best_load = None, None
                 for st in self._replicas.values():
                     if not st.alive or (require_ready and not st.ready):
+                        continue
+                    if not self._model_ok(st, t):
                         continue
                     load = (len(st.inflight)
                             + st.load.get("queue_depth", 0)
@@ -1220,13 +1321,15 @@ class Router:
             name = self._affinity.get(t.session)
             if name is not None:
                 st = self._replicas.get(name)
-                if st is not None and st.alive and st.ready:
+                if (st is not None and st.alive and st.ready
+                        and self._model_ok(st, t)):
                     return name, True
         if t.prefix is not None:
             name = self._prefix_home.get(t.prefix)
             if name is not None:
                 st = self._replicas.get(name)
-                if st is not None and st.alive and st.ready:
+                if (st is not None and st.alive and st.ready
+                        and self._model_ok(st, t)):
                     return name, False
         return None, False
 
@@ -1265,6 +1368,9 @@ class Router:
             limit = min(len(self._pending), 128)
             for i in range(limit):
                 t = self._pending[i]
+                if not self._model_ok(st, t):
+                    continue  # another model's ticket: not ours to
+                    # claim (its own replicas pull it)
                 hint, strong = self._hint_for(t)
                 if hint is None or hint == st.name:
                     del self._pending[i]
@@ -1319,7 +1425,7 @@ class Router:
                 # closing: a silently dropped ticket would hang its
                 # waiter — fail it typed and keep draining the queue
                 with self._mu:
-                    self._queued = max(0, self._queued - 1)
+                    self._q_adj(t, -1)
                 self._fail_ticket(t, NoReplicasError(
                     f"router closed before request {t.rid} was "
                     "dispatched"))
@@ -1330,9 +1436,10 @@ class Router:
         st = self._pick_replica(t)
         if st is None:
             with self._mu:
-                self._queued = max(0, self._queued - 1)
+                self._q_adj(t, -1)
             self._fail_ticket(t, NoReplicasError(
-                "all replicas down; request cannot be placed"))
+                "all replicas down; request cannot be placed"
+                + (f" (model {t.model!r})" if t.model else "")))
             return
         self._dispatch_to(t, st)
 
@@ -1383,7 +1490,12 @@ class Router:
                 # rotation and FALL BACK to in-replica prefill (chunked
                 # prefill / monolithic — the documented fallback path)
                 with self._mu:
-                    workers = list(self._prefill)
+                    # model filter first: a tagged prompt must prefill
+                    # on ITS model's weights — wrong-model KV pages
+                    # would be silent garbage
+                    workers = [w for w in self._prefill
+                               if t.model is None
+                               or getattr(w, "model", None) == t.model]
                     # round-robin cursor under the lock: two racing
                     # dispatchers must not pick the SAME worker and
                     # serialize on its replica lock while another
@@ -1421,7 +1533,7 @@ class Router:
             # typed replica-side rejection (bad request): the caller's
             # error, not a replica death
             with self._mu:
-                self._queued = max(0, self._queued - 1)
+                self._q_adj(t, -1)
             self._fail_ticket(t, sys.exc_info()[1])
             return
         except Exception:
@@ -1434,7 +1546,7 @@ class Router:
         t.replica, t.replica_rid = st.replica.name, rid
         wait = max(0.0, t.t_dispatched - t.t_submit)
         with self._mu:
-            self._queued = max(0, self._queued - 1)
+            self._q_adj(t, -1)
             a = 0.2  # EWMA over recent dispatches — the policy's
             self._ewma_wait = (wait if self._ewma_wait is None  # input
                                else (1 - a) * self._ewma_wait + a * wait)
@@ -1495,12 +1607,13 @@ class Router:
                 _tracing.event("stream.resume", ctx=t.trace,
                                rid=t.rid, retries=t.retries,
                                resume_at=t._stream_next)
-        if not self._alive_names():
+        if not self._alive_names(t.model):
             with self._mu:
-                self._queued = max(0, self._queued - 1)
+                self._q_adj(t, -1)
             self._fail_ticket(t, NoReplicasError(
-                f"request {t.rid} lost: all replicas down "
-                f"(after {t.retries} retries)"))
+                f"request {t.rid} lost: all replicas down"
+                + (f" for model {t.model!r}" if t.model else "")
+                + f" (after {t.retries} retries)"))
             return
         if self._dispatch_mode == "pull":
             with self._work:
@@ -1624,23 +1737,29 @@ class Router:
             _router_metrics()["healthy"].set(len(self._alive_names()))
         for t in orphans:
             with self._mu:
-                self._queued += 1  # back to pre-dispatch accounting
+                self._q_adj(t, +1)  # back to pre-dispatch accounting
             self._requeue(t)
-        if not self._alive_names():
-            # the LAST replica died: tickets still parked on the
-            # central pull queue would otherwise wait on claims that
-            # can never come (dead replicas never claim) — fail them
-            # typed, exactly like push mode's placement failure; a
-            # later replica recovery serves new admissions, not these
-            with self._work:
-                leftover = list(self._pending)
-                self._pending.clear()
+        # a queued ticket whose claim can never come dies typed, never
+        # parked forever: the whole fleet down fails everything; a
+        # MODEL's last replica down fails that model's tickets (claims
+        # are model-filtered, so no other replica will ever take them)
+        alive_models = {self._replicas[n].model
+                        for n in self._alive_names()}
+        fleet_dead = not alive_models
+        with self._work:
+            leftover = [lt for lt in self._pending
+                        if fleet_dead or (lt.model is not None
+                                          and lt.model
+                                          not in alive_models)]
             for lt in leftover:
-                with self._mu:
-                    self._queued = max(0, self._queued - 1)
-                self._fail_ticket(lt, NoReplicasError(
-                    f"request {lt.rid} lost: all replicas down before "
-                    "any could claim it"))
+                self._pending.remove(lt)
+        for lt in leftover:
+            with self._mu:
+                self._q_adj(lt, -1)
+            self._fail_ticket(lt, NoReplicasError(
+                f"request {lt.rid} lost: all replicas down before "
+                "any could claim it"
+                + (f" (model {lt.model!r})" if lt.model else "")))
 
     def _finish(self, t: Ticket, rec: Dict) -> None:
         """Complete a ticket from its replica-side result record."""
@@ -1761,28 +1880,86 @@ def _resolve_spec(spec: str, spec_kw: Optional[dict]):
     return dec
 
 
-def run_worker(spec: str, role: str = "decode", port: int = 0,
+_aot_fallback_warned = False
+
+
+def _boot_decoder(spec: Optional[str], spec_kw: Optional[dict],
+                  from_artifact: Optional[str]):
+    """Worker decoder bring-up -> ``(decoder, mode, diagnostic)`` with
+    mode in ``aot`` (trace-free from the serialized artifact) |
+    ``traced`` (ordinary spec path) | ``traced_fallback`` (artifact
+    asked for but rejected — fingerprint mismatch / torn / unreadable
+    — so the trace path ran instead, with the warn-once typed
+    PT-AOT-601 diagnostic). The fallback NEVER crashes the worker as
+    long as a ``spec`` exists to trace from."""
+    global _aot_fallback_warned
+    if from_artifact is None:
+        return _resolve_spec(spec, spec_kw), "traced", None
+    from . import aot as _aot
+
+    try:
+        return _aot.load_decoder(from_artifact), "aot", None
+    except _aot.AotError as e:
+        diag = (f"[PT-AOT-601] artifact boot fell back to the trace "
+                f"path: {e}")
+        if spec is None:
+            # nothing to fall back TO: artifact-only boot, typed error
+            raise
+        if not _aot_fallback_warned:
+            _aot_fallback_warned = True
+            print(diag, file=sys.stderr)
+        return _resolve_spec(spec, spec_kw), "traced_fallback", diag
+
+
+def run_worker(spec: Optional[str], role: str = "decode", port: int = 0,
                port_file: Optional[str] = None,
                spec_kw: Optional[dict] = None, warm: bool = True,
+               from_artifact: Optional[str] = None,
+               model: Optional[str] = None,
                _ready_evt: Optional[threading.Event] = None) -> None:
-    """One replica worker: build the decoder from ``spec``, serve the
-    router API + debug endpoints on ``port``, run until SIGTERM/SIGINT.
-    ``role="prefill"``: no serve loop — the worker only answers
-    /prefill (and reports ready after its prefill bucket warms)."""
+    """One replica worker: build the decoder from ``spec`` (or
+    trace-free from ``from_artifact`` — an aot artifact dir or a
+    checkpoint root, with warn-once PT-AOT-601 fallback to ``spec`` on
+    a rejected artifact), serve the router API + debug endpoints on
+    ``port``, run until SIGTERM/SIGINT. ``model=`` tags the replica
+    for model-id routing. ``role="prefill"``: no serve loop — the
+    worker only answers /prefill (and reports ready after its prefill
+    bucket warms)."""
     import signal as _signal
 
-    decoder = _resolve_spec(spec, spec_kw)
-    name = f"{role}-{os.getpid()}"
-    rep = LocalReplica(decoder, name=name)
+    from .utils import compat as _compat
+
+    t_start = time.perf_counter()
+    decoder, boot_mode, boot_diag = _boot_decoder(spec, spec_kw,
+                                                  from_artifact)
+    name = f"{model + '-' if model else ''}{role}-{os.getpid()}"
+    rep = LocalReplica(decoder, name=name, model=model)
     if role == "decode":
         rep.start()
     srv = _dbg_server.DebugServer(
         port=port, owned=True,
         run_config={"role": f"serving-{role}", "spec": spec,
+                    "model": model, "boot": boot_mode,
                     "slots": decoder.slots,
                     "capacity": decoder.capacity,
                     "paged": decoder.paged})
     srv.add_status("serving", decoder._statusz)
+    # /statusz "aot" section: how THIS process booted (trace-free vs
+    # traced), under which artifact/fingerprint, and its TTFR —
+    # time-to-first-ready, stamped once warm flips ready below
+    aot_status: Dict[str, Any] = {
+        "mode": boot_mode, "ttfr_ms": None, "model": model,
+        "fingerprint": _compat.runtime_fingerprint()}
+    if boot_diag is not None:
+        aot_status["diagnostic"] = boot_diag
+    if boot_mode == "aot":
+        info = getattr(decoder, "aot_info", {})
+        aot_status.update(
+            artifact=info.get("artifact"),
+            artifact_id=info.get("artifact_id"),
+            fingerprint=info.get("fingerprint"),
+            programs=info.get("programs"))
+    srv.add_status("aot", lambda: dict(aot_status))
     srv.set_ready(lambda: decoder.ready)
     if role == "decode":
         # arena endpoints only where a serve loop actually ticks — a
@@ -1831,6 +2008,12 @@ def run_worker(spec: str, role: str = "decode", port: int = 0,
             decoder._warmed = True
         else:
             rep.warmup()
+        # TTFR (time-to-first-ready): worker entry -> ready flipped.
+        # The AOT win lives here — an aot boot dispatched serialized
+        # executables where a traced boot re-traced + re-compiled
+        aot_status["ttfr_ms"] = (time.perf_counter() - t_start) * 1e3
+        if telemetry.enabled():
+            _router_metrics()["cold_starts"][boot_mode].inc()
     stop = threading.Event()
     for sig in (_signal.SIGTERM, _signal.SIGINT):
         try:
@@ -1869,29 +2052,43 @@ def _make_inject(rep: LocalReplica):
     return handler
 
 
-def spawn_replicas(spec: str, n: int, role: str = "decode",
+def spawn_replicas(spec: Optional[str], n: int, role: str = "decode",
                    spec_kw: Optional[dict] = None,
                    log_dir: Optional[str] = None,
                    env: Optional[dict] = None,
                    timeout_s: float = 300.0,
-                   warm: bool = True) -> List[HttpReplica]:
+                   warm: bool = True,
+                   model: Optional[str] = None,
+                   from_artifact: Optional[str] = None
+                   ) -> List[HttpReplica]:
     """Fork ``n`` replica worker processes (``--worker`` CLI) and wait
     until each is serving (and warm, unless ``warm=False``). Returns
     connected :class:`HttpReplica` handles owning their process
-    (``close()`` terminates it)."""
+    (``close()`` terminates it). ``model=`` tags the replicas for
+    model-id routing; ``from_artifact=`` boots them trace-free from an
+    aot artifact (``spec`` stays the traced fallback when given)."""
     import tempfile
 
+    enforce(spec is not None or from_artifact is not None,
+            "spawn_replicas needs a spec, an artifact, or both")
     workdir = log_dir or tempfile.mkdtemp(prefix="pt-router-")
     os.makedirs(workdir, exist_ok=True)
+    stem = f"{model + '-' if model else ''}{role}"
     procs = []
     for i in range(n):
-        pf = os.path.join(workdir, f"{role}{i}.port")
+        pf = os.path.join(workdir, f"{stem}{i}.port")
         if os.path.exists(pf):
             os.remove(pf)
-        log = open(os.path.join(workdir, f"{role}{i}.log"), "w")
+        log = open(os.path.join(workdir, f"{stem}{i}.log"), "w")
         cmd = [sys.executable, "-m", "paddle_tpu.serving_router",
-               "--worker", "--spec", spec, "--role", role,
+               "--worker", "--role", role,
                "--port", "0", "--port-file", pf]
+        if spec:
+            cmd += ["--spec", spec]
+        if from_artifact:
+            cmd += ["--from-artifact", from_artifact]
+        if model:
+            cmd += ["--model", model]
         if spec_kw:
             cmd += ["--spec-kw", json.dumps(spec_kw)]
         if not warm:
@@ -1924,7 +2121,7 @@ def spawn_replicas(spec: str, n: int, role: str = "decode",
                     "%s worker %s did not serve within %ss (log: %s)",
                     role, i, timeout_s, log.name)
             rep = HttpReplica(f"http://127.0.0.1:{port}",
-                              name=f"{role}{i}", proc=p)
+                              name=f"{stem}{i}", proc=p, model=model)
             if warm:
                 is_ready = False
                 while time.monotonic() < deadline:
@@ -1954,7 +2151,32 @@ def spawn_replicas(spec: str, n: int, role: str = "decode",
     return out
 
 
-def serve_main(spec: str, replicas: int = 2, prefill_workers: int = 0,
+def _parse_specs(spec: Optional[str]):
+    """``--spec`` grammar -> ``[(model_tag, module:fn)]``:
+    ``module:fn`` is the single untagged model (unchanged);
+    ``name=module:fn,name2=module2:fn2`` is the multi-model fleet —
+    each ``name`` tags its replicas for model-id routing
+    (``Router.submit(model="name")``), and each worker process builds
+    its OWN decoder, so per-model page pools come with the split."""
+    if spec is None:
+        return [(None, None)]
+    if "=" not in spec:
+        return [(None, spec)]
+    out = []
+    for part in spec.split(","):
+        name, sep, s = part.partition("=")
+        enforce(sep and name.strip() and s.strip(),
+                "multi-model --spec must be name=module:fn[,name2=...]"
+                ", got %r", part)
+        out.append((name.strip(), s.strip()))
+    names = [n for n, _ in out]
+    enforce(len(set(names)) == len(names),
+            "duplicate model name in --spec %r", spec)
+    return out
+
+
+def serve_main(spec: Optional[str], replicas: int = 2,
+               prefill_workers: int = 0,
                port: int = 0, spec_kw: Optional[dict] = None,
                log_dir: Optional[str] = None,
                policy: Optional[SLOPolicy] = None,
@@ -1962,18 +2184,31 @@ def serve_main(spec: str, replicas: int = 2, prefill_workers: int = 0,
                trace_sample: Optional[float] = None,
                textfile_path: Optional[str] = None,
                dispatch: str = "pull",
-               prefix_hash_tokens: Optional[int] = 64) -> Router:
+               prefix_hash_tokens: Optional[int] = 64,
+               from_artifact: Optional[str] = None) -> Router:
     """One-command serving bring-up (``python -m paddle_tpu.launch
     --serve``): spawn the replica (and prefill) worker processes, build
     the router over them, and serve the router front-end (POST /submit
     /stream /drain + /statusz + /podz replica fan-out) on ``port``.
-    Returns the running router — the caller owns
-    ``close(replicas=True)``."""
-    reps = spawn_replicas(spec, replicas, spec_kw=spec_kw,
-                          log_dir=log_dir)
-    pfs = (spawn_replicas(spec, prefill_workers, role="prefill",
-                          spec_kw=spec_kw, log_dir=log_dir)
-           if prefill_workers else [])
+    ``spec`` may be multi-model (see :func:`_parse_specs`): replicas
+    spawn per model, tagged for model-id routing. ``from_artifact``
+    boots the replicas trace-free from an aot artifact (single-model
+    fleets; ``spec`` stays the traced fallback). Returns the running
+    router — the caller owns ``close(replicas=True)``."""
+    pairs = _parse_specs(spec)
+    enforce(from_artifact is None or len(pairs) == 1,
+            "--from-artifact boots a single-model fleet (one artifact "
+            "holds one model's programs); got %s model specs",
+            len(pairs))
+    reps, pfs = [], []
+    for m, sp in pairs:
+        reps += spawn_replicas(sp, replicas, spec_kw=spec_kw,
+                               log_dir=log_dir, model=m,
+                               from_artifact=from_artifact)
+        if prefill_workers:
+            pfs += spawn_replicas(sp, prefill_workers, role="prefill",
+                                  spec_kw=spec_kw, log_dir=log_dir,
+                                  model=m)
     router = Router(reps, prefill_workers=pfs, policy=policy,
                     disagg_min_tokens=disagg_min_tokens,
                     trace_sample=trace_sample,
@@ -1993,9 +2228,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--worker", action="store_true",
                     help="run ONE replica worker (spawned by "
                     "spawn_replicas / launch --serve)")
-    ap.add_argument("--spec", required=True,
+    ap.add_argument("--spec", default=None,
                     help="module:function returning the replica's "
-                    "BatchedDecoder")
+                    "BatchedDecoder; router mode also accepts the "
+                    "multi-model form name=module:fn,name2=module2:fn2"
+                    " (optional when --from-artifact boots trace-free)")
+    ap.add_argument("--from-artifact", dest="from_artifact",
+                    default=None,
+                    help="aot artifact directory (or checkpoint root "
+                    "holding aot_step_N) — boot the replica(s) "
+                    "trace-free from serialized programs; --spec "
+                    "becomes the traced fallback on fingerprint "
+                    "mismatch")
+    ap.add_argument("--model", default=None,
+                    help="(worker mode) model tag for model-id "
+                    "routing; set by the router spawner for "
+                    "multi-model fleets")
     ap.add_argument("--spec-kw", default=None,
                     help="JSON kwargs for the spec function")
     ap.add_argument("--role", default="decode",
@@ -2030,11 +2278,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "the first N prompt tokens so shared system "
                     "prompts land on one warm replica (0 disables)")
     args = ap.parse_args(argv)
+    enforce(args.spec or args.from_artifact,
+            "need --spec module:fn and/or --from-artifact DIR")
     kw = json.loads(args.spec_kw) if args.spec_kw else None
     if args.worker:
         run_worker(args.spec, role=args.role, port=args.port,
                    port_file=args.port_file, spec_kw=kw,
-                   warm=args.warm)
+                   warm=args.warm, from_artifact=args.from_artifact,
+                   model=args.model)
         return 0
     router = serve_main(args.spec, replicas=args.replicas,
                         prefill_workers=args.prefill_workers,
@@ -2043,7 +2294,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         textfile_path=args.textfile,
                         dispatch=args.dispatch,
                         prefix_hash_tokens=(args.prefix_hash_tokens
-                                            or None))
+                                            or None),
+                        from_artifact=args.from_artifact)
     print(f"[router] serving on {router.server.url()} over "
           f"{args.replicas} replica(s)", file=sys.stderr)
     try:

@@ -135,6 +135,23 @@ def jax_export():
     return export
 
 
+def runtime_fingerprint():
+    """(jax, jaxlib, platform) identity of THIS process — the compat
+    gate for serialized-executable artifacts (``paddle_tpu.aot``,
+    ``jit.save``). A serialized StableHLO program is only trusted to
+    rehydrate under the toolchain that produced it; anything that
+    compares these dicts funnels through here so the fields evolve in
+    ONE place (a new field tightens every artifact at once)."""
+    try:
+        import jaxlib
+
+        jaxlib_ver = getattr(jaxlib, "__version__", "unknown")
+    except ImportError:  # jaxlib always ships with jax, but stay typed
+        jaxlib_ver = "unknown"
+    return {"jax": jax.__version__, "jaxlib": jaxlib_ver,
+            "platform": jax.default_backend()}
+
+
 def native_int8_allreduce():
     """Feature probe for a RUNTIME-NATIVE int8 AllReduce (the EQuARX
     in-XLA collective, PAPERS.md). No released jax/XLA exposes one

@@ -61,6 +61,9 @@ if [ "$MODE" = "full" ]; then
   # streaming data plane: per-token streaming arm (stream TTFT/ITL)
   # + prefix-hash vs session-only routing hit-rate A/B
   run python bench.py --model gpt_serve --router --stream --replicas 1
+  # aot compiled-program plane: TTFR A/B (traced boot vs trace-free
+  # artifact boot; gates ttfr_aot_ms < ttfr_traced_ms, _aot key)
+  run python bench.py --model gpt_serve --router --from-artifact --replicas 1
 
   echo "== pallas autotune ==" | tee -a "$LOG"
   run python tools/pallas_tune.py

@@ -89,6 +89,11 @@ fast ep-plan/exchange/host-cache tests ride -m mid above)"
 arrive incrementally across processes over per-token-flushed SSE)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_serving_stream.py \
       -q -k "stream_smoke" || exit $?
+    stage "aot smoke (export compiled programs -> drop the model -> \
+trace-free restore_and_run boot serves bit-identical tokens on CPU; \
+fingerprint-mismatch fallback + GC staleness ride -m mid above)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_aot.py \
+      -q -k "round_trip or trace_free" || exit $?
     stage "trace smoke (routed request through 2 worker processes -> \
 ONE merged cross-process chrome-trace with a shared trace id)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py \
