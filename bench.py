@@ -1030,11 +1030,283 @@ def _arm_stats(tickets, wall_s: float, short_lt=None):
     return out
 
 
+def _piecewise_open_loop(router, prompts, max_new: int, phases, rng,
+                         timeout_s: float = 900.0):
+    """:func:`_open_loop` over a piecewise-rate schedule — the
+    diurnal/spiky traffic trace the autoscale A/B drives. ``phases``
+    is ``[(rate_rps, n_requests), ...]``; arrivals inside each phase
+    are seeded-Poisson at that phase's rate, so the whole arrival
+    vector is a deterministic function of (rng seed, phases)."""
+    gaps = np.concatenate([rng.exponential(1.0 / rate, size=n)
+                           for rate, n in phases])
+    enforce_n = sum(n for _, n in phases)
+    assert enforce_n == len(prompts), (enforce_n, len(prompts))
+    arrivals = np.cumsum(gaps)
+    t0 = time.perf_counter()
+    tickets = []
+    for i, p in enumerate(prompts):
+        while time.perf_counter() - t0 < arrivals[i]:
+            time.sleep(0.0005)
+        tickets.append(router.submit(p, max_new, session=f"s{i}"))
+    router.wait(tickets, timeout=timeout_s)
+    return tickets, time.perf_counter() - t0
+
+
+def _autoscale_spike_ab(spec_kw, autoscale, smoke):
+    """The ``--autoscale MIN,MAX`` A/B: the SAME seeded spiky trace
+    (base rate, a 3x spike, base again) against two fleets —
+
+    1. ``static``: MAX replicas up for the whole run (the
+       over-provisioned baseline an autoscaler must justify itself
+       against);
+    2. ``autoscaled``: MIN replicas + a live :class:`~paddle_tpu.
+       autoscale.Scaler` growing the fleet on the spike and draining
+       it back on sustained headroom.
+
+    The replicas beyond MIN are pre-built and pre-warmed before the
+    timed run — the in-process stand-in for the AOT artifact shelf
+    (scale-up without trace+compile; production spawns hit the same
+    shape via ``spawn_replicas(..., from_artifact=...)``), so the
+    measured TTFR is the artifact-boot analog, not a compile.
+
+    Gates (ISSUE 18 acceptance):
+
+    - strictly fewer replica-seconds than static max over the serving
+      window;
+    - short-prompt p99 TTFT and p99 ITL within the static arm's
+      bounds (a CPU-noise slack factor — a 32-sample p99 is nearly a
+      max across two separately-timed arms) and shed no worse;
+    - the fleet actually grew (the spike forced at least one scale-up)
+      and came back to MIN (sustained headroom drained it);
+    - no flap: scale events <= the policy's cooldown-implied ceiling;
+    - replaying the recorded signal trace through a fresh policy
+      reproduces the live decision list bit-identically."""
+    from paddle_tpu.autoscale import AutoscalePolicy, Scaler, replay
+    from paddle_tpu.core.enforce import enforce
+    from paddle_tpu.serving_router import LocalReplica, Router
+
+    amin, amax = int(autoscale[0]), int(autoscale[1])
+    enforce(1 <= amin < amax,
+            "--autoscale needs 1 <= MIN < MAX, got %s,%s", amin, amax)
+    long_len, max_new = (112, 8) if smoke else (192, 16)
+    short_lt = long_len // 2
+    vocab = 1024 if smoke else 50257
+
+    def mk_prompts(n, seed):
+        # the router bench's mix: every 3rd prompt LONG, so the spike
+        # carries prefill weight too, not just decode ticks
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            ln = long_len if i % 3 == 2 else int(8 + (i * 5) % 16)
+            out.append(r.integers(1, vocab, (ln,)).astype(np.int32))
+        return out
+
+    def drive(rep, rids, timeout_s=600.0):
+        seen = {}
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            seen.update(rep.drain_results())
+            if all(r in seen for r in rids):
+                return seen
+            time.sleep(0.01)
+        raise TimeoutError(f"replica {rep.name}: warm requests "
+                           f"incomplete after {timeout_s}s")
+
+    # the whole MAX fleet, pre-warmed (short + long jit paths) BEFORE
+    # any timed arm: the static arm uses all of it; the autoscaled arm
+    # starts with [:amin] and pops the rest off the "artifact shelf"
+    reps = [LocalReplica(_router_replica_spec(**spec_kw),
+                         name=f"as{i}").start() for i in range(amax)]
+    warm = mk_prompts(2, 99)
+    for rep in reps:
+        drive(rep, [rep.submit(p, 2)
+                    for p in (warm[0], warm[1],
+                              np.ones(long_len, np.int32))])
+    scaler = None
+    try:
+        # rate calibration on ONE replica (the autoscaled arm's floor
+        # capacity): base load a single replica absorbs with headroom,
+        # spike 3x that — beyond one replica, inside MAX
+        cal = mk_prompts(8, 1)
+        t0 = time.perf_counter()
+        drive(reps[0], [reps[0].submit(p, max_new) for p in cal])
+        cal_rps = len(cal) / (time.perf_counter() - t0)
+        # base at 30% of one replica's closed-loop rate puts the 3x
+        # spike at 0.9x aggregate capacity. That ratio is the whole
+        # experiment: in-process replicas SHARE the host's compute
+        # (one XLA executable already saturates it), so growing the
+        # fleet buys decode SLOTS (concurrency -> queue wait), not
+        # throughput — a spike above aggregate capacity builds a
+        # backlog no fleet size can drain and the A/B would measure
+        # queueing collapse, while at 0.9x the MIN fleet is slot-
+        # starved (arrivals queue behind 2 busy slots) and the spawns
+        # visibly collapse the wait. Production TPU replicas add both
+        # axes; the slot axis is the one this host can exhibit.
+        base = 0.30 * cal_rps
+        spike = 3.0 * base
+        n_base = 8 if smoke else 12
+        n_spike = 16 if smoke else 24
+        phases = [(base, n_base), (spike, n_spike), (base, n_base)]
+        n_req = 2 * n_base + n_spike
+
+        # arm A: static max
+        router = Router(reps, poll_interval_s=0.02)
+        st_tickets, st_wall = _piecewise_open_loop(
+            router, mk_prompts(n_req, 11), max_new, phases,
+            np.random.default_rng(200))
+        router.close()
+        static = _arm_stats(st_tickets, st_wall, short_lt=short_lt)
+        static_rs = amax * st_wall
+
+        # arm B: autoscaled, same arrival schedule (same seed+phases)
+        shelf = list(reps[amin:])
+        fresh = iter(range(amax, 1_000_000))
+
+        def spawn():
+            if shelf:
+                return shelf.pop(0)
+            # shelf exhausted (retire_fn repools drained replicas, so
+            # only MAX-1 spawns can ever be in flight at once — this
+            # is a belt-and-braces path): a real cold boot
+            rep = LocalReplica(_router_replica_spec(**spec_kw),
+                               name=f"as{next(fresh)}").start()
+            reps.append(rep)
+            rep.warmup()
+            return rep
+
+        router = Router(reps[:amin], poll_interval_s=0.02)
+        # proactive up (a 100ms dispatch wait or 1.5x slots of
+        # in-flight votes up — real queueing, not the momentary
+        # all-slots-busy of two base arrivals overlapping), patient
+        # down (Poisson base traffic has multi-second quiet gaps; the
+        # headroom hold + down cooldown must outlast them or the
+        # scaler drains mid-base and pays a spawn on the next burst);
+        # the cooldowns (plus the measured TTFR) bound the event rate
+        policy = AutoscalePolicy(
+            min_replicas=amin, max_replicas=amax,
+            up_queue_wait_s=0.1, up_load=1.5,
+            down_queue_wait_s=0.05, down_load=0.5,
+            headroom_hold_s=2.5, cooldown_up_s=0.25,
+            cooldown_down_s=4.0, ttfr_hint_s=0.25)
+        # retired replicas go BACK on the shelf still warm: scale-down
+        # destroys the instance, not the artifact it boots from
+        scaler = Scaler(router, policy, spawn, interval_s=0.05,
+                        retire_fn=shelf.append)
+        t_run0 = time.monotonic()
+        scaler.start()
+        as_tickets, as_wall = _piecewise_open_loop(
+            router, mk_prompts(n_req, 11), max_new, phases,
+            np.random.default_rng(200))
+        serve_end = time.monotonic()
+        auto = _arm_stats(as_tickets, as_wall, short_lt=short_lt)
+        auto_rs = scaler.replica_seconds(until=serve_end)
+        # post-trace idle tail: give sustained headroom room to drain
+        # the spike's replicas back to MIN (bounded — the no-flap
+        # cooldowns make each down step take hold+cooldown)
+        deadline = time.monotonic() + 30.0
+        while (time.monotonic() < deadline
+               and scaler._live_count() > amin):
+            time.sleep(0.05)
+        scaler.stop()
+        router.close()
+        total_wall = time.monotonic() - t_run0
+
+        ups = [e for e in scaler.scale_events()
+               if e["event"] == "scale_up"]
+        downs = [e for e in scaler.scale_events()
+                 if e["event"] == "scale_down"]
+        peak = max(n for _, n in scaler.timeline)
+        final = scaler.timeline[-1][1]
+
+        # -- the gates -------------------------------------------------
+        enforce(len(ups) >= 1 and peak > amin,
+                "the 3x spike never forced a scale-up (peak fleet "
+                "%s from %s)", peak, amin)
+        enforce(len(downs) >= 1 and final == amin,
+                "sustained headroom never drained the fleet back to "
+                "MIN (final %s, want %s)", final, amin)
+        enforce(auto_rs < static_rs,
+                "autoscaling must cost strictly fewer replica-seconds "
+                "than static max (%.1f vs %.1f)", auto_rs, static_rs)
+        # SLO within the static arm's bounds. Two-level, the router
+        # bench gate's precedent: the MEAN short TTFT carries the
+        # tight bound (a ~20-sample p99 is the max — it always
+        # captures the one short that arrived in the spike's onset
+        # window before the spawns landed, pure scale-up physics, not
+        # a provisioning regression), while the p99 rides with a
+        # collapse bound that a fleet stuck at MIN through the spike
+        # blows by an order of magnitude
+        enforce(auto["ttft_short_mean_ms"]
+                <= 1.5 * static["ttft_short_mean_ms"] + 150.0,
+                "autoscaled mean short-prompt TTFT %.1f ms blew the "
+                "static-max bound %.1f ms",
+                auto["ttft_short_mean_ms"],
+                static["ttft_short_mean_ms"])
+        enforce(auto["ttft_short_p99_ms"]
+                <= 2.5 * static["ttft_short_p99_ms"] + 250.0,
+                "autoscaled short-prompt p99 TTFT %.1f ms collapsed "
+                "vs the static-max bound %.1f ms",
+                auto["ttft_short_p99_ms"],
+                static["ttft_short_p99_ms"])
+        enforce(auto["itl_p99_ms"]
+                <= 1.5 * static["itl_p99_ms"] + 100.0,
+                "autoscaled p99 ITL %.1f ms blew the static-max "
+                "bound %.1f ms", auto["itl_p99_ms"],
+                static["itl_p99_ms"])
+        enforce(auto["shed_rate"] <= static["shed_rate"] + 0.02,
+                "autoscaled shed rate %.3f worse than static %.3f",
+                auto["shed_rate"], static["shed_rate"])
+        ceiling = policy.max_events(total_wall, scaler.ttfr_s)
+        enforce(len(scaler.scale_events()) <= ceiling,
+                "flap: %s scale events exceed the cooldown-implied "
+                "ceiling %s over %.1fs",
+                len(scaler.scale_events()), ceiling, total_wall)
+        twin = replay(AutoscalePolicy(**policy.knobs()),
+                      scaler.trace.rows)
+        enforce(json.dumps(twin, sort_keys=True)
+                == json.dumps(scaler.decisions, sort_keys=True),
+                "replaying the recorded signal trace diverged from "
+                "the live decisions")
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        for rep in reps:
+            rep.close()
+
+    tl0 = scaler.timeline[0][0]
+    extras = dict(auto)
+    extras.update({
+        "autoscale_min": amin, "autoscale_max": amax,
+        "autoscale_peak": int(peak),
+        "rate_rps": round(base, 3),
+        "spike_rate_rps": round(spike, 3),
+        "replica_seconds": round(auto_rs, 2),
+        "replica_timeline": [[round(t - tl0, 2), n]
+                             for t, n in scaler.timeline],
+        "autoscale_scale_ups": len(ups),
+        "autoscale_scale_downs": len(downs),
+        "autoscale_events_ceiling": int(ceiling),
+        "autoscale_ttfr_s": (round(scaler.ttfr_s, 3)
+                             if scaler.ttfr_s is not None else None),
+        "static_replica_seconds": round(static_rs, 2),
+        "static_ttft_p50_ms": static["ttft_p50_ms"],
+        "static_ttft_p99_ms": static["ttft_p99_ms"],
+        "static_ttft_short_p99_ms": static.get("ttft_short_p99_ms"),
+        "static_ttft_short_mean_ms": static.get("ttft_short_mean_ms"),
+        "static_itl_p99_ms": static["itl_p99_ms"],
+        "static_shed_rate": static["shed_rate"],
+        "static_tokps": static["tokps"],
+    })
+    return extras.pop("tokps"), "tokens/sec", extras
+
+
 def bench_gpt_router(steps: int, batch_size: int, amp=None,
                      smoke: bool = False, replicas: int = 2,
                      prefill_workers: int = 1, overload: float = 2.0,
                      kv_dtype=None, router_procs: bool = False,
-                     stream: bool = False, from_artifact: bool = False):
+                     stream: bool = False, from_artifact: bool = False,
+                     autoscale=None):
     """Production-serving A/B (serving_router.Router): a seeded Poisson
     OPEN-loop load with long prompts mixed in, three arms on the same
     replicas —
@@ -1058,6 +1330,13 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
     (same router code path, deterministic for the gate test)."""
     from paddle_tpu.serving_router import (LocalReplica, Router,
                                            SLOPolicy, spawn_replicas)
+
+    if autoscale is not None:
+        # the autoscaling spike A/B is its own workload (piecewise
+        # rate, elastic fleet): it replaces the disagg arms entirely
+        return _autoscale_spike_ab({"smoke": smoke,
+                                    "kv_dtype": kv_dtype},
+                                   autoscale, smoke)
 
     n_req = 18 if smoke else max(18, min(steps, 48))
     long_len, max_new = (112, 8) if smoke else (192, 16)
@@ -1201,6 +1480,15 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
         "overload_ttft_p99_ms": over["ttft_p99_ms"],
         "overload_shed_rate": over["shed_rate"],
         "overload_tokps": over["tokps"],
+        # provisioning-cost accounting on EVERY router row (the
+        # autoscale A/B's comparison substrate): a static fleet's
+        # replica-seconds are just count x wall, and its timeline one
+        # flat change-point — same columns, same meaning, as the
+        # elastic rows
+        "replica_seconds": round(replicas * arm_wall["head"], 2),
+        "replica_timeline": [[0.0, replicas]],
+        "mono_replica_seconds": round(arm_wall["mono"], 2),
+        "mono_replica_timeline": [[0.0, 1]],
     })
     extras.update(aot_cols)
     if stream_arm is not None:
@@ -2292,6 +2580,9 @@ def run_config_fingerprint(metric: str, args, steps: int):
         "router_from_artifact": (
             True if getattr(args, "router", False)
             and getattr(args, "from_artifact", False) else None),
+        "router_autoscale": (
+            getattr(args, "autoscale", None)
+            if getattr(args, "router", False) else None),
         "layout": args.layout, "dp": args.dp, "infer": args.infer,
     }
     # None = knob not set; False values (e.g. --no-fused-ce) are REAL
@@ -2515,6 +2806,13 @@ def main():
                     "inter-token-latency columns) and the "
                     "prefix-hash vs session-only routing hit-rate "
                     "A/B to the same JSON line")
+    ap.add_argument("--autoscale", default=None, metavar="MIN,MAX",
+                    help="--router: replace the disagg arms with the "
+                    "autoscaling spike A/B — static MAX fleet vs a "
+                    "Scaler-driven fleet growing from MIN on a "
+                    "seeded 3x spike and draining back on sustained "
+                    "headroom, gated on SLO at strictly fewer "
+                    "replica-seconds")
     ap.add_argument("--from-artifact", dest="from_artifact",
                     action="store_true",
                     help="--router: add the AOT cold-start A/B — "
@@ -2604,6 +2902,31 @@ def main():
                     "--from-artifact only applies with --router "
                     "(the aot cold-start A/B)")
         return
+    autoscale = None
+    if args.autoscale:
+        if not args.router:
+            _emit_error(f"{args.model}_throughput",
+                        "--autoscale only applies with --router "
+                        "(the elastic-fleet spike A/B)")
+            return
+        if args.stream or args.from_artifact or args.router_procs:
+            _emit_error(f"{args.model}_throughput",
+                        "--autoscale is its own workload: drop "
+                        "--stream/--from-artifact/--router-procs")
+            return
+        try:
+            amin, amax = (int(x) for x in args.autoscale.split(","))
+        except ValueError:
+            _emit_error(f"{args.model}_throughput",
+                        f"--autoscale wants MIN,MAX integers, got "
+                        f"{args.autoscale!r}")
+            return
+        if not 1 <= amin < amax:
+            _emit_error(f"{args.model}_throughput",
+                        f"--autoscale needs 1 <= MIN < MAX, got "
+                        f"{amin},{amax}")
+            return
+        autoscale = (amin, amax)
     if args.router:
         if args.model != "gpt_serve":
             _emit_error(f"{args.model}_throughput",
@@ -2626,6 +2949,10 @@ def main():
         if args.from_artifact:
             # the AOT A/B adds the TTFR columns + its gate: own key
             metric += "_aot"
+        if autoscale:
+            # the elastic-fleet spike A/B is its own workload
+            # (piecewise rate, fleet size varies): own key per band
+            metric += f"_as{autoscale[0]}x{autoscale[1]}"
     if (args.vocab and "vocab" in sig
             and args.vocab != sig["vocab"].default):
         metric += f"_v{args.vocab}"
@@ -2877,6 +3204,7 @@ def main():
         kwargs["router_procs"] = args.router_procs
         kwargs["stream"] = args.stream
         kwargs["from_artifact"] = args.from_artifact
+        kwargs["autoscale"] = autoscale
     if args.prefill_chunk and "prefill_chunk" in sig:
         kwargs["prefill_chunk"] = args.prefill_chunk
     if (args.decode_steps and args.decode_steps > 1
@@ -3053,6 +3381,13 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                                   # cold-start A/B columns)
                                   "ttft_", "itl_", "mono_",
                                   "stream_", "prefix_", "ttfr_",
+                                  # autoscale plane: replica-seconds
+                                  # accounting + fleet timelines on
+                                  # every router row; the spike A/B's
+                                  # static-arm comparison columns and
+                                  # scale-event/TTFR evidence
+                                  "replica_", "autoscale_",
+                                  "static_", "spike_",
                                   # sharded-embedding plane: wire
                                   # payload vs dense counterfactual,
                                   # host-cache hit rate, table rows

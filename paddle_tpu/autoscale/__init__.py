@@ -1,0 +1,31 @@
+"""Autoscaling control plane — the router grows and shrinks its own
+fleet.
+
+Two layers, split so the decision core stays a pure function of
+recorded inputs:
+
+- :mod:`~paddle_tpu.autoscale.policy` — :class:`AutoscalePolicy`, a
+  deterministic hysteresis-ladder + cooldown-window policy over the
+  router's MEASURED signals (queue depth, dispatch-wait EWMA, load
+  factor, shed deltas). No clock, no I/O: time rides in the signal
+  row, so :func:`replay` over a recorded trace is bit-identical
+  run-to-run.
+- :mod:`~paddle_tpu.autoscale.scaler` — :class:`Scaler`, the control
+  loop that snapshots ``Router.signals()``, records the rows as a
+  replayable :class:`SignalTrace`, and ACTS: spawning a replica
+  (pre-warmed from the AOT artifact when the spawn fn says so;
+  placement stays ``/readyz``-gated exactly as at bring-up) and
+  drain+retiring one on sustained headroom (fail-closed — the router
+  purges the victim's placement hints the moment the drain starts).
+
+The scale-up latency model is the MEASURED time-to-first-ready of the
+last spawn (the worker's own boot stamp when reachable), fed back
+into the policy's effective up-cooldown via the signal rows — never a
+compile-time guess.
+"""
+
+from .policy import AutoscalePolicy, Decision, Signals, replay
+from .scaler import Scaler, SignalTrace
+
+__all__ = ["AutoscalePolicy", "Decision", "Signals", "replay",
+           "Scaler", "SignalTrace"]

@@ -1,0 +1,232 @@
+"""Deterministic autoscaling policy: hysteresis ladders + cooldown
+windows over the router's measured load signals.
+
+Design rules (the never-flap contract):
+
+- **Pure in time.** ``decide()`` reads the wall clock from the signal
+  row (``sig["t"]``), never from ``time``; the only mutable state is
+  the cooldown stamps and the headroom window start, all derived from
+  prior rows. Replaying a recorded trace through a fresh policy
+  (:func:`replay`) therefore reproduces every decision bit-identically.
+- **Hysteresis.** The scale-up thresholds (``up_queue_wait_s``,
+  ``up_load``) sit well ABOVE the scale-down ones
+  (``down_queue_wait_s``, ``down_load``): the load band between them
+  is dead — no oscillation driven by a signal hovering at one edge.
+- **Cooldowns + the measured scale-up latency model.** After a scale
+  up, the policy holds for ``cooldown_up_s`` PLUS the measured TTFR
+  of the last artifact boot (``sig["ttfr_s"]``, recorded by the
+  scaler; ``ttfr_hint_s`` until one is measured) — re-firing before
+  the previous spawn could possibly have landed and relieved the
+  signal is the classic thrash. Scale down needs ``headroom_hold_s``
+  of SUSTAINED headroom first, then its own ``cooldown_down_s`` (also
+  enforced against the last scale-up — never tear down what a spike
+  just built).
+- **Repair beats cooldown.** A fleet below ``min_replicas`` (replica
+  deaths) scales up immediately — cooldowns model load response, not
+  fault repair — but still one spawn at a time (a warming replica
+  gates the next decision).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List
+
+from ..core.enforce import enforce
+
+# one recorded Router.signals() row (+ the scaler's derived fields:
+# shed_delta, ttfr_s, warming adjusted for an in-progress spawn)
+Signals = Dict[str, Any]
+# one policy verdict: {"t", "action": hold|up|down, "reason", "n",
+# "target"} — JSON-stable, the replay bit-identity unit
+Decision = Dict[str, Any]
+
+
+class AutoscalePolicy:
+    """Hysteresis + cooldown scaling policy over one signal row.
+
+    ``decide()`` is evaluated once per scaler tick and returns the
+    action for THIS tick; the caller (the scaler, or :func:`replay`
+    over a recorded trace) owns acting on it. All thresholds compare
+    against the router's measured series: ``ewma_wait_s`` is the
+    dispatch-queue wait EWMA (the same series the SLO shed ladder
+    reads), load factor is in-flight over READY slots, and any shed
+    since the last tick is an immediate scale-up vote (shedding while
+    below max capacity means provisioning, not admission, is wrong).
+    """
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 up_queue_wait_s: float = 0.25, up_load: float = 2.0,
+                 down_queue_wait_s: float = 0.05,
+                 down_load: float = 0.5,
+                 headroom_hold_s: float = 30.0,
+                 cooldown_up_s: float = 10.0,
+                 cooldown_down_s: float = 30.0,
+                 ttfr_hint_s: float = 5.0):
+        enforce(1 <= int(min_replicas) <= int(max_replicas),
+                "need 1 <= min_replicas <= max_replicas, got %s..%s",
+                min_replicas, max_replicas)
+        enforce(down_load < up_load,
+                "hysteresis needs down_load %s < up_load %s",
+                down_load, up_load)
+        enforce(down_queue_wait_s < up_queue_wait_s,
+                "hysteresis needs down_queue_wait_s %s < "
+                "up_queue_wait_s %s", down_queue_wait_s,
+                up_queue_wait_s)
+        enforce(headroom_hold_s >= 0 and cooldown_up_s >= 0
+                and cooldown_down_s >= 0 and ttfr_hint_s >= 0,
+                "windows must be >= 0")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_queue_wait_s = float(up_queue_wait_s)
+        self.up_load = float(up_load)
+        self.down_queue_wait_s = float(down_queue_wait_s)
+        self.down_load = float(down_load)
+        self.headroom_hold_s = float(headroom_hold_s)
+        self.cooldown_up_s = float(cooldown_up_s)
+        self.cooldown_down_s = float(cooldown_down_s)
+        self.ttfr_hint_s = float(ttfr_hint_s)
+        self.reset()
+
+    def reset(self) -> None:
+        """Forget the cooldown stamps and headroom window — the state
+        a fresh replay pass starts from."""
+        self._last_up_t: Any = None
+        self._last_down_t: Any = None
+        self._headroom_since: Any = None
+
+    def knobs(self) -> Dict[str, Any]:
+        """The configured thresholds/windows (the /statusz payload)."""
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "up_queue_wait_s": self.up_queue_wait_s,
+            "up_load": self.up_load,
+            "down_queue_wait_s": self.down_queue_wait_s,
+            "down_load": self.down_load,
+            "headroom_hold_s": self.headroom_hold_s,
+            "cooldown_up_s": self.cooldown_up_s,
+            "cooldown_down_s": self.cooldown_down_s,
+            "ttfr_hint_s": self.ttfr_hint_s,
+        }
+
+    def max_events(self, duration_s: float,
+                   ttfr_s: Any = None) -> int:
+        """The cooldown-implied CEILING on scale events over a window
+        — the no-flap bound the bench gate asserts. One up at most per
+        effective up-cooldown (cooldown + TTFR), one down at most per
+        max(down cooldown, headroom hold), plus one boundary event
+        each."""
+        ttfr = self.ttfr_hint_s if ttfr_s is None else float(ttfr_s)
+        up_period = max(1e-9, self.cooldown_up_s + ttfr)
+        down_period = max(1e-9, max(self.cooldown_down_s,
+                                    self.headroom_hold_s))
+        return (int(duration_s / up_period) + 1
+                + int(duration_s / down_period) + 1)
+
+    def decide(self, sig: Signals) -> Decision:
+        """Evaluate one signal row -> this tick's decision."""
+        t = float(sig["t"])
+        n = int(sig.get("replicas") or 0)
+        warming = int(sig.get("warming") or 0)
+        draining = int(sig.get("draining") or 0)
+        slots = int(sig.get("slots") or 0)
+        in_flight = int(sig.get("in_flight") or 0)
+        queue_depth = int(sig.get("queue_depth") or 0)
+        wait = sig.get("ewma_wait_s")
+        shed = int(sig.get("shed_delta") or 0)
+        ttfr = sig.get("ttfr_s")
+        ttfr = self.ttfr_hint_s if ttfr is None else float(ttfr)
+
+        def out(action: str, reason: str, target: int) -> Decision:
+            if action == "up":
+                self._last_up_t = t
+                self._headroom_since = None
+            elif action == "down":
+                self._last_down_t = t
+                self._headroom_since = None
+            return {"t": t, "action": action, "reason": reason,
+                    "n": n, "target": target}
+
+        # fleet repair first: below the floor spawns NOW (deaths are
+        # not load), one at a time; above the ceiling drains now
+        if n < self.min_replicas:
+            if warming == 0:
+                return out("up", "below_min", n + 1)
+            return out("hold", "below_min_warming", n)
+        if n > self.max_replicas:
+            if draining == 0:
+                return out("down", "above_max", n - 1)
+            return out("hold", "above_max_draining", n)
+
+        # in-flight over READY capacity; an all-warming fleet (slots
+        # == 0) with queued work reads as hot, but warming>0 already
+        # holds any further spawn
+        load = (in_flight / slots) if slots > 0 else float(in_flight)
+        # the wait EWMA updates only ON dispatches, so it freezes at
+        # its last value when traffic stops: it's a PRESENT-tense
+        # signal only while work is actually in the system. Without
+        # the busy gate a spike's stale-high EWMA reads as hot
+        # forever and pins an idle fleet at max.
+        busy = queue_depth > 0 or in_flight > 0
+        hot = (shed > 0
+               or (busy and wait is not None
+                   and wait >= self.up_queue_wait_s)
+               or load >= self.up_load)
+        # true idleness (nothing in flight, nothing queued) is
+        # unambiguous headroom regardless of the wait EWMA — the
+        # router only updates ewma_wait_s ON dispatches, so after a
+        # burst it stays stale-high forever at idle and the wait
+        # condition alone would never let scale-down fire
+        cold = (shed == 0 and queue_depth == 0
+                and (in_flight == 0
+                     or (load <= self.down_load
+                         and (wait is None
+                              or wait <= self.down_queue_wait_s))))
+
+        # sustained-headroom window: any non-cold tick (or an active
+        # spawn/drain, or sitting at the floor) restarts the clock
+        if (cold and n > self.min_replicas and warming == 0
+                and draining == 0):
+            if self._headroom_since is None:
+                self._headroom_since = t
+        else:
+            self._headroom_since = None
+
+        if hot:
+            if n >= self.max_replicas:
+                return out("hold", "hot_at_max", n)
+            if warming > 0:
+                return out("hold", "hot_warming", n)
+            if (self._last_up_t is not None
+                    and t - self._last_up_t
+                    < self.cooldown_up_s + ttfr):
+                # the scale-up latency model: don't re-fire before the
+                # last spawn (measured TTFR) plus the cooldown could
+                # have relieved the signal
+                return out("hold", "hot_cooldown", n)
+            return out("up", "hot", n + 1)
+
+        if (self._headroom_since is not None
+                and t - self._headroom_since >= self.headroom_hold_s):
+            if (self._last_down_t is not None
+                    and t - self._last_down_t < self.cooldown_down_s):
+                return out("hold", "cold_cooldown", n)
+            if (self._last_up_t is not None
+                    and t - self._last_up_t < self.cooldown_down_s):
+                # never tear down what a spike just built
+                return out("hold", "cold_post_up", n)
+            return out("down", "sustained_headroom", n - 1)
+
+        return out("hold", "steady", n)
+
+
+def replay(policy: AutoscalePolicy,
+           rows: Iterable[Signals]) -> List[Decision]:
+    """Re-evaluate a recorded signal trace from a clean slate — the
+    deterministic offline twin of the live loop. The trace rows carry
+    every input ``decide()`` reads (including the measured ``ttfr_s``
+    the scaler stamped), so for the same rows and knobs the decision
+    list is bit-identical run-to-run — and identical to what the live
+    scaler decided when it recorded them."""
+    policy.reset()
+    return [policy.decide(dict(row)) for row in rows]

@@ -1,0 +1,447 @@
+"""The autoscale control loop: watch the router's measured signals,
+record them as a replayable trace, and act — spawn on sustained
+pressure, drain+retire on sustained headroom.
+
+The loop body (:meth:`Scaler.tick`) is deliberately thin:
+
+1. snapshot ``Router.signals()`` (pure read of the poll/dispatch
+   paths' own series);
+2. derive the policy inputs the router can't know — the shed delta
+   since the last tick, the measured TTFR of the last spawn, and an
+   in-progress spawn counted as warming;
+3. append the row to the :class:`SignalTrace` (JSONL when a path is
+   given) — the row IS the policy's whole world, which is what makes
+   :func:`~paddle_tpu.autoscale.policy.replay` bit-identical;
+4. ``policy.decide(row)`` and act on up/down.
+
+Actions run on background threads so a slow worker boot (seconds even
+from an AOT artifact) never stalls the decision cadence; the policy
+holds while one is in flight (warming/draining counts). Scale-up
+measures its own latency — the worker's boot-to-ready stamp when the
+handle exposes ``/statusz``, else the spawn wall time — and feeds it
+back as the ``ttfr_s`` signal field: the scale-up latency model is
+MEASURED, per the fleet actually serving, not configured. Scale-down
+picks the least-loaded live replica, asks the router to drain it
+(fail-closed: placement hints die immediately), waits for
+``drain_done``, then removes+closes it. A victim that DIES mid-drain
+is already handled: the router requeues its in-flight and
+``drain_done`` reports true, so the drain thread just completes the
+removal.
+
+Chaos points (``resilience.faults``): ``autoscale.spawn`` fires before
+each spawn attempt, ``autoscale.drain`` before each drain (``path`` =
+the victim name) — a raising rule turns either into the
+spawn-failure / drain-failure path deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..core.enforce import enforce
+from ..telemetry import tracing as _tracing
+from .policy import AutoscalePolicy, Decision, Signals
+
+
+@telemetry.cached_instruments
+def _autoscale_metrics(reg):
+    return {
+        "decisions": {
+            action: reg.counter(
+                "pt_autoscale_decisions_total",
+                "scaler policy decisions by action",
+                labels={"action": action})
+            for action in ("hold", "up", "down")},
+        "scale_ups": reg.counter(
+            "pt_autoscale_scale_ups_total",
+            "replicas spawned by the scaler"),
+        "scale_downs": reg.counter(
+            "pt_autoscale_scale_downs_total",
+            "replicas drained and retired by the scaler"),
+        "spawn_failures": reg.counter(
+            "pt_autoscale_spawn_failures_total",
+            "scale-up attempts that failed to produce a ready "
+            "replica"),
+        "target": reg.gauge(
+            "pt_autoscale_target_replicas",
+            "the policy's current replica target"),
+        "ttfr": reg.gauge(
+            "pt_autoscale_ttfr_seconds",
+            "measured scale-up latency: last spawn's "
+            "time-to-first-ready", unit="s"),
+    }
+
+
+class SignalTrace:
+    """Append-only record of the signal rows the policy saw — the
+    replay substrate. With a ``path``, every row is also persisted as
+    one JSON line (``sort_keys``) as it lands, so a crashed run still
+    leaves a replayable trace."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.rows: List[Signals] = []
+        self.path = path
+        self._f = open(path, "w") if path else None
+
+    def append(self, sig: Signals) -> None:
+        self.rows.append(sig)
+        if self._f is not None:
+            self._f.write(json.dumps(sig, sort_keys=True) + "\n")
+            self._f.flush()
+
+    @classmethod
+    def load(cls, path: str) -> "SignalTrace":
+        tr = cls()
+        with open(path) as f:
+            tr.rows = [json.loads(line) for line in f
+                       if line.strip()]
+        return tr
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+
+class Scaler:
+    """The control loop over one :class:`~paddle_tpu.serving_router.
+    Router`. ``spawn_fn`` returns ONE started+warmed replica handle
+    (typically a ``spawn_replicas(..., from_artifact=..., n=1)[0]``
+    closure — the artifact pre-warm path); the scaler adds it to the
+    router, measures its TTFR, and feeds that into the policy's
+    effective up-cooldown. Tests drive :meth:`tick` directly for
+    deterministic schedules; :meth:`start` runs it on a cadence."""
+
+    def __init__(self, router, policy: AutoscalePolicy,
+                 spawn_fn: Callable[[], Any],
+                 interval_s: float = 1.0,
+                 trace_path: Optional[str] = None,
+                 drain_timeout_s: float = 120.0,
+                 retire_fn: Optional[Callable[[Any], None]] = None):
+        enforce(interval_s > 0, "interval_s must be > 0, got %s",
+                interval_s)
+        self.router = router
+        self.policy = policy
+        self.spawn_fn = spawn_fn
+        # how a drained replica leaves the fleet: by default its
+        # handle is closed (the instance is destroyed — the artifact
+        # it booted from remains on disk for the next spawn); a
+        # retire_fn instead receives the still-open handle, e.g. to
+        # return a pre-warmed replica to a pool
+        self.retire_fn = retire_fn
+        self.interval_s = float(interval_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.trace = SignalTrace(trace_path)
+        self.decisions: List[Decision] = []
+        self.events: List[Dict[str, Any]] = []
+        self.spawn_failures = 0
+        self.ttfr_s: Optional[float] = None
+        self._shed_prev: Optional[int] = None
+        self._mu = threading.Lock()
+        self._spawning = False
+        self._draining_name: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._bg: List[threading.Thread] = []
+        # (t, live replica count) change-points — the replica-seconds
+        # integral the bench's provisioning-cost gate reads; draining
+        # replicas still count (they hold resources until removed)
+        self.timeline: List[Tuple[float, int]] = []
+        self._note_fleet()
+
+    # -- the loop body ------------------------------------------------------
+
+    def tick(self) -> Decision:
+        """One control-loop evaluation: snapshot, derive, record,
+        decide, act. Returns the decision (tests assert on it)."""
+        sig = self.router.signals()
+        shed = int(sig.get("shed_total") or 0)
+        sig["shed_delta"] = (0 if self._shed_prev is None
+                             else max(0, shed - self._shed_prev))
+        self._shed_prev = shed
+        with self._mu:
+            if self._spawning:
+                # an in-flight spawn counts as a warming replica: the
+                # policy must not re-fire into it, and the recorded
+                # row carries the adjustment so replay sees the same
+                # world the live decision did
+                sig["warming"] = int(sig.get("warming") or 0) + 1
+                sig["replicas"] = int(sig.get("replicas") or 0) + 1
+            if self._draining_name is not None:
+                sig["draining"] = max(1, int(sig.get("draining")
+                                             or 0))
+        if self.ttfr_s is not None:
+            sig["ttfr_s"] = self.ttfr_s
+        self.trace.append(sig)
+        d = self.policy.decide(sig)
+        self.decisions.append(d)
+        if telemetry.enabled():
+            m = _autoscale_metrics()
+            m["decisions"][d["action"]].inc()
+            m["target"].set(d["target"])
+            if d["action"] != "hold":
+                _tracing.event("autoscale.decision",
+                               action=d["action"],
+                               reason=d["reason"], n=d["n"],
+                               target=d["target"])
+        if d["action"] == "up":
+            self._scale_up(d)
+        elif d["action"] == "down":
+            self._scale_down(d)
+        self._note_fleet()
+        return d
+
+    # -- actions ------------------------------------------------------------
+
+    def _scale_up(self, d: Decision) -> None:
+        with self._mu:
+            if self._spawning:
+                return  # belt+braces: never double-spawn
+            self._spawning = True
+        t = threading.Thread(target=self._spawn_bg, args=(d,),
+                             daemon=True, name="pt-autoscale-spawn")
+        t.start()
+        self._bg.append(t)
+
+    def _spawn_bg(self, d: Decision) -> None:
+        from ..resilience import faults as _faults
+
+        t0 = time.monotonic()
+        try:
+            inj = _faults.active()
+            if inj is not None:
+                inj.fire("autoscale.spawn")
+            rep = self.spawn_fn()
+            self.router.add_replica(rep)
+            ttfr = self._replica_ttfr(rep, time.monotonic() - t0)
+            with self._mu:
+                self.ttfr_s = ttfr
+            self.events.append({
+                "t": time.monotonic(), "event": "scale_up",
+                "replica": rep.name, "reason": d["reason"],
+                "ttfr_s": ttfr})
+            if telemetry.enabled():
+                m = _autoscale_metrics()
+                m["scale_ups"].inc()
+                m["ttfr"].set(ttfr)
+                _tracing.event("autoscale.scale_up",
+                               replica=rep.name,
+                               reason=d["reason"],
+                               ttfr_s=ttfr)
+        except Exception as e:
+            with self._mu:
+                self.spawn_failures += 1
+            self.events.append({
+                "t": time.monotonic(), "event": "spawn_failed",
+                "error": repr(e)})
+            print(f"[PT-AS-701] autoscale spawn failed (the policy "
+                  f"retries after its cooldown): {e!r}",
+                  file=sys.stderr)
+            if telemetry.enabled():
+                _autoscale_metrics()["spawn_failures"].inc()
+                _tracing.event("autoscale.spawn_failed",
+                               error=repr(e))
+        finally:
+            with self._mu:
+                self._spawning = False
+            self._note_fleet()
+
+    @staticmethod
+    def _replica_ttfr(rep, wall_s: float) -> float:
+        """The measured TTFR: the worker's own boot-to-ready stamp
+        (its /statusz aot section) when the handle is a worker
+        process, else the spawn-call wall time (in-process spawns)."""
+        try:
+            status = rep._get("/statusz")["status"]
+            ttfr_ms = status["aot"]["ttfr_ms"]
+            if ttfr_ms:
+                return float(ttfr_ms) / 1e3
+        except Exception:
+            pass
+        return wall_s
+
+    def _scale_down(self, d: Decision) -> None:
+        victim = self._pick_victim()
+        if victim is None:
+            self.events.append({"t": time.monotonic(),
+                                "event": "no_victim"})
+            return
+        with self._mu:
+            if self._draining_name is not None:
+                return  # one drain at a time
+            self._draining_name = victim
+        t = threading.Thread(target=self._drain_bg,
+                             args=(victim, d), daemon=True,
+                             name="pt-autoscale-drain")
+        t.start()
+        self._bg.append(t)
+
+    def _pick_victim(self) -> Optional[str]:
+        """Least-loaded live non-draining replica (ties break by
+        name — deterministic), guarded by the policy floor."""
+        candidates = []
+        for name, row in self.router.loads().items():
+            if not row["alive"] or row["draining"]:
+                continue
+            ld = row.get("load") or {}
+            busy = (int(row.get("inflight") or 0)
+                    + int(ld.get("queue_depth", 0) or 0)
+                    + int(ld.get("active_slots", 0) or 0))
+            candidates.append((busy, name))
+        if len(candidates) <= self.policy.min_replicas:
+            return None
+        candidates.sort()
+        return candidates[0][1]
+
+    def _drain_bg(self, name: str, d: Decision) -> None:
+        from ..resilience import faults as _faults
+
+        try:
+            inj = _faults.active()
+            if inj is not None:
+                inj.fire("autoscale.drain", path=name)
+            self.router.drain_replica(name)
+            deadline = time.monotonic() + self.drain_timeout_s
+            while (time.monotonic() < deadline
+                   and not self._stop.is_set()):
+                if self.router.drain_done(name):
+                    break
+                time.sleep(min(0.05, self.interval_s))
+            handle = self.router.remove_replica(
+                name, close=self.retire_fn is None)
+            if self.retire_fn is not None:
+                self.retire_fn(handle)
+            self.events.append({
+                "t": time.monotonic(), "event": "scale_down",
+                "replica": name, "reason": d["reason"]})
+            if telemetry.enabled():
+                _autoscale_metrics()["scale_downs"].inc()
+                _tracing.event("autoscale.scale_down", replica=name,
+                               reason=d["reason"])
+        except Exception as e:
+            # a drain that can't finish leaves the victim DRAINING
+            # (fail-closed: it still takes no new work) and reports;
+            # the dead-victim case never lands here — drain_done is
+            # true for a dead replica and removal succeeds
+            self.events.append({
+                "t": time.monotonic(), "event": "drain_failed",
+                "replica": name, "error": repr(e)})
+            print(f"[PT-AS-702] autoscale drain of {name} failed: "
+                  f"{e!r}", file=sys.stderr)
+        finally:
+            with self._mu:
+                self._draining_name = None
+            self._note_fleet()
+
+    # -- accounting ---------------------------------------------------------
+
+    def _live_count(self) -> int:
+        return sum(1 for row in self.router.loads().values()
+                   if row["alive"])
+
+    def _note_fleet(self) -> None:
+        n = self._live_count()
+        with self._mu:
+            if not self.timeline or self.timeline[-1][1] != n:
+                self.timeline.append((time.monotonic(), n))
+
+    def replica_seconds(self, until: Optional[float] = None) -> float:
+        """Integral of the live replica count over time — the
+        provisioning cost the bench compares against static-max."""
+        with self._mu:
+            points = list(self.timeline)
+        if not points:
+            return 0.0
+        t_end = time.monotonic() if until is None else float(until)
+        total = 0.0
+        for (t0, n0), (t1, _) in zip(points, points[1:]):
+            total += n0 * max(0.0, t1 - t0)
+        total += points[-1][1] * max(0.0, t_end - points[-1][0])
+        return total
+
+    def scale_events(self) -> List[Dict[str, Any]]:
+        """The acted scale events (ups + downs) — the no-flap bound
+        compares their count against ``policy.max_events``."""
+        return [e for e in self.events
+                if e["event"] in ("scale_up", "scale_down")]
+
+    # -- lifecycle + observability ------------------------------------------
+
+    def start(self) -> "Scaler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="pt-autoscale")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:
+                # the control loop must outlive a bad tick (a racing
+                # close, a probe blip): record and keep deciding
+                self.events.append({
+                    "t": time.monotonic(), "event": "tick_failed",
+                    "error": repr(e)})
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for t in self._bg:
+            t.join(timeout=10)
+        self._bg = []
+        self.trace.close()
+
+    def close(self) -> None:
+        self.stop()
+
+    def __enter__(self) -> "Scaler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def statusz(self) -> Dict[str, Any]:
+        """The /statusz "autoscale" section."""
+        with self._mu:
+            spawning = self._spawning
+            draining = self._draining_name
+            ttfr = self.ttfr_s
+            events = list(self.events[-20:])
+            timeline = list(self.timeline[-50:])
+        t0 = timeline[0][0] if timeline else 0.0
+        return {
+            "policy": self.policy.knobs(),
+            "ttfr_s": ttfr,
+            "spawning": spawning,
+            "draining": draining,
+            "spawn_failures": self.spawn_failures,
+            "decisions": len(self.decisions),
+            "last_decision": (self.decisions[-1]
+                              if self.decisions else None),
+            "scale_events": len(self.scale_events()),
+            "events": events,
+            "replica_seconds": round(self.replica_seconds(), 3),
+            "timeline": [[round(t - t0, 3), n]
+                         for t, n in timeline],
+        }
+
+    def attach(self, server) -> None:
+        """Register the autoscale /statusz section on a running debug
+        server (the router's own, usually)."""
+        server.add_status("autoscale", self.statusz)

@@ -415,6 +415,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "first N prompt tokens (shared system prompts "
                     "land on one warm replica's prefix cache; 0 "
                     "disables)")
+    ap.add_argument("--autoscale", default=None, metavar="MIN,MAX",
+                    help="--serve: run the autoscaling control plane "
+                    "— the router grows/shrinks its replica fleet "
+                    "between MIN and MAX against the measured load "
+                    "signals (queue wait, load factor, sheds); "
+                    "scale-ups pre-warm from --from-artifact when "
+                    "given")
     ap.add_argument("script", nargs="?", default=None,
                     help="training script to run per rank (omitted "
                     "with --serve)")
@@ -429,6 +436,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         from .serving_router import serve_main
 
+        autoscale = None
+        if args.autoscale:
+            parts = args.autoscale.split(",")
+            if len(parts) != 2:
+                ap.error(f"--autoscale must be MIN,MAX, got "
+                         f"{args.autoscale!r}")
+            autoscale = (int(parts[0]), int(parts[1]))
         router = serve_main(
             args.spec, replicas=args.nproc,
             prefill_workers=args.prefill_workers, port=args.port,
@@ -436,10 +450,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             log_dir=args.log_dir, trace_sample=args.trace_sample,
             dispatch=args.dispatch,
             prefix_hash_tokens=args.prefix_hash_tokens or None,
-            from_artifact=args.from_artifact)
+            from_artifact=args.from_artifact,
+            autoscale=autoscale)
         print(f"[launch] router serving on {router.server.url()} over "
               f"{args.nproc} replica(s) + {args.prefill_workers} "
-              f"prefill worker(s)", file=sys.stderr)
+              f"prefill worker(s)"
+              + (f", autoscaling {autoscale[0]}..{autoscale[1]}"
+                 if autoscale else ""), file=sys.stderr)
         import threading as _threading
 
         stop = _threading.Event()
@@ -453,6 +470,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except KeyboardInterrupt:
             pass
         finally:
+            scaler = getattr(router, "scaler", None)
+            if scaler is not None:
+                scaler.stop()  # no scale action may race the close
             router.close(replicas=True)
         return 0
     if not args.script:

@@ -40,6 +40,12 @@ Injection points (:data:`POINTS`):
   threads interleave deterministically — the chaos suite uses it to
   force a real lock-order inversion the watchdog must catch with both
   witness stacks
+- ``autoscale.spawn`` the scaler's scale-up attempt, fired before the
+  spawn fn runs — a raising rule models a worker that dies mid-boot
+  and drives the spawn-failure/retry path deterministically
+- ``autoscale.drain`` the scaler's scale-down, fired before the drain
+  begins (``path`` = the victim replica's name) — delay rules widen
+  the SIGKILL-mid-drain window for the chaos e2e
 """
 
 from __future__ import annotations
@@ -54,7 +60,8 @@ from ..core.enforce import enforce
 
 POINTS = ("ckpt.write", "ckpt.manifest", "ckpt.stage", "ckpt.commit",
           "restore.read", "step.nan", "io.slow", "fleet.notice",
-          "router.dispatch", "lock.acquire")
+          "router.dispatch", "lock.acquire", "autoscale.spawn",
+          "autoscale.drain")
 
 _ACTIVE: Optional["FaultInjector"] = None
 _LOCK = threading.Lock()

@@ -709,6 +709,13 @@ class _ReplicaState:
         self.model = getattr(replica, "model", None)
         self.alive = True
         self.ready = False
+        # draining: retiring under the autoscaler — placement stops
+        # IMMEDIATELY (fail-closed: affinity/prefix hints are purged
+        # the moment the flag flips) but in-flight work keeps draining
+        # on the same trace ids; removed: the terminal state, its pull
+        # lanes exit
+        self.draining = False
+        self.removed = False
         self.claimed = 0  # pulled off the queue, not yet registered
         self.fails = 0
         self.load: Dict[str, Any] = {"queue_depth": 0,
@@ -833,6 +840,7 @@ class Router:
         self._work = threading.Condition(threading.Lock())
         self._dispatch_q: "queue.Queue[Optional[Ticket]]" = queue.Queue()
         self._threads: List[threading.Thread] = []
+        self._pull_lanes = max(1, int(pull_lanes))
         self._probe_all()
         if dispatch == "pull":
             # one pull-worker per (replica, lane): a replica pulls
@@ -841,14 +849,7 @@ class Router:
             # by a stale placement guess. Two lanes per replica so one
             # blocking disaggregated prefill can't idle the replica.
             for name in self._replicas:
-                st = self._replicas[name]
-                for lane in range(max(1, int(pull_lanes))):
-                    t = threading.Thread(
-                        target=self._pull_loop, args=(st,),
-                        daemon=True,
-                        name=f"pt-router-pull-{name}-{lane}")
-                    t.start()
-                    self._threads.append(t)
+                self._start_lanes(self._replicas[name])
         else:
             if dispatchers is None:
                 # a dispatcher BLOCKS for the whole synchronous prefill
@@ -869,6 +870,17 @@ class Router:
         t.start()
         self._threads.append(t)
         self.server: Optional[_dbg_server.DebugServer] = None
+
+    def _start_lanes(self, st: "_ReplicaState") -> None:
+        """Spawn the pull lanes for one replica (bring-up AND
+        scale-up: an added replica gets its own lanes under live
+        traffic)."""
+        for lane in range(self._pull_lanes):
+            t = threading.Thread(
+                target=self._pull_loop, args=(st,), daemon=True,
+                name=f"pt-router-pull-{st.name}-{lane}")
+            t.start()
+            self._threads.append(t)
 
     # -- public API ---------------------------------------------------------
 
@@ -981,6 +993,8 @@ class Router:
             return {
                 "replicas": len(self._replicas),
                 "alive": len(alive),
+                "draining": sum(1 for st in self._replicas.values()
+                                if st.alive and st.draining),
                 "prefill_workers": len(self._prefill),
                 "in_flight": self._in_flight_locked(),
                 "served": self._served_count,
@@ -1020,6 +1034,7 @@ class Router:
         for name, st in list(self._replicas.items()):
             row: Dict[str, Any] = {"alive": st.alive,
                                    "ready": st.ready,
+                                   "draining": st.draining,
                                    "inflight": len(st.inflight)}
             if st.alive:
                 try:
@@ -1029,6 +1044,140 @@ class Router:
                     row["error"] = repr(e)
             rows[name] = row
         return {"replicas": rows, "router": self.stats()}
+
+    # -- scale plane (the autoscale control loop's contract) ----------------
+
+    def signals(self) -> Dict[str, Any]:
+        """One snapshot of the MEASURED load signals the autoscaler
+        policy reads — queue depth, dispatch-wait EWMA, TTFT EWMA,
+        in-flight vs slots, shed/served counters — plus the fleet
+        shape (live / warming / draining counts). Pure read, no I/O:
+        everything here is maintained by the poll and dispatch paths.
+        The scaler records these rows verbatim as its replayable
+        signal trace, so the snapshot IS the policy's whole world."""
+        with self._mu:
+            live = [st for st in self._replicas.values()
+                    if st.alive and not st.draining]
+            ready = sum(1 for st in live if st.ready)
+            slots = sum(max(1, int(st.load.get("slots", 1) or 1))
+                        for st in live if st.ready)
+            return {
+                "t": time.monotonic(),
+                "queue_depth": len(self._pending),
+                "in_flight": self._in_flight_locked(),
+                "slots": slots,
+                "ewma_wait_s": self._ewma_wait,
+                "ewma_ttft_s": self._ewma_ttft,
+                "replicas": len(live),
+                "ready": ready,
+                "warming": len(live) - ready,
+                "draining": sum(1 for st in self._replicas.values()
+                                if st.alive and st.draining),
+                "shed_total": self._shed_count,
+                "served_total": self._served_count,
+            }
+
+    def add_replica(self, replica) -> None:
+        """Scale-up under live traffic: register a started/spawned
+        replica handle, probe it (readiness gates placement exactly as
+        at bring-up), and give it pull lanes. The next claim cycle
+        starts feeding it — no restart, no queue disruption."""
+        with self._mu:
+            enforce(replica.name not in self._replicas,
+                    "duplicate replica name %r", replica.name)
+            st = _ReplicaState(replica)
+            self._replicas[replica.name] = st
+            if st.model is not None and st.model not in self._models:
+                self._models = sorted(set(self._models) | {st.model})
+        self._probe(st)
+        if self._dispatch_mode == "pull":
+            self._start_lanes(st)
+        with self._work:
+            self._work.notify_all()
+        if telemetry.enabled():
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+
+    def drain_replica(self, name: str) -> None:
+        """Begin retiring replica ``name`` — FAIL-CLOSED: the draining
+        flag stops all NEW placement the moment it flips (claims,
+        least-loaded picks, and both hint tables), and its session-
+        affinity + prefix-home entries are purged HERE, not lazily, so
+        a multi-turn session's next request re-homes instead of
+        chasing a leaving replica. In-flight work is untouched: the
+        poll loop keeps harvesting it and open streams finish on the
+        same trace id. :meth:`drain_done` reports when it's empty."""
+        with self._mu:
+            st = self._replicas.get(name)
+            enforce(st is not None, "no replica %r to drain", name)
+            st.draining = True
+            for s, n in self._affinity.items():
+                if n == name:
+                    self._affinity.pop(s)
+            for h, n in self._prefix_home.items():
+                if n == name:
+                    self._prefix_home.pop(h)
+        with self._work:
+            self._work.notify_all()  # hinted tickets re-resolve now
+        if telemetry.enabled():
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+
+    def drain_done(self, name: str) -> bool:
+        """True once a draining replica holds no work — router-side
+        in-flight AND its own last-polled arena load are empty (or the
+        replica died: its in-flight was already requeued, nothing left
+        to wait for)."""
+        with self._mu:
+            st = self._replicas.get(name)
+            if st is None or not st.alive:
+                return True
+            if not st.draining:
+                return False
+            if st.inflight or st.claimed:
+                return False
+            ld = st.load
+            return not (ld.get("queue_depth", 0)
+                        or ld.get("active_slots", 0)
+                        or ld.get("prefilling", 0))
+
+    def remove_replica(self, name: str, close: bool = False) -> Any:
+        """Drop a drained (or dead) replica from the fleet; its pull
+        lanes exit on the removed flag. ``close=True`` also closes the
+        handle (terminating a worker process it owns). Returns the
+        replica handle so a caller that keeps it open can repool it.
+        Removing a replica that still holds in-flight work is a typed
+        error — drain first."""
+        with self._mu:
+            st = self._replicas.get(name)
+            enforce(st is not None, "no replica %r to remove", name)
+            enforce(not st.alive or (st.draining and not st.inflight),
+                    "replica %r still live with in-flight work: drain "
+                    "it first (drain_replica + drain_done)", name)
+            st.removed = True
+            st.alive = False
+            del self._replicas[name]
+            self._models = sorted({s.model
+                                   for s in self._replicas.values()
+                                   if s.model is not None})
+        with self._work:
+            self._work.notify_all()
+        if telemetry.enabled():
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+        if close:
+            try:
+                st.replica.close()
+            except Exception:
+                pass
+        return st.replica
+
+    def loads(self) -> Dict[str, Dict[str, Any]]:
+        """Per-replica last-polled load view (no I/O — the poll loop's
+        cached rows): the autoscaler's victim-selection input."""
+        with self._mu:
+            return {n: {"alive": st.alive, "ready": st.ready,
+                        "draining": st.draining,
+                        "inflight": len(st.inflight),
+                        "load": dict(st.load)}
+                    for n, st in self._replicas.items()}
 
     def trace_fanin(self,
                     trace_id: Optional[str] = None) -> Dict[str, Any]:
@@ -1195,8 +1344,13 @@ class Router:
     # -- policy -------------------------------------------------------------
 
     def _alive_names(self, model: Optional[str] = None) -> List[str]:
+        # PLACEABLE names: alive and not draining — a draining replica
+        # finishes what it holds but must never receive new work, and
+        # every can-this-ticket-ever-be-served check shares this
+        # definition (fail-closed scale-down)
         return [n for n, st in self._replicas.items()
-                if st.alive and (model is None or st.model == model)]
+                if st.alive and not st.draining
+                and (model is None or st.model == model)]
 
     @staticmethod
     def _model_ok(st: "_ReplicaState", t: Ticket) -> bool:
@@ -1235,8 +1389,8 @@ class Router:
             in_flight = self._in_flight_locked(model)
             slots = sum(st.load.get("slots", 1)
                         for st in self._replicas.values()
-                        if st.alive and (model is None
-                                         or st.model == model))
+                        if st.alive and not st.draining
+                        and (model is None or st.model == model))
             ewma = self._ewma_ttft
             wait = self._ewma_wait
         if self._dispatch_mode == "pull":
@@ -1277,13 +1431,15 @@ class Router:
                 if name is not None:
                     st = self._replicas.get(name)
                     if (st is not None and st.alive and st.ready
+                            and not st.draining
                             and self._model_ok(st, t)):
                         return st
 
             def pick(require_ready: bool):
                 best, best_load = None, None
                 for st in self._replicas.values():
-                    if not st.alive or (require_ready and not st.ready):
+                    if (not st.alive or st.draining
+                            or (require_ready and not st.ready)):
                         continue
                     if not self._model_ok(st, t):
                         continue
@@ -1322,6 +1478,7 @@ class Router:
             if name is not None:
                 st = self._replicas.get(name)
                 if (st is not None and st.alive and st.ready
+                        and not st.draining
                         and self._model_ok(st, t)):
                     return name, True
         if t.prefix is not None:
@@ -1329,6 +1486,7 @@ class Router:
             if name is not None:
                 st = self._replicas.get(name)
                 if (st is not None and st.alive and st.ready
+                        and not st.draining
                         and self._model_ok(st, t)):
                     return name, False
         return None, False
@@ -1344,10 +1502,12 @@ class Router:
         ``st.claimed`` counts pulls not yet registered in-flight, so
         racing lanes can't over-claim past the slot cap. Caller holds
         self._work."""
-        if self._stop.is_set() or not st.alive:
+        if (self._stop.is_set() or not st.alive or st.draining
+                or st.removed):
             return None
         if not st.ready and any(
-                s.alive and s.ready for s in self._replicas.values()):
+                s.alive and s.ready and not s.draining
+                for s in self._replicas.values()):
             # cold replica with warm peers available: don't pull —
             # but an all-cold fleet still serves (bring-up)
             return None
@@ -1394,6 +1554,8 @@ class Router:
         own pace gates its intake — a slow or warming replica pulls
         less and the fleet's fast replicas absorb the queue."""
         while not self._stop.is_set():
+            if st.removed:
+                return  # replica scaled away: this lane retires too
             with self._work:
                 got = self._claim_locked(st)
                 if got is None:
@@ -2059,14 +2221,18 @@ def spawn_replicas(spec: Optional[str], n: int, role: str = "decode",
                    timeout_s: float = 300.0,
                    warm: bool = True,
                    model: Optional[str] = None,
-                   from_artifact: Optional[str] = None
+                   from_artifact: Optional[str] = None,
+                   start_index: int = 0
                    ) -> List[HttpReplica]:
     """Fork ``n`` replica worker processes (``--worker`` CLI) and wait
     until each is serving (and warm, unless ``warm=False``). Returns
     connected :class:`HttpReplica` handles owning their process
     (``close()`` terminates it). ``model=`` tags the replicas for
     model-id routing; ``from_artifact=`` boots them trace-free from an
-    aot artifact (``spec`` stays the traced fallback when given)."""
+    aot artifact (``spec`` stays the traced fallback when given).
+    ``start_index=`` offsets the worker names/port-files — the
+    autoscaler spawns later workers into a fleet whose ``{role}0..``
+    names are taken."""
     import tempfile
 
     enforce(spec is not None or from_artifact is not None,
@@ -2075,7 +2241,7 @@ def spawn_replicas(spec: Optional[str], n: int, role: str = "decode",
     os.makedirs(workdir, exist_ok=True)
     stem = f"{model + '-' if model else ''}{role}"
     procs = []
-    for i in range(n):
+    for i in range(start_index, start_index + n):
         pf = os.path.join(workdir, f"{stem}{i}.port")
         if os.path.exists(pf):
             os.remove(pf)
@@ -2095,12 +2261,12 @@ def spawn_replicas(spec: Optional[str], n: int, role: str = "decode",
             cmd += ["--no-warm"]
         wenv = dict(os.environ if env is None else env)
         wenv.setdefault("JAX_PLATFORMS", "cpu")
-        procs.append((subprocess.Popen(
+        procs.append((i, subprocess.Popen(
             cmd, env=wenv, stdout=log, stderr=subprocess.STDOUT), pf,
             log))
     out = []
     try:
-        for i, (p, pf, log) in enumerate(procs):
+        for i, p, pf, log in procs:
             # per-WORKER deadline: the workers boot in parallel, so by
             # the time worker i's wait starts, it has been warming all
             # along — a shared deadline would let a slow first warmup
@@ -2141,12 +2307,12 @@ def spawn_replicas(spec: Optional[str], n: int, role: str = "decode",
                         log.name)
             out.append(rep)
     except BaseException:
-        for p, _, _ in procs:
+        for _, p, _, _ in procs:
             if p.poll() is None:
                 p.kill()
         raise
     finally:
-        for _, _, log in procs:
+        for _, _, _, log in procs:
             log.close()
     return out
 
@@ -2185,7 +2351,8 @@ def serve_main(spec: Optional[str], replicas: int = 2,
                textfile_path: Optional[str] = None,
                dispatch: str = "pull",
                prefix_hash_tokens: Optional[int] = 64,
-               from_artifact: Optional[str] = None) -> Router:
+               from_artifact: Optional[str] = None,
+               autoscale: Optional[Sequence[int]] = None) -> Router:
     """One-command serving bring-up (``python -m paddle_tpu.launch
     --serve``): spawn the replica (and prefill) worker processes, build
     the router over them, and serve the router front-end (POST /submit
@@ -2193,13 +2360,31 @@ def serve_main(spec: Optional[str], replicas: int = 2,
     ``spec`` may be multi-model (see :func:`_parse_specs`): replicas
     spawn per model, tagged for model-id routing. ``from_artifact``
     boots the replicas trace-free from an aot artifact (single-model
-    fleets; ``spec`` stays the traced fallback). Returns the running
-    router — the caller owns ``close(replicas=True)``."""
+    fleets; ``spec`` stays the traced fallback).
+
+    ``autoscale=(min, max)`` runs the autoscaling control plane: the
+    initial fleet is clamped into ``[min, max]`` and a
+    :class:`~paddle_tpu.autoscale.Scaler` (attached as
+    ``router.scaler`` and as the /statusz "autoscale" section) grows
+    and shrinks it against the router's measured signals — new
+    replicas spawn through the SAME artifact pre-warm path the
+    bring-up used. Returns the running router — the caller owns
+    ``close(replicas=True)`` (and ``router.scaler.stop()`` first when
+    autoscaled)."""
     pairs = _parse_specs(spec)
     enforce(from_artifact is None or len(pairs) == 1,
             "--from-artifact boots a single-model fleet (one artifact "
             "holds one model's programs); got %s model specs",
             len(pairs))
+    if autoscale is not None:
+        amin, amax = (int(autoscale[0]), int(autoscale[1]))
+        enforce(len(pairs) == 1,
+                "--autoscale manages a single-model fleet; got %s "
+                "model specs", len(pairs))
+        enforce(1 <= amin <= amax,
+                "--autoscale needs 1 <= min <= max, got %s,%s",
+                amin, amax)
+        replicas = min(max(replicas, amin), amax)
     reps, pfs = [], []
     for m, sp in pairs:
         reps += spawn_replicas(sp, replicas, spec_kw=spec_kw,
@@ -2216,6 +2401,27 @@ def serve_main(spec: Optional[str], replicas: int = 2,
                     dispatch=dispatch,
                     prefix_hash_tokens=prefix_hash_tokens)
     router.start_server(port=port)
+    if autoscale is not None:
+        from .autoscale import AutoscalePolicy, Scaler
+
+        model0, spec0 = pairs[0]
+        counter = iter(range(replicas, 1_000_000))
+
+        def _spawn():
+            # the artifact pre-warm path: each scale-up boots exactly
+            # like bring-up did (trace-free when an artifact is given,
+            # ready-gated either way), under a fresh worker index
+            return spawn_replicas(spec0, 1, spec_kw=spec_kw,
+                                  log_dir=log_dir, model=model0,
+                                  from_artifact=from_artifact,
+                                  start_index=next(counter))[0]
+
+        scaler = Scaler(router,
+                        AutoscalePolicy(min_replicas=amin,
+                                        max_replicas=amax),
+                        _spawn)
+        scaler.attach(router.server)
+        router.scaler = scaler.start()
     return router
 
 
@@ -2277,7 +2483,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="(router mode) route by a rolling hash of "
                     "the first N prompt tokens so shared system "
                     "prompts land on one warm replica (0 disables)")
+    ap.add_argument("--autoscale", default=None, metavar="MIN,MAX",
+                    help="(router mode) run the autoscaling control "
+                    "plane: grow/shrink the fleet between MIN and MAX "
+                    "replicas against the measured load signals "
+                    "(spawns ride --from-artifact when given)")
     args = ap.parse_args(argv)
+    autoscale = None
+    if args.autoscale:
+        parts = args.autoscale.split(",")
+        enforce(len(parts) == 2, "--autoscale must be MIN,MAX, got %r",
+                args.autoscale)
+        autoscale = (int(parts[0]), int(parts[1]))
     enforce(args.spec or args.from_artifact,
             "need --spec module:fn and/or --from-artifact DIR")
     kw = json.loads(args.spec_kw) if args.spec_kw else None
@@ -2295,15 +2512,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         dispatch=args.dispatch,
                         prefix_hash_tokens=(args.prefix_hash_tokens
                                             or None),
-                        from_artifact=args.from_artifact)
+                        from_artifact=args.from_artifact,
+                        autoscale=autoscale)
     print(f"[router] serving on {router.server.url()} over "
-          f"{args.replicas} replica(s)", file=sys.stderr)
+          f"{args.replicas} replica(s)"
+          + (f", autoscaling {autoscale[0]}..{autoscale[1]}"
+             if autoscale else ""), file=sys.stderr)
     try:
         while True:
             time.sleep(1)
     except KeyboardInterrupt:
         pass
     finally:
+        scaler = getattr(router, "scaler", None)
+        if scaler is not None:
+            scaler.stop()
         router.close(replicas=True)
     return 0
 

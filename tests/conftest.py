@@ -108,6 +108,12 @@ SLOW_PATTERNS = [
     # on the file) — the bare MID filename must not pull it into -m mid
     "test_embedding_ckpt.py::test_sigkill_mid_ep_table_save_restores_"
     "one_committed_step",
+    # autoscale subprocess chaos e2es (worker spawns + SIGKILL, ~60s
+    # each) and the spike A/B bench gate: full suite only — the bare
+    # test_autoscale.py MID pattern must not pull them into -m mid
+    "test_autoscale.py::test_sigkill_mid_scale_up_converges",
+    "test_autoscale.py::test_sigkill_drain_target_mid_drain",
+    "test_autoscale.py::test_autoscale_bench_gate",
 ]
 
 # mid tier = smoke + one representative per DEEP subsystem (pallas
@@ -188,6 +194,10 @@ MID_PATTERNS = [
     "test_sharding_plan.py",
     "test_resilience.py",
     "test_chaos.py",
+    # autoscale control plane: policy ladder/cooldown units, replay
+    # bit-identity, scaler stub loop, drain fail-closed (the SIGKILL
+    # chaos pair and the spike bench gate are pinned slow above)
+    "test_autoscale.py",
     "test_global_commit.py",
     "test_fleet.py",
     "test_fleet_controller.py",

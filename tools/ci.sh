@@ -98,6 +98,11 @@ fingerprint-mismatch fallback + GC staleness ride -m mid above)"
 ONE merged cross-process chrome-trace with a shared trace id)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py \
       -q -m chaos || exit $?
+    stage "scaler smoke (recorded-trace policy replay bit-identity + \
+one spawn/retire e2e on real in-process replicas; the SIGKILL chaos \
+pair and the spike A/B bench gate ride the full suite only)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_autoscale.py \
+      -q -k "replay or spawn_retire_e2e" || exit $?
     stage "dist smoke (REAL 2-process jax.distributed job: preempt \
 agreement + a step-agreed periodic save, both over the LIVE \
 ClientTransport KV — not the file fallback)"

@@ -40,6 +40,7 @@ MODULES = [
     "paddle_tpu.resilience",
     "paddle_tpu.serving",
     "paddle_tpu.serving_router",
+    "paddle_tpu.autoscale",
     "paddle_tpu.aot",
     "paddle_tpu.analysis",
     "paddle_tpu.train_loop",
