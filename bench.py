@@ -25,6 +25,24 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
+def _ledger_flops(program, fn, *args, n_partitions=1, **kwargs):
+    """FLOPs of one dispatch of ``fn(*args)`` — the same XLA cost-model
+    number ``utils.flops.lowered_flops`` reads, but REGISTERED in the
+    telemetry cost ledger under ``program`` so report_line can audit
+    the emitted mfu against the registry record (ride the name along as
+    ``extras["ledger_program"]`` plus ``ledger_dispatches`` /
+    ``ledger_window_s``). None when the backend won't cost the module
+    (the provenance-only record still registers)."""
+    from paddle_tpu.telemetry import costs as _tcosts
+
+    try:
+        return _tcosts.analyze_callable(
+            program, fn, *args, n_partitions=n_partitions,
+            **kwargs).get("flops")
+    except Exception:
+        return None
+
+
 def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
                     steps_per_call: int = 8, dp: int = 1, amp=None):
     """BASELINE config 1. ``steps_per_call`` fuses K optimizer steps into
@@ -64,12 +82,12 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
     # FLOPs of the module that is ACTUALLY dispatched (the k-step scan
     # when k>1) — lowered before any call donates buffers, and the AOT
     # compile inside the fallback is the same executable the timed loop
-    # reuses via the persistent cache
-    from paddle_tpu.utils.flops import lowered_flops
-
-    dispatched = trainer.steps_jit(k) if k > 1 else trainer._jit_step
-    step_flops = lowered_flops(
-        dispatched, trainer.params, trainer.buffers,
+    # reuses via the persistent cache. Registered in the telemetry cost
+    # ledger so the emitted mfu is auditable against the registry.
+    ledger_program = "bench.mnist_mlp.step"
+    step_flops = _ledger_flops(
+        ledger_program, trainer.steps_jit(k) if k > 1 else
+        trainer._jit_step, trainer.params, trainer.buffers,
         trainer.opt_state, trainer._rng, batch, n_partitions=dp)
     if step_flops and k > 1:
         step_flops /= k
@@ -88,6 +106,8 @@ def bench_mnist_mlp(steps: int, batch_size: int, warmup: int = 5,
     extras = {"step_time_ms": round(dt / (outer * k) * 1e3, 3)}
     if step_flops:
         extras["flops_per_sec"] = step_flops * outer * k / dt
+        extras.update(ledger_program=ledger_program,
+                      ledger_dispatches=outer, ledger_window_s=dt)
     return outer * k * batch_size / dt, "examples/sec", extras
 
 
@@ -196,7 +216,6 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
         return params, buffers, state, ls[-1]
 
     from paddle_tpu.core.profiler import RecordEvent
-    from paddle_tpu.utils.flops import lowered_flops
 
     # model FLOPs per STEP from XLA's cost model, measured on a k=1
     # probe (lower-only, never executed) and scaled by k explicitly:
@@ -209,9 +228,10 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     # k == 1: analyze ``step`` itself — its AOT fallback compile is the
     # same program the first dispatch reuses from the cache; a separate
     # donation-free probe jit would pay a second full (remote) compile
-    dispatch_flops = lowered_flops(
-        step if k == 1 else jax.jit(one_step), params, buffers, state,
-        batch)
+    ledger_program = f"bench.{type(model).__name__}.step"
+    dispatch_flops = _ledger_flops(
+        ledger_program, step if k == 1 else jax.jit(one_step), params,
+        buffers, state, batch)
     if dispatch_flops:
         dispatch_flops *= k * flops_scale
 
@@ -233,6 +253,9 @@ def _train_bench(model, loss_fn, make_batch, steps, batch_size, warmup=3,
     extras = {"step_time_ms": round(dt / (outer * k) * 1e3, 3)}
     if dispatch_flops:
         extras["flops_per_sec"] = dispatch_flops * outer / dt
+        extras.update(ledger_program=ledger_program,
+                      ledger_scale=k * flops_scale,
+                      ledger_dispatches=outer, ledger_window_s=dt)
     return outer * k * batch_size / dt, "examples/sec", extras
 
 
@@ -836,7 +859,23 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
                            .astype(np.int32), max_new)
             return dec.run()
 
-    run_all()  # warmup: compiles the step + prefill buckets
+    # warmup compiles the step + prefill buckets — with telemetry on
+    # for just this run so the serving dispatch sites register their
+    # programs in the cost ledger (the serve row's mfu/roofline source)
+    from paddle_tpu.telemetry import costs as _tcosts
+    from paddle_tpu.telemetry import metrics as _tmetrics
+
+    telem_was_on = _tmetrics.enabled()
+    _tmetrics.enable()
+    try:
+        run_all()
+    finally:
+        if not telem_was_on:
+            _tmetrics.disable()
+    step_rec = next((r for name, r in sorted(_tcosts.ledger().items())
+                     if name.startswith("serving.step[")), None)
+    ticks0, tok0, cap0 = dec.tick_count, dec.tick_tokens, \
+        dec.tick_capacity
     outer = max(1, steps // 50)
     t0 = time.perf_counter()
     total = 0
@@ -846,6 +885,22 @@ def bench_gpt_serve(steps: int, batch_size: int, amp=None,
     dt = time.perf_counter() - t0
     extras = {"requests": n_req, "slots": slots,
               "step_time_ms": round(dt / outer * 1e3, 3)}
+    # goodput: tokens emitted / slot-token capacity over the timed
+    # ticks, from the decoder's unconditional tick counters
+    cap_delta = dec.tick_capacity - cap0
+    if cap_delta > 0:
+        extras["goodput_ratio"] = round(
+            (dec.tick_tokens - tok0) / cap_delta, 4)
+    if step_rec is not None and step_rec.get("flops"):
+        # decode-dispatch FLOPs only (prefill excluded): a lower bound,
+        # audited in report_line against the same ledger record
+        n_ticks = dec.tick_count - ticks0
+        if n_ticks > 0:
+            extras["flops_per_sec"] = \
+                step_rec["flops"] * n_ticks / dt
+            extras.update(ledger_program=step_rec["program"],
+                          ledger_dispatches=n_ticks,
+                          ledger_window_s=dt)
     if gamma > 0:
         extras["accept_per_round"] = round(
             dec.spec_accepted / max(1, dec.spec_row_rounds), 3)
@@ -1969,9 +2024,9 @@ def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
         return ls[-1], params, state
 
     from paddle_tpu.core.profiler import RecordEvent
-    from paddle_tpu.utils.flops import lowered_flops
 
-    dispatch_flops = lowered_flops(step, params, state, ids, dense)
+    dispatch_flops = _ledger_flops("bench.deepfm_sparse.step", step,
+                                   params, state, ids, dense)
     for _ in range(3):
         loss, params, state = step(params, state, ids, dense)
     float(loss)
@@ -1987,6 +2042,8 @@ def bench_deepfm_sparse(steps: int, batch_size: int, amp=None,
     extras = {"step_time_ms": round(dt / (outer * k) * 1e3, 3)}
     if dispatch_flops:
         extras["flops_per_sec"] = dispatch_flops * outer / dt
+        extras.update(ledger_program="bench.deepfm_sparse.step",
+                      ledger_dispatches=outer, ledger_window_s=dt)
     return outer * k * batch_size / dt, "examples/sec", extras
 
 
@@ -2180,7 +2237,6 @@ def bench_input_pipeline(steps: int, batch_size: int, warmup: int = 3,
     from paddle_tpu import optimizer, parallel
     from paddle_tpu.data.device_loader import DevicePrefetcher
     from paddle_tpu.models import mnist as M
-    from paddle_tpu.utils.flops import lowered_flops
 
     pt.seed(0)
     batch_size = _cap(batch_size, 256)
@@ -2199,7 +2255,8 @@ def bench_input_pipeline(steps: int, batch_size: int, warmup: int = 3,
 
     # FLOPs before the first call donates the trainer state
     probe = next(host_batches(1))
-    step_flops = lowered_flops(trainer._jit_step, trainer.params,
+    step_flops = _ledger_flops("bench.input_pipeline.step",
+                               trainer._jit_step, trainer.params,
                                trainer.buffers, trainer.opt_state,
                                trainer._rng, probe)
     loss = None
@@ -2230,6 +2287,8 @@ def bench_input_pipeline(steps: int, batch_size: int, warmup: int = 3,
     }
     if step_flops:
         extras["flops_per_sec"] = step_flops * steps / dt_on
+        extras.update(ledger_program="bench.input_pipeline.step",
+                      ledger_dispatches=steps, ledger_window_s=dt_on)
     return value, "examples/sec", extras
 
 
@@ -2346,7 +2405,6 @@ def bench_sharding_plan(steps: int, batch_size: int, amp=None):
     from paddle_tpu.models import mnist as M
     from paddle_tpu.parallel.plan import (Plan, guard_no_resharding,
                                           max_device_bytes)
-    from paddle_tpu.utils.flops import lowered_flops
 
     pt.seed(0)
     batch_size = _cap(batch_size, 256)
@@ -2374,7 +2432,8 @@ def bench_sharding_plan(steps: int, batch_size: int, amp=None):
                  sh),
              "label": jax.device_put(
                  jnp.asarray(rng.integers(0, 10, batch_size)), sh)}
-    step_flops = lowered_flops(trainer._jit_step, trainer.params,
+    step_flops = _ledger_flops("bench.sharding_plan.step",
+                               trainer._jit_step, trainer.params,
                                trainer.buffers, trainer.opt_state,
                                trainer._rng, batch,
                                n_partitions=plan.num_devices)
@@ -2404,6 +2463,8 @@ def bench_sharding_plan(steps: int, batch_size: int, amp=None):
     }
     if step_flops:
         extras["flops_per_sec"] = step_flops * steps / dt
+        extras.update(ledger_program="bench.sharding_plan.step",
+                      ledger_dispatches=steps, ledger_window_s=dt)
     return steps * batch_size / dt, "examples/sec", extras
 
 
@@ -3404,7 +3465,10 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                           "fits_budget_only_planned", "shard_ratio",
                           "session_ratio", "step_time_ms_fp32", "dp",
                           "shed_rate", "replicas", "prefill_workers",
-                          "rate_rps")})
+                          "rate_rps",
+                          # performance-attribution plane: fraction of
+                          # serving capacity that emitted tokens
+                          "goodput_ratio")})
     flops_per_sec = extras.get("flops_per_sec")
     line["mfu"] = None
     if flops_per_sec:
@@ -3412,6 +3476,43 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
         m = _mfu(flops_per_sec, device, n_devices=max(1, dp))
         if m is not None:
             line["mfu"] = round(m, 4)
+    # Ledger-derived columns (performance-attribution plane): the
+    # roofline verdict rides straight from the cost-registry record,
+    # and the mfu above is AUDITED against it — the numerator must
+    # equal ledger FLOPs x scale x dispatches / window or the row
+    # refuses to print an mfu at all (``mfu_audit`` says why). A bench
+    # whose flops source drifts from the registry can't quietly ship a
+    # hand-rolled utilization number.
+    prog = extras.get("ledger_program")
+    if prog:
+        rec = None
+        try:
+            from paddle_tpu.telemetry import costs as _tcosts
+
+            rec = _tcosts.get(prog)
+        except Exception:
+            pass
+        rl = (rec or {}).get("roofline") or {}
+        if rl.get("verdict"):
+            line["roofline"] = rl["verdict"]
+            if rl.get("nominal"):
+                line["roofline_nominal"] = True
+        n_disp = extras.get("ledger_dispatches")
+        window = extras.get("ledger_window_s")
+        if flops_per_sec and n_disp and window:
+            rec_flops = (rec or {}).get("flops")
+            if not rec_flops:
+                line["mfu"] = None
+                line["mfu_audit"] = "no_ledger_record"
+            else:
+                expected = (rec_flops
+                            * float(extras.get("ledger_scale") or 1.0)
+                            * n_disp / window)
+                if abs(expected - flops_per_sec) <= 0.02 * expected:
+                    line["mfu_audit"] = "ledger"
+                else:
+                    line["mfu"] = None
+                    line["mfu_audit"] = "ledger_mismatch"
     if regression:
         line["regression"] = True
     return line

@@ -45,6 +45,11 @@ PT-LINT-308    Repo lint: attend-path QuantizedPool dispatch branch
                outside ops/paged_kv.py (storage-form dispatch must
                stay at the one attend boundary; kernels take raw
                (values, scales) arrays)
+PT-LINT-309    Repo lint: perf_counter()/time.time() delta around a
+               jitted/compiled dispatch with no device fence before
+               the stop-stamp (async dispatch: the delta times the
+               enqueue, not the device — fence with
+               block_until_ready / np.asarray / float(loss) first)
 PT-TUNE-501    Tuning table: device-matched decode entry exists only
                under the legacy pre-int8 key — dtype-keyed entry
                missing (stale table; re-run tools/pallas_tune.py
@@ -59,6 +64,14 @@ PT-RACE-403    Concurrency: timeout-less blocking call (join /
 PT-RACE-404    Concurrency: Condition.wait outside a predicate loop
 PT-RACE-405    Concurrency: non-daemon thread never joined in its
                module
+PT-PERF-801    Perf sentinel (warning): train-step wall time regressed
+               past the rolling baseline band for this
+               (program, backend) — warn-once; POST /profilez for a
+               device trace, /statusz costs for the roofline; delete
+               the baseline file to re-arm after an intended change
+PT-PERF-802    Perf sentinel (warning): serving inter-token latency
+               regressed past the rolling baseline band (same
+               machinery as 801 over per-tick ITL)
 PT-AOT-601     AOT serving (warning): --from-artifact boot rejected
                the serialized artifact (toolchain fingerprint drift,
                torn/unreadable artifact) and fell back to the trace

@@ -24,6 +24,13 @@ were each paid for with a real bug class (codes in ``diagnostics.py``):
   source invalidates them (the PR 6 snapshot SIGSEGV class).
 - **PT-LINT-305** — leftover debug hooks: ``jax.debug.print``,
   ``jax.debug.breakpoint``, ``breakpoint()``, ``pdb.set_trace()``.
+- **PT-LINT-309** — a ``time.perf_counter()`` / ``time.time()`` delta
+  taken around a jitted/compiled dispatch with no device fence before
+  the stop-stamp: jax dispatch is async, so the delta times the Python
+  enqueue (microseconds) instead of the device compute — a silently
+  30x-flattering step time (the _train_bench docstring bug class, now
+  a rule). Fence with ``jax.block_until_ready`` / ``np.asarray`` /
+  ``float(loss)`` / ``.item()`` before subtracting the start stamp.
 
 Suppression: append ``# pt-lint: disable=PT-LINT-303 <reason>`` to the
 flagged line (or the line above). The reason is REQUIRED — a bare
@@ -56,6 +63,8 @@ LINT_CODES = {
                    "flush or trace-header echo",
     "PT-LINT-308": "attend-path QuantizedPool dispatch branch outside "
                    "ops/paged_kv.py",
+    "PT-LINT-309": "timing delta around a jitted dispatch with no "
+                   "device fence before the stop-stamp",
 }
 
 # callees whose arguments get donated (this repo's donating entry
@@ -72,6 +81,18 @@ ATOMIC_MARKERS = {"mkstemp", "atomic_write_text",
 ATOMIC_DOTTED = {"os.replace"}
 
 SPAN_NAMES = {"Span", "RecordEvent"}
+
+# PT-LINT-309: wrappers whose result is an ASYNC dispatcher (calling it
+# returns before the device finishes), clock reads that start/stop a
+# measurement, and the host-sync calls that fence a dispatch. The rule
+# only trusts what it can prove in-scope: a name bound from a wrapper,
+# the repo's donating entry points, or a _jit_* attribute — never
+# "looks like a step function".
+JIT_WRAPPERS = {"jit", "pjit", "compile_step", "steps_jit"}
+TIMER_DOTTED = {"time.perf_counter", "time.time"}
+FENCE_TERMINALS = {"block_until_ready", "device_get", "asarray",
+                   "array", "item", "tolist"}
+FENCE_BUILTINS = {"float", "int"}
 
 # PT-LINT-306 (trace propagation) applies only to the serving/debug
 # HTTP planes — the files whose request hops carry the distributed
@@ -150,6 +171,7 @@ class _Linter(ast.NodeVisitor):
         self._trace_file = any(norm.endswith(f) for f in TRACE_FILES)
         self._pool_dispatch_file = norm.endswith(POOL_DISPATCH_FILE)
         self.findings: List[Diagnostic] = []
+        self._fence_fns: Set[str] = set()
         self._span_depth = 0
         # open-file bindings live per `with` body: name -> mode
         self._wfiles: List[Dict[str, str]] = []
@@ -177,6 +199,117 @@ class _Linter(ast.NodeVisitor):
         terminals, _ = self._scope_calls[-1]
         return bool(terminals & TRACE_MARKERS)
 
+    # -- PT-LINT-309: unfenced timing around a jitted dispatch --------------
+
+    def _scan_unfenced_timing(self, scope) -> None:
+        """Linear statement-order scan of ONE scope (nested functions
+        scan themselves): a ``timer_call() - <start_stamp>`` delta is
+        flagged when a jitted dispatch happened since the last fence —
+        the delta measured the async enqueue, not the device. Fences
+        anywhere between dispatch and stop-stamp clear the hazard, so
+        the standard bench shape (dispatch loop, ``float(loss)``,
+        delta) stays silent."""
+        jitted: Set[str] = set()
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                if _terminal(n.value.func) in JIT_WRAPPERS:
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name):
+                            jitted.add(tgt.id)
+        timers: Set[str] = set()
+        pending: List[Optional[str]] = [None]  # dispatch callee or None
+
+        def is_timer(v: ast.AST) -> bool:
+            return (isinstance(v, ast.Call)
+                    and (_dotted(v.func) in TIMER_DOTTED
+                         or _terminal(v.func) == "perf_counter"))
+
+        def is_dispatch(call: ast.Call) -> Optional[str]:
+            name = _terminal(call.func)
+            if (name in jitted or _is_donating_callee(call.func)):
+                return name
+            # direct jax.jit(fn)(x) double-call
+            if (isinstance(call.func, ast.Call)
+                    and _terminal(call.func.func) in JIT_WRAPPERS):
+                return _terminal(call.func.func)
+            return None
+
+        def see_exprs(node: ast.AST) -> None:
+            for n in ast.walk(node):
+                if (isinstance(n, ast.BinOp)
+                        and isinstance(n.op, ast.Sub)
+                        and isinstance(n.right, ast.Name)
+                        and n.right.id in timers
+                        and (is_timer(n.left)
+                             or (isinstance(n.left, ast.Name)
+                                 and n.left.id in timers))):
+                    if pending[0]:
+                        self._flag(
+                            "PT-LINT-309", n,
+                            f"timing delta over jitted dispatch "
+                            f"{pending[0]!r} with no device fence: the "
+                            f"delta measures the async enqueue, not "
+                            f"the device",
+                            "fence before the stop-stamp — "
+                            "jax.block_until_ready(out), "
+                            "np.asarray(out), float(loss) or "
+                            ".item() — then subtract the start stamp")
+                        pending[0] = None  # one finding per hazard
+                    continue
+                if not isinstance(n, ast.Call):
+                    continue
+                t = _terminal(n.func)
+                if (t in FENCE_TERMINALS or t in self._fence_fns
+                        or (isinstance(n.func, ast.Name)
+                            and n.func.id in FENCE_BUILTINS
+                            and n.args)):
+                    pending[0] = None
+                    continue
+                d = is_dispatch(n)
+                if d is not None:
+                    pending[0] = d
+
+        def bind_timers(stmt: ast.Assign) -> None:
+            stamp = (is_timer(stmt.value)
+                     or (isinstance(stmt.value, ast.IfExp)
+                         and (is_timer(stmt.value.body)
+                              or is_timer(stmt.value.orelse))))
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    (timers.add if stamp
+                     else timers.discard)(tgt.id)
+
+        def walk(body: Sequence[ast.stmt]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # nested scopes scan themselves
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    see_exprs(stmt.iter)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, (ast.While, ast.If)):
+                    see_exprs(stmt.test)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        see_exprs(item.context_expr)
+                    walk(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body)
+                    for h in stmt.handlers:
+                        walk(h.body)
+                    walk(stmt.orelse)
+                    walk(stmt.finalbody)
+                else:
+                    see_exprs(stmt)
+                    if isinstance(stmt, ast.Assign):
+                        bind_timers(stmt)
+
+        walk(scope.body)
+
     # -- scopes -------------------------------------------------------------
 
     def _enter_scope(self, node) -> None:
@@ -187,13 +320,26 @@ class _Linter(ast.NodeVisitor):
         self._devget_names.append(set())
 
     def visit_Module(self, node):
+        # file-local fence helpers (benches wrap the host fetch in a
+        # `_fence(out)` def): calling one fences for PT-LINT-309
+        self._fence_fns = {
+            fn.name for fn in ast.walk(node)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and any(isinstance(c, ast.Call)
+                    and (_terminal(c.func) in FENCE_TERMINALS
+                         or (isinstance(c.func, ast.Name)
+                             and c.func.id in FENCE_BUILTINS
+                             and c.args))
+                    for c in ast.walk(fn))}
         self._enter_scope(node)
+        self._scan_unfenced_timing(node)
         self.generic_visit(node)
         self._scope_calls.pop()
         self._devget_names.pop()
 
     def visit_FunctionDef(self, node):
         self._enter_scope(node)
+        self._scan_unfenced_timing(node)
         # PT-LINT-306 (handler side): a POST dispatch handler in a
         # trace-plane file must consult the trace header (bind the
         # incoming context via tracing.from_header) — otherwise every
@@ -448,6 +594,10 @@ def lint_source(src: str, path: str = "<string>") -> List[Diagnostic]:
             hint="fix the syntax error")]
     linter = _Linter(path)
     linter.visit(tree)
+    # the 309 scope scan emits at function-visit time, ahead of the
+    # per-call visits inside the same function — re-establish the
+    # documented line order before suppression filtering
+    linter.findings.sort(key=lambda d: (d.line or 0, d.code))
     sup = _suppressions(src)
     out: List[Diagnostic] = []
     for d in linter.findings:

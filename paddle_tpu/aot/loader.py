@@ -170,6 +170,20 @@ def load_decoder(path: str, *, check: bool = True):
     dec._step_fns.update(step_fns)
     for lb, fn in prefill_fns.items():
         dec._prefill_cache[("paged", lb) if dec.paged else lb] = fn
+    # cost-ledger provenance: the rehydrated programs register under
+    # the SAME names the serving dispatch sites use, so when a tick
+    # fills in their cost_analysis numbers the record still says
+    # "aot" + which artifact. Zero-cost when telemetry is off.
+    from ..telemetry import costs as _costs
+
+    for kd in step_fns:
+        _costs.note_aot_program(f"serving.step[k={kd}]",
+                                artifact_id=man.get("artifact_id"))
+    for lb in prefill_fns:
+        name = (f"serving.prefill[paged,{lb}]" if dec.paged
+                else f"serving.prefill[{lb}]")
+        _costs.note_aot_program(name,
+                                artifact_id=man.get("artifact_id"))
     # /statusz "aot" section source + bench TTFR provenance
     dec.aot_info = {
         "artifact": directory,

@@ -38,6 +38,7 @@ capability).
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -55,6 +56,8 @@ __all__ = ["BatchedDecoder", "PagedKVPool", "Request", "KVHandoff",
 from .nn.layer import inject_state
 from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
+from .telemetry import costs as _costs
+from .telemetry import profiling as _profiling
 from .telemetry import recompile as _recompile
 from .telemetry import server as _dbg_server
 from .telemetry import tracing as _tracing
@@ -785,6 +788,13 @@ class BatchedDecoder:
         # False until the serving step has dispatched once (jit warm),
         # False again while draining on preemption
         self._warmed = False
+        # tick accounting (plain counters, harness-readable without
+        # telemetry): ticks run, tokens actually emitted, and the
+        # token capacity (slots x k per tick) — the serving goodput
+        # ratio is tick_tokens / tick_capacity
+        self.tick_count = 0
+        self.tick_tokens = 0
+        self.tick_capacity = 0
         self._weights_fp = None  # stamped per run() when telemetry on
         # weights/buffers snapshot, passed to every jitted fn as REAL
         # arguments (inject_state): compiled programs stay weight-free,
@@ -925,6 +935,10 @@ class BatchedDecoder:
                             "spec": self.draft is not None,
                             "decode_steps": self.decode_steps}).start()
             self.debug_server.add_status("serving", self._statusz)
+            # on-demand bounded device capture (404->409->200 state
+            # machine; one concurrent capture, hard duration cap)
+            self.debug_server.add_post(
+                "/profilez", _profiling.make_profilez())
             # readiness is distinct from liveness: a draining or
             # not-yet-warmed arena answers ready=false on /healthz +
             # /readyz so a router stops PLACING sessions here without
@@ -1602,9 +1616,16 @@ class BatchedDecoder:
                 if self.paged:
                     row = self.table[s]
                     if cached == 0:
-                        self.pools, logits = self._prefill_fn_paged(lb)(
+                        pf = self._prefill_fn_paged(lb)
+                        self.pools, logits = pf(
                             self._mstate, self.pools, jnp.asarray(row),
                             jnp.asarray(padded), plen)
+                        if telem:
+                            _costs.ensure_program(
+                                f"serving.prefill[paged,{lb}]", pf,
+                                (self._mstate, self.pools,
+                                 jnp.asarray(row), jnp.asarray(padded),
+                                 plen), origin="serving")
                     else:
                         # prefill only the uncached suffix (page-aligned
                         # t0), then the usual last-token re-step for the
@@ -1627,9 +1648,16 @@ class BatchedDecoder:
                             jnp.asarray(r.prompt[plen - 1], jnp.int32),
                             plen - 1)
                 else:
-                    self.caches, logits = self._prefill_fn(lb)(
+                    pf = self._prefill_fn(lb)
+                    self.caches, logits = pf(
                         self._mstate, self.caches, jnp.asarray(padded),
                         plen, s)
+                    if telem:
+                        _costs.ensure_program(
+                            f"serving.prefill[{lb}]", pf,
+                            (self._mstate, self.caches,
+                             jnp.asarray(padded), plen, s),
+                            origin="serving")
                 self._activate(s, r, logits, int(plen))
 
     def _pick(self, logits, s: int, pos: int):
@@ -1740,6 +1768,21 @@ class BatchedDecoder:
                     self._mstate, self.caches, self.tok, self.t, gens)
             toks = np.asarray(jax.device_get(toks)).astype(np.int32)
         self._warmed = True
+        if telem:
+            # cost-ledger registration, once per step variant (set
+            # lookup after the first tick): lower() only reads avals,
+            # so the post-dispatch arrays — donated or not — are fine
+            prog = f"serving.step[k={kd}]"
+            if self.paged:
+                _costs.ensure_program(
+                    prog, step_fn,
+                    (self._mstate, self.pools, jnp.asarray(self.table),
+                     self.tok, self.t, gens), origin="serving")
+            else:
+                _costs.ensure_program(
+                    prog, step_fn,
+                    (self._mstate, self.caches, self.tok, self.t, gens),
+                    origin="serving")
         now = time.perf_counter()
         n_emitted = 0
         for s in range(self.slots):
@@ -1758,13 +1801,28 @@ class BatchedDecoder:
                 # per-tick streaming: this tick's tokens leave NOW
                 # (completion already streamed via finish above)
                 r.stream.offer(self.emitted[s], now)
+        # tick accounting (plain ints — the bench harness reads these
+        # without enabling telemetry)
+        self.tick_count += 1
+        self.tick_tokens += n_emitted
+        self.tick_capacity += self.slots * kd
         if telem and n_emitted:
             m = _serving_metrics()
             m["tokens"].inc(n_emitted)
+            itl = (time.perf_counter() - t_dispatch) / n_emitted
             m["decode_latency"].observe(
-                (time.perf_counter() - t_dispatch) / n_emitted,
+                itl,
                 exemplar=(tick_ctx.trace_id
                           if tick_ctx is not None else None))
+            # serving goodput (active-slot-tokens vs capacity) + the
+            # ITL regression sentinel; a degraded arena (router SLO
+            # lever / CPU-fallback run) never feeds a baseline
+            _profiling.goodput().note_tick(n_emitted, self.slots * kd)
+            _profiling.sentinel().observe(
+                f"serving.step[k={kd}]", self._backend(), itl,
+                kind="itl",
+                degraded=self.degraded or bool(os.environ.get(
+                    "PT_BENCH_CPU_FALLBACK")))
         # retired rows keep what _maybe_finish left (paged parking)
         keep = was_active & self.active
         cur_t = np.asarray(self.t)
@@ -1978,6 +2036,15 @@ class BatchedDecoder:
         # emit/budget/eos and one key chain, never two copies to keep
         # in lockstep
         return self._step_multi()
+
+    def _backend(self) -> str:
+        """First device's platform, resolved once (sentinel key)."""
+        name = getattr(self, "_backend_name", None)
+        if name is None:
+            devs = jax.devices()
+            name = devs[0].platform if devs else "unknown"
+            self._backend_name = name
+        return name
 
     def _maybe_finish(self, s: int):
         r = self.owner[s]

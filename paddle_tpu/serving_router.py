@@ -1240,6 +1240,31 @@ class Router:
         return {"trace_id": trace_id, "sources": sources,
                 "errors": errors, "trace": merged}
 
+    def profilez_fanout(self, body: bytes) -> Dict[str, Any]:
+        """Fleet device capture — the router's ``POST /profilez``: one
+        bounded capture in THIS process plus one per worker process,
+        all overlapping in time (the /tracez fan-out pattern with a
+        duration-sized timeout instead of the 2s scrape). A busy or
+        unreachable peer degrades to an ``errors`` row; the router's
+        own capture raising busy propagates (409 — the caller asked
+        this process and it said no)."""
+        from .telemetry import profiling as _profiling
+
+        seen = set()
+        urls: List[str] = []
+        peers = [st.replica for st in list(self._replicas.values())]
+        peers += list(self._prefill)
+        for rep in peers:
+            url = getattr(rep, "url", None)
+            if url is None or url in seen:
+                continue  # in-process replica: OUR capture covers it
+            seen.add(url)
+            urls.append(url)
+        local = _profiling.make_profilez()(body)
+        local["proc"] = "router"
+        return _profiling.profilez_fanout(urls, body,
+                                          local_result=local)
+
     def start_server(self, port: int = 0,
                      host: str = "127.0.0.1") -> _dbg_server.DebugServer:
         """Serve the router's own debug plane: /statusz gains a
@@ -1257,6 +1282,7 @@ class Router:
         srv.set_ready(lambda: bool(self._alive_names()))
         srv.add_post("/submit", self._http_submit)
         srv.add_post("/drain", self._http_drain)
+        srv.add_post("/profilez", self.profilez_fanout)
         srv.add_sse("/stream", self._http_stream)
         self.server = srv.start()
         return self.server
@@ -2152,6 +2178,12 @@ def run_worker(spec: Optional[str], role: str = "decode", port: int = 0,
         srv.add_post("/inject", _make_inject(rep))
     srv.add_post("/config", lambda b: _worker_config(rep, b))
     srv.add_post("/load", lambda b: rep.load())
+    # on-demand device capture for THIS worker process — the router's
+    # /profilez fans out here, so every process in the fleet lands its
+    # own XPlane artifact (plain handler, no fan-out: workers have no
+    # peers, hence no recursion)
+    from .telemetry import profiling as _profiling
+    srv.add_post("/profilez", _profiling.make_profilez())
     srv.add_post("/prefill", lambda b: (
         "application/octet-stream",
         rep.prefill(np.asarray(

@@ -18,6 +18,13 @@ at with its profiler (SURVEY §5.1). The pieces:
 - ``server``: debug HTTP endpoint on a daemon thread (/metrics /healthz
   /statusz /tracez /memz) — opt-in via ``TrainLoop.run(debug_port=)``,
   ``serving.BatchedDecoder.run(debug_port=)``, or ``server.start()``.
+- ``costs``: program cost ledger — XLA cost/memory analysis per cached
+  executable, MFU + arithmetic intensity + roofline verdict derivation
+  (per-backend peak table with a nominal CPU fallback row).
+- ``profiling``: goodput ledger (step-time bucket decomposition,
+  active-slot-tokens vs capacity), bounded on-demand device capture
+  (``POST /profilez``, 404→409→200), and the ``PT-PERF-80x``
+  step-time/ITL regression sentinel with persisted baselines.
 - ``diag``: device-memory monitor + :class:`FlightRecorder` (ring of
   recent steps, anomaly watch, atomic dump-on-anomaly bundles with a
   record/skip_step/halt policy).
@@ -42,8 +49,8 @@ Usage::
 
 from __future__ import annotations
 
-from . import (diag, export, lockwatch, metrics, recompile, server,
-               trace, tracing)
+from . import (costs, diag, export, lockwatch, metrics, profiling,
+               recompile, server, trace, tracing)
 from .diag import (AnomalyHalt, FlightRecorder, device_memory,
                    peak_memory_bytes)
 from .export import (openmetrics_text, prometheus_text, summary,
@@ -63,11 +70,11 @@ __all__ = [
     "FlightRecorder", "Gauge", "Histogram",
     "MetricsRegistry", "RecompileTracker", "RecordEvent", "Span",
     "TRACE_HEADER", "TraceContext", "TraceSpan",
-    "cached_instruments", "device_memory", "diag",
+    "cached_instruments", "costs", "device_memory", "diag",
     "disable", "enable", "enabled", "export", "export_chrome_trace",
     "export_jsonl", "fingerprint", "log_buckets",
     "lockwatch", "merge_chrome_trace", "metrics", "new_trace",
-    "openmetrics_text", "peak_memory_bytes",
+    "openmetrics_text", "peak_memory_bytes", "profiling",
     "prometheus_text", "recompile", "registry", "reset", "server",
     "span", "summary", "trace", "tracing", "write_textfile",
 ]
@@ -81,3 +88,5 @@ def reset() -> None:
     trace.reset()
     tracing.reset()
     recompile.tracker().reset()
+    costs.reset()
+    profiling.reset()

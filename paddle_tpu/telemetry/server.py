@@ -309,6 +309,28 @@ class DebugServer:
                    if isinstance(m, _metrics.Histogram) else None)
             if top:
                 exemplars[m.full_name] = top
+        # performance-attribution plane: the program cost ledger
+        # (FLOPs/HBM/roofline per cached executable), the goodput
+        # decomposition, and the regression sentinel's alarms
+        try:
+            from . import costs as _costs
+            from . import profiling as _profiling
+
+            costs = _costs.statusz_section()
+            goodput = _profiling.goodput().snapshot()
+            perf = _profiling.statusz_section()
+        except Exception as e:  # /statusz must render regardless
+            costs = goodput = perf = f"<costs status failed: {e!r}>"
+        # kernel tuning-table staleness (PT-TUNE-501): stale
+        # dtype-keyed entries visible without grepping logs (lazy
+        # import — pallas tuning must not load for a bare server)
+        try:
+            from ..ops.pallas import tuning as _tuning
+
+            tuning = {"stale_dtype_findings": [
+                str(d) for d in _tuning.stale_dtype_findings()]}
+        except Exception as e:
+            tuning = f"<tuning status failed: {e!r}>"
         return {
             "backend": devices[0].platform if devices else None,
             "device_count": len(devices),
@@ -324,6 +346,10 @@ class DebugServer:
             "tracing": _trace.tracing(),
             "recompile": _recompile.tracker().stats(),
             "resilience": resilience,
+            "costs": costs,
+            "goodput": goodput,
+            "perf": perf,
+            "tuning": tuning,
             "exemplars": exemplars,
             "status": status,
             "run_config": self.run_config,
@@ -545,9 +571,15 @@ def _make_handler(server: DebugServer):
                 # a handler error is the CALLER's problem (bad request,
                 # typed enforce failure) — answer 400 with the message;
                 # only transport breakage should look like a dead
-                # replica to a router's health check
+                # replica to a router's health check. A handler may
+                # carry its own status on the exception type (e.g.
+                # profiling.CaptureBusyError.http_status = 409 for the
+                # one-capture-in-flight contract).
                 try:
-                    self._send(400, json.dumps(
+                    code = getattr(type(e), "http_status", 400)
+                    if not (isinstance(code, int) and 400 <= code < 600):
+                        code = 400
+                    self._send(code, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}))
                 except Exception:
                     pass

@@ -13,6 +13,7 @@ Executor::Close-style graceful shutdown (join async checkpoint writers).
 from __future__ import annotations
 
 import contextlib
+import os
 import sys
 import threading
 import time
@@ -27,6 +28,8 @@ from .core.enforce import EnforceError, enforce
 from .resilience import faults as _faults
 from .resilience.controller import FleetController
 from .resilience.preemption import PreemptionHandler, _preempt_metrics
+from .telemetry import costs as _costs
+from .telemetry import profiling as _profiling
 from .telemetry import recompile as _recompile
 from .telemetry import server as _dbg_server
 from .telemetry import tracing as _tracing
@@ -179,6 +182,8 @@ class TrainLoop:
         self._recoveries_this_run = 0
         self._faulted = False
         self._last_loss_scale: Optional[float] = None
+        self._backend_name: Optional[str] = None
+        self._cost_registered = False
         self.debug_server = None  # set while run(debug_port=) is live
         # "idle" -> "running" -> "completed" | "preempted" | "faulted"
         self.status = "idle"
@@ -247,6 +252,35 @@ class TrainLoop:
             {"step": self.step, "rolled_back_to": restored,
              "error": why + " fell back past a corrupt step"})
         self.step = restored
+
+    def _backend(self) -> str:
+        """First device's platform, resolved once (sentinel key)."""
+        if self._backend_name is None:
+            import jax
+
+            devs = jax.devices()
+            self._backend_name = devs[0].platform if devs else "unknown"
+        return self._backend_name
+
+    def _register_step_cost(self, batch) -> None:
+        """One-shot cost-ledger registration of the dispatched step
+        program (telemetry is already known-on at the call site). The
+        extra lower().compile() rides the persistent compile cache —
+        same HLO as the executable the loop dispatches."""
+        tr = self.trainer
+        jf = getattr(tr, "_jit_step", None)
+        if jf is None:
+            return
+        plan = getattr(tr, "plan", None)
+        try:
+            _costs.ensure_program(
+                "train.step", jf,
+                (tr.params, tr.buffers, tr.opt_state, tr._rng, batch),
+                n_partitions=(plan.num_devices if plan is not None
+                              else 1),
+                origin="train_loop")
+        except Exception:
+            pass  # attribution must never fail a training step
 
     def _guard(self, loss) -> bool:
         """True if the step is clean; handles policy when not."""
@@ -451,6 +485,10 @@ class TrainLoop:
                                 "checkpoint_dir": self.manager.directory,
                                 "nan_policy": self.nan_policy,
                                 "num_steps": num_steps}).start()
+                # on-demand bounded device capture (404->409->200; the
+                # same handler the serving replicas mount)
+                self.debug_server.add_post(
+                    "/profilez", _profiling.make_profilez())
                 if hasattr(batches, "current_depth"):
                     # the input pipeline's live knob on /statusz
                     pf = batches
@@ -518,8 +556,28 @@ class TrainLoop:
             # lazily — a debug_port enables telemetry just above)
             run_trace = (_tracing.new_trace()
                          if telemetry.enabled() else None)
+            if telemetry.enabled():
+                # perf baselines live NEXT TO the checkpoints they
+                # describe (same lifecycle: a fresh run dir re-arms
+                # the sentinel; a resumed run alarms against the
+                # previous run's recorded step times)
+                _profiling.sentinel().attach(os.path.join(
+                    self.manager.directory, "perf_baselines.json"))
             rank = ctl.rank if ctl is not None else 0
-            for batch in batches:
+            self._cost_registered = False
+            batches_it = iter(batches)
+            while True:
+                # host-input-wait: time this step spends BLOCKED on the
+                # pipeline (goodput bucket 1); its own enabled() read —
+                # `telem` resolves further down
+                t_fetch = (time.perf_counter()
+                           if telemetry.enabled() else None)
+                try:
+                    batch = next(batches_it)
+                except StopIteration:
+                    break
+                input_wait = (time.perf_counter() - t_fetch
+                              if t_fetch is not None else 0.0)
                 if ctl is not None:
                     # fleet-coordinated preemption: check() is an Event
                     # peek + a throttled transport sample until a
@@ -557,6 +615,10 @@ class TrainLoop:
                 try:
                     with step_cm:
                         loss, metrics = self.trainer.train_step(batch)
+                    # dispatch stamp (goodput bucket 2): host time to
+                    # hand the step to the runtime — everything until
+                    # the loss fence below is device compute
+                    t_disp = time.perf_counter() if telem else None
                     if inj is not None and inj.fire("step.nan"):
                         # corrupt rule: poison the loss so the nan
                         # guard / recorder path runs deterministically
@@ -671,6 +733,23 @@ class TrainLoop:
                     # async-dispatch mirage
                     np.asarray(loss)
                     dt = time.perf_counter() - t0
+                    # performance attribution: register the step
+                    # program's cost once, split this step into goodput
+                    # buckets, and feed the regression sentinel (a
+                    # device-init-timeout CPU fallback is a degraded
+                    # row — it must never poison a chip baseline)
+                    if not self._cost_registered:
+                        self._cost_registered = True
+                        self._register_step_cost(batch)
+                    disp = (t_disp - t0) if t_disp is not None else 0.0
+                    _profiling.goodput().note_step(
+                        input_wait=input_wait, dispatch=disp,
+                        device_compute=max(0.0, dt - disp))
+                    _profiling.sentinel().observe(
+                        "train.step", self._backend(), dt,
+                        degraded=bool(os.environ.get(
+                            "PT_BENCH_CPU_FALLBACK")))
+                    _costs.observe_step("train.step", dt)
                     tmet = _train_metrics()
                     tmet["steps"].inc()
                     tmet["step_time"].observe(dt)
@@ -709,7 +788,14 @@ class TrainLoop:
                     on_step(self.step, loss, metrics)
                 if self.checkpoint_every and \
                         self.step % self.checkpoint_every == 0:
+                    t_ck = time.perf_counter() if telem else None
                     self.manager.save(self.step, self.trainer.state())
+                    if t_ck is not None:
+                        # goodput bucket 4: save() host time (async
+                        # writers make this small; a sync save or a
+                        # staging stall shows up here)
+                        _profiling.goodput().note_checkpoint_stall(
+                            time.perf_counter() - t_ck)
                     if ctl is not None:
                         ctl.note_checkpoint(self.step)
             if ctl is not None and self.status == "running" and \
@@ -725,6 +811,11 @@ class TrainLoop:
             self.status = "faulted"
             raise
         finally:
+            if telemetry.enabled():
+                # persist the sentinel's rolling baselines next to the
+                # checkpoints (attach() above set the path; a run that
+                # never enabled telemetry has nothing to write)
+                _profiling.sentinel().save()
             if self.debug_server is not None:
                 # joined before run() returns: no leaked daemon thread
                 # (the object stays on self for post-run inspection)

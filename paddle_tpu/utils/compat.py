@@ -78,6 +78,33 @@ def cost_analysis(compiled):
     return cost
 
 
+def memory_analysis(compiled):
+    """``compiled.memory_analysis()`` normalized to ONE plain dict of the
+    fields the cost ledger records (peak temp/argument/output/generated
+    bytes). Backends without the analysis (CPU on some jax versions, the
+    axon tunnel) return None from the method or raise — either way the
+    caller gets ``{}``, never an exception. New jax returns an object
+    with ``*_size_in_bytes`` attributes, some versions a dict; both are
+    flattened to the same keys."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if mem is None:
+        return {}
+    if isinstance(mem, dict):
+        return dict(mem)
+    out = {}
+    for field in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes",
+                  "host_temp_size_in_bytes"):
+        val = getattr(mem, field, None)
+        if val is not None:
+            out[field] = int(val)
+    return out
+
+
 def supports_partial_manual_shard_map() -> bool:
     """Whether shard_map's partial-auto mode (manual over a SUBSET of mesh
     axes, the rest left to GSPMD — the pipeline pp ring's compile mode) can

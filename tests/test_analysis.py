@@ -639,6 +639,69 @@ class TestLint:
               "    return QuantizedPool(q, s)\n")
         assert lint_source(mk, "paddle_tpu/serving.py") == []
 
+    def test_unfenced_timing_delta_flagged_fenced_clean(self):
+        """PT-LINT-309: a perf_counter delta around a jitted dispatch
+        with no device fence before the stop-stamp measures dispatch,
+        not compute (the async-dispatch mirage)."""
+        src = ("import time, jax\n"
+               "def bench(f, x):\n"
+               "    g = jax.jit(f)\n"
+               "    t0 = time.perf_counter()\n"
+               "    out = g(x)\n"
+               "    t1 = time.perf_counter()\n"
+               "    return t1 - t0\n")
+        diags = lint_source(src, "x.py")
+        assert [d.code for d in diags] == ["PT-LINT-309"]
+        assert diags[0].line == 7
+        # clean twin: block_until_ready fences before the stop stamp
+        clean = ("import time, jax\n"
+                 "def bench(f, x):\n"
+                 "    g = jax.jit(f)\n"
+                 "    t0 = time.perf_counter()\n"
+                 "    out = g(x)\n"
+                 "    jax.block_until_ready(out)\n"
+                 "    t1 = time.perf_counter()\n"
+                 "    return t1 - t0\n")
+        assert lint_source(clean, "x.py") == []
+
+    def test_unfenced_timing_fence_forms_and_direct_dispatch(self):
+        # float(loss) inside the timed loop is a fence; a direct
+        # jax.jit(f)(x) dispatch with no fence flags
+        looped = ("import time, jax\n"
+                  "def run(step, batches):\n"
+                  "    s = jax.jit(step)\n"
+                  "    t0 = time.perf_counter()\n"
+                  "    for b in batches:\n"
+                  "        loss = s(b)\n"
+                  "        total = float(loss)\n"
+                  "    dt = time.perf_counter() - t0\n"
+                  "    return dt\n")
+        assert lint_source(looped, "x.py") == []
+        direct = ("import time, jax\n"
+                  "def bench(f, x):\n"
+                  "    t0 = time.perf_counter()\n"
+                  "    y = jax.jit(f)(x)\n"
+                  "    dt = time.perf_counter() - t0\n"
+                  "    return dt\n")
+        diags = lint_source(direct, "x.py")
+        assert [d.code for d in diags] == ["PT-LINT-309"]
+
+    def test_unfenced_timing_local_fence_helper_recognized(self):
+        """A file-local helper whose body fences (the bench.py idiom:
+        ``def _fence(out): float(jax.device_get(out))``) counts as a
+        fence at its call sites — the dogfood false-positive class."""
+        src = ("import time, jax\n"
+               "def _fence(out):\n"
+               "    float(jax.device_get(out))\n"
+               "def bench(f, x):\n"
+               "    g = jax.jit(f)\n"
+               "    t0 = time.perf_counter()\n"
+               "    out = g(x)\n"
+               "    _fence(out)\n"
+               "    dt = time.perf_counter() - t0\n"
+               "    return dt\n")
+        assert lint_source(src, "x.py") == []
+
     def test_unparsable_file_is_a_finding(self):
         diags = lint_source("def f(:\n", "broken.py")
         assert len(diags) == 1 and "does not parse" in diags[0].message

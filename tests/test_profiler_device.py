@@ -1,0 +1,137 @@
+"""Device-capture steering: telemetry.trace start/stop_profiler's
+jax.profiler handoff + the fluid.profiler.cuda_profiler shim.
+
+The host-span machinery has tests in test_telemetry.py; the DEVICE
+side (``device_trace_dir=`` -> ``jax.profiler.start_trace`` /
+``stop_trace``) had none — these are its first. One test runs a REAL
+XPlane capture (jax's profiler works on the CPU backend), the rest pin
+the steering contract with a recording fake so the shims can't silently
+stop forwarding.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.telemetry import trace as ttrace
+
+
+class _FakeProfiler:
+    """Records start_trace/stop_trace calls in place of jax.profiler."""
+
+    def __init__(self):
+        self.started = []
+        self.stopped = 0
+
+    def start_trace(self, log_dir):
+        self.started.append(log_dir)
+
+    def stop_trace(self):
+        self.stopped += 1
+
+    class TraceAnnotation:
+        """No-op stand-in — Span wraps itself in one while collecting."""
+
+        def __init__(self, name):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    return fake
+
+
+def test_real_device_capture_lands_xplane_artifact(tmp_path):
+    """start_profiler(device_trace_dir=...) + jitted work + stop ->
+    a real XPlane artifact on disk (CPU backend captures too)."""
+    out = str(tmp_path / "xplane")
+    ttrace.start_profiler(device_trace_dir=out)
+    try:
+        x = jnp.ones((32, 32))
+        jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    finally:
+        events = ttrace.stop_profiler(device_trace=True)
+    assert isinstance(events, list)
+    artifacts = glob.glob(os.path.join(out, "**", "*.xplane.pb"),
+                          recursive=True)
+    assert artifacts, f"no xplane artifact under {out}"
+
+
+def test_start_profiler_steers_device_trace(fake_profiler):
+    ttrace.start_profiler(device_trace_dir="/tmp/dev-trace")
+    ttrace.stop_profiler(device_trace=True)
+    assert fake_profiler.started == ["/tmp/dev-trace"]
+    assert fake_profiler.stopped == 1
+
+
+def test_start_profiler_without_dir_skips_device_trace(fake_profiler):
+    ttrace.start_profiler()
+    ttrace.stop_profiler()
+    assert fake_profiler.started == []
+    assert fake_profiler.stopped == 0
+
+
+def test_profiler_context_steers_device_trace(fake_profiler, tmp_path):
+    timeline = str(tmp_path / "timeline.json")
+    with ttrace.profiler(timeline_path=timeline,
+                         device_trace_dir="/tmp/ctx-trace"):
+        with ttrace.span("inside"):
+            pass
+    assert fake_profiler.started == ["/tmp/ctx-trace"]
+    assert fake_profiler.stopped == 1
+    assert os.path.exists(timeline)  # host timeline rides along
+
+
+def test_fluid_cuda_profiler_steers_device_trace(fake_profiler):
+    from paddle_tpu.fluid import profiler as fluid_profiler
+
+    with fluid_profiler.cuda_profiler(output_file="/tmp/cuda-compat"):
+        pass
+    assert fake_profiler.started == ["/tmp/cuda-compat"]
+    assert fake_profiler.stopped == 1
+
+
+def test_fluid_cuda_profiler_without_output_is_host_only(fake_profiler):
+    from paddle_tpu.fluid import profiler as fluid_profiler
+
+    with fluid_profiler.cuda_profiler():
+        pass
+    assert fake_profiler.started == []
+    assert fake_profiler.stopped == 0
+
+
+def test_fluid_shim_parity_with_core_and_trace():
+    """The three import surfaces expose the SAME objects — a shim that
+    forks its own Span/start_profiler would split the event list."""
+    import importlib
+
+    core = importlib.import_module("paddle_tpu.core.profiler")
+    fluid_prof = importlib.import_module("paddle_tpu.fluid.profiler")
+    assert core.RecordEvent is ttrace.Span
+    assert fluid_prof.RecordEvent is ttrace.Span
+    assert fluid_prof.start_profiler is ttrace.start_profiler
+    assert fluid_prof.stop_profiler is ttrace.stop_profiler
+    assert core._events is ttrace._events  # in-place-mutation invariant
+
+
+def test_fluid_reset_profiler_drops_core_events():
+    ttrace.start_profiler()
+    try:
+        with ttrace.span("doomed"):
+            pass
+        from paddle_tpu.fluid import profiler as fluid_profiler
+
+        fluid_profiler.reset_profiler()
+    finally:
+        assert ttrace.stop_profiler() == []

@@ -14,6 +14,7 @@ import signal
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -596,6 +597,57 @@ def _worker_env():
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     return env
+
+
+@pytest.mark.slow
+def test_routed_profilez_one_capture_per_process(tmp_path):
+    """POST /profilez against a routed 2-worker fleet: the router's
+    fan-out returns one REAL XPlane capture per process (router + both
+    workers, three distinct pids), and a worker mid-capture answers a
+    second direct POST with 409 (one concurrent capture per process)."""
+    reps = spawn_replicas("bench:_router_replica_spec", 2,
+                          spec_kw={"smoke": True},
+                          log_dir=str(tmp_path), env=_worker_env())
+    router = Router(reps, poll_interval_s=0.05)
+    try:
+        body = json.dumps({"duration_ms": 300}).encode()
+        out = router.profilez_fanout(body)
+        assert out["errors"] == {}, out["errors"]
+        assert out["fleet"] == 3  # router + 2 workers
+        pids = [c["pid"] for c in out["captures"]]
+        assert len(set(pids)) == 3, pids
+        assert os.getpid() in pids  # the router's own capture
+        # every artifact the local process wrote is a real directory;
+        # worker artifacts live in the WORKER's filesystem namespace
+        # (same host here) — all must exist and be complete (atomic
+        # rename means existing == capture finished)
+        for c in out["captures"]:
+            assert os.path.isdir(c["artifact"]), c
+        # 409-while-busy, pinned against a live worker: hold a slow
+        # capture on reps[0], then race a second direct POST into it
+        slow = json.dumps({"duration_ms": 1500}).encode()
+        errs = []
+
+        def hold():
+            req = urllib.request.Request(
+                reps[0].url + "/profilez", data=slow,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                errs.append(r.status)
+
+        t = threading.Thread(target=hold, name="pt-test-profilez")
+        t.start()
+        time.sleep(0.4)  # the slow capture is now holding the lock
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req = urllib.request.Request(
+                reps[0].url + "/profilez", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 409
+        t.join(timeout=30)
+        assert errs == [200]  # the held capture itself completed
+    finally:
+        router.close(replicas=True)
 
 
 @pytest.mark.slow

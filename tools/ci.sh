@@ -60,6 +60,33 @@ dtype keys)"
       -q -k "quantized_kernel or gather_upto" || exit $?
     JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_decode.py \
       -q -k "dtype_key" || exit $?
+    # bench diff smoke: the session-vs-history comparator on a crafted
+    # 3-row session — a clean row compares, a >10% drop sets exit 1,
+    # and a cpu_fallback row is EXCLUDED (the BENCH_r05 pollution
+    # class must fail loudly here before it misreads a real session)
+    stage "bench diff smoke (tools/bench_diff.py on crafted rows)"
+    JAX_PLATFORMS=cpu python -c "
+import json, subprocess, sys, tempfile, os
+hist = {'a_tp': {'value': 100.0}, 'b_tp': {'value': 100.0},
+        'c_tp': {'value': 100.0}}
+rows = '\n'.join(json.dumps(r) for r in [
+    {'metric': 'a_tp', 'value': 99.0, 'unit': 'x/s', 'backend': 'tpu'},
+    {'metric': 'b_tp', 'value': 50.0, 'unit': 'x/s', 'backend': 'tpu'},
+    {'metric': 'c_tp', 'value': 40.0, 'unit': 'x/s',
+     'backend': 'cpu_fallback', 'backend_degraded': True}])
+with tempfile.TemporaryDirectory() as d:
+    hp, sp = os.path.join(d, 'h.json'), os.path.join(d, 's.log')
+    open(hp, 'w').write(json.dumps(hist))
+    open(sp, 'w').write(rows)
+    p = subprocess.run([sys.executable, 'tools/bench_diff.py', sp,
+                        '--history', hp, '--format', 'json'],
+                       capture_output=True, text=True)
+    rep = json.loads(p.stdout)
+    assert p.returncode == 1, p.returncode     # b_tp regressed
+    assert rep['regressions'] == ['b_tp'], rep
+    assert [e['metric'] for e in rep['excluded']] == ['c_tp'], rep
+print('bench diff smoke ok')
+" || exit $?
     ;;
 esac
 

@@ -43,6 +43,8 @@ MODULES = [
     "paddle_tpu.autoscale",
     "paddle_tpu.aot",
     "paddle_tpu.analysis",
+    "paddle_tpu.telemetry.costs",
+    "paddle_tpu.telemetry.profiling",
     "paddle_tpu.train_loop",
     "paddle_tpu.slim",
     "paddle_tpu.utils",
