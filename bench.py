@@ -1107,6 +1107,148 @@ def _piecewise_open_loop(router, prompts, max_new: int, phases, rng,
     return tickets, time.perf_counter() - t0
 
 
+def _gray_failure_ab(spec_kw, smoke):
+    """The ``--gray-failure`` A/B: the SAME seeded open-loop trace
+    against a 3-replica fleet, three arms —
+
+    1. ``clean``: no fault, reliability plane on (the baseline the
+       gate compares against);
+    2. ``off``: one replica wedged ~10x slow (a seeded
+       ``replica.wedge`` delay rule — the in-process SIGSTOP/GC-stall
+       stand-in) with NO reliability plane: the counterfactual,
+       recorded unasserted — requests keep landing on the gray
+       replica and its queue melts the tail;
+    3. ``on``: the same wedge with the reliability plane on —
+       dispatch-latency EWMA + queue outlier trip the breaker, the
+       victim leaves placement, stuck in-flight work hedges to a
+       healthy replica.
+
+    Gate (ISSUE 20 acceptance): arm 3's p99 TTFT <= 1.5x arm 1's
+    (plus a small absolute slack — an 18-sample p99 is nearly a max
+    across separately-timed arms), and the victim was actually
+    quarantined. Arm 2 rides along as evidence, never asserted."""
+    from paddle_tpu.core.enforce import enforce
+    from paddle_tpu.resilience import ReliabilityConfig
+    from paddle_tpu.resilience.faults import FaultInjector
+    from paddle_tpu.serving_router import LocalReplica, Router
+
+    n_rep = 3
+    n_req = 18 if smoke else 36
+    max_new = 6 if smoke else 8
+    wedge_s = 0.12  # per-tick freeze: ~10x a warm CPU serve tick
+    vocab = 1024 if smoke else 50257
+    reps = [LocalReplica(_router_replica_spec(**spec_kw),
+                         name=f"g{i}").start() for i in range(n_rep)]
+    victim = reps[-1].name
+
+    def mk_prompts(n, seed):
+        r = np.random.default_rng(seed)
+        return [r.integers(1, vocab,
+                           (int(8 + (i * 5) % 16),)).astype(np.int32)
+                for i in range(n)]
+
+    def drive(rep, rids, timeout_s=600.0):
+        deadline = time.time() + timeout_s
+        seen = {}
+        while time.time() < deadline:
+            seen.update(rep.drain_results())
+            if all(r in seen for r in rids):
+                return seen
+            time.sleep(0.01)
+        raise TimeoutError(f"replica {rep.name}: warm requests "
+                           f"incomplete after {timeout_s}s")
+
+    def rel_cfg():
+        # hedging arms after 6 fleet completions (the run is short);
+        # the cooldown parks the victim for the whole arm — a mid-run
+        # half-open probe against a still-wedged replica would only
+        # churn the placement the gate is measuring
+        return ReliabilityConfig(hedge_min_samples=6,
+                                 quarantine_cooldown_s=600.0)
+
+    try:
+        # warm every jit path the load will hit (all prompts pad into
+        # the short bucket; max_new covers the step)
+        for rep in reps:
+            drive(rep, [rep.submit(p, 2)
+                        for p in (mk_prompts(1, 99)[0],
+                                  np.ones(24, np.int32))])
+        # rate calibration: one replica's closed-loop service rate;
+        # 0.8x of it across a 3-replica fleet keeps the healthy
+        # majority unloaded, so the tail movement IS the gray replica
+        cal = mk_prompts(8, 1)
+        t0 = time.perf_counter()
+        drive(reps[0], [reps[0].submit(p, max_new) for p in cal])
+        rate = 0.8 * len(cal) / (time.perf_counter() - t0)
+
+        # arm 1: clean fleet, reliability on
+        router = Router(reps, poll_interval_s=0.02,
+                        reliability=rel_cfg())
+        clean = _arm_stats(*_open_loop(
+            router, mk_prompts(n_req, 7), max_new, rate,
+            np.random.default_rng(300)))
+        router.close()
+
+        # arm 2: wedged victim, NO reliability (the counterfactual)
+        with FaultInjector().on("replica.wedge", delay_s=wedge_s,
+                                match=victim):
+            router = Router(reps, poll_interval_s=0.02)
+            off = _arm_stats(*_open_loop(
+                router, mk_prompts(n_req, 7), max_new, rate,
+                np.random.default_rng(300)))
+            router.close()
+
+        # arm 3: the same wedge, reliability on
+        with FaultInjector().on("replica.wedge", delay_s=wedge_s,
+                                match=victim):
+            router = Router(reps, poll_interval_s=0.02,
+                            reliability=rel_cfg())
+            on_tickets, on_wall = _open_loop(
+                router, mk_prompts(n_req, 7), max_new, rate,
+                np.random.default_rng(300))
+            stats = router.stats()
+            router.close()
+        on = _arm_stats(on_tickets, on_wall)
+
+        # -- the gates -------------------------------------------------
+        enforce(victim in (stats.get("quarantined") or []),
+                "the wedged replica %s was never quarantined "
+                "(quarantined=%s)", victim, stats.get("quarantined"))
+        enforce(on["ttft_p99_ms"]
+                <= 1.5 * clean["ttft_p99_ms"] + 250.0,
+                "reliability-on p99 TTFT %.1f ms under one wedged "
+                "replica blew the clean-arm bound %.1f ms (clean "
+                "%.1f ms)", on["ttft_p99_ms"],
+                1.5 * clean["ttft_p99_ms"] + 250.0,
+                clean["ttft_p99_ms"])
+    finally:
+        for rep in reps:
+            rep.close()
+
+    rel = stats.get("reliability") or {}
+    extras = dict(on)
+    extras.update({
+        "replicas": n_rep,
+        "rate_rps": round(rate, 3),
+        "gray_wedge_s": wedge_s,
+        "gray_clean_ttft_p50_ms": clean["ttft_p50_ms"],
+        "gray_clean_ttft_p99_ms": clean["ttft_p99_ms"],
+        "gray_clean_itl_p99_ms": clean["itl_p99_ms"],
+        "gray_clean_tokps": clean["tokps"],
+        # the counterfactual, recorded but never asserted: CPU timing
+        # noise must not flake the gate, the blowup speaks for itself
+        "gray_off_ttft_p99_ms": off["ttft_p99_ms"],
+        "gray_off_itl_p99_ms": off["itl_p99_ms"],
+        "gray_off_tokps": off["tokps"],
+        "gray_on_ttft_p99_ms": on["ttft_p99_ms"],
+        "gray_hedges": rel.get("hedges"),
+        "gray_hedge_wins": rel.get("hedge_wins"),
+        "gray_quarantines": rel.get("quarantines"),
+        "gray_retry_budget": (rel.get("budget") or {}).get("tokens"),
+    })
+    return extras.pop("tokps"), "tokens/sec", extras
+
+
 def _autoscale_spike_ab(spec_kw, autoscale, smoke):
     """The ``--autoscale MIN,MAX`` A/B: the SAME seeded spiky trace
     (base rate, a 3x spike, base again) against two fleets —
@@ -1361,7 +1503,7 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
                      prefill_workers: int = 1, overload: float = 2.0,
                      kv_dtype=None, router_procs: bool = False,
                      stream: bool = False, from_artifact: bool = False,
-                     autoscale=None):
+                     autoscale=None, gray_failure: bool = False):
     """Production-serving A/B (serving_router.Router): a seeded Poisson
     OPEN-loop load with long prompts mixed in, three arms on the same
     replicas —
@@ -1392,6 +1534,11 @@ def bench_gpt_router(steps: int, batch_size: int, amp=None,
         return _autoscale_spike_ab({"smoke": smoke,
                                     "kv_dtype": kv_dtype},
                                    autoscale, smoke)
+    if gray_failure:
+        # the gray-failure reliability A/B likewise: one wedged
+        # replica, three arms, its own gate
+        return _gray_failure_ab({"smoke": smoke,
+                                 "kv_dtype": kv_dtype}, smoke)
 
     n_req = 18 if smoke else max(18, min(steps, 48))
     long_len, max_new = (112, 8) if smoke else (192, 16)
@@ -2644,6 +2791,9 @@ def run_config_fingerprint(metric: str, args, steps: int):
         "router_autoscale": (
             getattr(args, "autoscale", None)
             if getattr(args, "router", False) else None),
+        "router_gray_failure": (
+            True if getattr(args, "router", False)
+            and getattr(args, "gray_failure", False) else None),
         "layout": args.layout, "dp": args.dp, "infer": args.infer,
     }
     # None = knob not set; False values (e.g. --no-fused-ce) are REAL
@@ -2874,6 +3024,14 @@ def main():
                     "seeded 3x spike and draining back on sustained "
                     "headroom, gated on SLO at strictly fewer "
                     "replica-seconds")
+    ap.add_argument("--gray-failure", dest="gray_failure",
+                    action="store_true",
+                    help="--router: replace the disagg arms with the "
+                    "gray-failure reliability A/B — one replica "
+                    "wedged ~10x slow (seeded replica.wedge delay), "
+                    "clean vs reliability-off vs reliability-on arms; "
+                    "gates quarantine + bounded p99 TTFT with the "
+                    "plane on (_gray history key)")
     ap.add_argument("--from-artifact", dest="from_artifact",
                     action="store_true",
                     help="--router: add the AOT cold-start A/B — "
@@ -2988,6 +3146,19 @@ def main():
                         f"{amin},{amax}")
             return
         autoscale = (amin, amax)
+    if args.gray_failure:
+        if not args.router:
+            _emit_error(f"{args.model}_throughput",
+                        "--gray-failure only applies with --router "
+                        "(the reliability A/B)")
+            return
+        if (args.stream or args.from_artifact or args.router_procs
+                or autoscale):
+            _emit_error(f"{args.model}_throughput",
+                        "--gray-failure is its own workload: drop "
+                        "--stream/--from-artifact/--router-procs/"
+                        "--autoscale")
+            return
     if args.router:
         if args.model != "gpt_serve":
             _emit_error(f"{args.model}_throughput",
@@ -3014,6 +3185,10 @@ def main():
             # the elastic-fleet spike A/B is its own workload
             # (piecewise rate, fleet size varies): own key per band
             metric += f"_as{autoscale[0]}x{autoscale[1]}"
+        if args.gray_failure:
+            # the gray-failure A/B is its own workload (wedged
+            # replica, three arms): own key
+            metric += "_gray"
     if (args.vocab and "vocab" in sig
             and args.vocab != sig["vocab"].default):
         metric += f"_v{args.vocab}"
@@ -3266,6 +3441,7 @@ def main():
         kwargs["stream"] = args.stream
         kwargs["from_artifact"] = args.from_artifact
         kwargs["autoscale"] = autoscale
+        kwargs["gray_failure"] = args.gray_failure
     if args.prefill_chunk and "prefill_chunk" in sig:
         kwargs["prefill_chunk"] = args.prefill_chunk
     if (args.decode_steps and args.decode_steps > 1
@@ -3399,7 +3575,25 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                     else "recorded baseline")
         print(f"WARNING: {metric} regressed >10% vs best recorded "
               f"({value:.2f} vs {prev_str} {unit})", file=sys.stderr)
+    # regression-sentinel tie-in: arm from the LAST session's recorded
+    # timings (the reserved "_sentinel" history section — underscore
+    # keys never collide with metric names, the _superseded precedent),
+    # feed this run's measured step time, and persist the updated
+    # baselines back. A fresh bench session alarms on step-time drift
+    # against the previous session instead of needing min_samples
+    # warmup runs of its own.
+    from paddle_tpu.telemetry import profiling as _profiling
+
+    _profiling.seed_sentinel_from_history(history_path)
+    perf_diag = None
+    st_ms = extras.get("step_time_ms")
+    if st_ms:
+        perf_diag = _profiling.sentinel().observe(
+            metric, device.platform, float(st_ms) / 1e3,
+            degraded=bool(os.environ.get("PT_BENCH_CPU_FALLBACK")))
     if not smoke and on_accelerator:
+        history[_profiling.SENTINEL_HISTORY_KEY] = (
+            _profiling.sentinel_history_entry())
         # CPU debug runs never pollute the recorded trajectory
         with open(history_path, "w") as f:
             json.dump(history, f, indent=1)
@@ -3449,6 +3643,10 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                                   # scale-event/TTFR evidence
                                   "replica_", "autoscale_",
                                   "static_", "spike_",
+                                  # reliability plane: the
+                                  # gray-failure A/B's three-arm
+                                  # comparison + breaker evidence
+                                  "gray_",
                                   # sharded-embedding plane: wire
                                   # payload vs dense counterfactual,
                                   # host-cache hit rate, table rows
@@ -3515,6 +3713,11 @@ def report_line(metric, value, unit, extras, *, history_path, smoke,
                     line["mfu_audit"] = "ledger_mismatch"
     if regression:
         line["regression"] = True
+    if perf_diag is not None:
+        # the sentinel's step-TIME alarm rides the JSON line next to
+        # the throughput regression flag (different denominators — a
+        # batch-size change can move one without the other)
+        line["perf_regression"] = str(perf_diag)
     return line
 
 

@@ -24,6 +24,13 @@ were each paid for with a real bug class (codes in ``diagnostics.py``):
   source invalidates them (the PR 6 snapshot SIGSEGV class).
 - **PT-LINT-305** — leftover debug hooks: ``jax.debug.print``,
   ``jax.debug.breakpoint``, ``breakpoint()``, ``pdb.set_trace()``.
+- **PT-LINT-310** — a ``urllib.request.urlopen`` /
+  ``socket.create_connection`` call without an explicit ``timeout=``
+  in the serving/telemetry/resilience/autoscale planes: an unbounded
+  network wait on a gray peer (socket accepted, then silence) hangs
+  the caller forever — exactly the failure mode the reliability
+  plane's quarantine breaker exists to contain. Every hop carries its
+  own bound.
 - **PT-LINT-309** — a ``time.perf_counter()`` / ``time.time()`` delta
   taken around a jitted/compiled dispatch with no device fence before
   the stop-stamp: jax dispatch is async, so the delta times the Python
@@ -65,6 +72,8 @@ LINT_CODES = {
                    "ops/paged_kv.py",
     "PT-LINT-309": "timing delta around a jitted dispatch with no "
                    "device fence before the stop-stamp",
+    "PT-LINT-310": "network call without an explicit timeout= in a "
+                   "serving/telemetry/resilience module",
 }
 
 # callees whose arguments get donated (this repo's donating entry
@@ -110,6 +119,14 @@ TRACE_MARKERS = {"_trace_headers", "trace_headers", "to_header",
 # streaming) and touch the trace-header surface (echo X-PT-Trace) so
 # the stream stays on the request's trace.
 SSE_CONTENT_TYPE = "text/event-stream"
+
+# PT-LINT-310 (bounded network I/O) applies to the planes that talk to
+# possibly-gray peers: serving, telemetry, resilience, autoscale. A
+# urlopen/create_connection there without timeout= waits forever on a
+# wedged peer — the hang the reliability breaker quarantines, baked
+# into a client call it can't see.
+TIMEOUT_FILES = ("serving.py", "serving_router.py")
+TIMEOUT_DIRS = ("/telemetry/", "/resilience/", "/autoscale/")
 
 # PT-LINT-308: ops/paged_kv.py is THE storage-form dispatch boundary —
 # attend() unpacks a QuantizedPool into raw (values, scales) arrays
@@ -169,6 +186,9 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         norm = path.replace("\\", "/")
         self._trace_file = any(norm.endswith(f) for f in TRACE_FILES)
+        self._timeout_file = (
+            norm.endswith(TIMEOUT_FILES)
+            or any(d in "/" + norm for d in TIMEOUT_DIRS))
         self._pool_dispatch_file = norm.endswith(POOL_DISPATCH_FILE)
         self.findings: List[Diagnostic] = []
         self._fence_fns: Set[str] = set()
@@ -539,6 +559,34 @@ class _Linter(ast.NodeVisitor):
                 "build headers through _trace_headers(...) (or stamp "
                 "tracing.current().to_header() onto "
                 "tracing.TRACE_HEADER)")
+
+        # PT-LINT-310: unbounded network I/O in the serving/telemetry/
+        # resilience/autoscale planes. urlopen's timeout is also its
+        # 3rd positional; create_connection's its 2nd — either form
+        # counts as bounded.
+        if self._timeout_file:
+            unbounded = None
+            if (callee == "urlopen" and len(node.args) < 3
+                    and not any(kw.arg == "timeout"
+                                for kw in node.keywords)):
+                unbounded = "urlopen"
+            elif (callee == "create_connection"
+                    and dotted in ("socket.create_connection",
+                                   "create_connection")
+                    and len(node.args) < 2
+                    and not any(kw.arg == "timeout"
+                                for kw in node.keywords)):
+                unbounded = "socket.create_connection"
+            if unbounded:
+                self._flag(
+                    "PT-LINT-310", node,
+                    f"{unbounded}() without an explicit timeout= in "
+                    f"a serving/telemetry/resilience module: an "
+                    f"unbounded wait on a gray peer hangs this "
+                    f"caller forever",
+                    "pass timeout=<seconds> — bound every hop; the "
+                    "reliability plane can only quarantine hangs it "
+                    "can observe")
 
         # PT-LINT-308: isinstance(x, QuantizedPool) outside the one
         # dispatch boundary — storage-form branches belong to

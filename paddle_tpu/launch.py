@@ -422,6 +422,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "signals (queue wait, load factor, sheds); "
                     "scale-ups pre-warm from --from-artifact when "
                     "given")
+    ap.add_argument("--reliability", action="store_true",
+                    help="--serve: turn on the request reliability "
+                    "plane — end-to-end deadlines from the SLO "
+                    "class, SRE retry budgets, hedged dispatch past "
+                    "the adaptive p95, and gray-failure quarantine "
+                    "(circuit breaker + half-open probes)")
+    ap.add_argument("--deadline-s", dest="deadline_s", type=float,
+                    default=None,
+                    help="--serve: fixed end-to-end request deadline "
+                    "in seconds (implies --reliability; default "
+                    "derives per-request budgets from the SLO "
+                    "class's target TTFT)")
     ap.add_argument("script", nargs="?", default=None,
                     help="training script to run per rank (omitted "
                     "with --serve)")
@@ -443,6 +455,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ap.error(f"--autoscale must be MIN,MAX, got "
                          f"{args.autoscale!r}")
             autoscale = (int(parts[0]), int(parts[1]))
+        reliability = None
+        if args.reliability or args.deadline_s is not None:
+            from .resilience import ReliabilityConfig
+
+            reliability = ReliabilityConfig(deadline_s=args.deadline_s)
         router = serve_main(
             args.spec, replicas=args.nproc,
             prefill_workers=args.prefill_workers, port=args.port,
@@ -451,12 +468,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             dispatch=args.dispatch,
             prefix_hash_tokens=args.prefix_hash_tokens or None,
             from_artifact=args.from_artifact,
-            autoscale=autoscale)
+            autoscale=autoscale, reliability=reliability)
         print(f"[launch] router serving on {router.server.url()} over "
               f"{args.nproc} replica(s) + {args.prefill_workers} "
               f"prefill worker(s)"
               + (f", autoscaling {autoscale[0]}..{autoscale[1]}"
-                 if autoscale else ""), file=sys.stderr)
+                 if autoscale else "")
+              + (", reliability plane on" if reliability else ""),
+              file=sys.stderr)
         import threading as _threading
 
         stop = _threading.Event()

@@ -19,6 +19,12 @@ plane of PR 4 can *see* a failure; this one *survives* it):
   ``ckpt.commit``, ``restore.read``, ``step.nan``, ``io.slow``,
   ``fleet.notice``) — the substrate of the chaos test suite. Off by
   default with zero hot-path cost.
+- ``reliability``: the request reliability plane — end-to-end
+  :class:`Deadline` budgets (minted at ``Router.submit``, propagated
+  via ``X-PT-Deadline`` beside the trace header and through
+  ``KVHandoff``), SRE-style :class:`RetryBudget` token buckets,
+  adaptive hedged dispatch, and per-replica gray-failure circuit
+  breakers (:class:`ReplicaHealth`: closed → open → half-open probe).
 - ``controller``: the elastic fleet controller —
   :class:`FleetController` agrees "preempt at step N" across ranks
   over the coordination transport, makes every PERIODIC save a
@@ -43,20 +49,27 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import controller, faults, integrity, preemption, retry
+from . import controller, faults, integrity, preemption, reliability, retry
 from .controller import (BarrierTimeoutError, FileNotice,
                          FleetController, HttpNotice)
 from .faults import POINTS, FaultError, FaultInjector
 from .integrity import ChecksumError, checksum_bytes, verify_bytes
 from .preemption import PreemptionHandler
+from .reliability import (DEADLINE_HEADER, Deadline, DeadlineExceededError,
+                          LatencyTracker, ReliabilityConfig,
+                          ReliabilityPlane, ReplicaHealth, RetryBudget,
+                          RetryBudgetExhaustedError)
 from .retry import DEFAULT_POLICY, RetryPolicy, retry_io
 
 __all__ = [
-    "BarrierTimeoutError", "ChecksumError", "DEFAULT_POLICY",
-    "FaultError", "FaultInjector", "FileNotice", "FleetController",
-    "HttpNotice", "POINTS", "PreemptionHandler", "RetryPolicy",
-    "checksum_bytes", "controller", "faults", "integrity",
-    "preemption", "retry", "retry_io", "statusz", "verify_bytes",
+    "BarrierTimeoutError", "ChecksumError", "DEADLINE_HEADER",
+    "DEFAULT_POLICY", "Deadline", "DeadlineExceededError", "FaultError",
+    "FaultInjector", "FileNotice", "FleetController", "HttpNotice",
+    "LatencyTracker", "POINTS", "PreemptionHandler", "ReliabilityConfig",
+    "ReliabilityPlane", "ReplicaHealth", "RetryBudget",
+    "RetryBudgetExhaustedError", "RetryPolicy", "checksum_bytes",
+    "controller", "faults", "integrity", "preemption", "reliability",
+    "retry", "retry_io", "statusz", "verify_bytes",
 ]
 
 

@@ -46,6 +46,14 @@ Injection points (:data:`POINTS`):
 - ``autoscale.drain`` the scaler's scale-down, fired before the drain
   begins (``path`` = the victim replica's name) — delay rules widen
   the SIGKILL-mid-drain window for the chaos e2e
+- ``router.latency`` the router's per-request replica submit (``path``
+  = the replica name) — a seeded ``delay_s`` rule matched to ONE
+  replica simulates a gray (slow-but-alive) replica deterministically;
+  the delay lands inside the router's dispatch-latency measurement, so
+  hedging and quarantine see it exactly like a real stall
+- ``replica.wedge`` the local replica's serve-loop tick (``path`` =
+  the replica name) — a ``delay_s`` rule freezes the decode loop
+  mid-stream, the in-process stand-in for SIGSTOP
 """
 
 from __future__ import annotations
@@ -61,7 +69,7 @@ from ..core.enforce import enforce
 POINTS = ("ckpt.write", "ckpt.manifest", "ckpt.stage", "ckpt.commit",
           "restore.read", "step.nan", "io.slow", "fleet.notice",
           "router.dispatch", "lock.acquire", "autoscale.spawn",
-          "autoscale.drain")
+          "autoscale.drain", "router.latency", "replica.wedge")
 
 _ACTIVE: Optional["FaultInjector"] = None
 _LOCK = threading.Lock()

@@ -54,6 +54,7 @@ from .core.enforce import enforce
 __all__ = ["BatchedDecoder", "PagedKVPool", "Request", "KVHandoff",
            "TokenStream", "reject_cause"]
 from .nn.layer import inject_state
+from .resilience import reliability as _reliability
 from .ops import paged_kv as paged_ops
 from .ops.sampling import sample_from_logits
 from .telemetry import costs as _costs
@@ -98,13 +99,16 @@ def _serving_metrics(reg):
         # cause-labeled split of the same total (unlabeled series kept
         # for dashboard compat): pool_exhausted = paged admission
         # deferred on page exhaustion, capacity = hard queue-depth cap,
-        # shed = SLO load-shed (router-side policy)
+        # shed = SLO load-shed (router-side policy), deadline =
+        # end-to-end deadline expired before/while serving (the
+        # reliability plane's typed drop — never silently computed)
         "rejections_by_cause": {
             cause: reg.counter(
                 "pt_serving_admission_rejections_total",
                 "admissions rejected or deferred, by cause",
                 labels={"cause": cause})
-            for cause in ("pool_exhausted", "capacity", "shed")},
+            for cause in ("pool_exhausted", "capacity", "shed",
+                          "deadline")},
         "page_occupancy": reg.gauge(
             "pt_serving_page_occupancy_ratio",
             "allocated fraction of the KV page pool"),
@@ -503,7 +507,8 @@ class KVHandoff:
     ``from_bytes`` are the npz wire format the HTTP handoff uses."""
 
     def __init__(self, prompt, plen: int, logits, blocks,
-                 page_size: int, kv_dtype=None, trace=None):
+                 page_size: int, kv_dtype=None, trace=None,
+                 deadline=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.plen = int(plen)
         self.logits = np.asarray(logits, np.float32)
@@ -514,6 +519,10 @@ class KVHandoff:
         # wire form: in-process disaggregation hands the producer's
         # context straight to the decode replica — no HTTP header hop
         self.trace = trace
+        # end-to-end deadline (resilience.reliability.Deadline) riding
+        # the same wire: the decode replica inherits the REQUEST's
+        # remaining budget, not a fresh per-hop one
+        self.deadline = deadline
 
     @property
     def pages(self) -> int:
@@ -552,6 +561,9 @@ class KVHandoff:
         if self.trace is not None:
             # the trace context crosses the wire in header form
             arrays["trace"] = np.asarray(self.trace.to_header())
+        if self.deadline is not None:
+            # absolute wall-clock epoch — meaningful across processes
+            arrays["deadline"] = np.asarray(self.deadline.to_header())
         for side, name in ((0, "k"), (1, "v")):
             payload = stack(side)
             if quant:
@@ -578,9 +590,11 @@ class KVHandoff:
                       for i in range(z["k"].shape[0])]
         trace = (_tracing.from_header(str(z["trace"]))
                  if "trace" in z.files else None)
+        deadline = (_reliability.Deadline.from_header(str(z["deadline"]))
+                    if "deadline" in z.files else None)
         return KVHandoff(z["prompt"], plen, z["logits"], blocks,
                          page_size, "int8" if quant else None,
-                         trace=trace)
+                         trace=trace, deadline=deadline)
 
 
 class Request:
@@ -598,6 +612,8 @@ class Request:
         self.handoff: Optional[KVHandoff] = None  # pre-filled KV pages
         self.trace = None  # TraceContext (telemetry on + traced hop)
         self.stream: Optional[TokenStream] = None  # per-token sink
+        self.deadline = None  # reliability.Deadline (router-minted)
+        self.deadline_exceeded = False  # dropped typed, never computed
 
 
 class BatchedDecoder:
@@ -821,6 +837,10 @@ class BatchedDecoder:
         # status inspection)
         self.preempted = False  # last run() exited on a grace signal
         # (in-flight drained; self.queue holds the unserved remainder)
+        # slot-resident requests carrying a deadline: the per-tick
+        # expiry sweep is gated on this count, so an undeadlined run
+        # (reliability off) executes no deadline code per tick
+        self._dl_active = 0
 
     # ----- host API --------------------------------------------------------
 
@@ -856,6 +876,10 @@ class BatchedDecoder:
                     need, self._allocator.pages)
         self._next_rid += 1
         r.t_submit = time.perf_counter()
+        # ambient end-to-end deadline (the router's dispatch / the
+        # debug server's POST edge binds it — one contextvar read, the
+        # reliability analog of the telemetry enabled-flag gate)
+        r.deadline = _reliability.current()
         if telemetry.enabled():
             _serving_metrics()["requests"].inc()
             if stream is not None:
@@ -1120,6 +1144,12 @@ class BatchedDecoder:
         wire format; contiguous arenas chunk-prefill locally instead)."""
         enforce(self.paged, "prefill_export requires paged mode "
                 "(pages=N) — the handoff payload is KV pages")
+        # deadline check BEFORE the prefill compute: an expired request
+        # must never burn device work (the typed-drop contract)
+        dl = _reliability.current()
+        if dl is not None and dl.expired():
+            reject_cause("deadline")
+            dl.check("prefill export")  # raises DeadlineExceededError
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         plen = len(prompt)
         enforce(plen >= 1, "empty prompt")
@@ -1161,7 +1191,8 @@ class BatchedDecoder:
                             if al.kv_dtype else np.asarray(got))
                     blocks.append(tuple(payload))
                 return KVHandoff(prompt, plen, np.asarray(logits),
-                                 blocks, ps, al.kv_dtype, trace=ctx)
+                                 blocks, ps, al.kv_dtype, trace=ctx,
+                                 deadline=dl)
         finally:
             self._allocator.free(ids)
 
@@ -1208,6 +1239,10 @@ class BatchedDecoder:
         r.stream = stream
         self._next_rid += 1
         r.t_submit = time.perf_counter()
+        # the handoff carries the REQUEST's deadline (absolute epoch —
+        # remaining budget, not a per-hop reset); a bound ambient
+        # deadline wins, same precedence as the trace context below
+        r.deadline = _reliability.current() or handoff.deadline
         if telemetry.enabled():
             _serving_metrics()["requests"].inc()
             if stream is not None:
@@ -1560,6 +1595,16 @@ class BatchedDecoder:
                     or not self.queue):
                 continue
             r = self.queue.pop(0)
+            # a request that expired while QUEUED is dropped typed
+            # before any prefill work — never silently computed
+            while r.deadline is not None and r.deadline.expired():
+                self._expire_request(r, where="queue")
+                if not self.queue:
+                    r = None
+                    break
+                r = self.queue.pop(0)
+            if r is None:
+                break
             plen = len(r.prompt)
             lb = self._bucket_len(plen)
             padded = np.zeros((lb,), np.int32)
@@ -1571,6 +1616,10 @@ class BatchedDecoder:
                     reject_cause("pool_exhausted")
                     self.queue.insert(0, r)
                     break
+            if r.deadline is not None:
+                # slot-resident from here on: the per-tick expiry
+                # sweep (gated on this count) owns the deadline now
+                self._dl_active += 1
             self.owner[s] = r
             self._slot_gen[s] = self.gen_count
             self.gen_count += 1
@@ -2028,6 +2077,13 @@ class BatchedDecoder:
             np.where(keep, new_t, np.asarray(self.t)).astype(np.int32))
 
     def _step(self):
+        if self._dl_active:
+            # per-decode-tick deadline check (tentpole contract): an
+            # expired slot is torn down BEFORE the next dispatch, so
+            # no device tick is ever spent on a request nobody is
+            # waiting for. Gated on the count — zero per-tick cost
+            # while no slot-resident request carries a deadline.
+            self._expire_slots()
         if self.draft is not None and not self.degraded:
             return self._step_spec()
         # k == 1 rides the same generalized scan path (length-1 scan,
@@ -2045,6 +2101,54 @@ class BatchedDecoder:
             name = devs[0].platform if devs else "unknown"
             self._backend_name = name
         return name
+
+    def _expire_request(self, r: Request, where: str = "queue") -> None:
+        """Drop an expired request TYPED (cause-labeled shed): a done
+        record with ``deadline_exceeded`` set and no tokens — the drain
+        wire carries the flag so the router fails the ticket with
+        :class:`~paddle_tpu.resilience.reliability.DeadlineExceededError`
+        instead of inventing a result."""
+        reject_cause("deadline")
+        r.result = None
+        r.deadline_exceeded = True
+        r.t_done = time.perf_counter()
+        self.done[r.rid] = r
+        if r.stream is not None:
+            r.stream.fail(_reliability.DeadlineExceededError(
+                f"request {r.rid} deadline expired in {where}"))
+        if (telemetry.enabled() and r.trace is not None
+                and r.trace.sampled):
+            _tracing.event("serve.deadline_exceeded", ctx=r.trace,
+                           rid=r.rid, where=where)
+
+    def _expire_slots(self) -> None:
+        """Tear down every slot-resident request whose deadline passed
+        (active slots AND parked chunked-prefill slots)."""
+        now = time.time()
+        for s in range(self.slots):
+            st = self._pf[s]
+            r = st["r"] if st is not None else self.owner[s]
+            if r is None or r.deadline is None:
+                continue
+            if now < r.deadline.t_end:
+                continue
+            self._expire_request(
+                r, where="prefill" if st is not None else "decode")
+            self._dl_active -= 1
+            if st is not None:
+                self._pf[s] = None
+                self._pf_order.remove(s)
+            self.owner[s] = None
+            self._slot_trace[s] = None
+            self.active[s] = False
+            self.emitted[s] = []
+            if self.paged and self._slot_pages[s] is not None:
+                # freed pages may be REALLOCATED: park the cursor past
+                # capacity so the retired slot's stale writes drop
+                # (same argument as _maybe_finish's teardown)
+                self._allocator.free(self._slot_pages[s])
+                self._slot_pages[s] = None
+                self.t = self.t.at[s].set(self.capacity)
 
     def _maybe_finish(self, s: int):
         r = self.owner[s]
@@ -2065,6 +2169,8 @@ class BatchedDecoder:
                                    rid=r.rid,
                                    n_tokens=len(r.result),
                                    eos=bool(hit_eos))
+            if r.deadline is not None:
+                self._dl_active -= 1
             self.owner[s] = None
             self._slot_trace[s] = None
             self.active[s] = False

@@ -91,6 +91,7 @@ import numpy as np
 
 from . import telemetry
 from .core.enforce import EnforceError, enforce
+from .resilience import reliability as _reliability
 from .serving import BatchedDecoder, KVHandoff, TokenStream, reject_cause
 from .telemetry import server as _dbg_server
 from .telemetry import tracing as _tracing
@@ -102,11 +103,17 @@ def _trace_headers(base: Dict[str, str]) -> Dict[str, str]:
     """Stamp the bound trace context onto outbound HTTP headers — the
     ONE helper every cross-process hop in this file rides (pt-lint
     PT-LINT-306 flags HTTP POSTs here that skip it). No-op when
-    telemetry is off or no sampled context is bound."""
+    telemetry is off or no sampled context is bound. The bound
+    end-to-end deadline rides the SAME helper (``X-PT-Deadline`` beside
+    ``X-PT-Trace``) — but deadlines are a CORRECTNESS header, stamped
+    whether or not telemetry is on."""
     if telemetry.enabled():
         ctx = _tracing.current()
         if ctx is not None and ctx.sampled:
             base[_tracing.TRACE_HEADER] = ctx.to_header()
+    dl = _reliability.current()
+    if dl is not None:
+        base[_reliability.DEADLINE_HEADER] = dl.to_header()
     return base
 
 __all__ = ["Router", "SLOPolicy", "LocalReplica", "HttpReplica",
@@ -165,6 +172,27 @@ class _LRU:
 
     def __len__(self) -> int:
         return len(self._d)
+
+
+def _swallow(fn, *args) -> None:
+    """Run a fire-and-forget call, discarding any outcome (the hedge
+    loser's best-effort cancel: a wedged loser may time out — that
+    must never surface anywhere)."""
+    try:
+        fn(*args)
+    except Exception:
+        pass
+
+
+def _is_timeout_error(e: BaseException) -> bool:
+    """Gray-vs-dead discriminator for transport errors: a TIMEOUT
+    (socket accepted, then silence — the SIGSTOP/GC-stall signature)
+    feeds the circuit breaker; anything else (connection refused,
+    reset) is the plain-death path. urllib wraps socket timeouts in
+    URLError, so check ``.reason`` too."""
+    if isinstance(e, TimeoutError):
+        return True
+    return isinstance(getattr(e, "reason", None), TimeoutError)
 
 
 class NoReplicasError(EnforceError):
@@ -236,6 +264,27 @@ def _router_metrics(reg):
                 "serving replica cold starts by boot mode",
                 labels={"mode": mode})
             for mode in ("aot", "traced", "traced_fallback")},
+        # -- reliability plane (Router(reliability=...)) ----------------
+        "deadline_exceeded": reg.counter(
+            "pt_deadline_exceeded_total",
+            "requests dropped router-side because their end-to-end "
+            "deadline expired (pre-dispatch, on requeue, or reported "
+            "back by a replica)"),
+        "retry_budget_exhausted": reg.counter(
+            "pt_retry_budget_exhausted_total",
+            "request failures surfaced UN-retried: the retry token "
+            "bucket was dry (retry-storm brake)"),
+        "hedges": {
+            won: reg.counter(
+                "pt_hedges_total",
+                "hedged dispatches by outcome (won=true: the hedge's "
+                "result completed the request before the primary's)",
+                labels={"won": won})
+            for won in ("true", "false")},
+        "quarantines": reg.counter(
+            "pt_replica_quarantines_total",
+            "replicas quarantined by the gray-failure circuit "
+            "breaker (left placement but kept draining)"),
     }
 
 
@@ -264,13 +313,19 @@ class SLOPolicy:
 
     def __init__(self, target_ttft_s: Optional[float] = None,
                  degrade_at: float = 1.5, shed_at: float = 3.0,
-                 classes: Optional[Dict[str, "SLOPolicy"]] = None):
+                 classes: Optional[Dict[str, "SLOPolicy"]] = None,
+                 deadline_s: Optional[float] = None):
         enforce(shed_at >= degrade_at,
                 "shed_at %s < degrade_at %s (shedding is the deeper "
                 "degradation)", shed_at, degrade_at)
         self.target_ttft_s = target_ttft_s
         self.degrade_at = float(degrade_at)
         self.shed_at = float(shed_at)
+        # per-class END-TO-END deadline budget (reliability plane):
+        # requests admitted under this class get a Deadline minted with
+        # this budget; None defers to the ReliabilityConfig default
+        # (deadline_s, else deadline_factor x target_ttft_s)
+        self.deadline_s = deadline_s
         # per-model SLO classes (multi-model routing): model id ->
         # its own policy; unlisted models (and untagged requests) use
         # THIS policy's ladder as the fleet-wide default
@@ -465,6 +520,20 @@ class LocalReplica:
                 self._done.clear()
             return out
 
+    def cancel(self, rid: int) -> bool:
+        """Best-effort cancel (the hedge loser's path): drop ``rid``
+        from the arena queue if it has not been admitted to a slot yet
+        — an admitted request runs to completion and its result is
+        simply discarded (greedy decode is bounded by max_new, so the
+        waste is bounded too). Returns True when dequeued."""
+        with self._mu:
+            q = self.decoder.queue
+            for i, r in enumerate(q):
+                if r.rid == rid:
+                    del q[i]
+                    return True
+        return False
+
     def set_degraded(self, on: bool) -> None:
         with self._mu:
             self.decoder.set_degraded(on)
@@ -498,11 +567,31 @@ class LocalReplica:
         busy = bool(d.queue or d._pf_order or d.active.any())
         if not busy:
             return False
+        from .resilience import faults as _faults
+        inj = _faults.active()
+        if inj is not None:
+            # chaos point replica.wedge: a delay_s rule freezes THIS
+            # serve tick — the in-process stand-in for SIGSTOP (only
+            # fired while busy, so the idle loop doesn't burn the
+            # schedule clock)
+            inj.fire("replica.wedge", path=self.name)
         d._admit()
         d._prefill_tick()
         d._step()
         if d.done:
             for rid, r in d.done.items():
+                if getattr(r, "deadline_exceeded", False) \
+                        or r.result is None:
+                    # expired in the arena (queue/prefill/decode sweep):
+                    # the record carries the typed cause, never a fake
+                    # token list
+                    self._done[rid] = {
+                        "tokens": None, "ttft_s": None,
+                        "itl_p99_s": None, "t_first": r.t_first,
+                        "t_done": r.t_done, "n_tokens": 0,
+                        "deadline_exceeded": True,
+                    }
+                    continue
                 ts = r.t_tokens
                 itl = np.diff(ts) if len(ts) > 1 else np.asarray([0.0])
                 self._done[rid] = {
@@ -619,9 +708,17 @@ class HttpReplica:
 
     def drain_results(self) -> Dict[int, Dict]:
         out = self._post_json("/drain", {})
-        return {int(rid): {**rec, "tokens": np.asarray(
-            rec["tokens"], np.int32)}
+        # tokens=None marks a replica-side deadline expiry (typed
+        # record, never a fake token list) — keep it None, don't cast
+        return {int(rid): {**rec, "tokens": (
+            np.asarray(rec["tokens"], np.int32)
+            if rec.get("tokens") is not None else None)}
             for rid, rec in out["done"].items()}
+
+    def cancel(self, rid: int) -> bool:
+        """Best-effort cancel of a queued request (hedge loser)."""
+        out = self._post_json("/cancel", {"rid": int(rid)})
+        return bool(out.get("cancelled"))
 
     def set_degraded(self, on: bool) -> None:
         self._post_json("/config", {"degraded": bool(on)})
@@ -678,6 +775,14 @@ class Ticket:
         self.disaggregated = False
         self.stolen = False  # pull dispatch ignored a placement hint
         self.prefix: Optional[int] = None  # prefix-hash routing key
+        # reliability plane: end-to-end deadline minted at admission
+        # (None = unbudgeted), and hedged-dispatch state — the hedge's
+        # (replica, rid) pair so the first result wins and the loser's
+        # in-flight entry can be dropped + best-effort cancelled
+        self.deadline = None
+        self.hedged = False
+        self.hedge_replica: Optional[str] = None
+        self.hedge_rid: Optional[int] = None
         self.stream: Optional[TokenStream] = None  # client-side sink
         self.t_first_stream: Optional[float] = None
         self._stream_next = 0  # next token index to deliver (dedupe
@@ -716,6 +821,11 @@ class _ReplicaState:
         # lanes exit
         self.draining = False
         self.removed = False
+        # quarantined: the gray-failure breaker tripped — placement
+        # stops exactly like draining (fail-closed), but the state is
+        # REVERSIBLE: a successful half-open probe returns the replica
+        # to rotation. In-flight work keeps draining meanwhile.
+        self.quarantined = False
         self.claimed = 0  # pulled off the queue, not yet registered
         self.fails = 0
         self.load: Dict[str, Any] = {"queue_depth": 0,
@@ -761,7 +871,8 @@ class Router:
                  affinity_max_sessions: int = 4096,
                  prefix_hash_tokens: Optional[int] = 64,
                  prefix_homes_max: int = 4096,
-                 stream_buffer: int = 256):
+                 stream_buffer: int = 256,
+                 reliability=None):
         enforce(len(replicas) >= 1, "router needs >= 1 replica")
         enforce(dispatch in ("pull", "push"),
                 'dispatch must be "pull" (work-stealing replica pull) '
@@ -770,6 +881,25 @@ class Router:
         enforce(prefix_hash_tokens is None or prefix_hash_tokens >= 1,
                 "prefix_hash_tokens must be None or >= 1, got %s",
                 prefix_hash_tokens)
+        # reliability plane (deadlines / retry budget / hedging /
+        # quarantine): OFF by default — self._rel is None and the hot
+        # path keeps only `is None` checks (the telemetry-off
+        # discipline, pinned by the zero-cost tripwire test).
+        # Accepts True (defaults), a ReliabilityConfig, or a
+        # pre-built ReliabilityPlane.
+        if reliability is None or reliability is False:
+            self._rel = None
+        elif reliability is True:
+            self._rel = _reliability.ReliabilityPlane()
+        elif isinstance(reliability, _reliability.ReliabilityPlane):
+            self._rel = reliability
+        elif isinstance(reliability, _reliability.ReliabilityConfig):
+            self._rel = _reliability.ReliabilityPlane(reliability)
+        else:
+            raise EnforceError(
+                "reliability= must be None/False, True, a "
+                "ReliabilityConfig, or a ReliabilityPlane, got "
+                f"{type(reliability).__name__}")
         self._replicas: Dict[str, _ReplicaState] = {}
         for r in replicas:
             enforce(r.name not in self._replicas,
@@ -920,6 +1050,19 @@ class Router:
             # prompt hash alike and hint at the replica whose prefix
             # cache already holds those pages
             t.prefix = prefix_hash(t.prompt, self.prefix_hash_tokens)
+        if self._rel is not None:
+            # the end-to-end Deadline is MINTED here — admission is
+            # the one edge every request crosses exactly once (the
+            # trace-mint discipline); budget priority: the SLO class's
+            # deadline_s, then the config default, then
+            # deadline_factor x the class target TTFT
+            pol = (self.policy.resolve(model)
+                   if self.policy is not None else None)
+            t.deadline = self._rel.deadline_for(
+                target_ttft_s=(None if pol is None
+                               else pol.target_ttft_s),
+                budget_s=(None if pol is None
+                          else getattr(pol, "deadline_s", None)))
         if telemetry.enabled():
             _router_metrics()["requests"].inc()
             # the trace is MINTED here — admission is the one edge
@@ -1013,6 +1156,10 @@ class Router:
                 "queued_by_model": dict(self._queued_by),
                 "degraded_by": {str(k): v for k, v in
                                 self._degraded_by.items() if v},
+                "quarantined": [n for n, st in self._replicas.items()
+                                if st.alive and st.quarantined],
+                "reliability": (self._rel.statusz()
+                                if self._rel is not None else None),
             }
 
     def _prefix_stats(self) -> Dict[str, Any]:
@@ -1056,8 +1203,12 @@ class Router:
         The scaler records these rows verbatim as its replayable
         signal trace, so the snapshot IS the policy's whole world."""
         with self._mu:
+            # a quarantined replica is NOT capacity: the autoscaler
+            # must read quarantine as lost slots (and may scale up to
+            # cover it) exactly like a draining replica
             live = [st for st in self._replicas.values()
-                    if st.alive and not st.draining]
+                    if st.alive and not st.draining
+                    and not st.quarantined]
             ready = sum(1 for st in live if st.ready)
             slots = sum(max(1, int(st.load.get("slots", 1) or 1))
                         for st in live if st.ready)
@@ -1073,6 +1224,8 @@ class Router:
                 "warming": len(live) - ready,
                 "draining": sum(1 for st in self._replicas.values()
                                 if st.alive and st.draining),
+                "quarantined": sum(1 for st in self._replicas.values()
+                                   if st.alive and st.quarantined),
                 "shed_total": self._shed_count,
                 "served_total": self._served_count,
             }
@@ -1370,12 +1523,12 @@ class Router:
     # -- policy -------------------------------------------------------------
 
     def _alive_names(self, model: Optional[str] = None) -> List[str]:
-        # PLACEABLE names: alive and not draining — a draining replica
-        # finishes what it holds but must never receive new work, and
-        # every can-this-ticket-ever-be-served check shares this
-        # definition (fail-closed scale-down)
+        # PLACEABLE names: alive, not draining, not quarantined — a
+        # draining/quarantined replica finishes what it holds but must
+        # never receive new work, and every can-this-ticket-ever-be-
+        # served check shares this definition (fail-closed)
         return [n for n, st in self._replicas.items()
-                if st.alive and not st.draining
+                if st.alive and not st.draining and not st.quarantined
                 and (model is None or st.model == model)]
 
     @staticmethod
@@ -1416,6 +1569,7 @@ class Router:
             slots = sum(st.load.get("slots", 1)
                         for st in self._replicas.values()
                         if st.alive and not st.draining
+                        and not st.quarantined
                         and (model is None or st.model == model))
             ewma = self._ewma_ttft
             wait = self._ewma_wait
@@ -1457,14 +1611,14 @@ class Router:
                 if name is not None:
                     st = self._replicas.get(name)
                     if (st is not None and st.alive and st.ready
-                            and not st.draining
+                            and not st.draining and not st.quarantined
                             and self._model_ok(st, t)):
                         return st
 
             def pick(require_ready: bool):
                 best, best_load = None, None
                 for st in self._replicas.values():
-                    if (not st.alive or st.draining
+                    if (not st.alive or st.draining or st.quarantined
                             or (require_ready and not st.ready)):
                         continue
                     if not self._model_ok(st, t):
@@ -1489,6 +1643,23 @@ class Router:
             t.stream.fail(err)
         t.done.set()
 
+    def _deadline_fail(self, t: Ticket, where: str) -> None:
+        """Drop an expired request typed + counted (caller guarantees
+        the ticket is still in pre-dispatch accounting)."""
+        with self._mu:
+            self._q_adj(t, -1)
+            if self._rel is not None:
+                self._rel.deadline_exceeded += 1
+        if telemetry.enabled():
+            _router_metrics()["deadline_exceeded"].inc()
+            _tracing.event("router.deadline_exceeded", ctx=t.trace,
+                           rid=t.rid, where=where)
+        over = (-t.deadline.remaining() * 1e3
+                if t.deadline is not None else 0.0)
+        self._fail_ticket(t, _reliability.DeadlineExceededError(
+            f"request {t.rid} deadline expired {where} "
+            f"({over:.1f} ms past budget)"))
+
     # -- pull dispatch (work stealing) --------------------------------------
 
     def _hint_for(self, t: Ticket):
@@ -1504,7 +1675,7 @@ class Router:
             if name is not None:
                 st = self._replicas.get(name)
                 if (st is not None and st.alive and st.ready
-                        and not st.draining
+                        and not st.draining and not st.quarantined
                         and self._model_ok(st, t)):
                     return name, True
         if t.prefix is not None:
@@ -1512,7 +1683,7 @@ class Router:
             if name is not None:
                 st = self._replicas.get(name)
                 if (st is not None and st.alive and st.ready
-                        and not st.draining
+                        and not st.draining and not st.quarantined
                         and self._model_ok(st, t)):
                     return name, False
         return None, False
@@ -1529,10 +1700,11 @@ class Router:
         racing lanes can't over-claim past the slot cap. Caller holds
         self._work."""
         if (self._stop.is_set() or not st.alive or st.draining
-                or st.removed):
+                or st.removed or st.quarantined):
             return None
         if not st.ready and any(
                 s.alive and s.ready and not s.draining
+                and not s.quarantined
                 for s in self._replicas.values()):
             # cold replica with warm peers available: don't pull —
             # but an all-cold fleet still serves (bring-up)
@@ -1647,13 +1819,18 @@ class Router:
         # this dispatch span, and a retry re-enters here with the
         # SAME trace id (retry count annotated)
         cm_bind = _tracing.bind(t.trace) if telem else _NULL_CM
+        # the deadline binds beside the trace: in-process replica
+        # submits read it via reliability.current(), HTTP hops stamp
+        # X-PT-Deadline through _trace_headers
+        cm_dl = (_reliability.bind(t.deadline)
+                 if t.deadline is not None else _NULL_CM)
         cm_span = (_tracing.span("router.dispatch", ctx=t.trace,
                                  rid=t.rid,
                                  replica=st.replica.name,
                                  retry=t.retries, stolen=stolen)
                    if telem else _NULL_CM)
         try:
-            with cm_bind, cm_span:
+            with cm_bind, cm_dl, cm_span:
                 self._dispatch_on(t, st, telem)
         finally:
             if claimed:
@@ -1666,6 +1843,13 @@ class Router:
                      telem: bool) -> None:
         from .resilience import faults as _faults
 
+        if t.deadline is not None and t.deadline.expired():
+            # the pre-dispatch tripwire: an expired request NEVER
+            # reaches a replica (no device work is ever dispatched
+            # for it) — it dies here, typed and counted
+            self._deadline_fail(t, where="before dispatch")
+            return
+        t0 = time.perf_counter()
         try:
             inj = _faults.active()
             if inj is not None:
@@ -1713,6 +1897,13 @@ class Router:
             # streaming plane keep working un-streamed
             kw = ({"session": t.session, "stream": True}
                   if t.stream is not None else {"session": t.session})
+            if inj is not None:
+                # chaos point router.latency: a seeded delay_s rule
+                # matched to ONE replica simulates a gray (slow-but-
+                # alive) replica — fired INSIDE the t0 window, so the
+                # injected stall lands in the measured dispatch
+                # latency exactly like a real one
+                inj.fire("router.latency", path=st.replica.name)
             if handoff is not None:
                 rid = st.replica.inject(handoff, t.max_new, **kw)
             else:
@@ -1726,10 +1917,22 @@ class Router:
             return
         except Exception:
             # transport/dispatch failure: fail the replica over and
-            # retry the request on a survivor
+            # retry the request on a survivor. A TIMEOUT additionally
+            # feeds the gray-failure score first — consecutive
+            # timeouts are a breaker signal
+            if self._rel is not None:
+                with self._mu:
+                    self._rel.health(st.name).note_timeout()
             self._fail_replica(st, reason=repr(sys.exc_info()[1]))
             self._requeue(t)
             return
+        if self._rel is not None:
+            # dispatch latency (submit round-trip incl. any injected
+            # gray stall) feeds the per-replica breaker EWMA — the
+            # latency-outlier-vs-fleet-median quarantine signal
+            with self._mu:
+                self._rel.health(st.name).note_latency(
+                    time.perf_counter() - t0)
         t.t_dispatched = time.perf_counter()
         t.replica, t.replica_rid = st.replica.name, rid
         wait = max(0.0, t.t_dispatched - t.t_submit)
@@ -1756,7 +1959,7 @@ class Router:
         if t.stream is not None and rec is None:
             self._start_pump(t, st)
         if rec is not None:
-            self._finish(t, rec)
+            self._finish(t, rec, replica=st.name)
         if telemetry.enabled():
             _router_metrics()["queue_wait"].observe(
                 wait,
@@ -1772,6 +1975,27 @@ class Router:
         record on the SAME trace id: tokens already delivered stay
         valid — greedy re-decode is deterministic and the new pump
         skips past the delivered index."""
+        if t.deadline is not None and t.deadline.expired():
+            # no point retrying work nobody is waiting for — and an
+            # expired retry must never spend retry-budget tokens
+            self._deadline_fail(t, where="on requeue")
+            return
+        if self._rel is not None and not self._rel.budget.take():
+            # retry budget dry: degrade to ONE typed failure instead
+            # of amplifying a replica failure into a retry storm
+            with self._mu:
+                self._q_adj(t, -1)
+            if telemetry.enabled():
+                _router_metrics()["retry_budget_exhausted"].inc()
+                _tracing.event("router.retry_budget_exhausted",
+                               ctx=t.trace, rid=t.rid,
+                               retries=t.retries)
+            self._fail_ticket(
+                t, _reliability.RetryBudgetExhaustedError(
+                    f"request {t.rid} failed on replica {t.replica} "
+                    f"and the retry budget is exhausted "
+                    f"({self._rel.budget.snapshot()})"))
+            return
         t.retries += 1
         prev = t.replica
         t.replica = t.replica_rid = None
@@ -1899,7 +2123,30 @@ class Router:
             st.ready = bool(hz.get("ready", True))
             if not st.alive:
                 st.alive = True  # answered again: recovered
-        except Exception:
+        except Exception as e:
+            if self._rel is not None and st.alive \
+                    and _is_timeout_error(e):
+                # a TIMEOUT is the gray-failure signature (the process
+                # accepted the connection, then went silent — SIGSTOP,
+                # GC stall, compile storm); a refused connection is
+                # plain death. Feed the breaker; once it trips, the
+                # half-open probe owns recovery — don't ALSO count the
+                # replica toward health-fail death while quarantined
+                with self._mu:
+                    h = self._rel.health(st.name)
+                    h.note_timeout()
+                    reason = self._rel.quarantine_reason(h)
+                if reason is not None and not st.quarantined:
+                    self._maybe_quarantine(st, reason)
+                if st.quarantined:
+                    return
+                if reason is None:
+                    # breaker still counting consecutive timeouts:
+                    # not death yet (health_fails would otherwise
+                    # race the breaker and always win)
+                    return
+                # trip declined (last placeable replica): fall through
+                # to ordinary death accounting
             st.fails += 1
             if st.fails >= self.health_fails and st.alive:
                 self._fail_replica(st, reason="health check failed "
@@ -1949,8 +2196,42 @@ class Router:
                 "any could claim it"
                 + (f" (model {lt.model!r})" if lt.model else "")))
 
-    def _finish(self, t: Ticket, rec: Dict) -> None:
-        """Complete a ticket from its replica-side result record."""
+    def _finish(self, t: Ticket, rec: Dict,
+                replica: Optional[str] = None) -> None:
+        """Complete a ticket from its replica-side result record.
+        ``replica``: which replica produced the record — the hedge
+        winner/loser discriminator. First result wins; a later record
+        for a done ticket is discarded here (the hedge-loser path)."""
+        with self._mu:
+            if t.done.is_set():
+                return  # hedge loser / duplicate record: already won
+        if t.hedged:
+            self._resolve_hedge(t, replica)
+        if rec.get("deadline_exceeded") or rec.get("tokens") is None:
+            # the replica's arena dropped it at the per-tick deadline
+            # sweep: surface the SAME typed error the router-side
+            # drops use (never a fake token list)
+            if self._rel is not None:
+                with self._mu:
+                    self._rel.deadline_exceeded += 1
+            if telemetry.enabled():
+                _router_metrics()["deadline_exceeded"].inc()
+                _tracing.event("router.deadline_exceeded",
+                               ctx=t.trace, rid=t.rid,
+                               where=f"on replica "
+                                     f"{replica or t.replica}")
+            self._fail_ticket(t, _reliability.DeadlineExceededError(
+                f"request {t.rid} deadline expired on replica "
+                f"{replica or t.replica}"))
+            return
+        if self._rel is not None:
+            # a completed request refills the retry budget (the SRE
+            # fraction-of-successes rule) and its dispatch→done
+            # latency feeds the adaptive hedge threshold
+            self._rel.budget.note_success()
+            if t.t_dispatched:
+                self._rel.latency.observe(
+                    time.perf_counter() - t.t_dispatched)
         t.tokens = np.asarray(rec["tokens"], np.int32)
         with self._mu:
             # claim under the lock (the stream pump races this on fast
@@ -1987,6 +2268,36 @@ class Router:
             t.stream.finish(t.tokens)
         t.done.set()
 
+    def _resolve_hedge(self, t: Ticket, winner: Optional[str]) -> None:
+        """First result arrived for a hedged ticket: count the
+        outcome, drop the loser's in-flight registration, and
+        best-effort cancel its queued work (fire-and-forget on a
+        daemon thread — a wedged loser must not block the harvest)."""
+        won = winner is not None and winner == t.hedge_replica
+        if won:
+            loser_name, loser_rid = t.replica, t.replica_rid
+        else:
+            loser_name, loser_rid = t.hedge_replica, t.hedge_rid
+        if self._rel is not None and won:
+            with self._mu:
+                self._rel.hedge_wins += 1
+        if telemetry.enabled():
+            _router_metrics()["hedges"][
+                "true" if won else "false"].inc()
+            _tracing.event("router.hedge_resolved", ctx=t.trace,
+                           rid=t.rid, won=won, winner=winner)
+        lst = self._replicas.get(loser_name) if loser_name else None
+        if lst is None or loser_rid is None:
+            return
+        with self._mu:
+            lst.inflight.pop(loser_rid, None)
+        cancel = getattr(lst.replica, "cancel", None)
+        if cancel is not None:
+            threading.Thread(
+                target=_swallow, args=(cancel, loser_rid),
+                daemon=True,
+                name=f"pt-router-hedge-cancel-{t.rid}").start()
+
     def _harvest(self, st: _ReplicaState) -> None:
         if not st.inflight:
             return
@@ -1994,6 +2305,12 @@ class Router:
             done = st.replica.drain_results()
         except Exception:
             return  # the probe path owns failure counting
+        self._absorb(st, done)
+
+    def _absorb(self, st: _ReplicaState, done: Dict[int, Dict]) -> None:
+        """Complete tickets from drained result records (the harvest
+        body; the half-open probe reuses it for records that drained
+        alongside its probe request)."""
         for rid, rec in done.items():
             with self._mu:
                 t = st.inflight.pop(rid, None)
@@ -2007,7 +2324,172 @@ class Router:
                     while len(st.orphans) > 256:
                         st.orphans.pop(next(iter(st.orphans)))
                     continue
-            self._finish(t, rec)
+            self._finish(t, rec, replica=st.name)
+
+    # -- reliability sweep (quarantine + hedging + half-open probes) --------
+
+    def _maybe_quarantine(self, st: _ReplicaState, reason: str) -> None:
+        """Trip the breaker on ``st`` UNLESS it is the last placeable
+        replica for its model — a fleet must never quarantine itself
+        to zero (the lone gray replica stays in rotation: slow beats
+        unservable)."""
+        others = [n for n in self._alive_names(st.model)
+                  if n != st.name]
+        if not others:
+            return
+        self._quarantine(st, reason)
+
+    def _quarantine(self, st: _ReplicaState, reason: str) -> None:
+        """Open the breaker: ``st`` leaves placement and affinity
+        (fail-closed, the drain_replica pattern) but keeps draining
+        its in-flight work. REVERSIBLE — a successful half-open probe
+        returns it to rotation."""
+        with self._mu:
+            if st.quarantined:
+                return
+            st.quarantined = True
+            self._rel.health(st.name).trip(reason)
+            self._rel.quarantines += 1
+            for s, n in self._affinity.items():
+                if n == st.name:
+                    self._affinity.pop(s)
+            for h, n in self._prefix_home.items():
+                if n == st.name:
+                    self._prefix_home.pop(h)
+        if telemetry.enabled():
+            _router_metrics()["quarantines"].inc()
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+            _tracing.event("router.quarantine", replica=st.name,
+                           reason=reason)
+        with self._work:
+            self._work.notify_all()  # hinted tickets re-resolve now
+
+    def _reliability_sweep(self) -> None:
+        """One pass of the reliability plane's periodic work (runs on
+        the poll cadence, only when the plane is on): feed queue-depth
+        EWMAs, trip breakers on gray outliers, launch half-open
+        probes when cooldowns expire, and hedge stuck requests."""
+        cfg = self._rel.config
+        states = list(self._replicas.values())
+        med = self._rel.fleet_median_latency()
+        for st in states:
+            if not st.alive:
+                continue
+            if st.quarantined:
+                h = self._rel.health(st.name)
+                if h.probe_due(cfg.quarantine_cooldown_s):
+                    with self._mu:
+                        h.half_open()
+                    threading.Thread(
+                        target=self._half_open_probe, args=(st,),
+                        daemon=True,
+                        name=f"pt-router-probe-{st.name}").start()
+                continue
+            if st.draining:
+                continue
+            with self._mu:
+                h = self._rel.health(st.name)
+                h.note_queue(st.load.get("queue_depth", 0) or 0)
+                reason = self._rel.quarantine_reason(
+                    h, fleet_median=med)
+            if reason is not None:
+                self._maybe_quarantine(st, reason)
+        thr = self._rel.hedge_threshold()
+        if thr is not None:
+            now = time.perf_counter()
+            with self._mu:
+                stuck = [t for st in states
+                         for t in list(st.inflight.values())
+                         if (not t.hedged and t.stream is None
+                             and not t.done.is_set()
+                             and t.max_new <= cfg.hedge_max_new
+                             and t.t_dispatched
+                             and now - t.t_dispatched > thr)]
+            for t in stuck:
+                self._hedge(t)
+
+    def _hedge(self, t: Ticket) -> None:
+        """Issue the hedge: dispatch a DUPLICATE of a stuck request to
+        the least-loaded OTHER placeable replica, same trace id under
+        a ``router.hedge`` span. First result wins (_finish's done
+        guard); the loser is dropped + best-effort cancelled."""
+        with self._mu:
+            best, best_load = None, None
+            for st in self._replicas.values():
+                if (not st.alive or not st.ready or st.draining
+                        or st.quarantined or st.name == t.replica
+                        or not self._model_ok(st, t)):
+                    continue
+                load = (len(st.inflight)
+                        + (st.load.get("queue_depth", 0) or 0))
+                if best_load is None or load < best_load:
+                    best, best_load = st, load
+        if best is None:
+            return  # nowhere to hedge: the primary still owns it
+        telem = telemetry.enabled()
+        cm_bind = _tracing.bind(t.trace) if telem else _NULL_CM
+        cm_dl = (_reliability.bind(t.deadline)
+                 if t.deadline is not None else _NULL_CM)
+        cm_span = (_tracing.span("router.hedge", ctx=t.trace,
+                                 rid=t.rid, primary=t.replica,
+                                 hedge=best.name)
+                   if telem else _NULL_CM)
+        try:
+            with cm_bind, cm_dl, cm_span:
+                rid2 = best.replica.submit(t.prompt, t.max_new,
+                                           session=t.session)
+        except Exception:
+            return  # hedging is opportunistic, never a new failure
+        with self._mu:
+            self._rel.hedges += 1
+            t.hedged = True
+            t.hedge_replica = best.name
+            t.hedge_rid = rid2
+            best.inflight[rid2] = t
+        if telem:
+            _tracing.event("router.hedged", ctx=t.trace, rid=t.rid,
+                           replica=best.name)
+
+    def _half_open_probe(self, st: _ReplicaState) -> None:
+        """One cheap warmed request through the quarantined replica
+        (the breaker's half-open state): success closes the breaker
+        and returns the replica to rotation; failure reopens it and
+        the cooldown restarts."""
+        h = self._rel.health(st.name)
+        deadline = time.monotonic() + self._rel.config.probe_timeout_s
+        try:
+            hz = st.replica.healthz()
+            enforce(hz.get("status") == "ok",
+                    "probe healthz not ok: %r", hz)
+            rid = st.replica.submit(np.asarray([1, 2], np.int32), 1)
+            ok = False
+            while time.monotonic() < deadline:
+                done = st.replica.drain_results()
+                if rid in done:
+                    done.pop(rid)
+                    ok = True
+                self._absorb(st, done)  # in-flight that drained along
+                if ok:
+                    break
+                time.sleep(0.05)
+            enforce(ok, "probe request did not complete within "
+                    "probe_timeout_s")
+        except Exception:
+            with self._mu:
+                h.reopen()
+            if telemetry.enabled():
+                _tracing.event("router.probe_failed", replica=st.name)
+            return
+        with self._mu:
+            h.close()
+            st.quarantined = False
+            st.fails = 0
+        if telemetry.enabled():
+            _router_metrics()["healthy"].set(len(self._alive_names()))
+            _tracing.event("router.quarantine_lifted",
+                           replica=st.name)
+        with self._work:
+            self._work.notify_all()
 
     def _poll_once(self) -> None:
         """One health+results sweep (the poll loop's body; tests drive
@@ -2019,6 +2501,8 @@ class Router:
             self._probe(st)
             if st.inflight:
                 self._harvest(st)
+        if self._rel is not None:
+            self._reliability_sweep()
         if self._dispatch_mode == "pull" and self._pending:
             # probes/harvests may have freed headroom or flipped
             # readiness: wake the pull lanes
@@ -2173,8 +2657,12 @@ def run_worker(spec: Optional[str], role: str = "decode", port: int = 0,
         srv.add_post("/submit", _submit)
         srv.add_sse("/stream", _stream)
         srv.add_post("/drain", lambda b: {"done": {
-            rid: {**rec, "tokens": np.asarray(rec["tokens"]).tolist()}
+            rid: {**rec, "tokens": (
+                np.asarray(rec["tokens"]).tolist()
+                if rec.get("tokens") is not None else None)}
             for rid, rec in rep.drain_results().items()}})
+        srv.add_post("/cancel", lambda b: {"cancelled": rep.cancel(
+            int(json.loads(b.decode())["rid"]))})
         srv.add_post("/inject", _make_inject(rep))
     srv.add_post("/config", lambda b: _worker_config(rep, b))
     srv.add_post("/load", lambda b: rep.load())
@@ -2384,7 +2872,8 @@ def serve_main(spec: Optional[str], replicas: int = 2,
                dispatch: str = "pull",
                prefix_hash_tokens: Optional[int] = 64,
                from_artifact: Optional[str] = None,
-               autoscale: Optional[Sequence[int]] = None) -> Router:
+               autoscale: Optional[Sequence[int]] = None,
+               reliability=None) -> Router:
     """One-command serving bring-up (``python -m paddle_tpu.launch
     --serve``): spawn the replica (and prefill) worker processes, build
     the router over them, and serve the router front-end (POST /submit
@@ -2431,7 +2920,8 @@ def serve_main(spec: Optional[str], replicas: int = 2,
                     trace_sample=trace_sample,
                     textfile_path=textfile_path,
                     dispatch=dispatch,
-                    prefix_hash_tokens=prefix_hash_tokens)
+                    prefix_hash_tokens=prefix_hash_tokens,
+                    reliability=reliability)
     router.start_server(port=port)
     if autoscale is not None:
         from .autoscale import AutoscalePolicy, Scaler
@@ -2520,6 +3010,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "plane: grow/shrink the fleet between MIN and MAX "
                     "replicas against the measured load signals "
                     "(spawns ride --from-artifact when given)")
+    ap.add_argument("--reliability", action="store_true",
+                    help="(router mode) turn on the request "
+                    "reliability plane: end-to-end deadlines, retry "
+                    "budgets, hedged dispatch, gray-failure "
+                    "quarantine")
+    ap.add_argument("--deadline-s", dest="deadline_s", type=float,
+                    default=None,
+                    help="(router mode) default end-to-end request "
+                    "deadline budget in seconds (implies "
+                    "--reliability)")
     args = ap.parse_args(argv)
     autoscale = None
     if args.autoscale:
@@ -2536,6 +3036,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    warm=args.warm, from_artifact=args.from_artifact,
                    model=args.model)
         return 0
+    reliability = None
+    if args.reliability or args.deadline_s is not None:
+        from .resilience import reliability as _rel_mod
+
+        reliability = _rel_mod.ReliabilityConfig(
+            deadline_s=args.deadline_s)
     router = serve_main(args.spec, replicas=args.replicas,
                         prefill_workers=args.prefill_workers,
                         port=args.port, spec_kw=kw,
@@ -2545,7 +3051,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         prefix_hash_tokens=(args.prefix_hash_tokens
                                             or None),
                         from_artifact=args.from_artifact,
-                        autoscale=autoscale)
+                        autoscale=autoscale,
+                        reliability=reliability)
     print(f"[router] serving on {router.server.url()} over "
           f"{args.replicas} replica(s)"
           + (f", autoscaling {autoscale[0]}..{autoscale[1]}"

@@ -75,6 +75,11 @@ def _goodput_metrics(reg):
             "pt_goodput_ratio",
             "active-slot-tokens / arena token capacity",
             labels={"role": "serving"}),
+        "buckets": {b: reg.counter(
+            "pt_goodput_seconds_total",
+            "cumulative step-time decomposition by bucket",
+            unit="s", labels={"bucket": b})
+            for b in _GOODPUT_BUCKETS},
     }
 
 
@@ -106,8 +111,16 @@ class GoodputLedger:
             self._buckets["checkpoint_stall"] += checkpoint_stall
             self._steps += 1
             ratio = self._train_ratio_locked()
-        if ratio is not None and _metrics.enabled():
-            _goodput_metrics()["train"].set(ratio)
+        if _metrics.enabled():
+            m = _goodput_metrics()
+            if ratio is not None:
+                m["train"].set(ratio)
+            for b, v in (("input_wait", input_wait),
+                         ("dispatch", dispatch),
+                         ("device_compute", device_compute),
+                         ("checkpoint_stall", checkpoint_stall)):
+                if v > 0:
+                    m["buckets"][b].inc(v)
 
     def note_checkpoint_stall(self, seconds: float) -> None:
         """A blocking checkpoint save outside the per-step split (the
@@ -115,6 +128,8 @@ class GoodputLedger:
         already landed)."""
         with self._lock:
             self._buckets["checkpoint_stall"] += seconds
+        if seconds > 0 and _metrics.enabled():
+            _goodput_metrics()["buckets"]["checkpoint_stall"].inc(seconds)
 
     def note_tick(self, active_tokens: int, capacity_tokens: int) -> None:
         """One serving tick: tokens the arena actually advanced vs the
@@ -220,7 +235,9 @@ def capture_device_trace(out_dir: str,
             jax.profiler.stop_trace()
         os.makedirs(tmp, exist_ok=True)  # a no-op capture still lands
         os.replace(tmp, out_dir)
-        return {"artifact": out_dir, "pid": os.getpid(),
+        return {"artifact": out_dir,
+                "artifact_id": os.path.basename(out_dir),
+                "pid": os.getpid(),
                 "duration_ms": round(duration_ms, 3),
                 "wall_ms": round((time.perf_counter() - t0) * 1e3, 3)}
     finally:
@@ -235,11 +252,46 @@ def capture_busy() -> bool:
     return True
 
 
-def _default_artifact_dir() -> str:
-    base = os.environ.get("PT_PROFILEZ_DIR") or os.path.join(
+def artifact_base_dir() -> str:
+    """Where /profilez captures land by default (``PT_PROFILEZ_DIR`` or
+    a temp-dir subdirectory) — the root ``GET /profilez/artifact``
+    serves from."""
+    return os.environ.get("PT_PROFILEZ_DIR") or os.path.join(
         tempfile.gettempdir(), "pt_profilez")
-    return os.path.join(base,
+
+
+def _default_artifact_dir() -> str:
+    return os.path.join(artifact_base_dir(),
                         f"capture-{os.getpid()}-{int(time.time())}")
+
+
+def artifact_tar(artifact_id: Optional[str]) -> tuple:
+    """``GET /profilez/artifact?id=<basename>`` backend: one completed
+    capture directory under :func:`artifact_base_dir`, packed as a tar
+    in memory. Returns ``(content_type, payload_bytes)``.
+
+    The id is enforced to a bare directory name — a path separator or
+    dot-dot would let the download endpoint read outside the artifact
+    root."""
+    import io
+    import tarfile
+
+    from ..core.enforce import enforce
+
+    enforce(bool(artifact_id),
+            "profilez artifact id is required (GET ?id=<basename>)")
+    enforce(os.path.basename(artifact_id) == artifact_id
+            and artifact_id not in (".", ".."),
+            "profilez artifact id must be a bare directory name, got %r",
+            artifact_id)
+    path = os.path.join(artifact_base_dir(), artifact_id)
+    enforce(os.path.isdir(path), "no profilez artifact %r under %s "
+            "(POST /profilez to capture one)", artifact_id,
+            artifact_base_dir())
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(path, arcname=artifact_id)
+    return "application/x-tar", buf.getvalue()
 
 
 def make_profilez(default_dir: Optional[str] = None
@@ -380,6 +432,22 @@ class RegressionSentinel:
         except OSError:
             pass
 
+    def seed(self, program: str, backend: str, seconds: float, *,
+             kind: str = "step") -> None:
+        """Pre-arm a baseline from an external record (BENCH_HISTORY).
+
+        A seeded baseline starts PAST the ``min_samples`` warmup — the
+        whole point is alarming on the very first measurement of a
+        fresh session. An existing (observed or previously seeded)
+        baseline is never overwritten."""
+        if seconds is None or seconds <= 0:
+            return
+        key = self._key(program, backend)
+        with self._lock:
+            self._baselines.setdefault(
+                key, {"ewma": float(seconds), "n": self.min_samples,
+                      "kind": kind, "seeded": True})
+
     def observe(self, program: str, backend: str, seconds: float, *,
                 kind: str = "step", degraded: bool = False):
         """Feed one measurement; returns the emitted Diagnostic (or
@@ -462,6 +530,53 @@ def sentinel() -> RegressionSentinel:
     return _sentinel
 
 
+# Reserved BENCH_HISTORY.json key for sentinel baselines. Underscore
+# prefix keeps it out of the metric namespace (the `_superseded`
+# convention) — evaluate_against_history only ever looks up real
+# metric keys, so the section rides along untouched.
+SENTINEL_HISTORY_KEY = "_sentinel"
+
+
+def seed_sentinel_from_history(path: str) -> int:
+    """Arm the process sentinel from BENCH_HISTORY.json's reserved
+    ``"_sentinel"`` section (bench.py folds it in when it records), so
+    a bench session alarms on step-time drift against the LAST
+    session's timings instead of needing ``min_samples`` warmup runs of
+    its own. Returns the number of baselines seeded; a missing file,
+    torn JSON, or absent section seeds zero and never raises."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    rows = data.get(SENTINEL_HISTORY_KEY) if isinstance(data, dict) \
+        else None
+    if not isinstance(rows, dict):
+        return 0
+    s = sentinel()
+    n = 0
+    for key, row in rows.items():
+        try:
+            program, backend = key.split("|", 1)
+            ewma = float(row["ewma"])
+        except (AttributeError, KeyError, TypeError, ValueError):
+            continue  # one malformed row must not block the rest
+        s.seed(program, backend, ewma,
+               kind=row.get("kind", "step") if isinstance(row, dict)
+               else "step")
+        n += 1
+    return n
+
+
+def sentinel_history_entry() -> Dict[str, Dict[str, Any]]:
+    """The ``"_sentinel"`` section bench.py writes into BENCH_HISTORY:
+    the current baselines keyed ``program|backend``, trimmed to the
+    fields :func:`seed_sentinel_from_history` reads back."""
+    return {k: {"ewma": v["ewma"], "n": v["n"],
+                "kind": v.get("kind", "step")}
+            for k, v in sentinel().baselines().items()}
+
+
 def statusz_section() -> Dict[str, Any]:
     """The /statusz ``perf`` section: sentinel alarms + baseline
     count."""
@@ -479,6 +594,8 @@ def reset() -> None:
 
 
 __all__ = ["CaptureBusyError", "GoodputLedger", "RegressionSentinel",
+           "SENTINEL_HISTORY_KEY", "artifact_base_dir", "artifact_tar",
            "capture_busy", "capture_device_trace", "goodput",
-           "make_profilez", "profilez_fanout", "reset", "sentinel",
-           "statusz_section"]
+           "make_profilez", "profilez_fanout", "reset",
+           "seed_sentinel_from_history", "sentinel",
+           "sentinel_history_entry", "statusz_section"]

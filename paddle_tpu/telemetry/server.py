@@ -51,6 +51,7 @@ hook instrumented call-sites invoke is one empty-list check.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -449,6 +450,29 @@ def _make_handler(server: DebugServer):
                 elif path == "/memz":
                     self._send(200, json.dumps(server.memz(),
                                                default=str))
+                elif path == "/profilez/artifact":
+                    # off-host capture download: stream one /profilez
+                    # artifact directory as a tar (GET ?id=<artifact>)
+                    from urllib.parse import parse_qs
+
+                    from . import profiling as _profiling
+
+                    aid = (parse_qs(query).get("id") or [None])[0]
+                    try:
+                        ctype, data = _profiling.artifact_tar(aid)
+                    except Exception as e:
+                        self._send(404, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}))
+                    else:
+                        self.send_response(200)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header(
+                            "Content-Disposition",
+                            f'attachment; filename="{aid}.tar"')
+                        self.send_header("Content-Length",
+                                         str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
                 elif path == "/podz":
                     if server._fleet is None:
                         self._send(404, json.dumps({
@@ -535,25 +559,46 @@ def _make_handler(server: DebugServer):
                 hdr = self.headers.get(_tracing.TRACE_HEADER)
                 ctx = (_tracing.from_header(hdr)
                        if hdr and _metrics.enabled() else None)
+                # cross-process DEADLINE propagation: an incoming
+                # X-PT-Deadline (stamped beside the trace header by
+                # the router's _trace_headers) binds the request's
+                # remaining end-to-end budget for the handler, so a
+                # replica-side submit inherits it through
+                # reliability.current(). A CORRECTNESS header — parsed
+                # and bound whether or not telemetry is enabled
+                # (lazy import: resilience must not load unless a
+                # deadline actually arrives).
+                dhdr = self.headers.get("X-PT-Deadline")
+                dl = None
+                if dhdr:
+                    from ..resilience import reliability as _rel
+
+                    dl = _rel.Deadline.from_header(dhdr)
+                    cm_dl = (_rel.bind(dl) if dl is not None
+                             else contextlib.nullcontext())
+                else:
+                    cm_dl = contextlib.nullcontext()
                 if sse is not None:
                     # streaming endpoint: the context stays bound for
                     # the ITERATOR's whole life (tokens produce spans
                     # too), and rides the response headers back
                     if ctx is not None:
-                        with _tracing.bind(ctx), \
+                        with cm_dl, _tracing.bind(ctx), \
                                 _tracing.span("http.POST " + path,
                                               path=path):
                             self._send_sse(sse(body), ctx)
                     else:
-                        self._send_sse(sse(body))
+                        with cm_dl:
+                            self._send_sse(sse(body))
                     return
                 if ctx is not None:
-                    with _tracing.bind(ctx), \
+                    with cm_dl, _tracing.bind(ctx), \
                             _tracing.span("http.POST " + path,
                                           path=path):
                         out = fn(body)
                 else:
-                    out = fn(body)
+                    with cm_dl:
+                        out = fn(body)
                 if (isinstance(out, tuple) and len(out) == 2
                         and isinstance(out[1], (bytes, bytearray))):
                     ctype, data = out

@@ -114,6 +114,14 @@ SLOW_PATTERNS = [
     "test_autoscale.py::test_sigkill_mid_scale_up_converges",
     "test_autoscale.py::test_sigkill_drain_target_mid_drain",
     "test_autoscale.py::test_autoscale_bench_gate",
+    # reliability-plane subprocess chaos e2es (worker spawns + SIGSTOP
+    # wedge, ~60s): ci.sh mid runs them as their own "reliability
+    # smoke" stage (pytest -m chaos on the file) — the bare
+    # test_reliability.py MID pattern must not pull them into -m mid
+    "test_reliability.py::test_sigstop_worker_quarantined_hedge_"
+    "completes_sigcont_restores",
+    "test_reliability.py::test_retry_budget_exhaustion_is_"
+    "deterministic_e2e",
 ]
 
 # mid tier = smoke + one representative per DEEP subsystem (pallas
@@ -193,6 +201,10 @@ MID_PATTERNS = [
     "test_train_loop.py",
     "test_sharding_plan.py",
     "test_resilience.py",
+    # reliability plane: deadlines, retry budgets, hedging, quarantine
+    # breaker units + deterministic in-process router tests (the
+    # SIGSTOP chaos e2es are pinned slow above)
+    "test_reliability.py",
     "test_chaos.py",
     # autoscale control plane: policy ladder/cooldown units, replay
     # bit-identity, scaler stub loop, drain fail-closed (the SIGKILL

@@ -702,6 +702,49 @@ class TestLint:
                "    return dt\n")
         assert lint_source(src, "x.py") == []
 
+    def test_unbounded_network_call_flagged_timeout_clean(self):
+        """PT-LINT-310: a serving/telemetry/resilience-module network
+        call without an explicit timeout= is an unbounded hop — one
+        SIGSTOP'd peer wedges the caller forever (the gray-failure
+        plane's whole premise is that every hop is bounded)."""
+        src = ("import urllib.request\n"
+               "def fetch(url):\n"
+               "    with urllib.request.urlopen(url) as r:\n"
+               "        return r.read()\n")
+        diags = lint_source(src, "paddle_tpu/telemetry/server.py")
+        assert [d.code for d in diags] == ["PT-LINT-310"]
+        assert diags[0].line == 3
+        assert lint_source(src, "paddle_tpu/serving_router.py") != []
+        # clean twins: timeout kwarg, and the positional form
+        kw = ("import urllib.request\n"
+              "def fetch(url):\n"
+              "    with urllib.request.urlopen(url, timeout=5.0) as r:\n"
+              "        return r.read()\n")
+        assert lint_source(kw, "paddle_tpu/telemetry/server.py") == []
+        pos = ("from urllib.request import urlopen\n"
+               "def fetch(url, body):\n"
+               "    return urlopen(url, body, 5.0).read()\n")
+        assert lint_source(pos, "paddle_tpu/resilience/faults.py") == []
+        # outside the serving/telemetry/resilience planes: not flagged
+        # (an offline tool may legitimately block)
+        assert lint_source(src, "paddle_tpu/utils/fetch.py") == []
+        assert lint_source(src, "tools/bench_diff.py") == []
+
+    def test_unbounded_socket_connect_flagged_timeout_clean(self):
+        src = ("import socket\n"
+               "def dial(addr):\n"
+               "    return socket.create_connection(addr)\n")
+        diags = lint_source(src, "paddle_tpu/autoscale/scaler.py")
+        assert [d.code for d in diags] == ["PT-LINT-310"]
+        kw = ("import socket\n"
+              "def dial(addr, t):\n"
+              "    return socket.create_connection(addr, timeout=t)\n")
+        assert lint_source(kw, "paddle_tpu/autoscale/scaler.py") == []
+        pos = ("import socket\n"
+               "def dial(addr):\n"
+               "    return socket.create_connection(addr, 2.0)\n")
+        assert lint_source(pos, "paddle_tpu/autoscale/scaler.py") == []
+
     def test_unparsable_file_is_a_finding(self):
         diags = lint_source("def f(:\n", "broken.py")
         assert len(diags) == 1 and "does not parse" in diags[0].message

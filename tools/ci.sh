@@ -130,6 +130,12 @@ one spawn/retire e2e on real in-process replicas; the SIGKILL chaos \
 pair and the spike A/B bench gate ride the full suite only)"
     JAX_PLATFORMS=cpu python -m pytest tests/test_autoscale.py \
       -q -k "replay or spawn_retire_e2e" || exit $?
+    stage "reliability smoke (SIGSTOP a worker mid-stream -> gray \
+quarantine + hedge completes within deadline -> SIGCONT half-open \
+probe restores; plus seeded retry-budget-exhaustion determinism; \
+the fast deadline/budget/breaker units ride -m mid above)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_reliability.py \
+      -q -m chaos || exit $?
     stage "dist smoke (REAL 2-process jax.distributed job: preempt \
 agreement + a step-agreed periodic save, both over the LIVE \
 ClientTransport KV — not the file fallback)"
